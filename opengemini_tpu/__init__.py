@@ -1,0 +1,29 @@
+"""openGemini-TPU: a TPU-native distributed time-series database framework.
+
+A from-scratch re-design of the capabilities of openGemini (reference:
+/root/reference, a Go MPP time-series DB) for TPU hardware:
+
+- CPU side: line-protocol ingest, WAL + columnar memtable, immutable columnar
+  files with per-chunk pre-aggregation, inverted tag index, InfluxQL/PromQL
+  parsing and planning, metadata plane.
+- TPU side (JAX/XLA/Pallas): the hot scan->group->reduce stage of queries and
+  downsampling runs as jitted segmented window reductions over device arrays,
+  distributed across a `jax.sharding.Mesh` with XLA collectives replacing the
+  reference's spdy RPC exchange (reference: lib/spdy, engine/executor).
+
+Layout:
+  record.py   columnar in-memory format (reference: lib/record/record.go:57)
+  ops/        device kernels: segmented reductions, prom functions, pallas
+  parallel/   mesh + shard_map distributed execution
+  storage/    WAL, memtable, immutable file format, shard, engine
+  index/      inverted tag index (reference: engine/index/tsi)
+  sql/        InfluxQL parser (reference: lib/util/lifted/influx/influxql)
+  promql/     PromQL parser + transpiler (reference: lib/util/lifted/promql2influxql)
+  query/      planner + executor (reference: engine/executor)
+  meta/       metadata plane (reference: lib/util/lifted/influx/meta)
+  server/     HTTP protocol front-end (reference: lib/util/lifted/influx/httpd)
+  services/   retention, downsample, continuous queries (reference: services/)
+  models/     flagship jittable query compute graphs (plan templates)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+"""Device kernels: segmented window reductions, prom stencils, pallas.
+
+This package is the TPU-native replacement for the reference's generated
+per-type reduce kernels (engine/series_agg_func.gen.go — 45 reduce/merge
+functions, series_agg_reducer.gen.go — 148 functions) and its pluggable
+CoProcessor/Reducer seam (engine/coprocessor.go:43-101): instead of scalar Go
+loops per (type, agg) pair, every aggregate is a masked segmented reduction
+over (series-group, time-window) segment ids, jitted once per plan template
+and executed on the MXU/VPU.
+"""
+
+from opengemini_tpu.ops import segment, window  # noqa: F401

@@ -1,0 +1,92 @@
+"""Aggregate function registry: InfluxQL call name -> device reduction.
+
+The declarative replacement for the reference's call-processor dispatch
+(engine/executor/call_processor.go + agg_func.go): each entry knows how to
+compute per-segment outputs from a masked device batch and how the executor
+should render results (selector timestamps, integer vs float output).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from opengemini_tpu.ops import segment as seg
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    name: str
+    # fn(values, rel_t, seg_ids, num_segments, mask, *params)
+    #   -> (out_values, out_rel_t | None)
+    fn: Callable
+    is_selector: bool = False  # returns the selected point's own timestamp
+    int_output: bool = False  # count-like: render as int
+    needs_time: bool = False
+    params: tuple = field(default_factory=tuple)  # e.g. percentile q
+
+
+def _wrap_plain(f):
+    def run(values, rel_t, seg_ids, num_segments, mask, *params):
+        return f(values, seg_ids, num_segments, mask, *params), None
+
+    return run
+
+
+def _count(values, rel_t, seg_ids, n, mask):
+    return seg.seg_count(seg_ids, n, mask), None
+
+
+def _spread(values, rel_t, seg_ids, n, mask):
+    mx = seg.seg_max(values, seg_ids, n, mask)
+    mn = seg.seg_min(values, seg_ids, n, mask)
+    return mx - mn, None
+
+
+def _min_sel(values, rel_t, seg_ids, n, mask):
+    v, t, _ = seg.seg_min_selector(values, rel_t, seg_ids, n, mask)
+    return v, t
+
+
+def _max_sel(values, rel_t, seg_ids, n, mask):
+    v, t, _ = seg.seg_max_selector(values, rel_t, seg_ids, n, mask)
+    return v, t
+
+
+def _first(values, rel_t, seg_ids, n, mask):
+    v, t, _ = seg.seg_first(values, rel_t, seg_ids, n, mask)
+    return v, t
+
+
+def _last(values, rel_t, seg_ids, n, mask):
+    v, t, _ = seg.seg_last(values, rel_t, seg_ids, n, mask)
+    return v, t
+
+
+REGISTRY: dict[str, AggSpec] = {
+    "count": AggSpec("count", _count, int_output=True),
+    "sum": AggSpec("sum", _wrap_plain(seg.seg_sum)),
+    "mean": AggSpec("mean", _wrap_plain(seg.seg_mean)),
+    "min": AggSpec("min", _min_sel, is_selector=True, needs_time=True),
+    "max": AggSpec("max", _max_sel, is_selector=True, needs_time=True),
+    "first": AggSpec("first", _first, is_selector=True, needs_time=True),
+    "last": AggSpec("last", _last, is_selector=True, needs_time=True),
+    "spread": AggSpec("spread", _spread),
+    "stddev": AggSpec("stddev", _wrap_plain(seg.seg_stddev)),
+    "median": AggSpec("median", _wrap_plain(seg.seg_median)),
+    "percentile": AggSpec("percentile", _wrap_plain(seg.seg_percentile)),
+    "count_distinct": AggSpec(
+        "count_distinct", _wrap_plain(seg.seg_count_distinct), int_output=True
+    ),
+}
+
+
+def get(name: str) -> AggSpec:
+    spec = REGISTRY.get(name.lower())
+    if spec is None:
+        raise KeyError(f"unsupported aggregate function: {name}")
+    return spec
+
+
+def supported() -> list[str]:
+    return sorted(REGISTRY)

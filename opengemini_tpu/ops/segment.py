@@ -1,0 +1,198 @@
+"""Masked segmented reductions — the device hot loop.
+
+Each aggregate over (series-group, time-window) segments is a masked
+segmented reduction with segment id ``group_id * num_windows + window_id``.
+Rows arrive series-major and time-sorted within a series, so segment ids are
+sorted within each series run — ``indices_are_sorted`` is still False
+globally (multiple series interleave), but XLA's scatter-based segment ops
+handle this well, and the Pallas kernel (pallas_segment.py) exploits
+within-tile locality.
+
+This replaces the reference's generated scalar reduce loops
+(engine/series_agg_func.gen.go: floatSumReduce:47 etc., 45 fns;
+series_agg_reducer.gen.go, 148 fns): one masked-segment-reduce per aggregate
+instead of one hand-written loop per (type, agg).
+
+All functions are pure and jit-traceable; ``num_segments`` must be static.
+Null semantics: ``mask`` False rows contribute nothing; empty segments
+produce count==0 and the executor renders them as null/fill values
+(reference nil-bitmap semantics, lib/record/column.go:30).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_BIG_I32 = jnp.int32(2**31 - 1)
+
+
+def seg_sum(values, seg_ids, num_segments: int, mask):
+    data = jnp.where(mask, values, jnp.zeros((), values.dtype))
+    return jax.ops.segment_sum(data, seg_ids, num_segments=num_segments)
+
+
+def seg_count(seg_ids, num_segments: int, mask):
+    data = mask.astype(jnp.int32)
+    return jax.ops.segment_sum(data, seg_ids, num_segments=num_segments)
+
+
+def seg_min(values, seg_ids, num_segments: int, mask):
+    big = _type_max(values.dtype)
+    data = jnp.where(mask, values, big)
+    return jax.ops.segment_min(data, seg_ids, num_segments=num_segments)
+
+
+def seg_max(values, seg_ids, num_segments: int, mask):
+    small = _type_min(values.dtype)
+    data = jnp.where(mask, values, small)
+    return jax.ops.segment_max(data, seg_ids, num_segments=num_segments)
+
+
+def seg_mean(values, seg_ids, num_segments: int, mask):
+    s = seg_sum(values, seg_ids, num_segments, mask)
+    c = seg_count(seg_ids, num_segments, mask)
+    return s / jnp.maximum(c, 1).astype(s.dtype)
+
+
+def seg_sumsq(values, seg_ids, num_segments: int, mask):
+    data = jnp.where(mask, values * values, jnp.zeros((), values.dtype))
+    return jax.ops.segment_sum(data, seg_ids, num_segments=num_segments)
+
+
+def seg_stddev(values, seg_ids, num_segments: int, mask):
+    """Sample stddev, n-1 denominator (influx stddev semantics, reference
+    engine/series_agg_func.gen.go float stddev reducers).
+
+    Two-pass (mean, then squared deviations): the one-pass sum-of-squares
+    formula cancels catastrophically for large means, especially in f32 on
+    TPU. Cost is still two segment-sums — same shape on device.
+    """
+    mean = seg_mean(values, seg_ids, num_segments, mask)
+    dev = values - mean[seg_ids]
+    ssd = jax.ops.segment_sum(
+        jnp.where(mask, dev * dev, jnp.zeros((), values.dtype)),
+        seg_ids,
+        num_segments=num_segments,
+    )
+    c = seg_count(seg_ids, num_segments, mask).astype(values.dtype)
+    var = ssd / jnp.maximum(c - 1, 1)
+    return jnp.sqrt(jnp.maximum(var, 0))
+
+
+def seg_first(values, rel_t, seg_ids, num_segments: int, mask):
+    """(value, rel_t, row_idx) of the earliest valid row per segment; scan
+    order breaks timestamp ties (reference first/last tie semantics,
+    engine/series_agg_func.gen.go FirstReduce)."""
+    return _seg_extreme_by_time(values, rel_t, seg_ids, num_segments, mask, latest=False)
+
+
+def seg_last(values, rel_t, seg_ids, num_segments: int, mask):
+    return _seg_extreme_by_time(values, rel_t, seg_ids, num_segments, mask, latest=True)
+
+
+def _seg_extreme_by_time(values, rel_t, seg_ids, num_segments, mask, latest):
+    n = values.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    if latest:
+        t_ext = jax.ops.segment_max(
+            jnp.where(mask, rel_t, -_BIG_I32), seg_ids, num_segments=num_segments
+        )
+        cand = mask & (rel_t == t_ext[seg_ids])
+        # last occurrence in scan order among equal timestamps
+        sel = jax.ops.segment_max(
+            jnp.where(cand, idx, -_BIG_I32), seg_ids, num_segments=num_segments
+        )
+    else:
+        t_ext = jax.ops.segment_min(
+            jnp.where(mask, rel_t, _BIG_I32), seg_ids, num_segments=num_segments
+        )
+        cand = mask & (rel_t == t_ext[seg_ids])
+        sel = jax.ops.segment_min(
+            jnp.where(cand, idx, _BIG_I32), seg_ids, num_segments=num_segments
+        )
+    safe = jnp.clip(sel, 0, n - 1)
+    return values[safe], t_ext, sel
+
+
+def seg_min_selector(values, rel_t, seg_ids, num_segments: int, mask):
+    """min() as a *selector*: also returns the timestamp of the (first)
+    minimum row — InfluxQL bare-selector queries return the point's own time
+    (reference MinReduce keeps the row, series_agg_func.gen.go)."""
+    return _seg_extreme_by_value(values, rel_t, seg_ids, num_segments, mask, want_max=False)
+
+
+def seg_max_selector(values, rel_t, seg_ids, num_segments: int, mask):
+    return _seg_extreme_by_value(values, rel_t, seg_ids, num_segments, mask, want_max=True)
+
+
+def _seg_extreme_by_value(values, rel_t, seg_ids, num_segments, mask, want_max):
+    n = values.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    if want_max:
+        v_ext = seg_max(values, seg_ids, num_segments, mask)
+    else:
+        v_ext = seg_min(values, seg_ids, num_segments, mask)
+    cand = mask & (values == v_ext[seg_ids])
+    sel = jax.ops.segment_min(
+        jnp.where(cand, idx, _BIG_I32), seg_ids, num_segments=num_segments
+    )
+    safe = jnp.clip(sel, 0, n - 1)
+    return v_ext, rel_t[safe], sel
+
+
+def _sort_by_segment(values, seg_ids, num_segments, mask):
+    """Shared prologue for rank-based aggregates: rows sorted by
+    (segment, value) with invalid rows pushed into a trailing dummy segment.
+    Returns (sorted_values, sorted_seg, counts, starts)."""
+    sort_seg = jnp.where(mask, seg_ids, num_segments)
+    order = jnp.lexsort((values, sort_seg))
+    counts = seg_count(seg_ids, num_segments, mask)
+    starts = jnp.cumsum(counts) - counts
+    return values[order], sort_seg[order], counts, starts
+
+
+def seg_percentile(values, seg_ids, num_segments: int, mask, q: float):
+    """Nearest-rank percentile per segment (InfluxQL percentile(): returns an
+    actual sample, rank = ceil(q/100 * n); reference
+    engine/executor/agg_func.go percentile processors)."""
+    n = values.shape[0]
+    sorted_vals, _, counts, starts = _sort_by_segment(values, seg_ids, num_segments, mask)
+    rank = jnp.ceil(q / 100.0 * counts).astype(jnp.int32)
+    rank = jnp.clip(rank - 1, 0, jnp.maximum(counts - 1, 0))
+    sel = jnp.clip(starts + rank, 0, n - 1)
+    return sorted_vals[sel]
+
+
+def seg_median(values, seg_ids, num_segments: int, mask):
+    """InfluxQL median(): middle value, or mean of the two middles for even
+    counts (reference agg_func.go median handling)."""
+    n = values.shape[0]
+    sorted_vals, _, counts, starts = _sort_by_segment(values, seg_ids, num_segments, mask)
+    lo = starts + jnp.maximum((counts - 1) // 2, 0)
+    hi = starts + jnp.maximum(counts // 2, 0)
+    lo_v = sorted_vals[jnp.clip(lo, 0, n - 1)]
+    hi_v = sorted_vals[jnp.clip(hi, 0, n - 1)]
+    return (lo_v + hi_v) / 2
+
+
+def seg_count_distinct(values, seg_ids, num_segments: int, mask):
+    """count(distinct(field)) — sort by (seg, value), count run heads."""
+    sv, ss, _, _ = _sort_by_segment(values, seg_ids, num_segments, mask)
+    head = jnp.ones_like(ss, dtype=jnp.int32)
+    same = (ss[1:] == ss[:-1]) & (sv[1:] == sv[:-1])
+    head = head.at[1:].set(jnp.where(same, 0, 1))
+    head = jnp.where(ss < num_segments, head, 0)
+    return jax.ops.segment_sum(head, jnp.clip(ss, 0, num_segments - 1), num_segments=num_segments)
+
+
+def _type_max(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype)
+
+
+def _type_min(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).min, dtype)

@@ -1,0 +1,253 @@
+"""Columnar in-memory record format.
+
+The device-friendly analogue of the reference's `lib/record.Record`
+(record.go:57) / `ColVal` (column.go:30): struct-of-arrays with explicit
+validity masks instead of packed nil-bitmaps, so columns map 1:1 onto
+(values, mask) device array pairs.
+
+Field types follow InfluxDB semantics: float64, int64, bool, string.
+Strings never go to the device; group keys are dictionary-encoded on the CPU
+before transfer.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+class FieldType(enum.IntEnum):
+    """Field types (reference: lib/record/record.go influx.Field_Type_*)."""
+
+    FLOAT = 1
+    INT = 2
+    BOOL = 3
+    STRING = 4
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return _NP_DTYPES[self]
+
+
+_NP_DTYPES = {
+    FieldType.FLOAT: np.dtype(np.float64),
+    FieldType.INT: np.dtype(np.int64),
+    FieldType.BOOL: np.dtype(np.bool_),
+    FieldType.STRING: np.dtype(object),
+}
+
+TIME_COL = "time"
+
+
+def np_to_field_type(dtype: np.dtype) -> FieldType:
+    if dtype.kind == "f":
+        return FieldType.FLOAT
+    if dtype.kind in ("i", "u"):
+        return FieldType.INT
+    if dtype.kind == "b":
+        return FieldType.BOOL
+    return FieldType.STRING
+
+
+@dataclass
+class Column:
+    """A single column: values plus a validity mask (True = present).
+
+    Equivalent of the reference ColVal's Val+Bitmap (lib/record/column.go:30),
+    unpacked for device friendliness.
+    """
+
+    ftype: FieldType
+    values: np.ndarray
+    valid: np.ndarray
+
+    @classmethod
+    def empty(cls, ftype: FieldType) -> "Column":
+        return cls(ftype, np.empty(0, dtype=ftype.np_dtype), np.empty(0, dtype=np.bool_))
+
+    @classmethod
+    def from_values(cls, ftype: FieldType, values, valid=None) -> "Column":
+        arr = np.asarray(values, dtype=ftype.np_dtype)
+        if valid is None:
+            v = np.ones(len(arr), dtype=np.bool_)
+        else:
+            v = np.asarray(valid, dtype=np.bool_)
+        return cls(ftype, arr, v)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def take(self, idx: np.ndarray) -> "Column":
+        return Column(self.ftype, self.values[idx], self.valid[idx])
+
+    def concat(self, other: "Column") -> "Column":
+        assert self.ftype == other.ftype
+        return Column(
+            self.ftype,
+            np.concatenate([self.values, other.values]),
+            np.concatenate([self.valid, other.valid]),
+        )
+
+
+@dataclass
+class Record:
+    """A batch of rows for one series (or one measurement slice): a time
+    column plus named field columns, all equal length.
+
+    times are int64 nanoseconds since epoch (InfluxDB convention).
+    """
+
+    times: np.ndarray  # int64 ns
+    columns: dict[str, Column] = field(default_factory=dict)
+
+    @classmethod
+    def empty(cls) -> "Record":
+        return cls(np.empty(0, dtype=np.int64), {})
+
+    def __len__(self) -> int:
+        return len(self.times)
+
+    @property
+    def field_names(self) -> list[str]:
+        return list(self.columns.keys())
+
+    def take(self, idx: np.ndarray) -> "Record":
+        return Record(self.times[idx], {k: c.take(idx) for k, c in self.columns.items()})
+
+    def concat(self, other: "Record") -> "Record":
+        if len(self) == 0:
+            return other
+        if len(other) == 0:
+            return self
+        cols: dict[str, Column] = {}
+        names = list(self.columns.keys()) + [
+            k for k in other.columns if k not in self.columns
+        ]
+        n_self, n_other = len(self), len(other)
+        for k in names:
+            a = self.columns.get(k)
+            b = other.columns.get(k)
+            if a is None:
+                a = _null_column(b.ftype, n_self)
+            if b is None:
+                b = _null_column(a.ftype, n_other)
+            cols[k] = a.concat(b)
+        return Record(np.concatenate([self.times, other.times]), cols)
+
+    def sort_by_time(self, descending: bool = False) -> "Record":
+        """Stable sort by time. With duplicate timestamps the LAST occurrence
+        wins on dedup (reference last-write-wins merge semantics,
+        lib/record/merge.go)."""
+        order = np.argsort(self.times, kind="stable")
+        if descending:
+            order = order[::-1]
+        return self.take(order)
+
+    def dedup_last_wins(self) -> "Record":
+        """Assumes time-sorted ascending; keeps the last row per timestamp."""
+        if len(self) <= 1:
+            return self
+        keep = np.empty(len(self), dtype=np.bool_)
+        keep[:-1] = self.times[:-1] != self.times[1:]
+        keep[-1] = True
+        if keep.all():
+            return self
+        return self.take(np.nonzero(keep)[0])
+
+    def slice_time(self, t_min: int, t_max: int) -> "Record":
+        """Rows with t_min <= time < t_max (assumes nothing about order)."""
+        m = (self.times >= t_min) & (self.times < t_max)
+        if m.all():
+            return self
+        return self.take(np.nonzero(m)[0])
+
+
+def _null_column(ftype: FieldType, n: int) -> Column:
+    if ftype == FieldType.STRING:
+        vals = np.full(n, None, dtype=object)
+    else:
+        vals = np.zeros(n, dtype=ftype.np_dtype)
+    return Column(ftype, vals, np.zeros(n, dtype=np.bool_))
+
+
+class RecordBuilder:
+    """Row-at-a-time appender producing a Record; used by the memtable.
+
+    Maintains per-field python lists and converts to numpy on build — O(1)
+    amortized appends without numpy realloc churn.
+    """
+
+    def __init__(self) -> None:
+        self._times: list[int] = []
+        self._cols: dict[str, tuple[FieldType, list, list]] = {}
+
+    def __len__(self) -> int:
+        return len(self._times)
+
+    def append_row(self, t: int, fields: dict[str, tuple[FieldType, object]]) -> None:
+        # Validate the whole point before mutating any state: a rejected
+        # point must not leave a phantom row behind (the reference rejects
+        # whole points at routeAndMapOriginRows, coordinator/points_writer.go:381).
+        for name, (ftype, _) in fields.items():
+            col = self._cols.get(name)
+            if col is not None and col[0] != ftype:
+                raise FieldTypeConflict(name, col[0], ftype)
+        row_i = len(self._times)
+        self._times.append(t)
+        for name, (ftype, value) in fields.items():
+            col = self._cols.get(name)
+            if col is None:
+                col = (ftype, [], [])
+                self._cols[name] = col
+            _, vals, idxs = col
+            vals.append(value)
+            idxs.append(row_i)
+
+    def build(self) -> Record:
+        n = len(self._times)
+        times = np.asarray(self._times, dtype=np.int64)
+        cols: dict[str, Column] = {}
+        for name, (ftype, vals, idxs) in self._cols.items():
+            valid = np.zeros(n, dtype=np.bool_)
+            idx_arr = np.asarray(idxs, dtype=np.int64)
+            valid[idx_arr] = True
+            if ftype == FieldType.STRING:
+                values = np.full(n, None, dtype=object)
+            else:
+                values = np.zeros(n, dtype=ftype.np_dtype)
+            values[idx_arr] = np.asarray(vals, dtype=ftype.np_dtype)
+            cols[name] = Column(ftype, values, valid)
+        return Record(times, cols)
+
+
+class FieldTypeConflict(Exception):
+    """Write with a field type conflicting with the existing schema
+    (reference rejects these at routeAndMapOriginRows,
+    coordinator/points_writer.go:381)."""
+
+    def __init__(self, name: str, have: FieldType, got: FieldType):
+        super().__init__(
+            f"field type conflict for {name!r}: have {have.name}, got {got.name}"
+        )
+        self.field = name
+        self.have = have
+        self.got = got
+
+
+def merge_sorted_records(records: list[Record]) -> Record:
+    """Merge time-sorted records into one sorted, deduped record.
+
+    Later entries in `records` win on duplicate timestamps (caller passes
+    older files first, memtable last — the reference's out-of-order merge
+    ordering, engine/immutable/merge_tool.go)."""
+    recs = [r for r in records if len(r)]
+    if not recs:
+        return Record.empty()
+    if len(recs) == 1:
+        return recs[0].sort_by_time().dedup_last_wins()
+    merged = recs[0]
+    for r in recs[1:]:
+        merged = merged.concat(r)
+    return merged.sort_by_time().dedup_last_wins()

@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax import.
+
+Mirrors the reference's mock_tsdb_system strategy (SURVEY.md §4: distributed
+executor tested without a cluster): sharding/collective logic runs on
+xla_force_host_platform_device_count=8 CPU devices; real-TPU paths are
+exercised by bench.py on hardware.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The axon TPU plugin environment pins JAX_PLATFORMS=axon via sitecustomize;
+# the config update below (not the env var) is what actually forces CPU here.
+jax.config.update("jax_platforms", "cpu")
+
+# x64 on the CPU test mesh for exact float64/int64 parity with numpy oracles;
+# device code is dtype-explicit so it also runs with x64 off (TPU).
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

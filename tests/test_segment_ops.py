@@ -1,0 +1,191 @@
+"""Golden tests: device segmented reductions vs numpy oracles.
+
+The parity bar from SURVEY.md §7 step 2: exact result parity with the
+reference's Go reducers (series_agg_func.gen.go), modeled here as numpy
+per-group loops.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from opengemini_tpu.ops import segment as seg
+from opengemini_tpu.ops import window
+
+
+def make_batch(rng, n=500, num_segments=37, null_frac=0.2):
+    values = rng.normal(size=n)
+    seg_ids = np.sort(rng.integers(0, num_segments, size=n)).astype(np.int32)
+    mask = rng.random(n) > null_frac
+    rel_t = rng.integers(0, 10_000, size=n).astype(np.int32)
+    return (
+        jnp.asarray(values),
+        jnp.asarray(rel_t),
+        jnp.asarray(seg_ids),
+        jnp.asarray(mask),
+        values,
+        rel_t,
+        np.asarray(seg_ids),
+        mask,
+        num_segments,
+    )
+
+
+def group_rows(np_seg, ns):
+    return [np.nonzero(np_seg == s)[0] for s in range(ns)]
+
+
+def test_sum_count_mean(rng):
+    jv, jt, js, jm, v, t, s, m, ns = make_batch(rng)
+    got_sum = np.asarray(seg.seg_sum(jv, js, ns, jm))
+    got_cnt = np.asarray(seg.seg_count(js, ns, jm))
+    got_mean = np.asarray(seg.seg_mean(jv, js, ns, jm))
+    for sid, rows in enumerate(group_rows(s, ns)):
+        vals = v[rows][m[rows]]
+        assert got_cnt[sid] == len(vals)
+        assert np.isclose(got_sum[sid], vals.sum() if len(vals) else 0.0)
+        if len(vals):
+            assert np.isclose(got_mean[sid], vals.mean())
+
+
+def test_min_max(rng):
+    jv, jt, js, jm, v, t, s, m, ns = make_batch(rng)
+    got_min = np.asarray(seg.seg_min(jv, js, ns, jm))
+    got_max = np.asarray(seg.seg_max(jv, js, ns, jm))
+    for sid, rows in enumerate(group_rows(s, ns)):
+        vals = v[rows][m[rows]]
+        if len(vals):
+            assert got_min[sid] == vals.min()
+            assert got_max[sid] == vals.max()
+
+
+def test_first_last(rng):
+    jv, jt, js, jm, v, t, s, m, ns = make_batch(rng)
+    fv, ft, _ = seg.seg_first(jv, jt, js, ns, jm)
+    lv, lt, _ = seg.seg_last(jv, jt, js, ns, jm)
+    fv, ft, lv, lt = map(np.asarray, (fv, ft, lv, lt))
+    for sid, rows in enumerate(group_rows(s, ns)):
+        rows = rows[m[rows]]
+        if not len(rows):
+            continue
+        tmin, tmax = t[rows].min(), t[rows].max()
+        first_rows = rows[t[rows] == tmin]
+        last_rows = rows[t[rows] == tmax]
+        assert ft[sid] == tmin and fv[sid] == v[first_rows[0]]
+        assert lt[sid] == tmax and lv[sid] == v[last_rows[-1]]
+
+
+def test_selectors_min_max_time(rng):
+    jv, jt, js, jm, v, t, s, m, ns = make_batch(rng)
+    mv, mt, _ = seg.seg_min_selector(jv, jt, js, ns, jm)
+    xv, xt, _ = seg.seg_max_selector(jv, jt, js, ns, jm)
+    mv, mt, xv, xt = map(np.asarray, (mv, mt, xv, xt))
+    for sid, rows in enumerate(group_rows(s, ns)):
+        rows = rows[m[rows]]
+        if not len(rows):
+            continue
+        i_min = rows[np.argmin(v[rows])]
+        i_max = rows[np.argmax(v[rows])]
+        assert mv[sid] == v[i_min] and mt[sid] == t[i_min]
+        assert xv[sid] == v[i_max] and xt[sid] == t[i_max]
+
+
+def test_stddev_spread(rng):
+    jv, jt, js, jm, v, t, s, m, ns = make_batch(rng)
+    got_std = np.asarray(seg.seg_stddev(jv, js, ns, jm))
+    for sid, rows in enumerate(group_rows(s, ns)):
+        vals = v[rows][m[rows]]
+        if len(vals) >= 2:
+            assert np.isclose(got_std[sid], vals.std(ddof=1))
+
+
+@pytest.mark.parametrize("q", [10.0, 50.0, 90.0, 99.0])
+def test_percentile(rng, q):
+    jv, jt, js, jm, v, t, s, m, ns = make_batch(rng)
+    got = np.asarray(seg.seg_percentile(jv, js, ns, jm, q))
+    for sid, rows in enumerate(group_rows(s, ns)):
+        vals = np.sort(v[rows][m[rows]])
+        if not len(vals):
+            continue
+        rank = max(int(np.ceil(q / 100.0 * len(vals))) - 1, 0)
+        assert got[sid] == vals[rank]
+
+
+def test_median(rng):
+    jv, jt, js, jm, v, t, s, m, ns = make_batch(rng)
+    got = np.asarray(seg.seg_median(jv, js, ns, jm))
+    for sid, rows in enumerate(group_rows(s, ns)):
+        vals = v[rows][m[rows]]
+        if len(vals):
+            assert np.isclose(got[sid], np.median(vals))
+
+
+def test_count_distinct(rng):
+    n, ns = 400, 11
+    values = rng.integers(0, 5, size=n).astype(np.float64)
+    s = np.sort(rng.integers(0, ns, size=n)).astype(np.int32)
+    m = rng.random(n) > 0.2
+    got = np.asarray(
+        seg.seg_count_distinct(jnp.asarray(values), jnp.asarray(s), ns, jnp.asarray(m))
+    )
+    for sid in range(ns):
+        vals = values[(s == sid) & m]
+        assert got[sid] == len(np.unique(vals))
+
+
+def test_empty_segments_render_zero_count(rng):
+    ns = 8
+    jv = jnp.asarray(np.array([1.0, 2.0]))
+    js = jnp.asarray(np.array([3, 3], dtype=np.int32))
+    jm = jnp.asarray(np.array([True, True]))
+    cnt = np.asarray(seg.seg_count(js, ns, jm))
+    assert cnt.tolist() == [0, 0, 0, 2, 0, 0, 0, 0]
+
+
+class TestWindow:
+    def test_window_start_alignment(self):
+        minute = 60_000_000_000
+        assert window.window_start(125_000_000_000, minute) == 120_000_000_000
+        # negative times floor correctly
+        assert window.window_start(-1, minute) == -minute
+
+    def test_window_index_and_count(self):
+        minute = 60_000_000_000
+        times = np.array([0, 59, 60, 119, 180], dtype=np.int64) * 1_000_000_000
+        idx, aligned = window.window_index(times, 30_000_000_000, minute)
+        assert aligned == 0
+        assert idx.tolist() == [0, 0, 1, 1, 3]
+        assert window.num_windows(30_000_000_000, 181_000_000_000, minute) == 4
+
+    def test_dictionary_encode(self):
+        codes, uniq = window.dictionary_encode(["b", "a", "b", "c", "a"])
+        assert codes.tolist() == [0, 1, 0, 2, 1]
+        assert uniq == ["b", "a", "c"]
+
+
+def test_stddev_large_mean_no_cancellation(rng):
+    """Regression: one-pass sum-of-squares formula returned ~51 instead of
+    ~0.97 for values with mean 1e9 (catastrophic cancellation)."""
+    n, ns = 100, 1
+    v = 1e9 + rng.normal(size=n)
+    got = np.asarray(
+        seg.seg_stddev(
+            jnp.asarray(v),
+            jnp.zeros(n, dtype=jnp.int32),
+            ns,
+            jnp.ones(n, dtype=bool),
+        )
+    )
+    assert np.isclose(got[0], v.std(ddof=1), rtol=1e-6)
+
+
+def test_builder_rejects_whole_point_on_type_conflict():
+    """Regression: a rejected point must not leave a phantom row behind."""
+    from opengemini_tpu.record import RecordBuilder, FieldType, FieldTypeConflict
+
+    b = RecordBuilder()
+    b.append_row(1, {"a": (FieldType.FLOAT, 1.0)})
+    with pytest.raises(FieldTypeConflict):
+        b.append_row(2, {"x": (FieldType.FLOAT, 9.0), "a": (FieldType.INT, 2)})
+    rec = b.build()
+    assert len(rec) == 1 and "x" not in rec.columns
