@@ -1,0 +1,1 @@
+"""Series indexing (reference: engine/index/tsi mergeset inverted index)."""

@@ -1,0 +1,126 @@
+"""Inverted tag index: tag postings -> series ids.
+
+The role of the reference's mergeset-based tsi index
+(engine/index/tsi/mergeset_index.go, search.go): map tag filters to series
+id sets, series ids back to (measurement, tags). In-memory dict postings
+with an append-only on-disk log for durability; high-cardinality scaling
+later moves the postings into the C++ side, the API stays.
+
+Persistence format (series.log): one JSON array per line,
+    [sid, measurement, [[k, v], ...]]
+appended on series creation and replayed on open — JSON so arbitrary tag
+values (commas, tabs, '=') can never corrupt the log. Writes are buffered
+by the shard's WAL-sync cadence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+from opengemini_tpu.ingest.line_protocol import series_key
+
+
+class SeriesIndex:
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self.key_to_sid: dict[str, int] = {}
+        self.sid_to_series: dict[int, tuple[str, tuple]] = {}
+        # measurement -> set[sid]
+        self.mst_sids: dict[str, set[int]] = {}
+        # (measurement, tag_key, tag_value) -> set[sid]
+        self.postings: dict[tuple[str, str, str], set[int]] = {}
+        self._next_sid = 1
+        self._log = None
+        if path is not None:
+            self._replay()
+            self._log = open(path, "a", encoding="utf-8")
+
+    # -- write side ---------------------------------------------------------
+
+    def get_or_create(self, measurement: str, tags: tuple) -> int:
+        key = series_key(measurement, tags)
+        sid = self.key_to_sid.get(key)
+        if sid is not None:
+            return sid
+        sid = self._insert(measurement, tags, key)
+        if self._log is not None:
+            self._log.write(
+                json.dumps([sid, measurement, [list(t) for t in tags]]) + "\n"
+            )
+        return sid
+
+    def _insert(self, measurement: str, tags: tuple, key: str, sid: int | None = None) -> int:
+        if sid is None:
+            sid = self._next_sid
+        self._next_sid = max(self._next_sid, sid + 1)
+        self.key_to_sid[key] = sid
+        self.sid_to_series[sid] = (measurement, tags)
+        self.mst_sids.setdefault(measurement, set()).add(sid)
+        for k, v in tags:
+            self.postings.setdefault((measurement, k, v), set()).add(sid)
+        return sid
+
+    def flush(self) -> None:
+        if self._log is not None:
+            self._log.flush()
+            os.fsync(self._log.fileno())
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()
+            self._log = None
+
+    def _replay(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                try:
+                    sid, measurement, tag_list = json.loads(line)
+                except ValueError:
+                    continue  # torn tail from a crash mid-append
+                tags = tuple((k, v) for k, v in tag_list)
+                self._insert(measurement, tags, series_key(measurement, tags), sid)
+
+    # -- read side ----------------------------------------------------------
+
+    def series_ids(self, measurement: str) -> set[int]:
+        return set(self.mst_sids.get(measurement, ()))
+
+    def tag_values(self, measurement: str, key: str) -> list[str]:
+        vals = {
+            v
+            for (m, k, v) in self.postings
+            if m == measurement and k == key
+        }
+        return sorted(vals)
+
+    def tag_keys(self, measurement: str) -> list[str]:
+        return sorted({k for (m, k, _v) in self.postings if m == measurement})
+
+    def match_eq(self, measurement: str, key: str, value: str) -> set[int]:
+        return set(self.postings.get((measurement, key, value), ()))
+
+    def match_neq(self, measurement: str, key: str, value: str) -> set[int]:
+        return self.series_ids(measurement) - self.match_eq(measurement, key, value)
+
+    def match_regex(self, measurement: str, key: str, pattern: str, negate: bool = False) -> set[int]:
+        rx = re.compile(pattern)
+        hit: set[int] = set()
+        for (m, k, v), sids in self.postings.items():
+            if m == measurement and k == key and rx.search(v):
+                hit |= sids
+        if negate:
+            return self.series_ids(measurement) - hit
+        return hit
+
+    def tags_of(self, sid: int) -> dict[str, str]:
+        return dict(self.sid_to_series[sid][1])
+
+    def measurements(self) -> list[str]:
+        return sorted(self.mst_sids)

@@ -1,0 +1,6 @@
+"""Ingest protocol front-ends: line protocol parsing.
+
+Reference: the lifted VictoriaMetrics line-protocol parser used for ingest
+(lib/util/lifted/vm/protoparser/influx) behind httpd serveWrite
+(lib/util/lifted/influx/httpd/handler.go:1483-1633).
+"""

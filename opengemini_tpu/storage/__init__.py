@@ -1,0 +1,9 @@
+"""Storage engine: WAL, memtable, immutable columnar files, shards.
+
+TPU-first re-design of the reference's engine/ tree (shard.go:117,
+mutable/, immutable/): the on-disk layout is a columnar immutable format
+("TSF") whose chunks decode straight into device-transferable
+(values, mask) arrays, with per-chunk pre-aggregation metadata
+(reference: engine/immutable/pre_aggregation.go:40) so aggregate queries can
+skip block decode AND device transfer entirely.
+"""

@@ -1,0 +1,295 @@
+"""Engine: databases -> retention policies -> time-partitioned shards.
+
+Reference: engine/engine.go:112 (NewEngine, WriteRows:1203,
+CreateShard:1270, loadShards:299) plus the shard-group time partitioning
+from the meta data model (lib/util/lifted/influx/meta data.go). Round-1
+scope: a single-node engine embedding its own metadata (the distributed
+meta plane lives in opengemini_tpu/meta and layers on top).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time as _time
+
+from opengemini_tpu.ingest import line_protocol as lp
+from opengemini_tpu.storage.shard import Shard
+
+NS = 1_000_000_000
+DEFAULT_SHARD_DURATION = 7 * 24 * 3600 * NS  # influx 1w default for infinite RPs
+
+
+class RetentionPolicy:
+    def __init__(self, name: str, duration_ns: int = 0, shard_duration_ns: int = DEFAULT_SHARD_DURATION):
+        self.name = name
+        self.duration_ns = duration_ns  # 0 = infinite
+        self.shard_duration_ns = shard_duration_ns
+
+    def to_json(self):
+        return {
+            "name": self.name,
+            "duration_ns": self.duration_ns,
+            "shard_duration_ns": self.shard_duration_ns,
+        }
+
+    @classmethod
+    def from_json(cls, j):
+        return cls(j["name"], j["duration_ns"], j["shard_duration_ns"])
+
+
+class Database:
+    def __init__(self, name: str):
+        self.name = name
+        self.rps: dict[str, RetentionPolicy] = {}
+        self.default_rp = "autogen"
+
+
+class WriteError(Exception):
+    pass
+
+
+class DatabaseNotFound(WriteError):
+    def __init__(self, name: str):
+        super().__init__(f"database not found: {name!r}")
+
+
+class Engine:
+    """Single-node storage engine with embedded metadata."""
+
+    def __init__(
+        self,
+        root: str,
+        sync_wal: bool = False,
+        flush_threshold_bytes: int = 64 << 20,
+    ):
+        self.root = root
+        self.sync_wal = sync_wal
+        self.flush_threshold_bytes = flush_threshold_bytes
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.RLock()
+        self.databases: dict[str, Database] = {}
+        # (db, rp, group_start) -> Shard
+        self._shards: dict[tuple[str, str, int], Shard] = {}
+        self._load_meta()
+        self._load_shards()
+
+    # -- metadata -----------------------------------------------------------
+
+    def _meta_path(self) -> str:
+        return os.path.join(self.root, "meta.json")
+
+    def _load_meta(self) -> None:
+        p = self._meta_path()
+        if not os.path.exists(p):
+            return
+        with open(p, encoding="utf-8") as f:
+            j = json.load(f)
+        for dbj in j.get("databases", []):
+            db = Database(dbj["name"])
+            db.default_rp = dbj.get("default_rp", "autogen")
+            for rpj in dbj.get("rps", []):
+                rp = RetentionPolicy.from_json(rpj)
+                db.rps[rp.name] = rp
+            self.databases[db.name] = db
+
+    def _save_meta(self) -> None:
+        j = {
+            "databases": [
+                {
+                    "name": db.name,
+                    "default_rp": db.default_rp,
+                    "rps": [rp.to_json() for rp in db.rps.values()],
+                }
+                for db in self.databases.values()
+            ]
+        }
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(j, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._meta_path())
+
+    def create_database(self, name: str) -> None:
+        with self._lock:
+            if name in self.databases:
+                return
+            db = Database(name)
+            db.rps["autogen"] = RetentionPolicy("autogen")
+            self.databases[name] = db
+            self._save_meta()
+
+    def drop_database(self, name: str) -> None:
+        import shutil
+
+        with self._lock:
+            if name not in self.databases:
+                return
+            for key in [k for k in self._shards if k[0] == name]:
+                self._shards.pop(key).close()
+            del self.databases[name]
+            self._save_meta()
+            p = os.path.join(self.root, "data", name)
+            if os.path.exists(p):
+                shutil.rmtree(p)
+
+    def create_retention_policy(
+        self, db: str, name: str, duration_ns: int, shard_duration_ns: int | None = None,
+        default: bool = False,
+    ) -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d is None:
+                raise DatabaseNotFound(db)
+            if shard_duration_ns is None:
+                shard_duration_ns = _auto_shard_duration(duration_ns)
+            d.rps[name] = RetentionPolicy(name, duration_ns, shard_duration_ns)
+            if default:
+                d.default_rp = name
+            self._save_meta()
+
+    def database_names(self) -> list[str]:
+        return sorted(self.databases)
+
+    # -- shards -------------------------------------------------------------
+
+    def _shard_dir(self, db: str, rp: str, group_start: int) -> str:
+        return os.path.join(self.root, "data", db, rp, str(group_start))
+
+    def _load_shards(self) -> None:
+        data_dir = os.path.join(self.root, "data")
+        if not os.path.isdir(data_dir):
+            return
+        for db in os.listdir(data_dir):
+            for rp in os.listdir(os.path.join(data_dir, db)):
+                rp_obj = self.databases.get(db)
+                rp_meta = rp_obj.rps.get(rp) if rp_obj else None
+                dur = rp_meta.shard_duration_ns if rp_meta else DEFAULT_SHARD_DURATION
+                for g in os.listdir(os.path.join(data_dir, db, rp)):
+                    start = int(g)
+                    self._shards[(db, rp, start)] = Shard(
+                        self._shard_dir(db, rp, start), start, start + dur, self.sync_wal
+                    )
+
+    def _get_or_create_shard(self, db: str, rp: str, t_ns: int) -> Shard:
+        d = self.databases.get(db)
+        if d is None:
+            raise DatabaseNotFound(db)
+        rp_meta = d.rps.get(rp)
+        if rp_meta is None:
+            raise WriteError(f"retention policy not found: {db}.{rp}")
+        dur = rp_meta.shard_duration_ns
+        group_start = t_ns // dur * dur
+        key = (db, rp, group_start)
+        shard = self._shards.get(key)
+        if shard is None:
+            shard = Shard(
+                self._shard_dir(db, rp, group_start),
+                group_start,
+                group_start + dur,
+                self.sync_wal,
+            )
+            self._shards[key] = shard
+        return shard
+
+    def shards_for_range(self, db: str, rp: str | None, tmin: int, tmax: int) -> list[Shard]:
+        """Shards overlapping [tmin, tmax) — the shard-mapping step
+        (reference coordinator/shard_mapper.go:61 MapShards)."""
+        d = self.databases.get(db)
+        if d is None:
+            return []
+        rp = rp or d.default_rp
+        out = []
+        for (sdb, srp, _start), shard in sorted(self._shards.items()):
+            if sdb == db and srp == rp and shard.tmin < tmax and shard.tmax > tmin:
+                out.append(shard)
+        return out
+
+    def all_shards(self) -> list[Shard]:
+        return list(self._shards.values())
+
+    # -- write path ---------------------------------------------------------
+
+    def write_lines(
+        self,
+        db: str,
+        lines: str | bytes,
+        precision: str = "ns",
+        rp: str | None = None,
+        now_ns: int | None = None,
+    ) -> int:
+        """Parse + route + apply a line-protocol batch
+        (reference write path, SURVEY.md §3.1). Returns points written."""
+        d = self.databases.get(db)
+        if d is None:
+            raise DatabaseNotFound(db)
+        rp = rp or d.default_rp
+        if now_ns is None:
+            now_ns = _time.time_ns()
+        points = lp.parse_lines(lines, precision, now_ns)
+        if not points:
+            return 0
+        raw = lines.encode("utf-8") if isinstance(lines, str) else lines
+        with self._lock:
+            # group points by target shard (time routing)
+            by_shard: dict[int, list] = {}
+            shards: dict[int, Shard] = {}
+            for p in points:
+                shard = self._get_or_create_shard(db, rp, p[2])
+                key = id(shard)
+                shards[key] = shard
+                by_shard.setdefault(key, []).append(p)
+            n = 0
+            for key, pts in by_shard.items():
+                n += shards[key].write_points(pts, raw, precision, now_ns)
+                if shards[key].mem.approx_bytes > self.flush_threshold_bytes:
+                    shards[key].flush()
+            return n
+
+    def flush_all(self) -> None:
+        with self._lock:
+            for shard in self._shards.values():
+                shard.flush()
+
+    def drop_expired_shards(self, now_ns: int | None = None) -> list[tuple[str, str, int]]:
+        """Retention enforcement (reference services/retention/service.go:81):
+        drop shards whose whole range is past the RP duration."""
+        import shutil
+
+        if now_ns is None:
+            now_ns = _time.time_ns()
+        dropped = []
+        with self._lock:
+            for key in list(self._shards):
+                db, rp, start = key
+                d = self.databases.get(db)
+                rp_meta = d.rps.get(rp) if d else None
+                if rp_meta is None or rp_meta.duration_ns == 0:
+                    continue
+                shard = self._shards[key]
+                if shard.tmax <= now_ns - rp_meta.duration_ns:
+                    shard.close()
+                    shutil.rmtree(shard.path, ignore_errors=True)
+                    del self._shards[key]
+                    dropped.append(key)
+        return dropped
+
+    def close(self) -> None:
+        with self._lock:
+            for shard in self._shards.values():
+                shard.close()
+            self._shards.clear()
+
+
+def _auto_shard_duration(duration_ns: int) -> int:
+    """Influx defaults: RP < 2d -> 1h groups, < 6mo -> 1d, else 7d."""
+    day = 24 * 3600 * NS
+    if duration_ns == 0:
+        return 7 * day
+    if duration_ns < 2 * day:
+        return 3600 * NS
+    if duration_ns < 180 * day:
+        return day
+    return 7 * day

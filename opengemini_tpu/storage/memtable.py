@@ -1,0 +1,71 @@
+"""Columnar memtable: per-series row builders + per-measurement schema.
+
+Reference: engine/mutable/table.go:306 MemTable / MsInfo / WriteChunk.
+Rows are appended per series id; build() yields time-sorted deduped Records
+ready for flush or query-time merge with immutable chunks.
+"""
+
+from __future__ import annotations
+
+from opengemini_tpu.record import (
+    FieldType,
+    FieldTypeConflict,
+    Record,
+    RecordBuilder,
+)
+
+
+class MemTable:
+    def __init__(self, schemas: dict[str, dict[str, FieldType]] | None = None) -> None:
+        # sid -> builder
+        self._builders: dict[int, RecordBuilder] = {}
+        # measurement -> field -> type. SHARED with (and owned by) the shard:
+        # schema outlives memtable generations, otherwise a type-changing
+        # write after a flush slips through and corrupts the merge.
+        self.schemas: dict[str, dict[str, FieldType]] = (
+            schemas if schemas is not None else {}
+        )
+        # sid -> measurement
+        self._sid_mst: dict[int, str] = {}
+        self.row_count = 0
+        self.approx_bytes = 0
+        self.min_time: int | None = None
+        self.max_time: int | None = None
+
+    def write_row(self, sid: int, measurement: str, t: int, fields: dict) -> None:
+        schema = self.schemas.setdefault(measurement, {})
+        for name, (ftype, _v) in fields.items():
+            have = schema.get(name)
+            if have is None:
+                schema[name] = ftype
+            elif have != ftype:
+                raise FieldTypeConflict(name, have, ftype)
+        b = self._builders.get(sid)
+        if b is None:
+            b = RecordBuilder()
+            self._builders[sid] = b
+            self._sid_mst[sid] = measurement
+        b.append_row(t, fields)
+        self.row_count += 1
+        self.approx_bytes += 32 + 16 * len(fields)
+        if self.min_time is None or t < self.min_time:
+            self.min_time = t
+        if self.max_time is None or t > self.max_time:
+            self.max_time = t
+
+    def series_records(self) -> dict[int, tuple[str, Record]]:
+        """sid -> (measurement, sorted+deduped Record)."""
+        out: dict[int, tuple[str, Record]] = {}
+        for sid, b in self._builders.items():
+            rec = b.build().sort_by_time().dedup_last_wins()
+            out[sid] = (self._sid_mst[sid], rec)
+        return out
+
+    def record_for(self, sid: int) -> Record | None:
+        b = self._builders.get(sid)
+        if b is None or len(b) == 0:
+            return None
+        return b.build().sort_by_time().dedup_last_wins()
+
+    def __len__(self) -> int:
+        return self.row_count

@@ -1,0 +1,210 @@
+"""Shard: a time-ranged slice of one database/RP — WAL + memtable +
+immutable TSF files + series index.
+
+Reference: engine/shard.go:117 (WriteRows :512, Snapshot/flush :731,
+Compact :688, commitSnapshot :1008) and the per-shard WAL replay
+(engine/wal.go:390).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from opengemini_tpu.ingest import line_protocol as lp
+from opengemini_tpu.index.inverted import SeriesIndex
+from opengemini_tpu.record import FieldTypeConflict, Record, merge_sorted_records
+from opengemini_tpu.storage.memtable import MemTable
+from opengemini_tpu.storage.tsf import TSFReader, TSFWriter
+from opengemini_tpu.storage.wal import WAL
+
+
+class Shard:
+    def __init__(self, path: str, tmin: int, tmax: int, sync_wal: bool = False):
+        self.path = path
+        self.tmin = tmin  # inclusive ns
+        self.tmax = tmax  # exclusive ns
+        os.makedirs(path, exist_ok=True)
+        self.index = SeriesIndex(os.path.join(path, "series.log"))
+        # measurement -> field -> FieldType; owned here so it survives
+        # memtable generations and is seeded from immutable files on open.
+        self.schemas: dict[str, dict] = {}
+        self.mem = MemTable(self.schemas)
+        self._lock = threading.RLock()
+        self._files: list[TSFReader] = []
+        self._next_file_seq = 1
+        self._load_files()
+        for r in self._files:
+            for mst in r.measurements():
+                self.schemas.setdefault(mst, {}).update(r.schema(mst))
+        self.wal = WAL(os.path.join(path, "wal.log"), sync=sync_wal)
+        self._replay_wal()
+
+    # -- open/recovery ------------------------------------------------------
+
+    def _load_files(self) -> None:
+        names = sorted(
+            f for f in os.listdir(self.path) if f.endswith(".tsf")
+        )
+        for name in names:
+            self._files.append(TSFReader(os.path.join(self.path, name)))
+            seq = int(name.split(".")[0])
+            self._next_file_seq = max(self._next_file_seq, seq + 1)
+
+    def _replay_wal(self) -> None:
+        wal_path = os.path.join(self.path, "wal.log")
+        for lines, precision, now_ns in WAL.replay(wal_path):
+            points = lp.parse_lines(lines, precision, now_ns)
+            for p in points:
+                mst, tags, t, fields = p
+                if self.tmin <= t < self.tmax:
+                    sid = self.index.get_or_create(mst, tags)
+                    try:
+                        self.mem.write_row(sid, mst, t, fields)
+                    except FieldTypeConflict:
+                        # partial-write semantics: a point rejected at write
+                        # time must not poison replay either
+                        continue
+
+    # -- write path ---------------------------------------------------------
+
+    def write_points(self, points: list, raw_lines: bytes, precision: str, now_ns: int) -> int:
+        """Apply pre-parsed points in this shard's range; `raw_lines` is the
+        original batch logged for replay (replay re-filters by time range).
+        Returns rows written. Raises FieldTypeConflict BEFORE touching the
+        WAL — a rejected batch must not poison replay."""
+        with self._lock:
+            pending: dict[str, dict] = {}
+            for mst, _tags, _t, fields in points:
+                schema = self.schemas.get(mst, {})
+                batch_schema = pending.setdefault(mst, {})
+                for name, (ftype, _v) in fields.items():
+                    have = schema.get(name) or batch_schema.get(name)
+                    if have is None:
+                        batch_schema[name] = ftype
+                    elif have != ftype:
+                        raise FieldTypeConflict(name, have, ftype)
+            self.wal.append_lines(raw_lines, precision, now_ns)
+            n = 0
+            for mst, tags, t, fields in points:
+                sid = self.index.get_or_create(mst, tags)
+                self.mem.write_row(sid, mst, t, fields)
+                n += 1
+            return n
+
+    def flush(self) -> None:
+        """Memtable -> new TSF file, then truncate WAL. Crash-safe ordering:
+        the file is fsynced and atomically renamed before the WAL truncate
+        (reference commitSnapshot, engine/shard.go:1008)."""
+        with self._lock:
+            if len(self.mem) == 0:
+                return
+            self.index.flush()
+            path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
+            w = TSFWriter(path)
+            try:
+                for sid, (mst, rec) in sorted(self.mem.series_records().items()):
+                    w.add_chunk(mst, sid, rec)
+                w.finish()
+            except BaseException:
+                w.abort()
+                raise
+            self._next_file_seq += 1
+            self._files.append(TSFReader(path))
+            self.mem = MemTable(self.schemas)
+            self.wal.truncate()
+
+    def compact(self, max_files: int = 1) -> None:
+        """Full merge of immutable files (level compaction analogue,
+        reference engine/immutable/compact.go LevelCompact:120). Rewrites
+        all chunks per series merged+deduped into one file."""
+        with self._lock:
+            if len(self._files) <= max_files:
+                return
+            path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
+            w = TSFWriter(path)
+            try:
+                per_mst: dict[str, set[int]] = {}
+                for r in self._files:
+                    for mst in r.measurements():
+                        per_mst.setdefault(mst, set())
+                        for c in r.chunks(mst):
+                            per_mst[mst].add(c.sid)
+                for mst in sorted(per_mst):
+                    for sid in sorted(per_mst[mst]):
+                        recs = []
+                        for r in self._files:
+                            for c in r.chunks(mst, sids={sid}):
+                                recs.append(r.read_chunk(mst, c))
+                        merged = merge_sorted_records(recs)
+                        w.add_chunk(mst, sid, merged)
+                w.finish()
+            except BaseException:
+                w.abort()
+                raise
+            self._next_file_seq += 1
+            old = self._files
+            self._files = [TSFReader(path)]
+            for r in old:
+                r.close()
+                os.remove(r.path)
+
+    # -- read path ----------------------------------------------------------
+
+    def measurements(self) -> list[str]:
+        msts = set(self.index.measurements())
+        for r in self._files:
+            msts.update(r.measurements())
+        return sorted(msts)
+
+    def schema(self, measurement: str) -> dict:
+        return dict(self.schemas.get(measurement, {}))
+
+    def file_chunks(self, measurement: str, sids=None, tmin=None, tmax=None):
+        """[(reader, ChunkMeta)] oldest file first — the merge order that
+        makes last-write-wins correct."""
+        out = []
+        for r in self._files:
+            for c in r.chunks(measurement, sids, tmin, tmax):
+                out.append((r, c))
+        return out
+
+    def read_series(
+        self,
+        measurement: str,
+        sid: int,
+        tmin: int | None = None,
+        tmax: int | None = None,
+        fields: list[str] | None = None,
+    ) -> Record:
+        """Merged view of one series: immutable chunks (oldest first) +
+        memtable last, deduped last-wins, then time-sliced."""
+        recs = []
+        for r, c in self.file_chunks(measurement, {sid}, tmin, tmax):
+            recs.append(r.read_chunk(measurement, c, fields))
+        mem_rec = self.mem.record_for(sid)
+        if mem_rec is not None:
+            if fields is not None:
+                mem_rec = Record(
+                    mem_rec.times,
+                    {k: v for k, v in mem_rec.columns.items() if k in fields},
+                )
+            recs.append(mem_rec)
+        merged = merge_sorted_records(recs)
+        if tmin is not None or tmax is not None:
+            lo = tmin if tmin is not None else -(2**63)
+            hi = tmax if tmax is not None else 2**63 - 1
+            merged = merged.slice_time(lo, hi)
+        return merged
+
+    def mem_overlaps(self, measurement: str, sid: int) -> bool:
+        return self.mem.record_for(sid) is not None
+
+    def close(self) -> None:
+        with self._lock:
+            self.wal.flush()
+            self.wal.close()
+            self.index.flush()
+            self.index.close()
+            for r in self._files:
+                r.close()

@@ -1,0 +1,251 @@
+"""TSF — the immutable columnar file format (TSSP analogue).
+
+Reference: engine/immutable/tssp_file.go:65-146 (trailer + chunk meta +
+bloom), pre_aggregation.go:40 (per-column-segment count/min/max/sum that
+lets aggregate queries skip data blocks entirely).
+
+Layout:
+    "OGTSF01\\n"                      8-byte magic
+    column blocks (self-describing, see storage/encoding.py)
+    zlib(JSON meta)
+    trailer: [u64 meta_off][u32 meta_len][u32 meta_crc]"OGTSFEND"
+
+One chunk = one series' rows for one flush: time column + field columns,
+each with validity mask and numeric pre-aggregation. Chunks are written
+time-sorted and deduped. JSON meta is pragmatic round-1; the format keeps
+blocks self-describing so a binary meta (C++ side) can replace it without
+touching data blocks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+
+from opengemini_tpu.record import Column, FieldType, Record
+from opengemini_tpu.storage import encoding
+
+MAGIC = b"OGTSF01\n"
+END_MAGIC = b"OGTSFEND"
+_TRAILER = struct.Struct("<QII")
+
+
+class PreAgg:
+    """count/min/max/sum of the valid values of one numeric column chunk."""
+
+    __slots__ = ("count", "vmin", "vmax", "vsum")
+
+    def __init__(self, count: int, vmin, vmax, vsum):
+        self.count = count
+        self.vmin = vmin
+        self.vmax = vmax
+        self.vsum = vsum
+
+    @classmethod
+    def of(cls, col: Column) -> "PreAgg | None":
+        if col.ftype not in (FieldType.FLOAT, FieldType.INT):
+            return cls(int(col.valid.sum()), None, None, None)
+        vals = col.values[col.valid]
+        if len(vals) == 0:
+            return cls(0, None, None, None)
+        return cls(
+            len(vals),
+            vals.min().item(),
+            vals.max().item(),
+            vals.sum().item(),
+        )
+
+    def to_json(self):
+        return [self.count, self.vmin, self.vmax, self.vsum]
+
+    @classmethod
+    def from_json(cls, j) -> "PreAgg":
+        return cls(*j)
+
+
+class ChunkMeta:
+    __slots__ = ("sid", "rows", "tmin", "tmax", "time_loc", "cols")
+
+    def __init__(self, sid, rows, tmin, tmax, time_loc, cols):
+        self.sid = sid
+        self.rows = rows
+        self.tmin = tmin
+        self.tmax = tmax
+        self.time_loc = time_loc  # (off, len)
+        # field -> {"v": (off,len), "m": (off,len)|None, "pre": PreAgg}
+        self.cols = cols
+
+
+class TSFWriter:
+    def __init__(self, path: str):
+        self.path = path
+        self._tmp = path + ".tmp"
+        self._f = open(self._tmp, "wb")
+        self._f.write(MAGIC)
+        self._off = len(MAGIC)
+        # mst -> {"schema": {field: int}, "chunks": [meta json]}
+        self._meta: dict = {}
+
+    def _write_block(self, buf: bytes) -> tuple[int, int]:
+        off = self._off
+        self._f.write(buf)
+        self._off += len(buf)
+        return (off, len(buf))
+
+    def add_chunk(self, measurement: str, sid: int, rec: Record) -> None:
+        """rec must be time-sorted ascending and deduped."""
+        if len(rec) == 0:
+            return
+        m = self._meta.setdefault(measurement, {"schema": {}, "chunks": []})
+        time_loc = self._write_block(encoding.encode_ints(rec.times))
+        cols = {}
+        for name, col in rec.columns.items():
+            have = m["schema"].get(name)
+            if have is None:
+                m["schema"][name] = int(col.ftype)
+            elif have != int(col.ftype):
+                raise ValueError(
+                    f"field type conflict in file for {name!r}: {have} vs {int(col.ftype)}"
+                )
+            vbuf, mbuf = encoding.encode_column(col)
+            vloc = self._write_block(vbuf)
+            mloc = self._write_block(mbuf) if mbuf else None
+            pre = PreAgg.of(col)
+            cols[name] = {"v": vloc, "m": mloc, "pre": pre.to_json()}
+        m["chunks"].append(
+            {
+                "sid": sid,
+                "rows": len(rec),
+                "tmin": int(rec.times[0]),
+                "tmax": int(rec.times[-1]),
+                "time": time_loc,
+                "cols": cols,
+            }
+        )
+
+    def finish(self) -> None:
+        meta_buf = zlib.compress(
+            json.dumps(self._meta, separators=(",", ":")).encode("utf-8"), 1
+        )
+        meta_off = self._off
+        self._f.write(meta_buf)
+        self._f.write(_TRAILER.pack(meta_off, len(meta_buf), zlib.crc32(meta_buf)))
+        self._f.write(END_MAGIC)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        os.replace(self._tmp, self.path)  # atomic visibility
+
+    def abort(self) -> None:
+        self._f.close()
+        if os.path.exists(self._tmp):
+            os.remove(self._tmp)
+
+
+class TSFReader:
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+        self._f.seek(0, os.SEEK_END)
+        size = self._f.tell()
+        tail = _TRAILER.size + len(END_MAGIC)
+        if size < len(MAGIC) + tail:
+            raise CorruptFile(path, "too small")
+        self._f.seek(size - tail)
+        trailer = self._f.read(tail)
+        if trailer[-len(END_MAGIC) :] != END_MAGIC:
+            raise CorruptFile(path, "bad end magic")
+        meta_off, meta_len, meta_crc = _TRAILER.unpack(trailer[: _TRAILER.size])
+        self._f.seek(meta_off)
+        meta_buf = self._f.read(meta_len)
+        if zlib.crc32(meta_buf) != meta_crc:
+            raise CorruptFile(path, "meta crc mismatch")
+        raw = json.loads(zlib.decompress(meta_buf))
+        # mst -> (schema, [ChunkMeta])
+        self.meta: dict[str, tuple[dict, list[ChunkMeta]]] = {}
+        self.tmin: int | None = None
+        self.tmax: int | None = None
+        for mst, m in raw.items():
+            schema = {k: FieldType(v) for k, v in m["schema"].items()}
+            chunks = []
+            for c in m["chunks"]:
+                cols = {
+                    name: {
+                        "v": tuple(cc["v"]),
+                        "m": tuple(cc["m"]) if cc["m"] else None,
+                        "pre": PreAgg.from_json(cc["pre"]),
+                    }
+                    for name, cc in c["cols"].items()
+                }
+                cm = ChunkMeta(c["sid"], c["rows"], c["tmin"], c["tmax"], tuple(c["time"]), cols)
+                chunks.append(cm)
+                if self.tmin is None or cm.tmin < self.tmin:
+                    self.tmin = cm.tmin
+                if self.tmax is None or cm.tmax > self.tmax:
+                    self.tmax = cm.tmax
+            self.meta[mst] = (schema, chunks)
+
+    def close(self) -> None:
+        self._f.close()
+
+    def measurements(self) -> list[str]:
+        return list(self.meta)
+
+    def schema(self, measurement: str) -> dict[str, FieldType]:
+        entry = self.meta.get(measurement)
+        return entry[0] if entry else {}
+
+    def chunks(
+        self,
+        measurement: str,
+        sids: set[int] | None = None,
+        tmin: int | None = None,
+        tmax: int | None = None,
+    ) -> list[ChunkMeta]:
+        """Chunk metas matching series + time range (tmax exclusive) —
+        the block-skip step (reference location.go / pre-agg pruning)."""
+        entry = self.meta.get(measurement)
+        if entry is None:
+            return []
+        out = []
+        for c in entry[1]:
+            if sids is not None and c.sid not in sids:
+                continue
+            if tmin is not None and c.tmax < tmin:
+                continue
+            if tmax is not None and c.tmin >= tmax:
+                continue
+            out.append(c)
+        return out
+
+    def _read(self, loc: tuple[int, int]) -> bytes:
+        self._f.seek(loc[0])
+        return self._f.read(loc[1])
+
+    def read_times(self, chunk: ChunkMeta) -> np.ndarray:
+        return encoding.decode_ints(self._read(chunk.time_loc))
+
+    def read_chunk(
+        self, measurement: str, chunk: ChunkMeta, fields: list[str] | None = None
+    ) -> Record:
+        schema = self.schema(measurement)
+        times = self.read_times(chunk)
+        cols = {}
+        names = fields if fields is not None else list(chunk.cols)
+        for name in names:
+            loc = chunk.cols.get(name)
+            if loc is None:
+                continue
+            vbuf = self._read(loc["v"])
+            mbuf = self._read(loc["m"]) if loc["m"] else b""
+            cols[name] = encoding.decode_column(schema[name], vbuf, mbuf)
+        return Record(times, cols)
+
+
+class CorruptFile(Exception):
+    def __init__(self, path: str, why: str):
+        super().__init__(f"corrupt TSF file {path}: {why}")

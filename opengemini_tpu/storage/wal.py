@@ -1,0 +1,81 @@
+"""Per-shard write-ahead log.
+
+Reference: engine/wal.go:118 (snappy-compressed binary rows, partitioned,
+replayed on open at :390). Here an entry is the *raw line-protocol batch*
+(zlib-compressed) plus precision — replay re-parses, which reuses the one
+parser and keeps the format trivial to audit. Entry framing:
+
+    [u32 len][u32 crc32][u8 kind][payload]
+
+kind 1 = raw lines: [u8 precision_len][precision utf8][zlib(lines utf8)]
+Torn tails (crc/len mismatch at EOF) are truncated on replay, matching the
+reference's tolerant WAL restore (engine/wal.go replay error handling).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+_KIND_RAW_LINES = 1
+_HEADER = struct.Struct("<IIB")
+
+
+class WAL:
+    def __init__(self, path: str, sync: bool = False):
+        self.path = path
+        self.sync = sync
+        self._f = open(path, "ab")
+
+    def append_lines(self, lines: str | bytes, precision: str, now_ns: int) -> None:
+        if isinstance(lines, str):
+            lines = lines.encode("utf-8")
+        prec = precision.encode("utf-8")
+        payload = (
+            struct.pack("<BQ", len(prec), now_ns) + prec + zlib.compress(lines, 1)
+        )
+        crc = zlib.crc32(payload)
+        self._f.write(_HEADER.pack(len(payload), crc, _KIND_RAW_LINES) + payload)
+        if self.sync:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+    def truncate(self) -> None:
+        """Called after a successful memtable flush: logged data is now in
+        immutable files (reference commitSnapshot, engine/shard.go:1008)."""
+        self._f.close()
+        self._f = open(self.path, "wb")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    @staticmethod
+    def replay(path: str):
+        """Yield (lines_bytes, precision, now_ns) entries; stop at torn tail."""
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        off, n = 0, len(data)
+        while off + _HEADER.size <= n:
+            length, crc, kind = _HEADER.unpack_from(data, off)
+            start = off + _HEADER.size
+            end = start + length
+            if end > n:
+                break  # torn write
+            payload = data[start:end]
+            if zlib.crc32(payload) != crc:
+                break  # corrupt tail
+            if kind == _KIND_RAW_LINES:
+                plen, now_ns = struct.unpack_from("<BQ", payload)
+                prec = payload[9 : 9 + plen].decode("utf-8")
+                lines = zlib.decompress(payload[9 + plen :])
+                yield lines, prec, now_ns
+            off = end

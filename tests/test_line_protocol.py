@@ -1,0 +1,110 @@
+"""Line protocol parser tests (behavioral parity with InfluxDB 1.x ingest)."""
+
+import pytest
+
+from opengemini_tpu.ingest import line_protocol as lp
+from opengemini_tpu.record import FieldType
+
+
+def test_basic_point():
+    pts = lp.parse_lines('cpu,host=h1,region=us usage=0.5,idle=99i 1700000000000000000')
+    assert len(pts) == 1
+    mst, tags, t, fields = pts[0]
+    assert mst == "cpu"
+    assert tags == (("host", "h1"), ("region", "us"))
+    assert t == 1700000000000000000
+    assert fields == {"usage": (FieldType.FLOAT, 0.5), "idle": (FieldType.INT, 99)}
+
+
+def test_tags_sorted():
+    pts = lp.parse_lines("m,b=2,a=1 f=1 1")
+    assert pts[0][1] == (("a", "1"), ("b", "2"))
+
+
+def test_no_tags_no_timestamp():
+    pts = lp.parse_lines("m f=1", now_ns=42)
+    assert pts[0][1] == ()
+    assert pts[0][2] == 42
+
+
+def test_precision():
+    pts = lp.parse_lines("m f=1 1700000000", precision="s")
+    assert pts[0][2] == 1700000000 * 10**9
+    pts = lp.parse_lines("m f=1 1700000000000", precision="ms")
+    assert pts[0][2] == 1700000000000 * 10**6
+
+
+def test_value_types():
+    pts = lp.parse_lines('m a=1.5,b=2i,c=3u,d=t,e=F,f="hi there",g=true 1')
+    f = pts[0][3]
+    assert f["a"] == (FieldType.FLOAT, 1.5)
+    assert f["b"] == (FieldType.INT, 2)
+    assert f["c"] == (FieldType.INT, 3)
+    assert f["d"] == (FieldType.BOOL, True)
+    assert f["e"] == (FieldType.BOOL, False)
+    assert f["f"] == (FieldType.STRING, "hi there")
+    assert f["g"] == (FieldType.BOOL, True)
+
+
+def test_escapes():
+    pts = lp.parse_lines(r'my\ mst,ta\,g=va\ lue fi\=eld="quote\"d" 5')
+    mst, tags, t, fields = pts[0]
+    assert mst == "my mst"
+    assert tags == (("ta,g", "va lue"),)
+    assert fields == {"fi=eld": (FieldType.STRING, 'quote"d')}
+
+
+def test_string_with_spaces_and_commas():
+    pts = lp.parse_lines('m s="a, b c",x=1 7')
+    assert pts[0][3]["s"] == (FieldType.STRING, "a, b c")
+    assert pts[0][3]["x"] == (FieldType.FLOAT, 1.0)
+
+
+def test_comments_and_blank_lines():
+    pts = lp.parse_lines("# comment\n\nm f=1 1\n")
+    assert len(pts) == 1
+
+
+def test_multiple_lines():
+    pts = lp.parse_lines("m f=1 1\nm f=2 2\nn g=3 3")
+    assert len(pts) == 3
+
+
+def test_negative_and_exponent_values():
+    pts = lp.parse_lines("m a=-1.5,b=-2i,c=1e10,d=-1.2E-3 1")
+    f = pts[0][3]
+    assert f["a"][1] == -1.5 and f["b"][1] == -2
+    assert f["c"][1] == 1e10 and f["d"][1] == -1.2e-3
+
+
+def test_empty_tag_value_dropped():
+    pts = lp.parse_lines("m,a= f=1 1")
+    assert pts[0][1] == ()
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "m",  # no fields
+        "m,f=1",  # tag only, no fields
+        "m f=",  # missing value
+        "m f=1 notatime",  # bad timestamp
+        'm s="unterminated 1',  # unterminated string
+        "m f=1x 1",  # bad value
+        ", f=1",  # missing measurement
+    ],
+)
+def test_malformed_lines_raise(bad):
+    with pytest.raises((lp.ParseError, ValueError)):
+        lp.parse_lines(bad)
+
+
+def test_parse_error_carries_line_number():
+    with pytest.raises(lp.ParseError) as ei:
+        lp.parse_lines("m f=1 1\nbroken")
+    assert ei.value.lineno == 2
+
+
+def test_series_key():
+    assert lp.series_key("cpu", (("a", "1"), ("b", "2"))) == "cpu,a=1,b=2"
+    assert lp.series_key("cpu", ()) == "cpu"

@@ -1,0 +1,349 @@
+"""Storage layer tests: encodings, WAL, TSF files, shard, engine.
+
+Mirrors the reference's engine-against-temp-dirs strategy
+(SURVEY.md §4 item 4: engine/shard_test.go writes rows, flushes, compacts,
+queries cursors directly)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.record import Column, FieldType, Record
+from opengemini_tpu.storage import encoding
+from opengemini_tpu.storage.engine import Engine, NS
+from opengemini_tpu.storage.shard import Shard
+from opengemini_tpu.storage.tsf import TSFReader, TSFWriter
+from opengemini_tpu.storage.wal import WAL
+
+
+class TestEncoding:
+    def test_int_roundtrip_regular(self):
+        v = np.arange(0, 10_000_000_000, 10_000_000, dtype=np.int64)
+        buf = encoding.encode_ints(v)
+        assert len(buf) < 40  # constant-stride run
+        np.testing.assert_array_equal(encoding.decode_ints(buf), v)
+
+    def test_int_roundtrip_irregular(self, rng):
+        v = np.cumsum(rng.integers(1, 1000, size=5000)).astype(np.int64)
+        buf = encoding.encode_ints(v)
+        np.testing.assert_array_equal(encoding.decode_ints(buf), v)
+
+    def test_int_negative_deltas(self):
+        v = np.array([100, 50, 200, -5, 7], dtype=np.int64)
+        np.testing.assert_array_equal(encoding.decode_ints(encoding.encode_ints(v)), v)
+
+    def test_int_single_and_empty(self):
+        for v in ([], [42]):
+            arr = np.array(v, dtype=np.int64)
+            np.testing.assert_array_equal(encoding.decode_ints(encoding.encode_ints(arr)), arr)
+
+    def test_float_roundtrip(self, rng):
+        v = rng.normal(size=1000)
+        np.testing.assert_array_equal(encoding.decode_floats(encoding.encode_floats(v)), v)
+
+    def test_bool_roundtrip(self, rng):
+        v = rng.random(77) > 0.5
+        np.testing.assert_array_equal(encoding.decode_bools(encoding.encode_bools(v)), v)
+
+    def test_string_roundtrip(self):
+        v = np.array(["a", "", "héllo", "x" * 100], dtype=object)
+        got = encoding.decode_strings(encoding.encode_strings(v))
+        assert got.tolist() == v.tolist()
+
+    def test_mask_allvalid_empty(self):
+        m = np.ones(10, dtype=bool)
+        assert encoding.encode_mask(m) == b""
+        np.testing.assert_array_equal(encoding.decode_mask(b"", 10), m)
+
+
+class TestWAL:
+    def test_roundtrip_and_torn_tail(self, tmp_path):
+        p = str(tmp_path / "wal.log")
+        w = WAL(p)
+        w.append_lines("cpu f=1 1", "ns", 100)
+        w.append_lines("cpu f=2 2", "s", 200)
+        w.flush()
+        w.close()
+        # corrupt tail: append garbage
+        with open(p, "ab") as f:
+            f.write(b"\x07\x00\x00\x00garbage")
+        entries = list(WAL.replay(p))
+        assert len(entries) == 2
+        assert entries[0] == (b"cpu f=1 1", "ns", 100)
+        assert entries[1] == (b"cpu f=2 2", "s", 200)
+
+    def test_truncate(self, tmp_path):
+        p = str(tmp_path / "wal.log")
+        w = WAL(p)
+        w.append_lines("cpu f=1 1", "ns", 1)
+        w.truncate()
+        w.append_lines("cpu f=2 2", "ns", 2)
+        w.flush()
+        w.close()
+        entries = list(WAL.replay(p))
+        assert len(entries) == 1 and entries[0][0] == b"cpu f=2 2"
+
+
+class TestTSF:
+    def _make_record(self, n=100):
+        times = np.arange(n, dtype=np.int64) * 1_000_000_000
+        vals = np.linspace(0, 1, n)
+        valid = np.ones(n, dtype=bool)
+        valid[::7] = False
+        return Record(
+            times,
+            {
+                "f": Column(FieldType.FLOAT, vals, valid),
+                "i": Column.from_values(FieldType.INT, np.arange(n)),
+                "s": Column.from_values(
+                    FieldType.STRING, np.array([f"v{j}" for j in range(n)], dtype=object)
+                ),
+            },
+        )
+
+    def test_roundtrip(self, tmp_path):
+        p = str(tmp_path / "0001.tsf")
+        rec = self._make_record()
+        w = TSFWriter(p)
+        w.add_chunk("cpu", 1, rec)
+        w.finish()
+        r = TSFReader(p)
+        assert r.measurements() == ["cpu"]
+        chunks = r.chunks("cpu")
+        assert len(chunks) == 1
+        c = chunks[0]
+        assert c.sid == 1 and c.rows == 100
+        got = r.read_chunk("cpu", c)
+        np.testing.assert_array_equal(got.times, rec.times)
+        np.testing.assert_array_equal(got.columns["f"].values[got.columns["f"].valid],
+                                      rec.columns["f"].values[rec.columns["f"].valid])
+        np.testing.assert_array_equal(got.columns["f"].valid, rec.columns["f"].valid)
+        assert got.columns["s"].values.tolist() == rec.columns["s"].values.tolist()
+        r.close()
+
+    def test_preagg(self, tmp_path):
+        p = str(tmp_path / "0001.tsf")
+        rec = self._make_record()
+        w = TSFWriter(p)
+        w.add_chunk("cpu", 1, rec)
+        w.finish()
+        r = TSFReader(p)
+        pre = r.chunks("cpu")[0].cols["f"]["pre"]
+        vals = rec.columns["f"].values[rec.columns["f"].valid]
+        assert pre.count == len(vals)
+        assert pre.vmin == vals.min() and pre.vmax == vals.max()
+        assert np.isclose(pre.vsum, vals.sum())
+        r.close()
+
+    def test_chunk_time_pruning(self, tmp_path):
+        p = str(tmp_path / "0001.tsf")
+        w = TSFWriter(p)
+        w.add_chunk("cpu", 1, self._make_record())  # times 0..99s
+        w.finish()
+        r = TSFReader(p)
+        assert r.chunks("cpu", tmin=200 * NS) == []
+        assert r.chunks("cpu", tmax=0) == []
+        assert len(r.chunks("cpu", tmin=50 * NS, tmax=60 * NS)) == 1
+        r.close()
+
+    def test_corrupt_trailer_detected(self, tmp_path):
+        from opengemini_tpu.storage.tsf import CorruptFile
+
+        p = str(tmp_path / "0001.tsf")
+        w = TSFWriter(p)
+        w.add_chunk("cpu", 1, self._make_record())
+        w.finish()
+        with open(p, "r+b") as f:
+            f.seek(-4, 2)
+            f.write(b"XXXX")
+        with pytest.raises(CorruptFile):
+            TSFReader(p)
+
+
+class TestShard:
+    def test_write_flush_read(self, tmp_path):
+        import opengemini_tpu.ingest.line_protocol as lp
+
+        sh = Shard(str(tmp_path / "s1"), 0, 10**18)
+        lines = "cpu,host=h1 usage=1 1000000000\ncpu,host=h1 usage=2 2000000000"
+        pts = lp.parse_lines(lines)
+        sh.write_points(pts, lines.encode(), "ns", 0)
+        sid = sh.index.get_or_create("cpu", (("host", "h1"),))
+        rec = sh.read_series("cpu", sid)
+        assert rec.times.tolist() == [10**9, 2 * 10**9]
+        sh.flush()
+        rec = sh.read_series("cpu", sid)
+        assert rec.columns["usage"].values.tolist() == [1.0, 2.0]
+        sh.close()
+
+    def test_wal_replay_after_crash(self, tmp_path):
+        import opengemini_tpu.ingest.line_protocol as lp
+
+        path = str(tmp_path / "s1")
+        sh = Shard(path, 0, 10**18)
+        lines = "cpu,host=h1 usage=5 1000000000"
+        sh.write_points(lp.parse_lines(lines), lines.encode(), "ns", 0)
+        sh.wal.flush()
+        # simulate crash: no flush/close
+        sh2 = Shard(path, 0, 10**18)
+        sid = sh2.index.get_or_create("cpu", (("host", "h1"),))
+        rec = sh2.read_series("cpu", sid)
+        assert rec.columns["usage"].values.tolist() == [5.0]
+        sh2.close()
+
+    def test_dedup_across_memtable_and_file(self, tmp_path):
+        import opengemini_tpu.ingest.line_protocol as lp
+
+        sh = Shard(str(tmp_path / "s1"), 0, 10**18)
+        l1 = "cpu usage=1 1000000000"
+        sh.write_points(lp.parse_lines(l1), l1.encode(), "ns", 0)
+        sh.flush()
+        l2 = "cpu usage=9 1000000000"  # overwrite same timestamp
+        sh.write_points(lp.parse_lines(l2), l2.encode(), "ns", 0)
+        sid = sh.index.get_or_create("cpu", ())
+        rec = sh.read_series("cpu", sid)
+        assert rec.columns["usage"].values.tolist() == [9.0]
+        sh.close()
+
+    def test_compact_merges_files(self, tmp_path):
+        import opengemini_tpu.ingest.line_protocol as lp
+
+        sh = Shard(str(tmp_path / "s1"), 0, 10**18)
+        for i in range(3):
+            line = f"cpu usage={i} {i+1}000000000"
+            sh.write_points(lp.parse_lines(line), line.encode(), "ns", 0)
+            sh.flush()
+        assert len(sh._files) == 3
+        sh.compact()
+        assert len(sh._files) == 1
+        sid = sh.index.get_or_create("cpu", ())
+        rec = sh.read_series("cpu", sid)
+        assert rec.columns["usage"].values.tolist() == [0.0, 1.0, 2.0]
+        sh.close()
+
+
+class TestEngine:
+    def test_write_routes_to_shards_and_reopen(self, tmp_path):
+        root = str(tmp_path / "e")
+        e = Engine(root)
+        e.create_database("db")
+        week = 7 * 24 * 3600
+        # two points in different shard groups
+        e.write_lines("db", f"cpu v=1 {1 * NS}\ncpu v=2 {(week + 1) * NS}")
+        assert len(e.all_shards()) == 2
+        e.flush_all()
+        e.close()
+        e2 = Engine(root)
+        shards = e2.shards_for_range("db", None, 0, 2 * week * NS)
+        assert len(shards) == 2
+        sid = shards[0].index.get_or_create("cpu", ())
+        assert shards[0].read_series("cpu", sid).columns["v"].values.tolist() == [1.0]
+        e2.close()
+
+    def test_unknown_database_raises(self, tmp_path):
+        from opengemini_tpu.storage.engine import DatabaseNotFound
+
+        e = Engine(str(tmp_path / "e"))
+        with pytest.raises(DatabaseNotFound):
+            e.write_lines("nope", "cpu v=1 1")
+        e.close()
+
+    def test_retention_drops_expired_shards(self, tmp_path):
+        e = Engine(str(tmp_path / "e"))
+        e.create_database("db")
+        e.create_retention_policy("db", "short", duration_ns=2 * 24 * 3600 * NS, default=True)
+        e.write_lines("db", f"cpu v=1 {1 * NS}")  # ancient point
+        now = 10 * 24 * 3600 * NS
+        dropped = e.drop_expired_shards(now_ns=now)
+        assert len(dropped) == 1
+        assert e.shards_for_range("db", "short", 0, now) == []
+        e.close()
+
+    def test_drop_database(self, tmp_path):
+        e = Engine(str(tmp_path / "e"))
+        e.create_database("db")
+        e.write_lines("db", "cpu v=1 1")
+        e.drop_database("db")
+        assert e.database_names() == []
+        e.close()
+
+
+class TestReviewRegressions:
+    """Regressions for confirmed review findings."""
+
+    def test_type_conflict_does_not_poison_wal(self, tmp_path):
+        """A rejected batch must not be WAL-logged; shard must reopen."""
+        import opengemini_tpu.ingest.line_protocol as lp
+        from opengemini_tpu.record import FieldTypeConflict
+
+        path = str(tmp_path / "s1")
+        sh = Shard(path, 0, 10**18)
+        l1 = "cpu f=1i 1"
+        sh.write_points(lp.parse_lines(l1), l1.encode(), "ns", 0)
+        l2 = "cpu f=2.5 2"
+        with pytest.raises(FieldTypeConflict):
+            sh.write_points(lp.parse_lines(l2), l2.encode(), "ns", 0)
+        sh.wal.flush()
+        sh2 = Shard(path, 0, 10**18)  # must not raise
+        sid = sh2.index.get_or_create("cpu", ())
+        assert sh2.read_series("cpu", sid).columns["f"].values.tolist() == [1]
+        sh2.close()
+        sh.close()
+
+    def test_schema_survives_flush(self, tmp_path):
+        """Type-changing write after flush must still be rejected."""
+        import opengemini_tpu.ingest.line_protocol as lp
+        from opengemini_tpu.record import FieldTypeConflict
+
+        sh = Shard(str(tmp_path / "s1"), 0, 10**18)
+        l1 = "cpu f=1i 1"
+        sh.write_points(lp.parse_lines(l1), l1.encode(), "ns", 0)
+        sh.flush()
+        with pytest.raises(FieldTypeConflict):
+            sh.write_points(lp.parse_lines("cpu f=2.5 2"), b"cpu f=2.5 2", "ns", 0)
+        sh.close()
+
+    def test_schema_enforced_after_reopen(self, tmp_path):
+        import opengemini_tpu.ingest.line_protocol as lp
+        from opengemini_tpu.record import FieldTypeConflict
+
+        path = str(tmp_path / "s1")
+        sh = Shard(path, 0, 10**18)
+        sh.write_points(lp.parse_lines("cpu f=1i 1"), b"cpu f=1i 1", "ns", 0)
+        sh.flush()
+        sh.close()
+        sh2 = Shard(path, 0, 10**18)
+        with pytest.raises(FieldTypeConflict):
+            sh2.write_points(lp.parse_lines("cpu f=2.5 2"), b"cpu f=2.5 2", "ns", 0)
+        sh2.close()
+
+    def test_weird_tag_values_survive_reopen(self, tmp_path):
+        import opengemini_tpu.ingest.line_protocol as lp
+
+        path = str(tmp_path / "s1")
+        sh = Shard(path, 0, 10**18)
+        line = r"cpu,host=a\,b v=1 1"
+        sh.write_points(lp.parse_lines(line), line.encode(), "ns", 0)
+        sh.index.flush()
+        sh.wal.flush()
+        sh2 = Shard(path, 0, 10**18)
+        assert sh2.index.tag_values("cpu", "host") == ["a,b"]
+        sh2.close()
+        sh.close()
+
+    def test_series_key_no_aliasing(self):
+        from opengemini_tpu.ingest.line_protocol import series_key
+
+        k1 = series_key("cpu", (("host", "a"), ("x", "1")))
+        k2 = series_key("cpu", (("host", "a,x=1"),))
+        assert k1 != k2
+
+    def test_out_of_range_timestamp_rejected_at_parse(self):
+        import opengemini_tpu.ingest.line_protocol as lp
+
+        with pytest.raises(lp.ParseError):
+            lp.parse_lines("cpu v=1 99999999999999999999")
+        with pytest.raises(lp.ParseError):
+            lp.parse_lines("cpu v=99999999999999999999i 1")
+        # precision multiplication overflow too
+        with pytest.raises(lp.ParseError):
+            lp.parse_lines("cpu v=1 9999999999999999", precision="h")
