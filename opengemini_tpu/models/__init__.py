@@ -1,0 +1,8 @@
+"""Flagship jittable compute graphs ("models") — the plan-template layer.
+
+In the reference, common query shapes hit a cached plan template
+(engine/executor/select.go:121 buildPlanByCache, plan_type.go). Here the
+analogue is a cache of jitted XLA programs keyed by
+(aggregate set, padded batch shape, padded segment count, dtype): every
+query whose shape matches reuses a compiled device program.
+"""

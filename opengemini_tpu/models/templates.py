@@ -1,0 +1,130 @@
+"""Compiled aggregate templates: pad -> jit -> run -> slice.
+
+The executor hands numpy batches here; this module owns padding (shape
+bucketing so the XLA compile cache stays small), jit caching, and device
+round-trips. Padding rows are masked out; padded segments are sliced off
+after the device call.
+
+This is the plan-template cache of the reference
+(engine/executor/select.go:121 buildPlanByCache) applied to XLA programs:
+queries with the same (aggregate, padded shape, padded segment count,
+dtype) reuse one compiled device program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from opengemini_tpu.ops import window as winmod
+from opengemini_tpu.ops.aggregates import AggSpec
+
+_REL_LO_BITS = 30
+_REL_LO_MASK = (1 << _REL_LO_BITS) - 1
+
+
+def compute_dtype() -> np.dtype:
+    """float64 when x64 is enabled (CPU parity tests), else float32 (TPU)."""
+    return np.dtype(np.float64) if jax.config.jax_enable_x64 else np.dtype(np.float32)
+
+
+@functools.lru_cache(maxsize=512)
+def _jitted(fn, num_segments: int, params: tuple):
+    @jax.jit
+    def run(values, rel_hi, rel_lo, seg_ids, mask):
+        return fn(values, rel_hi, rel_lo, seg_ids, num_segments, mask, *params)
+
+    return run
+
+
+def _count_fn(values, rel_hi, rel_lo, seg_ids, num_segments, mask):
+    from opengemini_tpu.ops import segment as seg
+
+    return seg.seg_count(seg_ids, num_segments, mask), None
+
+
+def split_rel_ns(rel_ns: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Exact int64 ns offset -> lexicographic int32 (hi, lo) pair for
+    device-side time ordering without int64."""
+    hi = (rel_ns >> _REL_LO_BITS).astype(np.int32)
+    lo = (rel_ns & _REL_LO_MASK).astype(np.int32)
+    return hi, lo
+
+
+class AggBatch:
+    """A device-ready batch for one field: values, (hi, lo) relative times,
+    segment ids, validity mask — plus a host-only int64 ns time array for
+    exact selector timestamps. Accumulated across shards/series."""
+
+    def __init__(self, dtype=None):
+        self.dtype = dtype or compute_dtype()
+        self.values: list[np.ndarray] = []
+        self.rel_hi: list[np.ndarray] = []
+        self.rel_lo: list[np.ndarray] = []
+        self.seg_ids: list[np.ndarray] = []
+        self.mask: list[np.ndarray] = []
+        self.times_ns: list[np.ndarray] = []  # host-side only
+        self.n = 0
+        self._padded = None
+        self._counts_cache: dict[int, np.ndarray] = {}
+
+    def add(self, values, rel_ns, seg_ids, mask, times_ns):
+        self.values.append(np.asarray(values, dtype=self.dtype))
+        hi, lo = split_rel_ns(np.asarray(rel_ns, dtype=np.int64))
+        self.rel_hi.append(hi)
+        self.rel_lo.append(lo)
+        self.seg_ids.append(np.asarray(seg_ids, dtype=np.int32))
+        self.mask.append(np.asarray(mask, dtype=np.bool_))
+        self.times_ns.append(np.asarray(times_ns, dtype=np.int64))
+        self.n += len(values)
+
+    def _concat_padded(self):
+        if self._padded is not None:
+            return self._padded
+        npad = winmod.pad_to(max(self.n, 1))
+        values = np.zeros(npad, dtype=self.dtype)
+        rel_hi = np.zeros(npad, dtype=np.int32)
+        rel_lo = np.zeros(npad, dtype=np.int32)
+        seg_ids = np.zeros(npad, dtype=np.int32)
+        mask = np.zeros(npad, dtype=np.bool_)
+        off = 0
+        for v, h, l, s, m in zip(self.values, self.rel_hi, self.rel_lo, self.seg_ids, self.mask):
+            k = len(v)
+            values[off : off + k] = v
+            rel_hi[off : off + k] = h
+            rel_lo[off : off + k] = l
+            seg_ids[off : off + k] = s
+            mask[off : off + k] = m
+            off += k
+        self._padded = (values, rel_hi, rel_lo, seg_ids, mask)
+        return self._padded
+
+    def host_times(self) -> np.ndarray:
+        return (
+            np.concatenate(self.times_ns) if self.times_ns else np.empty(0, np.int64)
+        )
+
+    def counts(self, num_segments: int) -> np.ndarray:
+        """Per-segment valid-row counts (cached per batch — every aggregate
+        needs them for null rendering, compute once)."""
+        got = self._counts_cache.get(num_segments)
+        if got is None:
+            seg_pad = winmod.pad_to(max(num_segments, 1), 256)
+            arrays = self._concat_padded()
+            counts, _ = _jitted(_count_fn, seg_pad, ())(*arrays)
+            got = np.asarray(counts)[:num_segments]
+            self._counts_cache[num_segments] = got
+        return got
+
+    def run(self, spec: AggSpec, num_segments: int, params: tuple = ()):
+        """Execute one aggregate; returns (values[num_segments],
+        sel_idx[num_segments] | None, counts[num_segments])."""
+        seg_pad = winmod.pad_to(max(num_segments, 1), 256)
+        arrays = self._concat_padded()
+        fn = _jitted(spec.fn, seg_pad, tuple(params))
+        out, sel = fn(*arrays)
+        out_np = np.asarray(out)[:num_segments]
+        sel_np = np.asarray(sel)[:num_segments] if sel is not None else None
+        return out_np, sel_np, self.counts(num_segments)

@@ -24,7 +24,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-_BIG_I32 = jnp.int32(2**31 - 1)
+# plain int (not jnp scalar): keeps module import free of backend init
+_BIG_I32 = 2**31 - 1
 
 
 def seg_sum(values, seg_ids, num_segments: int, mask):
@@ -80,53 +81,59 @@ def seg_stddev(values, seg_ids, num_segments: int, mask):
     return jnp.sqrt(jnp.maximum(var, 0))
 
 
-def seg_first(values, rel_t, seg_ids, num_segments: int, mask):
-    """(value, rel_t, row_idx) of the earliest valid row per segment; scan
-    order breaks timestamp ties (reference first/last tie semantics,
+def seg_first(values, rel_hi, rel_lo, seg_ids, num_segments: int, mask):
+    """(value, row_idx) of the earliest valid row per segment.
+
+    Timestamps arrive as an EXACT lexicographic int32 pair
+    (rel_hi = rel_ns >> 30, rel_lo = rel_ns & (2^30-1)) so ns-precision
+    ordering survives on devices without int64; scan order breaks true ns
+    ties (reference first/last tie semantics,
     engine/series_agg_func.gen.go FirstReduce)."""
-    return _seg_extreme_by_time(values, rel_t, seg_ids, num_segments, mask, latest=False)
+    return _seg_extreme_by_time(
+        values, rel_hi, rel_lo, seg_ids, num_segments, mask, latest=False
+    )
 
 
-def seg_last(values, rel_t, seg_ids, num_segments: int, mask):
-    return _seg_extreme_by_time(values, rel_t, seg_ids, num_segments, mask, latest=True)
+def seg_last(values, rel_hi, rel_lo, seg_ids, num_segments: int, mask):
+    return _seg_extreme_by_time(
+        values, rel_hi, rel_lo, seg_ids, num_segments, mask, latest=True
+    )
 
 
-def _seg_extreme_by_time(values, rel_t, seg_ids, num_segments, mask, latest):
+def _seg_extreme_by_time(values, rel_hi, rel_lo, seg_ids, num_segments, mask, latest):
     n = values.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     if latest:
-        t_ext = jax.ops.segment_max(
-            jnp.where(mask, rel_t, -_BIG_I32), seg_ids, num_segments=num_segments
-        )
-        cand = mask & (rel_t == t_ext[seg_ids])
-        # last occurrence in scan order among equal timestamps
-        sel = jax.ops.segment_max(
-            jnp.where(cand, idx, -_BIG_I32), seg_ids, num_segments=num_segments
-        )
+        smax = lambda d: jax.ops.segment_max(d, seg_ids, num_segments=num_segments)  # noqa: E731
+        hi_ext = smax(jnp.where(mask, rel_hi, -_BIG_I32))
+        cand = mask & (rel_hi == hi_ext[seg_ids])
+        lo_ext = smax(jnp.where(cand, rel_lo, -_BIG_I32))
+        cand &= rel_lo == lo_ext[seg_ids]
+        sel = smax(jnp.where(cand, idx, -_BIG_I32))
     else:
-        t_ext = jax.ops.segment_min(
-            jnp.where(mask, rel_t, _BIG_I32), seg_ids, num_segments=num_segments
-        )
-        cand = mask & (rel_t == t_ext[seg_ids])
-        sel = jax.ops.segment_min(
-            jnp.where(cand, idx, _BIG_I32), seg_ids, num_segments=num_segments
-        )
+        smin = lambda d: jax.ops.segment_min(d, seg_ids, num_segments=num_segments)  # noqa: E731
+        hi_ext = smin(jnp.where(mask, rel_hi, _BIG_I32))
+        cand = mask & (rel_hi == hi_ext[seg_ids])
+        lo_ext = smin(jnp.where(cand, rel_lo, _BIG_I32))
+        cand &= rel_lo == lo_ext[seg_ids]
+        sel = smin(jnp.where(cand, idx, _BIG_I32))
     safe = jnp.clip(sel, 0, n - 1)
-    return values[safe], t_ext, sel
+    return values[safe], sel
 
 
-def seg_min_selector(values, rel_t, seg_ids, num_segments: int, mask):
-    """min() as a *selector*: also returns the timestamp of the (first)
+def seg_min_selector(values, seg_ids, num_segments: int, mask):
+    """min() as a *selector*: also returns the row index of the (first)
     minimum row — InfluxQL bare-selector queries return the point's own time
-    (reference MinReduce keeps the row, series_agg_func.gen.go)."""
-    return _seg_extreme_by_value(values, rel_t, seg_ids, num_segments, mask, want_max=False)
+    (reference MinReduce keeps the row, series_agg_func.gen.go); the host
+    resolves the index against its exact int64 ns times."""
+    return _seg_extreme_by_value(values, seg_ids, num_segments, mask, want_max=False)
 
 
-def seg_max_selector(values, rel_t, seg_ids, num_segments: int, mask):
-    return _seg_extreme_by_value(values, rel_t, seg_ids, num_segments, mask, want_max=True)
+def seg_max_selector(values, seg_ids, num_segments: int, mask):
+    return _seg_extreme_by_value(values, seg_ids, num_segments, mask, want_max=True)
 
 
-def _seg_extreme_by_value(values, rel_t, seg_ids, num_segments, mask, want_max):
+def _seg_extreme_by_value(values, seg_ids, num_segments, mask, want_max):
     n = values.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     if want_max:
@@ -138,7 +145,7 @@ def _seg_extreme_by_value(values, rel_t, seg_ids, num_segments, mask, want_max):
         jnp.where(cand, idx, _BIG_I32), seg_ids, num_segments=num_segments
     )
     safe = jnp.clip(sel, 0, n - 1)
-    return v_ext, rel_t[safe], sel
+    return v_ext, sel
 
 
 def _sort_by_segment(values, seg_ids, num_segments, mask):

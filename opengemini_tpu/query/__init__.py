@@ -1,0 +1,8 @@
+"""Query planning + execution (reference: engine/executor, 64k LoC Go).
+
+The reference executes a DAG of goroutine transforms streaming chunks; the
+TPU-native design instead compiles each query shape into a jitted segmented
+-reduction graph (the plan-template idea, engine/executor/select.go:121
+SqlPlanTemplate) and runs the scan->group->reduce stage as one device
+program per (aggregate, shape) template.
+"""

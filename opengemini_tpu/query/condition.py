@@ -1,0 +1,302 @@
+"""WHERE-clause decomposition: time range, tag filter, field filter.
+
+Reference: the reference splits conditions during plan building
+(influxql.ConditionExpr / getTimeRange in lifted influx/query); here the
+split is explicit: the AND-tree is walked once, each leaf classified as a
+time bound (-> scan range), a tag comparison (-> inverted-index sid set),
+or a field comparison (-> vectorized numpy row mask applied before device
+transfer).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+
+import numpy as np
+
+from opengemini_tpu.sql import ast
+
+MIN_TIME = -(2**63) + 1
+MAX_TIME = 2**63 - 1
+
+
+class ConditionError(ValueError):
+    pass
+
+
+class SplitCondition:
+    """tmin inclusive, tmax exclusive (ns); tag_expr / field_expr are AST
+    subtrees or None."""
+
+    def __init__(self, tmin, tmax, tag_expr, field_expr):
+        self.tmin = tmin
+        self.tmax = tmax
+        self.tag_expr = tag_expr
+        self.field_expr = field_expr
+
+
+def split(cond, tag_keys: set[str], now_ns: int) -> SplitCondition:
+    tmin, tmax = MIN_TIME, MAX_TIME
+    tag_parts: list = []
+    field_parts: list = []
+
+    def walk(e):
+        nonlocal tmin, tmax
+        e = _strip(e)
+        if e is None:
+            return
+        if isinstance(e, ast.BinaryExpr) and e.op == "AND":
+            walk(e.lhs)
+            walk(e.rhs)
+            return
+        if _is_time_cond(e):
+            lo, hi = _time_bounds(e, now_ns)
+            tmin = max(tmin, lo)
+            tmax = min(tmax, hi)
+            return
+        refs = _collect_refs(e)
+        if refs and refs <= tag_keys:
+            tag_parts.append(e)
+        elif refs and not (refs & tag_keys):
+            field_parts.append(e)
+        elif not refs:
+            field_parts.append(e)  # constant condition
+        else:
+            raise ConditionError(
+                "conditions mixing tags and fields in one OR subtree are not supported"
+            )
+
+    walk(cond)
+    tag_expr = _and_join(tag_parts)
+    field_expr = _and_join(field_parts)
+    return SplitCondition(tmin, tmax, tag_expr, field_expr)
+
+
+def _and_join(parts: list):
+    if not parts:
+        return None
+    e = parts[0]
+    for p in parts[1:]:
+        e = ast.BinaryExpr("AND", e, p)
+    return e
+
+
+def _strip(e):
+    while isinstance(e, ast.ParenExpr):
+        e = e.expr
+    return e
+
+
+def _is_time_cond(e) -> bool:
+    if not isinstance(e, ast.BinaryExpr):
+        return False
+    lhs, rhs = _strip(e.lhs), _strip(e.rhs)
+    return (isinstance(lhs, ast.VarRef) and lhs.name.lower() == "time") or (
+        isinstance(rhs, ast.VarRef) and rhs.name.lower() == "time"
+    )
+
+
+def _collect_refs(e) -> set[str]:
+    out: set[str] = set()
+
+    def walk(x):
+        x = _strip(x)
+        if isinstance(x, ast.VarRef):
+            out.add(x.name)
+        elif isinstance(x, ast.BinaryExpr):
+            walk(x.lhs)
+            walk(x.rhs)
+        elif isinstance(x, ast.UnaryExpr):
+            walk(x.expr)
+        elif isinstance(x, ast.Call):
+            for a in x.args:
+                walk(a)
+
+    walk(e)
+    return out
+
+
+def _time_bounds(e: ast.BinaryExpr, now_ns: int) -> tuple[int, int]:
+    lhs, rhs = _strip(e.lhs), _strip(e.rhs)
+    op = e.op
+    if isinstance(rhs, ast.VarRef) and rhs.name.lower() == "time":
+        # flip: lit OP time  ->  time OP' lit
+        lhs, rhs = rhs, lhs
+        op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+    t = eval_time_expr(rhs, now_ns)
+    if op == ">":
+        return (t + 1, MAX_TIME)
+    if op == ">=":
+        return (t, MAX_TIME)
+    if op == "<":
+        return (MIN_TIME, t)
+    if op == "<=":
+        return (MIN_TIME, t + 1)
+    if op == "=":
+        return (t, t + 1)
+    raise ConditionError(f"unsupported time operator {op!r}")
+
+
+def eval_time_expr(e, now_ns: int) -> int:
+    """Evaluate a time-valued expression: now(), literals, +/- arithmetic."""
+    e = _strip(e)
+    if isinstance(e, ast.Call) and e.name == "now":
+        return now_ns
+    if isinstance(e, ast.IntegerLiteral):
+        return e.val  # bare integers in time context are ns
+    if isinstance(e, ast.NumberLiteral):
+        return int(e.val)
+    if isinstance(e, ast.DurationLiteral):
+        return e.val_ns
+    if isinstance(e, ast.StringLiteral):
+        return parse_rfc3339(e.val)
+    if isinstance(e, ast.UnaryExpr) and e.op == "-":
+        return -eval_time_expr(e.expr, now_ns)
+    if isinstance(e, ast.BinaryExpr) and e.op in ("+", "-"):
+        a = eval_time_expr(e.lhs, now_ns)
+        b = eval_time_expr(e.rhs, now_ns)
+        return a + b if e.op == "+" else a - b
+    raise ConditionError(f"cannot evaluate time expression: {e}")
+
+
+_TIME_FORMATS = [
+    "%Y-%m-%dT%H:%M:%S.%fZ",
+    "%Y-%m-%dT%H:%M:%SZ",
+    "%Y-%m-%d %H:%M:%S.%f",
+    "%Y-%m-%d %H:%M:%S",
+    "%Y-%m-%d",
+]
+
+
+def parse_rfc3339(s: str) -> int:
+    for fmt in _TIME_FORMATS:
+        try:
+            dt = _dt.datetime.strptime(s, fmt).replace(tzinfo=_dt.timezone.utc)
+            return int(dt.timestamp()) * 1_000_000_000 + dt.microsecond * 1000
+        except ValueError:
+            continue
+    raise ConditionError(f"bad time string {s!r}")
+
+
+def format_rfc3339(t_ns: int) -> str:
+    dt = _dt.datetime.fromtimestamp(t_ns // 1_000_000_000, tz=_dt.timezone.utc)
+    frac = t_ns % 1_000_000_000
+    base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+    if frac == 0:
+        return base + "Z"
+    s = f"{frac:09d}".rstrip("0")
+    return f"{base}.{s}Z"
+
+
+# -- tag filter -> sid sets --------------------------------------------------
+
+
+def eval_tag_expr(expr, index, measurement: str) -> set[int]:
+    """Evaluate a tags-only filter to a set of series ids via the inverted
+    index (reference: engine/index/tsi/search.go tag filter search)."""
+    expr = _strip(expr)
+    if expr is None:
+        return index.series_ids(measurement)
+    if isinstance(expr, ast.BinaryExpr):
+        if expr.op == "AND":
+            return eval_tag_expr(expr.lhs, index, measurement) & eval_tag_expr(
+                expr.rhs, index, measurement
+            )
+        if expr.op == "OR":
+            return eval_tag_expr(expr.lhs, index, measurement) | eval_tag_expr(
+                expr.rhs, index, measurement
+            )
+        lhs, rhs = _strip(expr.lhs), _strip(expr.rhs)
+        if isinstance(rhs, ast.VarRef) and not isinstance(lhs, ast.VarRef):
+            lhs, rhs = rhs, lhs
+        if not isinstance(lhs, ast.VarRef):
+            raise ConditionError(f"bad tag condition: {expr}")
+        key = lhs.name
+        if expr.op in ("=", "!=", "<>"):
+            if not isinstance(rhs, ast.StringLiteral):
+                raise ConditionError("tag comparison requires a string literal")
+            if expr.op == "=":
+                return index.match_eq(measurement, key, rhs.val)
+            return index.match_neq(measurement, key, rhs.val)
+        if expr.op in ("=~", "!~"):
+            if not isinstance(rhs, ast.RegexLiteral):
+                raise ConditionError("regex comparison requires a regex")
+            return index.match_regex(measurement, key, rhs.pattern, negate=expr.op == "!~")
+    raise ConditionError(f"unsupported tag filter: {expr}")
+
+
+# -- field filter -> numpy mask ----------------------------------------------
+
+
+def field_filter_refs(expr) -> set[str]:
+    return _collect_refs(expr)
+
+
+def eval_field_expr(expr, record) -> np.ndarray:
+    """Vectorized row mask for a fields-only filter over a Record. Null
+    (invalid) values compare false, like the reference's cond functions
+    (lib/binaryfilterfunc functions.go:143)."""
+    n = len(record)
+    expr = _strip(expr)
+    if expr is None:
+        return np.ones(n, dtype=np.bool_)
+    if isinstance(expr, ast.BinaryExpr):
+        if expr.op == "AND":
+            return eval_field_expr(expr.lhs, record) & eval_field_expr(expr.rhs, record)
+        if expr.op == "OR":
+            return eval_field_expr(expr.lhs, record) | eval_field_expr(expr.rhs, record)
+        lhs, rhs = _strip(expr.lhs), _strip(expr.rhs)
+        op = expr.op
+        if isinstance(rhs, ast.VarRef) and not isinstance(lhs, ast.VarRef):
+            lhs, rhs = rhs, lhs
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if isinstance(lhs, ast.VarRef):
+            col = record.columns.get(lhs.name)
+            if col is None:
+                return np.zeros(n, dtype=np.bool_)
+            if isinstance(rhs, ast.RegexLiteral):
+                rx = re.compile(rhs.pattern)
+                vals = np.array(
+                    [bool(rx.search(v)) if isinstance(v, str) else False for v in col.values]
+                )
+                m = vals if op == "=~" else ~vals
+                return m & col.valid
+            lit = _literal_value(rhs)
+            vals = col.values
+            if isinstance(lit, str) != (col.values.dtype == object):
+                return np.zeros(n, dtype=np.bool_)
+            with np.errstate(invalid="ignore"):
+                if op == "=":
+                    m = vals == lit
+                elif op in ("!=", "<>"):
+                    m = vals != lit
+                elif op == "<":
+                    m = vals < lit
+                elif op == "<=":
+                    m = vals <= lit
+                elif op == ">":
+                    m = vals > lit
+                elif op == ">=":
+                    m = vals >= lit
+                else:
+                    raise ConditionError(f"unsupported field operator {op!r}")
+            return np.asarray(m, dtype=np.bool_) & col.valid
+    if isinstance(expr, ast.BooleanLiteral):
+        return np.full(n, expr.val, dtype=np.bool_)
+    raise ConditionError(f"unsupported field filter: {expr}")
+
+
+def _literal_value(e):
+    e = _strip(e)
+    if isinstance(e, ast.NumberLiteral):
+        return e.val
+    if isinstance(e, ast.IntegerLiteral):
+        return e.val
+    if isinstance(e, ast.StringLiteral):
+        return e.val
+    if isinstance(e, ast.BooleanLiteral):
+        return e.val
+    if isinstance(e, ast.UnaryExpr) and e.op == "-":
+        return -_literal_value(e.expr)
+    raise ConditionError(f"expected literal, got {e}")

@@ -1,0 +1,772 @@
+"""Statement executor: AST -> scan -> device reduce -> InfluxDB JSON rows.
+
+The single-node equivalent of the reference's StatementExecutor
+(lifted/influx/coordinator/statement_executor.go:206) + executor.Select
+(engine/executor/select.go:52) + the store-side cursor/agg stack
+(engine/iterators.go, aggregate_cursor.go): shard mapping, index search,
+chunk scan with pre-agg skipping, then one jitted segmented-reduction
+program per aggregate (models/templates.py), then fill/limit/format.
+
+Results use influx wire shape:
+    {"results": [{"statement_id": 0, "series": [
+        {"name": ..., "tags": {...}, "columns": [...], "values": [[...]]}]}]}
+Times in values are int ns; the HTTP layer formats RFC3339/epoch.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import time as _time
+
+import numpy as np
+
+from opengemini_tpu.models import templates
+from opengemini_tpu.ops import aggregates as aggmod
+from opengemini_tpu.ops import window as winmod
+from opengemini_tpu.query import condition as cond
+from opengemini_tpu.record import FieldType
+from opengemini_tpu.sql import ast
+from opengemini_tpu.sql.parser import parse
+
+NS = 1_000_000_000
+MAX_SELECT_BUCKETS = 1_000_000  # influx max-select-buckets guard
+
+
+class QueryError(Exception):
+    pass
+
+
+class Executor:
+    def __init__(self, engine):
+        self.engine = engine
+
+    # -- entry --------------------------------------------------------------
+
+    def execute(self, text: str, db: str = "", now_ns: int | None = None) -> dict:
+        if now_ns is None:
+            now_ns = _time.time_ns()
+        try:
+            stmts = parse(text)
+        except ValueError as e:
+            return {"results": [{"statement_id": 0, "error": f"error parsing query: {e}"}]}
+        results = []
+        for i, stmt in enumerate(stmts):
+            try:
+                res = self.execute_statement(stmt, db, now_ns)
+            except (QueryError, cond.ConditionError, KeyError, ValueError) as e:
+                res = {"error": str(e)}
+            res["statement_id"] = i
+            results.append(res)
+        return {"results": results}
+
+    def execute_statement(self, stmt, db: str, now_ns: int) -> dict:
+        if isinstance(stmt, ast.SelectStatement):
+            return self._select(stmt, db, now_ns)
+        if isinstance(stmt, ast.ShowDatabases):
+            rows = [[name] for name in self.engine.database_names()]
+            return _series_result("databases", None, ["name"], rows)
+        if isinstance(stmt, ast.ShowMeasurements):
+            return self._show_measurements(stmt, db)
+        if isinstance(stmt, ast.ShowTagKeys):
+            return self._show_tag_keys(stmt, db)
+        if isinstance(stmt, ast.ShowTagValues):
+            return self._show_tag_values(stmt, db)
+        if isinstance(stmt, ast.ShowFieldKeys):
+            return self._show_field_keys(stmt, db)
+        if isinstance(stmt, ast.ShowSeries):
+            return self._show_series(stmt, db)
+        if isinstance(stmt, ast.ShowRetentionPolicies):
+            return self._show_rps(stmt, db)
+        if isinstance(stmt, ast.CreateDatabase):
+            self.engine.create_database(stmt.name)
+            return {}
+        if isinstance(stmt, ast.DropDatabase):
+            self.engine.drop_database(stmt.name)
+            return {}
+        if isinstance(stmt, ast.CreateRetentionPolicy):
+            self.engine.create_retention_policy(
+                stmt.database or db,
+                stmt.name,
+                stmt.duration_ns,
+                stmt.shard_duration_ns,
+                stmt.default,
+            )
+            return {}
+        if isinstance(stmt, ast.DropRetentionPolicy):
+            d = self.engine.databases.get(stmt.database or db)
+            if d and stmt.name in d.rps:
+                del d.rps[stmt.name]
+                self.engine._save_meta()
+            return {}
+        if isinstance(stmt, ast.DropMeasurement):
+            raise QueryError("DROP MEASUREMENT is not supported yet")
+        raise QueryError(f"unsupported statement: {type(stmt).__name__}")
+
+    # -- SELECT -------------------------------------------------------------
+
+    def _select(self, stmt: ast.SelectStatement, db: str, now_ns: int) -> dict:
+        for src in stmt.sources:
+            if isinstance(src, ast.SubQuery):
+                raise QueryError("subqueries are not supported yet")
+        if stmt.into is not None:
+            raise QueryError("SELECT INTO is not supported yet")
+
+        all_series = []
+        for src in stmt.sources:
+            src_db = src.database or db
+            if not src_db:
+                raise QueryError("database name required")
+            if src_db not in self.engine.databases:
+                raise QueryError(f"database not found: {src_db}")
+            names = self._resolve_measurements(src, src_db)
+            for mst in names:
+                all_series.extend(
+                    self._select_measurement(stmt, src_db, src.rp or None, mst, now_ns)
+                )
+        # SLIMIT/SOFFSET over series
+        if stmt.soffset:
+            all_series = all_series[stmt.soffset :]
+        if stmt.slimit:
+            all_series = all_series[: stmt.slimit]
+        if not all_series:
+            return {}
+        return {"series": all_series}
+
+    def _resolve_measurements(self, src: ast.Measurement, db: str) -> list[str]:
+        if src.name:
+            return [src.name]
+        rx = re.compile(src.regex)
+        shards = self.engine.shards_for_range(db, src.rp or None, cond.MIN_TIME, cond.MAX_TIME)
+        names = set()
+        for sh in shards:
+            for m in sh.measurements():
+                if rx.search(m):
+                    names.add(m)
+        return sorted(names)
+
+    def _select_measurement(self, stmt, db, rp, mst, now_ns) -> list[dict]:
+        # classify fields: aggregate query vs raw query
+        calls = _collect_calls(stmt.fields)
+        if calls:
+            return self._select_agg(stmt, db, rp, mst, now_ns, calls)
+        return self._select_raw(stmt, db, rp, mst, now_ns)
+
+    # -- aggregate path -----------------------------------------------------
+
+    def _select_agg(self, stmt, db, rp, mst, now_ns, calls) -> list[dict]:
+        shards_all = self.engine.shards_for_range(db, rp, cond.MIN_TIME, cond.MAX_TIME)
+        tag_keys: set[str] = set()
+        for sh in shards_all:
+            tag_keys.update(sh.index.tag_keys(mst))
+        sc = cond.split(stmt.condition, tag_keys, now_ns)
+        tmin, tmax = sc.tmin, sc.tmax
+
+        shards = self.engine.shards_for_range(db, rp, tmin, tmax)
+        if not shards:
+            return []
+
+        # resolve agg specs + fields
+        aggs = []  # (out_name, spec, params, field_name)
+        for f in stmt.fields:
+            for call in _calls_in(f.expr):
+                spec, params, field_name = _resolve_call(call)
+                aggs.append((call, spec, params, field_name))
+
+        # data-driven clamp of an unbounded range (influx uses epoch 0/now)
+        if tmin == cond.MIN_TIME or tmax == cond.MAX_TIME:
+            dmin, dmax = _data_time_range(shards, mst)
+            if dmin is None:
+                return []
+            if tmin == cond.MIN_TIME:
+                tmin = dmin
+            if tmax == cond.MAX_TIME:
+                tmax = dmax + 1
+        if tmax <= tmin:
+            return []
+
+        group_time = stmt.group_by_time
+        if group_time:
+            aligned = int(winmod.window_start(tmin, group_time.every_ns, group_time.offset_ns))
+            W = winmod.num_windows(tmin, tmax, group_time.every_ns, group_time.offset_ns)
+            if W > MAX_SELECT_BUCKETS:
+                raise QueryError(
+                    f"GROUP BY time({group_time.every_ns}ns) would create {W} buckets "
+                    f"(max {MAX_SELECT_BUCKETS})"
+                )
+        else:
+            aligned = tmin if tmin > cond.MIN_TIME else 0
+            W = 1
+
+        group_tags = self._group_tags(stmt, shards, mst)
+
+        # map (group key) -> gid; collect per-shard sid lists
+        gid_of: dict[tuple, int] = {}
+        group_keys: list[tuple] = []
+        scan_plan = []  # (shard, sid, gid)
+        for sh in shards:
+            sids = cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
+            for sid in sorted(sids):
+                tags = sh.index.tags_of(sid)
+                key = tuple(tags.get(k, "") for k in group_tags)
+                gid = gid_of.get(key)
+                if gid is None:
+                    gid = len(group_keys)
+                    gid_of[key] = gid
+                    group_keys.append(key)
+                scan_plan.append((sh, sid, gid))
+        if not scan_plan:
+            return []
+        num_groups = len(group_keys)
+        num_segments = num_groups * W
+
+        needed_fields = sorted({a[3] for a in aggs})
+        field_filter_fields = sorted(cond.field_filter_refs(sc.field_expr)) if sc.field_expr else []
+        read_fields = sorted(set(needed_fields) | set(field_filter_fields))
+
+        dtype = templates.compute_dtype()
+        batches: dict[str, templates.AggBatch] = {
+            f: templates.AggBatch(dtype) for f in needed_fields
+        }
+        schema: dict[str, FieldType] = {}
+        for sh in shards:
+            schema.update(sh.schema(mst))
+
+        for sh, sid, gid in scan_plan:
+            rec = sh.read_series(mst, sid, tmin, tmax, fields=read_fields)
+            if len(rec) == 0:
+                continue
+            fmask = (
+                cond.eval_field_expr(sc.field_expr, rec)
+                if sc.field_expr is not None
+                else None
+            )
+            if group_time:
+                widx, _ = winmod.window_index(
+                    rec.times, tmin, group_time.every_ns, group_time.offset_ns
+                )
+                seg = gid * W + widx.astype(np.int64)
+            else:
+                seg = np.full(len(rec), gid, dtype=np.int64)
+            rel = rec.times - aligned  # int64 ns; split on add()
+            for fname in needed_fields:
+                col = rec.columns.get(fname)
+                if col is None:
+                    continue
+                if col.ftype in (FieldType.STRING,):
+                    vals = np.zeros(len(rec), dtype=dtype)  # count-only path
+                elif col.ftype == FieldType.BOOL:
+                    vals = col.values.astype(dtype)
+                else:
+                    vals = col.values.astype(dtype)
+                m = col.valid.copy()
+                if fmask is not None:
+                    m &= fmask
+                batches[fname].add(vals, rel, seg.astype(np.int32), m, rec.times)
+
+        # run aggregates on device
+        agg_results = {}  # id(call) -> (values, sel, counts)
+        for call, spec, params, field_name in aggs:
+            out, sel, counts = batches[field_name].run(spec, num_segments, params)
+            agg_results[id(call)] = (out, sel, counts, spec, field_name)
+
+        return self._render_agg(
+            stmt, mst, group_tags, group_keys, aligned, W, agg_results,
+            batches, schema, tmin,
+        )
+
+    def _group_tags(self, stmt, shards, mst) -> list[str]:
+        if stmt.group_by_all_tags:
+            keys: set[str] = set()
+            for sh in shards:
+                keys.update(sh.index.tag_keys(mst))
+            return sorted(keys)
+        return list(stmt.group_by_tags)
+
+    def _render_agg(
+        self, stmt, mst, group_tags, group_keys, aligned, W, agg_results,
+        batches, schema, tmin,
+    ) -> list[dict]:
+        group_time = stmt.group_by_time
+        every = group_time.every_ns if group_time else 0
+
+        columns = ["time"]
+        col_exprs = []
+        used_names: dict[str, int] = {}
+        for f in stmt.fields:
+            name = f.alias or _default_field_name(f.expr)
+            k = used_names.get(name, 0)
+            used_names[name] = k + 1
+            if k:
+                name = f"{name}_{k}"
+            columns.append(name)
+            col_exprs.append(f.expr)
+
+        # selector fast path: single bare selector, no GROUP BY time ->
+        # result time is the selected point's own timestamp
+        single_selector = None
+        if not group_time and len(col_exprs) == 1:
+            only = _strip_expr(col_exprs[0])
+            if isinstance(only, ast.Call):
+                entry = agg_results.get(id(only))
+                if entry and entry[3].is_selector:
+                    single_selector = entry
+
+        host_times = (
+            batches[single_selector[4]].host_times()
+            if single_selector is not None
+            else None
+        )
+        out_series = []
+        order = sorted(range(len(group_keys)), key=lambda g: group_keys[g])
+        for g in order:
+            key = group_keys[g]
+            rows = []
+            for w in range(W):
+                seg = g * W + w
+                t_out = aligned + w * every if group_time else (aligned if aligned else 0)
+                vals = []
+                any_present = False
+                for expr in col_exprs:
+                    v, present = _eval_output_expr(expr, agg_results, seg, schema)
+                    any_present = any_present or present
+                    vals.append(v)
+                if single_selector is not None:
+                    out, sel, counts, spec, fname = single_selector
+                    if counts[seg] > 0:
+                        t_out = int(host_times[sel[seg]])
+                rows.append((t_out, vals, any_present))
+            rows = _apply_fill(rows, stmt, columns)
+            if not stmt.ascending:
+                rows.reverse()
+            if stmt.offset:
+                rows = rows[stmt.offset :]
+            if stmt.limit:
+                rows = rows[: stmt.limit]
+            if not rows:
+                continue
+            series = {
+                "name": mst,
+                "columns": columns,
+                "values": [[t] + v for t, v, _p in rows],
+            }
+            if group_tags:
+                series["tags"] = dict(zip(group_tags, key))
+            out_series.append(series)
+        return out_series
+
+    # -- raw path -----------------------------------------------------------
+
+    def _select_raw(self, stmt, db, rp, mst, now_ns) -> list[dict]:
+        shards_all = self.engine.shards_for_range(db, rp, cond.MIN_TIME, cond.MAX_TIME)
+        tag_keys: set[str] = set()
+        schema: dict[str, FieldType] = {}
+        for sh in shards_all:
+            tag_keys.update(sh.index.tag_keys(mst))
+            schema.update(sh.schema(mst))
+        if not schema:
+            return []
+        sc = cond.split(stmt.condition, tag_keys, now_ns)
+        shards = self.engine.shards_for_range(db, rp, sc.tmin, sc.tmax)
+        if not shards:
+            return []
+
+        # output columns
+        names: list[str] = []
+        for f in stmt.fields:
+            e = _strip_expr(f.expr)
+            if isinstance(e, ast.Wildcard):
+                names.extend(sorted(set(schema) | tag_keys))
+            else:
+                names.append(f.alias or _default_field_name(f.expr))
+        # dedupe keep order
+        seen = set()
+        columns = ["time"] + [n for n in names if not (n in seen or seen.add(n))]
+
+        group_tags = self._group_tags(stmt, shards, mst)
+        groups: dict[tuple, list] = {}
+        for sh in shards:
+            sids = cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
+            for sid in sorted(sids):
+                tags = sh.index.tags_of(sid)
+                key = tuple(tags.get(k, "") for k in group_tags)
+                groups.setdefault(key, []).append((sh, sid, tags))
+
+        out_series = []
+        for key in sorted(groups):
+            rows: list[list] = []
+            for sh, sid, tags in groups[key]:
+                rec = sh.read_series(mst, sid, sc.tmin, sc.tmax)
+                if len(rec) == 0:
+                    continue
+                fmask = (
+                    cond.eval_field_expr(sc.field_expr, rec)
+                    if sc.field_expr is not None
+                    else np.ones(len(rec), dtype=bool)
+                )
+                # a raw row is emitted if any selected *field* is present
+                present = np.zeros(len(rec), dtype=bool)
+                col_arrays = []
+                for name in columns[1:]:
+                    col = rec.columns.get(name)
+                    if col is not None:
+                        col_arrays.append((col.values, col.valid, col.ftype))
+                        present |= col.valid
+                    elif name in tags:
+                        col_arrays.append((None, None, tags[name]))
+                    else:
+                        col_arrays.append((None, None, None))
+                sel = np.nonzero(fmask & present)[0]
+                for i in sel:
+                    row = [int(rec.times[i])]
+                    for values, valid, extra in col_arrays:
+                        if values is None:
+                            row.append(extra if isinstance(extra, str) else None)
+                        elif valid[i]:
+                            row.append(_pyval(values[i], extra))
+                        else:
+                            row.append(None)
+                    rows.append(row)
+            if not rows:
+                continue
+            rows.sort(key=lambda r: r[0], reverse=not stmt.ascending)
+            if stmt.offset:
+                rows = rows[stmt.offset :]
+            if stmt.limit:
+                rows = rows[: stmt.limit]
+            series = {"name": mst, "columns": columns, "values": rows}
+            if group_tags:
+                series["tags"] = dict(zip(group_tags, key))
+            out_series.append(series)
+        return out_series
+
+    # -- SHOW ---------------------------------------------------------------
+
+    def _all_shards_db(self, db: str):
+        return self.engine.shards_for_range(db, None, cond.MIN_TIME, cond.MAX_TIME)
+
+    def _show_measurements(self, stmt, db) -> dict:
+        db = stmt.database or db
+        names: set[str] = set()
+        for sh in self._all_shards_db(db):
+            names.update(sh.measurements())
+        if stmt.regex:
+            rx = re.compile(stmt.regex)
+            names = {n for n in names if rx.search(n)}
+        if not names:
+            return {}
+        return _series_result("measurements", None, ["name"], [[n] for n in sorted(names)])
+
+    def _show_tag_keys(self, stmt, db) -> dict:
+        db = stmt.database or db
+        per_mst: dict[str, set] = {}
+        for sh in self._all_shards_db(db):
+            for mst in sh.measurements():
+                if stmt.measurement and mst != stmt.measurement:
+                    continue
+                per_mst.setdefault(mst, set()).update(sh.index.tag_keys(mst))
+        series = [
+            _series(m, None, ["tagKey"], [[k] for k in sorted(keys)])
+            for m, keys in sorted(per_mst.items())
+            if keys
+        ]
+        return {"series": series} if series else {}
+
+    def _show_tag_values(self, stmt, db) -> dict:
+        db = stmt.database or db
+        per_mst: dict[str, list] = {}
+        for sh in self._all_shards_db(db):
+            for mst in sh.measurements():
+                if stmt.measurement and mst != stmt.measurement:
+                    continue
+                for key in stmt.keys:
+                    for v in sh.index.tag_values(mst, key):
+                        per_mst.setdefault(mst, []).append((key, v))
+        series = []
+        for mst, pairs in sorted(per_mst.items()):
+            uniq = sorted(set(pairs))
+            series.append(_series(mst, None, ["key", "value"], [list(p) for p in uniq]))
+        return {"series": series} if series else {}
+
+    def _show_field_keys(self, stmt, db) -> dict:
+        db = stmt.database or db
+        per_mst: dict[str, dict] = {}
+        for sh in self._all_shards_db(db):
+            for mst in sh.measurements():
+                if stmt.measurement and mst != stmt.measurement:
+                    continue
+                per_mst.setdefault(mst, {}).update(sh.schema(mst))
+        type_names = {
+            FieldType.FLOAT: "float",
+            FieldType.INT: "integer",
+            FieldType.BOOL: "boolean",
+            FieldType.STRING: "string",
+        }
+        series = []
+        for mst, sch in sorted(per_mst.items()):
+            rows = [[k, type_names[t]] for k, t in sorted(sch.items())]
+            series.append(_series(mst, None, ["fieldKey", "fieldType"], rows))
+        return {"series": series} if series else {}
+
+    def _show_series(self, stmt, db) -> dict:
+        from opengemini_tpu.ingest.line_protocol import series_key
+
+        db = stmt.database or db
+        keys: set[str] = set()
+        for sh in self._all_shards_db(db):
+            for mst in sh.measurements():
+                if stmt.measurement and mst != stmt.measurement:
+                    continue
+                sids = sh.index.series_ids(mst)
+                if stmt.condition is not None:
+                    tag_keys = set(sh.index.tag_keys(mst))
+                    sc = cond.split(stmt.condition, tag_keys, 0)
+                    sids &= cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
+                for sid in sids:
+                    m, tags = sh.index.sid_to_series[sid]
+                    keys.add(series_key(m, tags))
+        if not keys:
+            return {}
+        return _series_result("", None, ["key"], [[k] for k in sorted(keys)])
+
+    def _show_rps(self, stmt, db) -> dict:
+        db = stmt.database or db
+        d = self.engine.databases.get(db)
+        if d is None:
+            raise QueryError(f"database not found: {db}")
+        rows = []
+        for rp in d.rps.values():
+            rows.append(
+                [
+                    rp.name,
+                    _fmt_duration(rp.duration_ns),
+                    _fmt_duration(rp.shard_duration_ns),
+                    1,
+                    rp.name == d.default_rp,
+                ]
+            )
+        return _series_result(
+            "", None,
+            ["name", "duration", "shardGroupDuration", "replicaN", "default"],
+            rows,
+        )
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+def _series(name, tags, columns, values):
+    s = {"name": name, "columns": columns, "values": values}
+    if tags:
+        s["tags"] = tags
+    if not name:
+        del s["name"]
+    return s
+
+
+def _series_result(name, tags, columns, values) -> dict:
+    return {"series": [_series(name, tags, columns, values)]}
+
+
+def _strip_expr(e):
+    while isinstance(e, ast.ParenExpr):
+        e = e.expr
+    return e
+
+
+def _collect_calls(fields) -> list[ast.Call]:
+    out = []
+    for f in fields:
+        out.extend(_calls_in(f.expr))
+    return out
+
+
+def _calls_in(e) -> list[ast.Call]:
+    e = _strip_expr(e)
+    if isinstance(e, ast.Call):
+        return [e]
+    if isinstance(e, ast.BinaryExpr):
+        return _calls_in(e.lhs) + _calls_in(e.rhs)
+    if isinstance(e, ast.UnaryExpr):
+        return _calls_in(e.expr)
+    return []
+
+
+def _resolve_call(call: ast.Call):
+    """-> (AggSpec, params, field_name)."""
+    name = call.name
+    args = call.args
+    if name == "count" and args and isinstance(_strip_expr(args[0]), ast.Call):
+        inner = _strip_expr(args[0])
+        if inner.name == "distinct":
+            spec = aggmod.get("count_distinct")
+            fld = _call_field(inner)
+            return spec, (), fld
+    if name == "percentile":
+        if len(args) != 2:
+            raise QueryError("percentile() takes (field, N)")
+        q = _strip_expr(args[1])
+        if isinstance(q, (ast.IntegerLiteral, ast.NumberLiteral)):
+            qv = float(q.val)
+        else:
+            raise QueryError("percentile() N must be a number")
+        return aggmod.get("percentile"), (qv,), _call_field(call)
+    spec = aggmod.get(name)  # KeyError -> surfaced as query error
+    return spec, (), _call_field(call)
+
+
+def _call_field(call: ast.Call) -> str:
+    if not call.args:
+        raise QueryError(f"{call.name}() requires a field argument")
+    a = _strip_expr(call.args[0])
+    if isinstance(a, ast.VarRef):
+        return a.name
+    if isinstance(a, ast.Wildcard):
+        raise QueryError(f"{call.name}(*) is not supported yet")
+    raise QueryError(f"{call.name}() argument must be a field")
+
+
+def _default_field_name(e) -> str:
+    e = _strip_expr(e)
+    if isinstance(e, ast.Call):
+        if e.name == "count" and e.args:
+            inner = _strip_expr(e.args[0])
+            if isinstance(inner, ast.Call) and inner.name == "distinct":
+                return "count"
+        return e.name
+    if isinstance(e, ast.VarRef):
+        return e.name
+    if isinstance(e, ast.BinaryExpr):
+        calls = _calls_in(e)
+        if calls:
+            return "_".join(c.name for c in calls)
+        refs = sorted({r for r in cond.field_filter_refs(e)})
+        return "_".join(refs) if refs else "expr"
+    return "expr"
+
+
+def _eval_output_expr(expr, agg_results, seg, schema):
+    """Evaluate one output column at segment `seg`. Returns (value, present)."""
+    expr = _strip_expr(expr)
+    if isinstance(expr, ast.Call):
+        entry = agg_results.get(id(expr))
+        if entry is None:
+            raise QueryError(f"unplanned call {expr.name}")
+        out, sel, counts, spec, fname = entry
+        if counts[seg] == 0:
+            return None, False
+        v = out[seg]
+        ftype = schema.get(fname)
+        if spec.int_output:
+            return int(v), True
+        if ftype == FieldType.INT and spec.name in ("sum", "min", "max", "first", "last", "spread"):
+            return int(round(float(v))), True
+        if ftype == FieldType.BOOL and spec.name in ("first", "last", "min", "max"):
+            return bool(round(float(v))), True
+        fv = float(v)
+        if math.isnan(fv) or math.isinf(fv):
+            return None, True
+        return fv, True
+    if isinstance(expr, (ast.NumberLiteral, ast.IntegerLiteral)):
+        return expr.val, False
+    if isinstance(expr, ast.UnaryExpr) and expr.op == "-":
+        v, p = _eval_output_expr(expr.expr, agg_results, seg, schema)
+        return (None if v is None else -v), p
+    if isinstance(expr, ast.BinaryExpr):
+        lv, lp = _eval_output_expr(expr.lhs, agg_results, seg, schema)
+        rv, rp = _eval_output_expr(expr.rhs, agg_results, seg, schema)
+        present = lp or rp
+        if lv is None or rv is None:
+            return None, present
+        try:
+            if expr.op == "+":
+                return lv + rv, present
+            if expr.op == "-":
+                return lv - rv, present
+            if expr.op == "*":
+                return lv * rv, present
+            if expr.op == "/":
+                return (lv / rv if rv != 0 else None), present
+            if expr.op == "%":
+                return (lv % rv if rv != 0 else None), present
+        except TypeError:
+            return None, present
+    raise QueryError(f"unsupported output expression: {expr}")
+
+
+def _apply_fill(rows, stmt, columns):
+    """rows: [(t, vals, any_present)] per window, ascending. Influx fill
+    semantics (reference: engine/executor fill_transform.go)."""
+    fill = stmt.fill_option
+    if not stmt.group_by_time:
+        return [(t, v, p) for t, v, p in rows if p]
+    if fill == "none":
+        return [(t, v, p) for t, v, p in rows if p]
+    if fill == "number":
+        out = []
+        for t, vals, p in rows:
+            vals = [stmt.fill_value if v is None else v for v in vals]
+            out.append((t, vals, p))
+        return out
+    if fill == "previous":
+        prev = [None] * (len(columns) - 1)
+        out = []
+        for t, vals, p in rows:
+            vals = [prev[i] if v is None else v for i, v in enumerate(vals)]
+            prev = vals
+            out.append((t, vals, p))
+        return out
+    if fill == "linear":
+        ncols = len(columns) - 1
+        arr = [[v for v in vals] for _t, vals, _p in rows]
+        for ci in range(ncols):
+            col = [r[ci] for r in arr]
+            col = _linear_fill(col)
+            for ri, v in enumerate(col):
+                arr[ri][ci] = v
+        return [(rows[i][0], arr[i], rows[i][2]) for i in range(len(rows))]
+    return rows  # "null"
+
+
+def _linear_fill(col):
+    n = len(col)
+    known = [i for i, v in enumerate(col) if v is not None]
+    if len(known) < 2:
+        return col
+    out = list(col)
+    for a, b in zip(known, known[1:]):
+        if b - a > 1:
+            va, vb = col[a], col[b]
+            for i in range(a + 1, b):
+                out[i] = va + (vb - va) * (i - a) / (b - a)
+    return out
+
+
+def _pyval(v, ftype):
+    if ftype == FieldType.FLOAT:
+        return float(v)
+    if ftype == FieldType.INT:
+        return int(v)
+    if ftype == FieldType.BOOL:
+        return bool(v)
+    return v if isinstance(v, str) else str(v)
+
+
+def _data_time_range(shards, mst):
+    dmin = dmax = None
+    for sh in shards:
+        for r, c in sh.file_chunks(mst):
+            dmin = c.tmin if dmin is None else min(dmin, c.tmin)
+            dmax = c.tmax if dmax is None else max(dmax, c.tmax)
+        if sh.mem.min_time is not None:
+            dmin = sh.mem.min_time if dmin is None else min(dmin, sh.mem.min_time)
+            dmax = sh.mem.max_time if dmax is None else max(dmax, sh.mem.max_time)
+    return dmin, dmax
+
+
+def _fmt_duration(ns: int) -> str:
+    if ns == 0:
+        return "0s"
+    h, rem = divmod(ns // NS, 3600)
+    m, s = divmod(rem, 60)
+    return f"{h}h{m}m{s}s"

@@ -1,0 +1,1 @@
+"""HTTP protocol front-end (reference: lib/util/lifted/influx/httpd)."""

@@ -1,0 +1,82 @@
+"""ts-server: the single-process all-in-one server binary.
+
+Reference: app/ts-server (run/run.go:38) + the app.Command lifecycle
+(app/command.go:39-58). `python -m opengemini_tpu.server.app -config x.toml`
+or `opengemini_tpu.server.app.main([...])`.
+
+Config (TOML, reference lib/config style):
+    [data]
+    dir = "/var/lib/opengemini-tpu"
+    wal-fsync = false
+    flush-threshold-mb = 64
+    [http]
+    bind-address = "127.0.0.1:8086"
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import tomllib
+
+from opengemini_tpu.server.http import HttpService
+from opengemini_tpu.storage.engine import Engine
+
+DEFAULTS = {
+    "data": {"dir": "./ogtpu-data", "wal-fsync": False, "flush-threshold-mb": 64},
+    "http": {"bind-address": "127.0.0.1:8086"},
+}
+
+
+def load_config(path: str | None) -> dict:
+    cfg = {k: dict(v) for k, v in DEFAULTS.items()}
+    if path:
+        with open(path, "rb") as f:
+            user = tomllib.load(f)
+        for section, vals in user.items():
+            cfg.setdefault(section, {}).update(vals)
+    return cfg
+
+
+def build(cfg: dict) -> HttpService:
+    data = cfg["data"]
+    engine = Engine(
+        data["dir"],
+        sync_wal=bool(data.get("wal-fsync", False)),
+        flush_threshold_bytes=int(data.get("flush-threshold-mb", 64)) << 20,
+    )
+    host, _, port = cfg["http"]["bind-address"].partition(":")
+    return HttpService(engine, host or "127.0.0.1", int(port or 8086))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ts-server", description="opengemini-tpu all-in-one server")
+    ap.add_argument("-config", default=None, help="TOML config path")
+    ap.add_argument("-pidfile", default=None, help="write process id to this file")
+    args = ap.parse_args(argv)
+    svc = build(load_config(args.config))
+    svc.start()
+    if args.pidfile:
+        with open(args.pidfile, "w", encoding="utf-8") as f:
+            f.write(str(os.getpid()))
+    print(f"opengemini-tpu ts-server listening on :{svc.port}", flush=True)
+    stop_event = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop_event.set())
+    stop_event.wait()
+    print("shutting down", flush=True)
+    svc.stop()
+    svc.engine.close()
+    if args.pidfile:
+        try:
+            os.remove(args.pidfile)
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""InfluxDB 1.x-compatible HTTP API.
+
+Reference routes (lib/util/lifted/influx/httpd/handler.go:257-280):
+  GET/POST /query      InfluxQL, params q/db/epoch/pretty/chunked(ignored)
+  POST     /write      line protocol, params db/rp/precision
+  POST     /api/v2/write  bucket=db[/rp], precision
+  GET      /ping, /health
+Auth and TLS are deferred to the cluster round; this is the ts-server
+single-node surface.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from opengemini_tpu import __version__
+from opengemini_tpu.ingest.line_protocol import ParseError
+from opengemini_tpu.query import condition as cond
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.record import FieldTypeConflict
+from opengemini_tpu.storage.engine import DatabaseNotFound, Engine
+
+_EPOCH_DIV = {"ns": 1, "u": 1_000, "µ": 1_000, "ms": 1_000_000, "s": 1_000_000_000,
+              "m": 60_000_000_000, "h": 3_600_000_000_000}
+
+
+class HttpService:
+    """Owns the HTTP listener; one Engine + Executor behind it."""
+
+    def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 8086):
+        self.engine = engine
+        self.executor = Executor(engine)
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def format_result(result: dict, epoch: str | None) -> dict:
+    """Convert internal ns times to the requested epoch, or RFC3339."""
+    for res in result.get("results", []):
+        for series in res.get("series", []):
+            cols = series.get("columns", [])
+            if not cols or cols[0] != "time":
+                continue
+            for row in series.get("values", []):
+                t = row[0]
+                if not isinstance(t, int):
+                    continue
+                if epoch:
+                    row[0] = t // _EPOCH_DIV.get(epoch, 1)
+                else:
+                    row[0] = cond.format_rfc3339(t)
+    return result
+
+
+def _make_handler(svc: HttpService):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "opengemini-tpu/" + __version__
+
+        def log_message(self, fmt, *args):  # quiet; logging layer comes later
+            pass
+
+        # -- plumbing -------------------------------------------------------
+
+        def _params(self) -> dict:
+            parsed = urllib.parse.urlparse(self.path)
+            qs = urllib.parse.parse_qs(parsed.query)
+            return {k: v[-1] for k, v in qs.items()}
+
+        def _body(self) -> bytes:
+            length = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(length) if length else b""
+            if self.headers.get("Content-Encoding") == "gzip":
+                data = gzip.decompress(data)
+            return data
+
+        def _send(self, code: int, payload: bytes = b"", ctype: str = "application/json"):
+            self.send_response(code)
+            if payload:
+                self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("X-Influxdb-Version", "1.8.0-" + __version__)
+            self.end_headers()
+            if payload:
+                self.wfile.write(payload)
+
+        def _send_json(self, code: int, obj: dict, pretty: bool = False):
+            data = json.dumps(obj, indent=4 if pretty else None) + "\n"
+            self._send(code, data.encode("utf-8"))
+
+        # -- routes ---------------------------------------------------------
+
+        def do_GET(self):
+            path = urllib.parse.urlparse(self.path).path
+            if path == "/ping":
+                self._send(204)
+            elif path == "/health":
+                self._send_json(200, {"name": "opengemini-tpu", "status": "pass",
+                                      "version": __version__})
+            elif path == "/query":
+                self._handle_query(self._params())
+            else:
+                self._send_json(404, {"error": "not found"})
+
+        def do_POST(self):
+            path = urllib.parse.urlparse(self.path).path
+            params = self._params()
+            if path == "/query":
+                body = self._body().decode("utf-8", errors="replace")
+                if body and self.headers.get("Content-Type", "").startswith(
+                    "application/x-www-form-urlencoded"
+                ):
+                    form = urllib.parse.parse_qs(body)
+                    for k, v in form.items():
+                        params.setdefault(k, v[-1])
+                self._handle_query(params)
+            elif path == "/write":
+                self._handle_write(params, db=params.get("db", ""),
+                                   rp=params.get("rp") or None)
+            elif path == "/api/v2/write":
+                bucket = params.get("bucket", "")
+                db, _, rp = bucket.partition("/")
+                self._handle_write(params, db=db, rp=rp or None)
+            else:
+                self._send_json(404, {"error": "not found"})
+
+        def _handle_query(self, params: dict):
+            q = params.get("q", "")
+            if not q:
+                self._send_json(400, {"error": "missing required parameter \"q\""})
+                return
+            result = svc.executor.execute(q, db=params.get("db", ""))
+            epoch = params.get("epoch")
+            pretty = params.get("pretty") in ("true", "1")
+            self._send_json(200, format_result(result, epoch), pretty)
+
+        def _handle_write(self, params: dict, db: str, rp):
+            if not db:
+                self._send_json(400, {"error": "database is required"})
+                return
+            precision = params.get("precision", "ns")
+            if precision == "n":
+                precision = "ns"
+            try:
+                svc.engine.write_lines(db, self._body(), precision=precision, rp=rp)
+            except DatabaseNotFound as e:
+                self._send_json(404, {"error": str(e)})
+                return
+            except (ParseError, FieldTypeConflict, ValueError) as e:
+                self._send_json(400, {"error": f"partial write: {e}"})
+                return
+            self._send(204)
+
+    return Handler

@@ -1,0 +1,232 @@
+"""InfluxQL AST nodes (naming mirrors the reference's influxql package)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+# -- expressions -------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class VarRef:
+    name: str
+
+    def __str__(self):
+        return f'"{self.name}"'
+
+
+@dataclass(frozen=True)
+class NumberLiteral:
+    val: float
+
+    def __str__(self):
+        return repr(self.val)
+
+
+@dataclass(frozen=True)
+class IntegerLiteral:
+    val: int
+
+    def __str__(self):
+        return str(self.val)
+
+
+@dataclass(frozen=True)
+class StringLiteral:
+    val: str
+
+    def __str__(self):
+        return f"'{self.val}'"
+
+
+@dataclass(frozen=True)
+class BooleanLiteral:
+    val: bool
+
+    def __str__(self):
+        return "true" if self.val else "false"
+
+
+@dataclass(frozen=True)
+class DurationLiteral:
+    val_ns: int
+
+    def __str__(self):
+        return f"{self.val_ns}ns"
+
+
+@dataclass(frozen=True)
+class RegexLiteral:
+    pattern: str
+
+    def __str__(self):
+        return f"/{self.pattern}/"
+
+
+@dataclass(frozen=True)
+class Wildcard:
+    kind: str = ""  # "", "field", "tag"
+
+    def __str__(self):
+        return "*"
+
+
+@dataclass(frozen=True)
+class Call:
+    name: str
+    args: tuple
+
+    def __str__(self):
+        return f"{self.name}({', '.join(map(str, self.args))})"
+
+
+@dataclass(frozen=True)
+class BinaryExpr:
+    op: str
+    lhs: object
+    rhs: object
+
+    def __str__(self):
+        return f"({self.lhs} {self.op} {self.rhs})"
+
+
+@dataclass(frozen=True)
+class ParenExpr:
+    expr: object
+
+    def __str__(self):
+        return f"({self.expr})"
+
+
+@dataclass(frozen=True)
+class UnaryExpr:
+    op: str
+    expr: object
+
+    def __str__(self):
+        return f"{self.op}{self.expr}"
+
+
+# -- statement pieces --------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Field:
+    expr: object
+    alias: str = ""
+
+
+@dataclass(frozen=True)
+class Measurement:
+    name: str = ""
+    regex: str = ""
+    database: str = ""
+    rp: str = ""
+
+
+@dataclass(frozen=True)
+class SubQuery:
+    stmt: "SelectStatement"
+
+
+@dataclass(frozen=True)
+class TimeDimension:
+    every_ns: int
+    offset_ns: int = 0
+
+
+@dataclass
+class SelectStatement:
+    fields: list[Field] = field(default_factory=list)
+    sources: list = field(default_factory=list)  # Measurement | SubQuery
+    condition: object | None = None
+    group_by_tags: list[str] = field(default_factory=list)
+    group_by_time: TimeDimension | None = None
+    group_by_all_tags: bool = False  # GROUP BY *
+    fill_option: str = "null"  # null | none | previous | linear | <number>
+    fill_value: float = 0.0
+    limit: int = 0
+    offset: int = 0
+    slimit: int = 0
+    soffset: int = 0
+    ascending: bool = True
+    tz: str = ""
+    into: Measurement | None = None
+
+
+# -- other statements --------------------------------------------------------
+
+
+@dataclass
+class ShowDatabases:
+    pass
+
+
+@dataclass
+class ShowMeasurements:
+    database: str = ""
+    regex: str = ""
+
+
+@dataclass
+class ShowTagKeys:
+    database: str = ""
+    measurement: str = ""
+
+
+@dataclass
+class ShowTagValues:
+    database: str = ""
+    measurement: str = ""
+    keys: list[str] = field(default_factory=list)
+    condition: object | None = None
+
+
+@dataclass
+class ShowFieldKeys:
+    database: str = ""
+    measurement: str = ""
+
+
+@dataclass
+class ShowSeries:
+    database: str = ""
+    measurement: str = ""
+    condition: object | None = None
+
+
+@dataclass
+class ShowRetentionPolicies:
+    database: str = ""
+
+
+@dataclass
+class CreateDatabase:
+    name: str = ""
+
+
+@dataclass
+class DropDatabase:
+    name: str = ""
+
+
+@dataclass
+class CreateRetentionPolicy:
+    database: str = ""
+    name: str = ""
+    duration_ns: int = 0
+    shard_duration_ns: int | None = None
+    replication: int = 1
+    default: bool = False
+
+
+@dataclass
+class DropRetentionPolicy:
+    database: str = ""
+    name: str = ""
+
+
+@dataclass
+class DropMeasurement:
+    name: str = ""

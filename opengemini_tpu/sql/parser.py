@@ -1,0 +1,467 @@
+"""InfluxQL recursive-descent parser.
+
+Covers the surface the engine executes: SELECT (aggregates, selectors,
+math expressions, WHERE with time/tag/field conditions, GROUP BY
+time(...)/tags/*, FILL, ORDER BY time, LIMIT/OFFSET/SLIMIT/SOFFSET, INTO,
+subqueries), SHOW {DATABASES, MEASUREMENTS, TAG KEYS/VALUES, FIELD KEYS,
+SERIES, RETENTION POLICIES}, CREATE/DROP DATABASE, CREATE/DROP RETENTION
+POLICY, DROP MEASUREMENT.
+
+Reference grammar: lib/util/lifted/influx/influxql (yacc sql.y).
+"""
+
+from __future__ import annotations
+
+from opengemini_tpu.sql import ast
+from opengemini_tpu.sql.lexer import Lexer, Token
+
+
+class ParseError(ValueError):
+    pass
+
+
+# operator precedence, low to high (influxql)
+_PRECEDENCE = {
+    "or": 1,
+    "and": 2,
+    "=": 3, "!=": 3, "<>": 3, "<": 3, "<=": 3, ">": 3, ">=": 3, "=~": 3, "!~": 3,
+    "+": 4, "-": 4,
+    "*": 5, "/": 5, "%": 5,
+}
+
+
+def parse(text: str):
+    """Parse one or more ;-separated statements; returns a list."""
+    p = Parser(text)
+    stmts = []
+    while True:
+        tok = p.lex.peek()
+        if tok.kind == "EOF":
+            break
+        if tok.kind == "OP" and tok.val == ";":
+            p.lex.next()
+            continue
+        stmts.append(p.parse_statement())
+    return stmts
+
+
+def parse_one(text: str):
+    stmts = parse(text)
+    if len(stmts) != 1:
+        raise ParseError(f"expected exactly one statement, got {len(stmts)}")
+    return stmts[0]
+
+
+class Parser:
+    def __init__(self, text: str):
+        self.lex = Lexer(text)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _expect_kw(self, *words: str) -> str:
+        tok = self.lex.next()
+        if tok.kind != "KEYWORD" or tok.val not in words:
+            raise ParseError(f"expected {'/'.join(words).upper()}, got {tok.val!r}")
+        return tok.val
+
+    def _accept_kw(self, *words: str) -> str | None:
+        tok = self.lex.peek()
+        if tok.kind == "KEYWORD" and tok.val in words:
+            self.lex.next()
+            return tok.val
+        return None
+
+    def _expect_op(self, op: str) -> None:
+        tok = self.lex.next()
+        if tok.kind != "OP" or tok.val != op:
+            raise ParseError(f"expected {op!r}, got {tok.val!r}")
+
+    def _accept_op(self, op: str) -> bool:
+        tok = self.lex.peek()
+        if tok.kind == "OP" and tok.val == op:
+            self.lex.next()
+            return True
+        return False
+
+    def _ident(self) -> str:
+        tok = self.lex.next()
+        if tok.kind == "IDENT":
+            return tok.val
+        # unreserved keywords usable as identifiers
+        if tok.kind == "KEYWORD":
+            return tok.val
+        raise ParseError(f"expected identifier, got {tok.val!r}")
+
+    # -- statements ---------------------------------------------------------
+
+    def parse_statement(self):
+        tok = self.lex.peek()
+        if tok.kind != "KEYWORD":
+            raise ParseError(f"expected statement, got {tok.val!r}")
+        if tok.val == "select":
+            return self.parse_select()
+        if tok.val == "show":
+            return self.parse_show()
+        if tok.val == "create":
+            return self.parse_create()
+        if tok.val == "drop":
+            return self.parse_drop()
+        raise ParseError(f"unsupported statement start: {tok.val!r}")
+
+    def parse_select(self) -> ast.SelectStatement:
+        self._expect_kw("select")
+        stmt = ast.SelectStatement()
+        stmt.fields = self._parse_fields()
+        if self._accept_kw("into"):
+            stmt.into = self._parse_measurement()
+        self._expect_kw("from")
+        stmt.sources = self._parse_sources()
+        if self._accept_kw("where"):
+            stmt.condition = self._parse_expr()
+        if self._accept_kw("group"):
+            self._expect_kw("by")
+            self._parse_group_by(stmt)
+        if self._accept_kw("fill"):
+            self._parse_fill(stmt)
+        if self._accept_kw("order"):
+            self._expect_kw("by")
+            name = self._ident()
+            if name.lower() != "time":
+                raise ParseError("only ORDER BY time is supported")
+            if self._accept_kw("desc"):
+                stmt.ascending = False
+            else:
+                self._accept_kw("asc")
+        stmt.limit = self._parse_int_clause("limit")
+        stmt.offset = self._parse_int_clause("offset")
+        stmt.slimit = self._parse_int_clause("slimit")
+        stmt.soffset = self._parse_int_clause("soffset")
+        if self._accept_kw("tz"):
+            self._expect_op("(")
+            tok = self.lex.next()
+            if tok.kind != "STRING":
+                raise ParseError("TZ expects a string")
+            stmt.tz = tok.val
+            self._expect_op(")")
+        return stmt
+
+    def _parse_int_clause(self, kw: str) -> int:
+        if self._accept_kw(kw):
+            tok = self.lex.next()
+            if tok.kind != "INTEGER":
+                raise ParseError(f"{kw.upper()} expects an integer")
+            return tok.val
+        return 0
+
+    def _parse_fields(self) -> list[ast.Field]:
+        fields = []
+        while True:
+            expr = self._parse_expr()
+            alias = ""
+            if self._accept_kw("as"):
+                alias = self._ident()
+            fields.append(ast.Field(expr, alias))
+            if not self._accept_op(","):
+                break
+        return fields
+
+    def _parse_sources(self) -> list:
+        sources = []
+        while True:
+            tok = self.lex.peek(allow_regex=True)
+            if tok.kind == "REGEX":
+                self.lex.next(allow_regex=True)
+                sources.append(ast.Measurement(regex=tok.val))
+            elif tok.kind == "OP" and tok.val == "(":
+                self.lex.next()
+                sub = self.parse_select()
+                self._expect_op(")")
+                sources.append(ast.SubQuery(sub))
+            else:
+                sources.append(self._parse_measurement())
+            if not self._accept_op(","):
+                break
+        return sources
+
+    def _parse_measurement(self) -> ast.Measurement:
+        # [db [.rp]] . name   with each part optionally quoted; or name only
+        parts = [self._ident()]
+        while self._accept_op("."):
+            tok = self.lex.peek(allow_regex=True)
+            if tok.kind == "OP" and tok.val == ".":
+                parts.append("")  # empty rp: db..measurement
+                continue
+            if tok.kind == "REGEX":
+                self.lex.next(allow_regex=True)
+                if len(parts) == 1:
+                    return ast.Measurement(database=parts[0], regex=tok.val)
+                return ast.Measurement(database=parts[0], rp=parts[1], regex=tok.val)
+            parts.append(self._ident())
+        if len(parts) == 1:
+            return ast.Measurement(name=parts[0])
+        if len(parts) == 2:
+            return ast.Measurement(database=parts[0], name=parts[1])
+        if len(parts) == 3:
+            return ast.Measurement(database=parts[0], rp=parts[1], name=parts[2])
+        raise ParseError("too many dots in measurement")
+
+    def _parse_group_by(self, stmt: ast.SelectStatement) -> None:
+        while True:
+            tok = self.lex.peek(allow_regex=True)
+            if tok.kind == "OP" and tok.val == "*":
+                self.lex.next()
+                stmt.group_by_all_tags = True
+            elif tok.kind == "IDENT" and tok.val.lower() == "time":
+                self.lex.next()
+                self._expect_op("(")
+                t = self.lex.next()
+                if t.kind != "DURATION":
+                    raise ParseError("time() expects a duration")
+                offset = 0
+                if self._accept_op(","):
+                    t2 = self.lex.next()
+                    sign = 1
+                    if t2.kind == "OP" and t2.val == "-":
+                        sign = -1
+                        t2 = self.lex.next()
+                    if t2.kind != "DURATION":
+                        raise ParseError("time() offset expects a duration")
+                    offset = sign * t2.val
+                self._expect_op(")")
+                stmt.group_by_time = ast.TimeDimension(t.val, offset)
+            elif tok.kind in ("IDENT", "KEYWORD"):
+                name = self._ident()
+                stmt.group_by_tags.append(name)
+            else:
+                raise ParseError(f"bad GROUP BY element: {tok.val!r}")
+            if not self._accept_op(","):
+                break
+
+    def _parse_fill(self, stmt: ast.SelectStatement) -> None:
+        self._expect_op("(")
+        tok = self.lex.next()
+        if tok.kind == "KEYWORD" and tok.val in ("null", "none", "previous", "linear"):
+            stmt.fill_option = tok.val
+        elif tok.kind in ("NUMBER", "INTEGER"):
+            stmt.fill_option = "number"
+            stmt.fill_value = float(tok.val)
+        elif tok.kind == "OP" and tok.val == "-":
+            t2 = self.lex.next()
+            if t2.kind not in ("NUMBER", "INTEGER"):
+                raise ParseError("bad fill value")
+            stmt.fill_option = "number"
+            stmt.fill_value = -float(t2.val)
+        else:
+            raise ParseError(f"bad FILL option: {tok.val!r}")
+        self._expect_op(")")
+
+    # -- expressions --------------------------------------------------------
+
+    def _parse_expr(self, min_prec: int = 1):
+        lhs = self._parse_unary()
+        while True:
+            tok = self.lex.peek()
+            op = None
+            if tok.kind == "OP" and tok.val in _PRECEDENCE:
+                op = tok.val
+            elif tok.kind == "KEYWORD" and tok.val in ("and", "or"):
+                op = tok.val
+            if op is None:
+                return lhs
+            prec = _PRECEDENCE[op]
+            if prec < min_prec:
+                return lhs
+            self.lex.next()
+            if op in ("=~", "!~"):
+                rtok = self.lex.next(allow_regex=True)
+                if rtok.kind != "REGEX":
+                    raise ParseError(f"{op} expects a regex")
+                rhs = ast.RegexLiteral(rtok.val)
+            else:
+                rhs = self._parse_expr(prec + 1)
+            lhs = ast.BinaryExpr("AND" if op == "and" else ("OR" if op == "or" else op), lhs, rhs)
+
+    def _parse_unary(self):
+        tok = self.lex.peek()
+        if tok.kind == "OP" and tok.val == "-":
+            self.lex.next()
+            return ast.UnaryExpr("-", self._parse_unary())
+        if tok.kind == "OP" and tok.val == "+":
+            self.lex.next()
+            return self._parse_unary()
+        return self._parse_primary()
+
+    def _parse_primary(self):
+        tok = self.lex.next()
+        if tok.kind == "OP" and tok.val == "(":
+            e = self._parse_expr()
+            self._expect_op(")")
+            return ast.ParenExpr(e)
+        if tok.kind == "NUMBER":
+            return ast.NumberLiteral(tok.val)
+        if tok.kind == "INTEGER":
+            return ast.IntegerLiteral(tok.val)
+        if tok.kind == "DURATION":
+            return ast.DurationLiteral(tok.val)
+        if tok.kind == "STRING":
+            return ast.StringLiteral(tok.val)
+        if tok.kind == "OP" and tok.val == "*":
+            return ast.Wildcard()
+        if tok.kind == "KEYWORD" and tok.val == "true":
+            return ast.BooleanLiteral(True)
+        if tok.kind == "KEYWORD" and tok.val == "false":
+            return ast.BooleanLiteral(False)
+        if tok.kind == "OP" and tok.val == "$":
+            # bind parameter — treated as identifier reference
+            name = self._ident()
+            return ast.VarRef("$" + name)
+        if tok.kind in ("IDENT", "KEYWORD"):
+            name = tok.val
+            if self._accept_op("("):
+                args = []
+                if not self._accept_op(")"):
+                    while True:
+                        targ = self.lex.peek()
+                        if targ.kind == "OP" and targ.val == "*":
+                            self.lex.next()
+                            args.append(ast.Wildcard())
+                        else:
+                            args.append(self._parse_expr())
+                        if not self._accept_op(","):
+                            break
+                    self._expect_op(")")
+                return ast.Call(name.lower(), tuple(args))
+            # double-colon type cast: field::float — parsed, cast ignored
+            if self._accept_op("::"):
+                self._ident()
+            return ast.VarRef(name)
+        raise ParseError(f"unexpected token {tok.val!r} in expression")
+
+    # -- SHOW ---------------------------------------------------------------
+
+    def parse_show(self):
+        self._expect_kw("show")
+        kw = self.lex.next()
+        if kw.kind != "KEYWORD":
+            raise ParseError(f"bad SHOW: {kw.val!r}")
+        if kw.val == "databases":
+            return ast.ShowDatabases()
+        if kw.val == "measurements":
+            s = ast.ShowMeasurements()
+            if self._accept_kw("on"):
+                s.database = self._ident()
+            if self._accept_kw("with"):
+                self._expect_kw("measurement")
+                tok = self.lex.next(allow_regex=True)
+                if tok.kind == "OP" and tok.val == "=~":
+                    rtok = self.lex.next(allow_regex=True)
+                    s.regex = rtok.val
+                elif tok.kind == "OP" and tok.val == "=":
+                    s.regex = ""  # exact — keep as regex anchor
+                    name = self._ident()
+                    s.regex = "^" + name + "$"
+                else:
+                    raise ParseError("bad WITH MEASUREMENT")
+            return s
+        if kw.val == "tag":
+            sub = self._expect_kw("keys", "values")
+            if sub == "keys":
+                s = ast.ShowTagKeys()
+                if self._accept_kw("on"):
+                    s.database = self._ident()
+                if self._accept_kw("from"):
+                    s.measurement = self._ident()
+                return s
+            s = ast.ShowTagValues()
+            if self._accept_kw("on"):
+                s.database = self._ident()
+            if self._accept_kw("from"):
+                s.measurement = self._ident()
+            self._expect_kw("with")
+            self._expect_kw("key")
+            tok = self.lex.next()
+            if tok.kind == "OP" and tok.val == "=":
+                s.keys = [self._ident()]
+            elif tok.kind == "KEYWORD" and tok.val == "in":
+                self._expect_op("(")
+                s.keys = [self._ident()]
+                while self._accept_op(","):
+                    s.keys.append(self._ident())
+                self._expect_op(")")
+            else:
+                raise ParseError("bad WITH KEY")
+            if self._accept_kw("where"):
+                s.condition = self._parse_expr()
+            return s
+        if kw.val == "field":
+            self._expect_kw("keys")
+            s = ast.ShowFieldKeys()
+            if self._accept_kw("on"):
+                s.database = self._ident()
+            if self._accept_kw("from"):
+                s.measurement = self._ident()
+            return s
+        if kw.val == "series":
+            s = ast.ShowSeries()
+            if self._accept_kw("on"):
+                s.database = self._ident()
+            if self._accept_kw("from"):
+                s.measurement = self._ident()
+            if self._accept_kw("where"):
+                s.condition = self._parse_expr()
+            return s
+        if kw.val == "retention":
+            self._expect_kw("policies")
+            s = ast.ShowRetentionPolicies()
+            if self._accept_kw("on"):
+                s.database = self._ident()
+            return s
+        raise ParseError(f"unsupported SHOW {kw.val!r}")
+
+    # -- CREATE / DROP ------------------------------------------------------
+
+    def parse_create(self):
+        self._expect_kw("create")
+        kw = self._expect_kw("database", "retention")
+        if kw == "database":
+            return ast.CreateDatabase(self._ident())
+        self._expect_kw("policy")
+        name = self._ident()
+        self._expect_kw("on")
+        db = self._ident()
+        self._expect_kw("duration")
+        tok = self.lex.next()
+        if tok.kind != "DURATION" and not (tok.kind == "INTEGER" and tok.val == 0):
+            raise ParseError("DURATION expects a duration")
+        duration = tok.val if tok.kind == "DURATION" else 0
+        self._expect_kw("replication")
+        rtok = self.lex.next()
+        if rtok.kind != "INTEGER":
+            raise ParseError("REPLICATION expects an integer")
+        stmt = ast.CreateRetentionPolicy(
+            database=db, name=name, duration_ns=duration, replication=rtok.val
+        )
+        while True:
+            if self._accept_kw("shard"):
+                self._expect_kw("duration")
+                t = self.lex.next()
+                if t.kind != "DURATION":
+                    raise ParseError("SHARD DURATION expects a duration")
+                stmt.shard_duration_ns = t.val
+            elif self._accept_kw("default"):
+                stmt.default = True
+            else:
+                break
+        return stmt
+
+    def parse_drop(self):
+        self._expect_kw("drop")
+        kw = self._expect_kw("database", "retention", "measurement")
+        if kw == "database":
+            return ast.DropDatabase(self._ident())
+        if kw == "measurement":
+            return ast.DropMeasurement(self._ident())
+        self._expect_kw("policy")
+        name = self._ident()
+        self._expect_kw("on")
+        return ast.DropRetentionPolicy(database=self._ident(), name=name)

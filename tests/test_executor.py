@@ -1,0 +1,273 @@
+"""End-to-end query tests: write line protocol -> InfluxQL -> JSON results.
+
+The oracle style mirrors the reference's black-box suite
+(tests/server_test.go declarative Test{queries} tables, SURVEY.md §4 item
+5), minus HTTP: assertions are on the executor's result dict.
+"""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.storage.engine import Engine, NS
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = Engine(str(tmp_path / "data"))
+    e.create_database("db")
+    yield e, Executor(e)
+    e.close()
+
+
+BASE = 1_700_000_040  # minute-aligned epoch seconds
+
+
+def write_devops(e, hosts=3, samples=30, step=10):
+    lines = []
+    for hi in range(hosts):
+        for k in range(samples):
+            t = (BASE + k * step) * NS
+            lines.append(
+                f"cpu,host=h{hi},region={'us' if hi % 2 == 0 else 'eu'} "
+                f"usage_user={hi * 10 + k % 5}.0,usage_idle={90 - hi}i {t}"
+            )
+    e.write_lines("db", "\n".join(lines))
+
+
+def q(ex, text):
+    return ex.execute(text, db="db", now_ns=(BASE + 10_000) * NS)
+
+
+def series_of(res, i=0):
+    return res["results"][0]["series"][i]
+
+
+class TestAggregates:
+    def test_mean_group_by_time(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(
+            ex,
+            f"SELECT mean(usage_user) FROM cpu WHERE host = 'h0' AND "
+            f"time >= {BASE * NS} AND time < {(BASE + 300) * NS} GROUP BY time(1m)",
+        )
+        s = series_of(res)
+        assert s["name"] == "cpu"
+        assert s["columns"] == ["time", "mean"]
+        assert len(s["values"]) == 5
+        # h0 usage_user cycles 0,1,2,3,4 every 50s; per-minute mean of k%5
+        for i, (t, v) in enumerate(s["values"]):
+            assert t == (BASE + 60 * i) * NS
+            ks = [k % 5 for k in range(6 * i, 6 * (i + 1))]
+            assert v == pytest.approx(sum(ks) / 6)
+
+    def test_mean_group_by_time_and_tag(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(
+            ex,
+            f"SELECT mean(usage_user) FROM cpu WHERE time >= {BASE * NS} AND "
+            f"time < {(BASE + 300) * NS} GROUP BY time(1m), host",
+        )
+        series = res["results"][0]["series"]
+        assert [s["tags"]["host"] for s in series] == ["h0", "h1", "h2"]
+        for hi, s in enumerate(series):
+            base_val = hi * 10
+            assert s["values"][0][1] == pytest.approx(base_val + (0 + 1 + 2 + 3 + 4 + 0) / 6)
+
+    def test_count_sum_min_max(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(
+            ex,
+            "SELECT count(usage_user), sum(usage_user), min(usage_user), max(usage_user) "
+            "FROM cpu WHERE host = 'h1'",
+        )
+        s = series_of(res)
+        assert s["columns"] == ["time", "count", "sum", "min", "max"]
+        t, cnt, total, vmin, vmax = s["values"][0]
+        ks = [10 + k % 5 for k in range(30)]
+        assert cnt == 30 and total == pytest.approx(sum(ks))
+        assert vmin == 10 and vmax == 14
+
+    def test_selector_returns_point_time(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(ex, "SELECT max(usage_user) FROM cpu WHERE host = 'h0'")
+        s = series_of(res)
+        [(t, v)] = s["values"]
+        assert v == 4.0
+        # first k with k%5==4 is k=4 -> t = BASE+40
+        assert t == (BASE + 40) * NS
+
+    def test_first_last(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(ex, "SELECT first(usage_user), last(usage_user) FROM cpu WHERE host = 'h2'")
+        s = series_of(res)
+        [(t, first, last)] = s["values"]
+        assert first == 20.0 and last == 24.0
+
+    def test_integer_field_agg_renders_int(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(ex, "SELECT sum(usage_idle) FROM cpu WHERE host = 'h0'")
+        [(t, v)] = series_of(res)["values"]
+        assert v == 90 * 30 and isinstance(v, int)
+
+    def test_field_filter(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(ex, "SELECT count(usage_user) FROM cpu WHERE usage_user >= 10")
+        [(t, v)] = series_of(res)["values"]
+        assert v == 60  # h1 and h2 rows only
+
+    def test_math_on_aggregates(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(ex, "SELECT mean(usage_user) * 2 + 1 FROM cpu WHERE host = 'h0'")
+        [(t, v)] = series_of(res)["values"]
+        assert v == pytest.approx(2 * 2.0 + 1)  # mean of k%5 = 2
+
+    def test_fill_options(self, env):
+        e, ex = env
+        # sparse data: two points a minute apart with a gap
+        e.write_lines("db", f"m v=1 {BASE * NS}\nm v=5 {(BASE + 240) * NS}")
+        base_q = (
+            f"SELECT mean(v) FROM m WHERE time >= {BASE * NS} AND "
+            f"time < {(BASE + 300) * NS} GROUP BY time(1m)"
+        )
+        s = series_of(q(ex, base_q))
+        vals = [v for _t, v in s["values"]]
+        assert vals == [1.0, None, None, None, 5.0]
+        s = series_of(q(ex, base_q + " fill(0)"))
+        assert [v for _t, v in s["values"]] == [1.0, 0, 0, 0, 5.0]
+        s = series_of(q(ex, base_q + " fill(none)"))
+        assert len(s["values"]) == 2
+        s = series_of(q(ex, base_q + " fill(previous)"))
+        assert [v for _t, v in s["values"]] == [1.0, 1.0, 1.0, 1.0, 5.0]
+        s = series_of(q(ex, base_q + " fill(linear)"))
+        assert [v for _t, v in s["values"]] == [1.0, 2.0, 3.0, 4.0, 5.0]
+
+    def test_percentile_median_stddev(self, env):
+        e, ex = env
+        vals = list(range(1, 101))
+        lines = "\n".join(f"m v={v} {(BASE + i) * NS}" for i, v in enumerate(vals))
+        e.write_lines("db", lines)
+        res = q(ex, "SELECT percentile(v, 90), median(v), stddev(v) FROM m")
+        [(t, p90, med, std)] = series_of(res)["values"]
+        assert p90 == 90.0
+        assert med == pytest.approx(50.5)
+        assert std == pytest.approx(np.std(vals, ddof=1))
+
+    def test_count_distinct(self, env):
+        e, ex = env
+        lines = "\n".join(f"m v={i % 7} {(BASE + i) * NS}" for i in range(50))
+        e.write_lines("db", lines)
+        res = q(ex, "SELECT count(distinct(v)) FROM m")
+        [(t, v)] = series_of(res)["values"]
+        assert v == 7
+
+    def test_agg_across_flush_and_memtable(self, env):
+        e, ex = env
+        write_devops(e)
+        e.flush_all()
+        # newer points land in the memtable
+        e.write_lines("db", f"cpu,host=h0,region=us usage_user=100 {(BASE + 300) * NS}")
+        res = q(ex, "SELECT max(usage_user) FROM cpu")
+        [(t, v)] = series_of(res)["values"]
+        assert v == 100.0
+
+    def test_regex_measurement(self, env):
+        e, ex = env
+        e.write_lines("db", f"cpu_a v=1 {BASE*NS}\ncpu_b v=2 {BASE*NS}\nmem v=3 {BASE*NS}")
+        res = q(ex, "SELECT mean(v) FROM /^cpu_/")
+        names = [s["name"] for s in res["results"][0]["series"]]
+        assert names == ["cpu_a", "cpu_b"]
+
+    def test_unsupported_function_is_error(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(ex, "SELECT nosuchfunc(usage_user) FROM cpu")
+        assert "error" in res["results"][0]
+
+
+class TestRawQueries:
+    def test_raw_select(self, env):
+        e, ex = env
+        e.write_lines("db", f"m a=1,b=2 {BASE*NS}\nm a=3 {(BASE+1)*NS}")
+        res = q(ex, "SELECT a, b FROM m")
+        s = series_of(res)
+        assert s["columns"] == ["time", "a", "b"]
+        assert s["values"] == [[BASE * NS, 1.0, 2.0], [(BASE + 1) * NS, 3.0, None]]
+
+    def test_raw_select_wildcard_includes_tags(self, env):
+        e, ex = env
+        e.write_lines("db", f"m,host=h1 a=1 {BASE*NS}")
+        s = series_of(q(ex, "SELECT * FROM m"))
+        assert s["columns"] == ["time", "a", "host"]
+        assert s["values"] == [[BASE * NS, 1.0, "h1"]]
+
+    def test_raw_order_desc_limit(self, env):
+        e, ex = env
+        e.write_lines("db", "\n".join(f"m v={i} {(BASE+i)*NS}" for i in range(10)))
+        s = series_of(q(ex, "SELECT v FROM m ORDER BY time DESC LIMIT 3"))
+        assert [r[1] for r in s["values"]] == [9.0, 8.0, 7.0]
+
+    def test_raw_group_by_tag(self, env):
+        e, ex = env
+        e.write_lines("db", f"m,h=a v=1 {BASE*NS}\nm,h=b v=2 {BASE*NS}")
+        res = q(ex, "SELECT v FROM m GROUP BY h")
+        series = res["results"][0]["series"]
+        assert [s["tags"]["h"] for s in series] == ["a", "b"]
+
+    def test_string_field_roundtrip(self, env):
+        e, ex = env
+        e.write_lines("db", f'm s="hello world" {BASE*NS}')
+        s = series_of(q(ex, "SELECT s FROM m"))
+        assert s["values"] == [[BASE * NS, "hello world"]]
+
+
+class TestShowAndDDL:
+    def test_show_databases(self, env):
+        e, ex = env
+        res = q(ex, "SHOW DATABASES")
+        assert ["db"] in series_of(res)["values"]
+
+    def test_create_drop_database(self, env):
+        e, ex = env
+        q(ex, "CREATE DATABASE newdb")
+        assert "newdb" in e.database_names()
+        q(ex, "DROP DATABASE newdb")
+        assert "newdb" not in e.database_names()
+
+    def test_show_measurements_tag_keys_values_field_keys(self, env):
+        e, ex = env
+        write_devops(e)
+        assert series_of(q(ex, "SHOW MEASUREMENTS"))["values"] == [["cpu"]]
+        s = series_of(q(ex, "SHOW TAG KEYS FROM cpu"))
+        assert s["values"] == [["host"], ["region"]]
+        s = series_of(q(ex, "SHOW TAG VALUES FROM cpu WITH KEY = host"))
+        assert s["values"] == [["host", "h0"], ["host", "h1"], ["host", "h2"]]
+        s = series_of(q(ex, "SHOW FIELD KEYS FROM cpu"))
+        assert s["values"] == [["usage_idle", "integer"], ["usage_user", "float"]]
+
+    def test_show_series(self, env):
+        e, ex = env
+        write_devops(e)
+        s = series_of(q(ex, "SHOW SERIES FROM cpu"))
+        assert ["cpu,host=h0,region=us"] in s["values"]
+
+    def test_show_retention_policies(self, env):
+        e, ex = env
+        q(ex, "CREATE RETENTION POLICY rp1 ON db DURATION 30d REPLICATION 1")
+        s = series_of(q(ex, "SHOW RETENTION POLICIES ON db"))
+        names = [r[0] for r in s["values"]]
+        assert "autogen" in names and "rp1" in names
+
+    def test_statement_error_reported_per_statement(self, env):
+        e, ex = env
+        res = q(ex, "SELECT v FROM missing_db_measurement; SHOW DATABASES")
+        assert res["results"][0] == {"statement_id": 0} or "series" not in res["results"][0]
+        assert "series" in res["results"][1]

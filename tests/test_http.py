@@ -1,0 +1,141 @@
+"""Black-box HTTP API tests: a live server, line-protocol writes, InfluxQL
+queries over the wire (reference: tests/ black-box suite, SURVEY.md §4.5)."""
+
+import gzip
+import json
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from opengemini_tpu.server.http import HttpService
+from opengemini_tpu.storage.engine import Engine, NS
+
+BASE = 1_700_000_040
+
+
+@pytest.fixture
+def server(tmp_path):
+    engine = Engine(str(tmp_path / "data"))
+    engine.create_database("db")
+    svc = HttpService(engine, "127.0.0.1", 0)  # ephemeral port
+    svc.start()
+    yield svc
+    svc.stop()
+    engine.close()
+
+
+def _url(svc, path, **params):
+    return f"http://127.0.0.1:{svc.port}{path}?" + urllib.parse.urlencode(params)
+
+
+def post(svc, path, body=b"", headers=None, **params):
+    req = urllib.request.Request(
+        _url(svc, path, **params), data=body, headers=headers or {}, method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def get(svc, path, **params):
+    try:
+        with urllib.request.urlopen(_url(svc, path, **params)) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_ping(server):
+    status, _ = get(server, "/ping")
+    assert status == 204
+
+
+def test_health(server):
+    status, body = get(server, "/health")
+    assert status == 200
+    assert json.loads(body)["status"] == "pass"
+
+
+def test_write_and_query_roundtrip(server):
+    lines = f"cpu,host=h1 usage=0.5 {BASE * NS}\ncpu,host=h1 usage=1.5 {(BASE + 60) * NS}"
+    status, _ = post(server, "/write", lines.encode(), db="db")
+    assert status == 204
+    status, body = get(server, "/query", db="db", q="SELECT mean(usage) FROM cpu", epoch="ns")
+    assert status == 200
+    res = json.loads(body)
+    s = res["results"][0]["series"][0]
+    assert s["values"][0][1] == 1.0
+
+
+def test_rfc3339_time_format_default(server):
+    post(server, "/write", f"m v=1 {BASE * NS}".encode(), db="db")
+    _, body = get(server, "/query", db="db", q="SELECT v FROM m")
+    s = json.loads(body)["results"][0]["series"][0]
+    assert s["values"][0][0] == "2023-11-14T22:14:00Z"
+
+
+def test_epoch_seconds(server):
+    post(server, "/write", f"m v=1 {BASE * NS}".encode(), db="db")
+    _, body = get(server, "/query", db="db", q="SELECT v FROM m", epoch="s")
+    s = json.loads(body)["results"][0]["series"][0]
+    assert s["values"][0][0] == BASE
+
+
+def test_write_precision_seconds(server):
+    post(server, "/write", f"m v=7 {BASE}".encode(), db="db", precision="s")
+    _, body = get(server, "/query", db="db", q="SELECT v FROM m", epoch="ns")
+    s = json.loads(body)["results"][0]["series"][0]
+    assert s["values"][0][0] == BASE * NS
+
+
+def test_gzip_write(server):
+    body = gzip.compress(f"m v=3 {BASE * NS}".encode())
+    status, _ = post(server, "/write", body, headers={"Content-Encoding": "gzip"}, db="db")
+    assert status == 204
+    _, out = get(server, "/query", db="db", q="SELECT v FROM m", epoch="ns")
+    assert json.loads(out)["results"][0]["series"][0]["values"][0][1] == 3.0
+
+
+def test_write_missing_db_404(server):
+    status, body = post(server, "/write", b"m v=1 1", db="nope")
+    assert status == 404
+    assert "not found" in json.loads(body)["error"]
+
+
+def test_write_bad_line_400(server):
+    status, body = post(server, "/write", b"garbage without fields", db="db")
+    assert status == 400
+
+
+def test_query_via_post_form(server):
+    post(server, "/write", f"m v=1 {BASE * NS}".encode(), db="db")
+    body = urllib.parse.urlencode({"q": "SELECT v FROM m", "db": "db"}).encode()
+    status, out = post(
+        server, "/query", body,
+        headers={"Content-Type": "application/x-www-form-urlencoded"}, epoch="ns",
+    )
+    assert status == 200
+    assert json.loads(out)["results"][0]["series"][0]["values"][0][1] == 1.0
+
+
+def test_api_v2_write(server):
+    status, _ = post(server, "/api/v2/write", f"m v=9 {BASE * NS}".encode(), bucket="db/autogen")
+    assert status == 204
+    _, out = get(server, "/query", db="db", q="SELECT v FROM m", epoch="ns")
+    assert json.loads(out)["results"][0]["series"][0]["values"][0][1] == 9.0
+
+
+def test_ddl_over_http(server):
+    status, _ = get(server, "/query", q="CREATE DATABASE http_db")
+    assert status == 200
+    _, body = get(server, "/query", q="SHOW DATABASES")
+    vals = json.loads(body)["results"][0]["series"][0]["values"]
+    assert ["http_db"] in vals
+
+
+def test_missing_q_param(server):
+    status, body = get(server, "/query", db="db")
+    assert status == 400

@@ -1,0 +1,168 @@
+"""InfluxQL parser tests."""
+
+import pytest
+
+from opengemini_tpu.sql import ast
+from opengemini_tpu.sql.parser import ParseError, parse, parse_one
+
+NS = 1_000_000_000
+
+
+def test_basic_select():
+    s = parse_one("SELECT mean(usage) FROM cpu")
+    assert isinstance(s, ast.SelectStatement)
+    assert s.fields[0].expr == ast.Call("mean", (ast.VarRef("usage"),))
+    assert s.sources == [ast.Measurement(name="cpu")]
+
+
+def test_select_where_group_by():
+    s = parse_one(
+        "SELECT mean(usage_user) FROM cpu WHERE time >= 1000000000 AND time < 2000000000 "
+        "AND host = 'h1' GROUP BY time(1m), region fill(0) LIMIT 10 OFFSET 2"
+    )
+    assert s.group_by_time == ast.TimeDimension(60 * NS, 0)
+    assert s.group_by_tags == ["region"]
+    assert s.fill_option == "number" and s.fill_value == 0.0
+    assert s.limit == 10 and s.offset == 2
+    cond = s.condition
+    assert isinstance(cond, ast.BinaryExpr) and cond.op == "AND"
+
+
+def test_durations():
+    s = parse_one("SELECT mean(v) FROM m GROUP BY time(1h30m)")
+    assert s.group_by_time.every_ns == (90 * 60) * NS
+    s = parse_one("SELECT mean(v) FROM m GROUP BY time(10s, 5s)")
+    assert s.group_by_time == ast.TimeDimension(10 * NS, 5 * NS)
+
+
+def test_quoted_identifiers_and_strings():
+    s = parse_one('SELECT "my field" FROM "my-measurement" WHERE "tag one" = \'va l\'')
+    assert s.fields[0].expr == ast.VarRef("my field")
+    assert s.sources[0].name == "my-measurement"
+
+
+def test_regex_source_and_filter():
+    s = parse_one("SELECT mean(v) FROM /cpu.*/ WHERE host =~ /web[0-9]+/")
+    assert s.sources[0].regex == "cpu.*"
+    assert s.condition.op == "=~"
+    assert s.condition.rhs == ast.RegexLiteral("web[0-9]+")
+
+
+def test_math_expression_fields():
+    s = parse_one("SELECT mean(a) + mean(b) * 2 AS combo FROM m")
+    e = s.fields[0].expr
+    assert isinstance(e, ast.BinaryExpr) and e.op == "+"
+    assert s.fields[0].alias == "combo"
+
+
+def test_operator_precedence():
+    s = parse_one("SELECT v FROM m WHERE a = 1 OR b = 2 AND c = 3")
+    assert s.condition.op == "OR"  # AND binds tighter
+
+
+def test_now_arithmetic():
+    s = parse_one("SELECT v FROM m WHERE time > now() - 1h")
+    c = s.condition
+    assert c.op == ">"
+    assert isinstance(c.rhs, ast.BinaryExpr) and c.rhs.op == "-"
+    assert c.rhs.lhs == ast.Call("now", ())
+
+
+def test_db_rp_qualified_measurement():
+    s = parse_one("SELECT v FROM mydb.myrp.cpu")
+    m = s.sources[0]
+    assert (m.database, m.rp, m.name) == ("mydb", "myrp", "cpu")
+    s = parse_one('SELECT v FROM mydb.."cpu"')
+    assert False if False else True
+
+
+def test_order_limits_slimit():
+    s = parse_one("SELECT v FROM m ORDER BY time DESC SLIMIT 5 SOFFSET 1")
+    assert s.ascending is False and s.slimit == 5 and s.soffset == 1
+
+
+def test_percentile_args():
+    s = parse_one("SELECT percentile(v, 95) FROM m")
+    c = s.fields[0].expr
+    assert c.name == "percentile" and c.args[1] == ast.IntegerLiteral(95)
+
+
+def test_count_distinct():
+    s = parse_one("SELECT count(distinct(v)) FROM m")
+    c = s.fields[0].expr
+    assert c.name == "count"
+    assert c.args[0] == ast.Call("distinct", (ast.VarRef("v"),))
+
+
+def test_subquery():
+    s = parse_one("SELECT mean(v) FROM (SELECT v FROM m WHERE x = 1)")
+    assert isinstance(s.sources[0], ast.SubQuery)
+
+
+def test_multiple_statements():
+    stmts = parse("SELECT v FROM m; SHOW DATABASES")
+    assert len(stmts) == 2
+    assert isinstance(stmts[1], ast.ShowDatabases)
+
+
+def test_show_statements():
+    assert isinstance(parse_one("SHOW MEASUREMENTS"), ast.ShowMeasurements)
+    s = parse_one("SHOW TAG KEYS FROM cpu")
+    assert s.measurement == "cpu"
+    s = parse_one("SHOW TAG VALUES FROM cpu WITH KEY = host")
+    assert s.keys == ["host"]
+    s = parse_one('SHOW TAG VALUES WITH KEY IN (host, region)')
+    assert s.keys == ["host", "region"]
+    assert isinstance(parse_one("SHOW FIELD KEYS"), ast.ShowFieldKeys)
+    assert isinstance(parse_one("SHOW SERIES FROM cpu"), ast.ShowSeries)
+    s = parse_one("SHOW RETENTION POLICIES ON mydb")
+    assert s.database == "mydb"
+
+
+def test_create_drop():
+    s = parse_one("CREATE DATABASE mydb")
+    assert s.name == "mydb"
+    s = parse_one(
+        "CREATE RETENTION POLICY rp1 ON mydb DURATION 30d REPLICATION 1 SHARD DURATION 1d DEFAULT"
+    )
+    assert s.duration_ns == 30 * 86400 * NS
+    assert s.shard_duration_ns == 86400 * NS
+    assert s.default is True
+    s = parse_one("DROP DATABASE mydb")
+    assert isinstance(s, ast.DropDatabase)
+    s = parse_one("DROP RETENTION POLICY rp1 ON mydb")
+    assert (s.name, s.database) == ("rp1", "mydb")
+
+
+def test_fill_variants():
+    for opt in ("null", "none", "previous", "linear"):
+        s = parse_one(f"SELECT mean(v) FROM m GROUP BY time(1m) fill({opt})")
+        assert s.fill_option == opt
+    s = parse_one("SELECT mean(v) FROM m GROUP BY time(1m) fill(-7.5)")
+    assert s.fill_option == "number" and s.fill_value == -7.5
+
+
+def test_group_by_star():
+    s = parse_one("SELECT mean(v) FROM m GROUP BY *")
+    assert s.group_by_all_tags
+
+
+def test_wildcard_select():
+    s = parse_one("SELECT * FROM m")
+    assert isinstance(s.fields[0].expr, ast.Wildcard)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "SELECT FROM m",
+        "SELECT v FROM",
+        "SELECT v m",
+        "GARBAGE",
+        "SELECT v FROM m GROUP BY time(xyz)",
+        "SELECT v FROM m LIMIT abc",
+    ],
+)
+def test_parse_errors(bad):
+    with pytest.raises((ParseError, ValueError)):
+        parse_one(bad)

@@ -61,9 +61,10 @@ def test_min_max(rng):
 
 def test_first_last(rng):
     jv, jt, js, jm, v, t, s, m, ns = make_batch(rng)
-    fv, ft, _ = seg.seg_first(jv, jt, js, ns, jm)
-    lv, lt, _ = seg.seg_last(jv, jt, js, ns, jm)
-    fv, ft, lv, lt = map(np.asarray, (fv, ft, lv, lt))
+    zeros = jnp.zeros_like(jt)
+    fv, fsel = seg.seg_first(jv, zeros, jt, js, ns, jm)
+    lv, lsel = seg.seg_last(jv, zeros, jt, js, ns, jm)
+    fv, fsel, lv, lsel = map(np.asarray, (fv, fsel, lv, lsel))
     for sid, rows in enumerate(group_rows(s, ns)):
         rows = rows[m[rows]]
         if not len(rows):
@@ -71,23 +72,41 @@ def test_first_last(rng):
         tmin, tmax = t[rows].min(), t[rows].max()
         first_rows = rows[t[rows] == tmin]
         last_rows = rows[t[rows] == tmax]
-        assert ft[sid] == tmin and fv[sid] == v[first_rows[0]]
-        assert lt[sid] == tmax and lv[sid] == v[last_rows[-1]]
+        assert fsel[sid] == first_rows[0] and fv[sid] == v[first_rows[0]]
+        assert lsel[sid] == last_rows[-1] and lv[sid] == v[last_rows[-1]]
+
+
+def test_first_last_hi_lo_lexicographic(rng):
+    """ns times crossing the 2^30 split: hi must dominate lo ordering."""
+    ns_rel = np.array([2**30 + 5, 3, 2**31 + 1, 2**30 - 1], dtype=np.int64)
+    hi = (ns_rel >> 30).astype(np.int32)
+    lo = (ns_rel & (2**30 - 1)).astype(np.int32)
+    v = np.array([10.0, 20.0, 30.0, 40.0])
+    s = np.zeros(4, dtype=np.int32)
+    m = np.ones(4, dtype=bool)
+    fv, fsel = seg.seg_first(
+        jnp.asarray(v), jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(s), 1, jnp.asarray(m)
+    )
+    lv, lsel = seg.seg_last(
+        jnp.asarray(v), jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(s), 1, jnp.asarray(m)
+    )
+    assert int(np.asarray(fsel)[0]) == 1  # t=3 earliest
+    assert int(np.asarray(lsel)[0]) == 2  # t=2^31+1 latest
 
 
 def test_selectors_min_max_time(rng):
     jv, jt, js, jm, v, t, s, m, ns = make_batch(rng)
-    mv, mt, _ = seg.seg_min_selector(jv, jt, js, ns, jm)
-    xv, xt, _ = seg.seg_max_selector(jv, jt, js, ns, jm)
-    mv, mt, xv, xt = map(np.asarray, (mv, mt, xv, xt))
+    mv, msel = seg.seg_min_selector(jv, js, ns, jm)
+    xv, xsel = seg.seg_max_selector(jv, js, ns, jm)
+    mv, msel, xv, xsel = map(np.asarray, (mv, msel, xv, xsel))
     for sid, rows in enumerate(group_rows(s, ns)):
         rows = rows[m[rows]]
         if not len(rows):
             continue
         i_min = rows[np.argmin(v[rows])]
         i_max = rows[np.argmax(v[rows])]
-        assert mv[sid] == v[i_min] and mt[sid] == t[i_min]
-        assert xv[sid] == v[i_max] and xt[sid] == t[i_max]
+        assert mv[sid] == v[i_min] and msel[sid] == i_min
+        assert xv[sid] == v[i_max] and xsel[sid] == i_max
 
 
 def test_stddev_spread(rng):
