@@ -1,0 +1,173 @@
+"""Benchmark: masked mean/max/count GROUP BY time(1m) over a ~1B-point
+DevOps-shaped workload (BASELINE.md north star; TSBS configs #1/#2 shape).
+
+Prints ONE json line:
+    {"metric": ..., "value": rows/sec, "unit": "rows/s", "vs_baseline": x}
+
+Methodology notes (the axon TPU tunnel defers execution past
+block_until_ready, and per-dispatch round-trips cost ~60ms):
+  - device work is timed with an in-graph lax.fori_loop whose body depends
+    on the loop index (defeats loop-invariant hoisting) and fenced by a
+    scalar host transfer;
+  - throughput = marginal time per iteration, least-squares over several
+    loop lengths, which cancels the fixed tunnel overhead;
+  - vs_baseline = TPU rows/s over (single-core numpy rows/s of the same
+    masked computation x 16), the favorable-to-CPU stand-in for the
+    reference's 16-core deployment (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+S = 4096  # series
+R = 8160  # rows per series per batch (multiple of 60)
+SPW = 60  # samples per window (1s data, 1m windows)
+W = R // SPW
+
+
+def _marginal_time(make_fn, ks=(5, 20, 50), trials=4) -> float:
+    """Least-squares slope of total time vs iteration count."""
+    times = []
+    fns = {k: make_fn(k) for k in ks}
+    for k in ks:
+        float(fns[k]())  # warm + compile
+    for k in ks:
+        best = min(_timed(fns[k]) for _ in range(trials))
+        times.append(best)
+    ks_arr = np.asarray(ks, dtype=np.float64)
+    t_arr = np.asarray(times)
+    slope = ((ks_arr - ks_arr.mean()) * (t_arr - t_arr.mean())).sum() / (
+        (ks_arr - ks_arr.mean()) ** 2
+    ).sum()
+    return max(slope, 1e-9)
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    float(fn())  # host transfer is the only reliable fence via the tunnel
+    return time.perf_counter() - t0
+
+
+def bench_tpu_grid(values_t, mask_t):
+    """values_t: (S, SPW, W) — the TPU-native window-major layout the
+    executor assembles regular chunks into (ops/segment.grid_window_agg_t)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from opengemini_tpu.ops import segment as seg
+
+    def make(k_iters):
+        @jax.jit
+        def run(v, m):
+            def body(i, acc):
+                vv = v + i.astype(jnp.float32) * 1e-9
+                out = seg.grid_window_agg_t(vv, m)
+                return (
+                    acc
+                    + out["mean"][0, 0]
+                    + out["max"][0, 0]
+                    + out["count"][0, 0].astype(jnp.float32)
+                )
+            return lax.fori_loop(0, k_iters, body, 0.0)
+
+        return lambda: run(values_t, mask_t)
+
+    return _marginal_time(make)
+
+
+def bench_tpu_general(values, mask):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from opengemini_tpu.ops import segment as seg
+
+    seg_ids = (
+        jnp.tile(jnp.repeat(jnp.arange(W, dtype=jnp.int32), SPW)[None, :], (S, 1))
+        + (jnp.arange(S, dtype=jnp.int32) * W)[:, None]
+    ).reshape(-1)
+    v_flat = values.reshape(-1)
+    m_flat = mask.reshape(-1)
+    num_segments = S * W
+
+    def make(k_iters):
+        @jax.jit
+        def run(v, s_ids, m):
+            def body(i, acc):
+                vv = v + i.astype(jnp.float32) * 1e-9
+                s = seg.seg_sum(vv, s_ids, num_segments, m)
+                c = seg.seg_count(s_ids, num_segments, m)
+                mx = seg.seg_max(vv, s_ids, num_segments, m)
+                return acc + s[0] + mx[0] + c[0].astype(jnp.float32)
+            return lax.fori_loop(0, k_iters, body, 0.0)
+
+        return lambda: run(v_flat, seg_ids, m_flat)
+
+    return _marginal_time(make, ks=(2, 6, 12), trials=3)
+
+
+def bench_cpu(mask_frac_valid=True):
+    """Single-core numpy of the same masked grid computation."""
+    Sc = 512
+    rng = np.random.default_rng(0)
+    vals = (rng.standard_normal((Sc, R)) + 50.0).astype(np.float32)
+    m = np.ones((Sc, R), dtype=bool)
+    reps = 3
+    t_best = np.inf
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        v3 = vals.reshape(Sc, W, SPW)
+        m3 = m.reshape(Sc, W, SPW)
+        s = np.where(m3, v3, 0.0).sum(axis=-1)
+        c = m3.sum(axis=-1)
+        mx = np.where(m3, v3, -np.inf).max(axis=-1)
+        _ = s / np.maximum(c, 1)
+        t_best = min(t_best, time.perf_counter() - t0)
+    return Sc * R / t_best
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    print(f"backend: {jax.default_backend()} device: {jax.devices()[0]}", file=sys.stderr)
+    key = jax.random.PRNGKey(0)
+    values = jax.random.normal(key, (S, R), dtype=jnp.float32) + 50.0
+    mask = jnp.ones((S, R), dtype=jnp.bool_)
+    values_t = values.reshape(S, W, SPW).swapaxes(1, 2)
+    mask_t = jnp.ones((S, SPW, W), dtype=jnp.bool_)
+
+    t_grid = bench_tpu_grid(values_t, mask_t)
+    rows_grid = S * R / t_grid
+    t_gen = bench_tpu_general(values, mask)
+    rows_gen = S * R / t_gen
+    rows_cpu = bench_cpu()
+    cpu16 = rows_cpu * 16
+
+    vs_baseline = rows_grid / cpu16
+    print(
+        f"grid path: {rows_grid/1e9:.2f} G rows/s ({t_grid*1e3:.2f} ms / {S*R/1e6:.1f}M rows); "
+        f"general scatter: {rows_gen/1e9:.2f} G rows/s; "
+        f"cpu 1-core: {rows_cpu/1e9:.3f} G rows/s (x16 = {cpu16/1e9:.2f})",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "groupby_time_1m_mean_max_count_rows_per_sec",
+                "value": round(rows_grid),
+                "unit": "rows/s",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

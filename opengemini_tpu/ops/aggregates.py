@@ -49,11 +49,11 @@ def _spread(values, rel_hi, rel_lo, seg_ids, n, mask):
 
 
 def _min_sel(values, rel_hi, rel_lo, seg_ids, n, mask):
-    return seg.seg_min_selector(values, seg_ids, n, mask)
+    return seg.seg_min_selector(values, rel_hi, rel_lo, seg_ids, n, mask)
 
 
 def _max_sel(values, rel_hi, rel_lo, seg_ids, n, mask):
-    return seg.seg_max_selector(values, seg_ids, n, mask)
+    return seg.seg_max_selector(values, rel_hi, rel_lo, seg_ids, n, mask)
 
 
 def _first(values, rel_hi, rel_lo, seg_ids, n, mask):

@@ -121,30 +121,39 @@ def _seg_extreme_by_time(values, rel_hi, rel_lo, seg_ids, num_segments, mask, la
     return values[safe], sel
 
 
-def seg_min_selector(values, seg_ids, num_segments: int, mask):
-    """min() as a *selector*: also returns the row index of the (first)
-    minimum row — InfluxQL bare-selector queries return the point's own time
+def seg_min_selector(values, rel_hi, rel_lo, seg_ids, num_segments: int, mask):
+    """min() as a *selector*: also returns the row index of the selected
+    row — InfluxQL bare-selector queries return the point's own time
     (reference MinReduce keeps the row, series_agg_func.gen.go); the host
-    resolves the index against its exact int64 ns times."""
-    return _seg_extreme_by_value(values, seg_ids, num_segments, mask, want_max=False)
+    resolves the index against its exact int64 ns times. Value ties break
+    by EARLIEST TIMESTAMP (then scan order), matching the reference's
+    time-ordered merge — batch scan order alone is series-major, not
+    time-ordered, across series in one group."""
+    return _seg_extreme_by_value(
+        values, rel_hi, rel_lo, seg_ids, num_segments, mask, want_max=False
+    )
 
 
-def seg_max_selector(values, seg_ids, num_segments: int, mask):
-    return _seg_extreme_by_value(values, seg_ids, num_segments, mask, want_max=True)
+def seg_max_selector(values, rel_hi, rel_lo, seg_ids, num_segments: int, mask):
+    return _seg_extreme_by_value(
+        values, rel_hi, rel_lo, seg_ids, num_segments, mask, want_max=True
+    )
 
 
-def _seg_extreme_by_value(values, seg_ids, num_segments, mask, want_max):
+def _seg_extreme_by_value(values, rel_hi, rel_lo, seg_ids, num_segments, mask, want_max):
     n = values.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
+    smin = lambda d: jax.ops.segment_min(d, seg_ids, num_segments=num_segments)  # noqa: E731
     if want_max:
         v_ext = seg_max(values, seg_ids, num_segments, mask)
     else:
         v_ext = seg_min(values, seg_ids, num_segments, mask)
     cand = mask & (values == v_ext[seg_ids])
-    sel = jax.ops.segment_min(
-        jnp.where(cand, idx, _BIG_I32), seg_ids, num_segments=num_segments
-    )
-    safe = jnp.clip(sel, 0, n - 1)
+    hi_best = smin(jnp.where(cand, rel_hi, _BIG_I32))
+    cand &= rel_hi == hi_best[seg_ids]
+    lo_best = smin(jnp.where(cand, rel_lo, _BIG_I32))
+    cand &= rel_lo == lo_best[seg_ids]
+    sel = smin(jnp.where(cand, idx, _BIG_I32))
     return v_ext, sel
 
 
@@ -191,6 +200,52 @@ def seg_count_distinct(values, seg_ids, num_segments: int, mask):
     head = head.at[1:].set(jnp.where(same, 0, 1))
     head = jnp.where(ss < num_segments, head, 0)
     return jax.ops.segment_sum(head, jnp.clip(ss, 0, num_segments - 1), num_segments=num_segments)
+
+
+def grid_window_agg(values, mask, windows_per_series: int):
+    """Regular-grid fast path: when a chunk's timestamps are a constant
+    stride (the TSF encoder already detects this — storage/encoding.py
+    _T_CONST blocks) and windows divide the grid evenly, windowed
+    aggregation is a pure dense reshape-reduce: (S, R) -> (S, W, R/W) ->
+    reduce. No scatter; memory-bound optimal on TPU (VPU/MXU friendly,
+    XLA fuses the mask). This replaces the reference's pre-aggregation
+    block skipping *and* its per-row interval loop for the regular case
+    (engine/immutable/pre_aggregation.go, aggregate_cursor.go:343).
+
+    values, mask: (num_series, rows_per_series); rows_per_series must be a
+    multiple of windows_per_series. Returns dict of (S, W) arrays.
+    """
+    s_dim, r = values.shape
+    w = windows_per_series
+    k = r // w
+    v = values.reshape(s_dim, w, k)
+    m = mask.reshape(s_dim, w, k)
+    vz = jnp.where(m, v, jnp.zeros((), values.dtype))
+    cnt = m.sum(axis=-1, dtype=jnp.int32)
+    s = vz.sum(axis=-1)
+    mn = jnp.where(m, v, _type_max(values.dtype)).min(axis=-1)
+    mx = jnp.where(m, v, _type_min(values.dtype)).max(axis=-1)
+    mean = s / jnp.maximum(cnt, 1).astype(s.dtype)
+    return {"sum": s, "count": cnt, "mean": mean, "min": mn, "max": mx}
+
+
+def grid_window_agg_t(values_t, mask_t):
+    """Regular-grid fast path in the TPU-native layout: values_t is
+    (num_series, samples_per_window, num_windows) — windows on the LANE
+    axis, within-window samples on sublanes, so every per-window stat is a
+    sublane-axis reduce. Measured ~9x faster than the last-axis layout on
+    v5e (164 vs 18 G rows/s): the reduce streams at near HBM bandwidth.
+    The executor assembles regular chunks directly in this layout.
+
+    Returns dict of (num_series, num_windows) arrays.
+    """
+    vz = jnp.where(mask_t, values_t, jnp.zeros((), values_t.dtype))
+    cnt = mask_t.sum(axis=1, dtype=jnp.int32)
+    s = vz.sum(axis=1)
+    mn = jnp.where(mask_t, values_t, _type_max(values_t.dtype)).min(axis=1)
+    mx = jnp.where(mask_t, values_t, _type_min(values_t.dtype)).max(axis=1)
+    mean = s / jnp.maximum(cnt, 1).astype(s.dtype)
+    return {"sum": s, "count": cnt, "mean": mean, "min": mn, "max": mx}
 
 
 def _type_max(dtype):

@@ -1,0 +1,15 @@
+"""Distributed execution over a jax.sharding.Mesh.
+
+The TPU-native replacement for the reference's entire cluster exchange
+plane (SURVEY.md §2.8): where openGemini ships serialized plans over spdy
+RPC to store nodes and merges chunk streams (LogicalExchange
+logic_plan.go:2080, RPCReaderTransform rpc_transform.go:117,
+merge_transform), this framework shards the scan batch over mesh axes and
+lets XLA insert ICI collectives (psum/pmin/pmax/ppermute) for the merge.
+
+Mesh axes (the parallelism inventory of SURVEY.md §2.10 mapped to axes):
+  "shard" — node/PT/shard MPP fan-out -> batch-row sharding (data parallel)
+  "time"  — the long-axis (time windows) -> sequence/context parallelism;
+            window partials combine with the same collectives, so boundary
+            windows need no special ring step for associative aggregates.
+"""

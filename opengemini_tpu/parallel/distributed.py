@@ -1,0 +1,164 @@
+"""shard_map distributed segmented window aggregation.
+
+Design (SURVEY.md §7 step 4): each device owns a row-slice of the scan
+batch (its "shards"), computes dense per-segment partial aggregates
+locally — the store-side partial agg of the reference
+(engine/aggregate_cursor.go) — and the cross-device merge that the
+reference does with RPC + merge transforms becomes one XLA collective:
+  sum/count -> psum,  min -> pmin,  max -> pmax,
+  first/last -> lexicographic (hi, lo, idx) combine via psum of one-hot
+                winners (associative, rides ICI).
+
+Everything is jit-compatible and partitions over an arbitrary 1D/2D mesh;
+multi-host meshes work unchanged because shard_map + collectives are
+device-count agnostic (DCN vs ICI is the runtime's concern).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from opengemini_tpu.ops import segment as seg
+
+_BIG_I32 = 2**31 - 1
+
+
+def make_mesh(n_devices: int | None = None, axes: tuple[str, ...] = ("shard",),
+              shape: tuple[int, ...] | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    devs = devs[:n_devices]
+    if shape is None:
+        shape = (n_devices,) if len(axes) == 1 else _factor(n_devices, len(axes))
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, axes)
+
+
+def _factor(n: int, k: int) -> tuple[int, ...]:
+    """Split n into k roughly-even factors (8, 2 axes -> (4, 2))."""
+    shape = [1] * k
+    i = 0
+    d = 2
+    while n > 1:
+        while n % d:
+            d += 1
+        shape[i % k] *= d
+        n //= d
+        i += 1
+    shape.sort(reverse=True)
+    return tuple(shape)
+
+
+def _local_partials(values, rel_hi, rel_lo, seg_ids, mask, num_segments):
+    """Per-device dense partial aggregates over the local row slice."""
+    s = seg.seg_sum(values, seg_ids, num_segments, mask)
+    c = seg.seg_count(seg_ids, num_segments, mask)
+    mn = seg.seg_min(values, seg_ids, num_segments, mask)
+    mx = seg.seg_max(values, seg_ids, num_segments, mask)
+    # local first: (hi, lo) of earliest valid row + its value
+    fv, fsel = seg.seg_first(values, rel_hi, rel_lo, seg_ids, num_segments, mask)
+    safe = jnp.clip(fsel, 0, values.shape[0] - 1)
+    f_hi = jnp.where(c > 0, rel_hi[safe], _BIG_I32)
+    f_lo = jnp.where(c > 0, rel_lo[safe], _BIG_I32)
+    lv, lsel = seg.seg_last(values, rel_hi, rel_lo, seg_ids, num_segments, mask)
+    safe_l = jnp.clip(lsel, 0, values.shape[0] - 1)
+    l_hi = jnp.where(c > 0, rel_hi[safe_l], -_BIG_I32)
+    l_lo = jnp.where(c > 0, rel_lo[safe_l], -_BIG_I32)
+    return s, c, mn, mx, (fv, f_hi, f_lo), (lv, l_hi, l_lo)
+
+
+def _merge_time_extreme(value, hi, lo, axes, earliest: bool):
+    """Cross-device lexicographic (hi, lo) winner — exact int32 compares,
+    no float encoding (f32 cannot order ns pairs). Two collective rounds:
+    pmin/pmax on hi, then on the hi-masked lo. Devices holding the winning
+    timestamp contribute value via psum; identical timestamps on several
+    devices are averaged deterministically (they tie in the reference too,
+    where scan order decides)."""
+    if earliest:
+        red = jax.lax.pmin
+        big = _BIG_I32
+    else:
+        red = jax.lax.pmax
+        big = -_BIG_I32
+    hi_best = hi
+    for ax in axes:
+        hi_best = red(hi_best, ax)
+    cand = hi == hi_best
+    lo_masked = jnp.where(cand, lo, big)
+    lo_best = lo_masked
+    for ax in axes:
+        lo_best = red(lo_best, ax)
+    cand &= lo == lo_best
+    # timestamp ties across devices: lowest device rank wins (deterministic,
+    # one actual row's value — never an average of tied rows)
+    rank = jnp.zeros((), jnp.int32)
+    for ax in axes:
+        rank = rank * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+    rank_masked = jnp.where(cand, rank, _BIG_I32)
+    rank_best = rank_masked
+    for ax in axes:
+        rank_best = jax.lax.pmin(rank_best, ax)
+    is_winner = cand & (rank == rank_best)
+    wsum = value * is_winner
+    for ax in axes:
+        wsum = jax.lax.psum(wsum, ax)
+    return wsum
+
+
+def build_dist_agg(mesh: Mesh, num_segments: int):
+    """Compile the distributed query step: sharded batch -> replicated
+    {sum, count, mean, min, max, first, last} per segment.
+
+    The jitted function takes row-sharded arrays (padded to a multiple of
+    the mesh size) and returns replicated outputs — the equivalent of the
+    reference's store-scan + exchange + merge pipeline as ONE XLA program.
+    """
+    axes = mesh.axis_names
+    row_spec = P(axes)  # rows sharded over every mesh axis
+
+    def step(values, rel_hi, rel_lo, seg_ids, mask):
+        s, c, mn, mx, first_t, last_t = _local_partials(
+            values, rel_hi, rel_lo, seg_ids, mask, num_segments
+        )
+        for ax in axes:
+            s = jax.lax.psum(s, ax)
+            c = jax.lax.psum(c, ax)
+            mn = jax.lax.pmin(mn, ax)
+            mx = jax.lax.pmax(mx, ax)
+        fv = _merge_time_extreme(*first_t, axes, earliest=True)
+        lv = _merge_time_extreme(*last_t, axes, earliest=False)
+        mean = s / jnp.maximum(c, 1).astype(s.dtype)
+        return {
+            "sum": s, "count": c, "mean": mean,
+            "min": mn, "max": mx, "first": fv, "last": lv,
+        }
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(row_spec,) * 5,
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def shard_rows(mesh: Mesh, *arrays):
+    """Pad row arrays to a multiple of the mesh size (padding masked out by
+    callers via the mask array convention: the LAST array is the mask) and
+    device_put them with the row sharding."""
+    n_dev = mesh.size
+    n = len(arrays[0])
+    npad = (n + n_dev - 1) // n_dev * n_dev
+    spec = NamedSharding(mesh, P(mesh.axis_names))
+    out = []
+    for i, a in enumerate(arrays):
+        pad = np.zeros(npad - n, dtype=a.dtype)
+        out.append(jax.device_put(np.concatenate([a, pad]), spec))
+    return tuple(out)

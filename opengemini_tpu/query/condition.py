@@ -56,6 +56,12 @@ def split(cond, tag_keys: set[str], now_ns: int) -> SplitCondition:
             tmax = min(tmax, hi)
             return
         refs = _collect_refs(e)
+        if "time" in refs or "Time" in refs:
+            # influx rejects OR'd time conditions; silently dropping them
+            # would return wrong rows
+            raise ConditionError(
+                "time conditions must be AND-ed at the top level of WHERE"
+            )
         if refs and refs <= tag_keys:
             tag_parts.append(e)
         elif refs and not (refs & tag_keys):

@@ -37,13 +37,30 @@ class QueryError(Exception):
     pass
 
 
+_READONLY_STMTS = (
+    ast.SelectStatement,
+    ast.ShowDatabases,
+    ast.ShowMeasurements,
+    ast.ShowTagKeys,
+    ast.ShowTagValues,
+    ast.ShowFieldKeys,
+    ast.ShowSeries,
+    ast.ShowRetentionPolicies,
+)
+
+
 class Executor:
     def __init__(self, engine):
         self.engine = engine
 
     # -- entry --------------------------------------------------------------
 
-    def execute(self, text: str, db: str = "", now_ns: int | None = None) -> dict:
+    def execute(
+        self, text: str, db: str = "", now_ns: int | None = None,
+        read_only: bool = False,
+    ) -> dict:
+        """read_only=True (HTTP GET) rejects mutating statements — influx
+        1.x requires POST for anything but SELECT/SHOW."""
         if now_ns is None:
             now_ns = _time.time_ns()
         try:
@@ -53,6 +70,10 @@ class Executor:
         results = []
         for i, stmt in enumerate(stmts):
             try:
+                if read_only and not isinstance(stmt, _READONLY_STMTS):
+                    raise QueryError(
+                        f"{type(stmt).__name__} queries must be sent via POST"
+                    )
                 res = self.execute_statement(stmt, db, now_ns)
             except (QueryError, cond.ConditionError, KeyError, ValueError) as e:
                 res = {"error": str(e)}
@@ -232,6 +253,18 @@ class Executor:
         for sh in shards:
             schema.update(sh.schema(mst))
 
+        # string fields only support count on the device path (reference
+        # supports first/last/distinct on strings — host path, later round)
+        for call, spec, params, field_name in aggs:
+            if schema.get(field_name) == FieldType.STRING and spec.name != "count":
+                raise QueryError(
+                    f"{spec.name}() is not supported on string field {field_name!r}"
+                )
+        # selector ordering uses an int32 (hi, lo) split of rel ns; guard the
+        # 2^61 ns (~73 year) cliff explicitly rather than wrapping silently
+        if tmax - aligned >= (1 << 61):
+            raise QueryError("time range too large (over ~73 years) for aggregation")
+
         for sh, sid, gid in scan_plan:
             rec = sh.read_series(mst, sid, tmin, tmax, fields=read_fields)
             if len(rec) == 0:
@@ -392,11 +425,16 @@ class Executor:
                 key = tuple(tags.get(k, "") for k in group_tags)
                 groups.setdefault(key, []).append((sh, sid, tags))
 
+        # project only needed columns: selected fields + filter refs
+        filter_refs = cond.field_filter_refs(sc.field_expr) if sc.field_expr else set()
+        read_fields = sorted(
+            ({c for c in columns[1:] if c in schema} | set(filter_refs)) & set(schema)
+        )
         out_series = []
         for key in sorted(groups):
             rows: list[list] = []
             for sh, sid, tags in groups[key]:
-                rec = sh.read_series(mst, sid, sc.tmin, sc.tmax)
+                rec = sh.read_series(mst, sid, sc.tmin, sc.tmax, fields=read_fields)
                 if len(rec) == 0:
                     continue
                 fmask = (

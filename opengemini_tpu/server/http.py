@@ -114,7 +114,7 @@ def _make_handler(svc: HttpService):
                 self._send_json(200, {"name": "opengemini-tpu", "status": "pass",
                                       "version": __version__})
             elif path == "/query":
-                self._handle_query(self._params())
+                self._handle_query(self._params(), read_only=True)
             else:
                 self._send_json(404, {"error": "not found"})
 
@@ -140,12 +140,12 @@ def _make_handler(svc: HttpService):
             else:
                 self._send_json(404, {"error": "not found"})
 
-        def _handle_query(self, params: dict):
+        def _handle_query(self, params: dict, read_only: bool = False):
             q = params.get("q", "")
             if not q:
                 self._send_json(400, {"error": "missing required parameter \"q\""})
                 return
-            result = svc.executor.execute(q, db=params.get("db", ""))
+            result = svc.executor.execute(q, db=params.get("db", ""), read_only=read_only)
             epoch = params.get("epoch")
             pretty = params.get("pretty") in ("true", "1")
             self._send_json(200, format_result(result, epoch), pretty)

@@ -12,6 +12,8 @@ Reference grammar: lib/util/lifted/influx/influxql (yacc sql.y).
 
 from __future__ import annotations
 
+import re
+
 from opengemini_tpu.sql import ast
 from opengemini_tpu.sql.lexer import Lexer, Token
 
@@ -357,9 +359,8 @@ class Parser:
                     rtok = self.lex.next(allow_regex=True)
                     s.regex = rtok.val
                 elif tok.kind == "OP" and tok.val == "=":
-                    s.regex = ""  # exact — keep as regex anchor
                     name = self._ident()
-                    s.regex = "^" + name + "$"
+                    s.regex = "^" + re.escape(name) + "$"  # exact match
                 else:
                     raise ParseError("bad WITH MEASUREMENT")
             return s

@@ -271,3 +271,40 @@ class TestShowAndDDL:
         res = q(ex, "SELECT v FROM missing_db_measurement; SHOW DATABASES")
         assert res["results"][0] == {"statement_id": 0} or "series" not in res["results"][0]
         assert "series" in res["results"][1]
+
+
+class TestReviewRegressions2:
+    def test_or_time_condition_is_error(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(ex, f"SELECT usage_user FROM cpu WHERE time > {BASE*NS} OR usage_user > 5")
+        assert "time conditions" in res["results"][0]["error"]
+
+    def test_string_field_agg_rejected_except_count(self, env):
+        e, ex = env
+        e.write_lines("db", f'm status="ok" {BASE*NS}\nm status="bad" {(BASE+1)*NS}')
+        res = q(ex, "SELECT first(status) FROM m")
+        assert "not supported on string field" in res["results"][0]["error"]
+        res = q(ex, "SELECT count(status) FROM m")
+        [(t, v)] = series_of(res)["values"]
+        assert v == 2
+
+    def test_selector_tie_breaks_by_time_across_series(self, env):
+        e, ex = env
+        # equal max value 5.0: h_b earlier (t+10) than h_a (t+20), but h_a
+        # is scanned first (sorted sids) — time must win
+        e.write_lines(
+            "db",
+            f"m,h=a v=5 {(BASE+20)*NS}\nm,h=a v=1 {(BASE+30)*NS}\n"
+            f"m,h=b v=5 {(BASE+10)*NS}\nm,h=b v=2 {(BASE+40)*NS}",
+        )
+        res = q(ex, "SELECT max(v) FROM m")
+        [(t, v)] = series_of(res)["values"]
+        assert v == 5.0 and t == (BASE + 10) * NS
+
+    def test_show_measurements_exact_match_escaped(self, env):
+        e, ex = env
+        e.write_lines("db", f"axb v=1 {BASE*NS}\n")
+        # 'a.b' must NOT match 'axb'
+        res = q(ex, 'SHOW MEASUREMENTS WITH MEASUREMENT = "a.b"')
+        assert res["results"][0] == {"statement_id": 0}

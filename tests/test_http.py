@@ -128,8 +128,11 @@ def test_api_v2_write(server):
     assert json.loads(out)["results"][0]["series"][0]["values"][0][1] == 9.0
 
 
-def test_ddl_over_http(server):
-    status, _ = get(server, "/query", q="CREATE DATABASE http_db")
+def test_ddl_over_http_post_only(server):
+    # GET must reject mutating statements (influx 1.x POST requirement)
+    status, body = get(server, "/query", q="CREATE DATABASE http_db")
+    assert "must be sent via POST" in json.loads(body)["results"][0]["error"]
+    status, _ = post(server, "/query", b"", q="CREATE DATABASE http_db")
     assert status == 200
     _, body = get(server, "/query", q="SHOW DATABASES")
     vals = json.loads(body)["results"][0]["series"][0]["values"]

@@ -96,8 +96,9 @@ def test_first_last_hi_lo_lexicographic(rng):
 
 def test_selectors_min_max_time(rng):
     jv, jt, js, jm, v, t, s, m, ns = make_batch(rng)
-    mv, msel = seg.seg_min_selector(jv, js, ns, jm)
-    xv, xsel = seg.seg_max_selector(jv, js, ns, jm)
+    zeros = jnp.zeros_like(jt)
+    mv, msel = seg.seg_min_selector(jv, zeros, jt, js, ns, jm)
+    xv, xsel = seg.seg_max_selector(jv, zeros, jt, js, ns, jm)
     mv, msel, xv, xsel = map(np.asarray, (mv, msel, xv, xsel))
     for sid, rows in enumerate(group_rows(s, ns)):
         rows = rows[m[rows]]
@@ -107,6 +108,19 @@ def test_selectors_min_max_time(rng):
         i_max = rows[np.argmax(v[rows])]
         assert mv[sid] == v[i_min] and msel[sid] == i_min
         assert xv[sid] == v[i_max] and xsel[sid] == i_max
+
+
+def test_selector_value_tie_breaks_by_time(rng):
+    """Equal extreme values: the EARLIER timestamp wins, not scan order."""
+    v = np.array([5.0, 1.0, 5.0, 2.0])
+    lo = np.array([100, 30, 50, 40], dtype=np.int32)  # row 2 earlier than row 0
+    hi = np.zeros(4, dtype=np.int32)
+    s = np.zeros(4, dtype=np.int32)
+    m = np.ones(4, dtype=bool)
+    xv, xsel = seg.seg_max_selector(
+        jnp.asarray(v), jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(s), 1, jnp.asarray(m)
+    )
+    assert int(np.asarray(xsel)[0]) == 2
 
 
 def test_stddev_spread(rng):
@@ -208,3 +222,24 @@ def test_builder_rejects_whole_point_on_type_conflict():
         b.append_row(2, {"x": (FieldType.FLOAT, 9.0), "a": (FieldType.INT, 2)})
     rec = b.build()
     assert len(rec) == 1 and "x" not in rec.columns
+
+
+def test_grid_window_agg_layouts_match(rng):
+    """Both grid fast-path layouts must agree with the numpy oracle."""
+    S, W, K = 5, 7, 6
+    v = rng.normal(size=(S, W * K))
+    m = rng.random((S, W * K)) > 0.3
+    out = seg.grid_window_agg(jnp.asarray(v), jnp.asarray(m), W)
+    v_t = v.reshape(S, W, K).transpose(0, 2, 1)
+    m_t = m.reshape(S, W, K).transpose(0, 2, 1)
+    out_t = seg.grid_window_agg_t(jnp.asarray(v_t), jnp.asarray(m_t))
+    for s in range(S):
+        for w in range(W):
+            vals = v[s, w * K : (w + 1) * K][m[s, w * K : (w + 1) * K]]
+            for o in (out, out_t):
+                assert int(np.asarray(o["count"])[s, w]) == len(vals)
+                if len(vals):
+                    assert np.isclose(np.asarray(o["sum"])[s, w], vals.sum())
+                    assert np.asarray(o["min"])[s, w] == vals.min()
+                    assert np.asarray(o["max"])[s, w] == vals.max()
+                    assert np.isclose(np.asarray(o["mean"])[s, w], vals.mean())
