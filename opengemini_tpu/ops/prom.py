@@ -1,0 +1,197 @@
+"""PromQL range-vector functions as dense device kernels.
+
+Reference: the store-side prom cursors + reducers
+(engine/prom_range_vector_cursor.go, prom_function_reducers.go:633) which
+walk samples per series per step. TPU-native design: per series the
+samples live in a padded (num_series, max_samples) matrix; every step
+window is resolved to [first_idx, last_idx] sample indices with a
+vmap'd searchsorted, and rate/increase/delta become GATHERS + arithmetic
+over the (num_series, num_steps) grid — overlapping windows cost O(1)
+each via per-series prefix sums of counter-reset corrections, instead of
+re-walking samples (no data duplication across steps).
+
+Semantics follow Prometheus exactly (promql/functions.go extrapolatedRate):
+  - counter resets: correction[i] = v[i-1] if v[i] < v[i-1]
+  - extrapolation to window bounds, limited to 1.1x average sample
+    interval, and clamped to zero-crossing for counters.
+
+All timestamps here are int64 milliseconds (prom's unit) on the HOST;
+the device sees float64/float32 seconds relative to the window start —
+callers produce them via `prepare_matrix`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def prepare_matrix(series_samples: list[tuple[np.ndarray, np.ndarray]], dtype=np.float32):
+    """[(times_ms int64, values f64)] -> padded matrices.
+
+    Returns (times_s f64-as-dtype relative to base, values, counts, base_ms).
+    Times must be sorted ascending per series.
+    """
+    S = len(series_samples)
+    n_max = max((len(t) for t, _v in series_samples), default=0)
+    n_max = max(n_max, 1)
+    base_ms = min((int(t[0]) for t, _v in series_samples if len(t)), default=0)
+    times = np.zeros((S, n_max), dtype=np.float64)
+    values = np.zeros((S, n_max), dtype=dtype)
+    counts = np.zeros(S, dtype=np.int32)
+    for i, (t, v) in enumerate(series_samples):
+        k = len(t)
+        counts[i] = k
+        times[i, :k] = (t - base_ms) / 1000.0
+        values[i, :k] = v
+        if k:  # pad tail with a huge time so searchsorted never picks it
+            times[i, k:] = np.inf
+        else:
+            times[i, :] = np.inf
+    return times, values, counts, base_ms
+
+
+def window_bounds(times, counts, step_starts, step_ends):
+    """Per (series, step) first/last sample indices inside (start, end].
+
+    times: (S, N) seconds; step_starts/step_ends: (K,) seconds.
+    Returns (first_idx, last_idx, has_samples) each (S, K).
+    Prom windows are left-OPEN right-CLOSED: (t-w, t].
+    """
+    first_idx = _vmap_searchsorted(times, step_starts, "right")
+    last_idx = _vmap_searchsorted(times, step_ends, "right") - 1
+    has = (last_idx >= first_idx) & (first_idx < counts[:, None])
+    return first_idx, last_idx, has
+
+
+def _vmap_searchsorted(times, keys, side):
+    import jax
+
+    return jax.vmap(lambda row: jnp.searchsorted(row, keys, side=side))(times)
+
+
+def _gather_rows(mat, idx):
+    return jnp.take_along_axis(mat, idx, axis=1)
+
+
+def reset_corrections(values, counts):
+    """Per-series prefix sum of counter-reset corrections:
+    C[i] = sum_{j<=i} (v[j-1] if v[j] < v[j-1] else 0). (S, N)."""
+    prev = jnp.concatenate([values[:, :1], values[:, :-1]], axis=1)
+    drop = jnp.where(values < prev, prev, jnp.zeros((), values.dtype))
+    drop = drop.at[:, 0].set(0)
+    n = values.shape[1]
+    valid = jnp.arange(n)[None, :] < counts[:, None]
+    return jnp.cumsum(jnp.where(valid, drop, 0), axis=1)
+
+
+def extrapolated_rate(
+    times, values, counts, step_starts, step_ends,
+    window_s: float, is_counter: bool, is_rate: bool,
+):
+    """Prometheus extrapolatedRate for every (series, step).
+
+    Returns (out (S, K), valid (S, K)); valid requires >= 2 samples in the
+    window (prom semantics).
+    """
+    first_idx, last_idx, has = window_bounds(times, counts, step_starts, step_ends)
+    safe_first = jnp.clip(first_idx, 0, times.shape[1] - 1)
+    safe_last = jnp.clip(last_idx, 0, times.shape[1] - 1)
+    t_first = _gather_rows(times, safe_first)
+    t_last = _gather_rows(times, safe_last)
+    v_first = _gather_rows(values, safe_first)
+    v_last = _gather_rows(values, safe_last)
+    n_samples = last_idx - first_idx + 1
+    valid = has & (n_samples >= 2)
+
+    delta = v_last - v_first
+    if is_counter:
+        cum = reset_corrections(values, counts)
+        c_first = _gather_rows(cum, safe_first)
+        c_last = _gather_rows(cum, safe_last)
+        delta = delta + (c_last - c_first)
+
+    # prom extrapolation (promql/functions.go extrapolatedRate)
+    sampled_interval = t_last - t_first
+    sampled_interval = jnp.where(sampled_interval <= 0, 1.0, sampled_interval)
+    avg_interval = sampled_interval / jnp.maximum(n_samples - 1, 1).astype(times.dtype)
+    dur_to_start = t_first - step_starts[None, :]
+    dur_to_end = step_ends[None, :] - t_last
+    extrap_threshold = avg_interval * 1.1
+    dur_to_start = jnp.where(dur_to_start > extrap_threshold, avg_interval / 2, dur_to_start)
+    dur_to_end = jnp.where(dur_to_end > extrap_threshold, avg_interval / 2, dur_to_end)
+    if is_counter:
+        # a counter cannot extrapolate below zero (prom applies this only
+        # for delta > 0 AND v_first >= 0, promql/functions.go)
+        dur_zero = jnp.where(
+            (delta > 0) & (v_first >= 0),
+            sampled_interval * (v_first / jnp.maximum(delta, 1e-30)),
+            jnp.inf,
+        )
+        dur_to_start = jnp.minimum(dur_to_start, dur_zero)
+    extrapolated = sampled_interval + dur_to_start + dur_to_end
+    out = delta.astype(times.dtype) * (extrapolated / sampled_interval)
+    if is_rate:
+        out = out / window_s
+    return out, valid
+
+
+def over_time(times, values, counts, step_starts, step_ends, func: str):
+    """xxx_over_time functions: avg/min/max/sum/count/last. (S, K).
+
+    sum/avg/count/last use the O(S*K) prefix-sum+gather scheme (no dense
+    (S, K, N) tensor). min/max have no prefix form; they use a dense
+    window-membership tensor computed in step CHUNKS so peak memory stays
+    bounded at S * 256 * N booleans.
+    """
+    first_idx, last_idx, has = window_bounds(times, counts, step_starts, step_ends)
+    n = times.shape[1]
+    if func in ("sum", "avg", "count", "last"):
+        if func == "last":
+            safe_last = jnp.clip(last_idx, 0, n - 1)
+            return _gather_rows(values, safe_last), has
+        valid_cols = jnp.arange(n)[None, :] < counts[:, None]
+        csum = jnp.cumsum(jnp.where(valid_cols, values, 0), axis=1)
+        csum = jnp.concatenate([jnp.zeros_like(csum[:, :1]), csum], axis=1)  # (S, N+1)
+        safe_f = jnp.clip(first_idx, 0, n)
+        safe_l1 = jnp.clip(last_idx + 1, 0, n)
+        wsum = _gather_rows(csum, safe_l1) - _gather_rows(csum, safe_f)
+        wcnt = (last_idx - first_idx + 1).astype(values.dtype)
+        wcnt = jnp.where(has, wcnt, 0)
+        if func == "count":
+            return wcnt, has
+        if func == "sum":
+            return jnp.where(has, wsum, 0), has
+        return jnp.where(has, wsum, 0) / jnp.maximum(wcnt, 1), has
+    if func in ("min", "max"):
+        k = step_starts.shape[0]
+        chunk = 256
+        outs = []
+        fill = jnp.inf if func == "min" else -jnp.inf
+        for c0 in range(0, k, chunk):
+            fi = first_idx[:, c0 : c0 + chunk, None]
+            li = last_idx[:, c0 : c0 + chunk, None]
+            col = jnp.arange(n)[None, None, :]
+            in_win = (col >= fi) & (col <= li) & (col < counts[:, None, None])
+            v = values[:, None, :]
+            if func == "min":
+                outs.append(jnp.where(in_win, v, fill).min(axis=2))
+            else:
+                outs.append(jnp.where(in_win, v, fill).max(axis=2))
+        return jnp.concatenate(outs, axis=1), has
+    raise ValueError(f"unsupported over_time func {func!r}")
+
+
+def instant_values(times, values, counts, eval_times, lookback_s: float = 300.0):
+    """Instant vector selection: latest sample within [t - lookback, t].
+    Returns (vals (S, K), valid (S, K)) — prom staleness semantics (without
+    explicit staleness markers, which the influx data model doesn't carry).
+    """
+    idx = _vmap_searchsorted(times, eval_times, "right") - 1
+    safe = jnp.clip(idx, 0, times.shape[1] - 1)
+    t_at = _gather_rows(times, safe)
+    v_at = _gather_rows(values, safe)
+    valid = (idx >= 0) & (t_at >= eval_times[None, :] - lookback_s) & (
+        idx < counts[:, None]
+    )
+    return v_at, valid

@@ -1,0 +1,4 @@
+"""PromQL front-end (reference: lib/util/lifted/promql2influxql transpiler
++ the prometheus promql engine glue). Here PromQL evaluates directly
+against the storage engine through the same device kernels as InfluxQL,
+rather than transpiling to InfluxQL text."""

@@ -1,0 +1,499 @@
+"""PromQL evaluation engine over the storage engine + device kernels.
+
+Reference path: servePromRead -> promql2influxql.Transpile -> influx SELECT
+with prom logical nodes + prom cursors (SURVEY.md §3.3). Here the AST
+evaluates directly: selectors scan the same shards/index as InfluxQL, the
+range-vector math runs in ops/prom.py device kernels over dense
+(series, steps) grids, and label aggregation happens on the host.
+
+Data model (matching the reference's prom-on-influx mapping): metric name
+= measurement, labels = tags, sample value = field "value".
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as np
+
+from opengemini_tpu.ops import prom as promops
+from opengemini_tpu.promql import parser as pp
+
+MS = 1_000_000  # ns per ms
+DEFAULT_LOOKBACK_S = 300.0
+
+
+class PromError(ValueError):
+    pass
+
+
+def _anchor(pattern: str) -> str:
+    return "^(?:" + pattern + ")$"
+
+
+class Frame:
+    """Evaluation result: per-series (S, K) values over the step grid."""
+
+    __slots__ = ("labels", "values", "valid", "is_scalar")
+
+    def __init__(self, labels, values, valid, is_scalar=False):
+        self.labels = labels  # list[dict]
+        self.values = values  # (S, K) float
+        self.valid = valid  # (S, K) bool
+        self.is_scalar = is_scalar
+
+    @classmethod
+    def scalar(cls, v: float, k: int):
+        return cls([{}], np.full((1, k), v), np.ones((1, k), bool), True)
+
+
+class PromEngine:
+    def __init__(self, engine, value_field: str = "value",
+                 lookback_s: float = DEFAULT_LOOKBACK_S):
+        self.engine = engine
+        self.value_field = value_field
+        self.lookback_s = lookback_s
+
+    # -- public API -----------------------------------------------------
+
+    def query_range(self, text: str, start_s: float, end_s: float, step_s: float,
+                    db: str) -> dict:
+        if step_s <= 0:
+            raise PromError("step must be positive")
+        if not (math.isfinite(start_s) and math.isfinite(end_s) and math.isfinite(step_s)):
+            raise PromError("start/end/step must be finite")
+        n_steps = int(math.floor((end_s - start_s) / step_s)) + 1
+        if n_steps <= 0:
+            raise PromError("empty step range")
+        if n_steps > 11_000:
+            raise PromError("too many steps (max 11000)")
+        steps = start_s + np.arange(n_steps) * step_s
+        expr = pp.parse(text)
+        frame = self._eval(expr, steps, db)
+        result = []
+        for i, labels in enumerate(frame.labels):
+            pts = [
+                [float(steps[k]), _fmt(frame.values[i, k])]
+                for k in range(n_steps)
+                if frame.valid[i, k]
+            ]
+            if pts:
+                result.append({"metric": labels, "values": pts})
+        result.sort(key=lambda r: sorted(r["metric"].items()))
+        return {"resultType": "matrix", "result": result}
+
+    def query_instant(self, text: str, time_s: float, db: str) -> dict:
+        steps = np.array([time_s])
+        expr = pp.parse(text)
+        frame = self._eval(expr, steps, db)
+        if frame.is_scalar:
+            return {"resultType": "scalar", "result": [time_s, _fmt(frame.values[0, 0])]}
+        result = []
+        for i, labels in enumerate(frame.labels):
+            if frame.valid[i, 0]:
+                result.append(
+                    {"metric": labels, "value": [float(time_s), _fmt(frame.values[i, 0])]}
+                )
+        result.sort(key=lambda r: sorted(r["metric"].items()))
+        return {"resultType": "vector", "result": result}
+
+    # -- evaluation -------------------------------------------------------
+
+    def _eval(self, node, steps: np.ndarray, db: str) -> Frame:
+        k = len(steps)
+        if isinstance(node, pp.NumberLit):
+            return Frame.scalar(node.val, k)
+        if isinstance(node, pp.VectorSelector):
+            return self._eval_selector(node, steps, db, self.lookback_s, instant=True)
+        if isinstance(node, pp.MatrixSelector):
+            raise PromError("range vector must be wrapped in a function (e.g. rate)")
+        if isinstance(node, pp.FunctionCall):
+            return self._eval_function(node, steps, db)
+        if isinstance(node, pp.Aggregation):
+            return self._eval_aggregation(node, steps, db)
+        if isinstance(node, pp.BinaryOp):
+            return self._eval_binop(node, steps, db)
+        raise PromError(f"unsupported expression {type(node).__name__}")
+
+    def _collect_series(self, vs: pp.VectorSelector, t_min_ns: int, t_max_ns: int, db: str):
+        """-> (labels list, [(times_ms, values)] per series)."""
+        metric = vs.metric
+        for m in vs.matchers:
+            if m.name == "__name__":
+                if m.op != "=":
+                    raise PromError("__name__ supports only '=' here")
+                metric = m.value
+        if not metric:
+            raise PromError("metric name required")
+        shards = self.engine.shards_for_range(db, None, t_min_ns, t_max_ns)
+        out_labels: list[dict] = []
+        out_samples: list[tuple[np.ndarray, np.ndarray]] = []
+        # series may span shards: merge by label key
+        per_key: dict[tuple, list] = {}
+        for sh in shards:
+            sids = sh.index.series_ids(metric)
+            for m in vs.matchers:
+                if m.name == "__name__":
+                    continue
+                try:
+                    if m.op == "=":
+                        sids &= sh.index.match_eq(metric, m.name, m.value)
+                    elif m.op == "!=":
+                        sids &= sh.index.match_neq(metric, m.name, m.value)
+                    elif m.op == "=~":
+                        # prometheus fully anchors label-matcher regexes
+                        sids &= sh.index.match_regex(metric, m.name, _anchor(m.value))
+                    elif m.op == "!~":
+                        sids &= sh.index.match_regex(
+                            metric, m.name, _anchor(m.value), negate=True
+                        )
+                except re.error as e:
+                    raise PromError(f"invalid regex in matcher {m.name!r}: {e}") from None
+            for sid in sorted(sids):
+                tags = sh.index.tags_of(sid)
+                key = tuple(sorted(tags.items()))
+                per_key.setdefault((key,), []).append((sh, sid, tags))
+        for (key,), entries in sorted(per_key.items()):
+            times_all, vals_all = [], []
+            for sh, sid, tags in entries:
+                rec = sh.read_series(metric, sid, t_min_ns, t_max_ns, fields=[self.value_field])
+                col = rec.columns.get(self.value_field)
+                if col is None or len(rec) == 0:
+                    continue
+                valid = col.valid
+                times_all.append(rec.times[valid] // MS)
+                vals_all.append(col.values[valid].astype(np.float64))
+            if not times_all:
+                continue
+            t = np.concatenate(times_all)
+            v = np.concatenate(vals_all)
+            order = np.argsort(t, kind="stable")
+            labels = dict(entries[0][2])
+            labels["__name__"] = metric
+            out_labels.append(labels)
+            out_samples.append((t[order], v[order]))
+        return out_labels, out_samples
+
+    def _eval_selector(self, vs, steps, db, window_s, instant):
+        eval_times = steps - vs.offset_s
+        t_max_ns = int(eval_times[-1] * 1e9) + 1
+        t_min_ns = int((eval_times[0] - window_s) * 1e9)
+        labels, samples = self._collect_series(vs, t_min_ns, t_max_ns, db)
+        k = len(steps)
+        if not samples:
+            return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
+        times, values, counts, base_ms = promops.prepare_matrix(samples, dtype=np.float64)
+        rel = eval_times - base_ms / 1000.0
+        vals, valid = promops.instant_values(times, values, counts, rel, window_s)
+        return Frame(labels, np.asarray(vals), np.asarray(valid))
+
+    def _eval_function(self, node: pp.FunctionCall, steps, db) -> Frame:
+        name = node.name
+        range_fns = {
+            "rate": (True, True), "increase": (True, False), "delta": (False, False),
+        }
+        if name in range_fns:
+            is_counter, is_rate = range_fns[name]
+            ms_sel = _expect_matrix(node, 0)
+            return self._eval_range_fn(
+                ms_sel, steps, db,
+                lambda t, v, c, s0, s1: promops.extrapolated_rate(
+                    t, v, c, s0, s1, ms_sel.range_s, is_counter, is_rate
+                ),
+            )
+        if name in ("irate", "idelta"):
+            ms_sel = _expect_matrix(node, 0)
+            return self._eval_range_fn(
+                ms_sel, steps, db,
+                lambda t, v, c, s0, s1: _instant_rate(t, v, c, s0, s1, name == "irate"),
+            )
+        if name.endswith("_over_time"):
+            func = name[: -len("_over_time")]
+            ms_sel = _expect_matrix(node, 0)
+            return self._eval_range_fn(
+                ms_sel, steps, db,
+                lambda t, v, c, s0, s1: promops.over_time(t, v, c, s0, s1, func),
+            )
+        if name == "scalar":
+            f = self._eval(node.args[0], steps, db)
+            if len(f.labels) == 1:
+                # steps where the series had no sample become NaN (prom)
+                vals = np.where(f.valid[:1], f.values[:1], np.nan)
+                return Frame([{}], vals, np.ones((1, len(steps)), bool), True)
+            vals = np.full((1, len(steps)), np.nan)
+            return Frame([{}], vals, np.ones_like(vals, dtype=bool), True)
+        if name == "vector":
+            f = self._eval(node.args[0], steps, db)
+            f.is_scalar = False
+            return f
+        # elementwise math
+        elem = {
+            "abs": np.abs, "ceil": np.ceil, "floor": np.floor, "exp": np.exp,
+            "ln": np.log, "log2": np.log2, "log10": np.log10, "sqrt": np.sqrt,
+            "round": np.round,
+        }
+        if name in elem:
+            f = self._eval(node.args[0], steps, db)
+            with np.errstate(all="ignore"):
+                f.values = elem[name](f.values)
+            f.labels = [_drop_name(l) for l in f.labels]
+            return f
+        if name in ("clamp_min", "clamp_max"):
+            f = self._eval(node.args[0], steps, db)
+            bound = _expect_number(node, 1)
+            f.values = (
+                np.maximum(f.values, bound) if name == "clamp_min"
+                else np.minimum(f.values, bound)
+            )
+            f.labels = [_drop_name(l) for l in f.labels]
+            return f
+        if name == "timestamp":
+            f = self._eval(node.args[0], steps, db)
+            f.values = np.broadcast_to(steps[None, :], f.values.shape).copy()
+            f.labels = [_drop_name(l) for l in f.labels]
+            return f
+        raise PromError(f"unsupported function {name!r}")
+
+    def _eval_range_fn(self, ms_sel: pp.MatrixSelector, steps, db, kernel) -> Frame:
+        vs = ms_sel.vector
+        w = ms_sel.range_s
+        eval_times = steps - vs.offset_s
+        t_max_ns = int(eval_times[-1] * 1e9) + 1
+        t_min_ns = int((eval_times[0] - w) * 1e9)
+        labels, samples = self._collect_series(vs, t_min_ns, t_max_ns, db)
+        k = len(steps)
+        if not samples:
+            return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
+        times, values, counts, base_ms = promops.prepare_matrix(samples, dtype=np.float64)
+        base_s = base_ms / 1000.0
+        ends = eval_times - base_s
+        starts = ends - w
+        out, valid = kernel(times, values, counts, starts, ends)
+        labels = [_drop_name(l) for l in labels]
+        return Frame(labels, np.asarray(out), np.asarray(valid))
+
+    def _eval_aggregation(self, node: pp.Aggregation, steps, db) -> Frame:
+        f = self._eval(node.expr, steps, db)
+        k = len(steps)
+        if not f.labels:
+            return f
+        # group key per series
+        keys = []
+        out_labels_by_key: dict[tuple, dict] = {}
+        for labels in f.labels:
+            l = _drop_name(labels)
+            if node.without:
+                grp = {n: v for n, v in l.items() if n not in node.grouping}
+            elif node.grouping:
+                grp = {n: v for n, v in l.items() if n in node.grouping}
+            else:
+                grp = {}
+            key = tuple(sorted(grp.items()))
+            keys.append(key)
+            out_labels_by_key[key] = grp
+        uniq = sorted(out_labels_by_key)
+        key_idx = {kk: i for i, kk in enumerate(uniq)}
+        g = len(uniq)
+        vals = np.where(f.valid, f.values, 0.0)
+        member = np.zeros((g, len(f.labels)), dtype=bool)
+        for si, kk in enumerate(keys):
+            member[key_idx[kk], si] = True
+        counts = member.astype(np.float64) @ f.valid.astype(np.float64)
+        any_valid = counts > 0
+
+        op = node.op
+        if op in ("sum", "avg", "count", "stddev", "stdvar", "group"):
+            s = member.astype(np.float64) @ vals
+            if op == "sum":
+                out = s
+            elif op == "count":
+                out = counts
+            elif op == "group":
+                out = np.ones_like(s)
+            else:
+                mean = s / np.maximum(counts, 1)
+                sq = member.astype(np.float64) @ np.where(f.valid, f.values**2, 0.0)
+                var = sq / np.maximum(counts, 1) - mean**2
+                var = np.maximum(var, 0)
+                if op == "avg":
+                    out = mean
+                elif op == "stdvar":
+                    out = var
+                else:
+                    out = np.sqrt(var)
+            if op == "avg":
+                out = s / np.maximum(counts, 1)
+            return Frame([dict(u) for u in (out_labels_by_key[kk] for kk in uniq)],
+                         out, any_valid)
+        if op in ("min", "max"):
+            fill = np.inf if op == "min" else -np.inf
+            masked = np.where(f.valid, f.values, fill)
+            out = np.full((g, k), fill)
+            for si, kk in enumerate(keys):
+                gi = key_idx[kk]
+                out[gi] = np.minimum(out[gi], masked[si]) if op == "min" else np.maximum(out[gi], masked[si])
+            return Frame([dict(u) for u in (out_labels_by_key[kk] for kk in uniq)],
+                         out, any_valid)
+        if op in ("topk", "bottomk"):
+            n = int(_expect_number_node(node.param))
+            keep = np.zeros_like(f.valid)
+            for gi, kk in enumerate(uniq):
+                rows = [si for si, skk in enumerate(keys) if skk == kk]
+                for col in range(k):
+                    cand = [(f.values[si, col], si) for si in rows if f.valid[si, col]]
+                    cand.sort(reverse=(op == "topk"))
+                    for _v, si in cand[:n]:
+                        keep[si, col] = True
+            return Frame(f.labels, f.values, keep)
+        if op == "quantile":
+            q = float(_expect_number_node(node.param))
+            out = np.full((g, k), np.nan)
+            for gi, kk in enumerate(uniq):
+                rows = [si for si, skk in enumerate(keys) if skk == kk]
+                for col in range(k):
+                    vs_ = [f.values[si, col] for si in rows if f.valid[si, col]]
+                    if vs_:
+                        out[gi, col] = _prom_quantile(q, vs_)
+            return Frame([dict(u) for u in (out_labels_by_key[kk] for kk in uniq)],
+                         out, any_valid)
+        raise PromError(f"unsupported aggregation {op!r}")
+
+    def _eval_binop(self, node: pp.BinaryOp, steps, db) -> Frame:
+        lhs = self._eval(node.lhs, steps, db)
+        rhs = self._eval(node.rhs, steps, db)
+        op = node.op
+        if lhs.is_scalar and rhs.is_scalar:
+            v = _apply_op(op, lhs.values, rhs.values, comparison_keep=False)
+            return Frame([{}], v, lhs.valid & rhs.valid, True)
+        if lhs.is_scalar or rhs.is_scalar:
+            vec, sc, flipped = (rhs, lhs, True) if lhs.is_scalar else (lhs, rhs, False)
+            a, b = (sc.values, vec.values) if flipped else (vec.values, sc.values)
+            if op in ("==", "!=", "<", ">", "<=", ">="):
+                m = _cmp(op, a, b)
+                return Frame(vec.labels, vec.values, vec.valid & m)
+            v = _apply_op(op, a, b, comparison_keep=False)
+            labels = [_drop_name(l) for l in vec.labels]
+            return Frame(labels, np.broadcast_to(v, vec.values.shape).copy(), vec.valid)
+        # vector/vector: exact label match (ignoring __name__)
+        lkeys = [tuple(sorted(_drop_name(l).items())) for l in lhs.labels]
+        rmap = {tuple(sorted(_drop_name(l).items())): i for i, l in enumerate(rhs.labels)}
+        labels, vals, valid = [], [], []
+        for i, kk in enumerate(lkeys):
+            j = rmap.get(kk)
+            if j is None:
+                continue
+            if op in ("==", "!=", "<", ">", "<=", ">="):
+                m = _cmp(op, lhs.values[i], rhs.values[j])
+                labels.append(_drop_name(lhs.labels[i]))
+                vals.append(lhs.values[i])
+                valid.append(lhs.valid[i] & rhs.valid[j] & m)
+            else:
+                v = _apply_op(op, lhs.values[i], rhs.values[j], comparison_keep=False)
+                labels.append(_drop_name(lhs.labels[i]))
+                vals.append(v)
+                valid.append(lhs.valid[i] & rhs.valid[j])
+        k = len(steps)
+        if not labels:
+            return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
+        return Frame(labels, np.stack(vals), np.stack(valid))
+
+
+def _instant_rate(times, values, counts, starts, ends, per_second: bool):
+    """irate/idelta: last two samples in the window."""
+    import jax.numpy as jnp
+
+    from opengemini_tpu.ops.prom import window_bounds, _gather_rows
+
+    first_idx, last_idx, has = window_bounds(times, counts, starts, ends)
+    n = times.shape[1]
+    prev_idx = jnp.clip(last_idx - 1, 0, n - 1)
+    safe_last = jnp.clip(last_idx, 0, n - 1)
+    valid = has & (last_idx - first_idx >= 1)
+    v_last = _gather_rows(values, safe_last)
+    v_prev = _gather_rows(values, prev_idx)
+    t_last = _gather_rows(times, safe_last)
+    t_prev = _gather_rows(times, prev_idx)
+    dv = v_last - v_prev
+    if per_second:
+        dv = jnp.where(dv < 0, v_last, dv)  # counter reset
+        dt = jnp.maximum(t_last - t_prev, 1e-9)
+        return dv / dt, valid
+    return dv, valid
+
+
+def _prom_quantile(q: float, vals: list[float]) -> float:
+    if not vals:
+        return float("nan")
+    if q < 0:
+        return float("-inf")
+    if q > 1:
+        return float("inf")
+    s = sorted(vals)
+    n = len(s)
+    rank = q * (n - 1)
+    lo = int(math.floor(rank))
+    hi = min(lo + 1, n - 1)
+    w = rank - lo
+    return s[lo] * (1 - w) + s[hi] * w
+
+
+def _apply_op(op, a, b, comparison_keep):
+    with np.errstate(all="ignore"):
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return np.where(b != 0, a / np.where(b == 0, 1, b), np.inf * np.sign(a))
+        if op == "%":
+            return np.mod(a, np.where(b == 0, np.nan, b))
+        if op == "^":
+            return np.power(a, b)
+    raise PromError(f"unsupported operator {op!r}")
+
+
+def _cmp(op, a, b):
+    if op == "==":
+        return a == b
+    if op == "!=":
+        return a != b
+    if op == "<":
+        return a < b
+    if op == ">":
+        return a > b
+    if op == "<=":
+        return a <= b
+    return a >= b
+
+
+def _drop_name(labels: dict) -> dict:
+    return {k: v for k, v in labels.items() if k != "__name__"}
+
+
+def _expect_matrix(node, i) -> pp.MatrixSelector:
+    if i >= len(node.args) or not isinstance(node.args[i], pp.MatrixSelector):
+        raise PromError(f"{node.name}() expects a range vector")
+    return node.args[i]
+
+
+def _expect_number(node, i) -> float:
+    if i >= len(node.args) or not isinstance(node.args[i], pp.NumberLit):
+        raise PromError(f"{node.name}() expects a number argument")
+    return node.args[i].val
+
+
+def _expect_number_node(n) -> float:
+    if not isinstance(n, pp.NumberLit):
+        raise PromError("expected a number parameter")
+    return n.val
+
+
+def _fmt(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))

@@ -1,0 +1,304 @@
+"""PromQL parser (executed subset).
+
+Grammar covered: vector selectors with label matchers, range selectors,
+offset, number literals, function calls, aggregation operators with
+by/without clauses, scalar<->vector binary arithmetic and vector/vector
+arithmetic on matching label sets, parentheses.
+
+Reference grammar: promql2influxql (transpiler.go:45) drives Prometheus'
+own parser; this is a standalone hand-written equivalent for the engine's
+surface.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+class PromParseError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class LabelMatcher:
+    name: str
+    op: str  # = != =~ !~
+    value: str
+
+
+@dataclass
+class VectorSelector:
+    metric: str = ""
+    matchers: list[LabelMatcher] = field(default_factory=list)
+    offset_s: float = 0.0
+
+
+@dataclass
+class MatrixSelector:
+    vector: VectorSelector = None
+    range_s: float = 0.0
+
+
+@dataclass
+class NumberLit:
+    val: float = 0.0
+
+
+@dataclass
+class FunctionCall:
+    name: str = ""
+    args: list = field(default_factory=list)
+
+
+@dataclass
+class Aggregation:
+    op: str = ""
+    expr: object = None
+    grouping: list[str] = field(default_factory=list)
+    without: bool = False
+    param: object = None  # topk/quantile first arg
+
+
+@dataclass
+class BinaryOp:
+    op: str = ""
+    lhs: object = None
+    rhs: object = None
+
+
+AGG_OPS = {"sum", "avg", "min", "max", "count", "topk", "bottomk", "quantile",
+           "stddev", "stdvar", "group"}
+FUNCTIONS = {
+    "rate", "irate", "increase", "delta", "idelta",
+    "avg_over_time", "min_over_time", "max_over_time", "sum_over_time",
+    "count_over_time", "last_over_time",
+    "abs", "ceil", "floor", "round", "exp", "ln", "log2", "log10", "sqrt",
+    "clamp_min", "clamp_max", "scalar", "vector", "timestamp",
+}
+
+_DUR = re.compile(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w|y)")
+_DUR_S = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0,
+          "w": 604800.0, "y": 31536000.0}
+
+
+def parse_duration_s(s: str) -> float:
+    total = 0.0
+    pos = 0
+    while pos < len(s):
+        m = _DUR.match(s, pos)
+        if not m:
+            raise PromParseError(f"bad duration {s!r}")
+        total += float(m.group(1)) * _DUR_S[m.group(2)]
+        pos = m.end()
+    return total
+
+
+class _Lexer:
+    _TOKEN = re.compile(
+        r"\s*(?:"
+        r"(?P<dur>\d+(?:\.\d+)?(?:ms|s|m|h|d|w|y)(?:\d+(?:\.\d+)?(?:ms|s|m|h|d|w|y))*)"
+        r"|(?P<num>\d+\.?\d*(?:[eE][+-]?\d+)?)"
+        r"|(?P<id>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"|(?P<str>\"(?:[^\"\\]|\\.)*\"|'(?:[^'\\]|\\.)*')"
+        r"|(?P<op>=~|!~|!=|==|>=|<=|[-+*/%^(){}\[\],=<>])"
+        r")"
+    )
+
+    def __init__(self, text: str):
+        self.text = text
+        self.pos = 0
+        self.toks: list[tuple[str, str]] = []
+        self._tokenize()
+        self.i = 0
+
+    def _tokenize(self):
+        n = len(self.text)
+        pos = 0
+        while pos < n:
+            if self.text[pos].isspace():
+                pos += 1
+                continue
+            m = self._TOKEN.match(self.text, pos)
+            if not m:
+                raise PromParseError(f"bad token at {pos}: {self.text[pos:pos+10]!r}")
+            if m.group("dur"):
+                self.toks.append(("DUR", m.group("dur")))
+            elif m.group("num"):
+                self.toks.append(("NUM", m.group("num")))
+            elif m.group("id"):
+                self.toks.append(("ID", m.group("id")))
+            elif m.group("str"):
+                raw = m.group("str")
+                self.toks.append(("STR", _unquote(raw)))
+            else:
+                self.toks.append(("OP", m.group("op")))
+            pos = m.end()
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else ("EOF", "")
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+
+def _unquote(raw: str) -> str:
+    body = raw[1:-1]
+    return body.replace('\\"', '"').replace("\\'", "'").replace("\\\\", "\\")
+
+
+_PREC = {"or": 1, "and": 2, "unless": 2, "==": 3, "!=": 3, "<": 3, ">": 3,
+         "<=": 3, ">=": 3, "+": 4, "-": 4, "*": 5, "/": 5, "%": 5, "^": 6}
+
+
+def parse(text: str):
+    lx = _Lexer(text)
+    expr = _parse_expr(lx, 1)
+    if lx.peek()[0] != "EOF":
+        raise PromParseError(f"unexpected trailing token {lx.peek()[1]!r}")
+    return expr
+
+
+def _parse_expr(lx: _Lexer, min_prec: int):
+    lhs = _parse_primary(lx)
+    while True:
+        kind, val = lx.peek()
+        op = None
+        if kind == "OP" and val in _PREC:
+            op = val
+        elif kind == "ID" and val in ("and", "or", "unless"):
+            op = val
+        if op is None or _PREC[op] < min_prec:
+            return lhs
+        lx.next()
+        # ^ is right-associative in PromQL; all others left-associative
+        next_min = _PREC[op] if op == "^" else _PREC[op] + 1
+        rhs = _parse_expr(lx, next_min)
+        lhs = BinaryOp(op, lhs, rhs)
+
+
+def _parse_primary(lx: _Lexer):
+    kind, val = lx.peek()
+    if kind == "NUM":
+        lx.next()
+        return NumberLit(float(val))
+    if kind == "OP" and val == "-":
+        lx.next()
+        # unary minus binds looser than ^ in PromQL: -2^2 == -(2^2)
+        inner = _parse_expr(lx, _PREC["^"])
+        return BinaryOp("*", NumberLit(-1.0), inner)
+    if kind == "OP" and val == "(":
+        lx.next()
+        e = _parse_expr(lx, 1)
+        _expect(lx, ")")
+        return _maybe_range(lx, e)
+    if kind == "OP" and val == "{":
+        vs = _parse_selector(lx, "")
+        return _maybe_range(lx, vs)
+    if kind == "ID":
+        lx.next()
+        if val in AGG_OPS:
+            return _parse_aggregation(lx, val)
+        if lx.peek() == ("OP", "(") and val in FUNCTIONS:
+            lx.next()
+            args = []
+            if lx.peek() != ("OP", ")"):
+                args.append(_parse_expr(lx, 1))
+                while lx.peek() == ("OP", ","):
+                    lx.next()
+                    args.append(_parse_expr(lx, 1))
+            _expect(lx, ")")
+            return FunctionCall(val, args)
+        return _maybe_range(lx, _parse_selector(lx, val))
+    raise PromParseError(f"unexpected token {val!r}")
+
+
+def _parse_selector(lx: _Lexer, metric: str) -> VectorSelector:
+    matchers: list[LabelMatcher] = []
+    if lx.peek() == ("OP", "{"):
+        lx.next()
+        while lx.peek() != ("OP", "}"):
+            kind, name = lx.next()
+            if kind != "ID":
+                raise PromParseError(f"expected label name, got {name!r}")
+            okind, op = lx.next()
+            if okind != "OP" or op not in ("=", "!=", "=~", "!~"):
+                raise PromParseError(f"bad matcher op {op!r}")
+            skind, sval = lx.next()
+            if skind != "STR":
+                raise PromParseError("matcher value must be a string")
+            matchers.append(LabelMatcher(name, op, sval))
+            if lx.peek() == ("OP", ","):
+                lx.next()
+        _expect(lx, "}")
+    vs = VectorSelector(metric, matchers)
+    if lx.peek() == ("ID", "offset"):
+        lx.next()
+        kind, d = lx.next()
+        if kind != "DUR":
+            raise PromParseError("offset expects a duration")
+        vs.offset_s = parse_duration_s(d)
+    return vs
+
+
+def _maybe_range(lx: _Lexer, expr):
+    if lx.peek() == ("OP", "["):
+        lx.next()
+        kind, d = lx.next()
+        if kind != "DUR":
+            raise PromParseError("range selector expects a duration")
+        _expect(lx, "]")
+        if not isinstance(expr, VectorSelector):
+            raise PromParseError("range selector requires a vector selector")
+        ms = MatrixSelector(expr, parse_duration_s(d))
+        if lx.peek() == ("ID", "offset"):
+            lx.next()
+            k2, d2 = lx.next()
+            if k2 != "DUR":
+                raise PromParseError("offset expects a duration")
+            expr.offset_s = parse_duration_s(d2)
+        return ms
+    return expr
+
+
+def _parse_aggregation(lx: _Lexer, op: str) -> Aggregation:
+    agg = Aggregation(op)
+    # by/without before parens
+    if lx.peek() in (("ID", "by"), ("ID", "without")):
+        agg.without = lx.next()[1] == "without"
+        agg.grouping = _parse_grouping(lx)
+    _expect(lx, "(")
+    first = _parse_expr(lx, 1)
+    if lx.peek() == ("OP", ","):
+        lx.next()
+        agg.param = first
+        agg.expr = _parse_expr(lx, 1)
+    else:
+        agg.expr = first
+    _expect(lx, ")")
+    if lx.peek() in (("ID", "by"), ("ID", "without")):
+        agg.without = lx.next()[1] == "without"
+        agg.grouping = _parse_grouping(lx)
+    return agg
+
+
+def _parse_grouping(lx: _Lexer) -> list[str]:
+    _expect(lx, "(")
+    names = []
+    while lx.peek() != ("OP", ")"):
+        kind, v = lx.next()
+        if kind != "ID":
+            raise PromParseError(f"expected label, got {v!r}")
+        names.append(v)
+        if lx.peek() == ("OP", ","):
+            lx.next()
+    _expect(lx, ")")
+    return names
+
+
+def _expect(lx: _Lexer, op: str):
+    kind, val = lx.next()
+    if kind != "OP" or val != op:
+        raise PromParseError(f"expected {op!r}, got {val!r}")

@@ -75,7 +75,7 @@ class Executor:
                         f"{type(stmt).__name__} queries must be sent via POST"
                     )
                 res = self.execute_statement(stmt, db, now_ns)
-            except (QueryError, cond.ConditionError, KeyError, ValueError) as e:
+            except (QueryError, cond.ConditionError, KeyError, ValueError, re.error) as e:
                 res = {"error": str(e)}
             res["statement_id"] = i
             results.append(res)

@@ -1,9 +1,12 @@
 """InfluxDB 1.x-compatible HTTP API.
 
-Reference routes (lib/util/lifted/influx/httpd/handler.go:257-280):
+Reference routes (lib/util/lifted/influx/httpd/handler.go:257-280 and
+handler_prom.go:86-312):
   GET/POST /query      InfluxQL, params q/db/epoch/pretty/chunked(ignored)
   POST     /write      line protocol, params db/rp/precision
   POST     /api/v2/write  bucket=db[/rp], precision
+  GET/POST /api/v1/query, /api/v1/query_range   PromQL (params db opt.)
+  GET      /api/v1/labels, /api/v1/label/<name>/values
   GET      /ping, /health
 Auth and TLS are deferred to the cluster round; this is the ts-server
 single-node surface.
@@ -13,12 +16,15 @@ from __future__ import annotations
 
 import gzip
 import json
+import re
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from opengemini_tpu import __version__
 from opengemini_tpu.ingest.line_protocol import ParseError
+from opengemini_tpu.promql.engine import PromEngine, PromError
+from opengemini_tpu.promql.parser import PromParseError, parse_duration_s
 from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query.executor import Executor
 from opengemini_tpu.record import FieldTypeConflict
@@ -28,12 +34,41 @@ _EPOCH_DIV = {"ns": 1, "u": 1_000, "µ": 1_000, "ms": 1_000_000, "s": 1_000_000_
               "m": 60_000_000_000, "h": 3_600_000_000_000}
 
 
+def time_now_s() -> float:
+    import time as _t
+
+    return _t.time()
+
+
+def _prom_time(s: str | None) -> float:
+    """Prom API time param: unix seconds (float) or RFC3339."""
+    if s is None:
+        raise ValueError("missing time parameter")
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return cond.parse_rfc3339(s) / 1e9
+
+
+def _prom_step(s: str | None) -> float:
+    if s is None:
+        raise ValueError("missing step parameter")
+    try:
+        return float(s)
+    except ValueError:
+        return parse_duration_s(s)
+
+
 class HttpService:
     """Owns the HTTP listener; one Engine + Executor behind it."""
 
-    def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 8086):
+    def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 8086,
+                 prom_db: str = "prom"):
         self.engine = engine
         self.executor = Executor(engine)
+        self.prom = PromEngine(engine)
+        self.prom_db = prom_db
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -115,20 +150,24 @@ def _make_handler(svc: HttpService):
                                       "version": __version__})
             elif path == "/query":
                 self._handle_query(self._params(), read_only=True)
+            elif path.startswith("/api/v1/"):
+                self._handle_prom(path, self._params())
             else:
                 self._send_json(404, {"error": "not found"})
+
+        def _merge_form_body(self, params: dict) -> None:
+            body = self._body().decode("utf-8", errors="replace")
+            if body and self.headers.get("Content-Type", "").startswith(
+                "application/x-www-form-urlencoded"
+            ):
+                for k, v in urllib.parse.parse_qs(body).items():
+                    params.setdefault(k, v[-1])
 
         def do_POST(self):
             path = urllib.parse.urlparse(self.path).path
             params = self._params()
             if path == "/query":
-                body = self._body().decode("utf-8", errors="replace")
-                if body and self.headers.get("Content-Type", "").startswith(
-                    "application/x-www-form-urlencoded"
-                ):
-                    form = urllib.parse.parse_qs(body)
-                    for k, v in form.items():
-                        params.setdefault(k, v[-1])
+                self._merge_form_body(params)
                 self._handle_query(params)
             elif path == "/write":
                 self._handle_write(params, db=params.get("db", ""),
@@ -137,6 +176,9 @@ def _make_handler(svc: HttpService):
                 bucket = params.get("bucket", "")
                 db, _, rp = bucket.partition("/")
                 self._handle_write(params, db=db, rp=rp or None)
+            elif path.startswith("/api/v1/"):
+                self._merge_form_body(params)
+                self._handle_prom(path, params)
             else:
                 self._send_json(404, {"error": "not found"})
 
@@ -149,6 +191,57 @@ def _make_handler(svc: HttpService):
             epoch = params.get("epoch")
             pretty = params.get("pretty") in ("true", "1")
             self._send_json(200, format_result(result, epoch), pretty)
+
+        def _handle_prom(self, path: str, params: dict):
+            """Prometheus HTTP API v1 (reference: handler_prom.go)."""
+            db = params.get("db", svc.prom_db)
+            try:
+                if path == "/api/v1/query_range":
+                    data = svc.prom.query_range(
+                        params.get("query", ""),
+                        _prom_time(params.get("start")),
+                        _prom_time(params.get("end")),
+                        _prom_step(params.get("step")),
+                        db,
+                    )
+                elif path == "/api/v1/query":
+                    t = params.get("time")
+                    data = svc.prom.query_instant(
+                        params.get("query", ""),
+                        _prom_time(t) if t else time_now_s(),
+                        db,
+                    )
+                elif path == "/api/v1/labels":
+                    data = self._prom_labels(db)
+                elif path.startswith("/api/v1/label/") and path.endswith("/values"):
+                    name = path[len("/api/v1/label/") : -len("/values")]
+                    data = self._prom_label_values(db, name)
+                else:
+                    self._send_json(404, {"status": "error", "error": "not found"})
+                    return
+            except (PromError, PromParseError, ValueError, OverflowError, re.error) as e:
+                self._send_json(
+                    400, {"status": "error", "errorType": "bad_data", "error": str(e)}
+                )
+                return
+            self._send_json(200, {"status": "success", "data": data})
+
+        def _prom_labels(self, db):
+            names = {"__name__"}
+            for sh in svc.engine.shards_for_range(db, None, -(2**62), 2**62):
+                for mst in sh.measurements():
+                    names.update(sh.index.tag_keys(mst))
+            return sorted(names)
+
+        def _prom_label_values(self, db, name):
+            vals = set()
+            for sh in svc.engine.shards_for_range(db, None, -(2**62), 2**62):
+                for mst in sh.measurements():
+                    if name == "__name__":
+                        vals.add(mst)
+                    else:
+                        vals.update(sh.index.tag_values(mst, name))
+            return sorted(vals)
 
         def _handle_write(self, params: dict, db: str, rp):
             if not db:

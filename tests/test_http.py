@@ -142,3 +142,41 @@ def test_ddl_over_http_post_only(server):
 def test_missing_q_param(server):
     status, body = get(server, "/query", db="db")
     assert status == 400
+
+
+def test_prom_query_range_over_http(server):
+    server.engine.create_database("prom")
+    lines = "\n".join(
+        f"http_requests_total,instance=a value={i*30} {(BASE + i*15) * NS}"
+        for i in range(40)
+    )
+    post(server, "/write", lines.encode(), db="prom")
+    status, body = get(
+        server, "/api/v1/query_range",
+        query="rate(http_requests_total[2m])",
+        start=str(BASE + 300), end=str(BASE + 480), step="60",
+    )
+    assert status == 200
+    data = json.loads(body)
+    assert data["status"] == "success"
+    [r] = data["data"]["result"]
+    assert r["metric"]["instance"] == "a"
+    assert float(r["values"][0][1]) == pytest.approx(2.0, rel=1e-6)
+
+
+def test_prom_instant_and_labels(server):
+    server.engine.create_database("prom")
+    post(server, "/write", f"up,job=api value=1 {BASE * NS}".encode(), db="prom")
+    status, body = get(server, "/api/v1/query", query="up", time=str(BASE + 10))
+    data = json.loads(body)
+    assert data["data"]["result"][0]["value"][1] == "1.0"
+    _, body = get(server, "/api/v1/labels")
+    assert "job" in json.loads(body)["data"]
+    _, body = get(server, "/api/v1/label/__name__/values")
+    assert "up" in json.loads(body)["data"]
+
+
+def test_prom_bad_query_400(server):
+    status, body = get(server, "/api/v1/query", query="rate(", time="0")
+    assert status == 400
+    assert json.loads(body)["status"] == "error"

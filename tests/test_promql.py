@@ -1,0 +1,304 @@
+"""PromQL tests: parser, rate semantics vs a pure-python Prometheus oracle,
+engine end-to-end over the storage engine."""
+
+import math
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.ops import prom as promops
+from opengemini_tpu.promql import parser as pp
+from opengemini_tpu.promql.engine import PromEngine
+from opengemini_tpu.storage.engine import Engine, NS
+
+BASE = 1_700_000_000
+
+
+# -- oracle: prometheus promql/functions.go extrapolatedRate ----------------
+
+
+def prom_rate_oracle(times_s, values, t_end, window, is_counter=True, is_rate=True):
+    sel = [(t, v) for t, v in zip(times_s, values) if t_end - window < t <= t_end]
+    if len(sel) < 2:
+        return None
+    ts = [t for t, _ in sel]
+    vs = [v for _, v in sel]
+    delta = vs[-1] - vs[0]
+    if is_counter:
+        for i in range(1, len(vs)):
+            if vs[i] < vs[i - 1]:
+                delta += vs[i - 1]
+    sampled = ts[-1] - ts[0]
+    avg_iv = sampled / (len(sel) - 1)
+    dur_start = ts[0] - (t_end - window)
+    dur_end = t_end - ts[-1]
+    thresh = avg_iv * 1.1
+    if dur_start > thresh:
+        dur_start = avg_iv / 2
+    if dur_end > thresh:
+        dur_end = avg_iv / 2
+    if is_counter and delta > 0 and vs[0] >= 0:
+        dur_zero = sampled * (vs[0] / delta)
+        if dur_zero < dur_start:
+            dur_start = dur_zero
+    factor = (sampled + dur_start + dur_end) / sampled
+    out = delta * factor
+    if is_rate:
+        out /= window
+    return out
+
+
+class TestParser:
+    def test_selector_with_matchers(self):
+        e = pp.parse('http_requests_total{job="api", code=~"5.."}')
+        assert isinstance(e, pp.VectorSelector)
+        assert e.metric == "http_requests_total"
+        assert e.matchers[0] == pp.LabelMatcher("job", "=", "api")
+        assert e.matchers[1].op == "=~"
+
+    def test_rate_range(self):
+        e = pp.parse("rate(http_requests_total[5m])")
+        assert isinstance(e, pp.FunctionCall) and e.name == "rate"
+        assert isinstance(e.args[0], pp.MatrixSelector)
+        assert e.args[0].range_s == 300.0
+
+    def test_aggregation_by(self):
+        e = pp.parse("sum by (job) (rate(m[1m]))")
+        assert isinstance(e, pp.Aggregation)
+        assert e.op == "sum" and e.grouping == ["job"]
+        e2 = pp.parse("sum(rate(m[1m])) by (job)")
+        assert e2.grouping == ["job"]
+
+    def test_binary_and_precedence(self):
+        e = pp.parse("a + b * 2")
+        assert isinstance(e, pp.BinaryOp) and e.op == "+"
+        assert isinstance(e.rhs, pp.BinaryOp) and e.rhs.op == "*"
+
+    def test_topk(self):
+        e = pp.parse("topk(3, rate(m[5m]))")
+        assert e.op == "topk" and isinstance(e.param, pp.NumberLit)
+
+    def test_durations(self):
+        assert pp.parse_duration_s("1h30m") == 5400.0
+        assert pp.parse_duration_s("500ms") == 0.5
+
+    def test_offset(self):
+        e = pp.parse('m{a="b"} offset 5m')
+        assert e.offset_s == 300.0
+
+    @pytest.mark.parametrize("bad", ["rate(", "m{a=}", "sum by (", "m[xyz]"])
+    def test_errors(self, bad):
+        with pytest.raises(pp.PromParseError):
+            pp.parse(bad)
+
+
+class TestRateKernel:
+    @pytest.mark.parametrize("is_counter,is_rate", [(True, True), (True, False), (False, False)])
+    def test_extrapolated_rate_matches_oracle(self, rng, is_counter, is_rate):
+        # irregular scrape times + counter resets
+        n = 50
+        times_s = np.sort(rng.uniform(0, 600, n))
+        if is_counter:
+            vals = np.cumsum(rng.uniform(0, 10, n))
+            vals[30:] -= vals[30] * 0.9  # reset
+        else:
+            vals = rng.normal(size=n) * 10
+        window = 120.0
+        step_ends = np.arange(150.0, 600.0, 60.0)
+        samples = [(np.asarray(times_s * 1000, dtype=np.int64), vals)]
+        t, v, c, base_ms = promops.prepare_matrix(samples, dtype=np.float64)
+        # oracle uses ms-truncated times like the kernel input
+        times_trunc = np.asarray(times_s * 1000, dtype=np.int64) / 1000.0
+        out, valid = promops.extrapolated_rate(
+            t, v, c, step_ends - window - base_ms / 1000, step_ends - base_ms / 1000,
+            window, is_counter, is_rate,
+        )
+        out, valid = np.asarray(out), np.asarray(valid)
+        for k, te in enumerate(step_ends):
+            ref = prom_rate_oracle(times_trunc, vals, te, window, is_counter, is_rate)
+            if ref is None:
+                assert not valid[0, k]
+            else:
+                assert valid[0, k]
+                assert out[0, k] == pytest.approx(ref, rel=1e-9)
+
+    def test_over_time(self, rng):
+        times_s = np.arange(0, 300, 10.0)
+        vals = rng.normal(size=len(times_s))
+        samples = [(np.asarray(times_s * 1000, np.int64), vals)]
+        t, v, c, base = promops.prepare_matrix(samples, dtype=np.float64)
+        ends = np.array([100.0, 200.0])
+        starts = ends - 60.0
+        for func, ref_fn in (
+            ("avg", np.mean), ("min", np.min), ("max", np.max), ("sum", np.sum),
+        ):
+            out, valid = promops.over_time(t, v, c, starts, ends, func)
+            for k, te in enumerate(ends):
+                sel = vals[(times_s > te - 60) & (times_s <= te)]
+                assert np.asarray(out)[0, k] == pytest.approx(ref_fn(sel))
+
+
+@pytest.fixture
+def prom_env(tmp_path):
+    e = Engine(str(tmp_path / "data"))
+    e.create_database("prom")
+    yield e, PromEngine(e)
+    e.close()
+
+
+def write_counter(e, series: dict[str, list], start=BASE, step=15):
+    """series: label-value -> list of counter values."""
+    lines = []
+    for inst, vals in series.items():
+        for i, v in enumerate(vals):
+            lines.append(
+                f"http_requests_total,instance={inst},job=api value={v} "
+                f"{(start + i * step) * NS}"
+            )
+    e.write_lines("prom", "\n".join(lines))
+
+
+class TestEngine:
+    def test_instant_vector(self, prom_env):
+        e, pe = prom_env
+        write_counter(e, {"a": [1, 2, 3], "b": [10, 20, 30]})
+        data = pe.query_instant('http_requests_total{instance="a"}', BASE + 31, "prom")
+        assert data["resultType"] == "vector"
+        [r] = data["result"]
+        assert r["metric"]["instance"] == "a"
+        assert r["value"][1] == "3.0"
+
+    def test_rate_range_query(self, prom_env):
+        e, pe = prom_env
+        # steady 2/sec counter, 15s scrapes over 10 min
+        n = 40
+        write_counter(e, {"a": [i * 30 for i in range(n)]})
+        data = pe.query_range(
+            "rate(http_requests_total[2m])", BASE + 300, BASE + 480, 60, "prom"
+        )
+        assert data["resultType"] == "matrix"
+        [r] = data["result"]
+        for t, v in r["values"]:
+            assert float(v) == pytest.approx(2.0, rel=1e-6)
+
+    def test_sum_by_job(self, prom_env):
+        e, pe = prom_env
+        write_counter(e, {"a": [0, 60], "b": [0, 120]})
+        data = pe.query_range(
+            "sum by (job) (rate(http_requests_total[2m]))",
+            BASE + 15, BASE + 15, 60, "prom",
+        )
+        [r] = data["result"]
+        assert r["metric"] == {"job": "api"}
+        # prom rate divides the (non-extrapolatable, zero-start-clamped)
+        # increase by the full 120s window: a=60/120, b=120/120
+        assert float(r["values"][0][1]) == pytest.approx(1.5, rel=1e-9)
+
+    def test_scalar_arith_and_comparison(self, prom_env):
+        e, pe = prom_env
+        write_counter(e, {"a": [5, 5, 5], "b": [1, 1, 1]})
+        data = pe.query_instant("http_requests_total * 2", BASE + 31, "prom")
+        vals = {r["metric"]["instance"]: float(r["value"][1]) for r in data["result"]}
+        assert vals == {"a": 10.0, "b": 2.0}
+        data = pe.query_instant("http_requests_total > 3", BASE + 31, "prom")
+        assert [r["metric"]["instance"] for r in data["result"]] == ["a"]
+
+    def test_vector_vector_binop(self, prom_env):
+        e, pe = prom_env
+        write_counter(e, {"a": [4], "b": [8]})
+        lines = [
+            f"errors_total,instance={i},job=api value={v} {BASE * NS}"
+            for i, v in (("a", 1), ("b", 2))
+        ]
+        e.write_lines("prom", "\n".join(lines))
+        data = pe.query_instant(
+            "errors_total / http_requests_total", BASE + 10, "prom"
+        )
+        vals = {r["metric"]["instance"]: float(r["value"][1]) for r in data["result"]}
+        assert vals == {"a": 0.25, "b": 0.25}
+
+    def test_topk(self, prom_env):
+        e, pe = prom_env
+        write_counter(e, {"a": [1], "b": [9], "c": [5]})
+        data = pe.query_instant("topk(2, http_requests_total)", BASE + 10, "prom")
+        insts = sorted(r["metric"]["instance"] for r in data["result"])
+        assert insts == ["b", "c"]
+
+    def test_regex_matcher(self, prom_env):
+        e, pe = prom_env
+        write_counter(e, {"web1": [1], "web2": [2], "db1": [3]})
+        data = pe.query_instant(
+            'http_requests_total{instance=~"web.*"}', BASE + 10, "prom"
+        )
+        assert len(data["result"]) == 2
+
+    def test_stale_series_excluded(self, prom_env):
+        e, pe = prom_env
+        write_counter(e, {"a": [1]})  # single sample at BASE
+        data = pe.query_instant("http_requests_total", BASE + 400, "prom")
+        assert data["result"] == []  # beyond 5m lookback
+
+
+class TestReviewRegressions:
+    def test_anchored_regex_matcher(self, prom_env):
+        e, pe = prom_env
+        write_counter(e, {"web1": [1], "web10": [2]})
+        data = pe.query_instant(
+            'http_requests_total{instance=~"web1"}', BASE + 10, "prom"
+        )
+        assert [r["metric"]["instance"] for r in data["result"]] == ["web1"]
+
+    def test_invalid_regex_is_prom_error(self, prom_env):
+        from opengemini_tpu.promql.engine import PromError
+
+        e, pe = prom_env
+        write_counter(e, {"a": [1]})
+        with pytest.raises(PromError):
+            pe.query_instant('http_requests_total{instance=~"["}', BASE + 10, "prom")
+
+    def test_infinite_range_is_prom_error(self, prom_env):
+        from opengemini_tpu.promql.engine import PromError
+
+        e, pe = prom_env
+        with pytest.raises(PromError):
+            pe.query_range("up", float("inf"), float("inf"), 60, "prom")
+
+    def test_power_right_associative_and_unary_minus(self, prom_env):
+        e, pe = prom_env
+        data = pe.query_instant("2^3^2", BASE, "prom")
+        assert float(data["result"][1]) == 512.0
+        data = pe.query_instant("-2^2", BASE, "prom")
+        assert float(data["result"][1]) == -4.0
+
+    def test_scalar_invalid_steps_are_nan(self, prom_env):
+        e, pe = prom_env
+        write_counter(e, {"a": [7]})  # one sample at BASE
+        data = pe.query_range("scalar(http_requests_total)", BASE + 600, BASE + 600, 60, "prom")
+        # beyond lookback: scalar must be NaN, not the stale sample;
+        # NaN points still render (prom scalar always yields a value)
+        [r] = data["result"]
+        assert r["values"][0][1] == "NaN"
+
+    def test_counter_negative_first_value_no_clamp(self, rng):
+        # negative v_first with delta > 0: prom skips the zero-crossing clamp
+        times_s = np.array([10.0, 20.0, 30.0])
+        vals = np.array([-5.0, 0.0, 5.0])
+        samples = [(np.asarray(times_s * 1000, np.int64), vals)]
+        t, v, c, base = promops.prepare_matrix(samples, dtype=np.float64)
+        ends = np.array([40.0]) - base / 1000  # kernel times are base-relative
+        out, valid = promops.extrapolated_rate(t, v, c, ends - 60, ends, 60.0, True, False)
+        ref = prom_rate_oracle(times_s, vals, 40.0, 60.0, True, False)
+        assert np.asarray(out)[0, 0] == pytest.approx(ref, rel=1e-12)
+
+    def test_over_time_prefix_path_with_nulls(self, rng):
+        # irregular counts across series exercise the cumsum/gather path
+        s1 = (np.array([1000, 3000, 5000], np.int64), np.array([1.0, 2.0, 3.0]))
+        s2 = (np.array([2000], np.int64), np.array([10.0]))
+        t, v, c, base = promops.prepare_matrix([s1, s2], dtype=np.float64)
+        ends = np.array([6.0]) - base / 1000
+        starts = ends - 10.0
+        out, valid = promops.over_time(t, v, c, starts, ends, "sum")
+        assert np.asarray(out)[0, 0] == 6.0
+        assert np.asarray(out)[1, 0] == 10.0
+        out, valid = promops.over_time(t, v, c, starts, ends, "count")
+        assert np.asarray(out)[0, 0] == 3 and np.asarray(out)[1, 0] == 1
