@@ -25,7 +25,7 @@ from opengemini_tpu.models import templates
 from opengemini_tpu.ops import aggregates as aggmod
 from opengemini_tpu.ops import window as winmod
 from opengemini_tpu.query import condition as cond
-from opengemini_tpu.record import FieldType
+from opengemini_tpu.record import FieldType, FieldTypeConflict
 from opengemini_tpu.sql import ast
 from opengemini_tpu.sql.parser import parse
 
@@ -46,7 +46,15 @@ _READONLY_STMTS = (
     ast.ShowFieldKeys,
     ast.ShowSeries,
     ast.ShowRetentionPolicies,
+    ast.ShowContinuousQueries,
 )
+
+
+def _is_readonly(stmt) -> bool:
+    if not isinstance(stmt, _READONLY_STMTS):
+        return False
+    # SELECT ... INTO mutates
+    return not (isinstance(stmt, ast.SelectStatement) and stmt.into is not None)
 
 
 class Executor:
@@ -70,12 +78,15 @@ class Executor:
         results = []
         for i, stmt in enumerate(stmts):
             try:
-                if read_only and not isinstance(stmt, _READONLY_STMTS):
+                if read_only and not _is_readonly(stmt):
                     raise QueryError(
                         f"{type(stmt).__name__} queries must be sent via POST"
                     )
                 res = self.execute_statement(stmt, db, now_ns)
-            except (QueryError, cond.ConditionError, KeyError, ValueError, re.error) as e:
+            except (
+                QueryError, cond.ConditionError, KeyError, ValueError,
+                re.error, FieldTypeConflict,
+            ) as e:
                 res = {"error": str(e)}
             res["statement_id"] = i
             results.append(res)
@@ -120,6 +131,27 @@ class Executor:
                 del d.rps[stmt.name]
                 self.engine._save_meta()
             return {}
+        if isinstance(stmt, ast.CreateContinuousQuery):
+            from opengemini_tpu.storage.engine import ContinuousQuery
+
+            self.engine.create_continuous_query(
+                stmt.database or db,
+                ContinuousQuery(
+                    stmt.name, stmt.select_text,
+                    stmt.resample_every_ns, stmt.resample_for_ns,
+                ),
+            )
+            return {}
+        if isinstance(stmt, ast.DropContinuousQuery):
+            self.engine.drop_continuous_query(stmt.database or db, stmt.name)
+            return {}
+        if isinstance(stmt, ast.ShowContinuousQueries):
+            series = []
+            for name in sorted(self.engine.databases):
+                d = self.engine.databases[name]
+                rows = [[cq.name, cq.select_text] for cq in d.continuous_queries.values()]
+                series.append(_series(name, None, ["name", "query"], rows))
+            return {"series": series} if series else {}
         if isinstance(stmt, ast.DropMeasurement):
             raise QueryError("DROP MEASUREMENT is not supported yet")
         raise QueryError(f"unsupported statement: {type(stmt).__name__}")
@@ -130,8 +162,6 @@ class Executor:
         for src in stmt.sources:
             if isinstance(src, ast.SubQuery):
                 raise QueryError("subqueries are not supported yet")
-        if stmt.into is not None:
-            raise QueryError("SELECT INTO is not supported yet")
 
         all_series = []
         for src in stmt.sources:
@@ -150,9 +180,44 @@ class Executor:
             all_series = all_series[stmt.soffset :]
         if stmt.slimit:
             all_series = all_series[: stmt.slimit]
+        if stmt.into is not None:
+            written = self._write_into(stmt.into, db, all_series)
+            return _series_result("result", None, ["time", "written"], [[0, written]])
         if not all_series:
             return {}
         return {"series": all_series}
+
+    def _write_into(self, target: ast.Measurement, db: str, series_list: list[dict]) -> int:
+        """SELECT INTO: write result rows into the target measurement
+        (reference: into clause handling in statement_executor.go). Rows go
+        through the structured write path (WAL'd, schema-checked) — never
+        through line-protocol text, so arbitrary tag/field content is safe."""
+        tgt_db = target.database or db
+        if tgt_db not in self.engine.databases:
+            raise QueryError(f"database not found: {tgt_db}")
+        points = []
+        for series in series_list:
+            tags = tuple(sorted(series.get("tags", {}).items()))
+            cols = series["columns"][1:]
+            for row in series["values"]:
+                t, vals = row[0], row[1:]
+                fields = {}
+                for name, v in zip(cols, vals):
+                    if v is None:
+                        continue
+                    if isinstance(v, bool):
+                        fields[name] = (FieldType.BOOL, v)
+                    elif isinstance(v, int):
+                        fields[name] = (FieldType.INT, v)
+                    elif isinstance(v, float):
+                        fields[name] = (FieldType.FLOAT, v)
+                    else:
+                        fields[name] = (FieldType.STRING, str(v))
+                if fields:
+                    points.append((target.name, tags, t, fields))
+        if not points:
+            return 0
+        return self.engine.write_rows(tgt_db, points, rp=target.rp or None)
 
     def _resolve_measurements(self, src: ast.Measurement, db: str) -> list[str]:
         if src.name:

@@ -49,7 +49,24 @@ def build(cfg: dict) -> HttpService:
         flush_threshold_bytes=int(data.get("flush-threshold-mb", 64)) << 20,
     )
     host, _, port = cfg["http"]["bind-address"].partition(":")
-    return HttpService(engine, host or "127.0.0.1", int(port or 8086))
+    svc = HttpService(engine, host or "127.0.0.1", int(port or 8086))
+    svc.services = _build_services(cfg, svc)
+    return svc
+
+
+def _build_services(cfg: dict, svc: HttpService) -> list:
+    from opengemini_tpu.services.continuous import ContinuousQueryService
+    from opengemini_tpu.services.downsample import DownsampleService
+    from opengemini_tpu.services.retention import RetentionService
+
+    sc = cfg.get("services", {})
+    return [
+        RetentionService(svc.engine, float(sc.get("retention-interval-s", 1800))),
+        DownsampleService(svc.engine, float(sc.get("downsample-interval-s", 3600))),
+        ContinuousQueryService(
+            svc.engine, svc.executor, float(sc.get("cq-interval-s", 10))
+        ),
+    ]
 
 
 def main(argv=None) -> int:
@@ -59,6 +76,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     svc = build(load_config(args.config))
     svc.start()
+    for s in svc.services:
+        s.start()
     if args.pidfile:
         with open(args.pidfile, "w", encoding="utf-8") as f:
             f.write(str(os.getpid()))
@@ -68,6 +87,8 @@ def main(argv=None) -> int:
         signal.signal(sig, lambda *_: stop_event.set())
     stop_event.wait()
     print("shutting down", flush=True)
+    for s in svc.services:
+        s.stop()
     svc.stop()
     svc.engine.close()
     if args.pidfile:

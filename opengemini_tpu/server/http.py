@@ -69,6 +69,7 @@ class HttpService:
         self.executor = Executor(engine)
         self.prom = PromEngine(engine)
         self.prom_db = prom_db
+        self.services: list = []  # populated by server.app.build
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]

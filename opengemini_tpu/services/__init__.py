@@ -1,0 +1,4 @@
+"""Background services (reference: services/ — retention, downsample,
+continuousquery, stream, ... driven per-node from services/base.go)."""
+
+from opengemini_tpu.services.base import Service  # noqa: F401

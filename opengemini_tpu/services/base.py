@@ -1,0 +1,49 @@
+"""Service base: an interval-ticked background worker.
+
+Reference: services/base.go — every service is a ticker loop with
+open/close lifecycle; errors are logged, never fatal to the process.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+logger = logging.getLogger("opengemini_tpu.services")
+
+
+class Service:
+    name = "service"
+
+    def __init__(self, interval_s: float):
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def handle(self) -> None:  # override
+        raise NotImplementedError
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"svc-{self.name}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def tick(self) -> None:
+        """Run one iteration synchronously (tests and manual triggers)."""
+        self.handle()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.handle()
+            except Exception:  # noqa: BLE001 — service loops never die
+                logger.exception("service %s tick failed", self.name)

@@ -1,0 +1,84 @@
+"""Continuous query scheduler.
+
+Reference: services/continuousquery/service.go:53-130 — on each tick, run
+every CQ whose next window has closed, executing its SELECT ... INTO over
+the newly-closed GROUP BY time windows. The reference coordinates CQ
+leases across sql nodes via meta; single-process mode has no contention,
+the lease hook lands with the cluster round.
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _time
+
+from opengemini_tpu.ops import window as winmod
+from opengemini_tpu.services.base import Service
+from opengemini_tpu.sql import ast
+from opengemini_tpu.sql.parser import parse_one
+
+logger = logging.getLogger("opengemini_tpu.services.cq")
+
+
+class ContinuousQueryService(Service):
+    name = "continuousquery"
+
+    def __init__(self, engine, executor, interval_s: float = 10.0):
+        super().__init__(interval_s)
+        self.engine = engine
+        self.executor = executor
+
+    def handle(self, now_ns: int | None = None) -> int:
+        if now_ns is None:
+            now_ns = _time.time_ns()
+        ran = 0
+        dirty = False
+        for db_name, db in list(self.engine.databases.items()):
+            for cq in list(db.continuous_queries.values()):
+                try:
+                    if self._run_cq(db_name, cq, now_ns):
+                        ran += 1
+                        dirty = True
+                except Exception:  # noqa: BLE001 — one bad CQ never starves the rest
+                    logger.exception("CQ %s.%s failed", db_name, cq.name)
+        if dirty:
+            self.engine.save_cq_state()
+        return ran
+
+    def _run_cq(self, db: str, cq, now_ns: int) -> bool:
+        stmt = parse_one(cq.select_text)
+        if not isinstance(stmt, ast.SelectStatement) or stmt.group_by_time is None:
+            return False
+        every = stmt.group_by_time.every_ns
+        offset = stmt.group_by_time.offset_ns
+        run_every = cq.resample_every_ns or every
+        # windows that have fully closed since the last run; influx defaults
+        # FOR to max(EVERY, interval) so EVERY > interval misses no windows
+        end = int(winmod.window_start(now_ns, every, offset))
+        lookback = cq.resample_for_ns or max(run_every, every)
+        start = max(
+            end - lookback,
+            int(winmod.window_start(cq.last_run_ns, every, offset)) if cq.last_run_ns else end - lookback,
+        )
+        if end <= start or (cq.last_run_ns and now_ns - cq.last_run_ns < run_every):
+            return False
+        bounded = _with_time_bounds(stmt, start, end)
+        self.executor.execute_statement(bounded, db, now_ns)
+        cq.last_run_ns = now_ns
+        return True
+
+
+def _with_time_bounds(stmt: ast.SelectStatement, start_ns: int, end_ns: int):
+    """AND the CQ's WHERE with [start, end) — the window injection the
+    reference does when materializing CQ runs."""
+    bound = ast.BinaryExpr(
+        "AND",
+        ast.BinaryExpr(">=", ast.VarRef("time"), ast.IntegerLiteral(start_ns)),
+        ast.BinaryExpr("<", ast.VarRef("time"), ast.IntegerLiteral(end_ns)),
+    )
+    cond = bound if stmt.condition is None else ast.BinaryExpr("AND", stmt.condition, bound)
+    import copy
+
+    out = copy.copy(stmt)
+    out.condition = cond
+    return out

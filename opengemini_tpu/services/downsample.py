@@ -1,0 +1,18 @@
+"""Downsample service (reference: services/downsample/service.go:29-56):
+periodically finds shards past their policy age and rewrites them at the
+coarser resolution via the TPU batch path (storage/downsample.py)."""
+
+from __future__ import annotations
+
+from opengemini_tpu.services.base import Service
+
+
+class DownsampleService(Service):
+    name = "downsample"
+
+    def __init__(self, engine, interval_s: float = 3600.0):
+        super().__init__(interval_s)
+        self.engine = engine
+
+    def handle(self) -> None:
+        self.engine.run_downsample()

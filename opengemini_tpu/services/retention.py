@@ -1,0 +1,16 @@
+"""Retention enforcement service (reference: services/retention/service.go:81)."""
+
+from __future__ import annotations
+
+from opengemini_tpu.services.base import Service
+
+
+class RetentionService(Service):
+    name = "retention"
+
+    def __init__(self, engine, interval_s: float = 1800.0):
+        super().__init__(interval_s)
+        self.engine = engine
+
+    def handle(self) -> None:
+        self.engine.drop_expired_shards()

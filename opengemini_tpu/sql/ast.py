@@ -230,3 +230,24 @@ class DropRetentionPolicy:
 @dataclass
 class DropMeasurement:
     name: str = ""
+
+
+@dataclass
+class CreateContinuousQuery:
+    name: str = ""
+    database: str = ""
+    select: "SelectStatement | None" = None
+    select_text: str = ""  # raw SELECT source, persisted in meta
+    resample_every_ns: int = 0
+    resample_for_ns: int = 0
+
+
+@dataclass
+class DropContinuousQuery:
+    name: str = ""
+    database: str = ""
+
+
+@dataclass
+class ShowContinuousQueries:
+    pass

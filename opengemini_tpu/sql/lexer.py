@@ -17,7 +17,8 @@ KEYWORDS = {
     "series", "retention", "policies", "policy", "create", "drop", "database",
     "with", "key", "in", "on", "duration", "replication", "shard", "default",
     "into", "true", "false", "null", "none", "previous", "linear", "tz",
-    "measurement", "delete", "as", "name",
+    "measurement", "delete", "as", "name", "continuous", "query", "queries",
+    "begin", "end", "resample", "every", "for",
 }
 
 _DUR_RE = re.compile(r"(\d+)(ns|u|µ|us|ms|s|m|h|d|w)")

@@ -417,15 +417,49 @@ class Parser:
             if self._accept_kw("on"):
                 s.database = self._ident()
             return s
+        if kw.val == "continuous":
+            self._expect_kw("queries")
+            return ast.ShowContinuousQueries()
         raise ParseError(f"unsupported SHOW {kw.val!r}")
 
     # -- CREATE / DROP ------------------------------------------------------
 
     def parse_create(self):
         self._expect_kw("create")
-        kw = self._expect_kw("database", "retention")
+        kw = self._expect_kw("database", "retention", "continuous")
         if kw == "database":
             return ast.CreateDatabase(self._ident())
+        if kw == "continuous":
+            self._expect_kw("query")
+            name = self._ident()
+            self._expect_kw("on")
+            db = self._ident()
+            stmt = ast.CreateContinuousQuery(name=name, database=db)
+            if self._accept_kw("resample"):
+                while True:
+                    if self._accept_kw("every"):
+                        t = self.lex.next()
+                        if t.kind != "DURATION":
+                            raise ParseError("RESAMPLE EVERY expects a duration")
+                        stmt.resample_every_ns = t.val
+                    elif self._accept_kw("for"):
+                        t = self.lex.next()
+                        if t.kind != "DURATION":
+                            raise ParseError("RESAMPLE FOR expects a duration")
+                        stmt.resample_for_ns = t.val
+                    else:
+                        break
+            self._expect_kw("begin")
+            start_pos = self.lex.peek().pos
+            stmt.select = self.parse_select()
+            end_tok = self.lex.peek()
+            stmt.select_text = self.lex.text[start_pos : end_tok.pos].strip()
+            self._expect_kw("end")
+            if stmt.select.into is None:
+                raise ParseError("continuous query requires an INTO clause")
+            if stmt.select.group_by_time is None:
+                raise ParseError("continuous query requires GROUP BY time(...)")
+            return stmt
         self._expect_kw("policy")
         name = self._ident()
         self._expect_kw("on")
@@ -457,11 +491,16 @@ class Parser:
 
     def parse_drop(self):
         self._expect_kw("drop")
-        kw = self._expect_kw("database", "retention", "measurement")
+        kw = self._expect_kw("database", "retention", "measurement", "continuous")
         if kw == "database":
             return ast.DropDatabase(self._ident())
         if kw == "measurement":
             return ast.DropMeasurement(self._ident())
+        if kw == "continuous":
+            self._expect_kw("query")
+            name = self._ident()
+            self._expect_kw("on")
+            return ast.DropContinuousQuery(name=name, database=self._ident())
         self._expect_kw("policy")
         name = self._ident()
         self._expect_kw("on")

@@ -39,11 +39,60 @@ class RetentionPolicy:
         return cls(j["name"], j["duration_ns"], j["shard_duration_ns"])
 
 
+class ContinuousQuery:
+    """A registered CQ (reference: meta data model continuous queries +
+    services/continuousquery scheduler)."""
+
+    def __init__(self, name: str, select_text: str, resample_every_ns: int = 0,
+                 resample_for_ns: int = 0, last_run_ns: int = 0):
+        self.name = name
+        self.select_text = select_text
+        self.resample_every_ns = resample_every_ns
+        self.resample_for_ns = resample_for_ns
+        self.last_run_ns = last_run_ns
+
+    def to_json(self):
+        return {
+            "name": self.name,
+            "select_text": self.select_text,
+            "resample_every_ns": self.resample_every_ns,
+            "resample_for_ns": self.resample_for_ns,
+            "last_run_ns": self.last_run_ns,
+        }
+
+    @classmethod
+    def from_json(cls, j):
+        return cls(j["name"], j["select_text"], j.get("resample_every_ns", 0),
+                   j.get("resample_for_ns", 0), j.get("last_run_ns", 0))
+
+
+class DownsamplePolicy:
+    """Shard-rewrite policy (reference: downsample policies in the meta data
+    model, engine_downsample.go): shards older than `age_ns` are rewritten
+    at `every_ns` resolution."""
+
+    def __init__(self, age_ns: int, every_ns: int, field_aggs: dict | None = None):
+        self.age_ns = age_ns
+        self.every_ns = every_ns
+        self.field_aggs = field_aggs or {}  # field type name -> agg name
+
+    def to_json(self):
+        return {"age_ns": self.age_ns, "every_ns": self.every_ns,
+                "field_aggs": self.field_aggs}
+
+    @classmethod
+    def from_json(cls, j):
+        return cls(j["age_ns"], j["every_ns"], j.get("field_aggs", {}))
+
+
 class Database:
     def __init__(self, name: str):
         self.name = name
         self.rps: dict[str, RetentionPolicy] = {}
         self.default_rp = "autogen"
+        self.continuous_queries: dict[str, ContinuousQuery] = {}
+        # rp name -> [DownsamplePolicy]
+        self.downsample: dict[str, list[DownsamplePolicy]] = {}
 
 
 class WriteError(Exception):
@@ -92,6 +141,11 @@ class Engine:
             for rpj in dbj.get("rps", []):
                 rp = RetentionPolicy.from_json(rpj)
                 db.rps[rp.name] = rp
+            for cqj in dbj.get("cqs", []):
+                cq = ContinuousQuery.from_json(cqj)
+                db.continuous_queries[cq.name] = cq
+            for rp_name, pols in dbj.get("downsample", {}).items():
+                db.downsample[rp_name] = [DownsamplePolicy.from_json(p) for p in pols]
             self.databases[db.name] = db
 
     def _save_meta(self) -> None:
@@ -101,6 +155,11 @@ class Engine:
                     "name": db.name,
                     "default_rp": db.default_rp,
                     "rps": [rp.to_json() for rp in db.rps.values()],
+                    "cqs": [cq.to_json() for cq in db.continuous_queries.values()],
+                    "downsample": {
+                        rp: [p.to_json() for p in pols]
+                        for rp, pols in db.downsample.items()
+                    },
                 }
                 for db in self.databases.values()
             ]
@@ -248,6 +307,97 @@ class Engine:
                     shards[key].flush()
             return n
 
+    # -- continuous queries / downsample ----------------------------------
+
+    def create_continuous_query(self, db: str, cq: "ContinuousQuery") -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d is None:
+                raise DatabaseNotFound(db)
+            d.continuous_queries[cq.name] = cq
+            self._save_meta()
+
+    def drop_continuous_query(self, db: str, name: str) -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d and name in d.continuous_queries:
+                del d.continuous_queries[name]
+                self._save_meta()
+
+    def save_cq_state(self) -> None:
+        with self._lock:
+            self._save_meta()
+
+    def add_downsample_policy(self, db: str, rp: str, policy: "DownsamplePolicy") -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d is None:
+                raise DatabaseNotFound(db)
+            d.downsample.setdefault(rp, []).append(policy)
+            self._save_meta()
+
+    def shards_due_downsample(self, now_ns: int | None = None):
+        """[(shard, policy)] whose whole range has aged past a policy and
+        whose resolution is still finer (tracked via a marker file)."""
+        if now_ns is None:
+            now_ns = _time.time_ns()
+        due = []
+        with self._lock:
+            for (db, rp, _start), shard in sorted(self._shards.items()):
+                d = self.databases.get(db)
+                pols = d.downsample.get(rp, []) if d else []
+                best = None
+                for p in pols:
+                    if shard.tmax <= now_ns - p.age_ns:
+                        if best is None or p.every_ns > best.every_ns:
+                            best = p
+                if best is not None and _downsample_level(shard.path) < best.every_ns:
+                    due.append((shard, best))
+        return due
+
+    def run_downsample(self, now_ns: int | None = None) -> int:
+        """Execute all due downsample rewrites; returns shards rewritten.
+        Per-shard failures (e.g. a concurrent retention drop removing the
+        directory) are logged and skipped, never aborting the sweep."""
+        import logging
+
+        n = 0
+        for shard, policy in self.shards_due_downsample(now_ns):
+            try:
+                shard.rewrite_downsampled(policy.every_ns, policy.field_aggs)
+                _set_downsample_level(shard.path, policy.every_ns)
+                n += 1
+            except Exception:  # noqa: BLE001
+                logging.getLogger("opengemini_tpu.engine").exception(
+                    "downsample of shard %s failed", shard.path
+                )
+        return n
+
+    def write_rows(self, db: str, points: list, rp: str | None = None) -> int:
+        """Structured write path: points are
+        (measurement, tags tuple, t_ns, {field: (FieldType, value)}) —
+        used by SELECT INTO and internal services; values never round-trip
+        through line-protocol text (reference RecordWriter analogue,
+        coordinator/record_writer.go)."""
+        d = self.databases.get(db)
+        if d is None:
+            raise DatabaseNotFound(db)
+        rp = rp or d.default_rp
+        with self._lock:
+            by_shard: dict[int, list] = {}
+            shards: dict[int, Shard] = {}
+            for p in points:
+                shard = self._get_or_create_shard(db, rp, p[2])
+                key = id(shard)
+                shards[key] = shard
+                by_shard.setdefault(key, []).append(p)
+            n = 0
+            for key, pts in by_shard.items():
+                n += shards[key].write_points_structured(pts)
+                if shards[key].mem.approx_bytes > self.flush_threshold_bytes:
+                    shards[key].flush()
+            return n
+
     def flush_all(self) -> None:
         with self._lock:
             for shard in self._shards.values():
@@ -281,6 +431,24 @@ class Engine:
             for shard in self._shards.values():
                 shard.close()
             self._shards.clear()
+
+
+def _downsample_level(shard_path: str) -> int:
+    """Current resolution of a shard (0 = raw), persisted as a marker file
+    (the reference tracks per-shard downsample levels in meta,
+    engine_downsample.go:23 GetShardDownSampleLevel)."""
+    p = os.path.join(shard_path, "downsample.level")
+    try:
+        with open(p, encoding="utf-8") as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return 0
+
+
+def _set_downsample_level(shard_path: str, every_ns: int) -> None:
+    p = os.path.join(shard_path, "downsample.level")
+    with open(p, "w", encoding="utf-8") as f:
+        f.write(str(every_ns))
 
 
 def _auto_shard_duration(duration_ns: int) -> int:

@@ -53,8 +53,12 @@ class Shard:
 
     def _replay_wal(self) -> None:
         wal_path = os.path.join(self.path, "wal.log")
-        for lines, precision, now_ns in WAL.replay(wal_path):
-            points = lp.parse_lines(lines, precision, now_ns)
+        for entry in WAL.replay(wal_path):
+            if entry[0] == "lines":
+                _, lines, precision, now_ns = entry
+                points = lp.parse_lines(lines, precision, now_ns)
+            else:
+                points = entry[1]
             for p in points:
                 mst, tags, t, fields = p
                 if self.tmin <= t < self.tmax:
@@ -74,23 +78,37 @@ class Shard:
         Returns rows written. Raises FieldTypeConflict BEFORE touching the
         WAL — a rejected batch must not poison replay."""
         with self._lock:
-            pending: dict[str, dict] = {}
-            for mst, _tags, _t, fields in points:
-                schema = self.schemas.get(mst, {})
-                batch_schema = pending.setdefault(mst, {})
-                for name, (ftype, _v) in fields.items():
-                    have = schema.get(name) or batch_schema.get(name)
-                    if have is None:
-                        batch_schema[name] = ftype
-                    elif have != ftype:
-                        raise FieldTypeConflict(name, have, ftype)
+            self._check_types(points)
             self.wal.append_lines(raw_lines, precision, now_ns)
-            n = 0
-            for mst, tags, t, fields in points:
-                sid = self.index.get_or_create(mst, tags)
-                self.mem.write_row(sid, mst, t, fields)
-                n += 1
-            return n
+            return self._apply(points)
+
+    def write_points_structured(self, points: list) -> int:
+        """Same as write_points but WAL-logged as structured points (kind 2)
+        — the SELECT INTO / internal write path, no line-protocol text."""
+        with self._lock:
+            self._check_types(points)
+            self.wal.append_points(points)
+            return self._apply(points)
+
+    def _check_types(self, points: list) -> None:
+        pending: dict[str, dict] = {}
+        for mst, _tags, _t, fields in points:
+            schema = self.schemas.get(mst, {})
+            batch_schema = pending.setdefault(mst, {})
+            for name, (ftype, _v) in fields.items():
+                have = schema.get(name) or batch_schema.get(name)
+                if have is None:
+                    batch_schema[name] = ftype
+                elif have != ftype:
+                    raise FieldTypeConflict(name, have, ftype)
+
+    def _apply(self, points: list) -> int:
+        n = 0
+        for mst, tags, t, fields in points:
+            sid = self.index.get_or_create(mst, tags)
+            self.mem.write_row(sid, mst, t, fields)
+            n += 1
+        return n
 
     def flush(self) -> None:
         """Memtable -> new TSF file, then truncate WAL. Crash-safe ordering:
@@ -148,6 +166,50 @@ class Shard:
             for r in old:
                 r.close()
                 os.remove(r.path)
+
+    def rewrite_downsampled(self, every_ns: int, field_aggs: dict | None = None) -> int:
+        """Rewrite this shard at `every_ns` resolution (reference:
+        engine_downsample StartDownSampleTask). Returns rows written.
+        Flushes the memtable first; replaces all files atomically at the
+        end (write-new-then-swap, reference compaction_file_info.go)."""
+        from opengemini_tpu.storage.downsample import downsample_records
+
+        with self._lock:
+            self.flush()
+            path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
+            w = TSFWriter(path)
+            rows = 0
+            # schema changes are staged and applied only after the new file
+            # is durable — a mid-rewrite failure must not leave in-memory
+            # schemas diverged from on-disk (still raw) data
+            staged_schemas: dict[str, dict] = {}
+            try:
+                for mst in self.measurements():
+                    per_sid: dict[int, Record] = {}
+                    for sid in sorted(self.index.series_ids(mst)):
+                        rec = self.read_series(mst, sid)
+                        if len(rec):
+                            per_sid[sid] = rec
+                    out, new_schema = downsample_records(
+                        per_sid, self.schema(mst), self.tmin, self.tmax,
+                        every_ns, field_aggs,
+                    )
+                    staged_schemas[mst] = new_schema
+                    for sid in sorted(out):
+                        w.add_chunk(mst, sid, out[sid])
+                        rows += len(out[sid])
+                w.finish()
+            except BaseException:
+                w.abort()
+                raise
+            self.schemas.update(staged_schemas)
+            self._next_file_seq += 1
+            old = self._files
+            self._files = [TSFReader(path)]
+            for r in old:
+                r.close()
+                os.remove(r.path)
+            return rows
 
     # -- read path ----------------------------------------------------------
 

@@ -7,18 +7,25 @@ parser and keeps the format trivial to audit. Entry framing:
 
     [u32 len][u32 crc32][u8 kind][payload]
 
-kind 1 = raw lines: [u8 precision_len][precision utf8][zlib(lines utf8)]
+kind 1 = raw lines: [u8 precision_len][u64 now_ns][precision utf8][zlib(lines)]
+kind 2 = structured points: [zlib(JSON [[mst, [[k,v]..], t, {f: [type, val]}]..])]
+         (used by SELECT INTO / internal writes — values never round-trip
+         through line-protocol text)
 Torn tails (crc/len mismatch at EOF) are truncated on replay, matching the
 reference's tolerant WAL restore (engine/wal.go replay error handling).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import struct
 import zlib
 
+from opengemini_tpu.record import FieldType
+
 _KIND_RAW_LINES = 1
+_KIND_POINTS = 2
 _HEADER = struct.Struct("<IIB")
 
 
@@ -41,6 +48,20 @@ class WAL:
             self._f.flush()
             os.fsync(self._f.fileno())
 
+    def append_points(self, points: list) -> None:
+        """points: [(mst, tags tuple, t_ns, {field: (FieldType, value)})]."""
+        doc = [
+            [mst, [list(t) for t in tags], t_ns,
+             {k: [int(ft), v] for k, (ft, v) in fields.items()}]
+            for mst, tags, t_ns, fields in points
+        ]
+        payload = zlib.compress(json.dumps(doc).encode("utf-8"), 1)
+        crc = zlib.crc32(payload)
+        self._f.write(_HEADER.pack(len(payload), crc, _KIND_POINTS) + payload)
+        if self.sync:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
     def flush(self) -> None:
         self._f.flush()
         os.fsync(self._f.fileno())
@@ -58,7 +79,8 @@ class WAL:
 
     @staticmethod
     def replay(path: str):
-        """Yield (lines_bytes, precision, now_ns) entries; stop at torn tail."""
+        """Yield ("lines", lines_bytes, precision, now_ns) and
+        ("points", points) entries; stop at torn tail."""
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
@@ -77,5 +99,17 @@ class WAL:
                 plen, now_ns = struct.unpack_from("<BQ", payload)
                 prec = payload[9 : 9 + plen].decode("utf-8")
                 lines = zlib.decompress(payload[9 + plen :])
-                yield lines, prec, now_ns
+                yield ("lines", lines, prec, now_ns)
+            elif kind == _KIND_POINTS:
+                doc = json.loads(zlib.decompress(payload))
+                points = [
+                    (
+                        mst,
+                        tuple(tuple(t) for t in tags),
+                        t_ns,
+                        {k: (FieldType(ft), v) for k, (ft, v) in fields.items()},
+                    )
+                    for mst, tags, t_ns, fields in doc
+                ]
+                yield ("points", points)
             off = end

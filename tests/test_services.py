@@ -1,0 +1,253 @@
+"""Services + SELECT INTO + downsample tests (reference: services/ tests
+and engine_downsample paths)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.services.continuous import ContinuousQueryService
+from opengemini_tpu.services.retention import RetentionService
+from opengemini_tpu.storage.engine import DownsamplePolicy, Engine, NS
+
+BASE = 1_700_000_040  # minute-aligned
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = Engine(str(tmp_path / "data"))
+    e.create_database("db")
+    yield e, Executor(e)
+    e.close()
+
+
+def q(ex, text, now=None):
+    return ex.execute(text, db="db", now_ns=(now or (BASE + 10_000)) * NS)
+
+
+class TestSelectInto:
+    def test_into_writes_aggregates(self, env):
+        e, ex = env
+        lines = "\n".join(
+            f"cpu,host=h{i%2} v={i} {(BASE + i * 10) * NS}" for i in range(30)
+        )
+        e.write_lines("db", lines)
+        res = q(
+            ex,
+            f"SELECT mean(v) INTO cpu_1m FROM cpu WHERE time >= {BASE*NS} AND "
+            f"time < {(BASE+300)*NS} GROUP BY time(1m), host",
+        )
+        [row] = res["results"][0]["series"][0]["values"]
+        assert row[1] == 10  # 5 windows x 2 hosts
+        out = q(ex, "SELECT mean FROM cpu_1m GROUP BY host")
+        series = out["results"][0]["series"]
+        assert len(series) == 2
+        assert series[0]["columns"] == ["time", "mean"]
+        assert len(series[0]["values"]) == 5
+
+    def test_into_preserves_int_and_bool(self, env):
+        e, ex = env
+        e.write_lines("db", f"m i=5i,b=true {BASE*NS}")
+        q(ex, f"SELECT last(i), last(b) INTO m2 FROM m WHERE time >= {BASE*NS}")
+        out = q(ex, "SELECT last, last_1 FROM m2")
+        [row] = out["results"][0]["series"][0]["values"]
+        assert row[1] == 5 and row[2] is True
+
+
+class TestContinuousQueries:
+    CQ = (
+        'CREATE CONTINUOUS QUERY cq1 ON db BEGIN '
+        'SELECT mean(v) INTO cpu_1m FROM cpu GROUP BY time(1m), host END'
+    )
+
+    def test_create_show_drop(self, env):
+        e, ex = env
+        res = q(ex, self.CQ)
+        assert "error" not in res["results"][0]
+        res = q(ex, "SHOW CONTINUOUS QUERIES")
+        series = {s["name"]: s for s in res["results"][0]["series"]}
+        assert series["db"]["values"][0][0] == "cq1"
+        assert "SELECT mean(v) INTO cpu_1m" in series["db"]["values"][0][1]
+        q(ex, "DROP CONTINUOUS QUERY cq1 ON db")
+        res = q(ex, "SHOW CONTINUOUS QUERIES")
+        assert all(not s["values"] for s in res["results"][0].get("series", []))
+
+    def test_cq_persisted_across_reopen(self, env, tmp_path):
+        e, ex = env
+        q(ex, self.CQ)
+        e.close()
+        e2 = Engine(e.root)
+        assert "cq1" in e2.databases["db"].continuous_queries
+        e2.close()
+
+    def test_cq_service_materializes_windows(self, env):
+        e, ex = env
+        q(ex, self.CQ)
+        lines = "\n".join(
+            f"cpu,host=h0 v={i} {(BASE + i * 10) * NS}" for i in range(24)
+        )
+        e.write_lines("db", lines)  # 4 minutes of data
+        svc = ContinuousQueryService(e, ex, interval_s=3600)
+        # influx default: each run computes only the most recently closed
+        # window [end-every, end)
+        ran = svc.handle(now_ns=(BASE + 180) * NS)
+        assert ran == 1
+        out = q(ex, "SELECT mean FROM cpu_1m")
+        vals = out["results"][0]["series"][0]["values"]
+        assert [v for _t, v in vals] == [14.5]  # window [120, 180)
+        # second tick immediately: nothing new closed
+        assert svc.handle(now_ns=(BASE + 185) * NS) == 0
+        # a minute later the next window [180, 240) closes
+        assert svc.handle(now_ns=(BASE + 248) * NS) == 1
+        out = q(ex, "SELECT mean FROM cpu_1m")
+        vals = out["results"][0]["series"][0]["values"]
+        assert [v for _t, v in vals] == [14.5, 20.5]
+
+    def test_cq_resample_for_extends_lookback(self, env):
+        e, ex = env
+        q(
+            ex,
+            'CREATE CONTINUOUS QUERY cq2 ON db RESAMPLE FOR 3m BEGIN '
+            'SELECT mean(v) INTO cpu_1m_r FROM cpu GROUP BY time(1m) END',
+        )
+        lines = "\n".join(
+            f"cpu,host=h0 v={i} {(BASE + i * 10) * NS}" for i in range(18)
+        )
+        e.write_lines("db", lines)
+        svc = ContinuousQueryService(e, ex, interval_s=3600)
+        assert svc.handle(now_ns=(BASE + 180) * NS) == 1
+        out = q(ex, "SELECT mean FROM cpu_1m_r")
+        vals = out["results"][0]["series"][0]["values"]
+        assert [v for _t, v in vals] == [2.5, 8.5, 14.5]
+
+
+class TestDownsample:
+    def test_rewrite_downsampled_means(self, env):
+        e, ex = env
+        lines = "\n".join(
+            f"cpu,host=h{i%2} v={i}.0,c={i}i {(BASE + i * 10) * NS}" for i in range(60)
+        )
+        e.write_lines("db", lines)
+        [shard] = e.all_shards()
+        rows_before = 60
+        written = shard.rewrite_downsampled(60 * NS)
+        assert 0 < written < rows_before
+        out = q(ex, "SELECT v FROM cpu WHERE host = 'h0'")
+        vals = out["results"][0]["series"][0]["values"]
+        # h0 points: i even; first minute window holds i in {0,2,4} -> mean 2
+        assert vals[0][1] == pytest.approx(2.0)
+        # int field defaults to sum and stays int
+        out = q(ex, "SELECT c FROM cpu WHERE host = 'h0'")
+        v0 = out["results"][0]["series"][0]["values"][0][1]
+        assert v0 == 0 + 2 + 4 and isinstance(v0, int)
+
+    def test_downsample_policy_service_flow(self, env):
+        e, ex = env
+        e.write_lines("db", f"cpu v=1 {BASE * NS}\ncpu v=3 {(BASE + 30) * NS}")
+        e.add_downsample_policy("db", "autogen", DownsamplePolicy(
+            age_ns=1 * NS, every_ns=60 * NS))
+        week = 7 * 24 * 3600
+        now = (BASE + 2 * week) * NS
+        assert e.run_downsample(now_ns=now) == 1
+        # idempotent: already at level
+        assert e.run_downsample(now_ns=now) == 0
+        out = q(ex, "SELECT v FROM cpu")
+        [row] = out["results"][0]["series"][0]["values"]
+        assert row[1] == pytest.approx(2.0)
+
+    def test_policy_persisted(self, env):
+        e, ex = env
+        e.add_downsample_policy("db", "autogen", DownsamplePolicy(1, 60 * NS))
+        e.close()
+        e2 = Engine(e.root)
+        assert e2.databases["db"].downsample["autogen"][0].every_ns == 60 * NS
+        e2.close()
+
+
+class TestRetentionService:
+    def test_tick_drops_expired(self, env, monkeypatch):
+        e, ex = env
+        e.create_retention_policy("db", "short", duration_ns=24 * 3600 * NS, default=True)
+        e.write_lines("db", f"cpu v=1 {1 * NS}")  # ancient
+        svc = RetentionService(e, interval_s=3600)
+        import opengemini_tpu.storage.engine as eng_mod
+
+        monkeypatch.setattr(
+            eng_mod._time, "time_ns", lambda: (BASE + 10_000) * NS
+        )
+        svc.tick()
+        assert e.shards_for_range("db", "short", 0, 2**62) == []
+
+
+class TestReadOnlyGating:
+    def test_show_cq_allowed_on_get_into_rejected(self, env):
+        e, ex = env
+        res = ex.execute("SHOW CONTINUOUS QUERIES", db="db", read_only=True)
+        assert "error" not in res["results"][0]
+        res = ex.execute("SELECT mean(v) INTO x FROM cpu", db="db", read_only=True)
+        assert "must be sent via POST" in res["results"][0]["error"]
+
+
+class TestReviewRegressions:
+    def test_into_with_weird_tag_values(self, env):
+        """Tags with spaces/commas must survive SELECT INTO (structured
+        write path, no line-protocol round trip)."""
+        import opengemini_tpu.ingest.line_protocol as lp
+
+        e, ex = env
+        e.write_lines("db", r"m,host=web\ server\,1 v=4 %d" % (BASE * NS))
+        res = q(ex, f"SELECT mean(v) INTO m2 FROM m WHERE time >= {BASE*NS} GROUP BY host")
+        assert res["results"][0]["series"][0]["values"][0][1] == 1
+        out = q(ex, "SELECT mean FROM m2 GROUP BY host")
+        s = out["results"][0]["series"][0]
+        assert s["tags"]["host"] == "web server,1"
+        assert s["values"][0][1] == 4.0
+
+    def test_into_type_conflict_is_statement_error(self, env):
+        e, ex = env
+        e.write_lines("db", f"tgt mean=1i {BASE*NS}")  # mean is INT in target
+        e.write_lines("db", f"m v=1.5 {(BASE+1)*NS}")
+        res = q(ex, f"SELECT mean(v) INTO tgt FROM m WHERE time >= {BASE*NS}")
+        assert "type conflict" in res["results"][0]["error"]
+
+    def test_downsample_int_sum_exact_above_f32(self, env):
+        """Ints > 2^24 must survive downsampling exactly (host int64 path)."""
+        e, ex = env
+        big = 100_000_001
+        e.write_lines(
+            "db", f"m c={big}i {BASE*NS}\nm c={big}i {(BASE+10)*NS}"
+        )
+        [shard] = e.all_shards()
+        shard.rewrite_downsampled(60 * NS)
+        out = q(ex, "SELECT c FROM m")
+        [row] = out["results"][0]["series"][0]["values"]
+        assert row[1] == 2 * big
+
+    def test_failing_cq_does_not_starve_others(self, env):
+        e, ex = env
+        # cq_bad writes into a dropped database; cq_ok must still run
+        q(ex, 'CREATE CONTINUOUS QUERY a_bad ON db BEGIN '
+              'SELECT mean(v) INTO missing_db..x FROM cpu GROUP BY time(1m) END')
+        q(ex, 'CREATE CONTINUOUS QUERY b_ok ON db BEGIN '
+              'SELECT mean(v) INTO ok_1m FROM cpu GROUP BY time(1m) END')
+        e.write_lines("db", "\n".join(
+            f"cpu v={i} {(BASE + i*10)*NS}" for i in range(12)))
+        svc = ContinuousQueryService(e, ex, interval_s=3600)
+        ran = svc.handle(now_ns=(BASE + 120) * NS)
+        assert ran == 1  # only b_ok
+        out = q(ex, "SELECT mean FROM ok_1m")
+        assert out["results"][0]["series"][0]["values"]
+
+    def test_structured_wal_replay(self, env):
+        """Kind-2 WAL entries (INTO writes) must replay after a crash."""
+        e, ex = env
+        e.write_lines("db", f"m v=7 {BASE*NS}")
+        q(ex, f"SELECT last(v) INTO m2 FROM m WHERE time >= {BASE*NS}")
+        for sh in e.all_shards():
+            sh.wal.flush()
+        root = e.root
+        # crash: reopen without close
+        e2 = Engine(root)
+        ex2 = Executor(e2)
+        out = ex2.execute("SELECT last FROM m2", db="db", now_ns=(BASE+100)*NS)
+        assert out["results"][0]["series"][0]["values"][0][1] == 7.0
+        e2.close()

@@ -68,8 +68,8 @@ class TestWAL:
             f.write(b"\x07\x00\x00\x00garbage")
         entries = list(WAL.replay(p))
         assert len(entries) == 2
-        assert entries[0] == (b"cpu f=1 1", "ns", 100)
-        assert entries[1] == (b"cpu f=2 2", "s", 200)
+        assert entries[0] == ("lines", b"cpu f=1 1", "ns", 100)
+        assert entries[1] == ("lines", b"cpu f=2 2", "s", 200)
 
     def test_truncate(self, tmp_path):
         p = str(tmp_path / "wal.log")
@@ -80,7 +80,7 @@ class TestWAL:
         w.flush()
         w.close()
         entries = list(WAL.replay(p))
-        assert len(entries) == 1 and entries[0][0] == b"cpu f=2 2"
+        assert len(entries) == 1 and entries[0][1] == b"cpu f=2 2"
 
 
 class TestTSF:
