@@ -18,6 +18,7 @@ from __future__ import annotations
 import math
 import re
 import time as _time
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -25,8 +26,10 @@ from opengemini_tpu.models import templates
 from opengemini_tpu.ops import aggregates as aggmod
 from opengemini_tpu.ops import window as winmod
 from opengemini_tpu.query import condition as cond
+from opengemini_tpu.query import functions as fnmod
 from opengemini_tpu.record import FieldType, FieldTypeConflict
 from opengemini_tpu.sql import ast
+from opengemini_tpu.storage.engine import WriteError
 from opengemini_tpu.sql.parser import parse
 
 NS = 1_000_000_000
@@ -35,6 +38,33 @@ MAX_SELECT_BUCKETS = 1_000_000  # influx max-select-buckets guard
 
 class QueryError(Exception):
     pass
+
+
+@dataclass
+class ScanContext:
+    """Output of the shared select prologue (_scan_context)."""
+
+    sc: object
+    shards: list
+    tmin: int
+    tmax: int
+    schema: dict
+    tag_keys: set
+    group_time: object
+    aligned: int
+    W: int
+    group_tags: list
+    group_keys: list
+    scan_plan: list
+
+
+# host calls safe on string columns (python-object values end-to-end)
+_STRING_OK_HOST = {"count", "count_distinct", "mode", "first", "last", "distinct"}
+
+
+def _check_host_field_type(call_name: str, field: str, schema: dict) -> None:
+    if schema.get(field) == FieldType.STRING and call_name not in _STRING_OK_HOST:
+        raise QueryError(f"{call_name}() is not supported on string field {field!r}")
 
 
 _READONLY_STMTS = (
@@ -85,7 +115,7 @@ class Executor:
                 res = self.execute_statement(stmt, db, now_ns)
             except (
                 QueryError, cond.ConditionError, KeyError, ValueError,
-                re.error, FieldTypeConflict,
+                re.error, FieldTypeConflict, WriteError,
             ) as e:
                 res = {"error": str(e)}
             res["statement_id"] = i
@@ -232,45 +262,43 @@ class Executor:
         return sorted(names)
 
     def _select_measurement(self, stmt, db, rp, mst, now_ns) -> list[dict]:
-        # classify fields: aggregate query vs raw query
+        # classify fields: device-aggregate query, host-function query, raw
         calls = _collect_calls(stmt.fields)
-        if calls:
+        if not calls:
+            return self._select_raw(stmt, db, rp, mst, now_ns)
+        if all(_is_device_call(c) for c in calls):
             return self._select_agg(stmt, db, rp, mst, now_ns, calls)
-        return self._select_raw(stmt, db, rp, mst, now_ns)
+        return self._select_host(stmt, db, rp, mst, now_ns)
 
-    # -- aggregate path -----------------------------------------------------
+    # -- shared scan planning ----------------------------------------------
 
-    def _select_agg(self, stmt, db, rp, mst, now_ns, calls) -> list[dict]:
+    def _scan_context(self, stmt, db, rp, mst, now_ns):
+        """Shared prologue of every select path: schema/tag keys, WHERE
+        split, shard mapping, data-driven range clamp, window grid, group
+        construction (reference: the Prepare + MapShards steps,
+        SURVEY.md §3.2). Returns None when nothing matches."""
         shards_all = self.engine.shards_for_range(db, rp, cond.MIN_TIME, cond.MAX_TIME)
         tag_keys: set[str] = set()
+        schema: dict[str, FieldType] = {}
         for sh in shards_all:
             tag_keys.update(sh.index.tag_keys(mst))
+            schema.update(sh.schema(mst))
         sc = cond.split(stmt.condition, tag_keys, now_ns)
         tmin, tmax = sc.tmin, sc.tmax
-
         shards = self.engine.shards_for_range(db, rp, tmin, tmax)
         if not shards:
-            return []
-
-        # resolve agg specs + fields
-        aggs = []  # (out_name, spec, params, field_name)
-        for f in stmt.fields:
-            for call in _calls_in(f.expr):
-                spec, params, field_name = _resolve_call(call)
-                aggs.append((call, spec, params, field_name))
-
+            return None
         # data-driven clamp of an unbounded range (influx uses epoch 0/now)
         if tmin == cond.MIN_TIME or tmax == cond.MAX_TIME:
             dmin, dmax = _data_time_range(shards, mst)
             if dmin is None:
-                return []
+                return None
             if tmin == cond.MIN_TIME:
                 tmin = dmin
             if tmax == cond.MAX_TIME:
                 tmax = dmax + 1
         if tmax <= tmin:
-            return []
-
+            return None
         group_time = stmt.group_by_time
         if group_time:
             aligned = int(winmod.window_start(tmin, group_time.every_ns, group_time.offset_ns))
@@ -283,10 +311,8 @@ class Executor:
         else:
             aligned = tmin if tmin > cond.MIN_TIME else 0
             W = 1
-
         group_tags = self._group_tags(stmt, shards, mst)
-
-        # map (group key) -> gid; collect per-shard sid lists
+        # ordered group keys + per-(shard, sid) membership
         gid_of: dict[tuple, int] = {}
         group_keys: list[tuple] = []
         scan_plan = []  # (shard, sid, gid)
@@ -302,7 +328,31 @@ class Executor:
                     group_keys.append(key)
                 scan_plan.append((sh, sid, gid))
         if not scan_plan:
+            return None
+        return ScanContext(
+            sc, shards, tmin, tmax, schema, tag_keys, group_time, aligned, W,
+            group_tags, group_keys, scan_plan,
+        )
+
+    # -- aggregate path -----------------------------------------------------
+
+    def _select_agg(self, stmt, db, rp, mst, now_ns, calls) -> list[dict]:
+        ctx = self._scan_context(stmt, db, rp, mst, now_ns)
+        if ctx is None:
             return []
+        sc, shards = ctx.sc, ctx.shards
+        tmin, tmax = ctx.tmin, ctx.tmax
+        group_time, aligned, W = ctx.group_time, ctx.aligned, ctx.W
+        group_tags, group_keys, scan_plan = ctx.group_tags, ctx.group_keys, ctx.scan_plan
+        schema = ctx.schema
+
+        # resolve agg specs + fields
+        aggs = []  # (out_name, spec, params, field_name)
+        for f in stmt.fields:
+            for call in _calls_in(f.expr):
+                spec, params, field_name = _resolve_call(call)
+                aggs.append((call, spec, params, field_name))
+
         num_groups = len(group_keys)
         num_segments = num_groups * W
 
@@ -314,9 +364,6 @@ class Executor:
         batches: dict[str, templates.AggBatch] = {
             f: templates.AggBatch(dtype) for f in needed_fields
         }
-        schema: dict[str, FieldType] = {}
-        for sh in shards:
-            schema.update(sh.schema(mst))
 
         # string fields only support count on the device path (reference
         # supports first/last/distinct on strings — host path, later round)
@@ -446,6 +493,206 @@ class Executor:
             series = {
                 "name": mst,
                 "columns": columns,
+                "values": [[t] + v for t, v, _p in rows],
+            }
+            if group_tags:
+                series["tags"] = dict(zip(group_tags, key))
+            out_series.append(series)
+        return out_series
+
+    # -- host function path (transforms, mode/integral/top/bottom/...) ------
+
+    def _select_host(self, stmt, db, rp, mst, now_ns) -> list[dict]:
+        """General host path for calls outside the device aggregate set
+        (reference: sql-side transform processors, SURVEY.md §2.3)."""
+        ctx = self._scan_context(stmt, db, rp, mst, now_ns)
+        if ctx is None:
+            return []
+        sc, schema = ctx.sc, ctx.schema
+        tmin, tmax = ctx.tmin, ctx.tmax
+        group_time, aligned, W = ctx.group_time, ctx.aligned, ctx.W
+        group_tags = ctx.group_tags
+        if group_time:
+            window_times = [aligned + w * group_time.every_ns for w in range(W)]
+        else:
+            window_times = [aligned]
+        groups: dict[tuple, list] = {}
+        for sh, sid, gid in ctx.scan_plan:
+            groups.setdefault(ctx.group_keys[gid], []).append((sh, sid))
+
+        # resolve output columns
+        plans = []  # (name, kind, call_name, field, params, inner_agg|None)
+        multi_plan = None
+        for f in stmt.fields:
+            e = _strip_expr(f.expr)
+            if not isinstance(e, ast.Call):
+                raise QueryError(
+                    "expressions mixing functions and math are not supported "
+                    "in the host function path yet"
+                )
+            name = f.alias or _default_field_name(e)
+            kind, call_name, field, params, inner = _resolve_host_call(e, group_time)
+            _check_host_field_type(call_name, field, schema)
+            if kind == "multi":
+                if len(stmt.fields) > 1:
+                    raise QueryError(f"{call_name}() must be the only field")
+                multi_plan = (name, call_name, field, params)
+            else:
+                plans.append((name, kind, call_name, field, params, inner))
+
+        out_series = []
+        for key in sorted(groups):
+            rows_by_field: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+
+            def field_rows(fname: str):
+                got = rows_by_field.get(fname)
+                if got is not None:
+                    return got
+                ts_list, vs_list = [], []
+                for sh, sid in groups[key]:
+                    rec = sh.read_series(mst, sid, tmin, tmax, fields=[fname] + (
+                        sorted(cond.field_filter_refs(sc.field_expr)) if sc.field_expr else []))
+                    col = rec.columns.get(fname)
+                    if col is None or len(rec) == 0:
+                        continue
+                    m = col.valid.copy()
+                    if sc.field_expr is not None:
+                        m &= cond.eval_field_expr(sc.field_expr, rec)
+                    ts_list.append(rec.times[m])
+                    vs_list.append(col.values[m])
+                if not ts_list:
+                    got = (np.empty(0, np.int64), np.empty(0))
+                else:
+                    t = np.concatenate(ts_list)
+                    v = np.concatenate(vs_list)
+                    order = np.argsort(t, kind="stable")
+                    got = (t[order], v[order])
+                rows_by_field[fname] = got
+                return got
+
+            def window_slices(t: np.ndarray):
+                if not group_time:
+                    return [(window_times[0], slice(None))]
+                bounds = np.searchsorted(
+                    t, [aligned + w * group_time.every_ns for w in range(W + 1)]
+                )
+                return [
+                    (window_times[w], slice(bounds[w], bounds[w + 1]))
+                    for w in range(W)
+                ]
+
+            if multi_plan is not None:
+                name, call_name, fname, params = multi_plan
+                t, v = field_rows(fname)
+                rows = []
+                for wt, sl in window_slices(t):
+                    for rt, rv in fnmod.multi_row(call_name, t[sl], v[sl], params):
+                        rows.append([rt if rt is not None else wt, rv])
+                if not stmt.ascending:
+                    rows.reverse()
+                if stmt.offset:
+                    rows = rows[stmt.offset :]
+                if stmt.limit:
+                    rows = rows[: stmt.limit]
+                if not rows:
+                    continue
+                series = {"name": mst, "columns": ["time", name], "values": rows}
+                if group_tags:
+                    series["tags"] = dict(zip(group_tags, key))
+                out_series.append(series)
+                continue
+
+            # single raw transform: emit rows directly — dict keying would
+            # collapse rows when two series in the group share a timestamp
+            if len(plans) == 1 and plans[0][1] == "transform_raw":
+                name, _kind, call_name, fname, params, _inner = plans[0]
+                t, v = field_rows(fname)
+                t_out, v_out = fnmod.transform(call_name, t, v, params)
+                rows = [
+                    (int(tt), [fnmod.py_value(vv)], True)
+                    for tt, vv in zip(t_out, v_out)
+                ]
+                if not stmt.ascending:
+                    rows.reverse()
+                if stmt.offset:
+                    rows = rows[stmt.offset :]
+                if stmt.limit:
+                    rows = rows[: stmt.limit]
+                if not rows:
+                    continue
+                series = {
+                    "name": mst,
+                    "columns": ["time", name],
+                    "values": [[t0] + vv for t0, vv, _p in rows],
+                }
+                if group_tags:
+                    series["tags"] = dict(zip(group_tags, key))
+                out_series.append(series)
+                continue
+
+            col_maps: list[dict] = []  # per plan: {time: value}
+            has_plain_agg = False
+            for name, kind, call_name, fname, params, inner in plans:
+                t, v = field_rows(fname)
+                if kind == "agg":
+                    has_plain_agg = True
+                    m: dict = {}
+                    for wt, sl in window_slices(t):
+                        val, sel_t = fnmod.host_agg(call_name, t[sl], v[sl], params)
+                        if val is not None:
+                            m[wt] = (val, sel_t)
+                    col_maps.append(m)
+                elif kind == "transform_raw":
+                    t_out, v_out = fnmod.transform(call_name, t, v, params)
+                    col_maps.append({int(tt): (vv.item() if hasattr(vv, "item") else vv, None)
+                                     for tt, vv in zip(t_out, v_out)})
+                else:  # transform over inner aggregate windows
+                    seq_t, seq_v = [], []
+                    for wt, sl in window_slices(t):
+                        val, _sel = fnmod.host_agg(inner[0], t[sl], v[sl], inner[1])
+                        if val is not None:
+                            seq_t.append(wt)
+                            seq_v.append(val)
+                    t_out, v_out = fnmod.transform(
+                        call_name, np.asarray(seq_t, np.int64), np.asarray(seq_v), params
+                    )
+                    col_maps.append({int(tt): (float(vv), None) for tt, vv in zip(t_out, v_out)})
+
+            if has_plain_agg and group_time:
+                base_times = window_times
+            else:
+                seen = sorted({t for m in col_maps for t in m})
+                base_times = seen
+            rows = []
+            for bt in base_times:
+                vals = []
+                present = False
+                for m in col_maps:
+                    entry = m.get(bt)
+                    if entry is None:
+                        vals.append(None)
+                    else:
+                        vals.append(entry[0])
+                        present = True
+                # single bare selector-time semantics
+                t_render = bt
+                if len(plans) == 1 and not group_time:
+                    entry = col_maps[0].get(bt)
+                    if entry and entry[1] is not None:
+                        t_render = entry[1]
+                rows.append((t_render, vals, present))
+            rows = _apply_fill(rows, stmt, ["time"] + [p[0] for p in plans])
+            if not stmt.ascending:
+                rows.reverse()
+            if stmt.offset:
+                rows = rows[stmt.offset :]
+            if stmt.limit:
+                rows = rows[: stmt.limit]
+            if not rows:
+                continue
+            series = {
+                "name": mst,
+                "columns": ["time"] + [p[0] for p in plans],
                 "values": [[t] + v for t, v, _p in rows],
             }
             if group_tags:
@@ -693,6 +940,101 @@ def _calls_in(e) -> list[ast.Call]:
     if isinstance(e, ast.UnaryExpr):
         return _calls_in(e.expr)
     return []
+
+
+def _is_device_call(call: ast.Call) -> bool:
+    if call.name == "count" and call.args:
+        inner = _strip_expr(call.args[0])
+        if isinstance(inner, ast.Call) and inner.name == "distinct":
+            return True
+    if call.name in aggmod.REGISTRY:
+        # device aggs take a bare field ref (string fields route to count
+        # validation inside _select_agg)
+        return bool(call.args) and isinstance(_strip_expr(call.args[0]), ast.VarRef)
+    return False
+
+
+def _call_param_value(arg) -> float | int:
+    a = _strip_expr(arg)
+    if isinstance(a, ast.IntegerLiteral):
+        return a.val
+    if isinstance(a, ast.NumberLiteral):
+        return a.val
+    if isinstance(a, ast.DurationLiteral):
+        return a.val_ns
+    raise QueryError("function parameter must be a number or duration")
+
+
+def _resolve_host_call(call: ast.Call, group_time):
+    """-> (kind, call_name, field, params, inner) where kind is
+    'agg' | 'transform_raw' | 'transform_agg' | 'multi'."""
+    name = call.name
+    if name in fnmod.TRANSFORMS:
+        if not call.args:
+            raise QueryError(f"{name}() requires an argument")
+        inner_e = _strip_expr(call.args[0])
+        params = tuple(_call_param_value(a) for a in call.args[1:])
+        _check_host_arity(name, params)
+        if isinstance(inner_e, ast.Call):
+            if group_time is None:
+                raise QueryError(
+                    f"{name}() over an aggregate requires GROUP BY time(...)"
+                )
+            ikind, iname, ifield, iparams, _ = _resolve_host_call(inner_e, group_time)
+            if ikind != "agg":
+                raise QueryError(f"{name}() argument must be a field or aggregate")
+            return "transform_agg", name, ifield, params, (iname, iparams)
+        if isinstance(inner_e, ast.VarRef):
+            if group_time is not None:
+                raise QueryError(
+                    f"{name}() over raw points cannot use GROUP BY time(...) — "
+                    "wrap the field in an aggregate"
+                )
+            return "transform_raw", name, inner_e.name, params, None
+        raise QueryError(f"{name}() argument must be a field or aggregate")
+    if name in fnmod.MULTI_ROW:
+        if not call.args:
+            raise QueryError(f"{name}() requires a field argument")
+        fld = _strip_expr(call.args[0])
+        if not isinstance(fld, ast.VarRef):
+            raise QueryError(f"{name}() argument must be a field")
+        params = tuple(_call_param_value(a) for a in call.args[1:])
+        _check_host_arity(name, params)
+        return "multi", name, fld.name, params, None
+    if name == "count" and call.args and isinstance(_strip_expr(call.args[0]), ast.Call):
+        inner = _strip_expr(call.args[0])
+        if inner.name == "distinct":
+            fld = _strip_expr(inner.args[0])
+            return "agg", "count_distinct", fld.name, (), None
+    if name in fnmod.HOST_AGGS:
+        if not call.args or not isinstance(_strip_expr(call.args[0]), ast.VarRef):
+            raise QueryError(f"{name}() requires a field argument")
+        params = tuple(_call_param_value(a) for a in call.args[1:])
+        _check_host_arity(name, params)
+        return "agg", name, _strip_expr(call.args[0]).name, params, None
+    raise QueryError(f"unsupported function: {name}")
+
+
+# (min required params, max allowed params) per host call with parameters
+_HOST_ARITY = {
+    "percentile": (1, 1),
+    "moving_average": (1, 1),
+    "top": (1, 1),
+    "bottom": (1, 1),
+    "sample": (1, 1),
+    "distinct": (0, 0),
+    "difference": (0, 0),
+    "non_negative_difference": (0, 0),
+    "cumulative_sum": (0, 0),
+}
+
+
+def _check_host_arity(name: str, params: tuple) -> None:
+    lo, hi = _HOST_ARITY.get(name, (0, 1))
+    if not (lo <= len(params) <= hi):
+        raise QueryError(f"{name}() takes {lo + 1} to {hi + 1} arguments")
+    if name == "moving_average" and params and int(params[0]) < 1:
+        raise QueryError("moving_average() window must be >= 1")
 
 
 def _resolve_call(call: ast.Call):

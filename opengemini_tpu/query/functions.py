@@ -1,0 +1,164 @@
+"""Host-side function machinery: transforms and host-only aggregators.
+
+Reference: engine/executor transforms (difference, derivative,
+cumulative_sum, moving_average, elapsed — one transform file each,
+SURVEY.md §2.3) and call processors for mode/integral/top/bottom/sample.
+
+The device path (models/templates.py) executes the hot aggregates; any
+SELECT containing a call outside that set falls back to this host path,
+which evaluates per (group, window) over time-sorted numpy rows. This
+mirrors the reference's split between pushdown-able aggregates and
+sql-side transforms.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+NS = 1_000_000_000
+
+
+def py_value(v):
+    """numpy scalar -> python value; strings pass through."""
+    return v.item() if hasattr(v, "item") else v
+
+# transforms: f(times, values) -> (out_times, out_values); applied per
+# series-group over raw points, or over the window-aggregated sequence
+TRANSFORMS = {
+    "derivative",
+    "non_negative_derivative",
+    "difference",
+    "non_negative_difference",
+    "cumulative_sum",
+    "moving_average",
+    "elapsed",
+}
+
+# host aggregators: one value per (group, window)
+HOST_AGGS = {"mode", "integral", "sum", "count", "mean", "min", "max",
+             "first", "last", "spread", "stddev", "median", "percentile",
+             "count_distinct"}
+
+# multi-row selectors: several output rows per group
+MULTI_ROW = {"top", "bottom", "sample", "distinct"}
+
+
+def transform(name: str, times: np.ndarray, values: np.ndarray, params: tuple):
+    """Apply a transform over one (time-sorted) sequence; None values must
+    already be removed. Returns (times, values)."""
+    if len(times) == 0:
+        return times, values
+    if name in ("derivative", "non_negative_derivative"):
+        unit_ns = params[0] if params else NS
+        if len(times) < 2:
+            return times[:0], values[:0]
+        dv = np.diff(values)
+        dt = np.diff(times)
+        dt = np.where(dt == 0, 1, dt)
+        out = dv / (dt / unit_ns)
+        t_out = times[1:]
+        if name == "non_negative_derivative":
+            keep = out >= 0
+            return t_out[keep], out[keep]
+        return t_out, out
+    if name in ("difference", "non_negative_difference"):
+        if len(times) < 2:
+            return times[:0], values[:0]
+        out = np.diff(values)
+        t_out = times[1:]
+        if name == "non_negative_difference":
+            keep = out >= 0
+            return t_out[keep], out[keep]
+        return t_out, out
+    if name == "cumulative_sum":
+        return times, np.cumsum(values)
+    if name == "moving_average":
+        n = int(params[0]) if params else 2
+        if n < 1 or len(values) < n:
+            return times[:0], values[:0]
+        kernel = np.ones(n) / n
+        out = np.convolve(values, kernel, mode="valid")
+        return times[n - 1 :], out
+    if name == "elapsed":
+        unit_ns = params[0] if params else 1  # default ns
+        if len(times) < 2:
+            return times[:0], values[:0]
+        return times[1:], (np.diff(times) // unit_ns).astype(np.int64)
+    raise ValueError(f"unsupported transform {name!r}")
+
+
+def host_agg(name: str, times: np.ndarray, values: np.ndarray, params: tuple):
+    """One aggregate value over one window's points; returns (value, time_ns
+    | None). None value means null."""
+    if len(values) == 0:
+        return None, None
+    if name == "count":
+        return int(len(values)), None
+    if name == "sum":
+        return values.sum().item(), None
+    if name == "mean":
+        return float(values.mean()), None
+    if name == "min":
+        i = int(np.argmin(values))
+        return py_value(values[i]), int(times[i])
+    if name == "max":
+        i = int(np.argmax(values))
+        return py_value(values[i]), int(times[i])
+    if name == "first":
+        return py_value(values[0]), int(times[0])
+    if name == "last":
+        return py_value(values[-1]), int(times[-1])
+    if name == "spread":
+        return (values.max() - values.min()).item(), None
+    if name == "stddev":
+        if len(values) < 2:
+            return None, None
+        return float(values.std(ddof=1)), None
+    if name == "median":
+        return float(np.median(values)), None
+    if name == "percentile":
+        q = params[0]
+        rank = max(int(np.ceil(q / 100.0 * len(values))) - 1, 0)
+        return np.sort(values)[rank].item(), None
+    if name == "count_distinct":
+        return int(len(np.unique(values))), None
+    if name == "mode":
+        # most frequent; ties -> smallest value (influx semantics)
+        uniq, counts = np.unique(values, return_counts=True)
+        return py_value(uniq[np.argmax(counts)]), None
+    if name == "integral":
+        unit_ns = params[0] if params else NS
+        if len(values) < 2:
+            return 0.0, None
+        dt = np.diff(times) / unit_ns
+        areas = (values[1:] + values[:-1]) / 2 * dt
+        return float(areas.sum()), None
+    raise ValueError(f"unsupported host aggregate {name!r}")
+
+
+def multi_row(name: str, times: np.ndarray, values: np.ndarray, params: tuple,
+              rng: np.random.Generator | None = None):
+    """top/bottom/sample/distinct: list of (time_ns, value) output rows."""
+    if len(values) == 0:
+        return []
+    if name in ("top", "bottom"):
+        n = int(params[0]) if params else 1
+        n = min(n, len(values))
+        if name == "top":
+            idx = np.argpartition(-values, n - 1)[:n]
+        else:
+            idx = np.argpartition(values, n - 1)[:n]
+        # influx orders output rows by time
+        idx = idx[np.argsort(times[idx], kind="stable")]
+        return [(int(times[i]), values[i].item()) for i in idx]
+    if name == "sample":
+        n = int(params[0]) if params else 1
+        n = min(n, len(values))
+        rng = rng or np.random.default_rng()
+        idx = np.sort(rng.choice(len(values), size=n, replace=False))
+        return [(int(times[i]), values[i].item()) for i in idx]
+    if name == "distinct":
+        uniq = np.unique(values)
+        # influx returns distinct values with the epoch window time
+        return [(None, py_value(v)) for v in uniq]
+    raise ValueError(f"unsupported multi-row call {name!r}")

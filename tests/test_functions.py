@@ -1,0 +1,222 @@
+"""Host function path tests: transforms, mode/integral, top/bottom/
+distinct/sample (reference: engine/executor transform tests)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.storage.engine import Engine, NS
+
+BASE = 1_700_000_040
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = Engine(str(tmp_path / "data"))
+    e.create_database("db")
+    yield e, Executor(e)
+    e.close()
+
+
+def q(ex, text):
+    return ex.execute(text, db="db", now_ns=(BASE + 10_000) * NS)
+
+
+def series_of(res, i=0):
+    return res["results"][0]["series"][i]
+
+
+def write_seq(e, vals, step=10, mst="m", field="v"):
+    lines = "\n".join(
+        f"{mst} {field}={v} {(BASE + i * step) * NS}" for i, v in enumerate(vals)
+    )
+    e.write_lines("db", lines)
+
+
+class TestTransformsRaw:
+    def test_derivative_default_per_second(self, env):
+        e, ex = env
+        write_seq(e, [0, 10, 30])  # 10s apart
+        s = series_of(q(ex, "SELECT derivative(v) FROM m"))
+        assert [r[1] for r in s["values"]] == [1.0, 2.0]
+        assert s["values"][0][0] == (BASE + 10) * NS
+
+    def test_derivative_unit(self, env):
+        e, ex = env
+        write_seq(e, [0, 10])
+        s = series_of(q(ex, "SELECT derivative(v, 10s) FROM m"))
+        assert s["values"][0][1] == 10.0
+
+    def test_non_negative_derivative(self, env):
+        e, ex = env
+        write_seq(e, [0, 10, 5, 20])
+        s = series_of(q(ex, "SELECT non_negative_derivative(v) FROM m"))
+        assert [r[1] for r in s["values"]] == [1.0, 1.5]
+
+    def test_difference_and_cumulative_sum(self, env):
+        e, ex = env
+        write_seq(e, [1, 4, 2])
+        s = series_of(q(ex, "SELECT difference(v) FROM m"))
+        assert [r[1] for r in s["values"]] == [3.0, -2.0]
+        s = series_of(q(ex, "SELECT cumulative_sum(v) FROM m"))
+        assert [r[1] for r in s["values"]] == [1.0, 5.0, 7.0]
+
+    def test_moving_average(self, env):
+        e, ex = env
+        write_seq(e, [2, 4, 6, 8])
+        s = series_of(q(ex, "SELECT moving_average(v, 2) FROM m"))
+        assert [r[1] for r in s["values"]] == [3.0, 5.0, 7.0]
+
+    def test_elapsed(self, env):
+        e, ex = env
+        write_seq(e, [1, 1, 1])
+        s = series_of(q(ex, "SELECT elapsed(v, 1s) FROM m"))
+        assert [r[1] for r in s["values"]] == [10, 10]
+
+
+class TestTransformsOverAggregates:
+    def test_derivative_of_mean(self, env):
+        e, ex = env
+        # minute means: 0..5 -> 2.5, 6..11 -> 8.5, 12..17 -> 14.5
+        write_seq(e, list(range(18)))
+        s = series_of(q(
+            ex,
+            f"SELECT derivative(mean(v), 1m) FROM m WHERE time >= {BASE*NS} "
+            f"AND time < {(BASE+180)*NS} GROUP BY time(1m)",
+        ))
+        assert [r[1] for r in s["values"]] == [6.0, 6.0]
+
+    def test_transform_requires_group_by_time(self, env):
+        e, ex = env
+        write_seq(e, [1, 2])
+        res = q(ex, "SELECT derivative(mean(v)) FROM m")
+        assert "GROUP BY time" in res["results"][0]["error"]
+
+    def test_raw_transform_rejects_group_by_time(self, env):
+        e, ex = env
+        write_seq(e, [1, 2])
+        res = q(ex, "SELECT derivative(v) FROM m GROUP BY time(1m)")
+        assert "error" in res["results"][0]
+
+
+class TestHostAggs:
+    def test_mode(self, env):
+        e, ex = env
+        write_seq(e, [1, 2, 2, 3, 3])  # tie 2 vs 3 -> smallest (2)
+        s = series_of(q(ex, "SELECT mode(v) FROM m"))
+        assert s["values"][0][1] == 2.0
+
+    def test_integral_trapezoid(self, env):
+        e, ex = env
+        write_seq(e, [0, 10], step=10)
+        s = series_of(q(ex, "SELECT integral(v) FROM m"))
+        # trapezoid: (0+10)/2 * 10s = 50
+        assert s["values"][0][1] == pytest.approx(50.0)
+
+    def test_integral_unit(self, env):
+        e, ex = env
+        write_seq(e, [0, 10], step=10)
+        s = series_of(q(ex, "SELECT integral(v, 10s) FROM m"))
+        assert s["values"][0][1] == pytest.approx(5.0)
+
+    def test_mixed_host_agg_and_transform_columns(self, env):
+        e, ex = env
+        write_seq(e, list(range(12)))
+        res = q(
+            ex,
+            f"SELECT mode(v), difference(mean(v)) FROM m WHERE time >= {BASE*NS} "
+            f"AND time < {(BASE+120)*NS} GROUP BY time(1m)",
+        )
+        s = series_of(res)
+        assert s["columns"] == ["time", "mode", "difference"]
+        assert s["values"][0][1] == 0.0 and s["values"][0][2] is None
+        assert s["values"][1][2] == 6.0
+
+
+class TestMultiRow:
+    def test_top_bottom(self, env):
+        e, ex = env
+        write_seq(e, [5, 1, 9, 7, 3])
+        s = series_of(q(ex, "SELECT top(v, 2) FROM m"))
+        assert sorted(r[1] for r in s["values"]) == [7.0, 9.0]
+        # output ordered by time
+        assert s["values"][0][0] < s["values"][1][0]
+        s = series_of(q(ex, "SELECT bottom(v, 2) FROM m"))
+        assert sorted(r[1] for r in s["values"]) == [1.0, 3.0]
+
+    def test_distinct(self, env):
+        e, ex = env
+        write_seq(e, [2, 1, 2, 1, 3])
+        s = series_of(q(ex, "SELECT distinct(v) FROM m"))
+        assert [r[1] for r in s["values"]] == [1.0, 2.0, 3.0]
+
+    def test_sample_count(self, env):
+        e, ex = env
+        write_seq(e, list(range(10)))
+        s = series_of(q(ex, "SELECT sample(v, 3) FROM m"))
+        assert len(s["values"]) == 3
+
+    def test_top_must_be_only_field(self, env):
+        e, ex = env
+        write_seq(e, [1])
+        res = q(ex, "SELECT top(v, 2), mean(v) FROM m")
+        assert "only field" in res["results"][0]["error"]
+
+    def test_top_per_group(self, env):
+        e, ex = env
+        e.write_lines("db", "\n".join(
+            f"m,h={h} v={v} {(BASE + i * 10) * NS}"
+            for i, (h, v) in enumerate([("a", 1), ("a", 5), ("b", 9), ("b", 2)])
+        ))
+        res = q(ex, "SELECT top(v, 1) FROM m GROUP BY h")
+        series = res["results"][0]["series"]
+        got = {s["tags"]["h"]: s["values"][0][1] for s in series}
+        assert got == {"a": 5.0, "b": 9.0}
+
+
+class TestReviewRegressions:
+    def test_transform_duplicate_timestamps_across_series(self, env):
+        """Two series sharing a timestamp: cumulative_sum must not drop rows."""
+        e, ex = env
+        e.write_lines("db", "\n".join([
+            f"m,h=a v=100 {(BASE)*NS}",
+            f"m,h=a v=100 {(BASE+10)*NS}",
+            f"m,h=b v=200 {(BASE+10)*NS}",
+        ]))
+        s = series_of(q(ex, "SELECT cumulative_sum(v) FROM m"))
+        assert len(s["values"]) == 3
+        assert [r[1] for r in s["values"]] == [100.0, 200.0, 400.0]
+
+    def test_percentile_missing_param_is_error(self, env):
+        e, ex = env
+        write_seq(e, [1, 2])
+        res = q(ex, "SELECT mode(v), percentile(v) FROM m")
+        assert "argument" in res["results"][0]["error"]
+
+    def test_string_field_host_aggs(self, env):
+        e, ex = env
+        e.write_lines(
+            "db",
+            f'm s="b" {BASE*NS}\nm s="a" {(BASE+1)*NS}\nm s="b" {(BASE+2)*NS}',
+        )
+        res = q(ex, "SELECT mode(v), spread(s) FROM m")
+        assert "string field" in res["results"][0]["error"]
+        s = series_of(q(ex, "SELECT mode(s) FROM m"))
+        assert s["values"][0][1] == "b"
+        s = series_of(q(ex, "SELECT distinct(s) FROM m"))
+        assert [r[1] for r in s["values"]] == ["a", "b"]
+
+    def test_into_bad_rp_is_statement_error(self, env):
+        e, ex = env
+        write_seq(e, [1])
+        res = q(ex, f"SELECT mean(v) INTO db.badrp.m2 FROM m WHERE time >= {BASE*NS}")
+        assert "retention policy" in res["results"][0]["error"]
+
+    def test_top_respects_limit_and_desc(self, env):
+        e, ex = env
+        write_seq(e, [5, 1, 9, 7, 3])
+        s = series_of(q(ex, "SELECT top(v, 3) FROM m LIMIT 1"))
+        assert len(s["values"]) == 1
+        s = series_of(q(ex, "SELECT top(v, 3) FROM m ORDER BY time DESC"))
+        times = [r[0] for r in s["values"]]
+        assert times == sorted(times, reverse=True)
