@@ -59,6 +59,7 @@ class PromEngine:
 
     def query_range(self, text: str, start_s: float, end_s: float, step_s: float,
                     db: str) -> dict:
+        self._check_readable()
         if step_s <= 0:
             raise PromError("step must be positive")
         if not (math.isfinite(start_s) and math.isfinite(end_s) and math.isfinite(step_s)):
@@ -84,6 +85,7 @@ class PromEngine:
         return {"resultType": "matrix", "result": result}
 
     def query_instant(self, text: str, time_s: float, db: str) -> dict:
+        self._check_readable()
         steps = np.array([time_s])
         expr = pp.parse(text)
         frame = self._eval(expr, steps, db)
@@ -97,6 +99,10 @@ class PromEngine:
                 )
         result.sort(key=lambda r: sorted(r["metric"].items()))
         return {"resultType": "vector", "result": result}
+
+    def _check_readable(self) -> None:
+        if getattr(self.engine, "read_disabled", False):
+            raise PromError("reads are disabled (syscontrol)")
 
     # -- evaluation -------------------------------------------------------
 

@@ -30,6 +30,8 @@ from opengemini_tpu.query import functions as fnmod
 from opengemini_tpu.record import FieldType, FieldTypeConflict
 from opengemini_tpu.sql import ast
 from opengemini_tpu.storage.engine import WriteError
+from opengemini_tpu.utils import tracing
+from opengemini_tpu.utils.stats import GLOBAL as STATS
 from opengemini_tpu.sql.parser import parse
 
 NS = 1_000_000_000
@@ -81,6 +83,9 @@ _READONLY_STMTS = (
 
 
 def _is_readonly(stmt) -> bool:
+    if isinstance(stmt, ast.ExplainStatement):
+        # EXPLAIN ANALYZE executes the inner select — INTO would mutate
+        return stmt.select is None or stmt.select.into is None
     if not isinstance(stmt, _READONLY_STMTS):
         return False
     # SELECT ... INTO mutates
@@ -105,6 +110,7 @@ class Executor:
             stmts = parse(text)
         except ValueError as e:
             return {"results": [{"statement_id": 0, "error": f"error parsing query: {e}"}]}
+        STATS.incr("executor", "queries")
         results = []
         for i, stmt in enumerate(stmts):
             try:
@@ -112,6 +118,10 @@ class Executor:
                     raise QueryError(
                         f"{type(stmt).__name__} queries must be sent via POST"
                     )
+                if self.engine.read_disabled and isinstance(
+                    stmt, (ast.SelectStatement, ast.ExplainStatement)
+                ):
+                    raise QueryError("reads are disabled (syscontrol)")
                 res = self.execute_statement(stmt, db, now_ns)
             except (
                 QueryError, cond.ConditionError, KeyError, ValueError,
@@ -124,7 +134,10 @@ class Executor:
 
     def execute_statement(self, stmt, db: str, now_ns: int) -> dict:
         if isinstance(stmt, ast.SelectStatement):
+            STATS.incr("executor", "selects")
             return self._select(stmt, db, now_ns)
+        if isinstance(stmt, ast.ExplainStatement):
+            return self._explain(stmt, db, now_ns)
         if isinstance(stmt, ast.ShowDatabases):
             rows = [[name] for name in self.engine.database_names()]
             return _series_result("databases", None, ["name"], rows)
@@ -188,7 +201,52 @@ class Executor:
 
     # -- SELECT -------------------------------------------------------------
 
-    def _select(self, stmt: ast.SelectStatement, db: str, now_ns: int) -> dict:
+    def _explain(self, stmt: ast.ExplainStatement, db: str, now_ns: int) -> dict:
+        """EXPLAIN [ANALYZE] SELECT (reference:
+        executeExplainAnalyzeStatement, statement_executor.go:943)."""
+        sel = stmt.select
+        if stmt.analyze:
+            trace = tracing.Trace("EXPLAIN ANALYZE")
+            self._select(sel, db, now_ns, trace=trace)
+            trace.finish()
+            lines = trace.render()
+            return _series_result(
+                "", None, ["EXPLAIN ANALYZE"], [[line] for line in lines]
+            )
+        # EXPLAIN: describe the plan without executing (same validation
+        # as _select so the output never lies about a missing database)
+        lines = []
+        path = {
+            "raw": "RAW SCAN (host merge)",
+            "device": "DEVICE SEGMENTED REDUCTION (jit plan template)",
+            "host": "HOST FUNCTION PIPELINE",
+        }[_classify_select(sel)]
+        for src in sel.sources:
+            if isinstance(src, ast.SubQuery):
+                raise QueryError("subqueries are not supported yet")
+            src_db = src.database or db
+            if not src_db:
+                raise QueryError("database name required")
+            if src_db not in self.engine.databases:
+                raise QueryError(f"database not found: {src_db}")
+            names = self._resolve_measurements(src, src_db)
+            for mst in names:
+                ctx = self._scan_context(sel, src_db, src.rp or None, mst, now_ns)
+                lines.append(f"QUERY PLAN for {mst}: {path}")
+                if ctx is None:
+                    lines.append("    no matching shards/series")
+                    continue
+                lines.append(f"    shards: {len(ctx.shards)}")
+                lines.append(f"    series: {len(ctx.scan_plan)}")
+                lines.append(f"    groups: {len(ctx.group_keys)}  windows: {ctx.W}")
+                lines.append(
+                    f"    time range: [{ctx.tmin}, {ctx.tmax})  "
+                    f"segments: {len(ctx.group_keys) * ctx.W}"
+                )
+        return _series_result("", None, ["QUERY PLAN"], [[line] for line in lines])
+
+    def _select(self, stmt: ast.SelectStatement, db: str, now_ns: int,
+                trace=tracing.NOOP) -> dict:
         for src in stmt.sources:
             if isinstance(src, ast.SubQuery):
                 raise QueryError("subqueries are not supported yet")
@@ -202,9 +260,12 @@ class Executor:
                 raise QueryError(f"database not found: {src_db}")
             names = self._resolve_measurements(src, src_db)
             for mst in names:
-                all_series.extend(
-                    self._select_measurement(stmt, src_db, src.rp or None, mst, now_ns)
-                )
+                with trace.span(f"select: {mst}"):
+                    all_series.extend(
+                        self._select_measurement(
+                            stmt, src_db, src.rp or None, mst, now_ns, trace
+                        )
+                    )
         # SLIMIT/SOFFSET over series
         if stmt.soffset:
             all_series = all_series[stmt.soffset :]
@@ -261,13 +322,14 @@ class Executor:
                     names.add(m)
         return sorted(names)
 
-    def _select_measurement(self, stmt, db, rp, mst, now_ns) -> list[dict]:
-        # classify fields: device-aggregate query, host-function query, raw
-        calls = _collect_calls(stmt.fields)
-        if not calls:
+    def _select_measurement(self, stmt, db, rp, mst, now_ns, trace=tracing.NOOP) -> list[dict]:
+        kind = _classify_select(stmt)
+        if kind == "raw":
             return self._select_raw(stmt, db, rp, mst, now_ns)
-        if all(_is_device_call(c) for c in calls):
-            return self._select_agg(stmt, db, rp, mst, now_ns, calls)
+        if kind == "device":
+            return self._select_agg(
+                stmt, db, rp, mst, now_ns, _collect_calls(stmt.fields), trace
+            )
         return self._select_host(stmt, db, rp, mst, now_ns)
 
     # -- shared scan planning ----------------------------------------------
@@ -336,8 +398,13 @@ class Executor:
 
     # -- aggregate path -----------------------------------------------------
 
-    def _select_agg(self, stmt, db, rp, mst, now_ns, calls) -> list[dict]:
-        ctx = self._scan_context(stmt, db, rp, mst, now_ns)
+    def _select_agg(self, stmt, db, rp, mst, now_ns, calls, trace=tracing.NOOP) -> list[dict]:
+        with trace.span("map_shards") as sp:
+            ctx = self._scan_context(stmt, db, rp, mst, now_ns)
+            if ctx is not None:
+                sp.add_field("shards", len(ctx.shards))
+                sp.add_field("series", len(ctx.scan_plan))
+                sp.add_field("groups x windows", f"{len(ctx.group_keys)} x {ctx.W}")
         if ctx is None:
             return []
         sc, shards = ctx.sc, ctx.shards
@@ -377,48 +444,59 @@ class Executor:
         if tmax - aligned >= (1 << 61):
             raise QueryError("time range too large (over ~73 years) for aggregation")
 
-        for sh, sid, gid in scan_plan:
-            rec = sh.read_series(mst, sid, tmin, tmax, fields=read_fields)
-            if len(rec) == 0:
-                continue
-            fmask = (
-                cond.eval_field_expr(sc.field_expr, rec)
-                if sc.field_expr is not None
-                else None
-            )
-            if group_time:
-                widx, _ = winmod.window_index(
-                    rec.times, tmin, group_time.every_ns, group_time.offset_ns
-                )
-                seg = gid * W + widx.astype(np.int64)
-            else:
-                seg = np.full(len(rec), gid, dtype=np.int64)
-            rel = rec.times - aligned  # int64 ns; split on add()
-            for fname in needed_fields:
-                col = rec.columns.get(fname)
-                if col is None:
+        rows_scanned = 0
+        with trace.span("scan") as scan_span:
+            for sh, sid, gid in scan_plan:
+                rec = sh.read_series(mst, sid, tmin, tmax, fields=read_fields)
+                if len(rec) == 0:
                     continue
-                if col.ftype in (FieldType.STRING,):
-                    vals = np.zeros(len(rec), dtype=dtype)  # count-only path
-                elif col.ftype == FieldType.BOOL:
-                    vals = col.values.astype(dtype)
+                rows_scanned += len(rec)
+                fmask = (
+                    cond.eval_field_expr(sc.field_expr, rec)
+                    if sc.field_expr is not None
+                    else None
+                )
+                if group_time:
+                    widx, _ = winmod.window_index(
+                        rec.times, tmin, group_time.every_ns, group_time.offset_ns
+                    )
+                    seg = gid * W + widx.astype(np.int64)
                 else:
-                    vals = col.values.astype(dtype)
-                m = col.valid.copy()
-                if fmask is not None:
-                    m &= fmask
-                batches[fname].add(vals, rel, seg.astype(np.int32), m, rec.times)
+                    seg = np.full(len(rec), gid, dtype=np.int64)
+                rel = rec.times - aligned  # int64 ns; split on add()
+                for fname in needed_fields:
+                    col = rec.columns.get(fname)
+                    if col is None:
+                        continue
+                    if col.ftype in (FieldType.STRING,):
+                        vals = np.zeros(len(rec), dtype=dtype)  # count-only path
+                    else:
+                        vals = col.values.astype(dtype)
+                    m = col.valid.copy()
+                    if fmask is not None:
+                        m &= fmask
+                    batches[fname].add(vals, rel, seg.astype(np.int32), m, rec.times)
+            scan_span.add_field("rows", rows_scanned)
+        STATS.incr("executor", "rows_scanned", rows_scanned)
 
         # run aggregates on device
         agg_results = {}  # id(call) -> (values, sel, counts)
-        for call, spec, params, field_name in aggs:
-            out, sel, counts = batches[field_name].run(spec, num_segments, params)
-            agg_results[id(call)] = (out, sel, counts, spec, field_name)
+        with trace.span("device_compute") as sp:
+            for call, spec, params, field_name in aggs:
+                out, sel, counts = batches[field_name].run(spec, num_segments, params)
+                agg_results[id(call)] = (out, sel, counts, spec, field_name)
+            sp.add_field("aggregates", len(aggs))
+            sp.add_field("segments", num_segments)
+            sp.add_field(
+                "batch_rows", {f: b.n for f, b in batches.items()}
+            )
+            STATS.incr("executor", "device_batches", len(aggs))
 
-        return self._render_agg(
-            stmt, mst, group_tags, group_keys, aligned, W, agg_results,
-            batches, schema, tmin,
-        )
+        with trace.span("render"):
+            return self._render_agg(
+                stmt, mst, group_tags, group_keys, aligned, W, agg_results,
+                batches, schema, tmin,
+            )
 
     def _group_tags(self, stmt, shards, mst) -> list[str]:
         if stmt.group_by_all_tags:
@@ -940,6 +1018,17 @@ def _calls_in(e) -> list[ast.Call]:
     if isinstance(e, ast.UnaryExpr):
         return _calls_in(e.expr)
     return []
+
+
+def _classify_select(stmt: ast.SelectStatement) -> str:
+    """'raw' | 'device' | 'host' — the single source of truth for which
+    execution path a SELECT takes (used by execution AND EXPLAIN)."""
+    calls = _collect_calls(stmt.fields)
+    if not calls:
+        return "raw"
+    if all(_is_device_call(c) for c in calls):
+        return "device"
+    return "host"
 
 
 def _is_device_call(call: ast.Call) -> bool:

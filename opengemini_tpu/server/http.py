@@ -28,7 +28,8 @@ from opengemini_tpu.promql.parser import PromParseError, parse_duration_s
 from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query.executor import Executor
 from opengemini_tpu.record import FieldTypeConflict
-from opengemini_tpu.storage.engine import DatabaseNotFound, Engine
+from opengemini_tpu.storage.engine import DatabaseNotFound, Engine, WriteError
+from opengemini_tpu.utils.stats import GLOBAL as STATS
 
 _EPOCH_DIV = {"ns": 1, "u": 1_000, "µ": 1_000, "ms": 1_000_000, "s": 1_000_000_000,
               "m": 60_000_000_000, "h": 3_600_000_000_000}
@@ -153,6 +154,13 @@ def _make_handler(svc: HttpService):
                 self._handle_query(self._params(), read_only=True)
             elif path.startswith("/api/v1/"):
                 self._handle_prom(path, self._params())
+            elif path == "/debug/vars":
+                import time as _t
+
+                snap = {"system": {"uptime_s": round(_t.time() - STATS.started_at, 1),
+                                   "version": __version__}}
+                snap.update(STATS.snapshot())
+                self._send_json(200, snap)
             else:
                 self._send_json(404, {"error": "not found"})
 
@@ -180,8 +188,28 @@ def _make_handler(svc: HttpService):
             elif path.startswith("/api/v1/"):
                 self._merge_form_body(params)
                 self._handle_prom(path, params)
+            elif path == "/debug/ctrl":
+                self._handle_syscontrol(params)
             else:
                 self._send_json(404, {"error": "not found"})
+
+        def _handle_syscontrol(self, params: dict):
+            """Runtime admin toggles (reference: lib/syscontrol
+            syscontrol.go:42-300, /debug/ctrl?mod=...&switchon=...)."""
+            mod = params.get("mod", "")
+            on = params.get("switchon", "").lower() in ("true", "1")
+            if mod == "disablewrite":
+                svc.engine.write_disabled = on
+            elif mod == "disableread":
+                svc.engine.read_disabled = on
+            elif mod == "readonly":
+                svc.engine.write_disabled = on
+            elif mod == "flush":
+                svc.engine.flush_all()
+            else:
+                self._send_json(400, {"error": f"unknown syscontrol mod {mod!r}"})
+                return
+            self._send_json(200, {"status": "ok", "mod": mod, "switchon": on})
 
         def _handle_query(self, params: dict, read_only: bool = False):
             q = params.get("q", "")
@@ -258,6 +286,9 @@ def _make_handler(svc: HttpService):
                 return
             except (ParseError, FieldTypeConflict, ValueError) as e:
                 self._send_json(400, {"error": f"partial write: {e}"})
+                return
+            except WriteError as e:
+                self._send_json(403, {"error": str(e)})
                 return
             self._send(204)
 

@@ -251,3 +251,9 @@ class DropContinuousQuery:
 @dataclass
 class ShowContinuousQueries:
     pass
+
+
+@dataclass
+class ExplainStatement:
+    select: "SelectStatement | None" = None
+    analyze: bool = False

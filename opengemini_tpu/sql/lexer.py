@@ -18,7 +18,7 @@ KEYWORDS = {
     "with", "key", "in", "on", "duration", "replication", "shard", "default",
     "into", "true", "false", "null", "none", "previous", "linear", "tz",
     "measurement", "delete", "as", "name", "continuous", "query", "queries",
-    "begin", "end", "resample", "every", "for",
+    "begin", "end", "resample", "every", "for", "explain", "analyze",
 }
 
 _DUR_RE = re.compile(r"(\d+)(ns|u|µ|us|ms|s|m|h|d|w)")

@@ -102,6 +102,10 @@ class Parser:
             raise ParseError(f"expected statement, got {tok.val!r}")
         if tok.val == "select":
             return self.parse_select()
+        if tok.val == "explain":
+            self.lex.next()
+            analyze = self._accept_kw("analyze") is not None
+            return ast.ExplainStatement(self.parse_select(), analyze)
         if tok.val == "show":
             return self.parse_show()
         if tok.val == "create":

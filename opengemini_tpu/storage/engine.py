@@ -16,6 +16,7 @@ import time as _time
 
 from opengemini_tpu.ingest import line_protocol as lp
 from opengemini_tpu.storage.shard import Shard
+from opengemini_tpu.utils.stats import GLOBAL as STATS
 
 NS = 1_000_000_000
 DEFAULT_SHARD_DURATION = 7 * 24 * 3600 * NS  # influx 1w default for infinite RPs
@@ -118,6 +119,9 @@ class Engine:
         self.flush_threshold_bytes = flush_threshold_bytes
         os.makedirs(root, exist_ok=True)
         self._lock = threading.RLock()
+        # syscontrol toggles (reference: lib/syscontrol disable write/read)
+        self.write_disabled = False
+        self.read_disabled = False
         self.databases: dict[str, Database] = {}
         # (db, rp, group_start) -> Shard
         self._shards: dict[tuple[str, str, int], Shard] = {}
@@ -281,6 +285,8 @@ class Engine:
     ) -> int:
         """Parse + route + apply a line-protocol batch
         (reference write path, SURVEY.md §3.1). Returns points written."""
+        if self.write_disabled:
+            raise WriteError("writes are disabled (syscontrol)")
         d = self.databases.get(db)
         if d is None:
             raise DatabaseNotFound(db)
@@ -290,6 +296,7 @@ class Engine:
         points = lp.parse_lines(lines, precision, now_ns)
         if not points:
             return 0
+        STATS.incr("write", "points", len(points))
         raw = lines.encode("utf-8") if isinstance(lines, str) else lines
         with self._lock:
             # group points by target shard (time routing)
@@ -379,6 +386,8 @@ class Engine:
         used by SELECT INTO and internal services; values never round-trip
         through line-protocol text (reference RecordWriter analogue,
         coordinator/record_writer.go)."""
+        if self.write_disabled:
+            raise WriteError("writes are disabled (syscontrol)")
         d = self.databases.get(db)
         if d is None:
             raise DatabaseNotFound(db)

@@ -1,0 +1,42 @@
+"""Self-monitoring statistics registry.
+
+Reference: lib/statisticsPusher (~40 statistic modules accumulated and
+pushed to file/http/_internal). Here: a process-wide registry of named
+counters, exposed at /debug/vars (the influxdb expvar convention) and
+pushable into an `_internal` database by the monitor service.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class Statistics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, dict[str, int]] = defaultdict(lambda: defaultdict(int))
+        self.started_at = time.time()
+
+    def incr(self, module: str, name: str, delta: int = 1) -> None:
+        with self._lock:
+            self._counters[module][name] += delta
+
+    def set(self, module: str, name: str, value: int) -> None:
+        with self._lock:
+            self._counters[module][name] = value
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                m: dict(vals) for m, vals in self._counters.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+# process-wide registry (the reference's statistics singletons)
+GLOBAL = Statistics()

@@ -1,0 +1,98 @@
+"""Query-execution trace tree (reference: lib/tracing — Trace/Span
+span.go:31 with StartPP/EndPP wall-time measurement and fields; serialized
+back to the client by EXPLAIN ANALYZE, statement_executor.go:943).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+class Span:
+    __slots__ = ("name", "fields", "children", "_t0", "elapsed_ns")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.fields: list[tuple[str, object]] = []
+        self.children: list[Span] = []
+        self._t0 = time.perf_counter_ns()
+        self.elapsed_ns = 0
+
+    def add_field(self, key: str, value) -> None:
+        self.fields.append((key, value))
+
+    def finish(self) -> None:
+        self.elapsed_ns = time.perf_counter_ns() - self._t0
+
+
+class Trace:
+    def __init__(self, name: str):
+        self.root = Span(name)
+        self._stack = [self.root]
+
+    @contextmanager
+    def span(self, name: str):
+        s = Span(name)
+        self._stack[-1].children.append(s)
+        self._stack.append(s)
+        try:
+            yield s
+        finally:
+            s.finish()
+            self._stack.pop()
+
+    def add_field(self, key: str, value) -> None:
+        self._stack[-1].add_field(key, value)
+
+    def finish(self) -> None:
+        self.root.finish()
+
+    def render(self) -> list[str]:
+        """Indented tree lines (the EXPLAIN ANALYZE payload)."""
+        lines: list[str] = []
+
+        def walk(span: Span, depth: int):
+            pad = "    " * depth
+            lines.append(f"{pad}{span.name}: {_fmt_ns(span.elapsed_ns)}")
+            for k, v in span.fields:
+                lines.append(f"{pad}    {k}: {v}")
+            for c in span.children:
+                walk(c, depth + 1)
+
+        walk(self.root, 0)
+        return lines
+
+
+class NoopTrace:
+    """Zero-cost stand-in when tracing is off: the executor calls trace
+    methods unconditionally."""
+
+    @contextmanager
+    def span(self, name: str):
+        yield _NOOP_SPAN
+
+    def add_field(self, key: str, value) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+
+class _NoopSpan:
+    def add_field(self, key: str, value) -> None:
+        pass
+
+
+_NOOP_SPAN = _NoopSpan()
+NOOP = NoopTrace()
+
+
+def _fmt_ns(ns: int) -> str:
+    if ns >= 1_000_000_000:
+        return f"{ns / 1e9:.3f}s"
+    if ns >= 1_000_000:
+        return f"{ns / 1e6:.3f}ms"
+    if ns >= 1_000:
+        return f"{ns / 1e3:.1f}µs"
+    return f"{ns}ns"

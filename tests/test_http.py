@@ -180,3 +180,59 @@ def test_prom_bad_query_400(server):
     status, body = get(server, "/api/v1/query", query="rate(", time="0")
     assert status == 400
     assert json.loads(body)["status"] == "error"
+
+
+def test_explain_and_explain_analyze(server):
+    post(server, "/write", f"cpu v=1 {BASE*NS}\ncpu v=3 {(BASE+60)*NS}".encode(), db="db")
+    _, body = get(server, "/query", db="db", q="EXPLAIN SELECT mean(v) FROM cpu")
+    s = json.loads(body)["results"][0]["series"][0]
+    text = "\n".join(r[0] for r in s["values"])
+    assert "DEVICE SEGMENTED REDUCTION" in text and "series: 1" in text
+    _, body = get(server, "/query", db="db", q="EXPLAIN ANALYZE SELECT mean(v) FROM cpu")
+    s = json.loads(body)["results"][0]["series"][0]
+    text = "\n".join(r[0] for r in s["values"])
+    assert "device_compute" in text and "rows: 2" in text
+
+
+def test_debug_vars_and_syscontrol(server):
+    post(server, "/write", f"m v=1 {BASE*NS}".encode(), db="db")
+    get(server, "/query", db="db", q="SELECT v FROM m")
+    _, body = get(server, "/debug/vars")
+    snap = json.loads(body)
+    assert snap["write"]["points"] >= 1
+    assert snap["executor"]["queries"] >= 1
+    # disable writes
+    status, _ = post(server, "/debug/ctrl", mod="disablewrite", switchon="true")
+    assert status == 200
+    status, body = post(server, "/write", b"m v=2 1", db="db")
+    assert status == 403
+    post(server, "/debug/ctrl", mod="disablewrite", switchon="false")
+    status, _ = post(server, "/write", f"m v=2 {BASE*NS}".encode(), db="db")
+    assert status == 204
+    # disable reads
+    post(server, "/debug/ctrl", mod="disableread", switchon="true")
+    _, body = get(server, "/query", db="db", q="SELECT v FROM m")
+    assert "disabled" in json.loads(body)["results"][0]["error"]
+    post(server, "/debug/ctrl", mod="disableread", switchon="false")
+
+
+def test_explain_validates_like_select(server):
+    # missing db
+    _, body = get(server, "/query", q="EXPLAIN SELECT v FROM cpu")
+    assert "database name required" in json.loads(body)["results"][0]["error"]
+    # missing database
+    _, body = get(server, "/query", db="nope", q="EXPLAIN SELECT v FROM cpu")
+    assert "database not found" in json.loads(body)["results"][0]["error"]
+    # subquery guard
+    _, body = get(server, "/query", db="db", q="EXPLAIN SELECT v FROM (SELECT v FROM cpu)")
+    assert "subqueries" in json.loads(body)["results"][0]["error"]
+
+
+def test_disableread_blocks_promql_too(server):
+    server.engine.create_database("prom")
+    post(server, "/write", f"up value=1 {BASE*NS}".encode(), db="prom")
+    post(server, "/debug/ctrl", mod="disableread", switchon="true")
+    status, body = get(server, "/api/v1/query", query="up", time=str(BASE))
+    assert status == 400
+    assert "disabled" in json.loads(body)["error"]
+    post(server, "/debug/ctrl", mod="disableread", switchon="false")
