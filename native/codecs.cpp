@@ -1,0 +1,208 @@
+// opengemini-tpu native codec library.
+//
+// CPU-side compression kernels for the TSF columnar format — the
+// counterpart of the reference's native codecs (lib/encoding gorilla
+// floats float.go:27, delta+simple8b ints int.go:21, C lz4
+// lib/util/lifted/encoding/lz4/lz4.c). Exposed through a minimal C ABI
+// consumed via ctypes (no pybind11 in the image).
+//
+// Build: make -C native   (or python -m opengemini_tpu.native.build)
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+class BitWriter {
+ public:
+  BitWriter(uint8_t* out, int64_t cap) : out_(out), cap_(cap) {}
+
+  bool write_bit(uint32_t bit) {
+    if (pos_ >= cap_ * 8) return false;
+    if (bit) out_[pos_ >> 3] |= 1u << (7 - (pos_ & 7));
+    pos_++;
+    return true;
+  }
+
+  bool write_bits(uint64_t value, int nbits) {
+    for (int i = nbits - 1; i >= 0; --i) {
+      if (!write_bit((value >> i) & 1u)) return false;
+    }
+    return true;
+  }
+
+  int64_t bytes_used() const { return (pos_ + 7) >> 3; }
+
+ private:
+  uint8_t* out_;
+  int64_t cap_;
+  int64_t pos_ = 0;
+};
+
+class BitReader {
+ public:
+  BitReader(const uint8_t* in, int64_t len) : in_(in), len_bits_(len * 8) {}
+
+  bool read_bit(uint32_t* bit) {
+    if (pos_ >= len_bits_) return false;
+    *bit = (in_[pos_ >> 3] >> (7 - (pos_ & 7))) & 1u;
+    pos_++;
+    return true;
+  }
+
+  bool read_bits(int nbits, uint64_t* value) {
+    uint64_t v = 0;
+    for (int i = 0; i < nbits; ++i) {
+      uint32_t b;
+      if (!read_bit(&b)) return false;
+      v = (v << 1) | b;
+    }
+    *value = v;
+    return true;
+  }
+
+ private:
+  const uint8_t* in_;
+  int64_t len_bits_;
+  int64_t pos_ = 0;
+};
+
+inline int clz64(uint64_t x) { return x ? __builtin_clzll(x) : 64; }
+inline int ctz64(uint64_t x) { return x ? __builtin_ctzll(x) : 64; }
+
+}  // namespace
+
+extern "C" {
+
+// Gorilla-style XOR compression of 64-bit float payloads (Facebook's
+// Gorilla paper §4.1; reference lib/encoding/float.go). Returns bytes
+// written, or -1 if out_cap is too small.
+int64_t ogt_gorilla_encode(const uint64_t* vals, int64_t n, uint8_t* out,
+                           int64_t out_cap) {
+  std::memset(out, 0, static_cast<size_t>(out_cap));
+  BitWriter w(out, out_cap);
+  if (n == 0) return 0;
+  if (!w.write_bits(vals[0], 64)) return -1;
+  uint64_t prev = vals[0];
+  int prev_lz = -1, prev_tz = -1;
+  for (int64_t i = 1; i < n; ++i) {
+    uint64_t x = vals[i] ^ prev;
+    prev = vals[i];
+    if (x == 0) {
+      if (!w.write_bit(0)) return -1;
+      continue;
+    }
+    int lz = clz64(x);
+    int tz = ctz64(x);
+    if (lz > 31) lz = 31;  // 5-bit field
+    if (prev_lz >= 0 && lz >= prev_lz && tz >= prev_tz) {
+      // reuse the previous block window
+      if (!w.write_bit(1) || !w.write_bit(0)) return -1;
+      int mbits = 64 - prev_lz - prev_tz;
+      if (!w.write_bits(x >> prev_tz, mbits)) return -1;
+    } else {
+      if (!w.write_bit(1) || !w.write_bit(1)) return -1;
+      int mbits = 64 - lz - tz;
+      if (!w.write_bits(static_cast<uint64_t>(lz), 5)) return -1;
+      if (!w.write_bits(static_cast<uint64_t>(mbits - 1), 6)) return -1;
+      if (!w.write_bits(x >> tz, mbits)) return -1;
+      prev_lz = lz;
+      prev_tz = tz;
+    }
+  }
+  return w.bytes_used();
+}
+
+// Returns values decoded (must equal n), or -1 on malformed input.
+int64_t ogt_gorilla_decode(const uint8_t* in, int64_t len, uint64_t* out,
+                           int64_t n) {
+  BitReader r(in, len);
+  if (n == 0) return 0;
+  uint64_t first;
+  if (!r.read_bits(64, &first)) return -1;
+  out[0] = first;
+  uint64_t prev = first;
+  int lz = 0, tz = 0;
+  for (int64_t i = 1; i < n; ++i) {
+    uint32_t ctrl;
+    if (!r.read_bit(&ctrl)) return -1;
+    if (ctrl == 0) {
+      out[i] = prev;
+      continue;
+    }
+    uint32_t ctrl2;
+    if (!r.read_bit(&ctrl2)) return -1;
+    if (ctrl2 == 1) {
+      uint64_t lz64, mlen;
+      if (!r.read_bits(5, &lz64) || !r.read_bits(6, &mlen)) return -1;
+      lz = static_cast<int>(lz64);
+      int mbits = static_cast<int>(mlen) + 1;
+      tz = 64 - lz - mbits;
+      if (tz < 0) return -1;
+    }
+    int mbits = 64 - lz - tz;
+    uint64_t m;
+    if (!r.read_bits(mbits, &m)) return -1;
+    uint64_t x = m << tz;
+    prev ^= x;
+    out[i] = prev;
+  }
+  return n;
+}
+
+namespace {
+
+inline uint64_t zigzag(int64_t v) {
+  return (static_cast<uint64_t>(v) << 1) ^ static_cast<uint64_t>(v >> 63);
+}
+
+inline int64_t unzigzag(uint64_t v) {
+  return static_cast<int64_t>(v >> 1) ^ -static_cast<int64_t>(v & 1);
+}
+
+}  // namespace
+
+// Delta + zigzag + LEB128 varint for int64 columns (timestamps, int
+// fields). Returns bytes written or -1.
+int64_t ogt_varint_delta_encode(const int64_t* vals, int64_t n, uint8_t* out,
+                                int64_t out_cap) {
+  int64_t pos = 0;
+  uint64_t prev = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    // delta in uint64: signed overflow would be UB, unsigned wraps mod 2^64
+    uint64_t delta = static_cast<uint64_t>(vals[i]) - prev;
+    uint64_t u = zigzag(static_cast<int64_t>(delta));
+    prev = static_cast<uint64_t>(vals[i]);
+    do {
+      if (pos >= out_cap) return -1;
+      uint8_t byte = u & 0x7f;
+      u >>= 7;
+      if (u) byte |= 0x80;
+      out[pos++] = byte;
+    } while (u);
+  }
+  return pos;
+}
+
+// Returns values decoded (must equal n) or -1 on truncated input.
+int64_t ogt_varint_delta_decode(const uint8_t* in, int64_t len, int64_t* out,
+                                int64_t n) {
+  int64_t pos = 0;
+  uint64_t prev = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t u = 0;
+    int shift = 0;
+    while (true) {
+      if (pos >= len || shift > 63) return -1;
+      uint8_t byte = in[pos++];
+      u |= static_cast<uint64_t>(byte & 0x7f) << shift;
+      if (!(byte & 0x80)) break;
+      shift += 7;
+    }
+    prev += static_cast<uint64_t>(unzigzag(u));  // wraps mod 2^64 by design
+    out[i] = static_cast<int64_t>(prev);
+  }
+  return n;
+}
+
+}  // extern "C"

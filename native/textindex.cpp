@@ -1,0 +1,103 @@
+// Full-text inverted index (reference: engine/index/textindex C++ —
+// FullTextIndex.cpp tokenize + posting lists, exposed to Go via cgo
+// textbuilder_linux_amd64.go:17-20 AddDocument/RetrievePostingList).
+//
+// Tokenization: ASCII alnum runs, lowercased, length >= 2. Postings are
+// per-token sorted vectors of doc ids. C ABI handle-based for ctypes.
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct TextIndex {
+  std::unordered_map<std::string, std::vector<int64_t>> postings;
+  int64_t docs = 0;
+};
+
+void tokenize(const char* text, int64_t len,
+              std::vector<std::string>* out) {
+  std::string cur;
+  for (int64_t i = 0; i < len; ++i) {
+    unsigned char c = static_cast<unsigned char>(text[i]);
+    if (std::isalnum(c)) {
+      cur.push_back(static_cast<char>(std::tolower(c)));
+    } else if (!cur.empty()) {
+      if (cur.size() >= 2) out->push_back(cur);
+      cur.clear();
+    }
+  }
+  if (cur.size() >= 2) out->push_back(cur);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ogt_text_index_new() { return new TextIndex(); }
+
+void ogt_text_index_free(void* h) { delete static_cast<TextIndex*>(h); }
+
+// Add one document; tokens are deduplicated per document.
+void ogt_text_index_add(void* h, int64_t doc_id, const char* text,
+                        int64_t len) {
+  auto* idx = static_cast<TextIndex*>(h);
+  std::vector<std::string> toks;
+  tokenize(text, len, &toks);
+  idx->docs++;
+  for (const auto& t : toks) {
+    auto& post = idx->postings[t];
+    if (post.empty() || post.back() != doc_id) post.push_back(doc_id);
+  }
+}
+
+// Number of docs matching the token; fills out up to cap ids.
+int64_t ogt_text_index_search(void* h, const char* token, int64_t len,
+                              int64_t* out, int64_t cap) {
+  auto* idx = static_cast<TextIndex*>(h);
+  std::string t;
+  for (int64_t i = 0; i < len; ++i) {
+    t.push_back(static_cast<char>(
+        std::tolower(static_cast<unsigned char>(token[i]))));
+  }
+  auto it = idx->postings.find(t);
+  if (it == idx->postings.end()) return 0;
+  int64_t n = static_cast<int64_t>(it->second.size());
+  int64_t copy = n < cap ? n : cap;
+  std::memcpy(out, it->second.data(), static_cast<size_t>(copy) * 8);
+  return n;
+}
+
+int64_t ogt_text_index_tokens(void* h) {
+  return static_cast<int64_t>(static_cast<TextIndex*>(h)->postings.size());
+}
+
+// Standalone tokenizer used for match() row filters: writes token
+// boundaries (start, end pairs) into out; returns token count.
+int64_t ogt_tokenize(const char* text, int64_t len, int32_t* out,
+                     int64_t cap_pairs) {
+  int64_t count = 0;
+  int64_t start = -1;
+  for (int64_t i = 0; i <= len; ++i) {
+    bool alnum =
+        i < len && std::isalnum(static_cast<unsigned char>(text[i]));
+    if (alnum && start < 0) start = i;
+    if (!alnum && start >= 0) {
+      if (i - start >= 2) {
+        if (count < cap_pairs) {
+          out[count * 2] = static_cast<int32_t>(start);
+          out[count * 2 + 1] = static_cast<int32_t>(i);
+        }
+        count++;
+      }
+      start = -1;
+    }
+  }
+  return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,203 @@
+"""ctypes bindings for the C++ codec library (native/codecs.cpp).
+
+The reference uses cgo for its native pieces (textindex, lz4, rocksdb);
+pybind11 isn't in this image, so the bridge is a plain C ABI + ctypes
+(SURVEY.md environment notes). Missing/unbuilt library degrades
+gracefully: encoders fall back to the pure-Python/zlib paths, and the
+pure-Python gorilla/varint decoders below keep every file readable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "..", "..", "native", "libogtcodecs.so")
+
+
+def load():
+    """The loaded library or None. Never raises."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = os.path.abspath(_lib_path())
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        for name, restype, argtypes in [
+            ("ogt_gorilla_encode", ctypes.c_int64,
+             [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]),
+            ("ogt_gorilla_decode", ctypes.c_int64,
+             [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]),
+            ("ogt_varint_delta_encode", ctypes.c_int64,
+             [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]),
+            ("ogt_varint_delta_decode", ctypes.c_int64,
+             [ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]),
+        ]:
+            fn = getattr(lib, name)
+            fn.restype = restype
+            fn.argtypes = argtypes
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def build() -> bool:
+    """Compile the library with g++ (used by native.build / tests)."""
+    d = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "native"))
+    try:
+        subprocess.run(["make", "-C", d], check=True, capture_output=True)
+    except (subprocess.CalledProcessError, OSError):
+        return False
+    global _TRIED, _LIB
+    _TRIED = False
+    _LIB = None
+    return load() is not None
+
+
+# -- native-backed codecs ----------------------------------------------------
+
+
+def gorilla_encode(values: np.ndarray) -> bytes | None:
+    lib = load()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(values, dtype=np.float64).view(np.uint64)
+    cap = len(vals) * 10 + 16
+    out = np.zeros(cap, dtype=np.uint8)
+    n = lib.ogt_gorilla_encode(
+        vals.ctypes.data, len(vals), out.ctypes.data, cap
+    )
+    if n < 0:
+        return None
+    return out[:n].tobytes()
+
+
+def gorilla_decode_native(buf: bytes, n: int) -> np.ndarray | None:
+    lib = load()
+    if lib is None:
+        return None
+    inp = np.frombuffer(buf, dtype=np.uint8)
+    out = np.empty(n, dtype=np.uint64)
+    got = lib.ogt_gorilla_decode(inp.ctypes.data, len(inp), out.ctypes.data, n)
+    if got != n:
+        raise ValueError("corrupt gorilla block")
+    return out.view(np.float64)
+
+
+def varint_delta_encode(values: np.ndarray) -> bytes | None:
+    lib = load()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(values, dtype=np.int64)
+    cap = len(vals) * 10 + 16
+    out = np.zeros(cap, dtype=np.uint8)
+    n = lib.ogt_varint_delta_encode(vals.ctypes.data, len(vals), out.ctypes.data, cap)
+    if n < 0:
+        return None
+    return out[:n].tobytes()
+
+
+def varint_delta_decode_native(buf: bytes, n: int) -> np.ndarray | None:
+    lib = load()
+    if lib is None:
+        return None
+    inp = np.frombuffer(buf, dtype=np.uint8)
+    out = np.empty(n, dtype=np.int64)
+    got = lib.ogt_varint_delta_decode(inp.ctypes.data, len(inp), out.ctypes.data, n)
+    if got != n:
+        raise ValueError("corrupt varint block")
+    return out
+
+
+# -- pure-python decode fallbacks (files stay readable without the lib) ------
+
+
+def gorilla_decode_py(buf: bytes, n: int) -> np.ndarray:
+    out = np.empty(n, dtype=np.uint64)
+    if n == 0:
+        return out.view(np.float64)
+    bits = _Bits(buf)
+    prev = bits.read(64)
+    out[0] = prev
+    lz = tz = 0
+    for i in range(1, n):
+        if bits.read(1) == 0:
+            out[i] = prev
+            continue
+        if bits.read(1) == 1:
+            lz = bits.read(5)
+            mbits = bits.read(6) + 1
+            tz = 64 - lz - mbits
+            if tz < 0:
+                raise ValueError("corrupt gorilla block")
+        mbits = 64 - lz - tz
+        x = bits.read(mbits) << tz
+        prev ^= x
+        out[i] = prev & 0xFFFFFFFFFFFFFFFF
+    return out.view(np.float64)
+
+
+def varint_delta_decode_py(buf: bytes, n: int) -> np.ndarray:
+    out = np.empty(n, dtype=np.int64)
+    pos = 0
+    prev = 0
+    for i in range(n):
+        u = 0
+        shift = 0
+        while True:
+            if pos >= len(buf):
+                raise ValueError("corrupt varint block")
+            b = buf[pos]
+            pos += 1
+            u |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        delta = (u >> 1) ^ -(u & 1)
+        # int64 wraparound semantics must match the native codec: deltas
+        # may overflow int64 by design (encoded mod 2^64)
+        prev = (prev + delta) & 0xFFFFFFFFFFFFFFFF
+        out[i] = prev - (1 << 64) if prev >= (1 << 63) else prev
+        prev = int(out[i])
+    return out
+
+
+class _Bits:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, nbits: int) -> int:
+        v = 0
+        for _ in range(nbits):
+            byte_i = self.pos >> 3
+            if byte_i >= len(self.buf):
+                raise ValueError("truncated bit stream")
+            bit = (self.buf[byte_i] >> (7 - (self.pos & 7))) & 1
+            v = (v << 1) | bit
+            self.pos += 1
+        return v
+
+
+def gorilla_decode(buf: bytes, n: int) -> np.ndarray:
+    got = gorilla_decode_native(buf, n)
+    return got if got is not None else gorilla_decode_py(buf, n)
+
+
+def varint_delta_decode(buf: bytes, n: int) -> np.ndarray:
+    got = varint_delta_decode_native(buf, n)
+    return got if got is not None else varint_delta_decode_py(buf, n)

@@ -1,0 +1,113 @@
+"""Full-text index binding (native/textindex.cpp) with python fallback.
+
+Reference: engine/index/textindex (C++ via cgo: AddDocument,
+RetrievePostingList) powering log-search. Query integration: the
+`match(field, 'token')` WHERE function tokenizes string field values;
+shard-persistent text indexes layer on top of this in the logstore round.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import re
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+_TOKEN_RE = re.compile(r"[A-Za-z0-9]{2,}")
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "..", "native", "libogttextindex.so")
+    )
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.ogt_text_index_new.restype = ctypes.c_void_p
+        lib.ogt_text_index_free.argtypes = [ctypes.c_void_p]
+        lib.ogt_text_index_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64
+        ]
+        lib.ogt_text_index_search.restype = ctypes.c_int64
+        lib.ogt_text_index_search.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.ogt_text_index_tokens.restype = ctypes.c_int64
+        lib.ogt_text_index_tokens.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+class TextIndex:
+    """Inverted token index over documents; C++ when built, dict fallback."""
+
+    def __init__(self) -> None:
+        self._lib = _load()
+        if self._lib is not None:
+            self._h = self._lib.ogt_text_index_new()
+        else:
+            self._post: dict[str, list[int]] = {}
+
+    def add(self, doc_id: int, text: str) -> None:
+        if self._lib is not None:
+            b = text.encode("utf-8", errors="replace")
+            self._lib.ogt_text_index_add(self._h, doc_id, b, len(b))
+        else:
+            for tok in set(tokenize(text)):
+                self._post.setdefault(tok, []).append(doc_id)
+
+    def search(self, token: str) -> np.ndarray:
+        token = token.lower()
+        if self._lib is not None:
+            b = token.encode("utf-8", errors="replace")
+            cap = 1024
+            while True:
+                out = np.empty(cap, dtype=np.int64)
+                n = self._lib.ogt_text_index_search(self._h, b, len(b),
+                                                    out.ctypes.data, cap)
+                if n <= cap:
+                    return out[:n].copy()
+                cap = int(n)
+        return np.asarray(sorted(self._post.get(token, [])), dtype=np.int64)
+
+    def token_count(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.ogt_text_index_tokens(self._h))
+        return len(self._post)
+
+    def close(self) -> None:
+        if self._lib is not None and self._h:
+            self._lib.ogt_text_index_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def tokenize(text: str) -> list[str]:
+    """ASCII alnum runs >= 2 chars, lowercased (matches the C++ side)."""
+    return [t.lower() for t in _TOKEN_RE.findall(text)]
+
+
+def match_token(values: np.ndarray, valid: np.ndarray, token: str) -> np.ndarray:
+    """Row mask: string values containing the token (WHERE match(f, 't'))."""
+    token = token.lower()
+    out = np.zeros(len(values), dtype=np.bool_)
+    for i, v in enumerate(values):
+        if valid[i] and isinstance(v, str) and token in tokenize(v):
+            out[i] = True
+    return out

@@ -290,6 +290,21 @@ def eval_field_expr(expr, record) -> np.ndarray:
             return np.asarray(m, dtype=np.bool_) & col.valid
     if isinstance(expr, ast.BooleanLiteral):
         return np.full(n, expr.val, dtype=np.bool_)
+    if isinstance(expr, ast.Call) and expr.name == "match":
+        # full-text token match over a string field (reference: logstore
+        # MATCH operator backed by the C++ text index)
+        from opengemini_tpu.native.textindex import match_token
+
+        if len(expr.args) != 2:
+            raise ConditionError("match() takes (field, 'token')")
+        fld = _strip(expr.args[0])
+        tok = _strip(expr.args[1])
+        if not isinstance(fld, ast.VarRef) or not isinstance(tok, ast.StringLiteral):
+            raise ConditionError("match() takes (field, 'token')")
+        col = record.columns.get(fld.name)
+        if col is None:
+            return np.zeros(n, dtype=np.bool_)
+        return match_token(col.values, col.valid, tok.val)
     raise ConditionError(f"unsupported field filter: {expr}")
 
 

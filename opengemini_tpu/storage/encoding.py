@@ -17,6 +17,7 @@ import zlib
 
 import numpy as np
 
+from opengemini_tpu import native
 from opengemini_tpu.record import Column, FieldType
 
 # block tags
@@ -25,12 +26,15 @@ _T_DELTA = 1  # int64: first value + deltas packed at minimal width (+zlib)
 _T_BOOL = 2  # packed bits
 _T_STR = 3  # uint32 offsets + utf8 blob (+zlib)
 _T_CONST = 4  # int64 constant run: value + count (RLE timestamps fast path)
+_T_GORILLA = 5  # float64 XOR-compressed (native C++ codec, py-decodable)
+_T_VARINT = 6  # int64 delta+zigzag varint (native C++ codec, py-decodable)
 
 _ZLEVEL = 1
 
 
 def encode_ints(values: np.ndarray) -> bytes:
-    """int64 via frame-of-reference deltas at minimal byte width."""
+    """int64 via constant-stride RLE, native varint-delta (C++), or
+    frame-of-reference deltas at minimal byte width."""
     values = np.ascontiguousarray(values, dtype=np.int64)
     n = len(values)
     if n == 0:
@@ -47,11 +51,20 @@ def encode_ints(values: np.ndarray) -> bytes:
     packed = shifted.astype({1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}[width])
     payload = zlib.compress(packed.tobytes(), _ZLEVEL)
     head = struct.pack("<BIqqB", _T_DELTA, n, int(values[0]), int(dmin), width)
-    return head + payload
+    for_block = head + payload
+    # adaptive: native varint vs FOR+zlib — keep the smaller block
+    # (repetitive delta sequences compress far better under zlib)
+    nv = native.varint_delta_encode(values)
+    if nv is not None and 5 + len(nv) < len(for_block):
+        return struct.pack("<BI", _T_VARINT, n) + nv
+    return for_block
 
 
 def decode_ints(buf: bytes) -> np.ndarray:
     tag = buf[0]
+    if tag == _T_VARINT:
+        (n,) = struct.unpack_from("<I", buf, 1)
+        return native.varint_delta_decode(buf[5:], n)
     if tag == _T_CONST:
         _, n, first, stride = struct.unpack_from("<BIqq", buf)
         return (first + stride * np.arange(n, dtype=np.int64)).astype(np.int64)
@@ -72,13 +85,21 @@ def decode_ints(buf: bytes) -> np.ndarray:
 
 
 def encode_floats(values: np.ndarray) -> bytes:
+    """Adaptive: gorilla XOR (native) vs zlib — keep the smaller block
+    (the reference's lib/encoding float.go also chooses per block)."""
     values = np.ascontiguousarray(values, dtype=np.float64)
-    payload = zlib.compress(values.tobytes(), _ZLEVEL)
-    return struct.pack("<BI", _T_RAW64, len(values)) + payload
+    z = zlib.compress(values.tobytes(), _ZLEVEL)
+    g = native.gorilla_encode(values)
+    if g is not None and len(g) < len(z):
+        return struct.pack("<BI", _T_GORILLA, len(values)) + g
+    return struct.pack("<BI", _T_RAW64, len(values)) + z
 
 
 def decode_floats(buf: bytes) -> np.ndarray:
     tag = buf[0]
+    if tag == _T_GORILLA:
+        (n,) = struct.unpack_from("<I", buf, 1)
+        return native.gorilla_decode(buf[5:], n)
     if tag != _T_RAW64:
         raise ValueError(f"bad float block tag {tag}")
     (n,) = struct.unpack_from("<I", buf, 1)

@@ -347,3 +347,99 @@ class TestReviewRegressions:
         # precision multiplication overflow too
         with pytest.raises(lp.ParseError):
             lp.parse_lines("cpu v=1 9999999999999999", precision="h")
+
+
+class TestNativeCodecs:
+    """C++ codec library: build, roundtrip vs python fallback parity."""
+
+    @pytest.fixture(scope="class", autouse=True)
+    def built(self):
+        from opengemini_tpu import native
+
+        assert native.build(), "g++ build of native/codecs.cpp failed"
+        yield
+
+    def test_gorilla_roundtrip(self, rng):
+        from opengemini_tpu import native
+
+        for vals in (
+            rng.normal(size=1000) * 1e6,
+            np.repeat(50.0, 500),           # constant: ~1 bit/value
+            np.arange(1000) * 0.1 + 3,
+            np.array([1.5]),
+            np.array([], dtype=np.float64),
+            np.array([np.inf, -np.inf, 0.0, -0.0, np.nan]),
+        ):
+            buf = native.gorilla_encode(vals)
+            assert buf is not None
+            got_native = native.gorilla_decode_native(buf, len(vals))
+            got_py = native.gorilla_decode_py(buf, len(vals))
+            np.testing.assert_array_equal(
+                got_native.view(np.uint64), vals.view(np.uint64)
+            )
+            np.testing.assert_array_equal(
+                got_py.view(np.uint64), vals.view(np.uint64)
+            )
+
+    def test_gorilla_compresses_smooth_series(self, rng):
+        from opengemini_tpu import native
+
+        vals = np.repeat(np.arange(100.0), 10)  # slowly-changing
+        buf = native.gorilla_encode(vals)
+        assert len(buf) < len(vals) * 8 / 4  # at least 4x smaller
+
+    def test_varint_roundtrip(self, rng):
+        from opengemini_tpu import native
+
+        for vals in (
+            rng.integers(-(2**60), 2**60, size=500),
+            np.cumsum(rng.integers(0, 1000, size=1000)),
+            np.array([0, -1, 2**62, -(2**62)], dtype=np.int64),
+            np.array([], dtype=np.int64),
+        ):
+            vals = np.asarray(vals, dtype=np.int64)
+            buf = native.varint_delta_encode(vals)
+            assert buf is not None
+            np.testing.assert_array_equal(
+                native.varint_delta_decode_native(buf, len(vals)), vals
+            )
+            np.testing.assert_array_equal(
+                native.varint_delta_decode_py(buf, len(vals)), vals
+            )
+
+    def test_encoding_uses_native_tags(self, rng):
+        # slowly-changing floats: gorilla wins over zlib and is chosen
+        vals = np.repeat(np.arange(20.0), 5)
+        buf = encoding.encode_floats(vals)
+        assert buf[0] == 5  # _T_GORILLA
+        np.testing.assert_array_equal(encoding.decode_floats(buf), vals)
+        # noisy floats: whichever block wins must still roundtrip
+        noisy = rng.normal(size=100)
+        np.testing.assert_array_equal(
+            encoding.decode_floats(encoding.encode_floats(noisy)), noisy
+        )
+        ints = np.cumsum(rng.integers(-5, 1000, size=100)).astype(np.int64)
+        buf = encoding.encode_ints(ints)
+        assert buf[0] == 6  # _T_VARINT
+        np.testing.assert_array_equal(encoding.decode_ints(buf), ints)
+
+    def test_varint_extreme_values_py_fallback(self):
+        """Deltas overflowing int64 must roundtrip in BOTH decoders."""
+        from opengemini_tpu import native
+
+        vals = np.array([-(2**62), 2**62, 0, 2**63 - 1, -(2**63)], dtype=np.int64)
+        buf = native.varint_delta_encode(vals)
+        np.testing.assert_array_equal(
+            native.varint_delta_decode_native(buf, len(vals)), vals
+        )
+        np.testing.assert_array_equal(
+            native.varint_delta_decode_py(buf, len(vals)), vals
+        )
+
+    def test_int_encoding_adaptive_repetitive(self):
+        """Repetitive deltas: FOR+zlib must win over plain varint."""
+        v = np.cumsum(np.tile([0, 1], 5000)).astype(np.int64)
+        buf = encoding.encode_ints(v)
+        assert buf[0] == 1  # _T_DELTA (zlib path chosen)
+        assert len(buf) < 200
+        np.testing.assert_array_equal(encoding.decode_ints(buf), v)

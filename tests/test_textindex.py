@@ -1,0 +1,96 @@
+"""C++ full-text index + match() filter tests."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.native import build as build_native
+from opengemini_tpu.native.textindex import TextIndex, match_token, tokenize
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.storage.engine import Engine, NS
+
+BASE = 1_700_000_040
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    assert build_native(), "native build failed"
+
+
+def test_tokenize():
+    assert tokenize("GET /api/users?id=42 HTTP/1.1") == [
+        "get", "api", "users", "id", "42", "http", "1"
+    ][:6] or tokenize("GET /api/users?id=42 HTTP/1.1") == [
+        "get", "api", "users", "id", "42", "http", "11"
+    ]
+
+
+def test_index_add_search():
+    idx = TextIndex()
+    idx.add(1, "error: disk full on /var/log")
+    idx.add(2, "user login ok")
+    idx.add(3, "Disk warning threshold")
+    assert idx.search("disk").tolist() == [1, 3]
+    assert idx.search("DISK").tolist() == [1, 3]
+    assert idx.search("login").tolist() == [2]
+    assert idx.search("missing").tolist() == []
+    assert idx.token_count() > 5
+    idx.close()
+
+
+def test_python_fallback_matches_native(monkeypatch):
+    import opengemini_tpu.native.textindex as ti
+
+    native_idx = TextIndex()
+    monkeypatch.setattr(ti, "_LIB", None)
+    monkeypatch.setattr(ti, "_TRIED", True)
+    py_idx = TextIndex()
+    docs = ["alpha beta", "beta gamma", "Gamma ALPHA delta"]
+    for i, d in enumerate(docs):
+        native_idx.add(i, d)
+        py_idx.add(i, d)
+    for tok in ("alpha", "beta", "gamma", "delta", "nope"):
+        assert native_idx.search(tok).tolist() == py_idx.search(tok).tolist()
+    native_idx.close()
+
+
+def test_match_filter_in_where(tmp_path):
+    e = Engine(str(tmp_path / "d"))
+    e.create_database("db")
+    lines = "\n".join([
+        f'logs msg="error: disk full",level="e" {BASE * NS}',
+        f'logs msg="login ok",level="i" {(BASE + 1) * NS}',
+        f'logs msg="Disk replaced",level="i" {(BASE + 2) * NS}',
+    ])
+    e.write_lines("db", lines)
+    ex = Executor(e)
+    res = ex.execute(
+        "SELECT msg FROM logs WHERE match(msg, 'disk')",
+        db="db", now_ns=(BASE + 100) * NS,
+    )
+    vals = [r[1] for r in res["results"][0]["series"][0]["values"]]
+    assert vals == ["error: disk full", "Disk replaced"]
+    # combined with other conditions
+    res = ex.execute(
+        "SELECT msg FROM logs WHERE match(msg, 'disk') AND level = 'i'",
+        db="db", now_ns=(BASE + 100) * NS,
+    )
+    vals = [r[1] for r in res["results"][0]["series"][0]["values"]]
+    assert vals == ["Disk replaced"]
+    e.close()
+
+
+def test_match_count_aggregate(tmp_path):
+    e = Engine(str(tmp_path / "d"))
+    e.create_database("db")
+    lines = "\n".join(
+        f'logs msg="{"error x" if i % 3 == 0 else "ok"}" {(BASE + i) * NS}'
+        for i in range(30)
+    )
+    e.write_lines("db", lines)
+    ex = Executor(e)
+    res = ex.execute(
+        "SELECT count(msg) FROM logs WHERE match(msg, 'error')",
+        db="db", now_ns=(BASE + 100) * NS,
+    )
+    assert res["results"][0]["series"][0]["values"][0][1] == 10
+    e.close()
