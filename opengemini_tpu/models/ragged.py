@@ -1,0 +1,341 @@
+"""Ragged-to-dense segment batching: the TPU answer to variable group sizes.
+
+Scatter-based segment reduction on TPU measures ~0.04-1.2 G rows/s; dense
+axis reductions measure ~160 G rows/s (bench.py). So the general
+aggregation path converts ragged (segment id per row) batches into
+SIZE-BUCKETED DENSE matrices on the host and every aggregate becomes a
+dense axis-1 reduction. Design constraints learned on hardware:
+
+  - CANONICAL SHAPES: the WIDTHS ladder (16/64/256/1024, <=4x padding
+    waste) and pow2-padded row counts keep the XLA compile cache tiny
+    (arbitrary (g, w) shapes cost seconds of re-compile per query).
+  - Segments wider than the top width SPLIT into consecutive sub-rows;
+    combine on the host with reduceat (exact k-way variance combination
+    for stddev: SSD = sum_i [ssd_i + c_i (mu_i - mu)^2]).
+  - Offsets within segments come from RUN analysis (rows arrive as
+    consecutive same-segment runs per series chunk), not a global
+    argsort — freeze is O(N) + O(runs log runs).
+
+This is SURVEY.md §7's 'ragged group sizes' hard part. Segments live in
+exactly one bucket; per-bucket results scatter back into (num_segments,)
+outputs host-side.
+
+NOTE on this dev environment: the axon TPU tunnel moves host->device data
+at ~0.03 GB/s (measured), ~1000x below a real TPU host's PCIe/ICI — so
+end-to-end wall times here are transfer-bound and NOT representative;
+bench.py therefore measures device-resident compute. On production
+hardware the freeze (host, ~0.5s / 16M rows) and transfer (~50ms / GB)
+are minor next to the scan/decode stage.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from opengemini_tpu.models import templates
+
+_REL_LO_BITS = 30
+_REL_LO_MASK = (1 << _REL_LO_BITS) - 1
+
+WIDTHS = (16, 64, 256, 1024)  # ~4x max padding waste, 4 canonical shapes
+_MIN_G = 8
+
+# aggregates the dense path supports (others use the scatter/lexsort path)
+DENSE_AGGS = {"sum", "count", "mean", "min", "max", "first", "last",
+              "spread", "stddev"}
+
+
+class BucketedBatch:
+    """Drop-in alternative to templates.AggBatch for dense-capable
+    aggregates. add() accumulates ragged chunks; the first run() freezes
+    the batch into dense buckets."""
+
+    def __init__(self, dtype=None):
+        self.dtype = dtype or templates.compute_dtype()
+        self._vals: list[np.ndarray] = []
+        self._rel: list[np.ndarray] = []
+        self._seg: list[np.ndarray] = []
+        self._mask: list[np.ndarray] = []
+        self._times: list[np.ndarray] = []
+        self.n = 0
+        self._frozen = None
+
+    def add(self, values, rel_ns, seg_ids, mask, times_ns):
+        self._vals.append(np.asarray(values, dtype=self.dtype))
+        self._rel.append(np.asarray(rel_ns, dtype=np.int64))
+        self._seg.append(np.asarray(seg_ids, dtype=np.int64))
+        self._mask.append(np.asarray(mask, dtype=np.bool_))
+        self._times.append(np.asarray(times_ns, dtype=np.int64))
+        self.n += len(values)
+
+    def host_times(self) -> np.ndarray:
+        return np.concatenate(self._times) if self._times else np.empty(0, np.int64)
+
+    # -- freeze: ragged -> dense buckets --------------------------------
+
+    def _freeze(self, num_segments: int):
+        if self._frozen is not None:
+            return self._frozen
+        if self.n == 0:
+            self._frozen = []
+            return self._frozen
+        vals = np.concatenate(self._vals)
+        rel = np.concatenate(self._rel)
+        seg = np.concatenate(self._seg)
+        mask = np.concatenate(self._mask)
+        n = len(vals)
+        row_idx = np.arange(n, dtype=np.int32)
+
+        counts = np.bincount(seg, minlength=num_segments)
+
+        # within-segment arrival offsets via run analysis (no global sort)
+        run_starts = np.concatenate([[0], np.flatnonzero(seg[1:] != seg[:-1]) + 1])
+        run_segs = seg[run_starts]
+        run_lens = np.diff(np.concatenate([run_starts, [n]]))
+        order = np.argsort(run_segs, kind="stable")  # runs, not rows
+        cum = np.zeros(len(run_starts), dtype=np.int64)
+        lens_sorted = run_lens[order]
+        segs_sorted = run_segs[order]
+        csum = np.cumsum(lens_sorted) - lens_sorted
+        first_run_of_seg = np.searchsorted(segs_sorted, segs_sorted)
+        base_sorted = csum - csum[first_run_of_seg]
+        cum[order] = base_sorted
+        offsets = (
+            np.arange(n, dtype=np.int64)
+            - np.repeat(run_starts, run_lens)
+            + np.repeat(cum, run_lens)
+        )
+
+        buckets: list[_Bucket] = []
+        bucket_of = np.full(num_segments, -1, dtype=np.int8)
+        for bi, w in enumerate(WIDTHS):
+            lo = WIDTHS[bi - 1] if bi else 0
+            if w == WIDTHS[-1]:
+                here = counts > lo  # larger segments split into sub-rows
+            else:
+                here = (counts > lo) & (counts <= w)
+            segs_here = np.nonzero(here)[0]
+            if len(segs_here) == 0:
+                continue
+            bucket_of[segs_here] = len(buckets)
+            buckets.append(_Bucket(w, segs_here, counts[segs_here]))
+
+        for b in buckets:
+            w = b.width
+            # sub-row layout: segment k gets ceil(count/w) consecutive rows
+            n_sub = np.maximum((b.seg_counts + w - 1) // w, 1)
+            sub_base = np.cumsum(n_sub) - n_sub  # first sub-row per segment
+            g = int(n_sub.sum())
+            g_pad = _pow2_at_least(g, _MIN_G)
+            slot_of = np.zeros(num_segments, dtype=np.int64)
+            slot_of[b.segs] = sub_base
+            rows = np.nonzero(bucket_of[seg] == _index_of(buckets, b))[0]
+            off = offsets[rows]
+            flat = (slot_of[seg[rows]] + off // w) * w + off % w
+            vmat = np.zeros((g_pad, w), dtype=self.dtype)
+            mmat = np.zeros((g_pad, w), dtype=np.bool_)
+            hmat = np.zeros((g_pad, w), dtype=np.int32)
+            lmat = np.zeros((g_pad, w), dtype=np.int32)
+            imat = np.zeros((g_pad, w), dtype=np.int32)
+            vmat.reshape(-1)[flat] = vals[rows]
+            mmat.reshape(-1)[flat] = mask[rows]
+            r = rel[rows]
+            hmat.reshape(-1)[flat] = (r >> _REL_LO_BITS).astype(np.int32)
+            lmat.reshape(-1)[flat] = (r & _REL_LO_MASK).astype(np.int32)
+            imat.reshape(-1)[flat] = row_idx[rows]
+            b.arrays = (vmat, hmat, lmat, imat, mmat)
+            b.g = g
+            b.sub_base = sub_base
+            b.n_sub = n_sub
+            b.rel = rel  # for host combine of split selectors
+        self._frozen = buckets
+        return buckets
+
+    # -- execution -------------------------------------------------------
+
+    def run(self, spec, num_segments: int, params: tuple = ()):
+        """Same contract as AggBatch.run: (values, sel|None, counts)."""
+        buckets = self._freeze(num_segments)
+        out = np.zeros(num_segments, dtype=np.float64)
+        sel = np.zeros(num_segments, dtype=np.int64)
+        counts = np.zeros(num_segments, dtype=np.int64)
+        is_selector = spec.name in ("min", "max", "first", "last")
+        for b in buckets:
+            st = b.combined(need_selectors=is_selector)
+            counts[b.segs] = st["count"]
+            if spec.name == "spread":
+                out[b.segs] = st["max"] - st["min"]
+            elif spec.name == "stddev":
+                c = np.maximum(st["count"], 1)
+                out[b.segs] = np.sqrt(np.maximum(st["ssd"] / np.maximum(c - 1, 1), 0))
+            else:
+                out[b.segs] = st[spec.name]
+            if is_selector:
+                sel[b.segs] = st["sel_" + spec.name]
+        return out, (sel if is_selector else None), counts
+
+
+class _Bucket:
+    def __init__(self, width: int, segs: np.ndarray, seg_counts: np.ndarray):
+        self.width = width
+        self.segs = segs
+        self.seg_counts = seg_counts
+        self.arrays = None
+        self.g = 0
+        self.sub_base = None
+        self.n_sub = None
+        self.rel = None
+        self._raw: dict = {}
+        self._combined: dict = {}
+
+    def _raw_stats(self, need_selectors: bool) -> dict:
+        """Per-sub-row device stats, computed lazily per group: selector
+        lex scans (4 extra matrix passes) run only for selector queries."""
+        if "count" not in self._raw:
+            got = _stats_jit("basic")(*self.arrays)
+            self._raw.update({k: np.asarray(v)[: self.g] for k, v in got.items()})
+        if need_selectors and "sel_first" not in self._raw:
+            got = _stats_jit("selectors")(*self.arrays)
+            self._raw.update({k: np.asarray(v)[: self.g] for k, v in got.items()})
+        return self._raw
+
+    def combined(self, need_selectors: bool) -> dict:
+        """Per-segment stats: raw sub-row stats + host k-way combine."""
+        if "count" in self._combined and (
+            not need_selectors or "sel_first" in self._combined
+        ):
+            return self._combined
+        raw = self._raw_stats(need_selectors)
+        if (self.n_sub == 1).all():
+            self._combined = dict(raw)
+            self._combined["count"] = raw["count"].astype(np.int64)
+            return self._combined
+        starts = self.sub_base
+        out = self._combined
+        if "count" not in out:
+            cnt = np.add.reduceat(raw["count"], starts).astype(np.int64)
+            s = np.add.reduceat(raw["sum"], starts)
+            mean = s / np.maximum(cnt, 1)
+            # exact k-way variance combination:
+            # SSD = sum_i [ssd_i + c_i (mu_i - mu)^2]
+            mean_rep = np.repeat(mean, self.n_sub)
+            extra = raw["count"] * (raw["mean"] - mean_rep) ** 2
+            out.update(
+                count=cnt,
+                sum=s,
+                mean=mean,
+                min=np.minimum.reduceat(raw["min"], starts),
+                max=np.maximum.reduceat(raw["max"], starts),
+                ssd=np.add.reduceat(raw["ssd"] + extra, starts),
+            )
+        if need_selectors and "sel_first" not in out:
+            rel = self.rel
+            i64max = np.iinfo(np.int64).max
+            i64min = np.iinfo(np.int64).min
+            for name, latest in (("first", False), ("last", True)):
+                sel_sub = raw["sel_" + name]
+                r = np.where(
+                    raw["count"] > 0, rel[sel_sub], i64max if not latest else i64min
+                )
+                red = np.maximum if latest else np.minimum
+                best_rep = np.repeat(red.reduceat(r, starts), self.n_sub)
+                hit = (r == best_rep) & (raw["count"] > 0)
+                idx_sub = np.where(hit, np.arange(len(r)), len(r))
+                pick = np.clip(np.minimum.reduceat(idx_sub, starts), 0, len(r) - 1)
+                out[name] = raw[name][pick]
+                out["sel_" + name] = sel_sub[pick]
+            for name in ("min", "max"):
+                sel_sub = raw["sel_" + name]
+                ext_rep = np.repeat(out[name], self.n_sub)
+                hit = (raw[name] == ext_rep) & (raw["count"] > 0)
+                r = np.where(hit, rel[sel_sub], i64max)
+                best_rep = np.repeat(np.minimum.reduceat(r, starts), self.n_sub)
+                hit &= r == best_rep
+                idx_sub = np.where(hit, np.arange(len(r)), len(r))
+                pick = np.clip(np.minimum.reduceat(idx_sub, starts), 0, len(r) - 1)
+                out["sel_" + name] = sel_sub[pick]
+        return out
+
+
+def _index_of(buckets: list, b) -> int:
+    for i, x in enumerate(buckets):
+        if x is b:
+            return i
+    raise ValueError
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+_STATS_FNS: dict = {}
+_BIG_I32 = 2**31 - 1
+
+
+def _stats_jit(kind: str):
+    """Compiled per-sub-row stat kernels: 'basic' (one fused pass for
+    count/sum/mean/min/max/ssd) and 'selectors' (the four lexicographic
+    (hi, lo, col) scans for first/last/min/max row selection)."""
+    fn = _STATS_FNS.get(kind)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    def _take(mat, col_sel):
+        return jnp.take_along_axis(mat, col_sel[:, None], axis=1)[:, 0]
+
+    def _lex_col(hi, lo, cand, latest):
+        """Column of the lexicographically (hi, lo) extreme candidate;
+        ties by column order. int32-only — exact without x64 (TPU)."""
+        big = _BIG_I32
+        col = jnp.arange(hi.shape[1], dtype=jnp.int32)[None, :]
+        if latest:
+            hi_ext = jnp.where(cand, hi, -big).max(axis=1)
+            c2 = cand & (hi == hi_ext[:, None])
+            lo_ext = jnp.where(c2, lo, -big).max(axis=1)
+            c3 = c2 & (lo == lo_ext[:, None])
+            return jnp.where(c3, col, -big).max(axis=1)
+        hi_ext = jnp.where(cand, hi, big).min(axis=1)
+        c2 = cand & (hi == hi_ext[:, None])
+        lo_ext = jnp.where(c2, lo, big).min(axis=1)
+        c3 = c2 & (lo == lo_ext[:, None])
+        return jnp.where(c3, col, big).min(axis=1)
+
+    @jax.jit
+    def basic(v, hi, lo, idx, m):
+        zero = jnp.zeros((), v.dtype)
+        vz = jnp.where(m, v, zero)
+        cnt = m.sum(axis=1)
+        s = vz.sum(axis=1)
+        big = jnp.array(jnp.inf, v.dtype)
+        mn = jnp.where(m, v, big).min(axis=1)
+        mx = jnp.where(m, v, -big).max(axis=1)
+        mean = s / jnp.maximum(cnt, 1).astype(v.dtype)
+        dev = jnp.where(m, v - mean[:, None], zero)
+        ssd = (dev * dev).sum(axis=1)
+        return {"count": cnt, "sum": s, "ssd": ssd, "min": mn, "max": mx,
+                "mean": mean}
+
+    @jax.jit
+    def selectors(v, hi, lo, idx, m):
+        big = jnp.array(jnp.inf, v.dtype)
+        mn = jnp.where(m, v, big).min(axis=1)
+        mx = jnp.where(m, v, -big).max(axis=1)
+        clip = lambda c: jnp.clip(c, 0, v.shape[1] - 1)  # noqa: E731
+        cf = clip(_lex_col(hi, lo, m, latest=False))
+        cl = clip(_lex_col(hi, lo, m, latest=True))
+        cmin = clip(_lex_col(hi, lo, m & (v == mn[:, None]), latest=False))
+        cmax = clip(_lex_col(hi, lo, m & (v == mx[:, None]), latest=False))
+        return {
+            "first": _take(v, cf), "last": _take(v, cl),
+            "sel_first": _take(idx, cf), "sel_last": _take(idx, cl),
+            "sel_min": _take(idx, cmin), "sel_max": _take(idx, cmax),
+        }
+
+    _STATS_FNS["basic"] = basic
+    _STATS_FNS["selectors"] = selectors
+    return _STATS_FNS[kind]

@@ -22,7 +22,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from opengemini_tpu.models import templates
+from opengemini_tpu.models import ragged, templates
 from opengemini_tpu.ops import aggregates as aggmod
 from opengemini_tpu.ops import window as winmod
 from opengemini_tpu.query import condition as cond
@@ -428,8 +428,19 @@ class Executor:
         read_fields = sorted(set(needed_fields) | set(field_filter_fields))
 
         dtype = templates.compute_dtype()
-        batches: dict[str, templates.AggBatch] = {
-            f: templates.AggBatch(dtype) for f in needed_fields
+        # dense-capable aggregates use the ragged->dense bucketed batch
+        # (~100x over scatter on TPU, models/ragged.py); rank-based ones
+        # (percentile/median/count_distinct) keep the lexsort path
+        per_field_aggs: dict[str, list] = {}
+        for _call, spec, _params, fname in aggs:
+            per_field_aggs.setdefault(fname, []).append(spec.name)
+        batches: dict[str, object] = {
+            f: (
+                ragged.BucketedBatch(dtype)
+                if all(n in ragged.DENSE_AGGS for n in per_field_aggs[f])
+                else templates.AggBatch(dtype)
+            )
+            for f in needed_fields
         }
 
         # string fields only support count on the device path (reference
@@ -1189,6 +1200,8 @@ def _eval_output_expr(expr, agg_results, seg, schema):
         out, sel, counts, spec, fname = entry
         if counts[seg] == 0:
             return None, False
+        # single-sample stddev renders 0 (reference NewStdDevReduce,
+        # engine/executor/agg_func.go, returns 0 with isNil=false for n==1)
         v = out[seg]
         ftype = schema.get(fname)
         if spec.int_output:
