@@ -124,3 +124,41 @@ class SeriesIndex:
 
     def measurements(self) -> list[str]:
         return sorted(self.mst_sids)
+
+    # -- deletion ------------------------------------------------------------
+
+    def remove_sids(self, sids: set[int]) -> None:
+        """Drop series from the index and rewrite the log (reference: tsi
+        DeleteSeries / DropMeasurement index paths)."""
+        for sid in sids:
+            entry = self.sid_to_series.pop(sid, None)
+            if entry is None:
+                continue
+            mst, tags = entry
+            self.key_to_sid.pop(series_key(mst, tags), None)
+            bucket = self.mst_sids.get(mst)
+            if bucket is not None:
+                bucket.discard(sid)
+                if not bucket:
+                    del self.mst_sids[mst]
+            for k, v in tags:
+                post = self.postings.get((mst, k, v))
+                if post is not None:
+                    post.discard(sid)
+                    if not post:
+                        del self.postings[(mst, k, v)]
+        self._rewrite_log()
+
+    def _rewrite_log(self) -> None:
+        if self.path is None:
+            return
+        if self._log is not None:
+            self._log.close()
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for sid, (mst, tags) in sorted(self.sid_to_series.items()):
+                f.write(json.dumps([sid, mst, [list(t) for t in tags]]) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._log = open(self.path, "a", encoding="utf-8")

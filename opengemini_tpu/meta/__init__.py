@@ -1,0 +1,3 @@
+"""Metadata plane pieces that sit above the storage engine: users/auth
+now; the replicated cluster meta store joins in the cluster round
+(reference: app/ts-meta + lib/util/lifted/influx/meta data model)."""

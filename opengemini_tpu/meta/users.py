@@ -1,0 +1,145 @@
+"""User accounts + privileges.
+
+Reference: the influx meta user model (lib/util/lifted/influx/meta
+data.go users; httpd auth in handler.go). Passwords are salted
+PBKDF2-SHA256; privileges are per-database READ/WRITE/ALL plus a global
+admin flag. Persisted in users.json next to the engine meta (atomic
+replace).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import secrets
+import threading
+
+READ = "READ"
+WRITE = "WRITE"
+ALL = "ALL"
+
+_ITERS = 20_000
+
+
+class AuthError(Exception):
+    pass
+
+
+class User:
+    def __init__(self, name: str, salt: str, pw_hash: str, admin: bool = False,
+                 privileges: dict[str, str] | None = None):
+        self.name = name
+        self.salt = salt
+        self.pw_hash = pw_hash
+        self.admin = admin
+        self.privileges = privileges or {}
+
+    def check_password(self, password: str) -> bool:
+        return secrets.compare_digest(_hash(password, self.salt), self.pw_hash)
+
+    def can(self, action: str, db: str) -> bool:
+        if self.admin:
+            return True
+        p = self.privileges.get(db)
+        return p == ALL or p == action
+
+    def to_json(self):
+        return {
+            "name": self.name, "salt": self.salt, "hash": self.pw_hash,
+            "admin": self.admin, "privileges": self.privileges,
+        }
+
+    @classmethod
+    def from_json(cls, j):
+        return cls(j["name"], j["salt"], j["hash"], j.get("admin", False),
+                   j.get("privileges", {}))
+
+
+class UserStore:
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._lock = threading.Lock()
+        self.users: dict[str, User] = {}
+        if path and os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                for j in json.load(f).get("users", []):
+                    u = User.from_json(j)
+                    self.users[u.name] = u
+
+    def _save(self) -> None:
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"users": [u.to_json() for u in self.users.values()]}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # -- management ------------------------------------------------------
+
+    def create(self, name: str, password: str, admin: bool = False) -> None:
+        with self._lock:
+            if name in self.users:
+                raise AuthError(f"user already exists: {name}")
+            salt = secrets.token_hex(16)
+            self.users[name] = User(name, salt, _hash(password, salt), admin)
+            self._save()
+
+    def drop(self, name: str) -> None:
+        with self._lock:
+            if name not in self.users:
+                raise AuthError(f"user not found: {name}")
+            del self.users[name]
+            self._save()
+
+    def set_password(self, name: str, password: str) -> None:
+        with self._lock:
+            u = self.users.get(name)
+            if u is None:
+                raise AuthError(f"user not found: {name}")
+            u.salt = secrets.token_hex(16)
+            u.pw_hash = _hash(password, u.salt)
+            self._save()
+
+    def grant(self, name: str, db: str, privilege: str) -> None:
+        with self._lock:
+            u = self.users.get(name)
+            if u is None:
+                raise AuthError(f"user not found: {name}")
+            u.privileges[db] = privilege
+            self._save()
+
+    def grant_admin(self, name: str, admin: bool = True) -> None:
+        with self._lock:
+            u = self.users.get(name)
+            if u is None:
+                raise AuthError(f"user not found: {name}")
+            u.admin = admin
+            self._save()
+
+    def revoke(self, name: str, db: str) -> None:
+        with self._lock:
+            u = self.users.get(name)
+            if u is None:
+                raise AuthError(f"user not found: {name}")
+            u.privileges.pop(db, None)
+            self._save()
+
+    # -- authentication --------------------------------------------------
+
+    def authenticate(self, name: str, password: str) -> User:
+        u = self.users.get(name)
+        if u is None or not u.check_password(password):
+            raise AuthError("authorization failed")
+        return u
+
+    def __len__(self) -> int:
+        return len(self.users)
+
+
+def _hash(password: str, salt: str) -> str:
+    return hashlib.pbkdf2_hmac(
+        "sha256", password.encode("utf-8"), bytes.fromhex(salt), _ITERS
+    ).hex()

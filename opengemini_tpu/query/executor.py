@@ -16,6 +16,7 @@ Times in values are int ns; the HTTP layer formats RFC3339/epoch.
 from __future__ import annotations
 
 import math
+import os
 import re
 import time as _time
 from dataclasses import dataclass
@@ -29,6 +30,7 @@ from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query import functions as fnmod
 from opengemini_tpu.record import FieldType, FieldTypeConflict
 from opengemini_tpu.sql import ast
+from opengemini_tpu.meta.users import AuthError as _AuthError
 from opengemini_tpu.storage.engine import WriteError
 from opengemini_tpu.utils import tracing
 from opengemini_tpu.utils.stats import GLOBAL as STATS
@@ -79,6 +81,10 @@ _READONLY_STMTS = (
     ast.ShowSeries,
     ast.ShowRetentionPolicies,
     ast.ShowContinuousQueries,
+    ast.ShowUsers,
+    ast.ShowGrants,
+    ast.ShowMeasurementCardinality,
+    ast.ShowSeriesCardinality,
 )
 
 
@@ -93,17 +99,24 @@ def _is_readonly(stmt) -> bool:
 
 
 class Executor:
-    def __init__(self, engine):
+    def __init__(self, engine, users=None, auth_enabled: bool = False):
+        from opengemini_tpu.meta.users import UserStore
+
         self.engine = engine
+        self.users = users if users is not None else UserStore(
+            os.path.join(engine.root, "users.json")
+        )
+        self.auth_enabled = auth_enabled
 
     # -- entry --------------------------------------------------------------
 
     def execute(
         self, text: str, db: str = "", now_ns: int | None = None,
-        read_only: bool = False,
+        read_only: bool = False, user=None,
     ) -> dict:
         """read_only=True (HTTP GET) rejects mutating statements — influx
-        1.x requires POST for anything but SELECT/SHOW."""
+        1.x requires POST for anything but SELECT/SHOW. `user` is the
+        authenticated user when auth is enabled (privilege checks)."""
         if now_ns is None:
             now_ns = _time.time_ns()
         try:
@@ -118,6 +131,16 @@ class Executor:
                     raise QueryError(
                         f"{type(stmt).__name__} queries must be sent via POST"
                     )
+                if self.auth_enabled:
+                    if len(self.users) == 0:
+                        # bootstrap: ONLY creating the first admin is open
+                        if not (isinstance(stmt, ast.CreateUser) and stmt.admin):
+                            raise _AuthError(
+                                "create an admin user first: CREATE USER <name> "
+                                "WITH PASSWORD '<pw>' WITH ALL PRIVILEGES"
+                            )
+                    else:
+                        self._authorize(stmt, user, db)
                 if self.engine.read_disabled and isinstance(
                     stmt, (ast.SelectStatement, ast.ExplainStatement)
                 ):
@@ -127,10 +150,47 @@ class Executor:
                 QueryError, cond.ConditionError, KeyError, ValueError,
                 re.error, FieldTypeConflict, WriteError,
             ) as e:
+                # _AuthError deliberately NOT caught: authorization failures
+                # must surface as HTTP 401/403, not statement errors in a 200
                 res = {"error": str(e)}
             res["statement_id"] = i
             results.append(res)
         return {"results": results}
+
+    def _authorize(self, stmt, user, db: str) -> None:
+        """Privilege checks (reference: httpd auth + meta user privileges).
+        READ for selects/shows, WRITE for SELECT INTO, admin for DDL and
+        user management; SET PASSWORD allowed for self."""
+        from opengemini_tpu.meta.users import AuthError
+
+        if user is None:
+            raise AuthError("authorization required")
+        if user.admin:
+            return
+        if isinstance(stmt, ast.SetPassword) and stmt.name == user.name:
+            return
+        if isinstance(stmt, ast.ShowDatabases):
+            return  # any authenticated user (influx lists authorized dbs)
+        if isinstance(stmt, ast.SelectStatement):
+            need = "WRITE" if stmt.into is not None else "READ"
+            if user.can(need, db):
+                return
+            raise AuthError(f"user {user.name!r} lacks {need} on {db!r}")
+        if isinstance(stmt, ast.ExplainStatement):
+            if user.can("READ", db):
+                return
+            raise AuthError(f"user {user.name!r} lacks READ on {db!r}")
+        if isinstance(
+            stmt,
+            (ast.ShowMeasurements, ast.ShowTagKeys, ast.ShowTagValues,
+             ast.ShowFieldKeys, ast.ShowSeries, ast.ShowRetentionPolicies,
+             ast.ShowDatabases, ast.ShowContinuousQueries,
+             ast.ShowMeasurementCardinality, ast.ShowSeriesCardinality),
+        ):
+            if user.can("READ", getattr(stmt, "database", "") or db):
+                return
+            raise AuthError(f"user {user.name!r} lacks READ on {db!r}")
+        raise AuthError(f"user {user.name!r} is not authorized (admin required)")
 
     def execute_statement(self, stmt, db: str, now_ns: int) -> dict:
         if isinstance(stmt, ast.SelectStatement):
@@ -196,8 +256,94 @@ class Executor:
                 series.append(_series(name, None, ["name", "query"], rows))
             return {"series": series} if series else {}
         if isinstance(stmt, ast.DropMeasurement):
-            raise QueryError("DROP MEASUREMENT is not supported yet")
+            for sh in self._all_shards_db(db):
+                sh.delete_data(stmt.name)
+            return {}
+        if isinstance(stmt, (ast.DeleteSeries, ast.DropSeries)):
+            return self._delete(stmt, db, now_ns)
+        if isinstance(stmt, ast.CreateUser):
+            self.users.create(stmt.name, stmt.password, stmt.admin)
+            return {}
+        if isinstance(stmt, ast.DropUser):
+            self.users.drop(stmt.name)
+            return {}
+        if isinstance(stmt, ast.SetPassword):
+            self.users.set_password(stmt.name, stmt.password)
+            return {}
+        if isinstance(stmt, ast.GrantStatement):
+            if not stmt.database and stmt.privilege == "ALL":
+                self.users.grant_admin(stmt.user)
+            else:
+                self.users.grant(stmt.user, stmt.database, stmt.privilege)
+            return {}
+        if isinstance(stmt, ast.RevokeStatement):
+            if not stmt.database and stmt.privilege == "ALL":
+                self.users.grant_admin(stmt.user, admin=False)
+            else:
+                self.users.revoke(stmt.user, stmt.database)
+            return {}
+        if isinstance(stmt, ast.ShowUsers):
+            rows = [[u.name, u.admin] for u in self.users.users.values()]
+            return _series_result("", None, ["user", "admin"], sorted(rows))
+        if isinstance(stmt, ast.ShowGrants):
+            u = self.users.users.get(stmt.user)
+            if u is None:
+                raise QueryError(f"user not found: {stmt.user}")
+            rows = [[db_, p] for db_, p in sorted(u.privileges.items())]
+            return _series_result("", None, ["database", "privilege"], rows)
+        if isinstance(stmt, ast.ShowMeasurementCardinality):
+            names: set[str] = set()
+            for sh in self._all_shards_db(stmt.database or db):
+                names.update(sh.measurements())
+            return _series_result("", None, ["count"], [[len(names)]])
+        if isinstance(stmt, ast.ShowSeriesCardinality):
+            from opengemini_tpu.ingest.line_protocol import series_key
+
+            keys: set[str] = set()
+            for sh in self._all_shards_db(stmt.database or db):
+                for sid, (m, tags) in sh.index.sid_to_series.items():
+                    keys.add(series_key(m, tags))
+            return _series_result("", None, ["count"], [[len(keys)]])
         raise QueryError(f"unsupported statement: {type(stmt).__name__}")
+
+    def _delete(self, stmt, db: str, now_ns: int) -> dict:
+        """DELETE FROM m WHERE ... (time range + tag filters) and
+        DROP SERIES FROM m WHERE ... (whole series).
+        Reference: deleteSeries / dropSeries statement executors."""
+        if not stmt.measurement:
+            raise QueryError("DELETE/DROP SERIES requires FROM <measurement>")
+        is_drop_series = isinstance(stmt, ast.DropSeries)
+        shards = self._all_shards_db(db)
+        # tag keys unioned ACROSS shards (like _scan_context) — a shard
+        # without the measurement must not re-classify tags as fields,
+        # which would error mid-way with earlier shards already deleted
+        tag_keys: set[str] = set()
+        for sh in shards:
+            tag_keys.update(sh.index.tag_keys(stmt.measurement))
+        sc = cond.split(stmt.condition, tag_keys, now_ns)
+        if sc.field_expr is not None:
+            raise QueryError("DELETE conditions may only reference time and tags")
+        has_time = sc.tmin != cond.MIN_TIME or sc.tmax != cond.MAX_TIME
+        if is_drop_series and has_time:
+            # influx rejects time bounds here rather than over-deleting
+            raise QueryError("DROP SERIES does not support time conditions")
+        for sh in shards:
+            sids = (
+                cond.eval_tag_expr(sc.tag_expr, sh.index, stmt.measurement)
+                if sc.tag_expr is not None
+                else None
+            )
+            if sids is not None and not sids:
+                continue
+            if is_drop_series or not has_time:
+                sh.delete_data(stmt.measurement, sids)
+            else:
+                sh.delete_data(
+                    stmt.measurement, sids,
+                    None if sc.tmin == cond.MIN_TIME else sc.tmin,
+                    None if sc.tmax == cond.MAX_TIME else sc.tmax,
+                )
+        return {}
 
     # -- SELECT -------------------------------------------------------------
 

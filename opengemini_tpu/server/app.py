@@ -49,7 +49,10 @@ def build(cfg: dict) -> HttpService:
         flush_threshold_bytes=int(data.get("flush-threshold-mb", 64)) << 20,
     )
     host, _, port = cfg["http"]["bind-address"].partition(":")
-    svc = HttpService(engine, host or "127.0.0.1", int(port or 8086))
+    svc = HttpService(
+        engine, host or "127.0.0.1", int(port or 8086),
+        auth_enabled=bool(cfg["http"].get("auth-enabled", False)),
+    )
     svc.services = _build_services(cfg, svc)
     return svc
 
@@ -57,16 +60,20 @@ def build(cfg: dict) -> HttpService:
 def _build_services(cfg: dict, svc: HttpService) -> list:
     from opengemini_tpu.services.continuous import ContinuousQueryService
     from opengemini_tpu.services.downsample import DownsampleService
+    from opengemini_tpu.services.monitor import MonitorService
     from opengemini_tpu.services.retention import RetentionService
 
     sc = cfg.get("services", {})
-    return [
+    out = [
         RetentionService(svc.engine, float(sc.get("retention-interval-s", 1800))),
         DownsampleService(svc.engine, float(sc.get("downsample-interval-s", 3600))),
         ContinuousQueryService(
             svc.engine, svc.executor, float(sc.get("cq-interval-s", 10))
         ),
     ]
+    if sc.get("store-monitor", True):
+        out.append(MonitorService(svc.engine, float(sc.get("monitor-interval-s", 10))))
+    return out
 
 
 def main(argv=None) -> int:

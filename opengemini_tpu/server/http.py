@@ -65,9 +65,11 @@ class HttpService:
     """Owns the HTTP listener; one Engine + Executor behind it."""
 
     def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 8086,
-                 prom_db: str = "prom"):
+                 prom_db: str = "prom", auth_enabled: bool = False):
         self.engine = engine
-        self.executor = Executor(engine)
+        self.auth_enabled = auth_enabled
+        self.executor = Executor(engine, auth_enabled=auth_enabled)
+        self.users = self.executor.users
         self.prom = PromEngine(engine)
         self.prom_db = prom_db
         self.services: list = []  # populated by server.app.build
@@ -141,6 +143,37 @@ def _make_handler(svc: HttpService):
             data = json.dumps(obj, indent=4 if pretty else None) + "\n"
             self._send(code, data.encode("utf-8"))
 
+        def _authenticate(self, params: dict):
+            """Basic auth header or u/p params (influx 1.x). Returns the
+            user, or None when auth is disabled; sends 401 and returns
+            False on failure."""
+            if not svc.auth_enabled:
+                return None
+            if len(svc.users) == 0:
+                # bootstrap: with no users yet, requests pass so the first
+                # admin can be created (influx 1.x behavior)
+                return None
+            from opengemini_tpu.meta.users import AuthError
+            import base64
+
+            name = params.get("u")
+            pw = params.get("p")
+            header = self.headers.get("Authorization", "")
+            if name is None and header.startswith("Basic "):
+                try:
+                    raw = base64.b64decode(header[6:]).decode("utf-8")
+                    name, _, pw = raw.partition(":")
+                except Exception:  # noqa: BLE001
+                    name = None
+            if name is None:
+                self._send_json(401, {"error": "unable to parse authentication credentials"})
+                return False
+            try:
+                return svc.users.authenticate(name, pw or "")
+            except AuthError as e:
+                self._send_json(401, {"error": str(e)})
+                return False
+
         # -- routes ---------------------------------------------------------
 
         def do_GET(self):
@@ -196,6 +229,13 @@ def _make_handler(svc: HttpService):
         def _handle_syscontrol(self, params: dict):
             """Runtime admin toggles (reference: lib/syscontrol
             syscontrol.go:42-300, /debug/ctrl?mod=...&switchon=...)."""
+            user = self._authenticate(params)
+            if user is False:
+                return
+            if svc.auth_enabled and not (user and user.admin):
+                code = 401 if user is None else 403
+                self._send_json(code, {"error": "admin required"})
+                return
             mod = params.get("mod", "")
             on = params.get("switchon", "").lower() in ("true", "1")
             if mod == "disablewrite":
@@ -212,18 +252,36 @@ def _make_handler(svc: HttpService):
             self._send_json(200, {"status": "ok", "mod": mod, "switchon": on})
 
         def _handle_query(self, params: dict, read_only: bool = False):
+            user = self._authenticate(params)
+            if user is False:
+                return
             q = params.get("q", "")
             if not q:
                 self._send_json(400, {"error": "missing required parameter \"q\""})
                 return
-            result = svc.executor.execute(q, db=params.get("db", ""), read_only=read_only)
+            from opengemini_tpu.meta.users import AuthError
+
+            try:
+                result = svc.executor.execute(
+                    q, db=params.get("db", ""), read_only=read_only, user=user
+                )
+            except AuthError as e:
+                self._send_json(403, {"error": str(e)})
+                return
             epoch = params.get("epoch")
             pretty = params.get("pretty") in ("true", "1")
             self._send_json(200, format_result(result, epoch), pretty)
 
         def _handle_prom(self, path: str, params: dict):
             """Prometheus HTTP API v1 (reference: handler_prom.go)."""
+            user = self._authenticate(params)
+            if user is False:
+                return
             db = params.get("db", svc.prom_db)
+            if svc.auth_enabled and not (user and user.can("READ", db)):
+                code = 401 if user is None else 403
+                self._send_json(code, {"status": "error", "error": "read not authorized"})
+                return
             try:
                 if path == "/api/v1/query_range":
                     data = svc.prom.query_range(
@@ -273,6 +331,13 @@ def _make_handler(svc: HttpService):
             return sorted(vals)
 
         def _handle_write(self, params: dict, db: str, rp):
+            user = self._authenticate(params)
+            if user is False:
+                return
+            if svc.auth_enabled and not (user and user.can("WRITE", db)):
+                code = 401 if user is None else 403
+                self._send_json(code, {"error": f"write not authorized on {db!r}"})
+                return
             if not db:
                 self._send_json(400, {"error": "database is required"})
                 return

@@ -1,0 +1,39 @@
+"""Self-monitoring service: statistics pushed into the `_internal`
+database (reference: lib/statisticsPusher pushing to file/http/_internal,
+plus the ts-monitor agent)."""
+
+from __future__ import annotations
+
+import time as _time
+
+from opengemini_tpu.record import FieldType
+from opengemini_tpu.services.base import Service
+from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+INTERNAL_DB = "_internal"
+
+
+class MonitorService(Service):
+    name = "monitor"
+
+    def __init__(self, engine, interval_s: float = 10.0, hostname: str = "localhost"):
+        super().__init__(interval_s)
+        self.engine = engine
+        self.hostname = hostname
+
+    def handle(self) -> None:
+        snap = STATS.snapshot()
+        if not snap:
+            return
+        if INTERNAL_DB not in self.engine.databases:
+            self.engine.create_database(INTERNAL_DB)
+        now = _time.time_ns()
+        points = []
+        for module, vals in snap.items():
+            fields = {k: (FieldType.INT, int(v)) for k, v in vals.items()}
+            if fields:
+                points.append(
+                    (module, (("hostname", self.hostname),), now, fields)
+                )
+        if points:
+            self.engine.write_rows(INTERNAL_DB, points)

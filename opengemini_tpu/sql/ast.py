@@ -257,3 +257,67 @@ class ShowContinuousQueries:
 class ExplainStatement:
     select: "SelectStatement | None" = None
     analyze: bool = False
+
+
+@dataclass
+class CreateUser:
+    name: str = ""
+    password: str = ""
+    admin: bool = False
+
+
+@dataclass
+class DropUser:
+    name: str = ""
+
+
+@dataclass
+class SetPassword:
+    name: str = ""
+    password: str = ""
+
+
+@dataclass
+class GrantStatement:
+    privilege: str = ""  # READ | WRITE | ALL
+    database: str = ""  # empty + ALL -> admin
+    user: str = ""
+
+
+@dataclass
+class RevokeStatement:
+    privilege: str = ""
+    database: str = ""
+    user: str = ""
+
+
+@dataclass
+class ShowUsers:
+    pass
+
+
+@dataclass
+class ShowGrants:
+    user: str = ""
+
+
+@dataclass
+class DeleteSeries:
+    measurement: str = ""
+    condition: object | None = None
+
+
+@dataclass
+class DropSeries:
+    measurement: str = ""
+    condition: object | None = None
+
+
+@dataclass
+class ShowMeasurementCardinality:
+    database: str = ""
+
+
+@dataclass
+class ShowSeriesCardinality:
+    database: str = ""

@@ -19,6 +19,8 @@ KEYWORDS = {
     "into", "true", "false", "null", "none", "previous", "linear", "tz",
     "measurement", "delete", "as", "name", "continuous", "query", "queries",
     "begin", "end", "resample", "every", "for", "explain", "analyze",
+    "user", "users", "password", "privileges", "grant", "grants", "revoke",
+    "to", "set", "read", "write", "all", "cardinality", "exact",
 }
 
 _DUR_RE = re.compile(r"(\d+)(ns|u|µ|us|ms|s|m|h|d|w)")

@@ -112,7 +112,57 @@ class Parser:
             return self.parse_create()
         if tok.val == "drop":
             return self.parse_drop()
+        if tok.val == "grant":
+            return self.parse_grant()
+        if tok.val == "revoke":
+            return self.parse_revoke()
+        if tok.val == "set":
+            return self.parse_set_password()
+        if tok.val == "delete":
+            return self.parse_delete()
         raise ParseError(f"unsupported statement start: {tok.val!r}")
+
+    def parse_grant(self):
+        self._expect_kw("grant")
+        priv = self._expect_kw("read", "write", "all")
+        self._accept_kw("privileges")
+        if self._accept_kw("on"):
+            db = self._ident()
+            self._expect_kw("to")
+            return ast.GrantStatement(priv.upper(), db, self._ident())
+        self._expect_kw("to")  # GRANT ALL PRIVILEGES TO u -> admin
+        return ast.GrantStatement(priv.upper(), "", self._ident())
+
+    def parse_revoke(self):
+        self._expect_kw("revoke")
+        priv = self._expect_kw("read", "write", "all")
+        self._accept_kw("privileges")
+        if self._accept_kw("on"):
+            db = self._ident()
+            self._expect_kw("from")
+            return ast.RevokeStatement(priv.upper(), db, self._ident())
+        self._expect_kw("from")
+        return ast.RevokeStatement(priv.upper(), "", self._ident())
+
+    def parse_set_password(self):
+        self._expect_kw("set")
+        self._expect_kw("password")
+        self._expect_kw("for")
+        name = self._ident()
+        self._expect_op("=")
+        tok = self.lex.next()
+        if tok.kind != "STRING":
+            raise ParseError("SET PASSWORD expects a quoted string")
+        return ast.SetPassword(name, tok.val)
+
+    def parse_delete(self):
+        self._expect_kw("delete")
+        stmt = ast.DeleteSeries()
+        if self._accept_kw("from"):
+            stmt.measurement = self._ident()
+        if self._accept_kw("where"):
+            stmt.condition = self._parse_expr()
+        return stmt
 
     def parse_select(self) -> ast.SelectStatement:
         self._expect_kw("select")
@@ -406,7 +456,18 @@ class Parser:
             if self._accept_kw("from"):
                 s.measurement = self._ident()
             return s
+        if kw.val == "measurement":
+            self._expect_kw("cardinality")
+            s = ast.ShowMeasurementCardinality()
+            if self._accept_kw("on"):
+                s.database = self._ident()
+            return s
         if kw.val == "series":
+            if self._accept_kw("cardinality"):
+                s = ast.ShowSeriesCardinality()
+                if self._accept_kw("on"):
+                    s.database = self._ident()
+                return s
             s = ast.ShowSeries()
             if self._accept_kw("on"):
                 s.database = self._ident()
@@ -424,15 +485,33 @@ class Parser:
         if kw.val == "continuous":
             self._expect_kw("queries")
             return ast.ShowContinuousQueries()
+        if kw.val == "users":
+            return ast.ShowUsers()
+        if kw.val == "grants":
+            self._expect_kw("for")
+            return ast.ShowGrants(self._ident())
         raise ParseError(f"unsupported SHOW {kw.val!r}")
 
     # -- CREATE / DROP ------------------------------------------------------
 
     def parse_create(self):
         self._expect_kw("create")
-        kw = self._expect_kw("database", "retention", "continuous")
+        kw = self._expect_kw("database", "retention", "continuous", "user")
         if kw == "database":
             return ast.CreateDatabase(self._ident())
+        if kw == "user":
+            name = self._ident()
+            self._expect_kw("with")
+            self._expect_kw("password")
+            tok = self.lex.next()
+            if tok.kind != "STRING":
+                raise ParseError("CREATE USER expects a quoted password")
+            stmt = ast.CreateUser(name, tok.val)
+            if self._accept_kw("with"):
+                self._expect_kw("all")
+                self._expect_kw("privileges")
+                stmt.admin = True
+            return stmt
         if kw == "continuous":
             self._expect_kw("query")
             name = self._ident()
@@ -495,11 +574,22 @@ class Parser:
 
     def parse_drop(self):
         self._expect_kw("drop")
-        kw = self._expect_kw("database", "retention", "measurement", "continuous")
+        kw = self._expect_kw(
+            "database", "retention", "measurement", "continuous", "user", "series"
+        )
         if kw == "database":
             return ast.DropDatabase(self._ident())
         if kw == "measurement":
             return ast.DropMeasurement(self._ident())
+        if kw == "user":
+            return ast.DropUser(self._ident())
+        if kw == "series":
+            stmt = ast.DropSeries()
+            if self._accept_kw("from"):
+                stmt.measurement = self._ident()
+            if self._accept_kw("where"):
+                stmt.condition = self._parse_expr()
+            return stmt
         if kw == "continuous":
             self._expect_kw("query")
             name = self._ident()

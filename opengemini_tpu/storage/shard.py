@@ -11,6 +11,8 @@ from __future__ import annotations
 import os
 import threading
 
+import numpy as np
+
 from opengemini_tpu.ingest import line_protocol as lp
 from opengemini_tpu.index.inverted import SeriesIndex
 from opengemini_tpu.record import FieldTypeConflict, Record, merge_sorted_records
@@ -210,6 +212,66 @@ class Shard:
                 r.close()
                 os.remove(r.path)
             return rows
+
+    def delete_data(
+        self,
+        measurement: str,
+        sids: set[int] | None = None,
+        tmin: int | None = None,
+        tmax: int | None = None,
+    ) -> None:
+        """Delete rows (whole measurement, whole series, or a time range)
+        by rewriting immutable files without the deleted rows — the
+        reference's drop/delete paths also rewrite/tombstone immutable data
+        (engine DropMeasurement / DeleteSeries). Flushes first so the
+        memtable participates."""
+        with self._lock:
+            self.flush()
+            if measurement not in self.measurements():
+                return
+            if sids is not None:
+                sids = set(sids) & self.index.series_ids(measurement)
+                if not sids:
+                    return
+            lo = tmin if tmin is not None else -(2**62)
+            hi = tmax if tmax is not None else 2**62
+            full_series_delete = tmin is None and tmax is None
+            path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
+            w = TSFWriter(path)
+            wrote = False
+            try:
+                for mst in self.measurements():
+                    for sid in sorted(self.index.series_ids(mst)):
+                        rec = self.read_series(mst, sid)
+                        if len(rec) == 0:
+                            continue
+                        if mst == measurement and (sids is None or sid in sids):
+                            if full_series_delete:
+                                continue
+                            keep = (rec.times < lo) | (rec.times >= hi)
+                            if not keep.any():
+                                continue
+                            rec = rec.take(np.nonzero(keep)[0])
+                        w.add_chunk(mst, sid, rec)
+                        wrote = True
+                w.finish()
+            except BaseException:
+                w.abort()
+                raise
+            self._next_file_seq += 1
+            old = self._files
+            self._files = [TSFReader(path)] if wrote else []
+            if not wrote:
+                os.remove(path)
+            for r in old:
+                r.close()
+                os.remove(r.path)
+            # index + schema cleanup for fully-deleted series
+            if full_series_delete:
+                doomed = sids if sids is not None else self.index.series_ids(measurement)
+                self.index.remove_sids(set(doomed))
+                if not self.index.series_ids(measurement):
+                    self.schemas.pop(measurement, None)
 
     # -- read path ----------------------------------------------------------
 

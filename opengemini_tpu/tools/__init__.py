@@ -1,0 +1,1 @@
+"""Operator tools: backup/restore (reference: app/ts-recover, lib/backup)."""

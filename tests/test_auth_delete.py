@@ -1,0 +1,299 @@
+"""Users/auth + DELETE/DROP SERIES/DROP MEASUREMENT + cardinality tests."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.meta.users import AuthError, UserStore
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.server.http import HttpService
+from opengemini_tpu.storage.engine import Engine, NS
+
+BASE = 1_700_000_040
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = Engine(str(tmp_path / "data"))
+    e.create_database("db")
+    yield e, Executor(e)
+    e.close()
+
+
+def q(ex, text, **kw):
+    return ex.execute(text, db="db", now_ns=(BASE + 10_000) * NS, **kw)
+
+
+def series_of(res, i=0):
+    return res["results"][0]["series"][i]
+
+
+class TestUserStore:
+    def test_create_auth_persist(self, tmp_path):
+        p = str(tmp_path / "users.json")
+        us = UserStore(p)
+        us.create("admin", "secret", admin=True)
+        us.create("bob", "pw")
+        us.grant("bob", "db", "READ")
+        assert us.authenticate("admin", "secret").admin
+        with pytest.raises(AuthError):
+            us.authenticate("admin", "wrong")
+        us2 = UserStore(p)
+        assert us2.authenticate("bob", "pw").can("READ", "db")
+        assert not us2.users["bob"].can("WRITE", "db")
+
+    def test_set_password_and_drop(self, tmp_path):
+        us = UserStore(str(tmp_path / "u.json"))
+        us.create("x", "a")
+        us.set_password("x", "b")
+        with pytest.raises(AuthError):
+            us.authenticate("x", "a")
+        us.authenticate("x", "b")
+        us.drop("x")
+        with pytest.raises(AuthError):
+            us.authenticate("x", "b")
+
+
+class TestUserStatements:
+    def test_create_show_grant_revoke_drop(self, env):
+        e, ex = env
+        q(ex, "CREATE USER admin WITH PASSWORD 'pw' WITH ALL PRIVILEGES")
+        q(ex, "CREATE USER bob WITH PASSWORD 'pw2'")
+        s = series_of(q(ex, "SHOW USERS"))
+        assert ["admin", True] in s["values"] and ["bob", False] in s["values"]
+        q(ex, "GRANT READ ON db TO bob")
+        s = series_of(q(ex, "SHOW GRANTS FOR bob"))
+        assert s["values"] == [["db", "READ"]]
+        q(ex, "REVOKE READ ON db FROM bob")
+        s = series_of(q(ex, "SHOW GRANTS FOR bob"))
+        assert s["values"] == []
+        q(ex, "SET PASSWORD FOR bob = 'new'")
+        ex.users.authenticate("bob", "new")
+        q(ex, "DROP USER bob")
+        assert "bob" not in ex.users.users
+
+    def test_authorization_enforced(self, tmp_path):
+        e = Engine(str(tmp_path / "d"))
+        e.create_database("db")
+        ex = Executor(e, auth_enabled=True)
+        # bootstrap: no users yet
+        q(ex, "CREATE USER root WITH PASSWORD 'pw' WITH ALL PRIVILEGES")
+        root = ex.users.authenticate("root", "pw")
+        q(ex, "CREATE USER bob WITH PASSWORD 'pw'", user=root)
+        bob = ex.users.authenticate("bob", "pw")
+        # auth failures RAISE (the HTTP layer maps them to 401/403)
+        with pytest.raises(AuthError, match="lacks READ"):
+            q(ex, "SELECT v FROM m", user=bob)
+        q(ex, "GRANT READ ON db TO bob", user=root)
+        e.write_lines("db", f"m v=1 {BASE*NS}")
+        res = q(ex, "SELECT v FROM m", user=bob)
+        assert "error" not in res["results"][0]
+        # bob cannot drop databases
+        with pytest.raises(AuthError, match="not authorized"):
+            q(ex, "DROP DATABASE db", user=bob)
+        e.close()
+
+
+class TestDeletion:
+    def _write(self, e):
+        lines = "\n".join(
+            f"cpu,host=h{i%2} v={i} {(BASE + i) * NS}" for i in range(10)
+        )
+        e.write_lines("db", lines)
+
+    def test_drop_measurement(self, env):
+        e, ex = env
+        self._write(e)
+        e.write_lines("db", f"mem v=1 {BASE*NS}")
+        e.flush_all()
+        q(ex, "DROP MEASUREMENT cpu")
+        res = q(ex, "SHOW MEASUREMENTS")
+        assert series_of(res)["values"] == [["mem"]]
+        res = q(ex, "SELECT v FROM cpu")
+        assert "series" not in res["results"][0]
+
+    def test_delete_time_range(self, env):
+        e, ex = env
+        self._write(e)
+        q(ex, f"DELETE FROM cpu WHERE time >= {(BASE+3)*NS} AND time < {(BASE+7)*NS}")
+        res = q(ex, "SELECT count(v) FROM cpu")
+        assert series_of(res)["values"][0][1] == 6
+
+    def test_delete_with_tag(self, env):
+        e, ex = env
+        self._write(e)
+        q(ex, "DELETE FROM cpu WHERE host = 'h0'")
+        res = q(ex, "SELECT count(v) FROM cpu")
+        assert series_of(res)["values"][0][1] == 5
+        s = series_of(q(ex, "SHOW SERIES FROM cpu"))
+        assert all("h0" not in r[0] for r in s["values"])
+
+    def test_drop_series(self, env):
+        e, ex = env
+        self._write(e)
+        e.flush_all()
+        q(ex, "DROP SERIES FROM cpu WHERE host = 'h1'")
+        res = q(ex, "SELECT count(v) FROM cpu")
+        assert series_of(res)["values"][0][1] == 5
+
+    def test_cardinality(self, env):
+        e, ex = env
+        self._write(e)
+        s = series_of(q(ex, "SHOW MEASUREMENT CARDINALITY"))
+        assert s["values"] == [[1]]
+        s = series_of(q(ex, "SHOW SERIES CARDINALITY"))
+        assert s["values"] == [[2]]
+
+
+class TestHttpAuth:
+    @pytest.fixture
+    def server(self, tmp_path):
+        engine = Engine(str(tmp_path / "data"))
+        engine.create_database("db")
+        svc = HttpService(engine, "127.0.0.1", 0, auth_enabled=True)
+        svc.start()
+        yield svc
+        svc.stop()
+        engine.close()
+
+    def _req(self, svc, path, method="GET", body=b"", headers=None, **params):
+        url = f"http://127.0.0.1:{svc.port}{path}?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, data=body if method == "POST" else None,
+                                     headers=headers or {}, method=method)
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_auth_flow(self, server):
+        # bootstrap admin without credentials
+        status, _ = self._req(
+            server, "/query", "POST",
+            q="CREATE USER root WITH PASSWORD 'pw' WITH ALL PRIVILEGES",
+        )
+        assert status == 200
+        # now unauthenticated requests fail
+        status, _ = self._req(server, "/query", q="SHOW DATABASES")
+        assert status == 401
+        # wrong password
+        status, _ = self._req(server, "/query", q="SHOW DATABASES", u="root", p="no")
+        assert status == 401
+        # u/p params work
+        status, _ = self._req(server, "/query", q="SHOW DATABASES", u="root", p="pw")
+        assert status == 200
+        # basic auth works
+        import base64
+
+        hdr = {"Authorization": "Basic " + base64.b64encode(b"root:pw").decode()}
+        status, _ = self._req(server, "/query", headers=hdr, q="SHOW DATABASES")
+        assert status == 200
+        # write requires WRITE privilege
+        status, _ = self._req(server, "/write", "POST", b"m v=1 1", db="db")
+        assert status == 401
+        status, _ = self._req(server, "/write", "POST", b"m v=1 1", db="db",
+                              u="root", p="pw")
+        assert status == 204
+
+
+class TestReviewRegressions:
+    def test_delete_across_shards_with_empty_shard(self, env):
+        e, ex = env
+        week = 7 * 24 * 3600
+        # two shard groups; measurement only in the second
+        e.write_lines("db", f"other v=1 {1 * NS}")
+        e.write_lines("db", f"cpu,host=a v=1 {(week + 1) * NS}\ncpu,host=b v=2 {(week + 2) * NS}")
+        res = ex.execute("DELETE FROM cpu WHERE host = 'a'", db="db",
+                         now_ns=(2 * week) * NS)
+        assert "error" not in res["results"][0]
+        out = ex.execute("SELECT count(v) FROM cpu", db="db", now_ns=(2 * week) * NS)
+        assert out["results"][0]["series"][0]["values"][0][1] == 1
+
+    def test_drop_series_rejects_time_bounds(self, env):
+        e, ex = env
+        e.write_lines("db", f"cpu,host=a v=1 {BASE*NS}")
+        res = q(ex, f"DROP SERIES FROM cpu WHERE host = 'a' AND time < {BASE*NS}")
+        assert "time conditions" in res["results"][0]["error"]
+        out = q(ex, "SELECT count(v) FROM cpu")
+        assert out["results"][0]["series"][0]["values"][0][1] == 1  # nothing deleted
+
+    def test_bootstrap_only_allows_admin_creation(self, tmp_path):
+        e = Engine(str(tmp_path / "d"))
+        e.create_database("db")
+        ex = Executor(e, auth_enabled=True)
+        with pytest.raises(Exception) as ei:
+            ex.execute("SELECT v FROM m", db="db")
+        assert "admin user first" in str(ei.value)
+        with pytest.raises(Exception):
+            ex.execute("CREATE USER u WITH PASSWORD 'p'", db="db")  # non-admin
+        ex.execute("CREATE USER root WITH PASSWORD 'p' WITH ALL PRIVILEGES", db="db")
+        e.close()
+
+    def test_show_databases_any_authenticated_user(self, tmp_path):
+        e = Engine(str(tmp_path / "d"))
+        e.create_database("db")
+        ex = Executor(e, auth_enabled=True)
+        ex.execute("CREATE USER root WITH PASSWORD 'p' WITH ALL PRIVILEGES", db="db")
+        root = ex.users.authenticate("root", "p")
+        ex.execute("CREATE USER bob WITH PASSWORD 'b'", db="db", user=root)
+        bob = ex.users.authenticate("bob", "b")
+        res = ex.execute("SHOW DATABASES", db="", user=bob)
+        assert "series" in res["results"][0]
+        e.close()
+
+    def test_incremental_restore_prunes_deleted_files(self, env, tmp_path):
+        import time as _t
+
+        from opengemini_tpu.tools import backup as bk
+
+        e, ex = env
+        e.write_lines("db", f"m v=1 {BASE*NS}\nm v=2 {(BASE+1)*NS}")
+        e.flush_all()
+        full_dir = str(tmp_path / "full")
+        bk.backup(e.root, full_dir)
+        since = _t.time_ns()
+        q(ex, f"DELETE FROM m WHERE time >= {BASE*NS} AND time < {(BASE+1)*NS}")
+        inc_dir = str(tmp_path / "inc")
+        bk.backup(e.root, inc_dir, since_ns=since)
+        restore_dir = str(tmp_path / "restored")
+        bk.restore(full_dir, restore_dir)
+        bk.restore(inc_dir, restore_dir)
+        e2 = Engine(restore_dir)
+        ex2 = Executor(e2)
+        res = ex2.execute("SELECT count(v) FROM m", db="db",
+                          now_ns=(BASE + 100) * NS)
+        assert res["results"][0]["series"][0]["values"][0][1] == 1  # not resurrected
+        e2.close()
+
+    def test_http_auth_error_status_codes(self, tmp_path):
+        engine = Engine(str(tmp_path / "data"))
+        engine.create_database("db")
+        svc = HttpService(engine, "127.0.0.1", 0, auth_enabled=True)
+        svc.start()
+        try:
+            def req(path, method="GET", body=b"", **params):
+                url = f"http://127.0.0.1:{svc.port}{path}?" + urllib.parse.urlencode(params)
+                r = urllib.request.Request(url, data=body if method == "POST" else None,
+                                           method=method)
+                try:
+                    with urllib.request.urlopen(r) as resp:
+                        return resp.status, resp.read()
+                except urllib.error.HTTPError as ex2:
+                    return ex2.code, ex2.read()
+
+            # bootstrap: writes are locked even with zero users
+            status, _ = req("/write", "POST", b"m v=1 1", db="db")
+            assert status == 401
+            req("/query", "POST",
+                q="CREATE USER root WITH PASSWORD 'pw' WITH ALL PRIVILEGES")
+            req("/query", "POST", q="CREATE USER bob WITH PASSWORD 'b'",
+                u="root", p="pw")
+            # authorization failure -> 403, not 200-with-error
+            status, body = req("/query", q="SELECT v FROM m", db="db", u="bob", p="b")
+            assert status == 403
+        finally:
+            svc.stop()
+            engine.close()

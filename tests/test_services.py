@@ -251,3 +251,49 @@ class TestReviewRegressions:
         out = ex2.execute("SELECT last FROM m2", db="db", now_ns=(BASE+100)*NS)
         assert out["results"][0]["series"][0]["values"][0][1] == 7.0
         e2.close()
+
+
+class TestMonitorService:
+    def test_stats_pushed_to_internal(self, env):
+        from opengemini_tpu.services.monitor import MonitorService
+        from opengemini_tpu.utils.stats import GLOBAL
+
+        e, ex = env
+        GLOBAL.incr("executor", "queries", 5)
+        svc = MonitorService(e, interval_s=3600, hostname="n1")
+        svc.tick()
+        res = ex.execute("SELECT last(queries) FROM executor", db="_internal",
+                         now_ns=None)
+        v = res["results"][0]["series"][0]["values"][0][1]
+        assert v >= 5
+
+
+class TestBackupRestore:
+    def test_full_and_incremental_roundtrip(self, env, tmp_path):
+        import time as _t
+
+        from opengemini_tpu.tools import backup as bk
+        from opengemini_tpu.storage.engine import Engine
+
+        e, ex = env
+        e.write_lines("db", f"m v=1 {BASE*NS}")
+        e.flush_all()
+        full_dir = str(tmp_path / "bk_full")
+        m = bk.backup(e.root, full_dir)
+        assert m["kind"] == "full" and any(f.endswith(".tsf") for f in m["files"])
+        since = _t.time_ns()
+        e.write_lines("db", f"m v=2 {(BASE+60)*NS}")
+        e.flush_all()
+        inc_dir = str(tmp_path / "bk_inc")
+        m2 = bk.backup(e.root, inc_dir, since_ns=since)
+        assert m2["kind"] == "incremental"
+        # restore into a fresh dir: full then incremental
+        restore_dir = str(tmp_path / "restored")
+        bk.restore(full_dir, restore_dir)
+        bk.restore(inc_dir, restore_dir)
+        e2 = Engine(restore_dir)
+        ex2 = Executor(e2)
+        res = ex2.execute("SELECT count(v) FROM m", db="db",
+                          now_ns=(BASE + 10_000) * NS)
+        assert res["results"][0]["series"][0]["values"][0][1] == 2
+        e2.close()
