@@ -255,6 +255,28 @@ class Executor:
                 rows = [[cq.name, cq.select_text] for cq in d.continuous_queries.values()]
                 series.append(_series(name, None, ["name", "query"], rows))
             return {"series": series} if series else {}
+        if isinstance(stmt, ast.CreateStream):
+            from opengemini_tpu.services.stream import validate_stream_select
+            from opengemini_tpu.storage.engine import StreamTask
+
+            try:
+                validate_stream_select(stmt.select)
+            except ValueError as e:
+                raise QueryError(str(e)) from None
+            self.engine.create_stream(
+                db, StreamTask(stmt.name, stmt.select_text, stmt.delay_ns)
+            )
+            return {}
+        if isinstance(stmt, ast.DropStream):
+            self.engine.drop_stream(db, stmt.name)
+            return {}
+        if isinstance(stmt, ast.ShowStreams):
+            series = []
+            for name in sorted(self.engine.databases):
+                d = self.engine.databases[name]
+                rows = [[s.name, s.select_text] for s in d.streams.values()]
+                series.append(_series(name, None, ["name", "query"], rows))
+            return {"series": series} if series else {}
         if isinstance(stmt, ast.DropMeasurement):
             for sh in self._all_shards_db(db):
                 sh.delete_data(stmt.name)
@@ -393,12 +415,13 @@ class Executor:
 
     def _select(self, stmt: ast.SelectStatement, db: str, now_ns: int,
                 trace=tracing.NOOP) -> dict:
-        for src in stmt.sources:
-            if isinstance(src, ast.SubQuery):
-                raise QueryError("subqueries are not supported yet")
-
         all_series = []
         for src in stmt.sources:
+            if isinstance(src, ast.SubQuery):
+                all_series.extend(
+                    self._select_from_subquery(stmt, src, db, now_ns, trace)
+                )
+                continue
             src_db = src.database or db
             if not src_db:
                 raise QueryError("database name required")
@@ -455,6 +478,83 @@ class Executor:
         if not points:
             return 0
         return self.engine.write_rows(tgt_db, points, rp=target.rp or None)
+
+    def _select_from_subquery(self, stmt, src: ast.SubQuery, db: str,
+                              now_ns: int, trace=tracing.NOOP) -> list[dict]:
+        """FROM (SELECT ...): the inner result materializes into a
+        throw-away engine (tags stay tags, columns become fields), then the
+        outer statement runs against it. Reference: subquery builders in
+        engine/executor/select.go; correctness-first materialization here,
+        streaming later."""
+        import copy  # noqa: F811 — local import for the materializer
+        import tempfile
+
+        from opengemini_tpu.storage.engine import Engine as _Engine
+
+        inner = src.stmt
+        if _classify_select(inner) == "raw" and not (
+            inner.group_by_tags or inner.group_by_all_tags
+        ):
+            # influx propagates series tags through subqueries: a raw inner
+            # select must emit per-series output, not one merged series
+            inner = copy.copy(inner)
+            inner.group_by_all_tags = True
+        # push the outer time range into the inner select so the inner scan
+        # (and the materialization below) covers only the needed window
+        try:
+            sc_outer = cond.split(stmt.condition, set(), now_ns)
+            if sc_outer.tmin != cond.MIN_TIME or sc_outer.tmax != cond.MAX_TIME:
+                bound = ast.BinaryExpr(
+                    "AND",
+                    ast.BinaryExpr(">=", ast.VarRef("time"),
+                                   ast.IntegerLiteral(sc_outer.tmin)),
+                    ast.BinaryExpr("<", ast.VarRef("time"),
+                                   ast.IntegerLiteral(sc_outer.tmax)),
+                )
+                inner = copy.copy(inner)
+                inner.condition = (
+                    bound if inner.condition is None
+                    else ast.BinaryExpr("AND", inner.condition, bound)
+                )
+        except cond.ConditionError:
+            pass  # un-splittable outer condition: no pushdown
+        with trace.span("subquery"):
+            inner_res = self._select(inner, db, now_ns, trace)
+        series_list = inner_res.get("series", [])
+        mst_name = _inner_source_name(inner)
+        with tempfile.TemporaryDirectory(prefix="ogtpu-sub-") as tmp:
+            tmp_engine = _Engine(tmp, sync_wal=False)
+            try:
+                tmp_engine.create_database("sub")
+                points = []
+                for series in series_list:
+                    tags = tuple(sorted(series.get("tags", {}).items()))
+                    cols = series["columns"][1:]
+                    for row in series["values"]:
+                        fields = {}
+                        for name, v in zip(cols, row[1:]):
+                            if v is None:
+                                continue
+                            if isinstance(v, bool):
+                                fields[name] = (FieldType.BOOL, v)
+                            elif isinstance(v, int):
+                                fields[name] = (FieldType.INT, v)
+                            elif isinstance(v, float):
+                                fields[name] = (FieldType.FLOAT, v)
+                            else:
+                                fields[name] = (FieldType.STRING, str(v))
+                        if fields:
+                            points.append((mst_name, tags, row[0], fields))
+                if points:
+                    tmp_engine.write_rows("sub", points)
+                outer = copy.copy(stmt)
+                outer.sources = [ast.Measurement(name=mst_name)]
+                outer.into = None  # INTO applies once, in the caller
+                sub_ex = Executor(tmp_engine, users=self.users)
+                res = sub_ex._select(outer, "sub", now_ns, trace)
+                return res.get("series", [])
+            finally:
+                tmp_engine.close()
 
     def _resolve_measurements(self, src: ast.Measurement, db: str) -> list[str]:
         if src.name:
@@ -1138,6 +1238,16 @@ class Executor:
 
 
 # -- helpers -----------------------------------------------------------------
+
+
+def _inner_source_name(stmt: ast.SelectStatement) -> str:
+    """Influx keeps the innermost measurement name for subquery output."""
+    for src in stmt.sources:
+        if isinstance(src, ast.SubQuery):
+            return _inner_source_name(src.stmt)
+        if isinstance(src, ast.Measurement) and src.name:
+            return src.name
+    return "subquery"
 
 
 def _series(name, tags, columns, values):

@@ -321,3 +321,21 @@ class ShowMeasurementCardinality:
 @dataclass
 class ShowSeriesCardinality:
     database: str = ""
+
+
+@dataclass
+class CreateStream:
+    name: str = ""
+    select: "SelectStatement | None" = None
+    select_text: str = ""
+    delay_ns: int = 0
+
+
+@dataclass
+class DropStream:
+    name: str = ""
+
+
+@dataclass
+class ShowStreams:
+    pass

@@ -487,6 +487,8 @@ class Parser:
             return ast.ShowContinuousQueries()
         if kw.val == "users":
             return ast.ShowUsers()
+        if kw.val == "streams":
+            return ast.ShowStreams()
         if kw.val == "grants":
             self._expect_kw("for")
             return ast.ShowGrants(self._ident())
@@ -496,7 +498,26 @@ class Parser:
 
     def parse_create(self):
         self._expect_kw("create")
-        kw = self._expect_kw("database", "retention", "continuous", "user")
+        kw = self._expect_kw("database", "retention", "continuous", "user", "stream")
+        if kw == "stream":
+            # CREATE STREAM name INTO db..dest ON SELECT ... [DELAY 5s]
+            # (reference: openGemini stream DDL, services/stream)
+            name = self._ident()
+            stmt = ast.CreateStream(name=name)
+            self._expect_kw("on")
+            start_pos = self.lex.peek().pos
+            stmt.select = self.parse_select()
+            stmt.select_text = self.lex.text[start_pos : self.lex.pos].strip()
+            if self._accept_kw("delay"):
+                t = self.lex.next()
+                if t.kind != "DURATION":
+                    raise ParseError("DELAY expects a duration")
+                stmt.delay_ns = t.val
+            if stmt.select.into is None:
+                raise ParseError("stream requires an INTO clause")
+            if stmt.select.group_by_time is None:
+                raise ParseError("stream requires GROUP BY time(...)")
+            return stmt
         if kw == "database":
             return ast.CreateDatabase(self._ident())
         if kw == "user":
@@ -575,8 +596,11 @@ class Parser:
     def parse_drop(self):
         self._expect_kw("drop")
         kw = self._expect_kw(
-            "database", "retention", "measurement", "continuous", "user", "series"
+            "database", "retention", "measurement", "continuous", "user", "series",
+            "stream",
         )
+        if kw == "stream":
+            return ast.DropStream(self._ident())
         if kw == "database":
             return ast.DropDatabase(self._ident())
         if kw == "measurement":

@@ -86,6 +86,24 @@ class DownsamplePolicy:
         return cls(j["age_ns"], j["every_ns"], j.get("field_aggs", {}))
 
 
+class StreamTask:
+    """At-ingest window aggregation task (reference: services/stream +
+    app/ts-store/stream tag_task/time_task)."""
+
+    def __init__(self, name: str, select_text: str, delay_ns: int = 0):
+        self.name = name
+        self.select_text = select_text
+        self.delay_ns = delay_ns
+
+    def to_json(self):
+        return {"name": self.name, "select_text": self.select_text,
+                "delay_ns": self.delay_ns}
+
+    @classmethod
+    def from_json(cls, j):
+        return cls(j["name"], j["select_text"], j.get("delay_ns", 0))
+
+
 class Database:
     def __init__(self, name: str):
         self.name = name
@@ -94,6 +112,7 @@ class Database:
         self.continuous_queries: dict[str, ContinuousQuery] = {}
         # rp name -> [DownsamplePolicy]
         self.downsample: dict[str, list[DownsamplePolicy]] = {}
+        self.streams: dict[str, StreamTask] = {}
 
 
 class WriteError(Exception):
@@ -122,6 +141,7 @@ class Engine:
         # syscontrol toggles (reference: lib/syscontrol disable write/read)
         self.write_disabled = False
         self.read_disabled = False
+        self._write_observers: list = []
         self.databases: dict[str, Database] = {}
         # (db, rp, group_start) -> Shard
         self._shards: dict[tuple[str, str, int], Shard] = {}
@@ -150,6 +170,9 @@ class Engine:
                 db.continuous_queries[cq.name] = cq
             for rp_name, pols in dbj.get("downsample", {}).items():
                 db.downsample[rp_name] = [DownsamplePolicy.from_json(p) for p in pols]
+            for sj in dbj.get("streams", []):
+                st = StreamTask.from_json(sj)
+                db.streams[st.name] = st
             self.databases[db.name] = db
 
     def _save_meta(self) -> None:
@@ -164,6 +187,7 @@ class Engine:
                         rp: [p.to_json() for p in pols]
                         for rp, pols in db.downsample.items()
                     },
+                    "streams": [s.to_json() for s in db.streams.values()],
                 }
                 for db in self.databases.values()
             ]
@@ -312,7 +336,8 @@ class Engine:
                 n += shards[key].write_points(pts, raw, precision, now_ns)
                 if shards[key].mem.approx_bytes > self.flush_threshold_bytes:
                     shards[key].flush()
-            return n
+        self._notify_write(db, rp, points)
+        return n
 
     # -- continuous queries / downsample ----------------------------------
 
@@ -334,6 +359,38 @@ class Engine:
     def save_cq_state(self) -> None:
         with self._lock:
             self._save_meta()
+
+    def create_stream(self, db: str, task: "StreamTask") -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d is None:
+                raise DatabaseNotFound(db)
+            d.streams[task.name] = task
+            self._save_meta()
+
+    def drop_stream(self, db: str, name: str) -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d and name in d.streams:
+                del d.streams[name]
+                self._save_meta()
+
+    def add_write_observer(self, fn) -> None:
+        """fn(db, rp, points) called after every successful write — the
+        stream engine's ingest hook (reference: stream-aware PointsWriter,
+        coordinator/points_writer.go stream rows)."""
+        self._write_observers.append(fn)
+
+    def _notify_write(self, db: str, rp: str | None, points: list) -> None:
+        for fn in self._write_observers:
+            try:
+                fn(db, rp, points)
+            except Exception:  # noqa: BLE001 — observers never break ingest
+                import logging
+
+                logging.getLogger("opengemini_tpu.engine").exception(
+                    "write observer failed"
+                )
 
     def add_downsample_policy(self, db: str, rp: str, policy: "DownsamplePolicy") -> None:
         with self._lock:
@@ -405,7 +462,8 @@ class Engine:
                 n += shards[key].write_points_structured(pts)
                 if shards[key].mem.approx_bytes > self.flush_threshold_bytes:
                     shards[key].flush()
-            return n
+        self._notify_write(db, rp, points)
+        return n
 
     def flush_all(self) -> None:
         with self._lock:
