@@ -701,9 +701,34 @@ class Executor:
         if tmax - aligned >= (1 << 61):
             raise QueryError("time range too large (over ~73 years) for aggregation")
 
+        # pre-aggregation fast path (reference: immutable/pre_aggregation.go
+        # block skipping, SURVEY.md §7 'before device transfer'): for
+        # full-range count/sum/mean with no field filter, chunks wholly
+        # inside the range contribute their stored (count, sum) WITHOUT
+        # being decoded or transferred. Safe only when the series' sources
+        # cannot overlap (no memtable rows in range, non-overlapping chunks).
+        pre_eligible = (
+            not group_time
+            and sc.field_expr is None
+            and all(spec.name in ("count", "sum", "mean") for _c, spec, _p, _f in aggs)
+        )
+        pre_count = {f: np.zeros(num_segments) for f in needed_fields} if pre_eligible else {}
+        pre_sum = {f: np.zeros(num_segments) for f in needed_fields} if pre_eligible else {}
+        sum_fields = {f for _c, spec, _p, f in aggs if spec.name != "count"}
+        pre_used = False
+
         rows_scanned = 0
         with trace.span("scan") as scan_span:
             for sh, sid, gid in scan_plan:
+                if pre_eligible:
+                    handled, got_rows = self._scan_preagg(
+                        sh, mst, sid, gid, tmin, tmax, needed_fields,
+                        batches, pre_count, pre_sum, dtype, aligned, sum_fields,
+                    )
+                    if handled:
+                        pre_used = True
+                        rows_scanned += got_rows
+                        continue
                 rec = sh.read_series(mst, sid, tmin, tmax, fields=read_fields)
                 if len(rec) == 0:
                     continue
@@ -717,22 +742,12 @@ class Executor:
                     widx, _ = winmod.window_index(
                         rec.times, tmin, group_time.every_ns, group_time.offset_ns
                     )
-                    seg = gid * W + widx.astype(np.int64)
+                    seg = (gid * W + widx.astype(np.int64)).astype(np.int32)
                 else:
-                    seg = np.full(len(rec), gid, dtype=np.int64)
-                rel = rec.times - aligned  # int64 ns; split on add()
-                for fname in needed_fields:
-                    col = rec.columns.get(fname)
-                    if col is None:
-                        continue
-                    if col.ftype in (FieldType.STRING,):
-                        vals = np.zeros(len(rec), dtype=dtype)  # count-only path
-                    else:
-                        vals = col.values.astype(dtype)
-                    m = col.valid.copy()
-                    if fmask is not None:
-                        m &= fmask
-                    batches[fname].add(vals, rel, seg.astype(np.int32), m, rec.times)
+                    seg = np.full(len(rec), gid, dtype=np.int32)
+                _add_record_to_batches(
+                    rec, seg, aligned, needed_fields, batches, dtype, fmask
+                )
             scan_span.add_field("rows", rows_scanned)
         STATS.incr("executor", "rows_scanned", rows_scanned)
 
@@ -741,6 +756,21 @@ class Executor:
         with trace.span("device_compute") as sp:
             for call, spec, params, field_name in aggs:
                 out, sel, counts = batches[field_name].run(spec, num_segments, params)
+                if pre_used:
+                    # combine device partials with pre-agg contributions
+                    pc = pre_count[field_name]
+                    ps = pre_sum[field_name]
+                    if spec.name == "count":
+                        out = out + pc
+                    elif spec.name == "sum":
+                        out = out + ps
+                    else:  # mean = (dev_sum + pre_sum) / (dev_cnt + pre_cnt)
+                        dev_sum, _s, _c = batches[field_name].run(
+                            aggmod.get("sum"), num_segments
+                        )
+                        total_c = counts + pc
+                        out = (dev_sum + ps) / np.maximum(total_c, 1)
+                    counts = counts + pc.astype(counts.dtype)
                 agg_results[id(call)] = (out, sel, counts, spec, field_name)
             sp.add_field("aggregates", len(aggs))
             sp.add_field("segments", num_segments)
@@ -754,6 +784,59 @@ class Executor:
                 stmt, mst, group_tags, group_keys, aligned, W, agg_results,
                 batches, schema, tmin,
             )
+
+    def _scan_preagg(
+        self, sh, mst, sid, gid, tmin, tmax, needed_fields,
+        batches, pre_count, pre_sum, dtype, aligned, sum_fields,
+    ) -> tuple[bool, int]:
+        """Try the pre-agg path for one series. Returns (handled, rows):
+        handled=False -> caller does the normal decode+batch scan. No side
+        effects until the whole series validates."""
+        mem_rec = sh.mem.record_for(sid)
+        if mem_rec is not None and len(mem_rec.slice_time(tmin, tmax)):
+            return False, 0  # memtable rows may overwrite file rows
+        srcs = sh.file_chunks(mst, {sid}, tmin, tmax)
+        if not srcs:
+            return True, 0  # nothing in range at all
+        metas = sorted((c for _r, c in srcs), key=lambda c: c.tmin)
+        for a, b in zip(metas, metas[1:]):
+            if b.tmin <= a.tmax:
+                return False, 0  # overlapping chunks: dedup needed, decode
+        # validate: every fully-covered chunk must carry a sum for fields
+        # that need one (bool/string columns store count-only pre-agg)
+        contrib: list[tuple[str, int, float | None]] = []
+        full_rows = 0
+        partials = []
+        for r, c in srcs:
+            if tmin <= c.tmin and c.tmax < tmax:
+                for fname in needed_fields:
+                    loc = c.cols.get(fname)
+                    if loc is None:
+                        continue
+                    pre = loc["pre"]
+                    if not pre.count:
+                        continue
+                    if fname in sum_fields and pre.vsum is None:
+                        return False, 0
+                    contrib.append((fname, pre.count, pre.vsum))
+                full_rows += c.rows
+            else:
+                partials.append((r, c))
+        for fname, cnt, vsum in contrib:
+            pre_count[fname][gid] += cnt
+            if vsum is not None:
+                pre_sum[fname][gid] += vsum
+        rows = full_rows
+        for r, c in partials:
+            rec = r.read_chunk(mst, c, needed_fields).slice_time(tmin, tmax)
+            if not len(rec):
+                continue
+            rows += len(rec)
+            seg = np.full(len(rec), gid, dtype=np.int32)
+            _add_record_to_batches(
+                rec, seg, aligned, needed_fields, batches, dtype, None
+            )
+        return True, rows
 
     def _group_tags(self, stmt, shards, mst) -> list[str]:
         if stmt.group_by_all_tags:
@@ -1238,6 +1321,24 @@ class Executor:
 
 
 # -- helpers -----------------------------------------------------------------
+
+
+def _add_record_to_batches(rec, seg, aligned, needed_fields, batches, dtype, fmask):
+    """Shared scan step: one record's columns into the per-field device
+    batches (string columns become count-only zero payloads)."""
+    rel = rec.times - aligned  # int64 ns; (hi, lo)-split on add()
+    for fname in needed_fields:
+        col = rec.columns.get(fname)
+        if col is None:
+            continue
+        if col.ftype == FieldType.STRING:
+            vals = np.zeros(len(rec), dtype=dtype)  # count-only path
+        else:
+            vals = col.values.astype(dtype)
+        m = col.valid
+        if fmask is not None:
+            m = m & fmask
+        batches[fname].add(vals, rel, seg, m, rec.times)
 
 
 def _inner_source_name(stmt: ast.SelectStatement) -> str:

@@ -73,9 +73,14 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
     ]
     if sc.get("store-monitor", True):
         out.append(MonitorService(svc.engine, float(sc.get("monitor-interval-s", 10))))
+    from opengemini_tpu.services.compaction import CompactionService
     from opengemini_tpu.services.stream import StreamService
 
     out.append(StreamService(svc.engine, float(sc.get("stream-interval-s", 5))))
+    out.append(CompactionService(
+        svc.engine, float(sc.get("compact-interval-s", 600)),
+        int(sc.get("compact-max-files", 4)),
+    ))
     return out
 
 

@@ -1,0 +1,28 @@
+"""Background compaction (reference: shard-level compact scheduling,
+engine/compact.go + immutable LevelCompact compact.go:120): shards whose
+immutable file count exceeds the threshold are merged. Compaction also
+restores the pre-aggregation fast path: merged, non-overlapping chunks
+qualify for block skipping where fragmented ones may not."""
+
+from __future__ import annotations
+
+from opengemini_tpu.services.base import Service, logger
+
+
+class CompactionService(Service):
+    name = "compaction"
+
+    def __init__(self, engine, interval_s: float = 600.0, max_files: int = 4):
+        super().__init__(interval_s)
+        self.engine = engine
+        self.max_files = max_files
+
+    def handle(self) -> int:
+        n = 0
+        for shard in self.engine.all_shards():
+            try:
+                if shard.compact(max_files=self.max_files):
+                    n += 1
+            except Exception:  # noqa: BLE001
+                logger.exception("compaction of %s failed", shard.path)
+        return n

@@ -134,13 +134,14 @@ class Shard:
             self.mem = MemTable(self.schemas)
             self.wal.truncate()
 
-    def compact(self, max_files: int = 1) -> None:
+    def compact(self, max_files: int = 1) -> bool:
         """Full merge of immutable files (level compaction analogue,
         reference engine/immutable/compact.go LevelCompact:120). Rewrites
-        all chunks per series merged+deduped into one file."""
+        all chunks per series merged+deduped into one file. Returns whether
+        a merge happened."""
         with self._lock:
             if len(self._files) <= max_files:
-                return
+                return False
             path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
             w = TSFWriter(path)
             try:
@@ -165,9 +166,8 @@ class Shard:
             self._next_file_seq += 1
             old = self._files
             self._files = [TSFReader(path)]
-            for r in old:
-                r.close()
-                os.remove(r.path)
+            _retire_files(old)
+            return True
 
     def rewrite_downsampled(self, every_ns: int, field_aggs: dict | None = None) -> int:
         """Rewrite this shard at `every_ns` resolution (reference:
@@ -208,9 +208,7 @@ class Shard:
             self._next_file_seq += 1
             old = self._files
             self._files = [TSFReader(path)]
-            for r in old:
-                r.close()
-                os.remove(r.path)
+            _retire_files(old)
             return rows
 
     def delete_data(
@@ -263,9 +261,7 @@ class Shard:
             self._files = [TSFReader(path)] if wrote else []
             if not wrote:
                 os.remove(path)
-            for r in old:
-                r.close()
-                os.remove(r.path)
+            _retire_files(old)
             # index + schema cleanup for fully-deleted series
             if full_series_delete:
                 doomed = sids if sids is not None else self.index.series_ids(measurement)
@@ -332,3 +328,17 @@ class Shard:
             self.index.close()
             for r in self._files:
                 r.close()
+
+def _retire_files(readers: list) -> None:
+    """Unlink replaced immutable files WITHOUT closing their readers:
+    in-flight queries hold (reader, chunk) pairs outside the shard lock, and
+    POSIX keeps unlinked files readable through existing fds. The fds close
+    when the reader objects are garbage-collected after the last query
+    releases them (the reference's file-set swap works the same way)."""
+    import os as _os
+
+    for r in readers:
+        try:
+            _os.remove(r.path)
+        except OSError:
+            pass

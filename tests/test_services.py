@@ -20,6 +20,10 @@ def env(tmp_path):
     e.close()
 
 
+def series_of(res, i=0):
+    return res["results"][0]["series"][i]
+
+
 def q(ex, text, now=None):
     return ex.execute(text, db="db", now_ns=(now or (BASE + 10_000)) * NS)
 
@@ -297,3 +301,99 @@ class TestBackupRestore:
                           now_ns=(BASE + 10_000) * NS)
         assert res["results"][0]["series"][0]["values"][0][1] == 2
         e2.close()
+
+
+class TestPreAggFastPath:
+    def _flushed_env(self, e, ex, n=100):
+        lines = "\n".join(
+            f"cpu,host=h{i%2} v={i}.5,c={i}i {(BASE + i) * NS}" for i in range(n)
+        )
+        e.write_lines("db", lines)
+        e.flush_all()
+
+    def test_preagg_matches_decode_path(self, env):
+        e, ex = env
+        self._flushed_env(e, ex)
+        # full-range count/sum/mean: served by pre-agg (single flushed chunk)
+        res = q(ex, "SELECT count(v), sum(v), mean(v) FROM cpu GROUP BY host")
+        for s in res["results"][0]["series"]:
+            h = int(s["tags"]["host"][1])
+            vals = [i + 0.5 for i in range(100) if i % 2 == h]
+            t, cnt, total, mean = s["values"][0]
+            assert cnt == len(vals)
+            assert total == pytest.approx(sum(vals))
+            assert mean == pytest.approx(sum(vals) / len(vals))
+
+    def test_preagg_skips_decode(self, env, monkeypatch):
+        from opengemini_tpu.storage import tsf
+
+        e, ex = env
+        self._flushed_env(e, ex)
+        calls = {"n": 0}
+        orig = tsf.TSFReader.read_chunk
+
+        def counting(self, *a, **kw):
+            calls["n"] += 1
+            return orig(self, *a, **kw)
+
+        monkeypatch.setattr(tsf.TSFReader, "read_chunk", counting)
+        q(ex, "SELECT count(v), mean(v) FROM cpu")
+        assert calls["n"] == 0  # no chunk decode at all
+
+    def test_preagg_partial_range_and_memtable_fallback(self, env):
+        e, ex = env
+        self._flushed_env(e, ex)
+        # partial time range: must slice, not use whole-chunk preagg
+        res = q(ex, f"SELECT count(v) FROM cpu WHERE time >= {(BASE + 50) * NS}")
+        assert series_of(res)["values"][0][1] == 50
+        # memtable overlap disables the fast path (dedup risk)
+        e.write_lines("db", f"cpu,host=h0 v=999 {BASE * NS}")  # overwrites i=0
+        res = q(ex, "SELECT sum(v) FROM cpu WHERE host = 'h0'")
+        vals = [i + 0.5 for i in range(100) if i % 2 == 0]
+        expect = sum(vals) - 0.5 + 999
+        assert series_of(res)["values"][0][1] == pytest.approx(expect)
+
+    def test_preagg_with_field_filter_disabled(self, env):
+        e, ex = env
+        self._flushed_env(e, ex)
+        res = q(ex, "SELECT count(v) FROM cpu WHERE v >= 50")
+        assert series_of(res)["values"][0][1] == 50
+
+
+class TestCompactionService:
+    def test_tick_compacts_fragmented_shards(self, env):
+        from opengemini_tpu.services.compaction import CompactionService
+
+        e, ex = env
+        for i in range(6):
+            e.write_lines("db", f"m v={i} {(BASE + i) * NS}")
+            e.flush_all()
+        [shard] = e.all_shards()
+        assert len(shard._files) == 6
+        svc = CompactionService(e, interval_s=3600, max_files=4)
+        assert svc.handle() == 1
+        assert svc.handle() == 0  # idempotent once merged
+        assert len(shard._files) == 1
+        res = q(ex, "SELECT count(v) FROM m")
+        assert series_of(res)["values"][0][1] == 6
+
+
+def test_compaction_does_not_break_inflight_readers(tmp_path):
+    """Readers obtained before a compaction must stay usable (files are
+    unlinked, not closed, while queries hold them — POSIX semantics)."""
+    import opengemini_tpu.ingest.line_protocol as lp
+    from opengemini_tpu.storage.shard import Shard
+
+    sh = Shard(str(tmp_path / "s"), 0, 10**18)
+    for i in range(3):
+        line = f"m v={i} {(i+1)}000000000"
+        sh.write_points(lp.parse_lines(line), line.encode(), "ns", 0)
+        sh.flush()
+    sid = sh.index.get_or_create("m", ())
+    pairs = sh.file_chunks("m", {sid})  # in-flight query state
+    assert sh.compact() is True
+    # old readers still serve reads after their files were unlinked
+    for r, c in pairs:
+        rec = r.read_chunk("m", c)
+        assert len(rec) == 1
+    sh.close()
