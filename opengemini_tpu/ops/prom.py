@@ -182,6 +182,28 @@ def over_time(times, values, counts, step_starts, step_ends, func: str):
     raise ValueError(f"unsupported over_time func {func!r}")
 
 
+def changes_resets(times, values, counts, step_starts, step_ends, kind: str):
+    """changes()/resets() per (series, step): transitions between
+    consecutive in-window samples, via prefix sums of per-pair indicators
+    (prom promql/functions.go funcChanges/funcResets)."""
+    first_idx, last_idx, has = window_bounds(times, counts, step_starts, step_ends)
+    n = values.shape[1]
+    prev = jnp.concatenate([values[:, :1], values[:, :-1]], axis=1)
+    if kind == "changes":
+        ind = (values != prev).astype(values.dtype)
+    else:  # resets
+        ind = (values < prev).astype(values.dtype)
+    ind = ind.at[:, 0].set(0)
+    valid_cols = jnp.arange(n)[None, :] < counts[:, None]
+    cum = jnp.cumsum(jnp.where(valid_cols, ind, 0), axis=1)
+    cum = jnp.concatenate([jnp.zeros_like(cum[:, :1]), cum], axis=1)  # (S, N+1)
+    safe_f = jnp.clip(first_idx + 1, 0, n)  # pairs with i in (first, last]
+    safe_l1 = jnp.clip(last_idx + 1, 0, n)
+    out = _gather_rows(cum, safe_l1) - _gather_rows(cum, safe_f)
+    valid = has & (last_idx >= first_idx)
+    return jnp.where(valid, out, 0), valid
+
+
 def instant_values(times, values, counts, eval_times, lookback_s: float = 300.0):
     """Instant vector selection: latest sample within [t - lookback, t].
     Returns (vals (S, K), valid (S, K)) — prom staleness semantics (without

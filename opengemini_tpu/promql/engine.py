@@ -208,6 +208,33 @@ class PromEngine:
                     t, v, c, s0, s1, ms_sel.range_s, is_counter, is_rate
                 ),
             )
+        if name in ("changes", "resets"):
+            ms_sel = _expect_matrix(node, 0)
+            return self._eval_range_fn(
+                ms_sel, steps, db,
+                lambda t, v, c, s0, s1: promops.changes_resets(t, v, c, s0, s1, name),
+            )
+        if name == "absent":
+            if not node.args:
+                raise PromError("absent() requires an argument")
+            f = self._eval(node.args[0], steps, db)
+            k = len(steps)
+            present = f.valid.any(axis=0) if len(f.labels) else np.zeros(k, bool)
+            # prometheus derives the output labels from the selector's
+            # equality matchers (promql/functions.go createLabelsForAbsent)
+            labels = {}
+            arg = node.args[0]
+            if isinstance(arg, pp.VectorSelector):
+                for m in arg.matchers:
+                    if m.op == "=" and m.name != "__name__":
+                        labels[m.name] = m.value
+            return Frame([labels], np.ones((1, k)), ~present[None, :])
+        if name == "histogram_quantile":
+            if len(node.args) != 2:
+                raise PromError("histogram_quantile(q, vector) takes 2 arguments")
+            q = _expect_number(node, 0)
+            f = self._eval(node.args[1], steps, db)
+            return _histogram_quantile(q, f, len(steps))
         if name in ("irate", "idelta"):
             ms_sel = _expect_matrix(node, 0)
             return self._eval_range_fn(
@@ -428,6 +455,70 @@ def _instant_rate(times, values, counts, starts, ends, per_second: bool):
     return dv, valid
 
 
+def _histogram_quantile(q: float, f: Frame, k: int) -> Frame:
+    """Prom histogram_quantile over `le`-bucketed series
+    (promql/quantile.go bucketQuantile): group by labels minus `le`,
+    sort buckets, interpolate within the winning bucket. Vectorized over
+    steps per group (one (B, K) matrix pass, no per-column python loops).
+
+    Prom edge semantics: q > 1 -> +Inf, q < 0 -> -Inf; a winning FIRST
+    bucket with upperBound <= 0 returns that bound (interpolation starts
+    at 0 only for positive first buckets); a winning +Inf bucket returns
+    the previous bound."""
+    groups: dict[tuple, list[tuple[float, int]]] = {}
+    labels_of: dict[tuple, dict] = {}
+    for i, labels in enumerate(f.labels):
+        le = labels.get("le")
+        if le is None:
+            continue
+        le_v = float("inf") if le in ("+Inf", "inf", "Inf") else float(le)
+        rest = {kk: v for kk, v in labels.items() if kk not in ("le", "__name__")}
+        key = tuple(sorted(rest.items()))
+        groups.setdefault(key, []).append((le_v, i))
+        labels_of[key] = rest
+    out_labels, out_vals, out_valid = [], [], []
+    for key in sorted(groups):
+        buckets = sorted(groups[key])
+        les = np.array([le for le, _i in buckets])  # (B,), ascending
+        rows = [i for _le, i in buckets]
+        if len(buckets) < 2 or not math.isinf(les[-1]):
+            continue
+        counts = f.values[rows]  # (B, K) cumulative by le
+        bvalid = f.valid[rows]
+        valid = bvalid.all(axis=0)  # all buckets present at the step
+        total = counts[-1]
+        valid &= total > 0
+        if q > 1 or q < 0:
+            vals = np.full(k, np.inf if q > 1 else -np.inf)
+            out_labels.append(labels_of[key])
+            out_vals.append(vals)
+            out_valid.append(valid)
+            continue
+        rank = q * total  # (K,)
+        # first bucket index with count >= rank
+        hit = counts >= rank[None, :]
+        win = np.argmax(hit, axis=0)  # (K,)
+        prev = np.clip(win - 1, 0, len(buckets) - 1)
+        prev_c = np.where(win > 0, counts[prev, np.arange(k)], 0.0)
+        prev_le = np.where(win > 0, les[prev], 0.0)
+        win_le = les[win]
+        win_c = counts[win, np.arange(k)]
+        span = win_c - prev_c
+        with np.errstate(invalid="ignore", divide="ignore"):
+            frac = np.where(span > 0, (rank - prev_c) / np.where(span == 0, 1, span), 1.0)
+            vals = prev_le + (win_le - prev_le) * frac
+        # +Inf winning bucket -> previous bound (second-highest le)
+        vals = np.where(np.isinf(win_le), les[-2] if len(les) >= 2 else 0.0, vals)
+        # first bucket with non-positive bound -> the bound itself
+        vals = np.where((win == 0) & (win_le <= 0), win_le, vals)
+        out_labels.append(labels_of[key])
+        out_vals.append(vals)
+        out_valid.append(valid)
+    if not out_labels:
+        return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
+    return Frame(out_labels, np.stack(out_vals), np.stack(out_valid))
+
+
 def _prom_quantile(q: float, vals: list[float]) -> float:
     if not vals:
         return float("nan")
@@ -485,10 +576,24 @@ def _expect_matrix(node, i) -> pp.MatrixSelector:
     return node.args[i]
 
 
+def _const_fold(e):
+    """Constant expression value or None (unary minus parses as -1 * x)."""
+    if isinstance(e, pp.NumberLit):
+        return e.val
+    if isinstance(e, pp.BinaryOp):
+        lv, rv = _const_fold(e.lhs), _const_fold(e.rhs)
+        if lv is None or rv is None:
+            return None
+        return float(_apply_op(e.op, np.float64(lv), np.float64(rv),
+                               comparison_keep=False))
+    return None
+
+
 def _expect_number(node, i) -> float:
-    if i >= len(node.args) or not isinstance(node.args[i], pp.NumberLit):
+    v = _const_fold(node.args[i]) if i < len(node.args) else None
+    if v is None:
         raise PromError(f"{node.name}() expects a number argument")
-    return node.args[i].val
+    return v
 
 
 def _expect_number_node(n) -> float:

@@ -70,11 +70,12 @@ class BinaryOp:
 AGG_OPS = {"sum", "avg", "min", "max", "count", "topk", "bottomk", "quantile",
            "stddev", "stdvar", "group"}
 FUNCTIONS = {
-    "rate", "irate", "increase", "delta", "idelta",
+    "rate", "irate", "increase", "delta", "idelta", "changes", "resets",
     "avg_over_time", "min_over_time", "max_over_time", "sum_over_time",
     "count_over_time", "last_over_time",
     "abs", "ceil", "floor", "round", "exp", "ln", "log2", "log10", "sqrt",
     "clamp_min", "clamp_max", "scalar", "vector", "timestamp",
+    "histogram_quantile", "absent",
 }
 
 _DUR = re.compile(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w|y)")

@@ -81,6 +81,14 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
         svc.engine, float(sc.get("compact-interval-s", 600)),
         int(sc.get("compact-max-files", 4)),
     ))
+    if sc.get("cold-dir"):
+        from opengemini_tpu.services.hierarchical import HierarchicalService
+
+        out.append(HierarchicalService(
+            svc.engine, sc["cold-dir"],
+            int(float(sc.get("cold-age-days", 30)) * 86400e9),
+            float(sc.get("hierarchical-interval-s", 3600)),
+        ))
     return out
 
 

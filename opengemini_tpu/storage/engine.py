@@ -215,7 +215,9 @@ class Engine:
             if name not in self.databases:
                 return
             for key in [k for k in self._shards if k[0] == name]:
-                self._shards.pop(key).close()
+                shard = self._shards.pop(key)
+                shard.close()
+                _remove_shard_dir(shard.path)  # follows cold-tier symlinks
             del self.databases[name]
             self._save_meta()
             p = os.path.join(self.root, "data", name)
@@ -296,6 +298,10 @@ class Engine:
 
     def all_shards(self) -> list[Shard]:
         return list(self._shards.values())
+
+    def shards_of_db(self, db: str) -> list[Shard]:
+        """Every shard of a database across ALL retention policies."""
+        return [sh for (sdb, _rp, _s), sh in sorted(self._shards.items()) if sdb == db]
 
     # -- write path ---------------------------------------------------------
 
@@ -488,7 +494,7 @@ class Engine:
                 shard = self._shards[key]
                 if shard.tmax <= now_ns - rp_meta.duration_ns:
                     shard.close()
-                    shutil.rmtree(shard.path, ignore_errors=True)
+                    _remove_shard_dir(shard.path)
                     del self._shards[key]
                     dropped.append(key)
         return dropped
@@ -498,6 +504,23 @@ class Engine:
             for shard in self._shards.values():
                 shard.close()
             self._shards.clear()
+
+
+def _remove_shard_dir(path: str) -> None:
+    """Delete a shard directory, following a cold-tier symlink: the cold
+    copy is removed too, then the link — expired tiered data must not leak
+    or resurrect on restart."""
+    import shutil as _shutil
+
+    if os.path.islink(path):
+        target = os.path.realpath(path)
+        _shutil.rmtree(target, ignore_errors=True)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    else:
+        _shutil.rmtree(path, ignore_errors=True)
 
 
 def _downsample_level(shard_path: str) -> int:

@@ -302,3 +302,81 @@ class TestReviewRegressions:
         assert np.asarray(out)[1, 0] == 10.0
         out, valid = promops.over_time(t, v, c, starts, ends, "count")
         assert np.asarray(out)[0, 0] == 3 and np.asarray(out)[1, 0] == 1
+
+
+class TestNewFunctions:
+    def test_changes_and_resets(self, prom_env):
+        e, pe = prom_env
+        # values: 1,1,2,2,1 -> changes 2 (1->2, 2->1); resets 1 (2->1)
+        vals = [1, 1, 2, 2, 1]
+        lines = "\n".join(
+            f"m value={v} {(BASE + i * 15) * NS}" for i, v in enumerate(vals)
+        )
+        e.write_lines("prom", lines)
+        data = pe.query_instant("changes(m[2m])", BASE + 61, "prom")
+        assert float(data["result"][0]["value"][1]) == 2.0
+        data = pe.query_instant("resets(m[2m])", BASE + 61, "prom")
+        assert float(data["result"][0]["value"][1]) == 1.0
+
+    def test_absent(self, prom_env):
+        e, pe = prom_env
+        write_counter(e, {"a": [1]})
+        data = pe.query_instant("absent(http_requests_total)", BASE + 10, "prom")
+        assert data["result"] == []  # present -> empty vector
+        data = pe.query_instant("absent(nothing_here)", BASE + 10, "prom")
+        assert data["result"][0]["value"][1] == "1.0"
+
+    def test_histogram_quantile(self, prom_env):
+        e, pe = prom_env
+        buckets = [("0.1", 10), ("0.5", 50), ("1", 90), ("+Inf", 100)]
+        lines = "\n".join(
+            f'http_req_bucket,le={le},job=api value={c} {BASE * NS}'
+            for le, c in buckets
+        )
+        e.write_lines("prom", lines)
+        data = pe.query_instant(
+            "histogram_quantile(0.5, http_req_bucket)", BASE + 10, "prom"
+        )
+        [r] = data["result"]
+        assert r["metric"] == {"job": "api"}
+        assert float(r["value"][1]) == pytest.approx(0.5)
+        data = pe.query_instant(
+            "histogram_quantile(0.9, http_req_bucket)", BASE + 10, "prom"
+        )
+        # rank 90 falls exactly at le=1 bucket boundary
+        assert float(data["result"][0]["value"][1]) == pytest.approx(1.0)
+
+
+class TestReviewRegressions2:
+    def test_absent_carries_equality_matcher_labels(self, prom_env):
+        e, pe = prom_env
+        data = pe.query_instant(
+            'absent(ghost{job="api", code=~"5.."})', BASE + 10, "prom"
+        )
+        [r] = data["result"]
+        assert r["metric"] == {"job": "api"}  # eq matchers only
+
+    def test_histogram_quantile_edge_q(self, prom_env):
+        e, pe = prom_env
+        lines = "\n".join(
+            f'b_bucket,le={le} value={c} {BASE * NS}'
+            for le, c in (("1", 50), ("+Inf", 100))
+        )
+        e.write_lines("prom", lines)
+        data = pe.query_instant("histogram_quantile(1.5, b_bucket)", BASE + 5, "prom")
+        assert data["result"][0]["value"][1] == "+Inf"
+        data = pe.query_instant("histogram_quantile(-1, b_bucket)", BASE + 5, "prom")
+        assert data["result"][0]["value"][1] == "-Inf"
+        # rank beyond le=1 -> +Inf bucket wins -> previous bound
+        data = pe.query_instant("histogram_quantile(0.99, b_bucket)", BASE + 5, "prom")
+        assert float(data["result"][0]["value"][1]) == 1.0
+
+    def test_histogram_quantile_negative_first_bucket(self, prom_env):
+        e, pe = prom_env
+        lines = "\n".join(
+            f'nb_bucket,le={le} value={c} {BASE * NS}'
+            for le, c in (("-1", 30), ("0.5", 60), ("+Inf", 100))
+        )
+        e.write_lines("prom", lines)
+        data = pe.query_instant("histogram_quantile(0.1, nb_bucket)", BASE + 5, "prom")
+        assert float(data["result"][0]["value"][1]) == -1.0  # bound, not interp

@@ -397,3 +397,117 @@ def test_compaction_does_not_break_inflight_readers(tmp_path):
         rec = r.read_chunk("m", c)
         assert len(rec) == 1
     sh.close()
+
+
+class TestHierarchicalService:
+    def test_cold_move_keeps_shard_usable(self, env, tmp_path):
+        from opengemini_tpu.services.hierarchical import HierarchicalService
+
+        e, ex = env
+        e.write_lines("db", f"m v=1 {BASE*NS}\nm v=3 {(BASE+1)*NS}")
+        e.flush_all()
+        cold = str(tmp_path / "cold")
+        svc = HierarchicalService(e, cold, age_ns=1, interval_s=3600)
+        week = 7 * 24 * 3600
+        assert svc.handle(now_ns=(BASE + 2 * week) * NS) == 1
+        [shard] = e.all_shards()
+        import os
+        assert os.path.islink(shard.path)
+        # reads still work through the symlinked hot path
+        res = q(ex, "SELECT sum(v) FROM m")
+        assert series_of(res)["values"][0][1] == 4.0
+        # writes too (WAL reopened at cold location)
+        e.write_lines("db", f"m v=10 {(BASE+2)*NS}")
+        res = q(ex, "SELECT sum(v) FROM m")
+        assert series_of(res)["values"][0][1] == 14.0
+        # idempotent
+        assert svc.handle(now_ns=(BASE + 2 * week) * NS) == 0
+
+
+class TestParquetExport:
+    def test_export_roundtrip(self, env, tmp_path):
+        import pyarrow.parquet as pq
+
+        from opengemini_tpu.tools.export import export_measurement
+
+        e, ex = env
+        e.write_lines("db", "\n".join([
+            f'cpu,host=a usage=1.5,n=2i,ok=true,msg="hi" {BASE*NS}',
+            f"cpu,host=b usage=2.5 {(BASE+1)*NS}",
+        ]))
+        out = str(tmp_path / "cpu.parquet")
+        n = export_measurement(e, "db", "cpu", out)
+        assert n == 2
+        table = pq.read_table(out)
+        assert set(table.column_names) == {"time", "host", "usage", "n", "ok", "msg"}
+        d = table.to_pydict()
+        assert sorted(d["host"]) == ["a", "b"]
+        assert d["n"][d["host"].index("a")] == 2
+        assert d["usage"] == [1.5, 2.5] or sorted(d["usage"]) == [1.5, 2.5]
+
+
+class TestHierarchicalRegressions:
+    def test_relative_cold_dir_absolutized(self, env, tmp_path, monkeypatch):
+        from opengemini_tpu.services.hierarchical import HierarchicalService
+        import os
+
+        e, ex = env
+        e.write_lines("db", f"m v=1 {BASE*NS}")
+        e.flush_all()
+        monkeypatch.chdir(tmp_path)
+        svc = HierarchicalService(e, "cold-rel", age_ns=1, interval_s=3600)
+        week = 7 * 24 * 3600
+        assert svc.handle(now_ns=(BASE + 2 * week) * NS) == 1
+        [shard] = e.all_shards()
+        target = os.readlink(shard.path)
+        assert os.path.isabs(target) and os.path.isdir(target)
+        res = q(ex, "SELECT count(v) FROM m")
+        assert series_of(res)["values"][0][1] == 1
+
+    def test_inflight_readers_survive_tiering(self, env, tmp_path):
+        from opengemini_tpu.services.hierarchical import HierarchicalService
+
+        e, ex = env
+        e.write_lines("db", f"m v=7 {BASE*NS}")
+        e.flush_all()
+        [shard] = e.all_shards()
+        sid = shard.index.get_or_create("m", ())
+        pairs = shard.file_chunks("m", {sid})
+        svc = HierarchicalService(e, str(tmp_path / "cold"), age_ns=1)
+        week = 7 * 24 * 3600
+        assert svc.handle(now_ns=(BASE + 2 * week) * NS) == 1
+        for r, c in pairs:  # old readers still serve after the move
+            assert r.read_chunk("m", c).columns["v"].values.tolist() == [7.0]
+
+    def test_retention_removes_cold_copy(self, env, tmp_path, monkeypatch):
+        from opengemini_tpu.services.hierarchical import HierarchicalService
+        import os
+
+        e, ex = env
+        e.create_retention_policy("db", "short", duration_ns=24 * 3600 * NS,
+                                  default=True)
+        e.write_lines("db", f"m v=1 {BASE*NS}")
+        e.flush_all()
+        cold = str(tmp_path / "cold")
+        svc = HierarchicalService(e, cold, age_ns=1)
+        week = 7 * 24 * 3600
+        assert svc.handle(now_ns=(BASE + week) * NS) == 1
+        dropped = e.drop_expired_shards(now_ns=(BASE + 10 * week) * NS)
+        assert len(dropped) == 1
+        # neither the symlink nor the cold copy may remain
+        assert not any("autogen" in r or f for r, d, f in os.walk(cold) for f in f)
+        data_dir = os.path.join(e.root, "data", "db", "short")
+        assert not os.path.exists(data_dir) or not os.listdir(data_dir)
+
+    def test_export_includes_all_rps(self, env, tmp_path):
+        import pyarrow.parquet as pq
+        from opengemini_tpu.tools.export import export_measurement
+
+        e, ex = env
+        e.create_retention_policy("db", "rp2", duration_ns=0)
+        e.write_lines("db", f"m v=1 {BASE*NS}")  # autogen
+        e.write_lines("db", f"m v=2 {BASE*NS}", rp="rp2")
+        out = str(tmp_path / "m.parquet")
+        n = export_measurement(e, "db", "m", out)
+        assert n == 2
+        assert sorted(pq.read_table(out).to_pydict()["v"]) == [1.0, 2.0]
