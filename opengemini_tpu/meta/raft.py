@@ -1,0 +1,344 @@
+"""Raft consensus for the metadata plane.
+
+Reference: app/ts-meta uses hashicorp/raft (store.go:36, NewStore:437,
+storeFSM.Apply store_fsm.go:77) to replicate the cluster data model.
+This is a from-scratch Raft (election + log replication + persistence)
+with a pluggable transport: tests drive an in-memory bus (with partitions
+and message drops); deployments use the HTTP transport in meta/service.py.
+
+Scope: leader election with randomized timeouts, AppendEntries log
+replication with consistency checks and follower log repair, majority
+commit, persisted (term, votedFor, log) — the Figure-2 core. Snapshots
+and membership changes land with the cluster round.
+
+The node is DRIVEN: call tick() on a timer thread and deliver_* from the
+transport; no internal threads, which keeps tests deterministic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class LogEntry:
+    __slots__ = ("term", "cmd")
+
+    def __init__(self, term: int, cmd):
+        self.term = term
+        self.cmd = cmd
+
+    def to_json(self):
+        return [self.term, self.cmd]
+
+
+class RaftNode:
+    def __init__(self, node_id: str, peers: list[str], transport,
+                 apply_fn, storage_path: str | None = None,
+                 election_ticks: tuple[int, int] = (10, 20),
+                 heartbeat_ticks: int = 3):
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.transport = transport
+        self.apply_fn = apply_fn
+        self.storage_path = storage_path
+        self._lock = threading.RLock()
+
+        # persistent state
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self.log: list[LogEntry] = []
+        self._load()
+
+        # volatile
+        self.state = FOLLOWER
+        self.commit_index = 0  # 1-based; 0 = nothing
+        self.last_applied = 0
+        self.leader_id: str | None = None
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        self.votes: set[str] = set()
+
+        self._election_ticks = election_ticks
+        self._heartbeat_ticks = heartbeat_ticks
+        self._ticks_until_election = self._rand_election()
+        self._ticks_until_heartbeat = 0
+
+    # -- persistence ------------------------------------------------------
+
+    def _load(self) -> None:
+        if not self.storage_path or not os.path.exists(self.storage_path):
+            return
+        with open(self.storage_path, encoding="utf-8") as f:
+            j = json.load(f)
+        self.current_term = j["term"]
+        self.voted_for = j["voted_for"]
+        self.log = [LogEntry(t, c) for t, c in j["log"]]
+
+    def _persist(self) -> None:
+        if not self.storage_path:
+            return
+        tmp = self.storage_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({
+                "term": self.current_term,
+                "voted_for": self.voted_for,
+                "log": [e.to_json() for e in self.log],
+            }, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.storage_path)
+
+    # -- helpers ----------------------------------------------------------
+
+    def _rand_election(self) -> int:
+        return random.randint(*self._election_ticks)
+
+    def _last_log(self) -> tuple[int, int]:
+        """(index, term), 1-based index, (0, 0) when empty."""
+        if not self.log:
+            return 0, 0
+        return len(self.log), self.log[-1].term
+
+    def _become_follower(self, term: int, leader: str | None = None) -> None:
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+            self._persist()
+        self.state = FOLLOWER
+        self.leader_id = leader
+        self.votes = set()
+
+    def quorum(self) -> int:
+        return (len(self.peers) + 1) // 2 + 1
+
+    # -- public API --------------------------------------------------------
+
+    def propose(self, cmd) -> int | None:
+        """Append a command (leader only). Returns its log index or None."""
+        with self._lock:
+            if self.state != LEADER:
+                return None
+            self.log.append(LogEntry(self.current_term, cmd))
+            self._persist()
+            idx = len(self.log)
+            self.match_index[self.id] = idx
+            self._broadcast_append()
+            self._maybe_commit()  # single-node clusters commit immediately
+            return idx
+
+    def tick(self) -> None:
+        """Advance timers: election timeout / leader heartbeat."""
+        with self._lock:
+            if self.state == LEADER:
+                self._ticks_until_heartbeat -= 1
+                if self._ticks_until_heartbeat <= 0:
+                    self._ticks_until_heartbeat = self._heartbeat_ticks
+                    self._broadcast_append()
+                return
+            self._ticks_until_election -= 1
+            if self._ticks_until_election <= 0:
+                self._start_election()
+
+    # -- election ----------------------------------------------------------
+
+    def _start_election(self) -> None:
+        self.state = CANDIDATE
+        self.current_term += 1
+        self.voted_for = self.id
+        self._persist()
+        self.votes = {self.id}
+        self.leader_id = None
+        self._ticks_until_election = self._rand_election()
+        last_idx, last_term = self._last_log()
+        if len(self.votes) >= self.quorum():  # single-node cluster
+            self._become_leader()
+            return
+        for p in self.peers:
+            self.transport.send(p, {
+                "type": "request_vote", "from": self.id,
+                "term": self.current_term,
+                "last_log_index": last_idx, "last_log_term": last_term,
+            })
+
+    _REQUIRED_FIELDS = {
+        "request_vote": ("from", "term", "last_log_index", "last_log_term"),
+        "request_vote_reply": ("from", "term", "granted"),
+        "append_entries": ("from", "term", "prev_log_index", "prev_log_term",
+                           "entries", "leader_commit"),
+        "append_entries_reply": ("from", "term", "ok", "match_index"),
+    }
+
+    @classmethod
+    def valid_message(cls, msg) -> bool:
+        if not isinstance(msg, dict):
+            return False
+        req = cls._REQUIRED_FIELDS.get(msg.get("type"))
+        return req is not None and all(k in msg for k in req)
+
+    def deliver(self, msg: dict) -> None:
+        """Transport entry point for every message type; malformed
+        messages are dropped (the HTTP layer also 400s them)."""
+        if not self.valid_message(msg):
+            return
+        handlers = {
+            "request_vote": self._on_request_vote,
+            "request_vote_reply": self._on_request_vote_reply,
+            "append_entries": self._on_append_entries,
+            "append_entries_reply": self._on_append_entries_reply,
+        }
+        with self._lock:
+            handlers[msg["type"]](msg)
+
+    def _on_request_vote(self, m: dict) -> None:
+        if m["term"] > self.current_term:
+            self._become_follower(m["term"])
+        granted = False
+        if m["term"] == self.current_term and self.voted_for in (None, m["from"]):
+            last_idx, last_term = self._last_log()
+            up_to_date = (m["last_log_term"], m["last_log_index"]) >= (last_term, last_idx)
+            if up_to_date:
+                granted = True
+                self.voted_for = m["from"]
+                self._persist()
+                self._ticks_until_election = self._rand_election()
+        self.transport.send(m["from"], {
+            "type": "request_vote_reply", "from": self.id,
+            "term": self.current_term, "granted": granted,
+        })
+
+    def _on_request_vote_reply(self, m: dict) -> None:
+        if m["term"] > self.current_term:
+            self._become_follower(m["term"])
+            return
+        if self.state != CANDIDATE or m["term"] != self.current_term:
+            return
+        if m["granted"]:
+            self.votes.add(m["from"])
+            if len(self.votes) >= self.quorum():
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.id
+        # commit a no-op immediately: entries from previous terms can only
+        # commit indirectly through a current-term entry (Raft §8) —
+        # without this, previously-replicated entries stall until the next
+        # client proposal
+        self.log.append(LogEntry(self.current_term, {"op": "noop"}))
+        self._persist()
+        last_idx, _ = self._last_log()
+        self.next_index = {p: last_idx for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        self.match_index[self.id] = last_idx
+        self._ticks_until_heartbeat = 0
+        self._maybe_commit()  # single-node clusters
+        self._broadcast_append()
+
+    # -- replication -------------------------------------------------------
+
+    def _broadcast_append(self) -> None:
+        for p in self.peers:
+            self._send_append(p)
+
+    def _send_append(self, peer: str) -> None:
+        ni = self.next_index.get(peer, 1)
+        prev_idx = ni - 1
+        prev_term = self.log[prev_idx - 1].term if 1 <= prev_idx <= len(self.log) else 0
+        entries = [e.to_json() for e in self.log[ni - 1 :]]
+        self.transport.send(peer, {
+            "type": "append_entries", "from": self.id,
+            "term": self.current_term,
+            "prev_log_index": prev_idx, "prev_log_term": prev_term,
+            "entries": entries, "leader_commit": self.commit_index,
+        })
+
+    def _on_append_entries(self, m: dict) -> None:
+        if m["term"] > self.current_term:
+            self._become_follower(m["term"], m["from"])
+        ok = False
+        match_idx = 0
+        if m["term"] == self.current_term:
+            self.state = FOLLOWER
+            self.leader_id = m["from"]
+            self._ticks_until_election = self._rand_election()
+            prev_idx = m["prev_log_index"]
+            prev_ok = prev_idx == 0 or (
+                prev_idx <= len(self.log)
+                and self.log[prev_idx - 1].term == m["prev_log_term"]
+            )
+            if prev_ok:
+                ok = True
+                # overwrite conflicting suffix, append new entries
+                idx = prev_idx
+                changed = False
+                for term, cmd in m["entries"]:
+                    idx += 1
+                    if idx <= len(self.log):
+                        if self.log[idx - 1].term != term:
+                            del self.log[idx - 1 :]
+                            self.log.append(LogEntry(term, cmd))
+                            changed = True
+                    else:
+                        self.log.append(LogEntry(term, cmd))
+                        changed = True
+                if changed:
+                    self._persist()
+                match_idx = idx
+                if m["leader_commit"] > self.commit_index:
+                    self.commit_index = min(m["leader_commit"], len(self.log))
+                    self._apply_committed()
+        self.transport.send(m["from"], {
+            "type": "append_entries_reply", "from": self.id,
+            "term": self.current_term, "ok": ok, "match_index": match_idx,
+            "hint_next": len(self.log) + 1,
+        })
+
+    def _on_append_entries_reply(self, m: dict) -> None:
+        if m["term"] > self.current_term:
+            self._become_follower(m["term"])
+            return
+        if self.state != LEADER or m["term"] != self.current_term:
+            return
+        peer = m["from"]
+        if m["ok"]:
+            self.match_index[peer] = max(self.match_index.get(peer, 0), m["match_index"])
+            self.next_index[peer] = self.match_index[peer] + 1
+            self._maybe_commit()
+        else:
+            # log repair: back off (bounded by the follower's hint)
+            self.next_index[peer] = max(
+                1, min(self.next_index.get(peer, 1) - 1, m.get("hint_next", 1))
+            )
+            self._send_append(peer)
+
+    def _maybe_commit(self) -> None:
+        for idx in range(len(self.log), self.commit_index, -1):
+            if self.log[idx - 1].term != self.current_term:
+                break  # only commit entries from the current term (§5.4.2)
+            votes = sum(1 for mi in self.match_index.values() if mi >= idx)
+            if votes >= self.quorum():
+                self.commit_index = idx
+                self._apply_committed()
+                break
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            self.apply_fn(self.last_applied, self.log[self.last_applied - 1].cmd)
+
+    # -- introspection -----------------------------------------------------
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "id": self.id, "state": self.state, "term": self.current_term,
+                "leader": self.leader_id, "log_len": len(self.log),
+                "commit_index": self.commit_index,
+            }

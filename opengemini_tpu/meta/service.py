@@ -1,0 +1,163 @@
+"""ts-meta: the raft-replicated metadata service.
+
+Reference: app/ts-meta/meta (raft store + FSM store_fsm.go:77 Apply) and
+lib/metaclient (every node's cached view). The FSM state is the cluster
+data model: databases, retention policies, users' names, node registry.
+Commands are JSON dicts applied deterministically on every replica.
+
+Single-process embedding: `MetaStore` + `RaftNode` with a loopback
+transport gives the standalone (ts-server) deployment the same code path
+the clustered deployment uses; the HTTP transport + ticker run a real
+multi-process quorum.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+from opengemini_tpu.meta.raft import LEADER, RaftNode
+
+
+class MetaFSM:
+    """Deterministic state machine over cluster metadata commands."""
+
+    def __init__(self):
+        self.databases: dict[str, dict] = {}
+        self.nodes: dict[str, dict] = {}  # node id -> {addr, role}
+        self.applied_index = 0
+
+    def apply(self, index: int, cmd: dict) -> None:
+        op = cmd.get("op")
+        if op == "create_database":
+            self.databases.setdefault(cmd["name"], {"rps": {"autogen": {"duration_ns": 0}},
+                                                    "default_rp": "autogen"})
+        elif op == "drop_database":
+            self.databases.pop(cmd["name"], None)
+        elif op == "create_rp":
+            db = self.databases.get(cmd["db"])
+            if db is not None:
+                db["rps"][cmd["name"]] = {"duration_ns": cmd.get("duration_ns", 0)}
+                if cmd.get("default"):
+                    db["default_rp"] = cmd["name"]
+        elif op == "register_node":
+            self.nodes[cmd["id"]] = {"addr": cmd["addr"], "role": cmd.get("role", "data")}
+        elif op == "remove_node":
+            self.nodes.pop(cmd["id"], None)
+        # unknown ops are ignored deterministically (forward compatibility)
+        self.applied_index = index
+
+    def snapshot(self) -> dict:
+        return {"databases": self.databases, "nodes": self.nodes,
+                "applied_index": self.applied_index}
+
+
+class LoopbackTransport:
+    """Single-node transport: nothing to send (no peers)."""
+
+    def send(self, peer: str, msg: dict) -> None:  # pragma: no cover
+        pass
+
+
+class MetaStore:
+    """RaftNode + MetaFSM + a ticker thread. `propose` on the leader;
+    followers redirect via leader_hint()."""
+
+    def __init__(self, node_id: str, peers: list[str], transport=None,
+                 storage_path: str | None = None, tick_s: float = 0.05):
+        self.fsm = MetaFSM()
+        self.node = RaftNode(
+            node_id, peers, transport or LoopbackTransport(),
+            apply_fn=self.fsm.apply, storage_path=storage_path,
+        )
+        self._tick_s = tick_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"raft-{self.node.id}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._tick_s):
+            self.node.tick()
+
+    def propose(self, cmd: dict) -> bool:
+        return self.node.propose(cmd) is not None
+
+    def is_leader(self) -> bool:
+        return self.node.state == LEADER
+
+    def leader_hint(self) -> str | None:
+        return self.node.leader_id
+
+    def status(self) -> dict:
+        import copy
+
+        with self.node._lock:  # FSM mutates under this lock (apply_fn)
+            s = self.node.status()
+            s["fsm"] = copy.deepcopy(self.fsm.snapshot())
+        return s
+
+
+class HttpTransport:
+    """Raft messages over HTTP POST /raft/msg (the control-plane analogue
+    of the reference's meta RPC; the DATA plane uses mesh collectives,
+    parallel/distributed.py).
+
+    One long-lived sender thread per peer with a bounded queue: preserves
+    per-peer ordering, caps memory when a peer is down, and avoids
+    spawning a thread per heartbeat. `token` (shared cluster secret,
+    config meta.token) authenticates intra-cluster messages."""
+
+    def __init__(self, addr_of: dict[str, str], timeout_s: float = 0.5,
+                 token: str = "", max_queue: int = 256):
+        import queue
+
+        self.addr_of = addr_of
+        self.timeout_s = timeout_s
+        self.token = token
+        self._queues: dict[str, queue.Queue] = {}
+        self._lock = threading.Lock()
+        self._max_queue = max_queue
+
+    def send(self, peer: str, msg: dict) -> None:
+        import queue
+
+        addr = self.addr_of.get(peer)
+        if not addr:
+            return
+        with self._lock:
+            q = self._queues.get(peer)
+            if q is None:
+                q = queue.Queue(maxsize=self._max_queue)
+                self._queues[peer] = q
+                threading.Thread(
+                    target=self._sender, args=(addr, q), daemon=True,
+                    name=f"raft-send-{peer}",
+                ).start()
+        if self.token:
+            msg = dict(msg, token=self.token)
+        try:
+            q.put_nowait(msg)
+        except queue.Full:
+            pass  # drop under backpressure; raft retries via heartbeats
+
+    def _sender(self, addr: str, q) -> None:
+        while True:
+            msg = q.get()
+            try:
+                req = urllib.request.Request(
+                    f"http://{addr}/raft/msg", data=json.dumps(msg).encode("utf-8"),
+                    headers={"Content-Type": "application/json"}, method="POST",
+                )
+                urllib.request.urlopen(req, timeout=self.timeout_s).read()
+            except OSError:
+                pass  # unreachable peers are raft's normal case

@@ -53,6 +53,28 @@ def build(cfg: dict) -> HttpService:
         engine, host or "127.0.0.1", int(port or 8086),
         auth_enabled=bool(cfg["http"].get("auth-enabled", False)),
     )
+    meta_cfg = cfg.get("meta")
+    if meta_cfg and meta_cfg.get("node-id"):
+        # clustered meta plane (reference ts-meta): peers are "id@host:port"
+        from opengemini_tpu.meta.service import HttpTransport, MetaStore
+
+        peers = {}
+        for p in meta_cfg.get("peers", []):
+            pid, sep, addr = p.partition("@")
+            if not sep or not pid or ":" not in addr:
+                raise ValueError(
+                    f"meta.peers entries must be 'id@host:port', got {p!r}"
+                )
+            peers[pid] = addr
+        node_id = meta_cfg["node-id"]
+        token = meta_cfg.get("token", "")
+        transport = HttpTransport(peers, token=token)
+        svc.meta_store = MetaStore(
+            node_id, sorted(set(peers) | {node_id}), transport,
+            storage_path=os.path.join(engine.root, "meta.raftlog"),
+        )
+        svc.meta_store.token = token
+        svc.meta_store.start()
     svc.services = _build_services(cfg, svc)
     return svc
 
@@ -112,6 +134,8 @@ def main(argv=None) -> int:
     print("shutting down", flush=True)
     for s in svc.services:
         s.stop()
+    if svc.meta_store is not None:
+        svc.meta_store.stop()
     svc.stop()
     svc.engine.close()
     if args.pidfile:

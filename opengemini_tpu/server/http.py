@@ -73,6 +73,7 @@ class HttpService:
         self.prom = PromEngine(engine)
         self.prom_db = prom_db
         self.services: list = []  # populated by server.app.build
+        self.meta_store = None  # MetaStore when clustered (server.app.build)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -187,6 +188,11 @@ def _make_handler(svc: HttpService):
                 self._handle_query(self._params(), read_only=True)
             elif path.startswith("/api/v1/"):
                 self._handle_prom(path, self._params())
+            elif path == "/raft/status" and svc.meta_store is not None:
+                user = self._authenticate(self._params())
+                if user is False:
+                    return
+                self._send_json(200, svc.meta_store.status())
             elif path == "/debug/vars":
                 import time as _t
 
@@ -221,6 +227,23 @@ def _make_handler(svc: HttpService):
             elif path.startswith("/api/v1/"):
                 self._merge_form_body(params)
                 self._handle_prom(path, params)
+            elif path == "/raft/msg" and svc.meta_store is not None:
+                from opengemini_tpu.meta.raft import RaftNode as _RN
+
+                try:
+                    msg = json.loads(self._body())
+                except ValueError:
+                    msg = None
+                if not _RN.valid_message(msg):
+                    self._send_json(400, {"error": "bad raft message"})
+                    return
+                token = getattr(svc.meta_store, "token", "")
+                if token and msg.pop("token", None) != token:
+                    self._send_json(403, {"error": "bad cluster token"})
+                    return
+                msg.pop("token", None)
+                svc.meta_store.node.deliver(msg)
+                self._send(204)
             elif path == "/debug/ctrl":
                 self._handle_syscontrol(params)
             else:

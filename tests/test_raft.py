@@ -1,0 +1,263 @@
+"""Raft consensus tests: deterministic in-memory bus with partitions and
+drops (the mock-cluster strategy, SURVEY.md §4.3 — distributed logic
+tested without real nodes)."""
+
+import random
+
+import pytest
+
+from opengemini_tpu.meta.raft import CANDIDATE, FOLLOWER, LEADER, RaftNode
+from opengemini_tpu.meta.service import MetaFSM, MetaStore
+
+
+class Bus:
+    """Synchronous in-memory transport with controllable partitions."""
+
+    def __init__(self):
+        self.nodes: dict[str, RaftNode] = {}
+        self.queue: list[tuple[str, dict]] = []
+        self.cut: set[frozenset] = set()
+
+    def send(self, peer: str, msg: dict) -> None:
+        self.queue.append((peer, msg))
+
+    def partition(self, a: str, b: str) -> None:
+        self.cut.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        self.cut = set()
+
+    def deliver_all(self) -> None:
+        # messages may generate replies; loop until quiescent
+        for _ in range(100):
+            if not self.queue:
+                return
+            batch, self.queue = self.queue, []
+            for peer, msg in batch:
+                if frozenset((peer, msg["from"])) in self.cut:
+                    continue
+                node = self.nodes.get(peer)
+                if node is not None:
+                    node.deliver(msg)
+        raise AssertionError("bus did not quiesce")
+
+
+def make_cluster(n=3, tmp_path=None, seed=1):
+    random.seed(seed)
+    bus = Bus()
+    ids = [f"n{i}" for i in range(n)]
+    applied = {i: [] for i in ids}
+    nodes = {}
+    for i in ids:
+        path = str(tmp_path / f"{i}.raftlog") if tmp_path else None
+        nodes[i] = RaftNode(
+            i, ids, bus,
+            apply_fn=lambda idx, cmd, i=i: applied[i].append((idx, cmd)),
+            storage_path=path,
+        )
+    bus.nodes = nodes
+    return bus, nodes, applied
+
+
+def elect(bus, nodes, max_ticks=200):
+    for _ in range(max_ticks):
+        for node in nodes.values():
+            node.tick()
+        bus.deliver_all()
+        leaders = [n for n in nodes.values() if n.state == LEADER]
+        if leaders:
+            return leaders[0]
+    raise AssertionError("no leader elected")
+
+
+class TestElection:
+    def test_single_leader_emerges(self):
+        bus, nodes, _ = make_cluster(3)
+        leader = elect(bus, nodes)
+        assert sum(1 for n in nodes.values() if n.state == LEADER) == 1
+        assert all(
+            n.leader_id == leader.id for n in nodes.values() if n is not leader
+        )
+
+    def test_leader_failover(self):
+        bus, nodes, _ = make_cluster(3)
+        leader = elect(bus, nodes)
+        # isolate the leader
+        for other in nodes.values():
+            if other is not leader:
+                bus.partition(leader.id, other.id)
+        survivors = {i: n for i, n in nodes.items() if n is not leader}
+        new_leader = elect(bus, survivors)
+        assert new_leader.id != leader.id
+        assert new_leader.current_term > leader.current_term
+
+    def test_rejoined_stale_leader_steps_down(self):
+        bus, nodes, _ = make_cluster(3)
+        leader = elect(bus, nodes)
+        for other in nodes.values():
+            if other is not leader:
+                bus.partition(leader.id, other.id)
+        survivors = {i: n for i, n in nodes.items() if n is not leader}
+        elect(bus, survivors)
+        bus.heal()
+        for _ in range(30):
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+        assert leader.state == FOLLOWER
+
+
+class TestReplication:
+    def test_commands_commit_and_apply_everywhere(self):
+        bus, nodes, applied = make_cluster(3)
+        leader = elect(bus, nodes)
+        for k in range(5):
+            assert leader.propose({"op": "x", "k": k}) is not None
+            bus.deliver_all()
+        for _ in range(10):
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+        for i, log in applied.items():
+            assert [c["k"] for _idx, c in log if c.get("op") == "x"] == [0, 1, 2, 3, 4], i
+
+    def test_follower_rejects_propose(self):
+        bus, nodes, _ = make_cluster(3)
+        leader = elect(bus, nodes)
+        follower = next(n for n in nodes.values() if n is not leader)
+        assert follower.propose({"op": "x"}) is None
+
+    def test_log_repair_after_partition(self):
+        bus, nodes, applied = make_cluster(3)
+        leader = elect(bus, nodes)
+        follower = next(n for n in nodes.values() if n is not leader)
+        # follower partitioned while the leader commits entries
+        for other in nodes.values():
+            if other is not follower:
+                bus.partition(follower.id, other.id)
+        for k in range(3):
+            leader.propose({"op": "x", "k": k})
+            bus.deliver_all()
+        bus.heal()
+        for _ in range(30):
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+        assert [c["k"] for _i, c in applied[follower.id] if c.get("op") == "x"] == [0, 1, 2]
+
+    def test_divergent_follower_truncates(self):
+        bus, nodes, applied = make_cluster(3)
+        leader = elect(bus, nodes)
+        follower = next(n for n in nodes.values() if n is not leader)
+        # fabricate divergence: stale entries from a dead term
+        from opengemini_tpu.meta.raft import LogEntry
+
+        follower.log.append(LogEntry(0, {"op": "garbage"}))
+        follower.log.append(LogEntry(0, {"op": "garbage2"}))
+        leader.propose({"op": "good"})
+        for _ in range(30):
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+        ops = [c["op"] for _i, c in applied[follower.id] if c["op"] != "noop"]
+        assert ops == ["good"]
+        assert [e.cmd["op"] for e in follower.log if e.cmd["op"] != "noop"] == ["good"]
+
+    def test_persistence_across_restart(self, tmp_path):
+        bus, nodes, applied = make_cluster(3, tmp_path=tmp_path)
+        leader = elect(bus, nodes)
+        leader.propose({"op": "x", "k": 1})
+        for _ in range(10):
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+        # restart one node from disk
+        nid = leader.id
+        reborn = RaftNode(nid, list(nodes), bus, apply_fn=lambda i, c: None,
+                          storage_path=str(tmp_path / f"{nid}.raftlog"))
+        assert reborn.current_term == leader.current_term
+        assert [e.cmd for e in reborn.log] == [e.cmd for e in leader.log]
+
+
+class TestMetaStore:
+    def test_single_node_store(self, tmp_path):
+        store = MetaStore("m0", ["m0"], storage_path=str(tmp_path / "m0.log"),
+                          tick_s=0.01)
+        store.start()
+        try:
+            import time
+
+            deadline = time.time() + 5
+            while not store.is_leader() and time.time() < deadline:
+                time.sleep(0.02)
+            assert store.is_leader()
+            assert store.propose({"op": "create_database", "name": "db1"})
+            assert store.propose({"op": "create_rp", "db": "db1", "name": "rp1",
+                                  "duration_ns": 1000, "default": True})
+            assert store.propose({"op": "register_node",
+                                  "id": "data1", "addr": "127.0.0.1:9999"})
+            deadline = time.time() + 5
+            while store.fsm.applied_index < 3 and time.time() < deadline:
+                time.sleep(0.02)
+            snap = store.fsm.snapshot()
+            assert "db1" in snap["databases"]
+            assert snap["databases"]["db1"]["default_rp"] == "rp1"
+            assert snap["nodes"]["data1"]["addr"] == "127.0.0.1:9999"
+        finally:
+            store.stop()
+
+    def test_fsm_deterministic_unknown_ops(self):
+        fsm = MetaFSM()
+        fsm.apply(1, {"op": "??futuristic??"})
+        assert fsm.applied_index == 1
+
+
+class TestReviewRegressions:
+    def test_new_leader_commits_previous_term_entries_via_noop(self):
+        """Raft §8: entries replicated in an old term must commit once the
+        new leader's no-op commits — without waiting for a client write."""
+        bus, nodes, applied = make_cluster(3)
+        leader = elect(bus, nodes)
+        # replicate an entry but keep commit knowledge on the leader only
+        leader.propose({"op": "x", "k": 9})
+        bus.deliver_all()
+        # kill the leader before followers learn the commit index advance
+        for other in nodes.values():
+            if other is not leader:
+                bus.partition(leader.id, other.id)
+        survivors = {i: n for i, n in nodes.items() if n is not leader}
+        new_leader = elect(bus, survivors)
+        for _ in range(30):
+            for n in survivors.values():
+                n.tick()
+            bus.deliver_all()
+        got = [c for _i, c in applied[new_leader.id] if c.get("op") == "x"]
+        assert got == [{"op": "x", "k": 9}]
+
+    def test_malformed_messages_dropped(self):
+        bus, nodes, _ = make_cluster(3)
+        n0 = nodes["n0"]
+        n0.deliver([1, 2, 3])  # non-dict
+        n0.deliver({"type": "append_entries"})  # missing fields
+        n0.deliver({"type": "nosuch", "from": "x", "term": 1})
+        assert n0.current_term == 0  # untouched
+
+    def test_status_snapshot_is_isolated(self, tmp_path):
+        store = MetaStore("s0", ["s0"], storage_path=str(tmp_path / "s.log"),
+                          tick_s=0.01)
+        store.start()
+        try:
+            import time
+
+            deadline = time.time() + 5
+            while not store.is_leader() and time.time() < deadline:
+                time.sleep(0.02)
+            store.propose({"op": "create_database", "name": "d1"})
+            deadline = time.time() + 5
+            while "d1" not in store.fsm.databases and time.time() < deadline:
+                time.sleep(0.02)
+            snap = store.status()["fsm"]
+            snap["databases"]["d1"]["mutated"] = True
+            assert "mutated" not in store.fsm.databases["d1"]  # deep copy
+        finally:
+            store.stop()
