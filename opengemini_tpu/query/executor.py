@@ -1422,6 +1422,13 @@ def _call_param_value(arg) -> float | int:
     raise QueryError("function parameter must be a number or duration")
 
 
+def _call_param_any(arg):
+    a = _strip_expr(arg)
+    if isinstance(a, ast.StringLiteral):
+        return a.val
+    return _call_param_value(arg)
+
+
 def _resolve_host_call(call: ast.Call, group_time):
     """-> (kind, call_name, field, params, inner) where kind is
     'agg' | 'transform_raw' | 'transform_agg' | 'multi'."""
@@ -1455,7 +1462,16 @@ def _resolve_host_call(call: ast.Call, group_time):
         fld = _strip_expr(call.args[0])
         if not isinstance(fld, ast.VarRef):
             raise QueryError(f"{name}() argument must be a field")
-        params = tuple(_call_param_value(a) for a in call.args[1:])
+        if name == "detect":
+            # detect(field, 'algorithm'[, threshold]): string only in slot 0
+            params = []
+            for i, a in enumerate(call.args[1:]):
+                params.append(_call_param_any(a) if i == 0 else _call_param_value(a))
+            params = tuple(params)
+            if params and not isinstance(params[0], str):
+                raise QueryError("detect() algorithm must be a quoted string")
+        else:
+            params = tuple(_call_param_value(a) for a in call.args[1:])
         _check_host_arity(name, params)
         return "multi", name, fld.name, params, None
     if name == "count" and call.args and isinstance(_strip_expr(call.args[0]), ast.Call):
@@ -1480,6 +1496,7 @@ _HOST_ARITY = {
     "bottom": (1, 1),
     "sample": (1, 1),
     "distinct": (0, 0),
+    "detect": (0, 2),
     "difference": (0, 0),
     "non_negative_difference": (0, 0),
     "cumulative_sum": (0, 0),

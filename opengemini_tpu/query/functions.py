@@ -40,7 +40,7 @@ HOST_AGGS = {"mode", "integral", "sum", "count", "mean", "min", "max",
              "count_distinct"}
 
 # multi-row selectors: several output rows per group
-MULTI_ROW = {"top", "bottom", "sample", "distinct"}
+MULTI_ROW = {"top", "bottom", "sample", "distinct", "detect"}
 
 
 def transform(name: str, times: np.ndarray, values: np.ndarray, params: tuple):
@@ -161,4 +161,13 @@ def multi_row(name: str, times: np.ndarray, values: np.ndarray, params: tuple,
         uniq = np.unique(values)
         # influx returns distinct values with the epoch window time
         return [(None, py_value(v)) for v in uniq]
+    if name == "detect":
+        from opengemini_tpu.services.castor import detect as _detect
+
+        algorithm = params[0] if params else "mad"
+        threshold = float(params[1]) if len(params) > 1 else None
+        mask = _detect(np.asarray(values, dtype=np.float64), str(algorithm), threshold)
+        return [
+            (int(times[i]), py_value(values[i])) for i in np.nonzero(mask)[0]
+        ]
     raise ValueError(f"unsupported multi-row call {name!r}")

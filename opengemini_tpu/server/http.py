@@ -186,6 +186,8 @@ def _make_handler(svc: HttpService):
                                       "version": __version__})
             elif path == "/query":
                 self._handle_query(self._params(), read_only=True)
+            elif path == "/api/v1/consume":
+                self._handle_consume(self._params())
             elif path.startswith("/api/v1/"):
                 self._handle_prom(path, self._params())
             elif path == "/raft/status" and svc.meta_store is not None:
@@ -352,6 +354,104 @@ def _make_handler(svc: HttpService):
                     else:
                         vals.update(sh.index.tag_values(mst, name))
             return sorted(vals)
+
+        def _handle_consume(self, params: dict):
+            """Kafka-like cursor reads over a measurement (reference:
+            services/consume — log-stream consumption with cursors).
+            GET /api/v1/consume?db=&measurement=&cursor=&limit=
+            cursor is opaque: "t:k" = rows consumed up to time t, k rows
+            already taken AT exactly t (exact resume across ns ties)."""
+            user = self._authenticate(params)
+            if user is False:
+                return
+            db = params.get("db", "")
+            mst = params.get("measurement", "")
+            if svc.auth_enabled and len(svc.users) and not (
+                user and user.can("READ", db)
+            ):
+                self._send_json(403, {"error": "read not authorized"})
+                return
+            if getattr(svc.engine, "read_disabled", False):
+                self._send_json(403, {"error": "reads are disabled (syscontrol)"})
+                return
+            if not db or not mst:
+                self._send_json(400, {"error": "db and measurement are required"})
+                return
+            try:
+                limit = int(params.get("limit", 1000))
+            except ValueError:
+                self._send_json(400, {"error": "bad limit"})
+                return
+            limit = max(1, min(limit, 10_000))
+            cursor = params.get("cursor", "")
+            from_t, skip_at_t = 0, 0
+            if cursor:
+                try:
+                    a, _, b = cursor.partition(":")
+                    from_t, skip_at_t = int(a), int(b)
+                except ValueError:
+                    self._send_json(400, {"error": "bad cursor"})
+                    return
+            # gather per-series arrays; bound python-row materialization to
+            # the page via the (skip + limit + ties)-th smallest timestamp
+            import numpy as _np
+
+            from opengemini_tpu.query.functions import py_value
+
+            series_recs = []
+            all_times = []
+            for sh in svc.engine.shards_of_db(db):
+                for sid in sorted(sh.index.series_ids(mst)):
+                    rec = sh.read_series(mst, sid, from_t, 2**62)
+                    if not len(rec):
+                        continue
+                    series_recs.append((sh.index.tags_of(sid), rec))
+                    all_times.append(rec.times)
+            total = sum(len(t) for t in all_times)
+            need = skip_at_t + limit
+            if total and need < total:
+                merged = _np.concatenate(all_times)
+                kth = _np.partition(merged, need - 1)[need - 1]
+                page_tmax = int(kth)  # inclusive; ties included below
+            else:
+                page_tmax = None
+            rows = []
+            for tags, rec in series_recs:
+                sel = (
+                    _np.nonzero(rec.times <= page_tmax)[0]
+                    if page_tmax is not None
+                    else range(len(rec))
+                )
+                for i in sel:
+                    fields = {
+                        name: py_value(col.values[i])
+                        for name, col in rec.columns.items()
+                        if col.valid[i]
+                    }
+                    rows.append((int(rec.times[i]), tags, fields))
+            rows.sort(key=lambda r: r[0])
+            pos = 0
+            remaining_skip = skip_at_t
+            while pos < len(rows) and rows[pos][0] == from_t and remaining_skip > 0:
+                pos += 1
+                remaining_skip -= 1
+            out = rows[pos : pos + limit]
+            if out:
+                last_t = out[-1][0]
+                taken_at_last = sum(1 for r in out if r[0] == last_t)
+                if last_t == from_t:
+                    taken_at_last += skip_at_t - remaining_skip
+                next_cursor = f"{last_t}:{taken_at_last}"
+            else:
+                next_cursor = cursor or "0:0"
+            self._send_json(200, {
+                "rows": [
+                    {"time": t, "tags": tags, "fields": fields}
+                    for t, tags, fields in out
+                ],
+                "cursor": next_cursor,
+                "exhausted": total - (skip_at_t - remaining_skip) - len(out) <= 0,
+            })
 
         def _handle_write(self, params: dict, db: str, rp):
             user = self._authenticate(params)

@@ -1,0 +1,50 @@
+"""Anomaly detection (castor analogue).
+
+Reference: services/castor + python/ts-udf — openGemini ships anomaly
+detection as a Python sidecar driven through UDAF calls. This framework IS
+Python on the query side, so the algorithms run in-process behind the
+`detect(field, 'algorithm'[, threshold])` SQL function (host multi-row
+path) — no sidecar protocol needed; heavier ML detectors can still hook
+in here later.
+
+Algorithms (the reference agent's classic detectors):
+  mad    — robust z-score via median absolute deviation (default thr 3.0)
+  sigma  — z-score against mean/stddev (default thr 3.0)
+  iqr    — Tukey fences, thr x IQR beyond the quartiles (default thr 1.5)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+ALGORITHMS = ("mad", "sigma", "iqr")
+
+
+def detect(values: np.ndarray, algorithm: str, threshold: float | None = None) -> np.ndarray:
+    """Boolean anomaly mask over a value series."""
+    algorithm = algorithm.lower()
+    n = len(values)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    v = values.astype(np.float64)
+    if algorithm == "mad":
+        thr = 3.0 if threshold is None else threshold
+        med = np.median(v)
+        mad = np.median(np.abs(v - med))
+        if mad == 0:
+            return v != med
+        score = np.abs(v - med) / (1.4826 * mad)
+        return score > thr
+    if algorithm == "sigma":
+        thr = 3.0 if threshold is None else threshold
+        std = v.std()
+        if std == 0:
+            return np.zeros(n, dtype=bool)
+        return np.abs(v - v.mean()) / std > thr
+    if algorithm == "iqr":
+        thr = 1.5 if threshold is None else threshold
+        q1, q3 = np.percentile(v, [25, 75])
+        iqr = q3 - q1
+        return (v < q1 - thr * iqr) | (v > q3 + thr * iqr)
+    raise ValueError(f"unknown detect algorithm {algorithm!r} "
+                     f"(supported: {', '.join(ALGORITHMS)})")

@@ -236,3 +236,78 @@ def test_disableread_blocks_promql_too(server):
     assert status == 400
     assert "disabled" in json.loads(body)["error"]
     post(server, "/debug/ctrl", mod="disableread", switchon="false")
+
+
+def test_consume_api_cursor_pagination(server):
+    lines = "\n".join(
+        f'logs,host=h{i%2} msg="line {i}" {(BASE + i) * NS}' for i in range(10)
+    )
+    post(server, "/write", lines.encode(), db="db")
+    # duplicate-timestamp rows across series must paginate exactly
+    post(server, "/write", f'logs,host=h0 extra=1 {(BASE + 3) * NS}'.encode(), db="db")
+    seen = []
+    cursor = ""
+    for _ in range(10):
+        status, body = get(server, "/api/v1/consume", db="db",
+                           measurement="logs", limit="3",
+                           **({"cursor": cursor} if cursor else {}))
+        assert status == 200
+        data = json.loads(body)
+        seen.extend(data["rows"])
+        cursor = data["cursor"]
+        if data["exhausted"]:
+            break
+    assert len(seen) == 11
+    times = [r["time"] for r in seen]
+    assert times == sorted(times)
+    assert seen[0]["tags"] == {"host": "h0"}
+    assert seen[0]["fields"]["msg"] == "line 0"
+
+
+def test_consume_requires_params(server):
+    status, _ = get(server, "/api/v1/consume", db="db")
+    assert status == 400
+
+
+def test_detect_anomaly_function(server):
+    vals = [10.0] * 20 + [500.0] + [10.0] * 5
+    lines = "\n".join(f"m v={v} {(BASE + i) * NS}" for i, v in enumerate(vals))
+    post(server, "/write", lines.encode(), db="db")
+    _, body = get(server, "/query", db="db", epoch="ns",
+                  q="SELECT detect(v, 'mad') FROM m")
+    s = json.loads(body)["results"][0]["series"][0]
+    assert s["values"] == [[(BASE + 20) * NS, 500.0]]
+    # sigma with custom threshold
+    _, body = get(server, "/query", db="db", epoch="ns",
+                  q="SELECT detect(v, 'sigma', 2) FROM m")
+    s = json.loads(body)["results"][0]["series"][0]
+    assert [r[1] for r in s["values"]] == [500.0]
+    # unknown algorithm -> statement error
+    _, body = get(server, "/query", db="db", q="SELECT detect(v, 'bogus') FROM m")
+    assert "unknown detect algorithm" in json.loads(body)["results"][0]["error"]
+
+
+def test_consume_review_regressions(server):
+    post(server, "/write", f"logs v=1 {BASE*NS}".encode(), db="db")
+    # bad limit -> 400
+    status, _ = get(server, "/api/v1/consume", db="db", measurement="logs", limit="abc")
+    assert status == 400
+    # limit <= 0 clamps to 1, still terminates
+    status, body = get(server, "/api/v1/consume", db="db", measurement="logs", limit="0")
+    assert status == 200 and len(json.loads(body)["rows"]) == 1
+    # empty cursor param behaves like no cursor
+    status, body = get(server, "/api/v1/consume", db="db", measurement="logs", cursor="")
+    assert status == 200 and json.loads(body)["exhausted"]
+    # disableread blocks consume too
+    post(server, "/debug/ctrl", mod="disableread", switchon="true")
+    status, _ = get(server, "/api/v1/consume", db="db", measurement="logs")
+    assert status == 403
+    post(server, "/debug/ctrl", mod="disableread", switchon="false")
+
+
+def test_top_string_param_rejected_at_plan_time(server):
+    post(server, "/write", f"m v=1 {BASE*NS}".encode(), db="db")
+    _, body = get(server, "/query", db="db", q="SELECT top(v, 'abc') FROM m")
+    assert "number or duration" in json.loads(body)["results"][0]["error"]
+    _, body = get(server, "/query", db="db", q="SELECT detect(v, 'mad', 'x') FROM m")
+    assert "number or duration" in json.loads(body)["results"][0]["error"]
