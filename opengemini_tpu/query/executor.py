@@ -1077,7 +1077,10 @@ class Executor:
                     col_maps.append({int(tt): (float(vv), None) for tt, vv in zip(t_out, v_out)})
 
             if has_plain_agg and group_time:
-                base_times = window_times
+                # transforms may emit times outside the window grid
+                # (holt_winters forecasts) — union them in, never drop
+                extra = {t for m in col_maps for t in m} - set(window_times)
+                base_times = sorted(set(window_times) | extra)
             else:
                 seen = sorted({t for m in col_maps for t in m})
                 base_times = seen
@@ -1449,6 +1452,11 @@ def _resolve_host_call(call: ast.Call, group_time):
                 raise QueryError(f"{name}() argument must be a field or aggregate")
             return "transform_agg", name, ifield, params, (iname, iparams)
         if isinstance(inner_e, ast.VarRef):
+            if name.startswith("holt_winters"):
+                raise QueryError(
+                    "holt_winters() requires an aggregate argument with "
+                    "GROUP BY time(...)"
+                )
             if group_time is not None:
                 raise QueryError(
                     f"{name}() over raw points cannot use GROUP BY time(...) — "
@@ -1497,6 +1505,8 @@ _HOST_ARITY = {
     "sample": (1, 1),
     "distinct": (0, 0),
     "detect": (0, 2),
+    "holt_winters": (1, 2),
+    "holt_winters_with_fit": (1, 2),
     "difference": (0, 0),
     "non_negative_difference": (0, 0),
     "cumulative_sum": (0, 0),
@@ -1509,6 +1519,12 @@ def _check_host_arity(name: str, params: tuple) -> None:
         raise QueryError(f"{name}() takes {lo + 1} to {hi + 1} arguments")
     if name == "moving_average" and params and int(params[0]) < 1:
         raise QueryError("moving_average() window must be >= 1")
+    if name.startswith("holt_winters") and params:
+        n = int(params[0])
+        if not (1 <= n <= 10_000):
+            raise QueryError("holt_winters() N must be between 1 and 10000")
+        if len(params) > 1 and not (0 <= int(params[1]) <= 10_000):
+            raise QueryError("holt_winters() seasonal period must be 0..10000")
 
 
 def _resolve_call(call: ast.Call):

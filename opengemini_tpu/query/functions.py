@@ -32,6 +32,8 @@ TRANSFORMS = {
     "cumulative_sum",
     "moving_average",
     "elapsed",
+    "holt_winters",
+    "holt_winters_with_fit",
 }
 
 # host aggregators: one value per (group, window)
@@ -84,6 +86,11 @@ def transform(name: str, times: np.ndarray, values: np.ndarray, params: tuple):
         if len(times) < 2:
             return times[:0], values[:0]
         return times[1:], (np.diff(times) // unit_ns).astype(np.int64)
+    if name in ("holt_winters", "holt_winters_with_fit"):
+        n_forecast = int(params[0]) if params else 1
+        season = int(params[1]) if len(params) > 1 else 0
+        return holt_winters(times, np.asarray(values, np.float64), n_forecast,
+                            season, name.endswith("_with_fit"))
     raise ValueError(f"unsupported transform {name!r}")
 
 
@@ -134,6 +141,81 @@ def host_agg(name: str, times: np.ndarray, values: np.ndarray, params: tuple):
         areas = (values[1:] + values[:-1]) / 2 * dt
         return float(areas.sum()), None
     raise ValueError(f"unsupported host aggregate {name!r}")
+
+
+def holt_winters(times: np.ndarray, values: np.ndarray, n_forecast: int,
+                 season: int, with_fit: bool):
+    """Influx holt_winters(agg, N, S): triple (or double, S=0) exponential
+    smoothing fitted by SSE grid search, forecasting N points at the
+    sequence's stride (reference: engine/executor holt_winters transform).
+    Returns (times, values) — fitted values + forecasts when with_fit,
+    else the N forecasts only."""
+    n = len(values)
+    if n < max(2, 2 * max(season, 1)):
+        return times[:0], values[:0]
+    stride = int(np.median(np.diff(times))) if n > 1 else NS
+
+    def sse_and_fit(alpha, beta, gamma):
+        alpha = float(np.clip(alpha, 1e-3, 1 - 1e-3))
+        beta = float(np.clip(beta, 1e-3, 1 - 1e-3))
+        gamma = float(np.clip(gamma, 1e-3, 1 - 1e-3))
+        level = values[0]
+        trend = values[1] - values[0]
+        seas = (
+            values[:season] - values[:season].mean() if season else None
+        )
+        fit = np.empty(n)
+        for i in range(n):
+            s_i = seas[i % season] if season else 0.0
+            fit[i] = level + trend + s_i
+            err_base = values[i] - s_i
+            new_level = alpha * err_base + (1 - alpha) * (level + trend)
+            trend = beta * (new_level - level) + (1 - beta) * trend
+            if season:
+                seas[i % season] = gamma * (values[i] - new_level) + (1 - gamma) * s_i
+            level = new_level
+        resid = fit - values
+        return float(resid @ resid), fit, level, trend, seas
+
+    # Nelder-Mead like the reference (scipy when present: ~100 SSE evals
+    # instead of a 1000-point grid); coarse grid fallback otherwise
+    best = None
+    try:
+        from scipy.optimize import minimize
+
+        x0 = [0.5, 0.1, 0.1] if season else [0.5, 0.1]
+
+        def objective(x):
+            a, b = x[0], x[1]
+            g = x[2] if season else 0.0
+            return sse_and_fit(a, b, g)[0]
+
+        res = minimize(objective, x0, method="Nelder-Mead",
+                       options={"maxfev": 200, "xatol": 1e-3, "fatol": 1e-6})
+        a, b = res.x[0], res.x[1]
+        g = res.x[2] if season else 0.0
+        best = sse_and_fit(a, b, g)
+    except ImportError:  # pragma: no cover
+        grid = np.linspace(0.1, 0.9, 5)
+        gammas = grid if season else [0.0]
+        for a in grid:
+            for b in grid:
+                for g in gammas:
+                    cand = sse_and_fit(a, b, g)
+                    if best is None or cand[0] < best[0]:
+                        best = cand
+    _, fit, level, trend, seas = best
+    f_times = times[-1] + stride * np.arange(1, n_forecast + 1)
+    f_vals = np.array([
+        level + (k + 1) * trend + (seas[(n + k) % season] if season else 0.0)
+        for k in range(n_forecast)
+    ])
+    if with_fit:
+        return (
+            np.concatenate([times, f_times]),
+            np.concatenate([fit, f_vals]),
+        )
+    return f_times, f_vals
 
 
 def multi_row(name: str, times: np.ndarray, values: np.ndarray, params: tuple,

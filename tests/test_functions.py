@@ -220,3 +220,68 @@ class TestReviewRegressions:
         s = series_of(q(ex, "SELECT top(v, 3) FROM m ORDER BY time DESC"))
         times = [r[0] for r in s["values"]]
         assert times == sorted(times, reverse=True)
+
+
+class TestHoltWinters:
+    def test_forecast_linear_trend(self, env):
+        e, ex = env
+        # clean linear ramp: minute means 10, 20, ..., 60
+        lines = []
+        for w in range(6):
+            for k in range(6):
+                lines.append(f"m v={(w + 1) * 10} {(BASE + w * 60 + k * 10) * NS}")
+        e.write_lines("db", "\n".join(lines))
+        res = q(
+            ex,
+            f"SELECT holt_winters(mean(v), 3, 0) FROM m WHERE time >= {BASE*NS} "
+            f"AND time < {(BASE+360)*NS} GROUP BY time(1m)",
+        )
+        s = series_of(res)
+        assert len(s["values"]) == 3  # forecasts only
+        # forecast times continue at the 1m stride
+        assert s["values"][0][0] == (BASE + 360) * NS
+        # a linear ramp forecasts ~70, 80, 90
+        got = [v for _t, v in s["values"]]
+        for expect, v in zip([70, 80, 90], got):
+            assert v == pytest.approx(expect, rel=0.15)
+
+    def test_with_fit_includes_history(self, env):
+        e, ex = env
+        lines = [f"m v={w+1} {(BASE + w * 60) * NS}" for w in range(6)]
+        e.write_lines("db", "\n".join(lines))
+        res = q(
+            ex,
+            f"SELECT holt_winters_with_fit(mean(v), 2, 0) FROM m WHERE "
+            f"time >= {BASE*NS} AND time < {(BASE+360)*NS} GROUP BY time(1m)",
+        )
+        s = series_of(res)
+        assert len(s["values"]) == 8  # 6 fitted + 2 forecast
+
+    def test_requires_aggregate(self, env):
+        e, ex = env
+        write_seq(e, [1, 2, 3])
+        res = q(ex, "SELECT holt_winters(v, 3, 0) FROM m")
+        assert "aggregate" in res["results"][0]["error"]
+
+
+class TestHoltWintersRegressions:
+    def test_n_forecast_bounded(self, env):
+        e, ex = env
+        write_seq(e, [1, 2, 3])
+        res = q(ex, "SELECT holt_winters(mean(v), 2000000000, 0) FROM m "
+                    "GROUP BY time(1m)")
+        assert "between 1 and 10000" in res["results"][0]["error"]
+
+    def test_mixed_with_plain_agg_keeps_forecast_rows(self, env):
+        e, ex = env
+        lines = [f"m v={w+1} {(BASE + w * 60) * NS}" for w in range(6)]
+        e.write_lines("db", "\n".join(lines))
+        res = q(
+            ex,
+            f"SELECT mean(v), holt_winters(mean(v), 2, 0) FROM m WHERE "
+            f"time >= {BASE*NS} AND time < {(BASE+360)*NS} GROUP BY time(1m)",
+        )
+        s = series_of(res)
+        assert len(s["values"]) == 8  # 6 windows + 2 forecast rows
+        tail = s["values"][-2:]
+        assert all(r[1] is None and r[2] is not None for r in tail)
