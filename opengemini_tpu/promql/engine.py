@@ -32,6 +32,29 @@ def _anchor(pattern: str) -> str:
     return "^(?:" + pattern + ")$"
 
 
+def _match_sids(sh, metric: str, matchers) -> set[int]:
+    """Series ids matching prom label matchers via the inverted index
+    (prometheus fully anchors label-matcher regexes)."""
+    sids = sh.index.series_ids(metric)
+    for m in matchers:
+        if m.name == "__name__":
+            continue
+        try:
+            if m.op == "=":
+                sids &= sh.index.match_eq(metric, m.name, m.value)
+            elif m.op == "!=":
+                sids &= sh.index.match_neq(metric, m.name, m.value)
+            elif m.op == "=~":
+                sids &= sh.index.match_regex(metric, m.name, _anchor(m.value))
+            elif m.op == "!~":
+                sids &= sh.index.match_regex(
+                    metric, m.name, _anchor(m.value), negate=True
+                )
+        except re.error as e:
+            raise PromError(f"invalid regex in matcher {m.name!r}: {e}") from None
+    return sids
+
+
 class Frame:
     """Evaluation result: per-series (S, K) values over the step grid."""
 
@@ -100,6 +123,29 @@ class PromEngine:
         result.sort(key=lambda r: sorted(r["metric"].items()))
         return {"resultType": "vector", "result": result}
 
+    def series_labels(self, vs: "pp.VectorSelector", db: str) -> list[dict]:
+        """Label sets of series matching a selector — INDEX-ONLY, no data
+        decode (the /api/v1/series metadata surface)."""
+        self._check_readable()
+        metric = vs.metric
+        for m in vs.matchers:
+            if m.name == "__name__" and m.op == "=":
+                metric = m.value
+        if not metric:
+            raise PromError("metric name required")
+        seen = set()
+        out = []
+        for sh in self.engine.shards_for_range(db, None, -(2**62), 2**62):
+            for sid in _match_sids(sh, metric, vs.matchers):
+                tags = sh.index.tags_of(sid)
+                key = tuple(sorted(tags.items()))
+                if key not in seen:
+                    seen.add(key)
+                    labels = dict(tags)
+                    labels["__name__"] = metric
+                    out.append(labels)
+        return out
+
     def _check_readable(self) -> None:
         if getattr(self.engine, "read_disabled", False):
             raise PromError("reads are disabled (syscontrol)")
@@ -138,24 +184,7 @@ class PromEngine:
         # series may span shards: merge by label key
         per_key: dict[tuple, list] = {}
         for sh in shards:
-            sids = sh.index.series_ids(metric)
-            for m in vs.matchers:
-                if m.name == "__name__":
-                    continue
-                try:
-                    if m.op == "=":
-                        sids &= sh.index.match_eq(metric, m.name, m.value)
-                    elif m.op == "!=":
-                        sids &= sh.index.match_neq(metric, m.name, m.value)
-                    elif m.op == "=~":
-                        # prometheus fully anchors label-matcher regexes
-                        sids &= sh.index.match_regex(metric, m.name, _anchor(m.value))
-                    elif m.op == "!~":
-                        sids &= sh.index.match_regex(
-                            metric, m.name, _anchor(m.value), negate=True
-                        )
-                except re.error as e:
-                    raise PromError(f"invalid regex in matcher {m.name!r}: {e}") from None
+            sids = _match_sids(sh, metric, vs.matchers)
             for sid in sorted(sids):
                 tags = sh.index.tags_of(sid)
                 key = tuple(sorted(tags.items()))

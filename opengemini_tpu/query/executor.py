@@ -85,6 +85,10 @@ _READONLY_STMTS = (
     ast.ShowGrants,
     ast.ShowMeasurementCardinality,
     ast.ShowSeriesCardinality,
+    ast.ShowShards,
+    ast.ShowStats,
+    ast.ShowDiagnostics,
+    ast.ShowStreams,
 )
 
 
@@ -270,6 +274,43 @@ class Executor:
         if isinstance(stmt, ast.DropStream):
             self.engine.drop_stream(db, stmt.name)
             return {}
+        if isinstance(stmt, ast.ShowShards):
+            rows = []
+            for (sdb, rp, start), sh in sorted(self.engine._shards.items()):
+                rows.append([
+                    sdb, rp, start, sh.tmin, sh.tmax, len(sh._files),
+                    "cold" if os.path.islink(sh.path) else "hot",
+                ])
+            return _series_result(
+                "shards", None,
+                ["database", "retention_policy", "shard_group", "start_time",
+                 "end_time", "files", "tier"],
+                rows,
+            )
+        if isinstance(stmt, ast.ShowStats):
+            series = []
+            for module, vals in sorted(STATS.snapshot().items()):
+                rows = [[k, v] for k, v in sorted(vals.items())]
+                series.append(_series(module, None, ["statistic", "value"], rows))
+            return {"series": series} if series else {}
+        if isinstance(stmt, ast.ShowDiagnostics):
+            import platform
+            import sys as _sys
+
+            import jax as _jax
+
+            from opengemini_tpu import __version__
+
+            rows = [
+                ["version", __version__],
+                ["python", _sys.version.split()[0]],
+                ["jax", _jax.__version__],
+                ["backend", _jax.default_backend()],
+                ["devices", str(len(_jax.devices()))],
+                ["platform", platform.platform()],
+                ["data_dir", self.engine.root],
+            ]
+            return _series_result("system", None, ["name", "value"], rows)
         if isinstance(stmt, ast.ShowStreams):
             series = []
             for name in sorted(self.engine.databases):

@@ -210,6 +210,7 @@ def _make_handler(svc: HttpService):
             if body and self.headers.get("Content-Type", "").startswith(
                 "application/x-www-form-urlencoded"
             ):
+                self._form_pairs = urllib.parse.parse_qsl(body)
                 for k, v in urllib.parse.parse_qs(body).items():
                     params.setdefault(k, v[-1])
 
@@ -325,6 +326,8 @@ def _make_handler(svc: HttpService):
                     )
                 elif path == "/api/v1/labels":
                     data = self._prom_labels(db)
+                elif path == "/api/v1/series":
+                    data = self._prom_series(db, params)
                 elif path.startswith("/api/v1/label/") and path.endswith("/values"):
                     name = path[len("/api/v1/label/") : -len("/values")]
                     data = self._prom_label_values(db, name)
@@ -344,6 +347,35 @@ def _make_handler(svc: HttpService):
                 for mst in sh.measurements():
                     names.update(sh.index.tag_keys(mst))
             return sorted(names)
+
+        def _prom_series(self, db, params):
+            """/api/v1/series?match[]=selector — label sets of matching
+            series, index-only (reference: prom compat, handler_prom.go).
+            match[] may repeat; GET query string and POST form bodies both
+            count (promtool/Grafana POST urlencoded bodies)."""
+            from opengemini_tpu.promql import parser as prom_parser
+
+            matches = [v for k, v in self._raw_params() if k == "match[]"]
+            matches += [v for k, v in getattr(self, "_form_pairs", ())
+                        if k == "match[]"]
+            if not matches:
+                raise ValueError("missing match[] parameter")
+            out = []
+            seen = set()
+            for expr_text in matches:
+                expr = prom_parser.parse(expr_text)
+                if not isinstance(expr, prom_parser.VectorSelector):
+                    raise ValueError("match[] must be a vector selector")
+                for labels in svc.prom.series_labels(expr, db):
+                    key = tuple(sorted(labels.items()))
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(labels)
+            return out
+
+        def _raw_params(self) -> list[tuple[str, str]]:
+            parsed = urllib.parse.urlparse(self.path)
+            return urllib.parse.parse_qsl(parsed.query)
 
         def _prom_label_values(self, db, name):
             vals = set()

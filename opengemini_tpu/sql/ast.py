@@ -339,3 +339,18 @@ class DropStream:
 @dataclass
 class ShowStreams:
     pass
+
+
+@dataclass
+class ShowShards:
+    pass
+
+
+@dataclass
+class ShowStats:
+    pass
+
+
+@dataclass
+class ShowDiagnostics:
+    pass

@@ -21,7 +21,7 @@ KEYWORDS = {
     "begin", "end", "resample", "every", "for", "explain", "analyze",
     "user", "users", "password", "privileges", "grant", "grants", "revoke",
     "to", "set", "read", "write", "all", "cardinality", "exact",
-    "stream", "streams", "delay",
+    "stream", "streams", "delay", "shards", "stats", "diagnostics",
 }
 
 _DUR_RE = re.compile(r"(\d+)(ns|u|µ|us|ms|s|m|h|d|w)")

@@ -489,6 +489,12 @@ class Parser:
             return ast.ShowUsers()
         if kw.val == "streams":
             return ast.ShowStreams()
+        if kw.val == "shards":
+            return ast.ShowShards()
+        if kw.val == "stats":
+            return ast.ShowStats()
+        if kw.val == "diagnostics":
+            return ast.ShowDiagnostics()
         if kw.val == "grants":
             self._expect_kw("for")
             return ast.ShowGrants(self._ident())

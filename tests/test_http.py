@@ -311,3 +311,48 @@ def test_top_string_param_rejected_at_plan_time(server):
     assert "number or duration" in json.loads(body)["results"][0]["error"]
     _, body = get(server, "/query", db="db", q="SELECT detect(v, 'mad', 'x') FROM m")
     assert "number or duration" in json.loads(body)["results"][0]["error"]
+
+
+def test_prom_series_endpoint(server):
+    server.engine.create_database("prom")
+    post(server, "/write", "\n".join([
+        f"up,job=api,instance=a value=1 {BASE*NS}",
+        f"up,job=api,instance=b value=1 {BASE*NS}",
+        f"down,job=x value=1 {BASE*NS}",
+    ]).encode(), db="prom")
+    url = (f"http://127.0.0.1:{server.port}/api/v1/series?" +
+           urllib.parse.urlencode([("match[]", 'up{job="api"}')]))
+    with urllib.request.urlopen(url) as r:
+        data = json.loads(r.read())
+    assert data["status"] == "success"
+    insts = sorted(s["instance"] for s in data["data"])
+    assert insts == ["a", "b"]
+    # missing match[] -> 400
+    status, _ = get(server, "/api/v1/series")
+    assert status == 400
+
+
+def test_show_shards_stats_diagnostics(server):
+    post(server, "/write", f"m v=1 {BASE*NS}".encode(), db="db")
+    _, body = get(server, "/query", db="db", q="SHOW SHARDS")
+    s = json.loads(body)["results"][0]["series"][0]
+    assert s["columns"][0] == "database"
+    assert s["values"][0][0] == "db" and s["values"][0][6] == "hot"
+    _, body = get(server, "/query", q="SHOW STATS")
+    assert "series" in json.loads(body)["results"][0]
+    _, body = get(server, "/query", q="SHOW DIAGNOSTICS")
+    rows = dict(json.loads(body)["results"][0]["series"][0]["values"])
+    assert "jax" in rows and rows["backend"] in ("cpu", "tpu")
+
+
+def test_prom_series_post_form_body(server):
+    server.engine.create_database("prom")
+    post(server, "/write", f"up,job=api value=1 {BASE*NS}".encode(), db="prom")
+    body = urllib.parse.urlencode([("match[]", "up")]).encode()
+    status, out = post(
+        server, "/api/v1/series", body,
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+    )
+    assert status == 200
+    data = json.loads(out)["data"]
+    assert data and data[0]["job"] == "api"
