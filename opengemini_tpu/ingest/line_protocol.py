@@ -277,7 +277,12 @@ def _unescape(s: str) -> str:
 
 
 def _esc_key(s: str) -> str:
-    return s.replace("\\", "\\\\").replace(",", "\\,").replace("=", "\\=")
+    return (
+        s.replace("\\", "\\\\")
+        .replace(",", "\\,")
+        .replace("=", "\\=")
+        .replace(" ", "\\ ")
+    )
 
 
 def series_key(measurement: str, tags: tuple) -> str:

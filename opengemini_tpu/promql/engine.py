@@ -125,25 +125,42 @@ class PromEngine:
 
     def series_labels(self, vs: "pp.VectorSelector", db: str) -> list[dict]:
         """Label sets of series matching a selector — INDEX-ONLY, no data
-        decode (the /api/v1/series metadata surface)."""
+        decode (the /api/v1/series metadata surface). Unlike the query
+        path, ALL __name__ matcher operators are honored (=, !=, =~, !~)
+        by filtering the measurement set."""
         self._check_readable()
-        metric = vs.metric
+        shards = self.engine.shards_for_range(db, None, -(2**62), 2**62)
+        metrics: set[str] | None = {vs.metric} if vs.metric else None
         for m in vs.matchers:
-            if m.name == "__name__" and m.op == "=":
-                metric = m.value
-        if not metric:
+            if m.name != "__name__":
+                continue
+            if metrics is None:
+                metrics = {n for sh in shards for n in sh.index.measurements()}
+            try:
+                if m.op == "=":
+                    metrics &= {m.value}
+                elif m.op == "!=":
+                    metrics -= {m.value}
+                elif m.op in ("=~", "!~"):
+                    rx = re.compile(_anchor(m.value))
+                    hit = {n for n in metrics if rx.search(n)}
+                    metrics = hit if m.op == "=~" else metrics - hit
+            except re.error as e:
+                raise PromError(f"invalid __name__ regex: {e}") from None
+        if metrics is None:
             raise PromError("metric name required")
         seen = set()
         out = []
-        for sh in self.engine.shards_for_range(db, None, -(2**62), 2**62):
-            for sid in _match_sids(sh, metric, vs.matchers):
-                tags = sh.index.tags_of(sid)
-                key = tuple(sorted(tags.items()))
-                if key not in seen:
-                    seen.add(key)
-                    labels = dict(tags)
-                    labels["__name__"] = metric
-                    out.append(labels)
+        for sh in shards:
+            for metric in sorted(metrics):
+                for sid in _match_sids(sh, metric, vs.matchers):
+                    tags = sh.index.tags_of(sid)
+                    key = (metric, tuple(sorted(tags.items())))
+                    if key not in seen:
+                        seen.add(key)
+                        labels = dict(tags)
+                        labels["__name__"] = metric
+                        out.append(labels)
         return out
 
     def _check_readable(self) -> None:

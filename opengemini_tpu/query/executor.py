@@ -89,6 +89,7 @@ _READONLY_STMTS = (
     ast.ShowStats,
     ast.ShowDiagnostics,
     ast.ShowStreams,
+    ast.ShowSubscriptions,
 )
 
 
@@ -274,6 +275,36 @@ class Executor:
         if isinstance(stmt, ast.DropStream):
             self.engine.drop_stream(db, stmt.name)
             return {}
+        if isinstance(stmt, ast.CreateSubscription):
+            from opengemini_tpu.services.subscriber import Subscription
+
+            if not stmt.destinations:
+                raise QueryError("subscription requires at least one destination")
+            for dest in stmt.destinations:
+                if not dest.startswith(("http://", "https://")):
+                    raise QueryError(
+                        f"subscription destination must be an http(s) URL: {dest!r}"
+                    )
+            self.engine.create_subscription(
+                stmt.database or db,
+                Subscription(stmt.name, stmt.mode, stmt.destinations),
+            )
+            return {}
+        if isinstance(stmt, ast.DropSubscription):
+            self.engine.drop_subscription(stmt.database or db, stmt.name)
+            return {}
+        if isinstance(stmt, ast.ShowSubscriptions):
+            series = []
+            for name in sorted(self.engine.databases):
+                d = self.engine.databases[name]
+                rows = [
+                    [s.name, s.mode, ", ".join(s.destinations)]
+                    for s in d.subscriptions.values()
+                ]
+                series.append(
+                    _series(name, None, ["name", "mode", "destinations"], rows)
+                )
+            return {"series": series} if series else {}
         if isinstance(stmt, ast.ShowShards):
             rows = []
             for (sdb, rp, start), sh in sorted(self.engine._shards.items()):

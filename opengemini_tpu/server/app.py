@@ -103,6 +103,9 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
         svc.engine, float(sc.get("compact-interval-s", 600)),
         int(sc.get("compact-max-files", 4)),
     ))
+    from opengemini_tpu.services.subscriber import SubscriberManager
+
+    svc.subscriber = SubscriberManager(svc.engine)
     if sc.get("cold-dir"):
         from opengemini_tpu.services.hierarchical import HierarchicalService
 
@@ -134,6 +137,8 @@ def main(argv=None) -> int:
     print("shutting down", flush=True)
     for s in svc.services:
         s.stop()
+    if getattr(svc, "subscriber", None) is not None:
+        svc.subscriber.stop()
     if svc.meta_store is not None:
         svc.meta_store.stop()
     svc.stop()

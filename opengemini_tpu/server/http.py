@@ -178,6 +178,7 @@ def _make_handler(svc: HttpService):
         # -- routes ---------------------------------------------------------
 
         def do_GET(self):
+            self._form_pairs = ()  # reset per request (keep-alive reuse)
             path = urllib.parse.urlparse(self.path).path
             if path == "/ping":
                 self._send(204)
@@ -215,6 +216,7 @@ def _make_handler(svc: HttpService):
                     params.setdefault(k, v[-1])
 
         def do_POST(self):
+            self._form_pairs = ()  # reset per request (keep-alive reuse)
             path = urllib.parse.urlparse(self.path).path
             params = self._params()
             if path == "/query":
@@ -296,7 +298,49 @@ def _make_handler(svc: HttpService):
                 return
             epoch = params.get("epoch")
             pretty = params.get("pretty") in ("true", "1")
-            self._send_json(200, format_result(result, epoch), pretty)
+            result = format_result(result, epoch)
+            if params.get("chunked") in ("true", "1"):
+                try:
+                    chunk_size = max(1, int(params.get("chunk_size", 10_000)))
+                except ValueError:
+                    self._send_json(400, {"error": "bad chunk_size"})
+                    return
+                self._send_chunked(result, chunk_size)
+                return
+            self._send_json(200, result, pretty)
+
+        def _send_chunked(self, result: dict, chunk_size: int):
+            """Influx chunked responses: newline-delimited JSON documents
+            STREAMED via HTTP chunked transfer encoding — each document is
+            serialized and written independently, never the whole response
+            (handler.go chunked write path)."""
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.send_header("X-Influxdb-Version", "1.8.0-" + __version__)
+            self.end_headers()
+
+            def emit(doc: dict) -> None:
+                data = (json.dumps(doc) + "\n").encode("utf-8")
+                self.wfile.write(f"{len(data):X}\r\n".encode("ascii"))
+                self.wfile.write(data)
+                self.wfile.write(b"\r\n")
+
+            for res in result.get("results", []):
+                base = {k: v for k, v in res.items() if k != "series"}
+                series_list = res.get("series", [])
+                if not series_list:
+                    emit({"results": [base]})
+                    continue
+                for series in series_list:
+                    values = series.get("values", [])
+                    for off in range(0, max(len(values), 1), chunk_size):
+                        part = dict(series)
+                        part["values"] = values[off : off + chunk_size]
+                        if off + chunk_size < len(values):
+                            part["partial"] = True
+                        emit({"results": [dict(base, series=[part])]})
+            self.wfile.write(b"0\r\n\r\n")
 
         def _handle_prom(self, path: str, params: dict):
             """Prometheus HTTP API v1 (reference: handler_prom.go)."""

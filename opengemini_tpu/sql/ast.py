@@ -342,6 +342,25 @@ class ShowStreams:
 
 
 @dataclass
+class CreateSubscription:
+    name: str = ""
+    database: str = ""
+    mode: str = "ALL"
+    destinations: list[str] = field(default_factory=list)
+
+
+@dataclass
+class DropSubscription:
+    name: str = ""
+    database: str = ""
+
+
+@dataclass
+class ShowSubscriptions:
+    pass
+
+
+@dataclass
 class ShowShards:
     pass
 

@@ -491,6 +491,8 @@ class Parser:
             return ast.ShowStreams()
         if kw.val == "shards":
             return ast.ShowShards()
+        if kw.val == "subscriptions":
+            return ast.ShowSubscriptions()
         if kw.val == "stats":
             return ast.ShowStats()
         if kw.val == "diagnostics":
@@ -504,7 +506,25 @@ class Parser:
 
     def parse_create(self):
         self._expect_kw("create")
-        kw = self._expect_kw("database", "retention", "continuous", "user", "stream")
+        kw = self._expect_kw(
+            "database", "retention", "continuous", "user", "stream", "subscription"
+        )
+        if kw == "subscription":
+            # CREATE SUBSCRIPTION name ON db DESTINATIONS ALL|ANY 'url', ...
+            name = self._ident()
+            self._expect_kw("on")
+            db = self._ident()
+            self._expect_kw("destinations")
+            mode = self._expect_kw("all", "any").upper()
+            dests = []
+            while True:
+                tok = self.lex.next()
+                if tok.kind != "STRING":
+                    raise ParseError("destination must be a quoted URL")
+                dests.append(tok.val)
+                if not self._accept_op(","):
+                    break
+            return ast.CreateSubscription(name, db, mode, dests)
         if kw == "stream":
             # CREATE STREAM name INTO db..dest ON SELECT ... [DELAY 5s]
             # (reference: openGemini stream DDL, services/stream)
@@ -603,10 +623,14 @@ class Parser:
         self._expect_kw("drop")
         kw = self._expect_kw(
             "database", "retention", "measurement", "continuous", "user", "series",
-            "stream",
+            "stream", "subscription",
         )
         if kw == "stream":
             return ast.DropStream(self._ident())
+        if kw == "subscription":
+            name = self._ident()
+            self._expect_kw("on")
+            return ast.DropSubscription(name, self._ident())
         if kw == "database":
             return ast.DropDatabase(self._ident())
         if kw == "measurement":

@@ -113,6 +113,7 @@ class Database:
         # rp name -> [DownsamplePolicy]
         self.downsample: dict[str, list[DownsamplePolicy]] = {}
         self.streams: dict[str, StreamTask] = {}
+        self.subscriptions: dict[str, object] = {}
 
 
 class WriteError(Exception):
@@ -173,6 +174,11 @@ class Engine:
             for sj in dbj.get("streams", []):
                 st = StreamTask.from_json(sj)
                 db.streams[st.name] = st
+            from opengemini_tpu.services.subscriber import Subscription
+
+            for sj in dbj.get("subscriptions", []):
+                sub = Subscription.from_json(sj)
+                db.subscriptions[sub.name] = sub
             self.databases[db.name] = db
 
     def _save_meta(self) -> None:
@@ -188,6 +194,9 @@ class Engine:
                         for rp, pols in db.downsample.items()
                     },
                     "streams": [s.to_json() for s in db.streams.values()],
+                    "subscriptions": [
+                        s.to_json() for s in db.subscriptions.values()
+                    ],
                 }
                 for db in self.databases.values()
             ]
@@ -365,6 +374,21 @@ class Engine:
     def save_cq_state(self) -> None:
         with self._lock:
             self._save_meta()
+
+    def create_subscription(self, db: str, sub) -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d is None:
+                raise DatabaseNotFound(db)
+            d.subscriptions[sub.name] = sub
+            self._save_meta()
+
+    def drop_subscription(self, db: str, name: str) -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d and name in d.subscriptions:
+                del d.subscriptions[name]
+                self._save_meta()
 
     def create_stream(self, db: str, task: "StreamTask") -> None:
         with self._lock:

@@ -1,0 +1,237 @@
+"""Subscriptions + chunked query responses."""
+
+import json
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.server.http import HttpService
+from opengemini_tpu.services.subscriber import (
+    SubscriberManager,
+    Subscription,
+    points_to_lines,
+)
+from opengemini_tpu.storage.engine import Engine, NS
+from opengemini_tpu.record import FieldType
+
+BASE = 1_700_000_040
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = Engine(str(tmp_path / "data"))
+    e.create_database("db")
+    yield e, Executor(e)
+    e.close()
+
+
+def q(ex, text):
+    return ex.execute(text, db="db", now_ns=(BASE + 10_000) * NS)
+
+
+class _Sink:
+    """Tiny HTTP sink recording /write bodies."""
+
+    def __init__(self):
+        self.bodies = []
+        sink = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                sink.bodies.append(self.rfile.read(n).decode())
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestSubscriptions:
+    def test_ddl_and_persistence(self, env):
+        e, ex = env
+        res = q(ex, "CREATE SUBSCRIPTION s1 ON db DESTINATIONS ALL "
+                    "'http://h1:9', 'http://h2:9'")
+        assert "error" not in res["results"][0]
+        s = q(ex, "SHOW SUBSCRIPTIONS")["results"][0]["series"][0]
+        assert s["values"][0][0] == "s1" and s["values"][0][1] == "ALL"
+        e.close()
+        e2 = Engine(e.root)
+        assert "s1" in e2.databases["db"].subscriptions
+        e2.close()
+        q(ex, "DROP SUBSCRIPTION s1 ON db")
+
+    def test_forwarding(self, env):
+        import time
+
+        e, ex = env
+        sink = _Sink()
+        try:
+            mgr = SubscriberManager(e)
+            q(ex, f"CREATE SUBSCRIPTION fwd ON db DESTINATIONS ALL "
+                  f"'http://127.0.0.1:{sink.port}'")
+            e.write_lines("db", f"cpu,host=h1 v=1.5 {BASE*NS}")
+            deadline = time.time() + 5
+            while not sink.bodies and time.time() < deadline:
+                time.sleep(0.05)
+            assert sink.bodies
+            assert sink.bodies[0] == f"cpu,host=h1 v=1.5 {BASE*NS}"
+            mgr.stop()
+        finally:
+            sink.stop()
+
+    def test_points_to_lines_escaping_roundtrip(self):
+        import opengemini_tpu.ingest.line_protocol as lp
+
+        points = [
+            ("my mst", (("ta g", "v,1"),), 123,
+             {"f=x": (FieldType.FLOAT, 1.5), "s": (FieldType.STRING, 'a "b"'),
+              "i": (FieldType.INT, -7), "b": (FieldType.BOOL, True)}),
+        ]
+        text = points_to_lines(points)
+        [(mst, tags, t, fields)] = lp.parse_lines(text)
+        assert mst == "my mst" and tags == (("ta g", "v,1"),)
+        assert fields["f=x"] == (FieldType.FLOAT, 1.5)
+        assert fields["s"] == (FieldType.STRING, 'a "b"')
+        assert fields["i"] == (FieldType.INT, -7)
+        assert fields["b"] == (FieldType.BOOL, True)
+
+
+class TestChunkedQueries:
+    @pytest.fixture
+    def server(self, tmp_path):
+        engine = Engine(str(tmp_path / "data"))
+        engine.create_database("db")
+        svc = HttpService(engine, "127.0.0.1", 0)
+        svc.start()
+        yield svc
+        svc.stop()
+        engine.close()
+
+    def _get(self, svc, **params):
+        url = f"http://127.0.0.1:{svc.port}/query?" + urllib.parse.urlencode(params)
+        with urllib.request.urlopen(url) as r:
+            return r.read().decode()
+
+    def test_chunked_splits_series(self, server):
+        lines = "\n".join(f"m v={i} {(BASE+i)*NS}" for i in range(25))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/write?db=db",
+            data=lines.encode(), method="POST")
+        urllib.request.urlopen(req)
+        body = self._get(server, db="db", q="SELECT v FROM m", epoch="ns",
+                         chunked="true", chunk_size="10")
+        docs = [json.loads(l) for l in body.strip().split("\n")]
+        assert len(docs) == 3
+        sizes = [len(d["results"][0]["series"][0]["values"]) for d in docs]
+        assert sizes == [10, 10, 5]
+        assert docs[0]["results"][0]["series"][0].get("partial") is True
+        assert "partial" not in docs[-1]["results"][0]["series"][0]
+        # rows concatenate to the full result
+        all_rows = [r for d in docs
+                    for r in d["results"][0]["series"][0]["values"]]
+        assert len(all_rows) == 25
+
+    def test_chunked_bad_size(self, server):
+        try:
+            self._get(server, db="db", q="SELECT v FROM m", chunked="true",
+                      chunk_size="abc")
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+
+class TestReviewRegressions:
+    def test_subscription_rejects_bad_urls(self, env):
+        e, ex = env
+        res = q(ex, "CREATE SUBSCRIPTION bad ON db DESTINATIONS ALL 'localhost:8086'")
+        assert "http(s) URL" in res["results"][0]["error"]
+
+    def test_worker_survives_bad_destination(self, env):
+        import time
+
+        e, ex = env
+        sink = _Sink()
+        try:
+            mgr = SubscriberManager(e)
+            # one dead destination + one live; worker must keep going
+            q(ex, f"CREATE SUBSCRIPTION s ON db DESTINATIONS ALL "
+                  f"'http://127.0.0.1:1', 'http://127.0.0.1:{sink.port}'")
+            e.write_lines("db", f"m v=1 {BASE*NS}")
+            e.write_lines("db", f"m v=2 {(BASE+1)*NS}")
+            deadline = time.time() + 8
+            while len(sink.bodies) < 2 and time.time() < deadline:
+                time.sleep(0.05)
+            assert len(sink.bodies) == 2
+            assert mgr._thread.is_alive()
+            mgr.stop()
+        finally:
+            sink.stop()
+
+    def test_rp_forwarded(self, env):
+        import time
+
+        e, ex = env
+        e.create_retention_policy("db", "weekly", duration_ns=0)
+        sink = _Sink()
+
+        class _CapturePath(_Sink):
+            pass
+
+        paths = []
+        orig_post = SubscriberManager._post
+
+        def capture(self, dest, db, rp, body):
+            paths.append((db, rp))
+            return orig_post(self, dest, db, rp, body)
+
+        try:
+            mgr = SubscriberManager(e)
+            SubscriberManager._post = capture
+            q(ex, f"CREATE SUBSCRIPTION s ON db DESTINATIONS ALL "
+                  f"'http://127.0.0.1:{sink.port}'")
+            e.write_lines("db", f"m v=1 {BASE*NS}", rp="weekly")
+            deadline = time.time() + 5
+            while not paths and time.time() < deadline:
+                time.sleep(0.05)
+            assert paths and paths[0] == ("db", "weekly")
+            mgr.stop()
+        finally:
+            SubscriberManager._post = orig_post
+            sink.stop()
+
+
+def test_prom_series_name_matcher_operators(tmp_path):
+    from opengemini_tpu.promql.engine import PromEngine
+    from opengemini_tpu.promql import parser as pp
+
+    e = Engine(str(tmp_path / "d"))
+    e.create_database("prom")
+    e.write_lines("prom", "\n".join([
+        f"up,job=a value=1 {BASE*NS}",
+        f"upstream,job=b value=1 {BASE*NS}",
+        f"down,job=c value=1 {BASE*NS}",
+    ]))
+    pe = PromEngine(e)
+    sels = {
+        '{__name__=~"up.*"}': {"up", "upstream"},
+        'up{__name__!="up"}': set(),
+        '{__name__!="up"}': {"upstream", "down"},
+    }
+    for text, expect in sels.items():
+        labels = pe.series_labels(pp.parse(text), "prom")
+        assert {l["__name__"] for l in labels} == expect, text
+    e.close()
