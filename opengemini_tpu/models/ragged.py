@@ -44,6 +44,65 @@ _MIN_G = 8
 DENSE_AGGS = {"sum", "count", "mean", "min", "max", "first", "last",
               "spread", "stddev"}
 
+# aggregates the host-exact int64 path supports (INT fields: float compute
+# dtype would corrupt values beyond its mantissa — 2^24 in f32 on TPU).
+# Selector aggs (min/max/first/last) stay on-device for row selection.
+INT_EXACT_AGGS = {"sum", "count", "mean"}
+
+
+class IntExactBatch:
+    """Host-side exact int64 aggregation for INT fields (same add/run
+    contract as AggBatch/BucketedBatch, minus selector support — the
+    routing predicate never sends selectors here). numpy ufunc.at is
+    slower than the device, but integer exactness wins for int columns —
+    the same tradeoff storage/downsample.py makes for destructive
+    rewrites. No timestamps are retained (no selectors -> no consumer)."""
+
+    def __init__(self):
+        self._vals: list[np.ndarray] = []
+        self._seg: list[np.ndarray] = []
+        self._mask: list[np.ndarray] = []
+        self.n = 0
+        self._acc = None
+
+    def add(self, values, rel_ns, seg_ids, mask, times_ns):
+        self._vals.append(np.asarray(values))
+        self._seg.append(np.asarray(seg_ids, dtype=np.int64))
+        self._mask.append(np.asarray(mask, dtype=np.bool_))
+        self.n += len(values)
+
+    def host_times(self) -> np.ndarray:
+        return np.empty(0, np.int64)  # interface parity; never consumed
+
+    def _accumulate(self, num_segments: int):
+        if self._acc is not None:
+            return self._acc
+        s = np.zeros(num_segments, dtype=np.int64)
+        c = np.zeros(num_segments, dtype=np.int64)
+        for vals, seg, mask in zip(self._vals, self._seg, self._mask):
+            idx = np.flatnonzero(mask)
+            if not len(idx):
+                continue
+            v = vals[idx].astype(np.int64)
+            g = seg[idx]
+            np.add.at(s, g, v)
+            np.add.at(c, g, 1)
+        self._acc = (s, c)
+        self._vals = self._seg = self._mask = []  # free the raw rows
+        return self._acc
+
+    def run(self, spec, num_segments: int, params: tuple = ()):
+        s, c = self._accumulate(num_segments)
+        if spec.name == "sum":
+            out = s  # int64 end-to-end; renderer keeps integers exact
+        elif spec.name == "count":
+            out = c
+        elif spec.name == "mean":
+            out = s / np.maximum(c, 1)
+        else:
+            raise ValueError(f"int-exact path does not support {spec.name!r}")
+        return np.asarray(out), None, c
+
 
 class BucketedBatch:
     """Drop-in alternative to templates.AggBatch for dense-capable

@@ -752,14 +752,22 @@ class Executor:
         per_field_aggs: dict[str, list] = {}
         for _call, spec, _params, fname in aggs:
             per_field_aggs.setdefault(fname, []).append(spec.name)
-        batches: dict[str, object] = {
-            f: (
-                ragged.BucketedBatch(dtype)
-                if all(n in ragged.DENSE_AGGS for n in per_field_aggs[f])
-                else templates.AggBatch(dtype)
-            )
-            for f in needed_fields
-        }
+        def _pick_batch(f: str):
+            names = per_field_aggs[f]
+            if (
+                schema.get(f) == FieldType.INT
+                and all(n in ragged.INT_EXACT_AGGS for n in names)
+                and any(n in ("sum", "mean") for n in names)
+            ):
+                # int64-exact host path: float compute would corrupt ints
+                # beyond the mantissa (2^24 on-TPU f32). count alone is
+                # value-independent and stays on the fast device path.
+                return ragged.IntExactBatch()
+            if all(n in ragged.DENSE_AGGS for n in names):
+                return ragged.BucketedBatch(dtype)
+            return templates.AggBatch(dtype)
+
+        batches: dict[str, object] = {f: _pick_batch(f) for f in needed_fields}
 
         # string fields only support count on the device path (reference
         # supports first/last/distinct on strings — host path, later round)
@@ -784,8 +792,19 @@ class Executor:
             and sc.field_expr is None
             and all(spec.name in ("count", "sum", "mean") for _c, spec, _p, _f in aggs)
         )
-        pre_count = {f: np.zeros(num_segments) for f in needed_fields} if pre_eligible else {}
-        pre_sum = {f: np.zeros(num_segments) for f in needed_fields} if pre_eligible else {}
+        # pre-agg accumulators: int64 for INT fields (stored vsum values are
+        # exact python ints), float64 otherwise
+        def _pre_dtype(f):
+            return np.int64 if schema.get(f) == FieldType.INT else np.float64
+
+        pre_count = (
+            {f: np.zeros(num_segments, np.int64) for f in needed_fields}
+            if pre_eligible else {}
+        )
+        pre_sum = (
+            {f: np.zeros(num_segments, _pre_dtype(f)) for f in needed_fields}
+            if pre_eligible else {}
+        )
         sum_fields = {f for _c, spec, _p, f in aggs if spec.name != "count"}
         pre_used = False
 
@@ -1400,13 +1419,16 @@ class Executor:
 
 def _add_record_to_batches(rec, seg, aligned, needed_fields, batches, dtype, fmask):
     """Shared scan step: one record's columns into the per-field device
-    batches (string columns become count-only zero payloads)."""
+    batches (string columns become count-only zero payloads; int-exact
+    host batches receive the raw int64 values uncast)."""
     rel = rec.times - aligned  # int64 ns; (hi, lo)-split on add()
     for fname in needed_fields:
         col = rec.columns.get(fname)
         if col is None:
             continue
-        if col.ftype == FieldType.STRING:
+        if isinstance(batches[fname], ragged.IntExactBatch):
+            vals = col.values  # int64 end-to-end, no float cast
+        elif col.ftype == FieldType.STRING:
             vals = np.zeros(len(rec), dtype=dtype)  # count-only path
         else:
             vals = col.values.astype(dtype)
@@ -1669,6 +1691,10 @@ def _eval_output_expr(expr, agg_results, seg, schema):
         if spec.int_output:
             return int(v), True
         if ftype == FieldType.INT and spec.name in ("sum", "min", "max", "first", "last", "spread"):
+            # int64-exact path yields integer arrays: never round-trip
+            # through float (2^53 cliff)
+            if isinstance(v, np.integer):
+                return int(v), True
             return int(round(float(v))), True
         if ftype == FieldType.BOOL and spec.name in ("first", "last", "min", "max"):
             return bool(round(float(v))), True

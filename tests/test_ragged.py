@@ -119,3 +119,80 @@ def test_stddev_singleton_is_zero(tmp_path, rng):
     res = ex.execute("SELECT stddev(v) FROM m", db="db", now_ns=1700001000 * 10**9)
     assert res["results"][0]["series"][0]["values"][0][1] == 0.0
     e.close()
+
+
+class TestIntExactPath:
+    def test_sum_exact_beyond_f64_mantissa(self, tmp_path):
+        """Ints > 2^53: sum must be EXACT (float compute rounds them)."""
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        e = Engine(str(tmp_path / "d"))
+        e.create_database("db")
+        big = 2**53 + 1  # not representable in f64
+        e.write_lines(
+            "db",
+            f"m c={big}i 1700000000000000000\nm c=2i 1700000001000000000",
+        )
+        ex = Executor(e)
+        res = ex.execute("SELECT sum(c), count(c), mean(c) FROM m", db="db",
+                         now_ns=1700001000 * 10**9)
+        [(t, s, c, mean)] = res["results"][0]["series"][0]["values"]
+        assert s == big + 2  # exact int64, would be off under f64
+        assert isinstance(s, int) and c == 2
+        assert mean == pytest.approx((big + 2) / 2)
+        e.close()
+
+    def test_exact_with_preagg_after_flush(self, tmp_path):
+        """Pure pre-agg path (all chunks flushed, no memtable overlap):
+        the int64 pre_sum combine itself must be exact."""
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        e = Engine(str(tmp_path / "d"))
+        e.create_database("db")
+        big = 2**53 + 1
+        e.write_lines("db", f"m c={big}i 1700000000000000000")
+        e.flush_all()
+        e.write_lines("db", "m c=4i 1700000005000000000")
+        e.flush_all()  # two non-overlapping chunks, no memtable rows
+        ex = Executor(e)
+        # confirm the pre-agg path actually engages (no chunk decode)
+        from opengemini_tpu.storage import tsf as tsf_mod
+
+        calls = {"n": 0}
+        orig = tsf_mod.TSFReader.read_chunk
+
+        def counting(self, *a, **kw):
+            calls["n"] += 1
+            return orig(self, *a, **kw)
+
+        tsf_mod.TSFReader.read_chunk = counting
+        try:
+            res = ex.execute("SELECT sum(c) FROM m", db="db",
+                             now_ns=1700001000 * 10**9)
+        finally:
+            tsf_mod.TSFReader.read_chunk = orig
+        assert calls["n"] == 0  # served from pre-agg metadata
+        assert res["results"][0]["series"][0]["values"][0][1] == big + 4
+
+        # mixed pre-agg + memtable: falls back per series but stays exact
+        e.write_lines("db", "m c=1i 1700000006000000000")
+        res = ex.execute("SELECT sum(c) FROM m", db="db",
+                         now_ns=1700001000 * 10**9)
+        assert res["results"][0]["series"][0]["values"][0][1] == big + 5
+        e.close()
+
+    def test_mixed_aggs_fall_back_to_device(self, tmp_path):
+        """INT field with a selector agg keeps the device path (sel works)."""
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        e = Engine(str(tmp_path / "d"))
+        e.create_database("db")
+        e.write_lines("db", "m c=5i 1700000000000000000\nm c=9i 1700000001000000000")
+        ex = Executor(e)
+        res = ex.execute("SELECT max(c) FROM m", db="db", now_ns=1700001000 * 10**9)
+        [(t, v)] = res["results"][0]["series"][0]["values"]
+        assert v == 9 and t == 1700000001000000000  # selector time intact
+        e.close()
