@@ -641,6 +641,13 @@ class Executor:
         return sorted(names)
 
     def _select_measurement(self, stmt, db, rp, mst, now_ns, trace=tracing.NOOP) -> list[dict]:
+        # percentile_approx: answered from chunk histogram sketches
+        if len(stmt.fields) == 1:
+            only = _strip_expr(stmt.fields[0].expr)
+            if isinstance(only, ast.Call) and only.name == "percentile_approx":
+                return self._select_percentile_approx(
+                    stmt, db, rp, mst, now_ns, only
+                )
         kind = _classify_select(stmt)
         if kind == "raw":
             return self._select_raw(stmt, db, rp, mst, now_ns)
@@ -883,16 +890,11 @@ class Executor:
         """Try the pre-agg path for one series. Returns (handled, rows):
         handled=False -> caller does the normal decode+batch scan. No side
         effects until the whole series validates."""
-        mem_rec = sh.mem.record_for(sid)
-        if mem_rec is not None and len(mem_rec.slice_time(tmin, tmax)):
-            return False, 0  # memtable rows may overwrite file rows
-        srcs = sh.file_chunks(mst, {sid}, tmin, tmax)
+        needs_merge, srcs = _series_needs_merged_decode(sh, mst, sid, tmin, tmax)
+        if needs_merge:
+            return False, 0  # dedup required: decode via read_series
         if not srcs:
             return True, 0  # nothing in range at all
-        metas = sorted((c for _r, c in srcs), key=lambda c: c.tmin)
-        for a, b in zip(metas, metas[1:]):
-            if b.tmin <= a.tmax:
-                return False, 0  # overlapping chunks: dedup needed, decode
         # validate: every fully-covered chunk must carry a sum for fields
         # that need one (bool/string columns store count-only pre-agg)
         contrib: list[tuple[str, int, float | None]] = []
@@ -1006,6 +1008,108 @@ class Executor:
             }
             if group_tags:
                 series["tags"] = dict(zip(group_tags, key))
+            out_series.append(series)
+        return out_series
+
+    # -- percentile_approx (chunk-histogram sketches) ------------------------
+
+    def _select_percentile_approx(self, stmt, db, rp, mst, now_ns, call) -> list[dict]:
+        """percentile_approx(field, q): served from the per-chunk histogram
+        sketches in TSF pre-agg metadata — covered chunks contribute their
+        histograms with NO data decode (reference: OGSketch, persisted).
+        Memtable rows, partially-covered and histogram-less chunks decode
+        and bin exactly. Error: within one chunk-histogram bin width
+        (chunk_range/32) for sketch-served mass, one global bin width
+        (range/256) for directly-binned rows."""
+        from opengemini_tpu.query.sketch import HistSketch
+
+        if stmt.group_by_time is not None:
+            raise QueryError("percentile_approx() does not support GROUP BY time yet")
+        if len(call.args) != 2:
+            raise QueryError("percentile_approx() takes (field, q)")
+        fld = _strip_expr(call.args[0])
+        if not isinstance(fld, ast.VarRef):
+            raise QueryError("percentile_approx() field must be a field name")
+        qv = float(_call_param_value(call.args[1]))
+        if not (0 <= qv <= 100):
+            raise QueryError("percentile_approx() q must be between 0 and 100")
+        fname = fld.name
+        ctx = self._scan_context(stmt, db, rp, mst, now_ns)
+        if ctx is None:
+            return []
+        if ctx.schema.get(fname) not in (FieldType.FLOAT, FieldType.INT):
+            raise QueryError("percentile_approx() requires a numeric field")
+        if ctx.sc.field_expr is not None:
+            raise QueryError("percentile_approx() does not support field filters")
+        tmin, tmax = ctx.tmin, ctx.tmax
+
+        # pass 1: per group, chunk hists (zero decode) or decoded values;
+        # any dedup risk (overlapping chunks / memtable rows) falls the
+        # whole series back to the merged read_series view
+        plans: dict[int, list] = {}  # gid -> [(kind, payload)]
+        bounds: dict[int, list] = {}
+
+        def _add_vals(gid, vals):
+            vals = vals[np.isfinite(vals)]  # nan/inf points never bin
+            if not len(vals):
+                return
+            plans.setdefault(gid, []).append(("values", vals))
+            b = bounds.setdefault(gid, [np.inf, -np.inf])
+            b[0] = min(b[0], float(vals.min()))
+            b[1] = max(b[1], float(vals.max()))
+
+        for sh, sid, gid in ctx.scan_plan:
+            needs_merge, srcs = _series_needs_merged_decode(sh, mst, sid, tmin, tmax)
+            if needs_merge:
+                rec = sh.read_series(mst, sid, tmin, tmax, fields=[fname])
+                col = rec.columns.get(fname)
+                if col is not None and len(rec):
+                    _add_vals(gid, col.values[col.valid].astype(np.float64))
+                continue
+            for r, c in srcs:
+                loc = c.cols.get(fname)
+                pre = loc["pre"] if loc else None
+                covered = tmin <= c.tmin and c.tmax < tmax
+                if covered and pre is not None and pre.count and pre.hist is not None:
+                    plans.setdefault(gid, []).append(("hist", pre))
+                    b = bounds.setdefault(gid, [np.inf, -np.inf])
+                    b[0] = min(b[0], pre.vmin)
+                    b[1] = max(b[1], pre.vmax)
+                else:
+                    rec = r.read_chunk(mst, c, [fname]).slice_time(tmin, tmax)
+                    col = rec.columns.get(fname)
+                    if col is not None and len(rec):
+                        _add_vals(gid, col.values[col.valid].astype(np.float64))
+
+        name = stmt.fields[0].alias or "percentile_approx"
+        out_series = []
+        order = sorted(range(len(ctx.group_keys)), key=lambda g: ctx.group_keys[g])
+        t0 = ctx.aligned if ctx.aligned else 0
+        for g in order:
+            entries = plans.get(g)
+            if not entries:
+                continue
+            lo, hi = bounds[g]
+            sk = HistSketch(lo, hi)
+            for kind, payload in entries:
+                if kind == "hist":
+                    sk.add_chunk_hist(payload.vmin, payload.vmax, payload.hist)
+                else:
+                    sk.add_values(payload)
+            v = sk.percentile(qv)
+            if v is None:
+                continue
+            rows = [[t0, v]]
+            if not stmt.ascending:
+                rows.reverse()
+            rows = rows[stmt.offset :]
+            if stmt.limit:
+                rows = rows[: stmt.limit]
+            if not rows:
+                continue
+            series = {"name": mst, "columns": ["time", name], "values": rows}
+            if ctx.group_tags:
+                series["tags"] = dict(zip(ctx.group_tags, ctx.group_keys[g]))
             out_series.append(series)
         return out_series
 
@@ -1417,6 +1521,22 @@ class Executor:
 # -- helpers -----------------------------------------------------------------
 
 
+def _series_needs_merged_decode(sh, mst, sid, tmin, tmax):
+    """Dedup-risk check shared by the pre-agg and sketch fast paths: a
+    series needs the merged read_series view when memtable rows overlap
+    the range or its chunks overlap each other (last-write-wins dedup).
+    Returns (needs_merge, chunk_sources)."""
+    mem_rec = sh.mem.record_for(sid)
+    if mem_rec is not None and len(mem_rec.slice_time(tmin, tmax)):
+        return True, None
+    srcs = sh.file_chunks(mst, {sid}, tmin, tmax)
+    metas = sorted((c for _r, c in srcs), key=lambda c: c.tmin)
+    for a, b in zip(metas, metas[1:]):
+        if b.tmin <= a.tmax:
+            return True, None
+    return False, srcs
+
+
 def _add_record_to_batches(rec, seg, aligned, needed_fields, batches, dtype, fmask):
     """Shared scan step: one record's columns into the per-field device
     batches (string columns become count-only zero payloads; int-exact
@@ -1510,6 +1630,8 @@ def _is_device_call(call: ast.Call) -> bool:
 
 def _call_param_value(arg) -> float | int:
     a = _strip_expr(arg)
+    if isinstance(a, ast.UnaryExpr) and a.op == "-":
+        return -_call_param_value(a.expr)
     if isinstance(a, ast.IntegerLiteral):
         return a.val
     if isinstance(a, ast.NumberLiteral):

@@ -1,0 +1,84 @@
+"""Approximate percentile from chunk histogram sketches.
+
+Reference: OGSketch quantile sketches (engine/executor/ogsketch.go) — but
+persisted per chunk in the TSF pre-agg metadata, so
+`percentile_approx(field, q)` answers WITHOUT decoding data blocks:
+chunk histograms re-bin into one global histogram (proportional count
+distribution), memtable rows and histogram-less chunks bin directly.
+Error bound: directly-binned values are within one GLOBAL bin width
+(range/256); mass re-binned from a chunk histogram is within one CHUNK
+bin width ((chunk_max - chunk_min)/32), which dominates when a chunk
+spans most of the value range.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+GLOBAL_BINS = 256
+
+
+class HistSketch:
+    """Mergeable equi-width histogram over a fixed global [lo, hi]."""
+
+    def __init__(self, lo: float, hi: float, bins: int = GLOBAL_BINS):
+        self.lo = lo
+        self.hi = max(hi, lo)
+        self.bins = bins
+        self.counts = np.zeros(bins, dtype=np.float64)
+        self.total = 0.0
+
+    def _width(self) -> float:
+        return (self.hi - self.lo) / self.bins if self.hi > self.lo else 1.0
+
+    def add_chunk_hist(self, vmin: float, vmax: float, hist: list) -> None:
+        """Re-bin a chunk's histogram: each source bin's count spreads
+        proportionally over the global bins it overlaps."""
+        src = np.asarray(hist, dtype=np.float64)
+        n_src = len(src)
+        src_w = (vmax - vmin) / n_src if vmax > vmin else 0.0
+        if src_w == 0.0:
+            self.add_values(np.full(int(src.sum()), vmin))
+            return
+        w = self._width()
+        for i, c in enumerate(src):
+            if c == 0:
+                continue
+            a = vmin + i * src_w
+            b = a + src_w
+            g0 = int(np.clip((a - self.lo) / w, 0, self.bins - 1))
+            g1 = int(np.clip((b - self.lo) / w - 1e-12, 0, self.bins - 1))
+            if g1 <= g0:
+                self.counts[g0] += c
+            else:
+                # proportional split over covered global bins
+                for g in range(g0, g1 + 1):
+                    lo_g = self.lo + g * w
+                    hi_g = lo_g + w
+                    overlap = max(0.0, min(b, hi_g) - max(a, lo_g))
+                    self.counts[g] += c * overlap / src_w
+        self.total += float(src.sum())
+
+    def add_values(self, values: np.ndarray) -> None:
+        if len(values) == 0:
+            return
+        v = np.asarray(values, dtype=np.float64)
+        idx = np.clip(
+            ((v - self.lo) / self._width()).astype(np.int64), 0, self.bins - 1
+        )
+        np.add.at(self.counts, idx, 1.0)
+        self.total += len(v)
+
+    def percentile(self, q: float) -> float | None:
+        """Nearest-rank percentile, interpolated inside the winning bin."""
+        if self.total <= 0:
+            return None
+        rank = max(np.ceil(q / 100.0 * self.total), 1.0)
+        cum = np.cumsum(self.counts)
+        g = int(np.searchsorted(cum, rank - 1e-9))
+        g = min(g, self.bins - 1)
+        prev = cum[g - 1] if g > 0 else 0.0
+        in_bin = self.counts[g]
+        frac = (rank - prev) / in_bin if in_bin > 0 else 0.5
+        w = self._width()
+        return float(self.lo + g * w + frac * w)

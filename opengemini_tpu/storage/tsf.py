@@ -34,16 +34,24 @@ END_MAGIC = b"OGTSFEND"
 _TRAILER = struct.Struct("<QII")
 
 
+HIST_BINS = 32
+
+
 class PreAgg:
-    """count/min/max/sum of the valid values of one numeric column chunk."""
+    """count/min/max/sum of the valid values of one numeric column chunk,
+    plus a small equi-width histogram — the sketch that serves
+    percentile_approx() from metadata alone (reference: OGSketch
+    quantile sketches, engine/executor/ogsketch.go, except persisted
+    per chunk so queries skip data blocks entirely)."""
 
-    __slots__ = ("count", "vmin", "vmax", "vsum")
+    __slots__ = ("count", "vmin", "vmax", "vsum", "hist")
 
-    def __init__(self, count: int, vmin, vmax, vsum):
+    def __init__(self, count: int, vmin, vmax, vsum, hist=None):
         self.count = count
         self.vmin = vmin
         self.vmax = vmax
         self.vsum = vsum
+        self.hist = hist  # HIST_BINS int counts over [vmin, vmax], or None
 
     @classmethod
     def of(cls, col: Column) -> "PreAgg | None":
@@ -52,19 +60,23 @@ class PreAgg:
         vals = col.values[col.valid]
         if len(vals) == 0:
             return cls(0, None, None, None)
-        return cls(
-            len(vals),
-            vals.min().item(),
-            vals.max().item(),
-            vals.sum().item(),
-        )
+        vmin = vals.min().item()
+        vmax = vals.max().item()
+        finite = np.isfinite(np.asarray(vals, dtype=np.float64))
+        hist = None
+        if finite.all() and vmax > vmin:
+            hist = np.histogram(
+                vals.astype(np.float64), bins=HIST_BINS, range=(vmin, vmax)
+            )[0].tolist()
+        return cls(len(vals), vmin, vmax, vals.sum().item(), hist)
 
     def to_json(self):
-        return [self.count, self.vmin, self.vmax, self.vsum]
+        return [self.count, self.vmin, self.vmax, self.vsum, self.hist]
 
     @classmethod
     def from_json(cls, j) -> "PreAgg":
-        return cls(*j)
+        # older files carry 4-element pre-agg entries (no histogram)
+        return cls(*j) if len(j) >= 5 else cls(*j, None)
 
 
 class ChunkMeta:

@@ -1,0 +1,134 @@
+"""percentile_approx chunk-histogram sketch tests."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.query.sketch import HistSketch
+from opengemini_tpu.storage.engine import Engine, NS
+
+BASE = 1_700_000_040
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = Engine(str(tmp_path / "data"))
+    e.create_database("db")
+    yield e, Executor(e)
+    e.close()
+
+
+def q(ex, text):
+    return ex.execute(text, db="db", now_ns=(BASE + 100_000) * NS)
+
+
+def series_of(res, i=0):
+    return res["results"][0]["series"][i]
+
+
+class TestHistSketch:
+    def test_percentile_accuracy(self, rng):
+        vals = rng.normal(50, 10, size=100_000)
+        sk = HistSketch(vals.min(), vals.max())
+        sk.add_values(vals)
+        for p in (10, 50, 90, 99):
+            approx = sk.percentile(p)
+            exact = np.percentile(vals, p)
+            spread = vals.max() - vals.min()
+            assert abs(approx - exact) <= spread / 256 * 2, p
+
+    def test_merge_chunk_hists(self, rng):
+        a = rng.uniform(0, 50, size=5000)
+        b = rng.uniform(40, 100, size=5000)
+        ha = np.histogram(a, bins=32, range=(a.min(), a.max()))[0].tolist()
+        hb = np.histogram(b, bins=32, range=(b.min(), b.max()))[0].tolist()
+        sk = HistSketch(min(a.min(), b.min()), max(a.max(), b.max()))
+        sk.add_chunk_hist(a.min(), a.max(), ha)
+        sk.add_chunk_hist(b.min(), b.max(), hb)
+        allv = np.concatenate([a, b])
+        exact = np.percentile(allv, 50)
+        assert abs(sk.percentile(50) - exact) <= (allv.max() - allv.min()) / 32
+
+
+class TestPercentileApprox:
+    def test_from_chunks_without_decode(self, env, monkeypatch, rng):
+        from opengemini_tpu.storage import tsf
+
+        e, ex = env
+        vals = rng.normal(100, 20, size=2000)
+        lines = "\n".join(
+            f"m v={v} {(BASE + i) * NS}" for i, v in enumerate(vals)
+        )
+        e.write_lines("db", lines)
+        e.flush_all()
+        calls = {"n": 0}
+        orig = tsf.TSFReader.read_chunk
+
+        def counting(self, *a, **kw):
+            calls["n"] += 1
+            return orig(self, *a, **kw)
+
+        monkeypatch.setattr(tsf.TSFReader, "read_chunk", counting)
+        res = q(ex, "SELECT percentile_approx(v, 90) FROM m")
+        assert calls["n"] == 0  # metadata only
+        approx = series_of(res)["values"][0][1]
+        exact = np.percentile(vals, 90)
+        assert abs(approx - exact) <= (vals.max() - vals.min()) / 32
+
+    def test_mixed_memtable_exact_binning(self, env, rng):
+        e, ex = env
+        vals = list(range(100))
+        e.write_lines("db", "\n".join(
+            f"m v={v} {(BASE + i) * NS}" for i, v in enumerate(vals[:50])))
+        e.flush_all()
+        e.write_lines("db", "\n".join(
+            f"m v={v} {(BASE + 50 + i) * NS}" for i, v in enumerate(vals[50:])))
+        res = q(ex, "SELECT percentile_approx(v, 50) FROM m")
+        approx = series_of(res)["values"][0][1]
+        assert abs(approx - 50) <= 99 / 32 + 1
+
+    def test_group_by_tags(self, env, rng):
+        e, ex = env
+        e.write_lines("db", "\n".join(
+            f"m,h={'a' if i % 2 else 'b'} v={i} {(BASE + i) * NS}"
+            for i in range(200)
+        ))
+        res = q(ex, "SELECT percentile_approx(v, 99) FROM m GROUP BY h")
+        got = {s["tags"]["h"]: s["values"][0][1]
+               for s in res["results"][0]["series"]}
+        assert abs(got["a"] - 197) < 10 and abs(got["b"] - 196) < 10
+
+    def test_errors(self, env):
+        e, ex = env
+        e.write_lines("db", f'm v=1,s="x" {BASE*NS}')
+        res = q(ex, "SELECT percentile_approx(s, 50) FROM m")
+        assert "numeric field" in res["results"][0]["error"]
+        res = q(ex, "SELECT percentile_approx(v) FROM m")
+        assert "takes" in res["results"][0]["error"]
+        res = q(ex, "SELECT percentile_approx(v, 50) FROM m GROUP BY time(1m)")
+        assert "GROUP BY time" in res["results"][0]["error"]
+
+
+class TestReviewRegressions:
+    def test_q_out_of_range_rejected(self, env):
+        e, ex = env
+        e.write_lines("db", f"m v=1 {BASE*NS}")
+        for bad in (500, -1):
+            res = q(ex, f"SELECT percentile_approx(v, {bad}) FROM m")
+            assert "between 0 and 100" in res["results"][0]["error"]
+
+    def test_nonfinite_values_ignored(self, env):
+        e, ex = env
+        e.write_lines("db", "\n".join(
+            [f"m v={i} {(BASE + i) * NS}" for i in range(10)]
+            + [f"m v=nan {(BASE + 50) * NS}", f"m v=inf {(BASE + 51) * NS}"]
+        ))
+        res = q(ex, "SELECT percentile_approx(v, 50) FROM m")
+        v = series_of(res)["values"][0][1]
+        assert np.isfinite(v) and 0 <= v <= 9
+
+    def test_limit_offset_honored(self, env):
+        e, ex = env
+        e.write_lines("db", f"m v=1 {BASE*NS}")
+        res = q(ex, "SELECT percentile_approx(v, 50) FROM m OFFSET 1")
+        assert "series" not in res["results"][0]
