@@ -19,6 +19,7 @@ block_until_ready, and per-dispatch round-trips cost ~60ms):
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -170,8 +171,40 @@ def bench_cpu(mask_frac_valid=True):
     return Sc * R / t_best
 
 
+def _arm_watchdog():
+    """A hung device tunnel must not stall the bench forever: if the whole
+    run exceeds the budget, print a diagnostic and exit non-zero WITHOUT
+    fabricating a metric line (a missing measurement is the truthful
+    result when hardware is unreachable). A THREAD, not SIGALRM: the main
+    thread may be blocked inside non-interruptible C calls (device init),
+    where a Python signal handler would never run. Returns the timer."""
+    import threading
+
+    budget_s = int(os.environ.get("OGTPU_BENCH_TIMEOUT_S", "480"))
+
+    def fire():
+        print(
+            f"bench watchdog: no result within {budget_s}s — device/tunnel "
+            "unreachable or hung; no metric emitted",
+            file=sys.stderr,
+        )
+        sys.stderr.flush()
+        os._exit(1)
+
+    t = threading.Timer(budget_s, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main() -> None:
+    watchdog = _arm_watchdog()
     import jax
+
+    if os.environ.get("OGTPU_BENCH_CPU"):
+        # smoke mode: exercise the full bench pipeline on the CPU backend
+        # (numbers are meaningless; the env var pins axon otherwise)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     print(f"backend: {jax.default_backend()} device: {jax.devices()[0]}", file=sys.stderr)
@@ -190,6 +223,9 @@ def main() -> None:
     cpu16 = rows_cpu * 16
 
     vs_baseline = rows_grid / cpu16
+    # disarm BEFORE emitting the metric: a budget-edge firing between the
+    # print and a later cancel could os._exit past unflushed stdout
+    watchdog.cancel()
     print(
         f"grid path: {rows_grid/1e9:.2f} G rows/s ({t_grid*1e3:.2f} ms / {S*R/1e6:.1f}M rows); "
         f"ragged dense buckets (count/sum/mean/min/max/ssd): {rows_ragged/1e9:.2f} G rows/s; "
