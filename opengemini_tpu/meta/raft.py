@@ -122,16 +122,30 @@ class RaftNode:
 
     def propose(self, cmd) -> int | None:
         """Append a command (leader only). Returns its log index or None."""
+        got = self.propose_with_term(cmd)
+        return got[0] if got else None
+
+    def propose_with_term(self, cmd) -> tuple[int, int] | None:
+        """Like propose, but returns (index, term) so callers can verify
+        the entry SURVIVED (a deposed leader's uncommitted entries can be
+        overwritten at the same index by a new leader)."""
         with self._lock:
             if self.state != LEADER:
                 return None
             self.log.append(LogEntry(self.current_term, cmd))
             self._persist()
             idx = len(self.log)
+            term = self.current_term
             self.match_index[self.id] = idx
             self._broadcast_append()
             self._maybe_commit()  # single-node clusters commit immediately
-            return idx
+            return idx, term
+
+    def entry_term(self, idx: int) -> int | None:
+        with self._lock:
+            if 1 <= idx <= len(self.log):
+                return self.log[idx - 1].term
+            return None
 
     def tick(self) -> None:
         """Advance timers: election timeout / leader heartbeat."""

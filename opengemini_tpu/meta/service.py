@@ -21,12 +21,23 @@ from opengemini_tpu.meta.raft import LEADER, RaftNode
 
 
 class MetaFSM:
-    """Deterministic state machine over cluster metadata commands."""
+    """Deterministic state machine over cluster metadata commands.
+
+    `listeners` receive every applied command AFTER the FSM state update —
+    the hook through which each replica's local storage engine enacts
+    replicated DDL (reference: store_fsm.go Apply driving the data model
+    every node then observes via metaclient). Listener errors are logged,
+    never poison the deterministic FSM state."""
 
     def __init__(self):
         self.databases: dict[str, dict] = {}
         self.nodes: dict[str, dict] = {}  # node id -> {addr, role}
         self.applied_index = 0
+        self.listeners: list = []
+        # listener side effects DEFER here: apply() runs under the raft
+        # lock and listener work (engine DDL = disk I/O) must not stall
+        # heartbeats/elections. MetaStore drains outside the lock.
+        self.pending = __import__("collections").deque()
 
     def apply(self, index: int, cmd: dict) -> None:
         op = cmd.get("op")
@@ -41,12 +52,18 @@ class MetaFSM:
                 db["rps"][cmd["name"]] = {"duration_ns": cmd.get("duration_ns", 0)}
                 if cmd.get("default"):
                     db["default_rp"] = cmd["name"]
+        elif op == "drop_rp":
+            db = self.databases.get(cmd["db"])
+            if db is not None:
+                db["rps"].pop(cmd["name"], None)
         elif op == "register_node":
             self.nodes[cmd["id"]] = {"addr": cmd["addr"], "role": cmd.get("role", "data")}
         elif op == "remove_node":
             self.nodes.pop(cmd["id"], None)
         # unknown ops are ignored deterministically (forward compatibility)
         self.applied_index = index
+        if self.listeners:
+            self.pending.append((index, cmd))
 
     def snapshot(self) -> dict:
         return {"databases": self.databases, "nodes": self.nodes,
@@ -74,6 +91,8 @@ class MetaStore:
         self._tick_s = tick_s
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._drain_lock = threading.Lock()
+        self.listener_applied = 0
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -88,9 +107,102 @@ class MetaStore:
     def _run(self) -> None:
         while not self._stop.wait(self._tick_s):
             self.node.tick()
+            self.drain_listeners()
+
+    def drain_listeners(self) -> None:
+        """Run deferred listener side effects OUTSIDE the raft lock (disk
+        I/O here must never stall heartbeats/elections)."""
+        import logging
+
+        with self._drain_lock:
+            while self.fsm.pending:
+                index, cmd = self.fsm.pending.popleft()
+                for fn in self.fsm.listeners:
+                    try:
+                        fn(index, cmd)
+                    except Exception:  # noqa: BLE001
+                        logging.getLogger("opengemini_tpu.meta").exception(
+                            "meta listener failed at index %d", index
+                        )
+                self.listener_applied = index
 
     def propose(self, cmd: dict) -> bool:
-        return self.node.propose(cmd) is not None
+        ok = self.node.propose(cmd) is not None
+        self.drain_listeners()
+        return ok
+
+    def propose_and_wait(self, cmd: dict, timeout_s: float = 5.0) -> bool:
+        """Propose and block until the entry APPLIES locally, including
+        listener side effects (influx meta ops are synchronous). Verifies
+        the entry SURVIVED at (index, term) — a deposed leader's entry can
+        be overwritten at the same index by a successor."""
+        import time as _t
+
+        got = self.node.propose_with_term(cmd)
+        if got is None:
+            return False
+        idx, term = got
+        deadline = _t.monotonic() + timeout_s
+        while True:
+            self.drain_listeners()
+            if self.node.entry_term(idx) != term:
+                return False  # overwritten after a leader change
+            applied = (
+                self.node.last_applied >= idx
+                and (not self.fsm.listeners or self.listener_applied >= idx)
+            )
+            if applied:
+                return True
+            if _t.monotonic() > deadline:
+                return False
+            _t.sleep(0.01)
+
+    def attach_engine(self, engine) -> None:
+        """Enact replicated DDL on the local storage engine — every
+        replica's engine converges on the FSM's database set.
+
+        Replay safety: raft re-applies the WHOLE log after restart
+        (commit index is volatile). Engine side effects are guarded by a
+        persisted applied-index marker, so a drop/re-create history can
+        never replay a destructive drop over live data."""
+        import os as _os
+
+        marker_path = _os.path.join(engine.root, "meta.applied")
+
+        def _read_marker() -> int:
+            try:
+                with open(marker_path, encoding="utf-8") as f:
+                    return int(f.read().strip())
+            except (OSError, ValueError):
+                return 0
+
+        def _write_marker(index: int) -> None:
+            tmp = marker_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(str(index))
+                f.flush()
+                _os.fsync(f.fileno())
+            _os.replace(tmp, marker_path)
+
+        def on_apply(index: int, cmd: dict) -> None:
+            if index <= _read_marker():
+                return  # already enacted before a restart
+            op = cmd.get("op")
+            if op == "create_database":
+                engine.create_database(cmd["name"])
+            elif op == "drop_database":
+                engine.drop_database(cmd["name"])
+            elif op == "create_rp":
+                if cmd["db"] in engine.databases:
+                    engine.create_retention_policy(
+                        cmd["db"], cmd["name"], cmd.get("duration_ns", 0),
+                        cmd.get("shard_duration_ns"), cmd.get("default", False),
+                    )
+            elif op == "drop_rp":
+                engine.drop_retention_policy(cmd["db"], cmd["name"])
+            _write_marker(index)
+
+        self.fsm.listeners.append(on_apply)
 
     def is_leader(self) -> bool:
         return self.node.state == LEADER

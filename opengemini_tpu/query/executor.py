@@ -104,7 +104,8 @@ def _is_readonly(stmt) -> bool:
 
 
 class Executor:
-    def __init__(self, engine, users=None, auth_enabled: bool = False):
+    def __init__(self, engine, users=None, auth_enabled: bool = False,
+                 meta_store=None):
         from opengemini_tpu.meta.users import UserStore
 
         self.engine = engine
@@ -112,6 +113,23 @@ class Executor:
             os.path.join(engine.root, "users.json")
         )
         self.auth_enabled = auth_enabled
+        # when clustered, database/RP DDL replicates through raft
+        self.meta_store = meta_store
+
+    def _replicate_ddl(self, cmd: dict) -> bool:
+        """Route a DDL command through the raft meta store when clustered.
+        Returns True when handled (leader path; the engine change arrives
+        via the FSM listener). Raises on follower (client must redirect)."""
+        if self.meta_store is None:
+            return False
+        if not self.meta_store.is_leader():
+            leader = self.meta_store.leader_hint() or "unknown"
+            raise QueryError(
+                f"not the meta leader; retry against node {leader!r}"
+            )
+        if not self.meta_store.propose_and_wait(cmd):
+            raise QueryError("meta proposal failed (no quorum?)")
+        return True
 
     # -- entry --------------------------------------------------------------
 
@@ -219,25 +237,35 @@ class Executor:
         if isinstance(stmt, ast.ShowRetentionPolicies):
             return self._show_rps(stmt, db)
         if isinstance(stmt, ast.CreateDatabase):
-            self.engine.create_database(stmt.name)
+            if not self._replicate_ddl({"op": "create_database", "name": stmt.name}):
+                self.engine.create_database(stmt.name)
             return {}
         if isinstance(stmt, ast.DropDatabase):
-            self.engine.drop_database(stmt.name)
+            if not self._replicate_ddl({"op": "drop_database", "name": stmt.name}):
+                self.engine.drop_database(stmt.name)
             return {}
         if isinstance(stmt, ast.CreateRetentionPolicy):
-            self.engine.create_retention_policy(
-                stmt.database or db,
-                stmt.name,
-                stmt.duration_ns,
-                stmt.shard_duration_ns,
-                stmt.default,
-            )
+            tgt = stmt.database or db
+            if self.meta_store is not None and tgt not in self.meta_store.fsm.databases:
+                # validate against the FSM BEFORE proposing: the FSM would
+                # silently ignore an unknown db and persist a junk entry
+                raise QueryError(f"database not found: {tgt}")
+            cmd = {
+                "op": "create_rp", "db": tgt, "name": stmt.name,
+                "duration_ns": stmt.duration_ns,
+                "shard_duration_ns": stmt.shard_duration_ns,
+                "default": stmt.default,
+            }
+            if not self._replicate_ddl(cmd):
+                self.engine.create_retention_policy(
+                    tgt, stmt.name, stmt.duration_ns,
+                    stmt.shard_duration_ns, stmt.default,
+                )
             return {}
         if isinstance(stmt, ast.DropRetentionPolicy):
-            d = self.engine.databases.get(stmt.database or db)
-            if d and stmt.name in d.rps:
-                del d.rps[stmt.name]
-                self.engine._save_meta()
+            cmd = {"op": "drop_rp", "db": stmt.database or db, "name": stmt.name}
+            if not self._replicate_ddl(cmd):
+                self.engine.drop_retention_policy(stmt.database or db, stmt.name)
             return {}
         if isinstance(stmt, ast.CreateContinuousQuery):
             from opengemini_tpu.storage.engine import ContinuousQuery

@@ -74,6 +74,8 @@ def build(cfg: dict) -> HttpService:
             storage_path=os.path.join(engine.root, "meta.raftlog"),
         )
         svc.meta_store.token = token
+        svc.meta_store.attach_engine(engine)  # replicated DDL -> local engine
+        svc.executor.meta_store = svc.meta_store
         svc.meta_store.start()
     svc.services = _build_services(cfg, svc)
     return svc

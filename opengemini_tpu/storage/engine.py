@@ -233,6 +233,17 @@ class Engine:
             if os.path.exists(p):
                 shutil.rmtree(p)
 
+    def drop_retention_policy(self, db: str, name: str) -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d and name in d.rps:
+                del d.rps[name]
+                if d.default_rp == name:
+                    d.default_rp = "autogen" if "autogen" in d.rps else next(
+                        iter(d.rps), "autogen"
+                    )
+                self._save_meta()
+
     def create_retention_policy(
         self, db: str, name: str, duration_ns: int, shard_duration_ns: int | None = None,
         default: bool = False,

@@ -261,3 +261,100 @@ class TestReviewRegressions:
             assert "mutated" not in store.fsm.databases["d1"]  # deep copy
         finally:
             store.stop()
+
+
+class TestReplicatedDDL:
+    def test_ddl_replicates_to_every_engine(self, tmp_path):
+        """The money test: CREATE DATABASE on the leader materializes in
+        EVERY replica's storage engine via the FSM listener."""
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        bus, nodes, _ = make_cluster(3, tmp_path=tmp_path)
+        engines = {}
+        stores = {}
+        for nid, node in nodes.items():
+            eng = Engine(str(tmp_path / f"data-{nid}"))
+            store = MetaStore.__new__(MetaStore)  # wire around the ticker
+            import threading as _threading
+
+            from opengemini_tpu.meta.service import MetaFSM
+
+            store.fsm = MetaFSM()
+            store.node = node
+            store._drain_lock = _threading.Lock()
+            store.listener_applied = 0
+            node.apply_fn = store.fsm.apply
+            store.attach_engine(eng)
+            engines[nid] = eng
+            stores[nid] = store
+        leader = elect(bus, nodes)
+        ex = Executor(engines[leader.id], meta_store=stores[leader.id])
+        # propose_and_wait blocks on majority acks: pump the bus from a
+        # background thread while the executor waits (like live tickers)
+        import threading as _t
+        import time as _time
+
+        stop = _t.Event()
+
+        def pump():
+            while not stop.is_set():
+                for n in nodes.values():
+                    n.tick()
+                bus.deliver_all()
+                for st in stores.values():
+                    st.drain_listeners()
+                _time.sleep(0.002)
+
+        pumper = _t.Thread(target=pump, daemon=True)
+        pumper.start()
+        try:
+            res = ex.execute(
+                "CREATE DATABASE replicated; "
+                "CREATE RETENTION POLICY rp1 ON replicated DURATION 30d REPLICATION 1",
+                db="",
+            )
+            assert all("error" not in r for r in res["results"]), res
+            deadline = _time.time() + 5
+            while (
+                any("replicated" not in e.databases for e in engines.values())
+                and _time.time() < deadline
+            ):
+                _time.sleep(0.01)
+        finally:
+            stop.set()
+            pumper.join(timeout=5)
+        for nid, eng in engines.items():
+            assert "replicated" in eng.databases, nid
+            assert "rp1" in eng.databases["replicated"].rps, nid
+        # follower DDL is rejected with a leader hint
+        follower_id = next(i for i in nodes if i != leader.id)
+        ex_f = Executor(engines[follower_id], meta_store=stores[follower_id])
+        res = ex_f.execute("CREATE DATABASE nope", db="")
+        assert "not the meta leader" in res["results"][0]["error"]
+        for eng in engines.values():
+            eng.close()
+
+    def test_single_node_store_ddl_synchronous(self, tmp_path):
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        eng = Engine(str(tmp_path / "data"))
+        store = MetaStore("solo", ["solo"], storage_path=str(tmp_path / "m.log"),
+                          tick_s=0.01)
+        store.attach_engine(eng)
+        store.start()
+        try:
+            import time
+
+            deadline = time.time() + 5
+            while not store.is_leader() and time.time() < deadline:
+                time.sleep(0.02)
+            ex = Executor(eng, meta_store=store)
+            res = ex.execute("CREATE DATABASE d1", db="")
+            assert "error" not in res["results"][0]
+            assert "d1" in eng.databases  # applied synchronously
+            assert "d1" in store.fsm.databases
+        finally:
+            store.stop()
+            eng.close()
