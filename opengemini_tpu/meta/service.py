@@ -32,6 +32,8 @@ class MetaFSM:
     def __init__(self):
         self.databases: dict[str, dict] = {}
         self.nodes: dict[str, dict] = {}  # node id -> {addr, role}
+        self.users: dict[str, dict] = {}  # name -> {admin} (hashes live
+        # in each replica's UserStore via the listener, not the snapshot)
         self.applied_index = 0
         self.listeners: list = []
         # listener side effects DEFER here: apply() runs under the raft
@@ -60,6 +62,13 @@ class MetaFSM:
             self.nodes[cmd["id"]] = {"addr": cmd["addr"], "role": cmd.get("role", "data")}
         elif op == "remove_node":
             self.nodes.pop(cmd["id"], None)
+        elif op == "create_user":
+            self.users[cmd["name"]] = {"admin": cmd.get("admin", False)}
+        elif op == "drop_user":
+            self.users.pop(cmd["name"], None)
+        elif op == "grant_admin":
+            if cmd["user"] in self.users:
+                self.users[cmd["user"]]["admin"] = cmd.get("admin", True)
         # unknown ops are ignored deterministically (forward compatibility)
         self.applied_index = index
         if self.listeners:
@@ -67,7 +76,41 @@ class MetaFSM:
 
     def snapshot(self) -> dict:
         return {"databases": self.databases, "nodes": self.nodes,
-                "applied_index": self.applied_index}
+                "users": self.users, "applied_index": self.applied_index}
+
+
+def _marker_io(path: str | None):
+    """(read, write) closures for a persisted applied-index marker with an
+    in-memory cache (no per-command disk re-read). path=None -> no-op."""
+    import os as _os
+
+    cache = {"idx": None}
+
+    def read() -> int:
+        if cache["idx"] is not None:
+            return cache["idx"]
+        if not path:
+            cache["idx"] = 0
+            return 0
+        try:
+            with open(path, encoding="utf-8") as f:
+                cache["idx"] = int(f.read().strip())
+        except (OSError, ValueError):
+            cache["idx"] = 0
+        return cache["idx"]
+
+    def write(index: int) -> None:
+        cache["idx"] = index
+        if not path:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(str(index))
+            f.flush()
+            _os.fsync(f.fileno())
+        _os.replace(tmp, path)
+
+    return read, write
 
 
 class LoopbackTransport:
@@ -167,22 +210,9 @@ class MetaStore:
         never replay a destructive drop over live data."""
         import os as _os
 
-        marker_path = _os.path.join(engine.root, "meta.applied")
-
-        def _read_marker() -> int:
-            try:
-                with open(marker_path, encoding="utf-8") as f:
-                    return int(f.read().strip())
-            except (OSError, ValueError):
-                return 0
-
-        def _write_marker(index: int) -> None:
-            tmp = marker_path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as f:
-                f.write(str(index))
-                f.flush()
-                _os.fsync(f.fileno())
-            _os.replace(tmp, marker_path)
+        _read_marker, _write_marker = _marker_io(
+            _os.path.join(engine.root, "meta.applied")
+        )
 
         def on_apply(index: int, cmd: dict) -> None:
             if index <= _read_marker():
@@ -200,6 +230,28 @@ class MetaStore:
                     )
             elif op == "drop_rp":
                 engine.drop_retention_policy(cmd["db"], cmd["name"])
+            _write_marker(index)
+
+        self.fsm.listeners.append(on_apply)
+
+    def attach_users(self, user_store) -> None:
+        """Enact replicated user commands on the local UserStore (same
+        replay-safe marker discipline as attach_engine, via a sibling
+        marker next to the user store)."""
+        base = user_store.path or ""
+        _read_marker, _write_marker = _marker_io(
+            (base + ".applied") if base else None
+        )
+
+        user_ops = {"create_user", "drop_user", "set_password", "grant",
+                    "revoke", "grant_admin"}
+
+        def on_apply(index: int, cmd: dict) -> None:
+            if cmd.get("op") not in user_ops:
+                return
+            if index <= _read_marker():
+                return
+            user_store.apply_replicated(cmd)
             _write_marker(index)
 
         self.fsm.listeners.append(on_apply)

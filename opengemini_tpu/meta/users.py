@@ -127,6 +127,47 @@ class UserStore:
             u.privileges.pop(db, None)
             self._save()
 
+    # -- replicated application (raft listener path) ---------------------
+
+    def apply_replicated(self, cmd: dict) -> None:
+        """Enact a replicated user command carrying pre-computed salt/hash
+        (hashes are computed once at propose time so every replica stores
+        identical credentials). Idempotent by construction."""
+        op = cmd.get("op")
+        with self._lock:
+            if op == "create_user":
+                self.users[cmd["name"]] = User(
+                    cmd["name"], cmd["salt"], cmd["hash"], cmd.get("admin", False)
+                )
+            elif op == "drop_user":
+                self.users.pop(cmd["name"], None)
+            elif op == "set_password":
+                u = self.users.get(cmd["name"])
+                if u is not None:
+                    u.salt = cmd["salt"]
+                    u.pw_hash = cmd["hash"]
+            elif op == "grant":
+                u = self.users.get(cmd["user"])
+                if u is not None:
+                    u.privileges[cmd["db"]] = cmd["privilege"]
+            elif op == "revoke":
+                u = self.users.get(cmd["user"])
+                if u is not None:
+                    u.privileges.pop(cmd["db"], None)
+            elif op == "grant_admin":
+                u = self.users.get(cmd["user"])
+                if u is not None:
+                    u.admin = cmd.get("admin", True)
+            else:
+                return
+            self._save()
+
+    @staticmethod
+    def make_credentials(password: str) -> tuple[str, str]:
+        """(salt, hash) for replication-time hashing."""
+        salt = secrets.token_hex(16)
+        return salt, _hash(password, salt)
+
     # -- authentication --------------------------------------------------
 
     def authenticate(self, name: str, password: str) -> User:

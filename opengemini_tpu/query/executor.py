@@ -18,6 +18,7 @@ from __future__ import annotations
 import math
 import os
 import re
+import threading as _threading
 import time as _time
 from dataclasses import dataclass
 
@@ -113,8 +114,12 @@ class Executor:
             os.path.join(engine.root, "users.json")
         )
         self.auth_enabled = auth_enabled
-        # when clustered, database/RP DDL replicates through raft
+        # when clustered, database/RP/user DDL replicates through raft
         self.meta_store = meta_store
+        # serializes leader-side user DDL: check-then-propose must not race
+        # across HTTP threads (duplicate CREATE USER would silently replace
+        # the first user's credentials)
+        self._user_ddl_lock = _threading.Lock()
 
     def _replicate_ddl(self, cmd: dict) -> bool:
         """Route a DDL command through the raft meta store when clustered.
@@ -122,13 +127,37 @@ class Executor:
         via the FSM listener). Raises on follower (client must redirect)."""
         if self.meta_store is None:
             return False
-        if not self.meta_store.is_leader():
+        self._require_leader()
+        if not self.meta_store.propose_and_wait(cmd):
+            raise QueryError("meta proposal failed (no quorum?)")
+        return True
+
+    def _require_leader(self) -> None:
+        if self.meta_store is not None and not self.meta_store.is_leader():
             leader = self.meta_store.leader_hint() or "unknown"
             raise QueryError(
                 f"not the meta leader; retry against node {leader!r}"
             )
-        if not self.meta_store.propose_and_wait(cmd):
-            raise QueryError("meta proposal failed (no quorum?)")
+
+    def _require_user(self, name: str) -> None:
+        from opengemini_tpu.meta.users import AuthError
+
+        if name not in self.users.users:
+            raise AuthError(f"user not found: {name}")
+
+    def _user_ddl(self, validate_fn, cmd_fn) -> bool:
+        """Replicated user DDL: leadership first (a stale follower must
+        redirect, not answer from its lagging local store), then
+        validation + propose under one lock (check-then-propose races
+        across HTTP threads would silently overwrite credentials).
+        Returns False when not clustered (caller runs the local path)."""
+        if self.meta_store is None:
+            return False
+        with self._user_ddl_lock:
+            self._require_leader()
+            validate_fn()
+            if not self.meta_store.propose_and_wait(cmd_fn()):
+                raise QueryError("meta proposal failed (no quorum?)")
         return True
 
     # -- entry --------------------------------------------------------------
@@ -384,25 +413,66 @@ class Executor:
         if isinstance(stmt, (ast.DeleteSeries, ast.DropSeries)):
             return self._delete(stmt, db, now_ns)
         if isinstance(stmt, ast.CreateUser):
-            self.users.create(stmt.name, stmt.password, stmt.admin)
+            def _validate_create():
+                from opengemini_tpu.meta.users import AuthError
+
+                if stmt.name in self.users.users:
+                    raise AuthError(f"user already exists: {stmt.name}")
+
+            def _cmd_create():
+                from opengemini_tpu.meta.users import UserStore
+
+                salt, pw_hash = UserStore.make_credentials(stmt.password)
+                return {"op": "create_user", "name": stmt.name,
+                        "salt": salt, "hash": pw_hash, "admin": stmt.admin}
+
+            if not self._user_ddl(_validate_create, _cmd_create):
+                self.users.create(stmt.name, stmt.password, stmt.admin)
             return {}
         if isinstance(stmt, ast.DropUser):
-            self.users.drop(stmt.name)
+            if not self._user_ddl(
+                lambda: self._require_user(stmt.name),
+                lambda: {"op": "drop_user", "name": stmt.name},
+            ):
+                self.users.drop(stmt.name)
             return {}
         if isinstance(stmt, ast.SetPassword):
-            self.users.set_password(stmt.name, stmt.password)
+            def _cmd_setpw():
+                from opengemini_tpu.meta.users import UserStore
+
+                salt, pw_hash = UserStore.make_credentials(stmt.password)
+                return {"op": "set_password", "name": stmt.name,
+                        "salt": salt, "hash": pw_hash}
+
+            if not self._user_ddl(lambda: self._require_user(stmt.name), _cmd_setpw):
+                self.users.set_password(stmt.name, stmt.password)
             return {}
         if isinstance(stmt, ast.GrantStatement):
-            if not stmt.database and stmt.privilege == "ALL":
-                self.users.grant_admin(stmt.user)
-            else:
-                self.users.grant(stmt.user, stmt.database, stmt.privilege)
+            admin_grant = not stmt.database and stmt.privilege == "ALL"
+            cmd = (
+                {"op": "grant_admin", "user": stmt.user, "admin": True}
+                if admin_grant
+                else {"op": "grant", "user": stmt.user, "db": stmt.database,
+                      "privilege": stmt.privilege}
+            )
+            if not self._user_ddl(lambda: self._require_user(stmt.user), lambda: cmd):
+                if admin_grant:
+                    self.users.grant_admin(stmt.user)
+                else:
+                    self.users.grant(stmt.user, stmt.database, stmt.privilege)
             return {}
         if isinstance(stmt, ast.RevokeStatement):
-            if not stmt.database and stmt.privilege == "ALL":
-                self.users.grant_admin(stmt.user, admin=False)
-            else:
-                self.users.revoke(stmt.user, stmt.database)
+            admin_revoke = not stmt.database and stmt.privilege == "ALL"
+            cmd = (
+                {"op": "grant_admin", "user": stmt.user, "admin": False}
+                if admin_revoke
+                else {"op": "revoke", "user": stmt.user, "db": stmt.database}
+            )
+            if not self._user_ddl(lambda: self._require_user(stmt.user), lambda: cmd):
+                if admin_revoke:
+                    self.users.grant_admin(stmt.user, admin=False)
+                else:
+                    self.users.revoke(stmt.user, stmt.database)
             return {}
         if isinstance(stmt, ast.ShowUsers):
             rows = [[u.name, u.admin] for u in self.users.users.values()]

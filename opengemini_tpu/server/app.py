@@ -75,6 +75,7 @@ def build(cfg: dict) -> HttpService:
         )
         svc.meta_store.token = token
         svc.meta_store.attach_engine(engine)  # replicated DDL -> local engine
+        svc.meta_store.attach_users(svc.users)  # replicated user commands
         svc.executor.meta_store = svc.meta_store
         svc.meta_store.start()
     svc.services = _build_services(cfg, svc)

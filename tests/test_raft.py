@@ -358,3 +358,76 @@ class TestReplicatedDDL:
         finally:
             store.stop()
             eng.close()
+
+
+class TestReplicatedUsers:
+    def test_user_created_on_leader_authenticates_on_followers(self, tmp_path):
+        from opengemini_tpu.meta.users import UserStore
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        bus, nodes, _ = make_cluster(3, tmp_path=tmp_path)
+        engines, stores, ustores = {}, {}, {}
+        import threading as _th
+
+        from opengemini_tpu.meta.service import MetaFSM
+
+        for nid, node in nodes.items():
+            eng = Engine(str(tmp_path / f"data-{nid}"))
+            us = UserStore(str(tmp_path / f"users-{nid}.json"))
+            store = MetaStore.__new__(MetaStore)
+            store.fsm = MetaFSM()
+            store.node = node
+            store._drain_lock = _th.Lock()
+            store.listener_applied = 0
+            node.apply_fn = store.fsm.apply
+            store.attach_engine(eng)
+            store.attach_users(us)
+            engines[nid], stores[nid], ustores[nid] = eng, store, us
+        leader = elect(bus, nodes)
+        ex = Executor(engines[leader.id], users=ustores[leader.id],
+                      meta_store=stores[leader.id])
+        import time as _time
+
+        stop = _th.Event()
+
+        def pump():
+            while not stop.is_set():
+                for n in nodes.values():
+                    n.tick()
+                bus.deliver_all()
+                for st in stores.values():
+                    st.drain_listeners()
+                _time.sleep(0.002)
+
+        pumper = _th.Thread(target=pump, daemon=True)
+        pumper.start()
+        try:
+            res = ex.execute(
+                "CREATE USER root WITH PASSWORD 'pw' WITH ALL PRIVILEGES; "
+                "CREATE USER bob WITH PASSWORD 'b'; GRANT READ ON db TO bob",
+                db="",
+            )
+            assert all("error" not in r for r in res["results"]), res
+            deadline = _time.time() + 5
+            def _grant_everywhere():
+                return all(
+                    us.users.get("bob") is not None
+                    and us.users["bob"].privileges.get("db") == "READ"
+                    for us in ustores.values()
+                )
+            while not _grant_everywhere() and _time.time() < deadline:
+                _time.sleep(0.01)
+        finally:
+            stop.set()
+            pumper.join(timeout=5)
+        # identical credentials on every node
+        for nid, us in ustores.items():
+            u = us.authenticate("bob", "b")
+            assert u.can("READ", "db"), nid
+            assert us.authenticate("root", "pw").admin, nid
+        # persisted: fresh store from disk authenticates too
+        us2 = UserStore(str(tmp_path / f"users-{leader.id}.json"))
+        us2.authenticate("bob", "b")
+        for eng in engines.values():
+            eng.close()
