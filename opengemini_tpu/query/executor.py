@@ -34,6 +34,7 @@ from opengemini_tpu.sql import ast
 from opengemini_tpu.meta.users import AuthError as _AuthError
 from opengemini_tpu.storage.engine import WriteError
 from opengemini_tpu.utils import tracing
+from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER, QueryKilled
 from opengemini_tpu.utils.stats import GLOBAL as STATS
 from opengemini_tpu.sql.parser import parse
 
@@ -91,6 +92,7 @@ _READONLY_STMTS = (
     ast.ShowDiagnostics,
     ast.ShowStreams,
     ast.ShowSubscriptions,
+    ast.ShowQueries,
 )
 
 
@@ -176,9 +178,19 @@ class Executor:
         except ValueError as e:
             return {"results": [{"statement_id": 0, "error": f"error parsing query: {e}"}]}
         STATS.incr("executor", "queries")
+        qid = TRACKER.register(text, db)
+        try:
+            return self._execute_statements(stmts, db, now_ns, read_only, user)
+        finally:
+            TRACKER.unregister(qid)
+
+    def _execute_statements(self, stmts, db, now_ns, read_only, user) -> dict:
         results = []
         for i, stmt in enumerate(stmts):
             try:
+                # a killed query must not run its REMAINING statements
+                # either (the next one might be destructive DDL)
+                TRACKER.check()
                 if read_only and not _is_readonly(stmt):
                     raise QueryError(
                         f"{type(stmt).__name__} queries must be sent via POST"
@@ -200,7 +212,7 @@ class Executor:
                 res = self.execute_statement(stmt, db, now_ns)
             except (
                 QueryError, cond.ConditionError, KeyError, ValueError,
-                re.error, FieldTypeConflict, WriteError,
+                re.error, FieldTypeConflict, WriteError, QueryKilled,
             ) as e:
                 # _AuthError deliberately NOT caught: authorization failures
                 # must surface as HTTP 401/403, not statement errors in a 200
@@ -362,6 +374,19 @@ class Executor:
                     _series(name, None, ["name", "mode", "destinations"], rows)
                 )
             return {"series": series} if series else {}
+        if isinstance(stmt, ast.ShowQueries):
+            rows = [
+                [q["qid"], q["query"], q["database"],
+                 f"{q['duration_ms']}ms", q["status"]]
+                for q in TRACKER.snapshot()
+            ]
+            return _series_result(
+                "", None, ["qid", "query", "database", "duration", "status"], rows
+            )
+        if isinstance(stmt, ast.KillQuery):
+            if not TRACKER.kill(stmt.qid):
+                raise QueryError(f"no such query: {stmt.qid}")
+            return {}
         if isinstance(stmt, ast.ShowShards):
             rows = []
             for (sdb, rp, start), sh in sorted(self.engine._shards.items()):
@@ -916,6 +941,7 @@ class Executor:
         rows_scanned = 0
         with trace.span("scan") as scan_span:
             for sh, sid, gid in scan_plan:
+                TRACKER.check()  # KILL QUERY cancellation point
                 if pre_eligible:
                     handled, got_rows = self._scan_preagg(
                         sh, mst, sid, gid, tmin, tmax, needed_fields,
@@ -1157,6 +1183,7 @@ class Executor:
             b[1] = max(b[1], float(vals.max()))
 
         for sh, sid, gid in ctx.scan_plan:
+            TRACKER.check()  # KILL QUERY cancellation point
             needs_merge, srcs = _series_needs_merged_decode(sh, mst, sid, tmin, tmax)
             if needs_merge:
                 rec = sh.read_series(mst, sid, tmin, tmax, fields=[fname])
@@ -1261,6 +1288,7 @@ class Executor:
                     return got
                 ts_list, vs_list = [], []
                 for sh, sid in groups[key]:
+                    TRACKER.check()  # KILL QUERY cancellation point
                     rec = sh.read_series(mst, sid, tmin, tmax, fields=[fname] + (
                         sorted(cond.field_filter_refs(sc.field_expr)) if sc.field_expr else []))
                     col = rec.columns.get(fname)
@@ -1460,6 +1488,7 @@ class Executor:
         for key in sorted(groups):
             rows: list[list] = []
             for sh, sid, tags in groups[key]:
+                TRACKER.check()  # KILL QUERY cancellation point
                 rec = sh.read_series(mst, sid, sc.tmin, sc.tmax, fields=read_fields)
                 if len(rec) == 0:
                     continue

@@ -361,6 +361,16 @@ class ShowSubscriptions:
 
 
 @dataclass
+class ShowQueries:
+    pass
+
+
+@dataclass
+class KillQuery:
+    qid: int = 0
+
+
+@dataclass
 class ShowShards:
     pass
 

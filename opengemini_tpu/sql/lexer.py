@@ -22,7 +22,7 @@ KEYWORDS = {
     "user", "users", "password", "privileges", "grant", "grants", "revoke",
     "to", "set", "read", "write", "all", "cardinality", "exact",
     "stream", "streams", "delay", "shards", "stats", "diagnostics",
-    "subscription", "subscriptions", "destinations", "any",
+    "subscription", "subscriptions", "destinations", "any", "kill",
 }
 
 _DUR_RE = re.compile(r"(\d+)(ns|u|µ|us|ms|s|m|h|d|w)")

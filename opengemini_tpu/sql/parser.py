@@ -120,6 +120,13 @@ class Parser:
             return self.parse_set_password()
         if tok.val == "delete":
             return self.parse_delete()
+        if tok.val == "kill":
+            self.lex.next()
+            self._expect_kw("query")
+            t = self.lex.next()
+            if t.kind != "INTEGER":
+                raise ParseError("KILL QUERY expects a query id")
+            return ast.KillQuery(t.val)
         raise ParseError(f"unsupported statement start: {tok.val!r}")
 
     def parse_grant(self):
@@ -493,6 +500,8 @@ class Parser:
             return ast.ShowShards()
         if kw.val == "subscriptions":
             return ast.ShowSubscriptions()
+        if kw.val == "queries":
+            return ast.ShowQueries()
         if kw.val == "stats":
             return ast.ShowStats()
         if kw.val == "diagnostics":

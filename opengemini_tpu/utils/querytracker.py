@@ -1,0 +1,88 @@
+"""Running-query registry with kill support.
+
+Reference: the query task manager (lib/util/lifted/influx/query
+executor.go task manager + app/ts-store/transport/query/manager.go:130
+Kill): every executing query is registered with an id; SHOW QUERIES lists
+them, KILL QUERY marks one killed and execution aborts at the next
+cancellation point (scan loops check between series).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+# redact password literals before storing query text (the reference
+# renders [REDACTED] in SHOW QUERIES/logs for these statements)
+_PASSWORD_RE = re.compile(
+    r"(?i)(WITH\s+PASSWORD\s+|SET\s+PASSWORD\s+FOR\s+[^=]+=\s*)'(?:[^'\\]|\\.)*'"
+)
+
+
+def redact(text: str) -> str:
+    return _PASSWORD_RE.sub(lambda m: m.group(1) + "'[REDACTED]'", text)
+
+
+class QueryKilled(Exception):
+    def __init__(self, qid: int):
+        super().__init__(f"query {qid} killed")
+        self.qid = qid
+
+
+class QueryTracker:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 1
+        self._running: dict[int, dict] = {}
+        self._killed: set[int] = set()
+        self._local = threading.local()
+
+    def register(self, text: str, db: str) -> int:
+        with self._lock:
+            qid = self._next
+            self._next += 1
+            self._running[qid] = {
+                "query": redact(text), "database": db,
+                "started": time.monotonic(),
+            }
+        self._local.qid = qid
+        return qid
+
+    def unregister(self, qid: int) -> None:
+        with self._lock:
+            self._running.pop(qid, None)
+            self._killed.discard(qid)
+        self._local.qid = None
+
+    def kill(self, qid: int) -> bool:
+        with self._lock:
+            if qid not in self._running:
+                return False
+            self._killed.add(qid)
+            return True
+
+    def check(self) -> None:
+        """Cancellation point: raises when the CURRENT thread's query was
+        killed. Cheap (one set lookup), called between scan units."""
+        qid = getattr(self._local, "qid", None)
+        if qid is not None and qid in self._killed:
+            raise QueryKilled(qid)
+
+    def snapshot(self) -> list[dict]:
+        now = time.monotonic()
+        with self._lock:
+            return [
+                {
+                    "qid": qid,
+                    "query": info["query"],
+                    "database": info["database"],
+                    "duration_ms": int((now - info["started"]) * 1000),
+                    "status": "killed" if qid in self._killed else "running",
+                }
+                for qid, info in sorted(self._running.items())
+            ]
+
+
+# process-wide tracker (like the reference's per-node query manager)
+GLOBAL = QueryTracker()

@@ -308,3 +308,102 @@ class TestReviewRegressions2:
         # 'a.b' must NOT match 'axb'
         res = q(ex, 'SHOW MEASUREMENTS WITH MEASUREMENT = "a.b"')
         assert res["results"][0] == {"statement_id": 0}
+
+
+class TestQueryManager:
+    def test_show_queries_lists_running(self, env):
+        from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER
+
+        e, ex = env
+        write_devops(e)
+        # a query observes ITSELF in SHOW QUERIES
+        res = q(ex, "SHOW QUERIES")
+        s = series_of(res)
+        assert s["columns"] == ["qid", "query", "database", "duration", "status"]
+        assert any("SHOW QUERIES" in r[1] for r in s["values"])
+        assert TRACKER.snapshot() == []  # unregistered after completion
+
+    def test_kill_query_aborts_scan(self, env):
+        import threading
+        import time
+
+        from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER
+
+        e, ex = env
+        # enough series that the scan loop has many cancellation points
+        lines = "\n".join(
+            f"cpu,host=h{i} v={i} {(BASE + i) * NS}" for i in range(200)
+        )
+        e.write_lines("db", lines)
+        started = threading.Event()
+        orig_check = TRACKER.check
+
+        def slow_check():
+            started.set()
+            time.sleep(0.005)
+            orig_check()
+
+        TRACKER.check = slow_check
+        result = {}
+
+        def run():
+            result["res"] = q(ex, "SELECT mean(v) FROM cpu GROUP BY host")
+
+        t = threading.Thread(target=run)
+        try:
+            t.start()
+            assert started.wait(5)
+            # find and kill it
+            deadline = time.time() + 5
+            killed = False
+            while time.time() < deadline and not killed:
+                for info in TRACKER.snapshot():
+                    if "mean(v)" in info["query"]:
+                        killed = TRACKER.kill(info["qid"])
+                        break
+            assert killed
+            t.join(timeout=10)
+        finally:
+            TRACKER.check = orig_check
+        assert "killed" in result["res"]["results"][0]["error"]
+
+    def test_kill_unknown_query_errors(self, env):
+        e, ex = env
+        res = q(ex, "KILL QUERY 999999")
+        assert "no such query" in res["results"][0]["error"]
+
+    def test_killed_query_skips_remaining_statements(self, env):
+        from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER
+
+        e, ex = env
+        write_devops(e)
+        # kill the query from within its own first statement via a hook
+        orig_check = TRACKER.check
+        state = {"armed": False}
+
+        def hooked():
+            if state["armed"]:
+                for info in TRACKER.snapshot():
+                    if "DROP MEASUREMENT" in info["query"]:
+                        TRACKER.kill(info["qid"])
+                state["armed"] = False
+            orig_check()
+
+        TRACKER.check = hooked
+        state["armed"] = True
+        try:
+            res = q(ex, "SELECT mean(usage_user) FROM cpu; DROP MEASUREMENT cpu")
+        finally:
+            TRACKER.check = orig_check
+        # second statement must NOT have run: measurement still exists
+        assert "killed" in str(res["results"])
+        out = q(ex, "SHOW MEASUREMENTS")
+        assert ["cpu"] in series_of(out)["values"]
+
+    def test_show_queries_redacts_passwords(self, env):
+        from opengemini_tpu.utils.querytracker import redact
+
+        assert "[REDACTED]" in redact("CREATE USER bob WITH PASSWORD 'hunter2'")
+        assert "hunter2" not in redact("CREATE USER bob WITH PASSWORD 'hunter2'")
+        assert "s3c" not in redact("SET PASSWORD FOR u = 's3c'")
+        assert redact("SELECT v FROM m") == "SELECT v FROM m"
