@@ -109,6 +109,21 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
     from opengemini_tpu.services.subscriber import SubscriberManager
 
     svc.subscriber = SubscriberManager(svc.engine)
+    from opengemini_tpu.services.iodetector import IoDetectorService
+    from opengemini_tpu.services.sherlock import SherlockService
+
+    out.append(IoDetectorService(
+        svc.engine, float(sc.get("iodetector-interval-s", 30)),
+        float(sc.get("iodetector-timeout-s", 10)),
+        bool(sc.get("iodetector-fatal", False)),
+    ))
+    out.append(SherlockService(
+        svc.engine, float(sc.get("sherlock-interval-s", 30)),
+        float(sc.get("sherlock-mem-mb", 4096)),
+        int(sc.get("sherlock-threads", 200)),
+        float(sc.get("sherlock-cooldown-s", 600)),
+        bool(sc.get("sherlock-tracemalloc", False)),
+    ))
     if sc.get("cold-dir"):
         from opengemini_tpu.services.hierarchical import HierarchicalService
 

@@ -1,0 +1,94 @@
+"""Sherlock: watermark-triggered self-diagnostics.
+
+Reference: lib/sherlock (sherlock.go:30, Start:109, startDumpLoop:125) —
+a continuous CPU/memory/goroutine monitor that auto-dumps pprof profiles
+when watermarks are crossed. Python equivalent: RSS and thread-count
+watermarks; on crossing, dump every thread's stack plus a tracemalloc
+top-allocations report into `<data>/sherlock/`, rate-limited with a
+cooldown so a sustained spike produces one dump, not hundreds.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time as _time
+import traceback
+
+from opengemini_tpu.services.base import Service, logger
+
+
+def _rss_mb() -> float:
+    try:
+        with open("/proc/self/statm", encoding="ascii") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / (1 << 20)
+    except (OSError, ValueError, IndexError):  # pragma: no cover
+        return 0.0
+
+
+class SherlockService(Service):
+    name = "sherlock"
+
+    def __init__(self, engine, interval_s: float = 30.0,
+                 mem_mb_watermark: float = 4096.0,
+                 thread_watermark: int = 200,
+                 cooldown_s: float = 600.0,
+                 enable_tracemalloc: bool = False):
+        super().__init__(interval_s)
+        self.engine = engine
+        self.mem_mb_watermark = mem_mb_watermark
+        self.thread_watermark = thread_watermark
+        self.cooldown_s = cooldown_s
+        self._last_dump = float("-inf")  # monotonic() epoch is arbitrary
+        self.dumps = 0
+        if enable_tracemalloc:  # ~2x alloc overhead; opt-in like pprof heap
+            import tracemalloc
+
+            tracemalloc.start(10)
+
+    def handle(self) -> str | None:
+        import threading
+
+        rss = _rss_mb()
+        n_threads = threading.active_count()
+        trigger = None
+        if rss > self.mem_mb_watermark:
+            trigger = f"rss {rss:.0f}MB > {self.mem_mb_watermark:.0f}MB"
+        elif n_threads > self.thread_watermark:
+            trigger = f"threads {n_threads} > {self.thread_watermark}"
+        if trigger is None:
+            return None
+        now = _time.monotonic()
+        if now - self._last_dump < self.cooldown_s:
+            return None
+        # commit cooldown/counter only after the dump lands on disk: a
+        # failed dump (disk full) must not burn the window unretried
+        path = self._dump(trigger, rss, n_threads)
+        self._last_dump = now
+        self.dumps += 1
+        return path
+
+    def _dump(self, trigger: str, rss: float, n_threads: int) -> str:
+        out_dir = os.path.join(self.engine.root, "sherlock")
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, f"dump-{_time.strftime('%Y%m%dT%H%M%S')}.txt")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(f"sherlock dump — trigger: {trigger}\n")
+            f.write(f"rss_mb={rss:.1f} threads={n_threads}\n\n")
+            f.write("== thread stacks ==\n")
+            for tid, frame in sys._current_frames().items():
+                f.write(f"\n-- thread {tid} --\n")
+                f.write("".join(traceback.format_stack(frame)))
+            try:
+                import tracemalloc
+
+                if tracemalloc.is_tracing():
+                    f.write("\n== top allocations ==\n")
+                    snap = tracemalloc.take_snapshot()
+                    for stat in snap.statistics("lineno")[:25]:
+                        f.write(f"{stat}\n")
+            except Exception:  # noqa: BLE001
+                pass
+        logger.warning("sherlock: dumped diagnostics to %s (%s)", path, trigger)
+        return path

@@ -511,3 +511,104 @@ class TestHierarchicalRegressions:
         n = export_measurement(e, "db", "m", out)
         assert n == 2
         assert sorted(pq.read_table(out).to_pydict()["v"]) == [1.0, 2.0]
+
+
+class TestIoDetector:
+    def test_probe_ok(self, env):
+        from opengemini_tpu.services.iodetector import IoDetectorService
+
+        e, ex = env
+        svc = IoDetectorService(e, interval_s=3600, probe_timeout_s=5)
+        assert svc.handle() is True
+        assert svc.alarms == 0
+
+    def test_hang_raises_alarm(self, env, monkeypatch):
+        import time
+
+        from opengemini_tpu.services import iodetector as iod
+
+        e, ex = env
+        svc = iod.IoDetectorService(e, interval_s=3600, probe_timeout_s=0.05)
+        real_fsync = iod.os.fsync
+        monkeypatch.setattr(iod.os, "fsync", lambda fd: time.sleep(0.5))
+        try:
+            assert svc.handle() is False
+            assert svc.alarms == 1
+        finally:
+            monkeypatch.setattr(iod.os, "fsync", real_fsync)
+
+
+class TestSherlock:
+    def test_below_watermark_no_dump(self, env):
+        from opengemini_tpu.services.sherlock import SherlockService
+
+        e, ex = env
+        svc = SherlockService(e, mem_mb_watermark=10**6, thread_watermark=10**6)
+        assert svc.handle() is None
+
+    def test_watermark_dump_and_cooldown(self, env):
+        import os
+
+        from opengemini_tpu.services.sherlock import SherlockService
+
+        e, ex = env
+        svc = SherlockService(e, mem_mb_watermark=0.001, cooldown_s=600)
+        path = svc.handle()
+        assert path and os.path.exists(path)
+        content = open(path).read()
+        assert "thread stacks" in content and "trigger: rss" in content
+        # cooldown suppresses the next dump
+        assert svc.handle() is None
+        assert svc.dumps == 1
+
+    def test_hung_probe_not_stacked(self, env, monkeypatch):
+        import threading
+        import time
+
+        from opengemini_tpu.services import iodetector as iod
+
+        e, ex = env
+        svc = iod.IoDetectorService(e, interval_s=3600, probe_timeout_s=0.05)
+        release = threading.Event()
+        real_fsync = iod.os.fsync
+        monkeypatch.setattr(iod.os, "fsync", lambda fd: release.wait(5))
+        try:
+            assert svc.handle() is False  # starts the stuck probe
+            before = threading.active_count()
+            assert svc.handle() is False  # does NOT start a second thread
+            assert threading.active_count() == before
+            assert svc.alarms == 2
+        finally:
+            release.set()
+            monkeypatch.setattr(iod.os, "fsync", real_fsync)
+            time.sleep(0.05)
+
+    def test_first_dump_immediate_despite_cooldown(self, env):
+        # monotonic() epoch is arbitrary; a fresh service must dump on the
+        # first crossing even when monotonic() < cooldown_s
+        from opengemini_tpu.services.sherlock import SherlockService
+
+        e, ex = env
+        svc = SherlockService(e, mem_mb_watermark=0.001, cooldown_s=10**9)
+        assert svc.handle() is not None
+
+    def test_failed_dump_does_not_burn_cooldown(self, env, monkeypatch):
+        from opengemini_tpu.services import sherlock as sh
+
+        e, ex = env
+        svc = sh.SherlockService(e, mem_mb_watermark=0.001, cooldown_s=600)
+        calls = []
+
+        def boom(*a):
+            calls.append(1)
+            raise OSError("disk full")
+
+        monkeypatch.setattr(svc, "_dump", boom)
+        import pytest as _pytest
+
+        with _pytest.raises(OSError):
+            svc.handle()
+        assert svc.dumps == 0
+        monkeypatch.undo()
+        assert svc.handle() is not None  # retried immediately, not cooled down
+        assert svc.dumps == 1
