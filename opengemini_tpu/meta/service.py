@@ -20,6 +20,20 @@ import urllib.request
 from opengemini_tpu.meta.raft import LEADER, RaftNode
 
 
+# db-scoped registry commands (CQ / stream / subscription): FSM state key
+# and the command field that carries the registered object's JSON payload
+_REGISTRY_CREATE = {
+    "create_cq": ("cqs", "cq"),
+    "create_stream": ("streams", "task"),
+    "create_subscription": ("subscriptions", "sub"),
+}
+_REGISTRY_DROP = {
+    "drop_cq": "cqs",
+    "drop_stream": "streams",
+    "drop_subscription": "subscriptions",
+}
+
+
 class MetaFSM:
     """Deterministic state machine over cluster metadata commands.
 
@@ -58,6 +72,15 @@ class MetaFSM:
             db = self.databases.get(cmd["db"])
             if db is not None:
                 db["rps"].pop(cmd["name"], None)
+        elif op in _REGISTRY_CREATE:
+            key, payload = _REGISTRY_CREATE[op]
+            db = self.databases.get(cmd["db"])
+            if db is not None:
+                db.setdefault(key, {})[cmd[payload]["name"]] = cmd[payload]
+        elif op in _REGISTRY_DROP:
+            db = self.databases.get(cmd["db"])
+            if db is not None:
+                db.get(_REGISTRY_DROP[op], {}).pop(cmd["name"], None)
         elif op == "register_node":
             self.nodes[cmd["id"]] = {"addr": cmd["addr"], "role": cmd.get("role", "data")}
         elif op == "remove_node":
@@ -230,6 +253,33 @@ class MetaStore:
                     )
             elif op == "drop_rp":
                 engine.drop_retention_policy(cmd["db"], cmd["name"])
+            elif op == "create_cq":
+                if cmd["db"] in engine.databases:
+                    from opengemini_tpu.storage.engine import ContinuousQuery
+
+                    engine.create_continuous_query(
+                        cmd["db"], ContinuousQuery.from_json(cmd["cq"])
+                    )
+            elif op == "drop_cq":
+                engine.drop_continuous_query(cmd["db"], cmd["name"])
+            elif op == "create_stream":
+                if cmd["db"] in engine.databases:
+                    from opengemini_tpu.storage.engine import StreamTask
+
+                    engine.create_stream(
+                        cmd["db"], StreamTask.from_json(cmd["task"])
+                    )
+            elif op == "drop_stream":
+                engine.drop_stream(cmd["db"], cmd["name"])
+            elif op == "create_subscription":
+                if cmd["db"] in engine.databases:
+                    from opengemini_tpu.services.subscriber import Subscription
+
+                    engine.create_subscription(
+                        cmd["db"], Subscription.from_json(cmd["sub"])
+                    )
+            elif op == "drop_subscription":
+                engine.drop_subscription(cmd["db"], cmd["name"])
             _write_marker(index)
 
         self.fsm.listeners.append(on_apply)

@@ -134,6 +134,18 @@ class Executor:
             raise QueryError("meta proposal failed (no quorum?)")
         return True
 
+    def _check_fsm_db(self, name: str) -> None:
+        """Validate db existence against the FSM BEFORE proposing a
+        db-scoped command: the FSM silently ignores an unknown db, which
+        would persist a junk entry. Leadership is checked FIRST — a
+        lagging follower must redirect, not answer 'not found' from its
+        stale FSM (same rule as _user_ddl)."""
+        if self.meta_store is None:
+            return
+        self._require_leader()
+        if name not in self.meta_store.fsm.databases:
+            raise QueryError(f"database not found: {name}")
+
     def _require_leader(self) -> None:
         if self.meta_store is not None and not self.meta_store.is_leader():
             leader = self.meta_store.leader_hint() or "unknown"
@@ -287,10 +299,7 @@ class Executor:
             return {}
         if isinstance(stmt, ast.CreateRetentionPolicy):
             tgt = stmt.database or db
-            if self.meta_store is not None and tgt not in self.meta_store.fsm.databases:
-                # validate against the FSM BEFORE proposing: the FSM would
-                # silently ignore an unknown db and persist a junk entry
-                raise QueryError(f"database not found: {tgt}")
+            self._check_fsm_db(tgt)
             cmd = {
                 "op": "create_rp", "db": tgt, "name": stmt.name,
                 "duration_ns": stmt.duration_ns,
@@ -311,16 +320,21 @@ class Executor:
         if isinstance(stmt, ast.CreateContinuousQuery):
             from opengemini_tpu.storage.engine import ContinuousQuery
 
-            self.engine.create_continuous_query(
-                stmt.database or db,
-                ContinuousQuery(
-                    stmt.name, stmt.select_text,
-                    stmt.resample_every_ns, stmt.resample_for_ns,
-                ),
+            tgt = stmt.database or db
+            self._check_fsm_db(tgt)
+            cq = ContinuousQuery(
+                stmt.name, stmt.select_text,
+                stmt.resample_every_ns, stmt.resample_for_ns,
             )
+            if not self._replicate_ddl({"op": "create_cq", "db": tgt,
+                                        "cq": cq.to_json()}):
+                self.engine.create_continuous_query(tgt, cq)
             return {}
         if isinstance(stmt, ast.DropContinuousQuery):
-            self.engine.drop_continuous_query(stmt.database or db, stmt.name)
+            tgt = stmt.database or db
+            if not self._replicate_ddl({"op": "drop_cq", "db": tgt,
+                                        "name": stmt.name}):
+                self.engine.drop_continuous_query(tgt, stmt.name)
             return {}
         if isinstance(stmt, ast.ShowContinuousQueries):
             series = []
@@ -337,12 +351,16 @@ class Executor:
                 validate_stream_select(stmt.select)
             except ValueError as e:
                 raise QueryError(str(e)) from None
-            self.engine.create_stream(
-                db, StreamTask(stmt.name, stmt.select_text, stmt.delay_ns)
-            )
+            self._check_fsm_db(db)
+            task = StreamTask(stmt.name, stmt.select_text, stmt.delay_ns)
+            if not self._replicate_ddl({"op": "create_stream", "db": db,
+                                        "task": task.to_json()}):
+                self.engine.create_stream(db, task)
             return {}
         if isinstance(stmt, ast.DropStream):
-            self.engine.drop_stream(db, stmt.name)
+            if not self._replicate_ddl({"op": "drop_stream", "db": db,
+                                        "name": stmt.name}):
+                self.engine.drop_stream(db, stmt.name)
             return {}
         if isinstance(stmt, ast.CreateSubscription):
             from opengemini_tpu.services.subscriber import Subscription
@@ -354,13 +372,18 @@ class Executor:
                     raise QueryError(
                         f"subscription destination must be an http(s) URL: {dest!r}"
                     )
-            self.engine.create_subscription(
-                stmt.database or db,
-                Subscription(stmt.name, stmt.mode, stmt.destinations),
-            )
+            tgt = stmt.database or db
+            self._check_fsm_db(tgt)
+            sub = Subscription(stmt.name, stmt.mode, stmt.destinations)
+            if not self._replicate_ddl({"op": "create_subscription", "db": tgt,
+                                        "sub": sub.to_json()}):
+                self.engine.create_subscription(tgt, sub)
             return {}
         if isinstance(stmt, ast.DropSubscription):
-            self.engine.drop_subscription(stmt.database or db, stmt.name)
+            tgt = stmt.database or db
+            if not self._replicate_ddl({"op": "drop_subscription", "db": tgt,
+                                        "name": stmt.name}):
+                self.engine.drop_subscription(tgt, stmt.name)
             return {}
         if isinstance(stmt, ast.ShowSubscriptions):
             series = []

@@ -431,3 +431,130 @@ class TestReplicatedUsers:
         us2.authenticate("bob", "b")
         for eng in engines.values():
             eng.close()
+
+
+class TestReplicatedRegistries:
+    def test_cq_stream_subscription_replicate(self, tmp_path):
+        """CREATE CONTINUOUS QUERY / STREAM / SUBSCRIPTION on the leader
+        materializes in EVERY replica's engine registries; drops too."""
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        bus, nodes, _ = make_cluster(3, tmp_path=tmp_path)
+        engines, stores = {}, {}
+        for nid, node in nodes.items():
+            eng = Engine(str(tmp_path / f"data-{nid}"))
+            store = MetaStore.__new__(MetaStore)
+            import threading as _threading
+
+            from opengemini_tpu.meta.service import MetaFSM
+
+            store.fsm = MetaFSM()
+            store.node = node
+            store._drain_lock = _threading.Lock()
+            store.listener_applied = 0
+            node.apply_fn = store.fsm.apply
+            store.attach_engine(eng)
+            engines[nid] = eng
+            stores[nid] = store
+        leader = elect(bus, nodes)
+        ex = Executor(engines[leader.id], meta_store=stores[leader.id])
+        import threading as _t
+        import time as _time
+
+        stop = _t.Event()
+
+        def pump():
+            while not stop.is_set():
+                for n in nodes.values():
+                    n.tick()
+                bus.deliver_all()
+                for st in stores.values():
+                    st.drain_listeners()
+                _time.sleep(0.002)
+
+        pumper = _t.Thread(target=pump, daemon=True)
+        pumper.start()
+        try:
+            res = ex.execute(
+                "CREATE DATABASE regdb; "
+                'CREATE CONTINUOUS QUERY cq1 ON regdb BEGIN '
+                "SELECT mean(v) INTO m_1m FROM m GROUP BY time(1m) END; "
+                "CREATE STREAM st1 ON SELECT sum(v) INTO s_1m FROM m "
+                "GROUP BY time(1m); "
+                'CREATE SUBSCRIPTION sub1 ON regdb '
+                "DESTINATIONS ALL 'http://h1:9092'",
+                db="regdb",
+            )
+            assert all("error" not in r for r in res["results"]), res
+            deadline = _time.time() + 5
+            while _time.time() < deadline and any(
+                "regdb" not in e.databases
+                or "cq1" not in e.databases["regdb"].continuous_queries
+                or "st1" not in e.databases["regdb"].streams
+                or "sub1" not in e.databases["regdb"].subscriptions
+                for e in engines.values()
+            ):
+                _time.sleep(0.01)
+            for nid, eng in engines.items():
+                d = eng.databases["regdb"]
+                assert "cq1" in d.continuous_queries, nid
+                assert "mean(v)" in d.continuous_queries["cq1"].select_text
+                assert "st1" in d.streams, nid
+                assert d.subscriptions["sub1"].destinations == ["http://h1:9092"], nid
+            # drops converge too
+            res = ex.execute(
+                "DROP CONTINUOUS QUERY cq1 ON regdb; DROP STREAM st1; "
+                "DROP SUBSCRIPTION sub1 ON regdb", db="regdb",
+            )
+            assert all("error" not in r for r in res["results"]), res
+            deadline = _time.time() + 5
+            while _time.time() < deadline and any(
+                e.databases["regdb"].continuous_queries
+                or e.databases["regdb"].streams
+                or e.databases["regdb"].subscriptions
+                for e in engines.values()
+            ):
+                _time.sleep(0.01)
+            for nid, eng in engines.items():
+                d = eng.databases["regdb"]
+                assert not d.continuous_queries and not d.streams, nid
+                assert not d.subscriptions, nid
+            # unknown db rejected at propose time, not persisted as junk
+            res3 = ex.execute(
+                'CREATE CONTINUOUS QUERY cqx ON nosuchdb BEGIN '
+                "SELECT mean(v) INTO y FROM m GROUP BY time(1m) END",
+                db="regdb",
+            )
+            assert "database not found" in res3["results"][0].get("error", "")
+            fsm = stores[leader.id].fsm
+            assert "nosuchdb" not in fsm.databases
+            assert "cqx" not in fsm.databases["regdb"].get("cqs", {})
+        finally:
+            stop.set()
+            pumper.join(timeout=5)
+
+    def test_follower_redirects_before_fsm_check(self, tmp_path):
+        """A lagging follower must answer 'not the meta leader', never
+        'database not found' from its stale FSM (leadership-first rule)."""
+        from opengemini_tpu.meta.service import MetaFSM
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        class _Follower:
+            fsm = MetaFSM()  # empty: any db lookup would miss
+
+            def is_leader(self):
+                return False
+
+            def leader_hint(self):
+                return "n9"
+
+        eng = Engine(str(tmp_path / "f"))
+        ex = Executor(eng, meta_store=_Follower())
+        res = ex.execute(
+            'CREATE CONTINUOUS QUERY c ON somedb BEGIN '
+            "SELECT mean(v) INTO y FROM m GROUP BY time(1m) END", db="somedb",
+        )
+        err = res["results"][0].get("error", "")
+        assert "not the meta leader" in err and "n9" in err, err

@@ -537,6 +537,28 @@ class TestIoDetector:
         finally:
             monkeypatch.setattr(iod.os, "fsync", real_fsync)
 
+    def test_hung_probe_not_stacked(self, env, monkeypatch):
+        import threading
+        import time
+
+        from opengemini_tpu.services import iodetector as iod
+
+        e, ex = env
+        svc = iod.IoDetectorService(e, interval_s=3600, probe_timeout_s=0.05)
+        release = threading.Event()
+        real_fsync = iod.os.fsync
+        monkeypatch.setattr(iod.os, "fsync", lambda fd: release.wait(5))
+        try:
+            assert svc.handle() is False  # starts the stuck probe
+            before = threading.active_count()
+            assert svc.handle() is False  # does NOT start a second thread
+            assert threading.active_count() == before
+            assert svc.alarms == 2
+        finally:
+            release.set()
+            monkeypatch.setattr(iod.os, "fsync", real_fsync)
+            time.sleep(0.05)
+
 
 class TestSherlock:
     def test_below_watermark_no_dump(self, env):
@@ -560,28 +582,6 @@ class TestSherlock:
         # cooldown suppresses the next dump
         assert svc.handle() is None
         assert svc.dumps == 1
-
-    def test_hung_probe_not_stacked(self, env, monkeypatch):
-        import threading
-        import time
-
-        from opengemini_tpu.services import iodetector as iod
-
-        e, ex = env
-        svc = iod.IoDetectorService(e, interval_s=3600, probe_timeout_s=0.05)
-        release = threading.Event()
-        real_fsync = iod.os.fsync
-        monkeypatch.setattr(iod.os, "fsync", lambda fd: release.wait(5))
-        try:
-            assert svc.handle() is False  # starts the stuck probe
-            before = threading.active_count()
-            assert svc.handle() is False  # does NOT start a second thread
-            assert threading.active_count() == before
-            assert svc.alarms == 2
-        finally:
-            release.set()
-            monkeypatch.setattr(iod.os, "fsync", real_fsync)
-            time.sleep(0.05)
 
     def test_first_dump_immediate_despite_cooldown(self, env):
         # monotonic() epoch is arbitrary; a fresh service must dump on the
