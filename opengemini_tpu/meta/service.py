@@ -72,6 +72,20 @@ class MetaFSM:
             db = self.databases.get(cmd["db"])
             if db is not None:
                 db["rps"].pop(cmd["name"], None)
+                db.get("downsample", {}).pop(cmd["name"], None)
+        elif op == "add_downsample":
+            db = self.databases.get(cmd["db"])
+            if db is not None:
+                db.setdefault("downsample", {})[cmd["rp"]] = cmd["policies"]
+                if cmd.get("ttl_ns") and cmd["rp"] in db["rps"]:
+                    db["rps"][cmd["rp"]]["duration_ns"] = cmd["ttl_ns"]
+        elif op == "drop_downsample":
+            db = self.databases.get(cmd["db"])
+            if db is not None:
+                if cmd.get("rp"):
+                    db.get("downsample", {}).pop(cmd["rp"], None)
+                else:
+                    db.get("downsample", {}).clear()
         elif op in _REGISTRY_CREATE:
             key, payload = _REGISTRY_CREATE[op]
             db = self.databases.get(cmd["db"])
@@ -280,6 +294,17 @@ class MetaStore:
                     )
             elif op == "drop_subscription":
                 engine.drop_subscription(cmd["db"], cmd["name"])
+            elif op == "add_downsample":
+                if cmd["db"] in engine.databases:
+                    from opengemini_tpu.storage.engine import DownsamplePolicy
+
+                    engine.set_downsample_policies(
+                        cmd["db"], cmd["rp"],
+                        [DownsamplePolicy.from_json(p) for p in cmd["policies"]],
+                        ttl_ns=cmd.get("ttl_ns", 0),
+                    )
+            elif op == "drop_downsample":
+                engine.drop_downsample_policies(cmd["db"], cmd.get("rp"))
             _write_marker(index)
 
         self.fsm.listeners.append(on_apply)

@@ -134,6 +134,100 @@ class Executor:
             raise QueryError("meta proposal failed (no quorum?)")
         return True
 
+    # aggregates the downsample rewrite path can actually execute per field
+    # type: integers must stay on the exact host int64 path (sum/min/max/
+    # first/last) or produce float output (mean/stddev/median); count,
+    # count_distinct, spread and percentile would fail at rewrite time for
+    # INT fields, and percentile lacks its parameter in every path
+    _DOWNSAMPLE_AGGS = {
+        "float": {"sum", "count", "mean", "min", "max", "first", "last",
+                  "spread", "stddev", "median"},
+        "integer": {"sum", "mean", "min", "max", "first", "last",
+                    "stddev", "median"},
+        "boolean": {"first", "last"},
+    }
+
+    def _create_downsample(self, stmt, db: str) -> dict:
+        """CREATE DOWNSAMPLE (reference: CreateDownSampleStatement semantics,
+        meta downsample policies + engine_downsample.go): level i rewrites
+        shards older than SAMPLEINTERVAL[i] at TIMEINTERVAL[i] resolution."""
+        from opengemini_tpu.ops import aggregates as aggmod
+        from opengemini_tpu.storage.engine import DownsamplePolicy
+
+        tgt = stmt.database or db
+        if not stmt.rp:
+            raise QueryError("CREATE DOWNSAMPLE requires ON [db.]rp")
+        samples, times = stmt.sample_intervals, stmt.time_intervals
+        if len(samples) != len(times):
+            raise QueryError(
+                "SAMPLEINTERVAL and TIMEINTERVAL must have the same "
+                f"number of levels ({len(samples)} vs {len(times)})"
+            )
+        for i in range(len(samples)):
+            if times[i] <= 0 or samples[i] <= 0:
+                raise QueryError("downsample intervals must be positive")
+            if times[i] >= samples[i]:
+                raise QueryError(
+                    f"TIMEINTERVAL {_fmt_duration(times[i])} must be finer "
+                    f"than SAMPLEINTERVAL {_fmt_duration(samples[i])}"
+                )
+            if i and (samples[i] <= samples[i - 1] or times[i] <= times[i - 1]):
+                raise QueryError("downsample levels must be ascending")
+        if stmt.ttl_ns and samples and stmt.ttl_ns < samples[-1]:
+            raise QueryError("TTL must cover the last SAMPLEINTERVAL")
+        for tname, agg in stmt.type_aggs.items():
+            allowed = self._DOWNSAMPLE_AGGS.get(tname)
+            if allowed is None:
+                raise QueryError(f"unknown downsample field type: {tname!r}")
+            if agg not in allowed:
+                raise QueryError(
+                    f"downsample aggregate {agg!r} is not supported for "
+                    f"{tname} fields (one of: {', '.join(sorted(allowed))})"
+                )
+            aggmod.get(agg)  # registry sanity; allowlist is a subset
+        self._check_fsm_db(tgt)
+        if self.meta_store is not None:
+            fsm_db = self.meta_store.fsm.databases[tgt]
+            if stmt.rp not in fsm_db.get("rps", {}):
+                raise QueryError(f"retention policy not found: {tgt}.{stmt.rp}")
+            if stmt.rp in fsm_db.get("downsample", {}):
+                raise QueryError(f"downsample already exists on {tgt}.{stmt.rp}")
+        else:
+            d = self.engine.databases.get(tgt)
+            if d is None:
+                raise QueryError(f"database not found: {tgt}")
+            if stmt.rp not in d.rps:
+                raise QueryError(f"retention policy not found: {tgt}.{stmt.rp}")
+            if d.downsample.get(stmt.rp):
+                raise QueryError(f"downsample already exists on {tgt}.{stmt.rp}")
+        policies = [
+            DownsamplePolicy(samples[i], times[i], dict(stmt.type_aggs))
+            for i in range(len(samples))
+        ]
+        cmd = {"op": "add_downsample", "db": tgt, "rp": stmt.rp,
+               "ttl_ns": stmt.ttl_ns,
+               "policies": [p.to_json() for p in policies]}
+        if not self._replicate_ddl(cmd):
+            self.engine.set_downsample_policies(tgt, stmt.rp, policies,
+                                                ttl_ns=stmt.ttl_ns)
+        return {}
+
+    def _show_downsamples(self, stmt, db: str) -> dict:
+        tgt = stmt.database or db
+        d = self.engine.databases.get(tgt)
+        if d is None:
+            raise QueryError(f"database not found: {tgt}")
+        rows = []
+        for rp in sorted(d.downsample):
+            for p in d.downsample[rp]:
+                aggs = ",".join(f"{t}({a})" for t, a in sorted(p.field_aggs.items()))
+                rows.append([rp, aggs, _fmt_duration(p.age_ns),
+                             _fmt_duration(p.every_ns)])
+        series = _series(tgt, None,
+                         ["rpName", "aggs", "sampleInterval", "timeInterval"],
+                         rows)
+        return {"series": [series]}
+
     def _check_fsm_db(self, name: str) -> None:
         """Validate db existence against the FSM BEFORE proposing a
         db-scoped command: the FSM silently ignores an unknown db, which
@@ -379,6 +473,16 @@ class Executor:
                                         "sub": sub.to_json()}):
                 self.engine.create_subscription(tgt, sub)
             return {}
+        if isinstance(stmt, ast.CreateDownsample):
+            return self._create_downsample(stmt, db)
+        if isinstance(stmt, ast.DropDownsample):
+            tgt = stmt.database or db
+            cmd = {"op": "drop_downsample", "db": tgt, "rp": stmt.rp or None}
+            if not self._replicate_ddl(cmd):
+                self.engine.drop_downsample_policies(tgt, stmt.rp or None)
+            return {}
+        if isinstance(stmt, ast.ShowDownsamples):
+            return self._show_downsamples(stmt, db)
         if isinstance(stmt, ast.DropSubscription):
             tgt = stmt.database or db
             if not self._replicate_ddl({"op": "drop_subscription", "db": tgt,

@@ -361,6 +361,31 @@ class ShowSubscriptions:
 
 
 @dataclass
+class CreateDownsample:
+    """Reference: influxql CreateDownSampleStatement (ast.go:11262) —
+    SAMPLEINTERVAL[i] is the data-age threshold of level i, TIMEINTERVAL[i]
+    the rewritten resolution, Ops the per-type aggregates."""
+
+    database: str = ""
+    rp: str = ""
+    ttl_ns: int = 0
+    sample_intervals: list[int] = field(default_factory=list)
+    time_intervals: list[int] = field(default_factory=list)
+    type_aggs: dict = field(default_factory=dict)  # "float"/"integer" -> agg
+
+
+@dataclass
+class DropDownsample:
+    database: str = ""
+    rp: str = ""  # empty: drop on every rp of the database
+
+
+@dataclass
+class ShowDownsamples:
+    database: str = ""
+
+
+@dataclass
 class ShowQueries:
     pass
 

@@ -23,6 +23,7 @@ KEYWORDS = {
     "to", "set", "read", "write", "all", "cardinality", "exact",
     "stream", "streams", "delay", "shards", "stats", "diagnostics",
     "subscription", "subscriptions", "destinations", "any", "kill",
+    "downsample", "downsamples", "ttl", "sampleinterval", "timeinterval",
 }
 
 _DUR_RE = re.compile(r"(\d+)(ns|u|µ|us|ms|s|m|h|d|w)")

@@ -73,6 +73,18 @@ class Parser:
             return tok.val
         return None
 
+    def _duration_tok(self, clause: str) -> int:
+        t = self.lex.next()
+        if t.kind != "DURATION":
+            raise ParseError(f"{clause} expects a duration")
+        return t.val
+
+    def _duration_list(self, clause: str) -> list[int]:
+        out = [self._duration_tok(clause)]
+        while self._accept_op(","):
+            out.append(self._duration_tok(clause))
+        return out
+
     def _expect_op(self, op: str) -> None:
         tok = self.lex.next()
         if tok.kind != "OP" or tok.val != op:
@@ -502,6 +514,11 @@ class Parser:
             return ast.ShowSubscriptions()
         if kw.val == "queries":
             return ast.ShowQueries()
+        if kw.val == "downsamples":
+            stmt = ast.ShowDownsamples()
+            if self._accept_kw("on"):
+                stmt.database = self._ident()
+            return stmt
         if kw.val == "stats":
             return ast.ShowStats()
         if kw.val == "diagnostics":
@@ -516,8 +533,37 @@ class Parser:
     def parse_create(self):
         self._expect_kw("create")
         kw = self._expect_kw(
-            "database", "retention", "continuous", "user", "stream", "subscription"
+            "database", "retention", "continuous", "user", "stream",
+            "subscription", "downsample",
         )
+        if kw == "downsample":
+            # CREATE DOWNSAMPLE ON [db.]rp (float(mean),integer(sum))
+            #   WITH TTL 7d SAMPLEINTERVAL 1h,25h TIMEINTERVAL 5m,30m
+            # (reference: influxql CreateDownSampleStatement, ast.go:11262)
+            stmt = ast.CreateDownsample()
+            if self._accept_kw("on"):
+                first = self._ident()
+                if self._accept_op("."):
+                    stmt.database, stmt.rp = first, self._ident()
+                else:
+                    stmt.rp = first
+            if self._accept_op("("):
+                while True:
+                    tname = self._ident().lower()
+                    self._expect_op("(")
+                    stmt.type_aggs[tname] = self._ident().lower()
+                    self._expect_op(")")
+                    if not self._accept_op(","):
+                        break
+                self._expect_op(")")
+            self._expect_kw("with")
+            self._expect_kw("ttl")
+            stmt.ttl_ns = self._duration_tok("TTL")
+            self._expect_kw("sampleinterval")
+            stmt.sample_intervals = self._duration_list("SAMPLEINTERVAL")
+            self._expect_kw("timeinterval")
+            stmt.time_intervals = self._duration_list("TIMEINTERVAL")
+            return stmt
         if kw == "subscription":
             # CREATE SUBSCRIPTION name ON db DESTINATIONS ALL|ANY 'url', ...
             name = self._ident()
@@ -632,8 +678,21 @@ class Parser:
         self._expect_kw("drop")
         kw = self._expect_kw(
             "database", "retention", "measurement", "continuous", "user", "series",
-            "stream", "subscription",
+            "stream", "subscription", "downsample", "downsamples",
         )
+        if kw in ("downsample", "downsamples"):
+            stmt = ast.DropDownsample()
+            if self._accept_kw("on"):
+                first = self._ident()
+                if self._accept_op("."):
+                    stmt.database, stmt.rp = first, self._ident()
+                elif kw == "downsample":
+                    stmt.rp = first
+                else:  # DROP DOWNSAMPLES ON db: every rp of the database
+                    stmt.database = first
+            elif kw == "downsample":
+                raise ParseError("DROP DOWNSAMPLE requires ON [db.]rp")
+            return stmt
         if kw == "stream":
             return ast.DropStream(self._ident())
         if kw == "subscription":

@@ -88,7 +88,13 @@ def downsample_records(
     for name, ftype in schema.items():
         if ftype == FieldType.STRING:
             continue
-        agg_name = field_aggs.get(name) or DEFAULT_TYPE_AGGS[ftype]
+        # lookup order: exact field name, then type name (the SQL surface's
+        # `float(mean)` / `integer(sum)` ops map per-type — reference
+        # CreateDownSampleStatement Ops), then the type default
+        tname = {FieldType.FLOAT: "float", FieldType.INT: "integer",
+                 FieldType.BOOL: "boolean"}.get(ftype, "")
+        agg_name = (field_aggs.get(name) or field_aggs.get(tname)
+                    or DEFAULT_TYPE_AGGS[ftype])
         spec = aggmod.get(agg_name)
         if spec.int_output:  # count-like
             out_type = FieldType.INT

@@ -238,6 +238,7 @@ class Engine:
             d = self.databases.get(db)
             if d and name in d.rps:
                 del d.rps[name]
+                d.downsample.pop(name, None)  # policies die with their rp
                 if d.default_rp == name:
                     d.default_rp = "autogen" if "autogen" in d.rps else next(
                         iter(d.rps), "autogen"
@@ -439,6 +440,36 @@ class Engine:
             if d is None:
                 raise DatabaseNotFound(db)
             d.downsample.setdefault(rp, []).append(policy)
+            self._save_meta()
+
+    def set_downsample_policies(self, db: str, rp: str,
+                                policies: list["DownsamplePolicy"],
+                                ttl_ns: int = 0) -> None:
+        """Replace the rp's whole policy set (replace semantics keep the
+        raft-listener replay idempotent; already-exists is the DDL
+        layer's check, not the engine's). A nonzero ttl_ns also becomes
+        the rp's retention duration (reference: CREATE DOWNSAMPLE's
+        Duration is assigned to the rp, data.go SetDownSamplePolicy)."""
+        with self._lock:
+            d = self.databases.get(db)
+            if d is None:
+                raise DatabaseNotFound(db)
+            if rp not in d.rps:
+                raise WriteError(f"retention policy not found: {db}.{rp}")
+            d.downsample[rp] = list(policies)
+            if ttl_ns:
+                d.rps[rp].duration_ns = ttl_ns
+            self._save_meta()
+
+    def drop_downsample_policies(self, db: str, rp: str | None = None) -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d is None:
+                return
+            if rp is None:
+                d.downsample.clear()
+            else:
+                d.downsample.pop(rp, None)
             self._save_meta()
 
     def shards_due_downsample(self, now_ns: int | None = None):

@@ -520,6 +520,30 @@ class TestReplicatedRegistries:
                 d = eng.databases["regdb"]
                 assert not d.continuous_queries and not d.streams, nid
                 assert not d.subscriptions, nid
+            # downsample policies replicate too (per-rp, replace semantics)
+            res = ex.execute(
+                "CREATE DOWNSAMPLE ON regdb.autogen (float(mean)) WITH TTL 30d "
+                "SAMPLEINTERVAL 1h,25h TIMEINTERVAL 1m,30m", db="regdb",
+            )
+            assert all("error" not in r for r in res["results"]), res
+            deadline = _time.time() + 5
+            while _time.time() < deadline and any(
+                len(e.databases["regdb"].downsample.get("autogen", [])) != 2
+                for e in engines.values()
+            ):
+                _time.sleep(0.01)
+            for nid, eng in engines.items():
+                pols = eng.databases["regdb"].downsample["autogen"]
+                assert [(p.age_ns, p.every_ns) for p in pols] == [
+                    (3600 * 10**9, 60 * 10**9),
+                    (25 * 3600 * 10**9, 1800 * 10**9)], nid
+                assert pols[0].field_aggs == {"float": "mean"}, nid
+            # duplicate create rejected from the FSM registry
+            res = ex.execute(
+                "CREATE DOWNSAMPLE ON regdb.autogen WITH TTL 30d "
+                "SAMPLEINTERVAL 1h TIMEINTERVAL 1m", db="regdb",
+            )
+            assert "already exists" in res["results"][0].get("error", "")
             # unknown db rejected at propose time, not persisted as junk
             res3 = ex.execute(
                 'CREATE CONTINUOUS QUERY cqx ON nosuchdb BEGIN '
