@@ -640,8 +640,8 @@ class TestDownsampleSQL:
         e, ex = env
         e.write_lines("db", f"cpu v=1 {BASE * NS}\ncpu v=3 {(BASE + 30) * NS}")
         q(ex, "CREATE DOWNSAMPLE ON autogen (float(mean)) WITH TTL 52w "
-              "SAMPLEINTERVAL 1s TIMEINTERVAL 1ms")
-        # hand-tight intervals so the shard ages past level 0 immediately
+              "SAMPLEINTERVAL 2m TIMEINTERVAL 1m")
+        # tight intervals so the shard ages past level 0 immediately
         week = 7 * 24 * 3600
         assert e.run_downsample(now_ns=(BASE + 2 * week) * NS) == 1
 
