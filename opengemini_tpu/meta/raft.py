@@ -8,8 +8,11 @@ and message drops); deployments use the HTTP transport in meta/service.py.
 
 Scope: leader election with randomized timeouts, AppendEntries log
 replication with consistency checks and follower log repair, majority
-commit, persisted (term, votedFor, log) — the Figure-2 core. Snapshots
-and membership changes land with the cluster round.
+commit, persisted (term, votedFor, log) — the Figure-2 core — plus log
+compaction (§7): take_snapshot() truncates the applied prefix and an
+InstallSnapshot RPC catches up followers whose needed entries were
+compacted away. Log indices stay 1-based and ABSOLUTE; the in-memory
+list holds entries (snap_index, snap_index+len(log)].
 
 The node is DRIVEN: call tick() on a timer thread and deliver_* from the
 transport; no internal threads, which keeps tests deterministic.
@@ -42,11 +45,12 @@ class RaftNode:
     def __init__(self, node_id: str, peers: list[str], transport,
                  apply_fn, storage_path: str | None = None,
                  election_ticks: tuple[int, int] = (10, 20),
-                 heartbeat_ticks: int = 3):
+                 heartbeat_ticks: int = 3, restore_fn=None):
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.transport = transport
         self.apply_fn = apply_fn
+        self.restore_fn = restore_fn  # state-machine full restore (snapshots)
         self.storage_path = storage_path
         self._lock = threading.RLock()
 
@@ -54,12 +58,15 @@ class RaftNode:
         self.current_term = 0
         self.voted_for: str | None = None
         self.log: list[LogEntry] = []
+        self.snap_index = 0  # last log index covered by the snapshot
+        self.snap_term = 0
+        self.snap_state = None  # opaque state-machine snapshot
         self._load()
 
         # volatile
         self.state = FOLLOWER
-        self.commit_index = 0  # 1-based; 0 = nothing
-        self.last_applied = 0
+        self.commit_index = self.snap_index  # 1-based; 0 = nothing
+        self.last_applied = self.snap_index
         self.leader_id: str | None = None
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
@@ -69,6 +76,8 @@ class RaftNode:
         self._heartbeat_ticks = heartbeat_ticks
         self._ticks_until_election = self._rand_election()
         self._ticks_until_heartbeat = 0
+        if self.snap_state is not None and self.restore_fn:
+            self.restore_fn(self.snap_state)
 
     # -- persistence ------------------------------------------------------
 
@@ -80,6 +89,14 @@ class RaftNode:
         self.current_term = j["term"]
         self.voted_for = j["voted_for"]
         self.log = [LogEntry(t, c) for t, c in j["log"]]
+        self.snap_index = j.get("snap_index", 0)
+        self.snap_term = j.get("snap_term", 0)
+        # snapshot state lives in a sidecar written only on compaction /
+        # install: the hot _persist path must stay O(log), not O(state)
+        snap_path = self.storage_path + ".snap"
+        if self.snap_index and os.path.exists(snap_path):
+            with open(snap_path, encoding="utf-8") as f:
+                self.snap_state = json.load(f)
 
     def _persist(self) -> None:
         if not self.storage_path:
@@ -90,21 +107,51 @@ class RaftNode:
                 "term": self.current_term,
                 "voted_for": self.voted_for,
                 "log": [e.to_json() for e in self.log],
+                "snap_index": self.snap_index,
+                "snap_term": self.snap_term,
             }, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.storage_path)
+
+    def _persist_snapshot(self) -> None:
+        """Write the sidecar FIRST, then the log file referencing it: a
+        crash between the two leaves a snap file with no pointer (harmless)
+        rather than a pointer with no state."""
+        if not self.storage_path:
+            return
+        snap_path = self.storage_path + ".snap"
+        tmp = snap_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.snap_state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, snap_path)
 
     # -- helpers ----------------------------------------------------------
 
     def _rand_election(self) -> int:
         return random.randint(*self._election_ticks)
 
+    def _abs_last(self) -> int:
+        """Absolute index of the last log entry (snapshot included)."""
+        return self.snap_index + len(self.log)
+
+    def _term_at(self, idx: int) -> int | None:
+        """Term of the entry at absolute index idx; snap_term at the
+        snapshot boundary; None outside the known range."""
+        if idx == self.snap_index:
+            return self.snap_term
+        pos = idx - self.snap_index
+        if 1 <= pos <= len(self.log):
+            return self.log[pos - 1].term
+        return None
+
     def _last_log(self) -> tuple[int, int]:
-        """(index, term), 1-based index, (0, 0) when empty."""
+        """(index, term), 1-based absolute index, (0, 0) when empty."""
         if not self.log:
-            return 0, 0
-        return len(self.log), self.log[-1].term
+            return self.snap_index, self.snap_term
+        return self._abs_last(), self.log[-1].term
 
     def _become_follower(self, term: int, leader: str | None = None) -> None:
         if term > self.current_term:
@@ -134,7 +181,7 @@ class RaftNode:
                 return None
             self.log.append(LogEntry(self.current_term, cmd))
             self._persist()
-            idx = len(self.log)
+            idx = self._abs_last()
             term = self.current_term
             self.match_index[self.id] = idx
             self._broadcast_append()
@@ -143,9 +190,25 @@ class RaftNode:
 
     def entry_term(self, idx: int) -> int | None:
         with self._lock:
-            if 1 <= idx <= len(self.log):
-                return self.log[idx - 1].term
-            return None
+            return self._term_at(idx)
+
+    def take_snapshot(self, state_fn) -> bool:
+        """Compact the applied log prefix. state_fn() is called UNDER the
+        raft lock so the captured state-machine state corresponds exactly
+        to last_applied (apply_fn runs under this lock too)."""
+        with self._lock:
+            if self.last_applied <= self.snap_index:
+                return False
+            idx = self.last_applied
+            term = self._term_at(idx)
+            state = state_fn()
+            del self.log[: idx - self.snap_index]
+            self.snap_index = idx
+            self.snap_term = term
+            self.snap_state = state
+            self._persist_snapshot()
+            self._persist()
+            return True
 
     def tick(self) -> None:
         """Advance timers: election timeout / leader heartbeat."""
@@ -187,6 +250,8 @@ class RaftNode:
         "append_entries": ("from", "term", "prev_log_index", "prev_log_term",
                            "entries", "leader_commit"),
         "append_entries_reply": ("from", "term", "ok", "match_index"),
+        "install_snapshot": ("from", "term", "snap_index", "snap_term",
+                             "state"),
     }
 
     @classmethod
@@ -206,6 +271,7 @@ class RaftNode:
             "request_vote_reply": self._on_request_vote_reply,
             "append_entries": self._on_append_entries,
             "append_entries_reply": self._on_append_entries_reply,
+            "install_snapshot": self._on_install_snapshot,
         }
         with self._lock:
             handlers[msg["type"]](msg)
@@ -262,10 +328,20 @@ class RaftNode:
             self._send_append(p)
 
     def _send_append(self, peer: str) -> None:
-        ni = self.next_index.get(peer, 1)
+        ni = self.next_index.get(peer, self.snap_index + 1)
+        if ni <= self.snap_index:
+            # the entries the follower needs were compacted away: ship the
+            # whole snapshot instead (Raft §7 InstallSnapshot)
+            self.transport.send(peer, {
+                "type": "install_snapshot", "from": self.id,
+                "term": self.current_term,
+                "snap_index": self.snap_index, "snap_term": self.snap_term,
+                "state": self.snap_state,
+            })
+            return
         prev_idx = ni - 1
-        prev_term = self.log[prev_idx - 1].term if 1 <= prev_idx <= len(self.log) else 0
-        entries = [e.to_json() for e in self.log[ni - 1 :]]
+        prev_term = self._term_at(prev_idx) or 0
+        entries = [e.to_json() for e in self.log[ni - self.snap_index - 1 :]]
         self.transport.send(peer, {
             "type": "append_entries", "from": self.id,
             "term": self.current_term,
@@ -283,10 +359,12 @@ class RaftNode:
             self.leader_id = m["from"]
             self._ticks_until_election = self._rand_election()
             prev_idx = m["prev_log_index"]
-            prev_ok = prev_idx == 0 or (
-                prev_idx <= len(self.log)
-                and self.log[prev_idx - 1].term == m["prev_log_term"]
-            )
+            if prev_idx < self.snap_index:
+                prev_ok = True  # snapshot covers it: committed by definition
+            elif prev_idx == self.snap_index:
+                prev_ok = prev_idx == 0 or m["prev_log_term"] == self.snap_term
+            else:
+                prev_ok = self._term_at(prev_idx) == m["prev_log_term"]
             if prev_ok:
                 ok = True
                 # overwrite conflicting suffix, append new entries
@@ -294,9 +372,12 @@ class RaftNode:
                 changed = False
                 for term, cmd in m["entries"]:
                     idx += 1
-                    if idx <= len(self.log):
-                        if self.log[idx - 1].term != term:
-                            del self.log[idx - 1 :]
+                    if idx <= self.snap_index:
+                        continue  # already compacted (committed) here
+                    pos = idx - self.snap_index
+                    if pos <= len(self.log):
+                        if self.log[pos - 1].term != term:
+                            del self.log[pos - 1 :]
                             self.log.append(LogEntry(term, cmd))
                             changed = True
                     else:
@@ -304,14 +385,47 @@ class RaftNode:
                         changed = True
                 if changed:
                     self._persist()
-                match_idx = idx
+                match_idx = max(idx, self.snap_index)
                 if m["leader_commit"] > self.commit_index:
-                    self.commit_index = min(m["leader_commit"], len(self.log))
+                    self.commit_index = min(m["leader_commit"], self._abs_last())
                     self._apply_committed()
         self.transport.send(m["from"], {
             "type": "append_entries_reply", "from": self.id,
             "term": self.current_term, "ok": ok, "match_index": match_idx,
-            "hint_next": len(self.log) + 1,
+            "hint_next": self._abs_last() + 1,
+        })
+
+    def _on_install_snapshot(self, m: dict) -> None:
+        if m["term"] > self.current_term:
+            self._become_follower(m["term"], m["from"])
+        ok = False
+        if m["term"] == self.current_term:
+            self.state = FOLLOWER
+            self.leader_id = m["from"]
+            self._ticks_until_election = self._rand_election()
+            ok = True
+            si, st = m["snap_index"], m["snap_term"]
+            if si > self.last_applied:
+                # adopt: replace state wholesale; keep a log suffix only
+                # when it provably follows the snapshot
+                if self._term_at(si) == st:
+                    del self.log[: si - self.snap_index]
+                else:
+                    self.log = []
+                self.snap_index, self.snap_term = si, st
+                self.snap_state = m["state"]
+                self.commit_index = max(self.commit_index, si)
+                self.last_applied = si
+                if self.restore_fn:
+                    self.restore_fn(m["state"])
+                self._persist_snapshot()
+                self._persist()
+                self._apply_committed()  # retained suffix up to commit
+        self.transport.send(m["from"], {
+            "type": "append_entries_reply", "from": self.id,
+            "term": self.current_term, "ok": ok,
+            "match_index": self.last_applied if ok else 0,
+            "hint_next": self._abs_last() + 1,
         })
 
     def _on_append_entries_reply(self, m: dict) -> None:
@@ -333,8 +447,8 @@ class RaftNode:
             self._send_append(peer)
 
     def _maybe_commit(self) -> None:
-        for idx in range(len(self.log), self.commit_index, -1):
-            if self.log[idx - 1].term != self.current_term:
+        for idx in range(self._abs_last(), self.commit_index, -1):
+            if self._term_at(idx) != self.current_term:
                 break  # only commit entries from the current term (§5.4.2)
             votes = sum(1 for mi in self.match_index.values() if mi >= idx)
             if votes >= self.quorum():
@@ -345,7 +459,8 @@ class RaftNode:
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            self.apply_fn(self.last_applied, self.log[self.last_applied - 1].cmd)
+            entry = self.log[self.last_applied - self.snap_index - 1]
+            self.apply_fn(self.last_applied, entry.cmd)
 
     # -- introspection -----------------------------------------------------
 
@@ -355,4 +470,5 @@ class RaftNode:
                 "id": self.id, "state": self.state, "term": self.current_term,
                 "leader": self.leader_id, "log_len": len(self.log),
                 "commit_index": self.commit_index,
+                "snap_index": self.snap_index,
             }

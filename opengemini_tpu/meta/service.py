@@ -46,8 +46,10 @@ class MetaFSM:
     def __init__(self):
         self.databases: dict[str, dict] = {}
         self.nodes: dict[str, dict] = {}  # node id -> {addr, role}
-        self.users: dict[str, dict] = {}  # name -> {admin} (hashes live
-        # in each replica's UserStore via the listener, not the snapshot)
+        self.users: dict[str, dict] = {}  # name -> {admin, salt, hash,
+        # privileges}: full credential material (pre-hashed at propose
+        # time) so snapshots can rebuild a replica's UserStore; status()
+        # strips salt/hash before anything leaves the process
         self.applied_index = 0
         self.listeners: list = []
         # listener side effects DEFER here: apply() runs under the raft
@@ -65,7 +67,10 @@ class MetaFSM:
         elif op == "create_rp":
             db = self.databases.get(cmd["db"])
             if db is not None:
-                db["rps"][cmd["name"]] = {"duration_ns": cmd.get("duration_ns", 0)}
+                db["rps"][cmd["name"]] = {
+                    "duration_ns": cmd.get("duration_ns", 0),
+                    "shard_duration_ns": cmd.get("shard_duration_ns"),
+                }
                 if cmd.get("default"):
                     db["default_rp"] = cmd["name"]
         elif op == "drop_rp":
@@ -100,9 +105,27 @@ class MetaFSM:
         elif op == "remove_node":
             self.nodes.pop(cmd["id"], None)
         elif op == "create_user":
-            self.users[cmd["name"]] = {"admin": cmd.get("admin", False)}
+            # full credential material (pre-hashed at propose time) lives in
+            # FSM state so a snapshot can rebuild a replica's UserStore
+            self.users[cmd["name"]] = {
+                "admin": cmd.get("admin", False),
+                "salt": cmd.get("salt"), "hash": cmd.get("hash"),
+                "privileges": {},
+            }
         elif op == "drop_user":
             self.users.pop(cmd["name"], None)
+        elif op == "set_password":
+            u = self.users.get(cmd["name"])
+            if u is not None:
+                u["salt"], u["hash"] = cmd.get("salt"), cmd.get("hash")
+        elif op == "grant":
+            u = self.users.get(cmd["user"])
+            if u is not None:
+                u.setdefault("privileges", {})[cmd["db"]] = cmd["privilege"]
+        elif op == "revoke":
+            u = self.users.get(cmd["user"])
+            if u is not None:
+                u.setdefault("privileges", {}).pop(cmd["db"], None)
         elif op == "grant_admin":
             if cmd["user"] in self.users:
                 self.users[cmd["user"]]["admin"] = cmd.get("admin", True)
@@ -112,8 +135,30 @@ class MetaFSM:
             self.pending.append((index, cmd))
 
     def snapshot(self) -> dict:
-        return {"databases": self.databases, "nodes": self.nodes,
-                "users": self.users, "applied_index": self.applied_index}
+        """Deep-copied state for raft compaction (the raft node keeps the
+        result; sharing live dicts would let later applies mutate it)."""
+        import json as _json
+
+        return _json.loads(_json.dumps({
+            "databases": self.databases, "nodes": self.nodes,
+            "users": self.users, "applied_index": self.applied_index,
+        }))
+
+    def restore(self, state: dict) -> None:
+        """Replace FSM state from a snapshot (startup load or
+        InstallSnapshot) and queue a __restore__ event so attached
+        engine/user listeners fully re-sync — their per-op replay can
+        never cover commands that were compacted away."""
+        import json as _json
+
+        state = _json.loads(_json.dumps(state))
+        self.databases = state.get("databases", {})
+        self.nodes = state.get("nodes", {})
+        self.users = state.get("users", {})
+        self.applied_index = state.get("applied_index", 0)
+        self.pending.append(
+            (self.applied_index, {"op": "__restore__", "state": state})
+        )
 
 
 def _marker_io(path: str | None):
@@ -162,16 +207,21 @@ class MetaStore:
     followers redirect via leader_hint()."""
 
     def __init__(self, node_id: str, peers: list[str], transport=None,
-                 storage_path: str | None = None, tick_s: float = 0.05):
+                 storage_path: str | None = None, tick_s: float = 0.05,
+                 compact_threshold: int = 512):
         self.fsm = MetaFSM()
         self.node = RaftNode(
             node_id, peers, transport or LoopbackTransport(),
             apply_fn=self.fsm.apply, storage_path=storage_path,
+            restore_fn=self.fsm.restore,
         )
         self._tick_s = tick_s
+        self._compact_threshold = compact_threshold
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._drain_lock = threading.Lock()
+        self._inflight = 0  # propose_and_wait calls awaiting confirmation
+        self._inflight_lock = threading.Lock()
         self.listener_applied = 0
 
     def start(self) -> None:
@@ -188,6 +238,22 @@ class MetaStore:
         while not self._stop.wait(self._tick_s):
             self.node.tick()
             self.drain_listeners()
+            self.maybe_compact()
+
+    def maybe_compact(self) -> None:
+        """Snapshot + truncate when the log outgrows the threshold. Skipped
+        while any propose_and_wait is confirming: compaction would erase
+        the (index, term) it checks survival against."""
+        if len(self.node.log) <= self._compact_threshold:
+            return
+        with self._inflight_lock:
+            if self._inflight:
+                return
+            # only compact what listeners have fully enacted: a snapshot
+            # index beyond listener progress would strand their side effects
+            if self.fsm.listeners and self.listener_applied < self.node.last_applied:
+                return
+            self.node.take_snapshot(self.fsm.snapshot)
 
     def drain_listeners(self) -> None:
         """Run deferred listener side effects OUTSIDE the raft lock (disk
@@ -218,24 +284,30 @@ class MetaStore:
         be overwritten at the same index by a successor."""
         import time as _t
 
-        got = self.node.propose_with_term(cmd)
-        if got is None:
-            return False
-        idx, term = got
-        deadline = _t.monotonic() + timeout_s
-        while True:
-            self.drain_listeners()
-            if self.node.entry_term(idx) != term:
-                return False  # overwritten after a leader change
-            applied = (
-                self.node.last_applied >= idx
-                and (not self.fsm.listeners or self.listener_applied >= idx)
-            )
-            if applied:
-                return True
-            if _t.monotonic() > deadline:
+        with self._inflight_lock:
+            got = self.node.propose_with_term(cmd)
+            if got is None:
                 return False
-            _t.sleep(0.01)
+            self._inflight += 1
+        idx, term = got
+        try:
+            deadline = _t.monotonic() + timeout_s
+            while True:
+                self.drain_listeners()
+                if self.node.entry_term(idx) != term:
+                    return False  # overwritten after a leader change
+                applied = (
+                    self.node.last_applied >= idx
+                    and (not self.fsm.listeners or self.listener_applied >= idx)
+                )
+                if applied:
+                    return True
+                if _t.monotonic() > deadline:
+                    return False
+                _t.sleep(0.01)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
 
     def attach_engine(self, engine) -> None:
         """Enact replicated DDL on the local storage engine — every
@@ -251,10 +323,75 @@ class MetaStore:
             _os.path.join(engine.root, "meta.applied")
         )
 
+        def _full_sync(state: dict) -> None:
+            """Reconcile the engine to a snapshot's database set: per-op
+            replay can never cover commands compacted into the snapshot.
+            Engine-local dbs starting with '_' (e.g. _internal) are not
+            raft-managed and are left alone."""
+            from opengemini_tpu.services.subscriber import Subscription
+            from opengemini_tpu.storage.engine import (
+                ContinuousQuery, DownsamplePolicy, StreamTask,
+            )
+
+            dbs = state.get("databases", {})
+            # engine._lock is an RLock: hold it across the whole multi-step
+            # reconcile so background CQ/retention/subscriber scans never
+            # observe torn registries mid-restore (nested engine calls
+            # re-enter the same lock)
+            with engine._lock:
+                for name in list(engine.databases):
+                    if name not in dbs and not name.startswith("_"):
+                        engine.drop_database(name)
+                for name, meta in dbs.items():
+                    if name not in engine.databases:
+                        engine.create_database(name)
+                    d = engine.databases[name]
+                    rps = meta.get("rps", {})
+                    for rp, rpmeta in rps.items():
+                        if rp not in d.rps:
+                            engine.create_retention_policy(
+                                name, rp, rpmeta.get("duration_ns", 0),
+                                rpmeta.get("shard_duration_ns"),
+                                rp == meta.get("default_rp"),
+                            )
+                        else:
+                            d.rps[rp].duration_ns = rpmeta.get("duration_ns", 0)
+                    for rp in list(d.rps):
+                        if rp not in rps:
+                            engine.drop_retention_policy(name, rp)
+                    if meta.get("default_rp") in d.rps:
+                        d.default_rp = meta["default_rp"]
+                    # registries replace wholesale, keeping local CQ progress
+                    old_cqs = d.continuous_queries
+                    d.continuous_queries = {}
+                    for n, j in meta.get("cqs", {}).items():
+                        cq = ContinuousQuery.from_json(j)
+                        prev = old_cqs.get(n)
+                        if prev is not None and prev.select_text == cq.select_text:
+                            cq.last_run_ns = prev.last_run_ns
+                        d.continuous_queries[n] = cq
+                    d.streams = {
+                        n: StreamTask.from_json(j)
+                        for n, j in meta.get("streams", {}).items()
+                    }
+                    d.subscriptions = {
+                        n: Subscription.from_json(j)
+                        for n, j in meta.get("subscriptions", {}).items()
+                    }
+                    d.downsample = {
+                        rp: [DownsamplePolicy.from_json(p) for p in pols]
+                        for rp, pols in meta.get("downsample", {}).items()
+                    }
+                engine.save_cq_state()  # persists meta.json (re-entrant lock)
+
         def on_apply(index: int, cmd: dict) -> None:
             if index <= _read_marker():
                 return  # already enacted before a restart
             op = cmd.get("op")
+            if op == "__restore__":
+                _full_sync(cmd["state"])
+                _write_marker(index)
+                return
             if op == "create_database":
                 engine.create_database(cmd["name"])
             elif op == "drop_database":
@@ -322,7 +459,14 @@ class MetaStore:
                     "revoke", "grant_admin"}
 
         def on_apply(index: int, cmd: dict) -> None:
-            if cmd.get("op") not in user_ops:
+            op = cmd.get("op")
+            if op == "__restore__":
+                if index <= _read_marker():
+                    return
+                user_store.restore_replicated(cmd["state"].get("users", {}))
+                _write_marker(index)
+                return
+            if op not in user_ops:
                 return
             if index <= _read_marker():
                 return
@@ -343,6 +487,12 @@ class MetaStore:
         with self.node._lock:  # FSM mutates under this lock (apply_fn)
             s = self.node.status()
             s["fsm"] = copy.deepcopy(self.fsm.snapshot())
+        # never expose credential material (salt/PBKDF2 hash) through the
+        # status surface — /raft/status has no admin gate
+        s["fsm"]["users"] = {
+            n: {"admin": u.get("admin", False)}
+            for n, u in s["fsm"].get("users", {}).items()
+        }
         return s
 
 

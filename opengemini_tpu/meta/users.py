@@ -129,6 +129,21 @@ class UserStore:
 
     # -- replicated application (raft listener path) ---------------------
 
+    def restore_replicated(self, users_state: dict) -> None:
+        """Rebuild the store from an FSM snapshot's user state (full
+        credential material is carried in FSM state exactly so compacted
+        histories can still produce a working replica)."""
+        with self._lock:
+            self.users = {}
+            for name, u in users_state.items():
+                if not u.get("salt") or not u.get("hash"):
+                    continue  # flags-only entry from a pre-credential log
+                self.users[name] = User(
+                    name, u["salt"], u["hash"], u.get("admin", False),
+                    dict(u.get("privileges", {})),
+                )
+            self._save()
+
     def apply_replicated(self, cmd: dict) -> None:
         """Enact a replicated user command carrying pre-computed salt/hash
         (hashes are computed once at propose time so every replica stores

@@ -72,6 +72,7 @@ def build(cfg: dict) -> HttpService:
         svc.meta_store = MetaStore(
             node_id, sorted(set(peers) | {node_id}), transport,
             storage_path=os.path.join(engine.root, "meta.raftlog"),
+            compact_threshold=int(meta_cfg.get("compact-threshold", 512)),
         )
         svc.meta_store.token = token
         svc.meta_store.attach_engine(engine)  # replicated DDL -> local engine

@@ -283,6 +283,8 @@ class TestReplicatedDDL:
             store.fsm = MetaFSM()
             store.node = node
             store._drain_lock = _threading.Lock()
+            store._inflight_lock = _threading.Lock()
+            store._inflight = 0
             store.listener_applied = 0
             node.apply_fn = store.fsm.apply
             store.attach_engine(eng)
@@ -379,6 +381,8 @@ class TestReplicatedUsers:
             store.fsm = MetaFSM()
             store.node = node
             store._drain_lock = _th.Lock()
+            store._inflight_lock = _th.Lock()
+            store._inflight = 0
             store.listener_applied = 0
             node.apply_fn = store.fsm.apply
             store.attach_engine(eng)
@@ -452,6 +456,8 @@ class TestReplicatedRegistries:
             store.fsm = MetaFSM()
             store.node = node
             store._drain_lock = _threading.Lock()
+            store._inflight_lock = _threading.Lock()
+            store._inflight = 0
             store.listener_applied = 0
             node.apply_fn = store.fsm.apply
             store.attach_engine(eng)
@@ -582,3 +588,192 @@ class TestReplicatedRegistries:
         )
         err = res["results"][0].get("error", "")
         assert "not the meta leader" in err and "n9" in err, err
+
+
+class TestSnapshots:
+    def test_compaction_preserves_replication(self, tmp_path):
+        """take_snapshot truncates the applied prefix; proposals keep
+        absolute indices and commit normally afterwards."""
+        bus, nodes, applied = make_cluster(3, tmp_path=tmp_path)
+        leader = elect(bus, nodes)
+        for i in range(10):
+            leader.propose({"op": "x", "i": i})
+            bus.deliver_all()
+        assert leader.commit_index == leader._abs_last()
+        pre_last = leader._abs_last()
+        assert leader.take_snapshot(lambda: {"upto": leader.last_applied})
+        assert leader.snap_index == pre_last
+        assert len(leader.log) == 0
+        # replication continues with absolute indexing intact
+        idx = leader.propose({"op": "x", "i": 99})
+        bus.deliver_all()
+        assert idx == pre_last + 1
+        assert leader.commit_index == idx
+        for _ in range(5):  # commit index reaches followers on heartbeat
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+        for nid, node in nodes.items():
+            assert node.last_applied == idx, nid
+        assert applied[leader.id][-1][0] == idx
+
+    def test_lagging_follower_catches_up_via_install_snapshot(self, tmp_path):
+        bus, nodes, applied = make_cluster(3, tmp_path=tmp_path)
+        leader = elect(bus, nodes)
+        others = [n for n in nodes.values() if n is not leader]
+        slow = others[0]
+        bus.partition(leader.id, slow.id)
+        bus.partition(others[1].id, slow.id)
+        for i in range(20):
+            leader.propose({"op": "x", "i": i})
+            bus.deliver_all()
+        assert leader.take_snapshot(lambda: {"fsm": "state-at-20"})
+        assert slow.last_applied < leader.snap_index
+        restored = []
+        slow.restore_fn = restored.append
+        bus.heal()
+        for _ in range(30):
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+            if slow.last_applied >= leader.snap_index:
+                break
+        assert slow.snap_index == leader.snap_index
+        assert restored == [{"fsm": "state-at-20"}]
+        # and normal replication resumes for the healed follower
+        leader.propose({"op": "y"})
+        bus.deliver_all()
+        for _ in range(5):
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+        assert slow.last_applied == leader.last_applied
+
+    def test_restart_restores_from_snapshot(self, tmp_path):
+        bus, nodes, applied = make_cluster(3, tmp_path=tmp_path)
+        leader = elect(bus, nodes)
+        for i in range(5):
+            leader.propose({"op": "x", "i": i})
+            bus.deliver_all()
+        assert leader.take_snapshot(lambda: {"marker": "snapstate"})
+        # restart: a fresh node on the same storage path restores state
+        restored = []
+        reborn = RaftNode(
+            leader.id, list(nodes), bus, apply_fn=lambda i, c: None,
+            storage_path=leader.storage_path, restore_fn=restored.append,
+        )
+        assert restored == [{"marker": "snapstate"}]
+        assert reborn.snap_index == leader.snap_index
+        assert reborn.last_applied == leader.snap_index
+        assert reborn.commit_index == leader.snap_index
+
+    def test_metastore_snapshot_restores_engine_and_users(self, tmp_path):
+        """End-to-end: a compacted history rebuilds a NEW replica's engine
+        registries and user store through the __restore__ full sync."""
+        import threading as _t
+
+        from opengemini_tpu.meta.users import UserStore
+        from opengemini_tpu.storage.engine import Engine
+
+        fsm = MetaFSM()
+        cmds = [
+            {"op": "create_database", "name": "snapdb"},
+            {"op": "create_rp", "db": "snapdb", "name": "rp1",
+             "duration_ns": 3600 * 10**9},
+            {"op": "create_cq", "db": "snapdb",
+             "cq": {"name": "cq1", "select_text": "SELECT mean(v) INTO x "
+                    "FROM m GROUP BY time(1m)"}},
+            (lambda sh: {"op": "create_user", "name": "alice",
+                         "salt": sh[0], "hash": sh[1], "admin": True})(
+                UserStore.make_credentials("s3cret")),
+            {"op": "grant", "user": "alice", "db": "snapdb",
+             "privilege": "read"},
+        ]
+        for i, c in enumerate(cmds, start=1):
+            fsm.apply(i, c)
+        snap = fsm.snapshot()
+
+        # a brand-new replica restores from that snapshot alone
+        store = MetaStore.__new__(MetaStore)
+        store.fsm = MetaFSM()
+        store._drain_lock = _t.Lock()
+        store.listener_applied = 0
+        eng = Engine(str(tmp_path / "replica"))
+        users = UserStore(str(tmp_path / "users.json"))
+        store.attach_engine(eng)
+        store.attach_users(users)
+        store.fsm.restore(snap)
+        store.drain_listeners()
+
+        assert "snapdb" in eng.databases
+        assert eng.databases["snapdb"].rps["rp1"].duration_ns == 3600 * 10**9
+        assert "cq1" in eng.databases["snapdb"].continuous_queries
+        u = users.users["alice"]
+        assert u.check_password("s3cret") and u.admin
+        assert u.privileges == {"snapdb": "read"}
+        eng.close()
+
+    def test_status_never_leaks_credentials(self, tmp_path):
+        import threading as _t
+
+        from opengemini_tpu.meta.users import UserStore
+
+        store = MetaStore("solo", ["solo"], storage_path=None)
+        salt, h = UserStore.make_credentials("pw")
+        store.fsm.apply(1, {"op": "create_user", "name": "a",
+                            "salt": salt, "hash": h, "admin": True})
+        s = store.status()
+        assert s["fsm"]["users"] == {"a": {"admin": True}}
+        # FSM state itself still carries the material (snapshot needs it)
+        assert store.fsm.users["a"]["salt"] == salt
+
+    def test_snapshot_restores_shard_duration_and_default_rp(self, tmp_path):
+        import threading as _t
+
+        from opengemini_tpu.storage.engine import Engine
+
+        fsm = MetaFSM()
+        fsm.apply(1, {"op": "create_database", "name": "d1"})
+        fsm.apply(2, {"op": "create_rp", "db": "d1", "name": "rp2",
+                      "duration_ns": 10**12,
+                      "shard_duration_ns": 3600 * 10**9, "default": True})
+        snap = fsm.snapshot()
+        store = MetaStore.__new__(MetaStore)
+        store.fsm = MetaFSM()
+        store._drain_lock = _t.Lock()
+        store.listener_applied = 0
+        eng = Engine(str(tmp_path / "r2"))
+        store.attach_engine(eng)
+        store.fsm.restore(snap)
+        store.drain_listeners()
+        d = eng.databases["d1"]
+        assert d.rps["rp2"].shard_duration_ns == 3600 * 10**9
+        assert d.default_rp == "rp2"
+        eng.close()
+
+    def test_snapshot_sidecar_keeps_log_file_small(self, tmp_path):
+        import json as _json
+        import os as _os
+
+        bus, nodes, applied = make_cluster(3, tmp_path=tmp_path)
+        leader = elect(bus, nodes)
+        big_state = {"blob": "x" * 100_000}
+        for i in range(3):
+            leader.propose({"op": "x", "i": i})
+            bus.deliver_all()
+        assert leader.take_snapshot(lambda: big_state)
+        log_file = _os.path.getsize(leader.storage_path)
+        snap_file = _os.path.getsize(leader.storage_path + ".snap")
+        assert snap_file > 100_000 and log_file < 1000
+        # a propose after compaction rewrites only the small log file
+        before = _os.path.getmtime(leader.storage_path + ".snap")
+        leader.propose({"op": "y"})
+        assert _os.path.getmtime(leader.storage_path + ".snap") == before
+        with open(leader.storage_path) as f:
+            assert "blob" not in f.read()
+        # and restart still restores the sidecar state
+        restored = []
+        RaftNode(leader.id, list(nodes), bus, apply_fn=lambda i, c: None,
+                 storage_path=leader.storage_path,
+                 restore_fn=restored.append)
+        assert restored and restored[0]["blob"] == big_state["blob"]
