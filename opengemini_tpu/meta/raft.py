@@ -76,6 +76,10 @@ class RaftNode:
         self._heartbeat_ticks = heartbeat_ticks
         self._ticks_until_election = self._rand_election()
         self._ticks_until_heartbeat = 0
+        # learner: replicates but never campaigns. Set on joining nodes
+        # (until their conf-add commits) and on removed nodes — both would
+        # otherwise self-elect / zombie-campaign with inflated terms.
+        self.learner = False
         if self.snap_state is not None and self.restore_fn:
             self.restore_fn(self.snap_state)
 
@@ -192,6 +196,33 @@ class RaftNode:
         with self._lock:
             return self._term_at(idx)
 
+    def set_peers(self, peers: list[str]) -> None:
+        """Adopt a new peer set (committed conf change). Quorum follows
+        automatically (quorum() derives from len(peers)). A node removed
+        from its own cluster steps down and goes permanently quiet
+        (learner mode — it must never campaign against the live cluster)."""
+        with self._lock:
+            new = [p for p in peers if p != self.id]
+            removed = [p for p in self.peers if p not in new]
+            if self.state == LEADER:
+                # final notify: ship the committed removal to departing
+                # members BEFORE forgetting them, so they learn of their
+                # own removal and stop campaigning (instead of zombieing)
+                for p in removed:
+                    self._send_append(p)
+            self.peers = new
+            for p in removed:
+                self.next_index.pop(p, None)
+                self.match_index.pop(p, None)
+            if self.state == LEADER:
+                for p in new:
+                    self.next_index.setdefault(p, self._abs_last() + 1)
+                    self.match_index.setdefault(p, 0)
+            if self.id not in peers:
+                self.state = FOLLOWER
+                self.leader_id = None
+                self.learner = True
+
     def take_snapshot(self, state_fn) -> bool:
         """Compact the applied log prefix. state_fn() is called UNDER the
         raft lock so the captured state-machine state corresponds exactly
@@ -221,6 +252,9 @@ class RaftNode:
                 return
             self._ticks_until_election -= 1
             if self._ticks_until_election <= 0:
+                if self.learner:
+                    self._ticks_until_election = self._rand_election()
+                    return
                 self._start_election()
 
     # -- election ----------------------------------------------------------
@@ -265,6 +299,13 @@ class RaftNode:
         """Transport entry point for every message type; malformed
         messages are dropped (the HTTP layer also 400s them)."""
         if not self.valid_message(msg):
+            return
+        # a REMOVED member keeps timing out and campaigning with ever
+        # higher terms; ignoring vote traffic from non-members stops it
+        # deposing live leaders (§6 disruption problem). Append/install
+        # from unknown senders stay allowed so a joining node with a
+        # partial seed view can still be caught up by the leader.
+        if msg["type"].startswith("request_vote") and msg["from"] not in self.peers:
             return
         handlers = {
             "request_vote": self._on_request_vote,

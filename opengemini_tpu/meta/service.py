@@ -51,6 +51,7 @@ class MetaFSM:
         # time) so snapshots can rebuild a replica's UserStore; status()
         # strips salt/hash before anything leaves the process
         self.applied_index = 0
+        self.meta_removed: set[str] = set()  # conf-change tombstones
         self.listeners: list = []
         # listener side effects DEFER here: apply() runs under the raft
         # lock and listener work (engine DDL = disk I/O) must not stall
@@ -104,6 +105,18 @@ class MetaFSM:
             self.nodes[cmd["id"]] = {"addr": cmd["addr"], "role": cmd.get("role", "data")}
         elif op == "remove_node":
             self.nodes.pop(cmd["id"], None)
+        elif op == "raft_conf":
+            # single-server membership change (committed-entry semantics —
+            # a simplification of the dissertation's apply-on-append that
+            # is safe one change at a time with a majority up). Removals
+            # leave a tombstone so snapshot restore can subtract members
+            # that were in a replica's static seed config.
+            if cmd.get("action") == "add":
+                self.nodes[cmd["id"]] = {"addr": cmd["addr"], "role": "meta"}
+                self.meta_removed.discard(cmd["id"])
+            else:
+                self.nodes.pop(cmd["id"], None)
+                self.meta_removed.add(cmd["id"])
         elif op == "create_user":
             # full credential material (pre-hashed at propose time) lives in
             # FSM state so a snapshot can rebuild a replica's UserStore
@@ -142,6 +155,7 @@ class MetaFSM:
         return _json.loads(_json.dumps({
             "databases": self.databases, "nodes": self.nodes,
             "users": self.users, "applied_index": self.applied_index,
+            "meta_removed": sorted(self.meta_removed),
         }))
 
     def restore(self, state: dict) -> None:
@@ -156,6 +170,7 @@ class MetaFSM:
         self.nodes = state.get("nodes", {})
         self.users = state.get("users", {})
         self.applied_index = state.get("applied_index", 0)
+        self.meta_removed = set(state.get("meta_removed", []))
         self.pending.append(
             (self.applied_index, {"op": "__restore__", "state": state})
         )
@@ -223,6 +238,77 @@ class MetaStore:
         self._inflight = 0  # propose_and_wait calls awaiting confirmation
         self._inflight_lock = threading.Lock()
         self.listener_applied = 0
+        # live meta membership: seed config ± committed raft_conf changes
+        self._addr_lock = threading.Lock()
+        self._meta_addrs: dict[str, str] = dict(
+            getattr(transport, "addr_of", {}) or {p: "" for p in peers}
+        )
+        self._meta_addrs.setdefault(node_id, "")
+        self._conf_lock = threading.Lock()  # one membership change at a time
+        self.fsm.listeners.append(self._on_conf_change)
+
+    def meta_members(self) -> dict[str, str]:
+        """Snapshot of the membership address book (safe to iterate)."""
+        with self._addr_lock:
+            return dict(self._meta_addrs)
+
+    def _on_conf_change(self, index: int, cmd: dict) -> None:
+        """Adopt committed membership changes: update the address book and
+        the raft peer set (idempotent — safe under restart replay)."""
+        op = cmd.get("op")
+        with self._addr_lock:
+            if op == "raft_conf":
+                if cmd.get("action") == "add":
+                    self._meta_addrs[cmd["id"]] = cmd["addr"]
+                    if cmd["id"] == self.node.id:
+                        self.node.learner = False  # our join committed
+                else:
+                    self._meta_addrs.pop(cmd["id"], None)
+            elif op == "__restore__":
+                state = cmd["state"]
+                for nid, info in state.get("nodes", {}).items():
+                    if info.get("role") == "meta":
+                        self._meta_addrs[nid] = info.get("addr", "")
+                for nid in state.get("meta_removed", []):
+                    self._meta_addrs.pop(nid, None)
+                if self.node.id in state.get("meta_removed", []):
+                    self.node.learner = True
+            else:
+                return
+            members = dict(self._meta_addrs)
+        addr_of = getattr(self.node.transport, "addr_of", None)
+        if addr_of is not None:
+            for nid, addr in members.items():
+                if addr:
+                    addr_of[nid] = addr
+            for nid in list(addr_of):
+                if nid not in members:
+                    addr_of.pop(nid, None)
+        self.node.set_peers(sorted(members))
+
+    def bootstrap_membership(self) -> None:
+        """Record the seed membership in the FSM (leader, once): joiners
+        and snapshot-restored replicas must be able to derive the FULL
+        member set from replicated state alone — a partial seed view would
+        give them a smaller quorum and permit split-brain commits."""
+        if not self.is_leader():
+            return
+        if any(i.get("role") == "meta" for i in self.fsm.nodes.values()):
+            return
+        for nid, addr in sorted(self.meta_members().items()):
+            self.node.propose(
+                {"op": "raft_conf", "action": "add", "id": nid, "addr": addr}
+            )
+
+    def propose_conf_change(self, action: str, nid: str, addr: str = "") -> bool:
+        """Leader-side single-server membership change, serialized: raft's
+        single-server correctness argument requires one change at a time."""
+        with self._conf_lock:
+            if not self.is_leader():
+                return False
+            return self.propose_and_wait(
+                {"op": "raft_conf", "action": action, "id": nid, "addr": addr}
+            )
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -238,6 +324,7 @@ class MetaStore:
         while not self._stop.wait(self._tick_s):
             self.node.tick()
             self.drain_listeners()
+            self.bootstrap_membership()
             self.maybe_compact()
 
     def maybe_compact(self) -> None:
@@ -507,12 +594,16 @@ class HttpTransport:
     config meta.token) authenticates intra-cluster messages."""
 
     def __init__(self, addr_of: dict[str, str], timeout_s: float = 0.5,
-                 token: str = "", max_queue: int = 256):
+                 token: str = "", max_queue: int = 256, self_addr: str = ""):
         import queue
 
         self.addr_of = addr_of
         self.timeout_s = timeout_s
         self.token = token
+        # advertised in every outgoing message so receivers can learn our
+        # address: a joiner only knows its seed, yet must answer the
+        # leader's appends — without this, catch-up deadlocks
+        self.self_addr = self_addr
         self._queues: dict[str, queue.Queue] = {}
         self._lock = threading.Lock()
         self._max_queue = max_queue
@@ -529,19 +620,25 @@ class HttpTransport:
                 q = queue.Queue(maxsize=self._max_queue)
                 self._queues[peer] = q
                 threading.Thread(
-                    target=self._sender, args=(addr, q), daemon=True,
+                    target=self._sender, args=(peer, q), daemon=True,
                     name=f"raft-send-{peer}",
                 ).start()
-        if self.token:
-            msg = dict(msg, token=self.token)
+        if self.token or self.self_addr:
+            msg = dict(msg, token=self.token, addr=self.self_addr)
         try:
             q.put_nowait(msg)
         except queue.Full:
             pass  # drop under backpressure; raft retries via heartbeats
 
-    def _sender(self, addr: str, q) -> None:
+    def _sender(self, peer: str, q) -> None:
         while True:
             msg = q.get()
+            # resolve per message: conf changes can re-address a peer while
+            # this thread lives (a re-joined member would otherwise get
+            # raft traffic at its dead old address forever)
+            addr = self.addr_of.get(peer)
+            if not addr:
+                continue
             try:
                 req = urllib.request.Request(
                     f"http://{addr}/raft/msg", data=json.dumps(msg).encode("utf-8"),

@@ -212,6 +212,25 @@ class Executor:
                                                 ttl_ns=stmt.ttl_ns)
         return {}
 
+    def _show_cluster(self) -> dict:
+        """Reference: SHOW CLUSTER (meta/data node roster with status)."""
+        rows = []
+        if self.meta_store is None:
+            rows.append(["local", "", "meta,data", "leader"])
+        else:
+            leader = self.meta_store.leader_hint()
+            members = self.meta_store.meta_members()
+            for nid in sorted(members):
+                status = "leader" if nid == leader else "follower"
+                rows.append([nid, members[nid], "meta", status])
+            for nid, info in sorted(self.meta_store.fsm.nodes.items()):
+                if info.get("role") == "meta":
+                    continue  # already listed from the membership book
+                rows.append([nid, info.get("addr", ""),
+                             info.get("role", "data"), "registered"])
+        return {"series": [_series("cluster", None,
+                                   ["id", "addr", "role", "status"], rows)]}
+
     def _show_downsamples(self, stmt, db: str) -> dict:
         tgt = stmt.database or db
         d = self.engine.databases.get(tgt)
@@ -483,6 +502,8 @@ class Executor:
             return {}
         if isinstance(stmt, ast.ShowDownsamples):
             return self._show_downsamples(stmt, db)
+        if isinstance(stmt, ast.ShowCluster):
+            return self._show_cluster()
         if isinstance(stmt, ast.DropSubscription):
             tgt = stmt.database or db
             if not self._replicate_ddl({"op": "drop_subscription", "db": tgt,

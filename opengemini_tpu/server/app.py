@@ -68,7 +68,10 @@ def build(cfg: dict) -> HttpService:
             peers[pid] = addr
         node_id = meta_cfg["node-id"]
         token = meta_cfg.get("token", "")
-        transport = HttpTransport(peers, token=token)
+        transport = HttpTransport(
+            peers, token=token,
+            self_addr=meta_cfg.get("advertise", cfg["http"]["bind-address"]),
+        )
         svc.meta_store = MetaStore(
             node_id, sorted(set(peers) | {node_id}), transport,
             storage_path=os.path.join(engine.root, "meta.raftlog"),
@@ -78,9 +81,55 @@ def build(cfg: dict) -> HttpService:
         svc.meta_store.attach_engine(engine)  # replicated DDL -> local engine
         svc.meta_store.attach_users(svc.users)  # replicated user commands
         svc.executor.meta_store = svc.meta_store
+        if meta_cfg.get("join"):
+            # passive until our conf-add commits: a joiner must never
+            # self-elect off its partial seed view
+            svc.meta_store.node.learner = True
         svc.meta_store.start()
+        if meta_cfg.get("join"):
+            # new node: ask the existing cluster's leader to add us, then
+            # raft catches us up (snapshot or log) automatically
+            _spawn_joiner(
+                meta_cfg["join"], node_id,
+                meta_cfg.get("advertise", cfg["http"]["bind-address"]), token,
+            )
     svc.services = _build_services(cfg, svc)
     return svc
+
+
+def _spawn_joiner(seed: str, node_id: str, addr: str, token: str) -> None:
+    import json as _json
+    import urllib.request as _rq
+
+    def run():
+        import time as _time
+
+        target = seed
+        body = {"id": node_id, "addr": addr, "token": token}
+        for _ in range(120):
+            try:
+                req = _rq.Request(
+                    f"http://{target}/raft/join",
+                    data=_json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"}, method="POST",
+                )
+                with _rq.urlopen(req, timeout=3) as r:
+                    if r.status == 200:
+                        print(f"joined meta cluster via {target}", flush=True)
+                        return
+            except OSError as e:
+                # a 409 from a follower carries the leader's address
+                if hasattr(e, "read"):
+                    try:
+                        hint = _json.loads(e.read()).get("leader_addr")
+                        if hint:
+                            target = hint
+                    except Exception:  # noqa: BLE001
+                        target = seed
+            _time.sleep(1)
+        print("meta join failed after retries", flush=True)
+
+    threading.Thread(target=run, daemon=True, name="meta-join").start()
 
 
 def _build_services(cfg: dict, svc: HttpService) -> list:

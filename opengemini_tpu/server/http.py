@@ -247,8 +247,48 @@ def _make_handler(svc: HttpService):
                     self._send_json(403, {"error": "bad cluster token"})
                     return
                 msg.pop("token", None)
+                sender_addr = msg.pop("addr", None)
+                if sender_addr:
+                    # learn the sender's reachable address (token already
+                    # verified): lets a joiner answer a leader it has
+                    # never seen in config
+                    transport = svc.meta_store.node.transport
+                    addr_of = getattr(transport, "addr_of", None)
+                    if addr_of is not None:
+                        addr_of[msg["from"]] = sender_addr
                 svc.meta_store.node.deliver(msg)
                 self._send(204)
+            elif path in ("/raft/join", "/raft/remove") and svc.meta_store is not None:
+                try:
+                    req = json.loads(self._body())
+                except ValueError:
+                    req = None
+                if not isinstance(req, dict) or not req.get("id"):
+                    self._send_json(400, {"error": "id required"})
+                    return
+                token = getattr(svc.meta_store, "token", "")
+                if token and req.get("token") != token:
+                    self._send_json(403, {"error": "bad cluster token"})
+                    return
+                if not svc.meta_store.is_leader():
+                    hint = svc.meta_store.leader_hint()
+                    self._send_json(
+                        409, {"error": "not the meta leader", "leader": hint,
+                              "leader_addr": svc.meta_store.meta_members().get(
+                                  hint, "")})
+                    return
+                if path == "/raft/join":
+                    if not req.get("addr"):
+                        self._send_json(400, {"error": "addr required"})
+                        return
+                    ok = svc.meta_store.propose_conf_change(
+                        "add", req["id"], req["addr"])
+                else:
+                    ok = svc.meta_store.propose_conf_change("remove", req["id"])
+                if ok:
+                    self._send_json(200, {"ok": True})
+                else:
+                    self._send_json(503, {"error": "conf change failed"})
             elif path == "/debug/ctrl":
                 self._handle_syscontrol(params)
             else:

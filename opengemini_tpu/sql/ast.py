@@ -386,6 +386,11 @@ class ShowDownsamples:
 
 
 @dataclass
+class ShowCluster:
+    pass
+
+
+@dataclass
 class ShowQueries:
     pass
 

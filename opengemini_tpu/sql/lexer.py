@@ -24,6 +24,7 @@ KEYWORDS = {
     "stream", "streams", "delay", "shards", "stats", "diagnostics",
     "subscription", "subscriptions", "destinations", "any", "kill",
     "downsample", "downsamples", "ttl", "sampleinterval", "timeinterval",
+    "cluster",
 }
 
 _DUR_RE = re.compile(r"(\d+)(ns|u|µ|us|ms|s|m|h|d|w)")

@@ -514,6 +514,8 @@ class Parser:
             return ast.ShowSubscriptions()
         if kw.val == "queries":
             return ast.ShowQueries()
+        if kw.val == "cluster":
+            return ast.ShowCluster()
         if kw.val == "downsamples":
             stmt = ast.ShowDownsamples()
             if self._accept_kw("on"):

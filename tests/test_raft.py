@@ -777,3 +777,199 @@ class TestSnapshots:
                  storage_path=leader.storage_path,
                  restore_fn=restored.append)
         assert restored and restored[0]["blob"] == big_state["blob"]
+
+
+class TestMembership:
+    def _mk_store(self, node, tmp_path=None):
+        import threading as _t
+
+        store = MetaStore.__new__(MetaStore)
+        store.fsm = MetaFSM()
+        store.node = node
+        store._drain_lock = _t.Lock()
+        store._inflight_lock = _t.Lock()
+        store._inflight = 0
+        store._conf_lock = _t.Lock()
+        store._addr_lock = _t.Lock()
+        store.listener_applied = 0
+        store._meta_addrs = {nid: "" for nid in ["n0", "n1", "n2"]}
+        store.fsm.listeners.append(store._on_conf_change)
+        node.apply_fn = store.fsm.apply
+        return store
+
+    def test_add_node_grows_quorum_and_catches_up(self, tmp_path):
+        bus, nodes, applied = make_cluster(3, tmp_path=tmp_path)
+        stores = {nid: self._mk_store(n, tmp_path) for nid, n in nodes.items()}
+        leader = elect(bus, nodes)
+        lstore = stores[leader.id]
+        for i in range(5):
+            leader.propose({"op": "x", "i": i})
+            bus.deliver_all()
+        # bring up n3 with only a seed view; the conf change reaches it
+        n3 = RaftNode("n3", ["n0", "n1", "n2", "n3"], bus,
+                      apply_fn=lambda i, c: None,
+                      storage_path=str(tmp_path / "n3.raftlog"))
+        s3 = self._mk_store(n3)
+        s3._meta_addrs = {nid: "" for nid in ["n0", "n1", "n2", "n3"]}
+        bus.nodes["n3"] = n3
+        nodes["n3"] = n3
+        assert leader.propose({"op": "raft_conf", "action": "add",
+                               "id": "n3", "addr": "h:1"}) is not None
+        for _ in range(10):
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+            for st in list(stores.values()) + [s3]:
+                st.drain_listeners()
+        assert sorted(leader.peers) == ["n1", "n2", "n3"] or sorted(
+            leader.peers) == ["n0", "n1", "n3"] or sorted(
+            leader.peers) == ["n0", "n2", "n3"]
+        assert leader.quorum() == 3  # 4-node cluster
+        assert n3.last_applied == leader.last_applied  # caught up
+        # the new member participates in commits
+        leader.propose({"op": "y"})
+        for _ in range(5):
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+        assert n3.last_applied == leader.last_applied
+
+    def test_removed_node_steps_down_and_quorum_shrinks(self, tmp_path):
+        bus, nodes, applied = make_cluster(3, tmp_path=tmp_path)
+        stores = {nid: self._mk_store(n, tmp_path) for nid, n in nodes.items()}
+        leader = elect(bus, nodes)
+        victim = next(n for n in nodes.values() if n is not leader)
+        assert leader.propose({"op": "raft_conf", "action": "remove",
+                               "id": victim.id}) is not None
+        for _ in range(10):
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+            for st in stores.values():
+                st.drain_listeners()
+        assert victim.id not in leader.peers
+        assert leader.quorum() == 2  # 2-node cluster now
+        # the final-notify append delivered the removal to the victim:
+        # it applied it, stepped down, and went permanently quiet
+        assert victim.learner and victim.state == FOLLOWER
+        assert victim.id not in stores[leader.id]._meta_addrs
+
+    def test_tombstone_survives_snapshot_restore(self, tmp_path):
+        """A member removed before compaction must not resurrect in the
+        address book of a replica restored from the snapshot."""
+        fsm = MetaFSM()
+        fsm.apply(1, {"op": "raft_conf", "action": "add", "id": "n9",
+                      "addr": "h:9"})
+        fsm.apply(2, {"op": "raft_conf", "action": "remove", "id": "n1"})
+        snap = fsm.snapshot()
+        assert snap["meta_removed"] == ["n1"]
+
+        import threading as _t
+
+        class _FakeNode:
+            id = "n0"
+            peers = []
+            transport = None
+
+            def set_peers(self, p):
+                self.peers = [x for x in p if x != self.id]
+
+        store = MetaStore.__new__(MetaStore)
+        store.fsm = MetaFSM()
+        store.node = _FakeNode()
+        store._drain_lock = _t.Lock()
+        store._addr_lock = _t.Lock()
+        store.listener_applied = 0
+        store._meta_addrs = {"n0": "", "n1": "", "n2": ""}
+        store.fsm.listeners.append(store._on_conf_change)
+        store.fsm.restore(snap)
+        store.drain_listeners()
+        assert "n1" not in store._meta_addrs  # tombstone applied
+        assert store._meta_addrs.get("n9") == "h:9"  # conf-added member
+        assert "n1" not in store.node.peers
+
+    def test_removed_node_cannot_disrupt_cluster(self, tmp_path):
+        """A removed member campaigning with inflated terms must not
+        depose the live leader (vote traffic from non-members ignored)."""
+        bus, nodes, applied = make_cluster(3, tmp_path=tmp_path)
+        stores = {nid: self._mk_store(n, tmp_path) for nid, n in nodes.items()}
+        leader = elect(bus, nodes)
+        victim = next(n for n in nodes.values() if n is not leader)
+        leader.propose({"op": "raft_conf", "action": "remove",
+                        "id": victim.id})
+        for _ in range(10):
+            for n in nodes.values():
+                n.tick()
+            bus.deliver_all()
+            for st in stores.values():
+                st.drain_listeners()
+        term_before = leader.current_term
+        # victim learned of its removal (final notify) -> learner: its
+        # election timer fires forever without ever campaigning
+        assert victim.learner
+        for _ in range(100):
+            victim.tick()
+            bus.deliver_all()
+        assert victim.current_term == term_before  # silent, no term growth
+        assert leader.state == LEADER
+        assert leader.current_term == term_before
+
+    def test_learner_never_self_elects(self, tmp_path):
+        """A joining node with only a partial seed view must stay passive
+        until its conf-add commits (no single-node self-election)."""
+        bus = Bus()
+        lone = RaftNode("n9", ["n9"], bus, apply_fn=lambda i, c: None)
+        lone.learner = True
+        bus.nodes["n9"] = lone
+        for _ in range(100):
+            lone.tick()
+            bus.deliver_all()
+        assert lone.state == FOLLOWER and lone.current_term == 0
+
+    def test_bootstrap_membership_records_seed_once(self, tmp_path):
+        store = MetaStore("solo", ["solo", "other"], storage_path=None)
+        store._meta_addrs = {"solo": "h:1", "other": "h:2"}
+        # make it leader (single-node quorum over {solo,other} needs 2;
+        # force leadership directly for the unit test)
+        store.node.peers = []
+        for _ in range(50):
+            store.node.tick()
+            if store.node.state == LEADER:
+                break
+        assert store.node.state == LEADER
+        store.node.peers = ["other"]
+        store.bootstrap_membership()
+        store.drain_listeners()
+        metas = {nid for nid, i in store.fsm.nodes.items()
+                 if i.get("role") == "meta"}
+        assert metas == set()  # not committed yet (no quorum with 'other')
+        # single-node path: commits immediately
+        store2 = MetaStore("solo", ["solo"], storage_path=None)
+        store2._meta_addrs = {"solo": "h:1"}
+        for _ in range(50):
+            store2.node.tick()
+            if store2.node.state == LEADER:
+                break
+        store2.bootstrap_membership()
+        store2.drain_listeners()
+        assert {n for n, i in store2.fsm.nodes.items()
+                if i.get("role") == "meta"} == {"solo"}
+        before = len(store2.node.log)
+        store2.bootstrap_membership()  # idempotent: no second batch
+        assert len(store2.node.log) == before
+
+    def test_transport_advertises_sender_addr(self):
+        """Outgoing raft messages carry the sender's address so receivers
+        (e.g. a leader unknown to a fresh joiner) become reachable."""
+        import queue as _q
+
+        from opengemini_tpu.meta.service import HttpTransport
+
+        t = HttpTransport({"p": "h:1"}, token="tk", self_addr="me:9")
+        sent = _q.Queue()
+        t._queues["p"] = sent
+        import threading as _t2
+        t._lock = _t2.Lock()
+        t.send("p", {"type": "append_entries", "from": "me"})
+        msg = sent.get_nowait()
+        assert msg["addr"] == "me:9" and msg["token"] == "tk"
