@@ -52,6 +52,10 @@ class MetaFSM:
         # strips salt/hash before anything leaves the process
         self.applied_index = 0
         self.meta_removed: set[str] = set()  # conf-change tombstones
+        # raft members live separately from the data-node roster: the
+        # all-in-one server registers the SAME id in both roles, and one
+        # dict keyed by id would let each registration clobber the other
+        self.meta_nodes: dict[str, str] = {}  # id -> addr
         self.listeners: list = []
         # listener side effects DEFER here: apply() runs under the raft
         # lock and listener work (engine DDL = disk I/O) must not stall
@@ -112,10 +116,10 @@ class MetaFSM:
             # leave a tombstone so snapshot restore can subtract members
             # that were in a replica's static seed config.
             if cmd.get("action") == "add":
-                self.nodes[cmd["id"]] = {"addr": cmd["addr"], "role": "meta"}
+                self.meta_nodes[cmd["id"]] = cmd["addr"]
                 self.meta_removed.discard(cmd["id"])
             else:
-                self.nodes.pop(cmd["id"], None)
+                self.meta_nodes.pop(cmd["id"], None)
                 self.meta_removed.add(cmd["id"])
         elif op == "create_user":
             # full credential material (pre-hashed at propose time) lives in
@@ -156,6 +160,7 @@ class MetaFSM:
             "databases": self.databases, "nodes": self.nodes,
             "users": self.users, "applied_index": self.applied_index,
             "meta_removed": sorted(self.meta_removed),
+            "meta_nodes": self.meta_nodes,
         }))
 
     def restore(self, state: dict) -> None:
@@ -171,6 +176,7 @@ class MetaFSM:
         self.users = state.get("users", {})
         self.applied_index = state.get("applied_index", 0)
         self.meta_removed = set(state.get("meta_removed", []))
+        self.meta_nodes = state.get("meta_nodes", {})
         self.pending.append(
             (self.applied_index, {"op": "__restore__", "state": state})
         )
@@ -266,9 +272,8 @@ class MetaStore:
                     self._meta_addrs.pop(cmd["id"], None)
             elif op == "__restore__":
                 state = cmd["state"]
-                for nid, info in state.get("nodes", {}).items():
-                    if info.get("role") == "meta":
-                        self._meta_addrs[nid] = info.get("addr", "")
+                for nid, addr in state.get("meta_nodes", {}).items():
+                    self._meta_addrs[nid] = addr
                 for nid in state.get("meta_removed", []):
                     self._meta_addrs.pop(nid, None)
                 if self.node.id in state.get("meta_removed", []):
@@ -293,7 +298,7 @@ class MetaStore:
         give them a smaller quorum and permit split-brain commits."""
         if not self.is_leader():
             return
-        if any(i.get("role") == "meta" for i in self.fsm.nodes.values()):
+        if self.fsm.meta_nodes:
             return
         for nid, addr in sorted(self.meta_members().items()):
             self.node.propose(

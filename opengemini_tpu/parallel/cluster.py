@@ -1,0 +1,300 @@
+"""Multi-node data plane: shard-group placement, write routing, remote
+scans.
+
+Reference: coordinator/points_writer.go (MapShards + WritePointRows shard
+routing) and the coordinator select exchange (remote readers feeding the
+executor). The TPU-first twist: peers only ever SERVE raw columns over
+/internal/scan — every aggregation runs on the coordinating node's
+device. The chip is the compute plane; other nodes are storage.
+
+Placement is rendezvous (HRW) hashing over the registered data nodes:
+stable under node add/remove (only ~1/N of groups move), no ring state
+to replicate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import urllib.request
+
+import numpy as np
+
+from opengemini_tpu.index.inverted import SeriesIndex
+from opengemini_tpu.record import Column, FieldType, Record
+
+
+def owner(nodes: list[str], db: str, rp: str, group_start: int) -> str:
+    """Rendezvous hash: the node with the highest keyed digest owns the
+    shard group (deterministic on every node, no coordination)."""
+    best, best_score = None, -1
+    for n in sorted(nodes):
+        h = hashlib.blake2b(
+            f"{n}|{db}|{rp}|{group_start}".encode(), digest_size=8
+        ).digest()
+        score = int.from_bytes(h, "big")
+        if score > best_score:
+            best, best_score = n, score
+    return best
+
+
+class RemoteScanError(Exception):
+    """A data node required for a complete answer was unreachable."""
+
+
+class _RemoteMem:
+    """Memtable stand-in: carries the remote data range so the executor's
+    data-driven range clamp sees remote extents; never holds rows."""
+
+    def __init__(self, min_time, max_time):
+        self.min_time = min_time
+        self.max_time = max_time
+
+    def record_for(self, sid):
+        return None
+
+
+class RemoteShard:
+    """In-memory shard proxy built from a peer's /internal/scan response.
+
+    Duck-types the slice of the Shard surface the query paths touch
+    (index / schema / read_series / measurements / file_chunks / mem);
+    the pre-aggregation fast path is disabled for remote data
+    (supports_preagg) because chunk metadata never leaves the owner.
+    """
+
+    supports_preagg = False
+
+    def __init__(self, mst: str, payload: dict):
+        self.index = SeriesIndex()  # in-memory
+        self._mst = mst
+        self._schema: dict[str, FieldType] = {
+            name: FieldType[t] for name, t in payload.get("schema", {}).items()
+        }
+        self._records: dict[int, Record] = {}
+        tmin = tmax = None
+        for s in payload.get("series", []):
+            tags = tuple((k, v) for k, v in sorted(s["tags"].items()))
+            sid = self.index.get_or_create(mst, tags)
+            times = np.asarray(s["times"], dtype=np.int64)
+            cols = {}
+            for name, col in s.get("fields", {}).items():
+                ftype = FieldType[col["type"]]
+                if ftype == FieldType.STRING:
+                    values = np.asarray(col["values"], dtype=object)
+                elif ftype == FieldType.INT:
+                    values = np.asarray(col["values"], dtype=np.int64)
+                elif ftype == FieldType.BOOL:
+                    values = np.asarray(col["values"], dtype=bool)
+                else:
+                    values = np.asarray(col["values"], dtype=np.float64)
+                valid = np.asarray(col["valid"], dtype=bool)
+                cols[name] = Column(ftype, values, valid)
+            self._records[sid] = Record(times, cols)
+            if len(times):
+                t0, t1 = int(times[0]), int(times[-1])
+                tmin = t0 if tmin is None else min(tmin, t0)
+                tmax = t1 if tmax is None else max(tmax, t1)
+        self.tmin = tmin if tmin is not None else 0
+        self.tmax = (tmax + 1) if tmax is not None else 0
+        self.mem = _RemoteMem(tmin, tmax)
+
+    def measurements(self):
+        return [self._mst] if self._records else []
+
+    def schema(self, mst):
+        return dict(self._schema) if mst == self._mst else {}
+
+    def file_chunks(self, mst, sids=None, tmin=None, tmax=None):
+        return []
+
+    def read_series(self, mst, sid, tmin=None, tmax=None, fields=None):
+        rec = self._records.get(sid)
+        if rec is None or mst != self._mst:
+            return Record.empty()
+        times = rec.times
+        lo = 0 if tmin is None else int(np.searchsorted(times, tmin, "left"))
+        hi = len(times) if tmax is None else int(np.searchsorted(times, tmax, "left"))
+        cols = {
+            k: Column(c.ftype, c.values[lo:hi], c.valid[lo:hi])
+            for k, c in rec.columns.items()
+            if fields is None or k in fields
+        }
+        return Record(times[lo:hi], cols)
+
+
+def serialize_series(engine, db, rp, mst, tmin, tmax) -> dict:
+    """Owner-side /internal/scan body: every series of `mst` in range,
+    merged across local shards (shards are disjoint in time, memtable
+    merged per shard by read_series)."""
+    shards = engine.shards_for_range(db, rp, tmin, tmax)
+    schema: dict[str, str] = {}
+    by_key: dict[tuple, dict] = {}
+    for sh in sorted(shards, key=lambda s: s.tmin):
+        for name, ftype in sh.schema(mst).items():
+            schema.setdefault(name, ftype.name)
+        for sid in sorted(sh.index.series_ids(mst)):
+            rec = sh.read_series(mst, sid, tmin, tmax)
+            if len(rec) == 0:
+                continue
+            tags = sh.index.tags_of(sid)
+            key = tuple(sorted(tags.items()))
+            entry = by_key.setdefault(
+                key, {"tags": dict(tags), "times": [], "fields": {}}
+            )
+            base = len(entry["times"])
+            entry["times"].extend(int(t) for t in rec.times)
+            for name, col in rec.columns.items():
+                f = entry["fields"].setdefault(
+                    name, {"type": col.ftype.name, "values": [], "valid": []}
+                )
+                # pad fields that appeared late in this series
+                pad = base - len(f["values"])
+                if pad > 0:
+                    f["values"].extend([0] * pad)
+                    f["valid"].extend([False] * pad)
+                vals = col.values.tolist()
+                f["values"].extend(
+                    v if b else 0 for v, b in zip(vals, col.valid.tolist())
+                )
+                f["valid"].extend(bool(b) for b in col.valid.tolist())
+            # pad fields missing from this shard's chunk
+            n = len(entry["times"])
+            for f in entry["fields"].values():
+                if len(f["values"]) < n:
+                    pad = n - len(f["values"])
+                    f["values"].extend([0] * pad)
+                    f["valid"].extend([False] * pad)
+    return {"schema": schema, "series": list(by_key.values())}
+
+
+class DataRouter:
+    """Coordinator-side routing: which node owns a shard group, forward
+    writes there, and pull raw columns back for queries."""
+
+    def __init__(self, engine, meta_store, self_id: str, self_addr: str,
+                 token: str = "", timeout_s: float = 10.0):
+        self.engine = engine
+        self.meta_store = meta_store
+        self.self_id = self_id
+        self.self_addr = self_addr
+        self.token = token
+        self.timeout_s = timeout_s
+
+    def data_nodes(self) -> dict[str, str]:
+        nodes = {
+            nid: info.get("addr", "")
+            for nid, info in self.meta_store.fsm.nodes.items()
+            if info.get("role") == "data"
+        }
+        nodes.setdefault(self.self_id, self.self_addr)
+        return nodes
+
+    def _group_start(self, db: str, rp: str | None, t_ns: int) -> int:
+        from opengemini_tpu.storage.engine import DatabaseNotFound, WriteError
+
+        d = self.engine.databases.get(db)
+        if d is None:
+            raise DatabaseNotFound(db)
+        rp_meta = d.rps.get(rp or d.default_rp)
+        if rp_meta is None:
+            raise WriteError(f"retention policy not found: {db}.{rp}")
+        dur = rp_meta.shard_duration_ns
+        return t_ns // dur * dur
+
+    def split_points(self, db: str, rp: str | None, points: list):
+        """points -> (local, {node_id: [points]}) by shard-group owner."""
+        from opengemini_tpu.storage.engine import DatabaseNotFound
+
+        d = self.engine.databases.get(db)
+        if d is None:
+            raise DatabaseNotFound(db)
+        rp_name = rp or d.default_rp
+        nodes = self.data_nodes()
+        ids = sorted(nodes)
+        local, remote = [], {}
+        for p in points:
+            o = owner(ids, db, rp_name, self._group_start(db, rp, p[2]))
+            if o == self.self_id:
+                local.append(p)
+            else:
+                remote.setdefault(o, []).append(p)
+        return local, remote
+
+    def forward_write(self, node_id: str, db: str, rp: str | None,
+                      lines: str) -> None:
+        from urllib.parse import quote
+
+        addr = self.data_nodes().get(node_id, "")
+        if not addr:
+            raise RemoteScanError(f"no address for data node {node_id!r}")
+        url = f"http://{addr}/write?db={quote(db, safe='')}"
+        if rp:
+            url += f"&rp={quote(rp, safe='')}"
+        req = urllib.request.Request(
+            url, data=lines.encode("utf-8"),
+            headers={"X-Ogt-Internal": "1", "X-Ogt-Token": self.token},
+            method="POST",
+        )
+        urllib.request.urlopen(req, timeout=self.timeout_s).read()
+
+    def _post(self, addr: str, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            f"http://{addr}{path}",
+            data=json.dumps(dict(body, token=self.token)).encode("utf-8"),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            return json.loads(r.read())
+
+    def fetch_remote_shards(self, db: str, rp: str | None, mst: str,
+                            tmin: int, tmax: int) -> list[RemoteShard]:
+        """One RemoteShard per peer holding matching data. Unreachable
+        peers raise: a silently partial answer is a wrong answer."""
+        def fetch(nid, addr):
+            if not addr:
+                raise RemoteScanError(f"no address for data node {nid!r}")
+            try:
+                return self._post(addr, "/internal/scan", {
+                    "db": db, "rp": rp, "mst": mst,
+                    "tmin": tmin, "tmax": tmax,
+                })
+            except OSError as e:
+                raise RemoteScanError(
+                    f"data node {nid!r} ({addr}) unreachable: {e}"
+                ) from e
+
+        out = []
+        for payload in self._fanout(fetch):
+            if payload.get("series"):
+                out.append(RemoteShard(mst, payload))
+        return out
+
+    def _fanout(self, fetch):
+        """Run fetch(nid, addr) against every peer concurrently; one slow
+        peer bounds latency instead of summing across the cluster."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        peers = [(nid, addr) for nid, addr in sorted(self.data_nodes().items())
+                 if nid != self.self_id]
+        if not peers:
+            return []
+        with ThreadPoolExecutor(max_workers=min(16, len(peers))) as pool:
+            return list(pool.map(lambda p: fetch(*p), peers))
+
+    def remote_measurements(self, db: str, rp: str | None) -> set[str]:
+        def fetch(nid, addr):
+            if not addr:
+                return {}
+            try:
+                return self._post(addr, "/internal/measurements",
+                                  {"db": db, "rp": rp})
+            except OSError as e:
+                raise RemoteScanError(
+                    f"data node {nid!r} ({addr}) unreachable: {e}"
+                ) from e
+
+        names: set[str] = set()
+        for payload in self._fanout(fetch):
+            names.update(payload.get("measurements", []))
+        return names

@@ -118,6 +118,9 @@ class Executor:
         self.auth_enabled = auth_enabled
         # when clustered, database/RP/user DDL replicates through raft
         self.meta_store = meta_store
+        # multi-node data plane (parallel/cluster.DataRouter): peers serve
+        # raw columns, aggregation stays on this node's device
+        self.router = None
         # serializes leader-side user DDL: check-then-propose must not race
         # across HTTP threads (duplicate CREATE USER would silently replace
         # the first user's credentials)
@@ -224,8 +227,6 @@ class Executor:
                 status = "leader" if nid == leader else "follower"
                 rows.append([nid, members[nid], "meta", status])
             for nid, info in sorted(self.meta_store.fsm.nodes.items()):
-                if info.get("role") == "meta":
-                    continue  # already listed from the membership book
                 rows.append([nid, info.get("addr", ""),
                              info.get("role", "data"), "registered"])
         return {"series": [_series("cluster", None,
@@ -909,6 +910,12 @@ class Executor:
             for m in sh.measurements():
                 if rx.search(m):
                     names.add(m)
+        if self.router is not None:
+            try:
+                remote = self.router.remote_measurements(db, src.rp or None)
+            except Exception as e:  # noqa: BLE001
+                raise QueryError(str(e)) from e
+            names.update(m for m in remote if rx.search(m))
         return sorted(names)
 
     def _select_measurement(self, stmt, db, rp, mst, now_ns, trace=tracing.NOOP) -> list[dict]:
@@ -930,12 +937,27 @@ class Executor:
 
     # -- shared scan planning ----------------------------------------------
 
+    def _all_shards_with_remote(self, db, rp, mst, condition, now_ns):
+        """Local shards + RemoteShard proxies from peer data nodes (when
+        clustered routing is on). The remote fetch is bounded by the
+        query's own time range, extracted before tag keys are known."""
+        shards = self.engine.shards_for_range(db, rp, cond.MIN_TIME, cond.MAX_TIME)
+        if self.router is not None:
+            pre = cond.split(condition, set(), now_ns)
+            try:
+                shards = shards + self.router.fetch_remote_shards(
+                    db, rp, mst, pre.tmin, pre.tmax
+                )
+            except Exception as e:  # noqa: BLE001 — partial data = wrong data
+                raise QueryError(str(e)) from e
+        return shards
+
     def _scan_context(self, stmt, db, rp, mst, now_ns):
         """Shared prologue of every select path: schema/tag keys, WHERE
         split, shard mapping, data-driven range clamp, window grid, group
         construction (reference: the Prepare + MapShards steps,
         SURVEY.md §3.2). Returns None when nothing matches."""
-        shards_all = self.engine.shards_for_range(db, rp, cond.MIN_TIME, cond.MAX_TIME)
+        shards_all = self._all_shards_with_remote(db, rp, mst, stmt.condition, now_ns)
         tag_keys: set[str] = set()
         schema: dict[str, FieldType] = {}
         for sh in shards_all:
@@ -943,7 +965,7 @@ class Executor:
             schema.update(sh.schema(mst))
         sc = cond.split(stmt.condition, tag_keys, now_ns)
         tmin, tmax = sc.tmin, sc.tmax
-        shards = self.engine.shards_for_range(db, rp, tmin, tmax)
+        shards = [sh for sh in shards_all if sh.tmax > tmin and sh.tmin < tmax]
         if not shards:
             return None
         # data-driven clamp of an unbounded range (influx uses epoch 0/now)
@@ -1069,6 +1091,8 @@ class Executor:
             not group_time
             and sc.field_expr is None
             and all(spec.name in ("count", "sum", "mean") for _c, spec, _p, _f in aggs)
+            # remote proxies carry no chunk metadata: full decode for them
+            and all(getattr(sh, "supports_preagg", False) for sh in shards)
         )
         # pre-agg accumulators: int64 for INT fields (stored vsum values are
         # exact python ints), float64 otherwise
@@ -1593,7 +1617,7 @@ class Executor:
     # -- raw path -----------------------------------------------------------
 
     def _select_raw(self, stmt, db, rp, mst, now_ns) -> list[dict]:
-        shards_all = self.engine.shards_for_range(db, rp, cond.MIN_TIME, cond.MAX_TIME)
+        shards_all = self._all_shards_with_remote(db, rp, mst, stmt.condition, now_ns)
         tag_keys: set[str] = set()
         schema: dict[str, FieldType] = {}
         for sh in shards_all:
@@ -1602,7 +1626,7 @@ class Executor:
         if not schema:
             return []
         sc = cond.split(stmt.condition, tag_keys, now_ns)
-        shards = self.engine.shards_for_range(db, rp, sc.tmin, sc.tmax)
+        shards = [sh for sh in shards_all if sh.tmax > sc.tmin and sh.tmin < sc.tmax]
         if not shards:
             return []
 
@@ -1691,6 +1715,11 @@ class Executor:
         names: set[str] = set()
         for sh in self._all_shards_db(db):
             names.update(sh.measurements())
+        if self.router is not None:
+            try:
+                names.update(self.router.remote_measurements(db, None))
+            except Exception as e:  # noqa: BLE001
+                raise QueryError(str(e)) from e
         if stmt.regex:
             rx = re.compile(stmt.regex)
             names = {n for n in names if rx.search(n)}
@@ -1801,6 +1830,11 @@ def _series_needs_merged_decode(sh, mst, sid, tmin, tmax):
     series needs the merged read_series view when memtable rows overlap
     the range or its chunks overlap each other (last-write-wins dedup).
     Returns (needs_merge, chunk_sources)."""
+    if not getattr(sh, "supports_preagg", False):
+        # remote proxies expose no chunk metadata: always take the merged
+        # read_series view (returning (False, []) here would silently
+        # DROP the remote data from the fast paths)
+        return True, None
     mem_rec = sh.mem.record_for(sid)
     if mem_rec is not None and len(mem_rec.slice_time(tmin, tmax)):
         return True, None

@@ -93,8 +93,62 @@ def build(cfg: dict) -> HttpService:
                 meta_cfg["join"], node_id,
                 meta_cfg.get("advertise", cfg["http"]["bind-address"]), token,
             )
+    cluster_cfg = cfg.get("cluster", {})
+    if cluster_cfg.get("data-routing") and svc.meta_store is not None:
+        from opengemini_tpu.parallel.cluster import DataRouter
+
+        meta_cfg = cfg.get("meta", {})
+        advertise = meta_cfg.get("advertise", cfg["http"]["bind-address"])
+        svc.router = DataRouter(
+            engine, svc.meta_store, meta_cfg["node-id"], advertise,
+            token=meta_cfg.get("token", ""),
+        )
+        svc.executor.router = svc.router
+        _spawn_registrar(svc.meta_store, meta_cfg["node-id"], advertise,
+                         meta_cfg.get("token", ""))
     svc.services = _build_services(cfg, svc)
     return svc
+
+
+def _spawn_registrar(meta_store, node_id: str, addr: str, token: str) -> None:
+    """Register this node in the FSM data-node roster (leader-routed,
+    retried until the cluster has a leader)."""
+    import json as _json
+    import urllib.request as _rq
+
+    def run():
+        import time as _time
+
+        cmd = {"op": "register_node", "id": node_id, "addr": addr,
+               "role": "data"}
+        for _ in range(300):
+            if meta_store.fsm.nodes.get(node_id, {}).get("addr") == addr:
+                return  # already registered (replayed log or prior run)
+            if meta_store.is_leader():
+                if meta_store.propose_and_wait(cmd):
+                    return
+            else:
+                hint = meta_store.leader_hint()
+                laddr = meta_store.meta_members().get(hint or "", "")
+                if laddr:
+                    try:
+                        req = _rq.Request(
+                            f"http://{laddr}/cluster/register",
+                            data=_json.dumps({
+                                "id": node_id, "addr": addr,
+                                "role": "data", "token": token,
+                            }).encode(),
+                            headers={"Content-Type": "application/json"},
+                            method="POST",
+                        )
+                        with _rq.urlopen(req, timeout=3) as r:
+                            if r.status == 200:
+                                return
+                    except OSError:
+                        pass
+            _time.sleep(1)
+
+    threading.Thread(target=run, daemon=True, name="data-register").start()
 
 
 def _spawn_joiner(seed: str, node_id: str, addr: str, token: str) -> None:

@@ -74,6 +74,7 @@ class HttpService:
         self.prom_db = prom_db
         self.services: list = []  # populated by server.app.build
         self.meta_store = None  # MetaStore when clustered (server.app.build)
+        self.router = None  # DataRouter when [cluster] data-routing is on
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -258,6 +259,68 @@ def _make_handler(svc: HttpService):
                         addr_of[msg["from"]] = sender_addr
                 svc.meta_store.node.deliver(msg)
                 self._send(204)
+            elif path in ("/internal/scan", "/internal/measurements"):
+                from opengemini_tpu.parallel.cluster import serialize_series
+
+                try:
+                    req = json.loads(self._body())
+                except ValueError:
+                    req = None
+                if not isinstance(req, dict) or not req.get("db"):
+                    self._send_json(400, {"error": "db required"})
+                    return
+                token = getattr(svc.meta_store, "token", "") if svc.meta_store else ""
+                if token and req.get("token") != token:
+                    self._send_json(403, {"error": "bad cluster token"})
+                    return
+                if not token and svc.auth_enabled:
+                    # raw-data peer API must not bypass auth without a
+                    # shared cluster secret to vouch for the caller
+                    self._send_json(403, {"error": "cluster token required"})
+                    return
+                if path == "/internal/scan":
+                    payload = serialize_series(
+                        svc.engine, req["db"], req.get("rp"), req.get("mst", ""),
+                        int(req.get("tmin", -(2**62))), int(req.get("tmax", 2**62)),
+                    )
+                else:
+                    names = set()
+                    for sh in svc.engine.shards_for_range(
+                            req["db"], req.get("rp"), -(2**62), 2**62):
+                        names.update(sh.measurements())
+                    payload = {"measurements": sorted(names)}
+                self._send_json(200, payload)
+            elif path == "/cluster/register" and svc.meta_store is not None:
+                try:
+                    req = json.loads(self._body())
+                except ValueError:
+                    req = None
+                if not isinstance(req, dict) or not req.get("id") or not req.get("addr"):
+                    self._send_json(400, {"error": "id and addr required"})
+                    return
+                token = getattr(svc.meta_store, "token", "")
+                if token and req.get("token") != token:
+                    self._send_json(403, {"error": "bad cluster token"})
+                    return
+                if not token and svc.auth_enabled:
+                    # roster writes must not bypass auth without a shared
+                    # secret (an attacker-registered node would receive a
+                    # share of all writes and feed every query)
+                    self._send_json(403, {"error": "cluster token required"})
+                    return
+                if not svc.meta_store.is_leader():
+                    hint = svc.meta_store.leader_hint()
+                    self._send_json(
+                        409, {"error": "not the meta leader", "leader": hint,
+                              "leader_addr": svc.meta_store.meta_members().get(
+                                  hint, "")})
+                    return
+                ok = svc.meta_store.propose_and_wait({
+                    "op": "register_node", "id": req["id"],
+                    "addr": req["addr"], "role": req.get("role", "data"),
+                })
+                self._send_json(200 if ok else 503,
+                                {"ok": True} if ok else {"error": "no quorum"})
             elif path in ("/raft/join", "/raft/remove") and svc.meta_store is not None:
                 try:
                     req = json.loads(self._body())
@@ -570,13 +633,24 @@ def _make_handler(svc: HttpService):
             })
 
         def _handle_write(self, params: dict, db: str, rp):
-            user = self._authenticate(params)
-            if user is False:
-                return
-            if svc.auth_enabled and not (user and user.can("WRITE", db)):
-                code = 401 if user is None else 403
-                self._send_json(code, {"error": f"write not authorized on {db!r}"})
-                return
+            internal = bool(self.headers.get("X-Ogt-Internal"))
+            if internal:
+                # peer-forwarded write: the shared cluster token vouches
+                # for it (the coordinator already authenticated the client)
+                token = getattr(svc.meta_store, "token", "") if svc.meta_store else ""
+                if (token and self.headers.get("X-Ogt-Token") != token) or (
+                        not token and svc.auth_enabled):
+                    self._send_json(403, {"error": "bad cluster token"})
+                    return
+            else:
+                user = self._authenticate(params)
+                if user is False:
+                    return
+                if svc.auth_enabled and not (user and user.can("WRITE", db)):
+                    code = 401 if user is None else 403
+                    self._send_json(
+                        code, {"error": f"write not authorized on {db!r}"})
+                    return
             if not db:
                 self._send_json(400, {"error": "database is required"})
                 return
@@ -584,6 +658,10 @@ def _make_handler(svc: HttpService):
             if precision == "n":
                 precision = "ns"
             try:
+                router = getattr(svc, "router", None)
+                if router is not None and not internal:
+                    self._routed_write(router, db, rp, precision)
+                    return
                 svc.engine.write_lines(db, self._body(), precision=precision, rp=rp)
             except DatabaseNotFound as e:
                 self._send_json(404, {"error": str(e)})
@@ -593,6 +671,36 @@ def _make_handler(svc: HttpService):
                 return
             except WriteError as e:
                 self._send_json(403, {"error": str(e)})
+                return
+            self._send(204)
+
+        def _routed_write(self, router, db: str, rp, precision: str):
+            """Coordinator write: split points by shard-group owner; write
+            the local slice structurally, forward the rest as line
+            protocol with the internal marker (no re-routing loops)."""
+            import time as _time
+
+            from opengemini_tpu.ingest.line_protocol import parse_lines
+            from opengemini_tpu.services.subscriber import points_to_lines
+
+            try:
+                points = parse_lines(self._body(), precision, _time.time_ns())
+                local, remote = router.split_points(db, rp, points)
+                if local:
+                    svc.engine.write_rows(db, local, rp=rp)
+                for node_id, pts in sorted(remote.items()):
+                    router.forward_write(node_id, db, rp, points_to_lines(pts))
+            except DatabaseNotFound as e:
+                self._send_json(404, {"error": str(e)})
+                return
+            except (ParseError, FieldTypeConflict, ValueError) as e:
+                self._send_json(400, {"error": f"partial write: {e}"})
+                return
+            except WriteError as e:
+                self._send_json(403, {"error": str(e)})
+                return
+            except OSError as e:
+                self._send_json(503, {"error": f"forward failed: {e}"})
                 return
             self._send(204)
 

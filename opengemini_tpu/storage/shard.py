@@ -22,6 +22,8 @@ from opengemini_tpu.storage.wal import WAL
 
 
 class Shard:
+    supports_preagg = True  # RemoteShard proxies set False (no chunk meta)
+
     def __init__(self, path: str, tmin: int, tmax: int, sync_wal: bool = False):
         self.path = path
         self.tmin = tmin  # inclusive ns

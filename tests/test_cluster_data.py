@@ -1,0 +1,253 @@
+"""Multi-node data plane tests: placement, remote-shard proxies, and the
+serialize/parse round trip (mock-cluster strategy — no HTTP needed)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.parallel.cluster import (
+    DataRouter, RemoteShard, owner, serialize_series,
+)
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.record import FieldType
+from opengemini_tpu.storage.engine import Engine
+
+NS = 10**9
+BASE = 1_700_000_000
+
+
+def q(ex, sql, db="db"):
+    res = ex.execute(sql, db=db)["results"][0]
+    assert "error" not in res, res
+    return res
+
+
+class TestPlacement:
+    def test_deterministic_and_balanced(self):
+        nodes = ["n1", "n2", "n3"]
+        owners = [owner(nodes, "db", "rp", g * 7 * 86400 * NS)
+                  for g in range(300)]
+        assert owners == [owner(nodes, "db", "rp", g * 7 * 86400 * NS)
+                          for g in range(300)]
+        counts = {n: owners.count(n) for n in nodes}
+        assert all(60 < c < 140 for c in counts.values()), counts
+
+    def test_stability_under_node_add(self):
+        before = {g: owner(["n1", "n2"], "db", "rp", g) for g in range(1000)}
+        after = {g: owner(["n1", "n2", "n3"], "db", "rp", g) for g in range(1000)}
+        moved = sum(1 for g in before if before[g] != after[g])
+        # HRW: only ~1/3 of groups move to the new node, none shuffle
+        # between the old two
+        assert 200 < moved < 470, moved
+        assert all(after[g] == "n3" for g in before if before[g] != after[g])
+
+
+class TestRemoteShardProxy:
+    def _mk_remote(self, tmp_path, lines):
+        src = Engine(str(tmp_path / "src"))
+        src.create_database("db")
+        src.write_lines("db", lines)
+        payload = serialize_series(src, "db", None, "cpu", -(2**62), 2**62)
+        src.close()
+        return RemoteShard("cpu", payload)
+
+    def test_round_trip_values_and_nulls(self, tmp_path):
+        rs = self._mk_remote(tmp_path, "\n".join([
+            f"cpu,host=a v=1.5,c=7i {BASE * NS}",
+            f"cpu,host=a v=2.5 {(BASE + 60) * NS}",      # c null here
+            f"cpu,host=b s=\"x\" {(BASE + 30) * NS}",
+        ]))
+        assert rs.measurements() == ["cpu"]
+        assert rs.schema("cpu") == {"v": FieldType.FLOAT, "c": FieldType.INT,
+                                    "s": FieldType.STRING}
+        sids = rs.index.series_ids("cpu")
+        assert len(sids) == 2
+        sid_a = next(s for s in sids if rs.index.tags_of(s)["host"] == "a")
+        rec = rs.read_series("cpu", sid_a)
+        assert rec.times.tolist() == [BASE * NS, (BASE + 60) * NS]
+        assert rec.columns["v"].values.tolist() == [1.5, 2.5]
+        assert rec.columns["c"].valid.tolist() == [True, False]
+        assert rec.columns["c"].values[0] == 7
+        # time slicing
+        rec2 = rs.read_series("cpu", sid_a, tmin=(BASE + 1) * NS)
+        assert rec2.times.tolist() == [(BASE + 60) * NS]
+
+    def test_query_merges_local_and_remote(self, tmp_path):
+        """The money test: an executor over a local engine + a router stub
+        aggregates across both nodes' data on one device path."""
+        local = Engine(str(tmp_path / "local"))
+        local.create_database("db")
+        local.write_lines("db", f"cpu,host=a v=1 {BASE * NS}\n"
+                                f"cpu,host=a v=3 {(BASE + 30) * NS}")
+        remote = self._mk_remote(
+            tmp_path, f"cpu,host=a v=5 {(BASE + 3600) * NS}\n"
+                      f"cpu,host=c v=7 {(BASE + 3660) * NS}")
+
+        class StubRouter:
+            def fetch_remote_shards(self, db, rp, mst, tmin, tmax):
+                return [remote] if mst == "cpu" else []
+
+            def remote_measurements(self, db, rp):
+                return {"cpu"}
+
+        ex = Executor(local)
+        ex.router = StubRouter()
+        out = q(ex, "SELECT count(v), sum(v) FROM cpu")
+        [row] = out["series"][0]["values"]
+        assert row[1] == 4 and row[2] == 16  # 1+3 local, 5+7 remote
+        # grouped by tag: remote-only host appears
+        out = q(ex, "SELECT sum(v) FROM cpu GROUP BY host")
+        by_host = {s["tags"]["host"]: s["values"][0][1] for s in out["series"]}
+        assert by_host == {"a": 9.0, "c": 7.0}
+        # raw select sees both, time-ordered per series
+        out = q(ex, "SELECT v FROM cpu WHERE host = 'a'")
+        vals = [r[1] for r in out["series"][0]["values"]]
+        assert vals == [1.0, 3.0, 5.0]
+        # GROUP BY time window math includes remote extents
+        out = q(ex, "SELECT mean(v) FROM cpu WHERE host = 'a' "
+                    "GROUP BY time(1h)")
+        rows = out["series"][0]["values"]
+        assert len(rows) == 2 and rows[1][1] == 5.0
+        # regex measurement resolution consults the router
+        out = q(ex, "SELECT count(v) FROM /cp./")
+        assert out["series"][0]["values"][0][1] == 4
+        local.close()
+
+    def test_unreachable_peer_fails_query(self, tmp_path):
+        local = Engine(str(tmp_path / "l2"))
+        local.create_database("db")
+        local.write_lines("db", f"cpu v=1 {BASE * NS}")
+
+        class DeadRouter:
+            def fetch_remote_shards(self, db, rp, mst, tmin, tmax):
+                raise OSError("connection refused")
+
+        ex = Executor(local)
+        ex.router = DeadRouter()
+        res = ex.execute("SELECT count(v) FROM cpu", db="db")["results"][0]
+        assert "connection refused" in res.get("error", "")
+        local.close()
+
+
+class TestWriteSplit:
+    def test_split_points_by_owner(self, tmp_path):
+        eng = Engine(str(tmp_path / "e"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nA": {"addr": "hA:1", "role": "data"},
+                     "nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1")
+        week = 7 * 86400
+        points = [("cpu", (), (BASE + i * week) * NS, {"v": (FieldType.FLOAT, 1.0)})
+                  for i in range(40)]
+        local, remote = router.split_points("db", None, points)
+        assert len(local) + sum(len(v) for v in remote.values()) == 40
+        assert local and remote.get("nB")  # both nodes own some groups
+        # same group -> same destination, deterministically
+        local2, remote2 = router.split_points("db", None, points)
+        assert [p[2] for p in local] == [p[2] for p in local2]
+        eng.close()
+
+
+class TestReviewRegressions:
+    def test_percentile_approx_includes_remote(self, tmp_path):
+        """The sketch fast path must decode remote proxies, not skip them."""
+        local = Engine(str(tmp_path / "pl"))
+        local.create_database("db")
+        lines = "\n".join(f"cpu v={i} {(BASE + i) * NS}" for i in range(50))
+        local.write_lines("db", lines)
+
+        src = Engine(str(tmp_path / "pr"))
+        src.create_database("db")
+        lines = "\n".join(
+            f"cpu v={i} {(BASE + i) * NS}" for i in range(50, 100))
+        src.write_lines("db", lines)
+        payload = serialize_series(src, "db", None, "cpu", -(2**62), 2**62)
+        src.close()
+        remote = RemoteShard("cpu", payload)
+
+        class StubRouter:
+            def fetch_remote_shards(self, db, rp, mst, tmin, tmax):
+                return [remote]
+
+            def remote_measurements(self, db, rp):
+                return {"cpu"}
+
+        ex = Executor(local)
+        ex.router = StubRouter()
+        out = q(ex, "SELECT percentile_approx(v, 50) FROM cpu")
+        p50 = out["series"][0]["values"][0][1]
+        assert 40 <= p50 <= 60, p50  # over 0..99, not 0..49 (local only)
+        local.close()
+
+    def test_routed_write_unknown_db_is_clean_error(self, tmp_path):
+        eng = Engine(str(tmp_path / "ue"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nA": {"addr": "hA:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        from opengemini_tpu.storage.engine import DatabaseNotFound
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1")
+        with pytest.raises(DatabaseNotFound):
+            router.split_points("nope", None, [("m", (), 0, {})])
+        eng.close()
+
+    def test_show_measurements_includes_remote(self, tmp_path):
+        local = Engine(str(tmp_path / "sm"))
+        local.create_database("db")
+        local.write_lines("db", f"cpu v=1 {BASE * NS}")
+
+        class StubRouter:
+            def fetch_remote_shards(self, db, rp, mst, tmin, tmax):
+                return []
+
+            def remote_measurements(self, db, rp):
+                return {"remote_only"}
+
+        ex = Executor(local)
+        ex.router = StubRouter()
+        out = q(ex, "SHOW MEASUREMENTS")
+        names = [r[0] for r in out["series"][0]["values"]]
+        assert names == ["cpu", "remote_only"]
+        local.close()
+
+    def test_forward_write_escapes_url(self, tmp_path):
+        eng = Engine(str(tmp_path / "fe"))
+        eng.create_database("a&b")
+
+        class FsmStub:
+            nodes = {"nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1")
+        seen = {}
+
+        import opengemini_tpu.parallel.cluster as cl
+
+        class FakeResp:
+            def read(self):
+                return b""
+
+        def fake_urlopen(req, timeout=None):
+            seen["url"] = req.full_url
+            return FakeResp()
+
+        orig = cl.urllib.request.urlopen
+        cl.urllib.request.urlopen = fake_urlopen
+        try:
+            router.forward_write("nB", "a&b", "my rp", "m v=1 1")
+        finally:
+            cl.urllib.request.urlopen = orig
+        assert "db=a%26b" in seen["url"] and "rp=my%20rp" in seen["url"]
+        eng.close()

@@ -940,9 +940,7 @@ class TestMembership:
         store.node.peers = ["other"]
         store.bootstrap_membership()
         store.drain_listeners()
-        metas = {nid for nid, i in store.fsm.nodes.items()
-                 if i.get("role") == "meta"}
-        assert metas == set()  # not committed yet (no quorum with 'other')
+        assert store.fsm.meta_nodes == {}  # not committed (no quorum)
         # single-node path: commits immediately
         store2 = MetaStore("solo", ["solo"], storage_path=None)
         store2._meta_addrs = {"solo": "h:1"}
@@ -952,8 +950,7 @@ class TestMembership:
                 break
         store2.bootstrap_membership()
         store2.drain_listeners()
-        assert {n for n, i in store2.fsm.nodes.items()
-                if i.get("role") == "meta"} == {"solo"}
+        assert set(store2.fsm.meta_nodes) == {"solo"}
         before = len(store2.node.log)
         store2.bootstrap_membership()  # idempotent: no second batch
         assert len(store2.node.log) == before
