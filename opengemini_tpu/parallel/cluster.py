@@ -221,6 +221,41 @@ class DataRouter:
                 remote.setdefault(o, []).append(p)
         return local, remote
 
+    def routed_write(self, db: str, rp: str | None, points: list) -> int:
+        """The one coordinator-write sequence (used by HTTP /write and
+        SELECT INTO): split by owner, write the local slice structurally,
+        forward the rest as STRUCTURED JSON — line-protocol text cannot
+        carry arbitrary content (e.g. newlines in string fields)."""
+        local, remote = self.split_points(db, rp, points)
+        n = 0
+        if local:
+            n += self.engine.write_rows(db, local, rp=rp)
+        for node_id, pts in sorted(remote.items()):
+            self.forward_points(node_id, db, rp, pts)
+            n += len(pts)
+        return n
+
+    def forward_points(self, node_id: str, db: str, rp: str | None,
+                       points: list) -> None:
+        """POST structured points to the owner's /internal/write."""
+        addr = self.data_nodes().get(node_id, "")
+        if not addr:
+            raise RemoteScanError(f"no address for data node {node_id!r}")
+        body = {
+            "db": db, "rp": rp,
+            "points": [
+                [mst, list(map(list, tags)), int(t),
+                 {name: [ft.name, v] for name, (ft, v) in fields.items()}]
+                for mst, tags, t, fields in points
+            ],
+        }
+        try:
+            self._post(addr, "/internal/write", body)
+        except OSError as e:
+            raise RemoteScanError(
+                f"data node {node_id!r} ({addr}) write failed: {e}"
+            ) from e
+
     def forward_write(self, node_id: str, db: str, rp: str | None,
                       lines: str) -> None:
         from urllib.parse import quote

@@ -821,6 +821,17 @@ class Executor:
                     points.append((target.name, tags, t, fields))
         if not points:
             return 0
+        if self.router is not None:
+            # route INTO results by shard-group owner like any other write:
+            # result rows written only-locally would duplicate across nodes
+            # (every copy double-counts in merged scans)
+            from opengemini_tpu.parallel.cluster import RemoteScanError
+
+            try:
+                return self.router.routed_write(
+                    tgt_db, target.rp or None, points)
+            except (OSError, RemoteScanError) as e:
+                raise QueryError(f"INTO forward failed: {e}") from e
         return self.engine.write_rows(tgt_db, points, rp=target.rp or None)
 
     def _select_from_subquery(self, stmt, src: ast.SubQuery, db: str,

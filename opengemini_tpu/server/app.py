@@ -197,7 +197,8 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
         RetentionService(svc.engine, float(sc.get("retention-interval-s", 1800))),
         DownsampleService(svc.engine, float(sc.get("downsample-interval-s", 3600))),
         ContinuousQueryService(
-            svc.engine, svc.executor, float(sc.get("cq-interval-s", 10))
+            svc.engine, svc.executor, float(sc.get("cq-interval-s", 10)),
+            meta_store=svc.meta_store,
         ),
     ]
     if sc.get("store-monitor", True):

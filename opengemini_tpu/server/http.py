@@ -259,6 +259,39 @@ def _make_handler(svc: HttpService):
                         addr_of[msg["from"]] = sender_addr
                 svc.meta_store.node.deliver(msg)
                 self._send(204)
+            elif path == "/internal/write":
+                from opengemini_tpu.record import FieldType as _FT
+
+                try:
+                    req = json.loads(self._body())
+                except ValueError:
+                    req = None
+                if not isinstance(req, dict) or not req.get("db"):
+                    self._send_json(400, {"error": "db required"})
+                    return
+                token = getattr(svc.meta_store, "token", "") if svc.meta_store else ""
+                if token and req.get("token") != token:
+                    self._send_json(403, {"error": "bad cluster token"})
+                    return
+                if not token and svc.auth_enabled:
+                    self._send_json(403, {"error": "cluster token required"})
+                    return
+                try:
+                    points = [
+                        (mst, tuple(tuple(t) for t in tags), int(t_ns),
+                         {name: (_FT[ft], v)
+                          for name, (ft, v) in fields.items()})
+                        for mst, tags, t_ns, fields in req.get("points", [])
+                    ]
+                    svc.engine.write_rows(req["db"], points,
+                                          rp=req.get("rp") or None)
+                except (KeyError, TypeError, ValueError) as e:
+                    self._send_json(400, {"error": f"bad points: {e}"})
+                    return
+                except WriteError as e:
+                    self._send_json(403, {"error": str(e)})
+                    return
+                self._send_json(200, {"ok": True})
             elif path in ("/internal/scan", "/internal/measurements"):
                 from opengemini_tpu.parallel.cluster import serialize_series
 
@@ -675,21 +708,20 @@ def _make_handler(svc: HttpService):
             self._send(204)
 
         def _routed_write(self, router, db: str, rp, precision: str):
-            """Coordinator write: split points by shard-group owner; write
-            the local slice structurally, forward the rest as line
-            protocol with the internal marker (no re-routing loops)."""
+            """Coordinator write: parse, then the shared routed_write
+            sequence (split by owner, local structural write, structured
+            JSON forwards)."""
             import time as _time
 
             from opengemini_tpu.ingest.line_protocol import parse_lines
-            from opengemini_tpu.services.subscriber import points_to_lines
+            from opengemini_tpu.parallel.cluster import RemoteScanError
 
             try:
                 points = parse_lines(self._body(), precision, _time.time_ns())
-                local, remote = router.split_points(db, rp, points)
-                if local:
-                    svc.engine.write_rows(db, local, rp=rp)
-                for node_id, pts in sorted(remote.items()):
-                    router.forward_write(node_id, db, rp, points_to_lines(pts))
+                router.routed_write(db, rp, points)
+            except RemoteScanError as e:
+                self._send_json(503, {"error": f"forward failed: {e}"})
+                return
             except DatabaseNotFound as e:
                 self._send_json(404, {"error": str(e)})
                 return

@@ -23,12 +23,23 @@ logger = logging.getLogger("opengemini_tpu.services.cq")
 class ContinuousQueryService(Service):
     name = "continuousquery"
 
-    def __init__(self, engine, executor, interval_s: float = 10.0):
+    def __init__(self, engine, executor, interval_s: float = 10.0,
+                 meta_store=None):
         super().__init__(interval_s)
         self.engine = engine
         self.executor = executor
+        # data-routed cluster: only the meta leader runs CQs — with a
+        # router every node's CQ reads the WHOLE cluster, so N runners
+        # would write N copies of every result row. Without data routing
+        # each node aggregates only its own local writes, so every node
+        # must keep running its CQs.
+        self.meta_store = meta_store
 
     def handle(self, now_ns: int | None = None) -> int:
+        if (self.meta_store is not None
+                and getattr(self.executor, "router", None) is not None
+                and not self.meta_store.is_leader()):
+            return 0
         if now_ns is None:
             now_ns = _time.time_ns()
         ran = 0

@@ -251,3 +251,114 @@ class TestReviewRegressions:
             cl.urllib.request.urlopen = orig
         assert "db=a%26b" in seen["url"] and "rp=my%20rp" in seen["url"]
         eng.close()
+
+
+class TestClusteredCQAndInto:
+    def test_cq_runs_only_on_leader(self, tmp_path):
+        from opengemini_tpu.services.continuous import ContinuousQueryService
+
+        eng = Engine(str(tmp_path / "cq"))
+        eng.create_database("db")
+        eng.write_lines("db", f"m v=1 {BASE * NS}")
+        from opengemini_tpu.storage.engine import ContinuousQuery
+
+        eng.create_continuous_query("db", ContinuousQuery(
+            "c1", "SELECT mean(v) INTO x FROM m GROUP BY time(1m)"))
+        ex = Executor(eng)
+
+        class Follower:
+            def is_leader(self):
+                return False
+
+        class Leader:
+            def is_leader(self):
+                return True
+
+        class NullRouter:
+            def fetch_remote_shards(self, *a):
+                return []
+
+            def remote_measurements(self, *a):
+                return set()
+
+            def routed_write(self, db, rp, points):
+                return eng.write_rows(db, points, rp=rp)
+
+        ex.router = NullRouter()
+        svc = ContinuousQueryService(eng, ex, meta_store=Follower())
+        assert svc.handle(now_ns=(BASE + 600) * NS) == 0  # follower: skip
+        svc.meta_store = Leader()
+        assert svc.handle(now_ns=(BASE + 600) * NS) == 1  # leader: runs
+        # WITHOUT data routing every node keeps running its CQs
+        ex.router = None
+        svc2 = ContinuousQueryService(eng, ex, meta_store=Follower())
+        eng.write_lines("db", f"m v=2 {(BASE + 700) * NS}")
+        assert svc2.handle(now_ns=(BASE + 1500) * NS) == 1
+        eng.close()
+
+    def test_into_routes_through_cluster(self, tmp_path):
+        """SELECT INTO results split by owner like any other write."""
+        eng = Engine(str(tmp_path / "into"))
+        eng.create_database("db")
+        week = 7 * 86400
+        lines = "\n".join(
+            f"m v={i} {(BASE + i * week) * NS}" for i in range(10))
+        eng.write_lines("db", lines)
+
+        class FsmStub:
+            nodes = {"nA": {"addr": "hA:1", "role": "data"},
+                     "nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1")
+        forwarded = []
+        router.forward_points = lambda nid, db, rp, pts: forwarded.append(
+            (nid, pts))
+        # fetch_remote_shards must exist for the read side; no remote data
+        router.fetch_remote_shards = lambda *a: []
+        router.remote_measurements = lambda *a: set()
+        ex = Executor(eng)
+        ex.router = router
+        out = q(ex, "SELECT mean(v) INTO tgt FROM m GROUP BY time(1w)")
+        written = out["series"][0]["values"][0][1]
+        assert written == 10
+        assert forwarded and all(nid == "nB" for nid, _ in forwarded)
+        n_remote = sum(len(pts) for _, pts in forwarded)
+        local_rows = sum(
+            len(sh.read_series("tgt", sid).times)
+            for sh in eng.shards_for_range("db", None, -(2**62), 2**62)
+            for sid in sh.index.series_ids("tgt"))
+        assert local_rows + n_remote == 10
+        assert local_rows and n_remote  # genuinely split
+        eng.close()
+
+    def test_forwarded_points_carry_arbitrary_content(self, tmp_path):
+        """Structured JSON forwards must survive content line protocol
+        cannot carry (newlines/quotes in string fields and tags)."""
+        import json as _json
+
+        eng = Engine(str(tmp_path / "nl"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1")
+        captured = {}
+        router._post = lambda addr, path, body: captured.update(
+            {"addr": addr, "path": path, "body": body}) or {}
+        nasty = 'a\nb "quoted" \\ end'
+        pts = [("m", (("tag k", "v,1"),), BASE * NS,
+                {"s": (FieldType.STRING, nasty)})]
+        router.forward_points("nB", "db", None, pts)
+        assert captured["path"] == "/internal/write"
+        wire = _json.dumps(captured["body"])  # what urllib would send
+        decoded = _json.loads(wire)["points"][0]
+        assert decoded[3]["s"] == ["STRING", nasty]  # content intact
+        assert decoded[1] == [["tag k", "v,1"]]
+        eng.close()

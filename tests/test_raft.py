@@ -291,7 +291,12 @@ class TestReplicatedDDL:
             engines[nid] = eng
             stores[nid] = store
         leader = elect(bus, nodes)
-        ex = Executor(engines[leader.id], meta_store=stores[leader.id])
+        import functools as _ft
+
+        lstore = stores[leader.id]
+        lstore.propose_and_wait = _ft.partial(
+            MetaStore.propose_and_wait, lstore, timeout_s=60)
+        ex = Executor(engines[leader.id], meta_store=lstore)
         # propose_and_wait blocks on majority acks: pump the bus from a
         # background thread while the executor waits (like live tickers)
         import threading as _t
@@ -317,9 +322,13 @@ class TestReplicatedDDL:
                 db="",
             )
             assert all("error" not in r for r in res["results"]), res
-            deadline = _time.time() + 5
+            deadline = _time.time() + 30
             while (
-                any("replicated" not in e.databases for e in engines.values())
+                any(
+                    "replicated" not in e.databases
+                    or "rp1" not in e.databases["replicated"].rps
+                    for e in engines.values()
+                )
                 and _time.time() < deadline
             ):
                 _time.sleep(0.01)
@@ -389,8 +398,13 @@ class TestReplicatedUsers:
             store.attach_users(us)
             engines[nid], stores[nid], ustores[nid] = eng, store, us
         leader = elect(bus, nodes)
+        import functools as _ft
+
+        lstore = stores[leader.id]
+        lstore.propose_and_wait = _ft.partial(
+            MetaStore.propose_and_wait, lstore, timeout_s=60)
         ex = Executor(engines[leader.id], users=ustores[leader.id],
-                      meta_store=stores[leader.id])
+                      meta_store=lstore)
         import time as _time
 
         stop = _th.Event()
@@ -413,7 +427,7 @@ class TestReplicatedUsers:
                 db="",
             )
             assert all("error" not in r for r in res["results"]), res
-            deadline = _time.time() + 5
+            deadline = _time.time() + 30
             def _grant_everywhere():
                 return all(
                     us.users.get("bob") is not None
@@ -464,7 +478,12 @@ class TestReplicatedRegistries:
             engines[nid] = eng
             stores[nid] = store
         leader = elect(bus, nodes)
-        ex = Executor(engines[leader.id], meta_store=stores[leader.id])
+        import functools as _ft
+
+        lstore = stores[leader.id]
+        lstore.propose_and_wait = _ft.partial(
+            MetaStore.propose_and_wait, lstore, timeout_s=60)
+        ex = Executor(engines[leader.id], meta_store=lstore)
         import threading as _t
         import time as _time
 
@@ -493,7 +512,7 @@ class TestReplicatedRegistries:
                 db="regdb",
             )
             assert all("error" not in r for r in res["results"]), res
-            deadline = _time.time() + 5
+            deadline = _time.time() + 30
             while _time.time() < deadline and any(
                 "regdb" not in e.databases
                 or "cq1" not in e.databases["regdb"].continuous_queries
@@ -514,7 +533,7 @@ class TestReplicatedRegistries:
                 "DROP SUBSCRIPTION sub1 ON regdb", db="regdb",
             )
             assert all("error" not in r for r in res["results"]), res
-            deadline = _time.time() + 5
+            deadline = _time.time() + 30
             while _time.time() < deadline and any(
                 e.databases["regdb"].continuous_queries
                 or e.databases["regdb"].streams
@@ -532,7 +551,7 @@ class TestReplicatedRegistries:
                 "SAMPLEINTERVAL 1h,25h TIMEINTERVAL 1m,30m", db="regdb",
             )
             assert all("error" not in r for r in res["results"]), res
-            deadline = _time.time() + 5
+            deadline = _time.time() + 30
             while _time.time() < deadline and any(
                 len(e.databases["regdb"].downsample.get("autogen", [])) != 2
                 for e in engines.values()
