@@ -308,6 +308,22 @@ def eval_field_expr(expr, record) -> np.ndarray:
     raise ConditionError(f"unsupported field filter: {expr}")
 
 
+def conjunctive_match_terms(expr) -> list[tuple[str, str]]:
+    """(field, token) pairs for match() calls that are top-level CONJUNCTS
+    of the field filter — only those may prune series (a match under an
+    OR constrains nothing on its own)."""
+    expr = _strip(expr)
+    if expr is None:
+        return []
+    if isinstance(expr, ast.BinaryExpr) and expr.op == "AND":
+        return conjunctive_match_terms(expr.lhs) + conjunctive_match_terms(expr.rhs)
+    if isinstance(expr, ast.Call) and expr.name == "match" and len(expr.args) == 2:
+        fld, tok = _strip(expr.args[0]), _strip(expr.args[1])
+        if isinstance(fld, ast.VarRef) and isinstance(tok, ast.StringLiteral):
+            return [(fld.name, tok.val)]
+    return []
+
+
 def _literal_value(e):
     e = _strip(e)
     if isinstance(e, ast.NumberLiteral):

@@ -1007,8 +1007,15 @@ class Executor:
         gid_of: dict[tuple, int] = {}
         group_keys: list[tuple] = []
         scan_plan = []  # (shard, sid, gid)
+        # GROUP BY time emits fill rows even for series with zero matching
+        # rows — pruning those series would change the emitted series set,
+        # so the index only prunes un-windowed scans
+        match_terms = (
+            [] if group_time else cond.conjunctive_match_terms(sc.field_expr)
+        )
         for sh in shards:
             sids = cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
+            sids = _prune_text_sids(sh, mst, sids, match_terms)
             for sid in sorted(sids):
                 tags = sh.index.tags_of(sid)
                 key = tuple(tags.get(k, "") for k in group_tags)
@@ -1655,8 +1662,10 @@ class Executor:
 
         group_tags = self._group_tags(stmt, shards, mst)
         groups: dict[tuple, list] = {}
+        match_terms = cond.conjunctive_match_terms(sc.field_expr)
         for sh in shards:
             sids = cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
+            sids = _prune_text_sids(sh, mst, sids, match_terms)
             for sid in sorted(sids):
                 tags = sh.index.tags_of(sid)
                 key = tuple(tags.get(k, "") for k in group_tags)
@@ -1834,6 +1843,28 @@ class Executor:
 
 
 # -- helpers -----------------------------------------------------------------
+
+
+def _prune_text_sids(sh, mst, sids, match_terms):
+    """Intersect candidate series with the persisted text index for every
+    conjunctive match() term (reference: logstore token-index pruning).
+    Conservative: memtable rows are unindexed so live-memtable series
+    always survive; shards without the index (or RemoteShard proxies)
+    prune nothing."""
+    if not match_terms or not sids:
+        return sids
+    lookup = getattr(sh, "text_match_sids", None)
+    if lookup is None:
+        return sids
+    mem_sids = sh.mem.sids_for(mst)
+    for fld, tok in match_terms:
+        got = lookup(mst, fld, tok)
+        if got is None:
+            return sids  # a pre-sidecar file: cannot prune safely
+        sids = sids & (got | mem_sids)
+        if not sids:
+            break
+    return sids
 
 
 def _series_needs_merged_decode(sh, mst, sid, tmin, tmax):

@@ -53,6 +53,11 @@ class MemTable:
         if self.max_time is None or t > self.max_time:
             self.max_time = t
 
+    def sids_for(self, measurement: str) -> set[int]:
+        """Live series ids of one measurement — O(series), no record
+        builds (hot-path pruning uses this, not series_records)."""
+        return {sid for sid, m in self._sid_mst.items() if m == measurement}
+
     def series_records(self) -> dict[int, tuple[str, Record]]:
         """sid -> (measurement, sorted+deduped Record)."""
         out: dict[int, tuple[str, Record]] = {}

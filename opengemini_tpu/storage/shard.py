@@ -36,6 +36,7 @@ class Shard:
         self.mem = MemTable(self.schemas)
         self._lock = threading.RLock()
         self._files: list[TSFReader] = []
+        self._tidx_cache: dict[str, object] = {}  # tsf path -> parsed | None
         self._next_file_seq = 1
         self._load_files()
         for r in self._files:
@@ -124,13 +125,16 @@ class Shard:
             self.index.flush()
             path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
             w = TSFWriter(path)
+            tidx = _TextSidecar()
             try:
                 for sid, (mst, rec) in sorted(self.mem.series_records().items()):
                     w.add_chunk(mst, sid, rec)
+                    tidx.add(mst, sid, rec)
                 w.finish()
             except BaseException:
                 w.abort()
                 raise
+            tidx.write(path)
             self._next_file_seq += 1
             self._files.append(TSFReader(path))
             self.mem = MemTable(self.schemas)
@@ -146,6 +150,7 @@ class Shard:
                 return False
             path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
             w = TSFWriter(path)
+            tidx = _TextSidecar()
             try:
                 per_mst: dict[str, set[int]] = {}
                 for r in self._files:
@@ -161,13 +166,16 @@ class Shard:
                                 recs.append(r.read_chunk(mst, c))
                         merged = merge_sorted_records(recs)
                         w.add_chunk(mst, sid, merged)
+                        tidx.add(mst, sid, merged)
                 w.finish()
             except BaseException:
                 w.abort()
                 raise
+            tidx.write(path)
             self._next_file_seq += 1
             old = self._files
             self._files = [TSFReader(path)]
+            self._tidx_cache = {}
             _retire_files(old)
             return True
 
@@ -206,10 +214,12 @@ class Shard:
             except BaseException:
                 w.abort()
                 raise
+            _TextSidecar().write(path)  # downsampled output drops strings
             self.schemas.update(staged_schemas)
             self._next_file_seq += 1
             old = self._files
             self._files = [TSFReader(path)]
+            self._tidx_cache = {}
             _retire_files(old)
             return rows
 
@@ -291,6 +301,35 @@ class Shard:
                 out.append((r, c))
         return out
 
+    def text_match_sids(self, mst: str, field: str, token: str):
+        """Series whose PERSISTED rows may contain `token` in `field`
+        (pruning set; rows are verified exactly afterwards), or None when
+        any file predates the sidecar format (no pruning possible).
+        Memtable rows are unindexed — callers must union live-memtable
+        sids before intersecting."""
+        import json as _json
+
+        token = token.lower()
+        out: set[int] = set()
+        # whole lookup under the shard lock: compact() swaps the file set
+        # and resets the cache; populating the cache outside the lock
+        # would re-insert entries for retired files forever (RLock —
+        # sidecar JSONs are small, so the hold is short)
+        with self._lock:
+            for r in self._files:
+                cached = self._tidx_cache.get(r.path, False)
+                if cached is False:
+                    try:
+                        with open(_tidx_path(r.path), encoding="utf-8") as f:
+                            cached = _json.load(f)
+                    except (OSError, ValueError):
+                        cached = None
+                    self._tidx_cache[r.path] = cached
+                if cached is None:
+                    return None
+                out.update(cached.get(mst, {}).get(field, {}).get(token, []))
+        return out
+
     def read_series(
         self,
         measurement: str,
@@ -340,7 +379,49 @@ def _retire_files(readers: list) -> None:
     import os as _os
 
     for r in readers:
-        try:
-            _os.remove(r.path)
-        except OSError:
-            pass
+        for p in (r.path, _tidx_path(r.path)):
+            try:
+                _os.remove(p)
+            except OSError:
+                pass
+
+
+def _tidx_path(tsf_path: str) -> str:
+    return tsf_path[:-4] + ".tidx" if tsf_path.endswith(".tsf") else tsf_path + ".tidx"
+
+
+class _TextSidecar:
+    """Per-file inverted text index over string fields, built as chunks
+    are written (reference: the logstore per-segment token index,
+    lib/logstore + engine/index/textindex — here a token -> sids map used
+    to PRUNE series before decode; rows are still verified exactly)."""
+
+    def __init__(self):
+        self.idx: dict[str, dict[str, dict[str, set]]] = {}
+
+    def add(self, mst: str, sid: int, rec) -> None:
+        from opengemini_tpu.native.textindex import tokenize
+        from opengemini_tpu.record import FieldType
+
+        for name, col in rec.columns.items():
+            if col.ftype != FieldType.STRING:
+                continue
+            toks = self.idx.setdefault(mst, {}).setdefault(name, {})
+            for v, ok in zip(col.values, col.valid):
+                if ok and isinstance(v, str):
+                    for t in set(tokenize(v)):
+                        toks.setdefault(t, set()).add(sid)
+
+    def write(self, tsf_path: str) -> None:
+        import json as _json
+
+        p = _tidx_path(tsf_path)
+        data = {
+            m: {f: {t: sorted(s) for t, s in toks.items()}
+                for f, toks in flds.items()}
+            for m, flds in self.idx.items()
+        }
+        tmp = p + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            _json.dump(data, f)
+        os.replace(tmp, p)  # crash before this: missing sidecar = no prune

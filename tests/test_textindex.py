@@ -94,3 +94,148 @@ def test_match_count_aggregate(tmp_path):
     )
     assert res["results"][0]["series"][0]["values"][0][1] == 10
     e.close()
+
+
+class TestPersistedTextIndex:
+    BASE = 1_700_000_000
+    NS = 10**9
+
+    def _mk(self, tmp_path):
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        e = Engine(str(tmp_path / "ti"))
+        e.create_database("db")
+        lines = "\n".join(
+            f'logs,src=s{i} msg="{"error disk full" if i == 3 else "all good here"}" {(self.BASE + i) * self.NS}'
+            for i in range(8)
+        )
+        e.write_lines("db", lines)
+        return e, Executor(e)
+
+    def test_flush_writes_sidecar_and_lookup(self, tmp_path):
+        import glob
+
+        e, ex = self._mk(tmp_path)
+        e.flush_all()
+        shard = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        assert glob.glob(shard.path + "/*.tidx")
+        sids = shard.text_match_sids("logs", "msg", "ERROR")
+        assert sids is not None and len(sids) == 1
+        assert shard.index.tags_of(next(iter(sids)))["src"] == "s3"
+        assert shard.text_match_sids("logs", "msg", "good") is not None
+        e.close()
+
+    def test_match_query_prunes_decode_but_stays_exact(self, tmp_path):
+        e, ex = self._mk(tmp_path)
+        e.flush_all()
+        shard = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        calls = []
+        orig = shard.read_series
+        shard.read_series = lambda *a, **k: calls.append(a) or orig(*a, **k)
+        out = ex.execute("SELECT msg FROM logs WHERE match(msg, 'error')",
+                         db="db")["results"][0]
+        rows = out["series"][0]["values"]
+        assert len(rows) == 1 and "error" in rows[0][1]
+        assert len(calls) == 1  # 7 non-matching series never decoded
+        e.close()
+
+    def test_memtable_rows_survive_pruning(self, tmp_path):
+        e, ex = self._mk(tmp_path)
+        e.flush_all()
+        # new unflushed row with the token, in a NEW series
+        e.write_lines("db", f'logs,src=live msg="late error" {(self.BASE + 50) * self.NS}')
+        out = ex.execute("SELECT msg FROM logs WHERE match(msg, 'error')",
+                         db="db")["results"][0]
+        vals = sorted(r[1] for r in out["series"][0]["values"])
+        assert vals == ["error disk full", "late error"]
+        e.close()
+
+    def test_missing_sidecar_means_no_prune(self, tmp_path):
+        import glob
+        import os
+
+        e, ex = self._mk(tmp_path)
+        e.flush_all()
+        shard = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        for p in glob.glob(shard.path + "/*.tidx"):
+            os.remove(p)
+        shard._tidx_cache = {}
+        assert shard.text_match_sids("logs", "msg", "error") is None
+        out = ex.execute("SELECT msg FROM logs WHERE match(msg, 'error')",
+                         db="db")["results"][0]
+        assert len(out["series"][0]["values"]) == 1  # still correct
+        e.close()
+
+    def test_compaction_rebuilds_sidecar(self, tmp_path):
+        e, ex = self._mk(tmp_path)
+        e.flush_all()
+        e.write_lines("db", f'logs,src=s9 msg="second error wave" {(self.BASE + 60) * self.NS}')
+        e.flush_all()
+        shard = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        assert shard.compact(max_files=1) or len(shard._files) == 1
+        sids = shard.text_match_sids("logs", "msg", "error")
+        assert sids is not None and len(sids) == 2  # s3 + s9 post-merge
+        e.close()
+
+    def test_or_match_does_not_prune(self, tmp_path):
+        from opengemini_tpu.query import condition as cond
+        from opengemini_tpu.sql.parser import Parser
+
+        stmt = Parser("SELECT v FROM m WHERE match(msg, 'a') OR v > 1").parse_select()
+        sc = cond.split(stmt.condition, set(), 0)
+        assert cond.conjunctive_match_terms(sc.field_expr) == []
+        stmt2 = Parser(
+            "SELECT v FROM m WHERE match(msg, 'a') AND match(msg, 'b')"
+        ).parse_select()
+        sc2 = cond.split(stmt2.condition, set(), 0)
+        assert cond.conjunctive_match_terms(sc2.field_expr) == [
+            ("msg", "a"), ("msg", "b")]
+
+    def test_windowed_fill_series_set_unchanged_by_index(self, tmp_path):
+        """GROUP BY time emits fill rows for zero-match series; pruning
+        must not change the emitted series set (index on vs off)."""
+        import glob
+        import os
+
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        B, NS = self.BASE, self.NS
+        e = Engine(str(tmp_path / "fw"))
+        e.create_database("db")
+        e.write_lines("db", "\n".join([
+            f'logs,src=a msg="has error here",v=1 {B * NS}',
+            f'logs,src=b msg="all fine",v=2 {(B + 1) * NS}',
+        ]))
+        e.flush_all()
+        ex = Executor(e)
+        sql = (f"SELECT count(v) FROM logs WHERE match(msg, 'error') AND "
+               f"time >= {B * NS} AND time < {(B + 4) * NS} "
+               "GROUP BY time(2s), src fill(0)")
+
+        def series_set(res):
+            return sorted((s["tags"]["src"], len(s["values"]))
+                          for s in res.get("series", []))
+
+        with_idx = series_set(ex.execute(sql, db="db")["results"][0])
+        sh = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        for p in glob.glob(sh.path + "/*.tidx"):
+            os.remove(p)
+        sh._tidx_cache = {}
+        without = series_set(ex.execute(sql, db="db")["results"][0])
+        assert with_idx == without
+        e.close()
+
+    def test_mem_sids_for_is_cheap_mapping(self, tmp_path):
+        from opengemini_tpu.storage.engine import Engine
+
+        e = Engine(str(tmp_path / "ms"))
+        e.create_database("db")
+        e.write_lines("db", f'a,t=1 v=1 {self.BASE * self.NS}\n'
+                            f'b,t=2 v=2 {self.BASE * self.NS}')
+        sh = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        assert len(sh.mem.sids_for("a")) == 1
+        assert len(sh.mem.sids_for("b")) == 1
+        assert sh.mem.sids_for("zzz") == set()
+        e.close()
