@@ -93,6 +93,15 @@ def build(cfg: dict) -> HttpService:
                 meta_cfg["join"], node_id,
                 meta_cfg.get("advertise", cfg["http"]["bind-address"]), token,
             )
+    flight_cfg = cfg.get("flight", {})
+    if flight_cfg.get("bind-address"):
+        from opengemini_tpu.server.flight import FlightService
+
+        fhost, _, fport = flight_cfg["bind-address"].partition(":")
+        svc.flight = FlightService(
+            engine, svc.executor, fhost or "127.0.0.1", int(fport or 8087),
+            users=svc.users, auth_enabled=bool(cfg["http"].get("auth-enabled", False)),
+        )
     cluster_cfg = cfg.get("cluster", {})
     if cluster_cfg.get("data-routing") and svc.meta_store is not None:
         from opengemini_tpu.parallel.cluster import DataRouter
@@ -104,6 +113,8 @@ def build(cfg: dict) -> HttpService:
             token=meta_cfg.get("token", ""),
         )
         svc.executor.router = svc.router
+        if svc.flight is not None:
+            svc.flight.router = svc.router
         _spawn_registrar(svc.meta_store, meta_cfg["node-id"], advertise,
                          meta_cfg.get("token", ""))
     svc.services = _build_services(cfg, svc)
@@ -247,6 +258,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     svc = build(load_config(args.config))
     svc.start()
+    if svc.flight is not None:
+        svc.flight.start()
     for s in svc.services:
         s.start()
     if args.pidfile:
@@ -262,6 +275,8 @@ def main(argv=None) -> int:
         s.stop()
     if getattr(svc, "subscriber", None) is not None:
         svc.subscriber.stop()
+    if svc.flight is not None:
+        svc.flight.stop()
     if svc.meta_store is not None:
         svc.meta_store.stop()
     svc.stop()

@@ -75,6 +75,7 @@ class HttpService:
         self.services: list = []  # populated by server.app.build
         self.meta_store = None  # MetaStore when clustered (server.app.build)
         self.router = None  # DataRouter when [cluster] data-routing is on
+        self.flight = None  # FlightService when [flight] is configured
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]

@@ -1,0 +1,178 @@
+"""Arrow Flight surface tests: client DoPut/DoGet round trip against an
+in-process server (reference: openGemini arrow flight write service)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as fl
+import pytest
+
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.server.flight import FlightService
+from opengemini_tpu.storage.engine import Engine
+
+NS = 10**9
+BASE = 1_700_000_000
+
+
+@pytest.fixture
+def flight_env(tmp_path):
+    e = Engine(str(tmp_path / "fl"))
+    e.create_database("db")
+    ex = Executor(e)
+    svc = FlightService(e, ex, "127.0.0.1", 0)
+    svc.start()
+    client = fl.connect(f"grpc://127.0.0.1:{svc.port}")
+    # wait until the server answers
+    for _ in range(100):
+        try:
+            list(client.do_action(fl.Action("ping", b"")))
+            break
+        except fl.FlightError:
+            import time
+
+            time.sleep(0.05)
+    yield e, ex, svc, client
+    client.close()
+    svc.stop()
+    e.close()
+
+
+def test_do_put_then_sql_query(flight_env):
+    import json
+
+    e, ex, svc, client = flight_env
+    table = pa.table({
+        "time": pa.array([(BASE + i) * NS for i in range(4)], pa.int64()),
+        "host": pa.array(["a", "a", "b", "b"]),
+        "v": pa.array([1.5, 2.5, 10.0, 20.0], pa.float64()),
+        "n": pa.array([1, 2, 3, None], pa.int64()),
+    })
+    desc = fl.FlightDescriptor.for_command(json.dumps({
+        "db": "db", "measurement": "cpu", "tag_columns": ["host"],
+    }).encode())
+    writer, _ = client.do_put(desc, table.schema)
+    writer.write_table(table)
+    writer.close()
+
+    out = ex.execute("SELECT sum(v), sum(n) FROM cpu GROUP BY host",
+                     db="db")["results"][0]
+    by_host = {s["tags"]["host"]: s["values"][0][1:] for s in out["series"]}
+    assert by_host == {"a": [4.0, 3], "b": [30.0, 3]}
+    # int column stayed INT (null row skipped for that field)
+    out = ex.execute("SELECT n FROM cpu WHERE host = 'b'", db="db")["results"][0]
+    vals = [r[1] for r in out["series"][0]["values"]]
+    assert vals == [3]
+
+
+def test_do_get_returns_arrow_table(flight_env):
+    import json
+
+    e, ex, svc, client = flight_env
+    e.write_lines("db", "\n".join(
+        f"m,host=h{i % 2} v={i} {(BASE + i) * NS}" for i in range(6)))
+    ticket = fl.Ticket(json.dumps({
+        "db": "db", "q": "SELECT sum(v) FROM m GROUP BY host"}).encode())
+    table = client.do_get(ticket).read_all()
+    got = dict(zip(table.column("host").to_pylist(),
+                   table.column("sum").to_pylist()))
+    assert got == {"h0": 0 + 2 + 4, "h1": 1 + 3 + 5}
+
+
+def test_do_get_error_propagates(flight_env):
+    import json
+
+    e, ex, svc, client = flight_env
+    ticket = fl.Ticket(json.dumps({"db": "db", "q": "SELECT FROM"}).encode())
+    with pytest.raises(fl.FlightError):
+        client.do_get(ticket).read_all()
+
+
+def test_auth_enforced(tmp_path):
+    import json
+
+    from opengemini_tpu.meta.users import UserStore
+
+    e = Engine(str(tmp_path / "fa"))
+    e.create_database("db")
+    users = UserStore(str(tmp_path / "u.json"))
+    users.create("admin", "pw123456", admin=True)
+    ex = Executor(e, users=users, auth_enabled=True)
+    svc = FlightService(e, ex, "127.0.0.1", 0, users=users,
+                        auth_enabled=True)
+    svc.start()
+    client = fl.connect(f"grpc://127.0.0.1:{svc.port}")
+    for _ in range(100):
+        try:
+            list(client.do_action(fl.Action("ping", b"")))
+            break
+        except fl.FlightError:
+            import time
+
+            time.sleep(0.05)
+    bad = fl.Ticket(json.dumps({"db": "db", "q": "SHOW DATABASES"}).encode())
+    with pytest.raises(fl.FlightError):
+        client.do_get(bad).read_all()
+    good = fl.Ticket(json.dumps({
+        "db": "db", "q": "SHOW DATABASES", "u": "admin", "p": "pw123456",
+    }).encode())
+    table = client.do_get(good).read_all()
+    assert "db" in table.column("name").to_pylist()
+    client.close()
+    svc.stop()
+    e.close()
+
+
+def test_null_time_rejected(flight_env):
+    import json
+
+    e, ex, svc, client = flight_env
+    table = pa.table({
+        "time": pa.array([BASE * NS, None], pa.int64()),
+        "v": pa.array([1.0, 2.0]),
+    })
+    desc = fl.FlightDescriptor.for_command(json.dumps(
+        {"db": "db", "measurement": "m"}).encode())
+    with pytest.raises((fl.FlightError, pa.lib.ArrowInvalid), match="nulls"):
+        w, _ = client.do_put(desc, table.schema)
+        w.write_table(table)
+        w.close()
+    out = ex.execute("SELECT v FROM m", db="db")["results"][0]
+    assert "series" not in out  # nothing stored
+
+
+def test_tag_key_also_in_columns(flight_env):
+    import json
+
+    e, ex, svc, client = flight_env
+    e.write_lines("db", f"m,host=a v=1 {BASE * NS}\nm,host=b v=2 {(BASE + 1) * NS}")
+    t = client.do_get(fl.Ticket(json.dumps({
+        "db": "db", "q": "SELECT host, v FROM m GROUP BY host"}).encode())
+    ).read_all()
+    assert len(t) == 2  # not doubled
+    assert sorted(t.column("host").to_pylist()) == ["a", "b"]
+
+
+def test_multi_measurement_columns_union(flight_env):
+    import json
+
+    e, ex, svc, client = flight_env
+    e.write_lines("db", f"m1 v=1 {BASE * NS}\nm2 w=2,x=3 {BASE * NS}")
+    t = client.do_get(fl.Ticket(json.dumps({
+        "db": "db", "q": "SELECT * FROM m1, m2"}).encode())).read_all()
+    cols = set(t.column_names)
+    assert {"v", "w", "x"} <= cols
+    rows = t.to_pylist()
+    by_v = [r for r in rows if r["v"] is not None]
+    by_w = [r for r in rows if r["w"] is not None]
+    assert by_v[0]["w"] is None and by_w[0]["v"] is None
+    assert by_w[0]["w"] == 2.0 and by_w[0]["x"] == 3.0  # not mislabeled
+
+
+def test_do_get_rejects_mutations(flight_env):
+    import json
+
+    e, ex, svc, client = flight_env
+    with pytest.raises(fl.FlightError):
+        client.do_get(fl.Ticket(json.dumps({
+            "db": "db", "q": "DROP DATABASE db"}).encode())).read_all()
+    assert "db" in e.databases  # nothing dropped
