@@ -23,7 +23,9 @@ from __future__ import annotations
 import json
 import os
 import random
+import struct
 import threading
+import zlib
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -84,6 +86,20 @@ class RaftNode:
             self.restore_fn(self.snap_state)
 
     # -- persistence ------------------------------------------------------
+    #
+    # Three files (reference: the raft log store, lib/raftlog wal.go —
+    # hashicorp raft uses a real log store, not a rewritten blob):
+    #   <path>       small JSON: term, voted_for, snap_index/term —
+    #                rewritten only on the RARE events (votes, term bumps,
+    #                compaction)
+    #   <path>.seg   append-only framed entries [u32 len|u32 crc32|
+    #                json([abs_index, term, cmd])] — the HOT path appends
+    #                + fsyncs only the
+    #                new suffix, O(1) per entry; rewritten only on suffix
+    #                truncation (conflict repair) or compaction
+    #   <path>.snap  opaque state-machine snapshot (compaction/install)
+    # A torn tail in .seg (crash mid-append) is dropped at replay like the
+    # storage WAL; raft re-replicates anything uncommitted.
 
     def _load(self) -> None:
         if not self.storage_path or not os.path.exists(self.storage_path):
@@ -92,17 +108,80 @@ class RaftNode:
             j = json.load(f)
         self.current_term = j["term"]
         self.voted_for = j["voted_for"]
-        self.log = [LogEntry(t, c) for t, c in j["log"]]
         self.snap_index = j.get("snap_index", 0)
         self.snap_term = j.get("snap_term", 0)
+        if "log" in j:  # pre-segment format: migrate in place
+            self.log = [LogEntry(t, c) for t, c in j["log"]]
+            self._rewrite_log()
+            self._persist_state()
+        else:
+            self.log = self._read_segment()
         # snapshot state lives in a sidecar written only on compaction /
-        # install: the hot _persist path must stay O(log), not O(state)
+        # install: hot paths must stay O(new data), not O(state)
         snap_path = self.storage_path + ".snap"
         if self.snap_index and os.path.exists(snap_path):
             with open(snap_path, encoding="utf-8") as f:
                 self.snap_state = json.load(f)
 
-    def _persist(self) -> None:
+    def _read_segment(self) -> list:
+        seg = self.storage_path + ".seg"
+        out: list[LogEntry] = []
+        if not os.path.exists(seg):
+            return out
+        with open(seg, "rb") as f:
+            data = f.read()
+        pos, expect = 0, self.snap_index + 1
+        while pos + 8 <= len(data):
+            length, crc = struct.unpack_from("<II", data, pos)
+            payload = data[pos + 8 : pos + 8 + length]
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                break  # torn tail: drop the rest
+            idx, term, cmd = json.loads(payload)
+            if idx == expect:  # skip compacted/stale prefixes
+                out.append(LogEntry(term, cmd))
+                expect += 1
+            pos += 8 + length
+        if pos < len(data):
+            # truncate the torn tail NOW: later appends open with "ab" and
+            # anything written after the garbage would be unreachable on
+            # the next replay (committed entries silently regressing)
+            with open(seg, "r+b") as f:
+                f.truncate(pos)
+                f.flush()
+                os.fsync(f.fileno())
+        return out
+
+    def _append_segment(self, first_abs_index: int, entries) -> None:
+        """Append-only persist of a new log suffix (the hot path)."""
+        if not self.storage_path or not entries:
+            return
+        buf = bytearray()
+        for i, e in enumerate(entries):
+            payload = json.dumps([first_abs_index + i, e.term, e.cmd]).encode()
+            buf += struct.pack("<II", len(payload), zlib.crc32(payload))
+            buf += payload
+        with open(self.storage_path + ".seg", "ab") as f:
+            f.write(buf)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _rewrite_log(self) -> None:
+        """Full segment rewrite — only on conflict truncation/compaction."""
+        if not self.storage_path:
+            return
+        tmp = self.storage_path + ".seg.tmp"
+        with open(tmp, "wb") as f:
+            for i, e in enumerate(self.log):
+                payload = json.dumps(
+                    [self.snap_index + 1 + i, e.term, e.cmd]
+                ).encode()
+                f.write(struct.pack("<II", len(payload), zlib.crc32(payload)))
+                f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.storage_path + ".seg")
+
+    def _persist_state(self) -> None:
         if not self.storage_path:
             return
         tmp = self.storage_path + ".tmp"
@@ -110,7 +189,6 @@ class RaftNode:
             json.dump({
                 "term": self.current_term,
                 "voted_for": self.voted_for,
-                "log": [e.to_json() for e in self.log],
                 "snap_index": self.snap_index,
                 "snap_term": self.snap_term,
             }, f)
@@ -161,7 +239,7 @@ class RaftNode:
         if term > self.current_term:
             self.current_term = term
             self.voted_for = None
-            self._persist()
+            self._persist_state()
         self.state = FOLLOWER
         self.leader_id = leader
         self.votes = set()
@@ -184,7 +262,7 @@ class RaftNode:
             if self.state != LEADER:
                 return None
             self.log.append(LogEntry(self.current_term, cmd))
-            self._persist()
+            self._append_segment(self._abs_last(), [self.log[-1]])
             idx = self._abs_last()
             term = self.current_term
             self.match_index[self.id] = idx
@@ -237,8 +315,13 @@ class RaftNode:
             self.snap_index = idx
             self.snap_term = term
             self.snap_state = state
+            # ordering: sidecar, then state (new snap_index), then the
+            # segment rewrite — a crash leaving the OLD segment with the
+            # NEW snap_index is safe (stale prefix frames are skipped at
+            # replay), while the reverse would drop the retained suffix
             self._persist_snapshot()
-            self._persist()
+            self._persist_state()
+            self._rewrite_log()
             return True
 
     def tick(self) -> None:
@@ -263,7 +346,7 @@ class RaftNode:
         self.state = CANDIDATE
         self.current_term += 1
         self.voted_for = self.id
-        self._persist()
+        self._persist_state()
         self.votes = {self.id}
         self.leader_id = None
         self._ticks_until_election = self._rand_election()
@@ -327,7 +410,7 @@ class RaftNode:
             if up_to_date:
                 granted = True
                 self.voted_for = m["from"]
-                self._persist()
+                self._persist_state()
                 self._ticks_until_election = self._rand_election()
         self.transport.send(m["from"], {
             "type": "request_vote_reply", "from": self.id,
@@ -353,7 +436,7 @@ class RaftNode:
         # without this, previously-replicated entries stall until the next
         # client proposal
         self.log.append(LogEntry(self.current_term, {"op": "noop"}))
-        self._persist()
+        self._append_segment(self._abs_last(), [self.log[-1]])
         last_idx, _ = self._last_log()
         self.next_index = {p: last_idx for p in self.peers}
         self.match_index = {p: 0 for p in self.peers}
@@ -410,7 +493,8 @@ class RaftNode:
                 ok = True
                 # overwrite conflicting suffix, append new entries
                 idx = prev_idx
-                changed = False
+                truncated = False
+                appended_from: int | None = None  # in-memory log position
                 for term, cmd in m["entries"]:
                     idx += 1
                     if idx <= self.snap_index:
@@ -420,12 +504,20 @@ class RaftNode:
                         if self.log[pos - 1].term != term:
                             del self.log[pos - 1 :]
                             self.log.append(LogEntry(term, cmd))
-                            changed = True
+                            truncated = True
+                            if appended_from is None:
+                                appended_from = pos - 1
                     else:
                         self.log.append(LogEntry(term, cmd))
-                        changed = True
-                if changed:
-                    self._persist()
+                        if appended_from is None:
+                            appended_from = pos - 1
+                if truncated:
+                    self._rewrite_log()  # conflict repair: rare
+                elif appended_from is not None:
+                    self._append_segment(
+                        self.snap_index + appended_from + 1,
+                        self.log[appended_from:],
+                    )
                 match_idx = max(idx, self.snap_index)
                 if m["leader_commit"] > self.commit_index:
                     self.commit_index = min(m["leader_commit"], self._abs_last())
@@ -460,7 +552,8 @@ class RaftNode:
                 if self.restore_fn:
                     self.restore_fn(m["state"])
                 self._persist_snapshot()
-                self._persist()
+                self._persist_state()
+                self._rewrite_log()
                 self._apply_committed()  # retained suffix up to commit
         self.transport.send(m["from"], {
             "type": "append_entries_reply", "from": self.id,
