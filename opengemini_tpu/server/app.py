@@ -240,6 +240,12 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
         float(sc.get("sherlock-cooldown-s", 600)),
         bool(sc.get("sherlock-tracemalloc", False)),
     ))
+    if sc.get("castor-udf-dir"):
+        from opengemini_tpu.services.castor import load_udfs
+
+        names = load_udfs(sc["castor-udf-dir"])
+        if names:
+            print(f"castor udfs loaded: {', '.join(names)}", flush=True)
     if sc.get("cold-dir"):
         from opengemini_tpu.services.hierarchical import HierarchicalService
 

@@ -19,6 +19,46 @@ import numpy as np
 
 ALGORITHMS = ("mad", "sigma", "iqr")
 
+# user detectors loaded from [services] castor-udf-dir: name -> callable
+# (reference: python/ts-udf pluggable algorithm scripts)
+_UDFS: dict[str, object] = {}
+
+
+def load_udfs(directory: str) -> list[str]:
+    """Load every `<name>.py` in `directory` as a detector UDF. Each file
+    must define `detect(values: np.ndarray, threshold: float|None)
+    -> np.ndarray[bool]`. A broken file is skipped with a log line, never
+    taking the server down. Returns the loaded names."""
+    import logging
+    import os
+
+    log = logging.getLogger("opengemini_tpu.castor")
+    loaded = []
+    _UDFS.clear()  # idempotent reload: stale detectors must not linger
+    if not os.path.isdir(directory):
+        return loaded
+    for fname in sorted(os.listdir(directory)):
+        if not fname.endswith(".py") or fname.startswith("_"):
+            continue
+        name = fname[:-3].lower()
+        if name in ALGORITHMS:
+            log.warning("castor udf %r shadows a built-in; skipped", name)
+            continue
+        path = os.path.join(directory, fname)
+        ns: dict = {"np": np, "numpy": np}
+        try:
+            with open(path, encoding="utf-8") as f:
+                exec(compile(f.read(), path, "exec"), ns)  # noqa: S102
+            fn = ns.get("detect")
+            if not callable(fn):
+                raise TypeError("no detect(values, threshold) function")
+        except Exception:  # noqa: BLE001
+            log.exception("castor udf %s failed to load", path)
+            continue
+        _UDFS[name] = fn
+        loaded.append(name)
+    return loaded
+
 
 def detect(values: np.ndarray, algorithm: str, threshold: float | None = None) -> np.ndarray:
     """Boolean anomaly mask over a value series."""
@@ -46,5 +86,20 @@ def detect(values: np.ndarray, algorithm: str, threshold: float | None = None) -
         q1, q3 = np.percentile(v, [25, 75])
         iqr = q3 - q1
         return (v < q1 - thr * iqr) | (v > q3 + thr * iqr)
+    udf = _UDFS.get(algorithm)
+    if udf is not None:
+        try:
+            mask = np.asarray(udf(v, threshold))
+        except ValueError:
+            raise
+        except Exception as e:  # noqa: BLE001 — udf bugs become clean errors
+            raise ValueError(f"udf {algorithm!r} failed: {e}") from e
+        if mask.shape != (n,):
+            raise ValueError(
+                f"udf {algorithm!r} returned shape {mask.shape}, "
+                f"expected ({n},)"
+            )
+        return mask.astype(bool)
+    names = list(ALGORITHMS) + sorted(_UDFS)
     raise ValueError(f"unknown detect algorithm {algorithm!r} "
-                     f"(supported: {', '.join(ALGORITHMS)})")
+                     f"(supported: {', '.join(names)})")

@@ -1,0 +1,135 @@
+"""MCP server tests: JSON-RPC handshake + tools against a live HTTP
+service (reference: the openGemini MCP bridge)."""
+
+import json
+
+import pytest
+
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.server.http import HttpService
+from opengemini_tpu.storage.engine import Engine
+from opengemini_tpu.tools.mcp_server import Backend, handle
+
+NS = 10**9
+BASE = 1_700_000_000
+
+
+@pytest.fixture
+def mcp_env(tmp_path):
+    e = Engine(str(tmp_path / "mcp"))
+    e.create_database("db")
+    e.write_lines("db", "\n".join(
+        f"cpu,host=h{i % 2} v={i} {(BASE + i) * NS}" for i in range(6)))
+    svc = HttpService(e, "127.0.0.1", 0)
+    svc.start()
+    backend = Backend(f"http://127.0.0.1:{svc.port}")
+    yield e, backend
+    svc.stop()
+    e.close()
+
+
+def rpc(backend, method, params=None, mid=1):
+    return handle(backend, {"jsonrpc": "2.0", "id": mid, "method": method,
+                            "params": params or {}})
+
+
+def test_initialize_and_tools_list(mcp_env):
+    e, backend = mcp_env
+    r = rpc(backend, "initialize")
+    assert r["result"]["serverInfo"]["name"] == "opengemini-tpu"
+    assert "tools" in r["result"]["capabilities"]
+    assert rpc(backend, "notifications/initialized") is None
+    tools = rpc(backend, "tools/list")["result"]["tools"]
+    assert {t["name"] for t in tools} == {
+        "query", "write", "list_databases", "list_measurements"}
+
+
+def test_query_and_write_tools(mcp_env):
+    e, backend = mcp_env
+    r = rpc(backend, "tools/call", {"name": "query", "arguments": {
+        "q": "SELECT count(v) FROM cpu", "db": "db"}})
+    payload = json.loads(r["result"]["content"][0]["text"])
+    assert payload["results"][0]["series"][0]["values"][0][1] == 6
+    r = rpc(backend, "tools/call", {"name": "write", "arguments": {
+        "db": "db", "lines": f"cpu,host=h9 v=99 {(BASE + 99) * NS}"}})
+    assert json.loads(r["result"]["content"][0]["text"]) == {"ok": True}
+    assert rpc(backend, "tools/call", {"name": "list_databases",
+                                       "arguments": {}})
+    dbs = json.loads(rpc(backend, "tools/call", {
+        "name": "list_databases", "arguments": {}})["result"]["content"][0]["text"])
+    assert "db" in dbs["databases"]
+    msts = json.loads(rpc(backend, "tools/call", {
+        "name": "list_measurements", "arguments": {"db": "db"},
+    })["result"]["content"][0]["text"])
+    assert msts["measurements"] == ["cpu"]
+
+
+def test_errors(mcp_env):
+    e, backend = mcp_env
+    r = rpc(backend, "tools/call", {"name": "nope", "arguments": {}})
+    assert r["error"]["code"] == -32602
+    r = rpc(backend, "no/such/method")
+    assert r["error"]["code"] == -32601
+    # tool-level failure is an isError RESULT, not a protocol error (MCP)
+    r = rpc(backend, "tools/call", {"name": "write", "arguments": {
+        "db": "nosuchdb", "lines": "m v=1 1"}})
+    assert r["result"].get("isError") is True
+
+
+def test_stdio_round_trip(tmp_path):
+    """End-to-end through the real process: pipe JSON-RPC lines."""
+    import subprocess
+    import sys
+
+    e = Engine(str(tmp_path / "mcp2"))
+    e.create_database("db")
+    e.write_lines("db", f"m v=7 {BASE * NS}")
+    svc = HttpService(e, "127.0.0.1", 0)
+    svc.start()
+    msgs = "\n".join(json.dumps(m) for m in [
+        {"jsonrpc": "2.0", "id": 1, "method": "initialize", "params": {}},
+        {"jsonrpc": "2.0", "method": "notifications/initialized"},
+        {"jsonrpc": "2.0", "id": 2, "method": "tools/call", "params": {
+            "name": "query",
+            "arguments": {"q": "SELECT v FROM m", "db": "db"}}},
+    ]) + "\n"
+    out = subprocess.run(
+        [sys.executable, "-m", "opengemini_tpu.tools.mcp_server",
+         "--url", f"http://127.0.0.1:{svc.port}"],
+        input=msgs, capture_output=True, text=True, timeout=60,
+        env={"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin",
+             "JAX_PLATFORMS": "cpu"},
+    )
+    lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln.strip()]
+    assert lines[0]["id"] == 1 and "serverInfo" in lines[0]["result"]
+    body = json.loads(lines[1]["result"]["content"][0]["text"])
+    assert body["results"][0]["series"][0]["values"][0][1] == 7.0
+    svc.stop()
+    e.close()
+
+
+def test_query_tool_is_read_only(mcp_env):
+    e, backend = mcp_env
+    r = rpc(backend, "tools/call", {"name": "query", "arguments": {
+        "q": "DROP DATABASE db", "db": "db"}})
+    body = json.loads(r["result"]["content"][0]["text"])
+    assert "error" in body["results"][0]
+    assert "db" in e.databases  # nothing dropped
+
+
+def test_non_object_json_line_skipped(tmp_path):
+    import subprocess
+    import sys
+
+    msgs = '5\n[]\n"x"\n' + json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": "ping"}) + "\n"
+    out = subprocess.run(
+        [sys.executable, "-m", "opengemini_tpu.tools.mcp_server",
+         "--url", "http://127.0.0.1:1"],
+        input=msgs, capture_output=True, text=True, timeout=60,
+        env={"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin",
+             "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0
+    lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1 and lines[0]["id"] == 1  # survived garbage

@@ -721,3 +721,85 @@ class TestDownsampleSQL:
             "CREATE DOWNSAMPLE ON db.rpx (float(mean)) WITH TTL 30d "
             "SAMPLEINTERVAL 1h TIMEINTERVAL 1m", db="db")
         assert "error" not in res["results"][0], res
+
+
+class TestCastorUDF:
+    def test_udf_loads_and_runs_via_sql(self, env, tmp_path):
+        import numpy as np
+
+        from opengemini_tpu.services import castor
+
+        udf_dir = tmp_path / "udfs"
+        udf_dir.mkdir()
+        (udf_dir / "spike.py").write_text(
+            "def detect(values, threshold):\n"
+            "    thr = 100.0 if threshold is None else threshold\n"
+            "    return values > thr\n"
+        )
+        (udf_dir / "broken.py").write_text("def detect(:\n")  # syntax error
+        (udf_dir / "mad.py").write_text("def detect(v, t): return v > 0\n")
+        try:
+            loaded = castor.load_udfs(str(udf_dir))
+            assert loaded == ["spike"]  # broken skipped, builtin shadow skipped
+            e, ex = env
+            e.write_lines("db", "\n".join(
+                f"m v={v} {(BASE + i) * NS}"
+                for i, v in enumerate([1, 2, 500, 3])))
+            out = q(ex, "SELECT detect(v, 'spike') FROM m")
+            vals = out["results"][0]["series"][0]["values"]
+            assert [r[1] for r in vals] == [500.0]
+            # threshold param reaches the udf
+            out = q(ex, "SELECT detect(v, 'spike', 2.5) FROM m")
+            assert [r[1] for r in out["results"][0]["series"][0]["values"]] == [500.0, 3.0]
+            # unknown algorithm error names udfs too
+            r = ex.execute("SELECT detect(v, 'nope') FROM m", db="db")
+            assert "spike" in r["results"][0]["error"]
+        finally:
+            castor._UDFS.clear()
+
+    def test_bad_udf_shape_is_clean_error(self, env, tmp_path):
+        from opengemini_tpu.services import castor
+
+        udf_dir = tmp_path / "udfs2"
+        udf_dir.mkdir()
+        (udf_dir / "badshape.py").write_text(
+            "def detect(values, threshold):\n    return values[:1] > 0\n")
+        try:
+            castor.load_udfs(str(udf_dir))
+            e, ex = env
+            e.write_lines("db", f"m v=1 {BASE * NS}\nm v=2 {(BASE + 1) * NS}")
+            r = ex.execute("SELECT detect(v, 'badshape') FROM m", db="db")
+            assert "expected (2,)" in r["results"][0]["error"]
+        finally:
+            castor._UDFS.clear()
+
+    def test_udf_runtime_error_is_clean(self, env, tmp_path):
+        from opengemini_tpu.services import castor
+
+        udf_dir = tmp_path / "udfs3"
+        udf_dir.mkdir()
+        (udf_dir / "wrongarity.py").write_text(
+            "def detect(values):\n    return values > 0\n")
+        try:
+            castor.load_udfs(str(udf_dir))
+            e, ex = env
+            e.write_lines("db", f"m v=1 {BASE * NS}")
+            r = ex.execute("SELECT detect(v, 'wrongarity') FROM m", db="db")
+            err = r["results"][0]["error"]
+            assert "wrongarity" in err and "failed" in err
+        finally:
+            castor._UDFS.clear()
+
+    def test_load_udfs_idempotent(self, env, tmp_path):
+        from opengemini_tpu.services import castor
+
+        d1 = tmp_path / "u1"; d1.mkdir()
+        (d1 / "one.py").write_text("def detect(v, t): return v > 0\n")
+        d2 = tmp_path / "u2"; d2.mkdir()
+        (d2 / "two.py").write_text("def detect(v, t): return v > 0\n")
+        try:
+            assert castor.load_udfs(str(d1)) == ["one"]
+            assert castor.load_udfs(str(d2)) == ["two"]
+            assert set(castor._UDFS) == {"two"}  # 'one' did not linger
+        finally:
+            castor._UDFS.clear()
