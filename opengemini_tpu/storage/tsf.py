@@ -28,6 +28,7 @@ import numpy as np
 
 from opengemini_tpu.record import Column, FieldType, Record
 from opengemini_tpu.storage import encoding
+from opengemini_tpu.utils.bloom import BloomFilter
 
 MAGIC = b"OGTSF01\n"
 END_MAGIC = b"OGTSFEND"
@@ -200,6 +201,15 @@ class TSFReader:
                 if self.tmax is None or cm.tmax > self.tmax:
                     self.tmax = cm.tmax
             self.meta[mst] = (schema, chunks)
+        # per-measurement sid bloom (reference: lib/bloomfilter): single-
+        # series lookups reject in O(k) instead of scanning chunk metas —
+        # built from in-memory metadata, so no format change
+        self._sid_bloom: dict[str, BloomFilter] = {}
+        for mst, (_s, chunks) in self.meta.items():
+            bf = BloomFilter(len(chunks))
+            for c in chunks:
+                bf.add(c.sid)
+            self._sid_bloom[mst] = bf
 
     def close(self) -> None:
         self._f.close()
@@ -223,6 +233,10 @@ class TSFReader:
         entry = self.meta.get(measurement)
         if entry is None:
             return []
+        if sids is not None and len(sids) == 1:
+            bf = self._sid_bloom.get(measurement)
+            if bf is not None and next(iter(sids)) not in bf:
+                return []
         out = []
         for c in entry[1]:
             if sids is not None and c.sid not in sids:

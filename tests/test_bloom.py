@@ -1,0 +1,51 @@
+"""Bloom filter tests (reference: lib/bloomfilter)."""
+
+import random
+
+from opengemini_tpu.utils.bloom import BloomFilter
+
+
+def test_no_false_negatives():
+    bf = BloomFilter(1000, fp_rate=0.01)
+    items = [random.randrange(2**60) for _ in range(1000)]
+    for x in items:
+        bf.add(x)
+    assert all(x in bf for x in items)
+
+
+def test_false_positive_rate_reasonable():
+    random.seed(7)
+    bf = BloomFilter(1000, fp_rate=0.01)
+    present = set()
+    for _ in range(1000):
+        x = random.randrange(2**60)
+        present.add(x)
+        bf.add(x)
+    fp = sum(1 for _ in range(10000)
+             if (y := random.randrange(2**60)) not in present and y in bf)
+    assert fp < 300  # ~1% target, allow 3%
+
+
+def test_str_and_bytes_keys():
+    assert "hello" not in BloomFilter(1)  # empty filter: deterministic False
+    bf2 = BloomFilter(4)
+    bf2.add("series,key=a")
+    assert "series,key=a" in bf2 and b"other" not in bf2
+
+
+def test_tsf_reader_bloom_rejects_absent_sid(tmp_path):
+    from opengemini_tpu.storage.engine import Engine
+
+    e = Engine(str(tmp_path / "b"))
+    e.create_database("db")
+    NS = 10**9
+    e.write_lines("db", "\n".join(
+        f"m,host=h{i} v={i} {(1_700_000_000 + i) * NS}" for i in range(20)))
+    e.flush_all()
+    sh = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+    r = sh._files[0]
+    real_sids = {c.sid for c in r.chunks("m")}
+    assert all(r.chunks("m", sids={s}) for s in real_sids)  # no false neg
+    absent = max(real_sids) + 1000
+    assert r.chunks("m", sids={absent}) == []
+    e.close()
