@@ -246,6 +246,16 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
         names = load_udfs(sc["castor-udf-dir"])
         if names:
             print(f"castor udfs loaded: {', '.join(names)}", flush=True)
+    if sc.get("obs-dir"):
+        from opengemini_tpu.services.obstier import ObsTierService
+        from opengemini_tpu.storage.objstore import FSObjectStore
+
+        svc.engine.attach_object_store(FSObjectStore(sc["obs-dir"]))
+        out.append(ObsTierService(
+            svc.engine,
+            int(float(sc.get("obs-age-days", 90)) * 86400e9),
+            float(sc.get("obs-interval-s", 3600)),
+        ))
     if sc.get("cold-dir"):
         from opengemini_tpu.services.hierarchical import HierarchicalService
 

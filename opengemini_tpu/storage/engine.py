@@ -143,6 +143,10 @@ class Engine:
         self.write_disabled = False
         self.read_disabled = False
         self._write_observers: list = []
+        # object-storage tier (reference: lib/fileops obs): shard groups
+        # offloaded to the store, hydrated back lazily on query
+        self.obs_store = None
+        self.obs_shards: set[tuple[str, str, int]] = set()
         self.databases: dict[str, Database] = {}
         # (db, rp, group_start) -> Shard
         self._shards: dict[tuple[str, str, int], Shard] = {}
@@ -180,9 +184,13 @@ class Engine:
                 sub = Subscription.from_json(sj)
                 db.subscriptions[sub.name] = sub
             self.databases[db.name] = db
+        self.obs_shards = {
+            (d, r, int(s)) for d, r, s in j.get("obs_shards", [])
+        }
 
     def _save_meta(self) -> None:
         j = {
+            "obs_shards": sorted(list(k) for k in self.obs_shards),
             "databases": [
                 {
                     "name": db.name,
@@ -227,6 +235,7 @@ class Engine:
                 shard = self._shards.pop(key)
                 shard.close()
                 _remove_shard_dir(shard.path)  # follows cold-tier symlinks
+            self._purge_obs(lambda k: k[0] == name)
             del self.databases[name]
             self._save_meta()
             p = os.path.join(self.root, "data", name)
@@ -239,6 +248,12 @@ class Engine:
             if d and name in d.rps:
                 del d.rps[name]
                 d.downsample.pop(name, None)  # policies die with their rp
+                for key in [k for k in self._shards
+                            if k[0] == db and k[1] == name]:
+                    shard = self._shards.pop(key)
+                    shard.close()
+                    _remove_shard_dir(shard.path)
+                self._purge_obs(lambda k: k[0] == db and k[1] == name)
                 if d.default_rp == name:
                     d.default_rp = "autogen" if "autogen" in d.rps else next(
                         iter(d.rps), "autogen"
@@ -295,6 +310,13 @@ class Engine:
         key = (db, rp, group_start)
         shard = self._shards.get(key)
         if shard is None:
+            if key in self.obs_shards:
+                # writes into an offloaded range must land in the HYDRATED
+                # group — a fresh empty shard here would later be clobbered
+                # by hydration and the writes silently lost
+                shard = self._hydrate_shard(db, rp, group_start)
+                if shard is not None:
+                    return shard
             shard = Shard(
                 self._shard_dir(db, rp, group_start),
                 group_start,
@@ -304,13 +326,144 @@ class Engine:
             self._shards[key] = shard
         return shard
 
+    def attach_object_store(self, store) -> None:
+        self.obs_store = store
+        # reconcile a crash between offload's registry save and the local
+        # removal: a group present BOTH locally and in the registry keeps
+        # the local copy (same or newer) and drops the stale store copy
+        from opengemini_tpu.storage.objstore import shard_prefix
+
+        with self._lock:
+            stale = [k for k in self.obs_shards if k in self._shards]
+            for db, rp, start in stale:
+                store.delete_prefix(shard_prefix(db, rp, start))
+                self.obs_shards.discard((db, rp, start))
+            if stale:
+                self._save_meta()
+
+    def offload_shard(self, db: str, rp: str, group_start: int) -> bool:
+        """Move one whole shard group into the object store (reference:
+        the obs cold tier). Readers holding fds keep working (files are
+        unlinked, not truncated); the group hydrates back on next query."""
+        from opengemini_tpu.storage.objstore import shard_prefix
+
+        if self.obs_store is None:
+            return False
+        import shutil as _shutil
+
+        with self._lock:
+            key = (db, rp, group_start)
+            shard = self._shards.get(key)
+            if shard is None:
+                return False
+            with shard._lock:
+                shard.flush()
+                prefix = shard_prefix(db, rp, group_start)
+                # follow a cold-tier symlink: files live at the target
+                real = os.path.realpath(shard.path)
+                for fname in sorted(os.listdir(real)):
+                    full = os.path.join(real, fname)
+                    if os.path.isfile(full):
+                        self.obs_store.put(f"{prefix}/{fname}", full)
+                shard.wal.close()
+                shard.index.close()
+            del self._shards[key]
+            # registry FIRST: a crash before the local removal leaves both
+            # copies (attach_object_store reconciles, preferring local); the
+            # reverse order would strand the data in the bucket unreferenced
+            self.obs_shards.add(key)
+            self._save_meta()
+            _remove_shard_dir(shard.path)  # follows cold-tier symlinks
+            return True
+
+    def _purge_obs(self, match) -> None:
+        """Drop offloaded-group registry entries (and bucket copies) whose
+        key satisfies `match` — DROP DATABASE/RP must not let a recreated
+        namespace resurrect old offloaded data. Caller holds the lock and
+        saves meta."""
+        from opengemini_tpu.storage.objstore import shard_prefix
+
+        for key in [k for k in self.obs_shards if match(k)]:
+            if self.obs_store is not None:
+                self.obs_store.delete_prefix(shard_prefix(*key))
+            self.obs_shards.discard(key)
+
+    def _download_group(self, db: str, rp: str, group_start: int) -> None:
+        """Pull an offloaded group's files into its shard dir. NO engine
+        lock held — with a real bucket this is seconds of network I/O and
+        must not stall every other query/write."""
+        from opengemini_tpu.storage.objstore import shard_prefix
+
+        prefix = shard_prefix(db, rp, group_start)
+        dest = self._shard_dir(db, rp, group_start)
+        for key in self.obs_store.list(prefix):
+            fname = key.rsplit("/", 1)[-1]
+            self.obs_store.get(key, os.path.join(dest, fname))
+
+    def _install_hydrated(self, db: str, rp: str, group_start: int,
+                          save: bool = True) -> "Shard":
+        """Open a downloaded group and register it (caller holds the
+        lock). Idempotent: an already-live shard is returned untouched —
+        never clobbered. The store copy is kept for future re-offload."""
+        key = (db, rp, group_start)
+        existing = self._shards.get(key)
+        if existing is not None:
+            self.obs_shards.discard(key)
+            return existing
+        d = self.databases[db]
+        dur = d.rps[rp].shard_duration_ns
+        shard = Shard(self._shard_dir(db, rp, group_start), group_start,
+                      group_start + dur, self.sync_wal)
+        self._shards[key] = shard
+        self.obs_shards.discard(key)
+        if save:
+            self._save_meta()
+        return shard
+
+    def _hydrate_shard(self, db: str, rp: str, group_start: int) -> "Shard | None":
+        """Download + install in one step (write path; caller holds the
+        engine lock — rare enough that blocking is acceptable there)."""
+        if self.obs_store is None:
+            return None
+        if (db, rp, group_start) in self._shards:
+            return self._install_hydrated(db, rp, group_start)
+        self._download_group(db, rp, group_start)
+        return self._install_hydrated(db, rp, group_start)
+
     def shards_for_range(self, db: str, rp: str | None, tmin: int, tmax: int) -> list[Shard]:
         """Shards overlapping [tmin, tmax) — the shard-mapping step
-        (reference coordinator/shard_mapper.go:61 MapShards)."""
+        (reference coordinator/shard_mapper.go:61 MapShards). Offloaded
+        (object-store) groups in range hydrate back first."""
         d = self.databases.get(db)
         if d is None:
             return []
         rp = rp or d.default_rp
+        if self.obs_shards and self.obs_store is not None:
+            with self._lock:
+                rp_meta = d.rps.get(rp)
+                dur = rp_meta.shard_duration_ns if rp_meta else 0
+                todo = [
+                    k for k in sorted(self.obs_shards)
+                    if k[0] == db and k[1] == rp and dur
+                    and k[2] + dur > tmin and k[2] < tmax
+                ]
+            for odb, orp, start in todo:
+                try:
+                    # download OUTSIDE the lock (bucket I/O must not stall
+                    # unrelated queries/writes), install under it
+                    if (odb, orp, start) not in self._shards:
+                        self._download_group(odb, orp, start)
+                    with self._lock:
+                        self._install_hydrated(odb, orp, start, save=False)
+                except Exception:  # noqa: BLE001
+                    import logging
+
+                    logging.getLogger("opengemini_tpu.engine").exception(
+                        "hydration of %s/%s/%d failed", odb, orp, start
+                    )
+            if todo:
+                with self._lock:
+                    self._save_meta()
         out = []
         for (sdb, srp, _start), shard in sorted(self._shards.items()):
             if sdb == db and srp == rp and shard.tmin < tmax and shard.tmax > tmin:
@@ -563,6 +716,22 @@ class Engine:
                     _remove_shard_dir(shard.path)
                     del self._shards[key]
                     dropped.append(key)
+            # offloaded groups age out too (delete the store copy)
+            for key in sorted(self.obs_shards):
+                db, rp, start = key
+                d = self.databases.get(db)
+                rp_meta = d.rps.get(rp) if d else None
+                if rp_meta is None or rp_meta.duration_ns == 0:
+                    continue
+                if start + rp_meta.shard_duration_ns <= now_ns - rp_meta.duration_ns:
+                    if self.obs_store is not None:
+                        from opengemini_tpu.storage.objstore import shard_prefix
+
+                        self.obs_store.delete_prefix(shard_prefix(db, rp, start))
+                    self.obs_shards.discard(key)
+                    dropped.append(key)
+            if dropped:
+                self._save_meta()
         return dropped
 
     def close(self) -> None:

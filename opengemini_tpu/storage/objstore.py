@@ -1,0 +1,81 @@
+"""Object-storage tier (reference: lib/fileops obs backends — cold
+shards live in a bucket, hot paths hydrate them back on demand).
+
+`ObjectStore` is the minimal interface a bucket needs (put/get/list/
+delete by key). `FSObjectStore` is the filesystem-backed implementation
+used for dev/test and network-less deployments; an S3/OBS client drops
+in behind the same five methods.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class ObjectStore:
+    def put(self, key: str, src_path: str) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def get(self, key: str, dst_path: str) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> list[str]:  # pragma: no cover
+        raise NotImplementedError
+
+    def delete_prefix(self, prefix: str) -> int:  # pragma: no cover
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:  # pragma: no cover
+        raise NotImplementedError
+
+
+class FSObjectStore(ObjectStore):
+    """Keys are relative POSIX-ish paths under a root directory."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        p = os.path.abspath(os.path.join(self.root, key))
+        if not p.startswith(self.root + os.sep):
+            raise ValueError(f"key escapes store root: {key!r}")
+        return p
+
+    def put(self, key: str, src_path: str) -> None:
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + ".tmp"
+        shutil.copyfile(src_path, tmp)
+        os.replace(tmp, dst)
+
+    def get(self, key: str, dst_path: str) -> None:
+        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        tmp = dst_path + ".tmp"
+        shutil.copyfile(self._path(key), tmp)
+        os.replace(tmp, dst_path)
+
+    def list(self, prefix: str) -> list[str]:
+        base = self._path(prefix)
+        out = []
+        if not os.path.isdir(base):
+            return out
+        for dirpath, _dirs, files in os.walk(base):
+            for f in files:
+                full = os.path.join(dirpath, f)
+                out.append(os.path.relpath(full, self.root))
+        return sorted(out)
+
+    def delete_prefix(self, prefix: str) -> int:
+        base = self._path(prefix)
+        n = len(self.list(prefix))
+        shutil.rmtree(base, ignore_errors=True)
+        return n
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+
+def shard_prefix(db: str, rp: str, group_start: int) -> str:
+    return f"shards/{db}/{rp}/{group_start}"

@@ -803,3 +803,148 @@ class TestCastorUDF:
             assert set(castor._UDFS) == {"two"}  # 'one' did not linger
         finally:
             castor._UDFS.clear()
+
+
+class TestObsTier:
+    def _obs_env(self, tmp_path):
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+        from opengemini_tpu.storage.objstore import FSObjectStore
+
+        e = Engine(str(tmp_path / "data"))
+        e.create_database("db")
+        store = FSObjectStore(str(tmp_path / "bucket"))
+        e.attach_object_store(store)
+        week = 7 * 86400
+        lines = "\n".join(
+            f"m,host=h{w % 2} v={w} {(BASE + w * week) * NS}"
+            for w in range(4))
+        e.write_lines("db", lines)
+        e.flush_all()
+        return e, Executor(e), store
+
+    def test_offload_hydrate_round_trip(self, tmp_path):
+        import os
+
+        from opengemini_tpu.services.obstier import ObsTierService
+
+        e, ex, store = self._obs_env(tmp_path)
+        week = 7 * 86400
+        n_before = len(e._shards)
+        svc = ObsTierService(e, age_ns=2 * week * NS)
+        # "now" = base + 4 weeks: the first two groups have aged out
+        moved = svc.handle(now_ns=(BASE + 4 * week) * NS)
+        assert moved == 2
+        assert len(e._shards) == n_before - 2
+        assert len(e.obs_shards) == 2
+        assert store.list("shards/db/autogen")  # files in the bucket
+        # the local dirs are gone
+        gone = [k for k in e.obs_shards]
+        for db, rp, start in gone:
+            assert not os.path.exists(e._shard_dir(db, rp, start))
+        # query touching the offloaded range hydrates + returns everything
+        out = q(ex, "SELECT count(v), sum(v) FROM m")
+        row = out["results"][0]["series"][0]["values"][0]
+        assert row[1] == 4 and row[2] == 0 + 1 + 2 + 3
+        assert len(e.obs_shards) == 0  # hydrated back
+        e.close()
+
+    def test_restart_keeps_offloaded_groups_queryable(self, tmp_path):
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.services.obstier import ObsTierService
+        from opengemini_tpu.storage.engine import Engine
+        from opengemini_tpu.storage.objstore import FSObjectStore
+
+        e, ex, store = self._obs_env(tmp_path)
+        week = 7 * 86400
+        ObsTierService(e, age_ns=2 * week * NS).handle(
+            now_ns=(BASE + 4 * week) * NS)
+        assert e.obs_shards
+        e.close()
+        e2 = Engine(str(tmp_path / "data"))
+        e2.attach_object_store(FSObjectStore(str(tmp_path / "bucket")))
+        assert len(e2.obs_shards) == 2  # registry persisted
+        out = Executor(e2).execute("SELECT count(v) FROM m", db="db")
+        assert out["results"][0]["series"][0]["values"][0][1] == 4
+        e2.close()
+
+    def test_retention_deletes_store_copies(self, tmp_path):
+        from opengemini_tpu.services.obstier import ObsTierService
+
+        e, ex, store = self._obs_env(tmp_path)
+        week = 7 * 86400
+        ObsTierService(e, age_ns=1 * week * NS).handle(
+            now_ns=(BASE + 10 * week) * NS)
+        assert len(e.obs_shards) == 4
+        q(ex, "CREATE RETENTION POLICY short ON db DURATION 1h REPLICATION 1")
+        # shrink autogen's duration directly (ALTER analogue)
+        e.databases["db"].rps["autogen"].duration_ns = 1 * week * NS
+        dropped = e.drop_expired_shards(now_ns=(BASE + 100 * week) * NS)
+        assert len(dropped) == 4
+        assert not e.obs_shards
+        assert store.list("shards/db/autogen") == []  # bucket emptied
+        e.close()
+
+    def test_write_into_offloaded_range_merges(self, tmp_path):
+        """Writes landing in an offloaded group's range must hydrate the
+        group first — not create a fresh shard hydration later clobbers."""
+        from opengemini_tpu.services.obstier import ObsTierService
+
+        e, ex, store = self._obs_env(tmp_path)
+        week = 7 * 86400
+        ObsTierService(e, age_ns=1 * week * NS).handle(
+            now_ns=(BASE + 10 * week) * NS)
+        assert len(e.obs_shards) == 4
+        # write a NEW point into the first offloaded group's range
+        e.write_lines("db", f"m,host=h0 v=100 {(BASE + 3600) * NS}")
+        out = q(ex, "SELECT count(v), sum(v) FROM m")
+        row = out["results"][0]["series"][0]["values"][0]
+        assert row[1] == 5 and row[2] == 0 + 1 + 2 + 3 + 100  # old + new
+        e.close()
+
+    def test_crash_between_registry_and_removal_prefers_local(self, tmp_path):
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+        from opengemini_tpu.storage.objstore import FSObjectStore, shard_prefix
+
+        e, ex, store = self._obs_env(tmp_path)
+        # simulate the crash window: registry written, local dir kept
+        key = sorted(e._shards)[0]
+        db, rp, start = key
+        prefix = shard_prefix(db, rp, start)
+        sh = e._shards[key]
+        sh.flush()
+        import os
+
+        for fname in sorted(os.listdir(sh.path)):
+            full = os.path.join(sh.path, fname)
+            if os.path.isfile(full):
+                store.put(f"{prefix}/{fname}", full)
+        e.obs_shards.add(key)
+        e._save_meta()
+        e.close()
+        e2 = Engine(str(tmp_path / "data"))
+        e2.attach_object_store(FSObjectStore(str(tmp_path / "bucket")))
+        assert key not in e2.obs_shards  # reconciled: local wins
+        assert store.list(prefix) == []  # stale bucket copy removed
+        out = Executor(e2).execute("SELECT count(v) FROM m", db="db")
+        assert out["results"][0]["series"][0]["values"][0][1] == 4
+        e2.close()
+
+    def test_drop_database_purges_bucket(self, tmp_path):
+        from opengemini_tpu.services.obstier import ObsTierService
+
+        e, ex, store = self._obs_env(tmp_path)
+        week = 7 * 86400
+        ObsTierService(e, age_ns=1 * week * NS).handle(
+            now_ns=(BASE + 10 * week) * NS)
+        e.drop_database("db")
+        assert not e.obs_shards
+        assert store.list("shards/db") == []
+        # recreate: nothing resurrects
+        e.create_database("db")
+        from opengemini_tpu.query.executor import Executor
+
+        out = Executor(e).execute("SELECT count(v) FROM m", db="db")
+        assert "series" not in out["results"][0]
+        e.close()
