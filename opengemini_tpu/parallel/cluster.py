@@ -24,22 +24,35 @@ from opengemini_tpu.index.inverted import SeriesIndex
 from opengemini_tpu.record import Column, FieldType, Record
 
 
-def owner(nodes: list[str], db: str, rp: str, group_start: int) -> str:
-    """Rendezvous hash: the node with the highest keyed digest owns the
-    shard group (deterministic on every node, no coordination)."""
-    best, best_score = None, -1
+def owners(nodes: list[str], db: str, rp: str, group_start: int,
+           rf: int = 1) -> list[str]:
+    """Rendezvous hash: the rf nodes with the highest keyed digests own
+    the shard group, primary first (deterministic on every node, no
+    coordination; node add/remove moves ~1/N of groups)."""
+    scored = []
     for n in sorted(nodes):
         h = hashlib.blake2b(
             f"{n}|{db}|{rp}|{group_start}".encode(), digest_size=8
         ).digest()
-        score = int.from_bytes(h, "big")
-        if score > best_score:
-            best, best_score = n, score
-    return best
+        scored.append((int.from_bytes(h, "big"), n))
+    scored.sort(reverse=True)
+    return [n for _s, n in scored[: max(1, rf)]]
+
+
+def owner(nodes: list[str], db: str, rp: str, group_start: int) -> str:
+    return owners(nodes, db, rp, group_start, 1)[0]
 
 
 class RemoteScanError(Exception):
     """A data node required for a complete answer was unreachable."""
+
+
+class _NodeDown(Exception):
+    """Internal: one specific peer failed (drives replica failover)."""
+
+    def __init__(self, nid: str, msg: str):
+        super().__init__(msg)
+        self.nid = nid
 
 
 class _RemoteMem:
@@ -123,11 +136,15 @@ class RemoteShard:
         return Record(times[lo:hi], cols)
 
 
-def serialize_series(engine, db, rp, mst, tmin, tmax) -> dict:
+def serialize_series(engine, db, rp, mst, tmin, tmax,
+                     shard_filter=None) -> dict:
     """Owner-side /internal/scan body: every series of `mst` in range,
     merged across local shards (shards are disjoint in time, memtable
-    merged per shard by read_series)."""
+    merged per shard by read_series). `shard_filter(shard)` restricts to
+    groups this node is PRIMARY for (rf>1 reads)."""
     shards = engine.shards_for_range(db, rp, tmin, tmax)
+    if shard_filter is not None:
+        shards = [sh for sh in shards if shard_filter(sh)]
     schema: dict[str, str] = {}
     by_key: dict[tuple, dict] = {}
     for sh in sorted(shards, key=lambda s: s.tmin):
@@ -173,13 +190,17 @@ class DataRouter:
     writes there, and pull raw columns back for queries."""
 
     def __init__(self, engine, meta_store, self_id: str, self_addr: str,
-                 token: str = "", timeout_s: float = 10.0):
+                 token: str = "", timeout_s: float = 10.0, rf: int = 1):
         self.engine = engine
         self.meta_store = meta_store
         self.self_id = self_id
         self.self_addr = self_addr
         self.token = token
         self.timeout_s = timeout_s
+        # replication factor: every shard group lives on the rf top
+        # rendezvous owners; reads are primary-filtered so replicas never
+        # double-count (HA ops analogue of the reference's replication)
+        self.rf = max(1, rf)
 
     def data_nodes(self) -> dict[str, str]:
         nodes = {
@@ -203,29 +224,45 @@ class DataRouter:
         return t_ns // dur * dur
 
     def split_points(self, db: str, rp: str | None, points: list):
-        """points -> (local, {node_id: [points]}) by shard-group owner."""
+        """points -> (local, {node_id: [points]}): every point goes to ALL
+        rf owners of its shard group (replicas get their own copy)."""
         from opengemini_tpu.storage.engine import DatabaseNotFound
 
         d = self.engine.databases.get(db)
         if d is None:
             raise DatabaseNotFound(db)
         rp_name = rp or d.default_rp
-        nodes = self.data_nodes()
-        ids = sorted(nodes)
+        ids = sorted(self.data_nodes())
         local, remote = [], {}
         for p in points:
-            o = owner(ids, db, rp_name, self._group_start(db, rp, p[2]))
-            if o == self.self_id:
-                local.append(p)
-            else:
-                remote.setdefault(o, []).append(p)
+            dest = owners(ids, db, rp_name,
+                          self._group_start(db, rp, p[2]), self.rf)
+            for o in dest:
+                if o == self.self_id:
+                    local.append(p)
+                else:
+                    remote.setdefault(o, []).append(p)
         return local, remote
+
+    def is_primary(self, db: str, rp: str | None, group_start: int,
+                   live: list[str]) -> bool:
+        """Is this node the group's PRIMARY among `live` owners? Reads
+        with rf>1 include each group exactly once via this filter."""
+        d = self.engine.databases.get(db)
+        rp_name = rp or (d.default_rp if d else "autogen")
+        return owners(sorted(live), db, rp_name, group_start, 1)[0] == self.self_id
 
     def routed_write(self, db: str, rp: str | None, points: list) -> int:
         """The one coordinator-write sequence (used by HTTP /write and
         SELECT INTO): split by owner, write the local slice structurally,
         forward the rest as STRUCTURED JSON — line-protocol text cannot
-        carry arbitrary content (e.g. newlines in string fields)."""
+        carry arbitrary content (e.g. newlines in string fields).
+
+        Replicated writes (rf>1) are all-or-error: a down replica fails
+        the request AFTER other copies may have applied. That partial
+        state is retry-healable — points are idempotent under timestamp
+        last-write-wins — so clients must treat an error as 'retry',
+        never 'partially ok'."""
         local, remote = self.split_points(db, rp, points)
         n = 0
         if local:
@@ -282,28 +319,61 @@ class DataRouter:
         with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
             return json.loads(r.read())
 
-    def fetch_remote_shards(self, db: str, rp: str | None, mst: str,
-                            tmin: int, tmax: int) -> list[RemoteShard]:
-        """One RemoteShard per peer holding matching data. Unreachable
-        peers raise: a silently partial answer is a wrong answer."""
+    def scan_shards(self, db: str, rp: str | None, mst: str,
+                    tmin: int, tmax: int):
+        """(remote shards, live node set). With rf>1 each group is served
+        exactly once by its primary AMONG THE LIVE SET: dead peers are
+        dropped from `live` (all at once — one retry round) and the
+        group's next owner becomes primary (replica failover). At most
+        rf-1 dead nodes are tolerable: every group has rf distinct
+        owners, so with >= rf nodes down SOME group may have lost every
+        copy — the query fails rather than answer partially. rf=1
+        tolerates none for the same reason."""
+        nodes = self.data_nodes()
+        live = sorted(nodes)
+        dropped: list[str] = []
+        while True:
+            payloads, dead = self._fetch_once(db, rp, mst, tmin, tmax, live)
+            if not dead:
+                out = [RemoteShard(mst, p) for p in payloads
+                       if p.get("series")]
+                return out, live
+            dropped.extend(sorted(dead))
+            if len(dropped) >= self.rf:
+                raise RemoteScanError(
+                    f"{len(dropped)} data nodes unreachable "
+                    f"({', '.join(dropped)}) with replication factor "
+                    f"{self.rf}: some shard groups may have no live copy"
+                )
+            live = [n for n in live if n not in dead]
+
+    def _fetch_once(self, db, rp, mst, tmin, tmax, live):
+        """One fan-out round. Returns (payloads, dead node ids) —
+        collecting EVERY dead peer in the round so failover retries once,
+        not once per dead node."""
         def fetch(nid, addr):
+            if nid not in live:
+                return {}
             if not addr:
-                raise RemoteScanError(f"no address for data node {nid!r}")
+                return _NodeDown(nid, f"no address for data node {nid!r}")
             try:
                 return self._post(addr, "/internal/scan", {
                     "db": db, "rp": rp, "mst": mst,
                     "tmin": tmin, "tmax": tmax,
+                    "live": live, "rf": self.rf,
                 })
             except OSError as e:
-                raise RemoteScanError(
-                    f"data node {nid!r} ({addr}) unreachable: {e}"
-                ) from e
+                return _NodeDown(
+                    nid, f"data node {nid!r} ({addr}) unreachable: {e}"
+                )
 
-        out = []
-        for payload in self._fanout(fetch):
-            if payload.get("series"):
-                out.append(RemoteShard(mst, payload))
-        return out
+        payloads, dead = [], set()
+        for got in self._fanout(fetch):
+            if isinstance(got, _NodeDown):
+                dead.add(got.nid)
+            else:
+                payloads.append(got)
+        return payloads, dead
 
     def _fanout(self, fetch):
         """Run fetch(nid, addr) against every peer concurrently; one slow
@@ -318,18 +388,26 @@ class DataRouter:
             return list(pool.map(lambda p: fetch(*p), peers))
 
     def remote_measurements(self, db: str, rp: str | None) -> set[str]:
+        """Measurement names across peers, with the same rf-1 dead-node
+        tolerance as scans (names are replicated with the data)."""
         def fetch(nid, addr):
             if not addr:
-                return {}
+                return _NodeDown(nid, f"no address for data node {nid!r}")
             try:
                 return self._post(addr, "/internal/measurements",
                                   {"db": db, "rp": rp})
             except OSError as e:
-                raise RemoteScanError(
-                    f"data node {nid!r} ({addr}) unreachable: {e}"
-                ) from e
+                return _NodeDown(
+                    nid, f"data node {nid!r} ({addr}) unreachable: {e}"
+                )
 
         names: set[str] = set()
-        for payload in self._fanout(fetch):
-            names.update(payload.get("measurements", []))
+        dead: list[_NodeDown] = []
+        for got in self._fanout(fetch):
+            if isinstance(got, _NodeDown):
+                dead.append(got)
+            else:
+                names.update(got.get("measurements", []))
+        if len(dead) >= self.rf:
+            raise RemoteScanError(str(dead[0]))
         return names

@@ -956,11 +956,19 @@ class Executor:
         if self.router is not None:
             pre = cond.split(condition, set(), now_ns)
             try:
-                shards = shards + self.router.fetch_remote_shards(
+                remote, live = self.router.scan_shards(
                     db, rp, mst, pre.tmin, pre.tmax
                 )
             except Exception as e:  # noqa: BLE001 — partial data = wrong data
                 raise QueryError(str(e)) from e
+            if self.router.rf > 1:
+                # replicated groups: keep only those WE are primary for
+                # among the live set; replicas held here would double-count
+                shards = [
+                    sh for sh in shards
+                    if self.router.is_primary(db, rp, sh.tmin, live)
+                ]
+            shards = shards + remote
         return shards
 
     def _scan_context(self, stmt, db, rp, mst, now_ns):

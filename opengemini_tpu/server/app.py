@@ -111,6 +111,7 @@ def build(cfg: dict) -> HttpService:
         svc.router = DataRouter(
             engine, svc.meta_store, meta_cfg["node-id"], advertise,
             token=meta_cfg.get("token", ""),
+            rf=int(cluster_cfg.get("replication-factor", 1)),
         )
         svc.executor.router = svc.router
         if svc.flight is not None:

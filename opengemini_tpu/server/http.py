@@ -313,9 +313,21 @@ def _make_handler(svc: HttpService):
                     self._send_json(403, {"error": "cluster token required"})
                     return
                 if path == "/internal/scan":
+                    shard_filter = None
+                    live = req.get("live")
+                    if (int(req.get("rf", 1)) > 1 and live
+                            and svc.router is not None):
+                        # replicated groups: serve only those this node is
+                        # PRIMARY for among the caller's live set, so each
+                        # group is counted exactly once cluster-wide
+                        shard_filter = (
+                            lambda sh: svc.router.is_primary(
+                                req["db"], req.get("rp"), sh.tmin, live)
+                        )
                     payload = serialize_series(
                         svc.engine, req["db"], req.get("rp"), req.get("mst", ""),
                         int(req.get("tmin", -(2**62))), int(req.get("tmax", 2**62)),
+                        shard_filter=shard_filter,
                     )
                 else:
                     names = set()

@@ -83,8 +83,13 @@ class TestRemoteShardProxy:
                       f"cpu,host=c v=7 {(BASE + 3660) * NS}")
 
         class StubRouter:
+            rf = 1
+
             def fetch_remote_shards(self, db, rp, mst, tmin, tmax):
                 return [remote] if mst == "cpu" else []
+
+            def scan_shards(self, db, rp, mst, tmin, tmax):
+                return self.fetch_remote_shards(db, rp, mst, tmin, tmax), []
 
             def remote_measurements(self, db, rp):
                 return {"cpu"}
@@ -118,7 +123,9 @@ class TestRemoteShardProxy:
         local.write_lines("db", f"cpu v=1 {BASE * NS}")
 
         class DeadRouter:
-            def fetch_remote_shards(self, db, rp, mst, tmin, tmax):
+            rf = 1
+
+            def scan_shards(self, db, rp, mst, tmin, tmax):
                 raise OSError("connection refused")
 
         ex = Executor(local)
@@ -171,8 +178,10 @@ class TestReviewRegressions:
         remote = RemoteShard("cpu", payload)
 
         class StubRouter:
-            def fetch_remote_shards(self, db, rp, mst, tmin, tmax):
-                return [remote]
+            rf = 1
+
+            def scan_shards(self, db, rp, mst, tmin, tmax):
+                return [remote], []
 
             def remote_measurements(self, db, rp):
                 return {"cpu"}
@@ -207,8 +216,10 @@ class TestReviewRegressions:
         local.write_lines("db", f"cpu v=1 {BASE * NS}")
 
         class StubRouter:
-            def fetch_remote_shards(self, db, rp, mst, tmin, tmax):
-                return []
+            rf = 1
+
+            def scan_shards(self, db, rp, mst, tmin, tmax):
+                return [], []
 
             def remote_measurements(self, db, rp):
                 return {"remote_only"}
@@ -275,8 +286,10 @@ class TestClusteredCQAndInto:
                 return True
 
         class NullRouter:
-            def fetch_remote_shards(self, *a):
-                return []
+            rf = 1
+
+            def scan_shards(self, *a):
+                return [], []
 
             def remote_measurements(self, *a):
                 return set()
@@ -316,8 +329,8 @@ class TestClusteredCQAndInto:
         forwarded = []
         router.forward_points = lambda nid, db, rp, pts: forwarded.append(
             (nid, pts))
-        # fetch_remote_shards must exist for the read side; no remote data
-        router.fetch_remote_shards = lambda *a: []
+        # scan path must exist for the read side; no remote data
+        router.scan_shards = lambda *a: ([], [])
         router.remote_measurements = lambda *a: set()
         ex = Executor(eng)
         ex.router = router
@@ -362,3 +375,151 @@ class TestClusteredCQAndInto:
         assert decoded[3]["s"] == ["STRING", nasty]  # content intact
         assert decoded[1] == [["tag k", "v,1"]]
         eng.close()
+
+
+class TestReplicationFactor:
+    def test_owners_topn_and_stability(self):
+        from opengemini_tpu.parallel.cluster import owners
+
+        nodes = ["n1", "n2", "n3", "n4"]
+        for g in range(50):
+            o2 = owners(nodes, "db", "rp", g, 2)
+            assert len(o2) == 2 and len(set(o2)) == 2
+            assert o2 == owners(nodes, "db", "rp", g, 2)  # deterministic
+            assert o2[0] == owners(nodes, "db", "rp", g, 1)[0]  # prefix
+            # removing a non-owner never changes the owner pair
+            others = [n for n in nodes if n not in o2]
+            assert owners([n for n in nodes if n != others[0]],
+                          "db", "rp", g, 2) == o2
+
+    def _mk_cluster(self, tmp_path, rf):
+        """3 real HTTP nodes with routers (manual meta wiring)."""
+        from opengemini_tpu.parallel.cluster import DataRouter
+        from opengemini_tpu.server.http import HttpService
+
+        nodes = {}
+        addrs = {}
+        for nid in ("nA", "nB", "nC"):
+            e = Engine(str(tmp_path / nid))
+            e.create_database("db")
+            svc = HttpService(e, "127.0.0.1", 0)
+            svc.start()
+            addrs[nid] = f"127.0.0.1:{svc.port}"
+            nodes[nid] = (e, svc)
+
+        class FsmStub:
+            def __init__(self):
+                self.nodes = {n: {"addr": a, "role": "data"}
+                              for n, a in addrs.items()}
+
+        class StoreStub:
+            fsm = FsmStub()
+            token = ""
+
+        for nid, (e, svc) in nodes.items():
+            svc.router = DataRouter(e, StoreStub(), nid, addrs[nid], rf=rf)
+            svc.executor.router = svc.router
+        return nodes, addrs
+
+    def test_rf2_write_read_and_failover(self, tmp_path):
+        import urllib.request
+
+        nodes, addrs = self._mk_cluster(tmp_path, rf=2)
+        week = 7 * 86400
+        lines = "\n".join(
+            f"m v={w} {(BASE + w * week) * NS}" for w in range(12))
+        req = urllib.request.Request(
+            f"http://{addrs['nA']}/write?db=db", data=lines.encode(),
+            method="POST")
+        urllib.request.urlopen(req, timeout=30).read()
+
+        def rows_on(nid):
+            e = nodes[nid][0]
+            return sum(
+                len(sh.read_series("m", sid).times)
+                for sh in e.shards_for_range("db", None, -(2**62), 2**62)
+                for sid in sh.index.series_ids("m"))
+
+        total_copies = sum(rows_on(n) for n in nodes)
+        assert total_copies == 24  # every point on exactly 2 nodes
+
+        def query(nid, q):
+            import json as _json
+            import urllib.parse
+
+            url = (f"http://{addrs[nid]}/query?" +
+                   urllib.parse.urlencode({"q": q, "db": "db"}))
+            with urllib.request.urlopen(url, timeout=60) as r:
+                return _json.loads(r.read())
+
+        for nid in nodes:
+            res = query(nid, "SELECT count(v), sum(v) FROM m")
+            row = res["results"][0]["series"][0]["values"][0]
+            assert row[1] == 12 and row[2] == sum(range(12)), (nid, row)
+        # kill one node: every query still returns the FULL answer from
+        # the surviving replicas
+        dead = "nB"
+        nodes[dead][1].stop()
+        for nid in nodes:
+            if nid == dead:
+                continue
+            res = query(nid, "SELECT count(v), sum(v) FROM m")
+            row = res["results"][0]["series"][0]["values"][0]
+            assert row[1] == 12 and row[2] == sum(range(12)), (nid, row)
+        for nid, (e, svc) in nodes.items():
+            if nid != dead:
+                svc.stop()
+            e.close()
+
+    def test_too_many_dead_nodes_fails_not_partial(self, tmp_path):
+        """With rf=2 and BOTH owners of some group possibly down (>= rf
+        dead nodes), the query must FAIL rather than answer partially."""
+        import urllib.request
+
+        nodes, addrs = self._mk_cluster(tmp_path, rf=2)
+        week = 7 * 86400
+        lines = "\n".join(
+            f"m v={w} {(BASE + w * week) * NS}" for w in range(12))
+        req = urllib.request.Request(
+            f"http://{addrs['nA']}/write?db=db", data=lines.encode(),
+            method="POST")
+        urllib.request.urlopen(req, timeout=30).read()
+        nodes["nB"][1].stop()
+        nodes["nC"][1].stop()
+        import json as _json
+        import urllib.parse
+
+        url = (f"http://{addrs['nA']}/query?" + urllib.parse.urlencode(
+            {"q": "SELECT count(v) FROM m", "db": "db"}))
+        with urllib.request.urlopen(url, timeout=90) as r:
+            res = _json.loads(r.read())
+        err = res["results"][0].get("error", "")
+        assert "no live copy" in err, res
+        nodes["nA"][1].stop()
+        for nid, (e, _svc) in nodes.items():
+            e.close()
+
+    def test_show_measurements_survives_one_dead_node_rf2(self, tmp_path):
+        import json as _json
+        import urllib.parse
+        import urllib.request
+
+        nodes, addrs = self._mk_cluster(tmp_path, rf=2)
+        week = 7 * 86400
+        lines = "\n".join(
+            f"m v={w} {(BASE + w * week) * NS}" for w in range(6))
+        req = urllib.request.Request(
+            f"http://{addrs['nA']}/write?db=db", data=lines.encode(),
+            method="POST")
+        urllib.request.urlopen(req, timeout=30).read()
+        nodes["nB"][1].stop()
+        url = (f"http://{addrs['nA']}/query?" + urllib.parse.urlencode(
+            {"q": "SHOW MEASUREMENTS", "db": "db"}))
+        with urllib.request.urlopen(url, timeout=60) as r:
+            res = _json.loads(r.read())
+        vals = res["results"][0]["series"][0]["values"]
+        assert ["m"] in vals, res
+        for nid, (e, svc) in nodes.items():
+            if nid != "nB":
+                svc.stop()
+            e.close()
