@@ -136,12 +136,17 @@ class RemoteShard:
         return Record(times[lo:hi], cols)
 
 
-def serialize_series(engine, db, rp, mst, tmin, tmax,
-                     shard_filter=None) -> dict:
-    """Owner-side /internal/scan body: every series of `mst` in range,
-    merged across local shards (shards are disjoint in time, memtable
-    merged per shard by read_series). `shard_filter(shard)` restricts to
-    groups this node is PRIMARY for (rf>1 reads)."""
+# explicit little-endian wire dtypes: a big-endian peer must not emit
+# native-order buffers a little-endian coordinator misreads
+_BIN_DTYPES = {"FLOAT": "<f8", "INT": "<i8", "BOOL": "u1"}
+_PAD_DTYPES = {"FLOAT": np.float64, "INT": np.int64, "BOOL": bool}
+
+
+def _collect_series(engine, db, rp, mst, tmin, tmax, shard_filter=None):
+    """Shared scan collector: (schema, [{tags, times(ndarray),
+    fields: {name: (type, values(ndarray), valid(ndarray))}}]) — column
+    arrays stay numpy end to end (no per-value Python boxing); invalid
+    slots are zeroed so neither wire format leaks stale memory."""
     shards = engine.shards_for_range(db, rp, tmin, tmax)
     if shard_filter is not None:
         shards = [sh for sh in shards if shard_filter(sh)]
@@ -157,32 +162,133 @@ def serialize_series(engine, db, rp, mst, tmin, tmax,
             tags = sh.index.tags_of(sid)
             key = tuple(sorted(tags.items()))
             entry = by_key.setdefault(
-                key, {"tags": dict(tags), "times": [], "fields": {}}
+                key, {"tags": dict(tags), "chunks": []}
             )
-            base = len(entry["times"])
-            entry["times"].extend(int(t) for t in rec.times)
-            for name, col in rec.columns.items():
-                f = entry["fields"].setdefault(
-                    name, {"type": col.ftype.name, "values": [], "valid": []}
+            entry["chunks"].append(
+                (rec.times,
+                 {n: (c.values, c.valid) for n, c in rec.columns.items()})
+            )
+    out = []
+    for entry in by_key.values():
+        chunks = entry["chunks"]
+        times = np.concatenate([c[0] for c in chunks])
+        fnames = sorted({n for _t, cols in chunks for n in cols})
+        fields = {}
+        for name in fnames:
+            ftype = schema.get(name, "FLOAT")
+            pad_dt = _PAD_DTYPES.get(ftype, object)
+            parts_v, parts_m = [], []
+            for c_times, cols in chunks:
+                got = cols.get(name)
+                if got is None:  # field absent from this shard's chunk
+                    parts_v.append(np.zeros(len(c_times), pad_dt))
+                    parts_m.append(np.zeros(len(c_times), bool))
+                else:
+                    parts_v.append(got[0])
+                    parts_m.append(got[1])
+            values = np.concatenate(parts_v)
+            valid = np.concatenate(parts_m).astype(bool)
+            if ftype == "STRING":
+                values = np.asarray(
+                    [v if b else 0 for v, b in zip(values, valid)], object
                 )
-                # pad fields that appeared late in this series
-                pad = base - len(f["values"])
-                if pad > 0:
-                    f["values"].extend([0] * pad)
-                    f["valid"].extend([False] * pad)
-                vals = col.values.tolist()
-                f["values"].extend(
-                    v if b else 0 for v, b in zip(vals, col.valid.tolist())
-                )
-                f["valid"].extend(bool(b) for b in col.valid.tolist())
-            # pad fields missing from this shard's chunk
-            n = len(entry["times"])
-            for f in entry["fields"].values():
-                if len(f["values"]) < n:
-                    pad = n - len(f["values"])
-                    f["values"].extend([0] * pad)
-                    f["valid"].extend([False] * pad)
-    return {"schema": schema, "series": list(by_key.values())}
+            else:
+                values = np.where(valid, values, 0)
+            fields[name] = (ftype, values, valid)
+        out.append({"tags": entry["tags"], "times": times, "fields": fields})
+    return schema, out
+
+
+def serialize_series(engine, db, rp, mst, tmin, tmax,
+                     shard_filter=None) -> dict:
+    """JSON /internal/scan body (fallback wire format): every series of
+    `mst` in range, merged across local shards. `shard_filter(shard)`
+    restricts to groups this node is PRIMARY for (rf>1 reads)."""
+    schema, series = _collect_series(engine, db, rp, mst, tmin, tmax,
+                                     shard_filter)
+    out = []
+    for s in series:
+        fields = {}
+        for name, (ftype, values, valid) in s["fields"].items():
+            fields[name] = {"type": ftype, "values": values.tolist(),
+                            "valid": valid.tolist()}
+        out.append({"tags": s["tags"], "times": s["times"].tolist(),
+                    "fields": fields})
+    return {"schema": schema, "series": out}
+
+
+def serialize_series_binary(engine, db, rp, mst, tmin, tmax,
+                            shard_filter=None) -> bytes:
+    """Binary /internal/scan payload: [u32 header_len][header JSON]
+    [raw column buffers]. Numeric columns and times travel as raw
+    LITTLE-ENDIAN ndarrays (memcpy in, frombuffer out) instead of JSON
+    number lists — the data-plane wire bottleneck. String columns stay
+    JSON inside the header (rare, variable-width)."""
+    import struct as _struct
+
+    schema, series = _collect_series(engine, db, rp, mst, tmin, tmax,
+                                     shard_filter)
+    buffers: list[bytes] = []
+    off = 0
+
+    def _add(arr: np.ndarray, dtype: str) -> list[int]:
+        nonlocal off
+        b = np.ascontiguousarray(arr.astype(dtype, copy=False)).tobytes()
+        buffers.append(b)
+        loc = [off, len(b)]
+        off += len(b)
+        return loc
+
+    header = {"schema": schema, "series": []}
+    for s in series:
+        entry = {"tags": s["tags"],
+                 "times": _add(s["times"], "<i8"), "fields": {}}
+        for name, (ftype, values, valid) in s["fields"].items():
+            f = {"type": ftype, "valid": _add(valid, "u1")}
+            dt = _BIN_DTYPES.get(ftype)
+            if dt is not None:
+                f["values"] = _add(values, dt)
+            else:  # STRING: JSON in the header
+                f["strings"] = values.tolist()
+            entry["fields"][name] = f
+        header["series"].append(entry)
+    hbuf = json.dumps(header, separators=(",", ":")).encode()
+    return _struct.pack("<I", len(hbuf)) + hbuf + b"".join(buffers)
+
+
+def parse_series_binary(data: bytes) -> dict:
+    """Inverse of serialize_series_binary -> the JSON-shaped doc
+    RemoteShard consumes (arrays stay numpy, no per-value boxing)."""
+    import struct as _struct
+
+    (hlen,) = _struct.unpack_from("<I", data, 0)
+    header = json.loads(data[4 : 4 + hlen])
+    base = 4 + hlen
+    payload = memoryview(data)[base:]
+
+    def _arr(loc, dtype):
+        o, ln = loc
+        return np.frombuffer(payload[o : o + ln], dtype=dtype)
+
+    out = {"schema": header["schema"], "series": []}
+    for s in header["series"]:
+        fields = {}
+        for name, f in s["fields"].items():
+            t = f["type"]
+            valid = _arr(f["valid"], "u1").astype(bool)
+            if "values" in f:
+                values = _arr(f["values"], _BIN_DTYPES[t])
+                if t == "BOOL":
+                    values = values.astype(bool)
+            else:
+                values = f["strings"]
+            fields[name] = {"type": t, "values": values, "valid": valid}
+        out["series"].append({
+            "tags": s["tags"],
+            "times": _arr(s["times"], "<i8"),
+            "fields": fields,
+        })
+    return out
 
 
 class DataRouter:
@@ -310,14 +416,28 @@ class DataRouter:
         )
         urllib.request.urlopen(req, timeout=self.timeout_s).read()
 
-    def _post(self, addr: str, path: str, body: dict) -> dict:
+    def _post_raw(self, addr: str, path: str, body: dict):
+        """One internal-POST implementation (token injection, timeout);
+        returns (bytes, content_type)."""
         req = urllib.request.Request(
             f"http://{addr}{path}",
             data=json.dumps(dict(body, token=self.token)).encode("utf-8"),
             headers={"Content-Type": "application/json"}, method="POST",
         )
         with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-            return json.loads(r.read())
+            return r.read(), r.headers.get("Content-Type", "")
+
+    def _post(self, addr: str, path: str, body: dict) -> dict:
+        data, _ct = self._post_raw(addr, path, body)
+        return json.loads(data)
+
+    def _post_scan(self, addr: str, body: dict) -> dict:
+        """Scan post accepting the binary wire format (raw ndarray
+        buffers) with JSON fallback for peers that ignore fmt."""
+        data, ctype = self._post_raw(addr, "/internal/scan", body)
+        if ctype.startswith("application/octet-stream"):
+            return parse_series_binary(data)
+        return json.loads(data)
 
     def scan_shards(self, db: str, rp: str | None, mst: str,
                     tmin: int, tmax: int):
@@ -357,10 +477,10 @@ class DataRouter:
             if not addr:
                 return _NodeDown(nid, f"no address for data node {nid!r}")
             try:
-                return self._post(addr, "/internal/scan", {
+                return self._post_scan(addr, {
                     "db": db, "rp": rp, "mst": mst,
                     "tmin": tmin, "tmax": tmax,
-                    "live": live, "rf": self.rf,
+                    "live": live, "rf": self.rf, "fmt": "bin",
                 })
             except OSError as e:
                 return _NodeDown(

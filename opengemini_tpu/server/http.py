@@ -324,10 +324,21 @@ def _make_handler(svc: HttpService):
                             lambda sh: svc.router.is_primary(
                                 req["db"], req.get("rp"), sh.tmin, live)
                         )
+                    args = (svc.engine, req["db"], req.get("rp"),
+                            req.get("mst", ""),
+                            int(req.get("tmin", -(2**62))),
+                            int(req.get("tmax", 2**62)))
+                    if req.get("fmt") == "bin":
+                        from opengemini_tpu.parallel.cluster import (
+                            serialize_series_binary,
+                        )
+
+                        self._send(200, serialize_series_binary(
+                            *args, shard_filter=shard_filter),
+                            ctype="application/octet-stream")
+                        return
                     payload = serialize_series(
-                        svc.engine, req["db"], req.get("rp"), req.get("mst", ""),
-                        int(req.get("tmin", -(2**62))), int(req.get("tmax", 2**62)),
-                        shard_filter=shard_filter,
+                        *args, shard_filter=shard_filter,
                     )
                 else:
                     names = set()

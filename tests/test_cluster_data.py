@@ -523,3 +523,46 @@ class TestReplicationFactor:
             if nid != "nB":
                 svc.stop()
             e.close()
+
+
+class TestBinaryWire:
+    def test_binary_round_trip_equals_json(self, tmp_path):
+        import numpy as np
+
+        from opengemini_tpu.parallel.cluster import (
+            parse_series_binary, serialize_series, serialize_series_binary,
+        )
+
+        e = Engine(str(tmp_path / "bw"))
+        e.create_database("db")
+        e.write_lines("db", "\n".join([
+            f'cpu,host=a v=1.5,c=7i,ok=true,msg="hi there" {BASE * NS}',
+            f"cpu,host=a v=2.5 {(BASE + 60) * NS}",
+            f"cpu,host=b v=9 {(BASE + 30) * NS}",
+        ]))
+        doc = serialize_series(e, "db", None, "cpu", -(2**62), 2**62)
+        blob = serialize_series_binary(e, "db", None, "cpu", -(2**62), 2**62)
+        parsed = parse_series_binary(blob)
+        assert parsed["schema"] == doc["schema"]
+        assert len(parsed["series"]) == len(doc["series"])
+        for ps, js in zip(parsed["series"], doc["series"]):
+            assert ps["tags"] == js["tags"]
+            assert list(ps["times"]) == js["times"]
+            for name, jf in js["fields"].items():
+                pf = ps["fields"][name]
+                assert list(pf["valid"]) == jf["valid"]
+                if jf["type"] == "STRING":
+                    assert list(pf["values"]) == jf["values"]
+                else:
+                    got = np.asarray(pf["values"], np.float64)
+                    want = np.asarray(jf["values"], np.float64)
+                    assert np.array_equal(got, want)
+        # and a RemoteShard built from the binary doc reads identically
+        rs = RemoteShard("cpu", parsed)
+        sid = next(s for s in rs.index.series_ids("cpu")
+                   if rs.index.tags_of(s)["host"] == "a")
+        rec = rs.read_series("cpu", sid)
+        assert rec.columns["v"].values.tolist() == [1.5, 2.5]
+        assert rec.columns["msg"].values[0] == "hi there"
+        assert rec.columns["c"].valid.tolist() == [True, False]
+        e.close()
