@@ -175,7 +175,7 @@ class PromEngine:
             return Frame.scalar(node.val, k)
         if isinstance(node, pp.VectorSelector):
             return self._eval_selector(node, steps, db, self.lookback_s, instant=True)
-        if isinstance(node, pp.MatrixSelector):
+        if isinstance(node, (pp.MatrixSelector, pp.Subquery)):
             raise PromError("range vector must be wrapped in a function (e.g. rate)")
         if isinstance(node, pp.FunctionCall):
             return self._eval_function(node, steps, db)
@@ -334,13 +334,55 @@ class PromEngine:
             return f
         raise PromError(f"unsupported function {name!r}")
 
-    def _eval_range_fn(self, ms_sel: pp.MatrixSelector, steps, db, kernel) -> Frame:
-        vs = ms_sel.vector
-        w = ms_sel.range_s
-        eval_times = steps - vs.offset_s
-        t_max_ns = int(eval_times[-1] * 1e9) + 1
-        t_min_ns = int((eval_times[0] - w) * 1e9)
-        labels, samples = self._collect_series(vs, t_min_ns, t_max_ns, db)
+    # default subquery resolution when [range:] omits the step (the
+    # Prometheus global evaluation interval analogue)
+    subquery_default_step_s = 60.0
+
+    def _subquery_samples(self, sq: "pp.Subquery", steps, db):
+        """Evaluate the inner expression on an absolutely-aligned step
+        grid covering the outer window -> (labels, [(times_ms, values)])
+        shaped exactly like _collect_series output."""
+        # explicit None check: `or` would silently turn [range:0s] into
+        # the default step instead of rejecting it
+        step = self.subquery_default_step_s if sq.step_s is None else sq.step_s
+        if step <= 0:
+            raise PromError("subquery step must be positive")
+        t_end = float(steps[-1]) - sq.offset_s
+        t_start = float(steps[0]) - sq.offset_s - sq.range_s
+        first = math.ceil(t_start / step) * step  # absolute alignment
+        n = int(math.floor((t_end - first) / step)) + 1
+        if n <= 0:
+            return [], []
+        if n > 11_000:
+            raise PromError("subquery produces too many steps (max 11000)")
+        sub_steps = first + np.arange(n) * step
+        inner = self._eval(sq.expr, sub_steps, db)
+        if inner.is_scalar:
+            raise PromError("subquery is only allowed on instant vector")
+        # rint, not truncation: x.2999999*1000 would land 1ms early and
+        # flip boundary inclusion in the (start, end] kernel windows
+        times_ms = np.rint(sub_steps * 1000.0).astype(np.int64)
+        labels, samples = [], []
+        for i in range(len(inner.labels)):
+            mask = inner.valid[i]
+            if not mask.any():
+                continue
+            labels.append(inner.labels[i])
+            samples.append((times_ms[mask], inner.values[i][mask]))
+        return labels, samples
+
+    def _eval_range_fn(self, ms_sel, steps, db, kernel) -> Frame:
+        if isinstance(ms_sel, pp.Subquery):
+            w = ms_sel.range_s
+            eval_times = steps - ms_sel.offset_s
+            labels, samples = self._subquery_samples(ms_sel, steps, db)
+        else:
+            vs = ms_sel.vector
+            w = ms_sel.range_s
+            eval_times = steps - vs.offset_s
+            t_max_ns = int(eval_times[-1] * 1e9) + 1
+            t_min_ns = int((eval_times[0] - w) * 1e9)
+            labels, samples = self._collect_series(vs, t_min_ns, t_max_ns, db)
         k = len(steps)
         if not samples:
             return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
@@ -616,8 +658,9 @@ def _drop_name(labels: dict) -> dict:
     return {k: v for k, v in labels.items() if k != "__name__"}
 
 
-def _expect_matrix(node, i) -> pp.MatrixSelector:
-    if i >= len(node.args) or not isinstance(node.args[i], pp.MatrixSelector):
+def _expect_matrix(node, i):
+    if i >= len(node.args) or not isinstance(
+            node.args[i], (pp.MatrixSelector, pp.Subquery)):
         raise PromError(f"{node.name}() expects a range vector")
     return node.args[i]
 

@@ -41,6 +41,17 @@ class MatrixSelector:
 
 
 @dataclass
+class Subquery:
+    """expr[range:step] — the inner expression evaluated on an
+    absolutely-aligned step grid, consumed like a range vector."""
+
+    expr: object = None
+    range_s: float = 0.0
+    step_s: float | None = None  # None: engine default resolution
+    offset_s: float = 0.0
+
+
+@dataclass
 class NumberLit:
     val: float = 0.0
 
@@ -201,7 +212,7 @@ def _parse_primary(lx: _Lexer):
     if kind == "ID":
         lx.next()
         if val in AGG_OPS:
-            return _parse_aggregation(lx, val)
+            return _maybe_range(lx, _parse_aggregation(lx, val))
         if lx.peek() == ("OP", "(") and val in FUNCTIONS:
             lx.next()
             args = []
@@ -211,7 +222,7 @@ def _parse_primary(lx: _Lexer):
                     lx.next()
                     args.append(_parse_expr(lx, 1))
             _expect(lx, ")")
-            return FunctionCall(val, args)
+            return _maybe_range(lx, FunctionCall(val, args))
         return _maybe_range(lx, _parse_selector(lx, val))
     raise PromParseError(f"unexpected token {val!r}")
 
@@ -250,18 +261,39 @@ def _maybe_range(lx: _Lexer, expr):
         kind, d = lx.next()
         if kind != "DUR":
             raise PromParseError("range selector expects a duration")
+        nk, nv = lx.peek()
+        if nk == "ID" and nv.startswith(":"):
+            # subquery: expr[range:step] (the lexer folds ':1m' into one
+            # ID token because recording-rule names may contain colons)
+            lx.next()
+            step_txt = nv[1:]
+            if not step_txt and lx.peek()[0] == "DUR":  # '[5m : 1m]'
+                step_txt = lx.next()[1]
+            step_s = parse_duration_s(step_txt) if step_txt else None
+            _expect(lx, "]")
+            sq = Subquery(expr, parse_duration_s(d), step_s)
+            sq.offset_s = _maybe_offset(lx)
+            return _maybe_range(lx, sq)  # nested subqueries: sq[r:s]
         _expect(lx, "]")
         if not isinstance(expr, VectorSelector):
-            raise PromParseError("range selector requires a vector selector")
+            raise PromParseError(
+                "range selector requires a vector selector "
+                "(use expr[range:step] for subqueries)"
+            )
         ms = MatrixSelector(expr, parse_duration_s(d))
-        if lx.peek() == ("ID", "offset"):
-            lx.next()
-            k2, d2 = lx.next()
-            if k2 != "DUR":
-                raise PromParseError("offset expects a duration")
-            expr.offset_s = parse_duration_s(d2)
+        expr.offset_s = _maybe_offset(lx) or expr.offset_s
         return ms
     return expr
+
+
+def _maybe_offset(lx: _Lexer) -> float:
+    if lx.peek() == ("ID", "offset"):
+        lx.next()
+        k2, d2 = lx.next()
+        if k2 != "DUR":
+            raise PromParseError("offset expects a duration")
+        return parse_duration_s(d2)
+    return 0.0
 
 
 def _parse_aggregation(lx: _Lexer, op: str) -> Aggregation:
