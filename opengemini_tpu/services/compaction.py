@@ -19,10 +19,19 @@ class CompactionService(Service):
 
     def handle(self) -> int:
         n = 0
+        fanout = max(2, self.max_files)
         for shard in self.engine.all_shards():
             try:
-                if shard.compact(max_files=self.max_files):
+                # leveled: drain every mergeable run this tick (sustained
+                # ingest can flush faster than one merge per tick), each
+                # merge O(run) not O(shard)
+                while shard.compact_level(fanout=fanout):
                     n += 1
+                # mixed levels can still let the count run away: full
+                # merge as the independent backstop
+                if shard.file_count() > 8 * fanout:
+                    if shard.compact(max_files=fanout):
+                        n += 1
             except Exception:  # noqa: BLE001
                 logger.exception("compaction of %s failed", shard.path)
         return n

@@ -48,6 +48,14 @@ class Shard:
     # -- open/recovery ------------------------------------------------------
 
     def _load_files(self) -> None:
+        # sweep crash leftovers: a .merge/.tmp that never reached its
+        # os.replace would otherwise accumulate as full-size garbage
+        for f in os.listdir(self.path):
+            if f.endswith((".merge", ".tmp")):
+                try:
+                    os.remove(os.path.join(self.path, f))
+                except OSError:
+                    pass
         names = sorted(
             f for f in os.listdir(self.path) if f.endswith(".tsf")
         )
@@ -140,6 +148,31 @@ class Shard:
             self.mem = MemTable(self.schemas)
             self.wal.truncate()
 
+    @staticmethod
+    def _merge_readers(readers, w: "TSFWriter", tidx: "_TextSidecar") -> None:
+        """Shared merge body of compact()/compact_level(): all chunks per
+        series across `readers` (oldest first: timestamp last-write-wins
+        dedup holds), written merged into `w` + the text sidecar."""
+        per_mst: dict[str, set[int]] = {}
+        for r in readers:
+            for mst in r.measurements():
+                per_mst.setdefault(mst, set())
+                for c in r.chunks(mst):
+                    per_mst[mst].add(c.sid)
+        for mst in sorted(per_mst):
+            for sid in sorted(per_mst[mst]):
+                recs = []
+                for r in readers:
+                    for c in r.chunks(mst, sids={sid}):
+                        recs.append(r.read_chunk(mst, c))
+                merged = merge_sorted_records(recs)
+                w.add_chunk(mst, sid, merged)
+                tidx.add(mst, sid, merged)
+
+    def file_count(self) -> int:
+        with self._lock:
+            return len(self._files)
+
     def compact(self, max_files: int = 1) -> bool:
         """Full merge of immutable files (level compaction analogue,
         reference engine/immutable/compact.go LevelCompact:120). Rewrites
@@ -152,21 +185,7 @@ class Shard:
             w = TSFWriter(path)
             tidx = _TextSidecar()
             try:
-                per_mst: dict[str, set[int]] = {}
-                for r in self._files:
-                    for mst in r.measurements():
-                        per_mst.setdefault(mst, set())
-                        for c in r.chunks(mst):
-                            per_mst[mst].add(c.sid)
-                for mst in sorted(per_mst):
-                    for sid in sorted(per_mst[mst]):
-                        recs = []
-                        for r in self._files:
-                            for c in r.chunks(mst, sids={sid}):
-                                recs.append(r.read_chunk(mst, c))
-                        merged = merge_sorted_records(recs)
-                        w.add_chunk(mst, sid, merged)
-                        tidx.add(mst, sid, merged)
+                self._merge_readers(self._files, w, tidx)
                 w.finish()
             except BaseException:
                 w.abort()
@@ -177,6 +196,69 @@ class Shard:
             self._files = [TSFReader(path)]
             self._tidx_cache = {}
             _retire_files(old)
+            return True
+
+    @staticmethod
+    def _file_level(path: str) -> int:
+        """Size-tiered level: L0 < 1MB, each level 8x larger (reference:
+        immutable LevelCompact's level groups, compact.go:120 — here the
+        level derives from size, no extra metadata)."""
+        import math
+
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return 0
+        if size < (1 << 20):
+            return 0
+        return 1 + int(math.log(size / (1 << 20), 8))
+
+    def compact_level(self, fanout: int = 4) -> bool:
+        """Merge ONE run of >= fanout consecutive same-level files into a
+        single file, preserving file order (the merged output replaces the
+        run's FIRST file in place, so timestamp last-write-wins dedup
+        across remaining files stays correct). O(run) per call instead of
+        the full-merge's O(shard) — bounded write amplification."""
+        fanout = max(2, fanout)  # fanout=1 would rewrite a file in place
+        with self._lock:
+            if len(self._files) < fanout:
+                return False
+            levels = [self._file_level(r.path) for r in self._files]
+            run_start = run_len = 0
+            best: tuple[int, int] | None = None
+            for i in range(len(levels)):
+                if i > 0 and levels[i] == levels[i - 1]:
+                    run_len += 1
+                else:
+                    run_start, run_len = i, 1
+                if run_len >= fanout:
+                    # merge exactly `fanout` files per call: bounded work,
+                    # deterministic, and repeated ticks converge
+                    best = (run_start, fanout)
+                    break
+            if best is None:
+                return False
+            i0, n = best
+            run = self._files[i0 : i0 + n]
+            target = run[0].path
+            tmp = target + ".merge"
+            w = TSFWriter(tmp)
+            tidx = _TextSidecar()
+            try:
+                self._merge_readers(run, w, tidx)
+                w.finish()  # atomically lands at tmp
+            except BaseException:
+                w.abort()
+                raise
+            os.replace(tmp, target)  # new content under the run's 1st name
+            tidx.write(target)
+            new_reader = TSFReader(target)
+            retired = run[1:]
+            self._files = (
+                self._files[:i0] + [new_reader] + self._files[i0 + n :]
+            )
+            self._tidx_cache = {}
+            _retire_files(retired)  # the old run[0] reader keeps its fd
             return True
 
     def rewrite_downsampled(self, every_ns: int, field_aggs: dict | None = None) -> int:

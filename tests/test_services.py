@@ -371,9 +371,9 @@ class TestCompactionService:
         [shard] = e.all_shards()
         assert len(shard._files) == 6
         svc = CompactionService(e, interval_s=3600, max_files=4)
-        assert svc.handle() == 1
-        assert svc.handle() == 0  # idempotent once merged
-        assert len(shard._files) == 1
+        assert svc.handle() == 1  # leveled: merges one 4-file run
+        assert len(shard._files) == 3
+        assert svc.handle() == 0  # below fanout: no further merge
         res = q(ex, "SELECT count(v) FROM m")
         assert series_of(res)["values"][0][1] == 6
 

@@ -443,3 +443,120 @@ class TestNativeCodecs:
         assert buf[0] == 1  # _T_DELTA (zlib path chosen)
         assert len(buf) < 200
         np.testing.assert_array_equal(encoding.decode_ints(buf), v)
+
+
+class TestLeveledCompaction:
+    NS = 10**9
+    B = 1_700_000_000
+
+    def _shard_with_files(self, tmp_path, n_files, rows_per=5):
+        from opengemini_tpu.storage.engine import Engine
+
+        e = Engine(str(tmp_path / "lc"))
+        e.create_database("db")
+        t = self.B
+        for f in range(n_files):
+            lines = []
+            for r in range(rows_per):
+                lines.append(f"m,host=h{r % 2} v={f * 100 + r} {t * self.NS}")
+                t += 1
+            e.write_lines("db", "\n".join(lines))
+            e.flush_all()
+        sh = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        return e, sh
+
+    def test_merges_one_run_preserving_data(self, tmp_path):
+        e, sh = self._shard_with_files(tmp_path, 6)
+        before = len(sh._files)
+        assert sh.compact_level(fanout=4)
+        assert len(sh._files) == before - 3  # 4 -> 1
+        # every row still present, once
+        from opengemini_tpu.query.executor import Executor
+
+        out = Executor(e).execute("SELECT count(v) FROM m", db="db")
+        assert out["results"][0]["series"][0]["values"][0][1] == 30
+        e.close()
+
+    def test_last_write_wins_across_merge_boundary(self, tmp_path):
+        """Rows rewritten in a LATER (unmerged) file must still win over
+        the merged output of earlier files."""
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        e = Engine(str(tmp_path / "lw"))
+        e.create_database("db")
+        T = self.B * self.NS
+        for f in range(4):  # four files all writing the SAME point
+            e.write_lines("db", f"m v={f} {T}")
+            e.flush_all()
+        e.write_lines("db", f"m v=99 {T}")  # newest, 5th file
+        e.flush_all()
+        sh = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        assert sh.compact_level(fanout=4)  # merges the first four
+        out = Executor(e).execute("SELECT v FROM m", db="db")
+        assert out["results"][0]["series"][0]["values"][0][1] == 99.0
+        e.close()
+
+    def test_no_run_no_merge(self, tmp_path):
+        e, sh = self._shard_with_files(tmp_path, 3)
+        assert sh.compact_level(fanout=4) is False
+        e.close()
+
+    def test_text_sidecar_written_for_merged_file(self, tmp_path):
+        import glob
+
+        from opengemini_tpu.storage.engine import Engine
+
+        e = Engine(str(tmp_path / "ts"))
+        e.create_database("db")
+        for f in range(4):
+            e.write_lines(
+                "db", f'logs msg="event number{f} ok" {(self.B + f) * self.NS}')
+            e.flush_all()
+        sh = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        assert sh.compact_level(fanout=4)
+        assert len(glob.glob(sh.path + "/*.tidx")) == len(sh._files)
+        sids = sh.text_match_sids("logs", "msg", "number2")
+        assert sids and len(sids) == 1
+        e.close()
+
+    def test_service_drains_all_runs_in_one_tick(self, tmp_path):
+        from opengemini_tpu.services.compaction import CompactionService
+
+        e, sh = self._shard_with_files(tmp_path, 10)
+        svc = CompactionService(e, interval_s=3600, max_files=4)
+        merged = svc.handle()
+        assert merged >= 2  # 10 -> 7 -> 4 within ONE tick
+        assert sh.file_count() <= 4
+        from opengemini_tpu.query.executor import Executor
+
+        out = Executor(e).execute("SELECT count(v) FROM m", db="db")
+        assert out["results"][0]["series"][0]["values"][0][1] == 50
+        e.close()
+
+    def test_fanout_one_never_rewrites_in_place(self, tmp_path):
+        e, sh = self._shard_with_files(tmp_path, 2)
+        path0 = sh._files[0].path
+        import os
+
+        mtime = os.path.getmtime(path0)
+        assert sh.compact_level(fanout=1)  # floored to 2: merges the pair
+        assert sh.file_count() == 1
+        e.close()
+
+    def test_crash_leftover_merge_file_swept(self, tmp_path):
+        import os
+
+        from opengemini_tpu.storage.engine import Engine
+        from opengemini_tpu.storage.shard import Shard
+
+        e, sh = self._shard_with_files(tmp_path, 2)
+        orphan = os.path.join(sh.path, "00000001.tsf.merge")
+        with open(orphan, "wb") as f:
+            f.write(b"garbage")
+        path = sh.path
+        e.close()
+        sh2 = Shard(path, 0, 2**62)
+        assert not os.path.exists(orphan)
+        assert len(sh2._files) == 2  # real files untouched
+        sh2.close()
