@@ -28,6 +28,7 @@ _T_STR = 3  # uint32 offsets + utf8 blob (+zlib)
 _T_CONST = 4  # int64 constant run: value + count (RLE timestamps fast path)
 _T_GORILLA = 5  # float64 XOR-compressed (native C++ codec, py-decodable)
 _T_VARINT = 6  # int64 delta+zigzag varint (native C++ codec, py-decodable)
+_T_STRDICT = 7  # dictionary-coded strings: uniq table + min-width indices
 
 _ZLEVEL = 1
 
@@ -123,16 +124,46 @@ def decode_bools(buf: bytes) -> np.ndarray:
 
 
 def encode_strings(values: np.ndarray) -> bytes:
+    """Adaptive: low-cardinality columns (log levels, statuses, hostnames)
+    dictionary-encode — unique table + minimal-width indices (reference:
+    lib/compress dictionary coding); high-cardinality columns keep the
+    plain offsets+blob layout."""
     parts = [(v if isinstance(v, str) else "").encode("utf-8") for v in values]
-    offsets = np.zeros(len(parts) + 1, dtype=np.uint32)
+    n = len(parts)
+    uniq_set = set(parts)
+    if n >= 8 and len(uniq_set) <= max(16, n // 4):
+        uniq = sorted(uniq_set)  # sort only when the dict branch is taken
+        idx_of = {u: i for i, u in enumerate(uniq)}
+        width = _min_width(max(1, len(uniq) - 1))
+        dt = _WIDTH_DT[width]
+        indices = np.fromiter((idx_of[p] for p in parts), dt, count=n)
+        uoff = np.zeros(len(uniq) + 1, dtype=np.uint32)
+        np.cumsum([len(u) for u in uniq], out=uoff[1:])
+        payload = zlib.compress(
+            uoff.tobytes() + b"".join(uniq) + indices.tobytes(), _ZLEVEL
+        )
+        return struct.pack("<BIIB", _T_STRDICT, n, len(uniq), width) + payload
+    offsets = np.zeros(n + 1, dtype=np.uint32)
     np.cumsum([len(p) for p in parts], out=offsets[1:]) if parts else None
     blob = b"".join(parts)
     payload = zlib.compress(offsets.tobytes() + blob, _ZLEVEL)
-    return struct.pack("<BI", _T_STR, len(parts)) + payload
+    return struct.pack("<BI", _T_STR, n) + payload
 
 
 def decode_strings(buf: bytes) -> np.ndarray:
     tag = buf[0]
+    if tag == _T_STRDICT:
+        n, k, width = struct.unpack_from("<IIB", buf, 1)
+        payload = zlib.decompress(buf[10:])
+        uoff = np.frombuffer(payload[: 4 * (k + 1)], dtype=np.uint32)
+        blob_end = 4 * (k + 1) + int(uoff[-1])
+        blob = payload[4 * (k + 1) : blob_end]
+        dt = _WIDTH_DT[width]
+        indices = np.frombuffer(payload[blob_end:], dtype=dt)[:n]
+        table = np.empty(k, dtype=object)
+        for i in range(k):
+            table[i] = blob[uoff[i] : uoff[i + 1]].decode("utf-8")
+        return table[indices]
     if tag != _T_STR:
         raise ValueError(f"bad string block tag {tag}")
     (n,) = struct.unpack_from("<I", buf, 1)
@@ -180,6 +211,9 @@ def encode_column(col: Column) -> tuple[bytes, bytes]:
 def decode_column(ftype: FieldType, vbuf: bytes, mbuf: bytes) -> Column:
     values = _DECODERS[ftype](vbuf)
     return Column(ftype, values, decode_mask(mbuf, len(values)))
+
+
+_WIDTH_DT = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
 
 
 def _min_width(vmax: int) -> int:

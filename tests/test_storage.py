@@ -560,3 +560,63 @@ class TestLeveledCompaction:
         assert not os.path.exists(orphan)
         assert len(sh2._files) == 2  # real files untouched
         sh2.close()
+
+
+class TestStringDictEncoding:
+    def test_low_cardinality_dict_round_trip_and_smaller(self):
+        import numpy as np
+
+        from opengemini_tpu.storage.encoding import (
+            _T_STRDICT, decode_strings, encode_strings,
+        )
+
+        vals = np.array(
+            [("info", "warn", "error")[i % 3] for i in range(1000)], object)
+        buf = encode_strings(vals)
+        assert buf[0] == _T_STRDICT
+        out = decode_strings(buf)
+        assert out.tolist() == vals.tolist()
+        # force-plain encoding of the SAME repeated data: the dict block
+        # must beat it decisively
+        from opengemini_tpu.storage import encoding as enc
+
+        offsets = np.zeros(len(vals) + 1, dtype=np.uint32)
+        parts = [v.encode() for v in vals]
+        np.cumsum([len(p) for p in parts], out=offsets[1:])
+        import struct
+        import zlib
+
+        plain_same = struct.pack("<BI", enc._T_STR, len(parts)) + zlib.compress(
+            offsets.tobytes() + b"".join(parts), 6)
+        assert len(buf) < len(plain_same) / 3  # dict wins big on repeats
+        # high cardinality stays plain and round-trips
+        hi = np.array([f"unique-{i}" for i in range(1000)], object)
+        plain = encode_strings(hi)
+        assert plain[0] != _T_STRDICT
+        assert decode_strings(plain).tolist() == hi.tolist()
+
+    def test_small_and_edge_columns(self):
+        import numpy as np
+
+        from opengemini_tpu.storage.encoding import decode_strings, encode_strings
+
+        for data in ([], ["x"], ["", "", ""], ["a"] * 100,
+                     ["日本語", "ascii"] * 50):
+            vals = np.array(data, object)
+            assert decode_strings(encode_strings(vals)).tolist() == data
+
+    def test_persisted_through_tsf(self, tmp_path):
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        NS, B = 10**9, 1_700_000_000
+        e = Engine(str(tmp_path / "sd"))
+        e.create_database("db")
+        e.write_lines("db", "\n".join(
+            f'logs level="{("info", "error")[i % 2]}" {(B + i) * NS}'
+            for i in range(50)))
+        e.flush_all()
+        out = Executor(e).execute(
+            "SELECT level FROM logs WHERE level = 'error'", db="db")
+        assert len(out["results"][0]["series"][0]["values"]) == 25
+        e.close()
