@@ -164,7 +164,9 @@ class Shard:
                 recs = []
                 for r in readers:
                     for c in r.chunks(mst, sids={sid}):
-                        recs.append(r.read_chunk(mst, c))
+                        # one-pass merge: bypass the column cache so
+                        # soon-to-be-retired readers never pin memory
+                        recs.append(r.read_chunk(mst, c, cache=False))
                 merged = merge_sorted_records(recs)
                 w.add_chunk(mst, sid, merged)
                 tidx.add(mst, sid, merged)

@@ -22,7 +22,9 @@ from __future__ import annotations
 import json
 import os
 import struct
+import threading
 import zlib
+from collections import OrderedDict
 
 import numpy as np
 
@@ -204,6 +206,9 @@ class TSFReader:
         # per-measurement sid bloom (reference: lib/bloomfilter): single-
         # series lookups reject in O(k) instead of scanning chunk metas —
         # built from in-memory metadata, so no format change
+        self._col_cache: OrderedDict = OrderedDict()
+        self._cache_bytes = 0
+        self._cache_lock = threading.Lock()
         self._sid_bloom: dict[str, BloomFilter] = {}
         for mst, (_s, chunks) in self.meta.items():
             bf = BloomFilter(len(chunks))
@@ -249,26 +254,74 @@ class TSFReader:
         return out
 
     def _read(self, loc: tuple[int, int]) -> bytes:
-        self._f.seek(loc[0])
-        return self._f.read(loc[1])
+        # positioned read: concurrent query threads share this fd, and an
+        # interleaved seek+read pair would decode bytes from the wrong
+        # offset (and the column cache would then serve the garbage forever)
+        return os.pread(self._f.fileno(), loc[1], loc[0])
 
     def read_times(self, chunk: ChunkMeta) -> np.ndarray:
         return encoding.decode_ints(self._read(chunk.time_loc))
 
+    # decoded-column LRU (reference: lib/readcache — hot chunks decode
+    # once, not per query). Safe because TSF files are immutable and no
+    # read path mutates decoded arrays in place. BYTE-budgeted per open
+    # file; bulk one-pass scans (compaction, downsample, export) bypass
+    # it entirely (cache=False) so soon-to-be-retired readers never pin
+    # decoded arrays.
+    _CACHE_BYTES = 16 << 20  # decoded-bytes budget per open file
+
+    @staticmethod
+    def _val_nbytes(val) -> int:
+        if isinstance(val, Column):
+            return int(val.values.nbytes if hasattr(val.values, "nbytes")
+                       else len(val.values) * 64) + int(val.valid.nbytes)
+        return int(getattr(val, "nbytes", 64))
+
+    def _cached_col(self, key, decode):
+        with self._cache_lock:
+            got = self._col_cache.get(key)
+            if got is not None:
+                self._col_cache.move_to_end(key)
+                return got
+        val = decode()
+        nb = self._val_nbytes(val)
+        if nb > self._CACHE_BYTES:
+            return val  # a single oversized column never enters the cache
+        with self._cache_lock:
+            if key not in self._col_cache:
+                self._col_cache[key] = val
+                self._cache_bytes += nb
+            self._col_cache.move_to_end(key)
+            while self._cache_bytes > self._CACHE_BYTES and self._col_cache:
+                _k, old = self._col_cache.popitem(last=False)
+                self._cache_bytes -= self._val_nbytes(old)
+        return val
+
     def read_chunk(
-        self, measurement: str, chunk: ChunkMeta, fields: list[str] | None = None
+        self, measurement: str, chunk: ChunkMeta,
+        fields: list[str] | None = None, cache: bool = True,
     ) -> Record:
         schema = self.schema(measurement)
-        times = self.read_times(chunk)
+
+        def times_decode():
+            return self.read_times(chunk)
+
+        times = (self._cached_col((id(chunk), None), times_decode)
+                 if cache else times_decode())
         cols = {}
         names = fields if fields is not None else list(chunk.cols)
         for name in names:
             loc = chunk.cols.get(name)
             if loc is None:
                 continue
-            vbuf = self._read(loc["v"])
-            mbuf = self._read(loc["m"]) if loc["m"] else b""
-            cols[name] = encoding.decode_column(schema[name], vbuf, mbuf)
+
+            def decode(loc=loc, name=name):
+                vbuf = self._read(loc["v"])
+                mbuf = self._read(loc["m"]) if loc["m"] else b""
+                return encoding.decode_column(schema[name], vbuf, mbuf)
+
+            cols[name] = (self._cached_col((id(chunk), name), decode)
+                          if cache else decode())
         return Record(times, cols)
 
 

@@ -620,3 +620,102 @@ class TestStringDictEncoding:
             "SELECT level FROM logs WHERE level = 'error'", db="db")
         assert len(out["results"][0]["series"][0]["values"]) == 25
         e.close()
+
+
+class TestReadCache:
+    def test_decode_happens_once_per_column(self, tmp_path):
+        from opengemini_tpu.storage import encoding
+        from opengemini_tpu.storage.engine import Engine
+
+        NS, B = 10**9, 1_700_000_000
+        e = Engine(str(tmp_path / "rc"))
+        e.create_database("db")
+        e.write_lines("db", "\n".join(
+            f"m v={i} {(B + i) * NS}" for i in range(100)))
+        e.flush_all()
+        sh = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        calls = []
+        orig = encoding.decode_column
+        encoding.decode_column = lambda *a: calls.append(1) or orig(*a)
+        try:
+            sid = next(iter(sh.index.series_ids("m")))
+            r1 = sh.read_series("m", sid)
+            n1 = len(calls)
+            assert n1 >= 1
+            r2 = sh.read_series("m", sid)
+            assert len(calls) == n1  # cache hit: zero extra decodes
+            assert r1.columns["v"].values.tolist() == r2.columns["v"].values.tolist()
+        finally:
+            encoding.decode_column = orig
+        e.close()
+
+    def test_cache_bounded(self, tmp_path):
+        from opengemini_tpu.storage.engine import Engine
+        from opengemini_tpu.storage.tsf import TSFReader
+
+        NS, B = 10**9, 1_700_000_000
+        e = Engine(str(tmp_path / "rb"))
+        e.create_database("db")
+        # many series -> many chunks -> cache pressure
+        e.write_lines("db", "\n".join(
+            f"m,host=h{i} v={i} {(B + i) * NS}" for i in range(700)))
+        e.flush_all()
+        sh = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        r = sh._files[0]
+        for c in r.chunks("m"):
+            r.read_chunk("m", c)
+        assert r._cache_bytes <= TSFReader._CACHE_BYTES
+        e.close()
+
+    def test_bulk_merge_bypasses_cache(self, tmp_path):
+        from opengemini_tpu.storage.engine import Engine
+
+        NS, B = 10**9, 1_700_000_000
+        e = Engine(str(tmp_path / "bp"))
+        e.create_database("db")
+        for f in range(4):
+            e.write_lines("db", "\n".join(
+                f"m v={f * 10 + i} {(B + f * 10 + i) * NS}" for i in range(5)))
+            e.flush_all()
+        sh = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        old = list(sh._files)
+        assert sh.compact_level(fanout=4)
+        for r in old:
+            assert len(r._col_cache) == 0  # merge never populated caches
+        e.close()
+
+    def test_concurrent_reads_consistent(self, tmp_path):
+        """pread + cache under concurrency: many threads reading the same
+        chunks must all see identical, correct data."""
+        import threading
+
+        from opengemini_tpu.storage.engine import Engine
+
+        NS, B = 10**9, 1_700_000_000
+        e = Engine(str(tmp_path / "cc"))
+        e.create_database("db")
+        e.write_lines("db", "\n".join(
+            f"m,host=h{i % 16} v={i} {(B + i) * NS}" for i in range(2000)))
+        e.flush_all()
+        sh = e.shards_for_range("db", None, -(2**62), 2**62)[0]
+        sids = sorted(sh.index.series_ids("m"))
+        errs = []
+
+        def worker():
+            try:
+                for _ in range(10):
+                    for sid in sids:
+                        rec = sh.read_series("m", sid)
+                        v = rec.columns["v"].values
+                        h = int(sh.index.tags_of(sid)["host"][1:])
+                        assert (v.astype(int) % 16 == h).all()
+            except Exception as ex:  # noqa: BLE001
+                errs.append(ex)
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+        e.close()
