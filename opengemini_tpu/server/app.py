@@ -268,6 +268,50 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
     return out
 
 
+def _apply_runtime_config(svc: HttpService, cfg: dict) -> list[str]:
+    """Hot-apply the reloadable subset of [services] to running services
+    (reference: lib/config runtimecfg — SIGHUP re-reads the file; only
+    tick intervals and watermark-style knobs change live, topology
+    doesn't). Returns a list of 'service.field=value' changes."""
+    sc = cfg.get("services", {})
+    plans = {
+        "retention": {"interval_s": ("retention-interval-s", float)},
+        "downsample": {"interval_s": ("downsample-interval-s", float)},
+        "continuousquery": {"interval_s": ("cq-interval-s", float)},
+        "monitor": {"interval_s": ("monitor-interval-s", float)},
+        "stream": {"interval_s": ("stream-interval-s", float)},
+        "compaction": {"interval_s": ("compact-interval-s", float),
+                       "max_files": ("compact-max-files", int)},
+        "hierarchical": {"interval_s": ("hierarchical-interval-s", float)},
+        "obstier": {"interval_s": ("obs-interval-s", float)},
+        "iodetector": {"interval_s": ("iodetector-interval-s", float),
+                       "probe_timeout_s": ("iodetector-timeout-s", float),
+                       "fatal": ("iodetector-fatal", bool)},
+        "sherlock": {"interval_s": ("sherlock-interval-s", float),
+                     "mem_mb_watermark": ("sherlock-mem-mb", float),
+                     "thread_watermark": ("sherlock-threads", int),
+                     "cooldown_s": ("sherlock-cooldown-s", float)},
+    }
+    # two-phase: convert EVERYTHING first so a bad value rejects the whole
+    # reload instead of leaving a half-applied config behind an error
+    staged = []
+    for s in svc.services:
+        plan = plans.get(s.name)
+        if not plan:
+            continue
+        for attr, (key, conv) in plan.items():
+            if key in sc:
+                staged.append((s, attr, conv(sc[key])))
+    changed = []
+    for s, attr, new in staged:
+        if getattr(s, attr, None) != new:
+            setattr(s, attr, new)
+            changed.append(f"{s.name}.{attr}={new}")
+    # NOTE: a shortened interval takes effect after the service's current
+    # wait expires (the ticker re-reads interval_s each iteration)
+    return changed
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ts-server", description="opengemini-tpu all-in-one server")
     ap.add_argument("-config", default=None, help="TOML config path")
@@ -279,13 +323,26 @@ def main(argv=None) -> int:
         svc.flight.start()
     for s in svc.services:
         s.start()
+    stop_event = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop_event.set())
+
+    # installed BEFORE the pidfile exists: a supervisor that reads the
+    # pidfile and fires an immediate reload must not hit the default
+    # SIGHUP disposition (terminate)
+    def on_hup(*_):
+        try:
+            changed = _apply_runtime_config(svc, load_config(args.config))
+            print("config reloaded: " + (", ".join(changed) or "no changes"),
+                  flush=True)
+        except Exception as e:  # noqa: BLE001 — a bad file must not kill us
+            print(f"config reload failed: {e}", flush=True)
+
+    signal.signal(signal.SIGHUP, on_hup)
     if args.pidfile:
         with open(args.pidfile, "w", encoding="utf-8") as f:
             f.write(str(os.getpid()))
     print(f"opengemini-tpu ts-server listening on :{svc.port}", flush=True)
-    stop_event = threading.Event()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *_: stop_event.set())
     stop_event.wait()
     print("shutting down", flush=True)
     for s in svc.services:

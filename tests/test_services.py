@@ -948,3 +948,38 @@ class TestObsTier:
         out = Executor(e).execute("SELECT count(v) FROM m", db="db")
         assert "series" not in out["results"][0]
         e.close()
+
+
+class TestRuntimeConfigReload:
+    def test_apply_changes_intervals(self, tmp_path):
+        from opengemini_tpu.server.app import _apply_runtime_config, build
+
+        cfg = {
+            "data": {"dir": str(tmp_path / "rc")},
+            "http": {"bind-address": "127.0.0.1:0"},
+            "services": {"compact-interval-s": 600, "compact-max-files": 4},
+        }
+        svc = build(cfg)
+        comp = next(s for s in svc.services if s.name == "compaction")
+        assert comp.interval_s == 600
+        changed = _apply_runtime_config(svc, {
+            "services": {"compact-interval-s": 30, "compact-max-files": 8,
+                         "retention-interval-s": 1800}})
+        assert "compaction.interval_s=30.0" in changed
+        assert "compaction.max_files=8" in changed
+        assert comp.interval_s == 30.0 and comp.max_files == 8
+        ret = next(s for s in svc.services if s.name == "retention")
+        assert ret.interval_s == 1800.0
+        # idempotent: no spurious changes
+        assert _apply_runtime_config(svc, {
+            "services": {"compact-interval-s": 30}}) == []
+        # atomic: one bad value rejects the whole reload
+        import pytest as _p
+
+        with _p.raises(ValueError):
+            _apply_runtime_config(svc, {"services": {
+                "retention-interval-s": 60, "compact-max-files": "four"}})
+        ret = next(s for s in svc.services if s.name == "retention")
+        assert ret.interval_s == 1800.0  # earlier change NOT applied
+        svc.httpd.server_close()
+        svc.engine.close()
