@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import threading
 import urllib.request
 
 import numpy as np
@@ -41,6 +42,24 @@ def owners(nodes: list[str], db: str, rp: str, group_start: int,
 
 def owner(nodes: list[str], db: str, rp: str, group_start: int) -> str:
     return owners(nodes, db, rp, group_start, 1)[0]
+
+
+def encode_points(points: list) -> list:
+    """Structured points -> JSON-able wire shape (single definition:
+    forward_points, hints, and /internal/write all share it)."""
+    return [
+        [mst, list(map(list, tags)), int(t),
+         {name: [ft.name, v] for name, (ft, v) in fields.items()}]
+        for mst, tags, t, fields in points
+    ]
+
+
+def decode_points(doc: list) -> list:
+    return [
+        (mst, tuple(tuple(t) for t in tags), int(t_ns),
+         {name: (FieldType[ft], v) for name, (ft, v) in fields.items()})
+        for mst, tags, t_ns, fields in doc
+    ]
 
 
 class RemoteScanError(Exception):
@@ -307,6 +326,7 @@ class DataRouter:
         # rendezvous owners; reads are primary-filtered so replicas never
         # double-count (HA ops analogue of the reference's replication)
         self.rf = max(1, rf)
+        self._hint_lock = threading.Lock()
 
     def data_nodes(self) -> dict[str, str]:
         nodes = {
@@ -364,19 +384,157 @@ class DataRouter:
         forward the rest as STRUCTURED JSON — line-protocol text cannot
         carry arbitrary content (e.g. newlines in string fields).
 
-        Replicated writes (rf>1) are all-or-error: a down replica fails
-        the request AFTER other copies may have applied. That partial
-        state is retry-healable — points are idempotent under timestamp
-        last-write-wins — so clients must treat an error as 'retry',
-        never 'partially ok'."""
+        rf>1 uses hinted handoff (the dynamo recipe the reference's HA
+        writes follow): the write ACKs when at least one owner copy of
+        every point landed; copies for unreachable replicas queue as
+        hints and replay when the node returns. Reads stay correct
+        because failover makes a LIVE owner primary — and a live owner
+        holds its synchronous copy. rf=1 keeps all-or-error: there is no
+        second copy to lean on."""
         local, remote = self.split_points(db, rp, points)
         n = 0
         if local:
             n += self.engine.write_rows(db, local, rp=rp)
+        import urllib.error
+
+        failed: list[tuple[str, list, Exception]] = []
         for node_id, pts in sorted(remote.items()):
-            self.forward_points(node_id, db, rp, pts)
-            n += len(pts)
+            try:
+                self.forward_points(node_id, db, rp, pts)
+                n += len(pts)
+            except urllib.error.HTTPError as e:
+                # the replica is ALIVE and rejected the points (schema
+                # conflict, bad payload): hinting would retry forever —
+                # surface it as a hard failure instead
+                raise RemoteScanError(
+                    f"replica {node_id!r} rejected write: {e}"
+                ) from e
+            except (OSError, RemoteScanError) as e:
+                failed.append((node_id, pts, e))
+        if failed:
+            if self.rf <= 1 or not self._all_covered(db, rp, points, failed):
+                raise RemoteScanError(
+                    f"write failed: {failed[0][2]}"
+                ) from failed[0][2]
+            for node_id, pts, _e in failed:
+                self.hint(node_id, db, rp, pts)
+                n += len(pts)
         return n
+
+    def _all_covered(self, db, rp, points, failed) -> bool:
+        """Did every point land on at least one owner? (failed targets
+        excluded)."""
+        dead = {nid for nid, _pts, _e in failed}
+        d = self.engine.databases.get(db)
+        rp_name = rp or (d.default_rp if d else "autogen")
+        ids = sorted(self.data_nodes())
+        for p in points:
+            dest = owners(ids, db, rp_name,
+                          self._group_start(db, rp, p[2]), self.rf)
+            if all(o in dead for o in dest):
+                return False
+        return True
+
+    # -- hinted handoff ----------------------------------------------------
+
+    def _hints_dir(self) -> str:
+        import os
+
+        d = os.path.join(self.engine.root, "hints")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def hint(self, node_id: str, db: str, rp: str | None,
+             points: list) -> None:
+        """Queue replica copies for a down node (jsonl per target)."""
+        import os
+
+        rec = {"db": db, "rp": rp, "points": encode_points(points)}
+        path = os.path.join(self._hints_dir(), f"{node_id}.jsonl")
+        with self._hint_lock:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(rec) + "\n")
+
+    def pending_hint_nodes(self) -> set[str]:
+        """Nodes with queued hints FROM THIS coordinator: excluded from
+        this coordinator's read live-set so a just-recovered replica is
+        not made primary before its copies arrive (other coordinators'
+        hints are invisible here — a documented per-coordinator bound)."""
+        import os
+
+        with self._hint_lock:
+            try:
+                names = os.listdir(self._hints_dir())
+            except OSError:
+                return set()
+        return {f[:-6] for f in names if f.endswith(".jsonl")}
+
+    def replay_hints(self) -> int:
+        """Deliver queued hints to recovered nodes; returns points
+        delivered. Idempotent (timestamp last-write-wins), so a crash
+        mid-replay at worst re-delivers. The live file is atomically
+        RENAMED before processing: writes arriving mid-replay append to
+        a fresh file and can never be lost to a stale-snapshot rewrite."""
+        import os
+        import urllib.error
+
+        delivered = 0
+        d = self._hints_dir()
+        with self._hint_lock:
+            files = sorted(os.listdir(d))
+        for fname in files:
+            if not fname.endswith(".jsonl"):
+                continue
+            node_id = fname[:-6]
+            path = os.path.join(d, fname)
+            inflight = path + ".inflight"
+            with self._hint_lock:
+                try:
+                    os.replace(path, inflight)  # atomic capture
+                except OSError:
+                    continue
+            try:
+                with open(inflight, encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                continue
+            remaining = list(lines)
+            for i, line in enumerate(lines):
+                try:
+                    rec = json.loads(line)
+                    points = decode_points(rec["points"])
+                    self.forward_points(node_id, rec["db"], rec.get("rp"),
+                                        points)
+                    delivered += len(points)
+                    remaining[i] = None
+                except urllib.error.HTTPError:
+                    remaining[i] = None  # rejected by a LIVE node: poison,
+                    # drop it rather than retry forever
+                except (OSError, RemoteScanError):
+                    break  # node still down: keep the rest queued
+                except (ValueError, KeyError, TypeError):
+                    remaining[i] = None  # corrupt hint: drop it
+            kept = [l for l in remaining if l is not None]
+            with self._hint_lock:
+                if kept:
+                    # re-queue BEFORE any hints appended mid-replay: append
+                    # the live file (if any) after the kept prefix
+                    extra = b""
+                    try:
+                        with open(path, "rb") as f:
+                            extra = f.read()
+                    except OSError:
+                        pass
+                    tmp = path + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(("\n".join(kept) + "\n").encode())
+                        f.write(extra)
+                    os.replace(tmp, path)
+                try:
+                    os.remove(inflight)
+                except OSError:
+                    pass
+        return delivered
 
     def forward_points(self, node_id: str, db: str, rp: str | None,
                        points: list) -> None:
@@ -384,14 +542,7 @@ class DataRouter:
         addr = self.data_nodes().get(node_id, "")
         if not addr:
             raise RemoteScanError(f"no address for data node {node_id!r}")
-        body = {
-            "db": db, "rp": rp,
-            "points": [
-                [mst, list(map(list, tags)), int(t),
-                 {name: [ft.name, v] for name, (ft, v) in fields.items()}]
-                for mst, tags, t, fields in points
-            ],
-        }
+        body = {"db": db, "rp": rp, "points": encode_points(points)}
         try:
             self._post(addr, "/internal/write", body)
         except OSError as e:
@@ -451,6 +602,12 @@ class DataRouter:
         tolerates none for the same reason."""
         nodes = self.data_nodes()
         live = sorted(nodes)
+        if self.rf > 1:
+            pending = self.pending_hint_nodes() - {self.self_id}
+            if pending and len(live) - len(pending & set(live)) >= 1:
+                # a recovered replica missing OUR hinted copies must not
+                # serve as primary until the queue drains
+                live = [n for n in live if n not in pending]
         dropped: list[str] = []
         while True:
             payloads, dead = self._fetch_once(db, rp, mst, tmin, tmax, live)

@@ -42,6 +42,7 @@ def load_config(path: str | None) -> dict:
 
 
 def build(cfg: dict) -> HttpService:
+    hint_service = None
     data = cfg["data"]
     engine = Engine(
         data["dir"],
@@ -118,7 +119,14 @@ def build(cfg: dict) -> HttpService:
             svc.flight.router = svc.router
         _spawn_registrar(svc.meta_store, meta_cfg["node-id"], advertise,
                          meta_cfg.get("token", ""))
+        if svc.router.rf > 1:
+            from opengemini_tpu.services.hintreplay import HintReplayService
+
+            hint_service = HintReplayService(
+                svc.router, float(cluster_cfg.get("hint-interval-s", 30)))
     svc.services = _build_services(cfg, svc)
+    if hint_service is not None:
+        svc.services.append(hint_service)
     return svc
 
 

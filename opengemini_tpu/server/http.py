@@ -277,13 +277,10 @@ def _make_handler(svc: HttpService):
                 if not token and svc.auth_enabled:
                     self._send_json(403, {"error": "cluster token required"})
                     return
+                from opengemini_tpu.parallel.cluster import decode_points
+
                 try:
-                    points = [
-                        (mst, tuple(tuple(t) for t in tags), int(t_ns),
-                         {name: (_FT[ft], v)
-                          for name, (ft, v) in fields.items()})
-                        for mst, tags, t_ns, fields in req.get("points", [])
-                    ]
+                    points = decode_points(req.get("points", []))
                     svc.engine.write_rows(req["db"], points,
                                           rp=req.get("rp") or None)
                 except (KeyError, TypeError, ValueError) as e:

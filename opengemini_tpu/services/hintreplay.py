@@ -1,0 +1,21 @@
+"""Hint replay service (reference: the HA writer's hinted-handoff
+drainer): periodically delivers queued replica copies to recovered
+nodes."""
+
+from __future__ import annotations
+
+from opengemini_tpu.services.base import Service, logger
+
+
+class HintReplayService(Service):
+    name = "hintreplay"
+
+    def __init__(self, router, interval_s: float = 30.0):
+        super().__init__(interval_s)
+        self.router = router
+
+    def handle(self) -> int:
+        n = self.router.replay_hints()
+        if n:
+            logger.info("hinted handoff: delivered %d points", n)
+        return n

@@ -566,3 +566,202 @@ class TestBinaryWire:
         assert rec.columns["msg"].values[0] == "hi there"
         assert rec.columns["c"].valid.tolist() == [True, False]
         e.close()
+
+
+class TestHintedHandoff:
+    def test_write_acks_with_hint_when_replica_down(self, tmp_path):
+        """rf=2: one dead replica must not fail the write — its copy
+        queues as a hint and replays when the node returns."""
+        import urllib.request
+
+        nodes, addrs = TestReplicationFactor()._mk_cluster(tmp_path, rf=2)
+        dead = "nB"
+        nodes[dead][1].stop()
+        week = 7 * 86400
+        lines = "\n".join(
+            f"m v={w} {(BASE + w * week) * NS}" for w in range(12))
+        req = urllib.request.Request(
+            f"http://{addrs['nA']}/write?db=db", data=lines.encode(),
+            method="POST")
+        resp = urllib.request.urlopen(req, timeout=60)
+        assert resp.status == 204  # ACKed despite the dead replica
+        router = nodes["nA"][1].router
+        import os
+
+        hint_file = os.path.join(router._hints_dir(), f"{dead}.jsonl")
+        had_hints = os.path.exists(hint_file)
+        # full answer from a live node right now (surviving owners hold
+        # every point)
+        import json as _json
+        import urllib.parse
+
+        url = (f"http://{addrs['nA']}/query?" + urllib.parse.urlencode(
+            {"q": "SELECT count(v), sum(v) FROM m", "db": "db"}))
+        with urllib.request.urlopen(url, timeout=90) as r:
+            res = _json.loads(r.read())
+        row = res["results"][0]["series"][0]["values"][0]
+        assert row[1] == 12 and row[2] == sum(range(12))
+        # restart nB's HTTP on the SAME port, then replay hints
+        from opengemini_tpu.server.http import HttpService
+
+        e_dead = nodes[dead][0]
+        port = int(addrs[dead].rsplit(":", 1)[1])
+        svc2 = HttpService(e_dead, "127.0.0.1", port)
+        svc2.start()
+        if had_hints:
+            delivered = router.replay_hints()
+            assert delivered > 0
+            assert not os.path.exists(hint_file)  # queue drained
+            # the recovered node now holds its replica copies
+            rows = sum(
+                len(sh.read_series("m", sid).times)
+                for sh in e_dead.shards_for_range("db", None, -(2**62), 2**62)
+                for sid in sh.index.series_ids("m"))
+            assert rows > 0
+        svc2.stop()
+        for nid, (e, svc) in nodes.items():
+            if nid != dead:
+                svc.stop()
+            e.close()
+
+    def test_rf1_down_node_still_fails_write(self, tmp_path):
+        import urllib.request
+
+        nodes, addrs = TestReplicationFactor()._mk_cluster(tmp_path, rf=1)
+        nodes["nB"][1].stop()
+        week = 7 * 86400
+        lines = "\n".join(
+            f"m v={w} {(BASE + w * week) * NS}" for w in range(12))
+        req = urllib.request.Request(
+            f"http://{addrs['nA']}/write?db=db", data=lines.encode(),
+            method="POST")
+        import pytest as _p
+
+        with _p.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 503
+        for nid, (e, svc) in nodes.items():
+            if nid != "nB":
+                svc.stop()
+            e.close()
+
+    def test_all_owners_down_fails_even_rf2(self, tmp_path):
+        """If EVERY owner of some point is dead, the write must fail —
+        a hint with zero landed copies is a lie to the client."""
+        from opengemini_tpu.parallel.cluster import DataRouter, RemoteScanError
+
+        eng = Engine(str(tmp_path / "ao"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nB": {"addr": "h:1", "role": "data"},
+                     "nC": {"addr": "h:2", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        # self (nA) is NOT an owner: rf=2 over {nB, nC} only for... use a
+        # 3-node view where some group's two owners are both remote+dead
+        router = DataRouter(eng, StoreStub(), "nA", "h:0", rf=2)
+
+        def boom(nid, db, rp, pts):
+            raise RemoteScanError(f"{nid} down")
+
+        router.forward_points = boom
+        week = 7 * 86400 * NS
+        pts = [("m", (), BASE * NS + g * week, {"v": (FieldType.FLOAT, 1.0)})
+               for g in range(30)]
+        # at least one group will have both owners in {nB, nC} (not nA)
+        import pytest as _p
+
+        with _p.raises(RemoteScanError):
+            router.routed_write("db", None, pts)
+        eng.close()
+
+    def test_live_rejection_fails_write_not_hinted(self, tmp_path):
+        """A LIVE replica returning HTTP 4xx must fail the write — hinting
+        a rejection would retry a poison record forever."""
+        import urllib.error
+
+        from opengemini_tpu.parallel.cluster import DataRouter, RemoteScanError
+
+        eng = Engine(str(tmp_path / "rej"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nA": {"addr": "hA:1", "role": "data"},
+                     "nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1", rf=2)
+
+        def reject(nid, db, rp, pts):
+            raise urllib.error.HTTPError("http://x", 400, "bad", {}, None)
+
+        router.forward_points = reject
+        import pytest as _p
+
+        with _p.raises(RemoteScanError, match="rejected"):
+            router.routed_write("db", None, [
+                ("m", (), BASE * NS, {"v": (FieldType.FLOAT, 1.0)})])
+        import os
+
+        assert not os.path.exists(
+            os.path.join(router._hints_dir(), "nB.jsonl"))
+        eng.close()
+
+    def test_hints_appended_mid_replay_survive(self, tmp_path):
+        from opengemini_tpu.parallel.cluster import DataRouter
+
+        eng = Engine(str(tmp_path / "mid"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1", rf=2)
+        p1 = [("m", (), BASE * NS, {"v": (FieldType.FLOAT, 1.0)})]
+        p2 = [("m", (), (BASE + 1) * NS, {"v": (FieldType.FLOAT, 2.0)})]
+        router.hint("nB", "db", None, p1)
+        sent = []
+
+        def forward(nid, db, rp, pts):
+            # simulate a concurrent write queuing another hint mid-replay
+            if not sent:
+                router.hint("nB", "db", None, p2)
+            sent.append(pts)
+
+        router.forward_points = forward
+        n = router.replay_hints()
+        assert n == 1  # first batch delivered
+        n2 = router.replay_hints()  # mid-replay hint still queued: delivered
+        assert n2 == 1
+        assert len(sent) == 2
+        assert "nB" not in router.pending_hint_nodes()
+        eng.close()
+
+    def test_recovered_node_not_primary_until_hints_drain(self, tmp_path):
+        from opengemini_tpu.parallel.cluster import DataRouter
+
+        eng = Engine(str(tmp_path / "rp"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nA": {"addr": "", "role": "data"},
+                     "nB": {"addr": "", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "", rf=2)
+        router.hint("nB", "db", None, [
+            ("m", (), BASE * NS, {"v": (FieldType.FLOAT, 1.0)})])
+        router._fetch_once = lambda *a: ([], set())
+        _shards, live = router.scan_shards("db", None, "m", 0, 2**62)
+        assert "nB" not in live  # excluded while its hints are queued
+        eng.close()
