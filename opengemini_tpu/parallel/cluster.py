@@ -327,6 +327,27 @@ class DataRouter:
         # double-count (HA ops analogue of the reference's replication)
         self.rf = max(1, rf)
         self._hint_lock = threading.Lock()
+        # last health-probe results: node id -> bool (True = reachable)
+        self.health: dict[str, bool] = {}
+
+    def probe_health(self) -> dict[str, bool]:
+        """Ping every registered data node (reference: the cluster
+        manager's member health checks); results land in self.health and
+        surface through SHOW CLUSTER."""
+        def probe(nid, addr):
+            if not addr:
+                return (nid, False)
+            try:
+                with urllib.request.urlopen(
+                        f"http://{addr}/ping", timeout=2) as r:
+                    return (nid, r.status in (200, 204))
+            except OSError:
+                return (nid, False)
+
+        results = dict(self._fanout(probe))
+        results[self.self_id] = True
+        self.health = results
+        return results
 
     def data_nodes(self) -> dict[str, str]:
         nodes = {

@@ -226,9 +226,13 @@ class Executor:
             for nid in sorted(members):
                 status = "leader" if nid == leader else "follower"
                 rows.append([nid, members[nid], "meta", status])
+            health = getattr(self.router, "health", {}) if self.router else {}
             for nid, info in sorted(self.meta_store.fsm.nodes.items()):
+                status = "registered"
+                if nid in health:
+                    status = "up" if health[nid] else "down"
                 rows.append([nid, info.get("addr", ""),
-                             info.get("role", "data"), "registered"])
+                             info.get("role", "data"), status])
         return {"series": [_series("cluster", None,
                                    ["id", "addr", "role", "status"], rows)]}
 

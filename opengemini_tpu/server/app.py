@@ -119,11 +119,12 @@ def build(cfg: dict) -> HttpService:
             svc.flight.router = svc.router
         _spawn_registrar(svc.meta_store, meta_cfg["node-id"], advertise,
                          meta_cfg.get("token", ""))
-        if svc.router.rf > 1:
-            from opengemini_tpu.services.hintreplay import HintReplayService
+        from opengemini_tpu.services.hintreplay import HintReplayService
 
-            hint_service = HintReplayService(
-                svc.router, float(cluster_cfg.get("hint-interval-s", 30)))
+        # at rf=1 there are never hints to replay, but the same ticker
+        # drives member health probes for SHOW CLUSTER
+        hint_service = HintReplayService(
+            svc.router, float(cluster_cfg.get("hint-interval-s", 30)))
     svc.services = _build_services(cfg, svc)
     if hint_service is not None:
         svc.services.append(hint_service)

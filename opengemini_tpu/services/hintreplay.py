@@ -15,6 +15,7 @@ class HintReplayService(Service):
         self.router = router
 
     def handle(self) -> int:
+        self.router.probe_health()  # member liveness (SHOW CLUSTER status)
         n = self.router.replay_hints()
         if n:
             logger.info("hinted handoff: delivered %d points", n)

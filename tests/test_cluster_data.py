@@ -765,3 +765,48 @@ class TestHintedHandoff:
         _shards, live = router.scan_shards("db", None, "m", 0, 2**62)
         assert "nB" not in live  # excluded while its hints are queued
         eng.close()
+
+
+class TestClusterHealth:
+    def test_probe_marks_up_and_down(self, tmp_path):
+        from opengemini_tpu.parallel.cluster import DataRouter
+        from opengemini_tpu.server.http import HttpService
+
+        e = Engine(str(tmp_path / "hl"))
+        e.create_database("db")
+        live_svc = HttpService(e, "127.0.0.1", 0)
+        live_svc.start()
+
+        class FsmStub:
+            def __init__(self, port):
+                self.nodes = {
+                    "nUp": {"addr": f"127.0.0.1:{port}", "role": "data"},
+                    "nDown": {"addr": "127.0.0.1:1", "role": "data"},
+                }
+
+        class StoreStub:
+            fsm = FsmStub(live_svc.port)
+
+        router = DataRouter(e, StoreStub(), "nSelf", "x:0")
+        h = router.probe_health()
+        assert h["nUp"] is True and h["nDown"] is False
+        assert h["nSelf"] is True
+        # SHOW CLUSTER surfaces the statuses
+        from opengemini_tpu.query.executor import Executor
+
+        class MetaStub:
+            fsm = StoreStub.fsm
+
+            def leader_hint(self):
+                return None
+
+            def meta_members(self):
+                return {}
+
+        ex = Executor(e, meta_store=MetaStub())
+        ex.router = router
+        out = ex._show_cluster()
+        by_id = {r[0]: r[3] for r in out["series"][0]["values"]}
+        assert by_id["nUp"] == "up" and by_id["nDown"] == "down"
+        live_svc.stop()
+        e.close()
