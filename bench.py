@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -29,6 +30,12 @@ S = 4096  # series
 R = 8160  # rows per series per batch (multiple of 60)
 SPW = 60  # samples per window (1s data, 1m windows)
 W = R // SPW
+
+
+def _set_shapes(s: int, r: int) -> None:
+    global S, R, W
+    S, R = s, r
+    W = R // SPW
 
 
 def _marginal_time(make_fn, ks=(5, 20, 50), trials=4) -> float:
@@ -197,22 +204,31 @@ def _arm_watchdog():
     return t
 
 
-def main() -> None:
-    watchdog = _arm_watchdog()
+def _grid_inputs():
+    """The benchmark workload: (S, R) masked values plus the window-major
+    (S, SPW, W) transposed layout the executor assembles regular chunks
+    into. Shared by the device bench and the CPU smoke so both measure the
+    same computation."""
     import jax
-
-    if os.environ.get("OGTPU_BENCH_CPU"):
-        # smoke mode: exercise the full bench pipeline on the CPU backend
-        # (numbers are meaningless; the env var pins axon otherwise)
-        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    print(f"backend: {jax.default_backend()} device: {jax.devices()[0]}", file=sys.stderr)
     key = jax.random.PRNGKey(0)
     values = jax.random.normal(key, (S, R), dtype=jnp.float32) + 50.0
     mask = jnp.ones((S, R), dtype=jnp.bool_)
     values_t = values.reshape(S, W, SPW).swapaxes(1, 2)
     mask_t = jnp.ones((S, SPW, W), dtype=jnp.bool_)
+    return values, mask, values_t, mask_t
+
+
+def _device_main() -> None:
+    """The real device benchmark. Runs in a CHILD process (see main) so a
+    hung tunnel can be killed from outside; keeps its own watchdog as a
+    second belt so it self-reports before the parent's timeout."""
+    watchdog = _arm_watchdog()
+    import jax
+
+    print(f"backend: {jax.default_backend()} device: {jax.devices()[0]}", file=sys.stderr)
+    values, mask, values_t, mask_t = _grid_inputs()
 
     t_grid = bench_tpu_grid(values_t, mask_t)
     rows_grid = S * R / t_grid
@@ -243,6 +259,89 @@ def main() -> None:
             }
         )
     )
+
+
+def _cpu_smoke() -> None:
+    """Fallback when the device tunnel is dead: run the same masked grid
+    computation on the jax CPU backend at reduced shape and emit a metric
+    explicitly labeled as a CPU smoke number. A missing measurement used
+    to be the round-1 behavior; an honestly-labeled small number carries
+    strictly more information (pipeline works end-to-end, hardware absent)."""
+    _set_shapes(512, 2040)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    print(f"cpu-smoke backend: {jax.default_backend()}", file=sys.stderr)
+    _, _, values_t, mask_t = _grid_inputs()
+    t_grid = bench_tpu_grid(values_t, mask_t)
+    rows_grid = S * R / t_grid
+    rows_cpu = bench_cpu()
+    cpu16 = rows_cpu * 16
+    print(
+        f"cpu-smoke grid: {rows_grid/1e9:.3f} G rows/s; numpy 1-core: "
+        f"{rows_cpu/1e9:.3f} G rows/s",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "groupby_time_1m_mean_max_count_rows_per_sec_cpu_smoke",
+                "value": round(rows_grid),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_grid / cpu16, 3),
+                "note": "device backend unreachable; jax-CPU smoke at reduced shape",
+            }
+        )
+    )
+
+
+def main() -> None:
+    if "--device-child" in sys.argv:
+        _device_main()
+        return
+    if os.environ.get("OGTPU_BENCH_CPU"):
+        _cpu_smoke()
+        return
+
+    from __graft_entry__ import _probe_default_backend
+
+    # Budget layout (worst case ~8 min total): probe <=60s, device child
+    # <=OGTPU_BENCH_TIMEOUT_S (default 300s), CPU smoke ~90s. The child's
+    # in-process watchdog is armed 20s under the parent timeout so it
+    # self-reports before being killed.
+    budget_s = int(os.environ.get("OGTPU_BENCH_TIMEOUT_S", "300"))
+    if _probe_default_backend(timeout_s=60) >= 1:
+        env = dict(os.environ, OGTPU_BENCH_TIMEOUT_S=str(max(budget_s - 20, 30)))
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--device-child"],
+                capture_output=True, text=True, timeout=budget_s, env=env,
+            )
+        except subprocess.TimeoutExpired as e:
+            for stream in (e.stdout, e.stderr):
+                if stream:
+                    sys.stderr.write(stream if isinstance(stream, str) else stream.decode())
+            sys.stderr.write("bench: device child exceeded budget; falling back to CPU smoke\n")
+        else:
+            if r.stderr:
+                sys.stderr.write(r.stderr)
+            if r.returncode == 0:
+                for line in reversed(r.stdout.strip().splitlines()):
+                    try:
+                        parsed = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(parsed, dict) and "metric" in parsed:
+                        print(line)
+                        return
+            sys.stderr.write(
+                f"bench: device child rc={r.returncode} without a metric line; "
+                "falling back to CPU smoke\n"
+            )
+    else:
+        sys.stderr.write("bench: device backend probe failed; CPU smoke\n")
+    _cpu_smoke()
 
 
 if __name__ == "__main__":
