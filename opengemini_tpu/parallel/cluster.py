@@ -488,7 +488,14 @@ class DataRouter:
                 names = os.listdir(self._hints_dir())
             except OSError:
                 return set()
-        return {f[:-6] for f in names if f.endswith(".jsonl")}
+        # .inflight files (crash mid-replay) still hold undelivered copies
+        # for their node — it must stay excluded until they are merged
+        # back (replay_hints start) and delivered
+        return {f[:-6] for f in names if f.endswith(".jsonl")} | {
+            f[: -len(".jsonl.inflight")]
+            for f in names
+            if f.endswith(".jsonl.inflight")
+        }
 
     def replay_hints(self) -> int:
         """Deliver queued hints to recovered nodes; returns points
@@ -502,6 +509,40 @@ class DataRouter:
         delivered = 0
         d = self._hints_dir()
         with self._hint_lock:
+            # merge back any .inflight orphaned by a crash mid-replay:
+            # prepend its lines to the node's live queue (idempotent LWW
+            # delivery makes the worst case a re-delivery, never a loss)
+            try:
+                leftover = sorted(os.listdir(d))
+            except OSError:
+                return 0
+            for fname in leftover:
+                if not fname.endswith(".jsonl.inflight"):
+                    continue
+                infl = os.path.join(d, fname)
+                live = os.path.join(d, fname[: -len(".inflight")])
+                try:
+                    with open(infl, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    continue
+                extra = b""
+                try:
+                    with open(live, "rb") as f:
+                        extra = f.read()
+                except OSError:
+                    pass
+                tmp = live + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    if data and not data.endswith(b"\n"):
+                        f.write(b"\n")
+                    f.write(extra)
+                os.replace(tmp, live)
+                try:
+                    os.remove(infl)
+                except OSError:
+                    pass
             files = sorted(os.listdir(d))
         for fname in files:
             if not fname.endswith(".jsonl"):

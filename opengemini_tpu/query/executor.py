@@ -339,7 +339,7 @@ class Executor:
                     stmt, (ast.SelectStatement, ast.ExplainStatement)
                 ):
                     raise QueryError("reads are disabled (syscontrol)")
-                res = self.execute_statement(stmt, db, now_ns)
+                res = self.execute_statement(stmt, db, now_ns, user=user)
             except (
                 QueryError, cond.ConditionError, KeyError, ValueError,
                 re.error, FieldTypeConflict, WriteError, QueryKilled,
@@ -364,21 +364,33 @@ class Executor:
         if isinstance(stmt, ast.SetPassword) and stmt.name == user.name:
             return
         if isinstance(stmt, ast.ShowDatabases):
-            return  # any authenticated user (influx lists authorized dbs)
-        if isinstance(stmt, ast.SelectStatement):
-            need = "WRITE" if stmt.into is not None else "READ"
-            if user.can(need, db):
-                return
-            raise AuthError(f"user {user.name!r} lacks {need} on {db!r}")
+            return  # any authenticated user; rows are filtered to
+            # authorized dbs in execute_statement (influx semantics)
+        select = None
         if isinstance(stmt, ast.ExplainStatement):
-            if user.can("READ", db):
-                return
-            raise AuthError(f"user {user.name!r} lacks READ on {db!r}")
+            select = stmt.select
+        elif isinstance(stmt, ast.SelectStatement):
+            select = stmt
+        if select is not None:
+            # READ must hold on EVERY source database — including
+            # per-source overrides (FROM "otherdb"..m) and subquery inner
+            # sources — not just the request's db param; WRITE likewise on
+            # the INTO target's own database.
+            for sdb in sorted(self._select_source_dbs(select, db)):
+                if not user.can("READ", sdb):
+                    raise AuthError(f"user {user.name!r} lacks READ on {sdb!r}")
+            # checked on the SELECT itself whether it arrived bare or
+            # wrapped in EXPLAIN [ANALYZE] — analyze executes the write
+            if select.into is not None:
+                tdb = select.into.database or db
+                if not user.can("WRITE", tdb):
+                    raise AuthError(f"user {user.name!r} lacks WRITE on {tdb!r}")
+            return
         if isinstance(
             stmt,
             (ast.ShowMeasurements, ast.ShowTagKeys, ast.ShowTagValues,
              ast.ShowFieldKeys, ast.ShowSeries, ast.ShowRetentionPolicies,
-             ast.ShowDatabases, ast.ShowContinuousQueries,
+             ast.ShowContinuousQueries,
              ast.ShowMeasurementCardinality, ast.ShowSeriesCardinality),
         ):
             if user.can("READ", getattr(stmt, "database", "") or db):
@@ -386,14 +398,34 @@ class Executor:
             raise AuthError(f"user {user.name!r} lacks READ on {db!r}")
         raise AuthError(f"user {user.name!r} is not authorized (admin required)")
 
-    def execute_statement(self, stmt, db: str, now_ns: int) -> dict:
+    @staticmethod
+    def _select_source_dbs(select, default_db: str) -> set:
+        """Every database a SELECT reads from, recursing into subqueries."""
+        dbs = set()
+
+        def walk(s):
+            if not s.sources:
+                dbs.add(default_db)
+            for src in s.sources:
+                if isinstance(src, ast.SubQuery):
+                    walk(src.stmt)
+                else:
+                    dbs.add(src.database or default_db)
+
+        walk(select)
+        return dbs
+
+    def execute_statement(self, stmt, db: str, now_ns: int, user=None) -> dict:
         if isinstance(stmt, ast.SelectStatement):
             STATS.incr("executor", "selects")
             return self._select(stmt, db, now_ns)
         if isinstance(stmt, ast.ExplainStatement):
             return self._explain(stmt, db, now_ns)
         if isinstance(stmt, ast.ShowDatabases):
-            rows = [[name] for name in self.engine.database_names()]
+            names = self.engine.database_names()
+            if self.auth_enabled and user is not None and not user.admin:
+                names = [n for n in names if user.privileges.get(n)]
+            rows = [[name] for name in names]
             return _series_result("databases", None, ["name"], rows)
         if isinstance(stmt, ast.ShowMeasurements):
             return self._show_measurements(stmt, db)

@@ -599,9 +599,9 @@ def _make_handler(svc: HttpService):
                 return
             db = params.get("db", "")
             mst = params.get("measurement", "")
-            if svc.auth_enabled and len(svc.users) and not (
-                user and user.can("READ", db)
-            ):
+            if svc.auth_enabled and not (user and user.can("READ", db)):
+                # no bootstrap exemption: with auth on and zero users the
+                # only open operation is creating the first admin
                 self._send_json(403, {"error": "read not authorized"})
                 return
             if getattr(svc.engine, "read_disabled", False):

@@ -297,3 +297,93 @@ class TestReviewRegressions:
         finally:
             svc.stop()
             engine.close()
+
+
+class TestAdvisorRegressions:
+    """Round-1 advisor findings: cross-db privilege bypass, SHOW DATABASES
+    info leak, consume bootstrap bypass."""
+
+    def _auth_env(self, tmp_path):
+        e = Engine(str(tmp_path / "adv"))
+        e.create_database("db")
+        e.create_database("other")
+        e.write_lines("db", f"m v=1 {BASE*NS}")
+        e.write_lines("other", f"m v=9 {BASE*NS}")
+        ex = Executor(e, auth_enabled=True)
+        ex.execute("CREATE USER root WITH PASSWORD 'p' WITH ALL PRIVILEGES",
+                   db="db")
+        root = ex.users.authenticate("root", "p")
+        ex.execute("CREATE USER bob WITH PASSWORD 'b'", db="db", user=root)
+        bob = ex.users.authenticate("bob", "b")
+        return e, ex, root, bob
+
+    def test_cross_db_source_requires_read(self, tmp_path):
+        e, ex, root, bob = self._auth_env(tmp_path)
+        ex.execute("GRANT READ ON db TO bob", db="db", user=root)
+        with pytest.raises(AuthError, match="lacks READ on 'other'"):
+            ex.execute('SELECT v FROM "other".."m"', db="db", user=bob)
+        # subquery inner sources are checked too
+        with pytest.raises(AuthError, match="lacks READ on 'other'"):
+            ex.execute('SELECT mean(v) FROM (SELECT v FROM "other".."m")',
+                       db="db", user=bob)
+        # the authorized db still works
+        res = ex.execute("SELECT v FROM m", db="db", user=bob)
+        assert "error" not in res["results"][0]
+        e.close()
+
+    def test_into_requires_write_on_target_db(self, tmp_path):
+        e, ex, root, bob = self._auth_env(tmp_path)
+        ex.execute("GRANT ALL ON db TO bob", db="db", user=root)
+        with pytest.raises(AuthError, match="lacks WRITE on 'other'"):
+            ex.execute('SELECT v INTO "other".."t" FROM m', db="db", user=bob)
+        # INTO also still requires READ on the source db
+        ex.execute("CREATE USER carol WITH PASSWORD 'c'", db="db", user=root)
+        ex.execute("GRANT WRITE ON db TO carol", db="db", user=root)
+        carol = ex.users.authenticate("carol", "c")
+        with pytest.raises(AuthError, match="lacks READ on 'db'"):
+            ex.execute("SELECT v INTO t2 FROM m", db="db", user=carol)
+        e.close()
+
+    def test_show_databases_filtered_by_privilege(self, tmp_path):
+        e, ex, root, bob = self._auth_env(tmp_path)
+        ex.execute("GRANT READ ON db TO bob", db="db", user=root)
+        res = ex.execute("SHOW DATABASES", db="", user=bob)
+        names = [r[0] for r in res["results"][0]["series"][0]["values"]]
+        assert names == ["db"]
+        res = ex.execute("SHOW DATABASES", db="", user=root)
+        names = [r[0] for r in res["results"][0]["series"][0]["values"]]
+        assert sorted(names) == ["db", "other"]
+        e.close()
+
+
+    def test_explain_analyze_into_requires_write(self, tmp_path):
+        e, ex, root, bob = self._auth_env(tmp_path)
+        ex.execute("GRANT READ ON db TO bob", db="db", user=root)
+        with pytest.raises(AuthError, match="lacks WRITE"):
+            ex.execute("EXPLAIN ANALYZE SELECT v INTO t2 FROM m",
+                       db="db", user=bob)
+        # and nothing was written
+        res = ex.execute("SELECT v FROM t2", db="db", user=root)
+        assert "series" not in res["results"][0]
+        e.close()
+
+    def test_consume_locked_during_auth_bootstrap(self, tmp_path):
+        engine = Engine(str(tmp_path / "cons"))
+        engine.create_database("db")
+        engine.write_lines("db", f"m v=1 {BASE*NS}")
+        svc = HttpService(engine, "127.0.0.1", 0, auth_enabled=True)
+        svc.start()
+        try:
+            def req(**params):
+                url = (f"http://127.0.0.1:{svc.port}/api/v1/consume?"
+                       + urllib.parse.urlencode(params))
+                try:
+                    with urllib.request.urlopen(url) as r:
+                        return r.status
+                except urllib.error.HTTPError as e2:
+                    return e2.code
+            # zero users + auth on: consume must NOT be open
+            assert req(db="db", measurement="m") == 403
+        finally:
+            svc.stop()
+            engine.close()

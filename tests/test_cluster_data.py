@@ -810,3 +810,40 @@ class TestClusterHealth:
         assert by_id["nUp"] == "up" and by_id["nDown"] == "down"
         live_svc.stop()
         e.close()
+
+
+class TestHintInflightOrphan:
+    def test_inflight_orphan_merged_back(self, tmp_path):
+        """A crash mid-replay leaves <node>.jsonl.inflight; the node must
+        stay excluded from primary reads and the copies re-delivered in
+        order ahead of newer hints (advisor round-1 medium finding)."""
+        import os
+
+        from opengemini_tpu.parallel.cluster import DataRouter
+
+        eng = Engine(str(tmp_path / "orph"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1", rf=2)
+        p1 = [("m", (), BASE * NS, {"v": (FieldType.FLOAT, 1.0)})]
+        p2 = [("m", (), (BASE + 1) * NS, {"v": (FieldType.FLOAT, 2.0)})]
+        router.hint("nB", "db", None, p1)
+        d = router._hints_dir()
+        live = os.path.join(d, "nB.jsonl")
+        os.replace(live, live + ".inflight")  # simulate crash mid-replay
+        assert "nB" in router.pending_hint_nodes()
+        router.hint("nB", "db", None, p2)  # a newer hint arrives after
+        sent = []
+        router.forward_points = lambda nid, db, rp, pts: sent.append(pts)
+        n = router.replay_hints()
+        assert n == 2
+        assert [p[0][3]["v"][1] for p in sent] == [1.0, 2.0]  # order kept
+        assert not os.path.exists(live + ".inflight")
+        assert "nB" not in router.pending_hint_nodes()
+        eng.close()
