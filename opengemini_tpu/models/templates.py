@@ -69,6 +69,7 @@ class AggBatch:
         self.n = 0
         self._padded = None
         self._counts_cache: dict[int, np.ndarray] = {}
+        self._mesh_outs: dict[int, dict] = {}
 
     def add(self, values, rel_ns, seg_ids, mask, times_ns):
         self.values.append(np.asarray(values, dtype=self.dtype))
@@ -120,7 +121,20 @@ class AggBatch:
 
     def run(self, spec: AggSpec, num_segments: int, params: tuple = ()):
         """Execute one aggregate; returns (values[num_segments],
-        sel_idx[num_segments] | None, counts[num_segments])."""
+        sel_idx[num_segments] | None, counts[num_segments]).
+
+        With a configured device mesh (parallel/runtime.py) the mesh-
+        servable aggregates run as ONE shard_map program over all devices
+        (rows sharded, collective merges) — the executor's actual
+        multi-chip path; the sel contract is identical (global row
+        indices), so selector time resolution is unchanged."""
+        from opengemini_tpu.parallel import runtime as prt
+
+        mesh = prt.get_mesh()
+        if mesh is not None and not params:
+            got = self._run_mesh(mesh, spec, num_segments)
+            if got is not None:
+                return got
         seg_pad = winmod.pad_to(max(num_segments, 1), 256)
         arrays = self._concat_padded()
         fn = _jitted(spec.fn, seg_pad, tuple(params))
@@ -128,3 +142,26 @@ class AggBatch:
         out_np = np.asarray(out)[:num_segments]
         sel_np = np.asarray(sel)[:num_segments] if sel is not None else None
         return out_np, sel_np, self.counts(num_segments)
+
+    def _run_mesh(self, mesh, spec, num_segments: int):
+        from opengemini_tpu.parallel import distributed as dist
+
+        if spec.name not in dist.MESH_AGGS:
+            return None
+        seg_pad = winmod.pad_to(max(num_segments, 1), 256)
+        outs = self._mesh_outs.get(seg_pad)
+        if outs is None:
+            values, rel_hi, rel_lo, seg_ids, mask = self._concat_padded()
+            gidx = np.arange(len(values), dtype=np.int32)
+            fn = dist.batch_agg_jit(mesh, seg_pad)
+            sharded = dist.shard_rows(
+                mesh, values, rel_hi, rel_lo, seg_ids, mask, gidx
+            )
+            outs = {k: np.asarray(v) for k, v in fn(*sharded).items()}
+            self._mesh_outs[seg_pad] = outs
+        out = outs[spec.name][:num_segments]
+        sel = outs.get(spec.name + "_sel")
+        if sel is not None:
+            sel = sel[:num_segments]
+        counts = outs["count"][:num_segments]
+        return out, sel, counts

@@ -17,12 +17,14 @@ from __future__ import annotations
 import hashlib
 import json
 import threading
+import urllib.error
 import urllib.request
 
 import numpy as np
 
 from opengemini_tpu.index.inverted import SeriesIndex
 from opengemini_tpu.record import Column, FieldType, Record
+from opengemini_tpu.utils.stats import GLOBAL as STATS
 
 
 def owners(nodes: list[str], db: str, rp: str, group_start: int,
@@ -64,6 +66,18 @@ def decode_points(doc: list) -> list:
 
 class RemoteScanError(Exception):
     """A data node required for a complete answer was unreachable."""
+
+
+class PartialsRetry(Exception):
+    """A peer died between the metadata round and the partial-aggregate
+    round: the caller must rebuild its plan against a fresh live set
+    (primary assignment shifted) and retry the whole statement."""
+
+
+class PartialsUnavailable(Exception):
+    """A live peer answered the partial round with an HTTP error (e.g. a
+    not-yet-upgraded node 404ing the endpoint): the caller should fall
+    back to the raw column exchange instead of retrying or failing."""
 
 
 class _NodeDown(Exception):
@@ -153,6 +167,74 @@ class RemoteShard:
             if fields is None or k in fields
         }
         return Record(times[lo:hi], cols)
+
+
+class _MetaIndex(SeriesIndex):
+    """Empty index that still reports the remote measurement's tag keys
+    (GROUP BY * and WHERE classification need them); every posting lookup
+    legitimately returns nothing — remote series are represented by the
+    partial arrays, not by local sids."""
+
+    def __init__(self, tag_keys_by_mst: dict):
+        super().__init__()
+        self._tk = tag_keys_by_mst
+
+    def tag_keys(self, mst):
+        return set(self._tk.get(mst, ()))
+
+
+class MetaShard:
+    """Metadata-only stand-in for remote data during aggregate pushdown
+    (reference: the shard-mapper prepare round that fetches schema/tag
+    metadata before store-side execution). Contributes tag keys, field
+    schema, and the data time extent to scan planning; owns no rows."""
+
+    supports_preagg = False
+
+    def __init__(self, mst: str, tag_keys: set, schema: dict,
+                 dmin: int, dmax: int):
+        self._mst = mst
+        self.index = _MetaIndex({mst: set(tag_keys)})
+        self._schema = {n: FieldType[t] for n, t in schema.items()}
+        self.tmin = dmin
+        self.tmax = dmax + 1
+        self.mem = _RemoteMem(dmin, dmax)
+
+    def measurements(self):
+        return [self._mst]
+
+    def schema(self, mst):
+        return dict(self._schema) if mst == self._mst else {}
+
+    def file_chunks(self, mst, sids=None, tmin=None, tmax=None):
+        return []
+
+    def read_series(self, mst, sid, tmin=None, tmax=None, fields=None):
+        return Record.empty()
+
+
+def serialize_select_meta(engine, db, rp, mst, tmin, tmax,
+                          shard_filter=None) -> dict:
+    """Peer side of the pushdown metadata round: tag keys, schema, and
+    data extent of `mst` within the range on THIS node."""
+    shards = engine.shards_for_range(db, rp, tmin, tmax)
+    if shard_filter is not None:
+        shards = [sh for sh in shards if shard_filter(sh)]
+    tag_keys: set[str] = set()
+    schema: dict[str, str] = {}
+    dmin = dmax = None
+    for sh in shards:
+        tag_keys.update(sh.index.tag_keys(mst))
+        for name, ftype in sh.schema(mst).items():
+            schema.setdefault(name, ftype.name)
+        for r, c in sh.file_chunks(mst):
+            dmin = c.tmin if dmin is None else min(dmin, c.tmin)
+            dmax = c.tmax if dmax is None else max(dmax, c.tmax)
+        if sh.mem.min_time is not None:
+            dmin = sh.mem.min_time if dmin is None else min(dmin, sh.mem.min_time)
+            dmax = sh.mem.max_time if dmax is None else max(dmax, sh.mem.max_time)
+    return {"tag_keys": sorted(tag_keys), "schema": schema,
+            "dmin": dmin, "dmax": dmax}
 
 
 # explicit little-endian wire dtypes: a big-endian peer must not emit
@@ -662,14 +744,7 @@ class DataRouter:
         owners, so with >= rf nodes down SOME group may have lost every
         copy — the query fails rather than answer partially. rf=1
         tolerates none for the same reason."""
-        nodes = self.data_nodes()
-        live = sorted(nodes)
-        if self.rf > 1:
-            pending = self.pending_hint_nodes() - {self.self_id}
-            if pending and len(live) - len(pending & set(live)) >= 1:
-                # a recovered replica missing OUR hinted copies must not
-                # serve as primary until the queue drains
-                live = [n for n in live if n not in pending]
+        live = self._initial_live()
         dropped: list[str] = []
         while True:
             payloads, dead = self._fetch_once(db, rp, mst, tmin, tmax, live)
@@ -686,10 +761,119 @@ class DataRouter:
                 )
             live = [n for n in live if n not in dead]
 
+    def _initial_live(self) -> list[str]:
+        """Starting live set for a read fan-out: every registered data
+        node, minus (rf>1) recovered replicas still missing OUR hinted
+        copies — they must not serve as primary until the queue drains."""
+        live = sorted(self.data_nodes())
+        if self.rf > 1:
+            pending = self.pending_hint_nodes() - {self.self_id}
+            if pending and len(live) - len(pending & set(live)) >= 1:
+                live = [n for n in live if n not in pending]
+        return live
+
+    def has_peers(self) -> bool:
+        return any(nid != self.self_id for nid in self.data_nodes())
+
+    def select_meta(self, db: str, rp: str | None, mst: str,
+                    tmin: int, tmax: int):
+        """Pushdown metadata round: merged (tag_keys, schema, dmin, dmax)
+        across peers, with the same replica-failover semantics as
+        scan_shards. Returns (merged doc | None, live)."""
+        STATS.incr("cluster", "meta_fanouts")
+        live = self._initial_live()
+        dropped: list[str] = []
+        while True:
+            def fetch(nid, addr):
+                if nid not in live:
+                    return {}
+                if not addr:
+                    return _NodeDown(nid, f"no address for data node {nid!r}")
+                try:
+                    return self._post(addr, "/internal/select_meta", {
+                        "db": db, "rp": rp, "mst": mst,
+                        "tmin": tmin, "tmax": tmax,
+                        "live": live, "rf": self.rf,
+                    })
+                except OSError as e:
+                    return _NodeDown(
+                        nid, f"data node {nid!r} ({addr}) unreachable: {e}")
+
+            metas, dead = [], set()
+            for got in self._fanout(fetch):
+                if isinstance(got, _NodeDown):
+                    dead.add(got.nid)
+                elif got:
+                    metas.append(got)
+            if not dead:
+                break
+            dropped.extend(sorted(dead))
+            if len(dropped) >= self.rf:
+                raise RemoteScanError(
+                    f"{len(dropped)} data nodes unreachable "
+                    f"({', '.join(dropped)}) with replication factor "
+                    f"{self.rf}: some shard groups may have no live copy")
+            live = [n for n in live if n not in dead]
+        tag_keys: set[str] = set()
+        schema: dict[str, str] = {}
+        dmin = dmax = None
+        for m in metas:
+            tag_keys.update(m.get("tag_keys", []))
+            for n, t in m.get("schema", {}).items():
+                schema.setdefault(n, t)
+            if m.get("dmin") is not None:
+                dmin = m["dmin"] if dmin is None else min(dmin, m["dmin"])
+                dmax = m["dmax"] if dmax is None else max(dmax, m["dmax"])
+        if not schema and dmin is None and not tag_keys:
+            return None, live
+        return ({"tag_keys": tag_keys, "schema": schema,
+                 "dmin": dmin, "dmax": dmax}, live)
+
+    def select_partials(self, req: dict, live: list[str]) -> list[dict]:
+        """Partial-aggregate round against the live set pinned by the
+        metadata round. Any death here shifts primary ownership, which
+        invalidates the coordinator's whole plan — raise PartialsRetry
+        so the statement rebuilds, instead of silently merging a
+        now-inconsistent primary view."""
+        from opengemini_tpu.query.partials import parse_partials
+
+        STATS.incr("cluster", "partials_fanouts")
+        body = dict(req, live=live, rf=self.rf)
+
+        def fetch(nid, addr):
+            if nid not in live:
+                return {}
+            if not addr:
+                return _NodeDown(nid, f"no address for data node {nid!r}")
+            try:
+                raw, _ct = self._post_raw(addr, "/internal/select_partials", body)
+                return (nid, parse_partials(raw))
+            except urllib.error.HTTPError as e:
+                # the peer is ALIVE but errored (bad request / missing
+                # endpoint during a rolling upgrade): not a node-down
+                return PartialsUnavailable(
+                    f"data node {nid!r} ({addr}) cannot serve partials: {e}")
+            except OSError as e:
+                return _NodeDown(
+                    nid, f"data node {nid!r} ({addr}) unreachable: {e}")
+
+        docs = []
+        for got in self._fanout(fetch):
+            if isinstance(got, PartialsUnavailable):
+                raise got
+            if isinstance(got, _NodeDown):
+                raise PartialsRetry(str(got))
+            if got:
+                docs.append(got)
+        docs.sort(key=lambda p: p[0])  # deterministic tie-break order
+        return [d for _nid, d in docs]
+
     def _fetch_once(self, db, rp, mst, tmin, tmax, live):
         """One fan-out round. Returns (payloads, dead node ids) —
         collecting EVERY dead peer in the round so failover retries once,
         not once per dead node."""
+        STATS.incr("cluster", "scan_fanouts")
+
         def fetch(nid, addr):
             if nid not in live:
                 return {}

@@ -149,6 +149,123 @@ def build_dist_agg(mesh: Mesh, num_segments: int):
     return jax.jit(sharded)
 
 
+# aggregates the mesh batch step can serve (everything the executor's
+# device path computes except rank-based ones — median/percentile — and
+# stddev, which keep the single-device kernels)
+MESH_AGGS = {"count", "sum", "mean", "min", "max", "first", "last", "spread"}
+
+_BIG_F = jnp.inf
+
+
+def _reduce(x, axes, op):
+    for ax in axes:
+        x = op(x, ax)
+    return x
+
+
+def _winner(keys, valid, axes):
+    """Cross-device lexicographic winner one-hot. keys: [(array,
+    minimize)], narrowed key by key; ties resolve to the lowest device
+    rank — exactly one device wins per segment, deterministically."""
+    cand = valid
+    for arr, minimize in keys:
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            sent = _BIG_F if minimize else -_BIG_F
+        else:
+            sent = _BIG_I32 if minimize else -_BIG_I32
+        masked = jnp.where(cand, arr, sent)
+        best = _reduce(masked, axes, jax.lax.pmin if minimize else jax.lax.pmax)
+        cand = cand & (masked == best)
+    rank = jnp.zeros((), jnp.int32)
+    for ax in axes:
+        rank = rank * jax.lax.axis_size(ax) + jax.lax.axis_index(ax)
+    rank_masked = jnp.where(cand, rank, _BIG_I32)
+    rank_best = _reduce(rank_masked, axes, jax.lax.pmin)
+    return cand & (rank == rank_best)
+
+
+def _pick(x, w, axes):
+    """Replicate the winning device's x (w: winner one-hot). where, not
+    multiply: inf * 0 would poison the psum with NaN."""
+    return _reduce(jnp.where(w, x, jnp.zeros((), x.dtype)), axes, jax.lax.psum)
+
+
+def build_batch_agg(mesh: Mesh, num_segments: int):
+    """The executor's aggregate batch step over a device mesh: the exact
+    multi-chip equivalent of templates.AggBatch's single-device kernels.
+
+    Takes row-sharded (values, rel_hi, rel_lo, seg_ids, mask, global_idx)
+    and returns replicated per-segment outputs — values for every mesh
+    aggregate plus `<sel>`_sel global row indices for selectors, which the
+    executor resolves against host-side ns times exactly like the
+    single-device sel contract (reference: the store-side aggregate
+    cursors + coordinator merge collapsed into one SPMD program)."""
+    axes = mesh.axis_names
+
+    def step(values, rel_hi, rel_lo, seg_ids, mask, gidx):
+        n_rows = values.shape[0]
+
+        def tkeys(sel):
+            safe = jnp.clip(sel, 0, n_rows - 1)
+            return rel_hi[safe], rel_lo[safe], gidx[safe]
+
+        c = seg.seg_count(seg_ids, num_segments, mask)
+        s = seg.seg_sum(values, seg_ids, num_segments, mask)
+        valid = c > 0
+        totc = _reduce(c, axes, jax.lax.psum)
+        tots = _reduce(s, axes, jax.lax.psum)
+        out = {
+            "count": totc,
+            "sum": tots,
+            "mean": tots / jnp.maximum(totc, 1).astype(tots.dtype),
+        }
+        selectors = {
+            "min": seg.seg_min_selector(values, rel_hi, rel_lo, seg_ids,
+                                        num_segments, mask),
+            "max": seg.seg_max_selector(values, rel_hi, rel_lo, seg_ids,
+                                        num_segments, mask),
+            "first": seg.seg_first(values, rel_hi, rel_lo, seg_ids,
+                                   num_segments, mask),
+            "last": seg.seg_last(values, rel_hi, rel_lo, seg_ids,
+                                 num_segments, mask),
+        }
+        for name, (v, sel) in selectors.items():
+            th, tl, gsel = tkeys(sel)
+            if name == "min":
+                keys = [(v, True), (th, True), (tl, True)]
+            elif name == "max":
+                keys = [(v, False), (th, True), (tl, True)]
+            elif name == "first":
+                keys = [(th, True), (tl, True)]
+            else:
+                keys = [(th, False), (tl, False)]
+            w = _winner(keys, valid, axes)
+            out[name] = _pick(v, w, axes)
+            out[name + "_sel"] = _pick(gsel, w, axes)
+        out["spread"] = out["max"] - out["min"]
+        return out
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(axes),) * 6,
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+_BATCH_AGG_CACHE: dict = {}
+
+
+def batch_agg_jit(mesh: Mesh, num_segments: int):
+    key = (mesh, num_segments)
+    fn = _BATCH_AGG_CACHE.get(key)
+    if fn is None:
+        fn = _BATCH_AGG_CACHE[key] = build_batch_agg(mesh, num_segments)
+    return fn
+
+
 def shard_rows(mesh: Mesh, *arrays):
     """Pad row arrays to a multiple of the mesh size (padding masked out by
     callers via the mask array convention: the LAST array is the mask) and

@@ -1,0 +1,21 @@
+"""Process-wide device-mesh configuration.
+
+When a mesh is set (multi-chip deployment, or the driver's virtual-CPU
+dry run), the executor's general aggregate batch path runs as a
+shard_map program over it: rows sharded across devices, per-segment
+partials merged with XLA collectives (parallel/distributed.py). With no
+mesh, everything runs single-device exactly as before.
+"""
+
+from __future__ import annotations
+
+_mesh = None
+
+
+def set_mesh(mesh) -> None:
+    global _mesh
+    _mesh = mesh
+
+
+def get_mesh():
+    return _mesh

@@ -26,6 +26,7 @@ import numpy as np
 
 from opengemini_tpu.models import ragged, templates
 from opengemini_tpu.ops import aggregates as aggmod
+from opengemini_tpu.parallel import cluster as pcluster
 from opengemini_tpu.ops import window as winmod
 from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query import functions as fnmod
@@ -62,10 +63,45 @@ class ScanContext:
     group_tags: list
     group_keys: list
     scan_plan: list
+    live: list | None = None  # cluster live set pinned by the remote round
 
 
 # host calls safe on string columns (python-object values end-to-end)
 _STRING_OK_HOST = {"count", "count_distinct", "mode", "first", "last", "distinct"}
+
+
+def pick_batch(schema, agg_names, field: str, dtype):
+    """Batch implementation for one field given the aggregate names that
+    will run on it. Dense-capable aggregates use the ragged->dense
+    bucketed batch (~100x over scatter on TPU, models/ragged.py);
+    rank-based ones (percentile/median/count_distinct) keep the lexsort
+    AggBatch. Shared by the local aggregate path and the data-node
+    partial computation (query/partials.py) so both sides pick identical
+    numerics."""
+    from opengemini_tpu.models import ragged as _ragged
+    from opengemini_tpu.models import templates as _templates
+    from opengemini_tpu.parallel import runtime as _prt
+
+    if (
+        schema.get(field) == FieldType.INT
+        and all(n in _ragged.INT_EXACT_AGGS for n in agg_names)
+        and any(n in ("sum", "mean") for n in agg_names)
+    ):
+        # int64-exact host path: float compute would corrupt ints beyond
+        # the mantissa (2^24 on-TPU f32). count alone is value-independent
+        # and stays on the fast device path.
+        return _ragged.IntExactBatch()
+    if _prt.get_mesh() is not None:
+        from opengemini_tpu.parallel.distributed import MESH_AGGS
+
+        if all(n in MESH_AGGS for n in agg_names):
+            # device mesh configured: the AggBatch shard_map path runs
+            # these over every chip; the bucketed layout stays
+            # single-device
+            return _templates.AggBatch(dtype)
+    if all(n in _ragged.DENSE_AGGS for n in agg_names):
+        return _ragged.BucketedBatch(dtype)
+    return _templates.AggBatch(dtype)
 
 
 def _check_host_field_type(call_name: str, field: str, schema: dict) -> None:
@@ -984,17 +1020,36 @@ class Executor:
 
     # -- shared scan planning ----------------------------------------------
 
-    def _all_shards_with_remote(self, db, rp, mst, condition, now_ns):
-        """Local shards + RemoteShard proxies from peer data nodes (when
-        clustered routing is on). The remote fetch is bounded by the
-        query's own time range, extracted before tag keys are known."""
+    def _all_shards_with_remote(self, db, rp, mst, condition, now_ns,
+                                remote_mode="raw"):
+        """Local shards + remote representation from peer data nodes
+        (when clustered routing is on). remote_mode:
+          "raw"  — RemoteShard row proxies (full column exchange);
+          "meta" — one MetaShard carrying remote tag keys / schema /
+                   extent only; the rows stay put and arrive later as
+                   per-(group, window) partials (aggregate pushdown).
+        Returns (shards, live_node_list | None)."""
         shards = self.engine.shards_for_range(db, rp, cond.MIN_TIME, cond.MAX_TIME)
+        live = None
         if self.router is not None:
+            from opengemini_tpu.parallel.cluster import MetaShard
+
             pre = cond.split(condition, set(), now_ns)
             try:
-                remote, live = self.router.scan_shards(
-                    db, rp, mst, pre.tmin, pre.tmax
-                )
+                if remote_mode == "meta":
+                    meta, live = self.router.select_meta(
+                        db, rp, mst, pre.tmin, pre.tmax
+                    )
+                    remote = []
+                    if meta is not None and meta["dmin"] is not None:
+                        remote = [MetaShard(
+                            mst, meta["tag_keys"], meta["schema"],
+                            meta["dmin"], meta["dmax"],
+                        )]
+                else:
+                    remote, live = self.router.scan_shards(
+                        db, rp, mst, pre.tmin, pre.tmax
+                    )
             except Exception as e:  # noqa: BLE001 — partial data = wrong data
                 raise QueryError(str(e)) from e
             if self.router.rf > 1:
@@ -1005,14 +1060,16 @@ class Executor:
                     if self.router.is_primary(db, rp, sh.tmin, live)
                 ]
             shards = shards + remote
-        return shards
+        return shards, live
 
-    def _scan_context(self, stmt, db, rp, mst, now_ns):
+    def _scan_context(self, stmt, db, rp, mst, now_ns, remote_mode="raw"):
         """Shared prologue of every select path: schema/tag keys, WHERE
         split, shard mapping, data-driven range clamp, window grid, group
         construction (reference: the Prepare + MapShards steps,
         SURVEY.md §3.2). Returns None when nothing matches."""
-        shards_all = self._all_shards_with_remote(db, rp, mst, stmt.condition, now_ns)
+        shards_all, live = self._all_shards_with_remote(
+            db, rp, mst, stmt.condition, now_ns, remote_mode
+        )
         tag_keys: set[str] = set()
         schema: dict[str, FieldType] = {}
         for sh in shards_all:
@@ -1069,18 +1126,63 @@ class Executor:
                     gid_of[key] = gid
                     group_keys.append(key)
                 scan_plan.append((sh, sid, gid))
-        if not scan_plan:
+        if not scan_plan and not (remote_mode == "meta" and live is not None):
+            # clustered "meta" scans proceed with an empty local plan:
+            # the groups may exist only as remote partials
             return None
         return ScanContext(
             sc, shards, tmin, tmax, schema, tag_keys, group_time, aligned, W,
-            group_tags, group_keys, scan_plan,
+            group_tags, group_keys, scan_plan, live,
         )
 
     # -- aggregate path -----------------------------------------------------
 
     def _select_agg(self, stmt, db, rp, mst, now_ns, calls, trace=tracing.NOOP) -> list[dict]:
+        from opengemini_tpu.query import partials as pmod
+
+        # resolve agg specs + fields (before planning: the set decides
+        # whether remote data arrives as partials or raw columns)
+        aggs = []  # (out_name, spec, params, field_name)
+        for f in stmt.fields:
+            for call in _calls_in(f.expr):
+                spec, params, field_name = _resolve_call(call)
+                aggs.append((call, spec, params, field_name))
+
+        pushdown = (
+            self.router is not None
+            # getattr: duck-typed router stubs without the full surface
+            # keep the raw column-exchange path
+            and getattr(self.router, "has_peers", lambda: False)()
+            and all(spec.name in pmod.MERGEABLE for _c, spec, _p, _f in aggs)
+        )
+        attempts = max(self.router.rf, 1) if pushdown else 1
+        for attempt in range(attempts):
+            try:
+                return self._select_agg_run(
+                    stmt, db, rp, mst, now_ns, aggs, pushdown, trace
+                )
+            except pcluster.PartialsUnavailable:
+                # a live peer cannot serve partials (e.g. rolling
+                # upgrade): the raw column exchange still works
+                return self._select_agg_run(
+                    stmt, db, rp, mst, now_ns, aggs, False, trace
+                )
+            except pcluster.PartialsRetry as e:
+                # a peer died mid-query: primary ownership shifted, the
+                # whole plan (live set, local primary filter) is stale
+                if attempt == attempts - 1:
+                    raise QueryError(str(e)) from e
+        raise AssertionError("unreachable")
+
+    def _select_agg_run(self, stmt, db, rp, mst, now_ns, aggs, pushdown,
+                        trace=tracing.NOOP) -> list[dict]:
+        from opengemini_tpu.query import partials as pmod
+
         with trace.span("map_shards") as sp:
-            ctx = self._scan_context(stmt, db, rp, mst, now_ns)
+            ctx = self._scan_context(
+                stmt, db, rp, mst, now_ns,
+                remote_mode="meta" if pushdown else "raw",
+            )
             if ctx is not None:
                 sp.add_field("shards", len(ctx.shards))
                 sp.add_field("series", len(ctx.scan_plan))
@@ -1093,13 +1195,6 @@ class Executor:
         group_tags, group_keys, scan_plan = ctx.group_tags, ctx.group_keys, ctx.scan_plan
         schema = ctx.schema
 
-        # resolve agg specs + fields
-        aggs = []  # (out_name, spec, params, field_name)
-        for f in stmt.fields:
-            for call in _calls_in(f.expr):
-                spec, params, field_name = _resolve_call(call)
-                aggs.append((call, spec, params, field_name))
-
         num_groups = len(group_keys)
         num_segments = num_groups * W
 
@@ -1108,28 +1203,13 @@ class Executor:
         read_fields = sorted(set(needed_fields) | set(field_filter_fields))
 
         dtype = templates.compute_dtype()
-        # dense-capable aggregates use the ragged->dense bucketed batch
-        # (~100x over scatter on TPU, models/ragged.py); rank-based ones
-        # (percentile/median/count_distinct) keep the lexsort path
         per_field_aggs: dict[str, list] = {}
         for _call, spec, _params, fname in aggs:
             per_field_aggs.setdefault(fname, []).append(spec.name)
-        def _pick_batch(f: str):
-            names = per_field_aggs[f]
-            if (
-                schema.get(f) == FieldType.INT
-                and all(n in ragged.INT_EXACT_AGGS for n in names)
-                and any(n in ("sum", "mean") for n in names)
-            ):
-                # int64-exact host path: float compute would corrupt ints
-                # beyond the mantissa (2^24 on-TPU f32). count alone is
-                # value-independent and stays on the fast device path.
-                return ragged.IntExactBatch()
-            if all(n in ragged.DENSE_AGGS for n in names):
-                return ragged.BucketedBatch(dtype)
-            return templates.AggBatch(dtype)
-
-        batches: dict[str, object] = {f: _pick_batch(f) for f in needed_fields}
+        batches: dict[str, object] = {
+            f: pick_batch(schema, per_field_aggs[f], f, dtype)
+            for f in needed_fields
+        }
 
         # string fields only support count on the device path (reference
         # supports first/last/distinct on strings — host path, later round)
@@ -1227,13 +1307,41 @@ class Executor:
                         total_c = counts + pc
                         out = (dev_sum + ps) / np.maximum(total_c, 1)
                     counts = counts + pc.astype(counts.dtype)
-                agg_results[id(call)] = (out, sel, counts, spec, field_name)
+                agg_results[id(call)] = (out, sel, counts, spec, field_name, None)
             sp.add_field("aggregates", len(aggs))
             sp.add_field("segments", num_segments)
             sp.add_field(
                 "batch_rows", {f: b.n for f, b in batches.items()}
             )
             STATS.incr("executor", "device_batches", len(aggs))
+
+        has_remote_data = any(
+            isinstance(sh, pcluster.MetaShard) for sh in shards
+        )
+        if pushdown and ctx.live is not None and has_remote_data:
+            # aggregate pushdown: peers computed the same grid over their
+            # shards; merge their O(groups x windows) partial arrays
+            # (reference: rpc_transform partial agg + merge_transform)
+            from opengemini_tpu.sql import astjson
+
+            with trace.span("remote_partials") as sp:
+                req = {
+                    "db": db, "rp": rp, "mst": mst,
+                    "tmin": tmin, "tmax": tmax, "aligned": aligned,
+                    "every_ns": group_time.every_ns if group_time else 0,
+                    "offset_ns": group_time.offset_ns if group_time else 0,
+                    "W": W, "group_tags": group_tags,
+                    "aggs": per_field_aggs,
+                    "tag_expr": astjson.to_json(sc.tag_expr),
+                    "field_expr": astjson.to_json(sc.field_expr),
+                }
+                peer_docs = self.router.select_partials(req, ctx.live)
+                if peer_docs:
+                    pmod.merge_remote_partials(
+                        agg_results, aggs, batches, group_keys, W,
+                        peer_docs, group_tags,
+                    )
+                sp.add_field("peers", len(peer_docs))
 
         with trace.span("render"):
             return self._render_agg(
@@ -1328,7 +1436,7 @@ class Executor:
 
         host_times = (
             batches[single_selector[4]].host_times()
-            if single_selector is not None
+            if single_selector is not None and single_selector[5] is None
             else None
         )
         out_series = []
@@ -1346,9 +1454,12 @@ class Executor:
                     any_present = any_present or present
                     vals.append(v)
                 if single_selector is not None:
-                    out, sel, counts, spec, fname = single_selector
+                    out, sel, counts, spec, fname, times_abs = single_selector
                     if counts[seg] > 0:
-                        t_out = int(host_times[sel[seg]])
+                        t_out = (
+                            int(times_abs[seg]) if times_abs is not None
+                            else int(host_times[sel[seg]])
+                        )
                 rows.append((t_out, vals, any_present))
             rows = _apply_fill(rows, stmt, columns)
             if not stmt.ascending:
@@ -1679,7 +1790,9 @@ class Executor:
     # -- raw path -----------------------------------------------------------
 
     def _select_raw(self, stmt, db, rp, mst, now_ns) -> list[dict]:
-        shards_all = self._all_shards_with_remote(db, rp, mst, stmt.condition, now_ns)
+        shards_all, _live = self._all_shards_with_remote(
+            db, rp, mst, stmt.condition, now_ns
+        )
         tag_keys: set[str] = set()
         schema: dict[str, FieldType] = {}
         for sh in shards_all:
@@ -2198,7 +2311,7 @@ def _eval_output_expr(expr, agg_results, seg, schema):
         entry = agg_results.get(id(expr))
         if entry is None:
             raise QueryError(f"unplanned call {expr.name}")
-        out, sel, counts, spec, fname = entry
+        out, sel, counts, spec, fname, _times = entry
         if counts[seg] == 0:
             return None, False
         # single-sample stddev renders 0 (reference NewStdDevReduce,

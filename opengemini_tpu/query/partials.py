@@ -1,0 +1,451 @@
+"""Distributed partial aggregation: the data-node side of aggregate
+pushdown plus the coordinator-side merge.
+
+Reference: the store-side partial aggregation + exchange/merge pipeline
+(engine/executor/rpc_transform.go:117, merge_transform.go,
+agg_transform.go). The reference streams chunk partials through RPC
+transforms; here each peer runs the SAME device batch machinery the
+coordinator uses (models/templates.AggBatch & friends) over its local
+shards against the coordinator's window grid, and ships one dense
+per-(group, window) partial array set — O(groups x windows), never
+O(rows) — which the coordinator merges with numpy before rendering.
+
+Mergeability table (what travels per requested aggregate):
+  count          -> count
+  sum            -> sum            mean   -> sum + count
+  min/max        -> value + exact ns time (selector rendering)
+  first/last     -> value + exact ns time (lexicographic winner)
+  spread         -> min + max
+  stddev         -> count + mean + M2 (Chan et al. parallel variance —
+                    numerically stable pairwise combine, unlike the
+                    naive sum-of-squares formula in low precision)
+
+Everything else (percentile, median, distinct, host transforms) is not
+losslessly mergeable from fixed-size partials and falls back to the raw
+column exchange (parallel/cluster.serialize_series_binary).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+# aggregate names whose cross-node merge is lossless from the partial set
+MERGEABLE = {
+    "count", "sum", "mean", "min", "max", "first", "last", "spread", "stddev",
+}
+
+# partial arrays required per requested aggregate
+_REQUIRES = {
+    "count": (),
+    "sum": ("sum",),
+    "mean": ("sum",),
+    "min": ("min",),
+    "max": ("max",),
+    "first": ("first",),
+    "last": ("last",),
+    "spread": ("min", "max"),
+    "stddev": ("mean", "m2"),
+}
+
+_BIG = np.int64(2**62)
+
+
+def partial_names(agg_names) -> list[str]:
+    """Wire partial-array names for a field's requested aggregates.
+    count is always present: it doubles as the per-window presence mask."""
+    out = {"count"}
+    for a in agg_names:
+        out.update(_REQUIRES[a])
+    return sorted(out)
+
+
+# -- peer side ---------------------------------------------------------------
+
+
+def compute_partials(engine, router, req: dict) -> bytes:
+    """Run the local slice of a distributed aggregate query.
+
+    req (built by DataRouter.select_partials): db, rp, mst, tmin, tmax,
+    aligned, every_ns, offset_ns, W, group_tags, aggs {field: [names]},
+    tag_expr / field_expr (astjson docs), live, rf.
+    """
+    from opengemini_tpu.models import templates
+    from opengemini_tpu.ops import aggregates as aggmod
+    from opengemini_tpu.ops import window as winmod
+    from opengemini_tpu.query import condition as cond
+    from opengemini_tpu.query.executor import (
+        _add_record_to_batches,
+        _prune_text_sids,
+        pick_batch,
+    )
+    from opengemini_tpu.sql import astjson
+
+    db, rp, mst = req["db"], req.get("rp") or None, req["mst"]
+    tmin, tmax = int(req["tmin"]), int(req["tmax"])
+    aligned, W = int(req["aligned"]), int(req["W"])
+    every = int(req.get("every_ns") or 0)
+    offset = int(req.get("offset_ns") or 0)
+    group_tags = list(req["group_tags"])
+    per_field = {f: list(names) for f, names in req["aggs"].items()}
+    tag_expr = astjson.from_json(req.get("tag_expr"))
+    field_expr = astjson.from_json(req.get("field_expr"))
+
+    shards = engine.shards_for_range(db, rp, tmin, tmax)
+    live = req.get("live")
+    if int(req.get("rf", 1)) > 1 and live and router is not None:
+        shards = [
+            sh for sh in shards
+            if router.is_primary(db, rp, sh.tmin, live)
+        ]
+
+    schema = {}
+    for sh in shards:
+        schema.update(sh.schema(mst))
+
+    field_filter_fields = (
+        sorted(cond.field_filter_refs(field_expr)) if field_expr is not None else []
+    )
+    read_fields = sorted(set(per_field) | set(field_filter_fields))
+    dtype = templates.compute_dtype()
+    batches = {
+        f: pick_batch(schema, per_field[f], f, dtype) for f in per_field
+    }
+
+    # group bookkeeping against the COORDINATOR's grid
+    gid_of: dict[tuple, int] = {}
+    group_keys: list[tuple] = []
+    group_tag_dicts: list[dict] = []
+    match_terms = [] if every else cond.conjunctive_match_terms(field_expr)
+    for sh in shards:
+        sids = cond.eval_tag_expr(tag_expr, sh.index, mst)
+        sids = _prune_text_sids(sh, mst, sids, match_terms)
+        for sid in sorted(sids):
+            tags = sh.index.tags_of(sid)
+            key = tuple(tags.get(k, "") for k in group_tags)
+            gid = gid_of.get(key)
+            if gid is None:
+                gid = len(group_keys)
+                gid_of[key] = gid
+                group_keys.append(key)
+                group_tag_dicts.append({k: tags.get(k, "") for k in group_tags})
+            rec = sh.read_series(mst, sid, tmin, tmax, fields=read_fields)
+            if len(rec) == 0:
+                continue
+            fmask = (
+                cond.eval_field_expr(field_expr, rec)
+                if field_expr is not None else None
+            )
+            if every:
+                widx, _ = winmod.window_index(rec.times, tmin, every, offset)
+                seg = (gid * W + widx.astype(np.int64)).astype(np.int32)
+            else:
+                seg = np.full(len(rec), gid, dtype=np.int32)
+            _add_record_to_batches(
+                rec, seg, aligned, sorted(per_field), batches, dtype, fmask
+            )
+
+    n_seg = max(len(group_keys), 1) * W
+    fields_out: dict[str, dict[str, np.ndarray]] = {}
+    for f, names in per_field.items():
+        batch = batches[f]
+        want = partial_names(names)
+        arrs: dict[str, np.ndarray] = {}
+        counts = None
+
+        def run(spec_name):
+            out, sel, cnt = batch.run(aggmod.get(spec_name), n_seg)
+            return out, sel, cnt
+
+        for p in want:
+            if p == "count":
+                _o, _s, counts = run("count")
+                arrs["count"] = np.asarray(counts, np.int64)
+            elif p == "sum":
+                out, _s, counts = run("sum")
+                arrs["sum"] = np.asarray(out)
+            elif p in ("min", "max", "first", "last"):
+                out, sel, counts = run(p)
+                arrs[p + "_v"] = np.asarray(out, np.float64)
+                times = batch.host_times()
+                if sel is not None and len(times):
+                    t = times[np.clip(np.asarray(sel), 0, len(times) - 1)]
+                else:
+                    t = np.zeros(n_seg, np.int64)
+                arrs[p + "_t"] = np.asarray(t, np.int64)
+            elif p == "mean":
+                out, _s, counts = run("mean")
+                arrs["mean"] = np.asarray(out, np.float64)
+            elif p == "m2":
+                sd, _s, counts = run("stddev")
+                c = np.asarray(counts, np.float64)
+                arrs["m2"] = np.asarray(sd, np.float64) ** 2 * np.maximum(
+                    c - 1, 0
+                )
+        if counts is None:
+            _o, _s, counts = run("count")
+        arrs.setdefault("count", np.asarray(counts, np.int64))
+        fields_out[f] = arrs
+
+    ngroups = len(group_keys)
+    if ngroups * W != n_seg:  # zero local groups: ship empty arrays
+        fields_out = {
+            f: {p: a[: ngroups * W] for p, a in arrs.items()}
+            for f, arrs in fields_out.items()
+        }
+    return serialize_partials(group_tag_dicts, fields_out, ngroups, W)
+
+
+# -- wire format -------------------------------------------------------------
+# [u32 header_len][header JSON][raw little-endian array buffers]
+
+
+def serialize_partials(group_tag_dicts, fields_out, ngroups: int, W: int) -> bytes:
+    buffers: list[bytes] = []
+    off = 0
+
+    def add(arr: np.ndarray) -> dict:
+        nonlocal off
+        a = np.ascontiguousarray(arr)
+        d = "<i8" if a.dtype.kind in "iu" else "<f8"
+        b = a.astype(d, copy=False).tobytes()
+        buffers.append(b)
+        loc = {"d": d, "o": off, "n": len(b)}
+        off += len(b)
+        return loc
+
+    header = {
+        "groups": group_tag_dicts,
+        "W": W,
+        "fields": {
+            f: {p: add(arr) for p, arr in arrs.items()}
+            for f, arrs in fields_out.items()
+        },
+    }
+    hbuf = json.dumps(header, separators=(",", ":")).encode()
+    return struct.pack("<I", len(hbuf)) + hbuf + b"".join(buffers)
+
+
+def parse_partials(data: bytes) -> dict:
+    (hlen,) = struct.unpack_from("<I", data, 0)
+    header = json.loads(data[4 : 4 + hlen])
+    payload = memoryview(data)[4 + hlen :]
+    fields = {}
+    for f, arrs in header["fields"].items():
+        fields[f] = {
+            p: np.frombuffer(payload[loc["o"] : loc["o"] + loc["n"]], loc["d"])
+            for p, loc in arrs.items()
+        }
+    return {"groups": header["groups"], "W": header["W"], "fields": fields}
+
+
+# -- coordinator side --------------------------------------------------------
+
+
+def merge_remote_partials(
+    agg_results, aggs, batches, group_keys, W, peer_docs, group_tags,
+):
+    """Fold peers' partial docs into the locally-computed agg_results.
+
+    Mutates group_keys in place (appending remote-only groups) and
+    REPLACES each mergeable call's entry with the cluster-wide result:
+    (values, None, counts, spec, field, times_abs|None). Stack order for
+    time ties is local first, then peers in the order given (the caller
+    passes them sorted by node id) — deterministic across retries.
+    """
+    from opengemini_tpu.ops import aggregates as aggmod
+
+    gid_of = {k: i for i, k in enumerate(group_keys)}
+    for doc in peer_docs:
+        for gtags in doc["groups"]:
+            key = tuple(gtags.get(k, "") for k in group_tags)
+            if key not in gid_of:
+                gid_of[key] = len(group_keys)
+                group_keys.append(key)
+    n_seg = len(group_keys) * W
+
+    def expand(arr, fill=0):
+        arr = np.asarray(arr)
+        if len(arr) == n_seg:
+            return arr
+        out = np.full(n_seg, fill, dtype=arr.dtype if fill == 0 else np.float64)
+        out[: len(arr)] = arr
+        return out
+
+    # per-peer segment index maps (peer-local seg -> global seg)
+    peer_maps = []
+    for doc in peer_docs:
+        gmap = np.array(
+            [gid_of[tuple(g.get(k, "") for k in group_tags)] for g in doc["groups"]],
+            dtype=np.int64,
+        )
+        if len(gmap):
+            segs = (gmap[:, None] * W + np.arange(W)[None, :]).reshape(-1)
+        else:
+            segs = np.empty(0, np.int64)
+        peer_maps.append(segs)
+
+    def scatter(doc_i, field, pname, fill, dtype=np.float64):
+        """Peer partial array -> global-shaped array with `fill` holes.
+        dtype=int64 keeps ns timestamps exact (they do not fit f64)."""
+        out = np.full(n_seg, fill, dtype)
+        arrs = peer_docs[doc_i]["fields"].get(field)
+        segs = peer_maps[doc_i]
+        if arrs is None or pname not in arrs or not len(segs):
+            return out
+        a = np.asarray(arrs[pname], dtype)
+        out[segs[: len(a)]] = a
+        return out
+
+    def peer_counts(field):
+        return [
+            scatter(i, field, "count", 0).astype(np.int64)
+            for i in range(len(peer_docs))
+        ]
+
+    for call, spec, params, fname in aggs:
+        if spec.name not in MERGEABLE:
+            continue
+        entry = agg_results[id(call)]
+        l_out, l_counts = entry[0], entry[2]
+        n_local = len(l_counts)
+        pc = peer_counts(fname)
+        total_counts = expand(l_counts) + sum(pc)
+        times_abs = None
+
+        if spec.name == "count":
+            out = expand(np.asarray(l_out, np.int64)) + sum(pc)
+        elif spec.name == "sum":
+            # int sums stay int64 end-to-end (exact beyond 2^53) when
+            # every source shipped int64 partials
+            raws = [
+                (peer_maps[i], np.asarray(peer_docs[i]["fields"][fname]["sum"]))
+                for i in range(len(peer_docs))
+                if "sum" in peer_docs[i]["fields"].get(fname, {})
+            ]
+            all_int = np.asarray(l_out).dtype.kind in "iu" and all(
+                a.dtype.kind in "iu" for _s, a in raws
+            )
+            acc = expand(
+                np.asarray(l_out, np.int64 if all_int else np.float64)
+            ).copy()
+            for segs, a in raws:
+                if len(segs) and len(a):
+                    acc[segs[: len(a)]] += a.astype(acc.dtype)
+            out = acc
+        elif spec.name == "mean":
+            # local sum = local mean * local count — recovered from the
+            # FINAL local entry so pre-aggregation fast-path contributions
+            # (which never enter the device batch) are included
+            l_sum = np.asarray(l_out, np.float64) * np.asarray(
+                l_counts, np.float64
+            )
+            total_sum = expand(l_sum) + sum(
+                scatter(i, fname, "sum", 0) for i in range(len(peer_docs))
+            )
+            out = total_sum / np.maximum(total_counts, 1)
+        elif spec.name in ("min", "max", "first", "last"):
+            out, times_abs = _merge_selector(
+                spec.name, entry, batches[fname], l_counts, pc, fname,
+                peer_docs, scatter, expand, n_seg,
+            )
+        elif spec.name == "spread":
+            mn, _t1 = _merge_selector(
+                "min", None, batches[fname], l_counts, pc, fname,
+                peer_docs, scatter, expand, n_seg, local_spec="min",
+            )
+            mx, _t2 = _merge_selector(
+                "max", None, batches[fname], l_counts, pc, fname,
+                peer_docs, scatter, expand, n_seg, local_spec="max",
+            )
+            out = mx - mn
+            if np.asarray(entry[0]).dtype.kind in "iu":
+                out = np.rint(out).astype(np.int64)
+        elif spec.name == "stddev":
+            out = _merge_stddev(
+                entry, batches[fname], l_counts, pc, fname, peer_docs,
+                scatter, expand, n_seg,
+            )
+        else:  # pragma: no cover — MERGEABLE guard above
+            continue
+
+        agg_results[id(call)] = (out, None, total_counts, spec, fname, times_abs)
+
+
+def _local_selector(batch, spec_name, n_local):
+    from opengemini_tpu.ops import aggregates as aggmod
+
+    out, sel, counts = batch.run(aggmod.get(spec_name), n_local)
+    times = batch.host_times()
+    if sel is not None and len(times):
+        t = times[np.clip(np.asarray(sel), 0, len(times) - 1)]
+    else:
+        t = np.zeros(n_local, np.int64)
+    return np.asarray(out, np.float64), np.asarray(t, np.int64), counts
+
+
+def _merge_selector(
+    name, entry, batch, l_counts, pc, fname, peer_docs, scatter, expand,
+    n_seg, local_spec=None,
+):
+    """Merge a value+time selector across local + peers.
+
+    min/max pick the extreme VALUE (time = that point's time); first/last
+    pick the extreme TIME. Ties resolve to the earliest source in stack
+    order (local, then peers by node id) — one real point, deterministic."""
+    n_local = len(l_counts) if entry is None else len(entry[2])
+    if entry is not None and entry[1] is not None:
+        l_out = np.asarray(entry[0], np.float64)
+        times = batch.host_times()
+        l_t = (
+            times[np.clip(np.asarray(entry[1]), 0, len(times) - 1)]
+            if len(times) else np.zeros(n_local, np.int64)
+        )
+    else:
+        l_out, l_t, _c = _local_selector(batch, local_spec or name, n_local)
+    l_present = expand(l_counts[:n_local] if entry is None else entry[2]) > 0
+    vals = [expand(l_out)]
+    ts = [expand(l_t).astype(np.int64)]
+    present = [l_present]
+    for i in range(len(peer_docs)):
+        vals.append(scatter(i, fname, name + "_v", np.nan))
+        ts.append(scatter(i, fname, name + "_t", 0, np.int64))
+        present.append(pc[i] > 0)
+    V = np.stack(vals)
+    T = np.stack(ts)
+    P = np.stack(present)
+    if name in ("min", "max"):
+        key = np.where(P, V, np.inf if name == "min" else -np.inf)
+        pick = np.argmin(key, 0) if name == "min" else np.argmax(key, 0)
+    else:
+        key = np.where(P, T, _BIG if name == "first" else -_BIG)
+        pick = np.argmin(key, 0) if name == "first" else np.argmax(key, 0)
+    idx = (pick, np.arange(n_seg))
+    return V[idx], T[idx]
+
+
+def _merge_stddev(
+    entry, batch, l_counts, pc, fname, peer_docs, scatter, expand, n_seg,
+):
+    """Chan et al. pairwise (n, mean, M2) combine across sources."""
+    from opengemini_tpu.ops import aggregates as aggmod
+
+    n_local = len(entry[2])
+    l_sd = np.asarray(entry[0], np.float64)
+    l_mean, _s, _c = batch.run(aggmod.get("mean"), n_local)
+    n = expand(entry[2]).astype(np.float64)
+    mean = expand(np.asarray(l_mean, np.float64))
+    m2 = expand(l_sd) ** 2 * np.maximum(n - 1, 0)
+    for i in range(len(peer_docs)):
+        nb = pc[i].astype(np.float64)
+        mb = scatter(i, fname, "mean", 0.0)
+        m2b = scatter(i, fname, "m2", 0.0)
+        tot = n + nb
+        safe = np.maximum(tot, 1)
+        delta = mb - mean
+        mean = np.where(tot > 0, (n * mean + nb * mb) / safe, 0.0)
+        m2 = m2 + m2b + delta * delta * n * nb / safe
+        n = tot
+    return np.sqrt(np.maximum(m2 / np.maximum(n - 1, 1), 0.0))

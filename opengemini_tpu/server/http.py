@@ -132,6 +132,40 @@ def _make_handler(svc: HttpService):
                 data = gzip.decompress(data)
             return data
 
+        def _internal_request(self, svc) -> dict | None:
+            """Parse + authorize a peer-to-peer /internal/* request: one
+            shared implementation of the cluster-token policy (the data
+            plane must not bypass auth without the shared secret vouching
+            for the caller). Sends the error response and returns None on
+            rejection."""
+            try:
+                req = json.loads(self._body())
+            except ValueError:
+                req = None
+            if not isinstance(req, dict) or not req.get("db"):
+                self._send_json(400, {"error": "db required"})
+                return None
+            token = getattr(svc.meta_store, "token", "") if svc.meta_store else ""
+            if token and req.get("token") != token:
+                self._send_json(403, {"error": "bad cluster token"})
+                return None
+            if not token and svc.auth_enabled:
+                self._send_json(403, {"error": "cluster token required"})
+                return None
+            return req
+
+        @staticmethod
+        def _primary_filter(svc, req):
+            """rf>1 shard filter: serve only groups this node is PRIMARY
+            for among the caller's live set, so each group is counted
+            exactly once cluster-wide."""
+            live = req.get("live")
+            if (int(req.get("rf", 1)) > 1 and live
+                    and svc.router is not None):
+                return lambda sh: svc.router.is_primary(
+                    req["db"], req.get("rp"), sh.tmin, live)
+            return None
+
         def _send(self, code: int, payload: bytes = b"", ctype: str = "application/json"):
             self.send_response(code)
             if payload:
@@ -261,21 +295,8 @@ def _make_handler(svc: HttpService):
                 svc.meta_store.node.deliver(msg)
                 self._send(204)
             elif path == "/internal/write":
-                from opengemini_tpu.record import FieldType as _FT
-
-                try:
-                    req = json.loads(self._body())
-                except ValueError:
-                    req = None
-                if not isinstance(req, dict) or not req.get("db"):
-                    self._send_json(400, {"error": "db required"})
-                    return
-                token = getattr(svc.meta_store, "token", "") if svc.meta_store else ""
-                if token and req.get("token") != token:
-                    self._send_json(403, {"error": "bad cluster token"})
-                    return
-                if not token and svc.auth_enabled:
-                    self._send_json(403, {"error": "cluster token required"})
+                req = self._internal_request(svc)
+                if req is None:
                     return
                 from opengemini_tpu.parallel.cluster import decode_points
 
@@ -290,37 +311,39 @@ def _make_handler(svc: HttpService):
                     self._send_json(403, {"error": str(e)})
                     return
                 self._send_json(200, {"ok": True})
+            elif path in ("/internal/select_meta", "/internal/select_partials"):
+                req = self._internal_request(svc)
+                if req is None:
+                    return
+                if path == "/internal/select_meta":
+                    from opengemini_tpu.parallel.cluster import (
+                        serialize_select_meta,
+                    )
+
+                    self._send_json(200, serialize_select_meta(
+                        svc.engine, req["db"], req.get("rp"),
+                        req.get("mst", ""),
+                        int(req.get("tmin", -(2**62))),
+                        int(req.get("tmax", 2**62)),
+                        shard_filter=self._primary_filter(svc, req),
+                    ))
+                    return
+                from opengemini_tpu.query.partials import compute_partials
+
+                try:
+                    body = compute_partials(svc.engine, svc.router, req)
+                except (KeyError, TypeError, ValueError) as e:
+                    self._send_json(400, {"error": f"bad partials request: {e}"})
+                    return
+                self._send(200, body, ctype="application/octet-stream")
             elif path in ("/internal/scan", "/internal/measurements"):
                 from opengemini_tpu.parallel.cluster import serialize_series
 
-                try:
-                    req = json.loads(self._body())
-                except ValueError:
-                    req = None
-                if not isinstance(req, dict) or not req.get("db"):
-                    self._send_json(400, {"error": "db required"})
-                    return
-                token = getattr(svc.meta_store, "token", "") if svc.meta_store else ""
-                if token and req.get("token") != token:
-                    self._send_json(403, {"error": "bad cluster token"})
-                    return
-                if not token and svc.auth_enabled:
-                    # raw-data peer API must not bypass auth without a
-                    # shared cluster secret to vouch for the caller
-                    self._send_json(403, {"error": "cluster token required"})
+                req = self._internal_request(svc)
+                if req is None:
                     return
                 if path == "/internal/scan":
-                    shard_filter = None
-                    live = req.get("live")
-                    if (int(req.get("rf", 1)) > 1 and live
-                            and svc.router is not None):
-                        # replicated groups: serve only those this node is
-                        # PRIMARY for among the caller's live set, so each
-                        # group is counted exactly once cluster-wide
-                        shard_filter = (
-                            lambda sh: svc.router.is_primary(
-                                req["db"], req.get("rp"), sh.tmin, live)
-                        )
+                    shard_filter = self._primary_filter(svc, req)
                     args = (svc.engine, req["db"], req.get("rp"),
                             req.get("mst", ""),
                             int(req.get("tmin", -(2**62))),

@@ -331,6 +331,8 @@ class TestClusteredCQAndInto:
             (nid, pts))
         # scan path must exist for the read side; no remote data
         router.scan_shards = lambda *a: ([], [])
+        router.select_meta = lambda *a: (None, ["nA", "nB"])
+        router.select_partials = lambda req, live: []
         router.remote_measurements = lambda *a: set()
         ex = Executor(eng)
         ex.router = router

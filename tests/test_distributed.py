@@ -106,3 +106,50 @@ def test_first_tie_not_averaged(mesh):
     # device 0 holds rows [0, 100); its local first is row 0 (scan order)
     assert out["first"][0] == 0.0
     assert out["last"][0] in values  # an actual row value
+
+
+class TestExecutorMeshPath:
+    """The executor's aggregate path over a configured device mesh must
+    return bit-identical results to the single-device path (rows sharded
+    across 8 virtual devices, collective merges)."""
+
+    def test_mesh_results_match_single_device(self, tmp_path):
+        import jax
+
+        from opengemini_tpu.parallel import distributed as dist
+        from opengemini_tpu.parallel import runtime as prt
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        if len(jax.devices()) < 8:
+            import pytest
+
+            pytest.skip("needs 8 virtual devices")
+
+        ns = 10**9
+        base = 1_700_000_040
+        lines = []
+        for i in range(500):
+            t = (base + i * 7) * ns + (i % 97) * 1000 + 13
+            lines.append(f"m,host=h{i % 5} v={(i * 37) % 11 - 3} {t}")
+
+        e = Engine(str(tmp_path / "mesh"))
+        e.create_database("db")
+        e.write_lines("db", "\n".join(lines))
+        ex = Executor(e)
+        queries = [
+            "SELECT count(v), sum(v), mean(v) FROM m GROUP BY time(5m)",
+            "SELECT min(v), max(v), spread(v) FROM m GROUP BY host",
+            "SELECT first(v) FROM m",
+            "SELECT last(v) FROM m",
+            "SELECT max(v) FROM m",  # bare selector: exact point time
+        ]
+        solo = [ex.execute(q, db="db") for q in queries]
+        prt.set_mesh(dist.make_mesh(8, ("shard", "time")))
+        try:
+            meshed = [ex.execute(q, db="db") for q in queries]
+        finally:
+            prt.set_mesh(None)
+        for q, a, b in zip(queries, solo, meshed):
+            assert a == b, (q, a, b)
+        e.close()
