@@ -122,6 +122,12 @@ class SeriesIndex:
     def tags_of(self, sid: int) -> dict[str, str]:
         return dict(self.sid_to_series[sid][1])
 
+    def series_entry(self, sid: int) -> tuple[str, tuple]:
+        return self.sid_to_series[sid]
+
+    def iter_series_entries(self):
+        yield from self.sid_to_series.values()
+
     def measurements(self) -> list[str]:
         return sorted(self.mst_sids)
 

@@ -1,0 +1,325 @@
+"""ctypes binding for the C++ mergeset series index
+(native/seriesindex.cpp) — the high-cardinality replacement for the
+dict-based SeriesIndex, same API.
+
+Role of the reference's tsi mergeset index
+(engine/index/tsi/mergeset_index.go over lib/util/lifted/vm/mergeset):
+sorted immutable posting runs on disk (mmap, binary search) + a
+WAL-backed memtable, merged inline — million-series indexes open in
+seconds with bounded RSS instead of rebuilding Python dicts from a JSON
+log. Regex matching stays in Python (re semantics) over the C-side
+distinct tag-value enumeration; everything exact runs native.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import re
+import struct
+import subprocess
+import threading
+
+import numpy as np
+
+from opengemini_tpu.ingest.line_protocol import series_key
+
+_LIB = None
+_TRIED = False
+
+
+def _lib_path() -> str:
+    return os.path.abspath(os.path.join(
+        os.path.dirname(__file__), "..", "..", "native",
+        "libogtseriesindex.so"))
+
+
+def load():
+    """The loaded library or None. Never raises."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _lib_path()
+    if not os.path.exists(path):
+        _build()
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        u64 = ctypes.c_uint64
+        p = ctypes.c_void_p
+        cp = ctypes.c_char_p
+        u64p = ctypes.POINTER(u64)
+        for name, res, args in [
+            ("msi_open", p, [cp]),
+            ("msi_close", None, [p]),
+            ("msi_free", None, [p]),
+            ("msi_insert", u64, [p, cp, u64, u64]),
+            ("msi_lookup", u64, [p, cp, u64]),
+            ("msi_has_live", ctypes.c_int, [p, cp, u64]),
+            ("msi_series_ids", p, [p, cp, u64, u64p]),
+            ("msi_match_eq", p, [p, cp, u64, cp, u64, cp, u64, u64p]),
+            ("msi_enum_field", p, [p, ctypes.c_char, cp, u64,
+                                   ctypes.c_uint32, u64p, u64p]),
+            ("msi_key_of", p, [p, u64, u64p]),
+            ("msi_remove_sids", None, [p, u64p, u64]),
+            ("msi_flush", None, [p]),
+            ("msi_compact", None, [p]),
+            ("msi_stats", None, [p, u64p, u64p, u64p, u64p]),
+        ]:
+            fn = getattr(lib, name)
+            fn.restype = res
+            fn.argtypes = args
+        _LIB = lib
+    except (OSError, AttributeError):
+        _LIB = None
+    return _LIB
+
+
+def _build() -> None:
+    d = os.path.dirname(_lib_path())
+    try:
+        subprocess.run(["make", "-C", d, "libogtseriesindex.so"],
+                       check=True, capture_output=True)
+    except (OSError, subprocess.CalledProcessError):
+        pass
+
+
+def _field(b: bytes) -> bytes:
+    return struct.pack("<I", len(b)) + b
+
+
+def _pack_series(key: str, mst: str, tags: tuple) -> bytes:
+    out = [_field(key.encode()), _field(mst.encode()),
+           struct.pack("<I", len(tags))]
+    for k, v in tags:
+        out.append(_field(k.encode()))
+        out.append(_field(v.encode()))
+    return b"".join(out)
+
+
+def _unpack_series(blob: bytes):
+    off = 0
+
+    def field():
+        nonlocal off
+        (n,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        f = blob[off : off + n]
+        off += n
+        return f
+
+    key = field().decode()
+    mst = field().decode()
+    (ntags,) = struct.unpack_from("<I", blob, off)
+    off += 4
+    tags = tuple(
+        (field().decode(), field().decode()) for _ in range(ntags)
+    )
+    return key, mst, tags
+
+
+_TAGS_CACHE_MAX = 200_000
+
+
+class MergesetIndex:
+    """Drop-in for index.inverted.SeriesIndex backed by the native
+    mergeset engine. `path` is a DIRECTORY (runs + wal live inside)."""
+
+    def __init__(self, path: str):
+        lib = load()
+        if lib is None:
+            raise OSError("native series index library unavailable")
+        self._lib = lib
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._h = lib.msi_open(path.encode())
+        if not self._h:
+            raise OSError(f"msi_open failed for {path!r}")
+        self._lock = threading.Lock()
+        # sid -> (mst, tags): bounded decode cache for the render path
+        self._tags_cache: dict[int, tuple] = {}
+        # series key -> sid: the ingest hot path is overwhelmingly repeat
+        # series; skip the native call for those
+        self._key_cache: dict[str, int] = {}
+
+    def _handle(self):
+        """The live native handle. A closed index raises instead of
+        passing NULL into C (the dict index stayed readable after close;
+        here a clean OSError fails the racing query instead of
+        segfaulting the process)."""
+        h = self._h
+        if not h:
+            raise OSError("series index is closed")
+        return h
+
+    # -- write side ---------------------------------------------------------
+
+    def get_or_create(self, measurement: str, tags: tuple) -> int:
+        key = series_key(measurement, tags)
+        sid = self._key_cache.get(key)
+        if sid is not None:
+            return sid
+        blob = _pack_series(key, measurement, tags)
+        sid = int(self._lib.msi_insert(self._handle(), blob, len(blob), 0))
+        if len(self._key_cache) >= _TAGS_CACHE_MAX:
+            self._key_cache.clear()
+        self._key_cache[key] = sid
+        return sid
+
+    def flush(self) -> None:
+        self._lib.msi_flush(self._handle())
+
+    def compact(self) -> None:
+        self._lib.msi_compact(self._handle())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._h:
+                self._lib.msi_close(self._h)
+                self._h = None
+
+    # -- read side ----------------------------------------------------------
+
+    def _sid_buf(self, ptr, n: int) -> set[int]:
+        try:
+            if not n:
+                return set()
+            raw = ctypes.string_at(ptr, n * 8)
+            return set(map(int, np.frombuffer(raw, "<u8")))
+        finally:
+            self._lib.msi_free(ptr)
+
+    def series_ids(self, measurement: str) -> set[int]:
+        m = measurement.encode()
+        n = ctypes.c_uint64()
+        ptr = self._lib.msi_series_ids(self._handle(), m, len(m), ctypes.byref(n))
+        return self._sid_buf(ptr, int(n.value))
+
+    def match_eq(self, measurement: str, key: str, value: str) -> set[int]:
+        m, k, v = measurement.encode(), key.encode(), value.encode()
+        n = ctypes.c_uint64()
+        ptr = self._lib.msi_match_eq(
+            self._handle(), m, len(m), k, len(k), v, len(v), ctypes.byref(n))
+        return self._sid_buf(ptr, int(n.value))
+
+    def match_neq(self, measurement: str, key: str, value: str) -> set[int]:
+        return self.series_ids(measurement) - self.match_eq(
+            measurement, key, value)
+
+    def _enum(self, kind: bytes, pfx: bytes, idx: int) -> list[str]:
+        n = ctypes.c_uint64()
+        blen = ctypes.c_uint64()
+        ptr = self._lib.msi_enum_field(
+            self._handle(), kind, pfx, len(pfx), idx, ctypes.byref(n),
+            ctypes.byref(blen))
+        try:
+            raw = ctypes.string_at(ptr, blen.value)
+        finally:
+            self._lib.msi_free(ptr)
+        out = []
+        off = 0
+        for _ in range(n.value):
+            (ln,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            out.append(raw[off : off + ln].decode())
+            off += ln
+        return out
+
+    def tag_keys(self, measurement: str) -> list[str]:
+        return sorted(self._enum(b"P", _field(measurement.encode()), 1))
+
+    def tag_values(self, measurement: str, key: str) -> list[str]:
+        pfx = _field(measurement.encode()) + _field(key.encode())
+        return sorted(self._enum(b"P", pfx, 2))
+
+    def match_regex(self, measurement: str, key: str, pattern: str,
+                    negate: bool = False) -> set[int]:
+        rx = re.compile(pattern)
+        hit: set[int] = set()
+        for v in self.tag_values(measurement, key):
+            if rx.search(v):
+                hit |= self.match_eq(measurement, key, v)
+        if negate:
+            return self.series_ids(measurement) - hit
+        return hit
+
+    def tags_of(self, sid: int) -> dict[str, str]:
+        got = self._tags_cache.get(sid)
+        if got is None:
+            n = ctypes.c_uint64()
+            ptr = self._lib.msi_key_of(self._handle(), sid, ctypes.byref(n))
+            try:
+                raw = ctypes.string_at(ptr, n.value)
+            finally:
+                self._lib.msi_free(ptr)
+            if not raw:
+                raise KeyError(sid)
+            _key, mst, tags = _unpack_series(raw)
+            if len(self._tags_cache) >= _TAGS_CACHE_MAX:
+                self._tags_cache.clear()
+            got = self._tags_cache[sid] = (mst, tags)
+        return dict(got[1])
+
+    def series_entry(self, sid: int) -> tuple[str, tuple]:
+        self.tags_of(sid)  # populate the cache
+        mst, tags = self._tags_cache[sid]
+        return mst, tags
+
+    def iter_series_entries(self):
+        for m in self.measurements():
+            for sid in sorted(self.series_ids(m)):
+                yield self.series_entry(sid)
+
+    def measurements(self) -> list[str]:
+        # a measurement whose every series was removed must not list:
+        # membership postings are tombstone-filtered, 'M' items are not.
+        # msi_has_live early-exits — never decodes whole posting sets
+        h = self._handle()
+        out = []
+        for m in self._enum(b"M", b"", 0):
+            mb = m.encode()
+            if self._lib.msi_has_live(h, mb, len(mb)):
+                out.append(m)
+        return sorted(out)
+
+    # -- deletion ------------------------------------------------------------
+
+    def remove_sids(self, sids: set[int]) -> None:
+        if not sids:
+            return
+        arr = (ctypes.c_uint64 * len(sids))(*sorted(sids))
+        self._lib.msi_remove_sids(self._handle(), arr, len(sids))
+        for sid in sids:
+            self._tags_cache.pop(sid, None)
+        self._key_cache.clear()  # deletes are rare; a full drop is fine
+
+    def stats(self) -> dict:
+        a, b, c, d = (ctypes.c_uint64() for _ in range(4))
+        self._lib.msi_stats(self._handle(), *(ctypes.byref(x) for x in (a, b, c, d)))
+        return {"mem_items": a.value, "runs": b.value,
+                "run_items": c.value, "next_sid": d.value}
+
+
+def open_series_index(shard_path: str):
+    """Index factory for a shard directory: the native mergeset engine
+    when available, migrating any legacy series.log once; the dict
+    SeriesIndex otherwise."""
+    from opengemini_tpu.index.inverted import SeriesIndex
+
+    legacy_log = os.path.join(shard_path, "series.log")
+    msi_dir = os.path.join(shard_path, "seriesidx")
+    if load() is None:
+        return SeriesIndex(legacy_log)
+    idx = MergesetIndex(msi_dir)
+    if os.path.exists(legacy_log):
+        legacy = SeriesIndex(legacy_log)
+        for sid, (mst, tags) in sorted(legacy.sid_to_series.items()):
+            blob = _pack_series(series_key(mst, tags), mst, tags)
+            idx._lib.msi_insert(idx._h, blob, len(blob), sid)
+        legacy.close()
+        idx.compact()
+        idx.flush()
+        os.replace(legacy_log, legacy_log + ".migrated")
+    return idx

@@ -149,16 +149,20 @@ class AggBatch:
         if spec.name not in dist.MESH_AGGS:
             return None
         seg_pad = winmod.pad_to(max(num_segments, 1), 256)
-        outs = self._mesh_outs.get(seg_pad)
+        # winner-merge machinery is only compiled for the selector this
+        # spec actually needs; value-only aggregates share one program
+        sel = (spec.name,) if spec.name in ("min", "max", "first", "last") else ()
+        cache_key = (seg_pad, sel)
+        outs = self._mesh_outs.get(cache_key)
         if outs is None:
             values, rel_hi, rel_lo, seg_ids, mask = self._concat_padded()
             gidx = np.arange(len(values), dtype=np.int32)
-            fn = dist.batch_agg_jit(mesh, seg_pad)
+            fn = dist.batch_agg_jit(mesh, seg_pad, sel)
             sharded = dist.shard_rows(
                 mesh, values, rel_hi, rel_lo, seg_ids, mask, gidx
             )
             outs = {k: np.asarray(v) for k, v in fn(*sharded).items()}
-            self._mesh_outs[seg_pad] = outs
+            self._mesh_outs[cache_key] = outs
         out = outs[spec.name][:num_segments]
         sel = outs.get(spec.name + "_sel")
         if sel is not None:

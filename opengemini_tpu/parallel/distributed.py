@@ -190,15 +190,18 @@ def _pick(x, w, axes):
     return _reduce(jnp.where(w, x, jnp.zeros((), x.dtype)), axes, jax.lax.psum)
 
 
-def build_batch_agg(mesh: Mesh, num_segments: int):
+def build_batch_agg(mesh: Mesh, num_segments: int,
+                    sel_names: tuple = ()):
     """The executor's aggregate batch step over a device mesh: the exact
     multi-chip equivalent of templates.AggBatch's single-device kernels.
 
     Takes row-sharded (values, rel_hi, rel_lo, seg_ids, mask, global_idx)
-    and returns replicated per-segment outputs — values for every mesh
-    aggregate plus `<sel>`_sel global row indices for selectors, which the
-    executor resolves against host-side ns times exactly like the
-    single-device sel contract (reference: the store-side aggregate
+    and returns replicated per-segment outputs. count/sum/mean and
+    min/max/spread VALUES are plain psum/pmin/pmax; the winner one-hot
+    machinery (several collective rounds each) is built only for the
+    selectors in `sel_names` — their `<name>_sel` outputs are global row
+    indices the executor resolves against host-side ns times exactly like
+    the single-device sel contract (reference: the store-side aggregate
     cursors + coordinator merge collapsed into one SPMD program)."""
     axes = mesh.axis_names
 
@@ -214,22 +217,30 @@ def build_batch_agg(mesh: Mesh, num_segments: int):
         valid = c > 0
         totc = _reduce(c, axes, jax.lax.psum)
         tots = _reduce(s, axes, jax.lax.psum)
+        mn = _reduce(seg.seg_min(values, seg_ids, num_segments, mask),
+                     axes, jax.lax.pmin)
+        mx = _reduce(seg.seg_max(values, seg_ids, num_segments, mask),
+                     axes, jax.lax.pmax)
         out = {
             "count": totc,
             "sum": tots,
             "mean": tots / jnp.maximum(totc, 1).astype(tots.dtype),
+            "min": mn,
+            "max": mx,
+            "spread": mx - mn,
         }
-        selectors = {
-            "min": seg.seg_min_selector(values, rel_hi, rel_lo, seg_ids,
-                                        num_segments, mask),
-            "max": seg.seg_max_selector(values, rel_hi, rel_lo, seg_ids,
-                                        num_segments, mask),
-            "first": seg.seg_first(values, rel_hi, rel_lo, seg_ids,
-                                   num_segments, mask),
-            "last": seg.seg_last(values, rel_hi, rel_lo, seg_ids,
-                                 num_segments, mask),
+        local_sel = {
+            "min": lambda: seg.seg_min_selector(
+                values, rel_hi, rel_lo, seg_ids, num_segments, mask),
+            "max": lambda: seg.seg_max_selector(
+                values, rel_hi, rel_lo, seg_ids, num_segments, mask),
+            "first": lambda: seg.seg_first(
+                values, rel_hi, rel_lo, seg_ids, num_segments, mask),
+            "last": lambda: seg.seg_last(
+                values, rel_hi, rel_lo, seg_ids, num_segments, mask),
         }
-        for name, (v, sel) in selectors.items():
+        for name in sel_names:
+            v, sel = local_sel[name]()
             th, tl, gsel = tkeys(sel)
             if name == "min":
                 keys = [(v, True), (th, True), (tl, True)]
@@ -242,7 +253,6 @@ def build_batch_agg(mesh: Mesh, num_segments: int):
             w = _winner(keys, valid, axes)
             out[name] = _pick(v, w, axes)
             out[name + "_sel"] = _pick(gsel, w, axes)
-        out["spread"] = out["max"] - out["min"]
         return out
 
     sharded = jax.shard_map(
@@ -258,11 +268,12 @@ def build_batch_agg(mesh: Mesh, num_segments: int):
 _BATCH_AGG_CACHE: dict = {}
 
 
-def batch_agg_jit(mesh: Mesh, num_segments: int):
-    key = (mesh, num_segments)
+def batch_agg_jit(mesh: Mesh, num_segments: int, sel_names: tuple = ()):
+    key = (mesh, num_segments, sel_names)
     fn = _BATCH_AGG_CACHE.get(key)
     if fn is None:
-        fn = _BATCH_AGG_CACHE[key] = build_batch_agg(mesh, num_segments)
+        fn = _BATCH_AGG_CACHE[key] = build_batch_agg(
+            mesh, num_segments, sel_names)
     return fn
 
 

@@ -739,7 +739,7 @@ class Executor:
 
             keys: set[str] = set()
             for sh in self._all_shards_db(stmt.database or db):
-                for sid, (m, tags) in sh.index.sid_to_series.items():
+                for m, tags in sh.index.iter_series_entries():
                     keys.add(series_key(m, tags))
             return _series_result("", None, ["count"], [[len(keys)]])
         raise QueryError(f"unsupported statement: {type(stmt).__name__}")
@@ -1970,7 +1970,7 @@ class Executor:
                     sc = cond.split(stmt.condition, tag_keys, 0)
                     sids &= cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
                 for sid in sids:
-                    m, tags = sh.index.sid_to_series[sid]
+                    m, tags = sh.index.series_entry(sid)
                     keys.add(series_key(m, tags))
         if not keys:
             return {}

@@ -417,8 +417,13 @@ def _merge_selector(
     T = np.stack(ts)
     P = np.stack(present)
     if name in ("min", "max"):
+        # value ties break by EARLIEST timestamp — same rule as the
+        # single-device kernels (ops/segment.py) and the mesh merge
         key = np.where(P, V, np.inf if name == "min" else -np.inf)
-        pick = np.argmin(key, 0) if name == "min" else np.argmax(key, 0)
+        best = key.min(0) if name == "min" else key.max(0)
+        cand = P & (V == best[None, :])
+        tkey = np.where(cand, T, _BIG)
+        pick = np.argmin(tkey, 0)
     else:
         key = np.where(P, T, _BIG if name == "first" else -_BIG)
         pick = np.argmin(key, 0) if name == "first" else np.argmax(key, 0)

@@ -79,11 +79,11 @@ class HierarchicalService(Service):
         return 1
 
     def _reopen(self, shard) -> None:
-        from opengemini_tpu.index.inverted import SeriesIndex
+        from opengemini_tpu.index.mergeset import open_series_index
         from opengemini_tpu.storage.tsf import TSFReader
         from opengemini_tpu.storage.wal import WAL
 
-        shard.index = SeriesIndex(os.path.join(shard.path, "series.log"))
+        shard.index = open_series_index(shard.path)
         shard.wal = WAL(os.path.join(shard.path, "wal.log"), sync=shard.wal.sync)
         shard._files = [
             TSFReader(os.path.join(shard.path, f))

@@ -359,12 +359,14 @@ class Engine:
             with shard._lock:
                 shard.flush()
                 prefix = shard_prefix(db, rp, group_start)
-                # follow a cold-tier symlink: files live at the target
+                # follow a cold-tier symlink: files live at the target;
+                # recurse so the seriesidx/ mergeset dir travels too
                 real = os.path.realpath(shard.path)
-                for fname in sorted(os.listdir(real)):
-                    full = os.path.join(real, fname)
-                    if os.path.isfile(full):
-                        self.obs_store.put(f"{prefix}/{fname}", full)
+                for dirpath, _dirs, files in os.walk(real):
+                    for fname in sorted(files):
+                        full = os.path.join(dirpath, fname)
+                        rel = os.path.relpath(full, real)
+                        self.obs_store.put(f"{prefix}/{rel}", full)
                 shard.wal.close()
                 shard.index.close()
             del self._shards[key]
@@ -397,8 +399,10 @@ class Engine:
         prefix = shard_prefix(db, rp, group_start)
         dest = self._shard_dir(db, rp, group_start)
         for key in self.obs_store.list(prefix):
-            fname = key.rsplit("/", 1)[-1]
-            self.obs_store.get(key, os.path.join(dest, fname))
+            rel = key[len(prefix) + 1 :]  # may be nested (seriesidx/...)
+            target = os.path.join(dest, rel)
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            self.obs_store.get(key, target)
 
     def _install_hydrated(self, db: str, rp: str, group_start: int,
                           save: bool = True) -> "Shard":

@@ -14,7 +14,7 @@ import threading
 import numpy as np
 
 from opengemini_tpu.ingest import line_protocol as lp
-from opengemini_tpu.index.inverted import SeriesIndex
+from opengemini_tpu.index.mergeset import open_series_index
 from opengemini_tpu.record import FieldTypeConflict, Record, merge_sorted_records
 from opengemini_tpu.storage.memtable import MemTable
 from opengemini_tpu.storage.tsf import TSFReader, TSFWriter
@@ -29,7 +29,7 @@ class Shard:
         self.tmin = tmin  # inclusive ns
         self.tmax = tmax  # exclusive ns
         os.makedirs(path, exist_ok=True)
-        self.index = SeriesIndex(os.path.join(path, "series.log"))
+        self.index = open_series_index(path)
         # measurement -> field -> FieldType; owned here so it survives
         # memtable generations and is seeded from immutable files on open.
         self.schemas: dict[str, dict] = {}

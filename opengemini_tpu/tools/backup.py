@@ -45,7 +45,7 @@ def backup(data_dir: str, out_dir: str, since_ns: int = 0) -> dict:
         for root, _dirs, files in os.walk(data_root):
             rel_root = os.path.relpath(root, data_dir)
             for f in files:
-                if not _is_backup_file(f):
+                if not _is_backup_file(f, rel_root):
                     continue
                 src = os.path.join(root, f)
                 rel = os.path.join(rel_root, f)
@@ -61,8 +61,13 @@ def backup(data_dir: str, out_dir: str, since_ns: int = 0) -> dict:
     return manifest
 
 
-def _is_backup_file(name: str) -> bool:
-    return name.endswith(".tsf") or name in ("series.log", "downsample.level")
+def _is_backup_file(name: str, rel_root: str = "") -> bool:
+    if name.endswith(".tsf") or name in ("series.log", "downsample.level"):
+        return True
+    # mergeset series index: immutable runs + its own crc-framed wal
+    # (the SHARD wal stays excluded — backup is flush-first)
+    in_idx = os.path.basename(rel_root) == "seriesidx"
+    return in_idx and (name.endswith(".msi") or name == "wal.log")
 
 
 def restore(backup_dir: str, data_dir: str) -> int:
@@ -86,7 +91,7 @@ def restore(backup_dir: str, data_dir: str) -> int:
         for root, _dirs, files in os.walk(data_root):
             rel_root = os.path.relpath(root, data_dir)
             for f in files:
-                if _is_backup_file(f) and os.path.join(rel_root, f) not in keep:
+                if _is_backup_file(f, rel_root) and os.path.join(rel_root, f) not in keep:
                     os.remove(os.path.join(root, f))
     return n
 

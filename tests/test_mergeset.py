@@ -1,0 +1,156 @@
+"""C++ mergeset series index (native/seriesindex.cpp via
+index/mergeset.py): API parity with the dict SeriesIndex, durability,
+migration, and scale behavior (reference: engine/index/tsi
+mergeset_index.go)."""
+
+import os
+import random
+import string
+
+import pytest
+
+from opengemini_tpu.index.inverted import SeriesIndex
+from opengemini_tpu.index.mergeset import (
+    MergesetIndex, load, open_series_index,
+)
+
+pytestmark = pytest.mark.skipif(load() is None,
+                                reason="native series index unavailable")
+
+
+def _rand_tags(rng):
+    ks = rng.sample(["host", "dc", "rack", "app"], rng.randint(0, 3))
+    return tuple(sorted(
+        (k, "".join(rng.choices(string.ascii_lowercase, k=3))) for k in ks
+    ))
+
+
+class TestParityWithDictIndex:
+    def test_randomized_same_answers(self, tmp_path):
+        rng = random.Random(7)
+        a = SeriesIndex(str(tmp_path / "legacy.log"))
+        b = MergesetIndex(str(tmp_path / "msi"))
+        sid_map = {}  # a-sid -> b-sid
+        for _ in range(400):
+            mst = rng.choice(["cpu", "mem", "disk"])
+            tags = _rand_tags(rng)
+            sa = a.get_or_create(mst, tags)
+            sb = b.get_or_create(mst, tags)
+            sid_map[sa] = sb
+        for mst in ("cpu", "mem", "disk", "nope"):
+            assert {sid_map[s] for s in a.series_ids(mst)} == b.series_ids(mst)
+            assert a.tag_keys(mst) == b.tag_keys(mst)
+            for k in a.tag_keys(mst):
+                assert a.tag_values(mst, k) == b.tag_values(mst, k)
+                for v in a.tag_values(mst, k)[:5]:
+                    assert ({sid_map[s] for s in a.match_eq(mst, k, v)}
+                            == b.match_eq(mst, k, v))
+                    assert ({sid_map[s] for s in a.match_neq(mst, k, v)}
+                            == b.match_neq(mst, k, v))
+                assert ({sid_map[s] for s in a.match_regex(mst, k, "^[a-m]")}
+                        == b.match_regex(mst, k, "^[a-m]"))
+        assert a.measurements() == b.measurements()
+        for sa, sb in list(sid_map.items())[:50]:
+            assert a.tags_of(sa) == b.tags_of(sb)
+        # removal parity
+        doomed_a = set(list(a.series_ids("cpu"))[:10])
+        doomed_b = {sid_map[s] for s in doomed_a}
+        a.remove_sids(doomed_a)
+        b.remove_sids(doomed_b)
+        assert {sid_map[s] for s in a.series_ids("cpu")} == b.series_ids("cpu")
+        assert a.measurements() == b.measurements()
+        a.close()
+        b.close()
+
+    def test_nasty_tag_bytes(self, tmp_path):
+        """Separator-free encoding: tags containing NULs, commas, equals,
+        newlines, unicode must round-trip and never alias."""
+        ix = MergesetIndex(str(tmp_path / "msi"))
+        nasty = [
+            ("k=1", "v,2"), ("k\x001", "v\x00"), ("键", "值\n"),
+            ("a", ""), ("", "b"),
+        ]
+        sids = {}
+        for k, v in nasty:
+            sids[(k, v)] = ix.get_or_create("m", ((k, v),))
+        assert len(set(sids.values())) == len(nasty)  # no aliasing
+        for (k, v), sid in sids.items():
+            assert ix.match_eq("m", k, v) == {sid}
+            assert ix.tags_of(sid) == {k: v}
+        ix.close()
+
+
+class TestDurability:
+    def test_reopen_after_unclean_stop(self, tmp_path):
+        """No close(): the WAL alone must recover the memtable, and a torn
+        tail must not poison replay."""
+        d = str(tmp_path / "msi")
+        ix = MergesetIndex(d)
+        sids = [ix.get_or_create("cpu", (("host", f"h{i}"),))
+                for i in range(50)]
+        ix.flush()
+        del ix  # simulate crash: no msi_close, no run flush
+        # torn tail: append garbage to the wal
+        with open(os.path.join(d, "wal.log"), "ab") as f:
+            f.write(b"\x30\x00\x00\x00\xde\xad")
+        ix2 = MergesetIndex(d)
+        assert ix2.series_ids("cpu") == set(sids)
+        assert ix2.match_eq("cpu", "host", "h7") == {sids[7]}
+        # new series after recovery get fresh sids
+        s_new = ix2.get_or_create("cpu", (("host", "new"),))
+        assert s_new not in sids
+        ix2.close()
+
+    def test_removal_survives_compact_and_reopen(self, tmp_path):
+        d = str(tmp_path / "msi")
+        ix = MergesetIndex(d)
+        keep = ix.get_or_create("m", (("t", "keep"),))
+        drop = ix.get_or_create("m", (("t", "drop"),))
+        ix.remove_sids({drop})
+        ix.compact()
+        ix.close()
+        ix = MergesetIndex(d)
+        assert ix.series_ids("m") == {keep}
+        assert ix.match_eq("m", "t", "drop") == set()
+        with pytest.raises(KeyError):
+            ix.tags_of(drop)
+        ix.close()
+
+    def test_flush_merge_thresholds(self, tmp_path):
+        """Crossing the memtable threshold spills runs; compact folds
+        them to one and answers stay identical."""
+        ix = MergesetIndex(str(tmp_path / "msi"))
+        n = 30_000  # x ~4 items/series crosses the 64k memtable bound
+        for i in range(n):
+            ix.get_or_create("m", (("u", f"u{i}"),))
+        st = ix.stats()
+        assert st["runs"] >= 1
+        assert len(ix.series_ids("m")) == n
+        ix.compact()
+        assert ix.stats()["runs"] == 1
+        assert len(ix.series_ids("m")) == n
+        assert ix.match_eq("m", "u", "u12345") != set()
+        ix.close()
+
+
+class TestFactoryMigration:
+    def test_legacy_log_migrates_once(self, tmp_path):
+        shard_dir = str(tmp_path / "shard")
+        os.makedirs(shard_dir)
+        legacy = SeriesIndex(os.path.join(shard_dir, "series.log"))
+        s1 = legacy.get_or_create("cpu", (("host", "a"),))
+        s2 = legacy.get_or_create("mem", ())
+        legacy.flush()
+        legacy.close()
+        ix = open_series_index(shard_dir)
+        assert isinstance(ix, MergesetIndex)
+        # sids preserved exactly (TSF files reference them)
+        assert ix.series_ids("cpu") == {s1}
+        assert ix.series_ids("mem") == {s2}
+        assert ix.tags_of(s1) == {"host": "a"}
+        assert not os.path.exists(os.path.join(shard_dir, "series.log"))
+        ix.close()
+        # second open: no legacy log left, straight to mergeset
+        ix2 = open_series_index(shard_dir)
+        assert ix2.series_ids("cpu") == {s1}
+        ix2.close()

@@ -296,3 +296,26 @@ class TestMergeEdgeCases:
             [row] = res["results"][0]["series"][0]["values"]
             assert row[1] == pytest.approx(2.5) and row[2] == 6, (nid, row)
         _close(nodes)
+
+
+class TestSelectorTieBreak:
+    def test_min_value_tie_breaks_by_earliest_time(self, tmp_path):
+        """Equal min values on different nodes: the reported time must be
+        the EARLIEST occurrence, matching the single-device kernels."""
+        nodes, addrs = _mk_cluster(tmp_path, nids=("nA", "nB"))
+        week = 7 * 86400
+        # same value 1.0 in two different shard groups (different owners)
+        lines = "\n".join([
+            f"m v=1.0 {BASE * NS}",
+            f"m v=1.0 {(BASE + week) * NS}",
+            f"m v=9.0 {(BASE + 2 * week) * NS}",
+        ])
+        req = urllib.request.Request(
+            f"http://{addrs['nA']}/write?db=db", data=lines.encode(),
+            method="POST")
+        urllib.request.urlopen(req, timeout=30).read()
+        for nid in nodes:
+            res = _query(addrs, nid, "SELECT min(v) FROM m")
+            [row] = res["results"][0]["series"][0]["values"]
+            assert row == [BASE * NS, 1.0], (nid, row)
+        _close(nodes)
