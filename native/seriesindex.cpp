@@ -148,7 +148,16 @@ bool write_run(const std::string &path, const std::vector<std::string_view> &ite
         return false;
     }
     fclose(f);
-    return rename(tmp.c_str(), path.c_str()) == 0;
+    if (rename(tmp.c_str(), path.c_str()) != 0) return false;
+    // fsync the directory: the caller truncates the WAL right after, so
+    // the run's dirent must be durable first or a power loss drops both
+    std::string dir = path.substr(0, path.find_last_of('/'));
+    int dfd = open(dir.empty() ? "." : dir.c_str(), O_RDONLY | O_DIRECTORY);
+    if (dfd >= 0) {
+        fsync(dfd);
+        ::close(dfd);
+    }
+    return true;
 }
 
 bool open_run(const std::string &path, Run &r, uint64_t &max_sid) {

@@ -13,6 +13,7 @@ distinct tag-value enumeration; everything exact runs native.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import re
@@ -137,22 +138,23 @@ class MergesetIndex:
         self._h = lib.msi_open(path.encode())
         if not self._h:
             raise OSError(f"msi_open failed for {path!r}")
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         # sid -> (mst, tags): bounded decode cache for the render path
         self._tags_cache: dict[int, tuple] = {}
         # series key -> sid: the ingest hot path is overwhelmingly repeat
         # series; skip the native call for those
         self._key_cache: dict[str, int] = {}
 
-    def _handle(self):
-        """The live native handle. A closed index raises instead of
-        passing NULL into C (the dict index stayed readable after close;
-        here a clean OSError fails the racing query instead of
-        segfaulting the process)."""
-        h = self._h
-        if not h:
-            raise OSError("series index is closed")
-        return h
+    @contextlib.contextmanager
+    def _native(self):
+        """Serialized access to the live native handle. A closed index
+        raises a clean OSError; holding the (reentrant) lock for the
+        call's duration means a racing close() can never free the handle
+        under a reader (use-after-free -> process crash)."""
+        with self._lock:
+            if not self._h:
+                raise OSError("series index is closed")
+            yield self._h
 
     # -- write side ---------------------------------------------------------
 
@@ -162,17 +164,20 @@ class MergesetIndex:
         if sid is not None:
             return sid
         blob = _pack_series(key, measurement, tags)
-        sid = int(self._lib.msi_insert(self._handle(), blob, len(blob), 0))
+        with self._native() as h:
+            sid = int(self._lib.msi_insert(h, blob, len(blob), 0))
         if len(self._key_cache) >= _TAGS_CACHE_MAX:
             self._key_cache.clear()
         self._key_cache[key] = sid
         return sid
 
     def flush(self) -> None:
-        self._lib.msi_flush(self._handle())
+        with self._native() as h:
+            self._lib.msi_flush(h)
 
     def compact(self) -> None:
-        self._lib.msi_compact(self._handle())
+        with self._native() as h:
+            self._lib.msi_compact(h)
 
     def close(self) -> None:
         with self._lock:
@@ -194,14 +199,16 @@ class MergesetIndex:
     def series_ids(self, measurement: str) -> set[int]:
         m = measurement.encode()
         n = ctypes.c_uint64()
-        ptr = self._lib.msi_series_ids(self._handle(), m, len(m), ctypes.byref(n))
+        with self._native() as h:
+            ptr = self._lib.msi_series_ids(h, m, len(m), ctypes.byref(n))
         return self._sid_buf(ptr, int(n.value))
 
     def match_eq(self, measurement: str, key: str, value: str) -> set[int]:
         m, k, v = measurement.encode(), key.encode(), value.encode()
         n = ctypes.c_uint64()
-        ptr = self._lib.msi_match_eq(
-            self._handle(), m, len(m), k, len(k), v, len(v), ctypes.byref(n))
+        with self._native() as h:
+            ptr = self._lib.msi_match_eq(
+                h, m, len(m), k, len(k), v, len(v), ctypes.byref(n))
         return self._sid_buf(ptr, int(n.value))
 
     def match_neq(self, measurement: str, key: str, value: str) -> set[int]:
@@ -211,9 +218,10 @@ class MergesetIndex:
     def _enum(self, kind: bytes, pfx: bytes, idx: int) -> list[str]:
         n = ctypes.c_uint64()
         blen = ctypes.c_uint64()
-        ptr = self._lib.msi_enum_field(
-            self._handle(), kind, pfx, len(pfx), idx, ctypes.byref(n),
-            ctypes.byref(blen))
+        with self._native() as h:
+            ptr = self._lib.msi_enum_field(
+                h, kind, pfx, len(pfx), idx, ctypes.byref(n),
+                ctypes.byref(blen))
         try:
             raw = ctypes.string_at(ptr, blen.value)
         finally:
@@ -249,7 +257,8 @@ class MergesetIndex:
         got = self._tags_cache.get(sid)
         if got is None:
             n = ctypes.c_uint64()
-            ptr = self._lib.msi_key_of(self._handle(), sid, ctypes.byref(n))
+            with self._native() as h:
+                ptr = self._lib.msi_key_of(h, sid, ctypes.byref(n))
             try:
                 raw = ctypes.string_at(ptr, n.value)
             finally:
@@ -276,12 +285,12 @@ class MergesetIndex:
         # a measurement whose every series was removed must not list:
         # membership postings are tombstone-filtered, 'M' items are not.
         # msi_has_live early-exits — never decodes whole posting sets
-        h = self._handle()
         out = []
         for m in self._enum(b"M", b"", 0):
             mb = m.encode()
-            if self._lib.msi_has_live(h, mb, len(mb)):
-                out.append(m)
+            with self._native() as h:
+                if self._lib.msi_has_live(h, mb, len(mb)):
+                    out.append(m)
         return sorted(out)
 
     # -- deletion ------------------------------------------------------------
@@ -290,14 +299,16 @@ class MergesetIndex:
         if not sids:
             return
         arr = (ctypes.c_uint64 * len(sids))(*sorted(sids))
-        self._lib.msi_remove_sids(self._handle(), arr, len(sids))
+        with self._native() as h:
+            self._lib.msi_remove_sids(h, arr, len(sids))
         for sid in sids:
             self._tags_cache.pop(sid, None)
         self._key_cache.clear()  # deletes are rare; a full drop is fine
 
     def stats(self) -> dict:
         a, b, c, d = (ctypes.c_uint64() for _ in range(4))
-        self._lib.msi_stats(self._handle(), *(ctypes.byref(x) for x in (a, b, c, d)))
+        with self._native() as h:
+            self._lib.msi_stats(h, *(ctypes.byref(x) for x in (a, b, c, d)))
         return {"mem_items": a.value, "runs": b.value,
                 "run_items": c.value, "next_sid": d.value}
 
@@ -311,6 +322,14 @@ def open_series_index(shard_path: str):
     legacy_log = os.path.join(shard_path, "series.log")
     msi_dir = os.path.join(shard_path, "seriesidx")
     if load() is None:
+        if os.path.isdir(msi_dir) and os.listdir(msi_dir):
+            # the shard's series live ONLY in the mergeset dir: a silent
+            # dict fallback would restart sid numbering at 1 and alias
+            # unrelated series onto existing TSF chunks
+            raise OSError(
+                f"native series index library unavailable but {msi_dir!r} "
+                "holds this shard's index — rebuild native/ (make -C native)"
+            )
         return SeriesIndex(legacy_log)
     idx = MergesetIndex(msi_dir)
     if os.path.exists(legacy_log):

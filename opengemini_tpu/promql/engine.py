@@ -459,25 +459,79 @@ class PromEngine:
         if op in ("topk", "bottomk"):
             n = int(_expect_number_node(node.param))
             keep = np.zeros_like(f.valid)
-            for gi, kk in enumerate(uniq):
-                rows = [si for si, skk in enumerate(keys) if skk == kk]
-                for col in range(k):
-                    cand = [(f.values[si, col], si) for si in rows if f.valid[si, col]]
-                    cand.sort(reverse=(op == "topk"))
-                    for _v, si in cand[:n]:
-                        keep[si, col] = True
+            if n > 0:
+                for gi in range(g):
+                    rows = np.flatnonzero(member[gi])
+                    keep[rows] = _topk_keep(
+                        f.values[rows], f.valid[rows],
+                        min(n, len(rows)), descending=(op == "topk"),
+                    )
             return Frame(f.labels, f.values, keep)
         if op == "quantile":
+            # vectorized Prom quantile: sort once per group, linear
+            # interpolation at rank q*(n_valid-1) per step column
             q = float(_expect_number_node(node.param))
             out = np.full((g, k), np.nan)
-            for gi, kk in enumerate(uniq):
-                rows = [si for si, skk in enumerate(keys) if skk == kk]
-                for col in range(k):
-                    vs_ = [f.values[si, col] for si in rows if f.valid[si, col]]
-                    if vs_:
-                        out[gi, col] = _prom_quantile(q, vs_)
+            for gi in range(g):
+                rows = np.flatnonzero(member[gi])
+                sub_valid = f.valid[rows]
+                nvalid = sub_valid.sum(axis=0)  # (K,)
+                has = nvalid > 0
+                if q < 0 or q > 1:
+                    out[gi] = np.where(has, -np.inf if q < 0 else np.inf,
+                                       np.nan)
+                    continue
+                srt = np.sort(np.where(sub_valid, f.values[rows], np.inf),
+                              axis=0)
+                rank = q * np.maximum(nvalid - 1, 0)
+                lo = np.floor(rank).astype(np.int64)
+                hi = np.minimum(lo + 1, np.maximum(nvalid - 1, 0))
+                w = rank - lo
+                cols = np.arange(k)
+                cap = len(rows) - 1
+                vlo = srt[np.minimum(lo, cap), cols]
+                vhi = srt[np.minimum(hi, cap), cols]
+                out[gi] = np.where(has, vlo * (1 - w) + vhi * w, np.nan)
             return Frame([dict(u) for u in (out_labels_by_key[kk] for kk in uniq)],
                          out, any_valid)
+        if op == "count_values":
+            if not isinstance(node.param, pp.StringLit):
+                raise PromError("count_values expects a label-name string")
+            label = node.param.val
+            out_labels, out_rows = [], []
+            for gi, kk in enumerate(uniq):
+                rows = np.flatnonzero(member[gi])
+                sub = f.values[rows]
+                sub_valid = f.valid[rows]
+                cell_cols = np.broadcast_to(np.arange(k), sub.shape)[sub_valid]
+                seen = sub[sub_valid]
+                if not len(seen):
+                    continue
+                # one pass over valid cells: unique codes + bincount —
+                # O(cells + distinct x steps), never distinct x cells
+                nanmask = np.isnan(seen)
+                vals_f, cols_f = seen[~nanmask], cell_cols[~nanmask]
+                uvals, inv = np.unique(vals_f, return_inverse=True)
+                counts = np.bincount(
+                    inv * k + cols_f, minlength=len(uvals) * k
+                ).reshape(len(uvals), k).astype(np.float64)
+                for ui, v in enumerate(uvals):
+                    lbl = dict(out_labels_by_key[kk])
+                    lbl[label] = _fmt(float(v))
+                    out_labels.append(lbl)
+                    out_rows.append(counts[ui])
+                if nanmask.any():
+                    cnt = np.bincount(
+                        cell_cols[nanmask], minlength=k
+                    ).astype(np.float64)
+                    lbl = dict(out_labels_by_key[kk])
+                    lbl[label] = "NaN"
+                    out_labels.append(lbl)
+                    out_rows.append(cnt)
+            if not out_labels:
+                return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
+            counts_m = np.stack(out_rows)
+            return Frame(out_labels, counts_m, counts_m > 0)
         raise PromError(f"unsupported aggregation {op!r}")
 
     def _eval_binop(self, node: pp.BinaryOp, steps, db) -> Frame:
@@ -607,6 +661,33 @@ def _histogram_quantile(q: float, f: Frame, k: int) -> Frame:
     return Frame(out_labels, np.stack(out_vals), np.stack(out_valid))
 
 
+def _topk_keep(values: np.ndarray, valid: np.ndarray, m: int,
+               descending: bool) -> np.ndarray:
+    """(R, K) keep-mask of the m largest (descending) / smallest VALID
+    entries per column. Exact f64 comparisons, O(R x K) via partition
+    (full argsort of a 1M-series group would pay R log R per column);
+    invalid and NaN cells rank below every comparable value — a valid
+    -Inf still beats an invalid cell — and boundary ties resolve to the
+    lowest row index, deterministically."""
+    if m <= 0:
+        return np.zeros_like(valid)
+    keyx = np.where(valid, -values if descending else values, np.nan)
+    R = keyx.shape[0]
+    if m >= R:
+        return valid & ~np.isnan(keyx)
+    part = np.partition(keyx, m - 1, axis=0)  # NaN sorts last
+    b = part[m - 1]  # per-column boundary (m-th best), NaN if < m usable
+    strict = keyx < b
+    ties = keyx == b
+    need = m - strict.sum(axis=0)
+    tie_rank = np.cumsum(ties, axis=0) - 1
+    keep = strict | (ties & (tie_rank < need))
+    short = np.isnan(b)  # fewer than m comparable cells in the column
+    if short.any():
+        keep[:, short] = ~np.isnan(keyx[:, short])
+    return keep
+
+
 def _prom_quantile(q: float, vals: list[float]) -> float:
     if not vals:
         return float("nan")
@@ -686,9 +767,15 @@ def _expect_number(node, i) -> float:
 
 
 def _expect_number_node(n) -> float:
-    if not isinstance(n, pp.NumberLit):
-        raise PromError("expected a number parameter")
-    return n.val
+    if isinstance(n, pp.NumberLit):
+        return n.val
+    # the parser desugars unary minus to (-1 * x): fold constant arithmetic
+    if isinstance(n, pp.BinaryOp):
+        lhs, rhs = _expect_number_node(n.lhs), _expect_number_node(n.rhs)
+        folded = _apply_op(n.op, np.float64(lhs), np.float64(rhs),
+                           comparison_keep=False)
+        return float(folded)
+    raise PromError("expected a number parameter")
 
 
 def _fmt(v: float) -> str:

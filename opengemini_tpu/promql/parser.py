@@ -57,6 +57,11 @@ class NumberLit:
 
 
 @dataclass
+class StringLit:
+    val: str = ""
+
+
+@dataclass
 class FunctionCall:
     name: str = ""
     args: list = field(default_factory=list)
@@ -79,7 +84,7 @@ class BinaryOp:
 
 
 AGG_OPS = {"sum", "avg", "min", "max", "count", "topk", "bottomk", "quantile",
-           "stddev", "stdvar", "group"}
+           "stddev", "stdvar", "group", "count_values"}
 FUNCTIONS = {
     "rate", "irate", "increase", "delta", "idelta", "changes", "resets",
     "avg_over_time", "min_over_time", "max_over_time", "sum_over_time",
@@ -196,6 +201,9 @@ def _parse_primary(lx: _Lexer):
     if kind == "NUM":
         lx.next()
         return NumberLit(float(val))
+    if kind == "STR":
+        lx.next()
+        return StringLit(val)
     if kind == "OP" and val == "-":
         lx.next()
         # unary minus binds looser than ^ in PromQL: -2^2 == -(2^2)

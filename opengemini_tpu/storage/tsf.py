@@ -210,11 +210,18 @@ class TSFReader:
         self._cache_bytes = 0
         self._cache_lock = threading.Lock()
         self._sid_bloom: dict[str, BloomFilter] = {}
+        # per-(mst, sid) chunk lists: single-series lookups are O(own
+        # chunks); without this a scan over S series costs S x all-chunks
+        # meta filtering — quadratic at high cardinality
+        self._sid_chunks: dict[str, dict[int, list[ChunkMeta]]] = {}
         for mst, (_s, chunks) in self.meta.items():
             bf = BloomFilter(len(chunks))
+            by_sid: dict[int, list[ChunkMeta]] = {}
             for c in chunks:
                 bf.add(c.sid)
+                by_sid.setdefault(c.sid, []).append(c)
             self._sid_bloom[mst] = bf
+            self._sid_chunks[mst] = by_sid
 
     def close(self) -> None:
         self._f.close()
@@ -239,11 +246,15 @@ class TSFReader:
         if entry is None:
             return []
         if sids is not None and len(sids) == 1:
+            sid = next(iter(sids))
             bf = self._sid_bloom.get(measurement)
-            if bf is not None and next(iter(sids)) not in bf:
+            if bf is not None and sid not in bf:
                 return []
+            cand = self._sid_chunks.get(measurement, {}).get(sid, ())
+        else:
+            cand = entry[1]
         out = []
-        for c in entry[1]:
+        for c in cand:
             if sids is not None and c.sid not in sids:
                 continue
             if tmin is not None and c.tmax < tmin:

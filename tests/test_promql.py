@@ -490,3 +490,119 @@ class TestSubqueries:
             B + 600, B + 600, 30, db="db")
         assert res["result"], res
         e.close()
+
+
+class TestCountValuesAndRank:
+    """count_values + vectorized topk/bottomk/quantile (config #5 surface).
+    Oracle: hand-computed Prometheus semantics."""
+
+    def _write(self, e, series):
+        lines = []
+        for inst, vals in series.items():
+            for i, v in enumerate(vals):
+                lines.append(
+                    f"gauge_metric,instance={inst} value={v} "
+                    f"{(BASE + i * 15) * NS}")
+        e.write_lines("prom", "\n".join(lines))
+
+    def test_count_values(self, prom_env):
+        e, pe = prom_env
+        self._write(e, {"a": [2, 2], "b": [2, 3], "c": [5, 3]})
+        data = pe.query_instant('count_values("v", gauge_metric)',
+                                BASE + 16, "prom")
+        got = {r["metric"]["v"]: float(r["value"][1]) for r in data["result"]}
+        # at t=BASE+16 the latest samples are a=2, b=3, c=3
+        assert got == {"2.0": 1.0, "3.0": 2.0}
+
+    def test_count_values_by_group(self, prom_env):
+        e, pe = prom_env
+        lines = []
+        for inst, dc, v in [("a", "e", 1), ("b", "e", 1), ("c", "w", 1),
+                            ("d", "w", 7)]:
+            lines.append(f"m2,instance={inst},dc={dc} value={v} {BASE * NS}")
+        e.write_lines("prom", "\n".join(lines))
+        data = pe.query_instant('count_values by (dc) ("val", m2)',
+                                BASE + 1, "prom")
+        got = {(r["metric"]["dc"], r["metric"]["val"]): float(r["value"][1])
+               for r in data["result"]}
+        assert got == {("e", "1.0"): 2.0, ("w", "1.0"): 1.0,
+                       ("w", "7.0"): 1.0}
+
+    def test_topk_bottomk_values(self, prom_env):
+        e, pe = prom_env
+        self._write(e, {f"i{j}": [j] for j in range(10)})
+        data = pe.query_instant("topk(3, gauge_metric)", BASE + 1, "prom")
+        vals = sorted(float(r["value"][1]) for r in data["result"])
+        assert vals == [7.0, 8.0, 9.0]
+        data = pe.query_instant("bottomk(2, gauge_metric)", BASE + 1, "prom")
+        vals = sorted(float(r["value"][1]) for r in data["result"])
+        assert vals == [0.0, 1.0]
+
+    def test_quantile_matches_scalar_oracle(self, prom_env):
+        from opengemini_tpu.promql.engine import _prom_quantile
+
+        e, pe = prom_env
+        vals = [3.0, 1.0, 4.0, 1.5, 9.0, 2.6]
+        self._write(e, {f"i{j}": [v] for j, v in enumerate(vals)})
+        for q in (0.0, 0.25, 0.5, 0.9, 1.0):
+            data = pe.query_instant(f"quantile({q}, gauge_metric)",
+                                    BASE + 1, "prom")
+            [r] = data["result"]
+            assert float(r["value"][1]) == pytest.approx(
+                _prom_quantile(q, vals))
+
+    def test_topk_partition_path_matches_argsort(self):
+        """The O(R) partition keep-mask must agree with a full argsort
+        oracle, including boundary ties and invalid cells."""
+        import numpy as np
+
+        from opengemini_tpu.promql.engine import _topk_keep
+
+        rng = np.random.default_rng(3)
+        for trial in range(30):
+            R, K = rng.integers(2, 40), rng.integers(1, 6)
+            # small value alphabet -> many exact ties
+            vals = rng.integers(0, 5, size=(R, K)).astype(np.float64)
+            valid = rng.random((R, K)) > 0.3
+            n = int(rng.integers(1, R + 1))
+            for desc in (True, False):
+                got = _topk_keep(vals, valid, n, desc)
+                # oracle: stable argsort of (key, row) per column
+                for col in range(K):
+                    cand = [(vals[r, col], r) for r in range(R)
+                            if valid[r, col]]
+                    cand.sort(key=lambda t: (-t[0] if desc else t[0], t[1]))
+                    want = {r for _v, r in cand[:n]}
+                    assert {r for r in range(R) if got[r, col]} == want, (
+                        trial, col, n, desc)
+
+    def test_topk_edge_cases(self, prom_env):
+        import numpy as np
+
+        from opengemini_tpu.promql.engine import _topk_keep
+
+        # valid -Inf must beat invalid cells
+        vals = np.array([[0.0], [-np.inf], [1.0]])
+        valid = np.array([[False], [True], [True]])
+        got = _topk_keep(vals, valid, 2, descending=True)
+        assert got[:, 0].tolist() == [False, True, True]
+        # negative n via the engine: empty result
+        e, pe = prom_env
+        self._write(e, {"a": [1], "b": [2]})
+        data = pe.query_instant("topk(-1, gauge_metric)", BASE + 1, "prom")
+        assert data["result"] == []
+
+    def test_count_values_many_distinct_one_pass(self, prom_env):
+        """Mostly-distinct values (the config-#5 shape) stay fast and
+        correct: one unique+bincount pass, never distinct x cells."""
+        e, pe = prom_env
+        n = 3000
+        self._write(e, {f"i{j:05d}": [j * 0.5] for j in range(n)})
+        import time
+        t0 = time.perf_counter()
+        data = pe.query_instant('count_values("v", gauge_metric)',
+                                BASE + 1, "prom")
+        dt = time.perf_counter() - t0
+        assert len(data["result"]) == n
+        assert all(float(r["value"][1]) == 1.0 for r in data["result"])
+        assert dt < 5.0, dt
