@@ -178,6 +178,67 @@ def bench_cpu(mask_frac_valid=True):
     return Sc * R / t_best
 
 
+def bench_e2e(series: int = 500, points: int = 7200) -> dict:
+    """End-to-end ingest->query wall time (BASELINE config #1 shape).
+
+    Writes `series` hosts x `points` 1s-spaced samples of line protocol
+    through the real engine path (parse -> WAL -> memtable -> flush) and
+    times `SELECT mean(usage_user),max(usage_user),count(usage_user)
+    GROUP BY time(1m)` through the real executor, cold (includes XLA
+    compile + TSF decode) and warm.  Complements the device-resident
+    kernel numbers above: this is the number a user experiences, host
+    path included."""
+    import shutil
+    import tempfile
+
+    from opengemini_tpu.query.executor import Executor
+    from opengemini_tpu.storage.engine import Engine
+
+    NS = 1_000_000_000
+    base = 1_700_000_000
+    root = tempfile.mkdtemp(prefix="ogtpu-bench-")
+    try:
+        eng = Engine(root, sync_wal=False)
+        eng.create_database("bench")
+        rows = series * points
+        t0 = time.perf_counter()
+        # batch lines per flush-friendly slab; timestamps interleaved so
+        # every batch touches every series (TSBS writer shape)
+        batch = []
+        for p in range(points):
+            ts = (base + p) * NS
+            for s in range(series):
+                batch.append(f"cpu,host=h{s} usage_user={50 + (s + p) % 50} {ts}")
+            if len(batch) >= 100_000:
+                eng.write_lines("bench", "\n".join(batch))
+                batch.clear()
+        if batch:
+            eng.write_lines("bench", "\n".join(batch))
+        t_ingest = time.perf_counter() - t0
+        ex = Executor(eng)
+        q = (
+            "SELECT mean(usage_user), max(usage_user), count(usage_user) "
+            f"FROM cpu WHERE time >= {base * NS} AND time < {(base + points) * NS} "
+            "GROUP BY time(1m)"
+        )
+        t0 = time.perf_counter()
+        ex.execute(q, db="bench", now_ns=(base + points) * NS)
+        t_cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        ex.execute(q, db="bench", now_ns=(base + points) * NS)
+        t_warm = time.perf_counter() - t0
+        eng.close()
+        return {
+            "rows": rows,
+            "ingest_rows_per_s": round(rows / t_ingest),
+            "query_cold_s": round(t_cold, 3),
+            "query_warm_s": round(t_warm, 3),
+            "query_warm_rows_per_s": round(rows / t_warm),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _arm_watchdog():
     """A hung device tunnel must not stall the bench forever: if the whole
     run exceeds the budget, print a diagnostic and exit non-zero WITHOUT
@@ -237,16 +298,23 @@ def _device_main() -> None:
     rows_gen = S * R / t_gen
     rows_cpu = bench_cpu()
     cpu16 = rows_cpu * 16
+    # disarm once device work is done: the watchdog exists to catch a hung
+    # tunnel, and e2e below is host-bound — a slow host must not be
+    # misreported as "device unreachable" (it is still bounded by the
+    # parent's subprocess timeout)
+    watchdog.cancel()
+    e2e = bench_e2e(
+        series=int(os.environ.get("OGTPU_BENCH_E2E_SERIES", "200")),
+        points=int(os.environ.get("OGTPU_BENCH_E2E_POINTS", "7200")),
+    )
 
     vs_baseline = rows_grid / cpu16
-    # disarm BEFORE emitting the metric: a budget-edge firing between the
-    # print and a later cancel could os._exit past unflushed stdout
-    watchdog.cancel()
     print(
         f"grid path: {rows_grid/1e9:.2f} G rows/s ({t_grid*1e3:.2f} ms / {S*R/1e6:.1f}M rows); "
         f"ragged dense buckets (count/sum/mean/min/max/ssd): {rows_ragged/1e9:.2f} G rows/s; "
         f"xla scatter (for reference): {rows_gen/1e9:.2f} G rows/s; "
-        f"cpu 1-core: {rows_cpu/1e9:.3f} G rows/s (x16 = {cpu16/1e9:.2f})",
+        f"cpu 1-core: {rows_cpu/1e9:.3f} G rows/s (x16 = {cpu16/1e9:.2f}); "
+        f"e2e: {e2e}",
         file=sys.stderr,
     )
     print(
@@ -256,6 +324,7 @@ def _device_main() -> None:
                 "value": round(rows_grid),
                 "unit": "rows/s",
                 "vs_baseline": round(vs_baseline, 3),
+                "e2e_ingest_query": e2e,
             }
         )
     )
@@ -278,9 +347,10 @@ def _cpu_smoke() -> None:
     rows_grid = S * R / t_grid
     rows_cpu = bench_cpu()
     cpu16 = rows_cpu * 16
+    e2e = bench_e2e(series=100, points=1200)
     print(
         f"cpu-smoke grid: {rows_grid/1e9:.3f} G rows/s; numpy 1-core: "
-        f"{rows_cpu/1e9:.3f} G rows/s",
+        f"{rows_cpu/1e9:.3f} G rows/s; e2e: {e2e}",
         file=sys.stderr,
     )
     print(
@@ -291,6 +361,7 @@ def _cpu_smoke() -> None:
                 "unit": "rows/s",
                 "vs_baseline": round(rows_grid / cpu16, 3),
                 "note": "device backend unreachable; jax-CPU smoke at reduced shape",
+                "e2e_ingest_query": e2e,
             }
         )
     )

@@ -1,0 +1,160 @@
+"""Shared harness for the black-box result-parity suite.
+
+Replays tests/parity_cases.json (transcribed from the reference's
+tests/server_test.go data tables by tools/extract_parity.py) over HTTP
+against a live server and compares response JSON structurally:
+
+  - numbers compare numerically (Go prints 1.0 as 1, we may print 1.0);
+  - floats compare with 1e-9 relative tolerance (formatting, summation
+    order);
+  - when the expected result carries an "error", only the presence of an
+    error is asserted, not the wording (our error strings are our own);
+  - everything else (series names, tags, columns, values, row order) is
+    exact.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import urllib.parse
+import urllib.request
+
+CASES_PATH = os.path.join(os.path.dirname(__file__), "parity_cases.json")
+
+
+def load_cases() -> list[dict]:
+    with open(CASES_PATH) as f:
+        return json.load(f)["cases"]
+
+
+class ParityServer:
+    """One engine + HTTP server, databases created on demand."""
+
+    def __init__(self, root: str):
+        from opengemini_tpu.server.http import HttpService
+        from opengemini_tpu.storage.engine import Engine
+
+        self.engine = Engine(root)
+        self.svc = HttpService(self.engine, "127.0.0.1", 0)
+        self.svc.start()
+
+    def close(self) -> None:
+        self.svc.stop()
+        self.engine.close()
+
+    def prepare(self, case: dict) -> None:
+        db, rp = case.get("db", "db0"), case.get("rp", "rp0")
+        self.ensure_db(db, rp)
+        for w in case.get("writes", []):
+            wdb, wrp = w.get("db", db), w.get("rp", rp)
+            self.ensure_db(wdb, wrp)
+            body = "\n".join(w["lines"]).encode()
+            status, resp = self.post("/write", body, db=wdb, rp=wrp)
+            if status != 204:
+                raise AssertionError(f"write failed {status}: {resp[:300]}")
+
+    def ensure_db(self, db: str, rp: str) -> None:
+        if db not in self.engine.databases:
+            self.engine.create_database(db)
+        d = self.engine.databases[db]
+        if rp not in d.rps:
+            self.engine.create_retention_policy(db, rp, 0, default=True)
+        elif d.default_rp != rp:
+            d.default_rp = rp
+
+    def post(self, path: str, body: bytes, **params):
+        url = f"http://127.0.0.1:{self.svc.port}{path}?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, data=body, method="POST")
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def query(self, q: dict, default_db: str):
+        params = dict(q.get("params") or {"db": default_db})
+        params["q"] = q["command"]
+        url = f"http://127.0.0.1:{self.svc.port}/query?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, method="POST")
+        try:
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read())
+            except json.JSONDecodeError:
+                return {"error": f"http {e.code}"}
+
+
+def _num(x):
+    return isinstance(x, (int, float)) and not isinstance(x, bool)
+
+
+def values_equal(exp, act) -> bool:
+    if _num(exp) and _num(act):
+        if math.isclose(exp, act, rel_tol=1e-9, abs_tol=1e-12):
+            return True
+        return False
+    if type(exp) is not type(act):
+        return False
+    if isinstance(exp, list):
+        return len(exp) == len(act) and all(
+            values_equal(e, a) for e, a in zip(exp, act)
+        )
+    if isinstance(exp, dict):
+        return set(exp) == set(act) and all(values_equal(exp[k], act[k]) for k in exp)
+    return exp == act
+
+
+def result_matches(exp_json: str, actual: dict) -> tuple[bool, str]:
+    """Compare expected (reference) response JSON against our response."""
+    try:
+        exp = json.loads(exp_json)
+    except json.JSONDecodeError:
+        return False, f"unparseable expectation: {exp_json[:120]}"
+    # top-level error expectation: any error counts
+    if "error" in exp and "results" not in exp:
+        ok = "error" in actual and "results" not in actual or any(
+            "error" in r for r in actual.get("results", [])
+        )
+        return ok, "" if ok else f"expected an error, got {json.dumps(actual)[:200]}"
+    if "results" not in exp:
+        return False, "expectation has no results"
+    eresults = exp["results"]
+    aresults = actual.get("results")
+    if aresults is None:
+        return False, f"no results in actual: {json.dumps(actual)[:200]}"
+    if len(eresults) != len(aresults):
+        return False, f"result count {len(aresults)} != {len(eresults)}"
+    for er, ar in zip(eresults, aresults):
+        if "error" in er:
+            if "error" not in ar:
+                return False, f"expected error, got {json.dumps(ar)[:200]}"
+            continue
+        if "error" in ar:
+            return False, f"unexpected error: {ar['error'][:200]}"
+        eseries = er.get("series", [])
+        aseries = ar.get("series", [])
+        if len(eseries) != len(aseries):
+            return (
+                False,
+                f"series count {len(aseries)} != {len(eseries)}: "
+                f"exp={json.dumps(eseries)[:200]} act={json.dumps(aseries)[:200]}",
+            )
+        for es, as_ in zip(eseries, aseries):
+            for key in ("name", "tags", "columns"):
+                if es.get(key) != as_.get(key):
+                    return (
+                        False,
+                        f"{key} mismatch: exp={es.get(key)} act={as_.get(key)}",
+                    )
+            ev, av = es.get("values", []), as_.get("values", [])
+            if not values_equal(ev, av):
+                return (
+                    False,
+                    f"values mismatch in {es.get('name')}: "
+                    f"exp={json.dumps(ev)[:300]} act={json.dumps(av)[:300]}",
+                )
+    return True, ""

@@ -1,0 +1,82 @@
+"""Result-parity oracle: replay the reference's black-box query tables.
+
+Cases in parity_cases.json are transcribed from the reference's
+tests/server_test.go (the stated acceptance oracle, SURVEY.md §7) by
+tools/extract_parity.py.  Each case boots a fresh server, writes the
+case's line-protocol points, and asserts every query's response JSON
+matches the reference's expectation (see parity_common.result_matches
+for the comparison rules).
+
+Known gaps live in parity_xfail.json (regenerate with
+`python tools/parity_triage.py --write-ledger`).  A query in the ledger
+is expected to fail; when a feature lands and its queries start passing,
+the test FAILS with "unexpected pass" until the ledger is regenerated —
+keeping the ledger an honest, shrinking gap list.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+import parity_common as pc
+
+with open(os.path.join(os.path.dirname(__file__), "parity_xfail.json")) as f:
+    XFAIL: dict[str, str] = json.load(f)
+
+CASES = pc.load_cases()
+
+
+@pytest.fixture(scope="module")
+def server_for(tmp_path_factory):
+    servers: dict[str, pc.ParityServer] = {}
+    broken: dict[str, str] = {}
+
+    def get(case: dict) -> pc.ParityServer:
+        name = case["name"]
+        if name in broken:
+            pytest.fail(f"case setup failed earlier: {broken[name]}")
+        if name not in servers:
+            root = str(tmp_path_factory.mktemp(name))
+            srv = pc.ParityServer(root)
+            try:
+                srv.prepare(case)
+            except AssertionError as e:
+                srv.close()
+                broken[name] = str(e)
+                pytest.fail(f"case setup failed: {e}")
+            servers[name] = srv
+        return servers[name]
+
+    yield get
+    for srv in servers.values():
+        srv.close()
+
+
+def _params():
+    out = []
+    for case in CASES:
+        for i, q in enumerate(case["queries"]):
+            marks = []
+            if q.get("skip"):
+                marks.append(pytest.mark.skip(reason="skipped in reference suite"))
+            out.append(
+                pytest.param(case, q, f"{case['name']}#{i}", id=f"{case['name']}-{i}", marks=marks)
+            )
+    return out
+
+
+@pytest.mark.parametrize("case,q,qid", _params())
+def test_parity(case, q, qid, server_for):
+    srv = server_for(case)
+    actual = srv.query(q, case["db"])
+    ok, why = pc.result_matches(q["exp"], actual)
+    if qid in XFAIL:
+        if ok:
+            pytest.fail(
+                f"unexpected pass (remove from parity_xfail.json): {qid}"
+            )
+        pytest.xfail(f"known gap: {XFAIL[qid]}")
+    assert ok, f"{qid}\n  q: {q['command']}\n  {why}"

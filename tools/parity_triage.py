@@ -1,0 +1,76 @@
+"""Run every parity case against a live in-process server and report
+pass/fail per query.  Dev tool for curating tests/test_parity.py's xfail
+ledger; the committed test is the real gate.
+
+Usage:
+    python tools/parity_triage.py [case-name-substring]
+    python tools/parity_triage.py --write-ledger   # regenerate tests/parity_xfail.json
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_here, ".."))
+sys.path.insert(0, os.path.join(_here, "..", "tests"))
+
+import conftest  # noqa: E402,F401  (mirror the pytest env: cpu mesh + x64)
+import parity_common as pc  # noqa: E402
+
+
+def main() -> int:
+    write_ledger = "--write-ledger" in sys.argv
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    filt = args[0] if args else ""
+    cases = [c for c in pc.load_cases() if filt in c["name"]]
+    total = passed = failed = skipped = 0
+    fail_lines = []
+    ledger: dict[str, str] = {}
+    for case in cases:
+        with tempfile.TemporaryDirectory() as root:
+            srv = pc.ParityServer(root)
+            try:
+                try:
+                    srv.prepare(case)
+                except AssertionError as e:
+                    fail_lines.append(f"WRITE-FAIL {case['name']}: {e}")
+                    failed += len(case["queries"])
+                    total += len(case["queries"])
+                    continue
+                for i, q in enumerate(case["queries"]):
+                    total += 1
+                    if q.get("skip"):
+                        skipped += 1
+                        continue
+                    actual = srv.query(q, case["db"])
+                    ok, why = pc.result_matches(q["exp"], actual)
+                    if ok:
+                        passed += 1
+                    else:
+                        failed += 1
+                        ledger[f"{case['name']}#{i}"] = why[:200]
+                        fail_lines.append(
+                            f"FAIL {case['name']} :: {q['name']}\n"
+                            f"  q:   {q['command'][:160]}\n"
+                            f"  why: {why[:400]}"
+                        )
+            finally:
+                srv.close()
+    for line in fail_lines:
+        print(line)
+    print(f"\ntotal={total} passed={passed} failed={failed} skipped={skipped}")
+    if write_ledger:
+        import json
+
+        out = os.path.join(_here, "..", "tests", "parity_xfail.json")
+        with open(out, "w") as f:
+            json.dump(ledger, f, indent=1, sort_keys=True)
+        print(f"wrote {len(ledger)} xfail entries to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
