@@ -151,8 +151,9 @@ bool write_run(const std::string &path, const std::vector<std::string_view> &ite
     if (rename(tmp.c_str(), path.c_str()) != 0) return false;
     // fsync the directory: the caller truncates the WAL right after, so
     // the run's dirent must be durable first or a power loss drops both
-    std::string dir = path.substr(0, path.find_last_of('/'));
-    int dfd = open(dir.empty() ? "." : dir.c_str(), O_RDONLY | O_DIRECTORY);
+    size_t slash = path.find_last_of('/');
+    std::string dir = slash == std::string::npos ? "." : path.substr(0, slash);
+    int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
     if (dfd >= 0) {
         fsync(dfd);
         ::close(dfd);

@@ -457,7 +457,10 @@ class PromEngine:
             return Frame([dict(u) for u in (out_labels_by_key[kk] for kk in uniq)],
                          out, any_valid)
         if op in ("topk", "bottomk"):
-            n = int(_expect_number_node(node.param))
+            nv = _expect_number_node(node.param)
+            if math.isnan(nv) or math.isinf(nv):
+                raise PromError(f"invalid {op} parameter: {_fmt(nv)}")
+            n = int(nv)
             keep = np.zeros_like(f.valid)
             if n > 0:
                 for gi in range(g):
@@ -477,6 +480,8 @@ class PromEngine:
                 sub_valid = f.valid[rows]
                 nvalid = sub_valid.sum(axis=0)  # (K,)
                 has = nvalid > 0
+                if math.isnan(q):
+                    continue  # Prom: NaN phi -> NaN for every group
                 if q < 0 or q > 1:
                     out[gi] = np.where(has, -np.inf if q < 0 else np.inf,
                                        np.nan)
@@ -491,7 +496,12 @@ class PromEngine:
                 cap = len(rows) - 1
                 vlo = srt[np.minimum(lo, cap), cols]
                 vhi = srt[np.minimum(hi, cap), cols]
-                out[gi] = np.where(has, vlo * (1 - w) + vhi * w, np.nan)
+                res = np.where(has, vlo * (1 - w) + vhi * w, np.nan)
+                # a valid NaN sample poisons its column's quantile (the
+                # +Inf padding above would otherwise sort before it and
+                # fabricate +Inf where Prometheus interpolates to NaN)
+                nan_col = (sub_valid & np.isnan(f.values[rows])).any(axis=0)
+                out[gi] = np.where(nan_col, np.nan, res)
             return Frame([dict(u) for u in (out_labels_by_key[kk] for kk in uniq)],
                          out, any_valid)
         if op == "count_values":
@@ -666,15 +676,16 @@ def _topk_keep(values: np.ndarray, valid: np.ndarray, m: int,
     """(R, K) keep-mask of the m largest (descending) / smallest VALID
     entries per column. Exact f64 comparisons, O(R x K) via partition
     (full argsort of a 1M-series group would pay R log R per column);
-    invalid and NaN cells rank below every comparable value — a valid
-    -Inf still beats an invalid cell — and boundary ties resolve to the
-    lowest row index, deterministically."""
+    invalid cells never rank; valid NaN cells rank below every comparable
+    value but still fill leftover room (Prometheus pushes NaN samples
+    while the heap has room); boundary ties resolve to the lowest row
+    index, deterministically."""
     if m <= 0:
         return np.zeros_like(valid)
     keyx = np.where(valid, -values if descending else values, np.nan)
     R = keyx.shape[0]
     if m >= R:
-        return valid & ~np.isnan(keyx)
+        return valid.copy()
     part = np.partition(keyx, m - 1, axis=0)  # NaN sorts last
     b = part[m - 1]  # per-column boundary (m-th best), NaN if < m usable
     strict = keyx < b
@@ -684,7 +695,14 @@ def _topk_keep(values: np.ndarray, valid: np.ndarray, m: int,
     keep = strict | (ties & (tie_rank < need))
     short = np.isnan(b)  # fewer than m comparable cells in the column
     if short.any():
-        keep[:, short] = ~np.isnan(keyx[:, short])
+        keep[:, short] = valid[:, short] & ~np.isnan(values[:, short])
+    # leftover room (columns with < m comparable cells) fills with valid
+    # NaN samples in row order, matching the Prometheus heap
+    room = m - keep.sum(axis=0)
+    if (room > 0).any():
+        nanv = valid & np.isnan(values)
+        nan_rank = np.cumsum(nanv, axis=0) - 1
+        keep |= nanv & (nan_rank < room)
     return keep
 
 
@@ -767,15 +785,10 @@ def _expect_number(node, i) -> float:
 
 
 def _expect_number_node(n) -> float:
-    if isinstance(n, pp.NumberLit):
-        return n.val
-    # the parser desugars unary minus to (-1 * x): fold constant arithmetic
-    if isinstance(n, pp.BinaryOp):
-        lhs, rhs = _expect_number_node(n.lhs), _expect_number_node(n.rhs)
-        folded = _apply_op(n.op, np.float64(lhs), np.float64(rhs),
-                           comparison_keep=False)
-        return float(folded)
-    raise PromError("expected a number parameter")
+    v = _const_fold(n) if n is not None else None
+    if v is None:
+        raise PromError("expected a number parameter")
+    return v
 
 
 def _fmt(v: float) -> str:

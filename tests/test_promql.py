@@ -606,3 +606,35 @@ class TestCountValuesAndRank:
         assert len(data["result"]) == n
         assert all(float(r["value"][1]) == 1.0 for r in data["result"])
         assert dt < 5.0, dt
+
+    def test_topk_quantile_nan_inf_params(self, prom_env):
+        """Folded NaN/Inf parameters must fail cleanly (PromError), not
+        leak IndexError/OverflowError; NaN phi yields NaN results."""
+        from opengemini_tpu.promql.engine import PromError
+        e, pe = prom_env
+        self._write(e, {"a": [1], "b": [2]})
+        for q in ("topk(1/0, gauge_metric)", "topk(0/0, gauge_metric)",
+                  "bottomk(-1/0, gauge_metric)"):
+            with pytest.raises(PromError):
+                pe.query_instant(q, BASE + 1, "prom")
+        # quantile with NaN phi: every group is NaN, no crash
+        data = pe.query_instant("quantile(0/0, gauge_metric)", BASE + 1, "prom")
+        assert all(r["value"][1] == "NaN" for r in data["result"])
+
+    def test_topk_keeps_nan_samples_when_room(self, prom_env):
+        """Prometheus pushes NaN samples while the heap has room: topk(3)
+        over [1, NaN] returns both series; topk(1) prefers the number."""
+        e, pe = prom_env
+        self._write(e, {"a": [1], "b": ["NaN"]})
+        data = pe.query_instant("topk(3, gauge_metric)", BASE + 1, "prom")
+        assert sorted(r["metric"]["instance"] for r in data["result"]) == ["a", "b"]
+        data = pe.query_instant("topk(1, gauge_metric)", BASE + 1, "prom")
+        assert [r["metric"]["instance"] for r in data["result"]] == ["a"]
+
+    def test_quantile_nan_sample_poisons_group(self, prom_env):
+        """A valid NaN sample in a group yields NaN (the +Inf invalid-cell
+        padding must not surface as the quantile)."""
+        e, pe = prom_env
+        self._write(e, {"a": [1], "b": [3], "c": ["NaN"]})
+        data = pe.query_instant("quantile(0.9, gauge_metric)", BASE + 1, "prom")
+        assert [r["value"][1] for r in data["result"]] == ["NaN"]
