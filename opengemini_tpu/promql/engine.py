@@ -475,13 +475,14 @@ class PromEngine:
             # interpolation at rank q*(n_valid-1) per step column
             q = float(_expect_number_node(node.param))
             out = np.full((g, k), np.nan)
+            if math.isnan(q):  # Prom: NaN phi -> NaN for every group
+                return Frame([dict(u) for u in (out_labels_by_key[kk] for kk in uniq)],
+                             out, any_valid)
             for gi in range(g):
                 rows = np.flatnonzero(member[gi])
                 sub_valid = f.valid[rows]
                 nvalid = sub_valid.sum(axis=0)  # (K,)
                 has = nvalid > 0
-                if math.isnan(q):
-                    continue  # Prom: NaN phi -> NaN for every group
                 if q < 0 or q > 1:
                     out[gi] = np.where(has, -np.inf if q < 0 else np.inf,
                                        np.nan)

@@ -67,7 +67,7 @@ class ScanContext:
 
 
 # host calls safe on string columns (python-object values end-to-end)
-_STRING_OK_HOST = {"count", "count_distinct", "mode", "first", "last", "distinct"}
+_STRING_OK_HOST = {"count", "count_distinct", "mode", "first", "last", "distinct", "elapsed"}
 
 
 def pick_batch(schema, agg_names, field: str, dtype):
@@ -123,6 +123,7 @@ _READONLY_STMTS = (
     ast.ShowGrants,
     ast.ShowMeasurementCardinality,
     ast.ShowSeriesCardinality,
+    ast.ShowSeriesExactCardinality,
     ast.ShowShards,
     ast.ShowStats,
     ast.ShowDiagnostics,
@@ -426,8 +427,8 @@ class Executor:
             stmt,
             (ast.ShowMeasurements, ast.ShowTagKeys, ast.ShowTagValues,
              ast.ShowFieldKeys, ast.ShowSeries, ast.ShowRetentionPolicies,
-             ast.ShowContinuousQueries,
-             ast.ShowMeasurementCardinality, ast.ShowSeriesCardinality),
+             ast.ShowContinuousQueries, ast.ShowMeasurementCardinality,
+             ast.ShowSeriesCardinality, ast.ShowSeriesExactCardinality),
         ):
             if user.can("READ", getattr(stmt, "database", "") or db):
                 return
@@ -473,6 +474,11 @@ class Executor:
             return self._show_field_keys(stmt, db)
         if isinstance(stmt, ast.ShowSeries):
             return self._show_series(stmt, db)
+        if isinstance(stmt, ast.ShowSeriesExactCardinality):
+            return self._show_series_exact_cardinality(stmt, db)
+        if isinstance(stmt, ast.CreateMeasurement):
+            # schema-on-write engine: accept and record nothing (see parser)
+            return {}
         if isinstance(stmt, ast.ShowRetentionPolicies):
             return self._show_rps(stmt, db)
         if isinstance(stmt, ast.CreateDatabase):
@@ -737,11 +743,21 @@ class Executor:
         if isinstance(stmt, ast.ShowSeriesCardinality):
             from opengemini_tpu.ingest.line_protocol import series_key
 
-            keys: set[str] = set()
+            # one row per shard-group time range (reference output shape:
+            # startTime/endTime/count, coordinator show-executor)
+            by_range: dict[tuple[int, int], set] = {}
             for sh in self._all_shards_db(stmt.database or db):
+                bucket = by_range.setdefault((sh.tmin, sh.tmax), set())
                 for m, tags in sh.index.iter_series_entries():
-                    keys.add(series_key(m, tags))
-            return _series_result("", None, ["count"], [[len(keys)]])
+                    bucket.add(series_key(m, tags))
+            rows = [
+                [cond.format_rfc3339(lo), cond.format_rfc3339(hi), len(keys)]
+                for (lo, hi), keys in sorted(by_range.items())
+                if keys
+            ]
+            if not rows:
+                return {}
+            return _series_result("", None, ["startTime", "endTime", "count"], rows)
         raise QueryError(f"unsupported statement: {type(stmt).__name__}")
 
     def _delete(self, stmt, db: str, now_ns: int) -> dict:
@@ -1001,7 +1017,17 @@ class Executor:
             names.update(m for m in remote if rx.search(m))
         return sorted(names)
 
+    def _measurement_schema(self, db, rp, mst) -> dict:
+        schema: dict = {}
+        for sh in self.engine.shards_for_range(db, rp, cond.MIN_TIME, cond.MAX_TIME):
+            schema.update(sh.schema(mst))
+        return schema
+
     def _select_measurement(self, stmt, db, rp, mst, now_ns, trace=tracing.NOOP) -> list[dict]:
+        if _has_call_wildcard(stmt):
+            stmt = _expand_call_wildcards(
+                stmt, self._measurement_schema(db, rp, mst)
+            )
         # percentile_approx: answered from chunk histogram sketches
         if len(stmt.fields) == 1:
             only = _strip_expr(stmt.fields[0].expr)
@@ -1009,7 +1035,16 @@ class Executor:
                 return self._select_percentile_approx(
                     stmt, db, rp, mst, now_ns, only
                 )
+        aux_plan = _selector_aux_plan(stmt)
+        if aux_plan is not None:
+            return self._select_selector_aux(stmt, db, rp, mst, now_ns, aux_plan)
         kind = _classify_select(stmt)
+        if kind == "device" and _needs_string_host_path(
+            stmt, lambda: self._measurement_schema(db, rp, mst)
+        ):
+            # first/last/etc on STRING fields: the device batch layout is
+            # numeric; the host path computes them exactly
+            kind = "host"
         if kind == "raw":
             return self._select_raw(stmt, db, rp, mst, now_ns)
         if kind == "device":
@@ -1077,31 +1112,43 @@ class Executor:
             schema.update(sh.schema(mst))
         sc = cond.split(stmt.condition, tag_keys, now_ns)
         tmin, tmax = sc.tmin, sc.tmax
+        explicit_tmin = tmin != cond.MIN_TIME
+        explicit_tmax = tmax != cond.MAX_TIME
         shards = [sh for sh in shards_all if sh.tmax > tmin and sh.tmin < tmax]
         if not shards:
             return None
         # data-driven clamp of an unbounded range (influx uses epoch 0/now)
-        if tmin == cond.MIN_TIME or tmax == cond.MAX_TIME:
+        if not explicit_tmin or not explicit_tmax:
             dmin, dmax = _data_time_range(shards, mst)
             if dmin is None:
                 return None
-            if tmin == cond.MIN_TIME:
+            if not explicit_tmin:
                 tmin = dmin
-            if tmax == cond.MAX_TIME:
+            if not explicit_tmax:
                 tmax = dmax + 1
         if tmax <= tmin:
             return None
         group_time = stmt.group_by_time
         if group_time:
             aligned = int(winmod.window_start(tmin, group_time.every_ns, group_time.offset_ns))
-            W = winmod.num_windows(tmin, tmax, group_time.every_ns, group_time.offset_ns)
+            every = group_time.every_ns
+            if not explicit_tmax and stmt.limit and stmt.ascending:
+                # unbounded upper + LIMIT: the reference iterates windows
+                # to now(); emitting exactly offset+limit windows from the
+                # data start is equivalent and bounded
+                want = stmt.offset + stmt.limit
+                tmax = max(tmax, min(now_ns, aligned + want * every))
+            W = winmod.num_windows(tmin, tmax, every, group_time.offset_ns)
             if W > MAX_SELECT_BUCKETS:
                 raise QueryError(
-                    f"GROUP BY time({group_time.every_ns}ns) would create {W} buckets "
+                    f"GROUP BY time({every}ns) would create {W} buckets "
                     f"(max {MAX_SELECT_BUCKETS})"
                 )
         else:
-            aligned = tmin if tmin > cond.MIN_TIME else 0
+            # output timestamp of whole-range aggregates: the explicit WHERE
+            # lower bound, else epoch 0 (influx semantics; the data-driven
+            # clamp above must not leak into result rows)
+            aligned = tmin if explicit_tmin else 0
             W = 1
         group_tags = self._group_tags(stmt, shards, mst)
         # ordered group keys + per-(shard, sid) membership
@@ -1154,6 +1201,7 @@ class Executor:
             # keep the raw column-exchange path
             and getattr(self.router, "has_peers", lambda: False)()
             and all(spec.name in pmod.MERGEABLE for _c, spec, _p, _f in aggs)
+            and not any(f.lower() == "time" for _c, _s, _p, f in aggs)
         )
         attempts = max(self.router.rf, 1) if pushdown else 1
         for attempt in range(attempts):
@@ -1198,9 +1246,19 @@ class Executor:
         num_groups = len(group_keys)
         num_segments = num_groups * W
 
+        # aggregates over the `time` pseudo-field (count/first/last/min/max
+        # of row timestamps) are computed host-side from scanned row times
+        time_aggs = [a for a in aggs if a[3].lower() == "time"]
+        for _c, spec, _p, _f in time_aggs:
+            if spec.name not in ("count", "first", "last", "min", "max"):
+                raise QueryError(f"{spec.name}(time) is not supported")
+        aggs = [a for a in aggs if a[3].lower() != "time"]
+
         needed_fields = sorted({a[3] for a in aggs})
         field_filter_fields = sorted(cond.field_filter_refs(sc.field_expr)) if sc.field_expr else []
         read_fields = sorted(set(needed_fields) | set(field_filter_fields))
+        if time_aggs and not read_fields:
+            read_fields = None  # time-only aggregates: read every field
 
         dtype = templates.compute_dtype()
         per_field_aggs: dict[str, list] = {}
@@ -1231,6 +1289,7 @@ class Executor:
         # cannot overlap (no memtable rows in range, non-overlapping chunks).
         pre_eligible = (
             not group_time
+            and not time_aggs
             and sc.field_expr is None
             and all(spec.name in ("count", "sum", "mean") for _c, spec, _p, _f in aggs)
             # remote proxies carry no chunk metadata: full decode for them
@@ -1253,6 +1312,8 @@ class Executor:
         pre_used = False
 
         rows_scanned = 0
+        time_segs: list[np.ndarray] = []
+        time_vals: list[np.ndarray] = []
         with trace.span("scan") as scan_span:
             for sh, sid, gid in scan_plan:
                 TRACKER.check()  # KILL QUERY cancellation point
@@ -1281,6 +1342,10 @@ class Executor:
                     seg = (gid * W + widx.astype(np.int64)).astype(np.int32)
                 else:
                     seg = np.full(len(rec), gid, dtype=np.int32)
+                if time_aggs:
+                    m = fmask if fmask is not None else slice(None)
+                    time_segs.append(seg[m])
+                    time_vals.append(rec.times[m])
                 _add_record_to_batches(
                     rec, seg, aligned, needed_fields, batches, dtype, fmask
                 )
@@ -1308,6 +1373,29 @@ class Executor:
                         out = (dev_sum + ps) / np.maximum(total_c, 1)
                     counts = counts + pc.astype(counts.dtype)
                 agg_results[id(call)] = (out, sel, counts, spec, field_name, None)
+            if time_aggs:
+                import dataclasses as _dc
+
+                seg_all = (
+                    np.concatenate(time_segs) if time_segs
+                    else np.empty(0, np.int32)
+                )
+                t_all = (
+                    np.concatenate(time_vals) if time_vals
+                    else np.empty(0, np.int64)
+                )
+                tcounts = np.bincount(seg_all, minlength=num_segments).astype(np.int64)
+            for call, spec, _params, _f in time_aggs:
+                if spec.name == "count":
+                    tout = tcounts
+                elif spec.name in ("last", "max"):
+                    tout = np.full(num_segments, np.iinfo(np.int64).min, np.int64)
+                    np.maximum.at(tout, seg_all, t_all)
+                else:  # first/min
+                    tout = np.full(num_segments, np.iinfo(np.int64).max, np.int64)
+                    np.minimum.at(tout, seg_all, t_all)
+                spec2 = _dc.replace(spec, int_output=True)
+                agg_results[id(call)] = (tout, None, tcounts, spec2, "time", tout)
             sp.add_field("aggregates", len(aggs))
             sp.add_field("segments", num_segments)
             sp.add_field(
@@ -1416,6 +1504,9 @@ class Executor:
         col_exprs = []
         used_names: dict[str, int] = {}
         for f in stmt.fields:
+            e = _strip_expr(f.expr)
+            if isinstance(e, ast.VarRef) and e.name.lower() == "time":
+                continue  # explicit `time` is always column 0
             name = f.alias or _default_field_name(f.expr)
             k = used_names.get(name, 0)
             used_names[name] = k + 1
@@ -1580,6 +1671,187 @@ class Executor:
             series = {"name": mst, "columns": ["time", name], "values": rows}
             if ctx.group_tags:
                 series["tags"] = dict(zip(ctx.group_tags, ctx.group_keys[g]))
+            out_series.append(series)
+        return out_series
+
+    # -- selector + auxiliary columns (host path) ----------------------------
+
+    def _select_selector_aux(self, stmt, db, rp, mst, now_ns, plan) -> list[dict]:
+        """One selector call + bare/arithmetic auxiliary columns: the
+        selector picks rows, aux columns are read from the selected rows
+        (reference: aux fields in the cursor iterators, call iterator
+        top/bottom transforms).  time = the selected point's timestamp,
+        except 1-row selectors under GROUP BY time, which emit the window
+        start (matching the reference's output tables)."""
+        sel_call, aux_fields = plan
+        sel_name = sel_call.name
+        sel_field = _strip_expr(sel_call.args[0]).name
+        n_rows = 1
+        if sel_name in ("top", "bottom"):
+            if len(sel_call.args) != 2:
+                raise QueryError(f"{sel_name}() takes (field, N)")
+            n_rows = int(_call_param_value(sel_call.args[1]))
+            if n_rows <= 0:
+                raise QueryError(f"{sel_name}() N must be positive")
+        pctl = None
+        if sel_name == "percentile":
+            if len(sel_call.args) != 2:
+                raise QueryError("percentile() takes (field, p)")
+            pctl = float(_call_param_value(sel_call.args[1]))
+
+        ctx = self._scan_context(stmt, db, rp, mst, now_ns)
+        if ctx is None:
+            return []
+        sc, schema = ctx.sc, ctx.schema
+        tmin, tmax = ctx.tmin, ctx.tmax
+        group_time, aligned, W = ctx.group_time, ctx.aligned, ctx.W
+        every = group_time.every_ns if group_time else 0
+
+        if (schema.get(sel_field) == FieldType.STRING
+                and sel_name not in ("first", "last")):
+            raise QueryError(
+                f"{sel_name}() is not supported on string field {sel_field!r}")
+
+        # output columns: drop explicit bare `time` refs (always col 0)
+        columns = ["time"]
+        col_plans = []  # ("sel",) | ("aux", expr)
+        used_names: dict[str, int] = {}
+        for f in stmt.fields:
+            e = _strip_expr(f.expr)
+            if isinstance(e, ast.VarRef) and e.name.lower() == "time":
+                continue
+            name = f.alias or _default_field_name(e)
+            k = used_names.get(name, 0)
+            used_names[name] = k + 1
+            if k:
+                name = f"{name}_{k}"
+            columns.append(name)
+            if isinstance(e, ast.Call):
+                col_plans.append(("sel",))
+            else:
+                col_plans.append(("aux", e))
+
+        aux_field_names = [n for n in aux_fields if n in schema]
+        read_fields = sorted({sel_field, *aux_field_names} | (
+            set(cond.field_filter_refs(sc.field_expr)) if sc.field_expr else set()
+        ))
+
+        groups: dict[int, list] = {}
+        for sh, sid, gid in ctx.scan_plan:
+            groups.setdefault(gid, []).append((sh, sid))
+
+        out_series = []
+        for gid in sorted(groups, key=lambda g: ctx.group_keys[g]):
+            key = ctx.group_keys[gid]
+            # gather rows of every member series: time, selector value,
+            # aux field columns, per-row tag values
+            t_list, v_list = [], []
+            aux_cols: dict[str, list] = {n: [] for n in aux_field_names}
+            aux_valid: dict[str, list] = {n: [] for n in aux_field_names}
+            tag_cols: dict[str, list] = {}
+            tag_names = {
+                n for n in aux_fields if n not in schema
+            }
+            for n in tag_names:
+                tag_cols[n] = []
+            for sh, sid in groups[gid]:
+                TRACKER.check()
+                rec = sh.read_series(mst, sid, tmin, tmax, fields=read_fields)
+                col = rec.columns.get(sel_field)
+                if col is None or len(rec) == 0:
+                    continue
+                m = col.valid.copy()
+                if sc.field_expr is not None:
+                    m &= cond.eval_field_expr(sc.field_expr, rec)
+                if not m.any():
+                    continue
+                t_list.append(rec.times[m])
+                v_list.append(col.values[m])
+                nsel = int(m.sum())
+                for n in aux_field_names:
+                    ac = rec.columns.get(n)
+                    if ac is None:
+                        aux_cols[n].append(np.full(nsel, np.nan))
+                        aux_valid[n].append(np.zeros(nsel, bool))
+                    else:
+                        aux_cols[n].append(np.asarray(ac.values)[m])
+                        aux_valid[n].append(np.asarray(ac.valid)[m])
+                _, tags = sh.index.series_entry(sid)
+                tagd = dict(tags)
+                for n in tag_names:
+                    tag_cols[n].append([tagd.get(n)] * nsel)
+            if not t_list:
+                continue
+            t = np.concatenate(t_list)
+            v = np.concatenate(v_list)
+            order = np.argsort(t, kind="stable")
+            t, v = t[order], v[order]
+            aux_arr = {
+                n: (np.concatenate(aux_cols[n])[order],
+                    np.concatenate(aux_valid[n])[order])
+                for n in aux_field_names
+            }
+            tag_arr = {
+                n: [x for chunk in tag_cols[n] for x in chunk]
+                for n in tag_names
+            }
+            for n, vals in tag_arr.items():
+                tag_arr[n] = [vals[i] for i in order]
+
+            if group_time:
+                bounds = np.searchsorted(
+                    t, [aligned + w * every for w in range(W + 1)]
+                )
+                windows = [
+                    (aligned + w * every, slice(bounds[w], bounds[w + 1]))
+                    for w in range(W)
+                ]
+            else:
+                windows = [(aligned, slice(None))]
+
+            rows = []
+            for t_out, sl in windows:
+                tw, vw = t[sl], v[sl]
+                base = sl.start or 0
+                if len(vw) == 0:
+                    if n_rows == 1 and sel_name not in ("top", "bottom"):
+                        rows.append((t_out, [None] * (len(columns) - 1), False))
+                    continue
+                idxs = _selector_pick(sel_name, tw, vw, n_rows, pctl)
+                for i in idxs:
+                    ri = base + int(i)
+                    vals = []
+                    for cp in col_plans:
+                        if cp[0] == "sel":
+                            vals.append(_render_cell(
+                                v[ri], schema.get(sel_field), sel_name))
+                        else:
+                            vals.append(_eval_aux_expr(
+                                cp[1], ri, aux_arr, tag_arr, schema))
+                    t_row = (
+                        t_out
+                        if (group_time and n_rows == 1
+                            and sel_name not in ("top", "bottom"))
+                        else int(t[ri])
+                    )
+                    rows.append((t_row, vals, True))
+            if n_rows == 1 and sel_name not in ("top", "bottom"):
+                rows = _apply_fill(rows, stmt, columns)
+            if not stmt.ascending:
+                rows.reverse()
+            if stmt.offset:
+                rows = rows[stmt.offset:]
+            if stmt.limit:
+                rows = rows[: stmt.limit]
+            if not rows:
+                continue
+            series = {
+                "name": mst,
+                "columns": columns,
+                "values": [[tr] + vv for tr, vv, _p in rows],
+            }
+            if ctx.group_tags:
+                series["tags"] = dict(zip(ctx.group_tags, key))
             out_series.append(series)
         return out_series
 
@@ -1904,14 +2176,45 @@ class Executor:
             return {}
         return _series_result("measurements", None, ["name"], [[n] for n in sorted(names)])
 
+    @staticmethod
+    def _mst_match(stmt, mst: str) -> bool:
+        if stmt.measurement:
+            return mst == stmt.measurement
+        if getattr(stmt, "measurement_regex", ""):
+            return re.search(stmt.measurement_regex, mst) is not None
+        return True
+
+    @staticmethod
+    def _matching_sids(sh, mst: str, condition) -> set[int]:
+        """Series of `mst` in shard `sh` matching the tag predicates of
+        `condition`.  Time predicates are ignored (SHOW metadata statements
+        filter series, not points); predicates on keys that are not tags of
+        the measurement match NOTHING — `WHERE value = 'x'` over series
+        metadata is vacuously false, matching the reference's behavior
+        (coordinator show-executor tag-filter rewrite)."""
+        sids = sh.index.series_ids(mst)
+        if condition is not None:
+            tag_keys = set(sh.index.tag_keys(mst))
+            sc = cond.split(condition, tag_keys, 0)
+            if sc.field_expr is not None:
+                return set()
+            if sc.tag_expr is not None:
+                sids = sids & cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
+        return sids
+
     def _show_tag_keys(self, stmt, db) -> dict:
         db = stmt.database or db
         per_mst: dict[str, set] = {}
         for sh in self._all_shards_db(db):
             for mst in sh.measurements():
-                if stmt.measurement and mst != stmt.measurement:
+                if not self._mst_match(stmt, mst):
                     continue
-                per_mst.setdefault(mst, set()).update(sh.index.tag_keys(mst))
+                if stmt.condition is not None:
+                    for sid in self._matching_sids(sh, mst, stmt.condition):
+                        _, tags = sh.index.series_entry(sid)
+                        per_mst.setdefault(mst, set()).update(k for k, _ in tags)
+                else:
+                    per_mst.setdefault(mst, set()).update(sh.index.tag_keys(mst))
         series = [
             _series(m, None, ["tagKey"], [[k] for k in sorted(keys)])
             for m, keys in sorted(per_mst.items())
@@ -1921,18 +2224,41 @@ class Executor:
 
     def _show_tag_values(self, stmt, db) -> dict:
         db = stmt.database or db
-        per_mst: dict[str, list] = {}
+        key_rx = re.compile(stmt.key_regex) if stmt.key_regex else None
+        per_mst: dict[str, set] = {}
         for sh in self._all_shards_db(db):
             for mst in sh.measurements():
-                if stmt.measurement and mst != stmt.measurement:
+                if not self._mst_match(stmt, mst):
                     continue
-                for key in stmt.keys:
-                    for v in sh.index.tag_values(mst, key):
-                        per_mst.setdefault(mst, []).append((key, v))
+                wanted = [
+                    k for k in sh.index.tag_keys(mst)
+                    if (k in stmt.keys) or (key_rx is not None and key_rx.search(k))
+                ]
+                if not wanted:
+                    continue
+                if stmt.condition is None:
+                    # no series filter: direct inverted-index lookup, never
+                    # an O(series) walk (1M-series measurements)
+                    bucket = per_mst.setdefault(mst, set())
+                    for k in wanted:
+                        for v in sh.index.tag_values(mst, k):
+                            bucket.add((k, v))
+                    continue
+                for sid in self._matching_sids(sh, mst, stmt.condition):
+                    _, tags = sh.index.series_entry(sid)
+                    for k, v in tags:
+                        if k in wanted:
+                            per_mst.setdefault(mst, set()).add((k, v))
         series = []
         for mst, pairs in sorted(per_mst.items()):
-            uniq = sorted(set(pairs))
-            series.append(_series(mst, None, ["key", "value"], [list(p) for p in uniq]))
+            uniq = sorted(pairs, reverse=stmt.order_desc)
+            if stmt.offset:
+                uniq = uniq[stmt.offset:]
+            if stmt.limit:
+                uniq = uniq[:stmt.limit]
+            if uniq:
+                series.append(
+                    _series(mst, None, ["key", "value"], [list(p) for p in uniq]))
         return {"series": series} if series else {}
 
     def _show_field_keys(self, stmt, db) -> dict:
@@ -1940,7 +2266,7 @@ class Executor:
         per_mst: dict[str, dict] = {}
         for sh in self._all_shards_db(db):
             for mst in sh.measurements():
-                if stmt.measurement and mst != stmt.measurement:
+                if not self._mst_match(stmt, mst):
                     continue
                 per_mst.setdefault(mst, {}).update(sh.schema(mst))
         type_names = {
@@ -1962,19 +2288,36 @@ class Executor:
         keys: set[str] = set()
         for sh in self._all_shards_db(db):
             for mst in sh.measurements():
-                if stmt.measurement and mst != stmt.measurement:
+                if not self._mst_match(stmt, mst):
                     continue
-                sids = sh.index.series_ids(mst)
-                if stmt.condition is not None:
-                    tag_keys = set(sh.index.tag_keys(mst))
-                    sc = cond.split(stmt.condition, tag_keys, 0)
-                    sids &= cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
-                for sid in sids:
+                for sid in self._matching_sids(sh, mst, stmt.condition):
                     m, tags = sh.index.series_entry(sid)
                     keys.add(series_key(m, tags))
         if not keys:
             return {}
         return _series_result("", None, ["key"], [[k] for k in sorted(keys)])
+
+    def _show_series_exact_cardinality(self, stmt, db) -> dict:
+        """Per-measurement exact distinct-series count (reference:
+        ShowSeriesCardinalityStatement with EXACT, executor.go)."""
+        from opengemini_tpu.ingest.line_protocol import series_key
+
+        db = stmt.database or db
+        per_mst: dict[str, set] = {}
+        for sh in self._all_shards_db(db):
+            for mst in sh.measurements():
+                if not self._mst_match(stmt, mst):
+                    continue
+                bucket = per_mst.setdefault(mst, set())
+                for sid in self._matching_sids(sh, mst, stmt.condition):
+                    m, tags = sh.index.series_entry(sid)
+                    bucket.add(series_key(m, tags))
+        series = [
+            _series(m, None, ["count"], [[len(keys)]])
+            for m, keys in sorted(per_mst.items())
+            if keys
+        ]
+        return {"series": series} if series else {}
 
     def _show_rps(self, stmt, db) -> dict:
         db = stmt.database or db
@@ -2113,6 +2456,190 @@ def _calls_in(e) -> list[ast.Call]:
     return []
 
 
+# wildcard-in-call expansion: these functions expand `f(*)` over numeric
+# fields only (math is meaningless on strings/bools); everything else
+# expands over every field (reference: influxql RewriteFields)
+_NUMERIC_ONLY_WILDCARD = {
+    "difference", "non_negative_difference", "derivative",
+    "non_negative_derivative", "moving_average", "cumulative_sum", "sum",
+    "mean", "median", "stddev", "spread", "percentile", "integral",
+    "max", "min", "top", "bottom", "sample",
+}
+
+
+def _has_call_wildcard(stmt) -> bool:
+    for f in stmt.fields:
+        e = _strip_expr(f.expr)
+        if (isinstance(e, ast.Call) and e.args
+                and isinstance(_strip_expr(e.args[0]), ast.Wildcard)):
+            return True
+    return False
+
+
+def _expand_call_wildcards(stmt, schema):
+    """Rewrite `SELECT f(*) ...` into one call per matching field, each
+    aliased `f_<field>` (reference: influxql.RewriteFields wildcard
+    expansion)."""
+    import copy
+
+    new_fields = []
+    for f in stmt.fields:
+        e = _strip_expr(f.expr)
+        if not (isinstance(e, ast.Call) and e.args
+                and isinstance(_strip_expr(e.args[0]), ast.Wildcard)):
+            new_fields.append(f)
+            continue
+        base = _default_field_name(e)
+        numeric_only = e.name in _NUMERIC_ONLY_WILDCARD
+        for fld in sorted(schema):
+            if numeric_only and schema[fld] not in (FieldType.FLOAT, FieldType.INT):
+                continue
+            call = ast.Call(e.name, (ast.VarRef(fld),) + tuple(e.args[1:]))
+            new_fields.append(ast.Field(call, alias=f"{base}_{fld}"))
+    out = copy.copy(stmt)
+    out.fields = new_fields
+    return out
+
+
+def _needs_string_host_path(stmt, schema_fn) -> bool:
+    """schema_fn is called lazily — the shard-schema sweep only runs when a
+    call could actually involve a string field."""
+    candidates = []
+    for call in _collect_calls(stmt.fields):
+        if not call.args or call.name not in _STRING_OK_HOST or call.name == "count":
+            continue
+        a = _strip_expr(call.args[0])
+        if isinstance(a, ast.VarRef):
+            candidates.append(a.name)
+    if not candidates:
+        return False
+    schema = schema_fn()
+    return any(schema.get(n) == FieldType.STRING for n in candidates)
+
+
+_AUX_SELECTORS = {"first", "last", "max", "min", "top", "bottom", "percentile"}
+
+
+def _selector_aux_plan(stmt: ast.SelectStatement):
+    """Detect `SELECT <selector>(f, ...), aux...`: exactly one call, a
+    selector, with at least one auxiliary (non-call, non-`time`) column.
+    Returns (call, aux_field_names) or None."""
+    calls = _collect_calls(stmt.fields)
+    if len(calls) != 1 or calls[0].name not in _AUX_SELECTORS:
+        return None
+    call = calls[0]
+    if not call.args or not isinstance(_strip_expr(call.args[0]), ast.VarRef):
+        return None
+    aux_names: list[str] = []
+    has_aux = False
+    for f in stmt.fields:
+        e = _strip_expr(f.expr)
+        if isinstance(e, ast.Call):
+            continue
+        if isinstance(e, ast.VarRef) and e.name.lower() == "time":
+            continue
+        refs = _collect_varrefs(e)
+        if refs is None:
+            return None  # something we cannot evaluate per-row
+        aux_names.extend(refs)
+        has_aux = True
+    if not has_aux:
+        return None
+    return call, sorted(set(aux_names))
+
+
+def _collect_varrefs(e) -> list[str] | None:
+    """Field/tag names referenced by a per-row arithmetic expr, or None
+    if the expr contains anything other than refs/literals/arithmetic."""
+    e = _strip_expr(e)
+    if isinstance(e, ast.VarRef):
+        return [e.name]
+    if isinstance(e, (ast.NumberLiteral, ast.IntegerLiteral)):
+        return []
+    if isinstance(e, ast.UnaryExpr):
+        return _collect_varrefs(e.expr)
+    if isinstance(e, ast.BinaryExpr):
+        l, r = _collect_varrefs(e.lhs), _collect_varrefs(e.rhs)
+        if l is None or r is None:
+            return None
+        return l + r
+    return None
+
+
+def _selector_pick(sel_name: str, tw, vw, n_rows: int, pctl) -> list[int]:
+    """Row indices (into the window slice) a selector picks; output order
+    is time-ascending for multi-row selectors."""
+    if sel_name == "first":
+        return [0]
+    if sel_name == "last":
+        return [len(vw) - 1]
+    if sel_name == "max":
+        return [int(np.argmax(vw))]
+    if sel_name == "min":
+        return [int(np.argmin(vw))]
+    if sel_name == "percentile":
+        order = np.argsort(vw, kind="stable")
+        i = int(math.floor(len(vw) * pctl / 100.0 + 0.5)) - 1
+        if i < 0 or i >= len(vw):
+            return []
+        return [int(order[i])]
+    # top/bottom: n best by value (ties -> earliest), output time-ascending
+    keys = -vw if sel_name == "top" else vw
+    order = np.lexsort((np.arange(len(vw)), keys))[:n_rows]
+    return sorted(int(i) for i in order)
+
+
+def _render_cell(v, ftype, call_name: str):
+    if ftype == FieldType.STRING:
+        return None if v is None else str(v)
+    if ftype == FieldType.INT:
+        return int(v)
+    if ftype == FieldType.BOOL:
+        return bool(round(float(v)))
+    fv = float(v)
+    if math.isnan(fv) or math.isinf(fv):
+        return None
+    return fv
+
+
+def _eval_aux_expr(e, ri: int, aux_arr, tag_arr, schema):
+    """Evaluate one auxiliary column at selected row `ri`."""
+    e = _strip_expr(e)
+    if isinstance(e, ast.VarRef):
+        if e.name in aux_arr:
+            vals, valid = aux_arr[e.name]
+            if not valid[ri]:
+                return None
+            return _render_cell(vals[ri], schema.get(e.name), "aux")
+        if e.name in tag_arr:
+            return tag_arr[e.name][ri]
+        return None
+    if isinstance(e, (ast.NumberLiteral, ast.IntegerLiteral)):
+        return e.val
+    if isinstance(e, ast.UnaryExpr) and e.op == "-":
+        v = _eval_aux_expr(e.expr, ri, aux_arr, tag_arr, schema)
+        return None if v is None else -v
+    if isinstance(e, ast.BinaryExpr):
+        lv = _eval_aux_expr(e.lhs, ri, aux_arr, tag_arr, schema)
+        rv = _eval_aux_expr(e.rhs, ri, aux_arr, tag_arr, schema)
+        if lv is None or rv is None or isinstance(lv, str) or isinstance(rv, str):
+            return None
+        try:
+            if e.op == "+":
+                return lv + rv
+            if e.op == "-":
+                return lv - rv
+            if e.op == "*":
+                return lv * rv
+            if e.op == "/":
+                return lv / rv if rv != 0 else None
+            if e.op == "%":
+                return lv % rv if rv != 0 else None
+        except TypeError:
+            return None
+    raise QueryError(f"unsupported auxiliary expression: {e}")
+
+
 def _classify_select(stmt: ast.SelectStatement) -> str:
     """'raw' | 'device' | 'host' — the single source of truth for which
     execution path a SELECT takes (used by execution AND EXPLAIN)."""
@@ -2164,7 +2691,14 @@ def _resolve_host_call(call: ast.Call, group_time):
         if not call.args:
             raise QueryError(f"{name}() requires an argument")
         inner_e = _strip_expr(call.args[0])
-        params = tuple(_call_param_value(a) for a in call.args[1:])
+        if name == "difference":
+            # difference(f[, 'front'|'behind'|'absolute'])
+            params = tuple(_call_param_any(a) for a in call.args[1:])
+            if params and params[0] not in ("front", "behind", "absolute"):
+                raise QueryError(
+                    "difference() mode must be 'front', 'behind' or 'absolute'")
+        else:
+            params = tuple(_call_param_value(a) for a in call.args[1:])
         _check_host_arity(name, params)
         if isinstance(inner_e, ast.Call):
             if group_time is None:
@@ -2231,7 +2765,7 @@ _HOST_ARITY = {
     "detect": (0, 2),
     "holt_winters": (1, 2),
     "holt_winters_with_fit": (1, 2),
-    "difference": (0, 0),
+    "difference": (0, 1),
     "non_negative_difference": (0, 0),
     "cumulative_sum": (0, 0),
 }

@@ -66,7 +66,12 @@ def transform(name: str, times: np.ndarray, values: np.ndarray, params: tuple):
     if name in ("difference", "non_negative_difference"):
         if len(times) < 2:
             return times[:0], values[:0]
-        out = np.diff(values)
+        out = np.diff(values)  # 'behind' (default): v[i] - v[i-1]
+        mode = params[0] if params and isinstance(params[0], str) else "behind"
+        if mode == "front":
+            out = -out
+        elif mode == "absolute":
+            out = np.abs(out)
         t_out = times[1:]
         if name == "non_negative_difference":
             keep = out >= 0

@@ -173,27 +173,49 @@ class ShowMeasurements:
 class ShowTagKeys:
     database: str = ""
     measurement: str = ""
+    measurement_regex: str = ""
+    condition: object | None = None
 
 
 @dataclass
 class ShowTagValues:
     database: str = ""
     measurement: str = ""
+    measurement_regex: str = ""
     keys: list[str] = field(default_factory=list)
+    key_regex: str = ""
     condition: object | None = None
+    order_desc: bool = False
+    limit: int = 0
+    offset: int = 0
 
 
 @dataclass
 class ShowFieldKeys:
     database: str = ""
     measurement: str = ""
+    measurement_regex: str = ""
 
 
 @dataclass
 class ShowSeries:
     database: str = ""
     measurement: str = ""
+    measurement_regex: str = ""
     condition: object | None = None
+
+
+@dataclass
+class ShowSeriesExactCardinality:
+    database: str = ""
+    measurement: str = ""
+    measurement_regex: str = ""
+    condition: object | None = None
+
+
+@dataclass
+class CreateMeasurement:
+    name: str = ""
 
 
 @dataclass

@@ -76,6 +76,11 @@ class Lexer:
             elif c == "-" and self.text[self.pos : self.pos + 2] == "--":
                 nl = self.text.find("\n", self.pos)
                 self.pos = n if nl < 0 else nl
+            elif c == "/" and self.text[self.pos : self.pos + 2] == "/*":
+                # block comment, incl. optimizer hints /*+ ... */ (parsed
+                # and ignored; reference: influxql scanner + hint pass)
+                end = self.text.find("*/", self.pos + 2)
+                self.pos = n if end < 0 else end + 2
             else:
                 break
 

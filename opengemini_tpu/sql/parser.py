@@ -414,6 +414,28 @@ class Parser:
 
     # -- SHOW ---------------------------------------------------------------
 
+    def _name_or_regex(self) -> tuple[str, str]:
+        """FROM target of a SHOW statement: identifier or /regex/."""
+        tok = self.lex.peek(allow_regex=True)
+        if tok.kind == "REGEX":
+            self.lex.next(allow_regex=True)
+            return "", tok.val
+        return self._ident(), ""
+
+    def _accept_show_order(self, s) -> None:
+        """Trailing `ORDER BY value [ASC|DESC]` on SHOW TAG VALUES
+        (reference: influxql.y showTagValuesStatement sort fields)."""
+        if not self._accept_kw("order"):
+            return
+        self._expect_kw("by")
+        col = self._ident()
+        if col.lower() != "value":
+            raise ParseError("SHOW ... ORDER BY supports only `value`")
+        if self._accept_kw("desc"):
+            s.order_desc = True
+        else:
+            self._accept_kw("asc")
+
     def parse_show(self):
         self._expect_kw("show")
         kw = self.lex.next()
@@ -444,18 +466,25 @@ class Parser:
                 if self._accept_kw("on"):
                     s.database = self._ident()
                 if self._accept_kw("from"):
-                    s.measurement = self._ident()
+                    s.measurement, s.measurement_regex = self._name_or_regex()
+                if self._accept_kw("where"):
+                    s.condition = self._parse_expr()
                 return s
             s = ast.ShowTagValues()
             if self._accept_kw("on"):
                 s.database = self._ident()
             if self._accept_kw("from"):
-                s.measurement = self._ident()
+                s.measurement, s.measurement_regex = self._name_or_regex()
             self._expect_kw("with")
             self._expect_kw("key")
-            tok = self.lex.next()
+            tok = self.lex.next(allow_regex=True)
             if tok.kind == "OP" and tok.val == "=":
                 s.keys = [self._ident()]
+            elif tok.kind == "OP" and tok.val == "=~":
+                rtok = self.lex.next(allow_regex=True)
+                if rtok.kind != "REGEX":
+                    raise ParseError("bad WITH KEY regex")
+                s.key_regex = rtok.val
             elif tok.kind == "KEYWORD" and tok.val == "in":
                 self._expect_op("(")
                 s.keys = [self._ident()]
@@ -466,6 +495,9 @@ class Parser:
                 raise ParseError("bad WITH KEY")
             if self._accept_kw("where"):
                 s.condition = self._parse_expr()
+            self._accept_show_order(s)
+            s.limit = self._parse_int_clause("limit")
+            s.offset = self._parse_int_clause("offset")
             return s
         if kw.val == "field":
             self._expect_kw("keys")
@@ -473,7 +505,7 @@ class Parser:
             if self._accept_kw("on"):
                 s.database = self._ident()
             if self._accept_kw("from"):
-                s.measurement = self._ident()
+                s.measurement, s.measurement_regex = self._name_or_regex()
             return s
         if kw.val == "measurement":
             self._expect_kw("cardinality")
@@ -482,6 +514,16 @@ class Parser:
                 s.database = self._ident()
             return s
         if kw.val == "series":
+            if self._accept_kw("exact"):
+                self._expect_kw("cardinality")
+                s = ast.ShowSeriesExactCardinality()
+                if self._accept_kw("on"):
+                    s.database = self._ident()
+                if self._accept_kw("from"):
+                    s.measurement, s.measurement_regex = self._name_or_regex()
+                if self._accept_kw("where"):
+                    s.condition = self._parse_expr()
+                return s
             if self._accept_kw("cardinality"):
                 s = ast.ShowSeriesCardinality()
                 if self._accept_kw("on"):
@@ -491,7 +533,7 @@ class Parser:
             if self._accept_kw("on"):
                 s.database = self._ident()
             if self._accept_kw("from"):
-                s.measurement = self._ident()
+                s.measurement, s.measurement_regex = self._name_or_regex()
             if self._accept_kw("where"):
                 s.condition = self._parse_expr()
             return s
@@ -536,8 +578,19 @@ class Parser:
         self._expect_kw("create")
         kw = self._expect_kw(
             "database", "retention", "continuous", "user", "stream",
-            "subscription", "downsample",
+            "subscription", "downsample", "measurement",
         )
+        if kw == "measurement":
+            # CREATE MEASUREMENT name [WITH ...]: schema pre-declaration.
+            # Our engine is schema-on-write, so the statement validates and
+            # records nothing; shard-key/index clauses are accepted and
+            # ignored (reference: influxql CreateMeasurementStatement).
+            stmt = ast.CreateMeasurement(self._ident())
+            while self.lex.peek().kind != "EOF" and not (
+                self.lex.peek().kind == "OP" and self.lex.peek().val == ";"
+            ):
+                self.lex.next()
+            return stmt
         if kw == "downsample":
             # CREATE DOWNSAMPLE ON [db.]rp (float(mean),integer(sum))
             #   WITH TTL 7d SAMPLEINTERVAL 1h,25h TIMEINTERVAL 5m,30m

@@ -145,7 +145,9 @@ class TestDeletion:
         s = series_of(q(ex, "SHOW MEASUREMENT CARDINALITY"))
         assert s["values"] == [[1]]
         s = series_of(q(ex, "SHOW SERIES CARDINALITY"))
-        assert s["values"] == [[2]]
+        # one row per shard-group range: [startTime, endTime, count]
+        assert s["columns"] == ["startTime", "endTime", "count"]
+        assert [r[2] for r in s["values"]] == [2]
 
 
 class TestHttpAuth:

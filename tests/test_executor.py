@@ -283,7 +283,14 @@ class TestReviewRegressions2:
     def test_string_field_agg_rejected_except_count(self, env):
         e, ex = env
         e.write_lines("db", f'm status="ok" {BASE*NS}\nm status="bad" {(BASE+1)*NS}')
+        # first/last on strings route to the host path and work
         res = q(ex, "SELECT first(status) FROM m")
+        [(t, v)] = series_of(res)["values"]
+        assert v == "ok"
+        res = q(ex, "SELECT last(status) FROM m")
+        assert series_of(res)["values"][0][1] == "bad"
+        # numeric-only aggregates still reject strings
+        res = q(ex, "SELECT sum(status) FROM m")
         assert "not supported on string field" in res["results"][0]["error"]
         res = q(ex, "SELECT count(status) FROM m")
         [(t, v)] = series_of(res)["values"]
