@@ -111,6 +111,7 @@ def _check_host_field_type(call_name: str, field: str, schema: dict) -> None:
 
 _READONLY_STMTS = (
     ast.SelectStatement,
+    ast.UnionStatement,
     ast.ShowDatabases,
     ast.ShowMeasurements,
     ast.ShowTagKeys,
@@ -162,6 +163,8 @@ class Executor:
         # across HTTP threads (duplicate CREATE USER would silently replace
         # the first user's credentials)
         self._user_ddl_lock = _threading.Lock()
+        # per-thread stack of CTE names being expanded (cycle detection)
+        self._cte_state = _threading.local()
 
     def _replicate_ddl(self, cmd: dict) -> bool:
         """Route a DDL command through the raft meta store when clustered.
@@ -408,6 +411,10 @@ class Executor:
             select = stmt.select
         elif isinstance(stmt, ast.SelectStatement):
             select = stmt
+        elif isinstance(stmt, ast.UnionStatement):
+            for sel in stmt.selects:
+                self._authorize(sel, user, db)
+            return
         if select is not None:
             # READ must hold on EVERY source database — including
             # per-source overrides (FROM "otherdb"..m) and subquery inner
@@ -440,14 +447,43 @@ class Executor:
         """Every database a SELECT reads from, recursing into subqueries."""
         dbs = set()
 
+        seen: set[int] = set()
+
         def walk(s):
+            if s is None or id(s) in seen:
+                return
+            seen.add(id(s))
+            if isinstance(s, ast.UnionStatement):
+                for sel in s.selects:
+                    walk(sel)
+                return
             if not s.sources:
                 dbs.add(default_db)
             for src in s.sources:
-                if isinstance(src, ast.SubQuery):
-                    walk(src.stmt)
-                else:
-                    dbs.add(src.database or default_db)
+                walk_src(src, s)
+            walk_cond(s.condition)
+
+        def walk_src(src, owner):
+            if isinstance(src, ast.SubQuery):
+                walk(src.stmt)
+            elif isinstance(src, ast.JoinSource):
+                walk_src(src.left, owner)
+                walk_src(src.right, owner)
+            elif owner.ctes and src.name in owner.ctes:
+                walk(owner.ctes[src.name])
+            else:
+                dbs.add(src.database or default_db)
+
+        def walk_cond(e):
+            if e is None:
+                return
+            if isinstance(e, ast.InSubquery):
+                walk(e.stmt)
+            elif isinstance(e, ast.BinaryExpr):
+                walk_cond(e.lhs)
+                walk_cond(e.rhs)
+            elif isinstance(e, (ast.ParenExpr, ast.UnaryExpr)):
+                walk_cond(e.expr)
 
         walk(select)
         return dbs
@@ -456,6 +492,11 @@ class Executor:
         if isinstance(stmt, ast.SelectStatement):
             STATS.incr("executor", "selects")
             return self._select(stmt, db, now_ns)
+        if isinstance(stmt, ast.UnionStatement):
+            from opengemini_tpu.query import join as joinmod
+
+            STATS.incr("executor", "selects")
+            return joinmod.execute_union(self, stmt, db, now_ns)
         if isinstance(stmt, ast.ExplainStatement):
             return self._explain(stmt, db, now_ns)
         if isinstance(stmt, ast.ShowDatabases):
@@ -847,8 +888,24 @@ class Executor:
 
     def _select(self, stmt: ast.SelectStatement, db: str, now_ns: int,
                 trace=tracing.NOOP) -> dict:
+        stmt = self._rewrite_in_subqueries(stmt, db, now_ns)
+        if stmt is None:
+            return {}  # IN (empty subquery result): no rows can match
         all_series = []
         for src in stmt.sources:
+            if isinstance(src, ast.JoinSource):
+                from opengemini_tpu.query import join as joinmod
+
+                all_series.extend(
+                    joinmod.select_join(self, stmt, src, db, now_ns)
+                )
+                continue
+            if (isinstance(src, ast.Measurement) and stmt.ctes
+                    and src.name in stmt.ctes):
+                all_series.extend(
+                    self._select_cte(stmt, src, db, now_ns, trace)
+                )
+                continue
             if isinstance(src, ast.SubQuery):
                 all_series.extend(
                     self._select_from_subquery(stmt, src, db, now_ns, trace)
@@ -878,6 +935,126 @@ class Executor:
         if not all_series:
             return {}
         return {"series": all_series}
+
+    def _select_cte(self, stmt, src: ast.Measurement, db: str, now_ns: int,
+                    trace=tracing.NOOP) -> list[dict]:
+        """FROM <cte-name>: execute the WITH binding as a subquery, with
+        cycle detection (reference error text: CTE_Query expectations)."""
+        name = src.name
+        active = getattr(self._cte_state, "active", None)
+        if active is None:
+            active = self._cte_state.active = set()
+        if name in active:
+            raise QueryError(
+                f"Unsupported feature: recursive call to itself {name}")
+        active.add(name)
+        try:
+            sub = ast.SubQuery(stmt.ctes[name], alias=src.alias or name)
+            return self._select_from_subquery(stmt, sub, db, now_ns, trace)
+        finally:
+            active.discard(name)
+
+    def _rewrite_in_subqueries(self, stmt, db: str, now_ns: int):
+        """Replace `<ref> IN (SELECT ...)` predicates with OR-chains of
+        equalities against the subquery's first output column.  Returns
+        None when an IN set is empty (the predicate can never match)."""
+        if stmt.condition is None or not _has_in_subquery(stmt.condition):
+            return stmt
+        import copy
+
+        empty = []
+
+        def resolve(e, under_or=False):
+            if isinstance(e, ast.InSubquery):
+                # CTE refs inside the IN-subquery resolve with cycle checks
+                res = self._select(e.stmt, db, now_ns)
+                values = []
+                seen = set()
+                for s in res.get("series", []):
+                    for row in s.get("values", []):
+                        if len(row) < 2 or row[1] is None:
+                            continue
+                        if row[1] not in seen:
+                            seen.add(row[1])
+                            values.append(row[1])
+                if not values:
+                    if under_or:
+                        # an always-false leaf under OR must not erase the
+                        # other branch; no representable false leaf exists
+                        # in the condition machinery yet
+                        raise QueryError(
+                            "IN (empty subquery result) under OR is not supported")
+                    empty.append(True)
+                    return e
+                out = None
+                for v in values:
+                    if isinstance(v, bool):
+                        lit = ast.BooleanLiteral(v)
+                    elif isinstance(v, (int,)):
+                        lit = ast.IntegerLiteral(v)
+                    elif isinstance(v, float):
+                        lit = ast.NumberLiteral(v)
+                    else:
+                        lit = ast.StringLiteral(str(v))
+                    eq = ast.BinaryExpr("=", e.ref, lit)
+                    out = eq if out is None else ast.BinaryExpr("OR", out, eq)
+                return out
+            if isinstance(e, ast.BinaryExpr):
+                sub_or = under_or or e.op.upper() == "OR"
+                return ast.BinaryExpr(
+                    e.op, resolve(e.lhs, sub_or), resolve(e.rhs, sub_or))
+            if isinstance(e, ast.ParenExpr):
+                return ast.ParenExpr(resolve(e.expr, under_or))
+            if isinstance(e, ast.UnaryExpr):
+                return ast.UnaryExpr(e.op, resolve(e.expr, True))
+            return e
+
+        new_cond = resolve(stmt.condition)
+        if empty:
+            return None
+        stmt = copy.copy(stmt)
+        stmt.condition = new_cond
+        return stmt
+
+    def _project_union(self, stmt, inner_res) -> list[dict] | None:
+        """Raw column projection over a union subquery result; returns None
+        when the outer statement needs real execution (aggregates, WHERE,
+        grouping) and must fall back to materialization."""
+        if (stmt.condition is not None or stmt.group_by_tags
+                or stmt.group_by_all_tags or stmt.group_by_time):
+            return None
+        for f in stmt.fields:
+            e = _strip_expr(f.expr)
+            if not isinstance(e, (ast.VarRef, ast.Wildcard)):
+                return None
+        series = inner_res.get("series", [])
+        if not series:
+            return []
+        src = series[0]
+        cols_in = src["columns"]
+        names, idxs = [], []
+        for f in stmt.fields:
+            e = _strip_expr(f.expr)
+            if isinstance(e, ast.Wildcard):
+                for i, c in enumerate(cols_in[1:], start=1):
+                    names.append(c)
+                    idxs.append(i)
+            else:
+                if e.name.lower() == "time":
+                    continue  # always column 0
+                names.append(f.alias or e.name)
+                idxs.append(cols_in.index(e.name) if e.name in cols_in else -1)
+        rows = [
+            [row[0]] + [row[i] if i >= 0 else None for i in idxs]
+            for row in src["values"]
+        ]
+        if not stmt.ascending:
+            rows.reverse()
+        if stmt.offset:
+            rows = rows[stmt.offset:]
+        if stmt.limit:
+            rows = rows[: stmt.limit]
+        return [{"name": src["name"], "columns": ["time"] + names, "values": rows}]
 
     def _write_into(self, target: ast.Measurement, db: str, series_list: list[dict]) -> int:
         """SELECT INTO: write result rows into the target measurement
@@ -935,34 +1112,54 @@ class Executor:
         from opengemini_tpu.storage.engine import Engine as _Engine
 
         inner = src.stmt
-        if _classify_select(inner) == "raw" and not (
+        inner_raw_wild = False
+        if isinstance(inner, ast.SelectStatement) and _classify_select(
+                inner) == "raw" and not (
             inner.group_by_tags or inner.group_by_all_tags
         ):
             # influx propagates series tags through subqueries: a raw inner
             # select must emit per-series output, not one merged series
+            inner_raw_wild = any(
+                isinstance(_strip_expr(f.expr), ast.Wildcard)
+                for f in inner.fields
+            )
             inner = copy.copy(inner)
             inner.group_by_all_tags = True
         # push the outer time range into the inner select so the inner scan
         # (and the materialization below) covers only the needed window
-        try:
-            sc_outer = cond.split(stmt.condition, set(), now_ns)
-            if sc_outer.tmin != cond.MIN_TIME or sc_outer.tmax != cond.MAX_TIME:
-                bound = ast.BinaryExpr(
-                    "AND",
-                    ast.BinaryExpr(">=", ast.VarRef("time"),
-                                   ast.IntegerLiteral(sc_outer.tmin)),
-                    ast.BinaryExpr("<", ast.VarRef("time"),
-                                   ast.IntegerLiteral(sc_outer.tmax)),
-                )
-                inner = copy.copy(inner)
-                inner.condition = (
-                    bound if inner.condition is None
-                    else ast.BinaryExpr("AND", inner.condition, bound)
-                )
-        except cond.ConditionError:
-            pass  # un-splittable outer condition: no pushdown
+        if isinstance(inner, ast.UnionStatement):
+            pass  # union bodies materialize whole (no time pushdown yet)
+        else:
+            try:
+                sc_outer = cond.split(stmt.condition, set(), now_ns)
+                if sc_outer.tmin != cond.MIN_TIME or sc_outer.tmax != cond.MAX_TIME:
+                    bound = ast.BinaryExpr(
+                        "AND",
+                        ast.BinaryExpr(">=", ast.VarRef("time"),
+                                       ast.IntegerLiteral(sc_outer.tmin)),
+                        ast.BinaryExpr("<", ast.VarRef("time"),
+                                       ast.IntegerLiteral(sc_outer.tmax)),
+                    )
+                    inner = copy.copy(inner)
+                    inner.condition = (
+                        bound if inner.condition is None
+                        else ast.BinaryExpr("AND", inner.condition, bound)
+                    )
+            except cond.ConditionError:
+                pass  # un-splittable outer condition: no pushdown
         with trace.span("subquery"):
-            inner_res = self._select(inner, db, now_ns, trace)
+            if isinstance(inner, ast.UnionStatement):
+                from opengemini_tpu.query import join as joinmod
+
+                inner_res = joinmod.execute_union(self, inner, db, now_ns)
+                # a raw projection over a union must NOT round-trip through
+                # the point materializer: union rows legitimately repeat
+                # (series, time) pairs, which the engine would LWW-dedup
+                proj = self._project_union(stmt, inner_res)
+                if proj is not None:
+                    return proj
+            else:
+                inner_res = self._select(inner, db, now_ns, trace)
         series_list = inner_res.get("series", [])
         mst_name = _inner_source_name(inner)
         with tempfile.TemporaryDirectory(prefix="ogtpu-sub-") as tmp:
@@ -993,6 +1190,14 @@ class Executor:
                 outer = copy.copy(stmt)
                 outer.sources = [ast.Measurement(name=mst_name)]
                 outer.into = None  # INTO applies once, in the caller
+                # the source is now a materialized measurement: it must not
+                # re-resolve as a CTE name against the throw-away engine
+                outer.ctes = None
+                # influx wildcard-over-subquery expands to the inner's
+                # ORIGINAL output columns: explicit inner fields stay
+                # fields-only; a raw inner `select *` had tags inlined, so
+                # the outer wildcard inlines them again
+                outer._from_subquery = not inner_raw_wild
                 sub_ex = Executor(tmp_engine, users=self.users)
                 res = sub_ex._select(outer, "sub", now_ns, trace)
                 return res.get("series", [])
@@ -2077,17 +2282,31 @@ class Executor:
         if not shards:
             return []
 
-        # output columns
-        names: list[str] = []
+        # output columns: * expands to fields + tags, except tags consumed
+        # by GROUP BY (explicit or *), which surface in the series tags dict
+        # (influx wildcard semantics)
+        grouped_tags = (
+            tag_keys
+            if stmt.group_by_all_tags or getattr(stmt, "_from_subquery", False)
+            else set(stmt.group_by_tags)
+        )
+        names: list[tuple[str, str]] = []  # (output name, source ref)
         for f in stmt.fields:
             e = _strip_expr(f.expr)
             if isinstance(e, ast.Wildcard):
-                names.extend(sorted(set(schema) | tag_keys))
+                names.extend(
+                    (n, n) for n in sorted(set(schema) | (tag_keys - grouped_tags))
+                )
             else:
-                names.append(f.alias or _default_field_name(f.expr))
-        # dedupe keep order
+                src_name = e.name if isinstance(e, ast.VarRef) else ""
+                names.append(
+                    (f.alias or _default_field_name(f.expr), src_name)
+                )
+        # dedupe keep order (by output name)
         seen = set()
-        columns = ["time"] + [n for n in names if not (n in seen or seen.add(n))]
+        out_cols = [nm for nm in names if not (nm[0] in seen or seen.add(nm[0]))]
+        columns = ["time"] + [n for n, _s in out_cols]
+        src_of = {n: (s_ or n) for n, s_ in out_cols}
 
         group_tags = self._group_tags(stmt, shards, mst)
         groups: dict[tuple, list] = {}
@@ -2103,8 +2322,16 @@ class Executor:
         # project only needed columns: selected fields + filter refs
         filter_refs = cond.field_filter_refs(sc.field_expr) if sc.field_expr else set()
         read_fields = sorted(
-            ({c for c in columns[1:] if c in schema} | set(filter_refs)) & set(schema)
+            ({src_of[c] for c in columns[1:] if src_of[c] in schema}
+             | set(filter_refs)) & set(schema)
         )
+        # tag-only selects (e.g. SELECT "name" FROM m, openGemini
+        # semantics): a row exists wherever ANY field is set, so read
+        # every field for presence
+        tag_only = not read_fields and any(
+            src_of[c] in tag_keys for c in columns[1:])
+        if tag_only:
+            read_fields = None
         out_series = []
         for key in sorted(groups):
             rows: list[list] = []
@@ -2119,17 +2346,22 @@ class Executor:
                     else np.ones(len(rec), dtype=bool)
                 )
                 # a raw row is emitted if any selected *field* is present
+                # (tag-only selects: any field at all)
                 present = np.zeros(len(rec), dtype=bool)
                 col_arrays = []
                 for name in columns[1:]:
-                    col = rec.columns.get(name)
+                    ref = src_of[name]
+                    col = rec.columns.get(ref)
                     if col is not None:
                         col_arrays.append((col.values, col.valid, col.ftype))
                         present |= col.valid
-                    elif name in tags:
-                        col_arrays.append((None, None, tags[name]))
+                    elif ref in tags:
+                        col_arrays.append((None, None, tags[ref]))
                     else:
                         col_arrays.append((None, None, None))
+                if tag_only:
+                    for col in rec.columns.values():
+                        present |= col.valid
                 sel = np.nonzero(fmask & present)[0]
                 for i in sel:
                     row = [int(rec.times[i])]
@@ -2409,12 +2641,25 @@ def _add_record_to_batches(rec, seg, aligned, needed_fields, batches, dtype, fma
         batches[fname].add(vals, rel, seg, m, rec.times)
 
 
-def _inner_source_name(stmt: ast.SelectStatement) -> str:
-    """Influx keeps the innermost measurement name for subquery output."""
+def _inner_source_name(stmt, _depth: int = 0) -> str:
+    """Influx keeps the innermost measurement name for subquery output
+    (CTE references resolve to their body's innermost source; a union
+    body names itself after its sorted side names)."""
+    if _depth > 16:
+        return "subquery"
+    if isinstance(stmt, ast.UnionStatement):
+        parts: set[str] = set()
+        for sel in stmt.selects:
+            n = _inner_source_name(sel, _depth + 1)
+            if n != "subquery":
+                parts.update(n.split(","))
+        return ",".join(sorted(parts)) if parts else "subquery"
     for src in stmt.sources:
         if isinstance(src, ast.SubQuery):
-            return _inner_source_name(src.stmt)
+            return _inner_source_name(src.stmt, _depth + 1)
         if isinstance(src, ast.Measurement) and src.name:
+            if stmt.ctes and src.name in stmt.ctes:
+                return _inner_source_name(stmt.ctes[src.name], _depth + 1)
             return src.name
     return "subquery"
 
@@ -2638,6 +2883,16 @@ def _eval_aux_expr(e, ri: int, aux_arr, tag_arr, schema):
         except TypeError:
             return None
     raise QueryError(f"unsupported auxiliary expression: {e}")
+
+
+def _has_in_subquery(e) -> bool:
+    if isinstance(e, ast.InSubquery):
+        return True
+    if isinstance(e, ast.BinaryExpr):
+        return _has_in_subquery(e.lhs) or _has_in_subquery(e.rhs)
+    if isinstance(e, (ast.ParenExpr, ast.UnaryExpr)):
+        return _has_in_subquery(e.expr)
+    return False
 
 
 def _classify_select(stmt: ast.SelectStatement) -> str:

@@ -123,10 +123,31 @@ class Measurement:
     regex: str = ""
     database: str = ""
     rp: str = ""
+    alias: str = ""
 
 
 @dataclass(frozen=True)
 class SubQuery:
+    stmt: "SelectStatement"
+    alias: str = ""
+
+
+@dataclass(frozen=True)
+class JoinSource:
+    """A JOIN B ON <cond>. kind: inner|left|right|outer|full
+    (reference: influxql.Join, LogicalJoin at logic_plan.go:3679)."""
+
+    left: object  # Measurement | SubQuery | JoinSource
+    right: object
+    kind: str
+    on: object  # condition expr
+
+
+@dataclass(frozen=True)
+class InSubquery:
+    """<ref> IN (SELECT ...) in a WHERE clause."""
+
+    ref: object  # VarRef
     stmt: "SelectStatement"
 
 
@@ -153,6 +174,18 @@ class SelectStatement:
     ascending: bool = True
     tz: str = ""
     into: Measurement | None = None
+    ctes: dict | None = None  # WITH name AS (...) bindings, shared by ref
+
+
+@dataclass
+class UnionStatement:
+    """A UNION [ALL] [BY NAME] B [...]; selects with combine flags.
+    combines[i] describes how selects[i+1] merges into the running result.
+    (reference: influxql union statement, TestServer_Union_Table)."""
+
+    selects: list = field(default_factory=list)
+    combines: list = field(default_factory=list)  # (all: bool, by_name: bool)
+    ctes: dict | None = None
 
 
 # -- other statements --------------------------------------------------------

@@ -24,7 +24,7 @@ KEYWORDS = {
     "stream", "streams", "delay", "shards", "stats", "diagnostics",
     "subscription", "subscriptions", "destinations", "any", "kill",
     "downsample", "downsamples", "ttl", "sampleinterval", "timeinterval",
-    "cluster",
+    "cluster", "union", "join", "inner", "outer", "full", "left", "right",
 }
 
 _DUR_RE = re.compile(r"(\d+)(ns|u|µ|us|ms|s|m|h|d|w)")

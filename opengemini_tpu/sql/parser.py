@@ -32,6 +32,53 @@ _PRECEDENCE = {
 }
 
 
+def _attach_ctes(stmt, ctes: dict) -> None:
+    """Make WITH bindings visible to the statement and every nested select
+    (subqueries, join sides, IN-subqueries, and the CTE bodies themselves,
+    so CTEs can reference other CTEs)."""
+    seen: set[int] = set()
+
+    def walk(s):
+        if s is None or id(s) in seen:
+            return
+        seen.add(id(s))
+        if isinstance(s, ast.UnionStatement):
+            s.ctes = ctes
+            for sel in s.selects:
+                walk(sel)
+            return
+        if not isinstance(s, ast.SelectStatement):
+            return
+        s.ctes = ctes
+        for src in s.sources:
+            walk_source(src)
+        walk_cond(s.condition)
+
+    def walk_source(src):
+        if isinstance(src, ast.SubQuery):
+            walk(src.stmt)
+        elif isinstance(src, ast.JoinSource):
+            walk_source(src.left)
+            walk_source(src.right)
+
+    def walk_cond(e):
+        if e is None:
+            return
+        if isinstance(e, ast.InSubquery):
+            walk(e.stmt)
+        elif isinstance(e, ast.BinaryExpr):
+            walk_cond(e.lhs)
+            walk_cond(e.rhs)
+        elif isinstance(e, (ast.ParenExpr,)):
+            walk_cond(e.expr)
+        elif isinstance(e, ast.UnaryExpr):
+            walk_cond(e.expr)
+
+    walk(stmt)
+    for body in ctes.values():
+        walk(body)
+
+
 def parse(text: str):
     """Parse one or more ;-separated statements; returns a list."""
     p = Parser(text)
@@ -113,7 +160,9 @@ class Parser:
         if tok.kind != "KEYWORD":
             raise ParseError(f"expected statement, got {tok.val!r}")
         if tok.val == "select":
-            return self.parse_select()
+            return self.parse_select_or_union()
+        if tok.val == "with":
+            return self.parse_with()
         if tok.val == "explain":
             self.lex.next()
             analyze = self._accept_kw("analyze") is not None
@@ -183,6 +232,51 @@ class Parser:
             stmt.condition = self._parse_expr()
         return stmt
 
+    def parse_with(self):
+        """WITH name AS (SELECT ...), ... SELECT ... — common table
+        expressions (reference: LogicalCTE, logic_plan.go:3769)."""
+        self._expect_kw("with")
+        ctes: dict = {}
+        while True:
+            name = self._ident()
+            self._expect_kw("as")
+            self._expect_op("(")
+            ctes[name] = self.parse_select_or_union()
+            self._expect_op(")")
+            if not self._accept_op(","):
+                break
+        tok = self.lex.peek()
+        if not (tok.kind == "KEYWORD" and tok.val == "select"):
+            raise ParseError("WITH must be followed by SELECT")
+        stmt = self.parse_select_or_union()
+        _attach_ctes(stmt, ctes)
+        return stmt
+
+    def parse_select_or_union(self):
+        first = self._parse_union_unit()
+        tok = self.lex.peek()
+        if not (tok.kind == "KEYWORD" and tok.val == "union"):
+            return first
+        selects, combines = [first], []
+        while self._accept_kw("union"):
+            all_ = bool(self._accept_kw("all"))
+            by_name = False
+            if self._accept_kw("by"):
+                self._expect_kw("name")
+                by_name = True
+            selects.append(self._parse_union_unit())
+            combines.append((all_, by_name))
+        return ast.UnionStatement(selects, combines)
+
+    def _parse_union_unit(self):
+        tok = self.lex.peek()
+        if tok.kind == "OP" and tok.val == "(":
+            self.lex.next()
+            inner = self.parse_select_or_union()
+            self._expect_op(")")
+            return inner
+        return self.parse_select()
+
     def parse_select(self) -> ast.SelectStatement:
         self._expect_kw("select")
         stmt = ast.SelectStatement()
@@ -241,22 +335,63 @@ class Parser:
         return fields
 
     def _parse_sources(self) -> list:
-        sources = []
-        while True:
-            tok = self.lex.peek(allow_regex=True)
-            if tok.kind == "REGEX":
-                self.lex.next(allow_regex=True)
-                sources.append(ast.Measurement(regex=tok.val))
-            elif tok.kind == "OP" and tok.val == "(":
-                self.lex.next()
-                sub = self.parse_select()
-                self._expect_op(")")
-                sources.append(ast.SubQuery(sub))
-            else:
-                sources.append(self._parse_measurement())
-            if not self._accept_op(","):
-                break
+        sources = [self._parse_source_join()]
+        while self._accept_op(","):
+            sources.append(self._parse_source_join())
         return sources
+
+    def _parse_single_source(self):
+        import dataclasses
+
+        tok = self.lex.peek(allow_regex=True)
+        if tok.kind == "REGEX":
+            self.lex.next(allow_regex=True)
+            src = ast.Measurement(regex=tok.val)
+        elif tok.kind == "OP" and tok.val == "(":
+            self.lex.next()
+            sub = self.parse_select()
+            self._expect_op(")")
+            src = ast.SubQuery(sub)
+        else:
+            src = self._parse_measurement()
+        if self._accept_kw("as"):
+            src = dataclasses.replace(src, alias=self._ident())
+        return src
+
+    def _parse_source_join(self):
+        src = self._parse_single_source()
+        while True:
+            kind = self._accept_join_kind()
+            if kind is None:
+                return src
+            right = self._parse_single_source()
+            self._expect_kw("on")
+            on = self._parse_expr()
+            src = ast.JoinSource(src, right, kind, on)
+
+    def _accept_join_kind(self) -> str | None:
+        """JOIN | INNER JOIN | LEFT [OUTER] JOIN | RIGHT [OUTER] JOIN |
+        FULL [OUTER] JOIN | OUTER JOIN (reference: influxql.y join rules;
+        `outer join` keeps nulls, `full join` zero-fills — observed
+        server_test.go join tables)."""
+        if self._accept_kw("join"):
+            return "inner"
+        if self._accept_kw("inner"):
+            self._expect_kw("join")
+            return "inner"
+        for k in ("left", "right"):
+            if self._accept_kw(k):
+                self._accept_kw("outer")
+                self._expect_kw("join")
+                return k
+        if self._accept_kw("full"):
+            self._accept_kw("outer")
+            self._expect_kw("join")
+            return "full"
+        if self._accept_kw("outer"):
+            self._expect_kw("join")
+            return "outer"
+        return None
 
     def _parse_measurement(self) -> ast.Measurement:
         # [db [.rp]] . name   with each part optionally quoted; or name only
@@ -342,6 +477,10 @@ class Parser:
             elif tok.kind == "KEYWORD" and tok.val in ("and", "or"):
                 op = tok.val
             if op is None:
+                if tok.kind == "KEYWORD" and tok.val == "in" and min_prec <= 3:
+                    self.lex.next()
+                    lhs = self._parse_in(lhs)
+                    continue
                 return lhs
             prec = _PRECEDENCE[op]
             if prec < min_prec:
@@ -355,6 +494,25 @@ class Parser:
             else:
                 rhs = self._parse_expr(prec + 1)
             lhs = ast.BinaryExpr("AND" if op == "and" else ("OR" if op == "or" else op), lhs, rhs)
+
+    def _parse_in(self, lhs):
+        """<ref> IN (SELECT ...) or <ref> IN (lit, lit, ...) — the literal
+        form desugars to an OR chain of equalities."""
+        self._expect_op("(")
+        tok = self.lex.peek()
+        if tok.kind == "KEYWORD" and tok.val == "select":
+            sub = self.parse_select()
+            self._expect_op(")")
+            return ast.InSubquery(lhs, sub)
+        out = None
+        while True:
+            lit = self._parse_expr()
+            eq = ast.BinaryExpr("=", lhs, lit)
+            out = eq if out is None else ast.BinaryExpr("OR", out, eq)
+            if not self._accept_op(","):
+                break
+        self._expect_op(")")
+        return out
 
     def _parse_unary(self):
         tok = self.lex.peek()
@@ -406,6 +564,15 @@ class Parser:
                             break
                     self._expect_op(")")
                 return ast.Call(name.lower(), tuple(args))
+            # qualified references: alias.field / alias.* (join sources)
+            while self.lex.peek().kind == "OP" and self.lex.peek().val == ".":
+                self.lex.next()
+                nxt = self.lex.peek()
+                if nxt.kind == "OP" and nxt.val == "*":
+                    self.lex.next()
+                    name += ".*"
+                    break
+                name += "." + self._ident()
             # double-colon type cast: field::float — parsed, cast ignored
             if self._accept_op("::"):
                 self._ident()
