@@ -525,6 +525,19 @@ class Executor:
         if isinstance(stmt, ast.CreateDatabase):
             if not self._replicate_ddl({"op": "create_database", "name": stmt.name}):
                 self.engine.create_database(stmt.name)
+            if stmt.has_rp_clause:
+                rp_name = stmt.rp_name or "autogen"
+                cmd = {
+                    "op": "create_rp", "db": stmt.name, "name": rp_name,
+                    "duration_ns": stmt.duration_ns,
+                    "shard_duration_ns": stmt.shard_duration_ns,
+                    "default": True,
+                }
+                if not self._replicate_ddl(cmd):
+                    self.engine.create_retention_policy(
+                        stmt.name, rp_name, stmt.duration_ns,
+                        stmt.shard_duration_ns, default=True,
+                    )
             return {}
         if isinstance(stmt, ast.DropDatabase):
             if not self._replicate_ddl({"op": "drop_database", "name": stmt.name}):
@@ -891,6 +904,10 @@ class Executor:
         stmt = self._rewrite_in_subqueries(stmt, db, now_ns)
         if stmt is None:
             return {}  # IN (empty subquery result): no rows can match
+        if len(stmt.fields) == 1:
+            only = _strip_expr(stmt.fields[0].expr)
+            if isinstance(only, ast.Call) and only.name == "compare":
+                return self._select_compare(stmt, only, db, now_ns)
         all_series = []
         for src in stmt.sources:
             if isinstance(src, ast.JoinSource):
@@ -1016,6 +1033,103 @@ class Executor:
         stmt.condition = new_cond
         return stmt
 
+    def _select_compare(self, stmt, call, db: str, now_ns: int) -> dict:
+        """compare(ref, off...): evaluate the source over the WHERE range
+        and over each range shifted back by `off` seconds (or a duration),
+        align rows by (tags, time+off), and emit ref1..refN plus
+        ref1/refK ratio columns (reference: openGemini compare UDF,
+        TestServer_Query_Compare_Functions)."""
+        import copy as _copy
+
+        if len(call.args) < 2:
+            raise QueryError(
+                "invalid number of arguments for compare, expected more "
+                f"than one arguments, got {len(call.args)}")
+        ref_e = _strip_expr(call.args[0])
+        if not isinstance(ref_e, ast.VarRef):
+            raise QueryError("compare() first argument must be a column")
+        ref = ref_e.name
+        offsets = []
+        for a in call.args[1:]:
+            v = _call_param_value(a)
+            # bare integers are seconds; durations come in as ns
+            offsets.append(int(v) * NS if isinstance(v, int) and
+                           not isinstance(_strip_expr(a), ast.DurationLiteral)
+                           else int(v))
+        if not stmt.sources:
+            raise QueryError("compare() requires a FROM source")
+        src = stmt.sources[0]
+        if isinstance(src, ast.SubQuery):
+            inner = src.stmt
+        elif isinstance(src, ast.Measurement):
+            # raw field compare: first(field) over the range
+            inner = ast.SelectStatement(
+                fields=[ast.Field(ast.Call("first", (ast.VarRef(ref),)),
+                                  alias=ref)],
+                sources=[src],
+            )
+            inner.ctes = stmt.ctes
+        else:
+            raise QueryError("compare() source must be a measurement or subquery")
+
+        sc = cond.split(stmt.condition, set(), now_ns)
+        if sc.tmin == cond.MIN_TIME or sc.tmax == cond.MAX_TIME:
+            raise QueryError("compare() requires an explicit time range")
+
+        runs = []
+        for off in [0] + offsets:
+            bound = ast.BinaryExpr(
+                "AND",
+                ast.BinaryExpr(">=", ast.VarRef("time"),
+                               ast.IntegerLiteral(sc.tmin - off)),
+                ast.BinaryExpr("<", ast.VarRef("time"),
+                               ast.IntegerLiteral(sc.tmax - off)),
+            )
+            run_stmt = ast.SelectStatement(
+                fields=[ast.Field(ast.VarRef(ref))],
+                sources=[ast.SubQuery(_copy.copy(inner))],
+                condition=bound,
+                group_by_all_tags=True,
+            )
+            run_stmt.ctes = stmt.ctes
+            res = self._select(run_stmt, db, now_ns)
+            data: dict[tuple, dict[int, object]] = {}
+            name = "compare"
+            for ser in res.get("series", []):
+                name = ser.get("name", name)
+                key = tuple(sorted((ser.get("tags") or {}).items()))
+                bucket = data.setdefault(key, {})
+                ci = ser["columns"].index(ref) if ref in ser["columns"] else 1
+                for row in ser["values"]:
+                    if row[ci] is not None:
+                        bucket[row[0] + off] = row[ci]
+            runs.append((name, data))
+
+        src_name = runs[0][0] if runs else "compare"
+        all_keys = sorted({k for _n, d in runs for k in d})
+        k_runs = len(runs)
+        columns = (["time"] + [f"{ref}{i+1}" for i in range(k_runs)]
+                   + [f"{ref}1/{ref}{i+1}" for i in range(1, k_runs)])
+        out_series = []
+        for key in all_keys:
+            times = sorted({t for _n, d in runs for t in d.get(key, {})})
+            rows = []
+            for t in times:
+                vals = [d.get(key, {}).get(t) for _n, d in runs]
+                ratios = []
+                for i in range(1, k_runs):
+                    a, b = vals[0], vals[i]
+                    ratios.append(
+                        a / b if a is not None and b not in (None, 0) else None)
+                rows.append([t] + vals + ratios)
+            if not rows:
+                continue
+            series = {"name": src_name, "columns": columns, "values": rows}
+            if key:
+                series["tags"] = dict(key)
+            out_series.append(series)
+        return {"series": out_series} if out_series else {}
+
     def _project_union(self, stmt, inner_res) -> list[dict] | None:
         """Raw column projection over a union subquery result; returns None
         when the outer statement needs real execution (aggregates, WHERE,
@@ -1112,19 +1226,21 @@ class Executor:
         from opengemini_tpu.storage.engine import Engine as _Engine
 
         inner = src.stmt
-        inner_raw_wild = False
-        if isinstance(inner, ast.SelectStatement) and _classify_select(
-                inner) == "raw" and not (
-            inner.group_by_tags or inner.group_by_all_tags
-        ):
-            # influx propagates series tags through subqueries: a raw inner
-            # select must emit per-series output, not one merged series
-            inner_raw_wild = any(
+        inner_has_wild = False
+        if isinstance(inner, ast.SelectStatement):
+            inner_has_wild = any(
                 isinstance(_strip_expr(f.expr), ast.Wildcard)
+                or _call_wildcard_inner(_strip_expr(f.expr)) is not None
                 for f in inner.fields
             )
-            inner = copy.copy(inner)
-            inner.group_by_all_tags = True
+            if _classify_select(inner) == "raw" and not (
+                inner.group_by_tags or inner.group_by_all_tags
+            ):
+                # influx propagates series tags through subqueries: a raw
+                # inner select must emit per-series output, never one
+                # merged series
+                inner = copy.copy(inner)
+                inner.group_by_all_tags = True
         # push the outer time range into the inner select so the inner scan
         # (and the materialization below) covers only the needed window
         if isinstance(inner, ast.UnionStatement):
@@ -1195,9 +1311,9 @@ class Executor:
                 outer.ctes = None
                 # influx wildcard-over-subquery expands to the inner's
                 # ORIGINAL output columns: explicit inner fields stay
-                # fields-only; a raw inner `select *` had tags inlined, so
-                # the outer wildcard inlines them again
-                outer._from_subquery = not inner_raw_wild
+                # fields-only; an inner wildcard (bare or inside a call)
+                # lets the outer wildcard inline propagated tags
+                outer._from_subquery = not inner_has_wild
                 sub_ex = Executor(tmp_engine, users=self.users)
                 res = sub_ex._select(outer, "sub", now_ns, trace)
                 return res.get("series", [])
@@ -2092,7 +2208,9 @@ class Executor:
                 )
             name = f.alias or _default_field_name(e)
             kind, call_name, field, params, inner = _resolve_host_call(e, group_time)
-            _check_host_field_type(call_name, field, schema)
+            _check_host_field_type(
+                inner[0] if kind == "sliding" and inner else call_name,
+                field, schema)
             if kind == "multi":
                 if len(stmt.fields) > 1:
                     raise QueryError(f"{call_name}() must be the only field")
@@ -2193,6 +2311,7 @@ class Executor:
 
             col_maps: list[dict] = []  # per plan: {time: value}
             has_plain_agg = False
+            sliding_grid: list | None = None
             for name, kind, call_name, fname, params, inner in plans:
                 t, v = field_rows(fname)
                 if kind == "agg":
@@ -2202,6 +2321,19 @@ class Executor:
                         val, sel_t = fnmod.host_agg(call_name, t[sl], v[sl], params)
                         if val is not None:
                             m[wt] = (val, sel_t)
+                    col_maps.append(m)
+                elif kind == "sliding":
+                    n = int(params[0])
+                    slices = window_slices(t)
+                    m = {}
+                    sliding_grid = [wt for wt, _sl in slices[: max(len(slices) - n + 1, 0)]]
+                    for i in range(0, len(slices) - n + 1):
+                        lo = slices[i][1].start or 0
+                        hi = slices[i + n - 1][1].stop
+                        val, _sel = fnmod.host_agg(
+                            inner[0], t[lo:hi], v[lo:hi], inner[1])
+                        if val is not None:
+                            m[slices[i][0]] = (val, None)
                     col_maps.append(m)
                 elif kind == "transform_raw":
                     t_out, v_out = fnmod.transform(call_name, t, v, params)
@@ -2224,6 +2356,9 @@ class Executor:
                 # (holt_winters forecasts) — union them in, never drop
                 extra = {t for m in col_maps for t in m} - set(window_times)
                 base_times = sorted(set(window_times) | extra)
+            elif sliding_grid is not None:
+                # sliding windows emit every output slot; empties fill null
+                base_times = sliding_grid
             else:
                 seen = sorted({t for m in col_maps for t in m})
                 base_times = seen
@@ -2712,13 +2847,24 @@ _NUMERIC_ONLY_WILDCARD = {
 }
 
 
+def _call_wildcard_inner(e):
+    """f(*) -> (f, None); f(g(*), ...) -> (f, g). None when no wildcard."""
+    if not (isinstance(e, ast.Call) and e.args):
+        return None
+    a0 = _strip_expr(e.args[0])
+    if isinstance(a0, ast.Wildcard):
+        return e, None
+    if isinstance(a0, ast.Call) and a0.args and isinstance(
+            _strip_expr(a0.args[0]), ast.Wildcard):
+        return e, a0
+    return None
+
+
 def _has_call_wildcard(stmt) -> bool:
-    for f in stmt.fields:
-        e = _strip_expr(f.expr)
-        if (isinstance(e, ast.Call) and e.args
-                and isinstance(_strip_expr(e.args[0]), ast.Wildcard)):
-            return True
-    return False
+    return any(
+        _call_wildcard_inner(_strip_expr(f.expr)) is not None
+        for f in stmt.fields
+    )
 
 
 def _expand_call_wildcards(stmt, schema):
@@ -2730,16 +2876,29 @@ def _expand_call_wildcards(stmt, schema):
     new_fields = []
     for f in stmt.fields:
         e = _strip_expr(f.expr)
-        if not (isinstance(e, ast.Call) and e.args
-                and isinstance(_strip_expr(e.args[0]), ast.Wildcard)):
+        hit = _call_wildcard_inner(e)
+        if hit is None:
             new_fields.append(f)
             continue
-        base = _default_field_name(e)
-        numeric_only = e.name in _NUMERIC_ONLY_WILDCARD
+        outer, inner = hit
+        base = _default_field_name(outer)
+        type_call = (inner or outer).name
         for fld in sorted(schema):
-            if numeric_only and schema[fld] not in (FieldType.FLOAT, FieldType.INT):
+            ft = schema[fld]
+            if type_call in ("max", "min"):
+                if ft == FieldType.STRING:
+                    continue  # max/min(*): numeric + bool
+            elif type_call in _NUMERIC_ONLY_WILDCARD and ft not in (
+                    FieldType.FLOAT, FieldType.INT):
                 continue
-            call = ast.Call(e.name, (ast.VarRef(fld),) + tuple(e.args[1:]))
+            if inner is None:
+                call = ast.Call(
+                    outer.name, (ast.VarRef(fld),) + tuple(outer.args[1:]))
+            else:
+                new_inner = ast.Call(
+                    inner.name, (ast.VarRef(fld),) + tuple(inner.args[1:]))
+                call = ast.Call(
+                    outer.name, (new_inner,) + tuple(outer.args[1:]))
             new_fields.append(ast.Field(call, alias=f"{base}_{fld}"))
     out = copy.copy(stmt)
     out.fields = new_fields
@@ -2940,8 +3099,26 @@ def _call_param_any(arg):
 
 def _resolve_host_call(call: ast.Call, group_time):
     """-> (kind, call_name, field, params, inner) where kind is
-    'agg' | 'transform_raw' | 'transform_agg' | 'multi'."""
+    'agg' | 'transform_raw' | 'transform_agg' | 'multi' | 'sliding'."""
     name = call.name
+    if name == "sliding_window":
+        # sliding_window(agg(f), N): agg over N consecutive GROUP BY time
+        # windows, emitted at each window start (reference:
+        # TestServer_Query_Sliding_Window_Aggregate)
+        if len(call.args) != 2:
+            raise QueryError("sliding_window() takes (aggregate, N)")
+        if group_time is None:
+            raise QueryError("sliding_window() requires GROUP BY time(...)")
+        inner_e = _strip_expr(call.args[0])
+        if not isinstance(inner_e, ast.Call):
+            raise QueryError("sliding_window() argument must be an aggregate")
+        n = int(_call_param_value(call.args[1]))
+        if n < 1:
+            raise QueryError("sliding_window() N must be >= 1")
+        ikind, iname, ifield, iparams, _ = _resolve_host_call(inner_e, group_time)
+        if ikind != "agg":
+            raise QueryError("sliding_window() argument must be an aggregate")
+        return "sliding", name, ifield, (n,), (iname, iparams)
     if name in fnmod.TRANSFORMS:
         if not call.args:
             raise QueryError(f"{name}() requires an argument")

@@ -259,6 +259,12 @@ class ShowRetentionPolicies:
 @dataclass
 class CreateDatabase:
     name: str = ""
+    # optional WITH clause: creates/overrides the default retention policy
+    rp_name: str = ""
+    duration_ns: int = 0
+    shard_duration_ns: int | None = None
+    replication: int = 1
+    has_rp_clause: bool = False
 
 
 @dataclass

@@ -822,7 +822,33 @@ class Parser:
                 raise ParseError("stream requires GROUP BY time(...)")
             return stmt
         if kw == "database":
-            return ast.CreateDatabase(self._ident())
+            stmt = ast.CreateDatabase(self._ident())
+            if self._accept_kw("with"):
+                # WITH [DURATION d] [REPLICATION n] [SHARD DURATION d]
+                #      [INDEX DURATION d] [NAME rp]  (influxql.y)
+                stmt.has_rp_clause = True
+                while True:
+                    if self._accept_kw("duration"):
+                        stmt.duration_ns = self._duration_tok("DURATION")
+                    elif self._accept_kw("replication"):
+                        t = self.lex.next()
+                        if t.kind != "INTEGER":
+                            raise ParseError("REPLICATION expects an integer")
+                        stmt.replication = t.val
+                    elif self._accept_kw("shard"):
+                        self._expect_kw("duration")
+                        stmt.shard_duration_ns = self._duration_tok("SHARD DURATION")
+                    elif self._accept_kw("name"):
+                        stmt.rp_name = self._ident()
+                    else:
+                        tok = self.lex.peek()
+                        if tok.kind == "IDENT" and tok.val.lower() == "index":
+                            self.lex.next()
+                            self._expect_kw("duration")
+                            self._duration_tok("INDEX DURATION")  # accepted, n/a
+                        else:
+                            break
+            return stmt
         if kw == "user":
             name = self._ident()
             self._expect_kw("with")

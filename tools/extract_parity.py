@@ -313,6 +313,10 @@ def extract_fn(name: str, body: str):
     m = re.search(r'NewTest\("([^"]+)",\s*"([^"]+)"\)', body)
     if m:
         case["db"], case["rp"] = m.group(1), m.group(2)
+    for m in re.finditer(r'test\.db\s*=\s*"([^"]+)"', body):
+        case["db"] = m.group(1)
+    for m in re.finditer(r'test\.rp\s*=\s*"([^"]+)"', body):
+        case["rp"] = m.group(1)
     if "now()" in body or "time.Now" in body:
         raise Unresolvable("uses now()")
 
