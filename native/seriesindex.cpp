@@ -673,6 +673,34 @@ char *msi_key_of(void *h, uint64_t sid, uint64_t *out_len) {
     return alloc_out(key, out_len);
 }
 
+// Bulk key lookup: one call for many sids. Output buffer is a sequence
+// of [u32 len][len bytes] entries aligned with the input sids; a missing
+// or tombstoned sid emits len=0. Caller frees with msi_free.
+char *msi_keys_of(void *h, const uint64_t *sids, uint64_t n,
+                  uint64_t *out_len) {
+    Index *ix = (Index *)h;
+    std::lock_guard<std::mutex> g(ix->mu);
+    std::string out;
+    out.reserve(n * 48);
+    std::string prefix;
+    std::string found;
+    for (uint64_t i = 0; i < n; i++) {
+        prefix.assign(1, 'S');
+        put_u64be(prefix, sids[i]);
+        found.clear();
+        uint32_t len = 0;
+        std::string key;
+        if (lookup_exact_prefix(ix, prefix, found) &&
+            !ix->tombstones.count(sids[i])) {
+            key = found.substr(9);
+            len = (uint32_t)key.size();
+        }
+        out.append((const char *)&len, 4);
+        out.append(key);
+    }
+    return alloc_out(out, out_len);
+}
+
 void msi_remove_sids(void *h, const uint64_t *sids, uint64_t n) {
     Index *ix = (Index *)h;
     std::lock_guard<std::mutex> g(ix->mu);

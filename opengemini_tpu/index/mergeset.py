@@ -64,6 +64,7 @@ def load():
             ("msi_enum_field", p, [p, ctypes.c_char, cp, u64,
                                    ctypes.c_uint32, u64p, u64p]),
             ("msi_key_of", p, [p, u64, u64p]),
+            ("msi_keys_of", p, [p, u64p, u64, u64p]),
             ("msi_remove_sids", None, [p, u64p, u64]),
             ("msi_flush", None, [p]),
             ("msi_compact", None, [p]),
@@ -275,6 +276,40 @@ class MergesetIndex:
         self.tags_of(sid)  # populate the cache
         mst, tags = self._tags_cache[sid]
         return mst, tags
+
+    def entries_bulk(self, sids) -> list[tuple[str, tuple] | None]:
+        """Batch series_entry: ONE native call for all sids (the per-sid
+        ctypes round-trip dominates high-cardinality label assembly).
+        Missing sids yield None."""
+        import numpy as _np
+
+        sids = [int(s) for s in _np.asarray(sids, dtype=_np.uint64).tolist()]
+        # results assemble into a local map FIRST: evicting the shared
+        # cache must never drop answers for already-cached sids in this
+        # very request
+        local = {s: self._tags_cache[s] for s in sids if s in self._tags_cache}
+        missing = [s for s in sids if s not in local]
+        if missing:
+            arr = (ctypes.c_uint64 * len(missing))(*missing)
+            n = ctypes.c_uint64()
+            with self._native() as h:
+                ptr = self._lib.msi_keys_of(h, arr, len(missing), ctypes.byref(n))
+            try:
+                raw = ctypes.string_at(ptr, n.value)
+            finally:
+                self._lib.msi_free(ptr)
+            off = 0
+            for sid in missing:
+                (ln,) = struct.unpack_from("<I", raw, off)
+                off += 4
+                if ln:
+                    _key, mst, tags = _unpack_series(raw[off:off + ln])
+                    local[sid] = (mst, tags)
+                off += ln
+            if len(self._tags_cache) + len(missing) >= _TAGS_CACHE_MAX:
+                self._tags_cache.clear()
+            self._tags_cache.update(local)
+        return [local.get(s) for s in sids]
 
     def iter_series_entries(self):
         for m in self.measurements():

@@ -198,33 +198,76 @@ class PromEngine:
         shards = self.engine.shards_for_range(db, None, t_min_ns, t_max_ns)
         out_labels: list[dict] = []
         out_samples: list[tuple[np.ndarray, np.ndarray]] = []
-        # series may span shards: merge by label key
-        per_key: dict[tuple, list] = {}
+        # series may span shards: merge by label key.
+        # per_key: key -> (tags, [(times_ms, values)])
+        per_key: dict[tuple, tuple] = {}
+
+        def add(tags: dict, t_ms: np.ndarray, vals: np.ndarray) -> None:
+            key = tuple(sorted(tags.items()))
+            got = per_key.get(key)
+            if got is None:
+                per_key[key] = (tags, [(t_ms, vals)])
+            else:
+                got[1].append((t_ms, vals))
+
+        vf = self.value_field
         for sh in shards:
-            sids = _match_sids(sh, metric, vs.matchers)
-            for sid in sorted(sids):
-                tags = sh.index.tags_of(sid)
-                key = tuple(sorted(tags.items()))
-                per_key.setdefault((key,), []).append((sh, sid, tags))
-        for (key,), entries in sorted(per_key.items()):
-            times_all, vals_all = [], []
-            for sh, sid, tags in entries:
-                rec = sh.read_series(metric, sid, t_min_ns, t_max_ns, fields=[self.value_field])
-                col = rec.columns.get(self.value_field)
+            sids = sorted(_match_sids(sh, metric, vs.matchers))
+            if not sids:
+                continue
+            if len(sids) >= 64 and hasattr(sh, "read_series_bulk"):
+                # batched multi-series decode: packed (colstore) chunks
+                # decode once for every matched series — the config-#5
+                # path (BASELINE.md) that replaces the per-sid loop
+                sid_arr, rec = sh.read_series_bulk(
+                    metric, np.asarray(sids, np.int64),
+                    t_min_ns, t_max_ns, fields=[vf])
+                col = rec.columns.get(vf)
                 if col is None or len(rec) == 0:
                     continue
-                valid = col.valid
-                times_all.append(rec.times[valid] // MS)
-                vals_all.append(col.values[valid].astype(np.float64))
-            if not times_all:
-                continue
-            t = np.concatenate(times_all)
-            v = np.concatenate(vals_all)
-            order = np.argsort(t, kind="stable")
-            labels = dict(entries[0][2])
+                times_ms = rec.times // MS
+                vals64 = col.values.astype(np.float64)
+                uniq, starts = np.unique(sid_arr, return_index=True)
+                ends = np.append(starts[1:], len(sid_arr))
+                if hasattr(sh.index, "entries_bulk"):
+                    entries = sh.index.entries_bulk(uniq)
+                else:
+                    entries = [(None, tuple(sh.index.tags_of(int(s)).items()))
+                               for s in uniq]
+                for (sid, lo, hi), entry in zip(
+                        zip(uniq, starts, ends), entries):
+                    if entry is None:
+                        continue
+                    m = col.valid[lo:hi]
+                    if not m.any():
+                        continue
+                    add(dict(entry[1]), times_ms[lo:hi][m], vals64[lo:hi][m])
+            else:
+                for sid in sids:
+                    rec = sh.read_series(metric, sid, t_min_ns, t_max_ns,
+                                         fields=[vf])
+                    col = rec.columns.get(vf)
+                    if col is None or len(rec) == 0:
+                        continue
+                    valid = col.valid
+                    if not valid.any():
+                        continue
+                    add(sh.index.tags_of(sid),
+                        rec.times[valid] // MS,
+                        col.values[valid].astype(np.float64))
+        for key in sorted(per_key):
+            tags, parts = per_key[key]
+            if len(parts) == 1:
+                t, v = parts[0]
+            else:
+                t = np.concatenate([p[0] for p in parts])
+                v = np.concatenate([p[1] for p in parts])
+                order = np.argsort(t, kind="stable")
+                t, v = t[order], v[order]
+            labels = dict(tags)
             labels["__name__"] = metric
             out_labels.append(labels)
-            out_samples.append((t[order], v[order]))
+            out_samples.append((t, v))
         return out_labels, out_samples
 
     def _eval_selector(self, vs, steps, db, window_s, instant):

@@ -1635,8 +1635,57 @@ class Executor:
         rows_scanned = 0
         time_segs: list[np.ndarray] = []
         time_vals: list[np.ndarray] = []
+
+        def _scan_record(rec, seg):
+            if time_aggs:
+                m = fmask if fmask is not None else slice(None)
+                time_segs.append(seg[m])
+                time_vals.append(rec.times[m])
+            _add_record_to_batches(
+                rec, seg, aligned, needed_fields, batches, dtype, fmask
+            )
+
         with trace.span("scan") as scan_span:
-            for sh, sid, gid in scan_plan:
+            # batched multi-series path: one bulk decode per shard when
+            # many series are scanned (packed colstore chunks decode once
+            # for all their series; kills the per-sid Python loop that
+            # dominated config #5 — BASELINE.md round-2 profile)
+            remaining_plan = scan_plan
+            if not pre_eligible:
+                by_shard: dict[int, tuple] = {}
+                for sh, sid, gid in scan_plan:
+                    by_shard.setdefault(id(sh), (sh, []))[1].append((sid, gid))
+                remaining_plan = []
+                for sh, pairs in by_shard.values():
+                    if len(pairs) < 64 or not hasattr(sh, "read_series_bulk"):
+                        remaining_plan.extend(
+                            (sh, sid, gid) for sid, gid in pairs)
+                        continue
+                    TRACKER.check()
+                    sid_list = np.asarray([p[0] for p in pairs], np.int64)
+                    gid_list = np.asarray([p[1] for p in pairs], np.int64)
+                    o = np.argsort(sid_list)
+                    sid_sorted, gid_sorted = sid_list[o], gid_list[o]
+                    sid_arr, rec = sh.read_series_bulk(
+                        mst, sid_sorted, tmin, tmax, fields=read_fields)
+                    if len(rec) == 0:
+                        continue
+                    rows_scanned += len(rec)
+                    fmask = (
+                        cond.eval_field_expr(sc.field_expr, rec)
+                        if sc.field_expr is not None
+                        else None
+                    )
+                    gid_rows = gid_sorted[np.searchsorted(sid_sorted, sid_arr)]
+                    if group_time:
+                        widx, _ = winmod.window_index(
+                            rec.times, tmin, group_time.every_ns,
+                            group_time.offset_ns)
+                        seg = (gid_rows * W + widx.astype(np.int64)).astype(np.int32)
+                    else:
+                        seg = gid_rows.astype(np.int32)
+                    _scan_record(rec, seg)
+            for sh, sid, gid in remaining_plan:
                 TRACKER.check()  # KILL QUERY cancellation point
                 if pre_eligible:
                     handled, got_rows = self._scan_preagg(
@@ -1663,13 +1712,7 @@ class Executor:
                     seg = (gid * W + widx.astype(np.int64)).astype(np.int32)
                 else:
                     seg = np.full(len(rec), gid, dtype=np.int32)
-                if time_aggs:
-                    m = fmask if fmask is not None else slice(None)
-                    time_segs.append(seg[m])
-                    time_vals.append(rec.times[m])
-                _add_record_to_batches(
-                    rec, seg, aligned, needed_fields, batches, dtype, fmask
-                )
+                _scan_record(rec, seg)
             scan_span.add_field("rows", rows_scanned)
         STATS.incr("executor", "rows_scanned", rows_scanned)
 
@@ -2748,6 +2791,10 @@ def _series_needs_merged_decode(sh, mst, sid, tmin, tmax):
     if mem_rec is not None and len(mem_rec.slice_time(tmin, tmax)):
         return True, None
     srcs = sh.file_chunks(mst, {sid}, tmin, tmax)
+    if any(c.packed for _r, c in srcs):
+        # packed chunks hold many series: their pre-agg is chunk-wide, so
+        # per-series fast paths must take the merged decode
+        return True, None
     metas = sorted((c for _r, c in srcs), key=lambda c: c.tmin)
     for a, b in zip(metas, metas[1:]):
         if b.tmin <= a.tmax:

@@ -83,16 +83,38 @@ class PreAgg:
 
 
 class ChunkMeta:
-    __slots__ = ("sid", "rows", "tmin", "tmax", "time_loc", "cols")
+    __slots__ = ("sid", "rows", "tmin", "tmax", "time_loc", "cols",
+                 "smin", "smax", "sid_loc", "sparse")
 
-    def __init__(self, sid, rows, tmin, tmax, time_loc, cols):
-        self.sid = sid
+    def __init__(self, sid, rows, tmin, tmax, time_loc, cols,
+                 smin=None, smax=None, sid_loc=None, sparse=None):
+        self.sid = sid  # None for packed (multi-series) chunks
         self.rows = rows
         self.tmin = tmin
         self.tmax = tmax
         self.time_loc = time_loc  # (off, len)
         # field -> {"v": (off,len), "m": (off,len)|None, "pre": PreAgg}
         self.cols = cols
+        # packed chunks (PK-sorted column store, reference
+        # engine/immutable/colstore): rows sorted by (sid, time); the
+        # sid column is its own block and `sparse` is the sparse
+        # primary-key index [(sid, row_offset)] every SPARSE_K rows
+        # (reference engine/index/sparseindex/primary_index.go)
+        self.smin = smin
+        self.smax = smax
+        self.sid_loc = sid_loc
+        self.sparse = sparse
+
+    @property
+    def packed(self) -> bool:
+        return self.sid is None
+
+
+# packed-chunk tuning: pack when a measurement flushes many series; the
+# sparse PK index records every SPARSE_K-th row boundary
+PACK_MIN_SERIES = 64
+PACK_ROWS = 131072
+SPARSE_K = 1024
 
 
 class TSFWriter:
@@ -137,6 +159,48 @@ class TSFWriter:
                 "rows": len(rec),
                 "tmin": int(rec.times[0]),
                 "tmax": int(rec.times[-1]),
+                "time": time_loc,
+                "cols": cols,
+            }
+        )
+
+    def add_packed_chunk(self, measurement: str, sids: np.ndarray,
+                         rec: Record) -> None:
+        """One multi-series chunk: rows sorted by (sid, time) — the
+        PK-sorted column store layout (reference:
+        engine/immutable/colstore/chunk_builder.go).  `sids` is int64,
+        aligned with rec rows, non-decreasing; rows of one sid are
+        time-sorted and deduped."""
+        if len(rec) == 0:
+            return
+        m = self._meta.setdefault(measurement, {"schema": {}, "chunks": []})
+        time_loc = self._write_block(encoding.encode_ints(rec.times))
+        sid_loc = self._write_block(encoding.encode_ints(sids))
+        sparse = [[int(sids[i]), i] for i in range(0, len(sids), SPARSE_K)]
+        cols = {}
+        for name, col in rec.columns.items():
+            have = m["schema"].get(name)
+            if have is None:
+                m["schema"][name] = int(col.ftype)
+            elif have != int(col.ftype):
+                raise ValueError(
+                    f"field type conflict in file for {name!r}: {have} vs {int(col.ftype)}"
+                )
+            vbuf, mbuf = encoding.encode_column(col)
+            vloc = self._write_block(vbuf)
+            mloc = self._write_block(mbuf) if mbuf else None
+            pre = PreAgg.of(col)
+            cols[name] = {"v": vloc, "m": mloc, "pre": pre.to_json()}
+        m["chunks"].append(
+            {
+                "packed": 1,
+                "smin": int(sids[0]),
+                "smax": int(sids[-1]),
+                "sids": sid_loc,
+                "sparse": sparse,
+                "rows": len(rec),
+                "tmin": int(rec.times.min()),
+                "tmax": int(rec.times.max()),
                 "time": time_loc,
                 "cols": cols,
             }
@@ -196,7 +260,17 @@ class TSFReader:
                     }
                     for name, cc in c["cols"].items()
                 }
-                cm = ChunkMeta(c["sid"], c["rows"], c["tmin"], c["tmax"], tuple(c["time"]), cols)
+                if c.get("packed"):
+                    cm = ChunkMeta(
+                        None, c["rows"], c["tmin"], c["tmax"],
+                        tuple(c["time"]), cols,
+                        smin=c["smin"], smax=c["smax"],
+                        sid_loc=tuple(c["sids"]),
+                        sparse=[(p0, p1) for p0, p1 in c["sparse"]],
+                    )
+                else:
+                    cm = ChunkMeta(c["sid"], c["rows"], c["tmin"], c["tmax"],
+                                   tuple(c["time"]), cols)
                 chunks.append(cm)
                 if self.tmin is None or cm.tmin < self.tmin:
                     self.tmin = cm.tmin
@@ -214,14 +288,23 @@ class TSFReader:
         # chunks); without this a scan over S series costs S x all-chunks
         # meta filtering — quadratic at high cardinality
         self._sid_chunks: dict[str, dict[int, list[ChunkMeta]]] = {}
+        # packed chunks are listed separately: a single-sid lookup takes
+        # its per-sid chunks PLUS the packed chunks whose [smin, smax]
+        # span covers the sid (sparse index narrows the rows at read time)
+        self._packed_chunks: dict[str, list[ChunkMeta]] = {}
         for mst, (_s, chunks) in self.meta.items():
             bf = BloomFilter(len(chunks))
             by_sid: dict[int, list[ChunkMeta]] = {}
+            packed: list[ChunkMeta] = []
             for c in chunks:
+                if c.packed:
+                    packed.append(c)
+                    continue
                 bf.add(c.sid)
                 by_sid.setdefault(c.sid, []).append(c)
             self._sid_bloom[mst] = bf
             self._sid_chunks[mst] = by_sid
+            self._packed_chunks[mst] = packed
 
     def close(self) -> None:
         self._f.close()
@@ -245,17 +328,30 @@ class TSFReader:
         entry = self.meta.get(measurement)
         if entry is None:
             return []
+        packed = self._packed_chunks.get(measurement, ())
         if sids is not None and len(sids) == 1:
             sid = next(iter(sids))
             bf = self._sid_bloom.get(measurement)
             if bf is not None and sid not in bf:
-                return []
-            cand = self._sid_chunks.get(measurement, {}).get(sid, ())
+                cand = ()
+            else:
+                cand = self._sid_chunks.get(measurement, {}).get(sid, ())
         else:
             cand = entry[1]
         out = []
         for c in cand:
+            if c.packed:
+                continue  # appended below with the sid-span filter
             if sids is not None and c.sid not in sids:
+                continue
+            if tmin is not None and c.tmax < tmin:
+                continue
+            if tmax is not None and c.tmin >= tmax:
+                continue
+            out.append(c)
+        for c in packed:
+            if sids is not None and not any(
+                    c.smin <= s_ <= c.smax for s_ in sids):
                 continue
             if tmin is not None and c.tmax < tmin:
                 continue
@@ -334,6 +430,79 @@ class TSFReader:
             cols[name] = (self._cached_col((id(chunk), name), decode)
                           if cache else decode())
         return Record(times, cols)
+
+
+    # -- packed (PK-sorted column store) reads ------------------------------
+
+    def read_packed_sids(self, chunk: ChunkMeta, cache: bool = True) -> np.ndarray:
+        """The sid column of a packed chunk (non-decreasing int64)."""
+        def decode():
+            return encoding.decode_ints(self._read(chunk.sid_loc))
+
+        return (self._cached_col((id(chunk), "\x00sids"), decode)
+                if cache else decode())
+
+    def read_packed_sid(
+        self, measurement: str, chunk: ChunkMeta, sid: int,
+        fields: list[str] | None = None, cache: bool = True,
+    ) -> Record:
+        """One series' rows out of a packed chunk: the sparse PK index
+        bounds the candidate row window (and rejects out-of-span sids
+        without touching data), then an exact binary search on the
+        (cached) sid column finds the rows — the hybrid store reader
+        (reference engine/immutable/colstore reader +
+        sparseindex/primary_index.go)."""
+        if sid < chunk.smin or sid > chunk.smax:
+            return Record(np.empty(0, np.int64), {})
+        # sparse index: the sid's run lies strictly between the last
+        # sparse entry with entry_sid < sid and the first entry with
+        # entry_sid > sid (entries sample every SPARSE_K rows)
+        import bisect
+
+        sp = chunk.sparse or []
+        entry_sids = [e[0] for e in sp]
+        j = bisect.bisect_left(entry_sids, sid)
+        w_lo = sp[j - 1][1] if j > 0 else 0
+        k = bisect.bisect_right(entry_sids, sid)
+        w_hi = sp[k][1] if k < len(sp) else chunk.rows
+        sids = self.read_packed_sids(chunk, cache)
+        win = sids[w_lo:w_hi]
+        lo = w_lo + int(np.searchsorted(win, sid, "left"))
+        hi = w_lo + int(np.searchsorted(win, sid, "right"))
+        if lo == hi:
+            return Record(np.empty(0, np.int64), {})
+        rec = self.read_chunk(measurement, chunk, fields, cache)
+        return Record(
+            rec.times[lo:hi],
+            {
+                name: Column(col.ftype, col.values[lo:hi], col.valid[lo:hi])
+                for name, col in rec.columns.items()
+            },
+        )
+
+    def read_packed_bulk(
+        self, measurement: str, chunk: ChunkMeta,
+        fields: list[str] | None = None,
+        sid_filter: np.ndarray | None = None, cache: bool = True,
+    ) -> tuple[np.ndarray, Record]:
+        """(sids, record) of a packed chunk in ONE decode; when
+        `sid_filter` (sorted int64 array) is given, rows are masked to
+        those series — the batched multi-series scan that replaces
+        per-sid Python loops at high cardinality."""
+        sids = self.read_packed_sids(chunk, cache)
+        rec = self.read_chunk(measurement, chunk, fields, cache)
+        if sid_filter is None:
+            return sids, rec
+        keep = np.isin(sids, sid_filter)
+        if keep.all():
+            return sids, rec
+        return sids[keep], Record(
+            rec.times[keep],
+            {
+                name: Column(col.ftype, col.values[keep], col.valid[keep])
+                for name, col in rec.columns.items()
+            },
+        )
 
 
 class CorruptFile(Exception):

@@ -1,0 +1,179 @@
+"""PK-sorted packed column store (reference: engine/immutable/colstore,
+engine/index/sparseindex/primary_index.go): high-cardinality flushes pack
+many series into multi-series chunks sorted by (sid, time), with a sparse
+primary-key index for per-series extraction and a one-decode bulk read."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.record import FieldType
+
+from opengemini_tpu.storage.shard import Shard
+from opengemini_tpu.storage.tsf import PACK_MIN_SERIES, TSFReader
+
+NS = 1_000_000_000
+BASE = 1_700_000_000 * NS
+
+
+@pytest.fixture
+def shard(tmp_path):
+    sh = Shard(str(tmp_path / "s1"), BASE - NS, BASE + 10_000 * NS)
+    yield sh
+    sh.close()
+
+
+def _write_series(sh, n_series, points_per=3, mst="m"):
+    pts = []
+    for s in range(n_series):
+        for p in range(points_per):
+            pts.append((
+                mst, (("host", f"h{s:05d}"),), BASE + p * NS,
+                {"v": (FieldType.FLOAT, float(s * 1000 + p))},
+            ))
+    sh.write_points_structured(pts)
+
+
+class TestPackedFlush:
+    def test_high_cardinality_flush_packs(self, shard):
+        _write_series(shard, PACK_MIN_SERIES + 10)
+        shard.flush()
+        r = shard._files[-1]
+        chunks = r.chunks("m")
+        assert all(c.packed for c in chunks)
+        # far fewer chunks than series
+        assert len(chunks) < PACK_MIN_SERIES
+        assert chunks[0].sparse and chunks[0].sparse[0][1] == 0
+
+    def test_low_cardinality_stays_per_sid(self, shard):
+        _write_series(shard, 5)
+        shard.flush()
+        chunks = shard._files[-1].chunks("m")
+        assert all(not c.packed for c in chunks)
+        assert len(chunks) == 5
+
+    def test_read_series_from_packed(self, shard):
+        n = PACK_MIN_SERIES + 10
+        _write_series(shard, n)
+        shard.flush()
+        for s in (0, 17, n - 1):
+            sid = shard.index.get_or_create("m", (("host", f"h{s:05d}"),))
+            rec = shard.read_series("m", sid)
+            assert len(rec) == 3
+            assert list(rec.columns["v"].values) == [s * 1000 + p for p in range(3)]
+
+    def test_restart_reload(self, tmp_path):
+        sh = Shard(str(tmp_path / "s2"), BASE - NS, BASE + 10_000 * NS)
+        _write_series(sh, PACK_MIN_SERIES + 5)
+        sh.flush()
+        path = sh._files[-1].path
+        sh.close()
+        r = TSFReader(path)
+        assert all(c.packed for c in r.chunks("m"))
+        rec = r.read_packed_sid("m", r.chunks("m")[0], 1)
+        assert len(rec) == 3
+        r.close()
+
+
+class TestBulkRead:
+    def test_bulk_matches_per_sid(self, shard):
+        n = PACK_MIN_SERIES + 20
+        _write_series(shard, n)
+        shard.flush()
+        # late rows for some series land in the memtable (merge coverage)
+        shard.write_points_structured([
+            ("m", (("host", "h00003"),), BASE + 1 * NS,
+             {"v": (FieldType.FLOAT, 999.0)}),  # overwrite
+            ("m", (("host", "h00007"),), BASE + 50 * NS,
+             {"v": (FieldType.FLOAT, 777.0)}),  # append
+        ])
+        sids = [shard.index.get_or_create("m", (("host", f"h{s:05d}"),))
+                for s in range(n)]
+        sid_arr, rec = shard.read_series_bulk("m", np.asarray(sids))
+        # parity with the per-sid merged view
+        at = 0
+        for sid in sorted(sids):
+            ref = shard.read_series("m", sid)
+            k = len(ref)
+            assert (sid_arr[at:at + k] == sid).all()
+            assert (rec.times[at:at + k] == ref.times).all()
+            got = rec.columns["v"]
+            want = ref.columns["v"]
+            assert (got.valid[at:at + k] == want.valid).all()
+            assert (got.values[at:at + k][want.valid] == want.values[want.valid]).all()
+            at += k
+        assert at == len(rec)
+
+    def test_bulk_time_slice_and_filter(self, shard):
+        n = PACK_MIN_SERIES + 8
+        _write_series(shard, n, points_per=5)
+        shard.flush()
+        some = np.asarray([2, 9, 31], dtype=np.int64) + 1  # sids are 1-based
+        sid_arr, rec = shard.read_series_bulk(
+            "m", some, tmin=BASE + 1 * NS, tmax=BASE + 3 * NS)
+        assert set(sid_arr.tolist()) <= set(some.tolist())
+        assert ((rec.times >= BASE + NS) & (rec.times < BASE + 3 * NS)).all()
+        # 2 points in range per selected series
+        assert len(rec) == 2 * len(some)
+
+
+class TestCompaction:
+    def test_compact_repacks(self, shard):
+        n = PACK_MIN_SERIES + 4
+        _write_series(shard, n)
+        shard.flush()
+        shard.write_points_structured([
+            ("m", (("host", f"h{s:05d}"),), BASE + 10 * NS,
+             {"v": (FieldType.FLOAT, float(s))}) for s in range(n)
+        ])
+        shard.flush()
+        assert shard.compact()
+        chunks = shard._files[-1].chunks("m")
+        assert all(c.packed for c in chunks)
+        sid = shard.index.get_or_create("m", (("host", "h00002"),))
+        rec = shard.read_series("m", sid)
+        assert len(rec) == 4  # 3 original + 1 late
+
+
+class TestBulkDedupSemantics:
+    def test_partial_field_overwrite_row_wins(self, shard):
+        """Duplicate (sid, time) keeps the newest ROW whole — a partial
+        overwrite drops the old row's other fields, exactly like the
+        per-sid merged view (merge_sorted_records row semantics)."""
+        n = PACK_MIN_SERIES + 2
+        _write_series(shard, n)
+        sid = shard.index.get_or_create("m", (("host", "h00004"),))
+        shard.write_points_structured([
+            ("m", (("host", "h00004"),), BASE + 0 * NS,
+             {"v": (FieldType.FLOAT, 1.0), "w": (FieldType.FLOAT, 2.0)}),
+        ])
+        shard.flush()
+        shard.write_points_structured([
+            ("m", (("host", "h00004"),), BASE + 0 * NS,
+             {"v": (FieldType.FLOAT, 9.0)}),  # no w: old w must drop
+        ])
+        ref = shard.read_series("m", sid)
+        sid_arr, rec = shard.read_series_bulk(
+            "m", np.asarray([sid], dtype=np.int64))
+        i = int(np.searchsorted(rec.times, BASE))
+        j = int(np.searchsorted(ref.times, BASE))
+        assert rec.columns["v"].values[i] == ref.columns["v"].values[j] == 9.0
+        assert bool(rec.columns["w"].valid[i]) == bool(ref.columns["w"].valid[j])
+
+    def test_per_sid_then_packed_file_order(self, tmp_path):
+        """A newer packed chunk must beat an older per-sid chunk for the
+        same (sid, time) in the bulk path."""
+        sh = Shard(str(tmp_path / "s3"), BASE - NS, BASE + 10_000 * NS)
+        # flush 1: low cardinality -> per-sid chunks
+        sh.write_points_structured([
+            ("m", (("host", "h00000"),), BASE, {"v": (FieldType.FLOAT, 1.0)}),
+        ])
+        sh.flush()
+        # flush 2: high cardinality -> packed chunk, overwrites h00000@BASE
+        _write_series(sh, PACK_MIN_SERIES + 2, points_per=1)
+        sh.flush()
+        sid = sh.index.get_or_create("m", (("host", "h00000"),))
+        sid_arr, rec = sh.read_series_bulk("m", np.asarray([sid]))
+        assert len(rec) == 1
+        assert rec.columns["v"].values[0] == 0.0  # packed value (s*1000+p = 0)
+        assert rec.columns["v"].values[0] == sh.read_series("m", sid).columns["v"].values[0]
+        sh.close()
