@@ -265,6 +265,12 @@ def _make_handler(svc: HttpService):
                 bucket = params.get("bucket", "")
                 db, _, rp = bucket.partition("/")
                 self._handle_write(params, db=db, rp=rp or None)
+            elif path == "/api/v1/prom/write":
+                self._handle_prom_remote_write(params)
+            elif path == "/api/v1/prom/read":
+                self._handle_prom_remote_read(params)
+            elif path == "/api/v1/otlp/metrics":
+                self._handle_otlp_metrics(params)
             elif path.startswith("/api/v1/"):
                 self._merge_form_body(params)
                 self._handle_prom(path, params)
@@ -708,6 +714,160 @@ def _make_handler(svc: HttpService):
                 "cursor": next_cursor,
                 "exhausted": total - (skip_at_t - remaining_skip) - len(out) <= 0,
             })
+
+        def _check_write_auth(self, params: dict, db: str) -> bool:
+            user = self._authenticate(params)
+            if user is False:
+                return False
+            if svc.auth_enabled and not (user and user.can("WRITE", db)):
+                code = 401 if user is None else 403
+                self._send_json(
+                    code, {"error": f"write not authorized on {db!r}"})
+                return False
+            if not db:
+                self._send_json(400, {"error": "database is required"})
+                return False
+            return True
+
+        def _maybe_snappy(self, data: bytes) -> bytes:
+            """Remote write/read bodies are snappy block compressed
+            (Content-Encoding: snappy); tolerate raw protobuf too."""
+            from opengemini_tpu.ingest import protowire as pw
+
+            if self.headers.get("Content-Encoding") == "snappy":
+                return pw.snappy_uncompress(data)
+            try:
+                return pw.snappy_uncompress(data)
+            except pw.WireError:
+                return data
+
+        def _write_decoded_points(self, db: str, rp, points) -> bool:
+            try:
+                router = getattr(svc, "router", None)
+                if router is not None:
+                    router.routed_write(db, rp, points)
+                else:
+                    svc.engine.write_rows(db, points, rp=rp)
+            except DatabaseNotFound as e:
+                self._send_json(404, {"error": str(e)})
+                return False
+            except (FieldTypeConflict, ValueError) as e:
+                self._send_json(400, {"error": f"partial write: {e}"})
+                return False
+            except WriteError as e:
+                self._send_json(403, {"error": str(e)})
+                return False
+            return True
+
+        def _handle_prom_remote_write(self, params: dict) -> None:
+            """Prometheus remote write: snappy(protobuf WriteRequest)
+            (reference: handler_prom.go:86 servePromWrite)."""
+            from opengemini_tpu.ingest import prom_remote
+            from opengemini_tpu.ingest.protowire import WireError
+
+            db = params.get("db", "")
+            if not self._check_write_auth(params, db):
+                return
+            try:
+                body = self._maybe_snappy(self._body())
+                points = prom_remote.decode_write_request(body)
+            except (WireError, UnicodeDecodeError) as e:
+                self._send_json(400, {"error": f"bad remote write body: {e}"})
+                return
+            if self._write_decoded_points(db, params.get("rp") or None, points):
+                self._send(204)
+
+        def _handle_prom_remote_read(self, params: dict) -> None:
+            """Prometheus remote read: snappy(ReadRequest) ->
+            snappy(ReadResponse) raw samples (reference:
+            handler_prom.go servePromRead)."""
+            from opengemini_tpu.ingest import prom_remote
+            from opengemini_tpu.ingest import protowire as pw
+            from opengemini_tpu.promql.engine import _match_sids
+            from opengemini_tpu.promql.parser import LabelMatcher
+
+            db = params.get("db", "")
+            user = self._authenticate(params)
+            if user is False:
+                return
+            if svc.auth_enabled and not (user and user.can("READ", db)):
+                code = 401 if user is None else 403
+                self._send_json(code, {"error": f"read not authorized on {db!r}"})
+                return
+            if not db:
+                self._send_json(400, {"error": "database is required"})
+                return
+            try:
+                body = self._maybe_snappy(self._body())
+                queries = prom_remote.decode_read_request(body)
+            except pw.WireError as e:
+                self._send_json(400, {"error": f"bad remote read body: {e}"})
+                return
+            MS = 1_000_000
+            results = []
+            for q in queries:
+                metric = ""
+                matchers = []
+                for op, name, value in q["matchers"]:
+                    if name == "__name__" and op == "=":
+                        metric = value
+                    else:
+                        matchers.append(LabelMatcher(name, op, value))
+                series_out = []
+                if metric:
+                    tmin = q["start_ms"] * MS
+                    tmax = q["end_ms"] * MS + 1
+                    per_key: dict = {}
+                    for sh in svc.engine.shards_for_range(db, None, tmin, tmax):
+                        for sid in sorted(_match_sids(sh, metric, matchers)):
+                            rec = sh.read_series(
+                                metric, sid, tmin, tmax,
+                                fields=[prom_remote.VALUE_FIELD])
+                            col = rec.columns.get(prom_remote.VALUE_FIELD)
+                            if col is None or not len(rec):
+                                continue
+                            tags = sh.index.tags_of(sid)
+                            key = tuple(sorted(tags.items()))
+                            bucket = per_key.setdefault(key, (dict(tags), []))
+                            v = col.valid
+                            bucket[1].extend(
+                                zip((rec.times[v] // MS).tolist(),
+                                    col.values[v].tolist()))
+                    for key in sorted(per_key):
+                        labels, samples = per_key[key]
+                        labels["__name__"] = metric
+                        series_out.append((labels, sorted(samples)))
+                results.append(series_out)
+            payload = prom_remote.encode_read_response(results)
+            from opengemini_tpu.ingest.protowire import snappy_compress_literal
+            out = snappy_compress_literal(payload)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-protobuf")
+            self.send_header("Content-Encoding", "snappy")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def _handle_otlp_metrics(self, params: dict) -> None:
+            """OTLP/HTTP metrics export (protobuf body, optional gzip)
+            (reference: handler_otlp.go serveOtlpMetricsWrite)."""
+            from opengemini_tpu.ingest import otlp
+            from opengemini_tpu.ingest.protowire import WireError
+
+            db = params.get("db", "")
+            if not self._check_write_auth(params, db):
+                return
+            try:
+                points = otlp.decode_metrics_request(self._body())
+            except (WireError, UnicodeDecodeError) as e:
+                self._send_json(400, {"error": f"bad OTLP body: {e}"})
+                return
+            if self._write_decoded_points(db, params.get("rp") or None, points):
+                # empty ExportMetricsServiceResponse
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-protobuf")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
 
         def _handle_write(self, params: dict, db: str, rp):
             internal = bool(self.headers.get("X-Ogt-Internal"))

@@ -356,3 +356,136 @@ def test_prom_series_post_form_body(server):
     assert status == 200
     data = json.loads(out)["data"]
     assert data and data[0]["job"] == "api"
+
+
+# -- prometheus remote write/read + OTLP ingest ------------------------------
+
+
+def _varint(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _pb_len(fnum, payload):
+    return _varint((fnum << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _pb_sample(value, t_ms):
+    import struct
+    return (_varint((1 << 3) | 1) + struct.pack("<d", value)
+            + _varint((2 << 3) | 0) + _varint(t_ms & ((1 << 64) - 1)))
+
+
+def _pb_label(name, value):
+    return _pb_len(1, name.encode()) + _pb_len(2, value.encode())
+
+
+def _write_request(series):
+    """series: [(labels_dict, [(t_ms, v)])] -> WriteRequest bytes."""
+    out = b""
+    for labels, samples in series:
+        ts = b""
+        for n, v in labels.items():
+            ts += _pb_len(1, _pb_label(n, v))
+        for t_ms, val in samples:
+            ts += _pb_len(2, _pb_sample(val, t_ms))
+        out += _pb_len(1, ts)
+    return out
+
+
+def test_prom_remote_write_and_query(server):
+    from opengemini_tpu.ingest.protowire import snappy_compress_literal
+
+    body = snappy_compress_literal(_write_request([
+        ({"__name__": "http_requests_total", "job": "api", "instance": "a"},
+         [(BASE * 1000, 1.0), ((BASE + 15) * 1000, 5.0)]),
+        ({"__name__": "http_requests_total", "job": "api", "instance": "b"},
+         [(BASE * 1000, 2.0)]),
+    ]))
+    status, resp = post(server, "/api/v1/prom/write", body,
+                        headers={"Content-Encoding": "snappy"}, db="db")
+    assert status == 204, resp
+    # readable through InfluxQL...
+    status, resp = get(server, "/query", db="db",
+                       q="SELECT count(value) FROM http_requests_total")
+    s = json.loads(resp)["results"][0]["series"][0]
+    assert s["values"][0][1] == 3
+    # ...and through the Prom HTTP API
+    status, resp = get(server, "/api/v1/query", db="db",
+                       query='http_requests_total{instance="a"}',
+                       time=str(BASE + 20))
+    data = json.loads(resp)["data"]["result"]
+    assert len(data) == 1 and float(data[0]["value"][1]) == 5.0
+
+
+def test_prom_remote_read(server):
+    from opengemini_tpu.ingest import prom_remote
+    from opengemini_tpu.ingest.protowire import (
+        snappy_compress_literal, snappy_uncompress)
+
+    post(server, "/api/v1/prom/write", snappy_compress_literal(_write_request([
+        ({"__name__": "m1", "host": "x"}, [(BASE * 1000, 7.0)]),
+    ])), headers={"Content-Encoding": "snappy"}, db="db")
+    # ReadRequest: one query, matcher __name__ = m1
+    matcher = (_varint((1 << 3) | 0) + _varint(0)
+               + _pb_len(2, b"__name__") + _pb_len(3, b"m1"))
+    q = (_varint((1 << 3) | 0) + _varint((BASE - 10) * 1000)
+         + _varint((2 << 3) | 0) + _varint((BASE + 10) * 1000)
+         + _pb_len(3, matcher))
+    req = _pb_len(1, q)
+    status, resp = post(server, "/api/v1/prom/read",
+                        snappy_compress_literal(req),
+                        headers={"Content-Encoding": "snappy"}, db="db")
+    assert status == 200, resp
+    payload = snappy_uncompress(resp)
+    from opengemini_tpu.ingest import protowire as pw
+    results = [v for f, _w, v in pw.fields(payload) if f == 1]
+    assert len(results) == 1
+    ts_bufs = [v for f, _w, v in pw.fields(results[0]) if f == 1]
+    assert len(ts_bufs) == 1
+    labels = {}
+    samples = []
+    for f, w, v in pw.fields(ts_bufs[0]):
+        if f == 1:
+            kv = dict()
+            for f2, _w2, v2 in pw.fields(v):
+                kv[f2] = v2.decode()
+            labels[kv[1]] = kv[2]
+        elif f == 2:
+            vals = {f3: (w3, v3) for f3, w3, v3 in pw.fields(v)}
+            samples.append((pw.as_double(*vals[1]), vals[2][1]))
+    assert labels["__name__"] == "m1" and labels["host"] == "x"
+    assert samples == [(7.0, BASE * 1000)]
+
+
+def test_otlp_metrics_ingest(server):
+    import struct
+
+    def kv(key, val_any):
+        return _pb_len(1, key.encode()) + _pb_len(2, val_any)
+
+    t_ns = BASE * 10**9
+    # NumberDataPoint: attrs(7), time(3 fixed64), as_double(4)
+    dp = (_pb_len(7, kv("host", _pb_len(1, b"h1")))
+          + _varint((3 << 3) | 1) + struct.pack("<Q", t_ns)
+          + _varint((4 << 3) | 1) + struct.pack("<d", 42.5))
+    gauge = _pb_len(1, dp)
+    metric = _pb_len(1, b"cpu_temp") + _pb_len(5, gauge)
+    scope = _pb_len(2, metric)
+    resource = _pb_len(1, kv("service", _pb_len(1, b"svc1")))
+    rm = _pb_len(1, resource) + _pb_len(2, scope)
+    req = _pb_len(1, rm)
+    status, resp = post(server, "/api/v1/otlp/metrics", req, db="db")
+    assert status == 200, resp
+    status, resp = get(server, "/query", db="db",
+                       q="SELECT gauge FROM cpu_temp GROUP BY *", epoch="ns")
+    s = json.loads(resp)["results"][0]["series"][0]
+    assert s["tags"] == {"host": "h1", "service": "svc1"}
+    assert s["values"][0] == [t_ns, 42.5]
