@@ -460,6 +460,21 @@ def _make_handler(svc: HttpService):
                 svc.engine.write_disabled = on
             elif mod == "flush":
                 svc.engine.flush_all()
+            elif mod == "failpoint":
+                from opengemini_tpu.utils import failpoint as _fpmod
+
+                name = params.get("name", "")
+                action = params.get("action", "")
+                if not name:
+                    self._send_json(200, {"active": _fpmod.active()})
+                    return
+                if action in ("", "off"):
+                    _fpmod.disable(name)
+                else:
+                    _fpmod.enable(name, action)
+                self._send_json(200, {"status": "ok", "failpoint": name,
+                                      "action": action or "off"})
+                return
             else:
                 self._send_json(400, {"error": f"unknown syscontrol mod {mod!r}"})
                 return

@@ -27,6 +27,13 @@ class CompactionService(Service):
                 # merge O(run) not O(shard)
                 while shard.compact_level(fanout=fanout):
                     n += 1
+                # out-of-order: late-arriving data leaves time-overlapping
+                # files that leveled runs may never pick up; merge them
+                # away so read-side merge amplification stays bounded
+                # (reference: immutable/merge_out_of_order.go)
+                while (shard.has_time_overlap()
+                       and shard.compact_out_of_order(max_files=fanout)):
+                    n += 1
                 # mixed levels can still let the count run away: full
                 # merge as the independent backstop
                 if shard.file_count() > 8 * fanout:

@@ -23,6 +23,7 @@ from opengemini_tpu.storage.tsf import (
     PACK_MIN_SERIES, PACK_ROWS, TSFReader, TSFWriter,
 )
 from opengemini_tpu.storage.wal import WAL
+from opengemini_tpu.utils.failpoint import inject as _fp
 
 
 def _pack_entries(buffer: list) -> tuple[np.ndarray, Record]:
@@ -259,6 +260,7 @@ class Shard:
                     per_mst.setdefault(mst, []).append((sid, rec))
                 for mst, entries in per_mst.items():
                     _write_measurement_chunks(w, tidx, mst, entries)
+                _fp("shard-flush-before-publish")  # reference: engine/shard.go:457
                 w.finish()
             except BaseException:
                 w.abort()
@@ -267,6 +269,7 @@ class Shard:
             self._next_file_seq += 1
             self._files.append(TSFReader(path))
             self.mem = MemTable(self.schemas)
+            _fp("shard-flush-before-wal-truncate")
             self.wal.truncate()
 
     @staticmethod
@@ -347,6 +350,7 @@ class Shard:
             tidx = _TextSidecar()
             try:
                 self._merge_readers(self._files, w, tidx)
+                _fp("compact-before-replace")
                 w.finish()
             except BaseException:
                 w.abort()
@@ -400,26 +404,81 @@ class Shard:
             if best is None:
                 return False
             i0, n = best
-            run = self._files[i0 : i0 + n]
-            target = run[0].path
-            tmp = target + ".merge"
-            w = TSFWriter(tmp)
-            tidx = _TextSidecar()
-            try:
-                self._merge_readers(run, w, tidx)
-                w.finish()  # atomically lands at tmp
-            except BaseException:
-                w.abort()
-                raise
-            os.replace(tmp, target)  # new content under the run's 1st name
-            tidx.write(target)
-            new_reader = TSFReader(target)
-            retired = run[1:]
-            self._files = (
-                self._files[:i0] + [new_reader] + self._files[i0 + n :]
+            self._merge_run_locked(i0, n)
+            return True
+
+    def _merge_run_locked(self, i0: int, n: int) -> None:
+        """Merge the contiguous file run [i0, i0+n) into one file landing
+        at the run's FIRST position (file-order LWW stays correct).
+        Caller holds self._lock."""
+        run = self._files[i0 : i0 + n]
+        target = run[0].path
+        tmp = target + ".merge"
+        w = TSFWriter(tmp)
+        tidx = _TextSidecar()
+        try:
+            self._merge_readers(run, w, tidx)
+            w.finish()  # atomically lands at tmp
+        except BaseException:
+            w.abort()
+            raise
+        _fp("compact-before-replace")
+        os.replace(tmp, target)  # new content under the run's 1st name
+        tidx.write(target)
+        new_reader = TSFReader(target)
+        retired = run[1:]
+        self._files = (
+            self._files[:i0] + [new_reader] + self._files[i0 + n :]
+        )
+        self._tidx_cache = {}
+        _retire_files(retired)  # the old run[0] reader keeps its fd
+
+    def has_time_overlap(self) -> bool:
+        """True when any two immutable files' time ranges overlap (the
+        out-of-order state that inflates every read with merge work)."""
+        with self._lock:
+            ranges = sorted(
+                (r.tmin, r.tmax) for r in self._files if r.tmin is not None
             )
-            self._tidx_cache = {}
-            _retire_files(retired)  # the old run[0] reader keeps its fd
+        for (a_lo, a_hi), (b_lo, b_hi) in zip(ranges, ranges[1:]):
+            if b_lo <= a_hi:
+                return True
+        return False
+
+    def compact_out_of_order(self, max_files: int = 4) -> bool:
+        """Merge time-OVERLAPPING files regardless of level (reference:
+        engine/immutable/merge_out_of_order.go).  Late-arriving data
+        lands in new files whose ranges overlap old ones; leveled
+        compaction alone only merges once >= fanout same-level files
+        pile up, so overlap — and with it per-read merge amplification —
+        could persist indefinitely.  Merges the contiguous run from the
+        first overlapping file toward its overlap partner, capped at
+        `max_files` per call; repeated calls converge to disjoint
+        ranges."""
+        with self._lock:
+            if len(self._files) < 2:
+                return False
+            ranges = [(r.tmin, r.tmax) for r in self._files]
+            pick = None
+            for i in range(len(ranges)):
+                if ranges[i][0] is None:
+                    continue
+                for j in range(i + 1, len(ranges)):
+                    if ranges[j][0] is None:
+                        continue
+                    if (ranges[j][0] <= ranges[i][1]
+                            and ranges[i][0] <= ranges[j][1]):
+                        pick = (i, j)
+                        break
+                if pick:
+                    break
+            if pick is None:
+                return False
+            i, j = pick
+            # the run must stay contiguous (an intervening file's rows
+            # must not change rank relative to the merge output)
+            n = min(j - i + 1, max(2, max_files))
+            self._merge_run_locked(i, n)
             return True
 
     def rewrite_downsampled(self, every_ns: int, field_aggs: dict | None = None) -> int:

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import json
 import os
+
+from opengemini_tpu.utils.failpoint import inject as _fp
 import struct
 import zlib
 
@@ -46,6 +48,7 @@ class WAL:
         self._f.write(_HEADER.pack(len(payload), crc, _KIND_RAW_LINES) + payload)
         if self.sync:
             self._f.flush()
+            _fp("wal-before-sync")  # reference: engine/wal.go:391
             os.fsync(self._f.fileno())
 
     def append_points(self, points: list) -> None:
@@ -60,6 +63,7 @@ class WAL:
         self._f.write(_HEADER.pack(len(payload), crc, _KIND_POINTS) + payload)
         if self.sync:
             self._f.flush()
+            _fp("wal-before-sync")  # reference: engine/wal.go:391
             os.fsync(self._f.fileno())
 
     def flush(self) -> None:

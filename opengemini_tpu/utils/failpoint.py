@@ -1,0 +1,95 @@
+"""Failpoint-style fault injection.
+
+Reference: pingcap/failpoint sites in the WAL/flush/compaction paths
+(engine/shard.go:457, engine/wal.go:391, enabled via gofail in
+Makefile.common:26-27).  Sites are free at runtime when no failpoint is
+armed (one dict lookup on an empty dict).
+
+Arming:
+  - code:      failpoint.enable("shard-flush-before-publish", "error")
+  - env:       OGTPU_FAILPOINTS="wal-before-sync=error;flush=sleep:0.5"
+  - syscontrol: POST /debug/ctrl?mod=failpoint&name=...&action=...
+
+Actions: "error" (raise FailpointError), "panic" (os._exit(13): a hard
+crash the recovery paths must survive), "sleep:<seconds>", or a callable
+registered via enable().  Counts are recorded for assertions.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+_active: dict[str, object] = {}
+_hits: dict[str, int] = {}
+
+
+class FailpointError(RuntimeError):
+    def __init__(self, name: str):
+        super().__init__(f"failpoint {name!r} injected error")
+        self.name = name
+
+
+def _load_env() -> None:
+    spec = os.environ.get("OGTPU_FAILPOINTS", "")
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, action = part.partition("=")
+        _active[name.strip()] = action.strip()
+
+
+_load_env()
+
+
+def enable(name: str, action) -> None:
+    with _lock:
+        _active[name] = action
+
+
+def disable(name: str) -> None:
+    with _lock:
+        _active.pop(name, None)
+
+
+def disable_all() -> None:
+    with _lock:
+        _active.clear()
+        _hits.clear()
+
+
+def active() -> dict:
+    with _lock:
+        return dict(_active)
+
+
+def hits(name: str) -> int:
+    with _lock:
+        return _hits.get(name, 0)
+
+
+def inject(name: str) -> None:
+    """The site hook. No-op unless `name` is armed."""
+    if not _active:  # fast path: nothing armed anywhere
+        return
+    with _lock:
+        action = _active.get(name)
+        if action is None:
+            return
+        _hits[name] = _hits.get(name, 0) + 1
+    if callable(action):
+        action()
+        return
+    if action == "error":
+        raise FailpointError(name)
+    if action == "panic":
+        os._exit(13)
+    if isinstance(action, str) and action.startswith("sleep:"):
+        time.sleep(float(action.split(":", 1)[1]))
+        return
+    if action == "off":
+        return
+    raise ValueError(f"unknown failpoint action {action!r}")

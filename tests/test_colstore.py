@@ -177,3 +177,48 @@ class TestBulkDedupSemantics:
         assert rec.columns["v"].values[0] == 0.0  # packed value (s*1000+p = 0)
         assert rec.columns["v"].values[0] == sh.read_series("m", sid).columns["v"].values[0]
         sh.close()
+
+
+class TestOutOfOrderCompaction:
+    def test_overlapping_files_merge_away(self, shard):
+        """Late-arriving data creates time-overlapping files; OOO
+        compaction merges them to disjoint ranges with LWW intact
+        (reference: engine/immutable/merge_out_of_order.go)."""
+        sh = shard
+        # flush 1: t in [0, 100)
+        sh.write_points_structured([
+            ("m", (("host", "a"),), BASE + t * NS, {"v": (FieldType.FLOAT, 1.0)})
+            for t in range(0, 100, 10)
+        ])
+        sh.flush()
+        # flush 2: newer window [100, 200)
+        sh.write_points_structured([
+            ("m", (("host", "a"),), BASE + t * NS, {"v": (FieldType.FLOAT, 2.0)})
+            for t in range(100, 200, 10)
+        ])
+        sh.flush()
+        # flush 3: LATE data overlapping flush 1, overwriting t=50
+        sh.write_points_structured([
+            ("m", (("host", "a"),), BASE + 50 * NS, {"v": (FieldType.FLOAT, 9.0)}),
+        ])
+        sh.flush()
+        assert sh.has_time_overlap()
+        while sh.compact_out_of_order():
+            pass
+        assert not sh.has_time_overlap()
+        sid = sh.index.get_or_create("m", (("host", "a"),))
+        rec = sh.read_series("m", sid)
+        assert len(rec) == 20
+        i = int(np.searchsorted(rec.times, BASE + 50 * NS))
+        assert rec.columns["v"].values[i] == 9.0  # late write won
+
+    def test_no_overlap_is_noop(self, shard):
+        sh = shard
+        for lo in (0, 100):
+            sh.write_points_structured([
+                ("m", (("host", "a"),), BASE + (lo + t) * NS,
+                 {"v": (FieldType.FLOAT, 1.0)}) for t in range(0, 100, 10)
+            ])
+            sh.flush()
+        assert not sh.has_time_overlap()
+        assert not sh.compact_out_of_order()

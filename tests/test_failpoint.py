@@ -1,0 +1,85 @@
+"""Failpoint fault injection (reference: pingcap/failpoint sites at
+engine/shard.go:457, engine/wal.go:391; SURVEY.md §5 fault-injection)."""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.record import FieldType
+from opengemini_tpu.storage.shard import Shard
+from opengemini_tpu.utils import failpoint
+
+NS = 1_000_000_000
+BASE = 1_700_000_000 * NS
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+def _pt(t, v):
+    return ("m", (("host", "a"),), t, {"v": (FieldType.FLOAT, v)})
+
+
+def test_flush_failure_keeps_wal_and_recovers(tmp_path):
+    sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    sh.write_points_structured([_pt(BASE, 1.0), _pt(BASE + NS, 2.0)])
+    failpoint.enable("shard-flush-before-publish", "error")
+    with pytest.raises(failpoint.FailpointError):
+        sh.flush()
+    assert failpoint.hits("shard-flush-before-publish") == 1
+    sh.close()
+    failpoint.disable_all()
+    # crash-equivalent reopen: WAL replay restores everything
+    sh2 = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    sid = sh2.index.get_or_create("m", (("host", "a"),))
+    rec = sh2.read_series("m", sid)
+    assert len(rec) == 2 and list(rec.columns["v"].values) == [1.0, 2.0]
+    # no half-written file survived
+    assert sh2.file_count() == 0
+    sh2.close()
+
+
+def test_crash_between_publish_and_wal_truncate_is_idempotent(tmp_path):
+    """The dangerous window: file published, WAL not yet truncated. A
+    crash there must replay the WAL over the file without duplicating."""
+    sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    sh.write_points_structured([_pt(BASE, 1.0)])
+    failpoint.enable("shard-flush-before-wal-truncate", "error")
+    with pytest.raises(failpoint.FailpointError):
+        sh.flush()
+    sh.close()
+    failpoint.disable_all()
+    sh2 = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    assert sh2.file_count() == 1  # the published file
+    sid = sh2.index.get_or_create("m", (("host", "a"),))
+    rec = sh2.read_series("m", sid)
+    assert len(rec) == 1  # replayed WAL rows dedup against the file
+    sh2.close()
+
+
+def test_compaction_failure_leaves_files_intact(tmp_path):
+    sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    for i in range(2):
+        sh.write_points_structured([_pt(BASE + i * NS, float(i))])
+        sh.flush()
+    failpoint.enable("compact-before-replace", "error")
+    with pytest.raises(failpoint.FailpointError):
+        sh.compact()
+    failpoint.disable_all()
+    sid = sh.index.get_or_create("m", (("host", "a"),))
+    assert len(sh.read_series("m", sid)) == 2
+    assert sh.compact()  # succeeds once disarmed
+    assert len(sh.read_series("m", sid)) == 2
+    sh.close()
+
+
+def test_sleep_and_callable_actions(tmp_path):
+    import time
+    calls = []
+    failpoint.enable("wal-before-sync", lambda: calls.append(1))
+    sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS, sync_wal=True)
+    sh.write_points_structured([_pt(BASE, 1.0)])
+    assert calls
+    sh.close()
