@@ -213,6 +213,32 @@ class MetaShard:
         return Record.empty()
 
 
+def payload_to_points(mst: str, payload: dict) -> list:
+    """/internal/scan payload -> engine points (structured write shape)."""
+    from opengemini_tpu.record import FieldType
+
+    points = []
+    for s in payload.get("series", []):
+        tags = tuple(sorted(s["tags"].items()))
+        times = s["times"]
+        per_field = []
+        for name, col in s["fields"].items():
+            ftype = FieldType[col["type"]] if isinstance(col["type"], str) \
+                else FieldType(col["type"])
+            per_field.append((name, ftype, col["values"], col["valid"]))
+        for i, t in enumerate(times):
+            fields = {}
+            for name, ftype, values, valid in per_field:
+                if valid[i]:
+                    v = values[i]
+                    if hasattr(v, "item"):
+                        v = v.item()
+                    fields[name] = (ftype, v)
+            if fields:
+                points.append((mst, tags, int(t), fields))
+    return points
+
+
 def serialize_select_meta(engine, db, rp, mst, tmin, tmax,
                           shard_filter=None) -> dict:
     """Peer side of the pushdown metadata round: tag keys, schema, and
@@ -679,6 +705,103 @@ class DataRouter:
                 except OSError:
                     pass
         return delivered
+
+    # -- anti-entropy (rf>1 replica convergence) ----------------------------
+
+    def anti_entropy_round(self) -> int:
+        """One digest-exchange round (reference raft-replicated shards
+        keep replicas consistent by construction,
+        engine/engine_replication.go; the rendezvous+LWW data plane needs
+        this read-repair instead): for every shard group this node owns,
+        compare per-measurement content digests with the other live
+        owners and pull any diverged measurement's rows back for LWW
+        merge.  Symmetric rounds on each owner converge both ways.
+        Returns the number of repaired (group, measurement) pairs."""
+        if self.rf <= 1:
+            return 0
+        import os as _os
+
+        from opengemini_tpu.record import FieldType
+
+        ids = sorted(self.data_nodes())
+        nodes = self.data_nodes()
+        pending = self.pending_hint_nodes()
+        repaired = 0
+
+        # candidate groups: everything held locally PLUS groups the other
+        # owners hold that we should — a replica that lost its whole
+        # shard directory must still notice and re-pull
+        candidates: dict[tuple, object] = {
+            key: sh for key, sh in self.engine._shards.items()
+        }
+        peer_addrs: dict[str, str] = {}
+        for peer in ids:
+            if peer == self.self_id:
+                continue
+            if peer in pending or not self.health.get(peer, True):
+                continue  # hints still owed / peer down: not divergence
+            addr = nodes.get(peer, "")
+            if not addr:
+                continue
+            peer_addrs[peer] = addr
+            try:
+                got = self._post(addr, "/internal/groups", {"db": "_"})
+            except (OSError, ValueError):
+                peer_addrs.pop(peer, None)
+                continue
+            for db, rp, start in got.get("groups", []):
+                candidates.setdefault((db, rp, int(start)), None)
+
+        for (db, rp, start), sh in sorted(candidates.items()):
+            dest = owners(ids, db, rp, start, self.rf)
+            if self.self_id not in dest:
+                continue
+            local_digest = sh.content_digest() if sh is not None else {}
+            if sh is not None:
+                tmin, tmax = sh.tmin, sh.tmax
+            else:
+                d = self.engine.databases.get(db)
+                rp_meta = d.rps.get(rp) if d else None
+                dur = rp_meta.shard_duration_ns if rp_meta else 0
+                tmin, tmax = start, start + (dur or 2**62 - start)
+            for peer in dest:
+                if peer == self.self_id or peer not in peer_addrs:
+                    continue
+                addr = peer_addrs[peer]
+                try:
+                    got = self._post(addr, "/internal/digest", {
+                        "db": db, "rp": rp, "group_start": start,
+                    })
+                except (OSError, ValueError):
+                    continue
+                theirs = got.get("digest", {})
+                for mst in sorted(set(theirs) | set(local_digest)):
+                    if theirs.get(mst) == local_digest.get(mst):
+                        continue
+                    if mst not in theirs:
+                        continue  # peer missing data: ITS round pulls ours
+                    try:
+                        n = self._pull_measurement(
+                            addr, db, rp, mst, tmin, tmax)
+                    except (OSError, RemoteScanError, ValueError):
+                        continue
+                    if n:
+                        repaired += 1
+                        STATS.incr("cluster", "anti_entropy_repairs")
+        return repaired
+
+    def _pull_measurement(self, addr: str, db: str, rp, mst: str,
+                          tmin: int, tmax: int) -> int:
+        """Fetch a peer's rows for one (group, measurement) and LWW-merge
+        them locally via the structured write path."""
+        payload = self._post_scan(addr, {
+            "db": db, "rp": rp, "mst": mst, "tmin": tmin, "tmax": tmax,
+            "fmt": "bin",
+        })
+        points = payload_to_points(mst, payload)
+        if not points:
+            return 0
+        return self.engine.write_rows(db, points, rp=rp)
 
     def forward_points(self, node_id: str, db: str, rp: str | None,
                        points: list) -> None:

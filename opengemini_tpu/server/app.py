@@ -128,6 +128,12 @@ def build(cfg: dict) -> HttpService:
     svc.services = _build_services(cfg, svc)
     if hint_service is not None:
         svc.services.append(hint_service)
+    if svc.router is not None and svc.router.rf > 1:
+        from opengemini_tpu.services.antientropy import AntiEntropyService
+
+        svc.services.append(AntiEntropyService(
+            svc.router,
+            float(cluster_cfg.get("anti-entropy-interval-s", 300))))
     return svc
 
 

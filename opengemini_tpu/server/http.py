@@ -342,6 +342,27 @@ def _make_handler(svc: HttpService):
                     self._send_json(400, {"error": f"bad partials request: {e}"})
                     return
                 self._send(200, body, ctype="application/octet-stream")
+            elif path == "/internal/groups":
+                # anti-entropy: which shard groups does this node hold?
+                req = self._internal_request(svc)
+                if req is None:
+                    return
+                groups = [[db, rp, start]
+                          for (db, rp, start) in sorted(svc.engine._shards)]
+                self._send_json(200, {"groups": groups})
+            elif path == "/internal/digest":
+                # anti-entropy: this node's logical content digest of one
+                # shard group (rf>1 replica divergence detection)
+                req = self._internal_request(svc)
+                if req is None:
+                    return
+                group = int(req.get("group_start", 0))
+                digest: dict = {}
+                for sh in svc.engine.shards_for_range(
+                        req["db"], req.get("rp"), group, group + 1):
+                    if sh.tmin == group:
+                        digest = sh.content_digest()
+                self._send_json(200, {"digest": digest})
             elif path in ("/internal/scan", "/internal/measurements"):
                 from opengemini_tpu.parallel.cluster import serialize_series
 

@@ -711,6 +711,58 @@ class Shard:
             parts.append((np.full(len(mem_rec), int(sid), np.int64), mem_rec))
         return _merge_bulk_parts(parts, lo_t, hi_t)
 
+    def content_digest(self) -> dict:
+        """Per-measurement logical content digest: {mst: [rows, hash64]}.
+        Order-independent (per-series hashes fold with XOR) and keyed by
+        canonical series KEYS, never sids (sids differ across replicas).
+        Two replicas holding identical logical rows produce identical
+        digests regardless of file layout (reference: anti-entropy
+        digests for replicated shards, engine/engine_replication.go).
+        Cached until the file set or memtable changes."""
+        import zlib as _z
+
+        from opengemini_tpu.ingest.line_protocol import series_key
+
+        with self._lock:
+            state = (
+                tuple((r.path, os.path.getsize(r.path)) for r in self._files
+                      if os.path.exists(r.path)),
+                len(self.mem),
+            )
+            cached = getattr(self, "_digest_cache", None)
+            if cached is not None and cached[0] == state:
+                return cached[1]
+        out: dict[str, list] = {}
+        for mst in self.measurements():
+            rows = 0
+            acc = 0
+            for sid in sorted(self.index.series_ids(mst)):
+                rec = self.read_series(mst, sid)
+                if not len(rec):
+                    continue
+                rows += len(rec)
+                _m, tags = self.index.series_entry(sid)
+                h = _z.crc32(series_key(mst, tags).encode())
+                h = _z.crc32(np.ascontiguousarray(rec.times).tobytes(), h)
+                for name in sorted(rec.columns):
+                    col = rec.columns[name]
+                    h = _z.crc32(name.encode(), h)
+                    vals = col.values
+                    if vals.dtype == object:
+                        payload = "\x00".join(
+                            "" if v is None else str(v) for v in vals
+                        ).encode()
+                    else:
+                        payload = np.ascontiguousarray(vals).tobytes()
+                    h = _z.crc32(payload, h)
+                    h = _z.crc32(np.ascontiguousarray(col.valid).tobytes(), h)
+                acc ^= h
+            if rows:
+                out[mst] = [rows, acc]
+        with self._lock:
+            self._digest_cache = (state, out)
+        return out
+
     def mem_overlaps(self, measurement: str, sid: int) -> bool:
         return self.mem.record_for(sid) is not None
 

@@ -1,0 +1,130 @@
+"""rf>1 anti-entropy: digest exchange detects silently diverged replicas
+and read-repair reconverges them (reference: raft keeps replicas in sync
+by construction, engine/engine_replication.go; the rendezvous+LWW plane
+uses digests + pulls instead)."""
+
+import json
+import shutil
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from opengemini_tpu.storage.engine import Engine
+
+NS = 10**9
+BASE = 1_700_000_000
+
+
+def _mk_cluster(tmp_path, rf=2, nids=("nA", "nB")):
+    from opengemini_tpu.parallel.cluster import DataRouter
+    from opengemini_tpu.server.http import HttpService
+
+    nodes, addrs = {}, {}
+    for nid in nids:
+        e = Engine(str(tmp_path / nid))
+        e.create_database("db")
+        svc = HttpService(e, "127.0.0.1", 0)
+        svc.start()
+        addrs[nid] = f"127.0.0.1:{svc.port}"
+        nodes[nid] = (e, svc)
+
+    class FsmStub:
+        def __init__(self):
+            self.nodes = {n: {"addr": a, "role": "data"}
+                          for n, a in addrs.items()}
+
+    class StoreStub:
+        fsm = FsmStub()
+        token = ""
+
+    for nid, (e, svc) in nodes.items():
+        svc.router = DataRouter(e, StoreStub(), nid, addrs[nid], rf=rf)
+        svc.executor.router = svc.router
+    return nodes, addrs
+
+
+def _close(nodes):
+    for _nid, (e, svc) in nodes.items():
+        svc.stop()
+        e.close()
+
+
+def _write(addrs, nid, lines):
+    req = urllib.request.Request(
+        f"http://{addrs[nid]}/write?db=db", data=lines.encode(),
+        method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 204
+
+
+def _count(e):
+    total = 0
+    for sh in e.shards_for_range("db", None, -(2**62), 2**62):
+        for sid in sh.index.series_ids("cpu"):
+            total += len(sh.read_series("cpu", sid))
+    return total
+
+
+class TestAntiEntropy:
+    def test_diverged_replica_reconverges(self, tmp_path):
+        nodes, addrs = _mk_cluster(tmp_path, rf=2)
+        (eA, svcA), (eB, svcB) = nodes["nA"], nodes["nB"]
+        lines = "\n".join(
+            f"cpu,host=h{i} v={i} {(BASE + i) * NS}" for i in range(10)
+        )
+        _write(addrs, "nA", lines)
+        # rf=2 over 2 nodes: both hold every point
+        assert _count(eA) == 10 and _count(eB) == 10
+        for e in (eA, eB):
+            for sh in e.shards_for_range("db", None, -(2**62), 2**62):
+                sh.flush()
+
+        # silently destroy nB's data behind the system's back
+        for (db, rp, start), sh in list(eB._shards.items()):
+            sh.close()
+            shutil.rmtree(sh.path)
+            del eB._shards[(db, rp, start)]
+        assert _count(eB) == 0
+
+        # digests disagree -> nB pulls the measurement back from nA
+        svcA.router.probe_health()
+        svcB.router.probe_health()
+        repaired = svcB.router.anti_entropy_round()
+        assert repaired >= 1
+        assert _count(eB) == 10
+        # steady state: no further repairs
+        assert svcB.router.anti_entropy_round() == 0
+        assert svcA.router.anti_entropy_round() == 0
+        _close(nodes)
+
+    def test_partial_divergence_repairs_lww(self, tmp_path):
+        """One replica silently lost a suffix of rows; repair restores
+        exactly the missing rows without disturbing the rest."""
+        nodes, addrs = _mk_cluster(tmp_path, rf=2)
+        (eA, svcA), (eB, svcB) = nodes["nA"], nodes["nB"]
+        _write(addrs, "nA", "\n".join(
+            f"cpu,host=h v={i} {(BASE + i) * NS}" for i in range(6)))
+        for e in (eA, eB):
+            for sh in e.shards_for_range("db", None, -(2**62), 2**62):
+                sh.flush()
+        # nB loses its files (keeps WAL-less empty shard)
+        for (_db, _rp, _start), sh in eB._shards.items():
+            with sh._lock:
+                for r in sh._files:
+                    r.close()
+                    import os
+                    os.remove(r.path)
+                sh._files = []
+                sh._digest_cache = None
+        assert _count(eB) == 0
+        svcB.router.probe_health()
+        assert svcB.router.anti_entropy_round() >= 1
+        assert _count(eB) == 6
+        _close(nodes)
+
+    def test_rf1_never_runs(self, tmp_path):
+        nodes, addrs = _mk_cluster(tmp_path, rf=1)
+        _write(addrs, "nA", f"cpu v=1 {BASE * NS}")
+        assert nodes["nA"][1].router.anti_entropy_round() == 0
+        _close(nodes)
