@@ -706,6 +706,68 @@ class DataRouter:
                     pass
         return delivered
 
+    # -- shard migration / rebalancing --------------------------------------
+
+    MIGRATE_CHUNK = 20_000  # points per forwarded batch
+
+    def migrate_round(self) -> int:
+        """Rebalancing after membership change (reference:
+        app/ts-meta/meta/migrate_state_machine.go, engine/engine_ha.go):
+        any shard group held locally whose rendezvous owners no longer
+        include this node is PUSHED, measurement by measurement, to every
+        current live owner, then dropped locally.  The move is idempotent
+        (structured writes LWW-merge at the destination), so a crash at
+        any point simply retries next tick — no separate migration state
+        machine is needed where the reference records raft state.
+        Queries stay correct throughout: un-migrated data still serves
+        from the old holder via the scan fan-out (rf=1), or converges via
+        anti-entropy (rf>1).  Returns groups migrated."""
+        ids = sorted(self.data_nodes())
+        moved = 0
+        for (db, rp, start), sh in sorted(self.engine._shards.items()):
+            dest = owners(ids, db, rp, start, self.rf)
+            if self.self_id in dest:
+                continue
+            if not all(self.health.get(peer, True) for peer in dest):
+                continue  # owner down: retry when the cluster heals
+            try:
+                for peer in dest:
+                    self._push_shard(peer, db, rp, sh)
+            except (OSError, RemoteScanError):
+                continue  # partial pushes are safe: LWW dedups on retry
+            self.engine.drop_shard(db, rp, start)
+            moved += 1
+            STATS.incr("cluster", "groups_migrated")
+        return moved
+
+    def _push_shard(self, peer: str, db: str, rp, sh) -> None:
+        """Stream every row of one local shard to `peer` in bounded
+        structured-write batches."""
+        batch: list = []
+        for mst in sh.measurements():
+            for sid in sorted(sh.index.series_ids(mst)):
+                rec = sh.read_series(mst, sid)
+                if not len(rec):
+                    continue
+                _m, tags = sh.index.series_entry(sid)
+                cols = list(rec.columns.items())
+                for i in range(len(rec)):
+                    fields = {}
+                    for name, col in cols:
+                        if col.valid[i]:
+                            v = col.values[i]
+                            fields[name] = (
+                                col.ftype,
+                                v.item() if hasattr(v, "item") else v,
+                            )
+                    if fields:
+                        batch.append((mst, tags, int(rec.times[i]), fields))
+                    if len(batch) >= self.MIGRATE_CHUNK:
+                        self.forward_points(peer, db, rp, batch)
+                        batch = []
+        if batch:
+            self.forward_points(peer, db, rp, batch)
+
     # -- anti-entropy (rf>1 replica convergence) ----------------------------
 
     def anti_entropy_round(self) -> int:

@@ -134,6 +134,12 @@ def build(cfg: dict) -> HttpService:
         svc.services.append(AntiEntropyService(
             svc.router,
             float(cluster_cfg.get("anti-entropy-interval-s", 300))))
+    if svc.router is not None:
+        from opengemini_tpu.services.migration import MigrationService
+
+        svc.services.append(MigrationService(
+            svc.router,
+            float(cluster_cfg.get("migration-interval-s", 60))))
     return svc
 
 

@@ -1,0 +1,22 @@
+"""Shard migration service: pushes shard groups whose rendezvous
+ownership moved away (membership change) to their new owners and drops
+the local copies (reference: app/ts-meta/meta/migrate_state_machine.go,
+the balancer + engine_ha.go segment moves)."""
+
+from __future__ import annotations
+
+from opengemini_tpu.services.base import Service, logger
+
+
+class MigrationService(Service):
+    name = "migration"
+
+    def __init__(self, router, interval_s: float = 60.0):
+        super().__init__(interval_s)
+        self.router = router
+
+    def handle(self) -> int:
+        n = self.router.migrate_round()
+        if n:
+            logger.info("migration: moved %d shard groups to new owners", n)
+        return n

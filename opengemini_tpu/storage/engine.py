@@ -378,6 +378,22 @@ class Engine:
             _remove_shard_dir(shard.path)  # follows cold-tier symlinks
             return True
 
+    def drop_shard(self, db: str, rp: str, group_start: int) -> bool:
+        """Remove one local shard group entirely (post-migration cleanup:
+        the data now lives on its new rendezvous owners). Unlike the
+        cold-tier offload above, nothing is registered — ownership moved
+        away (reference: migrate_state_machine.go segment cleanup)."""
+        key = (db, rp, group_start)
+        with self._lock:
+            shard = self._shards.pop(key, None)
+            if shard is None:
+                return False
+            shard.close()
+            self._purge_obs(lambda k: k == key)
+            self._save_meta()
+            _remove_shard_dir(shard.path)
+            return True
+
     def _purge_obs(self, match) -> None:
         """Drop offloaded-group registry entries (and bucket copies) whose
         key satisfies `match` — DROP DATABASE/RP must not let a recreated

@@ -1,0 +1,148 @@
+"""Shard migration: membership changes rebalance existing data to the
+new rendezvous owners with queries correct throughout (reference:
+app/ts-meta/meta/migrate_state_machine.go, engine/engine_ha.go)."""
+
+import json
+import urllib.parse
+import urllib.request
+
+from opengemini_tpu.parallel.cluster import DataRouter, owners
+from opengemini_tpu.server.http import HttpService
+from opengemini_tpu.storage.engine import Engine
+
+NS = 10**9
+BASE = 1_700_000_000
+
+
+class FsmStub:
+    def __init__(self, addrs):
+        self.nodes = {n: {"addr": a, "role": "data"}
+                      for n, a in addrs.items()}
+
+
+class StoreStub:
+    token = ""
+
+    def __init__(self, addrs):
+        self.fsm = FsmStub(addrs)
+
+
+def _mk_node(tmp_path, nid, addrs, store):
+    e = Engine(str(tmp_path / nid))
+    e.create_database("db")
+    svc = HttpService(e, "127.0.0.1", 0)
+    svc.start()
+    addrs[nid] = f"127.0.0.1:{svc.port}"
+    return e, svc
+
+
+def _wire(nodes, addrs, store, rf=1):
+    for nid, (e, svc) in nodes.items():
+        svc.router = DataRouter(e, store, nid, addrs[nid], rf=rf)
+        svc.executor.router = svc.router
+
+
+def _query_count(addrs, nid):
+    url = (f"http://{addrs[nid]}/query?" + urllib.parse.urlencode(
+        {"q": "SELECT count(v) FROM cpu", "db": "db", "epoch": "ns"}))
+    with urllib.request.urlopen(url, timeout=60) as r:
+        res = json.loads(r.read())["results"][0]
+    assert "error" not in res, res
+    series = res.get("series")
+    return series[0]["values"][0][1] if series else 0
+
+
+def _write(addrs, nid, lines):
+    req = urllib.request.Request(
+        f"http://{addrs[nid]}/write?db=db", data=lines.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 204
+
+
+def test_node_join_rebalances_data(tmp_path):
+    addrs: dict = {}
+    store = StoreStub(addrs)
+    nodes = {}
+    for nid in ("nA", "nB"):
+        nodes[nid] = _mk_node(tmp_path, nid, addrs, store)
+    store.fsm = FsmStub(addrs)
+    _wire(nodes, addrs, store)
+
+    # 12 weekly points -> many shard groups spread over nA/nB
+    lines = "\n".join(
+        f"cpu,host=h{w % 3} v={w} {(BASE + w * 7 * 86400) * NS}"
+        for w in range(12)
+    )
+    _write(addrs, "nA", lines)
+    assert _query_count(addrs, "nA") == 12
+
+    # nC joins: membership grows, ownership of ~1/3 of groups moves
+    nodes["nC"] = _mk_node(tmp_path, "nC", addrs, store)
+    store.fsm = FsmStub(addrs)  # all routers share the store object
+    _wire(nodes, addrs, store)
+    for nid, (e, svc) in nodes.items():
+        svc.router.probe_health()
+
+    # queries stay correct BEFORE any migration happens
+    assert _query_count(addrs, "nC") == 12
+
+    # old owners push moved groups; nC receives its share
+    moved = 0
+    for nid in ("nA", "nB"):
+        moved += nodes[nid][1].router.migrate_round()
+    assert moved > 0
+
+    # data rebalanced: every group lives exactly on its owner
+    ids = sorted(addrs)
+    for nid, (e, svc) in nodes.items():
+        for (db, rp, start) in e._shards:
+            assert nid in owners(ids, db, rp, start, 1), (
+                f"{nid} still holds group {start}")
+    c_groups = len(nodes["nC"][0]._shards)
+    assert c_groups > 0, "new node received no shard groups"
+
+    # queries remain correct after rebalancing, from every coordinator
+    for nid in addrs:
+        assert _query_count(addrs, nid) == 12
+
+    # steady state: nothing more to move
+    for nid in addrs:
+        assert nodes[nid][1].router.migrate_round() == 0
+
+    for _nid, (e, svc) in nodes.items():
+        svc.stop()
+        e.close()
+
+
+def test_migration_waits_for_down_owner(tmp_path):
+    addrs: dict = {}
+    store = StoreStub(addrs)
+    nodes = {}
+    for nid in ("nA", "nB"):
+        nodes[nid] = _mk_node(tmp_path, nid, addrs, store)
+    store.fsm = FsmStub(addrs)
+    _wire(nodes, addrs, store)
+    lines = "\n".join(
+        f"cpu,host=h v={w} {(BASE + w * 7 * 86400) * NS}" for w in range(8))
+    _write(addrs, "nA", lines)
+
+    # fake a membership where a dead node owns groups: nC listed but down
+    addrs["nC"] = "127.0.0.1:1"  # nothing listens there
+    store.fsm = FsmStub(addrs)
+    for nid in ("nA", "nB"):
+        nodes[nid][1].router.probe_health()
+        # groups owned by the unreachable nC must NOT be dropped locally
+        before = len(nodes[nid][0]._shards)
+        nodes[nid][1].router.migrate_round()
+        # any group whose new owner is nC stays; only moves between live
+        # nodes happened — and data is never lost
+    total = 0
+    for nid in ("nA", "nB"):
+        for (db, rp, start), sh in nodes[nid][0]._shards.items():
+            for sid in sh.index.series_ids("cpu"):
+                total += len(sh.read_series("cpu", sid))
+    assert total == 8
+
+    for _nid, (e, svc) in nodes.items():
+        svc.stop()
+        e.close()
