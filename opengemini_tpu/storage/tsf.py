@@ -7,14 +7,13 @@ lets aggregate queries skip data blocks entirely).
 Layout:
     "OGTSF01\\n"                      8-byte magic
     column blocks (self-describing, see storage/encoding.py)
-    zlib(JSON meta)
+    meta: "BM02" + zlib(binary chunk meta — storage/chunkmeta.py,
+          reference chunk_meta_codec.go); legacy zlib(JSON) still reads
     trailer: [u64 meta_off][u32 meta_len][u32 meta_crc]"OGTSFEND"
 
-One chunk = one series' rows for one flush: time column + field columns,
-each with validity mask and numeric pre-aggregation. Chunks are written
-time-sorted and deduped. JSON meta is pragmatic round-1; the format keeps
-blocks self-describing so a binary meta (C++ side) can replace it without
-touching data blocks.
+Chunks are either one series' rows for one flush (time + field columns,
+validity masks, numeric pre-aggregation) or PK-sorted packed
+multi-series blocks (colstore layout, see add_packed_chunk).
 """
 
 from __future__ import annotations
@@ -207,9 +206,12 @@ class TSFWriter:
         )
 
     def finish(self) -> None:
-        meta_buf = zlib.compress(
-            json.dumps(self._meta, separators=(",", ":")).encode("utf-8"), 1
-        )
+        from opengemini_tpu.storage import chunkmeta
+
+        # binary chunk meta (format v2, reference chunk_meta_codec.go):
+        # decode cost stays flat as chunk counts grow; v1 zlib-JSON files
+        # remain readable
+        meta_buf = b"BM02" + zlib.compress(chunkmeta.encode_meta(self._meta), 1)
         meta_off = self._off
         self._f.write(meta_buf)
         self._f.write(_TRAILER.pack(meta_off, len(meta_buf), zlib.crc32(meta_buf)))
@@ -243,7 +245,12 @@ class TSFReader:
         meta_buf = self._f.read(meta_len)
         if zlib.crc32(meta_buf) != meta_crc:
             raise CorruptFile(path, "meta crc mismatch")
-        raw = json.loads(zlib.decompress(meta_buf))
+        if meta_buf[:4] == b"BM02":
+            from opengemini_tpu.storage import chunkmeta
+
+            raw = chunkmeta.decode_meta(zlib.decompress(meta_buf[4:]))
+        else:
+            raw = json.loads(zlib.decompress(meta_buf))
         # mst -> (schema, [ChunkMeta])
         self.meta: dict[str, tuple[dict, list[ChunkMeta]]] = {}
         self.tmin: int | None = None

@@ -1,0 +1,104 @@
+"""Binary chunk-meta codec round trips (reference: chunk_meta_codec.go)."""
+
+import zlib
+
+from opengemini_tpu.storage import chunkmeta
+
+
+def _roundtrip(meta):
+    return chunkmeta.decode_meta(chunkmeta.encode_meta(meta))
+
+
+def test_per_sid_chunk_roundtrip():
+    meta = {
+        "cpu": {
+            "schema": {"v": 1, "s": 4},
+            "chunks": [{
+                "sid": 7, "rows": 3, "tmin": -5, "tmax": 99,
+                "time": [8, 20],
+                "cols": {
+                    "v": {"v": [28, 40], "m": [68, 2],
+                          "pre": [3, -1.5, 2.5, 1.0, [1, 2]]},
+                    "s": {"v": [70, 9], "m": None,
+                          "pre": [3, None, None, None, None]},
+                },
+            }],
+        }
+    }
+    got = _roundtrip(meta)
+    assert got["cpu"]["schema"] == meta["cpu"]["schema"]
+    c = got["cpu"]["chunks"][0]
+    assert c["sid"] == 7 and c["tmin"] == -5 and c["time"] == [8, 20]
+    assert c["cols"]["v"]["pre"] == [3, -1.5, 2.5, 1.0, [1, 2]]
+    assert c["cols"]["s"]["m"] is None
+    assert c["cols"]["s"]["pre"][1] is None
+
+
+def test_packed_chunk_and_exact_int_sums():
+    big = 3 * (1 << 60)  # int sum beyond 2^53: must stay exact
+    meta = {
+        "m": {
+            "schema": {"c": 2},
+            "chunks": [{
+                "packed": 1, "smin": 1, "smax": 500,
+                "sids": [100, 64], "sparse": [[1, 0], [300, 1024]],
+                "rows": 2048, "tmin": 0, "tmax": 10**18,
+                "time": [8, 30],
+                "cols": {"c": {"v": [40, 50], "m": None,
+                               "pre": [2048, 1, 1 << 60, big, None]}},
+            }],
+        }
+    }
+    got = _roundtrip(meta)
+    c = got["m"]["chunks"][0]
+    assert c["packed"] and c["smin"] == 1 and c["smax"] == 500
+    assert c["sparse"] == [[1, 0], [300, 1024]]
+    pre = c["cols"]["c"]["pre"]
+    assert pre[3] == big and isinstance(pre[3], int)
+    assert pre[1] == 1 and pre[2] == 1 << 60
+
+
+def test_small_int_sums_stay_int():
+    meta = {"m": {"schema": {"c": 2}, "chunks": [{
+        "sid": 1, "rows": 2, "tmin": 0, "tmax": 1, "time": [8, 4],
+        "cols": {"c": {"v": [12, 8], "m": None,
+                       "pre": [2, 1, 5, 6, None]}}}]}}
+    pre = _roundtrip(meta)["m"]["chunks"][0]["cols"]["c"]["pre"]
+    assert pre == [2, 1, 5, 6, None]
+    assert all(isinstance(x, int) for x in pre[1:4])
+
+
+def test_legacy_json_meta_files_still_read(tmp_path):
+    """v1 files (zlib-JSON meta) written before the binary codec must
+    stay readable."""
+    import json
+    import numpy as np
+    from opengemini_tpu.record import Column, FieldType, Record
+    from opengemini_tpu.storage.tsf import TSFReader, TSFWriter
+
+    path = str(tmp_path / "legacy.tsf")
+    w = TSFWriter(path)
+    rec = Record(np.array([1, 2], np.int64), {
+        "v": Column(FieldType.FLOAT, np.array([1.0, 2.0]),
+                    np.array([True, True]))})
+    w.add_chunk("m", 5, rec)
+    # emulate the v1 finish(): plain zlib-JSON meta
+    meta_buf = zlib.compress(
+        json.dumps(w._meta, separators=(",", ":")).encode(), 1)
+    import os as _os
+    import struct as _struct
+    meta_off = w._off
+    w._f.write(meta_buf)
+    w._f.write(_struct.Struct("<QII").pack(
+        meta_off, len(meta_buf), zlib.crc32(meta_buf)))
+    w._f.write(b"OGTSFEND")
+    w._f.flush()
+    _os.fsync(w._f.fileno())
+    w._f.close()
+    _os.replace(w._tmp, path)
+
+    r = TSFReader(path)
+    got = r.read_chunk("m", r.chunks("m")[0])
+    assert list(got.times) == [1, 2]
+    assert list(got.columns["v"].values) == [1.0, 2.0]
+    r.close()
