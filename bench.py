@@ -7,8 +7,10 @@ Prints ONE json line:
 Methodology notes (the axon TPU tunnel defers execution past
 block_until_ready, and per-dispatch round-trips cost ~60ms):
   - device work is timed with an in-graph lax.fori_loop whose body depends
-    on the loop index (defeats loop-invariant hoisting) and fenced by a
-    scalar host transfer;
+    on the loop index (defeats loop-invariant hoisting), consumes every
+    element of every aggregate output (defeats XLA dead-code elimination
+    of unreferenced reduction rows — consuming only [0] inflated round-1
+    numbers ~3x), and is fenced by a scalar host transfer;
   - throughput = marginal time per iteration, least-squares over several
     loop lengths, which cancels the fixed tunnel overhead;
   - vs_baseline = TPU rows/s over (single-core numpy rows/s of the same
@@ -76,12 +78,13 @@ def bench_tpu_grid(values_t, mask_t):
             def body(i, acc):
                 vv = v + i.astype(jnp.float32) * 1e-9
                 out = seg.grid_window_agg_t(vv, m)
-                return (
-                    acc
-                    + out["mean"][0, 0]
-                    + out["max"][0, 0]
-                    + out["count"][0, 0].astype(jnp.float32)
-                )
+                # consume EVERY element of every stat: slicing [0, 0]
+                # lets XLA dead-code-eliminate all other rows of the
+                # reduction and the "throughput" becomes fiction
+                t = acc
+                for val in out.values():
+                    t = t + jnp.sum(val.astype(jnp.float32) * 1e-6)
+                return t
             return lax.fori_loop(0, k_iters, body, 0.0)
 
         return lambda: run(values_t, mask_t)
@@ -112,7 +115,12 @@ def bench_tpu_general(values, mask):
                 s = seg.seg_sum(vv, s_ids, num_segments, m)
                 c = seg.seg_count(s_ids, num_segments, m)
                 mx = seg.seg_max(vv, s_ids, num_segments, m)
-                return acc + s[0] + mx[0] + c[0].astype(jnp.float32)
+                return (
+                    acc
+                    + jnp.sum(s * 1e-6)
+                    + jnp.sum(mx * 1e-6)
+                    + jnp.sum(c.astype(jnp.float32) * 1e-6)
+                )
             return lax.fori_loop(0, k_iters, body, 0.0)
 
         return lambda: run(v_flat, seg_ids, m_flat)
@@ -144,11 +152,12 @@ def bench_tpu_ragged_dense():
         def run(v, hi, lo, idx, m):
             def body(i, acc):
                 out = stats(v + i.astype(jnp.float32) * 1e-9, hi, lo, idx, m)
-                # consume EVERY output — otherwise XLA dead-code-eliminates
-                # unused stat passes and the number lies
+                # consume EVERY ELEMENT of EVERY output — consuming only
+                # element [0] lets XLA dead-code-eliminate the other rows
+                # of each reduction, not just unused stat passes
                 total = acc
                 for val in out.values():
-                    total = total + val[0].astype(jnp.float32)
+                    total = total + jnp.sum(val.astype(jnp.float32) * 1e-6)
                 return total
             return lax.fori_loop(0, k_iters, body, 0.0)
 

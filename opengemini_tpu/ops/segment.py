@@ -4,9 +4,10 @@ Each aggregate over (series-group, time-window) segments is a masked
 segmented reduction with segment id ``group_id * num_windows + window_id``.
 Rows arrive series-major and time-sorted within a series, so segment ids are
 sorted within each series run — ``indices_are_sorted`` is still False
-globally (multiple series interleave), but XLA's scatter-based segment ops
-handle this well, and the Pallas kernel (pallas_segment.py) exploits
-within-tile locality.
+globally (multiple series interleave). These scatter-based forms are the
+general fallback; the hot paths are the dense layouts (``grid_window_agg_t``
+here, bucket matrices in ``models/ragged.py``), whose fused Pallas tile
+kernels live in ``ops/pallas_segment.py`` and engage on TPU backends.
 
 This replaces the reference's generated scalar reduce loops
 (engine/series_agg_func.gen.go: floatSumReduce:47 etc., 45 fns;
