@@ -414,4 +414,6 @@ def _stats_jit(kind: str):
         # with pallas routing on, 'selectors' must stay un-cached here so a
         # later request takes the pallas branch above
         _STATS_FNS["selectors"] = selectors
-    return _STATS_FNS.get(kind, selectors)
+    if kind == "selectors":
+        return selectors
+    return _STATS_FNS[kind]  # unknown kinds must raise, not silently alias

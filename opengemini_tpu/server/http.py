@@ -76,6 +76,9 @@ class HttpService:
         self.meta_store = None  # MetaStore when clustered (server.app.build)
         self.router = None  # DataRouter when [cluster] data-routing is on
         self.flight = None  # FlightService when [flight] is configured
+        from opengemini_tpu.server.logstore import LogStoreAPI
+
+        self.logstore = LogStoreAPI(self)  # /repo log-mode surface
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -225,6 +228,9 @@ def _make_handler(svc: HttpService):
                 self._handle_query(self._params(), read_only=True)
             elif path == "/api/v1/consume":
                 self._handle_consume(self._params())
+            elif path == "/repo" or path.startswith("/repo/"):
+                if not svc.logstore.handle(self, "GET", path, self._params()):
+                    self._send_json(404, {"error": "not found"})
             elif path.startswith("/api/v1/"):
                 self._handle_prom(path, self._params())
             elif path == "/raft/status" and svc.meta_store is not None:
@@ -271,6 +277,9 @@ def _make_handler(svc: HttpService):
                 self._handle_prom_remote_read(params)
             elif path == "/api/v1/otlp/metrics":
                 self._handle_otlp_metrics(params)
+            elif path == "/repo" or path.startswith("/repo/"):
+                if not svc.logstore.handle(self, "POST", path, params):
+                    self._send_json(404, {"error": "not found"})
             elif path.startswith("/api/v1/"):
                 self._merge_form_body(params)
                 self._handle_prom(path, params)
@@ -458,6 +467,15 @@ def _make_handler(svc: HttpService):
                     self._send_json(503, {"error": "conf change failed"})
             elif path == "/debug/ctrl":
                 self._handle_syscontrol(params)
+            else:
+                self._send_json(404, {"error": "not found"})
+
+        def do_DELETE(self):
+            self._form_pairs = ()  # reset per request (keep-alive reuse)
+            path = urllib.parse.urlparse(self.path).path
+            if path.startswith("/repo/"):
+                if not svc.logstore.handle(self, "DELETE", path, self._params()):
+                    self._send_json(404, {"error": "not found"})
             else:
                 self._send_json(404, {"error": "not found"})
 

@@ -1,0 +1,340 @@
+"""Log-storage mode: PPL grammar, repository/logstream CRUD, JSON upload,
+log search/histogram/context/analytics/consume over HTTP (reference:
+handler_logstore*.go + lib/util/lifted/logparser)."""
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from opengemini_tpu.sql import logparser as lp
+from opengemini_tpu.server.http import HttpService
+from opengemini_tpu.storage.engine import Engine
+
+BASE_MS = 1_700_000_040_000
+
+
+# -- grammar unit tests ------------------------------------------------------
+
+
+def test_parse_bare_term_is_content_match():
+    q = lp.parse_log_query("error")
+    assert isinstance(q.cond, lp.Term)
+    assert q.cond.field is None and q.cond.op == "match" and q.cond.value == "error"
+
+
+def test_parse_adjacency_is_and():
+    q = lp.parse_log_query("error timeout")
+    assert isinstance(q.cond, lp.And)
+    assert [c.value for c in q.cond.children] == ["error", "timeout"]
+
+
+def test_parse_field_phrase_and_or_parens():
+    q = lp.parse_log_query('level: warn or (error and "disk full")')
+    assert isinstance(q.cond, lp.Or)
+    left, right = q.cond.children
+    assert left.field == "level" and left.value == "warn"
+    assert isinstance(right, lp.And)
+    assert right.children[1].value == "disk full"
+
+
+def test_parse_comparisons_and_range():
+    q = lp.parse_log_query("latency > 100 and size in [10 200)")
+    cmp_t, rng = q.cond.children
+    assert cmp_t.op == "gt" and cmp_t.value == 100.0
+    assert isinstance(rng, lp.Rng)
+    assert rng.lo == 10 and rng.hi == 200 and rng.lo_incl and not rng.hi_incl
+
+
+def test_parse_pipe_segments_and_extract():
+    q = lp.parse_log_query(
+        'error | EXTRACT(content: "ip=(\\d+\\.\\d+\\.\\d+\\.\\d+)") AS(ip) | level: e'
+    )
+    assert q.extract is not None and q.extract.aliases == ["ip"]
+    assert isinstance(q.cond, lp.And)
+
+
+def test_parse_star_matches_all():
+    assert lp.parse_log_query("*").cond is None
+    assert lp.parse_log_query("").cond is None
+
+
+def test_parse_rejects_double_extract():
+    with pytest.raises(lp.LogParseError):
+        lp.parse_log_query('EXTRACT(a: "(x)") AS(b) | EXTRACT(a: "(y)") AS(c)')
+
+
+def test_parse_extract_group_count_mismatch():
+    with pytest.raises(lp.LogParseError):
+        lp.parse_log_query('EXTRACT(content: "(a)(b)") AS(only_one)')
+
+
+def test_where_compilation():
+    q = lp.parse_log_query("error and level: warn and latency > 5")
+    where = lp.to_influxql_where(q.cond)
+    assert "match(\"content\", 'error')" in where
+    assert "\"level\" = 'warn'" in where
+    assert '"latency" > 5.0' in where
+
+
+def test_where_skips_alias_terms_and_row_filter_enforces():
+    q = lp.parse_log_query(
+        'EXTRACT(content: "code=(\\d+)") AS(code) | code: 500'
+    )
+    aliases = set(q.aliases)
+    assert lp.to_influxql_where(q.cond, aliases) is None
+    rows = [
+        {"content": "GET /a code=500"},
+        {"content": "GET /b code=200"},
+        {"content": "no code here"},
+    ]
+    lp.apply_extract(q.extract, rows)
+    pred = lp.alias_row_filter(q.cond, aliases)
+    kept = [r for r in rows if pred(r)]
+    assert len(kept) == 1 and kept[0]["code"] == "500"
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+@pytest.fixture
+def server(tmp_path):
+    engine = Engine(str(tmp_path / "data"))
+    svc = HttpService(engine, "127.0.0.1", 0)
+    svc.start()
+    yield svc
+    svc.stop()
+    engine.close()
+
+
+def _req(svc, method, path, body=None, headers=None, **params):
+    url = f"http://127.0.0.1:{svc.port}{path}"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    req = urllib.request.Request(url, data=body, headers=headers or {},
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _setup_logs(svc, n=40):
+    assert _req(svc, "POST", "/repo/myrepo")[0] == 200
+    assert _req(svc, "POST", "/repo/myrepo/logstreams/app",
+                body=json.dumps({"ttl": 7}).encode())[0] == 200
+    lines = []
+    for i in range(n):
+        level = "error" if i % 4 == 0 else "info"
+        lines.append(json.dumps({
+            "time": BASE_MS + i * 1000,
+            "content": f"{level} req {i} code={500 if i % 4 == 0 else 200} "
+                       f"took {i * 2}ms",
+            "level": level,
+            "latency": i * 2.0,
+            "host": f"web{i % 2}",
+        }))
+    st, body = _req(
+        svc, "POST", "/repo/myrepo/logstreams/app/upload",
+        body="\n".join(lines).encode(),
+        headers={"log-tags": json.dumps({"dc": "eu"})},
+        mapping=json.dumps({"timestamp": "time", "tags": ["host"]}),
+    )
+    assert st == 200 and body["written"] == n, body
+
+
+def test_repo_crud(server):
+    assert _req(server, "POST", "/repo/r1")[0] == 200
+    assert _req(server, "POST", "/repo/r1")[0] == 400  # duplicate
+    assert _req(server, "POST", "/repo/bad%20name")[0] == 400
+    st, body = _req(server, "GET", "/repo")
+    assert st == 200 and "r1" in body["repositories"]
+    assert _req(server, "POST", "/repo/r1/logstreams/s1")[0] == 200
+    st, body = _req(server, "GET", "/repo/r1")
+    assert st == 200 and body["logstreams"][0]["name"] == "s1"
+    assert _req(server, "DELETE", "/repo/r1/logstreams/s1")[0] == 200
+    assert _req(server, "DELETE", "/repo/r1")[0] == 200
+    assert _req(server, "GET", "/repo/r1")[0] == 404
+
+
+def test_upload_and_query_logs(server):
+    _setup_logs(server)
+    st, body = _req(server, "GET", "/repo/myrepo/logstreams/app/logs",
+                    q="error", **{"from": BASE_MS, "to": BASE_MS + 60_000,
+                                  "limit": 100})
+    assert st == 200, body
+    # i % 4 == 0 -> 10 error rows, newest first
+    assert body["count"] == 10
+    ts = [r["timestamp"] for r in body["logs"]]
+    assert ts == sorted(ts, reverse=True)
+    row = body["logs"][0]
+    assert row["level"] == "error" and row["dc"] == "eu"
+    assert row["host"] in ("web0", "web1")
+
+
+def test_query_logs_filters(server):
+    _setup_logs(server)
+    base = dict(**{"from": BASE_MS, "to": BASE_MS + 60_000, "limit": 100})
+    st, body = _req(server, "GET", "/repo/myrepo/logstreams/app/logs",
+                    q="level: info and latency > 50", **base)
+    assert st == 200
+    assert all(r["latency"] > 50 and r["level"] == "info" for r in body["logs"])
+    st, body = _req(server, "GET", "/repo/myrepo/logstreams/app/logs",
+                    q="latency in [10 20]", **base)
+    assert st == 200
+    assert sorted(r["latency"] for r in body["logs"]) == [10, 12, 14, 16, 18, 20]
+
+
+def test_query_logs_scroll_pagination(server):
+    _setup_logs(server)
+    seen = []
+    scroll = ""
+    for _ in range(10):
+        params = {"q": "*", "from": BASE_MS, "to": BASE_MS + 60_000, "limit": 7}
+        if scroll:
+            params["scroll_id"] = scroll
+        st, body = _req(server, "GET", "/repo/myrepo/logstreams/app/logs",
+                        **params)
+        assert st == 200
+        seen.extend(r["timestamp"] for r in body["logs"])
+        scroll = body["scroll_id"]
+        if not scroll:
+            break
+    assert len(seen) == 40
+    assert seen == sorted(seen, reverse=True)
+    assert len(set(seen)) == 40  # no duplicates across pages
+
+
+def test_query_logs_extract_and_alias_filter(server):
+    _setup_logs(server)
+    st, body = _req(
+        server, "GET", "/repo/myrepo/logstreams/app/logs",
+        q='EXTRACT(content: "code=(\\d+)") AS(code) | code: 500',
+        **{"from": BASE_MS, "to": BASE_MS + 60_000, "limit": 100},
+    )
+    assert st == 200, body
+    assert body["count"] == 10
+    assert all(r["code"] == "500" for r in body["logs"])
+
+
+def test_scroll_with_alias_filter_covers_all_matches(server):
+    """Alias-filtered pages must keep scrolling through the raw stream:
+    a page whose rows are mostly filtered out still advances the cursor
+    instead of reporting early completion."""
+    _setup_logs(server)
+    seen, scroll = [], ""
+    for _ in range(30):
+        params = {
+            "q": 'EXTRACT(content: "code=(\\d+)") AS(code) | code: 500',
+            "from": BASE_MS, "to": BASE_MS + 60_000, "limit": 3,
+        }
+        if scroll:
+            params["scroll_id"] = scroll
+        st, body = _req(server, "GET", "/repo/myrepo/logstreams/app/logs",
+                        **params)
+        assert st == 200, body
+        seen.extend(r["timestamp"] for r in body["logs"])
+        scroll = body["scroll_id"]
+        if not scroll:
+            break
+    assert len(seen) == 10  # every i%4==0 row, no early stop, no dupes
+    assert len(set(seen)) == 10
+
+
+def test_histogram(server):
+    _setup_logs(server)
+    st, body = _req(server, "GET", "/repo/myrepo/logstreams/app/histogram",
+                    q="*", interval="10s",
+                    **{"from": BASE_MS, "to": BASE_MS + 40_000})
+    assert st == 200, body
+    assert body["count"] == 40
+    assert [b["count"] for b in body["histograms"]] == [10, 10, 10, 10]
+    st, body = _req(server, "GET", "/repo/myrepo/logstreams/app/histogram",
+                    q="error", interval="20s",
+                    **{"from": BASE_MS, "to": BASE_MS + 40_000})
+    assert body["count"] == 10
+
+
+def test_context(server):
+    _setup_logs(server)
+    mid = BASE_MS + 20_000
+    st, body = _req(server, "GET", "/repo/myrepo/logstreams/app/context",
+                    timestamp=mid, backward=3, forward=3,
+                    **{"from": BASE_MS, "to": BASE_MS + 60_000})
+    assert st == 200, body
+    ts = [r["timestamp"] for r in body["logs"]]
+    assert ts == [mid - 3000, mid - 2000, mid - 1000, mid, mid + 1000, mid + 2000]
+
+
+def test_analytics_group_by_tag(server):
+    _setup_logs(server)
+    st, body = _req(server, "GET", "/repo/myrepo/logstreams/app/analytics",
+                    q="*", group_by="host", agg="count",
+                    **{"from": BASE_MS, "to": BASE_MS + 60_000})
+    assert st == 200, body
+    got = {r["host"]: r["count"] for r in body["analytics"]}
+    assert got == {"web0": 20, "web1": 20}
+    st, body = _req(server, "GET", "/repo/myrepo/logstreams/app/analytics",
+                    q="*", agg="mean", field="latency",
+                    **{"from": BASE_MS, "to": BASE_MS + 60_000})
+    assert body["analytics"][0]["mean"] == pytest.approx(39.0)
+
+
+def test_consume_endpoints(server):
+    _setup_logs(server)
+    st, body = _req(server, "GET",
+                    "/repo/myrepo/logstreams/app/consume/cursor-time",
+                    **{"from": BASE_MS})
+    assert st == 200
+    cursor = body["cursor"]
+    st, body = _req(server, "GET",
+                    "/repo/myrepo/logstreams/app/consume/logs",
+                    cursor=cursor, limit=25)
+    assert st == 200, body
+    assert len(body["rows"]) == 25
+
+
+def test_upload_json_array_and_content_synthesis(server):
+    assert _req(server, "POST", "/repo/r2")[0] == 200
+    assert _req(server, "POST", "/repo/r2/logstreams/s")[0] == 200
+    body = json.dumps([
+        {"time": BASE_MS, "msg": "hello", "n": 3},
+        {"time": BASE_MS + 1000, "content": "explicit"},
+    ]).encode()
+    st, out = _req(server, "POST", "/repo/r2/logstreams/s/upload",
+                   body=body, type="json_array")
+    assert st == 200 and out["written"] == 2
+    st, out = _req(server, "GET", "/repo/r2/logstreams/s/logs",
+                   q="*", **{"from": BASE_MS - 1000, "to": BASE_MS + 10_000})
+    assert st == 200
+    contents = {r["content"] for r in out["logs"]}
+    assert "explicit" in contents
+    # row without content got one synthesized from its fields
+    assert any("hello" in c for c in contents)
+
+
+def test_upload_precision_and_bad_lines(server):
+    assert _req(server, "POST", "/repo/r3")[0] == 200
+    assert _req(server, "POST", "/repo/r3/logstreams/s")[0] == 200
+    # seconds precision
+    st, out = _req(server, "POST", "/repo/r3/logstreams/s/upload",
+                   body=json.dumps({"time": BASE_MS // 1000,
+                                    "content": "x"}).encode(),
+                   precision="s")
+    assert st == 200 and out["written"] == 1
+    st, body = _req(server, "GET", "/repo/r3/logstreams/s/logs", q="*",
+                    **{"from": BASE_MS - 1000, "to": BASE_MS + 1000})
+    assert body["count"] == 1 and body["logs"][0]["timestamp"] == BASE_MS
+    # non-JSON line becomes a content-only row (never dropped)
+    st, out = _req(server, "POST", "/repo/r3/logstreams/s/upload",
+                   body=b"plain text log line\n")
+    assert st == 200 and out["written"] == 1
+
+
+def test_logs_unknown_stream_404(server):
+    assert _req(server, "POST", "/repo/r4")[0] == 200
+    st, _ = _req(server, "POST", "/repo/r4/logstreams/nope/upload", body=b"{}")
+    assert st == 404
