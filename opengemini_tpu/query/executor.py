@@ -3422,7 +3422,10 @@ def _linear_fill(col):
 
 def _pyval(v, ftype):
     if ftype == FieldType.FLOAT:
-        return float(v)
+        fv = float(v)
+        # non-finite floats marshal as JSON null (influx semantics; a bare
+        # NaN/Infinity literal is not valid strict JSON and breaks clients)
+        return fv if math.isfinite(fv) else None
     if ftype == FieldType.INT:
         return int(v)
     if ftype == FieldType.BOOL:

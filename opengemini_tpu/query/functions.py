@@ -13,14 +13,21 @@ sql-side transforms.
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 NS = 1_000_000_000
 
 
 def py_value(v):
-    """numpy scalar -> python value; strings pass through."""
-    return v.item() if hasattr(v, "item") else v
+    """numpy scalar -> python value; strings pass through. Non-finite
+    floats become None: every caller feeds JSON row output, where a bare
+    NaN/Infinity literal is not strict JSON (influx marshals null)."""
+    out = v.item() if hasattr(v, "item") else v
+    if isinstance(out, float) and not math.isfinite(out):
+        return None
+    return out
 
 # transforms: f(times, values) -> (out_times, out_values); applied per
 # series-group over raw points, or over the window-aggregated sequence

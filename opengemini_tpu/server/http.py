@@ -113,6 +113,20 @@ def format_result(result: dict, epoch: str | None) -> dict:
     return result
 
 
+def _null_nonfinite(obj):
+    """Deep-copy with non-finite floats replaced by None (influx marshals
+    null). Only runs when a payload actually contains one."""
+    import math
+
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _null_nonfinite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_null_nonfinite(v) for v in obj]
+    return obj
+
+
 def _make_handler(svc: HttpService):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -129,10 +143,17 @@ def _make_handler(svc: HttpService):
             return {k: v[-1] for k, v in qs.items()}
 
         def _body(self) -> bytes:
+            """Read (and cache) the request body. Caching makes _body()
+            idempotent so handlers can drain the socket for keep-alive
+            correctness even when they ignore the payload."""
+            cached = getattr(self, "_body_cache", None)
+            if cached is not None:
+                return cached
             length = int(self.headers.get("Content-Length", 0))
             data = self.rfile.read(length) if length else b""
             if self.headers.get("Content-Encoding") == "gzip":
                 data = gzip.decompress(data)
+            self._body_cache = data
             return data
 
         def _internal_request(self, svc) -> dict | None:
@@ -180,7 +201,16 @@ def _make_handler(svc: HttpService):
                 self.wfile.write(payload)
 
         def _send_json(self, code: int, obj: dict, pretty: bool = False):
-            data = json.dumps(obj, indent=4 if pretty else None) + "\n"
+            indent = 4 if pretty else None
+            try:
+                # strict JSON: a stray non-finite float anywhere in a
+                # result must not serialize as a bare NaN/Infinity literal
+                # (unparseable by standard clients). allow_nan=False makes
+                # the common all-finite case zero-cost; only offending
+                # payloads pay for the sanitize walk.
+                data = json.dumps(obj, indent=indent, allow_nan=False) + "\n"
+            except ValueError:
+                data = json.dumps(_null_nonfinite(obj), indent=indent) + "\n"
             self._send(code, data.encode("utf-8"))
 
         def _authenticate(self, params: dict):
@@ -218,6 +248,7 @@ def _make_handler(svc: HttpService):
 
         def do_GET(self):
             self._form_pairs = ()  # reset per request (keep-alive reuse)
+            self._body_cache = None
             path = urllib.parse.urlparse(self.path).path
             if path == "/ping":
                 self._send(204)
@@ -259,6 +290,7 @@ def _make_handler(svc: HttpService):
 
         def do_POST(self):
             self._form_pairs = ()  # reset per request (keep-alive reuse)
+            self._body_cache = None
             path = urllib.parse.urlparse(self.path).path
             params = self._params()
             if path == "/query":
@@ -472,6 +504,7 @@ def _make_handler(svc: HttpService):
 
         def do_DELETE(self):
             self._form_pairs = ()  # reset per request (keep-alive reuse)
+            self._body_cache = None
             path = urllib.parse.urlparse(self.path).path
             if path.startswith("/repo/"):
                 if not svc.logstore.handle(self, "DELETE", path, self._params()):

@@ -47,21 +47,14 @@ from opengemini_tpu.ingest.line_protocol import FieldType
 from opengemini_tpu.sql import logparser
 from opengemini_tpu.storage.engine import DatabaseNotFound
 
+from opengemini_tpu.sql.lexer import parse_duration_ns as _parse_interval_ns
+
 NS_PER_MS = 1_000_000
+NS_PER_DAY = 86_400 * 10**9
 _NAME_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]{0,127}$")
 _PRECISION = {"ns": 1, "us": 1_000, "ms": 1_000_000, "s": 1_000_000_000,
               "": 1_000_000}
 _MAX_LIMIT = 1000
-_DUR_RE = re.compile(r"^(\d+)(ms|s|m|h|d)$")
-_DUR_NS = {"ms": 1_000_000, "s": 10**9, "m": 60 * 10**9, "h": 3600 * 10**9,
-           "d": 86400 * 10**9}
-
-
-def _parse_interval_ns(text: str) -> int | None:
-    m = _DUR_RE.match(text.strip())
-    if not m:
-        return None
-    return int(m.group(1)) * _DUR_NS[m.group(2)]
 
 
 class LogStoreAPI:
@@ -77,6 +70,11 @@ class LogStoreAPI:
         (caller falls through to its 404)."""
         if path != "/repo" and not path.startswith("/repo/"):
             return False
+        if method in ("POST", "DELETE"):
+            # drain the request body up front (cached; see Handler._body):
+            # several routes ignore their payload, and unread bytes would
+            # desync the next request on a keep-alive connection
+            h._body()
         parts = [urllib.parse.unquote(p) for p in path.split("/") if p][1:]
         # validate name segments up front: repo/logstream names are
         # interpolated into InfluxQL identifiers downstream, so anything
@@ -203,7 +201,7 @@ class LogStoreAPI:
                 continue  # the implicit default RP is not a logstream
             out.append({
                 "name": name,
-                "ttl_days": rp.duration_ns // _DUR_NS["d"] if rp.duration_ns else 0,
+                "ttl_days": rp.duration_ns // NS_PER_DAY if rp.duration_ns else 0,
             })
         return out
 
@@ -239,7 +237,7 @@ class LogStoreAPI:
                     return
             ttl_days = int(opts.get("ttl", 0) or 0)
             eng.create_retention_policy(
-                repo, ls, duration_ns=ttl_days * _DUR_NS["d"]
+                repo, ls, duration_ns=ttl_days * NS_PER_DAY
             )
             h._send_json(200, {"success": True})
         elif method == "DELETE":
@@ -320,8 +318,16 @@ class LogStoreAPI:
                 try:
                     objs.append(json.loads(line))
                 except ValueError:
-                    objs.append({"content": line.decode("utf-8", "replace")
-                                 if isinstance(line, bytes) else line})
+                    objs.append(line.decode("utf-8", "replace")
+                                if isinstance(line, bytes) else line)
+        # non-object entries (bare scalars, plain-text lines) become
+        # content-only rows — a log file of bare lines must ingest the
+        # same way whether or not the lines happen to parse as JSON
+        objs = [
+            o if isinstance(o, dict)
+            else {"content": o if isinstance(o, str) else json.dumps(o)}
+            for o in objs
+        ]
         now_ns = _time.time_ns()
         ts_field = mapping["timestamp"]
         discard = set(mapping.get("discard") or [])
@@ -443,6 +449,13 @@ class LogStoreAPI:
                 a, _, b = scroll_id.partition(":")
                 cur_t, skip_at = int(a), int(b)
             except ValueError:
+                h._send_json(400, {"error": "bad scroll_id"})
+                return
+            # a crafted skip component must not defeat the page cap (fetch
+            # = limit + skip_at becomes the engine LIMIT below); ties at
+            # one timestamp beyond 10x the max page size are not a thing
+            # a legitimate cursor can produce
+            if not (0 <= cur_t and 0 <= skip_at <= 10 * _MAX_LIMIT):
                 h._send_json(400, {"error": "bad scroll_id"})
                 return
             if reverse:

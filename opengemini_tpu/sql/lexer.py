@@ -42,6 +42,22 @@ _DUR_NS = {
 }
 
 
+def parse_duration_ns(text: str) -> int | None:
+    """Whole-string duration ('90s', '1h30m') -> ns, else None. The single
+    duration-unit table for every surface (SQL lexer, logstore intervals)."""
+    text = text.strip()
+    total, j, n = 0, 0, len(text)
+    if not n:
+        return None
+    while j < n:
+        m = _DUR_RE.match(text, j)
+        if not m or m.start() != j:
+            return None
+        total += int(m.group(1)) * _DUR_NS[m.group(2)]
+        j = m.end()
+    return total
+
+
 @dataclass
 class Token:
     kind: str  # IDENT KEYWORD STRING NUMBER INTEGER DURATION REGEX OP EOF

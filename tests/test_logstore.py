@@ -332,6 +332,20 @@ def test_upload_precision_and_bad_lines(server):
     st, out = _req(server, "POST", "/repo/r3/logstreams/s/upload",
                    body=b"plain text log line\n")
     assert st == 200 and out["written"] == 1
+    # bare JSON scalars ingest the same way as plain text (no special-
+    # casing lines that happen to parse as JSON)
+    st, out = _req(server, "POST", "/repo/r3/logstreams/s/upload",
+                   body=b'42\ntrue\n"hello scalar"\n')
+    assert st == 200 and out["written"] == 3, out
+
+
+def test_scroll_id_abuse_rejected(server):
+    _setup_logs(server)
+    base = {"q": "*", "from": BASE_MS, "to": BASE_MS + 60_000, "limit": 5}
+    for bad in ("0:1000000000", "5:-10", "-1:0", "x:y"):
+        st, body = _req(server, "GET", "/repo/myrepo/logstreams/app/logs",
+                        scroll_id=bad, **base)
+        assert st == 400, (bad, body)
 
 
 def test_logs_unknown_stream_404(server):
