@@ -18,6 +18,7 @@ import hashlib
 import json
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 
 import numpy as np
@@ -25,6 +26,10 @@ import numpy as np
 from opengemini_tpu.index.inverted import SeriesIndex
 from opengemini_tpu.record import Column, FieldType, Record
 from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+# a peer's cached health view older than this cannot vote in the quorum
+# failure view (its probe loop stalled or has not run yet)
+_MAX_VIEW_AGE_S = 90.0
 
 
 def owners(nodes: list[str], db: str, rp: str, group_start: int,
@@ -437,6 +442,12 @@ class DataRouter:
         self._hint_lock = threading.Lock()
         # last health-probe results: node id -> bool (True = reachable)
         self.health: dict[str, bool] = {}
+        self.health_ts: float = 0.0  # walltime of the last local probe
+        # quorum-aggregated failure view (gossip equivalent): node id ->
+        # bool agreed by a majority of live observers; plus first-seen-down
+        # walltime for failover grace decisions
+        self.shared_health: dict[str, bool] = {}
+        self.down_since: dict[str, float] = {}
 
     def probe_health(self) -> dict[str, bool]:
         """Ping every registered data node (reference: the cluster
@@ -452,10 +463,94 @@ class DataRouter:
             except OSError:
                 return (nid, False)
 
+        import time as _t
+
         results = dict(self._fanout(probe))
         results[self.self_id] = True
         self.health = results
+        self.health_ts = _t.time()
         return results
+
+    def exchange_health(self) -> dict[str, bool]:
+        """Shared failure view: probe locally, then exchange views with
+        reachable peers and agree by majority (the serf-gossip equivalent,
+        reference app/ts-meta/meta/cluster_manager.go:323 checkFailedNode;
+        SWIM-style indirect observation without the gossip protocol — the
+        membership roster is already raft-replicated, only liveness needs
+        agreement).
+
+        A node counts DOWN only when >half of the live observers (self +
+        peers whose view we could fetch) say so — one coordinator with a
+        broken route cannot wrongly demote a healthy replica, and one
+        flaky link cannot flap SHOW CLUSTER for everyone."""
+        import time as _t
+
+        local = dict(self.probe_health())
+        now = _t.time()
+
+        def fetch(nid, addr):
+            # fetch from EVERY peer, including ones our local probe lost:
+            # a reachable view from a "down" peer is the SWIM-style
+            # refutation (our route is broken, the node is fine)
+            if not addr:
+                return None
+            req = urllib.request.Request(
+                f"http://{addr}/cluster/health",
+                headers={"X-Ogt-Token": self.token},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=2) as r:
+                    got = json.loads(r.read())
+                view = got.get("health")
+                if isinstance(view, dict):
+                    return (nid, {str(k): bool(v) for k, v in view.items()},
+                            float(got.get("ts", 0)))
+            except (OSError, ValueError):
+                pass
+            return None
+
+        views: dict[str, dict[str, bool]] = {}
+        for got in self._fanout(fetch):
+            if got is None:
+                continue
+            nid, view, ts = got
+            # completing an HTTP round-trip to nid IS liveness evidence —
+            # it corrects a stale/failed local ping before the tally (the
+            # 2-node tie case: our broken route must not outvote the
+            # refutation we just received)
+            local[nid] = True
+            if now - ts <= _MAX_VIEW_AGE_S:
+                # stale cached views (peer's probe loop stalled or hasn't
+                # run yet) don't get to outvote fresh observations
+                views[nid] = view
+        views[self.self_id] = local
+        agreed: dict[str, bool] = {}
+        for nid in self.data_nodes():
+            votes = [v[nid] for v in views.values() if nid in v]
+            up = sum(votes) * 2 >= len(votes) if votes else local.get(nid, False)
+            agreed[nid] = up
+        agreed[self.self_id] = True
+        for nid, up in agreed.items():
+            if up:
+                self.down_since.pop(nid, None)
+            else:
+                self.down_since.setdefault(nid, now)
+        # roster changes: drop grace timestamps for decommissioned nodes so
+        # a later re-join with the same id starts a fresh grace window
+        for nid in list(self.down_since):
+            if nid not in agreed:
+                del self.down_since[nid]
+        self.shared_health = agreed
+        return agreed
+
+    def node_up(self, nid: str) -> bool:
+        """Best failure signal available: the quorum view when one has
+        been computed, else the local probe, defaulting optimistic (an
+        unknown node is treated reachable so writes try it and hint on
+        failure rather than silently skipping)."""
+        if nid in self.shared_health:
+            return self.shared_health[nid]
+        return self.health.get(nid, True)
 
     def data_nodes(self) -> dict[str, str]:
         nodes = {
@@ -728,8 +823,8 @@ class DataRouter:
             dest = owners(ids, db, rp, start, self.rf)
             if self.self_id in dest:
                 continue
-            if not all(self.health.get(peer, True) for peer in dest):
-                continue  # owner down: retry when the cluster heals
+            if not all(self.node_up(peer) for peer in dest):
+                continue  # owner down (quorum view): retry when healed
             try:
                 for peer in dest:
                     self._push_shard(peer, db, rp, sh)
@@ -800,7 +895,7 @@ class DataRouter:
         for peer in ids:
             if peer == self.self_id:
                 continue
-            if peer in pending or not self.health.get(peer, True):
+            if peer in pending or not self.node_up(peer):
                 continue  # hints still owed / peer down: not divergence
             addr = nodes.get(peer, "")
             if not addr:

@@ -259,22 +259,32 @@ class Executor:
         """Reference: SHOW CLUSTER (meta/data node roster with status)."""
         rows = []
         if self.meta_store is None:
-            rows.append(["local", "", "meta,data", "leader"])
+            rows.append(["local", "", "meta,data", "leader", ""])
         else:
             leader = self.meta_store.leader_hint()
             members = self.meta_store.meta_members()
             for nid in sorted(members):
                 status = "leader" if nid == leader else "follower"
-                rows.append([nid, members[nid], "meta", status])
+                rows.append([nid, members[nid], "meta", status, ""])
             health = getattr(self.router, "health", {}) if self.router else {}
+            shared = getattr(self.router, "shared_health", {}) if self.router else {}
+            down_since = getattr(self.router, "down_since", {}) if self.router else {}
             for nid, info in sorted(self.meta_store.fsm.nodes.items()):
                 status = "registered"
-                if nid in health:
+                # quorum view (exchange_health) wins over the purely local
+                # probe: one coordinator's broken route must not show a
+                # healthy node as down
+                if nid in shared:
+                    status = "up" if shared[nid] else "down"
+                elif nid in health:
                     status = "up" if health[nid] else "down"
+                since = down_since.get(nid)
                 rows.append([nid, info.get("addr", ""),
-                             info.get("role", "data"), status])
+                             info.get("role", "data"), status,
+                             cond.format_rfc3339(int(since * 1e9)) if since else ""])
         return {"series": [_series("cluster", None,
-                                   ["id", "addr", "role", "status"], rows)]}
+                                   ["id", "addr", "role", "status", "down_since"],
+                                   rows)]}
 
     def _show_downsamples(self, stmt, db: str) -> dict:
         tgt = stmt.database or db

@@ -269,6 +269,25 @@ def _make_handler(svc: HttpService):
                 if user is False:
                     return
                 self._send_json(200, svc.meta_store.status())
+            elif path == "/cluster/health" and svc.router is not None:
+                # peer view exchange for the quorum failure view
+                # (DataRouter.exchange_health); token-gated like the
+                # /internal data plane
+                token = getattr(svc.router, "token", "")
+                sent = self.headers.get("X-Ogt-Token", "")
+                if token and sent != token:
+                    self._send_json(403, {"error": "bad cluster token"})
+                    return
+                if not token and svc.auth_enabled:
+                    self._send_json(403, {"error": "cluster token required"})
+                    return
+                self._send_json(200, {
+                    "id": svc.router.self_id,
+                    "health": svc.router.health,
+                    # when the health was PROBED, not when it is served —
+                    # the voter discards stale views by this age
+                    "ts": svc.router.health_ts,
+                })
             elif path == "/debug/vars":
                 import time as _t
 

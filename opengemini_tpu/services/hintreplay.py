@@ -15,7 +15,9 @@ class HintReplayService(Service):
         self.router = router
 
     def handle(self) -> int:
-        self.router.probe_health()  # member liveness (SHOW CLUSTER status)
+        # member liveness: quorum-agreed failure view (SHOW CLUSTER status,
+        # migration gates, read-primary demotion)
+        self.router.exchange_health()
         n = self.router.replay_hints()
         if n:
             logger.info("hinted handoff: delivered %d points", n)

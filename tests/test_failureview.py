@@ -1,0 +1,216 @@
+"""Quorum-shared failure view (the serf-gossip equivalent; reference
+app/ts-meta/meta/cluster_manager.go:323 checkFailedNode): nodes exchange
+local probe views over /cluster/health and agree on liveness by
+majority, so one coordinator's broken route cannot demote a healthy
+replica, and a really-dead node is agreed down by everyone."""
+
+import json
+import urllib.request
+
+from opengemini_tpu.parallel.cluster import DataRouter
+from opengemini_tpu.server.http import HttpService
+from opengemini_tpu.storage.engine import Engine
+
+
+class FsmStub:
+    def __init__(self, addrs):
+        self.nodes = {n: {"addr": a, "role": "data"}
+                      for n, a in addrs.items()}
+
+
+class StoreStub:
+    token = ""
+
+    def __init__(self, addrs):
+        self.fsm = FsmStub(addrs)
+
+
+def _cluster(tmp_path, n):
+    addrs: dict[str, str] = {}
+    nodes = {}
+    for i in range(n):
+        nid = f"n{i}"
+        e = Engine(str(tmp_path / nid))
+        e.create_database("db")
+        svc = HttpService(e, "127.0.0.1", 0)
+        svc.start()
+        addrs[nid] = f"127.0.0.1:{svc.port}"
+        nodes[nid] = (e, svc)
+    store = StoreStub(addrs)
+    for nid, (e, svc) in nodes.items():
+        svc.router = DataRouter(e, store, nid, addrs[nid])
+        svc.executor.router = svc.router
+        svc.executor.meta_store = None
+    return nodes, addrs, store
+
+
+def _teardown(nodes):
+    for e, svc in nodes.values():
+        svc.stop()
+        e.close()
+
+
+def test_all_up_agreed(tmp_path):
+    nodes, addrs, _store = _cluster(tmp_path, 3)
+    try:
+        view = nodes["n0"][1].router.exchange_health()
+        assert view == {"n0": True, "n1": True, "n2": True}
+        assert nodes["n0"][1].router.down_since == {}
+    finally:
+        _teardown(nodes)
+
+
+def test_dead_node_agreed_down_and_recovers(tmp_path):
+    nodes, addrs, _store = _cluster(tmp_path, 3)
+    try:
+        e2, svc2 = nodes["n2"]
+        svc2.stop()  # n2 really dies
+        r0 = nodes["n0"][1].router
+        view = r0.exchange_health()
+        assert view["n2"] is False and view["n1"] is True
+        assert "n2" in r0.down_since
+        assert r0.node_up("n1") and not r0.node_up("n2")
+        # n2 comes back on the SAME port
+        host, _, port = addrs["n2"].partition(":")
+        svc_new = HttpService(e2, host, int(port))
+        svc_new.router = r0  # not used; roster addr is what matters
+        svc_new.start()
+        try:
+            view = r0.exchange_health()
+            assert view["n2"] is True
+            assert "n2" not in r0.down_since
+        finally:
+            svc_new.stop()
+        nodes["n2"] = (e2, svc2)  # svc2 already stopped; e2 closed in teardown
+    finally:
+        for nid, (e, svc) in nodes.items():
+            if nid != "n2":
+                svc.stop()
+            e.close()
+
+
+def test_local_route_break_outvoted(tmp_path):
+    """n0's local probe wrongly says n2 is down (simulated by poisoning
+    its local view); the peer views outvote it and the shared view keeps
+    n2 up."""
+    nodes, addrs, _store = _cluster(tmp_path, 3)
+    try:
+        r0 = nodes["n0"][1].router
+        real_probe = r0.probe_health
+
+        def broken_probe():
+            got = dict(real_probe())
+            got["n2"] = False  # my route to n2 is broken
+            r0.health = got
+            return got
+
+        r0.probe_health = broken_probe
+        # peers have probed recently (the hintreplay service tick)
+        nodes["n1"][1].router.probe_health()
+        nodes["n2"][1].router.probe_health()
+        view = r0.exchange_health()
+        # n1 and n2 both see n2 up; 2-of-3 majority keeps it up
+        assert view["n2"] is True
+        assert r0.node_up("n2")
+        # the purely local view still records the broken route
+        assert r0.health["n2"] is False
+    finally:
+        _teardown(nodes)
+
+
+def test_two_node_refutation(tmp_path):
+    """2-node cluster: a broken local ping to the only peer must be
+    refuted by the successful /cluster/health round-trip to that peer —
+    no false demotion in the smallest rf=2 deployment."""
+    nodes, addrs, _store = _cluster(tmp_path, 2)
+    try:
+        r0 = nodes["n0"][1].router
+        nodes["n1"][1].router.probe_health()
+        real_probe = r0.probe_health
+
+        def broken_probe():
+            got = dict(real_probe())
+            got["n1"] = False
+            r0.health = got
+            return got
+
+        r0.probe_health = broken_probe
+        view = r0.exchange_health()
+        assert view["n1"] is True
+    finally:
+        _teardown(nodes)
+
+
+def test_stale_peer_views_cannot_vote(tmp_path):
+    """A peer whose cached view is ancient (probe loop stalled) must not
+    outvote fresh observations."""
+    from opengemini_tpu.parallel import cluster as cl
+
+    nodes, addrs, _store = _cluster(tmp_path, 3)
+    try:
+        # n1 and n2 hold STALE views claiming n2 is down
+        for nid in ("n1", "n2"):
+            r = nodes[nid][1].router
+            r.health = {"n0": True, "n1": True, "n2": False}
+            r.health_ts = 1.0  # 1970 — far beyond _MAX_VIEW_AGE_S
+        r0 = nodes["n0"][1].router
+        view = r0.exchange_health()
+        # only n0's fresh local probe votes: n2 is reachable -> up
+        assert view["n2"] is True
+        assert cl._MAX_VIEW_AGE_S > 0  # the constant the rule rides on
+    finally:
+        _teardown(nodes)
+
+
+def test_health_endpoint_shape(tmp_path):
+    nodes, addrs, _store = _cluster(tmp_path, 2)
+    try:
+        r0 = nodes["n0"][1].router
+        r0.probe_health()
+        with urllib.request.urlopen(
+            f"http://{addrs['n0']}/cluster/health", timeout=10
+        ) as r:
+            got = json.loads(r.read())
+        assert got["id"] == "n0"
+        assert set(got["health"]) == {"n0", "n1"}
+    finally:
+        _teardown(nodes)
+
+
+def test_show_cluster_uses_shared_view(tmp_path):
+    import urllib.parse
+
+    nodes, addrs, store = _cluster(tmp_path, 3)
+    try:
+        # SHOW CLUSTER needs a meta_store on the executor; reuse the stub
+        # with the bits the renderer touches
+        class MetaStub(StoreStub):
+            def leader_hint(self):
+                return "n0"
+
+            def meta_members(self):
+                return {}
+
+        meta = MetaStub(addrs)
+        meta.fsm = store.fsm
+        ex = nodes["n0"][1].executor
+        ex.meta_store = meta
+        nodes["n1"][1].stop()
+        r0 = nodes["n0"][1].router
+        r0.exchange_health()
+        url = (f"http://{addrs['n0']}/query?"
+               + urllib.parse.urlencode({"q": "SHOW CLUSTER"}))
+        req = urllib.request.Request(url, data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            res = json.loads(r.read())
+        series = res["results"][0]["series"][0]
+        cols = series["columns"]
+        assert cols == ["id", "addr", "role", "status", "down_since"]
+        by_id = {row[0]: row for row in series["values"]}
+        assert by_id["n1"][3] == "down" and by_id["n1"][4] != ""
+        assert by_id["n2"][3] == "up" and by_id["n2"][4] == ""
+    finally:
+        for nid, (e, svc) in nodes.items():
+            if nid != "n1":
+                svc.stop()
+            e.close()
