@@ -163,23 +163,179 @@ def over_time(times, values, counts, step_starts, step_ends, func: str):
         if func == "sum":
             return jnp.where(has, wsum, 0), has
         return jnp.where(has, wsum, 0) / jnp.maximum(wcnt, 1), has
+    if func in ("stddev", "stdvar"):
+        # population variance over window samples (prom funcStddevOverTime)
+        # via prefix sums. Variance is shift-invariant, so values are
+        # centered on the per-series mean FIRST: raw v^2 prefix sums over
+        # a long series of large-magnitude samples (e.g. ~1.7e9 unix-
+        # timestamp gauges) reach ~3e22 and the window difference loses
+        # every significant digit (verified: naive form returned -4e5
+        # where the true variance was 0.65)
+        valid_cols = jnp.arange(n)[None, :] < counts[:, None]
+        vz_raw = jnp.where(valid_cols, values, 0)
+        series_n = jnp.maximum(counts, 1).astype(values.dtype)[:, None]
+        center = vz_raw.sum(axis=1, keepdims=True) / series_n
+        vz = jnp.where(valid_cols, values - center, 0)
+        c1 = jnp.cumsum(vz, axis=1)
+        c2 = jnp.cumsum(vz * vz, axis=1)
+        zcol = jnp.zeros_like(c1[:, :1])
+        c1 = jnp.concatenate([zcol, c1], axis=1)
+        c2 = jnp.concatenate([zcol, c2], axis=1)
+        safe_f = jnp.clip(first_idx, 0, n)
+        safe_l1 = jnp.clip(last_idx + 1, 0, n)
+        ws = _gather_rows(c1, safe_l1) - _gather_rows(c1, safe_f)
+        wss = _gather_rows(c2, safe_l1) - _gather_rows(c2, safe_f)
+        wcnt = jnp.where(has, (last_idx - first_idx + 1), 0).astype(values.dtype)
+        denom = jnp.maximum(wcnt, 1)
+        mean = ws / denom
+        var = jnp.maximum(wss / denom - mean * mean, 0)
+        out = var if func == "stdvar" else jnp.sqrt(var)
+        return jnp.where(has, out, 0), has
+    if func == "present":
+        return jnp.where(has, 1.0, 0.0).astype(values.dtype), has
     if func in ("min", "max"):
         k = step_starts.shape[0]
         chunk = 256
         outs = []
         fill = jnp.inf if func == "min" else -jnp.inf
         for c0 in range(0, k, chunk):
-            fi = first_idx[:, c0 : c0 + chunk, None]
-            li = last_idx[:, c0 : c0 + chunk, None]
-            col = jnp.arange(n)[None, None, :]
-            in_win = (col >= fi) & (col <= li) & (col < counts[:, None, None])
-            v = values[:, None, :]
+            in_win, v = _window_tensor(times, values, counts, first_idx,
+                                       last_idx, c0, chunk)
             if func == "min":
                 outs.append(jnp.where(in_win, v, fill).min(axis=2))
             else:
                 outs.append(jnp.where(in_win, v, fill).max(axis=2))
         return jnp.concatenate(outs, axis=1), has
     raise ValueError(f"unsupported over_time func {func!r}")
+
+
+def _window_tensor(times, values, counts, first_idx, last_idx, c0, chunk):
+    """Masked (S, C, N) membership view for one step chunk: (in_win, v)."""
+    n = values.shape[1]
+    fi = first_idx[:, c0 : c0 + chunk, None]
+    li = last_idx[:, c0 : c0 + chunk, None]
+    col = jnp.arange(n)[None, None, :]
+    in_win = (col >= fi) & (col <= li) & (col < counts[:, None, None])
+    return in_win, values[:, None, :]
+
+
+def quantile_over_time(times, values, counts, step_starts, step_ends, q: float):
+    """phi-quantile with linear interpolation over window samples (prom
+    funcQuantileOverTime). Dense chunked like min/max; NaN-padded windows
+    + nanquantile keep the masked samples out."""
+    first_idx, last_idx, has = window_bounds(times, counts, step_starts, step_ends)
+    k = step_starts.shape[0]
+    chunk = 256
+    outs = []
+    for c0 in range(0, k, chunk):
+        in_win, v = _window_tensor(times, values, counts, first_idx, last_idx, c0, chunk)
+        vw = jnp.where(in_win, v, jnp.nan)
+        outs.append(jnp.nanquantile(vw, jnp.clip(q, 0.0, 1.0), axis=2))
+    out = jnp.concatenate(outs, axis=1)
+    if q < 0:
+        out = jnp.full_like(out, -jnp.inf)
+    elif q > 1:
+        out = jnp.full_like(out, jnp.inf)
+    return out, has
+
+
+def mad_over_time(times, values, counts, step_starts, step_ends):
+    """median(|v - median(v)|) over window samples (prom mad_over_time)."""
+    first_idx, last_idx, has = window_bounds(times, counts, step_starts, step_ends)
+    k = step_starts.shape[0]
+    chunk = 128  # two dense passes live at once
+    outs = []
+    for c0 in range(0, k, chunk):
+        in_win, v = _window_tensor(times, values, counts, first_idx, last_idx, c0, chunk)
+        vw = jnp.where(in_win, v, jnp.nan)
+        med = jnp.nanmedian(vw, axis=2, keepdims=True)
+        outs.append(jnp.nanmedian(jnp.abs(vw - med), axis=2))
+    return jnp.concatenate(outs, axis=1), has
+
+
+def linear_regression(times, values, counts, step_starts, step_ends):
+    """Per-(series, step) least-squares over window samples, centered at
+    the window END (the prom eval time): returns (slope per second,
+    intercept at eval time, has_2plus). deriv() is the slope;
+    predict_linear(v, d) = intercept + slope * d
+    (prom promql/functions.go linearRegression)."""
+    first_idx, last_idx, has = window_bounds(times, counts, step_starts, step_ends)
+    k = step_starts.shape[0]
+    chunk = 128
+    slopes, intercepts = [], []
+    for c0 in range(0, k, chunk):
+        in_win, v = _window_tensor(times, values, counts, first_idx, last_idx, c0, chunk)
+        t_rel = times[:, None, :] - step_ends[None, c0 : c0 + chunk, None]
+        tw = jnp.where(in_win, t_rel, 0.0)
+        vw = jnp.where(in_win, v, 0.0)
+        cnt = in_win.sum(axis=2).astype(values.dtype)
+        denom_n = jnp.maximum(cnt, 1)
+        st = tw.sum(axis=2)
+        sv = vw.sum(axis=2)
+        stt = (tw * tw).sum(axis=2)
+        stv = (tw * vw).sum(axis=2)
+        cov = stv - st * sv / denom_n
+        var = stt - st * st / denom_n
+        slope = cov / jnp.where(var == 0, 1.0, var)
+        slope = jnp.where(var == 0, 0.0, slope)
+        intercept = sv / denom_n - slope * (st / denom_n)
+        slopes.append(slope)
+        intercepts.append(intercept)
+    first_t = _gather_rows(times, jnp.clip(first_idx, 0, times.shape[1] - 1))
+    last_t = _gather_rows(times, jnp.clip(last_idx, 0, times.shape[1] - 1))
+    has2 = has & (last_t > first_t)
+    return (jnp.concatenate(slopes, axis=1), jnp.concatenate(intercepts, axis=1),
+            has2)
+
+
+def holt_winters_window(times, values, counts, step_starts, step_ends,
+                        sf: float, tf: float):
+    """Prom double exponential smoothing per window
+    (funcHoltWinters/double_exponential_smoothing): sequential over the
+    window's samples — a lax.scan across the sample axis carrying
+    (level, trend) per (series, step), masked to each window's members.
+    Windows with <2 samples yield no result."""
+    from jax import lax
+
+    first_idx, last_idx, has = window_bounds(times, counts, step_starts, step_ends)
+    vj = jnp.asarray(values)  # dynamic scan indexing needs a jax array
+    n = values.shape[1]
+    k = step_starts.shape[0]
+    chunk = 128
+    outs, valids = [], []
+    for c0 in range(0, k, chunk):
+        in_win, _v = _window_tensor(times, values, counts, first_idx, last_idx,
+                                    c0, chunk)
+        shape = in_win[:, :, 0].shape  # (S, C)
+
+        def body(carry, i):
+            # prom recurrence (funcDoubleExponentialSmoothing): sample 0
+            # seeds the level; sample 1 seeds the trend then smooths with
+            # it; sample j>=2 first updates the trend from the two
+            # PREVIOUS levels, then smooths. Result = final level.
+            s_prev, s_curr, b, seen = carry
+            x = jnp.broadcast_to(vj[:, i][:, None], shape)
+            m = in_win[:, :, i]
+            is_first = m & (seen == 0)
+            is_second = m & (seen == 1)
+            later = m & (seen >= 2)
+            b_new = jnp.where(later, tf * (s_curr - s_prev) + (1 - tf) * b, b)
+            b_new = jnp.where(is_second, x - s_curr, b_new)
+            smooth = sf * x + (1 - sf) * (s_curr + b_new)
+            upd = is_second | later
+            new_s_prev = jnp.where(upd, s_curr, s_prev)
+            new_s_curr = jnp.where(upd, smooth, jnp.where(is_first, x, s_curr))
+            return (new_s_prev, new_s_curr, b_new,
+                    seen + m.astype(jnp.int32)), None
+
+        z = jnp.zeros(shape, values.dtype)
+        (s_prev, s_curr, b, seen), _ = lax.scan(
+            body, (z, z, z, jnp.zeros(shape, jnp.int32)), jnp.arange(n)
+        )
+        outs.append(s_curr)
+        valids.append(seen >= 2)
+    return (jnp.concatenate(outs, axis=1),
+            has & jnp.concatenate(valids, axis=1))
 
 
 def changes_resets(times, values, counts, step_starts, step_ends, kind: str):

@@ -503,9 +503,10 @@ class DataRouter:
                     got = json.loads(r.read())
                 view = got.get("health")
                 if isinstance(view, dict):
+                    age = got.get("age_s")
                     return (nid, {str(k): bool(v) for k, v in view.items()},
-                            float(got.get("ts", 0)))
-            except (OSError, ValueError):
+                            float(age) if age is not None else None)
+            except (OSError, ValueError, TypeError):
                 pass
             return None
 
@@ -513,15 +514,16 @@ class DataRouter:
         for got in self._fanout(fetch):
             if got is None:
                 continue
-            nid, view, ts = got
+            nid, view, age = got
             # completing an HTTP round-trip to nid IS liveness evidence —
             # it corrects a stale/failed local ping before the tally (the
             # 2-node tie case: our broken route must not outvote the
             # refutation we just received)
             local[nid] = True
-            if now - ts <= _MAX_VIEW_AGE_S:
+            if age is not None and age <= _MAX_VIEW_AGE_S:
                 # stale cached views (peer's probe loop stalled or hasn't
-                # run yet) don't get to outvote fresh observations
+                # run yet) don't get to outvote fresh observations; the
+                # age is peer-relative so clock skew cannot disqualify it
                 views[nid] = view
         views[self.self_id] = local
         agreed: dict[str, bool] = {}

@@ -120,7 +120,12 @@ class PromEngine:
                 result.append(
                     {"metric": labels, "value": [float(time_s), _fmt(frame.values[i, 0])]}
                 )
-        result.sort(key=lambda r: sorted(r["metric"].items()))
+        # top-level sort()/sort_desc()/sort_by_label() own the output
+        # order; everything else gets the stable by-labels order
+        if not (isinstance(expr, pp.FunctionCall)
+                and expr.name in ("sort", "sort_desc", "sort_by_label",
+                                  "sort_by_label_desc")):
+            result.sort(key=lambda r: sorted(r["metric"].items()))
         return {"resultType": "vector", "result": result}
 
     def series_labels(self, vs: "pp.VectorSelector", db: str) -> list[dict]:
@@ -330,12 +335,70 @@ class PromEngine:
                 ms_sel, steps, db,
                 lambda t, v, c, s0, s1: _instant_rate(t, v, c, s0, s1, name == "irate"),
             )
+        if name == "quantile_over_time":
+            q = _expect_number(node, 0)
+            ms_sel = _expect_matrix(node, 1)
+            return self._eval_range_fn(
+                ms_sel, steps, db,
+                lambda t, v, c, s0, s1: promops.quantile_over_time(t, v, c, s0, s1, q),
+            )
+        if name == "mad_over_time":
+            ms_sel = _expect_matrix(node, 0)
+            return self._eval_range_fn(
+                ms_sel, steps, db, promops.mad_over_time,
+            )
+        if name == "absent_over_time":
+            ms_sel = _expect_matrix(node, 0)
+            f = self._eval_range_fn(
+                ms_sel, steps, db,
+                lambda t, v, c, s0, s1: promops.over_time(t, v, c, s0, s1, "present"),
+            )
+            k = len(steps)
+            present = f.valid.any(axis=0) if len(f.labels) else np.zeros(k, bool)
+            labels = {}
+            vec = getattr(ms_sel, "vector", None)
+            if vec is not None:
+                for m in vec.matchers:
+                    if m.op == "=" and m.name != "__name__":
+                        labels[m.name] = m.value
+            return Frame([labels], np.ones((1, k)), ~present[None, :])
         if name.endswith("_over_time"):
             func = name[: -len("_over_time")]
             ms_sel = _expect_matrix(node, 0)
             return self._eval_range_fn(
                 ms_sel, steps, db,
                 lambda t, v, c, s0, s1: promops.over_time(t, v, c, s0, s1, func),
+            )
+        if name == "deriv":
+            ms_sel = _expect_matrix(node, 0)
+
+            def _deriv(t, v, c, s0, s1):
+                slope, _icept, has2 = promops.linear_regression(t, v, c, s0, s1)
+                return slope, has2
+
+            return self._eval_range_fn(ms_sel, steps, db, _deriv)
+        if name == "predict_linear":
+            ms_sel = _expect_matrix(node, 0)
+            dur = _expect_number(node, 1)
+
+            def _predict(t, v, c, s0, s1):
+                slope, icept, has2 = promops.linear_regression(t, v, c, s0, s1)
+                return icept + slope * dur, has2
+
+            return self._eval_range_fn(ms_sel, steps, db, _predict)
+        if name in ("holt_winters", "double_exponential_smoothing"):
+            ms_sel = _expect_matrix(node, 0)
+            sf = _expect_number(node, 1)
+            tf = _expect_number(node, 2)
+            if not (0 < sf < 1 and 0 < tf < 1):
+                raise PromError(
+                    "holt_winters smoothing factors must be in (0, 1)"
+                )
+            return self._eval_range_fn(
+                ms_sel, steps, db,
+                lambda t, v, c, s0, s1: promops.holt_winters_window(
+                    t, v, c, s0, s1, sf, tf
+                ),
             )
         if name == "scalar":
             f = self._eval(node.args[0], steps, db)
@@ -349,11 +412,16 @@ class PromEngine:
             f = self._eval(node.args[0], steps, db)
             f.is_scalar = False
             return f
-        # elementwise math
+        # elementwise math (prom promql/functions.go simple call table)
         elem = {
             "abs": np.abs, "ceil": np.ceil, "floor": np.floor, "exp": np.exp,
             "ln": np.log, "log2": np.log2, "log10": np.log10, "sqrt": np.sqrt,
-            "round": np.round,
+            "round": np.round, "sgn": np.sign,
+            "sin": np.sin, "cos": np.cos, "tan": np.tan,
+            "asin": np.arcsin, "acos": np.arccos, "atan": np.arctan,
+            "sinh": np.sinh, "cosh": np.cosh, "tanh": np.tanh,
+            "asinh": np.arcsinh, "acosh": np.arccosh, "atanh": np.arctanh,
+            "deg": np.degrees, "rad": np.radians,
         }
         if name in elem:
             f = self._eval(node.args[0], steps, db)
@@ -370,12 +438,126 @@ class PromEngine:
             )
             f.labels = [_drop_name(l) for l in f.labels]
             return f
+        if name == "clamp":
+            f = self._eval(node.args[0], steps, db)
+            lo = _expect_number(node, 1)
+            hi = _expect_number(node, 2)
+            if lo > hi:
+                # prom: clamp with min > max returns an empty vector
+                k = len(steps)
+                return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
+            f.values = np.clip(f.values, lo, hi)
+            f.labels = [_drop_name(l) for l in f.labels]
+            return f
         if name == "timestamp":
             f = self._eval(node.args[0], steps, db)
             f.values = np.broadcast_to(steps[None, :], f.values.shape).copy()
             f.labels = [_drop_name(l) for l in f.labels]
             return f
+        if name == "pi":
+            return Frame.scalar(math.pi, len(steps))
+        if name == "time":
+            k = len(steps)
+            return Frame([{}], steps[None, :].astype(float).copy(),
+                         np.ones((1, k), bool), True)
+        if name in _CLOCK_FNS:
+            # clock functions take an optional vector defaulting to time()
+            if node.args:
+                f = self._eval(node.args[0], steps, db)
+                f.labels = [_drop_name(l) for l in f.labels]
+            else:
+                f = Frame([{}], steps[None, :].astype(float).copy(),
+                          np.ones((1, len(steps)), bool), True)
+            f.values = _CLOCK_FNS[name](f.values)
+            return f
+        if name == "label_replace":
+            return self._label_replace(node, steps, db)
+        if name == "label_join":
+            return self._label_join(node, steps, db)
+        if name in ("sort", "sort_desc"):
+            f = self._eval(node.args[0], steps, db)
+            if len(f.labels) > 1:
+                # order by the (last) evaluated value; range queries sort
+                # by series labels at output regardless (prom ignores sort
+                # for range queries)
+                key = np.where(f.valid[:, -1], f.values[:, -1], -np.inf)
+                order = np.argsort(-key if name == "sort_desc" else key,
+                                   kind="stable")
+                f.labels = [f.labels[i] for i in order]
+                f.values = f.values[order]
+                f.valid = f.valid[order]
+            return f
+        if name in ("sort_by_label", "sort_by_label_desc"):
+            f = self._eval(node.args[0], steps, db)
+            keys = [_expect_string(node, i) for i in range(1, len(node.args))]
+            if not keys:
+                raise PromError(f"{name}() expects at least one label")
+            order = sorted(
+                range(len(f.labels)),
+                key=lambda i: tuple(f.labels[i].get(k, "") for k in keys),
+                reverse=name.endswith("_desc"),
+            )
+            f.labels = [f.labels[i] for i in order]
+            f.values = f.values[order]
+            f.valid = f.valid[order]
+            return f
         raise PromError(f"unsupported function {name!r}")
+
+    def _label_replace(self, node, steps, db) -> Frame:
+        """label_replace(v, dst, replacement, src, regex) — prom
+        funcLabelReplace: fully-anchored regex against src; on match, dst
+        is set to the expanded replacement ($1 group refs)."""
+        if len(node.args) != 5:
+            raise PromError("label_replace takes 5 arguments")
+        f = self._eval(node.args[0], steps, db)
+        dst = _expect_string(node, 1)
+        repl = _expect_string(node, 2)
+        src = _expect_string(node, 3)
+        pattern = _expect_string(node, 4)
+        if not _LABEL_NAME_RE.match(dst):
+            raise PromError(f"invalid destination label name {dst!r}")
+        try:
+            rx = re.compile("^(?:" + pattern + ")$")
+        except re.error as e:
+            raise PromError(f"invalid regex in label_replace: {e}") from None
+        out_labels = []
+        for labels in f.labels:
+            val = labels.get(src, "")
+            m = rx.match(val)
+            if m is None:
+                out_labels.append(labels)
+                continue
+            new = dict(labels)
+            expanded = _go_expand(repl, m)
+            if expanded:
+                new[dst] = expanded
+            else:
+                new.pop(dst, None)
+            out_labels.append(new)
+        f.labels = out_labels
+        return f
+
+    def _label_join(self, node, steps, db) -> Frame:
+        """label_join(v, dst, sep, src...) — prom funcLabelJoin."""
+        if len(node.args) < 3:
+            raise PromError("label_join takes at least 3 arguments")
+        f = self._eval(node.args[0], steps, db)
+        dst = _expect_string(node, 1)
+        sep = _expect_string(node, 2)
+        srcs = [_expect_string(node, i) for i in range(3, len(node.args))]
+        if not _LABEL_NAME_RE.match(dst):
+            raise PromError(f"invalid destination label name {dst!r}")
+        out_labels = []
+        for labels in f.labels:
+            joined = sep.join(labels.get(s, "") for s in srcs)
+            new = dict(labels)
+            if joined:
+                new[dst] = joined
+            else:
+                new.pop(dst, None)
+            out_labels.append(new)
+        f.labels = out_labels
+        return f
 
     # default subquery resolution when [range:] omits the step (the
     # Prometheus global evaluation interval analogue)
@@ -833,6 +1015,75 @@ def _expect_number_node(n) -> float:
     if v is None:
         raise PromError("expected a number parameter")
     return v
+
+
+def _expect_string(node, i) -> str:
+    arg = node.args[i] if i < len(node.args) else None
+    if not isinstance(arg, pp.StringLit):
+        raise PromError(f"{node.name}() expects a string argument at position {i}")
+    return arg.val
+
+
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+_GO_REF_RE = re.compile(r"\$(?:\{(\w+)\}|(\w+))")
+
+
+def _go_expand(template: str, m: re.Match) -> str:
+    """Go Regexp.Expand semantics for label_replace replacements: $1 /
+    ${name} group refs, a missing or out-of-range group expands to ""
+    (never an error), no backslash escape processing."""
+
+    def sub(ref: re.Match) -> str:
+        name = ref.group(1) or ref.group(2)
+        try:
+            got = m.group(int(name)) if name.isdigit() else m.group(name)
+        except (IndexError, re.error):
+            return ""
+        return got or ""
+
+    return _GO_REF_RE.sub(sub, template)
+
+
+def _clock_days(t: np.ndarray) -> np.ndarray:
+    safe = np.where(np.isfinite(t), t, 0.0)
+    return np.floor(safe).astype("int64").astype("datetime64[s]").astype("datetime64[D]")
+
+
+def _clock(fn):
+    def wrapped(t: np.ndarray) -> np.ndarray:
+        with np.errstate(all="ignore"):
+            return fn(t).astype(float)
+
+    return wrapped
+
+
+# prom clock functions (UTC; promql/functions.go funcHour et al.)
+_CLOCK_FNS = {
+    "minute": _clock(lambda t: np.floor(t / 60) % 60),
+    "hour": _clock(lambda t: np.floor(t / 3600) % 24),
+    "day_of_week": _clock(lambda t: (np.floor(t / 86400) + 4) % 7),
+    "day_of_month": _clock(
+        lambda t: (_clock_days(t) - _clock_days(t).astype("datetime64[M]")
+                   ).astype(int) + 1
+    ),
+    "day_of_year": _clock(
+        lambda t: (_clock_days(t) - _clock_days(t).astype("datetime64[Y]")
+                   ).astype(int) + 1
+    ),
+    "days_in_month": _clock(
+        lambda t: (
+            (_clock_days(t).astype("datetime64[M]") + 1).astype("datetime64[D]")
+            - _clock_days(t).astype("datetime64[M]").astype("datetime64[D]")
+        ).astype(int)
+    ),
+    "month": _clock(
+        lambda t: _clock_days(t).astype("datetime64[M]").astype(int) % 12 + 1
+    ),
+    "year": _clock(
+        lambda t: _clock_days(t).astype("datetime64[Y]").astype(int) + 1970
+    ),
+}
 
 
 def _fmt(v: float) -> str:

@@ -88,10 +88,18 @@ AGG_OPS = {"sum", "avg", "min", "max", "count", "topk", "bottomk", "quantile",
 FUNCTIONS = {
     "rate", "irate", "increase", "delta", "idelta", "changes", "resets",
     "avg_over_time", "min_over_time", "max_over_time", "sum_over_time",
-    "count_over_time", "last_over_time",
+    "count_over_time", "last_over_time", "stddev_over_time",
+    "stdvar_over_time", "quantile_over_time", "mad_over_time",
+    "present_over_time", "absent_over_time",
+    "deriv", "predict_linear", "holt_winters", "double_exponential_smoothing",
     "abs", "ceil", "floor", "round", "exp", "ln", "log2", "log10", "sqrt",
-    "clamp_min", "clamp_max", "scalar", "vector", "timestamp",
-    "histogram_quantile", "absent",
+    "sgn", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+    "tanh", "asinh", "acosh", "atanh", "deg", "rad", "pi",
+    "clamp", "clamp_min", "clamp_max", "scalar", "vector", "timestamp",
+    "histogram_quantile", "absent", "time", "minute", "hour",
+    "day_of_month", "day_of_week", "day_of_year", "days_in_month",
+    "month", "year", "label_replace", "label_join",
+    "sort", "sort_desc", "sort_by_label", "sort_by_label_desc",
 }
 
 _DUR = re.compile(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w|y)")

@@ -918,6 +918,10 @@ class Executor:
             only = _strip_expr(stmt.fields[0].expr)
             if isinstance(only, ast.Call) and only.name == "compare":
                 return self._select_compare(stmt, only, db, now_ns)
+            from opengemini_tpu.query import tablefunc as tfmod
+
+            if isinstance(only, ast.Call) and only.name in tfmod.TABLE_FUNCTIONS:
+                return self._select_table_function(stmt, only, db, now_ns)
         all_series = []
         for src in stmt.sources:
             if isinstance(src, ast.JoinSource):
@@ -2453,6 +2457,50 @@ class Executor:
         return out_series
 
     # -- raw path -----------------------------------------------------------
+
+    def _select_table_function(self, stmt, call, db: str, now_ns: int) -> dict:
+        """SELECT <table_function>('<params json>') FROM m WHERE time ...
+        (reference: LogicalTableFunction, logic_plan.go:3863; the one
+        production operator is rca, table_function_factory.go:26). The
+        measurement's raw rows in the time range are the function input;
+        the result is one row holding the output graph as JSON."""
+        from opengemini_tpu.query import tablefunc as tfmod
+
+        if len(call.args) != 1:
+            raise QueryError(f"{call.name}() takes one string argument")
+        arg = _strip_expr(call.args[0])
+        if not isinstance(arg, ast.StringLiteral):
+            raise QueryError(f"{call.name}() parameter must be a quoted string")
+        import dataclasses
+
+        raw_stmt = dataclasses.replace(
+            stmt, fields=[ast.Field(expr=ast.Wildcard())],
+            group_by_all_tags=True, limit=0, offset=0,
+        )
+        rows: list[dict] = []
+        for src in stmt.sources:
+            if not isinstance(src, ast.Measurement):
+                raise QueryError(f"{call.name}() requires a measurement source")
+            src_db = src.database or db
+            for series in self._select_raw(raw_stmt, src_db, src.rp or None,
+                                           src.name, now_ns):
+                tags = series.get("tags") or {}
+                cols = series["columns"]
+                for vals in series["values"]:
+                    row = dict(tags)
+                    for c, v in zip(cols, vals):
+                        if v is not None:
+                            row[c] = v
+                    rows.append(row)
+        try:
+            graph = tfmod.TABLE_FUNCTIONS[call.name](rows, arg.val)
+        except tfmod.TableFunctionError as e:
+            raise QueryError(str(e)) from None
+        name = stmt.sources[0].name if stmt.sources else call.name
+        import json as _json
+
+        return {"series": [_series(name, None, [call.name],
+                                   [[_json.dumps(graph, sort_keys=True)]])]}
 
     def _select_raw(self, stmt, db, rp, mst, now_ns) -> list[dict]:
         shards_all, _live = self._all_shards_with_remote(

@@ -281,12 +281,17 @@ def _make_handler(svc: HttpService):
                 if not token and svc.auth_enabled:
                     self._send_json(403, {"error": "cluster token required"})
                     return
+                import time as _t
+
+                ts = svc.router.health_ts
                 self._send_json(200, {
                     "id": svc.router.self_id,
                     "health": svc.router.health,
-                    # when the health was PROBED, not when it is served —
-                    # the voter discards stale views by this age
-                    "ts": svc.router.health_ts,
+                    # RELATIVE age of the probe, not a wall-clock stamp:
+                    # the voter's staleness cut must not depend on clocks
+                    # agreeing across nodes (NTP skew > the threshold
+                    # would silently disqualify a healthy peer's votes)
+                    "age_s": (_t.time() - ts) if ts else None,
                 })
             elif path == "/debug/vars":
                 import time as _t

@@ -1,0 +1,254 @@
+"""PromQL function-surface batch: *_over_time extensions, deriv /
+predict_linear / holt_winters, clock functions, label_replace/join,
+sort*, clamp, trig — audited against the reference's promql glue
+(lib/util/lifted/promql2influxql/call.go function table) and Prometheus
+semantics (promql/functions.go)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.promql.engine import PromEngine, PromError
+from opengemini_tpu.storage.engine import Engine, NS
+
+BASE = 1_700_000_000
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = Engine(str(tmp_path / "data"))
+    e.create_database("prom")
+    yield e, PromEngine(e)
+    e.close()
+
+
+def write_series(e, name, series, start=BASE, step=15):
+    lines = []
+    for inst, vals in series.items():
+        for i, v in enumerate(vals):
+            lines.append(f"{name},instance={inst} value={v} {(start + i * step) * NS}")
+    e.write_lines("prom", "\n".join(lines))
+
+
+def one_value(data):
+    if data.get("resultType") == "scalar":
+        return float(data["result"][1])
+    assert len(data["result"]) == 1, data
+    return float(data["result"][0]["value"][1])
+
+
+class TestOverTime:
+    def test_stddev_stdvar_over_time(self, env):
+        e, pe = env
+        vals = [1.0, 5.0, 2.0, 8.0, 4.0]
+        write_series(e, "m", {"a": vals})
+        t = BASE + 61
+        got = one_value(pe.query_instant("stdvar_over_time(m[2m])", t, "prom"))
+        exp_var = float(np.var(vals))  # population variance (prom)
+        assert got == pytest.approx(exp_var, rel=1e-9)
+        got = one_value(pe.query_instant("stddev_over_time(m[2m])", t, "prom"))
+        assert got == pytest.approx(math.sqrt(exp_var), rel=1e-9)
+
+    def test_quantile_over_time(self, env):
+        e, pe = env
+        vals = [1.0, 2.0, 3.0, 4.0]
+        write_series(e, "m", {"a": vals})
+        t = BASE + 61
+        got = one_value(pe.query_instant("quantile_over_time(0.5, m[2m])", t, "prom"))
+        assert got == pytest.approx(2.5)  # linear interpolation
+        got = one_value(pe.query_instant("quantile_over_time(0.25, m[2m])", t, "prom"))
+        assert got == pytest.approx(1.75)
+        # out-of-range phi maps to +/-Inf (prom behavior)
+        got = one_value(pe.query_instant("quantile_over_time(1.5, m[2m])", t, "prom"))
+        assert math.isinf(got) and got > 0
+
+    def test_mad_over_time(self, env):
+        e, pe = env
+        vals = [1.0, 2.0, 3.0, 10.0]
+        write_series(e, "m", {"a": vals})
+        t = BASE + 61
+        got = one_value(pe.query_instant("mad_over_time(m[2m])", t, "prom"))
+        med = np.median(vals)
+        assert got == pytest.approx(float(np.median(np.abs(np.array(vals) - med))))
+
+    def test_present_and_absent_over_time(self, env):
+        e, pe = env
+        write_series(e, "m", {"a": [1.0, 2.0]})
+        t = BASE + 31
+        assert one_value(pe.query_instant("present_over_time(m[1m])", t, "prom")) == 1.0
+        data = pe.query_instant("absent_over_time(m[1m])", t, "prom")
+        assert data["result"] == []  # samples present -> empty vector
+        data = pe.query_instant(
+            'absent_over_time(nosuch{job="x"}[1m])', t, "prom"
+        )
+        assert len(data["result"]) == 1
+        assert data["result"][0]["metric"] == {"job": "x"}
+
+    def test_last_over_time_still_works(self, env):
+        e, pe = env
+        write_series(e, "m", {"a": [1.0, 7.0]})
+        assert one_value(
+            pe.query_instant("last_over_time(m[1m])", BASE + 31, "prom")
+        ) == 7.0
+
+
+class TestRegression:
+    def test_deriv_exact_line(self, env):
+        e, pe = env
+        # v = 2 * t + const sampled every 15s -> slope exactly 2/s... use
+        # modest values to dodge f64 cancellation noise in the oracle sense
+        vals = [2.0 * i * 15 for i in range(9)]
+        write_series(e, "m", {"a": vals})
+        got = one_value(pe.query_instant("deriv(m[2m])", BASE + 121, "prom"))
+        assert got == pytest.approx(2.0, rel=1e-6)
+
+    def test_predict_linear(self, env):
+        e, pe = env
+        vals = [3.0 * i * 15 + 10 for i in range(9)]
+        write_series(e, "m", {"a": vals})
+        t_eval = BASE + 120
+        got = one_value(
+            pe.query_instant("predict_linear(m[2m], 60)", t_eval, "prom")
+        )
+        # value at eval time is 3*(t_eval-BASE)+10; +60s of slope 3
+        exp = 3.0 * (t_eval - BASE) + 10 + 3.0 * 60
+        assert got == pytest.approx(exp, rel=1e-6)
+
+    def test_deriv_single_sample_empty(self, env):
+        e, pe = env
+        write_series(e, "m", {"a": [5.0]})
+        data = pe.query_instant("deriv(m[1m])", BASE + 10, "prom")
+        assert data["result"] == []
+
+
+def holt_winters_oracle(vals, sf, tf):
+    """Prometheus funcDoubleExponentialSmoothing, transliterated."""
+    if len(vals) < 2:
+        return None
+    s0, s1 = 0.0, vals[0]
+    b = vals[1] - vals[0]
+    for i in range(1, len(vals)):
+        x = sf * vals[i]
+        if i - 1 == 0:
+            trend = b
+        else:
+            trend = tf * (s1 - s0) + (1 - tf) * b
+        b = trend
+        y = (1 - sf) * (s1 + b)
+        s0, s1 = s1, x + y
+    return s1
+
+
+class TestHoltWinters:
+    def test_matches_prom_recurrence(self, env):
+        e, pe = env
+        vals = [10.0, 12.0, 11.0, 15.0, 14.0, 18.0, 17.0]
+        write_series(e, "m", {"a": vals})
+        got = one_value(
+            pe.query_instant("holt_winters(m[3m], 0.5, 0.3)", BASE + 101, "prom")
+        )
+        assert got == pytest.approx(holt_winters_oracle(vals, 0.5, 0.3), rel=1e-9)
+
+    def test_bad_factors_rejected(self, env):
+        e, pe = env
+        write_series(e, "m", {"a": [1.0, 2.0]})
+        with pytest.raises(PromError):
+            pe.query_instant("holt_winters(m[1m], 1.5, 0.3)", BASE + 31, "prom")
+
+
+class TestElementwiseAndClock:
+    def test_trig_and_sgn(self, env):
+        e, pe = env
+        write_series(e, "m", {"a": [-0.5]})
+        t = BASE + 10
+        assert one_value(pe.query_instant("sgn(m)", t, "prom")) == -1.0
+        assert one_value(pe.query_instant("sin(m)", t, "prom")) == pytest.approx(
+            math.sin(-0.5)
+        )
+        assert one_value(pe.query_instant("deg(m)", t, "prom")) == pytest.approx(
+            math.degrees(-0.5)
+        )
+        assert one_value(pe.query_instant("pi()", t, "prom")) == pytest.approx(math.pi)
+
+    def test_clamp(self, env):
+        e, pe = env
+        write_series(e, "m", {"a": [5.0]})
+        t = BASE + 10
+        assert one_value(pe.query_instant("clamp(m, 1, 3)", t, "prom")) == 3.0
+        # min > max -> empty vector (prom)
+        data = pe.query_instant("clamp(m, 3, 1)", t, "prom")
+        assert data["result"] == []
+
+    def test_clock_functions(self, env):
+        import datetime as dt
+
+        e, pe = env
+        t = BASE + 10  # 2023-11-14T22:13:30Z
+        when = dt.datetime.fromtimestamp(t, dt.timezone.utc)
+        checks = {
+            "minute(time())": when.minute,
+            "hour(time())": when.hour,
+            "day_of_month(time())": when.day,
+            "day_of_week(time())": (when.weekday() + 1) % 7,
+            "day_of_year(time())": when.timetuple().tm_yday,
+            "month(time())": when.month,
+            "year(time())": when.year,
+            "days_in_month(time())": 30,  # November
+        }
+        for q, exp in checks.items():
+            got = one_value(pe.query_instant(q, t, "prom"))
+            assert got == float(exp), (q, got, exp)
+        # zero-arg form defaults to time()
+        assert one_value(pe.query_instant("hour()", t, "prom")) == float(when.hour)
+
+
+class TestLabelFns:
+    def test_label_replace(self, env):
+        e, pe = env
+        write_series(e, "m", {"web-01": [1.0]})
+        t = BASE + 10
+        data = pe.query_instant(
+            'label_replace(m, "host", "$1", "instance", "(web)-.*")', t, "prom"
+        )
+        assert data["result"][0]["metric"]["host"] == "web"
+        # no match: labels unchanged
+        data = pe.query_instant(
+            'label_replace(m, "host", "$1", "instance", "(db)-.*")', t, "prom"
+        )
+        assert "host" not in data["result"][0]["metric"]
+        with pytest.raises(PromError):
+            pe.query_instant(
+                'label_replace(m, "~bad~", "x", "instance", ".*")', t, "prom"
+            )
+
+    def test_label_join(self, env):
+        e, pe = env
+        write_series(e, "m", {"a": [1.0]})
+        t = BASE + 10
+        data = pe.query_instant(
+            'label_join(m, "combined", "-", "instance", "__name__")', t, "prom"
+        )
+        # __name__ is dropped from output labels but participates in join
+        assert data["result"][0]["metric"]["combined"] in ("a-m", "a-")
+
+
+class TestSort:
+    def test_sort_and_sort_desc(self, env):
+        e, pe = env
+        write_series(e, "m", {"a": [3.0], "b": [1.0], "c": [2.0]})
+        t = BASE + 10
+        data = pe.query_instant("sort(m)", t, "prom")
+        vals = [float(r["value"][1]) for r in data["result"]]
+        assert vals == sorted(vals)
+        data = pe.query_instant("sort_desc(m)", t, "prom")
+        vals = [float(r["value"][1]) for r in data["result"]]
+        assert vals == sorted(vals, reverse=True)
+
+    def test_sort_by_label(self, env):
+        e, pe = env
+        write_series(e, "m", {"b": [1.0], "a": [2.0], "c": [3.0]})
+        t = BASE + 10
+        data = pe.query_instant('sort_by_label_desc(m, "instance")', t, "prom")
+        insts = [r["metric"]["instance"] for r in data["result"]]
+        assert insts == ["c", "b", "a"]

@@ -1,0 +1,139 @@
+"""Table functions: the rca fault-demarcation operator (reference
+engine/executor/rca.go FaultDemarcation + table_function_factory.go),
+unit-level and through the SQL surface."""
+
+import json
+
+import pytest
+
+from opengemini_tpu.query import tablefunc as tf
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.storage.engine import Engine, NS
+
+BASE_MS = 1_700_000_000_000
+
+
+def ev(entity, etype, ann, rid="e1"):
+    return {"id": rid, "name": rid, "entity_id": entity, "type": etype,
+            "annotations": json.dumps(ann)}
+
+
+def topo(edges):
+    nodes = sorted({e[0] for e in edges} | {e[1] for e in edges})
+    return {
+        "nodes": [{"uid": n} for n in nodes],
+        "edges": [{"source": a, "target": b} for a, b in edges],
+    }
+
+
+def params(core, edges, hop=2, narrow=False):
+    return {
+        "hop_count": hop,
+        "bfs_narrow": narrow,
+        "task": {"metadata": {"core_entity_id": core}},
+        "topology": topo(edges),
+    }
+
+
+class TestFaultDemarcation:
+    def test_chain_correlated(self):
+        # core -> a -> b; a anomalous at the same time, b not correlated
+        rows = [
+            ev("core", "anomaly", {"timestamps": [BASE_MS]}),
+            ev("a", "anomaly", {"timestamps": [BASE_MS + 60_000]}),
+            ev("b", "anomaly", {"timestamps": [BASE_MS + 10 * 3600 * 1000]}),
+        ]
+        g = tf.fault_demarcation(
+            rows, params("core", [("core", "a"), ("a", "b")])
+        )
+        uids = {n["uid"] for n in g["nodes"]}
+        # core expands (anomalous): pulls a and b within 2 hops; b itself
+        # is NOT anomalous so it does not expand further — but it is in
+        # the BFS radius and thus in the graph (reference semantics)
+        assert uids == {"core", "a", "b"}
+        assert len(g["edges"]) == 2
+
+    def test_uncorrelated_neighbor_stops_expansion(self):
+        rows = [
+            ev("core", "anomaly", {"timestamps": [BASE_MS]}),
+            ev("far", "anomaly", {"timestamps": [BASE_MS + 9 * 3600 * 1000]}),
+        ]
+        # hop_count=1: core reaches a; a has no events -> never expands to far
+        g = tf.fault_demarcation(
+            rows, params("core", [("core", "a"), ("a", "far")], hop=1)
+        )
+        uids = {n["uid"] for n in g["nodes"]}
+        assert uids == {"core", "a"}
+
+    def test_alarm_window_rules(self):
+        # open-ended alarm: 2h window applies
+        rows = [
+            ev("core", "anomaly", {"timestamps": [BASE_MS]}),
+            ev("a", "alarm", {"start_time": BASE_MS + 90 * 60 * 1000}),
+        ]
+        assert tf._is_anomaly([BASE_MS], "a", tf._index_rows(rows))
+        # with an end_time the window narrows to 30min
+        rows[1] = ev("a", "alarm", {"start_time": BASE_MS + 90 * 60 * 1000,
+                                    "end_time": BASE_MS + 95 * 60 * 1000})
+        assert not tf._is_anomaly([BASE_MS], "a", tf._index_rows(rows))
+
+    def test_event_fallback_chain(self):
+        rows = [ev("a", "event", {"create_time": BASE_MS + 60 * 60 * 1000})]
+        assert tf._is_anomaly([BASE_MS], "a", tf._index_rows(rows))
+        rows = [ev("a", "event", {"end_time": BASE_MS + 60 * 60 * 1000})]
+        assert not tf._is_anomaly([BASE_MS], "a", tf._index_rows(rows))  # 30min rule
+
+    def test_bfs_narrow_shrinks_radius(self):
+        t = BASE_MS
+        rows = [
+            ev("core", "anomaly", {"timestamps": [t]}),
+            ev("a", "anomaly", {"timestamps": [t + 1000]}),
+        ]
+        edges = [("core", "a"), ("a", "b"), ("b", "c"), ("c", "d")]
+        wide = tf.fault_demarcation(rows, params("core", edges, hop=3))
+        narrow = tf.fault_demarcation(
+            rows, params("core", edges, hop=3, narrow=True)
+        )
+        assert {n["uid"] for n in narrow["nodes"]} < {
+            n["uid"] for n in wide["nodes"]
+        }
+
+    def test_missing_core_meta_rejected(self):
+        with pytest.raises(tf.TableFunctionError):
+            tf.fault_demarcation([], {"task": {}})
+        with pytest.raises(tf.TableFunctionError):
+            tf.run_rca([], "not-json{")
+
+
+class TestSQLSurface:
+    def test_select_rca(self, tmp_path):
+        eng = Engine(str(tmp_path / "d"), sync_wal=False)
+        eng.create_database("db")
+        t_ns = BASE_MS * 1_000_000
+        lines = []
+        for i, (ent, ts_off) in enumerate(
+            [("core", 0), ("svc-a", 30_000), ("svc-b", 8 * 3600 * 1000)]
+        ):
+            ann = json.dumps({"timestamps": [BASE_MS + ts_off]}).replace('"', '\\"')
+            lines.append(
+                f'events id="e{i}",name="n{i}",entity_id="{ent}",'
+                f'type="anomaly",annotations="{ann}" {t_ns + i * NS}'
+            )
+        eng.write_lines("db", "\n".join(lines))
+        ex = Executor(eng)
+        p = json.dumps({
+            "hop_count": 1,
+            "task": {"metadata": {"core_entity_id": "core"}},
+            "topology": topo([("core", "svc-a"), ("svc-a", "svc-b")]),
+        }).replace("'", "\\'")
+        res = ex.execute(
+            f"SELECT rca('{p}') FROM events WHERE time >= {t_ns - NS} "
+            f"AND time < {t_ns + 10 * NS}",
+            db="db", now_ns=t_ns + 20 * NS,
+        )
+        stmt = res["results"][0]
+        assert "error" not in stmt, stmt
+        graph = json.loads(stmt["series"][0]["values"][0][0])
+        uids = {n["uid"] for n in graph["nodes"]}
+        assert uids == {"core", "svc-a", "svc-b"}
+        eng.close()
