@@ -176,10 +176,19 @@ _TIME_FORMATS = [
 
 
 def parse_rfc3339(s: str) -> int:
+    # strptime %f caps at microseconds; peel off a 7-9 digit fraction so
+    # ns-precision literals ('...T00:00:00.000000001Z') parse exactly
+    frac_ns = 0
+    m = re.match(r"^(.*T\d\d:\d\d:\d\d)\.(\d{7,9})(Z|[+-].*)$", s)
+    if m:
+        digits = m.group(2)
+        frac_ns = int(digits.ljust(9, "0"))
+        s = m.group(1) + m.group(3)
     for fmt in _TIME_FORMATS:
         try:
             dt = _dt.datetime.strptime(s, fmt).replace(tzinfo=_dt.timezone.utc)
-            return int(dt.timestamp()) * 1_000_000_000 + dt.microsecond * 1000
+            return (int(dt.timestamp()) * 1_000_000_000 + dt.microsecond * 1000
+                    + frac_ns)
         except ValueError:
             continue
     raise ConditionError(f"bad time string {s!r}")
@@ -220,8 +229,28 @@ def eval_tag_expr(expr, index, measurement: str) -> set[int]:
             raise ConditionError(f"bad tag condition: {expr}")
         key = lhs.name
         if expr.op in ("=", "!=", "<>"):
+            if isinstance(rhs, ast.VarRef):
+                # tag-to-tag comparison (reference: `tennant = tennant`
+                # matches everything, Where_With_Tags#17); distinct tags
+                # compare per series
+                all_sids = index.series_ids(measurement)
+                if key == rhs.name:
+                    return set(all_sids) if expr.op == "=" else set()
+                out = set()
+                for sid in all_sids:
+                    tags = index.tags_of(sid)
+                    same = tags.get(key) == tags.get(rhs.name)
+                    if same == (expr.op == "="):
+                        out.add(sid)
+                return out
             if not isinstance(rhs, ast.StringLiteral):
-                raise ConditionError("tag comparison requires a string literal")
+                # tag vs non-string literal matches nothing — a typed
+                # mismatch, not a statement error (reference
+                # TagFilter#0: `where tag1=1` returns empty)
+                return (
+                    set() if expr.op == "="
+                    else set(index.series_ids(measurement))
+                )
             if expr.op == "=":
                 return index.match_eq(measurement, key, rhs.val)
             return index.match_neq(measurement, key, rhs.val)

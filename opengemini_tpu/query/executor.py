@@ -67,7 +67,8 @@ class ScanContext:
 
 
 # host calls safe on string columns (python-object values end-to-end)
-_STRING_OK_HOST = {"count", "count_distinct", "mode", "first", "last", "distinct", "elapsed"}
+_STRING_OK_HOST = {"count", "count_distinct", "mode", "first", "last",
+                   "distinct", "elapsed", "absent"}
 
 
 def pick_batch(schema, agg_names, field: str, dtype):
@@ -501,7 +502,15 @@ class Executor:
     def execute_statement(self, stmt, db: str, now_ns: int, user=None) -> dict:
         if isinstance(stmt, ast.SelectStatement):
             STATS.incr("executor", "selects")
-            return self._select(stmt, db, now_ns)
+            res = self._select(stmt, db, now_ns)
+            if not stmt.ascending and res.get("series"):
+                # ORDER BY time DESC reverses the SERIES order too
+                # (reference: Null_Aggregate desc cases expect the
+                # lexicographically-last tagset first). Applied HERE, at
+                # the statement boundary — _select recurses for
+                # subqueries/CTEs and must not double-reverse
+                res = dict(res, series=list(reversed(res["series"])))
+            return res
         if isinstance(stmt, ast.UnionStatement):
             from opengemini_tpu.query import join as joinmod
 
@@ -922,6 +931,33 @@ class Executor:
 
             if isinstance(only, ast.Call) and only.name in tfmod.TABLE_FUNCTIONS:
                 return self._select_table_function(stmt, only, db, now_ns)
+        # constant (string-literal) columns: allowed only WITH an alias
+        # and only alongside at least one variable field (reference
+        # TestServer_Query_Constant_Column; error text matches)
+        n_const = 0
+        for f in stmt.fields:
+            if isinstance(_strip_expr(f.expr), ast.StringLiteral):
+                if not f.alias:
+                    raise QueryError("field must contain at least one variable")
+                n_const += 1
+        if n_const == len(stmt.fields):
+            return {}  # only constants: empty result, no error
+        multi = self._multi_source_plan(stmt, db)
+        if multi == "rewrite":
+            # aggregates over multiple sources run on the UNION of rows
+            # (reference: count(age) FROM mst,mst1 = one combined count,
+            # TestServer_Query_MultiMeasurements) — rewrite as the same
+            # select over a raw SELECT * subquery spanning every source
+            import copy as _copy
+
+            inner = ast.SelectStatement(
+                fields=[ast.Field(expr=ast.Wildcard())],
+                sources=list(stmt.sources),
+                ctes=stmt.ctes,
+            )
+            outer = _copy.copy(stmt)
+            outer.sources = [ast.SubQuery(inner)]
+            return self._select(outer, db, now_ns, trace)
         all_series = []
         for src in stmt.sources:
             if isinstance(src, ast.JoinSource):
@@ -955,6 +991,8 @@ class Executor:
                             stmt, src_db, src.rp or None, mst, now_ns, trace
                         )
                     )
+        if multi == "merge":
+            all_series = _merge_multi_source(all_series, stmt)
         # SLIMIT/SOFFSET over series
         if stmt.soffset:
             all_series = all_series[stmt.soffset :]
@@ -966,6 +1004,50 @@ class Executor:
         if not all_series:
             return {}
         return {"series": all_series}
+
+    def _multi_source_plan(self, stmt, db: str) -> str | None:
+        """How a multi-source FROM combines (reference
+        TestServer_Query_MultiMeasurements: sources UNION into one series
+        named 'mst,mst1'):
+          - None: single effective source (or joins/CTEs — their own
+            machinery), no combining
+          - 'merge': raw projection — evaluate per source, merge output
+            series by tagset (name-joined, column-unioned, rows coalesced)
+          - 'rewrite': aggregates — re-run as agg over a raw SELECT *
+            subquery so the aggregation sees the UNION of rows
+        """
+        srcs = stmt.sources
+        if any(isinstance(s, ast.JoinSource) for s in srcs):
+            return None
+        if any(isinstance(s, ast.Measurement) and stmt.ctes
+               and s.name in stmt.ctes for s in srcs):
+            return None
+        n_effective = 0
+        for s in srcs:
+            if isinstance(s, ast.SubQuery):
+                n_effective += 1
+            elif isinstance(s, ast.Measurement):
+                if s.regex:
+                    try:
+                        n_effective += len(
+                            self._resolve_measurements(s, s.database or db)
+                        )
+                    except Exception:  # noqa: BLE001 — resolution errors surface later
+                        n_effective += 1
+                else:
+                    n_effective += 1
+        if n_effective <= 1:
+            return None
+        if _classify_select(stmt) == "raw":
+            return "merge"
+        if len(srcs) <= 1:
+            # a single regex source with aggregates keeps per-measurement
+            # series (influx semantics); only EXPLICIT multi-source
+            # aggregates union their rows
+            return None
+        # already inside the rewrite's own inner (SELECT * is raw) can't
+        # reach here; anything aggregating combines via the union rewrite
+        return "rewrite"
 
     def _select_cte(self, stmt, src: ast.Measurement, db: str, now_ns: int,
                     trace=tracing.NOOP) -> list[dict]:
@@ -1296,7 +1378,13 @@ class Executor:
             tmp_engine = _Engine(tmp, sync_wal=False)
             try:
                 tmp_engine.create_database("sub")
-                points = []
+                # points at the same (tags, time) MERGE their fields —
+                # multi-source inners legitimately emit one row per source
+                # at the same timestamp with disjoint columns, and the
+                # engine's point-level LWW would otherwise drop all but
+                # the last (TestServer_Query_MultiMeasurements#4/#5)
+                by_key: dict[tuple, dict] = {}
+                key_order: list[tuple] = []
                 for series in series_list:
                     tags = tuple(sorted(series.get("tags", {}).items()))
                     cols = series["columns"][1:]
@@ -1314,7 +1402,17 @@ class Executor:
                             else:
                                 fields[name] = (FieldType.STRING, str(v))
                         if fields:
-                            points.append((mst_name, tags, row[0], fields))
+                            pkey = (tags, row[0])
+                            got = by_key.get(pkey)
+                            if got is None:
+                                by_key[pkey] = fields
+                                key_order.append(pkey)
+                            else:
+                                got.update(fields)
+                points = [
+                    (mst_name, tags, t, by_key[(tags, t)])
+                    for tags, t in key_order
+                ]
                 if points:
                     tmp_engine.write_rows("sub", points)
                 outer = copy.copy(stmt)
@@ -1930,7 +2028,16 @@ class Executor:
                             else int(host_times[sel[seg]])
                         )
                 rows.append((t_out, vals, any_present))
-            rows = _apply_fill(rows, stmt, columns)
+            if not any(p for _t, _v, p in rows):
+                # zero matching points in the whole range: no series at
+                # all, regardless of fill (TestServer_Query_Fill#2)
+                continue
+            count_idx = tuple(
+                i for i, e in enumerate(col_exprs)
+                if isinstance(_strip_expr(e), ast.Call)
+                and _strip_expr(e).name in ("count", "count_distinct")
+            )
+            rows = _apply_fill(rows, stmt, columns, count_idx)
             if not stmt.ascending:
                 rows.reverse()
             if stmt.offset:
@@ -2343,13 +2450,20 @@ class Executor:
             if len(plans) == 1 and plans[0][1] == "transform_raw":
                 name, _kind, call_name, fname, params, _inner = plans[0]
                 t, v = field_rows(fname)
-                t_out, v_out = fnmod.transform(call_name, t, v, params)
+                if not stmt.ascending:
+                    # ORDER BY time DESC: the transform runs over the
+                    # DESC-ordered sequence (reference Null_Aggregate desc
+                    # difference cases — sign and row times follow the
+                    # reversed walk, not a reversed asc result)
+                    t_out, v_out = fnmod.transform(
+                        call_name, t[::-1], v[::-1], params
+                    )
+                else:
+                    t_out, v_out = fnmod.transform(call_name, t, v, params)
                 rows = [
                     (int(tt), [fnmod.py_value(vv)], True)
                     for tt, vv in zip(t_out, v_out)
                 ]
-                if not stmt.ascending:
-                    rows.reverse()
                 if stmt.offset:
                     rows = rows[stmt.offset :]
                 if stmt.limit:
@@ -2527,12 +2641,18 @@ class Executor:
             else set(stmt.group_by_tags)
         )
         names: list[tuple[str, str]] = []  # (output name, source ref)
+        const_cols: dict[str, str] = {}  # output name -> literal value
         for f in stmt.fields:
             e = _strip_expr(f.expr)
             if isinstance(e, ast.Wildcard):
                 names.extend(
                     (n, n) for n in sorted(set(schema) | (tag_keys - grouped_tags))
                 )
+            elif isinstance(e, ast.StringLiteral):
+                # constant column (validated to carry an alias upstream)
+                out_name = f.alias or _default_field_name(f.expr)
+                const_cols[out_name] = e.val
+                names.append((out_name, ""))
             else:
                 src_name = e.name if isinstance(e, ast.VarRef) else ""
                 names.append(
@@ -2586,6 +2706,9 @@ class Executor:
                 present = np.zeros(len(rec), dtype=bool)
                 col_arrays = []
                 for name in columns[1:]:
+                    if name in const_cols:
+                        col_arrays.append((None, None, const_cols[name]))
+                        continue
                     ref = src_of[name]
                     col = rec.columns.get(ref)
                     if col is not None:
@@ -2612,14 +2735,31 @@ class Executor:
             if not rows:
                 continue
             rows.sort(key=lambda r: r[0], reverse=not stmt.ascending)
-            if stmt.offset:
-                rows = rows[stmt.offset :]
-            if stmt.limit:
-                rows = rows[: stmt.limit]
             series = {"name": mst, "columns": columns, "values": rows}
             if group_tags:
                 series["tags"] = dict(zip(group_tags, key))
             out_series.append(series)
+        if stmt.offset or stmt.limit:
+            # LIMIT/OFFSET apply GLOBALLY over the time-merged row stream,
+            # not per series (reference TestServer_Query_LimitAndOffset:
+            # `group by tennant limit 1` returns one row total); series
+            # left empty by the slice are omitted entirely
+            flat = []
+            for si, s in enumerate(out_series):
+                flat.extend((row[0], si, row) for row in s["values"])
+            flat.sort(key=lambda e: (e[0], e[1]), reverse=not stmt.ascending)
+            if stmt.offset:
+                flat = flat[stmt.offset:]
+            if stmt.limit:
+                flat = flat[: stmt.limit]
+            kept: dict[int, list] = {}
+            for _t, si, row in flat:
+                kept.setdefault(si, []).append(row)
+            out_series = [
+                dict(s, values=kept[si])
+                for si, s in enumerate(out_series)
+                if si in kept
+            ]
         return out_series
 
     # -- SHOW ---------------------------------------------------------------
@@ -2881,6 +3021,51 @@ def _add_record_to_batches(rec, seg, aligned, needed_fields, batches, dtype, fma
         batches[fname].add(vals, rel, seg, m, rec.times)
 
 
+def _merge_multi_source(all_series: list[dict], stmt) -> list[dict]:
+    """Union the per-source output series of a multi-source raw SELECT
+    into combined series per tagset: name = sorted comma-join of source
+    names, columns = union (sorted when the projection used a wildcard),
+    rows time-ordered. Rows stay distinct even at equal timestamps —
+    each source's row keeps its identity (Constant_Column#0); aggregate
+    statements union rows upstream via the subquery rewrite instead
+    (reference TestServer_Query_MultiMeasurements)."""
+    wildcard = any(
+        isinstance(_strip_expr(f.expr), ast.Wildcard) for f in stmt.fields
+    )
+    groups: dict[tuple, dict] = {}
+    order: list[tuple] = []
+    for s in all_series:
+        key = tuple(sorted((s.get("tags") or {}).items()))
+        g = groups.get(key)
+        if g is None:
+            groups[key] = g = {"names": set(), "columns": ["time"],
+                               "rows": [], "tags": s.get("tags")}
+            order.append(key)
+        g["names"].add(s["name"])
+        cols = s["columns"]
+        for c in cols[1:]:
+            if c not in g["columns"]:
+                g["columns"].append(c)
+        for row in s["values"]:
+            g["rows"].append((row[0], dict(zip(cols[1:], row[1:]))))
+    out = []
+    for key in order:
+        g = groups[key]
+        if wildcard:
+            g["columns"] = ["time"] + sorted(g["columns"][1:])
+        g["rows"].sort(key=lambda r: r[0], reverse=not stmt.ascending)
+        merged = g["rows"]
+        name = ",".join(sorted(g["names"]))
+        values = [
+            [t] + [cv.get(c) for c in g["columns"][1:]] for t, cv in merged
+        ]
+        series = {"name": name, "columns": g["columns"], "values": values}
+        if g["tags"]:
+            series["tags"] = g["tags"]
+        out.append(series)
+    return out
+
+
 def _inner_source_name(stmt, _depth: int = 0) -> str:
     """Influx keeps the innermost measurement name for subquery output
     (CTE references resolve to their body's innermost source; a union
@@ -2894,14 +3079,23 @@ def _inner_source_name(stmt, _depth: int = 0) -> str:
             if n != "subquery":
                 parts.update(n.split(","))
         return ",".join(sorted(parts)) if parts else "subquery"
+    # multiple sources name the output after the sorted union of their
+    # innermost names (reference: "mst,mst1" in TestServer_Query_
+    # MultiMeasurements)
+    parts2: set[str] = set()
     for src in stmt.sources:
         if isinstance(src, ast.SubQuery):
-            return _inner_source_name(src.stmt, _depth + 1)
-        if isinstance(src, ast.Measurement) and src.name:
+            n = _inner_source_name(src.stmt, _depth + 1)
+        elif isinstance(src, ast.Measurement) and src.name:
             if stmt.ctes and src.name in stmt.ctes:
-                return _inner_source_name(stmt.ctes[src.name], _depth + 1)
-            return src.name
-    return "subquery"
+                n = _inner_source_name(stmt.ctes[src.name], _depth + 1)
+            else:
+                n = src.name
+        else:
+            continue
+        if n != "subquery":
+            parts2.update(n.split(","))
+    return ",".join(sorted(parts2)) if parts2 else "subquery"
 
 
 def _series(name, tags, columns, values):
@@ -2949,6 +3143,7 @@ _NUMERIC_ONLY_WILDCARD = {
     "non_negative_derivative", "moving_average", "cumulative_sum", "sum",
     "mean", "median", "stddev", "spread", "percentile", "integral",
     "max", "min", "top", "bottom", "sample",
+    "rate", "irate", "regr_slope",
 }
 
 
@@ -3430,14 +3625,24 @@ def _eval_output_expr(expr, agg_results, seg, schema):
     raise QueryError(f"unsupported output expression: {expr}")
 
 
-def _apply_fill(rows, stmt, columns):
+def _apply_fill(rows, stmt, columns, count_idx: tuple = ()):
     """rows: [(t, vals, any_present)] per window, ascending. Influx fill
-    semantics (reference: engine/executor fill_transform.go)."""
+    semantics (reference: engine/executor fill_transform.go). count_idx:
+    value indices holding bare count()/count(distinct) results — under
+    the default null fill those render 0 for empty windows
+    (TestServer_Query_Fill#6)."""
     fill = stmt.fill_option
     if not stmt.group_by_time:
         return [(t, v, p) for t, v, p in rows if p]
     if fill == "none":
         return [(t, v, p) for t, v, p in rows if p]
+    if fill == "null" and count_idx:
+        out = []
+        for t, vals, p in rows:
+            vals = [0 if (i in count_idx and v is None) else v
+                    for i, v in enumerate(vals)]
+            out.append((t, vals, p))
+        rows = out
     if fill == "number":
         out = []
         for t, vals, p in rows:

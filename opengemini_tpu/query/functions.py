@@ -46,7 +46,7 @@ TRANSFORMS = {
 # host aggregators: one value per (group, window)
 HOST_AGGS = {"mode", "integral", "sum", "count", "mean", "min", "max",
              "first", "last", "spread", "stddev", "median", "percentile",
-             "count_distinct"}
+             "count_distinct", "rate", "irate", "absent", "regr_slope"}
 
 # multi-row selectors: several output rows per group
 MULTI_ROW = {"top", "bottom", "sample", "distinct", "detect"}
@@ -152,6 +152,31 @@ def host_agg(name: str, times: np.ndarray, values: np.ndarray, params: tuple):
         dt = np.diff(times) / unit_ns
         areas = (values[1:] + values[:-1]) / 2 * dt
         return float(areas.sum()), None
+    if name == "rate":
+        # (last - first) / elapsed-seconds (openGemini InfluxQL rate,
+        # TestServer_Query_Null_Aggregate#22)
+        if len(values) < 2 or times[-1] == times[0]:
+            return None, None
+        dt_s = (int(times[-1]) - int(times[0])) / NS
+        return float((values[-1] - values[0]) / dt_s), None
+    if name == "irate":
+        # slope of the LAST sample pair (Null_Aggregate#23)
+        if len(values) < 2 or times[-1] == times[-2]:
+            return None, None
+        dt_s = (int(times[-1]) - int(times[-2])) / NS
+        return float((values[-1] - values[-2]) / dt_s), None
+    if name == "absent":
+        return 1, None  # any data in range -> 1 (Null_Aggregate#24)
+    if name == "regr_slope":
+        # least-squares slope against the SAMPLE ORDINAL, not wall time
+        # (verified against Null_Aggregate#32: gaps in the time axis do
+        # not stretch the x spacing)
+        if len(values) < 2:
+            return None, None
+        x = np.arange(len(values), dtype=np.float64)
+        v = values.astype(np.float64)
+        xc = x - x.mean()
+        return float((xc * (v - v.mean())).sum() / (xc * xc).sum()), None
     raise ValueError(f"unsupported host aggregate {name!r}")
 
 

@@ -144,12 +144,16 @@ class Parser:
             return True
         return False
 
-    def _ident(self) -> str:
+    def _ident(self, allow_string: bool = False) -> str:
         tok = self.lex.next()
         if tok.kind == "IDENT":
             return tok.val
         # unreserved keywords usable as identifiers
         if tok.kind == "KEYWORD":
+            return tok.val
+        if allow_string and tok.kind == "STRING":
+            # openGemini allows single-quoted aliases: AS 'name'
+            # (TestServer_Query_Constant_Column)
             return tok.val
         raise ParseError(f"expected identifier, got {tok.val!r}")
 
@@ -328,7 +332,7 @@ class Parser:
             expr = self._parse_expr()
             alias = ""
             if self._accept_kw("as"):
-                alias = self._ident()
+                alias = self._ident(allow_string=True)
             fields.append(ast.Field(expr, alias))
             if not self._accept_op(","):
                 break
