@@ -1,0 +1,611 @@
+// Batch InfluxDB line-protocol parser -> columnar arrays.
+//
+// Role of the reference's pooled VM protoparser
+// (lib/util/lifted/vm/protoparser/influx/parser.go, scheduled from
+// lib/util/lifted/influx/httpd/handler.go:1633): turn a raw /write body
+// into typed columns at millions of rows/s so the ingest path is never
+// parser-bound. Design differs from the reference (which emits per-row
+// structs consumed by Go loops): here the OUTPUT is already columnar —
+// one int64 value slot + validity byte per (column, row), a deduplicated
+// canonical-series table, and arena-backed strings — so the Python side
+// appends whole numpy slabs to the memtable without touching rows.
+//
+// Fast-path contract (checked, not assumed): any backslash escape or a
+// quote before the field section flips status=NEEDS_PYTHON and the caller
+// re-parses the batch with the exact Python parser. Everything else —
+// quoted strings, int/uint/bool/float literals, multi-space separators,
+// comment lines, out-of-range checks, '=' inside tag values — matches
+// ingest/line_protocol.py semantics exactly (equivalence-tested in
+// tests/test_native_lp.py).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cstdio>
+#include <cmath>
+#include <string>
+#include <string_view>
+#include <vector>
+#include <deque>
+#include <unordered_map>
+#include <algorithm>
+
+extern "C" {
+
+typedef struct {
+  int64_t n_rows;
+  int64_t* ts;           // n_rows
+  int32_t* series_ref;   // n_rows -> index into series table
+  int64_t n_series;
+  int64_t* skey_off;     // n_series+1 offsets into skey_arena (canonical keys)
+  char* skey_arena;
+  int32_t* series_mst;   // n_series -> measurement index
+  int32_t n_msts;
+  int64_t* mst_off;      // n_msts+1 offsets into mst_arena
+  char* mst_arena;
+  int32_t n_cols;
+  int64_t* col_name_off; // n_cols+1 offsets into col_name_arena
+  char* col_name_arena;
+  int32_t* col_mst;      // n_cols -> measurement index
+  int8_t* col_type;      // 1 float 2 int 3 bool 4 string
+  int64_t** col_vals;    // n_cols arrays of n_rows slots (f64 bits / i64 /
+                         // bool / (len | str_off<<32))
+  uint8_t** col_valid;   // n_cols arrays of n_rows validity bytes
+  char* str_arena;
+  int64_t str_arena_len;
+  int32_t status;        // 0 ok, 1 needs python parser, 2 parse error
+  int64_t err_line;
+  char err_msg[240];
+} LpBatch;
+
+LpBatch* ogt_lp_parse(const char* data, int64_t len, int64_t mult,
+                      int64_t now_ns, int64_t max_bytes);
+void ogt_lp_free(LpBatch* b);
+
+}  // extern "C"
+
+namespace {
+
+constexpr int32_t ST_OK = 0, ST_PY = 1, ST_ERR = 2;
+constexpr int8_t T_FLOAT = 1, T_INT = 2, T_BOOL = 3, T_STRING = 4;
+
+struct Sv {
+  const char* p;
+  size_t n;
+  std::string_view view() const { return {p, n}; }
+};
+
+struct Parser {
+  const char* data;
+  int64_t len;
+  int64_t mult;
+  int64_t now_ns;
+  int64_t max_bytes;
+  int64_t n_lines_cap;  // newline count upper bound for column allocation
+
+  std::vector<int64_t> ts;
+  std::vector<int32_t> series_ref;
+
+  // measurement table
+  std::unordered_map<std::string_view, int32_t> mst_map;
+  std::string mst_arena;
+  std::vector<int64_t> mst_off{0};
+
+  // series: raw key-section cache (views into input) -> series idx, plus
+  // the authoritative canonical-key map (views into skey_store)
+  std::unordered_map<std::string_view, int32_t> raw_series;
+  std::unordered_map<std::string_view, int32_t> canon_series;
+  std::deque<std::string> skey_store;
+  std::vector<int32_t> series_mst;
+
+  // columns keyed by (mst_id, name)
+  struct ColKey {
+    int32_t mst;
+    std::string_view name;
+    bool operator==(const ColKey& o) const {
+      return mst == o.mst && name == o.name;
+    }
+  };
+  struct ColKeyHash {
+    size_t operator()(const ColKey& k) const {
+      return std::hash<std::string_view>()(k.name) ^ (size_t(k.mst) * 0x9e3779b97f4a7c15ULL);
+    }
+  };
+  std::unordered_map<ColKey, int32_t, ColKeyHash> col_map;
+  std::string col_name_arena;
+  std::vector<int64_t> col_name_off{0};
+  std::vector<int32_t> col_mst;
+  std::vector<int8_t> col_type;
+  std::vector<int64_t*> col_vals;
+  std::vector<uint8_t*> col_valid;
+  int64_t col_bytes = 0;
+
+  std::string str_arena;
+  std::string key_buf;  // scratch for canonical key construction
+
+  int32_t status = ST_OK;
+  int64_t err_line = 0;
+  std::string err_msg;
+
+  ~Parser() {
+    for (auto* p : col_vals) free(p);
+    for (auto* p : col_valid) free(p);
+  }
+
+  bool fail(int64_t lineno, const std::string& msg) {
+    status = ST_ERR;
+    err_line = lineno;
+    err_msg = msg;
+    return false;
+  }
+  bool need_python() {
+    status = ST_PY;
+    return false;
+  }
+
+  int32_t intern_mst(std::string_view m) {
+    auto it = mst_map.find(m);
+    if (it != mst_map.end()) return it->second;
+    int32_t id = (int32_t)mst_off.size() - 1;
+    mst_arena.append(m);
+    mst_off.push_back((int64_t)mst_arena.size());
+    // map keys need stable addresses across arena growth: copy into the
+    // deque (deque never relocates existing elements)
+    skey_store.emplace_back(m);
+    mst_map.emplace(std::string_view(skey_store.back()), id);
+    return id;
+  }
+
+  int32_t intern_col(int32_t mst, std::string_view name, int8_t type,
+                     int64_t lineno, bool* fresh, bool* type_ok) {
+    auto it = col_map.find(ColKey{mst, name});
+    if (it != col_map.end()) {
+      int32_t id = it->second;
+      *fresh = false;
+      *type_ok = (col_type[id] == type);
+      return id;
+    }
+    int64_t need = col_bytes + n_lines_cap * 9;
+    if (need > max_bytes || (int64_t)col_vals.size() >= 4096) {
+      // batch too wide for the dense layout: let Python handle it
+      need_python();
+      return -1;
+    }
+    col_bytes = need;
+    int32_t id = (int32_t)col_vals.size();
+    col_name_arena.append(name);
+    col_name_off.push_back((int64_t)col_name_arena.size());
+    col_mst.push_back(mst);
+    col_type.push_back(type);
+    // calloc BOTH: invalid slots' value bytes flow into memtable slabs,
+    // flushed chunks and content_digest — heap garbage there breaks the
+    // replica-identical digest guarantee (and bool columns would read
+    // random True at invalid rows)
+    col_vals.push_back((int64_t*)calloc(n_lines_cap, sizeof(int64_t)));
+    col_valid.push_back((uint8_t*)calloc(n_lines_cap, 1));
+    skey_store.emplace_back(name);
+    col_map.emplace(ColKey{mst, std::string_view(skey_store.back())}, id);
+    *fresh = true;
+    *type_ok = true;
+    return id;
+  }
+};
+
+// append component to out, escaping the canonical-series-key specials
+// (ingest/line_protocol.py _esc_key). On the no-backslash fast path only
+// '=' inside a tag value is actually reachable; the full set keeps the
+// key byte-identical with Python's series_key() regardless.
+void esc_append(std::string& out, std::string_view s) {
+  for (char c : s) {
+    if (c == '\\' || c == ',' || c == '=' || c == ' ') out.push_back('\\');
+    out.push_back(c);
+  }
+}
+
+struct TagRef {
+  std::string_view k, v;
+};
+
+bool parse_float_token(const char* p, size_t n, double* out) {
+  // fast path: [-]digits up to 15 digits — exact in double (< 2^53), so
+  // identical to Python's correctly-rounded float(). Decimals go through
+  // strtod (also correctly rounded); a hand-rolled ip + fp/10^k would
+  // double-round and diverge from float() by 1 ULP on ~0.4% of tokens.
+  size_t i = 0;
+  bool neg = false;
+  if (i < n && (p[i] == '-' || p[i] == '+')) {
+    neg = p[i] == '-';
+    i++;
+  }
+  uint64_t ip = 0;
+  size_t di = i;
+  while (i < n && p[i] >= '0' && p[i] <= '9' && i - di < 15) ip = ip * 10 + (p[i++] - '0');
+  if (i == n && i > di) {
+    *out = neg ? -(double)ip : (double)ip;
+    return true;
+  }
+  // general: strtod needs NUL termination; token is bounded so copy.
+  // strtod accepts hex floats ("0x10") that Python float() rejects —
+  // screen them out so both parsers agree on what is an error.
+  char buf[64];
+  if (n == 0 || n >= sizeof(buf)) return false;
+  if (memchr(p, 'x', n) || memchr(p, 'X', n)) return false;
+  memcpy(buf, p, n);
+  buf[n] = 0;
+  char* end = nullptr;
+  double v = strtod(buf, &end);
+  if (end != buf + n) return false;
+  *out = v;
+  return true;
+}
+
+LpBatch* finish(Parser& P) {
+  auto* b = (LpBatch*)calloc(1, sizeof(LpBatch));
+  b->status = P.status;
+  b->err_line = P.err_line;
+  snprintf(b->err_msg, sizeof(b->err_msg), "%s", P.err_msg.c_str());
+  if (P.status != ST_OK) return b;
+
+  b->n_rows = (int64_t)P.ts.size();
+  b->ts = (int64_t*)malloc(sizeof(int64_t) * std::max<size_t>(1, P.ts.size()));
+  memcpy(b->ts, P.ts.data(), sizeof(int64_t) * P.ts.size());
+  b->series_ref = (int32_t*)malloc(sizeof(int32_t) * std::max<size_t>(1, P.series_ref.size()));
+  memcpy(b->series_ref, P.series_ref.data(), sizeof(int32_t) * P.series_ref.size());
+
+  b->n_series = (int64_t)P.series_mst.size();
+  // canonical keys sit in canon_series (views into skey_store); rebuild
+  // in index order
+  {
+    std::vector<std::string_view> keys(P.canon_series.size());
+    for (auto& kv : P.canon_series) keys[kv.second] = kv.first;
+    std::string arena;
+    std::vector<int64_t> off{0};
+    for (auto& k : keys) {
+      arena.append(k);
+      off.push_back((int64_t)arena.size());
+    }
+    b->skey_arena = (char*)malloc(std::max<size_t>(1, arena.size()));
+    memcpy(b->skey_arena, arena.data(), arena.size());
+    b->skey_off = (int64_t*)malloc(sizeof(int64_t) * off.size());
+    memcpy(b->skey_off, off.data(), sizeof(int64_t) * off.size());
+  }
+  b->series_mst = (int32_t*)malloc(sizeof(int32_t) * std::max<size_t>(1, P.series_mst.size()));
+  memcpy(b->series_mst, P.series_mst.data(), sizeof(int32_t) * P.series_mst.size());
+
+  b->n_msts = (int32_t)(P.mst_off.size() - 1);
+  b->mst_arena = (char*)malloc(std::max<size_t>(1, P.mst_arena.size()));
+  memcpy(b->mst_arena, P.mst_arena.data(), P.mst_arena.size());
+  b->mst_off = (int64_t*)malloc(sizeof(int64_t) * P.mst_off.size());
+  memcpy(b->mst_off, P.mst_off.data(), sizeof(int64_t) * P.mst_off.size());
+
+  b->n_cols = (int32_t)P.col_vals.size();
+  b->col_name_arena = (char*)malloc(std::max<size_t>(1, P.col_name_arena.size()));
+  memcpy(b->col_name_arena, P.col_name_arena.data(), P.col_name_arena.size());
+  b->col_name_off = (int64_t*)malloc(sizeof(int64_t) * P.col_name_off.size());
+  memcpy(b->col_name_off, P.col_name_off.data(), sizeof(int64_t) * P.col_name_off.size());
+  b->col_mst = (int32_t*)malloc(sizeof(int32_t) * std::max<size_t>(1, P.col_mst.size()));
+  memcpy(b->col_mst, P.col_mst.data(), sizeof(int32_t) * P.col_mst.size());
+  b->col_type = (int8_t*)malloc(std::max<size_t>(1, P.col_type.size()));
+  memcpy(b->col_type, P.col_type.data(), P.col_type.size());
+  b->col_vals = (int64_t**)malloc(sizeof(void*) * std::max<size_t>(1, P.col_vals.size()));
+  b->col_valid = (uint8_t**)malloc(sizeof(void*) * std::max<size_t>(1, P.col_valid.size()));
+  for (size_t i = 0; i < P.col_vals.size(); i++) {
+    b->col_vals[i] = P.col_vals[i];
+    b->col_valid[i] = P.col_valid[i];
+  }
+  P.col_vals.clear();  // ownership moved; Parser dtor must not free
+  P.col_valid.clear();
+
+  b->str_arena_len = (int64_t)P.str_arena.size();
+  b->str_arena = (char*)malloc(std::max<size_t>(1, P.str_arena.size()));
+  memcpy(b->str_arena, P.str_arena.data(), P.str_arena.size());
+  return b;
+}
+
+}  // namespace
+
+extern "C" LpBatch* ogt_lp_parse(const char* data, int64_t len, int64_t mult,
+                                 int64_t now_ns, int64_t max_bytes) {
+  Parser P;
+  P.data = data;
+  P.len = len;
+  P.mult = mult;
+  P.now_ns = now_ns;
+  P.max_bytes = max_bytes > 0 ? max_bytes : (int64_t)512 << 20;
+
+  // newline count bounds rows: column arrays allocate once at this size
+  int64_t nl = 1;
+  for (const char* p = data; (p = (const char*)memchr(p, '\n', data + len - p)); p++) nl++;
+  P.n_lines_cap = nl;
+  P.ts.reserve(nl);
+  P.series_ref.reserve(nl);
+
+  std::vector<TagRef> tags;
+  int64_t lineno = 0;
+  const char* p = data;
+  const char* end = data + len;
+
+  while (p < end) {
+    const char* eol = (const char*)memchr(p, '\n', end - p);
+    const char* le = eol ? eol : end;
+    lineno++;
+    const char* ls = p;
+    p = eol ? eol + 1 : end;
+    // strip '\r' and ' '
+    while (ls < le && (*ls == ' ' || *ls == '\r')) ls++;
+    while (le > ls && (le[-1] == ' ' || le[-1] == '\r')) le--;
+    if (ls == le || *ls == '#') continue;
+
+    // escapes (and quotes outside the field section) -> exact Python parser
+    if (memchr(ls, '\\', le - ls)) {
+      finish_py:
+      P.need_python();
+      return finish(P);
+    }
+
+    // sections split on spaces (runs of spaces collapse, matching the
+    // Python parser's non-escaped branch)
+    const char* sp1 = (const char*)memchr(ls, ' ', le - ls);
+    if (!sp1) {
+      P.fail(lineno, "expected: key fields [timestamp]");
+      return finish(P);
+    }
+    Sv key_part{ls, (size_t)(sp1 - ls)};
+    if (memchr(key_part.p, '"', key_part.n)) goto finish_py;
+    const char* fs = sp1;
+    while (fs < le && *fs == ' ') fs++;
+    // fields section ends at the first space OUTSIDE quotes
+    const char* fe = fs;
+    bool inq = false;
+    while (fe < le && (inq || *fe != ' ')) {
+      if (*fe == '"') inq = !inq;
+      fe++;
+    }
+    if (inq) {
+      P.fail(lineno, "unterminated string value");
+      return finish(P);
+    }
+    Sv fields_part{fs, (size_t)(fe - fs)};
+    const char* tp = fe;
+    while (tp < le && *tp == ' ') tp++;
+    const char* te = tp;
+    while (te < le && *te != ' ') te++;
+    Sv ts_part{tp, (size_t)(te - tp)};
+    const char* rest = te;
+    while (rest < le && *rest == ' ') rest++;
+    if (rest != le) {
+      P.fail(lineno, "expected: key fields [timestamp]");
+      return finish(P);
+    }
+    if (fields_part.n == 0) {
+      P.fail(lineno, "expected: key fields [timestamp]");
+      return finish(P);
+    }
+
+    // series: raw-section cache first (repeat tag-sets skip the sort)
+    int32_t sref;
+    auto rit = P.raw_series.find(key_part.view());
+    if (rit != P.raw_series.end()) {
+      sref = rit->second;
+    } else {
+      // measurement , tags
+      const char* c = (const char*)memchr(key_part.p, ',', key_part.n);
+      std::string_view mst{key_part.p,
+                           c ? (size_t)(c - key_part.p) : key_part.n};
+      if (mst.empty()) {
+        P.fail(lineno, "missing measurement");
+        return finish(P);
+      }
+      tags.clear();
+      if (c) {
+        const char* q = c + 1;
+        const char* kend = key_part.p + key_part.n;
+        while (q <= kend) {
+          const char* nc = (const char*)memchr(q, ',', kend - q);
+          const char* seg_end = nc ? nc : kend;
+          const char* eq = (const char*)memchr(q, '=', seg_end - q);
+          if (!eq || eq == q) {
+            P.fail(lineno, "bad tag");
+            return finish(P);
+          }
+          std::string_view tk{q, (size_t)(eq - q)};
+          std::string_view tv{eq + 1, (size_t)(seg_end - eq - 1)};
+          if (!tv.empty()) tags.push_back({tk, tv});  // empty values drop
+          if (!nc) break;
+          q = nc + 1;
+        }
+      }
+      std::stable_sort(tags.begin(), tags.end(),
+                       [](const TagRef& a, const TagRef& b) {
+                         return a.k < b.k || (a.k == b.k && a.v < b.v);
+                       });
+      P.key_buf.clear();
+      esc_append(P.key_buf, mst);
+      for (auto& t : tags) {
+        P.key_buf.push_back(',');
+        esc_append(P.key_buf, t.k);
+        P.key_buf.push_back('=');
+        esc_append(P.key_buf, t.v);
+      }
+      auto cit = P.canon_series.find(std::string_view(P.key_buf));
+      if (cit != P.canon_series.end()) {
+        sref = cit->second;
+      } else {
+        sref = (int32_t)P.series_mst.size();
+        P.skey_store.emplace_back(P.key_buf);
+        P.canon_series.emplace(std::string_view(P.skey_store.back()), sref);
+        P.series_mst.push_back(P.intern_mst(mst));
+      }
+      // cache the raw section (view into input, alive for the whole parse)
+      P.raw_series.emplace(key_part.view(), sref);
+    }
+    int32_t mst_id = P.series_mst[sref];
+
+    // fields
+    int64_t row = (int64_t)P.ts.size();
+    const char* q = fields_part.p;
+    const char* qend = fields_part.p + fields_part.n;
+    bool any_field = false;
+    while (q < qend) {
+      // segment ends at ',' outside quotes
+      const char* seg_end = q;
+      bool sq = false;
+      while (seg_end < qend && (sq || *seg_end != ',')) {
+        if (*seg_end == '"') sq = !sq;
+        seg_end++;
+      }
+      // name = value ('=' outside quotes)
+      const char* eq = q;
+      while (eq < seg_end && *eq != '=' && *eq != '"') eq++;
+      if (eq >= seg_end || *eq != '=' || eq == q) {
+        P.fail(lineno, "bad field");
+        return finish(P);
+      }
+      std::string_view name{q, (size_t)(eq - q)};
+      const char* v = eq + 1;
+      size_t vn = (size_t)(seg_end - v);
+      if (vn == 0) {
+        P.fail(lineno, std::string("missing value for field '") + std::string(name) + "'");
+        return finish(P);
+      }
+      int8_t vtype;
+      int64_t slot = 0;
+      // Python's int()/float() accept '_' digit separators; C parsing
+      // does not — route those batches to the exact Python parser
+      if (*v != '"' && memchr(v, '_', vn)) goto finish_py;
+      if (*v == '"') {
+        if (vn < 2 || v[vn - 1] != '"') {
+          P.fail(lineno, "bad string value");
+          return finish(P);
+        }
+        vtype = T_STRING;
+        int64_t off = (int64_t)P.str_arena.size();
+        P.str_arena.append(v + 1, vn - 2);
+        slot = (off << 32) | (int64_t)(vn - 2);
+      } else if (v[vn - 1] == 'i' || v[vn - 1] == 'u') {
+        char buf[32];
+        if (vn - 1 == 0 || vn - 1 >= sizeof(buf)) {
+          P.fail(lineno, "bad integer value");
+          return finish(P);
+        }
+        memcpy(buf, v, vn - 1);
+        buf[vn - 1] = 0;
+        errno = 0;
+        char* pe = nullptr;
+        long long iv = strtoll(buf, &pe, 10);
+        if (pe != buf + (vn - 1) || errno == ERANGE) {
+          // Python distinguishes bad literal vs out-of-range; both 400
+          P.fail(lineno, errno == ERANGE ? "integer out of int64 range"
+                                         : "bad integer value");
+          return finish(P);
+        }
+        vtype = T_INT;
+        slot = (int64_t)iv;
+      } else if (vn <= 5 && (*v == 't' || *v == 'T' || *v == 'f' || *v == 'F')) {
+        std::string_view sv{v, vn};
+        if (sv == "t" || sv == "T" || sv == "true" || sv == "True" || sv == "TRUE") {
+          vtype = T_BOOL;
+          slot = 1;
+        } else if (sv == "f" || sv == "F" || sv == "false" || sv == "False" ||
+                   sv == "FALSE") {
+          vtype = T_BOOL;
+          slot = 0;
+        } else {
+          double d;
+          if (!parse_float_token(v, vn, &d)) {
+            P.fail(lineno, "bad value");
+            return finish(P);
+          }
+          vtype = T_FLOAT;
+          memcpy(&slot, &d, 8);
+        }
+      } else {
+        double d;
+        if (!parse_float_token(v, vn, &d)) {
+          P.fail(lineno, "bad value");
+          return finish(P);
+        }
+        vtype = T_FLOAT;
+        memcpy(&slot, &d, 8);
+      }
+      bool fresh, type_ok;
+      int32_t col = P.intern_col(mst_id, name, vtype, lineno, &fresh, &type_ok);
+      if (col < 0) return finish(P);  // too wide -> python
+      if (!type_ok) {
+        // same batch, same measurement+field, two types: the Python path
+        // resolves this via FieldTypeConflict at write time; divert there
+        goto finish_py;
+      }
+      P.col_vals[col][row] = slot;
+      P.col_valid[col][row] = 1;
+      any_field = true;
+      q = seg_end < qend ? seg_end + 1 : qend;
+      if (seg_end < qend && seg_end + 1 == qend) {
+        P.fail(lineno, "bad field");  // trailing comma
+        return finish(P);
+      }
+    }
+    if (!any_field) {
+      P.fail(lineno, "no fields");
+      return finish(P);
+    }
+
+    // timestamp
+    int64_t t;
+    if (ts_part.n) {
+      // Python's int() accepts '_' separators; strtoll does not
+      if (memchr(ts_part.p, '_', ts_part.n)) goto finish_py;
+      char buf[32];
+      if (ts_part.n >= sizeof(buf)) {
+        P.fail(lineno, "bad timestamp");
+        return finish(P);
+      }
+      memcpy(buf, ts_part.p, ts_part.n);
+      buf[ts_part.n] = 0;
+      errno = 0;
+      char* pe = nullptr;
+      long long tv = strtoll(buf, &pe, 10);
+      if (pe != buf + ts_part.n || errno == ERANGE) {
+        P.fail(lineno, errno == ERANGE ? "timestamp out of int64 range"
+                                       : "bad timestamp");
+        return finish(P);
+      }
+      __int128 wide = (__int128)tv * P.mult;
+      if (wide > INT64_MAX || wide < INT64_MIN) {
+        P.fail(lineno, "timestamp out of int64 range");
+        return finish(P);
+      }
+      t = (int64_t)wide;
+    } else {
+      t = P.now_ns;
+    }
+    P.ts.push_back(t);
+    P.series_ref.push_back(sref);
+  }
+
+  return finish(P);
+}
+
+extern "C" void ogt_lp_free(LpBatch* b) {
+  if (!b) return;
+  free(b->ts);
+  free(b->series_ref);
+  free(b->skey_off);
+  free(b->skey_arena);
+  free(b->series_mst);
+  free(b->mst_off);
+  free(b->mst_arena);
+  free(b->col_name_off);
+  free(b->col_name_arena);
+  free(b->col_mst);
+  free(b->col_type);
+  if (b->col_vals)
+    for (int32_t i = 0; i < b->n_cols; i++) free(b->col_vals[i]);
+  if (b->col_valid)
+    for (int32_t i = 0; i < b->n_cols; i++) free(b->col_valid[i]);
+  free(b->col_vals);
+  free(b->col_valid);
+  free(b->str_arena);
+  free(b);
+}

@@ -22,6 +22,21 @@ import re
 from opengemini_tpu.ingest.line_protocol import series_key
 
 
+def parse_series_key(key: str) -> tuple[str, tuple]:
+    """Inverse of line_protocol.series_key: canonical key ->
+    (measurement, tags tuple). Components unescape with the parser's own
+    helpers so the round-trip is exact."""
+    from opengemini_tpu.ingest.line_protocol import _split_escaped, _unescape
+
+    segs = _split_escaped(key, ",")
+    mst = _unescape(segs[0])
+    tags = []
+    for seg in segs[1:]:
+        kv = _split_escaped(seg, "=")
+        tags.append((_unescape(kv[0]), _unescape(kv[1])))
+    return mst, tuple(tags)
+
+
 class SeriesIndex:
     def __init__(self, path: str | None = None):
         self.path = path
@@ -44,6 +59,18 @@ class SeriesIndex:
         sid = self.key_to_sid.get(key)
         if sid is not None:
             return sid
+        return self._insert_logged(measurement, tags, key)
+
+    def get_or_create_by_key(self, key: str) -> int:
+        """Canonical-key ingest path (the native parser hands keys, not
+        tag tuples); repeat series skip the tag reconstruction entirely."""
+        sid = self.key_to_sid.get(key)
+        if sid is not None:
+            return sid
+        measurement, tags = parse_series_key(key)
+        return self._insert_logged(measurement, tags, key)
+
+    def _insert_logged(self, measurement: str, tags: tuple, key: str) -> int:
         sid = self._insert(measurement, tags, key)
         if self._log is not None:
             self._log.write(

@@ -164,6 +164,20 @@ class MergesetIndex:
         sid = self._key_cache.get(key)
         if sid is not None:
             return sid
+        return self._insert_series(key, measurement, tags)
+
+    def get_or_create_by_key(self, key: str) -> int:
+        """Canonical-key ingest path (native parser output); repeat series
+        never reconstruct tags."""
+        sid = self._key_cache.get(key)
+        if sid is not None:
+            return sid
+        from opengemini_tpu.index.inverted import parse_series_key
+
+        measurement, tags = parse_series_key(key)
+        return self._insert_series(key, measurement, tags)
+
+    def _insert_series(self, key: str, measurement: str, tags: tuple) -> int:
         blob = _pack_series(key, measurement, tags)
         with self._native() as h:
             sid = int(self._lib.msi_insert(h, blob, len(blob), 0))

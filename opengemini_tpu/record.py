@@ -164,12 +164,14 @@ class Record:
         return self.take(np.nonzero(m)[0])
 
 
-def _null_column(ftype: FieldType, n: int) -> Column:
+def _zeroed(ftype: FieldType, n: int) -> np.ndarray:
     if ftype == FieldType.STRING:
-        vals = np.full(n, None, dtype=object)
-    else:
-        vals = np.zeros(n, dtype=ftype.np_dtype)
-    return Column(ftype, vals, np.zeros(n, dtype=np.bool_))
+        return np.full(n, None, dtype=object)
+    return np.zeros(n, dtype=ftype.np_dtype)
+
+
+def _null_column(ftype: FieldType, n: int) -> Column:
+    return Column(ftype, _zeroed(ftype, n), np.zeros(n, dtype=np.bool_))
 
 
 class RecordBuilder:
@@ -234,6 +236,67 @@ class FieldTypeConflict(Exception):
         self.field = name
         self.have = have
         self.got = got
+
+
+def merge_bulk_parts(
+    parts: list[tuple[np.ndarray, Record]], lo_t: int, hi_t: int
+) -> tuple[np.ndarray, Record]:
+    """Vectorized multi-series merge: `parts` is [(sid_arr, record)] in
+    oldest-to-newest order; output rows sort by (sid, time), duplicate
+    (sid, time) pairs keep the newest ROW whole (matching
+    merge_sorted_records / dedup_last_wins row semantics exactly), done
+    in one numpy pass over every series at once."""
+    parts = [(s, r) for s, r in parts if len(r)]
+    if not parts:
+        return np.empty(0, np.int64), Record(np.empty(0, np.int64), {})
+    sid_all = np.concatenate([s for s, _r in parts])
+    t_all = np.concatenate([r.times for _s, r in parts])
+    rank_all = np.concatenate(
+        [np.full(len(r), i, np.int32) for i, (_s, r) in enumerate(parts)])
+    in_range = (t_all >= lo_t) & (t_all < hi_t)
+
+    ftypes: dict[str, object] = {}
+    for _s, r in parts:
+        for name, col in r.columns.items():
+            ftypes.setdefault(name, col.ftype)
+
+    order = np.lexsort((rank_all, t_all, sid_all))
+    order = order[in_range[order]]
+    n = len(order)
+    if n == 0:
+        return np.empty(0, np.int64), Record(np.empty(0, np.int64), {})
+    sid_s = sid_all[order]
+    t_s = t_all[order]
+    new_grp = np.empty(n, np.bool_)
+    new_grp[0] = True
+    new_grp[1:] = (np.diff(sid_s) != 0) | (np.diff(t_s) != 0)
+    starts = np.flatnonzero(new_grp)
+    # newest row of each (sid, time) group wins whole (rank is the last
+    # lexsort key, so the group's final position is its newest part)
+    winners = np.append(starts[1:], n) - 1
+    out_sid = sid_s[starts]
+    out_t = t_s[starts]
+
+    cols = {}
+    for name, ftype in ftypes.items():
+        total = len(sid_all)
+        # zero-init, not np.empty: rows where no part has the column stay
+        # invalid but their value bytes still flow into flushed chunks and
+        # content_digest — heap garbage there breaks the replica-identical
+        # digest guarantee
+        values = _zeroed(ftype, total)
+        valid = np.zeros(total, dtype=np.bool_)
+        at = 0
+        for _s, r in parts:
+            m = len(r)
+            col = r.columns.get(name)
+            if col is not None:
+                values[at:at + m] = col.values
+                valid[at:at + m] = col.valid
+            at += m
+        take = order[winners]
+        cols[name] = Column(ftype, values[take], valid[take])
+    return out_sid, Record(out_t, cols)
 
 
 def merge_sorted_records(records: list[Record]) -> Record:

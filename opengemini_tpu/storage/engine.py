@@ -517,11 +517,30 @@ class Engine:
         rp = rp or d.default_rp
         if now_ns is None:
             now_ns = _time.time_ns()
+        raw = lines.encode("utf-8") if isinstance(lines, str) else lines
+
+        # fast path: native columnar parse -> slab writes (reference:
+        # pooled VM protoparser feeding the record writer). Falls back to
+        # the exact Python parser when the batch uses escapes or the
+        # library is absent.
+        from opengemini_tpu.ingest import native_lp
+
+        batch = native_lp.parse_columnar(raw, precision, now_ns)
+        if batch is not None:
+            if len(batch) == 0:
+                return 0
+            STATS.incr("write", "points", len(batch))
+            with self._lock:
+                n = self._write_columnar_locked(
+                    db, rp, batch, raw, precision, now_ns)
+            if self._write_observers:
+                self._notify_write(db, rp, batch.to_points())
+            return n
+
         points = lp.parse_lines(lines, precision, now_ns)
         if not points:
             return 0
         STATS.incr("write", "points", len(points))
-        raw = lines.encode("utf-8") if isinstance(lines, str) else lines
         with self._lock:
             # group points by target shard (time routing)
             by_shard: dict[int, list] = {}
@@ -537,6 +556,29 @@ class Engine:
                 if shards[key].mem.approx_bytes > self.flush_threshold_bytes:
                     shards[key].flush()
         self._notify_write(db, rp, points)
+        return n
+
+    def _write_columnar_locked(self, db: str, rp: str, batch,
+                               raw: bytes, precision: str, now_ns: int) -> int:
+        """Route a ColumnarBatch to its time shards (vectorized: one
+        floor-divide over all timestamps) and slab-write each. Caller
+        holds the engine lock."""
+        import numpy as np
+
+        d = self.databases[db]
+        rp_meta = d.rps.get(rp)
+        if rp_meta is None:
+            raise WriteError(f"retention policy not found: {db}.{rp}")
+        dur = rp_meta.shard_duration_ns
+        groups = batch.ts // dur * dur
+        uniq = np.unique(groups)
+        n = 0
+        for g in uniq:
+            shard = self._get_or_create_shard(db, rp, int(g))
+            rows = None if len(uniq) == 1 else np.flatnonzero(groups == g)
+            n += shard.write_columnar(batch, rows, raw, precision, now_ns)
+            if shard.mem.approx_bytes > self.flush_threshold_bytes:
+                shard.flush()
         return n
 
     # -- continuous queries / downsample ----------------------------------

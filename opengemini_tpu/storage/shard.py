@@ -16,7 +16,8 @@ import numpy as np
 from opengemini_tpu.ingest import line_protocol as lp
 from opengemini_tpu.index.mergeset import open_series_index
 from opengemini_tpu.record import (
-    Column, FieldTypeConflict, Record, merge_sorted_records,
+    Column, FieldTypeConflict, Record, merge_bulk_parts,
+    merge_sorted_records, _zeroed as _rec_zeroed,
 )
 from opengemini_tpu.storage.memtable import MemTable
 from opengemini_tpu.storage.tsf import (
@@ -40,7 +41,9 @@ def _pack_entries(buffer: list) -> tuple[np.ndarray, Record]:
             ftypes.setdefault(name, col.ftype)
     cols = {}
     for name, ftype in ftypes.items():
-        values = np.empty(total, dtype=ftype.np_dtype)
+        # zero-init (see record.merge_bulk_parts): garbage in invalid slots
+        # would persist into packed chunks and break digest equality
+        values = _rec_zeroed(ftype, total)
         valid = np.zeros(total, dtype=np.bool_)
         at = 0
         for _sid, rec in buffer:
@@ -54,59 +57,21 @@ def _pack_entries(buffer: list) -> tuple[np.ndarray, Record]:
     return sids, Record(times, cols)
 
 
-def _merge_bulk_parts(parts: list, lo_t: int, hi_t: int) -> tuple[np.ndarray, Record]:
-    """Vectorized multi-series merge: `parts` is [(sid_arr, record)] in
-    oldest-to-newest order; output rows sort by (sid, time), duplicate
-    (sid, time) pairs keep the newest ROW whole (matching
-    merge_sorted_records / dedup_last_wins row semantics exactly), done
-    in one numpy pass over every series at once."""
-    parts = [(s, r) for s, r in parts if len(r)]
-    if not parts:
-        return np.empty(0, np.int64), Record(np.empty(0, np.int64), {})
-    sid_all = np.concatenate([s for s, _r in parts])
-    t_all = np.concatenate([r.times for _s, r in parts])
-    rank_all = np.concatenate(
-        [np.full(len(r), i, np.int32) for i, (_s, r) in enumerate(parts)])
-    in_range = (t_all >= lo_t) & (t_all < hi_t)
+# bulk (sid, time) merge lives in record.py; shard call sites keep the
+# old private name
+_merge_bulk_parts = merge_bulk_parts
 
-    ftypes: dict[str, object] = {}
-    for _s, r in parts:
-        for name, col in r.columns.items():
-            ftypes.setdefault(name, col.ftype)
 
-    order = np.lexsort((rank_all, t_all, sid_all))
-    order = order[in_range[order]]
-    n = len(order)
-    if n == 0:
-        return np.empty(0, np.int64), Record(np.empty(0, np.int64), {})
-    sid_s = sid_all[order]
-    t_s = t_all[order]
-    new_grp = np.empty(n, np.bool_)
-    new_grp[0] = True
-    new_grp[1:] = (np.diff(sid_s) != 0) | (np.diff(t_s) != 0)
-    starts = np.flatnonzero(new_grp)
-    # newest row of each (sid, time) group wins whole (rank is the last
-    # lexsort key, so the group's final position is its newest part)
-    winners = np.append(starts[1:], n) - 1
-    out_sid = sid_s[starts]
-    out_t = t_s[starts]
-
-    cols = {}
-    for name, ftype in ftypes.items():
-        total = len(sid_all)
-        values = np.empty(total, dtype=ftype.np_dtype)
-        valid = np.zeros(total, dtype=np.bool_)
-        at = 0
-        for _s, r in parts:
-            m = len(r)
-            col = r.columns.get(name)
-            if col is not None:
-                values[at:at + m] = col.values
-                valid[at:at + m] = col.valid
-            at += m
-        take = order[winners]
-        cols[name] = Column(ftype, values[take], valid[take])
-    return out_sid, Record(out_t, cols)
+def _sid_entries(rec: Record, uniq, starts, ends):
+    """(sid, per-series Record) views over one (sid, time)-sorted bulk
+    table — the flush path's bridge from memtable tables to chunk writes."""
+    for sid, lo, hi in zip(uniq, starts, ends):
+        cols = {}
+        for name, col in rec.columns.items():
+            valid = col.valid[lo:hi]
+            if valid.any():  # fields this series never wrote stay absent
+                cols[name] = Column(col.ftype, col.values[lo:hi], valid)
+        yield int(sid), Record(rec.times[lo:hi], cols)
 
 
 def _write_measurement_chunks(w: TSFWriter, tidx, mst: str, entries,
@@ -185,10 +150,25 @@ class Shard:
             self._next_file_seq = max(self._next_file_seq, seq + 1)
 
     def _replay_wal(self) -> None:
+        from opengemini_tpu.ingest import native_lp
+
         wal_path = os.path.join(self.path, "wal.log")
         for entry in WAL.replay(wal_path):
             if entry[0] == "lines":
                 _, lines, precision, now_ns = entry
+                batch = None
+                try:
+                    batch = native_lp.parse_columnar(lines, precision, now_ns)
+                except lp.ParseError:
+                    batch = None
+                if batch is not None:
+                    try:
+                        self._apply_columnar(batch, check_types=True)
+                    except FieldTypeConflict:
+                        # partial-write semantics: a batch rejected at write
+                        # time must not poison replay either
+                        pass
+                    continue
                 points = lp.parse_lines(lines, precision, now_ns)
             else:
                 points = entry[1]
@@ -199,8 +179,6 @@ class Shard:
                     try:
                         self.mem.write_row(sid, mst, t, fields)
                     except FieldTypeConflict:
-                        # partial-write semantics: a point rejected at write
-                        # time must not poison replay either
                         continue
 
     # -- write path ---------------------------------------------------------
@@ -222,6 +200,83 @@ class Shard:
             self._check_types(points)
             self.wal.append_points(points)
             return self._apply(points)
+
+    def write_columnar(self, batch, rows: np.ndarray | None,
+                       raw_lines: bytes, precision: str, now_ns: int) -> int:
+        """Apply a native-parsed ColumnarBatch (ingest/native_lp.py). `rows`
+        selects this shard's row indices (None = all rows). WAL-logs the
+        ORIGINAL batch text (replay re-filters by time range, exactly like
+        write_points). Type conflicts raise BEFORE the WAL append."""
+        with self._lock:
+            self._check_columnar_types(batch, rows)
+            self.wal.append_lines(raw_lines, precision, now_ns)
+            return self._apply_columnar(batch, rows=rows)
+
+    def _check_columnar_types(self, batch, rows) -> None:
+        pending: dict[tuple[int, str], object] = {}
+        for mst_id, name, ftype, _values, valid in batch.cols:
+            sel = valid if rows is None else valid[rows]
+            if not sel.any():
+                continue
+            mst = batch.measurements[mst_id]
+            schema = self.schemas.get(mst, {})
+            have = schema.get(name) or pending.get((mst_id, name))
+            if have is None:
+                pending[(mst_id, name)] = ftype
+            elif have != ftype:
+                raise FieldTypeConflict(name, have, ftype)
+
+    def _resolve_sids(self, batch, refs: np.ndarray) -> np.ndarray:
+        """Map unique series refs -> sids via the series index (new series
+        register here). Returns an array indexed by ref."""
+        sid_by_ref = np.zeros(len(batch.series_keys), np.int64)
+        for ref in refs:
+            sid_by_ref[ref] = self.index.get_or_create_by_key(
+                batch.series_keys[int(ref)])
+        return sid_by_ref
+
+    def _apply_columnar(self, batch, rows: np.ndarray | None = None,
+                        check_types: bool = False) -> int:
+        """Memtable-apply the batch's selected rows (per-measurement slab
+        appends). `check_types=True` is the WAL-replay path (no prior
+        _check_columnar_types call; conflicts raise before any mutation).
+        Rows outside [tmin, tmax) are filtered here — replay feeds whole
+        batches."""
+        ts = batch.ts if rows is None else batch.ts[rows]
+        in_range = (ts >= self.tmin) & (ts < self.tmax)
+        if not in_range.all():
+            rows = (np.flatnonzero(in_range) if rows is None
+                    else rows[in_range])
+            ts = batch.ts[rows]
+        if len(ts) == 0:
+            return 0
+        if check_types:
+            self._check_columnar_types(batch, rows)
+        refs = batch.series_ref if rows is None else batch.series_ref[rows]
+        sid_by_ref = self._resolve_sids(batch, np.unique(refs))
+        sids = sid_by_ref[refs]
+        row_mst = batch.series_mst[refs]
+        n = 0
+        for mst_id in np.unique(row_mst):
+            mst = batch.measurements[int(mst_id)]
+            sel = row_mst == mst_id
+            all_rows = sel.all()
+            idx = None if all_rows else np.flatnonzero(sel)
+            cols = {}
+            for c_mst, name, ftype, values, valid in batch.cols:
+                if c_mst != mst_id:
+                    continue
+                v = values if rows is None else values[rows]
+                ok = valid if rows is None else valid[rows]
+                if not all_rows:
+                    v, ok = v[idx], ok[idx]
+                if ok.any():
+                    cols[name] = (ftype, v, ok)
+            m_sids = sids if all_rows else sids[idx]
+            m_ts = ts if all_rows else ts[idx]
+            self.mem.write_columnar(mst, m_sids, m_ts, cols)
+            n += len(m_ts)
+        return n
 
     def _check_types(self, points: list) -> None:
         pending: dict[str, dict] = {}
@@ -255,11 +310,13 @@ class Shard:
             w = TSFWriter(path)
             tidx = _TextSidecar()
             try:
-                per_mst: dict[str, list] = {}
-                for sid, (mst, rec) in sorted(self.mem.series_records().items()):
-                    per_mst.setdefault(mst, []).append((sid, rec))
-                for mst, entries in per_mst.items():
-                    _write_measurement_chunks(w, tidx, mst, entries)
+                for mst, sid_arr, rec in self.mem.measurement_tables():
+                    uniq, starts = np.unique(sid_arr, return_index=True)
+                    ends = np.append(starts[1:], len(sid_arr))
+                    _write_measurement_chunks(
+                        w, tidx, mst,
+                        _sid_entries(rec, uniq, starts, ends),
+                        n_series=len(uniq))
                 _fp("shard-flush-before-publish")  # reference: engine/shard.go:457
                 w.finish()
             except BaseException:
@@ -699,16 +756,13 @@ class Shard:
                 elif c.sid in sid_set:
                     rec = r.read_chunk(measurement, c, fields)
                     parts.append((np.full(len(rec), c.sid, np.int64), rec))
-        for sid in sids:
-            mem_rec = self.mem.record_for(sid)
-            if mem_rec is None:
-                continue
+        for sid_arr, mem_rec in self.mem.bulk_parts(measurement, sids):
             if fields is not None:
                 mem_rec = Record(
                     mem_rec.times,
                     {k: v for k, v in mem_rec.columns.items() if k in fields},
                 )
-            parts.append((np.full(len(mem_rec), int(sid), np.int64), mem_rec))
+            parts.append((sid_arr, mem_rec))
         return _merge_bulk_parts(parts, lo_t, hi_t)
 
     def content_digest(self) -> dict:

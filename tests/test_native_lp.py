@@ -1,0 +1,342 @@
+"""Native batch line-protocol parser + columnar ingest path.
+
+The Python parser (ingest/line_protocol.py) is the semantic reference;
+the native parser (native/lineproto.cpp via ingest/native_lp.py) must
+either produce identical points or defer (return None). The columnar
+write path (Engine.write_lines -> Shard.write_columnar -> MemTable
+slabs) must be indistinguishable from the row path at the query layer.
+"""
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.ingest import line_protocol as lp
+from opengemini_tpu.ingest import native_lp
+from opengemini_tpu.record import FieldType
+from opengemini_tpu.storage.engine import Engine
+from opengemini_tpu.storage.memtable import MemTable
+
+pytestmark = pytest.mark.skipif(
+    native_lp.load() is None, reason="native lineproto library unavailable")
+
+
+def _points(data, **kw):
+    b = native_lp.parse_columnar(data, **kw)
+    assert b is not None, "unexpected fallback"
+    return b.to_points()
+
+
+class TestParserEquivalence:
+    CASES = [
+        b"cpu,host=h1,region=us usage_user=50.5,usage_sys=3i,up=t 1700000000000000000",
+        b'cpu,host=h2 usage_user=60,msg="hello world, ok" 1700000001000000000',
+        b"m,b=2,a=1,a=0 v=1",          # duplicate tag keys keep stable order
+        b"m,k=a=b f=1 5",               # '=' inside tag value
+        b"mem,host=h1 free=123u 1700000002000000000",
+        b"bools x=TRUE,y=F,z=false",
+        b"neg v=-12.75e2 -1700000002000000000",
+        b"m   f=1   1700000000000000001",  # multi-space separators
+        b"# comment\n\nm f=1 7\r\nm f=2 8\r",
+        b'strings s="",t="x,y z=1"',
+        b"ints a=-9223372036854775808i,b=9223372036854775807i 1",
+        b"floats a=inf,b=-inf,c=nan 1",
+        b"dup f=1,f=2 9",               # duplicate field: last wins
+    ]
+
+    @pytest.mark.parametrize("data", CASES)
+    def test_points_equal(self, data):
+        got = _points(data, now_ns=424242)
+        want = lp.parse_lines(data, now_ns=424242)
+        # NaN-tolerant comparison
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert g[0] == w[0] and g[1] == w[1] and g[2] == w[2]
+            assert g[3].keys() == w[3].keys()
+            for k in g[3]:
+                tg, vg = g[3][k]
+                tw, vw = w[3][k]
+                assert tg == tw
+                if isinstance(vg, float) and isinstance(vw, float) and vw != vw:
+                    assert vg != vg
+                else:
+                    assert vg == vw
+
+    @pytest.mark.parametrize("precision", ["ns", "us", "ms", "s", "m", "h"])
+    def test_precision(self, precision):
+        got = _points(b"m f=1 17000", precision=precision)
+        want = lp.parse_lines(b"m f=1 17000", precision=precision)
+        assert got == want
+
+    ERRORS = [
+        b"novalue",
+        b"m f=abc",
+        b"m,=x f=1",
+        b"m f= 1",
+        b"m f=1 badts",
+        b"m f=1,",
+        b"m f=1 1 2 3",
+        b'm s="unterminated 1',
+        b"m f=99999999999999999999i 1",
+        b"m f=1 99999999999999999999",
+        b", f=1",
+        b"m ,f=1",
+        b"m f=0x10",
+    ]
+
+    @pytest.mark.parametrize("data", ERRORS)
+    def test_errors_agree(self, data):
+        with pytest.raises(lp.ParseError):
+            lp.parse_lines(data)
+        with pytest.raises(lp.ParseError):
+            if native_lp.parse_columnar(data) is None:
+                raise lp.ParseError(0, "fell back (also acceptable only if python errors)")
+
+    FALLBACKS = [
+        b"m,h=a\\ b f=1",            # escaped space
+        b'm f="say \\"hi\\""',       # escaped quote in string
+        b"m f=1_0",                   # python digit separators
+        b"m f=1 1_000",               # separators in the timestamp too
+        b'm"x,t=1 f=1',               # quote in the key section
+    ]
+
+    @pytest.mark.parametrize("data", FALLBACKS)
+    def test_fallback_cases(self, data):
+        assert native_lp.parse_columnar(data) is None
+
+    def test_series_keys_canonical(self):
+        b = native_lp.parse_columnar(b"m,k=a=b,j=z f=1 5")
+        [key] = b.series_keys
+        pts = lp.parse_lines(b"m,k=a=b,j=z f=1 5")
+        assert key == lp.series_key(pts[0][0], pts[0][1])
+
+    def test_float_bit_exact_parity(self):
+        """Native float parsing must be bit-identical to Python float():
+        a 1-ULP divergence would make replicas that parsed the same write
+        with different parsers digest-diverge forever."""
+        import random
+        import struct
+
+        rng = random.Random(7)
+        tokens = [repr(rng.uniform(-1e6, 1e6)) for _ in range(2000)]
+        tokens += ["9007199254740993", "12345678901234567890", "1e308",
+                   "-0.0", "5e-324", "10.80307196761422"]
+        for v in tokens:
+            data = f"m f={v} 1".encode()
+            a = _points(data)[0][3]["f"][1]
+            b = lp.parse_lines(data)[0][3]["f"][1]
+            assert struct.pack("<d", a) == struct.pack("<d", b), v
+
+    def test_invalid_slots_zeroed(self):
+        """Value slots of rows a column doesn't cover must be zero, not
+        heap garbage (they flow into flushed chunks and content_digest)."""
+        lines = ["m a=1 1"] + [f"m b=2 {i+2}" for i in range(4000)] + ["m a=3 4002"]
+        b = native_lp.parse_columnar("\n".join(lines).encode())
+        a_col = next(c for c in b.cols if c[1] == "a")
+        assert (a_col[3][~a_col[4]] == 0.0).all()
+
+    def test_series_record_shape_matches_row_path(self):
+        """Per-series records drop fields the series never wrote,
+        regardless of ingest path (digest parity across paths)."""
+        mt = MemTable()
+        mt.write_columnar(
+            "m", np.array([1], np.int64), np.array([10], np.int64),
+            {"x": (FieldType.FLOAT, np.array([1.0]), np.array([True]))})
+        mt.write_columnar(
+            "m", np.array([2], np.int64), np.array([10], np.int64),
+            {"y": (FieldType.FLOAT, np.array([2.0]), np.array([True]))})
+        assert set(mt.record_for(1).columns) == {"x"}
+        assert set(mt.series_records()[2][1].columns) == {"y"}
+
+    def test_large_batch_throughput_shape(self):
+        lines = []
+        for p in range(200):
+            for s in range(100):
+                lines.append(
+                    f"cpu,host=h{s} a={p}.5,b={s}i,c=t {1700000000 + p}000000000")
+        data = "\n".join(lines).encode()
+        b = native_lp.parse_columnar(data)
+        assert len(b) == 20000
+        assert len(b.series_keys) == 100
+        assert {c[1] for c in b.cols} == {"a", "b", "c"}
+        a = next(c for c in b.cols if c[1] == "a")
+        assert a[2] == FieldType.FLOAT and a[4].all()
+        assert float(a[3][0]) == 0.5
+
+
+class TestColumnarWritePath:
+    def _mk(self, tmp_path, name="native"):
+        eng = Engine(str(tmp_path / name), sync_wal=False)
+        eng.create_database("db")
+        return eng
+
+    def _query(self, eng, q, now=2_000_000_000_000_000_000):
+        from opengemini_tpu.query.executor import Executor
+
+        return Executor(eng).execute(q, db="db", now_ns=now)["results"][0]
+
+    DATA = (
+        "cpu,host=h1 usage=1,mode=\"sys\" 1700000000000000000\n"
+        "cpu,host=h2 usage=2 1700000001000000000\n"
+        "cpu,host=h1 usage=3,extra=7i 1700000060000000000\n"
+        "mem,host=h1 free=10i 1700000000500000000\n"
+    )
+
+    def test_native_vs_python_query_identical(self, tmp_path, monkeypatch):
+        eng_n = self._mk(tmp_path, "native")
+        eng_n.write_lines("db", self.DATA)
+
+        eng_p = self._mk(tmp_path, "python")
+        monkeypatch.setattr(native_lp, "_LIB", None)
+        monkeypatch.setattr(native_lp, "_TRIED", True)
+        eng_p.write_lines("db", self.DATA)
+        monkeypatch.undo()
+
+        for q in [
+            "SELECT * FROM cpu",
+            "SELECT usage, mode FROM cpu WHERE host = 'h1'",
+            "SELECT count(usage), max(usage) FROM cpu GROUP BY time(1m)",
+            "SELECT * FROM mem",
+            "SHOW SERIES",
+            "SHOW FIELD KEYS",
+        ]:
+            assert self._query(eng_n, q) == self._query(eng_p, q), q
+        eng_n.close()
+        eng_p.close()
+
+    def test_flush_and_requery(self, tmp_path):
+        eng = self._mk(tmp_path)
+        eng.write_lines("db", self.DATA)
+        eng.flush_all()
+        r = self._query(eng, "SELECT usage FROM cpu WHERE host = 'h1'")
+        assert [v[1] for v in r["series"][0]["values"]] == [1, 3]
+        eng.close()
+
+    def test_wal_replay_columnar(self, tmp_path):
+        eng = self._mk(tmp_path)
+        eng.write_lines("db", self.DATA)
+        eng.close()  # no flush: reopen replays the WAL
+        eng2 = Engine(str(tmp_path / "native"), sync_wal=False)
+        r = self._query(eng2, "SELECT usage FROM cpu WHERE host = 'h1'")
+        assert [v[1] for v in r["series"][0]["values"]] == [1, 3]
+        eng2.close()
+
+    def test_lww_across_paths(self, tmp_path):
+        """Same (series, timestamp) written via columnar then row then
+        columnar: strict append-order last-write-wins."""
+        eng = self._mk(tmp_path)
+        t = 1_700_000_000_000_000_000
+        eng.write_lines("db", f"m,h=a v=1 {t}")           # slab
+        eng.write_rows("db", [("m", (("h", "a"),), t,
+                               {"v": (FieldType.FLOAT, 2.0)})])  # row path
+        r = self._query(eng, "SELECT v FROM m")
+        assert r["series"][0]["values"][0][1] == 2
+        eng.write_lines("db", f"m,h=a v=3 {t}")           # slab again
+        r = self._query(eng, "SELECT v FROM m")
+        assert r["series"][0]["values"][0][1] == 3
+        eng.close()
+
+    def test_type_conflict_rejected_before_wal(self, tmp_path):
+        from opengemini_tpu.record import FieldTypeConflict
+
+        eng = self._mk(tmp_path)
+        t = 1_700_000_000_000_000_000
+        eng.write_lines("db", f"m v=1.5 {t}")
+        with pytest.raises(FieldTypeConflict):
+            eng.write_lines("db", f"m v=2i {t + 1}")
+        # good rows still there, conflicting row gone even after replay
+        eng.close()
+        eng2 = Engine(str(tmp_path / "native"), sync_wal=False)
+        r = self._query(eng2, "SELECT v FROM m")
+        assert [v[1] for v in r["series"][0]["values"]] == [1.5]
+        eng2.close()
+
+    def test_multi_shard_batch(self, tmp_path):
+        eng = self._mk(tmp_path)
+        week = 7 * 24 * 3600 * 10**9
+        t0 = 1_700_000_000_000_000_000
+        t1 = t0 + week  # different shard group
+        eng.write_lines("db", f"m v=1 {t0}\nm v=2 {t1}")
+        assert len(eng.all_shards()) == 2
+        r = self._query(eng, "SELECT v FROM m", now=t1 + week)
+        assert [v[1] for v in r["series"][0]["values"]] == [1, 2]
+        eng.close()
+
+
+class TestDigestStability:
+    def test_disjoint_field_sets_digest_replica_identical(self, tmp_path):
+        """Two replicas writing the same logical rows (series with disjoint
+        field sets, exercising the missing-column padding in
+        merge_bulk_parts) must produce identical content digests —
+        anti-entropy depends on it."""
+        data = (
+            "m,h=a x=1 1700000000000000000\n"
+            "m,h=b y=2 1700000000000000000\n"
+            "m,h=a x=3 1700000060000000000\n"
+        )
+        digs = []
+        for name in ("r1", "r2"):
+            eng = Engine(str(tmp_path / name), sync_wal=False)
+            eng.create_database("db")
+            eng.write_lines("db", data)
+            eng.flush_all()
+            [sh] = eng.all_shards()
+            digs.append(sh.content_digest())
+            eng.close()
+        assert digs[0] == digs[1]
+
+
+class TestMemtableSlabs:
+    def test_record_for_merges_slab_and_builder(self):
+        mt = MemTable()
+        mt.write_columnar(
+            "m", np.array([7, 7], np.int64),
+            np.array([100, 200], np.int64),
+            {"v": (FieldType.FLOAT, np.array([1.0, 2.0]),
+                   np.array([True, True]))},
+        )
+        mt.write_row(7, "m", 150, {"v": (FieldType.FLOAT, 9.0)})
+        rec = mt.record_for(7)
+        assert list(rec.times) == [100, 150, 200]
+        assert list(rec.columns["v"].values) == [1.0, 9.0, 2.0]
+        assert mt.row_count == 3
+
+    def test_freeze_preserves_order(self):
+        mt = MemTable()
+        mt.write_row(7, "m", 100, {"v": (FieldType.FLOAT, 1.0)})
+        mt.write_columnar(
+            "m", np.array([7], np.int64), np.array([100], np.int64),
+            {"v": (FieldType.FLOAT, np.array([5.0]), np.array([True]))},
+        )
+        rec = mt.record_for(7)
+        assert list(rec.times) == [100]
+        assert list(rec.columns["v"].values) == [5.0]  # slab is newer
+
+    def test_sids_and_tables(self):
+        mt = MemTable()
+        mt.write_columnar(
+            "a", np.array([1, 2], np.int64), np.array([10, 20], np.int64),
+            {"v": (FieldType.INT, np.array([5, 6], np.int64),
+                   np.ones(2, np.bool_))},
+        )
+        mt.write_row(3, "b", 30, {"w": (FieldType.FLOAT, 1.0)})
+        assert mt.sids_for("a") == {1, 2}
+        assert mt.sids_for("b") == {3}
+        tables = {mst: (list(sids), rec)
+                  for mst, sids, rec in mt.measurement_tables()}
+        assert set(tables) == {"a", "b"}
+        assert tables["a"][0] == [1, 2]
+
+    def test_type_conflict_no_partial_state(self):
+        from opengemini_tpu.record import FieldTypeConflict
+
+        mt = MemTable()
+        mt.write_row(1, "m", 10, {"v": (FieldType.FLOAT, 1.0)})
+        with pytest.raises(FieldTypeConflict):
+            mt.write_columnar(
+                "m", np.array([1], np.int64), np.array([20], np.int64),
+                {"v": (FieldType.INT, np.array([2], np.int64),
+                       np.ones(1, np.bool_))},
+            )
+        rec = mt.record_for(1)
+        assert list(rec.times) == [10]
+        assert mt.row_count == 1
