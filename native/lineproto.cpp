@@ -19,6 +19,7 @@
 // tests/test_native_lp.py).
 
 #include <cerrno>
+#include <clocale>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -225,16 +226,19 @@ bool parse_float_token(const char* p, size_t n, double* out) {
     *out = neg ? -(double)ip : (double)ip;
     return true;
   }
-  // general: strtod needs NUL termination; token is bounded so copy.
+  // general: strtod_l under an explicit C locale — plain strtod parses
+  // decimals per LC_NUMERIC, so a host locale with comma decimals would
+  // reject every "50.5" the locale-independent Python float() accepts.
   // strtod accepts hex floats ("0x10") that Python float() rejects —
   // screen them out so both parsers agree on what is an error.
+  static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
   char buf[64];
   if (n == 0 || n >= sizeof(buf)) return false;
   if (memchr(p, 'x', n) || memchr(p, 'X', n)) return false;
   memcpy(buf, p, n);
   buf[n] = 0;
   char* end = nullptr;
-  double v = strtod(buf, &end);
+  double v = c_loc ? strtod_l(buf, &end, c_loc) : strtod(buf, &end);
   if (end != buf + n) return false;
   *out = v;
   return true;

@@ -26,20 +26,38 @@ class ConditionError(ValueError):
 
 
 class SplitCondition:
-    """tmin inclusive, tmax exclusive (ns); tag_expr / field_expr are AST
-    subtrees or None."""
+    """tmin inclusive, tmax exclusive (ns); tag_expr / field_expr /
+    mixed_expr are AST subtrees or None. mixed_expr holds conjuncts whose
+    subtree references BOTH tags and fields (e.g. `tag = 'x' OR field > 1`
+    or `tag != field`): tags can only prune a sid SUPERSET for it
+    (tag_superset_sids); the exact answer needs per-row evaluation with
+    the series' tag values injected as columns (eval_row_filter)."""
 
-    def __init__(self, tmin, tmax, tag_expr, field_expr):
+    def __init__(self, tmin, tmax, tag_expr, field_expr, mixed_expr=None,
+                 tag_keys=frozenset()):
         self.tmin = tmin
         self.tmax = tmax
         self.tag_expr = tag_expr
         self.field_expr = field_expr
+        self.mixed_expr = mixed_expr
+        self.tag_keys = tag_keys
+        # /*+ full_series|specific_series */: mixed_expr was consumed as a
+        # series-level filter (series_only_sids) — no per-row evaluation.
+        # A flag rather than nulling mixed_expr: remote peers still need
+        # the expression to apply the same series-level filter.
+        self.mixed_series_level = False
+
+    @property
+    def has_row_filter(self) -> bool:
+        return self.field_expr is not None or (
+            self.mixed_expr is not None and not self.mixed_series_level)
 
 
 def split(cond, tag_keys: set[str], now_ns: int) -> SplitCondition:
     tmin, tmax = MIN_TIME, MAX_TIME
     tag_parts: list = []
     field_parts: list = []
+    mixed_parts: list = []
 
     def walk(e):
         nonlocal tmin, tmax
@@ -69,14 +87,15 @@ def split(cond, tag_keys: set[str], now_ns: int) -> SplitCondition:
         elif not refs:
             field_parts.append(e)  # constant condition
         else:
-            raise ConditionError(
-                "conditions mixing tags and fields in one OR subtree are not supported"
-            )
+            # subtree mixing tags and fields (reference evaluates arbitrary
+            # condition trees, lib/binaryfilterfunc functions.go:143)
+            mixed_parts.append(e)
 
     walk(cond)
-    tag_expr = _and_join(tag_parts)
-    field_expr = _and_join(field_parts)
-    return SplitCondition(tmin, tmax, tag_expr, field_expr)
+    return SplitCondition(
+        tmin, tmax, _and_join(tag_parts), _and_join(field_parts),
+        _and_join(mixed_parts), frozenset(tag_keys),
+    )
 
 
 def _and_join(parts: list):
@@ -261,11 +280,118 @@ def eval_tag_expr(expr, index, measurement: str) -> set[int]:
     raise ConditionError(f"unsupported tag filter: {expr}")
 
 
+def tag_superset_sids(expr, index, measurement: str, tag_keys: set[str]) -> set[int]:
+    """SOUND sid superset for a mixed tag/field tree: every sid that could
+    possibly satisfy the condition on some row. Field leaves (and any leaf
+    the index cannot answer conservatively) widen to all sids; tag leaves
+    use the inverted index. Used to prune the scan before the exact
+    per-row evaluation (eval_row_filter)."""
+    expr = _strip(expr)
+    all_sids = index.series_ids(measurement)
+    if expr is None:
+        return set(all_sids)
+    if isinstance(expr, ast.BinaryExpr):
+        if expr.op == "AND":
+            return tag_superset_sids(expr.lhs, index, measurement, tag_keys) & \
+                tag_superset_sids(expr.rhs, index, measurement, tag_keys)
+        if expr.op == "OR":
+            return tag_superset_sids(expr.lhs, index, measurement, tag_keys) | \
+                tag_superset_sids(expr.rhs, index, measurement, tag_keys)
+    refs = _collect_refs(expr)
+    if refs and refs <= tag_keys and isinstance(expr, ast.BinaryExpr):
+        # widen when the leaf can match series MISSING the tag (which the
+        # index has no posting for): `tag = ''` and regexes matching ''
+        lhs, rhs = _strip(expr.lhs), _strip(expr.rhs)
+        for side in (lhs, rhs):
+            if isinstance(side, ast.StringLiteral) and side.val == "" \
+                    and expr.op == "=":
+                return set(all_sids)
+            if isinstance(side, ast.RegexLiteral) and expr.op == "=~" \
+                    and re.search(side.pattern, ""):
+                return set(all_sids)
+        try:
+            return eval_tag_expr(expr, index, measurement)
+        except ConditionError:
+            return set(all_sids)
+    return set(all_sids)
+
+
+def series_only_sids(expr, index, measurement: str, tag_keys: set[str]) -> set[int]:
+    """Series-level evaluation for /*+ full_series */ and
+    /*+ specific_series */ hints (reference: hybrid store reader's
+    series-keyed scan): the condition identifies whole series, so field
+    leaves evaluate FALSE and the tag tree selects sids directly."""
+    expr = _strip(expr)
+    if expr is None:
+        return set(index.series_ids(measurement))
+    if isinstance(expr, ast.BinaryExpr):
+        if expr.op == "AND":
+            return series_only_sids(expr.lhs, index, measurement, tag_keys) & \
+                series_only_sids(expr.rhs, index, measurement, tag_keys)
+        if expr.op == "OR":
+            return series_only_sids(expr.lhs, index, measurement, tag_keys) | \
+                series_only_sids(expr.rhs, index, measurement, tag_keys)
+    refs = _collect_refs(expr)
+    if refs and refs <= tag_keys:
+        try:
+            return eval_tag_expr(expr, index, measurement)
+        except ConditionError:
+            return set()
+    return set()  # field leaves identify no series
+
+
 # -- field filter -> numpy mask ----------------------------------------------
 
 
 def field_filter_refs(expr) -> set[str]:
     return _collect_refs(expr)
+
+
+def row_filter_refs(sc: "SplitCondition") -> set[str]:
+    """Storage FIELD names the row filters read: field_expr refs plus the
+    non-tag refs of mixed_expr (tag refs come from the index, not chunks)."""
+    refs = set()
+    if sc.field_expr is not None:
+        refs |= _collect_refs(sc.field_expr)
+    if sc.mixed_expr is not None and not sc.mixed_series_level:
+        refs |= _collect_refs(sc.mixed_expr) - set(sc.tag_keys)
+    return refs
+
+
+def _with_tag_columns(rec, tag_refs, tags=None, sid_arr=None, index=None):
+    """Record plus the series' tag values as broadcast string columns.
+    Missing tags inject as '' (influx: an absent tag compares as the
+    empty string at row level). `tags` serves the per-series case;
+    (sid_arr, index) the bulk case (per-row lookup via the sid column)."""
+    from opengemini_tpu.record import Column, FieldType, Record
+
+    n = len(rec)
+    cols = dict(rec.columns)
+    for key in tag_refs:
+        if tags is not None:
+            vals = np.full(n, tags.get(key, ""), dtype=object)
+        else:
+            uniq = np.unique(sid_arr)
+            lut = {int(s): index.tags_of(int(s)).get(key, "") for s in uniq}
+            vals = np.array([lut[int(s)] for s in sid_arr], dtype=object)
+        cols[key] = Column(FieldType.STRING, vals, np.ones(n, dtype=np.bool_))
+    return Record(rec.times, cols)
+
+
+def eval_row_filter(sc: "SplitCondition", rec, tags=None, sid_arr=None,
+                    index=None) -> np.ndarray:
+    """Combined per-row mask: field_expr AND mixed_expr (the latter with
+    the series' tags injected as columns). Callers pass `tags` (per-series
+    scans) or `sid_arr` + `index` (bulk scans)."""
+    if sc.field_expr is not None:
+        m = eval_field_expr(sc.field_expr, rec)
+    else:
+        m = np.ones(len(rec), dtype=np.bool_)
+    if sc.mixed_expr is not None and not sc.mixed_series_level:
+        tag_refs = _collect_refs(sc.mixed_expr) & set(sc.tag_keys)
+        rec2 = _with_tag_columns(rec, tag_refs, tags, sid_arr, index)
+        m = m & eval_field_expr(sc.mixed_expr, rec2)
+    return m
 
 
 def eval_field_expr(expr, record) -> np.ndarray:
@@ -286,6 +412,37 @@ def eval_field_expr(expr, record) -> np.ndarray:
         if isinstance(rhs, ast.VarRef) and not isinstance(lhs, ast.VarRef):
             lhs, rhs = rhs, lhs
             op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if isinstance(lhs, ast.VarRef) and isinstance(rhs, ast.VarRef):
+            # column vs column (tag-vs-field compares arrive here with the
+            # tag injected as a string column — eval_row_filter)
+            a = record.columns.get(lhs.name)
+            b = record.columns.get(rhs.name)
+            if a is None or b is None:
+                return np.zeros(n, dtype=np.bool_)
+            if (a.values.dtype == object) != (b.values.dtype == object):
+                return np.zeros(n, dtype=np.bool_)  # typed mismatch
+            av, bv = a.values, b.values
+            if av.dtype == object:
+                # ordered compares on object arrays choke on None at
+                # invalid rows; the mask below discards them anyway
+                av = np.where(a.valid, av, "")
+                bv = np.where(b.valid, bv, "")
+            with np.errstate(invalid="ignore"):
+                if op == "=":
+                    m = av == bv
+                elif op in ("!=", "<>"):
+                    m = av != bv
+                elif op == "<":
+                    m = av < bv
+                elif op == "<=":
+                    m = av <= bv
+                elif op == ">":
+                    m = av > bv
+                elif op == ">=":
+                    m = av >= bv
+                else:
+                    raise ConditionError(f"unsupported field operator {op!r}")
+            return np.asarray(m, dtype=np.bool_) & a.valid & b.valid
         if isinstance(lhs, ast.VarRef):
             col = record.columns.get(lhs.name)
             if col is None:

@@ -848,7 +848,7 @@ class Executor:
         for sh in shards:
             tag_keys.update(sh.index.tag_keys(stmt.measurement))
         sc = cond.split(stmt.condition, tag_keys, now_ns)
-        if sc.field_expr is not None:
+        if sc.has_row_filter:
             raise QueryError("DELETE conditions may only reference time and tags")
         has_time = sc.tmin != cond.MIN_TIME or sc.tmax != cond.MAX_TIME
         if is_drop_series and has_time:
@@ -1594,8 +1594,20 @@ class Executor:
         match_terms = (
             [] if group_time else cond.conjunctive_match_terms(sc.field_expr)
         )
+        # /*+ full_series|specific_series */: the WHERE identifies whole
+        # series — evaluate mixed tag/field trees at the series level and
+        # skip their per-row filter (reference: hybrid store reader hints)
+        hinted = bool({"full_series", "specific_series"}
+                      & set(getattr(stmt, "hints", ())))
         for sh in shards:
             sids = cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
+            if sc.mixed_expr is not None:
+                if hinted:
+                    sids &= cond.series_only_sids(
+                        sc.mixed_expr, sh.index, mst, sc.tag_keys)
+                else:
+                    sids &= cond.tag_superset_sids(
+                        sc.mixed_expr, sh.index, mst, sc.tag_keys)
             sids = _prune_text_sids(sh, mst, sids, match_terms)
             for sid in sorted(sids):
                 tags = sh.index.tags_of(sid)
@@ -1606,6 +1618,8 @@ class Executor:
                     gid_of[key] = gid
                     group_keys.append(key)
                 scan_plan.append((sh, sid, gid))
+        if hinted:
+            sc.mixed_series_level = True  # consumed at the series level
         if not scan_plan and not (remote_mode == "meta" and live is not None):
             # clustered "meta" scans proceed with an empty local plan:
             # the groups may exist only as remote partials
@@ -1688,7 +1702,7 @@ class Executor:
         aggs = [a for a in aggs if a[3].lower() != "time"]
 
         needed_fields = sorted({a[3] for a in aggs})
-        field_filter_fields = sorted(cond.field_filter_refs(sc.field_expr)) if sc.field_expr else []
+        field_filter_fields = sorted(cond.row_filter_refs(sc))
         read_fields = sorted(set(needed_fields) | set(field_filter_fields))
         if time_aggs and not read_fields:
             read_fields = None  # time-only aggregates: read every field
@@ -1723,7 +1737,7 @@ class Executor:
         pre_eligible = (
             not group_time
             and not time_aggs
-            and sc.field_expr is None
+            and not sc.has_row_filter
             and all(spec.name in ("count", "sum", "mean") for _c, spec, _p, _f in aggs)
             # remote proxies carry no chunk metadata: full decode for them
             and all(getattr(sh, "supports_preagg", False) for sh in shards)
@@ -1784,8 +1798,9 @@ class Executor:
                         continue
                     rows_scanned += len(rec)
                     fmask = (
-                        cond.eval_field_expr(sc.field_expr, rec)
-                        if sc.field_expr is not None
+                        cond.eval_row_filter(sc, rec, sid_arr=sid_arr,
+                                             index=sh.index)
+                        if sc.has_row_filter
                         else None
                     )
                     gid_rows = gid_sorted[np.searchsorted(sid_sorted, sid_arr)]
@@ -1813,8 +1828,8 @@ class Executor:
                     continue
                 rows_scanned += len(rec)
                 fmask = (
-                    cond.eval_field_expr(sc.field_expr, rec)
-                    if sc.field_expr is not None
+                    cond.eval_row_filter(sc, rec, tags=sh.index.tags_of(sid))
+                    if sc.has_row_filter
                     else None
                 )
                 if group_time:
@@ -1898,6 +1913,8 @@ class Executor:
                     "aggs": per_field_aggs,
                     "tag_expr": astjson.to_json(sc.tag_expr),
                     "field_expr": astjson.to_json(sc.field_expr),
+                    "mixed_expr": astjson.to_json(sc.mixed_expr),
+                    "mixed_series_level": sc.mixed_series_level,
                 }
                 peer_docs = self.router.select_partials(req, ctx.live)
                 if peer_docs:
@@ -2084,7 +2101,7 @@ class Executor:
             return []
         if ctx.schema.get(fname) not in (FieldType.FLOAT, FieldType.INT):
             raise QueryError("percentile_approx() requires a numeric field")
-        if ctx.sc.field_expr is not None:
+        if ctx.sc.has_row_filter:
             raise QueryError("percentile_approx() does not support field filters")
         tmin, tmax = ctx.tmin, ctx.tmax
 
@@ -2217,9 +2234,8 @@ class Executor:
                 col_plans.append(("aux", e))
 
         aux_field_names = [n for n in aux_fields if n in schema]
-        read_fields = sorted({sel_field, *aux_field_names} | (
-            set(cond.field_filter_refs(sc.field_expr)) if sc.field_expr else set()
-        ))
+        read_fields = sorted({sel_field, *aux_field_names}
+                             | cond.row_filter_refs(sc))
 
         groups: dict[int, list] = {}
         for sh, sid, gid in ctx.scan_plan:
@@ -2246,8 +2262,9 @@ class Executor:
                 if col is None or len(rec) == 0:
                     continue
                 m = col.valid.copy()
-                if sc.field_expr is not None:
-                    m &= cond.eval_field_expr(sc.field_expr, rec)
+                if sc.has_row_filter:
+                    m &= cond.eval_row_filter(sc, rec,
+                                              tags=sh.index.tags_of(sid))
                 if not m.any():
                     continue
                 t_list.append(rec.times[m])
@@ -2393,14 +2410,16 @@ class Executor:
                 ts_list, vs_list = [], []
                 for sh, sid in groups[key]:
                     TRACKER.check()  # KILL QUERY cancellation point
-                    rec = sh.read_series(mst, sid, tmin, tmax, fields=[fname] + (
-                        sorted(cond.field_filter_refs(sc.field_expr)) if sc.field_expr else []))
+                    rec = sh.read_series(
+                        mst, sid, tmin, tmax,
+                        fields=[fname] + sorted(cond.row_filter_refs(sc)))
                     col = rec.columns.get(fname)
                     if col is None or len(rec) == 0:
                         continue
                     m = col.valid.copy()
-                    if sc.field_expr is not None:
-                        m &= cond.eval_field_expr(sc.field_expr, rec)
+                    if sc.has_row_filter:
+                        m &= cond.eval_row_filter(
+                            sc, rec, tags=sh.index.tags_of(sid))
                     ts_list.append(rec.times[m])
                     vs_list.append(col.values[m])
                 if not ts_list:
@@ -2667,16 +2686,27 @@ class Executor:
         group_tags = self._group_tags(stmt, shards, mst)
         groups: dict[tuple, list] = {}
         match_terms = cond.conjunctive_match_terms(sc.field_expr)
+        hinted = bool({"full_series", "specific_series"}
+                      & set(getattr(stmt, "hints", ())))
         for sh in shards:
             sids = cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
+            if sc.mixed_expr is not None:
+                if hinted:
+                    sids &= cond.series_only_sids(
+                        sc.mixed_expr, sh.index, mst, sc.tag_keys)
+                else:
+                    sids &= cond.tag_superset_sids(
+                        sc.mixed_expr, sh.index, mst, sc.tag_keys)
             sids = _prune_text_sids(sh, mst, sids, match_terms)
             for sid in sorted(sids):
                 tags = sh.index.tags_of(sid)
                 key = tuple(tags.get(k, "") for k in group_tags)
                 groups.setdefault(key, []).append((sh, sid, tags))
+        if hinted:
+            sc.mixed_series_level = True  # consumed at the series level
 
         # project only needed columns: selected fields + filter refs
-        filter_refs = cond.field_filter_refs(sc.field_expr) if sc.field_expr else set()
+        filter_refs = cond.row_filter_refs(sc)
         read_fields = sorted(
             ({src_of[c] for c in columns[1:] if src_of[c] in schema}
              | set(filter_refs)) & set(schema)
@@ -2697,8 +2727,8 @@ class Executor:
                 if len(rec) == 0:
                     continue
                 fmask = (
-                    cond.eval_field_expr(sc.field_expr, rec)
-                    if sc.field_expr is not None
+                    cond.eval_row_filter(sc, rec, tags=tags)
+                    if sc.has_row_filter
                     else np.ones(len(rec), dtype=bool)
                 )
                 # a raw row is emitted if any selected *field* is present
@@ -2804,7 +2834,7 @@ class Executor:
         if condition is not None:
             tag_keys = set(sh.index.tag_keys(mst))
             sc = cond.split(condition, tag_keys, 0)
-            if sc.field_expr is not None:
+            if sc.has_row_filter:
                 return set()
             if sc.tag_expr is not None:
                 sids = sids & cond.eval_tag_expr(sc.tag_expr, sh.index, mst)

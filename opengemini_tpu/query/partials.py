@@ -92,6 +92,7 @@ def compute_partials(engine, router, req: dict) -> bytes:
     per_field = {f: list(names) for f, names in req["aggs"].items()}
     tag_expr = astjson.from_json(req.get("tag_expr"))
     field_expr = astjson.from_json(req.get("field_expr"))
+    mixed_expr = astjson.from_json(req.get("mixed_expr"))
 
     shards = engine.shards_for_range(db, rp, tmin, tmax)
     live = req.get("live")
@@ -102,13 +103,17 @@ def compute_partials(engine, router, req: dict) -> bytes:
         ]
 
     schema = {}
+    tag_keys: set[str] = set()
     for sh in shards:
         schema.update(sh.schema(mst))
+        tag_keys.update(sh.index.tag_keys(mst))
+    # peer-local SplitCondition view: classification (what is mixed) was
+    # decided by the coordinator; this only drives row evaluation here
+    sc = cond.SplitCondition(tmin, tmax, tag_expr, field_expr, mixed_expr,
+                             frozenset(tag_keys))
+    sc.mixed_series_level = bool(req.get("mixed_series_level"))
 
-    field_filter_fields = (
-        sorted(cond.field_filter_refs(field_expr)) if field_expr is not None else []
-    )
-    read_fields = sorted(set(per_field) | set(field_filter_fields))
+    read_fields = sorted(set(per_field) | cond.row_filter_refs(sc))
     dtype = templates.compute_dtype()
     batches = {
         f: pick_batch(schema, per_field[f], f, dtype) for f in per_field
@@ -121,6 +126,11 @@ def compute_partials(engine, router, req: dict) -> bytes:
     match_terms = [] if every else cond.conjunctive_match_terms(field_expr)
     for sh in shards:
         sids = cond.eval_tag_expr(tag_expr, sh.index, mst)
+        if mixed_expr is not None:
+            if sc.mixed_series_level:  # hinted: exact series-level filter
+                sids &= cond.series_only_sids(mixed_expr, sh.index, mst, tag_keys)
+            else:
+                sids &= cond.tag_superset_sids(mixed_expr, sh.index, mst, tag_keys)
         sids = _prune_text_sids(sh, mst, sids, match_terms)
         for sid in sorted(sids):
             tags = sh.index.tags_of(sid)
@@ -135,8 +145,8 @@ def compute_partials(engine, router, req: dict) -> bytes:
             if len(rec) == 0:
                 continue
             fmask = (
-                cond.eval_field_expr(field_expr, rec)
-                if field_expr is not None else None
+                cond.eval_row_filter(sc, rec, tags=tags)
+                if sc.has_row_filter else None
             )
             if every:
                 widx, _ = winmod.window_index(rec.times, tmin, every, offset)

@@ -175,6 +175,7 @@ class SelectStatement:
     tz: str = ""
     into: Measurement | None = None
     ctes: dict | None = None  # WITH name AS (...) bindings, shared by ref
+    hints: tuple = ()  # optimizer hints: /*+ full_series */ etc.
 
 
 @dataclass

@@ -73,6 +73,10 @@ class Lexer:
     def __init__(self, text: str):
         self.text = text
         self.pos = 0
+        # optimizer hints seen while skipping /*+ ... */ comments; the
+        # parser drains these per statement (reference: influxql hint pass)
+        self.hints: list[str] = []
+        self._hint_seen: set[int] = set()
 
     def peek(self, allow_regex: bool = False) -> Token:
         save = self.pos
@@ -93,9 +97,14 @@ class Lexer:
                 nl = self.text.find("\n", self.pos)
                 self.pos = n if nl < 0 else nl
             elif c == "/" and self.text[self.pos : self.pos + 2] == "/*":
-                # block comment, incl. optimizer hints /*+ ... */ (parsed
-                # and ignored; reference: influxql scanner + hint pass)
+                # block comment; /*+ ... */ records optimizer hints
+                # (peek() re-scans, so dedupe by start offset)
                 end = self.text.find("*/", self.pos + 2)
+                if (self.text[self.pos + 2 : self.pos + 3] == "+"
+                        and self.pos not in self._hint_seen):
+                    self._hint_seen.add(self.pos)
+                    body = self.text[self.pos + 3 : (n if end < 0 else end)]
+                    self.hints.extend(body.split())
                 self.pos = n if end < 0 else end + 2
             else:
                 break

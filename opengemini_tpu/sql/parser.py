@@ -160,6 +160,8 @@ class Parser:
     # -- statements ---------------------------------------------------------
 
     def parse_statement(self):
+        # hints recorded before this statement's SELECT belong to nobody
+        self.lex.hints.clear()
         tok = self.lex.peek()
         if tok.kind != "KEYWORD":
             raise ParseError(f"expected statement, got {tok.val!r}")
@@ -285,6 +287,12 @@ class Parser:
         self._expect_kw("select")
         stmt = ast.SelectStatement()
         stmt.fields = self._parse_fields()
+        # hints appear between SELECT and the field list (/*+ ... */);
+        # the lexer records them while skipping comments — drain them to
+        # THIS statement so multi-statement inputs don't leak hints
+        if self.lex.hints:
+            stmt.hints = tuple(self.lex.hints)
+            self.lex.hints.clear()
         if self._accept_kw("into"):
             stmt.into = self._parse_measurement()
         self._expect_kw("from")
@@ -316,6 +324,10 @@ class Parser:
                 raise ParseError("TZ expects a string")
             stmt.tz = tok.val
             self._expect_op(")")
+        # hints only count between SELECT and the field list; any recorded
+        # later in the statement are discarded so they can't leak into the
+        # NEXT statement of a multi-statement input
+        self.lex.hints.clear()
         return stmt
 
     def _parse_int_clause(self, kw: str) -> int:

@@ -64,14 +64,13 @@ _merge_bulk_parts = merge_bulk_parts
 
 def _sid_entries(rec: Record, uniq, starts, ends):
     """(sid, per-series Record) views over one (sid, time)-sorted bulk
-    table — the flush path's bridge from memtable tables to chunk writes."""
+    table — the flush path's bridge from memtable tables to chunk writes.
+    Column slicing + all-invalid drop shares memtable._series_slice so the
+    per-series shape (and content_digest) can never diverge by path."""
+    from opengemini_tpu.storage.memtable import _series_slice
+
     for sid, lo, hi in zip(uniq, starts, ends):
-        cols = {}
-        for name, col in rec.columns.items():
-            valid = col.valid[lo:hi]
-            if valid.any():  # fields this series never wrote stay absent
-                cols[name] = Column(col.ftype, col.values[lo:hi], valid)
-        yield int(sid), Record(rec.times[lo:hi], cols)
+        yield int(sid), _series_slice(rec, lo, hi)
 
 
 def _write_measurement_chunks(w: TSFWriter, tidx, mst: str, entries,

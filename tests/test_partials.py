@@ -77,6 +77,10 @@ QUERIES = [
     "SELECT count(v), mean(v) FROM cpu GROUP BY time(2w)",
     "SELECT max(v) FROM cpu WHERE host = 'a' GROUP BY time(4w)",
     "SELECT sum(v) FROM cpu WHERE v > 3",  # field-filter pushdown
+    # mixed tag/field trees push down too (peers re-evaluate with tag
+    # columns injected; coordinator ships mixed_expr on the wire)
+    "SELECT sum(v), count(v) FROM cpu WHERE host = 'a' OR v > 3",
+    "SELECT max(v) FROM cpu WHERE host = 'b' OR c = 4 GROUP BY host",
     "SELECT mean(v) FROM cpu GROUP BY *",
     "SELECT count(v) FROM cpu WHERE time >= {t0} AND time < {t1}",
 ]
