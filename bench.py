@@ -1,20 +1,30 @@
-"""Benchmark: masked mean/max/count GROUP BY time(1m) over a ~1B-point
-DevOps-shaped workload (BASELINE.md north star; TSBS configs #1/#2 shape).
+"""Benchmarks for ALL five BASELINE.json configs, with a staged device
+probe that records WHERE device bring-up fails (instead of silently
+falling back, the r01/r02 failure mode).
 
-Prints ONE json line:
-    {"metric": ..., "value": rows/sec, "unit": "rows/s", "vs_baseline": x}
+Prints one JSON metric line per config; the FINAL line is the primary
+north-star metric (config #1) and embeds every config plus the probe
+diagnosis, so a driver that parses only the last JSON line still gets
+the full picture.
 
-Methodology notes (the axon TPU tunnel defers execution past
-block_until_ready, and per-dispatch round-trips cost ~60ms):
+Configs (BASELINE.json):
+  1. TSBS cpu-only `mean/max/count GROUP BY time(1m)` grid kernel
+  2. TSBS double-groupby-5: mean over 5 fields GROUP BY time(1h), hostname
+  3. PromQL rate() over 10k series, 24h window
+  4. Downsample rewrite 1s->1m mean/max/min
+  5. High-cardinality colstore: 200k series topk + count_values (host e2e)
+
+Methodology (the axon TPU tunnel defers execution past block_until_ready,
+and per-dispatch round-trips cost ~60ms):
   - device work is timed with an in-graph lax.fori_loop whose body depends
     on the loop index (defeats loop-invariant hoisting), consumes every
     element of every aggregate output (defeats XLA dead-code elimination
-    of unreferenced reduction rows — consuming only [0] inflated round-1
-    numbers ~3x), and is fenced by a scalar host transfer;
+    of unreferenced reduction rows), and is fenced by a scalar host
+    transfer;
   - throughput = marginal time per iteration, least-squares over several
     loop lengths, which cancels the fixed tunnel overhead;
-  - vs_baseline = TPU rows/s over (single-core numpy rows/s of the same
-    masked computation x 16), the favorable-to-CPU stand-in for the
+  - vs_baseline = device rows/s over (single-core numpy rows/s of the
+    same computation x 16), the favorable-to-CPU stand-in for the
     reference's 16-core deployment (BASELINE.json).
 """
 
@@ -28,16 +38,16 @@ import time
 
 import numpy as np
 
-S = 4096  # series
-R = 8160  # rows per series per batch (multiple of 60)
-SPW = 60  # samples per window (1s data, 1m windows)
-W = R // SPW
+SPW = 60  # samples per window for the 1m grid (1s data)
 
 
-def _set_shapes(s: int, r: int) -> None:
-    global S, R, W
-    S, R = s, r
-    W = R // SPW
+# -- timing harness ----------------------------------------------------------
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    float(fn())  # host transfer is the only reliable fence via the tunnel
+    return time.perf_counter() - t0
 
 
 def _marginal_time(make_fn, ks=(5, 20, 50), trials=4) -> float:
@@ -57,125 +67,58 @@ def _marginal_time(make_fn, ks=(5, 20, 50), trials=4) -> float:
     return max(slope, 1e-9)
 
 
-def _timed(fn) -> float:
-    t0 = time.perf_counter()
-    float(fn())  # host transfer is the only reliable fence via the tunnel
-    return time.perf_counter() - t0
+def _consume(out, acc):
+    """Fold EVERY element of every output into acc: consuming only [0]
+    lets XLA dead-code-eliminate the other reduction rows and the
+    'throughput' becomes fiction."""
+    import jax.numpy as jnp
+
+    vals = out.values() if isinstance(out, dict) else out
+    for val in vals:
+        acc = acc + jnp.sum(val.astype(jnp.float32) * 1e-6)
+    return acc
 
 
-def bench_tpu_grid(values_t, mask_t):
-    """values_t: (S, SPW, W) — the TPU-native window-major layout the
-    executor assembles regular chunks into (ops/segment.grid_window_agg_t)."""
+# -- config #1: grid window aggregation --------------------------------------
+
+
+def bench_grid(S: int, R: int) -> float:
+    """rows/s of masked mean/max/count GROUP BY time(1m) on the
+    window-major (S, SPW, W) layout the executor assembles."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from opengemini_tpu.ops import segment as seg
+
+    W = R // SPW
+    key = jax.random.PRNGKey(0)
+    values = jax.random.normal(key, (S, W, SPW), dtype=jnp.float32) + 50.0
+    values_t = values.swapaxes(1, 2)
+    mask_t = jnp.ones((S, SPW, W), dtype=jnp.bool_)
 
     def make(k_iters):
         @jax.jit
         def run(v, m):
             def body(i, acc):
                 vv = v + i.astype(jnp.float32) * 1e-9
-                out = seg.grid_window_agg_t(vv, m)
-                # consume EVERY element of every stat: slicing [0, 0]
-                # lets XLA dead-code-eliminate all other rows of the
-                # reduction and the "throughput" becomes fiction
-                t = acc
-                for val in out.values():
-                    t = t + jnp.sum(val.astype(jnp.float32) * 1e-6)
-                return t
+                return _consume(seg.grid_window_agg_t(vv, m), acc)
             return lax.fori_loop(0, k_iters, body, 0.0)
 
         return lambda: run(values_t, mask_t)
 
-    return _marginal_time(make)
+    return S * R / _marginal_time(make)
 
 
-def bench_tpu_general(values, mask):
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    from opengemini_tpu.ops import segment as seg
-
-    seg_ids = (
-        jnp.tile(jnp.repeat(jnp.arange(W, dtype=jnp.int32), SPW)[None, :], (S, 1))
-        + (jnp.arange(S, dtype=jnp.int32) * W)[:, None]
-    ).reshape(-1)
-    v_flat = values.reshape(-1)
-    m_flat = mask.reshape(-1)
-    num_segments = S * W
-
-    def make(k_iters):
-        @jax.jit
-        def run(v, s_ids, m):
-            def body(i, acc):
-                vv = v + i.astype(jnp.float32) * 1e-9
-                s = seg.seg_sum(vv, s_ids, num_segments, m)
-                c = seg.seg_count(s_ids, num_segments, m)
-                mx = seg.seg_max(vv, s_ids, num_segments, m)
-                return (
-                    acc
-                    + jnp.sum(s * 1e-6)
-                    + jnp.sum(mx * 1e-6)
-                    + jnp.sum(c.astype(jnp.float32) * 1e-6)
-                )
-            return lax.fori_loop(0, k_iters, body, 0.0)
-
-        return lambda: run(v_flat, seg_ids, m_flat)
-
-    return _marginal_time(make, ks=(2, 6, 12), trials=3)
-
-
-def bench_tpu_ragged_dense():
-    """Device-resident throughput of the ragged->dense bucket stats kernel
-    (models/ragged.py _stats_jit) on a (G, 256) bucket — the general-path
-    compute stage once host bucketization is done."""
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    from opengemini_tpu.models.ragged import _stats_jit
-
-    G, Wd = 131072, 256  # 33.5M rows
-    key = jax.random.PRNGKey(1)
-    v = jax.random.normal(key, (G, Wd), dtype=jnp.float32)
-    hi = jnp.zeros((G, Wd), jnp.int32)
-    lo = jnp.broadcast_to(jnp.arange(Wd, dtype=jnp.int32)[None, :], (G, Wd))
-    idx = jnp.broadcast_to(jnp.arange(Wd, dtype=jnp.int32)[None, :], (G, Wd))
-    m = jnp.ones((G, Wd), jnp.bool_)
-    stats = _stats_jit("basic")  # the mean/max/count north-star group
-
-    def make(k_iters):
-        @jax.jit
-        def run(v, hi, lo, idx, m):
-            def body(i, acc):
-                out = stats(v + i.astype(jnp.float32) * 1e-9, hi, lo, idx, m)
-                # consume EVERY ELEMENT of EVERY output — consuming only
-                # element [0] lets XLA dead-code-eliminate the other rows
-                # of each reduction, not just unused stat passes
-                total = acc
-                for val in out.values():
-                    total = total + jnp.sum(val.astype(jnp.float32) * 1e-6)
-                return total
-            return lax.fori_loop(0, k_iters, body, 0.0)
-
-        return lambda: run(v, hi, lo, idx, m)
-
-    dt = _marginal_time(make, ks=(2, 6, 14), trials=3)
-    return G * Wd / dt
-
-
-def bench_cpu(mask_frac_valid=True):
+def bench_cpu_grid(R: int) -> float:
     """Single-core numpy of the same masked grid computation."""
     Sc = 512
+    W = R // SPW
     rng = np.random.default_rng(0)
     vals = (rng.standard_normal((Sc, R)) + 50.0).astype(np.float32)
     m = np.ones((Sc, R), dtype=bool)
-    reps = 3
     t_best = np.inf
-    for _ in range(reps):
+    for _ in range(3):
         t0 = time.perf_counter()
         v3 = vals.reshape(Sc, W, SPW)
         m3 = m.reshape(Sc, W, SPW)
@@ -187,16 +130,233 @@ def bench_cpu(mask_frac_valid=True):
     return Sc * R / t_best
 
 
-def bench_e2e(series: int = 500, points: int = 7200) -> dict:
-    """End-to-end ingest->query wall time (BASELINE config #1 shape).
+# -- config #2: double-groupby-5 ---------------------------------------------
 
-    Writes `series` hosts x `points` 1s-spaced samples of line protocol
-    through the real engine path (parse -> WAL -> memtable -> flush) and
-    times `SELECT mean(usage_user),max(usage_user),count(usage_user)
-    GROUP BY time(1m)` through the real executor, cold (includes XLA
-    compile + TSF decode) and warm.  Complements the device-resident
-    kernel numbers above: this is the number a user experiences, host
-    path included."""
+
+def bench_double_groupby(hosts: int, fields: int, R: int, spw: int) -> float:
+    """mean over `fields` fields GROUP BY time(1h), hostname: the grid
+    kernel over a (hosts*fields) series axis — grouping by hostname is a
+    layout property (each lane IS one (host, field) group), the TSBS
+    double-groupby-5 shape."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    W = R // spw
+    S = hosts * fields
+    key = jax.random.PRNGKey(1)
+    v = jax.random.normal(key, (S, spw, W), dtype=jnp.float32) + 10.0
+    m = jnp.ones((S, spw, W), dtype=jnp.bool_)
+
+    def make(k_iters):
+        @jax.jit
+        def run(v, m):
+            def body(i, acc):
+                vv = v + i.astype(jnp.float32) * 1e-9
+                s = jnp.where(m, vv, 0.0).sum(axis=1)
+                c = m.sum(axis=1)
+                mean = s / jnp.maximum(c, 1).astype(jnp.float32)
+                return _consume([mean], acc)
+            return lax.fori_loop(0, k_iters, body, 0.0)
+
+        return lambda: run(v, m)
+
+    return S * R / _marginal_time(make, ks=(3, 9, 18), trials=3)
+
+
+def bench_cpu_double_groupby(fields: int, R: int, spw: int) -> float:
+    hosts_c = 256
+    W = R // spw
+    S = hosts_c * fields
+    rng = np.random.default_rng(1)
+    vals = (rng.standard_normal((S, W, spw)) + 10.0).astype(np.float32)
+    m = np.ones_like(vals, dtype=bool)
+    t_best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        s = np.where(m, vals, 0.0).sum(axis=-1)
+        c = m.sum(axis=-1)
+        _ = s / np.maximum(c, 1)
+        t_best = min(t_best, time.perf_counter() - t0)
+    return S * R / t_best
+
+
+# -- config #3: PromQL rate over 10k series ----------------------------------
+
+
+def bench_prom_rate(S: int, N: int, K: int) -> float:
+    """samples/s of extrapolated_rate over (S series, N samples) for K
+    eval steps — the dense (series, step) range-vector kernel
+    (ops/prom.py; reference: prom_range_vector_cursor)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from opengemini_tpu.ops import prom as prom_ops
+
+    scrape_s = 15.0
+    times = jnp.broadcast_to(
+        jnp.arange(N, dtype=jnp.float32) * scrape_s, (S, N))
+    key = jax.random.PRNGKey(2)
+    values = jnp.cumsum(
+        jax.random.uniform(key, (S, N), dtype=jnp.float32), axis=1)
+    counts = jnp.full((S,), N, dtype=jnp.int32)
+    window_s = 300.0
+    step = (N * scrape_s) / K
+    step_ends = (jnp.arange(K, dtype=jnp.float32) + 1.0) * step
+    step_starts = step_ends - window_s
+
+    def make(k_iters):
+        @jax.jit
+        def run(t, v, c, ss, se):
+            def body(i, acc):
+                vv = v + i.astype(jnp.float32) * 1e-9
+                out, valid = prom_ops.extrapolated_rate(
+                    t, vv, c, ss, se, window_s, True, True)
+                return _consume([out, valid], acc)
+            return lax.fori_loop(0, k_iters, body, 0.0)
+
+        return lambda: run(times, values, counts, step_starts, step_ends)
+
+    dt = _marginal_time(make, ks=(3, 9, 18), trials=3)
+    return S * N / dt
+
+
+def bench_cpu_prom_rate(N: int, K: int) -> float:
+    """Single-core numpy rate: per step, searchsorted window bounds +
+    extrapolated slope (the same computation, vectorized)."""
+    S = 256
+    scrape_s = 15.0
+    times = np.arange(N, dtype=np.float64) * scrape_s
+    rng = np.random.default_rng(2)
+    values = np.cumsum(rng.random((S, N), dtype=np.float64), axis=1)
+    window_s = 300.0
+    step = (N * scrape_s) / K
+    step_ends = (np.arange(K) + 1.0) * step
+    step_starts = step_ends - window_s
+    t_best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        first = np.searchsorted(times, step_starts, "left")
+        last = np.searchsorted(times, step_ends, "right") - 1
+        ok = last > first
+        f = np.clip(first, 0, N - 1)
+        la = np.clip(last, 0, N - 1)
+        dv = values[:, la] - values[:, f]
+        dt_s = times[la] - times[f]
+        _ = np.where(ok, dv / np.maximum(dt_s, 1e-9), np.nan)
+        t_best = min(t_best, time.perf_counter() - t0)
+    return S * N / t_best
+
+
+# -- config #4: downsample rewrite -------------------------------------------
+
+
+def bench_downsample(S: int, R: int) -> float:
+    """rows/s of the 1s->1m mean/max/min downsample compute stage
+    (storage/downsample.py feeds this exact grid shape)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    W = R // SPW
+    key = jax.random.PRNGKey(3)
+    v = jax.random.normal(key, (S, SPW, W), dtype=jnp.float32) + 50.0
+    m = jnp.ones((S, SPW, W), dtype=jnp.bool_)
+
+    def make(k_iters):
+        @jax.jit
+        def run(v, m):
+            def body(i, acc):
+                vv = v + i.astype(jnp.float32) * 1e-9
+                s = jnp.where(m, vv, 0.0).sum(axis=1)
+                c = m.sum(axis=1)
+                mean = s / jnp.maximum(c, 1).astype(jnp.float32)
+                mx = jnp.where(m, vv, -jnp.inf).max(axis=1)
+                mn = jnp.where(m, vv, jnp.inf).min(axis=1)
+                return _consume([mean, mx, mn], acc)
+            return lax.fori_loop(0, k_iters, body, 0.0)
+
+        return lambda: run(v, m)
+
+    return S * R / _marginal_time(make, ks=(3, 9, 18), trials=3)
+
+
+def bench_cpu_downsample(R: int) -> float:
+    Sc = 512
+    W = R // SPW
+    rng = np.random.default_rng(3)
+    vals = (rng.standard_normal((Sc, W, SPW)) + 50.0).astype(np.float32)
+    m = np.ones_like(vals, dtype=bool)
+    t_best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        s = np.where(m, vals, 0.0).sum(axis=-1)
+        c = m.sum(axis=-1)
+        _ = s / np.maximum(c, 1)
+        _ = np.where(m, vals, -np.inf).max(axis=-1)
+        _ = np.where(m, vals, np.inf).min(axis=-1)
+        t_best = min(t_best, time.perf_counter() - t0)
+    return Sc * R / t_best
+
+
+# -- config #5: high-cardinality colstore e2e --------------------------------
+
+
+def bench_colstore(series: int) -> dict:
+    """Host e2e at high cardinality: ingest `series` distinct series (one
+    sample each), flush through the PK-packed colstore, then time
+    topk(5) and count_values instant queries cold (storage/tsf.py
+    add_packed_chunk; reference: hybrid_store_reader at 1M series)."""
+    import shutil
+    import tempfile
+
+    from opengemini_tpu.promql.engine import PromEngine
+    from opengemini_tpu.storage.engine import Engine
+
+    NS = 1_000_000_000
+    base = 1_700_000_000
+    root = tempfile.mkdtemp(prefix="ogtpu-bench5-")
+    try:
+        eng = Engine(root, sync_wal=False)
+        eng.create_database("b")
+        t0 = time.perf_counter()
+        CH = 50_000
+        for lo in range(0, series, CH):
+            hi = min(lo + CH, series)
+            lines = "\n".join(
+                f"hc,sid=s{i},grp=g{i % 97} value={i % 1000} {(base) * NS}"
+                for i in range(lo, hi)
+            )
+            eng.write_lines("b", lines)
+        t_ingest = time.perf_counter() - t0
+        eng.flush_all()
+        pe = PromEngine(eng)
+        t0 = time.perf_counter()
+        r1 = pe.query_instant("topk(5, hc)", base + 10, db="b")
+        assert len(r1["result"]) == 5, len(r1["result"])
+        t_topk = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r2 = pe.query_instant('count_values("v", hc)', base + 10, db="b")
+        assert len(r2["result"]) == 1000, len(r2["result"])
+        t_cv = time.perf_counter() - t0
+        return {
+            "series": series,
+            "ingest_new_series_per_s": round(series / t_ingest),
+            "topk_cold_s": round(t_topk, 3),
+            "count_values_cold_s": round(t_cv, 3),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# -- e2e ingest+query (config #1 host path) ----------------------------------
+
+
+def bench_e2e(series: int = 500, points: int = 7200) -> dict:
+    """End-to-end ingest->query wall time (BASELINE config #1 shape):
+    line protocol through the real engine (native columnar parse -> WAL ->
+    memtable -> flush) and the real executor, cold + warm."""
     import shutil
     import tempfile
 
@@ -211,14 +371,12 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
         eng.create_database("bench")
         rows = series * points
         t0 = time.perf_counter()
-        # batch lines per flush-friendly slab; timestamps interleaved so
-        # every batch touches every series (TSBS writer shape)
         batch = []
         for p in range(points):
             ts = (base + p) * NS
             for s in range(series):
                 batch.append(f"cpu,host=h{s} usage_user={50 + (s + p) % 50} {ts}")
-            if len(batch) >= 100_000:
+            if len(batch) >= 200_000:
                 eng.write_lines("bench", "\n".join(batch))
                 batch.clear()
         if batch:
@@ -248,21 +406,102 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
-def _arm_watchdog():
-    """A hung device tunnel must not stall the bench forever: if the whole
-    run exceeds the budget, print a diagnostic and exit non-zero WITHOUT
-    fabricating a metric line (a missing measurement is the truthful
-    result when hardware is unreachable). A THREAD, not SIGALRM: the main
-    thread may be blocked inside non-interruptible C calls (device init),
-    where a Python signal handler would never run. Returns the timer."""
-    import threading
+# -- staged device probe -----------------------------------------------------
 
-    budget_s = int(os.environ.get("OGTPU_BENCH_TIMEOUT_S", "480"))
+_PROBE_SCRIPT = r"""
+import sys, time
+def mark(s):
+    print("STAGE " + s, flush=True)
+mark("import:begin")
+t0 = time.time()
+import jax
+mark(f"import:ok {time.time()-t0:.1f}s")
+mark("backend:begin")
+t0 = time.time()
+devs = jax.devices()
+mark(f"backend:ok {time.time()-t0:.1f}s n={len(devs)} kind={devs[0].device_kind} platform={jax.default_backend()}")
+mark("transfer:begin")
+t0 = time.time()
+import jax.numpy as jnp
+x = jnp.ones((8,), jnp.float32)
+s = float(x.sum())
+assert s == 8.0, s
+mark(f"transfer:ok {time.time()-t0:.1f}s")
+mark("kernel:begin")
+t0 = time.time()
+y = jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())(jnp.ones((256, 256), jnp.bfloat16))
+assert float(y) > 0
+mark(f"kernel:ok {time.time()-t0:.1f}s")
+print("PROBE OK " + jax.default_backend(), flush=True)
+"""
+
+
+def probe_device_staged(timeout_s: float = 90.0) -> dict:
+    """Run the staged bring-up probe (import -> backend enumerate ->
+    1-element transfer -> 1-tile kernel) in a subprocess. Returns
+    {ok, backend?, stages: [...], failed_stage?, detail?}. A hang is
+    attributed to the LAST stage that began — the diagnosis r01/r02
+    never recorded."""
+    if os.environ.get("OGTPU_FORCE_CPU"):
+        return {"ok": False, "failed_stage": "forced-cpu",
+                "detail": "OGTPU_FORCE_CPU set", "stages": []}
+    import tempfile
+
+    out_path = tempfile.mktemp(prefix="ogtpu-probe-")
+    stages: list[str] = []
+    try:
+        with open(out_path, "w") as out_f:
+            proc = subprocess.Popen(
+                [sys.executable, "-c", _PROBE_SCRIPT],
+                stdout=out_f, stderr=subprocess.STDOUT,
+            )
+            try:
+                rc = proc.wait(timeout=timeout_s)
+                hung = False
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                rc = -9
+                hung = True
+        with open(out_path, errors="replace") as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        stages = [ln[6:] for ln in lines if ln.startswith("STAGE ")]
+        ok_line = next((ln for ln in lines if ln.startswith("PROBE OK")), None)
+        if rc == 0 and ok_line:
+            backend = ok_line.split()[-1]
+            return {"ok": True, "backend": backend, "stages": stages}
+        begun = [s for s in stages if s.endswith(":begin")]
+        done = {s.split(":")[0] for s in stages if ":ok" in s}
+        failed = next(
+            (s.split(":")[0] for s in begun if s.split(":")[0] not in done),
+            "unknown")
+        detail = ("hung (killed after timeout)" if hung
+                  else f"exited rc={rc}: " + " | ".join(lines[-3:]))
+        return {"ok": False, "failed_stage": failed, "detail": detail,
+                "stages": stages}
+    except OSError as e:
+        return {"ok": False, "failed_stage": "spawn", "detail": str(e),
+                "stages": stages}
+    finally:
+        try:
+            os.remove(out_path)
+        except OSError:
+            pass
+
+
+# -- orchestration -----------------------------------------------------------
+
+
+def _arm_watchdog(budget_s: int):
+    """A hung device tunnel must not stall the bench forever. A THREAD,
+    not SIGALRM: the main thread may be blocked inside non-interruptible
+    C calls (device init), where a Python signal handler never runs."""
+    import threading
 
     def fire():
         print(
             f"bench watchdog: no result within {budget_s}s — device/tunnel "
-            "unreachable or hung; no metric emitted",
+            "hung mid-bench; no metric emitted",
             file=sys.stderr,
         )
         sys.stderr.flush()
@@ -274,154 +513,183 @@ def _arm_watchdog():
     return t
 
 
-def _grid_inputs():
-    """The benchmark workload: (S, R) masked values plus the window-major
-    (S, SPW, W) transposed layout the executor assembles regular chunks
-    into. Shared by the device bench and the CPU smoke so both measure the
-    same computation."""
-    import jax
-    import jax.numpy as jnp
+def _emit(metric: str, value, unit: str, vs_baseline, extra: dict | None = None):
+    doc = {"metric": metric, "value": value, "unit": unit,
+           "vs_baseline": vs_baseline}
+    if extra:
+        doc.update(extra)
+    print(json.dumps(doc), flush=True)
+    return doc
 
-    key = jax.random.PRNGKey(0)
-    values = jax.random.normal(key, (S, R), dtype=jnp.float32) + 50.0
-    mask = jnp.ones((S, R), dtype=jnp.bool_)
-    values_t = values.reshape(S, W, SPW).swapaxes(1, 2)
-    mask_t = jnp.ones((S, SPW, W), dtype=jnp.bool_)
-    return values, mask, values_t, mask_t
+
+def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
+    """Run configs #1-#5 and print one metric line each + the primary
+    summary line. `device=False` runs reduced shapes on the jax CPU
+    backend, explicitly suffixed _cpu_smoke."""
+    suffix = "" if device else "_cpu_smoke"
+    note = None if device else (
+        "device unreachable (see probe); jax-CPU smoke at reduced shape")
+    configs: dict[str, dict] = {}
+
+    # config #1: grid
+    S, R = (4096, 8160) if device else (512, 2040)
+    rows_grid = bench_grid(S, R)
+    cpu16_grid = bench_cpu_grid(R) * 16
+    vs1 = round(rows_grid / cpu16_grid, 3)
+    configs["1_groupby_time_1m"] = _emit(
+        f"groupby_time_1m_mean_max_count_rows_per_sec{suffix}",
+        round(rows_grid), "rows/s", vs1)
+
+    # config #2: double-groupby-5
+    hosts, fields, R2, spw2 = (4000, 5, 8640, 360) if device else (256, 5, 1440, 360)
+    rows_dg = bench_double_groupby(hosts, fields, R2, spw2)
+    vs2 = round(rows_dg / (bench_cpu_double_groupby(fields, R2, spw2) * 16), 3)
+    configs["2_double_groupby_5"] = _emit(
+        f"double_groupby5_mean_rows_per_sec{suffix}",
+        round(rows_dg), "rows/s", vs2)
+
+    # config #3: prom rate 10k series 24h
+    S3, N3, K3 = (10_000, 5760, 96) if device else (512, 1440, 24)
+    sps = bench_prom_rate(S3, N3, K3)
+    vs3 = round(sps / (bench_cpu_prom_rate(N3, K3) * 16), 3)
+    configs["3_prom_rate_10k"] = _emit(
+        f"prom_rate_10k_series_samples_per_sec{suffix}",
+        round(sps), "samples/s", vs3)
+
+    # config #4: downsample rewrite
+    S4, R4 = (4096, 8640) if device else (512, 2160)
+    rows_ds = bench_downsample(S4, R4)
+    vs4 = round(rows_ds / (bench_cpu_downsample(R4) * 16), 3)
+    configs["4_downsample_1s_1m"] = _emit(
+        f"downsample_1s_to_1m_rows_per_sec{suffix}",
+        round(rows_ds), "rows/s", vs4)
+
+    # configs #5 and e2e below are HOST-bound: disarm the device watchdog
+    # first — a slow host must not be misreported as a hung device/tunnel
+    # (the device configs above already printed their metric lines)
+    if watchdog is not None:
+        watchdog.cancel()
+
+    # config #5: colstore high-cardinality e2e (host path either way)
+    n5 = int(os.environ.get(
+        "OGTPU_BENCH_HC_SERIES", "200000" if device else "50000"))
+    hc = bench_colstore(n5)
+    # baseline: the round-2 pre-colstore measurement at 200k (16.2 s topk)
+    base_topk = 16.2 * (n5 / 200_000)
+    vs5 = round(base_topk / max(hc["topk_cold_s"], 1e-9), 3)
+    configs["5_colstore_200k"] = _emit(
+        f"colstore_hc_topk_cold_seconds{suffix}",
+        hc["topk_cold_s"], "s", vs5, {"detail": hc})
+
+    # e2e host path (config #1 shape)
+    e2e = bench_e2e(
+        series=int(os.environ.get("OGTPU_BENCH_E2E_SERIES", "200")),
+        points=int(os.environ.get("OGTPU_BENCH_E2E_POINTS",
+                                  "7200" if device else "1200")),
+    )
+
+    extra = {"configs": configs, "probe": probe, "e2e_ingest_query": e2e}
+    if note:
+        extra["note"] = note
+    _emit(
+        f"groupby_time_1m_mean_max_count_rows_per_sec{suffix}",
+        round(rows_grid), "rows/s", vs1, extra)
 
 
 def _device_main() -> None:
-    """The real device benchmark. Runs in a CHILD process (see main) so a
-    hung tunnel can be killed from outside; keeps its own watchdog as a
-    second belt so it self-reports before the parent's timeout."""
-    watchdog = _arm_watchdog()
+    budget = int(os.environ.get("OGTPU_BENCH_TIMEOUT_S", "420"))
+    watchdog = _arm_watchdog(budget)
     import jax
 
-    print(f"backend: {jax.default_backend()} device: {jax.devices()[0]}", file=sys.stderr)
-    values, mask, values_t, mask_t = _grid_inputs()
-
-    t_grid = bench_tpu_grid(values_t, mask_t)
-    rows_grid = S * R / t_grid
-    rows_ragged = bench_tpu_ragged_dense()
-    t_gen = bench_tpu_general(values, mask)
-    rows_gen = S * R / t_gen
-    rows_cpu = bench_cpu()
-    cpu16 = rows_cpu * 16
-    # disarm once device work is done: the watchdog exists to catch a hung
-    # tunnel, and e2e below is host-bound — a slow host must not be
-    # misreported as "device unreachable" (it is still bounded by the
-    # parent's subprocess timeout)
+    print(f"backend: {jax.default_backend()} device: {jax.devices()[0]}",
+          file=sys.stderr)
+    probe = json.loads(os.environ.get("OGTPU_BENCH_PROBE", "{}"))
+    _run_configs(device=True, probe=probe, watchdog=watchdog)
     watchdog.cancel()
-    e2e = bench_e2e(
-        series=int(os.environ.get("OGTPU_BENCH_E2E_SERIES", "200")),
-        points=int(os.environ.get("OGTPU_BENCH_E2E_POINTS", "7200")),
-    )
-
-    vs_baseline = rows_grid / cpu16
-    print(
-        f"grid path: {rows_grid/1e9:.2f} G rows/s ({t_grid*1e3:.2f} ms / {S*R/1e6:.1f}M rows); "
-        f"ragged dense buckets (count/sum/mean/min/max/ssd): {rows_ragged/1e9:.2f} G rows/s; "
-        f"xla scatter (for reference): {rows_gen/1e9:.2f} G rows/s; "
-        f"cpu 1-core: {rows_cpu/1e9:.3f} G rows/s (x16 = {cpu16/1e9:.2f}); "
-        f"e2e: {e2e}",
-        file=sys.stderr,
-    )
-    print(
-        json.dumps(
-            {
-                "metric": "groupby_time_1m_mean_max_count_rows_per_sec",
-                "value": round(rows_grid),
-                "unit": "rows/s",
-                "vs_baseline": round(vs_baseline, 3),
-                "e2e_ingest_query": e2e,
-            }
-        )
-    )
 
 
-def _cpu_smoke() -> None:
-    """Fallback when the device tunnel is dead: run the same masked grid
-    computation on the jax CPU backend at reduced shape and emit a metric
-    explicitly labeled as a CPU smoke number. A missing measurement used
-    to be the round-1 behavior; an honestly-labeled small number carries
-    strictly more information (pipeline works end-to-end, hardware absent)."""
-    _set_shapes(512, 2040)
+def _cpu_smoke(probe: dict) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-
     print(f"cpu-smoke backend: {jax.default_backend()}", file=sys.stderr)
-    _, _, values_t, mask_t = _grid_inputs()
-    t_grid = bench_tpu_grid(values_t, mask_t)
-    rows_grid = S * R / t_grid
-    rows_cpu = bench_cpu()
-    cpu16 = rows_cpu * 16
-    e2e = bench_e2e(series=100, points=1200)
-    print(
-        f"cpu-smoke grid: {rows_grid/1e9:.3f} G rows/s; numpy 1-core: "
-        f"{rows_cpu/1e9:.3f} G rows/s; e2e: {e2e}",
-        file=sys.stderr,
-    )
-    print(
-        json.dumps(
-            {
-                "metric": "groupby_time_1m_mean_max_count_rows_per_sec_cpu_smoke",
-                "value": round(rows_grid),
-                "unit": "rows/s",
-                "vs_baseline": round(rows_grid / cpu16, 3),
-                "note": "device backend unreachable; jax-CPU smoke at reduced shape",
-                "e2e_ingest_query": e2e,
-            }
-        )
-    )
+    _run_configs(device=False, probe=probe)
 
 
 def main() -> None:
     if "--device-child" in sys.argv:
         _device_main()
         return
+    if "--probe-only" in sys.argv:
+        print(json.dumps(probe_device_staged()))
+        return
     if os.environ.get("OGTPU_BENCH_CPU"):
-        _cpu_smoke()
+        _cpu_smoke({"ok": False, "failed_stage": "skipped",
+                    "detail": "OGTPU_BENCH_CPU set", "stages": []})
         return
 
-    from __graft_entry__ import _probe_default_backend
+    # Budget layout (default 900s total): up to 3 staged probes (90s each,
+    # retried across the window — a tunnel that comes up late still gets a
+    # device run), device child <= 420s, CPU smoke ~240s.
+    total_budget = int(os.environ.get("OGTPU_BENCH_TOTAL_S", "900"))
+    t_start = time.time()
+    probe: dict = {}
+    attempts = []
+    for attempt in range(3):
+        probe = probe_device_staged(
+            timeout_s=float(os.environ.get("OGTPU_PROBE_TIMEOUT_S", "90")))
+        attempts.append({k: probe.get(k) for k in
+                         ("ok", "failed_stage", "detail")})
+        if probe.get("ok"):
+            break
+        if time.time() - t_start > total_budget * 0.4:
+            break
+        time.sleep(10)
+    probe["attempts"] = attempts
 
-    # Budget layout (worst case ~8 min total): probe <=60s, device child
-    # <=OGTPU_BENCH_TIMEOUT_S (default 300s), CPU smoke ~90s. The child's
-    # in-process watchdog is armed 20s under the parent timeout so it
-    # self-reports before being killed.
-    budget_s = int(os.environ.get("OGTPU_BENCH_TIMEOUT_S", "300"))
-    if _probe_default_backend(timeout_s=60) >= 1:
-        env = dict(os.environ, OGTPU_BENCH_TIMEOUT_S=str(max(budget_s - 20, 30)))
+    if probe.get("ok"):
+        child_budget = int(os.environ.get("OGTPU_BENCH_TIMEOUT_S", "420"))
+        env = dict(os.environ, OGTPU_BENCH_PROBE=json.dumps(
+            {k: probe.get(k) for k in ("ok", "backend", "stages", "attempts")}))
         try:
+            # parent timeout: device budget + generous host-phase allowance
+            # (the child disarms its device watchdog before the host-bound
+            # configs; killing it there would discard valid device metrics)
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--device-child"],
-                capture_output=True, text=True, timeout=budget_s, env=env,
+                capture_output=True, text=True, timeout=child_budget + 420,
+                env=env,
             )
         except subprocess.TimeoutExpired as e:
             for stream in (e.stdout, e.stderr):
                 if stream:
                     sys.stderr.write(stream if isinstance(stream, str) else stream.decode())
-            sys.stderr.write("bench: device child exceeded budget; falling back to CPU smoke\n")
+            sys.stderr.write("bench: device child exceeded budget; CPU smoke\n")
+            probe["ok"] = False
+            probe["failed_stage"] = "bench-run"
+            probe["detail"] = "probe passed but full bench hung/overran"
         else:
             if r.stderr:
                 sys.stderr.write(r.stderr)
-            if r.returncode == 0:
-                for line in reversed(r.stdout.strip().splitlines()):
-                    try:
-                        parsed = json.loads(line)
-                    except ValueError:
-                        continue
-                    if isinstance(parsed, dict) and "metric" in parsed:
-                        print(line)
-                        return
+            metric_lines = []
+            for line in r.stdout.strip().splitlines():
+                try:
+                    parsed = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    metric_lines.append(line)
+            if r.returncode == 0 and metric_lines:
+                for line in metric_lines:
+                    print(line)
+                return
             sys.stderr.write(
-                f"bench: device child rc={r.returncode} without a metric line; "
-                "falling back to CPU smoke\n"
-            )
-    else:
-        sys.stderr.write("bench: device backend probe failed; CPU smoke\n")
-    _cpu_smoke()
+                f"bench: device child rc={r.returncode} without metrics; "
+                "CPU smoke\n")
+            probe["ok"] = False
+            probe["failed_stage"] = "bench-run"
+            probe["detail"] = f"device child rc={r.returncode}"
+    _cpu_smoke(probe)
 
 
 if __name__ == "__main__":
