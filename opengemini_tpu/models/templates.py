@@ -20,6 +20,7 @@ import numpy as np
 
 from opengemini_tpu.ops import window as winmod
 from opengemini_tpu.ops.aggregates import AggSpec
+from opengemini_tpu.utils.stats import GLOBAL as _STATS
 
 _REL_LO_BITS = 30
 _REL_LO_MASK = (1 << _REL_LO_BITS) - 1
@@ -31,12 +32,19 @@ def compute_dtype() -> np.dtype:
 
 
 @functools.lru_cache(maxsize=512)
-def _jitted(fn, num_segments: int, params: tuple):
+def _jitted_build(fn, num_segments: int, params: tuple):
+    _STATS.incr("device", "compile_cache_misses")
+
     @jax.jit
     def run(values, rel_hi, rel_lo, seg_ids, mask):
         return fn(values, rel_hi, rel_lo, seg_ids, num_segments, mask, *params)
 
     return run
+
+
+def _jitted(fn, num_segments: int, params: tuple):
+    _STATS.incr("device", "jit_lookups")  # hits = lookups - misses
+    return _jitted_build(fn, num_segments, params)
 
 
 def _count_fn(values, rel_hi, rel_lo, seg_ids, num_segments, mask):
@@ -100,12 +108,47 @@ class AggBatch:
             mask[off : off + k] = m
             off += k
         self._padded = (values, rel_hi, rel_lo, seg_ids, mask)
+        _STATS.incr("device", "h2d_bytes",
+                    sum(a.nbytes for a in self._padded))
         return self._padded
 
     def host_times(self) -> np.ndarray:
         return (
             np.concatenate(self.times_ns) if self.times_ns else np.empty(0, np.int64)
         )
+
+    def host_value_multiset(self, num_segments: int):
+        """Per-segment (value, count) multiset of the batch's masked rows:
+        (values f64, counts i64, offsets i64[num_segments+1]), values
+        sorted ascending within each segment. EXACTLY mergeable across
+        nodes — rank-based aggregates (percentile/median/count_distinct)
+        recompute losslessly from merged multisets, so distributed
+        pushdown ships O(groups x distinct) instead of raw columns
+        (reference: the hash-exchange distribution of rank aggs,
+        engine/executor agg transforms)."""
+        if not self.values:
+            return (np.empty(0, np.float64), np.empty(0, np.int64),
+                    np.zeros(num_segments + 1, np.int64))
+        v = np.concatenate(
+            [np.asarray(x, np.float64) for x in self.values])
+        s = np.concatenate(
+            [np.asarray(x, np.int64) for x in self.seg_ids])
+        m = np.concatenate([x for x in self.mask])
+        keep = m & (s >= 0) & (s < num_segments)
+        v, s = v[keep], s[keep]
+        if len(v) == 0:
+            return (v, np.empty(0, np.int64),
+                    np.zeros(num_segments + 1, np.int64))
+        order = np.lexsort((v, s))
+        v, s = v[order], s[order]
+        new = np.empty(len(v), np.bool_)
+        new[0] = True
+        new[1:] = (s[1:] != s[:-1]) | (v[1:] != v[:-1])
+        starts = np.flatnonzero(new)
+        counts = np.diff(np.append(starts, len(v)))
+        v_u, s_u = v[starts], s[starts]
+        offs = np.searchsorted(s_u, np.arange(num_segments + 1))
+        return v_u, counts.astype(np.int64), offs.astype(np.int64)
 
     def counts(self, num_segments: int) -> np.ndarray:
         """Per-segment valid-row counts (cached per batch — every aggregate
@@ -138,6 +181,7 @@ class AggBatch:
         seg_pad = winmod.pad_to(max(num_segments, 1), 256)
         arrays = self._concat_padded()
         fn = _jitted(spec.fn, seg_pad, tuple(params))
+        _STATS.incr("device", "kernel_launches")
         out, sel = fn(*arrays)
         out_np = np.asarray(out)[:num_segments]
         sel_np = np.asarray(sel)[:num_segments] if sel is not None else None

@@ -1647,7 +1647,11 @@ class Executor:
             # getattr: duck-typed router stubs without the full surface
             # keep the raw column-exchange path
             and getattr(self.router, "has_peers", lambda: False)()
-            and all(spec.name in pmod.MERGEABLE for _c, spec, _p, _f in aggs)
+            and all(
+                spec.name in pmod.MERGEABLE
+                or spec.name in pmod.MULTISET_MERGEABLE
+                for _c, spec, _p, _f in aggs
+            )
             and not any(f.lower() == "time" for _c, _s, _p, f in aggs)
         )
         attempts = max(self.router.rf, 1) if pushdown else 1

@@ -37,6 +37,12 @@ MERGEABLE = {
     "count", "sum", "mean", "min", "max", "first", "last", "spread", "stddev",
 }
 
+# rank-based aggregates: not mergeable from FIXED-SIZE partials, but
+# exactly mergeable from per-segment (value, count) multisets — peers ship
+# O(groups x distinct-values) instead of raw columns (reference
+# distributes these via hash exchange; here the multiset IS the exchange)
+MULTISET_MERGEABLE = {"median", "percentile", "count_distinct"}
+
 # partial arrays required per requested aggregate
 _REQUIRES = {
     "count": (),
@@ -48,6 +54,10 @@ _REQUIRES = {
     "last": ("last",),
     "spread": ("min", "max"),
     "stddev": ("mean", "m2"),
+    # the ragged multiset trio travels as mvals/mcnts/moffs on the wire
+    "median": ("mset",),
+    "percentile": ("mset",),
+    "count_distinct": ("mset",),
 }
 
 _BIG = np.int64(2**62)
@@ -194,6 +204,11 @@ def compute_partials(engine, router, req: dict) -> bytes:
                 arrs["m2"] = np.asarray(sd, np.float64) ** 2 * np.maximum(
                     c - 1, 0
                 )
+            elif p == "mset":
+                mv, mc, mo = batch.host_value_multiset(n_seg)
+                arrs["mvals"] = mv
+                arrs["mcnts"] = mc
+                arrs["moffs"] = mo
         if counts is None:
             _o, _s, counts = run("count")
         arrs.setdefault("count", np.asarray(counts, np.int64))
@@ -201,8 +216,15 @@ def compute_partials(engine, router, req: dict) -> bytes:
 
     ngroups = len(group_keys)
     if ngroups * W != n_seg:  # zero local groups: ship empty arrays
+        def _slice(p, a):
+            if p == "moffs":
+                return a[: ngroups * W + 1]  # offsets carry one extra slot
+            if p in ("mvals", "mcnts"):
+                return a  # already empty with zero groups
+            return a[: ngroups * W]
+
         fields_out = {
-            f: {p: a[: ngroups * W] for p, a in arrs.items()}
+            f: {p: _slice(p, a) for p, a in arrs.items()}
             for f, arrs in fields_out.items()
         }
     return serialize_partials(group_tag_dicts, fields_out, ngroups, W)
@@ -316,6 +338,18 @@ def merge_remote_partials(
         ]
 
     for call, spec, params, fname in aggs:
+        if spec.name in MULTISET_MERGEABLE:
+            entry = agg_results[id(call)]
+            l_counts = entry[2]
+            pc = peer_counts(fname)
+            total_counts = expand(l_counts) + sum(pc)
+            out = _merge_multiset(
+                spec, params, entry, batches[fname], l_counts, fname,
+                peer_docs, peer_maps, n_seg,
+            )
+            agg_results[id(call)] = (
+                out, None, total_counts, spec, fname, None)
+            continue
         if spec.name not in MERGEABLE:
             continue
         entry = agg_results[id(call)]
@@ -382,6 +416,70 @@ def merge_remote_partials(
             continue
 
         agg_results[id(call)] = (out, None, total_counts, spec, fname, times_abs)
+
+
+def _merge_multiset(spec, params, entry, batch, l_counts, fname, peer_docs,
+                    peer_maps, n_seg):
+    """Exact cluster-wide rank aggregate from per-segment (value, count)
+    multisets: local batch rows + every peer's shipped trio, combined and
+    rank-selected with the SAME semantics as the device kernels
+    (ops/segment.py seg_percentile nearest-rank, seg_median two-middle
+    mean, seg_count_distinct)."""
+    n_local = len(l_counts)
+    lv, lc, loffs = batch.host_value_multiset(n_local)
+    segs_all = [np.repeat(np.arange(n_local, dtype=np.int64),
+                          np.diff(loffs))]
+    vals_all = [lv]
+    cnts_all = [lc]
+    for i, doc in enumerate(peer_docs):
+        arrs = doc["fields"].get(fname) or {}
+        if "mvals" not in arrs or not len(peer_maps[i]):
+            continue
+        offs = np.asarray(arrs["moffs"], np.int64)
+        pv = np.asarray(arrs["mvals"], np.float64)
+        pcn = np.asarray(arrs["mcnts"], np.int64)
+        per_seg = np.diff(offs)
+        local_seg = np.repeat(np.arange(len(per_seg), dtype=np.int64), per_seg)
+        segs_all.append(peer_maps[i][local_seg])
+        vals_all.append(pv)
+        cnts_all.append(pcn)
+    seg = np.concatenate(segs_all)
+    val = np.concatenate(vals_all)
+    cnt = np.concatenate(cnts_all)
+    if len(seg) == 0:
+        dtype = np.int64 if spec.int_output else np.float64
+        return np.zeros(n_seg, dtype)
+    order = np.lexsort((val, seg))
+    seg, val, cnt = seg[order], val[order], cnt[order]
+    totals = np.bincount(seg, weights=cnt, minlength=n_seg).astype(np.int64)
+
+    if spec.name == "count_distinct":
+        head = np.empty(len(seg), np.bool_)
+        head[0] = True
+        head[1:] = (seg[1:] != seg[:-1]) | (val[1:] != val[:-1])
+        return np.bincount(seg[head], minlength=n_seg).astype(np.int64)
+
+    csum = np.cumsum(cnt)
+    first_run = np.searchsorted(seg, np.arange(n_seg), "left")
+    base = np.where(first_run > 0, csum[np.maximum(first_run, 1) - 1], 0)
+
+    def value_at_rank(rank):
+        """rank is 1-indexed within each segment."""
+        target = base + np.clip(rank, 1, np.maximum(totals, 1))
+        idx = np.searchsorted(csum, target, "left")
+        return val[np.clip(idx, 0, len(val) - 1)]
+
+    if spec.name == "percentile":
+        q = float(params[0]) if params else 50.0
+        rank = np.ceil(q / 100.0 * totals).astype(np.int64)
+        out = value_at_rank(rank)
+    else:  # median: mean of the two middle values
+        lo = value_at_rank((totals - 1) // 2 + 1)
+        hi = value_at_rank(totals // 2 + 1)
+        out = (lo + hi) / 2.0
+    if np.asarray(entry[0]).dtype.kind in "iu" and spec.name == "percentile":
+        out = np.rint(out).astype(np.int64)
+    return np.where(totals > 0, out, 0.0 if out.dtype.kind == "f" else 0)
 
 
 def _local_selector(batch, spec_name, n_local):

@@ -24,6 +24,8 @@ from opengemini_tpu.utils.failpoint import inject as _fp
 import struct
 import zlib
 
+from opengemini_tpu.utils.stats import GLOBAL as _STATS
+
 from opengemini_tpu.record import FieldType
 
 _KIND_RAW_LINES = 1
@@ -45,6 +47,8 @@ class WAL:
             struct.pack("<BQ", len(prec), now_ns) + prec + zlib.compress(lines, 1)
         )
         crc = zlib.crc32(payload)
+        _STATS.incr("wal", "appends")
+        _STATS.incr("wal", "bytes", _HEADER.size + len(payload))
         self._f.write(_HEADER.pack(len(payload), crc, _KIND_RAW_LINES) + payload)
         if self.sync:
             self._f.flush()
@@ -60,6 +64,8 @@ class WAL:
         ]
         payload = zlib.compress(json.dumps(doc).encode("utf-8"), 1)
         crc = zlib.crc32(payload)
+        _STATS.incr("wal", "appends")
+        _STATS.incr("wal", "bytes", _HEADER.size + len(payload))
         self._f.write(_HEADER.pack(len(payload), crc, _KIND_POINTS) + payload)
         if self.sync:
             self._f.flush()
@@ -76,6 +82,7 @@ class WAL:
     def truncate(self) -> None:
         """Called after a successful memtable flush: logged data is now in
         immutable files (reference commitSnapshot, engine/shard.go:1008)."""
+        _STATS.incr("wal", "truncates")
         self._f.close()
         self._f = open(self.path, "wb")
         self._f.flush()
