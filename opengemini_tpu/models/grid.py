@@ -1,0 +1,393 @@
+"""Regular-grid dense batch: production wiring for the windows-on-lanes
+fast path (ops/segment.py grid_window_agg_t).
+
+TSBS-shaped data — every series sampled on a constant stride — lets
+windowed aggregation skip segment machinery entirely: place samples into
+a dense (series_run, samples_per_window, num_windows) grid and every
+per-window statistic is one sublane-axis reduce (measured 132-290 G
+rows/s on v5e-1 vs 62-79 G for the bucketed layout; bench.py config #1).
+The reference reaches its regular fast path through pre-aggregation
+metadata + the interval cursor (engine/immutable/pre_aggregation.go:40,
+engine/aggregate_cursor.go:343); here regularity is detected per scan and
+the grid is assembled directly from the scanned chunks.
+
+GridBatch is SPECULATIVE: add() accumulates raw rows exactly like
+BucketedBatch; the first run() checks regularity (one global stride that
+divides the window, per-series-run constant spacing, bounded density
+waste) and either assembles the grid or silently delegates to a
+BucketedBatch built from the same rows. Wrong results are impossible —
+only the layout changes. The executor's stats counters record which path
+engaged (executor/grid_batches vs executor/grid_fallbacks).
+
+Contract is the AggBatch/BucketedBatch contract: add(values, rel_ns,
+seg_ids, mask, times_ns, sids=...) + run(spec, num_segments, params) ->
+(values, sel|None, counts), where sel indexes the batch's host_times()
+row order (selector timestamp resolution is unchanged).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from opengemini_tpu.models import ragged, templates
+from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+# aggregates the grid path serves; others never get routed here
+GRID_AGGS = {"count", "sum", "mean", "min", "max", "spread", "stddev",
+             "first", "last"}
+
+_MIN_S = 8
+_MIN_W = 8
+# hard cap on grid slots (~0.9 GB f64+mask+idx at 2^26) and max slots per
+# scanned row (sparse series would explode the dense grid)
+_MAX_GRID_CELLS = 1 << 26
+_MAX_EXPANSION = 8
+# samples-per-window above this would make (S, k, W) degenerate (one
+# giant sublane axis); bucketed split rows handle it better
+_MAX_K = 8192
+
+
+class GridBatch:
+    def __init__(self, dtype, W: int, every_ns: int):
+        self.dtype = dtype or templates.compute_dtype()
+        self.W = int(W)
+        self.every_ns = int(every_ns)
+        self._vals: list[np.ndarray] = []
+        self._rel: list[np.ndarray] = []
+        self._seg: list[np.ndarray] = []
+        self._mask: list[np.ndarray] = []
+        self._times: list[np.ndarray] = []
+        self._sids: list[np.ndarray | None] = []
+        self.n = 0
+        self._state = None  # grid state dict after a successful freeze
+        self._fallback = None  # BucketedBatch when the grid refuses
+        self._raw: dict = {}  # lazy per-(row, window) device stats
+
+    def add(self, values, rel_ns, seg_ids, mask, times_ns, sids=None):
+        self._vals.append(np.asarray(values, dtype=self.dtype))
+        self._rel.append(np.asarray(rel_ns, dtype=np.int64))
+        self._seg.append(np.asarray(seg_ids, dtype=np.int64))
+        self._mask.append(np.asarray(mask, dtype=np.bool_))
+        self._times.append(np.asarray(times_ns, dtype=np.int64))
+        if sids is None:
+            self._sids.append(None)
+        elif np.isscalar(sids):
+            self._sids.append(
+                np.full(len(self._vals[-1]), sids, dtype=np.int64))
+        else:
+            self._sids.append(np.asarray(sids, dtype=np.int64))
+        self.n += len(self._vals[-1])
+
+    def host_times(self) -> np.ndarray:
+        return (np.concatenate(self._times) if self._times
+                else np.empty(0, np.int64))
+
+    def host_value_multiset(self, num_segments: int):
+        """Rank-aggregate multisets never route to the grid path locally,
+        but the distributed merge may ask any batch for them."""
+        self._ensure_fallback()
+        return self._fallback.host_value_multiset(num_segments)
+
+    # -- freeze ----------------------------------------------------------
+
+    def _ensure_fallback(self):
+        if self._fallback is None:
+            fb = ragged.BucketedBatch(self.dtype)
+            for v, r, s, m, t in zip(self._vals, self._rel, self._seg,
+                                     self._mask, self._times):
+                fb.add(v, r, s, m, t)
+            self._fallback = fb
+
+    def _freeze(self, num_segments: int):
+        """Returns the grid state dict, or None (delegate to bucketed)."""
+        if self._state is not None or self._fallback is not None:
+            return self._state
+        state = self._try_grid(num_segments)
+        if state is None:
+            STATS.incr("executor", "grid_fallbacks")
+            self._ensure_fallback()
+        else:
+            STATS.incr("executor", "grid_batches")
+            self._state = state
+        return self._state
+
+    def _try_grid(self, num_segments: int):
+        W = self.W
+        if self.n == 0 or W < 1 or num_segments % W:
+            return None
+        if any(s is None for s in self._sids):
+            return None  # no series identity: cannot prove no slot clash
+        rel = np.concatenate(self._rel)
+        seg = np.concatenate(self._seg)
+        sid = np.concatenate(self._sids)
+        n = len(rel)
+        # series runs: sid change or chunk boundary (the same series split
+        # across shards/chunks gets separate rows — a run is only required
+        # to be internally constant-stride)
+        boundary = np.zeros(n, dtype=np.bool_)
+        boundary[0] = True
+        boundary[1:] = sid[1:] != sid[:-1]
+        off = 0
+        for v in self._vals[:-1]:
+            off += len(v)
+            boundary[off] = True
+        d = np.diff(rel)
+        inner = ~boundary[1:]
+        dd = d[inner]
+        if len(dd) and int(dd.min()) <= 0:
+            return None  # duplicate/unsorted times within a run
+        # dt = gcd(all within-run diffs, window) — every within-run diff is
+        # then a positive multiple of dt and every run's times share one
+        # residue class mod dt, so (window, (rel - w*every)//dt) is
+        # injective per run: gaps and per-series phase shifts grid fine,
+        # they just leave masked-off slots. All-singleton runs (one sample
+        # per series) degenerate to k=1.
+        dt = int(np.gcd(np.gcd.reduce(dd), self.every_ns)) if len(dd) \
+            else self.every_ns
+        if dt <= 0 or self.every_ns % dt:
+            return None
+        k = self.every_ns // dt
+        if k > _MAX_K:
+            return None
+        bnd_idx = np.flatnonzero(boundary)
+        S = len(bnd_idx)
+        cells = S * k * W
+        if cells > _MAX_GRID_CELLS or cells > max(_MAX_EXPANSION * n, 1 << 20):
+            return None
+        w = seg % W
+        r = (rel - w * self.every_ns) // dt
+        if (r < 0).any() or (r >= k).any():
+            return None  # window grid misaligned with the stride grid
+        rid = np.cumsum(boundary) - 1
+        S_pad = _pow2_at_least(S, _MIN_S)
+        W_pad = _pow2_at_least(W, _MIN_W)
+        vals = np.concatenate(self._vals)
+        mask = np.concatenate(self._mask)
+        vt = np.zeros((S_pad, k, W_pad), dtype=self.dtype)
+        mt = np.zeros((S_pad, k, W_pad), dtype=np.bool_)
+        imat = np.zeros((S_pad, k, W_pad), dtype=np.int32)
+        flat = (rid * k + r) * W_pad + w
+        vt.reshape(-1)[flat] = vals
+        mt.reshape(-1)[flat] = mask
+        imat.reshape(-1)[flat] = np.arange(n, dtype=np.int32)
+        run_gid = (seg[bnd_idx] // W).astype(np.int64)
+        order = np.argsort(run_gid, kind="stable")
+        sg = run_gid[order]
+        gb = np.empty(S, dtype=np.bool_)
+        gb[0] = True
+        gb[1:] = sg[1:] != sg[:-1]
+        starts = np.flatnonzero(gb)
+        return {
+            "k": k, "S": S, "W_pad": W_pad,
+            "arrays": (vt, mt, imat),
+            "rel": rel,
+            "row_order": order,  # grid rows sorted by gid
+            "gid_starts": starts,  # reduceat starts in row_order
+            "gids_present": sg[starts],
+            "rows_per_gid": np.diff(np.append(starts, S)),
+        }
+
+    # -- execution -------------------------------------------------------
+
+    def run(self, spec, num_segments: int, params: tuple = ()):
+        st = self._freeze(num_segments)
+        if st is None:
+            return self._fallback.run(spec, num_segments, params)
+        name = spec.name
+        if name not in GRID_AGGS:
+            self._ensure_fallback()
+            return self._fallback.run(spec, num_segments, params)
+        G = num_segments // self.W
+        raw = self._raw_stats(
+            need_ssd=(name == "stddev"),
+            need_selectors=name in ("min", "max", "first", "last"),
+        )
+        order, starts = st["row_order"], st["gid_starts"]
+        gids, W = st["gids_present"], self.W
+
+        cnt_rows = raw["count"][order].astype(np.int64)
+        cnt_g = np.add.reduceat(cnt_rows, starts, axis=0)
+        counts = np.zeros(num_segments, dtype=np.int64)
+        counts.reshape(G, W)[gids] = cnt_g
+
+        out = np.zeros(num_segments, dtype=np.float64)
+        out2d = out.reshape(G, W)
+        sel = None
+        if name == "count":
+            out2d[gids] = cnt_g
+        elif name == "sum":
+            out2d[gids] = np.add.reduceat(raw["sum"][order], starts, axis=0)
+        elif name == "mean":
+            s = np.add.reduceat(raw["sum"][order], starts, axis=0)
+            out2d[gids] = s / np.maximum(cnt_g, 1)
+        elif name == "min":
+            out2d[gids] = np.minimum.reduceat(raw["min"][order], starts, axis=0)
+            sel = self._combine_value_selector(st, raw, "min", num_segments)
+        elif name == "max":
+            out2d[gids] = np.maximum.reduceat(raw["max"][order], starts, axis=0)
+            sel = self._combine_value_selector(st, raw, "max", num_segments)
+        elif name == "spread":
+            mn = np.minimum.reduceat(raw["min"][order], starts, axis=0)
+            mx = np.maximum.reduceat(raw["max"][order], starts, axis=0)
+            out2d[gids] = mx - mn
+        elif name == "stddev":
+            s = np.add.reduceat(raw["sum"][order], starts, axis=0)
+            mean_g = s / np.maximum(cnt_g, 1)
+            # exact k-way variance combine across the gid's series rows:
+            # SSD = sum_i [ssd_i + c_i (mu_i - mu)^2]
+            mean_rep = np.repeat(mean_g, st["rows_per_gid"], axis=0)
+            extra = cnt_rows * (raw["mean"][order] - mean_rep) ** 2
+            ssd = np.add.reduceat(raw["ssd"][order] + extra, starts, axis=0)
+            out2d[gids] = np.sqrt(
+                np.maximum(ssd / np.maximum(cnt_g - 1, 1), 0))
+        elif name in ("first", "last"):
+            vals2d, sel = self._combine_time_selector(st, raw, name,
+                                                      num_segments)
+            out2d[gids] = vals2d
+        return out, sel, counts
+
+    def _raw_stats(self, need_ssd: bool, need_selectors: bool) -> dict:
+        st = self._state
+        vt, mt, imat = st["arrays"]
+        S = st["S"]
+        if "count" not in self._raw:
+            got = _grid_jit(vt.shape, str(vt.dtype), "basic")(vt, mt)
+            self._raw.update(
+                {k: np.asarray(v)[:S, : self.W] for k, v in got.items()})
+        if need_ssd and "ssd" not in self._raw:
+            got = _grid_jit(vt.shape, str(vt.dtype), "ssd")(vt, mt)
+            self._raw["ssd"] = np.asarray(got)[:S, : self.W]
+        if need_selectors and "sel_first" not in self._raw:
+            got = _grid_jit(vt.shape, str(vt.dtype), "selectors")(vt, mt, imat)
+            self._raw.update(
+                {k: np.asarray(v)[:S, : self.W] for k, v in got.items()})
+        return self._raw
+
+    def _combine_value_selector(self, st, raw, name, num_segments):
+        """Per-segment row index of the selected min/max point. Value ties
+        break by earliest timestamp then row order — the BucketedBatch /
+        ops/segment.py rule."""
+        order, starts = st["row_order"], st["gid_starts"]
+        gids = st["gids_present"]
+        G = num_segments // self.W
+        rel = st["rel"]
+        S = st["S"]
+        v = raw[name][order]
+        red = np.minimum if name == "min" else np.maximum
+        ext = red.reduceat(v, starts, axis=0)
+        ext_rep = np.repeat(ext, st["rows_per_gid"], axis=0)
+        cnt = raw["count"][order]
+        sel_sub = raw["sel_" + name][order]
+        hit = (v == ext_rep) & (cnt > 0)
+        t = np.where(hit, rel[sel_sub], np.iinfo(np.int64).max)
+        tbest = np.repeat(np.minimum.reduceat(t, starts, axis=0),
+                          st["rows_per_gid"], axis=0)
+        hit &= t == tbest
+        rows = np.arange(S, dtype=np.int64)[:, None]
+        idx = np.where(hit, rows, S)
+        pick = np.clip(np.minimum.reduceat(idx, starts, axis=0), 0, S - 1)
+        sel = np.zeros(num_segments, dtype=np.int64)
+        # result[g, w] = sel_sub[pick[g, w], w] — rows align with gids order
+        sel.reshape(G, self.W)[gids] = np.take_along_axis(sel_sub, pick, axis=0)
+        return sel
+
+    def _combine_time_selector(self, st, raw, name, num_segments):
+        """first/last across a gid's series rows: pick by extreme exact
+        timestamp (ties by row order). Returns (values for present gids,
+        sel array)."""
+        order, starts = st["row_order"], st["gid_starts"]
+        gids = st["gids_present"]
+        G = num_segments // self.W
+        rel = st["rel"]
+        S = st["S"]
+        cnt = raw["count"][order]
+        sel_sub = raw["sel_" + name][order]
+        vals_sub = raw[name][order]
+        latest = name == "last"
+        bad = np.iinfo(np.int64).min if latest else np.iinfo(np.int64).max
+        t = np.where(cnt > 0, rel[sel_sub], bad)
+        red = np.maximum if latest else np.minimum
+        tbest = np.repeat(red.reduceat(t, starts, axis=0),
+                          st["rows_per_gid"], axis=0)
+        hit = (cnt > 0) & (t == tbest)
+        rows = np.arange(S, dtype=np.int64)[:, None]
+        if latest:
+            # time ties pick the LATEST row in scan order — the
+            # ops/segment.py `smax(idx)` rule for last()
+            idx = np.where(hit, rows, -1)
+            pick = np.clip(np.maximum.reduceat(idx, starts, axis=0), 0, S - 1)
+        else:
+            idx = np.where(hit, rows, S)
+            pick = np.clip(np.minimum.reduceat(idx, starts, axis=0), 0, S - 1)
+        vals2d = np.take_along_axis(vals_sub, pick, axis=0)
+        sel = np.zeros(num_segments, dtype=np.int64)
+        sel.reshape(G, self.W)[gids] = np.take_along_axis(sel_sub, pick, axis=0)
+        return vals2d, sel
+
+
+def _pow2_at_least(n: int, floor: int) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+@functools.lru_cache(maxsize=256)
+def _grid_jit(shape: tuple, dtype: str, kind: str):
+    """Compiled (S_pad, k, W_pad) grid kernels, cached per canonical shape.
+    'basic' = one fused pass for count/sum/mean/min/max; 'ssd' = two-pass
+    squared deviations (the one-pass formula cancels catastrophically);
+    'selectors' = within-row argmin/argmax sample selection for
+    min/max/first/last."""
+    import jax
+    import jax.numpy as jnp
+
+    STATS.incr("device", "compile_cache_misses")
+
+    if kind == "basic":
+
+        @jax.jit
+        def basic(v, m):
+            from opengemini_tpu.ops import segment as seg
+
+            return seg.grid_window_agg_t(v, m)
+
+        return basic
+
+    if kind == "ssd":
+
+        @jax.jit
+        def ssd(v, m):
+            zero = jnp.zeros((), v.dtype)
+            vz = jnp.where(m, v, zero)
+            cnt = m.sum(axis=1)
+            mean = vz.sum(axis=1) / jnp.maximum(cnt, 1).astype(v.dtype)
+            dev = jnp.where(m, v - mean[:, None, :], zero)
+            return (dev * dev).sum(axis=1)
+
+        return ssd
+
+    @jax.jit
+    def selectors(v, m, imat):
+        big = jnp.array(jnp.inf, v.dtype)
+        k = v.shape[1]
+        mn = jnp.where(m, v, big).min(axis=1)
+        mx = jnp.where(m, v, -big).max(axis=1)
+        # argmin/argmax tie -> lowest k index = earliest in-row timestamp
+        r_min = jnp.argmin(jnp.where(m, v, big), axis=1)
+        r_max = jnp.argmin(jnp.where(m, -v, big), axis=1)
+        r_first = jnp.argmax(m, axis=1)
+        r_last = (k - 1) - jnp.argmax(m[:, ::-1, :], axis=1)
+
+        def take(mat, ridx):
+            return jnp.take_along_axis(mat, ridx[:, None, :], axis=1)[:, 0, :]
+
+        return {
+            "sel_min": take(imat, r_min), "sel_max": take(imat, r_max),
+            "sel_first": take(imat, r_first), "sel_last": take(imat, r_last),
+            "first": take(v, r_first), "last": take(v, r_last),
+        }
+
+    return selectors

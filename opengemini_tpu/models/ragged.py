@@ -65,7 +65,7 @@ class IntExactBatch:
         self.n = 0
         self._acc = None
 
-    def add(self, values, rel_ns, seg_ids, mask, times_ns):
+    def add(self, values, rel_ns, seg_ids, mask, times_ns, sids=None):
         self._vals.append(np.asarray(values))
         self._seg.append(np.asarray(seg_ids, dtype=np.int64))
         self._mask.append(np.asarray(mask, dtype=np.bool_))
@@ -119,7 +119,7 @@ class BucketedBatch:
         self.n = 0
         self._frozen = None
 
-    def add(self, values, rel_ns, seg_ids, mask, times_ns):
+    def add(self, values, rel_ns, seg_ids, mask, times_ns, sids=None):
         self._vals.append(np.asarray(values, dtype=self.dtype))
         self._rel.append(np.asarray(rel_ns, dtype=np.int64))
         self._seg.append(np.asarray(seg_ids, dtype=np.int64))

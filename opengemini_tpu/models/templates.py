@@ -79,7 +79,7 @@ class AggBatch:
         self._counts_cache: dict[int, np.ndarray] = {}
         self._mesh_outs: dict[int, dict] = {}
 
-    def add(self, values, rel_ns, seg_ids, mask, times_ns):
+    def add(self, values, rel_ns, seg_ids, mask, times_ns, sids=None):
         self.values.append(np.asarray(values, dtype=self.dtype))
         hi, lo = split_rel_ns(np.asarray(rel_ns, dtype=np.int64))
         self.rel_hi.append(hi)

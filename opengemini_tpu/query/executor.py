@@ -71,14 +71,18 @@ _STRING_OK_HOST = {"count", "count_distinct", "mode", "first", "last",
                    "distinct", "elapsed", "absent"}
 
 
-def pick_batch(schema, agg_names, field: str, dtype):
+def pick_batch(schema, agg_names, field: str, dtype, grid_ctx=None):
     """Batch implementation for one field given the aggregate names that
-    will run on it. Dense-capable aggregates use the ragged->dense
-    bucketed batch (~100x over scatter on TPU, models/ragged.py);
-    rank-based ones (percentile/median/count_distinct) keep the lexsort
-    AggBatch. Shared by the local aggregate path and the data-node
-    partial computation (query/partials.py) so both sides pick identical
-    numerics."""
+    will run on it. With a GROUP BY time() context (`grid_ctx` =
+    (W, every_ns)), dense-capable aggregates try the regular-grid
+    windows-on-lanes batch first (models/grid.py — the fastest layout,
+    with built-in fallback when the scanned data is not constant-stride);
+    otherwise they use the ragged->dense bucketed batch (~100x over
+    scatter on TPU, models/ragged.py); rank-based ones
+    (percentile/median/count_distinct) keep the lexsort AggBatch. Shared
+    by the local aggregate path and the data-node partial computation
+    (query/partials.py) so both sides pick identical numerics."""
+    from opengemini_tpu.models import grid as _grid
     from opengemini_tpu.models import ragged as _ragged
     from opengemini_tpu.models import templates as _templates
     from opengemini_tpu.parallel import runtime as _prt
@@ -100,6 +104,12 @@ def pick_batch(schema, agg_names, field: str, dtype):
             # these over every chip; the bucketed layout stays
             # single-device
             return _templates.AggBatch(dtype)
+    if (
+        grid_ctx is not None
+        and schema.get(field) in (FieldType.FLOAT, FieldType.INT)
+        and all(n in _grid.GRID_AGGS for n in agg_names)
+    ):
+        return _grid.GridBatch(dtype, grid_ctx[0], grid_ctx[1])
     if all(n in _ragged.DENSE_AGGS for n in agg_names):
         return _ragged.BucketedBatch(dtype)
     return _templates.AggBatch(dtype)
@@ -1715,8 +1725,9 @@ class Executor:
         per_field_aggs: dict[str, list] = {}
         for _call, spec, _params, fname in aggs:
             per_field_aggs.setdefault(fname, []).append(spec.name)
+        grid_ctx = (W, group_time.every_ns) if group_time else None
         batches: dict[str, object] = {
-            f: pick_batch(schema, per_field_aggs[f], f, dtype)
+            f: pick_batch(schema, per_field_aggs[f], f, dtype, grid_ctx)
             for f in needed_fields
         }
 
@@ -1766,13 +1777,14 @@ class Executor:
         time_segs: list[np.ndarray] = []
         time_vals: list[np.ndarray] = []
 
-        def _scan_record(rec, seg):
+        def _scan_record(rec, seg, sids=None):
             if time_aggs:
                 m = fmask if fmask is not None else slice(None)
                 time_segs.append(seg[m])
                 time_vals.append(rec.times[m])
             _add_record_to_batches(
-                rec, seg, aligned, needed_fields, batches, dtype, fmask
+                rec, seg, aligned, needed_fields, batches, dtype, fmask,
+                sids=sids,
             )
 
         with trace.span("scan") as scan_span:
@@ -1815,7 +1827,7 @@ class Executor:
                         seg = (gid_rows * W + widx.astype(np.int64)).astype(np.int32)
                     else:
                         seg = gid_rows.astype(np.int32)
-                    _scan_record(rec, seg)
+                    _scan_record(rec, seg, sids=sid_arr)
             for sh, sid, gid in remaining_plan:
                 TRACKER.check()  # KILL QUERY cancellation point
                 if pre_eligible:
@@ -1843,7 +1855,7 @@ class Executor:
                     seg = (gid * W + widx.astype(np.int64)).astype(np.int32)
                 else:
                     seg = np.full(len(rec), gid, dtype=np.int32)
-                _scan_record(rec, seg)
+                _scan_record(rec, seg, sids=sid)
             scan_span.add_field("rows", rows_scanned)
         STATS.incr("executor", "rows_scanned", rows_scanned)
 
@@ -1978,7 +1990,8 @@ class Executor:
             rows += len(rec)
             seg = np.full(len(rec), gid, dtype=np.int32)
             _add_record_to_batches(
-                rec, seg, aligned, needed_fields, batches, dtype, None
+                rec, seg, aligned, needed_fields, batches, dtype, None,
+                sids=sid,
             )
         return True, rows
 
@@ -3034,10 +3047,13 @@ def _series_needs_merged_decode(sh, mst, sid, tmin, tmax):
     return False, srcs
 
 
-def _add_record_to_batches(rec, seg, aligned, needed_fields, batches, dtype, fmask):
+def _add_record_to_batches(rec, seg, aligned, needed_fields, batches, dtype,
+                           fmask, sids=None):
     """Shared scan step: one record's columns into the per-field device
     batches (string columns become count-only zero payloads; int-exact
-    host batches receive the raw int64 values uncast)."""
+    host batches receive the raw int64 values uncast). `sids` (scalar or
+    per-row array) carries series identity for the grid batch's
+    constant-stride run detection."""
     rel = rec.times - aligned  # int64 ns; (hi, lo)-split on add()
     for fname in needed_fields:
         col = rec.columns.get(fname)
@@ -3052,7 +3068,7 @@ def _add_record_to_batches(rec, seg, aligned, needed_fields, batches, dtype, fma
         m = col.valid
         if fmask is not None:
             m = m & fmask
-        batches[fname].add(vals, rel, seg, m, rec.times)
+        batches[fname].add(vals, rel, seg, m, rec.times, sids=sids)
 
 
 def _merge_multi_source(all_series: list[dict], stmt) -> list[dict]:
