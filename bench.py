@@ -394,6 +394,20 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
         t0 = time.perf_counter()
         ex.execute(q, db="bench", now_ns=(base + points) * NS)
         t_warm = time.perf_counter() - t0
+        # A/B: same query with the grid fast path disabled (bucketed
+        # layout) — the production grid-vs-bucketed speedup, full e2e
+        prior_knob = os.environ.get("OGTPU_DISABLE_GRID")
+        os.environ["OGTPU_DISABLE_GRID"] = "1"
+        try:
+            ex.execute(q, db="bench", now_ns=(base + points) * NS)  # warm
+            t0 = time.perf_counter()
+            ex.execute(q, db="bench", now_ns=(base + points) * NS)
+            t_warm_bucketed = time.perf_counter() - t0
+        finally:
+            if prior_knob is None:
+                os.environ.pop("OGTPU_DISABLE_GRID", None)
+            else:
+                os.environ["OGTPU_DISABLE_GRID"] = prior_knob
         eng.close()
         return {
             "rows": rows,
@@ -401,6 +415,8 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
             "query_cold_s": round(t_cold, 3),
             "query_warm_s": round(t_warm, 3),
             "query_warm_rows_per_s": round(rows / t_warm),
+            "query_warm_bucketed_s": round(t_warm_bucketed, 3),
+            "grid_vs_bucketed_speedup": round(t_warm_bucketed / max(t_warm, 1e-9), 2),
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)

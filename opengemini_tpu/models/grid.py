@@ -153,7 +153,9 @@ class GridBatch:
             return None
         bnd_idx = np.flatnonzero(boundary)
         S = len(bnd_idx)
-        cells = S * k * W
+        S_pad = _pow2_at_least(S, _MIN_S)
+        W_pad = _pow2_at_least(W, _MIN_W)
+        cells = S_pad * k * W_pad  # padded = what actually allocates
         if cells > _MAX_GRID_CELLS or cells > max(_MAX_EXPANSION * n, 1 << 20):
             return None
         w = seg % W
@@ -161,8 +163,6 @@ class GridBatch:
         if (r < 0).any() or (r >= k).any():
             return None  # window grid misaligned with the stride grid
         rid = np.cumsum(boundary) - 1
-        S_pad = _pow2_at_least(S, _MIN_S)
-        W_pad = _pow2_at_least(W, _MIN_W)
         vals = np.concatenate(self._vals)
         mask = np.concatenate(self._mask)
         vt = np.zeros((S_pad, k, W_pad), dtype=self.dtype)

@@ -236,7 +236,10 @@ def grid_window_agg_t(values_t, mask_t):
     axis, within-window samples on sublanes, so every per-window stat is a
     sublane-axis reduce. Measured ~9x faster than the last-axis layout on
     v5e (164 vs 18 G rows/s): the reduce streams at near HBM bandwidth.
-    The executor assembles regular chunks directly in this layout.
+    Production wiring: models/grid.py GridBatch assembles scanned chunks
+    directly in this layout when the data is stride-regular (pick_batch
+    routes GROUP BY time() aggregates there); bench.py measures the same
+    kernel standalone.
 
     Returns dict of (num_series, num_windows) arrays.
     """

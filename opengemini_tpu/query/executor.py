@@ -106,6 +106,7 @@ def pick_batch(schema, agg_names, field: str, dtype, grid_ctx=None):
             return _templates.AggBatch(dtype)
     if (
         grid_ctx is not None
+        and not os.environ.get("OGTPU_DISABLE_GRID")  # A/B knob (bench.py)
         and schema.get(field) in (FieldType.FLOAT, FieldType.INT)
         and all(n in _grid.GRID_AGGS for n in agg_names)
     ):

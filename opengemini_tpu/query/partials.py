@@ -125,8 +125,13 @@ def compute_partials(engine, router, req: dict) -> bytes:
 
     read_fields = sorted(set(per_field) | cond.row_filter_refs(sc))
     dtype = templates.compute_dtype()
+    # same grid_ctx the coordinator uses: peers take the identical
+    # windows-on-lanes fast path for stride-regular data (pick_batch's
+    # "both sides pick identical numerics" contract)
+    grid_ctx = (W, every) if every else None
     batches = {
-        f: pick_batch(schema, per_field[f], f, dtype) for f in per_field
+        f: pick_batch(schema, per_field[f], f, dtype, grid_ctx)
+        for f in per_field
     }
 
     # group bookkeeping against the COORDINATOR's grid
@@ -164,7 +169,8 @@ def compute_partials(engine, router, req: dict) -> bytes:
             else:
                 seg = np.full(len(rec), gid, dtype=np.int32)
             _add_record_to_batches(
-                rec, seg, aligned, sorted(per_field), batches, dtype, fmask
+                rec, seg, aligned, sorted(per_field), batches, dtype, fmask,
+                sids=sid,
             )
 
     n_seg = max(len(group_keys), 1) * W
