@@ -249,9 +249,21 @@ class GridBatch:
         return out, sel, counts
 
     def _raw_stats(self, need_ssd: bool, need_selectors: bool) -> dict:
+        from opengemini_tpu.parallel import runtime as _prt
+
         st = self._state
         vt, mt, imat = st["arrays"]
         S = st["S"]
+        mesh = _prt.get_mesh()
+        if mesh is not None and vt.shape[0] >= mesh.size:
+            # multi-chip: series-run rows are independent — shard the S
+            # axis, GSPMD partitions the sublane reduces, no collectives
+            if "mesh_arrays" not in st:
+                from opengemini_tpu.parallel import distributed as _dist
+
+                st["mesh_arrays"] = _dist.shard_leading_axis(
+                    mesh, vt, mt, imat)
+            vt, mt, imat = st["mesh_arrays"]
         if "count" not in self._raw:
             got = _grid_jit(vt.shape, str(vt.dtype), "basic")(vt, mt)
             self._raw.update(

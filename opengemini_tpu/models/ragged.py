@@ -246,15 +246,37 @@ class _Bucket:
         self.rel = None
         self._raw: dict = {}
         self._combined: dict = {}
+        self._mesh_arrays = None
+
+    def _device_arrays(self, mesh):
+        """Matrices for the kernels: with a configured mesh, row-sharded
+        device arrays (bucket rows are independent — GSPMD partitions the
+        dense reduces with zero collectives, parallel/distributed.py
+        shard_leading_axis); otherwise the host matrices as-is."""
+        if mesh is None or self.g < mesh.size:
+            return self.arrays
+        if self._mesh_arrays is None:
+            from opengemini_tpu.parallel import distributed as _dist
+
+            self._mesh_arrays = _dist.shard_leading_axis(mesh, *self.arrays)
+        return self._mesh_arrays
 
     def _raw_stats(self, need_selectors: bool) -> dict:
         """Per-sub-row device stats, computed lazily per group: selector
         lex scans (4 extra matrix passes) run only for selector queries."""
+        from opengemini_tpu.parallel import runtime as _prt
+
+        mesh = _prt.get_mesh()
+        arrays = self._device_arrays(mesh)
+        # force the XLA selector form only when the inputs really are
+        # mesh-sharded (pallas_call does not auto-partition); unsharded
+        # buckets keep the fused Pallas kernel on TPU
+        sel_kind = "selectors_xla" if arrays is not self.arrays else "selectors"
         if "count" not in self._raw:
-            got = _stats_jit("basic")(*self.arrays)
+            got = _stats_jit("basic")(*arrays)
             self._raw.update({k: np.asarray(v)[: self.g] for k, v in got.items()})
         if need_selectors and "sel_first" not in self._raw:
-            got = _stats_jit("selectors")(*self.arrays)
+            got = _stats_jit(sel_kind)(*arrays)
             self._raw.update({k: np.asarray(v)[: self.g] for k, v in got.items()})
         return self._raw
 
@@ -338,8 +360,11 @@ def _stats_jit(kind: str):
     """Compiled per-sub-row stat kernels: 'basic' (one fused pass for
     count/sum/mean/min/max/ssd) and 'selectors' (the four lexicographic
     (hi, lo, col) scans for first/last/min/max row selection).
+    'selectors_xla' forces the XLA form — used with a device mesh, where
+    GSPMD partitions the plain XLA kernels over row-sharded inputs but
+    pallas_call does not auto-partition.
 
-    On a TPU backend these route to the fused Pallas tile kernels
+    On a TPU backend 'selectors' routes to the fused Pallas tile kernels
     (ops/pallas_segment.py) — one HBM pass feeds every statistic; the
     XLA expressions below serve CPU runs and remain the semantics
     oracle the Pallas kernels are tested against."""
@@ -410,10 +435,11 @@ def _stats_jit(kind: str):
         }
 
     _STATS_FNS["basic"] = basic
+    _STATS_FNS["selectors_xla"] = selectors
     if not pallas_segment.use_pallas():
         # with pallas routing on, 'selectors' must stay un-cached here so a
         # later request takes the pallas branch above
         _STATS_FNS["selectors"] = selectors
-    if kind == "selectors":
+    if kind in ("selectors", "selectors_xla"):
         return selectors
     return _STATS_FNS[kind]  # unknown kinds must raise, not silently alias

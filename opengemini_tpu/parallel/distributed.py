@@ -278,15 +278,38 @@ def batch_agg_jit(mesh: Mesh, num_segments: int, sel_names: tuple = ()):
 
 
 def shard_rows(mesh: Mesh, *arrays):
-    """Pad row arrays to a multiple of the mesh size (padding masked out by
-    callers via the mask array convention: the LAST array is the mask) and
-    device_put them with the row sharding."""
+    """Pad 1D row arrays to a multiple of the mesh size (padding masked
+    out by callers via the mask array convention) and device_put them with
+    the row sharding — the 1D special case of shard_leading_axis."""
+    return shard_leading_axis(mesh, *arrays)
+
+
+def shard_leading_axis(mesh: Mesh, *arrays):
+    """device_put matrices with their LEADING axis sharded over every mesh
+    axis (remaining axes replicated per device). This is how the dense
+    layouts (models/ragged.py bucket matrices, models/grid.py grids) go
+    multi-chip: their rows are independent — one segment/series-run lives
+    in exactly one row — so the per-row dense reduces partition with ZERO
+    collectives; GSPMD compiles the same kernels row-parallel and the host
+    gathers (num_rows,)-shaped outputs. The reference needs an exchange +
+    merge pipeline here (rpc_transform.go:117); the dense layout makes the
+    merge a no-op by construction.
+
+    Rows are padded (zeros -> masked out by the kernels' mask plane or
+    sliced off by the [:g] caller convention) to a multiple of mesh.size.
+    """
+    from opengemini_tpu.utils.stats import GLOBAL as _STATS
+
     n_dev = mesh.size
-    n = len(arrays[0])
+    n = arrays[0].shape[0]
     npad = (n + n_dev - 1) // n_dev * n_dev
-    spec = NamedSharding(mesh, P(mesh.axis_names))
+    spec = NamedSharding(
+        mesh, P(mesh.axis_names, *([None] * (arrays[0].ndim - 1))))
     out = []
-    for i, a in enumerate(arrays):
-        pad = np.zeros(npad - n, dtype=a.dtype)
-        out.append(jax.device_put(np.concatenate([a, pad]), spec))
+    for a in arrays:
+        if npad != n:
+            pad = np.zeros((npad - n,) + a.shape[1:], dtype=a.dtype)
+            a = np.concatenate([a, pad])
+        out.append(jax.device_put(a, spec))
+    _STATS.incr("device", "mesh_dense_batches")
     return tuple(out)

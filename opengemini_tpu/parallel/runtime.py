@@ -1,10 +1,13 @@
 """Process-wide device-mesh configuration.
 
-When a mesh is set (multi-chip deployment, or the driver's virtual-CPU
-dry run), the executor's general aggregate batch path runs as a
-shard_map program over it: rows sharded across devices, per-segment
-partials merged with XLA collectives (parallel/distributed.py). With no
-mesh, everything runs single-device exactly as before.
+When a mesh is set (server [device] config, or the driver's virtual-CPU
+dry run), the executor's aggregate batches go multi-chip: the dense
+layouts (models/grid.py, models/ragged.py) shard their independent row
+axes over the mesh — GSPMD partitions the dense kernels with zero
+collectives (distributed.shard_leading_axis) — and AggBatch's general
+path runs as a shard_map program with collective merges
+(distributed.build_batch_agg). With no mesh, everything runs
+single-device exactly as before.
 """
 
 from __future__ import annotations

@@ -85,7 +85,6 @@ def pick_batch(schema, agg_names, field: str, dtype, grid_ctx=None):
     from opengemini_tpu.models import grid as _grid
     from opengemini_tpu.models import ragged as _ragged
     from opengemini_tpu.models import templates as _templates
-    from opengemini_tpu.parallel import runtime as _prt
 
     if (
         schema.get(field) == FieldType.INT
@@ -96,14 +95,12 @@ def pick_batch(schema, agg_names, field: str, dtype, grid_ctx=None):
         # the mantissa (2^24 on-TPU f32). count alone is value-independent
         # and stays on the fast device path.
         return _ragged.IntExactBatch()
-    if _prt.get_mesh() is not None:
-        from opengemini_tpu.parallel.distributed import MESH_AGGS
-
-        if all(n in MESH_AGGS for n in agg_names):
-            # device mesh configured: the AggBatch shard_map path runs
-            # these over every chip; the bucketed layout stays
-            # single-device
-            return _templates.AggBatch(dtype)
+    # NOTE: a configured device mesh no longer reroutes dense-capable
+    # aggregates to AggBatch — the grid and bucketed layouts themselves go
+    # multi-chip by sharding their independent row axes (zero-collective
+    # GSPMD partitioning, distributed.shard_leading_axis), so multi-chip
+    # keeps the 62-160+ G rows/s dense kernels instead of the scatter
+    # family. AggBatch's shard_map path still serves its own cases.
     if (
         grid_ctx is not None
         and not os.environ.get("OGTPU_DISABLE_GRID")  # A/B knob (bench.py)

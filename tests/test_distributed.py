@@ -153,3 +153,48 @@ class TestExecutorMeshPath:
         for q, a, b in zip(queries, solo, meshed):
             assert a == b, (q, a, b)
         e.close()
+
+
+    def test_mesh_uses_dense_layouts(self, tmp_path):
+        """With a mesh set, GROUP BY time() over regular data must run the
+        grid layout row-sharded over the mesh — not the scatter AggBatch
+        (VERDICT r3: multi-chip used to select the slowest kernels)."""
+        import jax
+        import pytest
+
+        from opengemini_tpu.parallel import distributed as dist
+        from opengemini_tpu.parallel import runtime as prt
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+        from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+
+        ns = 10**9
+        base = 1_700_000_040
+        lines = []
+        for i in range(60):
+            for h in range(16):
+                lines.append(f"m,host=h{h} v={(h + i) % 9} {(base + i) * ns}")
+        e = Engine(str(tmp_path / "dense"))
+        e.create_database("db")
+        e.write_lines("db", "\n".join(lines))
+        ex = Executor(e)
+
+        def counter(module, name):
+            return STATS.snapshot().get(module, {}).get(name, 0)
+
+        prt.set_mesh(dist.make_mesh(8, ("shard",)))
+        try:
+            g0 = counter("executor", "grid_batches")
+            m0 = counter("device", "mesh_dense_batches")
+            res = ex.execute(
+                "SELECT mean(v), count(v) FROM m GROUP BY time(1m), host",
+                db="db")
+            assert "series" in res["results"][0]
+            assert counter("executor", "grid_batches") > g0
+            assert counter("device", "mesh_dense_batches") > m0
+        finally:
+            prt.set_mesh(None)
+        e.close()
