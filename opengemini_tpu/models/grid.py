@@ -324,6 +324,12 @@ class GridBatch:
         tbest = np.repeat(red.reduceat(t, starts, axis=0),
                           st["rows_per_gid"], axis=0)
         hit = (cnt > 0) & (t == tbest)
+        # exact-time ties across series rows: larger value wins
+        # (reference FirstReduce/LastReduce tie rule)
+        v_best = np.repeat(np.maximum.reduceat(
+            np.where(hit, vals_sub, -np.inf), starts, axis=0),
+            st["rows_per_gid"], axis=0)
+        hit &= vals_sub == v_best
         rows = np.arange(S, dtype=np.int64)[:, None]
         if latest:
             # time ties pick the LATEST row in scan order — the

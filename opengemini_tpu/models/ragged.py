@@ -321,6 +321,11 @@ class _Bucket:
                 red = np.maximum if latest else np.minimum
                 best_rep = np.repeat(red.reduceat(r, starts), self.n_sub)
                 hit = (r == best_rep) & (raw["count"] > 0)
+                # exact-time ties across sub-rows: larger value wins
+                # (reference FirstReduce/LastReduce tie rule)
+                v_best = np.repeat(np.maximum.reduceat(
+                    np.where(hit, raw[name], -np.inf), starts), self.n_sub)
+                hit &= raw[name] == v_best
                 idx_sub = np.where(hit, np.arange(len(r)), len(r))
                 pick = np.clip(np.minimum.reduceat(idx_sub, starts), 0, len(r) - 1)
                 out[name] = raw[name][pick]
@@ -418,14 +423,35 @@ def _stats_jit(kind: str):
         return {"count": cnt, "sum": s, "ssd": ssd, "min": mn, "max": mx,
                 "mean": mean}
 
+    def _first_last_col(v, hi, lo, cand, latest):
+        """Extreme (hi, lo) time; exact-time ties take the LARGER VALUE
+        (reference agg_func.go FirstReduce/LastReduce), then column
+        order."""
+        big = _BIG_I32
+        col = jnp.arange(hi.shape[1], dtype=jnp.int32)[None, :]
+        if latest:
+            hi_ext = jnp.where(cand, hi, -big).max(axis=1)
+            c2 = cand & (hi == hi_ext[:, None])
+            lo_ext = jnp.where(c2, lo, -big).max(axis=1)
+            c3 = c2 & (lo == lo_ext[:, None])
+        else:
+            hi_ext = jnp.where(cand, hi, big).min(axis=1)
+            c2 = cand & (hi == hi_ext[:, None])
+            lo_ext = jnp.where(c2, lo, big).min(axis=1)
+            c3 = c2 & (lo == lo_ext[:, None])
+        fbig = jnp.array(jnp.inf, v.dtype)
+        v_ext = jnp.where(c3, v, -fbig).max(axis=1)
+        c4 = c3 & (v == v_ext[:, None])
+        return jnp.where(c4, col, big).min(axis=1)
+
     @jax.jit
     def selectors(v, hi, lo, idx, m):
         big = jnp.array(jnp.inf, v.dtype)
         mn = jnp.where(m, v, big).min(axis=1)
         mx = jnp.where(m, v, -big).max(axis=1)
         clip = lambda c: jnp.clip(c, 0, v.shape[1] - 1)  # noqa: E731
-        cf = clip(_lex_col(hi, lo, m, latest=False))
-        cl = clip(_lex_col(hi, lo, m, latest=True))
+        cf = clip(_first_last_col(v, hi, lo, m, latest=False))
+        cl = clip(_first_last_col(v, hi, lo, m, latest=True))
         cmin = clip(_lex_col(hi, lo, m & (v == mn[:, None]), latest=False))
         cmax = clip(_lex_col(hi, lo, m & (v == mx[:, None]), latest=False))
         return {

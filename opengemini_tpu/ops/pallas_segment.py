@@ -176,6 +176,29 @@ def _lex_col(hi, lo, cand, latest):
     return jnp.min(_masked(col, c3, big), axis=1)
 
 
+def _first_last_col(v, hi, lo, cand, latest):
+    """first/last column pick: extreme (hi, lo) time, then exact-time ties
+    take the LARGER VALUE (reference agg_func.go FirstReduce/LastReduce),
+    then column order."""
+    big = _BIG_I32
+    col = jax.lax.broadcasted_iota(jnp.int32, hi.shape, dimension=1)
+    bcast = lambda x: jnp.broadcast_to(x, hi.shape)  # noqa: E731
+    if latest:
+        hi_ext = jnp.max(_masked(hi, cand, -big), axis=1, keepdims=True)
+        c2 = cand * (hi == bcast(hi_ext)).astype(jnp.int32)
+        lo_ext = jnp.max(_masked(lo, c2, -big), axis=1, keepdims=True)
+        c3 = c2 * (lo == bcast(lo_ext)).astype(jnp.int32)
+    else:
+        hi_ext = jnp.min(_masked(hi, cand, big), axis=1, keepdims=True)
+        c2 = cand * (hi == bcast(hi_ext)).astype(jnp.int32)
+        lo_ext = jnp.min(_masked(lo, c2, big), axis=1, keepdims=True)
+        c3 = c2 * (lo == bcast(lo_ext)).astype(jnp.int32)
+    fbig = jnp.array(jnp.inf, v.dtype)
+    v_ext = jnp.max(jnp.where(c3 != 0, v, -fbig), axis=1, keepdims=True)
+    c4 = c3 * (v == bcast(v_ext)).astype(jnp.int32)
+    return jnp.min(_masked(col, c4, big), axis=1)
+
+
 def _sel_kernel(v_ref, hi_ref, lo_ref, idx_ref, m_ref,
                 first_ref, last_ref, sf_ref, sl_ref, smin_ref, smax_ref):
     v = v_ref[...]
@@ -193,8 +216,8 @@ def _sel_kernel(v_ref, hi_ref, lo_ref, idx_ref, m_ref,
     )
     wlim = v.shape[1] - 1
     clip = lambda c: jnp.clip(c, 0, wlim)  # noqa: E731
-    cf = clip(_lex_col(hi, lo, m32, latest=False))
-    cl = clip(_lex_col(hi, lo, m32, latest=True))
+    cf = clip(_first_last_col(v, hi, lo, m32, latest=False))
+    cl = clip(_first_last_col(v, hi, lo, m32, latest=True))
     cmin = clip(_lex_col(hi, lo, m32 * (v == mn).astype(jnp.int32), latest=False))
     cmax = clip(_lex_col(hi, lo, m32 * (v == mx).astype(jnp.int32), latest=False))
 

@@ -87,9 +87,11 @@ def seg_first(values, rel_hi, rel_lo, seg_ids, num_segments: int, mask):
 
     Timestamps arrive as an EXACT lexicographic int32 pair
     (rel_hi = rel_ns >> 30, rel_lo = rel_ns & (2^30-1)) so ns-precision
-    ordering survives on devices without int64; scan order breaks true ns
-    ties (reference first/last tie semantics,
-    engine/series_agg_func.gen.go FirstReduce)."""
+    ordering survives on devices without int64. True ns ties pick the
+    LARGER VALUE — the reference first/last rule (engine/executor/
+    agg_func.go FirstReduce: `times == && v > firstValue`,
+    TestServer_Query_Aggregates_IdenticalTime); value ties then fall to
+    scan order."""
     return _seg_extreme_by_time(
         values, rel_hi, rel_lo, seg_ids, num_segments, mask, latest=False
     )
@@ -104,20 +106,22 @@ def seg_last(values, rel_hi, rel_lo, seg_ids, num_segments: int, mask):
 def _seg_extreme_by_time(values, rel_hi, rel_lo, seg_ids, num_segments, mask, latest):
     n = values.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
+    smax = lambda d: jax.ops.segment_max(d, seg_ids, num_segments=num_segments)  # noqa: E731
+    smin = lambda d: jax.ops.segment_min(d, seg_ids, num_segments=num_segments)  # noqa: E731
     if latest:
-        smax = lambda d: jax.ops.segment_max(d, seg_ids, num_segments=num_segments)  # noqa: E731
         hi_ext = smax(jnp.where(mask, rel_hi, -_BIG_I32))
         cand = mask & (rel_hi == hi_ext[seg_ids])
         lo_ext = smax(jnp.where(cand, rel_lo, -_BIG_I32))
         cand &= rel_lo == lo_ext[seg_ids]
-        sel = smax(jnp.where(cand, idx, -_BIG_I32))
     else:
-        smin = lambda d: jax.ops.segment_min(d, seg_ids, num_segments=num_segments)  # noqa: E731
         hi_ext = smin(jnp.where(mask, rel_hi, _BIG_I32))
         cand = mask & (rel_hi == hi_ext[seg_ids])
         lo_ext = smin(jnp.where(cand, rel_lo, _BIG_I32))
         cand &= rel_lo == lo_ext[seg_ids]
-        sel = smin(jnp.where(cand, idx, _BIG_I32))
+    # exact-time ties: larger value wins (reference FirstReduce/LastReduce)
+    v_ext = smax(jnp.where(cand, values, _type_min(values.dtype)))
+    cand &= values == v_ext[seg_ids]
+    sel = smin(jnp.where(cand, idx, _BIG_I32))
     safe = jnp.clip(sel, 0, n - 1)
     return values[safe], sel
 

@@ -572,8 +572,9 @@ class DataRouter:
         rp_meta = d.rps.get(rp or d.default_rp)
         if rp_meta is None:
             raise WriteError(f"retention policy not found: {db}.{rp}")
-        dur = rp_meta.shard_duration_ns
-        return t_ns // dur * dur
+        from opengemini_tpu.storage.engine import shard_group_start
+
+        return shard_group_start(t_ns, rp_meta.shard_duration_ns)
 
     def split_points(self, db: str, rp: str | None, points: list):
         """points -> (local, {node_id: [points]}): every point goes to ALL

@@ -95,7 +95,13 @@ def _merge_time_extreme(value, hi, lo, axes, earliest: bool):
     for ax in axes:
         lo_best = red(lo_best, ax)
     cand &= lo == lo_best
-    # timestamp ties across devices: lowest device rank wins (deterministic,
+    # exact-time ties: larger value wins (reference FirstReduce/LastReduce)
+    fbig = jnp.array(jnp.inf, value.dtype)
+    v_best = jnp.where(cand, value, -fbig)
+    for ax in axes:
+        v_best = jax.lax.pmax(v_best, ax)
+    cand &= value == v_best
+    # remaining ties across devices: lowest device rank wins (deterministic,
     # one actual row's value — never an average of tied rows)
     rank = jnp.zeros((), jnp.int32)
     for ax in axes:
@@ -247,9 +253,10 @@ def build_batch_agg(mesh: Mesh, num_segments: int,
             elif name == "max":
                 keys = [(v, False), (th, True), (tl, True)]
             elif name == "first":
-                keys = [(th, True), (tl, True)]
+                # time ties take the larger value (reference FirstReduce)
+                keys = [(th, True), (tl, True), (v, False)]
             else:
-                keys = [(th, False), (tl, False)]
+                keys = [(th, False), (tl, False), (v, False)]
             w = _winner(keys, valid, axes)
             out[name] = _pick(v, w, axes)
             out[name + "_sel"] = _pick(gsel, w, axes)

@@ -523,3 +523,37 @@ def _literal_value(e):
     if isinstance(e, ast.UnaryExpr) and e.op == "-":
         return -_literal_value(e.expr)
     raise ConditionError(f"expected literal, got {e}")
+
+
+def exact_series_tags(expr, tag_keys) -> dict:
+    """All tag-equality pairs appearing anywhere in a condition tree.
+
+    The /*+ full_series */ contract (reference influxql FullSeriesQuery,
+    parser.go:37): the collected pairs form the EXACT series key — a
+    series carrying additional tags does not match even where the
+    predicate itself holds (TestServer_Query_FullSeries: host=server01
+    selects cpu,host=server01 but not cpu,host=server01,region=uswest).
+    Non-tag terms (field predicates, OR branches) contribute pairs but
+    never widen the match.
+    """
+    pairs: dict[str, str] = {}
+
+    def walk(e):
+        e = _strip(e)
+        if isinstance(e, ast.BinaryExpr):
+            if e.op in ("AND", "OR"):
+                walk(e.lhs)
+                walk(e.rhs)
+                return
+            lhs, rhs = _strip(e.lhs), _strip(e.rhs)
+            if (
+                e.op == "="
+                and isinstance(lhs, ast.VarRef)
+                and lhs.name in tag_keys
+                and isinstance(rhs, ast.StringLiteral)
+            ):
+                pairs[lhs.name] = rhs.val
+
+    if expr is not None:
+        walk(expr)
+    return pairs

@@ -740,8 +740,10 @@ class Executor:
                 series.append(_series(name, None, ["name", "query"], rows))
             return {"series": series} if series else {}
         if isinstance(stmt, ast.DropMeasurement):
-            for sh in self._all_shards_db(db):
-                sh.delete_data(stmt.name)
+            # mark + deferred purge (reference MarkMeasurementDelete):
+            # SELECT hides it now; SHOW SERIES keeps the series until the
+            # retention tick (or a rewrite of the name) purges
+            self.engine.mark_measurement_delete(db, stmt.name)
             return {}
         if isinstance(stmt, (ast.DeleteSeries, ast.DropSeries)):
             return self._delete(stmt, db, now_ns)
@@ -818,8 +820,10 @@ class Executor:
             return _series_result("", None, ["database", "privilege"], rows)
         if isinstance(stmt, ast.ShowMeasurementCardinality):
             names: set[str] = set()
-            for sh in self._all_shards_db(stmt.database or db):
-                names.update(sh.measurements())
+            cdb = stmt.database or db
+            for sh in self._all_shards_db(cdb):
+                names.update(
+                    m for m in sh.measurements() if self._visible(cdb, m))
             return _series_result("", None, ["count"], [[len(names)]])
         if isinstance(stmt, ast.ShowSeriesCardinality):
             from opengemini_tpu.ingest.line_protocol import series_key
@@ -1274,6 +1278,58 @@ class Executor:
             rows = rows[: stmt.limit]
         return [{"name": src["name"], "columns": ["time"] + names, "values": rows}]
 
+    def _project_dimensioned(self, stmt, series_list: list[dict],
+                             dims: list[str], name: str):
+        """Bare projection over a dimensioned subquery: one output series,
+        dim tags as leading columns, inner rows (incl. all-null ones) in
+        series order. Returns None when the outer needs real execution."""
+        if (stmt.condition is not None or stmt.group_by_tags
+                or stmt.group_by_all_tags or stmt.group_by_time
+                or not series_list):
+            return None
+        for f in stmt.fields:
+            if not isinstance(_strip_expr(f.expr), (ast.VarRef, ast.Wildcard)):
+                return None
+        cols_in = series_list[0]["columns"]
+        names, sources = [], []  # source: ("dim", key) | ("col", idx)
+        for f in stmt.fields:
+            e = _strip_expr(f.expr)
+            if isinstance(e, ast.Wildcard):
+                for d in dims:
+                    names.append(d)
+                    sources.append(("dim", d))
+                for i, c in enumerate(cols_in[1:], start=1):
+                    names.append(c)
+                    sources.append(("col", i))
+            elif e.name.lower() == "time":
+                continue
+            elif e.name in dims:
+                names.append(f.alias or e.name)
+                sources.append(("dim", e.name))
+            else:
+                names.append(f.alias or e.name)
+                sources.append(
+                    ("col", cols_in.index(e.name))
+                    if e.name in cols_in else ("col", -1))
+        rows = []
+        for s in series_list:
+            tags = s.get("tags", {})
+            for row in s["values"]:
+                out = [row[0]]
+                for kind, ref in sources:
+                    if kind == "dim":
+                        out.append(tags.get(ref))
+                    else:
+                        out.append(row[ref] if ref >= 0 else None)
+                rows.append(out)
+        if not stmt.ascending:
+            rows.reverse()
+        if stmt.offset:
+            rows = rows[stmt.offset:]
+        if stmt.limit:
+            rows = rows[: stmt.limit]
+        return [{"name": name, "columns": ["time"] + names, "values": rows}]
+
     def _write_into(self, target: ast.Measurement, db: str, series_list: list[dict]) -> int:
         """SELECT INTO: write result rows into the target measurement
         (reference: into clause handling in statement_executor.go). Rows go
@@ -1345,6 +1401,17 @@ class Executor:
                 # merged series
                 inner = copy.copy(inner)
                 inner.group_by_all_tags = True
+            elif (
+                stmt.group_by_tags
+                and not inner.group_by_tags
+                and not inner.group_by_all_tags
+            ):
+                # influx subqueries INHERIT the outer GROUP BY dimensions:
+                # an inner call (top/agg) computes per outer group and its
+                # output series carry those tags
+                # (TestServer_SubQuery_Top_Min#0)
+                inner = copy.copy(inner)
+                inner.group_by_tags = list(stmt.group_by_tags)
         # push the outer time range into the inner select so the inner scan
         # (and the materialization below) covers only the needed window
         if isinstance(inner, ast.UnionStatement):
@@ -1381,6 +1448,31 @@ class Executor:
             else:
                 inner_res = self._select(inner, db, now_ns, trace)
         series_list = inner_res.get("series", [])
+        if (
+            not isinstance(inner, ast.UnionStatement)
+            and len(series_list) == 1
+            and not series_list[0].get("tags")
+        ):
+            # single untagged inner series + bare outer projection: project
+            # directly so all-null computed rows survive (the materializer
+            # cannot represent a row whose only field is null —
+            # TestServer_Query_SubqueryMath#0)
+            proj = self._project_union(stmt, inner_res)
+            if proj is not None:
+                return proj
+        if (
+            not isinstance(inner, ast.UnionStatement)
+            and isinstance(src.stmt, ast.SelectStatement)
+            and src.stmt.group_by_tags
+        ):
+            # dimensioned inner (explicit GROUP BY tags): a bare outer
+            # projection flattens series into one with the dims as columns,
+            # null rows preserved (TestServer_Query_Sliding_Window #8/#9)
+            proj = self._project_dimensioned(
+                stmt, series_list, list(src.stmt.group_by_tags),
+                _inner_source_name(inner))
+            if proj is not None:
+                return proj
         mst_name = _inner_source_name(inner)
         with tempfile.TemporaryDirectory(prefix="ogtpu-sub-") as tmp:
             tmp_engine = _Engine(tmp, sync_wal=False)
@@ -1432,8 +1524,54 @@ class Executor:
                 # influx wildcard-over-subquery expands to the inner's
                 # ORIGINAL output columns: explicit inner fields stay
                 # fields-only; an inner wildcard (bare or inside a call)
-                # lets the outer wildcard inline propagated tags
+                # lets the outer wildcard inline propagated tags. Inner
+                # EXPLICIT GROUP BY tags are output dimensions — the outer
+                # wildcard includes them as columns
+                # (TestServer_Query_SubqueryForLogicalOptimize#5)
                 outer._from_subquery = not inner_has_wild
+                if isinstance(src.stmt, ast.SelectStatement):
+                    outer._subquery_dims = list(src.stmt.group_by_tags)
+                # a flattenable plain-projection inner (bare field renames,
+                # no grouping) donates its explicit time bounds to the
+                # outer statement — the reference's subquery flattening
+                # makes the outer render window start at the inner tmin
+                # (SubqueryForLogicalOptimize#2); non-flattenable inners
+                # (computed projections) keep epoch-0 rendering (#4)
+                if (
+                    isinstance(src.stmt, ast.SelectStatement)
+                    and src.stmt.fields
+                    and all(isinstance(_strip_expr(f.expr), ast.VarRef)
+                            for f in src.stmt.fields)
+                    and not src.stmt.group_by_tags
+                    and not src.stmt.group_by_all_tags
+                    and src.stmt.group_by_time is None
+                    and src.stmt.condition is not None
+                ):
+                    try:
+                        sc_in = cond.split(src.stmt.condition, set(), now_ns)
+                        sc_out = cond.split(stmt.condition, set(), now_ns)
+                        if (
+                            sc_out.tmin == cond.MIN_TIME
+                            and sc_out.tmax == cond.MAX_TIME
+                            and (sc_in.tmin != cond.MIN_TIME
+                                 or sc_in.tmax != cond.MAX_TIME)
+                        ):
+                            bound = ast.BinaryExpr(
+                                "AND",
+                                ast.BinaryExpr(
+                                    ">=", ast.VarRef("time"),
+                                    ast.IntegerLiteral(sc_in.tmin)),
+                                ast.BinaryExpr(
+                                    "<", ast.VarRef("time"),
+                                    ast.IntegerLiteral(sc_in.tmax)),
+                            )
+                            outer.condition = (
+                                bound if outer.condition is None
+                                else ast.BinaryExpr(
+                                    "AND", outer.condition, bound)
+                            )
+                    except cond.ConditionError:
+                        pass
                 sub_ex = Executor(tmp_engine, users=self.users)
                 res = sub_ex._select(outer, "sub", now_ns, trace)
                 return res.get("series", [])
@@ -1543,6 +1681,8 @@ class Executor:
         split, shard mapping, data-driven range clamp, window grid, group
         construction (reference: the Prepare + MapShards steps,
         SURVEY.md §3.2). Returns None when nothing matches."""
+        if self.engine.is_measurement_dropped(db, mst):
+            return None  # mark-deleted: hidden from SELECT pre-purge
         shards_all, live = self._all_shards_with_remote(
             db, rp, mst, stmt.condition, now_ns, remote_mode
         )
@@ -1551,6 +1691,8 @@ class Executor:
         for sh in shards_all:
             tag_keys.update(sh.index.tag_keys(mst))
             schema.update(sh.schema(mst))
+        if not schema and stmt.group_by_all_tags:
+            raise QueryError("measurement not found")  # see _select_raw
         sc = cond.split(stmt.condition, tag_keys, now_ns)
         tmin, tmax = sc.tmin, sc.tmax
         explicit_tmin = tmin != cond.MIN_TIME
@@ -1607,6 +1749,10 @@ class Executor:
         # skip their per-row filter (reference: hybrid store reader hints)
         hinted = bool({"full_series", "specific_series"}
                       & set(getattr(stmt, "hints", ())))
+        exact_tags = (
+            cond.exact_series_tags(stmt.condition, tag_keys)
+            if "full_series" in getattr(stmt, "hints", ()) else None
+        ) or None  # no tag equalities -> the hint pins nothing
         for sh in shards:
             sids = cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
             if sc.mixed_expr is not None:
@@ -1616,6 +1762,9 @@ class Executor:
                 else:
                     sids &= cond.tag_superset_sids(
                         sc.mixed_expr, sh.index, mst, sc.tag_keys)
+            if exact_tags is not None:
+                sids = {s for s in sids
+                        if sh.index.tags_of(s) == exact_tags}
             sids = _prune_text_sids(sh, mst, sids, match_terms)
             for sid in sorted(sids):
                 tags = sh.index.tags_of(sid)
@@ -2023,13 +2172,15 @@ class Executor:
             columns.append(name)
             col_exprs.append(f.expr)
 
-        # selector fast path: single bare selector, no GROUP BY time ->
-        # result time is the selected point's own timestamp
+        # selector fast path: a single selector call (bare, or wrapped in
+        # scalar math like `max(rx) * 1`), no GROUP BY time -> result time
+        # is the selected point's own timestamp (reference
+        # TestServer_Query_Aggregates_Math#2)
         single_selector = None
         if not group_time and len(col_exprs) == 1:
-            only = _strip_expr(col_exprs[0])
-            if isinstance(only, ast.Call):
-                entry = agg_results.get(id(only))
+            calls = _calls_in(col_exprs[0])
+            if len(calls) == 1:
+                entry = agg_results.get(id(calls[0]))
                 if entry and entry[3].is_selector:
                     single_selector = entry
 
@@ -2372,6 +2523,135 @@ class Executor:
             out_series.append(series)
         return out_series
 
+    def _select_top_companions(self, stmt, ctx, multi_plan, mst) -> list[dict]:
+        """top()/bottom() with companion projections: select rows by the
+        call, then evaluate every other projection against the SELECTED
+        source rows (wildcards expand to fields+tags; scalar math follows
+        the raw-path null rules). Reference: the reference's top/bottom
+        transform keeps auxiliary columns from the winning rows
+        (TestServer_Query_For_BugList#2, TestServer_SubQuery_Top_Min#0)."""
+        sel_name, call_name, sel_field, params = multi_plan
+        sc, schema, tag_keys = ctx.sc, ctx.schema, ctx.tag_keys
+        group_time, aligned, W = ctx.group_time, ctx.aligned, ctx.W
+
+        cols = []  # (output name, spec)
+        for f in stmt.fields:
+            e = _strip_expr(f.expr)
+            if isinstance(e, ast.Call):
+                cols.append((f.alias or _default_field_name(e), ("top",)))
+            elif isinstance(e, ast.Wildcard):
+                for n in sorted(set(schema) | tag_keys):
+                    if n in schema:
+                        cols.append((n, ("field", n)))
+                    else:
+                        cols.append((n, ("tag", n)))
+            elif isinstance(e, ast.VarRef):
+                kind = ("tag", e.name) if e.name in tag_keys and \
+                    e.name not in schema else ("field", e.name)
+                cols.append((f.alias or e.name, kind))
+            else:
+                cols.append((f.alias or _default_field_name(f.expr),
+                             ("expr", e)))
+        need_fields = {sel_field}
+        for _n, spec in cols:
+            if spec[0] == "field":
+                need_fields.add(spec[1])
+            elif spec[0] == "expr":
+                need_fields |= _scalar_refs(spec[1])
+        read_fields = sorted((need_fields | cond.row_filter_refs(sc))
+                             & set(schema))
+
+        groups: dict[tuple, list] = {}
+        for sh, sid, gid in ctx.scan_plan:
+            groups.setdefault(ctx.group_keys[gid], []).append((sh, sid))
+
+        out_series = []
+        for key in sorted(groups):
+            times_l, topv_l, rowcols_l, tags_l = [], [], [], []
+            for sh, sid in groups[key]:
+                TRACKER.check()
+                rec = sh.read_series(mst, sid, ctx.tmin, ctx.tmax,
+                                     fields=read_fields)
+                col = rec.columns.get(sel_field)
+                if col is None or len(rec) == 0:
+                    continue
+                m = col.valid.copy()
+                if sc.has_row_filter:
+                    m &= cond.eval_row_filter(
+                        sc, rec, tags=sh.index.tags_of(sid))
+                if not m.any():
+                    continue
+                times_l.append(rec.times[m])
+                topv_l.append(col.values[m].astype(np.float64))
+                per = {}
+                for fname in read_fields:
+                    c2 = rec.columns.get(fname)
+                    if c2 is not None:
+                        per[fname] = (c2.values[m], c2.valid[m], c2.ftype)
+                rowcols_l.append(per)
+                tags_l.append((sh.index.tags_of(sid), int(m.sum())))
+            if not times_l:
+                continue
+            t = np.concatenate(times_l)
+            v = np.concatenate(topv_l)
+            src_i = np.concatenate([
+                np.full(n, i, np.int32)
+                for i, (_tg, n) in enumerate(tags_l)
+            ])
+            off_i = np.concatenate([
+                np.arange(n, dtype=np.int64) for _tg, n in tags_l
+            ])
+            order = np.argsort(t, kind="stable")
+            t, v, src_i, off_i = t[order], v[order], src_i[order], off_i[order]
+
+            def window_bounds():
+                if not group_time:
+                    return [slice(None)]
+                bs = np.searchsorted(
+                    t, [aligned + w * group_time.every_ns for w in range(W + 1)])
+                return [slice(bs[w], bs[w + 1]) for w in range(W)]
+
+            def row_value(spec, si, oi):
+                per = rowcols_l[si]
+                if spec[0] == "tag":
+                    return tags_l[si][0].get(spec[1])
+                if spec[0] == "field":
+                    got = per.get(spec[1])
+                    if got is None or not got[1][oi]:
+                        return None
+                    return _pyval(got[0][oi], got[2])
+                return _eval_scalar_row(spec[1], per, tags_l[si][0], oi)
+
+            rows = []
+            for sl in window_bounds():
+                idx = fnmod.select_top_bottom_idx(
+                    call_name, t[sl], v[sl], params)
+                base = sl.start or 0
+                for i in idx:
+                    gi = base + int(i)
+                    row = [int(t[gi])]
+                    for _n, spec in cols:
+                        if spec[0] == "top":
+                            row.append(_pyval(v[gi], schema.get(sel_field)))
+                        else:
+                            row.append(
+                                row_value(spec, int(src_i[gi]), int(off_i[gi])))
+                    rows.append(row)
+            if not stmt.ascending:
+                rows.reverse()
+            if stmt.offset:
+                rows = rows[stmt.offset:]
+            if stmt.limit:
+                rows = rows[: stmt.limit]
+            if not rows:
+                continue
+            series = {"name": mst, "columns": ["time"] + [n for n, _s in cols],
+                      "values": rows}
+            if ctx.group_tags:
+                series["tags"] = dict(zip(ctx.group_tags, key))
+            out_series.append(series)
+        return out_series
+
     # -- host function path (transforms, mode/integral/top/bottom/...) ------
 
     def _select_host(self, stmt, db, rp, mst, now_ns) -> list[dict]:
@@ -2391,6 +2671,29 @@ class Executor:
         groups: dict[tuple, list] = {}
         for sh, sid, gid in ctx.scan_plan:
             groups.setdefault(ctx.group_keys[gid], []).append((sh, sid))
+
+        # top/bottom with companion columns (wildcards, fields, math):
+        # detected before plan resolution — companions are not calls
+        if len(stmt.fields) > 1:
+            tb = [
+                _strip_expr(f.expr) for f in stmt.fields
+                if isinstance(_strip_expr(f.expr), ast.Call)
+                and _strip_expr(f.expr).name.lower() in ("top", "bottom")
+            ]
+            if len(tb) == 1 and all(
+                not isinstance(_strip_expr(f.expr), ast.Call)
+                or _strip_expr(f.expr) is tb[0]
+                for f in stmt.fields
+            ):
+                e = tb[0]
+                _kind, call_name, field, params, _inner = _resolve_host_call(
+                    e, group_time)
+                name = next(
+                    (f.alias for f in stmt.fields
+                     if _strip_expr(f.expr) is e and f.alias),
+                    _default_field_name(e))
+                return self._select_top_companions(
+                    stmt, ctx, (name, call_name, field, params), mst)
 
         # resolve output columns
         plans = []  # (name, kind, call_name, field, params, inner_agg|None)
@@ -2651,6 +2954,8 @@ class Executor:
                                    [[_json.dumps(graph, sort_keys=True)]])]}
 
     def _select_raw(self, stmt, db, rp, mst, now_ns) -> list[dict]:
+        if self.engine.is_measurement_dropped(db, mst):
+            return []  # mark-deleted: hidden from SELECT pre-purge
         shards_all, _live = self._all_shards_with_remote(
             db, rp, mst, stmt.condition, now_ns
         )
@@ -2660,6 +2965,12 @@ class Executor:
             tag_keys.update(sh.index.tag_keys(mst))
             schema.update(sh.schema(mst))
         if not schema:
+            if stmt.group_by_all_tags:
+                # GROUP BY * requires the measurement's tag keys from
+                # meta — a missing measurement is an error there, not an
+                # empty result (reference meta.Measurement ->
+                # ErrMeasurementNotFound; TestServer_Query_Where_Fields)
+                raise QueryError("measurement not found")
             return []
         sc = cond.split(stmt.condition, tag_keys, now_ns)
         shards = [sh for sh in shards_all if sh.tmax > sc.tmin and sh.tmin < sc.tmax]
@@ -2669,40 +2980,73 @@ class Executor:
         # output columns: * expands to fields + tags, except tags consumed
         # by GROUP BY (explicit or *), which surface in the series tags dict
         # (influx wildcard semantics)
-        grouped_tags = (
-            tag_keys
-            if stmt.group_by_all_tags or getattr(stmt, "_from_subquery", False)
-            else set(stmt.group_by_tags)
-        )
-        names: list[tuple[str, str]] = []  # (output name, source ref)
-        const_cols: dict[str, str] = {}  # output name -> literal value
+        if stmt.group_by_all_tags:
+            grouped_tags = tag_keys
+        elif getattr(stmt, "_from_subquery", False):
+            # inner EXPLICIT group-by tags are subquery output dimensions:
+            # the outer wildcard lists them as columns
+            grouped_tags = tag_keys - set(getattr(stmt, "_subquery_dims", ()))
+        else:
+            grouped_tags = set(stmt.group_by_tags)
+        names: list[tuple] = []  # (output name, kind, payload)
         for f in stmt.fields:
             e = _strip_expr(f.expr)
             if isinstance(e, ast.Wildcard):
                 names.extend(
-                    (n, n) for n in sorted(set(schema) | (tag_keys - grouped_tags))
+                    (n, "ref", n)
+                    for n in sorted(set(schema) | (tag_keys - grouped_tags))
                 )
             elif isinstance(e, ast.StringLiteral):
                 # constant column (validated to carry an alias upstream)
-                out_name = f.alias or _default_field_name(f.expr)
-                const_cols[out_name] = e.val
-                names.append((out_name, ""))
+                names.append(
+                    (f.alias or _default_field_name(f.expr), "const", e.val))
+            elif (
+                isinstance(e, (ast.BinaryExpr, ast.UnaryExpr))
+                and not _calls_in(e)
+            ):
+                # scalar field math (`f1 + f2 + f3`, `100 - age`): null
+                # unless every referenced field is present on the row;
+                # rows where ANY referenced field exists still emit
+                # (reference TestServer_Query_SubqueryMath)
+                names.append(
+                    (f.alias or _default_field_name(f.expr), "expr", e))
             else:
                 src_name = e.name if isinstance(e, ast.VarRef) else ""
                 names.append(
-                    (f.alias or _default_field_name(f.expr), src_name)
-                )
-        # dedupe keep order (by output name)
-        seen = set()
-        out_cols = [nm for nm in names if not (nm[0] in seen or seen.add(nm[0]))]
+                    (f.alias or _default_field_name(f.expr), "ref", src_name))
+        # duplicate output names get _N suffixes, all columns kept —
+        # `SELECT value, * FROM m` yields value, ..., value_1 (influx
+        # duplicate-column naming; TestServer_Query_Wildcards#4). const/
+        # expr lookups key by the FINAL (suffixed) name so colliding
+        # aliases stay wired to their own payloads.
+        used: dict[str, int] = {}
+        out_cols = []  # (final name, source ref)
+        const_cols: dict[str, str] = {}  # final name -> literal value
+        expr_cols: dict[str, object] = {}  # final name -> scalar expr AST
+        for n, kind, payload in names:
+            k = used.get(n, 0)
+            used[n] = k + 1
+            final = f"{n}_{k}" if k else n
+            if kind == "const":
+                const_cols[final] = payload
+                out_cols.append((final, final))
+            elif kind == "expr":
+                expr_cols[final] = payload
+                out_cols.append((final, final))
+            else:
+                out_cols.append((final, payload or n))
         columns = ["time"] + [n for n, _s in out_cols]
-        src_of = {n: (s_ or n) for n, s_ in out_cols}
+        src_of = {n: s_ for n, s_ in out_cols}
 
         group_tags = self._group_tags(stmt, shards, mst)
         groups: dict[tuple, list] = {}
         match_terms = cond.conjunctive_match_terms(sc.field_expr)
         hinted = bool({"full_series", "specific_series"}
                       & set(getattr(stmt, "hints", ())))
+        exact_tags = (
+            cond.exact_series_tags(stmt.condition, tag_keys)
+            if "full_series" in getattr(stmt, "hints", ()) else None
+        ) or None  # no tag equalities -> the hint pins nothing
         for sh in shards:
             sids = cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
             if sc.mixed_expr is not None:
@@ -2712,6 +3056,9 @@ class Executor:
                 else:
                     sids &= cond.tag_superset_sids(
                         sc.mixed_expr, sh.index, mst, sc.tag_keys)
+            if exact_tags is not None:
+                sids = {s for s in sids
+                        if sh.index.tags_of(s) == exact_tags}
             sids = _prune_text_sids(sh, mst, sids, match_terms)
             for sid in sorted(sids):
                 tags = sh.index.tags_of(sid)
@@ -2720,11 +3067,15 @@ class Executor:
         if hinted:
             sc.mixed_series_level = True  # consumed at the series level
 
-        # project only needed columns: selected fields + filter refs
+        # project only needed columns: selected fields + filter refs +
+        # scalar-math operand fields
         filter_refs = cond.row_filter_refs(sc)
+        expr_refs: set[str] = set()
+        for e in expr_cols.values():
+            expr_refs |= _scalar_refs(e)
         read_fields = sorted(
             ({src_of[c] for c in columns[1:] if src_of[c] in schema}
-             | set(filter_refs)) & set(schema)
+             | set(filter_refs) | expr_refs) & set(schema)
         )
         # tag-only selects (e.g. SELECT "name" FROM m, openGemini
         # semantics): a row exists wherever ANY field is set, so read
@@ -2755,6 +3106,12 @@ class Executor:
                         col_arrays.append((None, None, const_cols[name]))
                         continue
                     ref = src_of[name]
+                    if ref in expr_cols:
+                        vals, valid, touched = _eval_scalar_cols(
+                            expr_cols[ref], rec)
+                        col_arrays.append((vals, valid, FieldType.FLOAT))
+                        present |= touched
+                        continue
                     col = rec.columns.get(ref)
                     if col is not None:
                         col_arrays.append((col.values, col.valid, col.ftype))
@@ -2779,7 +3136,14 @@ class Executor:
                     rows.append(row)
             if not rows:
                 continue
-            rows.sort(key=lambda r: r[0], reverse=not stmt.ascending)
+            if getattr(stmt, "_subquery_dims", None) and not group_tags:
+                # ungrouped select over a dimensioned subquery keeps the
+                # inner series order (rows appended per-series, ascending
+                # within each — reference SubqueryForLogicalOptimize#5)
+                if not stmt.ascending:
+                    rows.reverse()
+            else:
+                rows.sort(key=lambda r: r[0], reverse=not stmt.ascending)
             series = {"name": mst, "columns": columns, "values": rows}
             if group_tags:
                 series["tags"] = dict(zip(group_tags, key))
@@ -2812,11 +3176,17 @@ class Executor:
     def _all_shards_db(self, db: str):
         return self.engine.shards_for_range(db, None, cond.MIN_TIME, cond.MAX_TIME)
 
+    def _visible(self, db: str, mst: str) -> bool:
+        """False for mark-deleted measurements (hidden from SELECT and
+        metadata SHOWs; SHOW SERIES intentionally still lists their series
+        until the purge — reference TestServer_Query_ShowSeries)."""
+        return not self.engine.is_measurement_dropped(db, mst)
+
     def _show_measurements(self, stmt, db) -> dict:
         db = stmt.database or db
         names: set[str] = set()
         for sh in self._all_shards_db(db):
-            names.update(sh.measurements())
+            names.update(m for m in sh.measurements() if self._visible(db, m))
         if self.router is not None:
             try:
                 names.update(self.router.remote_measurements(db, None))
@@ -2860,7 +3230,7 @@ class Executor:
         per_mst: dict[str, set] = {}
         for sh in self._all_shards_db(db):
             for mst in sh.measurements():
-                if not self._mst_match(stmt, mst):
+                if not self._mst_match(stmt, mst) or not self._visible(db, mst):
                     continue
                 if stmt.condition is not None:
                     for sid in self._matching_sids(sh, mst, stmt.condition):
@@ -2881,7 +3251,7 @@ class Executor:
         per_mst: dict[str, set] = {}
         for sh in self._all_shards_db(db):
             for mst in sh.measurements():
-                if not self._mst_match(stmt, mst):
+                if not self._mst_match(stmt, mst) or not self._visible(db, mst):
                     continue
                 wanted = [
                     k for k in sh.index.tag_keys(mst)
@@ -2919,7 +3289,7 @@ class Executor:
         per_mst: dict[str, dict] = {}
         for sh in self._all_shards_db(db):
             for mst in sh.measurements():
-                if not self._mst_match(stmt, mst):
+                if not self._mst_match(stmt, mst) or not self._visible(db, mst):
                     continue
                 per_mst.setdefault(mst, {}).update(sh.schema(mst))
         type_names = {
@@ -3170,6 +3540,106 @@ def _collect_calls(fields) -> list[ast.Call]:
     for f in fields:
         out.extend(_calls_in(f.expr))
     return out
+
+
+def _eval_scalar_row(e, per: dict, tags: dict, oi: int):
+    """One-row scalar-math evaluation over companion columns (`per` maps
+    field -> (values, valid, ftype)). None propagates through every op."""
+    e = _strip_expr(e)
+    if isinstance(e, ast.VarRef):
+        got = per.get(e.name)
+        if got is None or not got[1][oi]:
+            return None
+        try:
+            return float(got[0][oi])
+        except (TypeError, ValueError):
+            return None
+    if isinstance(e, (ast.NumberLiteral, ast.IntegerLiteral,
+                      ast.DurationLiteral)):
+        return float(e.val)
+    if isinstance(e, ast.UnaryExpr):
+        v = _eval_scalar_row(e.expr, per, tags, oi)
+        if v is None:
+            return None
+        return -v if e.op == "-" else v
+    if isinstance(e, ast.BinaryExpr):
+        lv = _eval_scalar_row(e.lhs, per, tags, oi)
+        rv = _eval_scalar_row(e.rhs, per, tags, oi)
+        if lv is None or rv is None:
+            return None
+        if e.op == "+":
+            return lv + rv
+        if e.op == "-":
+            return lv - rv
+        if e.op == "*":
+            return lv * rv
+        if e.op == "/":
+            return lv / rv if rv else None
+        if e.op == "%":
+            return lv % rv if rv else None
+    return None
+
+
+def _scalar_refs(e) -> set[str]:
+    """Field names referenced by a scalar-math projection expression."""
+    e = _strip_expr(e)
+    if isinstance(e, ast.VarRef):
+        return {e.name}
+    if isinstance(e, ast.BinaryExpr):
+        return _scalar_refs(e.lhs) | _scalar_refs(e.rhs)
+    if isinstance(e, ast.UnaryExpr):
+        return _scalar_refs(e.expr)
+    return set()
+
+
+def _eval_scalar_cols(e, rec):
+    """Vectorized scalar-math projection over one record.
+
+    Returns (values f64, valid, touched): `valid` requires EVERY operand
+    field present (influx null-propagation — `f1 + f2` is null when either
+    side is), `touched` is true where ANY referenced field is present (the
+    row still emits with a null value, TestServer_Query_SubqueryMath#0).
+    """
+    n = len(rec)
+    e = _strip_expr(e)
+    if isinstance(e, ast.VarRef):
+        col = rec.columns.get(e.name)
+        if col is None or col.ftype == FieldType.STRING:
+            z = np.zeros(n, bool)
+            return np.zeros(n), z, z.copy()
+        vals = np.where(col.valid, col.values.astype(np.float64), 0.0)
+        return vals, col.valid.copy(), col.valid.copy()
+    if isinstance(e, (ast.NumberLiteral, ast.IntegerLiteral,
+                      ast.DurationLiteral)):
+        ones = np.ones(n, bool)
+        return np.full(n, float(e.val)), ones, np.zeros(n, bool)
+    if isinstance(e, ast.UnaryExpr):
+        vals, valid, touched = _eval_scalar_cols(e.expr, rec)
+        return (-vals if e.op == "-" else vals), valid, touched
+    if isinstance(e, ast.BinaryExpr):
+        lv, lok, lt = _eval_scalar_cols(e.lhs, rec)
+        rv, rok, rt = _eval_scalar_cols(e.rhs, rec)
+        valid = lok & rok
+        touched = lt | rt
+        with np.errstate(all="ignore"):
+            if e.op == "+":
+                out = lv + rv
+            elif e.op == "-":
+                out = lv - rv
+            elif e.op == "*":
+                out = lv * rv
+            elif e.op == "/":
+                valid = valid & (rv != 0)  # x/0 is null (influx)
+                out = np.divide(lv, np.where(rv != 0, rv, 1.0))
+            elif e.op == "%":
+                valid = valid & (rv != 0)
+                out = np.mod(lv, np.where(rv != 0, rv, 1.0))
+            else:
+                z = np.zeros(n, bool)
+                return np.zeros(n), z, touched
+        return out, valid, touched
+    z = np.zeros(n, bool)
+    return np.zeros(n), z, z.copy()
 
 
 def _calls_in(e) -> list[ast.Call]:

@@ -255,20 +255,28 @@ def holt_winters(times: np.ndarray, values: np.ndarray, n_forecast: int,
     return f_times, f_vals
 
 
+def select_top_bottom_idx(name: str, times: np.ndarray, values: np.ndarray,
+                          params: tuple) -> np.ndarray:
+    """Row indices selected by top()/bottom(): extreme value first, value
+    ties take the OLDEST timestamp (influx rule), output ordered by time.
+    Exposed separately so companion-column projections can fetch other
+    fields of the selected rows (reference TestServer_Query_For_BugList#2:
+    `SELECT TOP(f, 2), *`)."""
+    n = int(params[0]) if params else 1
+    n = min(n, len(values))
+    order = (np.lexsort((times, -values)) if name == "top"
+             else np.lexsort((times, values)))
+    idx = order[:n]
+    return idx[np.argsort(times[idx], kind="stable")]
+
+
 def multi_row(name: str, times: np.ndarray, values: np.ndarray, params: tuple,
               rng: np.random.Generator | None = None):
     """top/bottom/sample/distinct: list of (time_ns, value) output rows."""
     if len(values) == 0:
         return []
     if name in ("top", "bottom"):
-        n = int(params[0]) if params else 1
-        n = min(n, len(values))
-        if name == "top":
-            idx = np.argpartition(-values, n - 1)[:n]
-        else:
-            idx = np.argpartition(values, n - 1)[:n]
-        # influx orders output rows by time
-        idx = idx[np.argsort(times[idx], kind="stable")]
+        idx = select_top_bottom_idx(name, times, values, params)
         return [(int(times[i]), values[i].item()) for i in idx]
     if name == "sample":
         n = int(params[0]) if params else 1

@@ -540,7 +540,13 @@ def _merge_selector(
         pick = np.argmin(tkey, 0)
     else:
         key = np.where(P, T, _BIG if name == "first" else -_BIG)
-        pick = np.argmin(key, 0) if name == "first" else np.argmax(key, 0)
+        tbest = key.min(0) if name == "first" else key.max(0)
+        cand = P & (T == tbest[None, :])
+        # exact-time ties across sources: larger value wins (reference
+        # FirstReduce/LastReduce); remaining ties to stack order
+        vbest = np.where(cand, V, -np.inf).max(0)
+        cand &= V == vbest[None, :]
+        pick = np.argmax(cand, 0)
     idx = (pick, np.arange(n_seg))
     return V[idx], T[idx]
 

@@ -14,3 +14,5 @@ class RetentionService(Service):
 
     def handle(self) -> None:
         self.engine.drop_expired_shards()
+        # the deferred half of DROP MEASUREMENT (mark-delete semantics)
+        self.engine.purge_dropped_measurements()

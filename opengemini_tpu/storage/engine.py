@@ -21,6 +21,24 @@ from opengemini_tpu.utils.stats import GLOBAL as STATS
 NS = 1_000_000_000
 DEFAULT_SHARD_DURATION = 7 * 24 * 3600 * NS  # influx 1w default for infinite RPs
 
+# Go time.Time zero (year 1, Jan 1 — a Monday) relative to the Unix epoch:
+# the reference aligns shard groups with Go's Truncate, which rounds to
+# multiples of the duration SINCE THE ZERO TIME (meta/data.go:2348), so 7d
+# groups start on Mondays, not the epoch's Thursday grid. The offset in ns
+# overflows int64, so alignment uses its residue mod the duration (the
+# phase) — same grid, int64-safe (works for numpy vectorized forms too).
+_GO_ZERO_S = -62135596800  # seconds; *NS overflows int64
+
+
+def _go_phase_ns(dur_ns: int) -> int:
+    return (_GO_ZERO_S * NS) % dur_ns  # python ints: exact, non-negative
+
+
+def shard_group_start(t_ns: int, dur_ns: int) -> int:
+    """Shard-group start containing t_ns: Go Truncate alignment."""
+    phase = _go_phase_ns(dur_ns)
+    return (t_ns - phase) // dur_ns * dur_ns + phase
+
 
 class RetentionPolicy:
     def __init__(self, name: str, duration_ns: int = 0, shard_duration_ns: int = DEFAULT_SHARD_DURATION):
@@ -114,6 +132,13 @@ class Database:
         self.downsample: dict[str, list[DownsamplePolicy]] = {}
         self.streams: dict[str, StreamTask] = {}
         self.subscriptions: dict[str, object] = {}
+        # DROP MEASUREMENT is a mark + deferred purge (reference:
+        # MarkMeasurementDelete, lifted/influx/coordinator/
+        # statement_executor.go:894): queries hide marked measurements
+        # immediately, SHOW SERIES keeps their series until the purge
+        # actually runs (the reference black-box suite asserts this,
+        # tests/server_test.go TestServer_Query_ShowSeries)
+        self.dropped_msts: set[str] = set()
 
 
 class WriteError(Exception):
@@ -183,6 +208,7 @@ class Engine:
             for sj in dbj.get("subscriptions", []):
                 sub = Subscription.from_json(sj)
                 db.subscriptions[sub.name] = sub
+            db.dropped_msts = set(dbj.get("dropped_msts", []))
             self.databases[db.name] = db
         self.obs_shards = {
             (d, r, int(s)) for d, r, s in j.get("obs_shards", [])
@@ -205,6 +231,7 @@ class Engine:
                     "subscriptions": [
                         s.to_json() for s in db.subscriptions.values()
                     ],
+                    "dropped_msts": sorted(db.dropped_msts),
                 }
                 for db in self.databases.values()
             ]
@@ -306,7 +333,7 @@ class Engine:
         if rp_meta is None:
             raise WriteError(f"retention policy not found: {db}.{rp}")
         dur = rp_meta.shard_duration_ns
-        group_start = t_ns // dur * dur
+        group_start = shard_group_start(t_ns, dur)
         key = (db, rp, group_start)
         shard = self._shards.get(key)
         if shard is None:
@@ -325,6 +352,52 @@ class Engine:
             )
             self._shards[key] = shard
         return shard
+
+    # -- DROP MEASUREMENT: mark + deferred purge ----------------------------
+
+    def mark_measurement_delete(self, db: str, mst: str) -> None:
+        """The reference's MarkMeasurementDelete: DROP MEASUREMENT only
+        marks; SELECT/SHOW MEASUREMENTS hide it immediately, the data and
+        its index entries survive until purge_dropped_measurements runs
+        (retention tick, or synchronously before a new write to the name)."""
+        d = self.databases.get(db)
+        if d is None:
+            raise DatabaseNotFound(db)
+        with self._lock:
+            d.dropped_msts.add(mst)
+            self._save_meta()
+
+    def is_measurement_dropped(self, db: str, mst: str) -> bool:
+        d = self.databases.get(db)
+        return d is not None and mst in d.dropped_msts
+
+    def purge_dropped_measurements(self, db: str | None = None) -> int:
+        """Physically delete mark-dropped measurements. Returns the number
+        purged. Driven by the retention service; also runs synchronously
+        before writes to a database with pending marks so old rows cannot
+        resurface under a recreated measurement name."""
+        n = 0
+        with self._lock:
+            for name, d in self.databases.items():
+                if db is not None and name != db:
+                    continue
+                if not d.dropped_msts:
+                    continue
+                # offloaded (object-store) groups hold data too: hydrate
+                # them first or the purge misses rows that would resurface
+                # on the next query-driven hydration
+                for (sdb, rp, g) in sorted(self.obs_shards):
+                    if sdb == name:
+                        self._hydrate_shard(sdb, rp, g)
+                for mst in sorted(d.dropped_msts):
+                    for (sdb, _rp, _g), sh in list(self._shards.items()):
+                        if sdb == name:
+                            sh.delete_data(mst)
+                    n += 1
+                d.dropped_msts.clear()
+            if n:
+                self._save_meta()
+        return n
 
     def attach_object_store(self, store) -> None:
         self.obs_store = store
@@ -514,6 +587,10 @@ class Engine:
         d = self.databases.get(db)
         if d is None:
             raise DatabaseNotFound(db)
+        if d.dropped_msts:
+            # a marked measurement being rewritten must not resurface its
+            # old rows: purge before accepting the batch
+            self.purge_dropped_measurements(db)
         rp = rp or d.default_rp
         if now_ns is None:
             now_ns = _time.time_ns()
@@ -570,7 +647,9 @@ class Engine:
         if rp_meta is None:
             raise WriteError(f"retention policy not found: {db}.{rp}")
         dur = rp_meta.shard_duration_ns
-        groups = batch.ts // dur * dur
+        # vectorized shard_group_start (Go Truncate alignment)
+        phase = _go_phase_ns(dur)
+        groups = (batch.ts - phase) // dur * dur + phase
         uniq = np.unique(groups)
         n = 0
         for g in uniq:
@@ -735,6 +814,8 @@ class Engine:
         d = self.databases.get(db)
         if d is None:
             raise DatabaseNotFound(db)
+        if d.dropped_msts:
+            self.purge_dropped_measurements(db)
         rp = rp or d.default_rp
         with self._lock:
             by_shard: dict[int, list] = {}

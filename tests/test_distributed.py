@@ -91,7 +91,9 @@ def test_graft_entry_single_and_multichip():
 
 def test_first_tie_not_averaged(mesh):
     """Equal earliest timestamps on different devices: result must be one
-    actual row's value (lowest device rank), never an average."""
+    actual row's value, never an average. Exact-time ties take the larger
+    value (reference agg_func.go FirstReduce,
+    TestServer_Query_Aggregates_IdenticalTime)."""
     n, num_segments = 800, 1
     rel_ns = np.full(n, 1_000_000, dtype=np.int64)  # all rows tie
     values = np.arange(n, dtype=np.float64)
@@ -103,9 +105,8 @@ def test_first_tie_not_averaged(mesh):
     out = jax.tree.map(
         np.asarray, step(*dist.shard_rows(mesh, values, rel_hi, rel_lo, seg_ids, mask))
     )
-    # device 0 holds rows [0, 100); its local first is row 0 (scan order)
-    assert out["first"][0] == 0.0
-    assert out["last"][0] in values  # an actual row value
+    assert out["first"][0] == values.max()
+    assert out["last"][0] == values.max()
 
 
 class TestExecutorMeshPath:
