@@ -172,6 +172,10 @@ class Executor:
         # across HTTP threads (duplicate CREATE USER would silently replace
         # the first user's credentials)
         self._user_ddl_lock = _threading.Lock()
+        # incremental GROUP BY time() result cache (query/resultcache.py)
+        from opengemini_tpu.query.resultcache import IncrementalCache
+
+        self._inc_cache = IncrementalCache()
         # per-thread stack of CTE names being expanded (cycle detection)
         self._cte_state = _threading.local()
 
@@ -1878,6 +1882,41 @@ class Executor:
             for f in needed_fields
         }
 
+        # incremental result cache (reference inc_agg_transform +
+        # lib/resultcache): GROUP BY time() windows whose shards took no
+        # writes since the last execution are served from cached
+        # (value, count) cells; only the stale hull is scanned/computed
+        cache_plan = None
+        if (
+            group_time is not None
+            and W >= 1
+            and self.router is None
+            and ctx.live is None
+            and not time_aggs
+            and len(ctx.group_keys) <= 20_000  # cache growth gate
+            and all(hasattr(sh, "data_version") for sh in shards)
+        ):
+            from opengemini_tpu.query import resultcache as rcache
+
+            fp = rcache.fingerprint(
+                db, rp, mst, sc, group_time, group_tags,
+                stmt.group_by_all_tags,
+                [(spec.name, params, fname)
+                 for _c, spec, params, fname in aggs],
+            )
+            cache_plan = rcache.CachePlan(
+                self._inc_cache, fp, shards, aligned,
+                group_time.every_ns, W, len(aggs), tmin, tmax)
+        full_hit = cache_plan is not None and not cache_plan.scan_ranges
+        scan_ranges = [(tmin, tmax)]
+        if cache_plan is not None and cache_plan.scan_ranges:
+            # disjoint stale runs: a now()-relative dashboard query scans
+            # only its partial edge windows + actually-written windows
+            scan_ranges = [
+                (max(tmin, lo), min(tmax, hi))
+                for lo, hi in cache_plan.scan_ranges
+            ]
+
         # string fields only support count on the device path (reference
         # supports first/last/distinct on strings — host path, later round)
         for call, spec, params, field_name in aggs:
@@ -1939,8 +1978,8 @@ class Executor:
             # many series are scanned (packed colstore chunks decode once
             # for all their series; kills the per-sid Python loop that
             # dominated config #5 — BASELINE.md round-2 profile)
-            remaining_plan = scan_plan
-            if not pre_eligible:
+            remaining_plan = [] if full_hit else scan_plan
+            if not pre_eligible and not full_hit:
                 by_shard: dict[int, tuple] = {}
                 for sh, sid, gid in scan_plan:
                     by_shard.setdefault(id(sh), (sh, []))[1].append((sid, gid))
@@ -1955,26 +1994,29 @@ class Executor:
                     gid_list = np.asarray([p[1] for p in pairs], np.int64)
                     o = np.argsort(sid_list)
                     sid_sorted, gid_sorted = sid_list[o], gid_list[o]
-                    sid_arr, rec = sh.read_series_bulk(
-                        mst, sid_sorted, tmin, tmax, fields=read_fields)
-                    if len(rec) == 0:
-                        continue
-                    rows_scanned += len(rec)
-                    fmask = (
-                        cond.eval_row_filter(sc, rec, sid_arr=sid_arr,
-                                             index=sh.index)
-                        if sc.has_row_filter
-                        else None
-                    )
-                    gid_rows = gid_sorted[np.searchsorted(sid_sorted, sid_arr)]
-                    if group_time:
-                        widx, _ = winmod.window_index(
-                            rec.times, tmin, group_time.every_ns,
-                            group_time.offset_ns)
-                        seg = (gid_rows * W + widx.astype(np.int64)).astype(np.int32)
-                    else:
-                        seg = gid_rows.astype(np.int32)
-                    _scan_record(rec, seg, sids=sid_arr)
+                    for rlo, rhi in scan_ranges:
+                        sid_arr, rec = sh.read_series_bulk(
+                            mst, sid_sorted, rlo, rhi, fields=read_fields)
+                        if len(rec) == 0:
+                            continue
+                        rows_scanned += len(rec)
+                        fmask = (
+                            cond.eval_row_filter(sc, rec, sid_arr=sid_arr,
+                                                 index=sh.index)
+                            if sc.has_row_filter
+                            else None
+                        )
+                        gid_rows = gid_sorted[
+                            np.searchsorted(sid_sorted, sid_arr)]
+                        if group_time:
+                            widx, _ = winmod.window_index(
+                                rec.times, tmin, group_time.every_ns,
+                                group_time.offset_ns)
+                            seg = (gid_rows * W + widx.astype(np.int64)
+                                   ).astype(np.int32)
+                        else:
+                            seg = gid_rows.astype(np.int32)
+                        _scan_record(rec, seg, sids=sid_arr)
             for sh, sid, gid in remaining_plan:
                 TRACKER.check()  # KILL QUERY cancellation point
                 if pre_eligible:
@@ -1986,23 +2028,27 @@ class Executor:
                         pre_used = True
                         rows_scanned += got_rows
                         continue
-                rec = sh.read_series(mst, sid, tmin, tmax, fields=read_fields)
-                if len(rec) == 0:
-                    continue
-                rows_scanned += len(rec)
-                fmask = (
-                    cond.eval_row_filter(sc, rec, tags=sh.index.tags_of(sid))
-                    if sc.has_row_filter
-                    else None
-                )
-                if group_time:
-                    widx, _ = winmod.window_index(
-                        rec.times, tmin, group_time.every_ns, group_time.offset_ns
+                for rlo, rhi in scan_ranges:
+                    rec = sh.read_series(mst, sid, rlo, rhi,
+                                         fields=read_fields)
+                    if len(rec) == 0:
+                        continue
+                    rows_scanned += len(rec)
+                    fmask = (
+                        cond.eval_row_filter(
+                            sc, rec, tags=sh.index.tags_of(sid))
+                        if sc.has_row_filter
+                        else None
                     )
-                    seg = (gid * W + widx.astype(np.int64)).astype(np.int32)
-                else:
-                    seg = np.full(len(rec), gid, dtype=np.int32)
-                _scan_record(rec, seg, sids=sid)
+                    if group_time:
+                        widx, _ = winmod.window_index(
+                            rec.times, tmin, group_time.every_ns,
+                            group_time.offset_ns)
+                        seg = (gid * W + widx.astype(np.int64)
+                               ).astype(np.int32)
+                    else:
+                        seg = np.full(len(rec), gid, dtype=np.int32)
+                    _scan_record(rec, seg, sids=sid)
             scan_span.add_field("rows", rows_scanned)
         STATS.incr("executor", "rows_scanned", rows_scanned)
 
@@ -2010,6 +2056,16 @@ class Executor:
         agg_results = {}  # id(call) -> (values, sel, counts)
         with trace.span("device_compute") as sp:
             for call, spec, params, field_name in aggs:
+                if full_hit:
+                    # every window served from cache: no scan, no device
+                    dt = (np.int64 if isinstance(
+                        batches[field_name], ragged.IntExactBatch)
+                        and spec.name in ("sum", "count") else np.float64)
+                    agg_results[id(call)] = (
+                        np.zeros(num_segments, dt), None,
+                        np.zeros(num_segments, np.int64), spec,
+                        field_name, None)
+                    continue
                 out, sel, counts = batches[field_name].run(spec, num_segments, params)
                 if pre_used:
                     # combine device partials with pre-agg contributions
@@ -2087,6 +2143,9 @@ class Executor:
                     )
                 sp.add_field("peers", len(peer_docs))
 
+        if cache_plan is not None:
+            with trace.span("inc_cache"):
+                group_keys = cache_plan.merge(agg_results, aggs, group_keys)
         with trace.span("render"):
             return self._render_agg(
                 stmt, mst, group_tags, group_keys, aligned, W, agg_results,

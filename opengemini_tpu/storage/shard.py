@@ -9,6 +9,7 @@ Compact :688, commitSnapshot :1008) and the per-shard WAL replay
 from __future__ import annotations
 
 import os
+import itertools
 import threading
 
 import numpy as np
@@ -105,6 +106,10 @@ def _write_measurement_chunks(w: TSFWriter, tidx, mst: str, entries,
         w.add_packed_chunk(mst, sids, packed)
 
 
+_DATA_VERSIONS = itertools.count(1)  # see Shard.data_version
+_MUT_LOG_MAX = 512  # bounded mutation history; overflow = assume-changed
+
+
 class Shard:
     supports_preagg = True  # RemoteShard proxies set False (no chunk meta)
 
@@ -114,6 +119,19 @@ class Shard:
         self.tmax = tmax  # exclusive ns
         os.makedirs(path, exist_ok=True)
         self.index = open_series_index(path)
+        # LOGICAL-content version + bounded mutation log: versions are
+        # drawn from a process-global counter so a (path, version) pair
+        # can never repeat — a dropped-and-recreated shard at the same
+        # path cannot alias a stale cache signature. The log records each
+        # mutation's TIME RANGE so the incremental result cache
+        # (query/resultcache.py) invalidates only the touched windows of
+        # this shard, not all of them (a 7d shard covers every window of a
+        # dashboard query). Flush/compact change layout, not content, and
+        # do not bump. Reference analogue: the query iterID + write
+        # tracking of inc_agg_transform.go / lib/resultcache.
+        self.data_version = next(_DATA_VERSIONS)
+        self._mut_floor = self.data_version  # history unknown at/below
+        self._mutations: list[tuple[int, int, int]] = []
         # measurement -> field -> FieldType; owned here so it survives
         # memtable generations and is seeded from immutable files on open.
         self.schemas: dict[str, dict] = {}
@@ -128,6 +146,31 @@ class Shard:
                 self.schemas.setdefault(mst, {}).update(r.schema(mst))
         self.wal = WAL(os.path.join(path, "wal.log"), sync=sync_wal)
         self._replay_wal()
+
+    def _note_mutation(self, lo: int, hi: int) -> None:
+        """Record a logical-content change over [lo, hi) ns."""
+        self.data_version = next(_DATA_VERSIONS)
+        self._mutations.append((self.data_version, lo, hi))
+        if len(self._mutations) > _MUT_LOG_MAX:
+            drop = len(self._mutations) // 2
+            self._mut_floor = self._mutations[drop - 1][0]
+            # REPLACE, never truncate in place: lockless readers iterate
+            # their own snapshot (a shrinking list would silently end a
+            # reversed() iterator early and hide recent mutations)
+            self._mutations = self._mutations[drop:]
+
+    def changed_since(self, version: int, lo: int, hi: int) -> bool:
+        """Did any mutation newer than `version` touch [lo, hi)?
+        Conservative: truncated history answers True."""
+        if version < self._mut_floor:
+            return True
+        muts = self._mutations  # snapshot ref (list is replaced, not cut)
+        for v, mlo, mhi in reversed(muts):
+            if v <= version:
+                break
+            if mhi > lo and mlo < hi:
+                return True
+        return False
 
     # -- open/recovery ------------------------------------------------------
 
@@ -275,6 +318,8 @@ class Shard:
             m_ts = ts if all_rows else ts[idx]
             self.mem.write_columnar(mst, m_sids, m_ts, cols)
             n += len(m_ts)
+        if n:
+            self._note_mutation(int(ts.min()), int(ts.max()) + 1)
         return n
 
     def _check_types(self, points: list) -> None:
@@ -295,6 +340,9 @@ class Shard:
             sid = self.index.get_or_create(mst, tags)
             self.mem.write_row(sid, mst, t, fields)
             n += 1
+        if n:
+            self._note_mutation(
+                min(p[2] for p in points), max(p[2] for p in points) + 1)
         return n
 
     def flush(self) -> None:
@@ -579,6 +627,7 @@ class Shard:
             self._files = [TSFReader(path)]
             self._tidx_cache = {}
             _retire_files(old)
+            self._note_mutation(self.tmin, self.tmax)  # after swap (see delete_data)
             return rows
 
     def delete_data(
@@ -632,6 +681,13 @@ class Shard:
             if not wrote:
                 os.remove(path)
             _retire_files(old)
+            # version bump AFTER the swap: a concurrent query that scanned
+            # the old files must cache under the OLD version so the next
+            # execution invalidates it (bump-before would let pre-delete
+            # rows be served from cache under the post-delete version)
+            self._note_mutation(
+                tmin if tmin is not None else self.tmin,
+                tmax if tmax is not None else self.tmax)
             # index + schema cleanup for fully-deleted series
             if full_series_delete:
                 doomed = sids if sids is not None else self.index.series_ids(measurement)
