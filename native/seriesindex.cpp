@@ -483,10 +483,8 @@ void msi_free(void *p) { free(p); }
 // series insert: fields are length-prefixed in one blob:
 //   key | mst | ntags | (tagk | tagv)*
 // returns the sid (existing or new). sid_req != 0 forces the sid (replay).
-uint64_t msi_insert(void *h, const char *blob, uint64_t blob_len,
-                    uint64_t sid_req) {
-    Index *ix = (Index *)h;
-    std::lock_guard<std::mutex> g(ix->mu);
+static uint64_t insert_blob_locked(Index *ix, const char *blob,
+                                   uint64_t blob_len, uint64_t sid_req) {
     const char *p = blob, *end = blob + blob_len;
     auto field = [&](std::string_view &out) -> bool {
         if (p + 4 > end) return false;
@@ -546,6 +544,64 @@ uint64_t msi_insert(void *h, const char *blob, uint64_t blob_len,
     }
     maybe_compact(ix);
     return sid;
+}
+
+uint64_t msi_insert(void *h, const char *blob, uint64_t blob_len,
+                    uint64_t sid_req) {
+    Index *ix = (Index *)h;
+    std::lock_guard<std::mutex> g(ix->mu);
+    return insert_blob_locked(ix, blob, blob_len, sid_req);
+}
+
+// Batched canonical-key ingest: keys arrive as <u32 len><bytes> entries,
+// guaranteed escape-free by the caller (keys containing backslashes take
+// the per-key structured path). Parsing mst,k=v,... here removes the
+// per-series Python parse + pack + ctypes round-trip that dominated
+// high-cardinality ingest (BASELINE.md config #5 profile). Returns the
+// number of keys processed; sids land in out_sids.
+uint64_t msi_insert_keys(void *h, const char *blob, uint64_t blob_len,
+                         uint64_t count, uint64_t *out_sids) {
+    Index *ix = (Index *)h;
+    std::lock_guard<std::mutex> g(ix->mu);
+    const char *p = blob, *end = blob + blob_len;
+    std::string item;
+    for (uint64_t i = 0; i < count; i++) {
+        if (p + 4 > end) return i;
+        uint32_t klen;
+        memcpy(&klen, p, 4);
+        p += 4;
+        if (p + klen > end) return i;
+        std::string_view key(p, klen);
+        p += klen;
+        // build the structured blob: key | mst | ntags | (k | v)...
+        size_t c = key.find(',');
+        std::string_view mst =
+            key.substr(0, c == std::string_view::npos ? key.size() : c);
+        item.clear();
+        put_field(item, key.data(), key.size());
+        put_field(item, mst.data(), mst.size());
+        std::string tags;
+        uint32_t ntags = 0;
+        size_t pos = (c == std::string_view::npos) ? key.size() : c + 1;
+        while (pos < key.size()) {
+            size_t nc = key.find(',', pos);
+            if (nc == std::string_view::npos) nc = key.size();
+            std::string_view seg = key.substr(pos, nc - pos);
+            size_t eq = seg.find('=');
+            if (eq != std::string_view::npos) {
+                put_field(tags, seg.data(), eq);
+                put_field(tags, seg.data() + eq + 1, seg.size() - eq - 1);
+                ntags++;
+            }
+            pos = nc + 1;
+        }
+        char nle[4];
+        memcpy(nle, &ntags, 4);
+        item.append(nle, 4);
+        item += tags;
+        out_sids[i] = insert_blob_locked(ix, item.data(), item.size(), 0);
+    }
+    return count;
 }
 
 // lookup without insert; returns 0 when absent

@@ -57,6 +57,7 @@ def load():
             ("msi_close", None, [p]),
             ("msi_free", None, [p]),
             ("msi_insert", u64, [p, cp, u64, u64]),
+            ("msi_insert_keys", u64, [p, cp, u64, u64, u64p]),
             ("msi_lookup", u64, [p, cp, u64]),
             ("msi_has_live", ctypes.c_int, [p, cp, u64]),
             ("msi_series_ids", p, [p, cp, u64, u64p]),
@@ -185,6 +186,40 @@ class MergesetIndex:
             self._key_cache.clear()
         self._key_cache[key] = sid
         return sid
+
+    def get_or_create_bulk(self, keys: list[str]) -> list[int]:
+        """Batched canonical-key ingest: ONE native call parses and
+        inserts every escape-free new key (the per-key Python parse +
+        pack + ctypes crossing dominated 1M-series ingest). Keys with
+        backslash escapes keep the exact per-key path."""
+        out = [0] * len(keys)
+        plain_i: list[int] = []
+        parts: list[bytes] = []
+        cache = self._key_cache
+        for i, key in enumerate(keys):
+            sid = cache.get(key)
+            if sid is not None:
+                out[i] = sid
+            elif "\\" in key:
+                out[i] = self.get_or_create_by_key(key)
+            else:
+                kb = key.encode()
+                parts.append(struct.pack("<I", len(kb)) + kb)
+                plain_i.append(i)
+        if plain_i:
+            blob = b"".join(parts)
+            sids = (ctypes.c_uint64 * len(plain_i))()
+            with self._native() as h:
+                done = int(self._lib.msi_insert_keys(
+                    h, blob, len(blob), len(plain_i), sids))
+            if done != len(plain_i):
+                raise OSError("series index batch insert failed")
+            if len(cache) + len(plain_i) >= _TAGS_CACHE_MAX:
+                cache.clear()
+            for i, sid in zip(plain_i, sids):
+                out[i] = int(sid)
+                cache[keys[i]] = int(sid)
+        return out
 
     def flush(self) -> None:
         with self._native() as h:

@@ -51,6 +51,31 @@ def prepare_matrix(series_samples: list[tuple[np.ndarray, np.ndarray]], dtype=np
     return times, values, counts, base_ms
 
 
+def prepare_matrix_runs(t_ms_all, v_all, lens, dtype=np.float32):
+    """prepare_matrix over run-encoded input: one concatenated (times_ms,
+    values) pair with per-series lengths, filled by ONE flat scatter — no
+    per-series Python loop (the loop dominated 1M-series instant queries,
+    BASELINE.md config #5)."""
+    lens = np.asarray(lens, np.int64)
+    S = len(lens)
+    n_max = max(1, int(lens.max()) if S else 1)
+    times = np.full((S, n_max), np.inf, dtype=np.float64)
+    values = np.zeros((S, n_max), dtype=dtype)
+    total = int(lens.sum())
+    starts = np.cumsum(lens) - lens
+    base_ms = 0
+    if total:
+        # times are ascending per series, so the global min is the min of
+        # each non-empty series' first sample
+        base_ms = int(t_ms_all[starts[lens > 0]].min())
+        rows = np.repeat(np.arange(S, dtype=np.int64), lens)
+        cols = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
+        flat = rows * n_max + cols
+        times.reshape(-1)[flat] = (np.asarray(t_ms_all) - base_ms) / 1000.0
+        values.reshape(-1)[flat] = v_all
+    return times, values, lens.astype(np.int32), base_ms
+
+
 def window_bounds(times, counts, step_starts, step_ends):
     """Per (series, step) first/last sample indices inside (start, end].
 

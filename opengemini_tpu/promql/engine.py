@@ -192,14 +192,7 @@ class PromEngine:
 
     def _collect_series(self, vs: pp.VectorSelector, t_min_ns: int, t_max_ns: int, db: str):
         """-> (labels list, [(times_ms, values)] per series)."""
-        metric = vs.metric
-        for m in vs.matchers:
-            if m.name == "__name__":
-                if m.op != "=":
-                    raise PromError("__name__ supports only '=' here")
-                metric = m.value
-        if not metric:
-            raise PromError("metric name required")
+        metric = self._metric_of(vs)
         shards = self.engine.shards_for_range(db, None, t_min_ns, t_max_ns)
         out_labels: list[dict] = []
         out_samples: list[tuple[np.ndarray, np.ndarray]] = []
@@ -619,7 +612,117 @@ class PromEngine:
         labels = [_drop_name(l) for l in labels]
         return Frame(labels, np.asarray(out), np.asarray(valid))
 
+    def _metric_of(self, vs: pp.VectorSelector) -> str:
+        metric = vs.metric
+        for m in vs.matchers:
+            if m.name == "__name__":
+                if m.op != "=":
+                    raise PromError("__name__ supports only '=' here")
+                metric = m.value
+        if not metric:
+            raise PromError("metric name required")
+        return metric
+
+    def _collect_runs(self, vs, t_min_ns: int, t_max_ns: int, db: str):
+        """Label-free bulk collection for the lazy aggregation fast path:
+        (shard, metric, uniq_sids, t_ms_all, v_all, lens) or None when
+        ineligible (multi-shard ranges must merge series by label, small
+        matches gain nothing)."""
+        metric = self._metric_of(vs)
+        shards = self.engine.shards_for_range(db, None, t_min_ns, t_max_ns)
+        if len(shards) != 1 or not hasattr(shards[0], "read_series_bulk"):
+            return None
+        sh = shards[0]
+        sids = sorted(_match_sids(sh, metric, vs.matchers))
+        if len(sids) < 4096:
+            return None  # eager path is fine at low cardinality
+        sid_arr, rec = sh.read_series_bulk(
+            metric, np.asarray(sids, np.int64), t_min_ns, t_max_ns,
+            fields=[self.value_field])
+        col = rec.columns.get(self.value_field)
+        if col is None or len(rec) == 0:
+            return (sh, metric, np.empty(0, np.int64),
+                    np.empty(0, np.int64), np.empty(0, np.float64),
+                    np.empty(0, np.int64))
+        keep = col.valid
+        sid_k = sid_arr[keep]
+        uniq, lens = np.unique(sid_k, return_counts=True)
+        return (sh, metric, uniq, rec.times[keep] // MS,
+                col.values[keep].astype(np.float64), lens)
+
+    def _eval_agg_fast(self, node: pp.Aggregation, steps, db):
+        """topk/bottomk/count_values over a bare high-cardinality selector
+        without materializing input labels: the winners' (or none of the)
+        labels resolve AFTER selection. At 1M series the eager path spends
+        ~85% of its time building label dicts that the result never uses
+        (BASELINE.md config #5). Returns None when inapplicable.
+
+        Exact-value ties at the topk/bottomk boundary may admit a
+        different (equally-valid) subset than the eager path: this path
+        scans rows in sid order, the eager path in label order, and
+        Prometheus defines boundary ties as arbitrary."""
+        if (node.op not in ("topk", "bottomk", "count_values")
+                or node.grouping or node.without
+                or not isinstance(node.expr, pp.VectorSelector)):
+            return None
+        vs = node.expr
+        window_s = self.lookback_s
+        eval_times = steps - vs.offset_s
+        t_max_ns = int(eval_times[-1] * 1e9) + 1
+        t_min_ns = int((eval_times[0] - window_s) * 1e9)
+        got = self._collect_runs(vs, t_min_ns, t_max_ns, db)
+        if got is None:
+            return None
+        sh, metric, uniq, t_ms_all, v_all, lens = got
+        k = len(steps)
+        if len(uniq) == 0:
+            return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
+        times, values, counts, base_ms = promops.prepare_matrix_runs(
+            t_ms_all, v_all, lens, dtype=np.float64)
+        rel = eval_times - base_ms / 1000.0
+        vals, valid = promops.instant_values(times, values, counts, rel,
+                                             window_s)
+        vals, valid = np.asarray(vals), np.asarray(valid)
+
+        def resolve(rows):
+            entries = sh.index.entries_bulk(uniq[rows])
+            out = []
+            for e in entries:
+                lbl = dict(e[1]) if e is not None else {}
+                lbl["__name__"] = metric
+                out.append(lbl)
+            return out
+
+        if node.op in ("topk", "bottomk"):
+            nv = _expect_number_node(node.param)
+            if math.isnan(nv) or math.isinf(nv):
+                raise PromError(f"invalid {node.op} parameter: {_fmt(nv)}")
+            n = int(nv)
+            if n <= 0:
+                return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
+            keep = _topk_keep(vals, valid, min(n, len(uniq)),
+                              descending=(node.op == "topk"))
+            rows = np.flatnonzero(keep.any(axis=1))
+            labels = resolve(rows)
+            order = sorted(range(len(rows)),
+                           key=lambda i: tuple(sorted(labels[i].items())))
+            rows = rows[order]
+            return Frame([labels[i] for i in order], vals[rows], keep[rows])
+
+        # count_values: input labels are never consulted (no grouping)
+        if not isinstance(node.param, pp.StringLit):
+            raise PromError("count_values expects a label-name string")
+        out_labels, out_rows = _count_values_cells(
+            vals, valid, k, {}, node.param.val)
+        if not out_labels:
+            return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
+        out = np.vstack(out_rows)
+        return Frame(out_labels, out, out > 0)
+
     def _eval_aggregation(self, node: pp.Aggregation, steps, db) -> Frame:
+        fast = self._eval_agg_fast(node, steps, db)
+        if fast is not None:
+            return fast
         f = self._eval(node.expr, steps, db)
         k = len(steps)
         if not f.labels:
@@ -737,33 +840,11 @@ class PromEngine:
             out_labels, out_rows = [], []
             for gi, kk in enumerate(uniq):
                 rows = np.flatnonzero(member[gi])
-                sub = f.values[rows]
-                sub_valid = f.valid[rows]
-                cell_cols = np.broadcast_to(np.arange(k), sub.shape)[sub_valid]
-                seen = sub[sub_valid]
-                if not len(seen):
-                    continue
-                # one pass over valid cells: unique codes + bincount —
-                # O(cells + distinct x steps), never distinct x cells
-                nanmask = np.isnan(seen)
-                vals_f, cols_f = seen[~nanmask], cell_cols[~nanmask]
-                uvals, inv = np.unique(vals_f, return_inverse=True)
-                counts = np.bincount(
-                    inv * k + cols_f, minlength=len(uvals) * k
-                ).reshape(len(uvals), k).astype(np.float64)
-                for ui, v in enumerate(uvals):
-                    lbl = dict(out_labels_by_key[kk])
-                    lbl[label] = _fmt(float(v))
-                    out_labels.append(lbl)
-                    out_rows.append(counts[ui])
-                if nanmask.any():
-                    cnt = np.bincount(
-                        cell_cols[nanmask], minlength=k
-                    ).astype(np.float64)
-                    lbl = dict(out_labels_by_key[kk])
-                    lbl[label] = "NaN"
-                    out_labels.append(lbl)
-                    out_rows.append(cnt)
+                lbls, rws = _count_values_cells(
+                    f.values[rows], f.valid[rows], k,
+                    out_labels_by_key[kk], label)
+                out_labels.extend(lbls)
+                out_rows.extend(rws)
             if not out_labels:
                 return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
             counts_m = np.stack(out_rows)
@@ -895,6 +976,37 @@ def _histogram_quantile(q: float, f: Frame, k: int) -> Frame:
     if not out_labels:
         return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
     return Frame(out_labels, np.stack(out_vals), np.stack(out_valid))
+
+
+def _count_values_cells(sub, sub_valid, k: int, base_labels: dict,
+                        label: str):
+    """Shared count_values bucketing (eager grouped path + lazy fast
+    path): one pass over valid cells — unique codes + bincount,
+    O(cells + distinct x steps) — plus the NaN bucket. Returns
+    (labels, rows)."""
+    cell_cols = np.broadcast_to(np.arange(k), sub.shape)[sub_valid]
+    seen = sub[sub_valid]
+    out_labels, out_rows = [], []
+    if not len(seen):
+        return out_labels, out_rows
+    nanmask = np.isnan(seen)
+    vals_f, cols_f = seen[~nanmask], cell_cols[~nanmask]
+    uvals, inv = np.unique(vals_f, return_inverse=True)
+    counts = np.bincount(
+        inv * k + cols_f, minlength=len(uvals) * k
+    ).reshape(len(uvals), k).astype(np.float64)
+    for ui, v in enumerate(uvals):
+        lbl = dict(base_labels)
+        lbl[label] = _fmt(float(v))
+        out_labels.append(lbl)
+        out_rows.append(counts[ui])
+    if nanmask.any():
+        lbl = dict(base_labels)
+        lbl[label] = "NaN"
+        out_labels.append(lbl)
+        out_rows.append(
+            np.bincount(cell_cols[nanmask], minlength=k).astype(np.float64))
+    return out_labels, out_rows
 
 
 def _topk_keep(values: np.ndarray, valid: np.ndarray, m: int,

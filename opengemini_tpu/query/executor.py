@@ -1894,6 +1894,7 @@ class Executor:
             and ctx.live is None
             and not time_aggs
             and len(ctx.group_keys) <= 20_000  # cache growth gate
+            and W <= 16_384  # > _MAX_WINDOWS would evict itself every run
             and all(hasattr(sh, "data_version") for sh in shards)
         ):
             from opengemini_tpu.query import resultcache as rcache

@@ -217,38 +217,54 @@ class CachePlan:
                 new_cnt.reshape(G, W)[:old_G] = np.asarray(counts).reshape(
                     old_G, W)
             merged[id(call)] = (new_out, new_cnt, spec_, fname_)
+        n_aggs = len(aggs)
         for w in range(W):
             if w in hull:
                 continue
             _sig, groups = self.cached[self.wstarts[w]]
-            for key, cells in groups.items():
-                g = gid_of[key]
-                seg = g * W + w
-                for ai, (call, _s, _p, _f) in enumerate(aggs):
-                    new_out, new_cnt, _sp, _fn = merged[id(call)]
-                    val, cnt = cells[ai]
-                    new_out[seg] = val
-                    new_cnt[seg] = cnt
+            if not groups:
+                continue
+            # vectorized per (window, agg): one fancy-index assignment
+            # over all of the window's cached groups
+            gids = np.fromiter((gid_of[key] for key in groups),
+                               np.int64, len(groups))
+            cells = np.asarray(
+                [[c[1] for c in v] for v in groups.values()], np.int64)
+            segs = gids * W + w
+            for ai, (call, _s, _p, _f) in enumerate(aggs):
+                new_out, new_cnt, _sp, _fn = merged[id(call)]
+                if new_out.dtype.kind in "iu":
+                    # int-exact values stay python-int end-to-end: a
+                    # float64 staging array would corrupt sums > 2^53
+                    new_out[segs] = np.fromiter(
+                        (v[ai][0] for v in groups.values()),
+                        np.int64, len(groups))
+                else:
+                    new_out[segs] = np.fromiter(
+                        (v[ai][0] for v in groups.values()),
+                        np.float64, len(groups))
+                new_cnt[segs] = cells[:, ai]
 
         # persist the recomputed windows (never the partial edge windows;
         # only groups with data — zero cells rebuild as zeros on read, so
         # sparse windows stay cheap at high group cardinality)
+        keys_by_gid = list(gid_of)  # insertion order == gid order
+        outs2d = [merged[id(call)][0].reshape(G, W) for call, *_ in aggs]
+        cnts2d = [merged[id(call)][1].reshape(G, W) for call, *_ in aggs]
         fresh: dict[int, tuple] = {}
         for w in self._fresh_ws():
             if w in self.partial:
                 continue
-            groups = {}
-            for key, g in gid_of.items():
-                seg = g * W + w
-                cells = []
-                any_data = False
-                for call, _s, _p, _f in aggs:
-                    new_out, new_cnt, _sp, _fn = merged[id(call)]
-                    c = int(new_cnt[seg])
-                    any_data = any_data or c > 0
-                    cells.append((new_out[seg].item(), c))
-                if any_data:
-                    groups[key] = cells
+            col_cnt = np.stack([c[:, w] for c in cnts2d])  # (n_aggs, G)
+            col_out = np.stack([o[:, w] for o in outs2d])
+            has = np.flatnonzero((col_cnt > 0).any(axis=0))
+            groups = {
+                keys_by_gid[g]: [
+                    (col_out[ai, g].item(), int(col_cnt[ai, g]))
+                    for ai in range(n_aggs)
+                ]
+                for g in has
+            }
             fresh[self.wstarts[w]] = (self.sigs[w], groups)
         if fresh:
             self.cache.update(self.fp, fresh)

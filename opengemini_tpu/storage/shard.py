@@ -272,6 +272,12 @@ class Shard:
         """Map unique series refs -> sids via the series index (new series
         register here). Returns an array indexed by ref."""
         sid_by_ref = np.zeros(len(batch.series_keys), np.int64)
+        bulk = getattr(self.index, "get_or_create_bulk", None)
+        if bulk is not None and len(refs) > 8:
+            ref_list = [int(r) for r in refs]
+            sids = bulk([batch.series_keys[r] for r in ref_list])
+            sid_by_ref[ref_list] = sids
+            return sid_by_ref
         for ref in refs:
             sid_by_ref[ref] = self.index.get_or_create_by_key(
                 batch.series_keys[int(ref)])

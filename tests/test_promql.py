@@ -638,3 +638,38 @@ class TestCountValuesAndRank:
         self._write(e, {"a": [1], "b": [3], "c": ["NaN"]})
         data = pe.query_instant("quantile(0.9, gauge_metric)", BASE + 1, "prom")
         assert [r["value"][1] for r in data["result"]] == ["NaN"]
+
+
+class TestLazyAggFastPath:
+    """topk/bottomk/count_values over high-cardinality selectors resolve
+    labels AFTER selection (config #5); results must equal the eager
+    path bit-for-bit."""
+
+    @pytest.fixture()
+    def hc(self, tmp_path):
+        from opengemini_tpu.storage.engine import Engine
+
+        e = Engine(str(tmp_path), sync_wal=False)
+        e.create_database("hc")
+        base = 1_700_000_000
+        lines = "\n".join(
+            f"m,sid=s{i},grp=g{i % 13} value={i * 7 % 4999} {base * NS}"
+            for i in range(5000))
+        e.write_lines("hc", lines)
+        e.flush_all()
+        from opengemini_tpu.promql.engine import PromEngine
+
+        yield PromEngine(e), base
+        e.close()
+
+    @pytest.mark.parametrize("q", [
+        "topk(5, m)", "bottomk(3, m)", 'count_values("v", m)',
+        "topk(2, m{grp=\"g3\"})",
+    ])
+    def test_fast_matches_eager(self, hc, q, monkeypatch):
+        pe, base = hc
+        fast = pe.query_instant(q, base + 10, db="hc")
+        monkeypatch.setattr(
+            type(pe), "_collect_runs", lambda self, *a, **k: None)
+        eager = pe.query_instant(q, base + 10, db="hc")
+        assert fast == eager, q
