@@ -46,6 +46,17 @@ def load():
         _build()
     if not os.path.exists(path):
         return None
+    _LIB = _load_at(path)
+    if _LIB is None:
+        # a stale .so from before a symbol was added: rebuild once and
+        # retry — refusing to open existing mergeset dirs over a fixable
+        # build is much worse than one make invocation
+        _build()
+        _LIB = _load_at(path)
+    return _LIB
+
+
+def _load_at(path: str):
     try:
         lib = ctypes.CDLL(path)
         u64 = ctypes.c_uint64
@@ -74,10 +85,9 @@ def load():
             fn = getattr(lib, name)
             fn.restype = res
             fn.argtypes = args
-        _LIB = lib
+        return lib
     except (OSError, AttributeError):
-        _LIB = None
-    return _LIB
+        return None
 
 
 def _build() -> None:
@@ -207,18 +217,24 @@ class MergesetIndex:
                 parts.append(struct.pack("<I", len(kb)) + kb)
                 plain_i.append(i)
         if plain_i:
-            blob = b"".join(parts)
-            sids = (ctypes.c_uint64 * len(plain_i))()
-            with self._native() as h:
-                done = int(self._lib.msi_insert_keys(
-                    h, blob, len(blob), len(plain_i), sids))
-            if done != len(plain_i):
-                raise OSError("series index batch insert failed")
             if len(cache) + len(plain_i) >= _TAGS_CACHE_MAX:
                 cache.clear()
-            for i, sid in zip(plain_i, sids):
-                out[i] = int(sid)
-                cache[keys[i]] = int(sid)
+            # chunked native calls: one giant batch would hold the index
+            # mutex for the whole 1M-series insert and stall every
+            # concurrent reader (lookup/match share the same lock)
+            CHUNK = 32_768
+            for lo in range(0, len(plain_i), CHUNK):
+                idxs = plain_i[lo:lo + CHUNK]
+                blob = b"".join(parts[lo:lo + CHUNK])
+                sids = (ctypes.c_uint64 * len(idxs))()
+                with self._native() as h:
+                    done = int(self._lib.msi_insert_keys(
+                        h, blob, len(blob), len(idxs), sids))
+                if done != len(idxs):
+                    raise OSError("series index batch insert failed")
+                for i, sid in zip(idxs, sids):
+                    out[i] = int(sid)
+                    cache[keys[i]] = int(sid)
         return out
 
     def flush(self) -> None:

@@ -630,8 +630,10 @@ class PromEngine:
         matches gain nothing)."""
         metric = self._metric_of(vs)
         shards = self.engine.shards_for_range(db, None, t_min_ns, t_max_ns)
-        if len(shards) != 1 or not hasattr(shards[0], "read_series_bulk"):
-            return None
+        if (len(shards) != 1
+                or not hasattr(shards[0], "read_series_bulk")
+                or not hasattr(shards[0].index, "entries_bulk")):
+            return None  # dict-index fallback has no bulk label fetch
         sh = shards[0]
         sids = sorted(_match_sids(sh, metric, vs.matchers))
         if len(sids) < 4096:

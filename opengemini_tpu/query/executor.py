@@ -39,12 +39,13 @@ from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER, QueryKilled
 from opengemini_tpu.utils.stats import GLOBAL as STATS
 from opengemini_tpu.sql.parser import parse
 
-NS = 1_000_000_000
-MAX_SELECT_BUCKETS = 1_000_000  # influx max-select-buckets guard
-
-
-class QueryError(Exception):
-    pass
+from opengemini_tpu.query.qhelpers import *  # noqa: F401,F403 — split helpers (VERDICT r3 #7)
+from opengemini_tpu.query.qhelpers import (  # noqa: F401
+    NS, MAX_SELECT_BUCKETS, QueryError,
+)
+from opengemini_tpu.query.hostpath import HostPathMixin
+from opengemini_tpu.query.showddl import ShowDdlMixin
+from opengemini_tpu.query.subquery import SubqueryMixin
 
 
 @dataclass
@@ -66,9 +67,7 @@ class ScanContext:
     live: list | None = None  # cluster live set pinned by the remote round
 
 
-# host calls safe on string columns (python-object values end-to-end)
-_STRING_OK_HOST = {"count", "count_distinct", "mode", "first", "last",
-                   "distinct", "elapsed", "absent"}
+
 
 
 def pick_batch(schema, agg_names, field: str, dtype, grid_ctx=None):
@@ -113,9 +112,6 @@ def pick_batch(schema, agg_names, field: str, dtype, grid_ctx=None):
     return _templates.AggBatch(dtype)
 
 
-def _check_host_field_type(call_name: str, field: str, schema: dict) -> None:
-    if schema.get(field) == FieldType.STRING and call_name not in _STRING_OK_HOST:
-        raise QueryError(f"{call_name}() is not supported on string field {field!r}")
 
 
 _READONLY_STMTS = (
@@ -143,6 +139,7 @@ _READONLY_STMTS = (
 )
 
 
+
 def _is_readonly(stmt) -> bool:
     if isinstance(stmt, ast.ExplainStatement):
         # EXPLAIN ANALYZE executes the inner select — INTO would mutate
@@ -153,7 +150,9 @@ def _is_readonly(stmt) -> bool:
     return not (isinstance(stmt, ast.SelectStatement) and stmt.into is not None)
 
 
-class Executor:
+
+
+class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
     def __init__(self, engine, users=None, auth_enabled: bool = False,
                  meta_store=None):
         from opengemini_tpu.meta.users import UserStore
@@ -179,183 +178,6 @@ class Executor:
         # per-thread stack of CTE names being expanded (cycle detection)
         self._cte_state = _threading.local()
 
-    def _replicate_ddl(self, cmd: dict) -> bool:
-        """Route a DDL command through the raft meta store when clustered.
-        Returns True when handled (leader path; the engine change arrives
-        via the FSM listener). Raises on follower (client must redirect)."""
-        if self.meta_store is None:
-            return False
-        self._require_leader()
-        if not self.meta_store.propose_and_wait(cmd):
-            raise QueryError("meta proposal failed (no quorum?)")
-        return True
-
-    # aggregates the downsample rewrite path can actually execute per field
-    # type: integers must stay on the exact host int64 path (sum/min/max/
-    # first/last) or produce float output (mean/stddev/median); count,
-    # count_distinct, spread and percentile would fail at rewrite time for
-    # INT fields, and percentile lacks its parameter in every path
-    _DOWNSAMPLE_AGGS = {
-        "float": {"sum", "count", "mean", "min", "max", "first", "last",
-                  "spread", "stddev", "median"},
-        "integer": {"sum", "mean", "min", "max", "first", "last",
-                    "stddev", "median"},
-        "boolean": {"first", "last"},
-    }
-
-    def _create_downsample(self, stmt, db: str) -> dict:
-        """CREATE DOWNSAMPLE (reference: CreateDownSampleStatement semantics,
-        meta downsample policies + engine_downsample.go): level i rewrites
-        shards older than SAMPLEINTERVAL[i] at TIMEINTERVAL[i] resolution."""
-        from opengemini_tpu.ops import aggregates as aggmod
-        from opengemini_tpu.storage.engine import DownsamplePolicy
-
-        tgt = stmt.database or db
-        if not stmt.rp:
-            raise QueryError("CREATE DOWNSAMPLE requires ON [db.]rp")
-        samples, times = stmt.sample_intervals, stmt.time_intervals
-        if len(samples) != len(times):
-            raise QueryError(
-                "SAMPLEINTERVAL and TIMEINTERVAL must have the same "
-                f"number of levels ({len(samples)} vs {len(times)})"
-            )
-        for i in range(len(samples)):
-            if times[i] <= 0 or samples[i] <= 0:
-                raise QueryError("downsample intervals must be positive")
-            if times[i] >= samples[i]:
-                raise QueryError(
-                    f"TIMEINTERVAL {_fmt_duration(times[i])} must be finer "
-                    f"than SAMPLEINTERVAL {_fmt_duration(samples[i])}"
-                )
-            if i and (samples[i] <= samples[i - 1] or times[i] <= times[i - 1]):
-                raise QueryError("downsample levels must be ascending")
-        if stmt.ttl_ns and samples and stmt.ttl_ns < samples[-1]:
-            raise QueryError("TTL must cover the last SAMPLEINTERVAL")
-        for tname, agg in stmt.type_aggs.items():
-            allowed = self._DOWNSAMPLE_AGGS.get(tname)
-            if allowed is None:
-                raise QueryError(f"unknown downsample field type: {tname!r}")
-            if agg not in allowed:
-                raise QueryError(
-                    f"downsample aggregate {agg!r} is not supported for "
-                    f"{tname} fields (one of: {', '.join(sorted(allowed))})"
-                )
-            aggmod.get(agg)  # registry sanity; allowlist is a subset
-        self._check_fsm_db(tgt)
-        if self.meta_store is not None:
-            fsm_db = self.meta_store.fsm.databases[tgt]
-            if stmt.rp not in fsm_db.get("rps", {}):
-                raise QueryError(f"retention policy not found: {tgt}.{stmt.rp}")
-            if stmt.rp in fsm_db.get("downsample", {}):
-                raise QueryError(f"downsample already exists on {tgt}.{stmt.rp}")
-        else:
-            d = self.engine.databases.get(tgt)
-            if d is None:
-                raise QueryError(f"database not found: {tgt}")
-            if stmt.rp not in d.rps:
-                raise QueryError(f"retention policy not found: {tgt}.{stmt.rp}")
-            if d.downsample.get(stmt.rp):
-                raise QueryError(f"downsample already exists on {tgt}.{stmt.rp}")
-        policies = [
-            DownsamplePolicy(samples[i], times[i], dict(stmt.type_aggs))
-            for i in range(len(samples))
-        ]
-        cmd = {"op": "add_downsample", "db": tgt, "rp": stmt.rp,
-               "ttl_ns": stmt.ttl_ns,
-               "policies": [p.to_json() for p in policies]}
-        if not self._replicate_ddl(cmd):
-            self.engine.set_downsample_policies(tgt, stmt.rp, policies,
-                                                ttl_ns=stmt.ttl_ns)
-        return {}
-
-    def _show_cluster(self) -> dict:
-        """Reference: SHOW CLUSTER (meta/data node roster with status)."""
-        rows = []
-        if self.meta_store is None:
-            rows.append(["local", "", "meta,data", "leader", ""])
-        else:
-            leader = self.meta_store.leader_hint()
-            members = self.meta_store.meta_members()
-            for nid in sorted(members):
-                status = "leader" if nid == leader else "follower"
-                rows.append([nid, members[nid], "meta", status, ""])
-            health = getattr(self.router, "health", {}) if self.router else {}
-            shared = getattr(self.router, "shared_health", {}) if self.router else {}
-            down_since = getattr(self.router, "down_since", {}) if self.router else {}
-            for nid, info in sorted(self.meta_store.fsm.nodes.items()):
-                status = "registered"
-                # quorum view (exchange_health) wins over the purely local
-                # probe: one coordinator's broken route must not show a
-                # healthy node as down
-                if nid in shared:
-                    status = "up" if shared[nid] else "down"
-                elif nid in health:
-                    status = "up" if health[nid] else "down"
-                since = down_since.get(nid)
-                rows.append([nid, info.get("addr", ""),
-                             info.get("role", "data"), status,
-                             cond.format_rfc3339(int(since * 1e9)) if since else ""])
-        return {"series": [_series("cluster", None,
-                                   ["id", "addr", "role", "status", "down_since"],
-                                   rows)]}
-
-    def _show_downsamples(self, stmt, db: str) -> dict:
-        tgt = stmt.database or db
-        d = self.engine.databases.get(tgt)
-        if d is None:
-            raise QueryError(f"database not found: {tgt}")
-        rows = []
-        for rp in sorted(d.downsample):
-            for p in d.downsample[rp]:
-                aggs = ",".join(f"{t}({a})" for t, a in sorted(p.field_aggs.items()))
-                rows.append([rp, aggs, _fmt_duration(p.age_ns),
-                             _fmt_duration(p.every_ns)])
-        series = _series(tgt, None,
-                         ["rpName", "aggs", "sampleInterval", "timeInterval"],
-                         rows)
-        return {"series": [series]}
-
-    def _check_fsm_db(self, name: str) -> None:
-        """Validate db existence against the FSM BEFORE proposing a
-        db-scoped command: the FSM silently ignores an unknown db, which
-        would persist a junk entry. Leadership is checked FIRST — a
-        lagging follower must redirect, not answer 'not found' from its
-        stale FSM (same rule as _user_ddl)."""
-        if self.meta_store is None:
-            return
-        self._require_leader()
-        if name not in self.meta_store.fsm.databases:
-            raise QueryError(f"database not found: {name}")
-
-    def _require_leader(self) -> None:
-        if self.meta_store is not None and not self.meta_store.is_leader():
-            leader = self.meta_store.leader_hint() or "unknown"
-            raise QueryError(
-                f"not the meta leader; retry against node {leader!r}"
-            )
-
-    def _require_user(self, name: str) -> None:
-        from opengemini_tpu.meta.users import AuthError
-
-        if name not in self.users.users:
-            raise AuthError(f"user not found: {name}")
-
-    def _user_ddl(self, validate_fn, cmd_fn) -> bool:
-        """Replicated user DDL: leadership first (a stale follower must
-        redirect, not answer from its lagging local store), then
-        validation + propose under one lock (check-then-propose races
-        across HTTP threads would silently overwrite credentials).
-        Returns False when not clustered (caller runs the local path)."""
-        if self.meta_store is None:
-            return False
-        with self._user_ddl_lock:
-            self._require_leader()
-            validate_fn()
-            if not self.meta_store.propose_and_wait(cmd_fn()):
-                raise QueryError("meta proposal failed (no quorum?)")
-        return True
-
-    # -- entry --------------------------------------------------------------
 
     def execute(
         self, text: str, db: str = "", now_ns: int | None = None,
@@ -376,6 +198,7 @@ class Executor:
             return self._execute_statements(stmts, db, now_ns, read_only, user)
         finally:
             TRACKER.unregister(qid)
+
 
     def _execute_statements(self, stmts, db, now_ns, read_only, user) -> dict:
         results = []
@@ -413,6 +236,7 @@ class Executor:
             res["statement_id"] = i
             results.append(res)
         return {"results": results}
+
 
     def _authorize(self, stmt, user, db: str) -> None:
         """Privilege checks (reference: httpd auth + meta user privileges).
@@ -465,6 +289,7 @@ class Executor:
             raise AuthError(f"user {user.name!r} lacks READ on {db!r}")
         raise AuthError(f"user {user.name!r} is not authorized (admin required)")
 
+
     @staticmethod
     def _select_source_dbs(select, default_db: str) -> set:
         """Every database a SELECT reads from, recursing into subqueries."""
@@ -511,384 +336,6 @@ class Executor:
         walk(select)
         return dbs
 
-    def execute_statement(self, stmt, db: str, now_ns: int, user=None) -> dict:
-        if isinstance(stmt, ast.SelectStatement):
-            STATS.incr("executor", "selects")
-            res = self._select(stmt, db, now_ns)
-            if not stmt.ascending and res.get("series"):
-                # ORDER BY time DESC reverses the SERIES order too
-                # (reference: Null_Aggregate desc cases expect the
-                # lexicographically-last tagset first). Applied HERE, at
-                # the statement boundary — _select recurses for
-                # subqueries/CTEs and must not double-reverse
-                res = dict(res, series=list(reversed(res["series"])))
-            return res
-        if isinstance(stmt, ast.UnionStatement):
-            from opengemini_tpu.query import join as joinmod
-
-            STATS.incr("executor", "selects")
-            return joinmod.execute_union(self, stmt, db, now_ns)
-        if isinstance(stmt, ast.ExplainStatement):
-            return self._explain(stmt, db, now_ns)
-        if isinstance(stmt, ast.ShowDatabases):
-            names = self.engine.database_names()
-            if self.auth_enabled and user is not None and not user.admin:
-                names = [n for n in names if user.privileges.get(n)]
-            rows = [[name] for name in names]
-            return _series_result("databases", None, ["name"], rows)
-        if isinstance(stmt, ast.ShowMeasurements):
-            return self._show_measurements(stmt, db)
-        if isinstance(stmt, ast.ShowTagKeys):
-            return self._show_tag_keys(stmt, db)
-        if isinstance(stmt, ast.ShowTagValues):
-            return self._show_tag_values(stmt, db)
-        if isinstance(stmt, ast.ShowFieldKeys):
-            return self._show_field_keys(stmt, db)
-        if isinstance(stmt, ast.ShowSeries):
-            return self._show_series(stmt, db)
-        if isinstance(stmt, ast.ShowSeriesExactCardinality):
-            return self._show_series_exact_cardinality(stmt, db)
-        if isinstance(stmt, ast.CreateMeasurement):
-            # schema-on-write engine: accept and record nothing (see parser)
-            return {}
-        if isinstance(stmt, ast.ShowRetentionPolicies):
-            return self._show_rps(stmt, db)
-        if isinstance(stmt, ast.CreateDatabase):
-            if not self._replicate_ddl({"op": "create_database", "name": stmt.name}):
-                self.engine.create_database(stmt.name)
-            if stmt.has_rp_clause:
-                rp_name = stmt.rp_name or "autogen"
-                cmd = {
-                    "op": "create_rp", "db": stmt.name, "name": rp_name,
-                    "duration_ns": stmt.duration_ns,
-                    "shard_duration_ns": stmt.shard_duration_ns,
-                    "default": True,
-                }
-                if not self._replicate_ddl(cmd):
-                    self.engine.create_retention_policy(
-                        stmt.name, rp_name, stmt.duration_ns,
-                        stmt.shard_duration_ns, default=True,
-                    )
-            return {}
-        if isinstance(stmt, ast.DropDatabase):
-            if not self._replicate_ddl({"op": "drop_database", "name": stmt.name}):
-                self.engine.drop_database(stmt.name)
-            return {}
-        if isinstance(stmt, ast.CreateRetentionPolicy):
-            tgt = stmt.database or db
-            self._check_fsm_db(tgt)
-            cmd = {
-                "op": "create_rp", "db": tgt, "name": stmt.name,
-                "duration_ns": stmt.duration_ns,
-                "shard_duration_ns": stmt.shard_duration_ns,
-                "default": stmt.default,
-            }
-            if not self._replicate_ddl(cmd):
-                self.engine.create_retention_policy(
-                    tgt, stmt.name, stmt.duration_ns,
-                    stmt.shard_duration_ns, stmt.default,
-                )
-            return {}
-        if isinstance(stmt, ast.DropRetentionPolicy):
-            cmd = {"op": "drop_rp", "db": stmt.database or db, "name": stmt.name}
-            if not self._replicate_ddl(cmd):
-                self.engine.drop_retention_policy(stmt.database or db, stmt.name)
-            return {}
-        if isinstance(stmt, ast.CreateContinuousQuery):
-            from opengemini_tpu.storage.engine import ContinuousQuery
-
-            tgt = stmt.database or db
-            self._check_fsm_db(tgt)
-            cq = ContinuousQuery(
-                stmt.name, stmt.select_text,
-                stmt.resample_every_ns, stmt.resample_for_ns,
-            )
-            if not self._replicate_ddl({"op": "create_cq", "db": tgt,
-                                        "cq": cq.to_json()}):
-                self.engine.create_continuous_query(tgt, cq)
-            return {}
-        if isinstance(stmt, ast.DropContinuousQuery):
-            tgt = stmt.database or db
-            if not self._replicate_ddl({"op": "drop_cq", "db": tgt,
-                                        "name": stmt.name}):
-                self.engine.drop_continuous_query(tgt, stmt.name)
-            return {}
-        if isinstance(stmt, ast.ShowContinuousQueries):
-            series = []
-            for name in sorted(self.engine.databases):
-                d = self.engine.databases[name]
-                rows = [[cq.name, cq.select_text] for cq in d.continuous_queries.values()]
-                series.append(_series(name, None, ["name", "query"], rows))
-            return {"series": series} if series else {}
-        if isinstance(stmt, ast.CreateStream):
-            from opengemini_tpu.services.stream import validate_stream_select
-            from opengemini_tpu.storage.engine import StreamTask
-
-            try:
-                validate_stream_select(stmt.select)
-            except ValueError as e:
-                raise QueryError(str(e)) from None
-            self._check_fsm_db(db)
-            task = StreamTask(stmt.name, stmt.select_text, stmt.delay_ns)
-            if not self._replicate_ddl({"op": "create_stream", "db": db,
-                                        "task": task.to_json()}):
-                self.engine.create_stream(db, task)
-            return {}
-        if isinstance(stmt, ast.DropStream):
-            if not self._replicate_ddl({"op": "drop_stream", "db": db,
-                                        "name": stmt.name}):
-                self.engine.drop_stream(db, stmt.name)
-            return {}
-        if isinstance(stmt, ast.CreateSubscription):
-            from opengemini_tpu.services.subscriber import Subscription
-
-            if not stmt.destinations:
-                raise QueryError("subscription requires at least one destination")
-            for dest in stmt.destinations:
-                if not dest.startswith(("http://", "https://")):
-                    raise QueryError(
-                        f"subscription destination must be an http(s) URL: {dest!r}"
-                    )
-            tgt = stmt.database or db
-            self._check_fsm_db(tgt)
-            sub = Subscription(stmt.name, stmt.mode, stmt.destinations)
-            if not self._replicate_ddl({"op": "create_subscription", "db": tgt,
-                                        "sub": sub.to_json()}):
-                self.engine.create_subscription(tgt, sub)
-            return {}
-        if isinstance(stmt, ast.CreateDownsample):
-            return self._create_downsample(stmt, db)
-        if isinstance(stmt, ast.DropDownsample):
-            tgt = stmt.database or db
-            cmd = {"op": "drop_downsample", "db": tgt, "rp": stmt.rp or None}
-            if not self._replicate_ddl(cmd):
-                self.engine.drop_downsample_policies(tgt, stmt.rp or None)
-            return {}
-        if isinstance(stmt, ast.ShowDownsamples):
-            return self._show_downsamples(stmt, db)
-        if isinstance(stmt, ast.ShowCluster):
-            return self._show_cluster()
-        if isinstance(stmt, ast.DropSubscription):
-            tgt = stmt.database or db
-            if not self._replicate_ddl({"op": "drop_subscription", "db": tgt,
-                                        "name": stmt.name}):
-                self.engine.drop_subscription(tgt, stmt.name)
-            return {}
-        if isinstance(stmt, ast.ShowSubscriptions):
-            series = []
-            for name in sorted(self.engine.databases):
-                d = self.engine.databases[name]
-                rows = [
-                    [s.name, s.mode, ", ".join(s.destinations)]
-                    for s in d.subscriptions.values()
-                ]
-                series.append(
-                    _series(name, None, ["name", "mode", "destinations"], rows)
-                )
-            return {"series": series} if series else {}
-        if isinstance(stmt, ast.ShowQueries):
-            rows = [
-                [q["qid"], q["query"], q["database"],
-                 f"{q['duration_ms']}ms", q["status"]]
-                for q in TRACKER.snapshot()
-            ]
-            return _series_result(
-                "", None, ["qid", "query", "database", "duration", "status"], rows
-            )
-        if isinstance(stmt, ast.KillQuery):
-            if not TRACKER.kill(stmt.qid):
-                raise QueryError(f"no such query: {stmt.qid}")
-            return {}
-        if isinstance(stmt, ast.ShowShards):
-            rows = []
-            for (sdb, rp, start), sh in sorted(self.engine._shards.items()):
-                rows.append([
-                    sdb, rp, start, sh.tmin, sh.tmax, len(sh._files),
-                    "cold" if os.path.islink(sh.path) else "hot",
-                ])
-            return _series_result(
-                "shards", None,
-                ["database", "retention_policy", "shard_group", "start_time",
-                 "end_time", "files", "tier"],
-                rows,
-            )
-        if isinstance(stmt, ast.ShowStats):
-            series = []
-            for module, vals in sorted(STATS.snapshot().items()):
-                rows = [[k, v] for k, v in sorted(vals.items())]
-                series.append(_series(module, None, ["statistic", "value"], rows))
-            return {"series": series} if series else {}
-        if isinstance(stmt, ast.ShowDiagnostics):
-            import platform
-            import sys as _sys
-
-            import jax as _jax
-
-            from opengemini_tpu import __version__
-
-            rows = [
-                ["version", __version__],
-                ["python", _sys.version.split()[0]],
-                ["jax", _jax.__version__],
-                ["backend", _jax.default_backend()],
-                ["devices", str(len(_jax.devices()))],
-                ["platform", platform.platform()],
-                ["data_dir", self.engine.root],
-            ]
-            return _series_result("system", None, ["name", "value"], rows)
-        if isinstance(stmt, ast.ShowStreams):
-            series = []
-            for name in sorted(self.engine.databases):
-                d = self.engine.databases[name]
-                rows = [[s.name, s.select_text] for s in d.streams.values()]
-                series.append(_series(name, None, ["name", "query"], rows))
-            return {"series": series} if series else {}
-        if isinstance(stmt, ast.DropMeasurement):
-            # mark + deferred purge (reference MarkMeasurementDelete):
-            # SELECT hides it now; SHOW SERIES keeps the series until the
-            # retention tick (or a rewrite of the name) purges
-            self.engine.mark_measurement_delete(db, stmt.name)
-            return {}
-        if isinstance(stmt, (ast.DeleteSeries, ast.DropSeries)):
-            return self._delete(stmt, db, now_ns)
-        if isinstance(stmt, ast.CreateUser):
-            def _validate_create():
-                from opengemini_tpu.meta.users import AuthError
-
-                if stmt.name in self.users.users:
-                    raise AuthError(f"user already exists: {stmt.name}")
-
-            def _cmd_create():
-                from opengemini_tpu.meta.users import UserStore
-
-                salt, pw_hash = UserStore.make_credentials(stmt.password)
-                return {"op": "create_user", "name": stmt.name,
-                        "salt": salt, "hash": pw_hash, "admin": stmt.admin}
-
-            if not self._user_ddl(_validate_create, _cmd_create):
-                self.users.create(stmt.name, stmt.password, stmt.admin)
-            return {}
-        if isinstance(stmt, ast.DropUser):
-            if not self._user_ddl(
-                lambda: self._require_user(stmt.name),
-                lambda: {"op": "drop_user", "name": stmt.name},
-            ):
-                self.users.drop(stmt.name)
-            return {}
-        if isinstance(stmt, ast.SetPassword):
-            def _cmd_setpw():
-                from opengemini_tpu.meta.users import UserStore
-
-                salt, pw_hash = UserStore.make_credentials(stmt.password)
-                return {"op": "set_password", "name": stmt.name,
-                        "salt": salt, "hash": pw_hash}
-
-            if not self._user_ddl(lambda: self._require_user(stmt.name), _cmd_setpw):
-                self.users.set_password(stmt.name, stmt.password)
-            return {}
-        if isinstance(stmt, ast.GrantStatement):
-            admin_grant = not stmt.database and stmt.privilege == "ALL"
-            cmd = (
-                {"op": "grant_admin", "user": stmt.user, "admin": True}
-                if admin_grant
-                else {"op": "grant", "user": stmt.user, "db": stmt.database,
-                      "privilege": stmt.privilege}
-            )
-            if not self._user_ddl(lambda: self._require_user(stmt.user), lambda: cmd):
-                if admin_grant:
-                    self.users.grant_admin(stmt.user)
-                else:
-                    self.users.grant(stmt.user, stmt.database, stmt.privilege)
-            return {}
-        if isinstance(stmt, ast.RevokeStatement):
-            admin_revoke = not stmt.database and stmt.privilege == "ALL"
-            cmd = (
-                {"op": "grant_admin", "user": stmt.user, "admin": False}
-                if admin_revoke
-                else {"op": "revoke", "user": stmt.user, "db": stmt.database}
-            )
-            if not self._user_ddl(lambda: self._require_user(stmt.user), lambda: cmd):
-                if admin_revoke:
-                    self.users.grant_admin(stmt.user, admin=False)
-                else:
-                    self.users.revoke(stmt.user, stmt.database)
-            return {}
-        if isinstance(stmt, ast.ShowUsers):
-            rows = [[u.name, u.admin] for u in self.users.users.values()]
-            return _series_result("", None, ["user", "admin"], sorted(rows))
-        if isinstance(stmt, ast.ShowGrants):
-            u = self.users.users.get(stmt.user)
-            if u is None:
-                raise QueryError(f"user not found: {stmt.user}")
-            rows = [[db_, p] for db_, p in sorted(u.privileges.items())]
-            return _series_result("", None, ["database", "privilege"], rows)
-        if isinstance(stmt, ast.ShowMeasurementCardinality):
-            names: set[str] = set()
-            cdb = stmt.database or db
-            for sh in self._all_shards_db(cdb):
-                names.update(
-                    m for m in sh.measurements() if self._visible(cdb, m))
-            return _series_result("", None, ["count"], [[len(names)]])
-        if isinstance(stmt, ast.ShowSeriesCardinality):
-            from opengemini_tpu.ingest.line_protocol import series_key
-
-            # one row per shard-group time range (reference output shape:
-            # startTime/endTime/count, coordinator show-executor)
-            by_range: dict[tuple[int, int], set] = {}
-            for sh in self._all_shards_db(stmt.database or db):
-                bucket = by_range.setdefault((sh.tmin, sh.tmax), set())
-                for m, tags in sh.index.iter_series_entries():
-                    bucket.add(series_key(m, tags))
-            rows = [
-                [cond.format_rfc3339(lo), cond.format_rfc3339(hi), len(keys)]
-                for (lo, hi), keys in sorted(by_range.items())
-                if keys
-            ]
-            if not rows:
-                return {}
-            return _series_result("", None, ["startTime", "endTime", "count"], rows)
-        raise QueryError(f"unsupported statement: {type(stmt).__name__}")
-
-    def _delete(self, stmt, db: str, now_ns: int) -> dict:
-        """DELETE FROM m WHERE ... (time range + tag filters) and
-        DROP SERIES FROM m WHERE ... (whole series).
-        Reference: deleteSeries / dropSeries statement executors."""
-        if not stmt.measurement:
-            raise QueryError("DELETE/DROP SERIES requires FROM <measurement>")
-        is_drop_series = isinstance(stmt, ast.DropSeries)
-        shards = self._all_shards_db(db)
-        # tag keys unioned ACROSS shards (like _scan_context) — a shard
-        # without the measurement must not re-classify tags as fields,
-        # which would error mid-way with earlier shards already deleted
-        tag_keys: set[str] = set()
-        for sh in shards:
-            tag_keys.update(sh.index.tag_keys(stmt.measurement))
-        sc = cond.split(stmt.condition, tag_keys, now_ns)
-        if sc.has_row_filter:
-            raise QueryError("DELETE conditions may only reference time and tags")
-        has_time = sc.tmin != cond.MIN_TIME or sc.tmax != cond.MAX_TIME
-        if is_drop_series and has_time:
-            # influx rejects time bounds here rather than over-deleting
-            raise QueryError("DROP SERIES does not support time conditions")
-        for sh in shards:
-            sids = (
-                cond.eval_tag_expr(sc.tag_expr, sh.index, stmt.measurement)
-                if sc.tag_expr is not None
-                else None
-            )
-            if sids is not None and not sids:
-                continue
-            if is_drop_series or not has_time:
-                sh.delete_data(stmt.measurement, sids)
-            else:
-                sh.delete_data(
-                    stmt.measurement, sids,
-                    None if sc.tmin == cond.MIN_TIME else sc.tmin,
-                    None if sc.tmax == cond.MAX_TIME else sc.tmax,
-                )
-        return {}
-
-    # -- SELECT -------------------------------------------------------------
 
     def _explain(self, stmt: ast.ExplainStatement, db: str, now_ns: int) -> dict:
         """EXPLAIN [ANALYZE] SELECT (reference:
@@ -933,6 +380,7 @@ class Executor:
                     f"segments: {len(ctx.group_keys) * ctx.W}"
                 )
         return _series_result("", None, ["QUERY PLAN"], [[line] for line in lines])
+
 
     def _select(self, stmt: ast.SelectStatement, db: str, now_ns: int,
                 trace=tracing.NOOP) -> dict:
@@ -1021,6 +469,7 @@ class Executor:
             return {}
         return {"series": all_series}
 
+
     def _multi_source_plan(self, stmt, db: str) -> str | None:
         """How a multi-source FROM combines (reference
         TestServer_Query_MultiMeasurements: sources UNION into one series
@@ -1065,6 +514,7 @@ class Executor:
         # reach here; anything aggregating combines via the union rewrite
         return "rewrite"
 
+
     def _select_cte(self, stmt, src: ast.Measurement, db: str, now_ns: int,
                     trace=tracing.NOOP) -> list[dict]:
         """FROM <cte-name>: execute the WITH binding as a subquery, with
@@ -1082,6 +532,7 @@ class Executor:
             return self._select_from_subquery(stmt, sub, db, now_ns, trace)
         finally:
             active.discard(name)
+
 
     def _rewrite_in_subqueries(self, stmt, db: str, now_ns: int):
         """Replace `<ref> IN (SELECT ...)` predicates with OR-chains of
@@ -1144,6 +595,7 @@ class Executor:
         stmt = copy.copy(stmt)
         stmt.condition = new_cond
         return stmt
+
 
     def _select_compare(self, stmt, call, db: str, now_ns: int) -> dict:
         """compare(ref, off...): evaluate the source over the WHERE range
@@ -1242,345 +694,6 @@ class Executor:
             out_series.append(series)
         return {"series": out_series} if out_series else {}
 
-    def _project_union(self, stmt, inner_res) -> list[dict] | None:
-        """Raw column projection over a union subquery result; returns None
-        when the outer statement needs real execution (aggregates, WHERE,
-        grouping) and must fall back to materialization."""
-        if (stmt.condition is not None or stmt.group_by_tags
-                or stmt.group_by_all_tags or stmt.group_by_time):
-            return None
-        for f in stmt.fields:
-            e = _strip_expr(f.expr)
-            if not isinstance(e, (ast.VarRef, ast.Wildcard)):
-                return None
-        series = inner_res.get("series", [])
-        if not series:
-            return []
-        src = series[0]
-        cols_in = src["columns"]
-        names, idxs = [], []
-        for f in stmt.fields:
-            e = _strip_expr(f.expr)
-            if isinstance(e, ast.Wildcard):
-                for i, c in enumerate(cols_in[1:], start=1):
-                    names.append(c)
-                    idxs.append(i)
-            else:
-                if e.name.lower() == "time":
-                    continue  # always column 0
-                names.append(f.alias or e.name)
-                idxs.append(cols_in.index(e.name) if e.name in cols_in else -1)
-        rows = [
-            [row[0]] + [row[i] if i >= 0 else None for i in idxs]
-            for row in src["values"]
-        ]
-        if not stmt.ascending:
-            rows.reverse()
-        if stmt.offset:
-            rows = rows[stmt.offset:]
-        if stmt.limit:
-            rows = rows[: stmt.limit]
-        return [{"name": src["name"], "columns": ["time"] + names, "values": rows}]
-
-    def _project_dimensioned(self, stmt, series_list: list[dict],
-                             dims: list[str], name: str):
-        """Bare projection over a dimensioned subquery: one output series,
-        dim tags as leading columns, inner rows (incl. all-null ones) in
-        series order. Returns None when the outer needs real execution."""
-        if (stmt.condition is not None or stmt.group_by_tags
-                or stmt.group_by_all_tags or stmt.group_by_time
-                or not series_list):
-            return None
-        for f in stmt.fields:
-            if not isinstance(_strip_expr(f.expr), (ast.VarRef, ast.Wildcard)):
-                return None
-        cols_in = series_list[0]["columns"]
-        names, sources = [], []  # source: ("dim", key) | ("col", idx)
-        for f in stmt.fields:
-            e = _strip_expr(f.expr)
-            if isinstance(e, ast.Wildcard):
-                for d in dims:
-                    names.append(d)
-                    sources.append(("dim", d))
-                for i, c in enumerate(cols_in[1:], start=1):
-                    names.append(c)
-                    sources.append(("col", i))
-            elif e.name.lower() == "time":
-                continue
-            elif e.name in dims:
-                names.append(f.alias or e.name)
-                sources.append(("dim", e.name))
-            else:
-                names.append(f.alias or e.name)
-                sources.append(
-                    ("col", cols_in.index(e.name))
-                    if e.name in cols_in else ("col", -1))
-        rows = []
-        for s in series_list:
-            tags = s.get("tags", {})
-            for row in s["values"]:
-                out = [row[0]]
-                for kind, ref in sources:
-                    if kind == "dim":
-                        out.append(tags.get(ref))
-                    else:
-                        out.append(row[ref] if ref >= 0 else None)
-                rows.append(out)
-        if not stmt.ascending:
-            rows.reverse()
-        if stmt.offset:
-            rows = rows[stmt.offset:]
-        if stmt.limit:
-            rows = rows[: stmt.limit]
-        return [{"name": name, "columns": ["time"] + names, "values": rows}]
-
-    def _write_into(self, target: ast.Measurement, db: str, series_list: list[dict]) -> int:
-        """SELECT INTO: write result rows into the target measurement
-        (reference: into clause handling in statement_executor.go). Rows go
-        through the structured write path (WAL'd, schema-checked) — never
-        through line-protocol text, so arbitrary tag/field content is safe."""
-        tgt_db = target.database or db
-        if tgt_db not in self.engine.databases:
-            raise QueryError(f"database not found: {tgt_db}")
-        points = []
-        for series in series_list:
-            tags = tuple(sorted(series.get("tags", {}).items()))
-            cols = series["columns"][1:]
-            for row in series["values"]:
-                t, vals = row[0], row[1:]
-                fields = {}
-                for name, v in zip(cols, vals):
-                    if v is None:
-                        continue
-                    if isinstance(v, bool):
-                        fields[name] = (FieldType.BOOL, v)
-                    elif isinstance(v, int):
-                        fields[name] = (FieldType.INT, v)
-                    elif isinstance(v, float):
-                        fields[name] = (FieldType.FLOAT, v)
-                    else:
-                        fields[name] = (FieldType.STRING, str(v))
-                if fields:
-                    points.append((target.name, tags, t, fields))
-        if not points:
-            return 0
-        if self.router is not None:
-            # route INTO results by shard-group owner like any other write:
-            # result rows written only-locally would duplicate across nodes
-            # (every copy double-counts in merged scans)
-            from opengemini_tpu.parallel.cluster import RemoteScanError
-
-            try:
-                return self.router.routed_write(
-                    tgt_db, target.rp or None, points)
-            except (OSError, RemoteScanError) as e:
-                raise QueryError(f"INTO forward failed: {e}") from e
-        return self.engine.write_rows(tgt_db, points, rp=target.rp or None)
-
-    def _select_from_subquery(self, stmt, src: ast.SubQuery, db: str,
-                              now_ns: int, trace=tracing.NOOP) -> list[dict]:
-        """FROM (SELECT ...): the inner result materializes into a
-        throw-away engine (tags stay tags, columns become fields), then the
-        outer statement runs against it. Reference: subquery builders in
-        engine/executor/select.go; correctness-first materialization here,
-        streaming later."""
-        import copy  # noqa: F811 — local import for the materializer
-        import tempfile
-
-        from opengemini_tpu.storage.engine import Engine as _Engine
-
-        inner = src.stmt
-        inner_has_wild = False
-        if isinstance(inner, ast.SelectStatement):
-            inner_has_wild = any(
-                isinstance(_strip_expr(f.expr), ast.Wildcard)
-                or _call_wildcard_inner(_strip_expr(f.expr)) is not None
-                for f in inner.fields
-            )
-            if _classify_select(inner) == "raw" and not (
-                inner.group_by_tags or inner.group_by_all_tags
-            ):
-                # influx propagates series tags through subqueries: a raw
-                # inner select must emit per-series output, never one
-                # merged series
-                inner = copy.copy(inner)
-                inner.group_by_all_tags = True
-            elif (
-                stmt.group_by_tags
-                and not inner.group_by_tags
-                and not inner.group_by_all_tags
-            ):
-                # influx subqueries INHERIT the outer GROUP BY dimensions:
-                # an inner call (top/agg) computes per outer group and its
-                # output series carry those tags
-                # (TestServer_SubQuery_Top_Min#0)
-                inner = copy.copy(inner)
-                inner.group_by_tags = list(stmt.group_by_tags)
-        # push the outer time range into the inner select so the inner scan
-        # (and the materialization below) covers only the needed window
-        if isinstance(inner, ast.UnionStatement):
-            pass  # union bodies materialize whole (no time pushdown yet)
-        else:
-            try:
-                sc_outer = cond.split(stmt.condition, set(), now_ns)
-                if sc_outer.tmin != cond.MIN_TIME or sc_outer.tmax != cond.MAX_TIME:
-                    bound = ast.BinaryExpr(
-                        "AND",
-                        ast.BinaryExpr(">=", ast.VarRef("time"),
-                                       ast.IntegerLiteral(sc_outer.tmin)),
-                        ast.BinaryExpr("<", ast.VarRef("time"),
-                                       ast.IntegerLiteral(sc_outer.tmax)),
-                    )
-                    inner = copy.copy(inner)
-                    inner.condition = (
-                        bound if inner.condition is None
-                        else ast.BinaryExpr("AND", inner.condition, bound)
-                    )
-            except cond.ConditionError:
-                pass  # un-splittable outer condition: no pushdown
-        with trace.span("subquery"):
-            if isinstance(inner, ast.UnionStatement):
-                from opengemini_tpu.query import join as joinmod
-
-                inner_res = joinmod.execute_union(self, inner, db, now_ns)
-                # a raw projection over a union must NOT round-trip through
-                # the point materializer: union rows legitimately repeat
-                # (series, time) pairs, which the engine would LWW-dedup
-                proj = self._project_union(stmt, inner_res)
-                if proj is not None:
-                    return proj
-            else:
-                inner_res = self._select(inner, db, now_ns, trace)
-        series_list = inner_res.get("series", [])
-        if (
-            not isinstance(inner, ast.UnionStatement)
-            and len(series_list) == 1
-            and not series_list[0].get("tags")
-        ):
-            # single untagged inner series + bare outer projection: project
-            # directly so all-null computed rows survive (the materializer
-            # cannot represent a row whose only field is null —
-            # TestServer_Query_SubqueryMath#0)
-            proj = self._project_union(stmt, inner_res)
-            if proj is not None:
-                return proj
-        if (
-            not isinstance(inner, ast.UnionStatement)
-            and isinstance(src.stmt, ast.SelectStatement)
-            and src.stmt.group_by_tags
-        ):
-            # dimensioned inner (explicit GROUP BY tags): a bare outer
-            # projection flattens series into one with the dims as columns,
-            # null rows preserved (TestServer_Query_Sliding_Window #8/#9)
-            proj = self._project_dimensioned(
-                stmt, series_list, list(src.stmt.group_by_tags),
-                _inner_source_name(inner))
-            if proj is not None:
-                return proj
-        mst_name = _inner_source_name(inner)
-        with tempfile.TemporaryDirectory(prefix="ogtpu-sub-") as tmp:
-            tmp_engine = _Engine(tmp, sync_wal=False)
-            try:
-                tmp_engine.create_database("sub")
-                # points at the same (tags, time) MERGE their fields —
-                # multi-source inners legitimately emit one row per source
-                # at the same timestamp with disjoint columns, and the
-                # engine's point-level LWW would otherwise drop all but
-                # the last (TestServer_Query_MultiMeasurements#4/#5)
-                by_key: dict[tuple, dict] = {}
-                key_order: list[tuple] = []
-                for series in series_list:
-                    tags = tuple(sorted(series.get("tags", {}).items()))
-                    cols = series["columns"][1:]
-                    for row in series["values"]:
-                        fields = {}
-                        for name, v in zip(cols, row[1:]):
-                            if v is None:
-                                continue
-                            if isinstance(v, bool):
-                                fields[name] = (FieldType.BOOL, v)
-                            elif isinstance(v, int):
-                                fields[name] = (FieldType.INT, v)
-                            elif isinstance(v, float):
-                                fields[name] = (FieldType.FLOAT, v)
-                            else:
-                                fields[name] = (FieldType.STRING, str(v))
-                        if fields:
-                            pkey = (tags, row[0])
-                            got = by_key.get(pkey)
-                            if got is None:
-                                by_key[pkey] = fields
-                                key_order.append(pkey)
-                            else:
-                                got.update(fields)
-                points = [
-                    (mst_name, tags, t, by_key[(tags, t)])
-                    for tags, t in key_order
-                ]
-                if points:
-                    tmp_engine.write_rows("sub", points)
-                outer = copy.copy(stmt)
-                outer.sources = [ast.Measurement(name=mst_name)]
-                outer.into = None  # INTO applies once, in the caller
-                # the source is now a materialized measurement: it must not
-                # re-resolve as a CTE name against the throw-away engine
-                outer.ctes = None
-                # influx wildcard-over-subquery expands to the inner's
-                # ORIGINAL output columns: explicit inner fields stay
-                # fields-only; an inner wildcard (bare or inside a call)
-                # lets the outer wildcard inline propagated tags. Inner
-                # EXPLICIT GROUP BY tags are output dimensions — the outer
-                # wildcard includes them as columns
-                # (TestServer_Query_SubqueryForLogicalOptimize#5)
-                outer._from_subquery = not inner_has_wild
-                if isinstance(src.stmt, ast.SelectStatement):
-                    outer._subquery_dims = list(src.stmt.group_by_tags)
-                # a flattenable plain-projection inner (bare field renames,
-                # no grouping) donates its explicit time bounds to the
-                # outer statement — the reference's subquery flattening
-                # makes the outer render window start at the inner tmin
-                # (SubqueryForLogicalOptimize#2); non-flattenable inners
-                # (computed projections) keep epoch-0 rendering (#4)
-                if (
-                    isinstance(src.stmt, ast.SelectStatement)
-                    and src.stmt.fields
-                    and all(isinstance(_strip_expr(f.expr), ast.VarRef)
-                            for f in src.stmt.fields)
-                    and not src.stmt.group_by_tags
-                    and not src.stmt.group_by_all_tags
-                    and src.stmt.group_by_time is None
-                    and src.stmt.condition is not None
-                ):
-                    try:
-                        sc_in = cond.split(src.stmt.condition, set(), now_ns)
-                        sc_out = cond.split(stmt.condition, set(), now_ns)
-                        if (
-                            sc_out.tmin == cond.MIN_TIME
-                            and sc_out.tmax == cond.MAX_TIME
-                            and (sc_in.tmin != cond.MIN_TIME
-                                 or sc_in.tmax != cond.MAX_TIME)
-                        ):
-                            bound = ast.BinaryExpr(
-                                "AND",
-                                ast.BinaryExpr(
-                                    ">=", ast.VarRef("time"),
-                                    ast.IntegerLiteral(sc_in.tmin)),
-                                ast.BinaryExpr(
-                                    "<", ast.VarRef("time"),
-                                    ast.IntegerLiteral(sc_in.tmax)),
-                            )
-                            outer.condition = (
-                                bound if outer.condition is None
-                                else ast.BinaryExpr(
-                                    "AND", outer.condition, bound)
-                            )
-                    except cond.ConditionError:
-                        pass
-                sub_ex = Executor(tmp_engine, users=self.users)
-                res = sub_ex._select(outer, "sub", now_ns, trace)
-                return res.get("series", [])
-            finally:
-                tmp_engine.close()
 
     def _resolve_measurements(self, src: ast.Measurement, db: str) -> list[str]:
         if src.name:
@@ -1600,11 +713,13 @@ class Executor:
             names.update(m for m in remote if rx.search(m))
         return sorted(names)
 
+
     def _measurement_schema(self, db, rp, mst) -> dict:
         schema: dict = {}
         for sh in self.engine.shards_for_range(db, rp, cond.MIN_TIME, cond.MAX_TIME):
             schema.update(sh.schema(mst))
         return schema
+
 
     def _select_measurement(self, stmt, db, rp, mst, now_ns, trace=tracing.NOOP) -> list[dict]:
         if _has_call_wildcard(stmt):
@@ -1637,6 +752,7 @@ class Executor:
         return self._select_host(stmt, db, rp, mst, now_ns)
 
     # -- shared scan planning ----------------------------------------------
+
 
     def _all_shards_with_remote(self, db, rp, mst, condition, now_ns,
                                 remote_mode="raw"):
@@ -1679,6 +795,7 @@ class Executor:
                 ]
             shards = shards + remote
         return shards, live
+
 
     def _scan_context(self, stmt, db, rp, mst, now_ns, remote_mode="raw"):
         """Shared prologue of every select path: schema/tag keys, WHERE
@@ -1792,6 +909,7 @@ class Executor:
 
     # -- aggregate path -----------------------------------------------------
 
+
     def _select_agg(self, stmt, db, rp, mst, now_ns, calls, trace=tracing.NOOP) -> list[dict]:
         from opengemini_tpu.query import partials as pmod
 
@@ -1833,6 +951,7 @@ class Executor:
                 if attempt == attempts - 1:
                     raise QueryError(str(e)) from e
         raise AssertionError("unreachable")
+
 
     def _select_agg_run(self, stmt, db, rp, mst, now_ns, aggs, pushdown,
                         trace=tracing.NOOP) -> list[dict]:
@@ -2153,6 +1272,7 @@ class Executor:
                 batches, schema, tmin,
             )
 
+
     def _scan_preagg(
         self, sh, mst, sid, gid, tmin, tmax, needed_fields,
         batches, pre_count, pre_sum, dtype, aligned, sum_fields,
@@ -2202,6 +1322,7 @@ class Executor:
             )
         return True, rows
 
+
     def _group_tags(self, stmt, shards, mst) -> list[str]:
         if stmt.group_by_all_tags:
             keys: set[str] = set()
@@ -2209,6 +1330,7 @@ class Executor:
                 keys.update(sh.index.tag_keys(mst))
             return sorted(keys)
         return list(stmt.group_by_tags)
+
 
     def _render_agg(
         self, stmt, mst, group_tags, group_keys, aligned, W, agg_results,
@@ -2301,1994 +1423,4 @@ class Executor:
 
     # -- percentile_approx (chunk-histogram sketches) ------------------------
 
-    def _select_percentile_approx(self, stmt, db, rp, mst, now_ns, call) -> list[dict]:
-        """percentile_approx(field, q): served from the per-chunk histogram
-        sketches in TSF pre-agg metadata — covered chunks contribute their
-        histograms with NO data decode (reference: OGSketch, persisted).
-        Memtable rows, partially-covered and histogram-less chunks decode
-        and bin exactly. Error: within one chunk-histogram bin width
-        (chunk_range/32) for sketch-served mass, one global bin width
-        (range/256) for directly-binned rows."""
-        from opengemini_tpu.query.sketch import HistSketch
 
-        if stmt.group_by_time is not None:
-            raise QueryError("percentile_approx() does not support GROUP BY time yet")
-        if len(call.args) != 2:
-            raise QueryError("percentile_approx() takes (field, q)")
-        fld = _strip_expr(call.args[0])
-        if not isinstance(fld, ast.VarRef):
-            raise QueryError("percentile_approx() field must be a field name")
-        qv = float(_call_param_value(call.args[1]))
-        if not (0 <= qv <= 100):
-            raise QueryError("percentile_approx() q must be between 0 and 100")
-        fname = fld.name
-        ctx = self._scan_context(stmt, db, rp, mst, now_ns)
-        if ctx is None:
-            return []
-        if ctx.schema.get(fname) not in (FieldType.FLOAT, FieldType.INT):
-            raise QueryError("percentile_approx() requires a numeric field")
-        if ctx.sc.has_row_filter:
-            raise QueryError("percentile_approx() does not support field filters")
-        tmin, tmax = ctx.tmin, ctx.tmax
-
-        # pass 1: per group, chunk hists (zero decode) or decoded values;
-        # any dedup risk (overlapping chunks / memtable rows) falls the
-        # whole series back to the merged read_series view
-        plans: dict[int, list] = {}  # gid -> [(kind, payload)]
-        bounds: dict[int, list] = {}
-
-        def _add_vals(gid, vals):
-            vals = vals[np.isfinite(vals)]  # nan/inf points never bin
-            if not len(vals):
-                return
-            plans.setdefault(gid, []).append(("values", vals))
-            b = bounds.setdefault(gid, [np.inf, -np.inf])
-            b[0] = min(b[0], float(vals.min()))
-            b[1] = max(b[1], float(vals.max()))
-
-        for sh, sid, gid in ctx.scan_plan:
-            TRACKER.check()  # KILL QUERY cancellation point
-            needs_merge, srcs = _series_needs_merged_decode(sh, mst, sid, tmin, tmax)
-            if needs_merge:
-                rec = sh.read_series(mst, sid, tmin, tmax, fields=[fname])
-                col = rec.columns.get(fname)
-                if col is not None and len(rec):
-                    _add_vals(gid, col.values[col.valid].astype(np.float64))
-                continue
-            for r, c in srcs:
-                loc = c.cols.get(fname)
-                pre = loc["pre"] if loc else None
-                covered = tmin <= c.tmin and c.tmax < tmax
-                if covered and pre is not None and pre.count and pre.hist is not None:
-                    plans.setdefault(gid, []).append(("hist", pre))
-                    b = bounds.setdefault(gid, [np.inf, -np.inf])
-                    b[0] = min(b[0], pre.vmin)
-                    b[1] = max(b[1], pre.vmax)
-                else:
-                    rec = r.read_chunk(mst, c, [fname]).slice_time(tmin, tmax)
-                    col = rec.columns.get(fname)
-                    if col is not None and len(rec):
-                        _add_vals(gid, col.values[col.valid].astype(np.float64))
-
-        name = stmt.fields[0].alias or "percentile_approx"
-        out_series = []
-        order = sorted(range(len(ctx.group_keys)), key=lambda g: ctx.group_keys[g])
-        t0 = ctx.aligned if ctx.aligned else 0
-        for g in order:
-            entries = plans.get(g)
-            if not entries:
-                continue
-            lo, hi = bounds[g]
-            sk = HistSketch(lo, hi)
-            for kind, payload in entries:
-                if kind == "hist":
-                    sk.add_chunk_hist(payload.vmin, payload.vmax, payload.hist)
-                else:
-                    sk.add_values(payload)
-            v = sk.percentile(qv)
-            if v is None:
-                continue
-            rows = [[t0, v]]
-            if not stmt.ascending:
-                rows.reverse()
-            rows = rows[stmt.offset :]
-            if stmt.limit:
-                rows = rows[: stmt.limit]
-            if not rows:
-                continue
-            series = {"name": mst, "columns": ["time", name], "values": rows}
-            if ctx.group_tags:
-                series["tags"] = dict(zip(ctx.group_tags, ctx.group_keys[g]))
-            out_series.append(series)
-        return out_series
-
-    # -- selector + auxiliary columns (host path) ----------------------------
-
-    def _select_selector_aux(self, stmt, db, rp, mst, now_ns, plan) -> list[dict]:
-        """One selector call + bare/arithmetic auxiliary columns: the
-        selector picks rows, aux columns are read from the selected rows
-        (reference: aux fields in the cursor iterators, call iterator
-        top/bottom transforms).  time = the selected point's timestamp,
-        except 1-row selectors under GROUP BY time, which emit the window
-        start (matching the reference's output tables)."""
-        sel_call, aux_fields = plan
-        sel_name = sel_call.name
-        sel_field = _strip_expr(sel_call.args[0]).name
-        n_rows = 1
-        if sel_name in ("top", "bottom"):
-            if len(sel_call.args) != 2:
-                raise QueryError(f"{sel_name}() takes (field, N)")
-            n_rows = int(_call_param_value(sel_call.args[1]))
-            if n_rows <= 0:
-                raise QueryError(f"{sel_name}() N must be positive")
-        pctl = None
-        if sel_name == "percentile":
-            if len(sel_call.args) != 2:
-                raise QueryError("percentile() takes (field, p)")
-            pctl = float(_call_param_value(sel_call.args[1]))
-
-        ctx = self._scan_context(stmt, db, rp, mst, now_ns)
-        if ctx is None:
-            return []
-        sc, schema = ctx.sc, ctx.schema
-        tmin, tmax = ctx.tmin, ctx.tmax
-        group_time, aligned, W = ctx.group_time, ctx.aligned, ctx.W
-        every = group_time.every_ns if group_time else 0
-
-        if (schema.get(sel_field) == FieldType.STRING
-                and sel_name not in ("first", "last")):
-            raise QueryError(
-                f"{sel_name}() is not supported on string field {sel_field!r}")
-
-        # output columns: drop explicit bare `time` refs (always col 0)
-        columns = ["time"]
-        col_plans = []  # ("sel",) | ("aux", expr)
-        used_names: dict[str, int] = {}
-        for f in stmt.fields:
-            e = _strip_expr(f.expr)
-            if isinstance(e, ast.VarRef) and e.name.lower() == "time":
-                continue
-            name = f.alias or _default_field_name(e)
-            k = used_names.get(name, 0)
-            used_names[name] = k + 1
-            if k:
-                name = f"{name}_{k}"
-            columns.append(name)
-            if isinstance(e, ast.Call):
-                col_plans.append(("sel",))
-            else:
-                col_plans.append(("aux", e))
-
-        aux_field_names = [n for n in aux_fields if n in schema]
-        read_fields = sorted({sel_field, *aux_field_names}
-                             | cond.row_filter_refs(sc))
-
-        groups: dict[int, list] = {}
-        for sh, sid, gid in ctx.scan_plan:
-            groups.setdefault(gid, []).append((sh, sid))
-
-        out_series = []
-        for gid in sorted(groups, key=lambda g: ctx.group_keys[g]):
-            key = ctx.group_keys[gid]
-            # gather rows of every member series: time, selector value,
-            # aux field columns, per-row tag values
-            t_list, v_list = [], []
-            aux_cols: dict[str, list] = {n: [] for n in aux_field_names}
-            aux_valid: dict[str, list] = {n: [] for n in aux_field_names}
-            tag_cols: dict[str, list] = {}
-            tag_names = {
-                n for n in aux_fields if n not in schema
-            }
-            for n in tag_names:
-                tag_cols[n] = []
-            for sh, sid in groups[gid]:
-                TRACKER.check()
-                rec = sh.read_series(mst, sid, tmin, tmax, fields=read_fields)
-                col = rec.columns.get(sel_field)
-                if col is None or len(rec) == 0:
-                    continue
-                m = col.valid.copy()
-                if sc.has_row_filter:
-                    m &= cond.eval_row_filter(sc, rec,
-                                              tags=sh.index.tags_of(sid))
-                if not m.any():
-                    continue
-                t_list.append(rec.times[m])
-                v_list.append(col.values[m])
-                nsel = int(m.sum())
-                for n in aux_field_names:
-                    ac = rec.columns.get(n)
-                    if ac is None:
-                        aux_cols[n].append(np.full(nsel, np.nan))
-                        aux_valid[n].append(np.zeros(nsel, bool))
-                    else:
-                        aux_cols[n].append(np.asarray(ac.values)[m])
-                        aux_valid[n].append(np.asarray(ac.valid)[m])
-                _, tags = sh.index.series_entry(sid)
-                tagd = dict(tags)
-                for n in tag_names:
-                    tag_cols[n].append([tagd.get(n)] * nsel)
-            if not t_list:
-                continue
-            t = np.concatenate(t_list)
-            v = np.concatenate(v_list)
-            order = np.argsort(t, kind="stable")
-            t, v = t[order], v[order]
-            aux_arr = {
-                n: (np.concatenate(aux_cols[n])[order],
-                    np.concatenate(aux_valid[n])[order])
-                for n in aux_field_names
-            }
-            tag_arr = {
-                n: [x for chunk in tag_cols[n] for x in chunk]
-                for n in tag_names
-            }
-            for n, vals in tag_arr.items():
-                tag_arr[n] = [vals[i] for i in order]
-
-            if group_time:
-                bounds = np.searchsorted(
-                    t, [aligned + w * every for w in range(W + 1)]
-                )
-                windows = [
-                    (aligned + w * every, slice(bounds[w], bounds[w + 1]))
-                    for w in range(W)
-                ]
-            else:
-                windows = [(aligned, slice(None))]
-
-            rows = []
-            for t_out, sl in windows:
-                tw, vw = t[sl], v[sl]
-                base = sl.start or 0
-                if len(vw) == 0:
-                    if n_rows == 1 and sel_name not in ("top", "bottom"):
-                        rows.append((t_out, [None] * (len(columns) - 1), False))
-                    continue
-                idxs = _selector_pick(sel_name, tw, vw, n_rows, pctl)
-                for i in idxs:
-                    ri = base + int(i)
-                    vals = []
-                    for cp in col_plans:
-                        if cp[0] == "sel":
-                            vals.append(_render_cell(
-                                v[ri], schema.get(sel_field), sel_name))
-                        else:
-                            vals.append(_eval_aux_expr(
-                                cp[1], ri, aux_arr, tag_arr, schema))
-                    t_row = (
-                        t_out
-                        if (group_time and n_rows == 1
-                            and sel_name not in ("top", "bottom"))
-                        else int(t[ri])
-                    )
-                    rows.append((t_row, vals, True))
-            if n_rows == 1 and sel_name not in ("top", "bottom"):
-                rows = _apply_fill(rows, stmt, columns)
-            if not stmt.ascending:
-                rows.reverse()
-            if stmt.offset:
-                rows = rows[stmt.offset:]
-            if stmt.limit:
-                rows = rows[: stmt.limit]
-            if not rows:
-                continue
-            series = {
-                "name": mst,
-                "columns": columns,
-                "values": [[tr] + vv for tr, vv, _p in rows],
-            }
-            if ctx.group_tags:
-                series["tags"] = dict(zip(ctx.group_tags, key))
-            out_series.append(series)
-        return out_series
-
-    def _select_top_companions(self, stmt, ctx, multi_plan, mst) -> list[dict]:
-        """top()/bottom() with companion projections: select rows by the
-        call, then evaluate every other projection against the SELECTED
-        source rows (wildcards expand to fields+tags; scalar math follows
-        the raw-path null rules). Reference: the reference's top/bottom
-        transform keeps auxiliary columns from the winning rows
-        (TestServer_Query_For_BugList#2, TestServer_SubQuery_Top_Min#0)."""
-        sel_name, call_name, sel_field, params = multi_plan
-        sc, schema, tag_keys = ctx.sc, ctx.schema, ctx.tag_keys
-        group_time, aligned, W = ctx.group_time, ctx.aligned, ctx.W
-
-        cols = []  # (output name, spec)
-        for f in stmt.fields:
-            e = _strip_expr(f.expr)
-            if isinstance(e, ast.Call):
-                cols.append((f.alias or _default_field_name(e), ("top",)))
-            elif isinstance(e, ast.Wildcard):
-                for n in sorted(set(schema) | tag_keys):
-                    if n in schema:
-                        cols.append((n, ("field", n)))
-                    else:
-                        cols.append((n, ("tag", n)))
-            elif isinstance(e, ast.VarRef):
-                kind = ("tag", e.name) if e.name in tag_keys and \
-                    e.name not in schema else ("field", e.name)
-                cols.append((f.alias or e.name, kind))
-            else:
-                cols.append((f.alias or _default_field_name(f.expr),
-                             ("expr", e)))
-        need_fields = {sel_field}
-        for _n, spec in cols:
-            if spec[0] == "field":
-                need_fields.add(spec[1])
-            elif spec[0] == "expr":
-                need_fields |= _scalar_refs(spec[1])
-        read_fields = sorted((need_fields | cond.row_filter_refs(sc))
-                             & set(schema))
-
-        groups: dict[tuple, list] = {}
-        for sh, sid, gid in ctx.scan_plan:
-            groups.setdefault(ctx.group_keys[gid], []).append((sh, sid))
-
-        out_series = []
-        for key in sorted(groups):
-            times_l, topv_l, rowcols_l, tags_l = [], [], [], []
-            for sh, sid in groups[key]:
-                TRACKER.check()
-                rec = sh.read_series(mst, sid, ctx.tmin, ctx.tmax,
-                                     fields=read_fields)
-                col = rec.columns.get(sel_field)
-                if col is None or len(rec) == 0:
-                    continue
-                m = col.valid.copy()
-                if sc.has_row_filter:
-                    m &= cond.eval_row_filter(
-                        sc, rec, tags=sh.index.tags_of(sid))
-                if not m.any():
-                    continue
-                times_l.append(rec.times[m])
-                topv_l.append(col.values[m].astype(np.float64))
-                per = {}
-                for fname in read_fields:
-                    c2 = rec.columns.get(fname)
-                    if c2 is not None:
-                        per[fname] = (c2.values[m], c2.valid[m], c2.ftype)
-                rowcols_l.append(per)
-                tags_l.append((sh.index.tags_of(sid), int(m.sum())))
-            if not times_l:
-                continue
-            t = np.concatenate(times_l)
-            v = np.concatenate(topv_l)
-            src_i = np.concatenate([
-                np.full(n, i, np.int32)
-                for i, (_tg, n) in enumerate(tags_l)
-            ])
-            off_i = np.concatenate([
-                np.arange(n, dtype=np.int64) for _tg, n in tags_l
-            ])
-            order = np.argsort(t, kind="stable")
-            t, v, src_i, off_i = t[order], v[order], src_i[order], off_i[order]
-
-            def window_bounds():
-                if not group_time:
-                    return [slice(None)]
-                bs = np.searchsorted(
-                    t, [aligned + w * group_time.every_ns for w in range(W + 1)])
-                return [slice(bs[w], bs[w + 1]) for w in range(W)]
-
-            def row_value(spec, si, oi):
-                per = rowcols_l[si]
-                if spec[0] == "tag":
-                    return tags_l[si][0].get(spec[1])
-                if spec[0] == "field":
-                    got = per.get(spec[1])
-                    if got is None or not got[1][oi]:
-                        return None
-                    return _pyval(got[0][oi], got[2])
-                return _eval_scalar_row(spec[1], per, tags_l[si][0], oi)
-
-            rows = []
-            for sl in window_bounds():
-                idx = fnmod.select_top_bottom_idx(
-                    call_name, t[sl], v[sl], params)
-                base = sl.start or 0
-                for i in idx:
-                    gi = base + int(i)
-                    row = [int(t[gi])]
-                    for _n, spec in cols:
-                        if spec[0] == "top":
-                            row.append(_pyval(v[gi], schema.get(sel_field)))
-                        else:
-                            row.append(
-                                row_value(spec, int(src_i[gi]), int(off_i[gi])))
-                    rows.append(row)
-            if not stmt.ascending:
-                rows.reverse()
-            if stmt.offset:
-                rows = rows[stmt.offset:]
-            if stmt.limit:
-                rows = rows[: stmt.limit]
-            if not rows:
-                continue
-            series = {"name": mst, "columns": ["time"] + [n for n, _s in cols],
-                      "values": rows}
-            if ctx.group_tags:
-                series["tags"] = dict(zip(ctx.group_tags, key))
-            out_series.append(series)
-        return out_series
-
-    # -- host function path (transforms, mode/integral/top/bottom/...) ------
-
-    def _select_host(self, stmt, db, rp, mst, now_ns) -> list[dict]:
-        """General host path for calls outside the device aggregate set
-        (reference: sql-side transform processors, SURVEY.md §2.3)."""
-        ctx = self._scan_context(stmt, db, rp, mst, now_ns)
-        if ctx is None:
-            return []
-        sc, schema = ctx.sc, ctx.schema
-        tmin, tmax = ctx.tmin, ctx.tmax
-        group_time, aligned, W = ctx.group_time, ctx.aligned, ctx.W
-        group_tags = ctx.group_tags
-        if group_time:
-            window_times = [aligned + w * group_time.every_ns for w in range(W)]
-        else:
-            window_times = [aligned]
-        groups: dict[tuple, list] = {}
-        for sh, sid, gid in ctx.scan_plan:
-            groups.setdefault(ctx.group_keys[gid], []).append((sh, sid))
-
-        # top/bottom with companion columns (wildcards, fields, math):
-        # detected before plan resolution — companions are not calls
-        if len(stmt.fields) > 1:
-            tb = [
-                _strip_expr(f.expr) for f in stmt.fields
-                if isinstance(_strip_expr(f.expr), ast.Call)
-                and _strip_expr(f.expr).name.lower() in ("top", "bottom")
-            ]
-            if len(tb) == 1 and all(
-                not isinstance(_strip_expr(f.expr), ast.Call)
-                or _strip_expr(f.expr) is tb[0]
-                for f in stmt.fields
-            ):
-                e = tb[0]
-                _kind, call_name, field, params, _inner = _resolve_host_call(
-                    e, group_time)
-                name = next(
-                    (f.alias for f in stmt.fields
-                     if _strip_expr(f.expr) is e and f.alias),
-                    _default_field_name(e))
-                return self._select_top_companions(
-                    stmt, ctx, (name, call_name, field, params), mst)
-
-        # resolve output columns
-        plans = []  # (name, kind, call_name, field, params, inner_agg|None)
-        multi_plan = None
-        for f in stmt.fields:
-            e = _strip_expr(f.expr)
-            if not isinstance(e, ast.Call):
-                raise QueryError(
-                    "expressions mixing functions and math are not supported "
-                    "in the host function path yet"
-                )
-            name = f.alias or _default_field_name(e)
-            kind, call_name, field, params, inner = _resolve_host_call(e, group_time)
-            _check_host_field_type(
-                inner[0] if kind == "sliding" and inner else call_name,
-                field, schema)
-            if kind == "multi":
-                if len(stmt.fields) > 1:
-                    raise QueryError(f"{call_name}() must be the only field")
-                multi_plan = (name, call_name, field, params)
-            else:
-                plans.append((name, kind, call_name, field, params, inner))
-
-        out_series = []
-        for key in sorted(groups):
-            rows_by_field: dict[str, tuple[np.ndarray, np.ndarray]] = {}
-
-            def field_rows(fname: str):
-                got = rows_by_field.get(fname)
-                if got is not None:
-                    return got
-                ts_list, vs_list = [], []
-                for sh, sid in groups[key]:
-                    TRACKER.check()  # KILL QUERY cancellation point
-                    rec = sh.read_series(
-                        mst, sid, tmin, tmax,
-                        fields=[fname] + sorted(cond.row_filter_refs(sc)))
-                    col = rec.columns.get(fname)
-                    if col is None or len(rec) == 0:
-                        continue
-                    m = col.valid.copy()
-                    if sc.has_row_filter:
-                        m &= cond.eval_row_filter(
-                            sc, rec, tags=sh.index.tags_of(sid))
-                    ts_list.append(rec.times[m])
-                    vs_list.append(col.values[m])
-                if not ts_list:
-                    got = (np.empty(0, np.int64), np.empty(0))
-                else:
-                    t = np.concatenate(ts_list)
-                    v = np.concatenate(vs_list)
-                    order = np.argsort(t, kind="stable")
-                    got = (t[order], v[order])
-                rows_by_field[fname] = got
-                return got
-
-            def window_slices(t: np.ndarray):
-                if not group_time:
-                    return [(window_times[0], slice(None))]
-                bounds = np.searchsorted(
-                    t, [aligned + w * group_time.every_ns for w in range(W + 1)]
-                )
-                return [
-                    (window_times[w], slice(bounds[w], bounds[w + 1]))
-                    for w in range(W)
-                ]
-
-            if multi_plan is not None:
-                name, call_name, fname, params = multi_plan
-                t, v = field_rows(fname)
-                rows = []
-                for wt, sl in window_slices(t):
-                    for rt, rv in fnmod.multi_row(call_name, t[sl], v[sl], params):
-                        rows.append([rt if rt is not None else wt, rv])
-                if not stmt.ascending:
-                    rows.reverse()
-                if stmt.offset:
-                    rows = rows[stmt.offset :]
-                if stmt.limit:
-                    rows = rows[: stmt.limit]
-                if not rows:
-                    continue
-                series = {"name": mst, "columns": ["time", name], "values": rows}
-                if group_tags:
-                    series["tags"] = dict(zip(group_tags, key))
-                out_series.append(series)
-                continue
-
-            # single raw transform: emit rows directly — dict keying would
-            # collapse rows when two series in the group share a timestamp
-            if len(plans) == 1 and plans[0][1] == "transform_raw":
-                name, _kind, call_name, fname, params, _inner = plans[0]
-                t, v = field_rows(fname)
-                if not stmt.ascending:
-                    # ORDER BY time DESC: the transform runs over the
-                    # DESC-ordered sequence (reference Null_Aggregate desc
-                    # difference cases — sign and row times follow the
-                    # reversed walk, not a reversed asc result)
-                    t_out, v_out = fnmod.transform(
-                        call_name, t[::-1], v[::-1], params
-                    )
-                else:
-                    t_out, v_out = fnmod.transform(call_name, t, v, params)
-                rows = [
-                    (int(tt), [fnmod.py_value(vv)], True)
-                    for tt, vv in zip(t_out, v_out)
-                ]
-                if stmt.offset:
-                    rows = rows[stmt.offset :]
-                if stmt.limit:
-                    rows = rows[: stmt.limit]
-                if not rows:
-                    continue
-                series = {
-                    "name": mst,
-                    "columns": ["time", name],
-                    "values": [[t0] + vv for t0, vv, _p in rows],
-                }
-                if group_tags:
-                    series["tags"] = dict(zip(group_tags, key))
-                out_series.append(series)
-                continue
-
-            col_maps: list[dict] = []  # per plan: {time: value}
-            has_plain_agg = False
-            sliding_grid: list | None = None
-            for name, kind, call_name, fname, params, inner in plans:
-                t, v = field_rows(fname)
-                if kind == "agg":
-                    has_plain_agg = True
-                    m: dict = {}
-                    for wt, sl in window_slices(t):
-                        val, sel_t = fnmod.host_agg(call_name, t[sl], v[sl], params)
-                        if val is not None:
-                            m[wt] = (val, sel_t)
-                    col_maps.append(m)
-                elif kind == "sliding":
-                    n = int(params[0])
-                    slices = window_slices(t)
-                    m = {}
-                    sliding_grid = [wt for wt, _sl in slices[: max(len(slices) - n + 1, 0)]]
-                    for i in range(0, len(slices) - n + 1):
-                        lo = slices[i][1].start or 0
-                        hi = slices[i + n - 1][1].stop
-                        val, _sel = fnmod.host_agg(
-                            inner[0], t[lo:hi], v[lo:hi], inner[1])
-                        if val is not None:
-                            m[slices[i][0]] = (val, None)
-                    col_maps.append(m)
-                elif kind == "transform_raw":
-                    t_out, v_out = fnmod.transform(call_name, t, v, params)
-                    col_maps.append({int(tt): (vv.item() if hasattr(vv, "item") else vv, None)
-                                     for tt, vv in zip(t_out, v_out)})
-                else:  # transform over inner aggregate windows
-                    seq_t, seq_v = [], []
-                    for wt, sl in window_slices(t):
-                        val, _sel = fnmod.host_agg(inner[0], t[sl], v[sl], inner[1])
-                        if val is not None:
-                            seq_t.append(wt)
-                            seq_v.append(val)
-                    t_out, v_out = fnmod.transform(
-                        call_name, np.asarray(seq_t, np.int64), np.asarray(seq_v), params
-                    )
-                    col_maps.append({int(tt): (float(vv), None) for tt, vv in zip(t_out, v_out)})
-
-            if has_plain_agg and group_time:
-                # transforms may emit times outside the window grid
-                # (holt_winters forecasts) — union them in, never drop
-                extra = {t for m in col_maps for t in m} - set(window_times)
-                base_times = sorted(set(window_times) | extra)
-            elif sliding_grid is not None:
-                # sliding windows emit every output slot; empties fill null
-                base_times = sliding_grid
-            else:
-                seen = sorted({t for m in col_maps for t in m})
-                base_times = seen
-            rows = []
-            for bt in base_times:
-                vals = []
-                present = False
-                for m in col_maps:
-                    entry = m.get(bt)
-                    if entry is None:
-                        vals.append(None)
-                    else:
-                        vals.append(entry[0])
-                        present = True
-                # single bare selector-time semantics
-                t_render = bt
-                if len(plans) == 1 and not group_time:
-                    entry = col_maps[0].get(bt)
-                    if entry and entry[1] is not None:
-                        t_render = entry[1]
-                rows.append((t_render, vals, present))
-            rows = _apply_fill(rows, stmt, ["time"] + [p[0] for p in plans])
-            if not stmt.ascending:
-                rows.reverse()
-            if stmt.offset:
-                rows = rows[stmt.offset :]
-            if stmt.limit:
-                rows = rows[: stmt.limit]
-            if not rows:
-                continue
-            series = {
-                "name": mst,
-                "columns": ["time"] + [p[0] for p in plans],
-                "values": [[t] + v for t, v, _p in rows],
-            }
-            if group_tags:
-                series["tags"] = dict(zip(group_tags, key))
-            out_series.append(series)
-        return out_series
-
-    # -- raw path -----------------------------------------------------------
-
-    def _select_table_function(self, stmt, call, db: str, now_ns: int) -> dict:
-        """SELECT <table_function>('<params json>') FROM m WHERE time ...
-        (reference: LogicalTableFunction, logic_plan.go:3863; the one
-        production operator is rca, table_function_factory.go:26). The
-        measurement's raw rows in the time range are the function input;
-        the result is one row holding the output graph as JSON."""
-        from opengemini_tpu.query import tablefunc as tfmod
-
-        if len(call.args) != 1:
-            raise QueryError(f"{call.name}() takes one string argument")
-        arg = _strip_expr(call.args[0])
-        if not isinstance(arg, ast.StringLiteral):
-            raise QueryError(f"{call.name}() parameter must be a quoted string")
-        import dataclasses
-
-        raw_stmt = dataclasses.replace(
-            stmt, fields=[ast.Field(expr=ast.Wildcard())],
-            group_by_all_tags=True, limit=0, offset=0,
-        )
-        rows: list[dict] = []
-        for src in stmt.sources:
-            if not isinstance(src, ast.Measurement):
-                raise QueryError(f"{call.name}() requires a measurement source")
-            src_db = src.database or db
-            for series in self._select_raw(raw_stmt, src_db, src.rp or None,
-                                           src.name, now_ns):
-                tags = series.get("tags") or {}
-                cols = series["columns"]
-                for vals in series["values"]:
-                    row = dict(tags)
-                    for c, v in zip(cols, vals):
-                        if v is not None:
-                            row[c] = v
-                    rows.append(row)
-        try:
-            graph = tfmod.TABLE_FUNCTIONS[call.name](rows, arg.val)
-        except tfmod.TableFunctionError as e:
-            raise QueryError(str(e)) from None
-        name = stmt.sources[0].name if stmt.sources else call.name
-        import json as _json
-
-        return {"series": [_series(name, None, [call.name],
-                                   [[_json.dumps(graph, sort_keys=True)]])]}
-
-    def _select_raw(self, stmt, db, rp, mst, now_ns) -> list[dict]:
-        if self.engine.is_measurement_dropped(db, mst):
-            return []  # mark-deleted: hidden from SELECT pre-purge
-        shards_all, _live = self._all_shards_with_remote(
-            db, rp, mst, stmt.condition, now_ns
-        )
-        tag_keys: set[str] = set()
-        schema: dict[str, FieldType] = {}
-        for sh in shards_all:
-            tag_keys.update(sh.index.tag_keys(mst))
-            schema.update(sh.schema(mst))
-        if not schema:
-            if stmt.group_by_all_tags:
-                # GROUP BY * requires the measurement's tag keys from
-                # meta — a missing measurement is an error there, not an
-                # empty result (reference meta.Measurement ->
-                # ErrMeasurementNotFound; TestServer_Query_Where_Fields)
-                raise QueryError("measurement not found")
-            return []
-        sc = cond.split(stmt.condition, tag_keys, now_ns)
-        shards = [sh for sh in shards_all if sh.tmax > sc.tmin and sh.tmin < sc.tmax]
-        if not shards:
-            return []
-
-        # output columns: * expands to fields + tags, except tags consumed
-        # by GROUP BY (explicit or *), which surface in the series tags dict
-        # (influx wildcard semantics)
-        if stmt.group_by_all_tags:
-            grouped_tags = tag_keys
-        elif getattr(stmt, "_from_subquery", False):
-            # inner EXPLICIT group-by tags are subquery output dimensions:
-            # the outer wildcard lists them as columns
-            grouped_tags = tag_keys - set(getattr(stmt, "_subquery_dims", ()))
-        else:
-            grouped_tags = set(stmt.group_by_tags)
-        names: list[tuple] = []  # (output name, kind, payload)
-        for f in stmt.fields:
-            e = _strip_expr(f.expr)
-            if isinstance(e, ast.Wildcard):
-                names.extend(
-                    (n, "ref", n)
-                    for n in sorted(set(schema) | (tag_keys - grouped_tags))
-                )
-            elif isinstance(e, ast.StringLiteral):
-                # constant column (validated to carry an alias upstream)
-                names.append(
-                    (f.alias or _default_field_name(f.expr), "const", e.val))
-            elif (
-                isinstance(e, (ast.BinaryExpr, ast.UnaryExpr))
-                and not _calls_in(e)
-            ):
-                # scalar field math (`f1 + f2 + f3`, `100 - age`): null
-                # unless every referenced field is present on the row;
-                # rows where ANY referenced field exists still emit
-                # (reference TestServer_Query_SubqueryMath)
-                names.append(
-                    (f.alias or _default_field_name(f.expr), "expr", e))
-            else:
-                src_name = e.name if isinstance(e, ast.VarRef) else ""
-                names.append(
-                    (f.alias or _default_field_name(f.expr), "ref", src_name))
-        # duplicate output names get _N suffixes, all columns kept —
-        # `SELECT value, * FROM m` yields value, ..., value_1 (influx
-        # duplicate-column naming; TestServer_Query_Wildcards#4). const/
-        # expr lookups key by the FINAL (suffixed) name so colliding
-        # aliases stay wired to their own payloads.
-        used: dict[str, int] = {}
-        out_cols = []  # (final name, source ref)
-        const_cols: dict[str, str] = {}  # final name -> literal value
-        expr_cols: dict[str, object] = {}  # final name -> scalar expr AST
-        for n, kind, payload in names:
-            k = used.get(n, 0)
-            used[n] = k + 1
-            final = f"{n}_{k}" if k else n
-            if kind == "const":
-                const_cols[final] = payload
-                out_cols.append((final, final))
-            elif kind == "expr":
-                expr_cols[final] = payload
-                out_cols.append((final, final))
-            else:
-                out_cols.append((final, payload or n))
-        columns = ["time"] + [n for n, _s in out_cols]
-        src_of = {n: s_ for n, s_ in out_cols}
-
-        group_tags = self._group_tags(stmt, shards, mst)
-        groups: dict[tuple, list] = {}
-        match_terms = cond.conjunctive_match_terms(sc.field_expr)
-        hinted = bool({"full_series", "specific_series"}
-                      & set(getattr(stmt, "hints", ())))
-        exact_tags = (
-            cond.exact_series_tags(stmt.condition, tag_keys)
-            if "full_series" in getattr(stmt, "hints", ()) else None
-        ) or None  # no tag equalities -> the hint pins nothing
-        for sh in shards:
-            sids = cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
-            if sc.mixed_expr is not None:
-                if hinted:
-                    sids &= cond.series_only_sids(
-                        sc.mixed_expr, sh.index, mst, sc.tag_keys)
-                else:
-                    sids &= cond.tag_superset_sids(
-                        sc.mixed_expr, sh.index, mst, sc.tag_keys)
-            if exact_tags is not None:
-                sids = {s for s in sids
-                        if sh.index.tags_of(s) == exact_tags}
-            sids = _prune_text_sids(sh, mst, sids, match_terms)
-            for sid in sorted(sids):
-                tags = sh.index.tags_of(sid)
-                key = tuple(tags.get(k, "") for k in group_tags)
-                groups.setdefault(key, []).append((sh, sid, tags))
-        if hinted:
-            sc.mixed_series_level = True  # consumed at the series level
-
-        # project only needed columns: selected fields + filter refs +
-        # scalar-math operand fields
-        filter_refs = cond.row_filter_refs(sc)
-        expr_refs: set[str] = set()
-        for e in expr_cols.values():
-            expr_refs |= _scalar_refs(e)
-        read_fields = sorted(
-            ({src_of[c] for c in columns[1:] if src_of[c] in schema}
-             | set(filter_refs) | expr_refs) & set(schema)
-        )
-        # tag-only selects (e.g. SELECT "name" FROM m, openGemini
-        # semantics): a row exists wherever ANY field is set, so read
-        # every field for presence
-        tag_only = not read_fields and any(
-            src_of[c] in tag_keys for c in columns[1:])
-        if tag_only:
-            read_fields = None
-        out_series = []
-        for key in sorted(groups):
-            rows: list[list] = []
-            for sh, sid, tags in groups[key]:
-                TRACKER.check()  # KILL QUERY cancellation point
-                rec = sh.read_series(mst, sid, sc.tmin, sc.tmax, fields=read_fields)
-                if len(rec) == 0:
-                    continue
-                fmask = (
-                    cond.eval_row_filter(sc, rec, tags=tags)
-                    if sc.has_row_filter
-                    else np.ones(len(rec), dtype=bool)
-                )
-                # a raw row is emitted if any selected *field* is present
-                # (tag-only selects: any field at all)
-                present = np.zeros(len(rec), dtype=bool)
-                col_arrays = []
-                for name in columns[1:]:
-                    if name in const_cols:
-                        col_arrays.append((None, None, const_cols[name]))
-                        continue
-                    ref = src_of[name]
-                    if ref in expr_cols:
-                        vals, valid, touched = _eval_scalar_cols(
-                            expr_cols[ref], rec)
-                        col_arrays.append((vals, valid, FieldType.FLOAT))
-                        present |= touched
-                        continue
-                    col = rec.columns.get(ref)
-                    if col is not None:
-                        col_arrays.append((col.values, col.valid, col.ftype))
-                        present |= col.valid
-                    elif ref in tags:
-                        col_arrays.append((None, None, tags[ref]))
-                    else:
-                        col_arrays.append((None, None, None))
-                if tag_only:
-                    for col in rec.columns.values():
-                        present |= col.valid
-                sel = np.nonzero(fmask & present)[0]
-                for i in sel:
-                    row = [int(rec.times[i])]
-                    for values, valid, extra in col_arrays:
-                        if values is None:
-                            row.append(extra if isinstance(extra, str) else None)
-                        elif valid[i]:
-                            row.append(_pyval(values[i], extra))
-                        else:
-                            row.append(None)
-                    rows.append(row)
-            if not rows:
-                continue
-            if getattr(stmt, "_subquery_dims", None) and not group_tags:
-                # ungrouped select over a dimensioned subquery keeps the
-                # inner series order (rows appended per-series, ascending
-                # within each — reference SubqueryForLogicalOptimize#5)
-                if not stmt.ascending:
-                    rows.reverse()
-            else:
-                rows.sort(key=lambda r: r[0], reverse=not stmt.ascending)
-            series = {"name": mst, "columns": columns, "values": rows}
-            if group_tags:
-                series["tags"] = dict(zip(group_tags, key))
-            out_series.append(series)
-        if stmt.offset or stmt.limit:
-            # LIMIT/OFFSET apply GLOBALLY over the time-merged row stream,
-            # not per series (reference TestServer_Query_LimitAndOffset:
-            # `group by tennant limit 1` returns one row total); series
-            # left empty by the slice are omitted entirely
-            flat = []
-            for si, s in enumerate(out_series):
-                flat.extend((row[0], si, row) for row in s["values"])
-            flat.sort(key=lambda e: (e[0], e[1]), reverse=not stmt.ascending)
-            if stmt.offset:
-                flat = flat[stmt.offset:]
-            if stmt.limit:
-                flat = flat[: stmt.limit]
-            kept: dict[int, list] = {}
-            for _t, si, row in flat:
-                kept.setdefault(si, []).append(row)
-            out_series = [
-                dict(s, values=kept[si])
-                for si, s in enumerate(out_series)
-                if si in kept
-            ]
-        return out_series
-
-    # -- SHOW ---------------------------------------------------------------
-
-    def _all_shards_db(self, db: str):
-        return self.engine.shards_for_range(db, None, cond.MIN_TIME, cond.MAX_TIME)
-
-    def _visible(self, db: str, mst: str) -> bool:
-        """False for mark-deleted measurements (hidden from SELECT and
-        metadata SHOWs; SHOW SERIES intentionally still lists their series
-        until the purge — reference TestServer_Query_ShowSeries)."""
-        return not self.engine.is_measurement_dropped(db, mst)
-
-    def _show_measurements(self, stmt, db) -> dict:
-        db = stmt.database or db
-        names: set[str] = set()
-        for sh in self._all_shards_db(db):
-            names.update(m for m in sh.measurements() if self._visible(db, m))
-        if self.router is not None:
-            try:
-                names.update(self.router.remote_measurements(db, None))
-            except Exception as e:  # noqa: BLE001
-                raise QueryError(str(e)) from e
-        if stmt.regex:
-            rx = re.compile(stmt.regex)
-            names = {n for n in names if rx.search(n)}
-        if not names:
-            return {}
-        return _series_result("measurements", None, ["name"], [[n] for n in sorted(names)])
-
-    @staticmethod
-    def _mst_match(stmt, mst: str) -> bool:
-        if stmt.measurement:
-            return mst == stmt.measurement
-        if getattr(stmt, "measurement_regex", ""):
-            return re.search(stmt.measurement_regex, mst) is not None
-        return True
-
-    @staticmethod
-    def _matching_sids(sh, mst: str, condition) -> set[int]:
-        """Series of `mst` in shard `sh` matching the tag predicates of
-        `condition`.  Time predicates are ignored (SHOW metadata statements
-        filter series, not points); predicates on keys that are not tags of
-        the measurement match NOTHING — `WHERE value = 'x'` over series
-        metadata is vacuously false, matching the reference's behavior
-        (coordinator show-executor tag-filter rewrite)."""
-        sids = sh.index.series_ids(mst)
-        if condition is not None:
-            tag_keys = set(sh.index.tag_keys(mst))
-            sc = cond.split(condition, tag_keys, 0)
-            if sc.has_row_filter:
-                return set()
-            if sc.tag_expr is not None:
-                sids = sids & cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
-        return sids
-
-    def _show_tag_keys(self, stmt, db) -> dict:
-        db = stmt.database or db
-        per_mst: dict[str, set] = {}
-        for sh in self._all_shards_db(db):
-            for mst in sh.measurements():
-                if not self._mst_match(stmt, mst) or not self._visible(db, mst):
-                    continue
-                if stmt.condition is not None:
-                    for sid in self._matching_sids(sh, mst, stmt.condition):
-                        _, tags = sh.index.series_entry(sid)
-                        per_mst.setdefault(mst, set()).update(k for k, _ in tags)
-                else:
-                    per_mst.setdefault(mst, set()).update(sh.index.tag_keys(mst))
-        series = [
-            _series(m, None, ["tagKey"], [[k] for k in sorted(keys)])
-            for m, keys in sorted(per_mst.items())
-            if keys
-        ]
-        return {"series": series} if series else {}
-
-    def _show_tag_values(self, stmt, db) -> dict:
-        db = stmt.database or db
-        key_rx = re.compile(stmt.key_regex) if stmt.key_regex else None
-        per_mst: dict[str, set] = {}
-        for sh in self._all_shards_db(db):
-            for mst in sh.measurements():
-                if not self._mst_match(stmt, mst) or not self._visible(db, mst):
-                    continue
-                wanted = [
-                    k for k in sh.index.tag_keys(mst)
-                    if (k in stmt.keys) or (key_rx is not None and key_rx.search(k))
-                ]
-                if not wanted:
-                    continue
-                if stmt.condition is None:
-                    # no series filter: direct inverted-index lookup, never
-                    # an O(series) walk (1M-series measurements)
-                    bucket = per_mst.setdefault(mst, set())
-                    for k in wanted:
-                        for v in sh.index.tag_values(mst, k):
-                            bucket.add((k, v))
-                    continue
-                for sid in self._matching_sids(sh, mst, stmt.condition):
-                    _, tags = sh.index.series_entry(sid)
-                    for k, v in tags:
-                        if k in wanted:
-                            per_mst.setdefault(mst, set()).add((k, v))
-        series = []
-        for mst, pairs in sorted(per_mst.items()):
-            uniq = sorted(pairs, reverse=stmt.order_desc)
-            if stmt.offset:
-                uniq = uniq[stmt.offset:]
-            if stmt.limit:
-                uniq = uniq[:stmt.limit]
-            if uniq:
-                series.append(
-                    _series(mst, None, ["key", "value"], [list(p) for p in uniq]))
-        return {"series": series} if series else {}
-
-    def _show_field_keys(self, stmt, db) -> dict:
-        db = stmt.database or db
-        per_mst: dict[str, dict] = {}
-        for sh in self._all_shards_db(db):
-            for mst in sh.measurements():
-                if not self._mst_match(stmt, mst) or not self._visible(db, mst):
-                    continue
-                per_mst.setdefault(mst, {}).update(sh.schema(mst))
-        type_names = {
-            FieldType.FLOAT: "float",
-            FieldType.INT: "integer",
-            FieldType.BOOL: "boolean",
-            FieldType.STRING: "string",
-        }
-        series = []
-        for mst, sch in sorted(per_mst.items()):
-            rows = [[k, type_names[t]] for k, t in sorted(sch.items())]
-            series.append(_series(mst, None, ["fieldKey", "fieldType"], rows))
-        return {"series": series} if series else {}
-
-    def _show_series(self, stmt, db) -> dict:
-        from opengemini_tpu.ingest.line_protocol import series_key
-
-        db = stmt.database or db
-        keys: set[str] = set()
-        for sh in self._all_shards_db(db):
-            for mst in sh.measurements():
-                if not self._mst_match(stmt, mst):
-                    continue
-                for sid in self._matching_sids(sh, mst, stmt.condition):
-                    m, tags = sh.index.series_entry(sid)
-                    keys.add(series_key(m, tags))
-        if not keys:
-            return {}
-        return _series_result("", None, ["key"], [[k] for k in sorted(keys)])
-
-    def _show_series_exact_cardinality(self, stmt, db) -> dict:
-        """Per-measurement exact distinct-series count (reference:
-        ShowSeriesCardinalityStatement with EXACT, executor.go)."""
-        from opengemini_tpu.ingest.line_protocol import series_key
-
-        db = stmt.database or db
-        per_mst: dict[str, set] = {}
-        for sh in self._all_shards_db(db):
-            for mst in sh.measurements():
-                if not self._mst_match(stmt, mst):
-                    continue
-                bucket = per_mst.setdefault(mst, set())
-                for sid in self._matching_sids(sh, mst, stmt.condition):
-                    m, tags = sh.index.series_entry(sid)
-                    bucket.add(series_key(m, tags))
-        series = [
-            _series(m, None, ["count"], [[len(keys)]])
-            for m, keys in sorted(per_mst.items())
-            if keys
-        ]
-        return {"series": series} if series else {}
-
-    def _show_rps(self, stmt, db) -> dict:
-        db = stmt.database or db
-        d = self.engine.databases.get(db)
-        if d is None:
-            raise QueryError(f"database not found: {db}")
-        rows = []
-        for rp in d.rps.values():
-            rows.append(
-                [
-                    rp.name,
-                    _fmt_duration(rp.duration_ns),
-                    _fmt_duration(rp.shard_duration_ns),
-                    1,
-                    rp.name == d.default_rp,
-                ]
-            )
-        return _series_result(
-            "", None,
-            ["name", "duration", "shardGroupDuration", "replicaN", "default"],
-            rows,
-        )
-
-
-# -- helpers -----------------------------------------------------------------
-
-
-def _prune_text_sids(sh, mst, sids, match_terms):
-    """Intersect candidate series with the persisted text index for every
-    conjunctive match() term (reference: logstore token-index pruning).
-    Conservative: memtable rows are unindexed so live-memtable series
-    always survive; shards without the index (or RemoteShard proxies)
-    prune nothing."""
-    if not match_terms or not sids:
-        return sids
-    lookup = getattr(sh, "text_match_sids", None)
-    if lookup is None:
-        return sids
-    mem_sids = sh.mem.sids_for(mst)
-    for fld, tok in match_terms:
-        got = lookup(mst, fld, tok)
-        if got is None:
-            return sids  # a pre-sidecar file: cannot prune safely
-        sids = sids & (got | mem_sids)
-        if not sids:
-            break
-    return sids
-
-
-def _series_needs_merged_decode(sh, mst, sid, tmin, tmax):
-    """Dedup-risk check shared by the pre-agg and sketch fast paths: a
-    series needs the merged read_series view when memtable rows overlap
-    the range or its chunks overlap each other (last-write-wins dedup).
-    Returns (needs_merge, chunk_sources)."""
-    if not getattr(sh, "supports_preagg", False):
-        # remote proxies expose no chunk metadata: always take the merged
-        # read_series view (returning (False, []) here would silently
-        # DROP the remote data from the fast paths)
-        return True, None
-    mem_rec = sh.mem.record_for(sid)
-    if mem_rec is not None and len(mem_rec.slice_time(tmin, tmax)):
-        return True, None
-    srcs = sh.file_chunks(mst, {sid}, tmin, tmax)
-    if any(c.packed for _r, c in srcs):
-        # packed chunks hold many series: their pre-agg is chunk-wide, so
-        # per-series fast paths must take the merged decode
-        return True, None
-    metas = sorted((c for _r, c in srcs), key=lambda c: c.tmin)
-    for a, b in zip(metas, metas[1:]):
-        if b.tmin <= a.tmax:
-            return True, None
-    return False, srcs
-
-
-def _add_record_to_batches(rec, seg, aligned, needed_fields, batches, dtype,
-                           fmask, sids=None):
-    """Shared scan step: one record's columns into the per-field device
-    batches (string columns become count-only zero payloads; int-exact
-    host batches receive the raw int64 values uncast). `sids` (scalar or
-    per-row array) carries series identity for the grid batch's
-    constant-stride run detection."""
-    rel = rec.times - aligned  # int64 ns; (hi, lo)-split on add()
-    for fname in needed_fields:
-        col = rec.columns.get(fname)
-        if col is None:
-            continue
-        if isinstance(batches[fname], ragged.IntExactBatch):
-            vals = col.values  # int64 end-to-end, no float cast
-        elif col.ftype == FieldType.STRING:
-            vals = np.zeros(len(rec), dtype=dtype)  # count-only path
-        else:
-            vals = col.values.astype(dtype)
-        m = col.valid
-        if fmask is not None:
-            m = m & fmask
-        batches[fname].add(vals, rel, seg, m, rec.times, sids=sids)
-
-
-def _merge_multi_source(all_series: list[dict], stmt) -> list[dict]:
-    """Union the per-source output series of a multi-source raw SELECT
-    into combined series per tagset: name = sorted comma-join of source
-    names, columns = union (sorted when the projection used a wildcard),
-    rows time-ordered. Rows stay distinct even at equal timestamps —
-    each source's row keeps its identity (Constant_Column#0); aggregate
-    statements union rows upstream via the subquery rewrite instead
-    (reference TestServer_Query_MultiMeasurements)."""
-    wildcard = any(
-        isinstance(_strip_expr(f.expr), ast.Wildcard) for f in stmt.fields
-    )
-    groups: dict[tuple, dict] = {}
-    order: list[tuple] = []
-    for s in all_series:
-        key = tuple(sorted((s.get("tags") or {}).items()))
-        g = groups.get(key)
-        if g is None:
-            groups[key] = g = {"names": set(), "columns": ["time"],
-                               "rows": [], "tags": s.get("tags")}
-            order.append(key)
-        g["names"].add(s["name"])
-        cols = s["columns"]
-        for c in cols[1:]:
-            if c not in g["columns"]:
-                g["columns"].append(c)
-        for row in s["values"]:
-            g["rows"].append((row[0], dict(zip(cols[1:], row[1:]))))
-    out = []
-    for key in order:
-        g = groups[key]
-        if wildcard:
-            g["columns"] = ["time"] + sorted(g["columns"][1:])
-        g["rows"].sort(key=lambda r: r[0], reverse=not stmt.ascending)
-        merged = g["rows"]
-        name = ",".join(sorted(g["names"]))
-        values = [
-            [t] + [cv.get(c) for c in g["columns"][1:]] for t, cv in merged
-        ]
-        series = {"name": name, "columns": g["columns"], "values": values}
-        if g["tags"]:
-            series["tags"] = g["tags"]
-        out.append(series)
-    return out
-
-
-def _inner_source_name(stmt, _depth: int = 0) -> str:
-    """Influx keeps the innermost measurement name for subquery output
-    (CTE references resolve to their body's innermost source; a union
-    body names itself after its sorted side names)."""
-    if _depth > 16:
-        return "subquery"
-    if isinstance(stmt, ast.UnionStatement):
-        parts: set[str] = set()
-        for sel in stmt.selects:
-            n = _inner_source_name(sel, _depth + 1)
-            if n != "subquery":
-                parts.update(n.split(","))
-        return ",".join(sorted(parts)) if parts else "subquery"
-    # multiple sources name the output after the sorted union of their
-    # innermost names (reference: "mst,mst1" in TestServer_Query_
-    # MultiMeasurements)
-    parts2: set[str] = set()
-    for src in stmt.sources:
-        if isinstance(src, ast.SubQuery):
-            n = _inner_source_name(src.stmt, _depth + 1)
-        elif isinstance(src, ast.Measurement) and src.name:
-            if stmt.ctes and src.name in stmt.ctes:
-                n = _inner_source_name(stmt.ctes[src.name], _depth + 1)
-            else:
-                n = src.name
-        else:
-            continue
-        if n != "subquery":
-            parts2.update(n.split(","))
-    return ",".join(sorted(parts2)) if parts2 else "subquery"
-
-
-def _series(name, tags, columns, values):
-    s = {"name": name, "columns": columns, "values": values}
-    if tags:
-        s["tags"] = tags
-    if not name:
-        del s["name"]
-    return s
-
-
-def _series_result(name, tags, columns, values) -> dict:
-    return {"series": [_series(name, tags, columns, values)]}
-
-
-def _strip_expr(e):
-    while isinstance(e, ast.ParenExpr):
-        e = e.expr
-    return e
-
-
-def _collect_calls(fields) -> list[ast.Call]:
-    out = []
-    for f in fields:
-        out.extend(_calls_in(f.expr))
-    return out
-
-
-def _eval_scalar_row(e, per: dict, tags: dict, oi: int):
-    """One-row scalar-math evaluation over companion columns (`per` maps
-    field -> (values, valid, ftype)). None propagates through every op."""
-    e = _strip_expr(e)
-    if isinstance(e, ast.VarRef):
-        got = per.get(e.name)
-        if got is None or not got[1][oi]:
-            return None
-        try:
-            return float(got[0][oi])
-        except (TypeError, ValueError):
-            return None
-    if isinstance(e, (ast.NumberLiteral, ast.IntegerLiteral,
-                      ast.DurationLiteral)):
-        return float(e.val)
-    if isinstance(e, ast.UnaryExpr):
-        v = _eval_scalar_row(e.expr, per, tags, oi)
-        if v is None:
-            return None
-        return -v if e.op == "-" else v
-    if isinstance(e, ast.BinaryExpr):
-        lv = _eval_scalar_row(e.lhs, per, tags, oi)
-        rv = _eval_scalar_row(e.rhs, per, tags, oi)
-        if lv is None or rv is None:
-            return None
-        if e.op == "+":
-            return lv + rv
-        if e.op == "-":
-            return lv - rv
-        if e.op == "*":
-            return lv * rv
-        if e.op == "/":
-            return lv / rv if rv else None
-        if e.op == "%":
-            return lv % rv if rv else None
-    return None
-
-
-def _scalar_refs(e) -> set[str]:
-    """Field names referenced by a scalar-math projection expression."""
-    e = _strip_expr(e)
-    if isinstance(e, ast.VarRef):
-        return {e.name}
-    if isinstance(e, ast.BinaryExpr):
-        return _scalar_refs(e.lhs) | _scalar_refs(e.rhs)
-    if isinstance(e, ast.UnaryExpr):
-        return _scalar_refs(e.expr)
-    return set()
-
-
-def _eval_scalar_cols(e, rec):
-    """Vectorized scalar-math projection over one record.
-
-    Returns (values f64, valid, touched): `valid` requires EVERY operand
-    field present (influx null-propagation — `f1 + f2` is null when either
-    side is), `touched` is true where ANY referenced field is present (the
-    row still emits with a null value, TestServer_Query_SubqueryMath#0).
-    """
-    n = len(rec)
-    e = _strip_expr(e)
-    if isinstance(e, ast.VarRef):
-        col = rec.columns.get(e.name)
-        if col is None or col.ftype == FieldType.STRING:
-            z = np.zeros(n, bool)
-            return np.zeros(n), z, z.copy()
-        vals = np.where(col.valid, col.values.astype(np.float64), 0.0)
-        return vals, col.valid.copy(), col.valid.copy()
-    if isinstance(e, (ast.NumberLiteral, ast.IntegerLiteral,
-                      ast.DurationLiteral)):
-        ones = np.ones(n, bool)
-        return np.full(n, float(e.val)), ones, np.zeros(n, bool)
-    if isinstance(e, ast.UnaryExpr):
-        vals, valid, touched = _eval_scalar_cols(e.expr, rec)
-        return (-vals if e.op == "-" else vals), valid, touched
-    if isinstance(e, ast.BinaryExpr):
-        lv, lok, lt = _eval_scalar_cols(e.lhs, rec)
-        rv, rok, rt = _eval_scalar_cols(e.rhs, rec)
-        valid = lok & rok
-        touched = lt | rt
-        with np.errstate(all="ignore"):
-            if e.op == "+":
-                out = lv + rv
-            elif e.op == "-":
-                out = lv - rv
-            elif e.op == "*":
-                out = lv * rv
-            elif e.op == "/":
-                valid = valid & (rv != 0)  # x/0 is null (influx)
-                out = np.divide(lv, np.where(rv != 0, rv, 1.0))
-            elif e.op == "%":
-                valid = valid & (rv != 0)
-                out = np.mod(lv, np.where(rv != 0, rv, 1.0))
-            else:
-                z = np.zeros(n, bool)
-                return np.zeros(n), z, touched
-        return out, valid, touched
-    z = np.zeros(n, bool)
-    return np.zeros(n), z, z.copy()
-
-
-def _calls_in(e) -> list[ast.Call]:
-    e = _strip_expr(e)
-    if isinstance(e, ast.Call):
-        return [e]
-    if isinstance(e, ast.BinaryExpr):
-        return _calls_in(e.lhs) + _calls_in(e.rhs)
-    if isinstance(e, ast.UnaryExpr):
-        return _calls_in(e.expr)
-    return []
-
-
-# wildcard-in-call expansion: these functions expand `f(*)` over numeric
-# fields only (math is meaningless on strings/bools); everything else
-# expands over every field (reference: influxql RewriteFields)
-_NUMERIC_ONLY_WILDCARD = {
-    "difference", "non_negative_difference", "derivative",
-    "non_negative_derivative", "moving_average", "cumulative_sum", "sum",
-    "mean", "median", "stddev", "spread", "percentile", "integral",
-    "max", "min", "top", "bottom", "sample",
-    "rate", "irate", "regr_slope",
-}
-
-
-def _call_wildcard_inner(e):
-    """f(*) -> (f, None); f(g(*), ...) -> (f, g). None when no wildcard."""
-    if not (isinstance(e, ast.Call) and e.args):
-        return None
-    a0 = _strip_expr(e.args[0])
-    if isinstance(a0, ast.Wildcard):
-        return e, None
-    if isinstance(a0, ast.Call) and a0.args and isinstance(
-            _strip_expr(a0.args[0]), ast.Wildcard):
-        return e, a0
-    return None
-
-
-def _has_call_wildcard(stmt) -> bool:
-    return any(
-        _call_wildcard_inner(_strip_expr(f.expr)) is not None
-        for f in stmt.fields
-    )
-
-
-def _expand_call_wildcards(stmt, schema):
-    """Rewrite `SELECT f(*) ...` into one call per matching field, each
-    aliased `f_<field>` (reference: influxql.RewriteFields wildcard
-    expansion)."""
-    import copy
-
-    new_fields = []
-    for f in stmt.fields:
-        e = _strip_expr(f.expr)
-        hit = _call_wildcard_inner(e)
-        if hit is None:
-            new_fields.append(f)
-            continue
-        outer, inner = hit
-        base = _default_field_name(outer)
-        type_call = (inner or outer).name
-        for fld in sorted(schema):
-            ft = schema[fld]
-            if type_call in ("max", "min"):
-                if ft == FieldType.STRING:
-                    continue  # max/min(*): numeric + bool
-            elif type_call in _NUMERIC_ONLY_WILDCARD and ft not in (
-                    FieldType.FLOAT, FieldType.INT):
-                continue
-            if inner is None:
-                call = ast.Call(
-                    outer.name, (ast.VarRef(fld),) + tuple(outer.args[1:]))
-            else:
-                new_inner = ast.Call(
-                    inner.name, (ast.VarRef(fld),) + tuple(inner.args[1:]))
-                call = ast.Call(
-                    outer.name, (new_inner,) + tuple(outer.args[1:]))
-            new_fields.append(ast.Field(call, alias=f"{base}_{fld}"))
-    out = copy.copy(stmt)
-    out.fields = new_fields
-    return out
-
-
-def _needs_string_host_path(stmt, schema_fn) -> bool:
-    """schema_fn is called lazily — the shard-schema sweep only runs when a
-    call could actually involve a string field."""
-    candidates = []
-    for call in _collect_calls(stmt.fields):
-        if not call.args or call.name not in _STRING_OK_HOST or call.name == "count":
-            continue
-        a = _strip_expr(call.args[0])
-        if isinstance(a, ast.VarRef):
-            candidates.append(a.name)
-    if not candidates:
-        return False
-    schema = schema_fn()
-    return any(schema.get(n) == FieldType.STRING for n in candidates)
-
-
-_AUX_SELECTORS = {"first", "last", "max", "min", "top", "bottom", "percentile"}
-
-
-def _selector_aux_plan(stmt: ast.SelectStatement):
-    """Detect `SELECT <selector>(f, ...), aux...`: exactly one call, a
-    selector, with at least one auxiliary (non-call, non-`time`) column.
-    Returns (call, aux_field_names) or None."""
-    calls = _collect_calls(stmt.fields)
-    if len(calls) != 1 or calls[0].name not in _AUX_SELECTORS:
-        return None
-    call = calls[0]
-    if not call.args or not isinstance(_strip_expr(call.args[0]), ast.VarRef):
-        return None
-    aux_names: list[str] = []
-    has_aux = False
-    for f in stmt.fields:
-        e = _strip_expr(f.expr)
-        if isinstance(e, ast.Call):
-            continue
-        if isinstance(e, ast.VarRef) and e.name.lower() == "time":
-            continue
-        refs = _collect_varrefs(e)
-        if refs is None:
-            return None  # something we cannot evaluate per-row
-        aux_names.extend(refs)
-        has_aux = True
-    if not has_aux:
-        return None
-    return call, sorted(set(aux_names))
-
-
-def _collect_varrefs(e) -> list[str] | None:
-    """Field/tag names referenced by a per-row arithmetic expr, or None
-    if the expr contains anything other than refs/literals/arithmetic."""
-    e = _strip_expr(e)
-    if isinstance(e, ast.VarRef):
-        return [e.name]
-    if isinstance(e, (ast.NumberLiteral, ast.IntegerLiteral)):
-        return []
-    if isinstance(e, ast.UnaryExpr):
-        return _collect_varrefs(e.expr)
-    if isinstance(e, ast.BinaryExpr):
-        l, r = _collect_varrefs(e.lhs), _collect_varrefs(e.rhs)
-        if l is None or r is None:
-            return None
-        return l + r
-    return None
-
-
-def _selector_pick(sel_name: str, tw, vw, n_rows: int, pctl) -> list[int]:
-    """Row indices (into the window slice) a selector picks; output order
-    is time-ascending for multi-row selectors."""
-    if sel_name == "first":
-        return [0]
-    if sel_name == "last":
-        return [len(vw) - 1]
-    if sel_name == "max":
-        return [int(np.argmax(vw))]
-    if sel_name == "min":
-        return [int(np.argmin(vw))]
-    if sel_name == "percentile":
-        order = np.argsort(vw, kind="stable")
-        i = int(math.floor(len(vw) * pctl / 100.0 + 0.5)) - 1
-        if i < 0 or i >= len(vw):
-            return []
-        return [int(order[i])]
-    # top/bottom: n best by value (ties -> earliest), output time-ascending
-    keys = -vw if sel_name == "top" else vw
-    order = np.lexsort((np.arange(len(vw)), keys))[:n_rows]
-    return sorted(int(i) for i in order)
-
-
-def _render_cell(v, ftype, call_name: str):
-    if ftype == FieldType.STRING:
-        return None if v is None else str(v)
-    if ftype == FieldType.INT:
-        return int(v)
-    if ftype == FieldType.BOOL:
-        return bool(round(float(v)))
-    fv = float(v)
-    if math.isnan(fv) or math.isinf(fv):
-        return None
-    return fv
-
-
-def _eval_aux_expr(e, ri: int, aux_arr, tag_arr, schema):
-    """Evaluate one auxiliary column at selected row `ri`."""
-    e = _strip_expr(e)
-    if isinstance(e, ast.VarRef):
-        if e.name in aux_arr:
-            vals, valid = aux_arr[e.name]
-            if not valid[ri]:
-                return None
-            return _render_cell(vals[ri], schema.get(e.name), "aux")
-        if e.name in tag_arr:
-            return tag_arr[e.name][ri]
-        return None
-    if isinstance(e, (ast.NumberLiteral, ast.IntegerLiteral)):
-        return e.val
-    if isinstance(e, ast.UnaryExpr) and e.op == "-":
-        v = _eval_aux_expr(e.expr, ri, aux_arr, tag_arr, schema)
-        return None if v is None else -v
-    if isinstance(e, ast.BinaryExpr):
-        lv = _eval_aux_expr(e.lhs, ri, aux_arr, tag_arr, schema)
-        rv = _eval_aux_expr(e.rhs, ri, aux_arr, tag_arr, schema)
-        if lv is None or rv is None or isinstance(lv, str) or isinstance(rv, str):
-            return None
-        try:
-            if e.op == "+":
-                return lv + rv
-            if e.op == "-":
-                return lv - rv
-            if e.op == "*":
-                return lv * rv
-            if e.op == "/":
-                return lv / rv if rv != 0 else None
-            if e.op == "%":
-                return lv % rv if rv != 0 else None
-        except TypeError:
-            return None
-    raise QueryError(f"unsupported auxiliary expression: {e}")
-
-
-def _has_in_subquery(e) -> bool:
-    if isinstance(e, ast.InSubquery):
-        return True
-    if isinstance(e, ast.BinaryExpr):
-        return _has_in_subquery(e.lhs) or _has_in_subquery(e.rhs)
-    if isinstance(e, (ast.ParenExpr, ast.UnaryExpr)):
-        return _has_in_subquery(e.expr)
-    return False
-
-
-def _classify_select(stmt: ast.SelectStatement) -> str:
-    """'raw' | 'device' | 'host' — the single source of truth for which
-    execution path a SELECT takes (used by execution AND EXPLAIN)."""
-    calls = _collect_calls(stmt.fields)
-    if not calls:
-        return "raw"
-    if all(_is_device_call(c) for c in calls):
-        return "device"
-    return "host"
-
-
-def _is_device_call(call: ast.Call) -> bool:
-    if call.name == "count" and call.args:
-        inner = _strip_expr(call.args[0])
-        if isinstance(inner, ast.Call) and inner.name == "distinct":
-            return True
-    if call.name in aggmod.REGISTRY:
-        # device aggs take a bare field ref (string fields route to count
-        # validation inside _select_agg)
-        return bool(call.args) and isinstance(_strip_expr(call.args[0]), ast.VarRef)
-    return False
-
-
-def _call_param_value(arg) -> float | int:
-    a = _strip_expr(arg)
-    if isinstance(a, ast.UnaryExpr) and a.op == "-":
-        return -_call_param_value(a.expr)
-    if isinstance(a, ast.IntegerLiteral):
-        return a.val
-    if isinstance(a, ast.NumberLiteral):
-        return a.val
-    if isinstance(a, ast.DurationLiteral):
-        return a.val_ns
-    raise QueryError("function parameter must be a number or duration")
-
-
-def _call_param_any(arg):
-    a = _strip_expr(arg)
-    if isinstance(a, ast.StringLiteral):
-        return a.val
-    return _call_param_value(arg)
-
-
-def _resolve_host_call(call: ast.Call, group_time):
-    """-> (kind, call_name, field, params, inner) where kind is
-    'agg' | 'transform_raw' | 'transform_agg' | 'multi' | 'sliding'."""
-    name = call.name
-    if name == "sliding_window":
-        # sliding_window(agg(f), N): agg over N consecutive GROUP BY time
-        # windows, emitted at each window start (reference:
-        # TestServer_Query_Sliding_Window_Aggregate)
-        if len(call.args) != 2:
-            raise QueryError("sliding_window() takes (aggregate, N)")
-        if group_time is None:
-            raise QueryError("sliding_window() requires GROUP BY time(...)")
-        inner_e = _strip_expr(call.args[0])
-        if not isinstance(inner_e, ast.Call):
-            raise QueryError("sliding_window() argument must be an aggregate")
-        n = int(_call_param_value(call.args[1]))
-        if n < 1:
-            raise QueryError("sliding_window() N must be >= 1")
-        ikind, iname, ifield, iparams, _ = _resolve_host_call(inner_e, group_time)
-        if ikind != "agg":
-            raise QueryError("sliding_window() argument must be an aggregate")
-        return "sliding", name, ifield, (n,), (iname, iparams)
-    if name in fnmod.TRANSFORMS:
-        if not call.args:
-            raise QueryError(f"{name}() requires an argument")
-        inner_e = _strip_expr(call.args[0])
-        if name == "difference":
-            # difference(f[, 'front'|'behind'|'absolute'])
-            params = tuple(_call_param_any(a) for a in call.args[1:])
-            if params and params[0] not in ("front", "behind", "absolute"):
-                raise QueryError(
-                    "difference() mode must be 'front', 'behind' or 'absolute'")
-        else:
-            params = tuple(_call_param_value(a) for a in call.args[1:])
-        _check_host_arity(name, params)
-        if isinstance(inner_e, ast.Call):
-            if group_time is None:
-                raise QueryError(
-                    f"{name}() over an aggregate requires GROUP BY time(...)"
-                )
-            ikind, iname, ifield, iparams, _ = _resolve_host_call(inner_e, group_time)
-            if ikind != "agg":
-                raise QueryError(f"{name}() argument must be a field or aggregate")
-            return "transform_agg", name, ifield, params, (iname, iparams)
-        if isinstance(inner_e, ast.VarRef):
-            if name.startswith("holt_winters"):
-                raise QueryError(
-                    "holt_winters() requires an aggregate argument with "
-                    "GROUP BY time(...)"
-                )
-            if group_time is not None:
-                raise QueryError(
-                    f"{name}() over raw points cannot use GROUP BY time(...) — "
-                    "wrap the field in an aggregate"
-                )
-            return "transform_raw", name, inner_e.name, params, None
-        raise QueryError(f"{name}() argument must be a field or aggregate")
-    if name in fnmod.MULTI_ROW:
-        if not call.args:
-            raise QueryError(f"{name}() requires a field argument")
-        fld = _strip_expr(call.args[0])
-        if not isinstance(fld, ast.VarRef):
-            raise QueryError(f"{name}() argument must be a field")
-        if name == "detect":
-            # detect(field, 'algorithm'[, threshold]): string only in slot 0
-            params = []
-            for i, a in enumerate(call.args[1:]):
-                params.append(_call_param_any(a) if i == 0 else _call_param_value(a))
-            params = tuple(params)
-            if params and not isinstance(params[0], str):
-                raise QueryError("detect() algorithm must be a quoted string")
-        else:
-            params = tuple(_call_param_value(a) for a in call.args[1:])
-        _check_host_arity(name, params)
-        return "multi", name, fld.name, params, None
-    if name == "count" and call.args and isinstance(_strip_expr(call.args[0]), ast.Call):
-        inner = _strip_expr(call.args[0])
-        if inner.name == "distinct":
-            fld = _strip_expr(inner.args[0])
-            return "agg", "count_distinct", fld.name, (), None
-    if name in fnmod.HOST_AGGS:
-        if not call.args or not isinstance(_strip_expr(call.args[0]), ast.VarRef):
-            raise QueryError(f"{name}() requires a field argument")
-        params = tuple(_call_param_value(a) for a in call.args[1:])
-        _check_host_arity(name, params)
-        return "agg", name, _strip_expr(call.args[0]).name, params, None
-    raise QueryError(f"unsupported function: {name}")
-
-
-# (min required params, max allowed params) per host call with parameters
-_HOST_ARITY = {
-    "percentile": (1, 1),
-    "moving_average": (1, 1),
-    "top": (1, 1),
-    "bottom": (1, 1),
-    "sample": (1, 1),
-    "distinct": (0, 0),
-    "detect": (0, 2),
-    "holt_winters": (1, 2),
-    "holt_winters_with_fit": (1, 2),
-    "difference": (0, 1),
-    "non_negative_difference": (0, 0),
-    "cumulative_sum": (0, 0),
-}
-
-
-def _check_host_arity(name: str, params: tuple) -> None:
-    lo, hi = _HOST_ARITY.get(name, (0, 1))
-    if not (lo <= len(params) <= hi):
-        raise QueryError(f"{name}() takes {lo + 1} to {hi + 1} arguments")
-    if name == "moving_average" and params and int(params[0]) < 1:
-        raise QueryError("moving_average() window must be >= 1")
-    if name.startswith("holt_winters") and params:
-        n = int(params[0])
-        if not (1 <= n <= 10_000):
-            raise QueryError("holt_winters() N must be between 1 and 10000")
-        if len(params) > 1 and not (0 <= int(params[1]) <= 10_000):
-            raise QueryError("holt_winters() seasonal period must be 0..10000")
-
-
-def _resolve_call(call: ast.Call):
-    """-> (AggSpec, params, field_name)."""
-    name = call.name
-    args = call.args
-    if name == "count" and args and isinstance(_strip_expr(args[0]), ast.Call):
-        inner = _strip_expr(args[0])
-        if inner.name == "distinct":
-            spec = aggmod.get("count_distinct")
-            fld = _call_field(inner)
-            return spec, (), fld
-    if name == "percentile":
-        if len(args) != 2:
-            raise QueryError("percentile() takes (field, N)")
-        q = _strip_expr(args[1])
-        if isinstance(q, (ast.IntegerLiteral, ast.NumberLiteral)):
-            qv = float(q.val)
-        else:
-            raise QueryError("percentile() N must be a number")
-        return aggmod.get("percentile"), (qv,), _call_field(call)
-    spec = aggmod.get(name)  # KeyError -> surfaced as query error
-    return spec, (), _call_field(call)
-
-
-def _call_field(call: ast.Call) -> str:
-    if not call.args:
-        raise QueryError(f"{call.name}() requires a field argument")
-    a = _strip_expr(call.args[0])
-    if isinstance(a, ast.VarRef):
-        return a.name
-    if isinstance(a, ast.Wildcard):
-        raise QueryError(f"{call.name}(*) is not supported yet")
-    raise QueryError(f"{call.name}() argument must be a field")
-
-
-def _default_field_name(e) -> str:
-    e = _strip_expr(e)
-    if isinstance(e, ast.Call):
-        if e.name == "count" and e.args:
-            inner = _strip_expr(e.args[0])
-            if isinstance(inner, ast.Call) and inner.name == "distinct":
-                return "count"
-        return e.name
-    if isinstance(e, ast.VarRef):
-        return e.name
-    if isinstance(e, ast.BinaryExpr):
-        calls = _calls_in(e)
-        if calls:
-            return "_".join(c.name for c in calls)
-        refs = sorted({r for r in cond.field_filter_refs(e)})
-        return "_".join(refs) if refs else "expr"
-    return "expr"
-
-
-def _eval_output_expr(expr, agg_results, seg, schema):
-    """Evaluate one output column at segment `seg`. Returns (value, present)."""
-    expr = _strip_expr(expr)
-    if isinstance(expr, ast.Call):
-        entry = agg_results.get(id(expr))
-        if entry is None:
-            raise QueryError(f"unplanned call {expr.name}")
-        out, sel, counts, spec, fname, _times = entry
-        if counts[seg] == 0:
-            return None, False
-        # single-sample stddev renders 0 (reference NewStdDevReduce,
-        # engine/executor/agg_func.go, returns 0 with isNil=false for n==1)
-        v = out[seg]
-        ftype = schema.get(fname)
-        if spec.int_output:
-            return int(v), True
-        if ftype == FieldType.INT and spec.name in ("sum", "min", "max", "first", "last", "spread"):
-            # int64-exact path yields integer arrays: never round-trip
-            # through float (2^53 cliff)
-            if isinstance(v, np.integer):
-                return int(v), True
-            return int(round(float(v))), True
-        if ftype == FieldType.BOOL and spec.name in ("first", "last", "min", "max"):
-            return bool(round(float(v))), True
-        fv = float(v)
-        if math.isnan(fv) or math.isinf(fv):
-            return None, True
-        return fv, True
-    if isinstance(expr, (ast.NumberLiteral, ast.IntegerLiteral)):
-        return expr.val, False
-    if isinstance(expr, ast.UnaryExpr) and expr.op == "-":
-        v, p = _eval_output_expr(expr.expr, agg_results, seg, schema)
-        return (None if v is None else -v), p
-    if isinstance(expr, ast.BinaryExpr):
-        lv, lp = _eval_output_expr(expr.lhs, agg_results, seg, schema)
-        rv, rp = _eval_output_expr(expr.rhs, agg_results, seg, schema)
-        present = lp or rp
-        if lv is None or rv is None:
-            return None, present
-        try:
-            if expr.op == "+":
-                return lv + rv, present
-            if expr.op == "-":
-                return lv - rv, present
-            if expr.op == "*":
-                return lv * rv, present
-            if expr.op == "/":
-                return (lv / rv if rv != 0 else None), present
-            if expr.op == "%":
-                return (lv % rv if rv != 0 else None), present
-        except TypeError:
-            return None, present
-    raise QueryError(f"unsupported output expression: {expr}")
-
-
-def _apply_fill(rows, stmt, columns, count_idx: tuple = ()):
-    """rows: [(t, vals, any_present)] per window, ascending. Influx fill
-    semantics (reference: engine/executor fill_transform.go). count_idx:
-    value indices holding bare count()/count(distinct) results — under
-    the default null fill those render 0 for empty windows
-    (TestServer_Query_Fill#6)."""
-    fill = stmt.fill_option
-    if not stmt.group_by_time:
-        return [(t, v, p) for t, v, p in rows if p]
-    if fill == "none":
-        return [(t, v, p) for t, v, p in rows if p]
-    if fill == "null" and count_idx:
-        out = []
-        for t, vals, p in rows:
-            vals = [0 if (i in count_idx and v is None) else v
-                    for i, v in enumerate(vals)]
-            out.append((t, vals, p))
-        rows = out
-    if fill == "number":
-        out = []
-        for t, vals, p in rows:
-            vals = [stmt.fill_value if v is None else v for v in vals]
-            out.append((t, vals, p))
-        return out
-    if fill == "previous":
-        prev = [None] * (len(columns) - 1)
-        out = []
-        for t, vals, p in rows:
-            vals = [prev[i] if v is None else v for i, v in enumerate(vals)]
-            prev = vals
-            out.append((t, vals, p))
-        return out
-    if fill == "linear":
-        ncols = len(columns) - 1
-        arr = [[v for v in vals] for _t, vals, _p in rows]
-        for ci in range(ncols):
-            col = [r[ci] for r in arr]
-            col = _linear_fill(col)
-            for ri, v in enumerate(col):
-                arr[ri][ci] = v
-        return [(rows[i][0], arr[i], rows[i][2]) for i in range(len(rows))]
-    return rows  # "null"
-
-
-def _linear_fill(col):
-    n = len(col)
-    known = [i for i, v in enumerate(col) if v is not None]
-    if len(known) < 2:
-        return col
-    out = list(col)
-    for a, b in zip(known, known[1:]):
-        if b - a > 1:
-            va, vb = col[a], col[b]
-            for i in range(a + 1, b):
-                out[i] = va + (vb - va) * (i - a) / (b - a)
-    return out
-
-
-def _pyval(v, ftype):
-    if ftype == FieldType.FLOAT:
-        fv = float(v)
-        # non-finite floats marshal as JSON null (influx semantics; a bare
-        # NaN/Infinity literal is not valid strict JSON and breaks clients)
-        return fv if math.isfinite(fv) else None
-    if ftype == FieldType.INT:
-        return int(v)
-    if ftype == FieldType.BOOL:
-        return bool(v)
-    return v if isinstance(v, str) else str(v)
-
-
-def _data_time_range(shards, mst):
-    dmin = dmax = None
-    for sh in shards:
-        for r, c in sh.file_chunks(mst):
-            dmin = c.tmin if dmin is None else min(dmin, c.tmin)
-            dmax = c.tmax if dmax is None else max(dmax, c.tmax)
-        if sh.mem.min_time is not None:
-            dmin = sh.mem.min_time if dmin is None else min(dmin, sh.mem.min_time)
-            dmax = sh.mem.max_time if dmax is None else max(dmax, sh.mem.max_time)
-    return dmin, dmax
-
-
-def _fmt_duration(ns: int) -> str:
-    if ns == 0:
-        return "0s"
-    h, rem = divmod(ns // NS, 3600)
-    m, s = divmod(rem, 60)
-    return f"{h}h{m}m{s}s"
