@@ -17,6 +17,7 @@ import json
 import threading
 import urllib.request
 
+from opengemini_tpu.utils import peers
 from opengemini_tpu.meta.raft import LEADER, RaftNode
 
 
@@ -646,9 +647,10 @@ class HttpTransport:
                 continue
             try:
                 req = urllib.request.Request(
-                    f"http://{addr}/raft/msg", data=json.dumps(msg).encode("utf-8"),
+                    peers.url(addr, "/raft/msg"),
+                    data=json.dumps(msg).encode("utf-8"),
                     headers={"Content-Type": "application/json"}, method="POST",
                 )
-                urllib.request.urlopen(req, timeout=self.timeout_s).read()
+                peers.urlopen(req, timeout=self.timeout_s).read()
             except OSError:
                 pass  # unreachable peers are raft's normal case

@@ -21,6 +21,8 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
+from opengemini_tpu.utils import peers
+
 import numpy as np
 
 from opengemini_tpu.index.inverted import SeriesIndex
@@ -457,8 +459,8 @@ class DataRouter:
             if not addr:
                 return (nid, False)
             try:
-                with urllib.request.urlopen(
-                        f"http://{addr}/ping", timeout=2) as r:
+                with peers.urlopen(peers.url(addr, "/ping"),
+                                   timeout=2) as r:
                     return (nid, r.status in (200, 204))
             except OSError:
                 return (nid, False)
@@ -495,11 +497,11 @@ class DataRouter:
             if not addr:
                 return None
             req = urllib.request.Request(
-                f"http://{addr}/cluster/health",
+                peers.url(addr, "/cluster/health"),
                 headers={"X-Ogt-Token": self.token},
             )
             try:
-                with urllib.request.urlopen(req, timeout=2) as r:
+                with peers.urlopen(req, timeout=2) as r:
                     got = json.loads(r.read())
                 view = got.get("health")
                 if isinstance(view, dict):
@@ -984,7 +986,7 @@ class DataRouter:
         addr = self.data_nodes().get(node_id, "")
         if not addr:
             raise RemoteScanError(f"no address for data node {node_id!r}")
-        url = f"http://{addr}/write?db={quote(db, safe='')}"
+        url = peers.url(addr, f"/write?db={quote(db, safe='')}")
         if rp:
             url += f"&rp={quote(rp, safe='')}"
         req = urllib.request.Request(
@@ -992,17 +994,17 @@ class DataRouter:
             headers={"X-Ogt-Internal": "1", "X-Ogt-Token": self.token},
             method="POST",
         )
-        urllib.request.urlopen(req, timeout=self.timeout_s).read()
+        peers.urlopen(req, timeout=self.timeout_s).read()
 
     def _post_raw(self, addr: str, path: str, body: dict):
         """One internal-POST implementation (token injection, timeout);
         returns (bytes, content_type)."""
         req = urllib.request.Request(
-            f"http://{addr}{path}",
+            peers.url(addr, path),
             data=json.dumps(dict(body, token=self.token)).encode("utf-8"),
             headers={"Content-Type": "application/json"}, method="POST",
         )
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+        with peers.urlopen(req, timeout=self.timeout_s) as r:
             return r.read(), r.headers.get("Content-Type", "")
 
     def _post(self, addr: str, path: str, body: dict) -> dict:

@@ -11,6 +11,10 @@ Config (TOML, reference lib/config style):
     flush-threshold-mb = 64
     [http]
     bind-address = "127.0.0.1:8086"
+    tls-cert = "/etc/ogt/node.crt"   # serve https (client + peer traffic)
+    tls-key = "/etc/ogt/node.key"
+    tls-ca = "/etc/ogt/ca.crt"       # peer-client trust (else system CAs)
+    tls-insecure-skip-verify = false # self-signed lab clusters
     [device]
     mesh-axes = ["shard", "time"]   # enables the multi-chip aggregate path
     mesh-devices = 0                # 0/absent = every local device
@@ -26,6 +30,7 @@ import threading
 import tomllib
 
 from opengemini_tpu.server.http import HttpService
+from opengemini_tpu.utils import peers as peernet
 from opengemini_tpu.storage.engine import Engine
 
 DEFAULTS = {
@@ -78,9 +83,30 @@ def build(cfg: dict) -> HttpService:
         flush_threshold_bytes=int(data.get("flush-threshold-mb", 64)) << 20,
     )
     host, _, port = cfg["http"]["bind-address"].partition(":")
+    http_cfg = cfg["http"]
+    tls = None
+    if http_cfg.get("tls-cert") and http_cfg.get("tls-key"):
+        # [http] tls-cert/tls-key serve the listener over https
+        tls = {"certfile": http_cfg["tls-cert"],
+               "keyfile": http_cfg["tls-key"]}
+    if tls or http_cfg.get("tls-ca") or http_cfg.get(
+            "tls-insecure-skip-verify"):
+        # peer clients (raft, /internal/*, registrar) speak https whenever
+        # ANY tls-* key is set: a node behind a TLS-terminating proxy (no
+        # serving cert of its own) still needs https to its peers
+        peernet.configure_tls(
+            ca_file=http_cfg.get("tls-ca") or None,
+            skip_verify=bool(http_cfg.get("tls-insecure-skip-verify",
+                                          False)),
+        )
+    else:
+        # process-global, like the device mesh: a config without TLS must
+        # not inherit https peer mode from an earlier build()
+        peernet.reset()
     svc = HttpService(
         engine, host or "127.0.0.1", int(port or 8086),
-        auth_enabled=bool(cfg["http"].get("auth-enabled", False)),
+        auth_enabled=bool(http_cfg.get("auth-enabled", False)),
+        tls=tls,
     )
     meta_cfg = cfg.get("meta")
     if meta_cfg and meta_cfg.get("node-id"):
@@ -194,7 +220,7 @@ def _spawn_registrar(meta_store, node_id: str, addr: str, token: str) -> None:
                 if laddr:
                     try:
                         req = _rq.Request(
-                            f"http://{laddr}/cluster/register",
+                            peernet.url(laddr, "/cluster/register"),
                             data=_json.dumps({
                                 "id": node_id, "addr": addr,
                                 "role": "data", "token": token,
@@ -202,7 +228,7 @@ def _spawn_registrar(meta_store, node_id: str, addr: str, token: str) -> None:
                             headers={"Content-Type": "application/json"},
                             method="POST",
                         )
-                        with _rq.urlopen(req, timeout=3) as r:
+                        with peernet.urlopen(req, timeout=3) as r:
                             if r.status == 200:
                                 return
                     except OSError:
@@ -224,11 +250,11 @@ def _spawn_joiner(seed: str, node_id: str, addr: str, token: str) -> None:
         for _ in range(120):
             try:
                 req = _rq.Request(
-                    f"http://{target}/raft/join",
+                    peernet.url(target, "/raft/join"),
                     data=_json.dumps(body).encode(),
                     headers={"Content-Type": "application/json"}, method="POST",
                 )
-                with _rq.urlopen(req, timeout=3) as r:
+                with peernet.urlopen(req, timeout=3) as r:
                     if r.status == 200:
                         print(f"joined meta cluster via {target}", flush=True)
                         return
@@ -391,7 +417,9 @@ def main(argv=None) -> int:
     if args.pidfile:
         with open(args.pidfile, "w", encoding="utf-8") as f:
             f.write(str(os.getpid()))
-    print(f"opengemini-tpu ts-server listening on :{svc.port}", flush=True)
+    scheme = "https" if svc.tls_enabled else "http"
+    print(f"opengemini-tpu ts-server listening on {scheme}://:{svc.port}",
+          flush=True)
     stop_event.wait()
     print("shutting down", flush=True)
     for s in svc.services:

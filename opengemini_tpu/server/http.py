@@ -61,11 +61,35 @@ def _prom_step(s: str | None) -> float:
         return parse_duration_s(s)
 
 
+class _TLSThreadingServer(ThreadingHTTPServer):
+    """TLS handshake in the worker thread: accept() returns the raw
+    connection immediately (do_handshake_on_connect=False on the wrapped
+    listener); finish_request — which ThreadingMixIn already runs in the
+    per-connection thread — performs the bounded handshake."""
+
+    def finish_request(self, request, client_address):
+        import socket
+        import ssl
+
+        try:
+            request.settimeout(30)
+            request.do_handshake()
+            request.settimeout(None)
+        except (ssl.SSLError, OSError, socket.timeout):
+            try:
+                request.close()
+            except OSError:
+                pass
+            return
+        super().finish_request(request, client_address)
+
+
 class HttpService:
     """Owns the HTTP listener; one Engine + Executor behind it."""
 
     def __init__(self, engine: Engine, host: str = "127.0.0.1", port: int = 8086,
-                 prom_db: str = "prom", auth_enabled: bool = False):
+                 prom_db: str = "prom", auth_enabled: bool = False,
+                 tls: dict | None = None):
         self.engine = engine
         self.auth_enabled = auth_enabled
         self.executor = Executor(engine, auth_enabled=auth_enabled)
@@ -80,7 +104,24 @@ class HttpService:
 
         self.logstore = LogStoreAPI(self)  # /repo log-mode surface
         handler = _make_handler(self)
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        if tls:
+            # serve every surface — client API, /internal/* data plane,
+            # /raft/* — over TLS (reference: the https options of
+            # lib/config sql.go applied to the httpd listener). The
+            # handshake runs in the per-connection WORKER thread
+            # (_TLSThreadingServer), never in the accept loop — one
+            # stalled client must not block all new connections.
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls["certfile"], tls["keyfile"])
+            self.httpd = _TLSThreadingServer((host, port), handler)
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
+        else:
+            self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.tls_enabled = bool(tls)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
 

@@ -250,7 +250,7 @@ class TestReviewRegressions:
             def read(self):
                 return b""
 
-        def fake_urlopen(req, timeout=None):
+        def fake_urlopen(req, timeout=None, context=None):
             seen["url"] = req.full_url
             return FakeResp()
 
