@@ -57,6 +57,7 @@ class MetaFSM:
         # all-in-one server registers the SAME id in both roles, and one
         # dict keyed by id would let each registration clobber the other
         self.meta_nodes: dict[str, str] = {}  # id -> addr
+        self.models: dict[str, dict] = {}  # castor fitted-model artifacts
         self.listeners: list = []
         # listener side effects DEFER here: apply() runs under the raft
         # lock and listener work (engine DDL = disk I/O) must not stall
@@ -147,6 +148,10 @@ class MetaFSM:
         elif op == "grant_admin":
             if cmd["user"] in self.users:
                 self.users[cmd["user"]]["admin"] = cmd.get("admin", True)
+        elif op == "save_model":
+            self.models[cmd["name"]] = cmd["doc"]
+        elif op == "drop_model":
+            self.models.pop(cmd["name"], None)
         # unknown ops are ignored deterministically (forward compatibility)
         self.applied_index = index
         if self.listeners:
@@ -162,6 +167,7 @@ class MetaFSM:
             "users": self.users, "applied_index": self.applied_index,
             "meta_removed": sorted(self.meta_removed),
             "meta_nodes": self.meta_nodes,
+            "models": self.models,
         }))
 
     def restore(self, state: dict) -> None:
@@ -178,6 +184,7 @@ class MetaFSM:
         self.applied_index = state.get("applied_index", 0)
         self.meta_removed = set(state.get("meta_removed", []))
         self.meta_nodes = state.get("meta_nodes", {})
+        self.models = state.get("models", {})
         self.pending.append(
             (self.applied_index, {"op": "__restore__", "state": state})
         )
@@ -476,6 +483,13 @@ class MetaStore:
                         for rp, pols in meta.get("downsample", {}).items()
                     }
                 engine.save_cq_state()  # persists meta.json (re-entrant lock)
+            # fitted models reconcile to the snapshot's set
+            want = state.get("models", {})
+            for name in engine.models.names():
+                if name not in want:
+                    engine.models.drop(name)
+            for name, doc in want.items():
+                engine.models.save(name, doc)
 
         def on_apply(index: int, cmd: dict) -> None:
             if index <= _read_marker():
@@ -524,6 +538,10 @@ class MetaStore:
                     )
             elif op == "drop_subscription":
                 engine.drop_subscription(cmd["db"], cmd["name"])
+            elif op == "save_model":
+                engine.models.save(cmd["name"], cmd["doc"])
+            elif op == "drop_model":
+                engine.models.drop(cmd["name"])
             elif op == "add_downsample":
                 if cmd["db"] in engine.databases:
                     from opengemini_tpu.storage.engine import DownsamplePolicy

@@ -136,6 +136,7 @@ _READONLY_STMTS = (
     ast.ShowStreams,
     ast.ShowSubscriptions,
     ast.ShowQueries,
+    ast.ShowModels,
 )
 
 

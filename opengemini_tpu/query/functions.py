@@ -271,7 +271,7 @@ def select_top_bottom_idx(name: str, times: np.ndarray, values: np.ndarray,
 
 
 def multi_row(name: str, times: np.ndarray, values: np.ndarray, params: tuple,
-              rng: np.random.Generator | None = None):
+              rng: np.random.Generator | None = None, models=None):
     """top/bottom/sample/distinct: list of (time_ns, value) output rows."""
     if len(values) == 0:
         return []
@@ -290,10 +290,19 @@ def multi_row(name: str, times: np.ndarray, values: np.ndarray, params: tuple,
         return [(None, py_value(v)) for v in uniq]
     if name == "detect":
         from opengemini_tpu.services.castor import detect as _detect
+        from opengemini_tpu.services.castor import detect_fitted as _fitted
 
-        algorithm = params[0] if params else "mad"
+        algorithm = str(params[0]) if params else "mad"
         threshold = float(params[1]) if len(params) > 1 else None
-        mask = _detect(np.asarray(values, dtype=np.float64), str(algorithm), threshold)
+        model = models.get(algorithm) if models is not None else None
+        if model is not None:
+            # a FITTED model by this name: score against its persisted
+            # training baseline (castor fit->detect pipeline); an explicit
+            # query threshold overrides the stored one
+            mask = _fitted(model, np.asarray(values, dtype=np.float64),
+                           threshold)
+        else:
+            mask = _detect(np.asarray(values, dtype=np.float64), algorithm, threshold)
         return [
             (int(times[i]), py_value(values[i])) for i in np.nonzero(mask)[0]
         ]

@@ -564,8 +564,16 @@ class HostPathMixin:
                 name, call_name, fname, params = multi_plan
                 t, v = field_rows(fname)
                 rows = []
+                models = None
+                if call_name == "detect" and params:
+                    # one disk read per query, not per window slice
+                    doc = self.engine.models.get(str(params[0]))
+                    if doc is not None:
+                        models = {str(params[0]): doc}
                 for wt, sl in window_slices(t):
-                    for rt, rv in fnmod.multi_row(call_name, t[sl], v[sl], params):
+                    for rt, rv in fnmod.multi_row(
+                            call_name, t[sl], v[sl], params,
+                            models=models):
                         rows.append([rt if rt is not None else wt, rv])
                 if not stmt.ascending:
                     rows.reverse()

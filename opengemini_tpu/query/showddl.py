@@ -455,6 +455,63 @@ class ShowDdlMixin:
                 rows = [[s.name, s.select_text] for s in d.streams.values()]
                 series.append(_series(name, None, ["name", "query"], rows))
             return {"series": series} if series else {}
+        if isinstance(stmt, ast.CreateModel):
+            # castor fit pipeline: train on the SELECT's output, persist
+            # the artifact; detect(field, '<name>') scores against it
+            # (reference: services/castor fit flow + model lifecycle)
+            from opengemini_tpu.services import castor as _castor
+
+            if stmt.name.lower() in _castor.ALGORITHMS:
+                raise QueryError(
+                    f"model name {stmt.name!r} shadows a built-in algorithm")
+            res = self._select(stmt.select, db, now_ns)
+            vals: list[float] = []
+            for series in res.get("series", []):
+                for row in series.get("values", []):
+                    for v in row[1:]:
+                        if isinstance(v, (int, float)) and not isinstance(
+                                v, bool):
+                            vals.append(float(v))
+            if stmt.name.lower() in _castor._UDFS:
+                raise QueryError(
+                    f"model name {stmt.name!r} shadows a loaded UDF")
+            try:
+                doc = _castor.fit(stmt.algorithm, np.asarray(vals),
+                                  stmt.threshold)
+            except ValueError as e:
+                raise QueryError(str(e)) from e
+            doc["name"] = stmt.name
+            doc["source"] = str(stmt.select)
+            # clustered: the fitted artifact replicates through raft like
+            # every other DDL (each replica persists it via the FSM
+            # listener); single-node saves directly
+            if not self._replicate_ddl(
+                    {"op": "save_model", "name": stmt.name, "doc": doc}):
+                self.engine.models.save(stmt.name, doc)
+            return {}
+        if isinstance(stmt, ast.ShowModels):
+            rows = []
+            for name in self.engine.models.names():
+                m = self.engine.models.get(name) or {}
+                rows.append([
+                    name, m.get("algorithm", ""), m.get("threshold"),
+                    m.get("trained_rows", 0),
+                    cond.format_rfc3339(
+                        int(m.get("fitted_at", 0)) * NS),
+                ])
+            if not rows:
+                return {}
+            return _series_result(
+                "models", None,
+                ["name", "algorithm", "threshold", "trainedRows", "fittedAt"],
+                rows)
+        if isinstance(stmt, ast.DropModel):
+            if stmt.name not in self.engine.models.names():
+                raise QueryError(f"model not found: {stmt.name}")
+            if not self._replicate_ddl({"op": "drop_model",
+                                        "name": stmt.name}):
+                self.engine.models.drop(stmt.name)
+            return {}
         if isinstance(stmt, ast.DropMeasurement):
             # mark + deferred purge (reference MarkMeasurementDelete):
             # SELECT hides it now; SHOW SERIES keeps the series until the

@@ -60,32 +60,54 @@ def load_udfs(directory: str) -> list[str]:
     return loaded
 
 
+def _baseline(algorithm: str, v: np.ndarray,
+              threshold: float | None) -> tuple[float, dict]:
+    """(threshold, fitted params) for a builtin algorithm — the ONE place
+    the formulas and default thresholds live (stateless detect, fit, and
+    fitted detect all share it)."""
+    if algorithm == "mad":
+        thr = 3.0 if threshold is None else float(threshold)
+        med = float(np.median(v))
+        return thr, {"median": med,
+                     "mad": float(np.median(np.abs(v - med)))}
+    if algorithm == "sigma":
+        thr = 3.0 if threshold is None else float(threshold)
+        return thr, {"mean": float(v.mean()), "std": float(v.std())}
+    if algorithm == "iqr":
+        thr = 1.5 if threshold is None else float(threshold)
+        q1, q3 = np.percentile(v, [25, 75])
+        return thr, {"q1": float(q1), "q3": float(q3)}
+    raise ValueError(f"unknown algorithm {algorithm!r}")
+
+
+def _score(algorithm: str, params: dict, thr: float,
+           v: np.ndarray) -> np.ndarray:
+    if algorithm == "mad":
+        med, mad = params["median"], params["mad"]
+        if mad == 0:
+            return v != med
+        return np.abs(v - med) / (1.4826 * mad) > thr
+    if algorithm == "sigma":
+        if params["std"] == 0:
+            return np.zeros(len(v), dtype=bool)
+        return np.abs(v - params["mean"]) / params["std"] > thr
+    if algorithm == "iqr":
+        iqr = params["q3"] - params["q1"]
+        return (v < params["q1"] - thr * iqr) | (v > params["q3"] + thr * iqr)
+    raise ValueError(f"unknown algorithm {algorithm!r}")
+
+
 def detect(values: np.ndarray, algorithm: str, threshold: float | None = None) -> np.ndarray:
-    """Boolean anomaly mask over a value series."""
+    """Boolean anomaly mask over a value series (stateless: the baseline
+    is fitted on the same window it scores)."""
     algorithm = algorithm.lower()
     n = len(values)
     if n == 0:
         return np.zeros(0, dtype=bool)
     v = values.astype(np.float64)
-    if algorithm == "mad":
-        thr = 3.0 if threshold is None else threshold
-        med = np.median(v)
-        mad = np.median(np.abs(v - med))
-        if mad == 0:
-            return v != med
-        score = np.abs(v - med) / (1.4826 * mad)
-        return score > thr
-    if algorithm == "sigma":
-        thr = 3.0 if threshold is None else threshold
-        std = v.std()
-        if std == 0:
-            return np.zeros(n, dtype=bool)
-        return np.abs(v - v.mean()) / std > thr
-    if algorithm == "iqr":
-        thr = 1.5 if threshold is None else threshold
-        q1, q3 = np.percentile(v, [25, 75])
-        iqr = q3 - q1
-        return (v < q1 - thr * iqr) | (v > q3 + thr * iqr)
+    if algorithm in ALGORITHMS:
+        thr, params = _baseline(algorithm, v, threshold)
+        return _score(algorithm, params, thr, v)
     udf = _UDFS.get(algorithm)
     if udf is not None:
         try:
@@ -103,3 +125,89 @@ def detect(values: np.ndarray, algorithm: str, threshold: float | None = None) -
     names = list(ALGORITHMS) + sorted(_UDFS)
     raise ValueError(f"unknown detect algorithm {algorithm!r} "
                      f"(supported: {', '.join(names)})")
+
+
+# -- fitted models (reference: the castor fit pipeline + model lifecycle,
+# services/castor/service.go:32-143, python/ts-udf/server) ------------------
+
+import json as _json
+import os as _os
+import threading as _threading
+import time as _time
+
+
+def fit(algorithm: str, values: np.ndarray, threshold: float | None = None) -> dict:
+    """Train a detector on a value series: learn the baseline statistics
+    the algorithm needs so later detect() calls score NEW data against
+    the TRAINING window (the point of fit vs stateless detection)."""
+    algorithm = algorithm.lower()
+    v = np.asarray(values, dtype=np.float64)
+    v = v[np.isfinite(v)]
+    if len(v) < 8:
+        raise ValueError(f"model fit needs >= 8 finite points, got {len(v)}")
+    if algorithm not in ALGORITHMS:
+        raise ValueError(
+            f"unknown fit algorithm {algorithm!r} "
+            f"(supported: {', '.join(ALGORITHMS)})")
+    thr, params = _baseline(algorithm, v, threshold)
+    return {
+        "algorithm": algorithm,
+        "threshold": thr,
+        "params": params,
+        "trained_rows": int(len(v)),
+        "fitted_at": int(_time.time()),
+    }
+
+
+def detect_fitted(model: dict, values: np.ndarray,
+                  threshold: float | None = None) -> np.ndarray:
+    """Score values against a fitted model's training baseline. An
+    explicit query-time threshold overrides the persisted one."""
+    v = np.asarray(values, dtype=np.float64)
+    thr = float(model["threshold"]) if threshold is None else float(threshold)
+    return _score(model["algorithm"], model["params"], thr, v)
+
+
+class ModelStore:
+    """Persisted fitted models: one JSON artifact per model under
+    <engine-root>/models/ (atomic replace on save, reloaded on open —
+    the reference keeps model files under the castor sidecar's model
+    dirs with version counters)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = _threading.Lock()
+        _os.makedirs(path, exist_ok=True)
+
+    def _file(self, name: str) -> str:
+        if not name or "/" in name or name.startswith("."):
+            raise ValueError(f"bad model name {name!r}")
+        return _os.path.join(self.path, name + ".json")
+
+    def save(self, name: str, doc: dict) -> None:
+        with self._lock:
+            tmp = self._file(name) + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                _json.dump(doc, f)
+                f.flush()
+                _os.fsync(f.fileno())
+            _os.replace(tmp, self._file(name))
+
+    def get(self, name: str) -> dict | None:
+        try:
+            with open(self._file(name), encoding="utf-8") as f:
+                return _json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def names(self) -> list[str]:
+        return sorted(
+            f[:-5] for f in _os.listdir(self.path) if f.endswith(".json"))
+
+    def drop(self, name: str) -> bool:
+        with self._lock:
+            try:
+                _os.remove(self._file(name))
+                return True
+            except OSError:
+                return False

@@ -295,6 +295,27 @@ class DropMeasurement:
 
 
 @dataclass
+class CreateModel:
+    """CREATE MODEL name WITH ALGORITHM 'mad' [THRESHOLD x] FROM (SELECT ...)
+    — the castor fit pipeline (reference services/castor fit flow)."""
+
+    name: str = ""
+    algorithm: str = ""
+    threshold: object = None
+    select: object = None
+
+
+@dataclass
+class ShowModels:
+    pass
+
+
+@dataclass
+class DropModel:
+    name: str = ""
+
+
+@dataclass
 class CreateContinuousQuery:
     name: str = ""
     database: str = ""

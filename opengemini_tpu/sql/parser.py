@@ -144,6 +144,20 @@ class Parser:
             return True
         return False
 
+    def _accept_word(self, word: str) -> bool:
+        """Contextual (non-reserved) keyword: matches an IDENT or KEYWORD
+        token case-insensitively. MODEL/ALGORITHM/THRESHOLD stay usable as
+        field/tag names this way."""
+        tok = self.lex.peek()
+        if tok.kind in ("IDENT", "KEYWORD") and tok.val.lower() == word:
+            self.lex.next()
+            return True
+        return False
+
+    def _expect_word(self, word: str) -> None:
+        if not self._accept_word(word):
+            raise ParseError(f"expected {word.upper()}")
+
     def _ident(self, allow_string: bool = False) -> str:
         tok = self.lex.next()
         if tok.kind == "IDENT":
@@ -621,6 +635,8 @@ class Parser:
 
     def parse_show(self):
         self._expect_kw("show")
+        if self._accept_word("models"):
+            return ast.ShowModels()
         kw = self.lex.next()
         if kw.kind != "KEYWORD":
             raise ParseError(f"bad SHOW: {kw.val!r}")
@@ -759,10 +775,33 @@ class Parser:
 
     def parse_create(self):
         self._expect_kw("create")
-        kw = self._expect_kw(
-            "database", "retention", "continuous", "user", "stream",
-            "subscription", "downsample", "measurement",
-        )
+        if self._accept_word("model"):
+            kw = "model"
+        else:
+            kw = self._expect_kw(
+                "database", "retention", "continuous", "user", "stream",
+                "subscription", "downsample", "measurement",
+            )
+        if kw == "model":
+            # CREATE MODEL name WITH ALGORITHM 'alg' [THRESHOLD x]
+            #   FROM (SELECT field FROM ...): fit + persist (castor)
+            stmt = ast.CreateModel(name=self._ident())
+            self._expect_kw("with")
+            self._expect_word("algorithm")
+            tok = self.lex.next()
+            if tok.kind != "STRING":
+                raise ParseError("ALGORITHM expects a quoted name")
+            stmt.algorithm = tok.val
+            if self._accept_word("threshold"):
+                ntok = self.lex.next()
+                if ntok.kind not in ("NUMBER", "INTEGER"):
+                    raise ParseError("THRESHOLD expects a number")
+                stmt.threshold = float(ntok.val)
+            self._expect_kw("from")
+            self._expect_op("(")
+            stmt.select = self.parse_select()
+            self._expect_op(")")
+            return stmt
         if kw == "measurement":
             # CREATE MEASUREMENT name [WITH ...]: schema pre-declaration.
             # Our engine is schema-on-write, so the statement validates and
@@ -940,6 +979,8 @@ class Parser:
 
     def parse_drop(self):
         self._expect_kw("drop")
+        if self._accept_word("model"):
+            return ast.DropModel(self._ident())
         kw = self._expect_kw(
             "database", "retention", "measurement", "continuous", "user", "series",
             "stream", "subscription", "downsample", "downsamples",

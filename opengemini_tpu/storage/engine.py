@@ -176,9 +176,20 @@ class Engine:
         # (db, rp, group_start) -> Shard
         self._shards: dict[tuple[str, str, int], Shard] = {}
         self._load_meta()
+        self._models = None  # lazy ModelStore (castor)
         self._load_shards()
 
     # -- metadata -----------------------------------------------------------
+
+    @property
+    def models(self):
+        """Fitted anomaly-detection models (castor fit pipeline),
+        persisted under <root>/models/."""
+        if self._models is None:
+            from opengemini_tpu.services.castor import ModelStore
+
+            self._models = ModelStore(os.path.join(self.root, "models"))
+        return self._models
 
     def _meta_path(self) -> str:
         return os.path.join(self.root, "meta.json")
