@@ -208,6 +208,18 @@ struct TagRef {
   std::string_view k, v;
 };
 
+// tokens the native numeric parsers cannot judge but Python's float()/int()
+// might accept: longer than the stack buffers, or carrying non-ASCII
+// digits (e.g. full-width Unicode numerals). These must divert to the
+// exact Python parser, never 400 (the fast-path contract: anything not
+// bit-equivalent flips to NEEDS_PYTHON).
+static bool numeric_needs_python(const char* p, size_t n, size_t buf_cap) {
+  if (n >= buf_cap) return true;
+  for (size_t i = 0; i < n; i++)
+    if ((unsigned char)p[i] >= 0x80) return true;
+  return false;
+}
+
 bool parse_float_token(const char* p, size_t n, double* out) {
   // fast path: [-]digits up to 15 digits — exact in double (< 2^53), so
   // identical to Python's correctly-rounded float(). Decimals go through
@@ -489,10 +501,11 @@ extern "C" LpBatch* ogt_lp_parse(const char* data, int64_t len, int64_t mult,
         slot = (off << 32) | (int64_t)(vn - 2);
       } else if (v[vn - 1] == 'i' || v[vn - 1] == 'u') {
         char buf[32];
-        if (vn - 1 == 0 || vn - 1 >= sizeof(buf)) {
+        if (vn - 1 == 0) {
           P.fail(lineno, "bad integer value");
           return finish(P);
         }
+        if (numeric_needs_python(v, vn - 1, sizeof(buf))) goto finish_py;
         memcpy(buf, v, vn - 1);
         buf[vn - 1] = 0;
         errno = 0;
@@ -518,6 +531,7 @@ extern "C" LpBatch* ogt_lp_parse(const char* data, int64_t len, int64_t mult,
         } else {
           double d;
           if (!parse_float_token(v, vn, &d)) {
+            if (numeric_needs_python(v, vn, 64)) goto finish_py;
             P.fail(lineno, "bad value");
             return finish(P);
           }
@@ -527,6 +541,7 @@ extern "C" LpBatch* ogt_lp_parse(const char* data, int64_t len, int64_t mult,
       } else {
         double d;
         if (!parse_float_token(v, vn, &d)) {
+          if (numeric_needs_python(v, vn, 64)) goto finish_py;
           P.fail(lineno, "bad value");
           return finish(P);
         }
@@ -561,10 +576,8 @@ extern "C" LpBatch* ogt_lp_parse(const char* data, int64_t len, int64_t mult,
       // Python's int() accepts '_' separators; strtoll does not
       if (memchr(ts_part.p, '_', ts_part.n)) goto finish_py;
       char buf[32];
-      if (ts_part.n >= sizeof(buf)) {
-        P.fail(lineno, "bad timestamp");
-        return finish(P);
-      }
+      if (numeric_needs_python(ts_part.p, ts_part.n, sizeof(buf)))
+        goto finish_py;
       memcpy(buf, ts_part.p, ts_part.n);
       buf[ts_part.n] = 0;
       errno = 0;

@@ -3,9 +3,11 @@ scans.
 
 Reference: coordinator/points_writer.go (MapShards + WritePointRows shard
 routing) and the coordinator select exchange (remote readers feeding the
-executor). The TPU-first twist: peers only ever SERVE raw columns over
-/internal/scan — every aggregation runs on the coordinating node's
-device. The chip is the compute plane; other nodes are storage.
+executor). The TPU-first data plane has two tiers: mergeable aggregates
+push down — each peer computes dense per-(group, window) partials on its
+own slice (query/partials.py) and ships O(groups x windows) arrays — and
+everything else falls back to peers SERVING raw columns over
+/internal/scan with aggregation on the coordinating node's device.
 
 Placement is rendezvous (HRW) hashing over the registered data nodes:
 stable under node add/remove (only ~1/N of groups move), no ring state

@@ -1255,6 +1255,11 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                     "field_expr": astjson.to_json(sc.field_expr),
                     "mixed_expr": astjson.to_json(sc.mixed_expr),
                     "mixed_series_level": sc.mixed_series_level,
+                    # the COORDINATOR's tag-key view: peers must evaluate
+                    # mixed trees against the same classification — a tag
+                    # absent from a peer's local index must still inject
+                    # as an empty-string column (r3 ADVICE #2)
+                    "tag_keys": sorted(sc.tag_keys),
                 }
                 peer_docs = self.router.select_partials(req, ctx.live)
                 if peer_docs:

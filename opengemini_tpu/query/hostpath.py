@@ -516,6 +516,13 @@ class HostPathMixin:
             else:
                 plans.append((name, kind, call_name, field, params, inner))
 
+        fitted_models = None
+        if multi_plan is not None and multi_plan[1] == "detect" \
+                and multi_plan[3]:
+            # one artifact read per QUERY (not per group or window slice)
+            doc = self.engine.models.get(str(multi_plan[3][0]))
+            if doc is not None:
+                fitted_models = {str(multi_plan[3][0]): doc}
         out_series = []
         for key in sorted(groups):
             rows_by_field: dict[str, tuple[np.ndarray, np.ndarray]] = {}
@@ -564,16 +571,10 @@ class HostPathMixin:
                 name, call_name, fname, params = multi_plan
                 t, v = field_rows(fname)
                 rows = []
-                models = None
-                if call_name == "detect" and params:
-                    # one disk read per query, not per window slice
-                    doc = self.engine.models.get(str(params[0]))
-                    if doc is not None:
-                        models = {str(params[0]): doc}
                 for wt, sl in window_slices(t):
                     for rt, rv in fnmod.multi_row(
                             call_name, t[sl], v[sl], params,
-                            models=models):
+                            models=fitted_models):
                         rows.append([rt if rt is not None else wt, rv])
                 if not stmt.ascending:
                     rows.reverse()

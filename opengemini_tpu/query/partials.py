@@ -7,8 +7,12 @@ agg_transform.go). The reference streams chunk partials through RPC
 transforms; here each peer runs the SAME device batch machinery the
 coordinator uses (models/templates.AggBatch & friends) over its local
 shards against the coordinator's window grid, and ships one dense
-per-(group, window) partial array set — O(groups x windows), never
-O(rows) — which the coordinator merges with numpy before rendering.
+per-(group, window) partial array set — O(groups x windows) for the
+MERGEABLE aggregates — which the coordinator merges with numpy before
+rendering. Rank aggregates ship per-segment (value, count) multisets
+instead: O(groups x distinct values), which degenerates toward O(rows)
+on continuous float fields — a density cutoff (below) refuses such
+wires and the coordinator falls back to the raw column exchange.
 
 Mergeability table (what travels per requested aggregate):
   count          -> count
@@ -117,8 +121,14 @@ def compute_partials(engine, router, req: dict) -> bytes:
     for sh in shards:
         schema.update(sh.schema(mst))
         tag_keys.update(sh.index.tag_keys(mst))
-    # peer-local SplitCondition view: classification (what is mixed) was
-    # decided by the coordinator; this only drives row evaluation here
+    if req.get("tag_keys") is not None:
+        # the coordinator's classification governs: a tag key it knows but
+        # no peer-local shard indexes must still inject as an empty-string
+        # column in row evaluation (tag != 'x' over a missing tag is TRUE,
+        # not column-missing-false)
+        tag_keys = set(req["tag_keys"])
+    # peer-side SplitCondition over the coordinator's view; this only
+    # drives row evaluation here
     sc = cond.SplitCondition(tmin, tmax, tag_expr, field_expr, mixed_expr,
                              frozenset(tag_keys))
     sc.mixed_series_level = bool(req.get("mixed_series_level"))
@@ -212,6 +222,14 @@ def compute_partials(engine, router, req: dict) -> bytes:
                 )
             elif p == "mset":
                 mv, mc, mo = batch.host_value_multiset(n_seg)
+                if len(mv) > 10_000 and len(mv) > 0.5 * max(batch.n, 1):
+                    # continuous float fields: distinct ~ rows, the
+                    # multiset wire would exceed a raw value column —
+                    # refuse (the 400 becomes PartialsUnavailable on the
+                    # coordinator, which falls back to the raw exchange)
+                    raise ValueError(
+                        "rank-aggregate multiset too dense "
+                        f"({len(mv)} distinct / {batch.n} rows)")
                 arrs["mvals"] = mv
                 arrs["mcnts"] = mc
                 arrs["moffs"] = mo

@@ -464,6 +464,15 @@ class ShowDdlMixin:
             if stmt.name.lower() in _castor.ALGORITHMS:
                 raise QueryError(
                     f"model name {stmt.name!r} shadows a built-in algorithm")
+            if stmt.name.lower() in _castor._UDFS:
+                raise QueryError(
+                    f"model name {stmt.name!r} shadows a loaded UDF")
+            if (not stmt.name or "/" in stmt.name
+                    or stmt.name.startswith(".")):
+                # ModelStore's artifact-name rules, enforced BEFORE the
+                # raft proposal: a bad name must never commit to the FSM
+                # (every replica's listener would fail forever)
+                raise QueryError(f"bad model name {stmt.name!r}")
             res = self._select(stmt.select, db, now_ns)
             vals: list[float] = []
             for series in res.get("series", []):
@@ -472,16 +481,13 @@ class ShowDdlMixin:
                         if isinstance(v, (int, float)) and not isinstance(
                                 v, bool):
                             vals.append(float(v))
-            if stmt.name.lower() in _castor._UDFS:
-                raise QueryError(
-                    f"model name {stmt.name!r} shadows a loaded UDF")
             try:
                 doc = _castor.fit(stmt.algorithm, np.asarray(vals),
                                   stmt.threshold)
             except ValueError as e:
                 raise QueryError(str(e)) from e
             doc["name"] = stmt.name
-            doc["source"] = str(stmt.select)
+            doc["source"] = stmt.select_text
             # clustered: the fitted artifact replicates through raft like
             # every other DDL (each replica persists it via the FSM
             # listener); single-node saves directly

@@ -3,8 +3,10 @@
 Reference: services/continuousquery/service.go:53-130 — on each tick, run
 every CQ whose next window has closed, executing its SELECT ... INTO over
 the newly-closed GROUP BY time windows. The reference coordinates CQ
-leases across sql nodes via meta; single-process mode has no contention,
-the lease hook lands with the cluster round.
+leases across sql nodes via meta; here the raft META LEADER is the lease
+(handle() runs CQs only on the leader when clustered — see the
+meta_store gate below; tested in
+test_cluster_data.py::test_cq_runs_only_on_leader).
 """
 
 from __future__ import annotations

@@ -303,6 +303,7 @@ class CreateModel:
     algorithm: str = ""
     threshold: object = None
     select: object = None
+    select_text: str = ""  # raw training-query text (provenance)
 
 
 @dataclass

@@ -799,7 +799,10 @@ class Parser:
                 stmt.threshold = float(ntok.val)
             self._expect_kw("from")
             self._expect_op("(")
+            start_pos = self.lex.peek().pos
             stmt.select = self.parse_select()
+            end_tok = self.lex.peek()
+            stmt.select_text = self.lex.text[start_pos:end_tok.pos].strip()
             self._expect_op(")")
             return stmt
         if kw == "measurement":

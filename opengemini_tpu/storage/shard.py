@@ -354,7 +354,13 @@ class Shard:
     def flush(self) -> None:
         """Memtable -> new TSF file, then truncate WAL. Crash-safe ordering:
         the file is fsynced and atomically renamed before the WAL truncate
-        (reference commitSnapshot, engine/shard.go:1008)."""
+        (reference commitSnapshot, engine/shard.go:1008).
+
+        Measurement chunks emit in sorted-name order (since r3): TSF file
+        layout can differ from files written by older versions for
+        multi-measurement shards. Replica comparison is CONTENT-based
+        (content_digest hashes logical rows, not file bytes), so
+        mixed-version replicas still agree."""
         with self._lock:
             if len(self.mem) == 0:
                 return
