@@ -46,7 +46,8 @@ TRANSFORMS = {
 # host aggregators: one value per (group, window)
 HOST_AGGS = {"mode", "integral", "sum", "count", "mean", "min", "max",
              "first", "last", "spread", "stddev", "median", "percentile",
-             "count_distinct", "rate", "irate", "absent", "regr_slope"}
+             "percentile_ogsketch", "count_distinct", "rate", "irate",
+             "absent", "regr_slope"}
 
 # multi-row selectors: several output rows per group
 MULTI_ROW = {"top", "bottom", "sample", "distinct", "detect"}
@@ -139,6 +140,17 @@ def host_agg(name: str, times: np.ndarray, values: np.ndarray, params: tuple):
         q = params[0]
         rank = max(int(np.ceil(q / 100.0 * len(values))) - 1, 0)
         return np.sort(values)[rank].item(), None
+    if name == "percentile_ogsketch":
+        # centroid-sketch quantile (reference percentile_ogsketch,
+        # call_processor.go:41): O(compression) memory per window however
+        # many rows feed it, mergeable across nodes (query/sketch.py)
+        from opengemini_tpu.query.sketch import OGSketch
+
+        q = params[0]
+        sk = OGSketch()
+        sk.insert(np.asarray(values, np.float64))
+        out = sk.quantile(q / 100.0)
+        return (None if math.isnan(out) else float(out)), None
     if name == "count_distinct":
         return int(len(np.unique(values))), None
     if name == "mode":

@@ -350,7 +350,8 @@ def _calls_in(e) -> list[ast.Call]:
 _NUMERIC_ONLY_WILDCARD = {
     "difference", "non_negative_difference", "derivative",
     "non_negative_derivative", "moving_average", "cumulative_sum", "sum",
-    "mean", "median", "stddev", "spread", "percentile", "integral",
+    "mean", "median", "stddev", "spread", "percentile",
+    "percentile_ogsketch", "integral",
     "max", "min", "top", "bottom", "sample",
     "rate", "irate", "regr_slope",
 }
@@ -713,6 +714,7 @@ def _resolve_host_call(call: ast.Call, group_time):
 # (min required params, max allowed params) per host call with parameters
 _HOST_ARITY = {
     "percentile": (1, 1),
+    "percentile_ogsketch": (1, 1),
     "moving_average": (1, 1),
     "top": (1, 1),
     "bottom": (1, 1),
@@ -729,6 +731,10 @@ _HOST_ARITY = {
 
 
 def _check_host_arity(name: str, params: tuple) -> None:
+    if name in ("percentile", "percentile_ogsketch") and params:
+        q = params[0]
+        if not (isinstance(q, (int, float)) and 0 <= q <= 100):
+            raise QueryError(f"{name}() N must be between 0 and 100")
     lo, hi = _HOST_ARITY.get(name, (0, 1))
     if not (lo <= len(params) <= hi):
         raise QueryError(f"{name}() takes {lo + 1} to {hi + 1} arguments")

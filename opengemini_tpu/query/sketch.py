@@ -13,6 +13,8 @@ spans most of the value range.
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 
 GLOBAL_BINS = 256
@@ -82,3 +84,231 @@ class HistSketch:
         frac = (rank - prev) / in_bin if in_bin > 0 else 0.5
         w = self._width()
         return float(self.lo + g * w + frac * w)
+
+
+# -- OGSketch: centroid (t-digest-family) quantile sketch --------------------
+
+
+class OGSketch:
+    """Centroid quantile sketch — the role of the reference's OGSketch
+    (engine/executor/ogsketch.go: bounded ClusterSet of (mean, weight)
+    centroids, quantiles interpolated over half-weight accumulative sums).
+
+    TPU-first shape: centroids live as parallel numpy arrays (means,
+    weights) and inserts are BATCH merges — buffer values, then one
+    sort + vectorized cumulative-weight compression pass, never a
+    per-point tree walk. Mergeable across nodes (concatenate centroid
+    sets, recompress): a peer ships O(compression) floats per segment
+    regardless of row count, which is what makes huge-cardinality
+    quantiles cheap in a cluster."""
+
+    def __init__(self, compression: int = 100):
+        self.compression = max(int(compression), 4)
+        self.means = np.empty(0, np.float64)
+        self.weights = np.empty(0, np.float64)
+        self._buf: list[np.ndarray] = []
+        self._buf_n = 0
+        self.min = math.inf
+        self.max = -math.inf
+
+    # -- build ----------------------------------------------------------
+
+    def insert(self, values) -> None:
+        v = np.asarray(values, np.float64).ravel()
+        v = v[np.isfinite(v)]
+        if not len(v):
+            return
+        self.min = min(self.min, float(v.min()))
+        self.max = max(self.max, float(v.max()))
+        self._buf.append(v)
+        self._buf_n += len(v)
+        if self._buf_n >= 8 * self.compression:
+            self._compress()
+
+    def merge(self, other: "OGSketch") -> None:
+        """Fold another sketch in as WEIGHTED centroids (lossless relative
+        to both sketches' own precision) and recompress."""
+        other._compress()
+        self._compress()
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        if len(other.means):
+            self.means, self.weights = _tdigest_compress(
+                np.concatenate([self.means, other.means]),
+                np.concatenate([self.weights, other.weights]),
+                self.compression,
+            )
+
+    def _compress(self) -> None:
+        if not self._buf:
+            return
+        bufv = np.concatenate(self._buf)
+        self._buf, self._buf_n = [], 0
+        m = np.concatenate([self.means, bufv])
+        w = np.concatenate([self.weights,
+                            np.ones(len(bufv), np.float64)])
+        self.means, self.weights = _tdigest_compress(m, w, self.compression)
+
+    # -- query ----------------------------------------------------------
+
+    @property
+    def n(self) -> float:
+        self._compress()
+        return float(self.weights.sum())
+
+    def quantile(self, q: float) -> float:
+        """Value at quantile q in [0, 1]: interpolation over half-weight
+        accumulative sums (the reference's updateAccumulativeSum +
+        Quantile walk, vectorized via searchsorted)."""
+        self._compress()
+        if not len(self.means):
+            return math.nan
+        q = min(max(q, 0.0), 1.0)
+        w = self.weights
+        total = w.sum()
+        # centroid "positions": cumulative weight at centroid midpoints
+        cum = np.cumsum(w) - w / 2
+        target = q * total
+        if target <= cum[0]:
+            return float(self.min if total > 1 else self.means[0])
+        if target >= cum[-1]:
+            return float(self.max if total > 1 else self.means[-1])
+        i = int(np.searchsorted(cum, target))
+        lo, hi = cum[i - 1], cum[i]
+        frac = (target - lo) / max(hi - lo, 1e-12)
+        return float(self.means[i - 1]
+                     + (self.means[i] - self.means[i - 1]) * frac)
+
+    # -- wire ------------------------------------------------------------
+
+    def serialize(self) -> bytes:
+        self._compress()
+        head = np.asarray(
+            [self.compression, len(self.means), self.min, self.max],
+            np.float64)
+        return b"".join(a.tobytes() for a in (head, self.means, self.weights))
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "OGSketch":
+        if len(raw) < 32:
+            raise ValueError("truncated OGSketch payload")
+        head = np.frombuffer(raw[:32], np.float64)
+        comp, k = int(head[0]), int(head[1])
+        if len(raw) != 32 + 16 * k:
+            raise ValueError(
+                f"OGSketch payload length {len(raw)} != {32 + 16 * k}")
+        s = cls(comp)
+        s.min, s.max = float(head[2]), float(head[3])
+        s.means = np.frombuffer(raw[32:32 + 8 * k], np.float64).copy()
+        s.weights = np.frombuffer(raw[32 + 8 * k:32 + 16 * k],
+                                  np.float64).copy()
+        return s
+
+
+def _tdigest_compress(means: np.ndarray, weights: np.ndarray,
+                      compression: int):
+    """Merge (mean, weight) centroids down to <= ~compression clusters
+    with the k1 (arcsine) scale function: tight clusters at the tails,
+    coarse in the middle — the error profile quantile sketches need.
+    Fully vectorized: one sort, one k-scale bucket assignment over the
+    cumulative weights, one reduceat per output array (a per-element
+    greedy loop was ~100x slower than np.quantile at 1M rows)."""
+    order = np.argsort(means, kind="stable")
+    m, w = means[order], weights[order]
+    total = w.sum()
+    if total <= 0:
+        return np.empty(0, np.float64), np.empty(0, np.float64)
+    q_left = (np.cumsum(w) - w) / total
+    k = np.floor(compression * (
+        np.arcsin(np.clip(2 * q_left - 1, -1.0, 1.0)) / np.pi + 0.5))
+    starts = np.flatnonzero(np.concatenate([[True], k[1:] != k[:-1]]))
+    out_w = np.add.reduceat(w, starts)
+    out_m = np.add.reduceat(m * w, starts) / out_w
+    return out_m, out_w
+
+
+# -- count-min sketch --------------------------------------------------------
+
+
+class CountMinSketch:
+    """Approximate frequency counts in sublinear space (reference:
+    engine/executor/count_min_sketch.go): a (depth x width) counter
+    matrix, point estimate = min over rows. Adds are VECTORIZED — a whole
+    batch of items hashes in one numpy pass per row (no per-item loop),
+    matching how the engine feeds columnar batches."""
+
+    def __init__(self, width: int = 2048, depth: int = 4, seed: int = 7):
+        self.width = int(width)
+        self.depth = int(depth)
+        self.seed = int(seed)
+        self.counts = np.zeros((depth, self.width), np.int64)
+        rng = np.random.default_rng(seed)
+        self._row_seed = rng.integers(0, 2**63, size=depth,
+                                      dtype=np.int64).astype(np.uint64)
+
+    def _rows(self, keys: np.ndarray) -> np.ndarray:
+        """(depth, n) column indices: splitmix64 finalizer with a per-row
+        seed xor. Plain multiply-shift fails here — float64 bit patterns
+        of small integers have 52 trailing zero bits, leaving the
+        product's top bits with almost no entropy (measured: every small
+        key collided with the heavy hitter)."""
+        k = keys.astype(np.uint64)[None, :] ^ self._row_seed[:, None]
+        with np.errstate(over="ignore"):
+            k ^= k >> np.uint64(30)
+            k *= np.uint64(0xBF58476D1CE4E5B9)
+            k ^= k >> np.uint64(27)
+            k *= np.uint64(0x94D049BB133111EB)
+            k ^= k >> np.uint64(31)
+        return (k % np.uint64(self.width)).astype(np.int64)
+
+    @staticmethod
+    def _keys_of(items) -> np.ndarray:
+        arr = np.asarray(items)
+        if arr.dtype.kind in "iuf":
+            # ONE numeric representation: 7 and 7.0 must collide, or a
+            # float producer + int consumer underestimates (the one thing
+            # count-min must never do). float64 is exact for ints < 2^53.
+            return arr.astype(np.float64).view(np.int64)
+        # strings/objects: stable 64-bit digests
+        import hashlib
+
+        return np.asarray([
+            int.from_bytes(
+                hashlib.blake2b(str(x).encode(), digest_size=8).digest(),
+                "little", signed=True)
+            for x in arr
+        ], np.int64)
+
+    def add(self, items, counts=1) -> None:
+        keys = self._keys_of(items)
+        if not len(keys):
+            return
+        c = np.broadcast_to(np.asarray(counts, np.int64), keys.shape)
+        idx = self._rows(keys)
+        for d in range(self.depth):
+            np.add.at(self.counts[d], idx[d], c)
+
+    def count(self, item) -> int:
+        keys = self._keys_of([item])
+        idx = self._rows(keys)
+        return int(min(self.counts[d, idx[d, 0]] for d in range(self.depth)))
+
+    def merge(self, other: "CountMinSketch") -> None:
+        if (other.width != self.width or other.depth != self.depth
+                or other.seed != self.seed):
+            raise ValueError("count-min parameters differ")
+        self.counts += other.counts
+
+    def serialize(self) -> bytes:
+        head = np.asarray([self.width, self.depth, self.seed], np.int64)
+        return head.tobytes() + self.counts.tobytes()
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "CountMinSketch":
+        width, depth, seed = np.frombuffer(raw[:24], np.int64)
+        s = cls(int(width), int(depth), int(seed))
+        body = np.frombuffer(raw[24:], np.int64)
+        if len(body) != s.depth * s.width:
+            raise ValueError("truncated count-min payload")
+        s.counts = body.reshape(s.depth, s.width).copy()
+        return s

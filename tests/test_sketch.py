@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.query import sketch
 from opengemini_tpu.query.sketch import HistSketch
 from opengemini_tpu.storage.engine import Engine, NS
 
@@ -132,3 +133,105 @@ class TestReviewRegressions:
         e.write_lines("db", f"m v=1 {BASE*NS}")
         res = q(ex, "SELECT percentile_approx(v, 50) FROM m OFFSET 1")
         assert "series" not in res["results"][0]
+
+
+class TestOGSketch:
+    """Centroid quantile sketch (reference engine/executor/ogsketch.go)."""
+
+    def test_quantile_accuracy_bounds(self):
+        rng = np.random.default_rng(3)
+        for dist in (rng.lognormal(0, 1, 100_000),
+                     rng.normal(50, 5, 100_000),
+                     rng.integers(0, 100, 100_000).astype(float)):
+            s = sketch.OGSketch(100)
+            for lo in range(0, len(dist), 7_000):
+                s.insert(dist[lo:lo + 7_000])
+            for q in (0.01, 0.1, 0.5, 0.9, 0.99):
+                approx = s.quantile(q)
+                exact = float(np.quantile(dist, q))
+                spread = float(dist.max() - dist.min())
+                assert abs(approx - exact) <= 0.01 * spread + 1e-9, (q, approx, exact)
+            assert len(s.means) < 3 * s.compression
+
+    def test_merge_equals_combined_build(self):
+        rng = np.random.default_rng(4)
+        data = rng.exponential(2.0, 60_000)
+        whole = sketch.OGSketch(100)
+        whole.insert(data)
+        parts = [sketch.OGSketch(100) for _ in range(4)]
+        for i, p in enumerate(parts):
+            p.insert(data[i::4])
+        merged = parts[0]
+        for p in parts[1:]:
+            merged.merge(p)
+        for q in (0.1, 0.5, 0.95):
+            assert abs(merged.quantile(q) - whole.quantile(q)) <= \
+                0.01 * (data.max() - data.min())
+
+    def test_serialize_roundtrip_and_extremes(self):
+        s = sketch.OGSketch(50)
+        s.insert([5.0, 1.0, 9.0, 3.0])
+        t = sketch.OGSketch.deserialize(s.serialize())
+        assert t.quantile(0.0) == 1.0 and t.quantile(1.0) == 9.0
+        assert abs(t.quantile(0.5) - s.quantile(0.5)) < 1e-12
+        empty = sketch.OGSketch(50)
+        assert np.isnan(empty.quantile(0.5))
+
+    def test_sql_percentile_ogsketch(self, tmp_path):
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        NS = 10**9
+        B = 1_700_000_040
+        e = Engine(str(tmp_path), sync_wal=False)
+        e.create_database("d")
+        rng = np.random.default_rng(5)
+        vals = rng.normal(100, 10, 3000)
+        e.write_lines("d", "\n".join(
+            f"m v={v} {(B + i) * NS}" for i, v in enumerate(vals)))
+        ex = Executor(e)
+        r = ex.execute("SELECT percentile_ogsketch(v, 50) FROM m", db="d")
+        got = r["results"][0]["series"][0]["values"][0][1]
+        assert abs(got - float(np.quantile(vals, 0.5))) < 1.0
+        # windowed form
+        r2 = ex.execute(
+            f"SELECT percentile_ogsketch(v, 90) FROM m WHERE time >= {B*NS} "
+            f"AND time < {(B+3000)*NS} GROUP BY time(10m)", db="d")
+        # B is 1m- but not 10m-aligned: 50min of data spans 6 buckets
+        assert len(r2["results"][0]["series"][0]["values"]) == 6
+        e.close()
+
+
+class TestCountMinSketch:
+    """Frequency sketch (reference engine/executor/count_min_sketch.go)."""
+
+    def test_never_underestimates_and_bounded_over(self):
+        rng = np.random.default_rng(6)
+        items = rng.zipf(1.3, 200_000) % 10_000
+        cm = sketch.CountMinSketch(width=4096, depth=4)
+        cm.add(items)
+        true = np.bincount(items, minlength=10_000)
+        over = []
+        for i in range(0, 10_000, 131):
+            est = cm.count(i)
+            assert est >= true[i], (i, est, true[i])
+            over.append(est - true[i])
+        # CM guarantee: overestimate ~ eN/width with prob 1-δ
+        assert np.mean(over) < 2 * len(items) / 4096
+
+    def test_merge_and_wire(self):
+        a = sketch.CountMinSketch(width=512, depth=3)
+        b = sketch.CountMinSketch(width=512, depth=3)
+        a.add(["x", "y", "x"])
+        b.add(["x", "z"])
+        a.merge(b)
+        assert a.count("x") >= 3 and a.count("z") >= 1
+        c = sketch.CountMinSketch.deserialize(a.serialize())
+        assert c.count("x") == a.count("x")
+
+    def test_mixed_key_types(self):
+        cm = sketch.CountMinSketch()
+        cm.add(np.asarray([1.5, 1.5, 2.5]))
+        assert cm.count(1.5) >= 2
+        cm.add(np.asarray([7, 7, 7], dtype=np.int64))
+        assert cm.count(7) >= 3
