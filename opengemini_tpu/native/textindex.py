@@ -99,15 +99,53 @@ class TextIndex:
 
 
 def tokenize(text: str) -> list[str]:
-    """ASCII alnum runs >= 2 chars, lowercased (matches the C++ side)."""
-    return [t.lower() for t in _TOKEN_RE.findall(text)]
+    """ASCII alnum runs >= 2 chars lowercased, plus one gram per
+    non-ASCII character (reference SimpleGramTokenizer's split-table
+    walk, FullTextIndex.cpp:19-40 — CJK indexes per character). Matches
+    the C++ tokenizer byte-for-byte over utf-8 input."""
+    out: list[str] = []
+    cur: list[str] = []
+    for ch in text:
+        if ch.isascii():
+            if ch.isalnum():
+                cur.append(ch.lower())
+                continue
+            if len(cur) >= 2:
+                out.append("".join(cur))
+            cur = []
+        else:
+            if len(cur) >= 2:
+                out.append("".join(cur))
+            cur = []
+            out.append(ch)
+    if len(cur) >= 2:
+        out.append("".join(cur))
+    return out
+
+
+def query_grams(term: str) -> list[str]:
+    """Index lookup tokens for one match() search term: its own
+    tokenization (a multi-character CJK term becomes several grams that
+    the caller intersects)."""
+    return tokenize(term)
 
 
 def match_token(values: np.ndarray, valid: np.ndarray, token: str) -> np.ndarray:
-    """Row mask: string values containing the token (WHERE match(f, 't'))."""
-    token = token.lower()
+    """Row mask for WHERE match(f, 'term').
+
+    ASCII terms match whole tokens case-insensitively. Terms with
+    non-ASCII characters match as EXACT (byte) substrings — the index
+    never case-folds non-ASCII (neither does the reference's
+    SimpleGramTokenizer), so the row filter must agree or pruning would
+    silently drop rows the filter accepts."""
+    has_cjk = not token.isascii()
+    term = token if has_cjk else token.lower()
     out = np.zeros(len(values), dtype=np.bool_)
     for i, v in enumerate(values):
-        if valid[i] and isinstance(v, str) and token in tokenize(v):
-            out[i] = True
+        if not (valid[i] and isinstance(v, str)):
+            continue
+        if has_cjk:
+            out[i] = term in v
+        else:
+            out[i] = term in tokenize(v)
     return out

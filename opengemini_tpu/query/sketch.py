@@ -265,10 +265,11 @@ class CountMinSketch:
     def _keys_of(items) -> np.ndarray:
         arr = np.asarray(items)
         if arr.dtype.kind in "iuf":
-            # ONE numeric representation: 7 and 7.0 must collide, or a
-            # float producer + int consumer underestimates (the one thing
-            # count-min must never do). float64 is exact for ints < 2^53.
-            return arr.astype(np.float64).view(np.int64)
+            # ONE numeric representation: 7 and 7.0 (and -0.0 and 0.0)
+            # must collide, or a float producer + int consumer
+            # underestimates (the one thing count-min must never do).
+            # float64 is exact for ints < 2^53; +0.0 canonicalizes -0.0.
+            return (arr.astype(np.float64) + 0.0).view(np.int64)
         # strings/objects: stable 64-bit digests
         import hashlib
 

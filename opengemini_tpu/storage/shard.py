@@ -735,7 +735,20 @@ class Shard:
         sids before intersecting."""
         import json as _json
 
-        token = token.lower()
+        from opengemini_tpu.native.textindex import query_grams
+
+        if token.isascii():
+            # pure-ASCII terms are whole lowercased tokens in the index
+            grams = [token.lower()]
+        else:
+            # mixed/CJK terms: prune on the NON-ASCII single-char grams
+            # only (raw bytes — the index never case-folds non-ASCII).
+            # ASCII fragments of a mixed term may be substrings of longer
+            # indexed tokens ('log' inside 'logfile') and must not
+            # constrain the pruning set.
+            grams = [g for g in query_grams(token) if not g.isascii()]
+        if not grams:
+            grams = [token.lower()]
         out: set[int] = set()
         # whole lookup under the shard lock: compact() swaps the file set
         # and resets the cache; populating the cache outside the lock
@@ -753,7 +766,13 @@ class Shard:
                     self._tidx_cache[r.path] = cached
                 if cached is None:
                     return None
-                out.update(cached.get(mst, {}).get(field, {}).get(token, []))
+                toks = cached.get(mst, {}).get(field, {})
+                # multi-gram terms (CJK) intersect their grams' postings
+                per_file: set[int] | None = None
+                for g in grams:
+                    got = set(toks.get(g, []))
+                    per_file = got if per_file is None else per_file & got
+                out.update(per_file or ())
         return out
 
     def read_series(
