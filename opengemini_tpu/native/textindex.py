@@ -10,13 +10,11 @@ from __future__ import annotations
 
 import ctypes
 import os
-import re
 
 import numpy as np
 
 _LIB = None
 _TRIED = False
-_TOKEN_RE = re.compile(r"[A-Za-z0-9]{2,}")
 
 
 def _load():
@@ -68,7 +66,24 @@ class TextIndex:
                 self._post.setdefault(tok, []).append(doc_id)
 
     def search(self, token: str) -> np.ndarray:
-        token = token.lower()
+        """Doc ids matching a term. Multi-gram terms (CJK strings, mixed
+        script) intersect their grams' postings — the per-character index
+        scheme query_grams() documents. ASCII lowercases; non-ASCII is
+        byte-exact (the index never case-folds it)."""
+        grams = query_grams(token)
+        if len(grams) > 1:
+            out = None
+            for g in grams:
+                if g.isascii():
+                    continue  # ASCII fragments may sit inside longer tokens
+                ids = set(self.search(g).tolist())
+                out = ids if out is None else out & ids
+            if out is None:  # pure-ASCII multi-token term: all must match
+                for g in grams:
+                    ids = set(self.search(g).tolist())
+                    out = ids if out is None else out & ids
+            return np.asarray(sorted(out or ()), dtype=np.int64)
+        token = token.lower() if token.isascii() else token
         if self._lib is not None:
             b = token.encode("utf-8", errors="replace")
             cap = 1024

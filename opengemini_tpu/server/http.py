@@ -237,11 +237,33 @@ def _make_handler(svc: HttpService):
                 self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
             self.send_header("X-Influxdb-Version", "1.8.0-" + __version__)
+            extra = getattr(self, "_extra_headers", None)
+            if extra:
+                for k, v in extra.items():
+                    self.send_header(k, v)
+                self._extra_headers = None
             self.end_headers()
             if payload:
                 self.wfile.write(payload)
 
-        def _send_json(self, code: int, obj: dict, pretty: bool = False):
+        def _send_err(self, status: int, exc: BaseException,
+                      extra: dict | None = None):
+            """Error response with the stable errno taxonomy attached:
+            X-Ogt-Errno header + errno field (reference lib/errno — the
+            code is what fleet log triage greps)."""
+            from opengemini_tpu.utils import errno as _errno
+
+            code, mod = _errno.classify(exc)
+            body = {"error": str(exc), "errno": code,
+                    "module": mod.name.lower()}
+            if extra:
+                body.update(extra)
+            self._send_json(status, body,
+                            headers={"X-Ogt-Errno": str(code)})
+
+        def _send_json(self, code: int, obj: dict, pretty: bool = False,
+                       headers: dict | None = None):
+            self._extra_headers = headers
             indent = 4 if pretty else None
             try:
                 # strict JSON: a stray non-finite float anywhere in a
@@ -282,7 +304,7 @@ def _make_handler(svc: HttpService):
             try:
                 return svc.users.authenticate(name, pw or "")
             except AuthError as e:
-                self._send_json(401, {"error": str(e)})
+                self._send_err(401, e)
                 return False
 
         # -- routes ---------------------------------------------------------
@@ -420,7 +442,7 @@ def _make_handler(svc: HttpService):
                     self._send_json(400, {"error": f"bad points: {e}"})
                     return
                 except WriteError as e:
-                    self._send_json(403, {"error": str(e)})
+                    self._send_err(403, e)
                     return
                 self._send_json(200, {"ok": True})
             elif path in ("/internal/select_meta", "/internal/select_partials"):
@@ -632,7 +654,7 @@ def _make_handler(svc: HttpService):
                     q, db=params.get("db", ""), read_only=read_only, user=user
                 )
             except AuthError as e:
-                self._send_json(403, {"error": str(e)})
+                self._send_err(403, e)
                 return
             epoch = params.get("epoch")
             pretty = params.get("pretty") in ("true", "1")
@@ -901,13 +923,13 @@ def _make_handler(svc: HttpService):
                 else:
                     svc.engine.write_rows(db, points, rp=rp)
             except DatabaseNotFound as e:
-                self._send_json(404, {"error": str(e)})
+                self._send_err(404, e)
                 return False
             except (FieldTypeConflict, ValueError) as e:
-                self._send_json(400, {"error": f"partial write: {e}"})
+                self._send_err(400, e, extra={"error": f"partial write: {e}"})
                 return False
             except WriteError as e:
-                self._send_json(403, {"error": str(e)})
+                self._send_err(403, e)
                 return False
             return True
 
@@ -1053,13 +1075,13 @@ def _make_handler(svc: HttpService):
                     return
                 svc.engine.write_lines(db, self._body(), precision=precision, rp=rp)
             except DatabaseNotFound as e:
-                self._send_json(404, {"error": str(e)})
+                self._send_err(404, e)
                 return
             except (ParseError, FieldTypeConflict, ValueError) as e:
-                self._send_json(400, {"error": f"partial write: {e}"})
+                self._send_err(400, e, extra={"error": f"partial write: {e}"})
                 return
             except WriteError as e:
-                self._send_json(403, {"error": str(e)})
+                self._send_err(403, e)
                 return
             self._send(204)
 
@@ -1079,13 +1101,13 @@ def _make_handler(svc: HttpService):
                 self._send_json(503, {"error": f"forward failed: {e}"})
                 return
             except DatabaseNotFound as e:
-                self._send_json(404, {"error": str(e)})
+                self._send_err(404, e)
                 return
             except (ParseError, FieldTypeConflict, ValueError) as e:
-                self._send_json(400, {"error": f"partial write: {e}"})
+                self._send_err(400, e, extra={"error": f"partial write: {e}"})
                 return
             except WriteError as e:
-                self._send_json(403, {"error": str(e)})
+                self._send_err(403, e)
                 return
             except OSError as e:
                 self._send_json(503, {"error": f"forward failed: {e}"})

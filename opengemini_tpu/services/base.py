@@ -45,5 +45,12 @@ class Service:
         while not self._stop.wait(self.interval_s):
             try:
                 self.handle()
-            except Exception:  # noqa: BLE001 — service loops never die
-                logger.exception("service %s tick failed", self.name)
+            except Exception as e:  # noqa: BLE001 — service loops never die
+                try:
+                    from opengemini_tpu.utils import errno as _errno
+
+                    note = _errno.tag(e)
+                except Exception:  # noqa: BLE001 — classify() must never
+                    note = "errno=?"  # kill the loop it annotates
+                logger.exception(
+                    "service %s tick failed [%s]", self.name, note)

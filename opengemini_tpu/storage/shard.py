@@ -747,8 +747,6 @@ class Shard:
             # indexed tokens ('log' inside 'logfile') and must not
             # constrain the pruning set.
             grams = [g for g in query_grams(token) if not g.isascii()]
-        if not grams:
-            grams = [token.lower()]
         out: set[int] = set()
         # whole lookup under the shard lock: compact() swaps the file set
         # and resets the cache; populating the cache outside the lock

@@ -40,6 +40,17 @@ def post(svc, path, body=b"", headers=None, **params):
         return e.code, e.read()
 
 
+def post_full(svc, path, body=b"", headers=None, **params):
+    req = urllib.request.Request(
+        _url(svc, path, **params), data=body, headers=headers or {},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
 def get(svc, path, **params):
     try:
         with urllib.request.urlopen(_url(svc, path, **params)) as r:
@@ -489,3 +500,49 @@ def test_otlp_metrics_ingest(server):
     s = json.loads(resp)["results"][0]["series"][0]
     assert s["tags"] == {"host": "h1", "service": "svc1"}
     assert s["values"][0] == [t_ns, 42.5]
+
+
+class TestErrnoTaxonomy:
+    """Stable error codes on the wire (reference lib/errno code taxonomy:
+    fleet log triage greps codes, not message text)."""
+
+    def test_classify_stability(self):
+        from opengemini_tpu.ingest.line_protocol import ParseError
+        from opengemini_tpu.meta.users import AuthError
+        from opengemini_tpu.query.qhelpers import QueryError
+        from opengemini_tpu.record import FieldType, FieldTypeConflict
+        from opengemini_tpu.storage.engine import DatabaseNotFound
+        from opengemini_tpu.utils import errno
+
+        cases = [
+            (ParseError(1, "bad"), errno.WRITE_PARSE, "write"),
+            (FieldTypeConflict("f", FieldType.FLOAT, FieldType.INT),
+             errno.WRITE_FIELD_CONFLICT, "write"),
+            (DatabaseNotFound("x"), errno.WRITE_DB_NOT_FOUND, "write"),
+            (AuthError("denied"), errno.AUTH_DENIED, "auth"),
+            (QueryError("measurement not found"), errno.QUERY_MEASUREMENT_NOT_FOUND, "query"),
+            (QueryError("xyz() is not supported"), errno.QUERY_UNSUPPORTED, "query"),
+        ]
+        for exc, want_code, want_mod in cases:
+            code, mod = errno.classify(exc)
+            assert code == want_code and mod.name.lower() == want_mod, exc
+        # explicit pin wins
+        e = QueryError("whatever")
+        e.og_errno = errno.META_NO_QUORUM
+        assert errno.classify(e)[0] == errno.META_NO_QUORUM
+        # OSError's built-in errno must NOT hijack classification
+        ce = ConnectionRefusedError(111, "refused")
+        assert errno.classify(ce)[0] == errno.NET_NODE_UNREACHABLE
+        assert "errno=" in errno.tag(QueryError("zz"))
+
+    def test_wire_surface(self, server):
+        from opengemini_tpu.utils import errno
+
+        # auth-less write to a missing database: stable code + header
+        status, headers, body = post_full(
+            server, "/write", b"m v=1", db="missing_db")
+        assert status == 404
+        assert headers.get("X-Ogt-Errno") == str(errno.WRITE_DB_NOT_FOUND)
+        doc = json.loads(body)
+        assert doc["errno"] == errno.WRITE_DB_NOT_FOUND
+        assert doc["module"] == "write"
