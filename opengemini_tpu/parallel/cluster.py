@@ -813,17 +813,19 @@ class DataRouter:
     MIGRATE_CHUNK = 20_000  # points per forwarded batch
 
     def migrate_round(self) -> int:
-        """Rebalancing after membership change (reference:
-        app/ts-meta/meta/migrate_state_machine.go, engine/engine_ha.go):
-        any shard group held locally whose rendezvous owners no longer
-        include this node is PUSHED, measurement by measurement, to every
-        current live owner, then dropped locally.  The move is idempotent
-        (structured writes LWW-merge at the destination), so a crash at
-        any point simply retries next tick — no separate migration state
-        machine is needed where the reference records raft state.
-        Queries stay correct throughout: un-migrated data still serves
-        from the old holder via the scan fan-out (rf=1), or converges via
-        anti-entropy (rf>1).  Returns groups migrated."""
+        """Rebalancing after membership change — TWO-PHASE (reference:
+        app/ts-meta/meta/migrate_state_machine.go + engine/engine_ha.go
+        PreAssign/Assign/Rollback): for each shard group held locally
+        whose rendezvous owners no longer include this node, every
+        destination opens an INVISIBLE staging area (begin), rows stream
+        into it, and only a commit folds them into the live shard —
+        queries never observe a half-migrated copy. Any failure aborts
+        the staging best-effort; a pusher that dies mid-stream leaves
+        staging dirs that the destinations TTL-expire (the rollback that
+        survives coordinator death). The local copy drops only after
+        every destination commits. Returns groups migrated."""
+        import uuid
+
         ids = sorted(self.data_nodes())
         moved = 0
         for (db, rp, start), sh in sorted(self.engine._shards.items()):
@@ -832,43 +834,60 @@ class DataRouter:
                 continue
             if not all(self.node_up(peer) for peer in dest):
                 continue  # owner down (quorum view): retry when healed
+            mig_id = f"mig-{self.self_id}-{uuid.uuid4().hex[:12]}"
+            begun: list[str] = []
             try:
                 for peer in dest:
-                    self._push_shard(peer, db, rp, sh)
+                    self._migrate_rpc(peer, {
+                        "phase": "begin", "mig_id": mig_id, "db": db,
+                        "rp": rp, "group_start": start})
+                    begun.append(peer)
+                for peer in dest:
+                    self._push_shard(peer, db, rp, sh, mig_id)
+                for peer in dest:
+                    self._migrate_rpc(peer, {
+                        "phase": "commit", "mig_id": mig_id, "db": db})
             except (OSError, RemoteScanError):
-                continue  # partial pushes are safe: LWW dedups on retry
+                for peer in begun:  # Rollback: best-effort abort now,
+                    try:            # TTL expiry covers the rest
+                        self._migrate_rpc(peer, {
+                            "phase": "abort", "mig_id": mig_id, "db": db})
+                    except (OSError, RemoteScanError):
+                        pass
+                continue
             self.engine.drop_shard(db, rp, start)
             moved += 1
             STATS.incr("cluster", "groups_migrated")
         return moved
 
-    def _push_shard(self, peer: str, db: str, rp, sh) -> None:
-        """Stream every row of one local shard to `peer` in bounded
-        structured-write batches."""
-        batch: list = []
-        for mst in sh.measurements():
-            for sid in sorted(sh.index.series_ids(mst)):
-                rec = sh.read_series(mst, sid)
-                if not len(rec):
-                    continue
-                _m, tags = sh.index.series_entry(sid)
-                cols = list(rec.columns.items())
-                for i in range(len(rec)):
-                    fields = {}
-                    for name, col in cols:
-                        if col.valid[i]:
-                            v = col.values[i]
-                            fields[name] = (
-                                col.ftype,
-                                v.item() if hasattr(v, "item") else v,
-                            )
-                    if fields:
-                        batch.append((mst, tags, int(rec.times[i]), fields))
-                    if len(batch) >= self.MIGRATE_CHUNK:
-                        self.forward_points(peer, db, rp, batch)
-                        batch = []
-        if batch:
-            self.forward_points(peer, db, rp, batch)
+    def _migrate_rpc(self, peer: str, body: dict) -> None:
+        addr = self.data_nodes().get(peer, "")
+        if not addr:
+            raise RemoteScanError(f"no address for data node {peer!r}")
+        try:
+            # commit folds the whole staged group into the live shard
+            # synchronously — far longer than a data-plane RPC
+            timeout = 300.0 if body.get("phase") == "commit" else None
+            got = self._post(addr, "/internal/migrate", body,
+                             timeout=timeout)
+        except OSError as e:
+            raise RemoteScanError(
+                f"data node {peer!r} ({addr}) migrate "
+                f"{body.get('phase')} failed: {e}") from e
+        if not got.get("ok"):
+            raise RemoteScanError(
+                f"data node {peer!r} rejected migrate {body.get('phase')}")
+
+    def _push_shard(self, peer: str, db: str, rp, sh, mig_id: str) -> None:
+        """Stream every row of one local shard into `peer`'s staging area
+        in bounded structured-write batches (extraction shared with
+        engine.commit_staging via iter_structured_batches)."""
+        from opengemini_tpu.storage.shard import iter_structured_batches
+
+        for batch in iter_structured_batches(sh, self.MIGRATE_CHUNK):
+            self._migrate_rpc(peer, {
+                "phase": "write", "mig_id": mig_id, "db": db,
+                "points": encode_points(batch)})
 
     # -- anti-entropy (rf>1 replica convergence) ----------------------------
 
@@ -998,7 +1017,8 @@ class DataRouter:
         )
         peers.urlopen(req, timeout=self.timeout_s).read()
 
-    def _post_raw(self, addr: str, path: str, body: dict):
+    def _post_raw(self, addr: str, path: str, body: dict,
+                  timeout: float | None = None):
         """One internal-POST implementation (token injection, timeout);
         returns (bytes, content_type)."""
         req = urllib.request.Request(
@@ -1006,11 +1026,12 @@ class DataRouter:
             data=json.dumps(dict(body, token=self.token)).encode("utf-8"),
             headers={"Content-Type": "application/json"}, method="POST",
         )
-        with peers.urlopen(req, timeout=self.timeout_s) as r:
+        with peers.urlopen(req, timeout=timeout or self.timeout_s) as r:
             return r.read(), r.headers.get("Content-Type", "")
 
-    def _post(self, addr: str, path: str, body: dict) -> dict:
-        data, _ct = self._post_raw(addr, path, body)
+    def _post(self, addr: str, path: str, body: dict,
+              timeout: float | None = None) -> dict:
+        data, _ct = self._post_raw(addr, path, body, timeout=timeout)
         return json.loads(data)
 
     def _post_scan(self, addr: str, body: dict) -> dict:

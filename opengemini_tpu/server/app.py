@@ -193,7 +193,10 @@ def build(cfg: dict) -> HttpService:
 
         svc.services.append(MigrationService(
             svc.router,
-            float(cluster_cfg.get("migration-interval-s", 60))))
+            float(cluster_cfg.get("migration-interval-s", 60)),
+            staging_ttl_s=float(
+                cluster_cfg.get("migration-staging-ttl-s", 900)),
+        ))
     return svc
 
 

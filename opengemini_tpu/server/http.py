@@ -445,6 +445,43 @@ def _make_handler(svc: HttpService):
                     self._send_err(403, e)
                     return
                 self._send_json(200, {"ok": True})
+            elif path == "/internal/migrate":
+                # two-phase shard-group migration (reference engine_ha.go
+                # PreAssign/Assign/Rollback): begin -> staged writes ->
+                # commit | abort; staging is invisible to queries and
+                # TTL-expired if the pusher dies (MigrationService)
+                req = self._internal_request(svc)
+                if req is None:
+                    return
+                from opengemini_tpu.parallel.cluster import decode_points
+
+                op = req.get("phase")
+                mig = str(req.get("mig_id", ""))
+                try:
+                    if op == "begin":
+                        svc.engine.begin_staging(
+                            req["db"], req.get("rp") or None,
+                            int(req["group_start"]), mig)
+                        out = {"ok": True}
+                    elif op == "write":
+                        n = svc.engine.write_staging(
+                            mig, decode_points(req.get("points", [])))
+                        out = {"ok": True, "rows": n}
+                    elif op == "commit":
+                        out = {"ok": True,
+                               "rows": svc.engine.commit_staging(mig)}
+                    elif op == "abort":
+                        out = {"ok": svc.engine.abort_staging(mig)}
+                    else:
+                        self._send_json(400, {"error": f"bad phase {op!r}"})
+                        return
+                except (KeyError, TypeError, ValueError) as e:
+                    self._send_json(400, {"error": f"bad migrate request: {e}"})
+                    return
+                except WriteError as e:
+                    self._send_err(403, e)
+                    return
+                self._send_json(200, out)
             elif path in ("/internal/select_meta", "/internal/select_partials"):
                 req = self._internal_request(svc)
                 if req is None:

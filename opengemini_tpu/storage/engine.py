@@ -177,6 +177,9 @@ class Engine:
         self._shards: dict[tuple[str, str, int], Shard] = {}
         self._load_meta()
         self._models = None  # lazy ModelStore (castor)
+        # inbound two-phase migrations: mig_id -> (db, rp, start, Shard);
+        # staging shards are NEVER in _shards (invisible to queries)
+        self._staging: dict[str, tuple] = {}
         self._load_shards()
 
     # -- metadata -----------------------------------------------------------
@@ -461,6 +464,122 @@ class Engine:
             self._save_meta()
             _remove_shard_dir(shard.path)  # follows cold-tier symlinks
             return True
+
+    # -- two-phase migration staging (reference engine_ha.go Pre*/Rollback) --
+
+    def _staging_root(self) -> str:
+        return os.path.join(self.root, "staging")
+
+    def begin_staging(self, db: str, rp: str, group_start: int,
+                      mig_id: str) -> None:
+        """PreAssign: open an INVISIBLE staging shard for an inbound
+        migration (never in self._shards, so queries cannot see half-
+        migrated rows). Idempotent — a retried begin reuses the dir."""
+        if not mig_id or "/" in mig_id or mig_id.startswith("."):
+            raise WriteError(f"bad migration id {mig_id!r}")
+        d = self.databases.get(db)
+        if d is None:
+            raise DatabaseNotFound(db)
+        rp_meta = d.rps.get(rp or d.default_rp)
+        if rp_meta is None:
+            raise WriteError(f"retention policy not found: {db}.{rp}")
+        with self._lock:
+            if mig_id in self._staging:
+                return
+            path = os.path.join(self._staging_root(), mig_id)
+            dur = rp_meta.shard_duration_ns
+            sh = Shard(path, group_start, group_start + dur, self.sync_wal)
+            self._staging[mig_id] = [db, rp or d.default_rp, group_start, sh,
+                                     _time.time()]
+
+    def write_staging(self, mig_id: str, points: list) -> int:
+        with self._lock:
+            got = self._staging.get(mig_id)
+            if got is None:
+                raise WriteError(f"unknown migration {mig_id!r}")
+            got[4] = _time.time()  # idle clock, NOT dir mtime: WAL
+            # appends never touch the directory timestamp
+            return got[3].write_points_structured(points)
+
+    def commit_staging(self, mig_id: str) -> int:
+        """Assign: fold the staged rows into the LIVE shard (LWW-idempotent
+        structured writes) and discard the staging area. Returns rows."""
+        with self._lock:
+            got = self._staging.pop(mig_id, None)
+        if got is None:
+            raise WriteError(f"unknown migration {mig_id!r}")
+        db, rp, _start, sh, _ts = got
+        from opengemini_tpu.storage.shard import iter_structured_batches
+
+        rows = 0
+        for batch in iter_structured_batches(sh, 20_000):
+            rows += self.write_rows(db, batch, rp=rp)
+        self._discard_staging_dir(sh)
+        return rows
+
+    def abort_staging(self, mig_id: str) -> bool:
+        """Rollback: drop the staging area; live data was never touched."""
+        with self._lock:
+            got = self._staging.pop(mig_id, None)
+        if got is None:
+            return False
+        self._discard_staging_dir(got[3])
+        return True
+
+    def close_staging(self) -> None:
+        with self._lock:
+            for entry in self._staging.values():
+                entry[3].close()
+            self._staging.clear()
+
+    def _discard_staging_dir(self, sh) -> None:
+        import shutil
+
+        path = sh.path
+        sh.close()
+        shutil.rmtree(path, ignore_errors=True)
+
+    def expire_staging(self, ttl_s: float = 900.0) -> int:
+        """Janitor half of the rollback story: a pusher that died
+        mid-stream leaves a staging dir behind; anything older than the
+        TTL is discarded — live data is untouched by construction, so
+        expiry IS the rollback (reference: the migrate state machine's
+        recovery + Rollback RPCs, engine_ha.go:33-258)."""
+        import shutil
+        import time as _t
+
+        root = self._staging_root()
+        if not os.path.isdir(root):
+            return 0
+        now = _t.time()
+        dropped = 0
+        with self._lock:
+            # ACTIVE registrations expire on IDLE time (last write seen;
+            # an in-progress stream keeps refreshing it, so a long
+            # migration never self-destructs mid-flight)
+            for name, entry in list(self._staging.items()):
+                if now - entry[4] >= ttl_s:
+                    self._staging.pop(name, None)
+                    self._discard_staging_dir(entry[3])
+                    dropped += 1
+            # ORPHAN dirs (no in-memory entry — e.g. this node restarted
+            # mid-migration) expire by their newest content mtime
+            for name in os.listdir(root):
+                if name in self._staging:
+                    continue
+                path = os.path.join(root, name)
+                try:
+                    newest = max(
+                        (os.path.getmtime(os.path.join(path, f))
+                         for f in os.listdir(path)),
+                        default=os.path.getmtime(path))
+                except OSError:
+                    continue
+                if now - newest < ttl_s:
+                    continue
+                shutil.rmtree(path, ignore_errors=True)
+                dropped += 1
+        return dropped
 
     def drop_shard(self, db: str, rp: str, group_start: int) -> bool:
         """Remove one local shard group entirely (post-migration cleanup:
@@ -893,6 +1012,9 @@ class Engine:
             for shard in self._shards.values():
                 shard.close()
             self._shards.clear()
+            for entry in self._staging.values():
+                entry[3].close()
+            self._staging.clear()
 
 
 def _remove_shard_dir(path: str) -> None:

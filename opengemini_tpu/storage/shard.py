@@ -106,6 +106,38 @@ def _write_measurement_chunks(w: TSFWriter, tidx, mst: str, entries,
         w.add_packed_chunk(mst, sids, packed)
 
 
+def iter_structured_batches(sh, chunk_rows: int):
+    """Yield a shard's full content as structured-point batches
+    (measurement, tags, t_ns, {field: (type, value)}) of <= chunk_rows —
+    the ONE extraction loop shared by migration pushes
+    (parallel/cluster._push_shard) and staging commits
+    (engine.commit_staging)."""
+    batch: list = []
+    for mst in sh.measurements():
+        for sid in sorted(sh.index.series_ids(mst)):
+            rec = sh.read_series(mst, sid)
+            if not len(rec):
+                continue
+            _m, tags = sh.index.series_entry(sid)
+            cols = list(rec.columns.items())
+            for i in range(len(rec)):
+                fields = {}
+                for name, col in cols:
+                    if col.valid[i]:
+                        v = col.values[i]
+                        fields[name] = (
+                            col.ftype,
+                            v.item() if hasattr(v, "item") else v,
+                        )
+                if fields:
+                    batch.append((mst, tags, int(rec.times[i]), fields))
+                if len(batch) >= chunk_rows:
+                    yield batch
+                    batch = []
+    if batch:
+        yield batch
+
+
 _DATA_VERSIONS = itertools.count(1)  # see Shard.data_version
 _MUT_LOG_MAX = 512  # bounded mutation history; overflow = assume-changed
 

@@ -212,8 +212,8 @@ class TestWireShape:
         calls = []
         orig = router._post_raw
 
-        def spy(addr, path, body):
-            data, ct = orig(addr, path, body)
+        def spy(addr, path, body, timeout=None):
+            data, ct = orig(addr, path, body, timeout=timeout)
             calls.append((path, len(data)))
             return data, ct
 
@@ -248,8 +248,8 @@ class TestWireShape:
         calls = []
         orig = router._post_raw
 
-        def spy(addr, path, body):
-            data, ct = orig(addr, path, body)
+        def spy(addr, path, body, timeout=None):
+            data, ct = orig(addr, path, body, timeout=timeout)
             calls.append(path)
             return data, ct
 
@@ -279,8 +279,8 @@ class TestWireShape:
         calls = []
         orig = router._post_raw
 
-        def spy(addr, path, body):
-            data, ct = orig(addr, path, body)
+        def spy(addr, path, body, timeout=None):
+            data, ct = orig(addr, path, body, timeout=timeout)
             calls.append((path, len(data)))
             return data, ct
 
