@@ -432,7 +432,8 @@ class DataRouter:
     writes there, and pull raw columns back for queries."""
 
     def __init__(self, engine, meta_store, self_id: str, self_addr: str,
-                 token: str = "", timeout_s: float = 10.0, rf: int = 1):
+                 token: str = "", timeout_s: float = 10.0, rf: int = 1,
+                 write_consistency: str = "one"):
         self.engine = engine
         self.meta_store = meta_store
         self.self_id = self_id
@@ -443,6 +444,16 @@ class DataRouter:
         # rendezvous owners; reads are primary-filtered so replicas never
         # double-count (HA ops analogue of the reference's replication)
         self.rf = max(1, rf)
+        # rf>1 write acknowledgment level (reference: the consistency-mode
+        # choice its HA policies give operators; influx /write
+        # consistency=any|one|quorum|all): how many synchronous owner
+        # copies each point needs before the write ACKs — the rest ride
+        # hinted handoff. "all" is the strict mode: every replica
+        # synchronously or the write errors.
+        if write_consistency not in ("any", "one", "quorum", "all"):
+            raise ValueError(
+                f"bad write consistency {write_consistency!r}")
+        self.write_consistency = write_consistency
         self._hint_lock = threading.Lock()
         # last health-probe results: node id -> bool (True = reachable)
         self.health: dict[str, bool] = {}
@@ -609,19 +620,26 @@ class DataRouter:
         rp_name = rp or (d.default_rp if d else "autogen")
         return owners(sorted(live), db, rp_name, group_start, 1)[0] == self.self_id
 
-    def routed_write(self, db: str, rp: str | None, points: list) -> int:
+    def routed_write(self, db: str, rp: str | None, points: list,
+                     consistency: str | None = None) -> int:
         """The one coordinator-write sequence (used by HTTP /write and
         SELECT INTO): split by owner, write the local slice structurally,
         forward the rest as STRUCTURED JSON — line-protocol text cannot
         carry arbitrary content (e.g. newlines in string fields).
 
-        rf>1 uses hinted handoff (the dynamo recipe the reference's HA
-        writes follow): the write ACKs when at least one owner copy of
-        every point landed; copies for unreachable replicas queue as
-        hints and replay when the node returns. Reads stay correct
-        because failover makes a LIVE owner primary — and a live owner
-        holds its synchronous copy. rf=1 keeps all-or-error: there is no
-        second copy to lean on."""
+        rf>1 acknowledges at the configured consistency level (reference:
+        the HA-policy consistency choice; influx consistency=any|one|
+        quorum|all): each point needs that many SYNCHRONOUS owner copies
+        before the write ACKs; copies for unreachable replicas queue as
+        hints and replay when the node returns. "all" is the strict mode
+        — every replica synchronously or the write errors, nothing is
+        hinted. Reads stay correct at every level because failover makes
+        a LIVE owner primary — and a live owner holds its synchronous
+        copy. rf=1 keeps all-or-error: there is no second copy to lean
+        on."""
+        level = consistency or self.write_consistency
+        if level not in ("any", "one", "quorum", "all"):
+            raise ValueError(f"bad consistency level {level!r}")
         local, remote = self.split_points(db, rp, points)
         n = 0
         if local:
@@ -643,18 +661,31 @@ class DataRouter:
             except (OSError, RemoteScanError) as e:
                 failed.append((node_id, pts, e))
         if failed:
-            if self.rf <= 1 or not self._all_covered(db, rp, points, failed):
+            if level == "any" and self.rf > 1:
+                # influx 'any': the durable local hint queue IS the ack —
+                # accept even when no owner was synchronously reachable
+                for node_id, pts, _e in failed:
+                    self.hint(node_id, db, rp, pts)
+                    n += len(pts)
+                return n
+            need = {
+                "one": 1,
+                "quorum": self.rf // 2 + 1,
+                "all": self.rf,
+            }.get(level, 1)
+            if self.rf <= 1 or not self._covered(db, rp, points, failed,
+                                                 need):
                 raise RemoteScanError(
-                    f"write failed: {failed[0][2]}"
+                    f"write failed at consistency={level}: {failed[0][2]}"
                 ) from failed[0][2]
             for node_id, pts, _e in failed:
                 self.hint(node_id, db, rp, pts)
                 n += len(pts)
         return n
 
-    def _all_covered(self, db, rp, points, failed) -> bool:
-        """Did every point land on at least one owner? (failed targets
-        excluded)."""
+    def _covered(self, db, rp, points, failed, need: int) -> bool:
+        """Did every point land on at least `need` owners? (failed
+        targets excluded)."""
         dead = {nid for nid, _pts, _e in failed}
         d = self.engine.databases.get(db)
         rp_name = rp or (d.default_rp if d else "autogen")
@@ -662,7 +693,7 @@ class DataRouter:
         for p in points:
             dest = owners(ids, db, rp_name,
                           self._group_start(db, rp, p[2]), self.rf)
-            if all(o in dead for o in dest):
+            if sum(1 for o in dest if o not in dead) < need:
                 return False
         return True
 

@@ -167,6 +167,8 @@ def build(cfg: dict) -> HttpService:
             engine, svc.meta_store, meta_cfg["node-id"], advertise,
             token=meta_cfg.get("token", ""),
             rf=int(cluster_cfg.get("replication-factor", 1)),
+            write_consistency=str(
+                cluster_cfg.get("write-consistency", "one")),
         )
         svc.executor.router = svc.router
         if svc.flight is not None:

@@ -952,11 +952,13 @@ def _make_handler(svc: HttpService):
             except pw.WireError:
                 return data
 
-        def _write_decoded_points(self, db: str, rp, points) -> bool:
+        def _write_decoded_points(self, db: str, rp, points,
+                                  consistency=None) -> bool:
             try:
                 router = getattr(svc, "router", None)
                 if router is not None:
-                    router.routed_write(db, rp, points)
+                    router.routed_write(db, rp, points,
+                                        consistency=consistency)
                 else:
                     svc.engine.write_rows(db, points, rp=rp)
             except DatabaseNotFound as e:
@@ -1108,7 +1110,8 @@ def _make_handler(svc: HttpService):
             try:
                 router = getattr(svc, "router", None)
                 if router is not None and not internal:
-                    self._routed_write(router, db, rp, precision)
+                    self._routed_write(router, db, rp, precision,
+                                       consistency=params.get("consistency"))
                     return
                 svc.engine.write_lines(db, self._body(), precision=precision, rp=rp)
             except DatabaseNotFound as e:
@@ -1122,18 +1125,28 @@ def _make_handler(svc: HttpService):
                 return
             self._send(204)
 
-        def _routed_write(self, router, db: str, rp, precision: str):
+        def _routed_write(self, router, db: str, rp, precision: str,
+                          consistency=None):
             """Coordinator write: parse, then the shared routed_write
             sequence (split by owner, local structural write, structured
             JSON forwards)."""
             import time as _time
+
+            if consistency is not None and consistency not in (
+                    "any", "one", "quorum", "all"):
+                # client typo = 400, never a retriable 503
+                self._send_json(400, {
+                    "error": f"invalid consistency {consistency!r} "
+                             "(any, one, quorum, all)"})
+                return
 
             from opengemini_tpu.ingest.line_protocol import parse_lines
             from opengemini_tpu.parallel.cluster import RemoteScanError
 
             try:
                 points = parse_lines(self._body(), precision, _time.time_ns())
-                router.routed_write(db, rp, points)
+                router.routed_write(db, rp, points,
+                                    consistency=consistency)
             except RemoteScanError as e:
                 self._send_json(503, {"error": f"forward failed: {e}"})
                 return

@@ -849,3 +849,130 @@ class TestHintInflightOrphan:
         assert not os.path.exists(live + ".inflight")
         assert "nB" not in router.pending_hint_nodes()
         eng.close()
+
+
+class TestWriteConsistency:
+    """rf>1 write acknowledgment levels (reference: the HA-policy
+    consistency choice; influx /write consistency=any|one|quorum|all)."""
+
+    def _mk(self, tmp_path, rf=2, consistency="one"):
+        from opengemini_tpu.parallel.cluster import DataRouter
+        from opengemini_tpu.server.http import HttpService
+
+        nodes = {}
+        addrs = {}
+        for nid in ("nA", "nB", "nC"):
+            e = Engine(str(tmp_path / nid))
+            e.create_database("db")
+            svc = HttpService(e, "127.0.0.1", 0)
+            svc.start()
+            addrs[nid] = f"127.0.0.1:{svc.port}"
+            nodes[nid] = (e, svc)
+
+        class FsmStub:
+            def __init__(self):
+                self.nodes = {n: {"addr": a, "role": "data"}
+                              for n, a in addrs.items()}
+
+        class StoreStub:
+            fsm = FsmStub()
+            token = ""
+
+        for nid, (e, svc) in nodes.items():
+            svc.router = DataRouter(e, StoreStub(), nid, addrs[nid], rf=rf,
+                                    write_consistency=consistency)
+            svc.executor.router = svc.router
+            svc.router.probe_health()
+        return nodes, addrs
+
+    def _kill(self, nodes, nid):
+        nodes[nid][1].stop()
+        for _e, svc in nodes.values():
+            svc.router.probe_health()
+
+    def test_one_acks_with_replica_down_all_refuses(self, tmp_path):
+        from opengemini_tpu.parallel.cluster import RemoteScanError, owners
+
+        nodes, addrs = self._mk(tmp_path, rf=2)
+        self._live = nodes
+        week = 7 * 86400
+        # find a group owned by (nB, nC) so nA coordinates remotely
+        rA = nodes["nA"][1].router
+        ids = sorted(rA.data_nodes())
+        t = None
+        for w in range(40):
+            cand = (BASE + w * week) * NS
+            from opengemini_tpu.storage.engine import shard_group_start
+            g = shard_group_start(cand, week * NS)
+            own = owners(ids, "db", "autogen", g, 2)
+            if "nA" not in own:
+                t, dest = cand, own
+                break
+        assert t is not None
+        self._kill(nodes, dest[1])  # secondary owner down
+        pts_line = f"m v=1 {t}"
+        import urllib.request
+
+        # consistency=one: ACKs (primary copy + hint for the dead replica)
+        req = urllib.request.Request(
+            f"http://{addrs['nA']}/write?db=db&consistency=one",
+            data=pts_line.encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 204
+        assert rA.pending_hint_nodes(), "dead replica's copy must hint"
+
+        # consistency=all: refuses while any replica is down
+        req2 = urllib.request.Request(
+            f"http://{addrs['nA']}/write?db=db&consistency=all",
+            data=f"m v=2 {t + NS}".encode(), method="POST")
+        import urllib.error
+
+        try:
+            urllib.request.urlopen(req2, timeout=30)
+            raise AssertionError("consistency=all must refuse")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        # quorum with rf=2 needs 2 synchronous copies -> also refuses
+        req3 = urllib.request.Request(
+            f"http://{addrs['nA']}/write?db=db&consistency=quorum",
+            data=f"m v=3 {t + 2 * NS}".encode(), method="POST")
+        try:
+            urllib.request.urlopen(req3, timeout=30)
+            raise AssertionError("consistency=quorum must refuse at rf=2")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        # consistency=any: the durable hint queue is the ack — succeeds
+        # even though a replica is down
+        req4 = urllib.request.Request(
+            f"http://{addrs['nA']}/write?db=db&consistency=any",
+            data=f"m v=4 {t + 3 * NS}".encode(), method="POST")
+        with urllib.request.urlopen(req4, timeout=30) as r:
+            assert r.status == 204
+        # a typo'd level is a 400 client error, not a retriable 503
+        req5 = urllib.request.Request(
+            f"http://{addrs['nA']}/write?db=db&consistency=bogus",
+            data=f"m v=5 {t + 4 * NS}".encode(), method="POST")
+        try:
+            urllib.request.urlopen(req5, timeout=30)
+            raise AssertionError("bad level must 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+    @pytest.fixture(autouse=True)
+    def _cleanup(self):
+        self._live = {}
+        yield
+        for e, svc in self._live.values():
+            try:
+                svc.stop()
+            except Exception:  # noqa: BLE001
+                pass
+            e.close()
+
+    def test_bad_level_rejected(self, tmp_path):
+        from opengemini_tpu.parallel.cluster import DataRouter
+
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            DataRouter(None, None, "x", "x", write_consistency="weird")

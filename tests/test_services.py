@@ -1056,3 +1056,47 @@ class TestCastorModels:
             db="db")
         assert ">= 8" in r2["results"][0].get("error", "")
         e.close()
+
+
+class TestMonitorAgent:
+    """ts-monitor external agent (reference app/ts-monitor/collector):
+    watches nodes from OUTSIDE and reports monitor series."""
+
+    def test_collect_and_report_round(self, tmp_path):
+        import os
+
+        from opengemini_tpu.server.http import HttpService
+        from opengemini_tpu.storage.engine import Engine
+        from opengemini_tpu.tools import monitor_agent as ma
+
+        e = Engine(str(tmp_path / "node"), sync_wal=False)
+        e.create_database("d")
+        e.write_lines("d", "m v=1 1700000000000000000")
+        svc = HttpService(e, "127.0.0.1", 0)
+        svc.start()
+        target = f"127.0.0.1:{svc.port}"
+        pidfile = tmp_path / "node.pid"
+        pidfile.write_text(str(os.getpid()))
+        try:
+            rc = ma.main([
+                "-targets", f"{target},127.0.0.1:1",  # second target: down
+                "-report", target, "-db", "monitor",
+                "-pidfiles", f"{target}={pidfile}", "-once"])
+            assert rc == 0
+            res = svc.executor.execute(
+                "SELECT up, ping_ms FROM ogmonitor_up GROUP BY target",
+                db="monitor")["results"][0]
+            by_tag = {s["tags"]["target"]: s["values"] for s in res["series"]}
+            assert by_tag[target][0][1] == 1
+            assert by_tag["127.0.0.1:1"][0][1] == 0  # down node observed
+            res2 = svc.executor.execute(
+                "SELECT write_points FROM ogmonitor_stats", db="monitor"
+            )["results"][0]
+            assert res2["series"][0]["values"][0][1] >= 1  # counters flowed
+            res3 = svc.executor.execute(
+                "SELECT rss_kb FROM ogmonitor_proc", db="monitor"
+            )["results"][0]
+            assert res3["series"][0]["values"][0][1] > 0
+        finally:
+            svc.stop()
+            e.close()
