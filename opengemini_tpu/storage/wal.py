@@ -11,6 +11,9 @@ kind 1 = raw lines: [u8 precision_len][u64 now_ns][precision utf8][zlib(lines)]
 kind 2 = structured points: [zlib(JSON [[mst, [[k,v]..], t, {f: [type, val]}]..])]
          (used by SELECT INTO / internal writes — values never round-trip
          through line-protocol text)
+kind 3 = raw lines, UNCOMPRESSED: same layout as kind 1 with the lines
+         stored verbatim (batches >= 1MiB: zlib wall time beats raw disk
+         writes on bulk loads — the reference WAL's snappy tradeoff)
 Torn tails (crc/len mismatch at EOF) are truncated on replay, matching the
 reference's tolerant WAL restore (engine/wal.go replay error handling).
 """

@@ -719,3 +719,46 @@ class TestReadCache:
             t.join()
         assert not errs, errs
         e.close()
+
+
+def test_wal_plain_kind_roundtrip(tmp_path):
+    """Batches >= 1MiB append UNCOMPRESSED (WAL kind 3) and must replay
+    bit-identically after a crash (no flush before close)."""
+    from opengemini_tpu.storage.engine import Engine
+    from opengemini_tpu.storage.wal import WAL, _KIND_RAW_LINES_PLAIN
+
+    NS = 10**9
+    base = 1_700_000_040
+    big = "\n".join(
+        f"m,host=h{i % 50} v={i} {(base + i) * NS}" for i in range(40_000))
+    assert len(big.encode()) >= (1 << 20)
+    e = Engine(str(tmp_path), sync_wal=False)
+    e.create_database("d")
+    e.write_lines("d", big)
+    e.write_lines("d", f"m,host=h0 v=-1 {base * NS - NS}")  # small: zlib kind
+    sh = list(e._shards.values())[0]
+    sh.wal.flush()
+    kinds = {entry_kind for entry_kind in _wal_kinds(sh.wal.path)}
+    assert _KIND_RAW_LINES_PLAIN in kinds and 1 in kinds, kinds
+    # crash (no flush): reopen replays both kinds
+    e2 = Engine(str(tmp_path), sync_wal=False)
+    sh2 = list(e2._shards.values())[0]
+    total = sum(
+        len(sh2.read_series("m", sid).times)
+        for sid in sh2.index.series_ids("m"))
+    assert total == 40_001, total
+    e2.close()
+    e.close()
+
+
+def _wal_kinds(path):
+    import struct
+
+    with open(path, "rb") as f:
+        data = f.read()
+    hdr = struct.Struct("<IIB")
+    off = 0
+    while off + hdr.size <= len(data):
+        length, _crc, kind = hdr.unpack_from(data, off)
+        yield kind
+        off += hdr.size + length
