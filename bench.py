@@ -388,21 +388,32 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
             f"FROM cpu WHERE time >= {base * NS} AND time < {(base + points) * NS} "
             "GROUP BY time(1m)"
         )
-        t0 = time.perf_counter()
-        ex.execute(q, db="bench", now_ns=(base + points) * NS)
-        t_cold = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        ex.execute(q, db="bench", now_ns=(base + points) * NS)
-        t_warm = time.perf_counter() - t0
+        now = (base + points) * NS
+
+        def run():
+            t0 = time.perf_counter()
+            ex.execute(q, db="bench", now_ns=now)
+            return time.perf_counter() - t0
+
+        t_cold = run()  # incl. XLA compiles + full scan
+        run()  # compile the stale-edge shapes too
+        t_cached = run()  # repeated dashboard query: incremental cache
+
+        def timed_uncached():
+            # scan+compute time with kernels warm and the result cache
+            # out of the picture (cleared per run)
+            ex._inc_cache.clear()
+            run()  # warm any remaining shape
+            ex._inc_cache.clear()
+            return run()
+
+        t_warm = timed_uncached()  # grid path
         # A/B: same query with the grid fast path disabled (bucketed
         # layout) — the production grid-vs-bucketed speedup, full e2e
         prior_knob = os.environ.get("OGTPU_DISABLE_GRID")
         os.environ["OGTPU_DISABLE_GRID"] = "1"
         try:
-            ex.execute(q, db="bench", now_ns=(base + points) * NS)  # warm
-            t0 = time.perf_counter()
-            ex.execute(q, db="bench", now_ns=(base + points) * NS)
-            t_warm_bucketed = time.perf_counter() - t0
+            t_warm_bucketed = timed_uncached()
         finally:
             if prior_knob is None:
                 os.environ.pop("OGTPU_DISABLE_GRID", None)
@@ -413,6 +424,7 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
             "rows": rows,
             "ingest_rows_per_s": round(rows / t_ingest),
             "query_cold_s": round(t_cold, 3),
+            "query_cached_s": round(t_cached, 4),
             "query_warm_s": round(t_warm, 3),
             "query_warm_rows_per_s": round(rows / t_warm),
             "query_warm_bucketed_s": round(t_warm_bucketed, 3),
