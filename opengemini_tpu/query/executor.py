@@ -605,6 +605,7 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         ref1/refK ratio columns (reference: openGemini compare UDF,
         TestServer_Query_Compare_Functions)."""
         import copy as _copy
+        from dataclasses import replace as _dc_replace
 
         if len(call.args) < 2:
             raise QueryError(
@@ -650,9 +651,19 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                 ast.BinaryExpr("<", ast.VarRef("time"),
                                ast.IntegerLiteral(sc.tmax - off)),
             )
+            run_inner = _copy.copy(inner)
+            gt = getattr(run_inner, "group_by_time", None)
+            if gt is not None and not gt.offset_ns:
+                # openGemini anchors compare() windows at the (shifted)
+                # RANGE START, not the epoch grid: the reference output
+                # rows carry tmin-aligned times
+                # (TestServer_Query_Compare_Functions#10). An explicit
+                # user GROUP BY time offset is respected as-is.
+                run_inner.group_by_time = _dc_replace(
+                    gt, offset_ns=(sc.tmin - off) % gt.every_ns)
             run_stmt = ast.SelectStatement(
                 fields=[ast.Field(ast.VarRef(ref))],
-                sources=[ast.SubQuery(_copy.copy(inner))],
+                sources=[ast.SubQuery(run_inner)],
                 condition=bound,
                 group_by_all_tags=True,
             )
