@@ -365,6 +365,13 @@ def _grid_jit(shape: tuple, dtype: str, kind: str):
     STATS.incr("device", "compile_cache_misses")
 
     if kind == "basic":
+        # deliberately XLA, not the Pallas grid kernel: the recorded v5e
+        # measurements (ops/pallas_segment.py module docstring) show XLA's
+        # own fusion WINNING for the pure grid reductions (~28-55 vs
+        # ~22-48 G rows/s) — only the selector lex-scans benefit from
+        # Pallas. Measurement beats ideology; it also keeps GSPMD row
+        # sharding working under a device mesh (pallas_call does not
+        # auto-partition).
 
         @jax.jit
         def basic(v, m):

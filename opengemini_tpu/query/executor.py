@@ -657,8 +657,11 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                 # openGemini anchors compare() windows at the (shifted)
                 # RANGE START, not the epoch grid: the reference output
                 # rows carry tmin-aligned times
-                # (TestServer_Query_Compare_Functions#10). An explicit
-                # user GROUP BY time offset is respected as-is.
+                # (TestServer_Query_Compare_Functions#10). A NON-ZERO
+                # user GROUP BY time offset is respected; an explicit 0s
+                # offset is indistinguishable from the default in the AST
+                # and re-anchors too (InfluxQL treats the forms
+                # identically).
                 run_inner.group_by_time = _dc_replace(
                     gt, offset_ns=(sc.tmin - off) % gt.every_ns)
             run_stmt = ast.SelectStatement(
