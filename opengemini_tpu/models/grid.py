@@ -80,6 +80,13 @@ class GridBatch:
             self._sids.append(np.asarray(sids, dtype=np.int64))
         self.n += len(self._vals[-1])
 
+    def layout_name(self) -> str:
+        if self._state is not None:
+            return "grid"
+        if self._fallback is not None:
+            return "grid->bucketed"
+        return "grid (not executed)"  # e.g. full result-cache hit
+
     def host_times(self) -> np.ndarray:
         return (np.concatenate(self._times) if self._times
                 else np.empty(0, np.int64))

@@ -71,6 +71,9 @@ class IntExactBatch:
         self._mask.append(np.asarray(mask, dtype=np.bool_))
         self.n += len(values)
 
+    def layout_name(self) -> str:
+        return "int-exact"
+
     def host_times(self) -> np.ndarray:
         return np.empty(0, np.int64)  # interface parity; never consumed
 
@@ -126,6 +129,9 @@ class BucketedBatch:
         self._mask.append(np.asarray(mask, dtype=np.bool_))
         self._times.append(np.asarray(times_ns, dtype=np.int64))
         self.n += len(values)
+
+    def layout_name(self) -> str:
+        return "bucketed"
 
     def host_times(self) -> np.ndarray:
         return np.concatenate(self._times) if self._times else np.empty(0, np.int64)

@@ -112,6 +112,11 @@ class AggBatch:
                     sum(a.nbytes for a in self._padded))
         return self._padded
 
+    def layout_name(self) -> str:
+        """Trace label for EXPLAIN ANALYZE (each batch class owns its
+        own name; executor never inspects internals)."""
+        return "scatter"
+
     def host_times(self) -> np.ndarray:
         return (
             np.concatenate(self.times_ns) if self.times_ns else np.empty(0, np.int64)

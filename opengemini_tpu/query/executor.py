@@ -1247,15 +1247,10 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                 "batch_rows", {f: b.n for f, b in batches.items()}
             )
             # EXPLAIN ANALYZE shows which layout actually executed per
-            # field (GridBatch may have fallen back internally)
-            def _layout(b):
-                name = type(b).__name__
-                if name == "GridBatch":
-                    return "grid" if b._state is not None else "grid->bucketed"
-                return {"BucketedBatch": "bucketed",
-                        "IntExactBatch": "int-exact"}.get(name, "scatter")
+            # field (a GridBatch may have fallen back internally, or not
+            # have run at all on a full cache hit)
             sp.add_field(
-                "layouts", {f: _layout(b) for f, b in batches.items()}
+                "layouts", {f: b.layout_name() for f, b in batches.items()}
             )
             STATS.incr("executor", "device_batches", len(aggs))
 
