@@ -618,12 +618,14 @@ class HttpTransport:
     config meta.token) authenticates intra-cluster messages."""
 
     def __init__(self, addr_of: dict[str, str], timeout_s: float = 0.5,
-                 token: str = "", max_queue: int = 256, self_addr: str = ""):
+                 token: str = "", max_queue: int = 256, self_addr: str = "",
+                 path: str = "/raft/msg"):
         import queue
 
         self.addr_of = addr_of
         self.timeout_s = timeout_s
         self.token = token
+        self.path = path
         # advertised in every outgoing message so receivers can learn our
         # address: a joiner only knows its seed, yet must answer the
         # leader's appends — without this, catch-up deadlocks
@@ -665,7 +667,7 @@ class HttpTransport:
                 continue
             try:
                 req = urllib.request.Request(
-                    peers.url(addr, "/raft/msg"),
+                    peers.url(addr, self.path),
                     data=json.dumps(msg).encode("utf-8"),
                     headers={"Content-Type": "application/json"}, method="POST",
                 )

@@ -454,6 +454,9 @@ class DataRouter:
             raise ValueError(
                 f"bad write consistency {write_consistency!r}")
         self.write_consistency = write_consistency
+        # strict replication mode (parallel/datarep.DataReplication) when
+        # [cluster] ha-policy = "replication"; None = write-available
+        self.datarep = None
         self._hint_lock = threading.Lock()
         # last health-probe results: node id -> bool (True = reachable)
         self.health: dict[str, bool] = {}
@@ -637,6 +640,10 @@ class DataRouter:
         a LIVE owner primary — and a live owner holds its synchronous
         copy. rf=1 keeps all-or-error: there is no second copy to lean
         on."""
+        if self.datarep is not None:
+            # strict replication HA policy: every batch raft-commits on
+            # its owner set before the ACK (parallel/datarep.py)
+            return self.datarep.write(db, rp, points)
         level = consistency or self.write_consistency
         if level not in ("any", "one", "quorum", "all"):
             raise ValueError(f"bad consistency level {level!r}")

@@ -171,6 +171,13 @@ def build(cfg: dict) -> HttpService:
                 cluster_cfg.get("write-consistency", "one")),
         )
         svc.executor.router = svc.router
+        if str(cluster_cfg.get("ha-policy", "write-available")) == \
+                "replication":
+            # strict mode: raft-committed writes per replica group
+            from opengemini_tpu.parallel.datarep import DataReplication
+
+            svc.router.datarep = DataReplication(
+                svc.router, token=meta_cfg.get("token", ""))
         if svc.flight is not None:
             svc.flight.router = svc.router
         _spawn_registrar(svc.meta_store, meta_cfg["node-id"], advertise,
@@ -435,6 +442,8 @@ def main(argv=None) -> int:
         svc.flight.stop()
     if svc.meta_store is not None:
         svc.meta_store.stop()
+    if getattr(svc.router, "datarep", None) is not None:
+        svc.router.datarep.stop()
     svc.stop()
     svc.engine.close()
     if args.pidfile:

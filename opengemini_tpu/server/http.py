@@ -445,6 +445,55 @@ def _make_handler(svc: HttpService):
                     self._send_err(403, e)
                     return
                 self._send_json(200, {"ok": True})
+            elif path == "/internal/raftdata":
+                # per-replica-group raft traffic (strict replication mode)
+                dr = getattr(getattr(svc, "router", None), "datarep", None)
+                if dr is None:
+                    self._send_json(404, {"error": "replication mode off"})
+                    return
+                from opengemini_tpu.meta.raft import RaftNode as _RN
+
+                try:
+                    msg = json.loads(self._body())
+                except ValueError:
+                    msg = None
+                if not isinstance(msg, dict):
+                    self._send_json(400, {"error": "bad raft message"})
+                    return
+                if dr.token and msg.pop("token", None) != dr.token:
+                    self._send_json(403, {"error": "bad cluster token"})
+                    return
+                if not dr.token and svc.auth_enabled:
+                    self._send_json(403, {"error": "cluster token required"})
+                    return
+                msg.pop("token", None)
+                msg.pop("addr", None)
+                core = {k: v for k, v in msg.items()
+                        if k not in ("group", "owners")}
+                if not _RN.valid_message(core):
+                    self._send_json(400, {"error": "bad raft message"})
+                    return
+                dr.deliver(msg)
+                self._send(204)
+            elif path == "/internal/raftdata_propose":
+                dr = getattr(getattr(svc, "router", None), "datarep", None)
+                if dr is None:
+                    self._send_json(404, {"error": "replication mode off"})
+                    return
+                try:
+                    req = json.loads(self._body())
+                except ValueError:
+                    req = None
+                if not isinstance(req, dict) or not req.get("db"):
+                    self._send_json(400, {"error": "db required"})
+                    return
+                if dr.token and req.pop("token", None) != dr.token:
+                    self._send_json(403, {"error": "bad cluster token"})
+                    return
+                if not dr.token and svc.auth_enabled:
+                    self._send_json(403, {"error": "cluster token required"})
+                    return
+                self._send_json(200, dr.handle_propose(req))
             elif path == "/internal/migrate":
                 # two-phase shard-group migration (reference engine_ha.go
                 # PreAssign/Assign/Rollback): begin -> staged writes ->

@@ -1,0 +1,181 @@
+"""Strict-consistency replication: raft-committed writes per replica
+group (reference lib/raftconn + engine/partition_raft.go; the
+ha-policy=replication mode)."""
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from opengemini_tpu.parallel.cluster import DataRouter, RemoteScanError
+from opengemini_tpu.parallel.datarep import DataReplication
+from opengemini_tpu.server.http import HttpService
+from opengemini_tpu.storage.engine import Engine
+
+NS = 10**9
+BASE = 1_700_000_000
+
+
+class FsmStub:
+    def __init__(self, addrs):
+        self.nodes = {n: {"addr": a, "role": "data"}
+                      for n, a in addrs.items()}
+
+
+class StoreStub:
+    token = ""
+
+    def __init__(self, addrs):
+        self.fsm = FsmStub(addrs)
+
+
+def _mk_cluster(tmp_path, nids, rf):
+    addrs = {}
+    nodes = {}
+    store = StoreStub(addrs)
+    for nid in nids:
+        e = Engine(str(tmp_path / nid), sync_wal=False)
+        e.create_database("db")
+        svc = HttpService(e, "127.0.0.1", 0)
+        svc.start()
+        addrs[nid] = f"127.0.0.1:{svc.port}"
+        nodes[nid] = (e, svc)
+    store.fsm.nodes = FsmStub(addrs).nodes
+    for nid, (e, svc) in nodes.items():
+        svc.router = DataRouter(e, store, nid, addrs[nid], rf=rf)
+        svc.router.datarep = DataReplication(svc.router)
+        svc.executor.router = svc.router
+        svc.router.probe_health()
+    return nodes, addrs, store
+
+
+def _teardown(nodes):
+    for e, svc in nodes.values():
+        if svc.router.datarep is not None:
+            svc.router.datarep.stop()
+        try:
+            svc.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        e.close()
+
+
+def _write(addrs, nid, lines, timeout=60):
+    req = urllib.request.Request(
+        f"http://{addrs[nid]}/write?db=db", data=lines.encode(),
+        method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status
+
+
+def _rows_on(e):
+    return sum(
+        len(sh.read_series("m", sid).times)
+        for sh in e.shards_for_range("db", None, -(2**62), 2**62)
+        for sid in sh.index.series_ids("m"))
+
+
+def _wait_rows(e, want, timeout=5.0):
+    """Follower apply lags the leader by a heartbeat (raft ACK = majority
+    DURABLY LOGGED + leader applied); poll briefly."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = _rows_on(e)
+        if got == want:
+            return got
+        time.sleep(0.05)
+    return _rows_on(e)
+
+
+def test_write_commits_on_every_replica_synchronously(tmp_path):
+    nodes, addrs, _ = _mk_cluster(tmp_path, ("nA", "nB"), rf=2)
+    try:
+        week = 7 * 86400
+        lines = "\n".join(
+            f"m,host=h{w} v={w} {(BASE + w * week) * NS}" for w in range(6))
+        assert _write(addrs, "nA", lines) == 204
+        # STRICT: the ACK means a majority durably logged the batch;
+        # every replica applies within a heartbeat (no hints, no
+        # anti-entropy round needed)
+        for nid, (e, _svc) in nodes.items():
+            assert _wait_rows(e, 6) == 6, nid
+        for _e, svc in nodes.values():
+            assert not svc.router.pending_hint_nodes()
+        # a write through the OTHER node (leader redirect path) also lands
+        assert _write(addrs, "nB", f"m,host=hx v=99 {BASE * NS}") == 204
+        for nid, (e, _svc) in nodes.items():
+            assert _wait_rows(e, 7) == 7, nid
+    finally:
+        _teardown(nodes)
+
+
+def test_rf3_commits_on_majority_with_member_down(tmp_path):
+    nodes, addrs, _ = _mk_cluster(tmp_path, ("nA", "nB", "nC"), rf=3)
+    try:
+        t = BASE * NS
+        assert _write(addrs, "nA", f"m v=1 {t}") == 204
+        # kill one member: rf=3 majority (2) still commits
+        nodes["nC"][1].stop()
+        for nid in ("nA", "nB"):
+            nodes[nid][1].router.probe_health()
+        assert _write(addrs, "nA", f"m v=2 {t + NS}") == 204
+        assert _wait_rows(nodes["nA"][0], 2) == 2
+        assert _wait_rows(nodes["nB"][0], 2) == 2
+    finally:
+        _teardown(nodes)
+
+
+def test_restart_replays_log_idempotently(tmp_path):
+    nodes, addrs, store = _mk_cluster(tmp_path, ("nA", "nB"), rf=2)
+    try:
+        lines = "\n".join(f"m v={i} {(BASE + i) * NS}" for i in range(5))
+        assert _write(addrs, "nA", lines) == 204
+        assert _wait_rows(nodes["nB"][0], 5) == 5
+        # restart nB: the raft log replays into the engine; LWW keeps the
+        # row set identical (no duplicates, no loss)
+        eB, svcB = nodes.pop("nB")
+        svcB.router.datarep.stop()
+        svcB.stop()
+        eB.close()
+        eB2 = Engine(str(tmp_path / "nB"), sync_wal=False)
+        svcB2 = HttpService(eB2, "127.0.0.1", 0)
+        svcB2.start()
+        store.fsm.nodes["nB"]["addr"] = f"127.0.0.1:{svcB2.port}"
+        svcB2.router = DataRouter(eB2, store, "nB",
+                                  f"127.0.0.1:{svcB2.port}", rf=2)
+        svcB2.router.datarep = DataReplication(svcB2.router)
+        nodes["nB"] = (eB2, svcB2)
+        assert _rows_on(eB2) == 5  # WAL + raft replay converge
+    finally:
+        _teardown(nodes)
+
+
+def test_non_owner_coordinator_first_write(tmp_path):
+    """A coordinator that owns none of the batch's groups must succeed on
+    the FIRST write (cold groups elect while the commit loop retries)."""
+    nodes, addrs, _ = _mk_cluster(tmp_path, ("nA", "nB", "nC"), rf=2)
+    try:
+        from opengemini_tpu.parallel.cluster import owners as _owners
+
+        week = 7 * 86400
+        rA = nodes["nA"][1].router
+        ids = sorted(rA.data_nodes())
+        t = None
+        for w in range(40):
+            cand = (BASE + w * week) * NS
+            start = rA._group_start("db", None, cand)
+            if "nA" not in _owners(ids, "db", "autogen", start, 2):
+                t = cand
+                break
+        assert t is not None
+        assert _write(addrs, "nA", f"m v=7 {t}") == 204
+        own = _owners(ids, "db", "autogen",
+                      rA._group_start("db", None, t), 2)
+        for nid in own:
+            assert _wait_rows(nodes[nid][0], 1) == 1, nid
+        assert _rows_on(nodes["nA"][0]) == 0  # coordinator holds nothing
+    finally:
+        _teardown(nodes)
