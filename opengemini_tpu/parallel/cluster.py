@@ -640,13 +640,14 @@ class DataRouter:
         a LIVE owner primary — and a live owner holds its synchronous
         copy. rf=1 keeps all-or-error: there is no second copy to lean
         on."""
-        if self.datarep is not None:
-            # strict replication HA policy: every batch raft-commits on
-            # its owner set before the ACK (parallel/datarep.py)
-            return self.datarep.write(db, rp, points)
         level = consistency or self.write_consistency
         if level not in ("any", "one", "quorum", "all"):
             raise ValueError(f"bad consistency level {level!r}")
+        if self.datarep is not None:
+            # strict replication HA policy: every batch raft-commits on
+            # its owner set before the ACK (parallel/datarep.py); the
+            # validated consistency param is subsumed by raft majority
+            return self.datarep.write(db, rp, points)
         local, remote = self.split_points(db, rp, points)
         n = 0
         if local:

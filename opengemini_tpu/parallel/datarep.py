@@ -21,6 +21,12 @@ Catch-up beyond log compaction: the write FSM's raft snapshot carries no
 rows (state lives in the engine), so a straggler needing compacted
 entries converges through the rf>1 anti-entropy digest repair instead —
 the compact threshold is set high to make that rare.
+
+Membership changes: owner sets are a pure function of the roster, so a
+roster change simply routes new writes to a NEW group over the new set;
+the old group idles (its log compacts to a marker) and the data itself
+moves via the two-phase migration service. SHOW DIAGNOSTICS lists the
+live groups with their raft state.
 """
 
 from __future__ import annotations
@@ -121,13 +127,18 @@ class ReplicaGroup:
         idx, term = got
         deadline = _time.monotonic() + timeout_s
         while _time.monotonic() < deadline:
-            # applied FIRST: compaction may truncate idx out of the log
-            # right after apply, and entry_term would then read None for
-            # a write that durably committed
-            if self.node.last_applied >= idx:
-                return True
-            if self.node.entry_term(idx) != term:
-                return False  # overwritten after a leader change
+            t = self.node.entry_term(idx)
+            if t == term:
+                if self.node.last_applied >= idx:
+                    return True  # OUR entry, applied
+            else:
+                # t different: overwritten after a leader change — an
+                # applied-first order would falsely ACK once the
+                # OVERWRITING entry applies (a lost write acked). t None:
+                # compacted before we confirmed the term — conservatively
+                # report False; the retry is LWW-idempotent, a false ACK
+                # is not recoverable.
+                return False
             _time.sleep(_TICK_S / 2)
         return False
 
@@ -194,6 +205,17 @@ class DataReplication:
                 grp.stop()
             self.groups.clear()
 
+    def group_status(self) -> list[list]:
+        """Snapshot rows for SHOW DIAGNOSTICS (taken under the lock —
+        lazy group creation mutates self.groups concurrently)."""
+        with self._lock:
+            items = list(self.groups.items())
+        return [
+            [gid, ",".join(g.owner_set), g.node.state,
+             g.node.leader_id or "", len(g.node.log), g.node.last_applied]
+            for gid, g in sorted(items)
+        ]
+
     # -- write path -------------------------------------------------------
 
     def write(self, db: str, rp, points: list) -> int:
@@ -216,27 +238,57 @@ class DataReplication:
             own = tuple(sorted(owners(ids, db, rp_name, start,
                                       self.router.rf)))
             buckets.setdefault(own, []).append(p)
-        n = 0
-        for owner_set, pts in sorted(buckets.items()):
+        # buckets commit through INDEPENDENT raft groups: run them
+        # concurrently (a serial walk would multiply cold-group election
+        # waits by the bucket count), all-or-error semantics unchanged
+        errors: list[Exception] = []
+
+        def commit(owner_set: tuple, pts: list) -> None:
             cmd = {"op": "write", "db": db, "rp": rp_name,
                    "points": encode_points(pts)}
-            if self.router.self_id in owner_set:
-                if not self._commit_local(owner_set, cmd):
-                    raise RemoteScanError(
-                        f"replication commit failed for group "
-                        f"{gid_of(owner_set)} (no quorum?)")
-            else:
-                self._commit_remote(owner_set, cmd)
-            n += len(pts)
-            STATS.incr("cluster", "raft_write_batches")
-        return n
+            try:
+                if self.router.self_id in owner_set:
+                    if not self._commit_local(owner_set, cmd):
+                        raise RemoteScanError(
+                            f"replication commit failed for group "
+                            f"{gid_of(owner_set)} (no quorum?)")
+                else:
+                    self._commit_remote(owner_set, cmd)
+                STATS.incr("cluster", "raft_write_batches")
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+
+        items = sorted(buckets.items())
+        if len(items) == 1:
+            commit(*items[0])
+        else:
+            threads = [
+                threading.Thread(target=commit, args=(own, pts),
+                                 daemon=True)
+                for own, pts in items
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if errors:
+            raise errors[0] if isinstance(
+                errors[0], RemoteScanError) else RemoteScanError(
+                str(errors[0]))
+        return sum(len(pts) for _own, pts in items)
 
     def _commit_local(self, owner_set: tuple, cmd: dict) -> bool:
         grp = self.ensure_group(owner_set)
         deadline = _time.monotonic() + 10.0
         while _time.monotonic() < deadline:
             if grp.is_leader():
-                return grp.propose_and_wait(cmd)
+                remaining = max(deadline - _time.monotonic(), 0.5)
+                if grp.propose_and_wait(cmd, timeout_s=remaining):
+                    return True
+                # deposed between check and propose (or the entry was
+                # overwritten): retrying is LWW-idempotent — keep going
+                # until the deadline instead of failing a live group
+                continue
             hint = grp.node.leader_id
             if hint and hint != self.router.self_id:
                 addr = self._addr_of.get(hint)

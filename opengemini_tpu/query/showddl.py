@@ -447,7 +447,15 @@ class ShowDdlMixin:
                 ["platform", platform.platform()],
                 ["data_dir", self.engine.root],
             ]
-            return _series_result("system", None, ["name", "value"], rows)
+            out = [_series("system", None, ["name", "value"], rows)]
+            dr = getattr(self.router, "datarep", None) if self.router else None
+            if dr is not None:
+                grows = dr.group_status()
+                out.append(_series(
+                    "replication_groups", None,
+                    ["group", "members", "state", "leader", "log_len",
+                     "applied"], grows or [["(none yet)", "", "", "", 0, 0]]))
+            return {"series": out}
         if isinstance(stmt, ast.ShowStreams):
             series = []
             for name in sorted(self.engine.databases):
