@@ -54,6 +54,7 @@ def parse_lines(
     data: str | bytes,
     precision: str = "ns",
     now_ns: int | None = None,
+    expand_tag_arrays: bool = False,
 ) -> list[Point]:
     if isinstance(data, bytes):
         data = data.decode("utf-8", errors="replace")
@@ -67,15 +68,87 @@ def parse_lines(
         line = line.strip("\r ")
         if not line or line.startswith("#"):
             continue
-        points.append(_parse_line(line, lineno, mult, now_ns))
+        p = _parse_line(line, lineno, mult, now_ns,
+                        bracket_tags=expand_tag_arrays)
+        if expand_tag_arrays and any(
+                v.startswith("[") and v.endswith("]") for _k, v in p[1]):
+            points.extend(_expand_tag_arrays(p, lineno))
+        else:
+            points.append(p)
     return points
 
 
-def _parse_line(line: str, lineno: int, mult: int, now_ns: int) -> Point:
+def _expand_tag_arrays(p: Point, lineno: int) -> list[Point]:
+    """openGemini tag arrays (engine/index/tsi/tag_array.go
+    AnalyzeTagSets): a tag value `[a,b]` expands the point into one
+    series per POSITION — every array tag on the line must carry the
+    same element count, scalar tags replicate. `cpu,host=[a,b],az=[1,2]`
+    -> (host=a, az=1) and (host=b, az=2)."""
+    mst, tags, t_ns, fields = p
+    arr_len = 0
+    split: dict[str, list[str]] = {}
+    for k, v in tags:
+        if v.startswith("[") and v.endswith("]"):
+            vals = v[1:-1].split(",")
+            if arr_len == 0:
+                arr_len = len(vals)
+            elif len(vals) != arr_len:
+                raise ParseError(
+                    lineno, "tag arrays on one line must have equal "
+                    f"lengths ({len(vals)} vs {arr_len})")
+            split[k] = vals
+    out = []
+    for i in range(arr_len):
+        # empty array elements drop like empty scalar tag values (the
+        # parser's 'influx drops empty tag values' rule)
+        row_tags = tuple(
+            (k, split[k][i] if k in split else v) for k, v in tags
+            if (split[k][i] if k in split else v))
+        out.append((mst, row_tags, t_ns, fields))
+    return out
+
+
+def _split_bracket_aware(s: str) -> list[str]:
+    """Split on ',' outside [...] — tag-array values carry commas
+    (`host=[a,b]`). Only used with tag-array expansion on; escapes inside
+    array brackets are not supported (matches the reference's
+    unmarshalTags array path)."""
+    parts: list[str] = []
+    cur: list[str] = []
+    depth = 0
+    esc = False
+    for ch in s:
+        if esc:  # escaped char: literal, never a separator
+            cur.append(ch)
+            esc = False
+            continue
+        if ch == "\\":
+            cur.append(ch)
+            esc = True
+            continue
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth = max(depth - 1, 0)
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
+def _parse_line(line: str, lineno: int, mult: int, now_ns: int,
+                bracket_tags: bool = False) -> Point:
     key_part, fields_part, ts_part = _split_sections(line, lineno)
 
     # measurement + tags
-    if "\\" in key_part:
+    if bracket_tags and "[" in key_part:
+        segs = _split_bracket_aware(key_part)
+        measurement = _unescape(segs[0]) if "\\" in segs[0] else segs[0]
+        raw_tags = segs[1:]
+    elif "\\" in key_part:
         segs = _split_escaped(key_part, ",")
         measurement = _unescape(segs[0])
         raw_tags = segs[1:]

@@ -130,7 +130,10 @@ class ReplicaGroup:
             t = self.node.entry_term(idx)
             if t == term:
                 if self.node.last_applied >= idx:
-                    return True  # OUR entry, applied
+                    # re-check the term AFTER observing applied: an
+                    # overwrite + apply can land between the two reads
+                    # (compacted-now reads None -> conservative False)
+                    return self.node.entry_term(idx) == term
             else:
                 # t different: overwritten after a leader change — an
                 # applied-first order would falsely ACK once the

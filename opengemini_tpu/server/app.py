@@ -81,6 +81,7 @@ def build(cfg: dict) -> HttpService:
         data["dir"],
         sync_wal=bool(data.get("wal-fsync", False)),
         flush_threshold_bytes=int(data.get("flush-threshold-mb", 64)) << 20,
+        tag_arrays=bool(data.get("enable-tag-array", False)),
     )
     host, _, port = cfg["http"]["bind-address"].partition(":")
     http_cfg = cfg["http"]

@@ -158,10 +158,15 @@ class Engine:
         root: str,
         sync_wal: bool = False,
         flush_threshold_bytes: int = 64 << 20,
+        tag_arrays: bool = False,
     ):
         self.root = root
         self.sync_wal = sync_wal
         self.flush_threshold_bytes = flush_threshold_bytes
+        # openGemini tag-array expansion (`host=[a,b]`), opt-in like the
+        # reference's per-database enableTagArray — brackets are legal
+        # literal tag bytes when off
+        self.tag_arrays = tag_arrays
         os.makedirs(root, exist_ok=True)
         self._lock = threading.RLock()
         # syscontrol toggles (reference: lib/syscontrol disable write/read)
@@ -336,7 +341,8 @@ class Engine:
                 for g in os.listdir(os.path.join(data_dir, db, rp)):
                     start = int(g)
                     self._shards[(db, rp, start)] = Shard(
-                        self._shard_dir(db, rp, start), start, start + dur, self.sync_wal
+                        self._shard_dir(db, rp, start), start, start + dur,
+                        self.sync_wal, tag_arrays=self.tag_arrays,
                     )
 
     def _get_or_create_shard(self, db: str, rp: str, t_ns: int) -> Shard:
@@ -363,6 +369,7 @@ class Engine:
                 group_start,
                 group_start + dur,
                 self.sync_wal,
+                tag_arrays=self.tag_arrays,
             )
             self._shards[key] = shard
         return shard
@@ -488,7 +495,8 @@ class Engine:
                 return
             path = os.path.join(self._staging_root(), mig_id)
             dur = rp_meta.shard_duration_ns
-            sh = Shard(path, group_start, group_start + dur, self.sync_wal)
+            sh = Shard(path, group_start, group_start + dur,
+                       self.sync_wal, tag_arrays=self.tag_arrays)
             self._staging[mig_id] = [db, rp or d.default_rp, group_start, sh,
                                      _time.time()]
 
@@ -636,7 +644,8 @@ class Engine:
         d = self.databases[db]
         dur = d.rps[rp].shard_duration_ns
         shard = Shard(self._shard_dir(db, rp, group_start), group_start,
-                      group_start + dur, self.sync_wal)
+                      group_start + dur, self.sync_wal,
+                      tag_arrays=self.tag_arrays)
         self._shards[key] = shard
         self.obs_shards.discard(key)
         if save:
@@ -732,7 +741,10 @@ class Engine:
         # library is absent.
         from opengemini_tpu.ingest import native_lp
 
-        batch = native_lp.parse_columnar(raw, precision, now_ns)
+        batch = None
+        if not (self.tag_arrays and b"=[" in raw):
+            # tag-array batches take the exact Python parser (expansion)
+            batch = native_lp.parse_columnar(raw, precision, now_ns)
         if batch is not None:
             if len(batch) == 0:
                 return 0
@@ -744,7 +756,8 @@ class Engine:
                 self._notify_write(db, rp, batch.to_points())
             return n
 
-        points = lp.parse_lines(lines, precision, now_ns)
+        points = lp.parse_lines(lines, precision, now_ns,
+                                expand_tag_arrays=self.tag_arrays)
         if not points:
             return 0
         STATS.incr("write", "points", len(points))

@@ -145,8 +145,10 @@ _MUT_LOG_MAX = 512  # bounded mutation history; overflow = assume-changed
 class Shard:
     supports_preagg = True  # RemoteShard proxies set False (no chunk meta)
 
-    def __init__(self, path: str, tmin: int, tmax: int, sync_wal: bool = False):
+    def __init__(self, path: str, tmin: int, tmax: int, sync_wal: bool = False,
+                 tag_arrays: bool = False):
         self.path = path
+        self.tag_arrays = tag_arrays  # WAL replay must expand like ingest
         self.tmin = tmin  # inclusive ns
         self.tmax = tmax  # exclusive ns
         os.makedirs(path, exist_ok=True)
@@ -232,7 +234,9 @@ class Shard:
                 _, lines, precision, now_ns = entry
                 batch = None
                 try:
-                    batch = native_lp.parse_columnar(lines, precision, now_ns)
+                    if not (self.tag_arrays and b"=[" in lines):
+                        batch = native_lp.parse_columnar(
+                            lines, precision, now_ns)
                 except lp.ParseError:
                     batch = None
                 if batch is not None:
@@ -243,7 +247,8 @@ class Shard:
                         # time must not poison replay either
                         pass
                     continue
-                points = lp.parse_lines(lines, precision, now_ns)
+                points = lp.parse_lines(lines, precision, now_ns,
+                                        expand_tag_arrays=self.tag_arrays)
             else:
                 points = entry[1]
             for p in points:

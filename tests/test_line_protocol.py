@@ -108,3 +108,57 @@ def test_parse_error_carries_line_number():
 def test_series_key():
     assert lp.series_key("cpu", (("a", "1"), ("b", "2"))) == "cpu,a=1,b=2"
     assert lp.series_key("cpu", ()) == "cpu"
+
+
+class TestTagArrays:
+    """openGemini tag arrays (reference engine/index/tsi/tag_array.go
+    AnalyzeTagSets): `host=[a,b]` expands position-aligned, opt-in via
+    [data] enable-tag-array."""
+
+    def test_expansion_semantics(self):
+        from opengemini_tpu.ingest.line_protocol import ParseError, parse_lines
+
+        pts = parse_lines(
+            "cpu,host=[a,b],az=[1,2],dc=west v=5 100",
+            expand_tag_arrays=True)
+        assert len(pts) == 2
+        # tags are canonically sorted
+        assert pts[0][1] == (("az", "1"), ("dc", "west"), ("host", "a"))
+        assert pts[1][1] == (("az", "2"), ("dc", "west"), ("host", "b"))
+        assert all(p[3]["v"][1] == 5.0 for p in pts)
+        # mismatched lengths error (the reference's ErrorTagArrayFormat)
+        import pytest as _pytest
+
+        with _pytest.raises(ParseError):
+            parse_lines("cpu,host=[a,b],az=[1,2,3] v=5 100",
+                        expand_tag_arrays=True)
+        # flag off: comma-in-brackets errors exactly like the native
+        # parser (bit-parity); commaless brackets stay literal bytes
+        with _pytest.raises(ParseError):
+            parse_lines("cpu,host=[a,b] v=5 100")
+        lit = parse_lines("cpu,host=[ab] v=5 100")
+        assert lit[0][1] == (("host", "[ab]"),)
+
+    def test_engine_end_to_end_with_replay(self, tmp_path):
+        from opengemini_tpu.query.executor import Executor
+        from opengemini_tpu.storage.engine import Engine
+
+        NS = 10**9
+        B = 1_700_000_040
+        e = Engine(str(tmp_path), sync_wal=False, tag_arrays=True)
+        e.create_database("d")
+        e.write_lines("d", f"cpu,host=[a,b] v=7 {B * NS}")
+        ex = Executor(e)
+        r = ex.execute("SHOW SERIES", db="d")
+        keys = [v[0] for v in r["results"][0]["series"][0]["values"]]
+        assert keys == ["cpu,host=a", "cpu,host=b"], keys
+        r2 = ex.execute("SELECT v FROM cpu WHERE host = 'b'", db="d")
+        assert r2["results"][0]["series"][0]["values"][0][1] == 7.0
+        e.close()
+        # crash replay (no flush): the WAL re-parse must expand too
+        e2 = Engine(str(tmp_path), sync_wal=False, tag_arrays=True)
+        ex2 = Executor(e2)
+        r3 = ex2.execute("SHOW SERIES", db="d")
+        keys3 = [v[0] for v in r3["results"][0]["series"][0]["values"]]
+        assert keys3 == ["cpu,host=a", "cpu,host=b"], keys3
+        e2.close()
