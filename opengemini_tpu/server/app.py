@@ -400,11 +400,62 @@ def _apply_runtime_config(svc: HttpService, cfg: dict) -> list[str]:
     return changed
 
 
+def _ensure_device_backend(timeout_s: float = 20.0) -> None:
+    """Degrade to CPU when the configured accelerator backend is broken.
+
+    Some environments pin a device platform (e.g. via sitecustomize)
+    whose plugin fails to load or hangs at init in a server process; the
+    first query would then crash or block forever. Probe the default
+    backend in a SUBPROCESS under a timeout (an in-process jax.devices()
+    on a hung tunnel is not interruptible) and force the CPU platform
+    before any in-process jax use when the probe fails. Production hosts
+    with working devices are unaffected. Only the CLI entrypoint probes:
+    embedders calling build() pick their own platform, and tests pin CPU
+    in conftest. OGTPU_SKIP_BACKEND_PROBE=1 skips the probe (known-good
+    device; also avoids serial probe cost when spawning many servers);
+    OGTPU_BACKEND_PROBE_TIMEOUT raises the budget on slow hosts where a
+    healthy device could miss the default window."""
+    if os.environ.get("OGTPU_SKIP_BACKEND_PROBE"):
+        return
+    import subprocess
+
+    try:
+        timeout_s = float(os.environ.get("OGTPU_BACKEND_PROBE_TIMEOUT",
+                                         timeout_s))
+    except ValueError:
+        print("ignoring non-numeric OGTPU_BACKEND_PROBE_TIMEOUT", flush=True)
+    code = ("import jax, jax.numpy as jnp;"
+            "jnp.ones((2,), jnp.float32).sum().block_until_ready();"
+            "print('OK', jax.default_backend())")
+    why = None
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+        if r.returncode != 0 or "OK" not in r.stdout:
+            lines = (r.stderr or r.stdout).strip().splitlines()
+            errs = [ln for ln in lines if "Error" in ln] or lines[-1:]
+            detail = errs[-1].strip() if errs else "no output"
+            why = f"probe exited {r.returncode}: {detail}"
+    except subprocess.TimeoutExpired:
+        why = f"probe timed out after {timeout_s:g}s (device init hung)"
+    except OSError as exc:
+        why = f"probe failed to spawn: {exc}"
+    if why is not None:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(f"device backend unavailable ({why}); serving on CPU "
+              "[set OGTPU_SKIP_BACKEND_PROBE=1 or "
+              "OGTPU_BACKEND_PROBE_TIMEOUT to override]", flush=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ts-server", description="opengemini-tpu all-in-one server")
     ap.add_argument("-config", default=None, help="TOML config path")
     ap.add_argument("-pidfile", default=None, help="write process id to this file")
     args = ap.parse_args(argv)
+    _ensure_device_backend()
     svc = build(load_config(args.config))
     svc.start()
     if svc.flight is not None:

@@ -546,3 +546,95 @@ class TestErrnoTaxonomy:
         doc = json.loads(body)
         assert doc["errno"] == errno.WRITE_DB_NOT_FOUND
         assert doc["module"] == "write"
+
+
+class TestBackendProbe:
+    """Startup device probe (server.app._ensure_device_backend): a broken
+    or hung accelerator plugin must degrade the server to CPU instead of
+    crashing the first query."""
+
+    def test_skip_env_short_circuits(self, monkeypatch):
+        import subprocess
+
+        from opengemini_tpu.server import app as appmod
+
+        monkeypatch.setenv("OGTPU_SKIP_BACKEND_PROBE", "1")
+
+        def boom(*a, **k):  # probe must not even spawn
+            raise AssertionError("probe ran despite skip env")
+
+        monkeypatch.setattr(subprocess, "run", boom)
+        appmod._ensure_device_backend(timeout_s=0.1)
+
+    def test_failed_probe_forces_cpu_with_reason(self, monkeypatch, capsys):
+        import subprocess
+
+        import jax
+
+        from opengemini_tpu.server import app as appmod
+
+        monkeypatch.delenv("OGTPU_SKIP_BACKEND_PROBE", raising=False)
+
+        class R:
+            returncode = 1
+            stdout = ""
+            stderr = "boilerplate\nRuntimeError: Unable to initialize backend 'axon'\nfootnote"
+
+        monkeypatch.setattr(subprocess, "run", lambda *a, **k: R())
+        appmod._ensure_device_backend(timeout_s=1.0)
+        # conftest already pins cpu, so the forced value is a no-op here
+        assert jax.config.jax_platforms == "cpu"
+        out = capsys.readouterr().out
+        assert "serving on CPU" in out
+        assert "Unable to initialize backend 'axon'" in out
+        assert "footnote" not in out  # only the error line, not the tail
+
+    def test_timeout_reported_as_hang(self, monkeypatch, capsys):
+        import subprocess
+
+        from opengemini_tpu.server import app as appmod
+
+        monkeypatch.delenv("OGTPU_SKIP_BACKEND_PROBE", raising=False)
+
+        def hang(*a, **k):
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=1.0)
+
+        monkeypatch.setattr(subprocess, "run", hang)
+        appmod._ensure_device_backend(timeout_s=1.0)
+        out = capsys.readouterr().out
+        assert "timed out" in out and "serving on CPU" in out
+
+    def test_healthy_probe_leaves_platform_alone(self, monkeypatch, capsys):
+        import subprocess
+
+        from opengemini_tpu.server import app as appmod
+
+        monkeypatch.delenv("OGTPU_SKIP_BACKEND_PROBE", raising=False)
+
+        class R:
+            returncode = 0
+            stdout = "OK tpu\n"
+            stderr = ""
+
+        monkeypatch.setattr(subprocess, "run", lambda *a, **k: R())
+        appmod._ensure_device_backend(timeout_s=1.0)
+        assert "serving on CPU" not in capsys.readouterr().out
+
+    def test_silent_probe_death_and_bad_timeout_env(self, monkeypatch, capsys):
+        import subprocess
+
+        from opengemini_tpu.server import app as appmod
+
+        monkeypatch.delenv("OGTPU_SKIP_BACKEND_PROBE", raising=False)
+        monkeypatch.setenv("OGTPU_BACKEND_PROBE_TIMEOUT", "20s")  # non-numeric
+
+        class R:  # plugin segfault: no output on either stream
+            returncode = -11
+            stdout = ""
+            stderr = ""
+
+        monkeypatch.setattr(subprocess, "run", lambda *a, **k: R())
+        appmod._ensure_device_backend(timeout_s=1.0)  # must not raise
+        out = capsys.readouterr().out
+        assert "ignoring non-numeric" in out
+        assert "no output" in out and "serving on CPU" in out
