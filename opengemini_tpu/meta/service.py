@@ -80,6 +80,35 @@ class MetaFSM:
                 }
                 if cmd.get("default"):
                     db["default_rp"] = cmd["name"]
+        elif op == "alter_rp":
+            db = self.databases.get(cmd["db"])
+            if db is not None and cmd["name"] in db["rps"]:
+                rp = db["rps"][cmd["name"]]
+                new_dur = rp.get("duration_ns", 0) \
+                    if cmd.get("duration_ns") is None else cmd["duration_ns"]
+                new_sd = rp.get("shard_duration_ns") \
+                    if cmd.get("shard_duration_ns") is None \
+                    else cmd["shard_duration_ns"]
+                if new_sd is None:
+                    # CREATE RP without SHARD DURATION stores None here but
+                    # the engine auto-computed one — mirror it so this guard
+                    # agrees with the engine's own rejection
+                    from opengemini_tpu.storage.engine import (
+                        _auto_shard_duration,
+                    )
+
+                    new_sd = _auto_shard_duration(rp.get("duration_ns", 0))
+                if new_dur and new_sd and new_dur < new_sd:
+                    # two alters validated against stale state can commit a
+                    # violating combination; the engine rejects it too —
+                    # no-op so FSM and engines stay consistent
+                    pass
+                else:
+                    rp["duration_ns"] = new_dur
+                    if new_sd is not None:
+                        rp["shard_duration_ns"] = new_sd
+                    if cmd.get("default"):
+                        db["default_rp"] = cmd["name"]
         elif op == "drop_rp":
             db = self.databases.get(cmd["db"])
             if db is not None:
@@ -456,6 +485,12 @@ class MetaStore:
                             )
                         else:
                             d.rps[rp].duration_ns = rpmeta.get("duration_ns", 0)
+                            # shard duration is mutable via ALTER RETENTION
+                            # POLICY — sync it too, or a snapshot-restored
+                            # replica lays out new shard groups differently
+                            sd = rpmeta.get("shard_duration_ns")
+                            if sd:
+                                d.rps[rp].shard_duration_ns = sd
                     for rp in list(d.rps):
                         if rp not in rps:
                             engine.drop_retention_policy(name, rp)
@@ -509,6 +544,22 @@ class MetaStore:
                         cmd["db"], cmd["name"], cmd.get("duration_ns", 0),
                         cmd.get("shard_duration_ns"), cmd.get("default", False),
                     )
+            elif op == "alter_rp":
+                if cmd["db"] in engine.databases:
+                    try:
+                        engine.alter_retention_policy(
+                            cmd["db"], cmd["name"], cmd.get("duration_ns"),
+                            cmd.get("shard_duration_ns"),
+                            cmd.get("default", False),
+                        )
+                    except ValueError as e:
+                        # rp vanished between commit and apply, or a
+                        # stale-validated alter the FSM also no-opped —
+                        # log it; silently diverging would be worse
+                        import logging
+
+                        logging.getLogger("opengemini_tpu.meta").warning(
+                            "alter_rp skipped by engine: %s", e)
             elif op == "drop_rp":
                 engine.drop_retention_policy(cmd["db"], cmd["name"])
             elif op == "create_cq":

@@ -53,11 +53,44 @@ HOST_AGGS = {"mode", "integral", "sum", "count", "mean", "min", "max",
 MULTI_ROW = {"top", "bottom", "sample", "distinct", "detect"}
 
 
+def _dedup_duplicate_times(times: np.ndarray, values: np.ndarray):
+    """Collapse runs of equal timestamps to one point (several series can
+    share an instant in a merged raw sequence). The reference
+    difference/derivative iterators keep the first point per distinct
+    timestamp and skip the rest (agg_iterator.gen.go
+    FloatDifferenceItem.AppendItemFastFunc: `if st == times[i] {continue}`);
+    its merge heap breaks time ties arbitrarily (merge_transform.go
+    HeapItems.Less is non-strict on equal keys), and the acceptance output
+    (TestServer_difference_derivative_time_duplicate) has the smallest
+    value winning — made deterministic here."""
+    if len(times) < 2:
+        return times, values
+    change = np.empty(len(times), bool)
+    change[0] = True
+    np.not_equal(times[1:], times[:-1], out=change[1:])
+    if change.all():
+        return times, values
+    starts = np.flatnonzero(change)
+    ends = np.append(starts[1:], len(times))
+    keep = np.array([s + int(np.argmin(values[s:e]))
+                     for s, e in zip(starts, ends)])
+    return times[keep], values[keep]
+
+
+# transforms whose reference iterators skip duplicate timestamps
+_DEDUP_TRANSFORMS = {
+    "difference", "non_negative_difference",
+    "derivative", "non_negative_derivative",
+}
+
+
 def transform(name: str, times: np.ndarray, values: np.ndarray, params: tuple):
     """Apply a transform over one (time-sorted) sequence; None values must
     already be removed. Returns (times, values)."""
     if len(times) == 0:
         return times, values
+    if name in _DEDUP_TRANSFORMS:
+        times, values = _dedup_duplicate_times(times, values)
     if name in ("derivative", "non_negative_derivative"):
         unit_ns = params[0] if params else NS
         if len(times) < 2:

@@ -35,6 +35,16 @@ from opengemini_tpu.query.qhelpers import (  # noqa: F401
     NS, MAX_SELECT_BUCKETS, QueryError,
 )
 
+_MIN_RP_DURATION_NS = 3600 * NS
+
+
+def _check_rp_min_duration(duration_ns: int | None) -> None:
+    """Influx rejects retention durations below 1h (0 = INF is allowed):
+    'retention policy duration must be at least 1h0m0s'."""
+    if duration_ns and duration_ns < _MIN_RP_DURATION_NS:
+        raise QueryError(
+            "retention policy duration must be at least 1h0m0s")
+
 
 class ShowDdlMixin:
     def _replicate_ddl(self, cmd: dict) -> bool:
@@ -289,6 +299,7 @@ class ShowDdlMixin:
         if isinstance(stmt, ast.CreateRetentionPolicy):
             tgt = stmt.database or db
             self._check_fsm_db(tgt)
+            _check_rp_min_duration(stmt.duration_ns)
             cmd = {
                 "op": "create_rp", "db": tgt, "name": stmt.name,
                 "duration_ns": stmt.duration_ns,
@@ -300,6 +311,50 @@ class ShowDdlMixin:
                     tgt, stmt.name, stmt.duration_ns,
                     stmt.shard_duration_ns, stmt.default,
                 )
+            return {}
+        if isinstance(stmt, ast.AlterRetentionPolicy):
+            tgt = stmt.database or db
+            self._check_fsm_db(tgt)
+            _check_rp_min_duration(stmt.duration_ns)
+            if self.meta_store is not None:
+                # validate against FSM state before proposing: the raft
+                # apply path is fire-and-forget, so a bad alter would
+                # otherwise succeed silently in a cluster
+                from opengemini_tpu.storage.engine import _auto_shard_duration
+
+                fsm_db = self.meta_store.fsm.databases[tgt]
+                rp = fsm_db.get("rps", {}).get(stmt.name)
+                if rp is None:
+                    raise QueryError(
+                        f"retention policy not found: {stmt.name}")
+                cur_dur = rp.get("duration_ns", 0)
+                new_dur = cur_dur if stmt.duration_ns is None \
+                    else stmt.duration_ns
+                new_sd = stmt.shard_duration_ns
+                if new_sd is None:
+                    # the FSM stores None when CREATE RP omitted SHARD
+                    # DURATION (and autogen has no key) — the engine
+                    # auto-computed it; mirror that here
+                    new_sd = rp.get("shard_duration_ns") \
+                        or _auto_shard_duration(cur_dur)
+                if new_dur and new_dur < new_sd:
+                    raise QueryError(
+                        "retention policy duration must be greater than "
+                        "the shard duration")
+            cmd = {
+                "op": "alter_rp", "db": tgt, "name": stmt.name,
+                "duration_ns": stmt.duration_ns,
+                "shard_duration_ns": stmt.shard_duration_ns,
+                "default": stmt.default,
+            }
+            if not self._replicate_ddl(cmd):
+                try:
+                    self.engine.alter_retention_policy(
+                        tgt, stmt.name, stmt.duration_ns,
+                        stmt.shard_duration_ns, stmt.default,
+                    )
+                except ValueError as e:
+                    raise QueryError(str(e)) from None
             return {}
         if isinstance(stmt, ast.DropRetentionPolicy):
             cmd = {"op": "drop_rp", "db": stmt.database or db, "name": stmt.name}

@@ -284,6 +284,21 @@ class CreateRetentionPolicy:
 
 
 @dataclass
+class AlterRetentionPolicy:
+    """ALTER RETENTION POLICY name ON db [DURATION d] [REPLICATION n]
+    [SHARD DURATION d] [DEFAULT] — None fields stay unchanged.
+    Reference: lib/util/lifted/influx/influxql/parser.go:393
+    (parseAlterRetentionPolicyStatement)."""
+
+    database: str = ""
+    name: str = ""
+    duration_ns: int | None = None
+    shard_duration_ns: int | None = None
+    replication: int | None = None
+    default: bool = False
+
+
+@dataclass
 class DropRetentionPolicy:
     database: str = ""
     name: str = ""

@@ -14,7 +14,8 @@ KEYWORDS = {
     "select", "from", "where", "group", "by", "fill", "limit", "offset",
     "slimit", "soffset", "order", "asc", "desc", "and", "or", "not", "show",
     "databases", "measurements", "tag", "values", "keys", "field", "fields",
-    "series", "retention", "policies", "policy", "create", "drop", "database",
+    "series", "retention", "policies", "policy", "create", "drop", "alter",
+    "database",
     "with", "key", "in", "on", "duration", "replication", "shard", "default",
     "into", "true", "false", "null", "none", "previous", "linear", "tz",
     "measurement", "delete", "as", "name", "continuous", "query", "queries",

@@ -193,6 +193,8 @@ class Parser:
             return self.parse_create()
         if tok.val == "drop":
             return self.parse_drop()
+        if tok.val == "alter":
+            return self.parse_alter()
         if tok.val == "grant":
             return self.parse_grant()
         if tok.val == "revoke":
@@ -978,6 +980,48 @@ class Parser:
                 stmt.default = True
             else:
                 break
+        return stmt
+
+    def parse_alter(self):
+        """ALTER RETENTION POLICY name ON db with any subset of DURATION /
+        REPLICATION / SHARD DURATION / DEFAULT, in any order (influxql
+        allows that; reference parser.go:393)."""
+        self._expect_kw("alter")
+        self._expect_kw("retention")
+        self._expect_kw("policy")
+        name = self._ident()
+        self._expect_kw("on")
+        stmt = ast.AlterRetentionPolicy(database=self._ident(), name=name)
+        saw = False
+        while True:
+            if self._accept_kw("duration"):
+                tok = self.lex.next()
+                if tok.kind == "DURATION":
+                    stmt.duration_ns = tok.val
+                elif tok.kind == "INTEGER" and tok.val == 0:
+                    stmt.duration_ns = 0
+                else:
+                    raise ParseError("DURATION expects a duration")
+            elif self._accept_kw("replication"):
+                rtok = self.lex.next()
+                if rtok.kind != "INTEGER":
+                    raise ParseError("REPLICATION expects an integer")
+                stmt.replication = rtok.val
+            elif self._accept_kw("shard"):
+                self._expect_kw("duration")
+                t = self.lex.next()
+                if t.kind != "DURATION":
+                    raise ParseError("SHARD DURATION expects a duration")
+                stmt.shard_duration_ns = t.val
+            elif self._accept_kw("default"):
+                stmt.default = True
+            else:
+                break
+            saw = True
+        if not saw:
+            raise ParseError(
+                "ALTER RETENTION POLICY requires at least one of "
+                "DURATION/REPLICATION/SHARD DURATION/DEFAULT")
         return stmt
 
     def parse_drop(self):

@@ -321,6 +321,35 @@ class Engine:
                 d.default_rp = name
             self._save_meta()
 
+    def alter_retention_policy(
+        self, db: str, name: str, duration_ns: int | None = None,
+        shard_duration_ns: int | None = None, default: bool = False,
+    ) -> None:
+        """Mutate an existing RP in place; None fields stay as they are.
+        New shard duration only affects shard groups created after the
+        change, matching influx semantics."""
+        with self._lock:
+            d = self.databases.get(db)
+            if d is None:
+                raise DatabaseNotFound(db)
+            rp = d.rps.get(name)
+            if rp is None:
+                raise ValueError(f"retention policy not found: {name}")
+            new_dur = rp.duration_ns if duration_ns is None else duration_ns
+            new_sd = rp.shard_duration_ns if shard_duration_ns is None \
+                else shard_duration_ns
+            if new_dur and new_dur < new_sd:
+                # influx rejects this combination rather than silently
+                # rewriting the shard layout (ErrIncompatibleDurations)
+                raise ValueError(
+                    "retention policy duration must be greater than the "
+                    "shard duration")
+            rp.duration_ns = new_dur
+            rp.shard_duration_ns = new_sd
+            if default:
+                d.default_rp = name
+            self._save_meta()
+
     def database_names(self) -> list[str]:
         return sorted(self.databases)
 

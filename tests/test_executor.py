@@ -266,6 +266,21 @@ class TestShowAndDDL:
         names = [r[0] for r in s["values"]]
         assert "autogen" in names and "rp1" in names
 
+    def test_alter_retention_policy(self, env):
+        e, ex = env
+        q(ex, "CREATE RETENTION POLICY rp1 ON db DURATION 30d REPLICATION 1")
+        q(ex, "ALTER RETENTION POLICY rp1 ON db DURATION 60d SHARD DURATION 2d DEFAULT")
+        s = series_of(q(ex, "SHOW RETENTION POLICIES ON db"))
+        row = next(r for r in s["values"] if r[0] == "rp1")
+        assert row[1] == "1440h0m0s"   # 60d duration
+        assert row[2] == "48h0m0s"     # 2d shard duration
+        assert row[-1] is True         # default
+        res = q(ex, "ALTER RETENTION POLICY nope ON db DURATION 1d")
+        assert "not found" in res["results"][0]["error"]
+        # influx rejects a duration below the shard duration
+        res = q(ex, "ALTER RETENTION POLICY rp1 ON db DURATION 1h")
+        assert "shard duration" in res["results"][0]["error"]
+
     def test_statement_error_reported_per_statement(self, env):
         e, ex = env
         res = q(ex, "SELECT v FROM missing_db_measurement; SHOW DATABASES")

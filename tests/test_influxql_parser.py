@@ -134,6 +134,22 @@ def test_create_drop():
     assert (s.name, s.database) == ("rp1", "mydb")
 
 
+def test_alter_retention_policy():
+    s = parse_one("ALTER RETENTION POLICY rp1 ON mydb DURATION 2w")
+    assert isinstance(s, ast.AlterRetentionPolicy)
+    assert (s.name, s.database) == ("rp1", "mydb")
+    assert s.duration_ns == 14 * 86400 * NS
+    assert s.shard_duration_ns is None and s.replication is None
+    s = parse_one(
+        "ALTER RETENTION POLICY rp1 ON mydb SHARD DURATION 2h REPLICATION 3 DEFAULT"
+    )
+    assert s.duration_ns is None
+    assert s.shard_duration_ns == 2 * 3600 * NS
+    assert s.replication == 3 and s.default is True
+    with pytest.raises(ValueError):
+        parse_one("ALTER RETENTION POLICY rp1 ON mydb")
+
+
 def test_fill_variants():
     for opt in ("null", "none", "previous", "linear"):
         s = parse_one(f"SELECT mean(v) FROM m GROUP BY time(1m) fill({opt})")

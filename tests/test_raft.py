@@ -322,11 +322,24 @@ class TestReplicatedDDL:
                 db="",
             )
             assert all("error" not in r for r in res["results"]), res
+            # invalid alters are rejected at the leader BEFORE proposing
+            res = ex.execute(
+                "ALTER RETENTION POLICY nope ON replicated DURATION 2d", db="")
+            assert "not found" in res["results"][0]["error"], res
+            res = ex.execute(
+                "ALTER RETENTION POLICY rp1 ON replicated DURATION 1h", db="")
+            assert "shard duration" in res["results"][0]["error"], res
+            res = ex.execute(
+                "ALTER RETENTION POLICY rp1 ON replicated DURATION 60d "
+                "SHARD DURATION 2d DEFAULT", db="")
+            assert "error" not in res["results"][0], res
             deadline = _time.time() + 30
             while (
                 any(
                     "replicated" not in e.databases
                     or "rp1" not in e.databases["replicated"].rps
+                    or e.databases["replicated"].rps["rp1"].duration_ns
+                    != 60 * 86400 * 1_000_000_000
                     for e in engines.values()
                 )
                 and _time.time() < deadline
@@ -337,7 +350,11 @@ class TestReplicatedDDL:
             pumper.join(timeout=5)
         for nid, eng in engines.items():
             assert "replicated" in eng.databases, nid
-            assert "rp1" in eng.databases["replicated"].rps, nid
+            rp = eng.databases["replicated"].rps.get("rp1")
+            assert rp is not None, nid
+            assert rp.duration_ns == 60 * 86400 * 1_000_000_000, nid
+            assert rp.shard_duration_ns == 2 * 86400 * 1_000_000_000, nid
+            assert eng.databases["replicated"].default_rp == "rp1", nid
         # follower DDL is rejected with a leader hint
         follower_id = next(i for i in nodes if i != leader.id)
         ex_f = Executor(engines[follower_id], meta_store=stores[follower_id])
