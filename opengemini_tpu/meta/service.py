@@ -89,15 +89,18 @@ class MetaFSM:
                 new_sd = rp.get("shard_duration_ns") \
                     if cmd.get("shard_duration_ns") is None \
                     else cmd["shard_duration_ns"]
-                if new_sd is None:
+                if not new_sd:
                     # CREATE RP without SHARD DURATION stores None here but
                     # the engine auto-computed one — mirror it so this guard
-                    # agrees with the engine's own rejection
+                    # agrees with the engine's own rejection (explicit 0 =
+                    # recompute, same as the engine)
                     from opengemini_tpu.storage.engine import (
                         _auto_shard_duration,
                     )
 
-                    new_sd = _auto_shard_duration(rp.get("duration_ns", 0))
+                    new_sd = _auto_shard_duration(
+                        rp.get("duration_ns", 0)
+                        if cmd.get("shard_duration_ns") != 0 else new_dur)
                 if new_dur and new_sd and new_dur < new_sd:
                     # two alters validated against stale state can commit a
                     # violating combination; the engine rejects it too —

@@ -857,40 +857,208 @@ class PromEngine:
         lhs = self._eval(node.lhs, steps, db)
         rhs = self._eval(node.rhs, steps, db)
         op = node.op
+        k = len(steps)
+        if op in pp.SET_OPS:
+            if lhs.is_scalar or rhs.is_scalar:
+                raise PromError(
+                    f"set operator {op!r} not allowed in binary scalar "
+                    "expression")
+            return _eval_set_op(op, lhs, rhs, node.matching, k)
         if lhs.is_scalar and rhs.is_scalar:
+            if op in pp.COMPARISONS:
+                # Prometheus: "comparisons between scalars must use BOOL"
+                if not node.bool_mod:
+                    raise PromError(
+                        "comparisons between scalars must use BOOL modifier")
+                v = _cmp(op, lhs.values, rhs.values).astype(np.float64)
+                return Frame([{}], v, lhs.valid & rhs.valid, True)
             v = _apply_op(op, lhs.values, rhs.values, comparison_keep=False)
             return Frame([{}], v, lhs.valid & rhs.valid, True)
         if lhs.is_scalar or rhs.is_scalar:
             vec, sc, flipped = (rhs, lhs, True) if lhs.is_scalar else (lhs, rhs, False)
             a, b = (sc.values, vec.values) if flipped else (vec.values, sc.values)
-            if op in ("==", "!=", "<", ">", "<=", ">="):
+            if op in pp.COMPARISONS:
                 m = _cmp(op, a, b)
+                if node.bool_mod:
+                    labels = [_drop_name(l) for l in vec.labels]
+                    vals = np.where(m, 1.0, 0.0)
+                    return Frame(labels,
+                                 np.broadcast_to(vals, vec.values.shape).copy(),
+                                 vec.valid.copy())
                 return Frame(vec.labels, vec.values, vec.valid & m)
             v = _apply_op(op, a, b, comparison_keep=False)
             labels = [_drop_name(l) for l in vec.labels]
             return Frame(labels, np.broadcast_to(v, vec.values.shape).copy(), vec.valid)
-        # vector/vector: exact label match (ignoring __name__)
-        lkeys = [tuple(sorted(_drop_name(l).items())) for l in lhs.labels]
-        rmap = {tuple(sorted(_drop_name(l).items())): i for i, l in enumerate(rhs.labels)}
-        labels, vals, valid = [], [], []
-        for i, kk in enumerate(lkeys):
-            j = rmap.get(kk)
-            if j is None:
-                continue
-            if op in ("==", "!=", "<", ">", "<=", ">="):
-                m = _cmp(op, lhs.values[i], rhs.values[j])
-                labels.append(_drop_name(lhs.labels[i]))
-                vals.append(lhs.values[i])
-                valid.append(lhs.valid[i] & rhs.valid[j] & m)
-            else:
-                v = _apply_op(op, lhs.values[i], rhs.values[j], comparison_keep=False)
-                labels.append(_drop_name(lhs.labels[i]))
-                vals.append(v)
-                valid.append(lhs.valid[i] & rhs.valid[j])
-        k = len(steps)
+        return _eval_vector_binop(op, lhs, rhs, node.matching,
+                                  node.bool_mod, k)
+
+
+def _signature(labels: dict, matching: "pp.VectorMatching | None") -> tuple:
+    """Match signature of a series under on()/ignoring() (Prometheus
+    signatureFunc): on() hashes exactly the named labels (absent = ""),
+    ignoring() hashes everything else minus __name__."""
+    base = _drop_name(labels)
+    if matching is not None and matching.on:
+        return tuple(base.get(n, "") for n in sorted(set(matching.labels)))
+    ignored = set(matching.labels) if matching is not None else ()
+    return tuple(sorted((n, v) for n, v in base.items() if n not in ignored))
+
+
+def _eval_set_op(op: str, lhs: Frame, rhs: Frame,
+                 matching, k: int) -> Frame:
+    """and/or/unless (VectorAnd/VectorOr/VectorUnless): set membership by
+    match signature, applied per step via the validity masks."""
+    rsig_valid: dict[tuple, np.ndarray] = {}
+    for j, rl in enumerate(rhs.labels):
+        s = _signature(rl, matching)
+        got = rsig_valid.get(s)
+        rsig_valid[s] = rhs.valid[j] if got is None else (got | rhs.valid[j])
+    if op == "or":
+        lsig_valid: dict[tuple, np.ndarray] = {}
+        for i, ll in enumerate(lhs.labels):
+            s = _signature(ll, matching)
+            got = lsig_valid.get(s)
+            lsig_valid[s] = lhs.valid[i] if got is None else (got | lhs.valid[i])
+        labels = list(lhs.labels)
+        vals = [lhs.values[i] for i in range(len(lhs.labels))]
+        valid = [lhs.valid[i] for i in range(len(lhs.labels))]
+        for j, rl in enumerate(rhs.labels):
+            s = _signature(rl, matching)
+            lv = lsig_valid.get(s)
+            v = rhs.valid[j] if lv is None else (rhs.valid[j] & ~lv)
+            if v.any():
+                labels.append(rl)
+                vals.append(rhs.values[j])
+                valid.append(v)
         if not labels:
             return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
         return Frame(labels, np.stack(vals), np.stack(valid))
+    # and / unless keep lhs rows, gated by rhs presence at the step
+    labels, vals, valid = [], [], []
+    zero = np.zeros(k, bool)
+    for i, ll in enumerate(lhs.labels):
+        rv = rsig_valid.get(_signature(ll, matching), zero)
+        v = (lhs.valid[i] & rv) if op == "and" else (lhs.valid[i] & ~rv)
+        if v.any():
+            labels.append(ll)
+            vals.append(lhs.values[i])
+            valid.append(v)
+    if not labels:
+        return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
+    return Frame(labels, np.stack(vals), np.stack(valid))
+
+
+_DROP_NAME_OPS = {"+", "-", "*", "/", "%", "^", "atan2"}
+
+
+def _result_metric(many_labels: dict, one_labels: dict, op: str,
+                   matching, bool_mod: bool) -> dict:
+    """Prometheus resultMetric (promql/engine.go): output labels start
+    from the many side; one-to-one restricts by on/ignoring; group
+    modifiers graft include labels from the one side."""
+    out = dict(many_labels)
+    if op in _DROP_NAME_OPS or bool_mod:
+        out.pop("__name__", None)
+    if matching.card == "one-to-one":
+        if matching.on:
+            keep = set(matching.labels)
+            out = {n: v for n, v in out.items() if n in keep}
+        else:
+            for n in matching.labels:
+                out.pop(n, None)
+    for n in matching.include:
+        v = one_labels.get(n, "")
+        if v != "":
+            out[n] = v
+        else:
+            out.pop(n, None)
+    return out
+
+
+def _eval_vector_binop(op: str, lhs: Frame, rhs: Frame, matching,
+                       bool_mod: bool, k: int) -> Frame:
+    """Vector/vector arithmetic and comparison with full matching
+    semantics (Prometheus VectorBinop; reference transpiler surface:
+    promql2influxql/binary_expr.go:308)."""
+    if matching is None:
+        matching = pp.VectorMatching(False, [], "one-to-one")
+    # orient so `one` is the side that must have unique signatures
+    if matching.card == "one-to-many":  # group_right: lhs is the one side
+        many, one, swapped = rhs, lhs, True
+    else:
+        many, one, swapped = lhs, rhs, False
+    # index the one side; equal signatures are an error when both series
+    # are present at any step, else the disjoint rows merge
+    one_rows: dict[tuple, tuple[np.ndarray, np.ndarray, dict]] = {}
+    for j, ol in enumerate(one.labels):
+        s = _signature(ol, matching)
+        got = one_rows.get(s)
+        if got is None:
+            one_rows[s] = (one.values[j], one.valid[j], ol)
+            continue
+        gv, gval, glabels = got
+        if (gval & one.valid[j]).any():
+            side = "right" if not swapped else "left"
+            raise PromError(
+                "found duplicate series for the match group on the "
+                f"{side} hand-side of the operation; many-to-many "
+                "matching not allowed: matching labels must be unique "
+                "on one side")
+        if matching.include and any(
+                glabels.get(n) != one.labels[j].get(n)
+                for n in matching.include):
+            raise PromError(
+                "found series with conflicting group_left/group_right "
+                "include labels in the match group")
+        one_rows[s] = (
+            np.where(one.valid[j], one.values[j], gv),
+            gval | one.valid[j], glabels,
+        )
+    out_labels, out_vals, out_valid = [], [], []
+    # result-series uniqueness: Prometheus errors when two matches land
+    # on the same output labels at the same step
+    seen: dict[tuple, np.ndarray] = {}
+    for i, ml in enumerate(many.labels):
+        got = one_rows.get(_signature(ml, matching))
+        if got is None:
+            continue
+        ov, oval, olabels = got
+        both = many.valid[i] & oval
+        vl, vr = (many.values[i], ov) if not swapped else (ov, many.values[i])
+        if op in pp.COMPARISONS:
+            m = _cmp(op, vl, vr)
+            if bool_mod:
+                vals = np.where(m, 1.0, 0.0)
+                valid = both
+            else:
+                vals = vl
+                valid = both & m
+        else:
+            vals = _apply_op(op, vl, vr, comparison_keep=False)
+            valid = both
+        labels = _result_metric(ml, olabels, op, matching, bool_mod)
+        sig = tuple(sorted(labels.items()))
+        prev = seen.get(sig)
+        if prev is not None:
+            if (prev & valid).any():
+                if matching.card == "one-to-one":
+                    raise PromError(
+                        "multiple matches for labels: many-to-one "
+                        "matching must be explicit (group_left/"
+                        "group_right)")
+                raise PromError(
+                    "multiple matches for labels: grouping labels must "
+                    "ensure unique matches")
+            seen[sig] = prev | valid
+        else:
+            seen[sig] = valid.copy()
+        if valid.any():
+            out_labels.append(labels)
+            out_vals.append(np.asarray(vals, np.float64))
+            out_valid.append(valid)
+    if not out_labels:
+        return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
+    return Frame(out_labels, np.stack(out_vals), np.stack(out_valid))
 
 
 def _instant_rate(times, values, counts, starts, ends, per_second: bool):
@@ -1076,6 +1244,8 @@ def _apply_op(op, a, b, comparison_keep):
             return np.mod(a, np.where(b == 0, np.nan, b))
         if op == "^":
             return np.power(a, b)
+        if op == "atan2":
+            return np.arctan2(a, b)
     raise PromError(f"unsupported operator {op!r}")
 
 

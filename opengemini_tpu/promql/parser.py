@@ -77,10 +77,24 @@ class Aggregation:
 
 
 @dataclass
+class VectorMatching:
+    """on()/ignoring() + group_left/group_right modifiers.
+    Reference: promql2influxql/binary_expr.go:308 (On/MatchKeys/
+    MatchCard/IncludeKeys) driving Prometheus' VectorMatching."""
+
+    on: bool = False  # True: on(labels); False: ignoring(labels)
+    labels: list[str] = field(default_factory=list)
+    card: str = "one-to-one"  # |many-to-one|one-to-many|many-to-many
+    include: list[str] = field(default_factory=list)
+
+
+@dataclass
 class BinaryOp:
     op: str = ""
     lhs: object = None
     rhs: object = None
+    bool_mod: bool = False
+    matching: VectorMatching | None = None
 
 
 AGG_OPS = {"sum", "avg", "min", "max", "count", "topk", "bottomk", "quantile",
@@ -175,7 +189,10 @@ def _unquote(raw: str) -> str:
 
 
 _PREC = {"or": 1, "and": 2, "unless": 2, "==": 3, "!=": 3, "<": 3, ">": 3,
-         "<=": 3, ">=": 3, "+": 4, "-": 4, "*": 5, "/": 5, "%": 5, "^": 6}
+         "<=": 3, ">=": 3, "+": 4, "-": 4, "*": 5, "/": 5, "%": 5,
+         "atan2": 5, "^": 6}
+COMPARISONS = {"==", "!=", "<", ">", "<=", ">="}
+SET_OPS = {"and", "or", "unless"}
 
 
 def parse(text: str):
@@ -193,15 +210,57 @@ def _parse_expr(lx: _Lexer, min_prec: int):
         op = None
         if kind == "OP" and val in _PREC:
             op = val
-        elif kind == "ID" and val in ("and", "or", "unless"):
+        elif kind == "ID" and val in ("and", "or", "unless", "atan2"):
             op = val
         if op is None or _PREC[op] < min_prec:
             return lhs
         lx.next()
+        bool_mod, matching = _parse_binop_modifiers(lx, op)
         # ^ is right-associative in PromQL; all others left-associative
         next_min = _PREC[op] if op == "^" else _PREC[op] + 1
         rhs = _parse_expr(lx, next_min)
-        lhs = BinaryOp(op, lhs, rhs)
+        lhs = BinaryOp(op, lhs, rhs, bool_mod, matching)
+
+
+def _parse_binop_modifiers(lx: _Lexer, op: str):
+    """[bool] [on(...)|ignoring(...)] [group_left|group_right [(...)]]
+    after a binary operator, with Prometheus' validity rules."""
+    bool_mod = False
+    if lx.peek() == ("ID", "bool"):
+        if op not in COMPARISONS:
+            raise PromParseError(
+                "bool modifier can only be used on comparison operators")
+        lx.next()
+        bool_mod = True
+    matching = None
+    if lx.peek() in (("ID", "on"), ("ID", "ignoring")):
+        on = lx.next()[1] == "on"
+        matching = VectorMatching(
+            on, _parse_grouping(lx),
+            "many-to-many" if op in SET_OPS else "one-to-one",
+        )
+        if lx.peek() in (("ID", "group_left"), ("ID", "group_right")):
+            which = lx.next()[1]
+            if op in SET_OPS:
+                raise PromParseError(
+                    f"no grouping allowed for {op!r} operation")
+            matching.card = ("many-to-one" if which == "group_left"
+                             else "one-to-many")
+            if lx.peek() == ("OP", "("):
+                matching.include = _parse_grouping(lx)
+            if on:
+                for ln in matching.include:
+                    if ln in matching.labels:
+                        raise PromParseError(
+                            f"label {ln!r} must not occur in ON and "
+                            "GROUP clauses at once")
+    elif op in SET_OPS:
+        matching = VectorMatching(False, [], "many-to-many")
+    if lx.peek() in (("ID", "group_left"), ("ID", "group_right")):
+        raise PromParseError(
+            f"unexpected {lx.peek()[1]!r}: grouping modifiers require "
+            "on(...) or ignoring(...) first")
+    return bool_mod, matching
 
 
 def _parse_primary(lx: _Lexer):

@@ -24,7 +24,7 @@ from opengemini_tpu.query import functions as fnmod
 from opengemini_tpu.record import FieldType, FieldTypeConflict
 from opengemini_tpu.sql import ast
 from opengemini_tpu.meta.users import AuthError as _AuthError
-from opengemini_tpu.storage.engine import WriteError
+from opengemini_tpu.storage.engine import WriteError, _auto_shard_duration
 from opengemini_tpu.utils import tracing
 from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER, QueryKilled
 from opengemini_tpu.utils.stats import GLOBAL as STATS
@@ -320,8 +320,6 @@ class ShowDdlMixin:
                 # validate against FSM state before proposing: the raft
                 # apply path is fire-and-forget, so a bad alter would
                 # otherwise succeed silently in a cluster
-                from opengemini_tpu.storage.engine import _auto_shard_duration
-
                 fsm_db = self.meta_store.fsm.databases[tgt]
                 rp = fsm_db.get("rps", {}).get(stmt.name)
                 if rp is None:
@@ -337,6 +335,8 @@ class ShowDdlMixin:
                     # auto-computed it; mirror that here
                     new_sd = rp.get("shard_duration_ns") \
                         or _auto_shard_duration(cur_dur)
+                elif not new_sd:  # explicit 0 = recompute auto layout
+                    new_sd = _auto_shard_duration(new_dur)
                 if new_dur and new_dur < new_sd:
                     raise QueryError(
                         "retention policy duration must be greater than "

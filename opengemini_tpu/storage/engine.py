@@ -314,7 +314,7 @@ class Engine:
             d = self.databases.get(db)
             if d is None:
                 raise DatabaseNotFound(db)
-            if shard_duration_ns is None:
+            if not shard_duration_ns:  # absent or 0 = auto (influx meta)
                 shard_duration_ns = _auto_shard_duration(duration_ns)
             d.rps[name] = RetentionPolicy(name, duration_ns, shard_duration_ns)
             if default:
@@ -336,8 +336,10 @@ class Engine:
             if rp is None:
                 raise ValueError(f"retention policy not found: {name}")
             new_dur = rp.duration_ns if duration_ns is None else duration_ns
-            new_sd = rp.shard_duration_ns if shard_duration_ns is None \
-                else shard_duration_ns
+            if shard_duration_ns is None:
+                new_sd = rp.shard_duration_ns
+            else:  # explicit 0 = recompute the auto layout (influx meta)
+                new_sd = shard_duration_ns or _auto_shard_duration(new_dur)
             if new_dur and new_dur < new_sd:
                 # influx rejects this combination rather than silently
                 # rewriting the shard layout (ErrIncompatibleDurations)

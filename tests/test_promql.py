@@ -673,3 +673,233 @@ class TestLazyAggFastPath:
             type(pe), "_collect_runs", lambda self, *a, **k: None)
         eager = pe.query_instant(q, base + 10, db="hc")
         assert fast == eager, q
+
+
+# -- vector matching: on/ignoring, group_left/right, set ops, bool --------
+# Mirrors Prometheus' promql/testdata/operators.test fixture (the
+# method/code error-rate join) — reference surface:
+# lib/util/lifted/promql2influxql/binary_expr.go:308 (On/MatchKeys/
+# MatchCard/IncludeKeys).
+
+@pytest.fixture
+def match_env(tmp_path):
+    e = Engine(str(tmp_path / "data"))
+    e.create_database("prom")
+    lines = []
+    for method, code, v in (
+        ("get", "500", 24), ("get", "404", 30), ("put", "501", 3),
+        ("post", "500", 6), ("post", "404", 21),
+    ):
+        lines.append(
+            f"http_errors,method={method},code={code} value={v} {BASE * NS}")
+    for method, v in (("get", 600), ("del", 34), ("post", 120)):
+        lines.append(f"http_requests,method={method} value={v} {BASE * NS}")
+    e.write_lines("prom", "\n".join(lines))
+    yield e, PromEngine(e)
+    e.close()
+
+
+def _vals(data):
+    """result -> {frozenset(non-name labels): value}"""
+    out = {}
+    for r in data["result"]:
+        key = frozenset(
+            (k, v) for k, v in r["metric"].items() if k != "__name__")
+        out[key] = float(r["value"][1])
+    return out
+
+
+class TestVectorMatching:
+    def test_group_left_ignoring(self, match_env):
+        e, pe = match_env
+        data = pe.query_instant(
+            "http_errors / ignoring(code) group_left http_requests",
+            BASE + 10, "prom")
+        vals = _vals(data)
+        assert vals == {
+            frozenset({("method", "get"), ("code", "500")}): pytest.approx(24 / 600),
+            frozenset({("method", "get"), ("code", "404")}): pytest.approx(30 / 600),
+            frozenset({("method", "post"), ("code", "500")}): pytest.approx(6 / 120),
+            frozenset({("method", "post"), ("code", "404")}): pytest.approx(21 / 120),
+        }
+        # no result carries a metric name after arithmetic
+        assert all("__name__" not in r["metric"] for r in data["result"])
+
+    def test_group_left_on(self, match_env):
+        e, pe = match_env
+        data = pe.query_instant(
+            "http_errors / on(method) group_left http_requests",
+            BASE + 10, "prom")
+        assert len(data["result"]) == 4
+
+    def test_group_right_mirror(self, match_env):
+        e, pe = match_env
+        data = pe.query_instant(
+            "http_requests / on(method) group_right http_errors",
+            BASE + 10, "prom")
+        vals = _vals(data)
+        # many side is now http_errors (rhs): same label sets, inverted values
+        assert vals[frozenset({("method", "get"), ("code", "500")})] == \
+            pytest.approx(600 / 24)
+        assert len(vals) == 4
+
+    def test_many_to_one_requires_group_left(self, match_env):
+        e, pe = match_env
+        with pytest.raises(ValueError, match="group_left"):
+            pe.query_instant(
+                "http_errors / ignoring(code) http_requests",
+                BASE + 10, "prom")
+
+    def test_duplicate_one_side_errors(self, match_env):
+        e, pe = match_env
+        # group_right makes the LHS the one side: http_errors has two
+        # series per method after ignoring code -> duplicate-signature error
+        with pytest.raises(ValueError, match="duplicate series"):
+            pe.query_instant(
+                "http_errors / ignoring(code) group_right http_requests",
+                BASE + 10, "prom")
+
+    def test_group_left_include_labels(self, match_env):
+        e, pe = match_env
+        # graft the one side's mode label onto the result
+        e.write_lines("prom", f"capacity,method=get,mode=turbo value=2 {BASE * NS}")
+        data = pe.query_instant(
+            "http_errors * on(method) group_left(mode) capacity",
+            BASE + 10, "prom")
+        vals = _vals(data)
+        assert vals == {
+            frozenset({("method", "get"), ("code", "500"), ("mode", "turbo")}):
+                pytest.approx(48.0),
+            frozenset({("method", "get"), ("code", "404"), ("mode", "turbo")}):
+                pytest.approx(60.0),
+        }
+
+    def test_one_to_one_on(self, match_env):
+        e, pe = match_env
+        # one-to-one with on(): output keeps only the on labels
+        data = pe.query_instant(
+            'http_errors{code="500"} / on(method) http_requests',
+            BASE + 10, "prom")
+        vals = _vals(data)
+        assert vals == {
+            frozenset({("method", "get")}): pytest.approx(24 / 600),
+            frozenset({("method", "post")}): pytest.approx(6 / 120),
+        }
+
+    def test_one_to_one_ignoring_drops_label(self, match_env):
+        e, pe = match_env
+        data = pe.query_instant(
+            'http_errors{code="500"} / ignoring(code) http_requests',
+            BASE + 10, "prom")
+        vals = _vals(data)
+        assert frozenset({("method", "get")}) in vals
+
+    def test_and(self, match_env):
+        e, pe = match_env
+        data = pe.query_instant(
+            "http_errors and on(method) http_requests", BASE + 10, "prom")
+        vals = _vals(data)
+        # put has no http_requests series -> dropped; labels + name kept
+        assert len(vals) == 4
+        assert frozenset({("method", "put"), ("code", "501")}) not in vals
+        assert all("__name__" in r["metric"] for r in data["result"])
+        assert vals[frozenset({("method", "get"), ("code", "500")})] == 24
+
+    def test_unless(self, match_env):
+        e, pe = match_env
+        data = pe.query_instant(
+            "http_errors unless on(method) http_requests", BASE + 10, "prom")
+        vals = _vals(data)
+        assert list(vals) == [frozenset({("method", "put"), ("code", "501")})]
+
+    def test_or(self, match_env):
+        e, pe = match_env
+        data = pe.query_instant(
+            "http_requests or on(method) http_errors", BASE + 10, "prom")
+        vals = _vals(data)
+        # all 3 lhs series, plus the rhs series whose method has no lhs
+        # match: put (501) only
+        assert len(vals) == 4
+        assert vals[frozenset({("method", "put"), ("code", "501")})] == 3
+
+    def test_or_full_label_match(self, match_env):
+        e, pe = match_env
+        # default many-to-many or: full label-set signature
+        data = pe.query_instant(
+            "http_requests or http_errors", BASE + 10, "prom")
+        # nothing collides (different label sets) -> union of all 8
+        assert len(data["result"]) == 8
+
+    def test_bool_vector_scalar(self, match_env):
+        e, pe = match_env
+        data = pe.query_instant(
+            "http_requests > bool 100", BASE + 10, "prom")
+        vals = _vals(data)
+        assert vals == {
+            frozenset({("method", "get")}): 1.0,
+            frozenset({("method", "del")}): 0.0,
+            frozenset({("method", "post")}): 1.0,
+        }
+        assert all("__name__" not in r["metric"] for r in data["result"])
+
+    def test_bool_vector_vector(self, match_env):
+        e, pe = match_env
+        data = pe.query_instant(
+            'http_errors{code="500"} > bool on(method) http_requests',
+            BASE + 10, "prom")
+        vals = _vals(data)
+        assert vals == {
+            frozenset({("method", "get")}): 0.0,
+            frozenset({("method", "post")}): 0.0,
+        }
+
+    def test_scalar_scalar_comparison_requires_bool(self, match_env):
+        e, pe = match_env
+        with pytest.raises(ValueError, match="BOOL"):
+            pe.query_instant("1 > 2", BASE + 10, "prom")
+        data = pe.query_instant("1 > bool 2", BASE + 10, "prom")
+        assert data["result"][1] == "0.0"
+
+    def test_filter_comparison_keeps_name(self, match_env):
+        e, pe = match_env
+        data = pe.query_instant("http_requests > 100", BASE + 10, "prom")
+        assert sorted(r["metric"]["method"] for r in data["result"]) == \
+            ["get", "post"]
+        assert all(r["metric"]["__name__"] == "http_requests"
+                   for r in data["result"])
+
+    def test_atan2(self, match_env):
+        e, pe = match_env
+        import math as _m
+
+        data = pe.query_instant(
+            "http_requests atan2 http_requests", BASE + 10, "prom")
+        for r in data["result"]:
+            assert float(r["value"][1]) == pytest.approx(_m.atan2(1, 1) * 1)
+        with pytest.raises(pp.PromParseError, match="bool"):
+            pp.parse("a atan2 bool b")  # bool only on comparisons
+
+
+class TestVectorMatchingParse:
+    def test_parse_modifiers(self):
+        e = pp.parse("a / on(job, instance) group_left(mode) b")
+        assert e.matching.on is True
+        assert e.matching.labels == ["job", "instance"]
+        assert e.matching.card == "many-to-one"
+        assert e.matching.include == ["mode"]
+        e = pp.parse("a + ignoring(code) b")
+        assert e.matching.on is False and e.matching.card == "one-to-one"
+        e = pp.parse("a > bool b")
+        assert e.bool_mod is True and e.matching is None
+        e = pp.parse("a and b")
+        assert e.matching.card == "many-to-many"
+
+    def test_parse_errors(self):
+        with pytest.raises(pp.PromParseError, match="bool"):
+            pp.parse("a + bool b")
+        with pytest.raises(pp.PromParseError, match="grouping"):
+            pp.parse("a and on(x) group_left b")
+        with pytest.raises(pp.PromParseError, match="ON and GROUP"):
+            pp.parse("a / on(x) group_left(x) b")
+        with pytest.raises(pp.PromParseError):
+            pp.parse("a / group_left b")
