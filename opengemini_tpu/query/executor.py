@@ -1191,6 +1191,7 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         agg_results = {}  # id(call) -> (values, sel, counts)
         with trace.span("device_compute") as sp:
             for call, spec, params, field_name in aggs:
+                TRACKER.check()  # kill between device batch dispatches
                 if full_hit:
                     # every window served from cache: no scan, no device
                     dt = (np.int64 if isinstance(

@@ -26,6 +26,7 @@ from opengemini_tpu.storage.tsf import (
 )
 from opengemini_tpu.storage.wal import WAL
 from opengemini_tpu.utils.failpoint import inject as _fp
+from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
 
 
 def _pack_entries(buffer: list) -> tuple[np.ndarray, Record]:
@@ -822,6 +823,12 @@ class Shard:
         memtable last, deduped last-wins, then time-sliced."""
         recs = []
         for r, c in self.file_chunks(measurement, {sid}, tmin, tmax):
+            # KILL QUERY must interrupt a long decode mid-series, not
+            # only at statement/series boundaries (reference:
+            # ts-store/transport/query/manager.go:130 IsKilled checked
+            # inside cursor loops). No-op on non-query threads; the check
+            # is a thread-local read + set lookup, far below decode cost.
+            _TRACKER.check()
             if c.packed:
                 recs.append(r.read_packed_sid(measurement, c, sid, fields))
             else:
@@ -867,6 +874,7 @@ class Shard:
             files = list(self._files)
         for r in files:
             for c in r.chunks(measurement, None, tmin, tmax):
+                _TRACKER.check()  # per-chunk kill point (see read_series)
                 if c.packed:
                     if c.smax < sids[0] or c.smin > sids[-1]:
                         continue
