@@ -45,6 +45,7 @@ class ParseError(ValueError):
     def __init__(self, lineno: int, msg: str):
         super().__init__(f"line {lineno}: {msg}")
         self.lineno = lineno
+        self.msg = msg
 
 
 Point = tuple  # (measurement, tags, time_ns, fields)

@@ -15,6 +15,7 @@ import threading
 import time as _time
 
 from opengemini_tpu.ingest import line_protocol as lp
+from opengemini_tpu.record import FieldTypeConflict
 from opengemini_tpu.storage.shard import Shard
 from opengemini_tpu.utils.stats import GLOBAL as STATS
 
@@ -28,6 +29,48 @@ DEFAULT_SHARD_DURATION = 7 * 24 * 3600 * NS  # influx 1w default for infinite RP
 # overflows int64, so alignment uses its residue mod the duration (the
 # phase) — same grid, int64-safe (works for numpy vectorized forms too).
 _GO_ZERO_S = -62135596800  # seconds; *NS overflows int64
+
+
+# -- multi-core ingest pool (reference: influx.ScheduleUnmarshalWork) ----
+_INGEST_WORKERS = int(os.environ.get("OGT_INGEST_WORKERS", "0")) or (
+    len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity")
+    else (os.cpu_count() or 1))
+_INGEST_SEGMENT_BYTES = 1 << 20  # split target; bodies below 2MB stay inline
+_NEEDS_PYTHON_PARSER = object()  # _write_segmented: skip native re-parse
+_ingest_pool_obj = None
+_ingest_pool_lock = threading.Lock()
+
+
+def _ingest_pool():
+    """Shared parse pool, or None on single-core hosts (threads would only
+    add overhead when the C parser has one core to release the GIL to)."""
+    global _ingest_pool_obj
+    if _INGEST_WORKERS < 2:
+        return None
+    if _ingest_pool_obj is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with _ingest_pool_lock:
+            if _ingest_pool_obj is None:
+                _ingest_pool_obj = ThreadPoolExecutor(
+                    max_workers=_INGEST_WORKERS,
+                    thread_name_prefix="ogt-ingest")
+    return _ingest_pool_obj
+
+
+def _split_lp_segments(raw: bytes, n: int) -> list[bytes]:
+    """Split a line-protocol body into <= n segments at line boundaries."""
+    target = max(len(raw) // n, _INGEST_SEGMENT_BYTES)
+    segs, start = [], 0
+    while start < len(raw) and len(segs) < n - 1:
+        cut = raw.find(b"\n", start + target)
+        if cut == -1:
+            break
+        segs.append(raw[start:cut + 1])
+        start = cut + 1
+    if start < len(raw):
+        segs.append(raw[start:])
+    return segs
 
 
 def _go_phase_ns(dur_ns: int) -> int:
@@ -775,7 +818,16 @@ class Engine:
         batch = None
         if not (self.tag_arrays and b"=[" in raw):
             # tag-array batches take the exact Python parser (expansion)
-            batch = native_lp.parse_columnar(raw, precision, now_ns)
+            # large bodies fan the native parse out across cores — the C
+            # call releases the GIL (reference:
+            # httpd/handler.go:1633 influx.ScheduleUnmarshalWork pool)
+            n = self._write_segmented(db, rp, raw, precision, now_ns)
+            if n is _NEEDS_PYTHON_PARSER:
+                pass  # segments already proved native can't parse this
+            elif n is not None:
+                return n
+            else:
+                batch = native_lp.parse_columnar(raw, precision, now_ns)
         if batch is not None:
             if len(batch) == 0:
                 return 0
@@ -809,6 +861,71 @@ class Engine:
         self._notify_write(db, rp, points)
         return n
 
+    def _write_segmented(self, db: str, rp: str, raw: bytes,
+                         precision: str, now_ns: int):
+        """Multi-core ingest: split a large body at line boundaries, parse
+        the segments concurrently (the native parser releases the GIL),
+        then apply in order. Returns None when the body is small or the
+        pool is unavailable (caller takes the single-batch path), or the
+        _NEEDS_PYTHON_PARSER sentinel when a segment proved the body
+        needs the exact Python parser. Reference:
+        lib/util/lifted/influx/httpd/handler.go:1633
+        (influx.ScheduleUnmarshalWork worker pool)."""
+        from opengemini_tpu.ingest import native_lp
+        from opengemini_tpu.ingest.line_protocol import ParseError
+
+        pool = _ingest_pool()
+        if pool is None or len(raw) < 2 * _INGEST_SEGMENT_BYTES:
+            return None
+        if native_lp.load() is None:
+            return None
+        segs = _split_lp_segments(raw, _INGEST_WORKERS)
+        if len(segs) < 2:
+            return None
+        errs: list = []
+
+        def parse_one(idx_seg):
+            idx, seg = idx_seg
+            try:
+                return native_lp.parse_columnar(seg, precision, now_ns)
+            except ParseError as e:
+                errs.append((idx, e))
+                return None
+        parsed = list(pool.map(parse_one, enumerate(segs)))
+        if errs:
+            # report the FIRST bad line of the body, not whichever worker
+            # thread finished first
+            idx, e = min(errs)
+            off = sum(s.count(b"\n") for s in segs[:idx])
+            raise ParseError(off + e.lineno, e.msg)
+        if any(b is None for b in parsed):
+            return _NEEDS_PYTHON_PARSER  # escapes etc.
+        # cross-segment field-type check BEFORE applying anything: the
+        # single-batch path rejects an internally-conflicting body with
+        # nothing persisted; segments must not differ
+        body_types: dict[tuple[str, str], object] = {}
+        for batch in parsed:
+            for mst_id, name, ftype, _values, valid in batch.cols:
+                if not valid.any():
+                    continue
+                key = (batch.measurements[mst_id], name)
+                have = body_types.get(key)
+                if have is None:
+                    body_types[key] = ftype
+                elif have != ftype:
+                    raise FieldTypeConflict(name, have, ftype)
+        total = 0
+        for seg, batch in zip(segs, parsed):
+            if len(batch) == 0:
+                continue
+            STATS.incr("write", "points", len(batch))
+            with self._lock:
+                total += self._write_columnar_locked(
+                    db, rp, batch, seg, precision, now_ns)
+            if self._write_observers:
+                self._notify_write(db, rp, batch.to_points())
+        return total
+
     def _write_columnar_locked(self, db: str, rp: str, batch,
                                raw: bytes, precision: str, now_ns: int) -> int:
         """Route a ColumnarBatch to its time shards (vectorized: one
@@ -816,7 +933,11 @@ class Engine:
         holds the engine lock."""
         import numpy as np
 
-        d = self.databases[db]
+        d = self.databases.get(db)
+        if d is None:
+            # a concurrent DROP DATABASE can land between segments of a
+            # segmented body (the lock is per segment)
+            raise DatabaseNotFound(db)
         rp_meta = d.rps.get(rp)
         if rp_meta is None:
             raise WriteError(f"retention policy not found: {db}.{rp}")

@@ -429,3 +429,57 @@ class TestQueryManager:
         assert "hunter2" not in redact("CREATE USER bob WITH PASSWORD 'hunter2'")
         assert "s3c" not in redact("SET PASSWORD FOR u = 's3c'")
         assert redact("SELECT v FROM m") == "SELECT v FROM m"
+
+
+class TestKillMidScan:
+    def test_kill_interrupts_long_decode_loop(self, env):
+        """A multi-second chunk-decode loop dies shortly after KILL, not at
+        the next statement/series boundary (reference:
+        app/ts-store/transport/query/manager.go:130 IsKilled inside
+        cursor loops)."""
+        import threading
+        import time
+
+        from opengemini_tpu.storage.tsf import TSFReader
+        from opengemini_tpu.utils.querytracker import (
+            GLOBAL as TRACKER, QueryKilled,
+        )
+
+        e, ex = env
+        # one series spread over many TSF files -> many chunks per scan
+        for i in range(140):
+            e.write_lines("db", f"cpu,host=h0 v={i} {(BASE + i) * NS}")
+            e.flush_all()
+        sh = next(iter(e._shards.values()))
+        sid = next(iter(sh.index.series_ids("cpu")))
+
+        orig = TSFReader.read_chunk
+
+        def slow(self, *a, **k):
+            time.sleep(0.02)  # 140 chunks -> ~3s unkilled
+            return orig(self, *a, **k)
+
+        qid = TRACKER.register("long scan", "db")
+        killed_at = {}
+
+        def killer():
+            time.sleep(0.1)
+            TRACKER.kill(qid)
+            killed_at["t"] = time.monotonic()
+
+        t = threading.Thread(target=killer)
+        t.start()
+        try:
+            TSFReader.read_chunk = slow
+            t0 = time.monotonic()
+            with pytest.raises(QueryKilled):
+                sh.read_series("cpu", sid)
+            t_died = time.monotonic()
+        finally:
+            TSFReader.read_chunk = orig
+            TRACKER.unregister(qid)
+            t.join()
+        assert t_died - t0 < 2.0  # died mid-loop, not after all chunks
+        # per-chunk checks: latency bounded by ONE slowed chunk decode
+        # (20ms) + scheduling slack
+        assert t_died - killed_at["t"] < 0.5

@@ -1,0 +1,164 @@
+"""Multi-core ingest: segmented parallel parse of large /write bodies.
+
+Reference: lib/util/lifted/influx/httpd/handler.go:1633
+(influx.ScheduleUnmarshalWork worker pool). The segmented path must be
+byte-for-byte equivalent to the single-batch path: same rows, same WAL
+replay, same error line numbers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.ingest.line_protocol import ParseError
+from opengemini_tpu.storage import engine as engmod
+from opengemini_tpu.storage.engine import Engine
+
+NS = 1_000_000_000
+BASE = 1_700_000_000
+
+
+@pytest.fixture
+def forced_pool(monkeypatch):
+    """Force the segmented path on single-core hosts."""
+    monkeypatch.setattr(engmod, "_INGEST_WORKERS", 4)
+    monkeypatch.setattr(engmod, "_ingest_pool_obj", None)
+    yield
+    monkeypatch.setattr(engmod, "_ingest_pool_obj", None)
+
+
+def _body(rows_per_host=800, hosts=40, fields=8, pad=""):
+    fieldstr = ",".join(f"f{j}={j}.25" for j in range(fields))
+    lines = []
+    for t in range(rows_per_host):
+        for h in range(hosts):
+            lines.append(
+                f"cpu,host=h{h}{pad} {fieldstr} {(BASE + t * 60) * NS + h}")
+    # second measurement + comments/blank lines mixed in
+    lines.append("")
+    lines.append("# comment")
+    lines.append(f"mem,host=h0 used=1i {BASE * NS}")
+    return ("\n".join(lines)).encode()
+
+
+def test_split_segments_line_boundaries():
+    raw = _body(50, 10)
+    segs = engmod._split_lp_segments(raw, 4)
+    assert b"".join(segs) == raw
+    for s in segs[:-1]:
+        assert s.endswith(b"\n")
+
+
+class TestSegmentedIngest:
+    def test_matches_single_batch(self, tmp_path, forced_pool):
+        raw = _body()
+        assert len(raw) > 2 * engmod._INGEST_SEGMENT_BYTES
+
+        e1 = Engine(str(tmp_path / "seg"), sync_wal=False)
+        e1.create_database("db")
+        n1 = e1.write_lines("db", raw)
+
+        # single-batch control: drop below the segmentation threshold
+        e2 = Engine(str(tmp_path / "one"), sync_wal=False)
+        e2.create_database("db")
+        import opengemini_tpu.storage.engine as _em
+        orig = _em._INGEST_SEGMENT_BYTES
+        try:
+            _em._INGEST_SEGMENT_BYTES = 1 << 40
+            n2 = e2.write_lines("db", raw)
+        finally:
+            _em._INGEST_SEGMENT_BYTES = orig
+        assert n1 == n2
+
+        from opengemini_tpu.query.executor import Executor
+
+        q = "SELECT count(f0), sum(f1), max(f5) FROM cpu"
+        r1 = Executor(e1).execute(q, db="db")
+        r2 = Executor(e2).execute(q, db="db")
+        assert r1 == r2
+        r1 = Executor(e1).execute("SELECT count(used) FROM mem", db="db")
+        assert r1["results"][0]["series"][0]["values"][0][1] == 1
+        e1.close()
+        e2.close()
+
+    def test_wal_replay_after_segmented_write(self, tmp_path, forced_pool):
+        raw = _body(200, 30)
+        path = str(tmp_path / "d")
+        e = Engine(path, sync_wal=False)
+        e.create_database("db")
+        n = e.write_lines("db", raw)
+        e.close()  # no flush: rows only in the WAL
+        e = Engine(path, sync_wal=False)
+        from opengemini_tpu.query.executor import Executor
+
+        r = Executor(e).execute("SELECT count(f0) FROM cpu", db="db")
+        assert r["results"][0]["series"][0]["values"][0][1] == 200 * 30
+        assert n == 200 * 30 + 1
+        e.close()
+
+    def test_parse_error_line_numbers_span_segments(self, tmp_path,
+                                                    forced_pool):
+        raw = _body()
+        lines = raw.split(b"\n")
+        bad_at = len(lines) - 5  # near the end -> lands in a late segment
+        lines[bad_at] = b"cpu,host=hX not_a_field"
+        raw = b"\n".join(lines)
+        e = Engine(str(tmp_path / "d"), sync_wal=False)
+        e.create_database("db")
+        with pytest.raises(ParseError) as ei:
+            e.write_lines("db", raw)
+        assert ei.value.lineno == bad_at + 1
+        e.close()
+
+    def test_cross_segment_type_conflict_atomic(self, tmp_path, forced_pool):
+        """A body whose late segment re-types a field must persist
+        NOTHING — same contract as the single-batch path."""
+        from opengemini_tpu.record import FieldTypeConflict
+
+        raw = _body()
+        # append a conflicting line: f0 was float, now int
+        raw += f"\ncpu,host=h0 f0=5i {BASE * NS}".encode()
+        e = Engine(str(tmp_path / "d"), sync_wal=False)
+        e.create_database("db")
+        with pytest.raises(FieldTypeConflict):
+            e.write_lines("db", raw)
+        from opengemini_tpu.query.executor import Executor
+
+        r = Executor(e).execute("SELECT count(f0) FROM cpu", db="db")
+        assert "series" not in r["results"][0], r
+        e.close()
+
+    def test_first_bad_line_wins_across_segments(self, tmp_path, forced_pool):
+        raw = _body()
+        lines = raw.split(b"\n")
+        early, late = 10, len(lines) - 5
+        lines[early] = b"cpu,host=hX broken"
+        lines[late] = b"cpu,host=hY broken"
+        e = Engine(str(tmp_path / "d"), sync_wal=False)
+        e.create_database("db")
+        with pytest.raises(ParseError) as ei:
+            e.write_lines("db", b"\n".join(lines))
+        assert ei.value.lineno == early + 1
+        e.close()
+
+    def test_multi_shard_routing(self, tmp_path, forced_pool):
+        # rows span two weekly shard groups
+        week = 7 * 86400
+        lines = []
+        filler = ",".join(f"f{j}={j}.5" for j in range(8))
+        for t in range(40000):
+            ts = (BASE + (t % 2) * week) * NS + t
+            lines.append(f"cpu,host=h{t % 50} {filler} {ts}")
+        raw = "\n".join(lines).encode()
+        if len(raw) < 2 * engmod._INGEST_SEGMENT_BYTES:
+            raw = raw + b"\n" + raw.replace(b"cpu,", b"cpu2,")
+        e = Engine(str(tmp_path / "d"), sync_wal=False)
+        e.create_database("db")
+        e.write_lines("db", raw)
+        assert len([k for k in e._shards if k[0] == "db"]) >= 2
+        from opengemini_tpu.query.executor import Executor
+
+        r = Executor(e).execute("SELECT count(f0) FROM cpu", db="db")
+        assert r["results"][0]["series"][0]["values"][0][1] == 40000
+        e.close()
