@@ -101,6 +101,11 @@ class GridBatch:
 
     def _ensure_fallback(self):
         if self._fallback is None:
+            if self._vals is None:
+                raise RuntimeError(
+                    "bucketed fallback requested after prefetch() dropped "
+                    "the raw rows — prefetch callers must keep aggs "
+                    "within GRID_AGGS")
             fb = ragged.BucketedBatch(self.dtype)
             for v, r, s, m, t in zip(self._vals, self._rel, self._seg,
                                      self._mask, self._times):
@@ -151,8 +156,7 @@ class GridBatch:
         # injective per run: gaps and per-series phase shifts grid fine,
         # they just leave masked-off slots. All-singleton runs (one sample
         # per series) degenerate to k=1.
-        dt = int(np.gcd(np.gcd.reduce(dd), self.every_ns)) if len(dd) \
-            else self.every_ns
+        dt = _stride_gcd(dd, self.every_ns) if len(dd) else self.every_ns
         if dt <= 0 or self.every_ns % dt:
             return None
         k = self.every_ns // dt
@@ -160,8 +164,8 @@ class GridBatch:
             return None
         bnd_idx = np.flatnonzero(boundary)
         S = len(bnd_idx)
-        S_pad = _pow2_at_least(S, _MIN_S)
-        W_pad = _pow2_at_least(W, _MIN_W)
+        S_pad = _pad_rows(S, _MIN_S)
+        W_pad = _pad_lanes(W, _MIN_W)
         cells = S_pad * k * W_pad  # padded = what actually allocates
         if cells > _MAX_GRID_CELLS or cells > max(_MAX_EXPANSION * n, 1 << 20):
             return None
@@ -174,11 +178,9 @@ class GridBatch:
         mask = np.concatenate(self._mask)
         vt = np.zeros((S_pad, k, W_pad), dtype=self.dtype)
         mt = np.zeros((S_pad, k, W_pad), dtype=np.bool_)
-        imat = np.zeros((S_pad, k, W_pad), dtype=np.int32)
         flat = (rid * k + r) * W_pad + w
         vt.reshape(-1)[flat] = vals
         mt.reshape(-1)[flat] = mask
-        imat.reshape(-1)[flat] = np.arange(n, dtype=np.int32)
         run_gid = (seg[bnd_idx] // W).astype(np.int64)
         order = np.argsort(run_gid, kind="stable")
         sg = run_gid[order]
@@ -188,7 +190,10 @@ class GridBatch:
         starts = np.flatnonzero(gb)
         return {
             "k": k, "S": S, "W_pad": W_pad,
-            "arrays": (vt, mt, imat),
+            "arrays": (vt, mt),
+            # imat (sample-index grid for the selector kernels) builds
+            # lazily from `flat` — count/sum/mean scans never pay for it
+            "imat": None, "flat": flat, "n": n,
             "rel": rel,
             "row_order": order,  # grid rows sorted by gid
             "gid_starts": starts,  # reduceat starts in row_order
@@ -198,7 +203,12 @@ class GridBatch:
 
     # -- execution -------------------------------------------------------
 
-    def run(self, spec, num_segments: int, params: tuple = ()):
+    def run(self, spec, num_segments: int, params: tuple = (),
+            want_sel: bool = True):
+        """want_sel=False skips the selector index machinery for min/max
+        (their values come from the basic kernel) — the sliced scan path
+        never consults sel (selector timestamps only matter without
+        GROUP BY time())."""
         st = self._freeze(num_segments)
         if st is None:
             return self._fallback.run(spec, num_segments, params)
@@ -209,7 +219,8 @@ class GridBatch:
         G = num_segments // self.W
         raw = self._raw_stats(
             need_ssd=(name == "stddev"),
-            need_selectors=name in ("min", "max", "first", "last"),
+            need_selectors=name in ("first", "last") or (
+                want_sel and name in ("min", "max")),
         )
         order, starts = st["row_order"], st["gid_starts"]
         gids, W = st["gids_present"], self.W
@@ -231,10 +242,12 @@ class GridBatch:
             out2d[gids] = s / np.maximum(cnt_g, 1)
         elif name == "min":
             out2d[gids] = np.minimum.reduceat(raw["min"][order], starts, axis=0)
-            sel = self._combine_value_selector(st, raw, "min", num_segments)
+            if want_sel:
+                sel = self._combine_value_selector(st, raw, "min", num_segments)
         elif name == "max":
             out2d[gids] = np.maximum.reduceat(raw["max"][order], starts, axis=0)
-            sel = self._combine_value_selector(st, raw, "max", num_segments)
+            if want_sel:
+                sel = self._combine_value_selector(st, raw, "max", num_segments)
         elif name == "spread":
             mn = np.minimum.reduceat(raw["min"][order], starts, axis=0)
             mx = np.maximum.reduceat(raw["max"][order], starts, axis=0)
@@ -255,33 +268,100 @@ class GridBatch:
             out2d[gids] = vals2d
         return out, sel, counts
 
-    def _raw_stats(self, need_ssd: bool, need_selectors: bool) -> dict:
+    def _device_arrays(self, with_imat: bool):
         from opengemini_tpu.parallel import runtime as _prt
 
         st = self._state
-        vt, mt, imat = st["arrays"]
-        S = st["S"]
+        vt, mt = st["arrays"]
+        imat = None
+        if with_imat:
+            imat = st["imat"]
+            if imat is None:
+                imat = np.zeros(vt.shape, dtype=np.int32)
+                imat.reshape(-1)[st["flat"]] = np.arange(
+                    st["n"], dtype=np.int32)
+                st["imat"] = imat
         mesh = _prt.get_mesh()
         if mesh is not None and vt.shape[0] >= mesh.size:
             # multi-chip: series-run rows are independent — shard the S
             # axis, GSPMD partitions the sublane reduces, no collectives
-            if "mesh_arrays" not in st:
-                from opengemini_tpu.parallel import distributed as _dist
+            from opengemini_tpu.parallel import distributed as _dist
 
-                st["mesh_arrays"] = _dist.shard_leading_axis(
-                    mesh, vt, mt, imat)
-            vt, mt, imat = st["mesh_arrays"]
+            if "mesh_arrays" not in st:
+                st["mesh_arrays"] = _dist.shard_leading_axis(mesh, vt, mt)
+            vt, mt = st["mesh_arrays"]
+            if with_imat:
+                if "mesh_imat" not in st:
+                    (st["mesh_imat"],) = _dist.shard_leading_axis(mesh, imat)
+                imat = st["mesh_imat"]
+        return vt, mt, imat
+
+    def _launch(self, kind: str):
+        """Dispatch one kernel group; returns unmaterialized device
+        results (JAX dispatch is async — the host is free to keep
+        decoding while the device reduces)."""
+        vt, mt, imat = self._device_arrays(with_imat=(kind == "selectors"))
+        if kind == "selectors":
+            return _grid_jit(vt.shape, str(vt.dtype), kind)(vt, mt, imat)
+        return _grid_jit(vt.shape, str(vt.dtype), kind)(vt, mt)
+
+    supports_want_sel = True
+
+    def prefetch(self, num_segments: int, agg_names,
+                 want_sel: bool = False) -> None:
+        """Sliced-scan overlap hook: freeze the grid and dispatch every
+        kernel this batch's aggregates will need, then drop the host-side
+        row lists and grid arrays — run() materializes the in-flight
+        device results later. No-op when the grid refuses (bucketed
+        fallback keeps its rows) or an agg outside GRID_AGGS is coming."""
+        names = set(agg_names)
+        if not names or not names <= GRID_AGGS:
+            return
+        st = self._freeze(num_segments)
+        if st is None:
+            return
+        self._pending = getattr(self, "_pending", {})
+        if "basic" not in self._pending:
+            self._pending["basic"] = self._launch("basic")
+        if "stddev" in names and "ssd" not in self._pending:
+            self._pending["ssd"] = self._launch("ssd")
+        need_sel_kernel = bool(names & {"first", "last"}) or (
+            want_sel and bool(names & {"min", "max"}))
+        if need_sel_kernel and "selectors" not in self._pending:
+            self._pending["selectors"] = self._launch("selectors")
+        # inputs are on device now; free the host copies
+        st["arrays"] = None
+        st["imat"] = None
+        st["flat"] = None
+        st.pop("mesh_arrays", None)
+        st.pop("mesh_imat", None)
+        self._vals = self._rel = self._seg = self._mask = self._sids = None
+
+    def _raw_stats(self, need_ssd: bool, need_selectors: bool) -> dict:
+        st = self._state
+        S = st["S"]
+        pending = getattr(self, "_pending", {})
+
+        def settle(kind):
+            got = pending.pop(kind, None)
+            if got is None:
+                if st["arrays"] is None:
+                    raise RuntimeError(
+                        f"grid kernel {kind!r} needed after prefetch "
+                        "dropped the host arrays")
+                got = self._launch(kind)
+            if kind == "ssd":
+                self._raw["ssd"] = np.asarray(got)[:S, : self.W]
+            else:
+                self._raw.update(
+                    {k: np.asarray(v)[:S, : self.W] for k, v in got.items()})
+
         if "count" not in self._raw:
-            got = _grid_jit(vt.shape, str(vt.dtype), "basic")(vt, mt)
-            self._raw.update(
-                {k: np.asarray(v)[:S, : self.W] for k, v in got.items()})
+            settle("basic")
         if need_ssd and "ssd" not in self._raw:
-            got = _grid_jit(vt.shape, str(vt.dtype), "ssd")(vt, mt)
-            self._raw["ssd"] = np.asarray(got)[:S, : self.W]
+            settle("ssd")
         if need_selectors and "sel_first" not in self._raw:
-            got = _grid_jit(vt.shape, str(vt.dtype), "selectors")(vt, mt, imat)
-            self._raw.update(
-                {k: np.asarray(v)[:S, : self.W] for k, v in got.items()})
+            settle("selectors")
         return self._raw
 
     def _combine_value_selector(self, st, raw, name, num_segments):
@@ -352,10 +432,44 @@ class GridBatch:
         return vals2d, sel
 
 
+def _stride_gcd(dd: np.ndarray, every_ns: int) -> int:
+    """gcd of every within-run time diff and the window length.
+    np.gcd.reduce is per-element microcode (~200ns/elt — 4s on a 20M-row
+    scan); constant-stride data (the common TSBS shape) exits via one
+    vectorized modulo pass instead."""
+    m = int(dd.min())
+    if m <= 0:
+        return 0
+    if not (dd % m).any():  # every diff is a multiple of the smallest
+        return int(np.gcd(m, every_ns))
+    return int(np.gcd(np.gcd.reduce(np.unique(dd)), every_ns))
+
+
 def _pow2_at_least(n: int, floor: int) -> int:
     p = floor
     while p < n:
         p *= 2
+    return p
+
+
+def _pad_lanes(n: int, floor: int) -> int:
+    """Pad the lane (W) axis to a multiple of 128 instead of a power of
+    two: at W=1667 that is 1792 rather than 2048 (-12% cells). Bounded
+    shape count for the compile cache: <= 16 steps to 2048, pow2 above."""
+    if n <= floor:
+        return floor
+    if n <= 2048:
+        return (n + 127) // 128 * 128
+    return _pow2_at_least(n, 2048)
+
+
+def _pad_rows(n: int, floor: int) -> int:
+    """Pad the row (S) axis in 1.5x steps instead of 2x: the padded rows
+    are pure zeros the kernels still reduce over."""
+    p = floor
+    while p < n:
+        p = (p * 3 + 1) // 2
+        p = (p + 7) // 8 * 8
     return p
 
 

@@ -765,6 +765,24 @@ class Shard:
                 out.append((r, c))
         return out
 
+    def approx_rows(self, measurement: str, tmin=None, tmax=None
+                    ) -> tuple[int, int]:
+        """(row count, chunk count) for the measurement in the time range,
+        from chunk metadata + memtable — no decode. Over-counts rows of
+        chunks straddling the range edges; the scan-slice planner only
+        needs the order of magnitude."""
+        rows = 0
+        chunks = 0
+        with self._lock:
+            files = list(self._files)
+        for r in files:
+            for c in r.chunks(measurement, None, tmin, tmax):
+                rows += c.rows
+                chunks += 1
+        # memtable rows count whole (order-of-magnitude estimate; the
+        # memtable has no per-measurement row bookkeeping)
+        return rows + len(self.mem), chunks
+
     def text_match_sids(self, mst: str, field: str, token: str):
         """Series whose PERSISTED rows may contain `token` in `field`
         (pruning set; rows are verified exactly afterwards), or None when
