@@ -335,11 +335,20 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
         names = load_udfs(sc["castor-udf-dir"])
         if names:
             print(f"castor udfs loaded: {', '.join(names)}", flush=True)
-    if sc.get("obs-dir"):
+    if sc.get("obs-dir") or sc.get("obs-url"):
         from opengemini_tpu.services.obstier import ObsTierService
-        from opengemini_tpu.storage.objstore import FSObjectStore
 
-        svc.engine.attach_object_store(FSObjectStore(sc["obs-dir"]))
+        if sc.get("obs-url"):
+            # remote S3-compatible bucket endpoint (reference: lib/obs)
+            from opengemini_tpu.storage.objstore import HTTPObjectStore
+
+            store = HTTPObjectStore(
+                sc["obs-url"], token=sc.get("obs-token") or None)
+        else:
+            from opengemini_tpu.storage.objstore import FSObjectStore
+
+            store = FSObjectStore(sc["obs-dir"])
+        svc.engine.attach_object_store(store)
         out.append(ObsTierService(
             svc.engine,
             int(float(sc.get("obs-age-days", 90)) * 86400e9),

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import threading
 import time as _time
 
@@ -694,16 +695,47 @@ class Engine:
     def _download_group(self, db: str, rp: str, group_start: int) -> None:
         """Pull an offloaded group's files into its shard dir. NO engine
         lock held — with a real bucket this is seconds of network I/O and
-        must not stall every other query/write."""
+        must not stall every other query/write.
+
+        Downloads land in a staging dir OUTSIDE data/ and swap in whole:
+        a crash or torn download must never leave a partial dir that
+        _load_shards would install as a live shard (the reconcile in
+        attach_object_store would then delete the bucket copy — data
+        loss from a half-hydrated shard)."""
         from opengemini_tpu.storage.objstore import shard_prefix
 
         prefix = shard_prefix(db, rp, group_start)
-        dest = self._shard_dir(db, rp, group_start)
-        for key in self.obs_store.list(prefix):
-            rel = key[len(prefix) + 1 :]  # may be nested (seriesidx/...)
-            target = os.path.join(dest, rel)
-            os.makedirs(os.path.dirname(target), exist_ok=True)
-            self.obs_store.get(key, target)
+        keys = self.obs_store.list(prefix)
+        if not keys:
+            raise WriteError(
+                f"offloaded group {db}/{rp}/{group_start} has no objects "
+                "in the bucket")
+        import uuid
+
+        # unique per-attempt staging dir: two concurrent hydrations of
+        # the same group must not clobber each other's downloads
+        tmp = os.path.join(self.root, ".hydrate-tmp",
+                           f"{db}_{rp}_{group_start}.{uuid.uuid4().hex[:8]}")
+        try:
+            for key in keys:
+                rel = key[len(prefix) + 1 :]  # may be nested (seriesidx/)
+                target = os.path.join(tmp, rel)
+                os.makedirs(os.path.dirname(target), exist_ok=True)
+                self.obs_store.get(key, target)
+            dest = self._shard_dir(db, rp, group_start)
+            # swap under the engine lock: the loser of a concurrent
+            # hydration discards its copy instead of replacing a dir the
+            # winner may already have OPEN as a live shard
+            with self._lock:
+                if (db, rp, group_start) in self._shards:
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    return
+                shutil.rmtree(dest, ignore_errors=True)
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                os.replace(tmp, dest)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
 
     def _install_hydrated(self, db: str, rp: str, group_start: int,
                           save: bool = True) -> "Shard":
@@ -761,12 +793,17 @@ class Engine:
                         self._download_group(odb, orp, start)
                     with self._lock:
                         self._install_hydrated(odb, orp, start, save=False)
-                except Exception:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001
                     import logging
 
                     logging.getLogger("opengemini_tpu.engine").exception(
                         "hydration of %s/%s/%d failed", odb, orp, start
                     )
+                    # fail LOUDLY: silently answering without the
+                    # offloaded shard would return incomplete results
+                    raise WriteError(
+                        f"shard {odb}/{orp}/{start} is in the object "
+                        f"store and could not be hydrated: {e}") from e
             if todo:
                 with self._lock:
                     self._save_meta()

@@ -805,15 +805,34 @@ class TestCastorUDF:
             castor._UDFS.clear()
 
 
+@pytest.fixture(params=["fs", "http"])
+def obs_store_factory(request, tmp_path):
+    """Builds clients for one persistent bucket backend: the filesystem
+    impl or the remote S3-subset HTTP impl (MiniBucketServer)."""
+    if request.param == "fs":
+        from opengemini_tpu.storage.objstore import FSObjectStore
+
+        yield lambda: FSObjectStore(str(tmp_path / "bucket"))
+        return
+    from opengemini_tpu.storage.objstore import (
+        HTTPObjectStore, MiniBucketServer,
+    )
+
+    srv = MiniBucketServer().start()
+    try:
+        yield lambda: HTTPObjectStore(srv.url)
+    finally:
+        srv.stop()
+
+
 class TestObsTier:
-    def _obs_env(self, tmp_path):
+    def _obs_env(self, tmp_path, make_store):
         from opengemini_tpu.query.executor import Executor
         from opengemini_tpu.storage.engine import Engine
-        from opengemini_tpu.storage.objstore import FSObjectStore
 
         e = Engine(str(tmp_path / "data"))
         e.create_database("db")
-        store = FSObjectStore(str(tmp_path / "bucket"))
+        store = make_store()
         e.attach_object_store(store)
         week = 7 * 86400
         lines = "\n".join(
@@ -823,12 +842,12 @@ class TestObsTier:
         e.flush_all()
         return e, Executor(e), store
 
-    def test_offload_hydrate_round_trip(self, tmp_path):
+    def test_offload_hydrate_round_trip(self, tmp_path, obs_store_factory):
         import os
 
         from opengemini_tpu.services.obstier import ObsTierService
 
-        e, ex, store = self._obs_env(tmp_path)
+        e, ex, store = self._obs_env(tmp_path, obs_store_factory)
         week = 7 * 86400
         n_before = len(e._shards)
         svc = ObsTierService(e, age_ns=2 * week * NS)
@@ -849,29 +868,30 @@ class TestObsTier:
         assert len(e.obs_shards) == 0  # hydrated back
         e.close()
 
-    def test_restart_keeps_offloaded_groups_queryable(self, tmp_path):
+    def test_restart_keeps_offloaded_groups_queryable(self, tmp_path,
+                                                       obs_store_factory):
         from opengemini_tpu.query.executor import Executor
         from opengemini_tpu.services.obstier import ObsTierService
         from opengemini_tpu.storage.engine import Engine
-        from opengemini_tpu.storage.objstore import FSObjectStore
 
-        e, ex, store = self._obs_env(tmp_path)
+        e, ex, store = self._obs_env(tmp_path, obs_store_factory)
         week = 7 * 86400
         ObsTierService(e, age_ns=2 * week * NS).handle(
             now_ns=(BASE + 4 * week) * NS)
         assert e.obs_shards
         e.close()
         e2 = Engine(str(tmp_path / "data"))
-        e2.attach_object_store(FSObjectStore(str(tmp_path / "bucket")))
+        e2.attach_object_store(obs_store_factory())
         assert len(e2.obs_shards) == 2  # registry persisted
         out = Executor(e2).execute("SELECT count(v) FROM m", db="db")
         assert out["results"][0]["series"][0]["values"][0][1] == 4
         e2.close()
 
-    def test_retention_deletes_store_copies(self, tmp_path):
+    def test_retention_deletes_store_copies(self, tmp_path,
+                                             obs_store_factory):
         from opengemini_tpu.services.obstier import ObsTierService
 
-        e, ex, store = self._obs_env(tmp_path)
+        e, ex, store = self._obs_env(tmp_path, obs_store_factory)
         week = 7 * 86400
         ObsTierService(e, age_ns=1 * week * NS).handle(
             now_ns=(BASE + 10 * week) * NS)
@@ -885,12 +905,13 @@ class TestObsTier:
         assert store.list("shards/db/autogen") == []  # bucket emptied
         e.close()
 
-    def test_write_into_offloaded_range_merges(self, tmp_path):
+    def test_write_into_offloaded_range_merges(self, tmp_path,
+                                                obs_store_factory):
         """Writes landing in an offloaded group's range must hydrate the
         group first — not create a fresh shard hydration later clobbers."""
         from opengemini_tpu.services.obstier import ObsTierService
 
-        e, ex, store = self._obs_env(tmp_path)
+        e, ex, store = self._obs_env(tmp_path, obs_store_factory)
         week = 7 * 86400
         ObsTierService(e, age_ns=1 * week * NS).handle(
             now_ns=(BASE + 10 * week) * NS)
@@ -902,12 +923,13 @@ class TestObsTier:
         assert row[1] == 5 and row[2] == 0 + 1 + 2 + 3 + 100  # old + new
         e.close()
 
-    def test_crash_between_registry_and_removal_prefers_local(self, tmp_path):
+    def test_crash_between_registry_and_removal_prefers_local(
+            self, tmp_path, obs_store_factory):
         from opengemini_tpu.query.executor import Executor
         from opengemini_tpu.storage.engine import Engine
-        from opengemini_tpu.storage.objstore import FSObjectStore, shard_prefix
+        from opengemini_tpu.storage.objstore import shard_prefix
 
-        e, ex, store = self._obs_env(tmp_path)
+        e, ex, store = self._obs_env(tmp_path, obs_store_factory)
         # simulate the crash window: registry written, local dir kept
         key = sorted(e._shards)[0]
         db, rp, start = key
@@ -924,17 +946,17 @@ class TestObsTier:
         e._save_meta()
         e.close()
         e2 = Engine(str(tmp_path / "data"))
-        e2.attach_object_store(FSObjectStore(str(tmp_path / "bucket")))
+        e2.attach_object_store(obs_store_factory())
         assert key not in e2.obs_shards  # reconciled: local wins
         assert store.list(prefix) == []  # stale bucket copy removed
         out = Executor(e2).execute("SELECT count(v) FROM m", db="db")
         assert out["results"][0]["series"][0]["values"][0][1] == 4
         e2.close()
 
-    def test_drop_database_purges_bucket(self, tmp_path):
+    def test_drop_database_purges_bucket(self, tmp_path, obs_store_factory):
         from opengemini_tpu.services.obstier import ObsTierService
 
-        e, ex, store = self._obs_env(tmp_path)
+        e, ex, store = self._obs_env(tmp_path, obs_store_factory)
         week = 7 * 86400
         ObsTierService(e, age_ns=1 * week * NS).handle(
             now_ns=(BASE + 10 * week) * NS)
