@@ -58,6 +58,10 @@ class MetaFSM:
         # dict keyed by id would let each registration clobber the other
         self.meta_nodes: dict[str, str] = {}  # id -> addr
         self.models: dict[str, dict] = {}  # castor fitted-model artifacts
+        # load-aware placement overrides: "db|rp|start" -> [owner ids];
+        # groups listed here ignore rendezvous (reference:
+        # app/ts-meta/meta/balance_manager.go moving PT ownership)
+        self.placement: dict[str, list] = {}
         self.listeners: list = []
         # listener side effects DEFER here: apply() runs under the raft
         # lock and listener work (engine DDL = disk I/O) must not stall
@@ -139,6 +143,11 @@ class MetaFSM:
             db = self.databases.get(cmd["db"])
             if db is not None:
                 db.get(_REGISTRY_DROP[op], {}).pop(cmd["name"], None)
+        elif op == "set_placement":
+            if cmd.get("owners"):
+                self.placement[cmd["key"]] = list(cmd["owners"])
+        elif op == "drop_placement":
+            self.placement.pop(cmd["key"], None)
         elif op == "register_node":
             self.nodes[cmd["id"]] = {"addr": cmd["addr"], "role": cmd.get("role", "data")}
         elif op == "remove_node":
@@ -200,6 +209,7 @@ class MetaFSM:
             "meta_removed": sorted(self.meta_removed),
             "meta_nodes": self.meta_nodes,
             "models": self.models,
+            "placement": self.placement,
         }))
 
     def restore(self, state: dict) -> None:
@@ -217,6 +227,7 @@ class MetaFSM:
         self.meta_removed = set(state.get("meta_removed", []))
         self.meta_nodes = state.get("meta_nodes", {})
         self.models = state.get("models", {})
+        self.placement = state.get("placement", {})
         self.pending.append(
             (self.applied_index, {"op": "__restore__", "state": state})
         )

@@ -581,6 +581,25 @@ class DataRouter:
         nodes.setdefault(self.self_id, self.self_addr)
         return nodes
 
+    def group_owners(self, db: str, rp_name: str, group_start: int,
+                     rf: int | None = None,
+                     nodes: list[str] | None = None) -> list[str]:
+        """Owner list for one shard group: a load-balancer placement
+        override from the meta FSM wins (filtered to nodes that still
+        exist — a removed node must not black-hole a group), else
+        rendezvous. Reference: balance_manager.go moving ownership away
+        from hot nodes."""
+        ids = sorted(self.data_nodes()) if nodes is None else nodes
+        over = getattr(self.meta_store.fsm, "placement", None)
+        if over:
+            got = over.get(f"{db}|{rp_name}|{group_start}")
+            if got:
+                live_set = set(ids)
+                kept = [n for n in got if n in live_set]
+                if kept:
+                    return kept[: max(1, rf or self.rf)]
+        return owners(ids, db, rp_name, group_start, rf or self.rf)
+
     def _group_start(self, db: str, rp: str | None, t_ns: int) -> int:
         from opengemini_tpu.storage.engine import DatabaseNotFound, WriteError
 
@@ -606,8 +625,8 @@ class DataRouter:
         ids = sorted(self.data_nodes())
         local, remote = [], {}
         for p in points:
-            dest = owners(ids, db, rp_name,
-                          self._group_start(db, rp, p[2]), self.rf)
+            dest = self.group_owners(
+                db, rp_name, self._group_start(db, rp, p[2]), nodes=ids)
             for o in dest:
                 if o == self.self_id:
                     local.append(p)
@@ -621,7 +640,9 @@ class DataRouter:
         with rf>1 include each group exactly once via this filter."""
         d = self.engine.databases.get(db)
         rp_name = rp or (d.default_rp if d else "autogen")
-        return owners(sorted(live), db, rp_name, group_start, 1)[0] == self.self_id
+        got = self.group_owners(db, rp_name, group_start, rf=1,
+                                nodes=sorted(live))
+        return got[0] == self.self_id
 
     def routed_write(self, db: str, rp: str | None, points: list,
                      consistency: str | None = None) -> int:
@@ -699,8 +720,8 @@ class DataRouter:
         rp_name = rp or (d.default_rp if d else "autogen")
         ids = sorted(self.data_nodes())
         for p in points:
-            dest = owners(ids, db, rp_name,
-                          self._group_start(db, rp, p[2]), self.rf)
+            dest = self.group_owners(
+                db, rp_name, self._group_start(db, rp, p[2]), nodes=ids)
             if sum(1 for o in dest if o not in dead) < need:
                 return False
         return True
@@ -851,6 +872,79 @@ class DataRouter:
 
     MIGRATE_CHUNK = 20_000  # points per forwarded batch
 
+    # -- load-aware balancing (reference: balance_manager.go) --------------
+
+    def collect_loads(self) -> dict[str, dict]:
+        """{node_id: disk_usage doc} for every reachable data node
+        (local node measured directly)."""
+        out: dict[str, dict] = {}
+        for nid, addr in sorted(self.data_nodes().items()):
+            if nid == self.self_id:
+                out[nid] = self.engine.disk_usage()
+                continue
+            try:
+                out[nid] = self._post(addr, "/internal/load", {"db": "_"})
+            except (OSError, RemoteScanError, ValueError):
+                continue  # unreachable node: skip this round
+        return out
+
+    def balance_round(self, min_skew_bytes: int = 64 << 20,
+                      skew_ratio: float = 1.3) -> dict | None:
+        """ONE load-balancing decision (meta-leader only): when the
+        heaviest data node carries skew_ratio x the lightest (and at
+        least min_skew_bytes more), move the largest group whose PRIMARY
+        is the heavy node to the light one via a raft-replicated
+        placement override — every node's group_owners() then excludes
+        the heavy node and its own migrate_round() streams the data over
+        the existing two-phase machinery. Returns the decision or None.
+        Reference: app/ts-meta/meta/balance_manager.go /
+        master_pt_balance_manager.go (load-reactive PT moves; rendezvous
+        handles membership-change moves already)."""
+        loads = self.collect_loads()
+        if len(loads) < 2:
+            return None
+        hot = max(loads, key=lambda n: loads[n].get("total", 0))
+        cold = min(loads, key=lambda n: loads[n].get("total", 0))
+        hot_b = loads[hot].get("total", 0)
+        cold_b = loads[cold].get("total", 0)
+        if hot == cold or hot_b < cold_b * skew_ratio + min_skew_bytes:
+            return None
+        ids = sorted(self.data_nodes())
+        over = getattr(self.meta_store.fsm, "placement", {}) or {}
+        best = None
+        for key, size in sorted(loads[hot].get("groups", {}).items(),
+                                key=lambda kv: -kv[1]):
+            try:
+                db, rp, start = key.split("|")
+                start_i = int(start)
+            except ValueError:
+                continue  # name containing '|' (legacy data): skip
+            cur = self.group_owners(db, rp, start_i, nodes=ids)
+            if cur and cur[0] == hot and cold not in cur:
+                # moving more than half the skew would just flip it
+                if size <= (hot_b - cold_b) * 0.75 and size > 0:
+                    best = (key, size, cur)
+                    break
+        if best is None:
+            return None
+        key, size, cur = best
+        # retained current owners stay FIRST: with rf>1 the primary must
+        # keep holding the data while migration is still in flight, or
+        # the primary-filtered reads would black-hole the group until
+        # the hot node's next migrate_round (the new owner has no rows
+        # yet); with rf=1 the list is just [cold] and unfiltered reads
+        # keep serving the hot node's copy until the move commits
+        new_owners = [n for n in cur if n != hot] + [cold]
+        new_owners = new_owners[: max(1, self.rf)]
+        if cold not in new_owners:
+            return None  # rf already saturated by data-holding owners
+        cmd = {"op": "set_placement", "key": key, "owners": new_owners}
+        if not self.meta_store.propose_and_wait(cmd):
+            return None
+        STATS.incr("cluster", "balance_moves")
+        return {"group": key, "bytes": size, "from": hot, "to": cold,
+                "owners": new_owners, "prior": over.get(key)}
+
     def migrate_round(self) -> int:
         """Rebalancing after membership change — TWO-PHASE (reference:
         app/ts-meta/meta/migrate_state_machine.go + engine/engine_ha.go
@@ -868,7 +962,7 @@ class DataRouter:
         ids = sorted(self.data_nodes())
         moved = 0
         for (db, rp, start), sh in sorted(self.engine._shards.items()):
-            dest = owners(ids, db, rp, start, self.rf)
+            dest = self.group_owners(db, rp, start, nodes=ids)
             if self.self_id in dest:
                 continue
             if not all(self.node_up(peer) for peer in dest):
@@ -975,7 +1069,7 @@ class DataRouter:
                 candidates.setdefault((db, rp, int(start)), None)
 
         for (db, rp, start), sh in sorted(candidates.items()):
-            dest = owners(ids, db, rp, start, self.rf)
+            dest = self.group_owners(db, rp, start, nodes=ids)
             if self.self_id not in dest:
                 continue
             local_digest = sh.content_digest() if sh is not None else {}

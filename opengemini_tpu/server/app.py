@@ -49,6 +49,33 @@ def load_config(path: str | None) -> dict:
     return cfg
 
 
+_JAX_DISTRIBUTED_UP = False
+
+
+def _init_jax_distributed(dev_cfg: dict) -> None:
+    """[device] coordinator-address + num-processes + process-id ->
+    jax.distributed.initialize BEFORE backend init, so jax.devices()
+    spans every host of the slice and make_mesh builds a global mesh
+    (DCN between hosts, ICI within — SURVEY §7 step 4; the reference's
+    analogue is its spdy node mesh). Must run before any jax use;
+    idempotent per process."""
+    global _JAX_DISTRIBUTED_UP
+    coord = dev_cfg.get("coordinator-address")
+    if not coord or _JAX_DISTRIBUTED_UP:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(dev_cfg["num-processes"]),
+        process_id=int(dev_cfg["process-id"]),
+    )
+    _JAX_DISTRIBUTED_UP = True
+    print(
+        f"jax.distributed up: process {dev_cfg['process-id']}/"
+        f"{dev_cfg['num-processes']} via {coord}", flush=True)
+
+
 def _configure_device_mesh(dev_cfg: dict) -> None:
     """[device] mesh-axes -> a process-wide jax mesh: every dense batch
     (grid / bucketed) and the AggBatch shard_map path then run multi-chip
@@ -63,6 +90,7 @@ def _configure_device_mesh(dev_cfg: dict) -> None:
         # inherit one from an earlier build() in the same process
         prt.set_mesh(None)
         return
+    _init_jax_distributed(dev_cfg)
     from opengemini_tpu.parallel import distributed as dist
 
     n = int(dev_cfg.get("mesh-devices", 0)) or None
@@ -206,6 +234,16 @@ def build(cfg: dict) -> HttpService:
             float(cluster_cfg.get("migration-interval-s", 60)),
             staging_ttl_s=float(
                 cluster_cfg.get("migration-staging-ttl-s", 900)),
+        ))
+    if svc.router is not None and svc.meta_store is not None and \
+            float(cluster_cfg.get("balance-interval-s", 3600)) > 0:
+        from opengemini_tpu.services.balancer import BalanceService
+
+        svc.services.append(BalanceService(
+            svc.router, svc.meta_store,
+            float(cluster_cfg.get("balance-interval-s", 3600)),
+            min_skew_mb=int(cluster_cfg.get("balance-min-skew-mb", 64)),
+            skew_ratio=float(cluster_cfg.get("balance-skew-ratio", 1.3)),
         ))
     return svc
 

@@ -564,6 +564,12 @@ def _make_handler(svc: HttpService):
                 groups = [[db, rp, start]
                           for (db, rp, start) in sorted(svc.engine._shards)]
                 self._send_json(200, {"groups": groups})
+            elif path == "/internal/load":
+                # balancer: this node's shard-group byte footprint
+                req = self._internal_request(svc)
+                if req is None:
+                    return
+                self._send_json(200, svc.engine.disk_usage())
             elif path == "/internal/digest":
                 # anti-entropy: this node's logical content digest of one
                 # shard group (rf>1 replica divergence detection)

@@ -1,0 +1,41 @@
+"""Load-aware balance service: periodic skew check on the meta leader.
+
+Reference: app/ts-meta/meta/balance_manager.go +
+master_pt_balance_manager.go — the reference's balance managers react to
+load reports and move PT ownership; rendezvous placement here already
+self-balances on membership change, so this service covers the OTHER
+case: byte-size skew between nodes with stable membership. Decisions are
+raft-replicated placement overrides; the data moves when the shedding
+node's own MigrationService observes it no longer owns the group.
+"""
+
+from __future__ import annotations
+
+from opengemini_tpu.services.base import Service, logger
+
+
+class BalanceService(Service):
+    name = "balancer"
+
+    def __init__(self, router, meta_store, interval_s: float = 3600.0,
+                 min_skew_mb: int = 64, skew_ratio: float = 1.3):
+        super().__init__(interval_s)
+        self.router = router
+        self.meta_store = meta_store
+        self.min_skew_bytes = int(min_skew_mb) << 20
+        self.skew_ratio = float(skew_ratio)
+
+    def handle(self) -> int:
+        if not getattr(self.meta_store, "is_leader", lambda: True)():
+            return 0  # one decision-maker per cluster
+        move = self.router.balance_round(
+            min_skew_bytes=self.min_skew_bytes,
+            skew_ratio=self.skew_ratio,
+        )
+        if move:
+            logger.info(
+                "balance: group %s (%d bytes) %s -> %s (owners %s)",
+                move["group"], move["bytes"], move["from"], move["to"],
+                move["owners"])
+            return 1
+        return 0

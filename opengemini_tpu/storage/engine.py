@@ -74,6 +74,14 @@ def _split_lp_segments(raw: bytes, n: int) -> list[bytes]:
     return segs
 
 
+def _check_namespace_name(name: str, what: str) -> None:
+    """db/rp names become directory components AND 'db|rp|start' keys in
+    the balancer's load reports and placement overrides — separators and
+    path characters must be rejected at creation."""
+    if not name or any(c in name for c in "|/\\\n\r\0") or name in (".", ".."):
+        raise WriteError(f"invalid {what} name {name!r}")
+
+
 def _go_phase_ns(dur_ns: int) -> int:
     return (_GO_ZERO_S * NS) % dur_ns  # python ints: exact, non-negative
 
@@ -307,6 +315,7 @@ class Engine:
         os.replace(tmp, self._meta_path())
 
     def create_database(self, name: str) -> None:
+        _check_namespace_name(name, "database")
         with self._lock:
             if name in self.databases:
                 return
@@ -354,6 +363,7 @@ class Engine:
         self, db: str, name: str, duration_ns: int, shard_duration_ns: int | None = None,
         default: bool = False,
     ) -> None:
+        _check_namespace_name(name, "retention policy")
         with self._lock:
             d = self.databases.get(db)
             if d is None:
@@ -395,6 +405,30 @@ class Engine:
             if default:
                 d.default_rp = name
             self._save_meta()
+
+    def disk_usage(self) -> dict:
+        """{"total": bytes, "groups": {"db|rp|start": bytes}} for live
+        shard dirs — the load signal the balancer compares across nodes
+        (reference: store load report feeding balance_manager.go)."""
+        groups: dict[str, int] = {}
+        total = 0
+        with self._lock:
+            items = list(self._shards.items())
+        for (db, rp, start), sh in items:
+            n = 0
+            try:
+                for dirpath, _dirs, files in os.walk(
+                        os.path.realpath(sh.path)):
+                    for f in files:
+                        try:
+                            n += os.path.getsize(os.path.join(dirpath, f))
+                        except OSError:
+                            pass
+            except OSError:
+                pass
+            groups[f"{db}|{rp}|{start}"] = n
+            total += n
+        return {"total": total, "groups": groups}
 
     def database_names(self) -> list[str]:
         return sorted(self.databases)

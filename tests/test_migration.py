@@ -265,3 +265,182 @@ class TestTwoPhaseMigration:
         assert _query_count(addrs, "nC") == 10
         for nid, (e, _svc) in nodes.items():
             assert not e._staging, nid
+
+
+class BalanceStoreStub(StoreStub):
+    """StoreStub + placement dict + synchronous propose (applies the
+    placement op directly, standing in for the raft round trip)."""
+
+    def __init__(self, addrs):
+        super().__init__(addrs)
+        self.fsm.placement = {}
+
+    def is_leader(self):
+        return True
+
+    def propose_and_wait(self, cmd, timeout_s=10.0):
+        if cmd["op"] == "set_placement":
+            self.fsm.placement[cmd["key"]] = list(cmd["owners"])
+            return True
+        if cmd["op"] == "drop_placement":
+            self.fsm.placement.pop(cmd["key"], None)
+            return True
+        return False
+
+
+def test_load_balance_moves_heavy_group(tmp_path):
+    """Load-aware balancing (reference: balance_manager.go): a byte-size
+    skew with stable membership triggers a placement override through
+    the meta store, and the heavy node's own migrate_round then streams
+    the group to the light node."""
+    addrs: dict = {}
+    store = BalanceStoreStub(addrs)
+    nodes = {}
+    for nid in ("nA", "nB"):
+        nodes[nid] = _mk_node(tmp_path, nid, addrs, store)
+    store.fsm = FsmStub(addrs)
+    store.fsm.placement = {}
+    _wire(nodes, addrs, store)
+    for nid in addrs:
+        nodes[nid][1].router.probe_health()
+
+    # many groups; rendezvous spreads them — then skew is FORCED by
+    # writing a fat measurement into one specific group
+    lines = "\n".join(
+        f"cpu,host=h{w % 3} v={w} {(BASE + w * 7 * 86400) * NS}"
+        for w in range(8))
+    _write(addrs, "nA", lines)
+    for nid in addrs:
+        nodes[nid][0].flush_all()
+
+    # find a group held by nA and fatten it locally
+    heavy_nid = "nA"
+    e_heavy = nodes[heavy_nid][0]
+    assert e_heavy._shards, "nA holds no groups; rewrite the test data"
+    (hdb, hrp, hstart) = sorted(e_heavy._shards)[0]
+    fat = "\n".join(
+        f"cpu,host=h0 v={i},pad=\"{'x' * 64}\" {hstart + i}"
+        for i in range(30_000))
+    e_heavy.write_lines("db", fat)
+    e_heavy.flush_all()
+
+    router = nodes[heavy_nid][1].router
+    loads = router.collect_loads()
+    assert set(loads) == {"nA", "nB"}
+    move = router.balance_round(min_skew_bytes=1, skew_ratio=1.05)
+    assert move is not None, loads
+    assert move["from"] == heavy_nid and move["to"] == "nB"
+    mdb, mrp, mstart = move["group"].split("|")
+    mkey = (mdb, mrp, int(mstart))
+    assert mkey in e_heavy._shards  # a group nA actually held
+    assert store.fsm.placement[move["group"]] == move["owners"]
+    # the chosen group cannot be bigger than 3/4 of the skew — moving
+    # the fattened (skew-sized) group would just flip the imbalance
+    skew = loads["nA"]["total"] - loads["nB"]["total"]
+    assert move["bytes"] <= skew * 0.75
+
+    # the override changes ownership everywhere
+    for nid in addrs:
+        got = nodes[nid][1].router.group_owners(mdb, mrp, int(mstart))
+        assert got == move["owners"]
+
+    # the heavy node sheds the group through the standard machinery
+    n_before = _query_count(addrs, "nA")
+    moved = router.migrate_round()
+    assert moved >= 1
+    assert mkey not in e_heavy._shards
+    assert mkey in nodes[move["to"]][0]._shards
+    # no rows lost, from either coordinator
+    for nid in addrs:
+        assert _query_count(addrs, nid) == n_before
+
+    # steady state: balanced enough, no further moves
+    assert router.balance_round(min_skew_bytes=1 << 40) is None
+
+    for _nid, (e, svc) in nodes.items():
+        svc.stop()
+        e.close()
+
+
+def test_placement_override_ignores_vanished_nodes(tmp_path):
+    addrs: dict = {}
+    store = BalanceStoreStub(addrs)
+    nodes = {}
+    for nid in ("nA", "nB"):
+        nodes[nid] = _mk_node(tmp_path, nid, addrs, store)
+    store.fsm = FsmStub(addrs)
+    store.fsm.placement = {"db|autogen|0": ["ghost"]}
+    _wire(nodes, addrs, store)
+    router = nodes["nA"][1].router
+    # every listed owner vanished: rendezvous wins, group not black-holed
+    got = router.group_owners("db", "autogen", 0)
+    assert got and "ghost" not in got
+    # partially vanished: surviving override owners win
+    store.fsm.placement["db|autogen|0"] = ["ghost", "nB"]
+    assert router.group_owners("db", "autogen", 0) == ["nB"]
+    for _nid, (e, svc) in nodes.items():
+        svc.stop()
+        e.close()
+
+
+def test_balance_override_keeps_a_data_holding_primary(tmp_path):
+    """With rf>1 the balance override must keep a retained (data-holding)
+    owner FIRST so primary-filtered reads never black-hole the group
+    while migration is still pending."""
+    addrs: dict = {}
+    store = BalanceStoreStub(addrs)
+    nodes = {}
+    for nid in ("nA", "nB", "nC"):
+        nodes[nid] = _mk_node(tmp_path, nid, addrs, store)
+    store.fsm = FsmStub(addrs)
+    store.fsm.placement = {}
+    _wire(nodes, addrs, store, rf=2)
+    for nid in addrs:
+        nodes[nid][1].router.probe_health()
+    lines = "\n".join(
+        f"cpu,host=h{w % 3} v={w} {(BASE + w * 7 * 86400) * NS}"
+        for w in range(8))
+    _write(addrs, "nA", lines)
+    for nid in addrs:
+        nodes[nid][0].flush_all()
+    # fatten several groups on whichever node is heaviest so some group
+    # under the 75%-skew cap exists
+    router = nodes["nA"][1].router
+    loads = router.collect_loads()
+    hot = max(loads, key=lambda n: loads[n]["total"])
+    e_hot = nodes[hot][0]
+    for i, key in enumerate(sorted(e_hot._shards)):
+        db, rp, start = key
+        fat = "\n".join(
+            f"cpu,host=h0 v={j},pad=\"{'y' * 32}\" {start + j}"
+            for j in range(4000 * (i % 3 + 1)))
+        e_hot.write_lines("db", fat)
+    e_hot.flush_all()
+    move = nodes[hot][1].router.balance_round(
+        min_skew_bytes=1, skew_ratio=1.01)
+    if move is None:
+        return  # loads happened to balance; nothing to assert
+    # primary (first owner) must be a RETAINED owner that holds the
+    # data, never the empty destination
+    assert move["owners"][0] != move["to"] or len(move["owners"]) == 1
+    mdb, mrp, mstart = move["group"].split("|")
+    if len(move["owners"]) > 1:
+        holder = move["owners"][0]
+        assert (mdb, mrp, int(mstart)) in nodes[holder][0]._shards
+    for _nid, (e, svc) in nodes.items():
+        svc.stop()
+        e.close()
+
+
+def test_invalid_namespace_names_rejected(tmp_path):
+    from opengemini_tpu.storage.engine import Engine, WriteError
+    import pytest as _pytest
+
+    e = Engine(str(tmp_path / "d"))
+    for bad in ("a|b", "a/b", "a\\b", "", ".", "a\nb"):
+        with _pytest.raises(WriteError):
+            e.create_database(bad)
+    e.create_database("ok")
+    with _pytest.raises(WriteError):
+        e.create_retention_policy("ok", "r|p", 0)
+    e.close()
