@@ -451,7 +451,10 @@ def bench_atspec(n_rows: int = 100_000_000, hosts: int = 100,
     NS = 1_000_000_000
     base = 1_699_999_980  # divisible by 60: windows align to the data
     pts = n_rows // hosts
-    chunk = 16_384
+    # bigger chunks at bigger scale: the sliced scan re-sweeps chunk
+    # metadata per slice, and its planner refuses when that sweep would
+    # dominate (chunks x slices budget in executor._plan_scan_slices)
+    chunk = 16_384 if n_rows <= 200_000_000 else 65_536
     root = keep_root or tempfile.mkdtemp(prefix="ogtpu-atspec-")
     try:
         from opengemini_tpu.query.executor import Executor

@@ -130,7 +130,21 @@ class SeriesIndex:
     def tag_keys(self, measurement: str) -> list[str]:
         return sorted({k for (m, k, _v) in self.postings if m == measurement})
 
+    def _with_key(self, measurement: str, key: str) -> set[int]:
+        out: set[int] = set()
+        for (m, k, _v), sids in self.postings.items():
+            if m == measurement and k == key:
+                out |= sids
+        return out
+
     def match_eq(self, measurement: str, key: str, value: str) -> set[int]:
+        if value == "":
+            # influx: a missing tag equals the empty string
+            # (server_test.go With_EmptyTags 'where empty tag'); an
+            # explicit '' posting matches too
+            return (self.series_ids(measurement)
+                    - self._with_key(measurement, key)) | set(
+                self.postings.get((measurement, key, ""), ()))
         return set(self.postings.get((measurement, key, value), ()))
 
     def match_neq(self, measurement: str, key: str, value: str) -> set[int]:
@@ -142,6 +156,11 @@ class SeriesIndex:
         for (m, k, v), sids in self.postings.items():
             if m == measurement and k == key and rx.search(v):
                 hit |= sids
+        if rx.search(""):
+            # the missing tag is "" and it matches: series without the
+            # key match the pattern too
+            hit |= self.series_ids(measurement) - self._with_key(
+                measurement, key)
         if negate:
             return self.series_ids(measurement) - hit
         return hit

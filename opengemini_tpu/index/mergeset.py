@@ -269,13 +269,33 @@ class MergesetIndex:
             ptr = self._lib.msi_series_ids(h, m, len(m), ctypes.byref(n))
         return self._sid_buf(ptr, int(n.value))
 
-    def match_eq(self, measurement: str, key: str, value: str) -> set[int]:
+    def _match_eq_raw(self, measurement: str, key: str,
+                      value: str) -> set[int]:
         m, k, v = measurement.encode(), key.encode(), value.encode()
         n = ctypes.c_uint64()
         with self._native() as h:
             ptr = self._lib.msi_match_eq(
                 h, m, len(m), k, len(k), v, len(v), ctypes.byref(n))
         return self._sid_buf(ptr, int(n.value))
+
+    def _with_key(self, measurement: str, key: str) -> set[int]:
+        """Series carrying the tag key at all (any value — including an
+        EXPLICIT empty value, hence the raw match: the ''-special
+        match_eq would recurse). Only empty-value match paths pay
+        this union."""
+        out: set[int] = set()
+        for v in self.tag_values(measurement, key):
+            out |= self._match_eq_raw(measurement, key, v)
+        return out
+
+    def match_eq(self, measurement: str, key: str, value: str) -> set[int]:
+        if value == "":
+            # influx: a missing tag equals the empty string; an explicit
+            # '' value stored in the index matches too (raw lookup)
+            return (self.series_ids(measurement)
+                    - self._with_key(measurement, key)) | \
+                self._match_eq_raw(measurement, key, "")
+        return self._match_eq_raw(measurement, key, value)
 
     def match_neq(self, measurement: str, key: str, value: str) -> set[int]:
         return self.series_ids(measurement) - self.match_eq(
@@ -312,9 +332,18 @@ class MergesetIndex:
                     negate: bool = False) -> set[int]:
         rx = re.compile(pattern)
         hit: set[int] = set()
+        empty_matches = bool(rx.search(""))  # missing tag is "" (influx)
+        with_key: set[int] = set()
         for v in self.tag_values(measurement, key):
             if rx.search(v):
-                hit |= self.match_eq(measurement, key, v)
+                got = self._match_eq_raw(measurement, key, v)
+                hit |= got
+                if empty_matches:
+                    with_key |= got
+            elif empty_matches:
+                with_key |= self._match_eq_raw(measurement, key, v)
+        if empty_matches:
+            hit |= self.series_ids(measurement) - with_key
         if negate:
             return self.series_ids(measurement) - hit
         return hit

@@ -174,12 +174,13 @@ def _sort_by_segment(values, seg_ids, num_segments, mask):
 
 
 def seg_percentile(values, seg_ids, num_segments: int, mask, q: float):
-    """Nearest-rank percentile per segment (InfluxQL percentile(): returns an
-    actual sample, rank = ceil(q/100 * n); reference
-    engine/executor/agg_func.go percentile processors)."""
+    """Nearest-rank percentile per segment (InfluxQL percentile(): returns
+    an actual sample, rank = floor(n*q/100 + 0.5) — the lifted influx rule
+    (FloatPercentileReduceSlice); reference engine/executor/agg_func.go
+    percentile processors)."""
     n = values.shape[0]
     sorted_vals, _, counts, starts = _sort_by_segment(values, seg_ids, num_segments, mask)
-    rank = jnp.ceil(q / 100.0 * counts).astype(jnp.int32)
+    rank = jnp.floor(q / 100.0 * counts + 0.5).astype(jnp.int32)
     rank = jnp.clip(rank - 1, 0, jnp.maximum(counts - 1, 0))
     sel = jnp.clip(starts + rank, 0, n - 1)
     return sorted_vals[sel]

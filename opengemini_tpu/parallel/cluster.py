@@ -903,6 +903,7 @@ class DataRouter:
         loads = self.collect_loads()
         if len(loads) < 2:
             return None
+        self._prune_placements(loads)
         hot = max(loads, key=lambda n: loads[n].get("total", 0))
         cold = min(loads, key=lambda n: loads[n].get("total", 0))
         hot_b = loads[hot].get("total", 0)
@@ -944,6 +945,31 @@ class DataRouter:
         STATS.incr("cluster", "balance_moves")
         return {"group": key, "bytes": size, "from": hot, "to": cold,
                 "owners": new_owners, "prior": over.get(key)}
+
+    def _prune_placements(self, loads: dict) -> None:
+        """Overrides must not pin groups forever: drop entries whose group
+        no longer exists on any reporting node (retention expired /
+        dropped) or whose owner list already equals plain rendezvous
+        (membership change caught up). Without this, the placement map
+        grows monotonically and defeats rendezvous self-balancing."""
+        over = dict(getattr(self.meta_store.fsm, "placement", {}) or {})
+        if not over:
+            return
+        held: set[str] = set()
+        for doc in loads.values():
+            held.update(doc.get("groups", {}))
+        ids = sorted(self.data_nodes())
+        for key, owner_list in over.items():
+            try:
+                db, rp, start = key.split("|")
+                start_i = int(start)
+            except ValueError:
+                continue
+            stale = key not in held or \
+                owner_list == owners(ids, db, rp, start_i, self.rf)
+            if stale:
+                self.meta_store.propose_and_wait(
+                    {"op": "drop_placement", "key": key})
 
     def migrate_round(self) -> int:
         """Rebalancing after membership change — TWO-PHASE (reference:

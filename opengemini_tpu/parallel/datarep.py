@@ -237,9 +237,11 @@ class DataReplication:
             start = self.router._group_start(db, rp, p[2])
             # SORTED owner set: rendezvous order varies per group start,
             # and order-variant tuples must share ONE raft group per
-            # distinct membership (not rf! of them)
-            own = tuple(sorted(owners(ids, db, rp_name, start,
-                                      self.router.rf)))
+            # distinct membership (not rf! of them). group_owners (not
+            # raw rendezvous): a balancer placement override must steer
+            # writes to the same owners migration moves the data to
+            own = tuple(sorted(self.router.group_owners(
+                db, rp_name, start, nodes=ids)))
             buckets.setdefault(own, []).append(p)
         # buckets commit through INDEPENDENT raft groups: run them
         # concurrently (a serial walk would multiply cold-group election

@@ -1075,6 +1075,15 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
             if spec.name not in ("count", "first", "last", "min", "max"):
                 raise QueryError(f"{spec.name}(time) is not supported")
         aggs = [a for a in aggs if a[3].lower() != "time"]
+        # influx: COUNT/COUNT(DISTINCT ...) over a TAG answers a constant
+        # 0 (tags are not countable fields; server_test.go
+        # Aggregates_IntMany 'count distinct select tag')
+        tag_count_aggs = [
+            a for a in aggs
+            if a[1].name in ("count", "count_distinct")
+            and a[3] not in schema and a[3] in sc.tag_keys
+        ]
+        aggs = [a for a in aggs if a not in tag_count_aggs]
 
         needed_fields = sorted({a[3] for a in aggs})
         field_filter_fields = sorted(cond.row_filter_refs(sc))
@@ -1128,10 +1137,14 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                 for lo, hi in cache_plan.scan_ranges
             ]
 
-        # string fields only support count on the device path (reference
-        # supports first/last/distinct on strings — host path, later round)
+        # string fields: count counts, mean answers influx's constant 0,
+        # stddev answers null (server_test.go Aggregates_String — the
+        # zero payload of string columns makes both fall out below);
+        # everything else is rejected (reference supports first/last on
+        # strings — host path, later round)
         for call, spec, params, field_name in aggs:
-            if schema.get(field_name) == FieldType.STRING and spec.name != "count":
+            if schema.get(field_name) == FieldType.STRING and \
+                    spec.name not in ("count", "mean", "stddev"):
                 raise QueryError(
                     f"{spec.name}() is not supported on string field {field_name!r}"
                 )
@@ -1235,6 +1248,20 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                 else:
                     out, sel, counts = batches[field_name].run(
                         spec, num_segments, params)
+                if spec.name == "percentile" and params:
+                    # influx: rank floor(n*q/100+0.5)-1 < 0 yields NO row
+                    # for the window (the device kernel clamps to the
+                    # minimum sample; zero the counts so it renders empty)
+                    qv = float(params[0])
+                    ok = np.floor(counts * qv / 100.0 + 0.5) >= 1
+                    if not ok.all():
+                        counts = np.where(ok, counts, 0)
+                if spec.name == "stddev" and \
+                        schema.get(field_name) == FieldType.STRING:
+                    # string stddev renders null rows (influx
+                    # Aggregates_String; numeric singletons stay 0 — the
+                    # reference's NewStdDevReduce rule)
+                    out = np.where(counts > 0, np.nan, out)
                 if pre_used:
                     # combine device partials with pre-agg contributions
                     pc = pre_count[field_name]
@@ -1263,6 +1290,12 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                     else np.empty(0, np.int64)
                 )
                 tcounts = np.bincount(seg_all, minlength=num_segments).astype(np.int64)
+            for call, spec, params, field_name in tag_count_aggs:
+                out = np.zeros(num_segments, np.int64)
+                counts = np.zeros(num_segments, np.int64)
+                counts.reshape(num_groups, W)[:, 0] = 1  # row renders as 0
+                agg_results[id(call)] = (out, None, counts, spec,
+                                         field_name, None)
             for call, spec, _params, _f in time_aggs:
                 if spec.name == "count":
                     tout = tcounts

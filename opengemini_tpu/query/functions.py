@@ -170,9 +170,23 @@ def host_agg(name: str, times: np.ndarray, values: np.ndarray, params: tuple):
     if name == "median":
         return float(np.median(values)), None
     if name == "percentile":
+        # percentile is a SELECTOR in influx: it returns an actual sample,
+        # and without GROUP BY time() the row carries that sample's OWN
+        # timestamp (server_test.go Selectors 'percentile'); earliest
+        # point wins a value tie
         q = params[0]
-        rank = max(int(np.ceil(q / 100.0 * len(values))) - 1, 0)
-        return np.sort(values)[rank].item(), None
+        # influx nearest-rank: floor(n*q/100 + 0.5) - 1; an index below 0
+        # means NO qualifying sample (nil), not the minimum
+        # (FloatPercentileReduceSlice)
+        rank = int(np.floor(q / 100.0 * len(values) + 0.5)) - 1
+        if rank < 0 or rank >= len(values):
+            return None, None
+        order = np.argsort(values, kind="stable")
+        i = int(order[rank])
+        hits = np.flatnonzero(values == values[i])
+        sel_t = int(times[hits[np.argmin(times[hits])]]) if len(hits) \
+            else int(times[i])
+        return py_value(values[i]), sel_t
     if name == "percentile_ogsketch":
         # centroid-sketch quantile (reference percentile_ogsketch,
         # call_processor.go:41): O(compression) memory per window however
@@ -330,9 +344,11 @@ def multi_row(name: str, times: np.ndarray, values: np.ndarray, params: tuple,
         idx = np.sort(rng.choice(len(values), size=n, replace=False))
         return [(int(times[i]), values[i].item()) for i in idx]
     if name == "distinct":
-        uniq = np.unique(values)
-        # influx returns distinct values with the epoch window time
-        return [(None, py_value(v)) for v in uniq]
+        # influx returns distinct values in FIRST-APPEARANCE order, with
+        # the window time (server_test.go AggregateSelectors 'distinct')
+        uniq, idx = np.unique(values, return_index=True)
+        order = np.argsort(idx)
+        return [(None, py_value(uniq[i])) for i in order]
     if name == "detect":
         from opengemini_tpu.services.castor import detect as _detect
         from opengemini_tpu.services.castor import detect_fitted as _fitted

@@ -36,6 +36,47 @@ from opengemini_tpu.query.qhelpers import (  # noqa: F401
 )
 
 
+def _eval_host_output(e, bt, col_maps, call_plan_idx):
+    """Evaluate a call-math output expression at one window: leaves are
+    host-call plan columns (absent -> null, which poisons the expression
+    like influx), numeric literals, and +-*/% with null-on-zero-divide."""
+    e = _strip_expr(e)
+    if isinstance(e, ast.Call):
+        entry = col_maps[call_plan_idx[id(e)]].get(bt)
+        if entry is None:
+            return None, False
+        return entry[0], True
+    if isinstance(e, (ast.IntegerLiteral, ast.NumberLiteral)):
+        return e.val, False
+    if isinstance(e, ast.DurationLiteral):
+        return e.val_ns, False
+    if isinstance(e, ast.UnaryExpr) and e.op == "-":
+        v, p = _eval_host_output(e.expr, bt, col_maps, call_plan_idx)
+        return (None if v is None else -v), p
+    if isinstance(e, ast.BinaryExpr):
+        lv, lp = _eval_host_output(e.lhs, bt, col_maps, call_plan_idx)
+        rv, rp = _eval_host_output(e.rhs, bt, col_maps, call_plan_idx)
+        present = lp or rp
+        if lv is None or rv is None:
+            return None, present
+        try:
+            if e.op == "+":
+                return lv + rv, present
+            if e.op == "-":
+                return lv - rv, present
+            if e.op == "*":
+                return lv * rv, present
+            if e.op == "/":
+                return (None if rv == 0 else lv / rv), present
+            if e.op == "%":
+                return (None if rv == 0 else lv % rv), present
+        except TypeError:
+            return None, present
+    raise QueryError(
+        "unsupported expression in host-path SELECT (functions, numbers "
+        "and +-*/% only)")
+
+
 class HostPathMixin:
     def _select_percentile_approx(self, stmt, db, rp, mst, now_ns, call) -> list[dict]:
         """percentile_approx(field, q): served from the per-chunk histogram
@@ -497,13 +538,41 @@ class HostPathMixin:
         # resolve output columns
         plans = []  # (name, kind, call_name, field, params, inner_agg|None)
         multi_plan = None
+        outputs = []  # (name, plan_index | ast expr for call math)
+        call_plan_idx: dict[int, int] = {}  # id(call) -> plans index
+
+        def _plan_call(e: ast.Call) -> int:
+            kind, call_name, field, params, inner = _resolve_host_call(
+                e, group_time)
+            _check_host_field_type(
+                inner[0] if kind == "sliding" and inner else call_name,
+                field, schema)
+            if kind == "multi":
+                raise QueryError(
+                    f"{call_name}() cannot be combined with other "
+                    "expressions")
+            plans.append((None, kind, call_name, field, params, inner))
+            call_plan_idx[id(e)] = len(plans) - 1
+            return len(plans) - 1
+
         for f in stmt.fields:
             e = _strip_expr(f.expr)
+            if isinstance(e, ast.VarRef) and e.name.lower() == "time":
+                continue  # explicit `time` is always column 0
             if not isinstance(e, ast.Call):
-                raise QueryError(
-                    "expressions mixing functions and math are not supported "
-                    "in the host function path yet"
-                )
+                # scalar math over host calls: `4 * mode(v)`,
+                # `sum(v) / elapsed(sum(v), 1m)` — every leaf call gets
+                # its own plan, the expression evaluates per window
+                # (reference: sql-side binary-expr materialize transform)
+                calls = _calls_in(f.expr)
+                if not calls:
+                    raise QueryError(
+                        "host-path expressions need at least one function")
+                for c in calls:
+                    _plan_call(c)
+                outputs.append((f.alias or _default_field_name(f.expr),
+                                f.expr))
+                continue
             name = f.alias or _default_field_name(e)
             kind, call_name, field, params, inner = _resolve_host_call(e, group_time)
             _check_host_field_type(
@@ -512,9 +581,16 @@ class HostPathMixin:
             if kind == "multi":
                 if len(stmt.fields) > 1:
                     raise QueryError(f"{call_name}() must be the only field")
+                if call_name == "distinct" and field in sc.tag_keys \
+                        and field not in schema:
+                    # influx: DISTINCT over a tag is not a field selection
+                    raise QueryError(
+                        "statement must have at least one field in "
+                        "select clause")
                 multi_plan = (name, call_name, field, params)
             else:
                 plans.append((name, kind, call_name, field, params, inner))
+                outputs.append((name, len(plans) - 1))
 
         fitted_models = None
         if multi_plan is not None and multi_plan[1] == "detect" \
@@ -592,7 +668,12 @@ class HostPathMixin:
 
             # single raw transform: emit rows directly — dict keying would
             # collapse rows when two series in the group share a timestamp
-            if len(plans) == 1 and plans[0][1] == "transform_raw":
+            if (len(plans) == 1 and plans[0][1] == "transform_raw"
+                    and len(outputs) == 1
+                    and isinstance(outputs[0][1], int)):
+                # bare transform only: a call-math output (e.g.
+                # difference(v) * 2) must go through the expression
+                # evaluator below, not this direct-emit path
                 name, _kind, call_name, fname, params, _inner = plans[0]
                 t, v = field_rows(fname)
                 if not stmt.ascending:
@@ -633,10 +714,18 @@ class HostPathMixin:
                 if kind == "agg":
                     has_plain_agg = True
                     m: dict = {}
-                    for wt, sl in window_slices(t):
-                        val, sel_t = fnmod.host_agg(call_name, t[sl], v[sl], params)
-                        if val is not None:
-                            m[wt] = (val, sel_t)
+                    if (call_name in ("count", "count_distinct")
+                            and fname not in schema
+                            and fname in sc.tag_keys):
+                        # influx: COUNT(DISTINCT <tag>) answers 0, not an
+                        # empty result (tags are not countable fields)
+                        m[window_times[0]] = (0, None)
+                    else:
+                        for wt, sl in window_slices(t):
+                            val, sel_t = fnmod.host_agg(
+                                call_name, t[sl], v[sl], params)
+                            if val is not None:
+                                m[wt] = (val, sel_t)
                     col_maps.append(m)
                 elif kind == "sliding":
                     n = int(params[0])
@@ -679,24 +768,34 @@ class HostPathMixin:
                 seen = sorted({t for m in col_maps for t in m})
                 base_times = seen
             rows = []
+            col_names = [name for name, _src in outputs]
             for bt in base_times:
                 vals = []
                 present = False
-                for m in col_maps:
-                    entry = m.get(bt)
-                    if entry is None:
-                        vals.append(None)
-                    else:
-                        vals.append(entry[0])
-                        present = True
-                # single bare selector-time semantics
+                for _name, src in outputs:
+                    if isinstance(src, int):
+                        entry = col_maps[src].get(bt)
+                        if entry is None:
+                            vals.append(None)
+                        else:
+                            vals.append(entry[0])
+                            present = True
+                    else:  # call-math expression over plan columns
+                        v, p = _eval_host_output(
+                            src, bt, col_maps, call_plan_idx)
+                        vals.append(v)
+                        present = present or p
+                # single BARE selector-time semantics: a selector inside
+                # math is an aggregate (influx strips the sample time)
                 t_render = bt
-                if len(plans) == 1 and not group_time:
+                if (len(plans) == 1 and not group_time
+                        and len(outputs) == 1
+                        and isinstance(outputs[0][1], int)):
                     entry = col_maps[0].get(bt)
                     if entry and entry[1] is not None:
                         t_render = entry[1]
                 rows.append((t_render, vals, present))
-            rows = _apply_fill(rows, stmt, ["time"] + [p[0] for p in plans])
+            rows = _apply_fill(rows, stmt, ["time"] + col_names)
             if not stmt.ascending:
                 rows.reverse()
             if stmt.offset:
@@ -707,7 +806,7 @@ class HostPathMixin:
                 continue
             series = {
                 "name": mst,
-                "columns": ["time"] + [p[0] for p in plans],
+                "columns": ["time"] + col_names,
                 "values": [[t] + v for t, v, _p in rows],
             }
             if group_tags:

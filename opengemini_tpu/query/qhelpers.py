@@ -582,6 +582,12 @@ def _classify_select(stmt: ast.SelectStatement) -> str:
     if not calls:
         return "raw"
     if all(_is_device_call(c) for c in calls):
+        if stmt.group_by_time is None and any(
+                c.name == "percentile" for c in calls):
+            # percentile is a SELECTOR: without GROUP BY time() the row
+            # carries the selected sample's own timestamp, which the
+            # device kernel does not surface (server_test.go Selectors)
+            return "host"
         return "device"
     return "host"
 

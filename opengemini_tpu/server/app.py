@@ -63,6 +63,12 @@ def _init_jax_distributed(dev_cfg: dict) -> None:
     coord = dev_cfg.get("coordinator-address")
     if not coord or _JAX_DISTRIBUTED_UP:
         return
+    missing = [k for k in ("num-processes", "process-id")
+               if dev_cfg.get(k) is None]
+    if missing:
+        raise SystemExit(
+            "[device] coordinator-address requires "
+            + " and ".join(missing))
     import jax
 
     jax.distributed.initialize(
@@ -84,13 +90,16 @@ def _configure_device_mesh(dev_cfg: dict) -> None:
     analogue is coordinator/shard_mapper.go:61."""
     from opengemini_tpu.parallel import runtime as prt
 
+    # multi-host init is independent of the mesh config: a coordinator
+    # address alone must still join the slice (jax.devices() then spans
+    # every host even if this node runs without a mesh)
+    _init_jax_distributed(dev_cfg)
     axes = dev_cfg.get("mesh-axes")
     if not axes:
         # the mesh is process-global: a config without [device] must not
         # inherit one from an earlier build() in the same process
         prt.set_mesh(None)
         return
-    _init_jax_distributed(dev_cfg)
     from opengemini_tpu.parallel import distributed as dist
 
     n = int(dev_cfg.get("mesh-devices", 0)) or None

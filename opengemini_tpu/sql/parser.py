@@ -582,6 +582,14 @@ class Parser:
             return ast.VarRef("$" + name)
         if tok.kind in ("IDENT", "KEYWORD"):
             name = tok.val
+            # influx alternate DISTINCT syntax (parser.go parseDistinct):
+            # `SELECT DISTINCT value`, `COUNT(DISTINCT value)` — a bare
+            # identifier right after `distinct` is its argument
+            if name.lower() == "distinct":
+                nxt = self.lex.peek()
+                if nxt.kind == "IDENT":
+                    self.lex.next()
+                    return ast.Call("distinct", (ast.VarRef(nxt.val),))
             if self._accept_op("("):
                 args = []
                 if not self._accept_op(")"):

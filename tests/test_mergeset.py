@@ -75,7 +75,15 @@ class TestParityWithDictIndex:
             sids[(k, v)] = ix.get_or_create("m", ((k, v),))
         assert len(set(sids.values())) == len(nasty)  # no aliasing
         for (k, v), sid in sids.items():
-            assert ix.match_eq("m", k, v) == {sid}
+            if v == "":
+                # influx '' semantics: the explicit-empty series AND
+                # every series missing the key match
+                got = ix.match_eq("m", k, v)
+                assert sid in got
+                assert got == {s for (k2, _v2), s in sids.items()
+                               if k2 != k} | {sid}
+            else:
+                assert ix.match_eq("m", k, v) == {sid}
             assert ix.tags_of(sid) == {k: v}
         ix.close()
 

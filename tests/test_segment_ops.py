@@ -140,7 +140,9 @@ def test_percentile(rng, q):
         vals = np.sort(v[rows][m[rows]])
         if not len(vals):
             continue
-        rank = max(int(np.ceil(q / 100.0 * len(vals))) - 1, 0)
+        # influx nearest-rank: floor(n*q/100 + 0.5) - 1
+        # (FloatPercentileReduceSlice)
+        rank = max(int(np.floor(q / 100.0 * len(vals) + 0.5)) - 1, 0)
         assert got[sid] == vals[rank]
 
 

@@ -1,0 +1,58 @@
+"""Regenerate tests/parity_skipped_ledger.json: which of the reference
+suite's OWN skipped queries this framework answers correctly
+(beyond-reference coverage; see tests/test_parity.py
+test_parity_beyond_reference).
+
+Usage: PYTHONPATH=. python tools/parity_skipped_sweep.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    tests_dir = os.path.join(os.path.dirname(__file__), "..", "tests")
+    sys.path.insert(0, tests_dir)
+    import parity_common as pc
+
+    passing, failing = [], []
+    for case in pc.load_cases():
+        skipped = [(i, q) for i, q in enumerate(case["queries"])
+                   if q.get("skip")]
+        if not skipped:
+            continue
+        srv = pc.ParityServer(tempfile.mkdtemp())
+        try:
+            srv.prepare(case)
+        except Exception as e:  # noqa: BLE001
+            failing += [(f"{case['name']}#{i}", f"setup: {e}")
+                        for i, _q in skipped]
+            srv.close()
+            continue
+        for i, q in skipped:
+            qid = f"{case['name']}#{i}"
+            try:
+                ok, why = pc.result_matches(q["exp"], srv.query(q, case["db"]))
+            except Exception as e:  # noqa: BLE001
+                ok, why = False, f"exception: {e}"
+            (passing if ok else failing).append((qid, why))
+        srv.close()
+    total = len(passing) + len(failing)
+    print(f"beyond-reference: {len(passing)}/{total} answered correctly")
+    out = os.path.join(tests_dir, "parity_skipped_ledger.json")
+    with open(out, "w") as f:
+        json.dump(sorted(q for q, _w in passing), f, indent=1)
+    for q, why in failing:
+        print("FAIL", q, str(why)[:100])
+
+
+if __name__ == "__main__":
+    main()
