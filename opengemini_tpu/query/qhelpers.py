@@ -582,11 +582,13 @@ def _classify_select(stmt: ast.SelectStatement) -> str:
     if not calls:
         return "raw"
     if all(_is_device_call(c) for c in calls):
-        if stmt.group_by_time is None and any(
-                c.name == "percentile" for c in calls):
-            # percentile is a SELECTOR: without GROUP BY time() the row
-            # carries the selected sample's own timestamp, which the
-            # device kernel does not surface (server_test.go Selectors)
+        if (stmt.group_by_time is None and len(calls) == 1
+                and calls[0].name == "percentile"):
+            # a SINGLE bare percentile is a SELECTOR: the row carries
+            # the selected sample's own timestamp, which the device
+            # kernel does not surface (server_test.go Selectors).
+            # Combined with other aggregates the time is epoch anyway —
+            # keep the device/pushdown path then.
             return "host"
         return "device"
     return "host"

@@ -808,9 +808,58 @@ class ShowDdlMixin:
         return {"series": series} if series else {}
 
 
+    @staticmethod
+    def _split_value_predicates(expr):
+        """Split a SHOW TAG VALUES condition into (series condition,
+        [output-value predicates]): influx lets WHERE reference the
+        output `value` column (server_test.go ShowTagValues 'with value
+        filter'). Only top-level AND conjuncts split; anything else
+        stays a series condition."""
+        preds: list = []
+
+        def walk(e):
+            if isinstance(e, ast.ParenExpr):
+                return walk(e.expr)
+            if isinstance(e, ast.BinaryExpr):
+                if e.op.upper() == "AND":
+                    lhs = walk(e.lhs)
+                    rhs = walk(e.rhs)
+                    if lhs is None:
+                        return rhs
+                    if rhs is None:
+                        return lhs
+                    return ast.BinaryExpr("AND", lhs, rhs)
+                lv = e.lhs
+                if isinstance(lv, ast.ParenExpr):
+                    lv = lv.expr
+                if (isinstance(lv, ast.VarRef) and lv.name == "value"
+                        and e.op in ("=", "!=", "=~", "!~")
+                        and isinstance(e.rhs,
+                                       (ast.StringLiteral, ast.RegexLiteral))):
+                    preds.append((e.op, e.rhs))
+                    return None
+            return e
+
+        return walk(expr), preds
+
+    @staticmethod
+    def _value_pred_ok(v: str, preds) -> bool:
+        for op, rhs in preds:
+            if op == "=" and v != rhs.val:
+                return False
+            if op == "!=" and v == rhs.val:
+                return False
+            if op in ("=~", "!~"):
+                hit = re.search(rhs.pattern, v) is not None
+                if (op == "=~") != hit:
+                    return False
+        return True
+
     def _show_tag_values(self, stmt, db) -> dict:
         db = stmt.database or db
         key_rx = re.compile(stmt.key_regex) if stmt.key_regex else None
+        series_cond, value_preds = self._split_value_predicates(
+            stmt.condition)
         per_mst: dict[str, set] = {}
         for sh in self._all_shards_db(db):
             for mst in sh.measurements():
@@ -822,7 +871,7 @@ class ShowDdlMixin:
                 ]
                 if not wanted:
                     continue
-                if stmt.condition is None:
+                if series_cond is None:
                     # no series filter: direct inverted-index lookup, never
                     # an O(series) walk (1M-series measurements)
                     bucket = per_mst.setdefault(mst, set())
@@ -830,13 +879,16 @@ class ShowDdlMixin:
                         for v in sh.index.tag_values(mst, k):
                             bucket.add((k, v))
                     continue
-                for sid in self._matching_sids(sh, mst, stmt.condition):
+                for sid in self._matching_sids(sh, mst, series_cond):
                     _, tags = sh.index.series_entry(sid)
                     for k, v in tags:
                         if k in wanted:
                             per_mst.setdefault(mst, set()).add((k, v))
         series = []
         for mst, pairs in sorted(per_mst.items()):
+            if value_preds:
+                pairs = {(k, v) for k, v in pairs
+                         if self._value_pred_ok(v, value_preds)}
             uniq = sorted(pairs, reverse=stmt.order_desc)
             if stmt.offset:
                 uniq = uniq[stmt.offset:]
