@@ -528,6 +528,12 @@ class HostPathMixin:
                 e = tb[0]
                 _kind, call_name, field, params, _inner = _resolve_host_call(
                     e, group_time)
+                if len(params) == 2 and isinstance(params[1], tuple):
+                    # companion columns would silently ignore the
+                    # per-tag selection — refuse loudly
+                    raise QueryError(
+                        f"{call_name}(field, tag..., N) cannot be "
+                        "combined with other columns")
                 name = next(
                     (f.alias for f in stmt.fields
                      if _strip_expr(f.expr) is e and f.alias),
@@ -643,6 +649,18 @@ class HostPathMixin:
                     for w in range(W)
                 ]
 
+            if multi_plan is not None and len(multi_plan[3]) == 2 and \
+                    multi_plan[1] in ("top", "bottom") and \
+                    isinstance(multi_plan[3][1], tuple):
+                series = self._multi_top_tags(
+                    stmt, multi_plan, groups[key], mst, tmin, tmax, sc,
+                    window_slices)
+                if series is not None:
+                    if group_tags:
+                        series["tags"] = dict(zip(group_tags, key))
+                    out_series.append(series)
+                continue
+
             if multi_plan is not None:
                 name, call_name, fname, params = multi_plan
                 t, v = field_rows(fname)
@@ -720,6 +738,10 @@ class HostPathMixin:
                         # influx: COUNT(DISTINCT <tag>) answers 0, not an
                         # empty result (tags are not countable fields)
                         m[window_times[0]] = (0, None)
+                    elif (call_name == "median"
+                          and schema.get(fname) == FieldType.STRING):
+                        # influx: MEDIAN over strings renders a null row
+                        m[window_times[0]] = (None, None)
                     else:
                         for wt, sl in window_slices(t):
                             val, sel_t = fnmod.host_agg(
@@ -813,6 +835,83 @@ class HostPathMixin:
                 series["tags"] = dict(zip(group_tags, key))
             out_series.append(series)
         return out_series
+
+    def _multi_top_tags(self, stmt, multi_plan, shard_sids, mst, tmin,
+                        tmax, sc, window_slices):
+        """top/bottom(field, tag..., N): per window, each DISTINCT tag
+        combination contributes its best point, and the best N
+        combinations emit (time-ascending). The tag columns ride along —
+        and INTO writes them back as TAGS, not fields (reference:
+        TestServer_Query_TopBottomWriteTags)."""
+        name, call_name, fname, (n_take, tagkeys) = multi_plan
+        want_top = call_name == "top"
+        ts_list, vs_list, ci_list = [], [], []
+        combos: list[tuple] = []
+        combo_idx: dict[tuple, int] = {}
+        filter_fields = [fname] + sorted(cond.row_filter_refs(sc))
+        for sh, sid in shard_sids:
+            TRACKER.check()
+            rec = sh.read_series(mst, sid, tmin, tmax, fields=filter_fields)
+            col = rec.columns.get(fname)
+            if col is None or len(rec) == 0:
+                continue
+            m = col.valid.copy()
+            if sc.has_row_filter:
+                m &= cond.eval_row_filter(sc, rec, tags=sh.index.tags_of(sid))
+            if not m.any():
+                continue
+            tags = sh.index.tags_of(sid)
+            combo = tuple(tags.get(k, "") for k in tagkeys)
+            ci = combo_idx.get(combo)
+            if ci is None:
+                ci = combo_idx[combo] = len(combos)
+                combos.append(combo)
+            ts_list.append(rec.times[m])
+            vs_list.append(col.values[m])  # native dtype: int64 stays exact
+            ci_list.append(np.full(int(m.sum()), ci, np.int64))
+        if not ts_list:
+            return None
+        t = np.concatenate(ts_list)
+        v = np.concatenate(vs_list)
+        ci = np.concatenate(ci_list)
+        order = np.argsort(t, kind="stable")
+        t, v, ci = t[order], v[order], ci[order]
+        rows = []
+        for wt, sl in window_slices(t):
+            tw, vw, cw = t[sl], v[sl], ci[sl]
+            if not len(tw):
+                continue
+            best: dict[int, tuple] = {}  # combo -> (value, time)
+            for i in range(len(tw)):
+                cur = best.get(int(cw[i]))
+                better = cur is None or (
+                    (vw[i] > cur[0]) if want_top else (vw[i] < cur[0]))
+                # value ties keep the EARLIEST point (time-sorted walk:
+                # first seen wins)
+                if better:
+                    best[int(cw[i])] = (vw[i], int(tw[i]))
+            ranked = sorted(
+                best.items(),
+                key=lambda kv: ((-kv[1][0]) if want_top else kv[1][0],
+                                kv[1][1]))[:n_take]
+            picked = sorted(ranked, key=lambda kv: kv[1][1])  # time asc
+            for combo_i, (val, t_ns) in picked:
+                rows.append([t_ns, fnmod.py_value(val)]
+                            + list(combos[combo_i]))
+        if not stmt.ascending:
+            rows.reverse()
+        if stmt.offset:
+            rows = rows[stmt.offset:]
+        if stmt.limit:
+            rows = rows[: stmt.limit]
+        if not rows:
+            return None
+        series = {"name": mst, "columns": ["time", name] + list(tagkeys),
+                  "values": rows}
+        if stmt.into is not None:
+            # INTO must write the tag columns back as TAGS
+            series["_tag_cols"] = list(tagkeys)
+        return series
 
     # -- raw path -----------------------------------------------------------
 

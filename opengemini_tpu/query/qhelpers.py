@@ -39,7 +39,8 @@ class QueryError(Exception):
 
 # host calls safe on string columns (python-object values end-to-end)
 _STRING_OK_HOST = {"count", "count_distinct", "mode", "first", "last",
-                   "distinct", "elapsed", "absent"}
+                   "distinct", "elapsed", "absent",
+                   "median"}  # median(string) renders a null row (influx)
 
 
 def _check_host_field_type(call_name: str, field: str, schema: dict) -> None:
@@ -693,6 +694,17 @@ def _resolve_host_call(call: ast.Call, group_time):
         fld = _strip_expr(call.args[0])
         if not isinstance(fld, ast.VarRef):
             raise QueryError(f"{name}() argument must be a field")
+        if name in ("top", "bottom") and len(call.args) > 2:
+            # top(field, tag..., N): best N values from DISTINCT tag
+            # combinations, one per combination (influx parser.go
+            # parseCall top/bottom tag-key form)
+            mids = [_strip_expr(a) for a in call.args[1:-1]]
+            if all(isinstance(m, ast.VarRef) for m in mids):
+                n = int(_call_param_value(call.args[-1]))
+                if n < 1:
+                    raise QueryError(f"{name}() N must be >= 1")
+                return ("multi", name, fld.name,
+                        (n, tuple(m.name for m in mids)), None)
         if name == "detect":
             # detect(field, 'algorithm'[, threshold]): string only in slot 0
             params = []

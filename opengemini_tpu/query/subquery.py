@@ -238,12 +238,33 @@ class SubqueryMixin:
             raise QueryError(f"database not found: {tgt_db}")
         points = []
         for series in series_list:
-            tags = tuple(sorted(series.get("tags", {}).items()))
+            base_tags = dict(series.get("tags", {}))
             cols = series["columns"][1:]
+            # top/bottom(field, tag, N) columns marked as tags write back
+            # as TAGS (reference TestServer_Query_TopBottomWriteTags)
+            tag_cols = set(series.get("_tag_cols", ()))
+            tag_idx = [(i, c) for i, c in enumerate(cols) if c in tag_cols]
+            if not tag_idx:
+                # the common path: one tag tuple per series, never per row
+                tags_t = tuple(sorted(base_tags.items()))
+                for row in series["values"]:
+                    fields = _row_fields(cols, row[1:])
+                    if fields:
+                        points.append((target.name, tags_t, row[0], fields))
+                continue
+            field_idx = [i for i, c in enumerate(cols) if c not in tag_cols]
             for row in series["values"]:
-                fields = _row_fields(cols, row[1:])
+                vals = row[1:]
+                fields = _row_fields([cols[i] for i in field_idx],
+                                     [vals[i] for i in field_idx])
                 if fields:
-                    points.append((target.name, tags, row[0], fields))
+                    tags = dict(base_tags)
+                    for i, c in tag_idx:
+                        if vals[i] is not None:
+                            tags[c] = str(vals[i])
+                    points.append((target.name,
+                                   tuple(sorted(tags.items())),
+                                   row[0], fields))
         if not points:
             return 0
         if self.router is not None:
