@@ -246,43 +246,56 @@ def _merge_bulk_sorted_fast(parts, lo_t: int, hi_t: int):
     vectorized monotonicity pass. Returns None when the shape does not
     apply (multi-sid parts, overlapping chunks, duplicate timestamps) —
     the caller's general merge handles those."""
+    # PRECONDITION: every part is internally time-sorted (TSF chunks are
+    # written sorted, memtable bulk parts sort on freeze) — searchsorted
+    # slicing below relies on it; the post-slice monotonicity check still
+    # rejects cross-part overlap/duplicates.
     single = []
+    ftypes: dict[str, object] = {}
     for s, r in parts:
-        if s[0] != s[-1]:
+        # CONSTANT sid required — endpoints alone are not enough: a
+        # time-sorted memtable part can interleave sids and still have
+        # s[0] == s[-1]
+        if s[0] != s[-1] or not (s == s[0]).all():
             return None
-        single.append((int(s[0]), s, r))
+        # column set collects over ALL parts — a part fully trimmed by
+        # the time range must still contribute its (all-invalid) columns,
+        # like the general merge path does
+        for name, col in r.columns.items():
+            ftypes.setdefault(name, col.ftype)
+        # pre-slice each part to [lo_t, hi_t): parts are time-sorted, so
+        # two searchsorteds trim chunk-straddle rows as VIEWS before any
+        # copy — the former post-concat range mask was a second full pass
+        lo = int(np.searchsorted(r.times, lo_t, "left"))
+        hi = int(np.searchsorted(r.times, hi_t, "left"))
+        if hi <= lo:
+            continue
+        single.append((int(s[0]), lo, hi, r))
+    if not single:
+        return np.empty(0, np.int64), Record(np.empty(0, np.int64), {})
     # stable by sid: parts of one series keep oldest-first order, which
     # the monotonicity check below then validates
     single.sort(key=lambda x: x[0])
-    sid_all = np.concatenate([s for _k, s, _r in single])
-    t_all = np.concatenate([r.times for _k, _s, r in single])
+    t_all = np.concatenate([r.times[lo:hi] for _k, lo, hi, r in single])
+    sid_all = np.concatenate(
+        [np.full(hi - lo, k, np.int64) for k, lo, hi, _r in single])
     ds = np.diff(sid_all)
     if not ((ds > 0) | ((ds == 0) & (np.diff(t_all) > 0))).all():
         return None  # overlap or duplicates: general merge required
-    in_range = (t_all >= lo_t) & (t_all < hi_t)
-    all_in = bool(in_range.all())
-    ftypes: dict[str, object] = {}
-    for _k, _s, r in single:
-        for name, col in r.columns.items():
-            ftypes.setdefault(name, col.ftype)
     cols = {}
+    total = len(t_all)
     for name, ftype in ftypes.items():
-        total = len(sid_all)
         values = _zeroed(ftype, total)
         valid = np.zeros(total, dtype=np.bool_)
         at = 0
-        for _k, _s, r in single:
-            m = len(r)
+        for _k, lo, hi, r in single:
+            m = hi - lo
             col = r.columns.get(name)
             if col is not None:
-                values[at:at + m] = col.values
-                valid[at:at + m] = col.valid
+                values[at:at + m] = col.values[lo:hi]
+                valid[at:at + m] = col.valid[lo:hi]
             at += m
-        cols[name] = Column(ftype, values, valid) if all_in else \
-            Column(ftype, values[in_range], valid[in_range])
-    if not all_in:
-        sid_all = sid_all[in_range]
-        t_all = t_all[in_range]
+        cols[name] = Column(ftype, values, valid)
     return sid_all, Record(t_all, cols)
 
 
