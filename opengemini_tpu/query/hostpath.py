@@ -36,6 +36,14 @@ from opengemini_tpu.query.qhelpers import (  # noqa: F401
 )
 
 
+def _is_time_field(f) -> bool:
+    """Explicit `SELECT time, ...` — always column 0, never a real
+    projection/companion (the one definition all three call sites
+    share)."""
+    e = _strip_expr(f.expr)
+    return isinstance(e, ast.VarRef) and e.name.lower() == "time"
+
+
 def _eval_host_output(e, bt, col_maps, call_plan_idx):
     """Evaluate a call-math output expression at one window: leaves are
     host-call plan columns (absent -> null, which poisons the expression
@@ -375,6 +383,8 @@ class HostPathMixin:
 
         cols = []  # (output name, spec)
         for f in stmt.fields:
+            if _is_time_field(f):
+                continue  # explicit time is column 0, not a companion
             e = _strip_expr(f.expr)
             if isinstance(e, ast.Call):
                 cols.append((f.alias or _default_field_name(e), ("top",)))
@@ -562,9 +572,9 @@ class HostPathMixin:
             return len(plans) - 1
 
         for f in stmt.fields:
-            e = _strip_expr(f.expr)
-            if isinstance(e, ast.VarRef) and e.name.lower() == "time":
+            if _is_time_field(f):
                 continue  # explicit `time` is always column 0
+            e = _strip_expr(f.expr)
             if not isinstance(e, ast.Call):
                 # scalar math over host calls: `4 * mode(v)`,
                 # `sum(v) / elapsed(sum(v), 1m)` — every leaf call gets
@@ -585,7 +595,8 @@ class HostPathMixin:
                 inner[0] if kind == "sliding" and inner else call_name,
                 field, schema)
             if kind == "multi":
-                if len(stmt.fields) > 1:
+                if sum(1 for f2 in stmt.fields
+                       if not _is_time_field(f2)) > 1:
                     raise QueryError(f"{call_name}() must be the only field")
                 if call_name == "distinct" and field in sc.tag_keys \
                         and field not in schema:
