@@ -401,11 +401,15 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
 
         def timed_uncached():
             # scan+compute time with kernels warm and the result cache
-            # out of the picture (cleared per run)
-            ex._inc_cache.clear()
-            run()  # warm any remaining shape
-            ex._inc_cache.clear()
-            return run()
+            # out of the picture (cleared per run); best-of-3 — this
+            # box's wall clocks swing run to run, and a single sample
+            # made grid_vs_bucketed_speedup noise (r05 recorded 0.72
+            # from one sample; repeated runs spanned 0.5-3.5x)
+            best = float("inf")
+            for _ in range(3):
+                ex._inc_cache.clear()
+                best = min(best, run())
+            return best
 
         t_warm = timed_uncached()  # grid path
         # A/B: same query with the grid fast path disabled (bucketed
@@ -429,6 +433,77 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
             "query_warm_rows_per_s": round(rows / t_warm),
             "query_warm_bucketed_s": round(t_warm_bucketed, 3),
             "grid_vs_bucketed_speedup": round(t_warm_bucketed / max(t_warm, 1e-9), 2),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_scan_floor(rows: int = 8_000_000, chunk: int = 16_384) -> dict:
+    """The host-side scan floor: decoded rows/s of real TSF chunks,
+    serial (the pre-scanpool path) vs pooled (storage/scanpool.py).
+    This is the stage that caps every query on a real accelerator — the
+    1B-row run measured ~4.7M rows/s serial decode, far below what a TPU
+    consumes — so its trajectory is tracked per round from now on."""
+    import shutil
+    import tempfile
+
+    from opengemini_tpu.record import Column, FieldType, Record
+    from opengemini_tpu.storage import scanpool
+    from opengemini_tpu.storage.tsf import TSFReader, TSFWriter
+
+    NS = 1_000_000_000
+    base = 1_700_000_000
+    root = tempfile.mkdtemp(prefix="ogtpu-scanfloor-")
+    try:
+        path = os.path.join(root, "00000001.tsf")
+        w = TSFWriter(path)
+        rng = np.random.default_rng(11)
+        sid = 0
+        for lo in range(0, rows, chunk):
+            n = min(chunk, rows - lo)
+            idx = np.arange(lo, lo + n, dtype=np.int64)
+            times = (base * NS) + idx * NS
+            vals = rng.standard_normal(n) + 50.0
+            rec = Record(times, {"v": Column(
+                FieldType.FLOAT, vals, np.ones(n, np.bool_))})
+            w.add_chunk("cpu", sid, rec)
+            sid += 1
+        w.finish()
+        r = TSFReader(path)
+        chunks = r.chunks("cpu")
+
+        def jobs():
+            # cache=False: every trial decodes for real
+            return [lambda c=c: r.read_chunk("cpu", c, cache=False)
+                    for c in chunks]
+
+        def timed(pooled: bool) -> float:
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                if pooled:
+                    for _out in scanpool.map_ordered(
+                            jobs(),
+                            [scanpool.est_chunk_bytes(c, None)
+                             for c in chunks]):
+                        pass
+                else:
+                    with scanpool.forced_serial():
+                        for job in jobs():
+                            job()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t_serial = timed(False)
+        t_pooled = timed(True)
+        r.close()
+        return {
+            "rows": rows,
+            "chunks": len(chunks),
+            "workers": scanpool.WORKERS,
+            "serial_rows_per_s": round(rows / t_serial),
+            "pooled_rows_per_s": round(rows / t_pooled),
+            "pool_speedup": round(t_serial / max(t_pooled, 1e-9), 2),
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -816,6 +891,19 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
         f"colstore_hc_topk_cold_seconds{suffix}",
         hc["topk_cold_s"], "s", vs5, {"detail": hc})
 
+    # host scan floor: decoded rows/s serial vs pooled (the stage that
+    # caps every query on a real accelerator; tracked per round)
+    scan_floor = None
+    try:
+        scan_floor = bench_scan_floor(
+            rows=int(os.environ.get("OGTPU_BENCH_SCANFLOOR_ROWS",
+                                    "8000000")))
+        _emit("host_scan_floor_pooled_rows_per_sec" + suffix,
+              scan_floor["pooled_rows_per_s"], "rows/s",
+              scan_floor["pool_speedup"], {"detail": scan_floor})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: scan floor failed: {e}", file=sys.stderr)
+
     # e2e host path (config #1 shape)
     e2e = bench_e2e(
         series=int(os.environ.get("OGTPU_BENCH_E2E_SERIES", "200")),
@@ -842,6 +930,8 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
             print(f"bench: atspec failed: {e}", file=sys.stderr)
 
     extra = {"configs": configs, "probe": probe, "e2e_ingest_query": e2e}
+    if scan_floor:
+        extra["host_scan_floor"] = scan_floor
     if note:
         extra["note"] = note
     atspec_best = _load_atspec_lastgood()

@@ -50,6 +50,8 @@ _MAX_K = 8192
 
 
 class GridBatch:
+    accepts_boundaries = True  # coalesced adds forward record breaks
+
     def __init__(self, dtype, W: int, every_ns: int):
         self.dtype = dtype or templates.compute_dtype()
         self.W = int(W)
@@ -60,12 +62,19 @@ class GridBatch:
         self._mask: list[np.ndarray] = []
         self._times: list[np.ndarray] = []
         self._sids: list[np.ndarray | None] = []
+        self._bnds: list[np.ndarray | None] = []
         self.n = 0
         self._state = None  # grid state dict after a successful freeze
         self._fallback = None  # BucketedBatch when the grid refuses
         self._raw: dict = {}  # lazy per-(row, window) device stats
 
-    def add(self, values, rel_ns, seg_ids, mask, times_ns, sids=None):
+    def add(self, values, rel_ns, seg_ids, mask, times_ns, sids=None,
+            boundaries=None):
+        """`boundaries` (optional sorted row offsets within this add)
+        marks run breaks inside a coalesced add — per-shard sid numbering
+        is independent, so a stager that concatenates records from
+        different shards must keep equal sid values from fusing into one
+        stride run."""
         self._vals.append(np.asarray(values, dtype=self.dtype))
         self._rel.append(np.asarray(rel_ns, dtype=np.int64))
         self._seg.append(np.asarray(seg_ids, dtype=np.int64))
@@ -78,6 +87,9 @@ class GridBatch:
                 np.full(len(self._vals[-1]), sids, dtype=np.int64))
         else:
             self._sids.append(np.asarray(sids, dtype=np.int64))
+        self._bnds.append(
+            None if boundaries is None
+            else np.asarray(boundaries, dtype=np.int64))
         self.n += len(self._vals[-1])
 
     def layout_name(self) -> str:
@@ -142,9 +154,12 @@ class GridBatch:
         boundary[0] = True
         boundary[1:] = sid[1:] != sid[:-1]
         off = 0
-        for v in self._vals[:-1]:
+        for v, b in zip(self._vals, self._bnds):
+            if b is not None and len(b):
+                boundary[off + b] = True  # coalesced-add record breaks
             off += len(v)
-            boundary[off] = True
+            if off < n:
+                boundary[off] = True
         d = np.diff(rel)
         inner = ~boundary[1:]
         dd = d[inner]
@@ -211,11 +226,13 @@ class GridBatch:
         GROUP BY time())."""
         st = self._freeze(num_segments)
         if st is None:
-            return self._fallback.run(spec, num_segments, params)
+            return self._fallback.run(spec, num_segments, params,
+                                      want_sel=want_sel)
         name = spec.name
         if name not in GRID_AGGS:
             self._ensure_fallback()
-            return self._fallback.run(spec, num_segments, params)
+            return self._fallback.run(spec, num_segments, params,
+                                      want_sel=want_sel)
         G = num_segments // self.W
         raw = self._raw_stats(
             need_ssd=(name == "stddev"),
@@ -336,6 +353,7 @@ class GridBatch:
         st.pop("mesh_arrays", None)
         st.pop("mesh_imat", None)
         self._vals = self._rel = self._seg = self._mask = self._sids = None
+        self._bnds = None
 
     def _raw_stats(self, need_ssd: bool, need_selectors: bool) -> dict:
         st = self._state
@@ -452,12 +470,29 @@ def _pow2_at_least(n: int, floor: int) -> int:
     return p
 
 
+@functools.lru_cache(maxsize=1)
+def _lane_quantum() -> int:
+    """Lane-axis padding quantum: 128 on TPU (the native lane tile —
+    anything less re-pads on device), 8 on CPU/GPU backends where a
+    128-wide floor at W=20 meant computing 6.4x the cells for nothing
+    (the measured grid-loses-to-bucketed regression in bench_e2e's
+    cpu-smoke shape)."""
+    import jax
+
+    return 128 if jax.default_backend() == "tpu" else 8
+
+
 def _pad_lanes(n: int, floor: int) -> int:
-    """Pad the lane (W) axis to a multiple of 128 instead of a power of
-    two: at W=1667 that is 1792 rather than 2048 (-12% cells). Bounded
-    shape count for the compile cache: <= 16 steps to 2048, pow2 above."""
+    """Pad the lane (W) axis to a multiple of the backend quantum
+    instead of a power of two: at W=1667 that is 1792 rather than 2048
+    on TPU (-12% cells). Shape count stays bounded for the compile
+    cache: the fine non-TPU quantum applies only below 256 lanes
+    (<= 32 small shapes), then 128-multiples to 2048, pow2 above."""
+    q = _lane_quantum()
     if n <= floor:
         return floor
+    if n <= 256:
+        return (n + q - 1) // q * q
     if n <= 2048:
         return (n + 127) // 128 * 128
     return _pow2_at_least(n, 2048)

@@ -218,15 +218,24 @@ class BucketedBatch:
 
     # -- execution -------------------------------------------------------
 
-    def run(self, spec, num_segments: int, params: tuple = ()):
-        """Same contract as AggBatch.run: (values, sel|None, counts)."""
+    supports_want_sel = True
+
+    def run(self, spec, num_segments: int, params: tuple = (),
+            want_sel: bool = True):
+        """Same contract as AggBatch.run: (values, sel|None, counts).
+        want_sel=False skips the selector lex-scan kernels for min/max
+        (their values come from the basic pass) — GROUP BY time() scans
+        never consult sel. first/last still need the selector kernel for
+        their VALUES."""
         buckets = self._freeze(num_segments)
         out = np.zeros(num_segments, dtype=np.float64)
         sel = np.zeros(num_segments, dtype=np.int64)
         counts = np.zeros(num_segments, dtype=np.int64)
         is_selector = spec.name in ("min", "max", "first", "last")
+        need_sel = spec.name in ("first", "last") or (
+            want_sel and spec.name in ("min", "max"))
         for b in buckets:
-            st = b.combined(need_selectors=is_selector)
+            st = b.combined(need_selectors=need_sel)
             counts[b.segs] = st["count"]
             if spec.name == "spread":
                 out[b.segs] = st["max"] - st["min"]
@@ -235,9 +244,9 @@ class BucketedBatch:
                 out[b.segs] = np.sqrt(np.maximum(st["ssd"] / np.maximum(c - 1, 1), 0))
             else:
                 out[b.segs] = st[spec.name]
-            if is_selector:
+            if is_selector and need_sel:
                 sel[b.segs] = st["sel_" + spec.name]
-        return out, (sel if is_selector else None), counts
+        return out, (sel if (is_selector and need_sel) else None), counts
 
 
 class _Bucket:
@@ -295,7 +304,14 @@ class _Bucket:
         raw = self._raw_stats(need_selectors)
         if (self.n_sub == 1).all():
             self._combined = dict(raw)
-            self._combined["count"] = raw["count"].astype(np.int64)
+            cnt = raw["count"].astype(np.int64)
+            self._combined["count"] = cnt
+            # mean recomputed host-side as f64(sum)/count — the SAME
+            # arithmetic as the k-way combine branch below and the grid
+            # layout (models/grid.py run()), so a query answers
+            # identically whichever layout or slice width the planner
+            # picked (the device f32 mean differs in the last ulp)
+            self._combined["mean"] = raw["sum"] / np.maximum(cnt, 1)
             return self._combined
         starts = self.sub_base
         out = self._combined

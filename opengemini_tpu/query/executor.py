@@ -32,6 +32,7 @@ from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query import functions as fnmod
 from opengemini_tpu.record import FieldType, FieldTypeConflict
 from opengemini_tpu.sql import ast
+from opengemini_tpu.storage import scanpool
 from opengemini_tpu.meta.users import AuthError as _AuthError
 from opengemini_tpu.storage.engine import WriteError
 from opengemini_tpu.utils import tracing
@@ -163,6 +164,101 @@ def _plan_scan_slices(shards, mst, scan_plan, aligned, every_ns, W,
         plan.append((w0, ws, max(lo, tmin), min(hi, tmax)))
         w0 += ws
     return plan
+
+
+class _ScanStager:
+    """Batched column materialization for the per-series scan tail: the
+    serial loop fed each tiny per-series record into the device batches
+    one add() at a time — at high cardinality that is hundreds of
+    thousands of numpy slivers the batch freeze must re-concatenate.
+    The stager accumulates the per-record column views and flushes ONE
+    contiguous array set per field (values cast once on the big array),
+    preserving the exact row order of the serial path so results are
+    bit-identical.  Record boundaries are forwarded to batches that want
+    them (GridBatch run detection) — per-shard sid numbering is
+    independent, so equal sid values from different shards must not fuse
+    into one stride run."""
+
+    def __init__(self, needed_fields, dtype, batches, time_aggs,
+                 time_segs, time_vals, aligned):
+        self.needed_fields = needed_fields
+        self.dtype = dtype
+        self.batches = batches
+        self.time_aggs = time_aggs
+        self.time_segs = time_segs
+        self.time_vals = time_vals
+        self.aligned = aligned
+        # shared per-record arrays: [(times, seg, sid)]
+        self._recs: list[tuple] = []
+        # field -> [(record index, values|None, mask)]
+        self._per_field: dict[str, list] = {f: [] for f in needed_fields}
+
+    def add(self, rec, seg, fmask, sid):
+        if self.time_aggs:
+            m = fmask if fmask is not None else slice(None)
+            self.time_segs.append(seg[m])
+            self.time_vals.append(rec.times[m])
+        ri = len(self._recs)
+        self._recs.append((rec.times, seg, sid))
+        for fname in self.needed_fields:
+            col = rec.columns.get(fname)
+            if col is None:
+                continue
+            m = col.valid if fmask is None else (col.valid & fmask)
+            if isinstance(self.batches[fname], ragged.IntExactBatch):
+                vals = col.values  # int64 end-to-end, no float cast
+            elif col.ftype == FieldType.STRING:
+                vals = None  # count-only payload: zeros at flush
+            else:
+                vals = col.values  # cast once per flush, not per record
+            self._per_field[fname].append((ri, vals, m))
+
+    def _gather(self, rec_idx):
+        """(times, seg, sids, rel, boundaries) over the given records —
+        concatenated ONCE and shared by every field present in all
+        records (the common schema-complete case)."""
+        times = np.concatenate([self._recs[i][0] for i in rec_idx])
+        seg = np.concatenate([self._recs[i][1] for i in rec_idx])
+        sids = np.concatenate([
+            np.full(len(self._recs[i][0]), self._recs[i][2], np.int64)
+            for i in rec_idx])
+        lens = np.asarray(
+            [len(self._recs[i][0]) for i in rec_idx], np.int64)
+        return times, seg, sids, times - self.aligned, np.cumsum(lens)[:-1]
+
+    def flush(self):
+        shared = None  # lazy: only fields present in EVERY record share
+        all_idx = list(range(len(self._recs)))
+        for fname, entries in self._per_field.items():
+            if not entries:
+                continue
+            batch = self.batches[fname]
+            rec_idx = [e[0] for e in entries]
+            if rec_idx == all_idx:
+                if shared is None:
+                    shared = self._gather(all_idx)
+                times, seg, sids, rel, bounds = shared
+            else:
+                times, seg, sids, rel, bounds = self._gather(rec_idx)
+            mask = np.concatenate([e[2] for e in entries])
+            # value payloads dispatch PER RECORD, exactly like the serial
+            # _add_record_to_batches: a field may be numeric in one shard
+            # and string (None marker -> zero payload) in another
+            parts = [
+                np.zeros(len(self._recs[ri][0]), dtype=self.dtype)
+                if v is None else v
+                for ri, v, _m in entries
+            ]
+            vals = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            if not isinstance(batch, ragged.IntExactBatch):
+                vals = vals.astype(self.dtype)
+            if getattr(batch, "accepts_boundaries", False):
+                batch.add(vals, rel, seg, mask, times, sids=sids,
+                          boundaries=bounds)
+            else:
+                batch.add(vals, rel, seg, mask, times, sids=sids)
+            self._per_field[fname] = []
+        self._recs = []
 
 
 def _stitch_sliced(sliced_out, spec, params, field_name, num_groups, W,
@@ -1115,6 +1211,7 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         if (
             group_time is not None
             and W >= 1
+            and aggs  # tag-count-only statements have nothing to cache
             and self.router is None
             and ctx.live is None
             and not time_aggs
@@ -1251,6 +1348,15 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                     out, sel, counts = _stitch_sliced(
                         sliced_out, spec, params, field_name,
                         num_groups, W, num_segments)
+                elif group_time and getattr(
+                        batches[field_name], "supports_want_sel", False):
+                    # GROUP BY time(): selector timestamps are never
+                    # consulted (window start renders instead), so skip
+                    # the selector-index kernels entirely — the imat
+                    # build + lex scans were most of the grid path's
+                    # cost for max()/min() scans
+                    out, sel, counts = batches[field_name].run(
+                        spec, num_segments, params, want_sel=False)
                 else:
                     out, sel, counts = batches[field_name].run(
                         spec, num_segments, params)
@@ -1298,8 +1404,12 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                 tcounts = np.bincount(seg_all, minlength=num_segments).astype(np.int64)
             for call, spec, params, field_name in tag_count_aggs:
                 out = np.zeros(num_segments, np.int64)
-                counts = np.zeros(num_segments, np.int64)
-                counts.reshape(num_groups, W)[:, 0] = 1  # row renders as 0
+                # the constant-0 row emits in EVERY window: under
+                # GROUP BY time() the reference renders the shortcut per
+                # window (window 0 alone would truncate the series to one
+                # row); without time grouping W == 1 and this is the
+                # single constant row as before
+                counts = np.ones(num_segments, np.int64)  # rows render as 0
                 agg_results[id(call)] = (out, None, counts, spec,
                                          field_name, None)
             for call, spec, _params, _f in time_aggs:
@@ -1390,7 +1500,14 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         pre_count, pre_sum, sum_fields, tmax,
     ) -> tuple[int, bool]:
         """The classic single-pass scan: decode every series in range into
-        `batches`. Returns (rows_scanned, pre_used)."""
+        `batches`. Returns (rows_scanned, pre_used).
+
+        Pipelined (storage/scanpool.py): bulk shard reads double-buffer —
+        unit N+1 decodes on a prefetch thread (which itself fans chunk
+        decodes across the worker pool) while unit N's rows feed the
+        device batches. Per-series records coalesce through a staging
+        buffer so each field takes ONE contiguous batch add per scan
+        instead of one tiny append per series."""
         rows_scanned = 0
         pre_used = False
         fmask = None
@@ -1415,39 +1532,51 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
             for sh, sid, gid in scan_plan:
                 by_shard.setdefault(id(sh), (sh, []))[1].append((sid, gid))
             remaining_plan = []
+            units = []  # thunks: () -> (sh, sid_sorted, gid_sorted, sid_arr, rec)
             for sh, pairs in by_shard.values():
                 if len(pairs) < 64 or not hasattr(sh, "read_series_bulk"):
                     remaining_plan.extend(
                         (sh, sid, gid) for sid, gid in pairs)
                     continue
-                TRACKER.check()
                 sid_list = np.asarray([p[0] for p in pairs], np.int64)
                 gid_list = np.asarray([p[1] for p in pairs], np.int64)
                 o = np.argsort(sid_list)
                 sid_sorted, gid_sorted = sid_list[o], gid_list[o]
                 for rlo, rhi in scan_ranges:
-                    sid_arr, rec = sh.read_series_bulk(
-                        mst, sid_sorted, rlo, rhi, fields=read_fields)
-                    if len(rec) == 0:
-                        continue
-                    rows_scanned += len(rec)
-                    fmask = (
-                        cond.eval_row_filter(sc, rec, sid_arr=sid_arr,
-                                             index=sh.index)
-                        if sc.has_row_filter
-                        else None
-                    )
-                    gid_rows = gid_sorted[
-                        np.searchsorted(sid_sorted, sid_arr)]
-                    if group_time:
-                        widx, _ = winmod.window_index(
-                            rec.times, tmin, group_time.every_ns,
-                            group_time.offset_ns)
-                        seg = (gid_rows * W + widx.astype(np.int64)
-                               ).astype(np.int32)
-                    else:
-                        seg = gid_rows.astype(np.int32)
-                    _scan_record(rec, seg, sids=sid_arr)
+                    units.append(
+                        lambda sh=sh, ss=sid_sorted, gs=gid_sorted,
+                        rlo=rlo, rhi=rhi:
+                        (sh, ss, gs) + sh.read_series_bulk(
+                            mst, ss, rlo, rhi, fields=read_fields))
+            for sh, sid_sorted, gid_sorted, sid_arr, rec in \
+                    scanpool.prefetch_ordered(units):
+                TRACKER.check()
+                if len(rec) == 0:
+                    continue
+                rows_scanned += len(rec)
+                fmask = (
+                    cond.eval_row_filter(sc, rec, sid_arr=sid_arr,
+                                         index=sh.index)
+                    if sc.has_row_filter
+                    else None
+                )
+                gid_rows = gid_sorted[
+                    np.searchsorted(sid_sorted, sid_arr)]
+                if group_time:
+                    widx, _ = winmod.window_index(
+                        rec.times, tmin, group_time.every_ns,
+                        group_time.offset_ns)
+                    seg = (gid_rows * W + widx.astype(np.int64)
+                           ).astype(np.int32)
+                else:
+                    seg = gid_rows.astype(np.int32)
+                _scan_record(rec, seg, sids=sid_arr)
+        # per-series tail: stage rows and materialize ONE contiguous
+        # array set per field at the end (per-chunk concatenation in this
+        # loop was the executor-side hot spot at high cardinality)
+        stager = _ScanStager(needed_fields, dtype, batches, time_aggs,
+                             time_segs, time_vals, aligned) \
+            if not pre_eligible and remaining_plan else None
         for sh, sid, gid in remaining_plan:
             TRACKER.check()  # KILL QUERY cancellation point
             if pre_eligible:
@@ -1479,7 +1608,12 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                            ).astype(np.int32)
                 else:
                     seg = np.full(len(rec), gid, dtype=np.int32)
-                _scan_record(rec, seg, sids=sid)
+                if stager is not None:
+                    stager.add(rec, seg, fmask, sid)
+                else:
+                    _scan_record(rec, seg, sids=sid)
+        if stager is not None:
+            stager.flush()
         return rows_scanned, pre_used
 
     def _scan_sliced(

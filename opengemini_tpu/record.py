@@ -310,6 +310,20 @@ def merge_bulk_parts(
     parts = [(s, r) for s, r in parts if len(r)]
     if not parts:
         return np.empty(0, np.int64), Record(np.empty(0, np.int64), {})
+    if len(parts) == 1:
+        # a single part already strictly (sid, time)-sorted (the memtable
+        # consolidation, or one packed colstore chunk) needs no merge at
+        # all — one monotonicity pass + a time mask instead of the
+        # three-key lexsort (the profiled hot spot of warm unflushed
+        # scans)
+        s, r = parts[0]
+        ds = np.diff(s)
+        if not len(ds) or ((ds > 0) | ((ds == 0) & (np.diff(r.times) > 0))).all():
+            m = (r.times >= lo_t) & (r.times < hi_t)
+            if m.all():
+                return s, r
+            idx = np.flatnonzero(m)
+            return s[idx], r.take(idx)
     fast = _merge_bulk_sorted_fast(parts, lo_t, hi_t)
     if fast is not None:
         return fast

@@ -143,7 +143,14 @@ def downsample_records(
         if name in host_fields:
             results[name] = host_results[name]
         else:
-            out, _sel, counts = batches[name].run(spec, num_segments, spec.params)
+            if getattr(batches[name], "supports_want_sel", False):
+                # selector row indices are never consulted here (window
+                # times render) — skip the selector lex-scan kernels
+                out, _sel, counts = batches[name].run(
+                    spec, num_segments, spec.params, want_sel=False)
+            else:
+                out, _sel, counts = batches[name].run(
+                    spec, num_segments, spec.params)
             results[name] = (out, counts)
 
     window_times = aligned + np.arange(W, dtype=np.int64) * every_ns

@@ -986,41 +986,72 @@ class Engine:
                 elif have != ftype:
                     raise FieldTypeConflict(name, have, ftype)
         total = 0
-        for seg, batch in zip(segs, parsed):
-            if len(batch) == 0:
-                continue
-            STATS.incr("write", "points", len(batch))
-            with self._lock:
-                total += self._write_columnar_locked(
-                    db, rp, batch, seg, precision, now_ns)
-            if self._write_observers:
-                self._notify_write(db, rp, batch.to_points())
+        with self._lock:
+            # ONE lock acquisition for the whole body, with every segment
+            # pre-validated against the LIVE shard schemas before the
+            # first applies: the old per-segment lock dance let a
+            # mid-batch schema conflict (or a racing writer) leave a
+            # partial write the single-batch path can never produce.
+            # Routing runs ONCE per segment and is reused for the apply.
+            routed = []
+            for seg, batch in zip(segs, parsed):
+                if len(batch) == 0:
+                    continue
+                route = list(self._route_columnar_locked(db, rp, batch))
+                for shard, rows in route:
+                    shard._check_columnar_types(batch, rows)
+                routed.append((seg, batch, route))
+            for seg, batch, route in routed:
+                STATS.incr("write", "points", len(batch))
+                for shard, rows in route:
+                    total += shard.write_columnar(
+                        batch, rows, seg, precision, now_ns)
+                    if shard.mem.approx_bytes > self.flush_threshold_bytes:
+                        shard.flush()
+        if self._write_observers and total:
+            # observers see the body ONCE, post-commit, like write_lines
+            pts: list = []
+            for batch in parsed:
+                if len(batch):
+                    pts.extend(batch.to_points())
+            self._notify_write(db, rp, pts)
         return total
+
+    def _route_columnar_locked(self, db: str, rp: str, batch):
+        """Yield (shard, rows) for a ColumnarBatch — ONE routing
+        implementation (vectorized Go-Truncate alignment) shared by
+        pre-validation and apply, so a segmented body is checked against
+        exactly the shards it will write to. Caller holds the engine
+        lock. Target shards are created here if missing (a body rejected
+        by pre-validation can leave empty shards behind — the same
+        behavior as the point write path, which also creates shards
+        before type checks)."""
+        import numpy as np
+
+        d = self.databases.get(db)
+        if d is None:
+            # a concurrent DROP DATABASE can land between segments of a
+            # segmented body (the lock is per body, drops take it too)
+            raise DatabaseNotFound(db)
+        rp_meta = d.rps.get(rp)
+        if rp_meta is None:
+            raise WriteError(f"retention policy not found: {db}.{rp}")
+        dur = rp_meta.shard_duration_ns
+        phase = _go_phase_ns(dur)
+        groups = (batch.ts - phase) // dur * dur + phase
+        uniq = np.unique(groups)
+        for g in uniq:
+            shard = self._get_or_create_shard(db, rp, int(g))
+            rows = None if len(uniq) == 1 else np.flatnonzero(groups == g)
+            yield shard, rows
 
     def _write_columnar_locked(self, db: str, rp: str, batch,
                                raw: bytes, precision: str, now_ns: int) -> int:
         """Route a ColumnarBatch to its time shards (vectorized: one
         floor-divide over all timestamps) and slab-write each. Caller
         holds the engine lock."""
-        import numpy as np
-
-        d = self.databases.get(db)
-        if d is None:
-            # a concurrent DROP DATABASE can land between segments of a
-            # segmented body (the lock is per segment)
-            raise DatabaseNotFound(db)
-        rp_meta = d.rps.get(rp)
-        if rp_meta is None:
-            raise WriteError(f"retention policy not found: {db}.{rp}")
-        dur = rp_meta.shard_duration_ns
-        # vectorized shard_group_start (Go Truncate alignment)
-        phase = _go_phase_ns(dur)
-        groups = (batch.ts - phase) // dur * dur + phase
-        uniq = np.unique(groups)
         n = 0
-        for g in uniq:
-            shard = self._get_or_create_shard(db, rp, int(g))
-            rows = None if len(uniq) == 1 else np.flatnonzero(groups == g)
+        for shard, rows in self._route_columnar_locked(db, rp, batch):
             n += shard.write_columnar(batch, rows, raw, precision, now_ns)
             if shard.mem.approx_bytes > self.flush_threshold_bytes:
                 shard.flush()

@@ -101,7 +101,11 @@ class HTTPObjectStore(ObjectStore):
     # -- http plumbing ---------------------------------------------------
 
     def _request(self, method: str, path: str, body=None, headers=None,
-                 ok=(200, 204), stream_to: str | None = None):
+                 ok=(200, 204), stream_to: str | None = None,
+                 want_status: bool = False):
+        """want_status=True returns (status, payload) so callers can
+        distinguish e.g. a 206 partial reply from a 200 full-object one
+        (get_range must slice the latter client-side)."""
         import time as _time
         import urllib.error
         import urllib.request
@@ -135,14 +139,20 @@ class HTTPObjectStore(ObjectStore):
                                     if not chunk:
                                         break
                                     f.write(chunk)
-                            return None
-                        return resp.read()
+                            return (resp.status, None) if want_status else None
+                        got = resp.read()
+                        return (resp.status, got) if want_status else got
                 finally:
                     if data is not None and hasattr(data, "close"):
                         data.close()
+            except ObjectStoreError:
+                # deliberate unexpected-status raise above: must NOT be
+                # swallowed by the OSError clause below and retried
+                # (ObjectStoreError derives from OSError)
+                raise
             except urllib.error.HTTPError as e:
                 if e.code in ok:  # e.g. DELETE tolerating 404
-                    return None
+                    return (e.code, None) if want_status else None
                 if e.code == 404:
                     raise ObjectStoreError(
                         f"object not found: {path}") from None
@@ -186,10 +196,14 @@ class HTTPObjectStore(ObjectStore):
         the Range header and replies 200 with the full body is sliced
         client-side — callers always get exactly the requested window."""
         end = start + length - 1
-        got = self._request(
+        status, got = self._request(
             "GET", key, headers={"Range": f"bytes={start}-{end}"},
-            ok=(200, 206))
-        if len(got) > length:  # 200 full-object reply
+            ok=(200, 206), want_status=True)
+        if status == 200:
+            # server ignored the Range header and sent the whole object.
+            # Slice on STATUS, not on len(got) > length: a short tail
+            # read (start + length past EOF) of a small object would
+            # otherwise silently return bytes from offset 0.
             got = got[start:start + length]
         return got
 
@@ -235,8 +249,14 @@ class HTTPObjectStore(ObjectStore):
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 return r.status == 200
-        except urllib.error.HTTPError:
-            return False
+        except urllib.error.HTTPError as e:
+            # only a definitive 404 means absent; 403/5xx must surface —
+            # "False" on a flaky auth/server error would let reconcile
+            # paths conclude an object is gone and re-upload or delete
+            if e.code == 404:
+                return False
+            raise ObjectStoreError(
+                f"HEAD {key}: HTTP {e.code}") from None
         except OSError:
             raise ObjectStoreError(f"HEAD {key} failed") from None
 

@@ -20,13 +20,13 @@ from opengemini_tpu.record import (
     Column, FieldTypeConflict, Record, merge_bulk_parts,
     merge_sorted_records, _zeroed as _rec_zeroed,
 )
+from opengemini_tpu.storage import scanpool
 from opengemini_tpu.storage.memtable import MemTable
 from opengemini_tpu.storage.tsf import (
     PACK_MIN_SERIES, PACK_ROWS, TSFReader, TSFWriter,
 )
 from opengemini_tpu.storage.wal import WAL
 from opengemini_tpu.utils.failpoint import inject as _fp
-from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
 
 
 def _pack_entries(buffer: list) -> tuple[np.ndarray, Record]:
@@ -454,22 +454,29 @@ class Shard:
                     batch_set = set(batch.tolist())
                     # one decode per chunk per batch (cache=False: the
                     # soon-to-be-retired readers must not pin memory);
-                    # parts append in file order for last-write-wins
-                    parts = []
+                    # decodes fan across the scan pool, yielding in file
+                    # order so last-write-wins ranking is unchanged
+                    def decode(r, c):
+                        if c.packed:
+                            s_arr, rec = r.read_packed_bulk(
+                                mst, c, None, sid_filter=batch, cache=False)
+                            return (s_arr, rec) if len(rec) else None
+                        rec = r.read_chunk(mst, c, cache=False)
+                        return (np.full(len(rec), c.sid, np.int64), rec)
+
+                    jobs = []
+                    ests = []
                     for r in readers:
                         for c in r.chunks(mst):
                             if c.packed:
                                 if c.smax < batch[0] or c.smin > batch[-1]:
                                     continue
-                                s_arr, rec = r.read_packed_bulk(
-                                    mst, c, None, sid_filter=batch,
-                                    cache=False)
-                                if len(rec):
-                                    parts.append((s_arr, rec))
-                            elif c.sid in batch_set:
-                                rec = r.read_chunk(mst, c, cache=False)
-                                parts.append(
-                                    (np.full(len(rec), c.sid, np.int64), rec))
+                            elif c.sid not in batch_set:
+                                continue
+                            jobs.append(lambda r=r, c=c: decode(r, c))
+                            ests.append(scanpool.est_chunk_bytes(c, None))
+                    parts = [p for p in scanpool.map_ordered(jobs, ests)
+                             if p is not None]
                     sid_arr, rec = _merge_bulk_parts(
                         parts, -(2**63), 2**63 - 1)
                     uniq, starts = np.unique(sid_arr, return_index=True)
@@ -838,19 +845,25 @@ class Shard:
         fields: list[str] | None = None,
     ) -> Record:
         """Merged view of one series: immutable chunks (oldest first) +
-        memtable last, deduped last-wins, then time-sliced."""
-        recs = []
-        for r, c in self.file_chunks(measurement, {sid}, tmin, tmax):
-            # KILL QUERY must interrupt a long decode mid-series, not
-            # only at statement/series boundaries (reference:
-            # ts-store/transport/query/manager.go:130 IsKilled checked
-            # inside cursor loops). No-op on non-query threads; the check
-            # is a thread-local read + set lookup, far below decode cost.
-            _TRACKER.check()
+        memtable last, deduped last-wins, then time-sliced. Multi-chunk
+        decodes fan out across the scan pool (storage/scanpool.py) in
+        file order; KILL QUERY still interrupts mid-series — the pool's
+        ordered yield re-checks the tracker per chunk exactly like the
+        old serial loop did (reference:
+        ts-store/transport/query/manager.go:130 IsKilled checked inside
+        cursor loops)."""
+        chunks = self.file_chunks(measurement, {sid}, tmin, tmax)
+        n_fields = len(fields) if fields is not None else None
+
+        def decode(r, c):
             if c.packed:
-                recs.append(r.read_packed_sid(measurement, c, sid, fields))
-            else:
-                recs.append(r.read_chunk(measurement, c, fields))
+                return r.read_packed_sid(measurement, c, sid, fields)
+            return r.read_chunk(measurement, c, fields)
+
+        recs = list(scanpool.map_ordered(
+            [lambda r=r, c=c: decode(r, c) for r, c in chunks],
+            [scanpool.est_chunk_bytes(c, n_fields) for _r, c in chunks],
+        ))
         mem_rec = self.mem.record_for(sid)
         if mem_rec is not None:
             if fields is not None:
@@ -890,19 +903,37 @@ class Shard:
         sid_set = set(int(s) for s in sids)
         with self._lock:
             files = list(self._files)
+        n_fields = len(fields) if fields is not None else None
+
+        def decode_packed(r, c):
+            s_arr, rec = r.read_packed_bulk(
+                measurement, c, fields, sid_filter=sids)
+            return (s_arr, rec) if len(rec) else None
+
+        def decode_single(r, c):
+            rec = r.read_chunk(measurement, c, fields)
+            return (np.full(len(rec), c.sid, np.int64), rec)
+
+        # chunk decodes fan out across the scan pool; map_ordered yields
+        # in submission (= file) order, so the parts list is identical to
+        # the old serial loop's and last-write-wins ranking is unchanged.
+        # Per-chunk kill points live inside map_ordered (see read_series).
+        jobs = []
+        ests = []
         for r in files:
             for c in r.chunks(measurement, None, tmin, tmax):
-                _TRACKER.check()  # per-chunk kill point (see read_series)
                 if c.packed:
                     if c.smax < sids[0] or c.smin > sids[-1]:
                         continue
-                    s_arr, rec = r.read_packed_bulk(
-                        measurement, c, fields, sid_filter=sids)
-                    if len(rec):
-                        parts.append((s_arr, rec))
+                    jobs.append(lambda r=r, c=c: decode_packed(r, c))
                 elif c.sid in sid_set:
-                    rec = r.read_chunk(measurement, c, fields)
-                    parts.append((np.full(len(rec), c.sid, np.int64), rec))
+                    jobs.append(lambda r=r, c=c: decode_single(r, c))
+                else:
+                    continue
+                ests.append(scanpool.est_chunk_bytes(c, n_fields))
+        for part in scanpool.map_ordered(jobs, ests):
+            if part is not None:
+                parts.append(part)
         for sid_arr, mem_rec in self.mem.bulk_parts(measurement, sids):
             if fields is not None:
                 mem_rec = Record(

@@ -65,8 +65,25 @@ class QueryTracker:
     def check(self) -> None:
         """Cancellation point: raises when the CURRENT thread's query was
         killed. Cheap (one set lookup), called between scan units."""
-        qid = getattr(self._local, "qid", None)
-        if qid is not None and qid in self._killed:
+        self.raise_if_killed(self.current_qid())
+
+    def current_qid(self) -> int | None:
+        """The query id bound to the calling thread (None off-query)."""
+        return getattr(self._local, "qid", None)
+
+    def bind(self, qid: int | None) -> None:
+        """Adopt a query id on a helper thread (scan-pool / prefetch
+        workers) so check() fires there too. Helper threads bind fresh
+        per task; the binding dies with the thread's next bind."""
+        self._local.qid = qid
+
+    def is_killed(self, qid: int | None) -> bool:
+        return qid is not None and qid in self._killed
+
+    def raise_if_killed(self, qid: int | None) -> None:
+        """check() for threads that carry the qid explicitly instead of
+        thread-locally (scan-pool decode workers)."""
+        if self.is_killed(qid):
             raise QueryKilled(qid)
 
     def snapshot(self) -> list[dict]:

@@ -43,6 +43,48 @@ def series_of(res, i=0):
     return res["results"][0]["series"][i]
 
 
+class TestTagCountShortcut:
+    """COUNT/COUNT(DISTINCT) over a TAG answers the constant 0 row
+    (parity: server_test.go Aggregates_IntMany 'count distinct select
+    tag'); with GROUP BY time() the constant row emits in EVERY window,
+    not just window 0."""
+
+    def test_count_tag_whole_range(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(ex, "SELECT count(distinct(host)) FROM cpu")
+        s = series_of(res)
+        assert s["values"] == [[0, 0]]
+
+    def test_count_tag_group_by_time_emits_every_window(self, env):
+        e, ex = env
+        write_devops(e)
+        res = q(
+            ex,
+            f"SELECT count(host) FROM cpu WHERE time >= {BASE * NS} "
+            f"AND time < {(BASE + 300) * NS} GROUP BY time(1m)",
+        )
+        s = series_of(res)
+        assert s["columns"] == ["time", "count"]
+        assert len(s["values"]) == 5  # one constant row PER window
+        for i, (t, v) in enumerate(s["values"]):
+            assert t == (BASE + i * 60) * NS
+            assert v == 0
+
+    def test_count_tag_alongside_field_agg(self, env):
+        e, ex = env
+        write_devops(e, hosts=1)
+        res = q(
+            ex,
+            f"SELECT count(region), count(usage_user) FROM cpu WHERE "
+            f"time >= {BASE * NS} AND time < {(BASE + 120) * NS} "
+            "GROUP BY time(1m)",
+        )
+        s = series_of(res)
+        assert [row[1] for row in s["values"]] == [0, 0]
+        assert [row[2] for row in s["values"]] == [6, 6]
+
+
 class TestAggregates:
     def test_mean_group_by_time(self, env):
         e, ex = env

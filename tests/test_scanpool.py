@@ -1,0 +1,312 @@
+"""Scan pool (storage/scanpool.py): the parallel pipelined decode path
+must be invisible except for speed — bit-identical results vs the serial
+path under shuffled completion order, a respected in-flight byte budget
+(backpressure), and clean shutdown when a query is KILLed mid-scan."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.query import executor as exmod
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.storage import scanpool
+from opengemini_tpu.storage.engine import Engine
+from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER, QueryKilled
+
+NS = 1_000_000_000
+BASE = 1_700_000_000
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = Engine(str(tmp_path / "data"), sync_wal=False)
+    e.create_database("db")
+    yield e, Executor(e)
+    e.close()
+
+
+@pytest.fixture
+def pool_on(monkeypatch):
+    """Force the pool live even on single-core CI boxes."""
+    monkeypatch.setattr(scanpool, "WORKERS", 4)
+    monkeypatch.setattr(scanpool, "_pool", None)
+    yield
+    monkeypatch.setattr(scanpool, "_pool", None)
+
+
+class TestMapOrdered:
+    def test_results_in_submission_order_despite_shuffled_completion(
+            self, pool_on):
+        rng = random.Random(7)
+        delays = [rng.uniform(0, 0.01) for _ in range(40)]
+
+        def mk(i):
+            def job():
+                time.sleep(delays[i])  # later jobs often finish first
+                return i
+            return job
+
+        got = list(scanpool.map_ordered([mk(i) for i in range(40)]))
+        assert got == list(range(40))
+
+    def test_serial_fallback_matches(self, pool_on):
+        jobs = [lambda i=i: i * i for i in range(10)]
+        pooled = list(scanpool.map_ordered(jobs))
+        with scanpool.forced_serial():
+            serial = list(scanpool.map_ordered(jobs))
+        assert pooled == serial == [i * i for i in range(10)]
+
+    def test_backpressure_bounds_inflight_bytes(self, pool_on):
+        n = 32
+        est = [100] * n
+        budget = 350  # admits at most 3 undrained jobs
+        lock = threading.Lock()
+        state = {"inflight": 0, "peak": 0}
+
+        def mk(i):
+            def job():
+                with lock:
+                    state["inflight"] += est[i]
+                    state["peak"] = max(state["peak"], state["inflight"])
+                time.sleep(0.002)
+                return i
+            return job
+
+        out = []
+        for i in scanpool.map_ordered(
+                [mk(i) for i in range(n)], est, inflight_bytes=budget):
+            out.append(i)
+            with lock:
+                state["inflight"] -= est[i]
+        assert out == list(range(n))
+        assert state["peak"] <= budget
+
+    def test_oversized_single_job_still_admitted(self, pool_on):
+        got = list(scanpool.map_ordered(
+            [lambda: 1, lambda: 2, lambda: 3, lambda: 4],
+            [10**9] * 4, inflight_bytes=100))
+        assert got == [1, 2, 3, 4]
+
+    def test_consumer_exception_cancels_pending(self, pool_on):
+        ran = []
+
+        def mk(i):
+            def job():
+                time.sleep(0.005)
+                ran.append(i)
+                return i
+            return job
+
+        gen = scanpool.map_ordered([mk(i) for i in range(200)])
+        with pytest.raises(RuntimeError):
+            for i in gen:
+                if i == 3:
+                    raise RuntimeError("consumer bails")
+        time.sleep(0.1)
+        # pending futures were cancelled: nowhere near all 200 ran
+        assert len(ran) < 100
+
+
+class TestPrefetchOrdered:
+    def test_order_and_values(self, pool_on):
+        thunks = [lambda i=i: (time.sleep(0.002), i)[1] for i in range(20)]
+        assert list(scanpool.prefetch_ordered(thunks)) == list(range(20))
+
+    def test_producer_error_propagates(self, pool_on):
+        def boom():
+            raise ValueError("decode failed")
+
+        with pytest.raises(ValueError, match="decode failed"):
+            list(scanpool.prefetch_ordered([lambda: 1, boom, lambda: 3]))
+
+    def test_early_abandon_stops_producer(self, pool_on):
+        ran = []
+
+        def mk(i):
+            def t():
+                ran.append(i)
+                time.sleep(0.005)
+                return i
+            return t
+
+        gen = scanpool.prefetch_ordered([mk(i) for i in range(100)])
+        assert next(gen) == 0
+        gen.close()
+        time.sleep(0.2)
+        assert len(ran) < 20  # producer noticed the abandon and stopped
+
+
+def _write_multi_chunk(e, hosts=8, points=400, flushes=4):
+    """Many TSF files + packed chunks + live memtable rows: every decode
+    source the pool touches."""
+    per = points // flushes
+    for f in range(flushes):
+        lines = []
+        for p in range(f * per, (f + 1) * per):
+            for h in range(hosts):
+                lines.append(
+                    f"cpu,host=h{h} v={(h * 13 + p) % 37}.25,u={p % 7}i "
+                    f"{(BASE + p * 5) * NS}")
+        e.write_lines("db", "\n".join(lines))
+        e.flush_all()
+    # unflushed tail in the memtable
+    e.write_lines("db", "\n".join(
+        f"cpu,host=h0 v=99.5 {(BASE + points * 5 + i) * NS}"
+        for i in range(5)))
+
+
+class TestPooledScanEqualsSerial:
+    QUERIES = [
+        "SELECT mean(v), max(v), count(v) FROM cpu WHERE time >= {lo} AND "
+        "time < {hi} GROUP BY time(1m)",
+        "SELECT first(v), last(v), min(v) FROM cpu WHERE time >= {lo} AND "
+        "time < {hi} GROUP BY time(2m), host",
+        "SELECT count(u), sum(u) FROM cpu WHERE time >= {lo} AND "
+        "time < {hi} AND v > 10 GROUP BY time(90s)",
+        "SELECT max(v) FROM cpu",  # selector timestamp without GROUP BY time
+        "SELECT percentile(v, 90) FROM cpu GROUP BY host",
+    ]
+
+    @pytest.mark.parametrize("qt", QUERIES)
+    def test_bit_identical(self, env, pool_on, qt):
+        e, ex = env
+        _write_multi_chunk(e)
+        lo, hi = BASE * NS, (BASE + 3000) * NS
+        q = qt.format(lo=lo, hi=hi)
+        pooled = ex.execute(q, db="db")
+        ex._inc_cache.clear()
+        with scanpool.forced_serial():
+            serial = ex.execute(q, db="db")
+        assert "error" not in str(pooled), pooled
+        assert pooled == serial, q
+
+    def test_mixed_type_field_across_shards(self, env, pool_on):
+        """A field numeric in one shard and string in another must
+        dispatch PER RECORD through the scan stager (the serial path's
+        behavior), not from the first staged record's type."""
+        e, ex = env
+        week = 7 * 24 * 3600
+        e.write_lines("db", f"m,host=a v=1.5,w=1 {BASE * NS}")
+        e.write_lines(
+            "db", f'm,host=a v="s",w=2 {(BASE + week) * NS}')
+        e.flush_all()
+        q = "SELECT count(v) FROM m WHERE w > 0"
+        pooled = ex.execute(q, db="db")
+        with scanpool.forced_serial():
+            serial = ex.execute(q, db="db")
+        assert pooled == serial
+        assert pooled["results"][0]["series"][0]["values"][0][1] == 2
+
+    def test_high_cardinality_packed(self, env, pool_on):
+        e, ex = env
+        # > PACK_MIN_SERIES series in one flush -> packed colstore chunks
+        lines = [f"hc,s=s{i} v={i % 101} {(BASE + i % 50) * NS}"
+                 for i in range(300)]
+        e.write_lines("db", "\n".join(lines))
+        e.flush_all()
+        q = f"SELECT count(v), sum(v) FROM hc WHERE time >= {BASE * NS}"
+        pooled = ex.execute(q, db="db")
+        with scanpool.forced_serial():
+            serial = ex.execute(q, db="db")
+        assert pooled == serial
+
+
+class TestKillMidPooledScan:
+    def test_kill_interrupts_pooled_decode(self, env, pool_on):
+        """KILL QUERY stops a pooled multi-chunk scan promptly (the
+        existing mid-scan KILL harness, now through the pool), and the
+        pool stays usable for the next query."""
+        from opengemini_tpu.storage.tsf import TSFReader
+
+        e, ex = env
+        for i in range(60):
+            e.write_lines("db", f"cpu,host=h0 v={i} {(BASE + i) * NS}")
+            e.flush_all()
+        sh = next(iter(e._shards.values()))
+        sid = next(iter(sh.index.series_ids("cpu")))
+
+        orig = TSFReader.read_chunk
+
+        def slow(self, *a, **k):
+            time.sleep(0.02)
+            return orig(self, *a, **k)
+
+        qid = TRACKER.register("pooled scan", "db")
+        killed_at = {}
+
+        def killer():
+            time.sleep(0.08)
+            TRACKER.kill(qid)
+            killed_at["t"] = time.monotonic()
+
+        t = threading.Thread(target=killer)
+        t.start()
+        try:
+            TSFReader.read_chunk = slow
+            with pytest.raises(QueryKilled):
+                sh.read_series("cpu", sid)
+            t_died = time.monotonic()
+        finally:
+            TSFReader.read_chunk = orig
+            TRACKER.unregister(qid)
+            t.join()
+        assert t_died - killed_at["t"] < 0.5  # died mid-scan, not at end
+        # clean shutdown: the shared pool serves the next scan correctly
+        rec = sh.read_series("cpu", sid)
+        assert len(rec) == 60
+
+    def test_kill_interrupts_prefetch_pipeline(self, env, pool_on):
+        """The double-buffered executor pipeline also dies promptly: the
+        kill surfaces from the prefetch producer thread."""
+        e, ex = env
+        _write_multi_chunk(e, hosts=70, points=120, flushes=3)
+        from opengemini_tpu.storage.shard import Shard
+
+        orig = Shard.read_series_bulk
+
+        def slow(self, *a, **k):
+            time.sleep(0.05)
+            return orig(self, *a, **k)
+
+        qid = TRACKER.register("pipeline scan", "db")
+
+        def killer():
+            time.sleep(0.02)
+            TRACKER.kill(qid)
+
+        t = threading.Thread(target=killer)
+        t.start()
+        try:
+            Shard.read_series_bulk = slow
+            with pytest.raises(QueryKilled):
+                # call the scan layer directly under the registered qid
+                ex._select(
+                    exmod.parse(
+                        "SELECT mean(v) FROM cpu GROUP BY time(1m)")[0],
+                    "db", (BASE + 10_000) * NS)
+        finally:
+            Shard.read_series_bulk = orig
+            TRACKER.unregister(qid)
+            t.join()
+
+
+class TestKnobs:
+    def test_workers_one_means_serial(self, monkeypatch):
+        monkeypatch.setattr(scanpool, "WORKERS", 1)
+        assert not scanpool.enabled()
+        assert scanpool.pool() is None
+        # still functional, inline
+        assert list(scanpool.map_ordered([lambda: 5])) == [5]
+
+    def test_est_chunk_bytes(self):
+        class C:
+            rows = 100
+            cols = {"a": None, "b": None}
+
+        assert scanpool.est_chunk_bytes(C(), None) == 100 * 9 * 4
+        assert scanpool.est_chunk_bytes(C(), 1) == 100 * 9 * 3
