@@ -382,6 +382,12 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
         if batch:
             eng.write_lines("bench", "\n".join(batch))
         t_ingest = time.perf_counter() - t0
+        # flush to immutable TSF files: the warm queries below measure
+        # the production steady-state read path (chunk decode + the
+        # decoded-column cache), not a memtable-only scan — below the
+        # 64MB auto-flush threshold the whole dataset would otherwise
+        # stay in memory and the colcache hit-rate line would read 0
+        eng.flush_all()
         ex = Executor(eng)
         q = (
             "SELECT mean(usage_user), max(usage_user), count(usage_user) "
@@ -411,7 +417,16 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
                 best = min(best, run())
             return best
 
+        # decoded-column cache hit rate over the warm repeats (the
+        # incremental result cache is cleared per run, so these scans
+        # exercise the chunk-decode path the colcache short-circuits)
+        from opengemini_tpu.storage import colcache as _colcache
+
+        cc0 = _colcache.GLOBAL.counters()
         t_warm = timed_uncached()  # grid path
+        cc1 = _colcache.GLOBAL.counters()
+        cc_hits = cc1["hits"] - cc0["hits"]
+        cc_miss = cc1["misses"] - cc0["misses"]
         # A/B: same query with the grid fast path disabled (bucketed
         # layout) — the production grid-vs-bucketed speedup, full e2e
         prior_knob = os.environ.get("OGTPU_DISABLE_GRID")
@@ -433,6 +448,9 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
             "query_warm_rows_per_s": round(rows / t_warm),
             "query_warm_bucketed_s": round(t_warm_bucketed, 3),
             "grid_vs_bucketed_speedup": round(t_warm_bucketed / max(t_warm, 1e-9), 2),
+            "colcache_hit_rate": round(
+                cc_hits / max(cc_hits + cc_miss, 1), 4),
+            "colcache_bytes_resident": cc1["bytes"],
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -506,6 +524,84 @@ def bench_scan_floor(rows: int = 8_000_000, chunk: int = 16_384) -> dict:
             "pool_speedup": round(t_serial / max(t_pooled, 1e-9), 2),
         }
     finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_colcache_warm(rows: int = 4_000_000, chunk: int = 16_384,
+                        series: int = 64) -> dict:
+    """Decoded-column cache warm speedup (storage/colcache.py): the SAME
+    bulk scan over real TSF files, cache off vs cache on (one priming
+    pass), through the production shard read path — the acceptance
+    metric for PR 2 (target: >= 2x warm rows/s)."""
+    import shutil
+    import tempfile
+
+    from opengemini_tpu.record import Column, FieldType, Record
+    from opengemini_tpu.storage import colcache
+    from opengemini_tpu.storage.shard import Shard
+    from opengemini_tpu.storage.tsf import TSFWriter
+
+    NS = 1_000_000_000
+    base = 1_700_000_000
+    root = tempfile.mkdtemp(prefix="ogtpu-colcache-")
+    cc = colcache.GLOBAL
+    prev = cc.config()
+    try:
+        path = os.path.join(root, "00000001.tsf")
+        w = TSFWriter(path)
+        rng = np.random.default_rng(7)
+        per_series = rows // series
+        for sid in range(series):
+            for lo in range(0, per_series, chunk):
+                n = min(chunk, per_series - lo)
+                idx = np.arange(lo, lo + n, dtype=np.int64)
+                times = (base * NS) + idx * NS
+                vals = rng.standard_normal(n) + 50.0
+                rec = Record(times, {"v": Column(
+                    FieldType.FLOAT, vals, np.ones(n, np.bool_))})
+                w.add_chunk("cpu", sid, rec)
+        w.finish()
+        sh = Shard(root, 0, 2**62)
+        sids = np.arange(series, dtype=np.int64)
+        total = per_series * series
+
+        def scan():
+            _s, rec = sh.read_series_bulk("cpu", sids)
+            return len(rec)
+
+        def timed() -> float:
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                got = scan()
+                assert got == total
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        cc.configure(budget_mb=0)  # off: every pass decodes
+        t_off = timed()
+        # on: budget sized for the decoded set; one priming pass fills
+        budget_mb = max(256, (total * 32) >> 20)
+        cc.configure(budget_mb=budget_mb)
+        cc.clear()
+        scan()
+        c0 = cc.counters()
+        t_on = timed()
+        c1 = cc.counters()
+        hits = c1["hits"] - c0["hits"]
+        misses = c1["misses"] - c0["misses"]
+        sh.close()
+        return {
+            "rows": total,
+            "cold_rows_per_s": round(total / t_off),
+            "warm_rows_per_s": round(total / t_on),
+            "colcache_warm_speedup": round(t_off / max(t_on, 1e-9), 2),
+            "hit_rate": round(hits / max(hits + misses, 1), 4),
+            "bytes_resident": c1["bytes"],
+        }
+    finally:
+        cc.configure(**prev)
+        cc.clear()
         shutil.rmtree(root, ignore_errors=True)
 
 
@@ -904,6 +1000,20 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     except Exception as e:  # noqa: BLE001 — bench must still emit
         print(f"bench: scan floor failed: {e}", file=sys.stderr)
 
+    # decoded-column cache: identical repeated scan, cache off vs on
+    # (the PR 2 acceptance metric; >= 2x warm target)
+    colcache_warm = None
+    try:
+        colcache_warm = bench_colcache_warm(
+            rows=int(os.environ.get("OGTPU_BENCH_COLCACHE_ROWS",
+                                    "4000000")))
+        _emit("colcache_warm_speedup" + suffix,
+              colcache_warm["colcache_warm_speedup"], "x",
+              colcache_warm["colcache_warm_speedup"],
+              {"detail": colcache_warm})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: colcache warm failed: {e}", file=sys.stderr)
+
     # e2e host path (config #1 shape)
     e2e = bench_e2e(
         series=int(os.environ.get("OGTPU_BENCH_E2E_SERIES", "200")),
@@ -932,6 +1042,8 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     extra = {"configs": configs, "probe": probe, "e2e_ingest_query": e2e}
     if scan_floor:
         extra["host_scan_floor"] = scan_floor
+    if colcache_warm:
+        extra["colcache_warm"] = colcache_warm
     if note:
         extra["note"] = note
     atspec_best = _load_atspec_lastgood()

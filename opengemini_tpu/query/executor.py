@@ -32,6 +32,8 @@ from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query import functions as fnmod
 from opengemini_tpu.record import FieldType, FieldTypeConflict
 from opengemini_tpu.sql import ast
+from opengemini_tpu.parallel import runtime as prt
+from opengemini_tpu.storage import colcache as colcache_mod
 from opengemini_tpu.storage import scanpool
 from opengemini_tpu.meta.users import AuthError as _AuthError
 from opengemini_tpu.storage.engine import WriteError
@@ -164,6 +166,45 @@ def _plan_scan_slices(shards, mst, scan_plan, aligned, every_ns, W,
         plan.append((w0, ws, max(lo, tmin), min(hi, tmax)))
         w0 += ws
     return plan
+
+
+def _device_scan_token(db, rp, mst, sc, group_time, group_tags, all_tags,
+                       tmin, tmax, aligned, W, dtype, scan_ranges, shards):
+    """Scan signature for the decoded-column cache's device tier
+    (storage/colcache.py): everything that determines a GridBatch's
+    assembled (values, mask) grids — the statement's non-time shape (like
+    resultcache.fingerprint), the resolved time geometry, the actually
+    scanned ranges (the incremental cache may shrink them per execution),
+    and every shard's (path, data_version).  data_version bumps on any
+    logical-content change (writes, deletes, rewrites) but not on
+    flush/compact, whose merged reads are bit-identical by construction —
+    the same trust the incremental result cache is built on.  Returns
+    None when any shard lacks the versioning contract (remote proxies)."""
+    import json as _json
+
+    from opengemini_tpu.sql import astjson
+
+    sigs = []
+    for sh in shards:
+        ver = getattr(sh, "data_version", None)
+        path = getattr(sh, "path", None)
+        if ver is None or path is None:
+            return None
+        sigs.append((path, ver))
+    return _json.dumps(
+        [
+            db, rp or "", mst,
+            astjson.to_json(sc.tag_expr),
+            astjson.to_json(sc.field_expr),
+            astjson.to_json(sc.mixed_expr),
+            bool(sc.mixed_series_level),
+            group_time.every_ns, group_time.offset_ns,
+            list(group_tags), bool(all_tags),
+            tmin, tmax, aligned, W, str(dtype),
+            [list(r) for r in scan_ranges], sorted(sigs),
+        ],
+        separators=(",", ":"),
+    )
 
 
 class _ScanStager:
@@ -1290,6 +1331,28 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         pre_used = False
         sliced_out = None
 
+        # decoded-column cache, device tier (storage/colcache.py): stamp
+        # grid batches with a scan signature so their padded device
+        # buffers are retained and a repeated identical scan skips the
+        # host->device transfer (and the grid scatter). Local
+        # deterministic scans only — no remote peers, no device mesh.
+        device_token = None
+        if (
+            group_time is not None
+            and self.router is None
+            and ctx.live is None
+            and colcache_mod.GLOBAL.device_enabled()
+            and prt.get_mesh() is None
+        ):
+            device_token = _device_scan_token(
+                db, rp, mst, sc, group_time, group_tags,
+                stmt.group_by_all_tags, tmin, tmax, aligned, W, dtype,
+                scan_ranges, shards)
+        if device_token is not None:
+            for f, b in batches.items():
+                if hasattr(b, "device_cache_token"):
+                    b.device_cache_token = f"{device_token}|{f}"
+
         # at-spec scans: window-aligned time slicing bounds host/device
         # memory and overlaps decode with device compute (VERDICT r4 #1;
         # reference analogue: the record-plan batch reader streams chunks,
@@ -1308,6 +1371,8 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                 shards, mst, scan_plan, aligned, group_time.every_ns, W,
                 tmin, tmax)
 
+        cc_before = (colcache_mod.GLOBAL.counters()
+                     if colcache_mod.GLOBAL.enabled() else None)
         with trace.span("scan") as scan_span:
             if full_hit:
                 rows_scanned = 0
@@ -1315,7 +1380,7 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                 rows_scanned, sliced_out = self._scan_sliced(
                     slice_plan, scan_plan, scan_ranges, sc, mst, group_time,
                     needed_fields, read_fields, dtype, schema,
-                    per_field_aggs, num_groups,
+                    per_field_aggs, num_groups, device_token,
                 )
             else:
                 rows_scanned, pre_used = self._scan_monolithic(
@@ -1328,6 +1393,22 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
             if slice_plan is not None:
                 scan_span.add_field("slices", len(slice_plan))
         STATS.incr("executor", "rows_scanned", rows_scanned)
+        # decoded-column cache attribution for EXPLAIN ANALYZE / query
+        # stage stats: the scan-interval delta of the process-global
+        # counters (concurrent queries can bleed in; the per-query exact
+        # time also lands on this query via querytracker stages)
+        if cc_before is not None:
+            cc_after = colcache_mod.GLOBAL.counters()
+            with trace.span("colcache") as sp:
+                for key in ("hits", "misses", "device_hits",
+                            "device_misses"):
+                    sp.add_field(key, cc_after[key] - cc_before[key])
+                sp.add_field(
+                    "time_ms",
+                    round((cc_after["time_ns"] - cc_before["time_ns"])
+                          / 1e6, 3))
+                sp.add_field("bytes_resident", cc_after["bytes"])
+                sp.add_field("device_bytes", cc_after["device_bytes"])
 
         # run aggregates on device
         agg_results = {}  # id(call) -> (values, sel, counts)
@@ -1619,7 +1700,7 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
     def _scan_sliced(
         self, slice_plan, scan_plan, scan_ranges, sc, mst, group_time,
         needed_fields, read_fields, dtype, schema, per_field_aggs,
-        num_groups,
+        num_groups, device_token=None,
     ) -> tuple[int, list]:
         """Window-aligned sliced scan: each slice decodes into its own
         batch set, then the device kernels for that slice are DISPATCHED
@@ -1642,6 +1723,12 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                               (W_s, group_time.every_ns))
                 for f in needed_fields
             }
+            if device_token is not None:
+                # per-slice signature: same scan, distinct window span
+                for f, b in sbatches.items():
+                    if hasattr(b, "device_cache_token"):
+                        b.device_cache_token = \
+                            f"{device_token}|{f}|{w0}:{W_s}"
             got, _pre = self._scan_monolithic(
                 scan_plan, ranges, sc, mst, group_time, lo, W_s,
                 needed_fields, read_fields, dtype, lo, sbatches,

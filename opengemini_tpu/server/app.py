@@ -27,7 +27,14 @@ import os
 import signal
 import sys
 import threading
-import tomllib
+
+try:
+    import tomllib  # py311+
+except ModuleNotFoundError:  # pragma: no cover — exercised on py<3.11
+    try:
+        import tomli as tomllib  # the pre-3.11 backport, same API
+    except ModuleNotFoundError:
+        tomllib = None  # config loading degrades to defaults-only
 
 from opengemini_tpu.server.http import HttpService
 from opengemini_tpu.utils import peers as peernet
@@ -42,6 +49,11 @@ DEFAULTS = {
 def load_config(path: str | None) -> dict:
     cfg = {k: dict(v) for k, v in DEFAULTS.items()}
     if path:
+        if tomllib is None:
+            raise SystemExit(
+                "-config requires a TOML parser: Python >= 3.11 "
+                "(tomllib) or the tomli package"
+            )
         with open(path, "rb") as f:
             user = tomllib.load(f)
         for section, vals in user.items():

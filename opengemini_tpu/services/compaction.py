@@ -2,7 +2,12 @@
 engine/compact.go + immutable LevelCompact compact.go:120): shards whose
 immutable file count exceeds the threshold are merged. Compaction also
 restores the pre-aggregation fast path: merged, non-overlapping chunks
-qualify for block skipping where fragmented ones may not."""
+qualify for block skipping where fragmented ones may not.
+
+Every merge this service triggers swaps the shard's file set, which
+invalidates the affected decoded-column cache generations
+(storage/colcache.py — the invalidation lives at the swap sites in
+storage/shard.py, so manual compact() calls are covered identically)."""
 
 from __future__ import annotations
 

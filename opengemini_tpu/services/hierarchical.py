@@ -85,8 +85,12 @@ class HierarchicalService(Service):
 
         shard.index = open_series_index(shard.path)
         shard.wal = WAL(os.path.join(shard.path, "wal.log"), sync=shard.wal.sync)
+        # file-set swap: release the old readers' decoded-column cache
+        # entries (their generations can never be hit again) and stamp
+        # the fresh readers with the shard's cache namespace
+        shard.drop_cached_columns()
         shard._files = [
-            TSFReader(os.path.join(shard.path, f))
+            shard._adopt(TSFReader(os.path.join(shard.path, f)))
             for f in sorted(os.listdir(shard.path))
             if f.endswith(".tsf")
         ]

@@ -1,4 +1,8 @@
-"""Retention enforcement service (reference: services/retention/service.go:81)."""
+"""Retention enforcement service (reference: services/retention/service.go:81).
+
+Expired-shard drops close each shard, which releases its decoded-column
+cache entries (storage/colcache.py via Shard.close); a recreated shard
+at the same path can never alias them (generation-keyed entries)."""
 
 from __future__ import annotations
 

@@ -572,6 +572,9 @@ class Engine:
                         self.obs_store.put(f"{prefix}/{rel}", full)
                 shard.wal.close()
                 shard.index.close()
+                # cold-tier offload retires the local files: release the
+                # shard's decoded-column cache entries (colcache)
+                shard.drop_cached_columns()
             del self._shards[key]
             # registry FIRST: a crash before the local removal leaves both
             # copies (attach_object_store reconciles, preferring local); the

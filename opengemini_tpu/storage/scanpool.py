@@ -124,7 +124,10 @@ def map_ordered(jobs, est_bytes=None, inflight_bytes: int | None = None):
 
     def run(job):
         # worker-side cancellation: a killed query stops paying for
-        # decodes whose results would be discarded anyway
+        # decodes whose results would be discarded anyway. Binding the
+        # qid also attributes worker-side cache fills (colcache stage
+        # time) to the owning query; the binding dies with the next task.
+        _TRACKER.bind(qid)
         _TRACKER.raise_if_killed(qid)
         return job()
 

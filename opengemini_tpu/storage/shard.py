@@ -20,13 +20,14 @@ from opengemini_tpu.record import (
     Column, FieldTypeConflict, Record, merge_bulk_parts,
     merge_sorted_records, _zeroed as _rec_zeroed,
 )
-from opengemini_tpu.storage import scanpool
+from opengemini_tpu.storage import colcache, scanpool
 from opengemini_tpu.storage.memtable import MemTable
 from opengemini_tpu.storage.tsf import (
     PACK_MIN_SERIES, PACK_ROWS, TSFReader, TSFWriter,
 )
 from opengemini_tpu.storage.wal import WAL
 from opengemini_tpu.utils.failpoint import inject as _fp
+from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
 
 
 def _pack_entries(buffer: list) -> tuple[np.ndarray, Record]:
@@ -167,6 +168,11 @@ class Shard:
         self.data_version = next(_DATA_VERSIONS)
         self._mut_floor = self.data_version  # history unknown at/below
         self._mutations: list[tuple[int, int, int]] = []
+        # decoded-column cache namespace (storage/colcache.py): a
+        # process-unique shard id stamped onto every reader this shard
+        # opens, so cache keys identify (shard, file, chunk) even when a
+        # dropped-and-recreated shard reuses a path
+        self.cache_ns = next(_DATA_VERSIONS)
         # measurement -> field -> FieldType; owned here so it survives
         # memtable generations and is seeded from immutable files on open.
         self.schemas: dict[str, dict] = {}
@@ -181,6 +187,18 @@ class Shard:
                 self.schemas.setdefault(mst, {}).update(r.schema(mst))
         self.wal = WAL(os.path.join(path, "wal.log"), sync=sync_wal)
         self._replay_wal()
+
+    def _adopt(self, reader: TSFReader) -> TSFReader:
+        """Stamp the shard's cache namespace onto a freshly-opened reader
+        (decoded-column cache key component, storage/colcache.py)."""
+        reader.owner_ns = self.cache_ns
+        return reader
+
+    def drop_cached_columns(self) -> int:
+        """Invalidate every decoded-column cache entry of this shard's
+        CURRENT files (close/offload hook; file-set swaps invalidate the
+        retired readers at the swap site). Returns entries dropped."""
+        return colcache.GLOBAL.invalidate_gens([r.gen for r in self._files])
 
     def _note_mutation(self, lo: int, hi: int) -> None:
         """Record a logical-content change over [lo, hi) ns."""
@@ -222,7 +240,7 @@ class Shard:
             f for f in os.listdir(self.path) if f.endswith(".tsf")
         )
         for name in names:
-            self._files.append(TSFReader(os.path.join(self.path, name)))
+            self._files.append(self._adopt(TSFReader(os.path.join(self.path, name))))
             seq = int(name.split(".")[0])
             self._next_file_seq = max(self._next_file_seq, seq + 1)
 
@@ -421,7 +439,7 @@ class Shard:
                 raise
             tidx.write(path)
             self._next_file_seq += 1
-            self._files.append(TSFReader(path))
+            self._files.append(self._adopt(TSFReader(path)))
             self.mem = MemTable(self.schemas)
             _fp("shard-flush-before-wal-truncate")
             self.wal.truncate()
@@ -519,7 +537,7 @@ class Shard:
             tidx.write(path)
             self._next_file_seq += 1
             old = self._files
-            self._files = [TSFReader(path)]
+            self._files = [self._adopt(TSFReader(path))]
             self._tidx_cache = {}
             _retire_files(old)
             return True
@@ -586,13 +604,18 @@ class Shard:
         _fp("compact-before-replace")
         os.replace(tmp, target)  # new content under the run's 1st name
         tidx.write(target)
-        new_reader = TSFReader(target)
+        new_reader = self._adopt(TSFReader(target))
         retired = run[1:]
         self._files = (
             self._files[:i0] + [new_reader] + self._files[i0 + n :]
         )
         self._tidx_cache = {}
         _retire_files(retired)  # the old run[0] reader keeps its fd
+        # run[0]'s OLD reader was replaced in place (same path, new
+        # generation): its path needs no unlink, but its cached decoded
+        # columns must go — they can never hit again (the new reader has
+        # a fresh generation) and would otherwise pin budget forever
+        colcache.GLOBAL.invalidate_gens([run[0].gen])
 
     def has_time_overlap(self) -> bool:
         """True when any two immutable files' time ranges overlap (the
@@ -681,7 +704,7 @@ class Shard:
             self.schemas.update(staged_schemas)
             self._next_file_seq += 1
             old = self._files
-            self._files = [TSFReader(path)]
+            self._files = [self._adopt(TSFReader(path))]
             self._tidx_cache = {}
             _retire_files(old)
             self._note_mutation(self.tmin, self.tmax)  # after swap (see delete_data)
@@ -734,7 +757,7 @@ class Shard:
                 raise
             self._next_file_seq += 1
             old = self._files
-            self._files = [TSFReader(path)] if wrote else []
+            self._files = [self._adopt(TSFReader(path))] if wrote else []
             if not wrote:
                 os.remove(path)
             _retire_files(old)
@@ -860,10 +883,28 @@ class Shard:
                 return r.read_packed_sid(measurement, c, sid, fields)
             return r.read_chunk(measurement, c, fields)
 
-        recs = list(scanpool.map_ordered(
-            [lambda r=r, c=c: decode(r, c) for r, c in chunks],
-            [scanpool.est_chunk_bytes(c, n_fields) for _r, c in chunks],
-        ))
+        # decoded-column cache consult BEFORE pool dispatch
+        # (storage/colcache.py): fully-cached chunks assemble inline and
+        # never enter the pool; misses fill through it, so the in-flight
+        # backpressure budget keeps applying to everything that decodes
+        recs: list = [None] * len(chunks)
+        jobs, ests, miss_at = [], [], []
+        for i, (r, c) in enumerate(chunks):
+            # a fully-cached scan submits nothing to the pool, so the
+            # pool's per-chunk kill points never run — keep KILL QUERY
+            # responsive per chunk on the warm path too
+            _TRACKER.check()
+            got = (r.read_packed_sid_if_cached(measurement, c, sid, fields)
+                   if c.packed
+                   else r.read_chunk_if_cached(measurement, c, fields))
+            if got is not None:
+                recs[i] = got
+            else:
+                jobs.append(lambda r=r, c=c: decode(r, c))
+                ests.append(scanpool.est_chunk_bytes(c, n_fields))
+                miss_at.append(i)
+        for i, out in zip(miss_at, scanpool.map_ordered(jobs, ests)):
+            recs[i] = out
         mem_rec = self.mem.record_for(sid)
         if mem_rec is not None:
             if fields is not None:
@@ -918,22 +959,40 @@ class Shard:
         # in submission (= file) order, so the parts list is identical to
         # the old serial loop's and last-write-wins ranking is unchanged.
         # Per-chunk kill points live inside map_ordered (see read_series).
+        # Fully-cached chunks (decoded-column cache, storage/colcache.py)
+        # assemble inline and skip the pool; `slots` keeps file order.
         jobs = []
         ests = []
+        slots: list = []
+        miss_at = []
         for r in files:
             for c in r.chunks(measurement, None, tmin, tmax):
                 if c.packed:
                     if c.smax < sids[0] or c.smin > sids[-1]:
                         continue
+                    _TRACKER.check()  # warm-path kill point (see read_series)
+                    got = r.read_packed_bulk_if_cached(
+                        measurement, c, fields, sid_filter=sids)
+                    if got is not None:
+                        slots.append(got if len(got[1]) else None)
+                        continue
                     jobs.append(lambda r=r, c=c: decode_packed(r, c))
                 elif c.sid in sid_set:
+                    _TRACKER.check()  # warm-path kill point
+                    got = r.read_chunk_if_cached(measurement, c, fields)
+                    if got is not None:
+                        slots.append(
+                            (np.full(len(got), c.sid, np.int64), got))
+                        continue
                     jobs.append(lambda r=r, c=c: decode_single(r, c))
                 else:
                     continue
+                miss_at.append(len(slots))
+                slots.append(None)
                 ests.append(scanpool.est_chunk_bytes(c, n_fields))
-        for part in scanpool.map_ordered(jobs, ests):
-            if part is not None:
-                parts.append(part)
+        for i, part in zip(miss_at, scanpool.map_ordered(jobs, ests)):
+            slots[i] = part
+        parts.extend(p for p in slots if p is not None)
         for sid_arr, mem_rec in self.mem.bulk_parts(measurement, sids):
             if fields is not None:
                 mem_rec = Record(
@@ -1004,6 +1063,10 @@ class Shard:
             self.wal.close()
             self.index.flush()
             self.index.close()
+            # retention drops / DROP DATABASE / engine close all arrive
+            # here: release every decoded-column cache entry this shard
+            # pinned (in-flight readers keep their arrays via refcounts)
+            self.drop_cached_columns()
             for r in self._files:
                 r.close()
 
@@ -1012,9 +1075,13 @@ def _retire_files(readers: list) -> None:
     in-flight queries hold (reader, chunk) pairs outside the shard lock, and
     POSIX keeps unlinked files readable through existing fds. The fds close
     when the reader objects are garbage-collected after the last query
-    releases them (the reference's file-set swap works the same way)."""
+    releases them (the reference's file-set swap works the same way).
+    Decoded-column cache entries of the retired generations drop here too
+    (compaction / downsample / delete rewrites); queries mid-scan keep
+    any arrays they already hold via normal refcounting."""
     import os as _os
 
+    colcache.GLOBAL.invalidate_gens([r.gen for r in readers])
     for r in readers:
         for p in (r.path, _tidx_path(r.path)):
             try:

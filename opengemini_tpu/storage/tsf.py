@@ -18,6 +18,7 @@ multi-series blocks (colstore layout, see add_packed_chunk).
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import struct
@@ -28,7 +29,7 @@ from collections import OrderedDict
 import numpy as np
 
 from opengemini_tpu.record import Column, FieldType, Record
-from opengemini_tpu.storage import encoding
+from opengemini_tpu.storage import colcache, encoding
 from opengemini_tpu.utils.bloom import BloomFilter
 
 MAGIC = b"OGTSF01\n"
@@ -227,9 +228,19 @@ class TSFWriter:
             os.remove(self._tmp)
 
 
+# process-global file generations: a reader opened over a path that a
+# compaction later rewrites IN PLACE (os.replace) gets a fresh number, so
+# a (generation, chunk) cache key can never alias stale decoded data
+_READER_GEN = itertools.count(1)
+
+
 class TSFReader:
     def __init__(self, path: str):
         self.path = path
+        # decoded-column cache identity (storage/colcache.py): gen is the
+        # invalidation handle; owner_ns is stamped by the owning Shard
+        self.gen = next(_READER_GEN)
+        self.owner_ns: int | None = None
         self._f = open(path, "rb")
         self._f.seek(0, os.SEEK_END)
         size = self._f.tell()
@@ -376,12 +387,17 @@ class TSFReader:
     def read_times(self, chunk: ChunkMeta) -> np.ndarray:
         return encoding.decode_ints(self._read(chunk.time_loc))
 
-    # decoded-column LRU (reference: lib/readcache — hot chunks decode
-    # once, not per query). Safe because TSF files are immutable and no
-    # read path mutates decoded arrays in place. BYTE-budgeted per open
-    # file; bulk one-pass scans (compaction, downsample, export) bypass
-    # it entirely (cache=False) so soon-to-be-retired readers never pin
-    # decoded arrays.
+    # decoded-column caching (reference: lib/readcache — hot chunks
+    # decode once, not per query). Safe because TSF files are immutable
+    # and no read path mutates decoded arrays in place. Two regimes:
+    # with the process-global decoded-column cache enabled
+    # (storage/colcache.py, OGT_COLCACHE_MB > 0) columns live there,
+    # keyed (shard, file generation, chunk, series, field) with explicit
+    # invalidation at every file-set swap; with it disabled, the original
+    # per-open-file byte-budgeted LRU below serves bit-identically. Bulk
+    # one-pass scans (compaction, downsample, export) bypass BOTH
+    # (cache=False) so soon-to-be-retired readers never pin decoded
+    # arrays.
     _CACHE_BYTES = 16 << 20  # decoded-bytes budget per open file
 
     @staticmethod
@@ -391,7 +407,27 @@ class TSFReader:
                        else len(val.values) * 64) + int(val.valid.nbytes)
         return int(getattr(val, "nbytes", 64))
 
-    def _cached_col(self, key, decode):
+    def _colcache_key(self, chunk: ChunkMeta, name):
+        # (shard id, file generation, chunk id, series, field): the sid
+        # is the chunk's own for per-series chunks, None for packed
+        # multi-series chunks (whose columns cache whole; per-sid slicing
+        # is a cheap binary search over the cached arrays)
+        return (self.owner_ns, self.gen, id(chunk), chunk.sid, name)
+
+    def _cached_col(self, chunk: ChunkMeta, name, decode):
+        """Decode-once lookup for one column of one chunk: `name` is the
+        field name, None for the time column, "\\x00sids" for a packed
+        chunk's sid column."""
+        cc = colcache.GLOBAL
+        if cc.enabled():
+            key = self._colcache_key(chunk, name)
+            got = cc.get(key)
+            if got is not None:
+                return got
+            val = decode()
+            cc.put(key, val)
+            return val
+        key = (id(chunk), name)
         with self._cache_lock:
             got = self._col_cache.get(key)
             if got is not None:
@@ -420,7 +456,7 @@ class TSFReader:
         def times_decode():
             return self.read_times(chunk)
 
-        times = (self._cached_col((id(chunk), None), times_decode)
+        times = (self._cached_col(chunk, None, times_decode)
                  if cache else times_decode())
         cols = {}
         names = fields if fields is not None else list(chunk.cols)
@@ -434,9 +470,42 @@ class TSFReader:
                 mbuf = self._read(loc["m"]) if loc["m"] else b""
                 return encoding.decode_column(schema[name], vbuf, mbuf)
 
-            cols[name] = (self._cached_col((id(chunk), name), decode)
+            cols[name] = (self._cached_col(chunk, name, decode)
                           if cache else decode())
         return Record(times, cols)
+
+    def _chunk_from_cache(self, chunk: ChunkMeta,
+                          fields: list[str] | None) -> Record | None:
+        """The consult-before-dispatch fast path: assemble a chunk Record
+        purely from already-cached columns, or None on ANY miss (the
+        caller then decodes through the scan pool, whose in-flight-bytes
+        backpressure keeps bounding memory). No IO, no decode."""
+        import time as _time
+
+        cc = colcache.GLOBAL
+        if not cc.enabled():
+            return None
+        t0 = _time.perf_counter_ns()
+        times = cc.peek(self._colcache_key(chunk, None))
+        if times is None:
+            return None
+        cols = {}
+        names = fields if fields is not None else list(chunk.cols)
+        for name in names:
+            if name not in chunk.cols:
+                continue
+            col = cc.peek(self._colcache_key(chunk, name))
+            if col is None:
+                return None
+            cols[name] = col
+        cc.count_peek(1 + len(cols), _time.perf_counter_ns() - t0)
+        return Record(times, cols)
+
+    def read_chunk_if_cached(
+        self, measurement: str, chunk: ChunkMeta,
+        fields: list[str] | None = None,
+    ) -> Record | None:
+        return self._chunk_from_cache(chunk, fields)
 
 
     # -- packed (PK-sorted column store) reads ------------------------------
@@ -446,8 +515,27 @@ class TSFReader:
         def decode():
             return encoding.decode_ints(self._read(chunk.sid_loc))
 
-        return (self._cached_col((id(chunk), "\x00sids"), decode)
+        return (self._cached_col(chunk, "\x00sids", decode)
                 if cache else decode())
+
+    @staticmethod
+    def _sid_row_range(chunk: ChunkMeta, sids: np.ndarray,
+                       sid: int) -> tuple[int, int]:
+        """[lo, hi) row window of one sid inside a packed chunk: the
+        sparse PK index bounds the candidates, an exact binary search on
+        the sid column finds the run."""
+        import bisect
+
+        sp = chunk.sparse or []
+        entry_sids = [e[0] for e in sp]
+        j = bisect.bisect_left(entry_sids, sid)
+        w_lo = sp[j - 1][1] if j > 0 else 0
+        k = bisect.bisect_right(entry_sids, sid)
+        w_hi = sp[k][1] if k < len(sp) else chunk.rows
+        win = sids[w_lo:w_hi]
+        lo = w_lo + int(np.searchsorted(win, sid, "left"))
+        hi = w_lo + int(np.searchsorted(win, sid, "right"))
+        return lo, hi
 
     def read_packed_sid(
         self, measurement: str, chunk: ChunkMeta, sid: int,
@@ -461,24 +549,42 @@ class TSFReader:
         sparseindex/primary_index.go)."""
         if sid < chunk.smin or sid > chunk.smax:
             return Record(np.empty(0, np.int64), {})
-        # sparse index: the sid's run lies strictly between the last
-        # sparse entry with entry_sid < sid and the first entry with
-        # entry_sid > sid (entries sample every SPARSE_K rows)
-        import bisect
-
-        sp = chunk.sparse or []
-        entry_sids = [e[0] for e in sp]
-        j = bisect.bisect_left(entry_sids, sid)
-        w_lo = sp[j - 1][1] if j > 0 else 0
-        k = bisect.bisect_right(entry_sids, sid)
-        w_hi = sp[k][1] if k < len(sp) else chunk.rows
         sids = self.read_packed_sids(chunk, cache)
-        win = sids[w_lo:w_hi]
-        lo = w_lo + int(np.searchsorted(win, sid, "left"))
-        hi = w_lo + int(np.searchsorted(win, sid, "right"))
+        lo, hi = self._sid_row_range(chunk, sids, sid)
         if lo == hi:
             return Record(np.empty(0, np.int64), {})
         rec = self.read_chunk(measurement, chunk, fields, cache)
+        return Record(
+            rec.times[lo:hi],
+            {
+                name: Column(col.ftype, col.values[lo:hi], col.valid[lo:hi])
+                for name, col in rec.columns.items()
+            },
+        )
+
+    def read_packed_sid_if_cached(
+        self, measurement: str, chunk: ChunkMeta, sid: int,
+        fields: list[str] | None = None,
+    ) -> Record | None:
+        """read_packed_sid served purely from cached columns, or None on
+        any miss.  Out-of-span sids answer the empty record directly (no
+        decode would have happened either way)."""
+        if sid < chunk.smin or sid > chunk.smax:
+            return Record(np.empty(0, np.int64), {})
+        cc = colcache.GLOBAL
+        if not cc.enabled():
+            return None
+        sids = cc.peek(self._colcache_key(chunk, "\x00sids"))
+        if sids is None:
+            return None
+        lo, hi = self._sid_row_range(chunk, sids, sid)
+        if lo == hi:
+            cc.count_peek(1)
+            return Record(np.empty(0, np.int64), {})
+        rec = self._chunk_from_cache(chunk, fields)
+        if rec is None:
+            return None
+        cc.count_peek(1)  # the sid-column peek on top of the record's
         return Record(
             rec.times[lo:hi],
             {
@@ -498,6 +604,10 @@ class TSFReader:
         per-sid Python loops at high cardinality."""
         sids = self.read_packed_sids(chunk, cache)
         rec = self.read_chunk(measurement, chunk, fields, cache)
+        return self._packed_bulk_filter(sids, rec, sid_filter)
+
+    @staticmethod
+    def _packed_bulk_filter(sids, rec, sid_filter):
         if sid_filter is None:
             return sids, rec
         keep = np.isin(sids, sid_filter)
@@ -510,6 +620,26 @@ class TSFReader:
                 for name, col in rec.columns.items()
             },
         )
+
+    def read_packed_bulk_if_cached(
+        self, measurement: str, chunk: ChunkMeta,
+        fields: list[str] | None = None,
+        sid_filter: np.ndarray | None = None,
+    ) -> tuple[np.ndarray, Record] | None:
+        """read_packed_bulk served purely from cached columns, or None on
+        any miss (the sid filter is applied per call — cached columns
+        stay whole so every sid set shares one entry)."""
+        cc = colcache.GLOBAL
+        if not cc.enabled():
+            return None
+        sids = cc.peek(self._colcache_key(chunk, "\x00sids"))
+        if sids is None:
+            return None
+        rec = self._chunk_from_cache(chunk, fields)
+        if rec is None:
+            return None
+        cc.count_peek(1)
+        return self._packed_bulk_filter(sids, rec, sid_filter)
 
 
 class CorruptFile(Exception):

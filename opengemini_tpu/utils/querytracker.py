@@ -80,6 +80,20 @@ class QueryTracker:
     def is_killed(self, qid: int | None) -> bool:
         return qid is not None and qid in self._killed
 
+    def add_stage_ns(self, qid: int | None, name: str, ns: int) -> None:
+        """Attribute stage time (e.g. the decoded-column cache's lookup /
+        fill work, storage/colcache.py) to a running query so SHOW
+        QUERIES-style snapshots expose where a long query spends its
+        time.  No-op off-query or after the query unregistered; helper
+        threads (scan pool) bind the owning qid per task."""
+        if qid is None or ns <= 0:
+            return
+        with self._lock:
+            info = self._running.get(qid)
+            if info is not None:
+                stages = info.setdefault("stages", {})
+                stages[name] = stages.get(name, 0) + ns
+
     def raise_if_killed(self, qid: int | None) -> None:
         """check() for threads that carry the qid explicitly instead of
         thread-locally (scan-pool decode workers)."""
@@ -96,6 +110,11 @@ class QueryTracker:
                     "database": info["database"],
                     "duration_ms": int((now - info["started"]) * 1000),
                     "status": "killed" if qid in self._killed else "running",
+                    # per-stage attribution (colcache etc.), ms
+                    "stages": {
+                        name: ns // 1_000_000
+                        for name, ns in info.get("stages", {}).items()
+                    },
                 }
                 for qid, info in sorted(self._running.items())
             ]

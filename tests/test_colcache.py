@@ -1,0 +1,406 @@
+"""Decoded-column cache tests (storage/colcache.py).
+
+Staleness is the whole game for a cache over an LSM store: these tests
+prove that a write -> flush, a compaction rewrite, and a retention drop
+each evict the affected keys and that a subsequent query returns fresh
+data; plus a concurrency test (readers racing invalidation never observe
+a freed/garbage buffer), the disabled path (bit-identical to the
+uncached read), LRU budget enforcement, and the device tier's
+signature-keyed grid-buffer reuse."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import opengemini_tpu.ingest.line_protocol as lp
+from opengemini_tpu.storage import colcache
+from opengemini_tpu.storage.engine import Engine, NS
+from opengemini_tpu.storage.shard import Shard
+
+BASE = 1_700_000_000
+
+
+@pytest.fixture
+def cache():
+    """The process cache, configured ON at a test-friendly budget and
+    restored (with whatever env-derived config the session had) after."""
+    cc = colcache.GLOBAL
+    prev = cc.config()
+    cc.clear()
+    cc.configure(budget_mb=64, device=False)
+    yield cc
+    cc.configure(**prev)
+    cc.clear()
+
+
+def _write(sh, line: str) -> None:
+    sh.write_points(lp.parse_lines(line), line.encode(), "ns", 0)
+
+
+def _fill_shard(sh, n_files=3, rows=50):
+    for f in range(n_files):
+        lines = "\n".join(
+            f"cpu usage={f * rows + i} {(BASE + f * rows + i)}000000000"
+            for i in range(rows)
+        )
+        _write(sh, lines)
+        sh.flush()
+
+
+class TestHostTier:
+    def test_warm_read_serves_from_cache(self, tmp_path, cache):
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        _fill_shard(sh)
+        sid = sh.index.get_or_create("cpu", ())
+        first = sh.read_series("cpu", sid)
+        c0 = cache.counters()
+        assert c0["fills"] > 0 and c0["bytes"] > 0
+        second = sh.read_series("cpu", sid)
+        c1 = cache.counters()
+        # the repeat is served by consult-before-dispatch: hits, no
+        # further misses/fills
+        assert c1["hits"] > c0["hits"]
+        assert c1["misses"] == c0["misses"]
+        assert c1["fills"] == c0["fills"]
+        np.testing.assert_array_equal(first.times, second.times)
+        np.testing.assert_array_equal(
+            first.columns["usage"].values, second.columns["usage"].values)
+        sh.close()
+
+    def test_write_flush_returns_fresh_data(self, tmp_path, cache):
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        _write(sh, "cpu usage=1 1000000000")
+        sh.flush()
+        sid = sh.index.get_or_create("cpu", ())
+        assert sh.read_series("cpu", sid).columns["usage"].values.tolist() \
+            == [1.0]
+        # overwrite the same timestamp; pre-flush the memtable row must
+        # win over the cached chunk, post-flush the new file must win
+        _write(sh, "cpu usage=9 1000000000")
+        assert sh.read_series("cpu", sid).columns["usage"].values.tolist() \
+            == [9.0]
+        sh.flush()
+        assert sh.read_series("cpu", sid).columns["usage"].values.tolist() \
+            == [9.0]
+        sh.close()
+
+    def test_compaction_rewrite_evicts_and_refreshes(self, tmp_path, cache):
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        _write(sh, "cpu usage=1 1000000000")
+        sh.flush()
+        _write(sh, "cpu usage=2 2000000000\ncpu usage=9 1000000000")
+        sh.flush()
+        sid = sh.index.get_or_create("cpu", ())
+        # warm the cache over the pre-compaction files
+        assert sh.read_series("cpu", sid).columns["usage"].values.tolist() \
+            == [9.0, 2.0]
+        c0 = cache.counters()
+        assert c0["bytes"] > 0
+        assert sh.compact()
+        c1 = cache.counters()
+        # the rewrite dropped every entry of the retired generations
+        assert c1["invalidations"] > c0["invalidations"]
+        assert c1["bytes"] == 0
+        got = sh.read_series("cpu", sid)
+        assert got.columns["usage"].values.tolist() == [9.0, 2.0]
+        assert got.times.tolist() == [1000000000, 2000000000]
+        sh.close()
+
+    def test_leveled_compaction_in_place_rewrite_evicts(self, tmp_path, cache):
+        # _merge_run_locked replaces run[0]'s PATH in place — the old
+        # reader's generation must be invalidated even though its path
+        # survives (aliasing would serve stale decoded columns forever)
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        _fill_shard(sh, n_files=4, rows=20)
+        sid = sh.index.get_or_create("cpu", ())
+        before = sh.read_series("cpu", sid)
+        assert cache.counters()["bytes"] > 0
+        c0 = cache.counters()
+        assert sh.compact_level(fanout=4)
+        c1 = cache.counters()
+        assert c1["invalidations"] > c0["invalidations"]
+        after = sh.read_series("cpu", sid)
+        np.testing.assert_array_equal(before.times, after.times)
+        np.testing.assert_array_equal(
+            before.columns["usage"].values, after.columns["usage"].values)
+        sh.close()
+
+    def test_retention_drop_evicts(self, tmp_path, cache):
+        e = Engine(str(tmp_path / "e"))
+        e.create_database("db")
+        e.create_retention_policy(
+            "db", "short", duration_ns=2 * 24 * 3600 * NS, default=True)
+        e.write_lines("db", f"cpu v=1 {1 * NS}")  # ancient point
+        e.flush_all()
+        sh = e.all_shards()[0]
+        sid = sh.index.get_or_create("cpu", ())
+        assert sh.read_series("cpu", sid).columns["v"].values.tolist() == [1.0]
+        c0 = cache.counters()
+        assert c0["bytes"] > 0
+        now = 10 * 24 * 3600 * NS
+        assert len(e.drop_expired_shards(now_ns=now)) == 1
+        c1 = cache.counters()
+        assert c1["invalidations"] > c0["invalidations"]
+        assert c1["bytes"] == 0
+        # recreated data at the same path must never alias old entries
+        e.write_lines("db", f"cpu v=7 {(now - NS)}")
+        e.flush_all()
+        sh2 = e.shards_for_range("db", None, 0, now + NS)[0]
+        sid2 = sh2.index.get_or_create("cpu", ())
+        assert sh2.read_series("cpu", sid2).columns["v"].values.tolist() \
+            == [7.0]
+        e.close()
+
+    def test_delete_rewrite_evicts(self, tmp_path, cache):
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        _write(sh, "cpu usage=1 1000000000\ncpu usage=2 2000000000")
+        sh.flush()
+        sid = sh.index.get_or_create("cpu", ())
+        assert len(sh.read_series("cpu", sid)) == 2
+        c0 = cache.counters()
+        sh.delete_data("cpu", tmin=0, tmax=1500000000)
+        c1 = cache.counters()
+        assert c1["invalidations"] > c0["invalidations"]
+        assert sh.read_series("cpu", sid).columns["usage"].values.tolist() \
+            == [2.0]
+        sh.close()
+
+    def test_downsample_rewrite_evicts(self, tmp_path, cache):
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        lines = "\n".join(
+            f"cpu usage={i} {(BASE + i)}000000000" for i in range(120))
+        _write(sh, lines)
+        sh.flush()
+        sid = sh.index.get_or_create("cpu", ())
+        assert len(sh.read_series("cpu", sid)) == 120
+        c0 = cache.counters()
+        sh.rewrite_downsampled(60 * NS)
+        c1 = cache.counters()
+        assert c1["invalidations"] > c0["invalidations"]
+        assert len(sh.read_series("cpu", sid)) < 120  # coarser now
+        sh.close()
+
+    def test_disabled_is_bit_identical_and_untouched(self, tmp_path, cache):
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        _fill_shard(sh, n_files=2, rows=30)
+        sid = sh.index.get_or_create("cpu", ())
+        warm = sh.read_series("cpu", sid)
+        cache.configure(budget_mb=0)
+        c0 = cache.counters()
+        assert c0["bytes"] == 0  # disabling cleared the tier
+        cold = sh.read_series("cpu", sid)
+        c1 = cache.counters()
+        # the disabled path never touches the global cache
+        assert (c1["hits"], c1["misses"], c1["fills"]) \
+            == (c0["hits"], c0["misses"], c0["fills"])
+        assert cold.times.tobytes() == warm.times.tobytes()
+        assert cold.columns["usage"].values.tobytes() \
+            == warm.columns["usage"].values.tobytes()
+        np.testing.assert_array_equal(
+            cold.columns["usage"].valid, warm.columns["usage"].valid)
+        sh.close()
+
+    def test_lru_budget_bounds_bytes(self, tmp_path, cache):
+        cache.configure(budget_mb=1)
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        # ~3MB decoded (float64 + times), far over the 1MB budget
+        for f in range(4):
+            lines = "\n".join(
+                f"cpu usage={i}.5 {(BASE + f * 50_000 + i)}000000000"
+                for i in range(50_000)
+            )
+            _write(sh, lines)
+            sh.flush()
+        sid = sh.index.get_or_create("cpu", ())
+        rec = sh.read_series("cpu", sid)
+        assert len(rec) == 200_000
+        c = cache.counters()
+        assert c["bytes"] <= 1 << 20
+        assert c["evictions"] > 0
+        sh.close()
+
+    def test_bulk_read_warm_hits(self, tmp_path, cache):
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        lines = []
+        for s in range(100):  # >= PACK_MIN_SERIES: exercises packed chunks
+            for i in range(20):
+                lines.append(
+                    f"cpu,host=h{s:03d} usage={s}.0 {(BASE + i)}000000000")
+        _write(sh, "\n".join(lines))
+        sh.flush()
+        sids = np.asarray(sorted(sh.index.series_ids("cpu")), np.int64)
+        s1, r1 = sh.read_series_bulk("cpu", sids)
+        c0 = cache.counters()
+        s2, r2 = sh.read_series_bulk("cpu", sids)
+        c1 = cache.counters()
+        assert c1["hits"] > c0["hits"] and c1["fills"] == c0["fills"]
+        np.testing.assert_array_equal(s1, s2)
+        np.testing.assert_array_equal(r1.times, r2.times)
+        np.testing.assert_array_equal(
+            r1.columns["usage"].values, r2.columns["usage"].values)
+        # a different sid subset must reuse the SAME cached packed columns
+        subset = sids[: len(sids) // 2]
+        c2 = cache.counters()
+        s3, r3 = sh.read_series_bulk("cpu", subset)
+        c3 = cache.counters()
+        assert c3["fills"] == c2["fills"]  # no re-decode
+        assert set(np.unique(s3)) == set(int(x) for x in subset)
+        sh.close()
+
+    def test_put_after_invalidate_is_tombstoned(self, cache):
+        # a decode racing the file-set swap must not resurrect entries
+        # of a retired generation (no hook would ever drop them again)
+        key = (None, 987654321, 1, 0, "v")
+        cache.invalidate_gens([987654321])
+        cache.put(key, np.zeros(16))
+        assert cache.peek(key) is None
+        c = cache.counters()
+        assert c["bytes"] == 0
+
+    def test_configure_budget_keeps_device_budget(self, cache):
+        cache.configure(budget_mb=64, device=True, device_budget_mb=128)
+        cache.configure(budget_mb=32)  # must NOT clobber the 128MB
+        got = cache.config()
+        assert got["budget_mb"] == 32
+        assert got["device_budget_mb"] == 128
+        assert got["device"] is True
+
+    def test_concurrent_readers_vs_invalidation(self, tmp_path, cache):
+        """Readers racing compaction-driven invalidation: every read must
+        observe exactly the committed rows (values are a function of the
+        timestamp, so any freed/garbage buffer or stale mix shows up as a
+        mismatch), and never crash."""
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        rows = 200
+        lines = "\n".join(
+            f"cpu usage={i} {(BASE + i)}000000000" for i in range(rows))
+        _write(sh, lines)
+        sh.flush()
+        sid = sh.index.get_or_create("cpu", ())
+        stop = threading.Event()
+        errors: list = []
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    rec = sh.read_series("cpu", sid)
+                    t = (rec.times // NS) - BASE
+                    np.testing.assert_array_equal(
+                        rec.columns["usage"].values, t.astype(np.float64))
+                    assert len(rec) == rows
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        def churner():
+            try:
+                for i in range(15):
+                    # rewrite the file set (same logical content) and
+                    # invalidate, over and over
+                    _write(sh, f"cpu usage=0 {BASE}000000000")
+                    sh.flush()
+                    sh.compact()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                stop.set()
+
+        threads = [threading.Thread(target=reader) for _ in range(3)]
+        threads.append(threading.Thread(target=churner))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        sh.close()
+
+
+class TestDeviceTier:
+    def test_repeated_grid_scan_reuses_device_buffers(self, tmp_path, cache):
+        from opengemini_tpu.query.executor import Executor
+
+        cache.configure(budget_mb=64, device=True)
+        e = Engine(str(tmp_path / "e"))
+        e.create_database("db")
+        lines = []
+        for p in range(600):
+            t = (BASE + p) * NS
+            for s in range(8):
+                lines.append(f"cpu,host=h{s} u={50 + (s + p) % 40} {t}")
+        e.write_lines("db", "\n".join(lines))
+        e.flush_all()
+        ex = Executor(e)
+        q = (f"SELECT mean(u), max(u) FROM cpu WHERE time >= {BASE * NS} "
+             f"AND time < {(BASE + 600) * NS} GROUP BY time(1m), host")
+        now = (BASE + 600) * NS
+
+        def run():
+            ex._inc_cache.clear()  # isolate the scan path from the
+            return ex.execute(q, db="db", now_ns=now)  # result cache
+
+        r1 = run()
+        c1 = cache.counters()
+        assert c1["device_misses"] > 0  # cold: signature missed, stored
+        assert c1["device_bytes"] > 0
+        r2 = run()
+        c2 = cache.counters()
+        assert c2["device_hits"] > c1["device_hits"]
+        assert r1 == r2
+        # a WRITE bumps the shard's data_version: the signature changes,
+        # the next scan must miss (never serve the pre-write grid)
+        e.write_lines("db", f"cpu,host=h0 u=999 {(BASE + 1) * NS}")
+        r3 = run()
+        c3 = cache.counters()
+        assert c3["device_misses"] > c2["device_misses"]
+        assert r3 != r1  # the new point changed window aggregates
+        e.close()
+
+    def test_device_tier_off_means_no_entries(self, tmp_path, cache):
+        from opengemini_tpu.query.executor import Executor
+
+        cache.configure(budget_mb=64, device=False)
+        e = Engine(str(tmp_path / "e"))
+        e.create_database("db")
+        lines = [f"cpu u={p} {(BASE + p) * NS}" for p in range(300)]
+        e.write_lines("db", "\n".join(lines))
+        e.flush_all()
+        ex = Executor(e)
+        q = (f"SELECT mean(u) FROM cpu WHERE time >= {BASE * NS} "
+             f"AND time < {(BASE + 300) * NS} GROUP BY time(1m)")
+        ex.execute(q, db="db", now_ns=(BASE + 300) * NS)
+        c = cache.counters()
+        assert c["device_bytes"] == 0 and c["device_entries"] == 0
+        e.close()
+
+
+class TestObservability:
+    def test_counters_exported_via_statistics(self, tmp_path, cache):
+        from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        _fill_shard(sh, n_files=2, rows=20)
+        sid = sh.index.get_or_create("cpu", ())
+        sh.read_series("cpu", sid)
+        sh.read_series("cpu", sid)
+        snap = STATS.snapshot().get("colcache", {})
+        for key in ("hits", "fills", "bytes", "time_ns"):
+            assert key in snap, f"missing colcache counter {key}"
+        assert snap["hits"] > 0 and snap["bytes"] > 0
+        sh.close()
+
+    def test_query_stage_attribution(self, tmp_path, cache):
+        from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER
+
+        sh = Shard(str(tmp_path / "s"), 0, 10**18)
+        _fill_shard(sh, n_files=2, rows=20)
+        sid = sh.index.get_or_create("cpu", ())
+        qid = TRACKER.register("SELECT * FROM cpu", "db")
+        try:
+            sh.read_series("cpu", sid)
+            sh.read_series("cpu", sid)
+            snap = [q for q in TRACKER.snapshot() if q["qid"] == qid]
+            assert snap and "colcache" in snap[0]["stages"]
+        finally:
+            TRACKER.unregister(qid)
+
+        sh.close()
