@@ -439,6 +439,24 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
             else:
                 os.environ["OGTPU_DISABLE_GRID"] = prior_knob
         eng.close()
+        # the ACTIVE grid configuration, so a grid_vs_bucketed regression
+        # is diagnosable from this JSON alone (r05 recorded 0.72x with no
+        # way to tell whether the 128-lane TPU floor, a live
+        # OGTPU_DISABLE_GRID, or plain single-sample noise was at fault)
+        from opengemini_tpu.models import grid as _grid
+
+        W = points // 60
+        grid_cfg = {
+            "backend": __import__("jax").default_backend(),
+            "lane_quantum": _grid._lane_quantum(),
+            "windows": W,
+            "w_padded": _grid._pad_lanes(W, _grid._MIN_W),
+            # GROUP BY time() never consults selector indices: PR 1 skips
+            # the selector lex-scan kernels on grid and bucketed alike
+            "want_sel": False,
+            "grid_disabled_env": bool(os.environ.get("OGTPU_DISABLE_GRID")),
+            "timing": "best_of_3_per_layout",
+        }
         return {
             "rows": rows,
             "ingest_rows_per_s": round(rows / t_ingest),
@@ -448,6 +466,7 @@ def bench_e2e(series: int = 500, points: int = 7200) -> dict:
             "query_warm_rows_per_s": round(rows / t_warm),
             "query_warm_bucketed_s": round(t_warm_bucketed, 3),
             "grid_vs_bucketed_speedup": round(t_warm_bucketed / max(t_warm, 1e-9), 2),
+            "grid_config": grid_cfg,
             "colcache_hit_rate": round(
                 cc_hits / max(cc_hits + cc_miss, 1), 4),
             "colcache_bytes_resident": cc1["bytes"],
@@ -522,6 +541,165 @@ def bench_scan_floor(rows: int = 8_000_000, chunk: int = 16_384) -> dict:
             "serial_rows_per_s": round(rows / t_serial),
             "pooled_rows_per_s": round(rows / t_pooled),
             "pool_speedup": round(t_serial / max(t_pooled, 1e-9), 2),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_flush_floor(rows: int = 4_000_000, chunk: int = 16_384) -> dict:
+    """The host-side WRITE floor: encoded rows/s of real TSF chunk
+    writes, serial (the pre-encodepool path) vs pipelined through the
+    encode pool (storage/encodepool.py) — the write-side mirror of
+    host_scan_floor.  Outputs are verified bit-identical, so the metric
+    measures the pipeline alone."""
+    import shutil
+    import tempfile
+
+    from opengemini_tpu.record import Column, FieldType, Record
+    from opengemini_tpu.storage import encodepool
+    from opengemini_tpu.storage.tsf import TSFWriter
+
+    NS = 1_000_000_000
+    base = 1_700_000_000
+    root = tempfile.mkdtemp(prefix="ogtpu-flushfloor-")
+    try:
+        rng = np.random.default_rng(13)
+        recs = []
+        for lo in range(0, rows, chunk):
+            n = min(chunk, rows - lo)
+            idx = np.arange(lo, lo + n, dtype=np.int64)
+            times = (base * NS) + idx * NS
+            recs.append(Record(times, {
+                "v": Column(FieldType.FLOAT,
+                            rng.standard_normal(n) + 50.0,
+                            np.ones(n, np.bool_)),
+                "u": Column(FieldType.INT, (idx * 17) % 1000,
+                            np.ones(n, np.bool_)),
+            }))
+
+        def write(path: str) -> float:
+            t0 = time.perf_counter()
+            w = TSFWriter(path, kind="flush")
+            for sid, rec in enumerate(recs):
+                w.add_chunk("cpu", sid, rec)
+            w.finish()
+            return time.perf_counter() - t0
+
+        # INTERLEAVED best-of-3 (serial, pooled, serial, pooled, ...):
+        # this box's wall clock swings ~30% run to run, and timing all
+        # serial trials before all pooled ones let one noisy regime land
+        # entirely on one side of the A/B
+        p_serial = os.path.join(root, "serial.tsf")
+        p_pooled = os.path.join(root, "pooled.tsf")
+        t_serial = t_pooled = float("inf")
+        for _ in range(3):
+            with encodepool.forced_serial():
+                t_serial = min(t_serial, write(p_serial))
+            t_pooled = min(t_pooled, write(p_pooled))
+        with open(p_serial, "rb") as fa, open(p_pooled, "rb") as fb:
+            identical = fa.read() == fb.read()
+        assert identical, "pooled flush output diverged from serial"
+        return {
+            "rows": rows,
+            "chunks": len(recs),
+            "workers": encodepool.WORKERS,
+            "serial_rows_per_s": round(rows / t_serial),
+            "pooled_rows_per_s": round(rows / t_pooled),
+            "pool_speedup": round(t_serial / max(t_pooled, 1e-9), 2),
+            "bit_identical": identical,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_ingest_during_flush(rows: int = 2_000_000) -> dict:
+    """Write availability during a flush: single-point write latency
+    percentiles while a flush of `rows` memtable rows runs, A/B — the
+    flush holding the shard lock end-to-end (the pre-off-lock behavior,
+    reproduced by wrapping flush in the shard lock) vs the off-lock
+    snapshot-and-swap flush.  The acceptance story for this PR: writes
+    are no longer blocked for the full flush duration."""
+    import shutil
+    import tempfile
+    import threading
+
+    from opengemini_tpu.record import FieldType
+    from opengemini_tpu.storage.shard import Shard
+
+    NS = 1_000_000_000
+    base = 1_700_000_000 * NS
+    root = tempfile.mkdtemp(prefix="ogtpu-ingestflush-")
+    try:
+        def run(locked: bool) -> dict:
+            path = os.path.join(root, "locked" if locked else "offlock")
+            sh = Shard(path, 0, 2**62)
+            from opengemini_tpu.ingest.native_lp import parse_columnar
+
+            n = 0
+            CH = 100_000
+            while n < rows:
+                m = min(CH, rows - n)
+                lines = "\n".join(
+                    f"cpu,host=h{i % 64} v={float(i % 97)} {base + i * NS}"
+                    for i in range(n, n + m)).encode()
+                batch = parse_columnar(lines, "ns", base)
+                sh.write_columnar(batch, None, lines, "ns", base)
+                n += m
+            lats: list[float] = []
+            stop = threading.Event()
+            started = threading.Event()
+
+            def flusher():
+                started.set()
+                if locked:
+                    with sh._flush_lock, sh._lock:  # the OLD behavior
+                        sh.flush()
+                else:
+                    sh.flush()
+                stop.set()
+
+            ft = threading.Thread(target=flusher)
+            ft.start()
+            started.wait()
+            t0 = time.perf_counter()
+            i = 0
+            while not stop.is_set():
+                t1 = time.perf_counter()
+                sh.write_points_structured([
+                    ("cpu", (("host", "hx"),), base + (rows + i) * NS,
+                     {"v": (FieldType.FLOAT, 1.0)})])
+                lats.append(time.perf_counter() - t1)
+                i += 1
+                # paced client (~1ms think time): an unpaced spin loop
+                # measures GIL starvation of the flush thread, not write
+                # availability
+                time.sleep(0.001)
+            flush_s = time.perf_counter() - t0
+            ft.join()
+            sh.close()
+            lats.sort()
+            if not lats:
+                lats = [flush_s]  # fully blocked: one write, whole flush
+
+            def pct(p):
+                return lats[min(len(lats) - 1, int(p * len(lats)))]
+
+            return {
+                "flush_s": round(flush_s, 3),
+                "writes_during_flush": len(lats),
+                "write_p50_ms": round(pct(0.50) * 1e3, 2),
+                "write_p99_ms": round(pct(0.99) * 1e3, 2),
+                "write_max_ms": round(lats[-1] * 1e3, 2),
+            }
+
+        before = run(locked=True)
+        after = run(locked=False)
+        return {
+            "rows": rows,
+            "locked_flush": before,
+            "offlock_flush": after,
+            "p99_improvement_x": round(
+                before["write_p99_ms"] / max(after["write_p99_ms"], 1e-6), 1),
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -1000,6 +1178,32 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     except Exception as e:  # noqa: BLE001 — bench must still emit
         print(f"bench: scan floor failed: {e}", file=sys.stderr)
 
+    # host flush floor: encoded rows/s serial vs pooled (the write-side
+    # mirror of host_scan_floor; tracked per round from PR 3 on)
+    flush_floor = None
+    try:
+        flush_floor = bench_flush_floor(
+            rows=int(os.environ.get("OGTPU_BENCH_FLUSHFLOOR_ROWS",
+                                    "4000000")))
+        _emit("flush_floor_pooled_rows_per_sec" + suffix,
+              flush_floor["pooled_rows_per_s"], "rows/s",
+              flush_floor["pool_speedup"], {"detail": flush_floor})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: flush floor failed: {e}", file=sys.stderr)
+
+    # write availability during flush: p99 single-point latency, flush
+    # holding the shard lock (pre-PR behavior) vs off-lock flush
+    ingest_flush = None
+    try:
+        ingest_flush = bench_ingest_during_flush(
+            rows=int(os.environ.get("OGTPU_BENCH_INGESTFLUSH_ROWS",
+                                    "2000000")))
+        _emit("ingest_during_flush_write_p99_ms" + suffix,
+              ingest_flush["offlock_flush"]["write_p99_ms"], "ms",
+              ingest_flush["p99_improvement_x"], {"detail": ingest_flush})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: ingest-during-flush failed: {e}", file=sys.stderr)
+
     # decoded-column cache: identical repeated scan, cache off vs on
     # (the PR 2 acceptance metric; >= 2x warm target)
     colcache_warm = None
@@ -1042,6 +1246,10 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     extra = {"configs": configs, "probe": probe, "e2e_ingest_query": e2e}
     if scan_floor:
         extra["host_scan_floor"] = scan_floor
+    if flush_floor:
+        extra["flush_floor"] = flush_floor
+    if ingest_flush:
+        extra["ingest_during_flush"] = ingest_flush
     if colcache_warm:
         extra["colcache_warm"] = colcache_warm
     if note:

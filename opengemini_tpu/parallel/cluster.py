@@ -265,9 +265,14 @@ def serialize_select_meta(engine, db, rp, mst, tmin, tmax,
         for r, c in sh.file_chunks(mst):
             dmin = c.tmin if dmin is None else min(dmin, c.tmin)
             dmax = c.tmax if dmax is None else max(dmax, c.tmax)
-        if sh.mem.min_time is not None:
-            dmin = sh.mem.min_time if dmin is None else min(dmin, sh.mem.min_time)
-            dmax = sh.mem.max_time if dmax is None else max(dmax, sh.mem.max_time)
+        # frozen flush snapshots count as in-memory rows too (lazy
+        # import: qhelpers imports this module at load time)
+        from opengemini_tpu.query.qhelpers import _shard_mem_time_range
+
+        m_lo, m_hi = _shard_mem_time_range(sh)
+        if m_lo is not None:
+            dmin = m_lo if dmin is None else min(dmin, m_lo)
+            dmax = m_hi if dmax is None else max(dmax, m_hi)
     return {"tag_keys": sorted(tag_keys), "schema": schema,
             "dmin": dmin, "dmax": dmax}
 

@@ -59,7 +59,9 @@ def _prune_text_sids(sh, mst, sids, match_terms):
     lookup = getattr(sh, "text_match_sids", None)
     if lookup is None:
         return sids
-    mem_sids = sh.mem.sids_for(mst)
+    # frozen flush snapshots are unindexed like the live memtable: their
+    # series must survive pruning too (shard.mem_sids_for spans both)
+    mem_sids = sh.mem_sids_for(mst)
     for fld, tok in match_terms:
         got = lookup(mst, fld, tok)
         if got is None:
@@ -69,6 +71,24 @@ def _prune_text_sids(sh, mst, sids, match_terms):
             break
     return sids
 
+
+
+def _shard_mem_overlaps(sh, sid, tmin, tmax) -> bool:
+    """Per-series in-memory overlap probe: real shards check frozen
+    flush snapshots + live memtable part-by-part (no merge, no lock —
+    this runs once per series on the pre-agg/sketch fast paths);
+    remote/meta proxies keep their plain `mem.record_for` stand-in."""
+    f = getattr(sh, "mem_overlaps_range", None)
+    if f is not None:
+        return f(sid, tmin, tmax)
+    rec = sh.mem.record_for(sid)
+    return rec is not None and len(rec.slice_time(tmin, tmax)) > 0
+
+
+def _shard_mem_time_range(sh):
+    """(min, max) of in-memory rows incl. frozen flush snapshots."""
+    f = getattr(sh, "mem_time_range", None)
+    return f() if f is not None else (sh.mem.min_time, sh.mem.max_time)
 
 
 def _series_needs_merged_decode(sh, mst, sid, tmin, tmax):
@@ -81,8 +101,7 @@ def _series_needs_merged_decode(sh, mst, sid, tmin, tmax):
         # read_series view (returning (False, []) here would silently
         # DROP the remote data from the fast paths)
         return True, None
-    mem_rec = sh.mem.record_for(sid)
-    if mem_rec is not None and len(mem_rec.slice_time(tmin, tmax)):
+    if _shard_mem_overlaps(sh, sid, tmin, tmax):
         return True, None
     srcs = sh.file_chunks(mst, {sid}, tmin, tmax)
     if any(c.packed for _r, c in srcs):
@@ -961,9 +980,10 @@ def _data_time_range(shards, mst):
         for r, c in sh.file_chunks(mst):
             dmin = c.tmin if dmin is None else min(dmin, c.tmin)
             dmax = c.tmax if dmax is None else max(dmax, c.tmax)
-        if sh.mem.min_time is not None:
-            dmin = sh.mem.min_time if dmin is None else min(dmin, sh.mem.min_time)
-            dmax = sh.mem.max_time if dmax is None else max(dmax, sh.mem.max_time)
+        m_lo, m_hi = _shard_mem_time_range(sh)
+        if m_lo is not None:
+            dmin = m_lo if dmin is None else min(dmin, m_lo)
+            dmax = m_hi if dmax is None else max(dmax, m_hi)
     return dmin, dmax
 
 

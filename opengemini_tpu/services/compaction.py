@@ -11,7 +11,10 @@ storage/shard.py, so manual compact() calls are covered identically)."""
 
 from __future__ import annotations
 
+import time
+
 from opengemini_tpu.services.base import Service, logger
+from opengemini_tpu.utils.stats import GLOBAL as _STATS
 
 
 class CompactionService(Service):
@@ -25,6 +28,7 @@ class CompactionService(Service):
     def handle(self) -> int:
         n = 0
         fanout = max(2, self.max_files)
+        t0 = time.perf_counter_ns()
         for shard in self.engine.all_shards():
             try:
                 # leveled: drain every mergeable run this tick (sustained
@@ -32,6 +36,7 @@ class CompactionService(Service):
                 # merge O(run) not O(shard)
                 while shard.compact_level(fanout=fanout):
                     n += 1
+                    _STATS.incr("compaction", "leveled_merges")
                 # out-of-order: late-arriving data leaves time-overlapping
                 # files that leveled runs may never pick up; merge them
                 # away so read-side merge amplification stays bounded
@@ -39,11 +44,18 @@ class CompactionService(Service):
                 while (shard.has_time_overlap()
                        and shard.compact_out_of_order(max_files=fanout)):
                     n += 1
+                    _STATS.incr("compaction", "out_of_order_merges")
                 # mixed levels can still let the count run away: full
                 # merge as the independent backstop
                 if shard.file_count() > 8 * fanout:
                     if shard.compact(max_files=fanout):
                         n += 1
+                        _STATS.incr("compaction", "full_merges")
             except Exception:  # noqa: BLE001
                 logger.exception("compaction of %s failed", shard.path)
+        if n:
+            # merge wall time per tick; together with the tsfwrite
+            # compact_encode_ns / compact_write_ns split (/debug/vars)
+            # this shows where compaction ticks actually spend their time
+            _STATS.incr("compaction", "tick_ns", time.perf_counter_ns() - t0)
         return n

@@ -52,7 +52,9 @@ class HierarchicalService(Service):
         rel = os.path.relpath(shard.path, self.engine.root)
         cold_path = os.path.abspath(os.path.join(self.cold_dir, rel))
         os.makedirs(os.path.dirname(cold_path), exist_ok=True)
-        with shard._lock:
+        # _flush_lock before _lock (shard lock-order rule; the flush
+        # below re-enters the flush lock)
+        with shard._flush_lock, shard._lock:
             shard.flush()
             # close WRITE handles only (writers are locked out by _lock);
             # reader objects stay open for lockless in-flight queries

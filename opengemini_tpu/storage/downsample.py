@@ -73,6 +73,11 @@ def downsample_records(
     Output rows carry the window START time (influx GROUP BY time
     convention); empty windows produce no rows.
     """
+    import time as _time
+
+    from opengemini_tpu.utils.stats import GLOBAL as _STATS
+
+    t_start = _time.perf_counter_ns()
     field_aggs = field_aggs or {}
     aligned = int(winmod.window_start(tmin, every_ns))
     W = winmod.num_windows(aligned, tmax, every_ns)
@@ -179,4 +184,11 @@ def downsample_records(
                 vals = vals.astype(np.float64)
             cols[name] = Column(out_type, vals, valid)
         out_records[sid] = Record(times, cols)
+    # aggregate compute time, distinct from the downsample_encode_ns /
+    # downsample_write_ns split the TSF writer records (/debug/vars):
+    # together they attribute a slow rewrite to compute vs encode vs IO
+    _STATS.incr("downsample", "compute_ns",
+                _time.perf_counter_ns() - t_start)
+    _STATS.incr("downsample", "rows_out",
+                sum(len(r) for r in out_records.values()))
     return out_records, out_schema

@@ -559,7 +559,10 @@ class Engine:
             shard = self._shards.get(key)
             if shard is None:
                 return False
-            with shard._lock:
+            # _flush_lock before _lock (shard lock-order rule): the flush
+            # below re-enters it, and a concurrent off-lock flush must
+            # not publish into a shard whose handles are being retired
+            with shard._flush_lock, shard._lock:
                 shard.flush()
                 prefix = shard_prefix(db, rp, group_start)
                 # follow a cold-tier symlink: files live at the target;
@@ -906,9 +909,13 @@ class Engine:
             if len(batch) == 0:
                 return 0
             STATS.incr("write", "points", len(batch))
+            tickets: list = []
+            touched: list = []
             with self._lock:
                 n = self._write_columnar_locked(
-                    db, rp, batch, raw, precision, now_ns)
+                    db, rp, batch, raw, precision, now_ns, tickets, touched)
+            self._commit_wal_tickets(tickets)
+            self._flush_over_threshold(touched)
             if self._write_observers:
                 self._notify_write(db, rp, batch.to_points())
             return n
@@ -918,6 +925,7 @@ class Engine:
         if not points:
             return 0
         STATS.incr("write", "points", len(points))
+        tickets: list = []
         with self._lock:
             # group points by target shard (time routing)
             by_shard: dict[int, list] = {}
@@ -929,9 +937,12 @@ class Engine:
                 by_shard.setdefault(key, []).append(p)
             n = 0
             for key, pts in by_shard.items():
-                n += shards[key].write_points(pts, raw, precision, now_ns)
-                if shards[key].mem.approx_bytes > self.flush_threshold_bytes:
-                    shards[key].flush()
+                got, t = shards[key].write_points(
+                    pts, raw, precision, now_ns, defer_commit=True)
+                n += got
+                tickets.append((shards[key], t))
+        self._commit_wal_tickets(tickets)  # fsyncs coalesce off-lock
+        self._flush_over_threshold(shards.values())
         self._notify_write(db, rp, points)
         return n
 
@@ -1004,13 +1015,19 @@ class Engine:
                 for shard, rows in route:
                     shard._check_columnar_types(batch, rows)
                 routed.append((seg, batch, route))
+            tickets: list = []
+            touched: list = []
             for seg, batch, route in routed:
                 STATS.incr("write", "points", len(batch))
                 for shard, rows in route:
-                    total += shard.write_columnar(
-                        batch, rows, seg, precision, now_ns)
-                    if shard.mem.approx_bytes > self.flush_threshold_bytes:
-                        shard.flush()
+                    got, t = shard.write_columnar(
+                        batch, rows, seg, precision, now_ns,
+                        defer_commit=True)
+                    total += got
+                    tickets.append((shard, t))
+                    touched.append(shard)
+        self._commit_wal_tickets(tickets)  # fsyncs coalesce off-lock
+        self._flush_over_threshold(touched)
         if self._write_observers and total:
             # observers see the body ONCE, post-commit, like write_lines
             pts: list = []
@@ -1048,16 +1065,55 @@ class Engine:
             rows = None if len(uniq) == 1 else np.flatnonzero(groups == g)
             yield shard, rows
 
+    @staticmethod
+    def _commit_wal_tickets(tickets) -> None:
+        """Finish deferred sync-WAL commits AFTER the engine lock drops:
+        concurrent request threads pile onto the WAL's group commit and
+        share fsyncs instead of serializing them under the engine lock
+        (no-ops instantly when sync is off or a flush already made the
+        entries durable)."""
+        for shard, ticket in tickets:
+            shard.wal.commit(ticket)
+
+    def _flush_over_threshold(self, shards) -> None:
+        """Threshold flushes AFTER the engine lock drops: the off-lock
+        flush (snapshot-and-swap, storage/shard.py) would otherwise run
+        its whole encode+write+fsync while holding the engine lock and
+        stall every other writer for the flush duration.  flush_if_over
+        re-checks the size under the shard's flush lock (and skips when
+        a flush is already in flight), so concurrent writers that all
+        saw the same over-threshold memtable trigger ONE flush.  A shard
+        dropped/offloaded between the lock release and here fails its
+        flush benignly (drop discarded the data on purpose) — re-raise
+        only if the shard is still registered."""
+        seen = set()
+        for shard in shards:
+            if id(shard) in seen:
+                continue
+            seen.add(id(shard))
+            try:
+                shard.flush_if_over(self.flush_threshold_bytes)
+            except Exception:
+                with self._lock:
+                    alive = any(s is shard for s in self._shards.values())
+                if alive:
+                    raise
+
     def _write_columnar_locked(self, db: str, rp: str, batch,
-                               raw: bytes, precision: str, now_ns: int) -> int:
+                               raw: bytes, precision: str, now_ns: int,
+                               tickets: list, touched: list) -> int:
         """Route a ColumnarBatch to its time shards (vectorized: one
         floor-divide over all timestamps) and slab-write each. Caller
-        holds the engine lock."""
+        holds the engine lock; deferred WAL commits append to `tickets`
+        and written shards to `touched` for the caller to finish
+        (commit + threshold flush) off-lock."""
         n = 0
         for shard, rows in self._route_columnar_locked(db, rp, batch):
-            n += shard.write_columnar(batch, rows, raw, precision, now_ns)
-            if shard.mem.approx_bytes > self.flush_threshold_bytes:
-                shard.flush()
+            got, t = shard.write_columnar(
+                batch, rows, raw, precision, now_ns, defer_commit=True)
+            n += got
+            tickets.append((shard, t))
+            touched.append(shard)
         return n
 
     # -- continuous queries / downsample ----------------------------------
@@ -1217,6 +1273,7 @@ class Engine:
         if d.dropped_msts:
             self.purge_dropped_measurements(db)
         rp = rp or d.default_rp
+        tickets: list = []
         with self._lock:
             by_shard: dict[int, list] = {}
             shards: dict[int, Shard] = {}
@@ -1227,9 +1284,12 @@ class Engine:
                 by_shard.setdefault(key, []).append(p)
             n = 0
             for key, pts in by_shard.items():
-                n += shards[key].write_points_structured(pts)
-                if shards[key].mem.approx_bytes > self.flush_threshold_bytes:
-                    shards[key].flush()
+                got, t = shards[key].write_points_structured(
+                    pts, defer_commit=True)
+                n += got
+                tickets.append((shards[key], t))
+        self._commit_wal_tickets(tickets)  # fsyncs coalesce off-lock
+        self._flush_over_threshold(shards.values())
         self._notify_write(db, rp, points)
         return n
 

@@ -77,10 +77,25 @@ class MemTable:
         self.approx_bytes = 0
         self.min_time: int | None = None
         self.max_time: int | None = None
+        # frozen = an immutable flush snapshot (shard.flush swapped a
+        # fresh memtable in and encodes this one OFF the shard lock):
+        # reads may come from several threads, writes must never land
+        self.frozen = False
+
+    def freeze(self) -> None:
+        """Mark immutable (flush snapshot). Any later write is a bug in
+        the caller's locking — fail loudly instead of corrupting the
+        snapshot a concurrent flush is encoding."""
+        self.frozen = True
+
+    def _check_mutable(self) -> None:
+        if self.frozen:
+            raise RuntimeError("write to a frozen memtable (flush snapshot)")
 
     # -- row path -----------------------------------------------------------
 
     def write_row(self, sid: int, measurement: str, t: int, fields: dict) -> None:
+        self._check_mutable()
         schema = self.schemas.setdefault(measurement, {})
         for name, (ftype, _v) in fields.items():
             have = schema.get(name)
@@ -112,6 +127,7 @@ class MemTable:
         n = len(times)
         if n == 0:
             return
+        self._check_mutable()
         schema = self.schemas.setdefault(measurement, {})
         for name, (ftype, _v, _ok) in cols.items():
             have = schema.get(name)

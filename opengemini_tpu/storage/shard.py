@@ -28,6 +28,7 @@ from opengemini_tpu.storage.tsf import (
 from opengemini_tpu.storage.wal import WAL
 from opengemini_tpu.utils.failpoint import inject as _fp
 from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
+from opengemini_tpu.utils.stats import GLOBAL as _STATS
 
 
 def _pack_entries(buffer: list) -> tuple[np.ndarray, Record]:
@@ -178,6 +179,28 @@ class Shard:
         self.schemas: dict[str, dict] = {}
         self.mem = MemTable(self.schemas)
         self._lock = threading.RLock()
+        # flush/rewrite serialization. Lock ORDER: _flush_lock before
+        # _lock, always — flush holds _flush_lock across its off-lock
+        # encode while taking _lock only to freeze and to publish;
+        # anything that both holds _lock and (transitively) flushes
+        # (delete/downsample rewrites, tier offload) must take
+        # _flush_lock first or it deadlocks against an in-flight flush.
+        self._flush_lock = threading.RLock()
+        # snapshot-and-swap flush state: memtables frozen under the lock,
+        # encoded + written OFF it. Each entry is (frozen memtable,
+        # rotated WAL segment path | None); readers merge frozen
+        # snapshots between the files and the live memtable until the
+        # TSF is published (engine/shard.go Snapshot/commitSnapshot).
+        # An immutable TUPLE replaced on every change, so hot per-series
+        # probes (_mem_parts) can snapshot it with one attribute read —
+        # no lock acquisition per series.
+        self._frozen: tuple[tuple[MemTable, str | None], ...] = ()
+        self._wal_seg_seq = 1
+        # rotated segments found at open (crash between publish and
+        # segment removal) or left by a failed flush: their rows replay
+        # into the memtable / stay in files, so the next successful
+        # flush removes them
+        self._stale_wal_segs: list[str] = []
         self._files: list[TSFReader] = []
         self._tidx_cache: dict[str, object] = {}  # tsf path -> parsed | None
         self._next_file_seq = 1
@@ -245,9 +268,23 @@ class Shard:
             self._next_file_seq = max(self._next_file_seq, seq + 1)
 
     def _replay_wal(self) -> None:
+        wal_path = os.path.join(self.path, "wal.log")
+        # rotated segments first (oldest → newest), then the live log:
+        # the append order every last-write-wins rank derives from. A
+        # segment present at open means a crash hit the window between
+        # WAL rotation and segment removal — its rows either replay fresh
+        # (TSF never published) or dedup against the published file.
+        for seg in WAL.segments(wal_path):
+            self._stale_wal_segs.append(seg)
+            seq = seg.rsplit(".", 1)[-1]
+            if seq.isdigit():
+                self._wal_seg_seq = max(self._wal_seg_seq, int(seq) + 1)
+            self._replay_one(seg)
+        self._replay_one(wal_path)
+
+    def _replay_one(self, wal_path: str) -> None:
         from opengemini_tpu.ingest import native_lp
 
-        wal_path = os.path.join(self.path, "wal.log")
         for entry in WAL.replay(wal_path):
             if entry[0] == "lines":
                 _, lines, precision, now_ns = entry
@@ -281,34 +318,64 @@ class Shard:
 
     # -- write path ---------------------------------------------------------
 
-    def write_points(self, points: list, raw_lines: bytes, precision: str, now_ns: int) -> int:
+    def write_points(self, points: list, raw_lines: bytes, precision: str,
+                     now_ns: int, defer_commit: bool = False):
         """Apply pre-parsed points in this shard's range; `raw_lines` is the
         original batch logged for replay (replay re-filters by time range).
         Returns rows written. Raises FieldTypeConflict BEFORE touching the
-        WAL — a rejected batch must not poison replay."""
+        WAL — a rejected batch must not poison replay.
+
+        The sync-WAL durability wait happens OUTSIDE the shard lock, so
+        concurrent writers coalesce into one fsync (WAL group commit)
+        instead of serializing an fsync each under the lock.  With
+        `defer_commit=True` returns (rows, ticket) and the CALLER owns
+        the `wal.commit(ticket)` — the engine lifts the wait out of its
+        own lock too, so fsyncs coalesce across server threads.
+
+        Sync-failure semantics (group commit): rows apply to the
+        memtable BEFORE the fsync barrier, so a write erroring at
+        commit() is already readable and will become durable with the
+        next successful sync/flush.  The old inline path had the mirror
+        inconsistency (the frame was written pre-fsync, so error-acked
+        rows resurfaced via replay after restart); either way an
+        errored ack means durability UNKNOWN, not rejected."""
         with self._lock:
             self._check_types(points)
-            self.wal.append_lines(raw_lines, precision, now_ns)
-            return self._apply(points)
+            ticket = self.wal.append_lines(raw_lines, precision, now_ns)
+            n = self._apply(points)
+        if defer_commit:
+            return n, ticket
+        self.wal.commit(ticket)
+        return n
 
-    def write_points_structured(self, points: list) -> int:
+    def write_points_structured(self, points: list,
+                                defer_commit: bool = False):
         """Same as write_points but WAL-logged as structured points (kind 2)
         — the SELECT INTO / internal write path, no line-protocol text."""
         with self._lock:
             self._check_types(points)
-            self.wal.append_points(points)
-            return self._apply(points)
+            ticket = self.wal.append_points(points)
+            n = self._apply(points)
+        if defer_commit:
+            return n, ticket
+        self.wal.commit(ticket)
+        return n
 
     def write_columnar(self, batch, rows: np.ndarray | None,
-                       raw_lines: bytes, precision: str, now_ns: int) -> int:
+                       raw_lines: bytes, precision: str, now_ns: int,
+                       defer_commit: bool = False):
         """Apply a native-parsed ColumnarBatch (ingest/native_lp.py). `rows`
         selects this shard's row indices (None = all rows). WAL-logs the
         ORIGINAL batch text (replay re-filters by time range, exactly like
         write_points). Type conflicts raise BEFORE the WAL append."""
         with self._lock:
             self._check_columnar_types(batch, rows)
-            self.wal.append_lines(raw_lines, precision, now_ns)
-            return self._apply_columnar(batch, rows=rows)
+            ticket = self.wal.append_lines(raw_lines, precision, now_ns)
+            n = self._apply_columnar(batch, rows=rows)
+        if defer_commit:
+            return n, ticket
+        self.wal.commit(ticket)  # see write_points: group-commit wait
+        return n
 
     def _check_columnar_types(self, batch, rows) -> None:
         pending: dict[tuple[int, str], object] = {}
@@ -408,41 +475,129 @@ class Shard:
         return n
 
     def flush(self) -> None:
-        """Memtable -> new TSF file, then truncate WAL. Crash-safe ordering:
-        the file is fsynced and atomically renamed before the WAL truncate
-        (reference commitSnapshot, engine/shard.go:1008).
+        """Memtable -> new TSF file, then drop the covering WAL segment.
+
+        Snapshot-and-swap (reference Snapshot/commitSnapshot,
+        engine/shard.go:731/:1008): under the shard lock the memtable is
+        FROZEN, the WAL rotates to a fresh segment, and a new memtable
+        installs — microseconds.  Encoding (pipelined through the encode
+        pool) and file writing then run OFF the shard lock, so concurrent
+        ingest and reads proceed for the whole encode+write+fsync;
+        readers merge the frozen snapshot between the files and the live
+        memtable until the new TSF publishes.  Crash-safe ordering is
+        unchanged: the file is fsynced and atomically renamed BEFORE the
+        rotated segment (and only it) is removed; a crash anywhere
+        replays the surviving segments over whatever published, and
+        last-write-wins dedup makes the overlap idempotent.  A failed
+        flush keeps its frozen snapshot queued (readable, recoverable);
+        the next flush drains it first, oldest first.
 
         Measurement chunks emit in sorted-name order (since r3): TSF file
         layout can differ from files written by older versions for
         multi-measurement shards. Replica comparison is CONTENT-based
         (content_digest hashes logical rows, not file bytes), so
         mixed-version replicas still agree."""
+        with self._flush_lock:
+            with self._lock:
+                if len(self.mem) == 0 and not self._frozen:
+                    return
+                self.index.flush()
+                if len(self.mem):
+                    seg = os.path.join(
+                        self.path, f"wal.log.{self._wal_seg_seq:06d}")
+                    self._wal_seg_seq += 1
+                    seg = self.wal.rotate(seg)
+                    self.mem.freeze()
+                    self._frozen = self._frozen + ((self.mem, seg),)
+                    self.mem = MemTable(self.schemas)
+            # off the shard lock: encode + write + fsync + publish, one
+            # file per frozen snapshot, oldest first (file append order =
+            # write order keeps last-write-wins ranking exact)
+            while True:
+                with self._lock:
+                    if not self._frozen:
+                        return
+                    frozen, seg = self._frozen[0]
+                    path = os.path.join(
+                        self.path, f"{self._next_file_seq:08d}.tsf")
+                    self._next_file_seq += 1
+                self._flush_frozen(frozen, seg, path)
+
+    def flush_if_over(self, threshold_bytes: int) -> bool:
+        """Threshold-path flush: N concurrent writers that all saw the
+        same over-threshold memtable must trigger ONE flush, not N
+        cascading rotations of a few trickle rows each.  Non-blocking: a
+        flush already in flight covers this crossing (rows written after
+        its freeze accumulate toward the next one), so the caller —
+        usually a request thread — never queues behind a full
+        encode+fsync just to re-check and no-op."""
+        if not self._flush_lock.acquire(blocking=False):
+            return False
+        try:
+            if self.mem.approx_bytes <= threshold_bytes and not self._frozen:
+                return False
+            self.flush()
+            return True
+        finally:
+            self._flush_lock.release()
+
+    def _flush_frozen(self, frozen: MemTable, seg: str | None,
+                      path: str) -> None:
+        """Encode+write one frozen memtable into `path`, publish it, then
+        remove the WAL segment(s) its rows came from.  Caller holds
+        _flush_lock but NOT _lock (except re-entrantly, when a rewrite
+        op flushes inline)."""
+        import time as _time
+
+        t0 = _time.perf_counter_ns()
+        w = TSFWriter(path, kind="flush")
+        tidx = _TextSidecar()
+        try:
+            for mst, sid_arr, rec in frozen.measurement_tables():
+                uniq, starts = np.unique(sid_arr, return_index=True)
+                ends = np.append(starts[1:], len(sid_arr))
+                _write_measurement_chunks(
+                    w, tidx, mst,
+                    _sid_entries(rec, uniq, starts, ends),
+                    n_series=len(uniq))
+            _fp("shard-flush-before-publish")  # reference: engine/shard.go:457
+            w.finish()
+        except BaseException:
+            w.abort()
+            raise
         with self._lock:
-            if len(self.mem) == 0:
-                return
-            self.index.flush()
-            path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
-            w = TSFWriter(path)
-            tidx = _TextSidecar()
+            reader = self._adopt(TSFReader(path))
+            self._files.append(reader)
+            # publish + un-freeze atomically: a reader snapshots either
+            # (old files + frozen) or (files + new TSF) — never neither
+            self._frozen = self._frozen[1:]
+            if seg is not None:
+                self._stale_wal_segs.append(seg)
+        # sidecar AFTER adoption: w.finish() already made the TSF
+        # visible on disk, so a sidecar failure here must not leave the
+        # snapshot queued (a retry would write the same rows into a
+        # SECOND file next to the adopted-on-reopen orphan). The brief
+        # no-sidecar window only disables text pruning — reads stay
+        # exact. Written under the lock, and only while OUR reader still
+        # owns the path: an in-place compaction that already replaced
+        # this file wrote the MERGED sidecar, which a stale write here
+        # must not clobber (silent text-prune under-reporting).
+        with self._lock:
+            if any(r is reader for r in self._files):
+                tidx.write(path)
+                self._tidx_cache.pop(path, None)
+        _STATS.incr("flush", "flushes")
+        _STATS.incr("flush", "rows", frozen.row_count)
+        _STATS.incr("flush", "total_ns", _time.perf_counter_ns() - t0)
+        _fp("shard-flush-before-wal-truncate")
+        # rows are durable in the published file: the rotated segment —
+        # and any stale ones from crashes/failed flushes — can go
+        stale, self._stale_wal_segs = self._stale_wal_segs, []
+        for p in stale:
             try:
-                for mst, sid_arr, rec in self.mem.measurement_tables():
-                    uniq, starts = np.unique(sid_arr, return_index=True)
-                    ends = np.append(starts[1:], len(sid_arr))
-                    _write_measurement_chunks(
-                        w, tidx, mst,
-                        _sid_entries(rec, uniq, starts, ends),
-                        n_series=len(uniq))
-                _fp("shard-flush-before-publish")  # reference: engine/shard.go:457
-                w.finish()
-            except BaseException:
-                w.abort()
-                raise
-            tidx.write(path)
-            self._next_file_seq += 1
-            self._files.append(self._adopt(TSFReader(path)))
-            self.mem = MemTable(self.schemas)
-            _fp("shard-flush-before-wal-truncate")
-            self.wal.truncate()
+                os.remove(p)
+            except OSError:
+                pass
 
     @staticmethod
     def _merge_readers(readers, w: "TSFWriter", tidx: "_TextSidecar") -> None:
@@ -521,11 +676,18 @@ class Shard:
         reference engine/immutable/compact.go LevelCompact:120). Rewrites
         all chunks per series merged+deduped into one file. Returns whether
         a merge happened."""
-        with self._lock:
+        # _flush_lock first (lock-order rule): a full merge allocates a
+        # NEW file sequence number, and racing an in-flight off-lock
+        # flush (which reserved a LOWER seq before its encode) would
+        # publish the merged old data under a HIGHER seq — in-memory
+        # order stays right, but _load_files sorts by name on reopen and
+        # would rank the stale merge newer than the flush. Serializing
+        # with the flush keeps seq order == publish order.
+        with self._flush_lock, self._lock:
             if len(self._files) <= max_files:
                 return False
             path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
-            w = TSFWriter(path)
+            w = TSFWriter(path, kind="compact")
             tidx = _TextSidecar()
             try:
                 self._merge_readers(self._files, w, tidx)
@@ -564,7 +726,10 @@ class Shard:
         across remaining files stays correct). O(run) per call instead of
         the full-merge's O(shard) — bounded write amplification."""
         fanout = max(2, fanout)  # fanout=1 would rewrite a file in place
-        with self._lock:
+        # _flush_lock first: in-place run merges allocate no new seq,
+        # but serializing with the off-lock flush keeps every file-set
+        # rewrite disjoint from a publish (see compact())
+        with self._flush_lock, self._lock:
             if len(self._files) < fanout:
                 return False
             levels = [self._file_level(r.path) for r in self._files]
@@ -593,7 +758,7 @@ class Shard:
         run = self._files[i0 : i0 + n]
         target = run[0].path
         tmp = target + ".merge"
-        w = TSFWriter(tmp)
+        w = TSFWriter(tmp, kind="compact")
         tidx = _TextSidecar()
         try:
             self._merge_readers(run, w, tidx)
@@ -639,7 +804,8 @@ class Shard:
         first overlapping file toward its overlap partner, capped at
         `max_files` per call; repeated calls converge to disjoint
         ranges."""
-        with self._lock:
+        # _flush_lock first: see compact()
+        with self._flush_lock, self._lock:
             if len(self._files) < 2:
                 return False
             ranges = [(r.tmin, r.tmax) for r in self._files]
@@ -672,10 +838,14 @@ class Shard:
         end (write-new-then-swap, reference compaction_file_info.go)."""
         from opengemini_tpu.storage.downsample import downsample_records
 
-        with self._lock:
+        # _flush_lock FIRST (see __init__ lock-order note): the inline
+        # flush below re-enters it, and holding it for the whole rewrite
+        # keeps a concurrent off-lock flush from publishing a pre-rewrite
+        # snapshot AFTER the file-set swap resurrects dropped rows
+        with self._flush_lock, self._lock:
             self.flush()
             path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
-            w = TSFWriter(path)
+            w = TSFWriter(path, kind="downsample")
             rows = 0
             # schema changes are staged and applied only after the new file
             # is durable — a mid-rewrite failure must not leave in-memory
@@ -722,7 +892,8 @@ class Shard:
         reference's drop/delete paths also rewrite/tombstone immutable data
         (engine DropMeasurement / DeleteSeries). Flushes first so the
         memtable participates."""
-        with self._lock:
+        # _flush_lock first: see rewrite_downsampled
+        with self._flush_lock, self._lock:
             self.flush()
             if measurement not in self.measurements():
                 return
@@ -734,7 +905,7 @@ class Shard:
             hi = tmax if tmax is not None else 2**62
             full_series_delete = tmin is None and tmax is None
             path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
-            w = TSFWriter(path)
+            w = TSFWriter(path, kind="delete")
             wrote = False
             try:
                 for mst in self.measurements():
@@ -777,6 +948,58 @@ class Shard:
 
     # -- read path ----------------------------------------------------------
 
+    def _scan_state(self) -> tuple[list, list]:
+        """(files, memtables oldest → newest, live last) in ONE lock
+        acquisition: a flush publish swaps (append file, pop frozen)
+        atomically under the same lock, so a reader sees the rows in the
+        frozen snapshot or in the new file — never in neither."""
+        with self._lock:
+            mems = [m for m, _seg in self._frozen]
+            mems.append(self.mem)
+            return list(self._files), mems
+
+    def _mem_parts(self) -> list:
+        """Memtable snapshots a read must merge, oldest → newest (frozen
+        flush snapshots first, live memtable last).  LOCK-FREE: _frozen
+        is an immutable tuple replaced on change, so per-series hot
+        paths pay one attribute read, not a lock acquisition."""
+        return [m for m, _seg in self._frozen] + [self.mem]
+
+    def mem_overlaps_range(self, sid: int, tmin: int, tmax: int) -> bool:
+        """Does ANY in-memory part (frozen snapshots or live memtable)
+        hold rows of `sid` in [tmin, tmax]?  Probes each part separately
+        — no merge, no lock — for the per-series fast-path checks."""
+        for m in self._mem_parts():
+            rec = m.record_for(sid)
+            if rec is not None and len(rec.slice_time(tmin, tmax)):
+                return True
+        return False
+
+    def mem_record_for(self, sid: int):
+        """Merged in-memory rows of one series across frozen flush
+        snapshots + the live memtable (newest last, last-write-wins) —
+        what `self.mem.record_for` meant before off-lock flush."""
+        recs = [r for r in (m.record_for(sid) for m in self._mem_parts())
+                if r is not None]
+        if not recs:
+            return None
+        return recs[0] if len(recs) == 1 else merge_sorted_records(recs)
+
+    def mem_sids_for(self, measurement: str) -> set[int]:
+        out: set[int] = set()
+        for m in self._mem_parts():
+            out |= m.sids_for(measurement)
+        return out
+
+    def mem_time_range(self) -> tuple[int | None, int | None]:
+        """(min, max) ns across frozen + live memtables (None = no rows)."""
+        tmin = tmax = None
+        for m in self._mem_parts():
+            if m.min_time is not None:
+                tmin = m.min_time if tmin is None else min(tmin, m.min_time)
+                tmax = m.max_time if tmax is None else max(tmax, m.max_time)
+        return tmin, tmax
+
     def measurements(self) -> list[str]:
         msts = set(self.index.measurements())
         for r in self._files:
@@ -803,15 +1026,15 @@ class Shard:
         needs the order of magnitude."""
         rows = 0
         chunks = 0
-        with self._lock:
-            files = list(self._files)
+        files, mems = self._scan_state()
         for r in files:
             for c in r.chunks(measurement, None, tmin, tmax):
                 rows += c.rows
                 chunks += 1
-        # memtable rows count whole (order-of-magnitude estimate; the
-        # memtable has no per-measurement row bookkeeping)
-        return rows + len(self.mem), chunks
+        # memtable rows (frozen flush snapshots included) count whole
+        # (order-of-magnitude estimate; the memtable has no
+        # per-measurement row bookkeeping)
+        return rows + sum(len(m) for m in mems), chunks
 
     def text_match_sids(self, mst: str, field: str, token: str):
         """Series whose PERSISTED rows may contain `token` in `field`
@@ -875,7 +1098,9 @@ class Shard:
         old serial loop did (reference:
         ts-store/transport/query/manager.go:130 IsKilled checked inside
         cursor loops)."""
-        chunks = self.file_chunks(measurement, {sid}, tmin, tmax)
+        files, mems = self._scan_state()
+        chunks = [(r, c) for r in files
+                  for c in r.chunks(measurement, {sid}, tmin, tmax)]
         n_fields = len(fields) if fields is not None else None
 
         def decode(r, c):
@@ -905,8 +1130,12 @@ class Shard:
                 miss_at.append(i)
         for i, out in zip(miss_at, scanpool.map_ordered(jobs, ests)):
             recs[i] = out
-        mem_rec = self.mem.record_for(sid)
-        if mem_rec is not None:
+        # frozen flush snapshots (oldest first) then the live memtable:
+        # both are newer than every file, live is newest of all
+        for m in mems:
+            mem_rec = m.record_for(sid)
+            if mem_rec is None:
+                continue
             if fields is not None:
                 mem_rec = Record(
                     mem_rec.times,
@@ -942,8 +1171,7 @@ class Shard:
         # rows win
         parts: list[tuple[np.ndarray, Record]] = []
         sid_set = set(int(s) for s in sids)
-        with self._lock:
-            files = list(self._files)
+        files, mems = self._scan_state()
         n_fields = len(fields) if fields is not None else None
 
         def decode_packed(r, c):
@@ -993,13 +1221,15 @@ class Shard:
         for i, part in zip(miss_at, scanpool.map_ordered(jobs, ests)):
             slots[i] = part
         parts.extend(p for p in slots if p is not None)
-        for sid_arr, mem_rec in self.mem.bulk_parts(measurement, sids):
-            if fields is not None:
-                mem_rec = Record(
-                    mem_rec.times,
-                    {k: v for k, v in mem_rec.columns.items() if k in fields},
-                )
-            parts.append((sid_arr, mem_rec))
+        for m in mems:  # frozen snapshots oldest first, live memtable last
+            for sid_arr, mem_rec in m.bulk_parts(measurement, sids):
+                if fields is not None:
+                    mem_rec = Record(
+                        mem_rec.times,
+                        {k: v for k, v in mem_rec.columns.items()
+                         if k in fields},
+                    )
+                parts.append((sid_arr, mem_rec))
         return _merge_bulk_parts(parts, lo_t, hi_t)
 
     def content_digest(self) -> dict:
@@ -1018,6 +1248,7 @@ class Shard:
             state = (
                 tuple((r.path, os.path.getsize(r.path)) for r in self._files
                       if os.path.exists(r.path)),
+                tuple(len(m) for m, _seg in self._frozen),
                 len(self.mem),
             )
             cached = getattr(self, "_digest_cache", None)
@@ -1055,10 +1286,12 @@ class Shard:
         return out
 
     def mem_overlaps(self, measurement: str, sid: int) -> bool:
-        return self.mem.record_for(sid) is not None
+        return any(m.record_for(sid) is not None for m in self._mem_parts())
 
     def close(self) -> None:
-        with self._lock:
+        # _flush_lock first: an in-flight off-lock flush finishes (or we
+        # get in line ahead of the next one) before handles close
+        with self._flush_lock, self._lock:
             self.wal.flush()
             self.wal.close()
             self.index.flush()

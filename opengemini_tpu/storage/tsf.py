@@ -23,14 +23,16 @@ import json
 import os
 import struct
 import threading
+import time
 import zlib
 from collections import OrderedDict
 
 import numpy as np
 
 from opengemini_tpu.record import Column, FieldType, Record
-from opengemini_tpu.storage import colcache, encoding
+from opengemini_tpu.storage import colcache, encodepool, encoding
 from opengemini_tpu.utils.bloom import BloomFilter
+from opengemini_tpu.utils.stats import GLOBAL as _STATS
 
 MAGIC = b"OGTSF01\n"
 END_MAGIC = b"OGTSFEND"
@@ -117,15 +119,39 @@ PACK_ROWS = 131072
 SPARSE_K = 1024
 
 
+def _col_nbytes(col: Column) -> int:
+    """Encode-input size estimate of one column (pipeline backpressure)."""
+    values = col.values
+    if getattr(values, "dtype", None) is not None and values.dtype == object:
+        nb = 32 * len(values)
+    else:
+        nb = int(getattr(values, "nbytes", 8 * len(values)))
+    return nb + int(col.valid.nbytes)
+
+
 class TSFWriter:
-    def __init__(self, path: str):
+    """Writes one TSF file.  Chunk encodes pipeline through the encode
+    pool (storage/encodepool.py): add_chunk submits the pure
+    numpy/zlib/gorilla encode of chunk N+1 while chunk N's blocks are
+    written, draining in submission order so offsets — and file bytes —
+    are identical to the serial path (OGT_ENCODE_WORKERS=1 degrades to
+    exactly that path).  `kind` tags the /debug/vars counters
+    ({kind}_encode_ns / {kind}_write_ns / {kind}_bytes under `tsfwrite`)
+    so flush vs compaction vs downsample encode time stays attributable.
+
+    NOT thread-safe: one writer thread owns the file (offsets and meta
+    are assigned at drain time on that thread)."""
+
+    def __init__(self, path: str, kind: str = "write"):
         self.path = path
+        self._kind = kind
         self._tmp = path + ".tmp"
         self._f = open(self._tmp, "wb")
         self._f.write(MAGIC)
         self._off = len(MAGIC)
         # mst -> {"schema": {field: int}, "chunks": [meta json]}
         self._meta: dict = {}
+        self._pipe = encodepool.OrderedEncodePipe(self._write_encoded)
 
     def _write_block(self, buf: bytes) -> tuple[int, int]:
         off = self._off
@@ -133,36 +159,87 @@ class TSFWriter:
         self._off += len(buf)
         return (off, len(buf))
 
-    def add_chunk(self, measurement: str, sid: int, rec: Record) -> None:
-        """rec must be time-sorted ascending and deduped."""
-        if len(rec) == 0:
-            return
-        m = self._meta.setdefault(measurement, {"schema": {}, "chunks": []})
-        time_loc = self._write_block(encoding.encode_ints(rec.times))
-        cols = {}
+    def _check_schema(self, m: dict, rec: Record) -> None:
+        """Synchronous (submit-time) schema merge: a type conflict raises
+        at the add_chunk call that introduced it, exactly like the serial
+        path — never later from inside a drained encode job."""
+        schema = m["schema"]
         for name, col in rec.columns.items():
-            have = m["schema"].get(name)
+            have = schema.get(name)
             if have is None:
-                m["schema"][name] = int(col.ftype)
+                schema[name] = int(col.ftype)
             elif have != int(col.ftype):
                 raise ValueError(
                     f"field type conflict in file for {name!r}: {have} vs {int(col.ftype)}"
                 )
+
+    @staticmethod
+    def _encode_job(measurement: str, sid, sids, rec: Record):
+        """Pure per-chunk encode (runs on a pool worker): every buffer and
+        pre-agg this chunk needs, NO offsets — those are assigned at
+        drain time in submission order."""
+        t0 = time.perf_counter_ns()
+        time_buf = encoding.encode_ints(rec.times)
+        sid_buf = encoding.encode_ints(sids) if sids is not None else None
+        cols = []
+        for name, col in rec.columns.items():
             vbuf, mbuf = encoding.encode_column(col)
+            cols.append((name, vbuf, mbuf, PreAgg.of(col).to_json()))
+        return (measurement, sid, sids, rec, time_buf, sid_buf, cols,
+                time.perf_counter_ns() - t0)
+
+    def _write_encoded(self, item) -> None:
+        """Drain stage (writer thread): assign offsets, write blocks,
+        append the chunk's meta entry."""
+        (measurement, sid, sids, rec, time_buf, sid_buf, cols,
+         encode_ns) = item
+        t0 = time.perf_counter_ns()
+        m = self._meta[measurement]
+        time_loc = self._write_block(time_buf)
+        entry: dict = {
+            "rows": len(rec),
+            "time": time_loc,
+        }
+        if sid_buf is not None:
+            entry["packed"] = 1
+            entry["smin"] = int(sids[0])
+            entry["smax"] = int(sids[-1])
+            entry["sids"] = self._write_block(sid_buf)
+            entry["sparse"] = [
+                [int(sids[i]), i] for i in range(0, len(sids), SPARSE_K)]
+            entry["tmin"] = int(rec.times.min())
+            entry["tmax"] = int(rec.times.max())
+        else:
+            entry["sid"] = sid
+            entry["tmin"] = int(rec.times[0])
+            entry["tmax"] = int(rec.times[-1])
+        out_cols = {}
+        nbytes = len(time_buf) + (len(sid_buf) if sid_buf else 0)
+        for name, vbuf, mbuf, pre in cols:
             vloc = self._write_block(vbuf)
             mloc = self._write_block(mbuf) if mbuf else None
-            pre = PreAgg.of(col)
-            cols[name] = {"v": vloc, "m": mloc, "pre": pre.to_json()}
-        m["chunks"].append(
-            {
-                "sid": sid,
-                "rows": len(rec),
-                "tmin": int(rec.times[0]),
-                "tmax": int(rec.times[-1]),
-                "time": time_loc,
-                "cols": cols,
-            }
-        )
+            nbytes += len(vbuf) + (len(mbuf) if mbuf else 0)
+            out_cols[name] = {"v": vloc, "m": mloc, "pre": pre}
+        entry["cols"] = out_cols
+        m["chunks"].append(entry)
+        _STATS.incr("tsfwrite", f"{self._kind}_encode_ns", encode_ns)
+        _STATS.incr("tsfwrite", f"{self._kind}_write_ns",
+                    time.perf_counter_ns() - t0)
+        _STATS.incr("tsfwrite", f"{self._kind}_bytes", nbytes)
+
+    def add_chunk(self, measurement: str, sid: int, rec: Record) -> None:
+        """rec must be time-sorted ascending and deduped.  The record's
+        arrays must stay unmutated until finish()/abort() — the encode
+        job may run concurrently (flush encodes a FROZEN memtable;
+        compaction/downsample records are freshly built)."""
+        if len(rec) == 0:
+            return
+        m = self._meta.setdefault(measurement, {"schema": {}, "chunks": []})
+        self._check_schema(m, rec)
+        est = int(rec.times.nbytes) + sum(
+            _col_nbytes(c) for c in rec.columns.values())
+        self._pipe.submit(
+            lambda: self._encode_job(measurement, sid, None, rec), est)
 
     def add_packed_chunk(self, measurement: str, sids: np.ndarray,
                          rec: Record) -> None:
@@ -174,41 +251,16 @@ class TSFWriter:
         if len(rec) == 0:
             return
         m = self._meta.setdefault(measurement, {"schema": {}, "chunks": []})
-        time_loc = self._write_block(encoding.encode_ints(rec.times))
-        sid_loc = self._write_block(encoding.encode_ints(sids))
-        sparse = [[int(sids[i]), i] for i in range(0, len(sids), SPARSE_K)]
-        cols = {}
-        for name, col in rec.columns.items():
-            have = m["schema"].get(name)
-            if have is None:
-                m["schema"][name] = int(col.ftype)
-            elif have != int(col.ftype):
-                raise ValueError(
-                    f"field type conflict in file for {name!r}: {have} vs {int(col.ftype)}"
-                )
-            vbuf, mbuf = encoding.encode_column(col)
-            vloc = self._write_block(vbuf)
-            mloc = self._write_block(mbuf) if mbuf else None
-            pre = PreAgg.of(col)
-            cols[name] = {"v": vloc, "m": mloc, "pre": pre.to_json()}
-        m["chunks"].append(
-            {
-                "packed": 1,
-                "smin": int(sids[0]),
-                "smax": int(sids[-1]),
-                "sids": sid_loc,
-                "sparse": sparse,
-                "rows": len(rec),
-                "tmin": int(rec.times.min()),
-                "tmax": int(rec.times.max()),
-                "time": time_loc,
-                "cols": cols,
-            }
-        )
+        self._check_schema(m, rec)
+        est = int(rec.times.nbytes) + int(sids.nbytes) + sum(
+            _col_nbytes(c) for c in rec.columns.values())
+        self._pipe.submit(
+            lambda: self._encode_job(measurement, None, sids, rec), est)
 
     def finish(self) -> None:
         from opengemini_tpu.storage import chunkmeta
 
+        self._pipe.drain()  # every chunk lands before the meta freezes
         # binary chunk meta (format v2, reference chunk_meta_codec.go):
         # decode cost stays flat as chunk counts grow; v1 zlib-JSON files
         # remain readable
@@ -223,6 +275,7 @@ class TSFWriter:
         os.replace(self._tmp, self.path)  # atomic visibility
 
     def abort(self) -> None:
+        self._pipe.abort()
         self._f.close()
         if os.path.exists(self._tmp):
             os.remove(self._tmp)

@@ -16,12 +16,30 @@ kind 3 = raw lines, UNCOMPRESSED: same layout as kind 1 with the lines
          writes on bulk loads — the reference WAL's snappy tradeoff)
 Torn tails (crc/len mismatch at EOF) are truncated on replay, matching the
 reference's tolerant WAL restore (engine/wal.go replay error handling).
+
+Segments: `rotate()` renames the live log aside (flush freezes the
+memtable and rotates in one step, so encoding runs off the shard lock
+while new writes land in a fresh segment); replay walks rotated segments
+oldest-first then the live log.  A rotated segment is removed only after
+the TSF holding its rows is fsynced and published.
+
+Group commit (sync=True): appends return a commit ticket; `commit(t)` —
+called OUTSIDE the shard lock — coalesces concurrent callers into one
+fsync.  The first waiter becomes the leader, optionally sleeps the
+`OGT_WAL_GROUP_COMMIT_US` gather window (0 = no window; followers whose
+entries an fsync already covered still piggyback), flushes, fires the
+`wal-before-sync` failpoint ONCE PER FSYNC (the reference semantics:
+the hook guards the durability barrier, not the append), then fsyncs
+and wakes everyone it covered.  On fsync/failpoint error each waiter
+retries as its own leader, so per-append error semantics are preserved.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
+import time
 
 from opengemini_tpu.utils.failpoint import inject as _fp
 import struct
@@ -43,14 +61,40 @@ _HEADER = struct.Struct("<IIB")
 # zlib-1 — the WAL of a trickle workload stays tiny.
 _PLAIN_THRESHOLD = 1 << 20
 
+# group-commit gather window (microseconds): how long a sync leader waits
+# for followers to pile in before fsyncing.  0 = fsync immediately
+# (concurrent callers whose entries the fsync covered still piggyback).
+GROUP_COMMIT_US = int(os.environ.get("OGT_WAL_GROUP_COMMIT_US", "200"))
+
 
 class WAL:
     def __init__(self, path: str, sync: bool = False):
         self.path = path
         self.sync = sync
         self._f = open(path, "ab")
+        # group-commit state: appended-entry tickets vs the highest ticket
+        # a completed fsync covers. _cond also fences rotate() against an
+        # in-flight leader fsync (close/rotate must never swap the fd
+        # under a leader).
+        self._cond = threading.Condition()
+        self._seq = 0
+        self._synced = 0
+        self._syncing = False
 
-    def append_lines(self, lines: str | bytes, precision: str, now_ns: int) -> None:
+    def _frame(self, kind: int, payload: bytes) -> int:
+        """Write one entry; return its commit ticket (0 when sync is off).
+        Appends are serialized by the owning shard's lock."""
+        crc = zlib.crc32(payload)
+        _STATS.incr("wal", "appends")
+        _STATS.incr("wal", "bytes", _HEADER.size + len(payload))
+        self._f.write(_HEADER.pack(len(payload), crc, kind) + payload)
+        if not self.sync:
+            return 0
+        with self._cond:
+            self._seq += 1
+            return self._seq
+
+    def append_lines(self, lines: str | bytes, precision: str, now_ns: int) -> int:
         if isinstance(lines, str):
             lines = lines.encode("utf-8")
         prec = precision.encode("utf-8")
@@ -59,16 +103,9 @@ class WAL:
         else:
             kind, body = _KIND_RAW_LINES, zlib.compress(lines, 1)
         payload = struct.pack("<BQ", len(prec), now_ns) + prec + body
-        crc = zlib.crc32(payload)
-        _STATS.incr("wal", "appends")
-        _STATS.incr("wal", "bytes", _HEADER.size + len(payload))
-        self._f.write(_HEADER.pack(len(payload), crc, kind) + payload)
-        if self.sync:
-            self._f.flush()
-            _fp("wal-before-sync")  # reference: engine/wal.go:391
-            os.fsync(self._f.fileno())
+        return self._frame(kind, payload)
 
-    def append_points(self, points: list) -> None:
+    def append_points(self, points: list) -> int:
         """points: [(mst, tags tuple, t_ns, {field: (FieldType, value)})]."""
         doc = [
             [mst, [list(t) for t in tags], t_ns,
@@ -76,30 +113,133 @@ class WAL:
             for mst, tags, t_ns, fields in points
         ]
         payload = zlib.compress(json.dumps(doc).encode("utf-8"), 1)
-        crc = zlib.crc32(payload)
-        _STATS.incr("wal", "appends")
-        _STATS.incr("wal", "bytes", _HEADER.size + len(payload))
-        self._f.write(_HEADER.pack(len(payload), crc, _KIND_POINTS) + payload)
-        if self.sync:
+        return self._frame(_KIND_POINTS, payload)
+
+    def commit(self, ticket: int) -> None:
+        """Block until the entry behind `ticket` is fsynced (no-op when
+        sync is off).  Call OUTSIDE the shard lock: that is what lets
+        concurrent writers coalesce into one fsync instead of serializing
+        an fsync each under the lock."""
+        if not self.sync or ticket <= 0:
+            return
+        while True:
+            with self._cond:
+                while True:
+                    if self._synced >= ticket:
+                        return
+                    if ticket > self._seq:
+                        # a ticket this WAL never minted (the shard's WAL
+                        # was swapped by a tier offload/reopen between
+                        # append and commit): the old instance's
+                        # close/flush made it durable — syncing HERE
+                        # could never satisfy it and would livelock
+                        return
+                    if not self._syncing:
+                        self._syncing = True  # become the leader
+                        # only our own entry pending? skip the gather
+                        # sleep — a single-writer workload must not pay
+                        # the window for followers that don't exist
+                        solo = (self._seq == ticket
+                                and self._synced == ticket - 1)
+                        break
+                    self._cond.wait()
+            try:
+                if GROUP_COMMIT_US > 0 and not solo:
+                    time.sleep(GROUP_COMMIT_US / 1e6)  # gather followers
+                with self._cond:
+                    target = self._seq  # everything appended so far
+                self._f.flush()
+                _fp("wal-before-sync")  # reference: engine/wal.go:391
+                os.fsync(self._f.fileno())
+                _STATS.incr("wal", "syncs")
+                with self._cond:
+                    if target - self._synced > 1:
+                        _STATS.incr("wal", "group_commits")
+                        _STATS.incr("wal", "group_coalesced",
+                                    target - self._synced - 1)
+                    self._synced = max(self._synced, target)
+            finally:
+                # on error: wake everyone; each retries as its own leader,
+                # so an armed failpoint hits every un-synced caller (the
+                # per-append fsync error semantics)
+                with self._cond:
+                    self._syncing = False
+                    self._cond.notify_all()
+
+    def rotate(self, seg_path: str) -> str | None:
+        """Freeze the live log: fsync it, rename to `seg_path`, start a
+        fresh empty log.  Returns seg_path, or None when the log held no
+        entries (nothing to protect).  Caller (shard.flush) holds the
+        shard lock, so no append races; an in-flight group-commit leader
+        is waited out before the fd swap, and everything rotated is
+        durable — pending commit() tickets resolve instantly."""
+        with self._cond:
+            while self._syncing:
+                self._cond.wait()
             self._f.flush()
-            _fp("wal-before-sync")  # reference: engine/wal.go:391
+            try:
+                if os.path.getsize(self.path) == 0:
+                    return None
+            except OSError:
+                pass
             os.fsync(self._f.fileno())
+            self._f.close()
+            os.replace(self.path, seg_path)
+            self._f = open(self.path, "wb")
+            self._synced = self._seq  # the segment fsync covered them all
+            _STATS.incr("wal", "rotations")
+            return seg_path
+
+    @staticmethod
+    def segments(path: str) -> list[str]:
+        """Rotated segment paths for the WAL at `path`, oldest first —
+        present only after a crash between rotate and segment removal."""
+        d = os.path.dirname(path) or "."
+        base = os.path.basename(path) + "."
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return []
+        segs = [n for n in names
+                if n.startswith(base) and n[len(base):].isdigit()]
+        segs.sort(key=lambda n: int(n[len(base):]))
+        return [os.path.join(d, n) for n in segs]
 
     def flush(self) -> None:
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        # fence an in-flight group-commit leader (like rotate/truncate):
+        # flushing/fsyncing concurrently is harmless, but close() reuses
+        # this wait and a leader must never see the fd swap under it
+        with self._cond:
+            while self._syncing:
+                self._cond.wait()
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._synced = self._seq
 
     def close(self) -> None:
-        self._f.close()
+        with self._cond:
+            while self._syncing:
+                self._cond.wait()
+            self._f.close()
+            # unblock any commit() that raced the close: everything
+            # appended was flushed+fsynced by the caller's flush()
+            self._synced = self._seq
+            self._cond.notify_all()
 
     def truncate(self) -> None:
-        """Called after a successful memtable flush: logged data is now in
-        immutable files (reference commitSnapshot, engine/shard.go:1008)."""
+        """Drop every logged entry: the data is durable elsewhere (legacy
+        single-segment flush path and tests; shard.flush now uses
+        rotate() + segment removal so ingest keeps logging while the
+        flush encodes)."""
         _STATS.incr("wal", "truncates")
-        self._f.close()
-        self._f = open(self.path, "wb")
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        with self._cond:
+            while self._syncing:
+                self._cond.wait()
+            self._f.close()
+            self._f = open(self.path, "wb")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._synced = self._seq
 
     @staticmethod
     def replay(path: str):

@@ -30,3 +30,24 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture
+def encode_pool_on(monkeypatch):
+    """Force the encode pool (storage/encodepool.py) live even on
+    single/dual-core CI boxes; shuts the forced pool down on teardown so
+    tests don't orphan worker threads."""
+    from opengemini_tpu.storage import encodepool
+
+    prev = encodepool._pool
+    monkeypatch.setattr(encodepool, "WORKERS", 4)
+    monkeypatch.setattr(encodepool, "_pool", None)
+    yield
+    forced = encodepool._pool
+    monkeypatch.setattr(encodepool, "_pool", None)
+    # never shut down the pre-test process-global pool: a test that
+    # reverted the _pool patch mid-test (monkeypatch.undo) could leave
+    # it installed here, and shutting it down would poison every later
+    # flush in the session
+    if forced is not None and forced is not prev:
+        forced.shutdown(wait=False)

@@ -82,6 +82,7 @@ def test_legacy_json_meta_files_still_read(tmp_path):
         "v": Column(FieldType.FLOAT, np.array([1.0, 2.0]),
                     np.array([True, True]))})
     w.add_chunk("m", 5, rec)
+    w._pipe.drain()  # land the pipelined chunk before poking _meta/_off
     # emulate the v1 finish(): plain zlib-JSON meta
     meta_buf = zlib.compress(
         json.dumps(w._meta, separators=(",", ":")).encode(), 1)

@@ -1,6 +1,8 @@
 """Failpoint fault injection (reference: pingcap/failpoint sites at
 engine/shard.go:457, engine/wal.go:391; SURVEY.md §5 fault-injection)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -82,4 +84,116 @@ def test_sleep_and_callable_actions(tmp_path):
     sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS, sync_wal=True)
     sh.write_points_structured([_pt(BASE, 1.0)])
     assert calls
+    sh.close()
+
+
+# -- crash safety under POOLED encode + concurrent writers -------------------
+# The off-lock flush encodes a frozen snapshot through the encode pool
+# (storage/encodepool.py) while ingest keeps landing in a fresh
+# memtable + rotated-WAL segment. A kill at either flush failpoint must
+# lose NOTHING that was acked: replay walks the rotated segments plus
+# the live log, and last-write-wins dedup makes any published-file
+# overlap idempotent.
+
+
+def _run_concurrent_flush_kill(tmp_path, fp_name):
+    """Concurrent writers + a flush killed at `fp_name`. Returns
+    (acked rows dict, reopened shard)."""
+    import threading
+
+    sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 10_000_000 * NS)
+    # pre-freeze rows (these ride the flush being killed)
+    sh.write_points_structured(
+        [_pt(BASE + i * NS, float(i)) for i in range(512)])
+    acked = {i: float(i) for i in range(512)}
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def writer(k):
+        i = 0
+        while not stop.is_set() and i < 300:
+            t_idx = 100_000 + k * 10_000 + i
+            sh.write_points_structured([_pt(BASE + t_idx * NS, float(t_idx))])
+            with lock:
+                acked[t_idx] = float(t_idx)  # record AFTER the ack
+            i += 1
+
+    failpoint.enable(fp_name, "error")
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        with pytest.raises(failpoint.FailpointError):
+            sh.flush()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        failpoint.disable_all()
+    sh.close()  # crash-equivalent: memtable + frozen snapshot dropped
+    sh2 = Shard(str(tmp_path / "s"), BASE - NS, BASE + 10_000_000 * NS)
+    return acked, sh2
+
+
+def _assert_all_acked(sh, acked):
+    sid = sh.index.get_or_create("m", (("host", "a"),))
+    rec = sh.read_series("m", sid)
+    got = {int((t - BASE) // NS): v
+           for t, v in zip(rec.times, rec.columns["v"].values)}
+    missing = set(acked) - set(got)
+    assert not missing, f"{len(missing)} acked rows lost: {sorted(missing)[:5]}"
+    for i, v in acked.items():
+        assert got[i] == v, (i, got[i], v)
+    assert len(got) == len(acked)  # and nothing duplicated/invented
+
+
+def test_pooled_flush_kill_before_publish_recovers_all_acked(
+        tmp_path, encode_pool_on):
+    acked, sh2 = _run_concurrent_flush_kill(
+        tmp_path, "shard-flush-before-publish")
+    # no partial TSF was adopted: the writer aborted pre-publish
+    assert sh2.file_count() == 0
+    assert not any(f.endswith((".tsf", ".tmp"))
+                   for f in os.listdir(sh2.path))
+    _assert_all_acked(sh2, acked)
+    # the shard is fully usable: the retried flush publishes everything
+    sh2.flush()
+    assert sh2.file_count() == 1
+    _assert_all_acked(sh2, acked)
+    sh2.close()
+
+
+def test_pooled_flush_kill_before_wal_truncate_recovers_all_acked(
+        tmp_path, encode_pool_on):
+    acked, sh2 = _run_concurrent_flush_kill(
+        tmp_path, "shard-flush-before-wal-truncate")
+    # the file WAS published; surviving WAL segments replay over it and
+    # dedup (idempotent), during-flush writes replay from the live log
+    assert sh2.file_count() == 1
+    _assert_all_acked(sh2, acked)
+    sh2.flush()  # leftover segments are swept by the next flush
+    assert not [f for f in os.listdir(sh2.path)
+                if f.startswith("wal.log.")]
+    _assert_all_acked(sh2, acked)
+    sh2.close()
+
+
+def test_flush_failure_keeps_frozen_snapshot_readable(tmp_path,
+                                                      encode_pool_on):
+    """A failed flush must not make the frozen rows unreadable in the
+    LIVE process: they stay queued (and the next flush drains them)."""
+    sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    sh.write_points_structured([_pt(BASE + i * NS, float(i))
+                                for i in range(64)])
+    sid = sh.index.get_or_create("m", (("host", "a"),))
+    failpoint.enable("shard-flush-before-publish", "error")
+    with pytest.raises(failpoint.FailpointError):
+        sh.flush()
+    failpoint.disable_all()
+    assert len(sh.read_series("m", sid)) == 64  # served from the snapshot
+    sh.write_points_structured([_pt(BASE + 500 * NS, 5.0)])
+    assert len(sh.read_series("m", sid)) == 65
+    sh.flush()  # retry drains the queued snapshot AND the new rows
+    assert sh.file_count() == 2  # one file per frozen snapshot
+    assert len(sh.read_series("m", sid)) == 65
     sh.close()
