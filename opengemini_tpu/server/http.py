@@ -103,6 +103,11 @@ class HttpService:
         from opengemini_tpu.server.logstore import LogStoreAPI
 
         self.logstore = LogStoreAPI(self)  # /repo log-mode surface
+        # monitoring: SHOW QUERIES / /debug/queries pair in-flight
+        # queries with the live acked-vs-durable ledger (PR 4)
+        from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
+
+        _TRACKER.set_durability_provider(engine.durability_snapshot)
         handler = _make_handler(self)
         if tls:
             # serve every surface — client API, /internal/* data plane,
@@ -363,6 +368,12 @@ def _make_handler(svc: HttpService):
                                    "version": __version__}}
                 snap.update(STATS.snapshot())
                 self._send_json(200, snap)
+            elif path == "/debug/queries":
+                from opengemini_tpu.utils.querytracker import (
+                    GLOBAL as _TRACKER,
+                )
+
+                self._send_json(200, _TRACKER.full_snapshot())
             else:
                 self._send_json(404, {"error": "not found"})
 
@@ -711,6 +722,20 @@ def _make_handler(svc: HttpService):
                 svc.engine.write_disabled = on
             elif mod == "flush":
                 svc.engine.flush_all()
+            elif mod == "durability":
+                # online acked-vs-durable invariant check (PR 4): cross-
+                # checks every clean shard's ledger live and reports
+                # loss/duplication without stopping the engine.  ONE
+                # snapshot drives both fields, so the violations always
+                # match the ledger state reported next to them.
+                snap = svc.engine.durability_snapshot()
+                violations = svc.engine.durability_check(snap)
+                self._send_json(200, {
+                    "status": "ok" if not violations else "violated",
+                    "violations": violations,
+                    "durability": snap,
+                })
+                return
             elif mod == "failpoint":
                 from opengemini_tpu.utils import failpoint as _fpmod
 

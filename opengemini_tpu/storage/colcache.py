@@ -375,8 +375,11 @@ class ColumnCache:
 
     def counters(self) -> dict:
         """Process-global counter snapshot (bench hit-rate lines, the
-        executor's per-scan delta for the `colcache` trace span)."""
-        snap = _STATS.snapshot().get("colcache", {})
+        executor's per-scan delta for the `colcache` trace span).
+        Counters-only read: the full stats snapshot runs gauge PROVIDERS
+        (durability ledger sweeps over every shard lock) — far too heavy
+        for a per-query call."""
+        snap = _STATS.counters("colcache")
         with self._lock:
             snap["bytes"] = self._host_bytes
             snap["device_bytes"] = self._dev_bytes

@@ -18,6 +18,7 @@ import time as _time
 from opengemini_tpu.ingest import line_protocol as lp
 from opengemini_tpu.record import FieldTypeConflict
 from opengemini_tpu.storage.shard import Shard
+from opengemini_tpu.utils.failpoint import inject as _fp
 from opengemini_tpu.utils.stats import GLOBAL as STATS
 
 NS = 1_000_000_000
@@ -238,6 +239,10 @@ class Engine:
         # staging shards are NEVER in _shards (invisible to queries)
         self._staging: dict[str, tuple] = {}
         self._load_shards()
+        # live acked-vs-durable gauges ride /debug/vars (utils/stats
+        # provider; close() unregisters so dead engines drop out)
+        self._durability_provider = self._durability_gauges
+        STATS.register_provider("durability", self._durability_provider)
 
     # -- metadata -----------------------------------------------------------
 
@@ -1072,6 +1077,10 @@ class Engine:
         share fsyncs instead of serializing them under the engine lock
         (no-ops instantly when sync is off or a flush already made the
         entries durable)."""
+        # lock handoff: engine lock dropped, rows applied, ack pending on
+        # the group-commit fsync — a kill here must never lose a row that
+        # a caller was told about (the ack happens after this returns)
+        _fp("engine-before-wal-commit")
         for shard, ticket in tickets:
             shard.wal.commit(ticket)
 
@@ -1086,6 +1095,7 @@ class Engine:
         dropped/offloaded between the lock release and here fails its
         flush benignly (drop discarded the data on purpose) — re-raise
         only if the shard is still registered."""
+        _fp("engine-before-threshold-flush")  # engine lock released
         seen = set()
         for shard in shards:
             if id(shard) in seen:
@@ -1298,6 +1308,48 @@ class Engine:
             for shard in self._shards.values():
                 shard.flush()
 
+    # -- durability ledger (PR 4) ------------------------------------------
+
+    def durability_snapshot(self) -> dict:
+        """Aggregate + per-shard acked-vs-durable ledgers (see
+        storage/shard.DurabilityLedger).  Per shard, `missing` > 0 means
+        acked rows are not accounted for in mem or published files —
+        silent loss; < 0 means a snapshot published twice.  The TOTAL
+        sums absolute values: a loss on one shard must never cancel a
+        double-publish on another in the gauge operators alert on."""
+        with self._lock:
+            shards = list(self._shards.items())
+        agg = {"acked": 0, "replayed": 0, "published": 0, "tsf_rows": 0,
+               "mem_rows": 0, "missing": 0, "dirty_shards": 0,
+               "shards": len(shards)}
+        per_shard = {}
+        for (db, rp, start), sh in shards:
+            snap = sh.ledger_snapshot()
+            per_shard[f"{db}|{rp}|{start}"] = snap
+            for k in ("acked", "replayed", "published", "tsf_rows",
+                      "mem_rows"):
+                agg[k] += snap[k]
+            agg["missing"] += abs(snap["missing"])
+            agg["dirty_shards"] += 1 if snap["dirty"] else 0
+        return {"totals": agg, "shards": per_shard}
+
+    def durability_check(self, snapshot: dict | None = None) -> list[dict]:
+        """Online invariant checker: every clean shard's ledger must
+        conserve rows (acked + replayed == published + mem).  Returns
+        violations (empty = healthy); the torture harness and
+        /debug/ctrl?mod=durability call this live.  Pass a
+        durability_snapshot() to check exactly the state being reported
+        (no second pass over the shard locks)."""
+        snap = snapshot if snapshot is not None else self.durability_snapshot()
+        return [
+            {"shard": key, **s}
+            for key, s in snap["shards"].items()
+            if not s["dirty"] and s["missing"] != 0
+        ]
+
+    def _durability_gauges(self) -> dict:
+        return self.durability_snapshot()["totals"]
+
     def drop_expired_shards(self, now_ns: int | None = None) -> list[tuple[str, str, int]]:
         """Retention enforcement (reference services/retention/service.go:81):
         drop shards whose whole range is past the RP duration."""
@@ -1338,6 +1390,13 @@ class Engine:
         return dropped
 
     def close(self) -> None:
+        STATS.unregister_provider("durability", self._durability_provider)
+        # the HTTP layer may have pointed the process-global querytracker
+        # at this engine's ledger: a closed engine must neither serve
+        # frozen durability state as live nor stay pinned in memory
+        from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
+
+        _TRACKER.detach_durability_provider(self.durability_snapshot)
         with self._lock:
             for shard in self._shards.values():
                 shard.close()

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from opengemini_tpu.utils.failpoint import inject as _fp
 from opengemini_tpu.record import (
     Column,
     FieldType,
@@ -71,8 +72,19 @@ class MemTable:
         # measurement -> [slab] in append (last-write-wins) order
         self._slabs: dict[str, list[_Slab]] = {}
         self._slab_sids: dict[str, set[int]] = {}
-        # measurement -> consolidated (sid_sorted, Record) cache
-        self._consolidated: dict[str, tuple[np.ndarray, Record]] = {}
+        # measurement -> (slab_count, (sid_sorted, Record)) cache. The
+        # count guards against the LOST-ACK race (PR 4): readers call
+        # _consolidate WITHOUT the shard lock, so a reader that computed
+        # a consolidation of N slabs can store it back AFTER a writer
+        # appended slab N+1 and popped the cache — a stale entry that
+        # hides the newest slab.  For reads that is transient staleness,
+        # but flush consumes measurement_tables() -> _consolidate on the
+        # FROZEN memtable: a stale hit there writes a TSF missing the
+        # last acked batch, whose rows then vanish with the snapshot and
+        # its WAL segment.  Slab lists only ever grow within a memtable
+        # generation, so a count captured before compute and re-checked
+        # at lookup detects every stale entry.
+        self._consolidated: dict[str, tuple[int, tuple[np.ndarray, Record]]] = {}
         self.row_count = 0
         self.approx_bytes = 0
         self.min_time: int | None = None
@@ -86,6 +98,7 @@ class MemTable:
         """Mark immutable (flush snapshot). Any later write is a bug in
         the caller's locking — fail loudly instead of corrupting the
         snapshot a concurrent flush is encoding."""
+        _fp("memtable-freeze")
         self.frozen = True
 
     def _check_mutable(self) -> None:
@@ -184,16 +197,24 @@ class MemTable:
 
     def _consolidate(self, measurement: str) -> tuple[np.ndarray, Record]:
         """Merged view of the measurement's slabs: rows sorted (sid, time),
-        deduped last-wins across slabs. Cached until the next write."""
+        deduped last-wins across slabs. Cached until the next write; the
+        cache entry records how many slabs it covers and a lookup only
+        hits when that count still matches (see __init__ — a stale store
+        from an unlocked reader must never mask a newer slab)."""
+        slabs = self._slabs.get(measurement, [])
+        n = len(slabs)  # capture BEFORE compute: racing appends miss
         cached = self._consolidated.get(measurement)
-        if cached is not None:
-            return cached
-        parts = [
-            (s.sids, Record(s.times, s.cols))
-            for s in self._slabs.get(measurement, [])
-        ]
+        if cached is not None and cached[0] == n:
+            return cached[1]
+        parts = [(s.sids, Record(s.times, s.cols)) for s in slabs[:n]]
         out = merge_bulk_parts(parts, -(2**63), 2**63 - 1)
-        self._consolidated[measurement] = out
+        # schedule-perturbation site between compute and store: the
+        # PR-4 lost-ack interleaving (reader computes, writer appends a
+        # slab + pops the cache, reader stores stale) replays exactly by
+        # arming a wait: action here — the count guard above must make
+        # the stale store harmless
+        _fp("memtable-consolidate-before-store")
+        self._consolidated[measurement] = (n, out)
         return out
 
     def _slab_record(self, sid: int) -> Record | None:

@@ -78,12 +78,15 @@ def _sid_entries(rec: Record, uniq, starts, ends):
 
 
 def _write_measurement_chunks(w: TSFWriter, tidx, mst: str, entries,
-                              n_series: int | None = None) -> None:
+                              n_series: int | None = None) -> int:
     """Write one measurement's series records: per-sid chunks at low
     cardinality, PK-sorted packed chunks (reference: colstore) once a
     flush carries >= PACK_MIN_SERIES series.  `entries` iterates
     (sid, rec) in ascending sid order; records stream out every
-    PACK_ROWS rows so compaction never holds a whole measurement."""
+    PACK_ROWS rows so compaction never holds a whole measurement.
+    Returns rows submitted to the writer — the flush path feeds this
+    into the durability ledger's tsf_rows counter."""
+    rows = 0
     if n_series is None:
         entries = list(entries)
         n_series = len(entries)
@@ -91,7 +94,8 @@ def _write_measurement_chunks(w: TSFWriter, tidx, mst: str, entries,
         for sid, rec in entries:
             w.add_chunk(mst, sid, rec)
             tidx.add(mst, sid, rec)
-        return
+            rows += len(rec)
+        return rows
     buffer: list = []
     buffered = 0
     for sid, rec in entries:
@@ -100,6 +104,7 @@ def _write_measurement_chunks(w: TSFWriter, tidx, mst: str, entries,
         tidx.add(mst, sid, rec)
         buffer.append((sid, rec))
         buffered += len(rec)
+        rows += len(rec)
         if buffered >= PACK_ROWS:
             sids, packed = _pack_entries(buffer)
             w.add_packed_chunk(mst, sids, packed)
@@ -107,6 +112,7 @@ def _write_measurement_chunks(w: TSFWriter, tidx, mst: str, entries,
     if buffer:
         sids, packed = _pack_entries(buffer)
         w.add_packed_chunk(mst, sids, packed)
+    return rows
 
 
 def iter_structured_batches(sh, chunk_rows: int):
@@ -143,6 +149,52 @@ def iter_structured_batches(sh, chunk_rows: int):
 
 _DATA_VERSIONS = itertools.count(1)  # see Shard.data_version
 _MUT_LOG_MAX = 512  # bounded mutation history; overflow = assume-changed
+
+
+class DurabilityLedger:
+    """Acked-rows vs durable-rows accounting for one shard (PR 4).
+
+    Flow conservation: every row the shard ACCEPTED (acked at the write
+    call's return, or re-applied by WAL replay on open) is either still
+    in an in-memory part (live memtable or a frozen flush snapshot) or
+    was handed to exactly one PUBLISHED TSF.  `published` counts rows at
+    the memtable's accounting (frozen.row_count, pre-dedup), so
+
+        acked + replayed == published + rows_in_mem_parts
+
+    holds at every instant the shard lock is held — a dropped snapshot
+    shows as a positive `missing`, a double-published one as negative.
+    `tsf_rows` counts rows actually written into published flush files
+    (post last-write-wins dedup): `published - tsf_rows` is legitimate
+    duplicate-timestamp collapse, and for a unique-timestamp workload
+    (the stress/torture harnesses) any nonzero gap is silent row loss —
+    exactly how the PR-4 consolidation-cache bug was pinned down.
+
+    All mutation happens under the shard lock; `dirty` marks shards
+    whose content was rewritten by delete/downsample (accounting
+    rebased — conservation no longer checkable)."""
+
+    __slots__ = ("acked", "replayed", "published", "tsf_rows", "dirty")
+
+    def __init__(self):
+        self.acked = 0
+        self.replayed = 0
+        self.published = 0
+        self.tsf_rows = 0
+        self.dirty = False
+
+    def snapshot(self, mem_rows: int) -> dict:
+        missing = (self.acked + self.replayed - self.published - mem_rows)
+        return {
+            "acked": self.acked,
+            "replayed": self.replayed,
+            "published": self.published,
+            "tsf_rows": self.tsf_rows,
+            "mem_rows": mem_rows,
+            "dirty": self.dirty,
+            # >0: acked rows vanished; <0: a snapshot published twice
+            "missing": 0 if self.dirty else missing,
+        }
 
 
 class Shard:
@@ -204,6 +256,10 @@ class Shard:
         self._files: list[TSFReader] = []
         self._tidx_cache: dict[str, object] = {}  # tsf path -> parsed | None
         self._next_file_seq = 1
+        # acked-vs-durable row accounting (see DurabilityLedger);
+        # _replaying routes replay-applied rows into the replayed bucket
+        self.ledger = DurabilityLedger()
+        self._replaying = False
         self._load_files()
         for r in self._files:
             for mst in r.measurements():
@@ -268,6 +324,13 @@ class Shard:
             self._next_file_seq = max(self._next_file_seq, seq + 1)
 
     def _replay_wal(self) -> None:
+        self._replaying = True
+        try:
+            self._replay_wal_inner()
+        finally:
+            self._replaying = False
+
+    def _replay_wal_inner(self) -> None:
         wal_path = os.path.join(self.path, "wal.log")
         # rotated segments first (oldest → newest), then the live log:
         # the append order every last-write-wins rank derives from. A
@@ -307,6 +370,7 @@ class Shard:
                                         expand_tag_arrays=self.tag_arrays)
             else:
                 points = entry[1]
+            replayed = 0
             for p in points:
                 mst, tags, t, fields = p
                 if self.tmin <= t < self.tmax:
@@ -315,6 +379,9 @@ class Shard:
                         self.mem.write_row(sid, mst, t, fields)
                     except FieldTypeConflict:
                         continue
+                    replayed += 1
+            if replayed:  # one batched credit per entry, not per row
+                self._ledger_accept(replayed)
 
     # -- write path ---------------------------------------------------------
 
@@ -449,7 +516,20 @@ class Shard:
             n += len(m_ts)
         if n:
             self._note_mutation(int(ts.min()), int(ts.max()) + 1)
+            self._ledger_accept(n)
         return n
+
+    def _ledger_accept(self, n: int) -> None:
+        """Rows entered the memtable (caller holds the shard lock):
+        credit the acked bucket — or replayed, when WAL replay is the
+        writer (those rows were acked in a previous process life).
+        /debug/vars durability gauges come from the live ledgers (stats
+        provider), never from separate counters — two diverging copies
+        of the same number would poison the alerting surface."""
+        if self._replaying:
+            self.ledger.replayed += n
+        else:
+            self.ledger.acked += n
 
     def _check_types(self, points: list) -> None:
         pending: dict[str, dict] = {}
@@ -472,6 +552,7 @@ class Shard:
         if n:
             self._note_mutation(
                 min(p[2] for p in points), max(p[2] for p in points) + 1)
+            self._ledger_accept(n)
         return n
 
     def flush(self) -> None:
@@ -510,6 +591,11 @@ class Shard:
                     self.mem.freeze()
                     self._frozen = self._frozen + ((self.mem, seg),)
                     self.mem = MemTable(self.schemas)
+                    # armed site between the freeze/rotate/swap (done,
+                    # still under both locks) and the off-lock encode —
+                    # a kill here leaves a rotated segment + frozen
+                    # snapshot that replay must fully recover
+                    _fp("shard-flush-after-rotate")
             # off the shard lock: encode + write + fsync + publish, one
             # file per frozen snapshot, oldest first (file append order =
             # write order keeps last-write-wins ranking exact)
@@ -550,16 +636,25 @@ class Shard:
         import time as _time
 
         t0 = _time.perf_counter_ns()
+        _fp("shard-flush-before-encode")  # off-lock encode begins
         w = TSFWriter(path, kind="flush")
         tidx = _TextSidecar()
+        tsf_rows = 0
         try:
             for mst, sid_arr, rec in frozen.measurement_tables():
                 uniq, starts = np.unique(sid_arr, return_index=True)
                 ends = np.append(starts[1:], len(sid_arr))
-                _write_measurement_chunks(
+                tsf_rows += _write_measurement_chunks(
                     w, tidx, mst,
                     _sid_entries(rec, uniq, starts, ends),
                     n_series=len(uniq))
+            # post-dedup rows can only ever SHRINK vs the snapshot's
+            # accepted-row count; more means duplicated rows — abort
+            # BEFORE finish() makes the bad file durable
+            if tsf_rows > frozen.row_count:
+                raise RuntimeError(
+                    f"flush wrote {tsf_rows} rows from a "
+                    f"{frozen.row_count}-row snapshot (duplication)")
             _fp("shard-flush-before-publish")  # reference: engine/shard.go:457
             w.finish()
         except BaseException:
@@ -573,6 +668,13 @@ class Shard:
             self._frozen = self._frozen[1:]
             if seg is not None:
                 self._stale_wal_segs.append(seg)
+            # ledger: the snapshot's rows moved from mem-parts to a
+            # published file — same lock hold as the swap, so the
+            # conservation invariant never wobbles mid-publish (gauges
+            # ride the stats provider; see _ledger_accept)
+            self.ledger.published += frozen.row_count
+            self.ledger.tsf_rows += tsf_rows
+        _fp("shard-flush-after-publish")
         # sidecar AFTER adoption: w.finish() already made the TSF
         # visible on disk, so a sidecar failure here must not leave the
         # snapshot queued (a retry would write the same rows into a
@@ -598,6 +700,7 @@ class Shard:
                 os.remove(p)
             except OSError:
                 pass
+        _fp("shard-flush-after-wal-truncate")
 
     @staticmethod
     def _merge_readers(readers, w: "TSFWriter", tidx: "_TextSidecar") -> None:
@@ -701,6 +804,7 @@ class Shard:
             old = self._files
             self._files = [self._adopt(TSFReader(path))]
             self._tidx_cache = {}
+            _fp("compact-before-retire")  # new set adopted, old not yet gone
             _retire_files(old)
             return True
 
@@ -768,6 +872,7 @@ class Shard:
             raise
         _fp("compact-before-replace")
         os.replace(tmp, target)  # new content under the run's 1st name
+        _fp("compact-after-replace")
         tidx.write(target)
         new_reader = self._adopt(TSFReader(target))
         retired = run[1:]
@@ -775,6 +880,7 @@ class Shard:
             self._files[:i0] + [new_reader] + self._files[i0 + n :]
         )
         self._tidx_cache = {}
+        _fp("compact-before-retire")
         _retire_files(retired)  # the old run[0] reader keeps its fd
         # run[0]'s OLD reader was replaced in place (same path, new
         # generation): its path needs no unlink, but its cached decoded
@@ -878,6 +984,7 @@ class Shard:
             self._tidx_cache = {}
             _retire_files(old)
             self._note_mutation(self.tmin, self.tmax)  # after swap (see delete_data)
+            self.ledger.dirty = True  # content rebased: counts no longer reconcile
             return rows
 
     def delete_data(
@@ -932,6 +1039,7 @@ class Shard:
             if not wrote:
                 os.remove(path)
             _retire_files(old)
+            self.ledger.dirty = True  # rows dropped: accounting rebased
             # version bump AFTER the swap: a concurrent query that scanned
             # the old files must cache under the OLD version so the next
             # execution invalidates it (bump-before would let pre-delete
@@ -1287,6 +1395,14 @@ class Shard:
 
     def mem_overlaps(self, measurement: str, sid: int) -> bool:
         return any(m.record_for(sid) is not None for m in self._mem_parts())
+
+    def ledger_snapshot(self) -> dict:
+        """Consistent acked-vs-durable snapshot (see DurabilityLedger).
+        Taken under the shard lock, so a concurrent write or flush
+        publish can never show a half-applied state."""
+        with self._lock:
+            mem_rows = sum(len(m) for m in self._mem_parts())
+            return self.ledger.snapshot(mem_rows)
 
     def close(self) -> None:
         # _flush_lock first: an in-flight off-lock flush finishes (or we

@@ -88,6 +88,7 @@ class WAL:
         _STATS.incr("wal", "appends")
         _STATS.incr("wal", "bytes", _HEADER.size + len(payload))
         self._f.write(_HEADER.pack(len(payload), crc, kind) + payload)
+        _fp("wal-after-append")  # entry framed, not yet fsynced/acked
         if not self.sync:
             return 0
         with self._cond:
@@ -184,7 +185,9 @@ class WAL:
                 pass
             os.fsync(self._f.fileno())
             self._f.close()
+            _fp("wal-rotate-before-rename")  # fsynced, still the live log
             os.replace(self.path, seg_path)
+            _fp("wal-rotate-after-rename")  # segment named, no live log yet
             self._f = open(self.path, "wb")
             self._synced = self._seq  # the segment fsync covered them all
             _STATS.incr("wal", "rotations")

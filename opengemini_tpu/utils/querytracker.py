@@ -37,6 +37,11 @@ class QueryTracker:
         self._running: dict[int, dict] = {}
         self._killed: set[int] = set()
         self._local = threading.local()
+        # optional () -> dict hook (engine.durability_snapshot): the
+        # monitoring view pairs in-flight queries with the live
+        # acked-vs-durable ledger so an operator sees loss the moment a
+        # query would observe it (PR 4)
+        self._durability_provider = None
 
     def register(self, text: str, db: str) -> int:
         with self._lock:
@@ -118,6 +123,31 @@ class QueryTracker:
                 }
                 for qid, info in sorted(self._running.items())
             ]
+
+    def set_durability_provider(self, fn) -> None:
+        """fn() -> engine.durability_snapshot()-shaped dict (None to
+        detach — e.g. the owning engine closed)."""
+        self._durability_provider = fn
+
+    def detach_durability_provider(self, fn) -> None:
+        """Detach ONLY if `fn` is still the attached provider — a closed
+        engine must not yank a newer engine's hook (bound-method equality
+        compares __self__ and __func__)."""
+        if self._durability_provider == fn:
+            self._durability_provider = None
+
+    def full_snapshot(self) -> dict:
+        """Monitoring snapshot: running queries plus a `durability`
+        section from the registered provider (empty dict when no engine
+        attached or the provider fails — monitoring must never raise)."""
+        durability: dict = {}
+        fn = self._durability_provider
+        if fn is not None:
+            try:
+                durability = fn()
+            except Exception:  # noqa: BLE001 — see docstring
+                durability = {}
+        return {"queries": self.snapshot(), "durability": durability}
 
 
 # process-wide tracker (like the reference's per-node query manager)

@@ -17,6 +17,12 @@ class Statistics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: dict[str, dict[str, int]] = defaultdict(lambda: defaultdict(int))
+        # computed gauge sections: module -> [fn() -> {name: int}].
+        # Providers are evaluated at snapshot time (live state — e.g. the
+        # per-shard durability ledgers aggregate, failpoint hit counts)
+        # and their values must be ints: the monitor service pushes every
+        # snapshot field into `_internal` as INT points.
+        self._providers: dict[str, list] = defaultdict(list)
         self.started_at = time.time()
 
     def incr(self, module: str, name: str, delta: int = 1) -> None:
@@ -27,11 +33,45 @@ class Statistics:
         with self._lock:
             self._counters[module][name] = value
 
+    def register_provider(self, module: str, fn) -> None:
+        """Attach a live gauge section to every snapshot(). Multiple
+        providers of one module merge by summing shared keys (several
+        engines in one process report process-wide totals)."""
+        with self._lock:
+            self._providers[module].append(fn)
+
+    def unregister_provider(self, module: str, fn) -> None:
+        with self._lock:
+            fns = self._providers.get(module)
+            if fns and fn in fns:
+                fns.remove(fn)
+            if fns is not None and not fns:
+                del self._providers[module]
+
+    def counters(self, module: str) -> dict:
+        """One module's RAW counter section — no gauge providers run.
+        Hot paths (the executor reads colcache counters twice per query)
+        must not pay the providers' engine/shard-lock sweeps just to
+        read a plain counter dict."""
+        with self._lock:
+            return dict(self._counters.get(module, ()))
+
     def snapshot(self) -> dict:
         with self._lock:
-            return {
-                m: dict(vals) for m, vals in self._counters.items()
-            }
+            out = {m: dict(vals) for m, vals in self._counters.items()}
+            providers = [(m, fn) for m, fns in self._providers.items()
+                         for fn in fns]
+        for module, fn in providers:  # outside the lock: providers lock
+            try:                      # their own structures (shard locks)
+                vals = fn()
+            except Exception:  # noqa: BLE001 — a dying provider (e.g. a
+                continue       # closed engine) must not break /debug/vars
+            if not vals:
+                continue  # keep empty sections out of pushed snapshots
+            sect = out.setdefault(module, {})
+            for k, v in vals.items():
+                sect[k] = sect.get(k, 0) + int(v)
+        return out
 
     def reset(self) -> None:
         with self._lock:
@@ -40,3 +80,14 @@ class Statistics:
 
 # process-wide registry (the reference's statistics singletons)
 GLOBAL = Statistics()
+
+
+def _failpoint_hits() -> dict:
+    from opengemini_tpu.utils import failpoint
+
+    return failpoint.all_hits()
+
+
+# failpoint hit counts ride every stats snapshot (/debug/vars): the
+# torture harness and operators can see WHICH armed sites actually fired
+GLOBAL.register_provider("failpoints", _failpoint_hits)

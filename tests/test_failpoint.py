@@ -87,6 +87,195 @@ def test_sleep_and_callable_actions(tmp_path):
     sh.close()
 
 
+# -- schedule-perturbation actions (PR 4) ------------------------------------
+
+
+def test_nth_hit_gating():
+    """"error#3" fires only on the third hit; earlier hits count."""
+    failpoint.enable("gated-site", "error#3")
+    failpoint.inject("gated-site")
+    failpoint.inject("gated-site")
+    with pytest.raises(failpoint.FailpointError):
+        failpoint.inject("gated-site")
+    failpoint.inject("gated-site")  # past the nth: counts only
+    assert failpoint.hits("gated-site") == 4
+
+
+def test_wait_set_forces_an_ordering():
+    """Deterministic schedule replay: a "wait:" site blocks its thread
+    until another thread's "set:" site releases it — the ordering log
+    records who actually ran first."""
+    import threading
+
+    failpoint.enable("site-a", "wait:ev1")
+    failpoint.enable("site-b", "set:ev1")
+    order = []
+
+    def blocked():
+        failpoint.inject("site-a")
+        order.append("a-done")
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    # the waiter must actually be parked before the release fires
+    for _ in range(1000):
+        if failpoint.hits("site-a"):
+            break
+        import time
+
+        time.sleep(0.001)
+    assert not order
+    failpoint.inject("site-b")  # releases ev1
+    t.join(10)
+    assert not t.is_alive() and order == ["a-done"]
+    log_sites = [site for _seq, site, _thr in failpoint.hit_log()]
+    assert log_sites == ["site-a", "site-b"]
+
+
+def test_wait_timeout_raises_instead_of_hanging(monkeypatch):
+    monkeypatch.setattr(failpoint, "WAIT_TIMEOUT_S", 0.05)
+    failpoint.enable("stuck-site", "wait:never-set")
+    with pytest.raises(RuntimeError, match="timed out"):
+        failpoint.inject("stuck-site")
+
+
+def test_barrier_rendezvous():
+    """barrier:3 holds every arriving thread until three have hit the
+    site, then releases them together."""
+    import threading
+    import time
+
+    failpoint.enable("rendezvous", "barrier:3")
+    released = []
+
+    def arrive(i):
+        failpoint.inject("rendezvous")
+        released.append(i)
+
+    threads = [threading.Thread(target=arrive, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    assert not released  # 2/3 arrived: everybody still parked
+    t3 = threading.Thread(target=arrive, args=(2,))
+    t3.start()
+    for t in threads + [t3]:
+        t.join(10)
+        assert not t.is_alive()
+    assert sorted(released) == [0, 1, 2]
+
+
+def test_record_all_hit_ordering_log(tmp_path):
+    """record_all logs every site reached — armed or not — so schedule
+    tests can assert which interleaving actually ran."""
+    failpoint.record_all(True)
+    sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    sh.write_points_structured([_pt(BASE, 1.0)])
+    sh.flush()
+    sh.close()
+    sites = [site for _seq, site, _thr in failpoint.hit_log()]
+    # the flush chain's sites appear in causal order
+    for a, b in [("memtable-freeze", "shard-flush-before-encode"),
+                 ("shard-flush-before-encode", "shard-flush-before-publish"),
+                 ("shard-flush-before-publish", "shard-flush-after-publish"),
+                 ("shard-flush-after-publish",
+                  "shard-flush-before-wal-truncate")]:
+        assert a in sites and b in sites, (a, b, sites)
+        assert sites.index(a) < sites.index(b), (a, b, sites)
+
+
+def test_stale_consolidation_store_cannot_hide_a_slab():
+    """Unit version of the lost-ack race: a stale consolidation entry
+    stored AFTER a newer slab arrived must never be served — the
+    slab-count guard detects it and recomputes (flush reads
+    measurement_tables -> _consolidate, so a stale hit there IS data
+    loss)."""
+    from opengemini_tpu.storage.memtable import MemTable
+    from opengemini_tpu.record import FieldType
+
+    m = MemTable()
+
+    def slab(lo, hi):
+        n = hi - lo
+        m.write_columnar(
+            "m", np.full(n, 7, np.int64),
+            np.arange(lo, hi, dtype=np.int64) * NS + BASE,
+            {"v": (FieldType.FLOAT, np.arange(lo, hi, dtype=np.float64),
+                   np.ones(n, np.bool_))})
+
+    slab(0, 50)
+    stale = m._consolidate("m")  # covers slab 1 only
+    slab(50, 100)  # writer wins the race; pops the cache
+    m._consolidated["m"] = (1, stale)  # the reader's late stale store
+    m.freeze()
+    tables = list(m.measurement_tables())
+    assert len(tables) == 1
+    _mst, sid_arr, rec = tables[0]
+    assert len(rec) == 100  # both slabs — the stale entry was rejected
+    assert list(rec.times) == [i * NS + BASE for i in range(100)]
+
+
+# -- the PR-4 lost-ack interleaving, replayed deterministically --------------
+
+
+def test_lost_ack_consolidation_interleaving_replay(tmp_path):
+    """Replay the exact race that lost one acked batch in ~2/6 runs of
+    the concurrency sanitizer (PR 3 known issue): an UNLOCKED reader
+    computes a slab consolidation, a writer appends a new slab and pops
+    the cache, the reader then stores its stale result back — and flush
+    consumed the stale cache, silently dropping the newest batch from
+    the published TSF (its rows then vanished with the snapshot and its
+    WAL segment).  The slab-count guard must make the stale store
+    harmless; the durability ledger cross-checks the published file."""
+    import threading
+
+    from opengemini_tpu.storage.engine import Engine
+
+    eng = Engine(str(tmp_path / "d"))
+    eng.create_database("db")
+    t0 = BASE // NS
+    lines_a = "\n".join(
+        f"m,w=w0 v={i}i {(t0 + i) * NS}" for i in range(50))
+    lines_b = "\n".join(
+        f"m,w=w0 v={i}i {(t0 + i) * NS}" for i in range(50, 100))
+    eng.write_lines("db", lines_a)  # slab 1
+    sh = eng.shards_of_db("db")[0]
+    sid = sh.index.get_or_create("m", (("w", "w0"),))
+
+    # reader consolidates slab 1, parks between compute and store
+    failpoint.enable("memtable-consolidate-before-store", "wait:stale#1")
+    reader_done = threading.Event()
+
+    def reader():
+        sh.mem_record_for(sid)  # -> _slab_record -> _consolidate
+        reader_done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    for _ in range(1000):
+        if failpoint.hits("memtable-consolidate-before-store"):
+            break
+        import time
+
+        time.sleep(0.001)
+    assert failpoint.hits("memtable-consolidate-before-store") == 1
+
+    eng.write_lines("db", lines_b)  # slab 2 lands, pops the cache
+    failpoint.set_event("stale")  # reader now stores its STALE result
+    assert reader_done.wait(10)
+
+    eng.flush_all()  # consumed the consolidation cache before the fix
+    snap = sh.ledger_snapshot()
+    assert snap["missing"] == 0, snap
+    # unique timestamps: every accepted row must be IN the file
+    assert snap["tsf_rows"] == snap["published"] == 100, snap
+    rec = sh.read_series("m", sid)
+    assert len(rec) == 100
+    assert list(rec.columns["v"].values) == list(range(100))
+    assert not eng.durability_check()
+    eng.close()
+
+
 # -- crash safety under POOLED encode + concurrent writers -------------------
 # The off-lock flush encodes a frozen snapshot through the encode pool
 # (storage/encodepool.py) while ingest keeps landing in a fresh

@@ -1,0 +1,184 @@
+"""Crash-torture harness wiring (PR 4).
+
+`tools/torture.py --quick` runs as a tier-1 test: fixed seeds, one kill
+at every stage of the WAL-append -> fsync -> rotate -> encode -> rename
+-> retire chain plus a parent-side SIGKILL, bounded ~30s.  The full
+randomized sweep (>= 100 kill points) is the `-m slow` target.
+
+Also covers the online acked-vs-durable invariant surface the harness
+leans on: the per-shard ledger, the engine checker, and the
+/debug/vars + /debug/ctrl?mod=durability + /debug/queries exposure."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TORTURE = os.path.join(ROOT, "tools", "torture.py")
+NS = 1_000_000_000
+BASE = 1_700_000_000
+
+
+def _run_torture(args, timeout):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("OGTPU_FAILPOINTS", None)  # the harness arms its own
+    proc = subprocess.run(
+        [sys.executable, TORTURE, *args], cwd=ROOT, env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, (
+        f"torture harness reported a durability violation:\n"
+        f"{proc.stdout[-4000:]}\n{proc.stderr[-2000:]}")
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("TORTURE-JSON ")][-1]
+    return json.loads(line[len("TORTURE-JSON "):])
+
+
+def test_torture_quick_no_acked_row_lost():
+    """Tier-1 gate: every fixed-seed kill across the durability chain
+    recovers every acked row exactly once."""
+    out = _run_torture(["--quick"], timeout=240)
+    assert out["summary"]["violations"] == 0
+    assert out["summary"]["rounds"] == 7
+    # the harness must actually have killed the child, not watched it
+    # finish — a never-firing site would silently test nothing
+    assert out["summary"]["killed"] >= 6
+
+
+@pytest.mark.slow
+def test_torture_full_randomized_sweep():
+    """>= 100 randomized kill points spanning the whole chain."""
+    out = _run_torture(["--rounds", "100", "--seed", "7"], timeout=1800)
+    assert out["summary"]["violations"] == 0
+    assert out["summary"]["rounds"] == 100
+
+
+def test_kill_site_catalog_matches_armed_sites():
+    """The harness's kill-site catalog and the armed `_fp(...)` sites in
+    the code must agree BOTH ways: a renamed site would silently stop
+    being tortured, and a newly armed site must enter the kill rotation
+    (and the README catalog) rather than silently escaping coverage."""
+    import re
+
+    from tools.torture import KILL_SITES
+
+    pkg = os.path.join(ROOT, "opengemini_tpu")
+    armed = set()
+    for dirpath, _dirs, files in os.walk(pkg):
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, f), encoding="utf-8") as fh:
+                armed.update(re.findall(r'_fp\("([^"]+)"\)', fh.read()))
+    missing = set(KILL_SITES) - armed
+    assert not missing, f"torture sites not armed anywhere: {missing}"
+    # object-store fault sites simulate REMOTE failures (torn/missing
+    # bucket objects), not local crash points — the cold tier has its
+    # own tests (test_objstore_remote) and the torture child runs no
+    # object store, so a kill armed there would never fire
+    not_on_chain = {"objstore-get-torn", "objstore-get-missing",
+                    "objstore-put-torn"}
+    untortured = armed - set(KILL_SITES) - not_on_chain
+    assert not untortured, (
+        f"armed sites missing from the torture kill rotation: {untortured}")
+
+
+# -- online ledger + debug exposure ------------------------------------------
+
+
+def test_durability_ledger_tracks_flush_and_replay(tmp_path):
+    from opengemini_tpu.storage.engine import Engine
+
+    eng = Engine(str(tmp_path / "d"))
+    eng.create_database("db")
+    lines = "\n".join(
+        f"m,w=a v={i}i {(BASE + i) * NS}" for i in range(40))
+    eng.write_lines("db", lines)
+    snap = eng.durability_snapshot()["totals"]
+    assert snap["acked"] == 40 and snap["mem_rows"] == 40
+    assert snap["missing"] == 0 and not eng.durability_check()
+    eng.flush_all()
+    snap = eng.durability_snapshot()["totals"]
+    assert snap["published"] == 40 and snap["tsf_rows"] == 40
+    assert snap["mem_rows"] == 0 and snap["missing"] == 0
+    eng.close()
+    # reopen: WAL is gone (flushed) — nothing replays, nothing missing
+    eng2 = Engine(str(tmp_path / "d"))
+    snap = eng2.durability_snapshot()["totals"]
+    assert snap["replayed"] == 0 and snap["missing"] == 0
+    eng2.close()
+
+
+def test_durability_ledger_counts_replay(tmp_path):
+    from opengemini_tpu.storage.engine import Engine
+
+    eng = Engine(str(tmp_path / "d"))
+    eng.create_database("db")
+    eng.write_lines("db", "\n".join(
+        f"m v={i}i {(BASE + i) * NS}" for i in range(10)))
+    eng.close()  # WAL survives (no flush)
+    eng2 = Engine(str(tmp_path / "d"))
+    snap = eng2.durability_snapshot()["totals"]
+    assert snap["replayed"] == 10 and snap["acked"] == 0
+    assert snap["mem_rows"] == 10 and snap["missing"] == 0
+    assert not eng2.durability_check()
+    eng2.close()
+
+
+def test_durability_ledger_detects_simulated_loss(tmp_path):
+    """The checker must actually FIRE: fake a dropped snapshot by
+    crediting acked rows that never reach mem or a file."""
+    from opengemini_tpu.storage.engine import Engine
+
+    eng = Engine(str(tmp_path / "d"))
+    eng.create_database("db")
+    eng.write_lines("db", f"m v=1i {BASE * NS}")
+    sh = eng.shards_of_db("db")[0]
+    sh.ledger.acked += 5  # 5 phantom acked rows = silent loss
+    bad = eng.durability_check()
+    assert len(bad) == 1 and bad[0]["missing"] == 5
+    eng.close()
+
+
+def test_debug_vars_and_ctrl_expose_durability(tmp_path):
+    from opengemini_tpu.server.http import HttpService
+    from opengemini_tpu.storage.engine import Engine
+    from opengemini_tpu.utils import failpoint
+
+    eng = Engine(str(tmp_path / "d"))
+    eng.create_database("db")
+    eng.write_lines("db", f"m v=1i {BASE * NS}")
+    failpoint.enable("debug-vars-probe", "off")
+    failpoint.inject("debug-vars-probe")
+    svc = HttpService(eng, "127.0.0.1", 0)
+    svc.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{svc.port}/debug/vars", timeout=30) as r:
+            vars_ = json.loads(r.read())
+        # /debug/vars sums every live engine in the process (other tests
+        # may leak quiescent ones): ours contributes at least its row
+        assert vars_["durability"]["acked"] >= 1
+        assert vars_["durability"]["missing"] == 0
+        assert vars_["failpoints"]["debug-vars-probe"] == 1
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/debug/ctrl?mod=durability",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            ctrl = json.loads(r.read())
+        assert ctrl["status"] == "ok" and ctrl["violations"] == []
+        assert ctrl["durability"]["totals"]["acked"] == 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{svc.port}/debug/queries",
+                timeout=30) as r:
+            qsnap = json.loads(r.read())
+        assert qsnap["durability"]["totals"]["acked"] == 1
+        assert qsnap["queries"] == []
+    finally:
+        svc.stop()
+        failpoint.disable_all()
+        eng.close()

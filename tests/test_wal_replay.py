@@ -1,0 +1,143 @@
+"""WAL segment replay edge cases (PR 4 satellite).
+
+The rotate/flush chain leaves rotated segments (`wal.log.NNNNNN`) on disk
+whenever a crash lands between `WAL.rotate` and the post-publish segment
+removal.  Replay walks segments oldest-first then the live log; these
+tests pin the edges: segment-without-file, segment-plus-file dedup,
+byte-identical duplicate segments, and a torn segment tail that must not
+swallow the live log behind it."""
+
+import os
+import shutil
+
+import pytest
+
+from opengemini_tpu.record import FieldType
+from opengemini_tpu.storage.shard import Shard
+from opengemini_tpu.utils import failpoint
+
+NS = 1_000_000_000
+BASE = 1_700_000_000 * NS
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    yield
+    failpoint.disable_all()
+
+
+def _pt(t, v):
+    return ("m", (("host", "a"),), t, {"v": (FieldType.FLOAT, v)})
+
+
+def _values(sh):
+    sid = sh.index.get_or_create("m", (("host", "a"),))
+    rec = sh.read_series("m", sid)
+    return list(rec.columns["v"].values) if len(rec) else []
+
+
+def _segments(path):
+    return sorted(f for f in os.listdir(path) if f.startswith("wal.log."))
+
+
+def test_replay_after_kill_between_rotate_and_encode(tmp_path):
+    """Crash right after the rotate (segment exists, NO TSF yet): every
+    row lives only in the segment and must replay in full."""
+    sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    sh.write_points_structured([_pt(BASE + i * NS, float(i))
+                                for i in range(8)])
+    failpoint.enable("shard-flush-before-encode", "error")
+    with pytest.raises(failpoint.FailpointError):
+        sh.flush()
+    sh.close()
+    failpoint.disable_all()
+    assert _segments(sh.path) == ["wal.log.000001"]
+    sh2 = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    assert _values(sh2) == [float(i) for i in range(8)]
+    assert sh2.file_count() == 0
+    assert sh2.ledger_snapshot()["missing"] == 0
+    sh2.flush()  # recovery flush publishes and sweeps the segment
+    assert not _segments(sh2.path)
+    assert _values(sh2) == [float(i) for i in range(8)]
+    sh2.close()
+
+
+def test_replay_after_kill_between_publish_and_segment_removal(tmp_path):
+    """Crash after the TSF published but before the rotated segment was
+    removed: the segment replays OVER the file and dedups — rows counted
+    exactly once."""
+    sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    sh.write_points_structured([_pt(BASE + i * NS, float(i))
+                                for i in range(8)])
+    failpoint.enable("shard-flush-after-publish", "error")
+    with pytest.raises(failpoint.FailpointError):
+        sh.flush()
+    sh.close()
+    failpoint.disable_all()
+    assert _segments(sh.path) == ["wal.log.000001"]
+    sh2 = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    assert sh2.file_count() == 1  # published
+    assert _values(sh2) == [float(i) for i in range(8)]  # deduped
+    sh2.flush()
+    assert not _segments(sh2.path)
+    assert _values(sh2) == [float(i) for i in range(8)]
+    sh2.close()
+
+
+def test_duplicate_segment_replay_is_idempotent(tmp_path):
+    """A byte-identical duplicate segment (e.g. a backup restored next
+    to the original) replays to the same logical rows — last-write-wins
+    dedup, never doubled counts."""
+    sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    sh.write_points_structured([_pt(BASE + i * NS, float(i))
+                                for i in range(6)])
+    failpoint.enable("shard-flush-before-encode", "error")
+    with pytest.raises(failpoint.FailpointError):
+        sh.flush()
+    sh.close()
+    failpoint.disable_all()
+    seg = os.path.join(sh.path, "wal.log.000001")
+    shutil.copyfile(seg, os.path.join(sh.path, "wal.log.000002"))
+    sh2 = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    assert _values(sh2) == [float(i) for i in range(6)]
+    sh2.flush()  # sweeps BOTH segments
+    assert not _segments(sh2.path)
+    assert _values(sh2) == [float(i) for i in range(6)]
+    # a third open (nothing left to replay) agrees
+    sh2.close()
+    sh3 = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    assert _values(sh3) == [float(i) for i in range(6)]
+    sh3.close()
+
+
+def test_truncated_segment_tail_then_live_log(tmp_path):
+    """A torn write in a rotated segment truncates THAT segment's replay
+    at the damage — the intact frames before it and the entire LIVE log
+    after it still replay."""
+    sh = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    # two frames in the log that will become the rotated segment
+    sh.write_points_structured([_pt(BASE + i * NS, float(i))
+                                for i in range(4)])
+    sh.write_points_structured([_pt(BASE + (4 + i) * NS, float(4 + i))
+                                for i in range(4)])
+    failpoint.enable("shard-flush-before-encode", "error")
+    with pytest.raises(failpoint.FailpointError):
+        sh.flush()
+    failpoint.disable_all()
+    # rows written AFTER the failed flush land in the fresh live log
+    sh.write_points_structured([_pt(BASE + (8 + i) * NS, float(8 + i))
+                                for i in range(4)])
+    sh.close()
+    seg = os.path.join(sh.path, "wal.log.000001")
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as f:  # tear into the SECOND frame
+        f.truncate(size - 3)
+    sh2 = Shard(str(tmp_path / "s"), BASE - NS, BASE + 1000 * NS)
+    got = _values(sh2)
+    # first frame of the torn segment + everything in the live log; the
+    # torn second frame (rows 4..7) is the only legitimate casualty
+    assert got == [0.0, 1.0, 2.0, 3.0, 8.0, 9.0, 10.0, 11.0]
+    assert sh2.ledger_snapshot()["missing"] == 0
+    sh2.flush()
+    assert _values(sh2) == got
+    sh2.close()

@@ -1,0 +1,384 @@
+#!/usr/bin/env python
+"""Crash-torture harness: acked-write durability under kill -9.
+
+The contract under test (reference: openGemini's gofail failpoints across
+the WAL/flush/compaction paths): once a write call RETURNS, its rows
+survive any crash, at any instant, anywhere in the
+
+    WAL-append -> fsync -> rotate -> encode -> rename -> retire
+
+chain — and replay never duplicates them.
+
+One round:
+  1. spawn a CHILD process (this script, --child) that opens an Engine
+     with sync WAL, runs concurrent writers + a flusher + a compactor,
+     and records every acked batch in an fsynced ack log AFTER the write
+     call returned;
+  2. kill it — either a failpoint armed with "panic#<k>" (os._exit at
+     the k-th hit of a chosen site) or a parent-side SIGKILL at a random
+     delay;
+  3. restart: open the engine over the wreckage (WAL replay), and assert
+     the single invariant — EVERY acked row is readable, with its exact
+     value, exactly once.  The engine's online durability ledger
+     (engine.durability_check) must also be clean, the reopen must be
+     idempotent (close + open again: same rows), and a post-recovery
+     flush must not lose anything either.
+
+Usage:
+    python tools/torture.py --quick               # tier-1: fixed seeds,
+                                                  #  bounded ~30s
+    python tools/torture.py --rounds 100 --seed 7 # the full randomized
+                                                  #  run (slow target)
+    python tools/torture.py --rounds 20 --site wal-before-sync
+Exit status 0 = no violation; 1 = durability violated (details on
+stdout as JSON).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+# runnable as `python tools/torture.py` from a checkout: the package
+# lives at the repo root, one directory up
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+NS = 1_000_000_000
+BASE = 1_700_000_000
+MST = "t"
+
+# every armed site along the durability chain (tools/torture.py and the
+# README failpoint catalog list the same names; tests assert the catalog
+# stays in sync with the code)
+KILL_SITES = [
+    "wal-after-append",
+    "wal-before-sync",
+    "engine-before-wal-commit",
+    "engine-before-threshold-flush",
+    "wal-rotate-before-rename",
+    "wal-rotate-after-rename",
+    "memtable-freeze",
+    "memtable-consolidate-before-store",
+    "shard-flush-after-rotate",
+    "shard-flush-before-encode",
+    "shard-flush-before-publish",
+    "shard-flush-after-publish",
+    "shard-flush-before-wal-truncate",
+    "shard-flush-after-wal-truncate",
+    "compact-before-replace",
+    "compact-after-replace",
+    "compact-before-retire",
+]
+
+# --quick rounds: (site, nth-hit) pairs that walk the whole chain once
+# with fixed seeds — bounded enough for tier-1 (< ~30s total)
+QUICK_ROUNDS = [
+    ("wal-before-sync", 3),
+    ("engine-before-wal-commit", 4),
+    ("wal-rotate-after-rename", 1),
+    ("shard-flush-before-publish", 1),
+    ("shard-flush-before-wal-truncate", 1),
+    ("compact-before-retire", 1),
+    (None, 0),  # parent-side SIGKILL at a fixed delay
+]
+
+
+def _expected_value(k: int) -> int:
+    return k
+
+
+def _batch_lines(wid: int, b: int, rows: int) -> str:
+    lines = []
+    for r in range(rows):
+        k = b * rows + r
+        t = (BASE + k) * NS
+        lines.append(f"{MST},w=w{wid} v={_expected_value(k)}i {t}")
+    return "\n".join(lines)
+
+
+# -- child: the workload that gets killed ---------------------------------
+
+
+def run_child(args) -> int:
+    from opengemini_tpu.storage.engine import Engine
+
+    eng = Engine(args.dir, sync_wal=True)
+    eng.flush_threshold_bytes = 8 * 1024  # frequent threshold flushes
+    eng.create_database("db")
+    stop = threading.Event()
+    errors: list = []
+    ack = open(args.ack_log, "a", encoding="utf-8")
+    ack_lock = threading.Lock()
+
+    def writer(wid: int):
+        try:
+            for b in range(args.batches):
+                eng.write_lines("db", _batch_lines(wid, b, args.rows))
+                # acked: record AFTER the write returned, fsynced so the
+                # parent's acked-set is a subset of what the engine acked
+                with ack_lock:
+                    ack.write(f"{wid} {b}\n")
+                    ack.flush()
+                    os.fsync(ack.fileno())
+        except Exception as e:  # noqa: BLE001 — surfaced via exit code
+            errors.append(e)
+
+    def flusher():
+        while not stop.is_set():
+            try:
+                eng.flush_all()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            time.sleep(0.002)
+
+    def compactor():
+        while not stop.is_set():
+            try:
+                for sh in eng.shards_of_db("db"):
+                    sh.compact()
+                    sh.compact_level()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+               for w in range(args.writers)]
+    threads += [threading.Thread(target=flusher, daemon=True),
+                threading.Thread(target=compactor, daemon=True)]
+    for t in threads:
+        t.start()
+    for t in threads[: args.writers]:
+        t.join()
+    stop.set()
+    for t in threads[args.writers:]:
+        t.join()
+    if errors:
+        print(f"CHILD-ERROR {errors[0]!r}", flush=True)
+        return 2
+    eng.close()
+    print("CHILD-DONE", flush=True)
+    return 0
+
+
+# -- parent: kill, restart, verify ----------------------------------------
+
+
+def _read_acks(path: str) -> set[tuple[int, int]]:
+    acked = set()
+    if not os.path.exists(path):
+        return acked
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 2 and all(p.isdigit() for p in parts):
+                acked.add((int(parts[0]), int(parts[1])))
+    return acked
+
+
+def _collect_rows(eng) -> dict[tuple[str, int], int]:
+    """{(writer-tag, time-index): value} over every readable row;
+    asserts no (series, time) appears twice across shards."""
+    from opengemini_tpu.storage.shard import iter_structured_batches
+
+    rows: dict[tuple[str, int], int] = {}
+    for sh in eng.shards_of_db("db"):
+        for batch in iter_structured_batches(sh, 100_000):
+            for mst, tags, t_ns, fields in batch:
+                if mst != MST:
+                    continue
+                wtag = dict(tags).get("w", "?")
+                key = (wtag, t_ns // NS - BASE)
+                if key in rows:
+                    raise AssertionError(f"row {key} readable twice")
+                if "v" not in fields:
+                    raise AssertionError(f"row {key} lost its field")
+                rows[key] = int(fields["v"][1])
+    return rows
+
+
+def _verify_rows(rows: dict, acked: set[tuple[int, int]], args) -> list[str]:
+    problems = []
+    for (wtag, k), v in rows.items():
+        # every readable row — acked or in-flight at the kill — must
+        # carry the exact value its (series, time) was written with
+        if v != _expected_value(k):
+            problems.append(f"corrupt row {wtag} k={k}: v={v}")
+    for wid, b in sorted(acked):
+        for r in range(args.rows):
+            k = b * args.rows + r
+            got = rows.get((f"w{wid}", k))
+            if got is None:
+                problems.append(f"LOST acked row: writer {wid} batch {b} "
+                                f"row {r} (k={k})")
+            elif got != _expected_value(k):
+                problems.append(f"acked row wrong value: writer {wid} "
+                                f"k={k}: {got}")
+    return problems
+
+
+def verify_dir(data_dir: str, ack_log: str, args) -> list[str]:
+    """Open the engine over a killed process's directory and check the
+    invariant; exercises reopen-idempotence and post-recovery flush."""
+    from opengemini_tpu.storage.engine import Engine
+
+    acked = _read_acks(ack_log)
+    problems: list[str] = []
+
+    eng = Engine(data_dir, sync_wal=True)
+    try:
+        rows1 = _collect_rows(eng)
+        problems += _verify_rows(rows1, acked, args)
+        problems += [f"ledger: {v}" for v in eng.durability_check()]
+    finally:
+        eng.close()
+
+    # reopen BEFORE any flush: leftover rotated segments replay again —
+    # idempotence (duplicate-segment replay must not double rows)
+    eng = Engine(data_dir, sync_wal=True)
+    try:
+        rows2 = _collect_rows(eng)
+        if rows2 != rows1:
+            problems.append(
+                f"reopen not idempotent: {len(rows1)} rows then "
+                f"{len(rows2)}")
+        # recovery flush: everything replayed must survive its own flush
+        eng.flush_all()
+        for sh in eng.shards_of_db("db"):
+            sh.compact()
+        rows3 = _collect_rows(eng)
+        problems += _verify_rows(rows3, acked, args)
+        if rows3 != rows2:
+            problems.append("post-recovery flush+compact changed rows")
+        problems += [f"post-flush ledger: {v}" for v in eng.durability_check()]
+    finally:
+        eng.close()
+    return problems
+
+
+def run_round(site: str | None, nth: int, seed: int, args,
+              sigkill_delay: float | None = None) -> dict:
+    workdir = tempfile.mkdtemp(prefix="ogt-torture-")
+    data_dir = os.path.join(workdir, "d")
+    ack_log = os.path.join(workdir, "acks.log")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["OGT_WAL_GROUP_COMMIT_US"] = "0"  # fsync instantly: tighter loop
+    if site is not None:
+        env["OGTPU_FAILPOINTS"] = f"{site}=panic#{nth}"
+    else:
+        env.pop("OGTPU_FAILPOINTS", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--dir", data_dir, "--ack-log", ack_log,
+           "--writers", str(args.writers), "--batches", str(args.batches),
+           "--rows", str(args.rows)]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    killed_by = None
+    if site is None:
+        delay = (sigkill_delay if sigkill_delay is not None
+                 else random.Random(seed).uniform(0.2, 1.5))
+        try:
+            proc.wait(delay)
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGKILL)
+            killed_by = "SIGKILL"
+    try:
+        out, _ = proc.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        killed_by = "watchdog"
+    rc = proc.returncode
+    if rc == 13:
+        killed_by = f"{site}#{nth}"
+    text = out.decode("utf-8", "replace")
+    if rc == 2 or "CHILD-ERROR" in text:
+        return {"site": site, "nth": nth, "ok": False, "killed_by": killed_by,
+                "problems": [f"child errored: {text[-400:]}"]}
+    problems = verify_dir(data_dir, ack_log, args)
+    acked = len(_read_acks(ack_log))
+    import shutil
+
+    if not problems:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {"site": site, "nth": nth, "ok": not problems,
+            "killed_by": killed_by, "acked_batches": acked,
+            "dir": None if not problems else workdir,
+            "problems": problems}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--dir")
+    ap.add_argument("--ack-log")
+    ap.add_argument("--quick", action="store_true",
+                    help="fixed-seed bounded run (tier-1 CI)")
+    ap.add_argument("--rounds", type=int, default=0,
+                    help="randomized rounds over all kill sites")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--site", help="restrict randomized rounds to one site")
+    ap.add_argument("--writers", type=int, default=3)
+    ap.add_argument("--batches", type=int, default=6)
+    ap.add_argument("--rows", type=int, default=25)
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return run_child(args)
+
+    rounds: list[tuple[str | None, int, float | None]] = []
+    if args.quick:
+        rounds = [(site, nth, 0.6) for site, nth in QUICK_ROUNDS]
+    else:
+        n = args.rounds or 100
+        rng = random.Random(args.seed)
+        sites = [args.site] if args.site else KILL_SITES
+        for _ in range(n):
+            # ~1 in 8 rounds kill from outside (SIGKILL at a random
+            # delay) — no site bias at all
+            if not args.site and rng.random() < 0.125:
+                rounds.append((None, 0, None))
+            else:
+                rounds.append((rng.choice(sites), rng.randint(1, 6), None))
+
+    results = []
+    t0 = time.time()
+    for i, (site, nth, delay) in enumerate(rounds):
+        res = run_round(site, nth, args.seed * 10_000 + i, args,
+                        sigkill_delay=delay)
+        results.append(res)
+        tag = res["killed_by"] or "ran-to-completion"
+        status = "ok" if res["ok"] else "VIOLATION"
+        print(f"[{i + 1}/{len(rounds)}] {site or 'sigkill'}: "
+              f"{tag}: {status}", flush=True)
+        if not res["ok"]:
+            for p in res["problems"]:
+                print("   ", p, flush=True)
+    bad = [r for r in results if not r["ok"]]
+    summary = {
+        "rounds": len(results),
+        "killed": sum(1 for r in results if r["killed_by"]),
+        "ran_to_completion": sum(1 for r in results if not r["killed_by"]),
+        "violations": len(bad),
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps({"summary": summary, "violations": bad}, indent=2))
+    # machine-readable single line (tests/test_torture.py parses this)
+    print("TORTURE-JSON " + json.dumps({"summary": summary}))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
