@@ -783,6 +783,76 @@ def bench_colcache_warm(rows: int = 4_000_000, chunk: int = 16_384,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_overload_shed(clients: int = 32, duration_s: float = 6.0,
+                        budget_mb: int = 4) -> dict:
+    """Resource-governor overload behavior (PR 5 acceptance metric): a
+    real HTTP server + engine under a TINY `OGT_MEM_BUDGET_MB` with
+    `clients` closed-loop mixed write/query clients.  Reports the shed
+    rate (429/503 + Retry-After — the governor WORKING instead of the
+    process OOMing), admitted-query p99, and the process's peak RSS next
+    to the budget.  The governor is configured at runtime and fully
+    restored (pass-through) afterwards."""
+    import shutil
+    import tempfile
+
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import loadgen as _loadgen
+
+    from opengemini_tpu.server.http import HttpService
+    from opengemini_tpu.storage.engine import Engine
+    from opengemini_tpu.utils.governor import GOVERNOR
+
+    root = tempfile.mkdtemp(prefix="ogtpu-overload-")
+    prev = GOVERNOR.config()
+    eng = svc = None
+    try:
+        # flush threshold just under the low watermark: the memtable+WAL
+        # backlog cycles through the backpressure band (429s while over
+        # the high watermark, recovery once a flush drains it) instead of
+        # either absorbing everything or wedging shut
+        eng = Engine(root, flush_threshold_bytes=1 << 20)
+        eng.create_database("load")
+        svc = HttpService(eng, port=0)
+        svc.start()
+        # high watermark just UNDER the flush threshold: every memtable
+        # generation's last stretch before its flush sheds writes (429),
+        # and the flush drains it below the low watermark — so the run
+        # exercises BOTH shed paths (429 write backpressure + 503
+        # admission) and the hysteresis recovery each cycle.  (With the
+        # watermark above the threshold a keeping-up flush would never
+        # let the backlog cross — correctly zero 429s.)
+        GOVERNOR.configure(
+            budget_mb=budget_mb, max_concurrent=2, queue=4,
+            timeout_ms=200, hiwat_pct=20, lowat_pct=8)
+        sampler = _loadgen.RssSampler().start()
+        out = _loadgen.run_load(
+            "127.0.0.1", svc.port, "load", clients=clients,
+            duration_s=duration_s, write_frac=0.6, batch_rows=100,
+            timeout_s=30.0)
+        peak_mb = sampler.stop()
+        gauges = GOVERNOR.gauges()
+        out.pop("acked_batches", None)
+        out.update({
+            "budget_mb": budget_mb,
+            "peak_rss_mb": round(peak_mb, 1),
+            "admitted_query_p99_ms": out["queries"]["p99_ms"],
+            "governor": {k: v for k, v in gauges.items()
+                         if not k.startswith("ledger_")},
+        })
+        return out
+    finally:
+        GOVERNOR.configure(**prev)
+        GOVERNOR.reset()
+        if svc is not None:
+            svc.stop()
+        if eng is not None:
+            eng.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_atspec(n_rows: int = 100_000_000, hosts: int = 100,
                  keep_root: str | None = None) -> dict:
     """Config #1 at SPEC scale (VERDICT r4 #1): the production query path
@@ -960,9 +1030,25 @@ def _load_atspec_lastgood() -> dict | None:
 # -- staged device probe -----------------------------------------------------
 
 _PROBE_SCRIPT = r"""
-import sys, time
+import faulthandler, os, sys, time
+
+# Per-stage watchdog (BENCH_r05: 3x `backend:begin -> hung` with ZERO
+# evidence).  A stage that stalls past its budget dumps EVERY thread's
+# stack to the captured output, then exits — faulthandler's C-level
+# watchdog, NOT a Python thread: the observed hang (jax.devices() stuck
+# inside the PJRT client) holds the GIL, so a Python-thread watchdog
+# would never get to run.  Env/device flags print up front (the dump
+# path can't run Python).  The parent parses both into probe.detail.
+_STAGE_BUDGET_S = float(os.environ.get("OGTPU_PROBE_STAGE_S", "40"))
+for _k in sorted(os.environ):
+    if any(t in _k for t in ("JAX", "TPU", "XLA", "PJRT", "LIBTPU", "OGT")):
+        print("WDOG-ENV " + _k + "=" + os.environ[_k], flush=True)
+
 def mark(s):
+    faulthandler.cancel_dump_traceback_later()
+    faulthandler.dump_traceback_later(_STAGE_BUDGET_S, exit=True)
     print("STAGE " + s, flush=True)
+
 mark("import:begin")
 t0 = time.time()
 import jax
@@ -983,7 +1069,12 @@ t0 = time.time()
 y = jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())(jnp.ones((256, 256), jnp.bfloat16))
 assert float(y) > 0
 mark(f"kernel:ok {time.time()-t0:.1f}s")
-print("PROBE OK " + jax.default_backend(), flush=True)
+# resolve the backend BEFORE disarming: default_backend() re-enters the
+# PJRT layer whose hang this watchdog exists to diagnose — touching it
+# unarmed would reopen the zero-evidence window
+_backend = jax.default_backend()
+faulthandler.cancel_dump_traceback_later()
+print("PROBE OK " + _backend, flush=True)
 """
 
 
@@ -1001,22 +1092,40 @@ def probe_device_staged(timeout_s: float = 90.0) -> dict:
     out_path = tempfile.mktemp(prefix="ogtpu-probe-")
     stages: list[str] = []
     try:
+        # one stage may legitimately consume the whole parent budget
+        # (cold TPU init has taken >60s of a 90s window), so the stage
+        # budget defaults to the FULL timeout — a smaller default would
+        # kill slow-but-healthy stages that used to pass.  The dump
+        # still always lands: on parent timeout we grant the armed
+        # watchdog a grace window below instead of SIGKILLing at once
+        stage_budget = float(os.environ.get(
+            "OGTPU_PROBE_STAGE_S", str(max(5.0, timeout_s))))
         with open(out_path, "w") as out_f:
             proc = subprocess.Popen(
                 [sys.executable, "-c", _PROBE_SCRIPT],
                 stdout=out_f, stderr=subprocess.STDOUT,
+                env=dict(os.environ, OGTPU_PROBE_STAGE_S=str(stage_budget)),
             )
             try:
                 rc = proc.wait(timeout=timeout_s)
                 hung = False
             except subprocess.TimeoutExpired:
-                proc.kill()
-                proc.wait()
-                rc = -9
+                # the stage watchdog is re-armed at full budget at every
+                # mark(), so when earlier stages ate most of the parent
+                # budget it can fire as late as ~timeout_s + stage_budget
+                # after start.  Grant it that grace to dump + self-exit
+                # (exit=True) — an immediate SIGKILL here would reproduce
+                # the zero-evidence r05 rounds this watchdog exists to fix
                 hung = True
+                try:
+                    rc = proc.wait(timeout=stage_budget + 5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+                    rc = -9
         with open(out_path, errors="replace") as f:
-            lines = [ln.strip() for ln in f if ln.strip()]
-        stages = [ln[6:] for ln in lines if ln.startswith("STAGE ")]
+            lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+        stages = [ln[6:].strip() for ln in lines if ln.startswith("STAGE ")]
         ok_line = next((ln for ln in lines if ln.startswith("PROBE OK")), None)
         if rc == 0 and ok_line:
             backend = ok_line.split()[-1]
@@ -1026,8 +1135,33 @@ def probe_device_staged(timeout_s: float = 90.0) -> dict:
         failed = next(
             (s.split(":")[0] for s in begun if s.split(":")[0] not in done),
             "unknown")
-        detail = ("hung (killed after timeout)" if hung
-                  else f"exited rc={rc}: " + " | ".join(lines[-3:]))
+        # child stage watchdog fired: faulthandler's dump ("Timeout
+        # (...)!"" + per-thread stacks) carries the thread stacks of the
+        # hang, and the WDOG-ENV preamble the env/device flags — the
+        # evidence the r05 `backend:begin -> hung` rounds never recorded
+        env_flags = {}
+        for ln in lines:
+            if ln.startswith("WDOG-ENV "):
+                k, _, v = ln[len("WDOG-ENV "):].partition("=")
+                env_flags[k] = v
+        wdog_at = next((i for i, ln in enumerate(lines)
+                        if ln.startswith("Timeout (")), None)
+        if wdog_at is not None:
+            detail = {
+                "summary": (f"stage {failed!r} exceeded its "
+                            f"{stage_budget:.0f}s watchdog budget"),
+                "thread_stacks": lines[wdog_at:],
+                "env": env_flags,
+            }
+        elif hung:
+            detail = {
+                "summary": ("hung (killed after timeout; child watchdog "
+                            "produced no dump)"),
+                "env": env_flags,
+            }
+        else:
+            detail = f"exited rc={rc}: " + " | ".join(
+                ln for ln in lines[-3:] if not ln.startswith("WDOG-ENV "))
         return {"ok": False, "failed_stage": failed, "detail": detail,
                 "stages": stages}
     except OSError as e:
@@ -1218,6 +1352,20 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     except Exception as e:  # noqa: BLE001 — bench must still emit
         print(f"bench: colcache warm failed: {e}", file=sys.stderr)
 
+    # resource-governor overload shedding: tiny budget, 32 closed-loop
+    # clients — shed rate + admitted-query p99 + peak RSS vs budget
+    # (the PR 5 acceptance metric)
+    overload = None
+    try:
+        overload = bench_overload_shed(
+            clients=int(os.environ.get("OGTPU_BENCH_OVERLOAD_CLIENTS", "32")),
+            duration_s=float(os.environ.get("OGTPU_BENCH_OVERLOAD_S", "6")))
+        _emit("overload_shed" + suffix,
+              overload["shed_rate"], "shed_rate",
+              overload["shed_rate"], {"detail": overload})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: overload shed failed: {e}", file=sys.stderr)
+
     # e2e host path (config #1 shape)
     e2e = bench_e2e(
         series=int(os.environ.get("OGTPU_BENCH_E2E_SERIES", "200")),
@@ -1252,6 +1400,8 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
         extra["ingest_during_flush"] = ingest_flush
     if colcache_warm:
         extra["colcache_warm"] = colcache_warm
+    if overload:
+        extra["overload_shed"] = overload
     if note:
         extra["note"] = note
     atspec_best = _load_atspec_lastgood()
@@ -1302,21 +1452,28 @@ def main() -> None:
                     "detail": "OGTPU_BENCH_CPU set", "stages": []})
         return
 
-    # Budget layout (default 900s total): up to 3 staged probes (90s each,
-    # retried across the window — a tunnel that comes up late still gets a
-    # device run), device child <= 420s, CPU smoke ~240s.
+    # Budget layout (default 900s total): staged probes retried across the
+    # front of the window (a tunnel that comes up late still gets a device
+    # run), then device child <= 420s, CPU smoke ~240s.  A HUNG probe
+    # attempt costs up to ~timeout_s + stage_budget + 5s — the watchdog
+    # grace wait that captures the hang's stack dump — not just timeout_s,
+    # so the retry gate reasons in worst-case attempt cost (fast failures
+    # still get all 3 attempts; full hangs stop while the device child and
+    # CPU smoke still fit their share).
     total_budget = int(os.environ.get("OGTPU_BENCH_TOTAL_S", "900"))
     t_start = time.time()
+    probe_timeout = float(os.environ.get("OGTPU_PROBE_TIMEOUT_S", "90"))
+    attempt_worst = probe_timeout + float(os.environ.get(
+        "OGTPU_PROBE_STAGE_S", str(max(5.0, probe_timeout)))) + 5.0
     probe: dict = {}
     attempts = []
     for attempt in range(3):
-        probe = probe_device_staged(
-            timeout_s=float(os.environ.get("OGTPU_PROBE_TIMEOUT_S", "90")))
+        probe = probe_device_staged(timeout_s=probe_timeout)
         attempts.append({k: probe.get(k) for k in
                          ("ok", "failed_stage", "detail")})
         if probe.get("ok"):
             break
-        if time.time() - t_start > total_budget * 0.4:
+        if time.time() - t_start + attempt_worst > total_budget * 0.4:
             break
         time.sleep(10)
     probe["attempts"] = attempts

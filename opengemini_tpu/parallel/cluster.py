@@ -686,12 +686,19 @@ class DataRouter:
                 self.forward_points(node_id, db, rp, pts)
                 n += len(pts)
             except urllib.error.HTTPError as e:
-                # the replica is ALIVE and rejected the points (schema
-                # conflict, bad payload): hinting would retry forever —
-                # surface it as a hard failure instead
-                raise RemoteScanError(
-                    f"replica {node_id!r} rejected write: {e}"
-                ) from e
+                if e.code == 400:
+                    # the replica deterministically rejected the payload
+                    # (unparseable points): hinting would retry forever —
+                    # surface it as a hard failure instead
+                    raise RemoteScanError(
+                        f"replica {node_id!r} rejected write: {e}"
+                    ) from e
+                # 429 write backpressure, 403 during a cluster-token
+                # rotation, 5xx: transient — count the replica as
+                # unreachable so the copy rides the hint queue and the
+                # consistency-level accounting (same classification as
+                # replay_hints), instead of failing the whole batch hard
+                failed.append((node_id, pts, e))
             except (OSError, RemoteScanError) as e:
                 failed.append((node_id, pts, e))
         if failed:
@@ -844,9 +851,19 @@ class DataRouter:
                                         points)
                     delivered += len(points)
                     remaining[i] = None
-                except urllib.error.HTTPError:
-                    remaining[i] = None  # rejected by a LIVE node: poison,
-                    # drop it rather than retry forever
+                except urllib.error.HTTPError as e:
+                    if e.code == 400:
+                        # the replica deterministically rejected the
+                        # payload (unparseable points): replaying can
+                        # never succeed — poison, drop this hint only
+                        remaining[i] = None
+                        continue
+                    # anything else (429 backpressure, 403 during a
+                    # cluster-token rotation, 5xx) can clear: a hinted
+                    # copy may BE the ack at consistency=any, so keep
+                    # the rest queued and retry next tick rather than
+                    # destroy acked durability
+                    break
                 except (OSError, RemoteScanError):
                     break  # node still down: keep the rest queued
                 except (ValueError, KeyError, TypeError):
@@ -1159,6 +1176,13 @@ class DataRouter:
         body = {"db": db, "rp": rp, "points": encode_points(points)}
         try:
             self._post(addr, "/internal/write", body)
+        except urllib.error.HTTPError:
+            # status errors carry the replica's classification (429 =
+            # transient write backpressure vs 4xx = hard rejection);
+            # HTTPError is an OSError, so without this re-raise the
+            # clause below would flatten both into RemoteScanError and
+            # callers could not tell them apart
+            raise
         except OSError as e:
             raise RemoteScanError(
                 f"data node {node_id!r} ({addr}) write failed: {e}"
@@ -1267,12 +1291,24 @@ class DataRouter:
                         "tmin": tmin, "tmax": tmax,
                         "live": live, "rf": self.rf,
                     })
+                except urllib.error.HTTPError as e:
+                    # the peer is ALIVE but rejected the round (governor
+                    # shed / rolling upgrade): not a node-down — treating
+                    # it as dead would fail the query "unreachable" at
+                    # rf=1 and evict a merely-overloaded replica from
+                    # the live set at rf>1.  PartialsUnavailable makes
+                    # the executor fall back to the raw column exchange.
+                    return PartialsUnavailable(
+                        f"data node {nid!r} ({addr}) cannot serve "
+                        f"metadata: {e}")
                 except OSError as e:
                     return _NodeDown(
                         nid, f"data node {nid!r} ({addr}) unreachable: {e}")
 
             metas, dead = [], set()
             for got in self._fanout(fetch):
+                if isinstance(got, PartialsUnavailable):
+                    raise got
                 if isinstance(got, _NodeDown):
                     dead.add(got.nid)
                 elif got:
@@ -1357,6 +1393,24 @@ class DataRouter:
                     "tmin": tmin, "tmax": tmax,
                     "live": live, "rf": self.rf, "fmt": "bin",
                 })
+            except urllib.error.HTTPError as e:
+                if e.code in (429, 503):
+                    # alive peer SHED the scan (governor admission or
+                    # backpressure): the raw exchange is the bottom of
+                    # the fallback chain, so this is a clean retryable
+                    # query error — NOT a node-down, which would
+                    # misreport "unreachable" and evict a merely-
+                    # overloaded replica from the live set
+                    raise RemoteScanError(
+                        f"data node {nid!r} ({addr}) rejected scan: {e}"
+                    ) from e
+                # any other status (500 disk fault, 404 rolling
+                # upgrade): the peer cannot serve this scan — fail over
+                # to a replica like an unreachable node, else one sick-
+                # but-alive node fails every query touching its shards
+                return _NodeDown(
+                    nid, f"data node {nid!r} ({addr}) cannot scan: {e}"
+                )
             except OSError as e:
                 return _NodeDown(
                     nid, f"data node {nid!r} ({addr}) unreachable: {e}"

@@ -15,6 +15,7 @@ Times in values are int ns; the HTTP layer formats RFC3339/epoch.
 
 from __future__ import annotations
 
+import contextlib
 import math
 import os
 import re
@@ -38,6 +39,7 @@ from opengemini_tpu.storage import scanpool
 from opengemini_tpu.meta.users import AuthError as _AuthError
 from opengemini_tpu.storage.engine import WriteError
 from opengemini_tpu.utils import tracing
+from opengemini_tpu.utils.governor import GOVERNOR
 from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER, QueryKilled
 from opengemini_tpu.utils.stats import GLOBAL as STATS
 from opengemini_tpu.sql.parser import parse
@@ -413,11 +415,26 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         except ValueError as e:
             return {"results": [{"statement_id": 0, "error": f"error parsing query: {e}"}]}
         STATS.incr("executor", "queries")
-        qid = TRACKER.register(text, db)
+        # admission control (utils/governor.py): may raise
+        # AdmissionRejected, which the HTTP layer maps to 503 +
+        # Retry-After and flight to UNAVAILABLE — deliberately NOT a
+        # statement error in a 200.  Pass-through (no lock, no wait)
+        # when the governor is disabled.
+        token = GOVERNOR.admit()
+        qid = None
         try:
+            qid = TRACKER.register(text, db)
+            if token.waited_ns:
+                # attribute the admission wait like any other query stage
+                # (shows in /debug/queries stages and /debug/vars
+                # query_stages — the trace-span channel)
+                TRACKER.add_stage_ns(qid, "admission_wait", token.waited_ns)
+                tracing.record_stage("admission_wait", token.waited_ns)
             return self._execute_statements(stmts, db, now_ns, read_only, user)
         finally:
-            TRACKER.unregister(qid)
+            if qid is not None:
+                TRACKER.unregister(qid)
+            token.release()
 
 
     def _execute_statements(self, stmts, db, now_ns, read_only, user) -> dict:
@@ -1018,6 +1035,12 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                     remote, live = self.router.scan_shards(
                         db, rp, mst, pre.tmin, pre.tmax
                     )
+            except pcluster.PartialsUnavailable:
+                # a live peer rejected the metadata round (governor
+                # shed / rolling upgrade): propagate so the pushdown
+                # driver falls back to the raw column exchange instead
+                # of flattening this into a hard QueryError
+                raise
             except Exception as e:  # noqa: BLE001 — partial data = wrong data
                 raise QueryError(str(e)) from e
             if self.router.rf > 1:
@@ -1373,7 +1396,20 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
 
         cc_before = (colcache_mod.GLOBAL.counters()
                      if colcache_mod.GLOBAL.enabled() else None)
-        with trace.span("scan") as scan_span:
+        # per-query working-set reservation (utils/governor.py): charge
+        # the chunk-meta estimate against the unified memory ledger for
+        # the scan's duration; a reservation that would overdraw the
+        # ledger kills this query through the tracker (clean error, no
+        # OOM).  Zero-cost no-op when the governor is disabled.
+        reservation = contextlib.nullcontext()
+        if GOVERNOR.enabled() and not full_hit:
+            est = estimate_scan_bytes(
+                shards, mst, tmin, tmax,
+                len(read_fields) if read_fields is not None else
+                len(schema) or 1)
+            reservation = GOVERNOR.scan_reservation(
+                TRACKER.current_qid(), est)
+        with reservation, trace.span("scan") as scan_span:
             if full_hit:
                 rows_scanned = 0
             elif slice_plan is not None:

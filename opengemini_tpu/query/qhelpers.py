@@ -996,6 +996,25 @@ def _fmt_duration(ns: int) -> str:
     return f"{h}h{m}m{s}s"
 
 
+def estimate_scan_bytes(shards, mst: str, tmin: int, tmax: int,
+                        n_fields: int | None) -> int:
+    """Estimated decoded working set of a scan, from chunk metadata +
+    memtable row counts alone (no decode) — the per-query reservation
+    the resource governor charges against its unified ledger before
+    scan dispatch (utils/governor.py).  Same 9-bytes-per-cell model as
+    scanpool.est_chunk_bytes; remote/duck-typed shards without chunk
+    metadata contribute 0 (their bytes live on the peer)."""
+    cols = (n_fields if n_fields else 1) + 2
+    total_rows = 0
+    for sh in shards:
+        approx = getattr(sh, "approx_rows", None)
+        if approx is None:
+            continue
+        r, _c = approx(mst, tmin, tmax)
+        total_rows += r
+    return total_rows * 9 * cols
+
+
 __all__ = [
     "_prune_text_sids",
     "_series_needs_merged_decode",
@@ -1035,6 +1054,7 @@ __all__ = [
     "_pyval",
     "_data_time_range",
     "_fmt_duration",
+    "estimate_scan_bytes",
     "QueryError",
     "_STRING_OK_HOST",
     "_check_host_field_type",

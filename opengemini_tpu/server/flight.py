@@ -52,6 +52,16 @@ class FlightService:
                 user = outer._check_auth(meta)
                 if user is not None and not user.can("WRITE", meta.get("db", "")):
                     raise fl.FlightUnauthorizedError("write not authorized")
+                # write-path backpressure (resource governor): shed as
+                # UNAVAILABLE — the flight analogue of HTTP 429 +
+                # Retry-After (the window rides the message text)
+                from opengemini_tpu.utils.governor import GOVERNOR
+
+                retry_after = GOVERNOR.write_backpressure()
+                if retry_after is not None:
+                    raise fl.FlightUnavailableError(
+                        "write backpressure: memtable+WAL backlog over the "
+                        f"high watermark; retry after {retry_after}s")
                 table = reader.read_all()
                 outer.write_table(
                     meta.get("db", ""), meta.get("rp"),
@@ -62,8 +72,16 @@ class FlightService:
             def do_get(self, context, ticket):
                 req = json.loads(ticket.ticket or b"{}")
                 user = outer._check_auth(req)
-                table = outer.query_table(req.get("db", ""), req.get("q", ""),
-                                          user=user)
+                from opengemini_tpu.utils.governor import AdmissionRejected
+
+                try:
+                    table = outer.query_table(req.get("db", ""),
+                                              req.get("q", ""), user=user)
+                except AdmissionRejected as e:
+                    # admission shed: UNAVAILABLE (flight analogue of the
+                    # HTTP 503 + Retry-After)
+                    raise fl.FlightUnavailableError(
+                        f"{e}; retry after {e.retry_after_s}s") from None
                 return fl.RecordBatchStream(table)
 
             def do_action(self, context, action):

@@ -29,6 +29,7 @@ from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query.executor import Executor
 from opengemini_tpu.record import FieldTypeConflict
 from opengemini_tpu.storage.engine import DatabaseNotFound, Engine, WriteError
+from opengemini_tpu.utils.governor import GOVERNOR, AdmissionRejected
 from opengemini_tpu.utils.stats import GLOBAL as STATS
 
 _EPOCH_DIV = {"ns": 1, "u": 1_000, "µ": 1_000, "ms": 1_000_000, "s": 1_000_000_000,
@@ -328,8 +329,7 @@ def _make_handler(svc: HttpService):
             elif path == "/api/v1/consume":
                 self._handle_consume(self._params())
             elif path == "/repo" or path.startswith("/repo/"):
-                if not svc.logstore.handle(self, "GET", path, self._params()):
-                    self._send_json(404, {"error": "not found"})
+                self._logstore("GET", path, self._params())
             elif path.startswith("/api/v1/"):
                 self._handle_prom(path, self._params())
             elif path == "/raft/status" and svc.meta_store is not None:
@@ -408,8 +408,7 @@ def _make_handler(svc: HttpService):
             elif path == "/api/v1/otlp/metrics":
                 self._handle_otlp_metrics(params)
             elif path == "/repo" or path.startswith("/repo/"):
-                if not svc.logstore.handle(self, "POST", path, params):
-                    self._send_json(404, {"error": "not found"})
+                self._logstore("POST", path, params)
             elif path.startswith("/api/v1/"):
                 self._merge_form_body(params)
                 self._handle_prom(path, params)
@@ -443,17 +442,35 @@ def _make_handler(svc: HttpService):
                 req = self._internal_request(svc)
                 if req is None:
                     return
+                # replica-side backpressure: the coordinator classifies
+                # this 429 as transient and queues the copy as a hint,
+                # so shedding here never costs acked durability
+                if self._shed_write_if_backpressured():
+                    return
                 from opengemini_tpu.parallel.cluster import decode_points
 
                 try:
                     points = decode_points(req.get("points", []))
                     svc.engine.write_rows(req["db"], points,
                                           rp=req.get("rp") or None)
-                except (KeyError, TypeError, ValueError) as e:
+                except DatabaseNotFound as e:
+                    # a replica lagging meta propagation transiently
+                    # lacks the db: 404 keeps the copy hinted until it
+                    # appears (the coordinator poisons only on 400)
+                    self._send_err(404, e)
+                    return
+                except (FieldTypeConflict, KeyError, TypeError,
+                        ValueError) as e:
                     self._send_json(400, {"error": f"bad points: {e}"})
                     return
                 except WriteError as e:
-                    self._send_err(403, e)
+                    # deterministic rejection of THIS payload (unknown
+                    # rp, invalid measurement): 400 so the coordinator
+                    # classifies it poison instead of hinting a copy
+                    # that can never be delivered — 403 stays reserved
+                    # for the cluster-token check, whose rotation
+                    # window is transient and must not destroy hints
+                    self._send_err(400, e)
                     return
                 self._send_json(200, {"ok": True})
             elif path == "/internal/raftdata":
@@ -546,25 +563,42 @@ def _make_handler(svc: HttpService):
                 req = self._internal_request(svc)
                 if req is None:
                     return
-                if path == "/internal/select_meta":
-                    from opengemini_tpu.parallel.cluster import (
-                        serialize_select_meta,
-                    )
-
-                    self._send_json(200, serialize_select_meta(
-                        svc.engine, req["db"], req.get("rp"),
-                        req.get("mst", ""),
-                        int(req.get("tmin", -(2**62))),
-                        int(req.get("tmax", 2**62)),
-                        shard_filter=self._primary_filter(svc, req),
-                    ))
-                    return
-                from opengemini_tpu.query.partials import compute_partials
-
+                # remote-initiated scans compete for the same memory as
+                # local queries: admit them so peer fan-out cannot drive
+                # a node past its budget while it sheds its own clients.
+                # A 503 here surfaces on the coordinator as a clean
+                # query error (PartialsUnavailable), not a node-down.
                 try:
-                    body = compute_partials(svc.engine, svc.router, req)
-                except (KeyError, TypeError, ValueError) as e:
-                    self._send_json(400, {"error": f"bad partials request: {e}"})
+                    with GOVERNOR.admitted():
+                        if path == "/internal/select_meta":
+                            from opengemini_tpu.parallel.cluster import (
+                                serialize_select_meta,
+                            )
+
+                            self._send_json(200, serialize_select_meta(
+                                svc.engine, req["db"], req.get("rp"),
+                                req.get("mst", ""),
+                                int(req.get("tmin", -(2**62))),
+                                int(req.get("tmax", 2**62)),
+                                shard_filter=self._primary_filter(svc, req),
+                            ))
+                            return
+                        from opengemini_tpu.query.partials import (
+                            compute_partials,
+                        )
+
+                        try:
+                            body = compute_partials(
+                                svc.engine, svc.router, req)
+                        except (KeyError, TypeError, ValueError) as e:
+                            self._send_json(
+                                400,
+                                {"error": f"bad partials request: {e}"})
+                            return
+                except AdmissionRejected as e:
+                    self._send_json(
+                        503, {"error": str(e)},
+                        headers={"Retry-After": str(e.retry_after_s)})
                     return
                 self._send(200, body, ctype="application/octet-stream")
             elif path == "/internal/groups":
@@ -601,23 +635,35 @@ def _make_handler(svc: HttpService):
                 if req is None:
                     return
                 if path == "/internal/scan":
-                    shard_filter = self._primary_filter(svc, req)
-                    args = (svc.engine, req["db"], req.get("rp"),
-                            req.get("mst", ""),
-                            int(req.get("tmin", -(2**62))),
-                            int(req.get("tmax", 2**62)))
-                    if req.get("fmt") == "bin":
-                        from opengemini_tpu.parallel.cluster import (
-                            serialize_series_binary,
-                        )
+                    # raw-series exchange materializes full decoded
+                    # columns for a peer — the memory-heaviest remote
+                    # read, so it takes an admission slot like
+                    # select_partials.  The coordinator maps a 503 to
+                    # a clean RemoteScanError, not a node-down.
+                    try:
+                        with GOVERNOR.admitted():
+                            shard_filter = self._primary_filter(svc, req)
+                            args = (svc.engine, req["db"], req.get("rp"),
+                                    req.get("mst", ""),
+                                    int(req.get("tmin", -(2**62))),
+                                    int(req.get("tmax", 2**62)))
+                            if req.get("fmt") == "bin":
+                                from opengemini_tpu.parallel.cluster import (
+                                    serialize_series_binary,
+                                )
 
-                        self._send(200, serialize_series_binary(
-                            *args, shard_filter=shard_filter),
-                            ctype="application/octet-stream")
+                                self._send(200, serialize_series_binary(
+                                    *args, shard_filter=shard_filter),
+                                    ctype="application/octet-stream")
+                                return
+                            payload = serialize_series(
+                                *args, shard_filter=shard_filter,
+                            )
+                    except AdmissionRejected as e:
+                        self._send_json(
+                            503, {"error": str(e)},
+                            headers={"Retry-After": str(e.retry_after_s)})
                         return
-                    payload = serialize_series(
-                        *args, shard_filter=shard_filter,
-                    )
                 else:
                     names = set()
                     for sh in svc.engine.shards_for_range(
@@ -697,8 +743,7 @@ def _make_handler(svc: HttpService):
             self._body_cache = None
             path = urllib.parse.urlparse(self.path).path
             if path.startswith("/repo/"):
-                if not svc.logstore.handle(self, "DELETE", path, self._params()):
-                    self._send_json(404, {"error": "not found"})
+                self._logstore("DELETE", path, self._params())
             else:
                 self._send_json(404, {"error": "not found"})
 
@@ -736,6 +781,31 @@ def _make_handler(svc: HttpService):
                     "durability": snap,
                 })
                 return
+            elif mod == "governor":
+                # runtime tuning of the resource governor: each knob
+                # changes only when passed; no knobs = status query.
+                # budget_mb=0 disables (pass-through).
+                knobs = {}
+                for key in ("budget_mb", "max_concurrent", "queue",
+                            "timeout_ms", "hiwat_pct", "lowat_pct",
+                            "overdraft_pct", "bg_pause_pct",
+                            "bg_max_pause_s", "bp_cache_ms"):
+                    if key in params:
+                        try:
+                            # the anti-starvation bound is a duration —
+                            # fractional seconds are meaningful
+                            knobs[key] = (float(params[key])
+                                          if key == "bg_max_pause_s"
+                                          else int(params[key]))
+                        except ValueError:
+                            self._send_json(
+                                400, {"error": f"bad {key}={params[key]!r}"})
+                            return
+                if knobs:
+                    GOVERNOR.configure(**knobs)
+                self._send_json(200, {"status": "ok",
+                                      "governor": GOVERNOR.describe()})
+                return
             elif mod == "failpoint":
                 from opengemini_tpu.utils import failpoint as _fpmod
 
@@ -772,6 +842,14 @@ def _make_handler(svc: HttpService):
                 )
             except AuthError as e:
                 self._send_err(403, e)
+                return
+            except AdmissionRejected as e:
+                # admission control shed (resource governor): 503 +
+                # Retry-After so well-behaved clients back off instead
+                # of retrying into the same overload
+                self._send_json(
+                    503, {"error": str(e)},
+                    headers={"Retry-After": str(e.retry_after_s)})
                 return
             epoch = params.get("epoch")
             pretty = params.get("pretty") in ("true", "1")
@@ -831,20 +909,25 @@ def _make_handler(svc: HttpService):
                 return
             try:
                 if path == "/api/v1/query_range":
-                    data = svc.prom.query_range(
-                        params.get("query", ""),
-                        _prom_time(params.get("start")),
-                        _prom_time(params.get("end")),
-                        _prom_step(params.get("step")),
-                        db,
-                    )
+                    # PromQL reads scan like any interactive query and must
+                    # take an admission slot — otherwise this surface is an
+                    # ungoverned side door around the /query sheds
+                    with GOVERNOR.admitted():
+                        data = svc.prom.query_range(
+                            params.get("query", ""),
+                            _prom_time(params.get("start")),
+                            _prom_time(params.get("end")),
+                            _prom_step(params.get("step")),
+                            db,
+                        )
                 elif path == "/api/v1/query":
                     t = params.get("time")
-                    data = svc.prom.query_instant(
-                        params.get("query", ""),
-                        _prom_time(t) if t else time_now_s(),
-                        db,
-                    )
+                    with GOVERNOR.admitted():
+                        data = svc.prom.query_instant(
+                            params.get("query", ""),
+                            _prom_time(t) if t else time_now_s(),
+                            db,
+                        )
                 elif path == "/api/v1/labels":
                     data = self._prom_labels(db)
                 elif path == "/api/v1/series":
@@ -855,6 +938,13 @@ def _make_handler(svc: HttpService):
                 else:
                     self._send_json(404, {"status": "error", "error": "not found"})
                     return
+            except AdmissionRejected as e:
+                self._send_json(
+                    503,
+                    {"status": "error", "errorType": "unavailable",
+                     "error": str(e)},
+                    headers={"Retry-After": str(e.retry_after_s)})
+                return
             except (PromError, PromParseError, ValueError, OverflowError, re.error) as e:
                 self._send_json(
                     400, {"status": "error", "errorType": "bad_data", "error": str(e)}
@@ -945,44 +1035,18 @@ def _make_handler(svc: HttpService):
                 except ValueError:
                     self._send_json(400, {"error": "bad cursor"})
                     return
-            # gather per-series arrays; bound python-row materialization to
-            # the page via the (skip + limit + ties)-th smallest timestamp
-            import numpy as _np
-
-            from opengemini_tpu.query.functions import py_value
-
-            series_recs = []
-            all_times = []
-            for sh in svc.engine.shards_of_db(db):
-                for sid in sorted(sh.index.series_ids(mst)):
-                    rec = sh.read_series(mst, sid, from_t, 2**62)
-                    if not len(rec):
-                        continue
-                    series_recs.append((sh.index.tags_of(sid), rec))
-                    all_times.append(rec.times)
-            total = sum(len(t) for t in all_times)
-            need = skip_at_t + limit
-            if total and need < total:
-                merged = _np.concatenate(all_times)
-                kth = _np.partition(merged, need - 1)[need - 1]
-                page_tmax = int(kth)  # inclusive; ties included below
-            else:
-                page_tmax = None
-            rows = []
-            for tags, rec in series_recs:
-                sel = (
-                    _np.nonzero(rec.times <= page_tmax)[0]
-                    if page_tmax is not None
-                    else range(len(rec))
-                )
-                for i in sel:
-                    fields = {
-                        name: py_value(col.values[i])
-                        for name, col in rec.columns.items()
-                        if col.valid[i]
-                    }
-                    rows.append((int(rec.times[i]), tags, fields))
-            rows.sort(key=lambda r: r[0])
+            try:
+                with GOVERNOR.admitted():
+                    rows, total = self._consume_gather(
+                        db, mst, from_t, skip_at_t + limit)
+            except AdmissionRejected as e:
+                # consume decodes every matched series row >= from_t —
+                # an interactive read surface like any other, so it must
+                # take an admission slot rather than bypass the governor
+                self._send_json(
+                    503, {"error": str(e)},
+                    headers={"Retry-After": str(e.retry_after_s)})
+                return
             pos = 0
             remaining_skip = skip_at_t
             while pos < len(rows) and rows[pos][0] == from_t and remaining_skip > 0:
@@ -1005,6 +1069,81 @@ def _make_handler(svc: HttpService):
                 "cursor": next_cursor,
                 "exhausted": total - (skip_at_t - remaining_skip) - len(out) <= 0,
             })
+
+        def _consume_gather(self, db: str, mst: str, from_t: int,
+                            need: int) -> tuple[list, int]:
+            """Materialize one consume page: gather per-series arrays and
+            bound python-row materialization to the page via the
+            `need`-th (= skip + limit, ties included) smallest timestamp.
+            Returns (sorted rows, total matched row count)."""
+            import numpy as _np
+
+            from opengemini_tpu.query.functions import py_value
+
+            series_recs = []
+            all_times = []
+            for sh in svc.engine.shards_of_db(db):
+                for sid in sorted(sh.index.series_ids(mst)):
+                    rec = sh.read_series(mst, sid, from_t, 2**62)
+                    if not len(rec):
+                        continue
+                    series_recs.append((sh.index.tags_of(sid), rec))
+                    all_times.append(rec.times)
+            total = sum(len(t) for t in all_times)
+            if total and need < total:
+                merged = _np.concatenate(all_times)
+                kth = _np.partition(merged, need - 1)[need - 1]
+                page_tmax = int(kth)  # inclusive; ties included below
+            else:
+                page_tmax = None
+            rows = []
+            for tags, rec in series_recs:
+                sel = (
+                    _np.nonzero(rec.times <= page_tmax)[0]
+                    if page_tmax is not None
+                    else range(len(rec))
+                )
+                for i in sel:
+                    fields = {
+                        name: py_value(col.values[i])
+                        for name, col in rec.columns.items()
+                        if col.valid[i]
+                    }
+                    rows.append((int(rec.times[i]), tags, fields))
+            rows.sort(key=lambda r: r[0])
+            return rows, total
+
+        def _logstore(self, method: str, path: str, params: dict) -> None:
+            """Dispatch to the /repo log-mode surface with governor shed
+            mapping: logstore endpoints execute queries through the same
+            admitted executor, so AdmissionRejected must answer 503 +
+            Retry-After here too (not a dropped connection)."""
+            try:
+                handled = svc.logstore.handle(self, method, path, params)
+            except AdmissionRejected as e:
+                self._body()  # drain any unread body: keep-alive correctness
+                self._send_json(
+                    503, {"error": str(e)},
+                    headers={"Retry-After": str(e.retry_after_s)})
+                return
+            if not handled:
+                self._send_json(404, {"error": "not found"})
+
+        def _shed_write_if_backpressured(self) -> bool:
+            """Write-path backpressure (resource governor): when the
+            memtable+WAL backlog is over the high watermark, answer 429 +
+            Retry-After instead of growing RSS unboundedly.  Returns True
+            when the write was shed (response already sent)."""
+            retry_after = GOVERNOR.write_backpressure()
+            if retry_after is None:
+                return False
+            self._body()  # drain the unread body: keep-alive correctness
+            self._send_json(
+                429,
+                {"error": "write backpressure: memtable+WAL backlog over "
+                          "the high watermark; retry later"},
+                headers={"Retry-After": str(retry_after)})
+            return True
 
         def _check_write_auth(self, params: dict, db: str) -> bool:
             user = self._authenticate(params)
@@ -1061,6 +1200,8 @@ def _make_handler(svc: HttpService):
             db = params.get("db", "")
             if not self._check_write_auth(params, db):
                 return
+            if self._shed_write_if_backpressured():
+                return
             try:
                 body = self._maybe_snappy(self._body())
                 points = prom_remote.decode_write_request(body)
@@ -1076,8 +1217,6 @@ def _make_handler(svc: HttpService):
             handler_prom.go servePromRead)."""
             from opengemini_tpu.ingest import prom_remote
             from opengemini_tpu.ingest import protowire as pw
-            from opengemini_tpu.promql.engine import _match_sids
-            from opengemini_tpu.promql.parser import LabelMatcher
 
             db = params.get("db", "")
             user = self._authenticate(params)
@@ -1096,6 +1235,32 @@ def _make_handler(svc: HttpService):
             except pw.WireError as e:
                 self._send_json(400, {"error": f"bad remote read body: {e}"})
                 return
+            try:
+                with GOVERNOR.admitted():
+                    results = self._prom_remote_read_results(db, queries)
+            except AdmissionRejected as e:
+                # remote read materializes full matched series — it must
+                # take an admission slot like every interactive read, not
+                # bypass the governor (body already drained above)
+                self._send_json(
+                    503, {"error": str(e)},
+                    headers={"Retry-After": str(e.retry_after_s)})
+                return
+            payload = prom_remote.encode_read_response(results)
+            from opengemini_tpu.ingest.protowire import snappy_compress_literal
+            out = snappy_compress_literal(payload)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-protobuf")
+            self.send_header("Content-Encoding", "snappy")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def _prom_remote_read_results(self, db, queries) -> list:
+            from opengemini_tpu.ingest import prom_remote
+            from opengemini_tpu.promql.engine import _match_sids
+            from opengemini_tpu.promql.parser import LabelMatcher
+
             MS = 1_000_000
             results = []
             for q in queries:
@@ -1131,15 +1296,7 @@ def _make_handler(svc: HttpService):
                         labels["__name__"] = metric
                         series_out.append((labels, sorted(samples)))
                 results.append(series_out)
-            payload = prom_remote.encode_read_response(results)
-            from opengemini_tpu.ingest.protowire import snappy_compress_literal
-            out = snappy_compress_literal(payload)
-            self.send_response(200)
-            self.send_header("Content-Type", "application/x-protobuf")
-            self.send_header("Content-Encoding", "snappy")
-            self.send_header("Content-Length", str(len(out)))
-            self.end_headers()
-            self.wfile.write(out)
+            return results
 
         def _handle_otlp_metrics(self, params: dict) -> None:
             """OTLP/HTTP metrics export (protobuf body, optional gzip)
@@ -1149,6 +1306,8 @@ def _make_handler(svc: HttpService):
 
             db = params.get("db", "")
             if not self._check_write_auth(params, db):
+                return
+            if self._shed_write_if_backpressured():
                 return
             try:
                 points = otlp.decode_metrics_request(self._body())
@@ -1183,6 +1342,8 @@ def _make_handler(svc: HttpService):
                     return
             if not db:
                 self._send_json(400, {"error": "database is required"})
+                return
+            if self._shed_write_if_backpressured():
                 return
             precision = params.get("precision", "ns")
             if precision == "n":

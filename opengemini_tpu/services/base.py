@@ -14,6 +14,14 @@ logger = logging.getLogger("opengemini_tpu.services")
 
 class Service:
     name = "service"
+    # governed services (compaction/downsample/stream/CQ) acquire a
+    # low-priority token from the resource governor per tick and pause
+    # while interactive query occupancy is high or an IO alarm is recent
+    # (utils/governor.py background throttling; pass-through when the
+    # governor is disabled).  Watchdog-style services (iodetector,
+    # sherlock, monitor) stay ungoverned — pausing them under load would
+    # blind the diagnostics exactly when they matter.
+    governed = False
 
     def __init__(self, interval_s: float):
         self.interval_s = interval_s
@@ -38,13 +46,29 @@ class Service:
             self._thread = None
 
     def tick(self) -> None:
-        """Run one iteration synchronously (tests and manual triggers)."""
+        """Run one iteration synchronously (tests and manual triggers).
+        Deliberately ungated: a manual trigger expresses operator intent,
+        and tests need deterministic ticks."""
         self.handle()
+
+    def _governed_tick(self) -> None:
+        if not self.governed:
+            self.handle()
+            return
+        from opengemini_tpu.utils.governor import GOVERNOR
+
+        token = GOVERNOR.acquire_background(self.name, stop=self._stop)
+        if token is None:
+            return  # stopping while paused: skip the tick entirely
+        try:
+            self.handle()
+        finally:
+            token.release()
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
             try:
-                self.handle()
+                self._governed_tick()
             except Exception as e:  # noqa: BLE001 — service loops never die
                 try:
                     from opengemini_tpu.utils import errno as _errno

@@ -19,6 +19,9 @@ from opengemini_tpu.utils.stats import GLOBAL as _STATS
 
 class CompactionService(Service):
     name = "compaction"
+    # low-priority: ticks acquire a governor background token and pause
+    # under interactive load / IO alarms (utils/governor.py)
+    governed = True
 
     def __init__(self, engine, interval_s: float = 600.0, max_files: int = 4):
         super().__init__(interval_s)

@@ -24,6 +24,10 @@ logger = logging.getLogger("opengemini_tpu.services.cq")
 
 class ContinuousQueryService(Service):
     name = "continuousquery"
+    # a CQ is a real query (scan + aggregate + write-back), not a
+    # watchdog: pause it while interactive occupancy is high, like
+    # compaction/downsample
+    governed = True
 
     def __init__(self, engine, executor, interval_s: float = 10.0,
                  meta_store=None):
@@ -76,7 +80,32 @@ class ContinuousQueryService(Service):
         if end <= start or (cq.last_run_ns and now_ns - cq.last_run_ns < run_every):
             return False
         bounded = _with_time_bounds(stmt, start, end)
-        self.executor.execute_statement(bounded, db, now_ns)
+        # a CQ takes a (background-priority) admission slot and a
+        # tracker qid like any client query: without these it would
+        # bypass the governor's occupancy accounting AND the
+        # reservation overdraft-kill (qid=None skips it), letting a
+        # heavy CQ blow the memory ceiling while client traffic is
+        # being shed.  AdmissionRejected skips the run; last_run_ns
+        # stays put so the window is retried next tick.
+        from opengemini_tpu.utils.governor import GOVERNOR, AdmissionRejected
+        from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER
+
+        try:
+            token = GOVERNOR.admit(kind="background")
+        except AdmissionRejected:
+            return False
+        qid = None
+        try:
+            if GOVERNOR.enabled():
+                # tracker registration only when governed: pass-through
+                # must keep /debug/queries (and every other observable)
+                # bit-identical to the pre-governor tree
+                qid = TRACKER.register(cq.select_text, db)
+            self.executor.execute_statement(bounded, db, now_ns)
+        finally:
+            if qid is not None:
+                TRACKER.unregister(qid)
+            token.release()
         cq.last_run_ns = now_ns
         return True
 

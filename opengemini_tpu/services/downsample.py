@@ -9,6 +9,9 @@ from opengemini_tpu.services.base import Service
 
 class DownsampleService(Service):
     name = "downsample"
+    # low-priority: ticks acquire a governor background token and pause
+    # under interactive load / IO alarms (utils/governor.py)
+    governed = True
 
     def __init__(self, engine, interval_s: float = 3600.0):
         super().__init__(interval_s)

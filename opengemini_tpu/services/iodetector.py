@@ -34,6 +34,7 @@ class IoDetectorService(Service):
             # previous probe still stuck in fsync: the disk is still hung;
             # count the repeat alarm but don't stack another blocked thread
             self.alarms += 1
+            self._note_alarm()
             logger.error("iodetector: previous probe still hung (alarm #%d)",
                          self.alarms)
             if self.fatal:
@@ -61,6 +62,7 @@ class IoDetectorService(Service):
         ok = done.wait(self.probe_timeout_s) and not err
         if not ok:
             self.alarms += 1
+            self._note_alarm()
             logger.error(
                 "iodetector: disk probe %s after %.1fs (alarm #%d)",
                 "failed" if err else "hung", self.probe_timeout_s, self.alarms,
@@ -69,3 +71,12 @@ class IoDetectorService(Service):
                 logger.critical("iodetector: fatal — exiting for failover")
                 os._exit(3)
         return ok
+
+    @staticmethod
+    def _note_alarm() -> None:
+        """Feed the resource governor: a hung disk pauses background
+        compaction/downsample/stream work so the recovering volume serves
+        interactive traffic and flushes first (utils/governor.py)."""
+        from opengemini_tpu.utils.governor import GOVERNOR
+
+        GOVERNOR.note_io_alarm()

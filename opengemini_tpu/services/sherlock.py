@@ -42,10 +42,54 @@ class SherlockService(Service):
         self.cooldown_s = cooldown_s
         self._last_dump = float("-inf")  # monotonic() epoch is arbitrary
         self.dumps = 0
+        # serializes the cooldown check+commit AND the dump itself: the
+        # governor burst hook (diagnose, its own thread) races the
+        # service tick (handle), and one window must yield ONE dump
+        import threading
+
+        self._dump_lock = threading.Lock()
         if enable_tracemalloc:  # ~2x alloc overhead; opt-in like pprof heap
             import tracemalloc
 
             tracemalloc.start(10)
+    def start(self) -> None:
+        # a governor shed/kill burst triggers a dump (already rate-limited
+        # on the governor side; our own cooldown still applies): the
+        # moment load is being shed is exactly when the operator needs
+        # thread stacks + the ledger on disk.  Registered here, not in
+        # __init__: the process-global hook must not outlive (or pin) an
+        # instance that was never run
+        from opengemini_tpu.utils.governor import GOVERNOR
+
+        GOVERNOR.set_diagnostic_hook(self.diagnose)
+        super().start()
+
+    def stop(self) -> None:
+        from opengemini_tpu.utils.governor import GOVERNOR
+
+        GOVERNOR.detach_diagnostic_hook(self.diagnose)
+        super().stop()
+
+    def diagnose(self, reason: str) -> str | None:
+        """Force a diagnostic dump for an external trigger (the governor's
+        shed/kill burst hook).  Honors the dump cooldown."""
+        import threading
+
+        return self._maybe_dump(reason, _rss_mb(), threading.active_count())
+
+    def _maybe_dump(self, trigger: str, rss: float,
+                    n_threads: int) -> str | None:
+        """Cooldown-gated dump, safe against handle()/diagnose() racing
+        from different threads.  The cooldown/counter commit only after
+        the dump lands on disk: a failed dump (disk full) must not burn
+        the window unretried."""
+        with self._dump_lock:
+            if _time.monotonic() - self._last_dump < self.cooldown_s:
+                return None
+            path = self._dump(trigger, rss, n_threads)
+            self._last_dump = _time.monotonic()
+            self.dumps += 1
+            return path
 
     def handle(self) -> str | None:
         import threading
@@ -59,15 +103,7 @@ class SherlockService(Service):
             trigger = f"threads {n_threads} > {self.thread_watermark}"
         if trigger is None:
             return None
-        now = _time.monotonic()
-        if now - self._last_dump < self.cooldown_s:
-            return None
-        # commit cooldown/counter only after the dump lands on disk: a
-        # failed dump (disk full) must not burn the window unretried
-        path = self._dump(trigger, rss, n_threads)
-        self._last_dump = now
-        self.dumps += 1
-        return path
+        return self._maybe_dump(trigger, rss, n_threads)
 
     def _dump(self, trigger: str, rss: float, n_threads: int) -> str:
         out_dir = os.path.join(self.engine.root, "sherlock")
@@ -76,6 +112,18 @@ class SherlockService(Service):
         with open(path, "w", encoding="utf-8") as f:
             f.write(f"sherlock dump — trigger: {trigger}\n")
             f.write(f"rss_mb={rss:.1f} threads={n_threads}\n\n")
+            try:
+                # the governor ledger snapshot: which component holds the
+                # memory / what the admission state was at dump time
+                from opengemini_tpu.utils.governor import GOVERNOR
+
+                f.write("== governor ==\n")
+                import json as _json
+
+                f.write(_json.dumps(GOVERNOR.describe(), indent=1))
+                f.write("\n\n")
+            except Exception:  # noqa: BLE001 — diagnostics best-effort
+                pass
             f.write("== thread stacks ==\n")
             for tid, frame in sys._current_frames().items():
                 f.write(f"\n-- thread {tid} --\n")
@@ -90,5 +138,8 @@ class SherlockService(Service):
                         f.write(f"{stat}\n")
             except Exception:  # noqa: BLE001
                 pass
+        from opengemini_tpu.utils.stats import GLOBAL as _STATS
+
+        _STATS.incr("sherlock", "sherlock_dumps")
         logger.warning("sherlock: dumped diagnostics to %s (%s)", path, trigger)
         return path

@@ -82,6 +82,10 @@ class _ValidateTask:
 
 class StreamService(Service):
     name = "stream"
+    # low-priority: window-flush ticks acquire a governor background
+    # token and pause under interactive load / IO alarms
+    # (utils/governor.py); ingest-side fold stays on the write path
+    governed = True
 
     def __init__(self, engine, interval_s: float = 5.0):
         super().__init__(interval_s)

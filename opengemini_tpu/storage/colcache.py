@@ -68,20 +68,11 @@ import threading
 import time
 from collections import OrderedDict
 
+from opengemini_tpu.utils.governor import _env_int
 from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
 from opengemini_tpu.utils.stats import GLOBAL as _STATS
 
 _DEFAULT_MB = 256
-
-
-def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    try:
-        return max(0, int(raw))
-    except ValueError:
-        return default
 
 
 def _nbytes(val) -> int:
@@ -125,11 +116,13 @@ class ColumnCache:
         self._dev: OrderedDict = OrderedDict()  # token -> (entry, nbytes)
         self._dev_bytes = 0
         if budget_mb is None:
-            budget_mb = _env_int("OGT_COLCACHE_MB", _DEFAULT_MB)
+            budget_mb = max(0, _env_int("OGT_COLCACHE_MB", _DEFAULT_MB))
         if device is None:
             device = os.environ.get("OGT_COLCACHE_DEVICE", "0") not in ("", "0")
         if device_budget_mb is None:
-            device_budget_mb = _env_int("OGT_COLCACHE_DEVICE_MB", budget_mb)
+            device_budget_mb = max(0,
+                                   _env_int("OGT_COLCACHE_DEVICE_MB",
+                                            budget_mb))
         self._budget = int(budget_mb) << 20
         self._dev_budget = int(device_budget_mb) << 20
         self._device = bool(device)
@@ -390,6 +383,17 @@ class ColumnCache:
             snap.setdefault(k, 0)
         return snap
 
+    def ledger_bytes(self) -> int:
+        """Host-tier resident bytes (resource-governor ledger component,
+        utils/governor.py)."""
+        with self._lock:
+            return self._host_bytes
+
+    def device_ledger_bytes(self) -> int:
+        """Device-tier resident bytes (resource-governor ledger)."""
+        with self._lock:
+            return self._dev_bytes
+
     def _publish_locked(self) -> None:
         _STATS.set("colcache", "bytes", self._host_bytes)
         _STATS.set("colcache", "device_bytes", self._dev_bytes)
@@ -402,3 +406,14 @@ class ColumnCache:
 
 # process-wide cache (the reference's readcache singleton)
 GLOBAL = ColumnCache()
+
+
+def _register_with_governor() -> None:
+    # both cache tiers join the unified memory ledger
+    from opengemini_tpu.utils.governor import GOVERNOR
+
+    GOVERNOR.register_component("colcache_host", GLOBAL.ledger_bytes)
+    GOVERNOR.register_component("colcache_device", GLOBAL.device_ledger_bytes)
+
+
+_register_with_governor()

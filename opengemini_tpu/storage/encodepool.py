@@ -39,6 +39,7 @@ import threading
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
+from opengemini_tpu.utils.governor import InflightGauge
 from opengemini_tpu.utils.stats import GLOBAL as _STATS
 
 
@@ -58,6 +59,19 @@ _pool_lock = threading.Lock()
 # thread-local, NOT process-global: a bench/test A-B block must not
 # degrade a concurrent flush on another thread to serial encode
 _serial_local = threading.local()
+
+# process-wide in-flight encode-input-bytes gauge: every pipe
+# contributes, so the resource governor's unified ledger
+# (utils/governor.py) sees the encode stage's live memory footprint
+_inflight = InflightGauge()
+_note_inflight = _inflight.note
+
+
+def total_inflight_bytes() -> int:
+    """Estimated encode-input bytes in flight across ALL open pipes.
+    (Named to avoid shadowing by OrderedEncodePipe's `inflight_bytes`
+    budget parameter.)"""
+    return _inflight.total()
 
 
 def enabled() -> bool:
@@ -123,12 +137,16 @@ class OrderedEncodePipe:
             self._drain_one()
         self._pending.append((self._p.submit(job), est_bytes))
         self._inflight += est_bytes
+        _note_inflight(est_bytes)
         _STATS.set("encodepool", "queue_depth", len(self._pending))
 
     def _drain_one(self) -> None:
         fut, nb = self._pending.popleft()
-        out = fut.result()  # worker exceptions surface on the writer thread
-        self._inflight -= nb
+        try:
+            out = fut.result()  # worker exceptions surface on the writer thread
+        finally:
+            self._inflight -= nb
+            _note_inflight(-nb)
         _STATS.set("encodepool", "queue_depth", len(self._pending))
         self._consume(out)
 
@@ -140,7 +158,18 @@ class OrderedEncodePipe:
     def abort(self) -> None:
         """Cancel pending jobs (writer abort). Running jobs finish into
         discarded futures; their results are never consumed."""
-        for fut, _nb in self._pending:
+        for fut, nb in self._pending:
             fut.cancel()
+            _note_inflight(-nb)
         self._pending.clear()
         self._inflight = 0
+
+
+def _register_with_governor() -> None:
+    # encode-stage in-flight bytes join the unified memory ledger
+    from opengemini_tpu.utils.governor import GOVERNOR
+
+    GOVERNOR.register_component("encodepool", total_inflight_bytes)
+
+
+_register_with_governor()

@@ -243,6 +243,13 @@ class Engine:
         # provider; close() unregisters so dead engines drop out)
         self._durability_provider = self._durability_gauges
         STATS.register_provider("durability", self._durability_provider)
+        # memtable+WAL backlog joins the resource governor's unified
+        # memory ledger and drives the /write backpressure watermark
+        # (utils/governor.py; multiple engines sum process-wide)
+        from opengemini_tpu.utils.governor import GOVERNOR as _GOVERNOR
+
+        self._governor_provider = self.mem_backlog_bytes
+        _GOVERNOR.register_component("memtable", self._governor_provider)
 
     # -- metadata -----------------------------------------------------------
 
@@ -1350,6 +1357,14 @@ class Engine:
     def _durability_gauges(self) -> dict:
         return self.durability_snapshot()["totals"]
 
+    def mem_backlog_bytes(self) -> int:
+        """Un-flushed resident bytes (live + frozen memtables + live WAL
+        logs) across every shard — the write-backpressure input of the
+        resource governor's ledger (utils/governor.py)."""
+        with self._lock:
+            shards = list(self._shards.values())
+        return sum(sh.mem_backlog_bytes() for sh in shards)
+
     def drop_expired_shards(self, now_ns: int | None = None) -> list[tuple[str, str, int]]:
         """Retention enforcement (reference services/retention/service.go:81):
         drop shards whose whole range is past the RP duration."""
@@ -1391,6 +1406,9 @@ class Engine:
 
     def close(self) -> None:
         STATS.unregister_provider("durability", self._durability_provider)
+        from opengemini_tpu.utils.governor import GOVERNOR as _GOVERNOR
+
+        _GOVERNOR.unregister_component("memtable", self._governor_provider)
         # the HTTP layer may have pointed the process-global querytracker
         # at this engine's ledger: a closed engine must neither serve
         # frozen durability state as live nor stay pinned in memory

@@ -303,5 +303,14 @@ class MemTable:
         # builder rows are newer than every slab (freeze rule) -> merge last
         return merge_sorted_records([srec, brec])
 
+    @property
+    def backlog_bytes(self) -> int:
+        """Estimated resident bytes of this (live or frozen) memtable —
+        the unit the resource governor's unified ledger and the write
+        backpressure watermark account in (utils/governor.py).  Same
+        estimate the flush threshold uses (approx_bytes), exposed under
+        one name so every accounting site agrees."""
+        return self.approx_bytes
+
     def __len__(self) -> int:
         return self.row_count

@@ -43,6 +43,7 @@ import threading
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
+from opengemini_tpu.utils.governor import InflightGauge
 from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
 
 
@@ -64,6 +65,19 @@ _pool_lock = threading.Lock()
 # thread-local, NOT process-global: a bench/test A-B block must not
 # degrade concurrent queries on other server threads to serial decode
 _serial_local = threading.local()
+
+# process-wide in-flight decoded-bytes gauge: every map_ordered pipeline
+# contributes, so the resource governor's unified ledger
+# (utils/governor.py) sees the scan stage's live memory footprint
+_inflight = InflightGauge()
+_note_inflight = _inflight.note
+
+
+def total_inflight_bytes() -> int:
+    """Estimated decoded bytes currently in flight across ALL scans.
+    (Named to avoid shadowing by map_ordered's `inflight_bytes` cap
+    parameter.)"""
+    return _inflight.total()
 
 
 def enabled() -> bool:
@@ -144,18 +158,23 @@ def map_ordered(jobs, est_bytes=None, inflight_bytes: int | None = None):
                 _TRACKER.check()
                 pending.append((p.submit(run, jobs[i]), est[i]))
                 inflight += est[i]
+                _note_inflight(est[i])
                 i += 1
             fut, nb = pending.popleft()
-            out = fut.result()
-            inflight -= nb
+            try:
+                out = fut.result()
+            finally:
+                inflight -= nb
+                _note_inflight(-nb)
             _TRACKER.check()
             yield out
     finally:
         # consumer abandoned mid-scan (exception, KILL, early close):
         # cancel everything not yet running; running jobs finish into
         # discarded futures (their own kill check stops killed queries)
-        for fut, _nb in pending:
+        for fut, nb in pending:
             fut.cancel()
+            _note_inflight(-nb)
 
 
 def prefetch_ordered(thunks, depth: int = 2):
@@ -227,3 +246,13 @@ def est_chunk_bytes(chunk, n_fields: int | None) -> int:
     the time (and sid, when packed) arrays."""
     cols = (n_fields if n_fields is not None else max(len(chunk.cols), 1)) + 2
     return chunk.rows * 9 * cols
+
+
+def _register_with_governor() -> None:
+    # scan-stage in-flight bytes join the unified memory ledger
+    from opengemini_tpu.utils.governor import GOVERNOR
+
+    GOVERNOR.register_component("scanpool", total_inflight_bytes)
+
+
+_register_with_governor()

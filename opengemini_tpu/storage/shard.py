@@ -1108,6 +1108,14 @@ class Shard:
                 tmax = m.max_time if tmax is None else max(tmax, m.max_time)
         return tmin, tmax
 
+    def mem_backlog_bytes(self) -> int:
+        """Un-flushed resident bytes: live + frozen memtables plus the
+        live WAL log.  LOCK-FREE (one _frozen tuple read + int reads) —
+        the resource governor polls this on every governed /write
+        (utils/governor.py write watermark; engine sums it per process)."""
+        return (sum(m.backlog_bytes for m in self._mem_parts())
+                + self.wal.backlog_bytes)
+
     def measurements(self) -> list[str]:
         msts = set(self.index.measurements())
         for r in self._files:

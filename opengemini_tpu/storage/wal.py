@@ -80,6 +80,14 @@ class WAL:
         self._seq = 0
         self._synced = 0
         self._syncing = False
+        # live-log byte backlog (resource-governor write watermark,
+        # utils/governor.py): bytes framed since the last rotate/truncate.
+        # Seeded from the on-disk size so a reopened shard's un-flushed
+        # log still counts against the ceiling.
+        try:
+            self.backlog_bytes = os.path.getsize(path)
+        except OSError:
+            self.backlog_bytes = 0
 
     def _frame(self, kind: int, payload: bytes) -> int:
         """Write one entry; return its commit ticket (0 when sync is off).
@@ -87,6 +95,7 @@ class WAL:
         crc = zlib.crc32(payload)
         _STATS.incr("wal", "appends")
         _STATS.incr("wal", "bytes", _HEADER.size + len(payload))
+        self.backlog_bytes += _HEADER.size + len(payload)
         self._f.write(_HEADER.pack(len(payload), crc, kind) + payload)
         _fp("wal-after-append")  # entry framed, not yet fsynced/acked
         if not self.sync:
@@ -190,6 +199,7 @@ class WAL:
             _fp("wal-rotate-after-rename")  # segment named, no live log yet
             self._f = open(self.path, "wb")
             self._synced = self._seq  # the segment fsync covered them all
+            self.backlog_bytes = 0  # the frozen memtable now carries them
             _STATS.incr("wal", "rotations")
             return seg_path
 
@@ -243,6 +253,7 @@ class WAL:
             self._f.flush()
             os.fsync(self._f.fileno())
             self._synced = self._seq
+            self.backlog_bytes = 0
 
     @staticmethod
     def replay(path: str):

@@ -30,6 +30,13 @@ site (1-based) and count hits otherwise — "panic#3" crashes the third
 time the site is reached, which is how the torture harness randomizes
 kill points along one code path.
 
+Beyond the storage lock-handoff sites (PR 4), every resource-governor
+decision edge is a site (utils/governor.py): governor-admit,
+governor-queue, governor-shed, governor-overdraft-kill,
+governor-backpressure-on, governor-backpressure-off — arm "wait:"
+actions there to pin admission/shed interleavings deterministically
+(catalogued with the storage sites in README.md).
+
 Counts are recorded per site for assertions, and every hit of an ARMED
 site (plus every site when record_all(True)) is appended to a global
 ordering log — (seq, site, thread) — so schedule tests can assert WHICH

@@ -42,6 +42,9 @@ class QueryTracker:
         # acked-vs-durable ledger so an operator sees loss the moment a
         # query would observe it (PR 4)
         self._durability_provider = None
+        # optional () -> dict hook (governor.admission_snapshot): pairs
+        # the running queries with the admission queue/slot state (PR 5)
+        self._admission_provider = None
 
     def register(self, text: str, db: str) -> int:
         with self._lock:
@@ -136,10 +139,15 @@ class QueryTracker:
         if self._durability_provider == fn:
             self._durability_provider = None
 
+    def set_admission_provider(self, fn) -> None:
+        """fn() -> governor.admission_snapshot()-shaped dict (None to
+        detach)."""
+        self._admission_provider = fn
+
     def full_snapshot(self) -> dict:
-        """Monitoring snapshot: running queries plus a `durability`
-        section from the registered provider (empty dict when no engine
-        attached or the provider fails — monitoring must never raise)."""
+        """Monitoring snapshot: running queries plus `durability` and
+        `admission` sections from the registered providers (empty dicts
+        when unattached or failing — monitoring must never raise)."""
         durability: dict = {}
         fn = self._durability_provider
         if fn is not None:
@@ -147,7 +155,15 @@ class QueryTracker:
                 durability = fn()
             except Exception:  # noqa: BLE001 — see docstring
                 durability = {}
-        return {"queries": self.snapshot(), "durability": durability}
+        admission: dict = {}
+        fn = self._admission_provider
+        if fn is not None:
+            try:
+                admission = fn()
+            except Exception:  # noqa: BLE001 — see docstring
+                admission = {}
+        return {"queries": self.snapshot(), "durability": durability,
+                "admission": admission}
 
 
 # process-wide tracker (like the reference's per-node query manager)

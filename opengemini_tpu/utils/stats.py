@@ -91,3 +91,14 @@ def _failpoint_hits() -> dict:
 # failpoint hit counts ride every stats snapshot (/debug/vars): the
 # torture harness and operators can see WHICH armed sites actually fired
 GLOBAL.register_provider("failpoints", _failpoint_hits)
+
+
+def _governor_gauges() -> dict:
+    from opengemini_tpu.utils import governor
+
+    return governor.GOVERNOR.gauges()
+
+
+# governor ledger/admission gauges ride /debug/vars when the governor is
+# enabled (OGT_MEM_BUDGET_MB set); the provider answers {} pass-through
+GLOBAL.register_provider("governor", _governor_gauges)

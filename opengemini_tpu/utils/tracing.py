@@ -65,14 +65,20 @@ class Trace:
         return lines
 
 
-def _record_stage(name: str, elapsed_ns: int) -> None:
+def record_stage(name: str, elapsed_ns: int) -> None:
     """Cumulative per-stage timings in the statistics registry — the
     operator-facing counterpart of EXPLAIN ANALYZE (reference:
-    executor_statistics.go per-transform counters)."""
+    executor_statistics.go per-transform counters).  Public: stages that
+    happen OUTSIDE a live trace (the governor's admission wait precedes
+    statement execution) record through here so /debug/vars carries them
+    alongside the span-recorded stages."""
     from opengemini_tpu.utils.stats import GLOBAL as STATS
 
     STATS.incr("query_stages", f"{name}_ns", elapsed_ns)
     STATS.incr("query_stages", f"{name}_count")
+
+
+_record_stage = record_stage  # internal alias (span finish path)
 
 
 class NoopTrace:

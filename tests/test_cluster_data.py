@@ -714,6 +714,240 @@ class TestHintedHandoff:
             os.path.join(router._hints_dir(), "nB.jsonl"))
         eng.close()
 
+    def test_replica_backpressure_429_hinted_not_hard(self, tmp_path):
+        """A replica shedding write backpressure (HTTP 429, resource
+        governor) is transiently unreachable, NOT a poison rejection:
+        the write acks at consistency=one on the local copy and the
+        remote copy rides the hint queue."""
+        import os
+        import urllib.error
+
+        from opengemini_tpu.parallel.cluster import DataRouter
+
+        eng = Engine(str(tmp_path / "bp"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nA": {"addr": "hA:1", "role": "data"},
+                     "nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1", rf=2)
+
+        def shed(nid, db, rp, pts):
+            raise urllib.error.HTTPError(
+                "http://x", 429, "write backpressure",
+                {"Retry-After": "2"}, None)
+
+        router.forward_points = shed
+        n = router.routed_write("db", None, [
+            ("m", (), BASE * NS, {"v": (FieldType.FLOAT, 1.0)})])
+        assert n == 2  # local synchronous copy + hinted replica copy
+        assert os.path.exists(
+            os.path.join(router._hints_dir(), "nB.jsonl"))
+        eng.close()
+
+    def test_hint_replay_keeps_429_queued(self, tmp_path):
+        """Hint replay treats a replica's 429 as 'still overloaded':
+        the copy stays queued for the next tick instead of being
+        dropped as poison."""
+        import urllib.error
+
+        from opengemini_tpu.parallel.cluster import DataRouter
+
+        eng = Engine(str(tmp_path / "bq"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1", rf=2)
+        pts = [("m", (), BASE * NS, {"v": (FieldType.FLOAT, 1.0)})]
+        router.hint("nB", "db", None, pts)
+        delivered = []
+
+        def shed(nid, db, rp, p):
+            raise urllib.error.HTTPError(
+                "http://x", 429, "write backpressure", {}, None)
+
+        router.forward_points = shed
+        assert router.replay_hints() == 0
+        assert "nB" in router.pending_hint_nodes()
+        router.forward_points = lambda nid, db, rp, p: delivered.append(p)
+        assert router.replay_hints() == 1
+        assert delivered and "nB" not in router.pending_hint_nodes()
+        eng.close()
+
+    def test_transient_replica_5xx_hinted_not_hard(self, tmp_path):
+        """A replica answering 500/503 (restart, disk hiccup, proxy) is
+        transiently unreachable like a connection error — the write acks
+        on the local copy and the remote copy rides the hint queue;
+        only a 400 (deterministic payload rejection) is poison."""
+        import os
+        import urllib.error
+
+        from opengemini_tpu.parallel.cluster import DataRouter
+
+        eng = Engine(str(tmp_path / "t5"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nA": {"addr": "hA:1", "role": "data"},
+                     "nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1", rf=2)
+
+        def err(nid, db, rp, pts):
+            raise urllib.error.HTTPError("http://x", 503, "restarting",
+                                         {}, None)
+
+        router.forward_points = err
+        n = router.routed_write("db", None, [
+            ("m", (), BASE * NS, {"v": (FieldType.FLOAT, 1.0)})])
+        assert n == 2  # local synchronous copy + hinted replica copy
+        assert os.path.exists(
+            os.path.join(router._hints_dir(), "nB.jsonl"))
+        eng.close()
+
+    def test_hint_replay_transient_kept_poison_dropped(self, tmp_path):
+        """Replay keeps hints queued across transient rejections (403
+        during a token rotation, 5xx) — a hinted copy may BE the ack at
+        consistency=any — and drops only deterministic 400 poison."""
+        import urllib.error
+
+        from opengemini_tpu.parallel.cluster import DataRouter
+
+        eng = Engine(str(tmp_path / "tk"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1", rf=2)
+        pts = [("m", (), BASE * NS, {"v": (FieldType.FLOAT, 1.0)})]
+        router.hint("nB", "db", None, pts)
+
+        def reject(code, msg):
+            def f(nid, db, rp, p):
+                raise urllib.error.HTTPError("http://x", code, msg, {}, None)
+            return f
+
+        router.forward_points = reject(403, "bad cluster token")
+        assert router.replay_hints() == 0
+        assert "nB" in router.pending_hint_nodes()
+        router.forward_points = reject(500, "internal")
+        assert router.replay_hints() == 0
+        assert "nB" in router.pending_hint_nodes()
+        router.forward_points = reject(400, "bad points")
+        assert router.replay_hints() == 0
+        assert "nB" not in router.pending_hint_nodes()  # poison dropped
+        eng.close()
+
+    def test_scan_fails_over_on_replica_http_500(self, tmp_path):
+        """A peer that is TCP-alive but persistently erroring on
+        /internal/scan (disk fault, bug) is treated like a dead node:
+        rf>1 failover serves the query from the surviving owners instead
+        of failing it cluster-wide.  Governor sheds (429/503) stay clean
+        retryable query errors — never node-down."""
+        import urllib.error
+
+        import pytest as _p
+
+        from opengemini_tpu.parallel.cluster import DataRouter, RemoteScanError
+
+        eng = Engine(str(tmp_path / "sf"))
+        eng.create_database("db")
+
+        class FsmStub:
+            nodes = {"nA": {"addr": "hA:1", "role": "data"},
+                     "nB": {"addr": "hB:1", "role": "data"}}
+
+        class StoreStub:
+            fsm = FsmStub()
+
+        router = DataRouter(eng, StoreStub(), "nA", "hA:1", rf=2)
+
+        def post_500(addr, body):
+            raise urllib.error.HTTPError("http://x", 500, "disk fault",
+                                         {}, None)
+
+        router._post_scan = post_500
+        shards, live = router.scan_shards("db", None, "m", 0, BASE * NS)
+        assert shards == [] and live == ["nA"]  # sick peer dropped, no error
+
+        def post_shed(addr, body):
+            raise urllib.error.HTTPError("http://x", 503, "query shed",
+                                         {"Retry-After": "1"}, None)
+
+        router._post_scan = post_shed
+        with _p.raises(RemoteScanError, match="rejected scan"):
+            router.scan_shards("db", None, "m", 0, BASE * NS)
+        eng.close()
+
+    def test_internal_write_status_contract(self, tmp_path):
+        """/internal/write's statuses ARE the coordinator's poison
+        classification: 400 = deterministic rejection of this payload
+        (bad points, field-type conflict, unknown rp — drop/fail it),
+        404 = db missing (meta propagation lag: keep the hint),
+        403 = cluster token only (transient rotation window)."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        from opengemini_tpu.parallel.cluster import encode_points
+        from opengemini_tpu.server.http import HttpService
+
+        eng = Engine(str(tmp_path / "iw"))
+        eng.create_database("db")
+        eng.write_lines("db", f"m v=1.0 {BASE * NS}")  # v is FLOAT
+        svc = HttpService(eng, "127.0.0.1", 0)
+        svc.start()
+
+        def post(doc):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{svc.port}/internal/write",
+                data=_json.dumps(doc).encode(), method="POST")
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+
+        def pts(ft, val):
+            return encode_points(
+                [("m", (), (BASE + 1) * NS, {"v": (ft, val)})])
+
+        try:
+            ok = post({"db": "db", "points": pts(FieldType.FLOAT, 2.0)})
+            assert ok == 200
+            # field-type conflict: deterministic -> 400, never a crash
+            conflict = post(
+                {"db": "db", "points": pts(FieldType.STRING, "x")})
+            assert conflict == 400
+            # unknown rp: deterministic -> 400 (was 403, which the
+            # coordinator must reserve for token-rotation transients)
+            assert post({"db": "db", "rp": "nosuch",
+                         "points": pts(FieldType.FLOAT, 3.0)}) == 400
+            # db missing on this replica: meta lag -> 404, hint kept
+            assert post({"db": "nodb",
+                         "points": pts(FieldType.FLOAT, 3.0)}) == 404
+            assert post({"db": "db", "points": [["m"]]}) == 400
+        finally:
+            svc.stop()
+            eng.close()
+
     def test_hints_appended_mid_replay_survive(self, tmp_path):
         from opengemini_tpu.parallel.cluster import DataRouter
 

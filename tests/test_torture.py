@@ -82,6 +82,14 @@ def test_kill_site_catalog_matches_armed_sites():
     # object store, so a kill armed there would never fire
     not_on_chain = {"objstore-get-torn", "objstore-get-missing",
                     "objstore-put-torn"}
+    # resource-governor decision edges (utils/governor.py): admission/
+    # shed/backpressure control flow, not durability lock handoffs — the
+    # torture child runs ungoverned (OGT_MEM_BUDGET_MB unset), so a kill
+    # armed there would never fire; their schedule control is exercised
+    # by tests/test_governor.py instead
+    not_on_chain |= {"governor-admit", "governor-queue", "governor-shed",
+                     "governor-overdraft-kill", "governor-backpressure-on",
+                     "governor-backpressure-off"}
     untortured = armed - set(KILL_SITES) - not_on_chain
     assert not untortured, (
         f"armed sites missing from the torture kill rotation: {untortured}")

@@ -1,0 +1,285 @@
+"""Closed-loop multi-client HTTP load generator.
+
+Drives a running opengemini-tpu HTTP endpoint with a mixed write/query
+workload from N concurrent closed-loop clients (each sends, waits for
+the response, optionally paces to a target QPS, repeats), recording
+per-class latency histograms (p50/p95/p99), shed counts (HTTP 429/503
+from the resource governor, utils/governor.py), and error counts.
+
+Used three ways:
+  - `tests/test_governor.py` overload soak: writers + queries against a
+    tiny `OGT_MEM_BUDGET_MB` — no OOM, no deadlock, every acked write
+    durable, shed requests carry Retry-After;
+  - `bench.py overload_shed` metric (32 clients vs a small budget:
+    shed rate, admitted-query p99, peak RSS vs budget);
+  - standalone CLI:
+      python tools/loadgen.py --host 127.0.0.1 --port 8086 --db load \
+          --clients 32 --duration 10 --write-frac 0.6
+
+Durability accounting: client i writes rows with tag client=c<i> and a
+unique per-client timestamp (seq-derived), and records each ACKED batch
+(seq range) — so a verifier can prove every acked row is readable
+afterwards (the same acked-row contract the torture harness checks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import threading
+import time
+
+
+def percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list (0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1, max(0, int(len(sorted_vals) * q / 100.0)))
+    return sorted_vals[k]
+
+
+def _lat_summary(lat_s: list[float]) -> dict:
+    vals = sorted(lat_s)
+    return {
+        "count": len(vals),
+        "p50_ms": round(percentile(vals, 50) * 1000, 3),
+        "p95_ms": round(percentile(vals, 95) * 1000, 3),
+        "p99_ms": round(percentile(vals, 99) * 1000, 3),
+        "max_ms": round((vals[-1] if vals else 0.0) * 1000, 3),
+    }
+
+
+class RssSampler:
+    """Peak-RSS sampler of THIS process while the load runs (the bench
+    embeds the server in-process, so its peak is the server's peak)."""
+
+    def __init__(self, interval_s: float = 0.05):
+        self.interval_s = interval_s
+        self.peak_mb = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @staticmethod
+    def rss_mb() -> float:
+        try:
+            with open("/proc/self/statm", encoding="ascii") as f:
+                pages = int(f.read().split()[1])
+            import os
+
+            return pages * os.sysconf("SC_PAGE_SIZE") / (1 << 20)
+        except (OSError, ValueError, IndexError):  # pragma: no cover
+            return 0.0
+
+    def start(self) -> "RssSampler":
+        def run():
+            while not self._stop.wait(self.interval_s):
+                self.peak_mb = max(self.peak_mb, self.rss_mb())
+
+        self.peak_mb = self.rss_mb()
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="loadgen-rss")
+        self._thread.start()
+        return self
+
+    def stop(self) -> float:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        return self.peak_mb
+
+
+class _ClientState:
+    __slots__ = ("idx", "seq", "acked", "write_lat", "query_lat",
+                 "sheds_429", "sheds_503", "retry_after_seen", "killed",
+                 "errors", "error_samples")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.seq = 0
+        self.acked: list[tuple[int, int]] = []  # (start_seq, n) acked
+        self.write_lat: list[float] = []
+        self.query_lat: list[float] = []
+        self.sheds_429 = 0
+        self.sheds_503 = 0
+        self.retry_after_seen = 0
+        self.killed = 0  # overdraft-killed queries (a governor shed)
+        self.errors = 0
+        self.error_samples: list[str] = []  # first few, for triage
+
+    def note_error(self, what: str) -> None:
+        self.errors += 1
+        if len(self.error_samples) < 3:
+            self.error_samples.append(what)
+
+
+def client_base_ts(idx: int) -> int:
+    """Per-client disjoint timestamp namespace (ns): rows never collide
+    across clients, so acked-row verification is an exact count."""
+    return (idx + 1) * 10**12
+
+
+def run_load(host: str, port: int, db: str, clients: int = 8,
+             duration_s: float = 5.0, write_frac: float = 0.5,
+             target_qps: float | None = None, batch_rows: int = 50,
+             measurement: str = "loadgen", query: str | None = None,
+             timeout_s: float = 10.0) -> dict:
+    """Run the closed-loop load; returns the aggregate summary dict.
+    Shed responses (429 write backpressure / 503 admission) count
+    separately from errors — shedding is the governor WORKING."""
+    if query is None:
+        query = f"SELECT count(v) FROM {measurement}"
+    states = [_ClientState(i) for i in range(clients)]
+    stop_at = time.monotonic() + duration_s
+    per_client_qps = (target_qps / clients) if target_qps else None
+
+    def worker(st: _ClientState) -> None:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        # deterministic write/query mix per client: no RNG, exact fraction
+        acc = 0.0
+        next_at = time.monotonic()
+        try:
+            while time.monotonic() < stop_at:
+                if per_client_qps:
+                    now = time.monotonic()
+                    if now < next_at:
+                        time.sleep(min(next_at - now, stop_at - now))
+                        if time.monotonic() >= stop_at:
+                            break
+                    next_at += 1.0 / per_client_qps
+                acc += write_frac
+                do_write = acc >= 1.0
+                if do_write:
+                    acc -= 1.0
+                t0 = time.monotonic()
+                try:
+                    if do_write:
+                        base = client_base_ts(st.idx) + st.seq
+                        body = "".join(
+                            f"{measurement},client=c{st.idx} v={st.seq + k}i "
+                            f"{base + k}\n"
+                            for k in range(batch_rows)
+                        ).encode()
+                        conn.request("POST", f"/write?db={db}", body=body)
+                        resp = conn.getresponse()
+                        resp.read()
+                        dt = time.monotonic() - t0
+                        if resp.status == 204:
+                            st.acked.append((st.seq, batch_rows))
+                            st.seq += batch_rows
+                            st.write_lat.append(dt)
+                        elif resp.status == 429:
+                            st.sheds_429 += 1
+                            if resp.getheader("Retry-After"):
+                                st.retry_after_seen += 1
+                        elif resp.status == 503:
+                            st.sheds_503 += 1
+                            if resp.getheader("Retry-After"):
+                                st.retry_after_seen += 1
+                        else:
+                            st.note_error(f"write status {resp.status}")
+                    else:
+                        from urllib.parse import quote
+
+                        conn.request(
+                            "GET", f"/query?db={db}&q={quote(query)}")
+                        resp = conn.getresponse()
+                        data = resp.read()
+                        dt = time.monotonic() - t0
+                        if resp.status == 200:
+                            doc = json.loads(data)
+                            errs = [r["error"]
+                                    for r in doc.get("results", [])
+                                    if "error" in r]
+                            if not errs:
+                                st.query_lat.append(dt)
+                            elif any("killed" in e for e in errs):
+                                # reservation-overdraft kill: the
+                                # governor shedding work, not a fault
+                                st.killed += 1
+                            else:
+                                st.note_error("query error: " + errs[0][:120])
+                        elif resp.status == 503:
+                            st.sheds_503 += 1
+                            if resp.getheader("Retry-After"):
+                                st.retry_after_seen += 1
+                        elif resp.status == 429:
+                            st.sheds_429 += 1
+                        else:
+                            st.note_error(f"query status {resp.status}")
+                except (OSError, http.client.HTTPException, ValueError) as e:
+                    st.note_error(f"transport: {type(e).__name__}: {e}")
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    conn = http.client.HTTPConnection(
+                        host, port, timeout=timeout_s)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(st,), daemon=True,
+                                name=f"loadgen-{st.idx}") for st in states]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        # generous join bound: a worker past stop_at is finishing ONE
+        # request; a longer hang means the server deadlocked (the soak
+        # test asserts on leftover alive threads)
+        t.join(timeout=duration_s + 4 * timeout_s)
+    alive = sum(1 for t in threads if t.is_alive())
+    wall_s = time.monotonic() - t_start
+
+    writes_ok = sum(len(st.write_lat) for st in states)
+    queries_ok = sum(len(st.query_lat) for st in states)
+    sheds = sum(st.sheds_429 + st.sheds_503 for st in states)
+    killed = sum(st.killed for st in states)
+    errors = sum(st.errors for st in states)
+    attempts = writes_ok + queries_ok + sheds + killed + errors
+    return {
+        "clients": clients,
+        "duration_s": round(wall_s, 3),
+        "attempts": attempts,
+        "qps": round(attempts / max(wall_s, 1e-9), 1),
+        "writes": _lat_summary([v for st in states for v in st.write_lat]),
+        "queries": _lat_summary([v for st in states for v in st.query_lat]),
+        "acked_rows": sum(n for st in states for _s, n in st.acked),
+        "acked_batches": {st.idx: st.acked for st in states},
+        "sheds_429": sum(st.sheds_429 for st in states),
+        "sheds_503": sum(st.sheds_503 for st in states),
+        "retry_after_seen": sum(st.retry_after_seen for st in states),
+        "killed_queries": killed,
+        "shed_rate": (round((sheds + killed) / attempts, 4)
+                      if attempts else 0.0),
+        "errors": errors,
+        "error_samples": [s for st in states for s in st.error_samples][:10],
+        "stuck_clients": alive,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8086)
+    ap.add_argument("--db", default="load")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--write-frac", type=float, default=0.5)
+    ap.add_argument("--target-qps", type=float, default=None)
+    ap.add_argument("--batch-rows", type=int, default=50)
+    ap.add_argument("--measurement", default="loadgen")
+    args = ap.parse_args()
+    out = run_load(args.host, args.port, args.db, clients=args.clients,
+                   duration_s=args.duration, write_frac=args.write_frac,
+                   target_qps=args.target_qps, batch_rows=args.batch_rows,
+                   measurement=args.measurement)
+    out.pop("acked_batches", None)  # CLI summary stays readable
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
