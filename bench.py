@@ -853,6 +853,102 @@ def bench_overload_shed(clients: int = 32, duration_s: float = 6.0,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_rebalance_under_traffic(clients: int = 6,
+                                  duration_s: float = 6.0) -> dict:
+    """Cluster rebalance cost (PR 6 acceptance metric): query p99 and
+    ingest rows/s while a FORCED balancer move streams shard groups
+    between nodes, vs the identical traffic quiescent.  Runs a real
+    rf=2 cluster of 3 subprocess server nodes (full stack: meta raft,
+    routed writes, two-phase migration) via the cluster-torture
+    harness's Cluster, preloads several shard groups, then measures two
+    equal loadgen windows — the second with `/debug/ctrl?mod=cluster&
+    op=move` placement overrides plus pumped migrate rounds keeping a
+    live migration streaming for the whole window."""
+    import shutil
+    import tempfile
+    import threading
+
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import cluster_torture as _ct
+    import loadgen as _loadgen
+
+    workdir = tempfile.mkdtemp(prefix="ogtpu-rebalance-")
+    cluster = _ct.Cluster(workdir, n=3, rf=2)
+    try:
+        cluster.spawn_all()
+        cluster.wait_ready()
+        targets = [node.addr for node in cluster.nodes]
+
+        def load(offset: int, frac: float, dur: float,
+                 measurement: str = "w") -> dict:
+            # measured windows WRITE to their own measurement but QUERY
+            # the fixed preload one — both windows' queries scan the
+            # identical dataset, so the p99 ratio isolates rebalance
+            # cost from dataset growth
+            return _loadgen.run_load(
+                "127.0.0.1", cluster.nodes[0].port, _ct.DB,
+                clients=clients, duration_s=dur, write_frac=frac,
+                batch_rows=100, measurement=measurement, targets=targets,
+                consistency="quorum", client_offset=offset,
+                ts_scale=_ct.TS_SCALE, timeout_s=30.0,
+                query=f"SELECT count(v) FROM {_ct.MST}")
+
+        def window(out: dict) -> dict:
+            return {"ingest_rows_per_s": round(
+                        out["acked_rows"] / max(out["duration_s"], 1e-9)),
+                    "query_p99_ms": out["queries"]["p99_ms"],
+                    "acked_rows": out["acked_rows"],
+                    "errors": out["errors"]}
+
+        # preload: every client lands in its own shard group (TS_SCALE
+        # spacing), so the forced moves have real bytes to stream
+        load(0, 1.0, max(2.0, duration_s / 2), measurement=_ct.MST)
+        quiescent = window(load(clients, 0.5, duration_s))
+
+        moves: list = []
+        stop = threading.Event()
+
+        def pump() -> None:
+            # keep a migration streaming for the whole window: force a
+            # placement override, pump migrate rounds until the group
+            # lands, repeat (ping-pong is fine — LWW makes it safe)
+            while not stop.is_set():
+                try:
+                    mv = cluster.force_move()
+                    if mv:
+                        moves.append(mv)
+                    for node in cluster.nodes:
+                        node.ctrl("cluster", op="migrate", timeout=120)
+                except (OSError, ValueError):
+                    pass
+                stop.wait(0.1)
+
+        pumper = threading.Thread(target=pump, daemon=True)
+        pumper.start()
+        try:
+            during = window(load(2 * clients, 0.5, duration_s))
+        finally:
+            stop.set()
+            pumper.join(timeout=180)
+        return {
+            "quiescent": quiescent,
+            "during_move": during,
+            "forced_moves": len(moves),
+            "query_p99_ratio": round(
+                during["query_p99_ms"]
+                / max(quiescent["query_p99_ms"], 1e-9), 3),
+            "ingest_ratio": round(
+                during["ingest_rows_per_s"]
+                / max(quiescent["ingest_rows_per_s"], 1), 3),
+        }
+    finally:
+        cluster.stop_all()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_atspec(n_rows: int = 100_000_000, hosts: int = 100,
                  keep_root: str | None = None) -> dict:
     """Config #1 at SPEC scale (VERDICT r4 #1): the production query path
@@ -1366,6 +1462,23 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     except Exception as e:  # noqa: BLE001 — bench must still emit
         print(f"bench: overload shed failed: {e}", file=sys.stderr)
 
+    # cluster rebalance cost: query p99 + ingest rows/s while a forced
+    # balancer move streams shard groups, vs quiescent (the PR 6
+    # acceptance metric; runs a real 3-node rf=2 subprocess cluster)
+    rebalance = None
+    try:
+        rebalance = bench_rebalance_under_traffic(
+            clients=int(os.environ.get("OGTPU_BENCH_REBALANCE_CLIENTS",
+                                       "6")),
+            duration_s=float(os.environ.get("OGTPU_BENCH_REBALANCE_S",
+                                            "6")))
+        _emit("rebalance_under_traffic_query_p99_ms" + suffix,
+              rebalance["during_move"]["query_p99_ms"], "ms",
+              rebalance["query_p99_ratio"], {"detail": rebalance})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: rebalance under traffic failed: {e}",
+              file=sys.stderr)
+
     # e2e host path (config #1 shape)
     e2e = bench_e2e(
         series=int(os.environ.get("OGTPU_BENCH_E2E_SERIES", "200")),
@@ -1402,6 +1515,8 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
         extra["colcache_warm"] = colcache_warm
     if overload:
         extra["overload_shed"] = overload
+    if rebalance:
+        extra["rebalance_under_traffic"] = rebalance
     if note:
         extra["note"] = note
     atspec_best = _load_atspec_lastgood()

@@ -23,17 +23,118 @@ import urllib.error
 import urllib.parse
 import urllib.request
 
+from opengemini_tpu.parallel import netfault
 from opengemini_tpu.utils import peers
 
 import numpy as np
 
 from opengemini_tpu.index.inverted import SeriesIndex
 from opengemini_tpu.record import Column, FieldType, Record
+from opengemini_tpu.utils.failpoint import inject as _fp
+from opengemini_tpu.utils.governor import _env_float, _env_int
 from opengemini_tpu.utils.stats import GLOBAL as STATS
 
 # a peer's cached health view older than this cannot vote in the quorum
 # failure view (its probe loop stalled or has not run yet)
 _MAX_VIEW_AGE_S = 90.0
+
+
+class CircuitOpen(OSError):
+    """Fast-failed by the per-node circuit breaker (peer is suspect)."""
+
+
+class CircuitBreaker:
+    """Per-peer consecutive-transport-failure breaker (the gossip
+    suspicion state machine's RPC-side analogue): after `threshold`
+    consecutive failures the peer is SUSPECT and every RPC to it fails
+    fast (CircuitOpen, an OSError — callers classify it exactly like an
+    unreachable node) instead of burning a full connect timeout per
+    call.  After `cooldown_s` ONE half-open trial RPC is let through:
+    success closes the breaker, failure re-opens it for a fresh
+    cooldown.
+
+    Pass-through when disabled (threshold <= 0, the default): allow()
+    is one comparison, record() a no-op — bit-identical to an
+    unwrapped transport (asserted by tests/test_netfault.py)."""
+
+    def __init__(self, threshold: int = 0, cooldown_s: float = 5.0):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        # peer key -> [consecutive failures, opened-at walltime,
+        #              half-open trial in flight]
+        self._peers: dict[str, list] = {}
+
+    def enabled(self) -> bool:
+        return self.threshold > 0
+
+    def allow(self, key: str) -> bool:
+        """May an RPC to `key` proceed?  False = fail fast (open, and
+        either cooling down or a half-open trial is already out)."""
+        if self.threshold <= 0:
+            return True
+        import time as _t
+
+        with self._lock:
+            st = self._peers.get(key)
+            if st is None or st[0] < self.threshold:
+                return True
+            if st[2]:
+                return False  # one half-open probe at a time
+            if _t.time() - st[1] >= self.cooldown_s:
+                st[2] = True  # this caller becomes the trial probe
+                return True
+            return False
+
+    def record(self, key: str, ok: bool) -> None:
+        """Outcome of an RPC to `key`.  An HTTP status error counts as
+        OK here — the peer answered, the circuit is about transport
+        reachability, not application health."""
+        if self.threshold <= 0:
+            return
+        import time as _t
+
+        with self._lock:
+            st = self._peers.setdefault(key, [0, 0.0, False])
+            st[2] = False
+            if ok:
+                st[0] = 0
+            else:
+                st[0] += 1
+                if st[0] >= self.threshold:
+                    st[1] = _t.time()  # (re)open with a fresh cooldown
+
+    def state(self, key: str) -> str:
+        if self.threshold <= 0:
+            return "closed"
+        import time as _t
+
+        with self._lock:
+            st = self._peers.get(key)
+            if st is None or st[0] < self.threshold:
+                return "closed"
+            if st[2] or _t.time() - st[1] >= self.cooldown_s:
+                return "half-open"
+            return "open"
+
+    def is_open(self, key: str) -> bool:
+        """Suspect right now (open or mid-trial)?  Feeds node_up() so
+        the quorum failure view sees breaker-detected deaths between
+        probe ticks."""
+        if self.threshold <= 0:
+            return False
+        with self._lock:
+            st = self._peers.get(key)
+            return st is not None and st[0] >= self.threshold
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            peers_ = {k: {"failures": st[0]} for k, st in self._peers.items()
+                      if st[0] > 0}
+        for k in peers_:
+            peers_[k]["state"] = self.state(k)
+        return {"threshold": self.threshold, "cooldown_s": self.cooldown_s,
+                "peers": peers_}
 
 
 def owners(nodes: list[str], db: str, rp: str, group_start: int,
@@ -462,6 +563,17 @@ class DataRouter:
         # strict replication mode (parallel/datarep.DataReplication) when
         # [cluster] ha-policy = "replication"; None = write-available
         self.datarep = None
+        # RPC hardening knobs (cluster torture forces all of these):
+        # liveness-probe timeout (was hardcoded 2s), transient-retry
+        # count + jittered exponential backoff base for data-plane
+        # RPCs, and the per-node circuit breaker (off by default —
+        # bit-identical pass-through, like the netfault transport)
+        self.probe_timeout_s = _env_float("OGT_PROBE_TIMEOUT_S", 2.0)
+        self.rpc_retries = max(0, _env_int("OGT_RPC_RETRIES", 0))
+        self.rpc_backoff_ms = _env_float("OGT_RPC_BACKOFF_MS", 50.0)
+        self.breaker = CircuitBreaker(
+            threshold=_env_int("OGT_CB_THRESHOLD", 0),
+            cooldown_s=_env_float("OGT_CB_COOLDOWN_S", 5.0))
         self._hint_lock = threading.Lock()
         # last health-probe results: node id -> bool (True = reachable)
         self.health: dict[str, bool] = {}
@@ -480,11 +592,24 @@ class DataRouter:
             if not addr:
                 return (nid, False)
             try:
+                netfault.check(self.self_id, "/ping", nid, addr)
                 with peers.urlopen(peers.url(addr, "/ping"),
-                                   timeout=2) as r:
-                    return (nid, r.status in (200, 204))
-            except OSError:
+                                   timeout=self.probe_timeout_s) as r:
+                    ok = r.status in (200, 204)
+            except urllib.error.HTTPError:
+                # the peer ANSWERED (just not 2xx): unhealthy for the
+                # probe, but transport-reachable for the breaker —
+                # mirrors _post_raw's taxonomy
+                self.breaker.record(addr, True)
                 return (nid, False)
+            except OSError:
+                self.breaker.record(addr, False)
+                return (nid, False)
+            # a completed probe round-trip is transport evidence either
+            # way; probes bypass allow() so they remain the breaker's
+            # half-open recovery signal even while it is open
+            self.breaker.record(addr, True)
+            return (nid, ok)
 
         import time as _t
 
@@ -522,7 +647,8 @@ class DataRouter:
                 headers={"X-Ogt-Token": self.token},
             )
             try:
-                with peers.urlopen(req, timeout=2) as r:
+                netfault.check(self.self_id, "/cluster/health", nid, addr)
+                with peers.urlopen(req, timeout=self.probe_timeout_s) as r:
                     got = json.loads(r.read())
                 view = got.get("health")
                 if isinstance(view, dict):
@@ -572,7 +698,15 @@ class DataRouter:
         """Best failure signal available: the quorum view when one has
         been computed, else the local probe, defaulting optimistic (an
         unknown node is treated reachable so writes try it and hint on
-        failure rather than silently skipping)."""
+        failure rather than silently skipping).  An OPEN circuit
+        breaker overrides both — K consecutive transport failures is
+        fresher evidence than the last probe tick, and gating here
+        keeps migrations/anti-entropy off a node the breaker is
+        fast-failing anyway."""
+        if nid != self.self_id and self.breaker.enabled():
+            addr = self.data_nodes().get(nid, "")
+            if addr and self.breaker.is_open(addr):
+                return False
         if nid in self.shared_health:
             return self.shared_health[nid]
         return self.health.get(nid, True)
@@ -682,6 +816,7 @@ class DataRouter:
 
         failed: list[tuple[str, list, Exception]] = []
         for node_id, pts in sorted(remote.items()):
+            _fp("cluster-write-before-forward")  # per-replica fan-out edge
             try:
                 self.forward_points(node_id, db, rp, pts)
                 n += len(pts)
@@ -706,6 +841,7 @@ class DataRouter:
                 # influx 'any': the durable local hint queue IS the ack —
                 # accept even when no owner was synchronously reachable
                 for node_id, pts, _e in failed:
+                    _fp("cluster-write-before-hint")
                     self.hint(node_id, db, rp, pts)
                     n += len(pts)
                 return n
@@ -720,6 +856,7 @@ class DataRouter:
                     f"write failed at consistency={level}: {failed[0][2]}"
                 ) from failed[0][2]
             for node_id, pts, _e in failed:
+                _fp("cluster-write-before-hint")
                 self.hint(node_id, db, rp, pts)
                 n += len(pts)
         return n
@@ -754,9 +891,11 @@ class DataRouter:
 
         rec = {"db": db, "rp": rp, "points": encode_points(points)}
         path = os.path.join(self._hints_dir(), f"{node_id}.jsonl")
+        _fp("cluster-hint-before-append")  # copy owed, nothing durable yet
         with self._hint_lock:
             with open(path, "a", encoding="utf-8") as f:
                 f.write(json.dumps(rec) + "\n")
+        _fp("cluster-hint-after-append")  # hint durable, ack not yet sent
 
     def pending_hint_nodes(self) -> set[str]:
         """Nodes with queued hints FROM THIS coordinator: excluded from
@@ -847,6 +986,7 @@ class DataRouter:
                 try:
                     rec = json.loads(line)
                     points = decode_points(rec["points"])
+                    _fp("cluster-replay-before-forward")
                     self.forward_points(node_id, rec["db"], rec.get("rp"),
                                         points)
                     delivered += len(points)
@@ -869,7 +1009,8 @@ class DataRouter:
                 except (ValueError, KeyError, TypeError):
                     remaining[i] = None  # corrupt hint: drop it
             kept = [l for l in remaining if l is not None]
-            with self._hint_lock:
+            _fp("cluster-replay-before-requeue")  # undelivered tail in
+            with self._hint_lock:                 # .inflight only
                 if kept:
                     # re-queue BEFORE any hints appended mid-replay: append
                     # the live file (if any) after the kept prefix
@@ -951,22 +1092,32 @@ class DataRouter:
         if best is None:
             return None
         key, size, cur = best
-        # retained current owners stay FIRST: with rf>1 the primary must
-        # keep holding the data while migration is still in flight, or
-        # the primary-filtered reads would black-hole the group until
-        # the hot node's next migrate_round (the new owner has no rows
-        # yet); with rf=1 the list is just [cold] and unfiltered reads
-        # keep serving the hot node's copy until the move commits
-        new_owners = [n for n in cur if n != hot] + [cold]
-        new_owners = new_owners[: max(1, self.rf)]
-        if cold not in new_owners:
-            return None  # rf already saturated by data-holding owners
-        cmd = {"op": "set_placement", "key": key, "owners": new_owners}
-        if not self.meta_store.propose_and_wait(cmd):
+        new_owners = self._propose_owner_swap(key, cur, hot, cold)
+        if new_owners is None:
             return None
         STATS.incr("cluster", "balance_moves")
         return {"group": key, "bytes": size, "from": hot, "to": cold,
                 "owners": new_owners, "prior": over.get(key)}
+
+    def _propose_owner_swap(self, key: str, cur: list[str], out_node: str,
+                            dest: str) -> list[str] | None:
+        """Raft-propose a placement override moving group `key` off
+        `out_node` onto `dest`.  Retained current owners stay FIRST:
+        with rf>1 the primary must keep holding the data while migration
+        is still in flight, or the primary-filtered reads would
+        black-hole the group until out_node's next migrate_round (the
+        new owner has no rows yet); with rf=1 the list is just [dest]
+        and unfiltered reads keep serving out_node's copy until the move
+        commits.  Returns the new owner list, or None (rf already
+        saturated by data-holding owners, or the proposal failed)."""
+        new_owners = [n for n in cur if n != out_node] + [dest]
+        new_owners = new_owners[: max(1, self.rf)]
+        if dest not in new_owners:
+            return None
+        if not self.meta_store.propose_and_wait(
+                {"op": "set_placement", "key": key, "owners": new_owners}):
+            return None
+        return new_owners
 
     def _prune_placements(self, loads: dict) -> None:
         """Overrides must not pin groups forever: drop entries whose group
@@ -992,6 +1143,48 @@ class DataRouter:
             if stale:
                 self.meta_store.propose_and_wait(
                     {"op": "drop_placement", "key": key})
+
+    def force_move(self, db: str | None = None) -> dict | None:
+        """Deterministic balancer decision for operators and the cluster
+        torture harness (POST /debug/ctrl?mod=cluster&op=move): pick the
+        largest shard group this node owns and propose a placement
+        override moving it to a node outside the current owner set — no
+        byte skew required.  Like balance_round, retained data-holding
+        owners stay FIRST so rf>1 primary-filtered reads never black-hole
+        the group mid-move; the data streams when this node's next
+        migrate_round observes the lost ownership.  Returns the decision
+        or None (nothing movable / not the meta leader)."""
+        ids = sorted(self.data_nodes())
+        if len(ids) < 2:
+            return None
+        usage = self.engine.disk_usage()
+        best = None
+        for key, _size in sorted(usage.get("groups", {}).items(),
+                                 key=lambda kv: (-kv[1], kv[0])):
+            try:
+                gdb, rp, start = key.split("|")
+                start_i = int(start)
+            except ValueError:
+                continue
+            if db and gdb != db:
+                continue
+            cur = self.group_owners(gdb, rp, start_i, nodes=ids)
+            if self.self_id not in cur:
+                continue
+            others = [n for n in ids if n not in cur]
+            if not others:
+                continue
+            best = (key, cur, others[0])
+            break
+        if best is None:
+            return None
+        key, cur, dest = best
+        new_owners = self._propose_owner_swap(key, cur, self.self_id, dest)
+        if new_owners is None:
+            return None
+        STATS.incr("cluster", "forced_moves")
+        return {"group": key, "from": self.self_id, "to": dest,
+                "owners": new_owners}
 
     def migrate_round(self) -> int:
         """Rebalancing after membership change — TWO-PHASE (reference:
@@ -1019,6 +1212,7 @@ class DataRouter:
             begun: list[str] = []
             try:
                 for peer in dest:
+                    _fp("cluster-migrate-before-begin")
                     self._migrate_rpc(peer, {
                         "phase": "begin", "mig_id": mig_id, "db": db,
                         "rp": rp, "group_start": start})
@@ -1026,20 +1220,65 @@ class DataRouter:
                 for peer in dest:
                     self._push_shard(peer, db, rp, sh, mig_id)
                 for peer in dest:
-                    self._migrate_rpc(peer, {
-                        "phase": "commit", "mig_id": mig_id, "db": db})
-            except (OSError, RemoteScanError):
-                for peer in begun:  # Rollback: best-effort abort now,
-                    try:            # TTL expiry covers the rest
+                    _fp("cluster-migrate-before-commit")
+                    self._commit_with_retry(peer, mig_id, db)
+                _fp("cluster-migrate-after-commit")  # all acks in, local
+            except Exception:                        # copy still present
+                # Rollback: ANY failure — transport, a peer's rejection,
+                # or a payload encode/decode fault (ValueError/KeyError,
+                # which previously ESCAPED this handler and left staging
+                # un-rolled-back until TTL) — aborts every begun peer
+                # best-effort; TTL expiry covers peers the abort cannot
+                # reach.  The local copy stays, so nothing is lost.
+                STATS.incr("cluster", "migrate_aborts")
+                for peer in begun:
+                    try:
+                        _fp("cluster-migrate-before-abort")
                         self._migrate_rpc(peer, {
                             "phase": "abort", "mig_id": mig_id, "db": db})
-                    except (OSError, RemoteScanError):
+                    except Exception:  # noqa: BLE001 — best-effort
                         pass
                 continue
+            # drop-local ONLY here, after every destination acked its
+            # commit — a kill at the site above leaves the group held by
+            # a non-owner, which the next migrate_round re-pushes (LWW
+            # fold into the already-live rows: convergent, no dupes)
+            _fp("cluster-migrate-before-drop-local")
             self.engine.drop_shard(db, rp, start)
             moved += 1
             STATS.incr("cluster", "groups_migrated")
         return moved
+
+    COMMIT_RETRIES = 3
+
+    def _commit_with_retry(self, peer: str, mig_id: str, db: str) -> None:
+        """Commit with bounded retries: the server side is idempotent (a
+        committed-marker answers a re-commit with ok), so a commit whose
+        ACK was lost in transit is safely retried here instead of
+        aborting — and then re-streaming — a fully staged migration."""
+        import random as _random
+        import time as _time
+
+        last: Exception | None = None
+        for i in range(self.COMMIT_RETRIES):
+            try:
+                self._migrate_rpc(peer, {
+                    "phase": "commit", "mig_id": mig_id, "db": db})
+                return
+            except urllib.error.HTTPError:
+                raise  # the peer ANSWERED (e.g. 400 unknown migration):
+                       # its classification is final, never retried
+            except CircuitOpen:
+                raise  # fail fast means fail fast: backing off against
+                       # a peer the breaker already classified dead
+                       # would just burn the migrate round's time
+            except (OSError, RemoteScanError) as e:
+                last = e
+                if i + 1 < self.COMMIT_RETRIES:
+                    base = max(self.rpc_backoff_ms, 20.0) / 1000.0
+                    _time.sleep(min(base * (2 ** i) * (1 + _random.random()),
+                                    2.0))
+        raise last
 
     def _migrate_rpc(self, peer: str, body: dict) -> None:
         addr = self.data_nodes().get(peer, "")
@@ -1066,6 +1305,7 @@ class DataRouter:
         from opengemini_tpu.storage.shard import iter_structured_batches
 
         for batch in iter_structured_batches(sh, self.MIGRATE_CHUNK):
+            _fp("cluster-migrate-before-push")
             self._migrate_rpc(peer, {
                 "phase": "write", "mig_id": mig_id, "db": db,
                 "points": encode_points(batch)})
@@ -1133,6 +1373,7 @@ class DataRouter:
                     continue
                 addr = peer_addrs[peer]
                 try:
+                    _fp("cluster-antientropy-before-digest")
                     got = self._post(addr, "/internal/digest", {
                         "db": db, "rp": rp, "group_start": start,
                     })
@@ -1145,6 +1386,7 @@ class DataRouter:
                     if mst not in theirs:
                         continue  # peer missing data: ITS round pulls ours
                     try:
+                        _fp("cluster-antientropy-before-pull")
                         n = self._pull_measurement(
                             addr, db, rp, mst, tmin, tmax)
                     except (OSError, RemoteScanError, ValueError):
@@ -1165,6 +1407,7 @@ class DataRouter:
         points = payload_to_points(mst, payload)
         if not points:
             return 0
+        _fp("cluster-antientropy-before-merge")  # pulled, not yet merged
         return self.engine.write_rows(db, points, rp=rp)
 
     def forward_points(self, node_id: str, db: str, rp: str | None,
@@ -1198,6 +1441,7 @@ class DataRouter:
         url = peers.url(addr, f"/write?db={quote(db, safe='')}")
         if rp:
             url += f"&rp={quote(rp, safe='')}"
+        netfault.check(self.self_id, "/write", node_id, addr)
         req = urllib.request.Request(
             url, data=lines.encode("utf-8"),
             headers={"X-Ogt-Internal": "1", "X-Ogt-Token": self.token},
@@ -1207,15 +1451,55 @@ class DataRouter:
 
     def _post_raw(self, addr: str, path: str, body: dict,
                   timeout: float | None = None):
-        """One internal-POST implementation (token injection, timeout);
-        returns (bytes, content_type)."""
-        req = urllib.request.Request(
-            peers.url(addr, path),
-            data=json.dumps(dict(body, token=self.token)).encode("utf-8"),
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        with peers.urlopen(req, timeout=timeout or self.timeout_s) as r:
-            return r.read(), r.headers.get("Content-Type", "")
+        """One internal-POST implementation (token injection, per-RPC
+        deadline, netfault hook, circuit breaker, transient retries);
+        returns (bytes, content_type).
+
+        Retry policy (OGT_RPC_RETRIES, default 0 = single attempt):
+        only transport-level OSErrors retry, with jittered exponential
+        backoff — every /internal/* RPC is idempotent (LWW structured
+        writes, marker-idempotent migration commits, read-only scans),
+        so a retried request can duplicate effort but never data.  An
+        HTTPError is the peer ANSWERING and is never retried here: the
+        status carries the peer's classification and the caller's
+        error taxonomy must see it unchanged."""
+        import random as _random
+        import time as _time
+
+        data = json.dumps(dict(body, token=self.token)).encode("utf-8")
+        attempts = self.rpc_retries + 1
+        for i in range(attempts):
+            if not self.breaker.allow(addr):
+                # fail fast means fail fast: never retried, not a new
+                # failure observation
+                raise CircuitOpen(
+                    f"circuit open to {addr} "
+                    f"({self.breaker.threshold} consecutive failures)")
+            req = urllib.request.Request(
+                peers.url(addr, path), data=data,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                # inside the try: an injected drop/delay/error behaves
+                # exactly like the real transport fault it simulates
+                # (drops retry, injected statuses classify as answers)
+                netfault.check(self.self_id, path, addr)
+                with peers.urlopen(req, timeout=timeout or self.timeout_s) as r:
+                    out = r.read(), r.headers.get("Content-Type", "")
+            except urllib.error.HTTPError:
+                self.breaker.record(addr, True)  # the peer answered
+                raise
+            except OSError:
+                self.breaker.record(addr, False)
+                if i + 1 >= attempts:
+                    raise
+                base = self.rpc_backoff_ms / 1000.0
+                _time.sleep(min(base * (2 ** i) * (1 + _random.random()),
+                                2.0))
+                continue
+            self.breaker.record(addr, True)
+            return out
 
     def _post(self, addr: str, path: str, body: dict,
               timeout: float | None = None) -> dict:
@@ -1248,6 +1532,7 @@ class DataRouter:
                 out = [RemoteShard(mst, p) for p in payloads
                        if p.get("series")]
                 return out, live
+            _fp("cluster-scan-failover")  # dead peers leave the live set
             dropped.extend(sorted(dead))
             if len(dropped) >= self.rf:
                 raise RemoteScanError(
@@ -1315,6 +1600,7 @@ class DataRouter:
                     metas.append(got)
             if not dead:
                 break
+            _fp("cluster-scan-failover")
             dropped.extend(sorted(dead))
             if len(dropped) >= self.rf:
                 raise RemoteScanError(

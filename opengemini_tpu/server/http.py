@@ -29,11 +29,18 @@ from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query.executor import Executor
 from opengemini_tpu.record import FieldTypeConflict
 from opengemini_tpu.storage.engine import DatabaseNotFound, Engine, WriteError
+from opengemini_tpu.utils.failpoint import inject as _fp
 from opengemini_tpu.utils.governor import GOVERNOR, AdmissionRejected
 from opengemini_tpu.utils.stats import GLOBAL as STATS
 
 _EPOCH_DIV = {"ns": 1, "u": 1_000, "µ": 1_000, "ms": 1_000_000, "s": 1_000_000_000,
               "m": 60_000_000_000, "h": 3_600_000_000_000}
+
+# early-reply keep-alive drain bounds (_send): a rejected request body
+# larger than the cap — or one that stalls longer than the timeout —
+# closes the connection instead of being read out
+_DRAIN_CAP_BYTES = 8 << 20
+_DRAIN_TIMEOUT_S = 10.0
 
 
 def time_now_s() -> float:
@@ -238,7 +245,48 @@ def _make_handler(svc: HttpService):
             return None
 
         def _send(self, code: int, payload: bytes = b"", ctype: str = "application/json"):
+            # keep-alive correctness for EVERY early reply (auth failure,
+            # bad request, shed) on a request whose body was never read:
+            # unread payload left in the socket desyncs the next
+            # pipelined request into BrokenPipe/BadStatusLine storms
+            # under torture load.  _body() caches, so handlers that
+            # already read it pay nothing; draining before the status
+            # line keeps the HTTP exchange well-ordered.
+            if getattr(self, "_body_cache", None) is None and \
+                    self.headers.get("Content-Length"):
+                try:
+                    # raw socket consumption only: a shed/reject reply
+                    # must not pay gzip decompression for a payload it
+                    # is refusing to process.  Draining is bounded — an
+                    # oversized rejected body costs a connection close,
+                    # not reading it all just to preserve keep-alive
+                    n = int(self.headers["Content-Length"])
+                    if n > _DRAIN_CAP_BYTES:
+                        self.close_connection = True
+                    else:
+                        # bounded wait: a client that declared a length
+                        # and stalls must cost a closed connection, not
+                        # a pinned handler thread (pre-auth DoS)
+                        prev = self.connection.gettimeout()
+                        self.connection.settimeout(_DRAIN_TIMEOUT_S)
+                        try:
+                            while n > 0:
+                                got = self.rfile.read(min(n, 1 << 20))
+                                if not got:
+                                    break
+                                n -= len(got)
+                        finally:
+                            self.connection.settimeout(prev)
+                        if n > 0:  # short body: socket is desynced
+                            self.close_connection = True
+                except (OSError, ValueError):
+                    # torn/stalled socket: reply anyway, then close (the
+                    # unread remainder makes keep-alive unusable)
+                    self.close_connection = True
+                self._body_cache = b""
             self.send_response(code)
+            if self.close_connection:
+                self.send_header("Connection", "close")
             if payload:
                 self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
@@ -449,6 +497,7 @@ def _make_handler(svc: HttpService):
                     return
                 from opengemini_tpu.parallel.cluster import decode_points
 
+                _fp("internal-write-before-apply")  # replica copy pending
                 try:
                     points = decode_points(req.get("points", []))
                     svc.engine.write_rows(req["db"], points,
@@ -472,6 +521,10 @@ def _make_handler(svc: HttpService):
                     # window is transient and must not destroy hints
                     self._send_err(400, e)
                     return
+                # the hairiest replica edge: the write IS durable but the
+                # ack dies here — the coordinator must classify it
+                # unreachable and hint a (LWW-idempotent) duplicate copy
+                _fp("internal-write-before-reply")
                 self._send_json(200, {"ok": True})
             elif path == "/internal/raftdata":
                 # per-replica-group raft traffic (strict replication mode)
@@ -536,19 +589,32 @@ def _make_handler(svc: HttpService):
                 mig = str(req.get("mig_id", ""))
                 try:
                     if op == "begin":
+                        _fp("internal-migrate-begin")
                         svc.engine.begin_staging(
                             req["db"], req.get("rp") or None,
                             int(req["group_start"]), mig)
                         out = {"ok": True}
                     elif op == "write":
+                        _fp("internal-migrate-write")
                         n = svc.engine.write_staging(
                             mig, decode_points(req.get("points", [])))
                         out = {"ok": True, "rows": n}
                     elif op == "commit":
+                        _fp("internal-migrate-commit")  # staged, not live
                         out = {"ok": True,
                                "rows": svc.engine.commit_staging(mig)}
+                        # committed (marker durable) but the ack can still
+                        # die here — the pusher's retried commit must get
+                        # ok from the marker, not a restream
+                        _fp("internal-migrate-commit-before-reply")
                     elif op == "abort":
-                        out = {"ok": svc.engine.abort_staging(mig)}
+                        _fp("internal-migrate-abort")
+                        # always ok: an unknown mig means nothing is
+                        # staged (never begun, TTL-expired, or already
+                        # committed — where abort must NOT undo the
+                        # fold), so the rollback is trivially complete
+                        out = {"ok": True,
+                               "aborted": svc.engine.abort_staging(mig)}
                     else:
                         self._send_json(400, {"error": f"bad phase {op!r}"})
                         return
@@ -805,6 +871,99 @@ def _make_handler(svc: HttpService):
                     GOVERNOR.configure(**knobs)
                 self._send_json(200, {"status": "ok",
                                       "governor": GOVERNOR.describe()})
+                return
+            elif mod == "netfault":
+                # deterministic network-fault rules for THIS node's
+                # OUTBOUND peer traffic (parallel/netfault.py): the
+                # torture harness's partition lever.  No action =
+                # status; action=off clears one rule; clear=1 heals all.
+                from opengemini_tpu.parallel import netfault as _nf
+
+                if params.get("clear", "").lower() in ("1", "true", "all"):
+                    _nf.clear_all()
+                    self._send_json(200, {"status": "ok", "rules": []})
+                    return
+                action = params.get("action", "")
+                if not action:
+                    self._send_json(200, {"rules": _nf.rules(),
+                                          "hits": _nf.hits()})
+                    return
+                src = params.get("src", "*")
+                dst = params.get("dst", "*")
+                pat = params.get("path", "*")
+                if action == "off":
+                    _nf.clear_rule(src, dst, pat)
+                else:
+                    try:
+                        _nf.set_rule(src, dst, pat, action)
+                    except ValueError as e:
+                        self._send_json(400, {"error": str(e)})
+                        return
+                self._send_json(200, {"status": "ok",
+                                      "rules": _nf.rules()})
+                return
+            elif mod == "cluster":
+                # synchronous cluster-service rounds + RPC-hardening
+                # knobs: lets the torture harness (and operators) force
+                # a migrate/balance/hint-replay/anti-entropy round NOW
+                # instead of waiting out a service interval, and inspect
+                # breaker/staging/hint state between faults.
+                router = svc.router
+                if router is None:
+                    self._send_json(400, {"error": "no data router"})
+                    return
+                for key, conv in (("cb_threshold", int),
+                                  ("cb_cooldown_s", float),
+                                  ("probe_timeout_s", float),
+                                  ("rpc_retries", int)):
+                    if key in params:
+                        try:
+                            val = conv(params[key])
+                        except ValueError:
+                            self._send_json(
+                                400, {"error": f"bad {key}={params[key]!r}"})
+                            return
+                        # same clamps as the constructor: a negative
+                        # retry count would make _post_raw's attempt
+                        # loop run zero times and return None
+                        if key == "cb_threshold":
+                            router.breaker.threshold = val
+                        elif key == "cb_cooldown_s":
+                            router.breaker.cooldown_s = max(0.0, val)
+                        elif key == "rpc_retries":
+                            router.rpc_retries = max(0, val)
+                        else:  # probe_timeout_s
+                            router.probe_timeout_s = max(0.05, val)
+                op = params.get("op", "")
+                out: dict = {"status": "ok"}
+                try:
+                    if op == "migrate":
+                        out["expired"] = svc.engine.expire_staging(
+                            float(params.get("staging_ttl_s", 900)))
+                        out["moved"] = router.migrate_round()
+                    elif op == "balance":
+                        out["move"] = router.balance_round()
+                    elif op == "move":
+                        out["move"] = router.force_move(
+                            params.get("db") or None)
+                    elif op == "hints":
+                        out["delivered"] = router.replay_hints()
+                    elif op == "antientropy":
+                        out["repaired"] = router.anti_entropy_round()
+                    elif op == "health":
+                        out["health"] = router.exchange_health()
+                    elif op:
+                        self._send_json(
+                            400, {"error": f"unknown cluster op {op!r}"})
+                        return
+                except Exception as e:  # noqa: BLE001 — a faulted round
+                    # must report, not drop the ctrl connection
+                    self._send_json(500, {"error": f"{op} failed: {e}"})
+                    return
+                out["breaker"] = router.breaker.snapshot()
+                out["staging"] = svc.engine.staging_ids()
+                out["pending_hints"] = sorted(router.pending_hint_nodes())
+                self._send_json(200, out)
                 return
             elif mod == "failpoint":
                 from opengemini_tpu.utils import failpoint as _fpmod

@@ -238,6 +238,10 @@ class Engine:
         # inbound two-phase migrations: mig_id -> (db, rp, start, Shard);
         # staging shards are NEVER in _shards (invisible to queries)
         self._staging: dict[str, tuple] = {}
+        # mig_ids whose commit fold is running RIGHT NOW (popped from
+        # _staging, marker not yet durable): a retried commit racing the
+        # fold must wait for the marker, not 400 "unknown migration"
+        self._folding: set[str] = set()
         self._load_shards()
         # live acked-vs-durable gauges ride /debug/vars (utils/stats
         # provider; close() unregisters so dead engines drop out)
@@ -638,19 +642,70 @@ class Engine:
 
     def commit_staging(self, mig_id: str) -> int:
         """Assign: fold the staged rows into the LIVE shard (LWW-idempotent
-        structured writes) and discard the staging area. Returns rows."""
+        structured writes) and discard the staging area. Returns rows.
+
+        IDEMPOTENT: a durable committed-marker is written after the fold,
+        so a re-commit of the same mig_id — the pusher retrying because
+        the first commit's ACK was lost in transit — answers ok instead
+        of failing the pusher into aborting (and re-streaming) a move
+        that already completed.  A retry that re-staged rows first (full
+        begin/write/commit replay) re-folds them; the structured write
+        path is last-write-wins on (series, timestamp), so the fold can
+        never duplicate rows."""
         with self._lock:
             got = self._staging.pop(mig_id, None)
+            if got is not None:
+                self._folding.add(mig_id)
         if got is None:
+            # a retried commit can arrive while the FIRST commit is
+            # still folding (its RPC timed out client-side, the work
+            # did not): wait out the fold, then answer from the marker
+            while True:
+                with self._lock:
+                    inflight = mig_id in self._folding
+                if not inflight:
+                    break
+                _time.sleep(0.05)
+            if os.path.exists(self._committed_marker(mig_id)):
+                return 0  # already folded; the previous ack was lost
             raise WriteError(f"unknown migration {mig_id!r}")
-        db, rp, _start, sh, _ts = got
-        from opengemini_tpu.storage.shard import iter_structured_batches
+        try:
+            db, rp, _start, sh, _ts = got
+            from opengemini_tpu.storage.shard import iter_structured_batches
 
-        rows = 0
-        for batch in iter_structured_batches(sh, 20_000):
-            rows += self.write_rows(db, batch, rp=rp)
-        self._discard_staging_dir(sh)
+            rows = 0
+            for batch in iter_structured_batches(sh, 20_000):
+                rows += self.write_rows(db, batch, rp=rp)
+            # a crash HERE (fold durable via WAL, marker absent) is safe:
+            # the pusher's retry re-stages + re-folds, LWW dedups
+            _fp("engine-staging-commit-before-marker")
+            self._write_committed_marker(mig_id, rows)
+            self._discard_staging_dir(sh)
+        finally:
+            with self._lock:
+                self._folding.discard(mig_id)
         return rows
+
+    def _committed_marker(self, mig_id: str) -> str:
+        return os.path.join(self._staging_root(), mig_id + ".committed")
+
+    def _write_committed_marker(self, mig_id: str, rows: int) -> None:
+        """Durable (fsynced, atomic-rename) record that `mig_id` folded:
+        the commit-idempotence token, TTL-expired with the staging dirs."""
+        os.makedirs(self._staging_root(), exist_ok=True)
+        path = self._committed_marker(mig_id)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps({"rows": rows, "ts": _time.time()}))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def staging_ids(self) -> list[str]:
+        """In-flight migration staging ids, snapshotted under the engine
+        lock (introspection must not race a concurrent begin/commit)."""
+        with self._lock:
+            return sorted(self._staging)
 
     def abort_staging(self, mig_id: str) -> bool:
         """Rollback: drop the staging area; live data was never touched."""
@@ -698,11 +753,23 @@ class Engine:
                     self._discard_staging_dir(entry[3])
                     dropped += 1
             # ORPHAN dirs (no in-memory entry — e.g. this node restarted
-            # mid-migration) expire by their newest content mtime
+            # mid-migration) expire by their newest content mtime;
+            # committed-markers (commit-idempotence tokens) age out the
+            # same way once no pusher can still be retrying that commit
             for name in os.listdir(root):
-                if name in self._staging:
+                if name in self._staging or name in self._folding:
+                    # a fold in flight is NOT an orphan: its commit
+                    # popped the registration but is still reading the
+                    # dir (the lock is not held across the fold)
                     continue
                 path = os.path.join(root, name)
+                if name.endswith(".committed") and os.path.isfile(path):
+                    try:
+                        if now - os.path.getmtime(path) >= ttl_s:
+                            os.remove(path)
+                    except OSError:
+                        pass
+                    continue
                 try:
                     newest = max(
                         (os.path.getmtime(os.path.join(path, f))

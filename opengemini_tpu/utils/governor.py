@@ -88,6 +88,13 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 class AdmissionRejected(Exception):
     """A query was shed by admission control (HTTP 503 + Retry-After)."""
 

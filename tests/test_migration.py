@@ -6,7 +6,11 @@ import json
 import urllib.parse
 import urllib.request
 
-from opengemini_tpu.parallel.cluster import DataRouter, owners
+import pytest
+
+from opengemini_tpu.parallel.cluster import (
+    DataRouter, RemoteScanError, owners,
+)
 from opengemini_tpu.server.http import HttpService
 from opengemini_tpu.storage.engine import Engine
 
@@ -265,6 +269,318 @@ class TestTwoPhaseMigration:
         assert _query_count(addrs, "nC") == 10
         for nid, (e, _svc) in nodes.items():
             assert not e._staging, nid
+
+
+class TestMigrationPartialFailure:
+    """The hairiest distributed edges (ISSUE 6): commit-ack loss,
+    destination crash between fold and ack, abort racing an already-
+    committed peer, and staging TTL expiry racing a live push — all must
+    re-converge by LWW with zero loss and zero duplication."""
+
+    def _cluster(self, tmp_path, nids, rf=1):
+        addrs: dict = {}
+        store = BalanceStoreStub(addrs)
+        nodes = {}
+        for nid in nids:
+            nodes[nid] = _mk_node(tmp_path, nid, addrs, store)
+        store.fsm = FsmStub(addrs)
+        store.fsm.placement = {}
+        _wire(nodes, addrs, store, rf=rf)
+        for _e, svc in nodes.values():
+            svc.router.probe_health()
+        return nodes, addrs, store
+
+    def _seed_local(self, e, n=6):
+        """Rows written ENGINE-level (no routing): data exists only on
+        this node, whatever placement says."""
+        t0 = (BASE // (7 * 86400) + 2) * 7 * 86400
+        e.write_lines("db", "\n".join(
+            f"cpu,host=h{i} v={i} {(t0 + i) * NS}" for i in range(n)))
+        key = sorted(e._shards)[0]
+        return key, n
+
+    def _close(self, nodes):
+        for _nid, (e, svc) in nodes.items():
+            svc.stop()
+            e.close()
+
+    def test_commit_ack_lost_then_retried_is_idempotent(self, tmp_path):
+        """The first commit lands but its ACK dies in transit; the
+        pusher's retry must hit the committed-marker (ok, no restream)
+        and the migration completes with exactly-once rows."""
+        nodes, addrs, store = self._cluster(tmp_path, ("nA", "nB"))
+        eA, svcA = nodes["nA"]
+        eB, _svcB = nodes["nB"]
+        routerA = svcA.router
+        (db, rp, start), n = self._seed_local(eA)
+        store.fsm.placement[f"{db}|{rp}|{start}"] = ["nB"]
+
+        orig = routerA._migrate_rpc
+        commits = {"n": 0}
+
+        def lossy(peer, body):
+            out = orig(peer, body)
+            if body.get("phase") == "commit":
+                commits["n"] += 1
+                if commits["n"] == 1:  # the server committed; the ack
+                    raise RemoteScanError("injected: commit ack lost")
+            return out
+
+        routerA._migrate_rpc = lossy
+        try:
+            assert routerA.migrate_round() == 1
+        finally:
+            routerA._migrate_rpc = orig
+        assert commits["n"] == 2  # retried once, against the marker
+        assert (db, rp, start) not in eA._shards  # drop-local happened
+        # exactly once, from both coordinators
+        for nid in addrs:
+            assert _query_count(addrs, nid) == n
+        assert not eA._staging and not eB._staging
+        # the idempotence marker exists until TTL
+        marks = [f for f in (tmp_path / "nB" / "staging").iterdir()
+                 if f.name.endswith(".committed")]
+        assert len(marks) == 1
+        self._close(nodes)
+
+    def test_commit_staging_direct_recommit_returns_ok(self, tmp_path):
+        """Engine-level idempotence contract: a re-commit of a folded
+        mig_id returns 0 (ok) instead of raising; an unknown mig_id
+        without a marker still raises."""
+        from opengemini_tpu.record import FieldType
+        from opengemini_tpu.storage.engine import Engine, WriteError
+
+        e = Engine(str(tmp_path / "d"))
+        e.create_database("db")
+        e.begin_staging("db", None, 0, "mig-idem-1")
+        e.write_staging("mig-idem-1", [
+            ("cpu", (), 1000, {"v": (FieldType.FLOAT, 1.0)})])
+        assert e.commit_staging("mig-idem-1") == 1
+        assert e.commit_staging("mig-idem-1") == 0  # marker answers
+        with pytest.raises(WriteError):
+            e.commit_staging("mig-never-began")
+        # markers TTL-expire like staging dirs
+        import os
+        import time
+
+        mark = e._committed_marker("mig-idem-1")
+        assert os.path.exists(mark)
+        old = time.time() - 3600
+        os.utime(mark, (old, old))
+        e.expire_staging(ttl_s=900)
+        assert not os.path.exists(mark)
+        e.close()
+
+    def test_commit_retry_racing_inflight_fold_waits_for_marker(
+            self, tmp_path):
+        """A retried commit arriving while the FIRST commit is still
+        folding (its RPC timed out client-side; the work did not) must
+        wait out the fold and answer ok from the marker — not 400
+        'unknown migration', which would abort + restream a move that
+        is completing."""
+        import threading
+        import time
+
+        from opengemini_tpu.record import FieldType
+        from opengemini_tpu.storage.engine import Engine
+        from opengemini_tpu.utils import failpoint
+
+        e = Engine(str(tmp_path / "d"))
+        e.create_database("db")
+        e.begin_staging("db", None, 0, "mig-race-1")
+        e.write_staging("mig-race-1", [
+            ("cpu", (), 1000, {"v": (FieldType.FLOAT, 1.0)})])
+        failpoint.enable("engine-staging-commit-before-marker",
+                         "wait:fold-gate")
+        first: dict = {}
+        second: dict = {}
+        try:
+            t1 = threading.Thread(
+                target=lambda: first.update(
+                    rows=e.commit_staging("mig-race-1")))
+            t1.start()
+            for _ in range(200):  # fold in flight (popped, gated)
+                if "mig-race-1" in e._folding:
+                    break
+                time.sleep(0.01)
+            assert "mig-race-1" in e._folding
+            t2 = threading.Thread(
+                target=lambda: second.update(
+                    rows=e.commit_staging("mig-race-1")))
+            t2.start()
+            time.sleep(0.15)
+            assert not second  # the retry WAITS, it does not 400
+            failpoint.set_event("fold-gate")
+            t1.join(10)
+            t2.join(10)
+        finally:
+            failpoint.disable("engine-staging-commit-before-marker")
+        assert first["rows"] == 1
+        assert second["rows"] == 0  # answered from the marker
+        assert not e._staging and not e._folding
+        e.close()
+
+    def test_destination_crash_between_fold_and_ack(self, tmp_path):
+        """Kill (error-inject) the destination BETWEEN the staging fold
+        and the marker write: rows are live (durable fold), the pusher
+        sees a failed commit and aborts, a later full re-push LWW-merges
+        without duplicating."""
+        from opengemini_tpu.record import FieldType
+        from opengemini_tpu.storage.engine import Engine
+        from opengemini_tpu.utils import failpoint
+
+        e = Engine(str(tmp_path / "d"))
+        e.create_database("db")
+        pts = [("cpu", (("host", "h1"),), 1000 + i,
+                {"v": (FieldType.FLOAT, float(i))}) for i in range(5)]
+        e.begin_staging("db", None, 0, "mig-crash-1")
+        e.write_staging("mig-crash-1", pts)
+        failpoint.enable("engine-staging-commit-before-marker", "error")
+        try:
+            with pytest.raises(failpoint.FailpointError):
+                e.commit_staging("mig-crash-1")
+        finally:
+            failpoint.disable_all()
+
+        def rows():
+            return sum(
+                len(sh.read_series("cpu", sid))
+                for sh in e.shards_of_db("db")
+                for sid in sh.index.series_ids("cpu"))
+
+        assert rows() == 5  # the fold IS durable
+        # no marker: a retried commit of the dead mig correctly fails,
+        # and the pusher's full retry (new mig id) dedups by LWW
+        import os
+
+        assert not os.path.exists(e._committed_marker("mig-crash-1"))
+        e.begin_staging("db", None, 0, "mig-crash-2")
+        e.write_staging("mig-crash-2", pts)
+        assert e.commit_staging("mig-crash-2") == 5
+        assert rows() == 5  # exactly once
+        # the orphaned staging dir from the crash TTL-expires
+        import time
+
+        orphan = tmp_path / "d" / "staging" / "mig-crash-1"
+        assert orphan.exists()
+        old = time.time() - 3600
+        for f in orphan.iterdir():
+            os.utime(f, (old, old))
+        os.utime(orphan, (old, old))
+        assert e.expire_staging(ttl_s=900) >= 1
+        assert not orphan.exists()
+        assert not e.durability_check()
+        e.close()
+
+    def test_abort_after_partial_commit_reconverges_lww(self, tmp_path):
+        """rf=2, owners forced to (nB, nC): commit lands on nB, fails
+        persistently on nC -> the pusher aborts everywhere (the abort to
+        already-committed nB must NOT undo the fold), keeps its copy,
+        and the NEXT round re-pushes both — LWW re-convergence, exactly
+        once from every coordinator."""
+        nodes, addrs, store = self._cluster(
+            tmp_path, ("nA", "nB", "nC"), rf=2)
+        eA, svcA = nodes["nA"]
+        eB, _ = nodes["nB"]
+        eC, _ = nodes["nC"]
+        routerA = svcA.router
+        (db, rp, start), n = self._seed_local(eA)
+        store.fsm.placement[f"{db}|{rp}|{start}"] = ["nB", "nC"]
+
+        orig = routerA._migrate_rpc
+
+        def c_commit_fails(peer, body):
+            if peer == "nC" and body.get("phase") == "commit":
+                raise RemoteScanError("injected: nC commit always fails")
+            return orig(peer, body)
+
+        routerA._migrate_rpc = c_commit_fails
+        try:
+            assert routerA.migrate_round() == 0  # aborted, nothing moved
+        finally:
+            routerA._migrate_rpc = orig
+        # nA kept its copy; nB holds the committed fold; nC rolled back
+        assert (db, rp, start) in eA._shards
+        assert not eB._staging and not eC._staging
+
+        def local_rows(e):
+            return sum(
+                len(sh.read_series("cpu", sid))
+                for sh in e.shards_of_db("db")
+                for sid in sh.index.series_ids("cpu"))
+
+        assert local_rows(eB) == n and local_rows(eC) == 0
+        # reads are correct even in the partial state (primary nB serves,
+        # nA's retained copy is rf>1-filtered)
+        for nid in addrs:
+            assert _query_count(addrs, nid) == n
+        # heal: the next round re-pushes to BOTH (LWW into nB's live
+        # rows), commits, and drops the local copy
+        assert routerA.migrate_round() == 1
+        assert (db, rp, start) not in eA._shards
+        assert local_rows(eB) == n and local_rows(eC) == n
+        for nid in addrs:
+            assert _query_count(addrs, nid) == n
+        self._close(nodes)
+
+    def test_abort_to_committed_peer_over_http_is_safe(self, tmp_path):
+        """The abort RPC against an already-committed mig answers ok
+        without undoing the fold (ok semantics the rollback loop relies
+        on), and against an unknown mig is a no-op."""
+        nodes, addrs, _store = self._cluster(tmp_path, ("nA", "nB"))
+        eB, svcB = nodes["nB"]
+        from opengemini_tpu.record import FieldType
+
+        eB.begin_staging("db", None, 0, "mig-ab-1")
+        eB.write_staging("mig-ab-1", [
+            ("cpu", (), 1000, {"v": (FieldType.FLOAT, 7.0)})])
+        assert eB.commit_staging("mig-ab-1") == 1
+        body = json.dumps({"db": "db", "phase": "abort",
+                           "mig_id": "mig-ab-1"}).encode()
+        req = urllib.request.Request(
+            f"http://{addrs['nB']}/internal/migrate", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            got = json.loads(r.read())
+        assert got["ok"] is True and got["aborted"] is False
+        assert _query_count(addrs, "nB") == 1  # the fold survived
+        self._close(nodes)
+
+    def test_staging_ttl_expiry_racing_live_push(self, tmp_path):
+        """A TTL sweep that fires mid-push (e.g. a pusher stalled past
+        the deadline) drops the staging area; the pusher's NEXT write or
+        commit fails cleanly (WriteError -> abort path), never folds a
+        truncated copy, and a full retry succeeds."""
+        import time
+
+        from opengemini_tpu.record import FieldType
+        from opengemini_tpu.storage.engine import Engine, WriteError
+
+        e = Engine(str(tmp_path / "d"))
+        e.create_database("db")
+        pts = [("cpu", (), 1000 + i, {"v": (FieldType.FLOAT, 1.0)})
+               for i in range(4)]
+        e.begin_staging("db", None, 0, "mig-ttl-1")
+        e.write_staging("mig-ttl-1", pts[:2])
+        e._staging["mig-ttl-1"][4] = time.time() - 3600  # stalled pusher
+        assert e.expire_staging(ttl_s=900) == 1
+        with pytest.raises(WriteError, match="unknown migration"):
+            e.write_staging("mig-ttl-1", pts[2:])
+        with pytest.raises(WriteError, match="unknown migration"):
+            e.commit_staging("mig-ttl-1")
+
+        def rows():
+            return sum(
+                len(sh.read_series("cpu", sid))
+                for sh in e.shards_of_db("db")
+                for sid in sh.index.series_ids("cpu"))
+
+        assert rows() == 0  # nothing half-folded
+        e.begin_staging("db", None, 0, "mig-ttl-2")
+        e.write_staging("mig-ttl-2", pts)
+        assert e.commit_staging("mig-ttl-2") == 4
+        assert rows() == 4
+        e.close()
 
 
 class BalanceStoreStub(StoreStub):

@@ -1,0 +1,394 @@
+"""Netfault transport + RPC hardening (circuit breaker, retries):
+deterministic per-(src,dst,path) fault rules, one-way partition
+semantics, and the bit-identical pass-through contract when nothing is
+armed (ISSUE 6 acceptance)."""
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from opengemini_tpu.parallel import netfault
+from opengemini_tpu.parallel.cluster import (
+    CircuitBreaker, CircuitOpen, DataRouter, RemoteScanError,
+)
+from opengemini_tpu.server.http import HttpService
+from opengemini_tpu.storage.engine import Engine
+
+NS = 10**9
+BASE = 1_700_000_000
+
+
+@pytest.fixture(autouse=True)
+def _clean_rules():
+    netfault.clear_all()
+    yield
+    netfault.clear_all()
+
+
+class FsmStub:
+    def __init__(self, addrs):
+        self.nodes = {n: {"addr": a, "role": "data"}
+                      for n, a in addrs.items()}
+
+
+class StoreStub:
+    token = ""
+
+    def __init__(self, addrs):
+        self.fsm = FsmStub(addrs)
+
+
+def _mk_node(tmp_path, nid, addrs):
+    e = Engine(str(tmp_path / nid))
+    e.create_database("db")
+    svc = HttpService(e, "127.0.0.1", 0)
+    svc.start()
+    addrs[nid] = f"127.0.0.1:{svc.port}"
+    return e, svc
+
+
+def _wire(nodes, addrs, store, rf=1):
+    for nid, (e, svc) in nodes.items():
+        svc.router = DataRouter(e, store, nid, addrs[nid], rf=rf)
+        svc.executor.router = svc.router
+    return {nid: svc.router for nid, (e, svc) in nodes.items()}
+
+
+class TestRules:
+    def test_drop_matches_src_dst_path(self):
+        netfault.set_rule("n1", "n2", "/internal/*", "drop")
+        with pytest.raises(netfault.NetFault):
+            netfault.check("n1", "/internal/write", "n2")
+        # NetFault is an OSError: callers classify it unreachable
+        with pytest.raises(OSError):
+            netfault.check("n1", "/internal/scan", "n2", "127.0.0.1:9")
+        # non-matching src / dst / path all pass through
+        netfault.check("n9", "/internal/write", "n2")
+        netfault.check("n1", "/internal/write", "n3")
+        netfault.check("n1", "/ping", "n2")
+        assert sum(netfault.hits().values()) == 2
+
+    def test_dst_matches_node_id_or_addr(self):
+        netfault.set_rule("*", "127.0.0.1:77*", "*", "drop")
+        with pytest.raises(netfault.NetFault):
+            netfault.check("any", "/x", "nodeid", "127.0.0.1:7777")
+        netfault.check("any", "/x", "nodeid", "127.0.0.1:8888")
+
+    def test_error_action_raises_http_status(self):
+        netfault.set_rule("*", "*", "/internal/scan", "error:503")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            netfault.check("n1", "/internal/scan", "n2")
+        assert ei.value.code == 503
+        netfault.clear_all()
+        netfault.set_rule("*", "*", "*", "error")  # default status
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            netfault.check("n1", "/anything", "n2")
+        assert ei.value.code == 503
+
+    def test_delay_action_sleeps_then_passes(self):
+        netfault.set_rule("*", "*", "*", "delay:0.05")
+        t0 = time.monotonic()
+        netfault.check("n1", "/x", "n2")  # returns (no raise)
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_validate_rejects_garbage(self):
+        for bad in ("dorp", "delay:x", "error:9999", "", "drop "):
+            with pytest.raises(ValueError):
+                netfault.set_rule("*", "*", "*", bad)
+        assert netfault.rules() == []
+
+    def test_clear_rule_and_all(self):
+        netfault.set_rule("a", "b", "c", "drop")
+        netfault.set_rule("a", "b", "d", "drop")
+        assert len(netfault.rules()) == 2
+        assert netfault.clear_rule("a", "b", "c")
+        assert not netfault.clear_rule("a", "b", "c")
+        assert len(netfault.rules()) == 1
+        netfault.clear_all()
+        assert netfault.rules() == [] and netfault.hits() == {}
+
+
+class TestPassThrough:
+    def test_check_is_noop_without_rules(self):
+        # the fast path must not raise, sleep, or record anything
+        netfault.check("n1", "/internal/write", "n2", "127.0.0.1:1")
+        assert netfault.hits() == {}
+
+    def test_breaker_disabled_is_passthrough(self):
+        br = CircuitBreaker()  # threshold 0 = disabled (the default)
+        assert not br.enabled()
+        for _ in range(10):
+            br.record("peer", False)
+            assert br.allow("peer")
+        assert br.state("peer") == "closed"
+        assert not br.is_open("peer")
+        assert br.snapshot()["peers"] == {}
+
+    def test_router_defaults_are_inert(self, tmp_path):
+        """With no env knobs set, the hardened transport is bit-identical:
+        no retries, breaker disabled, probe timeout at the historic 2s —
+        and a live write/query round trip returns byte-equal results
+        before arming and after arming+clearing netfault rules."""
+        addrs: dict = {}
+        store = StoreStub(addrs)
+        nodes = {nid: _mk_node(tmp_path, nid, addrs)
+                 for nid in ("n1", "n2")}
+        store.fsm = FsmStub(addrs)
+        routers = _wire(nodes, addrs, store)
+        try:
+            r1 = routers["n1"]
+            assert r1.rpc_retries == 0
+            assert not r1.breaker.enabled()
+            assert r1.probe_timeout_s == 2.0
+            lines = "\n".join(
+                f"cpu,host=h{w} v={w} {(BASE + w * 7 * 86400) * NS}"
+                for w in range(8))
+            r1.routed_write("db", None, _parse(lines))
+            before = _count(addrs, "n1")
+            netfault.set_rule("*", "none:1", "/nowhere", "drop")
+            netfault.clear_all()
+            after = _count(addrs, "n1")
+            assert json.dumps(before, sort_keys=True) == \
+                json.dumps(after, sort_keys=True)
+        finally:
+            for _e, svc in nodes.values():
+                svc.stop()
+                _e.close()
+
+
+def _parse(lines):
+    import time as _t
+
+    from opengemini_tpu.ingest.line_protocol import parse_lines
+
+    return parse_lines(lines.encode(), "ns", _t.time_ns())
+
+
+def _count(addrs, nid):
+    url = (f"http://{addrs[nid]}/query?" + urllib.parse.urlencode(
+        {"q": "SELECT count(v) FROM cpu", "db": "db", "epoch": "ns"}))
+    with urllib.request.urlopen(url, timeout=60) as r:
+        res = json.loads(r.read())["results"][0]
+    assert "error" not in res, res
+    return res
+
+
+class TestPartitionSemantics:
+    def test_one_way_partition_is_one_rule(self, tmp_path):
+        """A drop rule on n1's outbound makes n2 look dead FROM n1 while
+        n2 still sees n1 alive — the classic asymmetric partition."""
+        addrs: dict = {}
+        store = StoreStub(addrs)
+        nodes = {nid: _mk_node(tmp_path, nid, addrs)
+                 for nid in ("n1", "n2")}
+        store.fsm = FsmStub(addrs)
+        routers = _wire(nodes, addrs, store)
+        try:
+            netfault.set_rule("n1", addrs["n2"], "*", "drop")
+            h1 = routers["n1"].probe_health()
+            h2 = routers["n2"].probe_health()
+            assert h1["n2"] is False and h1["n1"] is True
+            assert h2["n1"] is True and h2["n2"] is True
+            netfault.clear_all()  # heal
+            assert routers["n1"].probe_health()["n2"] is True
+        finally:
+            for _e, svc in nodes.values():
+                svc.stop()
+                _e.close()
+
+    def test_drop_rule_fails_writes_over_to_hints(self, tmp_path):
+        """An rf=2 write with one replica black-holed still ACKs at
+        consistency=one, with the dead replica's copy queued as a hint —
+        and delivers after heal."""
+        addrs: dict = {}
+        store = StoreStub(addrs)
+        nodes = {nid: _mk_node(tmp_path, nid, addrs)
+                 for nid in ("n1", "n2")}
+        store.fsm = FsmStub(addrs)
+        routers = _wire(nodes, addrs, store, rf=2)
+        try:
+            netfault.set_rule("n1", addrs["n2"], "/internal/*", "drop")
+            pts = _parse(f"cpu,host=a v=1 {BASE * NS}")
+            n = routers["n1"].routed_write("db", None, pts,
+                                           consistency="one")
+            assert n == 2  # local copy + hinted copy both acked
+            assert routers["n1"].pending_hint_nodes() == {"n2"}
+            netfault.clear_all()
+            assert routers["n1"].replay_hints() == 1
+            assert routers["n1"].pending_hint_nodes() == set()
+        finally:
+            for _e, svc in nodes.values():
+                svc.stop()
+                _e.close()
+
+    def test_error_rule_sheds_scan_cleanly(self, tmp_path):
+        """An injected 503 on /internal/scan surfaces as a clean
+        RemoteScanError (shed classification), never a node-down."""
+        addrs: dict = {}
+        store = StoreStub(addrs)
+        nodes = {nid: _mk_node(tmp_path, nid, addrs)
+                 for nid in ("n1", "n2")}
+        store.fsm = FsmStub(addrs)
+        routers = _wire(nodes, addrs, store)
+        try:
+            netfault.set_rule("n1", addrs["n2"], "/internal/scan",
+                              "error:503")
+            with pytest.raises(RemoteScanError, match="rejected scan"):
+                routers["n1"].scan_shards("db", None, "cpu",
+                                          -(2**62), 2**62)
+        finally:
+            for _e, svc in nodes.values():
+                svc.stop()
+                _e.close()
+
+
+class TestCircuitBreaker:
+    def test_state_machine(self):
+        br = CircuitBreaker(threshold=2, cooldown_s=0.08)
+        assert br.allow("p") and br.state("p") == "closed"
+        br.record("p", False)
+        assert br.allow("p")  # one failure: still closed
+        br.record("p", False)
+        assert not br.allow("p") and br.state("p") == "open"
+        assert br.is_open("p")
+        time.sleep(0.1)
+        assert br.state("p") == "half-open"
+        assert br.allow("p")       # the single half-open trial
+        assert not br.allow("p")   # concurrent callers stay failed-fast
+        br.record("p", False)      # trial failed: reopen
+        assert not br.allow("p")
+        time.sleep(0.1)
+        assert br.allow("p")
+        br.record("p", True)       # trial succeeded: closed
+        assert br.allow("p") and br.state("p") == "closed"
+        # an HTTP-status answer counts as transport OK
+        br.record("p", False)
+        br.record("p", True)
+        assert br.state("p") == "closed"
+
+    def test_breaker_fast_fails_dead_peer_and_feeds_node_up(self, tmp_path):
+        addrs: dict = {}
+        store = StoreStub(addrs)
+        e, svc = _mk_node(tmp_path, "n1", addrs)
+        addrs["dead"] = "127.0.0.1:1"  # nothing listens there
+        store.fsm = FsmStub(addrs)
+        router = DataRouter(e, store, "n1", addrs["n1"])
+        router.breaker = CircuitBreaker(threshold=2, cooldown_s=30.0)
+        try:
+            for _ in range(2):
+                with pytest.raises(RemoteScanError):
+                    router.forward_points("dead", "db", None, [])
+            # breaker open: the next call fails fast with CircuitOpen
+            # (an OSError flattened into RemoteScanError by the caller)
+            with pytest.raises(RemoteScanError) as ei:
+                router.forward_points("dead", "db", None, [])
+            assert isinstance(ei.value.__cause__, CircuitOpen)
+            # and the failure view agrees without waiting for a probe
+            assert router.node_up("dead") is False
+            assert router.node_up("n1") is True
+            snap = router.breaker.snapshot()
+            assert snap["peers"]["127.0.0.1:1"]["state"] == "open"
+        finally:
+            svc.stop()
+            e.close()
+
+    def test_rpc_retries_recover_transient_faults(self, tmp_path):
+        """With OGT_RPC_RETRIES semantics (retries=1), a single injected
+        drop is absorbed by the retry: the write lands and ACKs."""
+        addrs: dict = {}
+        store = StoreStub(addrs)
+        nodes = {nid: _mk_node(tmp_path, nid, addrs)
+                 for nid in ("n1", "n2")}
+        store.fsm = FsmStub(addrs)
+        routers = _wire(nodes, addrs, store)
+        try:
+            r1 = routers["n1"]
+            r1.rpc_retries = 1
+            r1.rpc_backoff_ms = 1.0
+            calls = {"n": 0}
+            orig = netfault.check
+
+            def one_shot(src, path, *dsts):
+                if path == "/internal/write" and calls["n"] == 0:
+                    calls["n"] += 1
+                    raise netfault.NetFault("netfault: dropped once")
+                return orig(src, path, *dsts)
+
+            netfault.check = one_shot
+            try:
+                # route a point whose group lands on n2 (force via
+                # forward_points: the retry loop is in _post_raw)
+                pts = _parse(f"cpu,host=a v=1 {BASE * NS}")
+                r1.forward_points("n2", "db", None, pts)
+            finally:
+                netfault.check = orig
+            assert calls["n"] == 1  # dropped once, retried, delivered
+            res = _count(addrs, "n2")
+            assert res["series"][0]["values"][0][1] == 1
+        finally:
+            for _e, svc in nodes.values():
+                svc.stop()
+                _e.close()
+
+
+class TestCtrlEndpoints:
+    def test_netfault_ctrl_arm_status_heal(self, tmp_path):
+        addrs: dict = {}
+        store = StoreStub(addrs)
+        e, svc = _mk_node(tmp_path, "n1", addrs)
+        store.fsm = FsmStub(addrs)
+        svc.router = DataRouter(e, store, "n1", addrs["n1"])
+        base = f"http://{addrs['n1']}/debug/ctrl"
+        try:
+            def ctrl(qs):
+                req = urllib.request.Request(base + "?" + qs, method="POST")
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, json.loads(r.read())
+
+            code, got = ctrl("mod=netfault&src=*&dst=x:1&path=/internal/*"
+                             "&action=drop")
+            assert code == 200 and len(got["rules"]) == 1
+            code, got = ctrl("mod=netfault")
+            assert got["rules"][0]["dst"] == "x:1"
+            code, got = ctrl("mod=netfault&clear=1")
+            assert got["rules"] == []
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                ctrl("mod=netfault&src=*&dst=*&path=*&action=dorp")
+            assert ei.value.code == 400
+        finally:
+            netfault.clear_all()
+            svc.stop()
+            e.close()
+
+    def test_cluster_ctrl_status_and_knobs(self, tmp_path):
+        addrs: dict = {}
+        store = StoreStub(addrs)
+        e, svc = _mk_node(tmp_path, "n1", addrs)
+        store.fsm = FsmStub(addrs)
+        svc.router = DataRouter(e, store, "n1", addrs["n1"])
+        try:
+            req = urllib.request.Request(
+                f"http://{addrs['n1']}/debug/ctrl?mod=cluster"
+                "&cb_threshold=3&cb_cooldown_s=0.5&probe_timeout_s=1.5",
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                got = json.loads(r.read())
+            assert got["status"] == "ok"
+            assert got["breaker"]["threshold"] == 3
+            assert got["staging"] == [] and got["pending_hints"] == []
+            assert svc.router.breaker.cooldown_s == 0.5
+            assert svc.router.probe_timeout_s == 1.5
+            # forced service rounds answer synchronously
+            req = urllib.request.Request(
+                f"http://{addrs['n1']}/debug/ctrl?mod=cluster&op=hints",
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                got = json.loads(r.read())
+            assert got["delivered"] == 0
+        finally:
+            svc.stop()
+            e.close()

@@ -64,6 +64,7 @@ def test_kill_site_catalog_matches_armed_sites():
     (and the README catalog) rather than silently escaping coverage."""
     import re
 
+    from tools.cluster_torture import KILL_SITES as CLUSTER_KILL_SITES
     from tools.torture import KILL_SITES
 
     pkg = os.path.join(ROOT, "opengemini_tpu")
@@ -74,7 +75,11 @@ def test_kill_site_catalog_matches_armed_sites():
                 continue
             with open(os.path.join(dirpath, f), encoding="utf-8") as fh:
                 armed.update(re.findall(r'_fp\("([^"]+)"\)', fh.read()))
-    missing = set(KILL_SITES) - armed
+    # two kill rotations share one catalog: the single-node durability
+    # chain (tools/torture.py) and the cluster tier's decision edges
+    # (tools/cluster_torture.py) — both must stay armed in the code
+    catalog = set(KILL_SITES) | set(CLUSTER_KILL_SITES)
+    missing = catalog - armed
     assert not missing, f"torture sites not armed anywhere: {missing}"
     # object-store fault sites simulate REMOTE failures (torn/missing
     # bucket objects), not local crash points — the cold tier has its
@@ -90,7 +95,7 @@ def test_kill_site_catalog_matches_armed_sites():
     not_on_chain |= {"governor-admit", "governor-queue", "governor-shed",
                      "governor-overdraft-kill", "governor-backpressure-on",
                      "governor-backpressure-off"}
-    untortured = armed - set(KILL_SITES) - not_on_chain
+    untortured = armed - catalog - not_on_chain
     assert not untortured, (
         f"armed sites missing from the torture kill rotation: {untortured}")
 

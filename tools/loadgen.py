@@ -18,8 +18,12 @@ Used three ways:
 
 Durability accounting: client i writes rows with tag client=c<i> and a
 unique per-client timestamp (seq-derived), and records each ACKED batch
-(seq range) — so a verifier can prove every acked row is readable
-afterwards (the same acked-row contract the torture harness checks).
+(seq range + write-consistency level + coordinator) — so a verifier can
+prove every acked row is readable afterwards at its consistency level
+(the acked-row contract the torture harnesses check).  Cluster mode:
+`targets` spreads clients over multiple coordinators with transport
+failover, and `ack_log` journals every acked batch fsynced — the ground
+truth tools/cluster_torture.py verifies against.
 """
 
 from __future__ import annotations
@@ -89,15 +93,52 @@ class RssSampler:
         return self.peak_mb
 
 
+class _AckLog:
+    """Fsynced acked-batch journal: the cluster torture harness's ground
+    truth.  Each acked write appends one JSON line AFTER the 2xx came
+    back, flushed + fsynced before the client proceeds — so the recorded
+    set is a subset of what the cluster acked even if the harness itself
+    dies (the same discipline as tools/torture.py's ack log)."""
+
+    def __init__(self, path: str):
+        import os
+
+        self._f = open(path, "a", encoding="utf-8")
+        self._os = os
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def record(self, rec: dict) -> None:
+        with self._lock:
+            if self._closed:
+                return  # a stuck client's late ack after close: the
+                # journaled set stays a subset of the cluster's acks
+            self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            self._f.flush()
+            self._os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._f.close()
+
+
 class _ClientState:
     __slots__ = ("idx", "seq", "acked", "write_lat", "query_lat",
                  "sheds_429", "sheds_503", "retry_after_seen", "killed",
-                 "errors", "error_samples")
+                 "errors", "error_samples", "level", "targets", "target_i")
 
-    def __init__(self, idx: int):
+    def __init__(self, idx: int, level: str | None = None,
+                 targets: list[str] | None = None):
         self.idx = idx
+        self.level = level  # write consistency recorded per acked batch
+        self.targets = targets or []  # "host:port" coordinators, failover
+        self.target_i = 0
         self.seq = 0
-        self.acked: list[tuple[int, int]] = []  # (start_seq, n) acked
+        # acked batches: {"seq": start, "n": rows, "level": consistency,
+        # "target": coordinator} — the verifier knows which rows must
+        # survive which failure from the level
+        self.acked: list[dict] = []
         self.write_lat: list[float] = []
         self.query_lat: list[float] = []
         self.sheds_429 = 0
@@ -113,28 +154,59 @@ class _ClientState:
             self.error_samples.append(what)
 
 
-def client_base_ts(idx: int) -> int:
+def client_base_ts(idx: int, ts_scale: int = 10**12) -> int:
     """Per-client disjoint timestamp namespace (ns): rows never collide
-    across clients, so acked-row verification is an exact count."""
-    return (idx + 1) * 10**12
+    across clients, so acked-row verification is an exact count.
+    `ts_scale` spaces the namespaces — the cluster torture passes a
+    scale wider than a shard-group duration so clients land in DISTINCT
+    shard groups (migration/balance faults need several groups)."""
+    return (idx + 1) * ts_scale
 
 
 def run_load(host: str, port: int, db: str, clients: int = 8,
              duration_s: float = 5.0, write_frac: float = 0.5,
              target_qps: float | None = None, batch_rows: int = 50,
              measurement: str = "loadgen", query: str | None = None,
-             timeout_s: float = 10.0) -> dict:
+             timeout_s: float = 10.0, targets: list[str] | None = None,
+             consistency: str | list[str] | None = None,
+             ack_log: str | None = None, client_offset: int = 0,
+             ts_scale: int = 10**12) -> dict:
     """Run the closed-loop load; returns the aggregate summary dict.
     Shed responses (429 write backpressure / 503 admission) count
-    separately from errors — shedding is the governor WORKING."""
+    separately from errors — shedding is the governor WORKING.
+
+    Cluster mode: `targets` is a list of "host:port" coordinators —
+    clients round-robin across them and FAIL OVER to the next on a
+    transport error (a killed node costs its clients one failed request,
+    not the rest of the run).  `consistency` sets the /write consistency
+    level; a list cycles per client (e.g. ["one", "quorum"]) and the
+    level is recorded on every acked batch.  `ack_log` appends each
+    acked batch to an fsynced journal.  `client_offset` shifts the
+    client tag/timestamp namespace so successive runs against the same
+    database stay disjoint."""
     if query is None:
         query = f"SELECT count(v) FROM {measurement}"
-    states = [_ClientState(i) for i in range(clients)]
+    if targets is None:
+        targets = [f"{host}:{port}"]
+    levels = ([consistency] if isinstance(consistency, str)
+              else list(consistency or [None]))
+    states = [
+        _ClientState(client_offset + i, level=levels[i % len(levels)],
+                     targets=targets[i % len(targets):]
+                     + targets[: i % len(targets)])
+        for i in range(clients)
+    ]
+    journal = _AckLog(ack_log) if ack_log else None
     stop_at = time.monotonic() + duration_s
     per_client_qps = (target_qps / clients) if target_qps else None
 
+    def _connect(st: _ClientState):
+        h, _, p = st.targets[st.target_i % len(st.targets)].partition(":")
+        return http.client.HTTPConnection(h, int(p or 80),
+                                          timeout=timeout_s)
+
     def worker(st: _ClientState) -> None:
-        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        conn = _connect(st)
         # deterministic write/query mix per client: no RNG, exact fraction
         acc = 0.0
         next_at = time.monotonic()
@@ -154,18 +226,30 @@ def run_load(host: str, port: int, db: str, clients: int = 8,
                 t0 = time.monotonic()
                 try:
                     if do_write:
-                        base = client_base_ts(st.idx) + st.seq
+                        base = client_base_ts(st.idx, ts_scale) + st.seq
                         body = "".join(
                             f"{measurement},client=c{st.idx} v={st.seq + k}i "
                             f"{base + k}\n"
                             for k in range(batch_rows)
                         ).encode()
-                        conn.request("POST", f"/write?db={db}", body=body)
+                        url = f"/write?db={db}"
+                        if st.level:
+                            url += f"&consistency={st.level}"
+                        conn.request("POST", url, body=body)
                         resp = conn.getresponse()
                         resp.read()
                         dt = time.monotonic() - t0
                         if resp.status == 204:
-                            st.acked.append((st.seq, batch_rows))
+                            rec = {"client": st.idx, "seq": st.seq,
+                                   "n": batch_rows, "level": st.level,
+                                   "target": st.targets[
+                                       st.target_i % len(st.targets)]}
+                            if journal is not None:
+                                # journal BEFORE counting it acked: a
+                                # harness crash must never know of an
+                                # acked batch the journal missed
+                                journal.record(rec)
+                            st.acked.append(rec)
                             st.seq += batch_rows
                             st.write_lat.append(dt)
                         elif resp.status == 429:
@@ -213,8 +297,10 @@ def run_load(host: str, port: int, db: str, clients: int = 8,
                         conn.close()
                     except OSError:
                         pass
-                    conn = http.client.HTTPConnection(
-                        host, port, timeout=timeout_s)
+                    # fail over to the next coordinator in this client's
+                    # rotation (single-target mode reconnects in place)
+                    st.target_i += 1
+                    conn = _connect(st)
         finally:
             try:
                 conn.close()
@@ -233,6 +319,8 @@ def run_load(host: str, port: int, db: str, clients: int = 8,
         t.join(timeout=duration_s + 4 * timeout_s)
     alive = sum(1 for t in threads if t.is_alive())
     wall_s = time.monotonic() - t_start
+    if journal is not None:
+        journal.close()
 
     writes_ok = sum(len(st.write_lat) for st in states)
     queries_ok = sum(len(st.query_lat) for st in states)
@@ -247,7 +335,7 @@ def run_load(host: str, port: int, db: str, clients: int = 8,
         "qps": round(attempts / max(wall_s, 1e-9), 1),
         "writes": _lat_summary([v for st in states for v in st.write_lat]),
         "queries": _lat_summary([v for st in states for v in st.query_lat]),
-        "acked_rows": sum(n for st in states for _s, n in st.acked),
+        "acked_rows": sum(r["n"] for st in states for r in st.acked),
         "acked_batches": {st.idx: st.acked for st in states},
         "sheds_429": sum(st.sheds_429 for st in states),
         "sheds_503": sum(st.sheds_503 for st in states),
@@ -272,11 +360,24 @@ def main() -> None:
     ap.add_argument("--target-qps", type=float, default=None)
     ap.add_argument("--batch-rows", type=int, default=50)
     ap.add_argument("--measurement", default="loadgen")
+    ap.add_argument("--targets", default=None,
+                    help="comma-separated host:port coordinators "
+                         "(multi-node; clients fail over between them)")
+    ap.add_argument("--consistency", default=None,
+                    help="write consistency level, or a comma-separated "
+                         "list cycled per client (recorded per batch)")
+    ap.add_argument("--ack-log", default=None,
+                    help="append each acked batch to this fsynced journal")
     args = ap.parse_args()
+    levels = args.consistency.split(",") if args.consistency else None
     out = run_load(args.host, args.port, args.db, clients=args.clients,
                    duration_s=args.duration, write_frac=args.write_frac,
                    target_qps=args.target_qps, batch_rows=args.batch_rows,
-                   measurement=args.measurement)
+                   measurement=args.measurement,
+                   targets=args.targets.split(",") if args.targets else None,
+                   consistency=(levels[0] if levels and len(levels) == 1
+                                else levels),
+                   ack_log=args.ack_log)
     out.pop("acked_batches", None)  # CLI summary stays readable
     print(json.dumps(out, indent=1))
 
