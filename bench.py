@@ -783,6 +783,107 @@ def bench_colcache_warm(rows: int = 4_000_000, chunk: int = 16_384,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_rollup_dashboard(rows: int = 2_000_000, series: int = 12,
+                           span_s: int = 7200) -> dict:
+    """Materialized-rollup dashboard speedup (storage/rollup.py +
+    query/rollupplan.py acceptance metric): the same warm GROUP BY
+    time(1m) dashboard query answered via the planner splice vs a forced
+    raw scan, best-of-3 each, RESULT EQUALITY asserted between the two
+    paths.  The incremental result cache is bypassed (fresh executor per
+    run) so the ratio isolates rollup-vs-raw, not cache hits; the
+    decoded-column cache stays on for BOTH sides (the raw path gets its
+    best case and must still lose).  Values are integers so splice and
+    raw agree bit-for-bit.  Also reports the maintenance-lag gauge
+    (watermark age / dirty backlog) after a trailing live write."""
+    import json as _json
+    import shutil
+    import tempfile
+
+    from opengemini_tpu.query.executor import Executor
+    from opengemini_tpu.storage.engine import Engine
+    from opengemini_tpu.storage.rollup import RollupSpec
+    from opengemini_tpu.utils.stats import GLOBAL as _STATS
+
+    NS = 1_000_000_000
+    base = 1_700_000_040  # minute-aligned
+    root = tempfile.mkdtemp(prefix="ogtpu-rollup-")
+    eng = None
+    try:
+        eng = Engine(root, flush_threshold_bytes=1 << 30)
+        eng.create_database("db")
+        per_series = rows // series
+        step_ns = span_s * NS // per_series
+        batch = 200_000
+        for lo in range(0, per_series, batch):
+            n = min(batch, per_series - lo)
+            lines = []
+            for s in range(series):
+                t0 = base * NS + lo * step_ns + s * 7  # disjoint ns offsets
+                lines.extend(
+                    f"cpu,host=h{s} v={(lo + k) % 1000}i {t0 + k * step_ns}"
+                    for k in range(n)
+                )
+            eng.write_lines("db", "\n".join(lines))
+        eng.flush_all()
+        eng.create_rollup("db", RollupSpec("cpu_1m", "cpu", 60 * NS,
+                                          sketch=False))
+        now_ns = (base + span_s + 120) * NS
+        t0 = time.perf_counter()
+        folded = eng.rollup_mgr.maintain(now_ns=now_ns)  # backfill fold
+        fold_s = time.perf_counter() - t0
+        q = (f"SELECT mean(v), max(v), count(v) FROM cpu "
+             f"WHERE time >= {base * NS} AND time < {(base + span_s) * NS} "
+             f"GROUP BY time(1m), host")
+
+        def timed(read_enabled: bool):
+            eng.rollup_mgr.read_enabled = read_enabled
+            best, res = float("inf"), None
+            for _ in range(3):
+                ex = Executor(eng)  # fresh: empty incremental cache
+                t1 = time.perf_counter()
+                res = ex.execute(q, db="db", now_ns=now_ns)
+                best = min(best, time.perf_counter() - t1)
+            return best, res
+
+        timed(False)  # warm the decoded-column / OS caches for raw
+        t_raw, res_raw = timed(False)
+        t_splice, res_splice = timed(True)
+        eng.rollup_mgr.read_enabled = True
+        identical = (_json.dumps(res_splice, sort_keys=True)
+                     == _json.dumps(res_raw, sort_keys=True))
+        assert identical, "rollup splice result != forced raw scan result"
+        # maintenance lag after a live write lands beyond the watermark
+        # (status is computed against the bench's synthetic clock — the
+        # /debug/vars gauge uses wall time, meaningless for 2023 data)
+        eng.write_lines(
+            "db", f"cpu,host=h0 v=1i {(base + span_s + 60) * NS}")
+        status = eng.rollup_mgr.status(now_ns=now_ns)["db.cpu_1m"]
+        backlog = status["dirty_windows"] + max(
+            0, (now_ns - 60 * NS - status["watermark_ns"]) // (60 * NS))
+        return {
+            "rows": per_series * series,
+            "series": series,
+            "windows": span_s // 60,
+            "fold_s": round(fold_s, 3),
+            "windows_folded": folded,
+            "raw_ms": round(t_raw * 1000, 2),
+            "splice_ms": round(t_splice * 1000, 2),
+            "rollup_dashboard_speedup": round(t_raw / max(t_splice, 1e-9), 2),
+            "results_identical": identical,
+            "splice_stats": {
+                k: v for k, v in _STATS.counters("rollup").items()
+                if k.startswith("splice_")},
+            "maintenance_lag": {
+                "watermark_age_s": status["watermark_age_s"],
+                "dirty_backlog": int(backlog),
+            },
+        }
+    finally:
+        if eng is not None:
+            eng.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_overload_shed(clients: int = 32, duration_s: float = 6.0,
                         budget_mb: int = 4) -> dict:
     """Resource-governor overload behavior (PR 5 acceptance metric): a
@@ -1448,6 +1549,20 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     except Exception as e:  # noqa: BLE001 — bench must still emit
         print(f"bench: colcache warm failed: {e}", file=sys.stderr)
 
+    # materialized-rollup dashboard splice: warm GROUP BY time(1m) via
+    # rollup cells vs forced raw scan, equality asserted (the PR 7
+    # acceptance metric: >= 5x) + maintenance lag gauge
+    rollup_dash = None
+    try:
+        rollup_dash = bench_rollup_dashboard(
+            rows=int(os.environ.get("OGTPU_BENCH_ROLLUP_ROWS", "2000000")))
+        _emit("rollup_dashboard_speedup" + suffix,
+              rollup_dash["rollup_dashboard_speedup"], "x",
+              rollup_dash["rollup_dashboard_speedup"],
+              {"detail": rollup_dash})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: rollup dashboard failed: {e}", file=sys.stderr)
+
     # resource-governor overload shedding: tiny budget, 32 closed-loop
     # clients — shed rate + admitted-query p99 + peak RSS vs budget
     # (the PR 5 acceptance metric)
@@ -1513,6 +1628,8 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
         extra["ingest_during_flush"] = ingest_flush
     if colcache_warm:
         extra["colcache_warm"] = colcache_warm
+    if rollup_dash:
+        extra["rollup_dashboard"] = rollup_dash
     if overload:
         extra["overload_shed"] = overload
     if rebalance:

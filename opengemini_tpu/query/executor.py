@@ -1304,6 +1304,44 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                 for lo, hi in cache_plan.scan_ranges
             ]
 
+        # materialized-rollup splice (storage/rollup.py + rollupplan.py):
+        # windows below the rollup watermark and not dirty are answered
+        # from persisted rollup cells; the raw scan shrinks to the live
+        # tail + re-dirtied windows.  Runs INSIDE the result-cache's
+        # stale set so both layers compose; nothing here executes when no
+        # rollup spec matches (engine.rollup_mgr is None pass-through).
+        rollup_plan = None
+        if (
+            not full_hit
+            and group_time is not None
+            and aggs
+            and not time_aggs
+            and self.router is None
+            and ctx.live is None
+            and getattr(self.engine, "rollup_mgr", None) is not None
+        ):
+            from opengemini_tpu.query import rollupplan as rplan
+
+            rollup_plan = rplan.try_plan(
+                self.engine.rollup_mgr, db, rp, mst, sc, ctx, aggs,
+                schema, cache_plan, tmin, tmax)
+        if rollup_plan is not None:
+            with trace.span("rollup") as sp:
+                t0_rollup = _time.perf_counter_ns()
+                rollup_plan.fetch()
+                TRACKER.add_stage_ns(
+                    TRACKER.current_qid(), "rollup",
+                    _time.perf_counter_ns() - t0_rollup)
+                sp.add_field("windows_spliced", len(rollup_plan.serve))
+                sp.add_field("rollup_rows", rollup_plan.rows_read)
+            if rollup_plan.serve:
+                scan_ranges = rollup_plan.scan_ranges
+            else:
+                rollup_plan = None
+        # no raw scan at all: every window comes from the result cache
+        # and/or the rollup splice
+        no_scan = full_hit or (rollup_plan is not None and not scan_ranges)
+
         # string fields: count counts, mean answers influx's constant 0,
         # stddev answers null (server_test.go Aggregates_String — the
         # zero payload of string columns makes both fall out below);
@@ -1385,7 +1423,7 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
             group_time is not None
             and not time_aggs
             and not pre_eligible
-            and not full_hit
+            and not no_scan
             and self.router is None
             and ctx.live is None
             and W >= 8
@@ -1402,7 +1440,7 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         # ledger kills this query through the tracker (clean error, no
         # OOM).  Zero-cost no-op when the governor is disabled.
         reservation = contextlib.nullcontext()
-        if GOVERNOR.enabled() and not full_hit:
+        if GOVERNOR.enabled() and not no_scan:
             est = estimate_scan_bytes(
                 shards, mst, tmin, tmax,
                 len(read_fields) if read_fields is not None else
@@ -1410,7 +1448,7 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
             reservation = GOVERNOR.scan_reservation(
                 TRACKER.current_qid(), est)
         with reservation, trace.span("scan") as scan_span:
-            if full_hit:
+            if no_scan:
                 rows_scanned = 0
             elif slice_plan is not None:
                 rows_scanned, sliced_out = self._scan_sliced(
@@ -1451,8 +1489,9 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         with trace.span("device_compute") as sp:
             for call, spec, params, field_name in aggs:
                 TRACKER.check()  # kill between device batch dispatches
-                if full_hit:
-                    # every window served from cache: no scan, no device
+                if no_scan:
+                    # every window served from cache/rollup: no scan, no
+                    # device work
                     dt = (np.int64 if isinstance(
                         batches[field_name], ragged.IntExactBatch)
                         and spec.name in ("sum", "count") else np.float64)
@@ -1600,6 +1639,10 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                     )
                 sp.add_field("peers", len(peer_docs))
 
+        if rollup_plan is not None:
+            # before the cache merge: the cache persists the spliced
+            # windows (they sit in its stale set) from these arrays
+            group_keys = rollup_plan.merge(agg_results, aggs, group_keys)
         if cache_plan is not None:
             with trace.span("inc_cache"):
                 group_keys = cache_plan.merge(agg_results, aggs, group_keys)

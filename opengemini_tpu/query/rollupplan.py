@@ -1,0 +1,302 @@
+"""Planner splice over materialized rollups (storage/rollup.py).
+
+For an eligible ``GROUP BY time(T)`` aggregate query — T a multiple of a
+declared rollup's interval, grid on the rollup's boundaries, tags-only
+WHERE, every aggregate derivable from rollup cells (count/sum/min/max,
+mean = s/c, percentile from the spec's sketches) — the executor builds a
+RollupPlan: windows wholly below the rollup's durable watermark and not
+dirty are answered from rollup rows; everything else (the live tail,
+re-dirtied late windows, partial edge windows) stays a raw scan.  The
+plan composes with the incremental result cache (query/resultcache.py):
+it only ever serves windows the cache already classified stale, and the
+cells it fills are persisted back into the cache under the raw shards'
+freshness signatures — valid because a clean rollup window is equal to
+its raw computation by the watermark/dirty contract.
+
+merge() mirrors resultcache.CachePlan.merge's array staging (int-exact
+columns stay integer end-to-end) and runs BEFORE the cache merge so both
+layers see one consistent array set.
+"""
+
+from __future__ import annotations
+
+import base64
+
+import numpy as np
+
+from opengemini_tpu.storage import rollup as rmod
+from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+
+def try_plan(mgr, db, rp, mst, sc, ctx, aggs, schema, cache_plan,
+             tmin, tmax):
+    """Build a RollupPlan or return None (query ineligible / nothing
+    servable).  Cheap when no spec matches: two dict lookups."""
+    if mgr is None or not mgr.read_enabled:
+        return None
+    group_time = ctx.group_time
+    if group_time is None or not aggs:
+        return None
+    if sc.field_expr is not None or sc.mixed_expr is not None:
+        return None  # row-level filters are not derivable from cells
+    spec = mgr.spec_for(db, rp, mst, group_time.every_ns, ctx.aligned)
+    if spec is None:
+        return None
+    for _call, aspec, _params, fname in aggs:
+        if aspec.name == "percentile":
+            if not spec.sketch:
+                return None
+        elif aspec.name not in rmod.DERIVABLE:
+            return None
+        if spec.fields is not None and fname not in spec.fields:
+            return None
+    plan = RollupPlan(mgr, db, spec, sc, ctx, aggs, tmin, tmax, cache_plan)
+    if not plan.serve:
+        STATS.incr("rollup", "splice_misses")
+        return None
+    return plan
+
+
+class RollupPlan:
+    def __init__(self, mgr, db, spec, sc, ctx, aggs, tmin, tmax,
+                 cache_plan):
+        self.mgr = mgr
+        self.db = db
+        self.spec = spec
+        self.sc = sc
+        self.aggs = aggs
+        self.group_tags = ctx.group_tags
+        self.aligned = ctx.aligned
+        self.every = ctx.group_time.every_ns
+        self.W = ctx.W
+        self.tmin = tmin
+        self.tmax = tmax
+        self.rows_read = 0
+        wstarts = [self.aligned + w * self.every for w in range(self.W)]
+        partial = {
+            w for w in range(self.W)
+            if wstarts[w] < tmin or wstarts[w] + self.every > tmax
+        }
+        candidate = (set(cache_plan.stale) if cache_plan is not None
+                     else set(range(self.W)))
+        self.candidate = candidate
+        wm, dirty = mgr.serve_view(db, spec)
+        # map each dirty rollup window into its containing QUERY window
+        # once (the dirty set is bounded; probing every sub-window of
+        # every query window would be O(W * T/interval))
+        span_hi = self.aligned + self.W * self.every
+        dirty_qw = {
+            int((s - self.aligned) // self.every)
+            for s in dirty if self.aligned <= s < span_hi
+        }
+        serve = set()
+        for w in candidate - partial:
+            if wstarts[w] + self.every > wm or w in dirty_qw:
+                continue
+            serve.add(w)
+        self.wstarts = wstarts
+        self.serve = serve
+        # {w: {group_key: [(value, count) per agg]}}
+        self.cells: dict[int, dict[tuple, list]] = {}
+
+    @property
+    def scan_ranges(self):
+        """Disjoint [lo, hi) raw ranges covering the candidate windows
+        the rollup does NOT serve, clamped to the query bounds ([] =
+        fully spliced, no raw scan at all)."""
+        runs = []
+        for w in sorted(self.candidate - self.serve):
+            ws = self.wstarts[w]
+            we = ws + self.every
+            if runs and runs[-1][1] == ws:
+                runs[-1][1] = we
+            else:
+                runs.append([ws, we])
+        return [(max(self.tmin, lo), min(self.tmax, hi))
+                for lo, hi in runs if max(self.tmin, lo) < min(self.tmax, hi)]
+
+    # -- cell fetch -----------------------------------------------------------
+
+    def fetch(self) -> int:
+        """Read the rollup rows for the served windows and finalize
+        per-(group, window) aggregate cells.  A window whose cells
+        cannot answer an aggregate (e.g. a sketch persisted before the
+        spec kept them) falls OUT of the serve set here — fetch runs
+        before the raw scan ranges are consumed, so it simply re-joins
+        the raw tail."""
+        from opengemini_tpu.query.sketch import RollupSketch
+
+        runs = []
+        for w in sorted(self.serve):
+            ws = self.wstarts[w]
+            if runs and runs[-1][1] == ws:
+                runs[-1][1] = ws + self.every
+            else:
+                runs.append([ws, ws + self.every])
+        fields = sorted({a[3] for a in self.aggs})
+        recs = self.mgr.read_recs(self.db, self.spec, runs, fields,
+                                  tag_expr=self.sc.tag_expr)
+        self.rows_read = sum(len(r) for _t, r in recs)
+        need_sketch = any(a[1].name == "percentile" for a in self.aggs)
+        W = self.W
+        # vectorized accumulation: per (group, field) window arrays —
+        # the per-row python loop was the splice's hot spot at dashboard
+        # shapes (thousands of rollup rows per query)
+        # accs[gkey][fname] = [cnt W-arr, sum W-arr, mn W-arr, mx W-arr,
+        #                      {w: sketch}]
+        accs: dict[tuple, dict[str, list]] = {}
+        for tags, rec in recs:
+            tagd = dict(tags)
+            gkey = tuple(tagd.get(k, "") for k in self.group_tags)
+            per_f = accs.setdefault(gkey, {})
+            widx = ((rec.times - self.aligned) // self.every).astype(
+                np.int64)
+            ok = np.fromiter((int(w) in self.serve for w in widx),
+                             np.bool_, len(widx))
+            for fname in fields:
+                c_col = rec.columns.get(rmod.C_ + fname)
+                if c_col is None:
+                    continue
+                m = ok & c_col.valid & (c_col.values > 0)
+                if not m.any():
+                    continue
+                wv = widx[m]
+                acc = per_f.get(fname)
+                if acc is None:
+                    acc = per_f[fname] = [
+                        np.zeros(W, np.int64), None, None, None, {}]
+                np.add.at(acc[0], wv, c_col.values[m].astype(np.int64))
+                for slot, prefix, combine in (
+                        (1, rmod.S_, "sum"), (2, rmod.MN_, "min"),
+                        (3, rmod.MX_, "max")):
+                    col = rec.columns.get(prefix + fname)
+                    if col is None:
+                        continue
+                    vm = m & col.valid
+                    if not vm.any():
+                        continue
+                    vals = col.values[vm]
+                    wvv = widx[vm]
+                    arr = acc[slot]
+                    if arr is None:
+                        if combine == "sum":
+                            init = 0
+                        elif vals.dtype.kind in "iu":
+                            init = (np.iinfo(np.int64).max
+                                    if combine == "min"
+                                    else np.iinfo(np.int64).min)
+                        else:
+                            init = (np.inf if combine == "min"
+                                    else -np.inf)
+                        arr = acc[slot] = np.full(W, init, vals.dtype)
+                    if combine == "sum":
+                        np.add.at(arr, wvv, vals)
+                    elif combine == "min":
+                        np.minimum.at(arr, wvv, vals)
+                    else:
+                        np.maximum.at(arr, wvv, vals)
+                if need_sketch:
+                    col = rec.columns.get(rmod.SK_ + fname)
+                    if col is not None:
+                        vm = np.flatnonzero(m & col.valid)
+                        for i in vm:
+                            b64 = col.values[i]
+                            if not b64:
+                                continue
+                            sk = RollupSketch.deserialize(
+                                base64.b64decode(b64))
+                            w = int(widx[i])
+                            held = acc[4].get(w)
+                            if held is None:
+                                acc[4][w] = sk
+                            else:
+                                held.merge(sk)
+        bad: set[int] = set()
+        for gkey, per_f in accs.items():
+            windows = set()
+            for acc in per_f.values():
+                windows.update(np.flatnonzero(acc[0] > 0).tolist())
+            for w in windows:
+                self._finalize_cell(int(w), gkey, per_f, bad)
+        if bad:
+            self.serve -= bad
+            for w in bad:
+                self.cells.pop(w, None)
+        STATS.incr("rollup", "splice_hits")
+        STATS.incr("rollup", "splice_windows", len(self.serve))
+        STATS.incr("rollup", "splice_raw_windows",
+                   len(self.candidate - self.serve))
+        return self.rows_read
+
+    def _finalize_cell(self, w, gkey, per_f, bad):
+        out_cells = []
+        for _call, aspec, params, fname in self.aggs:
+            acc = per_f.get(fname)
+            cnt = int(acc[0][w]) if acc is not None else 0
+            if not cnt:
+                out_cells.append((0, 0))
+                continue
+            s = acc[1][w].item() if acc[1] is not None else 0
+            mn = acc[2][w].item() if acc[2] is not None else None
+            mx = acc[3][w].item() if acc[3] is not None else None
+            sk = acc[4].get(w)
+            name = aspec.name
+            if name == "count":
+                out_cells.append((cnt, cnt))
+            elif name == "sum":
+                out_cells.append((s, cnt))
+            elif name == "min":
+                out_cells.append((mn if mn is not None else 0.0, cnt))
+            elif name == "max":
+                out_cells.append((mx if mx is not None else 0.0, cnt))
+            elif name == "mean":
+                out_cells.append((s / cnt if cnt else 0.0, cnt))
+            else:  # percentile
+                if sk is None:
+                    bad.add(w)  # cell predates sketches: raw-scan it
+                    out_cells.append((0.0, 0))
+                    continue
+                qv = float(params[0]) if params else 0.0
+                v = sk.percentile(qv)
+                # influx: rank < 1 emits no row for the window — the
+                # executor zeroes device counts the same way
+                out_cells.append((0.0, 0) if v is None else (v, cnt))
+        self.cells.setdefault(w, {})[gkey] = out_cells
+
+    # -- merge into the computed arrays ---------------------------------------
+
+    def merge(self, agg_results, aggs, group_keys):
+        """Overwrite the served windows' cells into the aggregate arrays
+        (extending group_keys with rollup-only groups) — the same
+        contract as resultcache.CachePlan.merge, which runs after this
+        and persists the spliced windows under raw freshness
+        signatures."""
+        W = self.W
+        gid_of = {k: i for i, k in enumerate(group_keys)}
+        for w in sorted(self.serve):
+            for key in self.cells.get(w, ()):
+                if key not in gid_of:
+                    gid_of[key] = len(group_keys)
+                    group_keys.append(key)
+        G = len(group_keys)
+        n_seg = G * W
+        for ai, (call, _spec, _params, _fname) in enumerate(aggs):
+            out, _sel, counts, spec_, fname_, _times = agg_results[id(call)]
+            out = np.asarray(out)
+            new_out = np.zeros(n_seg, dtype=out.dtype)
+            new_cnt = np.zeros(n_seg, dtype=np.int64)
+            old_G = len(out) // W if W else 0
+            if len(out):
+                new_out.reshape(G, W)[:old_G] = out.reshape(old_G, W)
+                new_cnt.reshape(G, W)[:old_G] = np.asarray(
+                    counts).reshape(old_G, W)
+            int_out = new_out.dtype.kind in "iu"
+            for w in self.serve:
+                for key, cells in self.cells.get(w, {}).items():
+                    seg = gid_of[key] * W + w
+                    v, c = cells[ai]
+                    new_out[seg] = int(v) if int_out else float(v)
+                    new_cnt[seg] = c
+            agg_results[id(call)] = (new_out, None, new_cnt, spec_,
+                                     fname_, None)
+        return group_keys

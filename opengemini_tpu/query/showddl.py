@@ -725,6 +725,15 @@ class ShowDdlMixin:
                     None if sc.tmin == cond.MIN_TIME else sc.tmin,
                     None if sc.tmax == cond.MAX_TIME else sc.tmax,
                 )
+        if self.engine.rollup_mgr is not None:
+            # re-dirty the deleted span so maintenance re-folds it (and
+            # zero-fills series the delete emptied) — a clean-looking
+            # rollup window must never serve deleted rows
+            self.engine.rollup_mgr.note_delete(
+                db, stmt.measurement,
+                None if not has_time or sc.tmin == cond.MIN_TIME else sc.tmin,
+                None if not has_time or sc.tmax == cond.MAX_TIME else sc.tmax,
+            )
         return {}
 
     # -- SELECT -------------------------------------------------------------

@@ -227,6 +227,120 @@ def _tdigest_compress(means: np.ndarray, weights: np.ndarray,
     return out_m, out_w
 
 
+# -- rollup percentile cell (exact-until-K, then t-digest) -------------------
+
+
+class RollupSketch:
+    """Per-(series, window, field) percentile cell persisted by the
+    materialized-rollup subsystem (storage/rollup.py).
+
+    Two modes:
+      exact  — keeps the raw values while there are at most `exact_limit`
+               of them; `percentile()` reproduces influx's nearest-rank
+               semantics bit-for-bit, so a rollup-spliced percentile
+               equals the raw-scan answer (the splice fuzz asserts this).
+      digest — past the limit the cell degrades to an OGSketch (bounded
+               memory regardless of row count); `percentile()` is then
+               the t-digest interpolated quantile (documented approximate,
+               same trade the reference's downsampled quantiles make).
+
+    Merging (across series of one GROUP BY key, and across sub-windows
+    when the query's time(T) is a multiple of the rollup interval)
+    preserves exactness while the combined cell fits the limit."""
+
+    def __init__(self, exact_limit: int = 512, compression: int = 100):
+        self.exact_limit = int(exact_limit)
+        self.compression = int(compression)
+        self._vals: list[np.ndarray] = []
+        self._n = 0
+        self._digest: OGSketch | None = None
+
+    @property
+    def exact(self) -> bool:
+        return self._digest is None
+
+    @property
+    def n(self) -> float:
+        if self._digest is not None:
+            return self._digest.n
+        return float(self._n)
+
+    def add_values(self, values) -> None:
+        v = np.asarray(values, np.float64).ravel()
+        if not len(v):
+            return
+        if self._digest is not None:
+            self._digest.insert(v)
+            return
+        self._vals.append(v)
+        self._n += len(v)
+        if self._n > self.exact_limit:
+            self._degrade()
+
+    def merge(self, other: "RollupSketch") -> None:
+        if other._digest is None:
+            for v in other._vals:
+                self.add_values(v)
+            return
+        self._degrade()
+        self._digest.merge(other._digest)
+
+    def _degrade(self) -> None:
+        if self._digest is not None:
+            return
+        self._digest = OGSketch(self.compression)
+        for v in self._vals:
+            self._digest.insert(v)
+        self._vals, self._n = [], 0
+
+    def percentile(self, q_pct: float) -> float | None:
+        """Influx nearest-rank percentile in exact mode (rank
+        floor(n*q/100+0.5)-1, None when that rank is out of range — the
+        executor's 'no row for this window' rule); t-digest quantile in
+        digest mode."""
+        if self._digest is not None:
+            if self._digest.n <= 0:
+                return None
+            return self._digest.quantile(q_pct / 100.0)
+        if self._n == 0:
+            return None
+        allv = np.sort(np.concatenate(self._vals), kind="stable")
+        i = int(math.floor(len(allv) * q_pct / 100.0 + 0.5)) - 1
+        if i < 0 or i >= len(allv):
+            return None
+        return float(allv[i])
+
+    # -- wire ------------------------------------------------------------
+
+    def serialize(self) -> bytes:
+        if self._digest is not None:
+            return b"\x01" + self._digest.serialize()
+        head = np.asarray([self.exact_limit, self.compression], np.int64)
+        body = (np.concatenate(self._vals) if self._vals
+                else np.empty(0, np.float64))
+        return b"\x00" + head.tobytes() + body.tobytes()
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "RollupSketch":
+        if not raw:
+            raise ValueError("empty RollupSketch payload")
+        mode, rest = raw[0], raw[1:]
+        if mode == 1:
+            s = cls()
+            s._digest = OGSketch.deserialize(rest)
+            s.compression = s._digest.compression
+            return s
+        if mode != 0 or len(rest) < 16 or (len(rest) - 16) % 8:
+            raise ValueError("bad RollupSketch payload")
+        head = np.frombuffer(rest[:16], np.int64)
+        s = cls(int(head[0]), int(head[1]))
+        vals = np.frombuffer(rest[16:], np.float64).copy()
+        if len(vals):
+            s._vals = [vals]
+            s._n = len(vals)
+        return s
+
+
 # -- count-min sketch --------------------------------------------------------
 
 

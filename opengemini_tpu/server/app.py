@@ -366,6 +366,11 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
     from opengemini_tpu.services.stream import StreamService
 
     out.append(StreamService(svc.engine, float(sc.get("stream-interval-s", 5))))
+    from opengemini_tpu.services.rollup import RollupService
+
+    # inert (one None check per tick) until a rollup spec is declared
+    out.append(RollupService(
+        svc.engine, float(sc.get("rollup-interval-s", 5))))
     out.append(CompactionService(
         svc.engine, float(sc.get("compact-interval-s", 600)),
         int(sc.get("compact-max-files", 4)),

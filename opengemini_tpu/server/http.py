@@ -28,7 +28,8 @@ from opengemini_tpu.promql.parser import PromParseError, parse_duration_s
 from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query.executor import Executor
 from opengemini_tpu.record import FieldTypeConflict
-from opengemini_tpu.storage.engine import DatabaseNotFound, Engine, WriteError
+from opengemini_tpu.storage.engine import (NS, DatabaseNotFound, Engine,
+                                           WriteError)
 from opengemini_tpu.utils.failpoint import inject as _fp
 from opengemini_tpu.utils.governor import GOVERNOR, AdmissionRejected
 from opengemini_tpu.utils.stats import GLOBAL as STATS
@@ -963,6 +964,65 @@ def _make_handler(svc: HttpService):
                 out["breaker"] = router.breaker.snapshot()
                 out["staging"] = svc.engine.staging_ids()
                 out["pending_hints"] = sorted(router.pending_hint_nodes())
+                self._send_json(200, out)
+                return
+            elif mod == "rollup":
+                # materialized-rollup ops (storage/rollup.py):
+                #   (none)/status      per-spec watermark/dirty/backlog
+                #   op=flush           run maintenance synchronously NOW
+                #   op=invalidate      re-dirty [from,to) (all when unset)
+                #   op=declare         declare a spec (db, name,
+                #                      measurement, every_s | every_ns,
+                #                      [fields, sketch, delay_s, rp])
+                #   op=drop            drop a spec (db, name)
+                from opengemini_tpu.storage.rollup import (
+                    RollupSpec, enabled_by_env)
+
+                op = params.get("op", "")
+                mgr = svc.engine.rollup_mgr
+                out = {"status": "ok", "enabled": enabled_by_env()}
+                try:
+                    if op == "declare":
+                        every_ns = (
+                            int(params["every_ns"]) if "every_ns" in params
+                            else int(float(params["every_s"]) * NS))
+                        fields = (params["fields"].split(",")
+                                  if params.get("fields") else None)
+                        delay_ns = (int(float(params["delay_s"]) * NS)
+                                    if "delay_s" in params else None)
+                        spec = RollupSpec(
+                            params["name"], params["measurement"], every_ns,
+                            rp=params.get("rp") or None, fields=fields,
+                            sketch=params.get("sketch", "1") not in
+                            ("0", "false"),
+                            delay_ns=delay_ns)
+                        svc.engine.create_rollup(params["db"], spec)
+                        mgr = svc.engine.rollup_mgr
+                    elif op == "drop":
+                        svc.engine.drop_rollup(params["db"], params["name"])
+                    elif op == "flush":
+                        if mgr is not None:
+                            out["folded"] = mgr.maintain()
+                    elif op == "invalidate":
+                        if mgr is not None:
+                            out["invalidated"] = mgr.invalidate(
+                                params["db"], params.get("name") or None,
+                                int(params["from"]) if "from" in params
+                                else None,
+                                int(params["to"]) if "to" in params
+                                else None)
+                    elif op and op != "status":
+                        self._send_json(
+                            400, {"error": f"unknown rollup op {op!r}"})
+                        return
+                except KeyError as e:
+                    self._send_json(
+                        400, {"error": f"missing parameter {e.args[0]!r}"})
+                    return
+                except (ValueError, WriteError) as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                out["specs"] = mgr.status() if mgr is not None else {}
                 self._send_json(200, out)
                 return
             elif mod == "failpoint":

@@ -1,0 +1,58 @@
+"""Rollup maintenance service: governed background folding.
+
+Reference analogue: the continuous-query/downsample schedulers, but the
+work unit is the incremental fold of dirty/new windows
+(storage/rollup.py).  Ticks ride `Service._governed_tick` (PR 5): under
+interactive saturation or an IO alarm the whole tick pauses like
+compaction/downsample.  Inside a tick each tenant (database) folds
+separately and is CHARGED separately — fold time and window counts land
+in the governor's per-tenant accounts, and a tenant whose fold is
+skipped because the background gate closed mid-tick gets a shed mark —
+so one tenant's rollup churn is visible (and attributable) instead of
+disappearing into a global counter (the Taurus per-tenant governance
+argument, arXiv:2506.20010)."""
+
+from __future__ import annotations
+
+import time as _time
+
+from opengemini_tpu.services.base import Service, logger
+from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+
+class RollupService(Service):
+    name = "rollup"
+    governed = True
+
+    def __init__(self, engine, interval_s: float = 5.0):
+        super().__init__(interval_s)
+        self.engine = engine
+
+    def handle(self, now_ns: int | None = None) -> int:
+        mgr = self.engine.rollup_mgr
+        if mgr is None:
+            return 0
+        from opengemini_tpu.utils.governor import GOVERNOR
+
+        folded = 0
+        for db in mgr.dbs_with_specs():
+            if self._stop.is_set():
+                break
+            if not GOVERNOR.background_allowed():
+                # the gate closed mid-tick: remaining tenants are shed
+                # this round (retried next tick) and the shed is charged
+                # to THEM — their maintenance lag is their signal
+                GOVERNOR.charge_tenant(db, "rollup_sheds", 1)
+                STATS.incr("rollup", "tick_sheds")
+                continue
+            t0 = _time.perf_counter_ns()
+            try:
+                n = mgr.maintain_db(db, now_ns)
+            except Exception:  # noqa: BLE001 — one tenant's bad fold
+                logger.exception("rollup maintenance for %s failed", db)
+                continue  # never starves the others
+            folded += n
+            GOVERNOR.charge_tenant(db, "rollup_windows", n)
+            GOVERNOR.charge_tenant(
+                db, "rollup_ms", (_time.perf_counter_ns() - t0) // 1_000_000)
+        return folded

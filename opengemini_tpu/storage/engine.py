@@ -185,6 +185,10 @@ class Database:
         self.downsample: dict[str, list[DownsamplePolicy]] = {}
         self.streams: dict[str, StreamTask] = {}
         self.subscriptions: dict[str, object] = {}
+        # declared materialized rollups (storage/rollup.RollupSpec):
+        # maintained incrementally on ingest, spliced into eligible
+        # GROUP BY time() plans by the executor
+        self.rollups: dict[str, object] = {}
         # DROP MEASUREMENT is a mark + deferred purge (reference:
         # MarkMeasurementDelete, lifted/influx/coordinator/
         # statement_executor.go:894): queries hide marked measurements
@@ -243,6 +247,11 @@ class Engine:
         # fold must wait for the marker, not 400 "unknown migration"
         self._folding: set[str] = set()
         self._load_shards()
+        # materialized-rollup manager (storage/rollup.py): constructed
+        # only when a spec is declared AND OGT_ROLLUP != 0 — None keeps
+        # every write/query path bit-identical (one attribute check)
+        self.rollup_mgr = None
+        self._maybe_init_rollups()
         # live acked-vs-durable gauges ride /debug/vars (utils/stats
         # provider; close() unregisters so dead engines drop out)
         self._durability_provider = self._durability_gauges
@@ -296,6 +305,12 @@ class Engine:
                 sub = Subscription.from_json(sj)
                 db.subscriptions[sub.name] = sub
             db.dropped_msts = set(dbj.get("dropped_msts", []))
+            if dbj.get("rollups"):
+                from opengemini_tpu.storage.rollup import RollupSpec
+
+                for rj in dbj["rollups"]:
+                    spec = RollupSpec.from_json(rj)
+                    db.rollups[spec.name] = spec
             self.databases[db.name] = db
         self.obs_shards = {
             (d, r, int(s)) for d, r, s in j.get("obs_shards", [])
@@ -319,6 +334,7 @@ class Engine:
                         s.to_json() for s in db.subscriptions.values()
                     ],
                     "dropped_msts": sorted(db.dropped_msts),
+                    "rollups": [r.to_json() for r in db.rollups.values()],
                 }
                 for db in self.databases.values()
             ]
@@ -356,6 +372,14 @@ class Engine:
             p = os.path.join(self.root, "data", name)
             if os.path.exists(p):
                 shutil.rmtree(p)
+            if self.rollup_mgr is not None:
+                # a recreated database must not inherit this one's
+                # rollup watermarks (stale-clean windows would splice
+                # as empty over the new incarnation's data)
+                self.rollup_mgr.drop_db_state(name)
+            else:
+                shutil.rmtree(os.path.join(self.root, "rollup", name),
+                              ignore_errors=True)
 
     def drop_retention_policy(self, db: str, name: str) -> None:
         with self._lock:
@@ -509,9 +533,26 @@ class Engine:
         d = self.databases.get(db)
         if d is None:
             raise DatabaseNotFound(db)
+        to_reset = []
         with self._lock:
             d.dropped_msts.add(mst)
+            if self.rollup_mgr is not None:
+                # rollups of a dropped measurement drop WITH it: delete
+                # their target rows (scoped to the _rollup RP — the
+                # db-wide dropped_msts mark would collide with a raw
+                # measurement of the same name) and reset the watermark
+                # so a recreated name re-folds from scratch
+                for spec in d.rollups.values():
+                    if spec.measurement == mst:
+                        self._purge_rollup_target(db, spec.target)
+                        to_reset.append(spec.name)
             self._save_meta()
+        for name in to_reset:
+            # outside the engine lock: invalidation serializes against
+            # in-flight maintenance (st.m_lock), which itself takes
+            # engine locks while folding — lock order maintenance-lock
+            # before engine lock, never the reverse
+            self.rollup_mgr.invalidate(db, name)
 
     def is_measurement_dropped(self, db: str, mst: str) -> bool:
         d = self.databases.get(db)
@@ -988,42 +1029,60 @@ class Engine:
             if len(batch) == 0:
                 return 0
             STATS.incr("write", "points", len(batch))
-            tickets: list = []
-            touched: list = []
-            with self._lock:
-                n = self._write_columnar_locked(
-                    db, rp, batch, raw, precision, now_ns, tickets, touched)
-            self._commit_wal_tickets(tickets)
-            self._flush_over_threshold(touched)
-            if self._write_observers:
-                self._notify_write(db, rp, batch.to_points())
-            return n
+            rtok = None
+            if self.rollup_mgr is not None:
+                # PRE-apply: a late write's dirty mark is durable before
+                # the rows are (storage/rollup.py watermark contract);
+                # write_done releases the in-flight fold floor
+                rtok = self.rollup_mgr.note_write_columnar(db, rp, batch)
+            try:
+                tickets: list = []
+                touched: list = []
+                with self._lock:
+                    n = self._write_columnar_locked(
+                        db, rp, batch, raw, precision, now_ns, tickets,
+                        touched)
+                self._commit_wal_tickets(tickets)
+                self._flush_over_threshold(touched)
+                if self._write_observers:
+                    self._notify_write(db, rp, batch.to_points())
+                return n
+            finally:
+                if rtok is not None:
+                    self.rollup_mgr.write_done(rtok)
 
         points = lp.parse_lines(lines, precision, now_ns,
                                 expand_tag_arrays=self.tag_arrays)
         if not points:
             return 0
         STATS.incr("write", "points", len(points))
-        tickets: list = []
-        with self._lock:
-            # group points by target shard (time routing)
-            by_shard: dict[int, list] = {}
-            shards: dict[int, Shard] = {}
-            for p in points:
-                shard = self._get_or_create_shard(db, rp, p[2])
-                key = id(shard)
-                shards[key] = shard
-                by_shard.setdefault(key, []).append(p)
-            n = 0
-            for key, pts in by_shard.items():
-                got, t = shards[key].write_points(
-                    pts, raw, precision, now_ns, defer_commit=True)
-                n += got
-                tickets.append((shards[key], t))
-        self._commit_wal_tickets(tickets)  # fsyncs coalesce off-lock
-        self._flush_over_threshold(shards.values())
-        self._notify_write(db, rp, points)
-        return n
+        rtok = None
+        if self.rollup_mgr is not None:
+            rtok = self.rollup_mgr.note_write_points(db, rp, points)
+        try:
+            tickets: list = []
+            with self._lock:
+                # group points by target shard (time routing)
+                by_shard: dict[int, list] = {}
+                shards: dict[int, Shard] = {}
+                for p in points:
+                    shard = self._get_or_create_shard(db, rp, p[2])
+                    key = id(shard)
+                    shards[key] = shard
+                    by_shard.setdefault(key, []).append(p)
+                n = 0
+                for key, pts in by_shard.items():
+                    got, t = shards[key].write_points(
+                        pts, raw, precision, now_ns, defer_commit=True)
+                    n += got
+                    tickets.append((shards[key], t))
+            self._commit_wal_tickets(tickets)  # fsyncs coalesce off-lock
+            self._flush_over_threshold(shards.values())
+            self._notify_write(db, rp, points)
+            return n
+        finally:
+            if rtok is not None:
+                self.rollup_mgr.write_done(rtok)
 
     def _write_segmented(self, db: str, rp: str, raw: bytes,
                          precision: str, now_ns: int):
@@ -1079,42 +1138,59 @@ class Engine:
                 elif have != ftype:
                     raise FieldTypeConflict(name, have, ftype)
         total = 0
-        with self._lock:
-            # ONE lock acquisition for the whole body, with every segment
-            # pre-validated against the LIVE shard schemas before the
-            # first applies: the old per-segment lock dance let a
-            # mid-batch schema conflict (or a racing writer) leave a
-            # partial write the single-batch path can never produce.
-            # Routing runs ONCE per segment and is reused for the apply.
-            routed = []
-            for seg, batch in zip(segs, parsed):
-                if len(batch) == 0:
-                    continue
-                route = list(self._route_columnar_locked(db, rp, batch))
-                for shard, rows in route:
-                    shard._check_columnar_types(batch, rows)
-                routed.append((seg, batch, route))
-            tickets: list = []
-            touched: list = []
-            for seg, batch, route in routed:
-                STATS.incr("write", "points", len(batch))
-                for shard, rows in route:
-                    got, t = shard.write_columnar(
-                        batch, rows, seg, precision, now_ns,
-                        defer_commit=True)
-                    total += got
-                    tickets.append((shard, t))
-                    touched.append(shard)
-        self._commit_wal_tickets(tickets)  # fsyncs coalesce off-lock
-        self._flush_over_threshold(touched)
-        if self._write_observers and total:
-            # observers see the body ONCE, post-commit, like write_lines
-            pts: list = []
-            for batch in parsed:
-                if len(batch):
-                    pts.extend(batch.to_points())
-            self._notify_write(db, rp, pts)
-        return total
+        rtoks = []
+        try:
+            if self.rollup_mgr is not None:
+                # inside the try: a note hook failing for batch k must
+                # still release batches <k's in-flight floors via the
+                # finally, or the watermark stalls forever
+                for batch in parsed:
+                    if len(batch):
+                        t = self.rollup_mgr.note_write_columnar(
+                            db, rp, batch)
+                        if t is not None:
+                            rtoks.append(t)
+            with self._lock:
+                # ONE lock acquisition for the whole body, with every
+                # segment pre-validated against the LIVE shard schemas
+                # before the first applies: the old per-segment lock
+                # dance let a mid-batch schema conflict (or a racing
+                # writer) leave a partial write the single-batch path can
+                # never produce.  Routing runs ONCE per segment and is
+                # reused for the apply.
+                routed = []
+                for seg, batch in zip(segs, parsed):
+                    if len(batch) == 0:
+                        continue
+                    route = list(self._route_columnar_locked(db, rp, batch))
+                    for shard, rows in route:
+                        shard._check_columnar_types(batch, rows)
+                    routed.append((seg, batch, route))
+                tickets: list = []
+                touched: list = []
+                for seg, batch, route in routed:
+                    STATS.incr("write", "points", len(batch))
+                    for shard, rows in route:
+                        got, t = shard.write_columnar(
+                            batch, rows, seg, precision, now_ns,
+                            defer_commit=True)
+                        total += got
+                        tickets.append((shard, t))
+                        touched.append(shard)
+            self._commit_wal_tickets(tickets)  # fsyncs coalesce off-lock
+            self._flush_over_threshold(touched)
+            if self._write_observers and total:
+                # observers see the body ONCE, post-commit, like
+                # write_lines
+                pts: list = []
+                for batch in parsed:
+                    if len(batch):
+                        pts.extend(batch.to_points())
+                self._notify_write(db, rp, pts)
+            return total
+        finally:
+            for t in rtoks:
+                self.rollup_mgr.write_done(t)
 
     def _route_columnar_locked(self, db: str, rp: str, batch):
         """Yield (shard, rows) for a ColumnarBatch — ONE routing
@@ -1251,6 +1327,86 @@ class Engine:
                 del d.streams[name]
                 self._save_meta()
 
+    # -- materialized rollups (storage/rollup.py) --------------------------
+
+    def _maybe_init_rollups(self) -> None:
+        from opengemini_tpu.storage import rollup as _rollup
+
+        if (self.rollup_mgr is None and _rollup.enabled_by_env()
+                and any(d.rollups for d in self.databases.values())):
+            self.rollup_mgr = _rollup.RollupManager(self)
+
+    def create_rollup(self, db: str, spec) -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d is None:
+                raise DatabaseNotFound(db)
+            src_rp = spec.rp or d.default_rp
+            if src_rp not in d.rps:
+                raise WriteError(f"retention policy not found: {db}.{src_rp}")
+            _check_namespace_name(spec.name, "rollup")
+            if spec.name == spec.measurement:
+                # the spec name doubles as the target measurement AND as
+                # the dropped-measurement marker on drop_rollup — a name
+                # collision with the source would hide the source rows
+                raise WriteError(
+                    "rollup name must differ from its source measurement")
+            if spec.name in d.rollups:
+                # silently replacing would leave the old grid's rows and
+                # watermark behind — a redeclared interval would then
+                # double-count in the splice.  Drop first (the re-fold
+                # bootstrap zero-fills the old grid's cells).
+                raise WriteError(
+                    f"rollup already exists: {db}.{spec.name} "
+                    "(drop it first)")
+            d.rollups[spec.name] = spec
+            self._save_meta()
+        self._maybe_init_rollups()
+
+    def drop_rollup(self, db: str, name: str) -> None:
+        with self._lock:
+            d = self.databases.get(db)
+            if d and name in d.rollups:
+                spec = d.rollups.pop(name)
+                # the persisted cells drop with the spec, scoped to the
+                # _rollup RP (orphaned rows would leak disk and answer
+                # stale aggregates; a db-wide dropped_msts mark could
+                # nuke an unrelated raw measurement sharing the name)
+                self._purge_rollup_target(db, spec.target)
+                self._save_meta()
+        if self.rollup_mgr is not None:
+            self.rollup_mgr.drop_state(db, name)
+        else:
+            # OGT_ROLLUP=0: still remove the state file, or a later
+            # re-declare under a re-enabled env resurrects a stale
+            # watermark over a purged target
+            try:
+                os.remove(os.path.join(self.root, "rollup", db,
+                                       f"{name}.json"))
+            except OSError:
+                pass
+
+    def _purge_rollup_target(self, db: str, target: str) -> None:
+        """Delete a rollup target's rows from the _rollup RP's shards
+        only (caller holds the engine lock)."""
+        from opengemini_tpu.storage.rollup import ROLLUP_RP
+
+        for (sdb, rp, _g), sh in list(self._shards.items()):
+            if sdb == db and rp == ROLLUP_RP:
+                sh.delete_data(target)
+
+    def ensure_rollup_rp(self, db: str) -> None:
+        """The system RP rollup rows persist under — infinite retention
+        (rollups deliberately outlive their raw source data)."""
+        from opengemini_tpu.storage.rollup import ROLLUP_RP
+
+        with self._lock:
+            d = self.databases.get(db)
+            if d is not None and ROLLUP_RP not in d.rps:
+                d.rps[ROLLUP_RP] = RetentionPolicy(
+                    ROLLUP_RP, 0, DEFAULT_SHARD_DURATION)
+                self._save_meta()
+
     def add_write_observer(self, fn) -> None:
         """fn(db, rp, points) called after every successful write — the
         stream engine's ingest hook (reference: stream-aware PointsWriter,
@@ -1357,25 +1513,32 @@ class Engine:
         if d.dropped_msts:
             self.purge_dropped_measurements(db)
         rp = rp or d.default_rp
-        tickets: list = []
-        with self._lock:
-            by_shard: dict[int, list] = {}
-            shards: dict[int, Shard] = {}
-            for p in points:
-                shard = self._get_or_create_shard(db, rp, p[2])
-                key = id(shard)
-                shards[key] = shard
-                by_shard.setdefault(key, []).append(p)
-            n = 0
-            for key, pts in by_shard.items():
-                got, t = shards[key].write_points_structured(
-                    pts, defer_commit=True)
-                n += got
-                tickets.append((shards[key], t))
-        self._commit_wal_tickets(tickets)  # fsyncs coalesce off-lock
-        self._flush_over_threshold(shards.values())
-        self._notify_write(db, rp, points)
-        return n
+        rtok = None
+        if self.rollup_mgr is not None:
+            rtok = self.rollup_mgr.note_write_points(db, rp, points)
+        try:
+            tickets: list = []
+            with self._lock:
+                by_shard: dict[int, list] = {}
+                shards: dict[int, Shard] = {}
+                for p in points:
+                    shard = self._get_or_create_shard(db, rp, p[2])
+                    key = id(shard)
+                    shards[key] = shard
+                    by_shard.setdefault(key, []).append(p)
+                n = 0
+                for key, pts in by_shard.items():
+                    got, t = shards[key].write_points_structured(
+                        pts, defer_commit=True)
+                    n += got
+                    tickets.append((shards[key], t))
+            self._commit_wal_tickets(tickets)  # fsyncs coalesce off-lock
+            self._flush_over_threshold(shards.values())
+            self._notify_write(db, rp, points)
+            return n
+        finally:
+            if rtok is not None:
+                self.rollup_mgr.write_done(rtok)
 
     def flush_all(self) -> None:
         with self._lock:
@@ -1473,6 +1636,8 @@ class Engine:
 
     def close(self) -> None:
         STATS.unregister_provider("durability", self._durability_provider)
+        if self.rollup_mgr is not None:
+            self.rollup_mgr.close()
         from opengemini_tpu.utils.governor import GOVERNOR as _GOVERNOR
 
         _GOVERNOR.unregister_component("memtable", self._governor_provider)

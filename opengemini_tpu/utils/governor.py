@@ -208,6 +208,10 @@ class ResourceGovernor:
             "bp_on": 0, "bp_off": 0, "bg_pauses": 0, "bg_forced": 0,
             "io_alarms": 0,
         }
+        # -- per-tenant (database) accounting: background maintenance
+        # work charged to its owner (rollup folds, sheds) — surfaced in
+        # gauges()/describe() so a hostile tenant's churn is attributable
+        self._tenants: dict[str, dict[str, int]] = {}
         # -- shed/kill burst -> diagnostic hook (sherlock) --
         self._hook = None
         self._shed_times: deque = deque()
@@ -301,6 +305,7 @@ class ResourceGovernor:
             self._bp_backlog_at = float("-inf")
             self._io_alarm_until = 0.0
             self._bg_tokens = 0
+            self._tenants.clear()
             self._shed_times.clear()
             self._last_hook = float("-inf")
             self._cond.notify_all()
@@ -626,6 +631,23 @@ class ResourceGovernor:
         pause_at = max(1, (self._max_concurrent * self._bg_pause_pct + 99) // 100)
         return busy < pause_at
 
+    # -- per-tenant accounting -------------------------------------------------
+
+    def charge_tenant(self, tenant: str, key: str, delta: int = 1) -> None:
+        """Attribute background maintenance work (or a shed) to the
+        owning tenant (database).  Always counted — cheap — but only
+        SURFACED in gauges() while the governor is enabled, so the
+        disabled governor keeps /debug/vars byte-identical."""
+        if delta == 0:
+            return
+        with self._lock:
+            acct = self._tenants.setdefault(tenant, {})
+            acct[key] = acct.get(key, 0) + int(delta)
+
+    def tenant_accounts(self) -> dict:
+        with self._lock:
+            return {t: dict(a) for t, a in self._tenants.items()}
+
     # -- shed/kill burst -> diagnostics ---------------------------------------
 
     def set_diagnostic_hook(self, fn) -> None:
@@ -681,6 +703,10 @@ class ResourceGovernor:
         for name, nb in led.items():
             out[f"ledger_{name}_bytes"] = nb
         out["ledger_total_bytes"] = sum(led.values())
+        with self._lock:
+            for tenant, acct in self._tenants.items():
+                for key, v in acct.items():
+                    out[f"tenant_{tenant}_{key}"] = v
         return out
 
     def admission_snapshot(self) -> dict:
@@ -706,6 +732,7 @@ class ResourceGovernor:
             "config": self.config(),
             "ledger": self.ledger(),
             "admission": self.admission_snapshot(),
+            "tenants": self.tenant_accounts(),
         }
 
 

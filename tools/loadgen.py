@@ -349,6 +349,169 @@ def run_load(host: str, port: int, db: str, clients: int = 8,
     }
 
 
+def zipf_weights(n: int, s: float) -> list[float]:
+    """Normalized Zipf weights over ranks 1..n (tenant popularity)."""
+    raw = [1.0 / (r ** s) for r in range(1, n + 1)]
+    total = sum(raw)
+    return [w / total for w in raw]
+
+
+def run_dashboard_fleet(host: str, port: int, clients: int = 12,
+                        tenants: int = 4, zipf_s: float = 1.2,
+                        duration_s: float = 6.0, write_frac: float = 0.3,
+                        batch_rows: int = 50, window_s: int = 60,
+                        range_s: int = 1800, measurement: str = "m",
+                        timeout_s: float = 10.0, seed: int = 7) -> dict:
+    """Dashboard-fleet scenario: zipf-distributed tenant databases, each
+    client pinned to one tenant, issuing REPEATED IDENTICAL ``GROUP BY
+    time()`` dashboard queries mixed with live ingest (recent
+    timestamps) — the read shape materialized rollups
+    (storage/rollup.py) and the incremental result cache exist to make
+    cheap.  Reports per-tenant write/query p50/p99, shed counts, and
+    error counts, so a hostile tenant's impact on the others' tail is
+    measurable.  Declare rollup specs (/debug/ctrl?mod=rollup) before a
+    run to A/B the splice."""
+    import random
+
+    rng = random.Random(seed)
+    weights = zipf_weights(tenants, zipf_s)
+    tenant_of = [
+        rng.choices(range(tenants), weights=weights)[0]
+        for _ in range(clients)
+    ]
+    # every tenant db exists before traffic (idempotent)
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    from urllib.parse import quote
+
+    for t in range(tenants):
+        conn.request(
+            "POST", "/query?q=" + quote(f'CREATE DATABASE "tenant_{t}"'))
+        conn.getresponse().read()
+    conn.close()
+
+    now_ns = time.time_ns()
+    lo = (now_ns - range_s * 10 ** 9) // 10 ** 9 * 10 ** 9
+    hi = now_ns // 10 ** 9 * 10 ** 9
+    query = (f"SELECT mean(v), max(v), count(v) FROM {measurement} "
+             f"WHERE time >= {lo} AND time < {hi} "
+             f"GROUP BY time({window_s}s)")
+    states = [_ClientState(i) for i in range(clients)]
+    stop_at = time.monotonic() + duration_s
+
+    def worker(st: _ClientState) -> None:
+        tenant = tenant_of[st.idx]
+        db = f"tenant_{tenant}"
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        acc = 0.0
+        try:
+            while time.monotonic() < stop_at:
+                acc += write_frac
+                do_write = acc >= 1.0
+                if do_write:
+                    acc -= 1.0
+                t0 = time.monotonic()
+                try:
+                    if do_write:
+                        # live ingest: recent, in-window timestamps (per
+                        # client ns offsets keep series rows distinct)
+                        base = time.time_ns() - st.idx
+                        body = "".join(
+                            f"{measurement},client=c{st.idx} "
+                            f"v={st.seq + k}i {base - k * 1000}\n"
+                            for k in range(batch_rows)
+                        ).encode()
+                        conn.request("POST", f"/write?db={db}", body=body)
+                        resp = conn.getresponse()
+                        resp.read()
+                        dt = time.monotonic() - t0
+                        if resp.status == 204:
+                            st.seq += batch_rows
+                            st.write_lat.append(dt)
+                        elif resp.status in (429, 503):
+                            st.sheds_429 += resp.status == 429
+                            st.sheds_503 += resp.status == 503
+                        else:
+                            st.note_error(f"write status {resp.status}")
+                    else:
+                        conn.request(
+                            "GET", f"/query?db={db}&q={quote(query)}")
+                        resp = conn.getresponse()
+                        data = resp.read()
+                        dt = time.monotonic() - t0
+                        if resp.status == 200:
+                            doc = json.loads(data)
+                            errs = [r["error"]
+                                    for r in doc.get("results", [])
+                                    if "error" in r]
+                            if not errs:
+                                st.query_lat.append(dt)
+                            elif any("killed" in e for e in errs):
+                                st.killed += 1
+                            else:
+                                st.note_error(
+                                    "query error: " + errs[0][:120])
+                        elif resp.status in (429, 503):
+                            st.sheds_429 += resp.status == 429
+                            st.sheds_503 += resp.status == 503
+                        else:
+                            st.note_error(f"query status {resp.status}")
+                except (OSError, http.client.HTTPException,
+                        ValueError) as e:
+                    st.note_error(f"transport: {type(e).__name__}: {e}")
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    conn = http.client.HTTPConnection(
+                        host, port, timeout=timeout_s)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(st,), daemon=True,
+                                name=f"fleet-{st.idx}") for st in states]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 4 * timeout_s)
+    wall_s = time.monotonic() - t_start
+
+    per_tenant = {}
+    for t in range(tenants):
+        members = [st for st in states if tenant_of[st.idx] == t]
+        if not members:
+            continue
+        per_tenant[f"tenant_{t}"] = {
+            "clients": len(members),
+            "writes": _lat_summary(
+                [v for st in members for v in st.write_lat]),
+            "queries": _lat_summary(
+                [v for st in members for v in st.query_lat]),
+            "sheds": sum(st.sheds_429 + st.sheds_503 for st in members),
+            "killed": sum(st.killed for st in members),
+            "errors": sum(st.errors for st in members),
+        }
+    attempts = sum(
+        len(st.write_lat) + len(st.query_lat) + st.sheds_429
+        + st.sheds_503 + st.killed + st.errors for st in states)
+    return {
+        "scenario": "dashboard",
+        "clients": clients,
+        "tenants": tenants,
+        "zipf_s": zipf_s,
+        "duration_s": round(wall_s, 3),
+        "attempts": attempts,
+        "qps": round(attempts / max(wall_s, 1e-9), 1),
+        "per_tenant": per_tenant,
+        "stuck_clients": sum(1 for t in threads if t.is_alive()),
+        "error_samples": [s for st in states
+                          for s in st.error_samples][:10],
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--host", default="127.0.0.1")
@@ -368,7 +531,23 @@ def main() -> None:
                          "list cycled per client (recorded per batch)")
     ap.add_argument("--ack-log", default=None,
                     help="append each acked batch to this fsynced journal")
+    ap.add_argument("--scenario", default="mixed",
+                    choices=("mixed", "dashboard"),
+                    help="'dashboard' = zipf-tenant dashboard fleet "
+                         "(repeated identical GROUP BY time() reads + "
+                         "live ingest, per-tenant p50/p99 + sheds)")
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--zipf", type=float, default=1.2,
+                    help="zipf exponent for tenant popularity")
     args = ap.parse_args()
+    if args.scenario == "dashboard":
+        out = run_dashboard_fleet(
+            args.host, args.port, clients=args.clients,
+            tenants=args.tenants, zipf_s=args.zipf,
+            duration_s=args.duration, write_frac=args.write_frac,
+            batch_rows=args.batch_rows, measurement=args.measurement)
+        print(json.dumps(out, indent=1))
+        return
     levels = args.consistency.split(",") if args.consistency else None
     out = run_load(args.host, args.port, args.db, clients=args.clients,
                    duration_s=args.duration, write_frac=args.write_frac,
