@@ -30,9 +30,11 @@ import numpy as np
 
 from opengemini_tpu.index.inverted import SeriesIndex
 from opengemini_tpu.record import Column, FieldType, Record
+from opengemini_tpu.utils import tracing
 from opengemini_tpu.utils.failpoint import inject as _fp
 from opengemini_tpu.utils.governor import _env_float, _env_int
 from opengemini_tpu.utils.stats import GLOBAL as STATS
+from opengemini_tpu.utils.stats import observe_ns as _observe_ns
 
 # a peer's cached health view older than this cannot vote in the quorum
 # failure view (its probe loop stalled or has not run yet)
@@ -394,22 +396,27 @@ def _collect_series(engine, db, rp, mst, tmin, tmax, shard_filter=None):
         shards = [sh for sh in shards if shard_filter(sh)]
     schema: dict[str, str] = {}
     by_key: dict[tuple, dict] = {}
-    for sh in sorted(shards, key=lambda s: s.tmin):
-        for name, ftype in sh.schema(mst).items():
-            schema.setdefault(name, ftype.name)
-        for sid in sorted(sh.index.series_ids(mst)):
-            rec = sh.read_series(mst, sid, tmin, tmax)
-            if len(rec) == 0:
-                continue
-            tags = sh.index.tags_of(sid)
-            key = tuple(sorted(tags.items()))
-            entry = by_key.setdefault(
-                key, {"tags": dict(tags), "chunks": []}
-            )
-            entry["chunks"].append(
-                (rec.times,
-                 {n: (c.values, c.valid) for n, c in rec.columns.items()})
-            )
+    rows = 0
+    with tracing.current().span("scan") as _sp:
+        for sh in sorted(shards, key=lambda s: s.tmin):
+            for name, ftype in sh.schema(mst).items():
+                schema.setdefault(name, ftype.name)
+            for sid in sorted(sh.index.series_ids(mst)):
+                rec = sh.read_series(mst, sid, tmin, tmax)
+                if len(rec) == 0:
+                    continue
+                rows += len(rec)
+                tags = sh.index.tags_of(sid)
+                key = tuple(sorted(tags.items()))
+                entry = by_key.setdefault(
+                    key, {"tags": dict(tags), "chunks": []}
+                )
+                entry["chunks"].append(
+                    (rec.times,
+                     {n: (c.values, c.valid) for n, c in rec.columns.items()})
+                )
+        _sp.add_field("rows", rows)
+        _sp.add_field("series", len(by_key))
     out = []
     for entry in by_key.values():
         chunks = entry["chunks"]
@@ -442,12 +449,16 @@ def _collect_series(engine, db, rp, mst, tmin, tmax, shard_filter=None):
 
 
 def serialize_series(engine, db, rp, mst, tmin, tmax,
-                     shard_filter=None) -> dict:
+                     shard_filter=None, trace_ctx=None,
+                     node: str = "") -> dict:
     """JSON /internal/scan body (fallback wire format): every series of
     `mst` in range, merged across local shards. `shard_filter(shard)`
     restricts to groups this node is PRIMARY for (rf>1 reads)."""
-    schema, series = _collect_series(engine, db, rp, mst, tmin, tmax,
-                                     shard_filter)
+    t, cm = tracing.start_remote_activated("internal_scan", trace_ctx,
+                                           node=node)
+    with cm:
+        schema, series = _collect_series(engine, db, rp, mst, tmin, tmax,
+                                         shard_filter)
     out = []
     for s in series:
         fields = {}
@@ -456,11 +467,16 @@ def serialize_series(engine, db, rp, mst, tmin, tmax,
                             "valid": valid.tolist()}
         out.append({"tags": s["tags"], "times": s["times"].tolist(),
                     "fields": fields})
-    return {"schema": schema, "series": out}
+    doc = {"schema": schema, "series": out}
+    sub = tracing.ship_subtree(t)
+    if sub is not None:
+        doc["trace"] = sub
+    return doc
 
 
 def serialize_series_binary(engine, db, rp, mst, tmin, tmax,
-                            shard_filter=None) -> bytes:
+                            shard_filter=None, trace_ctx=None,
+                            node: str = "") -> bytes:
     """Binary /internal/scan payload: [u32 header_len][header JSON]
     [raw column buffers]. Numeric columns and times travel as raw
     LITTLE-ENDIAN ndarrays (memcpy in, frombuffer out) instead of JSON
@@ -468,8 +484,11 @@ def serialize_series_binary(engine, db, rp, mst, tmin, tmax,
     JSON inside the header (rare, variable-width)."""
     import struct as _struct
 
-    schema, series = _collect_series(engine, db, rp, mst, tmin, tmax,
-                                     shard_filter)
+    t, cm = tracing.start_remote_activated("internal_scan", trace_ctx,
+                                           node=node)
+    with cm:
+        schema, series = _collect_series(engine, db, rp, mst, tmin, tmax,
+                                         shard_filter)
     buffers: list[bytes] = []
     off = 0
 
@@ -494,6 +513,9 @@ def serialize_series_binary(engine, db, rp, mst, tmin, tmax,
                 f["strings"] = values.tolist()
             entry["fields"][name] = f
         header["series"].append(entry)
+    sub = tracing.ship_subtree(t)
+    if sub is not None:
+        header["trace"] = sub
     hbuf = json.dumps(header, separators=(",", ":")).encode()
     return _struct.pack("<I", len(hbuf)) + hbuf + b"".join(buffers)
 
@@ -513,6 +535,8 @@ def parse_series_binary(data: bytes) -> dict:
         return np.frombuffer(payload[o : o + ln], dtype=dtype)
 
     out = {"schema": header["schema"], "series": []}
+    if "trace" in header:
+        out["trace"] = header["trace"]
     for s in header["series"]:
         fields = {}
         for name, f in s["fields"].items():
@@ -1417,8 +1441,14 @@ class DataRouter:
         if not addr:
             raise RemoteScanError(f"no address for data node {node_id!r}")
         body = {"db": db, "rp": rp, "points": encode_points(points)}
+        tctx = tracing.current_ctx()
+        if tctx is not None:
+            body["trace"] = tctx
         try:
-            self._post(addr, "/internal/write", body)
+            out = self._post(addr, "/internal/write", body)
+            if isinstance(out, dict):
+                # replica applied under a child span and shipped it back
+                tracing.current().graft(out.get("trace"))
         except urllib.error.HTTPError:
             # status errors carry the replica's classification (429 =
             # transient write backpressure vs 4xx = hard rejection);
@@ -1480,6 +1510,7 @@ class DataRouter:
                 headers={"Content-Type": "application/json"},
                 method="POST",
             )
+            t0 = _time.perf_counter_ns()
             try:
                 # inside the try: an injected drop/delay/error behaves
                 # exactly like the real transport fault it simulates
@@ -1489,6 +1520,9 @@ class DataRouter:
                     out = r.read(), r.headers.get("Content-Type", "")
             except urllib.error.HTTPError:
                 self.breaker.record(addr, True)  # the peer answered
+                _observe_ns("rpc_seconds",
+                            _time.perf_counter_ns() - t0,
+                            peer=addr, path=path)
                 raise
             except OSError:
                 self.breaker.record(addr, False)
@@ -1499,6 +1533,10 @@ class DataRouter:
                                 2.0))
                 continue
             self.breaker.record(addr, True)
+            # per-(peer, path) latency: the straggler-attribution gauge —
+            # which node ate the time when a fan-out query is slow
+            _observe_ns("rpc_seconds", _time.perf_counter_ns() - t0,
+                        peer=addr, path=path)
             return out
 
     def _post(self, addr: str, path: str, body: dict,
@@ -1529,6 +1567,12 @@ class DataRouter:
         while True:
             payloads, dead = self._fetch_once(db, rp, mst, tmin, tmax, live)
             if not dead:
+                cur = tracing.current()
+                for p in payloads:
+                    # stitch each peer's scan subtree (shipped in the
+                    # response header) under the span issuing this round
+                    cur.graft(p.pop("trace", None) if isinstance(p, dict)
+                              else None)
                 out = [RemoteShard(mst, p) for p in payloads
                        if p.get("series")]
                 return out, live
@@ -1633,6 +1677,11 @@ class DataRouter:
 
         STATS.incr("cluster", "partials_fanouts")
         body = dict(req, live=live, rf=self.rf)
+        # wire trace ctx captured HERE, on the query thread — the fetch
+        # closures run on fan-out workers with no thread-local trace
+        tctx = tracing.current_ctx()
+        if tctx is not None:
+            body["trace"] = tctx
 
         def fetch(nid, addr):
             if nid not in live:
@@ -1667,18 +1716,22 @@ class DataRouter:
         collecting EVERY dead peer in the round so failover retries once,
         not once per dead node."""
         STATS.incr("cluster", "scan_fanouts")
+        tctx = tracing.current_ctx()  # captured on the query thread
 
         def fetch(nid, addr):
             if nid not in live:
                 return {}
             if not addr:
                 return _NodeDown(nid, f"no address for data node {nid!r}")
+            body = {
+                "db": db, "rp": rp, "mst": mst,
+                "tmin": tmin, "tmax": tmax,
+                "live": live, "rf": self.rf, "fmt": "bin",
+            }
+            if tctx is not None:
+                body["trace"] = tctx
             try:
-                return self._post_scan(addr, {
-                    "db": db, "rp": rp, "mst": mst,
-                    "tmin": tmin, "tmax": tmax,
-                    "live": live, "rf": self.rf, "fmt": "bin",
-                })
+                return self._post_scan(addr, body)
             except urllib.error.HTTPError as e:
                 if e.code in (429, 503):
                     # alive peer SHED the scan (governor admission or

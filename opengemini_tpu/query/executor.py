@@ -40,7 +40,8 @@ from opengemini_tpu.meta.users import AuthError as _AuthError
 from opengemini_tpu.storage.engine import WriteError
 from opengemini_tpu.utils import tracing
 from opengemini_tpu.utils.governor import GOVERNOR
-from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER, QueryKilled
+from opengemini_tpu.utils.querytracker import (GLOBAL as TRACKER,
+                                               QueryKilled, redact as _redact)
 from opengemini_tpu.utils.stats import GLOBAL as STATS
 from opengemini_tpu.sql.parser import parse
 
@@ -420,8 +421,14 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         # Retry-After and flight to UNAVAILABLE — deliberately NOT a
         # statement error in a 200.  Pass-through (no lock, no wait)
         # when the governor is disabled.
+        # t0 BEFORE admit(): a query that spent 5s in the admission
+        # queue and 10ms executing is slow BY 5s — the slow log must see
+        # client-perceived duration or overload (its prime use case)
+        # escapes capture, and admission_wait could exceed duration_ms
+        t0 = _time.perf_counter_ns()
         token = GOVERNOR.admit()
         qid = None
+        trace = None
         try:
             qid = TRACKER.register(text, db)
             if token.waited_ns:
@@ -430,8 +437,31 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                 # query_stages — the trace-span channel)
                 TRACKER.add_stage_ns(qid, "admission_wait", token.waited_ns)
                 tracing.record_stage("admission_wait", token.waited_ns)
+            if tracing.trace_enabled():
+                # per-query span tree (OGT_TRACE=1): activated thread-
+                # locally so deep callees — cluster RPC fan-out, the
+                # partials path — attach spans and wire ctx without a
+                # parameter threaded through every signature
+                trace = tracing.Trace("query")
+                trace.root.add_field("statement", _redact(text))
+                trace.root.add_field("database", db)
+                TRACKER.set_trace(qid, trace)
+                with tracing.activate(trace):
+                    return self._execute_statements(
+                        stmts, db, now_ns, read_only, user)
             return self._execute_statements(stmts, db, now_ns, read_only, user)
         finally:
+            dur_ns = _time.perf_counter_ns() - t0
+            if trace is not None:
+                trace.finish()
+                tracing.note_finished(qid, trace, {"database": db})
+            from opengemini_tpu.utils.slowlog import GLOBAL as SLOWLOG
+
+            if SLOWLOG.enabled():
+                # capture BEFORE unregister: the stage attribution map
+                # lives on the running-query entry
+                SLOWLOG.note(qid, text, db, dur_ns / 1e6, trace=trace,
+                             stages=TRACKER.stages_of(qid))
             if qid is not None:
                 TRACKER.unregister(qid)
             token.release()
@@ -580,7 +610,10 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         sel = stmt.select
         if stmt.analyze:
             trace = tracing.Trace("EXPLAIN ANALYZE")
-            self._select(sel, db, now_ns, trace=trace)
+            # activated so cluster RPCs under the analyze run carry wire
+            # ctx and replica subtrees stitch into THIS tree
+            with tracing.activate(trace):
+                self._select(sel, db, now_ns, trace=trace)
             trace.finish()
             lines = trace.render()
             return _series_result(
@@ -621,6 +654,10 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
 
     def _select(self, stmt: ast.SelectStatement, db: str, now_ns: int,
                 trace=tracing.NOOP) -> dict:
+        if trace is tracing.NOOP:
+            # adopt the per-query tree the executor activated (OGT_TRACE);
+            # EXPLAIN ANALYZE passes its own trace explicitly
+            trace = tracing.current()
         stmt = self._rewrite_in_subqueries(stmt, db, now_ns)
         if stmt is None:
             return {}  # IN (empty subquery result): no rows can match
@@ -1632,6 +1669,11 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                     "tag_keys": sorted(sc.tag_keys),
                 }
                 peer_docs = self.router.select_partials(req, ctx.live)
+                for doc in peer_docs:
+                    # stitch each replica's span subtree (shipped in the
+                    # partials header) under this RPC span — parentage
+                    # was fixed by the wire ctx the request carried
+                    trace.graft(doc.pop("trace", None))
                 if peer_docs:
                     pmod.merge_remote_partials(
                         agg_results, aggs, batches, group_keys, W,

@@ -144,45 +144,86 @@ def compute_partials(engine, router, req: dict) -> bytes:
         for f in per_field
     }
 
-    # group bookkeeping against the COORDINATOR's grid
-    gid_of: dict[tuple, int] = {}
-    group_keys: list[tuple] = []
-    group_tag_dicts: list[dict] = []
-    match_terms = [] if every else cond.conjunctive_match_terms(field_expr)
-    for sh in shards:
-        sids = cond.eval_tag_expr(tag_expr, sh.index, mst)
-        if mixed_expr is not None:
-            if sc.mixed_series_level:  # hinted: exact series-level filter
-                sids &= cond.series_only_sids(mixed_expr, sh.index, mst, tag_keys)
-            else:
-                sids &= cond.tag_superset_sids(mixed_expr, sh.index, mst, tag_keys)
-        sids = _prune_text_sids(sh, mst, sids, match_terms)
-        for sid in sorted(sids):
-            tags = sh.index.tags_of(sid)
-            key = tuple(tags.get(k, "") for k in group_tags)
-            gid = gid_of.get(key)
-            if gid is None:
-                gid = len(group_keys)
-                gid_of[key] = gid
-                group_keys.append(key)
-                group_tag_dicts.append({k: tags.get(k, "") for k in group_tags})
-            rec = sh.read_series(mst, sid, tmin, tmax, fields=read_fields)
-            if len(rec) == 0:
-                continue
-            fmask = (
-                cond.eval_row_filter(sc, rec, tags=tags)
-                if sc.has_row_filter else None
-            )
-            if every:
-                widx, _ = winmod.window_index(rec.times, tmin, every, offset)
-                seg = (gid * W + widx.astype(np.int64)).astype(np.int32)
-            else:
-                seg = np.full(len(rec), gid, dtype=np.int32)
-            _add_record_to_batches(
-                rec, seg, aligned, sorted(per_field), batches, dtype, fmask,
-                sids=sid,
-            )
+    # replica-side child trace (utils/tracing): parented at the
+    # coordinator's wire ctx when the request carries one, shipped back
+    # in the partials header so the coordinator stitches one tree
+    from opengemini_tpu.utils import tracing
 
+    trace, cm = tracing.start_remote_activated(
+        "select_partials", req.get("trace"),
+        node=getattr(router, "self_id", "") or "")
+    with cm:
+        cur = tracing.current()
+        # group bookkeeping against the COORDINATOR's grid.  Two passes
+        # under separate spans: index-side series selection ("scan"),
+        # then chunk decode + batch staging ("decode") — the per-stage
+        # split is what straggler attribution needs when one node's
+        # partials round is slow
+        gid_of: dict[tuple, int] = {}
+        group_keys: list[tuple] = []
+        group_tag_dicts: list[dict] = []
+        match_terms = [] if every else cond.conjunctive_match_terms(field_expr)
+        plan: list[tuple] = []  # (shard, sid, gid, tags)
+        with cur.span("scan") as sp:
+            for sh in shards:
+                sids = cond.eval_tag_expr(tag_expr, sh.index, mst)
+                if mixed_expr is not None:
+                    if sc.mixed_series_level:  # hinted: exact series filter
+                        sids &= cond.series_only_sids(
+                            mixed_expr, sh.index, mst, tag_keys)
+                    else:
+                        sids &= cond.tag_superset_sids(
+                            mixed_expr, sh.index, mst, tag_keys)
+                sids = _prune_text_sids(sh, mst, sids, match_terms)
+                for sid in sorted(sids):
+                    tags = sh.index.tags_of(sid)
+                    key = tuple(tags.get(k, "") for k in group_tags)
+                    gid = gid_of.get(key)
+                    if gid is None:
+                        gid = len(group_keys)
+                        gid_of[key] = gid
+                        group_keys.append(key)
+                        group_tag_dicts.append(
+                            {k: tags.get(k, "") for k in group_tags})
+                    plan.append((sh, sid, gid, tags))
+            sp.add_field("shards", len(shards))
+            sp.add_field("series", len(plan))
+        rows = 0
+        with cur.span("decode") as sp:
+            for sh, sid, gid, tags in plan:
+                rec = sh.read_series(mst, sid, tmin, tmax,
+                                     fields=read_fields)
+                if len(rec) == 0:
+                    continue
+                rows += len(rec)
+                fmask = (
+                    cond.eval_row_filter(sc, rec, tags=tags)
+                    if sc.has_row_filter else None
+                )
+                if every:
+                    widx, _ = winmod.window_index(
+                        rec.times, tmin, every, offset)
+                    seg = (gid * W + widx.astype(np.int64)).astype(np.int32)
+                else:
+                    seg = np.full(len(rec), gid, dtype=np.int32)
+                _add_record_to_batches(
+                    rec, seg, aligned, sorted(per_field), batches, dtype,
+                    fmask, sids=sid,
+                )
+            sp.add_field("rows", rows)
+
+        with cur.span("partial_merge") as sp:
+            fields_out = _compute_field_partials(
+                per_field, batches, group_keys, W, aggmod)
+            sp.add_field("fields", len(fields_out))
+    return serialize_partials(group_tag_dicts, fields_out,
+                              len(group_keys), W,
+                              trace=tracing.ship_subtree(trace))
+
+
+def _compute_field_partials(per_field, batches, group_keys, W, aggmod):
+    """Run the partial-array computation for every requested field (the
+    peer-side 'partial_merge' stage): {field: {partial_name: array}}."""
     n_seg = max(len(group_keys), 1) * W
     fields_out: dict[str, dict[str, np.ndarray]] = {}
     for f, names in per_field.items():
@@ -251,14 +292,15 @@ def compute_partials(engine, router, req: dict) -> bytes:
             f: {p: _slice(p, a) for p, a in arrs.items()}
             for f, arrs in fields_out.items()
         }
-    return serialize_partials(group_tag_dicts, fields_out, ngroups, W)
+    return fields_out
 
 
 # -- wire format -------------------------------------------------------------
 # [u32 header_len][header JSON][raw little-endian array buffers]
 
 
-def serialize_partials(group_tag_dicts, fields_out, ngroups: int, W: int) -> bytes:
+def serialize_partials(group_tag_dicts, fields_out, ngroups: int, W: int,
+                       trace: dict | None = None) -> bytes:
     buffers: list[bytes] = []
     off = 0
 
@@ -280,6 +322,10 @@ def serialize_partials(group_tag_dicts, fields_out, ngroups: int, W: int) -> byt
             for f, arrs in fields_out.items()
         },
     }
+    if trace is not None:
+        # the replica's span subtree rides the header (JSON next to the
+        # group/field directory, never the raw buffers)
+        header["trace"] = trace
     hbuf = json.dumps(header, separators=(",", ":")).encode()
     return struct.pack("<I", len(hbuf)) + hbuf + b"".join(buffers)
 
@@ -294,7 +340,10 @@ def parse_partials(data: bytes) -> dict:
             p: np.frombuffer(payload[loc["o"] : loc["o"] + loc["n"]], loc["d"])
             for p, loc in arrs.items()
         }
-    return {"groups": header["groups"], "W": header["W"], "fields": fields}
+    out = {"groups": header["groups"], "W": header["W"], "fields": fields}
+    if "trace" in header:
+        out["trace"] = header["trace"]
+    return out
 
 
 # -- coordinator side --------------------------------------------------------

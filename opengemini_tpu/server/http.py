@@ -30,9 +30,11 @@ from opengemini_tpu.query.executor import Executor
 from opengemini_tpu.record import FieldTypeConflict
 from opengemini_tpu.storage.engine import (NS, DatabaseNotFound, Engine,
                                            WriteError)
+from opengemini_tpu.utils import tracing
 from opengemini_tpu.utils.failpoint import inject as _fp
 from opengemini_tpu.utils.governor import GOVERNOR, AdmissionRejected
 from opengemini_tpu.utils.stats import GLOBAL as STATS
+from opengemini_tpu.utils.stats import observe_ns as _observe_ns
 
 _EPOCH_DIV = {"ns": 1, "u": 1_000, "µ": 1_000, "ms": 1_000_000, "s": 1_000_000_000,
               "m": 60_000_000_000, "h": 3_600_000_000_000}
@@ -42,6 +44,31 @@ _EPOCH_DIV = {"ns": 1, "u": 1_000, "µ": 1_000, "ms": 1_000_000, "s": 1_000_000_
 # closes the connection instead of being read out
 _DRAIN_CAP_BYTES = 8 << 20
 _DRAIN_TIMEOUT_S = 10.0
+
+
+def _route_of(path: str) -> str:
+    """Coarse route class for the HTTP latency histograms: a FIXED
+    vocabulary so /metrics label cardinality stays bounded no matter
+    what paths clients probe."""
+    if path in ("/query",):
+        return "query"
+    if path in ("/write", "/api/v2/write"):
+        return "write"
+    if path in ("/api/v1/prom/write", "/api/v1/otlp/metrics"):
+        return "write"
+    if path.startswith("/api/v1/"):
+        return "prom"
+    if path.startswith("/internal/"):
+        return "internal"
+    if path.startswith("/debug/") or path == "/metrics":
+        return "debug"
+    if path.startswith("/raft/") or path.startswith("/cluster/"):
+        return "cluster"
+    if path == "/repo" or path.startswith("/repo/"):
+        return "logstore"
+    if path in ("/ping", "/health"):
+        return "health"
+    return "other"
 
 
 def time_now_s() -> float:
@@ -365,6 +392,35 @@ def _make_handler(svc: HttpService):
         # -- routes ---------------------------------------------------------
 
         def do_GET(self):
+            self._observed("GET", self._do_get)
+
+        def do_POST(self):
+            self._observed("POST", self._do_post)
+
+        def do_DELETE(self):
+            self._observed("DELETE", self._do_delete)
+
+        def _observed(self, method: str, dispatch) -> None:
+            """Endpoint latency histograms (ogt_http_request_seconds,
+            labeled by coarse route class + method).  One enabled-flag
+            read when histograms are off (OGT_TRACE=0)."""
+            from opengemini_tpu.utils.stats import obs_enabled
+
+            if not obs_enabled():
+                dispatch()
+                return
+            import time as _t
+
+            t0 = _t.perf_counter_ns()
+            try:
+                dispatch()
+            finally:
+                _observe_ns(
+                    "http_request_seconds", _t.perf_counter_ns() - t0,
+                    route=_route_of(urllib.parse.urlparse(self.path).path),
+                    method=method)
+
+        def _do_get(self):
             self._form_pairs = ()  # reset per request (keep-alive reuse)
             self._body_cache = None
             path = urllib.parse.urlparse(self.path).path
@@ -410,6 +466,15 @@ def _make_handler(svc: HttpService):
                     # would silently disqualify a healthy peer's votes)
                     "age_s": (_t.time() - ts) if ts else None,
                 })
+            elif path == "/metrics":
+                # Prometheus text-format export (the statisticsPusher
+                # analogue): every registry counter/gauge + histogram
+                # under ogt_* names, scrapeable by a real Prometheus
+                from opengemini_tpu.utils.stats import render_prometheus
+
+                self._send(
+                    200, render_prometheus(__version__).encode("utf-8"),
+                    ctype="text/plain; version=0.0.4; charset=utf-8")
             elif path == "/debug/vars":
                 import time as _t
 
@@ -423,8 +488,55 @@ def _make_handler(svc: HttpService):
                 )
 
                 self._send_json(200, _TRACKER.full_snapshot())
+            elif path == "/debug/trace":
+                self._handle_debug_trace(self._params())
+            elif path == "/debug/slow":
+                from opengemini_tpu.utils.slowlog import GLOBAL as _SLOW
+
+                self._send_json(200, _SLOW.snapshot())
             else:
                 self._send_json(404, {"error": "not found"})
+
+        def _handle_debug_trace(self, params: dict) -> None:
+            """?qid= serves one stitched span tree (a RUNNING query's
+            live tree, else the finished-trace ring); ?trace_id= looks
+            up by trace id; bare = newest-first summaries."""
+            from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
+
+            qid_s = params.get("qid", "")
+            if qid_s:
+                try:
+                    qid = int(qid_s)
+                except ValueError:
+                    self._send_json(400, {"error": f"bad qid {qid_s!r}"})
+                    return
+                live = _TRACKER.trace_of(qid)
+                if live is not None:
+                    self._send_json(200, {
+                        "qid": qid, "status": "running",
+                        "trace_id": live.trace_id,
+                        "trace": live.to_dict()})
+                    return
+                doc = tracing.get_trace(qid=qid)
+                if doc is None:
+                    self._send_json(
+                        404, {"error": f"no trace for qid {qid} "
+                              "(finished long ago, or OGT_TRACE off)"})
+                    return
+                self._send_json(200, dict(doc, status="finished"))
+                return
+            tid = params.get("trace_id", "")
+            if tid:
+                doc = tracing.get_trace(trace_id=tid)
+                if doc is None:
+                    self._send_json(
+                        404, {"error": f"no trace {tid!r}"})
+                    return
+                self._send_json(200, dict(doc, status="finished"))
+                return
+            self._send_json(200, {
+                "enabled": tracing.trace_enabled(),
+                "recent": tracing.recent_traces()})
 
         def _merge_form_body(self, params: dict) -> None:
             body = self._body().decode("utf-8", errors="replace")
@@ -435,7 +547,7 @@ def _make_handler(svc: HttpService):
                 for k, v in urllib.parse.parse_qs(body).items():
                     params.setdefault(k, v[-1])
 
-        def do_POST(self):
+        def _do_post(self):
             self._form_pairs = ()  # reset per request (keep-alive reuse)
             self._body_cache = None
             path = urllib.parse.urlparse(self.path).path
@@ -498,11 +610,26 @@ def _make_handler(svc: HttpService):
                     return
                 from opengemini_tpu.parallel.cluster import decode_points
 
+                # replica-side child span: a routed write from a traced
+                # coordinator executes under it and ships it back in the
+                # ack, so the coordinator's tree shows which replica
+                # (and which phase) ate the time
+                _rtrace = tracing.start_remote(
+                    "internal_write", req.get("trace"),
+                    node=getattr(svc.router, "self_id", "") or "")
                 _fp("internal-write-before-apply")  # replica copy pending
                 try:
                     points = decode_points(req.get("points", []))
-                    svc.engine.write_rows(req["db"], points,
-                                          rp=req.get("rp") or None)
+                    if _rtrace is not None:
+                        with tracing.activate(_rtrace), \
+                                _rtrace.span("apply") as _sp:
+                            n_rows = svc.engine.write_rows(
+                                req["db"], points,
+                                rp=req.get("rp") or None)
+                            _sp.add_field("rows", n_rows)
+                    else:
+                        svc.engine.write_rows(req["db"], points,
+                                              rp=req.get("rp") or None)
                 except DatabaseNotFound as e:
                     # a replica lagging meta propagation transiently
                     # lacks the db: 404 keeps the copy hinted until it
@@ -526,7 +653,11 @@ def _make_handler(svc: HttpService):
                 # ack dies here — the coordinator must classify it
                 # unreachable and hint a (LWW-idempotent) duplicate copy
                 _fp("internal-write-before-reply")
-                self._send_json(200, {"ok": True})
+                out = {"ok": True}
+                sub = tracing.ship_subtree(_rtrace)
+                if sub is not None:
+                    out["trace"] = sub
+                self._send_json(200, out)
             elif path == "/internal/raftdata":
                 # per-replica-group raft traffic (strict replication mode)
                 dr = getattr(getattr(svc, "router", None), "datarep", None)
@@ -714,17 +845,23 @@ def _make_handler(svc: HttpService):
                                     req.get("mst", ""),
                                     int(req.get("tmin", -(2**62))),
                                     int(req.get("tmax", 2**62)))
+                            tkw = {
+                                "trace_ctx": req.get("trace"),
+                                "node": getattr(svc.router, "self_id", "")
+                                or "",
+                            }
                             if req.get("fmt") == "bin":
                                 from opengemini_tpu.parallel.cluster import (
                                     serialize_series_binary,
                                 )
 
                                 self._send(200, serialize_series_binary(
-                                    *args, shard_filter=shard_filter),
+                                    *args, shard_filter=shard_filter,
+                                    **tkw),
                                     ctype="application/octet-stream")
                                 return
                             payload = serialize_series(
-                                *args, shard_filter=shard_filter,
+                                *args, shard_filter=shard_filter, **tkw,
                             )
                     except AdmissionRejected as e:
                         self._send_json(
@@ -805,7 +942,7 @@ def _make_handler(svc: HttpService):
             else:
                 self._send_json(404, {"error": "not found"})
 
-        def do_DELETE(self):
+        def _do_delete(self):
             self._form_pairs = ()  # reset per request (keep-alive reuse)
             self._body_cache = None
             path = urllib.parse.urlparse(self.path).path
@@ -1024,6 +1161,44 @@ def _make_handler(svc: HttpService):
                     return
                 out["specs"] = mgr.status() if mgr is not None else {}
                 self._send_json(200, out)
+                return
+            elif mod == "obs":
+                # observability runtime tuning: trace capture on/off,
+                # histogram arming, slow-query threshold + ring bound.
+                # No knobs = status query.
+                from opengemini_tpu.utils.slowlog import GLOBAL as _SLOW
+                from opengemini_tpu.utils.stats import (obs_enabled,
+                                                        set_obs_enabled)
+
+                try:
+                    if "trace" in params:
+                        tracing.set_trace_enabled(
+                            params["trace"] in ("1", "true"))
+                    if "hist" in params:
+                        set_obs_enabled(params["hist"] in ("1", "true"))
+                    if "slow_ms" in params:
+                        v = params["slow_ms"]
+                        # slow_ms= (empty) or slow_ms=off disables
+                        _SLOW.configure(
+                            slow_ms=None if v in ("", "off", "none")
+                            else float(v))
+                    if "slow_max" in params:
+                        _SLOW.configure(slow_max=max(1, int(params["slow_max"])))
+                except ValueError as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                if params.get("clear", "") in ("1", "true"):
+                    _SLOW.clear()
+                    tracing.clear_recent()
+                slow = _SLOW.snapshot()
+                self._send_json(200, {
+                    "status": "ok",
+                    "trace": tracing.trace_enabled(),
+                    "hist": obs_enabled(),
+                    "slow_ms": slow["threshold_ms"],
+                    "slow_max": slow["max_records"],
+                    "slow_captured": slow["captured"],
+                })
                 return
             elif mod == "failpoint":
                 from opengemini_tpu.utils import failpoint as _fpmod
@@ -1567,6 +1742,30 @@ def _make_handler(svc: HttpService):
             precision = params.get("precision", "ns")
             if precision == "n":
                 precision = "ns"
+            # coordinator-side write trace (OGT_TRACE=1): routed-write
+            # RPC fan-out under it carries wire ctx, replica ack spans
+            # graft back, and the stitched tree lands in the
+            # /debug/trace ring (no qid — writes are not tracked
+            # queries; addressable by trace_id)
+            wtrace = None
+            if tracing.trace_enabled() and not internal:
+                wtrace = tracing.Trace("write")
+                wtrace.root.add_field("database", db)
+            try:
+                if wtrace is not None:
+                    with tracing.activate(wtrace):
+                        self._write_dispatch(params, db, rp, precision,
+                                             internal)
+                else:
+                    self._write_dispatch(params, db, rp, precision,
+                                         internal)
+            finally:
+                if wtrace is not None:
+                    wtrace.finish()
+                    tracing.note_finished(None, wtrace, {"database": db})
+
+        def _write_dispatch(self, params: dict, db: str, rp,
+                            precision: str, internal: bool) -> None:
             try:
                 router = getattr(svc, "router", None)
                 if router is not None and not internal:

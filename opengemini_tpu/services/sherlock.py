@@ -124,6 +124,21 @@ class SherlockService(Service):
                 f.write("\n\n")
             except Exception:  # noqa: BLE001 — diagnostics best-effort
                 pass
+            try:
+                # recent slow queries (utils/slowlog): the statements —
+                # with stage/span attribution — that were dragging when
+                # the watermark tripped
+                from opengemini_tpu.utils.slowlog import GLOBAL as _SLOW
+
+                slow = _SLOW.snapshot()
+                if slow["records"]:
+                    import json as _json
+
+                    f.write("== slow queries ==\n")
+                    f.write(_json.dumps(slow, indent=1, default=str))
+                    f.write("\n\n")
+            except Exception:  # noqa: BLE001 — diagnostics best-effort
+                pass
             f.write("== thread stacks ==\n")
             for tid, frame in sys._current_frames().items():
                 f.write(f"\n-- thread {tid} --\n")

@@ -648,6 +648,21 @@ class RollupManager:
         """Fold every (series, window) of [lo, hi) into rollup rows —
         ONE raw scan for the whole run, so advancing over a long idle
         span costs one (empty) sweep, not one per window."""
+        import time as _time
+
+        from opengemini_tpu.utils.stats import observe_ns as _observe_ns
+
+        _t0 = _time.perf_counter_ns()
+        try:
+            return self._fold_run_inner(db, spec, lo, hi)
+        finally:
+            # fold-latency distribution (ogt_rollup_fold_seconds): a
+            # maintenance tick stalling dashboards shows here first
+            _observe_ns("rollup_fold_seconds",
+                        _time.perf_counter_ns() - _t0)
+
+    def _fold_run_inner(self, db: str, spec: RollupSpec, lo: int,
+                        hi: int) -> int:
         from opengemini_tpu.query import condition as cond
         from opengemini_tpu.query.sketch import RollupSketch
 

@@ -29,6 +29,12 @@ from opengemini_tpu.storage.wal import WAL
 from opengemini_tpu.utils.failpoint import inject as _fp
 from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
 from opengemini_tpu.utils.stats import GLOBAL as _STATS
+from opengemini_tpu.utils.stats import histogram as _stats_histogram
+
+# flush wall-time distribution (ogt_flush_seconds at /metrics) — the
+# counters above it carry totals; the histogram carries the p99 an
+# operator actually pages on
+_H_FLUSH = _stats_histogram("flush_seconds")
 
 
 def _pack_entries(buffer: list) -> tuple[np.ndarray, Record]:
@@ -691,6 +697,7 @@ class Shard:
         _STATS.incr("flush", "flushes")
         _STATS.incr("flush", "rows", frozen.row_count)
         _STATS.incr("flush", "total_ns", _time.perf_counter_ns() - t0)
+        _H_FLUSH.observe_ns(_time.perf_counter_ns() - t0)
         _fp("shard-flush-before-wal-truncate")
         # rows are durable in the published file: the rotated segment —
         # and any stale ones from crashes/failed flushes — can go

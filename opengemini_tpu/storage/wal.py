@@ -46,8 +46,14 @@ import struct
 import zlib
 
 from opengemini_tpu.utils.stats import GLOBAL as _STATS
+from opengemini_tpu.utils.stats import histogram as _histogram
 
 from opengemini_tpu.record import FieldType
+
+# durability-barrier latency (ogt_wal_fsync_seconds at /metrics): the
+# fsync each sync-mode ack waits on — cached at module level so the hot
+# path pays one attribute load, not a registry lookup
+_H_FSYNC = _histogram("wal_fsync_seconds")
 
 _KIND_RAW_LINES = 1
 _KIND_POINTS = 2
@@ -160,7 +166,9 @@ class WAL:
                     target = self._seq  # everything appended so far
                 self._f.flush()
                 _fp("wal-before-sync")  # reference: engine/wal.go:391
+                _t0 = time.perf_counter_ns()
                 os.fsync(self._f.fileno())
+                _H_FSYNC.observe_ns(time.perf_counter_ns() - _t0)
                 _STATS.incr("wal", "syncs")
                 with self._cond:
                     if target - self._synced > 1:

@@ -88,6 +88,33 @@ class QueryTracker:
     def is_killed(self, qid: int | None) -> bool:
         return qid is not None and qid in self._killed
 
+    def set_trace(self, qid: int | None, trace) -> None:
+        """Bind a live span tree (utils/tracing.Trace) to a running
+        query: /debug/queries renders it in place and /debug/trace?qid=
+        serves it before the query finishes."""
+        if qid is None:
+            return
+        with self._lock:
+            info = self._running.get(qid)
+            if info is not None:
+                info["trace"] = trace
+
+    def trace_of(self, qid: int | None):
+        if qid is None:
+            return None
+        with self._lock:
+            info = self._running.get(qid)
+            return info.get("trace") if info else None
+
+    def stages_of(self, qid: int | None) -> dict:
+        """Copy of the per-stage ns attribution for one running query
+        (the slow-log grabs it just before unregister)."""
+        if qid is None:
+            return {}
+        with self._lock:
+            info = self._running.get(qid)
+            return dict(info.get("stages", ())) if info else {}
+
     def add_stage_ns(self, qid: int | None, name: str, ns: int) -> None:
         """Attribute stage time (e.g. the decoded-column cache's lookup /
         fill work, storage/colcache.py) to a running query so SHOW
@@ -111,8 +138,9 @@ class QueryTracker:
     def snapshot(self) -> list[dict]:
         now = time.monotonic()
         with self._lock:
-            return [
-                {
+            out = []
+            for qid, info in sorted(self._running.items()):
+                entry = {
                     "qid": qid,
                     "query": info["query"],
                     "database": info["database"],
@@ -124,8 +152,15 @@ class QueryTracker:
                         for name, ns in info.get("stages", {}).items()
                     },
                 }
-                for qid, info in sorted(self._running.items())
-            ]
+                trace = info.get("trace")
+                if trace is not None:
+                    # the stitched (so-far) span tree, rendered in place:
+                    # /debug/queries is where an operator first looks
+                    # when a cluster query is slow RIGHT NOW
+                    entry["trace_id"] = trace.trace_id
+                    entry["trace"] = trace.render()
+                out.append(entry)
+            return out
 
     def set_durability_provider(self, fn) -> None:
         """fn() -> engine.durability_snapshot()-shaped dict (None to

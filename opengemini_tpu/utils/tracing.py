@@ -1,21 +1,75 @@
-"""Query-execution trace tree (reference: lib/tracing — Trace/Span
-span.go:31 with StartPP/EndPP wall-time measurement and fields; serialized
-back to the client by EXPLAIN ANALYZE, statement_executor.go:943).
+"""Hierarchical query tracing with cross-node span propagation.
+
+Reference: lib/tracing — Trace/Span (span.go:31) with StartPP/EndPP
+wall-time measurement and fields, serialized back to the client by
+EXPLAIN ANALYZE (statement_executor.go:943); the reference additionally
+ships spans across the MPP executor's RPC boundary so the coordinator
+renders one tree spanning every store node.
+
+Here a Trace is a tree of Spans, each carrying (trace_id, span_id,
+parent_id, node, start wall-ns, elapsed perf-ns).  The coordinator
+attaches `ctx()` — {trace_id, span_id} of its innermost open span — to
+/internal/* RPC bodies; the replica executes under a child Trace built
+by `start_remote()` and returns `to_dict()` in its response payload;
+the coordinator `graft()`s the subtree back under the span that issued
+the RPC, yielding one stitched tree with correct cross-node parentage.
+
+Cost model: with OGT_TRACE unset/0 queries run under NoopTrace exactly
+as before — no Span objects, no ids, two perf_counter reads per stage
+for the cumulative stats channel.  OGT_TRACE=1 arms per-query trees
+(`/debug/trace?qid=`, slow-log capture); the arming check is one module
+global read per query.
 """
 
 from __future__ import annotations
 
+import os
+import random
+import threading
 import time
 from contextlib import contextmanager
 
+# per-query span-tree capture (OGT_TRACE=1).  Mutable at runtime via
+# /debug/ctrl?mod=obs — read through trace_enabled(), never directly.
+_TRACE_ON = os.environ.get("OGT_TRACE", "") in ("1", "true")
+
+# finished traces kept for /debug/trace?qid= (bounded; newest wins)
+_RECENT_MAX = 256
+_RECENT: dict[object, dict] = {}
+_RECENT_LOCK = threading.Lock()
+
+_ACTIVE = threading.local()
+
+
+def trace_enabled() -> bool:
+    return _TRACE_ON
+
+
+def set_trace_enabled(on: bool) -> None:
+    global _TRACE_ON
+    _TRACE_ON = bool(on)
+
+
+def _new_id() -> str:
+    # span/trace ids need uniqueness across NODES (replica subtrees are
+    # grafted into coordinator trees), so a per-process counter is not
+    # enough; 64 random bits at ~100ns/span only when tracing is armed
+    return f"{random.getrandbits(64):016x}"
+
 
 class Span:
-    __slots__ = ("name", "fields", "children", "_t0", "elapsed_ns")
+    __slots__ = ("name", "span_id", "parent_id", "node", "fields",
+                 "children", "start_ns", "elapsed_ns", "_t0")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, span_id: str, parent_id: str,
+                 node: str = ""):
         self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.node = node
         self.fields: list[tuple[str, object]] = []
         self.children: list[Span] = []
+        self.start_ns = time.time_ns()  # wall: cross-node alignment
         self._t0 = time.perf_counter_ns()
         self.elapsed_ns = 0
 
@@ -25,15 +79,41 @@ class Span:
     def finish(self) -> None:
         self.elapsed_ns = time.perf_counter_ns() - self._t0
 
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "span_id": self.span_id,
+            "parent_id": self.parent_id, "node": self.node,
+            "start_ns": self.start_ns, "elapsed_ns": self.elapsed_ns,
+            "fields": [[k, v] for k, v in self.fields],
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "Span":
+        s = cls.__new__(cls)
+        s.name = str(doc.get("name", ""))
+        s.span_id = str(doc.get("span_id", ""))
+        s.parent_id = str(doc.get("parent_id", ""))
+        s.node = str(doc.get("node", ""))
+        s.fields = [(k, v) for k, v in doc.get("fields", ())]
+        s.start_ns = int(doc.get("start_ns", 0))
+        s._t0 = 0
+        s.elapsed_ns = int(doc.get("elapsed_ns", 0))
+        s.children = [cls.from_dict(c) for c in doc.get("children", ())]
+        return s
+
 
 class Trace:
-    def __init__(self, name: str):
-        self.root = Span(name)
+    def __init__(self, name: str, trace_id: str | None = None,
+                 parent_span_id: str = "", node: str = ""):
+        self.trace_id = trace_id or _new_id()
+        self.node = node
+        self.root = Span(name, _new_id(), parent_span_id, node)
         self._stack = [self.root]
 
     @contextmanager
     def span(self, name: str):
-        s = Span(name)
+        s = Span(name, _new_id(), self._stack[-1].span_id, self.node)
         self._stack[-1].children.append(s)
         self._stack.append(s)
         try:
@@ -46,8 +126,34 @@ class Trace:
     def add_field(self, key: str, value) -> None:
         self._stack[-1].add_field(key, value)
 
+    def ctx(self) -> dict:
+        """Wire context of the innermost open span — attached to
+        /internal/* RPC bodies so the replica's subtree parents here."""
+        return {"trace_id": self.trace_id,
+                "span_id": self._stack[-1].span_id}
+
+    def graft(self, subtree: dict | None) -> None:
+        """Attach a remote subtree (a Trace.to_dict() from a replica's
+        response payload) under the innermost open span.  The subtree
+        root's recorded parent_id is the ctx span the coordinator sent;
+        a mismatched or trace-less payload is ignored, never an error —
+        stitching is best-effort observability."""
+        if not subtree or not isinstance(subtree, dict):
+            return
+        root = subtree.get("root")
+        if not isinstance(root, dict):
+            return
+        try:
+            self._stack[-1].children.append(Span.from_dict(root))
+        except (TypeError, ValueError):
+            pass
+
     def finish(self) -> None:
         self.root.finish()
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "node": self.node,
+                "root": self.root.to_dict()}
 
     def render(self) -> list[str]:
         """Indented tree lines (the EXPLAIN ANALYZE payload)."""
@@ -55,7 +161,9 @@ class Trace:
 
         def walk(span: Span, depth: int):
             pad = "    " * depth
-            lines.append(f"{pad}{span.name}: {_fmt_ns(span.elapsed_ns)}")
+            where = f" [{span.node}]" if span.node else ""
+            lines.append(
+                f"{pad}{span.name}{where}: {_fmt_ns(span.elapsed_ns)}")
             for k, v in span.fields:
                 lines.append(f"{pad}    {k}: {v}")
             for c in span.children:
@@ -63,6 +171,124 @@ class Trace:
 
         walk(self.root, 0)
         return lines
+
+
+def start_remote(name: str, ctx: dict | None, node: str = "") -> Trace | None:
+    """Replica side: a child Trace parented at the coordinator's wire
+    ctx.  None when the ctx is absent/malformed (untraced caller)."""
+    if not isinstance(ctx, dict):
+        return None
+    tid, sid = ctx.get("trace_id"), ctx.get("span_id")
+    if not tid or not sid:
+        return None
+    return Trace(name, trace_id=str(tid), parent_span_id=str(sid),
+                 node=node)
+
+
+def start_remote_activated(name: str, ctx: dict | None, node: str = ""):
+    """The whole replica-side entry protocol in one call: (trace | None,
+    activation context manager) — a nullcontext when the caller is
+    untraced, so handlers write `t, cm = ...; with cm: work()`
+    unconditionally.  Pair with ship_subtree(t) on the way out."""
+    import contextlib
+
+    t = start_remote(name, ctx, node=node)
+    return t, (activate(t) if t is not None else contextlib.nullcontext())
+
+
+def ship_subtree(trace: Trace | None) -> dict | None:
+    """Replica-side exit protocol: finish the child trace and hand back
+    the wire subtree for the response payload (None when untraced).
+    The obs-before-span-ship failpoint arms the computed-but-unshipped
+    window here for every shipping site."""
+    if trace is None:
+        return None
+    from opengemini_tpu.utils.failpoint import inject as _fp
+
+    _fp("obs-before-span-ship")
+    trace.finish()
+    return trace.to_dict()
+
+
+# -- thread-local activation -------------------------------------------------
+# The executor binds its per-query Trace here so deep callees (cluster
+# RPC fan-out, the partials serializer) reach it without threading a
+# trace parameter through every signature.  Worker threads (scan pool,
+# RPC fan-out) never inherit the binding — ctx is captured on the query
+# thread before dispatch.
+
+
+@contextmanager
+def activate(trace):
+    prev = getattr(_ACTIVE, "trace", None)
+    _ACTIVE.trace = trace
+    try:
+        yield trace
+    finally:
+        _ACTIVE.trace = prev
+
+
+def current():
+    """The calling thread's active Trace, or NOOP."""
+    t = getattr(_ACTIVE, "trace", None)
+    return t if t is not None else NOOP
+
+
+def current_ctx() -> dict | None:
+    """Wire ctx of the active trace (None when untraced) — what RPC
+    bodies carry."""
+    t = getattr(_ACTIVE, "trace", None)
+    return t.ctx() if isinstance(t, Trace) else None
+
+
+# -- finished-trace ring (/debug/trace) --------------------------------------
+
+
+def note_finished(qid, trace: Trace, meta: dict | None = None) -> None:
+    """Retain a finished trace for /debug/trace?qid= (bounded ring,
+    oldest evicted).  `qid` may be None (e.g. routed writes) — the
+    entry is then addressable by trace_id only."""
+    doc = {"qid": qid, "trace_id": trace.trace_id,
+           "name": trace.root.name,
+           "elapsed_ms": round(trace.root.elapsed_ns / 1e6, 3),
+           "trace": trace.to_dict()}
+    if meta:
+        doc.update(meta)
+    key = qid if qid is not None else trace.trace_id
+    with _RECENT_LOCK:
+        _RECENT.pop(key, None)
+        _RECENT[key] = doc
+        while len(_RECENT) > _RECENT_MAX:
+            _RECENT.pop(next(iter(_RECENT)))
+
+
+def recent_traces() -> list[dict]:
+    """Newest-first summaries (no tree) of the retained traces."""
+    with _RECENT_LOCK:
+        docs = list(_RECENT.values())
+    return [
+        {k: v for k, v in d.items() if k != "trace"}
+        for d in reversed(docs)
+    ]
+
+
+def get_trace(qid=None, trace_id: str | None = None) -> dict | None:
+    with _RECENT_LOCK:
+        if qid is not None:
+            return _RECENT.get(qid)
+        if trace_id is not None:
+            for d in _RECENT.values():
+                if d["trace_id"] == trace_id:
+                    return d
+    return None
+
+
+def clear_recent() -> None:
+    with _RECENT_LOCK:
+        _RECENT.clear()
+
+
+# -- cumulative stage statistics ---------------------------------------------
 
 
 def record_stage(name: str, elapsed_ns: int) -> None:
@@ -73,9 +299,15 @@ def record_stage(name: str, elapsed_ns: int) -> None:
     statement execution) record through here so /debug/vars carries them
     alongside the span-recorded stages."""
     from opengemini_tpu.utils.stats import GLOBAL as STATS
+    from opengemini_tpu.utils.stats import observe_ns
 
     STATS.incr("query_stages", f"{name}_ns", elapsed_ns)
     STATS.incr("query_stages", f"{name}_count")
+    # latency histogram per stage — only for the FIXED stage vocabulary
+    # (scan/device_compute/render/...); dynamic names ("select: <mst>")
+    # would leak label cardinality into /metrics
+    if " " not in name:
+        observe_ns("query_stage_seconds", elapsed_ns, stage=name)
 
 
 _record_stage = record_stage  # internal alias (span finish path)
@@ -97,6 +329,12 @@ class NoopTrace:
             _record_stage(name, time.perf_counter_ns() - t0)
 
     def add_field(self, key: str, value) -> None:
+        pass
+
+    def ctx(self) -> None:
+        return None
+
+    def graft(self, subtree) -> None:
         pass
 
     def finish(self) -> None:

@@ -102,6 +102,12 @@ def test_kill_site_catalog_matches_armed_sites():
     # deterministically by tests/test_rollup.py::TestCrashDurability
     not_on_chain |= {"rollup-mark-dirty", "rollup-fold-before-write",
                      "rollup-fold-after-write", "rollup-before-state-save"}
+    # observability span-ship edge (PR 8): fires on the replica between
+    # computing a response and embedding its trace subtree — a pure
+    # read-path observability site with no durability state to torture;
+    # its crash semantics (trace loss, never data loss) are covered by
+    # tests/test_observability.py
+    not_on_chain |= {"obs-before-span-ship"}
     untortured = armed - catalog - not_on_chain
     assert not untortured, (
         f"armed sites missing from the torture kill rotation: {untortured}")

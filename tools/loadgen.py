@@ -163,6 +163,98 @@ def client_base_ts(idx: int, ts_scale: int = 10**12) -> int:
     return (idx + 1) * ts_scale
 
 
+class _MetricsPoller:
+    """Scrapes GET /metrics from one target on an interval (plus once
+    at start and once after the workers join), tracking
+    ogt_write_rows_total — the scrape-vs-observed consistency source."""
+
+    METRIC = "ogt_write_rows_total"
+
+    def __init__(self, target: str, interval_s: float,
+                 timeout_s: float = 10.0):
+        h, _, p = target.partition(":")
+        self.host, self.port = h, int(p or 80)
+        self.interval_s = max(0.05, interval_s)
+        self.timeout_s = timeout_s
+        self.scrapes = 0
+        self.errors = 0
+        self.first: float | None = None
+        self.last: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def scrape_once(self) -> float | None:
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout_s)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read().decode("utf-8", errors="replace")
+            if resp.status != 200:
+                raise OSError(f"/metrics status {resp.status}")
+            val = 0.0
+            for line in body.splitlines():
+                if line.startswith(self.METRIC) and \
+                        not line.startswith("#"):
+                    # bare family (no labels): "<name> <value>"
+                    val = float(line.split()[-1])
+                    break
+            # a successful scrape with the family absent means the
+            # counter has not been created yet (lazy registry) — that IS
+            # zero; leaving first=None here would latch the baseline
+            # mid-run and misreport a consistency failure
+            self.scrapes += 1
+            if val is not None:
+                if self.first is None:
+                    self.first = val
+                self.last = val
+            return val
+        except (OSError, ValueError, http.client.HTTPException):
+            self.errors += 1
+            return None
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def start(self) -> "_MetricsPoller":
+        self.scrape_once()  # baseline BEFORE any load lands
+
+        def run():
+            while not self._stop.wait(self.interval_s):
+                self.scrape_once()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="loadgen-metrics-poll")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout_s + 1)
+        self.scrape_once()  # final value AFTER every worker joined
+
+    def summary(self, acked_rows: int) -> dict:
+        delta = (self.last - self.first
+                 if self.first is not None and self.last is not None
+                 else None)
+        return {
+            "metric": self.METRIC,
+            "scrapes": self.scrapes,
+            "scrape_errors": self.errors,
+            "first": self.first,
+            "last": self.last,
+            "metric_delta_rows": delta,
+            "observed_acked_rows": acked_rows,
+            # exact on a single node (nothing else writes): every acked
+            # row is visible in the scraped counter, no phantom rows
+            "consistent": (delta is not None
+                           and int(delta) == int(acked_rows)),
+        }
+
+
 def run_load(host: str, port: int, db: str, clients: int = 8,
              duration_s: float = 5.0, write_frac: float = 0.5,
              target_qps: float | None = None, batch_rows: int = 50,
@@ -170,7 +262,8 @@ def run_load(host: str, port: int, db: str, clients: int = 8,
              timeout_s: float = 10.0, targets: list[str] | None = None,
              consistency: str | list[str] | None = None,
              ack_log: str | None = None, client_offset: int = 0,
-             ts_scale: int = 10**12) -> dict:
+             ts_scale: int = 10**12,
+             metrics_poll_s: float | None = None) -> dict:
     """Run the closed-loop load; returns the aggregate summary dict.
     Shed responses (429 write backpressure / 503 admission) count
     separately from errors — shedding is the governor WORKING.
@@ -197,6 +290,9 @@ def run_load(host: str, port: int, db: str, clients: int = 8,
         for i in range(clients)
     ]
     journal = _AckLog(ack_log) if ack_log else None
+    poller = (_MetricsPoller(targets[0], metrics_poll_s,
+                             timeout_s=timeout_s).start()
+              if metrics_poll_s else None)
     stop_at = time.monotonic() + duration_s
     per_client_qps = (target_qps / clients) if target_qps else None
 
@@ -321,6 +417,8 @@ def run_load(host: str, port: int, db: str, clients: int = 8,
     wall_s = time.monotonic() - t_start
     if journal is not None:
         journal.close()
+    if poller is not None:
+        poller.stop()
 
     writes_ok = sum(len(st.write_lat) for st in states)
     queries_ok = sum(len(st.query_lat) for st in states)
@@ -328,7 +426,7 @@ def run_load(host: str, port: int, db: str, clients: int = 8,
     killed = sum(st.killed for st in states)
     errors = sum(st.errors for st in states)
     attempts = writes_ok + queries_ok + sheds + killed + errors
-    return {
+    out = {
         "clients": clients,
         "duration_s": round(wall_s, 3),
         "attempts": attempts,
@@ -347,6 +445,10 @@ def run_load(host: str, port: int, db: str, clients: int = 8,
         "error_samples": [s for st in states for s in st.error_samples][:10],
         "stuck_clients": alive,
     }
+    if poller is not None:
+        out["metrics_poll"] = poller.summary(
+            sum(r["n"] for st in states for r in st.acked))
+    return out
 
 
 def zipf_weights(n: int, s: float) -> list[float]:
@@ -539,6 +641,11 @@ def main() -> None:
     ap.add_argument("--tenants", type=int, default=4)
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="zipf exponent for tenant popularity")
+    ap.add_argument("--metrics-poll", type=float, default=None,
+                    metavar="SECONDS",
+                    help="scrape GET /metrics from the first target on "
+                         "this interval and report acked-rows vs "
+                         "ogt_write_rows_total consistency")
     args = ap.parse_args()
     if args.scenario == "dashboard":
         out = run_dashboard_fleet(
@@ -556,7 +663,8 @@ def main() -> None:
                    targets=args.targets.split(",") if args.targets else None,
                    consistency=(levels[0] if levels and len(levels) == 1
                                 else levels),
-                   ack_log=args.ack_log)
+                   ack_log=args.ack_log,
+                   metrics_poll_s=args.metrics_poll)
     out.pop("acked_batches", None)  # CLI summary stays readable
     print(json.dumps(out, indent=1))
 
