@@ -36,6 +36,8 @@ from opengemini_tpu.sql import ast
 from opengemini_tpu.parallel import runtime as prt
 from opengemini_tpu.storage import colcache as colcache_mod
 from opengemini_tpu.storage import scanpool
+from opengemini_tpu.storage.shard import FileQuarantined
+from opengemini_tpu.storage.tsf import CorruptFile
 from opengemini_tpu.meta.users import AuthError as _AuthError
 from opengemini_tpu.storage.engine import WriteError
 from opengemini_tpu.utils import tracing
@@ -496,9 +498,13 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
             except (
                 QueryError, cond.ConditionError, KeyError, ValueError,
                 re.error, FieldTypeConflict, WriteError, QueryKilled,
+                FileQuarantined,
             ) as e:
                 # _AuthError deliberately NOT caught: authorization failures
-                # must surface as HTTP 401/403, not statement errors in a 200
+                # must surface as HTTP 401/403, not statement errors in a 200.
+                # FileQuarantined IS caught: the detecting query fails as a
+                # clean per-statement error (the file is already out of the
+                # read set; a retry succeeds) instead of a dropped connection
                 res = {"error": str(e)}
             res["statement_id"] = i
             results.append(res)
@@ -1901,7 +1907,17 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                 pre_sum[fname][gid] += vsum
         rows = full_rows
         for r, c in partials:
-            rec = r.read_chunk(mst, c, needed_fields).slice_time(tmin, tmax)
+            try:
+                rec = r.read_chunk(
+                    mst, c, needed_fields).slice_time(tmin, tmax)
+            except CorruptFile as e:
+                # media damage on the pre-agg decode path: quarantine
+                # through the owning shard (raises FileQuarantined)
+                # rather than surfacing a raw codec error
+                handler = getattr(sh, "note_corrupt", None)
+                if handler is not None:
+                    handler(e)
+                raise
             if not len(rec):
                 continue
             rows += len(rec)

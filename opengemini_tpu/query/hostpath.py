@@ -25,6 +25,7 @@ from opengemini_tpu.record import FieldType, FieldTypeConflict
 from opengemini_tpu.sql import ast
 from opengemini_tpu.meta.users import AuthError as _AuthError
 from opengemini_tpu.storage.engine import WriteError
+from opengemini_tpu.storage.tsf import CorruptFile
 from opengemini_tpu.utils import tracing
 from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER, QueryKilled
 from opengemini_tpu.utils.stats import GLOBAL as STATS
@@ -150,7 +151,16 @@ class HostPathMixin:
                     b[0] = min(b[0], pre.vmin)
                     b[1] = max(b[1], pre.vmax)
                 else:
-                    rec = r.read_chunk(mst, c, [fname]).slice_time(tmin, tmax)
+                    try:
+                        rec = r.read_chunk(
+                            mst, c, [fname]).slice_time(tmin, tmax)
+                    except CorruptFile as e:
+                        # quarantine through the owning shard (raises
+                        # FileQuarantined) — see executor._scan_preagg
+                        handler = getattr(sh, "note_corrupt", None)
+                        if handler is not None:
+                            handler(e)
+                        raise
                     col = rec.columns.get(fname)
                     if col is not None and len(rec):
                         _add_vals(gid, col.values[col.valid].astype(np.float64))

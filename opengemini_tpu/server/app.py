@@ -375,6 +375,18 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
         svc.engine, float(sc.get("compact-interval-s", 600)),
         int(sc.get("compact-max-files", 4)),
     ))
+    from opengemini_tpu.services.scrub import ScrubService
+
+    # background integrity scrub (block CRC verification feeding
+    # quarantine + rf>1 anti-entropy repair); OGT_SCRUB=0 disables.
+    # Registered on svc so /debug/ctrl?mod=scrub controls THIS instance.
+    svc.scrub_service = ScrubService(
+        svc.engine,
+        float(sc.get("scrub-interval-s", 0) or 0) or None,
+        router=svc.router,
+        mb_per_tick=(int(sc["scrub-mb"]) if "scrub-mb" in sc else None),
+    )
+    out.append(svc.scrub_service)
     from opengemini_tpu.services.subscriber import SubscriberManager
 
     svc.subscriber = SubscriberManager(svc.engine)
@@ -452,6 +464,8 @@ def _apply_runtime_config(svc: HttpService, cfg: dict) -> list[str]:
                      "mem_mb_watermark": ("sherlock-mem-mb", float),
                      "thread_watermark": ("sherlock-threads", int),
                      "cooldown_s": ("sherlock-cooldown-s", float)},
+        "scrub": {"interval_s": ("scrub-interval-s", float),
+                  "mb_per_tick": ("scrub-mb", int)},
     }
     # two-phase: convert EVERYTHING first so a bad value rejects the whole
     # reload instead of leaving a half-applied config behind an error

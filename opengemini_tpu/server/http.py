@@ -28,6 +28,7 @@ from opengemini_tpu.promql.parser import PromParseError, parse_duration_s
 from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query.executor import Executor
 from opengemini_tpu.record import FieldTypeConflict
+from opengemini_tpu.storage.shard import FileQuarantined
 from opengemini_tpu.storage.engine import (NS, DatabaseNotFound, Engine,
                                            WriteError)
 from opengemini_tpu.utils import tracing
@@ -136,6 +137,7 @@ class HttpService:
         self.meta_store = None  # MetaStore when clustered (server.app.build)
         self.router = None  # DataRouter when [cluster] data-routing is on
         self.flight = None  # FlightService when [flight] is configured
+        self.scrub_service = None  # ScrubService (app build or lazy ctrl)
         from opengemini_tpu.server.logstore import LogStoreAPI
 
         self.logstore = LogStoreAPI(self)  # /repo log-mode surface
@@ -868,6 +870,14 @@ def _make_handler(svc: HttpService):
                             503, {"error": str(e)},
                             headers={"Retry-After": str(e.retry_after_s)})
                         return
+                    except FileQuarantined as e:
+                        # media damage detected mid-scan: the file is
+                        # quarantined; answer a clean 500 so the
+                        # coordinator's failover serves these ranges
+                        # from a replica this round (a retry here
+                        # succeeds without the file)
+                        self._send_err(500, e)
+                        return
                 else:
                     names = set()
                     for sh in svc.engine.shards_for_range(
@@ -1039,6 +1049,80 @@ def _make_handler(svc: HttpService):
                         return
                 self._send_json(200, {"status": "ok",
                                       "rules": _nf.rules()})
+                return
+            elif mod == "diskfault":
+                # deterministic MEDIA-fault rules for this node's
+                # storage IO (storage/diskfault.py): the scribble
+                # torture's bit-flip/torn-write/EIO lever.  No action =
+                # status; action=off clears one rule; clear=1 heals all.
+                from opengemini_tpu.storage import diskfault as _df
+
+                if params.get("clear", "").lower() in ("1", "true", "all"):
+                    _df.clear_all()
+                    self._send_json(200, {"status": "ok", "rules": []})
+                    return
+                action = params.get("action", "")
+                if not action:
+                    self._send_json(200, {"rules": _df.rules(),
+                                          "hits": _df.hits()})
+                    return
+                pat = params.get("path", "*")
+                if action == "off":
+                    _df.clear_rule(pat)
+                else:
+                    try:
+                        _df.set_rule(pat, action)
+                    except ValueError as e:
+                        self._send_json(400, {"error": str(e)})
+                        return
+                self._send_json(200, {"status": "ok",
+                                      "rules": _df.rules()})
+                return
+            elif mod == "scrub":
+                # integrity-scrub control (services/scrub.py): status +
+                # quarantine inventory, op=tick forces one governed
+                # sweep now, op=purge deletes quarantined files from
+                # disk, mb=/interval_s= tune the pace live.
+                from opengemini_tpu.services.scrub import ScrubService
+
+                scrub = getattr(svc, "scrub_service", None)
+                if scrub is None:
+                    # no background service wired (embedded/test server):
+                    # a ctrl-owned instance still serves manual ticks
+                    scrub = svc.scrub_service = ScrubService(
+                        svc.engine, 3600.0, router=svc.router)
+                if scrub.router is None and svc.router is not None:
+                    scrub.router = svc.router
+                # two-phase knob apply (like app._apply_runtime_config):
+                # a bad second param must reject the WHOLE request, not
+                # leave the first knob silently half-applied
+                staged = []
+                for key, conv, attr in (("mb", int, "mb_per_tick"),
+                                        ("interval_s", float,
+                                         "interval_s")):
+                    if key in params:
+                        try:
+                            val = conv(params[key])
+                            if val <= 0:
+                                raise ValueError(f"{key} must be > 0")
+                        except ValueError as e:
+                            self._send_json(400, {"error": str(e)})
+                            return
+                        staged.append((attr, val))
+                for attr, val in staged:
+                    setattr(scrub, attr, val)
+                out = {"status": "ok"}
+                op = params.get("op", "")
+                if op == "tick":
+                    out["verified_bytes"] = scrub.tick_now()
+                elif op == "purge":
+                    out["purged_files"] = svc.engine.purge_quarantined()
+                elif op:
+                    self._send_json(400, {"error": f"unknown op {op!r}"})
+                    return
+                out["scrub"] = scrub.status()
+                out["quarantine"] = svc.engine.quarantine_snapshot()
+                self._send_json(200, out)
                 return
             elif mod == "cluster":
                 # synchronous cluster-service rounds + RPC-hardening

@@ -256,6 +256,11 @@ class Engine:
         # provider; close() unregisters so dead engines drop out)
         self._durability_provider = self._durability_gauges
         STATS.register_provider("durability", self._durability_provider)
+        # quarantined-file gauge (media-fault containment): current
+        # count of files pulled from the read set, next to the
+        # detection counters the shards increment
+        self._quarantine_provider = self._quarantine_gauges
+        STATS.register_provider("quarantine", self._quarantine_provider)
         # memtable+WAL backlog joins the resource governor's unified
         # memory ledger and drives the /write backpressure watermark
         # (utils/governor.py; multiple engines sum process-wide)
@@ -339,10 +344,18 @@ class Engine:
                 for db in self.databases.values()
             ]
         }
+        from opengemini_tpu.storage import diskfault
+
         tmp = self._meta_path() + ".tmp"
+        if diskfault.armed():
+            diskfault.check("write", self._meta_path(),
+                            site="meta-save-write")
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(j, f)
             f.flush()
+            if diskfault.armed():
+                diskfault.on_fsync(self._meta_path(),
+                                   site="meta-save-fsync")
             os.fsync(f.fileno())
         os.replace(tmp, self._meta_path())
 
@@ -1587,6 +1600,33 @@ class Engine:
     def _durability_gauges(self) -> dict:
         return self.durability_snapshot()["totals"]
 
+    # -- quarantine (media-fault containment) ------------------------------
+
+    def quarantine_snapshot(self) -> dict:
+        """Every quarantined file across shards: {"files": [{shard,
+        path, why}], "total": n} — the /debug/ctrl?mod=scrub view."""
+        with self._lock:
+            shards = list(self._shards.items())
+        files = []
+        for (db, rp, start), sh in shards:
+            for path, why in sorted(sh.quarantined().items()):
+                files.append({"shard": f"{db}|{rp}|{start}",
+                              "path": path, "why": why})
+        return {"files": files, "total": len(files)}
+
+    def _quarantine_gauges(self) -> dict:
+        with self._lock:
+            shards = list(self._shards.values())
+        n = sum(len(sh.quarantined()) for sh in shards)
+        return {"files_current": n} if n else {}
+
+    def purge_quarantined(self) -> int:
+        """Delete quarantined files + markers from disk across all
+        shards (operator action after repair / accepted loss)."""
+        with self._lock:
+            shards = list(self._shards.values())
+        return sum(sh.purge_quarantined() for sh in shards)
+
     def mem_backlog_bytes(self) -> int:
         """Un-flushed resident bytes (live + frozen memtables + live WAL
         logs) across every shard — the write-backpressure input of the
@@ -1636,6 +1676,7 @@ class Engine:
 
     def close(self) -> None:
         STATS.unregister_provider("durability", self._durability_provider)
+        STATS.unregister_provider("quarantine", self._quarantine_provider)
         if self.rollup_mgr is not None:
             self.rollup_mgr.close()
         from opengemini_tpu.utils.governor import GOVERNOR as _GOVERNOR

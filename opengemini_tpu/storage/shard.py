@@ -23,9 +23,9 @@ from opengemini_tpu.record import (
 from opengemini_tpu.storage import colcache, scanpool
 from opengemini_tpu.storage.memtable import MemTable
 from opengemini_tpu.storage.tsf import (
-    PACK_MIN_SERIES, PACK_ROWS, TSFReader, TSFWriter,
+    PACK_MIN_SERIES, PACK_ROWS, CorruptFile, TSFReader, TSFWriter,
 )
-from opengemini_tpu.storage.wal import WAL
+from opengemini_tpu.storage.wal import WAL, WALCorruption
 from opengemini_tpu.utils.failpoint import inject as _fp
 from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
 from opengemini_tpu.utils.stats import GLOBAL as _STATS
@@ -157,6 +157,21 @@ _DATA_VERSIONS = itertools.count(1)  # see Shard.data_version
 _MUT_LOG_MAX = 512  # bounded mutation history; overflow = assume-changed
 
 
+class FileQuarantined(Exception):
+    """A read hit media damage in an immutable file; the file has been
+    QUARANTINED (out of the read set, durable `.quar` marker) and this
+    query failed cleanly before any wrong value was produced.  The NEXT
+    query over this shard skips the file; at rf>1 the coordinator's scan
+    failover classifies the resulting 500 as node-down for the round and
+    serves the ranges from a replica instead."""
+
+    def __init__(self, path: str, why: str):
+        super().__init__(
+            f"file quarantined after media fault: {path}: {why}")
+        self.path = path
+        self.why = why
+
+
 class DurabilityLedger:
     """Acked-rows vs durable-rows accounting for one shard (PR 4).
 
@@ -266,12 +281,21 @@ class Shard:
         # _replaying routes replay-applied rows into the replayed bucket
         self.ledger = DurabilityLedger()
         self._replaying = False
+        # media-damaged files pulled out of the read set: path -> why.
+        # Durable `.quar` markers keep quarantine sticky across reopens;
+        # the file itself stays on disk as evidence (and for operator
+        # purge via /debug/ctrl?mod=scrub&op=purge) — at rf>1 the scrub
+        # service heals the lost rows back in through anti-entropy.
+        self._quarantined: dict[str, str] = {}
         self._load_files()
         for r in self._files:
             for mst in r.measurements():
                 self.schemas.setdefault(mst, {}).update(r.schema(mst))
-        self.wal = WAL(os.path.join(path, "wal.log"), sync=sync_wal)
+        # replay BEFORE opening the live WAL handle: interior-corruption
+        # recovery may quarantine + rewrite wal.log on disk, and the
+        # append handle must open over the REWRITTEN file
         self._replay_wal()
+        self.wal = WAL(os.path.join(path, "wal.log"), sync=sync_wal)
 
     def _adopt(self, reader: TSFReader) -> TSFReader:
         """Stamp the shard's cache namespace onto a freshly-opened reader
@@ -284,6 +308,89 @@ class Shard:
         CURRENT files (close/offload hook; file-set swaps invalidate the
         retired readers at the swap site). Returns entries dropped."""
         return colcache.GLOBAL.invalidate_gens([r.gen for r in self._files])
+
+    # -- quarantine (media-fault containment) -------------------------------
+
+    def _quarantine_path(self, path: str, why: str) -> None:
+        """Record + durably mark one file quarantined (no reader swap —
+        open-time path, or the reader is already gone).  The `.quar`
+        marker keeps quarantine sticky across reopens; a crash between
+        detection and the marker just re-detects next open."""
+        import json as _json
+        import logging
+
+        _fp("quarantine-before-mark")  # detected, marker not yet durable
+        marker = _quar_marker(path)
+        tmp = marker + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                _json.dump({"why": why, "ts": __import__("time").time()}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, marker)
+        except OSError:
+            pass  # marker is sticky-convenience; in-memory state governs
+        self._quarantined[path] = why
+        _STATS.incr("quarantine", "tsf_files_total")
+        logging.getLogger("opengemini_tpu.shard").error(
+            "quarantined TSF file %s: %s", path, why)
+        from opengemini_tpu.utils.governor import GOVERNOR as _GOV
+
+        _GOV.trigger_diagnostic(f"TSF file quarantined: {path}: {why}")
+
+    def quarantine_file(self, path: str, why: str) -> bool:
+        """Runtime quarantine: pull a damaged file out of the read set.
+        Returns True when THIS call quarantined it (False = already
+        quarantined or not one of this shard's files).  Queries that
+        were mid-scan keep their reader refs (POSIX fds survive);
+        every later scan snapshot simply excludes the file."""
+        with self._lock:
+            idx = next((i for i, r in enumerate(self._files)
+                        if r.path == path), None)
+            if idx is None:
+                return False
+            reader = self._files[idx]
+            self._quarantine_path(path, why)
+            self._files = self._files[:idx] + self._files[idx + 1:]
+            self._tidx_cache.pop(path, None)
+            colcache.GLOBAL.invalidate_gens([reader.gen])
+            # logical content changed (rows vanished until repair):
+            # cached query results over the file's range must not mix
+            # with post-quarantine scans
+            lo = reader.tmin if reader.tmin is not None else self.tmin
+            hi = reader.tmax + 1 if reader.tmax is not None else self.tmax
+            self._note_mutation(lo, hi)
+        return True
+
+    def note_corrupt(self, exc: CorruptFile):
+        """Read-path handler: quarantine the damaged file and fail THIS
+        query cleanly (FileQuarantined) — detection always beats serving
+        a wrong value.  Unaffected queries (and retries of this one)
+        proceed without the file."""
+        self.quarantine_file(exc.path, exc.why)
+        raise FileQuarantined(exc.path, exc.why) from exc
+
+    def quarantined(self) -> dict[str, str]:
+        """{path: why} of this shard's quarantined files."""
+        with self._lock:
+            return dict(self._quarantined)
+
+    def purge_quarantined(self) -> int:
+        """Operator/scrub cleanup: delete quarantined files + markers
+        from disk (after rf>1 repair re-replicated the rows, or the
+        operator accepted the loss).  Returns files purged."""
+        with self._lock:
+            doomed = list(self._quarantined)
+            self._quarantined.clear()
+        n = 0
+        for path in doomed:
+            for p in (path, _quar_marker(path), _tidx_path(path)):
+                try:
+                    os.remove(p)
+                    n += p == path
+                except OSError:
+                    pass
+        return n
 
     def _note_mutation(self, lo: int, hi: int) -> None:
         """Record a logical-content change over [lo, hi) ns."""
@@ -313,6 +420,8 @@ class Shard:
     # -- open/recovery ------------------------------------------------------
 
     def _load_files(self) -> None:
+        import json as _json
+
         # sweep crash leftovers: a .merge/.tmp that never reached its
         # os.replace would otherwise accumulate as full-size garbage
         for f in os.listdir(self.path):
@@ -325,9 +434,29 @@ class Shard:
             f for f in os.listdir(self.path) if f.endswith(".tsf")
         )
         for name in names:
-            self._files.append(self._adopt(TSFReader(os.path.join(self.path, name))))
+            full = os.path.join(self.path, name)
+            # the sequence advances past EVERY file, quarantined or not:
+            # a later flush must never reuse a damaged file's name
             seq = int(name.split(".")[0])
             self._next_file_seq = max(self._next_file_seq, seq + 1)
+            marker = _quar_marker(full)
+            if os.path.exists(marker):
+                try:
+                    with open(marker, encoding="utf-8") as f:
+                        why = _json.load(f).get("why", "marker present")
+                except (OSError, ValueError):
+                    why = "marker present"
+                self._quarantined[full] = why
+                continue
+            try:
+                reader = TSFReader(full)
+            except CorruptFile as e:
+                # damaged trailer/meta/magic: the old behavior crashed
+                # the whole shard open (every query on every other file
+                # died with it) — quarantine the one file instead
+                self._quarantine_path(full, e.why)
+                continue
+            self._files.append(self._adopt(reader))
 
     def _replay_wal(self) -> None:
         self._replaying = True
@@ -352,42 +481,98 @@ class Shard:
         self._replay_one(wal_path)
 
     def _replay_one(self, wal_path: str) -> None:
+        try:
+            for entry in WAL.replay(wal_path):
+                self._replay_entry(entry)
+        except WALCorruption as e:
+            self._recover_wal_corruption(wal_path, e)
+
+    def _recover_wal_corruption(self, wal_path: str, e: WALCorruption) -> None:
+        """Interior WAL damage (media fault, never a crash artifact):
+        re-apply the salvaged suffix — every frame after the damage
+        holds rows that were ACKED — preserve the damaged log as a
+        quarantine sidecar, and rewrite a clean log from the decodable
+        frames so the recovered rows stay durable and the next reopen
+        replays cleanly (reopen idempotence).  At most the one destroyed
+        frame is lost, and LOUDLY: counters, log line, sherlock dump."""
+        import logging
+        import shutil as _shutil
+
+        for entry in e.salvaged_entries():
+            self._replay_entry(entry)
+        n_good = len(e.clean_frames) + len(e.salvaged_frames)
+        qdir = os.path.join(self.path, "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        qpath = os.path.join(
+            qdir, os.path.basename(wal_path) + f".corrupt-{e.offset}")
+        try:
+            if not os.path.exists(qpath):  # keep the FIRST evidence copy
+                _shutil.copy2(wal_path, qpath)
+        except OSError:
+            qpath = None  # evidence copy is best-effort, recovery is not
+        # rewrite the log with every decodable frame, atomically: the
+        # salvaged rows must not live only in this process's memtable
+        import zlib as _z
+
+        from opengemini_tpu.storage.wal import _HEADER as _WH
+
+        tmp = wal_path + ".tmp"
+        with open(tmp, "wb") as f:
+            for kind, payload in (*e.clean_frames, *e.salvaged_frames):
+                f.write(_WH.pack(len(payload), _z.crc32(payload), kind)
+                        + payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, wal_path)
+        _STATS.incr("wal", "interior_corruptions")
+        _STATS.incr("wal", "salvaged_frames", len(e.salvaged_frames))
+        _STATS.incr("quarantine", "wal_salvages_total")
+        logging.getLogger("opengemini_tpu.shard").error(
+            "WAL %s: interior corruption at offset %d — one frame "
+            "destroyed, %d frame(s) salvaged, damaged log preserved at "
+            "%s", wal_path, e.offset, len(e.salvaged_frames), qpath)
+        from opengemini_tpu.utils.governor import GOVERNOR as _GOV
+
+        _GOV.trigger_diagnostic(
+            f"WAL interior corruption in {wal_path} (offset {e.offset}, "
+            f"{n_good} frames recovered)")
+
+    def _replay_entry(self, entry) -> None:
         from opengemini_tpu.ingest import native_lp
 
-        for entry in WAL.replay(wal_path):
-            if entry[0] == "lines":
-                _, lines, precision, now_ns = entry
+        if entry[0] == "lines":
+            _, lines, precision, now_ns = entry
+            batch = None
+            try:
+                if not (self.tag_arrays and b"=[" in lines):
+                    batch = native_lp.parse_columnar(
+                        lines, precision, now_ns)
+            except lp.ParseError:
                 batch = None
+            if batch is not None:
                 try:
-                    if not (self.tag_arrays and b"=[" in lines):
-                        batch = native_lp.parse_columnar(
-                            lines, precision, now_ns)
-                except lp.ParseError:
-                    batch = None
-                if batch is not None:
-                    try:
-                        self._apply_columnar(batch, check_types=True)
-                    except FieldTypeConflict:
-                        # partial-write semantics: a batch rejected at write
-                        # time must not poison replay either
-                        pass
+                    self._apply_columnar(batch, check_types=True)
+                except FieldTypeConflict:
+                    # partial-write semantics: a batch rejected at write
+                    # time must not poison replay either
+                    pass
+                return
+            points = lp.parse_lines(lines, precision, now_ns,
+                                    expand_tag_arrays=self.tag_arrays)
+        else:
+            points = entry[1]
+        replayed = 0
+        for p in points:
+            mst, tags, t, fields = p
+            if self.tmin <= t < self.tmax:
+                sid = self.index.get_or_create(mst, tags)
+                try:
+                    self.mem.write_row(sid, mst, t, fields)
+                except FieldTypeConflict:
                     continue
-                points = lp.parse_lines(lines, precision, now_ns,
-                                        expand_tag_arrays=self.tag_arrays)
-            else:
-                points = entry[1]
-            replayed = 0
-            for p in points:
-                mst, tags, t, fields = p
-                if self.tmin <= t < self.tmax:
-                    sid = self.index.get_or_create(mst, tags)
-                    try:
-                        self.mem.write_row(sid, mst, t, fields)
-                    except FieldTypeConflict:
-                        continue
-                    replayed += 1
-            if replayed:  # one batched credit per entry, not per row
-                self._ledger_accept(replayed)
+                replayed += 1
+        if replayed:  # one batched credit per entry, not per row
+            self._ledger_accept(replayed)
 
     # -- write path ---------------------------------------------------------
 
@@ -803,6 +988,13 @@ class Shard:
                 self._merge_readers(self._files, w, tidx)
                 _fp("compact-before-replace")
                 w.finish()
+            except CorruptFile as e:
+                # damaged merge input: quarantine it so the NEXT
+                # compaction (and every query) proceeds without it —
+                # merging a corrupt block into the output would launder
+                # the damage past its checksum forever
+                w.abort()
+                self.note_corrupt(e)
             except BaseException:
                 w.abort()
                 raise
@@ -874,6 +1066,9 @@ class Shard:
         try:
             self._merge_readers(run, w, tidx)
             w.finish()  # atomically lands at tmp
+        except CorruptFile as e:
+            w.abort()
+            self.note_corrupt(e)  # see compact()
         except BaseException:
             w.abort()
             raise
@@ -1251,8 +1446,14 @@ class Shard:
                 jobs.append(lambda r=r, c=c: decode(r, c))
                 ests.append(scanpool.est_chunk_bytes(c, n_fields))
                 miss_at.append(i)
-        for i, out in zip(miss_at, scanpool.map_ordered(jobs, ests)):
-            recs[i] = out
+        try:
+            for i, out in zip(miss_at, scanpool.map_ordered(jobs, ests)):
+                recs[i] = out
+        except CorruptFile as e:
+            # media damage surfaced mid-scan (block CRC / short read):
+            # quarantine the file, fail THIS query cleanly — never
+            # return a partial/garbage record
+            self.note_corrupt(e)
         # frozen flush snapshots (oldest first) then the live memtable:
         # both are newer than every file, live is newest of all
         for m in mems:
@@ -1341,8 +1542,11 @@ class Shard:
                 miss_at.append(len(slots))
                 slots.append(None)
                 ests.append(scanpool.est_chunk_bytes(c, n_fields))
-        for i, part in zip(miss_at, scanpool.map_ordered(jobs, ests)):
-            slots[i] = part
+        try:
+            for i, part in zip(miss_at, scanpool.map_ordered(jobs, ests)):
+                slots[i] = part
+        except CorruptFile as e:
+            self.note_corrupt(e)  # see read_series
         parts.extend(p for p in slots if p is not None)
         for m in mems:  # frozen snapshots oldest first, live memtable last
             for sid_arr, mem_rec in m.bulk_parts(measurement, sids):
@@ -1456,6 +1660,11 @@ def _retire_files(readers: list) -> None:
 
 def _tidx_path(tsf_path: str) -> str:
     return tsf_path[:-4] + ".tidx" if tsf_path.endswith(".tsf") else tsf_path + ".tidx"
+
+
+def _quar_marker(tsf_path: str) -> str:
+    """Durable quarantine marker path for a damaged immutable file."""
+    return tsf_path + ".quar"
 
 
 class _TextSidecar:

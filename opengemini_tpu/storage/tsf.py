@@ -4,12 +4,20 @@ Reference: engine/immutable/tssp_file.go:65-146 (trailer + chunk meta +
 bloom), pre_aggregation.go:40 (per-column-segment count/min/max/sum that
 lets aggregate queries skip data blocks entirely).
 
-Layout:
-    "OGTSF01\\n"                      8-byte magic
-    column blocks (self-describing, see storage/encoding.py)
+Layout (format revision 2 — "survive the disk"):
+    "OGTSF02\\n"                      8-byte magic
+    column blocks, each SEALED: [encoded bytes][u32 crc32] — the
+          end-to-end per-block checksum verified on every decode
+          (self-describing payloads, see storage/encoding.py)
     meta: "BM02" + zlib(binary chunk meta — storage/chunkmeta.py,
           reference chunk_meta_codec.go); legacy zlib(JSON) still reads
     trailer: [u64 meta_off][u32 meta_len][u32 meta_crc]"OGTSFEND"
+
+Revision 1 files ("OGTSF01\\n", CRC-less blocks) remain readable: the
+head magic selects per-block verification, so a flipped bit in a v2
+data block raises CorruptFile at decode time — before any wrong value
+reaches a query — instead of silently decoding garbage (or crashing the
+codec).  Block locs cover the sealed length; `_read` strips the seal.
 
 Chunks are either one series' rows for one flush (time + field columns,
 validity masks, numeric pre-aggregation) or PK-sorted packed
@@ -30,13 +38,15 @@ from collections import OrderedDict
 import numpy as np
 
 from opengemini_tpu.record import Column, FieldType, Record
-from opengemini_tpu.storage import colcache, encodepool, encoding
+from opengemini_tpu.storage import colcache, diskfault, encodepool, encoding
 from opengemini_tpu.utils.bloom import BloomFilter
 from opengemini_tpu.utils.stats import GLOBAL as _STATS
 
-MAGIC = b"OGTSF01\n"
+MAGIC = b"OGTSF01\n"   # revision 1: CRC-less blocks (read-only legacy)
+MAGIC2 = b"OGTSF02\n"  # revision 2: per-block crc32 seals (written)
 END_MAGIC = b"OGTSFEND"
 _TRAILER = struct.Struct("<QII")
+_BLOCK_CRC = struct.Struct("<I")
 
 
 HIST_BINS = 32
@@ -147,17 +157,31 @@ class TSFWriter:
         self._kind = kind
         self._tmp = path + ".tmp"
         self._f = open(self._tmp, "wb")
-        self._f.write(MAGIC)
-        self._off = len(MAGIC)
+        self._f.write(MAGIC2)
+        self._off = len(MAGIC2)
         # mst -> {"schema": {field: int}, "chunks": [meta json]}
         self._meta: dict = {}
         self._pipe = encodepool.OrderedEncodePipe(self._write_encoded)
 
     def _write_block(self, buf: bytes) -> tuple[int, int]:
+        """Seal + write one block: [payload][u32 crc32(payload)] — the
+        ONE chokepoint every data block flows through, so the end-to-end
+        checksum can never be skipped by a new writer path.  Offsets and
+        lengths cover the sealed bytes; `TSFReader._read` verifies and
+        strips.  The diskfault hook may tear/corrupt what the media
+        actually holds — the writer still accounts the full sealed
+        length (a real torn sector lies to the writer the same way)."""
+        sealed = buf + _BLOCK_CRC.pack(zlib.crc32(buf))
         off = self._off
-        self._f.write(buf)
-        self._off += len(buf)
-        return (off, len(buf))
+        out = sealed
+        if diskfault.armed():
+            out = diskfault.on_write(self.path, sealed,
+                                     site="tsf-block-write")
+        self._f.write(out)
+        if len(out) != len(sealed):  # torn write: keep file offsets true
+            self._f.seek(off + len(sealed))
+        self._off += len(sealed)
+        return (off, len(sealed))
 
     def _check_schema(self, m: dict, rec: Record) -> None:
         """Synchronous (submit-time) schema merge: a type conflict raises
@@ -266,10 +290,15 @@ class TSFWriter:
         # remain readable
         meta_buf = b"BM02" + zlib.compress(chunkmeta.encode_meta(self._meta), 1)
         meta_off = self._off
-        self._f.write(meta_buf)
-        self._f.write(_TRAILER.pack(meta_off, len(meta_buf), zlib.crc32(meta_buf)))
-        self._f.write(END_MAGIC)
+        tail = (meta_buf
+                + _TRAILER.pack(meta_off, len(meta_buf), zlib.crc32(meta_buf))
+                + END_MAGIC)
+        if diskfault.armed():
+            tail = diskfault.on_write(self.path, tail, site="tsf-meta-write")
+        self._f.write(tail)
         self._f.flush()
+        if diskfault.armed():
+            diskfault.on_fsync(self.path, site="tsf-fsync")
         os.fsync(self._f.fileno())
         self._f.close()
         os.replace(self._tmp, self.path)  # atomic visibility
@@ -300,13 +329,28 @@ class TSFReader:
         tail = _TRAILER.size + len(END_MAGIC)
         if size < len(MAGIC) + tail:
             raise CorruptFile(path, "too small")
+        head = os.pread(self._f.fileno(), len(MAGIC), 0)
+        if diskfault.armed():
+            head = diskfault.on_read(path, head, site="tsf-open-read")
+        if head == MAGIC2:
+            # revision 2: every block carries a crc32 seal, verified on
+            # every decode (including colcache fills) in _read
+            self.block_crc = True
+        elif head == MAGIC:
+            self.block_crc = False  # legacy: readable, nothing to verify
+        else:
+            raise CorruptFile(path, "bad magic")
         self._f.seek(size - tail)
         trailer = self._f.read(tail)
+        if diskfault.armed():
+            trailer = diskfault.on_read(path, trailer, site="tsf-open-read")
         if trailer[-len(END_MAGIC) :] != END_MAGIC:
             raise CorruptFile(path, "bad end magic")
         meta_off, meta_len, meta_crc = _TRAILER.unpack(trailer[: _TRAILER.size])
         self._f.seek(meta_off)
         meta_buf = self._f.read(meta_len)
+        if diskfault.armed():
+            meta_buf = diskfault.on_read(path, meta_buf, site="tsf-open-read")
         if zlib.crc32(meta_buf) != meta_crc:
             raise CorruptFile(path, "meta crc mismatch")
         if meta_buf[:4] == b"BM02":
@@ -435,7 +479,22 @@ class TSFReader:
         # positioned read: concurrent query threads share this fd, and an
         # interleaved seek+read pair would decode bytes from the wrong
         # offset (and the column cache would then serve the garbage forever)
-        return os.pread(self._f.fileno(), loc[1], loc[0])
+        buf = os.pread(self._f.fileno(), loc[1], loc[0])
+        if diskfault.armed():
+            buf = diskfault.on_read(self.path, buf, site="tsf-block-read")
+        if len(buf) != loc[1]:
+            # a short pread means the media lost the block's tail (file
+            # truncated under us): surface it, never decode a prefix
+            raise CorruptFile(
+                self.path,
+                f"short read at {loc[0]}: {len(buf)}/{loc[1]} bytes")
+        if not self.block_crc:
+            return buf  # legacy revision-1 file: no seal to verify
+        payload, seal = buf[:-_BLOCK_CRC.size], buf[-_BLOCK_CRC.size:]
+        if zlib.crc32(payload) != _BLOCK_CRC.unpack(seal)[0]:
+            raise CorruptFile(
+                self.path, f"block crc mismatch at offset {loc[0]}")
+        return payload
 
     def read_times(self, chunk: ChunkMeta) -> np.ndarray:
         return encoding.decode_ints(self._read(chunk.time_loc))
@@ -674,6 +733,30 @@ class TSFReader:
             },
         )
 
+    # -- integrity scrub surface (services/scrub.py) ------------------------
+
+    def data_locs(self) -> list[tuple[int, int]]:
+        """Every data-block (off, len) of this file in a stable order —
+        the scrub service's work list.  Pure metadata walk, no IO."""
+        out: list[tuple[int, int]] = []
+        for mst in sorted(self.meta):
+            for c in self.meta[mst][1]:
+                out.append(c.time_loc)
+                if c.sid_loc:
+                    out.append(c.sid_loc)
+                for name in sorted(c.cols):
+                    cc = c.cols[name]
+                    out.append(cc["v"])
+                    if cc["m"]:
+                        out.append(cc["m"])
+        return out
+
+    def verify_block(self, loc: tuple[int, int]) -> int:
+        """Read + CRC-verify one block WITHOUT decoding or caching it;
+        returns bytes read.  Raises CorruptFile on any mismatch."""
+        self._read(loc)
+        return loc[1]
+
     def read_packed_bulk_if_cached(
         self, measurement: str, chunk: ChunkMeta,
         fields: list[str] | None = None,
@@ -696,5 +779,13 @@ class TSFReader:
 
 
 class CorruptFile(Exception):
+    """Media-level damage detected in a TSF file (bad magic/trailer,
+    meta CRC mismatch, short block read, block CRC mismatch).  Carries
+    the path so the shard's read paths can QUARANTINE the file — the
+    error taxonomy's boundary between "this query failed" and "this
+    file is damaged" (storage/shard.py quarantine)."""
+
     def __init__(self, path: str, why: str):
         super().__init__(f"corrupt TSF file {path}: {why}")
+        self.path = path
+        self.why = why

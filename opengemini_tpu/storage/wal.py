@@ -14,8 +14,19 @@ kind 2 = structured points: [zlib(JSON [[mst, [[k,v]..], t, {f: [type, val]}]..]
 kind 3 = raw lines, UNCOMPRESSED: same layout as kind 1 with the lines
          stored verbatim (batches >= 1MiB: zlib wall time beats raw disk
          writes on bulk loads — the reference WAL's snappy tradeoff)
-Torn tails (crc/len mismatch at EOF) are truncated on replay, matching the
-reference's tolerant WAL restore (engine/wal.go replay error handling).
+Corruption policy (the media-fault tier): a torn TAIL — the bad frame is
+the last decodable thing in the log — is truncated on replay, matching
+the reference's tolerant WAL restore (engine/wal.go replay error
+handling): a crash mid-append legitimately leaves a half-written final
+frame, and nothing after it was ever acked.  An INTERIOR bad frame — one
+with valid frames after it — can only be media damage (appends are
+strictly sequential), and every frame after it holds ACKED rows: replay
+raises `WALCorruption` instead of silently discarding them.  The
+exception carries the salvageable suffix (frames re-synced by scanning
+for the next valid [len][crc][kind] header whose CRC verifies), so the
+shard can re-apply the salvaged records, preserve the damaged log as a
+quarantine sidecar, and rewrite a clean log — losing at most the one
+destroyed frame, loudly, instead of the whole suffix, silently.
 
 Segments: `rotate()` renames the live log aside (flush freezes the
 memtable and rotates in one step, so encoding runs off the shard lock
@@ -45,6 +56,7 @@ from opengemini_tpu.utils.failpoint import inject as _fp
 import struct
 import zlib
 
+from opengemini_tpu.storage import diskfault
 from opengemini_tpu.utils.stats import GLOBAL as _STATS
 from opengemini_tpu.utils.stats import histogram as _histogram
 
@@ -58,7 +70,35 @@ _H_FSYNC = _histogram("wal_fsync_seconds")
 _KIND_RAW_LINES = 1
 _KIND_POINTS = 2
 _KIND_RAW_LINES_PLAIN = 3  # uncompressed: large batches (see append_lines)
+_KINDS = (_KIND_RAW_LINES, _KIND_POINTS, _KIND_RAW_LINES_PLAIN)
 _HEADER = struct.Struct("<IIB")
+
+
+class WALCorruption(Exception):
+    """Interior WAL damage: a bad frame with valid frames after it.
+    Replay raises this instead of silently truncating — the frames after
+    the damage hold ACKED rows.  Carries everything the shard needs to
+    recover: the raw decodable frames before (`clean_frames`) and after
+    (`salvaged_frames`) the damage, so it can re-apply the salvaged
+    suffix, quarantine the damaged log, and rewrite a clean one."""
+
+    def __init__(self, path: str, offset: int,
+                 clean_frames: list, salvaged_frames: list):
+        super().__init__(
+            f"WAL {path}: interior corruption at offset {offset} "
+            f"({len(salvaged_frames)} valid frame(s) salvaged after it)")
+        self.path = path
+        self.offset = offset
+        self.clean_frames = clean_frames        # [(kind, payload)] pre-damage
+        self.salvaged_frames = salvaged_frames  # [(kind, payload)] post-damage
+
+    def salvaged_entries(self):
+        """Decoded replay entries of the salvaged suffix (unknown kinds
+        — newer-version frames — are preserved in the rewrite but have
+        nothing to replay here)."""
+        return [WAL._decode_entry(kind, payload)
+                for kind, payload in self.salvaged_frames
+                if kind in _KINDS]
 
 # batches above this skip zlib: compressing a bulk-load batch costs more
 # wall time than writing it raw (measured: zlib-1 was ~40% of 10-field
@@ -102,7 +142,11 @@ class WAL:
         _STATS.incr("wal", "appends")
         _STATS.incr("wal", "bytes", _HEADER.size + len(payload))
         self.backlog_bytes += _HEADER.size + len(payload)
-        self._f.write(_HEADER.pack(len(payload), crc, kind) + payload)
+        data = _HEADER.pack(len(payload), crc, kind) + payload
+        if diskfault.armed():  # torn/flipped appends surface at replay
+            data = diskfault.on_write(self.path, data,
+                                      site="wal-append-write")
+        self._f.write(data)
         _fp("wal-after-append")  # entry framed, not yet fsynced/acked
         if not self.sync:
             return 0
@@ -166,6 +210,8 @@ class WAL:
                     target = self._seq  # everything appended so far
                 self._f.flush()
                 _fp("wal-before-sync")  # reference: engine/wal.go:391
+                if diskfault.armed():
+                    diskfault.on_fsync(self.path, site="wal-fsync")
                 _t0 = time.perf_counter_ns()
                 os.fsync(self._f.fileno())
                 _H_FSYNC.observe_ns(time.perf_counter_ns() - _t0)
@@ -200,6 +246,8 @@ class WAL:
                     return None
             except OSError:
                 pass
+            if diskfault.armed():
+                diskfault.on_fsync(self.path, site="wal-fsync")
             os.fsync(self._f.fileno())
             self._f.close()
             _fp("wal-rotate-before-rename")  # fsynced, still the live log
@@ -234,6 +282,8 @@ class WAL:
             while self._syncing:
                 self._cond.wait()
             self._f.flush()
+            if diskfault.armed():
+                diskfault.on_fsync(self.path, site="wal-fsync")
             os.fsync(self._f.fileno())
             self._synced = self._seq
 
@@ -259,45 +309,118 @@ class WAL:
             self._f.close()
             self._f = open(self.path, "wb")
             self._f.flush()
+            if diskfault.armed():
+                diskfault.on_fsync(self.path, site="wal-fsync")
             os.fsync(self._f.fileno())
             self._synced = self._seq
             self.backlog_bytes = 0
 
     @staticmethod
+    def _frame_at(data: bytes, off: int, strict: bool = False):
+        """(kind, payload, end) when a valid frame starts at `off`, else
+        None.  At a POSITIONALLY trusted offset (log start, or right
+        after a valid frame) validity is length-in-bounds + payload CRC
+        match; kind is NOT checked there, so a CRC-clean frame with an
+        unrecognized kind byte — a healthy frame from a newer version —
+        is skipped by replay (the old loop's forward-compat behavior),
+        never misclassified as media damage.  `strict` is the salvage
+        RESYNC probe: scanning arbitrary bytes, an empty payload with
+        crc 0 (any 8 zero bytes + any kind) would be a phantom frame,
+        so resync additionally demands a known kind and a non-empty
+        payload."""
+        if off + _HEADER.size > len(data):
+            return None
+        length, crc, kind = _HEADER.unpack_from(data, off)
+        if strict and (kind not in _KINDS or length == 0):
+            return None
+        start = off + _HEADER.size
+        end = start + length
+        if end > len(data):
+            return None
+        payload = data[start:end]
+        if zlib.crc32(payload) != crc:
+            return None
+        return kind, payload, end
+
+    @staticmethod
+    def _scan(data: bytes):
+        """Frame scan distinguishing torn tail from interior damage.
+        Returns (clean, salvaged, corrupt_off): `clean` = [(kind,
+        payload)] up to the first bad frame, `salvaged` = valid frames
+        re-synced after it (empty = torn tail, today's truncate), and
+        `corrupt_off` = byte offset of the damage (None = log clean)."""
+        clean: list = []
+        off, n = 0, len(data)
+        while off < n:
+            got = WAL._frame_at(data, off)
+            if got is None:
+                break
+            clean.append((got[0], got[1]))
+            off = got[2]
+        if off >= n:
+            return clean, [], None
+        corrupt_off = off
+        # salvage: hunt byte-by-byte for the next verifiable frame
+        # (strict probe — see _frame_at), then walk positionally until
+        # the next damaged stretch, re-probing the same way
+        salvaged: list = []
+        pos = off + 1
+        synced = False
+        while pos + _HEADER.size <= n:
+            got = WAL._frame_at(data, pos, strict=not synced)
+            if got is None:
+                synced = False
+                pos += 1
+                continue
+            salvaged.append((got[0], got[1]))
+            pos = got[2]
+            synced = True
+        return clean, salvaged, corrupt_off
+
+    @staticmethod
+    def _decode_entry(kind: int, payload: bytes):
+        if kind in (_KIND_RAW_LINES, _KIND_RAW_LINES_PLAIN):
+            plen, now_ns = struct.unpack_from("<BQ", payload)
+            prec = payload[9 : 9 + plen].decode("utf-8")
+            body = payload[9 + plen:]
+            lines = (zlib.decompress(body) if kind == _KIND_RAW_LINES
+                     else bytes(body))
+            return ("lines", lines, prec, now_ns)
+        doc = json.loads(zlib.decompress(payload))
+        points = [
+            (
+                mst,
+                tuple(tuple(t) for t in tags),
+                t_ns,
+                {k: (FieldType(ft), v) for k, (ft, v) in fields.items()},
+            )
+            for mst, tags, t_ns, fields in doc
+        ]
+        return ("points", points)
+
+    @staticmethod
     def replay(path: str):
         """Yield ("lines", lines_bytes, precision, now_ns) and
-        ("points", points) entries; stop at torn tail."""
+        ("points", points) entries.  A torn TAIL (bad final frame, crash
+        mid-append) truncates silently, as always.  An INTERIOR bad
+        frame — valid frames after it, so acked data sits beyond the
+        damage — raises WALCorruption after yielding the clean prefix;
+        the exception carries the salvaged suffix (see class doc).  The
+        old behavior silently dropped every acked record after the
+        damage."""
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
             data = f.read()
-        off, n = 0, len(data)
-        while off + _HEADER.size <= n:
-            length, crc, kind = _HEADER.unpack_from(data, off)
-            start = off + _HEADER.size
-            end = start + length
-            if end > n:
-                break  # torn write
-            payload = data[start:end]
-            if zlib.crc32(payload) != crc:
-                break  # corrupt tail
-            if kind in (_KIND_RAW_LINES, _KIND_RAW_LINES_PLAIN):
-                plen, now_ns = struct.unpack_from("<BQ", payload)
-                prec = payload[9 : 9 + plen].decode("utf-8")
-                body = payload[9 + plen:]
-                lines = (zlib.decompress(body) if kind == _KIND_RAW_LINES
-                         else bytes(body))
-                yield ("lines", lines, prec, now_ns)
-            elif kind == _KIND_POINTS:
-                doc = json.loads(zlib.decompress(payload))
-                points = [
-                    (
-                        mst,
-                        tuple(tuple(t) for t in tags),
-                        t_ns,
-                        {k: (FieldType(ft), v) for k, (ft, v) in fields.items()},
-                    )
-                    for mst, tags, t_ns, fields in doc
-                ]
-                yield ("points", points)
-            off = end
+        if diskfault.armed():
+            data = diskfault.on_read(path, data, site="wal-replay-read")
+        clean, salvaged, corrupt_off = WAL._scan(data)
+        for kind, payload in clean:
+            if kind in _KINDS:  # forward compat: skip newer-version kinds
+                yield WAL._decode_entry(kind, payload)
+        if corrupt_off is None:
+            return
+        if not salvaged:
+            _STATS.incr("wal", "torn_tails")
+            return  # torn tail: nothing acked can live past it
+        raise WALCorruption(path, corrupt_off, clean, salvaged)

@@ -660,6 +660,24 @@ class ResourceGovernor:
         if self._hook == fn:
             self._hook = None
 
+    def trigger_diagnostic(self, reason: str) -> None:
+        """Fire the diagnostic hook directly, off-thread (sherlock's own
+        cooldown still rate-limits the dump).  Non-governor emergencies
+        use this — the storage tier's first corruption/quarantine event
+        wants thread stacks + the ledger on disk while the evidence is
+        fresh."""
+        hook = self._hook
+        if hook is None:
+            return
+
+        def fire():
+            try:
+                hook(reason)
+            except Exception:  # noqa: BLE001 — diagnostics never take
+                pass           # down the detecting path
+        threading.Thread(target=fire, daemon=True,
+                         name="storage-diag").start()
+
     def _note_shed(self, reason: str) -> None:
         hook = None
         now = time.monotonic()

@@ -273,6 +273,13 @@ def test_tsf_corruption_fuzz(tmp_path):
     for trial in range(25):
         with open(src, "wb") as f:
             f.write(pristine)
+        # detection now QUARANTINES (durable .quar marker): drop the
+        # marker when restoring pristine bytes, or every later trial
+        # would silently skip the file instead of fuzzing the reader
+        try:
+            os.remove(src + ".quar")
+        except OSError:
+            pass
         _flip(src, rng)
         try:
             eng2 = Engine(str(tmp_path / "d"), sync_wal=False)
@@ -289,15 +296,23 @@ def test_tsf_corruption_fuzz(tmp_path):
                 assert 0 <= n <= 2000
             eng2.close()
         except Exception as e:  # noqa: BLE001
+            from opengemini_tpu.storage.shard import FileQuarantined
             from opengemini_tpu.storage.tsf import CorruptFile
 
-            # typed errors are acceptable; anything else is a finding
+            # typed errors are acceptable (FileQuarantined is the read
+            # path's containment wrapper around CorruptFile since the
+            # media-fault tier); anything else is a finding
             if not isinstance(
-                e, (ValueError, OSError, KeyError, EOFError, CorruptFile)
+                e, (ValueError, OSError, KeyError, EOFError, CorruptFile,
+                    FileQuarantined)
             ):
                 crashes.append((trial, type(e).__name__, str(e)[:120]))
     with open(src, "wb") as f:
         f.write(pristine)
+    try:
+        os.remove(src + ".quar")
+    except OSError:
+        pass
     assert not crashes, crashes
 
 

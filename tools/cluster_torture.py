@@ -174,6 +174,7 @@ balance-interval-s = 0
 [services]
 store-monitor = false
 compact-interval-s = 2
+scrub-interval-s = 3600
 retention-interval-s = 3600
 downsample-interval-s = 3600
 cq-interval-s = 3600
@@ -596,6 +597,38 @@ def verify(cluster: Cluster, acked: list[dict],
 # -- rounds ------------------------------------------------------------------
 
 
+def _scribble_node(victim: Node, rng: random.Random) -> str | None:
+    """Flip one bit in a data block of the victim's largest closed TSF
+    (DB shards only — never the meta/raft files).  Returns the path, or
+    None when the kill landed before any file closed."""
+    from opengemini_tpu.storage.tsf import TSFReader
+
+    roots = os.path.join(victim.data_dir, "data", DB)
+    candidates = sorted(
+        (os.path.join(dp, f)
+         for dp, _d, fs in os.walk(roots) for f in fs
+         if f.endswith(".tsf")),
+        key=os.path.getsize, reverse=True)
+    for path in candidates:
+        try:
+            r = TSFReader(path)
+            locs = r.data_locs()
+            r.close()
+        except Exception:  # noqa: BLE001 — half-written candidate
+            continue
+        if not locs:
+            continue
+        loc = locs[rng.randrange(len(locs))]
+        at = loc[0] + rng.randrange(loc[1])
+        with open(path, "r+b") as f:
+            f.seek(at)
+            b = f.read(1)
+            f.seek(at)
+            f.write(bytes([b[0] ^ (1 << rng.randrange(8))]))
+        return path
+    return None
+
+
 def _apply_round(cluster: Cluster, kind: str, rng: random.Random,
                  traffic: Traffic, site: str | None, nth: int,
                  victim: Node | None, pair: tuple[Node, Node] | None,
@@ -632,6 +665,23 @@ def _apply_round(cluster: Cluster, kind: str, rng: random.Random,
         time.sleep(rng.uniform(0.3, 1.2))
         victim.kill()
         detail["killed"].append(victim.nid)
+    elif kind == "scribble":
+        # media fault: kill the victim mid-traffic, then flip one bit
+        # inside a closed TSF data block of its data dir.  On restart
+        # the block CRC catches it (scrub tick / first decode), the
+        # file quarantines, and anti-entropy re-pulls the lost rows
+        # from the rf>1 replica — verify() then demands the FULL acked
+        # set from every coordinator, including this one.
+        time.sleep(rng.uniform(0.5, 1.2))
+        try:
+            # flush first so a closed TSF (the corruption target)
+            # deterministically exists on the victim
+            victim.ctrl("flush", timeout=30)
+        except (OSError, ValueError):
+            pass
+        victim.kill()
+        detail["killed"].append(victim.nid)
+        detail["scribbled"] = _scribble_node(victim, rng)
     elif kind == "partition":
         a, b = pair
         cluster.partition(a, b)
@@ -712,7 +762,30 @@ def run_rounds(cluster: Cluster, rounds: list[dict], workdir: str,
             detail["problems"] = [f"cluster never re-formed: {e}"]
             results.append(detail)
             break
+        scribble_problems: list[str] = []
+        if spec["kind"] == "scribble":
+            # force the integrity sweep NOW (instead of waiting out the
+            # production scrub interval): detection quarantines the
+            # damaged file and converge()'s anti-entropy rounds pull
+            # the lost rows back from the healthy replica
+            detail["quarantined"] = 0
+            for node in cluster.nodes:
+                if node.alive():
+                    try:
+                        got = node.ctrl("scrub", op="tick", timeout=120)
+                        detail["quarantined"] += \
+                            got.get("quarantine", {}).get("total", 0)
+                    except (OSError, ValueError):
+                        pass
+            if not detail.get("scribbled"):
+                scribble_problems.append(
+                    "scribble: no closed TSF target on the victim")
+            elif detail["quarantined"] < 1:
+                scribble_problems.append(
+                    "scribble: corruption injected but never detected/"
+                    "quarantined")
         problems = cluster.converge(timeout=90)
+        problems += scribble_problems
         acked = read_acks(ack_log)
         all_acked.extend(acked)
         detail["acked_batches"] = len(acked)
@@ -747,6 +820,11 @@ QUICK_ROUNDS = [
     # symmetric partition mid-traffic, then heal: hinted copies +
     # anti-entropy must re-converge every acked row
     {"kind": "partition", "pair": ["n1", "n2"]},
+    # media fault: kill a replica, flip one bit in a closed TSF data
+    # block, restart — block CRC detects, the file quarantines, and
+    # anti-entropy repairs from the rf=2 peer until every coordinator
+    # again serves the FULL acked set
+    {"kind": "scribble", "victim": "n3"},
 ]
 
 
@@ -764,9 +842,11 @@ def _random_schedule(rng: random.Random, n: int,
                     "victim": None if site in _MIGRATION_SITES
                     else rng.choice(nids),
                     "move": site in _MIGRATION_SITES or rng.random() < 0.3}
-        elif roll < 0.7:
+        elif roll < 0.65:
             spec = {"kind": "sigkill", "victim": rng.choice(nids),
                     "move": rng.random() < 0.4}
+        elif roll < 0.78:
+            spec = {"kind": "scribble", "victim": rng.choice(nids)}
         else:
             pair = rng.sample(nids, 2)
             spec = {"kind": "partition", "pair": pair,

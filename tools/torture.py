@@ -92,6 +92,51 @@ QUICK_ROUNDS = [
     (None, 0),  # parent-side SIGKILL at a fixed delay
 ]
 
+# media-fault (diskfault) consult sites in the storage IO paths
+# (storage/diskfault.py `site=` labels; the live-grep catalog test keeps
+# this list and the code in sync, like KILL_SITES for failpoints).
+# These are RULE consult points, not crash points — the scribble rounds
+# below and tests/test_diskfault.py drive them.
+DISKFAULT_SITES = [
+    "tsf-block-read",    # TSFReader._read: every block decode
+    "tsf-open-read",     # TSFReader.__init__: magic/trailer/meta
+    "tsf-block-write",   # TSFWriter._write_block: sealed block write
+    "tsf-meta-write",    # TSFWriter.finish: meta + trailer + end magic
+    "tsf-fsync",         # TSFWriter.finish: pre-rename durability
+    "wal-append-write",  # WAL._frame: entry framing
+    "wal-fsync",         # WAL commit/rotate/flush/truncate barriers
+    "wal-replay-read",   # WAL.replay: whole-log read at open
+    "meta-save-write",   # Engine._save_meta: metadata write
+    "meta-save-fsync",   # Engine._save_meta: metadata barrier
+]
+
+# --scribble: media-fault rounds — corrupt bytes ON DISK between the
+# kill and the restart-verify, then assert the detection/containment/
+# salvage contract instead of raw readability:
+#   wal-bitflip   flip one byte inside an INTERIOR WAL frame: replay
+#                 must salvage every frame after the damage (the old
+#                 code silently dropped the whole acked suffix), lose
+#                 at most the one destroyed frame, and preserve the
+#                 damaged log as a quarantine sidecar
+#   tsf-bitflip   flip one byte in a closed TSF data block: the block
+#                 CRC must catch it (scrub tick or first decode), the
+#                 file quarantines, and every acked row OUTSIDE the
+#                 quarantined file's chunk ranges stays readable with
+#                 its exact value — no wrong value is ever served
+#   tsf-truncate  chop the file's tail (trailer gone): quarantined at
+#                 open, same containment contract
+SCRIBBLE_MODES = ["wal-bitflip", "tsf-bitflip", "tsf-truncate"]
+
+# (mode, sigkill delay | None=run to completion).  The WAL round runs a
+# no-flush child to completion so the log deterministically holds every
+# frame; the TSF rounds kill mid-run so closed files exist alongside a
+# live WAL, like a real media fault window.
+QUICK_SCRIBBLE_ROUNDS = [
+    ("wal-bitflip", None),
+    ("tsf-bitflip", 0.05),
+    ("tsf-truncate", 0.05),
+]
+
 
 def _expected_value(k: int) -> int:
     return k
@@ -113,7 +158,9 @@ def run_child(args) -> int:
     from opengemini_tpu.storage.engine import Engine
 
     eng = Engine(args.dir, sync_wal=True)
-    eng.flush_threshold_bytes = 8 * 1024  # frequent threshold flushes
+    # scribble WAL rounds pin everything in the log (no flusher, huge
+    # threshold) so the corruption target deterministically exists
+    eng.flush_threshold_bytes = (1 << 30) if args.no_flush else 8 * 1024
     eng.create_database("db")
     stop = threading.Event()
     errors: list = []
@@ -155,8 +202,9 @@ def run_child(args) -> int:
 
     threads = [threading.Thread(target=writer, args=(w,), daemon=True)
                for w in range(args.writers)]
-    threads += [threading.Thread(target=flusher, daemon=True),
-                threading.Thread(target=compactor, daemon=True)]
+    if not args.no_flush:
+        threads += [threading.Thread(target=flusher, daemon=True),
+                    threading.Thread(target=compactor, daemon=True)]
     for t in threads:
         t.start()
     for t in threads[: args.writers]:
@@ -267,6 +315,307 @@ def verify_dir(data_dir: str, ack_log: str, args) -> list[str]:
     return problems
 
 
+# -- scribble: media-fault rounds -----------------------------------------
+
+
+def _find_wal_target(data_dir: str):
+    """A WAL file (live log or rotated segment) holding >= 3 frames, or
+    None.  Prefers the file with the most frames — more salvage work."""
+    from opengemini_tpu.storage.wal import WAL
+
+    best = None
+    for dirpath, _dirs, files in os.walk(data_dir):
+        for f in files:
+            if not (f == "wal.log" or f.startswith("wal.log.")):
+                continue
+            if ".corrupt" in f or f.endswith(".tmp"):
+                continue
+            path = os.path.join(dirpath, f)
+            with open(path, "rb") as fh:
+                data = fh.read()
+            clean, _salv, corrupt = WAL._scan(data)
+            if corrupt is None and len(clean) >= 3:
+                if best is None or len(clean) > best[2]:
+                    best = (path, data, len(clean))
+    return best
+
+
+def _wal_frame_rows(payload: bytes, kind: int) -> set[tuple[str, int]]:
+    """(writer-tag, k) keys carried by one raw-lines WAL frame."""
+    import struct as _struct
+    import zlib as _zlib
+
+    if kind not in (1, 3):
+        return set()
+    plen, _now = _struct.unpack_from("<BQ", payload)
+    body = payload[9 + plen:]
+    lines = _zlib.decompress(body) if kind == 1 else bytes(body)
+    out = set()
+    for line in lines.decode("utf-8").splitlines():
+        # "t,w=w<wid> v=<k>i <t_ns>"
+        try:
+            head, _fields, ts = line.split(" ")
+            wtag = head.split("w=", 1)[1]
+            out.add((wtag, int(ts) // NS - BASE))
+        except (ValueError, IndexError):
+            continue
+    return out
+
+
+def _scribble_wal(data_dir: str, rng: random.Random) -> dict | None:
+    """Flip one byte inside an interior frame's payload; returns the
+    victim row keys (only rows of THAT frame may legitimately vanish)."""
+    import struct as _struct
+
+    from opengemini_tpu.storage.wal import WAL, _HEADER
+
+    target = _find_wal_target(data_dir)
+    if target is None:
+        return None
+    path, data, n_frames = target
+    # walk to the chosen interior frame's byte offset
+    victim_idx = rng.randrange(1, n_frames - 1)
+    off = 0
+    for _ in range(victim_idx):
+        length, _crc, _kind = _HEADER.unpack_from(data, off)
+        off += _HEADER.size + length
+    length, _crc, kind = _HEADER.unpack_from(data, off)
+    payload = data[off + _HEADER.size: off + _HEADER.size + length]
+    victims = _wal_frame_rows(payload, kind)
+    flip_at = off + _HEADER.size + rng.randrange(length)
+    buf = bytearray(data)
+    buf[flip_at] ^= 1 << rng.randrange(8)
+    with open(path, "wb") as f:
+        f.write(bytes(buf))
+    return {"target": path, "frame": victim_idx, "of": n_frames,
+            "victims": victims}
+
+
+def _tsf_targets(data_dir: str) -> list[str]:
+    out = []
+    for dirpath, _dirs, files in os.walk(data_dir):
+        for f in files:
+            if f.endswith(".tsf"):
+                out.append(os.path.join(dirpath, f))
+    return sorted(out, key=os.path.getsize, reverse=True)
+
+
+def _tsf_chunk_ranges(path: str) -> list[tuple[int, int]]:
+    """(tmin, tmax) per chunk — the ranges acked rows may legitimately
+    vanish from once the file quarantines (single node: the media ate
+    them; at rf>1 anti-entropy restores them from a replica)."""
+    from opengemini_tpu.storage.tsf import TSFReader
+
+    r = TSFReader(path)
+    try:
+        return [(c.tmin, c.tmax)
+                for mst, (_s, chunks) in r.meta.items() for c in chunks]
+    finally:
+        r.close()
+
+
+def _scribble_tsf(data_dir: str, rng: random.Random,
+                  truncate: bool) -> dict | None:
+    """Corrupt the largest closed TSF: flip one bit in a random data
+    block (block CRC catches it) or truncate the tail (trailer gone,
+    caught at open)."""
+    from opengemini_tpu.storage.tsf import TSFReader
+
+    for path in _tsf_targets(data_dir):
+        try:
+            ranges = _tsf_chunk_ranges(path)
+        except Exception:  # noqa: BLE001 — already-damaged candidate
+            continue
+        if not ranges:
+            continue
+        if truncate:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(size - 16, 1))
+            return {"target": path, "mode": "truncate", "ranges": ranges}
+        r = TSFReader(path)
+        try:
+            locs = r.data_locs()
+        finally:
+            r.close()
+        if not locs:
+            continue
+        loc = locs[rng.randrange(len(locs))]
+        flip_at = loc[0] + rng.randrange(loc[1])
+        with open(path, "r+b") as f:
+            f.seek(flip_at)
+            b = f.read(1)
+            f.seek(flip_at)
+            f.write(bytes([b[0] ^ (1 << rng.randrange(8))]))
+        return {"target": path, "mode": "bitflip", "ranges": ranges}
+    return None
+
+
+def verify_scribbled(data_dir: str, ack_log: str, args, mode: str,
+                     scribble: dict) -> list[str]:
+    """The media-fault contract: damage is DETECTED (never decoded into
+    a wrong value), CONTAINED (only rows co-located with the damage may
+    vanish, and loudly), and recovery is idempotent.  WAL damage
+    additionally SALVAGES the acked suffix past the destroyed frame —
+    the regression the old truncate-at-first-bad-frame replay fails."""
+    from opengemini_tpu.services.scrub import ScrubService
+    from opengemini_tpu.storage.engine import Engine
+
+    acked = _read_acks(ack_log)
+    problems: list[str] = []
+
+    def check_rows(eng, rows) -> None:
+        # every readable row carries its exact value; a missing acked
+        # row must be explained by the damage (victim frame / chunk
+        # ranges of the quarantined file) — anything else is loss
+        for (wtag, k), v in rows.items():
+            if v != _expected_value(k):
+                problems.append(f"corrupt row served {wtag} k={k}: v={v}")
+        victims = scribble.get("victims", set())
+        ranges = scribble.get("ranges", [])
+        for wid, b in sorted(acked):
+            for rr in range(args.rows):
+                k = b * args.rows + rr
+                if rows.get((f"w{wid}", k)) is not None:
+                    continue
+                t_ns = (BASE + k) * NS
+                if (f"w{wid}", k) in victims:
+                    continue  # inside the destroyed WAL frame
+                if any(lo <= t_ns <= hi for lo, hi in ranges):
+                    continue  # inside the quarantined file's chunks
+                problems.append(
+                    f"LOST acked row outside the damage: writer {wid} "
+                    f"k={k}")
+
+    eng = Engine(data_dir, sync_wal=True)
+    try:
+        if mode != "wal-bitflip":
+            # deterministic detection: a scrub sweep (the tsf-truncate
+            # case already quarantined at open; bitflip needs the CRC
+            # walk).  Budget-unbounded tick: verify everything now.
+            # one tick with a huge budget sweeps every file
+            ScrubService(eng, 3600.0, mb_per_tick=1 << 20).tick_now()
+            q = eng.quarantine_snapshot()
+            if q["total"] < 1:
+                problems.append(
+                    f"{mode}: damage not detected (no quarantine)")
+        rows1 = _collect_rows(eng)
+        check_rows(eng, rows1)
+        problems += [f"ledger: {v}" for v in eng.durability_check()]
+        if mode == "wal-bitflip":
+            # loud salvage evidence: the damaged log preserved aside
+            sidecars = [
+                os.path.join(dp, f)
+                for dp, _d, fs in os.walk(data_dir)
+                for f in fs if ".corrupt-" in f
+            ]
+            if not sidecars:
+                problems.append(
+                    "wal-bitflip: no quarantine sidecar (silent "
+                    "truncation?)")
+    finally:
+        eng.close()
+
+    # reopen idempotence: the salvage rewrite / quarantine markers must
+    # replay clean — same rows, no second corruption event
+    eng = Engine(data_dir, sync_wal=True)
+    try:
+        rows2 = _collect_rows(eng)
+        if rows2 != rows1:
+            problems.append(
+                f"reopen not idempotent: {len(rows1)} rows then "
+                f"{len(rows2)}")
+        eng.flush_all()
+        rows3 = _collect_rows(eng)
+        check_rows(eng, rows3)
+        if rows3 != rows2:
+            problems.append("post-recovery flush changed rows")
+    finally:
+        eng.close()
+    return problems
+
+
+def run_scribble_round(mode: str, seed: int, args,
+                       sigkill_delay: float | None) -> dict:
+    """One media-fault round: run (and maybe kill) the child, corrupt
+    bytes on disk, restart-verify the detection/salvage contract."""
+    workdir = tempfile.mkdtemp(prefix="ogt-scribble-")
+    data_dir = os.path.join(workdir, "d")
+    ack_log = os.path.join(workdir, "acks.log")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["OGT_WAL_GROUP_COMMIT_US"] = "0"
+    env.pop("OGTPU_FAILPOINTS", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--dir", data_dir, "--ack-log", ack_log,
+           "--writers", str(args.writers), "--batches", str(args.batches),
+           "--rows", str(args.rows)]
+    if mode == "wal-bitflip":
+        cmd.append("--no-flush")  # every frame stays in the live log
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT)
+    killed_by = None
+    if sigkill_delay is not None:
+        # kill only once the corruption TARGET exists (a closed TSF):
+        # child interpreter startup dominates a fixed delay, so a wall-
+        # clock kill would routinely land before any data was written
+        deadline = time.time() + 30
+        while time.time() < deadline and proc.poll() is None:
+            if _tsf_targets(data_dir):
+                break
+            time.sleep(0.05)
+        try:
+            proc.wait(sigkill_delay)
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGKILL)
+            killed_by = "SIGKILL"
+    try:
+        out, _ = proc.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        killed_by = "watchdog"
+    text = out.decode("utf-8", "replace")
+    if proc.returncode == 2 or "CHILD-ERROR" in text:
+        return {"site": mode, "nth": 0, "ok": False, "killed_by": killed_by,
+                "problems": [f"child errored: {text[-400:]}"]}
+    rng = random.Random(seed)
+    if mode == "wal-bitflip":
+        scribble = _scribble_wal(data_dir, rng)
+    else:
+        scribble = _scribble_tsf(data_dir, rng,
+                                 truncate=(mode == "tsf-truncate"))
+    if scribble is None and mode != "wal-bitflip":
+        # nondeterministic kill landed before any target existed: flush
+        # once so a TSF exists, then retry the scribble.  (WAL rounds
+        # never fall through to a TSF scribble — the verification mode
+        # would no longer match the damage and report a false
+        # violation; their run-to-completion no-flush child guarantees
+        # frames anyway.)
+        from opengemini_tpu.storage.engine import Engine
+
+        eng = Engine(data_dir, sync_wal=True)
+        eng.flush_all()
+        eng.close()
+        scribble = _scribble_tsf(data_dir, rng,
+                                 truncate=(mode == "tsf-truncate"))
+    if scribble is None:
+        return {"site": mode, "nth": 0, "ok": False, "killed_by": killed_by,
+                "problems": ["no scribble target found"]}
+    problems = verify_scribbled(data_dir, ack_log, args, mode, scribble)
+    acked = len(_read_acks(ack_log))
+    import shutil
+
+    if not problems:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return {"site": mode, "nth": 0, "ok": not problems,
+            "killed_by": killed_by, "acked_batches": acked,
+            "scribble": {k: v for k, v in scribble.items()
+                         if k != "victims"},
+            "dir": None if not problems else workdir,
+            "problems": problems}
+
+
 def run_round(site: str | None, nth: int, seed: int, args,
               sigkill_delay: float | None = None) -> dict:
     workdir = tempfile.mkdtemp(prefix="ogt-torture-")
@@ -326,6 +675,9 @@ def main(argv=None) -> int:
     ap.add_argument("--ack-log")
     ap.add_argument("--quick", action="store_true",
                     help="fixed-seed bounded run (tier-1 CI)")
+    ap.add_argument("--scribble", action="store_true",
+                    help="media-fault rounds: corrupt on-disk bytes "
+                         "between kill and restart-verify")
     ap.add_argument("--rounds", type=int, default=0,
                     help="randomized rounds over all kill sites")
     ap.add_argument("--seed", type=int, default=1)
@@ -333,10 +685,47 @@ def main(argv=None) -> int:
     ap.add_argument("--writers", type=int, default=3)
     ap.add_argument("--batches", type=int, default=6)
     ap.add_argument("--rows", type=int, default=25)
+    ap.add_argument("--no-flush", action="store_true",
+                    help=argparse.SUPPRESS)  # child: pin rows in the WAL
     args = ap.parse_args(argv)
 
     if args.child:
         return run_child(args)
+
+    if args.scribble:
+        rng = random.Random(args.seed)
+        if args.quick:
+            schedule = list(QUICK_SCRIBBLE_ROUNDS)
+        else:
+            schedule = [
+                (rng.choice(SCRIBBLE_MODES),
+                 None if rng.random() < 0.3 else rng.uniform(0.0, 0.4))
+                for _ in range(args.rounds or 20)
+            ]
+        results = []
+        t0 = time.time()
+        for i, (mode, delay) in enumerate(schedule):
+            res = run_scribble_round(mode, args.seed * 10_000 + i, args,
+                                     sigkill_delay=delay)
+            results.append(res)
+            status = "ok" if res["ok"] else "VIOLATION"
+            print(f"[{i + 1}/{len(schedule)}] scribble:{mode}: "
+                  f"{res['killed_by'] or 'ran-to-completion'}: {status}",
+                  flush=True)
+            if not res["ok"]:
+                for p in res["problems"]:
+                    print("   ", p, flush=True)
+        bad = [r for r in results if not r["ok"]]
+        summary = {
+            "rounds": len(results),
+            "killed": sum(1 for r in results if r["killed_by"]),
+            "violations": len(bad),
+            "elapsed_s": round(time.time() - t0, 1),
+        }
+        print(json.dumps({"summary": summary, "violations": bad},
+                         indent=2, default=str))
+        print("TORTURE-JSON " + json.dumps({"summary": summary}))
+        return 1 if bad else 0
 
     rounds: list[tuple[str | None, int, float | None]] = []
     if args.quick:
