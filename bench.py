@@ -1060,6 +1060,121 @@ def bench_observability_overhead(series: int = 100, points: int = 2000,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_lockdep_overhead(series: int = 60, points: int = 1500,
+                           rounds: int = 3) -> dict:
+    """Cost of the runtime lock-order validator (ISSUE 10): the
+    identical warm e2e ingest+flush+GROUP BY time() workload in TWO
+    CHILD PROCESSES — one with OGT_LOCKDEP=1, one unset — because
+    arming is an import-time decision (that is exactly what makes the
+    unarmed path free).  Asserts the two runs are BIT-IDENTICAL (result
+    digest) and that the unarmed module exports CLASS ALIASES
+    (`lockdep.Lock is threading.Lock`), i.e. zero per-acquisition work
+    by construction rather than by measurement.  The armed ratio is
+    reported honestly — it is a testing mode, not a production cost."""
+    import hashlib  # noqa: F401 — child-side import, kept for greppers
+    import json as _json
+    import subprocess as _sp
+
+    child_src = r"""
+import hashlib, json, os, sys, tempfile, time, shutil
+from opengemini_tpu.query.executor import Executor
+from opengemini_tpu.storage.engine import Engine
+from opengemini_tpu.utils import lockdep
+import threading
+
+armed = os.environ.get("OGT_LOCKDEP", "") not in ("", "0")
+assert lockdep.enabled() == armed
+if not armed:
+    # the pass-through claim: aliases, not shims
+    assert lockdep.Lock is threading.Lock
+    assert lockdep.RLock is threading.RLock
+    assert lockdep.Condition is threading.Condition
+
+series, points, rounds = (int(sys.argv[1]), int(sys.argv[2]),
+                          int(sys.argv[3]))
+NS = 1_000_000_000
+base = 1_700_000_000
+root = tempfile.mkdtemp(prefix="ogtpu-bench-lockdep-")
+try:
+    t_ingest0 = time.perf_counter()
+    eng = Engine(root, sync_wal=False)
+    eng.create_database("bench")
+    batch = []
+    for p in range(points):
+        ts = (base + p) * NS
+        for s in range(series):
+            batch.append(f"cpu,host=h{s} v={50 + (s + p) % 50} {ts}")
+        if len(batch) >= 100_000:
+            eng.write_lines("bench", "\n".join(batch))
+            batch.clear()
+    if batch:
+        eng.write_lines("bench", "\n".join(batch))
+    eng.flush_all()
+    t_ingest = time.perf_counter() - t_ingest0
+    ex = Executor(eng)
+    q = ("SELECT mean(v), max(v), count(v) FROM cpu "
+         f"WHERE time >= {base * NS} AND time < {(base + points) * NS} "
+         "GROUP BY time(1m)")
+    now = (base + points) * NS
+    ex.execute(q, db="bench", now_ns=now)  # compile warmup
+    best = float("inf")
+    out = None
+    for _ in range(rounds):
+        ex._inc_cache.clear()  # measure the scan path, not the cache
+        t0 = time.perf_counter()
+        out = ex.execute(q, db="bench", now_ns=now)
+        best = min(best, time.perf_counter() - t0)
+    digest = hashlib.sha256(
+        json.dumps(out, sort_keys=True).encode()).hexdigest()
+    if armed:
+        lockdep.check()  # the workload itself must be violation-free
+    eng.close()
+    print("LOCKDEP-CHILD " + json.dumps({
+        "query_best_ms": best * 1e3, "ingest_s": t_ingest,
+        "digest": digest,
+        "lockdep": lockdep.stats_snapshot()}))
+finally:
+    shutil.rmtree(root, ignore_errors=True)
+"""
+
+    def run_child(armed: bool) -> dict:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("OGT_LOCKDEP", None)
+        if armed:
+            env["OGT_LOCKDEP"] = "1"
+        proc = _sp.run(
+            [sys.executable, "-c", child_src,
+             str(series), str(points), str(rounds)],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, (
+            f"lockdep bench child (armed={armed}) failed:\n"
+            f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("LOCKDEP-CHILD ")][-1]
+        return _json.loads(line[len("LOCKDEP-CHILD "):])
+
+    off = run_child(False)
+    on = run_child(True)
+    assert off["digest"] == on["digest"], (
+        "lockdep armed run changed query results")
+    q_ratio = on["query_best_ms"] / max(off["query_best_ms"], 1e-9)
+    return {
+        "rows": series * points,
+        "query_off_ms": round(off["query_best_ms"], 3),
+        "query_armed_ms": round(on["query_best_ms"], 3),
+        "query_armed_ratio": round(q_ratio, 3),
+        "ingest_off_s": round(off["ingest_s"], 3),
+        "ingest_armed_s": round(on["ingest_s"], 3),
+        "ingest_armed_ratio": round(
+            on["ingest_s"] / max(off["ingest_s"], 1e-9), 3),
+        "bit_identical": True,
+        "unarmed_is_alias": True,  # asserted inside the unarmed child
+        "armed_lock_classes": on["lockdep"].get("classes", 0),
+        "armed_order_edges": on["lockdep"].get("edges", 0),
+    }
+
+
 def bench_scrub_overhead(series: int = 100, points: int = 2000,
                          rounds: int = 5) -> dict:
     """Cost of the storage-integrity tier (ISSUE 9): the identical warm
@@ -1429,7 +1544,7 @@ _ATSPEC_LASTGOOD_PATH = os.path.join(
 
 
 def _save_atspec_lastgood(doc: dict) -> None:
-    rec = {"captured_unix": int(time.time()),
+    rec = {"captured_unix": int(time.time()),  # ogtlint: disable=OGT040 (wall-clock capture stamp)
            "captured_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
            "atspec": doc}
     prev = _load_atspec_lastgood()
@@ -1651,7 +1766,7 @@ _LASTGOOD_PATH = os.path.join(
 
 def _save_lastgood(configs: dict, e2e: dict | None) -> None:
     doc = {
-        "captured_unix": int(time.time()),
+        "captured_unix": int(time.time()),  # ogtlint: disable=OGT040 (wall-clock capture stamp)
         "captured_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "configs": configs,
     }
@@ -1840,6 +1955,19 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     except Exception as e:  # noqa: BLE001 — bench must still emit
         print(f"bench: scrub overhead failed: {e}", file=sys.stderr)
 
+    # lock-order validator cost (ISSUE 10): armed vs unarmed warm e2e in
+    # two child processes, bit-identical asserted; the unarmed leg also
+    # asserts the class-alias pass-through (zero per-acquisition work)
+    lockdep_overhead = None
+    try:
+        lockdep_overhead = bench_lockdep_overhead()
+        _emit("lockdep_overhead" + suffix,
+              lockdep_overhead["query_armed_ratio"], "x armed/off",
+              lockdep_overhead["query_armed_ratio"],
+              {"detail": lockdep_overhead})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: lockdep overhead failed: {e}", file=sys.stderr)
+
     # cluster rebalance cost: query p99 + ingest rows/s while a forced
     # balancer move streams shard groups, vs quiescent (the PR 6
     # acceptance metric; runs a real 3-node rf=2 subprocess cluster)
@@ -1899,6 +2027,8 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
         extra["observability_overhead"] = obs_overhead
     if scrub_overhead:
         extra["scrub_overhead"] = scrub_overhead
+    if lockdep_overhead:
+        extra["lockdep_overhead"] = lockdep_overhead
     if rebalance:
         extra["rebalance_under_traffic"] = rebalance
     if note:
@@ -1960,7 +2090,7 @@ def main() -> None:
     # still get all 3 attempts; full hangs stop while the device child and
     # CPU smoke still fit their share).
     total_budget = int(os.environ.get("OGTPU_BENCH_TOTAL_S", "900"))
-    t_start = time.time()
+    t_start = time.perf_counter()
     probe_timeout = float(os.environ.get("OGTPU_PROBE_TIMEOUT_S", "90"))
     attempt_worst = probe_timeout + float(os.environ.get(
         "OGTPU_PROBE_STAGE_S", str(max(5.0, probe_timeout)))) + 5.0
@@ -1972,7 +2102,7 @@ def main() -> None:
                          ("ok", "failed_stage", "detail")})
         if probe.get("ok"):
             break
-        if time.time() - t_start + attempt_worst > total_budget * 0.4:
+        if time.perf_counter() - t_start + attempt_worst > total_budget * 0.4:
             break
         time.sleep(10)
     probe["attempts"] = attempts

@@ -20,6 +20,7 @@ import re
 import struct
 import subprocess
 import threading
+from opengemini_tpu.utils import lockdep
 
 import numpy as np
 
@@ -150,7 +151,7 @@ class MergesetIndex:
         self._h = lib.msi_open(path.encode())
         if not self._h:
             raise OSError(f"msi_open failed for {path!r}")
-        self._lock = threading.RLock()
+        self._lock = lockdep.RLock()
         # sid -> (mst, tags): bounded decode cache for the render path
         self._tags_cache: dict[int, tuple] = {}
         # series key -> sid: the ingest hot path is overwhelmingly repeat

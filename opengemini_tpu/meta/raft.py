@@ -25,6 +25,7 @@ import os
 import random
 import struct
 import threading
+from opengemini_tpu.utils import lockdep
 import zlib
 
 FOLLOWER = "follower"
@@ -54,7 +55,7 @@ class RaftNode:
         self.apply_fn = apply_fn
         self.restore_fn = restore_fn  # state-machine full restore (snapshots)
         self.storage_path = storage_path
-        self._lock = threading.RLock()
+        self._lock = lockdep.RLock()
 
         # persistent state
         self.current_term = 0

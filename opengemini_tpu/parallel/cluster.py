@@ -19,6 +19,7 @@ from __future__ import annotations
 import hashlib
 import json
 import threading
+from opengemini_tpu.utils import lockdep
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -62,7 +63,7 @@ class CircuitBreaker:
     def __init__(self, threshold: int = 0, cooldown_s: float = 5.0):
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock()
         # peer key -> [consecutive failures, opened-at walltime,
         #              half-open trial in flight]
         self._peers: dict[str, list] = {}
@@ -83,7 +84,7 @@ class CircuitBreaker:
                 return True
             if st[2]:
                 return False  # one half-open probe at a time
-            if _t.time() - st[1] >= self.cooldown_s:
+            if _t.perf_counter() - st[1] >= self.cooldown_s:
                 st[2] = True  # this caller becomes the trial probe
                 return True
             return False
@@ -104,7 +105,7 @@ class CircuitBreaker:
             else:
                 st[0] += 1
                 if st[0] >= self.threshold:
-                    st[1] = _t.time()  # (re)open with a fresh cooldown
+                    st[1] = _t.perf_counter()  # (re)open: fresh cooldown
 
     def state(self, key: str) -> str:
         if self.threshold <= 0:
@@ -115,7 +116,7 @@ class CircuitBreaker:
             st = self._peers.get(key)
             if st is None or st[0] < self.threshold:
                 return "closed"
-            if st[2] or _t.time() - st[1] >= self.cooldown_s:
+            if st[2] or _t.perf_counter() - st[1] >= self.cooldown_s:
                 return "half-open"
             return "open"
 
@@ -598,7 +599,7 @@ class DataRouter:
         self.breaker = CircuitBreaker(
             threshold=_env_int("OGT_CB_THRESHOLD", 0),
             cooldown_s=_env_float("OGT_CB_COOLDOWN_S", 5.0))
-        self._hint_lock = threading.Lock()
+        self._hint_lock = lockdep.Lock()
         # last health-probe results: node id -> bool (True = reachable)
         self.health: dict[str, bool] = {}
         self.health_ts: float = 0.0  # walltime of the last local probe
@@ -640,7 +641,7 @@ class DataRouter:
         results = dict(self._fanout(probe))
         results[self.self_id] = True
         self.health = results
-        self.health_ts = _t.time()
+        self.health_ts = _t.time()  # ogtlint: disable=OGT040 (wall stamp, 0.0 sentinel)
         return results
 
     def exchange_health(self) -> dict[str, bool]:
@@ -658,7 +659,7 @@ class DataRouter:
         import time as _t
 
         local = dict(self.probe_health())
-        now = _t.time()
+        now = _t.time()  # ogtlint: disable=OGT040 (down_since display stamp)
 
         def fetch(nid, addr):
             # fetch from EVERY peer, including ones our local probe lost:

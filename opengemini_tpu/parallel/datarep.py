@@ -34,6 +34,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+from opengemini_tpu.utils import lockdep
 import time as _time
 
 from opengemini_tpu.meta.raft import LEADER, RaftNode
@@ -171,7 +172,7 @@ class DataReplication:
         self.engine = router.engine
         self.token = token
         self.groups: dict[str, ReplicaGroup] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock()
         # live address book shared (by reference) with every group
         # transport; refreshed from the roster on ensure/deliver
         self._addr_of: dict[str, str] = {}

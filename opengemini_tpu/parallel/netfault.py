@@ -48,9 +48,10 @@ from __future__ import annotations
 import fnmatch
 import os
 import threading
+from opengemini_tpu.utils import lockdep
 import time
 
-_lock = threading.Lock()
+_lock = lockdep.Lock()
 # armed rules: (src, dst, path, action) — first match wins, in arming order
 _rules: list[tuple[str, str, str, str]] = []
 _hits: dict[str, int] = {}
@@ -163,7 +164,10 @@ def check(src: str, path: str, *dsts: str) -> None:
             f"netfault: dropped {src or '?'} -> {dsts[0] if dsts else '?'} "
             f"{path}")
     if action.startswith("delay:"):
-        time.sleep(float(action.split(":", 1)[1]))
+        # audited blocking: delay: exists to stall RPCs mid-flight,
+        # deliberately wherever the consult point sits
+        with lockdep.allow_blocking("netfault delay action"):
+            time.sleep(float(action.split(":", 1)[1]))
         return
     # error[:status]
     import io

@@ -20,6 +20,7 @@ import math
 import os
 import re
 import threading as _threading
+from opengemini_tpu.utils import lockdep
 import time as _time
 from dataclasses import dataclass
 
@@ -395,7 +396,7 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         # serializes leader-side user DDL: check-then-propose must not race
         # across HTTP threads (duplicate CREATE USER would silently replace
         # the first user's credentials)
-        self._user_ddl_lock = _threading.Lock()
+        self._user_ddl_lock = lockdep.Lock()
         # incremental GROUP BY time() result cache (query/resultcache.py)
         from opengemini_tpu.query.resultcache import IncrementalCache
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import threading
+from opengemini_tpu.utils import lockdep
 from collections import OrderedDict
 
 import numpy as np
@@ -43,7 +44,7 @@ class IncrementalCache:
     def __init__(self, max_queries: int = _MAX_QUERIES,
                  max_windows: int = _MAX_WINDOWS):
         self._store: OrderedDict[str, dict] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock()
         self.max_queries = max_queries
         self.max_windows = max_windows
 

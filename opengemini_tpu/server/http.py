@@ -75,7 +75,8 @@ def _route_of(path: str) -> str:
 def time_now_s() -> float:
     import time as _t
 
-    return _t.time()
+    # wall clock: PromQL evaluation timestamp, not a duration
+    return _t.time()  # ogtlint: disable=OGT040
 
 
 def _prom_time(s: str | None) -> float:
@@ -466,7 +467,7 @@ def _make_handler(svc: HttpService):
                     # the voter's staleness cut must not depend on clocks
                     # agreeing across nodes (NTP skew > the threshold
                     # would silently disqualify a healthy peer's votes)
-                    "age_s": (_t.time() - ts) if ts else None,
+                    "age_s": (_t.time() - ts) if ts else None,  # ogtlint: disable=OGT040 (health_ts wall pair)
                 })
             elif path == "/metrics":
                 # Prometheus text-format export (the statisticsPusher
@@ -480,7 +481,8 @@ def _make_handler(svc: HttpService):
             elif path == "/debug/vars":
                 import time as _t
 
-                snap = {"system": {"uptime_s": round(_t.time() - STATS.started_at, 1),
+                snap = {"system": {"uptime_s": round(
+                    _t.perf_counter() - STATS.started_pc, 1),
                                    "version": __version__}}
                 snap.update(STATS.snapshot())
                 self._send_json(200, snap)
@@ -1347,7 +1349,9 @@ def _make_handler(svc: HttpService):
             STREAMED via HTTP chunked transfer encoding — each document is
             serialized and written independently, never the whole response
             (handler.go chunked write path)."""
-            self.send_response(200)
+            # drained: /query reads params via _merge_form_body/_body()
+            # before execution ever reaches here
+            self.send_response(200)  # ogtlint: disable=OGT020
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
             self.send_header("X-Influxdb-Version", "1.8.0-" + __version__)
@@ -1727,7 +1731,8 @@ def _make_handler(svc: HttpService):
             payload = prom_remote.encode_read_response(results)
             from opengemini_tpu.ingest.protowire import snappy_compress_literal
             out = snappy_compress_literal(payload)
-            self.send_response(200)
+            # drained: the read request was decoded from _body() above
+            self.send_response(200)  # ogtlint: disable=OGT020
             self.send_header("Content-Type", "application/x-protobuf")
             self.send_header("Content-Encoding", "snappy")
             self.send_header("Content-Length", str(len(out)))
@@ -1794,7 +1799,8 @@ def _make_handler(svc: HttpService):
                 return
             if self._write_decoded_points(db, params.get("rp") or None, points):
                 # empty ExportMetricsServiceResponse
-                self.send_response(200)
+                # drained: the OTLP payload was decoded from _body() above
+                self.send_response(200)  # ogtlint: disable=OGT020
                 self.send_header("Content-Type", "application/x-protobuf")
                 self.send_header("Content-Length", "0")
                 self.end_headers()

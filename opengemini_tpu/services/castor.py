@@ -282,6 +282,7 @@ def detect(values: np.ndarray, algorithm: str, threshold: float | None = None) -
 import json as _json
 import os as _os
 import threading as _threading
+from opengemini_tpu.utils import lockdep
 import time as _time
 
 
@@ -304,7 +305,8 @@ def fit(algorithm: str, values: np.ndarray, threshold: float | None = None) -> d
         "threshold": thr,
         "params": params,
         "trained_rows": int(len(v)),
-        "fitted_at": int(_time.time()),
+        # wall-clock record: model provenance shown to operators
+        "fitted_at": int(_time.time()),  # ogtlint: disable=OGT040
     }
 
 
@@ -357,7 +359,7 @@ class ModelStore:
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = _threading.Lock()
+        self._lock = lockdep.Lock()
         _os.makedirs(path, exist_ok=True)
 
     def _file(self, name: str) -> str:

@@ -80,8 +80,9 @@ class ScrubService(Service):
         # interleave cursor/done mutations (regressed cursors, double
         # verification charged twice, double pass counts)
         import threading
+        from opengemini_tpu.utils import lockdep
 
-        self._tick_lock = threading.Lock()
+        self._tick_lock = lockdep.Lock()
 
     # -- one tick ----------------------------------------------------------
 

@@ -46,8 +46,9 @@ class SherlockService(Service):
         # governor burst hook (diagnose, its own thread) races the
         # service tick (handle), and one window must yield ONE dump
         import threading
+        from opengemini_tpu.utils import lockdep
 
-        self._dump_lock = threading.Lock()
+        self._dump_lock = lockdep.Lock()
         if enable_tracemalloc:  # ~2x alloc overhead; opt-in like pprof heap
             import tracemalloc
 

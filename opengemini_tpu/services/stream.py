@@ -13,6 +13,7 @@ Supported aggregates: accumulable ones — count/sum/min/max/mean.
 from __future__ import annotations
 
 import threading
+from opengemini_tpu.utils import lockdep
 import time as _time
 
 from opengemini_tpu.ops import window as winmod
@@ -90,7 +91,7 @@ class StreamService(Service):
     def __init__(self, engine, interval_s: float = 5.0):
         super().__init__(interval_s)
         self.engine = engine
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock()
         self._flushing = threading.local()
         self._states: dict[tuple[str, str], _TaskState] = {}
         engine.add_write_observer(self.on_write)

@@ -65,6 +65,7 @@ from __future__ import annotations
 
 import os
 import threading
+from opengemini_tpu.utils import lockdep
 import time
 from collections import OrderedDict
 
@@ -104,7 +105,7 @@ class ColumnCache:
     def __init__(self, budget_mb: int | None = None,
                  device: bool | None = None,
                  device_budget_mb: int | None = None):
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock()
         self._host: OrderedDict = OrderedDict()  # key -> (value, nbytes)
         self._by_gen: dict[int, set] = {}
         self._host_bytes = 0

@@ -54,8 +54,9 @@ from __future__ import annotations
 import fnmatch
 import os
 import threading
+from opengemini_tpu.utils import lockdep
 
-_lock = threading.Lock()
+_lock = lockdep.Lock()
 # armed rules: (glob, action) — first applicable match wins, arming order
 _rules: list[tuple[str, str]] = []
 _hits: dict[str, int] = {}

@@ -36,6 +36,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+from opengemini_tpu.utils import lockdep
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
@@ -55,7 +56,7 @@ WORKERS = int(os.environ.get("OGT_ENCODE_WORKERS", "0")) or _auto_workers()
 INFLIGHT_BYTES = (int(os.environ.get("OGT_ENCODE_INFLIGHT_MB", "0")) or 256) << 20
 
 _pool: ThreadPoolExecutor | None = None
-_pool_lock = threading.Lock()
+_pool_lock = lockdep.Lock()
 # thread-local, NOT process-global: a bench/test A-B block must not
 # degrade a concurrent flush on another thread to serial encode
 _serial_local = threading.local()

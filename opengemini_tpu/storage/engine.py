@@ -13,6 +13,7 @@ import json
 import os
 import shutil
 import threading
+from opengemini_tpu.utils import lockdep
 import time as _time
 
 from opengemini_tpu.ingest import line_protocol as lp
@@ -40,7 +41,7 @@ _INGEST_WORKERS = int(os.environ.get("OGT_INGEST_WORKERS", "0")) or (
 _INGEST_SEGMENT_BYTES = 1 << 20  # split target; bodies below 2MB stay inline
 _NEEDS_PYTHON_PARSER = object()  # _write_segmented: skip native re-parse
 _ingest_pool_obj = None
-_ingest_pool_lock = threading.Lock()
+_ingest_pool_lock = lockdep.Lock()
 
 
 def _ingest_pool():
@@ -225,7 +226,10 @@ class Engine:
         # literal tag bytes when off
         self.tag_arrays = tag_arrays
         os.makedirs(root, exist_ok=True)
-        self._lock = threading.RLock()
+        # hot class: every write/query path serializes through it, so a
+        # blocking call here stalls the whole engine (lockdep-enforced;
+        # threshold flushes already run outside it, PR 3)
+        self._lock = lockdep.mark_hot(lockdep.RLock(), "engine._lock")
         # syscontrol toggles (reference: lib/syscontrol disable write/read)
         self.write_disabled = False
         self.read_disabled = False
@@ -350,7 +354,13 @@ class Engine:
         if diskfault.armed():
             diskfault.check("write", self._meta_path(),
                             site="meta-save-write")
-        with open(tmp, "w", encoding="utf-8") as f:
+        # audited (lockdep): the meta fsync runs under the engine lock —
+        # DDL is rare control-plane work, and the lock is what keeps the
+        # in-memory mutation and its durable record atomic (a failed
+        # save raises INSIDE the op; tests/test_diskfault.py pins that).
+        # Unlike the PR 7 rollup-state fsync this is not a hot path.
+        with lockdep.allow_blocking("engine meta save under DDL lock"), \
+                open(tmp, "w", encoding="utf-8") as f:
             json.dump(j, f)
             f.flush()
             if diskfault.armed():
@@ -372,6 +382,7 @@ class Engine:
     def drop_database(self, name: str) -> None:
         import shutil
 
+        obs_purge = []
         with self._lock:
             if name not in self.databases:
                 return
@@ -379,7 +390,7 @@ class Engine:
                 shard = self._shards.pop(key)
                 shard.close()
                 _remove_shard_dir(shard.path)  # follows cold-tier symlinks
-            self._purge_obs(lambda k: k[0] == name)
+            obs_purge = self._purge_obs(lambda k: k[0] == name)
             del self.databases[name]
             self._save_meta()
             p = os.path.join(self.root, "data", name)
@@ -393,8 +404,10 @@ class Engine:
             else:
                 shutil.rmtree(os.path.join(self.root, "rollup", name),
                               ignore_errors=True)
+        self._delete_obs_prefixes(obs_purge)
 
     def drop_retention_policy(self, db: str, name: str) -> None:
+        obs_purge = []
         with self._lock:
             d = self.databases.get(db)
             if d and name in d.rps:
@@ -405,12 +418,14 @@ class Engine:
                     shard = self._shards.pop(key)
                     shard.close()
                     _remove_shard_dir(shard.path)
-                self._purge_obs(lambda k: k[0] == db and k[1] == name)
+                obs_purge = self._purge_obs(
+                    lambda k: k[0] == db and k[1] == name)
                 if d.default_rp == name:
                     d.default_rp = "autogen" if "autogen" in d.rps else next(
                         iter(d.rps), "autogen"
                     )
                 self._save_meta()
+        self._delete_obs_prefixes(obs_purge)
 
     def create_retention_policy(
         self, db: str, name: str, duration_ns: int, shard_duration_ns: int | None = None,
@@ -608,9 +623,13 @@ class Engine:
 
         with self._lock:
             stale = [k for k in self.obs_shards if k in self._shards]
-            for db, rp, start in stale:
-                store.delete_prefix(shard_prefix(db, rp, start))
-                self.obs_shards.discard((db, rp, start))
+        # bucket deletes are HTTP round trips: outside the engine lock
+        # (lockdep), like drop_expired_shards
+        for db, rp, start in stale:
+            store.delete_prefix(shard_prefix(db, rp, start))
+        with self._lock:
+            for k in stale:
+                self.obs_shards.discard(k)
             if stale:
                 self._save_meta()
 
@@ -624,25 +643,59 @@ class Engine:
             return False
         import shutil as _shutil
 
+        key = (db, rp, group_start)
         with self._lock:
-            key = (db, rp, group_start)
             shard = self._shards.get(key)
             if shard is None:
                 return False
-            # _flush_lock before _lock (shard lock-order rule): the flush
-            # below re-enters it, and a concurrent off-lock flush must
-            # not publish into a shard whose handles are being retired
-            with shard._flush_lock, shard._lock:
-                shard.flush()
-                prefix = shard_prefix(db, rp, group_start)
-                # follow a cold-tier symlink: files live at the target;
-                # recurse so the seriesidx/ mergeset dir travels too
-                real = os.path.realpath(shard.path)
-                for dirpath, _dirs, files in os.walk(real):
-                    for fname in sorted(files):
-                        full = os.path.join(dirpath, fname)
-                        rel = os.path.relpath(full, real)
-                        self.obs_store.put(f"{prefix}/{rel}", full)
+        # UPLOAD PHASE — network IO under the SHARD's flush lock only
+        # (lockdep caught the old shape: the whole upload ran under
+        # engine._lock, stalling every write/query in the process behind
+        # one shard's bucket transfer).  _flush_lock freezes the FILE
+        # SET — flush/compact/delete/downsample all take it first —
+        # while writes stay live; a write landing mid-upload bumps
+        # data_version and the swap below aborts, leaving the shard
+        # local (the obstier tick retries; attach reconcile prefers
+        # local over any orphaned bucket objects).
+        with shard._flush_lock:
+            shard.flush()
+            with shard._lock:
+                v0 = shard.data_version
+            if shard.mem_backlog_bytes() != 0:
+                return False  # raced a write mid-flush: not idle
+            prefix = shard_prefix(db, rp, group_start)
+            # clear the prefix FIRST: an earlier aborted/crashed upload
+            # (swap lost to a mid-upload write) left orphan objects
+            # here, and uploading a since-compacted file set OVER them
+            # would make a later hydration re-download retired files —
+            # resurrecting deleted rows.  The registry never points
+            # here until the swap below succeeds, so the delete races
+            # no reader.
+            self.obs_store.delete_prefix(prefix)
+            # follow a cold-tier symlink: files live at the target;
+            # recurse so the seriesidx/ mergeset dir travels too
+            real = os.path.realpath(shard.path)
+            for dirpath, _dirs, files in os.walk(real):
+                for fname in sorted(files):
+                    full = os.path.join(dirpath, fname)
+                    rel = os.path.relpath(full, real)
+                    self.obs_store.put(f"{prefix}/{rel}", full)
+        # SWAP PHASE — revalidate + retire under the engine lock (no
+        # shard lock held on entry: engine -> shard order preserved)
+        with self._lock:
+            if self._shards.get(key) is not shard:
+                return False  # dropped/replaced mid-upload
+            with shard._lock:
+                dirty = (shard.data_version != v0
+                         or shard.mem_backlog_bytes() != 0)
+            if dirty:
+                return False  # rows landed mid-upload: bucket copy is
+                # stale — keep serving local, next tick re-offloads
+            # audited (lockdep): retiring an idle fully-synced shard —
+            # the close fsyncs are cheap no-ops here and the engine
+            # lock is what makes the registry swap atomic
+            with lockdep.allow_blocking("cold-tier retire of idle shard"), \
+                    shard._flush_lock, shard._lock:
                 shard.wal.close()
                 shard.index.close()
                 # cold-tier offload retires the local files: release the
@@ -683,16 +736,26 @@ class Engine:
             sh = Shard(path, group_start, group_start + dur,
                        self.sync_wal, tag_arrays=self.tag_arrays)
             self._staging[mig_id] = [db, rp or d.default_rp, group_start, sh,
-                                     _time.time()]
+                                     _time.perf_counter()]
 
     def write_staging(self, mig_id: str, points: list) -> int:
         with self._lock:
             got = self._staging.get(mig_id)
             if got is None:
                 raise WriteError(f"unknown migration {mig_id!r}")
-            got[4] = _time.time()  # idle clock, NOT dir mtime: WAL
+            got[4] = _time.perf_counter()  # idle clock, NOT dir mtime: WAL
             # appends never touch the directory timestamp
-            return got[3].write_points_structured(points)
+            sh = got[3]
+            n, ticket = sh.write_points_structured(points,
+                                                   defer_commit=True)
+        # the sync-WAL fsync waits OUTSIDE the engine lock (the deferred-
+        # commit discipline of the main write paths, PR 3; caught here by
+        # lockdep) — migration staging ingest must not serialize the
+        # whole destination engine behind its disk.  A TTL expiry racing
+        # the released lock closes the staging WAL with _synced caught
+        # up, so commit() returns instantly rather than livelocking.
+        sh.wal.commit(ticket)
+        return n
 
     def commit_staging(self, mig_id: str) -> int:
         """Assign: fold the staged rows into the LIVE shard (LWW-idempotent
@@ -750,7 +813,10 @@ class Engine:
         path = self._committed_marker(mig_id)
         tmp = path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
-            f.write(json.dumps({"rows": rows, "ts": _time.time()}))
+            # wall-clock record: operator forensics metadata only (the
+            # TTL reaper ages markers by file mtime, never this field)
+            f.write(json.dumps(
+                {"rows": rows, "ts": _time.time()}))  # ogtlint: disable=OGT040
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -795,14 +861,18 @@ class Engine:
         root = self._staging_root()
         if not os.path.isdir(root):
             return 0
-        now = _t.time()
+        # two clocks: active registrations idle out on the in-process
+        # duration clock; orphan DIRS compare against file mtimes, which
+        # only the wall clock can be compared to
+        now_pc = _t.perf_counter()
+        now = _t.time()  # ogtlint: disable=OGT040
         dropped = 0
         with self._lock:
             # ACTIVE registrations expire on IDLE time (last write seen;
             # an in-progress stream keeps refreshing it, so a long
             # migration never self-destructs mid-flight)
             for name, entry in list(self._staging.items()):
-                if now - entry[4] >= ttl_s:
+                if now_pc - entry[4] >= ttl_s:
                     self._staging.pop(name, None)
                     self._discard_staging_dir(entry[3])
                     dropped += 1
@@ -848,22 +918,44 @@ class Engine:
             if shard is None:
                 return False
             shard.close()
-            self._purge_obs(lambda k: k == key)
+            obs_purge = self._purge_obs(lambda k: k == key)
             self._save_meta()
             _remove_shard_dir(shard.path)
-            return True
+        self._delete_obs_prefixes(obs_purge)
+        return True
 
-    def _purge_obs(self, match) -> None:
-        """Drop offloaded-group registry entries (and bucket copies) whose
-        key satisfies `match` — DROP DATABASE/RP must not let a recreated
-        namespace resurrect old offloaded data. Caller holds the lock and
-        saves meta."""
+    def _purge_obs(self, match) -> list[str]:
+        """Drop offloaded-group registry entries whose key satisfies
+        `match` — DROP DATABASE/RP must not let a recreated namespace
+        resurrect old offloaded data.  Caller holds the lock and saves
+        meta; the returned bucket prefixes must be fed to
+        _delete_obs_prefixes AFTER the lock is released (lockdep: the
+        deletes are HTTP round trips).  Registry-first ordering means a
+        crash mid-delete leaves unreferenced orphan objects (a leak the
+        operator can sweep), never a registry entry pointing at a
+        half-deleted group (which would fail every later hydration)."""
         from opengemini_tpu.storage.objstore import shard_prefix
 
+        purged = []
         for key in [k for k in self.obs_shards if match(k)]:
             if self.obs_store is not None:
-                self.obs_store.delete_prefix(shard_prefix(*key))
+                purged.append((key, shard_prefix(*key)))
             self.obs_shards.discard(key)
+        return purged
+
+    def _delete_obs_prefixes(self, purged: list[tuple]) -> None:
+        """Bucket-object deletes for _purge_obs — call with NO engine
+        lock held.  Each delete RE-CHECKS the registry first: between
+        the purge and this call the namespace may have been recreated
+        and a fresh offload registered the SAME deterministic prefix —
+        deleting it then would erase the only remaining copy of live
+        data (the local files are gone after a successful offload)."""
+        for key, prefix in purged:
+            with self._lock:
+                if key in self.obs_shards or key in self._shards:
+                    continue  # the prefix belongs to a live incarnation
+            if self.obs_store is not None:
+                self.obs_store.delete_prefix(prefix)
 
     def _download_group(self, db: str, rp: str, group_start: int) -> None:
         """Pull an offloaded group's files into its shard dir. NO engine
@@ -938,7 +1030,12 @@ class Engine:
             return None
         if (db, rp, group_start) in self._shards:
             return self._install_hydrated(db, rp, group_start)
-        self._download_group(db, rp, group_start)
+        # audited (lockdep): a backfill write into an aged-out cold
+        # group downloads it under the engine lock by documented design
+        # — rare, and routing is mid-flight; the QUERY path hydrates
+        # outside the lock (shards_for_range)
+        with lockdep.allow_blocking("write-path cold hydration"):
+            self._download_group(db, rp, group_start)
         return self._install_hydrated(db, rp, group_start)
 
     def shards_for_range(self, db: str, rp: str | None, tmin: int, tmax: int) -> list[Shard]:
@@ -1259,14 +1356,22 @@ class Engine:
         flush benignly (drop discarded the data on purpose) — re-raise
         only if the shard is still registered."""
         _fp("engine-before-threshold-flush")  # engine lock released
-        seen = set()
+        self._flush_tolerating_drop(
+            shards, lambda sh: sh.flush_if_over(self.flush_threshold_bytes))
+
+    def _flush_tolerating_drop(self, shards, flush_fn) -> None:
+        """Flush each distinct shard OFF the engine lock, swallowing a
+        failure ONLY when a concurrent DROP removed the shard mid-flush
+        (its data is gone by design) — a live shard's flush failure
+        re-raises.  Shared by the threshold path and flush_all."""
+        seen: set[int] = set()
         for shard in shards:
             if id(shard) in seen:
                 continue
             seen.add(id(shard))
             try:
-                shard.flush_if_over(self.flush_threshold_bytes)
-            except Exception:
+                flush_fn(shard)
+            except Exception:  # noqa: BLE001 — see docstring
                 with self._lock:
                     alive = any(s is shard for s in self._shards.values())
                 if alive:
@@ -1554,9 +1659,14 @@ class Engine:
                 self.rollup_mgr.write_done(rtok)
 
     def flush_all(self) -> None:
+        # snapshot under the lock, flush OUTSIDE it: shard.flush encodes
+        # + fsyncs, and holding the engine lock across that stalls every
+        # write path behind one shard's disk — the PR 3 threshold-flush
+        # stall class, caught on this explicit path by lockdep's
+        # blocking-under-hot-lock check
         with self._lock:
-            for shard in self._shards.values():
-                shard.flush()
+            shards = list(self._shards.values())
+        self._flush_tolerating_drop(shards, lambda sh: sh.flush())
 
     # -- durability ledger (PR 4) ------------------------------------------
 
@@ -1656,7 +1766,12 @@ class Engine:
                     _remove_shard_dir(shard.path)
                     del self._shards[key]
                     dropped.append(key)
-            # offloaded groups age out too (delete the store copy)
+            # offloaded groups age out too (delete the store copy) —
+            # only COLLECTED here; the bucket deletes are HTTP calls and
+            # run outside the engine lock below (lockdep: retention must
+            # not stall every write/query behind object-store round
+            # trips)
+            purged = []
             for key in sorted(self.obs_shards):
                 db, rp, start = key
                 d = self.databases.get(db)
@@ -1664,14 +1779,17 @@ class Engine:
                 if rp_meta is None or rp_meta.duration_ns == 0:
                     continue
                 if start + rp_meta.shard_duration_ns <= now_ns - rp_meta.duration_ns:
-                    if self.obs_store is not None:
-                        from opengemini_tpu.storage.objstore import shard_prefix
+                    from opengemini_tpu.storage.objstore import shard_prefix
 
-                        self.obs_store.delete_prefix(shard_prefix(db, rp, start))
                     self.obs_shards.discard(key)
                     dropped.append(key)
+                    if self.obs_store is not None:
+                        purged.append((key, shard_prefix(*key)))
             if dropped:
                 self._save_meta()
+        # registry-first, deletes off-lock with re-check — same ordering
+        # and race protection as _purge_obs/_delete_obs_prefixes
+        self._delete_obs_prefixes(purged)
         return dropped
 
     def close(self) -> None:
@@ -1689,12 +1807,17 @@ class Engine:
 
         _TRACKER.detach_durability_provider(self.durability_snapshot)
         with self._lock:
-            for shard in self._shards.values():
-                shard.close()
-            self._shards.clear()
-            for entry in self._staging.values():
-                entry[3].close()
-            self._staging.clear()
+            # audited (lockdep): shutdown fsyncs (each shard's final WAL
+            # flush) run under the engine lock deliberately — the lock
+            # is what makes close atomic against in-flight writes, and
+            # nothing productive contends with a closing engine
+            with lockdep.allow_blocking("engine.close shutdown fsyncs"):
+                for shard in self._shards.values():
+                    shard.close()
+                self._shards.clear()
+                for entry in self._staging.values():
+                    entry[3].close()
+                self._staging.clear()
 
 
 def _remove_shard_dir(path: str) -> None:

@@ -51,6 +51,7 @@ import base64
 import json
 import os
 import threading
+from opengemini_tpu.utils import lockdep
 import time as _time
 
 import numpy as np
@@ -131,14 +132,14 @@ class _State:
         # service tick racing a ctrl-flush must not interleave claim /
         # restore bookkeeping.  Ordering: m_lock OUTSIDE the manager
         # lock; write-path marks never take it.
-        self.m_lock = threading.Lock()
+        self.m_lock = lockdep.Lock()
         # save() runs OUTSIDE the manager-wide lock (an fsync under it
         # would stall every concurrent splice/note across all specs):
         # mutators bump `ver` under the manager lock and snapshot; the
         # io_lock-serialized writer skips snapshots an already-persisted
         # newer version supersedes (a newer snapshot always contains
         # every older mutation)
-        self.io_lock = threading.Lock()
+        self.io_lock = lockdep.Lock()
         self.ver = 0
         self._saved_ver = -1
         self.watermark_ns: int | None = None
@@ -226,7 +227,9 @@ class RollupManager:
 
     def __init__(self, engine):
         self.engine = engine
-        self._lock = threading.RLock()
+        # hot class: state fsyncs were moved OFF this lock in PR 7 (the
+        # late-write-mark stall) — lockdep keeps them off it
+        self._lock = lockdep.mark_hot(lockdep.RLock(), "rollup.manager_lock")
         self._states: dict[tuple[str, str], _State] = {}
         # read_enabled=False forces raw scans (bench A/B, fuzz oracle)
         # without touching maintenance

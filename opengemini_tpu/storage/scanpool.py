@@ -40,6 +40,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+from opengemini_tpu.utils import lockdep
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
@@ -61,7 +62,7 @@ INFLIGHT_BYTES = (int(os.environ.get("OGT_SCAN_INFLIGHT_MB", "0")) or 256) << 20
 MIN_POOL_JOBS = 4
 
 _pool: ThreadPoolExecutor | None = None
-_pool_lock = threading.Lock()
+_pool_lock = lockdep.Lock()
 # thread-local, NOT process-global: a bench/test A-B block must not
 # degrade concurrent queries on other server threads to serial decode
 _serial_local = threading.local()

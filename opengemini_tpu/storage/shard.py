@@ -11,6 +11,7 @@ from __future__ import annotations
 import os
 import itertools
 import threading
+from opengemini_tpu.utils import lockdep
 
 import numpy as np
 
@@ -251,14 +252,18 @@ class Shard:
         # memtable generations and is seeded from immutable files on open.
         self.schemas: dict[str, dict] = {}
         self.mem = MemTable(self.schemas)
-        self._lock = threading.RLock()
+        # hot class (lockdep): fsync/sleep/socket under it is a
+        # violation — the one audited exception is WAL.rotate's fsync,
+        # which this very lock fences (see storage/wal.py)
+        self._lock = lockdep.mark_hot(lockdep.RLock(), "shard._lock")
         # flush/rewrite serialization. Lock ORDER: _flush_lock before
         # _lock, always — flush holds _flush_lock across its off-lock
         # encode while taking _lock only to freeze and to publish;
         # anything that both holds _lock and (transitively) flushes
         # (delete/downsample rewrites, tier offload) must take
         # _flush_lock first or it deadlocks against an in-flight flush.
-        self._flush_lock = threading.RLock()
+        self._flush_lock = lockdep.name_class(
+            lockdep.RLock(), "shard._flush_lock")
         # snapshot-and-swap flush state: memtables frozen under the lock,
         # encoded + written OFF it. Each entry is (frozen memtable,
         # rotated WAL segment path | None); readers merge frozen
@@ -311,25 +316,33 @@ class Shard:
 
     # -- quarantine (media-fault containment) -------------------------------
 
-    def _quarantine_path(self, path: str, why: str) -> None:
-        """Record + durably mark one file quarantined (no reader swap —
-        open-time path, or the reader is already gone).  The `.quar`
-        marker keeps quarantine sticky across reopens; a crash between
-        detection and the marker just re-detects next open."""
+    def _write_quar_marker(self, path: str, why: str) -> None:
+        """Durable `.quar` marker write+fsync.  Lock-free by design
+        (lockdep: the fsync must not stall writers/readers behind
+        media-fault bookkeeping) and idempotent — concurrent detectors
+        just rewrite the same marker."""
         import json as _json
-        import logging
 
         _fp("quarantine-before-mark")  # detected, marker not yet durable
         marker = _quar_marker(path)
         tmp = marker + ".tmp"
         try:
             with open(tmp, "w", encoding="utf-8") as f:
-                _json.dump({"why": why, "ts": __import__("time").time()}, f)
+                # wall-clock record: operator forensics metadata only
+                _json.dump({"why": why,
+                            "ts": __import__("time").time()},  # ogtlint: disable=OGT040
+                           f)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, marker)
         except OSError:
             pass  # marker is sticky-convenience; in-memory state governs
+
+    def _record_quarantined(self, path: str, why: str) -> None:
+        """In-memory quarantine record + counters (marker already
+        durable — see _write_quar_marker)."""
+        import logging
+
         self._quarantined[path] = why
         _STATS.incr("quarantine", "tsf_files_total")
         logging.getLogger("opengemini_tpu.shard").error(
@@ -338,6 +351,14 @@ class Shard:
 
         _GOV.trigger_diagnostic(f"TSF file quarantined: {path}: {why}")
 
+    def _quarantine_path(self, path: str, why: str) -> None:
+        """Record + durably mark one file quarantined (no reader swap —
+        open-time path, or the reader is already gone).  The `.quar`
+        marker keeps quarantine sticky across reopens; a crash between
+        detection and the marker just re-detects next open."""
+        self._write_quar_marker(path, why)
+        self._record_quarantined(path, why)
+
     def quarantine_file(self, path: str, why: str) -> bool:
         """Runtime quarantine: pull a damaged file out of the read set.
         Returns True when THIS call quarantined it (False = already
@@ -345,12 +366,21 @@ class Shard:
         were mid-scan keep their reader refs (POSIX fds survive);
         every later scan snapshot simply excludes the file."""
         with self._lock:
+            if not any(r.path == path for r in self._files):
+                return False
+        # durable marker OFF the shard lock (lockdep: the fsync must not
+        # stall writers/readers behind media-fault bookkeeping); written
+        # before the swap so detection stays sticky even if we crash
+        # mid-quarantine, and idempotent under concurrent detectors
+        self._write_quar_marker(path, why)
+        with self._lock:
             idx = next((i for i, r in enumerate(self._files)
                         if r.path == path), None)
             if idx is None:
-                return False
+                return False  # lost the race: another detector (or a
+                # compaction retire) already pulled the file
             reader = self._files[idx]
-            self._quarantine_path(path, why)
+            self._record_quarantined(path, why)
             self._files = self._files[:idx] + self._files[idx + 1:]
             self._tidx_cache.pop(path, None)
             colcache.GLOBAL.invalidate_gens([reader.gen])
@@ -978,7 +1008,11 @@ class Shard:
         # order stays right, but _load_files sorts by name on reopen and
         # would rank the stale merge newer than the flush. Serializing
         # with the flush keeps seq order == publish order.
-        with self._flush_lock, self._lock:
+        # audited (lockdep): the merge writes + fsyncs under the shard
+        # lock — the seq-order rule above requires exclusivity; the
+        # off-lock compaction restructure is tracked roadmap work
+        with lockdep.allow_blocking("compact merge under shard lock"), \
+                self._flush_lock, self._lock:
             if len(self._files) <= max_files:
                 return False
             path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
@@ -1032,7 +1066,10 @@ class Shard:
         # _flush_lock first: in-place run merges allocate no new seq,
         # but serializing with the off-lock flush keeps every file-set
         # rewrite disjoint from a publish (see compact())
-        with self._flush_lock, self._lock:
+        # audited (lockdep): rewrite/fsync under the shard lock — the
+        # PR 3 seq-order rule requires exclusivity here (see compact())
+        with lockdep.allow_blocking("level-compact merge under shard lock"), \
+                self._flush_lock, self._lock:
             if len(self._files) < fanout:
                 return False
             levels = [self._file_level(r.path) for r in self._files]
@@ -1113,7 +1150,10 @@ class Shard:
         `max_files` per call; repeated calls converge to disjoint
         ranges."""
         # _flush_lock first: see compact()
-        with self._flush_lock, self._lock:
+        # audited (lockdep): rewrite/fsync under the shard lock — the
+        # PR 3 seq-order rule requires exclusivity here (see compact())
+        with lockdep.allow_blocking("out-of-order compact merge under shard lock"), \
+                self._flush_lock, self._lock:
             if len(self._files) < 2:
                 return False
             ranges = [(r.tmin, r.tmax) for r in self._files]
@@ -1150,7 +1190,10 @@ class Shard:
         # flush below re-enters it, and holding it for the whole rewrite
         # keeps a concurrent off-lock flush from publishing a pre-rewrite
         # snapshot AFTER the file-set swap resurrects dropped rows
-        with self._flush_lock, self._lock:
+        # audited (lockdep): rewrite/fsync under the shard lock — the
+        # PR 3 seq-order rule requires exclusivity here (see compact())
+        with lockdep.allow_blocking("downsample rewrite under shard lock"), \
+                self._flush_lock, self._lock:
             self.flush()
             path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
             w = TSFWriter(path, kind="downsample")
@@ -1202,7 +1245,10 @@ class Shard:
         (engine DropMeasurement / DeleteSeries). Flushes first so the
         memtable participates."""
         # _flush_lock first: see rewrite_downsampled
-        with self._flush_lock, self._lock:
+        # audited (lockdep): rewrite/fsync under the shard lock — the
+        # PR 3 seq-order rule requires exclusivity here (see compact())
+        with lockdep.allow_blocking("delete rewrite under shard lock"), \
+                self._flush_lock, self._lock:
             self.flush()
             if measurement not in self.measurements():
                 return
@@ -1626,7 +1672,10 @@ class Shard:
     def close(self) -> None:
         # _flush_lock first: an in-flight off-lock flush finishes (or we
         # get in line ahead of the next one) before handles close
-        with self._flush_lock, self._lock:
+        # audited (lockdep): the final WAL fsync runs under the shard
+        # lock — close must be atomic against in-flight writes
+        with lockdep.allow_blocking("shard.close shutdown fsyncs"), \
+                self._flush_lock, self._lock:
             self.wal.flush()
             self.wal.close()
             self.index.flush()

@@ -31,6 +31,7 @@ import json
 import os
 import struct
 import threading
+from opengemini_tpu.utils import lockdep
 import time
 import zlib
 from collections import OrderedDict
@@ -397,7 +398,7 @@ class TSFReader:
         # built from in-memory metadata, so no format change
         self._col_cache: OrderedDict = OrderedDict()
         self._cache_bytes = 0
-        self._cache_lock = threading.Lock()
+        self._cache_lock = lockdep.Lock()
         self._sid_bloom: dict[str, BloomFilter] = {}
         # per-(mst, sid) chunk lists: single-series lookups are O(own
         # chunks); without this a scan over S series costs S x all-chunks

@@ -50,6 +50,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+from opengemini_tpu.utils import lockdep
 import time
 
 from opengemini_tpu.utils.failpoint import inject as _fp
@@ -122,7 +123,7 @@ class WAL:
         # a completed fsync covers. _cond also fences rotate() against an
         # in-flight leader fsync (close/rotate must never swap the fd
         # under a leader).
-        self._cond = threading.Condition()
+        self._cond = lockdep.Condition()
         self._seq = 0
         self._synced = 0
         self._syncing = False
@@ -248,7 +249,11 @@ class WAL:
                 pass
             if diskfault.armed():
                 diskfault.on_fsync(self.path, site="wal-fsync")
-            os.fsync(self._f.fileno())
+            # audited: rotate runs under the SHARD lock by design — that
+            # lock is what fences concurrent appends, and the fsync must
+            # cover every framed entry before the rename
+            with lockdep.allow_blocking("wal-rotate fsync fenced by shard lock"):
+                os.fsync(self._f.fileno())
             self._f.close()
             _fp("wal-rotate-before-rename")  # fsynced, still the live log
             os.replace(self.path, seg_path)

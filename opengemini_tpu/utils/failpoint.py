@@ -47,9 +47,10 @@ from __future__ import annotations
 
 import os
 import threading
+from opengemini_tpu.utils import lockdep
 import time
 
-_lock = threading.Lock()
+_lock = lockdep.Lock()
 _active: dict[str, object] = {}
 _hits: dict[str, int] = {}
 _events: dict[str, threading.Event] = {}
@@ -161,7 +162,7 @@ def _barrier_wait(site: str, parties: int) -> None:
     with _lock:
         st = _barriers.get(site)
         if st is None:
-            st = _barriers[site] = [0, threading.Condition(_lock), False]
+            st = _barriers[site] = [0, lockdep.Condition(_lock), False]
         st[0] += 1
         cond = st[1]
         if st[0] % parties == 0:
@@ -208,7 +209,10 @@ def inject(name: str) -> None:
         os._exit(13)
     if isinstance(action, str):
         if action.startswith("sleep:"):
-            time.sleep(float(action.split(":", 1)[1]))
+            # audited blocking: a sleep: action exists to WIDEN race
+            # windows, deliberately also under hot locks
+            with lockdep.allow_blocking("failpoint sleep action"):
+                time.sleep(float(action.split(":", 1)[1]))
             return
         if action.startswith("wait:"):
             ev = _event(action.split(":", 1)[1])

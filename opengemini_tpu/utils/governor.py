@@ -72,6 +72,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+from opengemini_tpu.utils import lockdep
 import time
 from collections import deque
 
@@ -161,8 +162,8 @@ class _BgToken:
 
 class ResourceGovernor:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = lockdep.Lock()
+        self._cond = lockdep.Condition(self._lock)
         self._local = threading.local()
         # -- config (runtime-tunable via configure()) --
         self._budget = _env_int("OGT_MEM_BUDGET_MB", 0) << 20
@@ -770,7 +771,7 @@ class InflightGauge:
     __slots__ = ("_lock", "_total")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock()
         self._total = 0
 
     def note(self, delta: int) -> None:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import re
 import threading
+from opengemini_tpu.utils import lockdep
 import time
 
 # redact password literals before storing query text (the reference
@@ -32,7 +33,7 @@ class QueryKilled(Exception):
 
 class QueryTracker:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock()
         self._next = 1
         self._running: dict[int, dict] = {}
         self._killed: set[int] = set()

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import threading
+from opengemini_tpu.utils import lockdep
 import time
 from collections import deque
 
@@ -32,7 +33,7 @@ def _env_float(name: str):
 
 class SlowLog:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock()
         self.threshold_ms = _env_float("OGT_SLOW_QUERY_MS")  # None = off
         try:
             self.max_records = max(

@@ -15,13 +15,14 @@ from __future__ import annotations
 import os
 import re
 import threading
+from opengemini_tpu.utils import lockdep
 import time
 from collections import defaultdict
 
 
 class Statistics:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock()
         self._counters: dict[str, dict[str, int]] = defaultdict(lambda: defaultdict(int))
         # computed gauge sections: module -> [fn() -> {name: int}].
         # Providers are evaluated at snapshot time (live state — e.g. the
@@ -29,7 +30,9 @@ class Statistics:
         # and their values must be ints: the monitor service pushes every
         # snapshot field into `_internal` as INT points.
         self._providers: dict[str, list] = defaultdict(list)
-        self.started_at = time.time()
+        # uptime is a DURATION: perf_counter, not wall clock (an NTP
+        # step mid-run would bend every scraped ogt_uptime_seconds)
+        self.started_pc = time.perf_counter()
 
     def incr(self, module: str, name: str, delta: int = 1) -> None:
         with self._lock:
@@ -109,6 +112,11 @@ def _governor_gauges() -> dict:
 # enabled (OGT_MEM_BUDGET_MB set); the provider answers {} pass-through
 GLOBAL.register_provider("governor", _governor_gauges)
 
+# lock-order validator findings (OGT_LOCKDEP=1 only): the torture
+# harnesses assert violations == 0 on live nodes via /debug/vars
+if lockdep.enabled():
+    GLOBAL.register_provider("lockdep", lockdep.stats_snapshot)
+
 
 # -- latency histograms ------------------------------------------------------
 # Fixed log2 buckets over nanoseconds: bounds 2^10 ns (~1µs) .. 2^35 ns
@@ -148,7 +156,7 @@ class Histogram:
     def __init__(self, name: str, labels: tuple = ()):
         self.name = name
         self.labels = labels  # sorted ((k, v), ...) — family identity
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock()
         self.counts = [0] * (_NBOUNDS + 1)  # [+Inf] last
         self.count = 0
         self.sum_ns = 0
@@ -209,7 +217,7 @@ def snapshot_percentile_s(hsnap: dict, q: float) -> float:
     return _BOUNDS_S[-1] * 2
 
 
-_HIST_LOCK = threading.Lock()
+_HIST_LOCK = lockdep.Lock()
 _HISTOGRAMS: dict[tuple, Histogram] = {}
 
 
@@ -298,7 +306,8 @@ def render_prometheus(version: str = "") -> str:
     lines.append("# HELP ogt_uptime_seconds process uptime")
     lines.append("# TYPE ogt_uptime_seconds gauge")
     lines.append(
-        f"ogt_uptime_seconds {_fmt_val(time.time() - GLOBAL.started_at)}")
+        f"ogt_uptime_seconds "
+        f"{_fmt_val(time.perf_counter() - GLOBAL.started_pc)}")
 
     # counters + provider gauges, one family per (module, key).  Two
     # distinct registry keys can sanitize to one family name (e.g.

@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 import random
 import threading
+from opengemini_tpu.utils import lockdep
 import time
 from contextlib import contextmanager
 
@@ -36,7 +37,7 @@ _TRACE_ON = os.environ.get("OGT_TRACE", "") in ("1", "true")
 # finished traces kept for /debug/trace?qid= (bounded; newest wins)
 _RECENT_MAX = 256
 _RECENT: dict[object, dict] = {}
-_RECENT_LOCK = threading.Lock()
+_RECENT_LOCK = lockdep.Lock()
 
 _ACTIVE = threading.local()
 

@@ -32,6 +32,18 @@ def rng():
     return np.random.default_rng(42)
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _lockdep_session_gate():
+    """OGT_LOCKDEP=1 turns the whole suite into a deadlock regression
+    test: any lock-order cycle or non-annotated blocking-under-hot-lock
+    witnessed by ANY test fails the session at teardown."""
+    yield
+    from opengemini_tpu.utils import lockdep
+
+    if lockdep.enabled():
+        lockdep.check()  # raises LockdepError with every report
+
+
 @pytest.fixture
 def encode_pool_on(monkeypatch):
     """Force the encode pool (storage/encodepool.py) live even on

@@ -221,14 +221,14 @@ class TestTwoPhaseMigration:
         stage_dir = tmp_path / "nB" / "staging" / "mig-dead-1"
         assert stage_dir.exists()
         assert eB.expire_staging(ttl_s=900) == 0  # fresh: not expired
-        eB._staging["mig-dead-1"][4] = time.time() - 3600
+        eB._staging["mig-dead-1"][4] = time.perf_counter() - 3600
         assert eB.expire_staging(ttl_s=900) == 1
         assert not stage_dir.exists()
         # orphan dir from a pre-restart migration expires by content age
         orphan = tmp_path / "nB" / "staging" / "mig-orphan"
         orphan.mkdir(parents=True)
         (orphan / "wal.log").write_bytes(b"x")
-        old = time.time() - 3600
+        old = time.time() - 3600  # wall clock: compared against file mtime
         os.utime(orphan / "wal.log", (old, old))
         os.utime(orphan, (old, old))
         assert eB.expire_staging(ttl_s=900) == 1
@@ -365,7 +365,7 @@ class TestMigrationPartialFailure:
 
         mark = e._committed_marker("mig-idem-1")
         assert os.path.exists(mark)
-        old = time.time() - 3600
+        old = time.time() - 3600  # wall clock: compared against file mtime
         os.utime(mark, (old, old))
         e.expire_staging(ttl_s=900)
         assert not os.path.exists(mark)
@@ -463,7 +463,7 @@ class TestMigrationPartialFailure:
 
         orphan = tmp_path / "d" / "staging" / "mig-crash-1"
         assert orphan.exists()
-        old = time.time() - 3600
+        old = time.time() - 3600  # wall clock: compared against file mtime
         for f in orphan.iterdir():
             os.utime(f, (old, old))
         os.utime(orphan, (old, old))
@@ -562,7 +562,7 @@ class TestMigrationPartialFailure:
                for i in range(4)]
         e.begin_staging("db", None, 0, "mig-ttl-1")
         e.write_staging("mig-ttl-1", pts[:2])
-        e._staging["mig-ttl-1"][4] = time.time() - 3600  # stalled pusher
+        e._staging["mig-ttl-1"][4] = time.perf_counter() - 3600  # stalled pusher
         assert e.expire_staging(ttl_s=900) == 1
         with pytest.raises(WriteError, match="unknown migration"):
             e.write_staging("mig-ttl-1", pts[2:])

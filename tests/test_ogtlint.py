@@ -1,0 +1,302 @@
+"""ogtlint (tools/ogtlint.py, ISSUE 10): the tier-1 zero-findings gate
+over the real tree, plus fixture trees exercising every rule, the
+suppression comments, and the baseline round-trip.
+
+The tree gate subsumes the PR 6/PR 9 live-grep catalog tests (failpoint
+KILL_SITES, cluster KILL_SITES, DISKFAULT_SITES) via rule OGT011 — a
+missing catalog row still names the undocumented site in the failure.
+"""
+
+import json
+import os
+
+from tools import ogtlint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- the gate -----------------------------------------------------------------
+
+
+def test_tree_has_zero_nonbaselined_findings():
+    """Every invariant the linter encodes holds over the live tree;
+    grandfathered findings live ONLY in the committed baseline."""
+    findings = ogtlint.collect_findings(ROOT)
+    baseline = ogtlint.load_baseline(
+        os.path.join(ROOT, ogtlint.BASELINE_DEFAULT))
+    fresh = ogtlint.apply_baseline(findings, baseline)
+    assert not fresh, (
+        "ogtlint findings (fix them, suppress with a per-line rationale, "
+        "or — only after review — add to tools/ogtlint_baseline.json):\n"
+        + "\n".join(f.render() for f in fresh))
+
+
+def test_baseline_file_is_committed_and_loadable():
+    path = os.path.join(ROOT, ogtlint.BASELINE_DEFAULT)
+    assert os.path.exists(path), "tools/ogtlint_baseline.json must be committed"
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert "entries" in doc
+
+
+# -- fixture helpers ----------------------------------------------------------
+
+
+def _tree(tmp_path, files: dict) -> str:
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(body, encoding="utf-8")
+    return str(tmp_path)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# -- OGT010: knob documentation ----------------------------------------------
+
+
+def test_ogt010_env_reads_must_be_documented(tmp_path):
+    root = _tree(tmp_path, {
+        "README.md": "Knobs: `OGT_DOCUMENTED`, `OGT_WILD_*` table.\n",
+        "opengemini_tpu/mod.py": (
+            "import os\n"
+            "a = os.environ.get('OGT_DOCUMENTED', '')\n"
+            "b = os.environ.get('OGT_WILD_EXTRA', '')\n"     # wildcard ok
+            "c = os.environ.get('OGT_MISSING', '')\n"        # finding
+            "d = os.environ['OGT_SUBSCRIPT']\n"              # finding
+            "e = os.getenv('OGT_GETENV')\n"                  # finding
+            "f = os.environ.get('OTHER_NAME', '')\n"         # not ours
+            "g = os.environ.get('OGT_HUSH', '')  # ogtlint: disable=OGT010\n"
+            "h = _env_int('OGT_HELPER', 0)\n"                # finding:
+            # knobs read through the repo's env-helper wrappers count
+            "i = governor._env_float('OGT_DOCUMENTED', 1.0)\n"  # doc'd: ok
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT010")
+    assert sorted(f.detail for f in found) == [
+        "OGT_GETENV", "OGT_HELPER", "OGT_MISSING", "OGT_SUBSCRIPT"]
+    assert all("missing from the README" in f.msg for f in found)
+
+
+# -- OGT011: torture catalogs -------------------------------------------------
+
+
+def test_ogt011_catalogs_agree_both_ways(tmp_path):
+    root = _tree(tmp_path, {
+        "tools/torture.py": (
+            "KILL_SITES = ['site-armed', 'site-gone']\n"
+            "DISKFAULT_SITES = ['df-ok', 'df-gone']\n"
+        ),
+        "tools/cluster_torture.py": "KILL_SITES = ['c-armed']\n",
+        "opengemini_tpu/storage/x.py": (
+            "def _fp(n):\n    pass\n"
+            "def io(site):\n    pass\n"
+            "_fp('site-armed')\n"
+            "_fp('c-armed')\n"
+            "_fp('site-new')\n"          # armed, not catalogued
+            "_fp('governor-admit')\n"    # NOT_ON_CHAIN exemption
+            "io(site='df-ok')\n"
+            "io(site='df-new')\n"        # consulted, not catalogued
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT011")
+    details = sorted(f.detail for f in found)
+    assert details == ["df-gone", "df-new", "site-gone", "site-new"]
+    msgs = {f.detail: f.msg for f in found}
+    # the PR 6/PR 9 failure messages survive the consolidation: a
+    # missing catalog row still names the undocumented site
+    assert "torture sites not armed anywhere" in msgs["site-gone"]
+    assert "missing from the torture kill rotation" in msgs["site-new"]
+    assert "missing from code" in msgs["df-gone"]
+    assert "missing from catalog" in msgs["df-new"]
+    # findings for in-code sites point at the arming line
+    site_new = [f for f in found if f.detail == "site-new"][0]
+    assert site_new.path == "opengemini_tpu/storage/x.py"
+    assert site_new.line == 7  # the `_fp('site-new')` arming line
+
+
+def test_ogt011_moot_without_catalogs(tmp_path):
+    root = _tree(tmp_path, {
+        "opengemini_tpu/x.py": "def _fp(n): pass\n_fp('anything')\n"})
+    assert _by_rule(ogtlint.collect_findings(root), "OGT011") == []
+
+
+# -- OGT020: drain-before-reply ----------------------------------------------
+
+
+def test_ogt020_direct_response_outside_send(tmp_path):
+    root = _tree(tmp_path, {
+        "opengemini_tpu/server/http.py": (
+            "class H:\n"
+            "    def _send(self, code):\n"
+            "        self.send_response(code)\n"         # the drain home
+            "    def ok_handler(self):\n"
+            "        self._send(200)\n"
+            "    def bad_handler(self):\n"
+            "        self.send_response(200)\n"          # finding
+            "    def audited_handler(self):\n"
+            "        self.send_response(200)  # ogtlint: disable=OGT020\n"
+        ),
+        "opengemini_tpu/server/other.py": (
+            "class X:\n"
+            "    def h(self):\n"
+            "        self.send_response(200)\n"          # http.py only
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT020")
+    assert [(f.detail, f.line) for f in found] == [("bad_handler", 7)]
+    assert "body drain" in found[0].msg
+
+
+# -- OGT030: exception hygiene ------------------------------------------------
+
+
+def test_ogt030_bare_and_swallowed_excepts(tmp_path):
+    root = _tree(tmp_path, {
+        "opengemini_tpu/query/q.py": (
+            "try:\n    pass\nexcept:\n    pass\n"        # bare: anywhere
+            "try:\n    pass\nexcept Exception:\n    pass\n"  # non-durability
+        ),
+        "opengemini_tpu/storage/s.py": (
+            "try:\n    pass\nexcept Exception:\n    pass\n"      # finding
+            "try:\n    pass\nexcept BaseException:\n    continue\n"
+            "try:\n    pass\nexcept Exception:\n    handle()\n"  # handled: ok
+            "try:\n    pass\nexcept OSError:\n    pass\n"        # narrow: ok
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT030")
+    got = sorted((f.path, f.detail) for f in found)
+    assert got == [
+        ("opengemini_tpu/query/q.py", "bare-except"),
+        ("opengemini_tpu/storage/s.py", "swallow"),
+        ("opengemini_tpu/storage/s.py", "swallow"),
+    ], got
+
+
+# -- OGT031: lockdep adoption -------------------------------------------------
+
+
+def test_ogt031_raw_lock_construction(tmp_path):
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": (
+            "import threading\n"
+            "import threading as _threading\n"
+            "from opengemini_tpu.utils import lockdep\n"
+            "a = threading.Lock()\n"                     # finding
+            "b = _threading.RLock()\n"                   # finding
+            "c = threading.Condition(a)\n"               # finding
+            "d = lockdep.Lock()\n"                       # adopted: ok
+            "e = threading.Event()\n"                    # not a lock
+        ),
+        "opengemini_tpu/utils/lockdep.py": (
+            "import threading\n"
+            "inner = threading.Lock()\n"                 # home: exempt
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT031")
+    assert sorted(f.detail for f in found) == [
+        "threading.Condition", "threading.Lock", "threading.RLock"]
+    assert all(f.path == "opengemini_tpu/mod.py" for f in found)
+
+
+# -- OGT040: duration clock ---------------------------------------------------
+
+
+def test_ogt040_time_time(tmp_path):
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": (
+            "import time\nimport time as _time\n"
+            "t0 = time.time()\n"                         # finding
+            "t1 = _time.time()\n"                        # finding
+            "ts = time.time()  # ogtlint: disable=OGT040 (wall clock)\n"
+            "ok = time.perf_counter()\n"
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT040")
+    assert [f.line for f in found] == [3, 4]
+
+
+# -- OGT050: metric-name grammar ---------------------------------------------
+
+
+def test_ogt050_metric_name_grammar(tmp_path):
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": (
+            "GLOBAL.incr('wal', 'fsyncs_total')\n"       # ok
+            "_STATS.incr('bad-mod', 'k')\n"              # finding
+            "GLOBAL.set('mod', 'Bad_Key', 3)\n"          # finding
+            "GLOBAL.incr(dynamic, 'k')\n"                # non-literal: skip
+            "histogram('query_stage_seconds')\n"         # ok
+            "histogram('bad-family')\n"                  # finding
+            "observe_ns('http_request_seconds', 5)\n"    # ok
+            "ev.set()\n"                                 # not stats
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT050")
+    assert sorted(f.detail for f in found) == [
+        "bad-family", "bad-mod.k", "mod.Bad_Key"]
+
+
+# -- baseline + output formats ------------------------------------------------
+
+
+def test_baseline_round_trip_and_new_occurrence(tmp_path):
+    files = {
+        "opengemini_tpu/mod.py": "import time\nt = time.time()\n",
+    }
+    root = _tree(tmp_path, files)
+    findings = ogtlint.collect_findings(root)
+    assert _rules(findings) == ["OGT040"]
+
+    bl_path = os.path.join(root, "baseline.json")
+    ogtlint.write_baseline(bl_path, findings)
+    loaded = ogtlint.load_baseline(bl_path)
+    # round-trip: everything baselined -> zero fresh findings
+    assert ogtlint.apply_baseline(findings, loaded) == []
+
+    # a NEW occurrence of the same (rule, path, detail) exceeds the
+    # grandfathered count and is reported
+    (tmp_path / "opengemini_tpu" / "mod.py").write_text(
+        "import time\nt = time.time()\nu = time.time()\n",
+        encoding="utf-8")
+    fresh = ogtlint.apply_baseline(
+        ogtlint.collect_findings(root), loaded)
+    assert len(fresh) == 1 and fresh[0].rule == "OGT040"
+
+
+def test_render_formats(tmp_path):
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": "import time\nt = time.time()\n"})
+    findings = ogtlint.collect_findings(root)
+    gh = ogtlint.render(findings, "github")
+    assert gh.startswith("::error file=opengemini_tpu/mod.py,line=2,")
+    doc = json.loads(ogtlint.render(findings, "json"))
+    assert doc[0]["rule"] == "OGT040" and doc[0]["line"] == 2
+    text = ogtlint.render(findings, "text")
+    assert text.startswith("opengemini_tpu/mod.py:2: OGT040")
+
+
+def test_cli_exit_codes(tmp_path):
+    dirty = _tree(tmp_path / "dirty", {
+        "opengemini_tpu/mod.py": "import time\nt = time.time()\n"})
+    assert ogtlint.main(["--root", dirty, "--no-baseline"]) == 1
+    clean = _tree(tmp_path / "clean", {
+        "opengemini_tpu/mod.py": "x = 1\n"})
+    assert ogtlint.main(["--root", clean, "--no-baseline"]) == 0
+    # --fix-baseline writes, then the default run is clean
+    assert ogtlint.main(["--root", dirty]) == 1
+    assert ogtlint.main(["--root", dirty, "--fix-baseline"]) == 0
+    assert ogtlint.main(["--root", dirty]) == 0
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": "def broken(:\n"})
+    found = ogtlint.collect_findings(root)
+    assert [f.rule for f in found] == ["SYNTAX"]

@@ -70,92 +70,11 @@ def test_torture_full_randomized_sweep():
     assert out["summary"]["rounds"] == 100
 
 
-def test_kill_site_catalog_matches_armed_sites():
-    """The harness's kill-site catalog and the armed `_fp(...)` sites in
-    the code must agree BOTH ways: a renamed site would silently stop
-    being tortured, and a newly armed site must enter the kill rotation
-    (and the README catalog) rather than silently escaping coverage."""
-    import re
-
-    from tools.cluster_torture import KILL_SITES as CLUSTER_KILL_SITES
-    from tools.torture import KILL_SITES
-
-    pkg = os.path.join(ROOT, "opengemini_tpu")
-    armed = set()
-    for dirpath, _dirs, files in os.walk(pkg):
-        for f in files:
-            if not f.endswith(".py"):
-                continue
-            with open(os.path.join(dirpath, f), encoding="utf-8") as fh:
-                armed.update(re.findall(r'_fp\("([^"]+)"\)', fh.read()))
-    # two kill rotations share one catalog: the single-node durability
-    # chain (tools/torture.py) and the cluster tier's decision edges
-    # (tools/cluster_torture.py) — both must stay armed in the code
-    catalog = set(KILL_SITES) | set(CLUSTER_KILL_SITES)
-    missing = catalog - armed
-    assert not missing, f"torture sites not armed anywhere: {missing}"
-    # object-store fault sites simulate REMOTE failures (torn/missing
-    # bucket objects), not local crash points — the cold tier has its
-    # own tests (test_objstore_remote) and the torture child runs no
-    # object store, so a kill armed there would never fire
-    not_on_chain = {"objstore-get-torn", "objstore-get-missing",
-                    "objstore-put-torn"}
-    # resource-governor decision edges (utils/governor.py): admission/
-    # shed/backpressure control flow, not durability lock handoffs — the
-    # torture child runs ungoverned (OGT_MEM_BUDGET_MB unset), so a kill
-    # armed there would never fire; their schedule control is exercised
-    # by tests/test_governor.py instead
-    not_on_chain |= {"governor-admit", "governor-queue", "governor-shed",
-                     "governor-overdraft-kill", "governor-backpressure-on",
-                     "governor-backpressure-off"}
-    # materialized-rollup maintenance edges (storage/rollup.py): the
-    # torture child declares no rollup specs, so a kill armed there
-    # would never fire; their crash semantics (durable watermark,
-    # write-ahead dirty marks, idempotent re-folds) are driven
-    # deterministically by tests/test_rollup.py::TestCrashDurability
-    not_on_chain |= {"rollup-mark-dirty", "rollup-fold-before-write",
-                     "rollup-fold-after-write", "rollup-before-state-save"}
-    # observability span-ship edge (PR 8): fires on the replica between
-    # computing a response and embedding its trace subtree — a pure
-    # read-path observability site with no durability state to torture;
-    # its crash semantics (trace loss, never data loss) are covered by
-    # tests/test_observability.py
-    not_on_chain |= {"obs-before-span-ship"}
-    # media-fault quarantine edge (ISSUE 9): fires between corruption
-    # detection and the durable `.quar` marker — a crash there simply
-    # re-detects on the next open (idempotent), and the torture child
-    # never holds corrupt files, so a kill armed there would never
-    # fire; driven deterministically by tests/test_diskfault.py
-    not_on_chain |= {"quarantine-before-mark"}
-    untortured = armed - catalog - not_on_chain
-    assert not untortured, (
-        f"armed sites missing from the torture kill rotation: {untortured}")
-
-
-def test_diskfault_site_catalog_matches_consult_points():
-    """The diskfault consult points (`site="..."` labels in
-    storage/*.py) and the DISKFAULT_SITES catalog (tools/torture.py +
-    README) must agree both ways, like the failpoint catalog above: a
-    renamed site silently leaves the scribble/diskfault coverage, and a
-    new IO chokepoint must be catalogued."""
-    import re
-
-    from tools.torture import DISKFAULT_SITES
-
-    pkg = os.path.join(ROOT, "opengemini_tpu")
-    consulted = set()
-    for dirpath, _dirs, files in os.walk(pkg):
-        for f in files:
-            if not f.endswith(".py"):
-                continue
-            with open(os.path.join(dirpath, f), encoding="utf-8") as fh:
-                consulted.update(
-                    re.findall(r'site="([a-z0-9-]+)"', fh.read()))
-    catalog = set(DISKFAULT_SITES)
-    assert catalog == consulted, (
-        f"diskfault site catalog out of sync: "
-        f"missing from code {catalog - consulted}, "
-        f"missing from catalog {consulted - catalog}")
+# The PR 6/PR 9 live-grep catalog tests (failpoint KILL_SITES, cluster
+# KILL_SITES, DISKFAULT_SITES vs the armed/consulted sites in the code)
+# moved into ogtlint rule OGT011 (tools/ogtlint.py, enforced tier-1 by
+# tests/test_ogtlint.py) — same bidirectional checks, same failure
+# messages, one analysis pass instead of three ad-hoc greps.
 
 
 # -- online ledger + debug exposure ------------------------------------------

@@ -302,8 +302,8 @@ class Cluster:
             node.terminate()
 
     def leader(self, timeout: float = 30.0) -> Node:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.perf_counter() + timeout
+        while time.perf_counter() < deadline:
             for node in self.nodes:
                 if not node.alive():
                     continue
@@ -320,7 +320,7 @@ class Cluster:
     def wait_ready(self, timeout: float = 90.0) -> None:
         """Every node serving, every data node registered + healthy in
         the quorum view, the database replicated everywhere."""
-        deadline = time.time() + timeout
+        deadline = time.perf_counter() + timeout
         for node in self.nodes:
             while True:
                 try:
@@ -330,7 +330,7 @@ class Cluster:
                             break
                 except OSError:
                     pass
-                if time.time() > deadline:
+                if time.perf_counter() > deadline:
                     raise TimeoutError(f"{node.nid} never served /ping")
                 time.sleep(0.2)
         want = {node.nid for node in self.nodes}
@@ -342,7 +342,7 @@ class Cluster:
                     break
             except (OSError, ValueError):
                 pass
-            if time.time() > deadline:
+            if time.perf_counter() > deadline:
                 raise TimeoutError(f"cluster never converged: {want}")
             time.sleep(0.3)
         # replicated DDL goes through the meta leader
@@ -354,7 +354,7 @@ class Cluster:
                     break
             except (OSError, ValueError, KeyError, TimeoutError):
                 pass
-            if time.time() > deadline:
+            if time.perf_counter() > deadline:
                 raise TimeoutError("CREATE DATABASE never committed")
             time.sleep(0.3)
         for node in self.nodes:
@@ -367,7 +367,7 @@ class Cluster:
                         break
                 except (OSError, ValueError, KeyError):
                     pass
-                if time.time() > deadline:
+                if time.perf_counter() > deadline:
                     raise TimeoutError(f"{node.nid} never saw {DB}")
                 time.sleep(0.2)
 
@@ -420,9 +420,9 @@ class Cluster:
         rounds all report zero work — twice in a row (one quiet sweep
         can race a round that was already in flight)."""
         problems: list[str] = []
-        deadline = time.time() + timeout
+        deadline = time.perf_counter() + timeout
         quiet_sweeps = 0
-        while time.time() < deadline:
+        while time.perf_counter() < deadline:
             busy = []
             for node in self.nodes:
                 if not node.alive():
@@ -518,7 +518,7 @@ def _read_all_rows(node: Node, deadline: float) -> dict[str, list]:
     retries while the just-healed cluster still answers with a
     transient fan-out error."""
     last = ""
-    while time.time() < deadline:
+    while time.perf_counter() < deadline:
         try:
             res = node.query(f"SELECT v FROM {MST} GROUP BY client")[
                 "results"][0]
@@ -544,7 +544,7 @@ def verify(cluster: Cluster, acked: list[dict],
     with exact values from EVERY coordinator; ledgers clean; no staging
     left anywhere."""
     problems: list[str] = []
-    deadline = time.time() + timeout
+    deadline = time.perf_counter() + timeout
     for node in cluster.nodes:
         try:
             rows = _read_all_rows(node, deadline)
@@ -591,6 +591,20 @@ def verify(cluster: Cluster, acked: list[dict],
             continue
         if st.get("staging"):
             problems.append(f"{node.nid}: staging left: {st['staging']}")
+        if os.environ.get("OGT_LOCKDEP", "") not in ("", "0"):
+            # nodes inherit OGT_LOCKDEP (env passthrough at spawn): the
+            # lock-order validator's findings surface in /debug/vars —
+            # a cycle or blocking-under-hot-lock on any LIVE node is a
+            # harness violation like a lost row
+            try:
+                lv = node.get("/debug/vars").get("lockdep", {})
+            except (OSError, ValueError) as e:
+                problems.append(f"{node.nid}: lockdep check failed: {e}")
+                continue
+            if lv.get("violations"):
+                problems.append(
+                    f"{node.nid}: lockdep violations={lv['violations']} "
+                    "(reports on the node's stderr/console log)")
     return problems
 
 
@@ -873,7 +887,7 @@ def main(argv=None) -> int:
     rng = random.Random(args.seed)
     workdir = tempfile.mkdtemp(prefix="ogt-cluster-torture-")
     cluster = Cluster(workdir, n=args.nodes, rf=args.rf)
-    t0 = time.time()
+    t0 = time.perf_counter()
     try:
         cluster.spawn_all()
         cluster.wait_ready()
@@ -895,7 +909,7 @@ def main(argv=None) -> int:
         "acked_batches": sum(r.get("acked_batches", 0) for r in results),
         "acked_rows": sum(rec["n"] for rec in all_acked),
         "violations": len(bad),
-        "elapsed_s": round(time.time() - t0, 1),
+        "elapsed_s": round(time.perf_counter() - t0, 1),
     }
     print(json.dumps({"summary": summary, "violations": bad}, indent=2,
                      default=str))

@@ -31,8 +31,18 @@ from __future__ import annotations
 import argparse
 import http.client
 import json
+import os as _os
+import sys as _sys
 import threading
 import time
+
+# runnable standalone (`python tools/loadgen.py`): the package lives at
+# the repo root, one directory up
+_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _ROOT not in _sys.path:
+    _sys.path.insert(0, _ROOT)
+
+from opengemini_tpu.utils import lockdep  # noqa: E402 (needs _ROOT)
 
 
 def percentile(sorted_vals: list[float], q: float) -> float:
@@ -105,7 +115,7 @@ class _AckLog:
 
         self._f = open(path, "a", encoding="utf-8")
         self._os = os
-        self._lock = threading.Lock()
+        self._lock = lockdep.Lock()
         self._closed = False
 
     def record(self, rec: dict) -> None:

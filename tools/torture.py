@@ -53,6 +53,8 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
+from opengemini_tpu.utils import lockdep  # noqa: E402 (needs _ROOT)
+
 NS = 1_000_000_000
 BASE = 1_700_000_000
 MST = "t"
@@ -165,7 +167,7 @@ def run_child(args) -> int:
     stop = threading.Event()
     errors: list = []
     ack = open(args.ack_log, "a", encoding="utf-8")
-    ack_lock = threading.Lock()
+    ack_lock = lockdep.Lock()
 
     def writer(wid: int):
         try:
@@ -216,6 +218,12 @@ def run_child(args) -> int:
         print(f"CHILD-ERROR {errors[0]!r}", flush=True)
         return 2
     eng.close()
+    if lockdep.enabled() and lockdep.violations():
+        # a child that ran to completion validates lock order too (a
+        # KILLED child already printed any violation at detection time)
+        print(f"CHILD-ERROR lockdep: {lockdep.violations()[0]!r}",
+              flush=True)
+        return 3
     print("CHILD-DONE", flush=True)
     return 0
 
@@ -559,8 +567,8 @@ def run_scribble_round(mode: str, seed: int, args,
         # kill only once the corruption TARGET exists (a closed TSF):
         # child interpreter startup dominates a fixed delay, so a wall-
         # clock kill would routinely land before any data was written
-        deadline = time.time() + 30
-        while time.time() < deadline and proc.poll() is None:
+        deadline = time.perf_counter() + 30
+        while time.perf_counter() < deadline and proc.poll() is None:
             if _tsf_targets(data_dir):
                 break
             time.sleep(0.05)
@@ -668,6 +676,18 @@ def run_round(site: str | None, nth: int, seed: int, args,
             "problems": problems}
 
 
+def _parent_lockdep_problems() -> list[dict]:
+    """OGT_LOCKDEP=1 rides through to the child (env inherit) AND arms
+    the parent, whose verify phase reopens every killed directory — a
+    lock-order cycle or blocking-under-hot-lock witnessed ANYWHERE in
+    the run is a harness violation like a lost row."""
+    if not lockdep.enabled() or not lockdep.violations():
+        return []
+    return [{"ok": False, "round": "lockdep",
+             "problems": ["lockdep: " + v.splitlines()[0]
+                          for v in lockdep.violations()]}]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--child", action="store_true")
@@ -703,7 +723,7 @@ def main(argv=None) -> int:
                 for _ in range(args.rounds or 20)
             ]
         results = []
-        t0 = time.time()
+        t0 = time.perf_counter()
         for i, (mode, delay) in enumerate(schedule):
             res = run_scribble_round(mode, args.seed * 10_000 + i, args,
                                      sigkill_delay=delay)
@@ -716,11 +736,12 @@ def main(argv=None) -> int:
                 for p in res["problems"]:
                     print("   ", p, flush=True)
         bad = [r for r in results if not r["ok"]]
+        bad += _parent_lockdep_problems()
         summary = {
             "rounds": len(results),
             "killed": sum(1 for r in results if r["killed_by"]),
             "violations": len(bad),
-            "elapsed_s": round(time.time() - t0, 1),
+            "elapsed_s": round(time.perf_counter() - t0, 1),
         }
         print(json.dumps({"summary": summary, "violations": bad},
                          indent=2, default=str))
@@ -743,7 +764,7 @@ def main(argv=None) -> int:
                 rounds.append((rng.choice(sites), rng.randint(1, 6), None))
 
     results = []
-    t0 = time.time()
+    t0 = time.perf_counter()
     for i, (site, nth, delay) in enumerate(rounds):
         res = run_round(site, nth, args.seed * 10_000 + i, args,
                         sigkill_delay=delay)
@@ -756,12 +777,13 @@ def main(argv=None) -> int:
             for p in res["problems"]:
                 print("   ", p, flush=True)
     bad = [r for r in results if not r["ok"]]
+    bad += _parent_lockdep_problems()
     summary = {
         "rounds": len(results),
         "killed": sum(1 for r in results if r["killed_by"]),
         "ran_to_completion": sum(1 for r in results if not r["killed_by"]),
         "violations": len(bad),
-        "elapsed_s": round(time.time() - t0, 1),
+        "elapsed_s": round(time.perf_counter() - t0, 1),
     }
     print(json.dumps({"summary": summary, "violations": bad}, indent=2))
     # machine-readable single line (tests/test_torture.py parses this)
