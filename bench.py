@@ -184,29 +184,104 @@ def bench_cpu_double_groupby(fields: int, R: int, spw: int) -> float:
 # -- config #3: PromQL rate over 10k series ----------------------------------
 
 
-def bench_prom_rate(S: int, N: int, K: int) -> float:
-    """samples/s of extrapolated_rate over (S series, N samples) for K
-    eval steps — the dense (series, step) range-vector kernel
-    (ops/prom.py; reference: prom_range_vector_cursor)."""
+def _prom_bench_setup(S: int, N: int, K: int):
+    """Shared prom-bench state: a regular 15s scrape grid with counter
+    resets, the window grid, the tiled prepared structure, and the dense
+    inputs the old kernels take (the in-bench reference)."""
+    import jax.numpy as jnp
+
+    from opengemini_tpu.models.grid import lane_quantum
+    from opengemini_tpu.ops import prom as prom_ops
+
+    scrape_s = 15.0
+    window_s = 300.0
+    step = (N * scrape_s) / K
+    rng = np.random.default_rng(2)
+    vals = np.cumsum(rng.random((S, N)), axis=1)
+    # counter resets so the reset-correction path is really exercised
+    rmask = rng.random((S, N)) < 0.002
+    vals = vals - np.maximum.accumulate(np.where(rmask, vals, 0.0), axis=1)
+    vals = vals.astype(np.float32)
+    t_row = np.arange(N, dtype=np.int64) * int(scrape_s * 1000)
+    lens = np.full(S, N, np.int64)
+    step_ends = (np.arange(K, dtype=np.float64) + 1.0) * step
+    step_starts = step_ends - window_s
+    t0 = time.perf_counter()
+    plan = prom_ops.plan_tiles(step_starts, step_ends, 0, int(t_row[-1]),
+                               max_tiles=max(8 * N + 64, 1024))
+    assert plan is not None, "bench window grid must be tile-eligible"
+    prep = prom_ops.prepare_tiled(
+        plan, np.tile(t_row, S), vals.reshape(-1).astype(np.float64), lens,
+        dtype=np.float32, max_gather_cols=8 * N + 64,
+        lane_quantum=lane_quantum())
+    assert prep is not None
+    prepare_s = time.perf_counter() - t0
+    dense = dict(
+        times=jnp.asarray(
+            np.where(np.isfinite(prep.times), prep.times, np.inf
+                     ).astype(np.float32)),
+        values=jnp.asarray(vals),
+        counts=jnp.asarray(lens.astype(np.int32)),
+        starts=jnp.asarray(step_starts.astype(np.float32)),
+        ends=jnp.asarray(step_ends.astype(np.float32)),
+    )
+    return prep, dense, window_s, prepare_s
+
+
+def _assert_prom_close(name, new, valid_new, old, valid_old, k_real,
+                       rtol=2e-3, atol=1e-3):
+    """In-bench tiled-vs-dense equality gate (the flush_floor pattern):
+    a speedup that changes answers is not a speedup."""
+    nv = np.asarray(valid_new)[:, :k_real]
+    ov = np.asarray(valid_old)
+    assert (nv == ov).all(), f"{name}: valid mask diverged"
+    a = np.asarray(new)[:, :k_real][ov]
+    b = np.asarray(old)[ov]
+    err = np.abs(a - b) - (atol + rtol * np.abs(b))
+    assert err.size == 0 or err.max() <= 0, (
+        f"{name}: tiled diverges from dense reference by {err.max():.3g}")
+
+
+def bench_prom_rate(S: int, N: int, K: int):
+    """samples/s of rate() over (S series, N samples) for K eval steps —
+    the TILED interval-reduction kernel (ops/prom.py TiledPrepared, the
+    production path), equality-gated in-bench against the dense
+    extrapolated_rate reference it replaced.  Returns (samples/s, detail)
+    with per-stage ns so regressions are attributable from the JSON."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from opengemini_tpu.ops import prom as prom_ops
 
-    scrape_s = 15.0
-    times = jnp.broadcast_to(
-        jnp.arange(N, dtype=jnp.float32) * scrape_s, (S, N))
-    key = jax.random.PRNGKey(2)
-    values = jnp.cumsum(
-        jax.random.uniform(key, (S, N), dtype=jnp.float32), axis=1)
-    counts = jnp.full((S,), N, dtype=jnp.int32)
-    window_s = 300.0
-    step = (N * scrape_s) / K
-    step_ends = (jnp.arange(K, dtype=jnp.float32) + 1.0) * step
-    step_starts = step_ends - window_s
+    prep, dense, window_s, prepare_s = _prom_bench_setup(S, N, K)
+    vpad = jnp.asarray(prep.values)
 
-    def make(k_iters):
+    # equality gate: tiled output == dense reference on this shape
+    new_out, new_valid = jax.jit(
+        lambda v: prep.rate(jnp, values=v, is_counter=True, is_rate=True))(vpad)
+    old_out, old_valid = jax.jit(
+        lambda t, v, c, s0, s1: prom_ops.extrapolated_rate(
+            t, v, c, s0, s1, window_s, True, True))(
+        dense["times"], dense["values"], dense["counts"], dense["starts"],
+        dense["ends"])
+    _assert_prom_close("prom_rate", new_out, new_valid, old_out, old_valid,
+                       prep.k_real)
+
+    def make_tiled(k_iters):
+        @jax.jit
+        def run(v):
+            def body(i, acc):
+                out, valid = prep.rate(
+                    jnp, values=v, value_shift=i.astype(jnp.float32) * 1e-9,
+                    is_counter=True, is_rate=True)
+                return _consume([out[:, :prep.k_real],
+                                 valid[:, :prep.k_real]], acc)
+            return lax.fori_loop(0, k_iters, body, 0.0)
+
+        return lambda: run(vpad)
+
+    def make_dense(k_iters):
         @jax.jit
         def run(t, v, c, ss, se):
             def body(i, acc):
@@ -216,10 +291,85 @@ def bench_prom_rate(S: int, N: int, K: int) -> float:
                 return _consume([out, valid], acc)
             return lax.fori_loop(0, k_iters, body, 0.0)
 
-        return lambda: run(times, values, counts, step_starts, step_ends)
+        return lambda: run(dense["times"], dense["values"], dense["counts"],
+                           dense["starts"], dense["ends"])
 
-    dt = _marginal_time(make, ks=(3, 9, 18), trials=3)
-    return S * N / dt
+    dt_tiled = _marginal_time(make_tiled, ks=(3, 9, 18), trials=3)
+    dt_dense = _marginal_time(make_dense, ks=(3, 9, 18), trials=3)
+    detail = {
+        "prom_prepare_ns": int(prepare_s * 1e9),
+        "prom_kernel_ns_per_iter": int(dt_tiled * 1e9),
+        "dense_kernel_ns_per_iter": int(dt_dense * 1e9),
+        "tiled_vs_dense_speedup": round(float(dt_dense / dt_tiled), 2),
+        "equality_checked": True,
+        "tile_occupancy": int(prep.occupancy),
+        "covered_tiles": int(prep.C),
+    }
+    return float(S * N / dt_tiled), detail
+
+
+def bench_prom_over_time(S: int, N: int, K: int):
+    """samples/s of a min_over_time + sum_over_time pair on the same
+    tiled prepared structure (sliding-extreme + prefix sums), equality-
+    gated against the dense over_time kernels.  The min path previously
+    materialized dense (S, 256, N) membership tensors."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from opengemini_tpu.ops import prom as prom_ops
+
+    prep, dense, _window_s, prepare_s = _prom_bench_setup(S, N, K)
+    vpad = jnp.asarray(prep.values)
+    for func in ("min", "sum"):
+        new_out, new_valid = jax.jit(
+            lambda v, f=func: prep.over_time(jnp, values=v, func=f))(vpad)
+        old_out, old_valid = jax.jit(
+            lambda t, v, c, s0, s1, f=func: prom_ops.over_time(
+                t, v, c, s0, s1, f))(
+            dense["times"], dense["values"], dense["counts"],
+            dense["starts"], dense["ends"])
+        _assert_prom_close(f"prom_{func}_over_time", new_out, new_valid,
+                           old_out, old_valid, prep.k_real, atol=1e-2)
+
+    def make_tiled(k_iters):
+        @jax.jit
+        def run(v):
+            def body(i, acc):
+                sh = i.astype(jnp.float32) * 1e-9
+                mn, va = prep.over_time(jnp, values=v, value_shift=sh,
+                                        func="min")
+                sm, vb = prep.over_time(jnp, values=v, value_shift=sh,
+                                        func="sum")
+                return _consume([mn[:, :prep.k_real], sm[:, :prep.k_real],
+                                 va[:, :prep.k_real]], acc)
+            return lax.fori_loop(0, k_iters, body, 0.0)
+
+        return lambda: run(vpad)
+
+    def make_dense(k_iters):
+        @jax.jit
+        def run(t, v, c, ss, se):
+            def body(i, acc):
+                vv = v + i.astype(jnp.float32) * 1e-9
+                mn, va = prom_ops.over_time(t, vv, c, ss, se, "min")
+                sm, _vb = prom_ops.over_time(t, vv, c, ss, se, "sum")
+                return _consume([mn, sm, va], acc)
+            return lax.fori_loop(0, k_iters, body, 0.0)
+
+        return lambda: run(dense["times"], dense["values"], dense["counts"],
+                           dense["starts"], dense["ends"])
+
+    dt_tiled = _marginal_time(make_tiled, ks=(3, 9, 18), trials=3)
+    dt_dense = _marginal_time(make_dense, ks=(3, 9, 18), trials=3)
+    detail = {
+        "prom_prepare_ns": int(prepare_s * 1e9),
+        "prom_kernel_ns_per_iter": int(dt_tiled * 1e9),
+        "dense_kernel_ns_per_iter": int(dt_dense * 1e9),
+        "tiled_vs_dense_speedup": round(float(dt_dense / dt_tiled), 2),
+        "equality_checked": True,
+    }
+    return float(S * N / dt_tiled), detail
 
 
 def bench_cpu_prom_rate(N: int, K: int) -> float:
@@ -1814,13 +1964,30 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
         f"double_groupby5_mean_rows_per_sec{suffix}",
         round(rows_dg), "rows/s", vs2)
 
-    # config #3: prom rate 10k series 24h
+    # config #3: prom rate 10k series 24h — the tiled range-vector
+    # engine, equality-gated in-bench against the dense reference, with
+    # per-stage ns in the artifact so a regression is attributable from
+    # the JSON alone
     S3, N3, K3 = (10_000, 5760, 96) if device else (512, 1440, 24)
-    sps = bench_prom_rate(S3, N3, K3)
+    sps, prom_detail = bench_prom_rate(S3, N3, K3)
     vs3 = round(sps / (bench_cpu_prom_rate(N3, K3) * 16), 3)
     configs["3_prom_rate_10k"] = _emit(
         f"prom_rate_10k_series_samples_per_sec{suffix}",
-        round(sps), "samples/s", vs3)
+        round(sps), "samples/s", vs3, {"detail": prom_detail})
+
+    # prom over_time variant (min + sum on one prepared structure):
+    # tracks the sliding-extreme and prefix-sum paths per round
+    try:
+        sps_ot, ot_detail = bench_prom_over_time(S3, N3, K3)
+        _emit("prom_over_time_min_sum_samples_per_sec" + suffix,
+              round(sps_ot), "samples/s",
+              ot_detail["tiled_vs_dense_speedup"], {"detail": ot_detail})
+    except AssertionError:
+        # the tiled-vs-dense equality gate tripped: a divergence must
+        # fail the bench loudly, never degrade to a missing metric
+        raise
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: prom over_time failed: {e}", file=sys.stderr)
 
     # config #4: downsample rewrite
     S4, R4 = (4096, 8640) if device else (512, 2160)

@@ -542,6 +542,12 @@ def _lane_quantum() -> int:
     return 128 if jax.default_backend() == "tpu" else 8
 
 
+def lane_quantum() -> int:
+    """Public backend lane quantum — the PromQL tiled kernels pad their
+    window (lane) axis with the same rule as the grid W axis."""
+    return _lane_quantum()
+
+
 def _pad_lanes(n: int, floor: int) -> int:
     """Pad the lane (W) axis to a multiple of the backend quantum
     instead of a power of two: at W=1667 that is 1792 rather than 2048

@@ -257,6 +257,90 @@ def grid_window_agg_t(values_t, mask_t):
     return {"sum": s, "count": cnt, "mean": mean, "min": mn, "max": mx}
 
 
+# ---------------------------------------------------------------------------
+# Tiled interval reductions (time-centric batch operators, TiLT
+# arXiv:2301.12030): per-(series, tile) partials answered per window from
+# cumulative tile prefixes.  Shared by the PromQL range-vector engine
+# (ops/prom.py TiledPrepared): every window is an exact union of
+# left-open/right-closed time tiles, so these helpers replace the per-window
+# sample walks (vmap'd searchsorted + dense membership tensors) with O(1)
+# prefix lookups.  `xp` is numpy or jax.numpy — the host path answers in
+# numpy (no dispatch/compile cost on CPU backends), the device path traces
+# the identical code under jit.
+# ---------------------------------------------------------------------------
+
+
+def tile_window_sums(tile_vals, ca, cb, xp=None):
+    """Per-window sums over contiguous compact-tile ranges [ca, cb) from
+    ONE cumulative pass over the tile partials.
+
+    tile_vals: (S, C) per-(series, tile) partial sums; ca/cb: (S, K) int
+    compact positions (cb exclusive).  Returns (S, K)."""
+    if xp is None:
+        xp = jnp
+    s_dim = tile_vals.shape[0]
+    cc = xp.cumsum(tile_vals, axis=1)
+    cc = xp.concatenate(
+        [xp.zeros((s_dim, 1), dtype=tile_vals.dtype), cc], axis=1)
+    return (xp.take_along_axis(cc, cb, axis=1)
+            - xp.take_along_axis(cc, ca, axis=1))
+
+
+def _accumulate_extreme(x, axis, want_min: bool, reverse: bool, xp):
+    if xp is not jnp:  # numpy host path
+        import numpy as _np
+
+        op = _np.minimum if want_min else _np.maximum
+        if reverse:
+            x = _np.flip(x, axis=axis)
+        out = op.accumulate(x, axis=axis)
+        return _np.flip(out, axis=axis) if reverse else out
+    from jax import lax
+
+    fn = lax.cummin if want_min else lax.cummax
+    return fn(x, axis=axis, reverse=reverse)
+
+
+def tile_sliding_extreme(tile_vals, win_tiles: int, start_pos, want_min: bool,
+                         xp=None):
+    """min/max over EXACTLY win_tiles consecutive tiles starting at compact
+    position start_pos (S, K): the fixed-length sliding-extreme trick —
+    block the tile axis at the window length, scan each block prefix-from-
+    left and suffix-from-right, and any length-L range [i, i+L) spans at
+    most two blocks, so its extreme is suffix_at(i) combined with
+    prefix_at(i+L-1).  O(C) build, O(1) per window — no dense membership
+    tensor, no per-sample rescan (the old chunked (S, 256, N) path)."""
+    if xp is None:
+        xp = jnp
+    import numpy as _np
+
+    s_dim, c_dim = tile_vals.shape
+    # identity element computed with numpy dtype logic: the host path must
+    # not touch a jax backend just to pick +/-inf
+    ndt = _np.dtype(str(tile_vals.dtype))
+    if _np.issubdtype(ndt, _np.floating):
+        fill = ndt.type(_np.inf if want_min else -_np.inf)
+    else:
+        info = _np.iinfo(ndt)
+        fill = ndt.type(info.max if want_min else info.min)
+    ln = max(int(win_tiles), 1)
+    blocks = (c_dim + ln - 1) // ln
+    pad = blocks * ln - c_dim
+    x = xp.concatenate(
+        [tile_vals, xp.full((s_dim, pad), fill, dtype=tile_vals.dtype)],
+        axis=1) if pad else tile_vals
+    x3 = x.reshape(s_dim, blocks, ln)
+    suf = _accumulate_extreme(x3, 2, want_min, reverse=True, xp=xp)
+    pre = _accumulate_extreme(x3, 2, want_min, reverse=False, xp=xp)
+    suf = suf.reshape(s_dim, blocks * ln)
+    pre = pre.reshape(s_dim, blocks * ln)
+    hi = xp.clip(start_pos + (ln - 1), 0, blocks * ln - 1)
+    lo = xp.clip(start_pos, 0, blocks * ln - 1)
+    a = xp.take_along_axis(suf, lo, axis=1)
+    b = xp.take_along_axis(pre, hi, axis=1)
+    return xp.minimum(a, b) if want_min else xp.maximum(a, b)
+
+
 def _type_max(dtype):
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.array(jnp.inf, dtype)

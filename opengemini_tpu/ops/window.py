@@ -51,6 +51,17 @@ def num_windows(range_start_ns: int, range_end_ns: int, every_ns: int, offset_ns
     return int((range_end_ns - 1 - offset_ns) // every_ns - (aligned - offset_ns) // every_ns) + 1
 
 
+def tile_index(t_ms: np.ndarray, anchor_ms: int, g_ms: int) -> np.ndarray:
+    """Left-OPEN right-CLOSED tile ordinal: tile i covers
+    (anchor + i*g, anchor + (i+1)*g].
+
+    The PromQL tiled range-vector engine's bucketize (ops/prom.py): prom
+    windows are (s, e], so its tiles close on the right — the mirror of
+    window_index's [start, end) InfluxQL buckets, same exact int64
+    floor-division idiom, no searchsorted."""
+    return (np.asarray(t_ms, np.int64) - anchor_ms - 1) // g_ms
+
+
 def relative_ms(times_ns: np.ndarray, base_ns: int) -> np.ndarray:
     """int32 milliseconds relative to base — the device-side time column.
 

@@ -12,13 +12,21 @@ Data model (matching the reference's prom-on-influx mapping): metric name
 
 from __future__ import annotations
 
+import functools
 import math
+import os
 import re
+import time as _time
+from contextlib import contextmanager
 
 import numpy as np
 
 from opengemini_tpu.ops import prom as promops
 from opengemini_tpu.promql import parser as pp
+from opengemini_tpu.utils import tracing
+from opengemini_tpu.utils.governor import _env_int
+from opengemini_tpu.utils.querytracker import GLOBAL as TRACKER
+from opengemini_tpu.utils.stats import GLOBAL as STATS
 
 MS = 1_000_000  # ns per ms
 DEFAULT_LOOKBACK_S = 300.0
@@ -26,6 +34,56 @@ DEFAULT_LOOKBACK_S = 300.0
 
 class PromError(ValueError):
     pass
+
+
+# -- tiled-engine knobs (documented in README "PromQL engine") -----------
+
+
+def _tiled_enabled() -> bool:
+    return os.environ.get("OGT_PROM_TILED", "1") != "0"
+
+
+def _bulk_sids_min() -> int:
+    return max(1, _env_int("OGT_PROM_BULK_SIDS", 1))
+
+
+def _tile_cells_mult() -> int:
+    return max(1, _env_int("OGT_PROM_TILE_CELLS", 8))
+
+
+@functools.lru_cache(maxsize=1)
+def _backend_is_cpu() -> bool:
+    try:
+        import jax
+
+        return jax.default_backend() == "cpu"
+    except Exception:  # noqa: BLE001 — no backend = host kernels
+        return True
+
+
+def _host_kernels() -> bool:
+    """numpy (host) vs jax.numpy (device) for the tiled kernels: on CPU
+    backends numpy answers without dispatch or per-shape compile cost;
+    accelerators keep the traced path."""
+    v = os.environ.get("OGT_PROM_HOST_KERNELS", "")
+    if v == "1":
+        return True
+    if v == "0":
+        return False
+    return _backend_is_cpu()
+
+
+@contextmanager
+def _stage(name: str):
+    """Per-stage attribution: /debug/vars query_stages + the per-query
+    stage map in /debug/queries and the slow-query log."""
+    t0 = _time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        ns = _time.perf_counter_ns() - t0
+        tracing.record_stage(name, ns)
+        TRACKER.add_stage_ns(TRACKER.current_qid(), name, ns)
 
 
 def _anchor(pattern: str) -> str:
@@ -94,7 +152,8 @@ class PromEngine:
             raise PromError("too many steps (max 11000)")
         steps = start_s + np.arange(n_steps) * step_s
         expr = pp.parse(text)
-        frame = self._eval(expr, steps, db)
+        with self._tracked(text, db):
+            frame = self._eval(expr, steps, db)
         result = []
         for i, labels in enumerate(frame.labels):
             pts = [
@@ -111,7 +170,8 @@ class PromEngine:
         self._check_readable()
         steps = np.array([time_s])
         expr = pp.parse(text)
-        frame = self._eval(expr, steps, db)
+        with self._tracked(text, db):
+            frame = self._eval(expr, steps, db)
         if frame.is_scalar:
             return {"resultType": "scalar", "result": [time_s, _fmt(frame.values[0, 0])]}
         result = []
@@ -172,6 +232,27 @@ class PromEngine:
         if getattr(self.engine, "read_disabled", False):
             raise PromError("reads are disabled (syscontrol)")
 
+    @contextmanager
+    def _tracked(self, text: str, db: str):
+        """Register the PromQL evaluation with the running-query registry
+        (shows in /debug/queries with per-stage attribution, KILL QUERY
+        cancels it between shard scans) and capture slow evaluations in
+        the slow-query log — the /api/v1/query_range surface was
+        previously invisible to both."""
+        t0 = _time.perf_counter_ns()
+        qid = TRACKER.register(text, db)
+        try:
+            yield
+        finally:
+            dur_ns = _time.perf_counter_ns() - t0
+            from opengemini_tpu.utils.slowlog import GLOBAL as SLOWLOG
+
+            if SLOWLOG.enabled():
+                SLOWLOG.note(qid, text, db, dur_ns / 1e6,
+                             stages=TRACKER.stages_of(qid),
+                             extra={"kind": "promql"})
+            TRACKER.unregister(qid)
+
     # -- evaluation -------------------------------------------------------
 
     def _eval(self, node, steps: np.ndarray, db: str) -> Frame:
@@ -191,11 +272,12 @@ class PromEngine:
         raise PromError(f"unsupported expression {type(node).__name__}")
 
     def _collect_series(self, vs: pp.VectorSelector, t_min_ns: int, t_max_ns: int, db: str):
-        """-> (labels list, [(times_ms, values)] per series)."""
+        """-> run-encoded (labels list, t_ms_all, v_all, lens): one
+        concatenated (times, values) pair with per-series lengths, ready
+        for prepare_matrix_runs' flat scatter / the tiled prepare — no
+        per-series matrix fill loop downstream."""
         metric = self._metric_of(vs)
         shards = self.engine.shards_for_range(db, None, t_min_ns, t_max_ns)
-        out_labels: list[dict] = []
-        out_samples: list[tuple[np.ndarray, np.ndarray]] = []
         # series may span shards: merge by label key.
         # per_key: key -> (tags, [(times_ms, values)])
         per_key: dict[tuple, tuple] = {}
@@ -209,14 +291,17 @@ class PromEngine:
                 got[1].append((t_ms, vals))
 
         vf = self.value_field
+        bulk_min = _bulk_sids_min()
         for sh in shards:
+            TRACKER.check()  # KILL QUERY cancellation point per shard
             sids = sorted(_match_sids(sh, metric, vs.matchers))
             if not sids:
                 continue
-            if len(sids) >= 64 and hasattr(sh, "read_series_bulk"):
+            if len(sids) >= bulk_min and hasattr(sh, "read_series_bulk"):
                 # batched multi-series decode: packed (colstore) chunks
-                # decode once for every matched series — the config-#5
-                # path (BASELINE.md) that replaces the per-sid loop
+                # decode once for every matched series.  Default for ANY
+                # match size (OGT_PROM_BULK_SIDS=1); raise the knob to
+                # make the per-sid decode loop handle small matches
                 sid_arr, rec = sh.read_series_bulk(
                     metric, np.asarray(sids, np.int64),
                     t_min_ns, t_max_ns, fields=[vf])
@@ -253,6 +338,10 @@ class PromEngine:
                     add(sh.index.tags_of(sid),
                         rec.times[valid] // MS,
                         col.values[valid].astype(np.float64))
+        out_labels: list[dict] = []
+        t_parts: list[np.ndarray] = []
+        v_parts: list[np.ndarray] = []
+        lens: list[int] = []
         for key in sorted(per_key):
             tags, parts = per_key[key]
             if len(parts) == 1:
@@ -265,20 +354,32 @@ class PromEngine:
             labels = dict(tags)
             labels["__name__"] = metric
             out_labels.append(labels)
-            out_samples.append((t, v))
-        return out_labels, out_samples
+            t_parts.append(t)
+            v_parts.append(v)
+            lens.append(len(t))
+        t_ms_all = (np.concatenate(t_parts) if t_parts
+                    else np.empty(0, np.int64)).astype(np.int64, copy=False)
+        v_all = (np.concatenate(v_parts) if v_parts
+                 else np.empty(0, np.float64))
+        return out_labels, t_ms_all, v_all, np.asarray(lens, np.int64)
 
     def _eval_selector(self, vs, steps, db, window_s, instant):
         eval_times = steps - vs.offset_s
         t_max_ns = int(eval_times[-1] * 1e9) + 1
         t_min_ns = int((eval_times[0] - window_s) * 1e9)
-        labels, samples = self._collect_series(vs, t_min_ns, t_max_ns, db)
+        with _stage("prom_collect"):
+            labels, t_ms_all, v_all, lens = self._collect_series(
+                vs, t_min_ns, t_max_ns, db)
         k = len(steps)
-        if not samples:
+        if not labels:
             return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
-        times, values, counts, base_ms = promops.prepare_matrix(samples, dtype=np.float64)
+        with _stage("prom_prepare"):
+            times, values, counts, base_ms = promops.prepare_matrix_runs(
+                t_ms_all, v_all, lens, dtype=np.float64)
         rel = eval_times - base_ms / 1000.0
-        vals, valid = promops.instant_values(times, values, counts, rel, window_s)
+        with _stage("prom_kernel"):
+            vals, valid = promops.instant_values(times, values, counts, rel,
+                                                 window_s)
         return Frame(labels, np.asarray(vals), np.asarray(valid))
 
     def _eval_function(self, node: pp.FunctionCall, steps, db) -> Frame:
@@ -291,16 +392,11 @@ class PromEngine:
             ms_sel = _expect_matrix(node, 0)
             return self._eval_range_fn(
                 ms_sel, steps, db,
-                lambda t, v, c, s0, s1: promops.extrapolated_rate(
-                    t, v, c, s0, s1, ms_sel.range_s, is_counter, is_rate
-                ),
-            )
+                {"kind": "rate", "is_counter": is_counter, "is_rate": is_rate})
         if name in ("changes", "resets"):
             ms_sel = _expect_matrix(node, 0)
             return self._eval_range_fn(
-                ms_sel, steps, db,
-                lambda t, v, c, s0, s1: promops.changes_resets(t, v, c, s0, s1, name),
-            )
+                ms_sel, steps, db, {"kind": "changes_resets", "which": name})
         if name == "absent":
             if not node.args:
                 raise PromError("absent() requires an argument")
@@ -326,26 +422,19 @@ class PromEngine:
             ms_sel = _expect_matrix(node, 0)
             return self._eval_range_fn(
                 ms_sel, steps, db,
-                lambda t, v, c, s0, s1: _instant_rate(t, v, c, s0, s1, name == "irate"),
-            )
+                {"kind": "instant_rate", "per_second": name == "irate"})
         if name == "quantile_over_time":
             q = _expect_number(node, 0)
             ms_sel = _expect_matrix(node, 1)
             return self._eval_range_fn(
-                ms_sel, steps, db,
-                lambda t, v, c, s0, s1: promops.quantile_over_time(t, v, c, s0, s1, q),
-            )
+                ms_sel, steps, db, {"kind": "quantile", "q": q})
         if name == "mad_over_time":
             ms_sel = _expect_matrix(node, 0)
-            return self._eval_range_fn(
-                ms_sel, steps, db, promops.mad_over_time,
-            )
+            return self._eval_range_fn(ms_sel, steps, db, {"kind": "mad"})
         if name == "absent_over_time":
             ms_sel = _expect_matrix(node, 0)
             f = self._eval_range_fn(
-                ms_sel, steps, db,
-                lambda t, v, c, s0, s1: promops.over_time(t, v, c, s0, s1, "present"),
-            )
+                ms_sel, steps, db, {"kind": "over_time", "func": "present"})
             k = len(steps)
             present = f.valid.any(axis=0) if len(f.labels) else np.zeros(k, bool)
             labels = {}
@@ -359,26 +448,15 @@ class PromEngine:
             func = name[: -len("_over_time")]
             ms_sel = _expect_matrix(node, 0)
             return self._eval_range_fn(
-                ms_sel, steps, db,
-                lambda t, v, c, s0, s1: promops.over_time(t, v, c, s0, s1, func),
-            )
+                ms_sel, steps, db, {"kind": "over_time", "func": func})
         if name == "deriv":
             ms_sel = _expect_matrix(node, 0)
-
-            def _deriv(t, v, c, s0, s1):
-                slope, _icept, has2 = promops.linear_regression(t, v, c, s0, s1)
-                return slope, has2
-
-            return self._eval_range_fn(ms_sel, steps, db, _deriv)
+            return self._eval_range_fn(ms_sel, steps, db, {"kind": "deriv"})
         if name == "predict_linear":
             ms_sel = _expect_matrix(node, 0)
             dur = _expect_number(node, 1)
-
-            def _predict(t, v, c, s0, s1):
-                slope, icept, has2 = promops.linear_regression(t, v, c, s0, s1)
-                return icept + slope * dur, has2
-
-            return self._eval_range_fn(ms_sel, steps, db, _predict)
+            return self._eval_range_fn(
+                ms_sel, steps, db, {"kind": "predict", "dur": dur})
         if name in ("holt_winters", "double_exponential_smoothing"):
             ms_sel = _expect_matrix(node, 0)
             sf = _expect_number(node, 1)
@@ -388,11 +466,7 @@ class PromEngine:
                     "holt_winters smoothing factors must be in (0, 1)"
                 )
             return self._eval_range_fn(
-                ms_sel, steps, db,
-                lambda t, v, c, s0, s1: promops.holt_winters_window(
-                    t, v, c, s0, s1, sf, tf
-                ),
-            )
+                ms_sel, steps, db, {"kind": "holt", "sf": sf, "tf": tf})
         if name == "scalar":
             f = self._eval(node.args[0], steps, db)
             if len(f.labels) == 1:
@@ -558,8 +632,8 @@ class PromEngine:
 
     def _subquery_samples(self, sq: "pp.Subquery", steps, db):
         """Evaluate the inner expression on an absolutely-aligned step
-        grid covering the outer window -> (labels, [(times_ms, values)])
-        shaped exactly like _collect_series output."""
+        grid covering the outer window -> run-encoded
+        (labels, t_ms_all, v_all, lens) shaped like _collect_series."""
         # explicit None check: `or` would silently turn [range:0s] into
         # the default step instead of rejecting it
         step = self.subquery_default_step_s if sq.step_s is None else sq.step_s
@@ -569,8 +643,10 @@ class PromEngine:
         t_start = float(steps[0]) - sq.offset_s - sq.range_s
         first = math.ceil(t_start / step) * step  # absolute alignment
         n = int(math.floor((t_end - first) / step)) + 1
+        empty = ([], np.empty(0, np.int64), np.empty(0, np.float64),
+                 np.empty(0, np.int64))
         if n <= 0:
-            return [], []
+            return empty
         if n > 11_000:
             raise PromError("subquery produces too many steps (max 11000)")
         sub_steps = first + np.arange(n) * step
@@ -580,37 +656,151 @@ class PromEngine:
         # rint, not truncation: x.2999999*1000 would land 1ms early and
         # flip boundary inclusion in the (start, end] kernel windows
         times_ms = np.rint(sub_steps * 1000.0).astype(np.int64)
-        labels, samples = [], []
+        labels, t_parts, v_parts, lens = [], [], [], []
         for i in range(len(inner.labels)):
             mask = inner.valid[i]
             if not mask.any():
                 continue
             labels.append(inner.labels[i])
-            samples.append((times_ms[mask], inner.values[i][mask]))
-        return labels, samples
+            t_parts.append(times_ms[mask])
+            v_parts.append(np.asarray(inner.values[i][mask], np.float64))
+            lens.append(int(mask.sum()))
+        if not labels:
+            return empty
+        return (labels, np.concatenate(t_parts), np.concatenate(v_parts),
+                np.asarray(lens, np.int64))
 
-    def _eval_range_fn(self, ms_sel, steps, db, kernel) -> Frame:
+    # range-function kinds the tiled engine lowers; everything else
+    # (quantile/mad/holt_winters — no prefix form) keeps the chunked
+    # dense fallback
+    _TILED_KINDS = frozenset(
+        ["rate", "instant_rate", "changes_resets", "deriv", "predict"])
+    _TILED_OVER_TIME = frozenset(
+        ["sum", "avg", "count", "last", "present", "stddev", "stdvar",
+         "min", "max"])
+
+    def _eval_range_fn(self, ms_sel, steps, db, spec: dict) -> Frame:
         if isinstance(ms_sel, pp.Subquery):
             w = ms_sel.range_s
             eval_times = steps - ms_sel.offset_s
-            labels, samples = self._subquery_samples(ms_sel, steps, db)
+            labels, t_ms_all, v_all, lens = self._subquery_samples(
+                ms_sel, steps, db)
         else:
             vs = ms_sel.vector
             w = ms_sel.range_s
             eval_times = steps - vs.offset_s
             t_max_ns = int(eval_times[-1] * 1e9) + 1
             t_min_ns = int((eval_times[0] - w) * 1e9)
-            labels, samples = self._collect_series(vs, t_min_ns, t_max_ns, db)
+            with _stage("prom_collect"):
+                labels, t_ms_all, v_all, lens = self._collect_series(
+                    vs, t_min_ns, t_max_ns, db)
         k = len(steps)
-        if not samples:
+        if not labels:
             return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
-        times, values, counts, base_ms = promops.prepare_matrix(samples, dtype=np.float64)
-        base_s = base_ms / 1000.0
-        ends = eval_times - base_s
-        starts = ends - w
-        out, valid = kernel(times, values, counts, starts, ends)
+        out, valid = self._run_range_kernel(
+            spec, t_ms_all, v_all, lens, eval_times, float(w))
         labels = [_drop_name(l) for l in labels]
-        return Frame(labels, np.asarray(out), np.asarray(valid))
+        return Frame(labels, out, valid)
+
+    def _tiled_prep(self, spec, t_ms_all, v_all, lens, eval_times, w):
+        """TiledPrepared for this (samples, window grid) pair, or None
+        when the spec or the grid is ineligible (dense fallback)."""
+        kind = spec["kind"]
+        if kind not in self._TILED_KINDS and not (
+                kind == "over_time" and spec["func"] in self._TILED_OVER_TIME):
+            return None
+        if not _tiled_enabled():
+            return None
+        n_max = int(lens.max())
+        s_dim = len(lens)
+        cells = _tile_cells_mult()
+        max_tiles = min(max(cells * n_max + 64, 1024),
+                        max((1 << 28) // max(s_dim, 1), 64))
+        plan = promops.plan_tiles(
+            eval_times - w, eval_times, int(t_ms_all.min()),
+            int(t_ms_all.max()), max_tiles)
+        if plan is None:
+            return None
+        host = _host_kernels()
+        lane_q = 1
+        if not host:
+            from opengemini_tpu.models.grid import lane_quantum
+
+            lane_q = lane_quantum()
+        return promops.prepare_tiled(
+            plan, t_ms_all, v_all, lens, dtype=np.float64,
+            max_gather_cols=cells * n_max + 64, lane_quantum=lane_q)
+
+    def _run_range_kernel(self, spec, t_ms_all, v_all, lens, eval_times, w):
+        """Dispatch one range-vector spec: tiled interval reductions when
+        the window grid fits the ms tile lattice, dense kernels otherwise.
+        Returns host numpy (out, valid)."""
+        kind = spec["kind"]
+        with _stage("prom_prepare"):
+            prep = self._tiled_prep(spec, t_ms_all, v_all, lens, eval_times, w)
+        if prep is not None:
+            STATS.incr("prom", "tiled_kernels")
+            xp = np
+            if not _host_kernels():
+                import jax.numpy as xp  # noqa: F811 — device path
+            with _stage("prom_kernel"):
+                if kind == "rate":
+                    out, valid = prep.rate(
+                        xp, is_counter=spec["is_counter"],
+                        is_rate=spec["is_rate"])
+                elif kind == "instant_rate":
+                    out, valid = prep.instant_rate(
+                        xp, per_second=spec["per_second"])
+                elif kind == "changes_resets":
+                    out, valid = prep.changes_resets(xp, kind=spec["which"])
+                elif kind == "deriv":
+                    out, _icept, valid = prep.linear_regression(xp)
+                elif kind == "predict":
+                    slope, icept, valid = prep.linear_regression(xp)
+                    out = icept + slope * spec["dur"]
+                else:
+                    out, valid = prep.over_time(xp, func=spec["func"])
+            kr = prep.k_real
+            return (np.asarray(out)[:, :kr], np.asarray(valid)[:, :kr])
+        # dense fallback (searchsorted window bounds)
+        STATS.incr("prom", "dense_kernels")
+        with _stage("prom_prepare"):
+            times, values, counts, base_ms = promops.prepare_matrix_runs(
+                t_ms_all, v_all, lens, dtype=np.float64)
+        ends = eval_times - base_ms / 1000.0
+        starts = ends - w
+        with _stage("prom_kernel"):
+            if kind == "rate":
+                out, valid = promops.extrapolated_rate(
+                    times, values, counts, starts, ends, w,
+                    spec["is_counter"], spec["is_rate"])
+            elif kind == "instant_rate":
+                out, valid = promops.instant_rate(
+                    times, values, counts, starts, ends, spec["per_second"])
+            elif kind == "changes_resets":
+                out, valid = promops.changes_resets(
+                    times, values, counts, starts, ends, spec["which"])
+            elif kind == "deriv":
+                out, _icept, valid = promops.linear_regression(
+                    times, values, counts, starts, ends)
+            elif kind == "predict":
+                slope, icept, valid = promops.linear_regression(
+                    times, values, counts, starts, ends)
+                out = icept + slope * spec["dur"]
+            elif kind == "quantile":
+                out, valid = promops.quantile_over_time(
+                    times, values, counts, starts, ends, spec["q"])
+            elif kind == "mad":
+                out, valid = promops.mad_over_time(
+                    times, values, counts, starts, ends)
+            elif kind == "holt":
+                out, valid = promops.holt_winters_window(
+                    times, values, counts, starts, ends, spec["sf"],
+                    spec["tf"])
+            else:
+                out, valid = promops.over_time(
+                    times, values, counts, starts, ends, spec["func"])
+        return np.asarray(out), np.asarray(valid)
 
     def _metric_of(self, vs: pp.VectorSelector) -> str:
         metric = vs.metric
@@ -1059,29 +1249,6 @@ def _eval_vector_binop(op: str, lhs: Frame, rhs: Frame, matching,
     if not out_labels:
         return Frame([], np.zeros((0, k)), np.zeros((0, k), bool))
     return Frame(out_labels, np.stack(out_vals), np.stack(out_valid))
-
-
-def _instant_rate(times, values, counts, starts, ends, per_second: bool):
-    """irate/idelta: last two samples in the window."""
-    import jax.numpy as jnp
-
-    from opengemini_tpu.ops.prom import window_bounds, _gather_rows
-
-    first_idx, last_idx, has = window_bounds(times, counts, starts, ends)
-    n = times.shape[1]
-    prev_idx = jnp.clip(last_idx - 1, 0, n - 1)
-    safe_last = jnp.clip(last_idx, 0, n - 1)
-    valid = has & (last_idx - first_idx >= 1)
-    v_last = _gather_rows(values, safe_last)
-    v_prev = _gather_rows(values, prev_idx)
-    t_last = _gather_rows(times, safe_last)
-    t_prev = _gather_rows(times, prev_idx)
-    dv = v_last - v_prev
-    if per_second:
-        dv = jnp.where(dv < 0, v_last, dv)  # counter reset
-        dt = jnp.maximum(t_last - t_prev, 1e-9)
-        return dv / dt, valid
-    return dv, valid
 
 
 def _histogram_quantile(q: float, f: Frame, k: int) -> Frame:

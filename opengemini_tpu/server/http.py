@@ -25,6 +25,7 @@ from opengemini_tpu import __version__
 from opengemini_tpu.ingest.line_protocol import ParseError
 from opengemini_tpu.promql.engine import PromEngine, PromError
 from opengemini_tpu.promql.parser import PromParseError, parse_duration_s
+from opengemini_tpu.utils.querytracker import QueryKilled
 from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query.executor import Executor
 from opengemini_tpu.record import FieldTypeConflict
@@ -1426,6 +1427,13 @@ def _make_handler(svc: HttpService):
                     {"status": "error", "errorType": "unavailable",
                      "error": str(e)},
                     headers={"Retry-After": str(e.retry_after_s)})
+                return
+            except QueryKilled as e:
+                # prom queries register with the query tracker now, so
+                # KILL QUERY cancels them like any /query statement
+                self._send_json(
+                    422, {"status": "error", "errorType": "canceled",
+                          "error": str(e)})
                 return
             except (PromError, PromParseError, ValueError, OverflowError, re.error) as e:
                 self._send_json(

@@ -114,13 +114,26 @@ class TestRateKernel:
             window, is_counter, is_rate,
         )
         out, valid = np.asarray(out), np.asarray(valid)
+        # the tiled production path must satisfy the same oracle
+        t_ms = np.asarray(times_s * 1000, dtype=np.int64)
+        plan = promops.plan_tiles(step_ends - window, step_ends,
+                                  int(t_ms.min()), int(t_ms.max()), 100_000)
+        assert plan is not None
+        prep = promops.prepare_tiled(plan, t_ms, vals, np.asarray([n]),
+                                     dtype=np.float64,
+                                     max_gather_cols=10**6)
+        t_out, t_valid = prep.rate(np, is_counter=is_counter,
+                                   is_rate=is_rate)
         for k, te in enumerate(step_ends):
             ref = prom_rate_oracle(times_trunc, vals, te, window, is_counter, is_rate)
             if ref is None:
                 assert not valid[0, k]
+                assert not t_valid[0, k]
             else:
                 assert valid[0, k]
                 assert out[0, k] == pytest.approx(ref, rel=1e-9)
+                assert t_valid[0, k]
+                assert t_out[0, k] == pytest.approx(ref, rel=1e-9)
 
     def test_over_time(self, rng):
         times_s = np.arange(0, 300, 10.0)
