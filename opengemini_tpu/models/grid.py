@@ -69,10 +69,11 @@ class GridBatch:
         self._raw: dict = {}  # lazy per-(row, window) device stats
         # scan signature for the decoded-column cache's DEVICE tier
         # (storage/colcache.py): when the executor proves the scan
-        # deterministic (local shards, no mesh) it stamps a token here
-        # and the padded device_put grid buffers are retained/reused
-        # across identical scans — a warm repeat skips the H2D transfer
-        # (and, on a hit, the host-side grid scatter too)
+        # deterministic (local shards) it stamps a token here and the
+        # padded device_put grid buffers — MESH-SHARDED when a device
+        # mesh is configured — are retained/reused across identical
+        # scans: a warm repeat skips the H2D transfer, the per-query
+        # reshard, and (on a hit) the host-side grid scatter too
         self.device_cache_token = None
 
     def add(self, values, rel_ns, seg_ids, mask, times_ns, sids=None,
@@ -188,6 +189,13 @@ class GridBatch:
         S = len(bnd_idx)
         S_pad = _pad_rows(S, _MIN_S)
         W_pad = _pad_lanes(W, _MIN_W)
+        mesh = self._mesh_for_rows(S_pad)
+        if mesh is not None and S_pad % mesh.size:
+            # multi-chip: pad the row axis to a mesh multiple up front so
+            # the grid scatters straight into the shardable shape (no
+            # second padding copy at device_put time) and the device-tier
+            # signature shape is stable across cold/warm scans
+            S_pad += mesh.size - S_pad % mesh.size
         cells = S_pad * k * W_pad  # padded = what actually allocates
         if cells > _MAX_GRID_CELLS or cells > max(_MAX_EXPANSION * n, 1 << 20):
             return None
@@ -208,15 +216,9 @@ class GridBatch:
 
             dev_entry = colcache.GLOBAL.device_get(
                 self.device_cache_token,
-                shape=(S_pad, k, W_pad), dtype=str(self.dtype))
+                shape=(S_pad, k, W_pad), dtype=str(self.dtype), mesh=mesh)
         if dev_entry is None:
-            vals = np.concatenate(self._vals)
-            mask = np.concatenate(self._mask)
-            vt = np.zeros((S_pad, k, W_pad), dtype=self.dtype)
-            mt = np.zeros((S_pad, k, W_pad), dtype=np.bool_)
-            vt.reshape(-1)[flat] = vals
-            mt.reshape(-1)[flat] = mask
-            arrays = (vt, mt)
+            arrays = self._scatter_grid((S_pad, k, W_pad), flat)
         else:
             arrays = None
         run_gid = (seg[bnd_idx] // W).astype(np.int64)
@@ -308,6 +310,16 @@ class GridBatch:
             out2d[gids] = vals2d
         return out, sel, counts
 
+    def _scatter_grid(self, shape, flat):
+        """Scatter the raw rows into the padded (S_pad, k, W_pad) grid:
+        the ONE scatter shared by freeze and the entry-lost rebuild, so
+        the rare rebuild branch can never diverge from the hot path."""
+        vt = np.zeros(shape, dtype=self.dtype)
+        mt = np.zeros(shape, dtype=np.bool_)
+        vt.reshape(-1)[flat] = np.concatenate(self._vals)
+        mt.reshape(-1)[flat] = np.concatenate(self._mask)
+        return vt, mt
+
     def _build_imat_np(self):
         st = self._state
         if st["flat"] is None:
@@ -318,39 +330,88 @@ class GridBatch:
         imat.reshape(-1)[st["flat"]] = np.arange(st["n"], dtype=np.int32)
         return imat
 
-    def _device_arrays(self, with_imat: bool):
+    @staticmethod
+    def _mesh_for_rows(rows: int):
+        """The configured device mesh when ``rows`` grid rows can shard
+        over it, else None (replicated single-device exactly as before)."""
         from opengemini_tpu.parallel import runtime as _prt
 
-        st = self._state
-        ent = st.get("device_entry")
-        if (ent is None and self.device_cache_token is not None
-                and _prt.get_mesh() is None):
-            # cold scan with the device tier on: one explicit device_put,
-            # retained in the cache — later kernel kinds of THIS scan and
-            # identically-signed future scans all skip the transfer
-            import jax
+        mesh = _prt.get_mesh()
+        if mesh is None or rows < mesh.size:
+            return None
+        return mesh
 
+    def _device_put(self, mesh, *arrays_np):
+        """One explicit device_put per array, straight into the final
+        layout: row-sharded over the mesh when configured (NamedSharding,
+        parallel/distributed.py), plain single-device otherwise — never a
+        replicated intermediate that a later reshard would re-copy."""
+        import jax
+
+        if mesh is not None:
+            from opengemini_tpu.parallel import distributed as _dist
+
+            return _dist.shard_leading_axis(mesh, *arrays_np)
+        return tuple(jax.device_put(a) for a in arrays_np)
+
+    def _device_arrays(self, with_imat: bool):
+        st = self._state
+        mesh = self._mesh_for_rows(st["shape"][0])
+        ent = st.get("device_entry")
+        if ent is not None and ent.get("mesh") is not mesh:
+            # mesh changed since the entry was consulted/stored (hot
+            # config reload): re-get — the cache reshards the retained
+            # buffers onto the new mesh, donating the stale layout
+            from opengemini_tpu.storage import colcache
+
+            ent = colcache.GLOBAL.device_get(
+                self.device_cache_token, shape=st["shape"],
+                dtype=str(self.dtype), mesh=mesh)
+            st["device_entry"] = ent
+        if (ent is None and self.device_cache_token is not None
+                and st["arrays"] is not None):
+            # cold scan with the device tier on: one transfer into the
+            # final (sharded) layout, retained in the cache — later
+            # kernel kinds of THIS scan and identically-signed future
+            # scans all skip the transfer
             from opengemini_tpu.storage import colcache
 
             vt_np, mt_np = st["arrays"]
+            vt_d, mt_d = self._device_put(mesh, vt_np, mt_np)
             ent = colcache.GLOBAL.device_put_grid(
-                self.device_cache_token,
-                jax.device_put(vt_np), jax.device_put(mt_np),
-                shape=vt_np.shape, dtype=str(vt_np.dtype))
+                self.device_cache_token, vt_d, mt_d,
+                shape=vt_np.shape, dtype=str(vt_np.dtype), mesh=mesh)
             st["device_entry"] = ent
         if ent is not None:
             imat = None
             if with_imat:
                 imat = ent.get("imat")
                 if imat is None:
-                    import jax
-
                     from opengemini_tpu.storage import colcache
 
+                    ent_mesh = ent.get("mesh")
+                    (imat_d,) = self._device_put(
+                        ent_mesh, self._build_imat_np())
                     imat = colcache.GLOBAL.device_add_imat(
-                        self.device_cache_token, ent,
-                        jax.device_put(self._build_imat_np()))
+                        self.device_cache_token, ent, imat_d,
+                        mesh=ent_mesh)
+                    if ent.get("mesh") is not ent_mesh:
+                        # a concurrent reshard moved the entry while the
+                        # imat was building: one more pass picks up the
+                        # new layout end to end (bounded by mesh swaps,
+                        # which are rare admin events)
+                        return self._device_arrays(with_imat)
             return ent["vt"], ent["mt"], imat
+        if st["arrays"] is None:
+            # the freeze-time device-cache hit skipped the host scatter,
+            # then the entry vanished (mesh swap dropped an indivisible
+            # geometry, or LRU eviction): rebuild the grid from the raw
+            # rows — unless prefetch() already dropped them
+            if self._vals is None or st["flat"] is None:
+                raise RuntimeError(
+                    "grid device entry lost after prefetch dropped the "
+                    "host rows (device mesh changed mid-query?)")
+            st["arrays"] = self._scatter_grid(st["shape"], st["flat"])
         vt, mt = st["arrays"]
         imat = None
         if with_imat:
@@ -358,12 +419,19 @@ class GridBatch:
             if imat is None:
                 imat = self._build_imat_np()
                 st["imat"] = imat
-        mesh = _prt.get_mesh()
-        if mesh is not None and vt.shape[0] >= mesh.size:
+        if mesh is not None:
             # multi-chip: series-run rows are independent — shard the S
-            # axis, GSPMD partitions the sublane reduces, no collectives
+            # axis, GSPMD partitions the sublane reduces, no collectives.
+            # Keyed by mesh EPOCH: a hot config reload (runtime.set_mesh)
+            # must never serve shards laid out for a dead mesh.
             from opengemini_tpu.parallel import distributed as _dist
+            from opengemini_tpu.parallel import runtime as _prt
 
+            epoch = _prt.mesh_epoch()
+            if st.get("mesh_epoch") != epoch:
+                st.pop("mesh_arrays", None)
+                st.pop("mesh_imat", None)
+                st["mesh_epoch"] = epoch
             if "mesh_arrays" not in st:
                 st["mesh_arrays"] = _dist.shard_leading_axis(mesh, vt, mt)
             vt, mt = st["mesh_arrays"]

@@ -262,18 +262,25 @@ class _Bucket:
         self._raw: dict = {}
         self._combined: dict = {}
         self._mesh_arrays = None
+        self._mesh_epoch = None
 
     def _device_arrays(self, mesh):
         """Matrices for the kernels: with a configured mesh, row-sharded
         device arrays (bucket rows are independent — GSPMD partitions the
         dense reduces with zero collectives, parallel/distributed.py
-        shard_leading_axis); otherwise the host matrices as-is."""
+        shard_leading_axis); otherwise the host matrices as-is. The
+        sharded copy is keyed by mesh EPOCH so a hot config reload
+        (runtime.set_mesh) reshards instead of serving a dead mesh."""
         if mesh is None or self.g < mesh.size:
             return self.arrays
-        if self._mesh_arrays is None:
+        from opengemini_tpu.parallel import runtime as _prt
+
+        epoch = _prt.mesh_epoch()
+        if self._mesh_arrays is None or self._mesh_epoch != epoch:
             from opengemini_tpu.parallel import distributed as _dist
 
             self._mesh_arrays = _dist.shard_leading_axis(mesh, *self.arrays)
+            self._mesh_epoch = epoch
         return self._mesh_arrays
 
     def _raw_stats(self, need_selectors: bool) -> dict:

@@ -654,6 +654,10 @@ class TiledPrepared:
         gidx_local = np.clip(gidx_local, 0, lim[:, :, None])
         self.gidx = (np.arange(S, dtype=np.int64)[:, None, None] * N
                      + gidx_local).astype(np.int64)
+        # row-LOCAL gather columns (gidx minus its row offset): the mesh
+        # path gathers per series row so GSPMD can shard the series axis
+        # without collectives; None until shard_tiled derives it
+        self.gidx_col = None
         self.C, self.pmax = C, pmax
         # (1, K): take_along_axis broadcasts the non-gather dim, so the
         # per-series copy would be S redundant rows of the same indices
@@ -684,8 +688,7 @@ class TiledPrepared:
 
     def _vals(self, xp, values, value_shift):
         v = self._values_for(xp) if values is None else values
-        vflat = v.reshape(-1)
-        vg = vflat[self.gidx]
+        vg = self._gather_tiles(xp, v)
         v_first = xp.take_along_axis(v, self.safe_f, axis=1)
         v_last = xp.take_along_axis(v, self.safe_l, axis=1)
         if value_shift is not None:
@@ -693,6 +696,15 @@ class TiledPrepared:
             v_first = v_first + value_shift
             v_last = v_last + value_shift
         return v, vg, v_first, v_last
+
+    def _gather_tiles(self, xp, mat):
+        """(S, C, pmax+1) covered-tile gather of a (S, N) matrix. The flat
+        form is one big take on the host; the row-local form (gidx_col)
+        keeps every gather inside its own series row, which is what lets
+        the mesh path shard the series axis with zero collectives."""
+        if self.gidx_col is not None:
+            return xp.take_along_axis(mat[:, None, :], self.gidx_col, axis=2)
+        return mat.reshape(-1)[self.gidx]
 
     def _window_sums(self, xp, tile_vals):
         from opengemini_tpu.ops import segment as seg
@@ -784,8 +796,8 @@ class TiledPrepared:
         if func in ("stddev", "stdvar"):
             # center on the per-series mean first (see over_time above: raw
             # v^2 prefixes cancel catastrophically for large magnitudes)
-            valid_cols = np.arange(self.N)[None, :] < self.counts[:, None]
-            series_n = np.maximum(self.counts, 1).astype(self.dtype)[:, None]
+            valid_cols = xp.arange(self.N)[None, :] < self.counts[:, None]
+            series_n = xp.maximum(self.counts, 1).astype(self.dtype)[:, None]
             vz_raw = xp.where(valid_cols, v, xp.zeros((), v.dtype))
             center = vz_raw.sum(axis=1, keepdims=True) / series_n
             vc = xp.where(self.ownmask, vg[:, :, 1:] - center[:, :, None],
@@ -837,7 +849,7 @@ class TiledPrepared:
         end (prom linearRegression), from tile partials of {v, t, t^2, tv}
         — the O(S*chunk*N) dense pass becomes four prefix lookups."""
         v, vg, _vf, _vl = self._vals(xp, values, value_shift)
-        tg = self.times.reshape(-1)[self.gidx][:, :, 1:].astype(self.dtype)
+        tg = self._gather_tiles(xp, self.times)[:, :, 1:].astype(self.dtype)
         z = xp.zeros((), vg.dtype)
         vz = xp.where(self.ownmask, vg[:, :, 1:], z)
         tz = xp.where(self.ownmask, tg, z)
@@ -858,6 +870,144 @@ class TiledPrepared:
         intercept = sv / denom_n - slope * (st / denom_n)
         has2 = self.has2 & (self.t_last > self.t_first)
         return slope, intercept, has2
+
+
+    def sharded(self, mesh) -> "ShardedTiled":
+        """The mesh view of this prepared state (cached per mesh object:
+        one sharding transfer per query however many kernels run)."""
+        cached = getattr(self, "_sharded_view", None)
+        if cached is not None and cached[0] is mesh:
+            return cached[1]
+        view = ShardedTiled(self, mesh)
+        self._sharded_view = (mesh, view)
+        return view
+
+
+# ---------------------------------------------------------------------------
+# Multi-chip tiled kernels: series-axis sharding over a device mesh.
+#
+# Every TiledPrepared tensor is either per-series (leading axis S: the
+# values/times matrices, the covered-tile gather and its masks, the
+# per-window prefix lookups and boundary-refinement gathers) or per-window
+# (the compact range positions ca/cb and the window edges). Series are
+# independent — no kernel ever combines two series rows — so sharding the
+# S axis partitions the WHOLE program with zero collectives, exactly the
+# GSPMD style of distributed.shard_leading_axis for the grid layout. The
+# boundary refinements (the straddling pair subtraction, first/last value
+# gathers) are row-local gathers and stay per-shard by construction once
+# the flat covered-tile gather is rewritten row-locally (gidx_col).
+# ---------------------------------------------------------------------------
+
+# per-series tensors (leading axis S — sharded over every mesh axis)
+_TILED_SHARD_ATTRS = (
+    "values", "counts", "times", "ownmask", "pairmask", "fmask",
+    "has1", "has2", "n_samp", "safe_f", "safe_l", "safe_fm1", "safe_lm1",
+    "t_first", "t_last", "t_lm1",
+)
+# per-window tensors (replicated: every shard answers all K windows for
+# its own series rows)
+_TILED_REPL_ATTRS = ("ca2", "cb2", "starts_rel", "ends_rel")
+
+
+class _TiledShardView(TiledPrepared):
+    """TiledPrepared stand-in rebuilt inside the jit trace: tensor
+    attributes are traced (sharded) arrays, statics are Python scalars.
+    The kernel methods run unmodified against it."""
+
+    def __init__(self):  # attrs are assigned by the trace, not prepared
+        pass
+
+
+class _PlanView:
+    __slots__ = ("win_tiles", "window_s")
+
+    def __init__(self, win_tiles: int, window_s: float):
+        self.win_tiles = win_tiles
+        self.window_s = window_s
+
+
+import functools as _functools  # noqa: E402  (kernel-cache only)
+
+
+@_functools.lru_cache(maxsize=128)
+def _sharded_tiled_jit(kernel: str, opts: tuple, meta: tuple):
+    """One compiled sharded program per (kernel, static opts, geometry).
+    Tensors arrive as a pytree argument (never closed over — constants
+    would be baked into the program) and carry their NamedSharding in;
+    GSPMD propagates it through every op."""
+    import jax
+
+    s_pad, n_cols, k_win, c_cov, pmax, dtype_str, win_tiles, window_s = meta
+    kwargs = dict(opts)
+
+    def fn(arrays):
+        view = _TiledShardView()
+        view.__dict__.update(arrays)
+        view.gidx = None  # force the row-local gather form
+        view.S, view.N, view.K = s_pad, n_cols, k_win
+        view.C, view.pmax = c_cov, pmax
+        view.dtype = np.dtype(dtype_str)
+        view.plan = _PlanView(win_tiles, window_s)
+        view._dev_values = arrays["values"]
+        return getattr(TiledPrepared, kernel)(view, jnp, **kwargs)
+
+    return jax.jit(fn)
+
+
+class ShardedTiled:
+    """Mesh execution of one TiledPrepared: per-series tensors device_put
+    with the series axis sharded (explicit NamedSharding, rows padded to a
+    multiple of mesh.size — padding rows carry all-False masks so they
+    answer as empty windows and are sliced off by the caller), per-window
+    tensors replicated. Kernel methods mirror TiledPrepared's but run as
+    one sharded jit program each; outputs are (S_pad, K)-sharded arrays
+    the caller slices to [:prep.S, :prep.k_real]."""
+
+    def __init__(self, prep: TiledPrepared, mesh):
+        import jax
+
+        from opengemini_tpu.parallel import distributed as dist
+
+        self.prep = prep
+        self.mesh = mesh
+        n_dev = mesh.size
+        self.S_pad = max(1, (prep.S + n_dev - 1) // n_dev * n_dev)
+        # row-local covered-tile gather: flat gidx minus its row offset
+        rows = (np.arange(prep.S, dtype=np.int64) * prep.N)[:, None, None]
+        gidx_col = (prep.gidx - rows).astype(np.int32)
+        series = {name: getattr(prep, name) for name in _TILED_SHARD_ATTRS}
+        series["gidx_col"] = gidx_col
+        sharded = dist.shard_leading_axis(mesh, *series.values())
+        self.arrays = dict(zip(series.keys(), sharded))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        for name in _TILED_REPL_ATTRS:
+            self.arrays[name] = jax.device_put(
+                np.asarray(getattr(prep, name)), repl)
+        self._meta = (self.S_pad, prep.N, prep.K, prep.C, prep.pmax,
+                      str(prep.dtype), prep.plan.win_tiles,
+                      float(prep.plan.window_s))
+
+    def _run(self, kernel: str, **opts):
+        fn = _sharded_tiled_jit(
+            kernel, tuple(sorted(opts.items())), self._meta)
+        return fn(self.arrays)
+
+    def rate(self, *, is_counter: bool, is_rate: bool):
+        return self._run("rate", is_counter=is_counter, is_rate=is_rate)
+
+    def instant_rate(self, *, per_second: bool):
+        return self._run("instant_rate", per_second=per_second)
+
+    def over_time(self, *, func: str):
+        return self._run("over_time", func=func)
+
+    def changes_resets(self, *, kind: str):
+        return self._run("changes_resets", kind=kind)
+
+    def linear_regression(self):
+        return self._run("linear_regression")
 
 
 class TileBudgetExceeded(ValueError):

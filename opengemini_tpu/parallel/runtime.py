@@ -4,21 +4,38 @@ When a mesh is set (server [device] config, or the driver's virtual-CPU
 dry run), the executor's aggregate batches go multi-chip: the dense
 layouts (models/grid.py, models/ragged.py) shard their independent row
 axes over the mesh — GSPMD partitions the dense kernels with zero
-collectives (distributed.shard_leading_axis) — and AggBatch's general
-path runs as a shard_map program with collective merges
-(distributed.build_batch_agg). With no mesh, everything runs
+collectives (distributed.shard_leading_axis) — the tiled PromQL engine
+(ops/prom.py ShardedTiled) shards its series axis the same way, and
+AggBatch's general path runs as a shard_map program with collective
+merges (distributed.build_batch_agg). With no mesh, everything runs
 single-device exactly as before.
+
+Every mesh assignment bumps a process-wide EPOCH. Long-lived caches of
+mesh-sharded buffers (a frozen batch's ``mesh_arrays``, the colcache
+device tier) key on ``mesh_epoch()`` so a hot config reload that swaps
+the mesh mid-process can never serve shards laid out for a dead mesh —
+they reshard (donating the stale buffers) or rebuild on next access.
 """
 
 from __future__ import annotations
 
 _mesh = None
+_mesh_epoch = 0
 
 
 def set_mesh(mesh) -> None:
-    global _mesh
+    global _mesh, _mesh_epoch
+    if mesh is not _mesh:
+        _mesh_epoch += 1
     _mesh = mesh
 
 
 def get_mesh():
     return _mesh
+
+
+def mesh_epoch() -> int:
+    """Identity token of the CURRENT mesh assignment. Caches holding
+    mesh-sharded device buffers must store it and treat a mismatch as
+    stale (the mesh object may be dead — its devices reassigned)."""
+    return _mesh_epoch

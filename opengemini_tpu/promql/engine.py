@@ -73,6 +73,19 @@ def _host_kernels() -> bool:
     return _backend_is_cpu()
 
 
+def _mesh_for_tiled():
+    """The configured device mesh when the tiled kernels should shard
+    their series axis over it (ops/prom.py ShardedTiled). A set mesh
+    overrides the host-kernel CPU shortcut — multi-chip execution is the
+    point of configuring one; OGT_PROM_MESH=0 opts the PromQL engine out
+    (grid/bucketed batches keep their own mesh paths)."""
+    if os.environ.get("OGT_PROM_MESH", "1") == "0":
+        return None
+    from opengemini_tpu.parallel import runtime as prt
+
+    return prt.get_mesh()
+
+
 @contextmanager
 def _stage(name: str):
     """Per-stage attribution: /debug/vars query_stages + the per-query
@@ -738,6 +751,41 @@ class PromEngine:
         kind = spec["kind"]
         with _stage("prom_prepare"):
             prep = self._tiled_prep(spec, t_ms_all, v_all, lens, eval_times, w)
+        mesh = _mesh_for_tiled() if prep is not None else None
+        if prep is not None and mesh is not None:
+            # multi-chip: series axis sharded over the mesh, one jit
+            # program per kernel (zero collectives); results sliced back
+            # to the real (S, k) window grid on the host
+            STATS.incr("prom", "tiled_mesh_kernels")
+            # sharding transfer attributed to the prepare stage (it is
+            # part of building this query's device state, and hiding it
+            # would make /debug/queries' stage sums lie about mesh cost).
+            # NOTE: like every device path here (the dense fallback
+            # included), the mesh kernels compute in the device dtype —
+            # f32 when jax x64 is off — while the host-numpy path is
+            # true f64 (README "Multi-chip execution").
+            with _stage("prom_prepare"):
+                sharded = prep.sharded(mesh)
+            with _stage("prom_kernel"):
+                if kind == "rate":
+                    out, valid = sharded.rate(
+                        is_counter=spec["is_counter"],
+                        is_rate=spec["is_rate"])
+                elif kind == "instant_rate":
+                    out, valid = sharded.instant_rate(
+                        per_second=spec["per_second"])
+                elif kind == "changes_resets":
+                    out, valid = sharded.changes_resets(kind=spec["which"])
+                elif kind == "deriv":
+                    out, _icept, valid = sharded.linear_regression()
+                elif kind == "predict":
+                    slope, icept, valid = sharded.linear_regression()
+                    out = icept + slope * spec["dur"]
+                else:
+                    out, valid = sharded.over_time(func=spec["func"])
+            kr = prep.k_real
+            return (np.asarray(out)[:prep.S, :kr],
+                    np.asarray(valid)[:prep.S, :kr])
         if prep is not None:
             STATS.incr("prom", "tiled_kernels")
             xp = np

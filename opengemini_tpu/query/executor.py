@@ -34,7 +34,6 @@ from opengemini_tpu.query import condition as cond
 from opengemini_tpu.query import functions as fnmod
 from opengemini_tpu.record import FieldType, FieldTypeConflict
 from opengemini_tpu.sql import ast
-from opengemini_tpu.parallel import runtime as prt
 from opengemini_tpu.storage import colcache as colcache_mod
 from opengemini_tpu.storage import scanpool
 from opengemini_tpu.storage.shard import FileQuarantined
@@ -1440,14 +1439,16 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
         # grid batches with a scan signature so their padded device
         # buffers are retained and a repeated identical scan skips the
         # host->device transfer (and the grid scatter). Local
-        # deterministic scans only — no remote peers, no device mesh.
+        # deterministic scans only — no remote peers. Under a device
+        # mesh the retained buffers are MESH-SHARDED (grid.py puts the
+        # cold grid straight into the sharded layout), so warm mesh
+        # queries skip the per-query shard_leading_axis copy entirely.
         device_token = None
         if (
             group_time is not None
             and self.router is None
             and ctx.live is None
             and colcache_mod.GLOBAL.device_enabled()
-            and prt.get_mesh() is None
         ):
             device_token = _device_scan_token(
                 db, rp, mst, sc, group_time, group_tags,

@@ -112,14 +112,21 @@ def _configure_device_mesh(dev_cfg: dict) -> None:
         # inherit one from an earlier build() in the same process
         prt.set_mesh(None)
         return
-    from opengemini_tpu.parallel import distributed as dist
-
-    n = int(dev_cfg.get("mesh-devices", 0)) or None
-    mesh = dist.make_mesh(n, tuple(axes))
+    mesh = _build_mesh(dev_cfg)
     prt.set_mesh(mesh)
     print(
         "device mesh: "
         f"{dict(zip(mesh.axis_names, mesh.devices.shape))}", flush=True)
+
+
+def _build_mesh(dev_cfg: dict):
+    """mesh-axes/mesh-devices -> a Mesh (the one [device] parsing shared
+    by boot and SIGHUP reload, so both always build the same geometry
+    for the same file)."""
+    from opengemini_tpu.parallel import distributed as dist
+
+    n = int(dev_cfg.get("mesh-devices", 0)) or None
+    return dist.make_mesh(n, tuple(dev_cfg.get("mesh-axes")))
 
 
 def build(cfg: dict) -> HttpService:
@@ -484,7 +491,38 @@ def _apply_runtime_config(svc: HttpService, cfg: dict) -> list[str]:
             changed.append(f"{s.name}.{attr}={new}")
     # NOTE: a shortened interval takes effect after the service's current
     # wait expires (the ticker re-reads interval_s each iteration)
+    changed.extend(_apply_mesh_config(cfg.get("device", {})))
     return changed
+
+
+def _apply_mesh_config(dev_cfg: dict) -> list[str]:
+    """Hot-apply a changed [device] mesh on SIGHUP. Safe now that every
+    sharded-buffer cache rekeys on runtime.mesh_epoch() (models/grid.py,
+    models/ragged.py) and the colcache device tier reshards retained
+    entries with the stale buffers donated — a live swap reshards, it
+    never serves a dead mesh. No-op when the effective mesh geometry is
+    unchanged (rebuilding an identical mesh would bump the epoch and
+    force every cache to reshard for nothing). Multi-host topology
+    (coordinator-address et al.) stays boot-only, like the reference's
+    runtimecfg."""
+    from opengemini_tpu.parallel import runtime as prt
+
+    axes = tuple(dev_cfg.get("mesh-axes") or ())
+    cur = prt.get_mesh()
+    if not axes:
+        if cur is None:
+            return []
+        prt.set_mesh(None)
+        return ["device.mesh=off"]
+    import jax
+
+    n = int(dev_cfg.get("mesh-devices", 0)) or len(jax.devices())
+    if cur is not None and tuple(cur.axis_names) == axes and cur.size == n:
+        return []
+    mesh = _build_mesh(dev_cfg)
+    prt.set_mesh(mesh)
+    return ["device.mesh="
+            + str(dict(zip(mesh.axis_names, mesh.devices.shape)))]
 
 
 def _ensure_device_backend(timeout_s: float = 20.0) -> None:

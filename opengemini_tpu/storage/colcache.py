@@ -45,7 +45,15 @@ Invalidation — every mutation of chunk identity:
                        generations of every open file
 Device-tier entries need no explicit invalidation: their keys embed the
 shard data_versions, so any content change keys a different entry and
-the stale one ages out of the LRU.
+the stale one ages out of the LRU.  Entries additionally record the
+device MESH they were sharded for (multi-chip execution,
+parallel/runtime.py): under a mesh the cold scan device_puts the padded
+grid straight into the sharded layout (one transfer, no replicated
+intermediate), warm scans reuse the sharded buffers with zero
+transfers, and a runtime.set_mesh() change reshards retained entries
+device-to-device with the stale buffers donated
+(parallel/distributed.py donate_reshard) instead of holding both
+layouts.
 
 Knobs (documented in README.md):
   OGT_COLCACHE_MB         host-tier decoded-bytes budget (0 disables the
@@ -106,6 +114,11 @@ class ColumnCache:
                  device: bool | None = None,
                  device_budget_mb: int | None = None):
         self._lock = lockdep.Lock()
+        # serializes device-tier relayouts: donation deletes the source
+        # buffers, so two threads chasing the same mesh swap must never
+        # both donate one entry's arrays (device compute stays OFF the
+        # main cache lock)
+        self._reshard_lock = lockdep.Lock()
         self._host: OrderedDict = OrderedDict()  # key -> (value, nbytes)
         self._by_gen: dict[int, set] = {}
         self._host_bytes = 0
@@ -289,10 +302,18 @@ class ColumnCache:
 
     # -- device tier ------------------------------------------------------
 
-    def device_get(self, token, shape, dtype: str):
+    def device_get(self, token, shape, dtype: str, mesh=None):
         """The retained device-grid entry for a scan signature, or None.
         Shape/dtype are verified defensively (the signature already pins
-        them; a mismatch is treated as a miss, never an error)."""
+        them; a mismatch is treated as a miss, never an error).
+
+        ``mesh`` is the caller's CURRENT layout decision (the configured
+        device mesh, or None for single-device). Entries are keyed by the
+        mesh they were sharded for; a hit laid out for a DIFFERENT mesh
+        (runtime.set_mesh changed — config reload) is resharded in place
+        device-to-device with the stale buffers DONATED
+        (distributed.donate_reshard), so the swap never re-decodes, never
+        re-transfers from host, and never holds both layouts resident."""
         if not self.device_enabled():
             return None
         t0 = time.perf_counter_ns()
@@ -304,17 +325,89 @@ class ColumnCache:
         if ent is not None and (ent["shape"] != tuple(shape)
                                 or ent["dtype"] != dtype):
             ent = None
+        if ent is not None and ent.get("mesh") is not mesh:
+            ent = self._device_reshard(token, ent, mesh)
         _STATS.incr("colcache",
                     "device_hits" if ent is not None else "device_misses")
         self._note_time(time.perf_counter_ns() - t0)
         return ent
 
-    def device_put_grid(self, token, vt, mt, shape, dtype: str):
+    def _device_reshard(self, token, ent, mesh):
+        """Relayout a retained entry onto ``mesh`` (None = single device),
+        donating the stale buffers. Returns the updated entry, or None
+        (drop -> miss) when the rows cannot shard evenly over the new
+        mesh — the caller then rebuilds from host rows at a compatible
+        padded shape.
+
+        Serialized by ``_reshard_lock`` and re-validated under the cache
+        lock so concurrent getters chasing one mesh swap never
+        double-donate the same buffers.  A query that took the entry
+        BEFORE the swap may still observe deleted buffers on backends
+        that implement donation — the inherent cost of a live mesh
+        reload, bounded to queries in flight at the admin event."""
+        from opengemini_tpu.parallel import distributed as _dist
+
+        with self._reshard_lock:
+            with self._lock:
+                got = self._dev.get(token)
+                live = got[0] if got is not None else None
+                if live is not ent:
+                    # replaced while we waited: usable only if the
+                    # replacement already fits the requested mesh
+                    return (live if live is not None
+                            and live.get("mesh") is mesh else None)
+                if ent.get("mesh") is mesh:
+                    return ent  # another thread finished the swap
+                arrays = [ent["vt"], ent["mt"]]
+                if ent.get("imat") is not None:
+                    arrays.append(ent["imat"])
+            rows = ent["shape"][0]
+            if mesh is not None and (rows < mesh.size or rows % mesh.size):
+                with self._lock:
+                    got = self._dev.get(token)
+                    if got is not None and got[0] is ent:
+                        del self._dev[token]
+                        self._dev_bytes -= got[1]
+                        self._publish_locked()
+                _STATS.incr("colcache", "device_reshard_drops")
+                return None
+            if mesh is not None:
+                spec = _dist.leading_axis_sharding(mesh, arrays[0].ndim)
+            else:
+                import jax
+
+                spec = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+            out = _dist.donate_reshard(spec, *arrays)
+            with self._lock:
+                ent["vt"], ent["mt"] = out[0], out[1]
+                if len(out) > 2:
+                    ent["imat"] = out[2]
+                elif ent.get("imat") is not None:
+                    # an imat attached between our snapshot and the swap
+                    # (device_add_imat racing the reshard) carries the
+                    # OLD mesh layout — drop it so the next selector
+                    # query rebuilds it sharded for the new mesh, and
+                    # give its bytes back to the budget
+                    stale = ent["imat"]
+                    ent["imat"] = None
+                    got = self._dev.get(token)
+                    if got is not None and got[0] is ent:
+                        self._dev[token] = (ent,
+                                            got[1] - int(stale.nbytes))
+                        self._dev_bytes -= int(stale.nbytes)
+                        self._publish_locked()
+                ent["mesh"] = mesh
+        _STATS.incr("colcache", "device_reshards")
+        return ent
+
+    def device_put_grid(self, token, vt, mt, shape, dtype: str, mesh=None):
         """Retain freshly transferred grid buffers; returns the entry
         (callers use the returned dict so concurrent puts converge on
-        one live object)."""
+        one live object). ``mesh`` records the layout the buffers were
+        sharded for (None = single device) — device_get reshards or
+        rebuilds when the process mesh changes."""
         ent = {"vt": vt, "mt": mt, "imat": None,
-               "shape": tuple(shape), "dtype": dtype}
+               "shape": tuple(shape), "dtype": dtype, "mesh": mesh}
         nb = int(vt.nbytes) + int(mt.nbytes)
         if not self.device_enabled() or nb > self._dev_budget:
             return ent  # still usable by the caller, just not retained
@@ -322,7 +415,8 @@ class ColumnCache:
             got = self._dev.get(token)
             if got is not None:
                 if (got[0]["shape"] == ent["shape"]
-                        and got[0]["dtype"] == ent["dtype"]):
+                        and got[0]["dtype"] == ent["dtype"]
+                        and got[0].get("mesh") is mesh):
                     self._dev.move_to_end(token)
                     return got[0]
                 # same token, different geometry (the defensive mismatch
@@ -335,11 +429,15 @@ class ColumnCache:
             self._publish_locked()
         return ent
 
-    def device_add_imat(self, token, ent, imat):
+    def device_add_imat(self, token, ent, imat, mesh=None):
         """Attach the lazily-built selector index grid to a retained
         entry. Returns the WINNING imat: a concurrent builder that lost
         the race gets the already-attached one, and the loser's bytes
-        are never double-counted against the device budget."""
+        are never double-counted against the device budget. ``mesh`` is
+        the layout the caller built ``imat`` for — if a concurrent
+        reshard moved the entry to a different mesh meanwhile, the
+        stale-layout imat is used caller-locally but never attached
+        (mixed-mesh entries would feed kernels incompatible devices)."""
         with self._lock:
             got = self._dev.get(token)
             if got is None or got[0] is not ent:
@@ -349,6 +447,8 @@ class ColumnCache:
                 return ent["imat"]
             if ent.get("imat") is not None:
                 return ent["imat"]
+            if ent.get("mesh") is not mesh:
+                return imat  # entry resharded since the caller's put
             ent["imat"] = imat
             self._dev[token] = (ent, got[1] + int(imat.nbytes))
             self._dev_bytes += int(imat.nbytes)
@@ -380,7 +480,8 @@ class ColumnCache:
             snap["entries"] = len(self._host)
             snap["device_entries"] = len(self._dev)
         for k in ("hits", "misses", "fills", "evictions", "invalidations",
-                  "device_hits", "device_misses", "time_ns"):
+                  "device_hits", "device_misses", "device_reshards",
+                  "device_reshard_drops", "time_ns"):
             snap.setdefault(k, 0)
         return snap
 
