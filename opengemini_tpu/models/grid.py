@@ -32,6 +32,7 @@ import functools
 import numpy as np
 
 from opengemini_tpu.models import ragged, templates
+from opengemini_tpu.utils import devobs
 from opengemini_tpu.utils.stats import GLOBAL as STATS
 
 # aggregates the grid path serves; others never get routed here
@@ -341,18 +342,26 @@ class GridBatch:
             return None
         return mesh
 
-    def _device_put(self, mesh, *arrays_np):
+    def _device_put(self, mesh, *arrays_np, xfer_site: str = "grid-shard"):
         """One explicit device_put per array, straight into the final
         layout: row-sharded over the mesh when configured (NamedSharding,
         parallel/distributed.py), plain single-device otherwise — never a
         replicated intermediate that a later reshard would re-copy."""
+        import time as _time
+
         import jax
 
         if mesh is not None:
             from opengemini_tpu.parallel import distributed as _dist
 
-            return _dist.shard_leading_axis(mesh, *arrays_np)
-        return tuple(jax.device_put(a) for a in arrays_np)
+            return _dist.shard_leading_axis(mesh, *arrays_np,
+                                            xfer_site=xfer_site)
+        t0 = _time.perf_counter_ns()
+        out = tuple(jax.device_put(a) for a in arrays_np)
+        devobs.note_transfer(
+            "h2d", xfer_site, sum(int(a.nbytes) for a in arrays_np),
+            (_time.perf_counter_ns() - t0) / 1e9)
+        return out
 
     def _device_arrays(self, with_imat: bool):
         st = self._state
@@ -377,7 +386,8 @@ class GridBatch:
             from opengemini_tpu.storage import colcache
 
             vt_np, mt_np = st["arrays"]
-            vt_d, mt_d = self._device_put(mesh, vt_np, mt_np)
+            vt_d, mt_d = self._device_put(mesh, vt_np, mt_np,
+                                          xfer_site="colcache-fill")
             ent = colcache.GLOBAL.device_put_grid(
                 self.device_cache_token, vt_d, mt_d,
                 shape=vt_np.shape, dtype=str(vt_np.dtype), mesh=mesh)
@@ -391,7 +401,8 @@ class GridBatch:
 
                     ent_mesh = ent.get("mesh")
                     (imat_d,) = self._device_put(
-                        ent_mesh, self._build_imat_np())
+                        ent_mesh, self._build_imat_np(),
+                        xfer_site="colcache-fill")
                     imat = colcache.GLOBAL.device_add_imat(
                         self.device_cache_token, ent, imat_d,
                         mesh=ent_mesh)
@@ -431,13 +442,24 @@ class GridBatch:
             if st.get("mesh_epoch") != epoch:
                 st.pop("mesh_arrays", None)
                 st.pop("mesh_imat", None)
+                devobs.LEDGER.drop(st.pop("ledger", None))
                 st["mesh_epoch"] = epoch
             if "mesh_arrays" not in st:
-                st["mesh_arrays"] = _dist.shard_leading_axis(mesh, vt, mt)
+                st["mesh_arrays"] = _dist.shard_leading_axis(
+                    mesh, vt, mt, xfer_site="grid-shard")
+                st["ledger"] = devobs.LEDGER.register(
+                    "grid_mesh", sum(int(a.nbytes)
+                                     for a in st["mesh_arrays"]),
+                    mesh_epoch=epoch, label="grid", anchor=self)
             vt, mt = st["mesh_arrays"]
             if with_imat:
                 if "mesh_imat" not in st:
-                    (st["mesh_imat"],) = _dist.shard_leading_axis(mesh, imat)
+                    (st["mesh_imat"],) = _dist.shard_leading_axis(
+                        mesh, imat, xfer_site="grid-shard")
+                    devobs.LEDGER.update(
+                        st.get("ledger"),
+                        sum(int(a.nbytes) for a in st["mesh_arrays"])
+                        + int(st["mesh_imat"].nbytes))
                 imat = st["mesh_imat"]
         return vt, mt, imat
 
@@ -446,9 +468,14 @@ class GridBatch:
         results (JAX dispatch is async — the host is free to keep
         decoding while the device reduces)."""
         vt, mt, imat = self._device_arrays(with_imat=(kind == "selectors"))
+        t0 = devobs.t0()
         if kind == "selectors":
-            return _grid_jit(vt.shape, str(vt.dtype), kind)(vt, mt, imat)
-        return _grid_jit(vt.shape, str(vt.dtype), kind)(vt, mt)
+            out = _grid_jit(vt.shape, str(vt.dtype), kind)(vt, mt, imat)
+        else:
+            out = _grid_jit(vt.shape, str(vt.dtype), kind)(vt, mt)
+        if t0:
+            devobs.note_exec(t0)
+        return out
 
     supports_want_sel = True
 
@@ -480,6 +507,7 @@ class GridBatch:
         st["flat"] = None
         st.pop("mesh_arrays", None)
         st.pop("mesh_imat", None)
+        devobs.LEDGER.drop(st.pop("ledger", None))
         self._vals = self._rel = self._seg = self._mask = self._sids = None
         self._bnds = None
 
@@ -497,10 +525,11 @@ class GridBatch:
                         "dropped the host arrays")
                 got = self._launch(kind)
             if kind == "ssd":
-                self._raw["ssd"] = np.asarray(got)[:S, : self.W]
+                self._raw["ssd"] = devobs.fetch_np(got)[:S, : self.W]
             else:
                 self._raw.update(
-                    {k: np.asarray(v)[:S, : self.W] for k, v in got.items()})
+                    {k: devobs.fetch_np(v)[:S, : self.W]
+                     for k, v in got.items()})
 
         if "count" not in self._raw:
             settle("basic")
@@ -652,7 +681,7 @@ def _grid_jit(shape: tuple, dtype: str, kind: str):
     import jax
     import jax.numpy as jnp
 
-    STATS.incr("device", "compile_cache_misses")
+    devobs.note_compile("grid_" + kind, (shape, dtype))
 
     if kind == "basic":
         # deliberately XLA, not the Pallas grid kernel: the recorded v5e

@@ -33,6 +33,7 @@ from __future__ import annotations
 import numpy as np
 
 from opengemini_tpu.models import templates
+from opengemini_tpu.utils import devobs
 
 _REL_LO_BITS = 30
 _REL_LO_MASK = (1 << _REL_LO_BITS) - 1
@@ -263,6 +264,7 @@ class _Bucket:
         self._combined: dict = {}
         self._mesh_arrays = None
         self._mesh_epoch = None
+        self._ledger = None
 
     def _device_arrays(self, mesh):
         """Matrices for the kernels: with a configured mesh, row-sharded
@@ -279,8 +281,14 @@ class _Bucket:
         if self._mesh_arrays is None or self._mesh_epoch != epoch:
             from opengemini_tpu.parallel import distributed as _dist
 
-            self._mesh_arrays = _dist.shard_leading_axis(mesh, *self.arrays)
+            devobs.LEDGER.drop(getattr(self, "_ledger", None))
+            self._mesh_arrays = _dist.shard_leading_axis(
+                mesh, *self.arrays, xfer_site="bucket-shard")
             self._mesh_epoch = epoch
+            self._ledger = devobs.LEDGER.register(
+                "bucket_mesh",
+                sum(int(a.nbytes) for a in self._mesh_arrays),
+                mesh_epoch=epoch, label="bucket", anchor=self)
         return self._mesh_arrays
 
     def _raw_stats(self, need_selectors: bool) -> dict:
@@ -295,11 +303,19 @@ class _Bucket:
         # buckets keep the fused Pallas kernel on TPU
         sel_kind = "selectors_xla" if arrays is not self.arrays else "selectors"
         if "count" not in self._raw:
+            t0 = devobs.t0()
             got = _stats_jit("basic")(*arrays)
-            self._raw.update({k: np.asarray(v)[: self.g] for k, v in got.items()})
+            if t0:
+                devobs.note_exec(t0)  # dispatch; fetch attributes below
+            self._raw.update({k: devobs.fetch_np(v)[: self.g]
+                              for k, v in got.items()})
         if need_selectors and "sel_first" not in self._raw:
+            t0 = devobs.t0()
             got = _stats_jit(sel_kind)(*arrays)
-            self._raw.update({k: np.asarray(v)[: self.g] for k, v in got.items()})
+            if t0:
+                devobs.note_exec(t0)
+            self._raw.update({k: devobs.fetch_np(v)[: self.g]
+                              for k, v in got.items()})
         return self._raw
 
     def combined(self, need_selectors: bool) -> dict:
@@ -405,6 +421,7 @@ def _stats_jit(kind: str):
     fn = _STATS_FNS.get(kind)
     if fn is not None:
         return fn
+    devobs.note_compile("bucket_" + kind)
     from opengemini_tpu.ops import pallas_segment
 
     if kind == "selectors" and pallas_segment.use_pallas():

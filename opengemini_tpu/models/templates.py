@@ -20,6 +20,7 @@ import numpy as np
 
 from opengemini_tpu.ops import window as winmod
 from opengemini_tpu.ops.aggregates import AggSpec
+from opengemini_tpu.utils import devobs
 from opengemini_tpu.utils.stats import GLOBAL as _STATS
 
 _REL_LO_BITS = 30
@@ -33,7 +34,8 @@ def compute_dtype() -> np.dtype:
 
 @functools.lru_cache(maxsize=512)
 def _jitted_build(fn, num_segments: int, params: tuple):
-    _STATS.incr("device", "compile_cache_misses")
+    devobs.note_compile("agg_batch",
+                        (fn.__name__, num_segments, params))
 
     @jax.jit
     def run(values, rel_hi, rel_lo, seg_ids, mask):
@@ -108,8 +110,9 @@ class AggBatch:
             mask[off : off + k] = m
             off += k
         self._padded = (values, rel_hi, rel_lo, seg_ids, mask)
-        _STATS.incr("device", "h2d_bytes",
-                    sum(a.nbytes for a in self._padded))
+        # the padded batch crosses to the device on the next kernel call
+        devobs.note_transfer("h2d", "agg-batch",
+                             sum(a.nbytes for a in self._padded))
         return self._padded
 
     def layout_name(self) -> str:
@@ -163,7 +166,7 @@ class AggBatch:
             seg_pad = winmod.pad_to(max(num_segments, 1), 256)
             arrays = self._concat_padded()
             counts, _ = _jitted(_count_fn, seg_pad, ())(*arrays)
-            got = np.asarray(counts)[:num_segments]
+            got = devobs.fetch_np(counts)[:num_segments]
             self._counts_cache[num_segments] = got
         return got
 
@@ -187,9 +190,15 @@ class AggBatch:
         arrays = self._concat_padded()
         fn = _jitted(spec.fn, seg_pad, tuple(params))
         _STATS.incr("device", "kernel_launches")
+        t0 = devobs.t0()
         out, sel = fn(*arrays)
-        out_np = np.asarray(out)[:num_segments]
-        sel_np = np.asarray(sel)[:num_segments] if sel is not None else None
+        if t0:
+            # dispatch only — the blocking fetch below attributes to
+            # device_transfer (fetch_np), never double-counted here
+            devobs.note_exec(t0)
+        out_np = devobs.fetch_np(out)[:num_segments]
+        sel_np = (devobs.fetch_np(sel)[:num_segments]
+                  if sel is not None else None)
         return out_np, sel_np, self.counts(num_segments)
 
     def _run_mesh(self, mesh, spec, num_segments: int):
@@ -210,7 +219,11 @@ class AggBatch:
             sharded = dist.shard_rows(
                 mesh, values, rel_hi, rel_lo, seg_ids, mask, gidx
             )
-            outs = {k: np.asarray(v) for k, v in fn(*sharded).items()}
+            t0 = devobs.t0()
+            got = fn(*sharded)
+            if t0:
+                devobs.note_exec(t0)  # dispatch; fetch attributes below
+            outs = {k: devobs.fetch_np(v) for k, v in got.items()}
             self._mesh_outs[cache_key] = outs
         out = outs[spec.name][:num_segments]
         sel = outs.get(spec.name + "_sel")

@@ -682,7 +682,18 @@ class TiledPrepared:
             return self.values
         dev = getattr(self, "_dev_values", None)
         if dev is None:
+            import time as _time
+
+            from opengemini_tpu.utils import devobs
+
+            t0 = _time.perf_counter_ns()
             dev = xp.asarray(self.values)
+            devobs.note_transfer(
+                "h2d", "prom-values", int(self.values.nbytes),
+                (_time.perf_counter_ns() - t0) / 1e9)
+            devobs.LEDGER.register(
+                "prom_dev_values", int(self.values.nbytes),
+                label="tiled-values", anchor=self)
             self._dev_values = dev
         return dev
 
@@ -937,6 +948,9 @@ def _sharded_tiled_jit(kernel: str, opts: tuple, meta: tuple):
     GSPMD propagates it through every op."""
     import jax
 
+    from opengemini_tpu.utils import devobs
+
+    devobs.note_compile("prom_" + kernel, (opts, meta))
     s_pad, n_cols, k_win, c_cov, pmax, dtype_str, win_tiles, window_s = meta
     kwargs = dict(opts)
 
@@ -977,7 +991,8 @@ class ShardedTiled:
         gidx_col = (prep.gidx - rows).astype(np.int32)
         series = {name: getattr(prep, name) for name in _TILED_SHARD_ATTRS}
         series["gidx_col"] = gidx_col
-        sharded = dist.shard_leading_axis(mesh, *series.values())
+        sharded = dist.shard_leading_axis(mesh, *series.values(),
+                                          xfer_site="prom-shard")
         self.arrays = dict(zip(series.keys(), sharded))
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -988,11 +1003,25 @@ class ShardedTiled:
         self._meta = (self.S_pad, prep.N, prep.K, prep.C, prep.pmax,
                       str(prep.dtype), prep.plan.win_tiles,
                       float(prep.plan.window_s))
+        from opengemini_tpu.utils import devobs
+        from opengemini_tpu.parallel import runtime as _prt
+
+        devobs.LEDGER.register(
+            "prom_sharded",
+            sum(int(a.nbytes) for a in self.arrays.values()),
+            mesh_epoch=_prt.mesh_epoch(), label="sharded-tiled",
+            anchor=self)
 
     def _run(self, kernel: str, **opts):
+        from opengemini_tpu.utils import devobs
+
         fn = _sharded_tiled_jit(
             kernel, tuple(sorted(opts.items())), self._meta)
-        return fn(self.arrays)
+        t0 = devobs.t0()
+        out = fn(self.arrays)
+        if t0:
+            devobs.note_exec(t0)
+        return out
 
     def rate(self, *, is_counter: bool, is_rate: bool):
         return self._run("rate", is_counter=is_counter, is_rate=is_rate)

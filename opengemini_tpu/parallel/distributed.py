@@ -297,19 +297,23 @@ def batch_agg_jit(mesh: Mesh, num_segments: int, sel_names: tuple = ()):
     key = (mesh, num_segments, sel_names)
     fn = _BATCH_AGG_CACHE.get(key)
     if fn is None:
+        from opengemini_tpu.utils import devobs
+
+        devobs.note_compile("mesh_batch_agg",
+                            (mesh.size, num_segments, sel_names))
         fn = _BATCH_AGG_CACHE[key] = build_batch_agg(
             mesh, num_segments, sel_names)
     return fn
 
 
-def shard_rows(mesh: Mesh, *arrays):
+def shard_rows(mesh: Mesh, *arrays, xfer_site: str = "agg-batch"):
     """Pad 1D row arrays to a multiple of the mesh size (padding masked
     out by callers via the mask array convention) and device_put them with
     the row sharding — the 1D special case of shard_leading_axis."""
-    return shard_leading_axis(mesh, *arrays)
+    return shard_leading_axis(mesh, *arrays, xfer_site=xfer_site)
 
 
-def shard_leading_axis(mesh: Mesh, *arrays):
+def shard_leading_axis(mesh: Mesh, *arrays, xfer_site: str = "mesh-shard"):
     """device_put matrices with their LEADING axis sharded over every mesh
     axis (remaining axes replicated per device). This is how the dense
     layouts (models/ragged.py bucket matrices, models/grid.py grids) go
@@ -323,6 +327,9 @@ def shard_leading_axis(mesh: Mesh, *arrays):
     Rows are padded (zeros -> masked out by the kernels' mask plane or
     sliced off by the [:g] caller convention) to a multiple of mesh.size.
     """
+    import time as _time
+
+    from opengemini_tpu.utils import devobs
     from opengemini_tpu.utils.stats import GLOBAL as _STATS
 
     n_dev = mesh.size
@@ -330,6 +337,7 @@ def shard_leading_axis(mesh: Mesh, *arrays):
     npad = (n + n_dev - 1) // n_dev * n_dev
     out = []
     nbytes = 0
+    t0 = _time.perf_counter_ns()
     for a in arrays:
         if npad != n:
             pad = np.zeros((npad - n,) + a.shape[1:], dtype=a.dtype)
@@ -341,6 +349,8 @@ def shard_leading_axis(mesh: Mesh, *arrays):
     # NOT repeat (the colcache device tier retains the sharded buffers);
     # the multichip bench asserts this counter is flat across warm runs
     _STATS.incr("device", "mesh_h2d_bytes", nbytes)
+    devobs.note_transfer("h2d", xfer_site, nbytes,
+                         (_time.perf_counter_ns() - t0) / 1e9)
     return tuple(out)
 
 
@@ -357,6 +367,9 @@ def _reshard_jit(out_shardings, avals):
     new one materializes — a mesh swap never holds both copies resident
     (donation is a no-op on backends that don't implement it, e.g. the
     CPU virtual mesh; the warning is suppressed at the call site)."""
+    from opengemini_tpu.utils import devobs
+
+    devobs.note_compile("reshard", avals)
     n = len(avals)
     return jax.jit(
         lambda *xs: xs,
@@ -376,19 +389,29 @@ def donate_reshard(target_sharding, *arrays):
     device set; a mesh shrink/grow (8 -> 4 devices) relayouts via
     jax.device_put instead — no donation there, the stale buffers free
     by refcount the moment the caller swaps them out."""
+    import time as _time
     import warnings
 
+    from opengemini_tpu.utils import devobs
     from opengemini_tpu.utils.stats import GLOBAL as _STATS
 
     _STATS.incr("device", "mesh_reshards")
+    nbytes = sum(int(a.nbytes) for a in arrays)
+    t0 = _time.perf_counter_ns()
     same_devices = all(
         set(a.sharding.device_set) == set(target_sharding.device_set)
         for a in arrays)
     if not same_devices:
-        return tuple(jax.device_put(a, target_sharding) for a in arrays)
+        out = tuple(jax.device_put(a, target_sharding) for a in arrays)
+        devobs.note_transfer("reshard", "reshard", nbytes,
+                             (_time.perf_counter_ns() - t0) / 1e9)
+        return out
     avals = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
     fn = _reshard_jit(target_sharding, avals)
     with warnings.catch_warnings():
         warnings.filterwarnings(
             "ignore", message=".*donated buffers were not usable.*")
-        return fn(*arrays)
+        out = fn(*arrays)
+    devobs.note_transfer("reshard", "reshard", nbytes,
+                         (_time.perf_counter_ns() - t0) / 1e9)
+    return out

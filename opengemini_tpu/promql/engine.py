@@ -784,8 +784,10 @@ class PromEngine:
                 else:
                     out, valid = sharded.over_time(func=spec["func"])
             kr = prep.k_real
-            return (np.asarray(out)[:prep.S, :kr],
-                    np.asarray(valid)[:prep.S, :kr])
+            from opengemini_tpu.utils import devobs
+
+            return (devobs.fetch_np(out)[:prep.S, :kr],
+                    devobs.fetch_np(valid)[:prep.S, :kr])
         if prep is not None:
             STATS.incr("prom", "tiled_kernels")
             xp = np
@@ -809,7 +811,10 @@ class PromEngine:
                 else:
                     out, valid = prep.over_time(xp, func=spec["func"])
             kr = prep.k_real
-            return (np.asarray(out)[:, :kr], np.asarray(valid)[:, :kr])
+            from opengemini_tpu.utils import devobs
+
+            return (devobs.fetch_np(out)[:, :kr],
+                    devobs.fetch_np(valid)[:, :kr])
         # dense fallback (searchsorted window bounds)
         STATS.incr("prom", "dense_kernels")
         with _stage("prom_prepare"):

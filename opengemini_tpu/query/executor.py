@@ -40,6 +40,7 @@ from opengemini_tpu.storage.shard import FileQuarantined
 from opengemini_tpu.storage.tsf import CorruptFile
 from opengemini_tpu.meta.users import AuthError as _AuthError
 from opengemini_tpu.storage.engine import WriteError
+from opengemini_tpu.utils import devobs
 from opengemini_tpu.utils import tracing
 from opengemini_tpu.utils.governor import GOVERNOR
 from opengemini_tpu.utils.querytracker import (GLOBAL as TRACKER,
@@ -1531,6 +1532,7 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
 
         # run aggregates on device
         agg_results = {}  # id(call) -> (values, sel, counts)
+        dv_before = devobs.span_snapshot() if devobs.enabled() else None
         with trace.span("device_compute") as sp:
             for call, spec, params, field_name in aggs:
                 TRACKER.check()  # kill between device batch dispatches
@@ -1648,6 +1650,18 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
                     "layouts", {f: b.layout_name() for f, b in batches.items()}
                 )
             STATS.incr("executor", "device_batches", len(aggs))
+            if dv_before is not None:
+                # devobs delta attribution (compiles + transfer bytes
+                # this span caused; concurrent queries can bleed in —
+                # the per-query exact time lands via the device_*
+                # tracker stages)
+                dv_after = devobs.span_snapshot()
+                for key in ("compiles", "h2d_bytes", "d2h_bytes",
+                            "reshard_bytes"):
+                    sp.add_field(key, dv_after[key] - dv_before[key])
+                sp.add_field("compile_wall_ms", round(
+                    dv_after["compile_wall_ms"]
+                    - dv_before["compile_wall_ms"], 3))
 
         has_remote_data = any(
             isinstance(sh, pcluster.MetaShard) for sh in shards

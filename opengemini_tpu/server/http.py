@@ -493,6 +493,13 @@ def _make_handler(svc: HttpService):
                 )
 
                 self._send_json(200, _TRACKER.full_snapshot())
+            elif path == "/debug/device":
+                # device-runtime telemetry (utils/devobs.py): device
+                # table, jit-cache inventory, retained-buffer ledger by
+                # owner, bounded recent-compile ring, capability probes
+                from opengemini_tpu.utils import devobs as _devobs
+
+                self._send_json(200, _devobs.debug_doc())
             elif path == "/debug/trace":
                 self._handle_debug_trace(self._params())
             elif path == "/debug/slow":
@@ -1285,6 +1292,54 @@ def _make_handler(svc: HttpService):
                     "slow_ms": slow["threshold_ms"],
                     "slow_max": slow["max_records"],
                     "slow_captured": slow["captured"],
+                })
+                return
+            elif mod == "devobs":
+                # device-runtime telemetry tuning: arm/disarm, warm-mark
+                # the recompile tripwire, clear the compile ring, and
+                # on-demand jax.profiler capture (single-capture guard).
+                # No knobs = status query.
+                from opengemini_tpu.utils import devobs as _devobs
+
+                if "arm" in params:
+                    _devobs.set_enabled(params["arm"] in ("1", "true"))
+                if params.get("clear", "") in ("1", "true"):
+                    _devobs.reset()
+                op = params.get("op", "")
+                if op == "mark_warm":
+                    _devobs.mark_warm()
+                elif op == "clear_warm":
+                    _devobs.clear_warm()
+                elif op == "profile":
+                    try:
+                        seconds = float(params.get("seconds", "2"))
+                    except ValueError:
+                        self._send_json(400, {
+                            "error": f"bad seconds "
+                                     f"{params.get('seconds')!r}"})
+                        return
+                    try:
+                        started = _devobs.start_profile(
+                            seconds, logdir=params.get("dir") or None)
+                    except RuntimeError as e:
+                        # capture already active (or backend refused):
+                        # 409 so retry loops back off instead of
+                        # stacking captures
+                        self._send_json(409, {"error": str(e)})
+                        return
+                    self._send_json(200, {"status": "ok",
+                                          "profile": started})
+                    return
+                elif op:
+                    self._send_json(400, {
+                        "error": f"unknown devobs op {op!r}"})
+                    return
+                self._send_json(200, {
+                    "status": "ok",
+                    "armed": _devobs.enabled(),
+                    "compiles_since_warm": _devobs.compiles_since_warm(),
+                    "ledger_bytes": _devobs.LEDGER.total_bytes(),
+                    "profile": _devobs.profile_status(),
                 })
                 return
             elif mod == "failpoint":

@@ -22,7 +22,7 @@ from opengemini_tpu.record import FieldType
 from opengemini_tpu.services.base import Service
 from opengemini_tpu.utils.stats import (GLOBAL as STATS, _RENAMES, _san,
                                         histograms_snapshot,
-                                        snapshot_percentile_s)
+                                        snapshot_percentile)
 
 INTERNAL_DB = "_internal"
 MONITOR_DB = "_monitor"
@@ -78,11 +78,21 @@ class MonitorService(Service):
                 continue
             tags = host_tag + tuple(
                 (str(k), str(v)) for k, v in labels)
-            points.append((_san(f"ogt_{name}"), tags, now, {
-                "p50": (FieldType.FLOAT, snapshot_percentile_s(hsnap, 50)),
-                "p99": (FieldType.FLOAT, snapshot_percentile_s(hsnap, 99)),
+            # p50/p99 in the family's own unit (seconds for latency
+            # families, raw bytes for the devobs transfer sizes); the
+            # sum field is named by unit so dashboards can't misread a
+            # byte total as seconds
+            seconds = hsnap.get("unit", "seconds") == "seconds"
+            fields = {
+                "p50": (FieldType.FLOAT, snapshot_percentile(hsnap, 50)),
+                "p99": (FieldType.FLOAT, snapshot_percentile(hsnap, 99)),
                 "count": (FieldType.INT, hsnap["count"]),
-                "sum_seconds": (FieldType.FLOAT, hsnap["sum_ns"] / 1e9),
-            }))
+            }
+            if seconds:
+                fields["sum_seconds"] = (FieldType.FLOAT,
+                                         hsnap["sum_ns"] / 1e9)
+            else:
+                fields["sum_bytes"] = (FieldType.INT, hsnap["sum_ns"])
+            points.append((_san(f"ogt_{name}"), tags, now, fields))
         if points:
             self.engine.write_rows(MONITOR_DB, points)

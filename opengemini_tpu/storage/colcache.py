@@ -77,6 +77,7 @@ from opengemini_tpu.utils import lockdep
 import time
 from collections import OrderedDict
 
+from opengemini_tpu.utils import devobs
 from opengemini_tpu.utils.governor import _env_int
 from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
 from opengemini_tpu.utils.stats import GLOBAL as _STATS
@@ -180,8 +181,7 @@ class ColumnCache:
             else:
                 self._evict_host_locked()
             if self._dev_budget <= 0 or not self.device_enabled():
-                self._dev.clear()
-                self._dev_bytes = 0
+                self._drop_dev_all_locked()
             else:
                 self._evict_dev_locked()
             self._publish_locked()
@@ -191,9 +191,14 @@ class ColumnCache:
             self._host.clear()
             self._by_gen.clear()
             self._host_bytes = 0
-            self._dev.clear()
-            self._dev_bytes = 0
+            self._drop_dev_all_locked()
             self._publish_locked()
+
+    def _drop_dev_all_locked(self) -> None:
+        for ent, _nb in self._dev.values():
+            devobs.LEDGER.drop(ent.pop("_ledger", None))
+        self._dev.clear()
+        self._dev_bytes = 0
 
     # -- host tier --------------------------------------------------------
 
@@ -368,6 +373,7 @@ class ColumnCache:
                     if got is not None and got[0] is ent:
                         del self._dev[token]
                         self._dev_bytes -= got[1]
+                        devobs.LEDGER.drop(ent.pop("_ledger", None))
                         self._publish_locked()
                 _STATS.incr("colcache", "device_reshard_drops")
                 return None
@@ -395,8 +401,12 @@ class ColumnCache:
                         self._dev[token] = (ent,
                                             got[1] - int(stale.nbytes))
                         self._dev_bytes -= int(stale.nbytes)
+                        devobs.LEDGER.update(ent.get("_ledger"),
+                                             got[1] - int(stale.nbytes))
                         self._publish_locked()
                 ent["mesh"] = mesh
+                devobs.LEDGER.update(ent.get("_ledger"),
+                                     mesh_epoch=self._mesh_epoch(mesh))
         _STATS.incr("colcache", "device_reshards")
         return ent
 
@@ -423,11 +433,25 @@ class ColumnCache:
                 # device_get treats as a miss): replace, never hand back
                 del self._dev[token]
                 self._dev_bytes -= got[1]
+                devobs.LEDGER.drop(got[0].pop("_ledger", None))
             self._dev[token] = (ent, nb)
             self._dev_bytes += nb
+            ent["_ledger"] = devobs.LEDGER.register(
+                "colcache_device", nb, mesh_epoch=self._mesh_epoch(mesh),
+                label=str(token)[:120])
             self._evict_dev_locked()
             self._publish_locked()
         return ent
+
+    @staticmethod
+    def _mesh_epoch(mesh):
+        """Ledger epoch stamp: the live mesh epoch for sharded entries,
+        None for single-device ones (not mesh-dependent)."""
+        if mesh is None:
+            return None
+        from opengemini_tpu.parallel import runtime as _prt
+
+        return _prt.mesh_epoch()
 
     def device_add_imat(self, token, ent, imat, mesh=None):
         """Attach the lazily-built selector index grid to a retained
@@ -452,6 +476,8 @@ class ColumnCache:
             ent["imat"] = imat
             self._dev[token] = (ent, got[1] + int(imat.nbytes))
             self._dev_bytes += int(imat.nbytes)
+            devobs.LEDGER.update(ent.get("_ledger"),
+                                 got[1] + int(imat.nbytes))
             self._evict_dev_locked()
             self._publish_locked()
         return imat
@@ -459,8 +485,9 @@ class ColumnCache:
     def _evict_dev_locked(self) -> None:
         n = 0
         while self._dev_bytes > self._dev_budget and self._dev:
-            _k, (_ent, nb) = self._dev.popitem(last=False)
+            _k, (ent, nb) = self._dev.popitem(last=False)
             self._dev_bytes -= nb
+            devobs.LEDGER.drop(ent.pop("_ledger", None))
             n += 1
         if n:
             _STATS.incr("colcache", "evictions", n)

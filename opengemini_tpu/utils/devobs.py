@@ -1,0 +1,709 @@
+"""Device-runtime telemetry: compile, transfer, and device-memory
+accounting for the accelerator tier.
+
+PR 8 made the HOST side observable (stitched traces, log2 histograms,
+/metrics, slow log); this module does the same for the device tier the
+multi-chip work built — jit compiles (models/templates.py, models/
+grid.py, models/ragged.py, ops/prom.py ShardedTiled, parallel/
+distributed.py), host<->device transfers (colcache fills, grid/bucket
+sharding, donate-resharding, result fetches), and retained device
+buffers (the colcache device tier, frozen-batch mesh arrays, the
+ShardedTiled caches).  Offload engines live or die by knowing exactly
+what transfer, compile, and residency cost each query pays (the
+GPU-offloading OLAP literature, arXiv:2601.19911); this is the
+instrumentation floor the decode-on-device roadmap item is judged
+against.
+
+Four concerns, one arming model (the PR 8 idiom — `OGT_DEVOBS=1`, or
+`/debug/ctrl?mod=devobs&arm=1` at runtime; results are bit-identical
+armed or not):
+
+  compile accounting   every jit lowering site calls note_compile() on
+      a program-cache miss.  ALWAYS cheap-counted (compiles are rare —
+      counters, the per-(kernel, geometry, mesh-epoch) inventory, the
+      bounded recent-compile ring, and the recompile TRIPWIRE run even
+      disarmed, replacing the old bare `device/compile_cache_misses`).
+      Armed additionally: backend compile WALL TIME via the
+      jax.monitoring duration events, attributed to the kernel label
+      and to the running query's `device_compile` stage.
+
+      The tripwire: mark_warm() (bench warm loops, or the ctrl op)
+      snapshots "everything is compiled now"; ANY lowering-site miss
+      after the mark increments `recompiles_after_warm_total` and flags
+      the ring entry — the classic silent 10x regression in jit systems
+      (shape churn, unstable cache keys, evicted programs).  Repeat
+      compiles of an already-seen (kernel, geometry, mesh-epoch) triple
+      are counted separately (`repeat_compiles_total`) with no mark
+      needed: the same program lowering twice always means a cache lost
+      an entry.
+
+  transfer accounting  note_transfer(direction, site, nbytes, seconds)
+      is the single chokepoint for h2d / d2h / reshard byte accounting
+      (it owns the `device/{h2d,d2h,reshard}_bytes` counters the ad-hoc
+      sites used to bump inline).  Armed additionally: per-site
+      `ogt_device_{h2d,d2h,reshard}_{bytes,seconds}` histograms and
+      `device_transfer` stage attribution.  fetch_np() wraps the
+      device->host materialization (np.asarray of a jax array) so
+      result fetches are labeled `result-fetch` — disarmed it is one
+      isinstance check over a plain np.asarray.
+
+  device-memory ledger every RETAINED device buffer registers (owner,
+      nbytes, mesh-epoch): the colcache device tier, grid `mesh_arrays`
+      / ragged `_Bucket._mesh_arrays` sharded copies, the ShardedTiled
+      per-query caches and TiledPrepared device values.  Entries anchor
+      to their holder via weakref.finalize, so a dropped batch can
+      never leak a ledger row; /debug/device answers "what is resident
+      and who owns it" by owner, and /metrics exports the gauges
+      (cross-checked against jax per-device memory_stats() where the
+      backend reports them — CPU does not).  Armed-only: register sites
+      check enabled(), so arm BEFORE the workload you want inventoried.
+
+  capability probes    backend_capabilities() answers what this jax
+      backend can actually run — today: Pallas support (probed by
+      executing a tiny real kernel from ops/pallas_segment.py).  The
+      tier-1 pallas suite skips-with-reason on backends where the probe
+      fails instead of reporting 12 undiagnosable failures, and fails
+      for real where it succeeds.
+
+An on-demand `jax.profiler` capture (start_profile / /debug/ctrl
+op=profile&seconds=N) rounds out the ops surface — single-capture
+guarded, writing a TensorBoard-loadable trace directory.
+
+Knobs (README "Device observability"): OGT_DEVOBS (1 = armed),
+OGT_DEVOBS_RING (recent-compile ring bound, default 256).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from collections import OrderedDict, deque
+from contextlib import contextmanager
+
+import numpy as _np
+
+from opengemini_tpu.utils import lockdep
+from opengemini_tpu.utils.stats import GLOBAL as _STATS
+
+_ON = os.environ.get("OGT_DEVOBS", "") in ("1", "true")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+_RING_MAX = max(16, _env_int("OGT_DEVOBS_RING", 256))
+
+# geometry-inventory bound per kernel: past this only the count grows
+# (a kernel compiling thousands of distinct geometries IS the finding)
+_GEOMETRIES_MAX = 512
+
+_lock = lockdep.Lock()
+_ring: deque = deque(maxlen=_RING_MAX)
+_inventory: dict[str, dict] = {}   # kernel -> {compiles, geometries: {},
+#                                    geometry_overflow, repeats}
+_warm_marked = False
+_compiles_since_warm = 0
+_compile_wall_ns = 0               # armed-only accumulation
+_started_pc = time.perf_counter()
+
+# thread-local label of the most recently built kernel: the backend
+# compile duration event fires on the SAME thread during the program's
+# first invocation, immediately after the lowering-site miss, so "last
+# built label on this thread" attributes it correctly for every
+# instrumented site (un-instrumented compiles attribute to "other")
+_tls = threading.local()
+
+_listener_registered = False
+
+
+def enabled() -> bool:
+    return _ON
+
+
+def set_enabled(on: bool) -> None:
+    global _ON
+    _ON = bool(on)
+    if _ON:
+        _ensure_listener()
+
+
+def _ensure_listener() -> None:
+    """Register the jax.monitoring compile-duration listener once (at
+    first arming — registration itself is idempotent-guarded here)."""
+    global _listener_registered
+    if _listener_registered:
+        return
+    _listener_registered = True
+    try:
+        import jax.monitoring as _mon
+
+        _mon.register_event_duration_secs_listener(_on_jax_duration)
+    except Exception:  # noqa: BLE001 — observability must not raise
+        pass
+
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _on_jax_duration(event: str, duration_s: float, **_kw) -> None:
+    if not _ON or event != _COMPILE_EVENT:
+        return
+    global _compile_wall_ns
+    ns = int(duration_s * 1e9)
+    kernel = getattr(_tls, "kernel", None) or "other"
+    with _lock:
+        _compile_wall_ns += ns
+        ent = getattr(_tls, "ring_entry", None)
+        if ent is not None and ent.get("kernel") == kernel:
+            ent["wall_ms"] = round(ent.get("wall_ms", 0.0) + ns / 1e6, 3)
+    from opengemini_tpu.utils.stats import observe_ns
+
+    observe_ns("device_compile_seconds", ns, kernel=kernel)
+    _note_stage("device_compile", ns)
+
+
+def _note_stage(name: str, ns: int) -> None:
+    """Attribute device time to the running query (tracker stages ->
+    /debug/queries + slow-log stages_ms) and the cumulative stage stats
+    (query_stages + the query_stage_seconds histogram)."""
+    from opengemini_tpu.utils import tracing
+    from opengemini_tpu.utils.querytracker import GLOBAL as _TRACKER
+
+    tracing.record_stage(name, ns)
+    _TRACKER.add_stage_ns(_TRACKER.current_qid(), name, ns)
+
+
+# per-(family, site) histogram cache: note_transfer is on the armed hot
+# path (every fetch/put), and the registry's get-or-create does a
+# sorted-tuple key build per call — cache the objects like every other
+# fixed-label call site does
+_hist_cache: dict[tuple, object] = {}
+
+
+def _hist(family: str, site: str, unit: str):
+    key = (family, site)
+    h = _hist_cache.get(key)
+    if h is None:
+        from opengemini_tpu.utils.stats import histogram
+
+        h = _hist_cache[key] = histogram(family, unit=unit, site=site)
+    return h
+
+
+# -- compile accounting -------------------------------------------------------
+
+
+def _mesh_epoch() -> int:
+    from opengemini_tpu.parallel import runtime as _prt
+
+    return _prt.mesh_epoch()
+
+
+def note_compile(kernel: str, geometry=()) -> None:
+    """Record one jit lowering-site program-cache MISS.  Called at every
+    site that builds a device program (templates._jitted_build, the grid
+    and bucket stat kernels, the ShardedTiled program cache, the mesh
+    batch-agg and reshard programs).  Always-on: compiles are rare, and
+    the inventory/tripwire is precisely the thing you need when the
+    system is misbehaving and nobody thought to arm anything."""
+    global _compiles_since_warm
+    geo = str(geometry)
+    epoch = _mesh_epoch()
+    _STATS.incr("device", "compiles_total")
+    _STATS.incr("device", "compile_cache_misses")  # pre-PR-14 spelling
+    entry = {
+        "kernel": kernel, "geometry": geo, "mesh_epoch": epoch,
+        "uptime_s": round(time.perf_counter() - _started_pc, 3),
+    }
+    with _lock:
+        inv = _inventory.get(kernel)
+        if inv is None:
+            inv = _inventory[kernel] = {
+                "compiles": 0, "geometries": OrderedDict(),
+                "geometry_overflow": 0, "repeats": 0}
+        inv["compiles"] += 1
+        key = (geo, epoch)
+        got = inv["geometries"].get(key)
+        if got is not None:
+            inv["geometries"][key] = got + 1
+            inv["repeats"] += 1
+            entry["repeat"] = True
+            _STATS.incr("device", "repeat_compiles_total")
+        elif len(inv["geometries"]) < _GEOMETRIES_MAX:
+            inv["geometries"][key] = 1
+        else:
+            inv["geometry_overflow"] += 1
+        if _warm_marked:
+            _compiles_since_warm += 1
+            entry["after_warm"] = True
+            _STATS.incr("device", "recompiles_after_warm_total")
+        _ring.append(entry)
+        _tls.kernel = kernel
+        _tls.ring_entry = entry
+
+
+def mark_warm() -> None:
+    """Arm the recompile tripwire: everything needed is compiled NOW;
+    any lowering-site miss from here on is a flagged recompile.  Bench
+    warm loops call this after their compile warmup; operators via
+    /debug/ctrl?mod=devobs&op=mark_warm once a service is warm."""
+    global _warm_marked, _compiles_since_warm
+    with _lock:
+        _warm_marked = True
+        _compiles_since_warm = 0
+
+
+def clear_warm() -> None:
+    global _warm_marked, _compiles_since_warm
+    with _lock:
+        _warm_marked = False
+        _compiles_since_warm = 0
+
+
+def compiles_since_warm() -> int:
+    """Lowering-site misses since mark_warm() (0 when never marked)."""
+    with _lock:
+        return _compiles_since_warm
+
+
+def jit_inventory() -> dict:
+    """Per-kernel program-cache view: compile counts, distinct
+    geometries (per mesh epoch), repeat compiles."""
+    with _lock:
+        return {
+            k: {
+                "compiles": v["compiles"],
+                "distinct_geometries": len(v["geometries"]),
+                "geometry_overflow": v["geometry_overflow"],
+                "repeat_compiles": v["repeats"],
+            }
+            for k, v in sorted(_inventory.items())
+        }
+
+
+def recent_compiles() -> list[dict]:
+    """Newest-first bounded ring of recent compiles with shapes."""
+    with _lock:
+        return [dict(e) for e in reversed(_ring)]
+
+
+# -- transfer accounting ------------------------------------------------------
+
+
+def note_transfer(direction: str, site: str, nbytes: int,
+                  seconds: float | None = None) -> None:
+    """The single chokepoint for device transfer accounting.  Always
+    owns the `device/{h2d,d2h,reshard}_bytes` counters; armed it adds
+    the per-site byte/latency histograms and attributes the wall to the
+    running query's `device_transfer` stage."""
+    nbytes = int(nbytes)
+    # counter spelled *_total so the unlabeled family name stays free
+    # for the per-site histogram of the same quantity
+    _STATS.incr("device", direction + "_bytes_total", nbytes)
+    if not _ON:
+        return
+    _hist("device_" + direction + "_bytes", site, "bytes").observe_ns(
+        nbytes)
+    if seconds is not None:
+        ns = int(seconds * 1e9)
+        _hist("device_" + direction + "_seconds", site,
+              "seconds").observe_ns(ns)
+        _note_stage("device_transfer", ns)
+
+
+def fetch_np(x, site: str = "result-fetch"):
+    """np.asarray with d2h accounting: device arrays count bytes (and,
+    armed, fetch wall time); host arrays pass straight through."""
+    import jax
+
+    if not isinstance(x, jax.Array):
+        return _np.asarray(x)
+    if not _ON:
+        a = _np.asarray(x)
+        note_transfer("d2h", site, a.nbytes)
+        return a
+    t0 = time.perf_counter_ns()
+    a = _np.asarray(x)
+    note_transfer("d2h", site, a.nbytes,
+                  (time.perf_counter_ns() - t0) / 1e9)
+    return a
+
+
+def t0() -> int:
+    """perf_counter_ns when armed, 0 disarmed — the one-branch guard
+    for exec-time attribution at kernel dispatch sites:
+
+        t = devobs.t0()
+        out = fn(*arrays)
+        if t:
+            devobs.note_exec(t)
+    """
+    return time.perf_counter_ns() if _ON else 0
+
+
+def note_exec(t0_ns: int) -> None:
+    """Attribute device-exec wall (dispatch + any blocking wait) since
+    ``t0_ns`` to the running query's `device_exec` stage."""
+    _note_stage("device_exec", time.perf_counter_ns() - t0_ns)
+
+
+def span_snapshot() -> dict:
+    """Cheap counters-only snapshot for per-span delta attribution (the
+    executor's device_compute span fields) and the bench device
+    blocks."""
+    snap = _STATS.counters("device")
+    with _lock:
+        wall = _compile_wall_ns
+    return {
+        "compiles": snap.get("compiles_total", 0),
+        "compile_wall_ms": round(wall / 1e6, 3),
+        "h2d_bytes": snap.get("h2d_bytes_total", 0),
+        "d2h_bytes": snap.get("d2h_bytes_total", 0),
+        "reshard_bytes": snap.get("reshard_bytes_total", 0),
+        "recompiles_after_warm": snap.get("recompiles_after_warm_total", 0),
+    }
+
+
+# -- device-memory ledger -----------------------------------------------------
+
+
+class DeviceLedger:
+    """Registry of retained device buffers: (owner, nbytes, mesh_epoch)
+    per entry.  Entries registered with an ``anchor`` drop automatically
+    when the anchor is collected — a per-query batch that dies
+    mid-flight can never leak a row.  The finalizer does NOT take the
+    ledger lock (a GC pass can fire finalizers inside a ledger method
+    that already holds it — dict mutation allocates); it appends the
+    handle to a lock-free deque drained at the next ledger operation.
+    Armed-only by the register sites' enabled() guard; register()
+    itself returns None disarmed so holders store-and-forget the
+    handle."""
+
+    def __init__(self) -> None:
+        self._lock = lockdep.Lock()
+        self._next = 1
+        self._entries: dict[int, dict] = {}
+        # GC-finalizer drop queue: deque.append is atomic and takes no
+        # lock, so it is safe to run at ANY allocation point
+        self._pending_drops: deque = deque()
+
+    def _drain_locked(self) -> None:
+        while True:
+            try:
+                handle = self._pending_drops.popleft()
+            except IndexError:
+                return
+            self._entries.pop(handle, None)
+
+    def register(self, owner: str, nbytes: int, mesh_epoch=None,
+                 label: str = "", anchor=None) -> int | None:
+        if not _ON:
+            return None
+        with self._lock:
+            self._drain_locked()
+            handle = self._next
+            self._next += 1
+            self._entries[handle] = {
+                "owner": owner, "nbytes": int(nbytes),
+                "mesh_epoch": mesh_epoch, "label": label,
+            }
+        if anchor is not None:
+            weakref.finalize(anchor, self._pending_drops.append, handle)
+        return handle
+
+    def update(self, handle: int | None, nbytes: int | None = None,
+               mesh_epoch=...) -> None:
+        if handle is None:
+            return
+        with self._lock:
+            self._drain_locked()
+            ent = self._entries.get(handle)
+            if ent is None:
+                return
+            if nbytes is not None:
+                ent["nbytes"] = int(nbytes)
+            if mesh_epoch is not ...:
+                ent["mesh_epoch"] = mesh_epoch
+
+    def drop(self, handle: int | None) -> None:
+        if handle is None:
+            return
+        with self._lock:
+            self._drain_locked()
+            self._entries.pop(handle, None)
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            self._drain_locked()
+            return sum(e["nbytes"] for e in self._entries.values())
+
+    def by_owner(self) -> dict:
+        """{owner: {bytes, entries, stale_epoch_entries}} — the
+        /debug/device residency answer.  An entry is stale when its
+        recorded mesh epoch no longer matches the live one (a buffer
+        laid out for a dead mesh, pending reshard or eviction)."""
+        live = _mesh_epoch()
+        out: dict[str, dict] = {}
+        with self._lock:
+            self._drain_locked()
+            for e in self._entries.values():
+                o = out.setdefault(e["owner"], {
+                    "bytes": 0, "entries": 0, "stale_epoch_entries": 0})
+                o["bytes"] += e["nbytes"]
+                o["entries"] += 1
+                if e["mesh_epoch"] is not None and e["mesh_epoch"] != live:
+                    o["stale_epoch_entries"] += 1
+        return out
+
+    def entries(self, limit: int = 256) -> list[dict]:
+        with self._lock:
+            self._drain_locked()
+            rows = sorted(self._entries.values(),
+                          key=lambda e: -e["nbytes"])[:limit]
+            return [dict(e) for e in rows]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._drain_locked()
+            self._entries.clear()
+
+
+LEDGER = DeviceLedger()
+
+
+def _ledger_gauges() -> dict:
+    """Stats provider: ledger residency gauges ride /debug/vars and
+    /metrics (module `device` -> ogt_device_ledger_* families) when
+    armed; {} pass-through disarmed, the governor-provider idiom."""
+    if not _ON:
+        return {}
+    out = {"ledger_bytes": LEDGER.total_bytes()}
+    for owner, doc in LEDGER.by_owner().items():
+        safe = "".join(c if c.isalnum() else "_" for c in owner.lower())
+        out["ledger_" + safe + "_bytes"] = doc["bytes"]
+        out["ledger_" + safe + "_entries"] = doc["entries"]
+    return out
+
+
+_STATS.register_provider("device", _ledger_gauges)
+
+
+# -- backend capabilities -----------------------------------------------------
+
+_caps_lock = lockdep.Lock()
+_caps: dict | None = None
+
+
+def backend_capabilities(probe: bool = True) -> dict:
+    """What this jax backend can actually run, probed once per process.
+    `pallas`: executes a tiny SELF-CONTAINED pallas_call (interpret mode
+    off-TPU, Mosaic on TPU) exercising the same backend capability the
+    product kernels need — an int-typed masked reduce stored into an
+    int32 out ref (exactly what breaks in interpret mode under x64 on
+    some jax versions).  Deliberately NOT one of the product kernels:
+    a regression in ops/pallas_segment.py must fail its tests, not
+    convert them into skips.
+
+    ``probe=False`` answers from the cache only (the /debug/device
+    handler must never run a compile inline on a serving thread)."""
+    global _caps
+    with _caps_lock:
+        if _caps is not None:
+            return _caps
+    if not probe:
+        return {"probed": False, "pallas": {
+            "supported": None,
+            "reason": "unprobed (pallas_supported() runs the probe)"}}
+    caps: dict = {"probed": True}
+    try:
+        import jax
+
+        caps["backend"] = jax.default_backend()
+        caps["device_count"] = len(jax.devices())
+    except Exception as e:  # noqa: BLE001 — a dead backend is an answer
+        caps["backend"] = None
+        caps["error"] = f"{type(e).__name__}: {e}"
+    ok, why = _probe_pallas()
+    caps["pallas"] = {"supported": ok, "reason": why}
+    with _caps_lock:
+        _caps = caps
+    return caps
+
+
+def _probe_pallas() -> tuple[bool, str]:
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kern(m_ref, cnt_ref):
+            # the product kernels' idiom: an integer reduce assigned
+            # into an int32 ref (widens to int64 under x64 interpret
+            # mode on affected jax versions — the capability gap)
+            cnt_ref[...] = (m_ref[...] != 0).sum(axis=1, keepdims=True)
+
+        m = _np.ones((8, 8), _np.int8)
+        out = pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct((8, 1), jnp.int32),
+            interpret=jax.default_backend() != "tpu",
+        )(m)
+        if int(_np.asarray(out)[0, 0]) != 8:
+            return False, "pallas probe kernel computed a wrong count"
+        return True, ""
+    except Exception as e:  # noqa: BLE001 — any failure = unsupported
+        return False, (f"pallas probe failed on this backend: "
+                       f"{type(e).__name__}: {e}")
+
+
+def pallas_supported() -> tuple[bool, str]:
+    """(supported, reason) — what tests/test_pallas.py gates on."""
+    cap = backend_capabilities()["pallas"]
+    return cap["supported"], cap["reason"]
+
+
+# -- on-demand profiler capture ----------------------------------------------
+
+_profile_lock = lockdep.Lock()
+_profile = {"active": False, "dir": None, "started_uptime_s": None,
+            "seconds": None, "last": None}
+
+
+def start_profile(seconds: float, logdir: str | None = None) -> dict:
+    """Start a single-capture-guarded jax.profiler trace for
+    ``seconds`` (clamped to [0.05, 120]); a background thread stops it.
+    Raises RuntimeError while a capture is already active.  Returns the
+    status dict (dir included) immediately — the trace directory is
+    TensorBoard / XProf loadable once `active` goes false."""
+    import tempfile
+
+    seconds = min(max(float(seconds), 0.05), 120.0)
+    with _profile_lock:
+        if _profile["active"]:
+            raise RuntimeError(
+                f"profiler capture already active in {_profile['dir']}")
+        if logdir is None:
+            logdir = tempfile.mkdtemp(prefix="ogt-devobs-profile-")
+        _profile.update(active=True, dir=logdir, seconds=seconds,
+                        started_uptime_s=round(
+                            time.perf_counter() - _started_pc, 3))
+    import jax
+
+    try:
+        jax.profiler.start_trace(logdir)
+    except Exception as e:  # noqa: BLE001 — surface, don't wedge the guard
+        with _profile_lock:
+            _profile.update(active=False,
+                            last={"dir": logdir, "ok": False,
+                                  "error": f"{type(e).__name__}: {e}"})
+        raise RuntimeError(f"profiler start failed: {e}") from e
+
+    def _stop():
+        time.sleep(seconds)
+        doc = {"dir": logdir, "seconds": seconds, "ok": True}
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            doc = {"dir": logdir, "seconds": seconds, "ok": False,
+                   "error": f"{type(e).__name__}: {e}"}
+        with _profile_lock:
+            _profile.update(active=False, last=doc)
+
+    threading.Thread(target=_stop, name="devobs-profile-stop",
+                     daemon=True).start()
+    return profile_status()
+
+
+def profile_status() -> dict:
+    with _profile_lock:
+        return dict(_profile)
+
+
+# -- /debug/device ------------------------------------------------------------
+
+
+def device_table() -> list[dict]:
+    """One row per jax device, with per-device memory stats where the
+    backend reports them (TPU/GPU; CPU answers null) — the cross-check
+    against the ledger's own residency accounting."""
+    try:
+        import jax
+
+        devs = jax.devices()
+    except Exception as e:  # noqa: BLE001
+        return [{"error": f"{type(e).__name__}: {e}"}]
+    out = []
+    for d in devs:
+        row = {"id": d.id, "platform": d.platform,
+               "device_kind": getattr(d, "device_kind", "")}
+        try:
+            row["memory_stats"] = d.memory_stats()
+        except Exception:  # noqa: BLE001 — optional per backend
+            row["memory_stats"] = None
+        out.append(row)
+    return out
+
+
+def debug_doc() -> dict:
+    """The GET /debug/device payload."""
+    from opengemini_tpu.parallel import runtime as _prt
+
+    mesh = _prt.get_mesh()
+    with _lock:
+        warm = {"marked": _warm_marked,
+                "compiles_since_warm": _compiles_since_warm}
+        wall_ms = round(_compile_wall_ns / 1e6, 3)
+    return {
+        "enabled": _ON,
+        # cache-only: the first debug scrape must never run the probe's
+        # kernel compile inline on a serving thread
+        "capabilities": backend_capabilities(probe=False),
+        "devices": device_table(),
+        "mesh": {"configured": mesh is not None,
+                 "size": getattr(mesh, "size", None),
+                 "epoch": _prt.mesh_epoch()},
+        "counters": _STATS.counters("device"),
+        "compile_wall_ms": wall_ms,
+        "jit_cache": jit_inventory(),
+        "recent_compiles": recent_compiles(),
+        "warm": warm,
+        "ledger": {
+            "total_bytes": LEDGER.total_bytes(),
+            "by_owner": LEDGER.by_owner(),
+            "entries": LEDGER.entries(),
+        },
+        "profile": profile_status(),
+    }
+
+
+def reset() -> None:
+    """Test/bench hygiene: clear the ring, inventory, warm mark, and
+    compile-wall accumulation (counters in the stats registry are the
+    registry's to reset)."""
+    global _compile_wall_ns
+    with _lock:
+        _ring.clear()
+        _inventory.clear()
+        _compile_wall_ns = 0
+    clear_warm()
+
+
+@contextmanager
+def armed(on: bool = True):
+    """Scoped arm/disarm (tests, bench A/B legs)."""
+    prev = _ON
+    set_enabled(on)
+    try:
+        yield
+    finally:
+        set_enabled(prev)
+
+
+if _ON:
+    _ensure_listener()

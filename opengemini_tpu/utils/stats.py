@@ -149,13 +149,21 @@ class Histogram:
     """Lock-cheap fixed-bucket latency histogram.  observe_ns computes
     the bucket outside the lock and holds it for three int updates; the
     lock is what makes concurrent counts EXACT (a bare `counts[i] += 1`
-    loses increments across bytecode boundaries under threads)."""
+    loses increments across bytecode boundaries under threads).
 
-    __slots__ = ("name", "labels", "_lock", "counts", "count", "sum_ns")
+    ``unit`` selects how the fixed 2^10..2^35 bounds export: "seconds"
+    (values are nanoseconds, le bounds and sum scale by 1e-9 — every
+    latency family) or "bytes" (values are raw bytes, bounds 1KiB..32GiB
+    export unscaled — the devobs transfer-size families)."""
 
-    def __init__(self, name: str, labels: tuple = ()):
+    __slots__ = ("name", "labels", "_lock", "counts", "count", "sum_ns",
+                 "unit")
+
+    def __init__(self, name: str, labels: tuple = (),
+                 unit: str = "seconds"):
         self.name = name
         self.labels = labels  # sorted ((k, v), ...) — family identity
+        self.unit = unit
         self._lock = lockdep.Lock()
         self.counts = [0] * (_NBOUNDS + 1)  # [+Inf] last
         self.count = 0
@@ -194,7 +202,7 @@ class Histogram:
     def snapshot(self) -> dict:
         with self._lock:
             return {"counts": list(self.counts), "count": self.count,
-                    "sum_ns": self.sum_ns}
+                    "sum_ns": self.sum_ns, "unit": self.unit}
 
     def percentile_s(self, q: float) -> float:
         return snapshot_percentile_s(self.snapshot(), q)
@@ -205,6 +213,14 @@ def snapshot_percentile_s(hsnap: dict, q: float) -> float:
     upper bound of the bucket holding the rank (overflow reports the
     last finite bound doubled).  Good to one log2 bucket — what the
     monitor service self-writes as p50/p99."""
+    return snapshot_percentile(dict(hsnap, unit="seconds"), q)
+
+
+def snapshot_percentile(hsnap: dict, q: float) -> float:
+    """Quantile in the histogram's own unit (seconds for latency
+    families, raw bytes for the devobs transfer-size families)."""
+    bounds = _BOUNDS_S if hsnap.get("unit", "seconds") == "seconds" \
+        else _BOUNDS_NS
     total = hsnap["count"]
     if total <= 0:
         return 0.0
@@ -213,25 +229,26 @@ def snapshot_percentile_s(hsnap: dict, q: float) -> float:
     for i, c in enumerate(hsnap["counts"]):
         acc += c
         if acc >= rank:
-            return _BOUNDS_S[i] if i < _NBOUNDS else _BOUNDS_S[-1] * 2
-    return _BOUNDS_S[-1] * 2
+            return bounds[i] if i < _NBOUNDS else bounds[-1] * 2
+    return bounds[-1] * 2
 
 
 _HIST_LOCK = lockdep.Lock()
 _HISTOGRAMS: dict[tuple, Histogram] = {}
 
 
-def histogram(name: str, **labels) -> Histogram:
+def histogram(name: str, unit: str = "seconds", **labels) -> Histogram:
     """Get-or-create the process-wide histogram for (name, labels).
     Call sites with fixed labels should cache the returned object —
-    observe_ns() itself is the hot path, not this lookup."""
+    observe_ns() itself is the hot path, not this lookup.  ``unit`` is
+    fixed at first creation (a family never changes units)."""
     key = (name, tuple(sorted(labels.items())))
     h = _HISTOGRAMS.get(key)
     if h is None:
         with _HIST_LOCK:
             h = _HISTOGRAMS.get(key)
             if h is None:
-                h = Histogram(name, key[1])
+                h = Histogram(name, key[1], unit=unit)
                 _HISTOGRAMS[key] = h
     return h
 
@@ -347,14 +364,17 @@ def render_prometheus(version: str = "") -> str:
             seen.add(fam)
             lines.append(f"# TYPE {fam} histogram")
             prev_fam = fam
+        seconds = hsnap.get("unit", "seconds") == "seconds"
+        bounds = _BOUNDS_S if seconds else _BOUNDS_NS
         acc = 0
         for i, c in enumerate(hsnap["counts"]):
             acc += c
             le = ("+Inf" if i == _NBOUNDS
-                  else repr(_BOUNDS_S[i]))
+                  else repr(bounds[i]) if seconds else str(bounds[i]))
             lab = _fmt_labels(tuple(labels) + (("le", le),))
             lines.append(f"{fam}_bucket{lab} {acc}")
         lab = _fmt_labels(labels)
-        lines.append(f"{fam}_sum{lab} {_fmt_val(hsnap['sum_ns'] / 1e9)}")
+        total = hsnap["sum_ns"] / 1e9 if seconds else hsnap["sum_ns"]
+        lines.append(f"{fam}_sum{lab} {_fmt_val(total)}")
         lines.append(f"{fam}_count{lab} {hsnap['count']}")
     return "\n".join(lines) + "\n"

@@ -243,6 +243,47 @@ def test_ogt050_metric_name_grammar(tmp_path):
         "bad-family", "bad-mod.k", "mod.Bad_Key"]
 
 
+def test_ogt010_devobs_knob_family(tmp_path):
+    """The ISSUE 14 knobs: OGT_DEVOBS* reads are OGT010 subjects like
+    every other knob family — documented spellings (incl. a wildcard)
+    pass, an undocumented sibling is a finding."""
+    root = _tree(tmp_path, {
+        "README.md": ("Device observability knobs: `OGT_DEVOBS`, "
+                      "`OGT_DEVOBS_RING`, `OGT_DEVOBS_X_*`.\n"),
+        "opengemini_tpu/utils/devobs_mod.py": (
+            "import os\n"
+            "a = os.environ.get('OGT_DEVOBS', '')\n"          # ok
+            "b = os.environ.get('OGT_DEVOBS_RING', '')\n"     # ok
+            "c = os.environ.get('OGT_DEVOBS_X_EXTRA', '')\n"  # wildcard ok
+            "d = os.environ.get('OGT_DEVOBS_SECRET', '')\n"   # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT010")
+    assert [f.detail for f in found] == ["OGT_DEVOBS_SECRET"]
+
+
+def test_ogt050_device_metric_family(tmp_path):
+    """The ogt_device_* family (ISSUE 14): counter keys, per-site
+    histogram families, and bytes-unit histograms all obey the metric
+    grammar; a dashed site name smuggled into a FAMILY name (labels are
+    free-form, family names are not) is a finding."""
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": (
+            "GLOBAL.incr('device', 'compiles_total')\n"          # ok
+            "GLOBAL.incr('device', 'h2d_bytes_total', 42)\n"     # ok
+            "GLOBAL.incr('device', 'recompiles_after_warm_total')\n"  # ok
+            "histogram('device_h2d_bytes', site='colcache-fill')\n"   # ok
+            "histogram('device_compile_seconds', kernel='grid_basic')\n"
+            "observe_ns('device_d2h_seconds', 5, site='result-fetch')\n"
+            "histogram('device_h2d-colcache-fill')\n"            # finding
+            "GLOBAL.incr('device', 'H2D_Bytes')\n"               # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT050")
+    assert sorted(f.detail for f in found) == [
+        "device.H2D_Bytes", "device_h2d-colcache-fill"]
+
+
 # -- baseline + output formats ------------------------------------------------
 
 
