@@ -961,6 +961,161 @@ def bench_colcache_warm(rows: int = 4_000_000, chunk: int = 16_384,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_device_decode_cold_scan(series: int = 96, points: int = 2400) -> dict:
+    """Decode on device (ISSUE 15): the SAME cold GROUP BY time() scan
+    over device-profile TSF data, host decode (`OGT_DEVICE_DECODE=0`)
+    vs fused device decode (`=1`), equality-gated in-bench.  The JSON
+    detail carries the compressed-vs-decoded H2D byte deltas
+    (`ogt_device_h2d_bytes_total` — the acceptance metric: the device
+    leg must transfer measurably fewer bytes), the per-stage
+    `device_transfer`/`device_exec` attribution, and the recompile
+    tripwire across a warm loop."""
+    import shutil
+    import tempfile
+
+    from opengemini_tpu.query.executor import Executor
+    from opengemini_tpu.storage import colcache
+    from opengemini_tpu.storage.engine import Engine
+    from opengemini_tpu.utils import devobs
+    from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+    import jax
+
+    from opengemini_tpu.ops import device_decode as devdec
+
+    NS = 1_000_000_000
+    base = 1_700_000_000
+    root = tempfile.mkdtemp(prefix="ogtpu-devdecode-")
+    cc = colcache.GLOBAL
+    prev_cc = cc.config()
+    prev_profile = os.environ.get("OGT_DEVICE_PROFILE")
+    prev_decode = os.environ.get("OGT_DEVICE_DECODE")
+    prev_armed = devobs.enabled()
+    # device decode requires x64 for bit-identity: enable it for this
+    # leg on CPU backends (restored in the finally); on TPU x64 stays
+    # off (f64 is software-emulated there) and the leg reports skipped
+    prev_x64 = bool(jax.config.jax_enable_x64)
+    if not prev_x64 and jax.default_backend() == "cpu":
+        jax.config.update("jax_enable_x64", True)
+    devdec._backend_ok.cache_clear()
+    rng = np.random.default_rng(15)
+    # the encoded path rides the BULK scan, which engages at >= 64
+    # series per shard (query/executor.py) — fewer would measure the
+    # per-series tail and trip the fused assert below
+    series = max(series, 64)
+    try:
+        if not devdec.active():
+            return {"skipped": "device decode inactive on this backend "
+                               "(requires jax x64)"}
+        os.environ["OGT_DEVICE_PROFILE"] = "1"
+        e = Engine(os.path.join(root, "data"), sync_wal=False)
+        e.create_database("db")
+        lines = []
+        for h in range(series):
+            vi = rng.integers(0, 240, points)
+            vf = np.round(rng.standard_normal(points) * 20 + 50, 6)
+            for p in range(points):
+                lines.append(
+                    f"cpu,host=h{h} vi={int(vi[p])}i,vf={vf[p]} "
+                    f"{(base + p * 10) * NS}")
+        e.write_lines("db", "\n".join(lines))
+        e.flush_all()
+        ex = Executor(e)
+        cc.configure(device=True)
+        devobs.set_enabled(True)  # per-site histograms + stage attribution
+        q = ("SELECT count(vi), min(vi), max(vi), mean(vf), sum(vf) "
+             "FROM cpu WHERE time >= %d AND time < %d GROUP BY time(1m)"
+             % (base * NS, (base + points * 10) * NS))
+
+        def leg(decode_flag: str) -> tuple:
+            os.environ["OGT_DEVICE_DECODE"] = decode_flag
+            cc.clear()
+            ex._inc_cache.clear()
+            dv0 = devobs.span_snapshot()
+            st0 = STATS.counters("query_stages")
+            t0 = time.perf_counter()
+            out = ex.execute(q, db="db")
+            dt = time.perf_counter() - t0
+            dv1 = devobs.span_snapshot()
+            st1 = STATS.counters("query_stages")
+            stages = {
+                k: round((st1.get(f"{k}_ns", 0) - st0.get(f"{k}_ns", 0))
+                         / 1e6, 3)
+                for k in ("device_transfer", "device_exec",
+                          "device_compile")}
+            return out, dv1["h2d_bytes"] - dv0["h2d_bytes"], dt, stages
+
+        decode_ctr0 = STATS.counters("device")  # this leg's deltas only
+        out_host, h2d_host, t_host, stages_host = leg("0")
+        fused0 = STATS.counters("executor").get("grid_decode_fused", 0)
+        out_dev, h2d_dev, t_dev, stages_dev = leg("1")
+        fused = STATS.counters("executor").get(
+            "grid_decode_fused", 0) - fused0
+        assert json.dumps(out_host, sort_keys=True) == \
+            json.dumps(out_dev, sort_keys=True), \
+            "device decode changed results"
+        assert fused >= 1, "fused device-decode path did not engage"
+        assert 0 < h2d_dev < h2d_host, (
+            f"device-decode H2D did not drop: {h2d_dev} vs {h2d_host}")
+        # warm loop under the recompile tripwire: identical repeats must
+        # reuse every program (and, with the device tier retaining the
+        # decoded grid, transfer nothing)
+        devobs.mark_warm()
+        dv0 = devobs.span_snapshot()
+        t_warm = float("inf")
+        for _ in range(3):
+            ex._inc_cache.clear()
+            t0 = time.perf_counter()
+            out_warm = ex.execute(q, db="db")
+            t_warm = min(t_warm, time.perf_counter() - t0)
+        recompiles = devobs.compiles_since_warm()
+        warm_h2d = devobs.span_snapshot()["h2d_bytes"] - dv0["h2d_bytes"]
+        devobs.clear_warm()
+        assert recompiles == 0, \
+            f"{recompiles} recompiles across warm device-decode loops"
+        assert json.dumps(out_warm, sort_keys=True) == \
+            json.dumps(out_dev, sort_keys=True)
+        decode_ctr = STATS.counters("device")
+        e.close()
+        return {
+            "rows": series * points,
+            "h2d_bytes_host_path": h2d_host,
+            "h2d_bytes_device_decode": h2d_dev,
+            "h2d_drop_x": round(h2d_host / max(h2d_dev, 1), 2),
+            "cold_ms_host": round(t_host * 1e3, 1),
+            "cold_ms_device_decode": round(t_dev * 1e3, 1),
+            "warm_ms": round(t_warm * 1e3, 1),
+            "warm_h2d_bytes": warm_h2d,
+            "stages_ms_host": stages_host,
+            "stages_ms_device_decode": stages_dev,
+            "fused_launches": fused,
+            "decode_payload_bytes": decode_ctr.get(
+                "decode_payload_bytes_total", 0) - decode_ctr0.get(
+                "decode_payload_bytes_total", 0),
+            "decode_fallbacks": decode_ctr.get(
+                "decode_fallbacks_total", 0) - decode_ctr0.get(
+                "decode_fallbacks_total", 0),
+            "recompiles_after_warm": recompiles,
+            "equality_ok": True,
+        }
+    finally:
+        devobs.set_enabled(prev_armed)
+        if prev_profile is None:
+            os.environ.pop("OGT_DEVICE_PROFILE", None)
+        else:
+            os.environ["OGT_DEVICE_PROFILE"] = prev_profile
+        if prev_decode is None:
+            os.environ.pop("OGT_DEVICE_DECODE", None)
+        else:
+            os.environ["OGT_DEVICE_DECODE"] = prev_decode
+        if bool(jax.config.jax_enable_x64) != prev_x64:
+            jax.config.update("jax_enable_x64", prev_x64)
+        devdec._backend_ok.cache_clear()
+        cc.configure(**prev_cc)
+        cc.clear()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_rollup_dashboard(rows: int = 2_000_000, series: int = 12,
                            span_s: int = 7200) -> dict:
     """Materialized-rollup dashboard speedup (storage/rollup.py +
@@ -2547,6 +2702,27 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     except Exception as e:  # noqa: BLE001 — bench must still emit
         print(f"bench: colcache warm failed: {e}", file=sys.stderr)
 
+    # decode on device (ISSUE 15): cold GROUP BY time() over
+    # device-profile data, host decode vs fused device decode —
+    # equality gated, H2D-drop asserted, tripwire-clean warm loop
+    device_decode = None
+    try:
+        device_decode = bench_device_decode_cold_scan(
+            series=int(os.environ.get("OGTPU_BENCH_DEVDECODE_SERIES",
+                                      "96")),
+            points=int(os.environ.get("OGTPU_BENCH_DEVDECODE_POINTS",
+                                      "2400")))
+        if device_decode.get("skipped"):
+            print("bench: device decode cold scan skipped: "
+                  + device_decode["skipped"], file=sys.stderr)
+        else:
+            _emit("device_decode_cold_scan_h2d_drop" + suffix,
+                  device_decode["h2d_drop_x"], "x",
+                  device_decode["h2d_drop_x"], {"detail": device_decode})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: device decode cold scan failed: {e}",
+              file=sys.stderr)
+
     # materialized-rollup dashboard splice: warm GROUP BY time(1m) via
     # rollup cells vs forced raw scan, equality asserted (the PR 7
     # acceptance metric: >= 5x) + maintenance lag gauge
@@ -2693,6 +2869,8 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
         extra["ingest_during_flush"] = ingest_flush
     if colcache_warm:
         extra["colcache_warm"] = colcache_warm
+    if device_decode:
+        extra["device_decode_cold_scan"] = device_decode
     if rollup_dash:
         extra["rollup_dashboard"] = rollup_dash
     if overload:

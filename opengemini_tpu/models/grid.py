@@ -50,6 +50,28 @@ _MAX_EXPANSION = 8
 _MAX_K = 8192
 
 
+class _EncodedVals:
+    """Array-like holder of one add_encoded() value column that is still
+    in its on-disk encoded blocks (record.EncodedColumn): the grid
+    freeze ships the raw payloads to the device decoder
+    (ops/device_decode.py); any host consumer — the bucketed fallback,
+    a scatter rebuild — decodes via __array__, the same numbers by
+    construction."""
+
+    __slots__ = ("col",)
+
+    def __init__(self, col):
+        self.col = col
+
+    def __len__(self):
+        return len(self.col)
+
+    def __array__(self, dtype=None, copy=None):
+        v = self.col.values
+        return np.asarray(v, dtype=dtype) if dtype is not None \
+            else np.asarray(v)
+
+
 class GridBatch:
     accepts_boundaries = True  # coalesced adds forward record breaks
 
@@ -84,7 +106,23 @@ class GridBatch:
         is independent, so a stager that concatenates records from
         different shards must keep equal sid values from fusing into one
         stride run."""
-        self._vals.append(np.asarray(values, dtype=self.dtype))
+        self._push(np.asarray(values, dtype=self.dtype), rel_ns, seg_ids,
+                   mask, times_ns, sids, boundaries)
+
+    def add_encoded(self, col, rel_ns, seg_ids, mask, times_ns, sids=None,
+                    boundaries=None):
+        """add() variant taking a still-encoded value column
+        (record.EncodedColumn): when EVERY add of the batch arrives
+        encoded, the freeze ships the raw block payloads to the device
+        and one jit program decodes, scatters, and reduces
+        (ops/device_decode.py); every fallback path decodes on the host
+        through the column's lazy .values — bit-identical either way."""
+        self._push(_EncodedVals(col), rel_ns, seg_ids, mask, times_ns,
+                   sids, boundaries)
+
+    def _push(self, vals, rel_ns, seg_ids, mask, times_ns, sids,
+              boundaries):
+        self._vals.append(vals)
         self._rel.append(np.asarray(rel_ns, dtype=np.int64))
         self._seg.append(np.asarray(seg_ids, dtype=np.int64))
         self._mask.append(np.asarray(mask, dtype=np.bool_))
@@ -218,8 +256,12 @@ class GridBatch:
             dev_entry = colcache.GLOBAL.device_get(
                 self.device_cache_token,
                 shape=(S_pad, k, W_pad), dtype=str(self.dtype), mesh=mesh)
+        enc_plan = None
         if dev_entry is None:
-            arrays = self._scatter_grid((S_pad, k, W_pad), flat)
+            enc_plan = self._encoded_plan((S_pad, k, W_pad), flat, mesh,
+                                          rel, bnd_idx, dt)
+            arrays = (None if enc_plan is not None
+                      else self._scatter_grid((S_pad, k, W_pad), flat))
         else:
             arrays = None
         run_gid = (seg[bnd_idx] // W).astype(np.int64)
@@ -232,6 +274,7 @@ class GridBatch:
         return {
             "k": k, "S": S, "W_pad": W_pad, "shape": (S_pad, k, W_pad),
             "arrays": arrays, "device_entry": dev_entry,
+            "encoded_plan": enc_plan,
             # imat (sample-index grid for the selector kernels) builds
             # lazily from `flat` — count/sum/mean scans never pay for it
             "imat": None, "flat": flat, "n": n,
@@ -310,6 +353,30 @@ class GridBatch:
                                                       num_segments)
             out2d[gids] = vals2d
         return out, sel, counts
+
+    def _encoded_plan(self, shape, flat, mesh, rel, starts, dt):
+        """Fused device-decode plan for a fully-encoded cold scan
+        (ops/device_decode.py), or None: every add must still carry its
+        encoded blocks, no device mesh may be configured (sharding the
+        decode output is future work — the host path shards as before),
+        and the decoder must accept every block.  None means the freeze
+        scatters on the host exactly as it always has."""
+        if not self._vals or mesh is not None:
+            return None
+        views = []
+        for v in self._vals:
+            col = getattr(v, "col", None)
+            if col is None or col.is_decoded:
+                return None
+            views.append((col.blocks, col.abs_segments(), col.n_full))
+        from opengemini_tpu.ops import device_decode
+
+        plan = device_decode.build_grid_plan(
+            views, flat, np.concatenate(self._mask), shape, self.dtype,
+            rel=rel, starts=starts, every_ns=self.every_ns, dt=dt)
+        if plan is None:
+            STATS.incr("executor", "grid_decode_fallbacks")
+        return plan
 
     def _scatter_grid(self, shape, flat):
         """Scatter the raw rows into the padded (S_pad, k, W_pad) grid:
@@ -400,9 +467,18 @@ class GridBatch:
                     from opengemini_tpu.storage import colcache
 
                     ent_mesh = ent.get("mesh")
-                    (imat_d,) = self._device_put(
-                        ent_mesh, self._build_imat_np(),
-                        xfer_site="colcache-fill")
+                    flat_dev = st.get("flat_dev")
+                    if flat_dev is not None and ent_mesh is None:
+                        # fused-decode entries keep their scatter slots
+                        # on device: build the selector grid there
+                        from opengemini_tpu.ops import device_decode
+
+                        imat_d = device_decode.imat_from_flat(
+                            flat_dev, st["shape"])
+                    else:
+                        (imat_d,) = self._device_put(
+                            ent_mesh, self._build_imat_np(),
+                            xfer_site="colcache-fill")
                     imat = colcache.GLOBAL.device_add_imat(
                         self.device_cache_token, ent, imat_d,
                         mesh=ent_mesh)
@@ -423,6 +499,9 @@ class GridBatch:
                     "grid device entry lost after prefetch dropped the "
                     "host rows (device mesh changed mid-query?)")
             st["arrays"] = self._scatter_grid(st["shape"], st["flat"])
+            # a pending fused-decode plan is superseded by the host
+            # scatter (encoded adds decode through _EncodedVals.__array__)
+            st["encoded_plan"] = None
         vt, mt = st["arrays"]
         imat = None
         if with_imat:
@@ -467,6 +546,39 @@ class GridBatch:
         """Dispatch one kernel group; returns unmaterialized device
         results (JAX dispatch is async — the host is free to keep
         decoding while the device reduces)."""
+        st = self._state
+        plan = st.get("encoded_plan")
+        if plan is not None and kind == "basic":
+            # fused cold path: compressed bytes -> device -> decode ->
+            # scatter -> basic reduce in ONE jit program; the decoded
+            # grid buffers come back for retention so ssd/selector
+            # kernels (and identically-signed future scans through the
+            # colcache device tier) reuse them without any transfer
+            from opengemini_tpu.ops import device_decode
+
+            stats, vt, mt, flat_d = device_decode.run_grid_plan(plan)
+            st["encoded_plan"] = None
+            ent = None
+            if self.device_cache_token is not None:
+                from opengemini_tpu.storage import colcache
+
+                ent = colcache.GLOBAL.device_put_grid(
+                    self.device_cache_token, vt, mt,
+                    shape=st["shape"], dtype=str(self.dtype), mesh=None)
+            if ent is None:
+                ent = {"vt": vt, "mt": mt, "imat": None,
+                       "shape": st["shape"], "dtype": str(self.dtype),
+                       "mesh": None}
+            # device-resident scatter slots, QUERY-scoped (on st, not
+            # the retained cache entry — the cache's budget/ledger
+            # accounting must not carry unaccounted buffers): this
+            # query's selector imat builds from them on device
+            # (device_decode.imat_from_flat) with no host grid
+            # transfer; warm repeats reuse the retained imat instead
+            st["flat_dev"] = flat_d
+            st["device_entry"] = ent
+            STATS.incr("executor", "grid_decode_fused")
+            return stats
         vt, mt, imat = self._device_arrays(with_imat=(kind == "selectors"))
         t0 = devobs.t0()
         if kind == "selectors":
@@ -519,7 +631,8 @@ class GridBatch:
         def settle(kind):
             got = pending.pop(kind, None)
             if got is None:
-                if st["arrays"] is None and st.get("device_entry") is None:
+                if (st["arrays"] is None and st.get("device_entry") is None
+                        and st.get("encoded_plan") is None):
                     raise RuntimeError(
                         f"grid kernel {kind!r} needed after prefetch "
                         "dropped the host arrays")

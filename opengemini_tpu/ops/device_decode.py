@@ -1,0 +1,627 @@
+"""Device-side decode of TSF device-profile blocks, fused into the grid
+aggregation data path.
+
+Cold scans used to pay CPU decode (zlib + delta reconstruction) and then
+a FULL-WIDTH host->device transfer of the padded grid — 8-byte values
+plus a mask byte for every padded cell.  This module moves the decode
+onto the accelerator for the block shapes that allow it ("GPU
+Acceleration of SQL Analytics on Compressed Data", arXiv:2506.10092;
+"Data Path Fusion", arXiv:2605.10511): the writer's device profile
+(storage/encoding.py, OGT_DEVICE_PROFILE=1) keeps int/float payloads in
+a raw envelope, the cold scan ships those encoded bytes (plus int32
+scatter slots and packed mask bits) to the device, and ONE jit program
+decodes, scatters into the (S_pad, k, W_pad) grid, and runs the basic
+window reduce — compressed-bytes -> decode -> group -> reduce with no
+decoded column ever materializing on the host.
+
+Decodable block kinds (encoding.DeviceBlock):
+
+  const   first + step * iota — pure header, zero payload bytes
+  delta   frame-of-reference deltas at fixed byte width: widen, +step,
+          int64 cumsum, +first (exactly the host decode_ints arithmetic,
+          so results are bit-identical)
+  raw64   little-endian float64 values: an 8-byte bitcast
+
+Everything else (zlib envelopes, gorilla, varint, bool/string blocks)
+keeps the host decode — EncodedColumn.values decodes lazily and the
+existing path runs unchanged.  `OGT_DEVICE_DECODE=0` disables this
+module entirely (bit-identical host path); x64 is required for
+bit-identity (int64 cumsum, f64 bitcast), so non-x64 backends answer
+inactive and fall back silently.
+
+The widen step routes through a Pallas kernel
+(ops/pallas_segment.widen_packed) for width-1/2 blocks where the
+backend supports Pallas (devobs.backend_capabilities probe + the
+use_pallas routing); the jnp bitcast path serves everywhere else.
+
+Program caching: one jitted program per static geometry (block
+signature, row count, grid shape, dtype, mask presence), registered
+with the devobs compile inventory — a warm loop repeating the same scan
+reuses the program, so the recompile tripwire stays clean.
+
+Counters (module `device`, /metrics `ogt_device_decode_*`):
+decode_blocks_total, decode_payload_bytes_total, decode_rows_total,
+decode_fallbacks_total.  Transfers land on the `device-decode` site of
+the `ogt_device_h2d_*` histograms via devobs.note_transfer.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+
+import numpy as np
+
+from opengemini_tpu.storage import encoding
+from opengemini_tpu.utils import devobs
+from opengemini_tpu.utils.stats import GLOBAL as _STATS
+
+# past this many blocks the unrolled decode program's compile time would
+# dominate what it saves; the host pool decode handles the long tail
+_MAX_BLOCKS = 256
+
+_XFER_SITE = "device-decode"
+
+
+def enabled() -> bool:
+    """The OGT_DEVICE_DECODE knob alone (README "Decode on device")."""
+    return os.environ.get("OGT_DEVICE_DECODE", "1") not in ("", "0")
+
+
+@functools.lru_cache(maxsize=1)
+def _backend_ok() -> bool:
+    """One-time probe: a live jax backend."""
+    try:
+        import jax
+
+        jax.devices()
+        return True
+    except Exception:  # noqa: BLE001 — no backend = host decode
+        return False
+
+
+def _x64_on() -> bool:
+    """Read the x64 flag FRESH every time — it is runtime-togglable,
+    and a stale cached True would run the int64 cumsum / f64 bitcast in
+    32-bit and silently diverge from the host path."""
+    try:
+        import jax
+
+        return bool(jax.config.jax_enable_x64)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def active() -> bool:
+    """Device decode usable in this process (knob + x64 + backend).
+    x64 is what makes the int64 cumsum and f64 bitcast bit-identical to
+    the host decoders."""
+    return enabled() and _x64_on() and _backend_ok()
+
+
+def classify(blocks) -> list | None:
+    """DeviceBlock views of every raw block buffer, or None when any
+    block (or the block count) is not device-decodable."""
+    if len(blocks) > _MAX_BLOCKS:
+        return None
+    out = []
+    for buf in blocks:
+        db = encoding.device_block(buf)
+        if db is None:
+            return None
+        out.append(db)
+    return out
+
+
+def _pack_blocks(dbs):
+    """(sig, payload, scalars) of classified DeviceBlocks — THE block
+    assembly every program entry point shares, so the jit cache key
+    (sig) can never desynchronize from the shipped bytes."""
+    sig = tuple((b.kind, b.n, b.width) for b in dbs)
+    payload = np.frombuffer(
+        b"".join(bytes(b.payload) for b in dbs), np.uint8)
+    scalars = np.array([[b.first, b.step] for b in dbs],
+                       np.int64).reshape(len(dbs), 2)
+    return sig, payload, scalars
+
+
+def note_fallback(n: int = 1) -> None:
+    """Count an eligible-looking encoded scan that ended up on the host
+    decode path anyway (ineligible blocks, mesh configured, knob off at
+    freeze time) — the triage counter for "why didn't H2D drop"."""
+    _STATS.incr("device", "decode_fallbacks_total", n)
+
+
+class GridPlan:
+    """Host-side inputs + static geometry of one fused decode->scatter->
+    reduce program invocation.  The scatter slots travel either as an
+    explicit int32 `flat` array (4 bytes/row) or — when every series run
+    is constant-stride and the window arithmetic verifies on the host —
+    as `runmeta` (rel0, stride, start_row) int64 triples plus one phase
+    scalar (~24 bytes/RUN), reconstructed on device."""
+
+    __slots__ = ("geom", "payload", "scalars", "viewruns", "flat",
+                 "runmeta", "consts", "maskbits", "n")
+
+    def __init__(self, geom, payload, scalars, viewruns, flat, runmeta,
+                 consts, maskbits, n):
+        self.geom = geom
+        self.payload = payload
+        self.scalars = scalars
+        self.viewruns = viewruns
+        self.flat = flat
+        self.runmeta = runmeta
+        self.consts = consts
+        self.maskbits = maskbits
+        self.n = n
+
+    def transfer_nbytes(self) -> int:
+        nb = int(self.payload.nbytes) + int(self.scalars.nbytes)
+        for a in (self.viewruns, self.flat, self.runmeta, self.consts,
+                  self.maskbits):
+            if a is not None:
+                nb += int(a.nbytes)
+        return nb
+
+
+def _affine_scatter(flat, rel, starts, every_ns, dt, k, w_pad):
+    """(runmeta, consts) when the scatter slots are reconstructible
+    on device from per-run scalars, else None.
+
+    Requirements, each VERIFIED on the host against the actual arrays
+    (vectorized int compares — far cheaper than the transfer they save):
+    every run's times are affine (rel0 + j*stride), and the window
+    ordinal follows one global phase: w == (rel - woff) // every.  Then
+    the device recomputes flat = (rid*k + (rel - w*every)//dt)*w_pad + w
+    exactly — any offset/edge subtlety just fails verification and the
+    plan ships the explicit flat array instead."""
+    n = len(rel)
+    runs = len(starts)
+    if n == 0 or runs == 0 or every_ns is None or not every_ns or not dt:
+        return None
+    lens = np.diff(np.append(starts, n))
+    rel0 = rel[starts]
+    stride = np.zeros(runs, np.int64)
+    multi = lens > 1
+    if multi.any():
+        d = np.diff(rel)
+        stride[multi] = d[starts[multi]]
+    rid = np.repeat(np.arange(runs, dtype=np.int64), lens)
+    j = np.arange(n, dtype=np.int64) - np.repeat(starts, lens)
+    if not np.array_equal(rel0[rid] + j * stride[rid], rel):
+        return None  # gaps / irregular spacing inside a run
+    w = flat % w_pad
+    # window phase: any valid woff satisfies woff + w*every <= rel <
+    # woff + (w+1)*every for EVERY row; the supremum of that interval,
+    # min(rel - w*every), is valid whenever any woff is — and the full
+    # verification below rejects the rest
+    woff = int((rel - w * every_ns).min())
+    if not np.array_equal((rel - woff) // every_ns, w):
+        return None
+    r = (rel - w * every_ns) // dt
+    if not np.array_equal((rid * k + r) * w_pad + w, flat):
+        return None
+    # (rel0, stride, start_row) per run — all DYNAMIC program inputs
+    # (~24 bytes/run): baking row offsets in as program constants would
+    # make every distinct series count a fresh multi-second compile
+    runmeta = np.stack([rel0, stride, starts.astype(np.int64)], axis=1)
+    return runmeta, np.array([woff], np.int64)
+
+
+def combine_views(views):
+    """Flatten per-column (blocks, segments, n_full) views into one
+    block list plus the absolute row runs of the combined view over the
+    combined decode (adjacent runs merged; None = identity).  Returns
+    (blocks, runs|None, n_view, n_full)."""
+    blocks: list = []
+    runs = []
+    base = 0
+    n_view = 0
+    for vb, segs, n_full in views:
+        blocks.extend(vb)
+        for a, b in np.asarray(segs, np.int64):
+            a, b = int(a) + base, int(b) + base
+            n_view += b - a
+            if runs and runs[-1][1] == a:
+                runs[-1][1] = b  # adjacent runs merge
+            else:
+                runs.append([a, b])
+        base += int(n_full)
+    if len(runs) == 1 and runs[0] == [0, base]:
+        return blocks, None, n_view, base  # identity view
+    return blocks, np.asarray(runs, np.int64), n_view, base
+
+
+def build_grid_plan(views, flat, mask, shape, dtype, rel=None,
+                    starts=None, every_ns=None, dt=None) -> GridPlan | None:
+    """Plan the fused program for one frozen grid: `views` are the
+    still-encoded value columns' (blocks, segments, n_full) triples in
+    row order, `flat` the host-computed scatter slots (injective,
+    < prod(shape)), `mask` the row validity.  `rel`/`starts`/
+    `every_ns`/`dt` (the freeze's run layout) enable the per-run scatter
+    reconstruction.  Returns None when the blocks are not
+    device-decodable or the transfer would not beat the decoded grid —
+    the caller host-decodes exactly as before."""
+    if not active():
+        return None
+    blocks, viewruns, n_view, n_full = combine_views(views)
+    dbs = classify(blocks)
+    if dbs is None:
+        note_fallback()
+        return None
+    if sum(b.n for b in dbs) != n_full or n_view != len(flat):
+        note_fallback()
+        return None  # defensive: blocks must cover the view exactly
+    n = n_view
+    sig, payload, scalars = _pack_blocks(dbs)
+    maskbits = None
+    if mask is not None and not mask.all():
+        maskbits = np.packbits(np.asarray(mask, np.bool_))
+    affine = None
+    if rel is not None and starts is not None:
+        affine = _affine_scatter(flat, rel, np.asarray(starts),
+                                 every_ns, dt, shape[1], shape[2])
+    if affine is not None:
+        runmeta, consts = affine
+        flat32 = None
+        nruns_affine = len(runmeta)
+    else:
+        runmeta, consts, nruns_affine = None, None, None
+        flat32 = np.ascontiguousarray(flat, np.int32)
+    geom = (sig, n, tuple(shape), np.dtype(dtype).str,
+            maskbits is not None, nruns_affine,
+            every_ns if nruns_affine else None,
+            dt if nruns_affine else None,
+            None if viewruns is None else len(viewruns))
+    plan = GridPlan(geom, payload, scalars, viewruns, flat32, runmeta,
+                    consts, maskbits, n)
+    # cost gate: the fused path must genuinely shrink the transfer below
+    # the decoded grid it replaces (values + mask bytes per padded cell)
+    if plan.transfer_nbytes() >= int(np.prod(shape)) * 9:
+        note_fallback()
+        return None
+    return plan
+
+
+def run_grid_plan(plan: GridPlan):
+    """Execute the fused program: one H2D of the encoded inputs (site
+    `device-decode`), then decode+scatter+reduce in a single jit program.
+    Returns ({count,sum,mean,min,max} device arrays, vt, mt, flat) —
+    vt/mt are the decoded grid buffers, ready for colcache device-tier
+    retention and the ssd/selector kernels; flat is the device-resident
+    scatter-slot vector (imat_from_flat builds the selector index grid
+    from it without a host round-trip)."""
+    import jax
+
+    t0 = time.perf_counter_ns()
+    inputs = [plan.payload, plan.scalars]
+    if plan.viewruns is not None:
+        inputs.append(plan.viewruns)
+    if plan.flat is not None:
+        inputs.append(plan.flat)
+    else:
+        inputs.extend((plan.runmeta, plan.consts))
+    if plan.maskbits is not None:
+        inputs.append(plan.maskbits)
+    dev = [jax.device_put(a) for a in inputs]
+    devobs.note_transfer("h2d", _XFER_SITE, plan.transfer_nbytes(),
+                         (time.perf_counter_ns() - t0) / 1e9)
+    _STATS.incr("device", "decode_blocks_total", len(plan.geom[0]))
+    _STATS.incr("device", "decode_payload_bytes_total",
+                int(plan.payload.nbytes))
+    _STATS.incr("device", "decode_rows_total", plan.n)
+    fn = _grid_program(plan.geom)
+    t = devobs.t0()
+    stats, vt, mt, flat = fn(*dev)
+    if t:
+        devobs.note_exec(t)
+    return stats, vt, mt, flat
+
+
+def imat_from_flat(flat_dev, shape):
+    """Selector index grid (sample ordinal per grid slot) from the
+    device-resident scatter slots a fused decode left behind — replaces
+    the host imat build + its full-grid transfer on the cold selector
+    path."""
+    return _imat_program(int(flat_dev.shape[0]), tuple(shape))(flat_dev)
+
+
+@functools.lru_cache(maxsize=256)
+def _imat_program(n: int, shape):
+    import jax
+    import jax.numpy as jnp
+
+    devobs.note_compile("grid_decode_imat", (n, shape))
+    cells = int(np.prod(shape))
+
+    def run(flat):
+        return jnp.zeros(cells, jnp.int32).at[flat].set(
+            jnp.arange(n, dtype=jnp.int32),
+            unique_indices=True).reshape(shape)
+
+    return jax.jit(run)
+
+
+def decode_to_device(blocks, dtype=None):
+    """Standalone device decode of raw block buffers -> one device value
+    vector (int64/float64, or `dtype` when given).  The non-fused entry
+    point: tests assert bit-identity against the host decoders with it,
+    and column-shaped consumers can device_put encoded bytes directly."""
+    import jax
+
+    dbs = classify(blocks)
+    if dbs is None:
+        raise ValueError("blocks are not device-decodable")
+    out_dtype = np.dtype(dtype) if dtype is not None else (
+        np.dtype(np.float64) if any(b.kind == "raw64" for b in dbs)
+        else np.dtype(np.int64))
+    sig, payload, scalars = _pack_blocks(dbs)
+    t0 = time.perf_counter_ns()
+    payload_d, scalars_d = jax.device_put(payload), jax.device_put(scalars)
+    devobs.note_transfer(
+        "h2d", _XFER_SITE, int(payload.nbytes) + int(scalars.nbytes),
+        (time.perf_counter_ns() - t0) / 1e9)
+    return _decode_program(sig, out_dtype.str)(payload_d, scalars_d)
+
+
+def materialize_enc(enc) -> np.ndarray:
+    """Host materialization of a (ftype, blocks, segments, slices)
+    encoded-column descriptor into the concatenated f64 sample vector —
+    the bit-identical fallback for consumers that need host values
+    (dense prom kernels, mesh sharding)."""
+    ftype, blocks, segments, slices = enc
+    d = encoding.decode_value_blocks(ftype, list(blocks)).astype(
+        np.float64)
+    if segments is not None:
+        d = (np.concatenate([d[a:b] for a, b in segments])
+             if len(segments) else d[:0])
+    if not slices:
+        return np.empty(0, np.float64)
+    if len(slices) == 1:
+        lo, hi = slices[0]
+        return d[lo:hi]
+    return np.concatenate([d[lo:hi] for lo, hi in slices])
+
+
+def decode_rows_matrix(enc, shape, dtype):
+    """Decode raw blocks ON device and lay the per-series sample slices
+    into a zero-padded (S, N) row matrix — the PromQL tiled kernels'
+    value matrix without the padded-f64 H2D (the transfer is the raw
+    payload + two ints per series).  `enc` is the (ftype, blocks,
+    segments, slices) descriptor (slices in VIEW coordinates).  Returns
+    the device matrix, or None when the blocks are not device-decodable
+    (caller host-materializes, bit-identically)."""
+    import jax
+
+    if not active():
+        return None
+    ftype, blocks, segments, slices = enc
+    dbs = classify(list(blocks))
+    if dbs is None:
+        note_fallback()
+        return None
+    n_full = sum(b.n for b in dbs)
+    if segments is None:
+        viewruns, n_view = None, n_full
+    else:
+        segments = np.asarray(segments, np.int64).reshape(-1, 2)
+        viewruns = segments
+        n_view = int((segments[:, 1] - segments[:, 0]).sum())
+        if len(segments) and (segments[:, 0] < 0).any() \
+                or len(segments) and (segments[:, 1] > n_full).any():
+            note_fallback()
+            return None
+    S, N = shape
+    lo = np.array([s[0] for s in slices], np.int64)
+    ln = np.array([s[1] - s[0] for s in slices], np.int64)
+    if len(slices) != S or (ln > N).any() or (lo < 0).any() \
+            or (lo + ln > n_view).any():
+        note_fallback()
+        return None
+    sig, payload, scalars = _pack_blocks(dbs)
+    host_in = [payload, scalars, lo, ln]
+    if viewruns is not None:
+        host_in.append(viewruns)
+    # cost gate: the encoded transfer must beat the padded value matrix
+    # it replaces (whole-block payloads can exceed a heavily trimmed
+    # view — raw64 floats have no width compression to amortize it)
+    if sum(int(a.nbytes) for a in host_in) >= \
+            S * N * np.dtype(dtype).itemsize:
+        note_fallback()
+        return None
+    t0 = time.perf_counter_ns()
+    dev = [jax.device_put(a) for a in host_in]
+    devobs.note_transfer(
+        "h2d", _XFER_SITE, sum(int(a.nbytes) for a in host_in),
+        (time.perf_counter_ns() - t0) / 1e9)
+    _STATS.incr("device", "decode_blocks_total", len(sig))
+    _STATS.incr("device", "decode_payload_bytes_total",
+                int(payload.nbytes))
+    _STATS.incr("device", "decode_rows_total", n_view)
+    fn = _rows_program(sig, n_view, (S, N), np.dtype(dtype).str,
+                       None if viewruns is None else len(viewruns))
+    t = devobs.t0()
+    out = fn(*dev)
+    if t:
+        devobs.note_exec(t)
+    return out
+
+
+@functools.lru_cache(maxsize=256)
+def _rows_program(sig, n: int, shape, dtype_str, nruns):
+    import jax
+    import jax.numpy as jnp
+
+    devobs.note_compile("prom_decode_rows", (len(sig), n, shape))
+    S, N = shape
+    out_dt = jnp.dtype(dtype_str)
+    decode = _decode_expr(sig, dtype_str)
+
+    def run(payload, scalars, lo, ln, viewruns=None):
+        if n == 0:
+            return jnp.zeros((S, N), out_dt)
+        vals = decode(payload, scalars)
+        if nruns is not None:
+            vals = _view_gather(vals, viewruns, n)
+        col = jnp.arange(N, dtype=jnp.int64)[None, :]
+        idx = jnp.clip(lo[:, None] + col, 0, n - 1)
+        m = col < ln[:, None]
+        return jnp.where(m, vals[idx], jnp.zeros((), out_dt))
+
+    return jax.jit(run)
+
+
+# -- jit program construction -------------------------------------------------
+
+
+def _view_gather(vals_full, viewruns, n_view: int):
+    """Gather a column VIEW (absolute [lo, hi) row runs) out of the
+    fully-decoded block concatenation, on device.  `viewruns` is the
+    dynamic (k, 2) run array; `n_view` is static."""
+    import jax.numpy as jnp
+
+    run_len = viewruns[:, 1] - viewruns[:, 0]
+    ends = jnp.cumsum(run_len)
+    pos = jnp.arange(n_view, dtype=jnp.int64)
+    rid = jnp.searchsorted(ends, pos, side="right")
+    start_out = ends - run_len
+    return vals_full[viewruns[rid, 0] + pos - start_out[rid]]
+
+
+def _widen(raw, width: int, cnt: int):
+    """(cnt*width,) LE bytes -> (cnt,) int64, matching the host
+    frombuffer(...).astype(int64) exactly (zero-extend below 8 bytes,
+    bit-reinterpretation at 8).  Width-1/2 blocks route through the
+    Pallas widen kernel where the backend supports it."""
+    import jax
+    import jax.numpy as jnp
+
+    if width in (1, 2) and _pallas_widen_ok():
+        from opengemini_tpu.ops import pallas_segment as ps
+
+        return ps.widen_packed(raw, width, cnt).astype(jnp.int64)
+    if width == 1:
+        return raw.astype(jnp.int64)
+    if width == 8:
+        # bitcast, not convert: uint64 values >= 2^63 must wrap to
+        # negative int64 exactly like numpy's astype
+        return jax.lax.bitcast_convert_type(
+            raw.reshape(cnt, 8), jnp.int64)
+    dt = {2: jnp.uint16, 4: jnp.uint32}[width]
+    return jax.lax.bitcast_convert_type(
+        raw.reshape(cnt, width), dt).astype(jnp.int64)
+
+
+def _pallas_widen_ok() -> bool:
+    from opengemini_tpu.ops import pallas_segment as ps
+
+    return ps.use_pallas() and devobs.pallas_supported()[0]
+
+
+def _decode_expr(sig, dtype_str):
+    """The unrolled per-block decode, shared by the standalone and fused
+    programs.  Returns a traced fn (payload, scalars) -> (n,) values in
+    `dtype_str`.  Offsets are static (they come from the signature), so
+    every slice lowers to a static-slice."""
+    import jax
+    import jax.numpy as jnp
+
+    out_dt = jnp.dtype(dtype_str)
+
+    def decode(payload, scalars):
+        pieces = []
+        off = 0
+        for i, (kind, bn, width) in enumerate(sig):
+            if bn == 0:
+                continue
+            first = scalars[i, 0]
+            step = scalars[i, 1]
+            if kind == "const":
+                piece = first + step * jnp.arange(bn, dtype=jnp.int64)
+            elif kind == "delta":
+                m = (bn - 1) * width
+                raw = jax.lax.slice(payload, (off,), (off + m,))
+                off += m
+                d = _widen(raw, width, bn - 1) + step
+                piece = jnp.concatenate(
+                    [first[None], first + jnp.cumsum(d)])
+            else:  # raw64
+                m = 8 * bn
+                raw = jax.lax.slice(payload, (off,), (off + m,))
+                off += m
+                piece = jax.lax.bitcast_convert_type(
+                    raw.reshape(bn, 8), jnp.float64)
+            pieces.append(piece.astype(out_dt))
+        if not pieces:
+            return jnp.zeros((0,), out_dt)
+        return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+    return decode
+
+
+@functools.lru_cache(maxsize=256)
+def _decode_program(sig, dtype_str):
+    import jax
+
+    devobs.note_compile("device_decode",
+                        (len(sig), sum(b[1] for b in sig), dtype_str))
+    return jax.jit(_decode_expr(sig, dtype_str))
+
+
+@functools.lru_cache(maxsize=256)
+def _grid_program(geom):
+    """One fused program per static geometry: decode the blocks, scatter
+    values+mask into the padded grid, and reduce the basic window stats
+    — the compressed-bytes->decode->group->reduce pipeline of the
+    data-path-fusion literature as a single XLA program."""
+    import jax
+    import jax.numpy as jnp
+
+    (sig, n, shape, dtype_str, has_mask, nruns_affine, every_ns, dt,
+     nruns) = geom
+    devobs.note_compile("grid_decode_fused",
+                        (len(sig), n, shape, dtype_str,
+                         nruns_affine is not None))
+    out_dt = jnp.dtype(dtype_str)
+    cells = int(np.prod(shape))
+    k, w_pad = shape[1], shape[2]
+    decode = _decode_expr(sig, dtype_str)
+
+    def scatter_slots(args):
+        if nruns_affine is None:
+            return args[0], args[1:]  # explicit flat
+        # runmeta rows: (rel0, stride, start_row) — all dynamic, so the
+        # program is free of run-count-sized constants
+        runmeta, consts = args[0], args[1]
+        starts_c = runmeta[:, 2]
+        ar = jnp.arange(n, dtype=jnp.int64)
+        rid = jnp.searchsorted(starts_c, ar, side="right") - 1
+        j = ar - starts_c[rid]
+        rel = runmeta[:, 0][rid] + j * runmeta[:, 1][rid]
+        w = (rel - consts[0]) // every_ns
+        r = (rel - w * every_ns) // dt
+        return ((rid * k + r) * w_pad + w).astype(jnp.int32), args[2:]
+
+    def run(payload, scalars, *rest):
+        from opengemini_tpu.ops import segment as seg
+
+        vals = decode(payload, scalars)
+        if nruns is not None:
+            vals = _view_gather(vals, rest[0], n)
+            rest = rest[1:]
+        flat, rest2 = scatter_slots(rest)
+        vt = jnp.zeros(cells, out_dt).at[flat].set(
+            vals, unique_indices=True).reshape(shape)
+        if has_mask:
+            shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+            bits = (rest2[0][:, None] >> shifts) & jnp.uint8(1)
+            mrow = bits.reshape(-1)[:n].astype(bool)
+        else:
+            mrow = jnp.ones((n,), bool)
+        mt = jnp.zeros(cells, bool).at[flat].set(
+            mrow, unique_indices=True).reshape(shape)
+        stats = seg.grid_window_agg_t(vt, mt)
+        return stats, vt, mt, flat
+
+    return jax.jit(run)

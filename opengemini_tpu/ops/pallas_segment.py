@@ -99,7 +99,10 @@ def _basic_kernel(v_ref, m_ref, cnt_ref, sum_ref, mean_ref, min_ref, max_ref, ss
     zero = jnp.zeros((), v.dtype)
     big = jnp.array(jnp.inf, v.dtype)
     vz = jnp.where(m, v, zero)
-    cnt = jnp.sum(m.astype(jnp.int32), axis=1, keepdims=True)
+    # explicit int32 result: under x64 the interpret-mode lowering widens
+    # integer reduces to int64, which an int32 out ref rejects ("Invalid
+    # dtype for swap") — the breakage devobs.backend_capabilities probes
+    cnt = jnp.sum(m.astype(jnp.int32), axis=1, keepdims=True).astype(jnp.int32)
     s = jnp.sum(vz, axis=1, keepdims=True)
     mean = s / jnp.maximum(cnt, 1).astype(v.dtype)
     dev = jnp.where(m, v - mean, zero)
@@ -229,8 +232,10 @@ def _sel_kernel(v_ref, hi_ref, lo_ref, idx_ref, m_ref,
         oh = jax.lax.broadcasted_iota(jnp.int32, mat.shape, 1) == jnp.broadcast_to(
             cols[:, None], mat.shape
         )
+        # keep the reduce at the ref dtype: x64 interpret mode widens
+        # integer sums to int64, which the int32 out refs reject
         return jnp.sum(jnp.where(oh, mat, jnp.zeros((), mat.dtype)),
-                       axis=1, keepdims=True)
+                       axis=1, keepdims=True).astype(mat.dtype)
 
     first_ref[...] = take(v, cf)
     last_ref[...] = take(v, cl)
@@ -284,7 +289,8 @@ def _grid_kernel(v_ref, m_ref, cnt_ref, sum_ref, mean_ref, min_ref, max_ref):
     zero = jnp.zeros((), v.dtype)
     big = jnp.array(jnp.inf, v.dtype)
     vz = jnp.where(m, v, zero)
-    cnt = jnp.sum(m.astype(jnp.int32), axis=1)
+    # int32 ref store under x64 interpret mode needs the explicit cast
+    cnt = jnp.sum(m.astype(jnp.int32), axis=1).astype(jnp.int32)
     s = jnp.sum(vz, axis=1)
     cnt_ref[...] = cnt
     sum_ref[...] = s
@@ -323,3 +329,38 @@ def grid_window_agg_t(values_t, mask_t):
     """Pallas variant of ops/segment.grid_window_agg_t: same (S, SPW, W)
     windows-on-lanes layout, all five stats from one VMEM residency."""
     return _grid_call(jnp.asarray(values_t), _as_i8(mask_t), interpret=_interpret())
+
+
+# -- packed-delta widen (device decode, ops/device_decode.py) ----------------
+
+
+def _widen_kernel(b_ref, out_ref):
+    """(cnt, width) LE bytes -> (cnt, 1) int32 little-endian combine.
+    int32 is exact for the width-1/2 blocks routed here; the explicit
+    astype keeps x64 interpret mode off int64 (the int32-ref rule)."""
+    b = b_ref[...]
+    acc = b[:, 0].astype(jnp.int32)
+    for j in range(1, b.shape[1]):
+        acc = acc + (b[:, j].astype(jnp.int32) << (8 * j))
+    out_ref[...] = acc[:, None].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("width", "cnt", "interpret"))
+def _widen_call(raw, *, width: int, cnt: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    out = pl.pallas_call(
+        _widen_kernel,
+        out_shape=jax.ShapeDtypeStruct((cnt, 1), jnp.int32),
+        interpret=interpret,
+    )(raw.reshape(cnt, width))
+    return out[:, 0]
+
+
+def widen_packed(raw, width: int, cnt: int):
+    """Widen `cnt` packed little-endian `width`-byte unsigned values to
+    int32 — the byte-combine step of the device-side FOR-delta decode
+    (ops/device_decode.py), as an explicit VMEM tile pass.  Callers
+    guarantee width in (1, 2) so int32 is exact."""
+    return _widen_call(jnp.asarray(raw), width=width, cnt=cnt,
+                       interpret=_interpret())

@@ -75,7 +75,10 @@ def prepare_matrix_runs(t_ms_all, v_all, lens, dtype=np.float32):
     S = len(lens)
     n_max = max(1, int(lens.max()) if S else 1)
     times = np.full((S, n_max), np.inf, dtype=np.float64)
-    values = np.zeros((S, n_max), dtype=dtype)
+    # v_all None = still-encoded values (TiledPrepared enc mode): only
+    # the time/count structure is prepared; the value matrix fills
+    # lazily (host fallback) or decodes on device (ops/device_decode)
+    values = None if v_all is None else np.zeros((S, n_max), dtype=dtype)
     total = int(lens.sum())
     starts = np.cumsum(lens) - lens
     base_ms = 0
@@ -87,7 +90,8 @@ def prepare_matrix_runs(t_ms_all, v_all, lens, dtype=np.float32):
         cols = np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
         flat = rows * n_max + cols
         times.reshape(-1)[flat] = (np.asarray(t_ms_all) - base_ms) / 1000.0
-        values.reshape(-1)[flat] = v_all
+        if values is not None:
+            values.reshape(-1)[flat] = v_all
     return times, values, lens.astype(np.int32), base_ms
 
 
@@ -561,10 +565,16 @@ class TiledPrepared:
 
     def __init__(self, plan: TilePlan, t_ms_all, v_all, lens,
                  dtype=np.float64, max_gather_cols: int | None = None,
-                 lane_quantum: int = 1):
+                 lane_quantum: int = 1, enc=None):
         lens = np.asarray(lens, np.int64)
         t_ms_all = np.asarray(t_ms_all, np.int64)
         self.plan = plan
+        # enc = (ftype, blocks, segments, slices): the value column is
+        # on-disk encoded blocks (device-decode cold path) — v_all may
+        # then be None and the (S, N) value matrix decodes on the DEVICE
+        # (_values_for -> ops/device_decode.decode_rows_matrix) or
+        # materializes lazily on the host (_host_values, bit-identical)
+        self._enc = enc if v_all is None else None
         self.dtype = np.dtype(dtype)
         S = len(lens)
         N = max(1, int(lens.max()) if S else 1)
@@ -675,24 +685,57 @@ class TiledPrepared:
 
     # -- kernel building blocks ------------------------------------------
 
+    def _host_values(self):
+        """The (S, N) value matrix on the host, materializing a
+        still-encoded column lazily (decode + the same flat scatter
+        prepare_matrix_runs does — bit-identical to the eager path)."""
+        if self.values is None:
+            from opengemini_tpu.ops import device_decode
+
+            v_all = device_decode.materialize_enc(self._enc)
+            values = np.zeros((self.S, self.N), dtype=self.dtype)
+            lens = np.asarray(self.counts, np.int64)
+            starts = np.cumsum(lens) - lens
+            rows = np.repeat(np.arange(self.S, dtype=np.int64), lens)
+            cols = np.arange(int(lens.sum()), dtype=np.int64) \
+                - np.repeat(starts, lens)
+            values.reshape(-1)[rows * self.N + cols] = v_all
+            self.values = values
+        return self.values
+
     def _values_for(self, xp):
         """The prepared value matrix in xp's array type (one cached device
-        copy for the traced path, so gathers run on device)."""
+        copy for the traced path, so gathers run on device).  A
+        still-encoded column decodes ON the device for the traced path —
+        the H2D carries the raw block payloads instead of the padded f64
+        matrix."""
         if xp is np:
-            return self.values
+            return self._host_values()
         dev = getattr(self, "_dev_values", None)
         if dev is None:
             import time as _time
 
             from opengemini_tpu.utils import devobs
 
+            if self.values is None:
+                from opengemini_tpu.ops import device_decode
+
+                dev = device_decode.decode_rows_matrix(
+                    self._enc, (self.S, self.N), self.dtype)
+                if dev is not None:
+                    devobs.LEDGER.register(
+                        "prom_dev_values", int(dev.nbytes),
+                        label="tiled-values-decoded", anchor=self)
+                    self._dev_values = dev
+                    return dev
+            mat = self._host_values()
             t0 = _time.perf_counter_ns()
-            dev = xp.asarray(self.values)
+            dev = xp.asarray(mat)
             devobs.note_transfer(
-                "h2d", "prom-values", int(self.values.nbytes),
+                "h2d", "prom-values", int(mat.nbytes),
                 (_time.perf_counter_ns() - t0) / 1e9)
             devobs.LEDGER.register(
-                "prom_dev_values", int(self.values.nbytes),
+                "prom_dev_values", int(mat.nbytes),
                 label="tiled-values", anchor=self)
             self._dev_values = dev
         return dev
@@ -989,7 +1032,9 @@ class ShardedTiled:
         # row-local covered-tile gather: flat gidx minus its row offset
         rows = (np.arange(prep.S, dtype=np.int64) * prep.N)[:, None, None]
         gidx_col = (prep.gidx - rows).astype(np.int32)
-        series = {name: getattr(prep, name) for name in _TILED_SHARD_ATTRS}
+        series = {name: (prep._host_values() if name == "values"
+                         else getattr(prep, name))
+                  for name in _TILED_SHARD_ATTRS}
         series["gidx_col"] = gidx_col
         sharded = dist.shard_leading_axis(mesh, *series.values(),
                                           xfer_site="prom-shard")
@@ -1046,11 +1091,12 @@ class TileBudgetExceeded(ValueError):
 
 
 def prepare_tiled(plan: TilePlan, t_ms_all, v_all, lens, dtype=np.float64,
-                  max_gather_cols: int | None = None, lane_quantum: int = 1):
+                  max_gather_cols: int | None = None, lane_quantum: int = 1,
+                  enc=None):
     """TiledPrepared or None (budget exceeded -> dense fallback)."""
     try:
         return TiledPrepared(plan, t_ms_all, v_all, lens, dtype=dtype,
                              max_gather_cols=max_gather_cols,
-                             lane_quantum=lane_quantum)
+                             lane_quantum=lane_quantum, enc=enc)
     except TileBudgetExceeded:
         return None

@@ -128,16 +128,27 @@ def _add_record_to_batches(rec, seg, aligned, needed_fields, batches, dtype,
         col = rec.columns.get(fname)
         if col is None:
             continue
-        if isinstance(batches[fname], ragged.IntExactBatch):
+        batch = batches[fname]
+        m = col.valid
+        if fmask is not None:
+            m = m & fmask
+        if (getattr(col, "is_decoded", True) is False
+                and hasattr(batch, "add_encoded")):
+            # still-encoded column (record.EncodedColumn) into a device-
+            # decode-capable batch: ship the raw block payloads — the
+            # grid freeze decodes them ON the accelerator, fused with
+            # the window reduce (ops/device_decode.py).  A row filter
+            # that touched this field already decoded it, so this branch
+            # only engages when the values were never needed on host.
+            batch.add_encoded(col, rel, seg, m, rec.times, sids=sids)
+            continue
+        if isinstance(batch, ragged.IntExactBatch):
             vals = col.values  # int64 end-to-end, no float cast
         elif col.ftype == FieldType.STRING:
             vals = np.zeros(len(rec), dtype=dtype)  # count-only path
         else:
             vals = col.values.astype(dtype)
-        m = col.valid
-        if fmask is not None:
-            m = m & fmask
-        batches[fname].add(vals, rel, seg, m, rec.times, sids=sids)
+        batch.add(vals, rel, seg, m, rec.times, sids=sids)
 
 
 

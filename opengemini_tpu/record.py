@@ -91,6 +91,119 @@ class Column:
         )
 
 
+class EncodedColumn(Column):
+    """Column whose values are still in their on-disk encoded blocks
+    (storage/encoding.py device-profile raw envelopes).
+
+    `.values` decodes lazily on the host — bit-identical to an eager
+    decode and memoized, so every existing consumer works unchanged.
+    Device-decode-aware consumers (models/grid.py GridBatch via
+    ops/device_decode.py) take `.blocks` — the raw self-describing block
+    buffers — and ship the encoded payloads to the accelerator instead.
+    `valid` is always a real (eagerly decoded) array: masks are tiny.
+
+    The column VIEW may be a row subset of the blocks' decoded
+    concatenation: `segments` is a (k, 2) int64 array of absolute
+    [lo, hi) row runs (None = the whole concatenation of `n_full`
+    rows).  A strictly-increasing take() — every time-range trim, sid
+    filter, and dedup keep over sorted rows — stays ENCODED by
+    composing run lists; anything else decodes, bit-identically.  The
+    device decoder replays the same runs after decoding whole blocks.
+
+    The column is immutable by the read-path contract like any cached
+    decoded column; the lazy decode is idempotent, so concurrent first
+    touches converge on identical arrays."""
+
+    # past this many row runs the per-run bookkeeping stops paying for
+    # itself; take() then just decodes
+    _SEG_CAP = 4096
+
+    def __init__(self, ftype: FieldType, blocks, valid: np.ndarray, decode,
+                 segments: np.ndarray | None = None,
+                 n_full: int | None = None):
+        self.ftype = ftype
+        self.blocks = list(blocks)
+        self.valid = valid
+        self.segments = segments
+        self.n_full = len(valid) if n_full is None else int(n_full)
+        self._decode = decode  # (ftype, blocks) -> np.ndarray host decode
+        self._values: np.ndarray | None = None
+
+    @property
+    def is_decoded(self) -> bool:
+        return self._values is not None
+
+    @property
+    def values(self) -> np.ndarray:  # type: ignore[override]
+        v = self._values
+        if v is None:
+            d = self._decode(self.ftype, self.blocks)
+            if self.segments is not None:
+                d = (np.concatenate([d[a:b] for a, b in self.segments])
+                     if len(self.segments) else d[:0])
+            v = self._values = d
+        return v
+
+    def __len__(self) -> int:
+        return len(self.valid)
+
+    def accounted_nbytes(self) -> int:
+        """Cache-budget accounting WITHOUT firing the lazy decode:
+        decoded width (8 bytes/value — only numeric ftypes are ever
+        encoded) plus the retained encoded payload, since both stay
+        live once a host consumer memoizes `.values`.  The single rule
+        both column caches (storage/colcache.py, storage/tsf.py)
+        charge by."""
+        return (len(self) * 8 + int(self.valid.nbytes)
+                + sum(len(b) for b in self.blocks))
+
+    def abs_segments(self) -> np.ndarray:
+        """The view's absolute [lo, hi) runs over the decoded block
+        concatenation ((k, 2) int64; identity view = one full run)."""
+        if self.segments is not None:
+            return self.segments
+        return np.array([[0, self.n_full]], np.int64)
+
+    def _abs_index(self) -> np.ndarray:
+        """Absolute row index per view row."""
+        segs = self.abs_segments()
+        return (np.concatenate([np.arange(a, b) for a, b in segs])
+                if len(segs) else np.empty(0, np.int64))
+
+    def take(self, idx: np.ndarray) -> "Column":
+        idx = np.asarray(idx)
+        if len(idx) == 0:
+            return Column(self.ftype,
+                          np.empty(0, dtype=self.ftype.np_dtype),
+                          np.empty(0, dtype=np.bool_))
+        if self.is_decoded or (
+                len(idx) > 1 and (np.diff(idx) <= 0).any()):
+            return super().take(idx)
+        abs_idx = self._abs_index()[idx]
+        brk = np.flatnonzero(np.diff(abs_idx) != 1)
+        if len(brk) + 1 > self._SEG_CAP:
+            return super().take(idx)
+        lo = np.concatenate([abs_idx[:1], abs_idx[brk + 1]])
+        hi = np.concatenate([abs_idx[brk], abs_idx[-1:]]) + 1
+        return EncodedColumn(
+            self.ftype, self.blocks, self.valid[idx], self._decode,
+            segments=np.stack([lo, hi], axis=1), n_full=self.n_full)
+
+    def concat(self, other: "Column") -> "Column":
+        if (isinstance(other, EncodedColumn) and not self.is_decoded
+                and not other.is_decoded and self.ftype == other.ftype):
+            segs = np.concatenate(
+                [self.abs_segments(),
+                 other.abs_segments() + self.n_full])
+            if len(segs) <= self._SEG_CAP:
+                return EncodedColumn(
+                    self.ftype, self.blocks + other.blocks,
+                    np.concatenate([self.valid, other.valid]),
+                    self._decode, segments=segs,
+                    n_full=self.n_full + other.n_full)
+        return super().concat(other)
+
+
 @dataclass
 class Record:
     """A batch of rows for one series (or one measurement slice): a time
@@ -140,6 +253,13 @@ class Record:
         """Stable sort by time. With duplicate timestamps the LAST occurrence
         wins on dedup (reference last-write-wins merge semantics,
         lib/record/merge.go)."""
+        if not descending and (
+                len(self) <= 1 or not (self.times[1:] < self.times[:-1]).any()):
+            # already ascending (every TSF chunk, most merged reads):
+            # records are immutable on the read path, so the identity
+            # return is safe — and it keeps lazily-encoded columns
+            # (EncodedColumn) intact for the device-decode path
+            return self
         order = np.argsort(self.times, kind="stable")
         if descending:
             order = order[::-1]
@@ -285,6 +405,10 @@ def _merge_bulk_sorted_fast(parts, lo_t: int, hi_t: int):
     cols = {}
     total = len(t_all)
     for name, ftype in ftypes.items():
+        enc = _concat_encoded(name, ftype, single, total)
+        if enc is not None:
+            cols[name] = enc
+            continue
         values = _zeroed(ftype, total)
         valid = np.zeros(total, dtype=np.bool_)
         at = 0
@@ -297,6 +421,33 @@ def _merge_bulk_sorted_fast(parts, lo_t: int, hi_t: int):
             at += m
         cols[name] = Column(ftype, values, valid)
     return sid_all, Record(t_all, cols)
+
+
+def _concat_encoded(name, ftype, single, total):
+    """Encoded-view concatenation for the sorted-fast merge: when every
+    part contributes this column as a still-encoded EncodedColumn, the
+    merged column composes their (possibly time-trimmed) row views — the
+    decoded bytes never materialize on the host (the device-decode cold
+    path, ops/device_decode.py).  Any decode, absence, or run-cap
+    overflow falls back to the copying path (bit-identical either
+    way)."""
+    merged = None
+    for _k, lo, hi, r in single:
+        col = r.columns.get(name)
+        if (not isinstance(col, EncodedColumn) or col.is_decoded
+                or col.ftype != ftype):
+            return None
+        view = col if (lo == 0 and hi == len(col)) \
+            else col.take(np.arange(lo, hi))
+        if not (isinstance(view, EncodedColumn) and not view.is_decoded):
+            return None  # run-cap overflow decoded the trim
+        merged = view if merged is None else merged.concat(view)
+        if not (isinstance(merged, EncodedColumn)
+                and not merged.is_decoded):
+            return None
+    if merged is None or len(merged) != total:
+        return None
+    return merged
 
 
 def merge_bulk_parts(

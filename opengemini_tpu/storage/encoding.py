@@ -17,6 +17,8 @@ import zlib
 
 import numpy as np
 
+import os
+
 from opengemini_tpu import native
 from opengemini_tpu.record import Column, FieldType
 
@@ -30,7 +32,72 @@ _T_GORILLA = 5  # float64 XOR-compressed (native C++ codec, py-decodable)
 _T_VARINT = 6  # int64 delta+zigzag varint (native C++ codec, py-decodable)
 _T_STRDICT = 7  # dictionary-coded strings: uniq table + min-width indices
 
+# device-profile flag bit on the tag byte: the payload is stored in its
+# RAW envelope (no zlib), so an accelerator kernel can decode the block
+# without a host round-trip (ops/device_decode.py).  Only _T_DELTA and
+# _T_RAW64 carry the flag (fixed-width FOR deltas and raw LE floats are
+# the device-decodable shapes); _T_CONST is device-decodable as-is (pure
+# header, an iota on device).  Written only under OGT_DEVICE_PROFILE=1;
+# readers decode flagged blocks unconditionally, so profile-written
+# files stay readable everywhere and legacy files are untouched.
+_DEV_FLAG = 0x80
+
 _ZLEVEL = 1
+
+_DELTA_HEAD = struct.calcsize("<BIqqB")
+
+
+def device_profile() -> bool:
+    """Writer-side device profile (OGT_DEVICE_PROFILE=1, README "Decode
+    on device"): int/float blocks keep their payloads in the raw
+    envelope (`_DEV_FLAG`) so cold scans can ship the encoded bytes
+    straight to the accelerator.  Trade: no zlib/gorilla/varint
+    second-stage compression on those blocks — FOR width-packing still
+    compresses ints; floats are stored at full width."""
+    return os.environ.get("OGT_DEVICE_PROFILE", "0") not in ("", "0")
+
+
+class DeviceBlock:
+    """Device-decodable view of one encoded block: the raw payload bytes
+    plus the scalar header the decode kernels need (ops/device_decode.py
+    builds its fused programs from these).  `kind` is one of:
+
+      const  int64 arithmetic run: first + step * iota(n); no payload
+      delta  int64 FOR deltas: out[0]=first, out[i]=first +
+             cumsum(widen(payload, width) + step); payload (n-1)*width
+      raw64  float64 raw LE values; payload n*8
+    """
+
+    __slots__ = ("kind", "n", "first", "step", "width", "payload")
+
+    def __init__(self, kind, n, first=0, step=0, width=0, payload=b""):
+        self.kind = kind
+        self.n = n
+        self.first = first
+        self.step = step
+        self.width = width
+        self.payload = payload
+
+
+def device_block(buf: bytes) -> DeviceBlock | None:
+    """Classify one self-describing block: a DeviceBlock when its values
+    can be decoded on the accelerator, None when only the host decoders
+    apply (zlib/gorilla/varint/bool/string payloads)."""
+    tag = buf[0]
+    if tag == _T_CONST:
+        _, n, first, stride = struct.unpack_from("<BIqq", buf)
+        return DeviceBlock("const", n, first, stride)
+    if tag == (_T_DELTA | _DEV_FLAG):
+        (n,) = struct.unpack_from("<I", buf, 1)
+        if n == 0:
+            return DeviceBlock("const", 0)
+        first, dmin, width = struct.unpack_from("<qqB", buf, 5)
+        return DeviceBlock("delta", n, first, dmin, width,
+                           buf[_DELTA_HEAD:])
+    if tag == (_T_RAW64 | _DEV_FLAG):
+        (n,) = struct.unpack_from("<I", buf, 1)
+        return DeviceBlock("raw64", n, payload=buf[5:])
+    return None
 
 
 def encode_ints(values: np.ndarray) -> bytes:
@@ -50,6 +117,10 @@ def encode_ints(values: np.ndarray) -> bytes:
     shifted = (deltas - dmin).astype(np.uint64)
     width = _min_width(int(shifted.max()))
     packed = shifted.astype({1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}[width])
+    if device_profile():
+        # raw envelope: FOR width-packing only, device-decodable
+        return struct.pack("<BIqqB", _T_DELTA | _DEV_FLAG, n,
+                           int(values[0]), int(dmin), width) + packed.tobytes()
     payload = zlib.compress(packed.tobytes(), _ZLEVEL)
     head = struct.pack("<BIqqB", _T_DELTA, n, int(values[0]), int(dmin), width)
     for_block = head + payload
@@ -69,18 +140,20 @@ def decode_ints(buf: bytes) -> np.ndarray:
     if tag == _T_CONST:
         _, n, first, stride = struct.unpack_from("<BIqq", buf)
         return (first + stride * np.arange(n, dtype=np.int64)).astype(np.int64)
-    if tag == _T_DELTA:
+    if tag & ~_DEV_FLAG == _T_DELTA:
         (n,) = struct.unpack_from("<I", buf, 1)
         if n == 0:
             return np.empty(0, dtype=np.int64)
-        _, n, first, dmin, width = struct.unpack_from("<BIqqB", buf)
-        payload = zlib.decompress(buf[struct.calcsize("<BIqqB") :])
+        first, dmin, width = struct.unpack_from("<qqB", buf, 5)
+        raw = buf[_DELTA_HEAD:]
+        payload = raw if tag & _DEV_FLAG else zlib.decompress(raw)
         dt = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}[width]
         shifted = np.frombuffer(payload, dtype=dt).astype(np.int64)
         out = np.empty(n, dtype=np.int64)
         out[0] = first
-        np.cumsum(shifted + dmin, out=out[1:]) if n > 1 else None
-        out[1:] += first
+        if n > 1:
+            np.cumsum(shifted + dmin, out=out[1:])
+            out[1:] += first
         return out
     raise ValueError(f"bad int block tag {tag}")
 
@@ -89,6 +162,10 @@ def encode_floats(values: np.ndarray) -> bytes:
     """Adaptive: gorilla XOR (native) vs zlib — keep the smaller block
     (the reference's lib/encoding float.go also chooses per block)."""
     values = np.ascontiguousarray(values, dtype=np.float64)
+    if device_profile():
+        # raw envelope: full-width LE floats, device-decodable
+        return struct.pack("<BI", _T_RAW64 | _DEV_FLAG, len(values)) \
+            + values.tobytes()
     z = zlib.compress(values.tobytes(), _ZLEVEL)
     g = native.gorilla_encode(values)
     if g is not None and len(g) < len(z):
@@ -101,10 +178,11 @@ def decode_floats(buf: bytes) -> np.ndarray:
     if tag == _T_GORILLA:
         (n,) = struct.unpack_from("<I", buf, 1)
         return native.gorilla_decode(buf[5:], n)
-    if tag != _T_RAW64:
+    if tag & ~_DEV_FLAG != _T_RAW64:
         raise ValueError(f"bad float block tag {tag}")
     (n,) = struct.unpack_from("<I", buf, 1)
-    payload = zlib.decompress(buf[5:])
+    raw = buf[5:]
+    payload = raw if tag & _DEV_FLAG else zlib.decompress(raw)
     return np.frombuffer(payload, dtype=np.float64).copy()
 
 
@@ -144,7 +222,8 @@ def encode_strings(values: np.ndarray) -> bytes:
         )
         return struct.pack("<BIIB", _T_STRDICT, n, len(uniq), width) + payload
     offsets = np.zeros(n + 1, dtype=np.uint32)
-    np.cumsum([len(p) for p in parts], out=offsets[1:]) if parts else None
+    if parts:
+        np.cumsum([len(p) for p in parts], out=offsets[1:])
     blob = b"".join(parts)
     payload = zlib.compress(offsets.tobytes() + blob, _ZLEVEL)
     return struct.pack("<BI", _T_STR, n) + payload
@@ -174,6 +253,18 @@ def decode_strings(buf: bytes) -> np.ndarray:
     for i in range(n):
         out[i] = blob[offsets[i] : offsets[i + 1]].decode("utf-8")
     return out
+
+
+def decode_value_blocks(ftype: FieldType, blocks) -> np.ndarray:
+    """Host decode of one or more self-describing value blocks into a
+    single array — the lazy fallback behind record.EncodedColumn (and
+    the oracle the device decoder is bit-identical to)."""
+    dec = _DECODERS[ftype]
+    if len(blocks) == 1:
+        return dec(blocks[0])
+    if not blocks:
+        return np.empty(0, dtype=ftype.np_dtype)
+    return np.concatenate([dec(b) for b in blocks])
 
 
 def encode_mask(valid: np.ndarray) -> bytes:

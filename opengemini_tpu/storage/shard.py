@@ -1543,14 +1543,24 @@ class Shard:
         sid_set = set(int(s) for s in sids)
         files, mems = self._scan_state()
         n_fields = len(fields) if fields is not None else None
+        # device-decode bulk path (ops/device_decode.py): eligible value
+        # blocks come back as still-encoded EncodedColumns so the grid
+        # freeze can ship compressed payloads to the accelerator; any
+        # merge/filter/fallback that touches .values host-decodes them
+        # bit-identically
+        from opengemini_tpu.ops import device_decode as _devdec
+
+        encoded_ok = _devdec.active()
 
         def decode_packed(r, c):
             s_arr, rec = r.read_packed_bulk(
-                measurement, c, fields, sid_filter=sids)
+                measurement, c, fields, sid_filter=sids,
+                encoded_ok=encoded_ok)
             return (s_arr, rec) if len(rec) else None
 
         def decode_single(r, c):
-            rec = r.read_chunk(measurement, c, fields)
+            rec = r.read_chunk(measurement, c, fields,
+                               encoded_ok=encoded_ok)
             return (np.full(len(rec), c.sid, np.int64), rec)
 
         # chunk decodes fan out across the scan pool; map_ordered yields

@@ -38,7 +38,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from opengemini_tpu.record import Column, FieldType, Record
+from opengemini_tpu.record import Column, EncodedColumn, FieldType, Record
 from opengemini_tpu.storage import colcache, diskfault, encodepool, encoding
 from opengemini_tpu.utils.bloom import BloomFilter
 from opengemini_tpu.utils.stats import GLOBAL as _STATS
@@ -515,6 +515,10 @@ class TSFReader:
 
     @staticmethod
     def _val_nbytes(val) -> int:
+        if getattr(val, "is_decoded", True) is False:
+            # still-encoded numeric column: one shared accounting rule
+            # (record.EncodedColumn), never firing the lazy decode
+            return val.accounted_nbytes()
         if isinstance(val, Column):
             return int(val.values.nbytes if hasattr(val.values, "nbytes")
                        else len(val.values) * 64) + int(val.valid.nbytes)
@@ -563,7 +567,15 @@ class TSFReader:
     def read_chunk(
         self, measurement: str, chunk: ChunkMeta,
         fields: list[str] | None = None, cache: bool = True,
+        encoded_ok: bool = False,
     ) -> Record:
+        """``encoded_ok=True`` (the device-decode bulk scan,
+        storage/shard.py read_series_bulk) returns numeric value columns
+        whose blocks are device-decodable as still-encoded
+        record.EncodedColumn — the CRC seal is verified here as always,
+        but the payload decode is deferred to the accelerator (or to the
+        column's lazy host fallback).  Times and masks always decode on
+        the host (they drive window/run planning)."""
         schema = self.schema(measurement)
 
         def times_decode():
@@ -581,7 +593,16 @@ class TSFReader:
             def decode(loc=loc, name=name):
                 vbuf = self._read(loc["v"])
                 mbuf = self._read(loc["m"]) if loc["m"] else b""
-                return encoding.decode_column(schema[name], vbuf, mbuf)
+                ftype = schema[name]
+                if encoded_ok and ftype in (FieldType.FLOAT,
+                                            FieldType.INT):
+                    db = encoding.device_block(vbuf)
+                    if db is not None:
+                        return EncodedColumn(
+                            ftype, [vbuf],
+                            encoding.decode_mask(mbuf, db.n),
+                            encoding.decode_value_blocks)
+                return encoding.decode_column(ftype, vbuf, mbuf)
 
             cols[name] = (self._cached_col(chunk, name, decode)
                           if cache else decode())
@@ -710,13 +731,18 @@ class TSFReader:
         self, measurement: str, chunk: ChunkMeta,
         fields: list[str] | None = None,
         sid_filter: np.ndarray | None = None, cache: bool = True,
+        encoded_ok: bool = False,
     ) -> tuple[np.ndarray, Record]:
         """(sids, record) of a packed chunk in ONE decode; when
         `sid_filter` (sorted int64 array) is given, rows are masked to
         those series — the batched multi-series scan that replaces
-        per-sid Python loops at high cardinality."""
+        per-sid Python loops at high cardinality.  ``encoded_ok`` defers
+        numeric value decode exactly like read_chunk — a sid filter that
+        actually drops rows slices the columns, which host-decodes the
+        lazy ones (bit-identical fallback)."""
         sids = self.read_packed_sids(chunk, cache)
-        rec = self.read_chunk(measurement, chunk, fields, cache)
+        rec = self.read_chunk(measurement, chunk, fields, cache,
+                              encoded_ok=encoded_ok)
         return self._packed_bulk_filter(sids, rec, sid_filter)
 
     @staticmethod

@@ -543,10 +543,15 @@ def _probe_pallas() -> tuple[bool, str]:
         from jax.experimental import pallas as pl
 
         def kern(m_ref, cnt_ref):
-            # the product kernels' idiom: an integer reduce assigned
-            # into an int32 ref (widens to int64 under x64 interpret
-            # mode on affected jax versions — the capability gap)
-            cnt_ref[...] = (m_ref[...] != 0).sum(axis=1, keepdims=True)
+            # the product kernels' idiom: a masked integer reduce with an
+            # EXPLICIT int32 result stored into an int32 ref.  The
+            # explicit cast is load-bearing — x64 interpret mode widens
+            # bare integer reduces to int64, which int32 refs reject —
+            # so the kernels in ops/pallas_segment.py cast the same way,
+            # and the probe passes wherever they can actually run.
+            cnt_ref[...] = ((m_ref[...] != 0)
+                            .sum(axis=1, keepdims=True)
+                            .astype(jnp.int32))
 
         m = _np.ones((8, 8), _np.int8)
         out = pl.pallas_call(

@@ -1,0 +1,343 @@
+"""Decode on device (ISSUE 15): device-profile encodings, the lazy
+EncodedColumn view algebra, the fused device decoder, and end-to-end
+cold-scan bit-identity between `OGT_DEVICE_DECODE=0` (host path) and
+`=1` (compressed bytes -> device -> decode -> reduce).
+
+Everything here runs on the CPU backend with x64 on (tests/conftest.py),
+which is exactly the regime the device decoder requires for
+bit-identity — equality assertions are exact, never approximate.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from opengemini_tpu.ops import device_decode as dd  # noqa: E402
+from opengemini_tpu.record import EncodedColumn, FieldType  # noqa: E402
+from opengemini_tpu.storage import encoding as enc  # noqa: E402
+
+NS = 1_000_000_000
+BASE = 1_700_000_000
+
+
+@pytest.fixture
+def profile_on(monkeypatch):
+    monkeypatch.setenv("OGT_DEVICE_PROFILE", "1")
+
+
+# -- encoding round-trip fuzz -------------------------------------------------
+
+
+def _int_cases(rng):
+    """Int columns straddling every adaptive boundary: constant stride
+    (_T_CONST), repetitive deltas (varint+zlib wins), wide random deltas
+    (FOR wins), each delta width, singletons, empties."""
+    yield np.empty(0, np.int64)
+    yield np.array([42], np.int64)
+    yield np.arange(0, 5000, 7, dtype=np.int64)              # const stride
+    yield np.cumsum(rng.integers(0, 3, 400)).astype(np.int64)    # repetitive
+    for scale in (200, 40_000, 2**20, 2**44):                # widths 1,2,4,8
+        yield np.cumsum(rng.integers(0, scale, 300)).astype(np.int64)
+    yield rng.integers(-2**62, 2**62, 257).astype(np.int64)  # wide/wrap
+    yield np.array([5, 5, 5, 5, 9], np.int64)                # dup then break
+
+
+def _float_cases(rng):
+    """Float columns straddling gorilla-vs-zlib: smooth series (gorilla
+    wins), constant (zlib wins), random, NaN/inf payloads, empties."""
+    yield np.empty(0, np.float64)
+    yield np.repeat(3.25, 300)
+    yield np.cumsum(rng.standard_normal(400)) + 50.0
+    yield rng.standard_normal(513) * 1e18
+    v = rng.standard_normal(64)
+    v[::7] = np.nan
+    v[3] = np.inf
+    yield v
+
+
+@pytest.mark.parametrize("profile", ["0", "1"])
+def test_encoding_roundtrip_fuzz(monkeypatch, profile, rng):
+    monkeypatch.setenv("OGT_DEVICE_PROFILE", profile)
+    for v in _int_cases(rng):
+        buf = enc.encode_ints(v)
+        np.testing.assert_array_equal(enc.decode_ints(buf), v)
+    for v in _float_cases(rng):
+        buf = enc.encode_floats(v)
+        got = enc.decode_floats(buf)
+        np.testing.assert_array_equal(
+            got.view(np.uint64), v.view(np.uint64))  # NaN-exact
+
+
+def test_profile_blocks_cross_readable(monkeypatch, rng):
+    """Profile-written blocks decode with the profile off (old reader,
+    new file) and plain blocks decode with it on (new reader, old
+    file) — the format change is reader-transparent."""
+    v_i = np.cumsum(rng.integers(0, 999, 500)).astype(np.int64)
+    v_f = rng.standard_normal(500)
+    monkeypatch.setenv("OGT_DEVICE_PROFILE", "1")
+    bi, bf = enc.encode_ints(v_i), enc.encode_floats(v_f)
+    assert enc.device_block(bi) is not None
+    assert enc.device_block(bf) is not None
+    monkeypatch.setenv("OGT_DEVICE_PROFILE", "0")
+    np.testing.assert_array_equal(enc.decode_ints(bi), v_i)
+    np.testing.assert_array_equal(enc.decode_floats(bf), v_f)
+    bi2, bf2 = enc.encode_ints(v_i), enc.encode_floats(v_f)
+    assert enc.device_block(bf2) is None  # zlib/gorilla: host-only
+    monkeypatch.setenv("OGT_DEVICE_PROFILE", "1")
+    np.testing.assert_array_equal(enc.decode_ints(bi2), v_i)
+    np.testing.assert_array_equal(enc.decode_floats(bf2), v_f)
+
+
+def test_device_block_classification(profile_on, rng):
+    assert enc.device_block(
+        enc.encode_ints(np.arange(100, dtype=np.int64))).kind == "const"
+    db = enc.device_block(enc.encode_ints(
+        np.cumsum(rng.integers(0, 200, 64)).astype(np.int64)))
+    assert db.kind == "delta" and db.width == 1
+    assert enc.device_block(
+        enc.encode_floats(rng.standard_normal(32))).kind == "raw64"
+    # bool/string blocks never classify
+    assert enc.device_block(
+        enc.encode_bools(np.ones(8, np.bool_))) is None
+
+
+# -- device decoder vs host oracle -------------------------------------------
+
+
+def test_decode_to_device_bit_identical(profile_on, rng):
+    blocks, want = [], []
+    for scale in (100, 50_000, 2**21, 2**45):
+        v = np.cumsum(rng.integers(0, scale, 300)).astype(np.int64)
+        b = enc.encode_ints(v)
+        blocks.append(b)
+        want.append(enc.decode_ints(b))
+    blocks.append(enc.encode_ints(np.arange(0, 900, 9, dtype=np.int64)))
+    want.append(np.arange(0, 900, 9, dtype=np.int64))
+    got = np.asarray(dd.decode_to_device(blocks))
+    np.testing.assert_array_equal(got, np.concatenate(want))
+    fb = [enc.encode_floats(rng.standard_normal(257))]
+    np.testing.assert_array_equal(
+        np.asarray(dd.decode_to_device(fb)),
+        enc.decode_floats(fb[0]))
+
+
+def test_pallas_widen_matches_jnp(profile_on, monkeypatch, rng):
+    """Force the Pallas widen kernel (interpret mode) and compare
+    against the default jnp bitcast path."""
+    from opengemini_tpu.ops import pallas_segment as ps
+    from opengemini_tpu.utils import devobs
+
+    ok, why = devobs.pallas_supported()
+    if not ok:
+        pytest.skip(why)
+    v = np.cumsum(rng.integers(0, 60_000, 400)).astype(np.int64)
+    blocks = [enc.encode_ints(v)]
+    want = np.asarray(dd.decode_to_device(blocks))
+    monkeypatch.setenv("OGTPU_PALLAS", "1")
+    ps.use_pallas.cache_clear()
+    dd._decode_program.cache_clear()
+    try:
+        got = np.asarray(dd.decode_to_device(blocks))
+    finally:
+        monkeypatch.delenv("OGTPU_PALLAS")
+        ps.use_pallas.cache_clear()
+        dd._decode_program.cache_clear()
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(want, v)
+
+
+# -- EncodedColumn view algebra ----------------------------------------------
+
+
+def _enc_col(rng, n=500, scale=1000):
+    v = np.cumsum(rng.integers(0, scale, n)).astype(np.int64)
+    buf = enc.encode_ints(v)
+    col = EncodedColumn(FieldType.INT, [buf], np.ones(n, np.bool_),
+                        enc.decode_value_blocks)
+    return col, v
+
+
+def test_encoded_column_lazy_and_take(profile_on, rng):
+    col, v = _enc_col(rng)
+    assert not col.is_decoded
+    # strictly-increasing takes stay encoded and compose
+    idx = np.flatnonzero(rng.random(len(v)) < 0.5)
+    t1 = col.take(idx)
+    assert isinstance(t1, EncodedColumn) and not t1.is_decoded
+    sub = np.arange(3, len(idx) - 2)
+    t2 = t1.take(sub)
+    assert isinstance(t2, EncodedColumn) and not t2.is_decoded
+    np.testing.assert_array_equal(t2.values, v[idx][sub])
+    # encoded takes never touched the source column's values
+    assert not col.is_decoded
+    # non-monotone takes decode (bit-identically) — via the source,
+    # which memoizes
+    t3 = col.take(idx[::-1])
+    np.testing.assert_array_equal(t3.values, v[idx[::-1]])
+    assert col.is_decoded
+    np.testing.assert_array_equal(col.values, v)
+
+
+def test_encoded_column_concat_views(profile_on, rng):
+    a, va = _enc_col(rng, 300)
+    b, vb = _enc_col(rng, 200)
+    a2 = a.take(np.arange(50, 250))
+    c = a2.concat(b)
+    assert isinstance(c, EncodedColumn) and not c.is_decoded
+    np.testing.assert_array_equal(
+        c.values, np.concatenate([va[50:250], vb]))
+
+
+def test_affine_scatter_rejects_irregular(profile_on, rng):
+    every, dt, k, w_pad = 60 * NS, 10 * NS, 6, 24
+    rel = np.tile(np.arange(100) * dt, 3)
+    starts = np.arange(3) * 100
+    rid = np.repeat(np.arange(3), 100)
+    w = rel // every
+    flat = (rid * k + (rel - w * every) // dt) * w_pad + w
+    assert dd._affine_scatter(flat, rel, starts, every, dt, k, w_pad) \
+        is not None
+    rel2 = rel.copy()
+    rel2[57] += 1  # one irregular sample: must fall back to explicit flat
+    assert dd._affine_scatter(flat, rel2, starts, every, dt, k, w_pad) \
+        is None
+
+
+# -- end-to-end cold-scan bit-identity ---------------------------------------
+
+
+@pytest.fixture
+def env(tmp_path, profile_on):
+    from opengemini_tpu.query.executor import Executor
+    from opengemini_tpu.storage.engine import Engine
+
+    e = Engine(str(tmp_path / "data"), sync_wal=False)
+    e.create_database("db")
+    yield e, Executor(e)
+    e.close()
+
+
+def _write_random_shard(e, rng, hosts=70, points=120):
+    """Randomized shard contents: regular int and float fields, a
+    sparse field (validity masks), and a handful of irregular rows so
+    some series refuse the grid."""
+    lines = []
+    for h in range(hosts):
+        step = int(rng.choice([10, 10, 10, 20]))
+        for p in range(points):
+            t = (BASE + p * step) * NS
+            f = f"cpu,host=h{h} vi={int(rng.integers(0, 250))}i," \
+                f"vf={float(rng.standard_normal()):.6f}"
+            if rng.random() < 0.3:
+                f += f",sparse={float(rng.random()):.4f}"
+            lines.append(f"{f} {t}")
+    e.write_lines("db", "\n".join(lines))
+    e.flush_all()
+
+
+QUERIES = [
+    "SELECT count(vi), min(vi), max(vi) FROM cpu WHERE time >= {lo} AND "
+    "time < {hi} GROUP BY time(1m)",
+    "SELECT mean(vf), sum(vf), stddev(vf), first(vf), last(vf) FROM cpu "
+    "WHERE time >= {lo} AND time < {hi} GROUP BY time(90s), host",
+    "SELECT count(sparse), max(sparse) FROM cpu WHERE time >= {lo} AND "
+    "time < {hi} GROUP BY time(2m)",
+    # partial range: exercises the encoded-view time trim
+    "SELECT mean(vf), count(vi) FROM cpu WHERE time >= {plo} AND "
+    "time < {phi} GROUP BY time(1m)",
+]
+
+
+def test_cold_scan_bit_identity_device_vs_host(env, monkeypatch, rng):
+    from opengemini_tpu.storage import colcache
+
+    e, ex = env
+    _write_random_shard(e, rng)
+    lo, hi = BASE * NS, (BASE + 120 * 20 + 60) * NS
+    plo, phi = (BASE + 300) * NS, (BASE + 1500) * NS
+    for q in QUERIES:
+        qq = q.format(lo=lo, hi=hi, plo=plo, phi=phi)
+        out = {}
+        for dec in ("0", "1"):
+            monkeypatch.setenv("OGT_DEVICE_DECODE", dec)
+            colcache.GLOBAL.clear()
+            ex._inc_cache.clear()
+            out[dec] = ex.execute(qq, db="db")
+        assert json.dumps(out["0"], sort_keys=True) == \
+            json.dumps(out["1"], sort_keys=True), qq
+
+
+def test_cold_scan_engages_device_decode(env, monkeypatch, rng):
+    """The int-field cold scan must actually take the fused path (not
+    silently fall back) and transfer fewer H2D bytes than the host
+    path's decoded grid."""
+    from opengemini_tpu.storage import colcache
+    from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+    e, ex = env
+    _write_random_shard(e, rng, hosts=70, points=100)
+    monkeypatch.setenv("OGT_COLCACHE_DEVICE", "1")
+    colcache.GLOBAL.configure(device=True)
+    q = ("SELECT count(vi), min(vi), max(vi) FROM cpu WHERE time >= %d "
+         "AND time < %d GROUP BY time(1m)" % (BASE * NS,
+                                              (BASE + 4000) * NS))
+
+    def h2d():
+        return STATS.counters("device").get("h2d_bytes_total", 0)
+
+    def run(dec):
+        monkeypatch.setenv("OGT_DEVICE_DECODE", dec)
+        colcache.GLOBAL.clear()
+        ex._inc_cache.clear()
+        before, fused = h2d(), STATS.counters("executor").get(
+            "grid_decode_fused", 0)
+        out = ex.execute(q, db="db")
+        return out, h2d() - before, STATS.counters("executor").get(
+            "grid_decode_fused", 0) - fused
+
+    out_host, bytes_host, _ = run("0")
+    out_dev, bytes_dev, fused = run("1")
+    assert json.dumps(out_host) == json.dumps(out_dev)
+    assert fused >= 1, "fused decode path did not engage"
+    assert 0 < bytes_dev < bytes_host, (bytes_dev, bytes_host)
+    colcache.GLOBAL.configure(device=False)
+
+
+def test_prom_tiled_device_decode_identity(env, monkeypatch, rng):
+    """PromQL tiled path: forced traced kernels with device decode on
+    vs host kernels — identical JSON output."""
+    from opengemini_tpu.promql.engine import PromEngine
+    from opengemini_tpu.storage import colcache
+
+    e, _ex = env
+    lines = []
+    for h in range(70):
+        for p in range(150):
+            lines.append(
+                f"req_total,host=h{h} value={h * 997 + p * 3}i "
+                f"{(BASE + p * 10) * NS}")
+    e.write_lines("db", "\n".join(lines))
+    e.flush_all()
+    pe = PromEngine(e)
+
+    def q():
+        colcache.GLOBAL.clear()
+        return pe.query_range("rate(req_total[5m])", BASE + 600,
+                              BASE + 1400, 30, db="db")
+
+    monkeypatch.setenv("OGT_PROM_HOST_KERNELS", "1")
+    want = q()
+    monkeypatch.setenv("OGT_PROM_HOST_KERNELS", "0")
+    monkeypatch.setenv("OGT_DEVICE_DECODE", "1")
+    got = q()
+    monkeypatch.setenv("OGT_DEVICE_DECODE", "0")
+    got_host = q()
+    assert json.dumps(got, sort_keys=True) == \
+        json.dumps(got_host, sort_keys=True)
+    assert json.dumps(got, sort_keys=True) == \
+        json.dumps(want, sort_keys=True)

@@ -284,6 +284,45 @@ def test_ogt050_device_metric_family(tmp_path):
         "device.H2D_Bytes", "device_h2d-colcache-fill"]
 
 
+def test_ogt010_device_decode_knob_family(tmp_path):
+    """The ISSUE 15 knobs: OGT_DEVICE_PROFILE / OGT_DEVICE_DECODE reads
+    are OGT010 subjects — documented spellings pass, an undocumented
+    sibling in the same family is a finding."""
+    root = _tree(tmp_path, {
+        "README.md": ("Decode on device knobs: `OGT_DEVICE_PROFILE`, "
+                      "`OGT_DEVICE_DECODE`.\n"),
+        "opengemini_tpu/ops/devdec_mod.py": (
+            "import os\n"
+            "a = os.environ.get('OGT_DEVICE_PROFILE', '0')\n"   # ok
+            "b = os.environ.get('OGT_DEVICE_DECODE', '1')\n"    # ok
+            "c = os.environ.get('OGT_DEVICE_TURBO', '')\n"      # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT010")
+    assert [f.detail for f in found] == ["OGT_DEVICE_TURBO"]
+
+
+def test_ogt050_device_decode_metric_family(tmp_path):
+    """The ogt_device_decode_* counters (ISSUE 15) obey the metric
+    grammar as keys of the `device` module; a dashed transfer-site name
+    smuggled into a FAMILY name (sites are labels, never families) is a
+    finding."""
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": (
+            "GLOBAL.incr('device', 'decode_blocks_total')\n"         # ok
+            "GLOBAL.incr('device', 'decode_payload_bytes_total')\n"  # ok
+            "GLOBAL.incr('device', 'decode_rows_total', 7)\n"        # ok
+            "GLOBAL.incr('device', 'decode_fallbacks_total')\n"      # ok
+            "histogram('device_h2d_bytes', site='device-decode')\n"  # ok
+            "histogram('device_decode-site')\n"                      # finding
+            "GLOBAL.incr('device', 'Decode_Rows')\n"                 # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT050")
+    assert sorted(f.detail for f in found) == [
+        "device.Decode_Rows", "device_decode-site"]
+
+
 # -- baseline + output formats ------------------------------------------------
 
 
