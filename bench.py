@@ -962,14 +962,21 @@ def bench_colcache_warm(rows: int = 4_000_000, chunk: int = 16_384,
 
 
 def bench_device_decode_cold_scan(series: int = 96, points: int = 2400) -> dict:
-    """Decode on device (ISSUE 15): the SAME cold GROUP BY time() scan
-    over device-profile TSF data, host decode (`OGT_DEVICE_DECODE=0`)
-    vs fused device decode (`=1`), equality-gated in-bench.  The JSON
-    detail carries the compressed-vs-decoded H2D byte deltas
-    (`ogt_device_h2d_bytes_total` — the acceptance metric: the device
-    leg must transfer measurably fewer bytes), the per-stage
-    `device_transfer`/`device_exec` attribution, and the recompile
-    tripwire across a warm loop."""
+    """Decode on device (ISSUE 15/16): the SAME cold GROUP BY time()
+    scan over device-profile TSF data, host decode (`OGT_DEVICE_DECODE=0`)
+    vs fused device decode (`=1`), equality-gated in-bench.  The column
+    mix is gorilla/varint-heavy (a step-hold float gauge and a
+    small-step int counter — the shapes where compression wins most) so
+    the H2D drop measures the FULL codec family, and the per-codec
+    decode counters in the detail prove which codecs shipped encoded.
+    When more than one device is visible a mesh-on leg repeats the cold
+    scan with the decode sharded over the mesh (ISSUE 16 tentpole):
+    equality-gated against the host result, warm mesh repeats asserted
+    transfer-free.  The JSON detail carries the compressed-vs-decoded
+    H2D byte deltas (`ogt_device_h2d_bytes_total` — the acceptance
+    metric: the device leg must transfer measurably fewer bytes), the
+    per-stage `device_transfer`/`device_exec` attribution, and the
+    recompile tripwire across a warm loop."""
     import shutil
     import tempfile
 
@@ -1012,8 +1019,14 @@ def bench_device_decode_cold_scan(series: int = 96, points: int = 2400) -> dict:
         e.create_database("db")
         lines = []
         for h in range(series):
-            vi = rng.integers(0, 240, points)
-            vf = np.round(rng.standard_normal(points) * 20 + 50, 6)
+            # gorilla/varint-heavy mix: a small-step counter (varint
+            # ~1 byte/sample) and a step-hold gauge (gorilla ~10% of
+            # raw64) — random-mantissa floats would defeat gorilla and
+            # fall back to the raw64 envelope
+            vi = np.cumsum(rng.integers(0, 3, points))
+            vf = np.round(np.cumsum(
+                rng.standard_normal(points)
+                * (rng.random(points) < 0.1)), 1) + 50
             for p in range(points):
                 lines.append(
                     f"cpu,host=h{h} vi={int(vi[p])}i,vf={vf[p]} "
@@ -1075,7 +1088,85 @@ def bench_device_decode_cold_scan(series: int = 96, points: int = 2400) -> dict:
             f"{recompiles} recompiles across warm device-decode loops"
         assert json.dumps(out_warm, sort_keys=True) == \
             json.dumps(out_dev, sort_keys=True)
+        # mesh-on leg (ISSUE 16): the same cold scan with the fused
+        # decode partitioned over every visible device — encoded bytes
+        # ship per-shard, results land sharded in the device tier, warm
+        # repeats must stay transfer-free under the recompile tripwire
+        mesh_doc = {"skipped": "single device"}
+        if len(jax.devices()) > 1:
+            from opengemini_tpu.parallel import distributed as dist
+            from opengemini_tpu.parallel import runtime as prt
+
+            mesh = dist.make_mesh(len(jax.devices()), ("shard",))
+            prt.set_mesh(mesh)
+            try:
+                mf0 = STATS.counters("executor").get(
+                    "grid_decode_fused", 0)
+                mm0 = STATS.counters("device").get("mesh_h2d_bytes", 0)
+                out_mesh, h2d_mesh, t_mesh, stages_mesh = leg("1")
+                mesh_fused = STATS.counters("executor").get(
+                    "grid_decode_fused", 0) - mf0
+                mesh_h2d = STATS.counters("device").get(
+                    "mesh_h2d_bytes", 0) - mm0
+                assert json.dumps(out_host, sort_keys=True) == \
+                    json.dumps(out_mesh, sort_keys=True), \
+                    "mesh-sharded decode changed results"
+                assert mesh_fused >= 1, \
+                    "mesh fused decode path did not engage"
+                assert 0 < h2d_mesh < h2d_host, (
+                    f"mesh decode H2D did not drop: {h2d_mesh} vs "
+                    f"{h2d_host}")
+                devobs.mark_warm()
+                dv0 = devobs.span_snapshot()
+                t_mesh_warm = float("inf")
+                for _ in range(3):
+                    ex._inc_cache.clear()
+                    t0 = time.perf_counter()
+                    out_mesh_warm = ex.execute(q, db="db")
+                    t_mesh_warm = min(t_mesh_warm,
+                                      time.perf_counter() - t0)
+                mesh_recompiles = devobs.compiles_since_warm()
+                mesh_warm_h2d = devobs.span_snapshot()["h2d_bytes"] \
+                    - dv0["h2d_bytes"]
+                devobs.clear_warm()
+                assert mesh_recompiles == 0, (
+                    f"{mesh_recompiles} recompiles across warm "
+                    "mesh-decode loops")
+                assert mesh_warm_h2d == 0, (
+                    f"warm mesh repeat transferred {mesh_warm_h2d} bytes")
+                assert json.dumps(out_mesh_warm, sort_keys=True) == \
+                    json.dumps(out_mesh, sort_keys=True)
+                mesh_doc = {
+                    "n_devices": len(jax.devices()),
+                    "h2d_bytes_mesh_decode": h2d_mesh,
+                    "mesh_h2d_bytes": mesh_h2d,
+                    "h2d_drop_x_vs_host": round(
+                        h2d_host / max(h2d_mesh, 1), 2),
+                    "cold_ms_mesh_decode": round(t_mesh * 1e3, 1),
+                    "warm_ms": round(t_mesh_warm * 1e3, 1),
+                    "warm_h2d_bytes": mesh_warm_h2d,
+                    "stages_ms": stages_mesh,
+                    "fused_launches": mesh_fused,
+                    "recompiles_after_warm": mesh_recompiles,
+                    "equality_ok": True,
+                }
+            finally:
+                prt.set_mesh(None)
         decode_ctr = STATS.counters("device")
+        codec_payload = {
+            c: decode_ctr.get(f"decode_payload_bytes_{c}_total", 0)
+            - decode_ctr0.get(f"decode_payload_bytes_{c}_total", 0)
+            for c in ("const", "delta", "raw64", "gorilla", "varint",
+                      "strdict")}
+        # the acceptance claim "gorilla/varint columns ship encoded":
+        # both codecs must have carried payload, and the encoded bytes
+        # must undercut the full decoded width of those columns
+        decoded_width = 2 * series * points * 8
+        assert codec_payload["gorilla"] > 0, "no gorilla blocks shipped"
+        assert codec_payload["varint"] > 0, "no varint blocks shipped"
+        assert sum(codec_payload.values()) < decoded_width, (
+            f"encoded payload {sum(codec_payload.values())} did not beat "
+            f"decoded width {decoded_width}")
         e.close()
         return {
             "rows": series * points,
@@ -1095,8 +1186,10 @@ def bench_device_decode_cold_scan(series: int = 96, points: int = 2400) -> dict:
             "decode_fallbacks": decode_ctr.get(
                 "decode_fallbacks_total", 0) - decode_ctr0.get(
                 "decode_fallbacks_total", 0),
+            "decode_payload_bytes_per_codec": codec_payload,
             "recompiles_after_warm": recompiles,
             "equality_ok": True,
+            "mesh": mesh_doc,
         }
     finally:
         devobs.set_enabled(prev_armed)
@@ -2346,6 +2439,137 @@ def _mc_warm_reshard_section(mesh) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _mc_encoded_section(mesh) -> dict:
+    """Mesh-sharded ENCODED cold scan through the real executor (ISSUE
+    16): device-profile gorilla/varint data, the same GROUP BY time()
+    scan with device decode off (host decode + full-width sharded put)
+    vs on (per-shard encoded H2D straight into the fused decode), with
+    equality, H2D drop, per-device placement of the decoded grid, and a
+    transfer-free warm repeat under the recompile tripwire all
+    asserted."""
+    import shutil
+    import tempfile
+
+    from opengemini_tpu.ops import device_decode as devdec
+    from opengemini_tpu.parallel import runtime as prt
+    from opengemini_tpu.query.executor import Executor
+    from opengemini_tpu.storage import colcache
+    from opengemini_tpu.storage.engine import Engine
+    from opengemini_tpu.utils import devobs
+    from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+    devdec._backend_ok.cache_clear()
+    if not devdec.active():
+        return {"skipped": "device decode inactive (requires jax x64)"}
+    ns = 10**9
+    base = 1_700_000_000
+    root = tempfile.mkdtemp(prefix="ogtpu-mc-enc-")
+    prior = colcache.GLOBAL.config()
+    prev_profile = os.environ.get("OGT_DEVICE_PROFILE")
+    prev_decode = os.environ.get("OGT_DEVICE_DECODE")
+    os.environ["OGT_DEVICE_PROFILE"] = "1"
+    colcache.GLOBAL.configure(budget_mb=64, device=True,
+                              device_budget_mb=64)
+    prt.set_mesh(mesh)
+    rng = np.random.default_rng(16)
+    series, points = 64, 480  # bulk scan needs >= 64 series
+    shard_shape = None
+    captured = []
+    orig_run = devdec.run_mesh_grid_plan
+
+    def spy_run(mplan):
+        out = orig_run(mplan)
+        captured.append(out[1])  # the sharded vt global array
+        return out
+
+    devdec.run_mesh_grid_plan = spy_run
+    try:
+        eng = Engine(os.path.join(root, "data"), sync_wal=False)
+        eng.create_database("db")
+        lines = []
+        for h in range(series):
+            vi = np.cumsum(rng.integers(0, 3, points))
+            vf = np.round(np.cumsum(
+                rng.standard_normal(points)
+                * (rng.random(points) < 0.1)), 1) + 50
+            for p in range(points):
+                lines.append(
+                    f"enc,host=h{h} vi={int(vi[p])}i,vf={vf[p]} "
+                    f"{(base + p * 10) * ns}")
+        eng.write_lines("db", "\n".join(lines))
+        eng.flush_all()
+        ex = Executor(eng)
+        q = ("SELECT count(vi), max(vi), mean(vf), sum(vf) FROM enc "
+             "WHERE time >= %d AND time < %d GROUP BY time(1m)"
+             % (base * ns, (base + points * 10) * ns))
+
+        def leg(flag: str):
+            os.environ["OGT_DEVICE_DECODE"] = flag
+            colcache.GLOBAL.clear()
+            ex._inc_cache.clear()
+            d0 = devobs.span_snapshot()["h2d_bytes"]
+            out = ex.execute(q, db="db")
+            return out, devobs.span_snapshot()["h2d_bytes"] - d0
+
+        f0 = STATS.counters("executor").get("grid_decode_fused", 0)
+        out_host, h2d_host = leg("0")
+        out_mesh, h2d_mesh = leg("1")
+        fused = STATS.counters("executor").get(
+            "grid_decode_fused", 0) - f0
+        assert json.dumps(out_host, sort_keys=True, default=str) == \
+            json.dumps(out_mesh, sort_keys=True, default=str), \
+            "mesh encoded cold scan changed results"
+        assert fused >= 1, "mesh fused decode did not engage"
+        assert 0 < h2d_mesh < h2d_host, (
+            f"encoded H2D did not drop: {h2d_mesh} vs {h2d_host}")
+        assert captured, "run_mesh_grid_plan was not reached"
+        shard_shape = _mc_assert_shards(captured[0], mesh)
+        # warm repeats: the sharded device-tier entry must serve both
+        # queries with zero transfer and zero recompiles
+        devobs.mark_warm()
+        m0 = STATS.counters("device").get("mesh_h2d_bytes", 0)
+        d0 = devobs.span_snapshot()["h2d_bytes"]
+        for _ in range(2):
+            ex._inc_cache.clear()
+            out_warm = ex.execute(q, db="db")
+        recompiles = devobs.compiles_since_warm()
+        warm_h2d = devobs.span_snapshot()["h2d_bytes"] - d0
+        warm_mesh = STATS.counters("device").get(
+            "mesh_h2d_bytes", 0) - m0
+        devobs.clear_warm()
+        assert recompiles == 0, \
+            f"{recompiles} recompiles across warm mesh encoded scans"
+        assert warm_mesh == 0 and warm_h2d == 0, (
+            f"warm mesh encoded scan transferred {warm_h2d} bytes "
+            f"({warm_mesh} mesh)")
+        assert json.dumps(out_warm, sort_keys=True, default=str) == \
+            json.dumps(out_mesh, sort_keys=True, default=str)
+        eng.close()
+        return {
+            "rows": series * points,
+            "h2d_bytes_host_path": int(h2d_host),
+            "h2d_bytes_mesh_decode": int(h2d_mesh),
+            "h2d_drop_x": round(h2d_host / max(h2d_mesh, 1), 2),
+            "fused_launches": int(fused),
+            "shard_shape": shard_shape,
+            "warm_h2d_bytes": int(warm_h2d),
+            "recompiles_after_warm": int(recompiles),
+            "equality_ok": True,
+        }
+    finally:
+        devdec.run_mesh_grid_plan = orig_run
+        prt.set_mesh(None)
+        colcache.GLOBAL.clear()
+        colcache.GLOBAL.configure(**prior)
+        for key, val in (("OGT_DEVICE_PROFILE", prev_profile),
+                         ("OGT_DEVICE_DECODE", prev_decode)):
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _multichip_child_main(n: int) -> None:
     """One forced-N-device child of bench_multichip_scaling: prints a
     single MULTICHIP-CHILD json line."""
@@ -2379,17 +2603,26 @@ def _multichip_child_main(n: int) -> None:
         },
     }
     doc.update(_mc_warm_reshard_section(mesh))
-    doc["equality_ok"] = all(
-        k["equality_ok"] for k in doc["kernels"].values())
     # per-child device telemetry: GSPMD compiles ONE program per kernel
     # regardless of mesh size, so the parent asserts `compiles` is flat
-    # across N (a count that grows with N means per-shard re-lowering)
+    # across N (a count that grows with N means per-shard re-lowering).
+    # Snapshot BEFORE the encoded section: per-shard fused decode
+    # programs are explicit per-device launches whose signatures carry
+    # each shard's payload widths, so their count legitimately varies
+    # with N — it lands in the section's own compile delta instead.
     doc["device"] = devobs.span_snapshot()
+    c0 = doc["device"].get("compiles", 0)
+    doc["encoded_cold_scan"] = _mc_encoded_section(mesh)
+    doc["encoded_cold_scan"]["compiles"] = \
+        devobs.span_snapshot().get("compiles", 0) - c0
+    doc["equality_ok"] = all(
+        k["equality_ok"] for k in doc["kernels"].values()) and \
+        doc["encoded_cold_scan"].get("equality_ok", True)
     print("MULTICHIP-CHILD " + json.dumps(doc), flush=True)
 
 
 def bench_multichip_scaling(n_list=(1, 2, 4, 8),
-                            child_timeout_s: float = 240.0) -> dict:
+                            child_timeout_s: float = 420.0) -> dict:
     """Re-exec per-N children and assemble the scaling doc (per-kernel
     ns/iter, shard shapes, equality flags, warm-transfer proof)."""
     per_n = {}
@@ -2437,6 +2670,11 @@ def bench_multichip_scaling(n_list=(1, 2, 4, 8),
         "equality_ok": all(d["equality_ok"] for d in per_n.values()),
         "warm_reshard_transfer_bytes": max(
             d["warm_reshard_transfer_bytes"] for d in per_n.values()),
+        # encoded cold scan (ISSUE 16): per-shard encoded H2D vs the
+        # host-decode full-width put, through the real executor
+        "encoded_h2d_drop_per_n": {
+            n: d.get("encoded_cold_scan", {}).get("h2d_drop_x")
+            for n, d in per_n.items()},
     }
     _write_multichip_artifact(doc)
     return doc

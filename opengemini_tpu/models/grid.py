@@ -357,11 +357,12 @@ class GridBatch:
     def _encoded_plan(self, shape, flat, mesh, rel, starts, dt):
         """Fused device-decode plan for a fully-encoded cold scan
         (ops/device_decode.py), or None: every add must still carry its
-        encoded blocks, no device mesh may be configured (sharding the
-        decode output is future work — the host path shards as before),
-        and the decoder must accept every block.  None means the freeze
+        encoded blocks and the decoder must accept every block.  Under a
+        configured mesh the plan is partitioned by output row shard
+        (rows are already padded to a mesh multiple) so each device
+        decodes only its own shard's bytes.  None means the freeze
         scatters on the host exactly as it always has."""
-        if not self._vals or mesh is not None:
+        if not self._vals:
             return None
         views = []
         for v in self._vals:
@@ -371,9 +372,15 @@ class GridBatch:
             views.append((col.blocks, col.abs_segments(), col.n_full))
         from opengemini_tpu.ops import device_decode
 
-        plan = device_decode.build_grid_plan(
-            views, flat, np.concatenate(self._mask), shape, self.dtype,
-            rel=rel, starts=starts, every_ns=self.every_ns, dt=dt)
+        mask = np.concatenate(self._mask)
+        if mesh is not None:
+            plan = device_decode.build_mesh_grid_plan(
+                views, flat, mask, shape, self.dtype, mesh,
+                rel=rel, starts=starts, every_ns=self.every_ns, dt=dt)
+        else:
+            plan = device_decode.build_grid_plan(
+                views, flat, mask, shape, self.dtype,
+                rel=rel, starts=starts, every_ns=self.every_ns, dt=dt)
         if plan is None:
             STATS.incr("executor", "grid_decode_fallbacks")
         return plan
@@ -556,7 +563,12 @@ class GridBatch:
             # colcache device tier) reuse them without any transfer
             from opengemini_tpu.ops import device_decode
 
-            stats, vt, mt, flat_d = device_decode.run_grid_plan(plan)
+            plan_mesh = getattr(plan, "mesh", None)
+            if plan_mesh is not None:
+                stats, vt, mt, flat_d = \
+                    device_decode.run_mesh_grid_plan(plan)
+            else:
+                stats, vt, mt, flat_d = device_decode.run_grid_plan(plan)
             st["encoded_plan"] = None
             ent = None
             if self.device_cache_token is not None:
@@ -564,11 +576,12 @@ class GridBatch:
 
                 ent = colcache.GLOBAL.device_put_grid(
                     self.device_cache_token, vt, mt,
-                    shape=st["shape"], dtype=str(self.dtype), mesh=None)
+                    shape=st["shape"], dtype=str(self.dtype),
+                    mesh=plan_mesh)
             if ent is None:
                 ent = {"vt": vt, "mt": mt, "imat": None,
                        "shape": st["shape"], "dtype": str(self.dtype),
-                       "mesh": None}
+                       "mesh": plan_mesh}
             # device-resident scatter slots, QUERY-scoped (on st, not
             # the retained cache entry — the cache's budget/ledger
             # accounting must not carry unaccounted buffers): this

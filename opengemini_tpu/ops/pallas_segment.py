@@ -364,3 +364,37 @@ def widen_packed(raw, width: int, cnt: int):
     guarantee width in (1, 2) so int32 is exact."""
     return _widen_call(jnp.asarray(raw), width=width, cnt=cnt,
                        interpret=_interpret())
+
+
+# -- bit unpack (gorilla device decode, ops/device_decode.py) ----------------
+
+
+def _unpack_bits_kernel(b_ref, out_ref):
+    """(nbytes,) uint8 -> (nbytes, 8) int32 bits, MSB-first within each
+    byte (np.unpackbits order — the gorilla stream's bit order).  int32
+    out keeps x64 interpret mode off int64 (the int32-ref rule)."""
+    b = b_ref[...].astype(jnp.int32)
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.int32)
+    out_ref[...] = ((b[:, None] >> shifts) & 1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("nbytes", "interpret"))
+def _unpack_bits_call(raw, *, nbytes: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    out = pl.pallas_call(
+        _unpack_bits_kernel,
+        out_shape=jax.ShapeDtypeStruct((nbytes, 8), jnp.int32),
+        interpret=interpret,
+    )(raw)
+    return out.reshape(nbytes * 8)
+
+
+def unpack_bits(raw, nbytes: int):
+    """Unpack `nbytes` payload bytes into a flat (nbytes*8,) int32 bit
+    vector, MSB-first per byte — the bit-addressing substrate of the
+    device-side gorilla decode (templated on the same probed pallas
+    routing as widen_packed; ops/device_decode.py carries the jnp
+    shift/mask fallback where the probe fails)."""
+    return _unpack_bits_call(jnp.asarray(raw), nbytes=nbytes,
+                             interpret=_interpret())

@@ -461,20 +461,30 @@ def merge_bulk_parts(
     parts = [(s, r) for s, r in parts if len(r)]
     if not parts:
         return np.empty(0, np.int64), Record(np.empty(0, np.int64), {})
-    if len(parts) == 1:
-        # a single part already strictly (sid, time)-sorted (the memtable
-        # consolidation, or one packed colstore chunk) needs no merge at
-        # all — one monotonicity pass + a time mask instead of the
-        # three-key lexsort (the profiled hot spot of warm unflushed
-        # scans)
-        s, r = parts[0]
-        ds = np.diff(s)
-        if not len(ds) or ((ds > 0) | ((ds == 0) & (np.diff(r.times) > 0))).all():
-            m = (r.times >= lo_t) & (r.times < hi_t)
-            if m.all():
-                return s, r
-            idx = np.flatnonzero(m)
-            return s[idx], r.take(idx)
+    # parts whose in-order concatenation is ALREADY strictly
+    # (sid, time)-sorted need no merge at all: one part (the memtable
+    # consolidation, one packed colstore chunk), or several packed
+    # chunks written series-ascending (a big flush streams a chunk
+    # every PACK_ROWS rows, never splitting a series).  One
+    # monotonicity pass + a time mask instead of the three-key lexsort,
+    # and — the part that matters for the device-decode cold path —
+    # Record.concat/take keep still-encoded columns ENCODED, where the
+    # general merge below materializes them on the host.
+    s_cat = (parts[0][0] if len(parts) == 1
+             else np.concatenate([s for s, _r in parts]))
+    t_cat = (parts[0][1].times if len(parts) == 1
+             else np.concatenate([r.times for _s, r in parts]))
+    ds = np.diff(s_cat)
+    if not len(ds) or (
+            (ds > 0) | ((ds == 0) & (np.diff(t_cat) > 0))).all():
+        rec = parts[0][1]
+        for _s, r in parts[1:]:
+            rec = rec.concat(r)
+        m = (t_cat >= lo_t) & (t_cat < hi_t)
+        if m.all():
+            return s_cat, rec
+        idx = np.flatnonzero(m)
+        return s_cat[idx], rec.take(idx)
     fast = _merge_bulk_sorted_fast(parts, lo_t, hi_t)
     if fast is not None:
         return fast

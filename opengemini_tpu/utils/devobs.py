@@ -186,13 +186,18 @@ def _note_stage(name: str, ns: int) -> None:
 _hist_cache: dict[tuple, object] = {}
 
 
-def _hist(family: str, site: str, unit: str):
-    key = (family, site)
+def _hist(family: str, site: str, unit: str, mesh: bool = False):
+    key = (family, site, mesh)
     h = _hist_cache.get(key)
     if h is None:
         from opengemini_tpu.utils.stats import histogram
 
-        h = _hist_cache[key] = histogram(family, unit=unit, site=site)
+        labels = {"site": site}
+        if mesh:
+            # the mesh dimension only appears on sharded transfers, so
+            # every pre-existing site keeps its exact label set
+            labels["mesh"] = "on"
+        h = _hist_cache[key] = histogram(family, unit=unit, **labels)
     return h
 
 
@@ -297,23 +302,27 @@ def recent_compiles() -> list[dict]:
 
 
 def note_transfer(direction: str, site: str, nbytes: int,
-                  seconds: float | None = None) -> None:
+                  seconds: float | None = None,
+                  mesh: bool = False) -> None:
     """The single chokepoint for device transfer accounting.  Always
     owns the `device/{h2d,d2h,reshard}_bytes` counters; armed it adds
     the per-site byte/latency histograms and attributes the wall to the
-    running query's `device_transfer` stage."""
+    running query's `device_transfer` stage.  ``mesh=True`` marks a
+    transfer made under a configured device mesh (a `mesh="on"` label on
+    the site's histograms — the sharded-decode H2D is distinguishable
+    from the single-device one at the same site)."""
     nbytes = int(nbytes)
     # counter spelled *_total so the unlabeled family name stays free
     # for the per-site histogram of the same quantity
     _STATS.incr("device", direction + "_bytes_total", nbytes)
     if not _ON:
         return
-    _hist("device_" + direction + "_bytes", site, "bytes").observe_ns(
-        nbytes)
+    _hist("device_" + direction + "_bytes", site, "bytes",
+          mesh).observe_ns(nbytes)
     if seconds is not None:
         ns = int(seconds * 1e9)
-        _hist("device_" + direction + "_seconds", site,
-              "seconds").observe_ns(ns)
+        _hist("device_" + direction + "_seconds", site, "seconds",
+              mesh).observe_ns(ns)
         _note_stage("device_transfer", ns)
 
 

@@ -341,3 +341,159 @@ def test_prom_tiled_device_decode_identity(env, monkeypatch, rng):
         json.dumps(got_host, sort_keys=True)
     assert json.dumps(got, sort_keys=True) == \
         json.dumps(want, sort_keys=True)
+
+
+# -- full codec family: gorilla / varint / strdict (ISSUE 16) ----------------
+
+
+def _gorilla_cases(rng):
+    """Compressible float streams the profile writer sends to the native
+    gorilla codec: quantized values, long repeats, NaN/±0.0/inf payloads
+    — XOR carries no arithmetic, so device decode must be NaN-exact."""
+    yield np.round(np.cumsum(rng.standard_normal(300)), 1)
+    yield np.repeat(rng.standard_normal(12), 40)
+    v = np.round(np.cumsum(rng.standard_normal(256)), 2)
+    v[::11] = np.nan
+    v[5] = np.inf
+    v[6] = -np.inf
+    v[7:9] = [0.0, -0.0]
+    yield v
+    yield np.zeros(200)
+    yield np.array([3.5])
+
+
+def _varint_cases(rng):
+    """Int streams the profile writer sends to the native varint-delta
+    codec: small deltas with occasional wide outliers, sign flips,
+    int64-boundary values (zigzag + mod-2^64 cumsum on device)."""
+    v = np.cumsum(rng.integers(-3, 4, 400)).astype(np.int64)
+    v[::97] += 2**40
+    yield v
+    yield rng.integers(-5, 6, 513).astype(np.int64).cumsum()
+    yield np.array([2**62, -2**62, 0, -1, 1], np.int64)
+    yield np.array([-7], np.int64)
+
+
+def test_gorilla_device_decode_fuzz(profile_on, rng):
+    for v in _gorilla_cases(rng):
+        buf = enc.encode_floats(v)
+        db = enc.device_block(buf)
+        if db is None or db.kind != "gorilla":
+            continue  # writer chose raw64 (incompressible) — fine
+        got = np.asarray(dd.decode_to_device([buf]))
+        np.testing.assert_array_equal(
+            got.view(np.uint64), enc.decode_floats(buf).view(np.uint64))
+
+
+def test_varint_device_decode_fuzz(profile_on, rng):
+    hit = 0
+    for v in _varint_cases(rng):
+        buf = enc.encode_ints(v)
+        db = enc.device_block(buf)
+        if db is None or db.kind != "varint":
+            continue
+        hit += 1
+        got = np.asarray(dd.decode_to_device([buf]))
+        np.testing.assert_array_equal(got, enc.decode_ints(buf))
+    assert hit >= 2, "varint cases unexpectedly all fell to FOR/const"
+
+
+def test_strdict_device_decode_indices(profile_on, rng):
+    """strdict ships the min-width index array; the uniq table stays on
+    the host — device indices gathered through the table must equal the
+    host string decode."""
+    vals = rng.choice(["info", "warn", "error", "debug"], 300)
+    buf = enc.encode_strings(vals)
+    db = enc.device_block(buf)
+    assert db is not None and db.kind == "strdict"
+    assert db.table is not None and len(db.table) <= 4
+    idx = np.asarray(dd.decode_to_device([buf], dtype=np.int64))
+    got = np.asarray([db.table[i] for i in idx])
+    np.testing.assert_array_equal(got, enc.decode_strings(buf))
+
+
+def test_mixed_codec_signature(profile_on, rng):
+    """One program over const+delta+raw64+gorilla+varint blocks: the
+    packed payload offsets and aux vectors must line up per block."""
+    blocks, want = [], []
+    v1 = np.arange(0, 500, 5, dtype=np.int64)
+    v2 = np.cumsum(rng.integers(-2, 3, 300)).astype(np.int64)
+    v3 = rng.standard_normal(200)
+    v4 = np.repeat(np.round(rng.standard_normal(8), 1), 25)
+    for v, encode in ((v1, enc.encode_ints), (v2, enc.encode_ints),
+                      (v3, enc.encode_floats), (v4, enc.encode_floats)):
+        buf = encode(v)
+        blocks.append(buf)
+        want.append(np.asarray(v, np.float64))
+    kinds = [enc.device_block(b).kind for b in blocks]
+    assert "varint" in kinds and "gorilla" in kinds
+    got = np.asarray(dd.decode_to_device(blocks, dtype=np.float64))
+    np.testing.assert_array_equal(
+        got.view(np.uint64), np.concatenate(want).view(np.uint64))
+
+
+def test_codec_knob_excludes(profile_on, monkeypatch, rng):
+    """OGT_DEVICE_DECODE_CODECS narrows the device family: an excluded
+    codec fails classification (-> host fallback), the others keep
+    working, and the default is everything."""
+    g = enc.encode_floats(np.repeat(np.round(rng.standard_normal(8), 1),
+                                    30))
+    assert enc.device_block(g).kind == "gorilla"
+    assert dd.classify([g]) is not None
+    monkeypatch.setenv("OGT_DEVICE_DECODE_CODECS", "const,delta,raw64")
+    assert dd.classify([g]) is None
+    r = enc.encode_floats(rng.standard_normal(64))
+    assert dd.classify([r]) is not None  # raw64 still allowed
+    monkeypatch.delenv("OGT_DEVICE_DECODE_CODECS")
+    assert dd.classify([g]) is not None
+
+
+def test_cost_gate_keeps_incompressible_on_host(profile_on, rng):
+    """Two gates: the WRITER refuses gorilla when the stream does not
+    shrink (random mantissas -> raw64 envelope), and the PLANNER refuses
+    a fused plan whose encoded transfer would not beat the decoded grid
+    it replaces."""
+    incompressible = rng.standard_normal(256) * 1e17
+    buf = enc.encode_floats(incompressible)
+    assert enc.device_block(buf).kind == "raw64"  # writer gate
+
+    # planner gate: a tight grid (cells == n) with full-width raw64
+    # payload + explicit int32 slots transfers MORE than the grid
+    S_pad, k, w_pad = 8, 1, 128
+    n = S_pad * k * w_pad
+    v = rng.standard_normal(n) * 1e17
+    blocks = [enc.encode_floats(v)]
+    assert enc.device_block(blocks[0]).kind == "raw64"
+    views = [(blocks, np.array([[0, n]], np.int64), n)]
+    flat = rng.permutation(n).astype(np.int64)
+    before = dd._STATS.snapshot().get("device", {}).get(
+        "decode_fallbacks_total", 0)
+    plan = dd.build_grid_plan(views, flat, np.ones(n, bool),
+                              (S_pad, k, w_pad), np.float64)
+    assert plan is None, "cost gate must refuse a transfer-losing plan"
+    assert dd._STATS.snapshot().get("device", {}).get(
+        "decode_fallbacks_total", 0) > before
+
+
+def test_per_codec_decode_counters(profile_on, rng):
+    """/debug/device contract: each decoded block increments its codec's
+    decode_blocks_/decode_payload_bytes_ family alongside aggregates."""
+    from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+    def counters():
+        c = STATS.snapshot().get("device", {})
+        return {k: v for k, v in c.items() if k.startswith("decode_")}
+
+    v = np.cumsum(rng.integers(-2, 3, 300)).astype(np.int64)
+    buf = enc.encode_ints(v)
+    assert enc.device_block(buf).kind == "varint"
+    sig, payload, _s, _a, _b = dd._pack_blocks(dd.classify([buf]))
+    before = counters()
+    dd._note_decode_stats(sig, 300)
+    after = counters()
+    assert after.get("decode_blocks_varint_total", 0) == \
+        before.get("decode_blocks_varint_total", 0) + 1
+    assert after.get("decode_payload_bytes_varint_total", 0) == \
+        before.get("decode_payload_bytes_varint_total", 0) + len(payload)
+    assert after["decode_blocks_total"] == \
+        before.get("decode_blocks_total", 0) + 1

@@ -165,6 +165,7 @@ class TestBlockChecksums:
     def test_colcache_fill_path_verifies(self, tmp_path, monkeypatch):
         from opengemini_tpu.storage import colcache
 
+        prior = colcache.GLOBAL.config()
         colcache.GLOBAL.configure(budget_mb=64)
         try:
             eng = _mk_engine(tmp_path)
@@ -179,7 +180,8 @@ class TestBlockChecksums:
                 sh.read_series("m", sid)
             eng.close()
         finally:
-            colcache.GLOBAL.configure(budget_mb=0)
+            colcache.GLOBAL.clear()
+            colcache.GLOBAL.configure(**prior)
 
     def test_truncated_file_quarantined_at_open(self, tmp_path):
         eng = _mk_engine(tmp_path)

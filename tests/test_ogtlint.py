@@ -323,6 +323,45 @@ def test_ogt050_device_decode_metric_family(tmp_path):
         "device.Decode_Rows", "device_decode-site"]
 
 
+def test_ogt010_device_decode_codecs_knob(tmp_path):
+    """The ISSUE 16 knob: OGT_DEVICE_DECODE_CODECS rides the same
+    OGT010 contract as its siblings — the documented spelling passes,
+    an undocumented per-codec variant is a finding."""
+    root = _tree(tmp_path, {
+        "README.md": ("Decode on device knobs: `OGT_DEVICE_PROFILE`, "
+                      "`OGT_DEVICE_DECODE`, `OGT_DEVICE_DECODE_CODECS`.\n"),
+        "opengemini_tpu/ops/devdec_mod.py": (
+            "import os\n"
+            "a = os.environ.get('OGT_DEVICE_DECODE_CODECS', 'all')\n"  # ok
+            "b = os.environ.get('OGT_DEVICE_DECODE_GORILLA', '')\n"    # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT010")
+    assert [f.detail for f in found] == ["OGT_DEVICE_DECODE_GORILLA"]
+
+
+def test_ogt050_per_codec_and_mesh_metric_family(tmp_path):
+    """The ISSUE 16 metrics: per-codec decode counters
+    (decode_blocks_<codec>_total / decode_payload_bytes_<codec>_total)
+    and the mesh transfer counter obey the grammar; codec names are
+    lowered into the KEY, never dashed into a histogram FAMILY, and
+    mesh=on is a label, not a family suffix."""
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": (
+            "GLOBAL.incr('device', 'decode_blocks_gorilla_total')\n"        # ok
+            "GLOBAL.incr('device', 'decode_payload_bytes_varint_total')\n"  # ok
+            "GLOBAL.incr('device', 'decode_blocks_strdict_total')\n"        # ok
+            "GLOBAL.incr('device', 'mesh_h2d_bytes', 42)\n"                 # ok
+            "histogram('device_h2d_bytes', site='device-decode', mesh='on')\n"
+            "histogram('device_h2d_bytes-mesh')\n"                # finding
+            "GLOBAL.incr('device', 'decode_blocks_GORILLA_total')\n"  # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT050")
+    assert sorted(f.detail for f in found) == [
+        "device.decode_blocks_GORILLA_total", "device_h2d_bytes-mesh"]
+
+
 # -- baseline + output formats ------------------------------------------------
 
 

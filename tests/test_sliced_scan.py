@@ -9,6 +9,8 @@ Reference analogue: the record-plan batch reader streams chunks
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 import pytest
 
@@ -65,6 +67,34 @@ def _run_both(ex, q, monkeypatch):
     return mono, sliced
 
 
+def _assert_equiv(a, b, path="$"):
+    """Structural equality, with floats bounded instead of exact.
+
+    The sliced path reduces each slice's bucket matrix separately and the
+    shapes differ from the monolithic scan's, so XLA's f32 `sum` may pick
+    a different accumulation order; `mean` on the irregular/bucketed
+    layout then differs in the last f32 ulp (~6e-8 relative observed).
+    Everything structural — keys, ordering, counts, ints, strings, nulls
+    — must still match exactly; floats get a tolerance with >10x margin
+    over the observed divergence but far below any real aggregation bug.
+    """
+    assert type(a) is type(b), f"{path}: {type(a)} != {type(b)}"
+    if isinstance(a, dict):
+        assert a.keys() == b.keys(), f"{path}: keys {a.keys()} != {b.keys()}"
+        for k in a:
+            _assert_equiv(a[k], b[k], f"{path}.{k}")
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b), f"{path}: len {len(a)} != {len(b)}"
+        for i, (x, y) in enumerate(zip(a, b)):
+            _assert_equiv(x, y, f"{path}[{i}]")
+    elif isinstance(a, float):
+        ok = (a == b or (math.isnan(a) and math.isnan(b))
+              or math.isclose(a, b, rel_tol=1e-6, abs_tol=1e-12))
+        assert ok, f"{path}: {a!r} !~ {b!r}"
+    else:
+        assert a == b, f"{path}: {a!r} != {b!r}"
+
+
 QUERIES = [
     "SELECT mean(v), max(v), count(v) FROM cpu WHERE time >= {lo} AND "
     "time < {hi} GROUP BY time(1m)",
@@ -101,7 +131,10 @@ class TestSlicedEqualsMonolithic:
              f"time >= {BASE * NS} AND time < {(t_end + 1) * NS} "
              "GROUP BY time(30s), host")
         mono, sliced = _run_both(ex, q, monkeypatch)
-        assert mono == sliced
+        # exact equality does not hold here: see _assert_equiv — the
+        # bucketed layout's per-slice f32 sums accumulate in a different
+        # order than the monolithic scan's, so mean() drifts by one ulp
+        _assert_equiv(mono, sliced)
 
     def test_memtable_rows_included(self, env, monkeypatch):
         e, ex = env
