@@ -1380,6 +1380,141 @@ def bench_overload_shed(clients: int = 32, duration_s: float = 6.0,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_offload_planner(clients: int = 4, duration_s: float = 3.0,
+                          warmup_s: float | None = None) -> dict:
+    """Adaptive offload planner (ISSUE 17 acceptance metric): the
+    mixed-shape fleet (tools/loadgen.py --scenario mixed_shapes — zipf
+    tiny dashboard queries interleaved with heavy cold scans over
+    device-profile data) under the adaptive planner vs forced-all-host
+    vs forced-all-device.  Result bodies are asserted BIT-IDENTICAL
+    across all three legs (the per-query sha256 fingerprints the
+    scenario records after the fleet — x64 keeps host and device f64),
+    and the per-class + aggregate p99 comparison and the planner's
+    route/decision counts land in the round artifact: the planner must
+    keep the recurring tiny shapes off the per-geometry compile path
+    and reserve the device for the shapes that amortize it."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import loadgen as _loadgen
+
+    from opengemini_tpu.ops import device_decode as devdec
+    from opengemini_tpu.query import offload
+    from opengemini_tpu.server.http import HttpService
+    from opengemini_tpu.storage import colcache
+    from opengemini_tpu.storage.engine import Engine
+    from opengemini_tpu.utils import devobs
+
+    cc = colcache.GLOBAL
+    prev_cc = cc.config()
+    prev_env = {k: os.environ.get(k) for k in
+                ("OGT_DEVICE_PROFILE", "OGT_RESULT_CACHE")}
+    prev_enabled = offload.enabled()
+    prev_force = offload.force_route()
+    prev_devobs = devobs.enabled()
+    prev_x64 = bool(jax.config.jax_enable_x64)
+    if not prev_x64 and jax.default_backend() == "cpu":
+        jax.config.update("jax_enable_x64", True)
+    devdec._backend_ok.cache_clear()
+    try:
+        if not devdec.active():
+            return {"skipped": "device decode inactive on this backend "
+                               "(requires jax x64)"}
+        os.environ["OGT_DEVICE_PROFILE"] = "1"  # encoded TSF columns
+        # every query must EXECUTE (the legs compare execution routes;
+        # a result-cache full hit would compare cache lookups instead)
+        os.environ["OGT_RESULT_CACHE"] = "0"
+        cc.configure(device=True)
+        # the planner's compile-cost prior reads per-(kernel, geometry)
+        # compile walls from the devobs inventory — armed-only telemetry
+        # (the warmup leg's compiles seed the adaptive leg's estimates)
+        devobs.reset()
+        devobs.set_enabled(True)
+
+        if warmup_s is None:
+            warmup_s = duration_s
+
+        def leg(force: str | None, leg_duration: float,
+                leg_warmup: float | None = None) -> dict:
+            offload.reset()
+            offload.set_enabled(True)
+            offload.set_force(force)
+            cc.clear()
+            # each leg pays its OWN decode-program compiles — the
+            # shared lru caches would otherwise credit later legs with
+            # the first leg's compile work (the shared reduce kernels
+            # are pre-warmed once by the warmup leg below instead)
+            devdec._grid_program.cache_clear()
+            devdec._rows_program.cache_clear()
+            root = tempfile.mkdtemp(prefix="ogtpu-offload-")
+            eng = svc = None
+            try:
+                eng = Engine(os.path.join(root, "data"), sync_wal=False)
+                svc = HttpService(eng, port=0)
+                svc.start()
+                return _loadgen.run_mixed_shapes(
+                    "127.0.0.1", svc.port, clients=clients,
+                    duration_s=leg_duration,
+                    warmup_s=(warmup_s if leg_warmup is None
+                              else leg_warmup))
+            finally:
+                if svc is not None:
+                    svc.stop()
+                if eng is not None:
+                    eng.close()
+                shutil.rmtree(root, ignore_errors=True)
+
+        # warmup: jax init + the shared (route-independent) jit kernels
+        # compile once here, so no leg carries the process's first-ever
+        # dispatch; discarded
+        leg(None, min(1.0, duration_s), leg_warmup=0.0)
+        adaptive = leg(None, duration_s)
+        all_host = leg("host", duration_s)
+        all_device = leg("device", duration_s)
+        for name, res in (("all_host", all_host),
+                          ("all_device", all_device)):
+            assert res["fingerprints"] == adaptive["fingerprints"], (
+                f"offload planner: {name} leg results diverge from "
+                f"adaptive: {res['fingerprints']} "
+                f"vs {adaptive['fingerprints']}")
+            assert not res["errors"] and not adaptive["errors"], (
+                "offload planner legs saw query errors: "
+                f"{res['error_samples'] or adaptive['error_samples']}")
+        p99 = {name: res["aggregate_p99_ms"]
+               for name, res in (("adaptive", adaptive),
+                                 ("all_host", all_host),
+                                 ("all_device", all_device))}
+        return {
+            "aggregate_p99_ms": p99,
+            "adaptive_beats_host": p99["adaptive"] < p99["all_host"],
+            "adaptive_beats_device": p99["adaptive"] < p99["all_device"],
+            "results_identical": True,  # asserted above
+            "adaptive": adaptive,
+            "all_host": all_host,
+            "all_device": all_device,
+        }
+    finally:
+        offload.reset()
+        offload.set_enabled(prev_enabled)
+        offload.set_force(prev_force)
+        devobs.set_enabled(prev_devobs)
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        cc.configure(**prev_cc)
+        if not prev_x64 and jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", False)
+        devdec._backend_ok.cache_clear()
+
+
 def bench_observability_overhead(series: int = 100, points: int = 2000,
                                  rounds: int = 5) -> dict:
     """Cost of the armed observability layer (PR 8): the identical warm
@@ -2989,6 +3124,29 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     except Exception as e:  # noqa: BLE001 — bench must still emit
         print(f"bench: overload shed failed: {e}", file=sys.stderr)
 
+    # adaptive offload planner (ISSUE 17): mixed-shape fleet, adaptive
+    # vs forced-all-host vs forced-all-device — results bit-identical
+    # asserted across all three, p99 comparison in the artifact
+    offload_planner = None
+    try:
+        offload_planner = bench_offload_planner(
+            clients=int(os.environ.get("OGTPU_BENCH_OFFLOAD_CLIENTS",
+                                       "4")),
+            duration_s=float(os.environ.get("OGTPU_BENCH_OFFLOAD_S",
+                                            "3")))
+        if offload_planner.get("skipped"):
+            print("bench: offload planner skipped: "
+                  + offload_planner["skipped"], file=sys.stderr)
+        else:
+            p99 = offload_planner["aggregate_p99_ms"]
+            _emit("offload_planner_aggregate_p99_ms" + suffix,
+                  p99["adaptive"], "ms",
+                  round(min(p99["all_host"], p99["all_device"])
+                        / max(p99["adaptive"], 1e-9), 3),
+                  {"detail": offload_planner})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: offload planner failed: {e}", file=sys.stderr)
+
     # observability overhead: identical warm e2e query, tracing +
     # histograms + slow-log armed vs disabled — < 3% with bit-identical
     # results asserted in-bench (the PR 8 acceptance metric)
@@ -3113,6 +3271,8 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
         extra["rollup_dashboard"] = rollup_dash
     if overload:
         extra["overload_shed"] = overload
+    if offload_planner and not offload_planner.get("skipped"):
+        extra["offload_planner"] = offload_planner
     if obs_overhead:
         extra["observability_overhead"] = obs_overhead
     if scrub_overhead:

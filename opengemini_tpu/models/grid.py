@@ -28,6 +28,7 @@ row order (selector timestamp resolution is unchanged).
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 
@@ -257,11 +258,22 @@ class GridBatch:
                 self.device_cache_token,
                 shape=(S_pad, k, W_pad), dtype=str(self.dtype), mesh=mesh)
         enc_plan = None
+        host_s = None
         if dev_entry is None:
             enc_plan = self._encoded_plan((S_pad, k, W_pad), flat, mesh,
                                           rel, bnd_idx, dt)
-            arrays = (None if enc_plan is not None
-                      else self._scatter_grid((S_pad, k, W_pad), flat))
+            if enc_plan is not None:
+                arrays = None
+            else:
+                # host route: the decode (through _EncodedVals.__array__)
+                # + scatter wall; each launch adds its own dispatch wall
+                # so the planner's host samples cover the same span the
+                # fused device sample does — including the selector
+                # group's second full-grid transfer, which the device
+                # route avoids by keeping the grid resident
+                t0 = time.perf_counter()
+                arrays = self._scatter_grid((S_pad, k, W_pad), flat)
+                host_s = time.perf_counter() - t0
         else:
             arrays = None
         run_gid = (seg[bnd_idx] // W).astype(np.int64)
@@ -274,7 +286,7 @@ class GridBatch:
         return {
             "k": k, "S": S, "W_pad": W_pad, "shape": (S_pad, k, W_pad),
             "arrays": arrays, "device_entry": dev_entry,
-            "encoded_plan": enc_plan,
+            "encoded_plan": enc_plan, "host_route_s": host_s,
             # imat (sample-index grid for the selector kernels) builds
             # lazily from `flat` — count/sum/mean scans never pay for it
             "imat": None, "flat": flat, "n": n,
@@ -365,13 +377,41 @@ class GridBatch:
         if not self._vals:
             return None
         views = []
+        any_decoded = False
         for v in self._vals:
             col = getattr(v, "col", None)
-            if col is None or col.is_decoded:
+            if col is None:
                 return None
+            if col.is_decoded:
+                # the colcache host tier already decoded this column —
+                # but the encoded blocks are still attached, so the
+                # DEVICE route stays available: a warm planner can
+                # route the repeat back to the accelerator where the
+                # decoded grid goes RESIDENT (colcache device tier)
+                # and every later repeat skips decode AND transfer
+                any_decoded = True
             views.append((col.blocks, col.abs_segments(), col.n_full))
         from opengemini_tpu.ops import device_decode
+        from opengemini_tpu.query import offload
 
+        # THE route decision for the encoded cold scan (query/offload.py):
+        # static prior = today's behavior (attempt the device build on
+        # cold encoded columns — the byte gate stays live as the
+        # planner's zero-sample prior; scatter on the host once the
+        # columns are already decoded), so a cold or disabled planner is
+        # bit-identical to the pre-planner dispatch.  "host" skips the
+        # build — the freeze scatters on the host exactly as it always
+        # has, without counting it as a decode fallback (it is a
+        # routing choice, not a failure)
+        dev_route = "mesh" if mesh is not None else "device"
+        static = "host" if any_decoded else dev_route
+        geo = (tuple(shape), str(self.dtype))
+        route = offload.GLOBAL.decide(
+            "grid_decode", geo, ("host", dev_route), static,
+            stage="grid_decode")
+        if route == "host" and not offload.wants_prewarm(
+                "grid_decode", geo):
+            return None
         mask = np.concatenate(self._mask)
         if mesh is not None:
             plan = device_decode.build_mesh_grid_plan(
@@ -381,6 +421,24 @@ class GridBatch:
             plan = device_decode.build_grid_plan(
                 views, flat, mask, shape, self.dtype,
                 rel=rel, starts=starts, every_ns=self.every_ns, dt=dt)
+        if route == "host":
+            # flip-justified by the planner but not yet compiled: hand
+            # the fused program to the BACKGROUND pre-warmer (the plan
+            # build above is host-side only) — this query still
+            # scatters on the host, and the geometry flips to the
+            # device once the compile lands
+            if plan is not None:
+                if mesh is not None:
+                    geoms = tuple(p.geom for p in plan.shards)
+                    offload.register_builder(
+                        "grid_decode", geo,
+                        lambda gs=geoms: [device_decode._grid_program(g)
+                                          for g in gs])
+                else:
+                    offload.register_builder(
+                        "grid_decode", geo,
+                        lambda g=plan.geom: device_decode._grid_program(g))
+            return None
         if plan is None:
             STATS.incr("executor", "grid_decode_fallbacks")
         return plan
@@ -562,13 +620,19 @@ class GridBatch:
             # kernels (and identically-signed future scans through the
             # colcache device tier) reuse them without any transfer
             from opengemini_tpu.ops import device_decode
+            from opengemini_tpu.query import offload
 
             plan_mesh = getattr(plan, "mesh", None)
+            t0 = time.perf_counter()
             if plan_mesh is not None:
                 stats, vt, mt, flat_d = \
                     device_decode.run_mesh_grid_plan(plan)
             else:
                 stats, vt, mt, flat_d = device_decode.run_grid_plan(plan)
+            offload.GLOBAL.observe(
+                "grid_decode", (st["shape"], str(self.dtype)),
+                "mesh" if plan_mesh is not None else "device",
+                time.perf_counter() - t0)
             st["encoded_plan"] = None
             ent = None
             if self.device_cache_token is not None:
@@ -594,12 +658,24 @@ class GridBatch:
             return stats
         vt, mt, imat = self._device_arrays(with_imat=(kind == "selectors"))
         t0 = devobs.t0()
+        tw = time.perf_counter()
         if kind == "selectors":
             out = _grid_jit(vt.shape, str(vt.dtype), kind)(vt, mt, imat)
         else:
             out = _grid_jit(vt.shape, str(vt.dtype), kind)(vt, mt)
         if t0:
             devobs.note_exec(t0)
+        if st.get("arrays") is not None or st.get("host_route_s") is not None:
+            # host-route planner sample, one per kernel group: the first
+            # launch carries the decode+scatter wall (freeze), every
+            # launch adds its own H2D-and-reduce dispatch — together the
+            # same span the fused device route's single sample covers
+            from opengemini_tpu.query import offload
+
+            base = st.pop("host_route_s", None)
+            offload.GLOBAL.observe(
+                "grid_decode", (st["shape"], str(self.dtype)), "host",
+                (base or 0.0) + (time.perf_counter() - tw))
         return out
 
     supports_want_sel = True

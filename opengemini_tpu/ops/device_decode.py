@@ -83,6 +83,7 @@ import time
 
 import numpy as np
 
+from opengemini_tpu.query import offload
 from opengemini_tpu.storage import encoding
 from opengemini_tpu.utils import devobs
 from opengemini_tpu.utils.stats import GLOBAL as _STATS
@@ -489,9 +490,15 @@ def build_grid_plan(views, flat, mask, shape, dtype, rel=None,
             None if viewruns is None else len(viewruns))
     plan = GridPlan(geom, payload, scalars, aux32, aux8, viewruns,
                     flat32, runmeta, consts, maskbits, n)
-    # cost gate: the fused path must genuinely shrink the transfer below
-    # the decoded grid it replaces (values + mask bytes per padded cell)
-    if plan.transfer_nbytes() >= int(np.prod(shape)) * 9:
+    # cost gate, now the offload planner's zero-sample prior: with no
+    # measured device samples this is the exact byte inequality (the
+    # fused path must shrink the transfer below the decoded grid it
+    # replaces — values + mask bytes per padded cell); once the planner
+    # holds real wall samples for this geometry its decide() owns the
+    # choice and the byte rule stands down
+    if not offload.GLOBAL.gate_prior(
+            "grid_decode", geom, plan.transfer_nbytes(),
+            int(np.prod(shape)) * 9):
         note_fallback()
         return None
     return plan
@@ -531,6 +538,12 @@ def run_grid_plan(plan: GridPlan):
     devobs.note_transfer("h2d", _XFER_SITE, plan.transfer_nbytes(),
                          (time.perf_counter_ns() - t0) / 1e9)
     _note_decode_stats(plan.geom[0], plan.n)
+    geom = plan.geom
+    pw_geo = (len(geom[0]), geom[1], geom[2], geom[3],
+              geom[5] is not None)
+    devobs.note_use("grid_decode_fused", pw_geo)
+    offload.register_builder("grid_decode_fused", pw_geo,
+                             lambda g=geom: _grid_program(g))
     fn = _grid_program(plan.geom)
     t = devobs.t0()
     stats, vt, mt, flat = fn(*dev)
@@ -910,11 +923,16 @@ def decode_rows_matrix(enc, shape, dtype):
     host_in.extend((lo, ln))
     if viewruns is not None:
         host_in.append(viewruns)
-    # cost gate: the encoded transfer must beat the padded value matrix
-    # it replaces (whole-block payloads can exceed a heavily trimmed
-    # view — raw64 floats have no width compression to amortize it)
-    if sum(int(a.nbytes) for a in host_in) >= \
-            S * N * np.dtype(dtype).itemsize:
+    # cost gate (the encoded transfer must beat the padded value matrix
+    # it replaces — whole-block payloads can exceed a heavily trimmed
+    # view; raw64 floats have no width compression to amortize it),
+    # serving as the offload planner's zero-sample prior: measured
+    # device samples for this geometry retire the byte rule
+    rows_geo = (len(sig), n_view, (S, N))
+    if not offload.GLOBAL.gate_prior(
+            "prom_decode_rows", rows_geo,
+            sum(int(a.nbytes) for a in host_in),
+            S * N * np.dtype(dtype).itemsize):
         note_fallback()
         return None
     t0 = time.perf_counter_ns()
@@ -923,6 +941,11 @@ def decode_rows_matrix(enc, shape, dtype):
         "h2d", _XFER_SITE, sum(int(a.nbytes) for a in host_in),
         (time.perf_counter_ns() - t0) / 1e9)
     _note_decode_stats(sig, n_view)
+    devobs.note_use("prom_decode_rows", rows_geo)
+    pw = (sig, n_view, (S, N), np.dtype(dtype).str,
+          None if viewruns is None else len(viewruns))
+    offload.register_builder("prom_decode_rows", rows_geo,
+                             lambda a=pw: _rows_program(*a))
     fn = _rows_program(sig, n_view, (S, N), np.dtype(dtype).str,
                        None if viewruns is None else len(viewruns))
     t = devobs.t0()

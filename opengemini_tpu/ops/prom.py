@@ -1058,10 +1058,16 @@ class ShardedTiled:
             anchor=self)
 
     def _run(self, kernel: str, **opts):
+        from opengemini_tpu.query import offload
         from opengemini_tpu.utils import devobs
 
-        fn = _sharded_tiled_jit(
-            kernel, tuple(sorted(opts.items())), self._meta)
+        opts_t = tuple(sorted(opts.items()))
+        devobs.note_use("prom_" + kernel, (opts_t, self._meta))
+        offload.register_builder(
+            "prom_" + kernel, (opts_t, self._meta),
+            lambda k=kernel, o=opts_t, m=self._meta:
+                _sharded_tiled_jit(k, o, m))
+        fn = _sharded_tiled_jit(kernel, opts_t, self._meta)
         t0 = devobs.t0()
         out = fn(self.arrays)
         if t0:

@@ -117,8 +117,13 @@ def _backend_is_cpu() -> bool:
 def _host_kernels() -> bool:
     """numpy (host) vs jax.numpy (device) for the tiled kernels: on CPU
     backends numpy answers without dispatch or per-shape compile cost;
-    accelerators keep the traced path."""
-    v = os.environ.get("OGT_PROM_HOST_KERNELS", "")
+    accelerators keep the traced path.  OGT_PROM_HOST_KERNELS resolves
+    ONCE through the offload knob layer (hot-reloadable via
+    /debug/ctrl?mod=offload) — not re-read from the environment on
+    every evaluation."""
+    from opengemini_tpu.query import offload
+
+    v = offload.prom_host_kernels_mode()
     if v == "1":
         return True
     if v == "0":
@@ -839,6 +844,73 @@ class PromEngine:
             max_gather_cols=cells * n_max + 64, lane_quantum=lane_q,
             enc=enc)
 
+    def _run_mesh_kernel(self, spec, kind, prep, mesh):
+        """Multi-chip tiled kernels: series axis sharded over the mesh,
+        one jit program per kernel (zero collectives); results sliced
+        back to the real (S, k) window grid on the host."""
+        STATS.incr("prom", "tiled_mesh_kernels")
+        # sharding transfer attributed to the prepare stage (it is
+        # part of building this query's device state, and hiding it
+        # would make /debug/queries' stage sums lie about mesh cost).
+        # NOTE: like every device path here (the dense fallback
+        # included), the mesh kernels compute in the device dtype —
+        # f32 when jax x64 is off — while the host-numpy path is
+        # true f64 (README "Multi-chip execution").
+        with _stage("prom_prepare"):
+            sharded = prep.sharded(mesh)
+        with _stage("prom_kernel"):
+            if kind == "rate":
+                out, valid = sharded.rate(
+                    is_counter=spec["is_counter"],
+                    is_rate=spec["is_rate"])
+            elif kind == "instant_rate":
+                out, valid = sharded.instant_rate(
+                    per_second=spec["per_second"])
+            elif kind == "changes_resets":
+                out, valid = sharded.changes_resets(kind=spec["which"])
+            elif kind == "deriv":
+                out, _icept, valid = sharded.linear_regression()
+            elif kind == "predict":
+                slope, icept, valid = sharded.linear_regression()
+                out = icept + slope * spec["dur"]
+            else:
+                out, valid = sharded.over_time(func=spec["func"])
+        kr = prep.k_real
+        from opengemini_tpu.utils import devobs
+
+        return (devobs.fetch_np(out)[:prep.S, :kr],
+                devobs.fetch_np(valid)[:prep.S, :kr])
+
+    def _run_tiled_kernel(self, spec, kind, prep, host: bool):
+        """Single-device tiled kernels: host numpy or jax.numpy per the
+        planner's route."""
+        STATS.incr("prom", "tiled_kernels")
+        xp = np
+        if not host:
+            import jax.numpy as xp  # noqa: F811 — device path
+        with _stage("prom_kernel"):
+            if kind == "rate":
+                out, valid = prep.rate(
+                    xp, is_counter=spec["is_counter"],
+                    is_rate=spec["is_rate"])
+            elif kind == "instant_rate":
+                out, valid = prep.instant_rate(
+                    xp, per_second=spec["per_second"])
+            elif kind == "changes_resets":
+                out, valid = prep.changes_resets(xp, kind=spec["which"])
+            elif kind == "deriv":
+                out, _icept, valid = prep.linear_regression(xp)
+            elif kind == "predict":
+                slope, icept, valid = prep.linear_regression(xp)
+                out = icept + slope * spec["dur"]
+            else:
+                out, valid = prep.over_time(xp, func=spec["func"])
+        kr = prep.k_real
+        from opengemini_tpu.utils import devobs
+
+        return (devobs.fetch_np(out)[:, :kr],
+                devobs.fetch_np(valid)[:, :kr])
+
     def _run_range_kernel(self, spec, t_ms_all, v_all, lens, eval_times,
                           w, enc=None):
         """Dispatch one range-vector spec: tiled interval reductions when
@@ -855,69 +927,36 @@ class PromEngine:
 
             v_all = device_decode.materialize_enc(enc)
         mesh = _mesh_for_tiled() if prep is not None else None
-        if prep is not None and mesh is not None:
-            # multi-chip: series axis sharded over the mesh, one jit
-            # program per kernel (zero collectives); results sliced back
-            # to the real (S, k) window grid on the host
-            STATS.incr("prom", "tiled_mesh_kernels")
-            # sharding transfer attributed to the prepare stage (it is
-            # part of building this query's device state, and hiding it
-            # would make /debug/queries' stage sums lie about mesh cost).
-            # NOTE: like every device path here (the dense fallback
-            # included), the mesh kernels compute in the device dtype —
-            # f32 when jax x64 is off — while the host-numpy path is
-            # true f64 (README "Multi-chip execution").
-            with _stage("prom_prepare"):
-                sharded = prep.sharded(mesh)
-            with _stage("prom_kernel"):
-                if kind == "rate":
-                    out, valid = sharded.rate(
-                        is_counter=spec["is_counter"],
-                        is_rate=spec["is_rate"])
-                elif kind == "instant_rate":
-                    out, valid = sharded.instant_rate(
-                        per_second=spec["per_second"])
-                elif kind == "changes_resets":
-                    out, valid = sharded.changes_resets(kind=spec["which"])
-                elif kind == "deriv":
-                    out, _icept, valid = sharded.linear_regression()
-                elif kind == "predict":
-                    slope, icept, valid = sharded.linear_regression()
-                    out = icept + slope * spec["dur"]
-                else:
-                    out, valid = sharded.over_time(func=spec["func"])
-            kr = prep.k_real
-            from opengemini_tpu.utils import devobs
-
-            return (devobs.fetch_np(out)[:prep.S, :kr],
-                    devobs.fetch_np(valid)[:prep.S, :kr])
         if prep is not None:
-            STATS.incr("prom", "tiled_kernels")
-            xp = np
-            if not _host_kernels():
-                import jax.numpy as xp  # noqa: F811 — device path
-            with _stage("prom_kernel"):
-                if kind == "rate":
-                    out, valid = prep.rate(
-                        xp, is_counter=spec["is_counter"],
-                        is_rate=spec["is_rate"])
-                elif kind == "instant_rate":
-                    out, valid = prep.instant_rate(
-                        xp, per_second=spec["per_second"])
-                elif kind == "changes_resets":
-                    out, valid = prep.changes_resets(xp, kind=spec["which"])
-                elif kind == "deriv":
-                    out, _icept, valid = prep.linear_regression(xp)
-                elif kind == "predict":
-                    slope, icept, valid = prep.linear_regression(xp)
-                    out = icept + slope * spec["dur"]
-                else:
-                    out, valid = prep.over_time(xp, func=spec["func"])
-            kr = prep.k_real
-            from opengemini_tpu.utils import devobs
+            # route through the offload planner (query/offload.py): the
+            # static prior reproduces today's dispatch exactly — mesh
+            # when configured (a set mesh overrides the host-kernel CPU
+            # shortcut), else host numpy per _host_kernels() — and the
+            # OGT_PROM_HOST_KERNELS override prunes the candidate set,
+            # so the pin and the planner are ONE mechanism
+            from opengemini_tpu.query import offload
 
-            return (devobs.fetch_np(out)[:, :kr],
-                    devobs.fetch_np(valid)[:, :kr])
+            geo = (prep.S, prep.N, prep.k_real)
+            mode = offload.prom_host_kernels_mode()
+            candidates = [c for c in ("host", "device")
+                          if not (mode == "1" and c == "device")
+                          and not (mode == "0" and c == "host")]
+            if mesh is not None:
+                candidates.append("mesh")
+            static = ("mesh" if mesh is not None
+                      else "host" if _host_kernels() else "device")
+            route = offload.GLOBAL.decide(
+                "prom_" + kind, geo, tuple(candidates), static,
+                stage="prom_kernel")
+            t_route = _time.perf_counter()
+            if route == "mesh":
+                out, valid = self._run_mesh_kernel(spec, kind, prep, mesh)
+            else:
+                out, valid = self._run_tiled_kernel(
+                    spec, kind, prep, host=(route == "host"))
+            offload.GLOBAL.observe("prom_" + kind, geo, route,
+                                   _time.perf_counter() - t_route)
+            return out, valid
         # dense fallback (searchsorted window bounds)
         STATS.incr("prom", "dense_kernels")
         with _stage("prom_prepare"):

@@ -132,14 +132,17 @@ def _add_record_to_batches(rec, seg, aligned, needed_fields, batches, dtype,
         m = col.valid
         if fmask is not None:
             m = m & fmask
-        if (getattr(col, "is_decoded", True) is False
+        if (getattr(col, "blocks", None) is not None
                 and hasattr(batch, "add_encoded")):
-            # still-encoded column (record.EncodedColumn) into a device-
-            # decode-capable batch: ship the raw block payloads — the
-            # grid freeze decodes them ON the accelerator, fused with
-            # the window reduce (ops/device_decode.py).  A row filter
-            # that touched this field already decoded it, so this branch
-            # only engages when the values were never needed on host.
+            # record.EncodedColumn into a device-decode-capable batch:
+            # keep the raw block payloads attached — the grid freeze can
+            # ship them to the accelerator and decode fused with the
+            # window reduce (ops/device_decode.py).  A column that is
+            # ALREADY decoded (colcache host-tier hit, or a row filter
+            # touched it) still rides this path: the offload planner
+            # (query/offload.py) decides host-vs-device per repeat, and
+            # host consumers read the memoized values through
+            # _EncodedVals.__array__ — bit-identical either way.
             batch.add_encoded(col, rel, seg, m, rec.times, sids=sids)
             continue
         if isinstance(batch, ragged.IntExactBatch):

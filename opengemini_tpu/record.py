@@ -12,6 +12,7 @@ before transfer.
 
 from __future__ import annotations
 
+import bisect
 import enum
 from dataclasses import dataclass, field
 
@@ -128,20 +129,57 @@ class EncodedColumn(Column):
         self.n_full = len(valid) if n_full is None else int(n_full)
         self._decode = decode  # (ftype, blocks) -> np.ndarray host decode
         self._values: np.ndarray | None = None
+        # provenance of this view's block concatenation as
+        # [(root_column, abs_row_offset)] — the FULL-view columns
+        # (segments None, typically colcache-resident chunk columns)
+        # whose decodes concatenate to exactly this view's blocks.
+        # Host decodes route through each root's memoized .values, so N
+        # views/merges over one cached chunk column cost ONE block
+        # decode process-wide, not N.  None = decode own blocks directly.
+        self._spans: list | None = None
 
     @property
     def is_decoded(self) -> bool:
         return self._values is not None
 
+    def _spans_or_self(self) -> list | None:
+        """This column as root spans, or None when it has no root
+        provenance (a standalone segmented view decodes its own
+        blocks)."""
+        if self._spans is not None:
+            return self._spans
+        if self.segments is None:
+            return [(self, 0)]
+        return None
+
     @property
     def values(self) -> np.ndarray:  # type: ignore[override]
         v = self._values
         if v is None:
-            d = self._decode(self.ftype, self.blocks)
-            if self.segments is not None:
-                d = (np.concatenate([d[a:b] for a, b in self.segments])
-                     if len(self.segments) else d[:0])
-            v = self._values = d
+            spans = self._spans
+            if spans is not None:
+                # slice each [lo, hi) run out of its root's memoized
+                # full decode (runs merged across a root boundary by
+                # take() split back here) — one decode per root ever
+                offs = [off for _r, off in spans] + [self.n_full]
+                pieces = []
+                for a, b in self.abs_segments():
+                    j = bisect.bisect_right(offs, a) - 1
+                    while a < b:
+                        root, off = spans[j]
+                        hi = min(b, offs[j + 1])
+                        pieces.append(root.values[a - off:hi - off])
+                        a = hi
+                        j += 1
+                v = (np.concatenate(pieces) if pieces
+                     else np.empty(0, self.ftype.np_dtype))
+            else:
+                d = self._decode(self.ftype, self.blocks)
+                if self.segments is not None:
+                    d = (np.concatenate([d[a:b] for a, b in self.segments])
+                         if len(self.segments) else d[:0])
+                v = d
+            self._values = v
         return v
 
     def __len__(self) -> int:
@@ -176,8 +214,7 @@ class EncodedColumn(Column):
             return Column(self.ftype,
                           np.empty(0, dtype=self.ftype.np_dtype),
                           np.empty(0, dtype=np.bool_))
-        if self.is_decoded or (
-                len(idx) > 1 and (np.diff(idx) <= 0).any()):
+        if len(idx) > 1 and (np.diff(idx) <= 0).any():
             return super().take(idx)
         abs_idx = self._abs_index()[idx]
         brk = np.flatnonzero(np.diff(abs_idx) != 1)
@@ -185,22 +222,41 @@ class EncodedColumn(Column):
             return super().take(idx)
         lo = np.concatenate([abs_idx[:1], abs_idx[brk + 1]])
         hi = np.concatenate([abs_idx[brk], abs_idx[-1:]]) + 1
-        return EncodedColumn(
+        out = EncodedColumn(
             self.ftype, self.blocks, self.valid[idx], self._decode,
             segments=np.stack([lo, hi], axis=1), n_full=self.n_full)
+        out._spans = self._spans_or_self()
+        if self._values is not None:
+            # already decoded (e.g. a colcache host-tier hit): keep the
+            # blocks attached — the device route stays available for a
+            # warm repeat — and carry the row subset of the memoized
+            # view so no host consumer ever re-decodes
+            out._values = self._values[idx]
+        return out
 
     def concat(self, other: "Column") -> "Column":
-        if (isinstance(other, EncodedColumn) and not self.is_decoded
-                and not other.is_decoded and self.ftype == other.ftype):
+        if (isinstance(other, EncodedColumn)
+                and self.ftype == other.ftype):
             segs = np.concatenate(
                 [self.abs_segments(),
                  other.abs_segments() + self.n_full])
             if len(segs) <= self._SEG_CAP:
-                return EncodedColumn(
+                out = EncodedColumn(
                     self.ftype, self.blocks + other.blocks,
                     np.concatenate([self.valid, other.valid]),
                     self._decode, segments=segs,
                     n_full=self.n_full + other.n_full)
+                s1, s2 = self._spans_or_self(), other._spans_or_self()
+                if s1 is not None and s2 is not None:
+                    out._spans = s1 + [(r, off + self.n_full)
+                                       for r, off in s2]
+                if self._values is not None and other._values is not None:
+                    # both sides already decoded: carry the memoized
+                    # views forward so no host consumer re-decodes;
+                    # mixed decode states stay lazy (bit-identical)
+                    out._values = np.concatenate(
+                        [self._values, other._values])
+                return out
         return super().concat(other)
 
 
@@ -425,25 +481,26 @@ def _merge_bulk_sorted_fast(parts, lo_t: int, hi_t: int):
 
 def _concat_encoded(name, ftype, single, total):
     """Encoded-view concatenation for the sorted-fast merge: when every
-    part contributes this column as a still-encoded EncodedColumn, the
-    merged column composes their (possibly time-trimmed) row views — the
-    decoded bytes never materialize on the host (the device-decode cold
-    path, ops/device_decode.py).  Any decode, absence, or run-cap
-    overflow falls back to the copying path (bit-identical either
-    way)."""
+    part contributes this column as an EncodedColumn, the merged column
+    composes their (possibly time-trimmed) row views.  Still-encoded
+    parts never materialize decoded bytes on the host (the device-decode
+    cold path, ops/device_decode.py); already-decoded parts (colcache
+    host-tier hits on a warm repeat) compose too, carrying their
+    memoized values forward WITH the raw blocks still attached — so the
+    offload planner (query/offload.py) keeps the device route available
+    on every repeat.  Any absence or run-cap overflow falls back to the
+    copying path (bit-identical either way)."""
     merged = None
     for _k, lo, hi, r in single:
         col = r.columns.get(name)
-        if (not isinstance(col, EncodedColumn) or col.is_decoded
-                or col.ftype != ftype):
+        if not isinstance(col, EncodedColumn) or col.ftype != ftype:
             return None
         view = col if (lo == 0 and hi == len(col)) \
             else col.take(np.arange(lo, hi))
-        if not (isinstance(view, EncodedColumn) and not view.is_decoded):
-            return None  # run-cap overflow decoded the trim
+        if not isinstance(view, EncodedColumn):
+            return None  # run-cap overflow dropped the blocks
         merged = view if merged is None else merged.concat(view)
-        if not (isinstance(merged, EncodedColumn)
-                and not merged.is_decoded):
+        if not isinstance(merged, EncodedColumn):
             return None
     if merged is None or len(merged) != total:
         return None

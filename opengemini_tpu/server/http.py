@@ -217,6 +217,10 @@ def _make_handler(svc: HttpService):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         server_version = "opengemini-tpu/" + __version__
+        # headers and payload flush as separate send()s; with Nagle on,
+        # the payload send stalls ~40ms waiting for the client's delayed
+        # ACK of the header packet — every keep-alive response paid it
+        disable_nagle_algorithm = True
 
         def log_message(self, fmt, *args):  # quiet; logging layer comes later
             pass
@@ -496,10 +500,15 @@ def _make_handler(svc: HttpService):
             elif path == "/debug/device":
                 # device-runtime telemetry (utils/devobs.py): device
                 # table, jit-cache inventory, retained-buffer ledger by
-                # owner, bounded recent-compile ring, capability probes
+                # owner, bounded recent-compile ring, capability probes —
+                # plus the offload planner's model/decision state
+                # (query/offload.py; devobs itself stays decoupled)
+                from opengemini_tpu.query import offload as _offload
                 from opengemini_tpu.utils import devobs as _devobs
 
-                self._send_json(200, _devobs.debug_doc())
+                doc = _devobs.debug_doc()
+                doc["planner"] = _offload.GLOBAL.debug_doc()
+                self._send_json(200, doc)
             elif path == "/debug/trace":
                 self._handle_debug_trace(self._params())
             elif path == "/debug/slow":
@@ -1341,6 +1350,68 @@ def _make_handler(svc: HttpService):
                     "ledger_bytes": _devobs.LEDGER.total_bytes(),
                     "profile": _devobs.profile_status(),
                 })
+                return
+            elif mod == "offload":
+                # adaptive offload planner (query/offload.py): arm/clear/
+                # freeze the cost model, tune the decision knobs, pin the
+                # prom host-kernels override, run a pre-warm sweep.
+                # No knobs = status query (the planner debug doc).
+                from opengemini_tpu.query import offload as _offload
+
+                if "arm" in params:
+                    _offload.set_enabled(params["arm"] in ("1", "true"))
+                if "freeze" in params:
+                    _offload.GLOBAL.set_frozen(
+                        params["freeze"] in ("1", "true"))
+                if params.get("clear", "") in ("1", "true"):
+                    _offload.GLOBAL.clear()
+                if "host_kernels" in params:
+                    try:
+                        _offload.set_prom_host_kernels_mode(
+                            params["host_kernels"])
+                    except ValueError as e:
+                        self._send_json(400, {"error": str(e)})
+                        return
+                if "force" in params:
+                    v = params["force"]
+                    try:
+                        _offload.set_force(
+                            None if v in ("", "none") else v)
+                    except ValueError as e:
+                        self._send_json(400, {"error": str(e)})
+                        return
+                knobs = {}
+                for k in ("min_samples", "explore_after"):
+                    if k in params:
+                        try:
+                            knobs[k] = int(params[k])
+                        except ValueError:
+                            self._send_json(400, {
+                                "error": f"bad {k} {params[k]!r}"})
+                            return
+                for k in ("amortize", "ewma"):
+                    if k in params:
+                        try:
+                            knobs[k] = float(params[k])
+                        except ValueError:
+                            self._send_json(400, {
+                                "error": f"bad {k} {params[k]!r}"})
+                            return
+                if knobs:
+                    _offload.GLOBAL.configure(**knobs)
+                op = params.get("op", "")
+                if op == "prewarm":
+                    ran = _offload.prewarm_once()
+                    self._send_json(200, {"status": "ok",
+                                          "prewarmed": ran})
+                    return
+                elif op:
+                    self._send_json(400, {
+                        "error": f"unknown offload op {op!r}"})
+                    return
+                doc = _offload.GLOBAL.debug_doc()
+                doc["status"] = "ok"
+                self._send_json(200, doc)
                 return
             elif mod == "failpoint":
                 from opengemini_tpu.utils import failpoint as _fpmod

@@ -1466,11 +1466,21 @@ class Shard:
         chunks = [(r, c) for r in files
                   for c in r.chunks(measurement, {sid}, tmin, tmax)]
         n_fields = len(fields) if fields is not None else None
+        # same deferred-decode contract as read_series_bulk: eligible
+        # value blocks come back as still-encoded EncodedColumns so the
+        # grid freeze's offload planner (query/offload.py) keeps the
+        # device route available; every host consumer decodes lazily,
+        # bit-identically
+        from opengemini_tpu.ops import device_decode as _devdec
+
+        encoded_ok = _devdec.active()
 
         def decode(r, c):
             if c.packed:
-                return r.read_packed_sid(measurement, c, sid, fields)
-            return r.read_chunk(measurement, c, fields)
+                return r.read_packed_sid(measurement, c, sid, fields,
+                                         encoded_ok=encoded_ok)
+            return r.read_chunk(measurement, c, fields,
+                                encoded_ok=encoded_ok)
 
         # decoded-column cache consult BEFORE pool dispatch
         # (storage/colcache.py): fully-cached chunks assemble inline and

@@ -671,30 +671,44 @@ class TSFReader:
         hi = w_lo + int(np.searchsorted(win, sid, "right"))
         return lo, hi
 
+    @staticmethod
+    def _slice_rows(rec: Record, lo: int, hi: int) -> Record:
+        """Row window [lo, hi) of a chunk record.  Plain columns slice as
+        views; EncodedColumns compose an encoded row-run view instead —
+        keeping the raw blocks attached for the device-decode route while
+        any host consumer decodes ONCE through the shared root column
+        (record.EncodedColumn.take), bit-identically."""
+        cols = {}
+        for name, col in rec.columns.items():
+            if isinstance(col, EncodedColumn):
+                cols[name] = col.take(np.arange(lo, hi))
+            else:
+                cols[name] = Column(col.ftype, col.values[lo:hi],
+                                    col.valid[lo:hi])
+        return Record(rec.times[lo:hi], cols)
+
     def read_packed_sid(
         self, measurement: str, chunk: ChunkMeta, sid: int,
         fields: list[str] | None = None, cache: bool = True,
+        encoded_ok: bool = False,
     ) -> Record:
         """One series' rows out of a packed chunk: the sparse PK index
         bounds the candidate row window (and rejects out-of-span sids
         without touching data), then an exact binary search on the
         (cached) sid column finds the rows — the hybrid store reader
         (reference engine/immutable/colstore reader +
-        sparseindex/primary_index.go)."""
+        sparseindex/primary_index.go).  ``encoded_ok`` defers numeric
+        value decode exactly like read_chunk: the sid's rows come back as
+        an encoded row-run view over the chunk's blocks."""
         if sid < chunk.smin or sid > chunk.smax:
             return Record(np.empty(0, np.int64), {})
         sids = self.read_packed_sids(chunk, cache)
         lo, hi = self._sid_row_range(chunk, sids, sid)
         if lo == hi:
             return Record(np.empty(0, np.int64), {})
-        rec = self.read_chunk(measurement, chunk, fields, cache)
-        return Record(
-            rec.times[lo:hi],
-            {
-                name: Column(col.ftype, col.values[lo:hi], col.valid[lo:hi])
-                for name, col in rec.columns.items()
-            },
-        )
+        rec = self.read_chunk(measurement, chunk, fields, cache,
+                              encoded_ok=encoded_ok)
+        return self._slice_rows(rec, lo, hi)
 
     def read_packed_sid_if_cached(
         self, measurement: str, chunk: ChunkMeta, sid: int,
@@ -719,13 +733,7 @@ class TSFReader:
         if rec is None:
             return None
         cc.count_peek(1)  # the sid-column peek on top of the record's
-        return Record(
-            rec.times[lo:hi],
-            {
-                name: Column(col.ftype, col.values[lo:hi], col.valid[lo:hi])
-                for name, col in rec.columns.items()
-            },
-        )
+        return self._slice_rows(rec, lo, hi)
 
     def read_packed_bulk(
         self, measurement: str, chunk: ChunkMeta,

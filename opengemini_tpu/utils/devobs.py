@@ -162,6 +162,12 @@ def _on_jax_duration(event: str, duration_s: float, **_kw) -> None:
         ent = getattr(_tls, "ring_entry", None)
         if ent is not None and ent.get("kernel") == kernel:
             ent["wall_ms"] = round(ent.get("wall_ms", 0.0) + ns / 1e6, 3)
+        # last-compile wall on the INVENTORY entry too: the offload
+        # planner's compile-cost prior (query/offload.py) reads it from
+        # inventory() per (kernel, geometry), not from the bounded ring
+        geo = getattr(_tls, "geo_entry", None)
+        if geo is not None:
+            geo["wall_ms"] = round(geo.get("wall_ms", 0.0) + ns / 1e6, 3)
     from opengemini_tpu.utils.stats import observe_ns
 
     observe_ns("device_compile_seconds", ns, kernel=kernel)
@@ -227,23 +233,15 @@ def note_compile(kernel: str, geometry=()) -> None:
         "uptime_s": round(time.perf_counter() - _started_pc, 3),
     }
     with _lock:
-        inv = _inventory.get(kernel)
-        if inv is None:
-            inv = _inventory[kernel] = {
-                "compiles": 0, "geometries": OrderedDict(),
-                "geometry_overflow": 0, "repeats": 0}
+        geo_ent = _geo_entry_locked(kernel, geo, epoch)
+        inv = _inventory[kernel]
         inv["compiles"] += 1
-        key = (geo, epoch)
-        got = inv["geometries"].get(key)
-        if got is not None:
-            inv["geometries"][key] = got + 1
-            inv["repeats"] += 1
-            entry["repeat"] = True
-            _STATS.incr("device", "repeat_compiles_total")
-        elif len(inv["geometries"]) < _GEOMETRIES_MAX:
-            inv["geometries"][key] = 1
-        else:
-            inv["geometry_overflow"] += 1
+        if geo_ent is not None:
+            if geo_ent["compiles"]:
+                inv["repeats"] += 1
+                entry["repeat"] = True
+                _STATS.incr("device", "repeat_compiles_total")
+            geo_ent["compiles"] += 1
         if _warm_marked:
             _compiles_since_warm += 1
             entry["after_warm"] = True
@@ -251,6 +249,39 @@ def note_compile(kernel: str, geometry=()) -> None:
         _ring.append(entry)
         _tls.kernel = kernel
         _tls.ring_entry = entry
+        _tls.geo_entry = geo_ent
+
+
+def _geo_entry_locked(kernel: str, geo: str, epoch) -> dict | None:
+    """The per-(geometry, mesh-epoch) inventory record for one kernel
+    (created on first sight, None past the per-kernel bound — the
+    overflow count is the finding then).  Caller holds _lock."""
+    inv = _inventory.get(kernel)
+    if inv is None:
+        inv = _inventory[kernel] = {
+            "compiles": 0, "geometries": OrderedDict(),
+            "geometry_overflow": 0, "repeats": 0}
+    key = (geo, epoch)
+    ent = inv["geometries"].get(key)
+    if ent is None:
+        if len(inv["geometries"]) >= _GEOMETRIES_MAX:
+            inv["geometry_overflow"] += 1
+            return None
+        ent = inv["geometries"][key] = {
+            "compiles": 0, "hits": 0, "wall_ms": 0.0}
+    return ent
+
+
+def note_use(kernel: str, geometry=()) -> None:
+    """Record one WARM dispatch of an already-compiled (kernel,
+    geometry) program — the shape-recurrence signal the offload
+    planner's amortization (query/offload.py) and the pre-warmer's
+    top-K ranking feed on.  Always-on and cheap (two dict lookups under
+    the lock, once per kernel launch)."""
+    with _lock:
+        ent = _geo_entry_locked(kernel, str(geometry), _mesh_epoch())
+        if ent is not None:
+            ent["hits"] += 1
 
 
 def mark_warm() -> None:
@@ -284,9 +315,37 @@ def jit_inventory() -> dict:
         return {
             k: {
                 "compiles": v["compiles"],
-                "distinct_geometries": len(v["geometries"]),
+                # use-only records (note_use before any compile) are not
+                # compiled geometries; the pre-PR counting stands
+                "distinct_geometries": sum(
+                    1 for e in v["geometries"].values() if e["compiles"]),
                 "geometry_overflow": v["geometry_overflow"],
                 "repeat_compiles": v["repeats"],
+            }
+            for k, v in sorted(_inventory.items())
+        }
+
+
+def inventory() -> dict:
+    """Structured per-(kernel, geometry) snapshot for the offload
+    planner's cost model (query/offload.py): each kernel maps to its
+    aggregate counts plus one record per (geometry, mesh-epoch) carrying
+    the compile count, the warm-dispatch hit count (note_use), and the
+    accumulated backend compile wall for that geometry — the
+    recurrence + compile-cost inputs the amortization math needs.
+    jit_inventory() stays the render-only aggregate view."""
+    with _lock:
+        return {
+            k: {
+                "compiles": v["compiles"],
+                "repeat_compiles": v["repeats"],
+                "geometry_overflow": v["geometry_overflow"],
+                "geometries": [
+                    {"geometry": geo, "mesh_epoch": epoch,
+                     "compiles": e["compiles"], "hits": e["hits"],
+                     "wall_ms": e["wall_ms"]}
+                    for (geo, epoch), e in v["geometries"].items()
+                ],
             }
             for k, v in sorted(_inventory.items())
         }

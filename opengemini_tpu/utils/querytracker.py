@@ -130,6 +130,17 @@ class QueryTracker:
                 stages = info.setdefault("stages", {})
                 stages[name] = stages.get(name, 0) + ns
 
+    def note_route(self, qid: int | None, stage: str, route: str) -> None:
+        """Record the offload planner's chosen route (host/device/mesh)
+        for one stage of a running query — /debug/queries shows WHERE a
+        query ran next to where it spent its time.  No-op off-query."""
+        if qid is None:
+            return
+        with self._lock:
+            info = self._running.get(qid)
+            if info is not None:
+                info.setdefault("routes", {})[stage] = route
+
     def raise_if_killed(self, qid: int | None) -> None:
         """check() for threads that carry the qid explicitly instead of
         thread-locally (scan-pool decode workers)."""
@@ -153,6 +164,10 @@ class QueryTracker:
                         for name, ns in info.get("stages", {}).items()
                     },
                 }
+                routes = info.get("routes")
+                if routes:
+                    # offload planner route per stage (query/offload.py)
+                    entry["routes"] = dict(routes)
                 trace = info.get("trace")
                 if trace is not None:
                     # the stitched (so-far) span tree, rendered in place:

@@ -172,15 +172,22 @@ def test_encoded_column_lazy_and_take(profile_on, rng):
     sub = np.arange(3, len(idx) - 2)
     t2 = t1.take(sub)
     assert isinstance(t2, EncodedColumn) and not t2.is_decoded
-    np.testing.assert_array_equal(t2.values, v[idx][sub])
-    # encoded takes never touched the source column's values
+    # composing views alone never decodes anything
     assert not col.is_decoded
-    # non-monotone takes decode (bit-identically) — via the source,
-    # which memoizes
+    np.testing.assert_array_equal(t2.values, v[idx][sub])
+    # materializing a view decodes ONCE through the shared root (the
+    # cache-resident source column): every later view of the same
+    # blocks slices the memoized decode instead of re-decoding
+    assert col.is_decoded
+    # non-monotone takes decode (bit-identically) — via the source
     t3 = col.take(idx[::-1])
     np.testing.assert_array_equal(t3.values, v[idx[::-1]])
-    assert col.is_decoded
     np.testing.assert_array_equal(col.values, v)
+    # a take of a DECODED source keeps the blocks attached (the device
+    # route stays available on warm repeats) and carries the row subset
+    t4 = col.take(idx)
+    assert isinstance(t4, EncodedColumn) and t4.is_decoded and t4.blocks
+    np.testing.assert_array_equal(t4.values, v[idx])
 
 
 def test_encoded_column_concat_views(profile_on, rng):

@@ -362,6 +362,51 @@ def test_ogt050_per_codec_and_mesh_metric_family(tmp_path):
         "device.decode_blocks_GORILLA_total", "device_h2d_bytes-mesh"]
 
 
+def test_ogt010_offload_knob_family(tmp_path):
+    """The ISSUE 17 knobs: OGT_OFFLOAD* reads (planner + pre-warmer +
+    the force/ring tuning) are OGT010 subjects — documented spellings
+    pass, an undocumented sibling in the family is a finding."""
+    root = _tree(tmp_path, {
+        "README.md": ("Adaptive offload knobs: `OGT_OFFLOAD`, "
+                      "`OGT_OFFLOAD_MIN_SAMPLES`, `OGT_OFFLOAD_AMORTIZE`, "
+                      "`OGT_OFFLOAD_FORCE`, `OGT_OFFLOAD_PREWARM`, "
+                      "`OGT_RESULT_CACHE`.\n"),
+        "opengemini_tpu/query/offload_mod.py": (
+            "import os\n"
+            "a = os.environ.get('OGT_OFFLOAD', '1')\n"              # ok
+            "b = os.environ.get('OGT_OFFLOAD_MIN_SAMPLES', '')\n"   # ok
+            "c = os.environ.get('OGT_OFFLOAD_FORCE', '')\n"         # ok
+            "d = os.environ.get('OGT_OFFLOAD_PREWARM', '')\n"       # ok
+            "e = os.environ.get('OGT_RESULT_CACHE', '1')\n"         # ok
+            "f = os.environ.get('OGT_OFFLOAD_TURBO', '')\n"         # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT010")
+    assert [f.detail for f in found] == ["OGT_OFFLOAD_TURBO"]
+
+
+def test_ogt050_offload_metric_family(tmp_path):
+    """The ogt_offload_* family (ISSUE 17): decision/reason/route
+    counters obey the metric grammar as keys of the `offload` module;
+    a route name dashed into the KEY (routes are lowered into the key
+    like codecs, never dashed) or a capitalized reason is a finding."""
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": (
+            "GLOBAL.incr('offload', 'decisions_total')\n"         # ok
+            "GLOBAL.incr('offload', 'observations_total')\n"      # ok
+            "GLOBAL.incr('offload', 'route_host_total')\n"        # ok
+            "GLOBAL.incr('offload', 'prewarm_compiles_total')\n"  # ok
+            "GLOBAL.incr('offload', 'explore_deferred_total')\n"  # ok
+            "GLOBAL.incr('offload', 'gate_vetoes_total')\n"       # ok
+            "GLOBAL.incr('offload', 'route-host_total')\n"        # finding
+            "GLOBAL.incr('offload', 'Amortize_Total')\n"          # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT050")
+    assert sorted(f.detail for f in found) == [
+        "offload.Amortize_Total", "offload.route-host_total"]
+
+
 # -- baseline + output formats ------------------------------------------------
 
 

@@ -624,6 +624,224 @@ def run_dashboard_fleet(host: str, port: int, clients: int = 12,
     }
 
 
+def run_mixed_shapes(host: str, port: int, clients: int = 6,
+                     duration_s: float = 5.0, tiny_shapes: int = 4,
+                     zipf_s: float = 1.2, heavy_every: int = 5,
+                     seed_rows: int = 153600, series: int = 64,
+                     measurement: str = "mix", db: str = "mixed",
+                     base_ns: int = 1_700_000_000 * 10 ** 9,
+                     timeout_s: float = 15.0, seed: int = 11,
+                     warmup_s: float = 0.0) -> dict:
+    """Mixed-shape fleet for the offload planner (query/offload.py):
+    a zipf-popular set of TINY recurring dashboard queries (short range,
+    coarse window — the geometries that recur thousands of times and
+    must never pay a device compile inline) interleaved with HEAVY cold
+    scans (full seeded range at fine granularity — the shapes worth the
+    device once their compile amortizes).  Deterministic end to end:
+    data seeds at fixed absolute timestamps and the read-only query mix
+    derives from `seed`, so two runs against identically-seeded engines
+    return bit-identical bodies — `fingerprints` (sha256 per distinct
+    query, issued once single-threaded after the fleet) is the equality
+    contract bench.py's offload_planner legs assert on.  Reports
+    per-class (tiny/heavy) p50/p99 and the planner's route/decision
+    counter deltas scraped from /debug/device."""
+    import hashlib
+    import random
+    from urllib.parse import quote
+
+    # >= 64 series: the encoded (device-decodable) columns ride the
+    # BULK scan, which engages at >= 64 series per shard
+    series = max(64, series)
+    step_ns = 10 ** 9  # one point per second per series
+    span_ns = (seed_rows // max(1, series)) * step_ns
+    lo, hi = base_ns, base_ns + span_ns
+
+    # seed: `series` tagged series, one point/second, fixed timestamps
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    conn.request("POST", "/query?q=" + quote(f'CREATE DATABASE "{db}"'))
+    conn.getresponse().read()
+    n_per = seed_rows // max(1, series)
+    for s in range(series):
+        body = "".join(
+            f"{measurement},series=s{s} v={float((s * 131 + k * 17) % 997)}"
+            f" {base_ns + k * step_ns}\n"
+            for k in range(n_per)
+        ).encode()
+        conn.request("POST", f"/write?db={db}", body=body)
+        resp = conn.getresponse()
+        resp.read()
+        if resp.status != 204:
+            conn.close()
+            raise RuntimeError(f"mixed_shapes seed write: {resp.status}")
+    # flush to TSF before the fleet: the offload routes under test are
+    # the ENCODED-column paths (device decode needs flushed blocks); a
+    # live memtable tail would pin every scan to the host for the wrong
+    # reason
+    conn.request("POST", "/debug/ctrl?mod=flush")
+    conn.getresponse().read()
+    conn.close()
+
+    # tiny shapes: distinct (range, window) pairs — each is ONE
+    # recurring geometry; zipf popularity concentrates repeats on the
+    # hot ones exactly like a dashboard fleet does
+    tiny = []
+    for i in range(tiny_shapes):
+        # short ranges: a tiny query touches ~5-8% of the span, the
+        # dashboard "last N minutes" shape — cheap on the host, never
+        # worth a per-geometry device compile
+        r_ns = span_ns // (12 + 3 * i)  # distinct ranges -> shapes
+        w_s = 30 + 15 * i
+        tiny.append(
+            f"SELECT mean(v) FROM {measurement} "
+            f"WHERE time >= {hi - r_ns} AND time < {hi} "
+            f"GROUP BY time({w_s}s)")
+    # heavy scans: a few distinct full-span dashboard panels, each
+    # re-issued round-robin.  SAME padded decode geometry across
+    # variants (constant width + window count + series set -> one
+    # device compile covers all); the result cache is off in the bench
+    # legs, so every issue re-executes — on the host route that is a
+    # full decode+scatter per repeat, while the device route's decoded
+    # grid stays RESIDENT in the colcache device tier and warm repeats
+    # skip the decode entirely.  Residency, not raw decode speed, is
+    # the device route's structural edge the planner has to find.
+    heavy_w_ns = 2 * step_ns
+    heavy_variants = max(1, min(4, (span_ns // heavy_w_ns) // 2))
+    heavy_width = span_ns - heavy_variants * heavy_w_ns
+    heavies = [
+        (f"SELECT mean(v), max(v), count(v) FROM {measurement} "
+         f"WHERE time >= {lo + j * heavy_w_ns} "
+         f"AND time < {lo + j * heavy_w_ns + heavy_width} "
+         f"GROUP BY time(2s)")
+        for j in range(heavy_variants)
+    ]
+    weights = zipf_weights(tiny_shapes, zipf_s)
+
+    def planner_counters() -> dict:
+        c = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        try:
+            c.request("GET", "/debug/device")
+            doc = json.loads(c.getresponse().read())
+            return dict(doc.get("planner", {}).get("counters", {}))
+        except (OSError, ValueError, http.client.HTTPException):
+            return {}
+        finally:
+            c.close()
+
+    counters_before = planner_counters()
+    states = [_ClientState(i) for i in range(clients)]
+    heavy_lat: list[list[float]] = [[] for _ in range(clients)]
+    # steady-state window: queries STARTING before warm_at run (they
+    # drive the planner's learning + the compile caches) but are not
+    # measured — p50/p99 compare the legs' converged behavior, the
+    # thing a fleet actually lives with
+    warm_at = time.monotonic() + max(0.0, warmup_s)
+    stop_at = warm_at + duration_s
+    # per-worker deterministic query sequence (seeded off the fleet seed)
+    seqs = [random.Random(seed * 1000 + i) for i in range(clients)]
+
+    def worker(st: _ClientState) -> None:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        wrng = seqs[st.idx]
+        n = 0
+        try:
+            while time.monotonic() < stop_at:
+                n += 1
+                is_heavy = heavy_every > 0 and n % heavy_every == 0
+                q = (heavies[(n // heavy_every) % len(heavies)]
+                     if is_heavy
+                     else wrng.choices(tiny, weights=weights)[0])
+                t0 = time.monotonic()
+                try:
+                    conn.request("GET", f"/query?db={db}&q={quote(q)}")
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    dt = time.monotonic() - t0
+                    if resp.status == 200:
+                        doc = json.loads(data)
+                        errs = [r["error"]
+                                for r in doc.get("results", [])
+                                if "error" in r]
+                        if errs:
+                            st.note_error("query error: " + errs[0][:120])
+                        elif t0 < warm_at:
+                            pass  # warmup: drives learning, unmeasured
+                        elif is_heavy:
+                            heavy_lat[st.idx].append(dt)
+                        else:
+                            st.query_lat.append(dt)
+                    elif resp.status in (429, 503):
+                        st.sheds_429 += resp.status == 429
+                        st.sheds_503 += resp.status == 503
+                    else:
+                        st.note_error(f"query status {resp.status}")
+                except (OSError, http.client.HTTPException,
+                        ValueError) as e:
+                    st.note_error(f"transport: {type(e).__name__}: {e}")
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    conn = http.client.HTTPConnection(
+                        host, port, timeout=timeout_s)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(st,), daemon=True,
+                                name=f"mixed-{st.idx}") for st in states]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=warmup_s + duration_s + 4 * timeout_s)
+    wall_s = time.monotonic() - t_start
+    counters_after = planner_counters()
+
+    # the equality contract: every distinct query once, single-threaded,
+    # hashed — identical seeding + identical data must hash identically
+    # whatever routes the planner picked during the fleet
+    fingerprints = {}
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        for name, q in [(f"heavy_{j}", q)
+                        for j, q in enumerate(heavies)] + [
+                (f"tiny_{i}", q) for i, q in enumerate(tiny)]:
+            conn.request("GET", f"/query?db={db}&q={quote(q)}")
+            fingerprints[name] = hashlib.sha256(
+                conn.getresponse().read()).hexdigest()
+    finally:
+        conn.close()
+
+    tiny_all = [v for st in states for v in st.query_lat]
+    heavy_all = [v for lat in heavy_lat for v in lat]
+    route_counts = {
+        k: counters_after.get(k, 0) - counters_before.get(k, 0)
+        for k in sorted(set(counters_before) | set(counters_after))
+    }
+    attempts = (len(tiny_all) + len(heavy_all)
+                + sum(st.sheds_429 + st.sheds_503 + st.errors
+                      for st in states))
+    return {
+        "scenario": "mixed_shapes",
+        "clients": clients,
+        "duration_s": round(wall_s, 3),
+        "warmup_s": round(warmup_s, 3),
+        "attempts": attempts,
+        "qps": round(attempts / max(wall_s, 1e-9), 1),
+        "tiny": _lat_summary(tiny_all),
+        "heavy": _lat_summary(heavy_all),
+        "aggregate_p99_ms": _lat_summary(tiny_all + heavy_all)["p99_ms"],
+        "planner_routes": route_counts,
+        "fingerprints": fingerprints,
+        "errors": sum(st.errors for st in states),
+        "error_samples": [s for st in states
+                          for s in st.error_samples][:10],
+        "stuck_clients": sum(1 for t in threads if t.is_alive()),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--host", default="127.0.0.1")
@@ -644,10 +862,13 @@ def main() -> None:
     ap.add_argument("--ack-log", default=None,
                     help="append each acked batch to this fsynced journal")
     ap.add_argument("--scenario", default="mixed",
-                    choices=("mixed", "dashboard"),
+                    choices=("mixed", "dashboard", "mixed_shapes"),
                     help="'dashboard' = zipf-tenant dashboard fleet "
                          "(repeated identical GROUP BY time() reads + "
-                         "live ingest, per-tenant p50/p99 + sheds)")
+                         "live ingest, per-tenant p50/p99 + sheds); "
+                         "'mixed_shapes' = zipf tiny dashboard queries "
+                         "+ heavy cold scans, per-class p50/p99 + "
+                         "offload-planner route counts")
     ap.add_argument("--tenants", type=int, default=4)
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="zipf exponent for tenant popularity")
@@ -657,6 +878,13 @@ def main() -> None:
                          "this interval and report acked-rows vs "
                          "ogt_write_rows_total consistency")
     args = ap.parse_args()
+    if args.scenario == "mixed_shapes":
+        out = run_mixed_shapes(
+            args.host, args.port, clients=args.clients,
+            duration_s=args.duration, zipf_s=args.zipf,
+            measurement=args.measurement)
+        print(json.dumps(out, indent=1))
+        return
     if args.scenario == "dashboard":
         out = run_dashboard_fleet(
             args.host, args.port, clients=args.clients,
