@@ -47,6 +47,10 @@ class SeriesIndex:
         # (measurement, tag_key, tag_value) -> set[sid]
         self.postings: dict[tuple[str, str, str], set[int]] = {}
         self._next_sid = 1
+        # label-engine invalidation protocol (see index.labels): bumped
+        # per measurement on insert, index-wide on removal
+        self._label_gens: dict[str, int] = {}
+        self._label_epoch = 0
         self._log = None
         if path is not None:
             self._replay()
@@ -87,7 +91,12 @@ class SeriesIndex:
         self.mst_sids.setdefault(measurement, set()).add(sid)
         for k, v in tags:
             self.postings.setdefault((measurement, k, v), set()).add(sid)
+        self._label_gens[measurement] = \
+            self._label_gens.get(measurement, 0) + 1
         return sid
+
+    def label_gen(self, measurement: str) -> tuple:
+        return (self._label_epoch, self._label_gens.get(measurement, 0))
 
     def flush(self) -> None:
         if self._log is not None:
@@ -199,6 +208,7 @@ class SeriesIndex:
                     post.discard(sid)
                     if not post:
                         del self.postings[(mst, k, v)]
+        self._label_epoch += 1
         self._rewrite_log()
 
     def _rewrite_log(self) -> None:

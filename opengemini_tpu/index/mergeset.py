@@ -157,6 +157,13 @@ class MergesetIndex:
         # series key -> sid: the ingest hot path is overwhelmingly repeat
         # series; skip the native call for those
         self._key_cache: dict[str, int] = {}
+        # label-engine invalidation protocol: per-measurement insert
+        # generation + index-wide removal epoch (index.labels snapshots
+        # and the tag_values cache key off label_gen())
+        self._label_gens: dict[str, int] = {}
+        self._label_epoch = 0
+        # (measurement, key) -> (label_gen, sorted values)
+        self._tagvals_cache: dict[tuple, tuple] = {}
 
     @contextlib.contextmanager
     def _native(self):
@@ -168,6 +175,13 @@ class MergesetIndex:
             if not self._h:
                 raise OSError("series index is closed")
             yield self._h
+
+    def label_gen(self, measurement: str) -> tuple:
+        return (self._label_epoch, self._label_gens.get(measurement, 0))
+
+    def _label_bump(self, measurement: str) -> None:
+        self._label_gens[measurement] = \
+            self._label_gens.get(measurement, 0) + 1
 
     # -- write side ---------------------------------------------------------
 
@@ -193,6 +207,7 @@ class MergesetIndex:
         blob = _pack_series(key, measurement, tags)
         with self._native() as h:
             sid = int(self._lib.msi_insert(h, blob, len(blob), 0))
+        self._label_bump(measurement)
         if len(self._key_cache) >= _TAGS_CACHE_MAX:
             self._key_cache.clear()
         self._key_cache[key] = sid
@@ -236,6 +251,9 @@ class MergesetIndex:
                 for i, sid in zip(idxs, sids):
                     out[i] = int(sid)
                     cache[keys[i]] = int(sid)
+                    # plain keys carry no escapes, so the measurement is
+                    # exactly the prefix before the first comma
+                    self._label_bump(keys[i].split(",", 1)[0])
         return out
 
     def flush(self) -> None:
@@ -289,7 +307,10 @@ class MergesetIndex:
             out |= self._match_eq_raw(measurement, key, v)
         return out
 
-    def match_eq(self, measurement: str, key: str, value: str) -> set[int]:
+    def _match_eq_walk(self, measurement: str, key: str,
+                       value: str) -> set[int]:
+        """The pre-tier mergeset walk — the oracle the columnar tier is
+        fuzzed against (tests/test_labels.py)."""
         if value == "":
             # influx: a missing tag equals the empty string; an explicit
             # '' value stored in the index matches too (raw lookup)
@@ -298,9 +319,38 @@ class MergesetIndex:
                 self._match_eq_raw(measurement, key, "")
         return self._match_eq_raw(measurement, key, value)
 
-    def match_neq(self, measurement: str, key: str, value: str) -> set[int]:
-        return self.series_ids(measurement) - self.match_eq(
+    def _match_neq_walk(self, measurement: str, key: str,
+                        value: str) -> set[int]:
+        return self.series_ids(measurement) - self._match_eq_walk(
             measurement, key, value)
+
+    def _tier_match(self, op: str, measurement: str, key: str,
+                    value: str) -> set[int] | None:
+        """Columnar-tier answer as a set (the index API's type), or None
+        when the tier is knob-disabled."""
+        from opengemini_tpu.index import labels
+
+        tier = labels.tier_for(self)
+        if tier is None:
+            return None
+        arr = labels.match_tier(tier.snapshot(measurement), op, key, value)
+        return None if arr is None else set(arr.tolist())
+
+    def match_eq(self, measurement: str, key: str, value: str) -> set[int]:
+        if value == "":
+            # the empty-value walk pays one cgo match_eq per distinct
+            # value (_with_key) — one posting-tier mask replaces it
+            got = self._tier_match("=", measurement, key, value)
+            if got is not None:
+                return got
+        return self._match_eq_walk(measurement, key, value)
+
+    def match_neq(self, measurement: str, key: str, value: str) -> set[int]:
+        # the walk rebuilds the full series_ids set to subtract from
+        got = self._tier_match("!=", measurement, key, value)
+        if got is not None:
+            return got
+        return self._match_neq_walk(measurement, key, value)
 
     def _enum(self, kind: bytes, pfx: bytes, idx: int) -> list[str]:
         n = ctypes.c_uint64()
@@ -325,12 +375,34 @@ class MergesetIndex:
     def tag_keys(self, measurement: str) -> list[str]:
         return sorted(self._enum(b"P", _field(measurement.encode()), 1))
 
+    _TAGVALS_CACHE_MAX = 4096
+
     def tag_values(self, measurement: str, key: str) -> list[str]:
+        # generation-keyed cache: match_regex re-enumerated (and
+        # re-sorted) the whole value list through cgo on EVERY call —
+        # twice per query for empty-matching selectors. Callers get the
+        # cached list itself; the meta/match paths never mutate it.
+        gen = self.label_gen(measurement)
+        got = self._tagvals_cache.get((measurement, key))
+        if got is not None and got[0] == gen:
+            return got[1]
         pfx = _field(measurement.encode()) + _field(key.encode())
-        return sorted(self._enum(b"P", pfx, 2))
+        vals = sorted(self._enum(b"P", pfx, 2))
+        if len(self._tagvals_cache) >= self._TAGVALS_CACHE_MAX:
+            self._tagvals_cache.clear()
+        self._tagvals_cache[(measurement, key)] = (gen, vals)
+        return vals
 
     def match_regex(self, measurement: str, key: str, pattern: str,
                     negate: bool = False) -> set[int]:
+        got = self._tier_match("!~" if negate else "=~",
+                               measurement, key, pattern)
+        if got is not None:
+            return got
+        return self._match_regex_walk(measurement, key, pattern, negate)
+
+    def _match_regex_walk(self, measurement: str, key: str, pattern: str,
+                          negate: bool = False) -> set[int]:
         rx = re.compile(pattern)
         hit: set[int] = set()
         empty_matches = bool(rx.search(""))  # missing tag is "" (influx)
@@ -372,10 +444,13 @@ class MergesetIndex:
         mst, tags = self._tags_cache[sid]
         return mst, tags
 
-    def entries_bulk(self, sids) -> list[tuple[str, tuple] | None]:
+    def entries_bulk(self, sids,
+                     cache: bool = True) -> list[tuple[str, tuple] | None]:
         """Batch series_entry: ONE native call for all sids (the per-sid
         ctypes round-trip dominates high-cardinality label assembly).
-        Missing sids yield None."""
+        Missing sids yield None. ``cache=False`` skips populating the
+        shared tags cache — million-row label-tier builds must not evict
+        the render path's working set (or balloon it past the bound)."""
         import numpy as _np
 
         sids = [int(s) for s in _np.asarray(sids, dtype=_np.uint64).tolist()]
@@ -401,9 +476,10 @@ class MergesetIndex:
                     _key, mst, tags = _unpack_series(raw[off:off + ln])
                     local[sid] = (mst, tags)
                 off += ln
-            if len(self._tags_cache) + len(missing) >= _TAGS_CACHE_MAX:
-                self._tags_cache.clear()
-            self._tags_cache.update(local)
+            if cache:
+                if len(self._tags_cache) + len(missing) >= _TAGS_CACHE_MAX:
+                    self._tags_cache.clear()
+                self._tags_cache.update(local)
         return [local.get(s) for s in sids]
 
     def iter_series_entries(self):
@@ -434,6 +510,10 @@ class MergesetIndex:
         for sid in sids:
             self._tags_cache.pop(sid, None)
         self._key_cache.clear()  # deletes are rare; a full drop is fine
+        # removals don't know their measurements: the index-wide epoch
+        # invalidates every label-tier snapshot and tag_values entry
+        self._label_epoch += 1
+        self._tagvals_cache.clear()
 
     def stats(self) -> dict:
         a, b, c, d = (ctypes.c_uint64() for _ in range(4))

@@ -161,9 +161,18 @@ def _anchor(pattern: str) -> str:
     return "^(?:" + pattern + ")$"
 
 
-def _match_sids(sh, metric: str, matchers) -> set[int]:
-    """Series ids matching prom label matchers via the inverted index
-    (prometheus fully anchors label-matcher regexes)."""
+def _match_sids(sh, metric: str, matchers) -> np.ndarray:
+    """Series ids matching prom label matchers, as a SORTED unique
+    int64 array (prometheus fully anchors label-matcher regexes). The
+    columnar label tier (index.labels) answers each matcher with a
+    posting array and composition is np.intersect1d, matchers ordered
+    cheapest-first; with the tier knob-disabled the legacy set walk
+    runs and the result converts — same sids either way."""
+    from opengemini_tpu.index import labels as _labels
+
+    tier = _labels.tier_for(sh.index)
+    if tier is not None:
+        return _match_sids_tier(tier, metric, matchers)
     sids = sh.index.series_ids(metric)
     for m in matchers:
         if m.name == "__name__":
@@ -181,6 +190,52 @@ def _match_sids(sh, metric: str, matchers) -> set[int]:
                 )
         except re.error as e:
             raise PromError(f"invalid regex in matcher {m.name!r}: {e}") from None
+    if not sids:
+        return np.empty(0, np.int64)
+    return np.fromiter(sorted(sids), np.int64, len(sids))
+
+
+def _match_sids_tier(tier, metric: str, matchers) -> np.ndarray:
+    from opengemini_tpu.index import labels as _labels
+    from opengemini_tpu.utils.stats import GLOBAL as _stats
+
+    snap = tier.snapshot(metric)
+    ms = [m for m in matchers
+          if m.name != "__name__" and m.op in ("=", "!=", "=~", "!~")]
+    if not ms:
+        return snap.sids
+    for m in ms:
+        if m.op in ("=~", "!~"):
+            try:
+                re.compile(_anchor(m.value))  # re caches the program
+            except re.error as e:
+                raise PromError(
+                    f"invalid regex in matcher {m.name!r}: {e}") from None
+    # cheapest matcher first: its postings bound every later intersect,
+    # and an empty prefix short-circuits the regex automaton passes
+    est = [snap.estimate(m.op, m.name,
+                         m.value if m.op in ("=", "!=") else None)
+           for m in ms]
+    order = sorted(range(len(ms)), key=est.__getitem__)
+    if order != list(range(len(ms))):
+        _stats.incr("index", "matcher_reorders_total")
+    sids = None
+    for i in order:
+        m = ms[i]
+        if m.op == "=":
+            cur = snap.match_eq(m.name, m.value)
+        elif m.op == "!=":
+            cur = snap.match_neq(m.name, m.value)
+        elif m.op == "=~":
+            cur = snap.match_regex(m.name, _anchor(m.value),
+                                   head=_labels._literal_head(m.value))
+        else:
+            cur = snap.match_regex(m.name, _anchor(m.value), negate=True,
+                                   head=_labels._literal_head(m.value))
+        sids = cur if sids is None else np.intersect1d(
+            sids, cur, assume_unique=True)
+        if sids.size == 0:
+            return sids
     return sids
 
 
@@ -375,17 +430,18 @@ class PromEngine:
         bulk_min = _bulk_sids_min()
         for sh in shards:
             TRACKER.check()  # KILL QUERY cancellation point per shard
-            sids = sorted(_match_sids(sh, metric, vs.matchers))
-            if not sids:
+            sids = _match_sids(sh, metric, vs.matchers)
+            if sids.size == 0:
                 continue
-            if len(sids) >= bulk_min and hasattr(sh, "read_series_bulk"):
+            if sids.size >= bulk_min and hasattr(sh, "read_series_bulk"):
                 # batched multi-series decode: packed (colstore) chunks
                 # decode once for every matched series.  Default for ANY
                 # match size (OGT_PROM_BULK_SIDS=1); raise the knob to
-                # make the per-sid decode loop handle small matches
+                # make the per-sid decode loop handle small matches.
+                # _match_sids already hands the sorted int64 array — no
+                # tags_of label materialization on the match path
                 sid_arr, rec = sh.read_series_bulk(
-                    metric, np.asarray(sids, np.int64),
-                    t_min_ns, t_max_ns, fields=[vf])
+                    metric, sids, t_min_ns, t_max_ns, fields=[vf])
                 col = rec.columns.get(vf)
                 if col is None or len(rec) == 0:
                     continue
@@ -422,7 +478,7 @@ class PromEngine:
                     add(dict(entry[1]), times_ms[lo:hi][m],
                         vals64[lo:hi][m])
             else:
-                for sid in sids:
+                for sid in sids.tolist():
                     rec = sh.read_series(metric, sid, t_min_ns, t_max_ns,
                                          fields=[vf])
                     col = rec.columns.get(vf)
@@ -1020,11 +1076,11 @@ class PromEngine:
                 or not hasattr(shards[0].index, "entries_bulk")):
             return None  # dict-index fallback has no bulk label fetch
         sh = shards[0]
-        sids = sorted(_match_sids(sh, metric, vs.matchers))
-        if len(sids) < 4096:
+        sids = _match_sids(sh, metric, vs.matchers)
+        if sids.size < 4096:
             return None  # eager path is fine at low cardinality
         sid_arr, rec = sh.read_series_bulk(
-            metric, np.asarray(sids, np.int64), t_min_ns, t_max_ns,
+            metric, sids, t_min_ns, t_max_ns,
             fields=[self.value_field])
         col = rec.columns.get(self.value_field)
         if col is None or len(rec) == 0:

@@ -1203,19 +1203,27 @@ class Executor(ShowDdlMixin, SubqueryMixin, HostPathMixin):
             if "full_series" in getattr(stmt, "hints", ()) else None
         ) or None  # no tag equalities -> the hint pins nothing
         for sh in shards:
-            sids = cond.eval_tag_expr(sc.tag_expr, sh.index, mst)
-            if sc.mixed_expr is not None:
+            # sorted int64 arrays end-to-end: the columnar label tier
+            # answers the tag tree and the mixed-tree prunes intersect
+            # without per-shard Python set materialization
+            sids = cond.eval_tag_sids(sc.tag_expr, sh.index, mst)
+            if sc.mixed_expr is not None and sids.size:
                 if hinted:
-                    sids &= cond.series_only_sids(
-                        sc.mixed_expr, sh.index, mst, sc.tag_keys)
+                    sids = np.intersect1d(
+                        sids, cond.series_only_arr(
+                            sc.mixed_expr, sh.index, mst, sc.tag_keys),
+                        assume_unique=True)
                 else:
-                    sids &= cond.tag_superset_sids(
-                        sc.mixed_expr, sh.index, mst, sc.tag_keys)
-            if exact_tags is not None:
-                sids = {s for s in sids
-                        if sh.index.tags_of(s) == exact_tags}
+                    sids = np.intersect1d(
+                        sids, cond.tag_superset_arr(
+                            sc.mixed_expr, sh.index, mst, sc.tag_keys),
+                        assume_unique=True)
+            if exact_tags is not None and sids.size:
+                keep = [s for s in sids.tolist()
+                        if sh.index.tags_of(s) == exact_tags]
+                sids = np.asarray(keep, np.int64)
             sids = _prune_text_sids(sh, mst, sids, match_terms)
-            for sid in sorted(sids):
+            for sid in sids.tolist():
                 tags = sh.index.tags_of(sid)
                 key = tuple(tags.get(k, "") for k in group_tags)
                 gid = gid_of.get(key)

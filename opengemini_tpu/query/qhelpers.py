@@ -54,7 +54,7 @@ def _prune_text_sids(sh, mst, sids, match_terms):
     Conservative: memtable rows are unindexed so live-memtable series
     always survive; shards without the index (or RemoteShard proxies)
     prune nothing."""
-    if not match_terms or not sids:
+    if not match_terms or len(sids) == 0:
         return sids
     lookup = getattr(sh, "text_match_sids", None)
     if lookup is None:
@@ -62,12 +62,21 @@ def _prune_text_sids(sh, mst, sids, match_terms):
     # frozen flush snapshots are unindexed like the live memtable: their
     # series must survive pruning too (shard.mem_sids_for spans both)
     mem_sids = sh.mem_sids_for(mst)
+    as_arr = isinstance(sids, np.ndarray)
     for fld, tok in match_terms:
         got = lookup(mst, fld, tok)
         if got is None:
             return sids  # a pre-sidecar file: cannot prune safely
-        sids = sids & (got | mem_sids)
-        if not sids:
+        keep = got | mem_sids
+        if as_arr:
+            # sorted-array candidates (the columnar label path): a
+            # membership mask keeps the order, no set round-trip
+            mask = np.fromiter((s in keep for s in sids.tolist()),
+                               np.bool_, len(sids))
+            sids = sids[mask]
+        else:
+            sids = sids & keep
+        if len(sids) == 0:
             break
     return sids
 

@@ -1,0 +1,363 @@
+"""Columnar label engine (ISSUE 18): the posting-array tier must be
+bit-identical to the set-returning index walk (the oracle) over
+randomized matcher workloads — including the influx
+missing-tag-equals-"" rule, empty-matching regexes, and negation —
+stay coherent under concurrent inserts via the generation protocol,
+and produce the identical mask when the LUT gather routes to the
+device or hash-shards over the virtual mesh."""
+
+import os
+import random
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.index import labels
+from opengemini_tpu.index import mergeset as msi
+from opengemini_tpu.index.inverted import SeriesIndex
+from opengemini_tpu.parallel import distributed as dist
+from opengemini_tpu.parallel import runtime as prt
+from opengemini_tpu.promql.parser import LabelMatcher
+from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+VALUES = ["", "a", "api-1", "api-2", "api-10", "web", "eu", "eu-west",
+          "us", "x,y", "spa ce"]
+KEYS = ("job", "region", "pod", "rare")
+PATTERNS = [r"api-.*", r".*", r"", r"a|eu", r"^$", r"(api)?.*1",
+            r"eu.*|us", r"nomatch\d+", r"(?:)", r"[aw]"]
+
+
+def _counter(name):
+    return STATS.snapshot().get("index", {}).get(name, 0)
+
+
+def _rand_series(rng, n):
+    out = []
+    for _ in range(n):
+        tags = sorted({(k, rng.choice(VALUES))
+                       for k in KEYS if rng.random() < 0.7})
+        out.append(tuple(tags))
+    return out
+
+
+def _fill_dict_index(series):
+    idx = SeriesIndex()
+    for tags in series:
+        idx.get_or_create("m", tags)
+    return idx
+
+
+def _matcher_cases(rng, n):
+    cases = []
+    for _ in range(n):
+        k = rng.choice(KEYS + ("missing_key",))
+        op = rng.choice(("=", "!=", "=~", "!~"))
+        if op in ("=", "!="):
+            v = rng.choice(VALUES + ["absent-value"])
+        else:
+            v = rng.choice(PATTERNS)
+        cases.append((op, k, v))
+    return cases
+
+
+def _oracle(idx, op, k, v):
+    if op == "=":
+        return idx.match_eq("m", k, v)
+    if op == "!=":
+        return idx.match_neq("m", k, v)
+    return idx.match_regex("m", k, v, negate=op == "!~")
+
+
+class TestDictOracleFuzz:
+    def test_randomized_equivalence(self):
+        rng = random.Random(1234)
+        idx = _fill_dict_index(_rand_series(rng, 800))
+        snap = labels.tier_for(idx).snapshot("m")
+        for op, k, v in _matcher_cases(rng, 300):
+            got = labels.match_tier(snap, op, k, v)
+            assert got.dtype == np.int64
+            assert np.all(got[1:] > got[:-1])  # sorted unique
+            want = _oracle(idx, op, k, v)
+            assert set(got.tolist()) == want, (op, k, v)
+
+    def test_tag_compare_matches_tags_of_walk(self):
+        rng = random.Random(5)
+        idx = _fill_dict_index(_rand_series(rng, 300))
+        snap = labels.tier_for(idx).snapshot("m")
+        for ka in KEYS + ("nokey",):
+            for kb in KEYS + ("nokey2",):
+                for want_eq in (True, False):
+                    got = set(
+                        snap.match_tag_compare(ka, kb, want_eq).tolist())
+                    want = set()
+                    for sid in idx.series_ids("m"):
+                        t = idx.tags_of(sid)
+                        if (t.get(ka) == t.get(kb)) == want_eq:
+                            want.add(sid)
+                    assert got == want, (ka, kb, want_eq)
+
+    def test_knob_off_yields_no_tier(self, monkeypatch):
+        monkeypatch.setenv("OGT_LABEL_INDEX", "0")
+        idx = _fill_dict_index(_rand_series(random.Random(0), 10))
+        assert labels.tier_for(idx) is None
+
+
+@pytest.mark.skipif(msi.load() is None,
+                    reason="native series index library unavailable")
+class TestMergesetOracleFuzz:
+    @pytest.fixture()
+    def midx(self):
+        with tempfile.TemporaryDirectory() as d:
+            idx = msi.MergesetIndex(d)
+            yield idx
+            idx.close()
+
+    def test_public_api_matches_walk(self, midx):
+        rng = random.Random(77)
+        keys = []
+        for tags in _rand_series(rng, 600):
+            # canonical plain keys only: no escapes in this corpus
+            plain = [(k, v) for k, v in tags
+                     if "," not in v and " " not in v and v]
+            keys.append(",".join(["m"] + [f"{k}={v}" for k, v in plain]))
+        midx.get_or_create_bulk(keys)
+        for op, k, v in _matcher_cases(rng, 200):
+            got = _oracle(midx, op, k, v)  # tier-backed public API
+            if op == "=":
+                want = midx._match_eq_walk("m", k, v)
+            elif op == "!=":
+                want = midx._match_neq_walk("m", k, v)
+            else:
+                want = midx._match_regex_walk("m", k, v,
+                                              negate=op == "!~")
+            assert got == want, (op, k, v)
+
+    def test_knob_off_reproduces_walk(self, midx, monkeypatch):
+        midx.get_or_create_bulk(["m,job=api-1", "m,job=web", "m,region=eu"])
+        on = midx.match_regex("m", "job", r"api-.*")
+        monkeypatch.setenv("OGT_LABEL_INDEX", "0")
+        off = midx.match_regex("m", "job", r"api-.*")
+        assert on == off == midx._match_regex_walk("m", "job", r"api-.*")
+
+    def test_tag_values_cache_invalidates_on_insert(self, midx):
+        midx.get_or_create_bulk(["m,job=a"])
+        assert midx.tag_values("m", "job") == ["a"]
+        assert midx.tag_values("m", "job") == ["a"]  # cached hit
+        midx.get_or_create_bulk(["m,job=b"])
+        assert midx.tag_values("m", "job") == ["a", "b"]
+
+    def test_remove_invalidates_snapshot_and_values(self, midx):
+        midx.get_or_create_bulk(["m,job=a", "m,job=b"])
+        assert len(midx.match_neq("m", "job", "a")) == 1
+        midx.remove_sids(midx.match_eq("m", "job", "b"))
+        assert midx.match_eq("m", "job", "b") == set()
+        assert midx.match_neq("m", "job", "a") == set()
+
+
+class TestMatchSids:
+    def _shard(self, idx):
+        class _Sh:
+            index = idx
+        return _Sh()
+
+    def test_selectivity_reorder_counts_and_matches_legacy(self, monkeypatch):
+        from opengemini_tpu.promql.engine import _match_sids
+
+        rng = random.Random(9)
+        idx = _fill_dict_index(_rand_series(rng, 500))
+        sh = self._shard(idx)
+        matchers = [
+            LabelMatcher("job", "=~", "api-.*"),   # broad regex first
+            LabelMatcher("region", "!=", "eu"),
+            LabelMatcher("pod", "=", "web"),       # cheapest last
+        ]
+        before = _counter("matcher_reorders_total")
+        got = _match_sids(sh, "m", matchers)
+        assert _counter("matcher_reorders_total") > before
+        monkeypatch.setenv("OGT_LABEL_INDEX", "0")
+        legacy = _match_sids(sh, "m", matchers)
+        assert isinstance(legacy, np.ndarray)
+        assert np.array_equal(got, legacy)
+
+    def test_empty_intersection_short_circuits(self):
+        from opengemini_tpu.promql.engine import _match_sids
+
+        idx = _fill_dict_index([(("job", "a"),)])
+        got = _match_sids(self._shard(idx), "m",
+                          [LabelMatcher("job", "=", "zzz"),
+                           LabelMatcher("job", "=~", "a.*")])
+        assert got.size == 0
+
+    def test_invalid_regex_raises_even_after_empty_prefix(self):
+        from opengemini_tpu.promql.engine import PromError, _match_sids
+
+        idx = _fill_dict_index([(("job", "a"),)])
+        with pytest.raises(PromError):
+            _match_sids(self._shard(idx), "m",
+                        [LabelMatcher("job", "=", "zzz"),
+                         LabelMatcher("job", "=~", "([")])
+
+
+class TestConditionArrays:
+    def test_eval_tag_sids_matches_set_walk(self):
+        from opengemini_tpu.query import condition as cond
+        from opengemini_tpu.sql.parser import parse
+
+        rng = random.Random(21)
+        idx = _fill_dict_index(_rand_series(rng, 400))
+        wheres = [
+            "job = 'api-1'",
+            "job != 'web' AND region = 'eu'",
+            "job =~ /api-.*/ OR region = 'us'",
+            "pod !~ /a|eu/ AND (job = '' OR region != 'eu')",
+            "job = region",
+            "job != pod OR rare = 'a'",
+            "job = ''",
+        ]
+        for w in wheres:
+            expr = parse(f"select f from m where {w}")[0].condition
+            arr = cond.eval_tag_sids(expr, idx, "m")
+            assert np.all(arr[1:] > arr[:-1])
+            want = cond.eval_tag_expr(expr, idx, "m")
+            assert set(arr.tolist()) == want, w
+
+    def test_superset_and_series_only_match_set_walk(self):
+        from opengemini_tpu.query import condition as cond
+        from opengemini_tpu.sql.parser import parse
+
+        rng = random.Random(22)
+        idx = _fill_dict_index(_rand_series(rng, 300))
+        tag_keys = set(KEYS)
+        for w in ["job = 'api-1' AND f > 1",
+                  "job =~ /.*/ OR f < 0",
+                  "region = '' AND f = 2"]:
+            expr = parse(f"select f from m where {w}")[0].condition
+            sup = cond.tag_superset_arr(expr, idx, "m", tag_keys)
+            assert set(sup.tolist()) == cond.tag_superset_sids(
+                expr, idx, "m", tag_keys)
+            ser = cond.series_only_arr(expr, idx, "m", tag_keys)
+            assert set(ser.tolist()) == cond.series_only_sids(
+                expr, idx, "m", tag_keys)
+
+
+class TestDeviceAndMeshGather:
+    @pytest.fixture(autouse=True)
+    def _no_leaked_mesh(self):
+        yield
+        prt.set_mesh(None)
+
+    def test_device_route_bit_identical(self, monkeypatch):
+        rng = random.Random(31)
+        idx = _fill_dict_index(_rand_series(rng, 600))
+        snap = labels.tier_for(idx).snapshot("m")
+        host = {(k, p, neg): snap.match_regex(k, p, negate=neg)
+                for k in KEYS for p in PATTERNS for neg in (False, True)}
+        monkeypatch.setattr(labels, "_route_gather",
+                            lambda n_rows, n_vals: "device")
+        for (k, p, neg), want in host.items():
+            got = snap.match_regex(k, p, negate=neg)
+            assert np.array_equal(got, want), (k, p, neg)
+
+    def test_mesh_sharded_probe_bit_identical(self, monkeypatch):
+        mesh = dist.make_mesh(8, ("shard",))
+        prt.set_mesh(mesh)
+        rng = random.Random(32)
+        idx = _fill_dict_index(_rand_series(rng, 900))
+        snap = labels.tier_for(idx).snapshot("m")
+        host = {(k, p): snap.match_regex(k, p)
+                for k in KEYS for p in PATTERNS}
+        monkeypatch.setattr(labels, "_route_gather",
+                            lambda n_rows, n_vals: "mesh")
+        for (k, p), want in host.items():
+            got = snap.match_regex(k, p)
+            assert np.array_equal(got, want), (k, p)
+        # partitions cover every row exactly once
+        parts = snap._hash_parts(8)
+        allrows = np.sort(np.concatenate(parts))
+        assert np.array_equal(allrows, np.arange(snap.n))
+
+    def test_mesh_parts_recompute_on_epoch_change(self):
+        idx = _fill_dict_index(_rand_series(random.Random(3), 50))
+        snap = labels.tier_for(idx).snapshot("m")
+        p1 = snap._hash_parts(4)
+        assert snap._hash_parts(4) is p1  # cached
+        prt.set_mesh(dist.make_mesh(4, ("shard",)))
+        p2 = snap._hash_parts(4)
+        assert p2 is not p1
+
+
+class TestConcurrentInvalidation:
+    def test_snapshot_stays_coherent_under_inserts(self):
+        idx = _fill_dict_index(_rand_series(random.Random(4), 200))
+        tier = labels.tier_for(idx)
+        stop = threading.Event()
+        errs = []
+
+        def hammer():
+            # bounded: an unbounded tight loop makes every snapshot
+            # rebuild race a growing index — O(n) builds over a
+            # geometrically growing n never converge on a loaded box
+            try:
+                for i in range(4000):
+                    if stop.is_set():
+                        break
+                    idx.get_or_create(
+                        "m", (("job", f"hot-{i % 37}"),
+                              ("pod", f"p{i}")))
+            except Exception as e:  # pragma: no cover - fail loudly
+                errs.append(e)
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            for _ in range(200):
+                snap = tier.snapshot("m")
+                got = snap.match_eq("job", "hot-1")
+                # every sid the snapshot returns matches under the oracle
+                for sid in got.tolist():
+                    assert idx.tags_of(sid).get("job") == "hot-1"
+        finally:
+            stop.set()
+            t.join()
+        assert not errs
+        # once writes quiesce, one more probe converges on the oracle
+        final = set(tier.snapshot("m").match_eq("job", "hot-1").tolist())
+        assert final == idx.match_eq("m", "job", "hot-1")
+
+    def test_generation_counters_move(self):
+        idx = SeriesIndex()
+        idx.get_or_create("m", (("a", "1"),))
+        g0 = idx.label_gen("m")
+        idx.get_or_create("m", (("a", "2"),))
+        g1 = idx.label_gen("m")
+        assert g1 != g0
+        idx.remove_sids({1})
+        assert idx.label_gen("m") != g1
+        assert idx.label_gen("other")  # unknown measurement: stable tuple
+
+
+class TestTierMetricsAndLru:
+    def test_build_hit_stale_counters(self):
+        idx = _fill_dict_index([(("a", "1"),)])
+        tier = labels.tier_for(idx)
+        b0, h0, s0 = (_counter("tier_builds_total"),
+                      _counter("tier_hits_total"),
+                      _counter("tier_stale_total"))
+        tier.snapshot("m")
+        tier.snapshot("m")
+        idx.get_or_create("m", (("a", "2"),))
+        tier.snapshot("m")
+        assert _counter("tier_builds_total") == b0 + 2
+        assert _counter("tier_hits_total") == h0 + 1
+        assert _counter("tier_stale_total") == s0 + 1
+
+    def test_lru_bound_holds(self):
+        idx = SeriesIndex()
+        for i in range(labels.LabelTier.MAX_SNAPSHOTS + 8):
+            idx.get_or_create(f"m{i}", (("a", "1"),))
+        tier = labels.tier_for(idx)
+        for i in range(labels.LabelTier.MAX_SNAPSHOTS + 8):
+            tier.snapshot(f"m{i}")
+        assert len(tier._snaps) == labels.LabelTier.MAX_SNAPSHOTS

@@ -407,6 +407,48 @@ def test_ogt050_offload_metric_family(tmp_path):
         "offload.Amortize_Total", "offload.route-host_total"]
 
 
+def test_ogt010_label_index_knob_family(tmp_path):
+    """The ISSUE 18 knobs: OGT_LABEL_INDEX / OGT_LABEL_INDEX_DEVICE
+    reads in the columnar label tier are OGT010 subjects — documented
+    spellings pass, an undocumented sibling is a finding."""
+    root = _tree(tmp_path, {
+        "README.md": ("Label engine knobs: `OGT_LABEL_INDEX`, "
+                      "`OGT_LABEL_INDEX_DEVICE`.\n"),
+        "opengemini_tpu/index/labels_mod.py": (
+            "import os\n"
+            "a = os.environ.get('OGT_LABEL_INDEX', '1')\n"          # ok
+            "b = os.environ.get('OGT_LABEL_INDEX_DEVICE', '')\n"    # ok
+            "c = os.environ.get('OGT_LABEL_INDEX_SHARDS', '')\n"    # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT010")
+    assert [f.detail for f in found] == ["OGT_LABEL_INDEX_SHARDS"]
+
+
+def test_ogt050_label_index_metric_family(tmp_path):
+    """The ogt_index_* family (ISSUE 18): tier build/hit/stale and
+    regex LUT counters obey the metric grammar as keys of the `index`
+    module; a dashed route or a capitalized family in the key is a
+    finding (the sanitizer would split the family's spellings)."""
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": (
+            "GLOBAL.incr('index', 'tier_builds_total')\n"             # ok
+            "GLOBAL.incr('index', 'tier_hits_total')\n"               # ok
+            "GLOBAL.incr('index', 'tier_stale_total')\n"              # ok
+            "GLOBAL.incr('index', 'regex_values_total', 5)\n"         # ok
+            "GLOBAL.incr('index', 'regex_prefilter_skipped_total')\n"  # ok
+            "GLOBAL.incr('index', 'regex_lut_hits_total')\n"          # ok
+            "GLOBAL.incr('index', 'matcher_reorders_total')\n"        # ok
+            "GLOBAL.incr('index', 'gather_fallback_total')\n"         # ok
+            "GLOBAL.incr('index', 'gather-mesh_total')\n"             # finding
+            "GLOBAL.incr('index', 'Regex_LUT_hits_total')\n"          # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT050")
+    assert sorted(f.detail for f in found) == [
+        "index.Regex_LUT_hits_total", "index.gather-mesh_total"]
+
+
 # -- baseline + output formats ------------------------------------------------
 
 

@@ -842,6 +842,204 @@ def run_mixed_shapes(host: str, port: int, clients: int = 6,
     }
 
 
+def run_cardinality_churn(host: str, port: int, clients: int = 6,
+                          duration_s: float = 10.0, batch_rows: int = 200,
+                          measurement: str = "churn", pods_per_gen: int = 400,
+                          churn_every_s: float = 1.0,
+                          warmup_s: float = 10.0,
+                          write_interval_s: float = 0.1,
+                          timeout_s: float = 30.0) -> dict:
+    """Cardinality-churn scenario (the label-engine soak): pod-style
+    labels churn under live ingest — every write batch advances a pod
+    "generation" (new `pod=g<g>-<i>` series, the old generation stops
+    receiving rows), so the columnar label tier (index/labels.py) is
+    invalidated and lazily rebuilt continuously while reader clients
+    run regex + negative selectors over the growing series set.  The
+    scenario reports query p99 split into first/second half of the run:
+    with generation-keyed snapshots the tail must stay FLAT even as
+    total cardinality grows (`p99_flat_ok`; rebuild cost is bounded by
+    live series, not by how many generations ever existed)."""
+    import random
+    from urllib.parse import quote
+
+    db = "churndb"
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    conn.request("POST", "/query?q=" + quote(f'CREATE DATABASE "{db}"'))
+    conn.getresponse().read()
+    # warmup: seed ~a churn window's worth of generation-0 rows and run
+    # each selector twice, so first-execution kernel compiles (and the
+    # scan-shape buckets the live run will hit) land before the clock
+    # starts — the recorded latencies measure churn behavior, not cold
+    # kernels
+    now = time.time_ns()
+    for b in range(24):
+        seed = "".join(
+            f"{measurement},job=api-{k % 20},"
+            f"pod=g0-{b % 4}-{k % pods_per_gen},"
+            f"region=r{k % 5} v={k}i {now - (b * batch_rows + k) * 1000}\n"
+            for k in range(batch_rows)).encode()
+        conn.request("POST", f"/write?db={db}", body=seed)
+        conn.getresponse().read()
+
+    states = [_ClientState(i) for i in range(clients)]
+    q_events: list[list[tuple]] = [[] for _ in range(clients)]
+    # eq-gated regex + negative selectors over a trailing 2s window:
+    # the matcher runs against the FULL ever-growing series set (that
+    # is what must stay flat), while the data scan stays bounded to the
+    # live generation's rows so selector latency dominates the measure
+    def make_queries():
+        lo = time.time_ns() - 2_000_000_000
+        return [
+            f"SELECT count(v) FROM {measurement} "
+            f"WHERE job = 'api-7' AND pod =~ /.*-1.0/ AND time >= {lo}",
+            f"SELECT count(v) FROM {measurement} "
+            f"WHERE job = 'api-13' AND pod !~ /g[02468].*/ "
+            f"AND time >= {lo}",
+            f"SELECT count(v) FROM {measurement} "
+            f"WHERE region = 'r4' AND job =~ /api-1\\d/ AND time >= {lo}",
+        ]
+    for q in make_queries() * 2:  # unrecorded warmup passes per shape
+        conn.request("GET", f"/query?db={db}&q={quote(q)}")
+        conn.getresponse().read()
+    conn.close()
+    # workers run warmup + measured back to back; events stamped before
+    # warmup_s are dropped from the latency record (the first seconds
+    # carry one-off steady-state costs — offload-planner route
+    # exploration pays its device compiles there, flush sizing settles)
+    t_start = time.monotonic()
+    stop_at = t_start + warmup_s + duration_s
+
+    q_timeouts = [0] * clients
+
+    def worker(st: _ClientState) -> None:
+        rng = random.Random(1000 + st.idx)
+        is_writer = st.idx % 2 == 0
+        # readers truncate at 8s: a one-off server-side stall (e.g. the
+        # offload planner's first device exploration paying a compile)
+        # must not starve the sampler for the rest of the run — the
+        # event is still visible in query_timeouts
+        conn_timeout = timeout_s if is_writer else min(8.0, timeout_s)
+        conn = http.client.HTTPConnection(host, port,
+                                          timeout=conn_timeout)
+        try:
+            while time.monotonic() < stop_at:
+                t0 = time.monotonic()
+                try:
+                    if is_writer:
+                        # pod generation advances on a wall-clock cadence
+                        # (a rolling deploy): each churn retires the old
+                        # pods and mints pods_per_gen new series, so the
+                        # label tier's snapshot is invalidated roughly
+                        # once per churn_every_s, not once per batch
+                        g = int((t0 - t_start) / churn_every_s)
+                        base = time.time_ns() - st.idx
+                        body = "".join(
+                            f"{measurement},job=api-{k % 20},"
+                            f"pod=g{g}-{st.idx}-{k % pods_per_gen},"
+                            f"region=r{k % 5} "
+                            f"v={st.seq + k}i {base - k * 1000}\n"
+                            for k in range(batch_rows)
+                        ).encode()
+                        conn.request("POST", f"/write?db={db}", body=body)
+                        resp = conn.getresponse()
+                        resp.read()
+                        dt = time.monotonic() - t0
+                        if resp.status == 204:
+                            st.seq += batch_rows
+                            st.write_lat.append(dt)
+                        elif resp.status in (429, 503):
+                            st.sheds_429 += resp.status == 429
+                            st.sheds_503 += resp.status == 503
+                        else:
+                            st.note_error(f"write status {resp.status}")
+                        # paced ingest: churn is about label cardinality
+                        # turning over, not about saturating the write
+                        # path — leave the box headroom so query latency
+                        # measures matching, not GIL contention
+                        time.sleep(write_interval_s)
+                    else:
+                        q = rng.choice(make_queries())
+                        conn.request(
+                            "GET", f"/query?db={db}&q={quote(q)}")
+                        resp = conn.getresponse()
+                        data = resp.read()
+                        dt = time.monotonic() - t0
+                        if resp.status == 200:
+                            doc = json.loads(data)
+                            errs = [r["error"]
+                                    for r in doc.get("results", [])
+                                    if "error" in r]
+                            if errs:
+                                st.note_error(
+                                    "query error: " + errs[0][:120])
+                            else:
+                                st.query_lat.append(dt)
+                                q_events[st.idx].append(
+                                    (t0 - t_start, dt))
+                        elif resp.status in (429, 503):
+                            st.sheds_429 += resp.status == 429
+                            st.sheds_503 += resp.status == 503
+                        else:
+                            st.note_error(f"query status {resp.status}")
+                except (OSError, http.client.HTTPException,
+                        ValueError) as e:
+                    if isinstance(e, TimeoutError) and not is_writer:
+                        q_timeouts[st.idx] += 1
+                    else:
+                        st.note_error(
+                            f"transport: {type(e).__name__}: {e}")
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    conn = http.client.HTTPConnection(
+                        host, port, timeout=conn_timeout)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(st,), daemon=True,
+                                name=f"churn-{st.idx}") for st in states]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=warmup_s + duration_s + 4 * timeout_s)
+    wall_s = time.monotonic() - t_start
+
+    events = sorted((ts, dt) for lst in q_events for (ts, dt) in lst
+                    if ts >= warmup_s)
+    half = warmup_s + (wall_s - warmup_s) / 2.0
+    first = [dt for (ts, dt) in events if ts < half]
+    second = [dt for (ts, dt) in events if ts >= half]
+    p99_first = _lat_summary(first)["p99_ms"]
+    p99_second = _lat_summary(second)["p99_ms"]
+    # flat: the second half's tail must not outgrow the first half's by
+    # more than 2.5x + a 5ms jitter floor, despite the extra generations
+    flat_ok = (not second or not first
+               or p99_second <= max(p99_first * 2.5, p99_first + 5.0))
+    return {
+        "scenario": "cardinality_churn",
+        "clients": clients,
+        "duration_s": round(wall_s, 3),
+        "warmup_s": warmup_s,
+        "generations": int(wall_s / churn_every_s),
+        "writes": _lat_summary(
+            [v for st in states for v in st.write_lat]),
+        "queries": _lat_summary([dt for (_, dt) in events]),
+        "query_p99_first_half_ms": p99_first,
+        "query_p99_second_half_ms": p99_second,
+        "p99_flat_ok": bool(flat_ok),
+        "query_timeouts": sum(q_timeouts),
+        "sheds": sum(st.sheds_429 + st.sheds_503 for st in states),
+        "errors": sum(st.errors for st in states),
+        "error_samples": [s for st in states
+                          for s in st.error_samples][:10],
+        "stuck_clients": sum(1 for t in threads if t.is_alive()),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--host", default="127.0.0.1")
@@ -862,13 +1060,18 @@ def main() -> None:
     ap.add_argument("--ack-log", default=None,
                     help="append each acked batch to this fsynced journal")
     ap.add_argument("--scenario", default="mixed",
-                    choices=("mixed", "dashboard", "mixed_shapes"),
+                    choices=("mixed", "dashboard", "mixed_shapes",
+                             "cardinality_churn"),
                     help="'dashboard' = zipf-tenant dashboard fleet "
                          "(repeated identical GROUP BY time() reads + "
                          "live ingest, per-tenant p50/p99 + sheds); "
                          "'mixed_shapes' = zipf tiny dashboard queries "
                          "+ heavy cold scans, per-class p50/p99 + "
-                         "offload-planner route counts")
+                         "offload-planner route counts; "
+                         "'cardinality_churn' = pod-style labels churn "
+                         "under live ingest while readers run regex + "
+                         "negative selectors; asserts flat query p99 "
+                         "(label-tier rebuilds stay bounded)")
     ap.add_argument("--tenants", type=int, default=4)
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="zipf exponent for tenant popularity")
@@ -878,6 +1081,13 @@ def main() -> None:
                          "this interval and report acked-rows vs "
                          "ogt_write_rows_total consistency")
     args = ap.parse_args()
+    if args.scenario == "cardinality_churn":
+        out = run_cardinality_churn(
+            args.host, args.port, clients=args.clients,
+            duration_s=args.duration, batch_rows=args.batch_rows,
+            measurement=args.measurement)
+        print(json.dumps(out, indent=1))
+        return
     if args.scenario == "mixed_shapes":
         out = run_mixed_shapes(
             args.host, args.port, clients=args.clients,
