@@ -995,6 +995,160 @@ def bench_ingest_during_flush(rows: int = 2_000_000) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_compaction_under_ingest(rows: int = 1_000_000,
+                                  duration_s: float = 4.0) -> dict:
+    """Ingest + query availability while compaction runs CONTINUOUSLY
+    (ISSUE 19): paced single-point write latency and small-scan query
+    latency percentiles over `duration_s`, three legs on identical
+    shards — quiescent (no compaction), off-lock compaction (the new
+    snapshot -> off-lock merge -> revalidated swap), and the
+    pre-off-lock behavior reproduced by wrapping each compaction in the
+    shard locks.  The acceptance story: continuous background rewrites
+    no longer degrade ingest/query p99 versus quiescent.  Scan digests
+    over the initial keyspace are asserted BIT-IDENTICAL before and
+    after every leg — compaction must never change query results."""
+    import hashlib
+    import shutil
+    import tempfile
+    import threading
+
+    from opengemini_tpu.record import FieldType
+    from opengemini_tpu.storage.shard import Shard
+
+    NS = 1_000_000_000
+    base = 1_700_000_000 * NS
+    root = tempfile.mkdtemp(prefix="ogtpu-compingest-")
+    n_files = 8
+
+    def build(path: str) -> "Shard":
+        from opengemini_tpu.ingest.native_lp import parse_columnar
+
+        sh = Shard(path, 0, 2**62)
+        per = rows // n_files
+        for f in range(n_files):
+            lo = f * per
+            lines = "\n".join(
+                f"cpu,host=h{i % 64} v={float(i % 97)} {base + i * NS}"
+                for i in range(lo, lo + per)).encode()
+            batch = parse_columnar(lines, "ns", base)
+            sh.write_columnar(batch, None, lines, "ns", base)
+            sh.flush()
+        return sh
+
+    def digest(sh: "Shard") -> str:
+        """Hash of every initial-keyspace row (time + value bytes), the
+        bit-identity witness across a compaction."""
+        h = hashlib.sha256()
+        for hid in range(64):
+            sid = sh.index.get_or_create("cpu", (("host", f"h{hid}"),))
+            # just below the first paced-write timestamp: inclusive or
+            # exclusive slicing both cover exactly the initial rows
+            rec = sh.read_series("cpu", sid, tmax=base + rows * NS - 1)
+            h.update(rec.times.tobytes())
+            h.update(rec.columns["v"].values.tobytes())
+        return h.hexdigest()
+
+    def run(mode: str) -> dict:
+        sh = build(os.path.join(root, mode))
+        before = digest(sh)
+        stop = threading.Event()
+        compactions = [0]
+
+        def compactor():
+            while not stop.is_set():
+                if mode == "locked":
+                    # the OLD behavior: merge + fsync under the locks
+                    with sh._flush_lock, sh._lock:
+                        did = sh.compact_level(fanout=2) or sh.compact()
+                else:
+                    did = sh.compact_level(fanout=2) or sh.compact()
+                if did:
+                    compactions[0] += 1
+                else:
+                    # re-split so the next pass has work: flush a tiny
+                    # file to keep the compactor continuously busy
+                    sh.write_points_structured([
+                        ("cpu", (("host", "h0"),),
+                         base + (2 * rows + compactions[0]) * NS,
+                         {"v": (FieldType.FLOAT, 0.0)})])
+                    sh.flush()
+
+        ct = None
+        if mode != "quiescent":
+            ct = threading.Thread(target=compactor, daemon=True)
+            ct.start()
+        w_lats: list[float] = []
+        q_lats: list[float] = []
+        sid0 = sh.index.get_or_create("cpu", (("host", "h1"),))
+        t0 = time.perf_counter()
+        i = 0
+        while time.perf_counter() - t0 < duration_s:
+            t1 = time.perf_counter()
+            sh.write_points_structured([
+                ("cpu", (("host", "hx"),), base + (rows + i) * NS,
+                 {"v": (FieldType.FLOAT, 1.0)})])
+            w_lats.append(time.perf_counter() - t1)
+            t1 = time.perf_counter()
+            sh.read_series("cpu", sid0, tmax=base + 4096 * NS)
+            q_lats.append(time.perf_counter() - t1)
+            i += 1
+            time.sleep(0.001)  # paced client (see ingest_during_flush)
+        stop.set()
+        if ct is not None:
+            ct.join()
+        ingest_rows_s = len(w_lats) / max(
+            time.perf_counter() - t0, 1e-9)
+        after = digest(sh)
+        sh.close()
+        for lats in (w_lats, q_lats):
+            lats.sort()
+
+        def pct(lats, p):
+            return lats[min(len(lats) - 1, int(p * len(lats)))]
+
+        return {
+            "compactions": compactions[0],
+            "ops": len(w_lats),
+            "ingest_ops_per_s": round(ingest_rows_s, 1),
+            "write_p50_ms": round(pct(w_lats, 0.50) * 1e3, 3),
+            "write_p99_ms": round(pct(w_lats, 0.99) * 1e3, 3),
+            "write_max_ms": round(w_lats[-1] * 1e3, 2),
+            "query_p99_ms": round(pct(q_lats, 0.99) * 1e3, 3),
+            "digest_identical": before == after,
+            "digest": after,
+        }
+
+    try:
+        quiescent = run("quiescent")
+        offlock = run("offlock")
+        locked = run("locked")
+        for leg, doc in (("quiescent", quiescent), ("offlock", offlock),
+                         ("locked", locked)):
+            if not doc["digest_identical"]:
+                raise AssertionError(
+                    f"compaction changed query results ({leg} leg)")
+        # identical initial content across legs -> identical digests
+        if not (quiescent["digest"] == offlock["digest"]
+                == locked["digest"]):
+            raise AssertionError("scan digests diverge across legs")
+        return {
+            "rows": rows,
+            "duration_s": duration_s,
+            "quiescent": quiescent,
+            "offlock_compaction": offlock,
+            "locked_compaction": locked,
+            # >= 1.0 means off-lock fully closed the gap to quiescent
+            "p99_vs_quiescent_x": round(
+                quiescent["write_p99_ms"]
+                / max(offlock["write_p99_ms"], 1e-6), 2),
+            "p99_improvement_x": round(
+                locked["write_p99_ms"]
+                / max(offlock["write_p99_ms"], 1e-6), 1),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_colcache_warm(rows: int = 4_000_000, chunk: int = 16_384,
                         series: int = 64) -> dict:
     """Decoded-column cache warm speedup (storage/colcache.py): the SAME
@@ -3188,6 +3342,21 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     except Exception as e:  # noqa: BLE001 — bench must still emit
         print(f"bench: ingest-during-flush failed: {e}", file=sys.stderr)
 
+    # ingest/query availability under CONTINUOUS compaction: off-lock
+    # merge vs quiescent vs merge-under-lock, scan digests asserted
+    # bit-identical across every leg (ISSUE 19 acceptance metric)
+    comp_ingest = None
+    try:
+        comp_ingest = bench_compaction_under_ingest(
+            rows=int(os.environ.get("OGTPU_BENCH_COMPINGEST_ROWS",
+                                    "1000000")))
+        _emit("compaction_under_ingest_write_p99_ms" + suffix,
+              comp_ingest["offlock_compaction"]["write_p99_ms"], "ms",
+              comp_ingest["p99_vs_quiescent_x"], {"detail": comp_ingest})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: compaction-under-ingest failed: {e}",
+              file=sys.stderr)
+
     # decoded-column cache: identical repeated scan, cache off vs on
     # (the PR 2 acceptance metric; >= 2x warm target)
     colcache_warm = None
@@ -3390,6 +3559,8 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
         extra["flush_floor"] = flush_floor
     if ingest_flush:
         extra["ingest_during_flush"] = ingest_flush
+    if comp_ingest:
+        extra["compaction_under_ingest"] = comp_ingest
     if colcache_warm:
         extra["colcache_warm"] = colcache_warm
     if device_decode:

@@ -608,6 +608,9 @@ class DataRouter:
         # walltime for failover grace decisions
         self.shared_health: dict[str, bool] = {}
         self.down_since: dict[str, float] = {}
+        # elastic-membership introspection (POST /debug/ctrl?mod=cluster
+        # op=decommission|drain): last drain/decommission progress doc
+        self.decommission_state: dict = {"phase": "idle"}
 
     def probe_health(self) -> dict[str, bool]:
         """Ping every registered data node (reference: the cluster
@@ -952,6 +955,8 @@ class DataRouter:
         import os
         import urllib.error
 
+        from opengemini_tpu.storage.engine import WriteError
+
         delivered = 0
         d = self._hints_dir()
         with self._hint_lock:
@@ -1007,13 +1012,22 @@ class DataRouter:
             except OSError:
                 continue
             remaining = list(lines)
+            # hints owed to a node that left the roster (decommission)
+            # are RE-ROUTED through the normal write path: the rows land
+            # on the group's CURRENT owners instead — an acked hinted
+            # copy must never vanish just because its target did
+            reroute = node_id not in self.data_nodes()
             for i, line in enumerate(lines):
                 try:
                     rec = json.loads(line)
                     points = decode_points(rec["points"])
                     _fp("cluster-replay-before-forward")
-                    self.forward_points(node_id, rec["db"], rec.get("rp"),
-                                        points)
+                    if reroute:
+                        self.routed_write(rec["db"], rec.get("rp"),
+                                          points, "one")
+                    else:
+                        self.forward_points(node_id, rec["db"],
+                                            rec.get("rp"), points)
                     delivered += len(points)
                     remaining[i] = None
                 except urllib.error.HTTPError as e:
@@ -1031,6 +1045,11 @@ class DataRouter:
                     break
                 except (OSError, RemoteScanError):
                     break  # node still down: keep the rest queued
+                except WriteError:
+                    # re-route target gone too (database/rp dropped since
+                    # the hint was queued): deterministically
+                    # undeliverable — poison, drop this hint only
+                    remaining[i] = None
                 except (ValueError, KeyError, TypeError):
                     remaining[i] = None  # corrupt hint: drop it
             kept = [l for l in remaining if l is not None]
@@ -1062,14 +1081,20 @@ class DataRouter:
 
     # -- load-aware balancing (reference: balance_manager.go) --------------
 
-    def collect_loads(self) -> dict[str, dict]:
+    def collect_loads(self, deadline: float | None = None) -> dict[str, dict]:
         """{node_id: disk_usage doc} for every reachable data node
-        (local node measured directly)."""
+        (local node measured directly).  `deadline` is an absolute
+        time.perf_counter() stamp: once past it the poll stops early so
+        one slow peer cannot stretch a balance pass past its budget
+        (breaker-open peers already fail fast via CircuitOpen)."""
+        import time as _time
         out: dict[str, dict] = {}
         for nid, addr in sorted(self.data_nodes().items()):
             if nid == self.self_id:
                 out[nid] = self.engine.disk_usage()
                 continue
+            if deadline is not None and _time.perf_counter() >= deadline:
+                break  # budget spent: decide on what we have
             try:
                 out[nid] = self._post(addr, "/internal/load", {"db": "_"})
             except (OSError, RemoteScanError, ValueError):
@@ -1077,7 +1102,8 @@ class DataRouter:
         return out
 
     def balance_round(self, min_skew_bytes: int = 64 << 20,
-                      skew_ratio: float = 1.3) -> dict | None:
+                      skew_ratio: float = 1.3,
+                      budget_s: float | None = None) -> dict | None:
         """ONE load-balancing decision (meta-leader only): when the
         heaviest data node carries skew_ratio x the lightest (and at
         least min_skew_bytes more), move the largest group whose PRIMARY
@@ -1088,7 +1114,10 @@ class DataRouter:
         Reference: app/ts-meta/meta/balance_manager.go /
         master_pt_balance_manager.go (load-reactive PT moves; rendezvous
         handles membership-change moves already)."""
-        loads = self.collect_loads()
+        import time as _time
+        deadline = (None if budget_s is None
+                    else _time.perf_counter() + budget_s)
+        loads = self.collect_loads(deadline)
         if len(loads) < 2:
             return None
         self._prune_placements(loads)
@@ -1117,6 +1146,13 @@ class DataRouter:
         if best is None:
             return None
         key, size, cur = best
+        if cold != self.self_id and self.breaker.enabled():
+            cold_addr = self.data_nodes().get(cold, "")
+            if cold_addr and self.breaker.is_open(cold_addr):
+                # the chosen destination stopped answering since its load
+                # report: proposing the override would strand the group
+                # behind migrate_round retries against a dead peer
+                return None
         new_owners = self._propose_owner_swap(key, cur, hot, cold)
         if new_owners is None:
             return None
@@ -1139,8 +1175,7 @@ class DataRouter:
         new_owners = new_owners[: max(1, self.rf)]
         if dest not in new_owners:
             return None
-        if not self.meta_store.propose_and_wait(
-                {"op": "set_placement", "key": key, "owners": new_owners}):
+        if not self._propose_placement(key, new_owners):
             return None
         return new_owners
 
@@ -1169,19 +1204,25 @@ class DataRouter:
                 self.meta_store.propose_and_wait(
                     {"op": "drop_placement", "key": key})
 
-    def force_move(self, db: str | None = None) -> dict | None:
+    def force_move(self, db: str | None = None,
+                   dest: str | None = None) -> dict | None:
         """Deterministic balancer decision for operators and the cluster
         torture harness (POST /debug/ctrl?mod=cluster&op=move): pick the
         largest shard group this node owns and propose a placement
         override moving it to a node outside the current owner set — no
-        byte skew required.  Like balance_round, retained data-holding
-        owners stay FIRST so rf>1 primary-filtered reads never black-hole
-        the group mid-move; the data streams when this node's next
-        migrate_round observes the lost ownership.  Returns the decision
-        or None (nothing movable / not the meta leader)."""
+        byte skew required.  `dest` pins the destination (elastic node
+        add: rebalance onto a JOINING node instead of whichever node
+        sorts first); default is the first non-owner.  Like
+        balance_round, retained data-holding owners stay FIRST so rf>1
+        primary-filtered reads never black-hole the group mid-move; the
+        data streams when this node's next migrate_round observes the
+        lost ownership.  Returns the decision or None (nothing movable /
+        unknown dest / not the meta leader)."""
         ids = sorted(self.data_nodes())
         if len(ids) < 2:
             return None
+        if dest is not None and dest not in ids:
+            return None  # unknown destination: not in the roster (yet)
         usage = self.engine.disk_usage()
         best = None
         for key, _size in sorted(usage.get("groups", {}).items(),
@@ -1196,6 +1237,11 @@ class DataRouter:
             cur = self.group_owners(gdb, rp, start_i, nodes=ids)
             if self.self_id not in cur:
                 continue
+            if dest is not None:
+                if dest in cur:
+                    continue  # already an owner of this group
+                best = (key, cur, dest)
+                break
             others = [n for n in ids if n not in cur]
             if not others:
                 continue
@@ -1210,6 +1256,226 @@ class DataRouter:
         STATS.incr("cluster", "forced_moves")
         return {"group": key, "from": self.self_id, "to": dest,
                 "owners": new_owners}
+
+    # -- elastic membership (online node add / decommission) ----------------
+
+    def _leader_post(self, path: str, body: dict) -> bool:
+        """Forward a roster/placement mutation to the meta leader over
+        HTTP (any node may initiate; raft serializes at the leader).
+        True on a 200 — anything else, including no known leader, is a
+        clean False for the caller to retry."""
+        hint = self.meta_store.leader_hint()
+        addr = self.meta_store.meta_members().get(hint or "", "")
+        if not addr:
+            return False
+        doc = dict(body)
+        doc["token"] = self.token
+        req = urllib.request.Request(
+            peers.url(addr, path), data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with peers.urlopen(req, timeout=self.timeout_s) as r:
+                return r.status == 200
+        except OSError:
+            return False
+
+    def _propose_placement(self, key: str, new_owners: list[str]) -> bool:
+        """Raft-replicate one placement override, proposing locally on
+        the leader and forwarding through /cluster/placement otherwise
+        (drain and force_move must work when issued on a follower)."""
+        if self.meta_store.is_leader():
+            return bool(self.meta_store.propose_and_wait(
+                {"op": "set_placement", "key": key, "owners": new_owners}))
+        return self._leader_post("/cluster/placement",
+                                 {"key": key, "owners": new_owners})
+
+    def add_node(self, node_id: str, addr: str, role: str = "data") -> dict:
+        """Operator-driven roster add (POST /debug/ctrl?mod=cluster&
+        op=add).  A node started with [meta] join registers itself
+        (server/app.py joiner + registrar); this op covers
+        pre-registration, repair after a lost registration, and tests.
+        Placement onto the new node follows from rendezvous plus
+        balancer moves — data streams over the ordinary two-phase
+        migration, nothing special-cased for joins."""
+        if not node_id or not addr:
+            return {"ok": False, "error": "id and addr required"}
+        if self.meta_store.is_leader():
+            ok = bool(self.meta_store.propose_and_wait(
+                {"op": "register_node", "id": node_id, "addr": addr,
+                 "role": role}))
+        else:
+            ok = self._leader_post("/cluster/register", {
+                "id": node_id, "addr": addr, "role": role})
+        if ok:
+            STATS.incr("cluster", "nodes_added")
+        return {"ok": ok, "node": node_id, "addr": addr,
+                "nodes": sorted(self.data_nodes())}
+
+    def _roster_remove(self, node_id: str) -> bool:
+        if self.meta_store.is_leader():
+            return bool(self.meta_store.propose_and_wait(
+                {"op": "remove_node", "id": node_id}))
+        return self._leader_post("/cluster/deregister", {"id": node_id})
+
+    def _conf_remove(self, node_id: str) -> bool:
+        """Drop a node from the raft voter set (no-op for data-only
+        nodes that never joined the meta group)."""
+        if node_id not in self.meta_store.meta_members():
+            return True
+        if self.meta_store.is_leader():
+            return bool(
+                self.meta_store.propose_conf_change("remove", node_id))
+        return self._leader_post("/raft/remove", {"id": node_id})
+
+    def drain_round(self) -> dict:
+        """ONE drain pass moving this node's data off (POST /debug/ctrl?
+        mod=cluster&op=drain): (1) raft-replicated placement overrides
+        disown every locally-held group — every coordinator re-routes at
+        the same committed index, so no peer's migrate_round can push
+        the group BACK mid-drain; (2) migrate_round streams the disowned
+        groups over the existing durable two-phase machinery; (3)
+        replay_hints drains copies owed to peers.  Writes that land here
+        mid-pass (the old placement still routed them) are picked up by
+        the next pass — they re-route or hint, never vanish.  Returns
+        progress counters; repeat until remaining_groups == 0 and
+        pending_hints is empty."""
+        ids = sorted(self.data_nodes())
+        others = [n for n in ids if n != self.self_id]
+        doc: dict = {"overridden": 0, "migrated": 0, "hints_replayed": 0,
+                     "dead_dests": []}
+        if not others:
+            doc["error"] = "cannot drain the last data node"
+            doc["remaining_groups"] = len(self.engine._shards)
+            doc["pending_hints"] = sorted(self.pending_hint_nodes())
+            return doc
+        for (db, rp, start) in sorted(self.engine._shards):
+            cur = self.group_owners(db, rp, start, nodes=ids)
+            if self.self_id not in cur:
+                continue
+            # retained data-holding owners stay FIRST (primary-filtered
+            # reads keep a data-holding primary mid-move), then fill
+            # from the post-removal rendezvous order so the override
+            # equals plain rendezvous once the roster drops this node —
+            # _prune_placements then retires it automatically
+            post = owners(others, db, rp, start, self.rf)
+            new = [n for n in cur if n != self.self_id]
+            new += [n for n in post if n not in new]
+            new = new[: max(1, min(self.rf, len(others)))]
+            if new and self._propose_placement(f"{db}|{rp}|{start}", new):
+                doc["overridden"] += 1
+        if self.breaker.enabled():
+            # migration already fails fast against these (CircuitOpen is
+            # never retried in _commit_with_retry); surfacing them lets
+            # the decommission loop stop early instead of spinning
+            doc["dead_dests"] = sorted(
+                n for n, a in self.data_nodes().items()
+                if n != self.self_id and a and self.breaker.is_open(a))
+        doc["migrated"] = self.migrate_round()
+        doc["hints_replayed"] = self.replay_hints()
+        doc["remaining_groups"] = len(self.engine._shards)
+        doc["pending_hints"] = sorted(self.pending_hint_nodes())
+        STATS.incr("cluster", "drain_rounds")
+        return doc
+
+    def decommission(self, node: str | None = None,
+                     deadline_s: float = 60.0) -> dict:
+        """Drain-then-remove (POST /debug/ctrl?mod=cluster&
+        op=decommission).  For THIS node: (0) with rf>1, one
+        anti-entropy round repairs this node's replicas while it still
+        owns them, so the copies it sheds are complete even if a peer
+        replica dies later; (1) drain passes under a perf_counter
+        deadline — each pass re-disowns any NEW groups full traffic
+        created meanwhile; (2) roster removal (remove_node through the
+        meta store): every coordinator's rendezvous excludes this node
+        from the committed index on, and late hints for it re-route
+        through replay_hints; (3) raft conf-change removal when this
+        node is a meta voter; (4) final drain passes for in-flight
+        writes that raced the removal.  Idempotent — re-issue after a
+        mid-drain crash or partition and it resumes from the durable
+        state (placements, staging, hint queues).
+
+        With `node` set to a PEER id, this is the forced path for a
+        node that died and cannot drain itself: roster + conf-change
+        removal only.  Acked rows survive on their rf>1 replicas;
+        anti-entropy re-replicates them to the new owners and local
+        hints for the dead node re-route on the next replay."""
+        import time as _time
+
+        if node and node != self.self_id:
+            return self._force_remove(node)
+        t0 = _time.perf_counter()
+        deadline = t0 + max(1.0, deadline_s)
+        state: dict = {"phase": "draining", "node": self.self_id,
+                       "rounds": 0, "overridden": 0, "migrated": 0,
+                       "done": False}
+        self.decommission_state = state
+        if self.rf > 1:
+            try:
+                state["repaired"] = self.anti_entropy_round()
+            except Exception as e:  # noqa: BLE001 — repair best-effort
+                state["repair_error"] = str(e)
+        drained = False
+        while _time.perf_counter() < deadline:
+            doc = self.drain_round()
+            state["rounds"] += 1
+            state["overridden"] += doc["overridden"]
+            state["migrated"] += doc["migrated"]
+            state["last_round"] = doc
+            if doc.get("error"):
+                state["phase"] = "failed"
+                state["error"] = doc["error"]
+                return state
+            if doc["remaining_groups"] == 0 and not doc["pending_hints"]:
+                drained = True
+                break
+            if doc["dead_dests"] and not doc["migrated"]:
+                # every blocked group waits on a breaker-open dest: fail
+                # fast rather than pinning the drain until the deadline
+                state["phase"] = "blocked"
+                state["blocked_on"] = doc["dead_dests"]
+                return state
+            _time.sleep(
+                min(0.2, max(0.0, deadline - _time.perf_counter())))
+        if not drained:
+            state["phase"] = "deadline"
+            return state
+        state["phase"] = "removing"
+        state["roster_removed"] = self._roster_remove(self.self_id)
+        state["conf_removed"] = self._conf_remove(self.self_id)
+        # in-flight writes that the pre-removal placement routed here
+        # land in fresh local groups: push them off too (bounded — the
+        # write sources saw the roster change at the commit index)
+        for _ in range(3):
+            final = self.drain_round()
+            state["final_round"] = final
+            if (final["remaining_groups"] == 0
+                    and not final["pending_hints"]):
+                break
+            if _time.perf_counter() >= deadline:
+                break
+        state["done"] = bool(state["roster_removed"])
+        state["phase"] = "done" if state["done"] else "failed"
+        state["elapsed_s"] = round(_time.perf_counter() - t0, 3)
+        if state["done"]:
+            STATS.incr("cluster", "decommissions")
+        return state
+
+    def _force_remove(self, node_id: str) -> dict:
+        """Forced removal of a peer that cannot drain itself (died
+        mid-drain, lost hardware).  See decommission()."""
+        state: dict = {"phase": "removing", "node": node_id,
+                       "forced": True, "done": False}
+        self.decommission_state = state
+        known = node_id in self.data_nodes()
+        state["roster_removed"] = (
+            self._roster_remove(node_id) if known else True)
+        state["conf_removed"] = self._conf_remove(node_id)
+        state["hints_replayed"] = self.replay_hints()
+        state["done"] = bool(state["roster_removed"])
+        state["phase"] = "done" if state["done"] else "failed"
+        if state["done"]:
+            STATS.incr("cluster", "decommissions")
+        return state
 
     def migrate_round(self) -> int:
         """Rebalancing after membership change — TWO-PHASE (reference:

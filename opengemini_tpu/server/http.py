@@ -904,22 +904,24 @@ def _make_handler(svc: HttpService):
                         names.update(sh.measurements())
                     payload = {"measurements": sorted(names)}
                 self._send_json(200, payload)
-            elif path == "/cluster/register" and svc.meta_store is not None:
+            elif path in ("/cluster/register", "/cluster/deregister",
+                          "/cluster/placement") and svc.meta_store is not None:
                 try:
                     req = json.loads(self._body())
                 except ValueError:
                     req = None
-                if not isinstance(req, dict) or not req.get("id") or not req.get("addr"):
-                    self._send_json(400, {"error": "id and addr required"})
+                if not isinstance(req, dict):
+                    self._send_json(400, {"error": "json body required"})
                     return
                 token = getattr(svc.meta_store, "token", "")
                 if token and req.get("token") != token:
                     self._send_json(403, {"error": "bad cluster token"})
                     return
                 if not token and svc.auth_enabled:
-                    # roster writes must not bypass auth without a shared
-                    # secret (an attacker-registered node would receive a
-                    # share of all writes and feed every query)
+                    # roster/placement writes must not bypass auth without
+                    # a shared secret (an attacker-registered node — or an
+                    # attacker-placed group — would receive a share of all
+                    # writes and feed every query)
                     self._send_json(403, {"error": "cluster token required"})
                     return
                 if not svc.meta_store.is_leader():
@@ -929,10 +931,31 @@ def _make_handler(svc: HttpService):
                               "leader_addr": svc.meta_store.meta_members().get(
                                   hint, "")})
                     return
-                ok = svc.meta_store.propose_and_wait({
-                    "op": "register_node", "id": req["id"],
-                    "addr": req["addr"], "role": req.get("role", "data"),
-                })
+                if path == "/cluster/register":
+                    if not req.get("id") or not req.get("addr"):
+                        self._send_json(400, {"error": "id and addr required"})
+                        return
+                    cmd = {"op": "register_node", "id": req["id"],
+                           "addr": req["addr"],
+                           "role": req.get("role", "data")}
+                elif path == "/cluster/deregister":
+                    # decommission roster drop, forwarded from the leaving
+                    # node (or a survivor forcing out a dead peer)
+                    if not req.get("id"):
+                        self._send_json(400, {"error": "id required"})
+                        return
+                    cmd = {"op": "remove_node", "id": req["id"]}
+                else:  # /cluster/placement — drain/balance owner override
+                    owners_l = req.get("owners")
+                    if (not req.get("key") or not isinstance(owners_l, list)
+                            or not owners_l
+                            or not all(isinstance(o, str) for o in owners_l)):
+                        self._send_json(
+                            400, {"error": "key and owners[] required"})
+                        return
+                    cmd = {"op": "set_placement", "key": req["key"],
+                           "owners": owners_l}
+                ok = svc.meta_store.propose_and_wait(cmd)
                 self._send_json(200 if ok else 503,
                                 {"ok": True} if ok else {"error": "no quorum"})
             elif path in ("/raft/join", "/raft/remove") and svc.meta_store is not None:
@@ -1186,13 +1209,31 @@ def _make_handler(svc: HttpService):
                         out["move"] = router.balance_round()
                     elif op == "move":
                         out["move"] = router.force_move(
-                            params.get("db") or None)
+                            params.get("db") or None,
+                            dest=params.get("dest") or None)
                     elif op == "hints":
                         out["delivered"] = router.replay_hints()
                     elif op == "antientropy":
                         out["repaired"] = router.anti_entropy_round()
                     elif op == "health":
                         out["health"] = router.exchange_health()
+                    elif op == "add":
+                        # elastic membership: register a data node in the
+                        # roster (a [meta] join node self-registers; this
+                        # covers pre-registration + repair)
+                        out["add"] = router.add_node(
+                            params.get("id", ""), params.get("addr", ""),
+                            params.get("role", "data"))
+                    elif op == "drain":
+                        # one drain pass: disown + migrate + hint replay
+                        out["drain"] = router.drain_round()
+                    elif op == "decommission":
+                        # drain-then-remove this node, or forced removal
+                        # of a dead peer via node=<id>
+                        out["decommission"] = router.decommission(
+                            node=params.get("node") or None,
+                            deadline_s=float(
+                                params.get("deadline_s", 60.0)))
                     elif op:
                         self._send_json(
                             400, {"error": f"unknown cluster op {op!r}"})
@@ -1204,6 +1245,8 @@ def _make_handler(svc: HttpService):
                 out["breaker"] = router.breaker.snapshot()
                 out["staging"] = svc.engine.staging_ids()
                 out["pending_hints"] = sorted(router.pending_hint_nodes())
+                out["nodes"] = sorted(router.data_nodes())
+                out["decommission_state"] = router.decommission_state
                 self._send_json(200, out)
                 return
             elif mod == "rollup":

@@ -39,7 +39,7 @@ Invalidation — every mutation of chunk identity:
   compact / downsample
   / delete rewrite     retired readers' generations are invalidated at
                        the file-set swap (shard._retire_files and
-                       _merge_run_locked)
+                       _compact_offlock)
   retention drop,
   shard close/offload  Shard.close / Engine.offload_shard invalidate the
                        generations of every open file

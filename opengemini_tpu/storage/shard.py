@@ -996,50 +996,151 @@ class Shard:
         with self._lock:
             return len(self._files)
 
+    @staticmethod
+    def _find_run(cur: list, run: list) -> int | None:
+        """Position of `run` inside `cur` — matched by READER IDENTITY,
+        contiguous and in order — or None when any member vanished
+        (quarantine pulled a file, or a delete/downsample rewrite swapped
+        the whole set).  The off-lock compaction swap revalidates its
+        snapshot through this before publishing."""
+        if not run:
+            return None
+        for j, r in enumerate(cur):
+            if r is run[0]:
+                if (j + len(run) <= len(cur)
+                        and all(cur[j + k] is run[k]
+                                for k in range(1, len(run)))):
+                    return j
+                return None
+        return None
+
+    def _compact_offlock(self, pick, *, full: bool) -> bool:
+        """Shared snapshot -> off-lock merge -> revalidated-swap engine
+        behind compact()/compact_level()/compact_out_of_order() (the PR 3
+        flush publish discipline applied to background rewrites).
+
+        `pick(files)` inspects an immutable snapshot and returns the
+        contiguous run [i0, i0+n) to merge, or None for nothing to do.
+        `full=True` collapses the run into a file under a FRESH sequence
+        number; `full=False` lands the output at the run's first path
+        (in-place run merge, file order — and with it timestamp LWW
+        rank — preserved).
+
+        Locking: the snapshot (and, for a full merge, the output seq
+        reservation) happens under `_flush_lock` + `_lock`; the merge,
+        encode and fsync run with NO lock held, so ingest/flush/queries
+        never stall behind a compaction.  The seq-order == publish-order
+        rule survives because the output seq is reserved BEFORE going
+        off-lock, exactly like flush reserves its path: a flush that
+        publishes mid-merge takes a strictly higher seq, so the merged
+        (older) rows can never outrank it by name on reopen.  The swap
+        re-acquires both locks and revalidates the snapshot by identity —
+        files appended meanwhile (flush publishes) are preserved after
+        the spliced output; a vanished input (quarantine, delete or
+        downsample rewrite) aborts the whole merge (output removed,
+        inputs untouched, next tick retries) because publishing it could
+        resurrect rows the concurrent rewrite dropped."""
+        with self._flush_lock, self._lock:
+            files = list(self._files)
+            sel = pick(files)
+            if sel is None:
+                return False
+            i0, n = sel
+            run = files[i0:i0 + n]
+            if full:
+                out_path = os.path.join(
+                    self.path, f"{self._next_file_seq:08d}.tsf")
+                self._next_file_seq += 1
+            else:
+                out_path = run[0].path
+        # merge into a `.merge` temp OFF both locks: invisible to queries
+        # and swept by _load_files if we crash before the swap
+        tmp = out_path + ".merge"
+        w = TSFWriter(tmp, kind="compact")
+        tidx = _TextSidecar()
+        try:
+            self._merge_readers(run, w, tidx)
+            w.finish()  # atomically lands at tmp, fsynced
+        except CorruptFile as e:
+            # damaged merge input: quarantine it so the NEXT compaction
+            # (and every query) proceeds without it — merging a corrupt
+            # block into the output would launder the damage past its
+            # checksum forever
+            w.abort()
+            self.note_corrupt(e)
+        except BaseException:
+            w.abort()
+            raise
+        # self-verify the output OFF-lock before it may replace an
+        # input: an in-place merge clobbers run[0] at the swap, so a
+        # torn write / bitflip on the output (diskfault tier) must
+        # abort HERE with every input intact — publishing first and
+        # trusting read-path CRCs would quarantine the merged file
+        # and lose the run's rows on a single replica
+        try:
+            rv = TSFReader(tmp)
+            try:
+                for loc in rv.data_locs():
+                    rv.verify_block(loc)
+            finally:
+                rv.close()
+        except Exception:  # noqa: BLE001 — any unreadable output aborts
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            _STATS.incr("compact", "output_verify_aborts")
+            return False
+        published = False
+        try:
+            _fp("compact-before-replace")
+            with self._flush_lock, self._lock:
+                j = self._find_run(self._files, run)
+                if j is None:
+                    # input vanished mid-merge (quarantine / rewrite):
+                    # abort — the next tick retries over the new set
+                    _STATS.incr("compact", "swap_aborts")
+                    return False
+                os.replace(tmp, out_path)
+                _fp("compact-after-replace")
+                published = True
+                tidx.write(out_path)
+                new_reader = self._adopt(TSFReader(out_path))
+                self._files = (
+                    self._files[:j] + [new_reader] + self._files[j + n:]
+                )
+                self._tidx_cache = {}
+                _fp("compact-before-retire")  # new set live, old not gone
+                if full:
+                    _retire_files(run)
+                else:
+                    _retire_files(run[1:])  # old run[0] reader keeps its fd
+                    # run[0]'s OLD reader was replaced in place (same
+                    # path, new generation): its path needs no unlink,
+                    # but its cached decoded columns can never hit again
+                    # and would otherwise pin budget forever
+                    colcache.GLOBAL.invalidate_gens([run[0].gen])
+            _STATS.incr("compact", "offlock_merges")
+            return True
+        finally:
+            if not published:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+
     def compact(self, max_files: int = 1) -> bool:
         """Full merge of immutable files (level compaction analogue,
         reference engine/immutable/compact.go LevelCompact:120). Rewrites
-        all chunks per series merged+deduped into one file. Returns whether
-        a merge happened."""
-        # _flush_lock first (lock-order rule): a full merge allocates a
-        # NEW file sequence number, and racing an in-flight off-lock
-        # flush (which reserved a LOWER seq before its encode) would
-        # publish the merged old data under a HIGHER seq — in-memory
-        # order stays right, but _load_files sorts by name on reopen and
-        # would rank the stale merge newer than the flush. Serializing
-        # with the flush keeps seq order == publish order.
-        # audited (lockdep): the merge writes + fsyncs under the shard
-        # lock — the seq-order rule above requires exclusivity; the
-        # off-lock compaction restructure is tracked roadmap work
-        with lockdep.allow_blocking("compact merge under shard lock"), \
-                self._flush_lock, self._lock:
-            if len(self._files) <= max_files:
-                return False
-            path = os.path.join(self.path, f"{self._next_file_seq:08d}.tsf")
-            w = TSFWriter(path, kind="compact")
-            tidx = _TextSidecar()
-            try:
-                self._merge_readers(self._files, w, tidx)
-                _fp("compact-before-replace")
-                w.finish()
-            except CorruptFile as e:
-                # damaged merge input: quarantine it so the NEXT
-                # compaction (and every query) proceeds without it —
-                # merging a corrupt block into the output would launder
-                # the damage past its checksum forever
-                w.abort()
-                self.note_corrupt(e)
-            except BaseException:
-                w.abort()
-                raise
-            tidx.write(path)
-            self._next_file_seq += 1
-            old = self._files
-            self._files = [self._adopt(TSFReader(path))]
-            self._tidx_cache = {}
-            _fp("compact-before-retire")  # new set adopted, old not yet gone
-            _retire_files(old)
-            return True
+        all chunks per series merged+deduped into one file under a fresh
+        sequence number. Returns whether a merge happened (False both for
+        nothing-to-do and for a merge aborted by the revalidating swap)."""
+        def pick(files):
+            if len(files) <= max_files:
+                return None
+            return (0, len(files))
+
+        return self._compact_offlock(pick, full=True)
 
     @staticmethod
     def _file_level(path: str) -> int:
@@ -1063,18 +1164,12 @@ class Shard:
         across remaining files stays correct). O(run) per call instead of
         the full-merge's O(shard) — bounded write amplification."""
         fanout = max(2, fanout)  # fanout=1 would rewrite a file in place
-        # _flush_lock first: in-place run merges allocate no new seq,
-        # but serializing with the off-lock flush keeps every file-set
-        # rewrite disjoint from a publish (see compact())
-        # audited (lockdep): rewrite/fsync under the shard lock — the
-        # PR 3 seq-order rule requires exclusivity here (see compact())
-        with lockdep.allow_blocking("level-compact merge under shard lock"), \
-                self._flush_lock, self._lock:
-            if len(self._files) < fanout:
-                return False
-            levels = [self._file_level(r.path) for r in self._files]
+
+        def pick(files):
+            if len(files) < fanout:
+                return None
+            levels = [self._file_level(r.path) for r in files]
             run_start = run_len = 0
-            best: tuple[int, int] | None = None
             for i in range(len(levels)):
                 if i > 0 and levels[i] == levels[i - 1]:
                     run_len += 1
@@ -1083,49 +1178,10 @@ class Shard:
                 if run_len >= fanout:
                     # merge exactly `fanout` files per call: bounded work,
                     # deterministic, and repeated ticks converge
-                    best = (run_start, fanout)
-                    break
-            if best is None:
-                return False
-            i0, n = best
-            self._merge_run_locked(i0, n)
-            return True
+                    return (run_start, fanout)
+            return None
 
-    def _merge_run_locked(self, i0: int, n: int) -> None:
-        """Merge the contiguous file run [i0, i0+n) into one file landing
-        at the run's FIRST position (file-order LWW stays correct).
-        Caller holds self._lock."""
-        run = self._files[i0 : i0 + n]
-        target = run[0].path
-        tmp = target + ".merge"
-        w = TSFWriter(tmp, kind="compact")
-        tidx = _TextSidecar()
-        try:
-            self._merge_readers(run, w, tidx)
-            w.finish()  # atomically lands at tmp
-        except CorruptFile as e:
-            w.abort()
-            self.note_corrupt(e)  # see compact()
-        except BaseException:
-            w.abort()
-            raise
-        _fp("compact-before-replace")
-        os.replace(tmp, target)  # new content under the run's 1st name
-        _fp("compact-after-replace")
-        tidx.write(target)
-        new_reader = self._adopt(TSFReader(target))
-        retired = run[1:]
-        self._files = (
-            self._files[:i0] + [new_reader] + self._files[i0 + n :]
-        )
-        self._tidx_cache = {}
-        _fp("compact-before-retire")
-        _retire_files(retired)  # the old run[0] reader keeps its fd
-        # run[0]'s OLD reader was replaced in place (same path, new
-        # generation): its path needs no unlink, but its cached decoded
-        # columns must go — they can never hit again (the new reader has
-        # a fresh generation) and would otherwise pin budget forever
-        colcache.GLOBAL.invalidate_gens([run[0].gen])
+        return self._compact_offlock(pick, full=False)
 
     def has_time_overlap(self) -> bool:
         """True when any two immutable files' time ranges overlap (the
@@ -1149,15 +1205,10 @@ class Shard:
         first overlapping file toward its overlap partner, capped at
         `max_files` per call; repeated calls converge to disjoint
         ranges."""
-        # _flush_lock first: see compact()
-        # audited (lockdep): rewrite/fsync under the shard lock — the
-        # PR 3 seq-order rule requires exclusivity here (see compact())
-        with lockdep.allow_blocking("out-of-order compact merge under shard lock"), \
-                self._flush_lock, self._lock:
-            if len(self._files) < 2:
-                return False
-            ranges = [(r.tmin, r.tmax) for r in self._files]
-            pick = None
+        def pick(files):
+            if len(files) < 2:
+                return None
+            ranges = [(r.tmin, r.tmax) for r in files]
             for i in range(len(ranges)):
                 if ranges[i][0] is None:
                     continue
@@ -1166,18 +1217,13 @@ class Shard:
                         continue
                     if (ranges[j][0] <= ranges[i][1]
                             and ranges[i][0] <= ranges[j][1]):
-                        pick = (i, j)
-                        break
-                if pick:
-                    break
-            if pick is None:
-                return False
-            i, j = pick
-            # the run must stay contiguous (an intervening file's rows
-            # must not change rank relative to the merge output)
-            n = min(j - i + 1, max(2, max_files))
-            self._merge_run_locked(i, n)
-            return True
+                        # the run must stay contiguous (an intervening
+                        # file's rows must not change rank relative to
+                        # the merge output)
+                        return (i, min(j - i + 1, max(2, max_files)))
+            return None
+
+        return self._compact_offlock(pick, full=False)
 
     def rewrite_downsampled(self, every_ns: int, field_aggs: dict | None = None) -> int:
         """Rewrite this shard at `every_ns` resolution (reference:
@@ -1190,8 +1236,10 @@ class Shard:
         # flush below re-enters it, and holding it for the whole rewrite
         # keeps a concurrent off-lock flush from publishing a pre-rewrite
         # snapshot AFTER the file-set swap resurrects dropped rows
-        # audited (lockdep): rewrite/fsync under the shard lock — the
-        # PR 3 seq-order rule requires exclusivity here (see compact())
+        # audited (lockdep): unlike compaction (now fully off-lock, see
+        # _compact_offlock), this rewrite derives its output from the
+        # LIVE memtable+file state, so it must exclude ingest for its
+        # whole read-rewrite-swap span — the exemption stays audited
         with lockdep.allow_blocking("downsample rewrite under shard lock"), \
                 self._flush_lock, self._lock:
             self.flush()
@@ -1245,8 +1293,9 @@ class Shard:
         (engine DropMeasurement / DeleteSeries). Flushes first so the
         memtable participates."""
         # _flush_lock first: see rewrite_downsampled
-        # audited (lockdep): rewrite/fsync under the shard lock — the
-        # PR 3 seq-order rule requires exclusivity here (see compact())
+        # audited (lockdep): like rewrite_downsampled (and unlike the
+        # off-lock compactions), the rewrite reads live state and must
+        # exclude ingest end-to-end — the exemption stays audited
         with lockdep.allow_blocking("delete rewrite under shard lock"), \
                 self._flush_lock, self._lock:
             self.flush()

@@ -60,6 +60,7 @@ __all__ = [
     "Lock", "RLock", "Condition", "LockdepError", "enabled", "mark_hot",
     "name_class", "held_classes", "allow_blocking", "violations",
     "hold_reports", "check", "reset", "stats_snapshot",
+    "RETIRED_EXEMPTIONS",
 ]
 
 _ARMED = os.environ.get("OGT_LOCKDEP", "") not in ("", "0")
@@ -68,6 +69,27 @@ HOLD_BUDGET_MS = float(os.environ.get("OGT_LOCKDEP_HOLD_MS", "0") or 0)
 
 class LockdepError(RuntimeError):
     """Raised by check(): at least one ordering/blocking violation."""
+
+
+# Exemption reasons that USED to be audited and were then eliminated by
+# restructuring the code (the off-lock compaction rework moved every
+# compaction merge/fsync off the hot shard lock).  Re-registering one is
+# a regression — the invariant is now "compaction never blocks under the
+# shard lock", and it is enforced here in BOTH modes (armed and not) so
+# the cheap unarmed tree still refuses the exemption at the call site.
+RETIRED_EXEMPTIONS = frozenset({
+    "compact merge under shard lock",
+    "level-compact merge under shard lock",
+    "out-of-order compact merge under shard lock",
+})
+
+
+def _check_retired(reason: str) -> None:
+    if reason in RETIRED_EXEMPTIONS:
+        raise LockdepError(
+            f"lockdep exemption {reason!r} is retired: compaction must "
+            "merge/fsync OFF the shard lock (snapshot -> off-lock merge "
+            "-> revalidated swap), not under an audited exemption")
 
 
 def enabled() -> bool:
@@ -103,6 +125,7 @@ if not _ARMED:
     _NULL_CTX = _NullCtx()
 
     def allow_blocking(reason: str = ""):
+        _check_retired(reason)
         return _NULL_CTX
 
     def violations() -> list:
@@ -486,6 +509,7 @@ else:
     def allow_blocking(reason: str = ""):
         """Annotate an AUDITED blocking call under a hot lock (e.g. the
         WAL rotate fsync, fenced by the shard lock by design)."""
+        _check_retired(reason)
         return _AllowCtx(reason)
 
     def _check_blocking(kind: str) -> None:

@@ -39,14 +39,17 @@ def _run(args, timeout):
 
 def test_cluster_torture_quick_zero_acked_row_loss():
     """Tier-1 gate: fixed schedule — node kill at an armed cluster site,
-    kill during a forced balancer move, partition + heal, and a media
+    kill during a forced balancer move, partition + heal, a media
     scribble (bit flip in a closed TSF on a killed replica; block CRC
     detects, quarantine contains, anti-entropy repairs from the rf=2
-    peer) — 0 acked-row loss or duplication, ledgers clean, no staging
-    left behind."""
-    out = _run(["--quick"], timeout=600)
+    peer), and an elastic membership round (join a 4th node under live
+    traffic, rebalance onto it, decommission an original with a
+    mid-drain partition) — 0 acked-row loss or duplication from every
+    SURVIVING coordinator, ledgers clean, no staging or pending hints
+    left behind for the removed node."""
+    out = _run(["--quick"], timeout=900)
     assert out["summary"]["violations"] == 0
-    assert out["summary"]["rounds"] == 4
+    assert out["summary"]["rounds"] == 5
     # the schedule must actually kill nodes (both failpoint rounds are
     # built to fire under traffic) and bank real acked traffic
     assert out["summary"]["killed"] >= 1

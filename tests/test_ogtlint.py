@@ -506,3 +506,42 @@ def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
         "opengemini_tpu/mod.py": "def broken(:\n"})
     found = ogtlint.collect_findings(root)
     assert [f.rule for f in found] == ["SYNTAX"]
+
+
+def test_ogt050_cluster_elastic_metric_family(tmp_path):
+    """The elastic-membership counters (ISSUE 19) ride the existing
+    ogt_cluster_* family: nodes_added / drain_rounds / decommissions
+    obey the grammar; a node id smuggled into a FAMILY name (nodes are
+    labels, never families) is a finding."""
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": (
+            "GLOBAL.incr('cluster', 'nodes_added')\n"        # ok
+            "GLOBAL.incr('cluster', 'drain_rounds')\n"       # ok
+            "GLOBAL.incr('cluster', 'decommissions')\n"      # ok
+            "GLOBAL.incr('cluster', 'drain-rounds')\n"       # finding
+            "GLOBAL.incr('cluster', 'decommissions_n4')\n"   # ok grammar,
+            # but a per-node key would explode the family; the reviewer
+            # gate is the README metric table, not this lint
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT050")
+    assert sorted(f.detail for f in found) == ["cluster.drain-rounds"]
+
+
+def test_ogt050_compact_metric_family(tmp_path):
+    """The off-lock compaction counters (ISSUE 19) open the
+    ogt_compact_* family: offlock_merges / swap_aborts /
+    output_verify_aborts obey the grammar; dashed or cased keys are
+    findings."""
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": (
+            "GLOBAL.incr('compact', 'offlock_merges')\n"        # ok
+            "GLOBAL.incr('compact', 'swap_aborts')\n"           # ok
+            "GLOBAL.incr('compact', 'output_verify_aborts')\n"  # ok
+            "GLOBAL.incr('compact', 'Swap_Aborts')\n"           # finding
+            "GLOBAL.incr('compact', 'swap-aborts')\n"           # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT050")
+    assert sorted(f.detail for f in found) == [
+        "compact.Swap_Aborts", "compact.swap-aborts"]

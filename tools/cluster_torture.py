@@ -18,10 +18,12 @@ anti-entropy):
 One round:
   1. (quick: fixed schedule; full: randomized) choose a fault — arm a
      cluster failpoint `panic#k` on a victim via /debug/ctrl, SIGKILL a
-     node mid-traffic, or partition a node pair with netfault drops —
-     optionally stacked with a FORCED shard move (op=move placement
-     override + migrate rounds) so the two-phase migration path is live
-     while the fault fires;
+     node mid-traffic, partition a node pair with netfault drops, or an
+     ELASTIC membership round (join a brand-new node, rebalance onto
+     it, decommission an original via drain-then-remove with a
+     partition stacked mid-drain) — optionally stacked with a FORCED
+     shard move (op=move placement override + migrate rounds) so the
+     two-phase migration path is live while the fault fires;
   2. drive tools/loadgen.py traffic against every coordinator (mixed
      consistency levels one+quorum, per-batch fsynced ack journal);
   3. heal: clear netfault rules, disarm surviving failpoints, restart
@@ -135,7 +137,7 @@ class Node:
     """One subprocess ts-server node (full stack) + its HTTP handle."""
 
     def __init__(self, nid: str, port: int, workdir: str,
-                 peer_specs: list[str], rf: int):
+                 peer_specs: list[str], rf: int, join: str | None = None):
         self.nid = nid
         self.port = port
         self.addr = f"127.0.0.1:{port}"
@@ -146,6 +148,10 @@ class Node:
         self.proc: subprocess.Popen | None = None
         self._logf = None
         peers_toml = ", ".join(f'"{p}"' for p in peer_specs)
+        # an elastic joiner knows only itself + its seed; it enters the
+        # meta group via /raft/join and the data roster via the
+        # registrar (the path an operator's `op=add` also covers)
+        join_toml = f'join = "{join}"\n' if join else ""
         with open(self.cfg_path, "w", encoding="utf-8") as f:
             f.write(f"""\
 [data]
@@ -160,7 +166,7 @@ bind-address = "127.0.0.1:{port}"
 node-id = "{nid}"
 peers = [{peers_toml}]
 advertise = "{self.addr}"
-
+{join_toml}
 [cluster]
 data-routing = true
 replication-factor = {rf}
@@ -289,9 +295,34 @@ class Cluster:
         nids = [f"n{i + 1}" for i in range(n)]
         specs = [f"{nid}@127.0.0.1:{port}"
                  for nid, port in zip(nids, ports)]
+        self.workdir = workdir
+        self.rf = rf
+        self._next_nid = n + 1
         self.nodes = [Node(nid, port, workdir, specs, rf)
                       for nid, port in zip(nids, ports)]
         self.by_id = {node.nid: node for node in self.nodes}
+
+    def add_elastic_node(self, seed: Node) -> Node:
+        """Spawn a brand-new node that JOINS the live cluster via its
+        seed (meta /raft/join + data-roster registrar) — the elastic
+        grow path, exercised under full traffic."""
+        port = _free_ports(1)[0]
+        nid = f"n{self._next_nid}"
+        self._next_nid += 1
+        node = Node(nid, port, self.workdir,
+                    [f"{nid}@127.0.0.1:{port}"], self.rf, join=seed.addr)
+        self.nodes.append(node)
+        self.by_id[nid] = node
+        node.spawn()
+        return node
+
+    def remove(self, node: Node) -> None:
+        """Retire a decommissioned node from the harness roster: its
+        process stops and wait_ready/converge/verify stop expecting it."""
+        node.terminate()
+        if node in self.nodes:
+            self.nodes.remove(node)
+        self.by_id.pop(node.nid, None)
 
     def spawn_all(self) -> None:
         for node in self.nodes:
@@ -643,6 +674,145 @@ def _scribble_node(victim: Node, rng: random.Random) -> str | None:
     return None
 
 
+def _elastic_round(cluster: Cluster, rng: random.Random,
+                   traffic: Traffic) -> dict:
+    """Membership change under full traffic: JOIN a brand-new node
+    (meta raft conf-add + data-roster registration), rebalance a group
+    onto it over the two-phase migration, then DECOMMISSION a non-leader
+    original (drain-then-remove) with a partition stacked mid-drain.
+    The decommission op is idempotent, so the harness re-issues it after
+    the heal until it reports done — exactly the operator runbook."""
+    detail: dict = {"problems": []}
+    seed = next(n for n in cluster.nodes if n.alive())
+    new = cluster.add_elastic_node(seed)
+    detail["added"] = new.nid
+    deadline = time.perf_counter() + 90
+    joined = False
+    while time.perf_counter() < deadline:
+        try:
+            st = seed.ctrl("cluster", timeout=15)
+            if new.nid in st.get("nodes", []):
+                joined = True
+                break
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.3)
+    if not joined:
+        detail["problems"].append(
+            f"elastic: {new.nid} never entered the data roster")
+        return detail
+    # rendezvous already re-homed ~1/N groups when the roster grew; a
+    # forced move with an explicit dest makes the migration path onto
+    # the joiner deterministic, then migrate rounds stream the data
+    for node in cluster.nodes:
+        if not node.alive() or node is new:
+            continue
+        try:
+            mv = node.ctrl("cluster", op="move", db=DB, dest=new.nid,
+                           timeout=60).get("move")
+        except (OSError, ValueError):
+            continue
+        if mv:
+            detail["move"] = mv
+            break
+    for node in cluster.nodes:
+        if node.alive():
+            try:
+                node.ctrl("cluster", op="migrate", timeout=120)
+            except (OSError, ValueError):
+                pass
+    # decommission a non-leader ORIGINAL while traffic still runs
+    try:
+        leader_nid = cluster.leader().nid
+    except TimeoutError:
+        leader_nid = ""
+    victim = next((n for n in cluster.nodes
+                   if n.alive() and n is not new and n.nid != leader_nid),
+                  None)
+    if victim is None:  # every non-joiner is dead or the meta leader
+        victim = next((n for n in cluster.nodes
+                       if n.alive() and n is not new), None)
+    if victim is None:
+        detail["problems"].append("elastic: no decommission candidate")
+        return detail
+    detail["decommissioned"] = victim.nid
+    out: dict = {}
+
+    def decomm(deadline_s: float, timeout: float) -> None:
+        try:
+            got = victim.ctrl("cluster", op="decommission",
+                              deadline_s=deadline_s, timeout=timeout)
+            out.clear()
+            out.update(got.get("decommission", {}))
+        except (OSError, ValueError) as e:
+            out["error"] = str(e)
+
+    # partition FIRST so the drain provably starts degraded (a fast
+    # drain would otherwise finish before a stacked fault lands): the
+    # blocked/deadline drain must make no false progress claims, and
+    # the post-heal re-issue must complete from durable state
+    peer = rng.choice([n for n in cluster.nodes
+                       if n.alive() and n is not victim])
+    cluster.partition(victim, peer)
+    detail["mid_drain_partition"] = [victim.nid, peer.nid]
+    th = threading.Thread(target=decomm, args=(45.0, 120.0), daemon=True,
+                          name="torture-decommission")
+    th.start()
+    time.sleep(1.5)  # drain passes run against the partitioned pair
+    for node in (victim, peer):
+        if node.alive():
+            node.netfault_clear()
+    traffic.join(timeout=90)
+    th.join(timeout=150)
+    detail["decommission"] = dict(out)
+    # a drain that raced the partition returns blocked/deadline WITHOUT
+    # removing the node — re-issue until done (resumes from the durable
+    # placements/staging/hint state, never re-copies committed groups)
+    deadline = time.perf_counter() + 120
+    while not out.get("done") and time.perf_counter() < deadline:
+        decomm(30.0, 90.0)
+        detail["decommission"] = dict(out)
+        if not out.get("done"):
+            time.sleep(0.5)
+    if not out.get("done"):
+        detail["problems"].append(
+            f"elastic: decommission of {victim.nid} never completed: "
+            f"{out}")
+        return detail
+    # late writes routed THROUGH the removed coordinator may sit in its
+    # hint queue: the runbook keeps the process up until a final drain
+    # reports clean, then retires it
+    try:
+        last = victim.ctrl("cluster", op="drain",
+                           timeout=120).get("drain", {})
+        if last.get("remaining_groups") or last.get("pending_hints"):
+            detail["problems"].append(
+                f"elastic: removed {victim.nid} still holds work: "
+                f"groups={last.get('remaining_groups')} "
+                f"hints={last.get('pending_hints')}")
+    except (OSError, ValueError) as e:
+        detail["problems"].append(
+            f"elastic: final drain check on {victim.nid} failed: {e}")
+    cluster.remove(victim)
+    for node in cluster.nodes:
+        if not node.alive():
+            continue
+        try:
+            st = node.ctrl("cluster", timeout=30)
+        except (OSError, ValueError) as e:
+            detail["problems"].append(
+                f"elastic: {node.nid} roster check failed: {e}")
+            continue
+        if victim.nid in st.get("nodes", []):
+            detail["problems"].append(
+                f"elastic: {node.nid} roster still lists {victim.nid}")
+        if victim.nid in (st.get("pending_hints") or []):
+            detail["problems"].append(
+                f"elastic: {node.nid} still owes hints to removed "
+                f"{victim.nid}")
+    return detail
+
+
 def _apply_round(cluster: Cluster, kind: str, rng: random.Random,
                  traffic: Traffic, site: str | None, nth: int,
                  victim: Node | None, pair: tuple[Node, Node] | None,
@@ -704,6 +874,10 @@ def _apply_round(cluster: Cluster, kind: str, rng: random.Random,
         for node in (a, b):
             if node.alive():
                 node.netfault_clear()
+    elif kind == "elastic":
+        # membership change under traffic: join a new node, rebalance
+        # onto it, decommission an original with a mid-drain partition
+        detail.update(_elastic_round(cluster, rng, traffic))
     if with_move:
         try:
             detail["move"] = cluster.force_move()
@@ -755,14 +929,25 @@ def run_rounds(cluster: Cluster, rounds: list[dict], workdir: str,
     offset = 0
     for i, spec in enumerate(rounds):
         ack_log = os.path.join(workdir, f"acks-{i}.jsonl")
-        traffic = Traffic(cluster, traffic_s, clients, offset,
-                          ack_log).start()
+        traffic = Traffic(cluster, spec.get("traffic_s", traffic_s),
+                          clients, offset, ack_log).start()
         offset += clients
         time.sleep(0.3)  # let the first batches land
-        victim = cluster.by_id[spec["victim"]] if spec.get("victim") \
-            else None
-        pair = tuple(cluster.by_id[n] for n in spec["pair"]) \
-            if spec.get("pair") else None
+        # resolve by id at round time: elastic rounds mutate membership,
+        # so a pre-scheduled victim may no longer exist — reroll it
+        live = [n for n in cluster.nodes if n.alive()] or cluster.nodes
+        victim = cluster.by_id.get(spec["victim"], rng.choice(live)) \
+            if spec.get("victim") else None
+        pair = None
+        if spec.get("pair"):
+            pair = tuple(cluster.by_id[n] for n in spec["pair"]
+                         if n in cluster.by_id)
+            if len(pair) < 2:
+                pair = tuple(rng.sample(live, 2)) if len(live) >= 2 \
+                    else None
+            if pair is None:
+                spec = dict(spec, kind="sigkill", victim=live[0].nid)
+                victim = live[0]
         detail = _apply_round(
             cluster, spec["kind"], rng, traffic, spec.get("site"),
             spec.get("nth", 1), victim, pair,
@@ -798,7 +983,8 @@ def run_rounds(cluster: Cluster, rounds: list[dict], workdir: str,
                 scribble_problems.append(
                     "scribble: corruption injected but never detected/"
                     "quarantined")
-        problems = cluster.converge(timeout=90)
+        problems = detail.pop("problems", [])
+        problems += cluster.converge(timeout=90)
         problems += scribble_problems
         acked = read_acks(ack_log)
         all_acked.extend(acked)
@@ -839,6 +1025,12 @@ QUICK_ROUNDS = [
     # anti-entropy repairs from the rf=2 peer until every coordinator
     # again serves the FULL acked set
     {"kind": "scribble", "victim": "n3"},
+    # elastic membership under full traffic: join a 4th node (raft
+    # conf-add + roster registration), force a group onto it over the
+    # two-phase migration, then decommission a non-leader original
+    # (drain-then-remove) with a partition stacked mid-drain — every
+    # acked row must stay exactly-once readable from every SURVIVOR
+    {"kind": "elastic", "traffic_s": 6.0},
 ]
 
 
@@ -859,7 +1051,13 @@ def _random_schedule(rng: random.Random, n: int,
         elif roll < 0.65:
             spec = {"kind": "sigkill", "victim": rng.choice(nids),
                     "move": rng.random() < 0.4}
-        elif roll < 0.78:
+        elif roll < 0.72:
+            # membership churn: each elastic round adds one node and
+            # decommissions one, so the cluster size stays constant
+            # while every round reshuffles which ids exist (victims are
+            # re-resolved at round time)
+            spec = {"kind": "elastic", "traffic_s": 6.0}
+        elif roll < 0.82:
             spec = {"kind": "scribble", "victim": rng.choice(nids)}
         else:
             pair = rng.sample(nids, 2)
