@@ -1576,6 +1576,139 @@ def bench_rollup_dashboard(rows: int = 2_000_000, series: int = 12,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_rule_fleet_tick(rules: int = 2000, series: int = 200,
+                          ticks: int = 3) -> dict:
+    """Continuous rule fleet under live ingest (promql/rules.py, the
+    ISSUE 20 acceptance metric): a fleet of rate/threshold rules ticking
+    while writes land, per-tick cost measured across growing window
+    lengths.  The incremental leg (dirty-tile refold + merged tile
+    prefixes, one merge shared per (selector, func, window)) must stay
+    FLAT as the window grows; the forced from-scratch leg (tile caches
+    invalidated before each tick — exactly what every tick would cost
+    without incremental maintenance) degrades linearly with the window.
+    Every measured incremental tick is re-checked BIT-IDENTICAL against
+    an untimed from-scratch evaluation (verify_last_tick), and the
+    flat/linear claim is asserted in-bench."""
+    import shutil
+    import tempfile
+
+    from opengemini_tpu.promql.rules import Rule, RuleManager
+    from opengemini_tpu.storage.engine import Engine
+
+    NS = 1_000_000_000
+    base = 1_700_000_040
+    interval_s = 15
+    windows_s = (60, 240, 960)
+    root = tempfile.mkdtemp(prefix="ogtpu-rules-")
+    eng = None
+    mgr = None
+    try:
+        eng = Engine(root, flush_threshold_bytes=1 << 30)
+        eng.create_database("db")
+
+        def write_span(lo_s: int, hi_s: int):
+            # 1 sample / s / series, float counters with resets: dense
+            # enough that the from-scratch leg's window scan dominates
+            # its fixed per-tick overhead
+            lines = []
+            for s in range(series):
+                v = float(s)
+                for t in range(lo_s, hi_s):
+                    v += (t * 13 + s * 7) % 97 * 0.25
+                    if (t + s) % 997 == 0:
+                        v = 0.5  # counter reset
+                    lines.append(
+                        f"rf_requests,job=api,host=h{s} value={v} "
+                        f"{(base + t) * NS + s}")
+            eng.write_lines("db", "\n".join(lines))
+
+        span = max(windows_s) + interval_s * (2 * ticks + 4)
+        write_span(0, span)
+        eng.flush_all()
+        mgr = RuleManager(eng)
+        per_group = rules // len(windows_s)
+        for w in windows_s:
+            fleet = []
+            for i in range(per_group):
+                if i % 2 == 0:
+                    # aggregated output: fleet recording rules write one
+                    # series each, so write-back stays O(rules) per tick
+                    # rather than O(rules x series)
+                    fleet.append(Rule(
+                        f"rec_w{w}_{i}",
+                        f"sum by (job) (rate(rf_requests[{w}s]))"))
+                else:
+                    fleet.append(Rule(
+                        f"alert_w{w}_{i}",
+                        f"sum by (job) (rate(rf_requests[{w}s]))"
+                        f" > {i * 0.01}",
+                        kind="alerting", for_s=0.0))
+            mgr.add_rules("db", f"fleet_{w}", fleet,
+                          interval_s=interval_s)
+        groups = {g.name: g for g in mgr.groups_for("db")}
+
+        now_s = base + span
+        per_window: dict[int, dict] = {}
+        verified = 0
+        for w in windows_s:
+            g = groups[f"fleet_{w}"]
+            incr, rescan = [], []
+            for k in range(ticks):
+                # live ingest between ticks: the head advances, tiles at
+                # the head dirty, everything older stays cached
+                write_span(now_s - base, now_s - base + interval_s)
+                now_s += interval_s
+                t0 = time.perf_counter()
+                assert mgr.tick_group(g, now_s * NS)
+                incr.append(time.perf_counter() - t0)
+                mgr.verify_last_tick(g)  # bitwise, untimed
+                verified += 1
+                # forced from-scratch: invalidate the tile caches so the
+                # next tick refolds the FULL window off storage
+                write_span(now_s - base, now_s - base + interval_s)
+                now_s += interval_s
+                mgr.invalidate("db", g.name)
+                t0 = time.perf_counter()
+                assert mgr.tick_group(g, now_s * NS)
+                rescan.append(time.perf_counter() - t0)
+                mgr.verify_last_tick(g)
+                verified += 1
+            per_window[w] = {
+                "incremental_ms": round(min(incr) * 1000, 2),
+                "rescan_ms": round(min(rescan) * 1000, 2),
+            }
+        w0, wN = windows_s[0], windows_s[-1]
+        incr_growth = (per_window[wN]["incremental_ms"]
+                       / max(per_window[w0]["incremental_ms"], 1e-9))
+        rescan_growth = (per_window[wN]["rescan_ms"]
+                         / max(per_window[w0]["rescan_ms"], 1e-9))
+        window_growth = wN / w0
+        # flat vs linear: the rescan leg must track the window growth
+        # while the incremental leg stays decoupled from it
+        assert rescan_growth > incr_growth * 2, (
+            f"rule fleet: rescan growth {rescan_growth:.2f}x not "
+            f"separated from incremental growth {incr_growth:.2f}x "
+            f"over a {window_growth:.0f}x window")
+        return {
+            "rules": per_group * len(windows_s),
+            "series": series,
+            "ticks_per_leg": ticks,
+            "interval_s": interval_s,
+            "per_window": {str(k): v for k, v in per_window.items()},
+            "incremental_growth": round(incr_growth, 2),
+            "rescan_growth": round(rescan_growth, 2),
+            "window_growth": window_growth,
+            "verified_ticks": verified,
+            "bit_identical": True,  # verify_last_tick raises otherwise
+        }
+    finally:
+        if mgr is not None:
+            mgr.close()
+        if eng is not None:
+            eng.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_overload_shed(clients: int = 32, duration_s: float = 6.0,
                         budget_mb: int = 4) -> dict:
     """Resource-governor overload behavior (PR 5 acceptance metric): a
@@ -3406,6 +3539,23 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
     except Exception as e:  # noqa: BLE001 — bench must still emit
         print(f"bench: rollup dashboard failed: {e}", file=sys.stderr)
 
+    # continuous rule fleet: incremental tick flat vs window length,
+    # forced re-scan linear, bit-identity asserted per measured tick
+    # (the ISSUE 20 acceptance metric)
+    rule_fleet = None
+    try:
+        rule_fleet = bench_rule_fleet_tick(
+            rules=int(os.environ.get("OGTPU_BENCH_RULE_FLEET", "2000")))
+        _emit("rule_fleet_tick" + suffix,
+              rule_fleet["per_window"][
+                  str(max(int(k) for k in rule_fleet["per_window"]))][
+                  "incremental_ms"], "ms",
+              rule_fleet["rescan_growth"]
+              / max(rule_fleet["incremental_growth"], 1e-9),
+              {"detail": rule_fleet})
+    except Exception as e:  # noqa: BLE001 — bench must still emit
+        print(f"bench: rule fleet tick failed: {e}", file=sys.stderr)
+
     # resource-governor overload shedding: tiny budget, 32 closed-loop
     # clients — shed rate + admitted-query p99 + peak RSS vs budget
     # (the PR 5 acceptance metric)
@@ -3567,6 +3717,8 @@ def _run_configs(device: bool, probe: dict, watchdog=None) -> None:
         extra["device_decode_cold_scan"] = device_decode
     if rollup_dash:
         extra["rollup_dashboard"] = rollup_dash
+    if rule_fleet:
+        extra["rule_fleet_tick"] = rule_fleet
     if overload:
         extra["overload_shed"] = overload
     if offload_planner and not offload_planner.get("skipped"):

@@ -378,6 +378,21 @@ def _build_services(cfg: dict, svc: HttpService) -> list:
     # inert (one None check per tick) until a rollup spec is declared
     out.append(RollupService(
         svc.engine, float(sc.get("rollup-interval-s", 5))))
+    from opengemini_tpu.promql.rules import enabled_by_env as _rules_on
+    from opengemini_tpu.services.rules import RulesService
+
+    if _rules_on():
+        from opengemini_tpu.promql.rules import RuleManager
+
+        # constructed eagerly so persisted groups resume ticking after a
+        # restart (the durable claim/watermark contract needs the
+        # manager live before traffic); OGT_RULES=0 keeps rules_hook
+        # None and every write path bit-identical
+        svc.rules_manager = RuleManager(svc.engine, prom=svc.prom)
+        out.append(RulesService(
+            svc.engine, float(sc.get("rules-interval-s", 5)),
+            manager=svc.rules_manager, meta_store=svc.meta_store,
+            router=svc.router))
     out.append(CompactionService(
         svc.engine, float(sc.get("compact-interval-s", 600)),
         int(sc.get("compact-max-files", 4)),
@@ -621,6 +636,8 @@ def main(argv=None) -> int:
         svc.meta_store.stop()
     if getattr(svc.router, "datarep", None) is not None:
         svc.router.datarep.stop()
+    if getattr(svc, "rules_manager", None) is not None:
+        svc.rules_manager.close()  # final state fsync + hook detach
     svc.stop()
     svc.engine.close()
     if args.pidfile:

@@ -1308,6 +1308,84 @@ def _make_handler(svc: HttpService):
                 out["specs"] = mgr.status() if mgr is not None else {}
                 self._send_json(200, out)
                 return
+            elif mod == "rules":
+                # continuous rule engine ops (promql/rules.py):
+                #   (none)/status      per-group watermark/alerts/tiles
+                #   op=declare         declare a group (db, group,
+                #                      [interval_s, lateness_s]) and/or
+                #                      one rule (record=<name> |
+                #                      alert=<name>, expr, [for_s,
+                #                      labels, annotations] — JSON)
+                #   op=drop            drop a rule (db, group, name) or
+                #                      a whole group (db, group)
+                #   op=tick            evaluate due groups NOW
+                from opengemini_tpu.promql.rules import (
+                    Rule, RuleError, RuleManager, enabled_by_env)
+
+                op = params.get("op", "")
+                mgr = svc.engine.rules_hook
+                out = {"status": "ok", "enabled": enabled_by_env()}
+                try:
+                    if op == "declare":
+                        if mgr is None and enabled_by_env():
+                            # same lazy-construction idiom as rollups:
+                            # the manager exists once config does
+                            mgr = RuleManager(svc.engine)
+                            svc.rules_manager = mgr
+                        if mgr is None:
+                            self._send_json(
+                                400, {"error": "rules disabled (OGT_RULES=0)"})
+                            return
+                        interval_s = (float(params["interval_s"])
+                                      if "interval_s" in params else None)
+                        lateness_s = (float(params["lateness_s"])
+                                      if "lateness_s" in params else None)
+                        if "record" in params or "alert" in params:
+                            kind = ("recording" if "record" in params
+                                    else "alerting")
+                            name = params.get("record") or params["alert"]
+                            rule = Rule(
+                                name, params["expr"], kind,
+                                labels=json.loads(params["labels"])
+                                if params.get("labels") else None,
+                                for_s=float(params.get("for_s", 0.0)),
+                                annotations=json.loads(params["annotations"])
+                                if params.get("annotations") else None)
+                            mgr.add_rule(params["db"], params["group"],
+                                         rule, interval_s, lateness_s)
+                        else:
+                            mgr.declare_group(params["db"], params["group"],
+                                              interval_s, lateness_s)
+                    elif op == "drop":
+                        if mgr is None:
+                            self._send_json(
+                                400, {"error": "no rule manager"})
+                            return
+                        if params.get("name"):
+                            mgr.drop_rule(params["db"], params["group"],
+                                          params["name"])
+                        else:
+                            mgr.drop_group(params["db"], params["group"])
+                    elif op == "tick":
+                        if mgr is not None:
+                            out["ticked"] = mgr.tick(
+                                int(params["now_ns"]) if "now_ns" in params
+                                else None,
+                                db=params.get("db") or None)
+                    elif op and op != "status":
+                        self._send_json(
+                            400, {"error": f"unknown rules op {op!r}"})
+                        return
+                except KeyError as e:
+                    self._send_json(
+                        400, {"error": f"missing parameter {e.args[0]!r}"})
+                    return
+                except (RuleError, ValueError, WriteError) as e:
+                    self._send_json(400, {"error": str(e)})
+                    return
+                out["groups"] = mgr.status() if mgr is not None else {}
+                self._send_json(200, out)
+                return
             elif mod == "obs":
                 # observability runtime tuning: trace capture on/off,
                 # histogram arming, slow-query threshold + ring bound.
@@ -1587,6 +1665,16 @@ def _make_handler(svc: HttpService):
                 elif path.startswith("/api/v1/label/") and path.endswith("/values"):
                     name = path[len("/api/v1/label/") : -len("/values")]
                     data = self._prom_label_values(db, name)
+                elif path == "/api/v1/rules":
+                    # prometheus rules endpoint (promql/rules.py) —
+                    # empty groups, not 404, when no manager is live
+                    mgr = svc.engine.rules_hook
+                    data = mgr.rules_api() if mgr is not None \
+                        else {"groups": []}
+                elif path == "/api/v1/alerts":
+                    mgr = svc.engine.rules_hook
+                    data = mgr.alerts_api() if mgr is not None \
+                        else {"alerts": []}
                 else:
                     self._send_json(404, {"status": "error", "error": "not found"})
                     return

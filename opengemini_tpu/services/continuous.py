@@ -7,6 +7,24 @@ leases across sql nodes via meta; here the raft META LEADER is the lease
 (handle() runs CQs only on the leader when clustered — see the
 meta_store gate below; tested in
 test_cluster_data.py::test_cq_runs_only_on_leader).
+
+Where this sits among the THREE continuous-computation tiers (see the
+README "Rules & alerting" section for the full decision table):
+
+  * StreamService — ingest-time fold of accumulable InfluxQL aggregates
+    into in-memory windows; never re-reads storage, can't repair late
+    data past its DELAY.
+  * ContinuousQueryService (here) — scheduled SELECT ... INTO that
+    RE-READS storage for closed windows: arbitrary InfluxQL (joins,
+    non-accumulable aggregates), at O(window) re-scan cost per run.
+  * RuleManager (promql/rules.py) — continuous PromQL rules maintained
+    incrementally over dirty-marked tile partials with a durable
+    watermark: O(new tiles) per tick, late data re-dirties, answers
+    asserted bit-identical to a from-scratch evaluation.
+
+Durations/deadlines here use time.perf_counter* (OGT040); time.time_ns
+appears only as the data-time `now` that window-close decisions are
+made against, where wall-clock is the semantic.
 """
 
 from __future__ import annotations

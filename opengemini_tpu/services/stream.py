@@ -8,6 +8,24 @@ continuous query (which re-reads storage), a stream never re-scans —
 state lives in memory keyed by (window, group tags).
 
 Supported aggregates: accumulable ones — count/sum/min/max/mean.
+
+Where this sits among the THREE continuous-computation tiers (see the
+README "Rules & alerting" section for the full decision table):
+
+  * StreamService (here) — ingest-time fold, zero re-read, accumulable
+    InfluxQL aggregates only; in-memory window state, lost on restart
+    (late data beyond DELAY is dropped, not re-folded).
+  * ContinuousQueryService — scheduled SELECT ... INTO, re-reads
+    storage for each closed window; arbitrary InfluxQL but O(window)
+    per run and no late-data repair of already-written windows.
+  * RuleManager (promql/rules.py) — continuous PromQL recording/alert
+    rules over durably-watermarked incremental tile state: O(dirty
+    tiles) per tick, late data re-dirties and is re-folded, results
+    asserted bit-identical to a from-scratch evaluation.
+
+Durations/deadlines here use time.perf_counter* (OGT040); time.time_ns
+appears only for DATA timestamps (window assignment of arriving rows),
+where wall-clock is the semantic.
 """
 
 from __future__ import annotations

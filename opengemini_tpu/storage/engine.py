@@ -256,6 +256,10 @@ class Engine:
         # every write/query path bit-identical (one attribute check)
         self.rollup_mgr = None
         self._maybe_init_rollups()
+        # continuous rule engine (promql/rules.py): set by RuleManager
+        # when OGT_RULES enables it — None keeps every write path
+        # bit-identical (one attribute check, same contract as rollups)
+        self.rules_hook = None
         # live acked-vs-durable gauges ride /debug/vars (utils/stats
         # provider; close() unregisters so dead engines drop out)
         self._durability_provider = self._durability_gauges
@@ -403,6 +407,13 @@ class Engine:
                 self.rollup_mgr.drop_db_state(name)
             else:
                 shutil.rmtree(os.path.join(self.root, "rollup", name),
+                              ignore_errors=True)
+            if self.rules_hook is not None:
+                # same stale-state hazard for rule groups: a recreated
+                # db must not inherit watermarks/alert state
+                self.rules_hook.drop_db_state(name)
+            else:
+                shutil.rmtree(os.path.join(self.root, "rules", name),
                               ignore_errors=True)
         self._delete_obs_prefixes(obs_purge)
 
@@ -1145,6 +1156,9 @@ class Engine:
                 # the rows are (storage/rollup.py watermark contract);
                 # write_done releases the in-flight fold floor
                 rtok = self.rollup_mgr.note_write_columnar(db, rp, batch)
+            utok = None
+            if self.rules_hook is not None:
+                utok = self.rules_hook.note_write_columnar(db, rp, batch)
             try:
                 tickets: list = []
                 touched: list = []
@@ -1160,6 +1174,8 @@ class Engine:
             finally:
                 if rtok is not None:
                     self.rollup_mgr.write_done(rtok)
+                if utok is not None:
+                    self.rules_hook.write_done(utok)
 
         points = lp.parse_lines(lines, precision, now_ns,
                                 expand_tag_arrays=self.tag_arrays)
@@ -1169,6 +1185,9 @@ class Engine:
         rtok = None
         if self.rollup_mgr is not None:
             rtok = self.rollup_mgr.note_write_points(db, rp, points)
+        utok = None
+        if self.rules_hook is not None:
+            utok = self.rules_hook.note_write_points(db, rp, points)
         try:
             tickets: list = []
             with self._lock:
@@ -1193,6 +1212,8 @@ class Engine:
         finally:
             if rtok is not None:
                 self.rollup_mgr.write_done(rtok)
+            if utok is not None:
+                self.rules_hook.write_done(utok)
 
     def _write_segmented(self, db: str, rp: str, raw: bytes,
                          precision: str, now_ns: int):
@@ -1249,6 +1270,7 @@ class Engine:
                     raise FieldTypeConflict(name, have, ftype)
         total = 0
         rtoks = []
+        utoks = []
         try:
             if self.rollup_mgr is not None:
                 # inside the try: a note hook failing for batch k must
@@ -1260,6 +1282,13 @@ class Engine:
                             db, rp, batch)
                         if t is not None:
                             rtoks.append(t)
+            if self.rules_hook is not None:
+                for batch in parsed:
+                    if len(batch):
+                        t = self.rules_hook.note_write_columnar(
+                            db, rp, batch)
+                        if t is not None:
+                            utoks.append(t)
             with self._lock:
                 # ONE lock acquisition for the whole body, with every
                 # segment pre-validated against the LIVE shard schemas
@@ -1301,6 +1330,8 @@ class Engine:
         finally:
             for t in rtoks:
                 self.rollup_mgr.write_done(t)
+            for t in utoks:
+                self.rules_hook.write_done(t)
 
     def _route_columnar_locked(self, db: str, rp: str, batch):
         """Yield (shard, rows) for a ColumnarBatch — ONE routing
@@ -1634,6 +1665,9 @@ class Engine:
         rtok = None
         if self.rollup_mgr is not None:
             rtok = self.rollup_mgr.note_write_points(db, rp, points)
+        utok = None
+        if self.rules_hook is not None:
+            utok = self.rules_hook.note_write_points(db, rp, points)
         try:
             tickets: list = []
             with self._lock:
@@ -1657,6 +1691,8 @@ class Engine:
         finally:
             if rtok is not None:
                 self.rollup_mgr.write_done(rtok)
+            if utok is not None:
+                self.rules_hook.write_done(utok)
 
     def flush_all(self) -> None:
         # snapshot under the lock, flush OUTSIDE it: shard.flush encodes

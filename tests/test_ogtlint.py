@@ -449,6 +449,52 @@ def test_ogt050_label_index_metric_family(tmp_path):
         "index.Regex_LUT_hits_total", "index.gather-mesh_total"]
 
 
+def test_ogt010_rules_knob_family(tmp_path):
+    """The ISSUE 20 knobs: the continuous rule engine's OGT_RULES*
+    reads are OGT010 subjects — the documented family passes, an
+    undocumented sibling is a finding."""
+    root = _tree(tmp_path, {
+        "README.md": ("Rules knobs: `OGT_RULES`, `OGT_RULES_INTERVAL_S`, "
+                      "`OGT_RULES_LATENESS_S`, `OGT_RULES_VERIFY`, "
+                      "`OGT_RULES_MAX_TILES`.\n"),
+        "opengemini_tpu/promql/rules_mod.py": (
+            "import os\n"
+            "a = os.environ.get('OGT_RULES', '1')\n"               # ok
+            "b = os.environ.get('OGT_RULES_INTERVAL_S', '15')\n"   # ok
+            "c = os.environ.get('OGT_RULES_LATENESS_S', '0')\n"    # ok
+            "d = os.environ.get('OGT_RULES_VERIFY', '0')\n"        # ok
+            "e = os.environ.get('OGT_RULES_MAX_TILES', '4096')\n"  # ok
+            "f = os.environ.get('OGT_RULES_SHARDS', '')\n"         # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT010")
+    assert [f.detail for f in found] == ["OGT_RULES_SHARDS"]
+
+
+def test_ogt050_rules_metric_family(tmp_path):
+    """The ogt_rules_* family (ISSUE 20): tick/fold/verify/alert
+    counters obey the metric grammar as keys of the `rules` module; a
+    dashed stage or a capitalized name is a finding."""
+    root = _tree(tmp_path, {
+        "opengemini_tpu/mod.py": (
+            "GLOBAL.incr('rules', 'ticks_total')\n"             # ok
+            "GLOBAL.incr('rules', 'tiles_folded_total', 4)\n"   # ok
+            "GLOBAL.incr('rules', 'series_written_total', 2)\n"  # ok
+            "GLOBAL.incr('rules', 'alerts_fired_total')\n"      # ok
+            "GLOBAL.incr('rules', 'alerts_resolved_total')\n"   # ok
+            "GLOBAL.incr('rules', 'verify_ticks_total')\n"      # ok
+            "GLOBAL.incr('rules', 'verify_failures_total')\n"   # ok
+            "GLOBAL.incr('rules', 'fallback_evals_total')\n"    # ok
+            "GLOBAL.incr('rules', 'dirty_marks_total')\n"       # ok
+            "GLOBAL.incr('rules', 'tick-sheds_total')\n"        # finding
+            "GLOBAL.incr('rules', 'Verify_skips_total')\n"      # finding
+        ),
+    })
+    found = _by_rule(ogtlint.collect_findings(root), "OGT050")
+    assert sorted(f.detail for f in found) == [
+        "rules.Verify_skips_total", "rules.tick-sheds_total"]
+
+
 # -- baseline + output formats ------------------------------------------------
 
 

@@ -1,0 +1,555 @@
+"""Continuous rule engine (promql/rules.py + services/rules.py):
+incremental-vs-rescan bit-identity under ragged/late/resetting traffic,
+the `for`-duration alert state machine with restart persistence and the
+mark-before-eval crash edge (no double-fire), leader-only ticking when
+clustered, per-tenant charging, the ctrl + /api/v1/rules + /api/v1/alerts
+surfaces, and OGT_RULES=0 inertness."""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opengemini_tpu.promql.engine import PromEngine
+from opengemini_tpu.promql.rules import (Rule, RuleError, RuleManager,
+                                         compile_expr)
+from opengemini_tpu.storage.engine import Engine, NS
+from opengemini_tpu.utils import failpoint
+from opengemini_tpu.utils.failpoint import FailpointError
+from opengemini_tpu.utils.stats import GLOBAL as STATS
+
+BASE = 1_700_000_040  # minute-aligned
+
+
+@pytest.fixture
+def env(tmp_path, monkeypatch):
+    # every tick in this file runs the from-scratch verify leg: the
+    # bit-identity contract is asserted inside the subsystem itself
+    monkeypatch.setenv("OGT_RULES_VERIFY", "1")
+    e = Engine(str(tmp_path / "data"))
+    e.create_database("db")
+    yield e
+    failpoint.disable_all()
+    e.close()
+
+
+def _mgr(e):
+    return RuleManager(e)
+
+
+def _abandon(mgr):
+    """Simulate a crash: drop the manager WITHOUT the close-time state
+    save (durable state stays whatever the last mid-tick fsync left)."""
+    STATS.unregister_provider("rules", mgr._stats_provider)
+    if getattr(mgr.engine, "rules_hook", None) is mgr:
+        mgr.engine.rules_hook = None
+    mgr._closed = True
+
+
+def write_counter(e, rng, n=200, hosts=3, base=BASE, jitter=True,
+                  mst="http_requests_total"):
+    """Ragged counter series with resets: per-series irregular steps,
+    occasional counter resets, float values."""
+    lines = []
+    for h in range(hosts):
+        t = float(base)
+        v = rng.random() * 5
+        for _ in range(n):
+            t += float(rng.integers(1, 5)) if jitter else 2.0
+            v += float(rng.random() * 10)
+            if rng.random() < 0.03:
+                v = float(rng.random())  # counter reset
+            lines.append(f"{mst},job=api,host=h{h} value={v} "
+                         f"{int(t * NS)}")
+    e.write_lines("db", "\n".join(lines))
+
+
+class TestCompile:
+    @pytest.mark.parametrize("expr,func,agg,cmp_op", [
+        ("rate(m[5m])", "rate", None, None),
+        ("increase(m{a=\"b\"}[60s])", "increase", None, None),
+        ("sum by (job) (rate(m[1m]))", "rate", "sum", None),
+        ("avg_over_time(m[2m]) > 5", "avg", None, ">"),
+        ("max by (host) (delta(m[30s])) <= 0", "delta", "max", "<="),
+        ("changes(m[1m])", "changes", None, None),
+    ])
+    def test_tiled_shapes(self, expr, func, agg, cmp_op):
+        c = compile_expr(expr)
+        assert c.tiled and c.func == func
+        assert c.agg_op == agg and c.cmp_op == cmp_op
+
+    @pytest.mark.parametrize("expr", [
+        "histogram_quantile(0.9, rate(m[5m]))",  # unsupported function
+        "rate(m[5m] offset 1m)",                 # offset
+        "topk(3, rate(m[5m]))",                  # param aggregation
+        "rate(m[5m]) / rate(n[5m])",             # vector/vector binop
+        "m",                                     # bare instant vector
+    ])
+    def test_fallback_shapes(self, expr):
+        assert not compile_expr(expr).tiled
+
+    def test_bad_rules_rejected(self):
+        with pytest.raises(Exception):
+            Rule("r", "rate(m[5m")  # parse error surfaces at declare
+        with pytest.raises(RuleError):
+            Rule("bad name!", "rate(m[5m])")
+        with pytest.raises(RuleError):
+            Rule("r", "m", kind="nonsense")
+
+
+class TestBitIdentity:
+    """The subsystem asserts incremental == from-scratch on every tick
+    (OGT_RULES_VERIFY, armed by the env fixture): these tests drive
+    ragged series, counter resets, late data and lattice-odd windows
+    through enough ticks that a maintenance bug would trip the verify
+    RuntimeError; the counters prove the verify leg actually ran."""
+
+    EXPRS = [
+        "rate(http_requests_total[60s])",
+        "increase(http_requests_total[45s])",
+        "sum by (job) (rate(http_requests_total[90s]))",
+        "avg_over_time(http_requests_total[60s])",
+        "max_over_time(http_requests_total[30s])",
+        "stddev_over_time(http_requests_total[60s])",
+        "resets(http_requests_total[90s])",
+        "changes(http_requests_total[60s])",
+        "count_over_time(http_requests_total[45s])",
+    ]
+
+    def test_incremental_matches_rescan_over_rounds(self, env):
+        e = env
+        rng = np.random.default_rng(11)
+        mgr = _mgr(e)
+        try:
+            for i, expr in enumerate(self.EXPRS):
+                mgr.add_rule("db", "g", Rule(f"rec_{i}", expr),
+                             interval_s=15)
+            base0 = STATS.counters("rules").get("verify_ticks", 0)
+            now = BASE
+            for _round in range(6):
+                write_counter(e, rng, n=60, base=now)
+                now += 120
+                assert mgr.tick(int(now * NS)) == 1
+                # LATE data into tiles already folded, then re-tick:
+                # the re-dirty path must restore identity
+                write_counter(e, rng, n=20, base=now - 300)
+                now += 15
+                assert mgr.tick(int(now * NS)) == 1
+            c = STATS.counters("rules")
+            assert c.get("verify_ticks", 0) - base0 == 12
+            assert c.get("verify_failures", 0) == 0
+            assert c.get("tiles_folded", 0) > 0
+        finally:
+            mgr.close()
+
+    def test_late_data_redirties(self, env):
+        e = env
+        rng = np.random.default_rng(3)
+        mgr = _mgr(e)
+        try:
+            mgr.add_rule("db", "g",
+                         Rule("r", "rate(http_requests_total[60s])"),
+                         interval_s=15)
+            write_counter(e, rng, n=100)
+            now = BASE + 260
+            mgr.tick(int(now * NS))
+            folded0 = STATS.counters("rules")["tiles_folded"]
+            # in-window late write dirties covered tiles
+            e.write_lines("db", "http_requests_total,job=api,host=h0 "
+                                f"value=7 {int((now - 30) * NS)}")
+            g = mgr.groups_for("db")[0]
+            assert sum(len(s.dirty) for s in g._sels.values()) > 0
+            mgr.tick(int((now + 15) * NS))
+            assert STATS.counters("rules")["tiles_folded"] > folded0
+        finally:
+            mgr.close()
+
+    def test_recorded_series_match_on_demand(self, env):
+        """Recording output, read back through the normal query path,
+        agrees with evaluating the expression on demand at the same
+        timestamp (the loadgen consistency oracle)."""
+        e = env
+        rng = np.random.default_rng(5)
+        mgr = _mgr(e)
+        try:
+            mgr.add_rule(
+                "db", "g",
+                Rule("job:http:rate1m",
+                     "sum by (job) (rate(http_requests_total[60s]))"),
+                interval_s=15)
+            write_counter(e, rng, n=120)
+            now = BASE + 250
+            mgr.tick(int(now * NS))
+            te = mgr.eval_time(mgr.groups_for("db")[0], int(now * NS))
+            pe = PromEngine(e)
+            rec = pe.query_instant("job:http:rate1m", te / 1e9, "db")
+            ond = pe.query_instant(
+                "sum by (job) (rate(http_requests_total[60s]))",
+                te / 1e9, "db")
+            assert rec["result"] and ond["result"]
+            got = float(rec["result"][0]["value"][1])
+            want = float(ond["result"][0]["value"][1])
+            assert got == pytest.approx(want, rel=1e-6)
+        finally:
+            mgr.close()
+
+    def test_fallback_rules_still_evaluate(self, env):
+        e = env
+        rng = np.random.default_rng(9)
+        mgr = _mgr(e)
+        try:
+            mgr.add_rule(
+                "db", "g",
+                Rule("r", "topk(2, rate(http_requests_total[60s]))"),
+                interval_s=15)
+            assert not mgr.groups_for("db")[0].rules[0].compiled.tiled
+            write_counter(e, rng, n=80)
+            base0 = STATS.counters("rules").get("fallback_evals", 0)
+            mgr.tick(int((BASE + 200) * NS))
+            assert STATS.counters("rules")["fallback_evals"] > base0
+            pe = PromEngine(e)
+            got = pe.query_instant("r", BASE + 200, "db")
+            assert got["result"]
+        finally:
+            mgr.close()
+
+
+class TestAlerts:
+    def _alerting_mgr(self, e, for_s=30.0):
+        mgr = _mgr(e)
+        mgr.add_rule(
+            "db", "g",
+            Rule("high", "sum by (job) "
+                 "(rate(http_requests_total[60s])) > 0.01",
+                 kind="alerting", for_s=for_s,
+                 labels={"severity": "page"}),
+            interval_s=15)
+        return mgr
+
+    def test_pending_firing_resolved(self, env):
+        e = env
+        rng = np.random.default_rng(21)
+        mgr = self._alerting_mgr(e)
+        try:
+            write_counter(e, rng, n=100)
+            now = BASE + 230
+            mgr.tick(int(now * NS))
+            st = mgr.status()["db.g"]
+            assert st["alerts_pending"] == 1 and st["alerts_firing"] == 0
+            mgr.tick(int((now + 15) * NS))  # 15s < for=30s: still pending
+            assert mgr.status()["db.g"]["alerts_pending"] == 1
+            mgr.tick(int((now + 30) * NS))  # for-duration met
+            st = mgr.status()["db.g"]
+            assert st["alerts_firing"] == 1
+            assert st["fires"] == {"high": 1}
+            al = mgr.alerts_api()["alerts"]
+            assert al[0]["state"] == "firing"
+            assert al[0]["labels"]["alertname"] == "high"
+            assert al[0]["labels"]["severity"] == "page"
+            # traffic stops: the window empties -> resolved
+            mgr.tick(int((now + 400) * NS))
+            st = mgr.status()["db.g"]
+            assert st["alerts_firing"] == 0
+            assert st["resolves"] == {"high": 1}
+            assert mgr.alerts_api()["alerts"] == []
+        finally:
+            mgr.close()
+
+    def test_state_survives_restart(self, env):
+        e = env
+        rng = np.random.default_rng(22)
+        mgr = self._alerting_mgr(e)
+        write_counter(e, rng, n=100)
+        now = BASE + 230
+        mgr.tick(int(now * NS))
+        assert mgr.status()["db.g"]["alerts_pending"] == 1
+        mgr.close()  # clean shutdown persists pending + watermark
+        mgr2 = RuleManager(e)
+        try:
+            st = mgr2.status()["db.g"]
+            assert st["alerts_pending"] == 1  # pending survived
+            mgr2.tick(int((now + 30) * NS))
+            st = mgr2.status()["db.g"]
+            # active_since persisted: for-duration spans the restart
+            assert st["alerts_firing"] == 1 and st["fires"] == {"high": 1}
+        finally:
+            mgr2.close()
+
+    def test_crash_at_mark_edge_never_double_fires(self, env):
+        """Kill the tick at the durable-claim edge, restart, re-tick the
+        SAME eval time: exactly one fire is recorded and the firing
+        state is intact (the satellite-2 crash contract)."""
+        e = env
+        rng = np.random.default_rng(23)
+        mgr = self._alerting_mgr(e, for_s=0.0)  # fires on first breach
+        write_counter(e, rng, n=100)
+        now = BASE + 230
+        failpoint.enable("rules-mark-before-eval", "error")
+        with pytest.raises(FailpointError):
+            mgr.tick(int(now * NS))
+        failpoint.disable_all()
+        # the claim is durable, the watermark is not advanced, and no
+        # alert transition leaked to disk
+        _abandon(mgr)  # crash: no close-time save
+        mgr2 = RuleManager(e)
+        try:
+            g = mgr2.groups_for("db")[0]
+            assert g.claimed_ns is not None and g.last_eval_ns is None
+            assert mgr2.status()["db.g"]["fires"] == {}
+            mgr2.tick(int(now * NS))  # the re-run of the claimed tick
+            st = mgr2.status()["db.g"]
+            assert st["alerts_firing"] == 1 and st["fires"] == {"high": 1}
+            # a second restart + re-tick of the same te is a no-op: the
+            # watermark advanced in the final save
+            mgr2.close()
+            mgr3 = RuleManager(e)
+            mgr3.tick(int(now * NS))
+            st = mgr3.status()["db.g"]
+            assert st["fires"] == {"high": 1}  # still exactly one
+            mgr3.close()
+        finally:
+            failpoint.disable_all()
+
+    def test_firing_state_survives_kill_after_fire(self, env):
+        """Crash AFTER a tick fired: restart must not un-fire (the state
+        landed in the same fsync as the watermark)."""
+        e = env
+        rng = np.random.default_rng(24)
+        mgr = self._alerting_mgr(e, for_s=0.0)
+        write_counter(e, rng, n=100)
+        now = BASE + 230
+        mgr.tick(int(now * NS))
+        assert mgr.status()["db.g"]["fires"] == {"high": 1}
+        _abandon(mgr)  # crash with no clean shutdown
+        mgr2 = RuleManager(e)
+        try:
+            st = mgr2.status()["db.g"]
+            assert st["alerts_firing"] == 1 and st["fires"] == {"high": 1}
+        finally:
+            mgr2.close()
+
+
+class TestServiceAndCluster:
+    class _Meta:
+        def __init__(self, leader):
+            self._leader = leader
+
+        def is_leader(self):
+            return self._leader
+
+    def test_leader_only_when_clustered(self, env):
+        from opengemini_tpu.services.rules import RulesService
+
+        e = env
+        rng = np.random.default_rng(31)
+        mgr = _mgr(e)
+        try:
+            mgr.add_rule("db", "g",
+                         Rule("r", "rate(http_requests_total[60s])"),
+                         interval_s=15)
+            write_counter(e, rng, n=60)
+            router = object()  # data routing on
+            follower = RulesService(e, manager=mgr,
+                                    meta_store=self._Meta(False),
+                                    router=router)
+            assert follower.handle(int((BASE + 200) * NS)) == 0
+            leader = RulesService(e, manager=mgr,
+                                  meta_store=self._Meta(True),
+                                  router=router)
+            assert leader.handle(int((BASE + 200) * NS)) == 1
+            # unclustered (no router): every node ticks
+            solo = RulesService(e, manager=mgr,
+                                meta_store=self._Meta(False), router=None)
+            assert solo.handle(int((BASE + 230) * NS)) == 1
+        finally:
+            mgr.close()
+
+    def test_tenant_charging(self, env):
+        from opengemini_tpu.services.rules import RulesService
+        from opengemini_tpu.utils.governor import GOVERNOR
+
+        e = env
+        rng = np.random.default_rng(32)
+        mgr = _mgr(e)
+        GOVERNOR.configure(budget_mb=64)
+        GOVERNOR.reset()  # drop accounts charged by earlier tests
+        try:
+            mgr.add_rule("db", "g",
+                         Rule("r", "rate(http_requests_total[60s])"),
+                         interval_s=15)
+            write_counter(e, rng, n=60)
+            svc = RulesService(e, manager=mgr)
+            assert svc.handle(int((BASE + 200) * NS)) == 1
+            acct = GOVERNOR.tenant_accounts()["db"]
+            assert acct["rules_groups"] == 1
+            assert "rules_ms" in acct
+        finally:
+            GOVERNOR.configure(budget_mb=0)
+            GOVERNOR.reset()
+            mgr.close()
+
+    def test_service_inert_without_manager(self, env):
+        from opengemini_tpu.services.rules import RulesService
+
+        assert RulesService(env).handle() == 0
+
+
+class TestSurfaces:
+    @pytest.fixture
+    def server(self, tmp_path, monkeypatch):
+        from opengemini_tpu.server.http import HttpService
+
+        monkeypatch.setenv("OGT_RULES_VERIFY", "1")
+        engine = Engine(str(tmp_path / "data"))
+        engine.create_database("db")
+        svc = HttpService(engine, "127.0.0.1", 0)
+        svc.start()
+        yield svc, engine
+        if getattr(svc, "rules_manager", None) is not None:
+            svc.rules_manager.close()
+        svc.stop()
+        engine.close()
+
+    @staticmethod
+    def _post(svc, path, **params):
+        url = (f"http://127.0.0.1:{svc.port}{path}?"
+               + urllib.parse.urlencode(params))
+        req = urllib.request.Request(url, data=b"", method="POST")
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read() or b"{}")
+
+    @staticmethod
+    def _get(svc, path, **params):
+        url = f"http://127.0.0.1:{svc.port}{path}"
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        try:
+            with urllib.request.urlopen(url) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read() or b"{}")
+
+    def test_ctrl_declare_tick_status_drop(self, server):
+        svc, engine = server
+        rng = np.random.default_rng(41)
+        write_counter(engine, rng, n=80)
+        code, out = self._post(
+            svc, "/debug/ctrl", mod="rules", op="declare", db="db",
+            group="g", interval_s="15",
+            record="job:rate", expr="rate(http_requests_total[60s])")
+        assert code == 200 and out["enabled"]
+        code, out = self._post(
+            svc, "/debug/ctrl", mod="rules", op="declare", db="db",
+            group="g", alert="hot",
+            expr="sum by (job) (rate(http_requests_total[60s])) > 0.01",
+            for_s="0", labels=json.dumps({"severity": "page"}))
+        assert code == 200
+        assert {r["name"] for r in out["groups"]["db.g"]["rules"]} == \
+            {"job:rate", "hot"}
+        code, out = self._post(
+            svc, "/debug/ctrl", mod="rules", op="tick", db="db",
+            now_ns=str((BASE + 230) * NS))
+        assert code == 200 and out["ticked"] == 1
+        st = out["groups"]["db.g"]
+        assert st["last_eval_ns"] is not None
+        assert st["alerts_firing"] == 1
+        # prometheus-shaped API surfaces
+        code, out = self._get(svc, "/api/v1/rules")
+        assert code == 200 and out["status"] == "success"
+        grp = out["data"]["groups"][0]
+        assert grp["name"] == "g" and grp["file"] == "db"
+        kinds = {r["name"]: r["type"] for r in grp["rules"]}
+        assert kinds == {"job:rate": "recording", "hot": "alerting"}
+        alert_rule = next(r for r in grp["rules"] if r["name"] == "hot")
+        assert alert_rule["state"] == "firing"
+        code, out = self._get(svc, "/api/v1/alerts")
+        assert code == 200
+        assert out["data"]["alerts"][0]["labels"]["alertname"] == "hot"
+        # drop one rule, then the group
+        code, out = self._post(svc, "/debug/ctrl", mod="rules",
+                               op="drop", db="db", group="g", name="hot")
+        assert code == 200
+        assert [r["name"] for r in out["groups"]["db.g"]["rules"]] == \
+            ["job:rate"]
+        code, out = self._post(svc, "/debug/ctrl", mod="rules",
+                               op="drop", db="db", group="g")
+        assert code == 200 and out["groups"] == {}
+
+    def test_ctrl_errors(self, server):
+        svc, _engine = server
+        code, out = self._post(svc, "/debug/ctrl", mod="rules",
+                               op="declare", db="db", group="g",
+                               record="r", expr="rate(m[5m")
+        assert code == 400 and "error" in out
+        code, out = self._post(svc, "/debug/ctrl", mod="rules",
+                               op="declare", db="nope", group="g")
+        assert code == 400
+        code, out = self._post(svc, "/debug/ctrl", mod="rules",
+                               op="frobnicate")
+        assert code == 400
+
+    def test_disabled_inertness(self, tmp_path, monkeypatch):
+        from opengemini_tpu.promql.rules import enabled_by_env
+        from opengemini_tpu.server.http import HttpService
+
+        monkeypatch.setenv("OGT_RULES", "0")
+        assert not enabled_by_env()
+        engine = Engine(str(tmp_path / "data"))
+        engine.create_database("db")
+        svc = HttpService(engine, "127.0.0.1", 0)
+        svc.start()
+        try:
+            assert engine.rules_hook is None
+            code, out = self._post(svc, "/debug/ctrl", mod="rules",
+                                   op="declare", db="db", group="g")
+            assert code == 400 and "disabled" in out["error"]
+            code, out = self._post(svc, "/debug/ctrl", mod="rules")
+            assert code == 200 and out["groups"] == {}
+            code, out = self._get(svc, "/api/v1/rules")
+            assert code == 200 and out["data"] == {"groups": []}
+            code, out = self._get(svc, "/api/v1/alerts")
+            assert code == 200 and out["data"] == {"alerts": []}
+            # writes run with the hook None: the path stays pass-through
+            engine.write_lines(
+                "db", f"m,host=a value=1 {BASE * NS}")
+            assert engine.rules_hook is None
+        finally:
+            svc.stop()
+            engine.close()
+
+
+class TestConfigPersistence:
+    def test_groups_reload_after_restart(self, env):
+        e = env
+        mgr = _mgr(e)
+        mgr.add_rule("db", "g",
+                     Rule("r", "rate(http_requests_total[60s])"),
+                     interval_s=7, lateness_s=2)
+        mgr.close()
+        mgr2 = RuleManager(e)
+        try:
+            g = mgr2.groups_for("db")[0]
+            assert g.name == "g" and g.interval_s == 7
+            assert g.lateness_s == 2
+            assert [r.name for r in g.rules] == ["r"]
+            assert g.rules[0].compiled.tiled
+        finally:
+            mgr2.close()
+
+    def test_drop_database_clears_state(self, env):
+        e = env
+        mgr = _mgr(e)
+        try:
+            mgr.add_rule("db", "g",
+                         Rule("r", "rate(http_requests_total[60s])"))
+            e.drop_database("db")
+            assert mgr.groups_for("db") == []
+            e.create_database("db")
+            assert mgr.groups_for("db") == []  # no inherited watermarks
+        finally:
+            mgr.close()

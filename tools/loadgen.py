@@ -1040,6 +1040,300 @@ def run_cardinality_churn(host: str, port: int, clients: int = 6,
     }
 
 
+def run_rule_fleet(host: str, port: int, clients: int = 6,
+                   duration_s: float = 10.0, rules: int = 200,
+                   series: int = 60, interval_s: float = 1.0,
+                   warmup_s: float = 3.0,
+                   write_interval_s: float = 0.25,
+                   timeout_s: float = 30.0) -> dict:
+    """Rule-fleet scenario (the continuous rule engine soak): a fleet of
+    recording + threshold-alert rules (promql/rules.py) ticks over LIVE
+    counter ingest while dashboard readers query the recorded series
+    through /api/v1/query.  A ticker thread forces group evaluations via
+    /debug/ctrl?mod=rules&op=tick and samples each tick's server-side
+    duration (status last_tick_ms).  The scenario asserts the per-tick
+    p99 stays FLAT first half vs second half of the run
+    (`tick_flat_ok`): incremental tile maintenance makes a tick cost
+    O(newly dirtied tiles), not O(window) — without it the tick would
+    grow with accumulated data.  It also re-evaluates a sample of rule
+    expressions on demand at the group's last watermark and checks the
+    recorded series agree (`recorded_consistent`).  Run the server with
+    OGT_RULES_VERIFY=1 to additionally assert every tick bit-identical
+    to a from-scratch evaluation (verify counters land in /metrics)."""
+    import random
+    from urllib.parse import quote
+
+    db = "rulefleetdb"
+    mst = "rf_requests"
+    windows_s = (30, 60, 120)
+    n_writers = max(1, (clients + 1) // 2)
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+
+    def ctrl(op_params: str) -> dict:
+        conn.request("POST", "/debug/ctrl?mod=rules&" + op_params)
+        resp = conn.getresponse()
+        body = resp.read()
+        doc = json.loads(body) if body else {}
+        if resp.status != 200:
+            raise RuntimeError(
+                f"rules ctrl failed ({resp.status}): "
+                f"{doc.get('error', body[:120])}")
+        return doc
+
+    conn.request("POST", "/query?q=" + quote(f'CREATE DATABASE "{db}"'))
+    conn.getresponse().read()
+
+    # seed: a max-window's worth of monotonic counter history per series
+    # (1 sample/s), so the first tick's rate() windows are fully covered
+    # before the clock starts
+    seed_s = max(windows_s) + 30
+    now = time.time_ns()
+    for lo in range(0, seed_s, 30):
+        body = "".join(
+            f"{mst},job=api,host=h{k} value={t * 3 + k} "
+            f"{now - (seed_s - t) * 1_000_000_000}\n"
+            for t in range(lo, min(lo + 30, seed_s))
+            for k in range(series)).encode()
+        conn.request("POST", f"/write?db={db}", body=body)
+        resp = conn.getresponse()
+        resp.read()
+        if resp.status != 204:
+            raise RuntimeError(f"seed write failed ({resp.status})")
+
+    # declare the fleet: one group, alternating recording rules (the
+    # dashboard-readable output) and threshold alerts over a mix of
+    # rate() windows
+    doc = ctrl(f"op=declare&db={db}&group=fleet"
+               f"&interval_s={interval_s}")
+    if not doc.get("enabled", False):
+        raise RuntimeError("rules engine disabled on server (OGT_RULES=0)")
+    recordings: list[tuple[str, str]] = []
+    for i in range(rules):
+        w = windows_s[i % len(windows_s)]
+        expr = f"sum by (job) (rate({mst}[{w}s]))"
+        if i % 2 == 0:
+            name = f"rf_rate_w{w}_{i}"
+            ctrl(f"op=declare&db={db}&group=fleet&record={name}"
+                 f"&expr={quote(expr)}")
+            recordings.append((name, expr))
+        else:
+            ctrl(f"op=declare&db={db}&group=fleet&alert=RfHot{i}"
+                 f"&expr={quote(expr + ' > ' + str(i * 0.05))}")
+    # warm: first tick pays recording-measurement creation and the
+    # fold/merge paths; two unrecorded reads per queried shape land any
+    # first-execution compiles before the clock starts
+    ctrl("op=tick")
+    for name, _ in recordings[:4] * 2:
+        conn.request("GET", f"/api/v1/query?db={db}&query={quote(name)}")
+        conn.getresponse().read()
+    conn.close()
+
+    states = [_ClientState(i) for i in range(clients)]
+    for st in states:
+        st.seq = seed_s * 3 + 1000  # counters continue past the seed
+    q_events: list[list[tuple]] = [[] for _ in range(clients)]
+    tick_events: list[tuple] = []  # (t_rel, server-side tick seconds)
+    t_start = time.monotonic()
+    stop_at = t_start + warmup_s + duration_s
+
+    def ticker() -> None:
+        tconn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        try:
+            while time.monotonic() < stop_at:
+                t0 = time.monotonic()
+                try:
+                    tconn.request("POST", "/debug/ctrl?mod=rules&op=tick")
+                    resp = tconn.getresponse()
+                    doc = json.loads(resp.read())
+                    g = doc.get("groups", {}).get(f"{db}.fleet")
+                    if doc.get("ticked", 0) >= 1 and g is not None:
+                        tick_events.append(
+                            (t0 - t_start, g["last_tick_ms"] / 1e3))
+                except (OSError, http.client.HTTPException, ValueError):
+                    try:
+                        tconn.close()
+                    except OSError:
+                        pass
+                    tconn = http.client.HTTPConnection(
+                        host, port, timeout=timeout_s)
+                time.sleep(interval_s)
+        finally:
+            try:
+                tconn.close()
+            except OSError:
+                pass
+
+    def worker(st: _ClientState) -> None:
+        rng = random.Random(3000 + st.idx)
+        is_writer = st.idx % 2 == 0
+        wrank = st.idx // 2
+        hosts = [k for k in range(series) if k % n_writers == wrank]
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        try:
+            while time.monotonic() < stop_at:
+                t0 = time.monotonic()
+                try:
+                    if is_writer:
+                        base = time.time_ns()
+                        body = "".join(
+                            f"{mst},job=api,host=h{k} "
+                            f"value={st.seq + k} {base - k}\n"
+                            for k in hosts).encode()
+                        conn.request("POST", f"/write?db={db}", body=body)
+                        resp = conn.getresponse()
+                        resp.read()
+                        dt = time.monotonic() - t0
+                        if resp.status == 204:
+                            st.seq += 7  # monotonic per-host counters
+                            st.write_lat.append(dt)
+                        elif resp.status in (429, 503):
+                            st.sheds_429 += resp.status == 429
+                            st.sheds_503 += resp.status == 503
+                        else:
+                            st.note_error(f"write status {resp.status}")
+                        time.sleep(write_interval_s)
+                    else:
+                        # dashboard reader: recorded series are normal
+                        # queryable series — cheap instant lookups, plus
+                        # the occasional alerts poll
+                        if rng.random() < 0.125:
+                            path = f"/api/v1/alerts?db={db}"
+                        else:
+                            name, _ = rng.choice(recordings)
+                            path = (f"/api/v1/query?db={db}"
+                                    f"&query={quote(name)}")
+                        conn.request("GET", path)
+                        resp = conn.getresponse()
+                        data = resp.read()
+                        dt = time.monotonic() - t0
+                        if resp.status == 200:
+                            doc = json.loads(data)
+                            if doc.get("status", "success") != "success":
+                                st.note_error(
+                                    "query error: "
+                                    + str(doc.get("error"))[:120])
+                            else:
+                                st.query_lat.append(dt)
+                                q_events[st.idx].append((t0 - t_start, dt))
+                        elif resp.status in (429, 503):
+                            st.sheds_429 += resp.status == 429
+                            st.sheds_503 += resp.status == 503
+                        else:
+                            st.note_error(f"query status {resp.status}")
+                except (OSError, http.client.HTTPException,
+                        ValueError) as e:
+                    st.note_error(f"transport: {type(e).__name__}: {e}")
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    conn = http.client.HTTPConnection(
+                        host, port, timeout=timeout_s)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(st,), daemon=True,
+                                name=f"rulefleet-{st.idx}")
+               for st in states]
+    threads.append(threading.Thread(target=ticker, daemon=True,
+                                    name="rulefleet-ticker"))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=warmup_s + duration_s + 4 * timeout_s)
+    wall_s = time.monotonic() - t_start
+
+    # quiescent closing tick, then recorded-vs-on-demand consistency at
+    # the group's watermark: the recorded sample at te must agree with
+    # re-evaluating the rule expression over raw samples at te
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    time.sleep(interval_s + 0.05)
+    doc = ctrl("op=tick")
+    g = doc.get("groups", {}).get(f"{db}.fleet", {})
+    te_ns = g.get("last_eval_ns")
+    checked = 0
+    max_rel_err = 0.0
+    consistency_errors: list[str] = []
+
+    def vector_of(query: str) -> dict:
+        conn.request("GET", f"/api/v1/query?db={db}&query={quote(query)}"
+                            f"&time={te_ns / 1e9}")
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+        if resp.status != 200 or doc.get("status") != "success":
+            raise RuntimeError(f"consistency query failed: {doc}")
+        return {r["metric"].get("job", ""): float(r["value"][1])
+                for r in doc["data"]["result"]}
+
+    if te_ns is not None:
+        for name, expr in recordings[:3]:
+            try:
+                rec = vector_of(name)
+                ond = vector_of(expr)
+            except (RuntimeError, OSError, ValueError,
+                    http.client.HTTPException) as e:
+                consistency_errors.append(f"{name}: {e}")
+                continue
+            for job, want in ond.items():
+                got = rec.get(job)
+                if got is None:
+                    consistency_errors.append(f"{name}: missing {job!r}")
+                    continue
+                rel = abs(got - want) / max(abs(want), 1e-12)
+                max_rel_err = max(max_rel_err, rel)
+                checked += 1
+    try:
+        conn.close()
+    except OSError:
+        pass
+    consistent = (checked > 0 and not consistency_errors
+                  and max_rel_err <= 1e-3)
+
+    ticks = sorted((ts, dt) for (ts, dt) in tick_events if ts >= warmup_s)
+    half = warmup_s + (wall_s - warmup_s) / 2.0
+    first = [dt for (ts, dt) in ticks if ts < half]
+    second = [dt for (ts, dt) in ticks if ts >= half]
+    p99_first = _lat_summary(first)["p99_ms"]
+    p99_second = _lat_summary(second)["p99_ms"]
+    # flat: per-tick cost must not grow with accumulated data — the
+    # second half's p99 stays within 2.5x + a 5ms jitter floor of the
+    # first half's (same tolerance as the churn scenario)
+    flat_ok = (not second or not first
+               or p99_second <= max(p99_first * 2.5, p99_first + 5.0))
+    q_all = sorted((ts, dt) for lst in q_events for (ts, dt) in lst
+                   if ts >= warmup_s)
+    return {
+        "scenario": "rule_fleet",
+        "clients": clients,
+        "duration_s": round(wall_s, 3),
+        "warmup_s": warmup_s,
+        "rules": rules,
+        "series": series,
+        "ticks_measured": len(ticks),
+        "tick_ms": _lat_summary([dt for (_, dt) in ticks]),
+        "tick_p99_first_half_ms": p99_first,
+        "tick_p99_second_half_ms": p99_second,
+        "tick_flat_ok": bool(flat_ok),
+        "recorded_consistent": bool(consistent),
+        "recorded_checked": checked,
+        "recorded_max_rel_err": max_rel_err,
+        "consistency_errors": consistency_errors[:10],
+        "alerts_firing": g.get("alerts_firing", 0),
+        "writes": _lat_summary(
+            [v for st in states for v in st.write_lat]),
+        "queries": _lat_summary([dt for (_, dt) in q_all]),
+        "sheds": sum(st.sheds_429 + st.sheds_503 for st in states),
+        "errors": sum(st.errors for st in states),
+        "error_samples": [s for st in states
+                          for s in st.error_samples][:10],
+        "stuck_clients": sum(1 for t in threads if t.is_alive()),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--host", default="127.0.0.1")
@@ -1061,7 +1355,7 @@ def main() -> None:
                     help="append each acked batch to this fsynced journal")
     ap.add_argument("--scenario", default="mixed",
                     choices=("mixed", "dashboard", "mixed_shapes",
-                             "cardinality_churn"),
+                             "cardinality_churn", "rule_fleet"),
                     help="'dashboard' = zipf-tenant dashboard fleet "
                          "(repeated identical GROUP BY time() reads + "
                          "live ingest, per-tenant p50/p99 + sheds); "
@@ -1071,8 +1365,16 @@ def main() -> None:
                          "'cardinality_churn' = pod-style labels churn "
                          "under live ingest while readers run regex + "
                          "negative selectors; asserts flat query p99 "
-                         "(label-tier rebuilds stay bounded)")
+                         "(label-tier rebuilds stay bounded); "
+                         "'rule_fleet' = recording+alert rule fleet "
+                         "ticking over live counter ingest while "
+                         "readers query the recorded series; asserts "
+                         "flat per-tick p99 and recorded-vs-on-demand "
+                         "consistency")
     ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--rules", type=int, default=200,
+                    help="rule_fleet scenario: fleet size (half "
+                         "recording rules, half threshold alerts)")
     ap.add_argument("--zipf", type=float, default=1.2,
                     help="zipf exponent for tenant popularity")
     ap.add_argument("--metrics-poll", type=float, default=None,
@@ -1081,6 +1383,12 @@ def main() -> None:
                          "this interval and report acked-rows vs "
                          "ogt_write_rows_total consistency")
     args = ap.parse_args()
+    if args.scenario == "rule_fleet":
+        out = run_rule_fleet(
+            args.host, args.port, clients=args.clients,
+            duration_s=args.duration, rules=args.rules)
+        print(json.dumps(out, indent=1))
+        return
     if args.scenario == "cardinality_churn":
         out = run_cardinality_churn(
             args.host, args.port, clients=args.clients,

@@ -110,6 +110,11 @@ NOT_ON_CHAIN = {
     # the durable `.quar` marker re-detects on the next open
     # (idempotent); driven deterministically by tests/test_diskfault.py
     "quarantine-before-mark",
+    # continuous-rule claim edge (promql/rules.py): the torture child
+    # declares no rule groups; the mark-before-eval crash contract
+    # (claimed tick re-evaluates once, no double-fire) is driven
+    # deterministically by tests/test_rules.py
+    "rules-mark-before-eval",
 }
 
 _METRIC_NAME = re.compile(r"^[a-z][a-z0-9_]*$")
